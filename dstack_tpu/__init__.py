@@ -1,0 +1,22 @@
+"""dstack_tpu — a TPU-native AI-workload orchestration framework.
+
+A from-scratch control plane with the capabilities of dstack
+(reference: src/dstack/_internal at /root/reference), re-designed so that
+TPU pod slices are the first-class unit of compute:
+
+- declarative run configurations (tasks, services, dev environments) and
+  fleets/volumes/gateways, validated by pydantic models;
+- an asyncio control-plane server (REST + sqlite/postgres + interval
+  reconcilers) that plans, provisions and supervises runs;
+- a GCP ``tpu_v2`` backend that provisions single- and multi-host TPU
+  slices (the reference supports single-host only,
+  cf. reference gcp/compute.py:699-726);
+- native C++ host agents (``tpu-shim``/``tpu-runner``) that detect TPUs,
+  pass ``/dev/accel*``/``/dev/vfio`` into containers and inject the JAX
+  multi-host rendezvous environment;
+- a TPU compute library (``dstack_tpu.models`` / ``ops`` / ``parallel`` /
+  ``train``): JAX/pallas models with dp/fsdp/tp/sp mesh parallelism used
+  by the built-in examples and benchmarks.
+"""
+
+from dstack_tpu.version import __version__  # noqa: F401

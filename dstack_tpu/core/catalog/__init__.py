@@ -1,0 +1,7 @@
+from dstack_tpu.core.catalog.tpu import (  # noqa: F401
+    CatalogItem,
+    TPU_SLICES,
+    TPUSliceShape,
+    query_slices,
+    slice_name,
+)

@@ -1,0 +1,219 @@
+"""TPU slice catalog — the gpuhunt equivalent for TPUs.
+
+The reference resolves offers through the external ``gpuhunt`` package
+(reference base/offers.py:24-152, ``KNOWN_TPUS`` at gcp/compute.py:9,66)
+and **filters out multi-host slices** (gcp/compute.py:699-726). This
+catalog makes multi-host pod slices first-class: every entry is a whole
+slice — generation, ICI topology, chip count, worker-host count — priced
+per slice-hour, across regions, on-demand and spot.
+
+Data is approximate public GCP pricing (catalog data, easily refreshed);
+the scheduler only relies on relative ordering and shapes.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from dstack_tpu.core.models.instances import Resources, TPUInfo
+from dstack_tpu.core.models.resources import ResourcesSpec, topology_chips
+
+
+@dataclass(frozen=True)
+class TPUGenerationInfo:
+    name: str
+    chips_per_host: int
+    hbm_gib_per_chip: float
+    tflops_bf16_per_chip: float
+    # per-chip-hour USD (on-demand, us-central-ish); spot multiplier applied below
+    price_per_chip_hour: float
+    spot_discount: float
+    host_vcpus: int  # per worker host
+    host_memory_gib: int
+    regions: tuple[str, ...]
+    dims: int  # ICI topology dimensionality (2 or 3)
+    # name convention: "cores" generations name slices by 2*chips (v2/v3/v4/v5p)
+    names_by_cores: bool
+    gcp_prefix: str  # accelerator-type prefix, e.g. "v5litepod"
+
+
+GENERATIONS: dict[str, TPUGenerationInfo] = {
+    "v2": TPUGenerationInfo(
+        "v2", 4, 8.0, 46.0, 1.125, 0.6, 96, 340,
+        ("us-central1", "europe-west4", "asia-east1"), 2, True, "v2",
+    ),
+    "v3": TPUGenerationInfo(
+        "v3", 4, 16.0, 123.0, 2.00, 0.6, 96, 340,
+        ("us-central1", "europe-west4"), 2, True, "v3",
+    ),
+    "v4": TPUGenerationInfo(
+        "v4", 4, 32.0, 275.0, 3.22, 0.6, 240, 400,
+        ("us-central2",), 3, True, "v4",
+    ),
+    "v5e": TPUGenerationInfo(
+        "v5e", 8, 16.0, 197.0, 1.20, 0.55, 224, 400,
+        ("us-central1", "us-west4", "us-east1", "europe-west4", "asia-southeast1"),
+        2, False, "v5litepod",
+    ),
+    "v5p": TPUGenerationInfo(
+        "v5p", 4, 95.0, 459.0, 4.20, 0.55, 208, 448,
+        ("us-central1", "us-east5", "europe-west4"), 3, True, "v5p",
+    ),
+    "v6e": TPUGenerationInfo(
+        "v6e", 8, 32.0, 918.0, 2.70, 0.55, 180, 720,
+        ("us-central2", "us-east1", "us-east5", "europe-west4", "asia-northeast1"),
+        2, False, "v6e",
+    ),
+}
+
+# Topology ladders per generation. Single-host entries first.
+# 2D generations (v5e/v6e): chips = x*y; hosts = ceil(chips / chips_per_host)
+_TOPOLOGIES_2D = ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16", "16x16"]
+# legacy 2D (v2/v3): 4 chips/host
+_TOPOLOGIES_2D_LEGACY = ["2x2", "4x4", "4x8", "8x8", "8x16", "16x16", "16x32", "32x32"]
+# 3D generations (v4/v5p): chips = x*y*z; 4 chips/host
+_TOPOLOGIES_3D = [
+    "2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4", "4x4x8", "4x8x8", "8x8x8",
+    "8x8x16", "8x16x16", "16x16x16",
+]
+
+_MAX_CHIPS = {"v2": 512, "v3": 1024, "v4": 4096, "v5e": 256, "v5p": 8960, "v6e": 256}
+
+
+@dataclass(frozen=True)
+class TPUSliceShape:
+    version: str
+    topology: str
+    chips: int
+    hosts: int
+
+    @property
+    def single_host(self) -> bool:
+        return self.hosts == 1
+
+
+def _topologies_for(gen: TPUGenerationInfo) -> list[str]:
+    if gen.dims == 3:
+        return _TOPOLOGIES_3D
+    if gen.name in ("v2", "v3"):
+        return _TOPOLOGIES_2D_LEGACY
+    return _TOPOLOGIES_2D
+
+
+def _shapes() -> list[TPUSliceShape]:
+    out = []
+    for gen in GENERATIONS.values():
+        for topo in _topologies_for(gen):
+            chips = topology_chips(topo)
+            if chips > _MAX_CHIPS[gen.name]:
+                continue
+            hosts = max(1, math.ceil(chips / gen.chips_per_host))
+            out.append(TPUSliceShape(gen.name, topo, chips, hosts))
+    return out
+
+
+TPU_SLICES: list[TPUSliceShape] = _shapes()
+
+
+def slice_name(version: str, chips: int) -> str:
+    """Public slice name: ``v5litepod-16``, ``v5p-128`` (cores), ``v6e-8``."""
+    gen = GENERATIONS[version]
+    n = chips * 2 if gen.names_by_cores else chips
+    return f"{gen.gcp_prefix}-{n}"
+
+
+@dataclass
+class CatalogItem:
+    version: str
+    topology: str
+    chips: int
+    hosts: int
+    region: str
+    price: float  # $/hour for the whole slice
+    spot: bool
+    instance_name: str = ""
+    resources: Optional[Resources] = None
+
+    def __post_init__(self) -> None:
+        gen = GENERATIONS[self.version]
+        if not self.instance_name:
+            self.instance_name = slice_name(self.version, self.chips)
+        if self.resources is None:
+            self.resources = Resources(
+                cpus=gen.host_vcpus * self.hosts,
+                memory_mib=gen.host_memory_gib * 1024 * self.hosts,
+                spot=self.spot,
+                disk_size_mib=100 * 1024,
+                tpu=TPUInfo(
+                    version=self.version,
+                    chips=self.chips,
+                    topology=self.topology,
+                    hosts=self.hosts,
+                    chips_per_host=min(gen.chips_per_host, self.chips),
+                    hbm_gib_per_chip=gen.hbm_gib_per_chip,
+                    tflops_bf16_per_chip=gen.tflops_bf16_per_chip,
+                ),
+            )
+
+
+def iter_catalog(
+    versions: Optional[list[str]] = None,
+    regions: Optional[list[str]] = None,
+    spot: Optional[bool] = None,
+) -> Iterator[CatalogItem]:
+    for shape in TPU_SLICES:
+        if versions is not None and shape.version not in versions:
+            continue
+        gen = GENERATIONS[shape.version]
+        for region in gen.regions:
+            if regions is not None and region not in regions:
+                continue
+            for is_spot in (False, True):
+                if spot is not None and is_spot != spot:
+                    continue
+                price = gen.price_per_chip_hour * shape.chips
+                if is_spot:
+                    price *= gen.spot_discount
+                yield CatalogItem(
+                    version=shape.version,
+                    topology=shape.topology,
+                    chips=shape.chips,
+                    hosts=shape.hosts,
+                    region=region,
+                    price=round(price, 2),
+                    spot=is_spot,
+                )
+
+
+def query_slices(
+    resources: ResourcesSpec,
+    regions: Optional[list[str]] = None,
+    spot: Optional[bool] = None,
+    max_price: Optional[float] = None,
+) -> list[CatalogItem]:
+    """Filter the catalog by a :class:`ResourcesSpec`.
+
+    Mirrors gpuhunt's ``Catalog.query`` filter shape
+    (reference base/offers.py:118-152) for TPU slices.
+    """
+    tpu = resources.tpu
+    if tpu is None:
+        return []
+    items = []
+    for item in iter_catalog(versions=tpu.version, regions=regions, spot=spot):
+        if not tpu.chips.contains(item.chips):
+            continue
+        if tpu.topology is not None and tpu.topology != item.topology:
+            continue
+        assert item.resources is not None
+        if not resources.cpu.count.contains(item.resources.cpus):
+            # host CPUs come with the slice; only reject if user demands more
+            if resources.cpu.count.min is not None and item.resources.cpus < resources.cpu.count.min:
+                continue
+        if resources.memory.min is not None and item.resources.memory_mib / 1024 < resources.memory.min:
+            continue
+        if max_price is not None and item.price > max_price:
+            continue
+        items.append(item)
+    items.sort(key=lambda it: (it.price, it.chips, it.region))
+    return items

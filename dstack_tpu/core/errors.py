@@ -1,0 +1,96 @@
+"""Framework error hierarchy.
+
+Parity: reference src/dstack/_internal/core/errors.py.
+"""
+
+
+class DstackTPUError(Exception):
+    """Base for all framework errors."""
+
+
+class ServerError(DstackTPUError):
+    pass
+
+
+class ClientError(DstackTPUError):
+    """4xx-class error; message is safe to show to the user."""
+
+    code = "error"
+    http_status = 400
+
+    @property
+    def msg(self) -> str:
+        return str(self.args[0]) if self.args else self.__class__.__name__
+
+
+class ConfigurationError(ClientError):
+    code = "configuration_error"
+
+
+class ResourceNotExistsError(ClientError):
+    code = "resource_not_exists"
+    http_status = 404
+
+
+class ResourceExistsError(ClientError):
+    code = "resource_exists"
+    http_status = 409
+
+
+class ForbiddenError(ClientError):
+    code = "forbidden"
+    http_status = 403
+
+
+class UnauthorizedError(ClientError):
+    code = "unauthorized"
+    http_status = 401
+
+
+class MethodNotAllowedError(ClientError):
+    code = "method_not_allowed"
+    http_status = 405
+
+
+class NoCapacityError(ServerError):
+    pass
+
+
+class BackendError(ServerError):
+    pass
+
+
+class BackendAuthError(BackendError):
+    pass
+
+
+class ComputeError(BackendError):
+    pass
+
+
+class NotYetTerminated(ComputeError):
+    """Instance termination is in progress; retry later."""
+
+
+class ProvisioningError(BackendError):
+    pass
+
+
+class PlacementGroupInUseError(BackendError):
+    pass
+
+
+class AgentError(ServerError):
+    """Shim/runner API request failed."""
+
+
+class AgentNotReady(AgentError):
+    """Agent not reachable yet (instance still booting)."""
+
+
+class SSHError(DstackTPUError):
+    pass
+
+
+class GatewayError(ServerError):
+    pass

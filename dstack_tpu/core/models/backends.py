@@ -1,0 +1,31 @@
+"""Backend types and base config models.
+
+Parity: reference src/dstack/_internal/core/models/backends/base.py.
+The set is intentionally smaller: TPU-relevant backends only, with
+``LOCAL`` for dev/tests and ``REMOTE`` for on-prem SSH fleets.
+"""
+
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class BackendType(str, Enum):
+    GCP = "gcp"  # the TPU cloud backend (tpu_v2 API)
+    LOCAL = "local"  # dev backend: agents on this machine
+    REMOTE = "remote"  # on-prem SSH fleets (user-supplied TPU hosts)
+    KUBERNETES = "kubernetes"  # GKE TPU node pools
+
+    def pretty(self) -> str:
+        return self.value
+
+
+class ConfigElementValue(CoreModel):
+    value: str
+    label: Optional[str] = None
+
+
+class ConfigElement(CoreModel):
+    selected: Optional[str] = None
+    values: list[ConfigElementValue] = []

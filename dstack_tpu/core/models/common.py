@@ -1,0 +1,87 @@
+"""Shared pydantic base types.
+
+Parity: reference src/dstack/_internal/core/models/common.py.
+"""
+
+import re
+from enum import Enum
+from typing import Annotated, Any, Optional, Union
+
+from pydantic import BaseModel, BeforeValidator, ConfigDict
+
+
+class CoreModel(BaseModel):
+    """Base for every wire/config model: forbid unknown keys in user
+    configs is handled per-model; default is tolerant parse, strict dump."""
+
+    model_config = ConfigDict(populate_by_name=True, use_enum_values=False)
+
+    def dict(self, *args: Any, **kwargs: Any) -> dict:  # pydantic-v1 style alias
+        return self.model_dump(*args, **kwargs)
+
+    def json(self, *args: Any, **kwargs: Any) -> str:
+        return self.model_dump_json(*args, **kwargs)
+
+
+_DURATION_RE = re.compile(r"^(?P<amount>\d+)(?P<unit>s|m|h|d|w)?$")
+_DURATION_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 24 * 3600, "w": 7 * 24 * 3600}
+
+
+def parse_duration(v: Union[int, str, None]) -> Optional[int]:
+    """``90``, ``"90s"``, ``"15m"``, ``"2h"``, ``"1d"``, ``"1w"`` → seconds.
+
+    Parity: reference core/models/profiles.py:parse_duration.
+    """
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return int(v)
+    if isinstance(v, str):
+        if v.lower() in ("off", "-1"):
+            return -1
+        m = _DURATION_RE.match(v.strip())
+        if m is None:
+            raise ValueError(f"invalid duration: {v!r}")
+        return int(m.group("amount")) * _DURATION_UNITS[m.group("unit") or "s"]
+    raise ValueError(f"invalid duration: {v!r}")
+
+
+def format_duration(seconds: Optional[int]) -> Optional[str]:
+    if seconds is None:
+        return None
+    if seconds < 0:
+        return "off"
+    for unit, mul in (("w", 7 * 86400), ("d", 86400), ("h", 3600), ("m", 60)):
+        if seconds % mul == 0 and seconds >= mul:
+            return f"{seconds // mul}{unit}"
+    return f"{seconds}s"
+
+
+Duration = Annotated[int, BeforeValidator(parse_duration)]
+
+
+class RegistryAuth(CoreModel):
+    """Private container registry credentials.
+
+    Parity: reference core/models/common.py:RegistryAuth.
+    """
+
+    username: Optional[str] = None
+    password: Optional[str] = None
+
+
+class ApplyAction(str, Enum):
+    CREATE = "create"
+    UPDATE = "update"
+
+
+class IncludeExcludeType(CoreModel):
+    include: Optional[list[str]] = None
+    exclude: Optional[list[str]] = None
+
+
+def is_core_model_subclass(t: Any) -> bool:
+    try:
+        return issubclass(t, CoreModel)
+    except TypeError:
+        return False

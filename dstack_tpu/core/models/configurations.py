@@ -1,0 +1,410 @@
+"""Declarative run/fleet/volume/gateway configurations (the user YAML).
+
+Parity: reference src/dstack/_internal/core/models/configurations.py:368-433
+(discriminated union on ``type``, JSON-schema exportable) — TPU-first:
+``resources.tpu`` is the accelerator spec, ``nodes`` on a task means TPU
+worker hosts when the matched offer is a multi-host slice.
+"""
+
+import re
+from enum import Enum
+from typing import Annotated, Any, Literal, Optional, Union
+
+from pydantic import Field, field_validator, model_validator
+
+from dstack_tpu.core.models.common import CoreModel, Duration, RegistryAuth
+from dstack_tpu.core.models.profiles import ProfileParams
+from dstack_tpu.core.models.resources import Memory, ResourcesSpec
+
+RUN_NAME_RE = re.compile(r"^[a-z][a-z0-9-]{1,40}$")
+
+DEFAULT_REPO_DIR = "/workflow"
+
+
+class RunConfigurationType(str, Enum):
+    TASK = "task"
+    SERVICE = "service"
+    DEV_ENVIRONMENT = "dev-environment"
+
+
+class ConfigurationType(str, Enum):
+    TASK = "task"
+    SERVICE = "service"
+    DEV_ENVIRONMENT = "dev-environment"
+    FLEET = "fleet"
+    VOLUME = "volume"
+    GATEWAY = "gateway"
+
+
+class PythonVersion(str, Enum):
+    PY310 = "3.10"
+    PY311 = "3.11"
+    PY312 = "3.12"
+
+
+class PortMapping(CoreModel):
+    local_port: Optional[int] = None
+    container_port: int
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        """Accept ``8000``, ``"8000"``, ``"80:8000"``, ``"*:8000"``."""
+        if isinstance(v, int):
+            return {"local_port": v, "container_port": v}
+        if isinstance(v, str):
+            parts = v.split(":")
+            if len(parts) == 1:
+                return {"local_port": int(parts[0]), "container_port": int(parts[0])}
+            if len(parts) == 2:
+                local = None if parts[0] in ("*", "") else int(parts[0])
+                return {"local_port": local, "container_port": int(parts[1])}
+            raise ValueError(f"invalid port mapping {v!r}")
+        return v
+
+
+class Env(CoreModel):
+    """Env var block: list of ``K=V`` / bare ``K`` (filled from caller env
+    at apply time) or a mapping."""
+
+    vars: dict[str, Optional[str]] = {}
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if isinstance(v, dict) and "vars" not in v:
+            return {"vars": {str(k): (None if val is None else str(val)) for k, val in v.items()}}
+        if isinstance(v, list):
+            out: dict[str, Optional[str]] = {}
+            for item in v:
+                if "=" in item:
+                    k, _, val = item.partition("=")
+                    out[k] = val
+                else:
+                    out[item] = None
+            return {"vars": out}
+        return v
+
+    def as_dict(self) -> dict[str, str]:
+        return {k: v for k, v in self.vars.items() if v is not None}
+
+    def __bool__(self) -> bool:
+        return bool(self.vars)
+
+
+class ScalingSpec(CoreModel):
+    """Service autoscaling target.
+
+    Parity: reference core/models/configurations.py ``ScalingSpec``
+    (metric ``rps``, consumed by RPSAutoscaler, services/autoscalers.py:60).
+    """
+
+    metric: Literal["rps"] = "rps"
+    target: float = 10.0
+    scale_up_delay: Duration = 300
+    scale_down_delay: Duration = 600
+
+
+class ServiceModelSpec(CoreModel):
+    """Registers the service in the OpenAI-compatible model gateway
+    (/proxy/models), cf. reference proxy/lib/routers/model_proxy.py."""
+
+    name: str
+    format: Literal["openai", "tgi"] = "openai"
+    prefix: str = "/v1"
+
+
+class VolumeMountPoint(CoreModel):
+    name: str
+    path: str
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            src, _, dst = v.partition(":")
+            return {"name": src, "path": dst}
+        return v
+
+
+class InstanceMountPoint(CoreModel):
+    instance_path: str
+    path: str
+    optional: bool = False
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            src, _, dst = v.partition(":")
+            return {"instance_path": src, "path": dst}
+        return v
+
+
+AnyMountPoint = Union[VolumeMountPoint, InstanceMountPoint]
+
+
+def _parse_mount(v: Any) -> Any:
+    if isinstance(v, str) and v.startswith("/"):
+        return InstanceMountPoint.model_validate(v)
+    return v
+
+
+class RepoSpec(CoreModel):
+    """Code to materialize in the container: local dir upload or git URL."""
+
+    path: Optional[str] = None  # local path (uploaded as archive + diff)
+    url: Optional[str] = None  # git remote
+    branch: Optional[str] = None
+    hash: Optional[str] = None
+
+
+class BaseRunConfiguration(ProfileParams):
+    type: str
+    name: Optional[str] = None
+    image: Optional[str] = None
+    privileged: bool = False
+    entrypoint: Optional[str] = None
+    registry_auth: Optional[RegistryAuth] = None
+    python: Optional[PythonVersion] = None
+    nvcc: bool = False  # kept for config-compat; ignored on TPU
+    single_branch: Optional[bool] = None
+    env: Env = Env()
+    secrets: list[str] = []
+    shell: Optional[str] = None
+    home_dir: str = "/root"
+    resources: ResourcesSpec = ResourcesSpec()
+    volumes: list[AnyMountPoint] = []
+    working_dir: Optional[str] = None
+    repos: list[RepoSpec] = []
+
+    @field_validator("volumes", mode="before")
+    @classmethod
+    def _mounts(cls, v: Any) -> Any:
+        if isinstance(v, list):
+            return [_parse_mount(x) for x in v]
+        return v
+
+    @field_validator("name")
+    @classmethod
+    def _name(cls, v: Optional[str]) -> Optional[str]:
+        if v is not None and RUN_NAME_RE.match(v) is None:
+            raise ValueError(
+                f"invalid run name {v!r}: must match {RUN_NAME_RE.pattern}"
+            )
+        return v
+
+
+class TaskConfiguration(BaseRunConfiguration):
+    """Batch job. ``nodes`` is the number of worker processes, one per TPU
+    worker host; for a multi-host slice set ``nodes`` equal to the slice's
+    host count (or leave 1 and let the framework expand it to the slice,
+    cf. services/jobs/configurators).
+    """
+
+    type: Literal["task"] = "task"
+    commands: list[str] = []
+    ports: list[PortMapping] = []
+    nodes: int = 1
+
+    @field_validator("nodes")
+    @classmethod
+    def _nodes(cls, v: int) -> int:
+        if v < 1:
+            raise ValueError("nodes must be >= 1")
+        return v
+
+
+class ServiceConfiguration(BaseRunConfiguration):
+    type: Literal["service"] = "service"
+    commands: list[str] = []
+    port: PortMapping = PortMapping(local_port=80, container_port=8000)
+    gateway: Optional[Union[bool, str]] = None
+    strip_prefix: bool = True
+    model: Optional[Union[ServiceModelSpec, str]] = None
+    https: bool = True
+    auth: bool = True
+    replicas: Any = None  # Range[int]; parsed below
+    scaling: Optional[ScalingSpec] = None
+
+    @field_validator("model", mode="before")
+    @classmethod
+    def _model(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            return ServiceModelSpec(name=v)
+        return v
+
+    @model_validator(mode="after")
+    def _replicas(self) -> "ServiceConfiguration":
+        from dstack_tpu.core.models.resources import IntRange
+
+        if self.replicas is None:
+            self.replicas = IntRange(min=1, max=1)
+        elif not isinstance(self.replicas, IntRange):
+            self.replicas = IntRange.model_validate(self.replicas)
+        if self.replicas.min != self.replicas.max and self.scaling is None:
+            raise ValueError("autoscaling range requires a `scaling` spec")
+        return self
+
+
+class DevEnvironmentConfiguration(BaseRunConfiguration):
+    type: Literal["dev-environment"] = "dev-environment"
+    ide: Literal["vscode", "cursor", "none"] = "vscode"
+    version: Optional[str] = None
+    init: list[str] = []
+    inactivity_duration: Optional[Union[bool, Duration]] = None
+
+    @field_validator("inactivity_duration", mode="before")
+    @classmethod
+    def _inactivity(cls, v: Any) -> Any:
+        if v is False:
+            return None
+        return v
+
+
+AnyRunConfiguration = Annotated[
+    Union[TaskConfiguration, ServiceConfiguration, DevEnvironmentConfiguration],
+    Field(discriminator="type"),
+]
+
+
+# ---- fleet / volume / gateway configurations (applied via `dtpu apply` too) ----
+
+
+class SSHHostParams(CoreModel):
+    hostname: str
+    port: int = 22
+    user: Optional[str] = None
+    identity_file: Optional[str] = None
+    internal_ip: Optional[str] = None
+    blocks: int = 1
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            return {"hostname": v}
+        return v
+
+
+class SSHParams(CoreModel):
+    user: Optional[str] = None
+    port: int = 22
+    identity_file: Optional[str] = None
+    hosts: list[SSHHostParams] = []
+    network: Optional[str] = None
+    proxy_jump: Optional[SSHHostParams] = None
+
+
+class InstanceGroupPlacement(str, Enum):
+    ANY = "any"
+    CLUSTER = "cluster"
+
+
+class FleetConfiguration(ProfileParams):
+    type: Literal["fleet"] = "fleet"
+    name: Optional[str] = None
+    env: Env = Env()
+    ssh_config: Optional[SSHParams] = None  # SSH fleet (on-prem TPU hosts)
+    nodes: Any = None  # Range[int] — cloud fleet size
+    placement: InstanceGroupPlacement = InstanceGroupPlacement.ANY
+    resources: ResourcesSpec = ResourcesSpec()
+    blocks: int = 1
+
+    @model_validator(mode="after")
+    def _check(self) -> "FleetConfiguration":
+        from dstack_tpu.core.models.resources import IntRange
+
+        if self.nodes is not None and not isinstance(self.nodes, IntRange):
+            self.nodes = IntRange.model_validate(self.nodes)
+        if self.ssh_config is None and self.nodes is None:
+            raise ValueError("fleet requires either `nodes` or `ssh_config`")
+        if self.ssh_config is not None and self.nodes is not None:
+            raise ValueError("`nodes` and `ssh_config` are mutually exclusive")
+        return self
+
+
+class VolumeConfiguration(CoreModel):
+    type: Literal["volume"] = "volume"
+    name: Optional[str] = None
+    backend: Optional[str] = None
+    region: Optional[str] = None
+    availability_zone: Optional[str] = None
+    size: Optional[Memory] = None
+    volume_id: Optional[str] = None  # register an existing disk
+    auto_cleanup_duration: Optional[Union[Duration, bool]] = None
+    tags: Optional[dict[str, str]] = None
+
+    @model_validator(mode="after")
+    def _check(self) -> "VolumeConfiguration":
+        if self.size is None and self.volume_id is None:
+            raise ValueError("volume requires `size` or `volume_id`")
+        return self
+
+
+class GatewayConfiguration(CoreModel):
+    type: Literal["gateway"] = "gateway"
+    name: Optional[str] = None
+    backend: str = "gcp"
+    region: str = "us-central2"
+    domain: Optional[str] = None
+    public_ip: bool = True
+    certificate: Optional[str] = None  # "lets-encrypt" | "acm" | None
+    tags: Optional[dict[str, str]] = None
+
+
+AnyApplyConfiguration = Annotated[
+    Union[
+        TaskConfiguration,
+        ServiceConfiguration,
+        DevEnvironmentConfiguration,
+        FleetConfiguration,
+        VolumeConfiguration,
+        GatewayConfiguration,
+    ],
+    Field(discriminator="type"),
+]
+
+
+class _ApplyWrapper(CoreModel):
+    config: AnyApplyConfiguration
+
+
+def parse_apply_configuration(data: dict) -> Union[
+    TaskConfiguration,
+    ServiceConfiguration,
+    DevEnvironmentConfiguration,
+    FleetConfiguration,
+    VolumeConfiguration,
+    GatewayConfiguration,
+]:
+    """Parse a user config dict (from YAML) into the right model.
+
+    Parity: reference core/models/configurations.py:410
+    (``parse_run_configuration`` / discriminated union).
+    """
+    if not isinstance(data, dict) or "type" not in data:
+        raise ValueError("configuration must be a mapping with a `type` key")
+    return _ApplyWrapper.model_validate({"config": data}).config
+
+
+def parse_run_configuration(data: dict) -> Union[
+    TaskConfiguration, ServiceConfiguration, DevEnvironmentConfiguration
+]:
+    conf = parse_apply_configuration(data)
+    if not isinstance(
+        conf, (TaskConfiguration, ServiceConfiguration, DevEnvironmentConfiguration)
+    ):
+        raise ValueError(f"not a run configuration: type={conf.type}")
+    return conf
+
+
+def configuration_json_schema() -> dict:
+    """JSON schema for the full apply-configuration union (IDE completion).
+
+    Parity: reference exports schema via pydantic too
+    (core/models/configurations.py:368-433).
+    """
+    from pydantic import TypeAdapter
+
+    return TypeAdapter(AnyApplyConfiguration).json_schema()

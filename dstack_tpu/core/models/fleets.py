@@ -1,0 +1,49 @@
+"""Fleet models (pools of instances / TPU slices).
+
+Parity: reference src/dstack/_internal/core/models/fleets.py.
+"""
+
+from datetime import datetime
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.configurations import FleetConfiguration
+from dstack_tpu.core.models.instances import Instance
+from dstack_tpu.core.models.profiles import Profile
+
+
+class FleetStatus(str, Enum):
+    ACTIVE = "active"
+    SUBMITTED = "submitted"
+    TERMINATING = "terminating"
+    FAILED = "failed"
+
+
+class FleetSpec(CoreModel):
+    configuration: FleetConfiguration
+    configuration_path: Optional[str] = None
+    profile: Optional[Profile] = None
+    autocreated: bool = False
+
+
+class Fleet(CoreModel):
+    id: str
+    name: str
+    project_name: str
+    spec: FleetSpec
+    created_at: Optional[datetime] = None
+    status: FleetStatus = FleetStatus.ACTIVE
+    status_message: Optional[str] = None
+    instances: list[Instance] = []
+
+
+class FleetPlan(CoreModel):
+    project_name: str
+    user: str
+    spec: FleetSpec
+    current_resource: Optional[Fleet] = None
+    offers: list = []
+    total_offers: int = 0
+    max_offer_price: Optional[float] = None
+    action: str = "create"

@@ -1,0 +1,50 @@
+"""Gateway models (HTTPS ingress VMs for services).
+
+Parity: reference src/dstack/_internal/core/models/gateways.py.
+"""
+
+from datetime import datetime
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.configurations import GatewayConfiguration
+
+
+class GatewayStatus(str, Enum):
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    RUNNING = "running"
+    FAILED = "failed"
+
+
+class GatewayProvisioningData(CoreModel):
+    instance_id: str
+    ip_address: Optional[str] = None
+    region: str = ""
+    availability_zone: Optional[str] = None
+    hostname: Optional[str] = None
+    backend_data: Optional[str] = None
+
+
+class Gateway(CoreModel):
+    id: str
+    name: str
+    project_name: str
+    configuration: GatewayConfiguration
+    created_at: Optional[datetime] = None
+    status: GatewayStatus = GatewayStatus.SUBMITTED
+    status_message: Optional[str] = None
+    ip_address: Optional[str] = None
+    hostname: Optional[str] = None
+    backend: Optional[BackendType] = None
+    default: bool = False
+
+
+class GatewayPlan(CoreModel):
+    project_name: str
+    user: str
+    spec: GatewayConfiguration
+    current_resource: Optional[Gateway] = None
+    action: str = "create"

@@ -1,0 +1,173 @@
+"""Concrete instance/offer models.
+
+Parity: reference src/dstack/_internal/core/models/instances.py.
+TPU-first difference: an *instance* may be a **multi-host pod slice** —
+``Resources.tpu.hosts > 1`` — provisioned and torn down atomically; each
+worker host runs its own shim/runner agent and gets its own job
+(cf. SURVEY.md §2.6).
+"""
+
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.common import CoreModel
+
+
+class TPUInfo(CoreModel):
+    """A concrete TPU slice inside an instance offer."""
+
+    version: str  # v2|v3|v4|v5e|v5p|v6e
+    chips: int  # total chips in the slice
+    topology: str  # ICI topology, e.g. "2x4", "4x4x4"
+    hosts: int = 1  # worker VMs in the slice (multi-host pod slice if > 1)
+    chips_per_host: int = 8
+    hbm_gib_per_chip: float = 16.0
+    tflops_bf16_per_chip: float = 197.0
+
+    @property
+    def accelerator_type(self) -> str:
+        """GCP accelerator-type string, e.g. ``v5litepod-8``."""
+        gen = {"v5e": "v5litepod", "v6e": "v6e"}.get(self.version, self.version)
+        return f"{gen}-{self.chips}"
+
+
+class Resources(CoreModel):
+    cpus: int
+    memory_mib: int
+    tpu: Optional[TPUInfo] = None
+    spot: bool = False
+    disk_size_mib: int = 102400
+    description: str = ""
+
+    def pretty_format(self) -> str:
+        s = f"{self.cpus}xCPU, {self.memory_mib / 1024:g}GB"
+        if self.tpu is not None:
+            s += f", {self.tpu.version}-{self.tpu.chips} ({self.tpu.topology}, {self.tpu.hosts} host{'s' if self.tpu.hosts > 1 else ''})"
+        s += f", {self.disk_size_mib / 1024:g}GB disk"
+        if self.spot:
+            s += " (spot)"
+        return s
+
+
+class InstanceType(CoreModel):
+    name: str
+    resources: Resources
+
+
+class InstanceAvailability(str, Enum):
+    UNKNOWN = "unknown"
+    AVAILABLE = "available"
+    NOT_AVAILABLE = "not_available"
+    NO_QUOTA = "no_quota"
+    IDLE = "idle"  # pool instance ready for reuse
+    BUSY = "busy"
+
+    @property
+    def is_available(self) -> bool:
+        return self in (
+            InstanceAvailability.UNKNOWN,
+            InstanceAvailability.AVAILABLE,
+            InstanceAvailability.IDLE,
+        )
+
+
+class InstanceOffer(CoreModel):
+    backend: BackendType
+    instance: InstanceType
+    region: str
+    price: float  # $/hour for the whole slice
+    availability_zones: Optional[list[str]] = None
+
+
+class InstanceOfferWithAvailability(InstanceOffer):
+    availability: InstanceAvailability = InstanceAvailability.UNKNOWN
+    instance_id: Optional[str] = None  # set when offer is an existing pool instance
+
+
+class SSHConnectionParams(CoreModel):
+    hostname: str
+    username: str
+    port: int = 22
+
+
+class SSHProxyParams(CoreModel):
+    hostname: str
+    username: str
+    port: int = 22
+    private_key: Optional[str] = None
+
+
+class InstanceStatus(str, Enum):
+    PENDING = "pending"
+    PROVISIONING = "provisioning"
+    IDLE = "idle"
+    BUSY = "busy"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+
+    def is_active(self) -> bool:
+        return self not in (InstanceStatus.TERMINATING, InstanceStatus.TERMINATED)
+
+    def is_available(self) -> bool:
+        return self == InstanceStatus.IDLE
+
+
+class InstanceConfiguration(CoreModel):
+    """What the backend needs to create an instance (slice)."""
+
+    project_name: str
+    instance_name: str
+    user: str = ""
+    ssh_public_keys: list[str] = []
+    availability_zone: Optional[str] = None
+    placement_group_name: Optional[str] = None
+    reservation: Optional[str] = None
+    volume_ids: list[str] = []
+    tags: dict[str, str] = {}
+
+
+class HostMetadata(CoreModel):
+    """Per-worker-host connection info inside a (possibly multi-host) slice.
+
+    Worker 0 is the coordinator host; on GCP TPU slices only worker 0 may
+    have an external IP, others are reached via an SSH proxy jump through
+    worker 0 (cf. SURVEY.md §7 hard parts).
+    """
+
+    worker_id: int
+    internal_ip: str
+    external_ip: Optional[str] = None
+    hostname: Optional[str] = None
+    ssh_port: int = 22
+    shim_port: int = 10998
+
+
+class RemoteConnectionInfo(CoreModel):
+    """SSH-fleet host connection info (user-supplied on-prem TPU hosts)."""
+
+    host: str
+    port: int = 22
+    ssh_user: str = ""
+    ssh_proxy: Optional[SSHProxyParams] = None
+
+
+class Instance(CoreModel):
+    id: str
+    project_name: Optional[str] = None
+    backend: Optional[BackendType] = None
+    instance_type: Optional[InstanceType] = None
+    name: str
+    fleet_id: Optional[str] = None
+    fleet_name: Optional[str] = None
+    instance_num: int = 0
+    hostname: Optional[str] = None
+    status: InstanceStatus
+    unreachable: bool = False
+    termination_reason: Optional[str] = None
+    created: Optional[str] = None
+    region: Optional[str] = None
+    availability_zone: Optional[str] = None
+    price: Optional[float] = None
+    total_blocks: int = 1
+    busy_blocks: int = 0

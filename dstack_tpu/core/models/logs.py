@@ -1,0 +1,41 @@
+"""Log event models.
+
+Parity: reference src/dstack/_internal/core/models/logs.py.
+"""
+
+import base64
+from datetime import datetime
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class LogEventSource(str, Enum):
+    STDOUT = "stdout"
+    STDERR = "stderr"
+
+
+class LogEvent(CoreModel):
+    timestamp: datetime
+    log_source: LogEventSource = LogEventSource.STDOUT
+    message: str  # base64-encoded bytes on the wire
+
+    @classmethod
+    def create(cls, timestamp: datetime, text: str, source: LogEventSource = LogEventSource.STDOUT) -> "LogEvent":
+        return cls(
+            timestamp=timestamp,
+            log_source=source,
+            message=base64.b64encode(text.encode()).decode(),
+        )
+
+    def text(self) -> str:
+        try:
+            return base64.b64decode(self.message).decode(errors="replace")
+        except Exception:
+            return self.message
+
+
+class JobSubmissionLogs(CoreModel):
+    logs: list[LogEvent] = []
+    next_token: Optional[str] = None

@@ -1,0 +1,31 @@
+"""Metric series models — TPU-aware.
+
+Parity: reference src/dstack/_internal/core/models/metrics.py, with
+per-GPU util/mem replaced by per-chip TPU duty cycle / HBM usage
+(collected via libtpu / tpu-info by the agent; SURVEY.md §5).
+"""
+
+from datetime import datetime
+from typing import Union
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class Metric(CoreModel):
+    name: str
+    timestamps: list[datetime] = []
+    values: list[Union[int, float]] = []
+
+
+class JobMetrics(CoreModel):
+    metrics: list[Metric] = []
+
+
+# Well-known metric names produced by the agent sampler:
+CPU_USAGE_PERCENT = "cpu_usage_percent"
+MEMORY_USAGE_BYTES = "memory_usage_bytes"
+MEMORY_WORKING_SET_BYTES = "memory_working_set_bytes"
+TPU_DUTY_CYCLE_PERCENT = "tpu_duty_cycle_percent"  # per-chip: suffix _chip{i}
+TPU_HBM_USAGE_BYTES = "tpu_hbm_usage_bytes"
+TPU_HBM_TOTAL_BYTES = "tpu_hbm_total_bytes"
+TPU_TENSORCORE_UTIL_PERCENT = "tpu_tensorcore_util_percent"

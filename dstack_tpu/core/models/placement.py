@@ -1,0 +1,37 @@
+"""Placement models.
+
+Parity: reference core/models/placement.py. On TPU the ICI topology *is*
+the placement group (SURVEY.md §2.6): a cluster-placement fleet maps to
+requesting a specific ``topology`` in tpu_v2 node creation rather than a
+cloud placement-group resource; this model remains for GCE CPU nodes and
+future mixed fleets.
+"""
+
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.common import CoreModel
+
+
+class PlacementStrategy(str, Enum):
+    CLUSTER = "cluster"
+
+
+class PlacementGroupConfiguration(CoreModel):
+    backend: BackendType
+    region: str
+    placement_strategy: PlacementStrategy = PlacementStrategy.CLUSTER
+
+
+class PlacementGroupProvisioningData(CoreModel):
+    backend: BackendType
+    backend_data: Optional[str] = None
+
+
+class PlacementGroup(CoreModel):
+    id: str
+    name: str
+    project_name: str
+    configuration: PlacementGroupConfiguration
+    provisioning_data: Optional[PlacementGroupProvisioningData] = None

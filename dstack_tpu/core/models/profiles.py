@@ -1,0 +1,165 @@
+"""Profiles: reusable provisioning preferences merged into run specs.
+
+Parity: reference src/dstack/_internal/core/models/profiles.py
+(``Profile``, ``RetryEvent``, spot/creation/termination/utilization
+policies; merge semantics at reference core/models/runs.py:369-386).
+"""
+
+from enum import Enum
+from typing import Optional, Union
+
+from pydantic import field_validator
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.common import CoreModel, Duration, parse_duration
+
+DEFAULT_TERMINATION_IDLE_TIME = 5 * 60  # seconds
+DEFAULT_STOP_DURATION = 300
+DEFAULT_RUN_TERMINATION_IDLE_TIME = DEFAULT_TERMINATION_IDLE_TIME
+DEFAULT_FLEET_TERMINATION_IDLE_TIME = 3 * 24 * 3600
+
+
+class SpotPolicy(str, Enum):
+    SPOT = "spot"
+    ONDEMAND = "on-demand"
+    AUTO = "auto"
+
+
+class CreationPolicy(str, Enum):
+    REUSE = "reuse"
+    REUSE_OR_CREATE = "reuse-or-create"
+
+
+class TerminationPolicy(str, Enum):
+    DONT_DESTROY = "dont-destroy"
+    DESTROY_AFTER_IDLE = "destroy-after-idle"
+
+
+class StartupOrder(str, Enum):
+    ANY = "any"
+    MASTER_FIRST = "master-first"
+    WORKERS_FIRST = "workers-first"
+
+
+class StopCriteria(str, Enum):
+    ALL_DONE = "all-done"
+    MASTER_DONE = "master-done"
+
+
+class RetryEvent(str, Enum):
+    NO_CAPACITY = "no-capacity"
+    INTERRUPTION = "interruption"  # spot preemption / TPU maintenance event
+    ERROR = "error"
+
+
+class ProfileRetry(CoreModel):
+    on_events: list[RetryEvent] = [
+        RetryEvent.NO_CAPACITY,
+        RetryEvent.INTERRUPTION,
+        RetryEvent.ERROR,
+    ]
+    duration: Optional[Duration] = None
+
+    @classmethod
+    def parse(cls, v) -> Optional["ProfileRetry"]:
+        if v is None or v is False:
+            return None
+        if v is True:
+            return cls()
+        if isinstance(v, ProfileRetry):
+            return v
+        return cls.model_validate(v)
+
+
+class UtilizationPolicy(CoreModel):
+    """Terminate a run whose accelerators idle below a threshold.
+
+    TPU semantics: min duty-cycle % over the time window (collected by the
+    agent's TPU metrics sampler; reference used per-GPU utilization,
+    process_running_jobs.py:652-716).
+    """
+
+    min_tpu_utilization: int = 0
+    time_window: Duration = 600
+
+    @field_validator("min_tpu_utilization")
+    @classmethod
+    def _pct(cls, v: int) -> int:
+        if not 0 <= v <= 100:
+            raise ValueError("min_tpu_utilization must be 0..100")
+        return v
+
+
+class SchedulePolicy(CoreModel):
+    cron: str
+
+
+class ProfileParams(CoreModel):
+    backends: Optional[list[BackendType]] = None
+    regions: Optional[list[str]] = None
+    availability_zones: Optional[list[str]] = None
+    instance_types: Optional[list[str]] = None
+    reservation: Optional[str] = None
+    spot_policy: Optional[SpotPolicy] = None
+    retry: Optional[Union[ProfileRetry, bool]] = None
+    max_duration: Optional[Union[Duration, bool]] = None
+    stop_duration: Optional[Union[Duration, bool]] = None
+    max_price: Optional[float] = None
+    creation_policy: Optional[CreationPolicy] = None
+    idle_duration: Optional[Union[Duration, bool]] = None
+    utilization_policy: Optional[UtilizationPolicy] = None
+    startup_order: Optional[StartupOrder] = None
+    stop_criteria: Optional[StopCriteria] = None
+    fleets: Optional[list[str]] = None
+    tags: Optional[dict[str, str]] = None
+
+    @field_validator("retry", mode="before")
+    @classmethod
+    def _retry(cls, v):
+        if isinstance(v, bool):
+            return ProfileRetry() if v else None
+        return v
+
+    @field_validator("max_duration", "stop_duration", "idle_duration", mode="before")
+    @classmethod
+    def _durations(cls, v):
+        if v is True:
+            raise ValueError("duration cannot be 'true'")
+        if v is False:
+            return -1
+        return parse_duration(v)
+
+
+class Profile(ProfileParams):
+    name: str = "default"
+    default: bool = False
+
+
+class ProfilesConfig(CoreModel):
+    profiles: list[Profile] = []
+
+    def default(self) -> Optional[Profile]:
+        for p in self.profiles:
+            if p.default:
+                return p
+        return None
+
+    def get(self, name: str) -> Profile:
+        for p in self.profiles:
+            if p.name == name:
+                return p
+        raise KeyError(name)
+
+
+def merge_profile_into(profile: Optional[Profile], params: ProfileParams) -> ProfileParams:
+    """Fields set on ``params`` win over the profile's.
+
+    Parity: reference core/models/runs.py:369-386 (``get_policy_map`` merge).
+    """
+    if profile is None:
+        return params
+    merged = params.model_copy()
+    for field in ProfileParams.model_fields:
+        if getattr(merged, field, None) is None:
+            setattr(merged, field, getattr(profile, field, None))
+    return merged

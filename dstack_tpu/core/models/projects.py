@@ -1,0 +1,30 @@
+"""Project (multi-tenancy) models.
+
+Parity: reference src/dstack/_internal/core/models/projects.py.
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.users import ProjectRole, User
+
+
+class Member(CoreModel):
+    user: User
+    project_role: ProjectRole
+
+
+class BackendInfo(CoreModel):
+    name: BackendType
+    config: dict = {}
+
+
+class Project(CoreModel):
+    id: str
+    project_name: str
+    owner: User
+    created_at: Optional[str] = None
+    backends: list[BackendInfo] = []
+    members: list[Member] = []
+    is_public: bool = False

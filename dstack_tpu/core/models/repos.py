@@ -1,0 +1,52 @@
+"""Repo (code source) models.
+
+Parity: reference src/dstack/_internal/core/models/repos/*: a run's code
+comes from a remote git repo (clone+checkout+diff) or a local dir
+uploaded as an archive (reference runner repo/manager.go:162).
+"""
+
+import hashlib
+from enum import Enum
+from typing import Optional, Union
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class RepoType(str, Enum):
+    REMOTE = "remote"
+    LOCAL = "local"
+    VIRTUAL = "virtual"  # no code; commands only
+
+
+class RemoteRepoInfo(CoreModel):
+    repo_type: RepoType = RepoType.REMOTE
+    repo_url: str
+    repo_branch: Optional[str] = None
+    repo_hash: Optional[str] = None
+
+
+class LocalRepoInfo(CoreModel):
+    repo_type: RepoType = RepoType.LOCAL
+    repo_dir: str = "."
+
+
+class VirtualRepoInfo(CoreModel):
+    repo_type: RepoType = RepoType.VIRTUAL
+
+
+AnyRepoInfo = Union[RemoteRepoInfo, LocalRepoInfo, VirtualRepoInfo]
+
+
+class RepoHead(CoreModel):
+    repo_id: str
+    repo_info: dict
+
+
+class RemoteRepoCreds(CoreModel):
+    clone_url: str
+    private_key: Optional[str] = None
+    oauth_token: Optional[str] = None
+
+
+def repo_id_for(path_or_url: str) -> str:
+    return hashlib.sha1(path_or_url.encode()).hexdigest()[:16]

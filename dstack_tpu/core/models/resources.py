@@ -1,0 +1,257 @@
+"""Resource requirement specs, TPU-first.
+
+Parity: reference src/dstack/_internal/core/models/resources.py:131,278
+(``ResourcesSpec``/``GPUSpec``) — but the accelerator spec here is a
+:class:`TPUSpec`: generation × chip-count × topology, where a multi-host
+pod slice is a single schedulable unit (the reference only supports
+single-host TPUs, reference gcp/compute.py:699-726).
+
+User YAML examples::
+
+    resources:
+      tpu: v5e-8                 # shorthand: generation-chips
+    resources:
+      tpu:
+        version: [v5p, v6e]
+        chips: 8..64
+        topology: 4x4x4          # optional exact ICI topology
+      cpu: 8..
+      memory: 32GB..
+      disk: 100GB..
+"""
+
+import math
+import re
+from typing import Annotated, Any, Generic, Optional, TypeVar, Union
+
+from pydantic import BeforeValidator, field_validator, model_validator
+
+from dstack_tpu.core.models.common import CoreModel
+
+T = TypeVar("T", bound=Union[int, float])
+
+_RANGE_RE = re.compile(r"^\s*(?P<min>[^.\s]+)?\s*(?:\.\.)\s*(?P<max>[^.\s]+)?\s*$")
+_MEMORY_RE = re.compile(r"^\s*(?P<num>\d+(?:\.\d+)?)\s*(?P<unit>[a-zA-Z]*)\s*$")
+
+_MEMORY_UNITS = {
+    "": 1.0,
+    "mb": 1.0 / 1024,
+    "gb": 1.0,
+    "tb": 1024.0,
+}
+
+
+def parse_memory(v: Any) -> float:
+    """``"512MB"``/``"16GB"``/``"1TB"``/number → GB (float)."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = _MEMORY_RE.match(str(v))
+    if m is None:
+        raise ValueError(f"invalid memory: {v!r}")
+    unit = m.group("unit").lower()
+    if unit not in _MEMORY_UNITS:
+        raise ValueError(f"invalid memory unit: {v!r}")
+    return float(m.group("num")) * _MEMORY_UNITS[unit]
+
+
+Memory = Annotated[float, BeforeValidator(parse_memory)]
+
+
+class Range(CoreModel, Generic[T]):
+    """Inclusive range; ``None`` bound = unbounded.
+
+    Accepts ``"4"``, ``4``, ``"2..8"``, ``"4.."``, ``"..8"``,
+    ``{"min": 2, "max": 8}``.
+    """
+
+    min: Optional[T] = None
+    max: Optional[T] = None
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if v is None or isinstance(v, dict):
+            return v
+        if isinstance(v, Range):
+            return {"min": v.min, "max": v.max}
+        if isinstance(v, (int, float)):
+            return {"min": v, "max": v}
+        if isinstance(v, str):
+            m = _RANGE_RE.match(v)
+            if m is not None:
+                return {"min": m.group("min"), "max": m.group("max")}
+            return {"min": v, "max": v}
+        raise ValueError(f"invalid range: {v!r}")
+
+    @model_validator(mode="after")
+    def _check(self) -> "Range[T]":
+        if self.min is not None and self.max is not None and self.min > self.max:
+            raise ValueError(f"invalid range: min {self.min} > max {self.max}")
+        return self
+
+    def contains(self, value: Union[int, float]) -> bool:
+        if self.min is not None and value < self.min:
+            return False
+        if self.max is not None and value > self.max:
+            return False
+        return True
+
+    def intersects(self, other: "Range") -> bool:
+        lo = max(x for x in (self.min, other.min, float("-inf")) if x is not None)
+        hi = min(x for x in (self.max, other.max, float("inf")) if x is not None)
+        return lo <= hi
+
+    def pretty(self) -> str:
+        if self.min == self.max and self.min is not None:
+            return str(self.min)
+        return f"{self.min if self.min is not None else ''}..{self.max if self.max is not None else ''}"
+
+
+class MemoryRange(Range[float]):
+    @model_validator(mode="before")
+    @classmethod
+    def _parse_mem(cls, v: Any) -> Any:
+        v = Range._parse.__func__(cls, v)  # type: ignore[attr-defined]
+        if isinstance(v, dict):
+            return {
+                k: (parse_memory(val) if val is not None and k in ("min", "max") else val)
+                for k, val in v.items()
+            }
+        return v
+
+
+IntRange = Range[int]
+
+# TPU generations in market order.  ``cores_per_chip`` is TensorCores;
+# scheduling is chip-granular.
+TPU_GENERATIONS = ("v2", "v3", "v4", "v5e", "v5p", "v6e")
+
+# GCP accelerator-type aliases → canonical generation.
+_TPU_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "v5p": "v5p",
+    "v6e": "v6e",
+    "v6litepod": "v6e",
+    "v2": "v2",
+    "v3": "v3",
+    "v4": "v4",
+    "v5e": "v5e",
+}
+
+_TPU_SHORT_RE = re.compile(
+    r"^(?P<gen>v\d+(?:litepod|lite|e|p)?)-(?P<chips>\d+)$", re.IGNORECASE
+)
+
+
+def normalize_tpu_version(v: str) -> str:
+    v = v.lower()
+    if v not in _TPU_ALIASES:
+        raise ValueError(
+            f"unknown TPU generation {v!r}; expected one of {sorted(set(_TPU_ALIASES))}"
+        )
+    return _TPU_ALIASES[v]
+
+
+class TPUSpec(CoreModel):
+    """Requested TPU slice: any of the listed generations, a chip-count
+    range, and optionally an exact ICI topology (e.g. ``4x4x4`` for v4/v5p,
+    ``8x16`` for v5e/v6e)."""
+
+    version: Optional[list[str]] = None
+    chips: IntRange = IntRange(min=1, max=None)
+    topology: Optional[str] = None
+
+    @field_validator("version", mode="before")
+    @classmethod
+    def _versions(cls, v: Any) -> Any:
+        if v is None:
+            return v
+        if isinstance(v, str):
+            v = [v]
+        return [normalize_tpu_version(x) for x in v]
+
+    @field_validator("topology", mode="before")
+    @classmethod
+    def _topology(cls, v: Any) -> Any:
+        if v is None:
+            return v
+        v = str(v).lower().replace(" ", "")
+        if not re.match(r"^\d+x\d+(x\d+)?$", v):
+            raise ValueError(f"invalid TPU topology {v!r}; expected e.g. '2x4' or '4x4x4'")
+        return v
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse_shorthand(cls, v: Any) -> Any:
+        """``"v5e-8"`` / ``"v5litepod-8"`` / ``"v5p"`` → full spec."""
+        if isinstance(v, str):
+            m = _TPU_SHORT_RE.match(v.strip())
+            if m is not None:
+                return {"version": m.group("gen"), "chips": int(m.group("chips"))}
+            return {"version": v.strip()}
+        if isinstance(v, int):
+            return {"chips": v}
+        return v
+
+    def pretty(self) -> str:
+        gen = "/".join(self.version) if self.version else "tpu"
+        s = f"{gen}:{self.chips.pretty()}"
+        if self.topology:
+            s += f":{self.topology}"
+        return s
+
+
+def topology_chips(topology: str) -> int:
+    return math.prod(int(x) for x in topology.split("x"))
+
+
+class CPUSpec(CoreModel):
+    """vCPU count range (architecture pinning is not needed on TPU VMs —
+    they are all x86/arm per generation; kept simple)."""
+
+    count: IntRange = IntRange(min=2, max=None)
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if v is None or isinstance(v, dict):
+            return v
+        return {"count": v}
+
+
+class DiskSpec(CoreModel):
+    size: MemoryRange = MemoryRange(min=100.0, max=None)
+
+    @model_validator(mode="before")
+    @classmethod
+    def _parse(cls, v: Any) -> Any:
+        if v is None or isinstance(v, dict):
+            return v
+        return {"size": v}
+
+
+DEFAULT_MEMORY_SIZE = MemoryRange(min=8.0)
+DEFAULT_DISK = DiskSpec(size=MemoryRange(min=100.0))
+
+
+class ResourcesSpec(CoreModel):
+    """The ``resources`` block of a run configuration.
+
+    Parity: reference core/models/resources.py:278 (``ResourcesSpec``),
+    with ``gpu`` → ``tpu``.
+    """
+
+    cpu: CPUSpec = CPUSpec()
+    memory: MemoryRange = DEFAULT_MEMORY_SIZE
+    shm_size: Optional[Memory] = None
+    tpu: Optional[TPUSpec] = None
+    disk: Optional[DiskSpec] = DEFAULT_DISK
+
+    def pretty(self) -> str:
+        parts = [f"cpu={self.cpu.count.pretty()}", f"mem={self.memory.pretty()}GB"]
+        if self.tpu is not None:
+            parts.append(f"tpu={self.tpu.pretty()}")
+        if self.disk is not None:
+            parts.append(f"disk={self.disk.size.pretty()}GB")
+        return " ".join(parts)

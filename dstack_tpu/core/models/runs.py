@@ -1,0 +1,402 @@
+"""Run/Job models and state machines.
+
+Parity: reference src/dstack/_internal/core/models/runs.py
+(``RunSpec``:185, ``JobSpec``:306, ``Run``:421, ``JobStatus``,
+``RunStatus``, ``JobTerminationReason``). TPU-first additions:
+:class:`ClusterInfo` carries the JAX/libtpu rendezvous environment
+(coordinator address, worker hostnames) instead of MASTER_ADDR/NCCL
+wiring (reference runner executor.go:237-246).
+"""
+
+import uuid
+from datetime import datetime, timezone
+from enum import Enum
+from typing import Any, Optional, Union
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.common import CoreModel, RegistryAuth
+from dstack_tpu.core.models.configurations import (
+    AnyRunConfiguration,
+    DevEnvironmentConfiguration,
+    PortMapping,
+    RunConfigurationType,
+    ServiceConfiguration,
+    TaskConfiguration,
+)
+from dstack_tpu.core.models.instances import (
+    HostMetadata,
+    InstanceOfferWithAvailability,
+    InstanceType,
+    Resources,
+    SSHConnectionParams,
+    SSHProxyParams,
+)
+from dstack_tpu.core.models.profiles import (
+    Profile,
+    ProfileRetry,
+    StartupOrder,
+    StopCriteria,
+    UtilizationPolicy,
+)
+from dstack_tpu.core.models.resources import ResourcesSpec
+
+
+def now_utc() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+class AppSpec(CoreModel):
+    port: int
+    map_to_port: Optional[int] = None
+    app_name: str
+    url_path: Optional[str] = None
+
+
+class JobStatus(str, Enum):
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    PULLING = "pulling"
+    RUNNING = "running"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+    ABORTED = "aborted"
+    FAILED = "failed"
+    DONE = "done"
+
+    @classmethod
+    def finished_statuses(cls) -> list["JobStatus"]:
+        return [cls.TERMINATED, cls.ABORTED, cls.FAILED, cls.DONE]
+
+    def is_finished(self) -> bool:
+        return self in self.finished_statuses()
+
+
+class RunStatus(str, Enum):
+    PENDING = "pending"
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    RUNNING = "running"
+    TERMINATING = "terminating"
+    TERMINATED = "terminated"
+    FAILED = "failed"
+    DONE = "done"
+
+    @classmethod
+    def finished_statuses(cls) -> list["RunStatus"]:
+        return [cls.TERMINATED, cls.FAILED, cls.DONE]
+
+    def is_finished(self) -> bool:
+        return self in self.finished_statuses()
+
+
+class JobTerminationReason(str, Enum):
+    # Retryable events (mapped to ProfileRetry.on_events):
+    FAILED_TO_START_DUE_TO_NO_CAPACITY = "failed_to_start_due_to_no_capacity"
+    INTERRUPTED_BY_NO_CAPACITY = "interrupted_by_no_capacity"  # spot preemption / TPU maintenance
+    # Terminal:
+    WAITING_INSTANCE_LIMIT_EXCEEDED = "waiting_instance_limit_exceeded"
+    WAITING_RUNNER_LIMIT_EXCEEDED = "waiting_runner_limit_exceeded"
+    TERMINATED_BY_USER = "terminated_by_user"
+    TERMINATED_BY_SERVER = "terminated_by_server"
+    INACTIVITY_DURATION_EXCEEDED = "inactivity_duration_exceeded"
+    TERMINATED_DUE_TO_UTILIZATION_POLICY = "terminated_due_to_utilization_policy"
+    VOLUME_ERROR = "volume_error"
+    GATEWAY_ERROR = "gateway_error"
+    SCALED_DOWN = "scaled_down"
+    DONE_BY_RUNNER = "done_by_runner"
+    ABORTED_BY_USER = "aborted_by_user"
+    MAX_DURATION_EXCEEDED = "max_duration_exceeded"
+    CONTAINER_EXITED_WITH_ERROR = "container_exited_with_error"
+    PORTS_BINDING_FAILED = "ports_binding_failed"
+    CREATING_CONTAINER_ERROR = "creating_container_error"
+    EXECUTOR_ERROR = "executor_error"
+    INSTANCE_UNREACHABLE = "instance_unreachable"
+
+    def to_retry_event(self) -> Optional[str]:
+        from dstack_tpu.core.models.profiles import RetryEvent
+
+        mapping = {
+            JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY: RetryEvent.NO_CAPACITY,
+            JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY: RetryEvent.INTERRUPTION,
+            JobTerminationReason.CONTAINER_EXITED_WITH_ERROR: RetryEvent.ERROR,
+            JobTerminationReason.EXECUTOR_ERROR: RetryEvent.ERROR,
+            JobTerminationReason.INSTANCE_UNREACHABLE: RetryEvent.ERROR,
+        }
+        ev = mapping.get(self)
+        return ev.value if ev is not None else None
+
+    def to_job_status(self) -> JobStatus:
+        if self == JobTerminationReason.DONE_BY_RUNNER:
+            return JobStatus.DONE
+        if self == JobTerminationReason.ABORTED_BY_USER:
+            return JobStatus.ABORTED
+        if self in (
+            JobTerminationReason.TERMINATED_BY_USER,
+            JobTerminationReason.TERMINATED_BY_SERVER,
+            JobTerminationReason.INACTIVITY_DURATION_EXCEEDED,
+            JobTerminationReason.SCALED_DOWN,
+        ):
+            return JobStatus.TERMINATED
+        return JobStatus.FAILED
+
+
+class RunTerminationReason(str, Enum):
+    ALL_JOBS_DONE = "all_jobs_done"
+    JOB_FAILED = "job_failed"
+    RETRY_LIMIT_EXCEEDED = "retry_limit_exceeded"
+    STOPPED_BY_USER = "stopped_by_user"
+    ABORTED_BY_USER = "aborted_by_user"
+    SERVER_ERROR = "server_error"
+
+    def to_status(self) -> RunStatus:
+        if self == RunTerminationReason.ALL_JOBS_DONE:
+            return RunStatus.DONE
+        if self in (RunTerminationReason.STOPPED_BY_USER, RunTerminationReason.ABORTED_BY_USER):
+            return RunStatus.TERMINATED
+        return RunStatus.FAILED
+
+
+class Requirements(CoreModel):
+    resources: ResourcesSpec
+    max_price: Optional[float] = None
+    spot: Optional[bool] = None  # None = either
+    reservation: Optional[str] = None
+
+    def pretty_format(self) -> str:
+        s = self.resources.pretty()
+        if self.spot is not None:
+            s += f" spot={self.spot}"
+        if self.max_price is not None:
+            s += f" max_price=${self.max_price:g}"
+        return s
+
+
+class Retry(CoreModel):
+    on_events: list[str]
+    duration: Optional[int] = None
+
+
+class ClusterInfo(CoreModel):
+    """Rendezvous info injected into every job of a distributed run.
+
+    The runner turns this into the TPU-native env (cf. agent/python/env.py):
+    ``DTPU_COORDINATOR_ADDRESS``/``DTPU_NODE_RANK``/``DTPU_NODES_NUM``/
+    ``DTPU_NODES_IPS`` plus JAX-standard ``TPU_WORKER_ID``,
+    ``TPU_WORKER_HOSTNAMES``, and (multislice) ``MEGASCALE_*``.
+    Parity: reference ClusterInfo + executor.go:237-246.
+    """
+
+    master_node_ip: str = ""
+    nodes_ips: list[str] = []
+    job_ips: list[str] = []
+    coordinator_port: int = 8476
+    megascale_coordinator_address: Optional[str] = None  # DCN multislice
+    slice_id: int = 0
+    num_slices: int = 1
+    tpu_chips_per_host: int = 0
+    tpu_total_chips: int = 0
+    tpu_topology: Optional[str] = None
+
+
+class JobSSHKey(CoreModel):
+    """Per-replica keypair for inter-node SSH (reference
+    jobs/configurators/base.py:246-255)."""
+
+    private: str
+    public: str
+
+
+class GpusPerJob(CoreModel):
+    pass  # placeholder to keep wire-compat with reference naming; unused
+
+
+class JobSpec(CoreModel):
+    replica_num: int = 0
+    job_num: int = 0  # worker-host index within the replica
+    job_name: str
+    jobs_per_replica: int = 1
+    app_specs: list[AppSpec] = []
+    commands: list[str] = []
+    env: dict[str, str] = {}
+    home_dir: str = "/root"
+    image_name: str = ""
+    privileged: bool = False
+    pjrt_device: Optional[str] = "TPU"
+    registry_auth: Optional[RegistryAuth] = None
+    requirements: Requirements
+    retry: Optional[Retry] = None
+    max_duration: Optional[int] = None
+    stop_duration: Optional[int] = None
+    utilization_policy: Optional[UtilizationPolicy] = None
+    working_dir: Optional[str] = None
+    ssh_key: Optional[JobSSHKey] = None
+    single_branch: bool = False
+    service_port: Optional[int] = None
+
+
+class JobProvisioningData(CoreModel):
+    """Where a job landed: which instance (slice), which worker host.
+
+    Parity: reference JobProvisioningData; TPU-first: ``hosts`` lists
+    every worker of the slice, ``worker_id`` selects this job's host.
+    """
+
+    backend: BackendType
+    instance_type: InstanceType
+    instance_id: str
+    hostname: Optional[str] = None  # this job's host (worker `worker_id`)
+    internal_ip: Optional[str] = None
+    region: str = ""
+    availability_zone: Optional[str] = None
+    price: float = 0.0
+    username: str = "root"
+    ssh_port: int = 22
+    ssh_proxy: Optional[SSHProxyParams] = None
+    dockerized: bool = True  # False → server talks directly to runner (no shim)
+    worker_id: int = 0
+    hosts: list[HostMetadata] = []
+    backend_data: Optional[str] = None  # opaque backend JSON (e.g. TPU node name)
+
+    def ready(self) -> bool:
+        return self.hostname is not None
+
+
+class JobRuntimeData(CoreModel):
+    network_mode: str = "host"
+    ports: Optional[dict[int, int]] = None  # container→host when bridged
+    offer: Optional[InstanceOfferWithAvailability] = None
+    volume_names: list[str] = []
+
+
+class JobSubmission(CoreModel):
+    id: str
+    submission_num: int = 0
+    submitted_at: datetime
+    last_processed_at: Optional[datetime] = None
+    finished_at: Optional[datetime] = None
+    status: JobStatus
+    termination_reason: Optional[JobTerminationReason] = None
+    termination_reason_message: Optional[str] = None
+    exit_status: Optional[int] = None
+    job_provisioning_data: Optional[JobProvisioningData] = None
+    job_runtime_data: Optional[JobRuntimeData] = None
+
+    @property
+    def age(self) -> float:
+        return (now_utc() - self.submitted_at).total_seconds()
+
+
+class Job(CoreModel):
+    job_spec: JobSpec
+    job_submissions: list[JobSubmission] = []
+
+    @property
+    def latest(self) -> Optional[JobSubmission]:
+        return self.job_submissions[-1] if self.job_submissions else None
+
+
+class RunSpec(CoreModel):
+    run_name: Optional[str] = None
+    repo_id: Optional[str] = None
+    repo_data: Optional[dict] = None
+    repo_code_hash: Optional[str] = None
+    working_dir: str = "."
+    configuration_path: Optional[str] = None
+    configuration: AnyRunConfiguration
+    profile: Optional[Profile] = None
+    ssh_key_pub: str = ""
+    merged_profile: Optional[Profile] = None
+
+    def effective_profile(self) -> Profile:
+        """Run-config fields win over profile fields
+        (reference core/models/runs.py:369-386)."""
+        base = self.profile or Profile(name="default")
+        merged = base.model_copy()
+        for field in (
+            "backends", "regions", "availability_zones", "instance_types",
+            "reservation", "spot_policy", "retry", "max_duration", "stop_duration",
+            "max_price", "creation_policy", "idle_duration", "utilization_policy",
+            "startup_order", "stop_criteria", "fleets", "tags",
+        ):
+            v = getattr(self.configuration, field, None)
+            if v is not None:
+                setattr(merged, field, v)
+        return merged
+
+
+class ServiceSpec(CoreModel):
+    url: str
+    model: Optional[dict] = None
+    options: dict = {}
+
+
+class Run(CoreModel):
+    id: str
+    project_name: str
+    user: str
+    submitted_at: datetime
+    last_processed_at: Optional[datetime] = None
+    status: RunStatus
+    status_message: Optional[str] = None
+    termination_reason: Optional[RunTerminationReason] = None
+    run_spec: RunSpec
+    jobs: list[Job] = []
+    service: Optional[ServiceSpec] = None
+    deleted: bool = False
+    error: Optional[str] = None
+
+    @property
+    def run_name(self) -> str:
+        return self.run_spec.run_name or ""
+
+    def is_deployment_in_progress(self) -> bool:
+        return any(
+            not j.job_submissions[-1].status.is_finished()
+            for j in self.jobs
+            if j.job_submissions
+        )
+
+
+class JobPlan(CoreModel):
+    job_spec: JobSpec
+    offers: list[InstanceOfferWithAvailability] = []
+    total_offers: int = 0
+    max_price: Optional[float] = None
+
+
+class RunPlan(CoreModel):
+    project_name: str
+    user: str
+    run_spec: RunSpec
+    job_plans: list[JobPlan] = []
+    current_resource: Optional[Run] = None
+    action: str = "create"  # create|update
+
+    def get_effective_run_spec(self) -> RunSpec:
+        return self.run_spec
+
+
+class ApplyRunPlanInput(CoreModel):
+    run_spec: RunSpec
+    current_resource: Optional[Run] = None
+
+
+def generate_run_name(prefix_words: Optional[tuple[list[str], list[str]]] = None) -> str:
+    """Docker-style random run names (reference utils/random_names.py)."""
+    import random
+
+    adjectives = [
+        "amber", "bold", "calm", "deft", "eager", "fast", "gold", "hazy",
+        "icy", "jolly", "keen", "lucid", "mellow", "noble", "opal", "proud",
+        "quick", "rapid", "shiny", "tidy", "vivid", "warm", "young", "zesty",
+    ]
+    nouns = [
+        "otter", "falcon", "panda", "lynx", "heron", "ibex", "jackal", "koala",
+        "lemur", "marmot", "narwhal", "ocelot", "puffin", "quokka", "raven",
+        "seal", "tapir", "urchin", "vole", "walrus", "yak", "zebra", "crane",
+    ]
+    return f"{random.choice(adjectives)}-{random.choice(nouns)}-{random.randint(1, 99)}"
+
+
+def new_uuid() -> str:
+    return str(uuid.uuid4())

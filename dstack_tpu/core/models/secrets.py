@@ -1,0 +1,14 @@
+"""Secret models.
+
+Parity: reference src/dstack/_internal/core/models/secrets.py.
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class Secret(CoreModel):
+    id: Optional[str] = None
+    name: str
+    value: Optional[str] = None  # hidden unless explicitly requested

@@ -1,0 +1,36 @@
+"""User and auth models.
+
+Parity: reference src/dstack/_internal/core/models/users.py.
+"""
+
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.common import CoreModel
+
+
+class GlobalRole(str, Enum):
+    ADMIN = "admin"
+    USER = "user"
+
+
+class ProjectRole(str, Enum):
+    ADMIN = "admin"
+    MANAGER = "manager"
+    USER = "user"
+
+
+class User(CoreModel):
+    id: str
+    username: str
+    global_role: GlobalRole = GlobalRole.USER
+    email: Optional[str] = None
+    active: bool = True
+
+
+class UserWithCreds(User):
+    creds: Optional[dict] = None  # {"token": "..."}
+
+
+class UserTokenCreds(CoreModel):
+    token: str

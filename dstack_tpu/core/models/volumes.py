@@ -1,0 +1,65 @@
+"""Volume models (network disks attachable to TPU VMs/slices).
+
+Parity: reference src/dstack/_internal/core/models/volumes.py; on GCP
+these are persistent disks attached to TPU nodes via
+``UpdateNodeRequest(dataDisks)`` (reference gcp/compute.py:578-676).
+"""
+
+from datetime import datetime
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.configurations import VolumeConfiguration
+
+
+class VolumeStatus(str, Enum):
+    SUBMITTED = "submitted"
+    PROVISIONING = "provisioning"
+    ACTIVE = "active"
+    FAILED = "failed"
+
+
+class VolumeProvisioningData(CoreModel):
+    backend: Optional[BackendType] = None
+    volume_id: str
+    size_gb: float
+    availability_zone: Optional[str] = None
+    price: Optional[float] = None
+    attachable: bool = True
+    detachable: bool = True
+    backend_data: Optional[str] = None
+
+
+class VolumeAttachmentData(CoreModel):
+    device_name: Optional[str] = None
+
+
+class VolumeAttachment(CoreModel):
+    volume_id: str
+    instance_id: Optional[str] = None
+    attachment_data: Optional[VolumeAttachmentData] = None
+
+
+class Volume(CoreModel):
+    id: str
+    name: str
+    project_name: str
+    external: bool = False
+    created_at: Optional[datetime] = None
+    last_job_processed_at: Optional[datetime] = None
+    status: VolumeStatus = VolumeStatus.SUBMITTED
+    status_message: Optional[str] = None
+    deleted: bool = False
+    configuration: VolumeConfiguration
+    provisioning_data: Optional[VolumeProvisioningData] = None
+    attachments: list[VolumeAttachment] = []
+
+
+class VolumePlan(CoreModel):
+    project_name: str
+    user: str
+    spec: VolumeConfiguration
+    current_resource: Optional[Volume] = None
+    action: str = "create"

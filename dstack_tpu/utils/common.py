@@ -1,0 +1,62 @@
+"""Small shared helpers."""
+
+import asyncio
+import functools
+from collections.abc import Awaitable, Callable, Iterable
+from datetime import datetime, timedelta, timezone
+from typing import Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def get_current_datetime() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def get_or_error(v: Optional[T], what: str = "value") -> T:
+    if v is None:
+        raise ValueError(f"{what} is unexpectedly None")
+    return v
+
+
+def pretty_date(dt: Optional[datetime]) -> str:
+    """Compact relative time: '3 mins ago'."""
+    if dt is None:
+        return ""
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    diff = get_current_datetime() - dt
+    s = diff.total_seconds()
+    if s < 0:
+        return "now"
+    for limit, unit, div in (
+        (60, "sec", 1),
+        (3600, "min", 60),
+        (86400, "hour", 3600),
+        (7 * 86400, "day", 86400),
+    ):
+        if s < limit:
+            n = int(s // div)
+            return f"{n} {unit}{'s' if n != 1 else ''} ago"
+    return dt.strftime("%Y-%m-%d")
+
+
+def since(delta_seconds: float) -> datetime:
+    return get_current_datetime() - timedelta(seconds=delta_seconds)
+
+
+def batched(items: Iterable[T], n: int) -> Iterable[list[T]]:
+    batch: list[T] = []
+    for item in items:
+        batch.append(item)
+        if len(batch) >= n:
+            yield batch
+            batch = []
+    if batch:
+        yield batch
+
+
+def run_async(fn: Callable[..., T], *args) -> Awaitable[T]:
+    """Run a blocking callable on the default executor."""
+    loop = asyncio.get_running_loop()
+    return loop.run_in_executor(None, functools.partial(fn, *args))

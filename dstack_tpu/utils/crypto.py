@@ -1,0 +1,35 @@
+"""SSH keypair generation and token helpers.
+
+Parity: reference src/dstack/_internal/utils/crypto.py.
+"""
+
+import secrets
+from typing import Tuple
+
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+
+def generate_rsa_key_pair_bytes(comment: str = "dtpu") -> Tuple[str, str]:
+    """Actually ed25519 (smaller, faster, universally supported by modern
+    sshd); name kept for parity with the reference helper."""
+    key = Ed25519PrivateKey.generate()
+    private = key.private_bytes(
+        encoding=serialization.Encoding.PEM,
+        format=serialization.PrivateFormat.OpenSSH,
+        encryption_algorithm=serialization.NoEncryption(),
+    ).decode()
+    public = (
+        key.public_key()
+        .public_bytes(
+            encoding=serialization.Encoding.OpenSSH,
+            format=serialization.PublicFormat.OpenSSH,
+        )
+        .decode()
+        + f" {comment}\n"
+    )
+    return private, public
+
+
+def generate_auth_token() -> str:
+    return secrets.token_hex(32)

@@ -1,0 +1,61 @@
+"""``${{ ns.var }}`` string interpolation for configs.
+
+Parity: reference src/dstack/_internal/utils/interpolator.py (used for
+volume-name templating at jobs/configurators/base.py:258-294).
+"""
+
+import re
+from typing import Any, Optional
+
+_VAR_RE = re.compile(r"\$\{\{\s*(?P<expr>[a-zA-Z0-9_.]+)\s*\}\}")
+
+
+class InterpolatorError(ValueError):
+    pass
+
+
+class VariablesInterpolator:
+    def __init__(self, namespaces: dict[str, dict[str, str]], skip_missing: bool = False):
+        self._ns = namespaces
+        self._skip_missing = skip_missing
+
+    def _resolve(self, expr: str) -> Optional[str]:
+        parts = expr.split(".")
+        if len(parts) != 2:
+            raise InterpolatorError(f"expected 'namespace.variable', got {expr!r}")
+        ns, var = parts
+        if ns not in self._ns:
+            raise InterpolatorError(f"unknown namespace {ns!r} in ${{{{ {expr} }}}}")
+        if var not in self._ns[ns]:
+            if self._skip_missing:
+                return None
+            raise InterpolatorError(f"unknown variable {expr!r}")
+        return self._ns[ns][var]
+
+    def interpolate(self, s: str) -> tuple[str, list[str]]:
+        """Returns (interpolated string, list of unresolved expressions)."""
+        missing: list[str] = []
+
+        def repl(m: re.Match) -> str:
+            value = self._resolve(m.group("expr"))
+            if value is None:
+                missing.append(m.group("expr"))
+                return m.group(0)
+            return value
+
+        return _VAR_RE.sub(repl, s), missing
+
+    def interpolate_or_error(self, s: str) -> str:
+        result, missing = self.interpolate(s)
+        if missing:
+            raise InterpolatorError(f"unresolved variables: {missing}")
+        return result
+
+
+def interpolate_job_volumes(text: str, env: dict[str, Any]) -> str:
+    """Resolve ``${{ env.X }}`` / ``${{ dtpu.node_rank }}`` in mount specs."""
+    ns = {
+        "env": {k: str(v) for k, v in env.items()},
+        "dtpu": {k: str(v) for k, v in env.items() if k.startswith(("node_", "run_"))},
+    }
+    return VariablesInterpolator(ns).interpolate_or_error(text)

@@ -1,0 +1,31 @@
+"""Structured logging for server and agents.
+
+Parity: reference src/dstack/_internal/utils/logging.py.
+"""
+
+import logging
+import os
+import sys
+
+
+class _Formatter(logging.Formatter):
+    default_msec_format = "%s.%03d"
+
+    def format(self, record: logging.LogRecord) -> str:
+        record.levelname = record.levelname.lower()
+        return super().format(record)
+
+
+def configure_logging(level: str | int | None = None) -> None:
+    level = level or os.getenv("DTPU_LOG_LEVEL", "INFO")
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        _Formatter(fmt="[%(asctime)s] %(levelname)s %(name)s: %(message)s")
+    )
+    root = logging.getLogger("dstack_tpu")
+    root.handlers = [handler]
+    root.setLevel(level)
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(name)

@@ -1,0 +1,4 @@
+__version__ = "0.1.0"
+
+# Agent (shim/runner) API compatibility version, bumped on wire changes.
+AGENT_API_VERSION = 1

@@ -1,0 +1,39 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh *before* jax import.
+
+Mirrors the reference's test strategy (SURVEY.md §4): everything runs on
+one machine — multi-chip sharding is validated on virtual CPU devices,
+the control plane against in-memory sqlite with mocked backends.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests without pytest-asyncio (not in this image):
+    each coroutine test gets a fresh event loop."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        loop = asyncio.new_event_loop()
+        try:
+            loop.run_until_complete(fn(**kwargs))
+        finally:
+            loop.close()
+        return True
+    return None

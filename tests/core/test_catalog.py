@@ -1,0 +1,53 @@
+from dstack_tpu.core.catalog import TPU_SLICES, query_slices, slice_name
+from dstack_tpu.core.models.resources import ResourcesSpec
+
+
+class TestCatalogShapes:
+    def test_has_multihost_slices(self):
+        multi = [s for s in TPU_SLICES if s.hosts > 1]
+        assert multi, "catalog must include multi-host pod slices"
+
+    def test_v5e_8_single_host(self):
+        s = next(s for s in TPU_SLICES if s.version == "v5e" and s.chips == 8)
+        assert s.hosts == 1 and s.topology == "2x4"
+
+    def test_v5p_64_hosts(self):
+        s = next(s for s in TPU_SLICES if s.version == "v5p" and s.chips == 64)
+        assert s.hosts == 16  # 4 chips per host
+
+    def test_names(self):
+        assert slice_name("v5e", 8) == "v5litepod-8"
+        assert slice_name("v5p", 64) == "v5p-128"  # cores naming
+        assert slice_name("v6e", 8) == "v6e-8"
+
+
+class TestQuery:
+    def test_query_v5e_8(self):
+        spec = ResourcesSpec.model_validate({"tpu": "v5e-8"})
+        items = query_slices(spec)
+        assert items
+        assert all(i.version == "v5e" and i.chips == 8 for i in items)
+        # sorted by price: spot first
+        assert items[0].spot
+
+    def test_query_topology(self):
+        spec = ResourcesSpec.model_validate({"tpu": {"version": "v5p", "topology": "4x4x4"}})
+        items = query_slices(spec)
+        assert items and all(i.topology == "4x4x4" and i.chips == 64 for i in items)
+
+    def test_query_region_and_price(self):
+        spec = ResourcesSpec.model_validate({"tpu": {"version": "v5e", "chips": "8..32"}})
+        items = query_slices(spec, regions=["us-west4"], spot=False, max_price=40.0)
+        assert all(i.region == "us-west4" and not i.spot and i.price <= 40.0 for i in items)
+
+    def test_no_tpu_no_offers(self):
+        assert query_slices(ResourcesSpec()) == []
+
+    def test_resources_populated(self):
+        spec = ResourcesSpec.model_validate({"tpu": "v5p-8"})
+        items = query_slices(spec)
+        assert items
+        r = items[0].resources
+        assert r is not None and r.tpu is not None
+        assert r.tpu.hosts == 2  # 8 chips / 4 per host
+        assert r.cpus > 0

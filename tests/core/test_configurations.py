@@ -1,0 +1,127 @@
+import pytest
+
+from dstack_tpu.core.models.configurations import (
+    DevEnvironmentConfiguration,
+    FleetConfiguration,
+    GatewayConfiguration,
+    PortMapping,
+    ServiceConfiguration,
+    TaskConfiguration,
+    VolumeConfiguration,
+    parse_apply_configuration,
+    parse_run_configuration,
+)
+
+
+class TestTask:
+    def test_minimal(self):
+        conf = parse_run_configuration(
+            {"type": "task", "commands": ["python train.py"], "resources": {"tpu": "v5e-8"}}
+        )
+        assert isinstance(conf, TaskConfiguration)
+        assert conf.nodes == 1
+        assert conf.resources.tpu is not None
+
+    def test_multinode(self):
+        conf = parse_run_configuration(
+            {
+                "type": "task",
+                "nodes": 8,
+                "commands": ["python train.py"],
+                "resources": {"tpu": {"version": "v5p", "chips": 32}},
+            }
+        )
+        assert isinstance(conf, TaskConfiguration) and conf.nodes == 8
+
+    def test_env_forms(self):
+        conf = parse_run_configuration(
+            {"type": "task", "commands": ["true"], "env": ["A=1", "B"]}
+        )
+        assert conf.env.vars == {"A": "1", "B": None}
+        conf2 = parse_run_configuration(
+            {"type": "task", "commands": ["true"], "env": {"A": 1}}
+        )
+        assert conf2.env.vars == {"A": "1"}
+
+    def test_ports(self):
+        conf = parse_run_configuration(
+            {"type": "task", "commands": ["true"], "ports": [8000, "80:8000", "*:9000"]}
+        )
+        assert conf.ports[0] == PortMapping(local_port=8000, container_port=8000)
+        assert conf.ports[1] == PortMapping(local_port=80, container_port=8000)
+        assert conf.ports[2] == PortMapping(local_port=None, container_port=9000)
+
+    def test_bad_name(self):
+        with pytest.raises(ValueError):
+            parse_run_configuration({"type": "task", "name": "Bad Name!", "commands": ["x"]})
+
+
+class TestService:
+    def test_minimal(self):
+        conf = parse_run_configuration(
+            {"type": "service", "commands": ["serve"], "port": 8000}
+        )
+        assert isinstance(conf, ServiceConfiguration)
+        assert conf.replicas.min == 1 and conf.replicas.max == 1
+
+    def test_autoscaling_requires_scaling(self):
+        with pytest.raises(ValueError):
+            parse_run_configuration(
+                {"type": "service", "commands": ["serve"], "port": 8000, "replicas": "1..4"}
+            )
+        conf = parse_run_configuration(
+            {
+                "type": "service",
+                "commands": ["serve"],
+                "port": 8000,
+                "replicas": "1..4",
+                "scaling": {"metric": "rps", "target": 20},
+            }
+        )
+        assert conf.scaling is not None and conf.scaling.target == 20
+
+    def test_model(self):
+        conf = parse_run_configuration(
+            {"type": "service", "commands": ["serve"], "port": 8000, "model": "llama-3-8b"}
+        )
+        assert conf.model is not None and conf.model.name == "llama-3-8b"
+
+
+class TestOtherConfigs:
+    def test_dev_env(self):
+        conf = parse_run_configuration({"type": "dev-environment", "ide": "vscode"})
+        assert isinstance(conf, DevEnvironmentConfiguration)
+
+    def test_fleet_cloud(self):
+        conf = parse_apply_configuration(
+            {"type": "fleet", "nodes": 2, "resources": {"tpu": "v5e-8"}}
+        )
+        assert isinstance(conf, FleetConfiguration)
+
+    def test_fleet_needs_nodes_or_ssh(self):
+        with pytest.raises(ValueError):
+            parse_apply_configuration({"type": "fleet"})
+
+    def test_fleet_ssh(self):
+        conf = parse_apply_configuration(
+            {
+                "type": "fleet",
+                "ssh_config": {"user": "ubuntu", "hosts": ["10.0.0.1", {"hostname": "10.0.0.2"}]},
+            }
+        )
+        assert isinstance(conf, FleetConfiguration)
+        assert conf.ssh_config is not None and len(conf.ssh_config.hosts) == 2
+
+    def test_volume(self):
+        conf = parse_apply_configuration({"type": "volume", "size": "100GB"})
+        assert isinstance(conf, VolumeConfiguration) and conf.size == 100.0
+        with pytest.raises(ValueError):
+            parse_apply_configuration({"type": "volume"})
+
+    def test_gateway(self):
+        conf = parse_apply_configuration({"type": "gateway", "domain": "x.example.com"})
+        assert isinstance(conf, GatewayConfiguration)
+
+    def test_unknown_type(self):
+        with pytest.raises(ValueError):
+            parse_apply_configuration({"type": "nope"})

@@ -1,0 +1,91 @@
+import pytest
+
+from dstack_tpu.core.models.resources import (
+    IntRange,
+    MemoryRange,
+    ResourcesSpec,
+    TPUSpec,
+    parse_memory,
+    topology_chips,
+)
+
+
+class TestMemory:
+    def test_units(self):
+        assert parse_memory("512MB") == 0.5
+        assert parse_memory("16GB") == 16.0
+        assert parse_memory("1TB") == 1024.0
+        assert parse_memory(8) == 8.0
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_memory("16QB")
+
+
+class TestRange:
+    def test_forms(self):
+        assert IntRange.model_validate("4") == IntRange(min=4, max=4)
+        assert IntRange.model_validate(4) == IntRange(min=4, max=4)
+        assert IntRange.model_validate("2..8") == IntRange(min=2, max=8)
+        assert IntRange.model_validate("4..") == IntRange(min=4, max=None)
+        assert IntRange.model_validate("..8") == IntRange(min=None, max=8)
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            IntRange.model_validate("8..2")
+
+    def test_contains(self):
+        r = IntRange.model_validate("2..8")
+        assert r.contains(2) and r.contains(8) and not r.contains(9)
+
+    def test_memory_range(self):
+        r = MemoryRange.model_validate("32GB..1TB")
+        assert r.min == 32.0 and r.max == 1024.0
+
+
+class TestTPUSpec:
+    def test_shorthand(self):
+        spec = TPUSpec.model_validate("v5e-8")
+        assert spec.version == ["v5e"]
+        assert spec.chips == IntRange(min=8, max=8)
+
+    def test_gcp_alias(self):
+        spec = TPUSpec.model_validate("v5litepod-16")
+        assert spec.version == ["v5e"]
+        assert spec.chips.min == 16
+
+    def test_full_form(self):
+        spec = TPUSpec.model_validate(
+            {"version": ["v5p", "v6e"], "chips": "8..64", "topology": "4x4x4"}
+        )
+        assert spec.version == ["v5p", "v6e"]
+        assert spec.chips == IntRange(min=8, max=64)
+        assert spec.topology == "4x4x4"
+
+    def test_bad_generation(self):
+        with pytest.raises(ValueError):
+            TPUSpec.model_validate("v99-8")
+
+    def test_bad_topology(self):
+        with pytest.raises(ValueError):
+            TPUSpec.model_validate({"topology": "4by4"})
+
+    def test_topology_chips(self):
+        assert topology_chips("4x4x4") == 64
+        assert topology_chips("2x4") == 8
+
+
+class TestResourcesSpec:
+    def test_defaults(self):
+        spec = ResourcesSpec()
+        assert spec.tpu is None
+        assert spec.cpu.count.min == 2
+
+    def test_yaml_shape(self):
+        spec = ResourcesSpec.model_validate(
+            {"tpu": "v5e-8", "cpu": "8..", "memory": "32GB..", "disk": "200GB"}
+        )
+        assert spec.tpu is not None and spec.tpu.chips.min == 8
+        assert spec.cpu.count.min == 8
+        assert spec.memory.min == 32.0
+        assert spec.disk is not None and spec.disk.size.min == 200.0
