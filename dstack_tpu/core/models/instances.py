@@ -27,9 +27,11 @@ class TPUInfo(CoreModel):
 
     @property
     def accelerator_type(self) -> str:
-        """GCP accelerator-type string, e.g. ``v5litepod-8``."""
-        gen = {"v5e": "v5litepod", "v6e": "v6e"}.get(self.version, self.version)
-        return f"{gen}-{self.chips}"
+        """GCP accelerator-type string: cores-named for v2/v3/v4/v5p
+        (``v5p-128`` = 64 chips), chips-named for v5e/v6e."""
+        gen = {"v5e": "v5litepod"}.get(self.version, self.version)
+        n = self.chips * 2 if self.version in ("v2", "v3", "v4", "v5p") else self.chips
+        return f"{gen}-{n}"
 
 
 class Resources(CoreModel):

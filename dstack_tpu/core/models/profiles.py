@@ -116,8 +116,10 @@ class ProfileParams(CoreModel):
     @field_validator("retry", mode="before")
     @classmethod
     def _retry(cls, v):
-        if isinstance(v, bool):
-            return ProfileRetry() if v else None
+        # True → default retry. False is KEPT as False ("explicitly
+        # disabled") so profile merge doesn't override it; None = unset.
+        if v is True:
+            return ProfileRetry()
         return v
 
     @field_validator("max_duration", "stop_duration", "idle_duration", mode="before")
@@ -149,6 +151,15 @@ class ProfilesConfig(CoreModel):
             if p.name == name:
                 return p
         raise KeyError(name)
+
+
+def resolve_retry(v: Union[ProfileRetry, bool, None]) -> Optional[ProfileRetry]:
+    """Collapse the tri-state ``retry`` field to an effective policy."""
+    if v is None or v is False:
+        return None
+    if v is True:
+        return ProfileRetry()
+    return v
 
 
 def merge_profile_into(profile: Optional[Profile], params: ProfileParams) -> ProfileParams:

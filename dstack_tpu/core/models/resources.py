@@ -30,7 +30,6 @@ from dstack_tpu.core.models.common import CoreModel
 
 T = TypeVar("T", bound=Union[int, float])
 
-_RANGE_RE = re.compile(r"^\s*(?P<min>[^.\s]+)?\s*(?:\.\.)\s*(?P<max>[^.\s]+)?\s*$")
 _MEMORY_RE = re.compile(r"^\s*(?P<num>\d+(?:\.\d+)?)\s*(?P<unit>[a-zA-Z]*)\s*$")
 
 _MEMORY_UNITS = {
@@ -77,9 +76,9 @@ class Range(CoreModel, Generic[T]):
         if isinstance(v, (int, float)):
             return {"min": v, "max": v}
         if isinstance(v, str):
-            m = _RANGE_RE.match(v)
-            if m is not None:
-                return {"min": m.group("min"), "max": m.group("max")}
+            if ".." in v:
+                lo, _, hi = v.partition("..")
+                return {"min": lo.strip() or None, "max": hi.strip() or None}
             return {"min": v, "max": v}
         raise ValueError(f"invalid range: {v!r}")
 
@@ -184,11 +183,27 @@ class TPUSpec(CoreModel):
     @model_validator(mode="before")
     @classmethod
     def _parse_shorthand(cls, v: Any) -> Any:
-        """``"v5e-8"`` / ``"v5litepod-8"`` / ``"v5p"`` → full spec."""
+        """``"v5e-8"`` / ``"v5litepod-8"`` / ``"v5p-128"`` / ``"v5p"`` → full spec.
+
+        GCP naming semantics: for the cores-named generations (v2/v3/v4/
+        v5p) the number in the public accelerator type is TensorCores =
+        2×chips (``v5p-128`` is a 64-chip slice); for v5e/v6e (and the
+        ``v5litepod-N`` alias) it is chips. We follow GCP so users can
+        paste accelerator types verbatim.
+        """
         if isinstance(v, str):
             m = _TPU_SHORT_RE.match(v.strip())
             if m is not None:
-                return {"version": m.group("gen"), "chips": int(m.group("chips"))}
+                raw_gen = m.group("gen").lower()
+                n = int(m.group("chips"))
+                if raw_gen in ("v2", "v3", "v4", "v5p"):
+                    if n % 2 != 0:
+                        raise ValueError(
+                            f"{v!r}: {raw_gen} slices are named by cores (2×chips); "
+                            "expected an even number"
+                        )
+                    n //= 2
+                return {"version": raw_gen, "chips": n}
             return {"version": v.strip()}
         if isinstance(v, int):
             return {"chips": v}

@@ -310,18 +310,17 @@ class RunSpec(CoreModel):
     def effective_profile(self) -> Profile:
         """Run-config fields win over profile fields
         (reference core/models/runs.py:369-386)."""
+        from dstack_tpu.core.models.profiles import ProfileParams, merge_profile_into
+
         base = self.profile or Profile(name="default")
-        merged = base.model_copy()
-        for field in (
-            "backends", "regions", "availability_zones", "instance_types",
-            "reservation", "spot_policy", "retry", "max_duration", "stop_duration",
-            "max_price", "creation_policy", "idle_duration", "utilization_policy",
-            "startup_order", "stop_criteria", "fleets", "tags",
-        ):
-            v = getattr(self.configuration, field, None)
-            if v is not None:
-                setattr(merged, field, v)
-        return merged
+        conf_params = ProfileParams(
+            **{
+                f: getattr(self.configuration, f, None)
+                for f in ProfileParams.model_fields
+            }
+        )
+        merged = merge_profile_into(base, conf_params)
+        return Profile(name=base.name, default=base.default, **merged.model_dump())
 
 
 class ServiceSpec(CoreModel):

@@ -1,0 +1,251 @@
+"""Llama-family transformer, TPU-first.
+
+Pure-functional JAX: parameters are plain pytrees with a parallel
+*logical-axis spec tree* (see ``dstack_tpu.parallel.sharding``), layers
+are stacked on a leading ``layers`` dim and executed with ``lax.scan``
+(single trace/compile of the layer body — XLA-friendly, fast compiles
+even at 80 layers), matmuls in bf16 on the MXU with f32 accumulation,
+rematerialization on the layer boundary.
+
+This is the compute-plane flagship used by ``bench.py`` and
+``__graft_entry__.py``; the orchestrator treats it as user code (the
+reference ships torch examples instead — examples/fine-tuning).
+"""
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dstack_tpu.ops.attention import attention
+from dstack_tpu.parallel.ring_attention import ring_attention
+from dstack_tpu.parallel.sharding import ShardingRules, constrain, default_rules
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    intermediate_size: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def num_params(self) -> int:
+        e, h = self.vocab_size * self.hidden_size, self.hidden_size
+        per_layer = (
+            h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
+            + 3 * h * self.intermediate_size + 2 * h
+        )
+        out = 0 if self.tie_embeddings else e
+        return e + self.n_layers * per_layer + h + out
+
+
+LLAMA_3_8B = LlamaConfig()
+LLAMA_3_70B = LlamaConfig(
+    hidden_size=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+    intermediate_size=28672,
+)
+LLAMA_32_1B = LlamaConfig(
+    hidden_size=2048, n_layers=16, n_heads=32, n_kv_heads=8, head_dim=64,
+    intermediate_size=8192, tie_embeddings=True,
+)
+LLAMA_32_3B = LlamaConfig(
+    hidden_size=3072, n_layers=28, n_heads=24, n_kv_heads=8,
+    intermediate_size=8192, tie_embeddings=True,
+)
+LLAMA_TINY = LlamaConfig(  # for tests / virtual meshes
+    vocab_size=512, hidden_size=128, n_layers=2, n_heads=4, n_kv_heads=2,
+    head_dim=32, intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
+    remat=False,
+)
+
+CONFIGS = {
+    "llama-3-8b": LLAMA_3_8B,
+    "llama-3-70b": LLAMA_3_70B,
+    "llama-3.2-1b": LLAMA_32_1B,
+    "llama-3.2-3b": LLAMA_32_3B,
+    "llama-tiny": LLAMA_TINY,
+}
+
+
+def param_specs(config: LlamaConfig) -> dict:
+    """Logical-axis tree matching :func:`init_params` output."""
+    L = ("layers",)
+    specs = {
+        "embed": ("vocab", "embed_fsdp"),
+        "layers": {
+            "attn_norm": L + (None,),
+            "wq": L + ("embed_fsdp", "heads"),
+            "wk": L + ("embed_fsdp", "kv_heads"),
+            "wv": L + ("embed_fsdp", "kv_heads"),
+            "wo": L + ("heads", "embed_fsdp"),
+            "mlp_norm": L + (None,),
+            "w_gate": L + ("embed_fsdp", "mlp"),
+            "w_up": L + ("embed_fsdp", "mlp"),
+            "w_down": L + ("mlp", "embed_fsdp"),
+        },
+        "final_norm": (None,),
+    }
+    if not config.tie_embeddings:
+        specs["lm_head"] = ("embed_fsdp", "vocab")
+    return specs
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> dict:
+    c = config
+    k = jax.random.split(key, 8)
+    std = 0.02
+    dt = c.dtype
+
+    def normal(key, shape, scale=std):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    L = c.n_layers
+    params = {
+        "embed": normal(k[0], (c.vocab_size, c.hidden_size)),
+        "layers": {
+            "attn_norm": jnp.ones((L, c.hidden_size), dt),
+            "wq": normal(k[1], (L, c.hidden_size, c.q_dim)),
+            "wk": normal(k[2], (L, c.hidden_size, c.kv_dim)),
+            "wv": normal(k[3], (L, c.hidden_size, c.kv_dim)),
+            "wo": normal(k[4], (L, c.q_dim, c.hidden_size), std / math.sqrt(2 * L)),
+            "mlp_norm": jnp.ones((L, c.hidden_size), dt),
+            "w_gate": normal(k[5], (L, c.hidden_size, c.intermediate_size)),
+            "w_up": normal(k[6], (L, c.hidden_size, c.intermediate_size)),
+            "w_down": normal(k[7], (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)),
+        },
+        "final_norm": jnp.ones((c.hidden_size,), dt),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = normal(jax.random.fold_in(key, 99), (c.hidden_size, c.vocab_size))
+    return params
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype) * w
+
+
+def rope_freqs(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
+    """positions [T] → (cos, sin) each [T, head_dim//2], f32."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [B, H, T, D]; rotate-half convention."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, None].astype(x.dtype)
+    s = sin[None, None].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _attention_block(
+    x: jax.Array,
+    layer: dict,
+    config: LlamaConfig,
+    cos: jax.Array,
+    sin: jax.Array,
+    mesh: Optional[Mesh],
+    rules: ShardingRules,
+    attn_impl: Optional[str],
+) -> jax.Array:
+    c = config
+    b, t, _ = x.shape
+    h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+    q = jnp.einsum("bte,ed->btd", h, layer["wq"])
+    k = jnp.einsum("bte,ed->btd", h, layer["wk"])
+    v = jnp.einsum("bte,ed->btd", h, layer["wv"])
+    q = q.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+    k = k.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+    v = v.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+    q = constrain(q, rules, "batch", "heads", "seq", None, mesh=mesh)
+    k = constrain(k, rules, "batch", "kv_heads", "seq", None, mesh=mesh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if use_ring:
+        o = ring_attention(q, k, v, mesh=mesh, causal=True)
+    else:
+        o = attention(q, k, v, causal=True, impl=attn_impl)
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, c.q_dim)
+    o = jnp.einsum("btd,de->bte", o, layer["wo"])
+    return constrain(o, rules, "batch", "seq", None, mesh=mesh)
+
+
+def _mlp_block(
+    x: jax.Array,
+    layer: dict,
+    config: LlamaConfig,
+    mesh: Optional[Mesh],
+    rules: ShardingRules,
+) -> jax.Array:
+    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    g = jnp.einsum("bte,ef->btf", h, layer["w_gate"])
+    u = jnp.einsum("bte,ef->btf", h, layer["w_up"])
+    g = constrain(g, rules, "batch", "seq", "mlp", mesh=mesh)
+    o = jnp.einsum("btf,fe->bte", jax.nn.silu(g) * u, layer["w_down"])
+    return constrain(o, rules, "batch", "seq", None, mesh=mesh)
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,  # [B, T] int32
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    rules: Optional[ShardingRules] = None,
+    attn_impl: Optional[str] = None,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Token ids → logits [B, T, vocab] (f32)."""
+    c = config
+    rules = rules or default_rules()
+    x = params["embed"].at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
+    x = constrain(x, rules, "batch", "seq", None, mesh=mesh)
+    t = tokens.shape[1]
+    pos = positions if positions is not None else jnp.arange(t)
+    cos, sin = rope_freqs(pos, c.head_dim, c.rope_theta)
+
+    def layer_fn(x, layer):
+        x = x + _attention_block(x, layer, c, cos, sin, mesh, rules, attn_impl)
+        x = x + _mlp_block(x, layer, c, mesh, rules)
+        return x, None
+
+    if c.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bte,ev->btv", x, head.astype(c.dtype))
+    logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
+    return logits.astype(jnp.float32)
+
+
+def abstract_params(config: LlamaConfig) -> dict:
+    """Shape/dtype tree without allocating (for sharding planning)."""
+    return jax.eval_shape(lambda: init_params(config, jax.random.key(0)))

@@ -1,0 +1,133 @@
+"""Ring attention: exact sequence-parallel attention over the ``sp`` axis.
+
+Long-context strategy (SURVEY.md §5 "long-context"): the sequence dim is
+sharded across devices; each step every device computes blockwise
+attention of its local Q shard against the currently-held KV shard, then
+rotates KV around the ring with ``ppermute`` (ICI neighbor exchange —
+bandwidth-optimal on a TPU torus). Online log-sum-exp merging keeps the
+result exact (Liu et al., Ring Attention; blockwise softmax as in Flash
+Attention). Compute/communication overlap is left to XLA's latency
+hiding scheduler, which pipelines ppermute with the matmuls.
+
+No NCCL analog exists or is needed: this *is* the distributed
+communication backend for the sequence dimension.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attention(
+    q: jax.Array,  # [B, H, Tq, D]
+    k: jax.Array,  # [B, H, Tk, D]
+    v: jax.Array,  # [B, H, Tk, D]
+    bias: Optional[jax.Array],  # broadcastable to [B, H, Tq, Tk] or None
+    scale: float,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One KV-block of attention → (unnormalized out, running max, denom)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1)  # [B, H, Tq]
+    # Guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1.
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)  # [B, H, Tq]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return o.astype(jnp.float32), m_safe, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Merge two blockwise-softmax partials (log-sum-exp combine)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    return o, m, l
+
+
+def _causal_bias(tq: int, tk: int, q_offset, k_offset, dtype=jnp.float32) -> jax.Array:
+    """Causal mask bias for Q rows [q_offset, q_offset+tq) vs K cols
+    [k_offset, k_offset+tk) in global coordinates."""
+    qi = q_offset + jnp.arange(tq)[:, None]
+    kj = k_offset + jnp.arange(tk)[None, :]
+    return jnp.where(qi >= kj, 0.0, NEG_INF).astype(dtype)[None, None]
+
+
+def ring_attention(
+    q: jax.Array,  # [B, H, T_local, D] — seq sharded over "sp"
+    k: jax.Array,  # [B, Hkv, T_local, D]
+    v: jax.Array,  # [B, Hkv, T_local, D]
+    *,
+    mesh: Mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Exact multi-device attention with KV rotating around the ``sp`` ring.
+
+    Inputs/outputs are *global* arrays (sharded over ``axis_name`` on the
+    sequence dim); internally runs as shard_map.
+    """
+    sp = mesh.shape[axis_name]
+    if sp == 1:
+        from dstack_tpu.ops.attention import attention as local_attention
+
+        return local_attention(q, k, v, causal=causal, scale=scale)
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if k.shape[1] != q.shape[1]:  # GQA: expand KV heads before the ring
+        assert q.shape[1] % k.shape[1] == 0
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+
+    # batch/head dims follow the outer sharding; seq is sharded over sp.
+    qkv_spec = P(None, None, axis_name, None)
+
+    def local_fn(q, k, v):
+        idx = jax.lax.axis_index(axis_name)
+        t_local = q.shape[2]  # per-shard sequence length
+        q32 = q.astype(jnp.float32)
+
+        def step(carry, r):
+            o, m, l, kb, vb = carry
+            # KV block currently held originated at ring position (idx - r) % sp
+            src = (idx - r) % sp
+            if causal:
+                bias = _causal_bias(t_local, t_local, idx * t_local, src * t_local)
+            else:
+                bias = None
+            ob, mb, lb = _block_attention(q32, kb, vb, bias, scale)
+            o, m, l = _merge(o, m, l, ob, mb, lb)
+            # rotate KV to the next device (ring neighbor over ICI)
+            perm = [(i, (i + 1) % sp) for i in range(sp)]
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+            return (o, m, l, kb, vb), None
+
+        o0 = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+        m0 = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+        l0 = jnp.zeros(q.shape[:3], jnp.float32)
+        (o, m, l, _, _), _ = jax.lax.scan(
+            step, (o0, m0, l0, k, v), jnp.arange(sp)
+        )
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (o / l[..., None]).astype(q.dtype)
+
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+        check_rep=False,
+    )(q, k, v)

@@ -1,0 +1,163 @@
+"""Training step: sharded init, loss, optimizer update.
+
+The full train step is one ``jit`` over the mesh: forward (bf16, remat),
+backward, optax update — XLA inserts all collectives (reduce-scatter/
+all-gather for fsdp, psum for tp) from the shardings alone.
+"""
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dstack_tpu.models import llama
+from dstack_tpu.parallel.sharding import ShardingRules, default_rules, tree_shardings
+
+
+def cross_entropy_loss(
+    logits: jax.Array,  # [B, T, V] f32
+    targets: jax.Array,  # [B, T] int32
+    mask: Optional[jax.Array] = None,  # [B, T] 0/1
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (mean loss, total weight)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        mask = jnp.ones_like(targets, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    total = jnp.maximum(mask.sum(), 1.0)
+    return -(ll * mask).sum() / total, total
+
+
+def default_optimizer(
+    lr: float = 3e-4, weight_decay: float = 0.1, warmup: int = 100, decay_steps: int = 10000
+) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps=warmup, decay_steps=max(decay_steps, warmup + 1)
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def state_specs(config: llama.LlamaConfig, optimizer: optax.GradientTransformation, rules: ShardingRules, mesh: Mesh) -> dict:
+    """Shardings for the full train state (params + opt state + step)."""
+    pspecs = llama.param_specs(config)
+    param_sh = tree_shardings(pspecs, mesh, rules)
+    params_abs = llama.abstract_params(config)
+    opt_abs = jax.eval_shape(optimizer.init, params_abs)
+
+    # optax states mirror the param tree inside ScaleByAdamState etc.;
+    # shard any leaf whose shape matches a param leaf, replicate the rest.
+    flat_params = {leaf.shape: sh for (path, leaf), sh in zip(
+        jax.tree_util.tree_leaves_with_path(params_abs),
+        jax.tree.leaves(param_sh),
+    )}
+    repl = NamedSharding(mesh, P())
+
+    def opt_leaf_sharding(leaf):
+        return flat_params.get(leaf.shape, repl)
+
+    opt_sh = jax.tree.map(opt_leaf_sharding, opt_abs)
+    return {"params": param_sh, "opt_state": opt_sh, "step": repl}
+
+
+def batch_sharding(mesh: Mesh, rules: ShardingRules) -> NamedSharding:
+    return rules.mesh_sharding(mesh, ("batch", "seq"))
+
+
+def sharded_init(
+    config: llama.LlamaConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+    seed: int = 0,
+) -> tuple[dict, dict]:
+    """Initialize the train state directly sharded (no host gather).
+
+    Returns (state, state_shardings).
+    """
+    rules = rules or default_rules()
+    shardings = state_specs(config, optimizer, rules, mesh)
+
+    def init(key):
+        params = llama.init_params(config, key)
+        return {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    key = jax.random.key(seed)
+    state = jax.jit(init, out_shardings=shardings)(key)
+    return state, shardings
+
+
+def make_train_step(
+    config: llama.LlamaConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+    attn_impl: Optional[str] = None,
+) -> Callable:
+    """Build the jitted train step: (state, batch{tokens,targets,mask}) →
+    (state, metrics)."""
+    rules = rules or default_rules()
+    shardings = state_specs(config, optimizer, rules, mesh)
+    b_sh = batch_sharding(mesh, rules)
+    batch_sh = {"tokens": b_sh, "targets": b_sh, "mask": b_sh}
+    repl = NamedSharding(mesh, P())
+
+    def loss_fn(params, batch):
+        logits = llama.forward(
+            params, batch["tokens"], config, mesh=mesh, rules=rules, attn_impl=attn_impl
+        )
+        loss, _ = cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+        return loss
+
+    def step(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        gnorm = optax.global_norm(grads)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, {"loss": repl, "grad_norm": repl}),
+        donate_argnums=(0,),
+    )
+
+
+def make_eval_step(
+    config: llama.LlamaConfig,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+) -> Callable:
+    rules = rules or default_rules()
+
+    def step(params, batch):
+        logits = llama.forward(params, batch["tokens"], config, mesh=mesh, rules=rules)
+        loss, _ = cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+        return {"loss": loss}
+
+    return jax.jit(step)
+
+
+def flops_per_token(config: llama.LlamaConfig, seq_len: int) -> float:
+    """Approximate train FLOPs/token: 6·N params + attention term."""
+    n = config.num_params()
+    attn = 12 * config.n_layers * config.hidden_size * seq_len  # fwd+bwd qk/av
+    return 6.0 * n + attn

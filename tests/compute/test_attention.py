@@ -1,0 +1,48 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dstack_tpu.ops.attention import _xla_attention, attention, flash_attention
+
+
+def _rand_qkv(key, b=2, h=4, hkv=2, t=256, d=128, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    q = jax.random.normal(k1, (b, h, t, d), dtype)
+    k = jax.random.normal(k2, (b, hkv, t, d), dtype)
+    v = jax.random.normal(k3, (b, hkv, t, d), dtype)
+    return q, k, v
+
+
+class TestXLAAttention:
+    def test_causal_matches_naive(self):
+        q, k, v = _rand_qkv(jax.random.key(0), b=1, h=2, hkv=2, t=16, d=8)
+        out = _xla_attention(q, k, v, causal=True, scale=8**-0.5)
+        # naive reference
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 8**-0.5
+        mask = jnp.tril(jnp.ones((16, 16), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+        expected = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+    def test_gqa(self):
+        q, k, v = _rand_qkv(jax.random.key(1), h=8, hkv=2, t=32, d=16)
+        out = attention(q, k, v, causal=True, impl="xla")
+        assert out.shape == q.shape
+
+
+class TestFlashKernel:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_xla(self, causal):
+        q, k, v = _rand_qkv(jax.random.key(2), b=1, h=2, hkv=1, t=512, d=128)
+        ref = _xla_attention(q, k, v, causal=causal, scale=128**-0.5)
+        out = flash_attention(
+            q, k, v, causal=causal, block_q=128, block_k=128, interpret=True
+        )
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+    def test_gqa_grouping(self):
+        q, k, v = _rand_qkv(jax.random.key(3), b=1, h=4, hkv=2, t=256, d=128)
+        ref = _xla_attention(q, k, v, causal=True, scale=128**-0.5)
+        out = flash_attention(q, k, v, causal=True, block_q=128, block_k=128, interpret=True)
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)

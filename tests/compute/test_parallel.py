@@ -1,0 +1,70 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dstack_tpu.ops.attention import _xla_attention
+from dstack_tpu.parallel.mesh import MeshConfig, make_mesh, mesh_shape
+from dstack_tpu.parallel.ring_attention import ring_attention
+from dstack_tpu.parallel.sharding import default_rules, tree_shardings
+
+
+class TestMesh:
+    def test_make_mesh_8(self):
+        mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+        assert mesh_shape(mesh) == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+
+    def test_wildcard(self):
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=-1, tp=2))
+        assert mesh_shape(mesh)["fsdp"] == 4
+
+    def test_subset_mesh(self):
+        # fixed axes smaller than the device count use a leading subset
+        mesh = make_mesh(MeshConfig(dp=3, fsdp=1, tp=1))
+        assert mesh.devices.size == 3
+
+    def test_bad_shape(self):
+        with pytest.raises(ValueError):
+            make_mesh(MeshConfig(dp=5, fsdp=2, tp=1))  # 10 > 8 devices
+
+
+class TestShardingRules:
+    def test_param_shardings(self):
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=4, tp=2))
+        rules = default_rules()
+        specs = {"w": ("embed_fsdp", "mlp"), "norm": (None,)}
+        sh = tree_shardings(specs, mesh, rules)
+        assert str(sh["w"].spec) == "PartitionSpec('fsdp', 'tp')"
+        assert str(sh["norm"].spec) == "PartitionSpec(None,)"
+
+
+class TestRingAttention:
+    def test_matches_local(self):
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, sp=8, tp=1))
+        b, h, hkv, t, d = 1, 4, 2, 128, 32
+        key = jax.random.key(0)
+        k1, k2, k3 = jax.random.split(key, 3)
+        q = jax.random.normal(k1, (b, h, t, d))
+        k = jax.random.normal(k2, (b, hkv, t, d))
+        v = jax.random.normal(k3, (b, hkv, t, d))
+        ref = _xla_attention(q, k, v, causal=True, scale=d**-0.5)
+        out = ring_attention(q, k, v, mesh=mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_non_causal(self):
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, sp=4, tp=1))
+        b, h, t, d = 2, 2, 64, 16
+        key = jax.random.key(1)
+        k1, k2, k3 = jax.random.split(key, 3)
+        q = jax.random.normal(k1, (b, h, t, d))
+        k = jax.random.normal(k2, (b, h, t, d))
+        v = jax.random.normal(k3, (b, h, t, d))
+        ref = _xla_attention(q, k, v, causal=False, scale=d**-0.5)
+        out = ring_attention(q, k, v, mesh=mesh, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_sp1_fallback(self):
+        mesh = make_mesh(MeshConfig(dp=8, fsdp=1, sp=1, tp=1))
+        q = jnp.ones((1, 2, 32, 16))
+        out = ring_attention(q, q, q, mesh=mesh, causal=True)
+        assert out.shape == q.shape

@@ -7,7 +7,9 @@ the control plane against in-memory sqlite with mocked backends.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU regardless of the ambient JAX_PLATFORMS (e.g. a tunneled TPU):
+# unit tests always run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,6 +20,16 @@ import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
 import pytest  # noqa: E402
+
+# A sitecustomize hook may have force-registered a TPU plugin and set
+# jax.config jax_platforms to it (overriding the env var). Reset to CPU —
+# config.update wins over both.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -44,7 +44,7 @@ class TestQuery:
         assert query_slices(ResourcesSpec()) == []
 
     def test_resources_populated(self):
-        spec = ResourcesSpec.model_validate({"tpu": "v5p-8"})
+        spec = ResourcesSpec.model_validate({"tpu": "v5p-16"})
         items = query_slices(spec)
         assert items
         r = items[0].resources
