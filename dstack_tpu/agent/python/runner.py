@@ -1,0 +1,393 @@
+"""tpu-runner: per-job executor.
+
+Parity: reference runner/internal/executor (executor.go:95,231) and
+runner API (runner/api/server.go:61-68): receives the job over HTTP,
+materializes the repo, execs commands, streams state+logs incrementally
+by timestamp cursor. The C++ agent (dstack_tpu/agent/cpp) implements the
+same wire contract; this Python implementation drives the local backend
+and tests.
+
+TPU-first env injection: instead of the reference's
+``DSTACK_MASTER_NODE_IP``/NCCL wiring (executor.go:237-246) the runner
+exports the JAX/libtpu rendezvous set: ``DTPU_*`` plus ``TPU_WORKER_ID``,
+``TPU_WORKER_HOSTNAMES``, ``JAX_COORDINATOR_ADDRESS`` and ``MEGASCALE_*``
+for DCN multislice.
+"""
+
+import asyncio
+import base64
+import io
+import json
+import os
+import shlex
+import signal
+import tarfile
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Optional
+
+from aiohttp import web
+
+from dstack_tpu.agent import schemas
+from dstack_tpu.core.models.logs import LogEvent, LogEventSource
+from dstack_tpu.utils.logging import get_logger
+from dstack_tpu.version import __version__
+
+logger = get_logger("agent.runner")
+
+
+def cluster_env(ci, worker_id: Optional[int] = None) -> dict[str, str]:
+    """ClusterInfo → rendezvous environment (the TPU analog of
+    reference executor.go:237-246)."""
+    env: dict[str, str] = {}
+    nodes = ci.nodes_ips or ([ci.master_node_ip] if ci.master_node_ip else [])
+    num_nodes = max(len(nodes), 1)
+    rank = worker_id if worker_id is not None else 0
+    env["DTPU_NODES_IPS"] = "\n".join(nodes)
+    env["DTPU_MASTER_NODE_IP"] = ci.master_node_ip
+    env["DTPU_NODE_RANK"] = str(rank)
+    env["DTPU_NODES_NUM"] = str(num_nodes)
+    env["DTPU_COORDINATOR_ADDRESS"] = (
+        f"{ci.master_node_ip}:{ci.coordinator_port}" if ci.master_node_ip else ""
+    )
+    # JAX-standard variables: jax.distributed.initialize() picks these up.
+    env["JAX_COORDINATOR_ADDRESS"] = env["DTPU_COORDINATOR_ADDRESS"]
+    env["JAX_NUM_PROCESSES"] = str(num_nodes)
+    env["JAX_PROCESS_ID"] = str(rank)
+    # libtpu multi-host slice topology:
+    env["TPU_WORKER_ID"] = str(rank)
+    env["TPU_WORKER_HOSTNAMES"] = ",".join(nodes)
+    if ci.tpu_chips_per_host:
+        env["DTPU_TPU_CHIPS_PER_HOST"] = str(ci.tpu_chips_per_host)
+    if ci.tpu_total_chips:
+        env["DTPU_TPU_TOTAL_CHIPS"] = str(ci.tpu_total_chips)
+    if ci.tpu_topology:
+        env["DTPU_TPU_TOPOLOGY"] = ci.tpu_topology
+    # DCN multislice (v5p/v6e multi-slice over data-center network):
+    if ci.megascale_coordinator_address:
+        env["MEGASCALE_COORDINATOR_ADDRESS"] = ci.megascale_coordinator_address
+        env["MEGASCALE_NUM_SLICES"] = str(ci.num_slices)
+        env["MEGASCALE_SLICE_ID"] = str(ci.slice_id)
+    return env
+
+
+class Executor:
+    def __init__(self, home_dir: Path):
+        self.home_dir = home_dir
+        self.job: Optional[schemas.SubmitBody] = None
+        self.code_path: Optional[Path] = None
+        self.state_events: list[schemas.RunnerJobStateEvent] = []
+        self.job_logs: list[LogEvent] = []
+        self.runner_logs: list[LogEvent] = []
+        self._proc: Optional[asyncio.subprocess.Process] = None
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        self.no_connections_since: Optional[float] = None
+
+    # -- state/log pumps --
+
+    def _push_state(
+        self,
+        state: str,
+        reason: Optional[str] = None,
+        message: Optional[str] = None,
+        exit_status: Optional[int] = None,
+    ) -> None:
+        self.state_events.append(
+            schemas.RunnerJobStateEvent(
+                state=state,
+                timestamp=time.time(),
+                termination_reason=reason,
+                termination_message=message,
+                exit_status=exit_status,
+            )
+        )
+
+    def _log(self, text: str, source=LogEventSource.STDOUT) -> None:
+        self.job_logs.append(
+            LogEvent.create(datetime.now(timezone.utc), text, source)
+        )
+
+    def _rlog(self, text: str) -> None:
+        self.runner_logs.append(LogEvent.create(datetime.now(timezone.utc), text))
+
+    # -- lifecycle --
+
+    def submit(self, body: schemas.SubmitBody) -> None:
+        self.job = body
+        self._push_state("submitted")
+
+    def upload_code(self, data: bytes) -> None:
+        code_dir = self.home_dir / "code"
+        code_dir.mkdir(parents=True, exist_ok=True)
+        if data[:2] == b"\x1f\x8b" or data[:5].startswith(b"ustar") or len(data) > 0:
+            try:
+                with tarfile.open(fileobj=io.BytesIO(data), mode="r:*") as tf:
+                    tf.extractall(code_dir, filter="data")
+            except tarfile.TarError:
+                (code_dir / "code.bin").write_bytes(data)
+        self.code_path = code_dir
+
+    async def run(self) -> None:
+        if self.job is None:
+            raise ValueError("no job submitted")
+        self._task = asyncio.create_task(self._run_job())
+
+    async def _setup_repo(self, workdir: Path) -> None:
+        assert self.job is not None
+        repo = self.job.repo_data or {}
+        rtype = repo.get("repo_type", "virtual")
+        if rtype == "remote" and repo.get("repo_url"):
+            cmd = ["git", "clone", "--depth", "1"]
+            if repo.get("repo_branch"):
+                cmd += ["-b", repo["repo_branch"]]
+            cmd += [repo["repo_url"], str(workdir)]
+            self._rlog(f"cloning {repo['repo_url']}")
+            proc = await asyncio.create_subprocess_exec(
+                *cmd, stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT
+            )
+            out, _ = await proc.communicate()
+            if proc.returncode != 0:
+                raise RuntimeError(f"git clone failed: {out.decode()[-500:]}")
+        elif self.code_path is not None:
+            # local repo uploaded as archive
+            import shutil
+
+            shutil.copytree(self.code_path, workdir, dirs_exist_ok=True)
+
+    async def _run_job(self) -> None:
+        assert self.job is not None
+        spec = self.job.job_spec
+        workdir = self.home_dir / "workflow"
+        workdir.mkdir(parents=True, exist_ok=True)
+        try:
+            await self._setup_repo(workdir)
+        except Exception as e:
+            self._push_state("failed", reason="executor_error", message=str(e))
+            return
+
+        env = dict(os.environ)
+        env.update(cluster_env(self.job.cluster_info, spec.get("job_num", 0)))
+        env.update(self.job.secrets)
+        env.update(spec.get("env") or {})
+        env["DTPU_RUN_NAME"] = self.job.run_name
+        env["DTPU_JOB_NAME"] = self.job.job_name
+
+        commands = spec.get("commands") or []
+        script = " && ".join(commands) if commands else "true"
+        shell = spec.get("shell") or "/bin/bash"
+        cwd = spec.get("working_dir") or str(workdir)
+        Path(cwd).mkdir(parents=True, exist_ok=True)
+
+        self._push_state("running")
+        self._rlog(f"executing: {script}")
+        try:
+            self._proc = await asyncio.create_subprocess_exec(
+                shell,
+                "-c",
+                script,
+                cwd=cwd,
+                env=env,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT,
+                start_new_session=True,  # own process group for clean kill
+            )
+        except FileNotFoundError as e:
+            self._push_state("failed", reason="executor_error", message=str(e))
+            return
+
+        pump = asyncio.create_task(self._pump_logs())
+        max_duration = spec.get("max_duration")
+        try:
+            if max_duration:
+                try:
+                    await asyncio.wait_for(self._proc.wait(), timeout=max_duration)
+                except asyncio.TimeoutError:
+                    self._rlog("max_duration exceeded; terminating")
+                    await self.stop(grace=5)
+                    await self._proc.wait()
+                    await pump
+                    self._push_state(
+                        "terminated", reason="max_duration_exceeded"
+                    )
+                    return
+            else:
+                await self._proc.wait()
+        finally:
+            await pump
+        rc = self._proc.returncode
+        if self._stopped:
+            self._push_state("terminated", reason="terminated_by_user", exit_status=rc)
+        elif rc == 0:
+            self._push_state("done", reason="done_by_runner", exit_status=0)
+        else:
+            self._push_state(
+                "failed",
+                reason="container_exited_with_error",
+                message=f"exit status {rc}",
+                exit_status=rc,
+            )
+
+    async def _pump_logs(self) -> None:
+        assert self._proc is not None and self._proc.stdout is not None
+        while True:
+            try:
+                line = await self._proc.stdout.readline()
+            except (ValueError, asyncio.LimitOverrunError):
+                # line too long; read a chunk instead
+                line = await self._proc.stdout.read(65536)
+            if not line:
+                break
+            self._log(line.decode(errors="replace"))
+
+    async def stop(self, grace: int = 10) -> None:
+        self._stopped = True
+        if self._proc is not None and self._proc.returncode is None:
+            try:
+                os.killpg(os.getpgid(self._proc.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                return
+            try:
+                await asyncio.wait_for(self._proc.wait(), timeout=grace)
+            except asyncio.TimeoutError:
+                try:
+                    os.killpg(os.getpgid(self._proc.pid), signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+    def pull(self, since: float) -> schemas.PullResponse:
+        states = [e for e in self.state_events if e.timestamp > since]
+        logs = [
+            e for e in self.job_logs if e.timestamp.timestamp() > since
+        ]
+        rlogs = [
+            e for e in self.runner_logs if e.timestamp.timestamp() > since
+        ]
+        finished = any(
+            e.state in ("done", "failed", "terminated", "aborted")
+            for e in self.state_events
+        )
+        ts_candidates = (
+            [e.timestamp for e in states]
+            + [e.timestamp.timestamp() for e in logs]
+            + [e.timestamp.timestamp() for e in rlogs]
+        )
+        last = max(ts_candidates) if ts_candidates else since
+        return schemas.PullResponse(
+            job_states=states,
+            job_logs=logs,
+            runner_logs=rlogs,
+            last_updated=last,
+            has_more=not finished,
+        )
+
+    def metrics(self) -> schemas.MetricsSample:
+        import psutil
+
+        cpu_micro = 0
+        mem = 0
+        procs = []
+        if self._proc is not None and self._proc.returncode is None:
+            try:
+                p = psutil.Process(self._proc.pid)
+                procs = [p] + p.children(recursive=True)
+            except psutil.Error:
+                procs = []
+        for p in procs:
+            try:
+                t = p.cpu_times()
+                cpu_micro += int((t.user + t.system) * 1_000_000)
+                mem += p.memory_info().rss
+            except psutil.Error:
+                continue
+        sample = schemas.MetricsSample(
+            timestamp=time.time(),
+            cpu_usage_micro=cpu_micro,
+            memory_usage_bytes=mem,
+            memory_working_set_bytes=mem,
+        )
+        tpu = _read_tpu_metrics()
+        if tpu is not None:
+            sample.tpu_duty_cycle_percent = tpu.get("duty_cycle", [])
+            sample.tpu_hbm_usage_bytes = tpu.get("hbm_usage", [])
+            sample.tpu_hbm_total_bytes = tpu.get("hbm_total", [])
+        return sample
+
+
+def _read_tpu_metrics() -> Optional[dict]:
+    """TPU hardware metrics via libtpu's monitoring output when present.
+
+    The nvidia-smi analog (reference metrics.go:31-256 shells out to
+    smi tools); on TPU VMs libtpu exposes metrics through
+    /run/tpu_metrics or the `tpu-info` CLI — both optional, gated here.
+    """
+    path = Path("/run/tpu_metrics.json")
+    if path.exists():
+        try:
+            return json.loads(path.read_text())
+        except Exception:
+            return None
+    return None
+
+
+def build_app(home_dir: Path) -> web.Application:
+    ex = Executor(home_dir)
+    app = web.Application(client_max_size=1024 * 1024 * 1024)
+    app["executor"] = ex
+
+    async def healthcheck(request):
+        return web.json_response(
+            schemas.HealthcheckResponse(
+                service="tpu-runner", version=__version__
+            ).model_dump()
+        )
+
+    async def submit(request):
+        body = schemas.SubmitBody.model_validate(await request.json())
+        ex.submit(body)
+        return web.json_response({})
+
+    async def upload_code(request):
+        data = await request.read()
+        ex.upload_code(data)
+        return web.json_response({})
+
+    async def run(request):
+        await ex.run()
+        return web.json_response({})
+
+    async def pull(request):
+        since = float(request.query.get("timestamp", 0))
+        ex.no_connections_since = None
+        return web.Response(
+            text=ex.pull(since).model_dump_json(), content_type="application/json"
+        )
+
+    async def stop(request):
+        await ex.stop()
+        return web.json_response({})
+
+    async def metrics(request):
+        return web.Response(
+            text=ex.metrics().model_dump_json(), content_type="application/json"
+        )
+
+    app.router.add_get("/api/healthcheck", healthcheck)
+    app.router.add_post("/api/submit", submit)
+    app.router.add_post("/api/upload_code", upload_code)
+    app.router.add_post("/api/run", run)
+    app.router.add_get("/api/pull", pull)
+    app.router.add_post("/api/stop", stop)
+    app.router.add_get("/api/metrics", metrics)
+    return app
+
+
+async def serve(port: int, home_dir: Path) -> web.AppRunner:
+    app = build_app(home_dir)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    logger.info("tpu-runner listening on :%d, home=%s", port, home_dir)
+    return runner

@@ -1,0 +1,39 @@
+"""``tpu-runner-py`` entrypoint."""
+
+import argparse
+import asyncio
+from pathlib import Path
+
+
+def main() -> None:
+    from dstack_tpu.agent.python.runner import serve
+    from dstack_tpu.utils.logging import configure_logging
+
+    configure_logging()
+    parser = argparse.ArgumentParser("tpu-runner-py")
+    parser.add_argument("--port", type=int, default=10999)
+    parser.add_argument("--home", type=str, default="~/.dtpu/runner")
+    args = parser.parse_args()
+
+    async def run():
+        import signal
+
+        runner = await serve(args.port, Path(args.home).expanduser())
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+
+        async def shutdown():
+            # kill the job's process group before exiting so no orphans
+            ex = runner.app["executor"]
+            await ex.stop(grace=5)
+            stop.set()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, lambda: asyncio.create_task(shutdown()))
+        await stop.wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,413 @@
+"""tpu-shim: per-host agent managing job containers/processes.
+
+Parity: reference runner/internal/shim (docker.go, task.go, resources.go,
+host/): task FSM pending→preparing→pulling→creating→running→terminated,
+container runtime with device passthrough, host/TPU detection, state
+restore. The C++ agent implements the same contract; this Python shim
+drives the local backend and tests, and supports hosts without Docker
+via a process runtime (each task's runner is a subprocess).
+
+TPU passthrough (replaces the reference's nvidia/amd device logic,
+docker.go:995-1065): detect ``/dev/accel*`` (TPU VM in-kernel driver) or
+``/dev/vfio`` (v5p+); containers get the devices plus
+``PJRT_DEVICE=TPU`` env, or ``privileged`` when requested
+(reference docker.go:775-776,807).
+"""
+
+import asyncio
+import glob
+import os
+import shutil
+import socket
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+import psutil
+from aiohttp import web
+
+from dstack_tpu.agent import schemas
+from dstack_tpu.agent.schemas import TaskStatus
+from dstack_tpu.utils.logging import get_logger
+from dstack_tpu.version import __version__
+
+logger = get_logger("agent.shim")
+
+
+def detect_tpu() -> Optional[schemas.TPUDeviceInfo]:
+    accel = sorted(glob.glob("/dev/accel*"))
+    vfio = sorted(glob.glob("/dev/vfio/*"))
+    if not accel and not vfio:
+        return None
+    paths = accel or vfio
+    gen = os.environ.get("DTPU_TPU_GENERATION")
+    return schemas.TPUDeviceInfo(
+        chip_count=len(accel) if accel else max(len(vfio) - 1, 0),
+        device_paths=paths,
+        generation=gen,
+    )
+
+
+def host_info() -> schemas.HostInfo:
+    mem = psutil.virtual_memory().total
+    disk = shutil.disk_usage("/").total
+    addrs = []
+    try:
+        addrs = [
+            a.address
+            for addrs_ in psutil.net_if_addrs().values()
+            for a in addrs_
+            if a.family == socket.AF_INET and not a.address.startswith("127.")
+        ]
+    except Exception:
+        pass
+    return schemas.HostInfo(
+        cpus=psutil.cpu_count() or 1,
+        memory_bytes=mem,
+        disk_bytes=disk,
+        tpu=detect_tpu(),
+        hostname=socket.gethostname(),
+        addresses=addrs,
+    )
+
+
+class Task:
+    def __init__(self, req: schemas.TaskSubmitRequest):
+        self.req = req
+        self.status = TaskStatus.PENDING
+        self.termination_reason: Optional[str] = None
+        self.termination_message: Optional[str] = None
+        self.container_name: Optional[str] = None
+        self.runner_proc: Optional[asyncio.subprocess.Process] = None
+        self.runner_port: int = req.runner_port
+        self.home: Optional[Path] = None
+
+    def transition(self, to: TaskStatus) -> None:
+        if to not in schemas.ALLOWED_TRANSITIONS[self.status]:
+            raise ValueError(f"illegal transition {self.status} -> {to}")
+        self.status = to
+
+    def info(self) -> schemas.TaskInfo:
+        return schemas.TaskInfo(
+            id=self.req.id,
+            status=self.status,
+            termination_reason=self.termination_reason,
+            termination_message=self.termination_message,
+            container_name=self.container_name,
+            ports=[
+                schemas.PortMapping(container_port=self.runner_port, host_port=self.runner_port)
+            ],
+        )
+
+
+class ProcessRuntime:
+    """Containerless runtime: each task runs a tpu-runner subprocess on
+    this host (local backend, images without Docker). The moral
+    equivalent of ``dockerized=False`` backends in the reference
+    (vastai/k8s, runner/ssh.py:64-66)."""
+
+    def __init__(self, base_dir: Path):
+        self.base_dir = base_dir
+
+    async def start(self, task: Task) -> None:
+        task.transition(TaskStatus.PREPARING)
+        task.transition(TaskStatus.PULLING)  # nothing to pull
+        task.transition(TaskStatus.CREATING)
+        home = self.base_dir / task.req.id
+        home.mkdir(parents=True, exist_ok=True)
+        task.home = home
+        env = dict(os.environ)
+        env.update(task.req.env)
+        if task.req.pjrt_device:
+            env["PJRT_DEVICE"] = task.req.pjrt_device
+        env.update(task.req.tpu_env)
+        task.runner_proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-m",
+            "dstack_tpu.agent.python.runner_main",
+            "--port",
+            str(task.runner_port),
+            "--home",
+            str(home),
+            env=env,
+            # same process group as the shim: killing the shim's group
+            # reaps runners too (no orphan agents after abrupt exit)
+        )
+        # wait for the runner port to accept
+        for _ in range(100):
+            if task.runner_proc.returncode is not None:
+                raise RuntimeError("runner process exited early")
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1", task.runner_port)
+                w.close()
+                break
+            except OSError:
+                await asyncio.sleep(0.1)
+        else:
+            raise RuntimeError("runner did not start listening")
+        task.container_name = f"proc-{task.runner_proc.pid}"
+        task.transition(TaskStatus.RUNNING)
+
+    async def terminate(self, task: Task, timeout: int) -> None:
+        # terminate only the runner process (it shares the shim's process
+        # group); the runner kills its own job process group on SIGTERM
+        proc = task.runner_proc
+        if proc is not None and proc.returncode is None:
+            try:
+                proc.terminate()
+                try:
+                    await asyncio.wait_for(proc.wait(), timeout=timeout)
+                except asyncio.TimeoutError:
+                    proc.kill()
+            except ProcessLookupError:
+                pass
+
+    async def remove(self, task: Task) -> None:
+        if task.home is not None:
+            shutil.rmtree(task.home, ignore_errors=True)
+
+
+class DockerRuntime:
+    """Docker runtime over the unix-socket HTTP API (no docker SDK in
+    the image; aiohttp speaks to /var/run/docker.sock directly).
+
+    Parity: reference shim docker.go:690-1065 — image pull with registry
+    auth, container create with devices/mounts/shm/network, entrypoint
+    script starting sshd + runner, state restore from live containers.
+    """
+
+    def __init__(self, base_dir: Path, socket_path: str = "/var/run/docker.sock"):
+        self.base_dir = base_dir
+        self.socket_path = socket_path
+
+    @staticmethod
+    def available(socket_path: str = "/var/run/docker.sock") -> bool:
+        return Path(socket_path).exists()
+
+    async def _request(self, method: str, path: str, json_body=None, params=None):
+        import aiohttp
+
+        conn = aiohttp.UnixConnector(path=self.socket_path)
+        async with aiohttp.ClientSession(connector=conn) as session:
+            async with session.request(
+                method, f"http://docker{path}", json=json_body, params=params
+            ) as resp:
+                if resp.status >= 400:
+                    text = await resp.text()
+                    raise RuntimeError(f"docker API {path}: {resp.status} {text[:300]}")
+                if resp.content_type == "application/json":
+                    return await resp.json()
+                return await resp.read()
+
+    async def start(self, task: Task) -> None:
+        req = task.req
+        task.transition(TaskStatus.PREPARING)
+        task.transition(TaskStatus.PULLING)
+        await self._request(
+            "POST", "/images/create", params={"fromImage": req.image_name}
+        )
+        task.transition(TaskStatus.CREATING)
+        devices = []
+        tpu = detect_tpu()
+        if tpu is not None and not req.privileged:
+            devices = [
+                {"PathOnHost": p, "PathInContainer": p, "CgroupPermissions": "rwm"}
+                for p in tpu.device_paths
+            ]
+        env = [f"{k}={v}" for k, v in {**req.env, **req.tpu_env}.items()]
+        if req.pjrt_device:
+            env.append(f"PJRT_DEVICE={req.pjrt_device}")
+        runner_cmd = (
+            "python -m dstack_tpu.agent.python.runner_main "
+            f"--port {req.runner_port} --home /root/.dtpu"
+        )
+        config = {
+            "Image": req.image_name,
+            "Env": env,
+            "Cmd": ["/bin/sh", "-c", runner_cmd],
+            "HostConfig": {
+                "Privileged": req.privileged,
+                "NetworkMode": req.network_mode,
+                "Devices": devices,
+                "Binds": [f"{m['source']}:{m['target']}" for m in req.mounts],
+                "ShmSize": req.shm_size_bytes or 0,
+            },
+        }
+        name = f"dtpu-{req.id[:13]}"
+        await self._request("POST", "/containers/create", json_body=config, params={"name": name})
+        await self._request("POST", f"/containers/{name}/start")
+        task.container_name = name
+        task.transition(TaskStatus.RUNNING)
+
+    async def terminate(self, task: Task, timeout: int) -> None:
+        if task.container_name:
+            try:
+                await self._request(
+                    "POST",
+                    f"/containers/{task.container_name}/stop",
+                    params={"t": str(timeout)},
+                )
+            except RuntimeError:
+                pass
+
+    async def remove(self, task: Task) -> None:
+        if task.container_name:
+            try:
+                await self._request(
+                    "DELETE",
+                    f"/containers/{task.container_name}",
+                    params={"force": "true"},
+                )
+            except RuntimeError:
+                pass
+
+
+class Shim:
+    def __init__(self, base_dir: Path, runtime: Optional[str] = None):
+        self.base_dir = base_dir
+        self.tasks: dict[str, Task] = {}
+        if runtime == "docker" or (
+            runtime is None and DockerRuntime.available()
+        ):
+            self.runtime = DockerRuntime(base_dir)
+        else:
+            self.runtime = ProcessRuntime(base_dir)
+        self._next_runner_port = 11000
+
+    def _alloc_port(self) -> int:
+        # find a free localhost port for a process-mode runner
+        while True:
+            port = self._next_runner_port
+            self._next_runner_port += 1
+            with socket.socket() as s:
+                try:
+                    s.bind(("127.0.0.1", port))
+                    return port
+                except OSError:
+                    continue
+
+    async def submit(self, req: schemas.TaskSubmitRequest) -> Task:
+        if req.id in self.tasks:
+            raise ValueError(f"task {req.id} exists")
+        if isinstance(self.runtime, ProcessRuntime):
+            req.runner_port = self._alloc_port()
+        task = Task(req)
+        self.tasks[req.id] = task
+        asyncio.create_task(self._start(task))
+        return task
+
+    async def _start(self, task: Task) -> None:
+        try:
+            await self.runtime.start(task)
+        except Exception as e:
+            logger.exception("task %s failed to start", task.req.id)
+            task.termination_reason = "creating_container_error"
+            task.termination_message = str(e)
+            try:
+                task.transition(TaskStatus.TERMINATED)
+            except ValueError:
+                task.status = TaskStatus.TERMINATED
+
+    async def terminate(self, task_id: str, timeout: int, reason=None, message=None) -> None:
+        task = self.tasks[task_id]
+        if task.status == TaskStatus.TERMINATED:
+            return
+        await self.runtime.terminate(task, timeout)
+        task.termination_reason = reason or task.termination_reason
+        task.termination_message = message or task.termination_message
+        task.status = TaskStatus.TERMINATED
+
+    async def remove(self, task_id: str) -> None:
+        task = self.tasks[task_id]
+        if task.status != TaskStatus.TERMINATED:
+            raise ValueError("task must be terminated before removal")
+        await self.runtime.remove(task)
+        del self.tasks[task_id]
+
+
+def build_app(shim: Shim) -> web.Application:
+    app = web.Application()
+    app["shim"] = shim
+
+    async def healthcheck(request):
+        return web.json_response(
+            schemas.HealthcheckResponse(
+                service="tpu-shim", version=__version__
+            ).model_dump()
+        )
+
+    async def list_tasks(request):
+        return web.json_response(
+            schemas.TaskListResponse(ids=list(shim.tasks)).model_dump()
+        )
+
+    async def submit(request):
+        req = schemas.TaskSubmitRequest.model_validate(await request.json())
+        try:
+            task = await shim.submit(req)
+        except ValueError as e:
+            return web.json_response({"detail": str(e)}, status=409)
+        return web.Response(
+            text=task.info().model_dump_json(), content_type="application/json"
+        )
+
+    async def get_task(request):
+        task = shim.tasks.get(request.match_info["id"])
+        if task is None:
+            return web.json_response({"detail": "not found"}, status=404)
+        return web.Response(
+            text=task.info().model_dump_json(), content_type="application/json"
+        )
+
+    async def terminate(request):
+        tid = request.match_info["id"]
+        if tid not in shim.tasks:
+            return web.json_response({"detail": "not found"}, status=404)
+        body = schemas.TerminateRequest.model_validate(
+            await request.json() if request.can_read_body else {}
+        )
+        await shim.terminate(tid, body.timeout_seconds, body.reason, body.message)
+        return web.Response(
+            text=shim.tasks[tid].info().model_dump_json(),
+            content_type="application/json",
+        )
+
+    async def remove(request):
+        tid = request.match_info["id"]
+        if tid not in shim.tasks:
+            return web.json_response({"detail": "not found"}, status=404)
+        try:
+            await shim.remove(tid)
+        except ValueError as e:
+            return web.json_response({"detail": str(e)}, status=409)
+        return web.json_response({})
+
+    async def get_host_info(request):
+        return web.Response(
+            text=host_info().model_dump_json(), content_type="application/json"
+        )
+
+    app.router.add_get("/api/healthcheck", healthcheck)
+    app.router.add_get("/api/tasks", list_tasks)
+    app.router.add_post("/api/tasks", submit)
+    app.router.add_get("/api/tasks/{id}", get_task)
+    app.router.add_post("/api/tasks/{id}/terminate", terminate)
+    app.router.add_post("/api/tasks/{id}/remove", remove)
+    app.router.add_get("/api/host_info", get_host_info)
+    return app
+
+
+async def serve(port: int, base_dir: Path, runtime: Optional[str] = None) -> web.AppRunner:
+    shim = Shim(base_dir, runtime=runtime)
+    app = build_app(shim)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "0.0.0.0", port)
+    await site.start()
+    logger.info(
+        "tpu-shim listening on :%d (runtime=%s)",
+        port,
+        type(shim.runtime).__name__,
+    )
+    return runner

@@ -1,0 +1,46 @@
+"""``tpu-shim-py`` entrypoint.
+
+Service mode (``--service``) additionally writes the host-info JSON used
+by the SSH-fleet adoption handshake (reference host_info.go:75,
+remote/provisioning.py:99-140).
+"""
+
+import argparse
+import asyncio
+import json
+from pathlib import Path
+
+
+def main() -> None:
+    from dstack_tpu.agent.python.shim import host_info, serve
+    from dstack_tpu.utils.logging import configure_logging
+
+    configure_logging()
+    parser = argparse.ArgumentParser("tpu-shim-py")
+    parser.add_argument("--port", type=int, default=10998)
+    parser.add_argument("--base-dir", type=str, default="~/.dtpu/shim")
+    parser.add_argument("--runtime", choices=["docker", "process"], default=None)
+    parser.add_argument(
+        "--service", action="store_true", help="write host info file on start"
+    )
+    parser.add_argument(
+        "--host-info-path", type=str, default="~/.dtpu/host_info.json"
+    )
+    args = parser.parse_args()
+
+    base_dir = Path(args.base_dir).expanduser()
+    base_dir.mkdir(parents=True, exist_ok=True)
+    if args.service:
+        p = Path(args.host_info_path).expanduser()
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(host_info().model_dump()))
+
+    async def run():
+        await serve(args.port, base_dir, runtime=args.runtime)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Agent (shim/runner) wire schemas.
+
+Parity: reference runner/internal/schemas (Go structs mirroring server
+pydantic, schemas.go:21-143) + shim v2 task API (shim/api/server.go:53-58).
+One schema module shared by: the server's agent client, the Python
+reference agent, tests' fake agents, and (as the contract) the C++
+agents in dstack_tpu/agent/cpp.
+
+TPU-first: the task/job carries ``cluster_info`` with the JAX/libtpu
+rendezvous environment instead of MASTER_ADDR wiring, and ``pjrt_device``
+/ ``tpu_env`` instead of GPU device requests.
+"""
+
+from enum import Enum
+from typing import Optional
+
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.logs import LogEvent
+from dstack_tpu.core.models.runs import ClusterInfo
+
+
+class TaskStatus(str, Enum):
+    """Shim task FSM (reference shim/task.go:65 ``IsTransitionAllowed``)."""
+
+    PENDING = "pending"
+    PREPARING = "preparing"
+    PULLING = "pulling"
+    CREATING = "creating"
+    RUNNING = "running"
+    TERMINATED = "terminated"
+
+
+ALLOWED_TRANSITIONS: dict[TaskStatus, list[TaskStatus]] = {
+    TaskStatus.PENDING: [TaskStatus.PREPARING, TaskStatus.TERMINATED],
+    TaskStatus.PREPARING: [TaskStatus.PULLING, TaskStatus.TERMINATED],
+    TaskStatus.PULLING: [TaskStatus.CREATING, TaskStatus.TERMINATED],
+    TaskStatus.CREATING: [TaskStatus.RUNNING, TaskStatus.TERMINATED],
+    TaskStatus.RUNNING: [TaskStatus.TERMINATED],
+    TaskStatus.TERMINATED: [],
+}
+
+
+class PortMapping(CoreModel):
+    container_port: int
+    host_port: int = 0  # 0 = same / auto
+
+
+class TaskSubmitRequest(CoreModel):
+    """POST /api/tasks on the shim."""
+
+    id: str
+    name: str
+    image_name: str = ""  # empty = process mode (no container)
+    registry_username: Optional[str] = None
+    registry_password: Optional[str] = None
+    container_user: str = "root"
+    privileged: bool = False
+    pjrt_device: Optional[str] = "TPU"
+    tpu_env: dict[str, str] = {}  # TPU_WORKER_ID etc., set by the server
+    env: dict[str, str] = {}
+    mounts: list[dict] = []  # {source, target} host bind mounts
+    volumes: list[dict] = []  # attached network volume devices
+    port_mappings: list[PortMapping] = []
+    network_mode: str = "host"  # host|bridge
+    shm_size_bytes: int = 0
+    cpus: float = 0
+    memory_bytes: int = 0
+    ssh_authorized_keys: list[str] = []
+    ssh_port: int = 10022
+    runner_port: int = 10999
+
+
+class TaskInfo(CoreModel):
+    id: str
+    status: TaskStatus
+    termination_reason: Optional[str] = None
+    termination_message: Optional[str] = None
+    container_name: Optional[str] = None
+    ports: list[PortMapping] = []
+
+
+class TaskListResponse(CoreModel):
+    ids: list[str] = []
+
+
+class TerminateRequest(CoreModel):
+    timeout_seconds: int = 10
+    reason: Optional[str] = None
+    message: Optional[str] = None
+
+
+class HealthcheckResponse(CoreModel):
+    service: str  # "tpu-shim" | "tpu-runner"
+    version: str
+
+
+class TPUDeviceInfo(CoreModel):
+    chip_count: int = 0
+    device_paths: list[str] = []  # /dev/accel* or /dev/vfio/*
+    generation: Optional[str] = None
+    hbm_gib_per_chip: float = 0.0
+    libtpu_version: Optional[str] = None
+
+
+class HostInfo(CoreModel):
+    """SSH-fleet adoption handshake (reference host_info.go:75)."""
+
+    cpus: int
+    memory_bytes: int
+    disk_bytes: int = 0
+    tpu: Optional[TPUDeviceInfo] = None
+    hostname: str = ""
+    addresses: list[str] = []
+
+
+# ---- runner API (in-container / per-job) ----
+
+
+class RunnerJobStateEvent(CoreModel):
+    state: str  # JobStatus value
+    timestamp: float
+    termination_reason: Optional[str] = None
+    termination_message: Optional[str] = None
+    exit_status: Optional[int] = None
+
+
+class SubmitBody(CoreModel):
+    """POST /api/submit on the runner."""
+
+    run_name: str
+    job_name: str
+    job_spec: dict  # JobSpec dump
+    cluster_info: ClusterInfo = ClusterInfo()
+    secrets: dict[str, str] = {}
+    repo_data: dict = {}  # {repo_type, ...}
+    state: str = "submitted"
+
+
+class PullResponse(CoreModel):
+    job_states: list[RunnerJobStateEvent] = []
+    job_logs: list[LogEvent] = []
+    runner_logs: list[LogEvent] = []
+    last_updated: float = 0
+    no_connections_secs: int = 0
+    has_more: bool = True
+
+
+class MetricsSample(CoreModel):
+    timestamp: float
+    cpu_usage_micro: int = 0
+    memory_usage_bytes: int = 0
+    memory_working_set_bytes: int = 0
+    tpu_duty_cycle_percent: list[float] = []  # per chip
+    tpu_hbm_usage_bytes: list[int] = []
+    tpu_hbm_total_bytes: list[int] = []

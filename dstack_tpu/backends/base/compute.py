@@ -1,0 +1,122 @@
+"""Backend ``Compute`` interface.
+
+Parity: reference core/backends/base/compute.py:49-133 (``Compute`` ABC)
+and :136-335 (capability mixins). TPU-first: ``create_instance`` may
+provision a whole multi-host pod slice; provisioning data then carries
+per-worker host metadata (``JobProvisioningData.hosts``).
+"""
+
+import abc
+from typing import Optional
+
+from dstack_tpu.core.models.instances import (
+    InstanceConfiguration,
+    InstanceOfferWithAvailability,
+)
+from dstack_tpu.core.models.runs import JobProvisioningData, Requirements
+from dstack_tpu.core.models.volumes import (
+    Volume,
+    VolumeAttachmentData,
+    VolumeProvisioningData,
+)
+
+
+class Compute(abc.ABC):
+    """The per-backend provisioning driver."""
+
+    @abc.abstractmethod
+    async def get_offers(
+        self, requirements: Requirements
+    ) -> list[InstanceOfferWithAvailability]:
+        ...
+
+    @abc.abstractmethod
+    async def terminate_instance(
+        self, instance_id: str, region: str, backend_data: Optional[str] = None
+    ) -> None:
+        ...
+
+    async def update_provisioning_data(
+        self, provisioning_data: JobProvisioningData
+    ) -> JobProvisioningData:
+        """Poll the cloud for IPs/hostnames of a provisioning instance;
+        returns updated data (reference compute.py:update_provisioning_data)."""
+        return provisioning_data
+
+
+class ComputeWithCreateInstanceSupport(abc.ABC):
+    """Backends that can provision instances independent of a job
+    (fleets `nodes: N`, pool reuse)."""
+
+    @abc.abstractmethod
+    async def create_instance(
+        self,
+        instance_offer: InstanceOfferWithAvailability,
+        instance_config: InstanceConfiguration,
+    ) -> JobProvisioningData:
+        ...
+
+
+class ComputeWithMultinodeSupport:
+    """Marker: offers may be multi-host TPU slices / cluster placement.
+
+    The reference explicitly excludes multi-host TPUs
+    (reference gcp/compute.py:699-726); here they are the headline
+    feature — a slice provisions atomically, all workers or nothing.
+    """
+
+
+class ComputeWithReservationSupport:
+    """Marker: supports capacity reservations (GCP future reservations)."""
+
+
+class ComputeWithPlacementGroupSupport(abc.ABC):
+    @abc.abstractmethod
+    async def create_placement_group(self, name: str, region: str) -> str:
+        """Returns backend_data."""
+
+    @abc.abstractmethod
+    async def delete_placement_group(self, name: str, region: str, backend_data: str) -> None:
+        ...
+
+
+class ComputeWithGatewaySupport(abc.ABC):
+    @abc.abstractmethod
+    async def create_gateway(self, name: str, region: str) -> dict:
+        ...
+
+    @abc.abstractmethod
+    async def terminate_gateway(self, instance_id: str, region: str) -> None:
+        ...
+
+
+class ComputeWithVolumeSupport(abc.ABC):
+    @abc.abstractmethod
+    async def create_volume(self, volume: Volume) -> VolumeProvisioningData:
+        ...
+
+    @abc.abstractmethod
+    async def delete_volume(self, volume: Volume) -> None:
+        ...
+
+    async def attach_volume(self, volume: Volume, instance_id: str) -> VolumeAttachmentData:
+        raise NotImplementedError
+
+    async def detach_volume(self, volume: Volume, instance_id: str) -> None:
+        raise NotImplementedError
+
+    async def register_volume(self, volume: Volume) -> VolumeProvisioningData:
+        raise NotImplementedError
+
+
+def get_backend_capabilities(compute_cls: type) -> dict[str, bool]:
+    """Capability matrix from mixin subclassing
+    (reference core/backends/__init__.py:31-60)."""
+    return {
+        "create_instance": issubclass(compute_cls, ComputeWithCreateInstanceSupport),
+        "multinode": issubclass(compute_cls, ComputeWithMultinodeSupport),
+        "reservations": issubclass(compute_cls, ComputeWithReservationSupport),
+        "placement_groups": issubclass(compute_cls, ComputeWithPlacementGroupSupport),
+        "gateways": issubclass(compute_cls, ComputeWithGatewaySupport),
+        "volumes": issubclass(compute_cls, ComputeWithVolumeSupport),
+    }

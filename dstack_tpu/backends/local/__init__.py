@@ -1,0 +1,1 @@
+from dstack_tpu.backends.local.compute import LocalCompute  # noqa: F401

@@ -1,0 +1,126 @@
+"""SSH tunnels via the system ``ssh`` binary.
+
+Parity: reference core/services/ssh/tunnel.py (subprocess wrapper with
+socket forwarding and proxy jumps; paramiko is not used for tunnels in
+the reference either). Used to reach shim/runner APIs on cloud TPU
+hosts; worker N of a multi-host slice is reached with a proxy jump
+through worker 0 (only worker 0 may have an external IP).
+"""
+
+import asyncio
+import os
+import socket
+import subprocess
+import tempfile
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from dstack_tpu.core.errors import SSHError
+from dstack_tpu.core.models.instances import SSHConnectionParams, SSHProxyParams
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("ssh.tunnel")
+
+SSH_DEFAULT_OPTS = [
+    "-o", "StrictHostKeyChecking=no",
+    "-o", "UserKnownHostsFile=/dev/null",
+    "-o", "ExitOnForwardFailure=yes",
+    "-o", "ConnectTimeout=10",
+    "-o", "ServerAliveInterval=10",
+]
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class SSHTunnel:
+    host: str
+    username: str
+    port: int = 22
+    identity_file: Optional[str] = None
+    proxy: Optional[SSHProxyParams] = None
+    forwards: dict[int, int] = field(default_factory=dict)  # local -> remote
+    _proc: Optional[subprocess.Popen] = None
+    _proxy_key_file: Optional[str] = None
+
+    async def open(self, timeout: float = 30.0) -> None:
+        cmd = ["ssh", "-N", *SSH_DEFAULT_OPTS, "-p", str(self.port)]
+        if self.identity_file:
+            cmd += ["-i", self.identity_file]
+        if self.proxy is not None:
+            if self.proxy.private_key:
+                fd, path = tempfile.mkstemp(prefix="dtpu-proxykey-")
+                os.write(fd, self.proxy.private_key.encode())
+                os.close(fd)
+                os.chmod(path, 0o600)
+                self._proxy_key_file = path
+            jump = f"{self.proxy.username}@{self.proxy.hostname}:{self.proxy.port}"
+            cmd += ["-J", jump]
+        for local, remote in self.forwards.items():
+            cmd += ["-L", f"127.0.0.1:{local}:127.0.0.1:{remote}"]
+        cmd.append(f"{self.username}@{self.host}")
+        logger.debug("opening tunnel: %s", " ".join(cmd))
+        self._proc = subprocess.Popen(
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
+        )
+        # wait until the first forwarded port accepts
+        local_ports = list(self.forwards)
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if self._proc.poll() is not None:
+                err = (self._proc.stderr.read() or b"").decode()[-500:]
+                raise SSHError(f"ssh tunnel exited: {err}")
+            if not local_ports:
+                return
+            try:
+                with socket.create_connection(("127.0.0.1", local_ports[0]), 0.5):
+                    return
+            except OSError:
+                await asyncio.sleep(0.2)
+        self.close()
+        raise SSHError(f"ssh tunnel to {self.host} timed out")
+
+    def close(self) -> None:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        if self._proxy_key_file:
+            try:
+                os.unlink(self._proxy_key_file)
+            except OSError:
+                pass
+
+    async def __aenter__(self) -> "SSHTunnel":
+        await self.open()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        self.close()
+
+
+async def open_tunnel_to_params(
+    params: SSHConnectionParams,
+    remote_ports: list[int],
+    identity_file: Optional[str] = None,
+    proxy: Optional[SSHProxyParams] = None,
+) -> tuple[SSHTunnel, dict[int, int]]:
+    """Returns (tunnel, {remote_port: local_port})."""
+    mapping = {find_free_port(): rp for rp in remote_ports}
+    tunnel = SSHTunnel(
+        host=params.hostname,
+        username=params.username,
+        port=params.port,
+        identity_file=identity_file,
+        proxy=proxy,
+        forwards=mapping,
+    )
+    await tunnel.open()
+    return tunnel, {rp: lp for lp, rp in mapping.items()}

@@ -1,0 +1,106 @@
+"""Server assembly: DB migrate, bootstrap admin+project, routes, loops.
+
+Parity: reference server/app.py:67-186 (``create_app`` lifespan: migrate
+DB, load server config, create admin + default project, start scheduler;
+``register_routes``).
+"""
+
+from typing import Optional
+
+from aiohttp import web
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.server import settings
+from dstack_tpu.server.background import create_scheduler
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.http.kit import build_app
+from dstack_tpu.server.routers.core import ALL_ROUTERS, auth_dependency
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import projects as projects_service
+from dstack_tpu.server.services import users as users_service
+from dstack_tpu.utils.logging import configure_logging, get_logger
+
+logger = get_logger("server.app")
+
+
+async def create_app(
+    database_url: str = "",
+    admin_token: Optional[str] = None,
+    default_project: Optional[str] = None,
+    with_background: bool = True,
+    local_backend: bool = True,
+) -> web.Application:
+    db = Database(database_url or settings.DATABASE_URL)
+    await db.connect()
+    await db.migrate()
+
+    admin = await users_service.get_or_create_admin(
+        db, admin_token or settings.SERVER_ADMIN_TOKEN
+    )
+    project_name = default_project or settings.DEFAULT_PROJECT_NAME
+    admin_row = await users_service.get_user_by_name(db, "admin")
+    project_row = await projects_service.get_project_row(db, project_name)
+    if project_row is None:
+        await projects_service.create_project(db, admin_row, project_name)
+        project_row = await projects_service.get_project_row(db, project_name)
+        logger.info("created default project %s", project_name)
+    if local_backend:
+        existing = await backends_service.list_backend_rows(db, project_row)
+        if not any(r["type"] == BackendType.LOCAL.value for r in existing):
+            await backends_service.create_backend(
+                db, project_row, BackendType.LOCAL, {}
+            )
+
+    state = {"db": db, "admin_token": admin.creds["token"] if admin.creds else None}
+    app = build_app(ALL_ROUTERS, state, auth_dependency=auth_dependency)
+    register_proxy_routes(app)
+
+    scheduler = create_scheduler(db)
+    state["scheduler"] = scheduler
+
+    async def on_startup(app: web.Application) -> None:
+        if with_background:
+            scheduler.start()
+
+    async def on_cleanup(app: web.Application) -> None:
+        await scheduler.stop()
+        await db.close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def register_proxy_routes(app: web.Application) -> None:
+    try:
+        from dstack_tpu.proxy.service_proxy import register_routes
+
+        register_routes(app)
+    except ImportError:
+        pass
+
+
+async def run_server(
+    host: str = "",
+    port: int = 0,
+    database_url: str = "",
+    admin_token: Optional[str] = None,
+) -> None:
+    import asyncio
+
+    configure_logging()
+    app = await create_app(database_url=database_url, admin_token=admin_token)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    host = host or settings.SERVER_HOST
+    port = port or settings.SERVER_PORT
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    token = app["state"]["admin_token"]
+    logger.info("dstack-tpu server is running at http://%s:%d", host, port)
+    print(f"The admin token is {token}", flush=True)
+    print(f"The server is running at http://{host}:{port}/", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await runner.cleanup()

@@ -1,0 +1,36 @@
+"""Background reconciler registration.
+
+Parity: reference server/background/__init__.py:39-97 (intervals tuned
+for ~150 active jobs/runs/instances per replica).
+"""
+
+from dstack_tpu.server.background.scheduler import BackgroundScheduler
+from dstack_tpu.server.db import Database
+
+
+def create_scheduler(db: Database) -> BackgroundScheduler:
+    from dstack_tpu.server.background.tasks.process_fleets import process_fleets
+    from dstack_tpu.server.background.tasks.process_instances import process_instances
+    from dstack_tpu.server.background.tasks.process_metrics import collect_metrics
+    from dstack_tpu.server.background.tasks.process_running_jobs import (
+        process_running_jobs,
+    )
+    from dstack_tpu.server.background.tasks.process_runs import process_runs
+    from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+        process_submitted_jobs,
+    )
+    from dstack_tpu.server.background.tasks.process_terminating_jobs import (
+        process_terminating_jobs,
+    )
+    from dstack_tpu.server.background.tasks.process_volumes import process_volumes
+
+    sched = BackgroundScheduler()
+    sched.add(lambda: process_runs(db), 2.0, "process_runs")
+    sched.add(lambda: process_submitted_jobs(db), 1.0, "process_submitted_jobs")
+    sched.add(lambda: process_running_jobs(db), 1.0, "process_running_jobs")
+    sched.add(lambda: process_terminating_jobs(db), 2.0, "process_terminating_jobs")
+    sched.add(lambda: process_instances(db), 2.0, "process_instances")
+    sched.add(lambda: process_fleets(db), 10.0, "process_fleets")
+    sched.add(lambda: process_volumes(db), 10.0, "process_volumes")
+    sched.add(lambda: collect_metrics(db), 10.0, "collect_metrics")
+    return sched

@@ -1,0 +1,62 @@
+"""Interval scheduler for reconciliation loops.
+
+Parity: reference uses APScheduler (server/background/__init__.py:39-97);
+not bundled here, so the framework ships its own: each loop is an
+asyncio task firing every ``interval`` seconds with jitter, errors
+logged and swallowed (a failing tick must not kill the loop).
+"""
+
+import asyncio
+import random
+from typing import Awaitable, Callable, Optional
+
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.background")
+
+
+class BackgroundScheduler:
+    def __init__(self) -> None:
+        self._jobs: list[tuple[str, Callable[[], Awaitable], float, float]] = []
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = asyncio.Event()
+
+    def add(
+        self,
+        fn: Callable[[], Awaitable],
+        interval: float,
+        name: Optional[str] = None,
+        jitter: float = 0.2,
+    ) -> None:
+        self._jobs.append((name or fn.__name__, fn, interval, jitter))
+
+    async def _loop(self, name: str, fn, interval: float, jitter: float) -> None:
+        # initial stagger so loops don't fire in lockstep
+        await asyncio.sleep(random.uniform(0, min(interval, 1.0)))
+        while not self._stopped.is_set():
+            try:
+                await fn()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("background task %s failed", name)
+            delay = interval + random.uniform(-jitter, jitter) * interval
+            try:
+                await asyncio.wait_for(self._stopped.wait(), timeout=max(delay, 0.05))
+            except asyncio.TimeoutError:
+                pass
+
+    def start(self) -> None:
+        self._stopped.clear()
+        for name, fn, interval, jitter in self._jobs:
+            self._tasks.append(
+                asyncio.create_task(self._loop(name, fn, interval, jitter), name=name)
+            )
+        logger.info("started %d background loops", len(self._tasks))
+
+    async def stop(self) -> None:
+        self._stopped.set()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()

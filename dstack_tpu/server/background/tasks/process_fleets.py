@@ -1,0 +1,26 @@
+"""Fleet reconciler: auto-delete empty autocreated fleets.
+
+Parity: reference background/tasks/process_fleets.py:83.
+"""
+
+from dstack_tpu.core.models.runs import now_utc
+from dstack_tpu.server.db import Database
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_fleets")
+
+
+async def process_fleets(db: Database) -> None:
+    rows = await db.fetchall(
+        "SELECT f.id, f.name FROM fleets f WHERE f.autocreated = 1 AND f.deleted = 0 "
+        "AND NOT EXISTS (SELECT 1 FROM instances i WHERE i.fleet_id = f.id AND i.deleted = 0) "
+        "AND NOT EXISTS (SELECT 1 FROM runs r WHERE r.fleet_id = f.id AND r.deleted = 0 "
+        "  AND r.status NOT IN ('terminated','failed','done'))"
+    )
+    for row in rows:
+        await db.update_by_id(
+            "fleets",
+            row["id"],
+            {"deleted": 1, "last_processed_at": now_utc().isoformat()},
+        )
+        logger.info("deleted empty autocreated fleet %s", row["name"])

@@ -1,0 +1,250 @@
+"""Instance lifecycle reconciler.
+
+Parity: reference background/tasks/process_instances.py (provision fleet
+instances, poll provisioning data :630-744, idle termination :196,
+termination retries with deadlines :817-899).
+"""
+
+from datetime import datetime, timedelta
+
+from dstack_tpu.backends.base.compute import ComputeWithCreateInstanceSupport
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.instances import InstanceConfiguration, InstanceStatus
+from dstack_tpu.core.models.runs import JobProvisioningData, now_utc
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services.locking import claim_one
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_instances")
+
+ACTIVE = (
+    InstanceStatus.PENDING.value,
+    InstanceStatus.PROVISIONING.value,
+    InstanceStatus.IDLE.value,
+    InstanceStatus.TERMINATING.value,
+)
+
+
+async def process_instances(db: Database) -> None:
+    rows = await db.fetchall(
+        f"SELECT id FROM instances WHERE status IN ({','.join('?' for _ in ACTIVE)}) "
+        "AND deleted = 0 ORDER BY last_processed_at ASC LIMIT ?",
+        (*ACTIVE, settings.MAX_PROCESSING_INSTANCES),
+    )
+    async with claim_one("instances", [r["id"] for r in rows]) as iid:
+        if iid is None:
+            return
+        await _process(db, iid)
+
+
+async def _process(db: Database, instance_id: str) -> None:
+    row = await db.get_by_id("instances", instance_id)
+    if row is None:
+        return
+    status = InstanceStatus(row["status"])
+    if status == InstanceStatus.PENDING:
+        await _provision(db, row)
+    elif status == InstanceStatus.PROVISIONING:
+        await _poll_provisioning(db, row)
+    elif status == InstanceStatus.IDLE:
+        await _maybe_terminate_idle(db, row)
+    elif status == InstanceStatus.TERMINATING:
+        await _terminate(db, row)
+
+
+async def _provision(db: Database, row: dict) -> None:
+    """Fleet-created instances start at PENDING and are provisioned here
+    (job-driven instances are provisioned in process_submitted_jobs)."""
+    project_row = await db.get_by_id("projects", row["project_id"])
+    offer_raw = loads(row.get("offer"))
+    if offer_raw is None:
+        await _mark(db, row, InstanceStatus.TERMINATED, termination_reason="no offer")
+        return
+    from dstack_tpu.core.models.instances import InstanceOfferWithAvailability
+
+    offer = InstanceOfferWithAvailability.model_validate(offer_raw)
+    compute = await backends_service.get_project_backend(db, project_row, offer.backend)
+    if not isinstance(compute, ComputeWithCreateInstanceSupport):
+        await _mark(
+            db, row, InstanceStatus.TERMINATED, termination_reason="backend unavailable"
+        )
+        return
+    try:
+        jpd = await compute.create_instance(
+            offer,
+            InstanceConfiguration(
+                project_name=project_row["name"], instance_name=row["name"]
+            ),
+        )
+    except Exception as e:
+        logger.warning("instance %s provisioning failed: %s", row["name"], e)
+        created = datetime.fromisoformat(row["created_at"])
+        if now_utc() - created > timedelta(seconds=settings.PROVISIONING_TIMEOUT):
+            await _mark(
+                db, row, InstanceStatus.TERMINATED, termination_reason=str(e)[:300]
+            )
+        else:
+            await _touch(db, row)
+        return
+    await db.update_by_id(
+        "instances",
+        row["id"],
+        {
+            "status": InstanceStatus.PROVISIONING.value,
+            "job_provisioning_data": dumps(jpd),
+            "started_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+
+
+async def _poll_provisioning(db: Database, row: dict) -> None:
+    """Poll the backend until hostnames/IPs are known, then go IDLE/BUSY."""
+    jpd_raw = loads(row.get("job_provisioning_data"))
+    if jpd_raw is None:
+        await _touch(db, row)
+        return
+    jpd = JobProvisioningData.model_validate(jpd_raw)
+    if not jpd.ready():
+        project_row = await db.get_by_id("projects", row["project_id"])
+        compute = await backends_service.get_project_backend(
+            db, project_row, jpd.backend
+        )
+        if compute is not None:
+            try:
+                jpd = await compute.update_provisioning_data(jpd)
+            except Exception as e:
+                logger.debug("update_provisioning_data %s: %s", row["name"], e)
+        if not jpd.ready():
+            created = datetime.fromisoformat(row["created_at"])
+            if now_utc() - created > timedelta(seconds=settings.PROVISIONING_TIMEOUT):
+                await _mark(
+                    db,
+                    row,
+                    InstanceStatus.TERMINATING,
+                    termination_reason="provisioning timeout",
+                )
+            else:
+                await _touch(db, row)
+            return
+        await db.update_by_id(
+            "instances", row["id"], {"job_provisioning_data": dumps(jpd)}
+        )
+        # propagate fresh host data to jobs assigned to this instance
+        jobs = await db.fetchall(
+            "SELECT id, job_provisioning_data FROM jobs WHERE instance_id = ?",
+            (row["id"],),
+        )
+        for j in jobs:
+            jd = loads(j.get("job_provisioning_data")) or {}
+            wid = jd.get("worker_id", 0)
+            merged = jpd.model_copy()
+            merged.worker_id = wid
+            if len(merged.hosts) > wid:
+                w = merged.hosts[wid]
+                merged.hostname = w.external_ip or w.internal_ip
+                merged.internal_ip = w.internal_ip
+            await db.update_by_id(
+                "jobs", j["id"], {"job_provisioning_data": dumps(merged)}
+            )
+    # instance is reachable; busy if jobs are assigned
+    jobs = await db.fetchall(
+        "SELECT id FROM jobs WHERE instance_id = ? AND status IN (?,?,?,?)",
+        (
+            row["id"],
+            "submitted",
+            "provisioning",
+            "pulling",
+            "running",
+        ),
+    )
+    await _mark(
+        db, row, InstanceStatus.BUSY if jobs else InstanceStatus.IDLE
+    )
+
+
+async def _maybe_terminate_idle(db: Database, row: dict) -> None:
+    idle_time = row.get("termination_idle_time", 300)
+    if idle_time < 0:
+        await _touch(db, row)
+        return
+    last = datetime.fromisoformat(row["last_processed_at"] or row["created_at"])
+    # instances stay idle until the idle budget since last state change
+    busy_jobs = await db.fetchall(
+        "SELECT id FROM jobs WHERE instance_id = ? AND status IN (?,?,?,?)",
+        (row["id"], "submitted", "provisioning", "pulling", "running"),
+    )
+    if busy_jobs:
+        await _mark(db, row, InstanceStatus.BUSY)
+        return
+    if now_utc() - last > timedelta(seconds=idle_time):
+        logger.info("instance %s idle for > %ds; terminating", row["name"], idle_time)
+        await _mark(
+            db, row, InstanceStatus.TERMINATING, termination_reason="idle timeout"
+        )
+
+
+async def _terminate(db: Database, row: dict) -> None:
+    project_row = await db.get_by_id("projects", row["project_id"])
+    backend = row.get("backend")
+    jpd_raw = loads(row.get("job_provisioning_data"))
+    if backend and jpd_raw:
+        compute = await backends_service.get_project_backend(
+            db, project_row, BackendType(backend)
+        )
+        if compute is not None:
+            try:
+                await compute.terminate_instance(
+                    jpd_raw.get("instance_id", row["id"]),
+                    row.get("region") or "",
+                    jpd_raw.get("backend_data"),
+                )
+            except Exception as e:
+                logger.warning("terminate %s failed: %s", row["name"], e)
+                deadline = row.get("termination_deadline")
+                if deadline is None:
+                    await db.update_by_id(
+                        "instances",
+                        row["id"],
+                        {
+                            "termination_deadline": (
+                                now_utc() + timedelta(minutes=15)
+                            ).isoformat(),
+                            "last_processed_at": now_utc().isoformat(),
+                        },
+                    )
+                    return
+                if now_utc() < datetime.fromisoformat(deadline):
+                    await _touch(db, row)
+                    return
+    await db.update_by_id(
+        "instances",
+        row["id"],
+        {
+            "status": InstanceStatus.TERMINATED.value,
+            "deleted": 1,
+            "finished_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    logger.info("instance %s terminated", row["name"])
+
+
+async def _mark(db: Database, row: dict, status: InstanceStatus, **fields) -> None:
+    await db.update_by_id(
+        "instances",
+        row["id"],
+        {
+            "status": status.value,
+            "last_processed_at": now_utc().isoformat(),
+            **fields,
+        },
+    )
+
+
+async def _touch(db: Database, row: dict) -> None:
+    await db.update_by_id(
+        "instances", row["id"], {"last_processed_at": now_utc().isoformat()}
+    )

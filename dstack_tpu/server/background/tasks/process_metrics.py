@@ -1,0 +1,66 @@
+"""Pull per-job hardware metrics from runners into DB points.
+
+Parity: reference background/tasks/process_metrics.py:142 (10s loop,
+cgroup+accelerator sampler → ``JobMetricsPoint`` rows) — TPU metrics
+instead of nvidia-smi.
+"""
+
+from dstack_tpu.core.errors import AgentError, AgentNotReady
+from dstack_tpu.core.models.runs import JobProvisioningData, JobStatus, new_uuid, now_utc
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.server.services.agent_client import runner_client_for
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_metrics")
+
+KEEP_POINTS_PER_JOB = 1000
+
+
+async def collect_metrics(db: Database) -> None:
+    rows = await db.fetchall(
+        "SELECT * FROM jobs WHERE status = ? LIMIT 50", (JobStatus.RUNNING.value,)
+    )
+    for job_row in rows:
+        try:
+            await _collect_job(db, job_row)
+        except (AgentError, AgentNotReady):
+            continue
+        except Exception:
+            logger.exception("metrics collection failed for %s", job_row["job_name"])
+
+
+async def _collect_job(db: Database, job_row: dict) -> None:
+    jpd_raw = loads(job_row.get("job_provisioning_data"))
+    if jpd_raw is None:
+        return
+    jpd = JobProvisioningData.model_validate(jpd_raw)
+    jrd = loads(job_row.get("job_runtime_data")) or {}
+    ports = jrd.get("ports") or {}
+    runner_port = next(iter(ports.values()), 10999)
+    async with runner_client_for(jpd, int(runner_port)) as runner:
+        sample = await runner.metrics()
+    await db.insert(
+        "job_metrics_points",
+        {
+            "id": new_uuid(),
+            "job_id": job_row["id"],
+            "timestamp": now_utc().isoformat(),
+            "cpu_usage_micro": sample.cpu_usage_micro,
+            "memory_usage_bytes": sample.memory_usage_bytes,
+            "memory_working_set_bytes": sample.memory_working_set_bytes,
+            "tpu_metrics": dumps(
+                {
+                    "duty_cycle": sample.tpu_duty_cycle_percent,
+                    "hbm_usage": sample.tpu_hbm_usage_bytes,
+                    "hbm_total": sample.tpu_hbm_total_bytes,
+                }
+            ),
+        },
+    )
+    # bound growth per job
+    await db.execute(
+        "DELETE FROM job_metrics_points WHERE job_id = ? AND id NOT IN ("
+        "SELECT id FROM job_metrics_points WHERE job_id = ? "
+        "ORDER BY timestamp DESC LIMIT ?)",
+        (job_row["id"], job_row["id"], KEEP_POINTS_PER_JOB),
+    )

@@ -1,0 +1,339 @@
+"""Drive provisioning → pulling → running jobs.
+
+Parity: reference background/tasks/process_running_jobs.py
+(PROVISIONING: shim healthcheck + submit task :385-509; PULLING: wait
+container, submit to runner :772-827; RUNNING: incremental pull of
+states/logs :601-649).
+"""
+
+from typing import Optional
+
+from dstack_tpu.agent import schemas as agent_schemas
+from dstack_tpu.core.errors import AgentError, AgentNotReady
+from dstack_tpu.core.models.logs import LogEvent
+from dstack_tpu.core.models.runs import (
+    ClusterInfo,
+    JobProvisioningData,
+    JobSpec,
+    JobStatus,
+    JobTerminationReason,
+    now_utc,
+)
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.server.services import jobs as jobs_service
+from dstack_tpu.server.services.agent_client import (
+    RUNNER_PORT,
+    runner_client_for,
+    shim_client_for,
+)
+from dstack_tpu.server.services.locking import claim_one
+from dstack_tpu.server.services.logs import get_log_storage
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_running_jobs")
+
+ACTIVE = (
+    JobStatus.PROVISIONING.value,
+    JobStatus.PULLING.value,
+    JobStatus.RUNNING.value,
+)
+
+
+async def process_running_jobs(db: Database) -> None:
+    rows = await db.fetchall(
+        f"SELECT id FROM jobs WHERE status IN ({','.join('?' for _ in ACTIVE)}) "
+        "ORDER BY last_processed_at ASC LIMIT ?",
+        (*ACTIVE, settings.MAX_PROCESSING_JOBS),
+    )
+    async with claim_one("jobs", [r["id"] for r in rows]) as job_id:
+        if job_id is None:
+            return
+        await _process(db, job_id)
+
+
+async def _process(db: Database, job_id: str) -> None:
+    job_row = await db.get_by_id("jobs", job_id)
+    if job_row is None or job_row["status"] not in ACTIVE:
+        return
+    jpd_raw = loads(job_row.get("job_provisioning_data"))
+    if jpd_raw is None:
+        return
+    jpd = JobProvisioningData.model_validate(jpd_raw)
+    status = JobStatus(job_row["status"])
+    try:
+        if status == JobStatus.PROVISIONING:
+            await _process_provisioning(db, job_row, jpd)
+        elif status == JobStatus.PULLING:
+            await _process_pulling(db, job_row, jpd)
+        else:
+            await _process_running(db, job_row, jpd)
+    except AgentNotReady as e:
+        await _handle_unreachable(db, job_row, str(e))
+    except AgentError as e:
+        logger.warning("job %s agent error: %s", job_row["job_name"], e)
+        await jobs_service.update_job_status(
+            db,
+            job_row["id"],
+            JobStatus.TERMINATING,
+            termination_reason=JobTerminationReason.EXECUTOR_ERROR,
+            termination_reason_message=str(e)[:500],
+        )
+
+
+async def _handle_unreachable(db: Database, job_row: dict, message: str) -> None:
+    """Agent unreachable: tolerate within the wait budget, then fail."""
+    from datetime import datetime, timezone
+
+    submitted = datetime.fromisoformat(job_row["submitted_at"])
+    age = (now_utc() - submitted).total_seconds()
+    status = JobStatus(job_row["status"])
+    budget = settings.AGENT_WAIT_TIMEOUT if status != JobStatus.RUNNING else 120
+    disconnected = job_row.get("disconnected_at")
+    if status == JobStatus.RUNNING:
+        if disconnected is None:
+            await db.update_by_id(
+                "jobs",
+                job_row["id"],
+                {
+                    "disconnected_at": now_utc().isoformat(),
+                    "last_processed_at": now_utc().isoformat(),
+                },
+            )
+            return
+        age = (now_utc() - datetime.fromisoformat(disconnected)).total_seconds()
+    if age > budget:
+        reason = (
+            JobTerminationReason.WAITING_RUNNER_LIMIT_EXCEEDED
+            if status != JobStatus.RUNNING
+            else JobTerminationReason.INSTANCE_UNREACHABLE
+        )
+        await jobs_service.update_job_status(
+            db,
+            job_row["id"],
+            JobStatus.TERMINATING,
+            termination_reason=reason,
+            termination_reason_message=message[:500],
+        )
+    else:
+        await db.update_by_id(
+            "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+        )
+
+
+async def _build_cluster_info(db: Database, job_row: dict, jpd: JobProvisioningData) -> ClusterInfo:
+    """Rendezvous info across the replica's jobs (slice workers or
+    sibling instances)."""
+    tpu = jpd.instance_type.resources.tpu
+    if jpd.hosts:
+        ips = [h.internal_ip for h in sorted(jpd.hosts, key=lambda h: h.worker_id)]
+    else:
+        rows = await db.fetchall(
+            "SELECT job_num, job_provisioning_data FROM jobs "
+            "WHERE run_id = ? AND replica_num = ? AND submission_num = ? "
+            "ORDER BY job_num",
+            (job_row["run_id"], job_row["replica_num"], job_row["submission_num"]),
+        )
+        ips = []
+        for r in rows:
+            d = loads(r.get("job_provisioning_data"))
+            ips.append((d or {}).get("internal_ip") or (d or {}).get("hostname") or "")
+    return ClusterInfo(
+        master_node_ip=ips[0] if ips else "",
+        nodes_ips=ips,
+        tpu_chips_per_host=tpu.chips_per_host if tpu else 0,
+        tpu_total_chips=tpu.chips if tpu else 0,
+        tpu_topology=tpu.topology if tpu else None,
+    )
+
+
+async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisioningData) -> None:
+    if not jpd.ready():
+        # wait for process_instances to fill in hostnames
+        await db.update_by_id(
+            "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+        )
+        return
+    job_spec = JobSpec.model_validate(loads(job_row["job_spec"]))
+    async with shim_client_for(jpd) as shim:
+        await shim.healthcheck()
+        task_req = agent_schemas.TaskSubmitRequest(
+            id=job_row["id"],
+            name=job_spec.job_name,
+            image_name=job_spec.image_name if jpd.dockerized else "",
+            registry_username=(job_spec.registry_auth.username if job_spec.registry_auth else None),
+            registry_password=(job_spec.registry_auth.password if job_spec.registry_auth else None),
+            privileged=job_spec.privileged,
+            pjrt_device=job_spec.pjrt_device,
+            env={},
+            network_mode="host",
+        )
+        info = await shim.submit_task(task_req)
+    jrd = {
+        "network_mode": "host",
+        "ports": {p.container_port: p.host_port for p in info.ports},
+        "pull_cursor": 0.0,
+    }
+    await db.update_by_id(
+        "jobs",
+        job_row["id"],
+        {
+            "status": JobStatus.PULLING.value,
+            "job_runtime_data": dumps(jrd),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    logger.info("job %s: task submitted to shim", job_spec.job_name)
+
+
+def _runner_port(job_row: dict) -> int:
+    jrd = loads(job_row.get("job_runtime_data")) or {}
+    ports = jrd.get("ports") or {}
+    for _container, host in ports.items():
+        return int(host)
+    return RUNNER_PORT
+
+
+async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData) -> None:
+    job_spec = JobSpec.model_validate(loads(job_row["job_spec"]))
+    async with shim_client_for(jpd) as shim:
+        info = await shim.get_task(job_row["id"])
+    if info.status == agent_schemas.TaskStatus.TERMINATED:
+        await jobs_service.update_job_status(
+            db,
+            job_row["id"],
+            JobStatus.TERMINATING,
+            termination_reason=JobTerminationReason.CREATING_CONTAINER_ERROR,
+            termination_reason_message=info.termination_message,
+        )
+        return
+    if info.status != agent_schemas.TaskStatus.RUNNING:
+        await db.update_by_id(
+            "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+        )
+        return
+    # container/process is up: hand the job to the runner
+    jrd = loads(job_row.get("job_runtime_data")) or {}
+    jrd["ports"] = {p.container_port: p.host_port for p in info.ports}
+    await db.update_by_id("jobs", job_row["id"], {"job_runtime_data": dumps(jrd)})
+    runner_port = _runner_port({**job_row, "job_runtime_data": dumps(jrd)})
+    run_row = await db.get_by_id("runs", job_row["run_id"])
+    cluster_info = await _build_cluster_info(db, job_row, jpd)
+    if "" in cluster_info.nodes_ips and len(cluster_info.nodes_ips) > 1:
+        # sibling nodes not provisioned yet; wait before starting the master
+        await db.update_by_id(
+            "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+        )
+        return
+    async with runner_client_for(jpd, runner_port) as runner:
+        await runner.healthcheck()
+        await runner.submit(
+            agent_schemas.SubmitBody(
+                run_name=run_row["run_name"],
+                job_name=job_spec.job_name,
+                job_spec={
+                    **job_spec.model_dump(),
+                    "job_num": jpd.worker_id if jpd.hosts else job_spec.job_num,
+                },
+                cluster_info=cluster_info,
+            )
+        )
+        code = await _get_code_blob(db, run_row)
+        if code:
+            await runner.upload_code(code)
+        await runner.run()
+    await db.update_by_id(
+        "jobs",
+        job_row["id"],
+        {
+            "status": JobStatus.RUNNING.value,
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    logger.info("job %s: running", job_spec.job_name)
+
+
+async def _get_code_blob(db: Database, run_row: dict) -> Optional[bytes]:
+    from dstack_tpu.core.models.runs import RunSpec
+
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    if run_spec.repo_code_hash is None or run_spec.repo_id is None:
+        return None
+    repo = await db.fetchone(
+        "SELECT id FROM repos WHERE project_id = ? AND name = ?",
+        (run_row["project_id"], run_spec.repo_id),
+    )
+    if repo is None:
+        return None
+    code = await db.fetchone(
+        "SELECT blob FROM codes WHERE repo_id = ? AND blob_hash = ?",
+        (repo["id"], run_spec.repo_code_hash),
+    )
+    return code["blob"] if code else None
+
+
+async def _process_running(db: Database, job_row: dict, jpd: JobProvisioningData) -> None:
+    jrd = loads(job_row.get("job_runtime_data")) or {}
+    cursor = float(jrd.get("pull_cursor", 0.0))
+    runner_port = _runner_port(job_row)
+    async with runner_client_for(jpd, runner_port) as runner:
+        resp = await runner.pull(cursor)
+    run_row = await db.get_by_id("runs", job_row["run_id"])
+    project_row = await db.get_by_id("projects", run_row["project_id"])
+    from dstack_tpu.utils.common import run_async
+    import functools
+
+    storage = get_log_storage()
+    if resp.job_logs:
+        await run_async(
+            functools.partial(
+                storage.write_logs,
+                project_row["name"],
+                run_row["run_name"],
+                job_row["job_name"],
+                resp.job_logs,
+            )
+        )
+    if resp.runner_logs:
+        await run_async(
+            functools.partial(
+                storage.write_logs,
+                project_row["name"],
+                run_row["run_name"],
+                job_row["job_name"],
+                resp.runner_logs,
+                diagnostics=True,
+            )
+        )
+    jrd["pull_cursor"] = max(cursor, resp.last_updated)
+    fields = {
+        "job_runtime_data": dumps(jrd),
+        "last_processed_at": now_utc().isoformat(),
+        "disconnected_at": None,
+    }
+    terminal = None
+    for ev in resp.job_states:
+        if ev.state in ("done", "failed", "terminated", "aborted"):
+            terminal = ev
+    if terminal is not None:
+        reason = (
+            JobTerminationReason(terminal.termination_reason)
+            if terminal.termination_reason
+            else None
+        )
+        status = JobStatus(terminal.state)
+        fields.update(
+            {
+                "status": JobStatus.TERMINATING.value,
+                "termination_reason": reason.value if reason else None,
+                "termination_reason_message": terminal.termination_message,
+                "exit_status": terminal.exit_status,
+            }
+        )
+        logger.info(
+            "job %s finished on runner: %s (%s)",
+            job_row["job_name"],
+            terminal.state,
+            terminal.termination_reason,
+        )
+    await db.update_by_id("jobs", job_row["id"], fields)

@@ -1,0 +1,177 @@
+"""Run-status aggregation FSM + retry/replica logic.
+
+Parity: reference background/tasks/process_runs.py:186-343 (aggregate
+job statuses → run status), :130-183 (PENDING resubmission loop),
+``_should_retry_job:346-399``.
+"""
+
+from datetime import datetime, timedelta
+
+from dstack_tpu.core.models.profiles import RetryEvent
+from dstack_tpu.core.models.runs import (
+    JobSpec,
+    JobStatus,
+    JobTerminationReason,
+    RunStatus,
+    RunTerminationReason,
+    now_utc,
+)
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.server.services import jobs as jobs_service
+from dstack_tpu.server.services.locking import claim_one
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_runs")
+
+ACTIVE = (
+    RunStatus.PENDING.value,
+    RunStatus.SUBMITTED.value,
+    RunStatus.PROVISIONING.value,
+    RunStatus.RUNNING.value,
+    RunStatus.TERMINATING.value,
+)
+
+
+async def process_runs(db: Database) -> None:
+    rows = await db.fetchall(
+        f"SELECT id FROM runs WHERE status IN ({','.join('?' for _ in ACTIVE)}) "
+        "AND deleted = 0 ORDER BY last_processed_at ASC LIMIT ?",
+        (*ACTIVE, settings.MAX_PROCESSING_RUNS),
+    )
+    async with claim_one("runs", [r["id"] for r in rows]) as run_id:
+        if run_id is None:
+            return
+        await _process(db, run_id)
+
+
+async def _process(db: Database, run_id: str) -> None:
+    run_row = await db.get_by_id("runs", run_id)
+    if run_row is None:
+        return
+    status = RunStatus(run_row["status"])
+    job_rows = await jobs_service.latest_job_rows_for_run(db, run_id)
+    if status == RunStatus.TERMINATING.value or status == RunStatus.TERMINATING:
+        await _finish_if_jobs_done(db, run_row, job_rows)
+        return
+    if not job_rows:
+        await _touch(db, run_id)
+        return
+
+    statuses = {JobStatus(r["status"]) for r in job_rows}
+
+    # retry failed jobs before aggregating
+    retried = False
+    for r in job_rows:
+        if JobStatus(r["status"]) in (JobStatus.FAILED, JobStatus.TERMINATED):
+            if await _maybe_retry(db, run_row, r):
+                retried = True
+    if retried:
+        await _touch(db, run_id)
+        return
+
+    new_status = None
+    reason = None
+    if statuses <= {JobStatus.DONE}:
+        new_status = RunStatus.TERMINATING
+        reason = RunTerminationReason.ALL_JOBS_DONE
+    elif JobStatus.FAILED in statuses or JobStatus.ABORTED in statuses:
+        new_status = RunStatus.TERMINATING
+        reason = RunTerminationReason.JOB_FAILED
+    elif JobStatus.TERMINATED in statuses and statuses <= set(
+        JobStatus.finished_statuses()
+    ):
+        new_status = RunStatus.TERMINATING
+        reason = RunTerminationReason.JOB_FAILED
+    elif JobStatus.RUNNING in statuses:
+        new_status = RunStatus.RUNNING
+    elif statuses & {JobStatus.PROVISIONING, JobStatus.PULLING}:
+        new_status = RunStatus.PROVISIONING
+    if new_status is not None and new_status != status:
+        fields = {
+            "status": new_status.value,
+            "last_processed_at": now_utc().isoformat(),
+        }
+        if reason is not None:
+            fields["termination_reason"] = reason.value
+        await db.update_by_id("runs", run_id, fields)
+        logger.info(
+            "run %s: %s -> %s", run_row["run_name"], status.value, new_status.value
+        )
+        if new_status == RunStatus.TERMINATING:
+            # stop any jobs still active (failed sibling semantics)
+            for r in job_rows:
+                if not JobStatus(r["status"]).is_finished() and r[
+                    "status"
+                ] != JobStatus.TERMINATING.value:
+                    await jobs_service.update_job_status(
+                        db,
+                        r["id"],
+                        JobStatus.TERMINATING,
+                        termination_reason=JobTerminationReason.TERMINATED_BY_SERVER,
+                    )
+    else:
+        await _touch(db, run_id)
+
+
+async def _maybe_retry(db: Database, run_row: dict, job_row: dict) -> bool:
+    """Resubmit a failed job when its retry policy covers the event."""
+    spec = JobSpec.model_validate(loads(job_row["job_spec"]))
+    if spec.retry is None:
+        return False
+    reason = (
+        JobTerminationReason(job_row["termination_reason"])
+        if job_row.get("termination_reason")
+        else None
+    )
+    if reason is None:
+        return False
+    event = reason.to_retry_event()
+    if event is None or event not in spec.retry.on_events:
+        return False
+    if spec.retry.duration is not None:
+        submitted = datetime.fromisoformat(run_row["submitted_at"])
+        if now_utc() - submitted > timedelta(seconds=spec.retry.duration):
+            return False
+    new_num = job_row["submission_num"] + 1
+    await jobs_service.create_job_row(
+        db,
+        {**run_row, "run_name": run_row["run_name"]},
+        spec,
+        submission_num=new_num,
+    )
+    logger.info(
+        "run %s: retrying job %s (submission %d, event %s)",
+        run_row["run_name"],
+        job_row["job_name"],
+        new_num,
+        event,
+    )
+    return True
+
+
+async def _finish_if_jobs_done(db: Database, run_row: dict, job_rows: list[dict]) -> None:
+    unfinished = [
+        r for r in job_rows if not JobStatus(r["status"]).is_finished()
+    ]
+    if unfinished:
+        await _touch(db, run_row["id"])
+        return
+    reason = (
+        RunTerminationReason(run_row["termination_reason"])
+        if run_row.get("termination_reason")
+        else RunTerminationReason.ALL_JOBS_DONE
+    )
+    final = reason.to_status()
+    await db.update_by_id(
+        "runs",
+        run_row["id"],
+        {"status": final.value, "last_processed_at": now_utc().isoformat()},
+    )
+    logger.info("run %s: %s", run_row["run_name"], final.value)
+
+
+async def _touch(db: Database, run_id: str) -> None:
+    await db.update_by_id(
+        "runs", run_id, {"last_processed_at": now_utc().isoformat()}
+    )

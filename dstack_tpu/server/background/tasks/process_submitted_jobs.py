@@ -1,0 +1,309 @@
+"""Assign-then-provision for submitted jobs.
+
+Parity: reference background/tasks/process_submitted_jobs.py:180-331
+(two-transaction pattern: (1) try an idle pool instance, (2) pick offers
+and provision; master-job wait for multinode at :138-154; fleet-per-run
+at :480-507).
+
+TPU-first: a multi-host slice provisions **atomically** as one instance;
+jobs 1..N-1 of the replica attach to workers of the master job's slice
+instead of provisioning their own VMs (slice-level rethink of the
+reference's master-job dance, SURVEY.md §7).
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.instances import (
+    InstanceConfiguration,
+    InstanceStatus,
+)
+from dstack_tpu.core.models.profiles import CreationPolicy
+from dstack_tpu.core.models.runs import (
+    JobProvisioningData,
+    JobSpec,
+    JobStatus,
+    JobTerminationReason,
+    RunSpec,
+    now_utc,
+)
+from dstack_tpu.backends.base.compute import ComputeWithCreateInstanceSupport
+from dstack_tpu.core.models.fleets import FleetStatus
+from dstack_tpu.core.models.runs import new_uuid
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import instances as instances_service
+from dstack_tpu.server.services import jobs as jobs_service
+from dstack_tpu.server.services.locking import claim_one
+from dstack_tpu.server.services.offers import get_offers_by_requirements
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_submitted_jobs")
+
+
+async def process_submitted_jobs(db: Database) -> None:
+    rows = await db.fetchall(
+        "SELECT id FROM jobs WHERE status = ? ORDER BY last_processed_at ASC LIMIT ?",
+        (JobStatus.SUBMITTED.value, settings.MAX_PROCESSING_JOBS),
+    )
+    async with claim_one("jobs", [r["id"] for r in rows]) as job_id:
+        if job_id is None:
+            return
+        await _process_job(db, job_id)
+
+
+async def _process_job(db: Database, job_id: str) -> None:
+    job_row = await db.get_by_id("jobs", job_id)
+    if job_row is None or job_row["status"] != JobStatus.SUBMITTED.value:
+        return
+    run_row = await db.get_by_id("runs", job_row["run_id"])
+    if run_row is None:
+        return
+    project_row = await db.get_by_id("projects", run_row["project_id"])
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    job_spec = JobSpec.model_validate(loads(job_row["job_spec"]))
+
+    if job_spec.jobs_per_replica > 1 and job_spec.job_num > 0:
+        await _attach_worker_job(db, job_row, run_row, job_spec)
+        return
+
+    profile = run_spec.effective_profile()
+    requirements = job_spec.requirements
+    multinode = job_spec.jobs_per_replica > 1 or requirements.resources.tpu is not None
+
+    # Phase 1: idle pool instance
+    pool = await instances_service.get_pool_instances(db, project_row)
+    candidates = instances_service.filter_pool_instances(
+        pool, requirements=requirements
+    )
+    for row in candidates:
+        jpd = loads(row.get("job_provisioning_data"))
+        if jpd is None:
+            continue
+        await _assign(db, job_row, row["id"], jpd, worker_id=0)
+        await instances_service.mark_instance(db, row["id"], InstanceStatus.BUSY)
+        logger.info("job %s reuses instance %s", job_spec.job_name, row["name"])
+        return
+
+    if profile.creation_policy == CreationPolicy.REUSE:
+        await _fail_no_capacity(db, job_row, "no idle instance and creation_policy=reuse")
+        return
+
+    # Phase 2: provision
+    project_backends = await backends_service.get_project_backends(db, project_row)
+    offers = await get_offers_by_requirements(
+        project_backends, requirements, profile, multinode=multinode
+    )
+    offers = [
+        (b, o)
+        for b, o in offers
+        if o.availability.is_available
+    ][: settings.MAX_OFFERS_TRIED]
+    if not offers:
+        await _fail_no_capacity(db, job_row, "no matching offers")
+        return
+
+    fleet_id = await _get_or_create_run_fleet(db, run_row, project_row, run_spec)
+    for btype, offer in offers:
+        compute = await backends_service.get_project_backend(db, project_row, btype)
+        if not isinstance(compute, ComputeWithCreateInstanceSupport):
+            continue
+        tpu = offer.instance.resources.tpu
+        if tpu is not None and job_spec.jobs_per_replica > 1:
+            # slice worker count must cover the requested nodes
+            if tpu.hosts < job_spec.jobs_per_replica:
+                continue
+        instance_name = f"{run_row['run_name']}-{job_spec.replica_num}-{job_spec.job_num}"
+        config = InstanceConfiguration(
+            project_name=project_row["name"],
+            instance_name=instance_name,
+            user=run_row["user_id"],
+        )
+        try:
+            jpd = await compute.create_instance(offer, config)
+        except Exception as e:
+            logger.warning(
+                "create_instance failed on %s/%s: %s", btype.value, offer.region, e
+            )
+            continue
+        inst_row = await instances_service.create_instance_row(
+            db,
+            project_row,
+            name=instance_name,
+            offer=offer,
+            fleet_id=fleet_id,
+            status=InstanceStatus.PROVISIONING,
+            jpd=jpd,
+            termination_idle_time=(
+                profile.idle_duration
+                if isinstance(profile.idle_duration, int)
+                else 300
+            ),
+        )
+        await _assign(db, job_row, inst_row["id"], jpd.model_dump(), worker_id=0)
+        logger.info(
+            "job %s provisioning on %s (%s, $%.2f/h)",
+            job_spec.job_name,
+            offer.instance.name,
+            offer.region,
+            offer.price,
+        )
+        return
+    await _fail_no_capacity(db, job_row, "all offers failed to provision")
+
+
+async def _attach_worker_job(
+    db: Database, job_row: dict, run_row: dict, job_spec: JobSpec
+) -> None:
+    """Jobs 1..N-1 wait for the master job's slice/cluster
+    (reference :138-154), then attach to worker ``job_num``."""
+    master = await db.fetchone(
+        "SELECT * FROM jobs WHERE run_id = ? AND replica_num = ? AND job_num = 0 "
+        "AND submission_num = ? ",
+        (run_row["id"], job_row["replica_num"], job_row["submission_num"]),
+    )
+    if master is None:
+        await _fail(db, job_row, JobTerminationReason.TERMINATED_BY_SERVER, "no master job")
+        return
+    if master["status"] in (
+        JobStatus.FAILED.value,
+        JobStatus.TERMINATED.value,
+        JobStatus.ABORTED.value,
+    ):
+        await _fail(
+            db, job_row, JobTerminationReason.TERMINATED_BY_SERVER, "master job failed"
+        )
+        return
+    master_jpd = loads(master.get("job_provisioning_data"))
+    if not master_jpd or not master.get("instance_id"):
+        # master not provisioned yet; requeue
+        await db.update_by_id(
+            "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+        )
+        return
+    jpd = JobProvisioningData.model_validate(master_jpd)
+    if len(jpd.hosts) > job_spec.job_num:
+        # multi-host slice: attach to worker job_num
+        worker = jpd.hosts[job_spec.job_num]
+        jpd.worker_id = job_spec.job_num
+        jpd.hostname = worker.external_ip or worker.internal_ip
+        jpd.internal_ip = worker.internal_ip
+        await _assign(
+            db, job_row, master["instance_id"], jpd.model_dump(), worker_id=job_spec.job_num
+        )
+        logger.info(
+            "job %s attached to slice worker %d", job_spec.job_name, job_spec.job_num
+        )
+    else:
+        # single-host instances: provision a separate instance per node
+        # in the same backend/region (cluster fleet)
+        await _provision_sibling(db, job_row, run_row, job_spec, jpd)
+
+
+async def _provision_sibling(
+    db: Database, job_row: dict, run_row: dict, job_spec: JobSpec, master_jpd
+) -> None:
+    project_row = await db.get_by_id("projects", run_row["project_id"])
+    compute = await backends_service.get_project_backend(
+        db, project_row, master_jpd.backend
+    )
+    if not isinstance(compute, ComputeWithCreateInstanceSupport):
+        await _fail(
+            db, job_row, JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY,
+            "backend cannot create sibling instances",
+        )
+        return
+    offers = await compute.get_offers(job_spec.requirements)
+    offers = [o for o in offers if o.region == master_jpd.region]
+    if not offers:
+        await _fail_no_capacity(db, job_row, "no sibling offers in master region")
+        return
+    instance_name = f"{run_row['run_name']}-{job_spec.replica_num}-{job_spec.job_num}"
+    try:
+        jpd = await compute.create_instance(
+            offers[0],
+            InstanceConfiguration(
+                project_name=project_row["name"], instance_name=instance_name
+            ),
+        )
+    except Exception as e:
+        await _fail_no_capacity(db, job_row, f"sibling provisioning failed: {e}")
+        return
+    inst_row = await instances_service.create_instance_row(
+        db,
+        project_row,
+        name=instance_name,
+        offer=offers[0],
+        fleet_id=run_row.get("fleet_id"),
+        instance_num=job_spec.job_num,
+        status=InstanceStatus.PROVISIONING,
+        jpd=jpd,
+    )
+    await _assign(db, job_row, inst_row["id"], jpd.model_dump(), worker_id=0)
+
+
+async def _get_or_create_run_fleet(
+    db: Database, run_row: dict, project_row: dict, run_spec: RunSpec
+) -> str:
+    if run_row.get("fleet_id"):
+        return run_row["fleet_id"]
+    fleet_id = new_uuid()
+    await db.insert(
+        "fleets",
+        {
+            "id": fleet_id,
+            "project_id": project_row["id"],
+            "name": f"fleet-{run_row['run_name']}",
+            "status": FleetStatus.ACTIVE.value,
+            "spec": dumps(
+                {
+                    "configuration": {"type": "fleet", "nodes": 1},
+                    "autocreated": True,
+                }
+            ),
+            "autocreated": 1,
+            "created_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    await db.update_by_id("runs", run_row["id"], {"fleet_id": fleet_id})
+    return fleet_id
+
+
+async def _assign(
+    db: Database, job_row: dict, instance_id: str, jpd: dict, worker_id: int
+) -> None:
+    if isinstance(jpd, dict):
+        jpd = dict(jpd)
+        jpd["worker_id"] = worker_id
+    await db.update_by_id(
+        "jobs",
+        job_row["id"],
+        {
+            "status": JobStatus.PROVISIONING.value,
+            "instance_id": instance_id,
+            "instance_assigned": 1,
+            "job_provisioning_data": dumps(jpd),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+
+
+async def _fail_no_capacity(db: Database, job_row: dict, message: str) -> None:
+    await _fail(
+        db, job_row, JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY, message
+    )
+
+
+async def _fail(
+    db: Database, job_row: dict, reason: JobTerminationReason, message: str
+) -> None:
+    logger.info("job %s: %s (%s)", job_row["job_name"], reason.value, message)
+    await jobs_service.update_job_status(
+        db,
+        job_row["id"],
+        JobStatus.TERMINATING,
+        termination_reason=reason,
+        termination_reason_message=message,
+    )

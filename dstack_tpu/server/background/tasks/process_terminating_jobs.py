@@ -1,0 +1,100 @@
+"""Tear down terminating jobs and release instances.
+
+Parity: reference background/tasks/process_terminating_jobs.py +
+services/jobs/__init__.py:209-330 (stop runner, terminate shim task,
+detach volumes, release instance).
+"""
+
+from dstack_tpu.core.errors import AgentError, AgentNotReady
+from dstack_tpu.core.models.instances import InstanceStatus
+from dstack_tpu.core.models.runs import (
+    JobProvisioningData,
+    JobStatus,
+    JobTerminationReason,
+    now_utc,
+)
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database, loads
+from dstack_tpu.server.services import jobs as jobs_service
+from dstack_tpu.server.services.agent_client import shim_client_for
+from dstack_tpu.server.services.locking import claim_one
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_terminating_jobs")
+
+
+async def process_terminating_jobs(db: Database) -> None:
+    rows = await db.fetchall(
+        "SELECT id FROM jobs WHERE status = ? ORDER BY last_processed_at ASC LIMIT ?",
+        (JobStatus.TERMINATING.value, settings.MAX_PROCESSING_JOBS),
+    )
+    async with claim_one("jobs", [r["id"] for r in rows]) as job_id:
+        if job_id is None:
+            return
+        await _process(db, job_id)
+
+
+async def _process(db: Database, job_id: str) -> None:
+    job_row = await db.get_by_id("jobs", job_id)
+    if job_row is None or job_row["status"] != JobStatus.TERMINATING.value:
+        return
+    jpd_raw = loads(job_row.get("job_provisioning_data"))
+    if jpd_raw is not None:
+        jpd = JobProvisioningData.model_validate(jpd_raw)
+        try:
+            async with shim_client_for(jpd) as shim:
+                await shim.terminate_task(
+                    job_row["id"],
+                    timeout=10,
+                    reason=job_row.get("termination_reason"),
+                )
+                await shim.remove_task(job_row["id"])
+        except (AgentError, AgentNotReady) as e:
+            logger.debug("job %s: agent teardown skipped: %s", job_row["job_name"], e)
+        # Release the instance for reuse. Only worker 0 owns the slice;
+        # sibling jobs release their own per-node instances.
+        if job_row.get("instance_id"):
+            await _release_instance(db, job_row)
+
+    reason = (
+        JobTerminationReason(job_row["termination_reason"])
+        if job_row.get("termination_reason")
+        else JobTerminationReason.TERMINATED_BY_SERVER
+    )
+    final = reason.to_job_status()
+    await jobs_service.update_job_status(
+        db, job_row["id"], final, termination_reason=reason
+    )
+    logger.info("job %s: %s (%s)", job_row["job_name"], final.value, reason.value)
+
+
+async def _release_instance(db: Database, job_row: dict) -> None:
+    inst = await db.get_by_id("instances", job_row["instance_id"])
+    if inst is None or inst["status"] in (
+        InstanceStatus.TERMINATING.value,
+        InstanceStatus.TERMINATED.value,
+    ):
+        return
+    # other unfinished jobs still on this instance?
+    others = await db.fetchall(
+        "SELECT id FROM jobs WHERE instance_id = ? AND id != ? AND status IN (?,?,?,?,?)",
+        (
+            inst["id"],
+            job_row["id"],
+            JobStatus.SUBMITTED.value,
+            JobStatus.PROVISIONING.value,
+            JobStatus.PULLING.value,
+            JobStatus.RUNNING.value,
+            JobStatus.TERMINATING.value,
+        ),
+    )
+    if others:
+        return
+    await db.update_by_id(
+        "instances",
+        inst["id"],
+        {
+            "status": InstanceStatus.IDLE.value,
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )

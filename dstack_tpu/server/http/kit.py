@@ -1,0 +1,149 @@
+"""Minimal async REST framework (aiohttp + pydantic).
+
+The reference rides FastAPI (reference server/app.py:67-186); this image
+has no FastAPI/starlette, so the framework ships its own kit with the
+same ergonomics: routers with typed request/response models, bearer-token
+auth dependency, ClientError → HTTP status mapping.
+"""
+
+import inspect
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, get_type_hints
+
+from aiohttp import web
+from pydantic import BaseModel, ValidationError
+
+from dstack_tpu.core.errors import ClientError
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.http")
+
+
+@dataclass
+class RequestContext:
+    request: web.Request
+    app: web.Application
+    path_params: dict[str, str]
+    user: Any = None  # row dict of the authenticated user
+    project: Any = None  # row dict of the authorized project
+
+    @property
+    def state(self) -> dict:
+        return self.app["state"]
+
+    def param(self, name: str) -> str:
+        return self.path_params[name]
+
+
+class Router:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.routes: list[tuple[str, str, Callable]] = []
+
+    def _add(self, method: str, path: str, fn: Callable) -> Callable:
+        self.routes.append((method, self.prefix + path, fn))
+        return fn
+
+    def post(self, path: str) -> Callable:
+        return lambda fn: self._add("POST", path, fn)
+
+    def get(self, path: str) -> Callable:
+        return lambda fn: self._add("GET", path, fn)
+
+    def delete(self, path: str) -> Callable:
+        return lambda fn: self._add("DELETE", path, fn)
+
+
+def _serialize(result: Any) -> web.StreamResponse:
+    if isinstance(result, web.StreamResponse):
+        return result
+    if result is None:
+        return web.json_response({})
+    if isinstance(result, BaseModel):
+        return web.Response(
+            text=result.model_dump_json(), content_type="application/json"
+        )
+    if isinstance(result, list) and result and isinstance(result[0], BaseModel):
+        return web.Response(
+            text="[" + ",".join(r.model_dump_json() for r in result) + "]",
+            content_type="application/json",
+        )
+    return web.json_response(result)
+
+
+def _make_handler(fn: Callable, auth_dependency: Optional[Callable]) -> Callable:
+    hints = get_type_hints(fn)
+    sig = inspect.signature(fn)
+    body_param = None
+    for name, p in sig.parameters.items():
+        ann = hints.get(name)
+        if (
+            ann is not None
+            and inspect.isclass(ann)
+            and issubclass(ann, BaseModel)
+        ):
+            body_param = (name, ann)
+    wants_ctx = "ctx" in sig.parameters
+    no_auth = getattr(fn, "__no_auth__", False)
+
+    async def handler(request: web.Request) -> web.StreamResponse:
+        ctx = RequestContext(
+            request=request,
+            app=request.app,
+            path_params=dict(request.match_info),
+        )
+        try:
+            if auth_dependency is not None and not no_auth:
+                await auth_dependency(ctx)
+            kwargs: dict[str, Any] = {}
+            if wants_ctx:
+                kwargs["ctx"] = ctx
+            if body_param is not None:
+                name, model = body_param
+                raw = await request.read()
+                try:
+                    data = json.loads(raw) if raw else {}
+                except json.JSONDecodeError:
+                    raise ClientError("invalid JSON body")
+                try:
+                    kwargs[name] = model.model_validate(data)
+                except ValidationError as e:
+                    return web.json_response(
+                        {"detail": json.loads(e.json())}, status=422
+                    )
+            result = fn(**kwargs)
+            if inspect.isawaitable(result):
+                result = await result
+            return _serialize(result)
+        except ClientError as e:
+            return web.json_response(
+                {"detail": [{"msg": e.msg, "code": e.code}]},
+                status=e.http_status,
+            )
+        except Exception:
+            logger.exception("unhandled error in %s %s", request.method, request.path)
+            return web.json_response(
+                {"detail": [{"msg": "internal server error", "code": "error"}]},
+                status=500,
+            )
+
+    return handler
+
+
+def no_auth(fn: Callable) -> Callable:
+    fn.__no_auth__ = True  # type: ignore[attr-defined]
+    return fn
+
+
+def build_app(
+    routers: list[Router],
+    state: dict,
+    auth_dependency: Optional[Callable] = None,
+) -> web.Application:
+    app = web.Application(client_max_size=256 * 1024 * 1024)
+    app["state"] = state
+    for router in routers:
+        for method, path, fn in router.routes:
+            app.router.add_route(method, path, _make_handler(fn, auth_dependency))
+    return app

@@ -1,0 +1,16 @@
+"""``python -m dstack_tpu.server.main`` — uvicorn-less server entry.
+
+Parity: reference server/main.py (4 lines).
+"""
+
+import asyncio
+
+from dstack_tpu.server.app import run_server
+
+
+def main() -> None:
+    asyncio.run(run_server())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,235 @@
+"""Ordered schema migrations.
+
+Parity: reference server/models.py:174-700 (17 tables) + Alembic
+migrations dir. JSON documents live in TEXT columns (sqlite); every
+table carries the timestamps the reconcilers key on
+(``last_processed_at`` ordering, SURVEY.md §3.2).
+"""
+
+MIGRATIONS: list[tuple[str, str]] = [
+    (
+        "0001_initial",
+        """
+CREATE TABLE users (
+    id TEXT PRIMARY KEY,
+    username TEXT NOT NULL UNIQUE,
+    global_role TEXT NOT NULL DEFAULT 'user',
+    email TEXT,
+    token TEXT NOT NULL UNIQUE,
+    active INTEGER NOT NULL DEFAULT 1,
+    created_at TEXT NOT NULL
+);
+
+CREATE TABLE projects (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL UNIQUE,
+    owner_id TEXT NOT NULL REFERENCES users(id),
+    is_public INTEGER NOT NULL DEFAULT 0,
+    deleted INTEGER NOT NULL DEFAULT 0,
+    created_at TEXT NOT NULL
+);
+
+CREATE TABLE members (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    user_id TEXT NOT NULL REFERENCES users(id),
+    project_role TEXT NOT NULL DEFAULT 'user',
+    UNIQUE (project_id, user_id)
+);
+
+CREATE TABLE backends (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    type TEXT NOT NULL,
+    config TEXT NOT NULL DEFAULT '{}',
+    auth TEXT,
+    UNIQUE (project_id, type)
+);
+
+CREATE TABLE repos (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    repo_info TEXT NOT NULL DEFAULT '{}',
+    creds TEXT,
+    UNIQUE (project_id, name)
+);
+
+CREATE TABLE codes (
+    id TEXT PRIMARY KEY,
+    repo_id TEXT NOT NULL REFERENCES repos(id),
+    blob_hash TEXT NOT NULL,
+    blob BLOB,
+    UNIQUE (repo_id, blob_hash)
+);
+
+CREATE TABLE fleets (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT 'active',
+    status_message TEXT,
+    spec TEXT NOT NULL,
+    autocreated INTEGER NOT NULL DEFAULT 0,
+    deleted INTEGER NOT NULL DEFAULT 0,
+    created_at TEXT NOT NULL,
+    last_processed_at TEXT
+);
+CREATE INDEX idx_fleets_project ON fleets(project_id, deleted);
+
+CREATE TABLE runs (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    user_id TEXT NOT NULL REFERENCES users(id),
+    repo_id TEXT REFERENCES repos(id),
+    fleet_id TEXT REFERENCES fleets(id),
+    run_name TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT 'submitted',
+    termination_reason TEXT,
+    run_spec TEXT NOT NULL,
+    service_spec TEXT,
+    desired_replica_count INTEGER NOT NULL DEFAULT 1,
+    deleted INTEGER NOT NULL DEFAULT 0,
+    submitted_at TEXT NOT NULL,
+    last_processed_at TEXT,
+    UNIQUE (project_id, run_name, deleted)
+);
+CREATE INDEX idx_runs_status ON runs(status, last_processed_at);
+
+CREATE TABLE jobs (
+    id TEXT PRIMARY KEY,
+    run_id TEXT NOT NULL REFERENCES runs(id),
+    run_name TEXT NOT NULL,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    job_num INTEGER NOT NULL DEFAULT 0,
+    replica_num INTEGER NOT NULL DEFAULT 0,
+    submission_num INTEGER NOT NULL DEFAULT 0,
+    job_name TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT 'submitted',
+    termination_reason TEXT,
+    termination_reason_message TEXT,
+    exit_status INTEGER,
+    job_spec TEXT NOT NULL,
+    job_provisioning_data TEXT,
+    job_runtime_data TEXT,
+    instance_id TEXT REFERENCES instances(id),
+    used_instance_id TEXT,
+    instance_assigned INTEGER NOT NULL DEFAULT 0,
+    disconnected_at TEXT,
+    inactivity_secs INTEGER,
+    submitted_at TEXT NOT NULL,
+    last_processed_at TEXT,
+    finished_at TEXT
+);
+CREATE INDEX idx_jobs_status ON jobs(status, last_processed_at);
+CREATE INDEX idx_jobs_run ON jobs(run_id);
+
+CREATE TABLE instances (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    fleet_id TEXT REFERENCES fleets(id),
+    instance_num INTEGER NOT NULL DEFAULT 0,
+    name TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT 'pending',
+    unreachable INTEGER NOT NULL DEFAULT 0,
+    termination_reason TEXT,
+    health_status TEXT,
+    backend TEXT,
+    region TEXT,
+    availability_zone TEXT,
+    price REAL,
+    offer TEXT,
+    instance_configuration TEXT,
+    job_provisioning_data TEXT,
+    remote_connection_info TEXT,
+    termination_policy TEXT,
+    termination_idle_time INTEGER NOT NULL DEFAULT 300,
+    termination_deadline TEXT,
+    total_blocks INTEGER NOT NULL DEFAULT 1,
+    busy_blocks INTEGER NOT NULL DEFAULT 0,
+    started_at TEXT,
+    finished_at TEXT,
+    deleted INTEGER NOT NULL DEFAULT 0,
+    created_at TEXT NOT NULL,
+    last_processed_at TEXT,
+    last_retry_at TEXT
+);
+CREATE INDEX idx_instances_status ON instances(status, last_processed_at);
+
+CREATE TABLE volumes (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT 'submitted',
+    status_message TEXT,
+    configuration TEXT NOT NULL,
+    provisioning_data TEXT,
+    external INTEGER NOT NULL DEFAULT 0,
+    deleted INTEGER NOT NULL DEFAULT 0,
+    created_at TEXT NOT NULL,
+    last_processed_at TEXT,
+    last_job_processed_at TEXT
+);
+
+CREATE TABLE volume_attachments (
+    id TEXT PRIMARY KEY,
+    volume_id TEXT NOT NULL REFERENCES volumes(id),
+    instance_id TEXT NOT NULL REFERENCES instances(id),
+    attachment_data TEXT,
+    UNIQUE (volume_id, instance_id)
+);
+
+CREATE TABLE gateways (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT 'submitted',
+    status_message TEXT,
+    configuration TEXT NOT NULL,
+    provisioning_data TEXT,
+    ip_address TEXT,
+    is_default INTEGER NOT NULL DEFAULT 0,
+    created_at TEXT NOT NULL,
+    last_processed_at TEXT,
+    UNIQUE (project_id, name)
+);
+
+CREATE TABLE placement_groups (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    fleet_id TEXT REFERENCES fleets(id),
+    name TEXT NOT NULL,
+    configuration TEXT NOT NULL,
+    provisioning_data TEXT,
+    fleet_deleted INTEGER NOT NULL DEFAULT 0,
+    deleted INTEGER NOT NULL DEFAULT 0,
+    created_at TEXT NOT NULL
+);
+
+CREATE TABLE job_metrics_points (
+    id TEXT PRIMARY KEY,
+    job_id TEXT NOT NULL REFERENCES jobs(id),
+    timestamp TEXT NOT NULL,
+    cpu_usage_micro INTEGER NOT NULL DEFAULT 0,
+    memory_usage_bytes INTEGER NOT NULL DEFAULT 0,
+    memory_working_set_bytes INTEGER NOT NULL DEFAULT 0,
+    tpu_metrics TEXT
+);
+CREATE INDEX idx_metrics_job ON job_metrics_points(job_id, timestamp);
+
+CREATE TABLE job_prometheus_metrics (
+    job_id TEXT PRIMARY KEY REFERENCES jobs(id),
+    collected_at TEXT NOT NULL,
+    text TEXT NOT NULL
+);
+
+CREATE TABLE secrets (
+    id TEXT PRIMARY KEY,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    name TEXT NOT NULL,
+    value TEXT NOT NULL,
+    UNIQUE (project_id, name)
+);
+""",
+    ),
+]

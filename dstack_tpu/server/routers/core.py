@@ -1,0 +1,410 @@
+"""REST routers: server info, users, projects, backends, runs, logs,
+instances, fleets, volumes, gateways, secrets, metrics.
+
+Parity: reference server/routers/*.py (15 files; thin endpoints
+delegating to services, URL shape ``/api/project/{name}/...``).
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.errors import ResourceNotExistsError, UnauthorizedError
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.configurations import VolumeConfiguration
+from dstack_tpu.core.models.metrics import JobMetrics, Metric
+from dstack_tpu.core.models.users import GlobalRole, ProjectRole
+from dstack_tpu.core.models.volumes import Volume, VolumeStatus
+from dstack_tpu.server.db import dumps, loads
+from dstack_tpu.server.http.kit import RequestContext, Router, no_auth
+from dstack_tpu.server.routers import schemas as s
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import projects as projects_service
+from dstack_tpu.server.services import runs as runs_service
+from dstack_tpu.server.services import users as users_service
+from dstack_tpu.server.services.logs import get_log_storage
+from dstack_tpu.version import __version__
+
+server_router = Router("/api/server")
+users_router = Router("/api/users")
+projects_router = Router("/api/projects")
+project_router = Router("/api/project/{project_name}")
+
+
+async def auth_dependency(ctx: RequestContext) -> None:
+    """Bearer-token auth + project access (reference server/security/)."""
+    auth = ctx.request.headers.get("Authorization", "")
+    if not auth.startswith("Bearer "):
+        raise UnauthorizedError("missing bearer token")
+    token = auth.removeprefix("Bearer ").strip()
+    db = ctx.state["db"]
+    user_row = await users_service.get_user_by_token(db, token)
+    if user_row is None:
+        raise UnauthorizedError("invalid token")
+    ctx.user = user_row
+    project_name = ctx.path_params.get("project_name")
+    if project_name is not None:
+        project_row = await projects_service.get_project_row_or_error(db, project_name)
+        await projects_service.check_project_access(db, project_row, user_row)
+        ctx.project = project_row
+
+
+# ---- server ----
+
+
+@server_router.get("/info")
+@no_auth
+async def server_info(ctx: RequestContext):
+    return {"server_version": __version__}
+
+
+# ---- users ----
+
+
+@users_router.post("/list")
+async def list_users(ctx: RequestContext):
+    return await users_service.list_users(ctx.state["db"])
+
+
+@users_router.post("/get_my_user")
+async def get_my_user(ctx: RequestContext):
+    return users_service.user_row_to_model(ctx.user)
+
+
+@users_router.post("/create")
+async def create_user(ctx: RequestContext, body: s.CreateUserRequest):
+    _require_global_admin(ctx)
+    return await users_service.create_user(
+        ctx.state["db"], body.username, body.global_role, body.email
+    )
+
+
+@users_router.post("/delete")
+async def delete_users(ctx: RequestContext, body: s.DeleteUsersRequest):
+    _require_global_admin(ctx)
+    await users_service.delete_users(ctx.state["db"], body.users)
+
+
+def _require_global_admin(ctx: RequestContext) -> None:
+    from dstack_tpu.core.errors import ForbiddenError
+
+    if ctx.user["global_role"] != GlobalRole.ADMIN.value:
+        raise ForbiddenError("global admin required")
+
+
+# ---- projects ----
+
+
+@projects_router.post("/list")
+async def list_projects(ctx: RequestContext):
+    return await projects_service.list_projects_for_user(ctx.state["db"], ctx.user)
+
+
+@projects_router.post("/create")
+async def create_project(ctx: RequestContext, body: s.CreateProjectRequest):
+    return await projects_service.create_project(
+        ctx.state["db"], ctx.user, body.project_name, body.is_public
+    )
+
+
+@projects_router.post("/delete")
+async def delete_projects(ctx: RequestContext, body: s.DeleteProjectsRequest):
+    await projects_service.delete_projects(ctx.state["db"], ctx.user, body.projects_names)
+
+
+@project_router.post("/get")
+async def get_project(ctx: RequestContext):
+    return await projects_service.get_project(ctx.state["db"], ctx.param("project_name"))
+
+
+@project_router.post("/set_members")
+async def set_members(ctx: RequestContext, body: s.SetMembersRequest):
+    db = ctx.state["db"]
+    await projects_service.check_project_access(
+        db, ctx.project, ctx.user, require_role=ProjectRole.MANAGER
+    )
+    members = [
+        (m["username"], ProjectRole(m.get("project_role", "user")))
+        for m in body.members
+    ]
+    await projects_service.set_members(db, ctx.project["id"], members)
+    return await projects_service.get_project(db, ctx.param("project_name"))
+
+
+# ---- backends ----
+
+
+@project_router.post("/backends/create")
+async def create_backend(ctx: RequestContext, body: s.CreateBackendRequest):
+    db = ctx.state["db"]
+    await projects_service.check_project_access(
+        db, ctx.project, ctx.user, require_role=ProjectRole.ADMIN
+    )
+    await backends_service.create_backend(db, ctx.project, body.type, body.config)
+
+
+@project_router.post("/backends/delete")
+async def delete_backends(ctx: RequestContext, body: s.DeleteBackendsRequest):
+    db = ctx.state["db"]
+    await projects_service.check_project_access(
+        db, ctx.project, ctx.user, require_role=ProjectRole.ADMIN
+    )
+    await backends_service.delete_backends(db, ctx.project, body.types)
+
+
+@project_router.post("/backends/list")
+async def list_backends(ctx: RequestContext):
+    rows = await backends_service.list_backend_rows(ctx.state["db"], ctx.project)
+    return [{"name": r["type"], "config": loads(r["config"]) or {}} for r in rows]
+
+
+# ---- runs ----
+
+
+@project_router.post("/runs/get_plan")
+async def get_run_plan(ctx: RequestContext, body: s.GetRunPlanRequest):
+    return await runs_service.get_plan(
+        ctx.state["db"], ctx.project, ctx.user, body.run_spec
+    )
+
+
+@project_router.post("/runs/apply")
+async def apply_run_plan(ctx: RequestContext, body: s.ApplyRunPlanRequest):
+    return await runs_service.submit_run(
+        ctx.state["db"], ctx.project, ctx.user, body.run_spec
+    )
+
+
+@project_router.post("/runs/list")
+async def list_runs(ctx: RequestContext):
+    return await runs_service.list_runs(ctx.state["db"], ctx.project)
+
+
+@project_router.post("/runs/get")
+async def get_run(ctx: RequestContext, body: s.GetRunRequest):
+    return await runs_service.get_run(ctx.state["db"], ctx.project, body.run_name)
+
+
+@project_router.post("/runs/stop")
+async def stop_runs(ctx: RequestContext, body: s.StopRunsRequest):
+    await runs_service.stop_runs(
+        ctx.state["db"], ctx.project, body.runs_names, abort=body.abort
+    )
+
+
+@project_router.post("/runs/delete")
+async def delete_runs(ctx: RequestContext, body: s.DeleteRunsRequest):
+    await runs_service.delete_runs(ctx.state["db"], ctx.project, body.runs_names)
+
+
+# ---- logs ----
+
+
+@project_router.post("/logs/poll")
+async def poll_logs(ctx: RequestContext, body: s.PollLogsRequest):
+    from dstack_tpu.utils.common import parse_dt, run_async
+
+    db = ctx.state["db"]
+    run_row = await runs_service.get_run_row(db, ctx.project, body.run_name)
+    if run_row is None:
+        raise ResourceNotExistsError(f"run {body.run_name} not found")
+    job_row = await db.fetchone(
+        "SELECT job_name FROM jobs WHERE run_id = ? AND replica_num = ? AND job_num = ? "
+        "ORDER BY submission_num DESC LIMIT 1",
+        (run_row["id"], body.replica_num, body.job_num),
+    )
+    if job_row is None:
+        raise ResourceNotExistsError("job not found")
+    storage = get_log_storage()
+    # file I/O off the event loop (multi-hundred-MB logs must not stall
+    # the reconcilers)
+    import functools
+
+    return await run_async(
+        functools.partial(
+            storage.poll_logs,
+            ctx.param("project_name"),
+            body.run_name,
+            job_row["job_name"],
+            start_time=parse_dt(body.start_time),
+            limit=body.limit,
+            diagnostics=body.diagnose,
+            next_token=body.next_token,
+        )
+    )
+
+
+# ---- instances & fleets ----
+
+
+@project_router.post("/instances/list")
+async def list_instances(ctx: RequestContext):
+    from dstack_tpu.server.services.instances import instance_row_to_model
+
+    db = ctx.state["db"]
+    rows = await db.fetchall(
+        "SELECT * FROM instances WHERE project_id = ? AND deleted = 0",
+        (ctx.project["id"],),
+    )
+    return [instance_row_to_model(r, ctx.param("project_name")) for r in rows]
+
+
+@project_router.post("/fleets/list")
+async def list_fleets(ctx: RequestContext):
+    from dstack_tpu.server.services.fleets import list_fleets as _list
+
+    return await _list(ctx.state["db"], ctx.project)
+
+
+@project_router.post("/fleets/apply")
+async def apply_fleet(ctx: RequestContext, body: s.ApplyFleetRequest):
+    from dstack_tpu.server.services.fleets import apply_fleet as _apply
+
+    return await _apply(ctx.state["db"], ctx.project, ctx.user, body.configuration)
+
+
+@project_router.post("/fleets/delete")
+async def delete_fleets(ctx: RequestContext, body: s.DeleteFleetsRequest):
+    from dstack_tpu.server.services.fleets import delete_fleets as _delete
+
+    await _delete(ctx.state["db"], ctx.project, body.names)
+
+
+# ---- volumes ----
+
+
+@project_router.post("/volumes/list")
+async def list_volumes(ctx: RequestContext):
+    from dstack_tpu.server.services.volumes import list_volumes as _list
+
+    return await _list(ctx.state["db"], ctx.project)
+
+
+@project_router.post("/volumes/apply")
+async def apply_volume(ctx: RequestContext, body: s.ApplyVolumeRequest):
+    from dstack_tpu.server.services.volumes import apply_volume as _apply
+
+    return await _apply(ctx.state["db"], ctx.project, ctx.user, body.configuration)
+
+
+@project_router.post("/volumes/delete")
+async def delete_volumes(ctx: RequestContext, body: s.DeleteVolumesRequest):
+    from dstack_tpu.server.services.volumes import delete_volumes as _delete
+
+    await _delete(ctx.state["db"], ctx.project, body.names)
+
+
+# ---- secrets ----
+
+
+@project_router.post("/secrets/list")
+async def list_secrets(ctx: RequestContext):
+    db = ctx.state["db"]
+    rows = await db.fetchall(
+        "SELECT name FROM secrets WHERE project_id = ?", (ctx.project["id"],)
+    )
+    return [{"name": r["name"]} for r in rows]
+
+
+@project_router.post("/secrets/create")
+async def create_secret(ctx: RequestContext, body: s.CreateSecretRequest):
+    from dstack_tpu.core.models.runs import new_uuid
+    from dstack_tpu.server.services.encryption import encrypt
+
+    db = ctx.state["db"]
+    existing = await db.fetchone(
+        "SELECT id FROM secrets WHERE project_id = ? AND name = ?",
+        (ctx.project["id"], body.name),
+    )
+    if existing:
+        await db.update_by_id("secrets", existing["id"], {"value": encrypt(body.value)})
+    else:
+        await db.insert(
+            "secrets",
+            {
+                "id": new_uuid(),
+                "project_id": ctx.project["id"],
+                "name": body.name,
+                "value": encrypt(body.value),
+            },
+        )
+
+
+@project_router.post("/secrets/delete")
+async def delete_secrets(ctx: RequestContext, body: s.DeleteSecretsRequest):
+    db = ctx.state["db"]
+    for name in body.secrets_names:
+        await db.execute(
+            "DELETE FROM secrets WHERE project_id = ? AND name = ?",
+            (ctx.project["id"], name),
+        )
+
+
+# ---- metrics ----
+
+
+@project_router.post("/metrics/job")
+async def get_job_metrics(ctx: RequestContext, body: s.GetJobMetricsRequest):
+    """DB metric points → Metric series (reference services/metrics.py:20)."""
+    db = ctx.state["db"]
+    run_row = await runs_service.get_run_row(db, ctx.project, body.run_name)
+    if run_row is None:
+        raise ResourceNotExistsError(f"run {body.run_name} not found")
+    job_row = await db.fetchone(
+        "SELECT id FROM jobs WHERE run_id = ? AND replica_num = ? AND job_num = ? "
+        "ORDER BY submission_num DESC LIMIT 1",
+        (run_row["id"], body.replica_num, body.job_num),
+    )
+    if job_row is None:
+        raise ResourceNotExistsError("job not found")
+    points = await db.fetchall(
+        "SELECT * FROM job_metrics_points WHERE job_id = ? "
+        "ORDER BY timestamp DESC LIMIT ?",
+        (job_row["id"], body.limit),
+    )
+    points.reverse()
+    from datetime import datetime
+
+    def series(name, key, transform=lambda v, prev, dt: v):
+        ts, vals = [], []
+        prev = None
+        for p in points:
+            t = datetime.fromisoformat(p["timestamp"])
+            v = p[key]
+            if prev is not None:
+                dt = (t - prev[0]).total_seconds()
+                vals.append(transform(v, prev[1], dt))
+                ts.append(t)
+            prev = (t, v)
+        return Metric(name=name, timestamps=ts, values=vals)
+
+    metrics = [
+        series(
+            "cpu_usage_percent",
+            "cpu_usage_micro",
+            lambda v, prev, dt: max(0.0, (v - prev) / (dt * 1e6) * 100 if dt else 0.0),
+        ),
+        series("memory_usage_bytes", "memory_usage_bytes", lambda v, p, dt: v),
+    ]
+    # TPU series: one per chip
+    tpu_series: dict[str, Metric] = {}
+    for p in points:
+        t = datetime.fromisoformat(p["timestamp"])
+        tm = loads(p.get("tpu_metrics")) or {}
+        for i, duty in enumerate(tm.get("duty_cycle") or []):
+            m = tpu_series.setdefault(
+                f"tpu_duty_cycle_percent_chip{i}",
+                Metric(name=f"tpu_duty_cycle_percent_chip{i}"),
+            )
+            m.timestamps.append(t)
+            m.values.append(duty)
+        for i, hbm in enumerate(tm.get("hbm_usage") or []):
+            m = tpu_series.setdefault(
+                f"tpu_hbm_usage_bytes_chip{i}",
+                Metric(name=f"tpu_hbm_usage_bytes_chip{i}"),
+            )
+            m.timestamps.append(t)
+            m.values.append(hbm)
+    metrics.extend(tpu_series.values())
+    return JobMetrics(metrics=metrics)
+
+
+ALL_ROUTERS = [server_router, users_router, projects_router, project_router]

@@ -1,0 +1,122 @@
+"""REST request bodies.
+
+Parity: reference server/schemas/*.py (one module per resource there;
+kept together here — the models are thin).
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.common import CoreModel
+from dstack_tpu.core.models.configurations import (
+    FleetConfiguration,
+    GatewayConfiguration,
+    VolumeConfiguration,
+)
+from dstack_tpu.core.models.runs import RunSpec
+from dstack_tpu.core.models.users import GlobalRole, ProjectRole
+
+
+class CreateUserRequest(CoreModel):
+    username: str
+    global_role: GlobalRole = GlobalRole.USER
+    email: Optional[str] = None
+
+
+class DeleteUsersRequest(CoreModel):
+    users: list[str]
+
+
+class CreateProjectRequest(CoreModel):
+    project_name: str
+    is_public: bool = False
+
+
+class DeleteProjectsRequest(CoreModel):
+    projects_names: list[str]
+
+
+class SetMembersRequest(CoreModel):
+    members: list[dict]  # [{username, project_role}]
+
+
+class CreateBackendRequest(CoreModel):
+    type: BackendType
+    config: dict = {}
+
+
+class DeleteBackendsRequest(CoreModel):
+    types: list[BackendType]
+
+
+class GetRunPlanRequest(CoreModel):
+    run_spec: RunSpec
+
+
+class ApplyRunPlanRequest(CoreModel):
+    run_spec: RunSpec
+    force: bool = False
+
+
+class GetRunRequest(CoreModel):
+    run_name: str
+
+
+class StopRunsRequest(CoreModel):
+    runs_names: list[str]
+    abort: bool = False
+
+
+class DeleteRunsRequest(CoreModel):
+    runs_names: list[str]
+
+
+class PollLogsRequest(CoreModel):
+    run_name: str
+    job_submission_id: Optional[str] = None
+    replica_num: int = 0
+    job_num: int = 0
+    start_time: Optional[str] = None
+    next_token: Optional[str] = None  # line-offset pagination cursor
+    limit: int = 1000
+    diagnose: bool = False
+
+
+class ApplyFleetRequest(CoreModel):
+    configuration: FleetConfiguration
+
+
+class DeleteFleetsRequest(CoreModel):
+    names: list[str]
+
+
+class ApplyVolumeRequest(CoreModel):
+    configuration: VolumeConfiguration
+
+
+class DeleteVolumesRequest(CoreModel):
+    names: list[str]
+
+
+class ApplyGatewayRequest(CoreModel):
+    configuration: GatewayConfiguration
+
+
+class DeleteGatewaysRequest(CoreModel):
+    names: list[str]
+
+
+class GetJobMetricsRequest(CoreModel):
+    run_name: str
+    replica_num: int = 0
+    job_num: int = 0
+    limit: int = 100
+
+
+class CreateSecretRequest(CoreModel):
+    name: str
+    value: str
+
+
+class DeleteSecretsRequest(CoreModel):
+    secrets_names: list[str]

@@ -1,0 +1,178 @@
+"""HTTP clients for the shim/runner agent APIs + tunnel dispatch.
+
+Parity: reference server/services/runner/client.py (RunnerClient /
+ShimClient) and runner/ssh.py:24-114 (``@runner_ssh_tunnel``). For the
+local backend the agents are reached directly over TCP; for cloud/SSH
+instances each call rides an SSH tunnel (worker N of a multi-host slice
+proxy-jumps through worker 0).
+"""
+
+import asyncio
+from contextlib import asynccontextmanager
+from typing import Optional
+
+import aiohttp
+
+from dstack_tpu.agent import schemas
+from dstack_tpu.core.errors import AgentError, AgentNotReady
+from dstack_tpu.core.models.runs import JobProvisioningData
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.agent_client")
+
+SHIM_PORT = 10998
+RUNNER_PORT = 10999
+
+
+class _HTTPBase:
+    def __init__(self, hostname: str, port: int):
+        self.base = f"http://{hostname}:{port}"
+
+    async def _request(
+        self, method: str, path: str, json_body=None, data=None, params=None,
+        timeout: float = 20.0,
+    ):
+        try:
+            async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=timeout)
+            ) as session:
+                async with session.request(
+                    method,
+                    self.base + path,
+                    json=json_body,
+                    data=data,
+                    params=params,
+                ) as resp:
+                    if resp.status >= 400:
+                        text = await resp.text()
+                        raise AgentError(
+                            f"{method} {path}: {resp.status} {text[:300]}"
+                        )
+                    return await resp.json()
+        except aiohttp.ClientConnectionError as e:
+            raise AgentNotReady(f"{self.base}{path}: {e}") from e
+        except asyncio.TimeoutError as e:
+            raise AgentNotReady(f"{self.base}{path}: timeout") from e
+
+
+class ShimClient(_HTTPBase):
+    async def healthcheck(self) -> schemas.HealthcheckResponse:
+        return schemas.HealthcheckResponse.model_validate(
+            await self._request("GET", "/api/healthcheck", timeout=5)
+        )
+
+    async def submit_task(self, req: schemas.TaskSubmitRequest) -> schemas.TaskInfo:
+        return schemas.TaskInfo.model_validate(
+            await self._request("POST", "/api/tasks", json_body=req.model_dump())
+        )
+
+    async def get_task(self, task_id: str) -> schemas.TaskInfo:
+        return schemas.TaskInfo.model_validate(
+            await self._request("GET", f"/api/tasks/{task_id}")
+        )
+
+    async def terminate_task(
+        self, task_id: str, timeout: int = 10, reason: Optional[str] = None
+    ) -> schemas.TaskInfo:
+        return schemas.TaskInfo.model_validate(
+            await self._request(
+                "POST",
+                f"/api/tasks/{task_id}/terminate",
+                json_body=schemas.TerminateRequest(
+                    timeout_seconds=timeout, reason=reason
+                ).model_dump(),
+            )
+        )
+
+    async def remove_task(self, task_id: str) -> None:
+        await self._request("POST", f"/api/tasks/{task_id}/remove")
+
+    async def host_info(self) -> schemas.HostInfo:
+        return schemas.HostInfo.model_validate(
+            await self._request("GET", "/api/host_info")
+        )
+
+
+class RunnerClient(_HTTPBase):
+    async def healthcheck(self) -> schemas.HealthcheckResponse:
+        return schemas.HealthcheckResponse.model_validate(
+            await self._request("GET", "/api/healthcheck", timeout=5)
+        )
+
+    async def submit(self, body: schemas.SubmitBody) -> None:
+        await self._request("POST", "/api/submit", json_body=body.model_dump())
+
+    async def upload_code(self, data: bytes) -> None:
+        await self._request("POST", "/api/upload_code", data=data, timeout=120)
+
+    async def run(self) -> None:
+        await self._request("POST", "/api/run")
+
+    async def pull(self, timestamp: float) -> schemas.PullResponse:
+        return schemas.PullResponse.model_validate(
+            await self._request(
+                "GET", "/api/pull", params={"timestamp": str(timestamp)}
+            )
+        )
+
+    async def stop(self) -> None:
+        await self._request("POST", "/api/stop")
+
+    async def metrics(self) -> schemas.MetricsSample:
+        return schemas.MetricsSample.model_validate(
+            await self._request("GET", "/api/metrics")
+        )
+
+
+def _direct(jpd: JobProvisioningData) -> bool:
+    """Local/dev instances are reached without SSH."""
+    return jpd.backend.value == "local" or jpd.hostname in ("127.0.0.1", "localhost")
+
+
+@asynccontextmanager
+async def shim_client_for(jpd: JobProvisioningData, shim_port: Optional[int] = None):
+    """Yield a ShimClient for the job's worker host, tunneling if needed."""
+    port = shim_port
+    if port is None:
+        port = SHIM_PORT
+        for h in jpd.hosts:
+            if h.worker_id == jpd.worker_id:
+                port = h.shim_port
+    if _direct(jpd):
+        yield ShimClient(jpd.hostname or "127.0.0.1", port)
+        return
+    from dstack_tpu.core.services.ssh.tunnel import open_tunnel_to_params
+    from dstack_tpu.core.models.instances import SSHConnectionParams
+
+    tunnel, ports = await open_tunnel_to_params(
+        SSHConnectionParams(
+            hostname=jpd.hostname or "", username=jpd.username, port=jpd.ssh_port
+        ),
+        [port],
+        proxy=jpd.ssh_proxy,
+    )
+    try:
+        yield ShimClient("127.0.0.1", ports[port])
+    finally:
+        tunnel.close()
+
+
+@asynccontextmanager
+async def runner_client_for(jpd: JobProvisioningData, runner_port: int):
+    if _direct(jpd):
+        yield RunnerClient(jpd.hostname or "127.0.0.1", runner_port)
+        return
+    from dstack_tpu.core.services.ssh.tunnel import open_tunnel_to_params
+    from dstack_tpu.core.models.instances import SSHConnectionParams
+
+    tunnel, ports = await open_tunnel_to_params(
+        SSHConnectionParams(
+            hostname=jpd.hostname or "", username=jpd.username, port=jpd.ssh_port
+        ),
+        [runner_port],
+        proxy=jpd.ssh_proxy,
+    )
+    try:
+        yield RunnerClient("127.0.0.1", ports[runner_port])
+    finally:
+        tunnel.close()

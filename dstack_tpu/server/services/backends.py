@@ -1,0 +1,105 @@
+"""Per-project backend registry.
+
+Parity: reference server/services/backends/ (configs from API or server
+config.yml, backend instantiation cache; configurators registry
+core/backends/configurators.py:67).
+"""
+
+from typing import Optional
+
+from dstack_tpu.backends.base.compute import Compute
+from dstack_tpu.core.errors import ClientError
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.runs import new_uuid
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.backends")
+
+# project_id -> {BackendType -> Compute}
+_compute_cache: dict[str, dict[BackendType, Compute]] = {}
+
+
+def _instantiate(btype: BackendType, config: dict) -> Compute:
+    if btype == BackendType.LOCAL:
+        from dstack_tpu.backends.local import LocalCompute
+
+        return LocalCompute()
+    if btype == BackendType.GCP:
+        from dstack_tpu.backends.gcp.compute import GCPTPUCompute
+
+        return GCPTPUCompute(config)
+    if btype == BackendType.REMOTE:
+        from dstack_tpu.backends.ssh_fleet.compute import SSHFleetCompute
+
+        return SSHFleetCompute(config)
+    raise ClientError(f"unsupported backend type {btype}")
+
+
+async def create_backend(
+    db: Database, project_row: dict, btype: BackendType, config: dict
+) -> None:
+    existing = await db.fetchone(
+        "SELECT id FROM backends WHERE project_id = ? AND type = ?",
+        (project_row["id"], btype.value),
+    )
+    if existing is not None:
+        await db.execute(
+            "UPDATE backends SET config = ? WHERE id = ?",
+            (dumps(config), existing["id"]),
+        )
+    else:
+        await db.insert(
+            "backends",
+            {
+                "id": new_uuid(),
+                "project_id": project_row["id"],
+                "type": btype.value,
+                "config": dumps(config),
+            },
+        )
+    _compute_cache.pop(project_row["id"], None)
+
+
+async def delete_backends(db: Database, project_row: dict, types: list[BackendType]) -> None:
+    for t in types:
+        await db.execute(
+            "DELETE FROM backends WHERE project_id = ? AND type = ?",
+            (project_row["id"], t.value),
+        )
+    _compute_cache.pop(project_row["id"], None)
+
+
+async def list_backend_rows(db: Database, project_row: dict) -> list[dict]:
+    return await db.fetchall(
+        "SELECT * FROM backends WHERE project_id = ?", (project_row["id"],)
+    )
+
+
+async def get_project_backends(
+    db: Database, project_row: dict
+) -> list[tuple[BackendType, Compute]]:
+    pid = project_row["id"]
+    if pid not in _compute_cache:
+        cache: dict[BackendType, Compute] = {}
+        for row in await list_backend_rows(db, project_row):
+            btype = BackendType(row["type"])
+            try:
+                cache[btype] = _instantiate(btype, loads(row["config"]) or {})
+            except Exception:
+                logger.exception("failed to instantiate backend %s", btype)
+        _compute_cache[pid] = cache
+    return list(_compute_cache[pid].items())
+
+
+async def get_project_backend(
+    db: Database, project_row: dict, btype: BackendType
+) -> Optional[Compute]:
+    for t, c in await get_project_backends(db, project_row):
+        if t == btype:
+            return c
+    return None
+
+
+def clear_backend_cache() -> None:
+    _compute_cache.clear()

@@ -1,0 +1,54 @@
+"""Pluggable column encryption for stored credentials/tokens.
+
+Parity: reference server/services/encryption/__init__.py (identity and
+AES key types; ``encrypt:70``/``decrypt:77``). Values are tagged with
+the scheme so old rows stay readable after key rotation.
+"""
+
+import base64
+import hashlib
+from typing import Optional
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from dstack_tpu.server import settings
+
+_PREFIX_IDENTITY = "enc:identity:"
+_PREFIX_AES = "enc:aes:"
+
+
+def _aes_keys() -> list[bytes]:
+    # derive 256-bit keys from configured passphrases
+    return [hashlib.sha256(k.encode()).digest() for k in settings.ENCRYPTION_KEYS]
+
+
+def encrypt(plaintext: Optional[str]) -> Optional[str]:
+    if plaintext is None:
+        return None
+    keys = _aes_keys()
+    if not keys:
+        return _PREFIX_IDENTITY + plaintext
+    aes = AESGCM(keys[0])
+    import os
+
+    nonce = os.urandom(12)
+    ct = aes.encrypt(nonce, plaintext.encode(), None)
+    return _PREFIX_AES + base64.b64encode(nonce + ct).decode()
+
+
+def decrypt(stored: Optional[str]) -> Optional[str]:
+    if stored is None:
+        return None
+    if stored.startswith(_PREFIX_IDENTITY):
+        return stored[len(_PREFIX_IDENTITY):]
+    if stored.startswith(_PREFIX_AES):
+        blob = base64.b64decode(stored[len(_PREFIX_AES):])
+        nonce, ct = blob[:12], blob[12:]
+        last = None
+        for key in _aes_keys():
+            try:
+                return AESGCM(key).decrypt(nonce, ct, None).decode()
+            except Exception as e:  # try older keys on rotation
+                last = e
+        raise ValueError(f"cannot decrypt value: {last}")
+    return stored  # legacy/plaintext row

@@ -1,0 +1,174 @@
+"""Fleet CRUD: cloud fleets (N instances) and SSH fleets (user hosts).
+
+Parity: reference server/services/fleets.py (``get_plan:231``,
+``create_fleet:310``, ``create_fleet_instance_model:383``,
+``create_fleet_ssh_instance_model:409``).
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.errors import ClientError, ResourceNotExistsError
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.configurations import FleetConfiguration
+from dstack_tpu.core.models.fleets import Fleet, FleetSpec, FleetStatus
+from dstack_tpu.core.models.instances import (
+    InstanceOfferWithAvailability,
+    InstanceStatus,
+    RemoteConnectionInfo,
+)
+from dstack_tpu.core.models.runs import Requirements, new_uuid, now_utc
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import instances as instances_service
+from dstack_tpu.server.services.instances import instance_row_to_model
+from dstack_tpu.server.services.offers import get_offers_by_requirements
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.fleets")
+
+
+async def fleet_row_to_model(db: Database, row: dict, project_name: str) -> Fleet:
+    inst_rows = await db.fetchall(
+        "SELECT * FROM instances WHERE fleet_id = ? AND deleted = 0", (row["id"],)
+    )
+    spec_raw = loads(row["spec"]) or {}
+    spec = FleetSpec(
+        configuration=FleetConfiguration.model_validate(
+            spec_raw.get("configuration", {"type": "fleet", "nodes": 1})
+        ),
+        autocreated=bool(spec_raw.get("autocreated") or row.get("autocreated")),
+    )
+    from datetime import datetime
+
+    return Fleet(
+        id=row["id"],
+        name=row["name"],
+        project_name=project_name,
+        spec=spec,
+        created_at=datetime.fromisoformat(row["created_at"]),
+        status=FleetStatus(row["status"]),
+        status_message=row.get("status_message"),
+        instances=[
+            instance_row_to_model(r, project_name, row["name"]) for r in inst_rows
+        ],
+    )
+
+
+async def list_fleets(db: Database, project_row: dict) -> list[Fleet]:
+    rows = await db.fetchall(
+        "SELECT * FROM fleets WHERE project_id = ? AND deleted = 0 ORDER BY created_at DESC",
+        (project_row["id"],),
+    )
+    return [await fleet_row_to_model(db, r, project_row["name"]) for r in rows]
+
+
+async def apply_fleet(
+    db: Database, project_row: dict, user_row: dict, conf: FleetConfiguration
+) -> Fleet:
+    name = conf.name or f"fleet-{new_uuid()[:8]}"
+    existing = await db.fetchone(
+        "SELECT id FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_row["id"], name),
+    )
+    if existing is not None:
+        raise ClientError(f"fleet {name} already exists")
+    fleet_id = new_uuid()
+    await db.insert(
+        "fleets",
+        {
+            "id": fleet_id,
+            "project_id": project_row["id"],
+            "name": name,
+            "status": FleetStatus.ACTIVE.value,
+            "spec": dumps({"configuration": conf.model_dump(), "autocreated": False}),
+            "autocreated": 0,
+            "created_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    if conf.ssh_config is not None:
+        # SSH fleet: one instance row per user-supplied host, adopted by
+        # process_instances via the remote backend
+        for num, host in enumerate(conf.ssh_config.hosts):
+            rci = RemoteConnectionInfo(
+                host=host.hostname,
+                port=host.port or conf.ssh_config.port,
+                ssh_user=host.user or conf.ssh_config.user or "root",
+            )
+            row = {
+                "id": new_uuid(),
+                "project_id": project_row["id"],
+                "fleet_id": fleet_id,
+                "instance_num": num,
+                "name": f"{name}-{num}",
+                "status": InstanceStatus.PENDING.value,
+                "backend": BackendType.REMOTE.value,
+                "region": "remote",
+                "price": 0.0,
+                "remote_connection_info": dumps(rci),
+                "total_blocks": host.blocks,
+                "busy_blocks": 0,
+                "deleted": 0,
+                "created_at": now_utc().isoformat(),
+                "last_processed_at": now_utc().isoformat(),
+            }
+            await db.insert("instances", row)
+    elif conf.nodes is not None:
+        # cloud fleet: pre-provision min nodes
+        requirements = Requirements(resources=conf.resources)
+        project_backends = await backends_service.get_project_backends(db, project_row)
+        offers = await get_offers_by_requirements(
+            project_backends, requirements, multinode=True
+        )
+        n = conf.nodes.min or 0
+        if n > 0 and not offers:
+            raise ClientError("no offers match the fleet requirements")
+        for num in range(n):
+            _, offer = offers[0]
+            await instances_service.create_instance_row(
+                db,
+                project_row,
+                name=f"{name}-{num}",
+                offer=offer,
+                fleet_id=fleet_id,
+                instance_num=num,
+                status=InstanceStatus.PENDING,
+            )
+    row = await db.get_by_id("fleets", fleet_id)
+    return await fleet_row_to_model(db, row, project_row["name"])
+
+
+async def delete_fleets(db: Database, project_row: dict, names: list[str]) -> None:
+    for name in names:
+        row = await db.fetchone(
+            "SELECT * FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+            (project_row["id"], name),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"fleet {name} not found")
+        busy = await db.fetchall(
+            "SELECT id FROM instances WHERE fleet_id = ? AND status = ? AND deleted = 0",
+            (row["id"], InstanceStatus.BUSY.value),
+        )
+        if busy:
+            raise ClientError(f"fleet {name} has busy instances")
+        # terminate member instances via process_instances
+        await db.execute(
+            "UPDATE instances SET status = ?, last_processed_at = ? "
+            "WHERE fleet_id = ? AND deleted = 0 AND status != ?",
+            (
+                InstanceStatus.TERMINATING.value,
+                now_utc().isoformat(),
+                row["id"],
+                InstanceStatus.TERMINATED.value,
+            ),
+        )
+        await db.update_by_id(
+            "fleets",
+            row["id"],
+            {
+                "status": FleetStatus.TERMINATING.value,
+                "deleted": 1,
+                "last_processed_at": now_utc().isoformat(),
+            },
+        )

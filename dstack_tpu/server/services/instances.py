@@ -1,0 +1,156 @@
+"""Instance rows and pool matching.
+
+Parity: reference server/services/instances.py
+(``filter_pool_instances:130`` job→instance assignment; multinode
+same-fleet constraint). A TPU slice instance may back N jobs — one per
+worker host — all of the same run.
+"""
+
+from datetime import datetime
+from typing import Optional
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.instances import (
+    Instance,
+    InstanceOfferWithAvailability,
+    InstanceStatus,
+    InstanceType,
+)
+from dstack_tpu.core.models.runs import JobProvisioningData, new_uuid, now_utc
+from dstack_tpu.server.db import Database, dumps, loads
+
+
+from dstack_tpu.utils.common import parse_dt as _dt  # noqa: E402
+
+
+def instance_row_to_model(row: dict, project_name: str = "", fleet_name: Optional[str] = None) -> Instance:
+    offer = loads(row.get("offer"))
+    itype = None
+    if offer:
+        itype = InstanceType.model_validate(offer["instance"])
+    jpd = loads(row.get("job_provisioning_data"))
+    return Instance(
+        id=row["id"],
+        project_name=project_name,
+        backend=BackendType(row["backend"]) if row.get("backend") else None,
+        instance_type=itype,
+        name=row["name"],
+        fleet_id=row.get("fleet_id"),
+        fleet_name=fleet_name,
+        instance_num=row.get("instance_num", 0),
+        hostname=(jpd or {}).get("hostname"),
+        status=InstanceStatus(row["status"]),
+        unreachable=bool(row.get("unreachable")),
+        termination_reason=row.get("termination_reason"),
+        created=row.get("created_at"),
+        region=row.get("region"),
+        availability_zone=row.get("availability_zone"),
+        price=row.get("price"),
+        total_blocks=row.get("total_blocks", 1),
+        busy_blocks=row.get("busy_blocks", 0),
+    )
+
+
+async def create_instance_row(
+    db: Database,
+    project_row: dict,
+    name: str,
+    offer: InstanceOfferWithAvailability,
+    fleet_id: Optional[str] = None,
+    instance_num: int = 0,
+    status: InstanceStatus = InstanceStatus.PENDING,
+    jpd: Optional[JobProvisioningData] = None,
+    instance_config: Optional[dict] = None,
+    termination_idle_time: int = 300,
+) -> dict:
+    row = {
+        "id": new_uuid(),
+        "project_id": project_row["id"],
+        "fleet_id": fleet_id,
+        "instance_num": instance_num,
+        "name": name,
+        "status": status.value,
+        "backend": offer.backend.value,
+        "region": offer.region,
+        "price": offer.price,
+        "offer": dumps(offer),
+        "instance_configuration": dumps(instance_config or {}),
+        "job_provisioning_data": dumps(jpd) if jpd else None,
+        "termination_idle_time": termination_idle_time,
+        "total_blocks": 1,
+        "busy_blocks": 0,
+        "deleted": 0,
+        "created_at": now_utc().isoformat(),
+        "last_processed_at": now_utc().isoformat(),
+    }
+    await db.insert("instances", row)
+    return row
+
+
+async def get_pool_instances(
+    db: Database, project_row: dict, status: Optional[InstanceStatus] = None
+) -> list[dict]:
+    sql = "SELECT * FROM instances WHERE project_id = ? AND deleted = 0"
+    params: list = [project_row["id"]]
+    if status is not None:
+        sql += " AND status = ?"
+        params.append(status.value)
+    return await db.fetchall(sql, params)
+
+
+def filter_pool_instances(
+    rows: list[dict],
+    offer_backend: Optional[BackendType] = None,
+    fleet_id: Optional[str] = None,
+    requirements=None,
+) -> list[dict]:
+    """Idle instances matching the job (reference instances.py:130)."""
+    out = []
+    for row in rows:
+        if row["status"] != InstanceStatus.IDLE.value:
+            continue
+        if row.get("unreachable"):
+            continue
+        if offer_backend is not None and row.get("backend") != offer_backend.value:
+            continue
+        if fleet_id is not None and row.get("fleet_id") != fleet_id:
+            continue
+        if requirements is not None:
+            offer = loads(row.get("offer"))
+            if offer is None:
+                continue
+            res = offer["instance"]["resources"]
+            spec = requirements.resources
+            if spec.cpu.count.min is not None and res["cpus"] < spec.cpu.count.min:
+                continue
+            if spec.memory.min is not None and res["memory_mib"] / 1024 < spec.memory.min:
+                continue
+            tpu = res.get("tpu")
+            if spec.tpu is not None:
+                if tpu is None:
+                    continue
+                if spec.tpu.version is not None and tpu["version"] not in spec.tpu.version:
+                    continue
+                if not spec.tpu.chips.contains(tpu["chips"]):
+                    continue
+                if spec.tpu.topology is not None and tpu["topology"] != spec.tpu.topology:
+                    continue
+            elif tpu is not None:
+                continue  # don't waste TPU slices on CPU jobs
+        out.append(row)
+    out.sort(key=lambda r: r.get("price") or 0.0)
+    return out
+
+
+async def mark_instance(
+    db: Database, instance_id: str, status: InstanceStatus, **fields
+) -> None:
+    await db.update_by_id(
+        "instances",
+        instance_id,
+        {
+            "status": status.value,
+            "last_processed_at": now_utc().isoformat(),
+            **fields,
+        },
+    )

@@ -1,0 +1,139 @@
+"""Job row ↔ model conversion and job termination.
+
+Parity: reference server/services/jobs/__init__.py
+(``job_model_to_job_submission:110``, ``process_terminating_job:209``).
+"""
+
+from datetime import datetime
+from typing import Optional
+
+from dstack_tpu.core.models.runs import (
+    Job,
+    JobProvisioningData,
+    JobRuntimeData,
+    JobSpec,
+    JobStatus,
+    JobSubmission,
+    JobTerminationReason,
+    new_uuid,
+    now_utc,
+)
+from dstack_tpu.server.db import Database, dumps, loads
+
+
+from dstack_tpu.utils.common import parse_dt as _dt  # noqa: E402
+
+
+def job_row_to_submission(row: dict) -> JobSubmission:
+    jpd = loads(row.get("job_provisioning_data"))
+    jrd = loads(row.get("job_runtime_data"))
+    return JobSubmission(
+        id=row["id"],
+        submission_num=row["submission_num"],
+        submitted_at=_dt(row["submitted_at"]) or now_utc(),
+        last_processed_at=_dt(row.get("last_processed_at")),
+        finished_at=_dt(row.get("finished_at")),
+        status=JobStatus(row["status"]),
+        termination_reason=(
+            JobTerminationReason(row["termination_reason"])
+            if row.get("termination_reason")
+            else None
+        ),
+        termination_reason_message=row.get("termination_reason_message"),
+        exit_status=row.get("exit_status"),
+        job_provisioning_data=(
+            JobProvisioningData.model_validate(jpd) if jpd else None
+        ),
+        job_runtime_data=JobRuntimeData.model_validate(jrd) if jrd else None,
+    )
+
+
+async def job_rows_to_jobs(db: Database, run_id: str) -> list[Job]:
+    """Group submissions by (replica_num, job_num) into Job models."""
+    rows = await db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? "
+        "ORDER BY replica_num, job_num, submission_num",
+        (run_id,),
+    )
+    jobs: dict[tuple[int, int], Job] = {}
+    for row in rows:
+        key = (row["replica_num"], row["job_num"])
+        if key not in jobs:
+            jobs[key] = Job(
+                job_spec=JobSpec.model_validate(loads(row["job_spec"])),
+                job_submissions=[],
+            )
+        else:
+            # later submission carries the freshest spec
+            jobs[key].job_spec = JobSpec.model_validate(loads(row["job_spec"]))
+        jobs[key].job_submissions.append(job_row_to_submission(row))
+    return [jobs[k] for k in sorted(jobs)]
+
+
+async def create_job_row(
+    db: Database,
+    run_row: dict,
+    job_spec: JobSpec,
+    submission_num: int = 0,
+) -> dict:
+    row = {
+        "id": new_uuid(),
+        "run_id": run_row["id"],
+        "run_name": run_row["run_name"],
+        "project_id": run_row["project_id"],
+        "job_num": job_spec.job_num,
+        "replica_num": job_spec.replica_num,
+        "submission_num": submission_num,
+        "job_name": job_spec.job_name,
+        "status": JobStatus.SUBMITTED.value,
+        "job_spec": dumps(job_spec),
+        "instance_assigned": 0,
+        "submitted_at": now_utc().isoformat(),
+        "last_processed_at": now_utc().isoformat(),
+    }
+    await db.insert("jobs", row)
+    return row
+
+
+async def update_job_status(
+    db: Database,
+    job_id: str,
+    status: JobStatus,
+    termination_reason: Optional[JobTerminationReason] = None,
+    termination_reason_message: Optional[str] = None,
+    exit_status: Optional[int] = None,
+) -> None:
+    fields: dict = {
+        "status": status.value,
+        "last_processed_at": now_utc().isoformat(),
+    }
+    if termination_reason is not None:
+        fields["termination_reason"] = termination_reason.value
+    if termination_reason_message is not None:
+        fields["termination_reason_message"] = termination_reason_message
+    if exit_status is not None:
+        fields["exit_status"] = exit_status
+    if status.is_finished():
+        fields["finished_at"] = now_utc().isoformat()
+    await db.update_by_id("jobs", job_id, fields)
+
+
+async def get_unfinished_job_rows(db: Database, run_id: str) -> list[dict]:
+    finished = tuple(s.value for s in JobStatus.finished_statuses())
+    return await db.fetchall(
+        f"SELECT * FROM jobs WHERE run_id = ? AND status NOT IN "
+        f"({','.join('?' for _ in finished)})",
+        (run_id, *finished),
+    )
+
+
+async def latest_job_rows_for_run(db: Database, run_id: str) -> list[dict]:
+    """The newest submission row per (replica_num, job_num)."""
+    return await db.fetchall(
+        "SELECT j.* FROM jobs j JOIN ("
+        "  SELECT replica_num, job_num, MAX(submission_num) AS sn"
+        "  FROM jobs WHERE run_id = ? GROUP BY replica_num, job_num"
+        ") m ON j.replica_num = m.replica_num AND j.job_num = m.job_num "
+        "AND j.submission_num = m.sn WHERE j.run_id = ?",
+        (run_id, run_id),
+    )

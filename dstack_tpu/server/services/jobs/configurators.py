@@ -1,0 +1,139 @@
+"""JobSpec factories per run-configuration type.
+
+Parity: reference server/services/jobs/configurators/ (``JobConfigurator``
+ABC base.py:58-255; ``TaskJobConfigurator`` emits one JobSpec per node,
+task.py:12-21; per-replica SSH keypair for inter-node SSH,
+base.py:246-255).
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.models.configurations import (
+    DevEnvironmentConfiguration,
+    ServiceConfiguration,
+    TaskConfiguration,
+)
+from dstack_tpu.core.models.profiles import resolve_retry
+from dstack_tpu.core.models.runs import (
+    AppSpec,
+    JobSSHKey,
+    JobSpec,
+    Requirements,
+    Retry,
+    RunSpec,
+)
+from dstack_tpu.server.services.offers import requirements_from_run_spec
+from dstack_tpu.utils.crypto import generate_rsa_key_pair_bytes
+
+DEFAULT_IMAGE = "python:3.12-slim"  # TPU jobs usually set their own image
+
+
+def _base_spec(
+    run_spec: RunSpec,
+    job_name: str,
+    replica_num: int,
+    job_num: int,
+    jobs_per_replica: int,
+    ssh_key: Optional[JobSSHKey],
+    commands: list[str],
+    app_specs: Optional[list[AppSpec]] = None,
+    service_port: Optional[int] = None,
+) -> JobSpec:
+    conf = run_spec.configuration
+    profile = run_spec.effective_profile()
+    retry = resolve_retry(profile.retry)
+    return JobSpec(
+        replica_num=replica_num,
+        job_num=job_num,
+        job_name=job_name,
+        jobs_per_replica=jobs_per_replica,
+        app_specs=app_specs or [],
+        commands=commands,
+        env=conf.env.as_dict(),
+        home_dir=conf.home_dir,
+        image_name=conf.image or DEFAULT_IMAGE,
+        privileged=conf.privileged,
+        pjrt_device="TPU" if conf.resources.tpu is not None else None,
+        registry_auth=conf.registry_auth,
+        requirements=requirements_from_run_spec(run_spec),
+        retry=(
+            Retry(
+                on_events=[e.value for e in retry.on_events],
+                duration=retry.duration,
+            )
+            if retry is not None
+            else None
+        ),
+        max_duration=(
+            profile.max_duration if isinstance(profile.max_duration, int) and profile.max_duration > 0 else None
+        ),
+        stop_duration=(
+            profile.stop_duration if isinstance(profile.stop_duration, int) and profile.stop_duration > 0 else 300
+        ),
+        utilization_policy=profile.utilization_policy,
+        working_dir=conf.working_dir,
+        ssh_key=ssh_key,
+        service_port=service_port,
+    )
+
+
+def get_job_specs_from_run_spec(run_spec: RunSpec, replica_num: int = 0) -> list[JobSpec]:
+    """One replica's JobSpecs (reference jobs/__init__.py:68)."""
+    conf = run_spec.configuration
+    run_name = run_spec.run_name or "run"
+    if isinstance(conf, TaskConfiguration):
+        nodes = conf.nodes
+        ssh_key = None
+        if nodes > 1:
+            private, public = generate_rsa_key_pair_bytes(f"{run_name}-internode")
+            ssh_key = JobSSHKey(private=private, public=public)
+        return [
+            _base_spec(
+                run_spec,
+                job_name=f"{run_name}-{replica_num}-{job_num}",
+                replica_num=replica_num,
+                job_num=job_num,
+                jobs_per_replica=nodes,
+                ssh_key=ssh_key,
+                commands=list(conf.commands),
+                app_specs=[
+                    AppSpec(port=p.container_port, map_to_port=p.local_port, app_name=f"app{i}")
+                    for i, p in enumerate(conf.ports)
+                ],
+            )
+            for job_num in range(nodes)
+        ]
+    if isinstance(conf, ServiceConfiguration):
+        return [
+            _base_spec(
+                run_spec,
+                job_name=f"{run_name}-{replica_num}-0",
+                replica_num=replica_num,
+                job_num=0,
+                jobs_per_replica=1,
+                ssh_key=None,
+                commands=list(conf.commands),
+                service_port=conf.port.container_port,
+                app_specs=[
+                    AppSpec(
+                        port=conf.port.container_port,
+                        map_to_port=conf.port.local_port,
+                        app_name="service",
+                    )
+                ],
+            )
+        ]
+    if isinstance(conf, DevEnvironmentConfiguration):
+        commands = list(conf.init) + ["tail -f /dev/null"]
+        return [
+            _base_spec(
+                run_spec,
+                job_name=f"{run_name}-{replica_num}-0",
+                replica_num=replica_num,
+                job_num=0,
+                jobs_per_replica=1,
+                ssh_key=None,
+                commands=commands,
+            )
+        ]
+    raise ValueError(f"unsupported configuration type {type(conf)}")

@@ -1,0 +1,94 @@
+"""In-process resource locking.
+
+Parity: reference server/services/locking.py:13-81 (``ResourceLocker``
+locksets + sorted-key deadlock avoidance). The single-process asyncio
+server holds row claims in memory — the sqlite analog of Postgres
+``FOR UPDATE SKIP LOCKED``: reconcilers atomically claim ids out of a
+shared set and release them after commit.
+"""
+
+import asyncio
+from contextlib import asynccontextmanager
+from typing import Hashable, Iterable
+
+
+class LockSet:
+    """A named set of locked keys with async waiting."""
+
+    def __init__(self) -> None:
+        self._locked: set[Hashable] = set()
+        self._cond = asyncio.Condition()
+
+    async def acquire(self, keys: Iterable[Hashable]) -> list[Hashable]:
+        # sorted acquisition order prevents lock-order deadlocks
+        # (reference locking.py:25-35)
+        keys = sorted(set(keys), key=str)
+        async with self._cond:
+            await self._cond.wait_for(
+                lambda: not any(k in self._locked for k in keys)
+            )
+            self._locked.update(keys)
+        return keys
+
+    def try_claim(self, keys: Iterable[Hashable]) -> list[Hashable]:
+        """Non-blocking SKIP-LOCKED-style claim: returns the subset of
+        ``keys`` that were free and are now claimed."""
+        got = []
+        for k in keys:
+            if k not in self._locked:
+                self._locked.add(k)
+                got.append(k)
+        return got
+
+    async def release(self, keys: Iterable[Hashable]) -> None:
+        async with self._cond:
+            self._locked.difference_update(keys)
+            self._cond.notify_all()
+
+    def locked(self) -> set[Hashable]:
+        return set(self._locked)
+
+
+class ResourceLocker:
+    def __init__(self) -> None:
+        self._sets: dict[str, LockSet] = {}
+
+    def namespace(self, name: str) -> LockSet:
+        if name not in self._sets:
+            self._sets[name] = LockSet()
+        return self._sets[name]
+
+    @asynccontextmanager
+    async def lock_ctx(self, namespace: str, keys: Iterable[Hashable]):
+        ls = self.namespace(namespace)
+        acquired = await ls.acquire(keys)
+        try:
+            yield
+        finally:
+            await ls.release(acquired)
+
+
+_locker = ResourceLocker()
+
+
+def get_locker() -> ResourceLocker:
+    return _locker
+
+
+@asynccontextmanager
+async def claim_one(namespace: str, candidates: list[Hashable]):
+    """Claim the first free candidate (reconciler queue pop).
+
+    Yields the claimed key or None.
+    """
+    ls = get_locker().namespace(namespace)
+    claimed: list[Hashable] = []
+    for k in candidates:
+        claimed = ls.try_claim([k])
+        if claimed:
+            break
+    try:
+        yield claimed[0] if claimed else None
+    finally:
+        if claimed:
+            await ls.release(claimed)

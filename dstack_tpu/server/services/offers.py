@@ -1,0 +1,88 @@
+"""Offer aggregation and filtering across backends.
+
+Parity: reference server/services/offers.py (merge backend offers,
+filter by profile backends/regions/AZ/instance types/max_price,
+multinode-capable backends only for cluster runs; TPUs are never
+divisible into blocks — reference offers.py:129-131).
+"""
+
+from typing import Optional, Sequence
+
+from dstack_tpu.backends.base.compute import Compute, ComputeWithMultinodeSupport
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.instances import InstanceOfferWithAvailability
+from dstack_tpu.core.models.profiles import Profile, SpotPolicy
+from dstack_tpu.core.models.runs import Requirements
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.offers")
+
+
+async def get_offers_by_requirements(
+    backends: Sequence[tuple[BackendType, Compute]],
+    requirements: Requirements,
+    profile: Optional[Profile] = None,
+    multinode: bool = False,
+) -> list[tuple[BackendType, InstanceOfferWithAvailability]]:
+    profile = profile or Profile(name="default")
+    offers: list[tuple[BackendType, InstanceOfferWithAvailability]] = []
+    for btype, compute in backends:
+        if profile.backends is not None and btype not in profile.backends:
+            continue
+        if multinode and not isinstance(compute, ComputeWithMultinodeSupport):
+            continue
+        try:
+            backend_offers = await compute.get_offers(requirements)
+        except Exception:
+            logger.exception("get_offers failed for backend %s", btype.value)
+            continue
+        for offer in backend_offers:
+            if not _offer_matches(offer, requirements, profile):
+                continue
+            offers.append((btype, offer))
+    offers.sort(key=lambda bo: (bo[1].price, bo[1].instance.name))
+    return offers
+
+
+def _offer_matches(
+    offer: InstanceOfferWithAvailability,
+    requirements: Requirements,
+    profile: Profile,
+) -> bool:
+    if profile.regions is not None and offer.region not in profile.regions:
+        return False
+    if (
+        profile.availability_zones is not None
+        and offer.availability_zones is not None
+        and not set(offer.availability_zones) & set(profile.availability_zones)
+    ):
+        return False
+    if (
+        profile.instance_types is not None
+        and offer.instance.name not in profile.instance_types
+    ):
+        return False
+    max_price = requirements.max_price or profile.max_price
+    if max_price is not None and offer.price > max_price:
+        return False
+    spot_policy = profile.spot_policy or SpotPolicy.ONDEMAND
+    if spot_policy == SpotPolicy.SPOT and not offer.instance.resources.spot:
+        return False
+    if spot_policy == SpotPolicy.ONDEMAND and offer.instance.resources.spot:
+        return False
+    return True
+
+
+def requirements_from_run_spec(run_spec) -> Requirements:
+    profile = run_spec.effective_profile()
+    spot = None
+    if profile.spot_policy == SpotPolicy.SPOT:
+        spot = True
+    elif profile.spot_policy in (SpotPolicy.ONDEMAND, None):
+        spot = False
+    return Requirements(
+        resources=run_spec.configuration.resources,
+        max_price=profile.max_price,
+        spot=spot,
+        reservation=profile.reservation,
+    )

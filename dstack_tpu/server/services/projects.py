@@ -1,0 +1,168 @@
+"""Project (multi-tenancy) management and permission checks.
+
+Parity: reference server/services/projects.py + permissions.py.
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.errors import (
+    ForbiddenError,
+    ResourceExistsError,
+    ResourceNotExistsError,
+)
+from dstack_tpu.core.models.projects import Member, Project
+from dstack_tpu.core.models.runs import new_uuid, now_utc
+from dstack_tpu.core.models.users import GlobalRole, ProjectRole
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.services.users import user_row_to_model
+
+
+import re
+
+PROJECT_NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9._-]{0,50}$")
+
+
+async def create_project(db: Database, user_row: dict, name: str, is_public: bool = False) -> Project:
+    from dstack_tpu.core.errors import ClientError
+
+    if PROJECT_NAME_RE.match(name) is None:
+        raise ClientError(f"invalid project name {name!r}")
+    existing = await db.fetchone(
+        "SELECT id FROM projects WHERE name = ? AND deleted = 0", (name,)
+    )
+    if existing is not None:
+        raise ResourceExistsError(f"project {name} already exists")
+    project_id = new_uuid()
+    await db.insert(
+        "projects",
+        {
+            "id": project_id,
+            "name": name,
+            "owner_id": user_row["id"],
+            "is_public": int(is_public),
+            "deleted": 0,
+            "created_at": now_utc().isoformat(),
+        },
+    )
+    await db.insert(
+        "members",
+        {
+            "id": new_uuid(),
+            "project_id": project_id,
+            "user_id": user_row["id"],
+            "project_role": ProjectRole.ADMIN.value,
+        },
+    )
+    return await get_project(db, name)
+
+
+async def get_project_row(db: Database, name: str) -> Optional[dict]:
+    return await db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (name,)
+    )
+
+
+async def get_project_row_or_error(db: Database, name: str) -> dict:
+    row = await get_project_row(db, name)
+    if row is None:
+        raise ResourceNotExistsError(f"project {name} not found")
+    return row
+
+
+async def get_project(db: Database, name: str) -> Project:
+    row = await get_project_row_or_error(db, name)
+    members = await list_members(db, row["id"])
+    owner_row = await db.get_by_id("users", row["owner_id"])
+    return Project(
+        id=row["id"],
+        project_name=row["name"],
+        owner=user_row_to_model(owner_row),
+        created_at=row["created_at"],
+        members=members,
+        is_public=bool(row["is_public"]),
+    )
+
+
+async def list_projects_for_user(db: Database, user_row: dict) -> list[Project]:
+    if user_row["global_role"] == GlobalRole.ADMIN.value:
+        rows = await db.fetchall("SELECT name FROM projects WHERE deleted = 0")
+    else:
+        rows = await db.fetchall(
+            "SELECT p.name AS name FROM projects p "
+            "JOIN members m ON m.project_id = p.id "
+            "WHERE m.user_id = ? AND p.deleted = 0",
+            (user_row["id"],),
+        )
+    return [await get_project(db, r["name"]) for r in rows]
+
+
+async def delete_projects(db: Database, user_row: dict, names: list[str]) -> None:
+    for name in names:
+        row = await get_project_row_or_error(db, name)
+        role = await get_member_role(db, row["id"], user_row["id"])
+        if (
+            user_row["global_role"] != GlobalRole.ADMIN.value
+            and role != ProjectRole.ADMIN
+        ):
+            raise ForbiddenError(f"not an admin of project {name}")
+        await db.execute("UPDATE projects SET deleted = 1 WHERE id = ?", (row["id"],))
+
+
+async def list_members(db: Database, project_id: str) -> list[Member]:
+    rows = await db.fetchall(
+        "SELECT u.*, m.project_role AS project_role FROM members m "
+        "JOIN users u ON u.id = m.user_id WHERE m.project_id = ?",
+        (project_id,),
+    )
+    return [
+        Member(user=user_row_to_model(r), project_role=ProjectRole(r["project_role"]))
+        for r in rows
+    ]
+
+
+async def get_member_role(
+    db: Database, project_id: str, user_id: str
+) -> Optional[ProjectRole]:
+    row = await db.fetchone(
+        "SELECT project_role FROM members WHERE project_id = ? AND user_id = ?",
+        (project_id, user_id),
+    )
+    return ProjectRole(row["project_role"]) if row else None
+
+
+async def set_members(
+    db: Database, project_id: str, members: list[tuple[str, ProjectRole]]
+) -> None:
+    """members: list of (username, role)."""
+    await db.execute("DELETE FROM members WHERE project_id = ?", (project_id,))
+    for username, role in members:
+        user = await db.fetchone("SELECT id FROM users WHERE username = ?", (username,))
+        if user is None:
+            raise ResourceNotExistsError(f"user {username} not found")
+        await db.insert(
+            "members",
+            {
+                "id": new_uuid(),
+                "project_id": project_id,
+                "user_id": user["id"],
+                "project_role": role.value,
+            },
+        )
+
+
+async def check_project_access(
+    db: Database, project_row: dict, user_row: dict, require_role: Optional[ProjectRole] = None
+) -> None:
+    """Raises ForbiddenError unless the user may access the project."""
+    if user_row["global_role"] == GlobalRole.ADMIN.value:
+        return
+    role = await get_member_role(db, project_row["id"], user_row["id"])
+    if role is None and not project_row["is_public"]:
+        raise ForbiddenError("no access to project")
+    if require_role == ProjectRole.ADMIN and role != ProjectRole.ADMIN:
+        raise ForbiddenError("project admin role required")
+    if require_role == ProjectRole.MANAGER and role not in (
+        ProjectRole.ADMIN,
+        ProjectRole.MANAGER,
+    ):
+        raise ForbiddenError("project manager role required")

@@ -1,0 +1,264 @@
+"""Run lifecycle: plan → apply/submit → stop/delete.
+
+Parity: reference server/services/runs.py (``get_plan:273``,
+``apply_plan:363``, ``submit_run:421``, ``stop_runs:520``,
+``scale_run_replicas:957``).
+"""
+
+from datetime import datetime
+from typing import Optional
+
+from dstack_tpu.core.errors import (
+    ClientError,
+    ResourceExistsError,
+    ResourceNotExistsError,
+)
+from dstack_tpu.core.models.configurations import ServiceConfiguration, TaskConfiguration
+from dstack_tpu.core.models.runs import (
+    Job,
+    JobPlan,
+    JobStatus,
+    JobTerminationReason,
+    Run,
+    RunPlan,
+    RunSpec,
+    RunStatus,
+    RunTerminationReason,
+    ServiceSpec,
+    generate_run_name,
+    new_uuid,
+    now_utc,
+)
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import jobs as jobs_service
+from dstack_tpu.server.services.jobs.configurators import get_job_specs_from_run_spec
+from dstack_tpu.server.services.offers import (
+    get_offers_by_requirements,
+    requirements_from_run_spec,
+)
+from dstack_tpu.server.services.users import user_row_to_model
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.runs")
+
+
+from dstack_tpu.utils.common import parse_dt as _dt  # noqa: E402
+
+
+async def run_row_to_run(db: Database, row: dict) -> Run:
+    jobs = await jobs_service.job_rows_to_jobs(db, row["id"])
+    user_row = await db.get_by_id("users", row["user_id"])
+    service_spec = loads(row.get("service_spec"))
+    run = Run(
+        id=row["id"],
+        project_name=row["project_name"] if "project_name" in row else "",
+        user=user_row["username"] if user_row else "",
+        submitted_at=_dt(row["submitted_at"]) or now_utc(),
+        last_processed_at=_dt(row.get("last_processed_at")),
+        status=RunStatus(row["status"]),
+        termination_reason=(
+            RunTerminationReason(row["termination_reason"])
+            if row.get("termination_reason")
+            else None
+        ),
+        run_spec=RunSpec.model_validate(loads(row["run_spec"])),
+        jobs=jobs,
+        service=ServiceSpec.model_validate(service_spec) if service_spec else None,
+        deleted=bool(row["deleted"]),
+    )
+    if not run.project_name:
+        proj = await db.get_by_id("projects", row["project_id"])
+        run.project_name = proj["name"] if proj else ""
+    return run
+
+
+async def get_run_row(
+    db: Database, project_row: dict, run_name: str
+) -> Optional[dict]:
+    return await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project_row["id"], run_name),
+    )
+
+
+async def get_plan(
+    db: Database, project_row: dict, user_row: dict, run_spec: RunSpec
+) -> RunPlan:
+    run_spec = _prepare_run_spec(run_spec)
+    multinode = (
+        isinstance(run_spec.configuration, TaskConfiguration)
+        and run_spec.configuration.nodes > 1
+    ) or (
+        run_spec.configuration.resources.tpu is not None
+    )
+    project_backends = await backends_service.get_project_backends(db, project_row)
+    offers = await get_offers_by_requirements(
+        project_backends,
+        requirements_from_run_spec(run_spec),
+        run_spec.effective_profile(),
+        multinode=multinode,
+    )
+    job_specs = get_job_specs_from_run_spec(run_spec, replica_num=0)
+    job_plans = [
+        JobPlan(
+            job_spec=spec,
+            offers=[o for _, o in offers[:50]],
+            total_offers=len(offers),
+            max_price=max((o.price for _, o in offers), default=None),
+        )
+        for spec in job_specs
+    ]
+    current = None
+    if run_spec.run_name:
+        row = await get_run_row(db, project_row, run_spec.run_name)
+        if row is not None:
+            current = await run_row_to_run(db, row)
+    return RunPlan(
+        project_name=project_row["name"],
+        user=user_row["username"],
+        run_spec=run_spec,
+        job_plans=job_plans,
+        current_resource=current,
+        action="update" if current is not None else "create",
+    )
+
+
+def _prepare_run_spec(run_spec: RunSpec) -> RunSpec:
+    from dstack_tpu.core.models.configurations import RUN_NAME_RE
+
+    if run_spec.run_name is None:
+        run_spec = run_spec.model_copy()
+        run_spec.run_name = (
+            run_spec.configuration.name or generate_run_name()
+        )
+    if RUN_NAME_RE.match(run_spec.run_name) is None:
+        raise ClientError(
+            f"invalid run name {run_spec.run_name!r}: must match {RUN_NAME_RE.pattern}"
+        )
+    return run_spec
+
+
+def _desired_replica_count(run_spec: RunSpec) -> int:
+    conf = run_spec.configuration
+    if isinstance(conf, ServiceConfiguration):
+        return conf.replicas.min or 1
+    return 1
+
+
+async def submit_run(
+    db: Database, project_row: dict, user_row: dict, run_spec: RunSpec
+) -> Run:
+    run_spec = _prepare_run_spec(run_spec)
+    existing = await get_run_row(db, project_row, run_spec.run_name)
+    if existing is not None:
+        if RunStatus(existing["status"]).is_finished():
+            # resubmission replaces the finished run (soft-delete old)
+            await db.execute(
+                "UPDATE runs SET deleted = 1 WHERE id = ?", (existing["id"],)
+            )
+        else:
+            raise ResourceExistsError(
+                f"run {run_spec.run_name} already exists and is active"
+            )
+    run_row = {
+        "id": new_uuid(),
+        "project_id": project_row["id"],
+        "user_id": user_row["id"],
+        "run_name": run_spec.run_name,
+        "status": RunStatus.SUBMITTED.value,
+        "run_spec": dumps(run_spec),
+        "desired_replica_count": _desired_replica_count(run_spec),
+        "deleted": 0,
+        "submitted_at": now_utc().isoformat(),
+        "last_processed_at": now_utc().isoformat(),
+    }
+    await db.insert("runs", run_row)
+    # expand replica 0..N-1 into job rows
+    for replica_num in range(run_row["desired_replica_count"]):
+        for spec in get_job_specs_from_run_spec(run_spec, replica_num):
+            await jobs_service.create_job_row(db, run_row, spec)
+    logger.info(
+        "submitted run %s (%d replicas)",
+        run_spec.run_name,
+        run_row["desired_replica_count"],
+    )
+    return await run_row_to_run(db, run_row)
+
+
+async def list_runs(
+    db: Database,
+    project_row: Optional[dict] = None,
+    include_deleted: bool = False,
+    only_active: bool = False,
+) -> list[Run]:
+    sql = "SELECT * FROM runs WHERE 1=1"
+    params: list = []
+    if project_row is not None:
+        sql += " AND project_id = ?"
+        params.append(project_row["id"])
+    if not include_deleted:
+        sql += " AND deleted = 0"
+    if only_active:
+        finished = tuple(s.value for s in RunStatus.finished_statuses())
+        sql += f" AND status NOT IN ({','.join('?' for _ in finished)})"
+        params.extend(finished)
+    sql += " ORDER BY submitted_at DESC"
+    rows = await db.fetchall(sql, params)
+    return [await run_row_to_run(db, r) for r in rows]
+
+
+async def get_run(db: Database, project_row: dict, run_name: str) -> Run:
+    row = await get_run_row(db, project_row, run_name)
+    if row is None:
+        raise ResourceNotExistsError(f"run {run_name} not found")
+    return await run_row_to_run(db, row)
+
+
+async def stop_runs(
+    db: Database, project_row: dict, run_names: list[str], abort: bool = False
+) -> None:
+    for name in run_names:
+        row = await get_run_row(db, project_row, name)
+        if row is None:
+            raise ResourceNotExistsError(f"run {name} not found")
+        status = RunStatus(row["status"])
+        if status.is_finished():
+            continue
+        reason = (
+            RunTerminationReason.ABORTED_BY_USER
+            if abort
+            else RunTerminationReason.STOPPED_BY_USER
+        )
+        await db.update_by_id(
+            "runs",
+            row["id"],
+            {
+                "status": RunStatus.TERMINATING.value,
+                "termination_reason": reason.value,
+                "last_processed_at": now_utc().isoformat(),
+            },
+        )
+        # flag unfinished jobs for the terminating reconciler
+        job_reason = (
+            JobTerminationReason.ABORTED_BY_USER
+            if abort
+            else JobTerminationReason.TERMINATED_BY_USER
+        )
+        for job_row in await jobs_service.get_unfinished_job_rows(db, row["id"]):
+            await jobs_service.update_job_status(
+                db,
+                job_row["id"],
+                JobStatus.TERMINATING,
+                termination_reason=job_reason,
+            )
+
+
+async def delete_runs(db: Database, project_row: dict, run_names: list[str]) -> None:
+    for name in run_names:
+        row = await get_run_row(db, project_row, name)
+        if row is None:
+            raise ResourceNotExistsError(f"run {name} not found")
+        if not RunStatus(row["status"]).is_finished():
+            raise ClientError(f"run {name} is not finished; stop it first")
+        await db.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (row["id"],))

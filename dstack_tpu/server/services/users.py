@@ -1,0 +1,90 @@
+"""User management and token auth.
+
+Parity: reference server/services/users.py.
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.errors import ForbiddenError, ResourceExistsError, UnauthorizedError
+from dstack_tpu.core.models.runs import new_uuid, now_utc
+from dstack_tpu.core.models.users import GlobalRole, User, UserWithCreds
+from dstack_tpu.server.db import Database
+from dstack_tpu.utils.crypto import generate_auth_token
+
+
+def user_row_to_model(row: dict) -> User:
+    return User(
+        id=row["id"],
+        username=row["username"],
+        global_role=GlobalRole(row["global_role"]),
+        email=row.get("email"),
+        active=bool(row["active"]),
+    )
+
+
+async def create_user(
+    db: Database,
+    username: str,
+    global_role: GlobalRole = GlobalRole.USER,
+    email: Optional[str] = None,
+    token: Optional[str] = None,
+) -> UserWithCreds:
+    existing = await db.fetchone("SELECT id FROM users WHERE username = ?", (username,))
+    if existing is not None:
+        raise ResourceExistsError(f"user {username} already exists")
+    token = token or generate_auth_token()
+    row = {
+        "id": new_uuid(),
+        "username": username,
+        "global_role": global_role.value,
+        "email": email,
+        "token": token,
+        "active": 1,
+        "created_at": now_utc().isoformat(),
+    }
+    await db.insert("users", row)
+    return UserWithCreds(**user_row_to_model(row).model_dump(), creds={"token": token})
+
+
+async def get_or_create_admin(db: Database, token: Optional[str] = None) -> UserWithCreds:
+    row = await db.fetchone("SELECT * FROM users WHERE username = 'admin'")
+    if row is not None:
+        if token and row["token"] != token:
+            await db.execute("UPDATE users SET token = ? WHERE id = ?", (token, row["id"]))
+            row["token"] = token
+        return UserWithCreds(
+            **user_row_to_model(row).model_dump(), creds={"token": row["token"]}
+        )
+    return await create_user(db, "admin", GlobalRole.ADMIN, token=token)
+
+
+async def get_user_by_token(db: Database, token: str) -> Optional[dict]:
+    return await db.fetchone(
+        "SELECT * FROM users WHERE token = ? AND active = 1", (token,)
+    )
+
+
+async def get_user_by_name(db: Database, username: str) -> Optional[dict]:
+    return await db.fetchone("SELECT * FROM users WHERE username = ?", (username,))
+
+
+async def list_users(db: Database) -> list[User]:
+    rows = await db.fetchall("SELECT * FROM users ORDER BY username")
+    return [user_row_to_model(r) for r in rows]
+
+
+async def delete_users(db: Database, usernames: list[str]) -> None:
+    for name in usernames:
+        if name == "admin":
+            raise ForbiddenError("cannot delete the admin user")
+        await db.execute("DELETE FROM users WHERE username = ?", (name,))
+
+
+async def refresh_token(db: Database, username: str) -> UserWithCreds:
+    row = await get_user_by_name(db, username)
+    if row is None:
+        raise UnauthorizedError(f"no such user {username}")
+    token = generate_auth_token()
+    await db.execute("UPDATE users SET token = ? WHERE id = ?", (token, row["id"]))
+    row["token"] = token
+    return UserWithCreds(**user_row_to_model(row).model_dump(), creds={"token": token})

@@ -1,0 +1,106 @@
+"""Volume CRUD.
+
+Parity: reference server/services/volumes.py (network volume CRUD +
+external volume registration).
+"""
+
+from datetime import datetime
+from typing import Optional
+
+from dstack_tpu.core.errors import ClientError, ResourceNotExistsError
+from dstack_tpu.core.models.configurations import VolumeConfiguration
+from dstack_tpu.core.models.runs import new_uuid, now_utc
+from dstack_tpu.core.models.volumes import (
+    Volume,
+    VolumeAttachment,
+    VolumeProvisioningData,
+    VolumeStatus,
+)
+from dstack_tpu.server.db import Database, dumps, loads
+
+
+def volume_row_to_model(row: dict, project_name: str, attachments=None) -> Volume:
+    pd = loads(row.get("provisioning_data"))
+    return Volume(
+        id=row["id"],
+        name=row["name"],
+        project_name=project_name,
+        external=bool(row["external"]),
+        created_at=datetime.fromisoformat(row["created_at"]),
+        status=VolumeStatus(row["status"]),
+        status_message=row.get("status_message"),
+        deleted=bool(row["deleted"]),
+        configuration=VolumeConfiguration.model_validate(loads(row["configuration"])),
+        provisioning_data=VolumeProvisioningData.model_validate(pd) if pd else None,
+        attachments=attachments or [],
+    )
+
+
+async def list_volumes(db: Database, project_row: dict) -> list[Volume]:
+    rows = await db.fetchall(
+        "SELECT * FROM volumes WHERE project_id = ? AND deleted = 0",
+        (project_row["id"],),
+    )
+    out = []
+    for row in rows:
+        atts = await db.fetchall(
+            "SELECT * FROM volume_attachments WHERE volume_id = ?", (row["id"],)
+        )
+        out.append(
+            volume_row_to_model(
+                row,
+                project_row["name"],
+                [
+                    VolumeAttachment(
+                        volume_id=a["volume_id"], instance_id=a["instance_id"]
+                    )
+                    for a in atts
+                ],
+            )
+        )
+    return out
+
+
+async def apply_volume(
+    db: Database, project_row: dict, user_row: dict, conf: VolumeConfiguration
+) -> Volume:
+    name = conf.name or f"volume-{new_uuid()[:8]}"
+    existing = await db.fetchone(
+        "SELECT id FROM volumes WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_row["id"], name),
+    )
+    if existing is not None:
+        raise ClientError(f"volume {name} already exists")
+    row = {
+        "id": new_uuid(),
+        "project_id": project_row["id"],
+        "name": name,
+        "status": VolumeStatus.SUBMITTED.value,
+        "configuration": dumps(conf),
+        "external": int(conf.volume_id is not None),
+        "deleted": 0,
+        "created_at": now_utc().isoformat(),
+        "last_processed_at": now_utc().isoformat(),
+    }
+    await db.insert("volumes", row)
+    return volume_row_to_model(row, project_row["name"])
+
+
+async def delete_volumes(db: Database, project_row: dict, names: list[str]) -> None:
+    for name in names:
+        row = await db.fetchone(
+            "SELECT * FROM volumes WHERE project_id = ? AND name = ? AND deleted = 0",
+            (project_row["id"], name),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"volume {name} not found")
+        atts = await db.fetchall(
+            "SELECT id FROM volume_attachments WHERE volume_id = ?", (row["id"],)
+        )
+        if atts:
+            raise ClientError(f"volume {name} is attached; detach first")
+        await db.update_by_id(
+            "volumes",
+            row["id"],
+            {"deleted": 1, "last_processed_at": now_utc().isoformat()},
+        )

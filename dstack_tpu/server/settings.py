@@ -1,0 +1,59 @@
+"""Server settings from environment variables.
+
+Parity: reference src/dstack/_internal/server/settings.py:1-79 (env-var
+tier of the 3-tier config system, SURVEY.md §5).
+"""
+
+import os
+from pathlib import Path
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.getenv(name)
+    return int(v) if v else default
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.getenv(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+SERVER_DIR_PATH = Path(os.getenv("DTPU_SERVER_DIR", "~/.dtpu/server")).expanduser()
+
+# sqlite file (default) or "postgres://..." (gated: asyncpg not bundled)
+DATABASE_URL = os.getenv("DTPU_DATABASE_URL", "")
+
+SERVER_HOST = os.getenv("DTPU_SERVER_HOST", "127.0.0.1")
+SERVER_PORT = _env_int("DTPU_SERVER_PORT", 3000)
+SERVER_URL = os.getenv("DTPU_SERVER_URL", f"http://{SERVER_HOST}:{SERVER_PORT}")
+
+SERVER_ADMIN_TOKEN = os.getenv("DTPU_SERVER_ADMIN_TOKEN")
+
+DEFAULT_PROJECT_NAME = os.getenv("DTPU_DEFAULT_PROJECT", "main")
+
+# Encryption keys for DB-stored credentials (comma-separated, first is
+# active). Empty -> identity (plaintext) encryption.
+ENCRYPTION_KEYS = [k for k in os.getenv("DTPU_ENCRYPTION_KEYS", "").split(",") if k]
+
+# Log storage: "file" (default) | "gcp" (gated on google-cloud-logging)
+LOG_STORAGE = os.getenv("DTPU_LOG_STORAGE", "file")
+LOG_DIR = Path(os.getenv("DTPU_LOG_DIR", str(SERVER_DIR_PATH / "logs"))).expanduser()
+
+ENABLE_PROMETHEUS_METRICS = _env_bool("DTPU_ENABLE_PROMETHEUS_METRICS", True)
+
+# Reconciler capacity tuning. Parity: reference background/__init__.py:44-56
+# (batch sizes sized for ~150 active jobs/runs/instances per replica).
+MAX_PROCESSING_RUNS = _env_int("DTPU_MAX_PROCESSING_RUNS", 15)
+MAX_PROCESSING_JOBS = _env_int("DTPU_MAX_PROCESSING_JOBS", 15)
+MAX_PROCESSING_INSTANCES = _env_int("DTPU_MAX_PROCESSING_INSTANCES", 15)
+MAX_OFFERS_TRIED = _env_int("DTPU_MAX_OFFERS_TRIED", 25)
+
+# Provisioning deadlines (seconds). Parity: process_instances.py:110.
+PROVISIONING_TIMEOUT = _env_int("DTPU_PROVISIONING_TIMEOUT", 600)
+AGENT_WAIT_TIMEOUT = _env_int("DTPU_AGENT_WAIT_TIMEOUT", 600)
+
+SENTRY_DSN = os.getenv("DTPU_SENTRY_DSN")  # gated: sentry-sdk optional
+
+SERVER_CONFIG_PATH = SERVER_DIR_PATH / "config.yml"

@@ -1,0 +1,188 @@
+"""Test factories + fake Compute.
+
+Parity: reference server/testing/common.py:106-975 (factory functions
+for every model + ``ComputeMockSpec``). The FakeCompute provisions
+imaginary instances instantly — multi-host TPU slices included — so
+reconciler loops are testable without a cloud (SURVEY.md §4).
+"""
+
+from typing import Optional
+
+from dstack_tpu.backends.base.compute import (
+    Compute,
+    ComputeWithCreateInstanceSupport,
+    ComputeWithMultinodeSupport,
+)
+from dstack_tpu.core.catalog import CatalogItem
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.configurations import parse_run_configuration
+from dstack_tpu.core.models.instances import (
+    HostMetadata,
+    InstanceAvailability,
+    InstanceConfiguration,
+    InstanceOfferWithAvailability,
+    InstanceType,
+    Resources,
+    TPUInfo,
+)
+from dstack_tpu.core.models.runs import (
+    JobProvisioningData,
+    Requirements,
+    RunSpec,
+)
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.services import projects as projects_service
+from dstack_tpu.server.services import users as users_service
+
+
+async def create_test_db() -> Database:
+    db = Database("sqlite://:memory:")
+    await db.connect()
+    await db.migrate()
+    return db
+
+
+async def create_test_user(db: Database, username: str = "admin"):
+    from dstack_tpu.core.models.users import GlobalRole
+
+    user = await users_service.create_user(
+        db, username, GlobalRole.ADMIN, token=f"token-{username}"
+    )
+    row = await users_service.get_user_by_name(db, username)
+    return user, row
+
+
+async def create_test_project(db: Database, user_row: dict, name: str = "main") -> dict:
+    await projects_service.create_project(db, user_row, name)
+    return await projects_service.get_project_row(db, name)
+
+
+def tpu_offer(
+    version: str = "v5e",
+    chips: int = 8,
+    topology: str = "2x4",
+    hosts: int = 1,
+    region: str = "us-central1",
+    price: float = 9.6,
+    spot: bool = False,
+) -> InstanceOfferWithAvailability:
+    item = CatalogItem(
+        version=version,
+        topology=topology,
+        chips=chips,
+        hosts=hosts,
+        region=region,
+        price=price,
+        spot=spot,
+    )
+    return InstanceOfferWithAvailability(
+        backend=BackendType.GCP,
+        instance=InstanceType(name=item.instance_name, resources=item.resources),
+        region=region,
+        price=price,
+        availability=InstanceAvailability.AVAILABLE,
+    )
+
+
+def cpu_offer(region: str = "us-central1", price: float = 0.5) -> InstanceOfferWithAvailability:
+    return InstanceOfferWithAvailability(
+        backend=BackendType.GCP,
+        instance=InstanceType(
+            name="n2-standard-8",
+            resources=Resources(cpus=8, memory_mib=32 * 1024),
+        ),
+        region=region,
+        price=price,
+        availability=InstanceAvailability.AVAILABLE,
+    )
+
+
+class FakeCompute(Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinodeSupport):
+    """Instantly 'provisions' instances; records calls for assertions."""
+
+    def __init__(
+        self,
+        offers: Optional[list[InstanceOfferWithAvailability]] = None,
+        fail_create: bool = False,
+        delay_ips: bool = False,
+    ):
+        self.offers = offers if offers is not None else [tpu_offer()]
+        self.fail_create = fail_create
+        self.delay_ips = delay_ips
+        self.created: list[InstanceConfiguration] = []
+        self.terminated: list[str] = []
+        self._counter = 0
+
+    async def get_offers(self, requirements: Requirements):
+        res = requirements.resources
+        out = []
+        for o in self.offers:
+            tpu = o.instance.resources.tpu
+            if res.tpu is not None:
+                if tpu is None:
+                    continue
+                if res.tpu.version is not None and tpu.version not in res.tpu.version:
+                    continue
+                if not res.tpu.chips.contains(tpu.chips):
+                    continue
+            out.append(o)
+        return out
+
+    async def create_instance(self, instance_offer, instance_config):
+        if self.fail_create:
+            raise RuntimeError("fake provisioning failure")
+        self.created.append(instance_config)
+        self._counter += 1
+        tpu = instance_offer.instance.resources.tpu
+        hosts = []
+        n_hosts = tpu.hosts if tpu else 1
+        for w in range(n_hosts):
+            hosts.append(
+                HostMetadata(
+                    worker_id=w,
+                    internal_ip=f"10.0.{self._counter}.{w + 1}",
+                    external_ip=f"34.1.{self._counter}.{w + 1}" if w == 0 else None,
+                )
+            )
+        jpd = JobProvisioningData(
+            backend=instance_offer.backend,
+            instance_type=instance_offer.instance,
+            instance_id=f"fake-{self._counter}",
+            hostname=None if self.delay_ips else (hosts[0].external_ip or hosts[0].internal_ip),
+            internal_ip=None if self.delay_ips else hosts[0].internal_ip,
+            region=instance_offer.region,
+            price=instance_offer.price,
+            username="dtpu",
+            ssh_port=22,
+            hosts=[] if self.delay_ips else hosts,
+            backend_data=None,
+        )
+        self._pending_hosts = hosts
+        return jpd
+
+    async def update_provisioning_data(self, provisioning_data):
+        if self.delay_ips and not provisioning_data.ready():
+            hosts = getattr(self, "_pending_hosts", [])
+            provisioning_data.hosts = hosts
+            if hosts:
+                provisioning_data.hostname = hosts[0].external_ip or hosts[0].internal_ip
+                provisioning_data.internal_ip = hosts[0].internal_ip
+        return provisioning_data
+
+    async def terminate_instance(self, instance_id, region, backend_data=None):
+        self.terminated.append(instance_id)
+
+
+def make_run_spec(conf_dict: dict, run_name: Optional[str] = None) -> RunSpec:
+    return RunSpec(
+        run_name=run_name,
+        configuration=parse_run_configuration(conf_dict),
+        ssh_key_pub="ssh-ed25519 AAAA test",
+    )
+
+
+def install_fake_backend(project_row: dict, compute: Compute, btype=BackendType.GCP) -> None:
+    """Put a fake compute into the backend cache for the project."""
+    from dstack_tpu.server.services import backends as backends_service
+
+    backends_service._compute_cache[project_row["id"]] = {btype: compute}

@@ -13,6 +13,16 @@ def get_current_datetime() -> datetime:
     return datetime.now(timezone.utc)
 
 
+def parse_dt(v: Optional[str]) -> Optional[datetime]:
+    """ISO string → aware datetime (naive input treated as UTC)."""
+    if not v:
+        return None
+    dt = datetime.fromisoformat(v)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt
+
+
 def get_or_error(v: Optional[T], what: str = "value") -> T:
     if v is None:
         raise ValueError(f"{what} is unexpectedly None")

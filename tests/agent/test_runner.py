@@ -1,0 +1,148 @@
+import asyncio
+from pathlib import Path
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.agent import schemas
+from dstack_tpu.agent.python.runner import build_app, cluster_env
+from dstack_tpu.core.models.runs import ClusterInfo
+
+
+async def _client(tmp_path) -> TestClient:
+    app = build_app(Path(tmp_path))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def _pull_until_finished(client, timeout=15.0):
+    states, logs = [], []
+    ts = 0.0
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        resp = await client.get("/api/pull", params={"timestamp": str(ts)})
+        body = schemas.PullResponse.model_validate(await resp.json())
+        states.extend(body.job_states)
+        logs.extend(body.job_logs)
+        ts = max(ts, body.last_updated)
+        if not body.has_more:
+            return states, logs
+        await asyncio.sleep(0.1)
+    raise TimeoutError(f"job did not finish; states={[s.state for s in states]}")
+
+
+class TestRunnerE2E:
+    async def test_job_success_with_logs(self, tmp_path):
+        client = await _client(tmp_path)
+        try:
+            body = schemas.SubmitBody(
+                run_name="r1",
+                job_name="r1-0-0",
+                job_spec={
+                    "commands": ["echo hello-$DTPU_NODE_RANK", "echo DONE"],
+                    "env": {},
+                    "job_num": 0,
+                },
+                cluster_info=ClusterInfo(master_node_ip="127.0.0.1", nodes_ips=["127.0.0.1"]),
+            )
+            r = await client.post("/api/submit", json=body.model_dump())
+            assert r.status == 200
+            r = await client.post("/api/run")
+            assert r.status == 200
+            states, logs = await _pull_until_finished(client)
+            assert states[-1].state == "done"
+            text = "".join(ev.text() for ev in logs)
+            assert "hello-0" in text and "DONE" in text
+        finally:
+            await client.close()
+
+    async def test_job_failure_exit_status(self, tmp_path):
+        client = await _client(tmp_path)
+        try:
+            body = schemas.SubmitBody(
+                run_name="r2",
+                job_name="r2-0-0",
+                job_spec={"commands": ["exit 3"]},
+            )
+            await client.post("/api/submit", json=body.model_dump())
+            await client.post("/api/run")
+            states, _ = await _pull_until_finished(client)
+            assert states[-1].state == "failed"
+            assert states[-1].exit_status == 3
+        finally:
+            await client.close()
+
+    async def test_stop(self, tmp_path):
+        client = await _client(tmp_path)
+        try:
+            body = schemas.SubmitBody(
+                run_name="r3",
+                job_name="r3-0-0",
+                job_spec={"commands": ["sleep 60"]},
+            )
+            await client.post("/api/submit", json=body.model_dump())
+            await client.post("/api/run")
+            await asyncio.sleep(0.5)
+            await client.post("/api/stop")
+            states, _ = await _pull_until_finished(client)
+            assert states[-1].state == "terminated"
+        finally:
+            await client.close()
+
+    async def test_max_duration(self, tmp_path):
+        client = await _client(tmp_path)
+        try:
+            body = schemas.SubmitBody(
+                run_name="r4",
+                job_name="r4-0-0",
+                job_spec={"commands": ["sleep 60"], "max_duration": 1},
+            )
+            await client.post("/api/submit", json=body.model_dump())
+            await client.post("/api/run")
+            states, _ = await _pull_until_finished(client)
+            assert states[-1].state == "terminated"
+            assert states[-1].termination_reason == "max_duration_exceeded"
+        finally:
+            await client.close()
+
+    async def test_metrics_endpoint(self, tmp_path):
+        client = await _client(tmp_path)
+        try:
+            r = await client.get("/api/metrics")
+            assert r.status == 200
+            sample = schemas.MetricsSample.model_validate(await r.json())
+            assert sample.timestamp > 0
+        finally:
+            await client.close()
+
+
+class TestClusterEnv:
+    def test_tpu_rendezvous_env(self):
+        ci = ClusterInfo(
+            master_node_ip="10.0.0.1",
+            nodes_ips=["10.0.0.1", "10.0.0.2"],
+            coordinator_port=8476,
+            tpu_chips_per_host=4,
+            tpu_total_chips=8,
+            tpu_topology="2x2x2",
+        )
+        env = cluster_env(ci, worker_id=1)
+        assert env["DTPU_NODE_RANK"] == "1"
+        assert env["DTPU_NODES_NUM"] == "2"
+        assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
+        assert env["JAX_PROCESS_ID"] == "1"
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["TPU_WORKER_HOSTNAMES"] == "10.0.0.1,10.0.0.2"
+        assert env["DTPU_TPU_TOPOLOGY"] == "2x2x2"
+
+    def test_multislice_env(self):
+        ci = ClusterInfo(
+            master_node_ip="10.0.0.1",
+            nodes_ips=["10.0.0.1"],
+            megascale_coordinator_address="10.0.0.1:8081",
+            num_slices=2,
+            slice_id=1,
+        )
+        env = cluster_env(ci, 0)
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"

@@ -1,0 +1,108 @@
+import asyncio
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.agent import schemas
+from dstack_tpu.agent.python.shim import Shim, Task, build_app
+from dstack_tpu.agent.schemas import TaskStatus
+
+
+class TestTaskFSM:
+    def test_happy_path(self):
+        t = Task(schemas.TaskSubmitRequest(id="t1", name="n"))
+        for s in (
+            TaskStatus.PREPARING,
+            TaskStatus.PULLING,
+            TaskStatus.CREATING,
+            TaskStatus.RUNNING,
+            TaskStatus.TERMINATED,
+        ):
+            t.transition(s)
+        assert t.status == TaskStatus.TERMINATED
+
+    def test_illegal_transition(self):
+        t = Task(schemas.TaskSubmitRequest(id="t2", name="n"))
+        with pytest.raises(ValueError):
+            t.transition(TaskStatus.RUNNING)
+
+    def test_terminate_from_any(self):
+        for via in (TaskStatus.PREPARING, TaskStatus.PULLING):
+            t = Task(schemas.TaskSubmitRequest(id="t3", name="n"))
+            t.transition(TaskStatus.PREPARING)
+            if via == TaskStatus.PULLING:
+                t.transition(TaskStatus.PULLING)
+            t.transition(TaskStatus.TERMINATED)
+
+
+async def _shim_client(tmp_path):
+    shim = Shim(Path(tmp_path), runtime="process")
+    app = build_app(shim)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return shim, client
+
+
+class TestShimAPI:
+    async def test_healthcheck_and_host_info(self, tmp_path):
+        _, client = await _shim_client(tmp_path)
+        try:
+            r = await client.get("/api/healthcheck")
+            body = await r.json()
+            assert body["service"] == "tpu-shim"
+            r = await client.get("/api/host_info")
+            info = schemas.HostInfo.model_validate(await r.json())
+            assert info.cpus >= 1 and info.memory_bytes > 0
+        finally:
+            await client.close()
+
+    async def test_task_lifecycle_process_runtime(self, tmp_path):
+        _, client = await _shim_client(tmp_path)
+        try:
+            req = schemas.TaskSubmitRequest(id="task-1", name="test")
+            r = await client.post("/api/tasks", json=req.model_dump())
+            assert r.status == 200
+            # poll until running (runner subprocess boots)
+            for _ in range(100):
+                r = await client.get("/api/tasks/task-1")
+                info = schemas.TaskInfo.model_validate(await r.json())
+                if info.status in (TaskStatus.RUNNING, TaskStatus.TERMINATED):
+                    break
+                await asyncio.sleep(0.1)
+            assert info.status == TaskStatus.RUNNING, info
+            assert info.ports and info.ports[0].host_port >= 11000
+
+            # duplicate submit is a conflict
+            r = await client.post("/api/tasks", json=req.model_dump())
+            assert r.status == 409
+
+            # terminate + remove
+            r = await client.post(
+                "/api/tasks/task-1/terminate",
+                json=schemas.TerminateRequest(timeout_seconds=2).model_dump(),
+            )
+            info = schemas.TaskInfo.model_validate(await r.json())
+            assert info.status == TaskStatus.TERMINATED
+            r = await client.post("/api/tasks/task-1/remove")
+            assert r.status == 200
+            r = await client.get("/api/tasks")
+            assert (await r.json())["ids"] == []
+        finally:
+            await client.close()
+
+    async def test_remove_requires_terminated(self, tmp_path):
+        shim, client = await _shim_client(tmp_path)
+        try:
+            req = schemas.TaskSubmitRequest(id="task-2", name="test")
+            await client.post("/api/tasks", json=req.model_dump())
+            for _ in range(100):
+                if shim.tasks["task-2"].status == TaskStatus.RUNNING:
+                    break
+                await asyncio.sleep(0.1)
+            r = await client.post("/api/tasks/task-2/remove")
+            assert r.status == 409
+            await client.post("/api/tasks/task-2/terminate", json={})
+            await client.post("/api/tasks/task-2/remove")
+        finally:
+            await client.close()

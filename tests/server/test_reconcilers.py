@@ -1,0 +1,207 @@
+"""Loop-level reconciler tests: seed DB state via factories, install a
+fake Compute, call the loop once, assert DB transitions.
+
+Parity with the reference test strategy (SURVEY.md §4: "this is how
+multi-node provisioning is tested without a cluster").
+"""
+
+import pytest
+
+from dstack_tpu.core.models.instances import InstanceStatus
+from dstack_tpu.core.models.runs import JobStatus, RunStatus
+from dstack_tpu.server.background.tasks.process_instances import process_instances
+from dstack_tpu.server.background.tasks.process_runs import process_runs
+from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+    process_submitted_jobs,
+)
+from dstack_tpu.server.background.tasks.process_terminating_jobs import (
+    process_terminating_jobs,
+)
+from dstack_tpu.server.db import loads
+from dstack_tpu.server.services import runs as runs_service
+from dstack_tpu.server.testing.common import (
+    FakeCompute,
+    create_test_db,
+    create_test_project,
+    create_test_user,
+    install_fake_backend,
+    make_run_spec,
+    tpu_offer,
+)
+
+
+async def _setup(offers=None, **fake_kwargs):
+    db = await create_test_db()
+    _, user_row = await create_test_user(db)
+    project_row = await create_test_project(db, user_row)
+    compute = FakeCompute(offers=offers, **fake_kwargs)
+    install_fake_backend(project_row, compute)
+    return db, user_row, project_row, compute
+
+
+TASK_V5E8 = {
+    "type": "task",
+    "commands": ["python train.py"],
+    "resources": {"tpu": "v5e-8"},
+}
+
+
+class TestSubmittedJobs:
+    async def test_provisions_tpu_slice(self):
+        db, user_row, project_row, compute = await _setup()
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK_V5E8, "test-run")
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run.id,))
+        assert job["status"] == JobStatus.PROVISIONING.value
+        assert len(compute.created) == 1
+        inst = await db.get_by_id("instances", job["instance_id"])
+        assert inst["status"] == InstanceStatus.PROVISIONING.value
+        jpd = loads(job["job_provisioning_data"])
+        assert jpd["instance_type"]["resources"]["tpu"]["chips"] == 8
+
+    async def test_no_offers_fails_job(self):
+        db, user_row, project_row, compute = await _setup(offers=[])
+        await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK_V5E8, "no-offers")
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs")
+        assert job["status"] == JobStatus.TERMINATING.value
+        assert job["termination_reason"] == "failed_to_start_due_to_no_capacity"
+
+    async def test_multihost_slice_one_instance_n_jobs(self):
+        """nodes=4 on a v5p-16 slice (4 hosts): ONE atomic slice
+        provisioning; workers attach to slice hosts."""
+        offers = [tpu_offer(version="v5p", chips=16, topology="2x2x4", hosts=4, price=67.2)]
+        db, user_row, project_row, compute = await _setup(offers=offers)
+        conf = {
+            "type": "task",
+            "nodes": 4,
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5p", "chips": 16}},
+        }
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "multihost")
+        )
+        # master job first
+        await process_submitted_jobs(db)
+        # then workers 1..3
+        for _ in range(3):
+            await process_submitted_jobs(db)
+        jobs = await db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY job_num", (run.id,)
+        )
+        assert len(jobs) == 4
+        assert all(j["status"] == JobStatus.PROVISIONING.value for j in jobs)
+        assert len(compute.created) == 1  # one slice, not 4 VMs
+        assert len({j["instance_id"] for j in jobs}) == 1
+        for j in jobs:
+            jpd = loads(j["job_provisioning_data"])
+            assert jpd["worker_id"] == j["job_num"]
+        # worker 0 has external ip, workers 1+ internal only
+        jpd3 = loads(jobs[3]["job_provisioning_data"])
+        assert jpd3["hostname"].startswith("10.0.")
+
+    async def test_pool_reuse(self):
+        db, user_row, project_row, compute = await _setup()
+        run1 = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK_V5E8, "first")
+        )
+        await process_submitted_jobs(db)
+        job1 = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run1.id,))
+        # finish job1, release instance
+        await db.update_by_id(
+            "instances", job1["instance_id"], {"status": InstanceStatus.IDLE.value}
+        )
+        run2 = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK_V5E8, "second")
+        )
+        await process_submitted_jobs(db)
+        job2 = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run2.id,))
+        assert job2["instance_id"] == job1["instance_id"]
+        assert len(compute.created) == 1  # reused, not re-provisioned
+        inst = await db.get_by_id("instances", job1["instance_id"])
+        assert inst["status"] == InstanceStatus.BUSY.value
+
+
+class TestRunFSM:
+    async def test_run_provisioning_then_failed(self):
+        db, user_row, project_row, compute = await _setup(offers=[])
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK_V5E8, "doomed")
+        )
+        await process_submitted_jobs(db)  # -> terminating (no capacity)
+        await process_terminating_jobs(db)  # -> failed
+        await process_runs(db)  # run -> terminating
+        await process_runs(db)  # run -> failed
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.FAILED.value
+
+    async def test_retry_on_no_capacity(self):
+        db, user_row, project_row, compute = await _setup(offers=[])
+        conf = {**TASK_V5E8, "retry": {"on_events": ["no-capacity"], "duration": "1h"}}
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "retrier")
+        )
+        await process_submitted_jobs(db)
+        await process_terminating_jobs(db)
+        await process_runs(db)  # should retry, not fail
+        jobs = await db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY submission_num", (run.id,)
+        )
+        assert len(jobs) == 2
+        assert jobs[1]["status"] == JobStatus.SUBMITTED.value
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] != RunStatus.FAILED.value
+
+
+class TestInstances:
+    async def test_delayed_ips_polled(self):
+        """GCP-style: create returns without IPs; process_instances polls
+        update_provisioning_data until hosts appear, then propagates to jobs."""
+        db, user_row, project_row, compute = await _setup(delay_ips=True)
+        await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK_V5E8, "delayed")
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs")
+        jpd = loads(job["job_provisioning_data"])
+        assert jpd["hostname"] is None
+        await process_instances(db)
+        job = await db.fetchone("SELECT * FROM jobs")
+        jpd = loads(job["job_provisioning_data"])
+        assert jpd["hostname"] is not None
+        inst = await db.fetchone("SELECT * FROM instances")
+        assert inst["status"] == InstanceStatus.BUSY.value
+
+    async def test_idle_timeout_terminates(self):
+        db, user_row, project_row, compute = await _setup()
+        from dstack_tpu.server.services.instances import create_instance_row
+
+        offer = tpu_offer()
+        from dstack_tpu.core.models.instances import InstanceConfiguration
+
+        jpd = await compute.create_instance(
+            offer, InstanceConfiguration(project_name="main", instance_name="idler")
+        )
+        row = await create_instance_row(
+            db,
+            project_row,
+            name="idler",
+            offer=offer,
+            status=InstanceStatus.IDLE,
+            jpd=jpd,
+            termination_idle_time=0,
+        )
+        import asyncio
+
+        await asyncio.sleep(0.01)
+        await process_instances(db)  # idle -> terminating
+        inst = await db.get_by_id("instances", row["id"])
+        assert inst["status"] == InstanceStatus.TERMINATING.value
+        await process_instances(db)  # terminating -> terminated
+        inst = await db.get_by_id("instances", row["id"])
+        assert inst["status"] == InstanceStatus.TERMINATED.value
+        assert compute.terminated  # backend told to tear down

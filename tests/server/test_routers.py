@@ -1,0 +1,200 @@
+"""REST contract tests over the real app (reference router tests use
+httpx AsyncClient over the ASGI app; here aiohttp's TestClient)."""
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.server.app import create_app
+
+
+async def _client() -> tuple[TestClient, str]:
+    app = await create_app(
+        database_url="sqlite://:memory:",
+        admin_token="test-admin-token",
+        with_background=False,
+        local_backend=True,
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, "test-admin-token"
+
+
+def _auth(token: str) -> dict:
+    return {"Authorization": f"Bearer {token}"}
+
+
+TASK = {
+    "run_spec": {
+        "configuration": {"type": "task", "commands": ["echo hi"]},
+        "ssh_key_pub": "ssh-ed25519 AAAA test",
+    }
+}
+
+
+class TestAuth:
+    async def test_server_info_no_auth(self):
+        client, _ = await _client()
+        try:
+            r = await client.get("/api/server/info")
+            assert r.status == 200
+            assert "server_version" in await r.json()
+        finally:
+            await client.close()
+
+    async def test_unauthorized(self):
+        client, _ = await _client()
+        try:
+            r = await client.post("/api/projects/list")
+            assert r.status == 401
+            r = await client.post(
+                "/api/projects/list", headers=_auth("wrong-token")
+            )
+            assert r.status == 401
+        finally:
+            await client.close()
+
+
+class TestProjectsAndUsers:
+    async def test_default_project_exists(self):
+        client, token = await _client()
+        try:
+            r = await client.post("/api/projects/list", headers=_auth(token))
+            assert r.status == 200
+            projects = await r.json()
+            assert [p["project_name"] for p in projects] == ["main"]
+        finally:
+            await client.close()
+
+    async def test_create_user_and_project_roles(self):
+        client, token = await _client()
+        try:
+            r = await client.post(
+                "/api/users/create",
+                headers=_auth(token),
+                json={"username": "alice"},
+            )
+            assert r.status == 200
+            alice = await r.json()
+            alice_token = alice["creds"]["token"]
+            # alice (not a member) cannot see project main
+            r = await client.post(
+                "/api/project/main/get", headers=_auth(alice_token)
+            )
+            assert r.status == 403
+            # admin adds alice as member
+            r = await client.post(
+                "/api/project/main/set_members",
+                headers=_auth(token),
+                json={
+                    "members": [
+                        {"username": "admin", "project_role": "admin"},
+                        {"username": "alice", "project_role": "user"},
+                    ]
+                },
+            )
+            assert r.status == 200
+            r = await client.post("/api/project/main/get", headers=_auth(alice_token))
+            assert r.status == 200
+            # non-admin cannot create users
+            r = await client.post(
+                "/api/users/create", headers=_auth(alice_token), json={"username": "bob"}
+            )
+            assert r.status == 403
+        finally:
+            await client.close()
+
+
+class TestRunsAPI:
+    async def test_get_plan_local_offer(self):
+        client, token = await _client()
+        try:
+            r = await client.post(
+                "/api/project/main/runs/get_plan", headers=_auth(token), json=TASK
+            )
+            assert r.status == 200
+            plan = await r.json()
+            assert plan["job_plans"][0]["total_offers"] >= 1
+            assert plan["job_plans"][0]["offers"][0]["backend"] == "local"
+            assert plan["run_spec"]["run_name"]  # name generated
+        finally:
+            await client.close()
+
+    async def test_apply_list_get_stop(self):
+        client, token = await _client()
+        try:
+            body = {
+                "run_spec": {
+                    **TASK["run_spec"],
+                    "run_name": "rest-run",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth(token), json=body
+            )
+            assert r.status == 200
+            run = await r.json()
+            assert run["status"] == "submitted"
+            # duplicate active run rejected
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth(token), json=body
+            )
+            assert r.status == 409
+            r = await client.post(
+                "/api/project/main/runs/list", headers=_auth(token)
+            )
+            assert [x["run_spec"]["run_name"] for x in await r.json()] == ["rest-run"]
+            r = await client.post(
+                "/api/project/main/runs/get",
+                headers=_auth(token),
+                json={"run_name": "rest-run"},
+            )
+            assert r.status == 200
+            r = await client.post(
+                "/api/project/main/runs/stop",
+                headers=_auth(token),
+                json={"runs_names": ["rest-run"]},
+            )
+            assert r.status == 200
+            r = await client.post(
+                "/api/project/main/runs/get",
+                headers=_auth(token),
+                json={"run_name": "rest-run"},
+            )
+            assert (await r.json())["status"] == "terminating"
+        finally:
+            await client.close()
+
+    async def test_validation_error(self):
+        client, token = await _client()
+        try:
+            r = await client.post(
+                "/api/project/main/runs/apply",
+                headers=_auth(token),
+                json={"run_spec": {"configuration": {"type": "nope"}}},
+            )
+            assert r.status == 422
+        finally:
+            await client.close()
+
+
+class TestSecretsAPI:
+    async def test_secret_roundtrip(self):
+        client, token = await _client()
+        try:
+            r = await client.post(
+                "/api/project/main/secrets/create",
+                headers=_auth(token),
+                json={"name": "hf_token", "value": "s3cret"},
+            )
+            assert r.status == 200
+            r = await client.post(
+                "/api/project/main/secrets/list", headers=_auth(token)
+            )
+            assert await r.json() == [{"name": "hf_token"}]
+            r = await client.post(
+                "/api/project/main/secrets/delete",
+                headers=_auth(token),
+                json={"secrets_names": ["hf_token"]},
+            )
+            assert r.status == 200
+        finally:
+            await client.close()
