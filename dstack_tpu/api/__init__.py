@@ -1,0 +1,141 @@
+"""Public Python API.
+
+Parity: reference src/dstack/api (``Client`` facade,
+``RunCollection.get_plan/submit/attach``, api/_public/runs.py:396-734).
+
+Usage::
+
+    from dstack_tpu.api import Client
+    client = Client.from_config()           # ~/.dtpu/config.yml
+    run = client.runs.apply_configuration(task_conf)
+    for line in client.runs.logs(run.run_name):
+        print(line, end="")
+"""
+
+import time
+from pathlib import Path
+from typing import Iterator, Optional, Union
+
+import yaml
+
+from dstack_tpu.api.http_client import APIClient
+from dstack_tpu.core.errors import ConfigurationError
+from dstack_tpu.core.models.configurations import (
+    AnyRunConfiguration,
+    parse_run_configuration,
+)
+from dstack_tpu.core.models.runs import Run, RunPlan, RunSpec, RunStatus
+
+CLIENT_CONFIG_PATH = Path("~/.dtpu/config.yml").expanduser()
+
+
+def read_client_config(path: Optional[Path] = None) -> dict:
+    path = path or CLIENT_CONFIG_PATH
+    if not path.exists():
+        raise ConfigurationError(
+            f"no client config at {path}; run `dtpu config --url ... --token ...`"
+        )
+    return yaml.safe_load(path.read_text()) or {}
+
+
+def write_client_config(url: str, token: str, project: str = "main") -> None:
+    # token file: owner-only (bearer token grants full API access)
+    CLIENT_CONFIG_PATH.parent.mkdir(parents=True, exist_ok=True, mode=0o700)
+    CLIENT_CONFIG_PATH.parent.chmod(0o700)
+    CLIENT_CONFIG_PATH.write_text(
+        yaml.safe_dump({"url": url, "token": token, "project": project})
+    )
+    CLIENT_CONFIG_PATH.chmod(0o600)
+
+
+class RunCollection:
+    def __init__(self, client: "Client"):
+        self._c = client
+
+    def get_plan(self, conf: Union[dict, AnyRunConfiguration], run_name: Optional[str] = None) -> RunPlan:
+        return self._c.api.get_run_plan(self._c.project, self._spec(conf, run_name))
+
+    def apply_configuration(
+        self, conf: Union[dict, AnyRunConfiguration], run_name: Optional[str] = None
+    ) -> Run:
+        return self._c.api.apply_run(self._c.project, self._spec(conf, run_name))
+
+    def _spec(self, conf, run_name: Optional[str]) -> RunSpec:
+        if isinstance(conf, dict):
+            conf = parse_run_configuration(conf)
+        return RunSpec(run_name=run_name, configuration=conf, ssh_key_pub="")
+
+    def list(self) -> list[Run]:
+        return self._c.api.list_runs(self._c.project)
+
+    def get(self, run_name: str) -> Run:
+        return self._c.api.get_run(self._c.project, run_name)
+
+    def stop(self, run_name: str, abort: bool = False) -> None:
+        self._c.api.stop_runs(self._c.project, [run_name], abort=abort)
+
+    def delete(self, run_name: str) -> None:
+        self._c.api.delete_runs(self._c.project, [run_name])
+
+    def wait(
+        self, run_name: str, timeout: Optional[float] = None, poll: float = 2.0
+    ) -> Run:
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            run = self.get(run_name)
+            if run.status.is_finished():
+                return run
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"run {run_name} not finished: {run.status}")
+            time.sleep(poll)
+
+    def logs(
+        self,
+        run_name: str,
+        follow: bool = False,
+        diagnose: bool = False,
+        on_status=None,
+        poll_interval: float = 2.0,
+    ) -> Iterator[str]:
+        """Yield decoded log text; with ``follow`` keeps polling until
+        the run finishes (and fully drains the tail). ``on_status`` is an
+        optional callback invoked with the Run on every status poll —
+        used by the CLI to interleave status lines."""
+        token: Optional[str] = None
+        finished_seen = False
+        while True:
+            batch = self._c.api.poll_logs(
+                self._c.project, run_name, next_token=token, diagnose=diagnose
+            )
+            token = batch.next_token or token
+            for ev in batch.logs:
+                yield ev.text()
+            if batch.logs:
+                continue  # keep draining full pages back-to-back
+            if not follow:
+                return
+            if finished_seen:
+                return  # run finished and the tail is drained
+            run = self.get(run_name)
+            if on_status is not None:
+                on_status(run)
+            if run.status.is_finished():
+                finished_seen = True  # one more drain pass, then exit
+                continue
+            time.sleep(poll_interval)
+
+
+class Client:
+    """Facade over the REST API (reference api/_public/__init__.py)."""
+
+    def __init__(self, url: str, token: str, project: str = "main"):
+        self.api = APIClient(url, token)
+        self.project = project
+        self.runs = RunCollection(self)
+
+    @classmethod
+    def from_config(cls, project: Optional[str] = None) -> "Client":
+        cfg = read_client_config()
+        return cls(
+            cfg["url"], cfg["token"], project or cfg.get("project", "main")
+        )

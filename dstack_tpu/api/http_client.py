@@ -1,0 +1,215 @@
+"""Raw typed HTTP client for the server REST API.
+
+Parity: reference src/dstack/api/server/ (``APIClient`` with typed
+resources). Sync (requests) — used by the CLI and the public Python API.
+"""
+
+from typing import Any, Optional
+
+import requests
+
+from dstack_tpu.core.errors import (
+    ClientError,
+    ForbiddenError,
+    ResourceExistsError,
+    ResourceNotExistsError,
+    UnauthorizedError,
+)
+from dstack_tpu.core.models.configurations import (
+    FleetConfiguration,
+    VolumeConfiguration,
+)
+from dstack_tpu.core.models.fleets import Fleet
+from dstack_tpu.core.models.logs import JobSubmissionLogs
+from dstack_tpu.core.models.metrics import JobMetrics
+from dstack_tpu.core.models.projects import Project
+from dstack_tpu.core.models.runs import Run, RunPlan, RunSpec
+from dstack_tpu.core.models.users import User, UserWithCreds
+from dstack_tpu.core.models.volumes import Volume
+
+_ERRORS = {
+    401: UnauthorizedError,
+    403: ForbiddenError,
+    404: ResourceNotExistsError,
+    409: ResourceExistsError,
+}
+
+
+class APIClient:
+    def __init__(self, base_url: str, token: str):
+        self.base_url = base_url.rstrip("/")
+        self._session = requests.Session()
+        self._session.headers["Authorization"] = f"Bearer {token}"
+
+    @staticmethod
+    def _raise_for_error(resp: requests.Response) -> None:
+        if resp.status_code < 400:
+            return
+        detail = ""
+        try:
+            d = resp.json().get("detail")
+            if isinstance(d, list) and d:
+                detail = d[0].get("msg", str(d))
+            else:
+                detail = str(d)
+        except Exception:
+            detail = resp.text[:300]
+        raise _ERRORS.get(resp.status_code, ClientError)(detail)
+
+    def _post(self, path: str, body: Optional[dict] = None) -> Any:
+        resp = self._session.post(
+            self.base_url + path, json=body if body is not None else {}, timeout=60
+        )
+        self._raise_for_error(resp)
+        return resp.json()
+
+    def _get(self, path: str) -> Any:
+        resp = self._session.get(self.base_url + path, timeout=30)
+        self._raise_for_error(resp)
+        return resp.json()
+
+    # server
+    def server_info(self) -> dict:
+        return self._get("/api/server/info")
+
+    # users
+    def get_my_user(self) -> User:
+        return User.model_validate(self._post("/api/users/get_my_user"))
+
+    def create_user(self, username: str, global_role: str = "user") -> UserWithCreds:
+        return UserWithCreds.model_validate(
+            self._post("/api/users/create", {"username": username, "global_role": global_role})
+        )
+
+    # projects
+    def list_projects(self) -> list[Project]:
+        return [Project.model_validate(p) for p in self._post("/api/projects/list")]
+
+    def create_project(self, name: str) -> Project:
+        return Project.model_validate(
+            self._post("/api/projects/create", {"project_name": name})
+        )
+
+    # runs
+    def get_run_plan(self, project: str, run_spec: RunSpec) -> RunPlan:
+        return RunPlan.model_validate(
+            self._post(
+                f"/api/project/{project}/runs/get_plan",
+                {"run_spec": run_spec.model_dump(mode="json")},
+            )
+        )
+
+    def apply_run(self, project: str, run_spec: RunSpec) -> Run:
+        return Run.model_validate(
+            self._post(
+                f"/api/project/{project}/runs/apply",
+                {"run_spec": run_spec.model_dump(mode="json")},
+            )
+        )
+
+    def list_runs(self, project: str) -> list[Run]:
+        return [
+            Run.model_validate(r) for r in self._post(f"/api/project/{project}/runs/list")
+        ]
+
+    def get_run(self, project: str, run_name: str) -> Run:
+        return Run.model_validate(
+            self._post(f"/api/project/{project}/runs/get", {"run_name": run_name})
+        )
+
+    def stop_runs(self, project: str, run_names: list[str], abort: bool = False) -> None:
+        self._post(
+            f"/api/project/{project}/runs/stop",
+            {"runs_names": run_names, "abort": abort},
+        )
+
+    def delete_runs(self, project: str, run_names: list[str]) -> None:
+        self._post(f"/api/project/{project}/runs/delete", {"runs_names": run_names})
+
+    # logs
+    def poll_logs(
+        self,
+        project: str,
+        run_name: str,
+        start_time: Optional[str] = None,
+        next_token: Optional[str] = None,
+        diagnose: bool = False,
+        limit: int = 1000,
+    ) -> JobSubmissionLogs:
+        return JobSubmissionLogs.model_validate(
+            self._post(
+                f"/api/project/{project}/logs/poll",
+                {
+                    "run_name": run_name,
+                    "start_time": start_time,
+                    "next_token": next_token,
+                    "diagnose": diagnose,
+                    "limit": limit,
+                },
+            )
+        )
+
+    # metrics
+    def get_job_metrics(self, project: str, run_name: str, limit: int = 100) -> JobMetrics:
+        return JobMetrics.model_validate(
+            self._post(
+                f"/api/project/{project}/metrics/job",
+                {"run_name": run_name, "limit": limit},
+            )
+        )
+
+    # fleets
+    def list_fleets(self, project: str) -> list[Fleet]:
+        return [
+            Fleet.model_validate(f)
+            for f in self._post(f"/api/project/{project}/fleets/list")
+        ]
+
+    def apply_fleet(self, project: str, conf: FleetConfiguration) -> Fleet:
+        return Fleet.model_validate(
+            self._post(
+                f"/api/project/{project}/fleets/apply",
+                {"configuration": conf.model_dump(mode="json")},
+            )
+        )
+
+    def delete_fleets(self, project: str, names: list[str]) -> None:
+        self._post(f"/api/project/{project}/fleets/delete", {"names": names})
+
+    # volumes
+    def list_volumes(self, project: str) -> list[Volume]:
+        return [
+            Volume.model_validate(v)
+            for v in self._post(f"/api/project/{project}/volumes/list")
+        ]
+
+    def apply_volume(self, project: str, conf: VolumeConfiguration) -> Volume:
+        return Volume.model_validate(
+            self._post(
+                f"/api/project/{project}/volumes/apply",
+                {"configuration": conf.model_dump(mode="json")},
+            )
+        )
+
+    def delete_volumes(self, project: str, names: list[str]) -> None:
+        self._post(f"/api/project/{project}/volumes/delete", {"names": names})
+
+    # instances
+    def list_instances(self, project: str) -> list[dict]:
+        return self._post(f"/api/project/{project}/instances/list")
+
+    # backends
+    def create_backend(self, project: str, btype: str, config: dict) -> None:
+        self._post(
+            f"/api/project/{project}/backends/create",
+            {"type": btype, "config": config},
+        )
+
+    def list_backends(self, project: str) -> list[dict]:
+        return self._post(f"/api/project/{project}/backends/list")
+
+    # secrets
+    def create_secret(self, project: str, name: str, value: str) -> None:
+        self._post(
+            f"/api/project/{project}/secrets/create", {"name": name, "value": value}
+        )

@@ -1,0 +1,434 @@
+"""``dtpu`` CLI.
+
+Parity: reference src/dstack/_internal/cli (argparse+rich; commands
+registered in cli/main.py:93: apply/attach/ps/logs/stop/fleet/volume/
+gateway/metrics/server/config/init). Built on click+rich here.
+"""
+
+import json
+import sys
+import time
+from pathlib import Path
+from typing import Optional
+
+import click
+import yaml
+from rich.console import Console
+from rich.table import Table
+
+from dstack_tpu.core.errors import ClientError, DstackTPUError
+from dstack_tpu.utils.common import pretty_date
+from dstack_tpu.version import __version__
+
+console = Console()
+
+
+def _client(project: Optional[str] = None):
+    from dstack_tpu.api import Client
+
+    return Client.from_config(project=project)
+
+
+@click.group()
+@click.version_option(__version__, prog_name="dtpu")
+def cli() -> None:
+    """dstack-tpu: TPU-native AI workload orchestrator."""
+
+
+@cli.command()
+@click.option("--host", default=None)
+@click.option("--port", type=int, default=None)
+@click.option("--token", default=None, help="admin token (generated if omitted)")
+@click.option("--db", "database_url", default="", help="sqlite://PATH database URL")
+def server(host, port, token, database_url) -> None:
+    """Start the control-plane server."""
+    import asyncio
+
+    from dstack_tpu.server.app import run_server
+
+    try:
+        asyncio.run(
+            run_server(
+                host=host or "", port=port or 0, database_url=database_url,
+                admin_token=token,
+            )
+        )
+    except KeyboardInterrupt:
+        pass
+
+
+@cli.command()
+@click.option("--url", required=True)
+@click.option("--token", required=True)
+@click.option("--project", default="main")
+def config(url, token, project) -> None:
+    """Save client connection config (~/.dtpu/config.yml)."""
+    from dstack_tpu.api import write_client_config
+
+    write_client_config(url, token, project)
+    console.print(f"[green]Configured[/green] {url} (project: {project})")
+
+
+@cli.command()
+@click.option("-f", "--file", "config_path", required=True, type=click.Path(exists=True))
+@click.option("-y", "--yes", is_flag=True, help="skip confirmation")
+@click.option("-d", "--detach", is_flag=True, help="do not stream logs")
+@click.option("-n", "--name", default=None, help="run name override")
+@click.option("--project", default=None)
+def apply(config_path, yes, detach, name, project) -> None:
+    """Apply a configuration (task/service/dev-environment/fleet/volume)."""
+    from dstack_tpu.core.models.configurations import (
+        FleetConfiguration,
+        GatewayConfiguration,
+        VolumeConfiguration,
+        parse_apply_configuration,
+    )
+
+    data = yaml.safe_load(Path(config_path).read_text())
+    try:
+        conf = parse_apply_configuration(data)
+    except Exception as e:
+        _die(f"invalid configuration: {e}")
+    client = _client(project)
+    try:
+        if isinstance(conf, FleetConfiguration):
+            fleet = client.api.apply_fleet(client.project, conf)
+            console.print(f"[green]Fleet {fleet.name} created[/green]")
+            return
+        if isinstance(conf, VolumeConfiguration):
+            vol = client.api.apply_volume(client.project, conf)
+            console.print(f"[green]Volume {vol.name} submitted[/green]")
+            return
+        if isinstance(conf, GatewayConfiguration):
+            _die("gateway apply is not supported yet in this build")
+        plan = client.runs.get_plan(conf, run_name=name)
+        _print_plan(plan)
+        if not yes and not click.confirm("Submit the run?", default=True):
+            return
+        run = client.runs.apply_configuration(conf, run_name=plan.run_spec.run_name)
+        console.print(
+            f"[green]Submitted[/green] run [bold]{run.run_spec.run_name}[/bold]"
+        )
+        if not detach:
+            _stream_run(client, run.run_spec.run_name)
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+def _print_plan(plan) -> None:
+    t = Table(title=f"Run plan: {plan.run_spec.run_name}", title_justify="left")
+    t.add_column("#")
+    t.add_column("backend")
+    t.add_column("instance")
+    t.add_column("resources")
+    t.add_column("region")
+    t.add_column("$/h", justify="right")
+    jp = plan.job_plans[0] if plan.job_plans else None
+    if jp is None or not jp.offers:
+        console.print("[yellow]No offers available[/yellow]")
+        return
+    for i, offer in enumerate(jp.offers[:10]):
+        t.add_row(
+            str(i + 1),
+            offer.backend.value,
+            offer.instance.name,
+            offer.instance.resources.pretty_format(),
+            offer.region,
+            f"{offer.price:.2f}",
+        )
+    if jp.total_offers > 10:
+        t.add_row("…", f"{jp.total_offers} offers total", "", "", "", "")
+    console.print(t)
+
+
+def _stream_run(client, run_name: str) -> None:
+    console.print("[dim]Waiting for the run to start... (Ctrl-C to detach)[/dim]")
+    state = {"status": None, "run": None}
+
+    def on_status(run) -> None:
+        state["run"] = run
+        if run.status.value != state["status"]:
+            console.print(f"[dim]{run.run_spec.run_name}: {run.status.value}[/dim]")
+            state["status"] = run.status.value
+
+    try:
+        # single shared follow-mode generator (no duplicated cursor logic)
+        for text in client.runs.logs(run_name, follow=True, on_status=on_status):
+            sys.stdout.write(text)
+            sys.stdout.flush()
+        run = state["run"] or client.runs.get(run_name)
+        sub = (
+            run.jobs[0].job_submissions[-1]
+            if run.jobs and run.jobs[0].job_submissions
+            else None
+        )
+        exit_info = (
+            f" (exit status {sub.exit_status})"
+            if sub is not None and sub.exit_status is not None
+            else ""
+        )
+        console.print(
+            f"\n[bold]{run_name}[/bold] finished: {run.status.value}{exit_info}"
+        )
+        if run.status.value == "failed" and sub is not None:
+            console.print(
+                f"[red]{sub.termination_reason}: "
+                f"{sub.termination_reason_message or ''}[/red]"
+            )
+    except KeyboardInterrupt:
+        console.print("\n[dim]Detached. The run keeps going; "
+                      f"`dtpu stop {run_name}` to stop it.[/dim]")
+
+
+@cli.command()
+@click.option("--project", default=None)
+@click.option("-a", "--all", "show_all", is_flag=True, help="include finished runs")
+def ps(project, show_all) -> None:
+    """List runs."""
+    client = _client(project)
+    runs = client.runs.list()
+    t = Table()
+    for col in ("NAME", "BACKEND", "RESOURCES", "PRICE", "STATUS", "SUBMITTED"):
+        t.add_column(col)
+    for run in runs:
+        if not show_all and run.status.is_finished():
+            continue
+        sub = (
+            run.jobs[0].job_submissions[-1]
+            if run.jobs and run.jobs[0].job_submissions
+            else None
+        )
+        jpd = sub.job_provisioning_data if sub else None
+        t.add_row(
+            run.run_spec.run_name,
+            jpd.backend.value if jpd else "",
+            jpd.instance_type.resources.pretty_format() if jpd else "",
+            f"{jpd.price:.2f}" if jpd else "",
+            run.status.value,
+            pretty_date(run.submitted_at),
+        )
+    console.print(t)
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("--project", default=None)
+@click.option("-d", "--diagnose", is_flag=True, help="show runner diagnostics logs")
+@click.option("-f", "--follow", is_flag=True)
+def logs(run_name, project, diagnose, follow) -> None:
+    """Print a run's logs."""
+    client = _client(project)
+    try:
+        for text in client.runs.logs(run_name, follow=follow, diagnose=diagnose):
+            sys.stdout.write(text)
+        sys.stdout.flush()
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("--project", default=None)
+@click.option("-x", "--abort", is_flag=True)
+@click.option("-y", "--yes", is_flag=True)
+def stop(run_name, project, abort, yes) -> None:
+    """Stop a run."""
+    if not yes and not click.confirm(f"Stop run {run_name}?", default=True):
+        return
+    client = _client(project)
+    try:
+        client.runs.stop(run_name, abort=abort)
+        console.print(f"[green]Stopping[/green] {run_name}")
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("--project", default=None)
+@click.option("-y", "--yes", is_flag=True)
+def delete(run_name, project, yes) -> None:
+    """Delete a finished run."""
+    if not yes and not click.confirm(f"Delete run {run_name}?", default=True):
+        return
+    client = _client(project)
+    try:
+        client.runs.delete(run_name)
+        console.print(f"[green]Deleted[/green] {run_name}")
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+@cli.group()
+def fleet() -> None:
+    """Manage fleets."""
+
+
+@fleet.command("list")
+@click.option("--project", default=None)
+def fleet_list(project) -> None:
+    client = _client(project)
+    t = Table()
+    for col in ("FLEET", "INSTANCE", "BACKEND", "RESOURCES", "PRICE", "STATUS", "CREATED"):
+        t.add_column(col)
+    for f in client.api.list_fleets(client.project):
+        if not f.instances:
+            t.add_row(f.name, "", "", "", "", f.status.value, pretty_date(f.created_at))
+        for inst in f.instances:
+            t.add_row(
+                f.name,
+                f"{inst.instance_num}",
+                inst.backend.value if inst.backend else "",
+                inst.instance_type.resources.pretty_format() if inst.instance_type else "",
+                f"{inst.price:.2f}" if inst.price is not None else "",
+                inst.status.value,
+                pretty_date(f.created_at),
+            )
+    console.print(t)
+
+
+@fleet.command("delete")
+@click.argument("name")
+@click.option("--project", default=None)
+@click.option("-y", "--yes", is_flag=True)
+def fleet_delete(name, project, yes) -> None:
+    if not yes and not click.confirm(f"Delete fleet {name}?", default=True):
+        return
+    client = _client(project)
+    try:
+        client.api.delete_fleets(client.project, [name])
+        console.print(f"[green]Deleting[/green] fleet {name}")
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+@cli.group()
+def volume() -> None:
+    """Manage volumes."""
+
+
+@volume.command("list")
+@click.option("--project", default=None)
+def volume_list(project) -> None:
+    client = _client(project)
+    t = Table()
+    for col in ("NAME", "BACKEND", "REGION", "SIZE", "STATUS"):
+        t.add_column(col)
+    for v in client.api.list_volumes(client.project):
+        t.add_row(
+            v.name,
+            v.configuration.backend or "",
+            v.configuration.region or "",
+            f"{v.configuration.size:g}GB" if v.configuration.size else "",
+            v.status.value,
+        )
+    console.print(t)
+
+
+@volume.command("delete")
+@click.argument("name")
+@click.option("--project", default=None)
+@click.option("-y", "--yes", is_flag=True)
+def volume_delete(name, project, yes) -> None:
+    if not yes and not click.confirm(f"Delete volume {name}?", default=True):
+        return
+    client = _client(project)
+    try:
+        client.api.delete_volumes(client.project, [name])
+        console.print(f"[green]Deleted[/green] volume {name}")
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+@cli.command()
+@click.option("--project", default=None)
+def pool(project) -> None:
+    """List pool instances."""
+    client = _client(project)
+    t = Table()
+    for col in ("NAME", "BACKEND", "REGION", "PRICE", "STATUS"):
+        t.add_column(col)
+    for inst in client.api.list_instances(client.project):
+        t.add_row(
+            inst["name"],
+            inst.get("backend") or "",
+            inst.get("region") or "",
+            f"{inst['price']:.2f}" if inst.get("price") is not None else "",
+            inst["status"],
+        )
+    console.print(t)
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("--project", default=None)
+def metrics(run_name, project) -> None:
+    """Show latest hardware metrics of a run (CPU/mem/TPU)."""
+    client = _client(project)
+    try:
+        jm = client.api.get_job_metrics(client.project, run_name)
+    except DstackTPUError as e:
+        _die(str(e))
+    t = Table()
+    t.add_column("METRIC")
+    t.add_column("LAST", justify="right")
+    t.add_column("POINTS", justify="right")
+    for m in jm.metrics:
+        last = f"{m.values[-1]:.1f}" if m.values else "-"
+        t.add_row(m.name, last, str(len(m.values)))
+    console.print(t)
+
+
+@cli.command()
+@click.option("--tpu", "tpu_spec", default=None, help="e.g. v5e-8 or v5p")
+@click.option("--spot/--on-demand", default=None)
+def offer(tpu_spec, spot) -> None:
+    """Browse the TPU slice catalog (no server needed)."""
+    from dstack_tpu.core.catalog import query_slices
+    from dstack_tpu.core.models.resources import ResourcesSpec, TPUSpec
+
+    spec = ResourcesSpec(
+        tpu=TPUSpec.model_validate(tpu_spec) if tpu_spec else TPUSpec()
+    )
+    items = query_slices(spec, spot=spot)
+    t = Table()
+    for col in ("SLICE", "TOPOLOGY", "CHIPS", "HOSTS", "REGION", "SPOT", "$/H"):
+        t.add_column(col)
+    for it in items[:40]:
+        t.add_row(
+            it.instance_name,
+            it.topology,
+            str(it.chips),
+            str(it.hosts),
+            it.region,
+            "yes" if it.spot else "no",
+            f"{it.price:.2f}",
+        )
+    if len(items) > 40:
+        t.add_row("…", f"{len(items)} total", "", "", "", "", "")
+    console.print(t)
+
+
+def _die(msg: str) -> None:
+    console.print(f"[red]Error:[/red] {msg}")
+    sys.exit(1)
+
+
+def main() -> None:
+    import requests
+
+    try:
+        cli()
+    except ClientError as e:
+        _die(e.msg)
+    except requests.exceptions.ConnectionError as e:
+        _die(
+            "cannot reach the server — is it running? "
+            f"({e.request.url if e.request is not None else e})"
+        )
+    except requests.exceptions.RequestException as e:
+        _die(f"request failed: {e}")
+
+
+if __name__ == "__main__":
+    main()

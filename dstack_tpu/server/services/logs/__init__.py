@@ -81,11 +81,12 @@ class FileLogStorage:
         start_time = _aware(start_time)
         offset = int(next_token) if next_token else 0
         events: list[LogEvent] = []
-        lineno = 0
+        scanned = offset
         with path.open() as f:
             for lineno, line in enumerate(f):
                 if lineno < offset:
                     continue
+                scanned = lineno + 1
                 try:
                     ev = LogEvent.model_validate(json.loads(line))
                 except Exception:
@@ -95,8 +96,10 @@ class FileLogStorage:
                 events.append(ev)
                 if len(events) >= limit:
                     break
-        token = str(lineno + 1) if len(events) >= limit else None
-        return JobSubmissionLogs(logs=events, next_token=token)
+        # next_token is ALWAYS the resume offset (lines consumed), so
+        # clients never fall back to a lossy timestamp cursor — bursts
+        # sharing one timestamp are never dropped between polls.
+        return JobSubmissionLogs(logs=events, next_token=str(scanned))
 
 
 _storage: Optional[FileLogStorage] = None
