@@ -1,0 +1,29 @@
+"""Remote (SSH fleet) backend.
+
+Instances are user-supplied hosts; there is no offer market — fleet
+apply creates PENDING instance rows with ``remote_connection_info`` and
+``process_instances`` adopts them via :mod:`.provisioning`.
+"""
+
+from typing import Optional
+
+from dstack_tpu.backends.base.compute import Compute, ComputeWithMultinodeSupport
+from dstack_tpu.core.models.instances import InstanceOfferWithAvailability
+from dstack_tpu.core.models.runs import Requirements
+
+
+class SSHFleetCompute(Compute, ComputeWithMultinodeSupport):
+    def __init__(self, config: Optional[dict] = None):
+        self.config = config or {}
+
+    async def get_offers(
+        self, requirements: Requirements
+    ) -> list[InstanceOfferWithAvailability]:
+        return []  # pool-only: jobs match adopted idle instances
+
+    async def terminate_instance(
+        self, instance_id: str, region: str, backend_data: Optional[str] = None
+    ) -> None:
+        # host remains the user's; the shim service is removed during
+        # fleet deletion (process_instances → provisioning.remove_host)
+        return None

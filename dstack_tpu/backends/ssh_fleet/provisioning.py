@@ -1,0 +1,105 @@
+"""SSH-fleet host adoption: install the shim on user-supplied TPU hosts.
+
+Parity: reference remote/provisioning.py:99-204 (paramiko-based env
+upload, shim installed as a systemd service, host-info JSON handshake,
+consumed by process_instances._add_remote:214-385). No paramiko in this
+image — the system ``ssh`` binary is used, and the command runner is
+injectable so tests fake the wire.
+"""
+
+import asyncio
+import json
+import shlex
+from typing import Awaitable, Callable, Optional
+
+from dstack_tpu.agent import schemas as agent_schemas
+from dstack_tpu.core.errors import ProvisioningError
+from dstack_tpu.core.models.instances import RemoteConnectionInfo
+from dstack_tpu.utils.logging import get_logger
+from dstack_tpu.version import __version__
+
+logger = get_logger("backends.ssh_fleet")
+
+SHIM_PORT = 10998
+
+SYSTEMD_UNIT = """\
+[Unit]
+Description=dstack-tpu shim
+After=network.target
+
+[Service]
+Type=simple
+ExecStart=/usr/bin/env python3 -m dstack_tpu.agent.python.shim_main \\
+  --port {port} --base-dir /root/.dtpu/shim --service \\
+  --host-info-path /root/.dtpu/host_info.json
+Restart=always
+RestartSec=2
+
+[Install]
+WantedBy=multi-user.target
+"""
+
+SSHRunner = Callable[[RemoteConnectionInfo, str], Awaitable[tuple[int, str]]]
+
+
+async def default_ssh_run(rci: RemoteConnectionInfo, command: str) -> tuple[int, str]:
+    """Run a command on the host via the system ssh binary."""
+    cmd = [
+        "ssh",
+        "-o", "StrictHostKeyChecking=no",
+        "-o", "UserKnownHostsFile=/dev/null",
+        "-o", "ConnectTimeout=15",
+        "-p", str(rci.port),
+        f"{rci.ssh_user}@{rci.host}",
+        command,
+    ]
+    proc = await asyncio.create_subprocess_exec(
+        *cmd,
+        stdout=asyncio.subprocess.PIPE,
+        stderr=asyncio.subprocess.STDOUT,
+    )
+    out, _ = await proc.communicate()
+    return proc.returncode or 0, out.decode(errors="replace")
+
+
+async def adopt_host(
+    rci: RemoteConnectionInfo,
+    ssh_run: Optional[SSHRunner] = None,
+) -> agent_schemas.HostInfo:
+    """Install + start the shim service, return the host-info handshake."""
+    run = ssh_run or default_ssh_run
+    unit = SYSTEMD_UNIT.format(port=SHIM_PORT)
+    install = (
+        "set -e; "
+        "python3 -c 'import dstack_tpu' 2>/dev/null || "
+        f"python3 -m pip install -q dstack-tpu=={__version__}; "
+        "mkdir -p /root/.dtpu; "
+        f"printf %s {shlex.quote(unit)} > /etc/systemd/system/dtpu-shim.service; "
+        "systemctl daemon-reload && systemctl enable --now dtpu-shim"
+    )
+    rc, out = await run(rci, install)
+    if rc != 0:
+        raise ProvisioningError(
+            f"shim install failed on {rci.host}: {out[-400:]}"
+        )
+    # wait for the host-info handshake file written in --service mode
+    for _ in range(30):
+        rc, out = await run(rci, "cat /root/.dtpu/host_info.json 2>/dev/null")
+        if rc == 0 and out.strip():
+            try:
+                return agent_schemas.HostInfo.model_validate(json.loads(out))
+            except (json.JSONDecodeError, ValueError):
+                pass
+        await asyncio.sleep(2)
+    raise ProvisioningError(f"no host-info handshake from {rci.host}")
+
+
+async def remove_host(
+    rci: RemoteConnectionInfo, ssh_run: Optional[SSHRunner] = None
+) -> None:
+    run = ssh_run or default_ssh_run
+    await run(
+        rci,
+        "systemctl disable --now dtpu-shim 2>/dev/null; "
+        "rm -f /etc/systemd/system/dtpu-shim.service",
+    )

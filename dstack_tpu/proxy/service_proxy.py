@@ -1,0 +1,213 @@
+"""In-server service proxy + OpenAI-compatible model gateway.
+
+Parity: reference server/services/proxy (``/proxy/services/{proj}/{run}/``
+gateway-less ingress, service_proxy.py:135) and the model adapter
+(reference proxy/lib/routers/model_proxy.py:102, clients/openai.py:67 /
+tgi.py:208). Requests resolve the run's RUNNING service replicas and
+round-robin across them; each request is recorded for the RPS
+autoscaler.
+"""
+
+import itertools
+import json
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from dstack_tpu.core.models.runs import JobProvisioningData, JobStatus
+from dstack_tpu.proxy.stats import get_service_stats
+from dstack_tpu.server.db import Database, loads
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("proxy.service")
+
+_rr_counter = itertools.count()
+
+
+async def _resolve_replicas(
+    db: Database, project_name: str, run_name: str
+) -> list[tuple[str, int]]:
+    """→ [(host, port)] of RUNNING service replicas."""
+    project = await db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project is None:
+        return []
+    run = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project["id"], run_name),
+    )
+    if run is None:
+        return []
+    jobs = await db.fetchall(
+        "SELECT * FROM jobs WHERE run_id = ? AND status = ?",
+        (run["id"], JobStatus.RUNNING.value),
+    )
+    out = []
+    for job in jobs:
+        jpd_raw = loads(job.get("job_provisioning_data"))
+        spec = loads(job["job_spec"])
+        if jpd_raw is None or spec.get("service_port") is None:
+            continue
+        jpd = JobProvisioningData.model_validate(jpd_raw)
+        # host networking: service listens on its container port on the host
+        out.append((jpd.hostname or "127.0.0.1", int(spec["service_port"])))
+    return out
+
+
+def _proxy_session(app: web.Application) -> aiohttp.ClientSession:
+    """One long-lived pooled session for the proxy hot path."""
+    state = app["state"]
+    session = state.get("proxy_session")
+    if session is None or session.closed:
+        session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=300),
+            connector=aiohttp.TCPConnector(limit=256, keepalive_timeout=30),
+        )
+        state["proxy_session"] = session
+    return session
+
+
+async def _forward(
+    request: web.Request, host: str, port: int, path: str
+) -> web.StreamResponse:
+    url = f"http://{host}:{port}/{path.lstrip('/')}"
+    if request.query_string:
+        url += f"?{request.query_string}"
+    body = await request.read()
+    headers = {
+        k: v
+        for k, v in request.headers.items()
+        if k.lower() not in ("host", "authorization", "transfer-encoding")
+    }
+    session = _proxy_session(request.app)
+    try:
+        async with session.request(
+            request.method, url, data=body, headers=headers
+        ) as upstream:
+            resp = web.StreamResponse(
+                status=upstream.status, headers={"Content-Type": upstream.content_type}
+            )
+            await resp.prepare(request)
+            async for chunk in upstream.content.iter_chunked(64 * 1024):
+                await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+    except aiohttp.ClientError as e:
+        return web.json_response(
+            {"detail": f"service unreachable: {e}"}, status=502
+        )
+
+
+async def service_proxy_handler(request: web.Request) -> web.StreamResponse:
+    db: Database = request.app["state"]["db"]
+    project = request.match_info["project_name"]
+    run_name = request.match_info["run_name"]
+    path = request.match_info.get("path", "")
+    # record BEFORE the no-replica check: demand on a scaled-to-zero
+    # service is what makes the autoscaler scale it back up
+    get_service_stats().record(project, run_name)
+    replicas = await _resolve_replicas(db, project, run_name)
+    if not replicas:
+        return web.json_response(
+            {"detail": f"no running replicas for {run_name}"}, status=503
+        )
+    host, port = replicas[next(_rr_counter) % len(replicas)]
+    return await _forward(request, host, port, path)
+
+
+async def model_proxy_handler(request: web.Request) -> web.StreamResponse:
+    """OpenAI-compatible endpoint: routes by ``model`` name to the
+    service whose config registered that model."""
+    db: Database = request.app["state"]["db"]
+    project = request.match_info["project_name"]
+    path = request.match_info.get("path", "chat/completions")
+    body_raw = await request.read()
+    try:
+        payload = json.loads(body_raw) if body_raw else {}
+    except json.JSONDecodeError:
+        return web.json_response({"detail": "invalid JSON"}, status=400)
+    model_name = payload.get("model")
+    run_row = await _find_model_service(db, project, model_name)
+    if run_row is None:
+        return web.json_response(
+            {"detail": f"model {model_name!r} not found"}, status=404
+        )
+    run_name = run_row["run_name"]
+    get_service_stats().record(project, run_name)  # before the 503 check
+    replicas = await _resolve_replicas(db, project, run_name)
+    if not replicas:
+        return web.json_response(
+            {"detail": f"no running replicas for model {model_name}"}, status=503
+        )
+    host, port = replicas[next(_rr_counter) % len(replicas)]
+    spec = loads(run_row["run_spec"])
+    prefix = (
+        spec.get("configuration", {}).get("model", {}) or {}
+    ).get("prefix", "/v1")
+    return await _forward(
+        request, host, port, f"{prefix.strip('/')}/{path.lstrip('/')}"
+    )
+
+
+async def model_list_handler(request: web.Request) -> web.Response:
+    db: Database = request.app["state"]["db"]
+    project = request.match_info["project_name"]
+    rows = await _list_model_services(db, project)
+    data = [
+        {
+            "id": (loads(r["run_spec"])["configuration"]["model"] or {}).get("name"),
+            "object": "model",
+            "owned_by": "dstack-tpu",
+        }
+        for r in rows
+    ]
+    return web.json_response({"object": "list", "data": data})
+
+
+async def _list_model_services(db: Database, project_name: str) -> list[dict]:
+    project = await db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project is None:
+        return []
+    rows = await db.fetchall(
+        "SELECT * FROM runs WHERE project_id = ? AND deleted = 0 "
+        "AND status IN ('running','provisioning','submitted')",
+        (project["id"],),
+    )
+    out = []
+    for r in rows:
+        conf = (loads(r["run_spec"]) or {}).get("configuration", {})
+        if conf.get("type") == "service" and conf.get("model"):
+            out.append(r)
+    return out
+
+
+async def _find_model_service(
+    db: Database, project_name: str, model_name: Optional[str]
+) -> Optional[dict]:
+    for r in await _list_model_services(db, project_name):
+        conf = loads(r["run_spec"])["configuration"]
+        if (conf.get("model") or {}).get("name") == model_name:
+            return r
+    return None
+
+
+def register_routes(app: web.Application) -> None:
+    app.router.add_route(
+        "*",
+        "/proxy/services/{project_name}/{run_name}/{path:.*}",
+        service_proxy_handler,
+    )
+    app.router.add_get(
+        "/proxy/models/{project_name}/models", model_list_handler
+    )
+    app.router.add_post(
+        "/proxy/models/{project_name}/{path:.*}", model_proxy_handler
+    )
+
+
+def service_url(project_name: str, run_name: str) -> str:
+    return f"/proxy/services/{project_name}/{run_name}/"

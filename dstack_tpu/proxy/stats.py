@@ -1,0 +1,45 @@
+"""Per-service request statistics for the RPS autoscaler.
+
+Parity: reference gateway/services/stats.py:156 (RPS windows from nginx
+access logs) — here the in-server proxy records requests directly.
+"""
+
+import time
+from collections import defaultdict, deque
+from typing import Deque
+
+
+class ServiceStats:
+    def __init__(self, window_seconds: float = 600.0):
+        self.window = window_seconds
+        self._requests: dict[tuple[str, str], Deque[float]] = defaultdict(deque)
+
+    def record(self, project: str, run_name: str) -> None:
+        q = self._requests[(project, run_name)]
+        q.append(time.monotonic())
+        self._trim(q)
+
+    def _trim(self, q: Deque[float]) -> None:
+        cutoff = time.monotonic() - self.window
+        while q and q[0] < cutoff:
+            q.popleft()
+
+    def rps(self, project: str, run_name: str, over_seconds: float = 60.0) -> float:
+        q = self._requests.get((project, run_name))
+        if not q:
+            return 0.0
+        self._trim(q)
+        cutoff = time.monotonic() - over_seconds
+        n = sum(1 for t in q if t >= cutoff)
+        return n / over_seconds
+
+    def last_request_at(self, project: str, run_name: str) -> float:
+        q = self._requests.get((project, run_name))
+        return q[-1] if q else 0.0
+
+
+_stats = ServiceStats()
+
+
+def get_service_stats() -> ServiceStats:
+    return _stats

@@ -64,6 +64,9 @@ async def create_app(
 
     async def on_cleanup(app: web.Application) -> None:
         await scheduler.stop()
+        session = state.get("proxy_session")
+        if session is not None and not session.closed:
+            await session.close()
         await db.close()
 
     app.on_startup.append(on_startup)

@@ -57,6 +57,10 @@ async def _process(db: Database, instance_id: str) -> None:
 async def _provision(db: Database, row: dict) -> None:
     """Fleet-created instances start at PENDING and are provisioned here
     (job-driven instances are provisioned in process_submitted_jobs)."""
+    rci_raw = loads(row.get("remote_connection_info"))
+    if rci_raw:
+        await _adopt_remote(db, row, rci_raw)
+        return
     project_row = await db.get_by_id("projects", row["project_id"])
     offer_raw = loads(row.get("offer"))
     if offer_raw is None:
@@ -79,14 +83,7 @@ async def _provision(db: Database, row: dict) -> None:
             ),
         )
     except Exception as e:
-        logger.warning("instance %s provisioning failed: %s", row["name"], e)
-        created = datetime.fromisoformat(row["created_at"])
-        if now_utc() - created > timedelta(seconds=settings.PROVISIONING_TIMEOUT):
-            await _mark(
-                db, row, InstanceStatus.TERMINATED, termination_reason=str(e)[:300]
-            )
-        else:
-            await _touch(db, row)
+        await _provision_failed(db, row, e, what=f"instance {row['name']} provisioning")
         return
     await db.update_by_id(
         "instances",
@@ -98,6 +95,96 @@ async def _provision(db: Database, row: dict) -> None:
             "last_processed_at": now_utc().isoformat(),
         },
     )
+
+
+async def _adopt_remote(db: Database, row: dict, rci_raw: dict) -> None:
+    """SSH-fleet host adoption (reference _add_remote:214-385): install
+    the shim over SSH, read the host-info handshake, build offer + JPD."""
+    from dstack_tpu.backends.ssh_fleet import provisioning as ssh_prov
+    from dstack_tpu.core.models.instances import (
+        InstanceOfferWithAvailability,
+        InstanceType,
+        RemoteConnectionInfo,
+        Resources,
+        TPUInfo,
+    )
+
+    rci = RemoteConnectionInfo.model_validate(rci_raw)
+    try:
+        info = await ssh_prov.adopt_host(rci, ssh_run=_SSH_RUN_OVERRIDE)
+    except Exception as e:
+        await _provision_failed(db, row, e, what=f"ssh-fleet adoption of {rci.host}")
+        return
+    tpu = None
+    if info.tpu is not None and info.tpu.chip_count > 0:
+        tpu = TPUInfo(
+            version=info.tpu.generation or "v4",
+            chips=info.tpu.chip_count,
+            topology=f"1x{info.tpu.chip_count}",
+            hosts=1,
+            chips_per_host=info.tpu.chip_count,
+        )
+    resources = Resources(
+        cpus=info.cpus,
+        memory_mib=info.memory_bytes // (1024 * 1024),
+        tpu=tpu,
+        disk_size_mib=info.disk_bytes // (1024 * 1024) or 102400,
+    )
+    offer = InstanceOfferWithAvailability(
+        backend=BackendType.REMOTE,
+        instance=InstanceType(name=info.hostname or rci.host, resources=resources),
+        region="remote",
+        price=0.0,
+    )
+    from dstack_tpu.core.models.instances import HostMetadata
+
+    jpd = JobProvisioningData(
+        backend=BackendType.REMOTE,
+        instance_type=offer.instance,
+        instance_id=row["id"],
+        hostname=rci.host,
+        internal_ip=rci_raw.get("internal_ip") or rci.host,
+        region="remote",
+        price=0.0,
+        username=rci.ssh_user,
+        ssh_port=rci.port,
+        dockerized=True,
+        hosts=[
+            HostMetadata(
+                worker_id=0,
+                internal_ip=rci_raw.get("internal_ip") or rci.host,
+                external_ip=rci.host,
+            )
+        ],
+    )
+    await db.update_by_id(
+        "instances",
+        row["id"],
+        {
+            "status": InstanceStatus.IDLE.value,
+            "offer": dumps(offer),
+            "job_provisioning_data": dumps(jpd),
+            "started_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    logger.info("adopted ssh-fleet host %s (%s)", rci.host, resources.pretty_format())
+
+
+# tests inject a fake ssh runner here
+_SSH_RUN_OVERRIDE = None
+
+
+async def _provision_failed(db: Database, row: dict, exc: Exception, what: str) -> None:
+    """Retry within the provisioning budget, then give up."""
+    logger.warning("%s failed: %s", what, exc)
+    created = datetime.fromisoformat(row["created_at"])
+    if now_utc() - created > timedelta(seconds=settings.PROVISIONING_TIMEOUT):
+        await _mark(
+            db, row, InstanceStatus.TERMINATED, termination_reason=str(exc)[:300]
+        )
+    else:
+        await _touch(db, row)
 
 
 async def _poll_provisioning(db: Database, row: dict) -> None:

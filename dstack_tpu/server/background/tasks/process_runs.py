@@ -51,11 +51,16 @@ async def _process(db: Database, run_id: str) -> None:
         return
     status = RunStatus(run_row["status"])
     job_rows = await jobs_service.latest_job_rows_for_run(db, run_id)
-    if status == RunStatus.TERMINATING.value or status == RunStatus.TERMINATING:
+    if status == RunStatus.TERMINATING:
         await _finish_if_jobs_done(db, run_row, job_rows)
         return
     if not job_rows:
         await _touch(db, run_id)
+        return
+
+    spec_conf = (loads(run_row["run_spec"]) or {}).get("configuration", {})
+    if spec_conf.get("type") == "service":
+        await _process_service_run(db, run_row, job_rows)
         return
 
     statuses = {JobStatus(r["status"]) for r in job_rows}
@@ -112,6 +117,122 @@ async def _process(db: Database, run_id: str) -> None:
                     )
     else:
         await _touch(db, run_id)
+
+
+# run_id -> monotonic time of the last replica-count change
+_last_scaled: dict[str, float] = {}
+
+
+async def _process_service_run(db: Database, run_row: dict, job_rows: list[dict]) -> None:
+    """Service replica reconciliation + status aggregation.
+
+    Parity: reference scale_run_replicas (runs.py:957) + the PENDING
+    resubmission loop (process_runs.py:130-183): failed replicas restart,
+    the RPS autoscaler adjusts the replica count, scaled-down replicas
+    terminate with reason SCALED_DOWN and don't fail the run.
+    """
+    import time as _time
+
+    from dstack_tpu.core.models.configurations import ServiceConfiguration
+    from dstack_tpu.core.models.runs import RunSpec
+    from dstack_tpu.server.services.autoscalers import get_service_scaler
+
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    conf = run_spec.configuration
+    assert isinstance(conf, ServiceConfiguration)
+    project = await db.get_by_id("projects", run_row["project_id"])
+
+    by_replica: dict[int, dict] = {r["replica_num"]: r for r in job_rows}
+    active = {
+        num: r
+        for num, r in by_replica.items()
+        if not JobStatus(r["status"]).is_finished()
+    }
+    scaler = get_service_scaler(conf)
+    desired = scaler.get_desired_count(
+        project["name"],
+        run_row["run_name"],
+        current=run_row.get("desired_replica_count", 1),
+        last_scaled_at=_last_scaled.get(run_row["id"]),
+    )
+    if desired != run_row.get("desired_replica_count"):
+        logger.info(
+            "service %s: scaling %d -> %d replicas",
+            run_row["run_name"],
+            run_row.get("desired_replica_count", 1),
+            desired,
+        )
+        _last_scaled[run_row["id"]] = _time.monotonic()
+        await db.update_by_id(
+            "runs", run_row["id"], {"desired_replica_count": desired}
+        )
+
+    # restart failed replicas / start replicas up to desired
+    from dstack_tpu.server.services.jobs.configurators import (
+        get_job_specs_from_run_spec,
+    )
+
+    for num in range(desired):
+        row = by_replica.get(num)
+        if row is not None and not JobStatus(row["status"]).is_finished():
+            continue
+        if row is not None and row.get("termination_reason") not in (
+            None,
+            JobTerminationReason.SCALED_DOWN.value,
+        ):
+            # crashed replica: restart ONLY when the retry policy covers
+            # the event — otherwise the run fails (no infinite crash loop)
+            if not await _maybe_retry(db, run_row, row):
+                await db.update_by_id(
+                    "runs",
+                    run_row["id"],
+                    {
+                        "status": RunStatus.TERMINATING.value,
+                        "termination_reason": RunTerminationReason.JOB_FAILED.value,
+                        "last_processed_at": now_utc().isoformat(),
+                    },
+                )
+                logger.info(
+                    "service %s: replica %d failed (%s) with no retry; failing run",
+                    run_row["run_name"],
+                    num,
+                    row.get("termination_reason"),
+                )
+                return
+            continue
+        sub = (row["submission_num"] + 1) if row is not None else 0
+        for spec in get_job_specs_from_run_spec(run_spec, replica_num=num):
+            await jobs_service.create_job_row(db, run_row, spec, submission_num=sub)
+        logger.info("service %s: (re)starting replica %d", run_row["run_name"], num)
+    # scale down excess replicas
+    for num, row in sorted(active.items(), reverse=True):
+        if num >= desired and row["status"] != JobStatus.TERMINATING.value:
+            await jobs_service.update_job_status(
+                db,
+                row["id"],
+                JobStatus.TERMINATING,
+                termination_reason=JobTerminationReason.SCALED_DOWN,
+            )
+
+    # aggregate status: RUNNING if any replica serves
+    statuses = {JobStatus(r["status"]) for r in job_rows}
+    status = RunStatus(run_row["status"])
+    new_status = None
+    if JobStatus.RUNNING in statuses:
+        new_status = RunStatus.RUNNING
+    elif statuses & {JobStatus.PROVISIONING, JobStatus.PULLING}:
+        new_status = RunStatus.PROVISIONING
+    if new_status is not None and new_status != status:
+        await db.update_by_id(
+            "runs",
+            run_row["id"],
+            {"status": new_status.value, "last_processed_at": now_utc().isoformat()},
+        )
+        logger.info(
+            "run %s: %s -> %s", run_row["run_name"], status.value, new_status.value
+        )
+    else:
+        await _touch(db, run_row["id"])
 
 
 async def _maybe_retry(db: Database, run_row: dict, job_row: dict) -> bool:

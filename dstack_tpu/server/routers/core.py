@@ -292,6 +292,30 @@ async def delete_volumes(ctx: RequestContext, body: s.DeleteVolumesRequest):
     await _delete(ctx.state["db"], ctx.project, body.names)
 
 
+# ---- gateways ----
+
+
+@project_router.post("/gateways/list")
+async def list_gateways(ctx: RequestContext):
+    from dstack_tpu.server.services.gateways import list_gateways as _list
+
+    return await _list(ctx.state["db"], ctx.project)
+
+
+@project_router.post("/gateways/create")
+async def create_gateway(ctx: RequestContext, body: s.ApplyGatewayRequest):
+    from dstack_tpu.server.services.gateways import create_gateway as _create
+
+    return await _create(ctx.state["db"], ctx.project, body.configuration)
+
+
+@project_router.post("/gateways/delete")
+async def delete_gateways(ctx: RequestContext, body: s.DeleteGatewaysRequest):
+    from dstack_tpu.server.services.gateways import delete_gateways as _delete
+
+    await _delete(ctx.state["db"], ctx.project, body.names)
+
+
 # ---- secrets ----
 
 

@@ -1,0 +1,72 @@
+"""Service replica autoscaling.
+
+Parity: reference server/services/services/autoscalers.py
+(``ManualScaler:38``, ``RPSAutoscaler:60``, ``get_service_scaler:111``).
+"""
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from dstack_tpu.core.models.configurations import ScalingSpec, ServiceConfiguration
+from dstack_tpu.core.models.resources import IntRange
+from dstack_tpu.proxy.stats import get_service_stats
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.autoscalers")
+
+
+@dataclass
+class ReplicaInfo:
+    active: int
+    last_scaled_at: Optional[float] = None
+
+
+class BaseScaler:
+    def get_desired_count(
+        self, project: str, run_name: str, current: int, last_scaled_at: Optional[float]
+    ) -> int:
+        raise NotImplementedError
+
+
+class ManualScaler(BaseScaler):
+    def __init__(self, replicas: IntRange):
+        self.replicas = replicas
+
+    def get_desired_count(self, project, run_name, current, last_scaled_at) -> int:
+        lo = self.replicas.min or 1
+        hi = self.replicas.max or lo
+        return min(max(current, lo), hi)
+
+
+class RPSAutoscaler(BaseScaler):
+    def __init__(self, replicas: IntRange, scaling: ScalingSpec):
+        self.replicas = replicas
+        self.scaling = scaling
+
+    def get_desired_count(self, project, run_name, current, last_scaled_at) -> int:
+        lo = self.replicas.min if self.replicas.min is not None else 0
+        hi = self.replicas.max or max(lo, 1)
+        rps = get_service_stats().rps(project, run_name, over_seconds=60.0)
+        # replicas needed so that per-replica RPS <= target
+        import math
+
+        needed = math.ceil(rps / self.scaling.target) if rps > 0 else lo
+        desired = min(max(needed, lo), hi)
+        now = time.monotonic()
+        if last_scaled_at is not None:
+            since = now - last_scaled_at
+            if desired > current and since < self.scaling.scale_up_delay:
+                return current
+            if desired < current and since < self.scaling.scale_down_delay:
+                return current
+        return desired
+
+
+def get_service_scaler(conf: ServiceConfiguration) -> BaseScaler:
+    replicas = conf.replicas
+    if not isinstance(replicas, IntRange):
+        replicas = IntRange.model_validate(replicas)
+    if conf.scaling is not None and replicas.min != replicas.max:
+        return RPSAutoscaler(replicas, conf.scaling)
+    return ManualScaler(replicas)

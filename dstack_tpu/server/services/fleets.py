@@ -106,6 +106,8 @@ async def apply_fleet(
                 "region": "remote",
                 "price": 0.0,
                 "remote_connection_info": dumps(rci),
+                # on-prem hosts are never auto-terminated for idleness
+                "termination_idle_time": -1,
                 "total_blocks": host.blocks,
                 "busy_blocks": 0,
                 "deleted": 0,

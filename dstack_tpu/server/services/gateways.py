@@ -1,0 +1,76 @@
+"""Gateway CRUD.
+
+Parity: reference server/services/gateways/ (create_gateway:129,
+connection pool, service sync). In this build the in-server proxy is the
+default ingress; gateway rows model dedicated ingress VMs — provisioning
+them requires a backend with ComputeWithGatewaySupport (the GCP gateway
+VM path is future work; the registry/API surface is complete).
+"""
+
+from datetime import datetime
+
+from dstack_tpu.core.errors import ClientError, ResourceNotExistsError
+from dstack_tpu.core.models.configurations import GatewayConfiguration
+from dstack_tpu.core.models.gateways import Gateway, GatewayStatus
+from dstack_tpu.core.models.runs import new_uuid, now_utc
+from dstack_tpu.server.db import Database, dumps, loads
+
+
+def gateway_row_to_model(row: dict, project_name: str) -> Gateway:
+    return Gateway(
+        id=row["id"],
+        name=row["name"],
+        project_name=project_name,
+        configuration=GatewayConfiguration.model_validate(loads(row["configuration"])),
+        created_at=datetime.fromisoformat(row["created_at"]),
+        status=GatewayStatus(row["status"]),
+        status_message=row.get("status_message"),
+        ip_address=row.get("ip_address"),
+        default=bool(row.get("is_default")),
+    )
+
+
+async def list_gateways(db: Database, project_row: dict) -> list[Gateway]:
+    rows = await db.fetchall(
+        "SELECT * FROM gateways WHERE project_id = ? ORDER BY created_at",
+        (project_row["id"],),
+    )
+    return [gateway_row_to_model(r, project_row["name"]) for r in rows]
+
+
+async def create_gateway(
+    db: Database, project_row: dict, conf: GatewayConfiguration
+) -> Gateway:
+    name = conf.name or f"gateway-{new_uuid()[:8]}"
+    existing = await db.fetchone(
+        "SELECT id FROM gateways WHERE project_id = ? AND name = ?",
+        (project_row["id"], name),
+    )
+    if existing is not None:
+        raise ClientError(f"gateway {name} already exists")
+    any_gateway = await db.fetchone(
+        "SELECT id FROM gateways WHERE project_id = ?", (project_row["id"],)
+    )
+    row = {
+        "id": new_uuid(),
+        "project_id": project_row["id"],
+        "name": name,
+        "status": GatewayStatus.SUBMITTED.value,
+        "configuration": dumps(conf),
+        "is_default": int(any_gateway is None),
+        "created_at": now_utc().isoformat(),
+        "last_processed_at": now_utc().isoformat(),
+    }
+    await db.insert("gateways", row)
+    return gateway_row_to_model(row, project_row["name"])
+
+
+async def delete_gateways(db: Database, project_row: dict, names: list[str]) -> None:
+    for name in names:
+        row = await db.fetchone(
+            "SELECT id FROM gateways WHERE project_id = ? AND name = ?",
+            (project_row["id"], name),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"gateway {name} not found")
+        await db.execute("DELETE FROM gateways WHERE id = ?", (row["id"],))

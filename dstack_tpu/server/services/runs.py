@@ -161,6 +161,15 @@ async def submit_run(
             raise ResourceExistsError(
                 f"run {run_spec.run_name} already exists and is active"
             )
+    service_spec = None
+    if isinstance(run_spec.configuration, ServiceConfiguration):
+        from dstack_tpu.proxy.service_proxy import service_url
+
+        model = run_spec.configuration.model
+        service_spec = ServiceSpec(
+            url=service_url(project_row["name"], run_spec.run_name),
+            model=model.model_dump() if model is not None else None,
+        )
     run_row = {
         "id": new_uuid(),
         "project_id": project_row["id"],
@@ -168,6 +177,7 @@ async def submit_run(
         "run_name": run_spec.run_name,
         "status": RunStatus.SUBMITTED.value,
         "run_spec": dumps(run_spec),
+        "service_spec": dumps(service_spec) if service_spec else None,
         "desired_replica_count": _desired_replica_count(run_spec),
         "deleted": 0,
         "submitted_at": now_utc().isoformat(),

@@ -1,0 +1,173 @@
+"""GCP TPU backend against a fake transport (the reference mocks the
+google SDK similarly; SURVEY.md §4 'cloud-mocked')."""
+
+import json
+
+import pytest
+
+from dstack_tpu.backends.gcp.compute import GCPTPUCompute
+from dstack_tpu.core.errors import ComputeError
+from dstack_tpu.core.models.instances import InstanceConfiguration
+from dstack_tpu.core.models.resources import ResourcesSpec
+from dstack_tpu.core.models.runs import Requirements
+
+
+class FakeTransport:
+    def __init__(self):
+        self.calls = []
+        self.nodes = {}
+
+    async def request(self, method, url, json_body=None, params=None):
+        self.calls.append((method, url, json_body, params))
+        if method == "POST" and url.endswith("/nodes"):
+            node_id = params["nodeId"]
+            self.nodes[node_id] = {
+                "state": "CREATING",
+                "acceleratorType": json_body["acceleratorType"],
+            }
+            return {"name": f"operations/create-{node_id}"}
+        if method == "POST" and url.endswith("/queuedResources"):
+            node_id = json_body["tpu"]["nodeSpec"][0]["nodeId"]
+            self.nodes[node_id] = {"state": "CREATING", "queued": True}
+            return {"name": "operations/qr"}
+        if method == "GET" and "/nodes/" in url:
+            node_id = url.rsplit("/", 1)[1]
+            return self.nodes.get(node_id, {"state": "TERMINATED"})
+        if method == "DELETE":
+            node_id = url.rsplit("/", 1)[1]
+            self.nodes.pop(node_id, None)
+            return {}
+        if method == "PATCH":
+            node_id = url.rsplit("/", 1)[1]
+            self.nodes[node_id]["dataDisks"] = json_body["dataDisks"]
+            return {}
+        return {}
+
+
+def _compute():
+    t = FakeTransport()
+    return GCPTPUCompute({"project_id": "test-proj"}, transport=t), t
+
+
+class TestOffers:
+    async def test_offers_from_catalog(self):
+        compute, _ = _compute()
+        req = Requirements(
+            resources=ResourcesSpec.model_validate({"tpu": "v5e-8"}), spot=False
+        )
+        offers = await compute.get_offers(req)
+        assert offers
+        assert all(o.instance.name == "v5litepod-8" for o in offers)
+        assert all(not o.instance.resources.spot for o in offers)
+        assert offers[0].availability_zones
+
+    async def test_multihost_offers_exist(self):
+        compute, _ = _compute()
+        req = Requirements(
+            resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5p", "chips": 64}}
+            )
+        )
+        offers = await compute.get_offers(req)
+        assert offers
+        tpu = offers[0].instance.resources.tpu
+        assert tpu.hosts == 16 and tpu.accelerator_type == "v5p-128"
+
+
+class TestCreatePoll:
+    async def test_create_and_poll_multihost(self):
+        compute, t = _compute()
+        req = Requirements(
+            resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5e", "chips": 16}}
+            )
+        )
+        offers = await compute.get_offers(req)
+        offer = offers[0]
+        jpd = await compute.create_instance(
+            offer,
+            InstanceConfiguration(
+                project_name="main",
+                instance_name="run-0-0",
+                ssh_public_keys=["ssh-ed25519 AAA"],
+            ),
+        )
+        assert jpd.hostname is None  # IPs come later
+        bd = json.loads(jpd.backend_data)
+        node = t.nodes[bd["node_id"]]
+        # startup script installs the shim on every worker
+        assert node["state"] == "CREATING"
+        create_call = next(c for c in t.calls if c[0] == "POST")
+        assert "tpu-shim" in create_call[2]["metadata"]["startup-script"]
+
+        # still creating -> unchanged
+        jpd2 = await compute.update_provisioning_data(jpd)
+        assert jpd2.hostname is None
+        # node READY with all 2 workers
+        t.nodes[bd["node_id"]] = {
+            "state": "READY",
+            "networkEndpoints": [
+                {"ipAddress": "10.0.0.2", "accessConfig": {"externalIp": "34.0.0.2"}},
+                {"ipAddress": "10.0.0.3"},
+            ],
+        }
+        jpd3 = await compute.update_provisioning_data(jpd)
+        assert jpd3.hostname == "34.0.0.2"
+        assert len(jpd3.hosts) == 2
+        assert jpd3.hosts[1].external_ip is None  # worker 1: internal only
+
+    async def test_partial_workers_not_ready(self):
+        compute, t = _compute()
+        req = Requirements(
+            resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5e", "chips": 16}}  # 2 hosts
+            )
+        )
+        offer = (await compute.get_offers(req))[0]
+        jpd = await compute.create_instance(
+            offer, InstanceConfiguration(project_name="main", instance_name="x")
+        )
+        bd = json.loads(jpd.backend_data)
+        t.nodes[bd["node_id"]] = {
+            "state": "READY",
+            "networkEndpoints": [{"ipAddress": "10.0.0.2"}],  # only 1 of 2
+        }
+        jpd = await compute.update_provisioning_data(jpd)
+        assert jpd.hostname is None  # all-or-nothing
+
+    async def test_preempted_raises(self):
+        compute, t = _compute()
+        req = Requirements(
+            resources=ResourcesSpec.model_validate({"tpu": "v5e-8"}), spot=True
+        )
+        offer = (await compute.get_offers(req))[0]
+        jpd = await compute.create_instance(
+            offer, InstanceConfiguration(project_name="main", instance_name="sp")
+        )
+        bd = json.loads(jpd.backend_data)
+        t.nodes[bd["node_id"]]["state"] = "PREEMPTED"
+        with pytest.raises(ComputeError):
+            await compute.update_provisioning_data(jpd)
+
+    async def test_big_slice_uses_queued_resources(self):
+        compute, t = _compute()
+        req = Requirements(
+            resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5p", "chips": 64}}  # 16 hosts
+            )
+        )
+        offer = (await compute.get_offers(req))[0]
+        await compute.create_instance(
+            offer, InstanceConfiguration(project_name="main", instance_name="big")
+        )
+        assert any("queuedResources" in c[1] for c in t.calls)
+
+    async def test_terminate(self):
+        compute, t = _compute()
+        req = Requirements(resources=ResourcesSpec.model_validate({"tpu": "v5e-8"}))
+        offer = (await compute.get_offers(req))[0]
+        jpd = await compute.create_instance(
+            offer, InstanceConfiguration(project_name="main", instance_name="gone")
+        )
+        await compute.terminate_instance(jpd.instance_id, jpd.region, jpd.backend_data)
+        assert not t.nodes

@@ -1,0 +1,189 @@
+"""Service runs: proxy ingress, model registry, autoscaler."""
+
+import asyncio
+import json
+import time
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.core.models.configurations import (
+    ScalingSpec,
+    ServiceConfiguration,
+)
+from dstack_tpu.core.models.resources import IntRange
+from dstack_tpu.proxy.stats import ServiceStats, get_service_stats
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.services.autoscalers import (
+    ManualScaler,
+    RPSAutoscaler,
+    get_service_scaler,
+)
+
+
+def _auth(token):
+    return {"Authorization": f"Bearer {token}"}
+
+
+SERVICE_BODY = {
+    "run_spec": {
+        "run_name": "echo-svc",
+        "configuration": {
+            "type": "service",
+            "commands": [
+                "python -c \""
+                "import http.server,json;"
+                "h=type('H',(http.server.BaseHTTPRequestHandler,),{"
+                "'do_GET':lambda s:(s.send_response(200),s.end_headers(),"
+                "s.wfile.write(b'echo-ok')),"
+                "'log_message':lambda s,*a:None});"
+                "http.server.HTTPServer(('127.0.0.1',18123),h).serve_forever()\""
+            ],
+            "port": 18123,
+            "model": "test-model",
+        },
+        "ssh_key_pub": "ssh-ed25519 AAAA t",
+    }
+}
+
+
+class TestServiceE2E:
+    async def test_service_proxied_and_model_listed(self, tmp_path):
+        from pathlib import Path
+
+        from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
+
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="svc-tok",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth("svc-tok"), json=SERVICE_BODY
+            )
+            assert r.status == 200
+            run = await r.json()
+            assert run["service"]["url"] == "/proxy/services/main/echo-svc/"
+
+            # wait for the replica to run
+            deadline = asyncio.get_event_loop().time() + 60
+            while asyncio.get_event_loop().time() < deadline:
+                r = await client.post(
+                    "/api/project/main/runs/get",
+                    headers=_auth("svc-tok"),
+                    json={"run_name": "echo-svc"},
+                )
+                run = await r.json()
+                if run["status"] == "running":
+                    break
+                assert run["status"] not in ("failed", "terminated"), run
+                await asyncio.sleep(0.5)
+            assert run["status"] == "running"
+            await asyncio.sleep(1.0)  # service process boot
+
+            # ingress through the in-server proxy (no auth needed)
+            for _ in range(20):
+                r = await client.get("/proxy/services/main/echo-svc/hello")
+                if r.status == 200:
+                    break
+                await asyncio.sleep(0.5)
+            assert r.status == 200
+            assert await r.text() == "echo-ok"
+
+            # model registry lists the service's model
+            r = await client.get("/proxy/models/main/models")
+            data = await r.json()
+            assert [m["id"] for m in data["data"]] == ["test-model"]
+
+            # requests were recorded for the autoscaler
+            assert get_service_stats().rps("main", "echo-svc", over_seconds=60) > 0
+
+            # stop
+            await client.post(
+                "/api/project/main/runs/stop",
+                headers=_auth("svc-tok"),
+                json={"runs_names": ["echo-svc"]},
+            )
+        finally:
+            await client.close()
+
+    async def test_proxy_503_when_no_replicas(self):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="svc-tok",
+            with_background=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/proxy/services/main/ghost/x")
+            assert r.status == 503
+        finally:
+            await client.close()
+
+
+class TestAutoscaler:
+    def test_manual_scaler_clamps(self):
+        s = ManualScaler(IntRange(min=2, max=2))
+        assert s.get_desired_count("p", "r", current=1, last_scaled_at=None) == 2
+
+    def test_rps_scaler_scales_up(self, monkeypatch):
+        stats = ServiceStats()
+        monkeypatch.setattr(
+            "dstack_tpu.server.services.autoscalers.get_service_stats", lambda: stats
+        )
+        for _ in range(600):  # 10 rps over the last minute
+            stats.record("p", "r")
+        s = RPSAutoscaler(
+            IntRange(min=1, max=4),
+            ScalingSpec(metric="rps", target=5, scale_up_delay=0, scale_down_delay=0),
+        )
+        assert s.get_desired_count("p", "r", current=1, last_scaled_at=None) == 2
+
+    def test_rps_scaler_respects_delay(self, monkeypatch):
+        stats = ServiceStats()
+        monkeypatch.setattr(
+            "dstack_tpu.server.services.autoscalers.get_service_stats", lambda: stats
+        )
+        for _ in range(600):
+            stats.record("p", "r")
+        s = RPSAutoscaler(
+            IntRange(min=1, max=4),
+            ScalingSpec(metric="rps", target=5, scale_up_delay=300, scale_down_delay=600),
+        )
+        # just scaled: delay blocks the change
+        assert (
+            s.get_desired_count("p", "r", current=1, last_scaled_at=time.monotonic())
+            == 1
+        )
+
+    def test_rps_scaler_scale_down_to_min(self, monkeypatch):
+        stats = ServiceStats()
+        monkeypatch.setattr(
+            "dstack_tpu.server.services.autoscalers.get_service_stats", lambda: stats
+        )
+        s = RPSAutoscaler(
+            IntRange(min=1, max=4),
+            ScalingSpec(metric="rps", target=5, scale_up_delay=0, scale_down_delay=0),
+        )
+        assert s.get_desired_count("p", "r", current=3, last_scaled_at=None) == 1
+
+    def test_get_service_scaler_dispatch(self):
+        manual = ServiceConfiguration.model_validate(
+            {"type": "service", "commands": ["x"], "port": 80, "replicas": 2}
+        )
+        assert isinstance(get_service_scaler(manual), ManualScaler)
+        auto = ServiceConfiguration.model_validate(
+            {
+                "type": "service",
+                "commands": ["x"],
+                "port": 80,
+                "replicas": "1..4",
+                "scaling": {"metric": "rps", "target": 10},
+            }
+        )
+        assert isinstance(get_service_scaler(auto), RPSAutoscaler)

@@ -1,0 +1,100 @@
+"""SSH-fleet adoption with a fake ssh runner."""
+
+import json
+
+from dstack_tpu.agent import schemas as agent_schemas
+from dstack_tpu.core.models.instances import InstanceStatus
+from dstack_tpu.server.background.tasks import process_instances as pi
+from dstack_tpu.server.background.tasks.process_instances import process_instances
+from dstack_tpu.server.db import loads
+from dstack_tpu.server.services.fleets import apply_fleet, list_fleets
+from dstack_tpu.server.testing.common import (
+    create_test_db,
+    create_test_project,
+    create_test_user,
+)
+from dstack_tpu.core.models.configurations import FleetConfiguration
+
+
+def fake_ssh_runner(host_info: dict):
+    async def run(rci, command):
+        if "host_info.json" in command and "cat" in command:
+            return 0, json.dumps(host_info)
+        return 0, ""
+
+    return run
+
+
+HOST_INFO = {
+    "cpus": 96,
+    "memory_bytes": 340 * 2**30,
+    "disk_bytes": 1000 * 2**30,
+    "hostname": "tpu-host-1",
+    "tpu": {
+        "chip_count": 4,
+        "device_paths": ["/dev/accel0", "/dev/accel1", "/dev/accel2", "/dev/accel3"],
+        "generation": "v4",
+    },
+}
+
+
+class TestSSHFleetAdoption:
+    async def test_fleet_apply_creates_pending_hosts(self):
+        db = await create_test_db()
+        _, user_row = await create_test_user(db)
+        project_row = await create_test_project(db, user_row)
+        conf = FleetConfiguration.model_validate(
+            {
+                "type": "fleet",
+                "name": "onprem",
+                "ssh_config": {"user": "ubuntu", "hosts": ["10.1.0.1", "10.1.0.2"]},
+            }
+        )
+        fleet = await apply_fleet(db, project_row, user_row, conf)
+        assert len(fleet.instances) == 2
+        assert all(i.status == InstanceStatus.PENDING for i in fleet.instances)
+
+    async def test_adoption_handshake(self, monkeypatch):
+        db = await create_test_db()
+        _, user_row = await create_test_user(db)
+        project_row = await create_test_project(db, user_row)
+        conf = FleetConfiguration.model_validate(
+            {
+                "type": "fleet",
+                "name": "onprem",
+                "ssh_config": {"user": "ubuntu", "hosts": ["10.1.0.1"]},
+            }
+        )
+        await apply_fleet(db, project_row, user_row, conf)
+        monkeypatch.setattr(pi, "_SSH_RUN_OVERRIDE", fake_ssh_runner(HOST_INFO))
+        await process_instances(db)
+        inst = await db.fetchone("SELECT * FROM instances")
+        assert inst["status"] == InstanceStatus.IDLE.value
+        offer = loads(inst["offer"])
+        assert offer["instance"]["resources"]["tpu"]["chips"] == 4
+        assert offer["instance"]["resources"]["tpu"]["version"] == "v4"
+        jpd = loads(inst["job_provisioning_data"])
+        assert jpd["hostname"] == "10.1.0.1"
+        assert jpd["username"] == "ubuntu"
+
+    async def test_adoption_failure_retries_then_times_out(self, monkeypatch):
+        db = await create_test_db()
+        _, user_row = await create_test_user(db)
+        project_row = await create_test_project(db, user_row)
+        conf = FleetConfiguration.model_validate(
+            {
+                "type": "fleet",
+                "name": "bad",
+                "ssh_config": {"user": "x", "hosts": ["10.9.9.9"]},
+            }
+        )
+        await apply_fleet(db, project_row, user_row, conf)
+
+        async def failing_run(rci, command):
+            return 255, "connection refused"
+
+        monkeypatch.setattr(pi, "_SSH_RUN_OVERRIDE", failing_run)
+        await process_instances(db)
+        inst = await db.fetchone("SELECT * FROM instances")
+        # still pending (retrying within the provisioning budget)
+        assert inst["status"] == InstanceStatus.PENDING.value
