@@ -1,0 +1,389 @@
+// Minimal threaded HTTP/1.1 server + client for the native agents.
+// Parity: the reference Go agents use net/http (runner/internal/api);
+// here a thread-per-connection server (agent traffic is a handful of
+// control-plane calls per second — simplicity over epoll) and a
+// blocking client that also speaks HTTP over unix sockets (Docker API).
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace dtpu::http {
+
+struct Request {
+  std::string method;
+  std::string path;               // without query string
+  std::map<std::string, std::string> query;
+  std::map<std::string, std::string> headers;
+  std::string body;
+  std::vector<std::string> path_params;  // wildcard captures in route order
+};
+
+struct Response {
+  int status = 200;
+  std::string content_type = "application/json";
+  std::string body;
+};
+
+using Handler = std::function<Response(const Request&)>;
+
+namespace detail {
+
+inline std::string url_decode(const std::string& s) {
+  std::string out;
+  for (size_t i = 0; i < s.size(); i++) {
+    if (s[i] == '%' && i + 2 < s.size()) {
+      out += static_cast<char>(std::stoi(s.substr(i + 1, 2), nullptr, 16));
+      i += 2;
+    } else if (s[i] == '+') {
+      out += ' ';
+    } else {
+      out += s[i];
+    }
+  }
+  return out;
+}
+
+inline bool read_exact(int fd, std::string& buf, size_t n) {
+  size_t start = buf.size();
+  buf.resize(start + n);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::read(fd, &buf[start + got], n - got);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// read until \r\n\r\n, return header block; leftover goes into `extra`
+inline bool read_headers(int fd, std::string& headers, std::string& extra) {
+  std::string data;
+  char chunk[4096];
+  while (true) {
+    size_t pos = data.find("\r\n\r\n");
+    if (pos != std::string::npos) {
+      headers = data.substr(0, pos + 4);
+      extra = data.substr(pos + 4);
+      return true;
+    }
+    ssize_t r = ::read(fd, chunk, sizeof chunk);
+    if (r <= 0) return false;
+    data.append(chunk, static_cast<size_t>(r));
+    if (data.size() > 1 << 20) return false;  // header flood guard
+  }
+}
+
+inline bool write_all(int fd, const std::string& data) {
+  size_t sent = 0;
+  while (sent < data.size()) {
+    ssize_t w = ::write(fd, data.data() + sent, data.size() - sent);
+    if (w <= 0) return false;
+    sent += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+inline std::string lower(std::string s) {
+  for (auto& c : s) c = static_cast<char>(tolower(c));
+  return s;
+}
+
+}  // namespace detail
+
+// Route pattern: literal segments or "*" captures, e.g.
+// "/api/tasks/*/terminate" -> path_params = [task_id].
+class Router {
+ public:
+  void add(const std::string& method, const std::string& pattern, Handler h) {
+    routes_.push_back({method, split(pattern), std::move(h)});
+  }
+
+  Response dispatch(Request& req) const {
+    auto segs = split(req.path);
+    for (const auto& r : routes_) {
+      if (r.method != req.method) continue;
+      std::vector<std::string> params;
+      if (match(r.pattern, segs, params)) {
+        req.path_params = std::move(params);
+        return r.handler(req);
+      }
+    }
+    return Response{404, "application/json", "{\"detail\":\"not found\"}"};
+  }
+
+ private:
+  struct Route {
+    std::string method;
+    std::vector<std::string> pattern;
+    Handler handler;
+  };
+  std::vector<Route> routes_;
+
+  static std::vector<std::string> split(const std::string& p) {
+    std::vector<std::string> out;
+    std::stringstream ss(p);
+    std::string seg;
+    while (std::getline(ss, seg, '/')) {
+      if (!seg.empty()) out.push_back(seg);
+    }
+    return out;
+  }
+
+  static bool match(const std::vector<std::string>& pat,
+                    const std::vector<std::string>& segs,
+                    std::vector<std::string>& params) {
+    if (pat.size() != segs.size()) return false;
+    for (size_t i = 0; i < pat.size(); i++) {
+      if (pat[i] == "*") {
+        params.push_back(segs[i]);
+      } else if (pat[i] != segs[i]) {
+        return false;
+      }
+    }
+    return true;
+  }
+};
+
+class Server {
+ public:
+  explicit Server(Router router) : router_(std::move(router)) {}
+
+  // returns the bound port (useful with port=0)
+  int listen_and_serve(int port, std::atomic<bool>* stop = nullptr) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) return -1;
+    socklen_t len = sizeof addr;
+    getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    bound_port_ = ntohs(addr.sin_port);
+    ::listen(fd_, 64);
+    accept_thread_ = std::thread([this, stop] { accept_loop(stop); });
+    return bound_port_;
+  }
+
+  int port() const { return bound_port_; }
+
+  void shutdown() {
+    stopping_ = true;
+    if (fd_ >= 0) {
+      ::shutdown(fd_, SHUT_RDWR);
+      ::close(fd_);
+      fd_ = -1;
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+  }
+
+  ~Server() { shutdown(); }
+
+ private:
+  Router router_;
+  int fd_ = -1;
+  int bound_port_ = 0;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+
+  void accept_loop(std::atomic<bool>* stop) {
+    while (!stopping_ && (stop == nullptr || !*stop)) {
+      int client = ::accept(fd_, nullptr, nullptr);
+      if (client < 0) {
+        if (stopping_) break;
+        continue;
+      }
+      std::thread([this, client] {
+        handle(client);
+        ::close(client);
+      }).detach();
+    }
+  }
+
+  void handle(int client) {
+    std::string head, extra;
+    if (!detail::read_headers(client, head, extra)) return;
+    Request req;
+    std::istringstream hs(head);
+    std::string line;
+    std::getline(hs, line);
+    {
+      std::istringstream rl(line);
+      std::string target, version;
+      rl >> req.method >> target >> version;
+      auto qpos = target.find('?');
+      req.path = detail::url_decode(target.substr(0, qpos));
+      if (qpos != std::string::npos) {
+        std::stringstream qs(target.substr(qpos + 1));
+        std::string pair;
+        while (std::getline(qs, pair, '&')) {
+          auto eq = pair.find('=');
+          if (eq != std::string::npos) {
+            req.query[detail::url_decode(pair.substr(0, eq))] =
+                detail::url_decode(pair.substr(eq + 1));
+          }
+        }
+      }
+    }
+    while (std::getline(hs, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      auto colon = line.find(':');
+      if (colon != std::string::npos) {
+        std::string key = detail::lower(line.substr(0, colon));
+        std::string val = line.substr(colon + 1);
+        while (!val.empty() && val.front() == ' ') val.erase(0, 1);
+        req.headers[key] = val;
+      }
+    }
+    size_t content_length = 0;
+    auto it = req.headers.find("content-length");
+    if (it != req.headers.end()) content_length = std::stoul(it->second);
+    req.body = extra;
+    if (req.body.size() < content_length) {
+      if (!detail::read_exact(client, req.body, content_length - req.body.size()))
+        return;
+    }
+    Response resp;
+    try {
+      resp = router_.dispatch(req);
+    } catch (const std::exception& e) {
+      resp = Response{500, "application/json",
+                      std::string("{\"detail\":\"") + e.what() + "\"}"};
+    }
+    std::ostringstream out;
+    out << "HTTP/1.1 " << resp.status << " X\r\n"
+        << "Content-Type: " << resp.content_type << "\r\n"
+        << "Content-Length: " << resp.body.size() << "\r\n"
+        << "Connection: close\r\n\r\n"
+        << resp.body;
+    detail::write_all(client, out.str());
+  }
+};
+
+// Blocking HTTP client over TCP or a unix socket (Docker API).
+class Client {
+ public:
+  static Response request_tcp(const std::string& host, int port,
+                              const std::string& method, const std::string& target,
+                              const std::string& body = "") {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+      ::close(fd);
+      return Response{599, "text/plain", "connect failed"};
+    }
+    Response r = roundtrip(fd, host, method, target, body);
+    ::close(fd);
+    return r;
+  }
+
+  static Response request_unix(const std::string& socket_path,
+                               const std::string& method, const std::string& target,
+                               const std::string& body = "") {
+    int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    strncpy(addr.sun_path, socket_path.c_str(), sizeof(addr.sun_path) - 1);
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+      ::close(fd);
+      return Response{599, "text/plain", "connect failed"};
+    }
+    Response r = roundtrip(fd, "docker", method, target, body);
+    ::close(fd);
+    return r;
+  }
+
+ private:
+  static Response roundtrip(int fd, const std::string& host,
+                            const std::string& method, const std::string& target,
+                            const std::string& body) {
+    std::ostringstream req;
+    req << method << ' ' << target << " HTTP/1.1\r\n"
+        << "Host: " << host << "\r\n"
+        << "Content-Type: application/json\r\n"
+        << "Content-Length: " << body.size() << "\r\n"
+        << "Connection: close\r\n\r\n"
+        << body;
+    if (!detail::write_all(fd, req.str())) {
+      return Response{599, "text/plain", "write failed"};
+    }
+    std::string head, extra;
+    if (!detail::read_headers(fd, head, extra)) {
+      return Response{599, "text/plain", "read failed"};
+    }
+    Response resp;
+    std::istringstream hs(head);
+    std::string line;
+    std::getline(hs, line);
+    {
+      std::istringstream sl(line);
+      std::string version;
+      sl >> version >> resp.status;
+    }
+    size_t content_length = std::string::npos;
+    bool chunked = false;
+    while (std::getline(hs, line)) {
+      if (!line.empty() && line.back() == '\r') line.pop_back();
+      std::string low = detail::lower(line);
+      if (low.rfind("content-length:", 0) == 0) {
+        content_length = std::stoul(line.substr(15));
+      }
+      if (low.rfind("transfer-encoding:", 0) == 0 &&
+          low.find("chunked") != std::string::npos) {
+        chunked = true;
+      }
+    }
+    resp.body = extra;
+    if (chunked) {
+      // drain remaining then de-chunk
+      char chunk[4096];
+      ssize_t r;
+      while ((r = ::read(fd, chunk, sizeof chunk)) > 0)
+        resp.body.append(chunk, static_cast<size_t>(r));
+      resp.body = dechunk(resp.body);
+    } else if (content_length != std::string::npos) {
+      if (resp.body.size() < content_length) {
+        detail::read_exact(fd, resp.body, content_length - resp.body.size());
+      }
+    } else {
+      char chunk[4096];
+      ssize_t r;
+      while ((r = ::read(fd, chunk, sizeof chunk)) > 0)
+        resp.body.append(chunk, static_cast<size_t>(r));
+    }
+    return resp;
+  }
+
+  static std::string dechunk(const std::string& data) {
+    std::string out;
+    size_t pos = 0;
+    while (pos < data.size()) {
+      size_t nl = data.find("\r\n", pos);
+      if (nl == std::string::npos) break;
+      size_t len = std::stoul(data.substr(pos, nl - pos), nullptr, 16);
+      if (len == 0) break;
+      out += data.substr(nl + 2, len);
+      pos = nl + 2 + len + 2;
+    }
+    return out;
+  }
+};
+
+}  // namespace dtpu::http

@@ -1,0 +1,290 @@
+// Minimal JSON value + parser/serializer for the native agents.
+// No external deps are available in the build image (no nlohmann), and
+// the wire schemas (agent/schemas.py) only need objects/arrays/strings/
+// numbers/bools — a compact hand-rolled implementation keeps the agents
+// dependency-free (parity: reference Go agents use encoding/json).
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <variant>
+#include <vector>
+
+namespace dtpu::json {
+
+class Value;
+using Array = std::vector<Value>;
+using Object = std::map<std::string, Value>;
+
+class Value {
+ public:
+  using Storage =
+      std::variant<std::nullptr_t, bool, double, std::string, Array, Object>;
+
+  Value() : v_(nullptr) {}
+  Value(std::nullptr_t) : v_(nullptr) {}
+  Value(bool b) : v_(b) {}
+  Value(int i) : v_(static_cast<double>(i)) {}
+  Value(int64_t i) : v_(static_cast<double>(i)) {}
+  Value(double d) : v_(d) {}
+  Value(const char* s) : v_(std::string(s)) {}
+  Value(std::string s) : v_(std::move(s)) {}
+  Value(Array a) : v_(std::move(a)) {}
+  Value(Object o) : v_(std::move(o)) {}
+
+  bool is_null() const { return std::holds_alternative<std::nullptr_t>(v_); }
+  bool is_bool() const { return std::holds_alternative<bool>(v_); }
+  bool is_number() const { return std::holds_alternative<double>(v_); }
+  bool is_string() const { return std::holds_alternative<std::string>(v_); }
+  bool is_array() const { return std::holds_alternative<Array>(v_); }
+  bool is_object() const { return std::holds_alternative<Object>(v_); }
+
+  bool as_bool(bool def = false) const {
+    return is_bool() ? std::get<bool>(v_) : def;
+  }
+  double as_number(double def = 0) const {
+    return is_number() ? std::get<double>(v_) : def;
+  }
+  int64_t as_int(int64_t def = 0) const {
+    return is_number() ? static_cast<int64_t>(std::get<double>(v_)) : def;
+  }
+  const std::string& as_string() const {
+    static const std::string empty;
+    return is_string() ? std::get<std::string>(v_) : empty;
+  }
+  const Array& as_array() const {
+    static const Array empty;
+    return is_array() ? std::get<Array>(v_) : empty;
+  }
+  const Object& as_object() const {
+    static const Object empty;
+    return is_object() ? std::get<Object>(v_) : empty;
+  }
+
+  // object access; returns null Value for missing keys
+  const Value& operator[](const std::string& key) const {
+    static const Value null_value;
+    if (!is_object()) return null_value;
+    auto& o = std::get<Object>(v_);
+    auto it = o.find(key);
+    return it == o.end() ? null_value : it->second;
+  }
+  Value& set(const std::string& key, Value val) {
+    if (!is_object()) v_ = Object{};
+    std::get<Object>(v_)[key] = std::move(val);
+    return *this;
+  }
+  void push_back(Value val) {
+    if (!is_array()) v_ = Array{};
+    std::get<Array>(v_).push_back(std::move(val));
+  }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+  void write(std::ostream& os) const {
+    if (is_null()) {
+      os << "null";
+    } else if (is_bool()) {
+      os << (as_bool() ? "true" : "false");
+    } else if (is_number()) {
+      double d = std::get<double>(v_);
+      if (d == static_cast<int64_t>(d) && std::abs(d) < 1e15) {
+        os << static_cast<int64_t>(d);
+      } else {
+        os.precision(17);
+        os << d;
+      }
+    } else if (is_string()) {
+      write_string(os, std::get<std::string>(v_));
+    } else if (is_array()) {
+      os << '[';
+      bool first = true;
+      for (const auto& e : std::get<Array>(v_)) {
+        if (!first) os << ',';
+        first = false;
+        e.write(os);
+      }
+      os << ']';
+    } else {
+      os << '{';
+      bool first = true;
+      for (const auto& [k, val] : std::get<Object>(v_)) {
+        if (!first) os << ',';
+        first = false;
+        write_string(os, k);
+        os << ':';
+        val.write(os);
+      }
+      os << '}';
+    }
+  }
+
+  static Value parse(const std::string& text) {
+    size_t pos = 0;
+    Value v = parse_value(text, pos);
+    skip_ws(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON data");
+    return v;
+  }
+
+ private:
+  Storage v_;
+
+  static void write_string(std::ostream& os, const std::string& s) {
+    os << '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\r': os << "\\r"; break;
+        case '\t': os << "\\t"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  static void skip_ws(const std::string& t, size_t& p) {
+    while (p < t.size() && (t[p] == ' ' || t[p] == '\t' || t[p] == '\n' || t[p] == '\r')) p++;
+  }
+
+  static Value parse_value(const std::string& t, size_t& p) {
+    skip_ws(t, p);
+    if (p >= t.size()) throw std::runtime_error("unexpected end of JSON");
+    char c = t[p];
+    if (c == '{') return parse_object(t, p);
+    if (c == '[') return parse_array(t, p);
+    if (c == '"') return Value(parse_string(t, p));
+    if (c == 't') { expect(t, p, "true"); return Value(true); }
+    if (c == 'f') { expect(t, p, "false"); return Value(false); }
+    if (c == 'n') { expect(t, p, "null"); return Value(nullptr); }
+    return parse_number(t, p);
+  }
+
+  static void expect(const std::string& t, size_t& p, const char* word) {
+    size_t n = strlen(word);
+    if (t.compare(p, n, word) != 0) throw std::runtime_error("bad JSON literal");
+    p += n;
+  }
+
+  static Value parse_number(const std::string& t, size_t& p) {
+    size_t start = p;
+    while (p < t.size() && (isdigit((unsigned char)t[p]) || strchr("+-.eE", t[p]))) p++;
+    try {
+      return Value(std::stod(t.substr(start, p - start)));
+    } catch (...) {
+      throw std::runtime_error("bad JSON number");
+    }
+  }
+
+  static std::string parse_string(const std::string& t, size_t& p) {
+    if (t[p] != '"') throw std::runtime_error("expected string");
+    p++;
+    std::string out;
+    while (p < t.size() && t[p] != '"') {
+      char c = t[p++];
+      if (c == '\\' && p < t.size()) {
+        char e = t[p++];
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'u': {
+            if (p + 4 > t.size()) throw std::runtime_error("bad \\u escape");
+            unsigned code = std::stoul(t.substr(p, 4), nullptr, 16);
+            p += 4;
+            // minimal UTF-8 encode (BMP only; surrogate pairs combined)
+            if (code >= 0xD800 && code <= 0xDBFF && p + 6 <= t.size() &&
+                t[p] == '\\' && t[p + 1] == 'u') {
+              unsigned low = std::stoul(t.substr(p + 2, 4), nullptr, 16);
+              p += 6;
+              code = 0x10000 + ((code - 0xD800) << 10) + (low - 0xDC00);
+            }
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else if (code < 0x10000) {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xF0 | (code >> 18));
+              out += static_cast<char>(0x80 | ((code >> 12) & 0x3F));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    if (p >= t.size()) throw std::runtime_error("unterminated string");
+    p++;  // closing quote
+    return out;
+  }
+
+  static Value parse_array(const std::string& t, size_t& p) {
+    p++;  // [
+    Array arr;
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == ']') { p++; return Value(std::move(arr)); }
+    while (true) {
+      arr.push_back(parse_value(t, p));
+      skip_ws(t, p);
+      if (p >= t.size()) throw std::runtime_error("unterminated array");
+      if (t[p] == ',') { p++; continue; }
+      if (t[p] == ']') { p++; break; }
+      throw std::runtime_error("bad array");
+    }
+    return Value(std::move(arr));
+  }
+
+  static Value parse_object(const std::string& t, size_t& p) {
+    p++;  // {
+    Object obj;
+    skip_ws(t, p);
+    if (p < t.size() && t[p] == '}') { p++; return Value(std::move(obj)); }
+    while (true) {
+      skip_ws(t, p);
+      std::string key = parse_string(t, p);
+      skip_ws(t, p);
+      if (p >= t.size() || t[p] != ':') throw std::runtime_error("bad object");
+      p++;
+      obj[key] = parse_value(t, p);
+      skip_ws(t, p);
+      if (p >= t.size()) throw std::runtime_error("unterminated object");
+      if (t[p] == ',') { p++; continue; }
+      if (t[p] == '}') { p++; break; }
+      throw std::runtime_error("bad object");
+    }
+    return Value(std::move(obj));
+  }
+};
+
+}  // namespace dtpu::json

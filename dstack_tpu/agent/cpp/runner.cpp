@@ -1,0 +1,479 @@
+// tpu-runner (native): per-job executor.
+//
+// Parity: reference runner/internal/executor (executor.go:95,231 — PTY
+// exec, cluster env, incremental state/log pull by timestamp cursor) and
+// runner API (api/server.go:61-68). Wire contract shared with the
+// Python agent (dstack_tpu/agent/schemas.py).
+//
+// TPU-first env injection: DTPU_* + TPU_WORKER_ID / TPU_WORKER_HOSTNAMES
+// / JAX_COORDINATOR_ADDRESS / MEGASCALE_* instead of the reference's
+// MASTER_ADDR/NCCL wiring (executor.go:237-246).
+
+#include <fcntl.h>
+#include <pty.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <optional>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "http.hpp"
+#include "json.hpp"
+
+using dtpu::json::Array;
+using dtpu::json::Object;
+using dtpu::json::Value;
+
+namespace {
+
+constexpr const char* kVersion = "0.1.0";
+
+double now_unix() {
+  return std::chrono::duration<double>(
+             std::chrono::system_clock::now().time_since_epoch())
+      .count();
+}
+
+std::string iso_timestamp(double unix_ts) {
+  time_t secs = static_cast<time_t>(unix_ts);
+  int micros = static_cast<int>((unix_ts - secs) * 1e6);
+  char buf[64];
+  struct tm tm_utc;
+  gmtime_r(&secs, &tm_utc);
+  strftime(buf, sizeof buf, "%Y-%m-%dT%H:%M:%S", &tm_utc);
+  char out[96];
+  snprintf(out, sizeof out, "%s.%06d+00:00", buf, micros);
+  return out;
+}
+
+// base64 for log payloads (wire format matches core/models/logs.py)
+std::string base64_encode(const std::string& in) {
+  static const char* tbl =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  int val = 0, valb = -6;
+  for (unsigned char c : in) {
+    val = (val << 8) + c;
+    valb += 8;
+    while (valb >= 0) {
+      out.push_back(tbl[(val >> valb) & 0x3F]);
+      valb -= 6;
+    }
+  }
+  if (valb > -6) out.push_back(tbl[((val << 8) >> (valb + 8)) & 0x3F]);
+  while (out.size() % 4) out.push_back('=');
+  return out;
+}
+
+struct StateEvent {
+  std::string state;
+  double timestamp;
+  std::string termination_reason;
+  std::string termination_message;
+  std::optional<int> exit_status;
+
+  Value to_json() const {
+    Value v{Object{}};
+    v.set("state", state);
+    v.set("timestamp", timestamp);
+    v.set("termination_reason",
+          termination_reason.empty() ? Value(nullptr) : Value(termination_reason));
+    v.set("termination_message",
+          termination_message.empty() ? Value(nullptr) : Value(termination_message));
+    v.set("exit_status", exit_status ? Value(*exit_status) : Value(nullptr));
+    return v;
+  }
+};
+
+struct LogEvent {
+  double timestamp;
+  std::string text;
+
+  Value to_json() const {
+    Value v{Object{}};
+    v.set("timestamp", iso_timestamp(timestamp));
+    v.set("log_source", "stdout");
+    v.set("message", base64_encode(text));
+    return v;
+  }
+};
+
+class Executor {
+ public:
+  explicit Executor(std::string home_dir) : home_dir_(std::move(home_dir)) {}
+
+  void submit(const Value& body) {
+    std::lock_guard<std::mutex> lk(mu_);
+    job_ = body;
+    push_state_locked({"submitted", now_unix(), "", "", std::nullopt});
+  }
+
+  void upload_code(const std::string& data) {
+    std::string dir = home_dir_ + "/code";
+    ::mkdir(home_dir_.c_str(), 0755);
+    ::mkdir(dir.c_str(), 0755);
+    std::string tarball = dir + "/code.tar";
+    std::ofstream f(tarball, std::ios::binary);
+    f.write(data.data(), static_cast<std::streamsize>(data.size()));
+    f.close();
+    // tar extraction via the system tar (busybox/gnu both fine)
+    std::string cmd = "tar -xf '" + tarball + "' -C '" + dir + "' 2>/dev/null";
+    (void)system(cmd.c_str());
+  }
+
+  void run() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (running_) return;
+    running_ = true;
+    worker_ = std::thread([this] { exec_job(); });
+  }
+
+  void stop() {
+    stopped_ = true;
+    pid_t pid = child_pid_.load();
+    if (pid > 0) {
+      ::kill(-pid, SIGTERM);
+      std::thread([pid] {
+        std::this_thread::sleep_for(std::chrono::seconds(10));
+        ::kill(-pid, SIGKILL);
+      }).detach();
+    }
+  }
+
+  Value pull(double since) {
+    std::lock_guard<std::mutex> lk(mu_);
+    Value resp{Object{}};
+    Value states{Array{}}, logs{Array{}}, rlogs{Array{}};
+    double last = since;
+    bool finished = false;
+    for (const auto& e : states_) {
+      if (e.state == "done" || e.state == "failed" || e.state == "terminated")
+        finished = true;
+      if (e.timestamp > since) {
+        states.push_back(e.to_json());
+        last = std::max(last, e.timestamp);
+      }
+    }
+    for (const auto& e : logs_) {
+      if (e.timestamp > since) {
+        logs.push_back(e.to_json());
+        last = std::max(last, e.timestamp);
+      }
+    }
+    for (const auto& e : runner_logs_) {
+      if (e.timestamp > since) {
+        rlogs.push_back(e.to_json());
+        last = std::max(last, e.timestamp);
+      }
+    }
+    resp.set("job_states", std::move(states));
+    resp.set("job_logs", std::move(logs));
+    resp.set("runner_logs", std::move(rlogs));
+    resp.set("last_updated", last);
+    resp.set("no_connections_secs", 0);
+    resp.set("has_more", !finished);
+    return resp;
+  }
+
+  Value metrics() const {
+    // cgroup v2 cpu/mem of this process tree (parity: metrics.go:31-256,
+    // TPU metrics come from /run/tpu_metrics.json when libtpu writes it)
+    Value v{Object{}};
+    v.set("timestamp", now_unix());
+    v.set("cpu_usage_micro", read_cgroup_cpu_micro());
+    int64_t mem = read_cgroup_memory();
+    v.set("memory_usage_bytes", mem);
+    v.set("memory_working_set_bytes", mem);
+    Value duty{Array{}}, hbm_use{Array{}}, hbm_total{Array{}};
+    std::ifstream tf("/run/tpu_metrics.json");
+    if (tf) {
+      std::stringstream ss;
+      ss << tf.rdbuf();
+      try {
+        Value t = Value::parse(ss.str());
+        for (const auto& x : t["duty_cycle"].as_array()) duty.push_back(x);
+        for (const auto& x : t["hbm_usage"].as_array()) hbm_use.push_back(x);
+        for (const auto& x : t["hbm_total"].as_array()) hbm_total.push_back(x);
+      } catch (...) {
+      }
+    }
+    v.set("tpu_duty_cycle_percent", std::move(duty));
+    v.set("tpu_hbm_usage_bytes", std::move(hbm_use));
+    v.set("tpu_hbm_total_bytes", std::move(hbm_total));
+    return v;
+  }
+
+ private:
+  std::string home_dir_;
+  std::mutex mu_;
+  Value job_;
+  std::vector<StateEvent> states_;
+  std::vector<LogEvent> logs_;
+  std::vector<LogEvent> runner_logs_;
+  std::thread worker_;
+  std::atomic<pid_t> child_pid_{0};
+  std::atomic<bool> stopped_{false};
+  bool running_ = false;
+
+  void push_state_locked(StateEvent e) { states_.push_back(std::move(e)); }
+
+  void push_state(StateEvent e) {
+    std::lock_guard<std::mutex> lk(mu_);
+    push_state_locked(std::move(e));
+  }
+
+  void rlog(const std::string& text) {
+    std::lock_guard<std::mutex> lk(mu_);
+    runner_logs_.push_back({now_unix(), text + "\n"});
+  }
+
+  static int64_t read_cgroup_cpu_micro() {
+    std::ifstream f("/sys/fs/cgroup/cpu.stat");
+    std::string key;
+    int64_t val;
+    while (f >> key >> val) {
+      if (key == "usage_usec") return val;
+    }
+    return 0;
+  }
+
+  static int64_t read_cgroup_memory() {
+    std::ifstream f("/sys/fs/cgroup/memory.current");
+    int64_t v = 0;
+    f >> v;
+    return v;
+  }
+
+  std::vector<std::string> build_env() {
+    std::vector<std::string> env;
+    for (char** e = environ; *e != nullptr; e++) env.emplace_back(*e);
+    const Value& ci = job_["cluster_info"];
+    const Value& spec = job_["job_spec"];
+    int rank = static_cast<int>(spec["job_num"].as_int());
+    std::string master = ci["master_node_ip"].as_string();
+    std::string nodes_joined, nodes_newline;
+    int n_nodes = 0;
+    for (const auto& ip : ci["nodes_ips"].as_array()) {
+      if (n_nodes) {
+        nodes_joined += ",";
+        nodes_newline += "\n";
+      }
+      nodes_joined += ip.as_string();
+      nodes_newline += ip.as_string();
+      n_nodes++;
+    }
+    if (n_nodes == 0) n_nodes = 1;
+    int port = static_cast<int>(ci["coordinator_port"].as_int(8476));
+    std::string coord = master.empty() ? "" : master + ":" + std::to_string(port);
+    auto add = [&env](const std::string& k, const std::string& v) {
+      env.push_back(k + "=" + v);
+    };
+    add("DTPU_NODES_IPS", nodes_newline);
+    add("DTPU_MASTER_NODE_IP", master);
+    add("DTPU_NODE_RANK", std::to_string(rank));
+    add("DTPU_NODES_NUM", std::to_string(n_nodes));
+    add("DTPU_COORDINATOR_ADDRESS", coord);
+    add("JAX_COORDINATOR_ADDRESS", coord);
+    add("JAX_NUM_PROCESSES", std::to_string(n_nodes));
+    add("JAX_PROCESS_ID", std::to_string(rank));
+    add("TPU_WORKER_ID", std::to_string(rank));
+    add("TPU_WORKER_HOSTNAMES", nodes_joined);
+    if (ci["tpu_chips_per_host"].as_int())
+      add("DTPU_TPU_CHIPS_PER_HOST", std::to_string(ci["tpu_chips_per_host"].as_int()));
+    if (ci["tpu_total_chips"].as_int())
+      add("DTPU_TPU_TOTAL_CHIPS", std::to_string(ci["tpu_total_chips"].as_int()));
+    if (!ci["tpu_topology"].as_string().empty())
+      add("DTPU_TPU_TOPOLOGY", ci["tpu_topology"].as_string());
+    if (!ci["megascale_coordinator_address"].as_string().empty()) {
+      add("MEGASCALE_COORDINATOR_ADDRESS",
+          ci["megascale_coordinator_address"].as_string());
+      add("MEGASCALE_NUM_SLICES", std::to_string(ci["num_slices"].as_int(1)));
+      add("MEGASCALE_SLICE_ID", std::to_string(ci["slice_id"].as_int(0)));
+    }
+    for (const auto& [k, v] : job_["secrets"].as_object()) add(k, v.as_string());
+    for (const auto& [k, v] : spec["env"].as_object()) add(k, v.as_string());
+    add("DTPU_RUN_NAME", job_["run_name"].as_string());
+    add("DTPU_JOB_NAME", job_["job_name"].as_string());
+    return env;
+  }
+
+  void exec_job() {
+    Value spec;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      spec = job_["job_spec"];
+    }
+    std::string script;
+    for (const auto& c : spec["commands"].as_array()) {
+      if (!script.empty()) script += " && ";
+      script += c.as_string();
+    }
+    if (script.empty()) script = "true";
+    std::string cwd = spec["working_dir"].as_string();
+    if (cwd.empty()) cwd = home_dir_ + "/workflow";
+    ::mkdir(home_dir_.c_str(), 0755);
+    ::mkdir(cwd.c_str(), 0755);
+
+    std::vector<std::string> env = build_env();
+    std::vector<char*> envp;
+    for (auto& e : env) envp.push_back(e.data());
+    envp.push_back(nullptr);
+
+    rlog("executing: " + script);
+    push_state({"running", now_unix(), "", "", std::nullopt});
+
+    // PTY exec (parity: executor.go:586-623) so user code sees a tty
+    int master_fd;
+    pid_t pid = forkpty(&master_fd, nullptr, nullptr, nullptr);
+    if (pid < 0) {
+      push_state({"failed", now_unix(), "executor_error", "forkpty failed",
+                  std::nullopt});
+      return;
+    }
+    if (pid == 0) {
+      // child
+      setpgid(0, 0);
+      if (chdir(cwd.c_str()) != 0) _exit(127);
+      const char* shell = "/bin/bash";
+      if (access(shell, X_OK) != 0) shell = "/bin/sh";
+      execle(shell, shell, "-c", script.c_str(), nullptr, envp.data());
+      _exit(127);
+    }
+    child_pid_ = pid;
+
+    double max_duration = spec["max_duration"].as_number(0);
+    double deadline = max_duration > 0 ? now_unix() + max_duration : 0;
+
+    // pump PTY output into the log buffer
+    char buf[8192];
+    std::string pending;
+    fcntl(master_fd, F_SETFL, O_NONBLOCK);
+    int status = 0;
+    bool exited = false;
+    bool deadline_hit = false;
+    while (true) {
+      ssize_t r = ::read(master_fd, buf, sizeof buf);
+      if (r > 0) {
+        std::lock_guard<std::mutex> lk(mu_);
+        logs_.push_back({now_unix(), std::string(buf, static_cast<size_t>(r))});
+      } else if (r == 0) {
+        break;
+      } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        break;  // EIO when child closes the pty
+      }
+      pid_t w = waitpid(pid, &status, WNOHANG);
+      if (w == pid) {
+        exited = true;
+        break;
+      }
+      if (deadline > 0 && now_unix() > deadline && !deadline_hit) {
+        deadline_hit = true;
+        rlog("max_duration exceeded; terminating");
+        ::kill(-pid, SIGTERM);
+        deadline = now_unix() + 10;  // grace, then SIGKILL below
+      } else if (deadline_hit && now_unix() > deadline) {
+        ::kill(-pid, SIGKILL);
+      }
+      if (r <= 0) std::this_thread::sleep_for(std::chrono::milliseconds(30));
+    }
+    if (!exited) waitpid(pid, &status, 0);
+    // drain remaining output
+    while (true) {
+      ssize_t r = ::read(master_fd, buf, sizeof buf);
+      if (r <= 0) break;
+      std::lock_guard<std::mutex> lk(mu_);
+      logs_.push_back({now_unix(), std::string(buf, static_cast<size_t>(r))});
+    }
+    ::close(master_fd);
+    child_pid_ = 0;
+
+    int exit_code = WIFEXITED(status) ? WEXITSTATUS(status) : 128 + WTERMSIG(status);
+    if (deadline_hit) {
+      push_state({"terminated", now_unix(), "max_duration_exceeded", "",
+                  exit_code});
+    } else if (stopped_) {
+      push_state({"terminated", now_unix(), "terminated_by_user", "", exit_code});
+    } else if (exit_code == 0) {
+      push_state({"done", now_unix(), "done_by_runner", "", 0});
+    } else {
+      push_state({"failed", now_unix(), "container_exited_with_error",
+                  "exit status " + std::to_string(exit_code), exit_code});
+    }
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 10999;
+  std::string home = std::string(getenv("HOME") ? getenv("HOME") : "/root") +
+                     "/.dtpu/runner";
+  for (int i = 1; i < argc - 1; i++) {
+    if (strcmp(argv[i], "--port") == 0) port = atoi(argv[i + 1]);
+    if (strcmp(argv[i], "--home") == 0) home = argv[i + 1];
+  }
+  auto executor = std::make_shared<Executor>(home);
+
+  dtpu::http::Router router;
+  router.add("GET", "/api/healthcheck", [](const dtpu::http::Request&) {
+    Value v{Object{}};
+    v.set("service", "tpu-runner");
+    v.set("version", kVersion);
+    return dtpu::http::Response{200, "application/json", v.dump()};
+  });
+  router.add("POST", "/api/submit", [executor](const dtpu::http::Request& req) {
+    executor->submit(Value::parse(req.body));
+    return dtpu::http::Response{200, "application/json", "{}"};
+  });
+  router.add("POST", "/api/upload_code", [executor](const dtpu::http::Request& req) {
+    executor->upload_code(req.body);
+    return dtpu::http::Response{200, "application/json", "{}"};
+  });
+  router.add("POST", "/api/run", [executor](const dtpu::http::Request&) {
+    executor->run();
+    return dtpu::http::Response{200, "application/json", "{}"};
+  });
+  router.add("GET", "/api/pull", [executor](const dtpu::http::Request& req) {
+    double since = 0;
+    auto it = req.query.find("timestamp");
+    if (it != req.query.end()) since = atof(it->second.c_str());
+    return dtpu::http::Response{200, "application/json",
+                                executor->pull(since).dump()};
+  });
+  router.add("POST", "/api/stop", [executor](const dtpu::http::Request&) {
+    executor->stop();
+    return dtpu::http::Response{200, "application/json", "{}"};
+  });
+  router.add("GET", "/api/metrics", [executor](const dtpu::http::Request&) {
+    return dtpu::http::Response{200, "application/json",
+                                executor->metrics().dump()};
+  });
+
+  signal(SIGPIPE, SIG_IGN);
+  dtpu::http::Server server(std::move(router));
+  int bound = server.listen_and_serve(port);
+  if (bound < 0) {
+    fprintf(stderr, "tpu-runner: cannot bind port %d\n", port);
+    return 1;
+  }
+  fprintf(stderr, "tpu-runner listening on :%d home=%s\n", bound, home.c_str());
+  // serve until SIGTERM/SIGINT
+  static std::atomic<bool> stop{false};
+  signal(SIGTERM, [](int) { stop = true; });
+  signal(SIGINT, [](int) { stop = true; });
+  while (!stop) std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  executor->stop();
+  std::this_thread::sleep_for(std::chrono::milliseconds(300));
+  return 0;
+}

@@ -1,0 +1,510 @@
+// tpu-shim (native): per-host agent managing job containers/processes.
+//
+// Parity: reference runner/internal/shim (docker.go container lifecycle
+// over the unix-socket Docker API, task.go FSM, host/gpu.go detection —
+// TPU-flavored: /dev/accel* & /dev/vfio passthrough + PJRT_DEVICE=TPU,
+// docker.go:775-776,807,995-1065). Wire contract: agent/schemas.py.
+
+#include <dirent.h>
+#include <signal.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/sysinfo.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "http.hpp"
+#include "json.hpp"
+
+using dtpu::json::Array;
+using dtpu::json::Object;
+using dtpu::json::Value;
+
+namespace {
+
+constexpr const char* kVersion = "0.1.0";
+
+// ---- task FSM (parity: shim/task.go:65) ----
+
+enum class TaskStatus { Pending, Preparing, Pulling, Creating, Running, Terminated };
+
+const char* status_name(TaskStatus s) {
+  switch (s) {
+    case TaskStatus::Pending: return "pending";
+    case TaskStatus::Preparing: return "preparing";
+    case TaskStatus::Pulling: return "pulling";
+    case TaskStatus::Creating: return "creating";
+    case TaskStatus::Running: return "running";
+    case TaskStatus::Terminated: return "terminated";
+  }
+  return "?";
+}
+
+bool transition_allowed(TaskStatus from, TaskStatus to) {
+  if (to == TaskStatus::Terminated) return from != TaskStatus::Terminated;
+  switch (from) {
+    case TaskStatus::Pending: return to == TaskStatus::Preparing;
+    case TaskStatus::Preparing: return to == TaskStatus::Pulling;
+    case TaskStatus::Pulling: return to == TaskStatus::Creating;
+    case TaskStatus::Creating: return to == TaskStatus::Running;
+    default: return false;
+  }
+}
+
+// ---- TPU / host detection (parity: host/gpu.go:50-63, TPU-flavored) ----
+
+Value detect_tpu() {
+  Value paths{Array{}};
+  int accel_count = 0, vfio_count = 0;
+  if (DIR* d = opendir("/dev")) {
+    while (dirent* e = readdir(d)) {
+      if (strncmp(e->d_name, "accel", 5) == 0) {
+        paths.push_back(std::string("/dev/") + e->d_name);
+        accel_count++;
+      }
+    }
+    closedir(d);
+  }
+  if (DIR* d = opendir("/dev/vfio")) {
+    while (dirent* e = readdir(d)) {
+      if (e->d_name[0] != '.') {
+        if (accel_count == 0) paths.push_back(std::string("/dev/vfio/") + e->d_name);
+        vfio_count++;
+      }
+    }
+    closedir(d);
+  }
+  if (accel_count == 0 && vfio_count == 0) return Value(nullptr);
+  Value v{Object{}};
+  v.set("chip_count", accel_count > 0 ? accel_count : std::max(vfio_count - 1, 0));
+  v.set("device_paths", std::move(paths));
+  const char* gen = getenv("DTPU_TPU_GENERATION");
+  v.set("generation", gen ? Value(gen) : Value(nullptr));
+  v.set("hbm_gib_per_chip", 0.0);
+  v.set("libtpu_version", Value(nullptr));
+  return v;
+}
+
+Value host_info() {
+  Value v{Object{}};
+  v.set("cpus", static_cast<int64_t>(sysconf(_SC_NPROCESSORS_ONLN)));
+  struct sysinfo si{};
+  sysinfo(&si);
+  v.set("memory_bytes", static_cast<int64_t>(si.totalram) * si.mem_unit);
+  struct statvfs fs{};
+  int64_t disk = 0;
+  if (statvfs("/", &fs) == 0)
+    disk = static_cast<int64_t>(fs.f_blocks) * static_cast<int64_t>(fs.f_frsize);
+  v.set("disk_bytes", disk);
+  v.set("tpu", detect_tpu());
+  char host[256] = {0};
+  gethostname(host, sizeof host - 1);
+  v.set("hostname", std::string(host));
+  v.set("addresses", Value{Array{}});
+  return v;
+}
+
+// ---- runtimes ----
+
+struct Task {
+  Value req;  // TaskSubmitRequest
+  TaskStatus status = TaskStatus::Pending;
+  std::string termination_reason;
+  std::string termination_message;
+  std::string container_name;
+  pid_t runner_pid = 0;
+  int runner_port = 0;
+
+  Value info() const {
+    Value v{Object{}};
+    v.set("id", req["id"]);
+    v.set("status", status_name(status));
+    v.set("termination_reason",
+          termination_reason.empty() ? Value(nullptr) : Value(termination_reason));
+    v.set("termination_message",
+          termination_message.empty() ? Value(nullptr) : Value(termination_message));
+    v.set("container_name",
+          container_name.empty() ? Value(nullptr) : Value(container_name));
+    Value ports{Array{}};
+    Value pm{Object{}};
+    pm.set("container_port", runner_port);
+    pm.set("host_port", runner_port);
+    ports.push_back(std::move(pm));
+    v.set("ports", std::move(ports));
+    return v;
+  }
+};
+
+const char* kDockerSock = "/var/run/docker.sock";
+
+bool docker_available() {
+  struct stat st{};
+  return ::stat(kDockerSock, &st) == 0;
+}
+
+class Shim {
+ public:
+  Shim(std::string base_dir, std::string runner_bin, bool use_docker)
+      : base_dir_(std::move(base_dir)),
+        runner_bin_(std::move(runner_bin)),
+        use_docker_(use_docker) {}
+
+  Value submit(const Value& req, std::string& error) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string id = req["id"].as_string();
+    if (tasks_.count(id)) {
+      error = "task exists";
+      return Value(nullptr);
+    }
+    Task& task = tasks_[id];
+    task.req = req;
+    task.runner_port = next_port_++;
+    std::thread([this, id] { start_task(id); }).detach();
+    return task.info();
+  }
+
+  Value get(const std::string& id, bool& found) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = tasks_.find(id);
+    found = it != tasks_.end();
+    return found ? it->second.info() : Value(nullptr);
+  }
+
+  Value list() {
+    std::lock_guard<std::mutex> lk(mu_);
+    Value ids{Array{}};
+    for (const auto& [id, _] : tasks_) ids.push_back(id);
+    Value v{Object{}};
+    v.set("ids", std::move(ids));
+    return v;
+  }
+
+  Value terminate(const std::string& id, int timeout, const std::string& reason,
+                  bool& found) {
+    pid_t pid = 0;
+    std::string container;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = tasks_.find(id);
+      found = it != tasks_.end();
+      if (!found) return Value(nullptr);
+      Task& t = it->second;
+      if (t.status == TaskStatus::Terminated) return t.info();
+      pid = t.runner_pid;
+      container = t.container_name;
+      if (!reason.empty()) t.termination_reason = reason;
+    }
+    if (use_docker_ && !container.empty() && container.rfind("proc-", 0) != 0) {
+      dtpu::http::Client::request_unix(
+          kDockerSock, "POST",
+          "/containers/" + container + "/stop?t=" + std::to_string(timeout));
+    } else if (pid > 0) {
+      ::kill(pid, SIGTERM);
+      for (int i = 0; i < timeout * 10; i++) {
+        if (::kill(pid, 0) != 0) break;
+        std::this_thread::sleep_for(std::chrono::milliseconds(100));
+      }
+      if (::kill(pid, 0) == 0) ::kill(pid, SIGKILL);
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    Task& t = tasks_[id];
+    t.status = TaskStatus::Terminated;
+    return t.info();
+  }
+
+  bool remove(const std::string& id, std::string& error) {
+    std::string container;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = tasks_.find(id);
+      if (it == tasks_.end()) {
+        error = "not found";
+        return false;
+      }
+      if (it->second.status != TaskStatus::Terminated) {
+        error = "task must be terminated before removal";
+        return false;
+      }
+      container = it->second.container_name;
+      tasks_.erase(it);
+    }
+    if (use_docker_ && !container.empty() && container.rfind("proc-", 0) != 0) {
+      dtpu::http::Client::request_unix(kDockerSock, "DELETE",
+                                       "/containers/" + container + "?force=true");
+    }
+    return true;
+  }
+
+ private:
+  std::string base_dir_;
+  std::string runner_bin_;
+  bool use_docker_;
+  std::mutex mu_;
+  std::map<std::string, Task> tasks_;
+  int next_port_ = 11000;
+
+  void set_status(const std::string& id, TaskStatus to) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = tasks_.find(id);
+    if (it == tasks_.end()) return;
+    if (transition_allowed(it->second.status, to)) it->second.status = to;
+  }
+
+  void fail_task(const std::string& id, const std::string& message) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = tasks_.find(id);
+    if (it == tasks_.end()) return;
+    it->second.status = TaskStatus::Terminated;
+    it->second.termination_reason = "creating_container_error";
+    it->second.termination_message = message;
+  }
+
+  void start_task(const std::string& id) {
+    Value req;
+    int runner_port;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      req = tasks_[id].req;
+      runner_port = tasks_[id].runner_port;
+    }
+    set_status(id, TaskStatus::Preparing);
+    std::string image = req["image_name"].as_string();
+    if (use_docker_ && !image.empty()) {
+      start_docker(id, req, image, runner_port);
+    } else {
+      start_process(id, req, runner_port);
+    }
+  }
+
+  // process runtime: runner subprocess on the host (no container)
+  void start_process(const std::string& id, const Value& req, int runner_port) {
+    set_status(id, TaskStatus::Pulling);
+    set_status(id, TaskStatus::Creating);
+    std::string home = base_dir_ + "/" + id;
+    ::mkdir(base_dir_.c_str(), 0755);
+    ::mkdir(home.c_str(), 0755);
+    pid_t pid = fork();
+    if (pid < 0) {
+      fail_task(id, "fork failed");
+      return;
+    }
+    if (pid == 0) {
+      for (const auto& [k, v] : req["env"].as_object())
+        setenv(k.c_str(), v.as_string().c_str(), 1);
+      for (const auto& [k, v] : req["tpu_env"].as_object())
+        setenv(k.c_str(), v.as_string().c_str(), 1);
+      if (!req["pjrt_device"].as_string().empty())
+        setenv("PJRT_DEVICE", req["pjrt_device"].as_string().c_str(), 1);
+      std::string port_s = std::to_string(runner_port);
+      execl(runner_bin_.c_str(), runner_bin_.c_str(), "--port", port_s.c_str(),
+            "--home", home.c_str(), nullptr);
+      _exit(127);
+    }
+    // wait for the runner port
+    for (int i = 0; i < 100; i++) {
+      auto r = dtpu::http::Client::request_tcp("127.0.0.1", runner_port, "GET",
+                                               "/api/healthcheck");
+      if (r.status == 200) {
+        std::lock_guard<std::mutex> lk(mu_);
+        Task& t = tasks_[id];
+        t.runner_pid = pid;
+        t.container_name = "proc-" + std::to_string(pid);
+        break;
+      }
+      int status;
+      if (waitpid(pid, &status, WNOHANG) == pid) {
+        fail_task(id, "runner exited early");
+        return;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    set_status(id, TaskStatus::Running);
+  }
+
+  // docker runtime over the unix-socket API (parity: docker.go:690-1065)
+  void start_docker(const std::string& id, const Value& req,
+                    const std::string& image, int runner_port) {
+    set_status(id, TaskStatus::Pulling);
+    auto pull = dtpu::http::Client::request_unix(
+        kDockerSock, "POST", "/images/create?fromImage=" + image);
+    if (pull.status >= 400) {
+      fail_task(id, "image pull failed: " + pull.body.substr(0, 200));
+      return;
+    }
+    set_status(id, TaskStatus::Creating);
+    Value config{Object{}};
+    config.set("Image", image);
+    Value env{Array{}};
+    for (const auto& [k, v] : req["env"].as_object())
+      env.push_back(k + "=" + v.as_string());
+    for (const auto& [k, v] : req["tpu_env"].as_object())
+      env.push_back(k + "=" + v.as_string());
+    if (!req["pjrt_device"].as_string().empty())
+      env.push_back("PJRT_DEVICE=" + req["pjrt_device"].as_string());
+    config.set("Env", std::move(env));
+    Value cmd{Array{}};
+    cmd.push_back("/bin/sh");
+    cmd.push_back("-c");
+    cmd.push_back("tpu-runner --port " + std::to_string(runner_port) +
+                  " --home /root/.dtpu");
+    config.set("Cmd", std::move(cmd));
+    Value host_config{Object{}};
+    host_config.set("Privileged", req["privileged"].as_bool());
+    host_config.set("NetworkMode", req["network_mode"].as_string().empty()
+                                       ? "host"
+                                       : req["network_mode"].as_string());
+    // TPU device passthrough when not privileged
+    Value devices{Array{}};
+    Value tpu = detect_tpu();
+    if (!tpu.is_null() && !req["privileged"].as_bool()) {
+      for (const auto& p : tpu["device_paths"].as_array()) {
+        Value d{Object{}};
+        d.set("PathOnHost", p);
+        d.set("PathInContainer", p);
+        d.set("CgroupPermissions", "rwm");
+        devices.push_back(std::move(d));
+      }
+    }
+    host_config.set("Devices", std::move(devices));
+    if (req["shm_size_bytes"].as_int() > 0)
+      host_config.set("ShmSize", req["shm_size_bytes"]);
+    Value binds{Array{}};
+    for (const auto& m : req["mounts"].as_array())
+      binds.push_back(m["source"].as_string() + ":" + m["target"].as_string());
+    host_config.set("Binds", std::move(binds));
+    config.set("HostConfig", std::move(host_config));
+    std::string name = "dtpu-" + id.substr(0, 13);
+    auto create = dtpu::http::Client::request_unix(
+        kDockerSock, "POST", "/containers/create?name=" + name, config.dump());
+    if (create.status >= 400) {
+      fail_task(id, "container create failed: " + create.body.substr(0, 200));
+      return;
+    }
+    auto start = dtpu::http::Client::request_unix(kDockerSock, "POST",
+                                                  "/containers/" + name + "/start");
+    if (start.status >= 400) {
+      fail_task(id, "container start failed: " + start.body.substr(0, 200));
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      tasks_[id].container_name = name;
+    }
+    set_status(id, TaskStatus::Running);
+  }
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 10998;
+  std::string base_dir = std::string(getenv("HOME") ? getenv("HOME") : "/root") +
+                         "/.dtpu/shim";
+  std::string runner_bin = "tpu-runner";
+  std::string runtime;  // "", "docker", "process"
+  bool service_mode = false;
+  std::string host_info_path;
+  for (int i = 1; i < argc; i++) {
+    if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) port = atoi(argv[++i]);
+    else if (strcmp(argv[i], "--base-dir") == 0 && i + 1 < argc) base_dir = argv[++i];
+    else if (strcmp(argv[i], "--runner-bin") == 0 && i + 1 < argc) runner_bin = argv[++i];
+    else if (strcmp(argv[i], "--runtime") == 0 && i + 1 < argc) runtime = argv[++i];
+    else if (strcmp(argv[i], "--service") == 0) service_mode = true;
+    else if (strcmp(argv[i], "--host-info-path") == 0 && i + 1 < argc)
+      host_info_path = argv[++i];
+  }
+  bool use_docker = runtime == "docker" || (runtime.empty() && docker_available());
+  if (service_mode) {
+    std::string p = host_info_path.empty()
+                        ? std::string(getenv("HOME") ? getenv("HOME") : "/root") +
+                              "/.dtpu/host_info.json"
+                        : host_info_path;
+    std::ofstream f(p);
+    f << host_info().dump();
+  }
+  auto shim = std::make_shared<Shim>(base_dir, runner_bin, use_docker);
+
+  dtpu::http::Router router;
+  router.add("GET", "/api/healthcheck", [](const dtpu::http::Request&) {
+    Value v{Object{}};
+    v.set("service", "tpu-shim");
+    v.set("version", kVersion);
+    return dtpu::http::Response{200, "application/json", v.dump()};
+  });
+  router.add("GET", "/api/host_info", [](const dtpu::http::Request&) {
+    return dtpu::http::Response{200, "application/json", host_info().dump()};
+  });
+  router.add("GET", "/api/tasks", [shim](const dtpu::http::Request&) {
+    return dtpu::http::Response{200, "application/json", shim->list().dump()};
+  });
+  router.add("POST", "/api/tasks", [shim](const dtpu::http::Request& req) {
+    std::string error;
+    Value info = shim->submit(Value::parse(req.body), error);
+    if (!error.empty()) {
+      return dtpu::http::Response{409, "application/json",
+                                  "{\"detail\":\"" + error + "\"}"};
+    }
+    return dtpu::http::Response{200, "application/json", info.dump()};
+  });
+  router.add("GET", "/api/tasks/*", [shim](const dtpu::http::Request& req) {
+    bool found;
+    Value info = shim->get(req.path_params[0], found);
+    if (!found)
+      return dtpu::http::Response{404, "application/json",
+                                  "{\"detail\":\"not found\"}"};
+    return dtpu::http::Response{200, "application/json", info.dump()};
+  });
+  router.add("POST", "/api/tasks/*/terminate",
+             [shim](const dtpu::http::Request& req) {
+               int timeout = 10;
+               std::string reason;
+               if (!req.body.empty()) {
+                 try {
+                   Value b = Value::parse(req.body);
+                   timeout = static_cast<int>(b["timeout_seconds"].as_int(10));
+                   reason = b["reason"].as_string();
+                 } catch (...) {
+                 }
+               }
+               bool found;
+               Value info = shim->terminate(req.path_params[0], timeout, reason, found);
+               if (!found)
+                 return dtpu::http::Response{404, "application/json",
+                                             "{\"detail\":\"not found\"}"};
+               return dtpu::http::Response{200, "application/json", info.dump()};
+             });
+  router.add("POST", "/api/tasks/*/remove", [shim](const dtpu::http::Request& req) {
+    std::string error;
+    if (!shim->remove(req.path_params[0], error)) {
+      int code = error == "not found" ? 404 : 409;
+      return dtpu::http::Response{code, "application/json",
+                                  "{\"detail\":\"" + error + "\"}"};
+    }
+    return dtpu::http::Response{200, "application/json", "{}"};
+  });
+
+  signal(SIGPIPE, SIG_IGN);
+  dtpu::http::Server server(std::move(router));
+  int bound = server.listen_and_serve(port);
+  if (bound < 0) {
+    fprintf(stderr, "tpu-shim: cannot bind port %d\n", port);
+    return 1;
+  }
+  fprintf(stderr, "tpu-shim listening on :%d (runtime=%s)\n", bound,
+          use_docker ? "docker" : "process");
+  static std::atomic<bool> stop{false};
+  signal(SIGTERM, [](int) { stop = true; });
+  signal(SIGINT, [](int) { stop = true; });
+  while (!stop) std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  return 0;
+}

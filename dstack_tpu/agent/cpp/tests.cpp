@@ -1,0 +1,111 @@
+// Native agent unit tests (parity: the reference's colocated Go
+// *_test.go — task FSM, JSON wire format, HTTP routing).
+#include <cassert>
+#include <cstdio>
+#include <string>
+
+#include "http.hpp"
+#include "json.hpp"
+
+using dtpu::json::Array;
+using dtpu::json::Object;
+using dtpu::json::Value;
+
+static int failures = 0;
+
+#define CHECK(cond)                                                    \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      printf("FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);           \
+      failures++;                                                      \
+    }                                                                  \
+  } while (0)
+
+void test_json_roundtrip() {
+  Value v{Object{}};
+  v.set("name", "täsk-1\n\"quoted\"");
+  v.set("num", 42);
+  v.set("pi", 3.5);
+  v.set("flag", true);
+  v.set("nothing", Value(nullptr));
+  Value arr{Array{}};
+  arr.push_back(1);
+  arr.push_back("two");
+  v.set("arr", std::move(arr));
+  std::string s = v.dump();
+  Value parsed = Value::parse(s);
+  CHECK(parsed["name"].as_string() == "täsk-1\n\"quoted\"");
+  CHECK(parsed["num"].as_int() == 42);
+  CHECK(parsed["pi"].as_number() == 3.5);
+  CHECK(parsed["flag"].as_bool());
+  CHECK(parsed["nothing"].is_null());
+  CHECK(parsed["arr"].as_array().size() == 2);
+  CHECK(parsed["missing"].is_null());
+}
+
+void test_json_parse_escapes() {
+  Value v = Value::parse(R"({"s": "aA\n\t\"b\"", "n": -1.5e2})");
+  CHECK(v["s"].as_string() == "aA\n\t\"b\"");
+  CHECK(v["n"].as_number() == -150.0);
+  bool threw = false;
+  try {
+    Value::parse("{broken");
+  } catch (...) {
+    threw = true;
+  }
+  CHECK(threw);
+}
+
+void test_router_wildcards() {
+  dtpu::http::Router router;
+  router.add("GET", "/api/tasks/*", [](const dtpu::http::Request& r) {
+    return dtpu::http::Response{200, "text/plain", "get:" + r.path_params[0]};
+  });
+  router.add("POST", "/api/tasks/*/terminate", [](const dtpu::http::Request& r) {
+    return dtpu::http::Response{200, "text/plain", "term:" + r.path_params[0]};
+  });
+  dtpu::http::Request req;
+  req.method = "GET";
+  req.path = "/api/tasks/abc";
+  CHECK(router.dispatch(req).body == "get:abc");
+  req.method = "POST";
+  req.path = "/api/tasks/abc/terminate";
+  CHECK(router.dispatch(req).body == "term:abc");
+  req.path = "/api/unknown";
+  CHECK(router.dispatch(req).status == 404);
+}
+
+void test_server_end_to_end() {
+  dtpu::http::Router router;
+  router.add("POST", "/echo", [](const dtpu::http::Request& r) {
+    Value v = Value::parse(r.body);
+    Value out{Object{}};
+    out.set("got", v["msg"]);
+    auto it = r.query.find("q");
+    out.set("q", it != r.query.end() ? Value(it->second) : Value(nullptr));
+    return dtpu::http::Response{200, "application/json", out.dump()};
+  });
+  dtpu::http::Server server(std::move(router));
+  int port = server.listen_and_serve(0);
+  CHECK(port > 0);
+  auto resp = dtpu::http::Client::request_tcp(
+      "127.0.0.1", port, "POST", "/echo?q=x%20y", R"({"msg":"hello"})");
+  CHECK(resp.status == 200);
+  Value v = Value::parse(resp.body);
+  CHECK(v["got"].as_string() == "hello");
+  CHECK(v["q"].as_string() == "x y");
+  server.shutdown();
+}
+
+int main() {
+  test_json_roundtrip();
+  test_json_parse_escapes();
+  test_router_wildcards();
+  test_server_end_to_end();
+  if (failures == 0) {
+    printf("all native agent tests passed\n");
+    return 0;
+  }
+  printf("%d failures\n", failures);
+  return 1;
+}

@@ -23,28 +23,46 @@ TPU_API = "https://tpu.googleapis.com/v2"
 
 
 class Transport:
-    """Pluggable HTTP layer (tests install a fake)."""
+    """Pluggable HTTP layer (tests install a fake). Pools one client
+    session and refreshes OAuth credentials on expiry."""
 
     def __init__(self, credentials: Any = None):
         self._credentials = credentials
-        self._token: Optional[str] = None
+        self._session: Optional[aiohttp.ClientSession] = None
 
     def _get_token(self) -> str:
-        if self._credentials is None:
-            try:
+        try:
+            if self._credentials is None:
                 import google.auth
-                import google.auth.transport.requests
 
                 creds, _ = google.auth.default(
                     scopes=["https://www.googleapis.com/auth/cloud-platform"]
                 )
-                creds.refresh(google.auth.transport.requests.Request())
                 self._credentials = creds
-            except Exception as e:
-                raise BackendAuthError(f"GCP auth failed: {e}") from e
+            creds = self._credentials
+            # refresh expired/initial tokens (long-running server: tokens
+            # expire hourly)
+            if not getattr(creds, "valid", False) and hasattr(creds, "refresh"):
+                import google.auth.transport.requests
+
+                creds.refresh(google.auth.transport.requests.Request())
+        except Exception as e:
+            raise BackendAuthError(f"GCP auth failed: {e}") from e
         if hasattr(self._credentials, "token"):
             return self._credentials.token
         raise BackendAuthError("no usable GCP credentials")
+
+    def _get_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60),
+                connector=aiohttp.TCPConnector(limit=32, keepalive_timeout=30),
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
 
     async def request(
         self,
@@ -55,21 +73,20 @@ class Transport:
     ) -> dict:
         loop = asyncio.get_running_loop()
         token = await loop.run_in_executor(None, self._get_token)
-        async with aiohttp.ClientSession() as session:
-            async with session.request(
-                method,
-                url,
-                json=json_body,
-                params=params,
-                headers={"Authorization": f"Bearer {token}"},
-                timeout=aiohttp.ClientTimeout(total=60),
-            ) as resp:
-                text = await resp.text()
-                if resp.status >= 400:
-                    raise BackendError(
-                        f"GCP API {method} {url}: {resp.status} {text[:400]}"
-                    )
-                return json.loads(text) if text else {}
+        session = self._get_session()
+        async with session.request(
+            method,
+            url,
+            json=json_body,
+            params=params,
+            headers={"Authorization": f"Bearer {token}"},
+        ) as resp:
+            text = await resp.text()
+            if resp.status >= 400:
+                raise BackendError(
+                    f"GCP API {method} {url}: {resp.status} {text[:400]}"
+                )
+            return json.loads(text) if text else {}
 
 
 class TPUNodesAPI:
@@ -173,6 +190,13 @@ class TPUNodesAPI:
     async def delete_node(self, zone: str, node_id: str) -> dict:
         return await self.transport.request(
             "DELETE", f"{TPU_API}/{self._zone_parent(zone)}/nodes/{node_id}"
+        )
+
+    async def delete_queued_resource(self, zone: str, resource_id: str) -> dict:
+        return await self.transport.request(
+            "DELETE",
+            f"{TPU_API}/{self._zone_parent(zone)}/queuedResources/{resource_id}",
+            params={"force": "true"},
         )
 
     async def update_node_disks(self, zone: str, node_id: str, data_disks: list[dict]) -> dict:

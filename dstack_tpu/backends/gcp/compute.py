@@ -137,8 +137,10 @@ class GCPTPUCompute(
             instance_config.ssh_public_keys, tpu.version
         )
         spot = instance_offer.instance.resources.spot
+        used_qr = False
         try:
             if tpu.hosts > 4 or instance_config.reservation:
+                used_qr = True
                 # big slices go through the queued-resources path
                 # (atomic all-workers admission)
                 await self.api.create_queued_resource(
@@ -179,7 +181,9 @@ class GCPTPUCompute(
             ssh_port=22,
             dockerized=True,
             hosts=[],
-            backend_data=json.dumps({"zone": zone, "node_id": node_id}),
+            backend_data=json.dumps(
+                {"zone": zone, "node_id": node_id, "queued_resource": used_qr}
+            ),
         )
 
     async def update_provisioning_data(
@@ -227,9 +231,16 @@ class GCPTPUCompute(
         try:
             await self.api.delete_node(zone, node_id)
         except BackendError as e:
-            if "404" in str(e):
-                return  # already gone
-            raise
+            if "404" not in str(e):
+                raise
+        if bd.get("queued_resource"):
+            # a WAITING queued resource would otherwise admit a slice
+            # nobody tracks (and block name reuse) — force-delete it
+            try:
+                await self.api.delete_queued_resource(zone, f"{node_id}-qr")
+            except BackendError as e:
+                if "404" not in str(e):
+                    logger.warning("queued resource cleanup failed: %s", e)
 
     # ---- volumes: persistent disks attached to TPU nodes ----
 

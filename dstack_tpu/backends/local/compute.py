@@ -96,6 +96,21 @@ class LocalCompute(
         )
         return [offer]
 
+    @staticmethod
+    def _native_agent_paths() -> Optional[tuple[Path, Path]]:
+        """(tpu-shim, tpu-runner) native binaries when built and enabled
+        via DTPU_NATIVE_AGENT=1."""
+        import os
+
+        if os.getenv("DTPU_NATIVE_AGENT") != "1":
+            return None
+        root = Path(__file__).resolve().parents[3]
+        shim = root / "build" / "tpu-shim"
+        runner = root / "build" / "tpu-runner"
+        if shim.exists() and runner.exists():
+            return shim, runner
+        return None
+
     async def create_instance(
         self,
         instance_offer: InstanceOfferWithAvailability,
@@ -104,16 +119,27 @@ class LocalCompute(
         shim_port = _free_port()
         inst_dir = self.base_dir / instance_config.instance_name
         inst_dir.mkdir(parents=True, exist_ok=True)
+        native = self._native_agent_paths()
+        if native is not None:
+            shim_bin, runner_bin = native
+            cmd = [
+                str(shim_bin),
+                "--port", str(shim_port),
+                "--base-dir", str(inst_dir),
+                "--runtime", "process",
+                "--runner-bin", str(runner_bin),
+            ]
+        else:
+            cmd = [
+                sys.executable,
+                "-m",
+                "dstack_tpu.agent.python.shim_main",
+                "--port", str(shim_port),
+                "--base-dir", str(inst_dir),
+                "--runtime", "process",
+            ]
         proc = await asyncio.create_subprocess_exec(
-            sys.executable,
-            "-m",
-            "dstack_tpu.agent.python.shim_main",
-            "--port",
-            str(shim_port),
-            "--base-dir",
-            str(inst_dir),
-            "--runtime",
-            "process",
+            *cmd,
             start_new_session=True,
         )
         instance_id = f"local-{shim_port}"

@@ -100,14 +100,55 @@ async def _forward(
         )
 
 
+async def _check_service_auth(
+    request: web.Request, db: Database, run_row: Optional[dict]
+) -> Optional[web.Response]:
+    """Enforce the service's ``auth: true`` (the default): the caller must
+    present a valid server token (reference: gateway auth check against
+    /api/auth). Returns an error response or None when authorized."""
+    if run_row is None:
+        return None  # nonexistent run: fall through to 503 (no info leak)
+    conf = (loads(run_row["run_spec"]) or {}).get("configuration", {})
+    if conf.get("auth") is False:
+        return None
+    auth = request.headers.get("Authorization", "")
+    token = auth.removeprefix("Bearer ").strip() if auth.startswith("Bearer ") else ""
+    if token:
+        from dstack_tpu.server.services.users import get_user_by_token
+
+        if await get_user_by_token(db, token) is not None:
+            return None
+    return web.json_response(
+        {"detail": "authentication required for this service"}, status=401
+    )
+
+
+async def _get_run_row(db: Database, project_name: str, run_name: str) -> Optional[dict]:
+    project = await db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project is None:
+        return None
+    return await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project["id"], run_name),
+    )
+
+
 async def service_proxy_handler(request: web.Request) -> web.StreamResponse:
     db: Database = request.app["state"]["db"]
     project = request.match_info["project_name"]
     run_name = request.match_info["run_name"]
     path = request.match_info.get("path", "")
+    run_row = await _get_run_row(db, project, run_name)
+    denied = await _check_service_auth(request, db, run_row)
+    if denied is not None:
+        return denied
     # record BEFORE the no-replica check: demand on a scaled-to-zero
-    # service is what makes the autoscaler scale it back up
-    get_service_stats().record(project, run_name)
+    # service is what makes the autoscaler scale it back up — but only
+    # for runs that actually exist (no unbounded keys from random names)
+    if run_row is not None:
+        get_service_stats().record(project, run_name)
     replicas = await _resolve_replicas(db, project, run_name)
     if not replicas:
         return web.json_response(
@@ -135,6 +176,9 @@ async def model_proxy_handler(request: web.Request) -> web.StreamResponse:
             {"detail": f"model {model_name!r} not found"}, status=404
         )
     run_name = run_row["run_name"]
+    denied = await _check_service_auth(request, db, run_row)
+    if denied is not None:
+        return denied
     get_service_stats().record(project, run_name)  # before the 503 check
     replicas = await _resolve_replicas(db, project, run_name)
     if not replicas:

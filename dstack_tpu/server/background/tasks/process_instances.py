@@ -8,6 +8,7 @@ termination retries with deadlines :817-899).
 from datetime import datetime, timedelta
 
 from dstack_tpu.backends.base.compute import ComputeWithCreateInstanceSupport
+from dstack_tpu.core.errors import ComputeError
 from dstack_tpu.core.models.backends import BackendType
 from dstack_tpu.core.models.instances import InstanceConfiguration, InstanceStatus
 from dstack_tpu.core.models.runs import JobProvisioningData, now_utc
@@ -202,6 +203,19 @@ async def _poll_provisioning(db: Database, row: dict) -> None:
         if compute is not None:
             try:
                 jpd = await compute.update_provisioning_data(jpd)
+            except ComputeError as e:
+                # terminal provisioning failure (e.g. spot slice
+                # PREEMPTED): fail fast instead of waiting out the
+                # provisioning timeout; jobs get a retryable event
+                logger.info("instance %s failed while provisioning: %s", row["name"], e)
+                await _mark(
+                    db,
+                    row,
+                    InstanceStatus.TERMINATING,
+                    termination_reason=str(e)[:300],
+                )
+                await _interrupt_jobs_on_instance(db, row["id"], str(e)[:300])
+                return
             except Exception as e:
                 logger.debug("update_provisioning_data %s: %s", row["name"], e)
         if not jpd.ready():
@@ -273,9 +287,41 @@ async def _maybe_terminate_idle(db: Database, row: dict) -> None:
         )
 
 
+async def _interrupt_jobs_on_instance(db: Database, instance_id: str, message: str) -> None:
+    """Mark the instance's active jobs interrupted (retryable event)."""
+    from dstack_tpu.core.models.runs import JobStatus, JobTerminationReason
+    from dstack_tpu.server.services import jobs as jobs_service
+
+    jobs = await db.fetchall(
+        "SELECT id FROM jobs WHERE instance_id = ? AND status IN (?,?,?,?)",
+        (instance_id, "submitted", "provisioning", "pulling", "running"),
+    )
+    for j in jobs:
+        await jobs_service.update_job_status(
+            db,
+            j["id"],
+            JobStatus.TERMINATING,
+            termination_reason=JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
+            termination_reason_message=message,
+        )
+
+
 async def _terminate(db: Database, row: dict) -> None:
     project_row = await db.get_by_id("projects", row["project_id"])
     backend = row.get("backend")
+    # ssh-fleet hosts: uninstall the shim service on fleet deletion so the
+    # host can be cleanly re-adopted (reference provisioning teardown)
+    rci_raw = loads(row.get("remote_connection_info"))
+    if rci_raw and backend == BackendType.REMOTE.value:
+        from dstack_tpu.backends.ssh_fleet import provisioning as ssh_prov
+        from dstack_tpu.core.models.instances import RemoteConnectionInfo
+
+        try:
+            await ssh_prov.remove_host(
+                RemoteConnectionInfo.model_validate(rci_raw), ssh_run=_SSH_RUN_OVERRIDE
+            )
+        except Exception as e:
+            logger.debug("ssh-fleet shim removal failed: %s", e)
     jpd_raw = loads(row.get("job_provisioning_data"))
     if backend and jpd_raw:
         compute = await backends_service.get_project_backend(

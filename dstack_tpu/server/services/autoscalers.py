@@ -34,8 +34,8 @@ class ManualScaler(BaseScaler):
         self.replicas = replicas
 
     def get_desired_count(self, project, run_name, current, last_scaled_at) -> int:
-        lo = self.replicas.min or 1
-        hi = self.replicas.max or lo
+        lo = self.replicas.min if self.replicas.min is not None else 1
+        hi = self.replicas.max if self.replicas.max is not None else max(lo, 1)
         return min(max(current, lo), hi)
 
 

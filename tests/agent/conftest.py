@@ -1,0 +1,31 @@
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+BUILD_DIR = REPO / "build"
+
+
+@pytest.fixture(scope="session")
+def agent_binaries():
+    """Build the native C++ agents once per session."""
+    if shutil.which("cmake") is None or shutil.which("ninja") is None:
+        pytest.skip("cmake/ninja not available")
+    subprocess.run(
+        [
+            "cmake",
+            "-S", str(REPO / "dstack_tpu/agent/cpp"),
+            "-B", str(BUILD_DIR),
+            "-G", "Ninja",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", str(BUILD_DIR), "tpu-runner", "tpu-shim"],
+        check=True,
+        capture_output=True,
+    )
+    return BUILD_DIR / "tpu-runner", BUILD_DIR / "tpu-shim"

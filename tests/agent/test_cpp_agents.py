@@ -1,0 +1,204 @@
+"""Wire-contract tests against the NATIVE agents: the C++ tpu-runner and
+tpu-shim must speak the same protocol as the Python reference agent
+(agent/schemas.py). Builds via cmake+ninja once per session."""
+
+import asyncio
+import shutil
+import socket
+import subprocess
+from pathlib import Path
+
+import aiohttp
+import pytest
+
+from dstack_tpu.agent import schemas
+from dstack_tpu.core.models.runs import ClusterInfo
+
+REPO = Path(__file__).resolve().parents[2]
+BUILD_DIR = REPO / "build"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _wait_port(port: int, timeout: float = 10.0) -> None:
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.close()
+            return
+        except OSError:
+            await asyncio.sleep(0.1)
+    raise TimeoutError(f"port {port} never opened")
+
+
+async def _request(port: int, method: str, path: str, json_body=None, params=None):
+    async with aiohttp.ClientSession() as session:
+        async with session.request(
+            method, f"http://127.0.0.1:{port}{path}", json=json_body, params=params
+        ) as resp:
+            return resp.status, await resp.json()
+
+
+class TestCppRunner:
+    async def test_full_job_lifecycle(self, agent_binaries, tmp_path):
+        runner_bin, _ = agent_binaries
+        port = _free_port()
+        proc = subprocess.Popen(
+            [str(runner_bin), "--port", str(port), "--home", str(tmp_path)],
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            await _wait_port(port)
+            status, body = await _request(port, "GET", "/api/healthcheck")
+            assert status == 200 and body["service"] == "tpu-runner"
+
+            submit = schemas.SubmitBody(
+                run_name="cpp-run",
+                job_name="cpp-run-0-0",
+                job_spec={
+                    "commands": [
+                        "echo native-rank-$DTPU_NODE_RANK",
+                        "echo coord=$JAX_COORDINATOR_ADDRESS",
+                    ],
+                    "env": {},
+                    "job_num": 1,
+                },
+                cluster_info=ClusterInfo(
+                    master_node_ip="10.0.0.1",
+                    nodes_ips=["10.0.0.1", "10.0.0.2"],
+                    coordinator_port=8476,
+                ),
+            )
+            status, _ = await _request(
+                port, "POST", "/api/submit", json_body=submit.model_dump()
+            )
+            assert status == 200
+            status, _ = await _request(port, "POST", "/api/run")
+            assert status == 200
+
+            # poll until finished (same protocol as the python agent)
+            states, text = [], ""
+            ts = 0.0
+            for _ in range(100):
+                status, body = await _request(
+                    port, "GET", "/api/pull", params={"timestamp": str(ts)}
+                )
+                pull = schemas.PullResponse.model_validate(body)
+                states.extend(pull.job_states)
+                text += "".join(ev.text() for ev in pull.job_logs)
+                ts = max(ts, pull.last_updated)
+                if not pull.has_more:
+                    break
+                await asyncio.sleep(0.1)
+            assert states and states[-1].state == "done"
+            assert states[-1].exit_status == 0
+            # TPU rendezvous env was injected by the NATIVE executor
+            assert "native-rank-1" in text
+            assert "coord=10.0.0.1:8476" in text
+
+            status, body = await _request(port, "GET", "/api/metrics")
+            sample = schemas.MetricsSample.model_validate(body)
+            assert sample.timestamp > 0
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    async def test_failure_and_stop(self, agent_binaries, tmp_path):
+        runner_bin, _ = agent_binaries
+        port = _free_port()
+        proc = subprocess.Popen(
+            [str(runner_bin), "--port", str(port), "--home", str(tmp_path)],
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            await _wait_port(port)
+            submit = schemas.SubmitBody(
+                run_name="r", job_name="j", job_spec={"commands": ["exit 5"]}
+            )
+            await _request(port, "POST", "/api/submit", json_body=submit.model_dump())
+            await _request(port, "POST", "/api/run")
+            for _ in range(100):
+                _, body = await _request(
+                    port, "GET", "/api/pull", params={"timestamp": "0"}
+                )
+                pull = schemas.PullResponse.model_validate(body)
+                if not pull.has_more:
+                    break
+                await asyncio.sleep(0.1)
+            last = pull.job_states[-1]
+            assert last.state == "failed" and last.exit_status == 5
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+
+class TestCppShim:
+    async def test_task_lifecycle_with_cpp_runner(self, agent_binaries, tmp_path):
+        """Shim (C++) spawns runner (C++) in process mode; the full FSM
+        and API match the contract."""
+        runner_bin, shim_bin = agent_binaries
+        port = _free_port()
+        proc = subprocess.Popen(
+            [
+                str(shim_bin),
+                "--port", str(port),
+                "--base-dir", str(tmp_path),
+                "--runtime", "process",
+                "--runner-bin", str(runner_bin),
+            ],
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            await _wait_port(port)
+            status, body = await _request(port, "GET", "/api/healthcheck")
+            assert body["service"] == "tpu-shim"
+
+            status, info = await _request(port, "GET", "/api/host_info")
+            host = schemas.HostInfo.model_validate(info)
+            assert host.cpus >= 1 and host.memory_bytes > 0
+
+            req = schemas.TaskSubmitRequest(id="t-1", name="task")
+            status, info = await _request(
+                port, "POST", "/api/tasks", json_body=req.model_dump()
+            )
+            assert status == 200
+            for _ in range(100):
+                status, info = await _request(port, "GET", "/api/tasks/t-1")
+                ti = schemas.TaskInfo.model_validate(info)
+                if ti.status in (schemas.TaskStatus.RUNNING, schemas.TaskStatus.TERMINATED):
+                    break
+                await asyncio.sleep(0.1)
+            assert ti.status == schemas.TaskStatus.RUNNING, ti
+
+            # runner inside the task answers on its port
+            runner_port = ti.ports[0].host_port
+            status, hc = await _request(runner_port, "GET", "/api/healthcheck")
+            assert hc["service"] == "tpu-runner"
+
+            # duplicate submit -> 409
+            status, _ = await _request(
+                port, "POST", "/api/tasks", json_body=req.model_dump()
+            )
+            assert status == 409
+            # remove before terminate -> 409
+            status, _ = await _request(port, "POST", "/api/tasks/t-1/remove")
+            assert status == 409
+            status, info = await _request(
+                port,
+                "POST",
+                "/api/tasks/t-1/terminate",
+                json_body={"timeout_seconds": 2},
+            )
+            assert schemas.TaskInfo.model_validate(info).status == schemas.TaskStatus.TERMINATED
+            status, _ = await _request(port, "POST", "/api/tasks/t-1/remove")
+            assert status == 200
+            status, listing = await _request(port, "GET", "/api/tasks")
+            assert listing["ids"] == []
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)

@@ -1,0 +1,72 @@
+"""Full-stack e2e with the NATIVE agents: server reconcilers drive the
+C++ tpu-shim/tpu-runner through the local backend."""
+
+import asyncio
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
+
+
+def _auth(token):
+    return {"Authorization": f"Bearer {token}"}
+
+
+class TestNativeAgentE2E:
+    async def test_task_on_cpp_agents(self, agent_binaries, tmp_path, monkeypatch):
+        monkeypatch.setenv("DTPU_NATIVE_AGENT", "1")
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="native-tok",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            body = {
+                "run_spec": {
+                    "run_name": "native-e2e",
+                    "configuration": {
+                        "type": "task",
+                        "commands": ["echo NATIVE-AGENT-OK rank=$DTPU_NODE_RANK"],
+                    },
+                    "ssh_key_pub": "ssh-ed25519 AAAA t",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth("native-tok"), json=body
+            )
+            assert r.status == 200
+            deadline = asyncio.get_event_loop().time() + 60
+            status = None
+            while asyncio.get_event_loop().time() < deadline:
+                r = await client.post(
+                    "/api/project/main/runs/get",
+                    headers=_auth("native-tok"),
+                    json={"run_name": "native-e2e"},
+                )
+                run = await r.json()
+                status = run["status"]
+                if status in ("done", "failed", "terminated"):
+                    break
+                await asyncio.sleep(0.5)
+            assert status == "done", run
+            r = await client.post(
+                "/api/project/main/logs/poll",
+                headers=_auth("native-tok"),
+                json={"run_name": "native-e2e"},
+            )
+            logs = await r.json()
+            import base64
+
+            text = "".join(
+                base64.b64decode(ev["message"]).decode() for ev in logs["logs"]
+            )
+            assert "NATIVE-AGENT-OK rank=0" in text
+        finally:
+            await client.close()

@@ -40,6 +40,7 @@ SERVICE_BODY = {
             ],
             "port": 18123,
             "model": "test-model",
+            "auth": False,
         },
         "ssh_key_pub": "ssh-ed25519 AAAA t",
     }
@@ -108,6 +109,55 @@ class TestServiceE2E:
                 headers=_auth("svc-tok"),
                 json={"runs_names": ["echo-svc"]},
             )
+        finally:
+            await client.close()
+
+    async def test_auth_enforced_by_default(self, tmp_path):
+        """Services default to auth: true — the proxy requires a valid
+        server token (reference: gateway auth check)."""
+        from pathlib import Path
+
+        from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
+
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="svc-tok",
+            with_background=False,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            body = {
+                "run_spec": {
+                    "run_name": "private-svc",
+                    "configuration": {
+                        "type": "service",
+                        "commands": ["sleep 5"],
+                        "port": 18999,
+                        # auth defaults to True
+                    },
+                    "ssh_key_pub": "k",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth("svc-tok"), json=body
+            )
+            assert r.status == 200
+            # no token -> 401 before any replica resolution
+            r = await client.get("/proxy/services/main/private-svc/x")
+            assert r.status == 401
+            # bad token -> 401
+            r = await client.get(
+                "/proxy/services/main/private-svc/x", headers=_auth("wrong")
+            )
+            assert r.status == 401
+            # valid token -> passes auth (503: no replicas yet)
+            r = await client.get(
+                "/proxy/services/main/private-svc/x", headers=_auth("svc-tok")
+            )
+            assert r.status == 503
         finally:
             await client.close()
 
