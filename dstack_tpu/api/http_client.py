@@ -17,9 +17,11 @@ from dstack_tpu.core.errors import (
 )
 from dstack_tpu.core.models.configurations import (
     FleetConfiguration,
+    GatewayConfiguration,
     VolumeConfiguration,
 )
 from dstack_tpu.core.models.fleets import Fleet
+from dstack_tpu.core.models.gateways import Gateway
 from dstack_tpu.core.models.logs import JobSubmissionLogs
 from dstack_tpu.core.models.metrics import JobMetrics
 from dstack_tpu.core.models.projects import Project
@@ -213,3 +215,29 @@ class APIClient:
         self._post(
             f"/api/project/{project}/secrets/create", {"name": name, "value": value}
         )
+
+    def list_secrets(self, project: str) -> list[dict]:
+        return self._post(f"/api/project/{project}/secrets/list")
+
+    def delete_secrets(self, project: str, names: list[str]) -> None:
+        self._post(
+            f"/api/project/{project}/secrets/delete", {"secrets_names": names}
+        )
+
+    # gateways
+    def list_gateways(self, project: str) -> list[Gateway]:
+        return [
+            Gateway.model_validate(g)
+            for g in self._post(f"/api/project/{project}/gateways/list")
+        ]
+
+    def create_gateway(self, project: str, conf: GatewayConfiguration) -> Gateway:
+        return Gateway.model_validate(
+            self._post(
+                f"/api/project/{project}/gateways/create",
+                {"configuration": conf.model_dump(mode="json")},
+            )
+        )
+
+    def delete_gateways(self, project: str, names: list[str]) -> None:
+        self._post(f"/api/project/{project}/gateways/delete", {"names": names})

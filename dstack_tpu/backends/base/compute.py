@@ -83,11 +83,17 @@ class ComputeWithPlacementGroupSupport(abc.ABC):
 class ComputeWithGatewaySupport(abc.ABC):
     @abc.abstractmethod
     async def create_gateway(self, name: str, region: str) -> dict:
-        ...
+        """Provision a gateway VM; returns provisioning data
+        ``{instance_id, ip_address, region, agent_port, agent_token?}``."""
 
     @abc.abstractmethod
     async def terminate_gateway(self, instance_id: str, region: str) -> None:
         ...
+
+    async def update_gateway_provisioning_data(self, pd: dict) -> dict:
+        """Poll the cloud for the gateway VM's IP when it wasn't
+        available at create time; returns updated provisioning data."""
+        return pd
 
 
 class ComputeWithVolumeSupport(abc.ABC):

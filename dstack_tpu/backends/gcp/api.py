@@ -210,6 +210,100 @@ class TPUNodesAPI:
         )
 
 
+GCE_API = "https://compute.googleapis.com/compute/v1"
+
+
+class GCEInstancesAPI:
+    """Plain GCE VM lifecycle — used for gateway VMs (reference
+    provisions the gateway via the backend's generic VM path,
+    base/compute.py:684-692 + gcp compute)."""
+
+    def __init__(self, project: str, transport: Optional[Transport] = None):
+        self.project = project
+        self.transport = transport or Transport()
+
+    def _zone_url(self, zone: str) -> str:
+        return f"{GCE_API}/projects/{self.project}/zones/{zone}"
+
+    async def create_instance(
+        self,
+        zone: str,
+        name: str,
+        machine_type: str = "e2-small",
+        startup_script: str = "",
+        tags: Optional[list[str]] = None,
+        public_ip: bool = True,
+    ) -> dict:
+        body = {
+            "name": name,
+            "machineType": f"zones/{zone}/machineTypes/{machine_type}",
+            "disks": [
+                {
+                    "boot": True,
+                    "autoDelete": True,
+                    "initializeParams": {
+                        "sourceImage": (
+                            "projects/ubuntu-os-cloud/global/images/family/"
+                            "ubuntu-2204-lts"
+                        ),
+                        "diskSizeGb": "30",
+                    },
+                }
+            ],
+            "networkInterfaces": [
+                {
+                    "network": "global/networks/default",
+                    **(
+                        {"accessConfigs": [{"type": "ONE_TO_ONE_NAT"}]}
+                        if public_ip
+                        else {}
+                    ),
+                }
+            ],
+            "metadata": {
+                "items": [{"key": "startup-script", "value": startup_script}]
+            },
+            "tags": {"items": tags or ["tpu-gateway"]},
+        }
+        return await self.transport.request(
+            "POST", f"{self._zone_url(zone)}/instances", json_body=body
+        )
+
+    async def get_instance(self, zone: str, name: str) -> dict:
+        return await self.transport.request(
+            "GET", f"{self._zone_url(zone)}/instances/{name}"
+        )
+
+    async def delete_instance(self, zone: str, name: str) -> dict:
+        return await self.transport.request(
+            "DELETE", f"{self._zone_url(zone)}/instances/{name}"
+        )
+
+    async def ensure_firewall_rule(
+        self, name: str, target_tag: str, ports: list[str]
+    ) -> None:
+        """Idempotently open ingress ports for instances with a tag
+        (the gateway agent port is not covered by GCP's default
+        http-server/https-server rules)."""
+        body = {
+            "name": name,
+            "network": "global/networks/default",
+            "direction": "INGRESS",
+            "allowed": [{"IPProtocol": "tcp", "ports": ports}],
+            "sourceRanges": ["0.0.0.0/0"],
+            "targetTags": [target_tag],
+        }
+        try:
+            await self.transport.request(
+                "POST",
+                f"{GCE_API}/projects/{self.project}/global/firewalls",
+                json_body=body,
+            )
+        except BackendError as e:
+            if "409" not in str(e) and "alreadyExists" not in str(e):
+                raise
+
+
 def runtime_version_for(tpu_version: str) -> str:
     """TPU runtime image matrix (reference gcp/compute.py:775-781)."""
     return {

@@ -15,12 +15,14 @@ from typing import Optional
 from dstack_tpu.backends.base.compute import (
     Compute,
     ComputeWithCreateInstanceSupport,
+    ComputeWithGatewaySupport,
     ComputeWithMultinodeSupport,
     ComputeWithReservationSupport,
     ComputeWithVolumeSupport,
 )
 from dstack_tpu.backends.gcp.api import (
     TPU_ZONES,
+    GCEInstancesAPI,
     TPUNodesAPI,
     Transport,
     runtime_version_for,
@@ -77,12 +79,45 @@ fi
 """
 
 
+GATEWAY_PORT = 8002
+
+
+def get_gateway_startup_script(token: str, server_url: str = "") -> str:
+    """Startup script for a gateway VM: nginx + certbot + the gateway
+    agent (reference base/compute.py:684-692 blue/green venv install +
+    proxy/gateway/systemd/)."""
+    server_flag = (
+        f" \\\n  --server-url {shlex.quote(server_url)}" if server_url else ""
+    )
+    return f"""#!/bin/bash
+set -e
+apt-get update -q && apt-get install -yq nginx certbot python3-certbot-nginx python3-pip
+python3 -m pip install -q dstack-tpu=={__version__} || true
+mkdir -p /root/.dtpu
+cat > /etc/systemd/system/tpu-gateway.service <<'EOF'
+[Unit]
+Description=dstack-tpu gateway agent
+After=network.target nginx.service
+[Service]
+ExecStart=/usr/bin/python3 -m dstack_tpu.gateway.app --port {GATEWAY_PORT} \\
+  --state-file /root/.dtpu/gateway-state.json --token {shlex.quote(token)} \\
+  --nginx-conf-dir /etc/nginx/sites-enabled --access-log /var/log/nginx/access.log{server_flag}
+Restart=always
+[Install]
+WantedBy=multi-user.target
+EOF
+systemctl daemon-reload
+systemctl enable --now tpu-gateway
+"""
+
+
 class GCPTPUCompute(
     Compute,
     ComputeWithCreateInstanceSupport,
     ComputeWithMultinodeSupport,
     ComputeWithReservationSupport,
     ComputeWithVolumeSupport,
+    ComputeWithGatewaySupport,
 ):
     """config: {"project_id": ..., "regions": [...], "network": ...}"""
 
@@ -91,6 +126,7 @@ class GCPTPUCompute(
         self.project_id = config.get("project_id", "")
         self.regions = config.get("regions")
         self.api = TPUNodesAPI(self.project_id, transport=transport)
+        self.gce = GCEInstancesAPI(self.project_id, transport=transport)
 
     async def get_offers(
         self, requirements: Requirements
@@ -241,6 +277,71 @@ class GCPTPUCompute(
             except BackendError as e:
                 if "404" not in str(e):
                     logger.warning("queued resource cleanup failed: %s", e)
+
+    # ---- gateways: plain GCE VMs running the gateway agent ----
+
+    async def create_gateway(self, name: str, region: str) -> dict:
+        import secrets as _secrets
+
+        zone = TPU_ZONES.get(region)
+        if zone is None:
+            raise ComputeError(f"no known zone for region {region}")
+        token = _secrets.token_hex(16)
+        vm_name = f"dtpu-gateway-{name}"
+        # default VPC rules cover only 80/443; the agent port needs its own
+        await self.gce.ensure_firewall_rule(
+            "dtpu-gateway-allow-agent", "tpu-gateway", ["80", "443", str(GATEWAY_PORT)]
+        )
+        from dstack_tpu.server import settings
+
+        await self.gce.create_instance(
+            zone,
+            vm_name,
+            startup_script=get_gateway_startup_script(token, settings.SERVER_URL),
+            tags=["tpu-gateway", "http-server", "https-server"],
+        )
+        # the insert is async; the VM may not be queryable yet — the
+        # reconciler polls update_gateway_provisioning_data for the IP
+        ip = None
+        try:
+            inst = await self.gce.get_instance(zone, vm_name)
+        except BackendError:
+            inst = {}
+        for ni in inst.get("networkInterfaces", []):
+            for ac in ni.get("accessConfigs", []):
+                if ac.get("natIP"):
+                    ip = ac["natIP"]
+        return {
+            "instance_id": vm_name,
+            "ip_address": ip,
+            "region": region,
+            "availability_zone": zone,
+            "agent_port": GATEWAY_PORT,
+            "agent_token": token,
+        }
+
+    async def terminate_gateway(self, instance_id: str, region: str) -> None:
+        zone = TPU_ZONES.get(region)
+        if zone is None:
+            return
+        try:
+            await self.gce.delete_instance(zone, instance_id)
+        except BackendError as e:
+            if "404" not in str(e):
+                raise
+
+    async def update_gateway_provisioning_data(self, pd: dict) -> dict:
+        if pd.get("ip_address"):
+            return pd
+        zone = pd.get("availability_zone") or TPU_ZONES.get(pd.get("region", ""))
+        if zone is None:
+            return pd
+        inst = await self.gce.get_instance(zone, pd["instance_id"])
+        for ni in inst.get("networkInterfaces", []):
+            for ac in ni.get("accessConfigs", []):
+                if ac.get("natIP"):
+                    pd["ip_address"] = ac["natIP"]
+        return pd
 
     # ---- volumes: persistent disks attached to TPU nodes ----
 

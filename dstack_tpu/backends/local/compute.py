@@ -19,6 +19,7 @@ import psutil
 from dstack_tpu.backends.base.compute import (
     Compute,
     ComputeWithCreateInstanceSupport,
+    ComputeWithGatewaySupport,
     ComputeWithMultinodeSupport,
 )
 from dstack_tpu.core.models.backends import BackendType
@@ -43,7 +44,10 @@ def _free_port() -> int:
 
 
 class LocalCompute(
-    Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinodeSupport
+    Compute,
+    ComputeWithCreateInstanceSupport,
+    ComputeWithMultinodeSupport,
+    ComputeWithGatewaySupport,
 ):
     """Each "instance" is a local shim subprocess with a process runtime."""
 
@@ -180,3 +184,31 @@ class LocalCompute(
                 os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
             except ProcessLookupError:
                 pass
+
+    # ---- gateways: a local tpu-gateway agent subprocess ----
+
+    async def create_gateway(self, name: str, region: str) -> dict:
+        port = _free_port()
+        gw_dir = self.base_dir / f"gateway-{name}"
+        gw_dir.mkdir(parents=True, exist_ok=True)
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable,
+            "-m",
+            "dstack_tpu.gateway.app",
+            "--host", "127.0.0.1",
+            "--port", str(port),
+            "--state-file", str(gw_dir / "state.json"),
+            start_new_session=True,
+        )
+        instance_id = f"local-gw-{port}"
+        self._procs[instance_id] = proc
+        logger.info("local gateway %s: pid=%d port=%d", name, proc.pid, port)
+        return {
+            "instance_id": instance_id,
+            "ip_address": "127.0.0.1",
+            "region": region,
+            "agent_port": port,
+        }
+
+    async def terminate_gateway(self, instance_id: str, region: str) -> None:
+        await self.terminate_instance(instance_id, region)

@@ -100,7 +100,9 @@ def apply(config_path, yes, detach, name, project) -> None:
             console.print(f"[green]Volume {vol.name} submitted[/green]")
             return
         if isinstance(conf, GatewayConfiguration):
-            _die("gateway apply is not supported yet in this build")
+            gw = client.api.create_gateway(client.project, conf)
+            console.print(f"[green]Gateway {gw.name} submitted[/green]")
+            return
         plan = client.runs.get_plan(conf, run_name=name)
         _print_plan(plan)
         if not yes and not click.confirm("Submit the run?", default=True):
@@ -298,6 +300,90 @@ def fleet_delete(name, project, yes) -> None:
     try:
         client.api.delete_fleets(client.project, [name])
         console.print(f"[green]Deleting[/green] fleet {name}")
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+@cli.group()
+def gateway() -> None:
+    """Manage gateways."""
+
+
+@gateway.command("list")
+@click.option("--project", default=None)
+def gateway_list(project) -> None:
+    client = _client(project)
+    t = Table()
+    for col in ("NAME", "BACKEND", "REGION", "DOMAIN", "ADDRESS", "DEFAULT", "STATUS"):
+        t.add_column(col)
+    for g in client.api.list_gateways(client.project):
+        t.add_row(
+            g.name,
+            g.configuration.backend,
+            g.configuration.region,
+            g.configuration.domain or "",
+            g.ip_address or "",
+            "✓" if g.default else "",
+            g.status.value,
+        )
+    console.print(t)
+
+
+@gateway.command("delete")
+@click.argument("name")
+@click.option("--project", default=None)
+@click.option("-y", "--yes", is_flag=True)
+def gateway_delete(name, project, yes) -> None:
+    if not yes and not click.confirm(f"Delete gateway {name}?", default=True):
+        return
+    client = _client(project)
+    try:
+        client.api.delete_gateways(client.project, [name])
+        console.print(f"[green]Deleted[/green] gateway {name}")
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+@cli.group()
+def secret() -> None:
+    """Manage project secrets."""
+
+
+@secret.command("set")
+@click.argument("name")
+@click.argument("value")
+@click.option("--project", default=None)
+def secret_set(name, value, project) -> None:
+    client = _client(project)
+    try:
+        client.api.create_secret(client.project, name, value)
+        console.print(f"[green]Secret {name} set[/green]")
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+@secret.command("list")
+@click.option("--project", default=None)
+def secret_list(project) -> None:
+    client = _client(project)
+    t = Table()
+    t.add_column("NAME")
+    for s in client.api.list_secrets(client.project):
+        t.add_row(s["name"])
+    console.print(t)
+
+
+@secret.command("delete")
+@click.argument("name")
+@click.option("--project", default=None)
+@click.option("-y", "--yes", is_flag=True)
+def secret_delete(name, project, yes) -> None:
+    if not yes and not click.confirm(f"Delete secret {name}?", default=True):
+        return
+    client = _client(project)
+    try:
+        client.api.delete_secrets(client.project, [name])
+        console.print(f"[green]Deleted[/green] secret {name}")
     except DstackTPUError as e:
         _die(str(e))
 

@@ -1,0 +1,10 @@
+"""Gateway agent: the standalone ingress daemon running on a gateway VM.
+
+Parity: reference src/dstack/_internal/proxy/gateway/ (FastAPI app on the
+gateway VM managing nginx + a service/replica registry + per-service RPS
+stats, registered from the server over its connection pool). TPU-native
+differences: replicas are reached directly over VPC ip:port (TPU VMs and
+the gateway share a network) instead of per-replica SSH tunnels, and the
+agent carries an embedded HTTP data path so it works without nginx (local
+backend, tests); nginx + ACME remain the production path.
+"""

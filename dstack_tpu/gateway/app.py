@@ -1,0 +1,390 @@
+"""Gateway agent HTTP app.
+
+Parity: reference proxy/gateway/app.py + routers/{registry,stats,config}
+(FastAPI app on the gateway VM, reached by the server over its gateway
+connection pool; reference gateway/routers/registry.py:122). Routes:
+
+- ``GET /healthcheck``                       agent liveness + version
+- ``POST /api/registry/services/register``   upsert service (domain, auth, model)
+- ``POST /api/registry/services/unregister``
+- ``POST /api/registry/replicas/register``   attach replica (job_id, host, port)
+- ``POST /api/registry/replicas/unregister``
+- ``GET /api/stats``                         per-service RPS windows
+- ``POST /api/config``                       acme email, server url (auth checks)
+
+Data path: nginx in production (configs written per service); embedded
+aiohttp proxy always available — by ``Host`` header for registered
+domains, by path ``/services/{project}/{run}/...``, and an
+OpenAI-compatible ``/models/{project}/...`` router.
+"""
+
+import argparse
+import asyncio
+import itertools
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from dstack_tpu.gateway.nginx import NginxManager
+from dstack_tpu.gateway.state import GatewayState, Replica, Service
+from dstack_tpu.gateway.stats import AccessLogTailer, GatewayStats
+from dstack_tpu.utils.logging import get_logger
+from dstack_tpu.version import __version__
+
+logger = get_logger("gateway.app")
+
+_rr = itertools.count()
+
+
+class GatewayAgent:
+    def __init__(
+        self,
+        state: GatewayState,
+        token: Optional[str] = None,
+        nginx: Optional[NginxManager] = None,
+        server_url: Optional[str] = None,
+    ):
+        self.state = state
+        self.token = token
+        self.nginx = nginx
+        self.server_url = server_url
+        self.stats = GatewayStats()
+        self.tailer: Optional[AccessLogTailer] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._auth_cache: dict[str, tuple[bool, float]] = {}
+
+    def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=300),
+                connector=aiohttp.TCPConnector(limit=256, keepalive_timeout=30),
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # ---- nginx sync (in executor: file IO + subprocess) ----
+
+    async def sync_nginx(self, svc: Service, removed: bool = False) -> None:
+        if self.nginx is None or not svc.domain:
+            return
+        loop = asyncio.get_running_loop()
+        if removed:
+            await loop.run_in_executor(None, self.nginx.remove_service, svc)
+        else:
+            if svc.https:
+                await loop.run_in_executor(None, self.nginx.issue_cert, svc.domain)
+            await loop.run_in_executor(None, self.nginx.write_service, svc)
+
+    # ---- end-user auth (reference: gateway checks token against server) ----
+
+    async def check_user_token(self, token: str) -> bool:
+        if not token or self.server_url is None:
+            return False
+        cached = self._auth_cache.get(token)
+        if cached is not None and cached[1] > time.time():
+            return cached[0]
+        ok = False
+        try:
+            async with self.session().post(
+                f"{self.server_url.rstrip('/')}/api/users/get_my_user",
+                headers={"Authorization": f"Bearer {token}"},
+            ) as resp:
+                ok = resp.status == 200
+        except aiohttp.ClientError:
+            ok = False
+        self._auth_cache[token] = (ok, time.time() + 60.0)
+        if len(self._auth_cache) > 10_000:  # bound the cache
+            self._auth_cache.clear()
+        return ok
+
+
+def _registry_auth(agent: GatewayAgent, request: web.Request) -> Optional[web.Response]:
+    if agent.token is None:
+        return None
+    auth = request.headers.get("Authorization", "")
+    if auth.removeprefix("Bearer ").strip() != agent.token:
+        return web.json_response({"detail": "unauthorized"}, status=401)
+    return None
+
+
+async def _service_auth(
+    agent: GatewayAgent, svc: Service, request: web.Request
+) -> Optional[web.Response]:
+    if not svc.auth:
+        return None
+    auth = request.headers.get("Authorization", "")
+    token = auth.removeprefix("Bearer ").strip() if auth.startswith("Bearer ") else ""
+    if await agent.check_user_token(token):
+        return None
+    return web.json_response(
+        {"detail": "authentication required for this service"}, status=401
+    )
+
+
+async def _forward(
+    agent: GatewayAgent, request: web.Request, svc: Service, path: str
+) -> web.StreamResponse:
+    replicas = list(svc.replicas.values())
+    if not replicas:
+        return web.json_response(
+            {"detail": f"no running replicas for {svc.run_name}"}, status=503
+        )
+    r = replicas[next(_rr) % len(replicas)]
+    url = f"http://{r.host}:{r.port}/{path.lstrip('/')}"
+    if request.query_string:
+        url += f"?{request.query_string}"
+    body = await request.read()
+    headers = {
+        k: v
+        for k, v in request.headers.items()
+        if k.lower() not in ("host", "authorization", "transfer-encoding")
+    }
+    try:
+        async with agent.session().request(
+            request.method, url, data=body, headers=headers
+        ) as upstream:
+            # pass response headers through except hop-by-hop ones
+            # (Set-Cookie/Location/rate-limit headers must survive)
+            hop = {
+                "transfer-encoding", "connection", "keep-alive", "upgrade",
+                "content-length", "proxy-authenticate", "te", "trailers",
+            }
+            out_headers = [
+                (k, v) for k, v in upstream.headers.items() if k.lower() not in hop
+            ]
+            resp = web.StreamResponse(status=upstream.status)
+            for k, v in out_headers:
+                resp.headers.add(k, v)
+            await resp.prepare(request)
+            async for chunk in upstream.content.iter_chunked(64 * 1024):
+                await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        return web.json_response({"detail": f"replica unreachable: {e}"}, status=502)
+
+
+def build_app(agent: GatewayAgent) -> web.Application:
+    app = web.Application()
+    app["agent"] = agent
+
+    # ---- health + registry ----
+
+    async def healthcheck(request: web.Request) -> web.Response:
+        return web.json_response({"service": "tpu-gateway", "version": __version__})
+
+    async def register_service(request: web.Request) -> web.Response:
+        denied = _registry_auth(agent, request)
+        if denied:
+            return denied
+        b = await request.json()
+        svc = Service(
+            project=b["project"],
+            run_name=b["run_name"],
+            domain=b.get("domain"),
+            auth=b.get("auth", True),
+            client_max_body_size=b.get("client_max_body_size", 64 * 1024 * 1024),
+            strip_prefix=b.get("strip_prefix", True),
+            model_name=b.get("model_name"),
+            model_prefix=b.get("model_prefix", "/v1"),
+            https=b.get("https", True),
+        )
+        agent.state.register_service(svc)
+        await agent.sync_nginx(agent.state.get(svc.project, svc.run_name))
+        return web.json_response({"status": "ok"})
+
+    async def unregister_service(request: web.Request) -> web.Response:
+        denied = _registry_auth(agent, request)
+        if denied:
+            return denied
+        b = await request.json()
+        svc = agent.state.unregister_service(b["project"], b["run_name"])
+        if svc is not None:
+            await agent.sync_nginx(svc, removed=True)
+        return web.json_response({"status": "ok"})
+
+    async def register_replica(request: web.Request) -> web.Response:
+        denied = _registry_auth(agent, request)
+        if denied:
+            return denied
+        b = await request.json()
+        try:
+            svc = agent.state.register_replica(
+                b["project"],
+                b["run_name"],
+                Replica(job_id=b["job_id"], host=b["host"], port=int(b["port"])),
+            )
+        except KeyError as e:
+            return web.json_response({"detail": str(e)}, status=404)
+        await agent.sync_nginx(svc)
+        return web.json_response({"status": "ok"})
+
+    async def unregister_replica(request: web.Request) -> web.Response:
+        denied = _registry_auth(agent, request)
+        if denied:
+            return denied
+        b = await request.json()
+        svc = agent.state.unregister_replica(
+            b["project"], b["run_name"], b["job_id"]
+        )
+        if svc is not None:
+            await agent.sync_nginx(svc)
+        return web.json_response({"status": "ok"})
+
+    async def get_stats(request: web.Request) -> web.Response:
+        denied = _registry_auth(agent, request)
+        if denied:
+            return denied
+        if agent.tailer is not None:
+            agent.tailer.poll()
+        return web.json_response({"services": agent.stats.snapshot()})
+
+    async def set_config(request: web.Request) -> web.Response:
+        denied = _registry_auth(agent, request)
+        if denied:
+            return denied
+        b = await request.json()
+        agent.state.set_config(
+            acme_email=b.get("acme_email"), server_url=b.get("server_url")
+        )
+        if "acme_email" in b and agent.nginx is not None:
+            agent.nginx.acme_email = b["acme_email"]
+        if "server_url" in b:
+            agent.server_url = b["server_url"]
+        return web.json_response({"status": "ok"})
+
+    app.router.add_get("/healthcheck", healthcheck)
+    app.router.add_post("/api/registry/services/register", register_service)
+    app.router.add_post("/api/registry/services/unregister", unregister_service)
+    app.router.add_post("/api/registry/replicas/register", register_replica)
+    app.router.add_post("/api/registry/replicas/unregister", unregister_replica)
+    app.router.add_get("/api/stats", get_stats)
+    app.router.add_post("/api/config", set_config)
+
+    # ---- embedded data path ----
+
+    async def path_proxy(request: web.Request) -> web.StreamResponse:
+        project = request.match_info["project"]
+        run_name = request.match_info["run_name"]
+        path = request.match_info.get("path", "")
+        svc = agent.state.get(project, run_name)
+        if svc is None:
+            return web.json_response({"detail": "service not found"}, status=404)
+        denied = await _service_auth(agent, svc, request)
+        if denied:
+            return denied
+        agent.stats.record(project, run_name)
+        # strip_prefix=false services expect the full request path
+        if not svc.strip_prefix:
+            path = request.path
+        return await _forward(agent, request, svc, path)
+
+    async def model_list(request: web.Request) -> web.Response:
+        project = request.match_info["project"]
+        # anonymous callers see only auth:false models; a valid server
+        # token reveals the rest (no enumeration of private services)
+        auth_hdr = request.headers.get("Authorization", "")
+        token = (
+            auth_hdr.removeprefix("Bearer ").strip()
+            if auth_hdr.startswith("Bearer ")
+            else ""
+        )
+        authed = await agent.check_user_token(token) if token else False
+        data = [
+            {"id": s.model_name, "object": "model", "owned_by": "dstack-tpu"}
+            for s in agent.state.models(project)
+            if authed or not s.auth
+        ]
+        return web.json_response({"object": "list", "data": data})
+
+    async def model_proxy(request: web.Request) -> web.StreamResponse:
+        project = request.match_info["project"]
+        path = request.match_info.get("path", "chat/completions")
+        body_raw = await request.read()
+        try:
+            payload = json.loads(body_raw) if body_raw else {}
+        except json.JSONDecodeError:
+            return web.json_response({"detail": "invalid JSON"}, status=400)
+        svc = agent.state.by_model(project, payload.get("model"))
+        if svc is None:
+            return web.json_response(
+                {"detail": f"model {payload.get('model')!r} not found"}, status=404
+            )
+        denied = await _service_auth(agent, svc, request)
+        if denied:
+            return denied
+        agent.stats.record(project, svc.run_name)
+        return await _forward(
+            agent,
+            request,
+            svc,
+            f"{svc.model_prefix.strip('/')}/{path.lstrip('/')}",
+        )
+
+    async def host_proxy(request: web.Request) -> web.StreamResponse:
+        """Catch-all: route by Host header for registered domains (what
+        nginx does in production, available without it)."""
+        svc = agent.state.by_domain(request.headers.get("Host", ""))
+        if svc is None:
+            return web.json_response({"detail": "not found"}, status=404)
+        denied = await _service_auth(agent, svc, request)
+        if denied:
+            return denied
+        agent.stats.record(svc.project, svc.run_name)
+        return await _forward(agent, request, svc, request.path)
+
+    app.router.add_get("/models/{project}/models", model_list)
+    app.router.add_post("/models/{project}/{path:.*}", model_proxy)
+    app.router.add_route(
+        "*", "/services/{project}/{run_name}/{path:.*}", path_proxy
+    )
+    app.router.add_route("*", "/{path:.*}", host_proxy)
+
+    async def on_cleanup(app: web.Application) -> None:
+        await agent.close()
+
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="tpu-gateway")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8002)
+    p.add_argument("--state-file", default="")
+    p.add_argument("--token", default="")
+    p.add_argument("--server-url", default="")
+    p.add_argument("--nginx-conf-dir", default="")
+    p.add_argument("--access-log", default="")
+    args = p.parse_args(argv)
+
+    state = GatewayState(Path(args.state_file) if args.state_file else None)
+    nginx = (
+        NginxManager(conf_dir=Path(args.nginx_conf_dir))
+        if args.nginx_conf_dir
+        else None
+    )
+    agent = GatewayAgent(
+        state,
+        token=args.token or None,
+        nginx=nginx,
+        # precedence: CLI flag, then the persisted value from the last
+        # /api/config push (auth must survive agent restarts)
+        server_url=args.server_url or state.server_url or None,
+    )
+    if args.access_log:
+        agent.tailer = AccessLogTailer(Path(args.access_log), state, agent.stats)
+    app = build_app(agent)
+    logger.info("tpu-gateway listening on %s:%d", args.host, args.port)
+    web.run_app(app, host=args.host, port=args.port, print=None)
+
+
+if __name__ == "__main__":
+    main()

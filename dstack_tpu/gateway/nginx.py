@@ -1,0 +1,117 @@
+"""nginx site-config management + ACME certificates for the gateway VM.
+
+Parity: reference proxy/gateway/services/nginx.py:56-180 (per-domain
+site config written to conf.d, `nginx -s reload`, certbot per-domain).
+The command runner is injectable so tests assert the rendered configs
+and reload/certbot invocations without nginx installed.
+"""
+
+import subprocess
+from pathlib import Path
+from typing import Callable, Optional
+
+from dstack_tpu.gateway.state import Service
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("gateway.nginx")
+
+CommandRunner = Callable[[list[str]], subprocess.CompletedProcess]
+
+
+def _run(cmd: list[str]) -> subprocess.CompletedProcess:
+    return subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+
+
+class NginxManager:
+    def __init__(
+        self,
+        conf_dir: Path = Path("/etc/nginx/sites-enabled"),
+        runner: CommandRunner = _run,
+        acme_email: Optional[str] = None,
+    ):
+        self.conf_dir = Path(conf_dir)
+        self.runner = runner
+        self.acme_email = acme_email
+
+    # ---- site configs ----
+
+    def _conf_path(self, svc: Service) -> Path:
+        return self.conf_dir / f"443-{svc.domain}.conf"
+
+    def write_service(self, svc: Service) -> None:
+        """Render and install the site config for a service, then reload."""
+        if not svc.domain:
+            return
+        self.conf_dir.mkdir(parents=True, exist_ok=True)
+        self._conf_path(svc).write_text(self.render_config(svc))
+        self.reload()
+
+    def remove_service(self, svc: Service) -> None:
+        if not svc.domain:
+            return
+        path = self._conf_path(svc)
+        if path.exists():
+            path.unlink()
+        self.reload()
+
+    def render_config(self, svc: Service) -> str:
+        upstream = f"{svc.run_name}_{svc.project}".replace("-", "_")
+        servers = (
+            "\n".join(
+                f"    server {r.host}:{r.port};" for r in svc.replicas.values()
+            )
+            or "    server 127.0.0.1:9;  # no replicas: connection refused -> 502"
+        )
+        listen = (
+            f"""
+    listen 443 ssl;
+    ssl_certificate /etc/letsencrypt/live/{svc.domain}/fullchain.pem;
+    ssl_certificate_key /etc/letsencrypt/live/{svc.domain}/privkey.pem;"""
+            if svc.https
+            else """
+    listen 80;"""
+        )
+        return f"""upstream {upstream} {{
+{servers}
+}}
+
+server {{{listen}
+    server_name {svc.domain};
+    client_max_body_size {svc.client_max_body_size};
+
+    location / {{
+        proxy_pass http://{upstream};
+        proxy_set_header Host $host;
+        proxy_set_header X-Real-IP $remote_addr;
+        proxy_http_version 1.1;
+        proxy_set_header Upgrade $http_upgrade;
+        proxy_set_header Connection "upgrade";
+        proxy_read_timeout 300s;
+        proxy_buffering off;
+    }}
+}}
+"""
+
+    # ---- control ----
+
+    def reload(self) -> None:
+        result = self.runner(["nginx", "-s", "reload"])
+        if result.returncode != 0:
+            logger.warning("nginx reload failed: %s", result.stderr)
+
+    def issue_cert(self, domain: str) -> bool:
+        """Obtain a Let's Encrypt certificate for the domain (reference
+        nginx.py run_certbot). Returns True on success."""
+        cmd = [
+            "certbot", "certonly", "--non-interactive", "--agree-tos",
+            "--nginx", "--domain", domain,
+        ]
+        if self.acme_email:
+            cmd += ["--email", self.acme_email]
+        else:
+            cmd += ["--register-unsafely-without-email"]
+        result = self.runner(cmd)
+        if result.returncode != 0:
+            logger.warning("certbot failed for %s: %s", domain, result.stderr)
+            return False
+        return True

@@ -1,0 +1,182 @@
+"""Gateway registry state, persisted across agent restarts.
+
+Parity: reference proxy/gateway/repo/state_v1.py:164 (versioned JSON
+state file restored on gateway restart).
+"""
+
+import json
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+STATE_VERSION = 1
+
+
+@dataclass
+class Replica:
+    job_id: str
+    host: str
+    port: int
+
+
+@dataclass
+class Service:
+    project: str
+    run_name: str
+    domain: Optional[str] = None  # full host, e.g. myrun.gw.example.com
+    auth: bool = True
+    client_max_body_size: int = 64 * 1024 * 1024
+    strip_prefix: bool = True
+    model_name: Optional[str] = None  # OpenAI model routing
+    model_prefix: str = "/v1"
+    https: bool = True
+    replicas: dict[str, Replica] = field(default_factory=dict)
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.project, self.run_name)
+
+
+class GatewayState:
+    """In-memory registry with JSON persistence. Thread-safe: the agent's
+    aiohttp handlers run on one loop, but nginx/certbot work happens in
+    executor threads."""
+
+    def __init__(self, path: Optional[Path] = None):
+        self._path = path
+        self._lock = threading.Lock()
+        self.services: dict[tuple[str, str], Service] = {}
+        self.acme_email: Optional[str] = None
+        self.server_url: Optional[str] = None  # survives agent restarts
+        if path is not None and path.exists():
+            self._load()
+
+    def set_config(
+        self,
+        acme_email: Optional[str] = None,
+        server_url: Optional[str] = None,
+    ) -> None:
+        with self._lock:
+            if acme_email is not None:
+                self.acme_email = acme_email
+            if server_url is not None:
+                self.server_url = server_url
+            self._save()
+
+    # ---- mutations (each persists) ----
+
+    def register_service(self, svc: Service) -> None:
+        with self._lock:
+            prev = self.services.get(svc.key)
+            if prev is not None:
+                svc.replicas = prev.replicas  # keep live replicas on update
+            self.services[svc.key] = svc
+            self._save()
+
+    def unregister_service(self, project: str, run_name: str) -> Optional[Service]:
+        with self._lock:
+            svc = self.services.pop((project, run_name), None)
+            self._save()
+            return svc
+
+    def register_replica(self, project: str, run_name: str, replica: Replica) -> Service:
+        with self._lock:
+            svc = self.services.get((project, run_name))
+            if svc is None:
+                raise KeyError(f"service {project}/{run_name} not registered")
+            svc.replicas[replica.job_id] = replica
+            self._save()
+            return svc
+
+    def unregister_replica(self, project: str, run_name: str, job_id: str) -> Optional[Service]:
+        with self._lock:
+            svc = self.services.get((project, run_name))
+            if svc is None:
+                return None
+            svc.replicas.pop(job_id, None)
+            self._save()
+            return svc
+
+    # ---- queries ----
+
+    def get(self, project: str, run_name: str) -> Optional[Service]:
+        return self.services.get((project, run_name))
+
+    def by_domain(self, host: str) -> Optional[Service]:
+        host = host.split(":")[0].lower()
+        for svc in self.services.values():
+            if svc.domain and svc.domain.lower() == host:
+                return svc
+        return None
+
+    def by_model(self, project: str, model_name: str) -> Optional[Service]:
+        for svc in self.services.values():
+            if svc.project == project and svc.model_name == model_name:
+                return svc
+        return None
+
+    def models(self, project: str) -> list[Service]:
+        return [
+            s
+            for s in self.services.values()
+            if s.project == project and s.model_name
+        ]
+
+    # ---- persistence ----
+
+    def _save(self) -> None:
+        if self._path is None:
+            return
+        data = {
+            "version": STATE_VERSION,
+            "acme_email": self.acme_email,
+            "server_url": self.server_url,
+            "services": [
+                {
+                    "project": s.project,
+                    "run_name": s.run_name,
+                    "domain": s.domain,
+                    "auth": s.auth,
+                    "client_max_body_size": s.client_max_body_size,
+                    "strip_prefix": s.strip_prefix,
+                    "model_name": s.model_name,
+                    "model_prefix": s.model_prefix,
+                    "https": s.https,
+                    "replicas": [
+                        {"job_id": r.job_id, "host": r.host, "port": r.port}
+                        for r in s.replicas.values()
+                    ],
+                }
+                for s in self.services.values()
+            ],
+        }
+        tmp = self._path.with_suffix(".tmp")
+        tmp.parent.mkdir(parents=True, exist_ok=True)
+        tmp.write_text(json.dumps(data, indent=1))
+        tmp.replace(self._path)
+
+    def _load(self) -> None:
+        try:
+            data = json.loads(self._path.read_text())
+        except (json.JSONDecodeError, OSError):
+            return
+        self.acme_email = data.get("acme_email")
+        self.server_url = data.get("server_url")
+        for sd in data.get("services", []):
+            svc = Service(
+                project=sd["project"],
+                run_name=sd["run_name"],
+                domain=sd.get("domain"),
+                auth=sd.get("auth", True),
+                client_max_body_size=sd.get("client_max_body_size", 64 * 1024 * 1024),
+                strip_prefix=sd.get("strip_prefix", True),
+                model_name=sd.get("model_name"),
+                model_prefix=sd.get("model_prefix", "/v1"),
+                https=sd.get("https", True),
+            )
+            for rd in sd.get("replicas", []):
+                svc.replicas[rd["job_id"]] = Replica(
+                    job_id=rd["job_id"], host=rd["host"], port=rd["port"]
+                )
+            self.services[svc.key] = svc

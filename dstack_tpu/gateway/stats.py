@@ -1,0 +1,80 @@
+"""Per-service request stats on the gateway.
+
+Parity: reference proxy/gateway/services/stats.py:156 — collects
+per-service RPS windows from the nginx access log; the server scrapes
+them to drive the RPS autoscaler. The embedded data path records
+requests directly; nginx mode tails the access log incrementally.
+"""
+
+import time
+from collections import defaultdict, deque
+from pathlib import Path
+from typing import Deque, Optional
+
+WINDOW_SECONDS = 600.0
+
+
+class GatewayStats:
+    def __init__(self) -> None:
+        self._requests: dict[tuple[str, str], Deque[float]] = defaultdict(deque)
+
+    def record(self, project: str, run_name: str, ts: Optional[float] = None) -> None:
+        q = self._requests[(project, run_name)]
+        q.append(ts if ts is not None else time.time())
+        cutoff = time.time() - WINDOW_SECONDS
+        while q and q[0] < cutoff:
+            q.popleft()
+
+    def snapshot(self) -> list[dict]:
+        """→ [{project, run_name, requests_60s, last_request_at}] for the
+        server's stats collector."""
+        now = time.time()
+        out = []
+        for (project, run_name), q in self._requests.items():
+            n60 = sum(1 for t in q if t >= now - 60.0)
+            out.append(
+                {
+                    "project": project,
+                    "run_name": run_name,
+                    "requests_60s": n60,
+                    "last_request_at": q[-1] if q else 0.0,
+                }
+            )
+        return out
+
+
+class AccessLogTailer:
+    """Incremental nginx access-log reader. Expects the default combined
+    format with ``$host`` prepended via::
+
+        log_format gateway '$host $remote_addr [$time_local] "$request" $status';
+
+    Each line's host is resolved to a service via the registry's domain
+    index and recorded into the stats."""
+
+    def __init__(self, path: Path, state, stats: GatewayStats):
+        self.path = Path(path)
+        self.state = state
+        self.stats = stats
+        self._offset = 0
+
+    def poll(self) -> int:
+        """Read any new lines; returns number of requests recorded."""
+        if not self.path.exists():
+            return 0
+        size = self.path.stat().st_size
+        if size < self._offset:  # rotated
+            self._offset = 0
+        if size == self._offset:
+            return 0
+        n = 0
+        with self.path.open("r", errors="replace") as f:
+            f.seek(self._offset)
+            for line in f:
+                host = line.split(" ", 1)[0].strip()
+                svc = self.state.by_domain(host)
+                if svc is not None:
+                    self.stats.record(svc.project, svc.run_name)
+                    n += 1
+            self._offset = f.tell()
+        return n

@@ -13,6 +13,8 @@ class ServiceStats:
     def __init__(self, window_seconds: float = 600.0):
         self.window = window_seconds
         self._requests: dict[tuple[str, str], Deque[float]] = defaultdict(deque)
+        # gateway-reported windows: (project, run) -> (rps, recorded_monotonic)
+        self._external: dict[tuple[str, str], tuple[float, float]] = {}
 
     def record(self, project: str, run_name: str) -> None:
         q = self._requests[(project, run_name)]
@@ -24,14 +26,22 @@ class ServiceStats:
         while q and q[0] < cutoff:
             q.popleft()
 
+    def merge_external(self, project: str, run_name: str, rps: float) -> None:
+        """Record a gateway-scraped RPS sample (reference: server pulls
+        gateway /api/stats windows to drive the autoscaler)."""
+        self._external[(project, run_name)] = (rps, time.monotonic())
+
     def rps(self, project: str, run_name: str, over_seconds: float = 60.0) -> float:
+        total = 0.0
+        ext = self._external.get((project, run_name))
+        if ext is not None and time.monotonic() - ext[1] < 120.0:
+            total += ext[0]
         q = self._requests.get((project, run_name))
-        if not q:
-            return 0.0
-        self._trim(q)
-        cutoff = time.monotonic() - over_seconds
-        n = sum(1 for t in q if t >= cutoff)
-        return n / over_seconds
+        if q:
+            self._trim(q)
+            cutoff = time.monotonic() - over_seconds
+            total += sum(1 for t in q if t >= cutoff) / over_seconds
+        return total
 
     def last_request_at(self, project: str, run_name: str) -> float:
         q = self._requests.get((project, run_name))

@@ -67,6 +67,9 @@ async def create_app(
         session = state.get("proxy_session")
         if session is not None and not session.closed:
             await session.close()
+        from dstack_tpu.server.services.gateways import get_connection_pool
+
+        await get_connection_pool().close()
         await db.close()
 
     app.on_startup.append(on_startup)

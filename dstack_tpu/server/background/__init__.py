@@ -22,6 +22,7 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
     from dstack_tpu.server.background.tasks.process_terminating_jobs import (
         process_terminating_jobs,
     )
+    from dstack_tpu.server.background.tasks.process_gateways import process_gateways
     from dstack_tpu.server.background.tasks.process_volumes import process_volumes
 
     sched = BackgroundScheduler()
@@ -32,5 +33,6 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
     sched.add(lambda: process_instances(db), 2.0, "process_instances")
     sched.add(lambda: process_fleets(db), 10.0, "process_fleets")
     sched.add(lambda: process_volumes(db), 10.0, "process_volumes")
+    sched.add(lambda: process_gateways(db), 5.0, "process_gateways")
     sched.add(lambda: collect_metrics(db), 10.0, "collect_metrics")
     return sched

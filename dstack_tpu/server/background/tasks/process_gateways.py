@@ -1,0 +1,192 @@
+"""Gateway reconciliation: provision submitted gateways, healthcheck
+running ones, scrape their per-service stats for the autoscaler.
+
+Parity: reference server/background/tasks/process_gateways.py (175 LoC:
+provision submitted gateways, connection-pool upkeep) + the stats pull
+that feeds RPSAutoscaler (reference: gateway stats flow into
+process_runs via services/pool).
+"""
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.configurations import GatewayConfiguration
+from dstack_tpu.core.models.gateways import GatewayStatus
+from dstack_tpu.core.models.runs import now_utc
+from dstack_tpu.proxy.stats import get_service_stats
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import gateways as gateways_service
+from dstack_tpu.server.services.locking import claim_one
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("background.process_gateways")
+
+PROVISION_TIMEOUT_SECONDS = 10 * 60
+
+
+async def process_gateways(db: Database) -> None:
+    rows = await db.fetchall(
+        "SELECT id FROM gateways WHERE status IN (?, ?) "
+        "ORDER BY last_processed_at ASC LIMIT 10",
+        (GatewayStatus.SUBMITTED.value, GatewayStatus.PROVISIONING.value),
+    )
+    async with claim_one("gateways", [r["id"] for r in rows]) as gid:
+        if gid is not None:
+            await _process(db, gid)
+    await _collect_stats(db)
+    await _sync_services(db)
+
+
+async def _process(db: Database, gateway_id: str) -> None:
+    row = await db.get_by_id("gateways", gateway_id)
+    if row is None:
+        return
+    try:
+        if row["status"] == GatewayStatus.SUBMITTED.value:
+            await _provision(db, row)
+        elif row["status"] == GatewayStatus.PROVISIONING.value:
+            await _check_ready(db, row)
+    finally:
+        await db.update_by_id(
+            "gateways", gateway_id, {"last_processed_at": now_utc().isoformat()}
+        )
+
+
+async def _provision(db: Database, row: dict) -> None:
+    from dstack_tpu.backends.base.compute import ComputeWithGatewaySupport
+
+    project_row = await db.get_by_id("projects", row["project_id"])
+    conf = GatewayConfiguration.model_validate(loads(row["configuration"]))
+    compute = await backends_service.get_project_backend(
+        db, project_row, BackendType(conf.backend)
+    )
+    if not isinstance(compute, ComputeWithGatewaySupport):
+        await db.update_by_id(
+            "gateways",
+            row["id"],
+            {
+                "status": GatewayStatus.FAILED.value,
+                "status_message": f"backend {conf.backend} does not support gateways",
+            },
+        )
+        return
+    try:
+        pd = await compute.create_gateway(row["name"], conf.region)
+    except Exception as e:
+        logger.warning("gateway %s provisioning failed: %s", row["name"], e)
+        await db.update_by_id(
+            "gateways",
+            row["id"],
+            {"status": GatewayStatus.FAILED.value, "status_message": str(e)},
+        )
+        return
+    await db.update_by_id(
+        "gateways",
+        row["id"],
+        {
+            "status": GatewayStatus.PROVISIONING.value,
+            "provisioning_data": dumps(pd),
+            "ip_address": pd.get("ip_address"),
+        },
+    )
+    logger.info("gateway %s: instance %s created", row["name"], pd.get("instance_id"))
+
+
+async def _check_ready(db: Database, row: dict) -> None:
+    """Healthcheck the agent; RUNNING when it responds."""
+    from datetime import datetime
+
+    if not row.get("ip_address"):
+        # VM IP wasn't assigned at create time; poll the backend
+        from dstack_tpu.backends.base.compute import ComputeWithGatewaySupport
+
+        project_row = await db.get_by_id("projects", row["project_id"])
+        conf = GatewayConfiguration.model_validate(loads(row["configuration"]))
+        compute = await backends_service.get_project_backend(
+            db, project_row, BackendType(conf.backend)
+        )
+        pd = loads(row.get("provisioning_data")) or {}
+        if isinstance(compute, ComputeWithGatewaySupport):
+            pd = await compute.update_gateway_provisioning_data(pd)
+            await db.update_by_id(
+                "gateways",
+                row["id"],
+                {"provisioning_data": dumps(pd), "ip_address": pd.get("ip_address")},
+            )
+            row = {**row, "provisioning_data": dumps(pd), "ip_address": pd.get("ip_address")}
+
+    resp = await gateways_service.call_agent(row, "GET", "/healthcheck")
+    if resp is not None:
+        # push server_url so the agent can validate end-user tokens
+        # against /api/users/get_my_user (reference: gateway auth check
+        # proxies to the dstack server)
+        from dstack_tpu.server import settings
+
+        await gateways_service.call_agent(
+            row, "POST", "/api/config", {"server_url": settings.SERVER_URL}
+        )
+        await db.update_by_id(
+            "gateways", row["id"], {"status": GatewayStatus.RUNNING.value}
+        )
+        logger.info("gateway %s: running at %s", row["name"], row.get("ip_address"))
+        return
+    created = datetime.fromisoformat(row["created_at"])
+    if (now_utc() - created).total_seconds() > PROVISION_TIMEOUT_SECONDS:
+        await db.update_by_id(
+            "gateways",
+            row["id"],
+            {
+                "status": GatewayStatus.FAILED.value,
+                "status_message": "agent did not become reachable in time",
+            },
+        )
+
+
+async def _sync_services(db: Database) -> None:
+    """Re-assert every RUNNING service replica on its gateway each cycle
+    (idempotent upserts). Heals one-shot registration failures at the
+    PULLING→RUNNING transition and agent restarts that lost state."""
+    from dstack_tpu.core.models.runs import JobStatus
+
+    gateways = await db.fetchall(
+        "SELECT * FROM gateways WHERE status = ?", (GatewayStatus.RUNNING.value,)
+    )
+    if not gateways:
+        return
+    job_rows = await db.fetchall(
+        "SELECT * FROM jobs WHERE status = ?", (JobStatus.RUNNING.value,)
+    )
+    for job_row in job_rows:
+        spec = loads(job_row["job_spec"]) or {}
+        if spec.get("service_port") is None:
+            continue
+        resolved = await gateways_service.gateway_row_for_job(db, job_row)
+        if resolved is None:
+            continue
+        gw_row, project_row, run_row = resolved
+        jpd = loads(job_row.get("job_provisioning_data")) or {}
+        await gateways_service.register_replica(
+            db,
+            gw_row,
+            project_row["name"],
+            run_row,
+            job_row,
+            host=jpd.get("hostname") or "127.0.0.1",
+            port=int(spec["service_port"]),
+        )
+
+
+async def _collect_stats(db: Database) -> None:
+    """Pull /api/stats from every RUNNING gateway into the in-server
+    ServiceStats so RPSAutoscaler sees gateway traffic too."""
+    rows = await db.fetchall(
+        "SELECT * FROM gateways WHERE status = ?", (GatewayStatus.RUNNING.value,)
+    )
+    stats = get_service_stats()
+    for row in rows:
+        resp = await gateways_service.call_agent(row, "GET", "/api/stats")
+        if resp is None:
+            continue
+        for s in resp.get("services", []):
+            stats.merge_external(
+                s["project"], s["run_name"], s.get("requests_60s", 0) / 60.0
+            )

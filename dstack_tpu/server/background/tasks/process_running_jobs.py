@@ -251,6 +251,41 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
         },
     )
     logger.info("job %s: running", job_spec.job_name)
+    await _register_on_gateway(db, job_row, job_spec, jpd)
+
+
+async def _register_on_gateway(
+    db: Database, job_row: dict, job_spec: JobSpec, jpd: JobProvisioningData
+) -> None:
+    """Publish a freshly RUNNING service replica to the run's gateway
+    (reference process_running_jobs.py:316-349 -> gateway registry)."""
+    from dstack_tpu.server.services import gateways as gateways_service
+
+    if job_spec.service_port is None:
+        return
+    resolved = await gateways_service.gateway_row_for_job(db, job_row)
+    if resolved is None:
+        return
+    gw_row, project_row, run_row = resolved
+    ok = await gateways_service.register_replica(
+        db,
+        gw_row,
+        project_row["name"],
+        run_row,
+        job_row,
+        host=jpd.hostname or "127.0.0.1",
+        port=int(job_spec.service_port),
+    )
+    if ok:
+        logger.info(
+            "job %s: replica registered on gateway %s",
+            job_spec.job_name,
+            gw_row["name"],
+        )
+    else:
+        logger.warning(
+            "job %s: gateway %s registration failed", job_spec.job_name, gw_row["name"]
+        )
 
 
 async def _get_code_blob(db: Database, run_row: dict) -> Optional[bytes]:

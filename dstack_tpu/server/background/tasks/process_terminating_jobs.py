@@ -56,6 +56,7 @@ async def _process(db: Database, job_id: str) -> None:
         if job_row.get("instance_id"):
             await _release_instance(db, job_row)
 
+    await _unregister_from_gateway(db, job_row)
     reason = (
         JobTerminationReason(job_row["termination_reason"])
         if job_row.get("termination_reason")
@@ -66,6 +67,34 @@ async def _process(db: Database, job_id: str) -> None:
         db, job_row["id"], final, termination_reason=reason
     )
     logger.info("job %s: %s (%s)", job_row["job_name"], final.value, reason.value)
+
+
+async def _unregister_from_gateway(db: Database, job_row: dict) -> None:
+    """Withdraw the replica from the run's gateway; when it was the last
+    one, drop the whole service entry (reference jobs service
+    unregisters replicas on termination)."""
+    from dstack_tpu.server.services import gateways as gateways_service
+
+    resolved = await gateways_service.gateway_row_for_job(db, job_row)
+    if resolved is None:
+        return
+    gw_row, project_row, run_row = resolved
+    await gateways_service.unregister_replica(
+        db, gw_row, project_row["name"], run_row["run_name"], job_row["id"]
+    )
+    live = await db.fetchone(
+        "SELECT id FROM jobs WHERE run_id = ? AND id != ? AND status IN (?, ?)",
+        (
+            run_row["id"],
+            job_row["id"],
+            JobStatus.RUNNING.value,
+            JobStatus.TERMINATING.value,
+        ),
+    )
+    if live is None:
+        await gateways_service.unregister_service(
+            db, gw_row, project_row["name"], run_row["run_name"]
+        )
 
 
 async def _release_instance(db: Database, job_row: dict) -> None:

@@ -1,31 +1,46 @@
-"""Gateway CRUD.
+"""Gateways: CRUD, provisioning glue, agent connection pool, service sync.
 
 Parity: reference server/services/gateways/ (create_gateway:129,
-connection pool, service sync). In this build the in-server proxy is the
-default ingress; gateway rows model dedicated ingress VMs — provisioning
-them requires a backend with ComputeWithGatewaySupport (the GCP gateway
-VM path is future work; the registry/API surface is complete).
+connect_to_gateway_with_retry:173, connection.py/pool.py/client.py) and
+server/services/services/ (register_replica used at
+process_running_jobs.py:332). TPU-native: the gateway agent is reached
+directly over HTTP on its VPC/public IP (reference tunnels SSH); the
+agent's embedded proxy serves traffic even before DNS/nginx exist.
 """
 
+import asyncio
 from datetime import datetime
+from typing import Optional
+
+import aiohttp
 
 from dstack_tpu.core.errors import ClientError, ResourceNotExistsError
 from dstack_tpu.core.models.configurations import GatewayConfiguration
-from dstack_tpu.core.models.gateways import Gateway, GatewayStatus
+from dstack_tpu.core.models.gateways import (
+    Gateway,
+    GatewayProvisioningData,
+    GatewayStatus,
+)
 from dstack_tpu.core.models.runs import new_uuid, now_utc
 from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.gateways")
 
 
 def gateway_row_to_model(row: dict, project_name: str) -> Gateway:
+    pd = loads(row.get("provisioning_data"))
+    conf = GatewayConfiguration.model_validate(loads(row["configuration"]))
     return Gateway(
         id=row["id"],
         name=row["name"],
         project_name=project_name,
-        configuration=GatewayConfiguration.model_validate(loads(row["configuration"])),
+        configuration=conf,
         created_at=datetime.fromisoformat(row["created_at"]),
         status=GatewayStatus(row["status"]),
         status_message=row.get("status_message"),
         ip_address=row.get("ip_address"),
+        hostname=(pd or {}).get("hostname"),
         default=bool(row.get("is_default")),
     )
 
@@ -66,11 +81,243 @@ async def create_gateway(
 
 
 async def delete_gateways(db: Database, project_row: dict, names: list[str]) -> None:
+    from dstack_tpu.server.services import backends as backends_service
+
     for name in names:
         row = await db.fetchone(
-            "SELECT id FROM gateways WHERE project_id = ? AND name = ?",
+            "SELECT * FROM gateways WHERE project_id = ? AND name = ?",
             (project_row["id"], name),
         )
         if row is None:
             raise ResourceNotExistsError(f"gateway {name} not found")
+        pd = loads(row.get("provisioning_data"))
+        if pd is not None:
+            conf = GatewayConfiguration.model_validate(loads(row["configuration"]))
+            try:
+                from dstack_tpu.backends.base.compute import (
+                    ComputeWithGatewaySupport,
+                )
+                from dstack_tpu.core.models.backends import BackendType
+
+                compute = await backends_service.get_project_backend(
+                    db, project_row, BackendType(conf.backend)
+                )
+                if isinstance(compute, ComputeWithGatewaySupport):
+                    await compute.terminate_gateway(
+                        pd["instance_id"], pd.get("region", conf.region)
+                    )
+            except Exception as e:
+                logger.warning("gateway %s VM termination failed: %s", name, e)
+        await _pool.drop(row["id"])
         await db.execute("DELETE FROM gateways WHERE id = ?", (row["id"],))
+
+
+# ---- agent connection pool (reference gateways/pool.py + client.py) ----
+
+
+class GatewayConnectionPool:
+    """Pooled HTTP sessions to gateway agents, keyed by gateway id."""
+
+    def __init__(self) -> None:
+        self._sessions: dict[str, aiohttp.ClientSession] = {}
+
+    def session(self, gateway_id: str) -> aiohttp.ClientSession:
+        s = self._sessions.get(gateway_id)
+        if s is None or s.closed:
+            s = aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=30))
+            self._sessions[gateway_id] = s
+        return s
+
+    async def drop(self, gateway_id: str) -> None:
+        s = self._sessions.pop(gateway_id, None)
+        if s is not None and not s.closed:
+            await s.close()
+
+    async def close(self) -> None:
+        for s in self._sessions.values():
+            if not s.closed:
+                await s.close()
+        self._sessions.clear()
+
+
+_pool = GatewayConnectionPool()
+
+
+def get_connection_pool() -> GatewayConnectionPool:
+    return _pool
+
+
+def agent_base_url(row: dict) -> Optional[str]:
+    """http URL of the gateway agent from its provisioning data."""
+    pd = loads(row.get("provisioning_data")) or {}
+    host = row.get("ip_address") or pd.get("hostname")
+    if not host:
+        return None
+    port = pd.get("agent_port", 8002)
+    return f"http://{host}:{port}"
+
+
+def agent_headers(row: dict) -> dict:
+    pd = loads(row.get("provisioning_data")) or {}
+    token = pd.get("agent_token")
+    return {"Authorization": f"Bearer {token}"} if token else {}
+
+
+async def call_agent(
+    row: dict, method: str, path: str, json_body: Optional[dict] = None
+) -> Optional[dict]:
+    """One API call to a gateway agent; None on connection failure."""
+    base = agent_base_url(row)
+    if base is None:
+        return None
+    try:
+        async with _pool.session(row["id"]).request(
+            method, f"{base}{path}", json=json_body, headers=agent_headers(row)
+        ) as resp:
+            if resp.status >= 400:
+                logger.warning(
+                    "gateway %s %s -> %d", row["name"], path, resp.status
+                )
+                return None
+            return await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        # aiohttp's total-timeout surfaces as asyncio.TimeoutError, not
+        # ClientError — both must honor the "None on failure" contract
+        logger.debug("gateway %s unreachable: %s", row["name"], e)
+        return None
+
+
+# ---- run <-> gateway resolution & service sync ----
+
+
+async def resolve_run_gateway(
+    db: Database, project_row: dict, run_conf: dict
+) -> Optional[dict]:
+    """Which gateway (row) a service run publishes to. ``gateway: false``
+    or no gateway in the project → None (in-server proxy)."""
+    if run_conf.get("type") != "service":
+        return None
+    want = run_conf.get("gateway")
+    if want is False:
+        return None
+    if isinstance(want, str):
+        row = await db.fetchone(
+            "SELECT * FROM gateways WHERE project_id = ? AND name = ?",
+            (project_row["id"], want),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"gateway {want} not found")
+        return row
+    row = await db.fetchone(
+        "SELECT * FROM gateways WHERE project_id = ? AND is_default = 1",
+        (project_row["id"],),
+    )
+    if row is None and want is True:
+        raise ResourceNotExistsError("no default gateway in project")
+    return row
+
+
+def service_domain(gateway_row: dict, run_name: str) -> Optional[str]:
+    conf = loads(gateway_row["configuration"]) or {}
+    domain = conf.get("domain")
+    return f"{run_name}.{domain}" if domain else None
+
+
+async def register_service(
+    db: Database, gateway_row: dict, project_name: str, run_row: dict
+) -> bool:
+    """Upsert the service on the gateway agent (idempotent)."""
+    spec = loads(run_row["run_spec"]) or {}
+    conf = spec.get("configuration", {})
+    model = conf.get("model") or {}
+    run_name = run_row["run_name"]
+    gw_conf = loads(gateway_row["configuration"]) or {}
+    body = {
+        "project": project_name,
+        "run_name": run_name,
+        "domain": service_domain(gateway_row, run_name),
+        "auth": conf.get("auth", True),
+        "strip_prefix": conf.get("strip_prefix", True),
+        "model_name": model.get("name"),
+        "model_prefix": model.get("prefix", "/v1"),
+        "https": bool(gw_conf.get("certificate")) and conf.get("https", True),
+    }
+    resp = await call_agent(
+        gateway_row, "POST", "/api/registry/services/register", body
+    )
+    return resp is not None
+
+
+async def register_replica(
+    db: Database,
+    gateway_row: dict,
+    project_name: str,
+    run_row: dict,
+    job_row: dict,
+    host: str,
+    port: int,
+) -> bool:
+    ok = await register_service(db, gateway_row, project_name, run_row)
+    if not ok:
+        return False
+    resp = await call_agent(
+        gateway_row,
+        "POST",
+        "/api/registry/replicas/register",
+        {
+            "project": project_name,
+            "run_name": run_row["run_name"],
+            "job_id": job_row["id"],
+            "host": host,
+            "port": port,
+        },
+    )
+    return resp is not None
+
+
+async def unregister_replica(
+    db: Database, gateway_row: dict, project_name: str, run_name: str, job_id: str
+) -> None:
+    await call_agent(
+        gateway_row,
+        "POST",
+        "/api/registry/replicas/unregister",
+        {"project": project_name, "run_name": run_name, "job_id": job_id},
+    )
+
+
+async def unregister_service(
+    db: Database, gateway_row: dict, project_name: str, run_name: str
+) -> None:
+    await call_agent(
+        gateway_row,
+        "POST",
+        "/api/registry/services/unregister",
+        {"project": project_name, "run_name": run_name},
+    )
+
+
+async def gateway_row_for_job(db: Database, job_row: dict) -> Optional[tuple[dict, dict, dict]]:
+    """(gateway_row, project_row, run_row) for a service job using a
+    gateway, else None."""
+    run_row = await db.fetchone(
+        "SELECT * FROM runs WHERE id = ?", (job_row["run_id"],)
+    )
+    if run_row is None:
+        return None
+    spec = loads(run_row["run_spec"]) or {}
+    conf = spec.get("configuration", {})
+    if conf.get("type") != "service":
+        return None
+    project_row = await db.fetchone(
+        "SELECT * FROM projects WHERE id = ?", (run_row["project_id"],)
+    )
+    if project_row is None:
+        return None
+    try:
+        gw = await resolve_run_gateway(db, project_row, conf)
+    except ResourceNotExistsError:
+        return None
+    if gw is None or gw["status"] != GatewayStatus.RUNNING.value:
+        return None
+    return gw, project_row, run_row

@@ -166,8 +166,28 @@ async def submit_run(
         from dstack_tpu.proxy.service_proxy import service_url
 
         model = run_spec.configuration.model
+        url = service_url(project_row["name"], run_spec.run_name)
+        # published on a gateway: the public URL is {run}.{gateway domain}
+        # (reference: run's service_spec URL points at the gateway)
+        from dstack_tpu.server.services import gateways as gateways_service
+
+        gw_row = await gateways_service.resolve_run_gateway(
+            db, project_row, {"type": "service", **run_spec.configuration.model_dump()}
+        )
+        if gw_row is not None:
+            domain = gateways_service.service_domain(gw_row, run_spec.run_name)
+            gw_conf = loads(gw_row["configuration"]) or {}
+            if domain:
+                scheme = "https" if gw_conf.get("certificate") else "http"
+                url = f"{scheme}://{domain}"
+            elif gw_row.get("ip_address"):
+                url = (
+                    f"http://{gw_row['ip_address']}:"
+                    f"{(loads(gw_row.get('provisioning_data')) or {}).get('agent_port', 8002)}"
+                    f"/services/{project_row['name']}/{run_spec.run_name}/"
+                )
         service_spec = ServiceSpec(
-            url=service_url(project_row["name"], run_spec.run_name),
+            url=url,
             model=model.model_dump() if model is not None else None,
         )
     run_row = {
