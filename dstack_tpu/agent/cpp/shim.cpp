@@ -444,6 +444,26 @@ int main(int argc, char** argv) {
   router.add("GET", "/api/host_info", [](const dtpu::http::Request&) {
     return dtpu::http::Response{200, "application/json", host_info().dump()};
   });
+  // TPU exporter relay (DCGM-exporter analog): serve the libtpu/tpu-info
+  // Prometheus mirror file when present, else a minimal inventory gauge.
+  router.add("GET", "/metrics", [](const dtpu::http::Request&) {
+    const char* env = std::getenv("DTPU_TPU_PROM_FILE");
+    std::string path = env ? env : "/run/tpu_prom.txt";
+    std::ifstream f(path);
+    if (f.good()) {
+      std::stringstream ss;
+      ss << f.rdbuf();
+      return dtpu::http::Response{200, "text/plain", ss.str()};
+    }
+    Value tpu = detect_tpu();
+    long chips = 0;
+    if (tpu.is_object()) chips = (long)tpu["chip_count"].as_number(0);
+    std::string text =
+        "# HELP tpu_chips_total TPU chips visible on this host\n"
+        "# TYPE tpu_chips_total gauge\n"
+        "tpu_chips_total " + std::to_string(chips) + "\n";
+    return dtpu::http::Response{200, "text/plain", text};
+  });
   router.add("GET", "/api/tasks", [shim](const dtpu::http::Request&) {
     return dtpu::http::Response{200, "application/json", shim->list().dump()};
   });

@@ -394,7 +394,31 @@ def build_app(shim: Shim) -> web.Application:
     app.router.add_get("/api/tasks/{id}", get_task)
     app.router.add_post("/api/tasks/{id}/terminate", terminate)
     app.router.add_post("/api/tasks/{id}/remove", remove)
+    async def prometheus_metrics(request):
+        """TPU exporter relay (reference shim/dcgm/exporter.go:212 spawns
+        nvidia dcgm-exporter and relays its Prometheus text). On TPU VMs
+        the exporter analog is libtpu's monitoring output mirrored to a
+        file (DTPU_TPU_PROM_FILE, default /run/tpu_prom.txt) by tpu-info
+        or a sidecar; absent that, a minimal inventory gauge is emitted."""
+        path = Path(os.getenv("DTPU_TPU_PROM_FILE", "/run/tpu_prom.txt"))
+        if path.exists():
+            try:
+                return web.Response(
+                    text=path.read_text(), content_type="text/plain"
+                )
+            except OSError:
+                pass
+        tpu = detect_tpu()
+        chips = tpu.chip_count if tpu is not None else 0
+        text = (
+            "# HELP tpu_chips_total TPU chips visible on this host\n"
+            "# TYPE tpu_chips_total gauge\n"
+            f"tpu_chips_total {chips}\n"
+        )
+        return web.Response(text=text, content_type="text/plain")
+
     app.router.add_get("/api/host_info", get_host_info)
+    app.router.add_get("/metrics", prometheus_metrics)
     return app
 
 

@@ -110,7 +110,9 @@ class GatewayState:
                 return svc
         return None
 
-    def by_model(self, project: str, model_name: str) -> Optional[Service]:
+    def by_model(self, project: str, model_name: Optional[str]) -> Optional[Service]:
+        if model_name is None:
+            return None  # plain services have model_name=None; never match
         for svc in self.services.values():
             if svc.project == project and svc.model_name == model_name:
                 return svc

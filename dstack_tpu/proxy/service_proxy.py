@@ -232,6 +232,8 @@ async def _list_model_services(db: Database, project_name: str) -> list[dict]:
 async def _find_model_service(
     db: Database, project_name: str, model_name: Optional[str]
 ) -> Optional[dict]:
+    if model_name is None:
+        return None
     for r in await _list_model_services(db, project_name):
         conf = loads(r["run_spec"])["configuration"]
         if (conf.get("model") or {}).get("name") == model_name:

@@ -23,6 +23,9 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
         process_terminating_jobs,
     )
     from dstack_tpu.server.background.tasks.process_gateways import process_gateways
+    from dstack_tpu.server.background.tasks.process_prometheus_metrics import (
+        collect_prometheus_metrics,
+    )
     from dstack_tpu.server.background.tasks.process_volumes import process_volumes
 
     sched = BackgroundScheduler()
@@ -35,4 +38,12 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
     sched.add(lambda: process_volumes(db), 10.0, "process_volumes")
     sched.add(lambda: process_gateways(db), 5.0, "process_gateways")
     sched.add(lambda: collect_metrics(db), 10.0, "collect_metrics")
+    from dstack_tpu.server import settings
+
+    if settings.ENABLE_PROMETHEUS_METRICS:
+        sched.add(
+            lambda: collect_prometheus_metrics(db),
+            10.0,
+            "collect_prometheus_metrics",
+        )
     return sched

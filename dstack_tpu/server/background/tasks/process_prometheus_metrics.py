@@ -1,0 +1,54 @@
+"""Relay TPU exporter Prometheus samples from shims into the DB.
+
+Parity: reference background/tasks/process_prometheus_metrics.py:135
+(10s loop pulling the shim's DCGM exporter ``/metrics`` into
+``JobPrometheusMetrics`` rows, served relabeled at the server's
+``/metrics``).
+"""
+
+from dstack_tpu.core.errors import AgentError, AgentNotReady
+from dstack_tpu.core.models.runs import JobProvisioningData, JobStatus, now_utc
+from dstack_tpu.server.db import Database, loads
+from dstack_tpu.server.services.agent_client import shim_client_for
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_prometheus_metrics")
+
+
+async def collect_prometheus_metrics(db: Database) -> None:
+    rows = await db.fetchall(
+        "SELECT * FROM jobs WHERE status = ? LIMIT 50", (JobStatus.RUNNING.value,)
+    )
+    for job_row in rows:
+        try:
+            await _collect_job(db, job_row)
+        except (AgentError, AgentNotReady):
+            continue
+        except Exception:
+            logger.exception(
+                "prometheus relay failed for %s", job_row["job_name"]
+            )
+
+
+async def _collect_job(db: Database, job_row: dict) -> None:
+    jpd_raw = loads(job_row.get("job_provisioning_data"))
+    if jpd_raw is None:
+        return
+    jpd = JobProvisioningData.model_validate(jpd_raw)
+    async with shim_client_for(jpd) as shim:
+        text = await shim.get_prometheus_metrics()
+    existing = await db.fetchone(
+        "SELECT job_id FROM job_prometheus_metrics WHERE job_id = ?",
+        (job_row["id"],),
+    )
+    values = {"collected_at": now_utc().isoformat(), "text": text}
+    if existing is not None:
+        await db.execute(
+            "UPDATE job_prometheus_metrics SET collected_at = ?, text = ? "
+            "WHERE job_id = ?",
+            (values["collected_at"], values["text"], job_row["id"]),
+        )
+    else:
+        await db.insert(
+            "job_prometheus_metrics", {"job_id": job_row["id"], **values}
+        )

@@ -57,6 +57,11 @@ async def _process(db: Database, job_id: str) -> None:
             await _release_instance(db, job_row)
 
     await _unregister_from_gateway(db, job_row)
+    # metrics relay rows are only rendered for RUNNING jobs; drop them
+    # so the table doesn't grow with one text blob per job ever run
+    await db.execute(
+        "DELETE FROM job_prometheus_metrics WHERE job_id = ?", (job_row["id"],)
+    )
     reason = (
         JobTerminationReason(job_row["termination_reason"])
         if job_row.get("termination_reason")

@@ -27,6 +27,7 @@ server_router = Router("/api/server")
 users_router = Router("/api/users")
 projects_router = Router("/api/projects")
 project_router = Router("/api/project/{project_name}")
+root_router = Router("")
 
 
 async def auth_dependency(ctx: RequestContext) -> None:
@@ -431,4 +432,29 @@ async def get_job_metrics(ctx: RequestContext, body: s.GetJobMetricsRequest):
     return JobMetrics(metrics=metrics)
 
 
-ALL_ROUTERS = [server_router, users_router, projects_router, project_router]
+# ---- prometheus scrape endpoint ----
+
+
+@root_router.get("/metrics")
+@no_auth
+async def prometheus_metrics(ctx: RequestContext):
+    """Cluster-wide Prometheus text (reference services/prometheus.py,
+    unauthenticated scrape endpoint gated by settings)."""
+    from aiohttp import web
+
+    from dstack_tpu.server import settings
+    from dstack_tpu.server.services.prometheus import render_metrics
+
+    if not settings.ENABLE_PROMETHEUS_METRICS:
+        raise ResourceNotExistsError("prometheus metrics disabled")
+    text = await render_metrics(ctx.state["db"])
+    return web.Response(text=text, content_type="text/plain")
+
+
+ALL_ROUTERS = [
+    server_router,
+    users_router,
+    projects_router,
+    project_router,
+    root_router,
+]

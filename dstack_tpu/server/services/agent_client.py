@@ -30,7 +30,7 @@ class _HTTPBase:
 
     async def _request(
         self, method: str, path: str, json_body=None, data=None, params=None,
-        timeout: float = 20.0,
+        timeout: float = 20.0, raw: bool = False,
     ):
         try:
             async with aiohttp.ClientSession(
@@ -48,7 +48,7 @@ class _HTTPBase:
                         raise AgentError(
                             f"{method} {path}: {resp.status} {text[:300]}"
                         )
-                    return await resp.json()
+                    return await (resp.text() if raw else resp.json())
         except aiohttp.ClientConnectionError as e:
             raise AgentNotReady(f"{self.base}{path}: {e}") from e
         except asyncio.TimeoutError as e:
@@ -91,6 +91,11 @@ class ShimClient(_HTTPBase):
         return schemas.HostInfo.model_validate(
             await self._request("GET", "/api/host_info")
         )
+
+    async def get_prometheus_metrics(self) -> str:
+        """Raw Prometheus text from the shim's TPU exporter relay
+        (DCGM-exporter analog, reference shim/dcgm/)."""
+        return await self._request("GET", "/metrics", timeout=10, raw=True)
 
 
 class RunnerClient(_HTTPBase):

@@ -1,0 +1,224 @@
+"""Cluster-wide Prometheus ``/metrics`` rendering.
+
+Parity: reference server/services/prometheus.py (get_metrics:31,
+_render_metrics:295 — per-instance price/accelerator gauges, per-run and
+per-job samples incl. relayed DCGM exporter text). TPU translation: the
+DCGM relay becomes a libtpu/tpu-info exporter relay (raw text stored in
+``job_prometheus_metrics`` by the collection loop), and accelerator
+gauges speak chips / duty cycle / HBM instead of GPUs.
+"""
+
+from datetime import datetime
+from typing import Iterable
+
+from dstack_tpu.core.models.runs import JobStatus, RunStatus
+from dstack_tpu.server.db import Database, loads
+
+
+RELAY_STALENESS_SECONDS = 60.0  # a few 10s scrape intervals
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
+
+
+def _labels(d: dict) -> str:
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in d.items() if v is not None)
+    return "{" + inner + "}"
+
+
+class _Writer:
+    def __init__(self) -> None:
+        self.lines: list[str] = []
+        self._typed: set[str] = set()
+
+    def sample(
+        self, name: str, mtype: str, help_: str, labels: dict, value
+    ) -> None:
+        if name not in self._typed:
+            self.lines.append(f"# HELP {name} {help_}")
+            self.lines.append(f"# TYPE {name} {mtype}")
+            self._typed.add(name)
+        self.lines.append(f"{name}{_labels(labels)} {value}")
+
+    def raw(self, text: str) -> None:
+        self.lines.append(text.rstrip("\n"))
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+async def render_metrics(db: Database) -> str:
+    w = _Writer()
+    projects = {
+        p["id"]: p["name"] for p in await db.fetchall("SELECT * FROM projects")
+    }
+
+    await _render_instances(db, w, projects)
+    await _render_runs(db, w, projects)
+    await _render_jobs(db, w, projects)
+    return w.render()
+
+
+async def _render_instances(db: Database, w: _Writer, projects: dict) -> None:
+    rows = await db.fetchall("SELECT * FROM instances WHERE deleted = 0")
+    now = datetime.now().astimezone()
+    for r in rows:
+        offer = loads(r.get("offer")) or {}
+        resources = (offer.get("instance") or {}).get("resources") or {}
+        tpu = resources.get("tpu") or {}
+        labels = {
+            "dtpu_project_name": projects.get(r["project_id"], ""),
+            "dtpu_instance_name": r["name"],
+            "dtpu_backend": r.get("backend") or offer.get("backend"),
+            "dtpu_region": r.get("region") or offer.get("region"),
+            "dtpu_instance_status": r["status"],
+            "dtpu_tpu_type": tpu.get("slice_name") or tpu.get("version"),
+        }
+        w.sample(
+            "dtpu_instance_price_dollars_per_hour",
+            "gauge",
+            "Instance offer price",
+            labels,
+            r.get("price") or offer.get("price") or 0.0,
+        )
+        w.sample(
+            "dtpu_instance_tpu_chips",
+            "gauge",
+            "TPU chips on the instance (0 for CPU-only)",
+            labels,
+            tpu.get("chips") or 0,
+        )
+        created = r.get("created_at")
+        if created:
+            age = (
+                now - datetime.fromisoformat(created).astimezone()
+            ).total_seconds()
+            w.sample(
+                "dtpu_instance_duration_seconds_total",
+                "counter",
+                "Seconds since instance creation",
+                labels,
+                max(0.0, age),
+            )
+
+
+async def _render_runs(db: Database, w: _Writer, projects: dict) -> None:
+    rows = await db.fetchall(
+        "SELECT project_id, status, COUNT(*) AS n FROM runs WHERE deleted = 0 "
+        "GROUP BY project_id, status"
+    )
+    # active states always emitted (zeros included) so series drop to 0
+    # instead of disappearing; finished states only when non-zero
+    counts = {(r["project_id"], r["status"]): r["n"] for r in rows}
+    for pid, pname in projects.items():
+        for status in RunStatus:
+            n = counts.get((pid, status.value), 0)
+            if n == 0 and status.is_finished():
+                continue
+            w.sample(
+                "dtpu_runs",
+                "gauge",
+                "Runs by status",
+                {"dtpu_project_name": pname, "dtpu_run_status": status.value},
+                n,
+            )
+
+
+async def _render_jobs(db: Database, w: _Writer, projects: dict) -> None:
+    job_rows = await db.fetchall(
+        "SELECT * FROM jobs WHERE status = ?", (JobStatus.RUNNING.value,)
+    )
+    for job_row in job_rows:
+        run_row = await db.get_by_id("runs", job_row["run_id"])
+        if run_row is None:
+            continue
+        labels = {
+            "dtpu_project_name": projects.get(run_row["project_id"], ""),
+            "dtpu_run_name": run_row["run_name"],
+            "dtpu_job_name": job_row["job_name"],
+            "dtpu_replica_num": job_row.get("replica_num", 0),
+        }
+        point = await db.fetchone(
+            "SELECT * FROM job_metrics_points WHERE job_id = ? "
+            "ORDER BY timestamp DESC LIMIT 1",
+            (job_row["id"],),
+        )
+        if point is not None:
+            w.sample(
+                "dtpu_job_cpu_seconds_total",
+                "counter",
+                "Cumulative job CPU time",
+                labels,
+                (point["cpu_usage_micro"] or 0) / 1e6,
+            )
+            w.sample(
+                "dtpu_job_memory_usage_bytes",
+                "gauge",
+                "Job memory usage",
+                labels,
+                point["memory_usage_bytes"] or 0,
+            )
+            tm = loads(point.get("tpu_metrics")) or {}
+            for i, duty in enumerate(tm.get("duty_cycle") or []):
+                w.sample(
+                    "dtpu_job_tpu_duty_cycle_percent",
+                    "gauge",
+                    "TPU TensorCore duty cycle",
+                    {**labels, "dtpu_tpu_chip": i},
+                    duty,
+                )
+            hbm_total = tm.get("hbm_total") or []
+            for i, hbm in enumerate(tm.get("hbm_usage") or []):
+                w.sample(
+                    "dtpu_job_tpu_hbm_usage_bytes",
+                    "gauge",
+                    "TPU HBM bytes in use",
+                    {**labels, "dtpu_tpu_chip": i},
+                    hbm,
+                )
+                if i < len(hbm_total):
+                    w.sample(
+                        "dtpu_job_tpu_hbm_total_bytes",
+                        "gauge",
+                        "TPU HBM capacity",
+                        {**labels, "dtpu_tpu_chip": i},
+                        hbm_total[i],
+                    )
+        relay = await db.fetchone(
+            "SELECT * FROM job_prometheus_metrics WHERE job_id = ?",
+            (job_row["id"],),
+        )
+        if relay is not None and relay["text"]:
+            # don't serve frozen samples as live when the shim went quiet
+            age = (
+                datetime.now().astimezone()
+                - datetime.fromisoformat(relay["collected_at"]).astimezone()
+            ).total_seconds()
+            if age < RELAY_STALENESS_SECONDS:
+                w.raw(_relabel(relay["text"], labels))
+
+
+def _relabel(text: str, labels: dict) -> str:
+    """Inject dtpu job labels into relayed exporter samples (reference
+    prometheus.py relabels DCGM lines with dstack run/job labels)."""
+    extra = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+    out = []
+    for line in text.splitlines():
+        s = line.strip()
+        if not s or s.startswith("#"):
+            out.append(line)
+            continue
+        # metric{a="b"} v  |  metric v
+        if "{" in s and "}" in s:
+            name, rest = s.split("{", 1)
+            inner, tail = rest.rsplit("}", 1)
+            joined = f"{inner},{extra}" if inner else extra
+            out.append(f"{name}{{{joined}}}{tail}")
+        else:
+            parts = s.split(None, 1)
+            if len(parts) == 2:
+                out.append(f"{parts[0]}{{{extra}}} {parts[1]}")
+            else:
+                out.append(line)
+    return "\n".join(out)

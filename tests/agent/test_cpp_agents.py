@@ -138,6 +138,43 @@ class TestCppRunner:
 
 
 class TestCppShim:
+    async def test_prometheus_relay_endpoint(self, agent_binaries, tmp_path):
+        """/metrics serves the exporter mirror file when present, else an
+        inventory gauge — same contract as the Python shim."""
+        import os
+
+        runner_bin, shim_bin = agent_binaries
+        port = _free_port()
+        prom = tmp_path / "tpu_prom.txt"
+        env = {**os.environ, "DTPU_TPU_PROM_FILE": str(prom)}
+        proc = subprocess.Popen(
+            [
+                str(shim_bin),
+                "--port", str(port),
+                "--base-dir", str(tmp_path),
+                "--runtime", "process",
+                "--runner-bin", str(runner_bin),
+            ],
+            stderr=subprocess.DEVNULL,
+            env=env,
+        )
+        try:
+            await _wait_port(port)
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"http://127.0.0.1:{port}/metrics"
+                ) as resp:
+                    assert resp.status == 200
+                    assert "tpu_chips_total" in await resp.text()
+                prom.write_text("tpu_sample 42\n")
+                async with session.get(
+                    f"http://127.0.0.1:{port}/metrics"
+                ) as resp:
+                    assert (await resp.text()) == "tpu_sample 42\n"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
     async def test_task_lifecycle_with_cpp_runner(self, agent_binaries, tmp_path):
         """Shim (C++) spawns runner (C++) in process mode; the full FSM
         and API match the contract."""

@@ -1,0 +1,235 @@
+"""Prometheus plane: /metrics rendering, exporter relay relabeling, shim
+relay endpoint, collection loop.
+
+Parity: reference services/prometheus.py + process_prometheus_metrics
+tests (seed DB state, call the loop once, assert rows / rendered text).
+"""
+
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.db import dumps
+from dstack_tpu.server.services.prometheus import _relabel, render_metrics
+
+
+def _auth(token: str) -> dict:
+    return {"Authorization": f"Bearer {token}"}
+
+
+async def _seed_running_job(db) -> tuple[str, str]:
+    """Minimal project/run/job rows with one metrics point + relay text."""
+    from dstack_tpu.core.models.runs import new_uuid, now_utc
+
+    project = await db.fetchone("SELECT * FROM projects WHERE name = 'main'")
+    run_id = new_uuid()
+    await db.insert(
+        "runs",
+        {
+            "id": run_id,
+            "project_id": project["id"],
+            "user_id": (await db.fetchone("SELECT * FROM users"))["id"],
+            "run_name": "metrics-run",
+            "status": "running",
+            "run_spec": dumps(
+                {"configuration": {"type": "task", "commands": ["x"]}}
+            ),
+            "desired_replica_count": 1,
+            "deleted": 0,
+            "submitted_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    job_id = new_uuid()
+    await db.insert(
+        "jobs",
+        {
+            "id": job_id,
+            "run_id": run_id,
+            "run_name": "metrics-run",
+            "project_id": project["id"],
+            "job_name": "metrics-run-0-0",
+            "job_num": 0,
+            "replica_num": 0,
+            "submission_num": 0,
+            "status": "running",
+            "job_spec": dumps({"job_name": "metrics-run-0-0"}),
+            "submitted_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    await db.insert(
+        "job_metrics_points",
+        {
+            "id": new_uuid(),
+            "job_id": job_id,
+            "timestamp": now_utc().isoformat(),
+            "cpu_usage_micro": 2_500_000,
+            "memory_usage_bytes": 1024,
+            "memory_working_set_bytes": 512,
+            "tpu_metrics": dumps(
+                {
+                    "duty_cycle": [91.5, 88.0],
+                    "hbm_usage": [7e9, 6e9],
+                    "hbm_total": [16e9, 16e9],
+                }
+            ),
+        },
+    )
+    await db.insert(
+        "job_prometheus_metrics",
+        {
+            "job_id": job_id,
+            "collected_at": now_utc().isoformat(),
+            "text": (
+                "# TYPE tpu_tensorcore_utilization gauge\n"
+                'tpu_tensorcore_utilization{chip="0"} 0.93\n'
+                "tpu_chips_total 8\n"
+            ),
+        },
+    )
+    return run_id, job_id
+
+
+class TestPrometheusRendering:
+    async def test_metrics_endpoint(self):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tok",
+            with_background=False,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        db = app["state"]["db"]
+        try:
+            await _seed_running_job(db)
+            r = await client.get("/metrics")
+            assert r.status == 200
+            text = await r.text()
+            # job gauges with dtpu labels
+            assert 'dtpu_job_cpu_seconds_total{' in text
+            assert 'dtpu_run_name="metrics-run"' in text
+            assert 'dtpu_job_tpu_duty_cycle_percent{' in text
+            assert 'dtpu_tpu_chip="1"' in text
+            assert "dtpu_job_tpu_hbm_total_bytes{" in text
+            # run status gauge
+            assert 'dtpu_runs{' in text
+            # relayed exporter samples got the job labels injected
+            assert 'tpu_tensorcore_utilization{chip="0",dtpu_project_name="main"' in text
+            assert 'tpu_chips_total{dtpu_project_name="main"' in text
+        finally:
+            await client.close()
+
+    def test_relabel_injects_labels(self):
+        out = _relabel(
+            'm1{a="b"} 1\nm2 2\n# c\n', {"dtpu_run_name": "r1"}
+        )
+        lines = out.splitlines()
+        assert lines[0] == 'm1{a="b",dtpu_run_name="r1"} 1'
+        assert lines[1] == 'm2{dtpu_run_name="r1"} 2'
+        assert lines[2] == "# c"
+
+
+class TestShimPrometheusRelay:
+    async def test_shim_metrics_endpoint(self, tmp_path, monkeypatch):
+        from dstack_tpu.agent.python.shim import Shim, build_app
+
+        prom = tmp_path / "tpu_prom.txt"
+        monkeypatch.setenv("DTPU_TPU_PROM_FILE", str(prom))
+        shim = Shim(base_dir=tmp_path, runtime="process")
+        client = TestClient(TestServer(build_app(shim)))
+        await client.start_server()
+        try:
+            # no exporter file -> inventory fallback
+            r = await client.get("/metrics")
+            assert r.status == 200
+            assert "tpu_chips_total" in await r.text()
+
+            # exporter file relayed verbatim
+            prom.write_text("tpu_hbm_bytes 123\n")
+            r = await client.get("/metrics")
+            assert (await r.text()) == "tpu_hbm_bytes 123\n"
+        finally:
+            await client.close()
+
+
+class TestPrometheusCollection:
+    async def test_collect_loop_upserts(self, tmp_path, monkeypatch):
+        """Seed a RUNNING job pointing at a live local shim; the loop
+        stores then refreshes the relay row."""
+        from dstack_tpu.agent.python.shim import Shim
+        from dstack_tpu.agent.python.shim import build_app as build_shim_app
+        from dstack_tpu.server.background.tasks.process_prometheus_metrics import (
+            collect_prometheus_metrics,
+        )
+
+        prom = tmp_path / "tpu_prom.txt"
+        prom.write_text("tpu_sample 1\n")
+        monkeypatch.setenv("DTPU_TPU_PROM_FILE", str(prom))
+        shim = Shim(base_dir=tmp_path, runtime="process")
+        shim_client = TestClient(TestServer(build_shim_app(shim)))
+        await shim_client.start_server()
+        shim_port = shim_client.server.port
+
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tok",
+            with_background=False,
+            local_backend=True,
+        )
+        db = app["state"]["db"]
+        server_client = TestClient(TestServer(app))
+        await server_client.start_server()
+        try:
+            _, job_id = await _seed_running_job(db)
+            await db.execute(
+                "UPDATE jobs SET job_provisioning_data = ? WHERE id = ?",
+                (
+                    dumps(
+                        {
+                            "backend": "local",
+                            "instance_type": {
+                                "name": "local",
+                                "resources": {
+                                    "cpus": 1,
+                                    "memory_mib": 1024,
+                                    "spot": False,
+                                },
+                            },
+                            "instance_id": f"local-{shim_port}",
+                            "hostname": "127.0.0.1",
+                            "region": "local",
+                            "price": 0.0,
+                            "username": "local",
+                            "ssh_port": 0,
+                            "dockerized": True,
+                            "hosts": [
+                                {
+                                    "worker_id": 0,
+                                    "internal_ip": "127.0.0.1",
+                                    "external_ip": "127.0.0.1",
+                                    "shim_port": shim_port,
+                                }
+                            ],
+                        }
+                    ),
+                    job_id,
+                ),
+            )
+            await collect_prometheus_metrics(db)
+            row = await db.fetchone(
+                "SELECT * FROM job_prometheus_metrics WHERE job_id = ?", (job_id,)
+            )
+            assert row["text"] == "tpu_sample 1\n"
+
+            prom.write_text("tpu_sample 2\n")
+            await collect_prometheus_metrics(db)
+            row = await db.fetchone(
+                "SELECT * FROM job_prometheus_metrics WHERE job_id = ?", (job_id,)
+            )
+            assert row["text"] == "tpu_sample 2\n"
+        finally:
+            await server_client.close()
+            await shim_client.close()
