@@ -134,22 +134,63 @@ class Executor:
             raise ValueError("no job submitted")
         self._task = asyncio.create_task(self._run_job())
 
+    async def _git(self, args: list[str], cwd: Optional[Path] = None) -> str:
+        proc = await asyncio.create_subprocess_exec(
+            "git",
+            *args,
+            cwd=cwd,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+        )
+        out, _ = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {args[0]} failed: {out.decode(errors='replace')[-500:]}"
+            )
+        return out.decode(errors="replace")
+
     async def _setup_repo(self, workdir: Path) -> None:
+        """Materialize the job's code (reference repo/manager.go:162:
+        clone+fetch+checkout+apply-diff for remote repos, unpack archive
+        for local ones)."""
         assert self.job is not None
         repo = self.job.repo_data or {}
         rtype = repo.get("repo_type", "virtual")
         if rtype == "remote" and repo.get("repo_url"):
-            cmd = ["git", "clone", "--depth", "1"]
+            cmd = ["clone"]
+            if not repo.get("repo_hash"):
+                cmd += ["--depth", "1"]
             if repo.get("repo_branch"):
                 cmd += ["-b", repo["repo_branch"]]
-            cmd += [repo["repo_url"], str(workdir)]
+            url = repo["repo_url"]
+            creds = repo.get("repo_creds") or {}
+            if creds.get("oauth_token") and url.startswith("https://"):
+                url = url.replace(
+                    "https://", f"https://oauth2:{creds['oauth_token']}@", 1
+                )
+            cmd += [url, str(workdir)]
             self._rlog(f"cloning {repo['repo_url']}")
-            proc = await asyncio.create_subprocess_exec(
-                *cmd, stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT
+            await self._git(cmd)
+            if repo.get("repo_hash"):
+                try:
+                    await self._git(
+                        ["checkout", "-q", repo["repo_hash"]], cwd=workdir
+                    )
+                except RuntimeError:
+                    # local commit not pushed to origin: run from branch tip
+                    self._rlog(
+                        f"commit {repo['repo_hash'][:12]} not on origin; "
+                        "running from branch tip"
+                    )
+            # uncommitted changes shipped as one patch blob
+            patch = (
+                self.code_path / "code.bin" if self.code_path is not None else None
             )
-            out, _ = await proc.communicate()
-            if proc.returncode != 0:
-                raise RuntimeError(f"git clone failed: {out.decode()[-500:]}")
+            if patch is not None and patch.exists():
+                self._rlog("applying uploaded diff")
+                await self._git(
+                    ["apply", "--whitespace=nowarn", str(patch)], cwd=workdir
+                )
         elif self.code_path is not None:
             # local repo uploaded as archive
             import shutil

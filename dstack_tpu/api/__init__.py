@@ -52,18 +52,61 @@ class RunCollection:
     def __init__(self, client: "Client"):
         self._c = client
 
-    def get_plan(self, conf: Union[dict, AnyRunConfiguration], run_name: Optional[str] = None) -> RunPlan:
-        return self._c.api.get_run_plan(self._c.project, self._spec(conf, run_name))
+    def get_plan(
+        self,
+        conf: Union[dict, AnyRunConfiguration],
+        run_name: Optional[str] = None,
+        repo_dir: Optional[str] = None,
+    ) -> RunPlan:
+        return self._c.api.get_run_plan(
+            self._c.project, self._spec(conf, run_name, repo_dir, upload=False)
+        )
 
     def apply_configuration(
-        self, conf: Union[dict, AnyRunConfiguration], run_name: Optional[str] = None
+        self,
+        conf: Union[dict, AnyRunConfiguration],
+        run_name: Optional[str] = None,
+        repo_dir: Optional[str] = None,
     ) -> Run:
-        return self._c.api.apply_run(self._c.project, self._spec(conf, run_name))
+        """Submit a run. With ``repo_dir`` the working directory is
+        packaged and uploaded first (archive for plain dirs, git diff for
+        remote checkouts — reference api/_public/runs.py submit +
+        repos upload)."""
+        return self._c.api.apply_run(
+            self._c.project, self._spec(conf, run_name, repo_dir, upload=True)
+        )
 
-    def _spec(self, conf, run_name: Optional[str]) -> RunSpec:
+    def _spec(
+        self,
+        conf,
+        run_name: Optional[str],
+        repo_dir: Optional[str] = None,
+        upload: bool = False,
+    ) -> RunSpec:
         if isinstance(conf, dict):
             conf = parse_run_configuration(conf)
-        return RunSpec(run_name=run_name, configuration=conf, ssh_key_pub="")
+        spec = RunSpec(run_name=run_name, configuration=conf, ssh_key_pub="")
+        if repo_dir is not None:
+            if not upload:
+                # plan-only: cheap metadata detection, no archive build
+                from dstack_tpu.core.services.repos import detect_repo
+
+                repo_id, info = detect_repo(repo_dir)
+                spec.repo_id = repo_id
+                spec.repo_data = info.model_dump()
+                return spec
+            from dstack_tpu.core.services.repos import package_repo
+
+            repo_id, repo_data, blob_hash, blob = package_repo(repo_dir)
+            spec.repo_id = repo_id
+            spec.repo_data = repo_data
+            spec.repo_code_hash = blob_hash
+            self._c.api.init_repo(self._c.project, repo_id, repo_data)
+            if blob is not None and not self._c.api.is_code_uploaded(
+                self._c.project, repo_id, blob_hash
+            ):
+                self._c.api.upload_code(self._c.project, repo_id, blob_hash, blob)
+        return spec
 
     def list(self) -> list[Run]:
         return self._c.api.list_runs(self._c.project)

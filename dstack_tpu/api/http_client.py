@@ -211,6 +211,45 @@ class APIClient:
         return self._post(f"/api/project/{project}/backends/list")
 
     # secrets
+    def init_repo(
+        self,
+        project: str,
+        repo_id: str,
+        repo_info: dict,
+        creds: Optional[dict] = None,
+    ) -> None:
+        self._post(
+            f"/api/project/{project}/repos/init",
+            {"repo_id": repo_id, "repo_info": repo_info, "creds": creds},
+        )
+
+    def list_repos(self, project: str) -> list[dict]:
+        return self._post(f"/api/project/{project}/repos/list")
+
+    def delete_repos(self, project: str, repos_ids: list[str]) -> None:
+        self._post(
+            f"/api/project/{project}/repos/delete", {"repos_ids": repos_ids}
+        )
+
+    def is_code_uploaded(self, project: str, repo_id: str, blob_hash: str) -> bool:
+        r = self._post(
+            f"/api/project/{project}/repos/is_code_uploaded",
+            {"repo_id": repo_id, "blob_hash": blob_hash},
+        )
+        return bool(r.get("uploaded"))
+
+    def upload_code(
+        self, project: str, repo_id: str, blob_hash: str, blob: bytes
+    ) -> None:
+        resp = self._session.post(
+            self.base_url + f"/api/project/{project}/repos/upload_code",
+            params={"repo_id": repo_id, "blob_hash": blob_hash},
+            data=blob,
+            headers={"Content-Type": "application/octet-stream"},
+            timeout=300,
+        )
+        self._raise_for_error(resp)
+
     def create_secret(self, project: str, name: str, value: str) -> None:
         self._post(
             f"/api/project/{project}/secrets/create", {"name": name, "value": value}

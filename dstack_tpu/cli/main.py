@@ -36,6 +36,25 @@ def cli() -> None:
 
 
 @cli.command()
+@click.option("--project", default=None)
+def init(project) -> None:
+    """Register the current directory as this project's repo
+    (reference `dstack init`)."""
+    from dstack_tpu.core.services.repos import detect_repo
+
+    repo_id, info = detect_repo(".")
+    client = _client(project)
+    try:
+        client.api.init_repo(client.project, repo_id, info.model_dump())
+    except DstackTPUError as e:
+        _die(str(e))
+    console.print(
+        f"[green]OK[/green] repo [bold]{repo_id}[/bold] "
+        f"({info.repo_type.value}) registered in project {client.project}"
+    )
+
+
+@cli.command()
 @click.option("--host", default=None)
 @click.option("--port", type=int, default=None)
 @click.option("--token", default=None, help="admin token (generated if omitted)")
@@ -75,7 +94,10 @@ def config(url, token, project) -> None:
 @click.option("-d", "--detach", is_flag=True, help="do not stream logs")
 @click.option("-n", "--name", default=None, help="run name override")
 @click.option("--project", default=None)
-def apply(config_path, yes, detach, name, project) -> None:
+@click.option(
+    "--no-repo", is_flag=True, help="do not upload the working directory"
+)
+def apply(config_path, yes, detach, name, project, no_repo) -> None:
     """Apply a configuration (task/service/dev-environment/fleet/volume)."""
     from dstack_tpu.core.models.configurations import (
         FleetConfiguration,
@@ -103,11 +125,14 @@ def apply(config_path, yes, detach, name, project) -> None:
             gw = client.api.create_gateway(client.project, conf)
             console.print(f"[green]Gateway {gw.name} submitted[/green]")
             return
+        repo_dir = None if no_repo else str(Path(config_path).resolve().parent)
         plan = client.runs.get_plan(conf, run_name=name)
         _print_plan(plan)
         if not yes and not click.confirm("Submit the run?", default=True):
             return
-        run = client.runs.apply_configuration(conf, run_name=plan.run_spec.run_name)
+        run = client.runs.apply_configuration(
+            conf, run_name=plan.run_spec.run_name, repo_dir=repo_dir
+        )
         console.print(
             f"[green]Submitted[/green] run [bold]{run.run_spec.run_name}[/bold]"
         )

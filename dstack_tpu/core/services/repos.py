@@ -1,0 +1,203 @@
+"""Client-side repo detection and code packaging.
+
+Parity: the reference packages the working directory before submit —
+a remote git repo ships (url, branch, hash) plus a local diff, a plain
+directory ships a tar archive (reference runner/internal/repo/manager.go:162,
+src/dstack/_internal/core/services/repos.py). Archives are built
+deterministically (sorted entries, zeroed mtimes/owners) so the content
+hash is stable across machines.
+"""
+
+import hashlib
+import io
+import os
+import subprocess
+import tarfile
+from pathlib import Path
+from typing import Optional, Union
+
+from dstack_tpu.core.errors import ClientError
+from dstack_tpu.core.models.repos import (
+    LocalRepoInfo,
+    RemoteRepoInfo,
+    RepoType,
+    VirtualRepoInfo,
+    repo_id_for,
+)
+
+# Directories never worth shipping to a job container.
+DEFAULT_EXCLUDES = {
+    ".git",
+    "__pycache__",
+    ".venv",
+    "venv",
+    "node_modules",
+    ".mypy_cache",
+    ".pytest_cache",
+    ".ruff_cache",
+    ".idea",
+    ".vscode",
+}
+# Soft cap matching the reference's guidance for local repos; beyond it
+# the caller should use a remote repo or volumes instead.
+MAX_ARCHIVE_SIZE = 64 * 1024 * 1024
+
+
+def _git(args: list[str], cwd: Path) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", *args],
+            cwd=cwd,
+            capture_output=True,
+            text=True,
+            timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return out.stdout
+
+
+def detect_repo(
+    repo_dir: Union[str, Path],
+) -> tuple[str, Union[RemoteRepoInfo, LocalRepoInfo, VirtualRepoInfo]]:
+    """Identify the code source for ``repo_dir``.
+
+    A git checkout with an origin remote becomes a remote repo
+    (clone-on-host + diff upload); anything else becomes a local repo
+    (archive upload).
+    """
+    repo_dir = Path(repo_dir).resolve()
+    url = _git(["remote", "get-url", "origin"], repo_dir)
+    if url:
+        url = url.strip()
+        branch = (_git(["rev-parse", "--abbrev-ref", "HEAD"], repo_dir) or "").strip()
+        commit = (_git(["rev-parse", "HEAD"], repo_dir) or "").strip()
+        info = RemoteRepoInfo(
+            repo_url=url,
+            repo_branch=branch if branch and branch != "HEAD" else None,
+            repo_hash=commit or None,
+        )
+        return repo_id_for(url), info
+    return repo_id_for(str(repo_dir)), LocalRepoInfo(repo_dir=str(repo_dir))
+
+
+def _tracked_files(repo_dir: Path) -> Optional[list[str]]:
+    out = _git(["ls-files", "--cached", "--others", "--exclude-standard"], repo_dir)
+    if out is None:
+        return None
+    return [line for line in out.splitlines() if line]
+
+
+def _walk_files(repo_dir: Path) -> list[str]:
+    files: list[str] = []
+    for root, dirs, names in os.walk(repo_dir):
+        dirs[:] = sorted(d for d in dirs if d not in DEFAULT_EXCLUDES)
+        for name in sorted(names):
+            p = Path(root) / name
+            if p.is_symlink() or not p.is_file():
+                continue
+            files.append(str(p.relative_to(repo_dir)))
+    return files
+
+
+def package_archive(repo_dir: Union[str, Path]) -> tuple[str, bytes]:
+    """Deterministic tar.gz of the working directory → (sha256, bytes)."""
+    repo_dir = Path(repo_dir).resolve()
+    rel_files = _tracked_files(repo_dir)
+    if rel_files is None:
+        rel_files = _walk_files(repo_dir)
+    buf = io.BytesIO()
+    total = 0
+    with tarfile.open(fileobj=buf, mode="w:gz", format=tarfile.PAX_FORMAT) as tf:
+        for rel in sorted(set(rel_files)):
+            p = repo_dir / rel
+            if not p.is_file() or p.is_symlink():
+                continue
+            data = p.read_bytes()
+            total += len(data)
+            if total > MAX_ARCHIVE_SIZE:
+                raise ClientError(
+                    f"local repo exceeds {MAX_ARCHIVE_SIZE // (1024 * 1024)}MB; "
+                    "use a git remote or a volume for large data"
+                )
+            ti = tarfile.TarInfo(name=rel)
+            ti.size = len(data)
+            ti.mtime = 0
+            ti.uid = ti.gid = 0
+            ti.uname = ti.gname = ""
+            ti.mode = 0o755 if os.access(p, os.X_OK) else 0o644
+            tf.addfile(ti, io.BytesIO(data))
+    blob = buf.getvalue()
+    return hashlib.sha256(blob).hexdigest(), blob
+
+
+# Patch stanza that `git apply` accepts for creating an empty file
+# (git diff --no-index emits nothing for zero-byte files).
+_EMPTY_FILE_PATCH = (
+    "diff --git a/{rel} b/{rel}\n"
+    "new file mode {mode}\n"
+    "index 0000000..e69de29\n"
+)
+
+
+def package_diff(repo_dir: Union[str, Path]) -> tuple[Optional[str], Optional[bytes]]:
+    """Uncommitted changes of a git checkout as one patch blob.
+
+    Tracked modifications come from ``git diff HEAD --binary``; untracked
+    files are appended via ``git diff --no-index`` so the runner can
+    restore the exact working tree with a single ``git apply``. Captured
+    as raw bytes — text mode would translate CRLF and corrupt patches of
+    CRLF files.
+    """
+    repo_dir = Path(repo_dir).resolve()
+    parts: list[bytes] = []
+    diff = subprocess.run(
+        ["git", "diff", "HEAD", "--binary", "--no-color"],
+        cwd=repo_dir,
+        capture_output=True,
+        timeout=60,
+    )
+    if diff.returncode == 0 and diff.stdout:
+        parts.append(diff.stdout)
+    untracked = _git(["ls-files", "--others", "--exclude-standard"], repo_dir)
+    for rel in (untracked or "").splitlines():
+        if not rel:
+            continue
+        out = subprocess.run(
+            ["git", "diff", "--no-index", "--binary", "--no-color", "/dev/null", rel],
+            cwd=repo_dir,
+            capture_output=True,
+        )
+        # --no-index exits 1 when files differ; that's the success path
+        if out.stdout:
+            parts.append(out.stdout)
+        elif (repo_dir / rel).is_file():
+            # zero-byte file: git emits no diff; synthesize the creation
+            mode = "100755" if os.access(repo_dir / rel, os.X_OK) else "100644"
+            parts.append(
+                _EMPTY_FILE_PATCH.format(rel=rel, mode=mode).encode()
+            )
+    if not parts:
+        return None, None
+    blob = b"".join(parts)
+    if len(blob) > MAX_ARCHIVE_SIZE:
+        raise ClientError("uncommitted diff too large; commit and push instead")
+    return hashlib.sha256(blob).hexdigest(), blob
+
+
+def package_repo(
+    repo_dir: Union[str, Path],
+) -> tuple[str, dict, Optional[str], Optional[bytes]]:
+    """One-call packaging: → (repo_id, repo_info dict, blob_hash, blob).
+
+    blob is an archive for local repos, a diff for remote repos, or None
+    when there is nothing to upload (clean remote checkout).
+    """
+    repo_id, info = detect_repo(repo_dir)
+    if info.repo_type == RepoType.REMOTE:
+        blob_hash, blob = package_diff(repo_dir)
+    else:
+        blob_hash, blob = package_archive(repo_dir)
+    return repo_id, info.model_dump(), blob_hash, blob

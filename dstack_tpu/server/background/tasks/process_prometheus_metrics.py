@@ -16,8 +16,13 @@ logger = get_logger("server.process_prometheus_metrics")
 
 
 async def collect_prometheus_metrics(db: Database) -> None:
+    # oldest-collected first so >50 running jobs rotate fairly instead of
+    # the same rows being refreshed every cycle
     rows = await db.fetchall(
-        "SELECT * FROM jobs WHERE status = ? LIMIT 50", (JobStatus.RUNNING.value,)
+        "SELECT j.* FROM jobs j "
+        "LEFT JOIN job_prometheus_metrics m ON m.job_id = j.id "
+        "WHERE j.status = ? ORDER BY COALESCE(m.collected_at, '') ASC LIMIT 50",
+        (JobStatus.RUNNING.value,),
     )
     for job_row in rows:
         try:

@@ -225,6 +225,14 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
             "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
         )
         return
+    from dstack_tpu.core.models.runs import RunSpec
+
+    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    repo_data = dict(run_spec.repo_data or {})
+    if repo_data and run_spec.repo_id:
+        creds = await _get_repo_creds(db, run_row["project_id"], run_spec.repo_id)
+        if creds:
+            repo_data["repo_creds"] = creds
     async with runner_client_for(jpd, runner_port) as runner:
         await runner.healthcheck()
         await runner.submit(
@@ -236,9 +244,10 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
                     "job_num": jpd.worker_id if jpd.hosts else job_spec.job_num,
                 },
                 cluster_info=cluster_info,
+                repo_data=repo_data,
             )
         )
-        code = await _get_code_blob(db, run_row)
+        code = await _get_code_blob(db, run_row, run_spec)
         if code:
             await runner.upload_code(code)
         await runner.run()
@@ -288,10 +297,36 @@ async def _register_on_gateway(
         )
 
 
-async def _get_code_blob(db: Database, run_row: dict) -> Optional[bytes]:
+async def _get_repo_creds(
+    db: Database, project_id: str, repo_id: str
+) -> Optional[dict]:
+    """Decrypted repo creds for the runner's git clone (the reference
+    passes RemoteRepoCreds in the runner submit body)."""
+    from dstack_tpu.server.services.encryption import decrypt
+
+    row = await db.fetchone(
+        "SELECT creds FROM repos WHERE project_id = ? AND name = ?",
+        (project_id, repo_id),
+    )
+    if row is None or not row["creds"]:
+        return None
+    creds = loads(row["creds"]) or {}
+    for key in ("oauth_token", "private_key"):
+        if creds.get(key):
+            try:
+                creds[key] = decrypt(creds[key])
+            except Exception:
+                pass  # stored unencrypted (pre-encryption rows)
+    return creds
+
+
+async def _get_code_blob(
+    db: Database, run_row: dict, run_spec=None
+) -> Optional[bytes]:
     from dstack_tpu.core.models.runs import RunSpec
 
-    run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    if run_spec is None:
+        run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
     if run_spec.repo_code_hash is None or run_spec.repo_id is None:
         return None
     repo = await db.fetchone(

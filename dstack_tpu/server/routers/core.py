@@ -363,6 +363,72 @@ async def delete_secrets(ctx: RequestContext, body: s.DeleteSecretsRequest):
         )
 
 
+# ---- repos ----
+
+
+@project_router.post("/repos/init")
+async def init_repo(ctx: RequestContext, body: s.InitRepoRequest):
+    """Register a code source (reference server/routers/repos.py)."""
+    from dstack_tpu.server.services import repos as repos_service
+
+    return await repos_service.init_repo(
+        ctx.state["db"], ctx.project["id"], body.repo_id, body.repo_info, body.creds
+    )
+
+
+@project_router.post("/repos/list")
+async def list_repos(ctx: RequestContext):
+    from dstack_tpu.server.services import repos as repos_service
+
+    return await repos_service.list_repos(ctx.state["db"], ctx.project["id"])
+
+
+@project_router.post("/repos/get")
+async def get_repo(ctx: RequestContext, body: s.GetRepoRequest):
+    from dstack_tpu.server.db import loads as _loads
+    from dstack_tpu.server.services import repos as repos_service
+
+    row = await repos_service.get_repo(ctx.state["db"], ctx.project["id"], body.repo_id)
+    if row is None:
+        raise ResourceNotExistsError(f"repo {body.repo_id} not found")
+    return {"repo_id": row["name"], "repo_info": _loads(row["repo_info"]) or {}}
+
+
+@project_router.post("/repos/delete")
+async def delete_repos(ctx: RequestContext, body: s.DeleteReposRequest):
+    from dstack_tpu.server.services import repos as repos_service
+
+    await repos_service.delete_repos(ctx.state["db"], ctx.project["id"], body.repos_ids)
+
+
+@project_router.post("/repos/is_code_uploaded")
+async def is_code_uploaded(ctx: RequestContext, body: s.IsCodeUploadedRequest):
+    from dstack_tpu.server.services import repos as repos_service
+
+    uploaded = await repos_service.is_code_uploaded(
+        ctx.state["db"], ctx.project["id"], body.repo_id, body.blob_hash
+    )
+    return {"uploaded": uploaded}
+
+
+@project_router.post("/repos/upload_code")
+async def upload_code(ctx: RequestContext):
+    """Raw binary body; repo_id + blob_hash as query params (the
+    reference uploads code as a multipart file, server/routers/repos.py)."""
+    from dstack_tpu.server.services import repos as repos_service
+
+    repo_id = ctx.request.query.get("repo_id")
+    blob_hash = ctx.request.query.get("blob_hash")
+    if not repo_id or not blob_hash:
+        from dstack_tpu.core.errors import ClientError
+
+        raise ClientError("repo_id and blob_hash query params are required")
+    blob = await ctx.request.read()
+    await repos_service.upload_code(
+        ctx.state["db"], ctx.project["id"], repo_id, blob_hash, blob
+    )
+
+
 # ---- metrics ----
 
 

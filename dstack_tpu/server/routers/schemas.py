@@ -120,3 +120,22 @@ class CreateSecretRequest(CoreModel):
 
 class DeleteSecretsRequest(CoreModel):
     secrets_names: list[str]
+
+
+class InitRepoRequest(CoreModel):
+    repo_id: str
+    repo_info: dict
+    creds: Optional[dict] = None
+
+
+class GetRepoRequest(CoreModel):
+    repo_id: str
+
+
+class DeleteReposRequest(CoreModel):
+    repos_ids: list[str]
+
+
+class IsCodeUploadedRequest(CoreModel):
+    repo_id: str
+    blob_hash: str

@@ -9,7 +9,7 @@ gauges speak chips / duty cycle / HBM instead of GPUs.
 """
 
 from datetime import datetime
-from typing import Iterable
+from typing import Iterable, Optional
 
 from dstack_tpu.core.models.runs import JobStatus, RunStatus
 from dstack_tpu.server.db import Database, loads
@@ -129,6 +129,7 @@ async def _render_jobs(db: Database, w: _Writer, projects: dict) -> None:
     job_rows = await db.fetchall(
         "SELECT * FROM jobs WHERE status = ?", (JobStatus.RUNNING.value,)
     )
+    seen_meta: set = set()
     for job_row in job_rows:
         run_row = await db.get_by_id("runs", job_row["run_id"])
         if run_row is None:
@@ -196,17 +197,31 @@ async def _render_jobs(db: Database, w: _Writer, projects: dict) -> None:
                 - datetime.fromisoformat(relay["collected_at"]).astimezone()
             ).total_seconds()
             if age < RELAY_STALENESS_SECONDS:
-                w.raw(_relabel(relay["text"], labels))
+                w.raw(_relabel(relay["text"], labels, seen_meta))
 
 
-def _relabel(text: str, labels: dict) -> str:
+def _relabel(text: str, labels: dict, seen_meta: Optional[set] = None) -> str:
     """Inject dtpu job labels into relayed exporter samples (reference
-    prometheus.py relabels DCGM lines with dstack run/job labels)."""
+    prometheus.py relabels DCGM lines with dstack run/job labels).
+
+    ``seen_meta`` dedups ``# HELP``/``# TYPE`` comment lines across jobs:
+    the Prometheus text parser rejects a second TYPE line for the same
+    metric name, so only the first job's metadata is kept."""
     extra = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
     out = []
     for line in text.splitlines():
         s = line.strip()
-        if not s or s.startswith("#"):
+        if not s:
+            out.append(line)
+            continue
+        if s.startswith("#"):
+            parts = s.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                key = (parts[1], parts[2])
+                if seen_meta is not None:
+                    if key in seen_meta:
+                        continue
+                    seen_meta.add(key)
             out.append(line)
             continue
         # metric{a="b"} v  |  metric v

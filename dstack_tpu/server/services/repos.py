@@ -1,0 +1,142 @@
+"""Repos service: repo registration + code blob storage.
+
+Parity: reference src/dstack/_internal/server/services/repos.py — repos
+are per-project code sources (remote git / local dir); ``codes`` rows
+hold uploaded archives or git diffs keyed by content hash, which
+process_running_jobs streams to the runner before start
+(reference server/services/repos.py, runner repo/manager.go:162).
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.errors import ClientError, ResourceNotExistsError
+from dstack_tpu.core.models.repos import RepoHead
+from dstack_tpu.core.models.runs import new_uuid
+from dstack_tpu.server.db import Database, dumps, loads
+
+# Archives beyond this size are rejected server-side; the reference
+# similarly caps local-repo uploads (client warns at 2MB, server-side
+# request limit governs).
+MAX_CODE_SIZE = 128 * 1024 * 1024
+
+
+async def init_repo(
+    db: Database,
+    project_id: str,
+    repo_id: str,
+    repo_info: dict,
+    creds: Optional[dict] = None,
+) -> RepoHead:
+    """Create or update a repo row (reference repos.init_repo)."""
+    if creds:
+        from dstack_tpu.server.services.encryption import encrypt
+
+        creds = dict(creds)
+        for key in ("oauth_token", "private_key"):
+            if creds.get(key):
+                creds[key] = encrypt(creds[key])
+    row = await db.fetchone(
+        "SELECT id FROM repos WHERE project_id = ? AND name = ?",
+        (project_id, repo_id),
+    )
+    if row is None:
+        await db.insert(
+            "repos",
+            {
+                "id": new_uuid(),
+                "project_id": project_id,
+                "name": repo_id,
+                "repo_info": dumps(repo_info),
+                "creds": dumps(creds) if creds else None,
+            },
+        )
+    else:
+        updates = {"repo_info": dumps(repo_info)}
+        if creds is not None:
+            updates["creds"] = dumps(creds)
+        await db.update_by_id("repos", row["id"], updates)
+    return RepoHead(repo_id=repo_id, repo_info=repo_info)
+
+
+async def get_repo(db: Database, project_id: str, repo_id: str) -> Optional[dict]:
+    return await db.fetchone(
+        "SELECT * FROM repos WHERE project_id = ? AND name = ?",
+        (project_id, repo_id),
+    )
+
+
+async def list_repos(db: Database, project_id: str) -> list[RepoHead]:
+    rows = await db.fetchall(
+        "SELECT * FROM repos WHERE project_id = ? ORDER BY name", (project_id,)
+    )
+    return [
+        RepoHead(repo_id=r["name"], repo_info=loads(r["repo_info"]) or {})
+        for r in rows
+    ]
+
+
+async def delete_repos(db: Database, project_id: str, repo_ids: list[str]) -> None:
+    for repo_id in repo_ids:
+        row = await get_repo(db, project_id, repo_id)
+        if row is None:
+            continue
+        await db.execute("DELETE FROM codes WHERE repo_id = ?", (row["id"],))
+        await db.execute("DELETE FROM repos WHERE id = ?", (row["id"],))
+
+
+async def upload_code(
+    db: Database,
+    project_id: str,
+    repo_id: str,
+    blob_hash: str,
+    blob: bytes,
+) -> None:
+    """Store a code blob (tar archive or git diff) under its content hash.
+
+    Idempotent: re-uploading an existing hash is a no-op (reference
+    server/services/repos.py upload_code).
+    """
+    if len(blob) > MAX_CODE_SIZE:
+        raise ClientError(
+            f"code upload too large ({len(blob)} bytes > {MAX_CODE_SIZE})"
+        )
+    import hashlib
+
+    actual = hashlib.sha256(blob).hexdigest()
+    if actual != blob_hash:
+        # a corrupted upload stored under the claimed hash would be pinned
+        # forever by the is_code_uploaded dedup
+        raise ClientError(
+            f"code blob hash mismatch: claimed {blob_hash}, got {actual}"
+        )
+    repo = await get_repo(db, project_id, repo_id)
+    if repo is None:
+        raise ResourceNotExistsError(f"repo {repo_id} not initialized")
+    existing = await db.fetchone(
+        "SELECT id FROM codes WHERE repo_id = ? AND blob_hash = ?",
+        (repo["id"], blob_hash),
+    )
+    if existing is not None:
+        return
+    await db.insert(
+        "codes",
+        {
+            "id": new_uuid(),
+            "repo_id": repo["id"],
+            "blob_hash": blob_hash,
+            "blob": blob,
+        },
+    )
+
+
+async def is_code_uploaded(
+    db: Database, project_id: str, repo_id: str, blob_hash: str
+) -> bool:
+    repo = await get_repo(db, project_id, repo_id)
+    if repo is None:
+        return False
+    row = await db.fetchone(
+        "SELECT id FROM codes WHERE repo_id = ? AND blob_hash = ?",
+        (repo["id"], blob_hash),
+    )
+    return row is not None
