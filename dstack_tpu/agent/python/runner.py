@@ -197,6 +197,46 @@ class Executor:
 
             shutil.copytree(self.code_path, workdir, dirs_exist_ok=True)
 
+    def _setup_internode_ssh(self, spec: dict) -> dict[str, str]:
+        """Install the per-replica keypair + host config so worker 0 can
+        `ssh <node-ip>` into siblings (reference executor.go:729-777
+        ``configureSSH``). Keys live under the runner home (never the
+        host user's ~/.ssh — process mode shares the host); in a
+        container /root/.ssh/config is also linked for plain `ssh`."""
+        assert self.job is not None
+        ssh_key = spec.get("ssh_key") or {}
+        if not ssh_key.get("private"):
+            return {}
+        ssh_dir = self.home_dir / "ssh"
+        ssh_dir.mkdir(parents=True, exist_ok=True)
+        key_file = ssh_dir / "id_internode"
+        key_file.touch(mode=0o600)
+        key_file.write_text(ssh_key["private"])
+        key_file.chmod(0o600)
+        conf_lines = []
+        for ip in self.job.cluster_info.nodes_ips or []:
+            if not ip:
+                continue
+            conf_lines += [
+                f"Host {ip}",
+                f"  IdentityFile {key_file}",
+                "  Port 10022",
+                "  User root",
+                "  StrictHostKeyChecking no",
+                "  UserKnownHostsFile /dev/null",
+                "",
+            ]
+        conf_file = ssh_dir / "config"
+        conf_file.write_text("\n".join(conf_lines))
+        if Path("/.dockerenv").exists():
+            root_ssh = Path("/root/.ssh")
+            root_ssh.mkdir(mode=0o700, exist_ok=True)
+            if not (root_ssh / "config").exists():
+                (root_ssh / "config").write_text(
+                    f"Include {conf_file}\n"
+                )
+        return {"DTPU_SSH_CONFIG": str(conf_file)}
+
     async def _run_job(self) -> None:
         assert self.job is not None
         spec = self.job.job_spec
@@ -214,6 +254,8 @@ class Executor:
         env.update(spec.get("env") or {})
         env["DTPU_RUN_NAME"] = self.job.run_name
         env["DTPU_JOB_NAME"] = self.job.job_name
+        ssh_env = self._setup_internode_ssh(spec)
+        env.update(ssh_env)
 
         commands = spec.get("commands") or []
         script = " && ".join(commands) if commands else "true"

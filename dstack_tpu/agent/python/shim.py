@@ -222,10 +222,33 @@ class DockerRuntime:
             "python -m dstack_tpu.agent.python.runner_main "
             f"--port {req.runner_port} --home /root/.dtpu"
         )
+        entry = runner_cmd
+        if req.ssh_authorized_keys:
+            # reference docker.go:884-910: authorize keys + best-effort
+            # sshd so `dtpu attach` / inter-node ssh can reach the
+            # container; images without sshd still run the job.
+            # Keys are base64-wrapped: they are user-controlled strings
+            # and must not be interpolated into shell quoting.
+            import base64 as _b64
+
+            keys_b64 = _b64.b64encode(
+                ("\n".join(req.ssh_authorized_keys) + "\n").encode()
+            ).decode()
+            entry = (
+                "mkdir -p /root/.ssh && chmod 700 /root/.ssh && "
+                f"echo {keys_b64} | base64 -d >> /root/.ssh/authorized_keys && "
+                "chmod 600 /root/.ssh/authorized_keys && "
+                "if command -v sshd >/dev/null 2>&1; then "
+                "mkdir -p /run/sshd; ssh-keygen -A >/dev/null 2>&1; "
+                # absolute path: OpenSSH refuses to re-exec a relative argv[0]
+                f'"$(command -v sshd)" -p {req.ssh_port} -o PermitRootLogin=yes '
+                "-o PasswordAuthentication=no; fi; "
+                + runner_cmd
+            )
         config = {
             "Image": req.image_name,
             "Env": env,
-            "Cmd": ["/bin/sh", "-c", runner_cmd],
+            "Cmd": ["/bin/sh", "-c", entry],
             "HostConfig": {
                 "Privileged": req.privileged,
                 "NetworkMode": req.network_mode,

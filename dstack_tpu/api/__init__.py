@@ -85,7 +85,13 @@ class RunCollection:
     ) -> RunSpec:
         if isinstance(conf, dict):
             conf = parse_run_configuration(conf)
-        spec = RunSpec(run_name=run_name, configuration=conf, ssh_key_pub="")
+        try:
+            from dstack_tpu.api.attach import get_or_create_client_keypair
+
+            _, ssh_key_pub = get_or_create_client_keypair()
+        except Exception:
+            ssh_key_pub = ""
+        spec = RunSpec(run_name=run_name, configuration=conf, ssh_key_pub=ssh_key_pub)
         if repo_dir is not None:
             if not upload:
                 # plan-only: cheap metadata detection, no archive build
@@ -107,6 +113,13 @@ class RunCollection:
             ):
                 self._c.api.upload_code(self._c.project, repo_id, blob_hash, blob)
         return spec
+
+    def attach(self, run_name: str):
+        """Port-forward to the run and register `ssh <run-name>`
+        (reference Run.attach, api/_public/runs.py:244)."""
+        from dstack_tpu.api.attach import attach_sync
+
+        return attach_sync(self.get(run_name))
 
     def list(self) -> list[Run]:
         return self._c.api.list_runs(self._c.project)

@@ -1,0 +1,210 @@
+"""Client-side run attachment: port forwarding + SSH config + IDE links.
+
+Parity: reference ``Run.attach`` (api/_public/runs.py:244-365) and
+``SSHAttach`` (core/services/ssh/attach.py): reserve local ports for the
+job's apps, open an SSH tunnel to the job host, write an ssh config
+entry so ``ssh <run-name>`` works, and for dev environments print the
+VS Code remote URL.
+
+TPU-first deltas: the local backend runs jobs as host processes (no
+tunnel needed — ports are already on 127.0.0.1), and multi-host slices
+attach to worker 0 (jump host for the rest).
+"""
+
+import asyncio
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from dstack_tpu.core.errors import ClientError
+from dstack_tpu.core.models.runs import Run
+from dstack_tpu.core.services.ssh.tunnel import SSHTunnel, find_free_port
+from dstack_tpu.utils.crypto import generate_rsa_key_pair_bytes
+
+DTPU_DIR = Path.home() / ".dstack_tpu"
+SSH_DIR = DTPU_DIR / "ssh"
+SSH_CONFIG = SSH_DIR / "config"
+CONTAINER_SSH_PORT = 10022
+
+
+def get_or_create_client_keypair() -> tuple[Path, str]:
+    """Lazy per-user keypair; the public half rides run_spec.ssh_key_pub
+    and is authorized inside job containers."""
+    SSH_DIR.mkdir(parents=True, exist_ok=True)
+    key_file = SSH_DIR / "id_ed25519"
+    pub_file = SSH_DIR / "id_ed25519.pub"
+    if not key_file.exists():
+        private, public = generate_rsa_key_pair_bytes(comment="dtpu-client")
+        key_file.write_text(private)
+        key_file.chmod(0o600)
+        pub_file.write_text(public)
+    return key_file, pub_file.read_text().strip()
+
+
+def _ssh_config_entry(
+    run_name: str,
+    hostname: str,
+    username: str,
+    port: int,
+    identity_file: Path,
+    proxy_jump: Optional[str] = None,
+) -> str:
+    lines = [
+        f"Host {run_name}",
+        f"  HostName {hostname}",
+        f"  User {username}",
+        f"  Port {port}",
+        f"  IdentityFile {identity_file}",
+        "  StrictHostKeyChecking no",
+        "  UserKnownHostsFile /dev/null",
+    ]
+    if proxy_jump:
+        lines.append(f"  ProxyJump {proxy_jump}")
+    return "\n".join(lines) + "\n\n"
+
+
+def update_ssh_config(run_name: str, entry: Optional[str]) -> Path:
+    """Idempotently (re)write the ``Host <run_name>`` block; ``None``
+    removes it (reference SSHAttach config management)."""
+    SSH_DIR.mkdir(parents=True, exist_ok=True)
+    text = SSH_CONFIG.read_text() if SSH_CONFIG.exists() else ""
+    blocks = [b for b in text.split("\n\n") if b.strip()]
+    blocks = [
+        b for b in blocks if not b.lstrip().startswith(f"Host {run_name}\n")
+        and b.lstrip() != f"Host {run_name}"
+    ]
+    kept = "\n\n".join(b.strip("\n") for b in blocks)
+    if kept:
+        kept += "\n\n"
+    SSH_CONFIG.write_text(kept + (entry or ""))
+    return SSH_CONFIG
+
+
+@dataclass
+class RunAttachment:
+    run_name: str
+    ports: dict[int, int] = field(default_factory=dict)  # container → local
+    tunnel: Optional[SSHTunnel] = None
+    ssh_host: Optional[str] = None  # `ssh <alias>` alias when configured
+    ide_url: Optional[str] = None
+
+    def alive(self) -> bool:
+        """False once the underlying ssh process has exited (direct
+        local attachments have no process to die)."""
+        if self.tunnel is None or self.tunnel._proc is None:
+            return True
+        return self.tunnel._proc.poll() is None
+
+    def close(self) -> None:
+        if self.tunnel is not None:
+            self.tunnel.close()
+            self.tunnel = None
+        update_ssh_config(self.run_name, None)
+
+
+def plan_attachment(run: Run) -> tuple[dict[int, int], Optional[dict]]:
+    """→ (container_port→host_port on the job host, jpd dict or None).
+
+    Pure planning half, separated for testability: decides which ports
+    exist and where they currently live.
+    """
+    if not run.jobs or run.jobs[0].latest is None:
+        raise ClientError(f"run {run.run_spec.run_name} has no job submission")
+    sub = run.jobs[0].latest
+    jpd = sub.job_provisioning_data
+    if jpd is None or not jpd.hostname:
+        raise ClientError(f"run {run.run_spec.run_name} is not provisioned yet")
+    job_spec = run.jobs[0].job_spec
+    container_ports = [a.port for a in job_spec.app_specs]
+    if job_spec.service_port and job_spec.service_port not in container_ports:
+        container_ports.append(job_spec.service_port)
+    runtime_ports = (sub.job_runtime_data.ports or {}) if sub.job_runtime_data else {}
+    host_ports = {
+        int(c): int(runtime_ports.get(c) or runtime_ports.get(str(c)) or c)
+        for c in container_ports
+    }
+    return host_ports, jpd.model_dump()
+
+
+async def attach(run: Run, local_backend_direct: bool = True) -> RunAttachment:
+    """Open the attachment: direct for local-backend runs, SSH tunnel
+    otherwise. Desired local ports honor ``map_to_port`` (``ports:
+    "8080:8000"``), falling back to a free port when taken."""
+    host_ports, jpd = plan_attachment(run)
+    run_name = run.run_spec.run_name or "run"
+    job_spec = run.jobs[0].job_spec
+    desired_local = {
+        a.port: (a.map_to_port or a.port) for a in job_spec.app_specs
+    }
+    att = RunAttachment(run_name=run_name)
+
+    if jpd["backend"] == "local" and local_backend_direct:
+        # job runs as a process on this machine; ports are already local
+        att.ports = {c: h for c, h in host_ports.items()}
+        return att
+
+    key_file, _ = get_or_create_client_keypair()
+    forwards: dict[int, int] = {}
+    for c, h in host_ports.items():
+        local = desired_local.get(c, c)
+        if _port_taken(local):
+            local = find_free_port()
+        forwards[local] = h
+        att.ports[c] = local
+    proxy = jpd.get("ssh_proxy")
+    tunnel = SSHTunnel(
+        host=jpd["hostname"],
+        username=jpd.get("username", "root"),
+        port=jpd.get("ssh_port", 22),
+        identity_file=str(key_file),
+        proxy=None if proxy is None else _proxy_params(proxy),
+        forwards=forwards,
+    )
+    await tunnel.open()
+    att.tunnel = tunnel
+
+    # `ssh <run-name>` → in-container sshd, jumping through the host
+    jump = f"{jpd.get('username', 'root')}@{jpd['hostname']}:{jpd.get('ssh_port', 22)}"
+    entry = _ssh_config_entry(
+        run_name,
+        jpd["hostname"],
+        "root",
+        CONTAINER_SSH_PORT,
+        key_file,
+        proxy_jump=jump,
+    )
+    update_ssh_config(run_name, entry)
+    att.ssh_host = run_name
+
+    # IDE link only once `ssh <run-name>` actually resolves
+    conf = run.run_spec.configuration
+    if getattr(conf, "type", None) == "dev-environment":
+        ide = getattr(conf, "ide", "vscode")
+        if ide in ("vscode", "cursor"):
+            scheme = "vscode" if ide == "vscode" else "cursor"
+            att.ide_url = (
+                f"{scheme}://vscode-remote/ssh-remote+{run_name}/root/.dtpu/workflow"
+            )
+    return att
+
+
+def _proxy_params(proxy: dict):
+    from dstack_tpu.core.models.instances import SSHProxyParams
+
+    return SSHProxyParams.model_validate(proxy)
+
+
+def _port_taken(port: int) -> bool:
+    import socket
+
+    with socket.socket() as s:
+        try:
+            s.bind(("127.0.0.1", port))
+            return False
+        except OSError:
+            return True
+
+
+def attach_sync(run: Run) -> RunAttachment:
+    # the tunnel is a plain subprocess — no loop-bound state survives
+    return asyncio.run(attach(run))

@@ -256,6 +256,45 @@ def logs(run_name, project, diagnose, follow) -> None:
 @cli.command()
 @click.argument("run_name")
 @click.option("--project", default=None)
+@click.option(
+    "--no-logs", is_flag=True, help="keep the tunnel open without streaming logs"
+)
+def attach(run_name, project, no_logs) -> None:
+    """Forward the run's ports here and register `ssh RUN_NAME`
+    (reference `dstack attach`)."""
+    client = _client(project)
+    try:
+        att = client.runs.attach(run_name)
+    except DstackTPUError as e:
+        _die(str(e))
+    try:
+        for container, local in sorted(att.ports.items()):
+            console.print(
+                f"Port [bold]{container}[/bold] → http://127.0.0.1:{local}"
+            )
+        if att.ssh_host:
+            console.print(
+                f"SSH: [bold]ssh -F ~/.dstack_tpu/ssh/config {att.ssh_host}[/bold]"
+            )
+        if att.ide_url:
+            console.print(f"IDE: [link]{att.ide_url}[/link]")
+        if no_logs:
+            console.print("Attached. Ctrl-C to detach.")
+            while att.alive():
+                time.sleep(2)
+            console.print("[red]Tunnel died[/red]")
+        else:
+            _stream_run(client, run_name)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        att.close()
+        console.print("Detached.")
+
+
+@cli.command()
+@click.argument("run_name")
+@click.option("--project", default=None)
 @click.option("-x", "--abort", is_flag=True)
 @click.option("-y", "--yes", is_flag=True)
 def stop(run_name, project, abort, yes) -> None:

@@ -76,11 +76,18 @@ async def _provision(db: Database, row: dict) -> None:
             db, row, InstanceStatus.TERMINATED, termination_reason="backend unavailable"
         )
         return
+    from dstack_tpu.server.services import projects as projects_service
+
+    project_key = await projects_service.get_project_ssh_public_key(
+        db, project_row["id"]
+    )
     try:
         jpd = await compute.create_instance(
             offer,
             InstanceConfiguration(
-                project_name=project_row["name"], instance_name=row["name"]
+                project_name=project_row["name"],
+                instance_name=row["name"],
+                ssh_public_keys=[project_key] if project_key else [],
             ),
         )
     except Exception as e:

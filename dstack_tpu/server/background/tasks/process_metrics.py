@@ -37,7 +37,9 @@ async def _collect_job(db: Database, job_row: dict) -> None:
     jrd = loads(job_row.get("job_runtime_data")) or {}
     ports = jrd.get("ports") or {}
     runner_port = next(iter(ports.values()), 10999)
-    async with runner_client_for(jpd, int(runner_port)) as runner:
+    async with runner_client_for(
+        jpd, int(runner_port), db=db, project_id=job_row["project_id"]
+    ) as runner:
         sample = await runner.metrics()
     await db.insert(
         "job_metrics_points",

@@ -40,7 +40,9 @@ async def _collect_job(db: Database, job_row: dict) -> None:
     if jpd_raw is None:
         return
     jpd = JobProvisioningData.model_validate(jpd_raw)
-    async with shim_client_for(jpd) as shim:
+    async with shim_client_for(
+        jpd, db=db, project_id=job_row["project_id"]
+    ) as shim:
         text = await shim.get_prometheus_metrics()
     existing = await db.fetchone(
         "SELECT job_id FROM job_prometheus_metrics WHERE job_id = ?",

@@ -155,7 +155,24 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
         )
         return
     job_spec = JobSpec.model_validate(loads(job_row["job_spec"]))
-    async with shim_client_for(jpd) as shim:
+    # authorized keys for in-container sshd: the user's key (attach) +
+    # the per-replica inter-node key (reference configurators/base.py:246)
+    authorized_keys: list[str] = []
+    run_row_for_keys = await db.get_by_id("runs", job_row["run_id"])
+    if run_row_for_keys is not None:
+        from dstack_tpu.core.models.runs import RunSpec as _RunSpec
+
+        try:
+            _spec = _RunSpec.model_validate(loads(run_row_for_keys["run_spec"]))
+            if _spec.ssh_key_pub:
+                authorized_keys.append(_spec.ssh_key_pub.strip())
+        except Exception:
+            pass
+    if job_spec.ssh_key is not None and job_spec.ssh_key.public:
+        authorized_keys.append(job_spec.ssh_key.public.strip())
+    async with shim_client_for(
+        jpd, db=db, project_id=job_row["project_id"]
+    ) as shim:
         await shim.healthcheck()
         task_req = agent_schemas.TaskSubmitRequest(
             id=job_row["id"],
@@ -167,6 +184,7 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
             pjrt_device=job_spec.pjrt_device,
             env={},
             network_mode="host",
+            ssh_authorized_keys=authorized_keys,
         )
         info = await shim.submit_task(task_req)
     jrd = {
@@ -196,7 +214,9 @@ def _runner_port(job_row: dict) -> int:
 
 async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData) -> None:
     job_spec = JobSpec.model_validate(loads(job_row["job_spec"]))
-    async with shim_client_for(jpd) as shim:
+    async with shim_client_for(
+        jpd, db=db, project_id=job_row["project_id"]
+    ) as shim:
         info = await shim.get_task(job_row["id"])
     if info.status == agent_schemas.TaskStatus.TERMINATED:
         await jobs_service.update_job_status(
@@ -233,7 +253,9 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
         creds = await _get_repo_creds(db, run_row["project_id"], run_spec.repo_id)
         if creds:
             repo_data["repo_creds"] = creds
-    async with runner_client_for(jpd, runner_port) as runner:
+    async with runner_client_for(
+        jpd, runner_port, db=db, project_id=job_row["project_id"]
+    ) as runner:
         await runner.healthcheck()
         await runner.submit(
             agent_schemas.SubmitBody(
@@ -346,7 +368,9 @@ async def _process_running(db: Database, job_row: dict, jpd: JobProvisioningData
     jrd = loads(job_row.get("job_runtime_data")) or {}
     cursor = float(jrd.get("pull_cursor", 0.0))
     runner_port = _runner_port(job_row)
-    async with runner_client_for(jpd, runner_port) as runner:
+    async with runner_client_for(
+        jpd, runner_port, db=db, project_id=job_row["project_id"]
+    ) as runner:
         resp = await runner.pull(cursor)
     run_row = await db.get_by_id("runs", job_row["run_id"])
     project_row = await db.get_by_id("projects", run_row["project_id"])

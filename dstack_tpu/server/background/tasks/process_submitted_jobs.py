@@ -119,6 +119,7 @@ async def _process_job(db: Database, job_id: str) -> None:
             project_name=project_row["name"],
             instance_name=instance_name,
             user=run_row["user_id"],
+            ssh_public_keys=await _instance_ssh_keys(db, project_row, run_spec),
         )
         try:
             jpd = await compute.create_instance(offer, config)
@@ -201,6 +202,23 @@ async def _attach_worker_job(
         await _provision_sibling(db, job_row, run_row, job_spec, jpd)
 
 
+async def _instance_ssh_keys(db: Database, project_row: dict, run_spec) -> list[str]:
+    """Keys authorized on a freshly provisioned instance: the project key
+    (server tunnels) + the submitting user's key (`dtpu attach`).
+    Reference base/compute.py get_user_data authorized_keys."""
+    from dstack_tpu.server.services import projects as projects_service
+
+    keys = []
+    project_key = await projects_service.get_project_ssh_public_key(
+        db, project_row["id"]
+    )
+    if project_key:
+        keys.append(project_key)
+    if run_spec is not None and getattr(run_spec, "ssh_key_pub", ""):
+        keys.append(run_spec.ssh_key_pub.strip())
+    return keys
+
+
 async def _provision_sibling(
     db: Database, job_row: dict, run_row: dict, job_spec: JobSpec, master_jpd
 ) -> None:
@@ -220,11 +238,16 @@ async def _provision_sibling(
         await _fail_no_capacity(db, job_row, "no sibling offers in master region")
         return
     instance_name = f"{run_row['run_name']}-{job_spec.replica_num}-{job_spec.job_num}"
+    sibling_run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
     try:
         jpd = await compute.create_instance(
             offers[0],
             InstanceConfiguration(
-                project_name=project_row["name"], instance_name=instance_name
+                project_name=project_row["name"],
+                instance_name=instance_name,
+                ssh_public_keys=await _instance_ssh_keys(
+                    db, project_row, sibling_run_spec
+                ),
             ),
         )
     except Exception as e:

@@ -232,4 +232,11 @@ CREATE TABLE secrets (
 );
 """,
     ),
+    (
+        "0002_project_ssh_keys",
+        """
+ALTER TABLE projects ADD COLUMN ssh_private_key TEXT;
+ALTER TABLE projects ADD COLUMN ssh_public_key TEXT;
+""",
+    ),
 ]

@@ -134,8 +134,27 @@ def _direct(jpd: JobProvisioningData) -> bool:
     return jpd.backend.value == "local" or jpd.hostname in ("127.0.0.1", "localhost")
 
 
+async def _tunnel_identity(db, project_id: Optional[str]) -> Optional[str]:
+    """Project private key path for server→instance tunnels (reference
+    runner/ssh.py uses the project key for every hop)."""
+    if db is None or project_id is None:
+        return None
+    from dstack_tpu.server.services.projects import get_project_ssh_identity
+
+    try:
+        return await get_project_ssh_identity(db, project_id)
+    except Exception:
+        logger.warning("project %s: ssh identity unavailable", project_id)
+        return None
+
+
 @asynccontextmanager
-async def shim_client_for(jpd: JobProvisioningData, shim_port: Optional[int] = None):
+async def shim_client_for(
+    jpd: JobProvisioningData,
+    shim_port: Optional[int] = None,
+    db=None,
+    project_id: Optional[str] = None,
+):
     """Yield a ShimClient for the job's worker host, tunneling if needed."""
     port = shim_port
     if port is None:
@@ -155,6 +174,7 @@ async def shim_client_for(jpd: JobProvisioningData, shim_port: Optional[int] = N
         ),
         [port],
         proxy=jpd.ssh_proxy,
+        identity_file=await _tunnel_identity(db, project_id),
     )
     try:
         yield ShimClient("127.0.0.1", ports[port])
@@ -163,7 +183,12 @@ async def shim_client_for(jpd: JobProvisioningData, shim_port: Optional[int] = N
 
 
 @asynccontextmanager
-async def runner_client_for(jpd: JobProvisioningData, runner_port: int):
+async def runner_client_for(
+    jpd: JobProvisioningData,
+    runner_port: int,
+    db=None,
+    project_id: Optional[str] = None,
+):
     if _direct(jpd):
         yield RunnerClient(jpd.hostname or "127.0.0.1", runner_port)
         return
@@ -176,6 +201,7 @@ async def runner_client_for(jpd: JobProvisioningData, runner_port: int):
         ),
         [runner_port],
         proxy=jpd.ssh_proxy,
+        identity_file=await _tunnel_identity(db, project_id),
     )
     try:
         yield RunnerClient("127.0.0.1", ports[runner_port])

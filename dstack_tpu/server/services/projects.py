@@ -32,6 +32,11 @@ async def create_project(db: Database, user_row: dict, name: str, is_public: boo
     )
     if existing is not None:
         raise ResourceExistsError(f"project {name} already exists")
+    from dstack_tpu.utils.crypto import generate_rsa_key_pair_bytes
+
+    # per-project keypair: the server authenticates to every instance it
+    # provisions with this key (reference ProjectModel ssh_private_key)
+    private_key, public_key = generate_rsa_key_pair_bytes(comment=f"dtpu-{name}")
     project_id = new_uuid()
     await db.insert(
         "projects",
@@ -42,6 +47,8 @@ async def create_project(db: Database, user_row: dict, name: str, is_public: boo
             "is_public": int(is_public),
             "deleted": 0,
             "created_at": now_utc().isoformat(),
+            "ssh_private_key": private_key,
+            "ssh_public_key": public_key,
         },
     )
     await db.insert(
@@ -54,6 +61,45 @@ async def create_project(db: Database, user_row: dict, name: str, is_public: boo
         },
     )
     return await get_project(db, name)
+
+
+async def get_project_ssh_identity(db: Database, project_id: str) -> Optional[str]:
+    """Path to the project's private key on disk (0600, cached per
+    project) — the identity the server's shim/runner tunnels use.
+    Pre-0002 projects without a key get one lazily."""
+    from dstack_tpu.server import settings
+    from dstack_tpu.utils.crypto import generate_rsa_key_pair_bytes
+
+    row = await db.fetchone(
+        "SELECT id, name, ssh_private_key FROM projects WHERE id = ?", (project_id,)
+    )
+    if row is None:
+        return None
+    private = row["ssh_private_key"]
+    if not private:
+        private, public = generate_rsa_key_pair_bytes(comment=f"dtpu-{row['name']}")
+        await db.update_by_id(
+            "projects",
+            project_id,
+            {"ssh_private_key": private, "ssh_public_key": public},
+        )
+    keys_dir = settings.SERVER_DIR_PATH / "keys"
+    keys_dir.mkdir(parents=True, exist_ok=True)
+    key_file = keys_dir / project_id
+    if not key_file.exists() or key_file.read_text() != private:
+        key_file.touch(mode=0o600)
+        key_file.write_text(private)
+        key_file.chmod(0o600)
+    return str(key_file)
+
+
+async def get_project_ssh_public_key(db: Database, project_id: str) -> Optional[str]:
+    """The public half installed on every provisioned instance."""
+    await get_project_ssh_identity(db, project_id)  # ensure keypair exists
+    row = await db.fetchone(
+        "SELECT ssh_public_key FROM projects WHERE id = ?", (project_id,)
+    )
+    return (row["ssh_public_key"] or "").strip() if row else None
 
 
 async def get_project_row(db: Database, name: str) -> Optional[dict]:

@@ -1,0 +1,197 @@
+"""Client attach plane: keypair management, ssh config entries, port
+planning, local-backend direct attach, dev-env IDE links.
+
+Parity: reference Run.attach / SSHAttach (api/_public/runs.py:244,
+core/services/ssh/attach.py).
+"""
+
+from datetime import datetime, timezone
+
+import pytest
+
+import dstack_tpu.api.attach as attach_mod
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.instances import InstanceType, Resources
+from dstack_tpu.core.models.runs import (
+    AppSpec,
+    Job,
+    JobProvisioningData,
+    JobRuntimeData,
+    JobSpec,
+    JobStatus,
+    JobSubmission,
+    Requirements,
+    Run,
+    RunSpec,
+    RunStatus,
+)
+
+
+@pytest.fixture
+def ssh_dir(tmp_path, monkeypatch):
+    monkeypatch.setattr(attach_mod, "DTPU_DIR", tmp_path)
+    monkeypatch.setattr(attach_mod, "SSH_DIR", tmp_path / "ssh")
+    monkeypatch.setattr(attach_mod, "SSH_CONFIG", tmp_path / "ssh" / "config")
+    return tmp_path / "ssh"
+
+
+def _run(
+    backend="local",
+    hostname="127.0.0.1",
+    app_specs=None,
+    runtime_ports=None,
+    conf_type="task",
+    service_port=None,
+) -> Run:
+    conf = {"type": conf_type}
+    if conf_type == "task":
+        conf["commands"] = ["true"]
+    elif conf_type == "service":
+        conf["commands"] = ["true"]
+        conf["port"] = 8000
+    from dstack_tpu.core.models.resources import ResourcesSpec
+
+    job_spec = JobSpec(
+        job_name="r-0-0",
+        requirements=Requirements(resources=ResourcesSpec()),
+        app_specs=app_specs or [],
+        service_port=service_port,
+    )
+    jpd = JobProvisioningData(
+        backend=BackendType(backend),
+        instance_type=InstanceType(
+            name="local", resources=Resources(cpus=1, memory_mib=1024)
+        ),
+        instance_id="i-1",
+        hostname=hostname,
+        username="root",
+        ssh_port=22,
+    )
+    sub = JobSubmission(
+        id="s1",
+        submitted_at=datetime.now(timezone.utc),
+        status=JobStatus.RUNNING,
+        job_provisioning_data=jpd,
+        job_runtime_data=JobRuntimeData(ports=runtime_ports),
+    )
+    return Run(
+        id="r1",
+        project_name="main",
+        user="admin",
+        submitted_at=datetime.now(timezone.utc),
+        status=RunStatus.RUNNING,
+        run_spec=RunSpec(run_name="myrun", configuration=conf),
+        jobs=[Job(job_spec=job_spec, job_submissions=[sub])],
+    )
+
+
+class TestKeypair:
+    def test_created_once_with_0600(self, ssh_dir):
+        key1, pub1 = attach_mod.get_or_create_client_keypair()
+        key2, pub2 = attach_mod.get_or_create_client_keypair()
+        assert key1 == key2 and pub1 == pub2
+        assert pub1.startswith("ssh-ed25519 ")
+        assert (key1.stat().st_mode & 0o777) == 0o600
+
+
+class TestSSHConfig:
+    def test_add_replace_remove(self, ssh_dir):
+        e1 = attach_mod._ssh_config_entry(
+            "run-a", "1.2.3.4", "root", 10022, ssh_dir / "id", "root@1.2.3.4:22"
+        )
+        attach_mod.update_ssh_config("run-a", e1)
+        text = attach_mod.SSH_CONFIG.read_text()
+        assert "Host run-a" in text and "ProxyJump root@1.2.3.4:22" in text
+
+        e2 = attach_mod._ssh_config_entry(
+            "run-b", "5.6.7.8", "root", 10022, ssh_dir / "id"
+        )
+        attach_mod.update_ssh_config("run-b", e2)
+        # replace run-a with new hostname
+        e1b = attach_mod._ssh_config_entry(
+            "run-a", "9.9.9.9", "root", 10022, ssh_dir / "id"
+        )
+        attach_mod.update_ssh_config("run-a", e1b)
+        text = attach_mod.SSH_CONFIG.read_text()
+        assert text.count("Host run-a") == 1
+        assert "9.9.9.9" in text and "1.2.3.4" not in text
+        assert "Host run-b" in text
+
+        attach_mod.update_ssh_config("run-a", None)
+        text = attach_mod.SSH_CONFIG.read_text()
+        assert "Host run-a" not in text and "Host run-b" in text
+
+
+class TestPlanAttachment:
+    def test_ports_from_app_specs_and_runtime(self):
+        run = _run(
+            app_specs=[AppSpec(port=8000, app_name="app0")],
+            runtime_ports={8000: 32768},
+        )
+        host_ports, jpd = attach_mod.plan_attachment(run)
+        assert host_ports == {8000: 32768}
+        assert jpd["backend"] == "local"
+
+    def test_service_port_included_host_networking(self):
+        run = _run(service_port=9000)
+        host_ports, _ = attach_mod.plan_attachment(run)
+        assert host_ports == {9000: 9000}
+
+    def test_unprovisioned_raises(self):
+        run = _run(hostname=None)
+        with pytest.raises(Exception):
+            attach_mod.plan_attachment(run)
+
+
+class TestAttach:
+    async def test_local_backend_direct_no_tunnel(self, ssh_dir):
+        run = _run(
+            app_specs=[AppSpec(port=8000, app_name="app0")],
+            runtime_ports={8000: 18000},
+        )
+        att = await attach_mod.attach(run)
+        assert att.tunnel is None
+        assert att.ports == {8000: 18000}
+        att.close()
+
+    async def test_local_dev_env_has_no_ide_url(self, ssh_dir):
+        # no ssh config entry is written for direct attachments, so no
+        # (dead) vscode link either
+        run = _run(conf_type="dev-environment")
+        att = await attach_mod.attach(run)
+        assert att.ide_url is None
+        att.close()
+
+    async def test_remote_dev_env_tunnel_config_and_ide_url(
+        self, ssh_dir, monkeypatch
+    ):
+        opened = {}
+
+        class FakeTunnel:
+            def __init__(self, **kw):
+                opened.update(kw)
+                self._proc = None
+
+            async def open(self, timeout=30.0):
+                pass
+
+            def close(self):
+                opened["closed"] = True
+
+        monkeypatch.setattr(attach_mod, "SSHTunnel", FakeTunnel)
+        run = _run(
+            backend="gcp",
+            hostname="10.0.0.5",
+            app_specs=[AppSpec(port=8000, app_name="app0")],
+            conf_type="dev-environment",
+        )
+        att = await attach_mod.attach(run)
+        assert att.ide_url and att.ide_url.startswith("vscode://vscode-remote/")
+        assert att.ssh_host == "myrun"
+        assert opened["host"] == "10.0.0.5"
+        assert 8000 in att.ports
+        text = attach_mod.SSH_CONFIG.read_text()
+        assert "Host myrun" in text and "ProxyJump root@10.0.0.5:22" in text
+        att.close()
+        assert opened.get("closed") is True
+        assert "Host myrun" not in attach_mod.SSH_CONFIG.read_text()
