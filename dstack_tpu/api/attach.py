@@ -24,6 +24,7 @@ from dstack_tpu.utils.crypto import generate_rsa_key_pair_bytes
 DTPU_DIR = Path.home() / ".dstack_tpu"
 SSH_DIR = DTPU_DIR / "ssh"
 SSH_CONFIG = SSH_DIR / "config"
+MAIN_SSH_DIR = Path.home() / ".ssh"
 CONTAINER_SSH_PORT = 10022
 
 
@@ -35,10 +36,44 @@ def get_or_create_client_keypair() -> tuple[Path, str]:
     pub_file = SSH_DIR / "id_ed25519.pub"
     if not key_file.exists():
         private, public = generate_rsa_key_pair_bytes(comment="dtpu-client")
+        key_file.touch(mode=0o600)  # no world-readable window
         key_file.write_text(private)
         key_file.chmod(0o600)
         pub_file.write_text(public)
+    elif not pub_file.exists():
+        # recover the public half from the private key
+        from cryptography.hazmat.primitives import serialization
+
+        key = serialization.load_ssh_private_key(
+            key_file.read_bytes(), password=None
+        )
+        public = (
+            key.public_key()
+            .public_bytes(
+                encoding=serialization.Encoding.OpenSSH,
+                format=serialization.PublicFormat.OpenSSH,
+            )
+            .decode()
+            + " dtpu-client\n"
+        )
+        pub_file.write_text(public)
     return key_file, pub_file.read_text().strip()
+
+
+def ensure_ssh_config_include() -> None:
+    """Make `ssh <run-name>` and VS Code Remote-SSH resolve our entries:
+    default ssh config resolution must Include ~/.dstack_tpu/ssh/config
+    (the reference SSHAttach patches ~/.ssh/config the same way)."""
+    main_dir = MAIN_SSH_DIR
+    main_dir.mkdir(mode=0o700, exist_ok=True)
+    main_config = main_dir / "config"
+    include_line = f"Include {SSH_CONFIG}"
+    text = main_config.read_text() if main_config.exists() else ""
+    if include_line in text:
+        return
+    # Include must appear before any Host block to apply globally
+    main_config.write_text(f"{include_line}\n{text}")
+    main_config.chmod(0o600)
 
 
 def _ssh_config_entry(
@@ -151,11 +186,22 @@ async def attach(run: Run, local_backend_direct: bool = True) -> RunAttachment:
             local = find_free_port()
         forwards[local] = h
         att.ports[c] = local
+    # The tunnel targets the *container's* sshd (port 10022, on the host
+    # with host networking, or the mapped host port when bridged) — the
+    # client key is authorized inside the container, not on the VM
+    # (reference attach reaches container sshd the same way).
+    sub = run.jobs[0].latest
+    runtime_ports = (sub.job_runtime_data.ports or {}) if sub.job_runtime_data else {}
+    container_ssh_port = int(
+        runtime_ports.get(CONTAINER_SSH_PORT)
+        or runtime_ports.get(str(CONTAINER_SSH_PORT))
+        or CONTAINER_SSH_PORT
+    )
     proxy = jpd.get("ssh_proxy")
     tunnel = SSHTunnel(
         host=jpd["hostname"],
-        username=jpd.get("username", "root"),
-        port=jpd.get("ssh_port", 22),
+        username="root",
+        port=container_ssh_port,
         identity_file=str(key_file),
         proxy=None if proxy is None else _proxy_params(proxy),
         forwards=forwards,
@@ -163,17 +209,17 @@ async def attach(run: Run, local_backend_direct: bool = True) -> RunAttachment:
     await tunnel.open()
     att.tunnel = tunnel
 
-    # `ssh <run-name>` → in-container sshd, jumping through the host
-    jump = f"{jpd.get('username', 'root')}@{jpd['hostname']}:{jpd.get('ssh_port', 22)}"
+    # `ssh <run-name>` → the same container sshd; Include-linked into
+    # ~/.ssh/config so plain ssh and VS Code Remote-SSH both resolve it
     entry = _ssh_config_entry(
         run_name,
         jpd["hostname"],
         "root",
-        CONTAINER_SSH_PORT,
+        container_ssh_port,
         key_file,
-        proxy_jump=jump,
     )
     update_ssh_config(run_name, entry)
+    ensure_ssh_config_include()
     att.ssh_host = run_name
 
     # IDE link only once `ssh <run-name>` actually resolves

@@ -29,6 +29,7 @@ async def create_app(
     default_project: Optional[str] = None,
     with_background: bool = True,
     local_backend: bool = True,
+    apply_server_config: bool = False,
 ) -> web.Application:
     db = Database(database_url or settings.DATABASE_URL)
     await db.connect()
@@ -51,7 +52,21 @@ async def create_app(
                 db, project_row, BackendType.LOCAL, {}
             )
 
-    state = {"db": db, "admin_token": admin.creds["token"] if admin.creds else None}
+    config_manager = None
+    if apply_server_config:
+        from dstack_tpu.server.services.config import ServerConfigManager
+
+        config_manager = ServerConfigManager()
+        try:
+            await config_manager.apply(db, admin_row)
+        except Exception as e:
+            logger.warning("server config.yml not applied: %s", e)
+
+    state = {
+        "db": db,
+        "admin_token": admin.creds["token"] if admin.creds else None,
+        "config_manager": config_manager,
+    }
     app = build_app(ALL_ROUTERS, state, auth_dependency=auth_dependency)
     register_proxy_routes(app)
 
@@ -95,7 +110,9 @@ async def run_server(
     import asyncio
 
     configure_logging()
-    app = await create_app(database_url=database_url, admin_token=admin_token)
+    app = await create_app(
+        database_url=database_url, admin_token=admin_token, apply_server_config=True
+    )
     runner = web.AppRunner(app)
     await runner.setup()
     host = host or settings.SERVER_HOST

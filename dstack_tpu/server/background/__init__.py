@@ -26,6 +26,9 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
     from dstack_tpu.server.background.tasks.process_prometheus_metrics import (
         collect_prometheus_metrics,
     )
+    from dstack_tpu.server.background.tasks.process_placement_groups import (
+        process_placement_groups,
+    )
     from dstack_tpu.server.background.tasks.process_volumes import process_volumes
 
     sched = BackgroundScheduler()
@@ -36,6 +39,7 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
     sched.add(lambda: process_instances(db), 2.0, "process_instances")
     sched.add(lambda: process_fleets(db), 10.0, "process_fleets")
     sched.add(lambda: process_volumes(db), 10.0, "process_volumes")
+    sched.add(lambda: process_placement_groups(db), 30.0, "process_placement_groups")
     sched.add(lambda: process_gateways(db), 5.0, "process_gateways")
     sched.add(lambda: collect_metrics(db), 10.0, "collect_metrics")
     from dstack_tpu.server import settings

@@ -17,7 +17,12 @@ async def process_fleets(db: Database) -> None:
         "AND NOT EXISTS (SELECT 1 FROM runs r WHERE r.fleet_id = f.id AND r.deleted = 0 "
         "  AND r.status NOT IN ('terminated','failed','done'))"
     )
+    from dstack_tpu.server.services.placement import (
+        schedule_fleet_placement_cleanup,
+    )
+
     for row in rows:
+        await schedule_fleet_placement_cleanup(db, row["id"])
         await db.update_by_id(
             "fleets",
             row["id"],

@@ -55,6 +55,38 @@ async def _process(db: Database, instance_id: str) -> None:
         await _terminate(db, row)
 
 
+async def _fleet_placement_group(
+    db: Database, project_row: dict, row: dict, compute, offer
+):
+    """Cluster-placement fleets get a placement group on backends that
+    support one (TPU slices don't need it — topology is the placement)."""
+    fleet_id = row.get("fleet_id")
+    if not fleet_id:
+        return None
+    fleet_row = await db.get_by_id("fleets", fleet_id)
+    if fleet_row is None:
+        return None
+    spec = loads(fleet_row.get("spec")) or {}
+    placement = ((spec.get("configuration") or {}).get("placement")) or "any"
+    if placement != "cluster":
+        return None
+    from dstack_tpu.server.services.placement import prepare_placement_group
+
+    try:
+        return await prepare_placement_group(
+            db,
+            project_row,
+            fleet_id,
+            fleet_row["name"],
+            compute,
+            offer.backend,
+            offer.region,
+        )
+    except Exception as e:
+        logger.warning("placement group for fleet %s failed: %s", fleet_row["name"], e)
+        return None
+
+
 async def _provision(db: Database, row: dict) -> None:
     """Fleet-created instances start at PENDING and are provisioned here
     (job-driven instances are provisioned in process_submitted_jobs)."""
@@ -81,6 +113,9 @@ async def _provision(db: Database, row: dict) -> None:
     project_key = await projects_service.get_project_ssh_public_key(
         db, project_row["id"]
     )
+    placement_group_name = await _fleet_placement_group(
+        db, project_row, row, compute, offer
+    )
     try:
         jpd = await compute.create_instance(
             offer,
@@ -88,6 +123,7 @@ async def _provision(db: Database, row: dict) -> None:
                 project_name=project_row["name"],
                 instance_name=row["name"],
                 ssh_public_keys=[project_key] if project_key else [],
+                placement_group_name=placement_group_name,
             ),
         )
     except Exception as e:

@@ -1,0 +1,13 @@
+"""Delete stale placement groups.
+
+Parity: reference background/tasks/process_placement_groups.py (30s
+loop: groups whose fleet was deleted are removed from the cloud, with
+retries on failure).
+"""
+
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.services.placement import delete_stale_placement_groups
+
+
+async def process_placement_groups(db: Database) -> None:
+    await delete_stale_placement_groups(db)

@@ -140,6 +140,7 @@ async def create_backend(ctx: RequestContext, body: s.CreateBackendRequest):
         db, ctx.project, ctx.user, require_role=ProjectRole.ADMIN
     )
     await backends_service.create_backend(db, ctx.project, body.type, body.config)
+    await _writeback_server_config(ctx)
 
 
 @project_router.post("/backends/delete")
@@ -149,6 +150,18 @@ async def delete_backends(ctx: RequestContext, body: s.DeleteBackendsRequest):
         db, ctx.project, ctx.user, require_role=ProjectRole.ADMIN
     )
     await backends_service.delete_backends(db, ctx.project, body.types)
+    await _writeback_server_config(ctx)
+
+
+async def _writeback_server_config(ctx: RequestContext) -> None:
+    """Keep config.yml in sync with API-side backend changes so the next
+    restart's config apply doesn't wipe them."""
+    mgr = ctx.state.get("config_manager")
+    if mgr is not None:
+        try:
+            await mgr.sync_from_db(ctx.state["db"])
+        except Exception:
+            pass
 
 
 @project_router.post("/backends/list")

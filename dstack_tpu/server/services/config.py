@@ -1,0 +1,143 @@
+"""Server config.yml ⇄ DB sync.
+
+Parity: reference ``ServerConfigManager`` (server/services/config.py:81-213):
+the second tier of the 3-tier config system (SURVEY.md §5) — a YAML file
+at ``~/.dtpu/server/config.yml`` declaring projects and their backends,
+applied to the DB on every server start; a default file is written on
+first boot so users have something to edit.
+
+Schema:
+
+    projects:
+      - name: main
+        backends:
+          - type: gcp
+            project_id: my-gcp-project
+            regions: [us-central2]
+      - name: research
+        backends: []
+    encryption:
+      keys: []          # documented; active keys come from env
+"""
+
+from pathlib import Path
+from typing import Optional
+
+import yaml
+
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.server.db import Database
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.config")
+
+DEFAULT_CONFIG = """\
+# dstack-tpu server configuration (applied to the DB on every start).
+# Reference: `dstack server` config.yml.
+projects:
+  - name: main
+    backends: []
+#      - type: gcp
+#        project_id: my-gcp-project
+#        regions: [us-central2]
+"""
+
+
+class ServerConfigManager:
+    def __init__(self, path: Optional[Path] = None):
+        from dstack_tpu.server import settings
+
+        self.path = path or settings.SERVER_CONFIG_PATH
+
+    def load(self) -> Optional[dict]:
+        """Parsed config, or None when the file doesn't exist."""
+        if not self.path.exists():
+            return None
+        data = yaml.safe_load(self.path.read_text()) or {}
+        if not isinstance(data, dict):
+            raise ValueError(f"{self.path}: top level must be a mapping")
+        return data
+
+    def write_default(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.path.write_text(DEFAULT_CONFIG)
+        logger.info("wrote default server config to %s", self.path)
+
+    async def sync_from_db(self, db: Database) -> None:
+        """DB → file write-back after API-side backend changes, so the
+        next restart's apply() doesn't wipe them (the reference keeps
+        config.yml and DB in both-way sync, config.py:81-213)."""
+        from dstack_tpu.server.db import loads
+
+        projects = []
+        rows = await db.fetchall(
+            "SELECT * FROM projects WHERE deleted = 0 ORDER BY created_at"
+        )
+        for prow in rows:
+            backends = []
+            brows = await db.fetchall(
+                "SELECT * FROM backends WHERE project_id = ? ORDER BY type",
+                (prow["id"],),
+            )
+            for brow in brows:
+                if brow["type"] == BackendType.LOCAL.value:
+                    continue  # managed by the server itself
+                backends.append({"type": brow["type"], **(loads(brow["config"]) or {})})
+            projects.append({"name": prow["name"], "backends": backends})
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.path.write_text(
+            "# dstack-tpu server configuration (kept in sync with the DB).\n"
+            + yaml.safe_dump({"projects": projects}, sort_keys=False)
+        )
+
+    async def apply(self, db: Database, admin_row: dict) -> None:
+        """Sync file → DB: create declared projects, upsert their
+        backends, remove backends no longer declared (projects are never
+        auto-deleted — reference behavior)."""
+        from dstack_tpu.server.services import backends as backends_service
+        from dstack_tpu.server.services import projects as projects_service
+
+        config = self.load()
+        if config is None:
+            self.write_default()
+            return
+        for pconf in config.get("projects") or []:
+            name = pconf.get("name")
+            if not name:
+                logger.warning("%s: project entry without name skipped", self.path)
+                continue
+            project_row = await projects_service.get_project_row(db, name)
+            if project_row is None:
+                await projects_service.create_project(db, admin_row, name)
+                project_row = await projects_service.get_project_row(db, name)
+                logger.info("config.yml: created project %s", name)
+            declared: set[str] = set()
+            for bconf in pconf.get("backends") or []:
+                btype_raw = (bconf or {}).get("type")
+                try:
+                    btype = BackendType(btype_raw)
+                except ValueError:
+                    logger.warning(
+                        "config.yml: unknown backend type %r in project %s",
+                        btype_raw,
+                        name,
+                    )
+                    continue
+                declared.add(btype.value)
+                cfg = {k: v for k, v in bconf.items() if k != "type"}
+                await backends_service.create_backend(db, project_row, btype, cfg)
+            # the local backend is managed by the server itself
+            declared.add(BackendType.LOCAL.value)
+            existing = await backends_service.list_backend_rows(db, project_row)
+            stale = [
+                BackendType(r["type"])
+                for r in existing
+                if r["type"] not in declared
+            ]
+            if stale:
+                await backends_service.delete_backends(db, project_row, stale)
+                logger.info(
+                    "config.yml: removed backends %s from project %s",
+                    [b.value for b in stale],
+                    name,
+                )

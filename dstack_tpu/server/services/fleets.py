@@ -165,6 +165,11 @@ async def delete_fleets(db: Database, project_row: dict, names: list[str]) -> No
                 InstanceStatus.TERMINATED.value,
             ),
         )
+        from dstack_tpu.server.services.placement import (
+            schedule_fleet_placement_cleanup,
+        )
+
+        await schedule_fleet_placement_cleanup(db, row["id"])
         await db.update_by_id(
             "fleets",
             row["id"],

@@ -1,0 +1,132 @@
+"""Placement-group service.
+
+Parity: reference server/services/placement.py +
+``ComputeWithPlacementGroupSupport`` (base/compute.py:219-243). On TPU
+the ICI topology *is* the placement group (SURVEY.md §2.6) — TPU slices
+never need one — so this service only engages for backends that
+explicitly support cloud placement groups (GCE CPU nodes, future mixed
+fleets).
+"""
+
+from typing import Optional
+
+from dstack_tpu.backends.base.compute import ComputeWithPlacementGroupSupport
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.placement import (
+    PlacementGroupConfiguration,
+    PlacementGroupProvisioningData,
+    PlacementStrategy,
+)
+from dstack_tpu.core.models.runs import new_uuid, now_utc
+from dstack_tpu.server.db import Database, dumps, loads
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.placement")
+
+
+async def prepare_placement_group(
+    db: Database,
+    project_row: dict,
+    fleet_id: Optional[str],
+    fleet_name: str,
+    compute,
+    backend: BackendType,
+    region: str,
+) -> Optional[str]:
+    """Ensure one placement group per (fleet, region); returns its name
+    for ``InstanceConfiguration.placement_group_name`` or None when the
+    backend has no placement-group concept."""
+    if not isinstance(compute, ComputeWithPlacementGroupSupport):
+        return None
+    # one live group per (fleet, region); fleet_deleted rows are doomed —
+    # a recreated same-name fleet must NOT reuse them (the reconciler is
+    # about to delete their cloud resource)
+    existing = await db.fetchone(
+        "SELECT id, name FROM placement_groups "
+        "WHERE fleet_id = ? AND json_extract(configuration, '$.region') = ? "
+        "AND deleted = 0 AND fleet_deleted = 0",
+        (fleet_id, region),
+    )
+    if existing is not None:
+        return existing["name"]
+    name = f"{fleet_name}-{region}-{new_uuid()[:6]}-pg"
+    backend_data = await compute.create_placement_group(name, region)
+    await db.insert(
+        "placement_groups",
+        {
+            "id": new_uuid(),
+            "project_id": project_row["id"],
+            "fleet_id": fleet_id,
+            "name": name,
+            "configuration": dumps(
+                PlacementGroupConfiguration(
+                    backend=backend,
+                    region=region,
+                    placement_strategy=PlacementStrategy.CLUSTER,
+                ).model_dump()
+            ),
+            "provisioning_data": dumps(
+                PlacementGroupProvisioningData(
+                    backend=backend, backend_data=backend_data
+                ).model_dump()
+            ),
+            "fleet_deleted": 0,
+            "deleted": 0,
+            "created_at": now_utc().isoformat(),
+        },
+    )
+    logger.info("created placement group %s (%s/%s)", name, backend.value, region)
+    return name
+
+
+async def schedule_fleet_placement_cleanup(db: Database, fleet_id: str) -> None:
+    """Mark the fleet's placement groups for deletion; the
+    process_placement_groups reconciler tears them down (reference
+    process_placement_groups.py: groups outlive instances briefly)."""
+    await db.execute(
+        "UPDATE placement_groups SET fleet_deleted = 1 WHERE fleet_id = ?",
+        (fleet_id,),
+    )
+
+
+async def delete_stale_placement_groups(db: Database) -> None:
+    """Reconciler body: delete backend resources for groups whose fleet
+    is gone (reference background/tasks/process_placement_groups.py)."""
+    from dstack_tpu.server.services import backends as backends_service
+
+    rows = await db.fetchall(
+        "SELECT * FROM placement_groups WHERE fleet_deleted = 1 AND deleted = 0 "
+        "LIMIT 10"
+    )
+    for row in rows:
+        conf_raw = loads(row["configuration"]) or {}
+        pd_raw = loads(row.get("provisioning_data")) or {}
+        try:
+            conf = PlacementGroupConfiguration.model_validate(conf_raw)
+        except Exception:
+            await db.update_by_id("placement_groups", row["id"], {"deleted": 1})
+            continue
+        project_row = await db.get_by_id("projects", row["project_id"])
+        if project_row is None:
+            await db.update_by_id("placement_groups", row["id"], {"deleted": 1})
+            continue
+        try:
+            compute = await backends_service.get_project_backend(
+                db, project_row, conf.backend
+            )
+        except Exception:
+            compute = None
+        if isinstance(compute, ComputeWithPlacementGroupSupport):
+            try:
+                await compute.delete_placement_group(
+                    row["name"], conf.region, pd_raw.get("backend_data") or ""
+                )
+            except Exception as e:
+                logger.warning(
+                    "placement group %s deletion failed (will retry): %s",
+                    row["name"],
+                    e,
+                )
+                continue
+        await db.update_by_id("placement_groups", row["id"], {"deleted": 1})
+        logger.info("deleted placement group %s", row["name"])

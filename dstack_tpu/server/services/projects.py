@@ -63,10 +63,16 @@ async def create_project(db: Database, user_row: dict, name: str, is_public: boo
     return await get_project(db, name)
 
 
+_identity_cache: dict[str, str] = {}  # project_id → key file path
+
+
 async def get_project_ssh_identity(db: Database, project_id: str) -> Optional[str]:
     """Path to the project's private key on disk (0600, cached per
     project) — the identity the server's shim/runner tunnels use.
     Pre-0002 projects without a key get one lazily."""
+    cached = _identity_cache.get(project_id)
+    if cached is not None:
+        return cached
     from dstack_tpu.server import settings
     from dstack_tpu.utils.crypto import generate_rsa_key_pair_bytes
 
@@ -90,6 +96,7 @@ async def get_project_ssh_identity(db: Database, project_id: str) -> Optional[st
         key_file.touch(mode=0o600)
         key_file.write_text(private)
         key_file.chmod(0o600)
+    _identity_cache[project_id] = str(key_file)
     return str(key_file)
 
 

@@ -32,6 +32,7 @@ def ssh_dir(tmp_path, monkeypatch):
     monkeypatch.setattr(attach_mod, "DTPU_DIR", tmp_path)
     monkeypatch.setattr(attach_mod, "SSH_DIR", tmp_path / "ssh")
     monkeypatch.setattr(attach_mod, "SSH_CONFIG", tmp_path / "ssh" / "config")
+    monkeypatch.setattr(attach_mod, "MAIN_SSH_DIR", tmp_path / "main_ssh")
     return tmp_path / "ssh"
 
 
@@ -188,10 +189,16 @@ class TestAttach:
         att = await attach_mod.attach(run)
         assert att.ide_url and att.ide_url.startswith("vscode://vscode-remote/")
         assert att.ssh_host == "myrun"
+        # the tunnel and ssh entry target the container's sshd directly
         assert opened["host"] == "10.0.0.5"
+        assert opened["port"] == attach_mod.CONTAINER_SSH_PORT
+        assert opened["username"] == "root"
         assert 8000 in att.ports
         text = attach_mod.SSH_CONFIG.read_text()
-        assert "Host myrun" in text and "ProxyJump root@10.0.0.5:22" in text
+        assert "Host myrun" in text and f"Port {attach_mod.CONTAINER_SSH_PORT}" in text
+        # our entries are Include-linked into the user's main ssh config
+        main = (attach_mod.MAIN_SSH_DIR / "config").read_text()
+        assert main.startswith(f"Include {attach_mod.SSH_CONFIG}")
         att.close()
         assert opened.get("closed") is True
         assert "Host myrun" not in attach_mod.SSH_CONFIG.read_text()

@@ -1,0 +1,106 @@
+"""Server config.yml ⇄ DB sync (reference ServerConfigManager,
+server/services/config.py:81-213)."""
+
+from pathlib import Path
+
+from dstack_tpu.server.db import Database, loads
+from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import projects as projects_service
+from dstack_tpu.server.services import users as users_service
+from dstack_tpu.server.services.config import ServerConfigManager
+
+
+async def _db() -> Database:
+    db = Database("sqlite://:memory:")
+    await db.connect()
+    await db.migrate()
+    return db
+
+
+async def _admin(db):
+    await users_service.get_or_create_admin(db, "tok")
+    return await users_service.get_user_by_name(db, "admin")
+
+
+class TestServerConfigManager:
+    async def test_default_written_when_missing(self, tmp_path):
+        db = await _db()
+        admin = await _admin(db)
+        path = Path(tmp_path) / "config.yml"
+        mgr = ServerConfigManager(path)
+        await mgr.apply(db, admin)
+        assert path.exists()
+        assert "projects:" in path.read_text()
+        await db.close()
+
+    async def test_projects_and_backends_synced(self, tmp_path):
+        db = await _db()
+        admin = await _admin(db)
+        path = Path(tmp_path) / "config.yml"
+        path.write_text(
+            "projects:\n"
+            "  - name: alpha\n"
+            "    backends:\n"
+            "      - type: gcp\n"
+            "        project_id: my-proj\n"
+            "        regions: [us-central2]\n"
+            "  - name: beta\n"
+        )
+        await ServerConfigManager(path).apply(db, admin)
+        for name in ("alpha", "beta"):
+            row = await projects_service.get_project_row(db, name)
+            assert row is not None, name
+        alpha = await projects_service.get_project_row(db, "alpha")
+        rows = await backends_service.list_backend_rows(db, alpha)
+        assert [r["type"] for r in rows] == ["gcp"]
+        assert loads(rows[0]["config"])["project_id"] == "my-proj"
+
+        # re-apply with the backend removed → deleted from DB
+        path.write_text("projects:\n  - name: alpha\n    backends: []\n")
+        await ServerConfigManager(path).apply(db, admin)
+        rows = await backends_service.list_backend_rows(db, alpha)
+        assert rows == []
+        await db.close()
+
+    async def test_writeback_preserves_api_backends_across_restart(self, tmp_path):
+        """Backends added via the API survive a restart because the file
+        is rewritten from the DB (reference two-way sync)."""
+        db = await _db()
+        admin = await _admin(db)
+        path = Path(tmp_path) / "config.yml"
+        mgr = ServerConfigManager(path)
+        await mgr.apply(db, admin)  # writes default file
+
+        # simulate API-side backend creation + write-back
+        await users_service.get_or_create_admin(db, "tok")
+        project = await projects_service.create_project(db, admin, "apiproj")
+        project_row = await projects_service.get_project_row(db, "apiproj")
+        from dstack_tpu.core.models.backends import BackendType
+
+        await backends_service.create_backend(
+            db, project_row, BackendType.GCP, {"project_id": "p1"}
+        )
+        await mgr.sync_from_db(db)
+        text = path.read_text()
+        assert "apiproj" in text and "gcp" in text
+
+        # restart: apply the rewritten file → backend still there
+        await ServerConfigManager(path).apply(db, admin)
+        rows = await backends_service.list_backend_rows(db, project_row)
+        assert [r["type"] for r in rows] == ["gcp"]
+        await db.close()
+
+    async def test_unknown_backend_type_skipped(self, tmp_path):
+        db = await _db()
+        admin = await _admin(db)
+        path = Path(tmp_path) / "config.yml"
+        path.write_text(
+            "projects:\n"
+            "  - name: gamma\n"
+            "    backends:\n"
+            "      - type: warp-drive\n"
+        )
+        await ServerConfigManager(path).apply(db, admin)  # must not raise
+        row = await projects_service.get_project_row(db, "gamma")
+        assert row is not None
+        await db.close()

@@ -1,0 +1,146 @@
+"""Placement-group lifecycle: created for cluster fleets on supporting
+backends, skipped otherwise, deleted by the reconciler after fleet
+deletion (reference process_placement_groups.py, base/compute.py:219-243).
+"""
+
+from dstack_tpu.backends.base.compute import ComputeWithPlacementGroupSupport
+from dstack_tpu.core.models.configurations import FleetConfiguration
+from dstack_tpu.core.models.instances import InstanceStatus
+from dstack_tpu.server.background.tasks.process_instances import process_instances
+from dstack_tpu.server.background.tasks.process_placement_groups import (
+    process_placement_groups,
+)
+from dstack_tpu.server.services import fleets as fleets_service
+from dstack_tpu.server.testing.common import (
+    FakeCompute,
+    create_test_db,
+    create_test_project,
+    create_test_user,
+    install_fake_backend,
+    tpu_offer,
+)
+
+
+class FakePlacementCompute(FakeCompute, ComputeWithPlacementGroupSupport):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.pg_created: list[tuple[str, str]] = []
+        self.pg_deleted: list[tuple[str, str, str]] = []
+        self.fail_pg_delete = False
+
+    async def create_placement_group(self, name: str, region: str) -> str:
+        self.pg_created.append((name, region))
+        return f"pg-data-{name}"
+
+    async def delete_placement_group(
+        self, name: str, region: str, backend_data: str
+    ) -> None:
+        if self.fail_pg_delete:
+            raise RuntimeError("cloud hiccup")
+        self.pg_deleted.append((name, region, backend_data))
+
+
+async def _setup(compute):
+    db = await create_test_db()
+    _, user_row = await create_test_user(db)
+    project_row = await create_test_project(db, user_row)
+    install_fake_backend(project_row, compute)
+    return db, user_row, project_row
+
+
+def _cluster_fleet_conf(name="pgfleet"):
+    return FleetConfiguration.model_validate(
+        {
+            "type": "fleet",
+            "name": name,
+            "placement": "cluster",
+            "nodes": 2,
+            "resources": {"tpu": "v5e-8"},
+        }
+    )
+
+
+class TestPlacementGroups:
+    async def test_cluster_fleet_creates_group_once(self):
+        compute = FakePlacementCompute(offers=[tpu_offer()])
+        db, user_row, project_row = await _setup(compute)
+        await fleets_service.apply_fleet(
+            db, project_row, user_row, _cluster_fleet_conf()
+        )
+        # both pending instances provision through the same group
+        for _ in range(2):
+            await process_instances(db)
+        assert len(compute.pg_created) == 1
+        assert compute.pg_created[0][0].startswith("pgfleet-")
+        for cfg in compute.created:
+            assert cfg.placement_group_name == compute.pg_created[0][0]
+        rows = await db.fetchall("SELECT * FROM placement_groups")
+        assert len(rows) == 1 and rows[0]["deleted"] == 0
+        await db.close()
+
+    async def test_any_placement_skips_group(self):
+        compute = FakePlacementCompute(offers=[tpu_offer()])
+        db, user_row, project_row = await _setup(compute)
+        conf = _cluster_fleet_conf("anyfleet")
+        conf.placement = "any"
+        await fleets_service.apply_fleet(db, project_row, user_row, conf)
+        await process_instances(db)
+        assert compute.pg_created == []
+        await db.close()
+
+    async def test_unsupporting_backend_skips_group(self):
+        compute = FakeCompute(offers=[tpu_offer()])  # no placement mixin
+        db, user_row, project_row = await _setup(compute)
+        await fleets_service.apply_fleet(
+            db, project_row, user_row, _cluster_fleet_conf("nopg")
+        )
+        await process_instances(db)
+        rows = await db.fetchall("SELECT * FROM placement_groups")
+        assert rows == []
+        assert compute.created and compute.created[0].placement_group_name is None
+        await db.close()
+
+    async def test_fleet_delete_triggers_group_deletion(self):
+        compute = FakePlacementCompute(offers=[tpu_offer()])
+        db, user_row, project_row = await _setup(compute)
+        await fleets_service.apply_fleet(
+            db, project_row, user_row, _cluster_fleet_conf()
+        )
+        for _ in range(2):
+            await process_instances(db)
+        # release instances so the fleet can be deleted
+        await db.execute(
+            "UPDATE instances SET status = ?", (InstanceStatus.IDLE.value,)
+        )
+        await fleets_service.delete_fleets(db, project_row, ["pgfleet"])
+        row = (await db.fetchall("SELECT * FROM placement_groups"))[0]
+        assert row["fleet_deleted"] == 1 and row["deleted"] == 0
+
+        await process_placement_groups(db)
+        row = (await db.fetchall("SELECT * FROM placement_groups"))[0]
+        assert row["deleted"] == 1
+        assert compute.pg_deleted == [
+            (row["name"], "us-central1", f"pg-data-{row['name']}")
+        ]
+        await db.close()
+
+    async def test_deletion_failure_retries(self):
+        compute = FakePlacementCompute(offers=[tpu_offer()])
+        db, user_row, project_row = await _setup(compute)
+        await fleets_service.apply_fleet(
+            db, project_row, user_row, _cluster_fleet_conf()
+        )
+        await process_instances(db)
+        await db.execute(
+            "UPDATE instances SET status = ?", (InstanceStatus.IDLE.value,)
+        )
+        await fleets_service.delete_fleets(db, project_row, ["pgfleet"])
+        compute.fail_pg_delete = True
+        await process_placement_groups(db)
+        row = (await db.fetchall("SELECT * FROM placement_groups"))[0]
+        assert row["deleted"] == 0  # kept for retry
+        compute.fail_pg_delete = False
+        await process_placement_groups(db)
+        row = (await db.fetchall("SELECT * FROM placement_groups"))[0]
+        assert row["deleted"] == 1
+        await db.close()

@@ -87,7 +87,7 @@ class TestServiceE2E:
             await asyncio.sleep(1.0)  # service process boot
 
             # ingress through the in-server proxy (no auth needed)
-            for _ in range(20):
+            for _ in range(60):  # generous under full-suite load
                 r = await client.get("/proxy/services/main/echo-svc/hello")
                 if r.status == 200:
                     break
