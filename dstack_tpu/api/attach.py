@@ -137,8 +137,9 @@ class RunAttachment:
         update_ssh_config(self.run_name, None)
 
 
-def plan_attachment(run: Run) -> tuple[dict[int, int], Optional[dict]]:
-    """→ (container_port→host_port on the job host, jpd dict or None).
+def plan_attachment(run: Run) -> tuple[dict[int, int], Optional[dict], int]:
+    """→ (container_port→host_port on the job host, jpd dict,
+    container ssh port on the host).
 
     Pure planning half, separated for testability: decides which ports
     exist and where they currently live.
@@ -154,18 +155,19 @@ def plan_attachment(run: Run) -> tuple[dict[int, int], Optional[dict]]:
     if job_spec.service_port and job_spec.service_port not in container_ports:
         container_ports.append(job_spec.service_port)
     runtime_ports = (sub.job_runtime_data.ports or {}) if sub.job_runtime_data else {}
-    host_ports = {
-        int(c): int(runtime_ports.get(c) or runtime_ports.get(str(c)) or c)
-        for c in container_ports
-    }
-    return host_ports, jpd.model_dump()
+
+    def on_host(port: int) -> int:
+        return int(runtime_ports.get(port) or runtime_ports.get(str(port)) or port)
+
+    host_ports = {int(c): on_host(c) for c in container_ports}
+    return host_ports, jpd.model_dump(), on_host(CONTAINER_SSH_PORT)
 
 
 async def attach(run: Run, local_backend_direct: bool = True) -> RunAttachment:
     """Open the attachment: direct for local-backend runs, SSH tunnel
     otherwise. Desired local ports honor ``map_to_port`` (``ports:
     "8080:8000"``), falling back to a free port when taken."""
-    host_ports, jpd = plan_attachment(run)
+    host_ports, jpd, container_ssh_port = plan_attachment(run)
     run_name = run.run_spec.run_name or "run"
     job_spec = run.jobs[0].job_spec
     desired_local = {
@@ -190,13 +192,6 @@ async def attach(run: Run, local_backend_direct: bool = True) -> RunAttachment:
     # with host networking, or the mapped host port when bridged) — the
     # client key is authorized inside the container, not on the VM
     # (reference attach reaches container sshd the same way).
-    sub = run.jobs[0].latest
-    runtime_ports = (sub.job_runtime_data.ports or {}) if sub.job_runtime_data else {}
-    container_ssh_port = int(
-        runtime_ports.get(CONTAINER_SSH_PORT)
-        or runtime_ports.get(str(CONTAINER_SSH_PORT))
-        or CONTAINER_SSH_PORT
-    )
     proxy = jpd.get("ssh_proxy")
     tunnel = SSHTunnel(
         host=jpd["hostname"],
@@ -210,13 +205,22 @@ async def attach(run: Run, local_backend_direct: bool = True) -> RunAttachment:
     att.tunnel = tunnel
 
     # `ssh <run-name>` → the same container sshd; Include-linked into
-    # ~/.ssh/config so plain ssh and VS Code Remote-SSH both resolve it
+    # ~/.ssh/config so plain ssh and VS Code Remote-SSH both resolve it.
+    # A provisioning-data ssh_proxy must appear here too or the entry
+    # would dial a host the client can't reach directly.
+    jump = None
+    if proxy is not None:
+        jump = (
+            f"{proxy.get('username', 'root')}@{proxy['hostname']}"
+            f":{proxy.get('port', 22)}"
+        )
     entry = _ssh_config_entry(
         run_name,
         jpd["hostname"],
         "root",
         container_ssh_port,
         key_file,
+        proxy_jump=jump,
     )
     update_ssh_config(run_name, entry)
     ensure_ssh_config_include()

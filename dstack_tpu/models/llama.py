@@ -163,6 +163,20 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
+def _proj(
+    layer: dict, name: str, inp: jax.Array, eq: str, eq_a: str, eq_b: str
+) -> jax.Array:
+    """Base matmul + optional LoRA bypass: x·W + s·(x·A)·B.
+    The low-rank path stays unfused from W (two skinny matmuls) —
+    cheaper on MXU than materializing W+ΔW per step. One helper for all
+    seven adaptable projections."""
+    y = jnp.einsum(eq, inp, layer[name])
+    a, b = layer.get(f"{name}_lora_a"), layer.get(f"{name}_lora_b")
+    if a is not None and b is not None:
+        y = y + jnp.einsum(eq_b, jnp.einsum(eq_a, inp, a), b) * layer["lora_scale"]
+    return y
+
+
 def _attention_block(
     x: jax.Array,
     layer: dict,
@@ -176,9 +190,9 @@ def _attention_block(
     c = config
     b, t, _ = x.shape
     h = rms_norm(x, layer["attn_norm"], c.norm_eps)
-    q = jnp.einsum("bte,ed->btd", h, layer["wq"])
-    k = jnp.einsum("bte,ed->btd", h, layer["wk"])
-    v = jnp.einsum("bte,ed->btd", h, layer["wv"])
+    q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+    k = _proj(layer, "wk", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+    v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
     q = q.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -192,8 +206,8 @@ def _attention_block(
     else:
         o = attention(q, k, v, causal=True, impl=attn_impl)
     o = o.transpose(0, 2, 1, 3).reshape(b, t, c.q_dim)
-    o = jnp.einsum("btd,de->bte", o, layer["wo"])
-    return constrain(o, rules, "batch", "seq", None, mesh=mesh)
+    out = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+    return constrain(out, rules, "batch", "seq", None, mesh=mesh)
 
 
 def _mlp_block(
@@ -204,10 +218,12 @@ def _mlp_block(
     rules: ShardingRules,
 ) -> jax.Array:
     h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
-    g = jnp.einsum("bte,ef->btf", h, layer["w_gate"])
-    u = jnp.einsum("bte,ef->btf", h, layer["w_up"])
+    g = _proj(layer, "w_gate", h, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+    u = _proj(layer, "w_up", h, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
     g = constrain(g, rules, "batch", "seq", "mlp", mesh=mesh)
-    o = jnp.einsum("btf,fe->bte", jax.nn.silu(g) * u, layer["w_down"])
+    o = _proj(
+        layer, "w_down", jax.nn.silu(g) * u, "btf,fe->bte", "btf,fr->btr", "btr,re->bte"
+    )
     return constrain(o, rules, "batch", "seq", None, mesh=mesh)
 
 
@@ -219,8 +235,15 @@ def forward(
     rules: Optional[ShardingRules] = None,
     attn_impl: Optional[str] = None,
     positions: Optional[jax.Array] = None,
+    lora: Optional[dict] = None,
+    lora_scale: float = 1.0,
 ) -> jax.Array:
-    """Token ids → logits [B, T, vocab] (f32)."""
+    """Token ids → logits [B, T, vocab] (f32).
+
+    ``lora`` is an adapter pytree from train/lora.py: stacked per-layer
+    low-rank factors scanned together with the base weights — the
+    adapters ride the same lax.scan, so XLA sees one fused layer body.
+    """
     c = config
     rules = rules or default_rules()
     x = params["embed"].at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
@@ -238,7 +261,15 @@ def forward(
         layer_fn = jax.checkpoint(
             layer_fn, policy=jax.checkpoint_policies.nothing_saveable
         )
-    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    xs = params["layers"]
+    if lora is not None:
+        L = c.n_layers
+        xs = {
+            **xs,
+            **lora["layers"],
+            "lora_scale": jnp.full((L,), lora_scale, c.dtype),
+        }
+    x, _ = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bte,ev->btv", x, head.astype(c.dtype))

@@ -21,7 +21,10 @@ from dstack_tpu.server.services import projects as projects_service
 from dstack_tpu.server.services import runs as runs_service
 from dstack_tpu.server.services import users as users_service
 from dstack_tpu.server.services.logs import get_log_storage
+from dstack_tpu.utils.logging import get_logger
 from dstack_tpu.version import __version__
+
+logger = get_logger("server.routers")
 
 server_router = Router("/api/server")
 users_router = Router("/api/users")
@@ -161,7 +164,9 @@ async def _writeback_server_config(ctx: RequestContext) -> None:
         try:
             await mgr.sync_from_db(ctx.state["db"])
         except Exception:
-            pass
+            # a silent failure here would let the next restart's config
+            # apply wipe the backend just created — make it loud
+            logger.exception("config.yml write-back failed; fix %s", mgr.path)
 
 
 @project_router.post("/backends/list")

@@ -72,7 +72,11 @@ async def get_project_ssh_identity(db: Database, project_id: str) -> Optional[st
     Pre-0002 projects without a key get one lazily."""
     cached = _identity_cache.get(project_id)
     if cached is not None:
-        return cached
+        from pathlib import Path as _Path
+
+        if _Path(cached).exists():
+            return cached
+        _identity_cache.pop(project_id, None)  # key file removed/rotated
     from dstack_tpu.server import settings
     from dstack_tpu.utils.crypto import generate_rsa_key_pair_bytes
 

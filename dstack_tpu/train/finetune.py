@@ -1,0 +1,177 @@
+"""Runnable fine-tune driver: ``python -m dstack_tpu.train.finetune``.
+
+The entrypoint the framework's own example configs execute on TPU slices
+(examples/llama-finetune-v5e.yaml; BASELINE.md config "Llama-3-8B LoRA
+on v5litepod-8"). The reference ships fine-tuning only as user examples
+(reference examples/fine-tuning/); here the driver is part of the
+framework so provisioning → first-train-step latency can be measured
+end-to-end.
+
+Multi-host: when the runner injects the JAX coordinator env
+(agent/python/runner.py cluster_env), ``jax.distributed.initialize()``
+picks it up and the same script spans the whole slice.
+
+Data: synthetic token stream by default (zero-egress friendly); pass
+``--data tokens.npy`` for a real pre-tokenized corpus.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama-3.2-1b")
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=8, help="global batch size")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--full", action="store_true", help="full fine-tune (no LoRA)")
+    p.add_argument("--lora-rank", type=int, default=16)
+    p.add_argument("--lora-alpha", type=float, default=32.0)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--fsdp", type=int, default=-1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--data", default=None, help="pre-tokenized .npy [N, T] corpus")
+    p.add_argument("--out", default="adapters", help="output dir for weights")
+    p.add_argument("--log-every", type=int, default=10)
+    args = p.parse_args(argv)
+
+    import jax
+
+    # join the slice-wide process group when the orchestrator provides one
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") and int(
+        os.environ.get("JAX_NUM_PROCESSES", "1")
+    ) > 1:
+        jax.distributed.initialize()
+
+    import jax.numpy as jnp
+
+    from dstack_tpu.models import llama
+    from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+    from dstack_tpu.train import lora as lora_mod
+    from dstack_tpu.train.step import (
+        default_optimizer,
+        flops_per_token,
+        make_train_step,
+        sharded_init,
+    )
+
+    config = llama.CONFIGS[args.model]
+    mesh = make_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, sp=args.sp, tp=args.tp))
+    n_chips = len(jax.devices())
+    print(
+        f"model={args.model} params={config.num_params() / 1e9:.2f}B "
+        f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} chips={n_chips}",
+        flush=True,
+    )
+
+    opt = default_optimizer(lr=args.lr, decay_steps=args.steps)
+    t0 = time.perf_counter()
+    if args.full:
+        state, _ = sharded_init(config, opt, mesh)
+        step_fn = make_train_step(config, opt, mesh)
+    else:
+        lora_conf = lora_mod.LoRAConfig(rank=args.lora_rank, alpha=args.lora_alpha)
+        params, state, _ = lora_mod.sharded_lora_init(config, lora_conf, opt, mesh)
+        step_fn = lora_mod.make_lora_train_step(config, lora_conf, opt, mesh)
+    print(f"init done in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    if args.data:
+        import numpy as np
+
+        corpus = np.load(args.data)
+        if corpus.shape[0] < args.batch:
+            p.error(
+                f"corpus has {corpus.shape[0]} rows < batch size {args.batch}"
+            )
+        if corpus.shape[1] < args.seq_len:
+            p.error(
+                f"corpus seq len {corpus.shape[1]} < requested {args.seq_len}"
+            )
+
+        def next_batch(i):
+            idx = (i * args.batch) % (corpus.shape[0] - args.batch + 1)
+            tok = jnp.asarray(corpus[idx : idx + args.batch, : args.seq_len])
+            return {
+                "tokens": tok,
+                "targets": jnp.roll(tok, -1, axis=1),
+                "mask": jnp.ones_like(tok),
+            }
+    else:
+
+        def next_batch(i):
+            tok = jax.random.randint(
+                jax.random.key(i), (args.batch, args.seq_len), 0, config.vocab_size
+            )
+            return {
+                "tokens": tok,
+                "targets": jnp.roll(tok, -1, axis=1),
+                "mask": jnp.ones_like(tok),
+            }
+
+    ftok = flops_per_token(config, args.seq_len)
+    tokens_per_step = args.batch * args.seq_len
+    first_step_at = None
+    t_window = time.perf_counter()
+    for i in range(args.steps):
+        batch = next_batch(i)
+        if args.full:
+            state, metrics = step_fn(state, batch)
+        else:
+            state, metrics = step_fn(params, state, batch)
+        if first_step_at is None:
+            jax.block_until_ready(metrics["loss"])
+            first_step_at = time.perf_counter()
+            # the provision→first-train-step latency marker the server
+            # scrapes from job logs (BASELINE.md target metric)
+            print(
+                json.dumps(
+                    {"event": "first_train_step", "t_unix": time.time()}
+                ),
+                flush=True,
+            )
+        if (i + 1) % args.log_every == 0:
+            loss = float(jax.device_get(metrics["loss"]))
+            dt = time.perf_counter() - t_window
+            t_window = time.perf_counter()
+            tps = tokens_per_step * args.log_every / dt
+            print(
+                f"step {i + 1}/{args.steps} loss={loss:.4f} "
+                f"tokens/s={tps:,.0f} tokens/s/chip={tps / n_chips:,.0f} "
+                f"mfu~{ftok * tps / n_chips / 197e12:.2%}",
+                flush=True,
+            )
+
+    if jax.process_index() == 0:
+        out = Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        import numpy as np
+
+        if args.full:
+            flat = {
+                "/".join(str(k.key) for k in path): np.asarray(jax.device_get(leaf))
+                for path, leaf in jax.tree_util.tree_leaves_with_path(
+                    state["params"]
+                )
+            }
+            flat["step"] = np.asarray(jax.device_get(state["step"]))
+            np.savez(out / "model_weights.npz", **flat)
+            print(f"weights saved to {out}/model_weights.npz", flush=True)
+        else:
+            flat = {
+                f"layers.{k}": np.asarray(jax.device_get(v))
+                for k, v in state["lora"]["layers"].items()
+            }
+            np.savez(out / "lora_adapters.npz", **flat)
+            print(f"adapters saved to {out}/lora_adapters.npz", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

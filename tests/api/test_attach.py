@@ -129,13 +129,13 @@ class TestPlanAttachment:
             app_specs=[AppSpec(port=8000, app_name="app0")],
             runtime_ports={8000: 32768},
         )
-        host_ports, jpd = attach_mod.plan_attachment(run)
+        host_ports, jpd, ssh_port = attach_mod.plan_attachment(run)
         assert host_ports == {8000: 32768}
         assert jpd["backend"] == "local"
 
     def test_service_port_included_host_networking(self):
         run = _run(service_port=9000)
-        host_ports, _ = attach_mod.plan_attachment(run)
+        host_ports, _, _ = attach_mod.plan_attachment(run)
         assert host_ports == {9000: 9000}
 
     def test_unprovisioned_raises(self):
