@@ -1,0 +1,102 @@
+"""Minimal Kubernetes REST client.
+
+The image bundles no kubernetes pip package, so this speaks the API
+server's REST surface directly over ``requests`` with bearer-token auth
+(the same calls kubectl makes). Only the endpoints the backend uses:
+nodes, pods, services.
+
+Parity: reference src/dstack/_internal/core/backends/kubernetes uses the
+official client for the same operations (list nodes, create pod +
+NodePort jump service).
+"""
+
+from typing import Any, Optional
+
+import requests
+
+from dstack_tpu.core.errors import BackendError
+
+
+class KubernetesAPIError(BackendError):
+    pass
+
+
+class KubernetesAPI:
+    def __init__(
+        self,
+        api_server: str,
+        token: str,
+        namespace: str = "default",
+        verify_ssl: bool = False,
+        ca_cert_path: Optional[str] = None,
+    ):
+        self.base = api_server.rstrip("/")
+        self.namespace = namespace
+        self._session = requests.Session()
+        self._session.headers["Authorization"] = f"Bearer {token}"
+        self._session.verify = ca_cert_path if ca_cert_path else verify_ssl
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        json_body: Optional[dict] = None,
+        ok_missing: bool = False,
+    ) -> Any:
+        resp = self._session.request(
+            method, self.base + path, json=json_body, timeout=30
+        )
+        if resp.status_code == 404 and ok_missing:
+            return None
+        if resp.status_code >= 400:
+            raise KubernetesAPIError(
+                f"{method} {path}: {resp.status_code} {resp.text[:300]}"
+            )
+        return resp.json()
+
+    # nodes
+
+    def list_nodes(self) -> list[dict]:
+        return self._request("GET", "/api/v1/nodes").get("items", [])
+
+    # pods
+
+    def create_pod(self, manifest: dict) -> dict:
+        return self._request(
+            "POST", f"/api/v1/namespaces/{self.namespace}/pods", manifest
+        )
+
+    def get_pod(self, name: str) -> Optional[dict]:
+        return self._request(
+            "GET",
+            f"/api/v1/namespaces/{self.namespace}/pods/{name}",
+            ok_missing=True,
+        )
+
+    def delete_pod(self, name: str) -> None:
+        self._request(
+            "DELETE",
+            f"/api/v1/namespaces/{self.namespace}/pods/{name}",
+            ok_missing=True,
+        )
+
+    # services
+
+    def create_service(self, manifest: dict) -> dict:
+        return self._request(
+            "POST", f"/api/v1/namespaces/{self.namespace}/services", manifest
+        )
+
+    def get_service(self, name: str) -> Optional[dict]:
+        return self._request(
+            "GET",
+            f"/api/v1/namespaces/{self.namespace}/services/{name}",
+            ok_missing=True,
+        )
+
+    def delete_service(self, name: str) -> None:
+        self._request(
+            "DELETE",
+            f"/api/v1/namespaces/{self.namespace}/services/{name}",
+            ok_missing=True,
+        )

@@ -1,0 +1,311 @@
+"""Kubernetes (GKE TPU) backend.
+
+Parity: reference core/backends/kubernetes (616 LoC: jobs as pods +
+jump-pod NodePort for reachability). TPU-first redesign: pods request
+``google.com/tpu`` resources and are pinned to GKE TPU node pools via
+the standard selectors (``cloud.google.com/gke-tpu-accelerator``,
+``cloud.google.com/gke-tpu-topology``); the pod runs the dtpu agent
+(shim in process mode) so the normal shim→runner flow applies, reached
+through a NodePort service instead of SSH.
+
+Single-host TPU slices per pod (like the reference's TPU support);
+multi-host GKE slices need JobSet-style gang scheduling — the GCP
+``tpu_v2`` backend is the multi-host path in this framework.
+
+Offers are derived from the cluster's live nodes (the reference does the
+same: capacity is whatever the cluster has).
+"""
+
+from typing import Optional
+
+from dstack_tpu.backends.base.compute import (
+    Compute,
+    ComputeWithCreateInstanceSupport,
+)
+from dstack_tpu.backends.kubernetes.api import KubernetesAPI
+from dstack_tpu.core.errors import ComputeError
+from dstack_tpu.core.models.backends import BackendType
+from dstack_tpu.core.models.instances import (
+    HostMetadata,
+    InstanceAvailability,
+    InstanceConfiguration,
+    InstanceOfferWithAvailability,
+    InstanceType,
+    Resources,
+    TPUInfo,
+)
+from dstack_tpu.core.models.runs import JobProvisioningData, Requirements
+from dstack_tpu.utils.common import run_async
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("backends.kubernetes")
+
+SHIM_PORT = 10998
+# process-mode runners allocate ports monotonically from 11000 and never
+# reuse them — expose enough for job retries on the same pod
+RUNNER_PORT_RANGE = (11000, 11010)
+SSH_PORT = 10022
+
+# GKE TPU accelerator label → (generation, chips per host)
+GKE_TPU_TYPES = {
+    "tpu-v4-podslice": ("v4", 4),
+    "tpu-v5-lite-podslice": ("v5e", 8),
+    "tpu-v5-lite-device": ("v5e", 8),
+    "tpu-v5p-slice": ("v5p", 4),
+    "tpu-v6e-slice": ("v6e", 8),
+}
+
+
+def _parse_quantity(q) -> int:
+    """K8s resource quantity → integer units (handles m/Ki/Mi/Gi)."""
+    if q is None:
+        return 0
+    s = str(q)
+    mult = 1
+    for suffix, m in (
+        ("Ki", 1024), ("Mi", 1024**2), ("Gi", 1024**3), ("Ti", 1024**4)
+    ):
+        if s.endswith(suffix):
+            return int(float(s[: -len(suffix)])) * m
+    if s.endswith("m"):
+        return max(1, int(s[:-1]) // 1000)
+    return int(float(s) * mult)
+
+
+class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
+    """``config``: {api_server, token, namespace?, verify_ssl?,
+    ca_cert_path?, agent_image?, node_price_per_hour?}."""
+
+    def __init__(self, config: dict, api: Optional[KubernetesAPI] = None):
+        self.config = config
+        if api is None:
+            if not config.get("api_server") or not config.get("token"):
+                raise ComputeError(
+                    "kubernetes backend requires api_server and token"
+                )
+            api = KubernetesAPI(
+                api_server=config["api_server"],
+                token=config["token"],
+                namespace=config.get("namespace", "default"),
+                verify_ssl=config.get("verify_ssl", False),
+                ca_cert_path=config.get("ca_cert_path"),
+            )
+        self.api = api
+        self.agent_image = config.get("agent_image", "python:3.12-slim")
+        self.price = float(config.get("node_price_per_hour", 0.0))
+
+    # -- offers --
+
+    def _node_offer(self, node: dict) -> Optional[InstanceOfferWithAvailability]:
+        labels = node["metadata"].get("labels", {})
+        alloc = node.get("status", {}).get("allocatable", {})
+        cpus = _parse_quantity(alloc.get("cpu"))
+        memory_mib = _parse_quantity(alloc.get("memory")) // (1024 * 1024)
+        if cpus <= 0:
+            return None
+        tpu = None
+        accel = labels.get("cloud.google.com/gke-tpu-accelerator")
+        tpu_count = _parse_quantity(alloc.get("google.com/tpu"))
+        if accel and accel in GKE_TPU_TYPES and tpu_count > 0:
+            version, chips_per_host = GKE_TPU_TYPES[accel]
+            topology = labels.get(
+                "cloud.google.com/gke-tpu-topology", f"1x{tpu_count}"
+            )
+            tpu = TPUInfo(
+                version=version,
+                chips=tpu_count,
+                topology=topology,
+                hosts=1,
+                chips_per_host=chips_per_host,
+            )
+        region = labels.get("topology.kubernetes.io/region", "cluster")
+        name = node["metadata"]["name"]
+        return InstanceOfferWithAvailability(
+            backend=BackendType.KUBERNETES,
+            instance=InstanceType(
+                name=name,
+                resources=Resources(cpus=cpus, memory_mib=memory_mib, tpu=tpu),
+            ),
+            region=region,
+            price=self.price,
+            availability=InstanceAvailability.AVAILABLE,
+        )
+
+    async def get_offers(
+        self, requirements: Requirements
+    ) -> list[InstanceOfferWithAvailability]:
+        nodes = await run_async(self.api.list_nodes)
+        offers = []
+        res = requirements.resources
+        for node in nodes:
+            offer = self._node_offer(node)
+            if offer is None:
+                continue
+            tpu = offer.instance.resources.tpu
+            if res.tpu is not None:
+                if tpu is None:
+                    continue
+                if res.tpu.version is not None and tpu.version not in res.tpu.version:
+                    continue
+                if not res.tpu.chips.contains(tpu.chips):
+                    continue
+            offers.append(offer)
+        return offers
+
+    # -- provisioning --
+
+    def _pod_name(self, instance_name: str) -> str:
+        return f"dtpu-{instance_name}"[:60].rstrip("-").lower()
+
+    def _manifests(
+        self,
+        pod_name: str,
+        offer: InstanceOfferWithAvailability,
+        instance_config: InstanceConfiguration,
+    ) -> tuple[dict, dict]:
+        tpu = offer.instance.resources.tpu
+        resources: dict = {}
+        node_selector: dict = {}
+        if tpu is not None:
+            resources = {
+                "requests": {"google.com/tpu": str(tpu.chips)},
+                "limits": {"google.com/tpu": str(tpu.chips)},
+            }
+            accel = next(
+                (
+                    k
+                    for k, (v, _) in GKE_TPU_TYPES.items()
+                    if v == tpu.version and "device" not in k
+                ),
+                None,
+            )
+            if accel:
+                node_selector = {
+                    "cloud.google.com/gke-tpu-accelerator": accel,
+                    "cloud.google.com/gke-tpu-topology": tpu.topology,
+                }
+        ports = [SHIM_PORT, *range(RUNNER_PORT_RANGE[0], RUNNER_PORT_RANGE[1]), SSH_PORT]
+        authorized = "\n".join(instance_config.ssh_public_keys)
+        bootstrap = (
+            "pip install --quiet aiohttp psutil pyyaml pydantic requests cryptography && "
+            "mkdir -p /root/.ssh && chmod 700 /root/.ssh && "
+            f"printf '%s\\n' \"$DTPU_AUTHORIZED_KEYS\" >> /root/.ssh/authorized_keys && "
+            "chmod 600 /root/.ssh/authorized_keys && "
+            # best-effort sshd so `dtpu attach`'s tunnel has a target;
+            # the job itself does not depend on it
+            "if ! command -v sshd >/dev/null 2>&1; then "
+            "apt-get update -qq && apt-get install -y -qq openssh-server "
+            ">/dev/null 2>&1 || true; fi; "
+            "if command -v sshd >/dev/null 2>&1; then "
+            "mkdir -p /run/sshd; ssh-keygen -A >/dev/null 2>&1; "
+            f'"$(command -v sshd)" -p {SSH_PORT} -o PermitRootLogin=yes '
+            "-o PasswordAuthentication=no || true; fi; "
+            "python -m dstack_tpu.agent.python.shim_main "
+            f"--port {SHIM_PORT} --base-dir /root/.dtpu --runtime process"
+        )
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod_name,
+                "labels": {"app": "dtpu", "dtpu-instance": pod_name},
+            },
+            "spec": {
+                "restartPolicy": "Never",
+                "nodeSelector": node_selector,
+                "containers": [
+                    {
+                        "name": "agent",
+                        "image": self.agent_image,
+                        "command": ["/bin/sh", "-c", bootstrap],
+                        "env": [
+                            {"name": "PJRT_DEVICE", "value": "TPU"},
+                            {
+                                "name": "DTPU_AUTHORIZED_KEYS",
+                                "value": authorized,
+                            },
+                        ],
+                        "ports": [{"containerPort": p} for p in ports],
+                        "resources": resources,
+                        "securityContext": {"privileged": tpu is not None},
+                    }
+                ],
+            },
+        }
+        service = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": pod_name},
+            "spec": {
+                "type": "NodePort",
+                "selector": {"dtpu-instance": pod_name},
+                "ports": [
+                    {"name": f"p{p}", "port": p, "targetPort": p} for p in ports
+                ],
+            },
+        }
+        return pod, service
+
+    async def create_instance(
+        self,
+        instance_offer: InstanceOfferWithAvailability,
+        instance_config: InstanceConfiguration,
+    ) -> JobProvisioningData:
+        pod_name = self._pod_name(instance_config.instance_name)
+        pod, service = self._manifests(pod_name, instance_offer, instance_config)
+        await run_async(self.api.create_pod, pod)
+        try:
+            await run_async(self.api.create_service, service)
+        except Exception:
+            await run_async(self.api.delete_pod, pod_name)
+            raise
+        return JobProvisioningData(
+            backend=BackendType.KUBERNETES,
+            instance_type=instance_offer.instance,
+            instance_id=pod_name,
+            hostname=None,  # filled by update_provisioning_data
+            region=instance_offer.region,
+            price=instance_offer.price,
+            username="root",
+            ssh_port=SSH_PORT,
+            dockerized=True,  # pod runs the shim; normal shim→runner flow
+        )
+
+    async def update_provisioning_data(
+        self, provisioning_data: JobProvisioningData
+    ) -> JobProvisioningData:
+        pod_name = provisioning_data.instance_id
+        pod = await run_async(self.api.get_pod, pod_name)
+        if pod is None:
+            return provisioning_data
+        status = pod.get("status", {})
+        host_ip = status.get("hostIP")
+        pod_ip = status.get("podIP")
+        if status.get("phase") != "Running" or not host_ip:
+            return provisioning_data
+        svc = await run_async(self.api.get_service, pod_name)
+        port_map: dict[str, int] = {}
+        if svc is not None:
+            for p in svc.get("spec", {}).get("ports", []):
+                if p.get("nodePort"):
+                    port_map[str(p["port"])] = int(p["nodePort"])
+        provisioning_data.hostname = host_ip
+        provisioning_data.internal_ip = pod_ip or host_ip
+        shim_nodeport = int(port_map.get(str(SHIM_PORT), SHIM_PORT))
+        provisioning_data.ssh_port = int(port_map.get(str(SSH_PORT), SSH_PORT))
+        provisioning_data.hosts = [
+            HostMetadata(
+                worker_id=0,
+                internal_ip=pod_ip or host_ip,
+                external_ip=host_ip,
+                shim_port=shim_nodeport,
+                port_map=port_map,
+            )
+        ]
+        return provisioning_data
+
+    async def terminate_instance(
+        self, instance_id: str, region: str, backend_data: Optional[str] = None
+    ) -> None:
+        await run_async(self.api.delete_service, instance_id)
+        await run_async(self.api.delete_pod, instance_id)

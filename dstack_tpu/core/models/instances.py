@@ -140,6 +140,9 @@ class HostMetadata(CoreModel):
     worker_id: int
     internal_ip: str
     external_ip: Optional[str] = None
+    # in-host port → externally reachable port, for NAT'd environments
+    # (e.g. Kubernetes NodePort); empty = ports are reachable as-is
+    port_map: dict[str, int] = {}
     hostname: Optional[str] = None
     ssh_port: int = 22
     shim_port: int = 10998

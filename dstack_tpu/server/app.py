@@ -69,6 +69,7 @@ async def create_app(
     }
     app = build_app(ALL_ROUTERS, state, auth_dependency=auth_dependency)
     register_proxy_routes(app)
+    register_ui_routes(app)
 
     scheduler = create_scheduler(db)
     state["scheduler"] = scheduler
@@ -90,6 +91,23 @@ async def create_app(
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app
+
+
+def register_ui_routes(app: web.Application) -> None:
+    """Serve the web console (reference serves the React SPA as statics
+    from the server, app.py:247-250; here a no-build vanilla-JS SPA in
+    server/statics/)."""
+    from pathlib import Path
+
+    statics = Path(__file__).parent / "statics"
+    if not statics.exists():
+        return
+
+    async def index(request: web.Request) -> web.FileResponse:
+        return web.FileResponse(statics / "index.html")
+
+    app.router.add_get("/", index)
+    app.router.add_static("/statics/", statics, show_index=False)
 
 
 def register_proxy_routes(app: web.Application) -> None:

@@ -204,12 +204,19 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
     logger.info("job %s: task submitted to shim", job_spec.job_name)
 
 
-def _runner_port(job_row: dict) -> int:
+def _runner_port(job_row: dict, jpd: Optional[JobProvisioningData] = None) -> int:
     jrd = loads(job_row.get("job_runtime_data")) or {}
     ports = jrd.get("ports") or {}
+    port = RUNNER_PORT
     for _container, host in ports.items():
-        return int(host)
-    return RUNNER_PORT
+        port = int(host)
+        break
+    # NAT'd environments (k8s NodePort) publish in-host ports elsewhere
+    if jpd is not None:
+        for h in jpd.hosts:
+            if h.worker_id == jpd.worker_id and h.port_map:
+                return int(h.port_map.get(str(port), port))
+    return port
 
 
 async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData) -> None:
@@ -236,7 +243,7 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
     jrd = loads(job_row.get("job_runtime_data")) or {}
     jrd["ports"] = {p.container_port: p.host_port for p in info.ports}
     await db.update_by_id("jobs", job_row["id"], {"job_runtime_data": dumps(jrd)})
-    runner_port = _runner_port({**job_row, "job_runtime_data": dumps(jrd)})
+    runner_port = _runner_port({**job_row, "job_runtime_data": dumps(jrd)}, jpd)
     run_row = await db.get_by_id("runs", job_row["run_id"])
     cluster_info = await _build_cluster_info(db, job_row, jpd)
     if "" in cluster_info.nodes_ips and len(cluster_info.nodes_ips) > 1:
@@ -367,7 +374,7 @@ async def _get_code_blob(
 async def _process_running(db: Database, job_row: dict, jpd: JobProvisioningData) -> None:
     jrd = loads(job_row.get("job_runtime_data")) or {}
     cursor = float(jrd.get("pull_cursor", 0.0))
-    runner_port = _runner_port(job_row)
+    runner_port = _runner_port(job_row, jpd)
     async with runner_client_for(
         jpd, runner_port, db=db, project_id=job_row["project_id"]
     ) as runner:

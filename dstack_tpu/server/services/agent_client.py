@@ -130,8 +130,13 @@ class RunnerClient(_HTTPBase):
 
 
 def _direct(jpd: JobProvisioningData) -> bool:
-    """Local/dev instances are reached without SSH."""
-    return jpd.backend.value == "local" or jpd.hostname in ("127.0.0.1", "localhost")
+    """Local/dev instances are reached without SSH; kubernetes pods are
+    reached over plain TCP at the node IP + NodePort (the NAT mapping
+    lives in jpd.hosts[].port_map — backends/kubernetes/compute.py)."""
+    return (
+        jpd.backend.value in ("local", "kubernetes")
+        or jpd.hostname in ("127.0.0.1", "localhost")
+    )
 
 
 async def _tunnel_identity(db, project_id: Optional[str]) -> Optional[str]:

@@ -33,6 +33,10 @@ def _instantiate(btype: BackendType, config: dict) -> Compute:
         from dstack_tpu.backends.ssh_fleet.compute import SSHFleetCompute
 
         return SSHFleetCompute(config)
+    if btype == BackendType.KUBERNETES:
+        from dstack_tpu.backends.kubernetes.compute import KubernetesCompute
+
+        return KubernetesCompute(config)
     raise ClientError(f"unsupported backend type {btype}")
 
 

@@ -9,7 +9,7 @@ import json
 import re
 from datetime import datetime, timezone
 from pathlib import Path
-from typing import Optional
+from typing import Optional, Protocol
 
 from dstack_tpu.core.models.logs import JobSubmissionLogs, LogEvent
 from dstack_tpu.server import settings
@@ -102,16 +102,69 @@ class FileLogStorage:
         return JobSubmissionLogs(logs=events, next_token=str(scanned))
 
 
-_storage: Optional[FileLogStorage] = None
+class LogStorage(Protocol):
+    """Contract both backends satisfy structurally (reference
+    logs/base.py): FileLogStorage and GCPLogStorage."""
+
+    def write_logs(
+        self,
+        project_name: str,
+        run_name: str,
+        job_name: str,
+        events: list[LogEvent],
+        diagnostics: bool = False,
+    ) -> None: ...
+
+    def poll_logs(
+        self,
+        project_name: str,
+        run_name: str,
+        job_name: str,
+        start_time: Optional[datetime] = None,
+        limit: int = 1000,
+        diagnostics: bool = False,
+        next_token: Optional[str] = None,
+    ) -> JobSubmissionLogs: ...
 
 
-def get_log_storage() -> FileLogStorage:
+_storage = None
+
+
+def init_log_storage():
+    """Instantiate the backend selected by DTPU_LOG_STORAGE
+    (reference settings.LOG_STORAGE: file | cloudwatch | gcp; here
+    file | gcp). Only a *missing dependency* falls back to file —
+    auth/config errors for an explicitly configured backend must fail
+    loudly, not silently divert logs to local disk."""
     global _storage
-    if _storage is None:
-        _storage = FileLogStorage()
+    kind = settings.LOG_STORAGE
+    if kind == "gcp":
+        from dstack_tpu.server.services.logs.gcp import GCPLogStorage
+
+        try:
+            _storage = GCPLogStorage()
+            return _storage
+        except RuntimeError as e:  # google-cloud-logging not installed
+            import logging
+
+            logging.getLogger("dstack_tpu.server.logs").warning(
+                "DTPU_LOG_STORAGE=gcp unavailable (%s); using file storage", e
+            )
+    elif kind != "file":
+        raise ValueError(
+            f"unknown DTPU_LOG_STORAGE={kind!r} (expected 'file' or 'gcp')"
+        )
+    _storage = FileLogStorage()
     return _storage
 
 
-def set_log_storage(storage: FileLogStorage) -> None:
+def get_log_storage():
+    global _storage
+    if _storage is None:
+        init_log_storage()
+    return _storage
+
+
+def set_log_storage(storage) -> None:
     global _storage
     _storage = storage

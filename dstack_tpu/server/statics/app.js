@@ -1,0 +1,414 @@
+/* dstack-tpu web console — no-build SPA over the REST API.
+   TPU-build equivalent of the reference React frontend (frontend/src/pages:
+   Runs, Fleets, Instances, Volumes, Models, Project, User). */
+"use strict";
+
+const state = {
+  token: localStorage.getItem("dtpu_token") || "",
+  project: localStorage.getItem("dtpu_project") || "main",
+  projects: [],
+  user: null,
+};
+
+async function api(path, body) {
+  const resp = await fetch(path, {
+    method: "POST",
+    headers: {
+      "Authorization": "Bearer " + state.token,
+      "Content-Type": "application/json",
+    },
+    body: JSON.stringify(body || {}),
+  });
+  if (resp.status === 401 || resp.status === 403) {
+    if (path === "/api/users/get_my_user") throw new Error("unauthorized");
+  }
+  if (!resp.ok) {
+    let detail = resp.statusText;
+    try {
+      const d = await resp.json();
+      if (d.detail && d.detail.length) detail = d.detail[0].msg;
+    } catch (e) { /* keep statusText */ }
+    throw new Error(detail);
+  }
+  return resp.json();
+}
+const papi = (path, body) => api(`/api/project/${state.project}${path}`, body);
+
+const h = (tag, attrs, ...children) => {
+  const el = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "onclick") el.onclick = v;
+    else if (k === "class") el.className = v;
+    else el.setAttribute(k, v);
+  }
+  for (const c of children.flat()) {
+    if (c == null) continue;
+    el.append(c.nodeType ? c : document.createTextNode(c));
+  }
+  return el;
+};
+
+function statusBadge(s) {
+  return h("span", { class: `status s-${s}` }, s);
+}
+function fmtDate(iso) {
+  if (!iso) return "—";
+  const d = new Date(iso);
+  return d.toLocaleString();
+}
+function toast(msg) {
+  const t = h("div", { class: "toast" }, msg);
+  document.body.append(t);
+  setTimeout(() => t.remove(), 3500);
+}
+
+/* ---------- layout ---------- */
+
+const PAGES = [
+  ["runs", "Runs"],
+  ["fleets", "Fleets"],
+  ["instances", "Instances"],
+  ["volumes", "Volumes"],
+  ["gateways", "Gateways"],
+  ["repos", "Repos"],
+  ["secrets", "Secrets"],
+  ["project", "Project"],
+];
+
+function currentRoute() {
+  const parts = location.hash.replace(/^#\/?/, "").split("/").filter(Boolean);
+  return { page: parts[0] || "runs", arg: parts[1] };
+}
+
+function renderShell(content) {
+  const { page } = currentRoute();
+  const app = document.getElementById("app");
+  app.replaceChildren(
+    h("div", { id: "topbar" },
+      h("div", { class: "logo" }, "dstack-", h("span", {}, "tpu")),
+      h("select", {
+        onchange: undefined,
+      }),
+      h("div", { style: "flex:1" }),
+      h("span", { class: "muted" }, state.user ? state.user.username : ""),
+      h("button", {
+        onclick: () => { localStorage.removeItem("dtpu_token"); state.token = ""; render(); },
+      }, "Sign out"),
+    ),
+    h("div", { id: "layout" },
+      h("div", { id: "nav" },
+        PAGES.map(([id, label]) =>
+          h("a", { class: page === id ? "active" : "", href: `#/${id}` }, label)),
+      ),
+      h("div", { id: "main" }, content),
+    ),
+  );
+  const sel = app.querySelector("select");
+  for (const p of state.projects) {
+    const o = h("option", { value: p.project_name }, p.project_name);
+    if (p.project_name === state.project) o.selected = true;
+    sel.append(o);
+  }
+  sel.onchange = () => {
+    state.project = sel.value;
+    localStorage.setItem("dtpu_project", sel.value);
+    render();
+  };
+}
+
+function table(headers, rows, empty) {
+  if (!rows.length) return h("div", { class: "empty" }, empty || "Nothing here yet");
+  return h("table", {},
+    h("thead", {}, h("tr", {}, headers.map((x) => h("th", {}, x)))),
+    h("tbody", {}, rows),
+  );
+}
+
+/* ---------- pages ---------- */
+
+async function pageRuns() {
+  const runs = await papi("/runs/list");
+  return h("div", {},
+    h("h1", {}, "Runs"),
+    table(
+      ["Name", "Type", "Status", "Backend", "Resources", "Submitted", ""],
+      runs.map((r) => {
+        const sub = r.jobs?.[0]?.job_submissions?.slice(-1)[0];
+        const jpd = sub?.job_provisioning_data;
+        return h("tr", {},
+          h("td", {}, h("a", { href: `#/runs/${r.run_spec.run_name}` }, r.run_spec.run_name)),
+          h("td", {}, r.run_spec.configuration?.type || "task"),
+          h("td", {}, statusBadge(r.status)),
+          h("td", {}, jpd?.backend || "—"),
+          h("td", {}, jpd?.instance_type?.resources?.tpu
+            ? `TPU ${jpd.instance_type.resources.tpu.version}-${jpd.instance_type.resources.tpu.chips}`
+            : (jpd?.instance_type?.name || "—")),
+          h("td", {}, fmtDate(r.submitted_at)),
+          h("td", {}, h("div", { class: "row-actions" },
+            ["running", "submitted", "provisioning", "pending"].includes(r.status)
+              ? h("button", { class: "danger", onclick: async (e) => {
+                  e.stopPropagation();
+                  await papi("/runs/stop", { runs_names: [r.run_spec.run_name], abort: false });
+                  toast(`Stopping ${r.run_spec.run_name}`); render();
+                } }, "Stop")
+              : h("button", { class: "danger", onclick: async (e) => {
+                  e.stopPropagation();
+                  await papi("/runs/delete", { runs_names: [r.run_spec.run_name] });
+                  toast(`Deleted ${r.run_spec.run_name}`); render();
+                } }, "Delete"),
+          )),
+        );
+      }),
+      "No runs — submit one with `dtpu apply -f task.yaml`",
+    ),
+  );
+}
+
+async function pageRunDetail(name) {
+  const run = await papi("/runs/get", { run_name: name });
+  const sub = run.jobs?.[0]?.job_submissions?.slice(-1)[0];
+  const jpd = sub?.job_provisioning_data;
+  const logsPre = h("pre", { class: "logs" }, "loading logs…");
+
+  (async () => {
+    let token = null, text = "";
+    for (let i = 0; i < 50; i++) {
+      const batch = await papi("/logs/poll", { run_name: name, next_token: token, limit: 1000 });
+      if (!batch.logs.length) break;
+      token = batch.next_token;
+      // atob alone maps bytes to Latin-1 and mangles UTF-8 output
+      text += batch.logs.map((ev) => new TextDecoder("utf-8").decode(
+        Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0)))).join("");
+    }
+    logsPre.textContent = text || "(no logs)";
+  })().catch((e) => { logsPre.textContent = "log fetch failed: " + e.message; });
+
+  return h("div", {},
+    h("h1", {}, h("a", { href: "#/runs" }, "Runs"), " / ", name, " ", statusBadge(run.status)),
+    h("div", { class: "kv" },
+      h("div", { class: "k" }, "Type"), h("div", {}, run.run_spec.configuration?.type),
+      h("div", { class: "k" }, "Backend"), h("div", {}, jpd?.backend || "—"),
+      h("div", { class: "k" }, "Host"), h("div", {}, jpd?.hostname || "—"),
+      h("div", { class: "k" }, "Price"), h("div", {}, jpd ? `$${(jpd.price || 0).toFixed(2)}/h` : "—"),
+      h("div", { class: "k" }, "Submitted"), h("div", {}, fmtDate(run.submitted_at)),
+      h("div", { class: "k" }, "Status message"), h("div", {}, run.status_message || "—"),
+      h("div", { class: "k" }, "Service URL"), h("div", {}, run.service?.url || "—"),
+    ),
+    h("h1", {}, "Logs"),
+    logsPre,
+  );
+}
+
+async function pageFleets() {
+  const fleets = await papi("/fleets/list");
+  return h("div", {},
+    h("h1", {}, "Fleets"),
+    table(
+      ["Name", "Status", "Instances", "Created", ""],
+      fleets.map((f) => h("tr", {},
+        h("td", {}, f.name),
+        h("td", {}, statusBadge(f.status)),
+        h("td", {}, String((f.instances || []).length)),
+        h("td", {}, fmtDate(f.created_at)),
+        h("td", {}, h("button", { class: "danger", onclick: async () => {
+          await papi("/fleets/delete", { names: [f.name] });
+          toast(`Deleted fleet ${f.name}`); render();
+        } }, "Delete")),
+      )),
+      "No fleets — create one with `dtpu apply -f fleet.yaml`",
+    ),
+  );
+}
+
+async function pageInstances() {
+  const instances = await papi("/instances/list");
+  return h("div", {},
+    h("h1", {}, "Instances"),
+    table(
+      ["Name", "Status", "Backend", "Region", "Resources", "Price", "Created"],
+      instances.map((i) => h("tr", {},
+        h("td", {}, i.name),
+        h("td", {}, statusBadge(i.status)),
+        h("td", {}, i.backend || "—"),
+        h("td", {}, i.region || "—"),
+        h("td", {}, i.instance_type?.resources?.tpu
+          ? `TPU ${i.instance_type.resources.tpu.version}-${i.instance_type.resources.tpu.chips}`
+          : (i.instance_type?.name || "—")),
+        h("td", {}, `$${(i.price || 0).toFixed(2)}/h`),
+        h("td", {}, fmtDate(i.created)),
+      )),
+    ),
+  );
+}
+
+async function pageVolumes() {
+  const volumes = await papi("/volumes/list");
+  return h("div", {},
+    h("h1", {}, "Volumes"),
+    table(
+      ["Name", "Status", "Backend", "Region", "Size", ""],
+      volumes.map((v) => h("tr", {},
+        h("td", {}, v.name),
+        h("td", {}, statusBadge(v.status)),
+        h("td", {}, v.configuration?.backend || "—"),
+        h("td", {}, v.configuration?.region || "—"),
+        h("td", {}, v.configuration?.size ? `${v.configuration.size}` : "—"),
+        h("td", {}, h("button", { class: "danger", onclick: async () => {
+          await papi("/volumes/delete", { names: [v.name] });
+          toast(`Deleted volume ${v.name}`); render();
+        } }, "Delete")),
+      )),
+    ),
+  );
+}
+
+async function pageGateways() {
+  const gws = await papi("/gateways/list");
+  return h("div", {},
+    h("h1", {}, "Gateways"),
+    table(
+      ["Name", "Status", "Hostname", "Domain", ""],
+      gws.map((g) => h("tr", {},
+        h("td", {}, g.name),
+        h("td", {}, statusBadge(g.status)),
+        h("td", {}, g.hostname || "—"),
+        h("td", {}, g.configuration?.domain || "—"),
+        h("td", {}, h("button", { class: "danger", onclick: async () => {
+          await papi("/gateways/delete", { names: [g.name] });
+          toast(`Deleted gateway ${g.name}`); render();
+        } }, "Delete")),
+      )),
+    ),
+  );
+}
+
+async function pageRepos() {
+  const repos = await papi("/repos/list");
+  return h("div", {},
+    h("h1", {}, "Repos"),
+    table(
+      ["Repo ID", "Type", "Source", ""],
+      repos.map((r) => h("tr", {},
+        h("td", {}, r.repo_id),
+        h("td", {}, r.repo_info?.repo_type || "—"),
+        h("td", {}, r.repo_info?.repo_url || r.repo_info?.repo_dir || "—"),
+        h("td", {}, h("button", { class: "danger", onclick: async () => {
+          await papi("/repos/delete", { repos_ids: [r.repo_id] });
+          toast(`Deleted repo ${r.repo_id}`); render();
+        } }, "Delete")),
+      )),
+      "No repos — `dtpu init` registers one",
+    ),
+  );
+}
+
+async function pageSecrets() {
+  const secrets = await papi("/secrets/list");
+  const nameIn = h("input", { placeholder: "NAME" });
+  const valueIn = h("input", { placeholder: "value", type: "password" });
+  return h("div", {},
+    h("h1", {}, "Secrets"),
+    h("div", { style: "display:flex;gap:8px;margin-bottom:16px" },
+      nameIn, valueIn,
+      h("button", { class: "primary", onclick: async () => {
+        if (!nameIn.value) return;
+        await papi("/secrets/create", { name: nameIn.value, value: valueIn.value });
+        toast(`Secret ${nameIn.value} saved`); render();
+      } }, "Add secret"),
+    ),
+    table(
+      ["Name", ""],
+      secrets.map((s) => h("tr", {},
+        h("td", {}, s.name),
+        h("td", {}, h("button", { class: "danger", onclick: async () => {
+          await papi("/secrets/delete", { secrets_names: [s.name] });
+          toast(`Deleted ${s.name}`); render();
+        } }, "Delete")),
+      )),
+    ),
+  );
+}
+
+async function pageProject() {
+  const project = await papi("/get");
+  const backends = await papi("/backends/list");
+  return h("div", {},
+    h("h1", {}, `Project: ${project.project_name}`),
+    h("div", { class: "kv" },
+      h("div", { class: "k" }, "Owner"), h("div", {}, project.owner?.username || "—"),
+      h("div", { class: "k" }, "Members"),
+      h("div", {}, (project.members || []).map((m) =>
+        `${m.user.username} (${m.project_role})`).join(", ") || "—"),
+    ),
+    h("h1", {}, "Backends"),
+    table(
+      ["Type", "Config"],
+      backends.map((b) => h("tr", {},
+        h("td", {}, b.name),
+        h("td", {}, h("span", { class: "muted" }, JSON.stringify(b.config))),
+      )),
+    ),
+  );
+}
+
+/* ---------- login + router ---------- */
+
+function renderLogin(err) {
+  const tokenIn = h("input", { placeholder: "admin token", type: "password" });
+  document.getElementById("app").replaceChildren(
+    h("div", { id: "login" },
+      h("div", { class: "logo", style: "font-size:20px;margin-bottom:12px" },
+        "dstack-", h("span", { style: "color:var(--accent)" }, "tpu")),
+      h("div", { class: "muted" }, "Paste the server admin token (printed at server start) or a user token."),
+      tokenIn,
+      err ? h("div", { style: "color:var(--err);margin-bottom:10px" }, err) : null,
+      h("button", { class: "primary", style: "width:100%", onclick: async () => {
+        state.token = tokenIn.value.trim();
+        try {
+          await api("/api/users/get_my_user");
+          localStorage.setItem("dtpu_token", state.token);
+          render();
+        } catch (e) {
+          renderLogin("Invalid token");
+        }
+      } }, "Sign in"),
+    ),
+  );
+}
+
+const ROUTES = {
+  runs: pageRuns,
+  fleets: pageFleets,
+  instances: pageInstances,
+  volumes: pageVolumes,
+  gateways: pageGateways,
+  repos: pageRepos,
+  secrets: pageSecrets,
+  project: pageProject,
+};
+
+async function render() {
+  if (!state.token) return renderLogin();
+  try {
+    state.user = await api("/api/users/get_my_user");
+    state.projects = await api("/api/projects/list");
+    if (!state.projects.find((p) => p.project_name === state.project) && state.projects.length) {
+      state.project = state.projects[0].project_name;
+    }
+  } catch (e) {
+    return renderLogin(e.message === "unauthorized" ? "Session expired" : e.message);
+  }
+  const { page, arg } = currentRoute();
+  let content;
+  try {
+    content = page === "runs" && arg
+      ? await pageRunDetail(arg)
+      : await (ROUTES[page] || pageRuns)();
+  } catch (e) {
+    content = h("div", { class: "empty" }, "Error: " + e.message);
+  }
+  renderShell(content);
+}
+
+window.addEventListener("hashchange", render);
+render();

@@ -95,25 +95,30 @@ def main(argv=None) -> int:
                 f"corpus seq len {corpus.shape[1]} < requested {args.seq_len}"
             )
 
+    def _make_batch(tok):
+        # the roll wraps the last target to the sequence's first token —
+        # mask that position out instead of training on garbage
+        mask = jnp.ones_like(tok).at[:, -1].set(0)
+        return {"tokens": tok, "targets": jnp.roll(tok, -1, axis=1), "mask": mask}
+
+    if args.data:
+
         def next_batch(i):
             idx = (i * args.batch) % (corpus.shape[0] - args.batch + 1)
-            tok = jnp.asarray(corpus[idx : idx + args.batch, : args.seq_len])
-            return {
-                "tokens": tok,
-                "targets": jnp.roll(tok, -1, axis=1),
-                "mask": jnp.ones_like(tok),
-            }
+            return _make_batch(
+                jnp.asarray(corpus[idx : idx + args.batch, : args.seq_len])
+            )
     else:
 
         def next_batch(i):
-            tok = jax.random.randint(
-                jax.random.key(i), (args.batch, args.seq_len), 0, config.vocab_size
+            return _make_batch(
+                jax.random.randint(
+                    jax.random.key(i),
+                    (args.batch, args.seq_len),
+                    0,
+                    config.vocab_size,
+                )
             )
-            return {
-                "tokens": tok,
-                "targets": jnp.roll(tok, -1, axis=1),
-                "mask": jnp.ones_like(tok),
-            }
 
     ftok = flops_per_token(config, args.seq_len)
     tokens_per_step = args.batch * args.seq_len
@@ -148,28 +153,33 @@ def main(argv=None) -> int:
                 flush=True,
             )
 
+    import numpy as np
+
+    def fetch(x):
+        """Sharded array → host numpy; on multi-host slices shards live
+        on other processes, so gather across the slice first."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    if args.full:
+        flat = {
+            "/".join(str(getattr(k, "key", k)) for k in path): fetch(leaf)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(state["params"])
+        }
+        flat["step"] = fetch(state["step"])
+    else:
+        flat = {
+            f"layers.{k}": fetch(v) for k, v in state["lora"]["layers"].items()
+        }
     if jax.process_index() == 0:
         out = Path(args.out)
         out.mkdir(parents=True, exist_ok=True)
-        import numpy as np
-
-        if args.full:
-            flat = {
-                "/".join(str(k.key) for k in path): np.asarray(jax.device_get(leaf))
-                for path, leaf in jax.tree_util.tree_leaves_with_path(
-                    state["params"]
-                )
-            }
-            flat["step"] = np.asarray(jax.device_get(state["step"]))
-            np.savez(out / "model_weights.npz", **flat)
-            print(f"weights saved to {out}/model_weights.npz", flush=True)
-        else:
-            flat = {
-                f"layers.{k}": np.asarray(jax.device_get(v))
-                for k, v in state["lora"]["layers"].items()
-            }
-            np.savez(out / "lora_adapters.npz", **flat)
-            print(f"adapters saved to {out}/lora_adapters.npz", flush=True)
+        fname = "model_weights.npz" if args.full else "lora_adapters.npz"
+        np.savez(out / fname, **flat)
+        print(f"weights saved to {out}/{fname}", flush=True)
     return 0
 
 

@@ -128,12 +128,12 @@ def lora_state_specs(
         lambda: init_lora_params(config, lora_config, jax.random.key(0))
     )
     opt_abs = jax.eval_shape(optimizer.init, lora_abs)
-    flat = {leaf.shape: sh for (path, leaf), sh in zip(
-        jax.tree_util.tree_leaves_with_path(lora_abs),
-        jax.tree.leaves(lora_sh),
-    )}
     repl = NamedSharding(mesh, P())
-    opt_sh = jax.tree.map(lambda leaf: flat.get(leaf.shape, repl), opt_abs)
+    # path-suffix matching (shapes collide: wq/wo adapters share a shape
+    # whenever q_dim == hidden — see step.mirror_opt_shardings)
+    from dstack_tpu.train.step import mirror_opt_shardings
+
+    opt_sh = mirror_opt_shardings(lora_abs, lora_sh, opt_abs, repl)
     state_sh = {"lora": lora_sh, "opt_state": opt_sh, "step": repl}
     return base_sh, state_sh
 

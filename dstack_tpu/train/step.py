@@ -44,25 +44,40 @@ def default_optimizer(
     )
 
 
+def mirror_opt_shardings(params_abs, param_sh, opt_abs, repl) -> Any:
+    """Shardings for an optax state tree: optax states embed copies of
+    the param tree (ScaleByAdamState.mu/nu, …), so each opt leaf whose
+    tree path *ends with* a param path inherits that param's sharding.
+
+    Path-suffix matching, NOT shape matching — distinct params can share
+    a shape with different shardings (wq [L,h,h] vs wo [L,h,h] when
+    q_dim == hidden, as in every Llama config)."""
+    param_paths = {
+        tuple(str(k) for k in path): sh
+        for (path, _), sh in zip(
+            jax.tree_util.tree_leaves_with_path(params_abs),
+            jax.tree.leaves(param_sh),
+        )
+    }
+
+    def leaf_sh(path, leaf):
+        p = tuple(str(k) for k in path)
+        for i in range(len(p)):
+            if p[i:] in param_paths:
+                return param_paths[p[i:]]
+        return repl
+
+    return jax.tree_util.tree_map_with_path(leaf_sh, opt_abs)
+
+
 def state_specs(config: llama.LlamaConfig, optimizer: optax.GradientTransformation, rules: ShardingRules, mesh: Mesh) -> dict:
     """Shardings for the full train state (params + opt state + step)."""
     pspecs = llama.param_specs(config)
     param_sh = tree_shardings(pspecs, mesh, rules)
     params_abs = llama.abstract_params(config)
     opt_abs = jax.eval_shape(optimizer.init, params_abs)
-
-    # optax states mirror the param tree inside ScaleByAdamState etc.;
-    # shard any leaf whose shape matches a param leaf, replicate the rest.
-    flat_params = {leaf.shape: sh for (path, leaf), sh in zip(
-        jax.tree_util.tree_leaves_with_path(params_abs),
-        jax.tree.leaves(param_sh),
-    )}
     repl = NamedSharding(mesh, P())
-
-    def opt_leaf_sharding(leaf):
-        return flat_params.get(leaf.shape, repl)
-
-    opt_sh = jax.tree.map(opt_leaf_sharding, opt_abs)
+    opt_sh = mirror_opt_shardings(params_abs, param_sh, opt_abs, repl)
     return {"params": param_sh, "opt_state": opt_sh, "step": repl}
 
 

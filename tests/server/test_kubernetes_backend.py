@@ -1,0 +1,226 @@
+"""Kubernetes (GKE TPU) backend against a fake API server
+(reference backends/kubernetes, 616 LoC — jobs as pods + NodePort)."""
+
+import pytest
+
+from dstack_tpu.backends.kubernetes.compute import (
+    RUNNER_PORT_RANGE,
+    SHIM_PORT,
+    SSH_PORT,
+    KubernetesCompute,
+    _parse_quantity,
+)
+from dstack_tpu.core.models.instances import InstanceConfiguration
+from dstack_tpu.core.models.resources import ResourcesSpec
+from dstack_tpu.core.models.runs import Requirements
+
+
+def _node(name, cpus="8", memory="32Gi", tpu=None, accel=None, topo=None, region="us-central2"):
+    labels = {"topology.kubernetes.io/region": region}
+    alloc = {"cpu": cpus, "memory": memory}
+    if tpu:
+        alloc["google.com/tpu"] = str(tpu)
+        labels["cloud.google.com/gke-tpu-accelerator"] = accel
+        if topo:
+            labels["cloud.google.com/gke-tpu-topology"] = topo
+    return {
+        "metadata": {"name": name, "labels": labels},
+        "status": {"allocatable": alloc},
+    }
+
+
+class FakeK8sAPI:
+    namespace = "default"
+
+    def __init__(self, nodes=None):
+        self.nodes = nodes or []
+        self.pods: dict[str, dict] = {}
+        self.services: dict[str, dict] = {}
+        self.deleted: list[str] = []
+
+    def list_nodes(self):
+        return self.nodes
+
+    def create_pod(self, manifest):
+        name = manifest["metadata"]["name"]
+        self.pods[name] = manifest
+        return manifest
+
+    def get_pod(self, name):
+        pod = self.pods.get(name)
+        if pod is None:
+            return None
+        return {
+            **pod,
+            "status": {"phase": "Running", "hostIP": "34.1.2.3", "podIP": "10.8.0.5"},
+        }
+
+    def delete_pod(self, name):
+        self.pods.pop(name, None)
+        self.deleted.append(f"pod/{name}")
+
+    def create_service(self, manifest):
+        name = manifest["metadata"]["name"]
+        # k8s assigns nodePorts
+        for i, p in enumerate(manifest["spec"]["ports"]):
+            p["nodePort"] = 30000 + i
+        self.services[name] = manifest
+        return manifest
+
+    def get_service(self, name):
+        return self.services.get(name)
+
+    def delete_service(self, name):
+        self.services.pop(name, None)
+        self.deleted.append(f"svc/{name}")
+
+
+def _compute(nodes):
+    return KubernetesCompute({}, api=FakeK8sAPI(nodes))
+
+
+class TestQuantity:
+    def test_parse(self):
+        assert _parse_quantity("8") == 8
+        assert _parse_quantity("4000m") == 4
+        assert _parse_quantity("32Gi") == 32 * 1024**3
+        assert _parse_quantity(None) == 0
+
+
+class TestOffers:
+    async def test_tpu_nodes_become_tpu_offers(self):
+        compute = _compute([
+            _node("tpu-node", tpu=8, accel="tpu-v5-lite-podslice", topo="2x4"),
+            _node("cpu-node"),
+        ])
+        reqs = Requirements(resources=ResourcesSpec(tpu="v5e-8"))
+        offers = await compute.get_offers(reqs)
+        assert len(offers) == 1
+        tpu = offers[0].instance.resources.tpu
+        assert tpu.version == "v5e" and tpu.chips == 8 and tpu.topology == "2x4"
+        assert offers[0].region == "us-central2"
+
+    async def test_cpu_requirements_include_all_nodes(self):
+        compute = _compute([
+            _node("tpu-node", tpu=8, accel="tpu-v6e-slice", topo="2x4"),
+            _node("cpu-node"),
+        ])
+        offers = await compute.get_offers(Requirements(resources=ResourcesSpec()))
+        assert len(offers) == 2
+
+    async def test_version_filter(self):
+        compute = _compute([
+            _node("tpu-node", tpu=8, accel="tpu-v5-lite-podslice", topo="2x4"),
+        ])
+        reqs = Requirements(resources=ResourcesSpec(tpu="v4-8"))
+        assert await compute.get_offers(reqs) == []
+
+
+class TestProvisioning:
+    async def _provision(self):
+        compute = _compute([
+            _node("tpu-node", tpu=8, accel="tpu-v5-lite-podslice", topo="2x4"),
+        ])
+        offers = await compute.get_offers(
+            Requirements(resources=ResourcesSpec(tpu="v5e-8"))
+        )
+        jpd = await compute.create_instance(
+            offers[0],
+            InstanceConfiguration(
+                project_name="main",
+                instance_name="run1-0-0",
+                ssh_public_keys=["ssh-ed25519 AAAA user"],
+            ),
+        )
+        return compute, jpd
+
+    async def test_pod_and_service_created(self):
+        compute, jpd = await self._provision()
+        api = compute.api
+        assert len(api.pods) == 1 and len(api.services) == 1
+        pod = list(api.pods.values())[0]
+        c = pod["spec"]["containers"][0]
+        assert c["resources"]["limits"]["google.com/tpu"] == "8"
+        assert (
+            pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+            == "tpu-v5-lite-podslice"
+        )
+        assert pod["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+        assert any("shim_main" in str(x) for x in c["command"])
+        assert jpd.hostname is None  # not yet resolved
+        assert jpd.dockerized is True
+
+    async def test_update_provisioning_data_resolves_nodeports(self):
+        compute, jpd = await self._provision()
+        jpd = await compute.update_provisioning_data(jpd)
+        assert jpd.hostname == "34.1.2.3"
+        assert jpd.internal_ip == "10.8.0.5"
+        assert len(jpd.hosts) == 1
+        h = jpd.hosts[0]
+        # shim reachable via its NodePort
+        assert h.shim_port == h.port_map[str(SHIM_PORT)]
+        assert str(RUNNER_PORT_RANGE[0]) in h.port_map
+        assert jpd.ssh_port == h.port_map[str(SSH_PORT)]
+
+    async def test_terminate_deletes_pod_and_service(self):
+        compute, jpd = await self._provision()
+        await compute.terminate_instance(jpd.instance_id, jpd.region)
+        api = compute.api
+        assert not api.pods and not api.services
+
+    async def test_service_failure_rolls_back_pod(self):
+        compute = _compute([
+            _node("tpu-node", tpu=8, accel="tpu-v5-lite-podslice", topo="2x4"),
+        ])
+        api = compute.api
+
+        def boom(manifest):
+            raise RuntimeError("quota")
+
+        api.create_service = boom
+        offers = await compute.get_offers(
+            Requirements(resources=ResourcesSpec(tpu="v5e-8"))
+        )
+        with pytest.raises(RuntimeError):
+            await compute.create_instance(
+                offers[0],
+                InstanceConfiguration(
+                    project_name="main", instance_name="run1-0-0"
+                ),
+            )
+        assert not api.pods  # rolled back
+
+
+class TestRunnerPortTranslation:
+    def test_port_map_translates_runner_port(self):
+        from dstack_tpu.core.models.backends import BackendType
+        from dstack_tpu.core.models.instances import (
+            HostMetadata,
+            InstanceType,
+            Resources,
+        )
+        from dstack_tpu.core.models.runs import JobProvisioningData
+        from dstack_tpu.server.background.tasks.process_running_jobs import (
+            _runner_port,
+        )
+        from dstack_tpu.server.db import dumps
+
+        jpd = JobProvisioningData(
+            backend=BackendType.KUBERNETES,
+            instance_type=InstanceType(
+                name="n", resources=Resources(cpus=1, memory_mib=1024)
+            ),
+            instance_id="p",
+            hostname="34.1.2.3",
+            hosts=[
+                HostMetadata(
+                    worker_id=0,
+                    internal_ip="10.8.0.5",
+                    shim_port=30000,
+                    port_map={"11000": 30001},
+                )
+            ],
+        )
+        job_row = {"job_runtime_data": dumps({"ports": {11000: 11000}})}
+        assert _runner_port(job_row, jpd) == 30001
+        assert _runner_port(job_row) == 11000  # no translation without jpd

@@ -1,0 +1,174 @@
+"""Pluggable log storage: GCP Cloud Logging backend against a fake
+client, backend selection, file fallback (reference
+server/services/logs/{gcp,filelog}.py)."""
+
+from datetime import datetime, timedelta, timezone
+
+from dstack_tpu.core.models.logs import LogEvent, LogEventSource
+from dstack_tpu.server.services import logs as logs_mod
+from dstack_tpu.server.services.logs import FileLogStorage, init_log_storage
+from dstack_tpu.server.services.logs.gcp import GCPLogStorage
+
+
+class FakePager:
+    def __init__(self, entries, page_size, next_page_token=None):
+        self._entries = entries[:page_size]
+        self.next_page_token = next_page_token
+
+    @property
+    def pages(self):
+        return iter([iter(self._entries)])
+
+
+class FakeEntry:
+    def __init__(self, timestamp, payload):
+        self.timestamp = timestamp
+        self.payload = payload
+
+
+class FakeGCPClient:
+    def __init__(self):
+        self.entries: list[tuple[dict, dict, datetime]] = []
+        self.filters: list[str] = []
+
+    def logger(self, name):
+        client = self
+
+        class _Logger:
+            def log_struct(self, payload, labels=None, timestamp=None):
+                client.entries.append((payload, labels, timestamp))
+
+        return _Logger()
+
+    def list_entries(self, filter_, order_by, page_size, page_token=None):
+        self.filters.append(filter_)
+        offset = int(page_token) if page_token else 0
+        selected = [
+            FakeEntry(ts, dict(payload))
+            for payload, labels, ts in self.entries[offset : offset + page_size]
+        ]
+        nt = (
+            str(offset + page_size)
+            if offset + page_size < len(self.entries)
+            else None
+        )
+        return FakePager(selected, page_size, nt)
+
+
+def _events(n, start=None):
+    start = start or datetime(2026, 7, 29, 12, 0, tzinfo=timezone.utc)
+    return [
+        LogEvent.create(start + timedelta(seconds=i), f"line-{i}\n")
+        for i in range(n)
+    ]
+
+
+class TestGCPLogStorage:
+    def test_write_and_poll_roundtrip(self):
+        client = FakeGCPClient()
+        storage = GCPLogStorage(client=client)
+        storage.write_logs("main", "run1", "run1-0-0", _events(3))
+        assert len(client.entries) == 3
+        _, labels, _ = client.entries[0]
+        assert labels["dtpu_run"] == "run1" and labels["dtpu_stream"] == "job"
+
+        logs = storage.poll_logs("main", "run1", "run1-0-0", limit=10)
+        assert [ev.text() for ev in logs.logs] == [
+            "line-0\n", "line-1\n", "line-2\n"
+        ]
+        assert 'labels.dtpu_job="run1-0-0"' in client.filters[-1]
+        # cursor contract: last page must still return a resumable token
+        # (clients loop `token = next_token or token` until an empty
+        # page — None would loop them forever)
+        assert logs.next_token and logs.next_token.startswith("ts:")
+
+    def test_pagination_token(self):
+        client = FakeGCPClient()
+        storage = GCPLogStorage(client=client)
+        storage.write_logs("main", "r", "r-0-0", _events(5))
+        page1 = storage.poll_logs("main", "r", "r-0-0", limit=2)
+        assert len(page1.logs) == 2 and page1.next_token == "2"
+        page2 = storage.poll_logs(
+            "main", "r", "r-0-0", limit=2, next_token=page1.next_token
+        )
+        assert [ev.text() for ev in page2.logs] == ["line-2\n", "line-3\n"]
+
+    def test_ts_cursor_same_timestamp_no_duplicates(self):
+        """Past the last Cloud Logging page the cursor is ts:<iso>:<n>;
+        re-polling with it must not re-deliver same-timestamp events."""
+        client = FakeGCPClient()
+        storage = GCPLogStorage(client=client)
+        t = datetime(2026, 7, 29, 12, 0, tzinfo=timezone.utc)
+        storage.write_logs(
+            "main", "r", "r-0-0",
+            [LogEvent.create(t, f"same-{i}\n") for i in range(3)],
+        )
+        page = storage.poll_logs("main", "r", "r-0-0", limit=10)
+        assert len(page.logs) == 3
+        assert page.next_token == f"ts:{t.isoformat()}:3"
+        # resume: fake client re-returns everything; skip logic dedupes
+        again = storage.poll_logs(
+            "main", "r", "r-0-0", limit=10, next_token=page.next_token
+        )
+        assert again.logs == []
+        assert again.next_token == page.next_token  # cursor preserved
+
+    def test_diagnostics_stream_label(self):
+        client = FakeGCPClient()
+        storage = GCPLogStorage(client=client)
+        storage.write_logs(
+            "main", "r", "r-0-0", _events(1), diagnostics=True
+        )
+        assert client.entries[0][1]["dtpu_stream"] == "runner"
+
+    def test_start_time_filter_in_query(self):
+        client = FakeGCPClient()
+        storage = GCPLogStorage(client=client)
+        storage.poll_logs(
+            "main", "r", "r-0-0",
+            start_time=datetime(2026, 7, 29, tzinfo=timezone.utc),
+        )
+        assert 'timestamp>"2026-07-29' in client.filters[-1]
+
+
+class TestBackendSelection:
+    def test_gcp_missing_dependency_falls_back_to_file(self, monkeypatch):
+        from dstack_tpu.server import settings
+        from dstack_tpu.server.services.logs import gcp as gcp_mod
+
+        monkeypatch.setattr(settings, "LOG_STORAGE", "gcp")
+
+        def raise_missing(*a, **kw):
+            raise RuntimeError("google-cloud-logging is not installed")
+
+        monkeypatch.setattr(gcp_mod.GCPLogStorage, "__init__", raise_missing)
+        storage = init_log_storage()
+        assert isinstance(storage, FileLogStorage)
+        logs_mod.set_log_storage(None)
+
+    def test_gcp_auth_error_fails_loudly(self, monkeypatch):
+        """Only a missing dependency downgrades to file storage — broken
+        credentials for an explicitly configured backend must not
+        silently divert logs to local disk."""
+        import pytest
+
+        from dstack_tpu.server import settings
+        from dstack_tpu.server.services.logs import gcp as gcp_mod
+
+        monkeypatch.setattr(settings, "LOG_STORAGE", "gcp")
+
+        def raise_auth(*a, **kw):
+            raise ValueError("could not determine credentials")
+
+        monkeypatch.setattr(gcp_mod.GCPLogStorage, "__init__", raise_auth)
+        with pytest.raises(ValueError):
+            init_log_storage()
+        logs_mod.set_log_storage(None)
+
+    def test_default_is_file(self, monkeypatch):
+        from dstack_tpu.server import settings
+
+        monkeypatch.setattr(settings, "LOG_STORAGE", "file")
+        storage = init_log_storage()
+        assert isinstance(storage, FileLogStorage)
+        logs_mod.set_log_storage(None)

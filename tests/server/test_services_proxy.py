@@ -92,7 +92,19 @@ class TestServiceE2E:
                 if r.status == 200:
                     break
                 await asyncio.sleep(0.5)
-            assert r.status == 200
+            if r.status != 200:
+                # surface the run/job state so a flake is diagnosable
+                rr = await client.post(
+                    "/api/project/main/runs/get",
+                    headers=_auth("svc-tok"),
+                    json={"run_name": "echo-svc"},
+                )
+                run_state = await rr.json()
+                raise AssertionError(
+                    f"proxy returned {r.status}; run status="
+                    f"{run_state.get('status')} msg={run_state.get('status_message')} "
+                    f"jobs={[(j['job_submissions'][-1]['status'], j['job_submissions'][-1].get('termination_reason'), j['job_submissions'][-1].get('termination_reason_message')) for j in run_state.get('jobs', [])]}"
+                )
             assert await r.text() == "echo-ok"
 
             # model registry lists the service's model

@@ -1,0 +1,34 @@
+"""Web console statics are served by the server (reference app.py:247-250
+serves the frontend SPA the same way)."""
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.server.app import create_app
+
+
+class TestUIServing:
+    async def test_index_and_statics(self):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="ui-token",
+            with_background=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/")
+            assert r.status == 200
+            text = await r.text()
+            assert "<title>dstack-tpu</title>" in text
+            assert "/statics/app.js" in text
+
+            r = await client.get("/statics/app.js")
+            assert r.status == 200
+            js = await r.text()
+            assert "pageRuns" in js
+
+            # API routes unaffected
+            r = await client.get("/api/server/info")
+            assert r.status == 200
+        finally:
+            await client.close()
