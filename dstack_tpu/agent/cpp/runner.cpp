@@ -127,9 +127,16 @@ class Executor {
     std::ofstream f(tarball, std::ios::binary);
     f.write(data.data(), static_cast<std::streamsize>(data.size()));
     f.close();
-    // tar extraction via the system tar (busybox/gnu both fine)
+    // tar extraction via the system tar (busybox/gnu both fine);
+    // non-archives (git diffs for remote repos) are kept as code.bin —
+    // the same fallback the Python runner uses
     std::string cmd = "tar -xf '" + tarball + "' -C '" + dir + "' 2>/dev/null";
-    (void)system(cmd.c_str());
+    if (system(cmd.c_str()) != 0) {
+      ::rename(tarball.c_str(), (dir + "/code.bin").c_str());
+    } else {
+      ::unlink(tarball.c_str());
+    }
+    has_code_ = true;
   }
 
   void run() {
@@ -181,9 +188,38 @@ class Executor {
     resp.set("job_logs", std::move(logs));
     resp.set("runner_logs", std::move(rlogs));
     resp.set("last_updated", last);
-    resp.set("no_connections_secs", 0);
+    resp.set("no_connections_secs", no_connections_secs());
     resp.set("has_more", !finished);
     return resp;
+  }
+
+  // Seconds since the last ESTABLISHED TCP connection on the SSH port,
+  // read from /proc/net/tcp{,6} (parity: reference connections.go:130) —
+  // drives dev-env inactivity_duration termination.
+  int64_t no_connections_secs() {
+    int established = 0;
+    for (const char* path : {"/proc/net/tcp", "/proc/net/tcp6"}) {
+      std::ifstream f(path);
+      std::string line;
+      std::getline(f, line);  // header
+      while (std::getline(f, line)) {
+        // fields: sl local_address rem_address st ...
+        std::istringstream ss(line);
+        std::string sl, local, rem, st;
+        ss >> sl >> local >> rem >> st;
+        auto colon = local.rfind(':');
+        if (colon == std::string::npos) continue;
+        long port = strtol(local.substr(colon + 1).c_str(), nullptr, 16);
+        if (port == ssh_port_ && st == "01") established++;  // 01=ESTABLISHED
+      }
+    }
+    double now = now_unix();
+    if (established > 0) {
+      no_conn_since_ = 0;
+      return 0;
+    }
+    if (no_conn_since_ == 0) no_conn_since_ = now;
+    return static_cast<int64_t>(now - no_conn_since_);
   }
 
   Value metrics() const {
@@ -225,6 +261,9 @@ class Executor {
   std::atomic<pid_t> child_pid_{0};
   std::atomic<bool> stopped_{false};
   bool running_ = false;
+  bool has_code_ = false;
+  long ssh_port_ = 10022;
+  double no_conn_since_ = 0;
 
   void push_state_locked(StateEvent e) { states_.push_back(std::move(e)); }
 
@@ -308,6 +347,99 @@ class Executor {
     return env;
   }
 
+  static std::string shq(const std::string& s) {
+    // single-quote for /bin/sh: ' -> '\''
+    std::string out = "'";
+    for (char c : s) out += (c == '\'') ? std::string("'\\''") : std::string(1, c);
+    return out + "'";
+  }
+
+  // Materialize the job's code (parity: reference repo/manager.go:162 and
+  // the Python runner's _setup_repo): remote git clone+checkout+apply-diff,
+  // or copy of the uploaded archive extraction.
+  bool setup_repo(const std::string& workdir) {
+    Value repo;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      repo = job_["repo_data"];
+    }
+    std::string rtype = repo["repo_type"].as_string();
+    std::string code_dir = home_dir_ + "/code";
+    if (rtype == "remote" && !repo["repo_url"].as_string().empty()) {
+      std::string url = repo["repo_url"].as_string();
+      std::string branch = repo["repo_branch"].as_string();
+      std::string hash = repo["repo_hash"].as_string();
+      std::string cmd = "git clone";
+      if (hash.empty()) cmd += " --depth 1";
+      if (!branch.empty()) cmd += " -b " + shq(branch);
+      cmd += " " + shq(url) + " " + shq(workdir) + " 2>&1";
+      rlog("cloning " + url);
+      if (system(cmd.c_str()) != 0) {
+        push_state({"failed", now_unix(), "executor_error", "git clone failed",
+                    std::nullopt});
+        return false;
+      }
+      if (!hash.empty()) {
+        std::string co = "git -C " + shq(workdir) + " checkout -q " + shq(hash) +
+                         " 2>/dev/null";
+        if (system(co.c_str()) != 0)
+          rlog("commit " + hash.substr(0, 12) + " not on origin; branch tip");
+      }
+      std::string patch = code_dir + "/code.bin";
+      if (::access(patch.c_str(), R_OK) == 0) {
+        rlog("applying uploaded diff");
+        std::string ap = "git -C " + shq(workdir) +
+                         " apply --whitespace=nowarn " + shq(patch) + " 2>&1";
+        if (system(ap.c_str()) != 0) {
+          push_state({"failed", now_unix(), "executor_error",
+                      "git apply failed", std::nullopt});
+          return false;
+        }
+      }
+    } else if (has_code_) {
+      std::string cp = "cp -a " + shq(code_dir) + "/. " + shq(workdir) +
+                       " 2>/dev/null; rm -f " + shq(workdir) + "/code.bin";
+      (void)system(cp.c_str());
+    }
+    return true;
+  }
+
+  // Per-replica inter-node SSH (parity: executor.go:729-777 configureSSH
+  // and the Python runner): install the keypair + per-node config and
+  // export DTPU_SSH_CONFIG.
+  std::string setup_internode_ssh() {
+    Value spec, ci;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      spec = job_["job_spec"];
+      ci = job_["cluster_info"];
+    }
+    std::string priv = spec["ssh_key"]["private"].as_string();
+    if (priv.empty()) return "";
+    std::string ssh_dir = home_dir_ + "/ssh";
+    ::mkdir(ssh_dir.c_str(), 0700);
+    std::string key_file = ssh_dir + "/id_internode";
+    {
+      std::ofstream kf(key_file);
+      kf << priv;
+    }
+    ::chmod(key_file.c_str(), 0600);
+    std::string conf;
+    for (const auto& ip : ci["nodes_ips"].as_array()) {
+      std::string s = ip.as_string();
+      if (s.empty()) continue;
+      conf += "Host " + s + "\n  IdentityFile " + key_file +
+              "\n  Port 10022\n  User root\n  StrictHostKeyChecking no\n"
+              "  UserKnownHostsFile /dev/null\n\n";
+    }
+    std::string conf_file = ssh_dir + "/config";
+    {
+      std::ofstream cf(conf_file);
+      cf << conf;
+    }
+    return conf_file;
+  }
+
   void exec_job() {
     Value spec;
     {
@@ -324,8 +456,11 @@ class Executor {
     if (cwd.empty()) cwd = home_dir_ + "/workflow";
     ::mkdir(home_dir_.c_str(), 0755);
     ::mkdir(cwd.c_str(), 0755);
+    if (!setup_repo(cwd)) return;
+    std::string ssh_config = setup_internode_ssh();
 
     std::vector<std::string> env = build_env();
+    if (!ssh_config.empty()) env.push_back("DTPU_SSH_CONFIG=" + ssh_config);
     std::vector<char*> envp;
     for (auto& e : env) envp.push_back(e.data());
     envp.push_back(nullptr);

@@ -73,8 +73,9 @@ def cluster_env(ci, worker_id: Optional[int] = None) -> dict[str, str]:
 
 
 class Executor:
-    def __init__(self, home_dir: Path):
+    def __init__(self, home_dir: Path, ssh_port: int = 10022):
         self.home_dir = home_dir
+        self.ssh_port = ssh_port
         self.job: Optional[schemas.SubmitBody] = None
         self.code_path: Optional[Path] = None
         self.state_events: list[schemas.RunnerJobStateEvent] = []
@@ -363,7 +364,32 @@ class Executor:
             runner_logs=rlogs,
             last_updated=last,
             has_more=not finished,
+            no_connections_secs=self.no_connections_secs(),
         )
+
+    def no_connections_secs(self) -> int:
+        """Seconds since the last established TCP connection on the SSH
+        port (reference connections.go:130 counts via procfs) — drives
+        dev-env ``inactivity_duration`` termination."""
+        established = 0
+        try:
+            import psutil
+
+            established = sum(
+                1
+                for c in psutil.net_connections("tcp")
+                if c.laddr
+                and c.laddr.port == self.ssh_port
+                and c.status == "ESTABLISHED"
+            )
+        except Exception:
+            return 0
+        if established > 0:
+            self.no_connections_since = None
+            return 0
+        if self.no_connections_since is None:
+            self.no_connections_since = time.time()
+        return int(time.time() - self.no_connections_since)
 
     def metrics(self) -> schemas.MetricsSample:
         import psutil
@@ -442,7 +468,6 @@ def build_app(home_dir: Path) -> web.Application:
 
     async def pull(request):
         since = float(request.query.get("timestamp", 0))
-        ex.no_connections_since = None
         return web.Response(
             text=ex.pull(since).model_dump_json(), content_type="application/json"
         )

@@ -437,4 +437,94 @@ async def _process_running(db: Database, job_row: dict, jpd: JobProvisioningData
             terminal.state,
             terminal.termination_reason,
         )
+    if terminal is None:
+        policy_fields = await _check_job_policies(
+            db, job_row, run_row, resp.no_connections_secs
+        )
+        fields.update(policy_fields)
     await db.update_by_id("jobs", job_row["id"], fields)
+
+
+async def _check_job_policies(
+    db: Database, job_row: dict, run_row: dict, no_connections_secs: int
+) -> dict:
+    """Inactivity + utilization termination policies for RUNNING jobs
+    (reference process_running_jobs.py:652-716)."""
+    from dstack_tpu.core.models.runs import RunSpec
+
+    try:
+        run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    except Exception:
+        return {}
+    conf = run_spec.configuration
+
+    # dev environments: terminate after N secs with no SSH connections
+    # (the runner counts established conns on its SSH port)
+    inactivity = getattr(conf, "inactivity_duration", None)
+    if isinstance(inactivity, bool):
+        inactivity = 10800 if inactivity else None  # reference default 3h
+    if inactivity and no_connections_secs >= int(inactivity):
+        logger.info(
+            "job %s: no connections for %ds (limit %ds); terminating",
+            job_row["job_name"],
+            no_connections_secs,
+            int(inactivity),
+        )
+        return {
+            "status": JobStatus.TERMINATING.value,
+            "termination_reason": (
+                JobTerminationReason.INACTIVITY_DURATION_EXCEEDED.value
+            ),
+            "termination_reason_message": (
+                f"no SSH connections for {no_connections_secs}s"
+            ),
+        }
+
+    # utilization policy: all TPU chips below the duty-cycle threshold
+    # for the whole window → terminate
+    job_spec = JobSpec.model_validate(loads(job_row["job_spec"]))
+    policy = job_spec.utilization_policy
+    if policy is not None and policy.min_tpu_utilization > 0:
+        from datetime import timedelta
+
+        window_start = now_utc() - timedelta(seconds=int(policy.time_window))
+        points = await db.fetchall(
+            "SELECT timestamp, tpu_metrics FROM job_metrics_points "
+            "WHERE job_id = ? AND timestamp >= ? ORDER BY timestamp",
+            (job_row["id"], window_start.isoformat()),
+        )
+        # require coverage of most of the window before judging
+        if points and len(points) >= 3:
+            from datetime import datetime as _dt
+
+            first = _dt.fromisoformat(points[0]["timestamp"])
+            covered = (now_utc() - first).total_seconds()
+            if covered >= int(policy.time_window) * 0.9:
+                below = True
+                saw_tpu = False
+                for p in points:
+                    tm = loads(p.get("tpu_metrics")) or {}
+                    duty = tm.get("duty_cycle") or []
+                    if duty:
+                        saw_tpu = True
+                        if max(duty) >= policy.min_tpu_utilization:
+                            below = False
+                            break
+                if saw_tpu and below:
+                    logger.info(
+                        "job %s: TPU utilization below %d%% for %ds; terminating",
+                        job_row["job_name"],
+                        policy.min_tpu_utilization,
+                        int(policy.time_window),
+                    )
+                    return {
+                        "status": JobStatus.TERMINATING.value,
+                        "termination_reason": (
+                            JobTerminationReason.TERMINATED_DUE_TO_UTILIZATION_POLICY.value
+                        ),
+                        "termination_reason_message": (
+                            f"TPU duty cycle < {policy.min_tpu_utilization}% "
+                            f"for {int(policy.time_window)}s"
+                        ),
+                    }
+    return {}

@@ -1,0 +1,154 @@
+"""Inactivity + utilization termination policies
+(reference process_running_jobs.py:652-716)."""
+
+from datetime import timedelta
+
+from dstack_tpu.core.models.runs import (
+    JobStatus,
+    JobTerminationReason,
+    new_uuid,
+    now_utc,
+)
+from dstack_tpu.server.background.tasks.process_running_jobs import (
+    _check_job_policies,
+)
+from dstack_tpu.server.db import dumps
+from dstack_tpu.server.testing.common import (
+    create_test_db,
+    create_test_project,
+    create_test_user,
+)
+
+
+async def _setup(conf: dict, job_spec_extra: dict | None = None):
+    db = await create_test_db()
+    _, user_row = await create_test_user(db)
+    project_row = await create_test_project(db, user_row)
+    run_id = new_uuid()
+    run_row = {
+        "id": run_id,
+        "project_id": project_row["id"],
+        "run_name": "pol-run",
+        "user_id": user_row["id"],
+        "run_spec": dumps(
+            {
+                "run_name": "pol-run",
+                "configuration": conf,
+                "ssh_key_pub": "",
+            }
+        ),
+        "status": "running",
+        "submitted_at": now_utc().isoformat(),
+        "last_processed_at": now_utc().isoformat(),
+    }
+    await db.insert("runs", run_row)
+    job_row = {
+        "id": new_uuid(),
+        "run_id": run_id,
+        "run_name": "pol-run",
+        "project_id": project_row["id"],
+        "job_name": "pol-run-0-0",
+        "status": JobStatus.RUNNING.value,
+        "job_spec": dumps(
+            {
+                "job_name": "pol-run-0-0",
+                "requirements": {"resources": {}},
+                **(job_spec_extra or {}),
+            }
+        ),
+        "submitted_at": now_utc().isoformat(),
+        "last_processed_at": now_utc().isoformat(),
+    }
+    await db.insert("jobs", job_row)
+    return db, job_row, run_row
+
+
+class TestInactivityPolicy:
+    async def test_exceeded_terminates(self):
+        conf = {
+            "type": "dev-environment",
+            "ide": "vscode",
+            "inactivity_duration": 600,
+        }
+        db, job_row, run_row = await _setup(conf)
+        fields = await _check_job_policies(db, job_row, run_row, 700)
+        assert fields["status"] == JobStatus.TERMINATING.value
+        assert (
+            fields["termination_reason"]
+            == JobTerminationReason.INACTIVITY_DURATION_EXCEEDED.value
+        )
+        await db.close()
+
+    async def test_below_threshold_keeps_running(self):
+        conf = {
+            "type": "dev-environment",
+            "ide": "vscode",
+            "inactivity_duration": 600,
+        }
+        db, job_row, run_row = await _setup(conf)
+        assert await _check_job_policies(db, job_row, run_row, 10) == {}
+        await db.close()
+
+    async def test_no_policy_no_action(self):
+        conf = {"type": "task", "commands": ["true"]}
+        db, job_row, run_row = await _setup(conf)
+        assert await _check_job_policies(db, job_row, run_row, 99999) == {}
+        await db.close()
+
+
+def _tpu_point(job_id, ago_secs, duty):
+    return {
+        "id": new_uuid(),
+        "job_id": job_id,
+        "timestamp": (now_utc() - timedelta(seconds=ago_secs)).isoformat(),
+        "cpu_usage_micro": 0,
+        "memory_usage_bytes": 0,
+        "tpu_metrics": dumps({"duty_cycle": duty}),
+    }
+
+
+class TestUtilizationPolicy:
+    CONF = {"type": "task", "commands": ["python train.py"]}
+    POLICY = {"utilization_policy": {"min_tpu_utilization": 40, "time_window": 600}}
+
+    async def test_idle_tpu_terminates(self):
+        db, job_row, run_row = await _setup(self.CONF, self.POLICY)
+        for ago in (590, 400, 200, 20):
+            await db.insert(
+                "job_metrics_points", _tpu_point(job_row["id"], ago, [5.0, 3.0])
+            )
+        fields = await _check_job_policies(db, job_row, run_row, 0)
+        assert (
+            fields["termination_reason"]
+            == JobTerminationReason.TERMINATED_DUE_TO_UTILIZATION_POLICY.value
+        )
+        await db.close()
+
+    async def test_busy_tpu_keeps_running(self):
+        db, job_row, run_row = await _setup(self.CONF, self.POLICY)
+        for ago in (590, 400, 200, 20):
+            await db.insert(
+                "job_metrics_points", _tpu_point(job_row["id"], ago, [5.0, 85.0])
+            )
+        assert await _check_job_policies(db, job_row, run_row, 0) == {}
+        await db.close()
+
+    async def test_insufficient_window_coverage_waits(self):
+        """A job that just started must not be judged on a sliver of the
+        window (reference waits for full window coverage)."""
+        db, job_row, run_row = await _setup(self.CONF, self.POLICY)
+        for ago in (60, 40, 20):
+            await db.insert(
+                "job_metrics_points", _tpu_point(job_row["id"], ago, [0.0])
+            )
+        assert await _check_job_policies(db, job_row, run_row, 0) == {}
+        await db.close()
+
+    async def test_no_tpu_metrics_no_action(self):
+        db, job_row, run_row = await _setup(self.CONF, self.POLICY)
+        for ago in (590, 300, 20):
+            p = _tpu_point(job_row["id"], ago, [])
+            p["tpu_metrics"] = dumps({})
+            await db.insert("job_metrics_points", p)
+        assert await _check_job_policies(db, job_row, run_row, 0) == {}
+        await db.close()
