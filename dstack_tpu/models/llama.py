@@ -237,8 +237,15 @@ def forward(
     positions: Optional[jax.Array] = None,
     lora: Optional[dict] = None,
     lora_scale: float = 1.0,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Token ids → logits [B, T, vocab] (f32).
+
+    With ``return_hidden=True`` returns the final normed hidden states
+    [B, T, hidden] (model dtype) instead — callers then apply the LM
+    head themselves (train/step.py fuses it into the loss so full-vocab
+    log-probabilities never hit HBM; see fused_cross_entropy /
+    chunked_cross_entropy there).
 
     ``lora`` is an adapter pytree from train/lora.py: stacked per-layer
     low-rank factors scanned together with the base weights — the
@@ -258,8 +265,15 @@ def forward(
         return x, None
 
     if c.remat:
+        # Save the flash-attention residuals (q/k/v/o/lse, tagged in
+        # ops/flash.py) across the remat boundary: the backward pass
+        # then reuses them instead of re-running the attention kernel,
+        # at ~80MB/layer — everything else is recomputed as usual.
         layer_fn = jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.nothing_saveable
+            layer_fn,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_residuals"
+            ),
         )
     xs = params["layers"]
     if lora is not None:
@@ -271,6 +285,8 @@ def forward(
         }
     x, _ = jax.lax.scan(layer_fn, x, xs)
     x = rms_norm(x, params["final_norm"], c.norm_eps)
+    if return_hidden:
+        return x
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bte,ev->btv", x, head.astype(c.dtype))
     logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)

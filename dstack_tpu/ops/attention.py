@@ -1,22 +1,32 @@
-"""Attention ops: pallas flash-attention TPU kernel + XLA fallback.
+"""Attention ops: dispatching entry point (pallas flash kernel / XLA).
 
 The hot op of every model (SURVEY.md's compute-plane requirement). The
-pallas kernel streams KV blocks through VMEM with online softmax, so HBM
-traffic is O(T·D) per query block instead of materializing the [T, T]
-score matrix; the MXU sees [block_q, D] × [D, block_k] matmuls.
-GQA is supported by mapping each Q head group onto its KV head.
-
-Falls back to a fused-by-XLA einsum path off-TPU (CPU tests, virtual
-meshes) and for shapes that don't tile (tiny test models).
+pallas kernels live in :mod:`dstack_tpu.ops.flash` — KV-block grid with
+double-buffered DMA streaming, online softmax, custom VJP with pallas
+backward kernels, GQA via index_map. This module keeps the
+shape/platform dispatch and the XLA fallback used off-TPU (CPU tests,
+virtual meshes) and for non-tiling shapes (decode steps, tiny models).
 """
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
+from dstack_tpu.ops.flash import (  # re-exported public kernel API
+    flash_attention,
+    flash_attention_with_lse,
+    flash_supported,
+)
+
 NEG_INF = -1e30
+
+__all__ = [
+    "attention",
+    "flash_attention",
+    "flash_attention_with_lse",
+    "flash_supported",
+]
 
 
 def _xla_attention(
@@ -43,116 +53,6 @@ def _xla_attention(
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
-def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int, causal: bool
-):
-    from jax.experimental import pallas as pl
-
-    block_q, d = q_ref.shape[2], q_ref.shape[3]
-    t = k_ref.shape[2]
-    qi = pl.program_id(2)
-
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [BQ, D]
-
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-
-    if causal:
-        # only KV blocks overlapping [0, (qi+1)*BQ) contribute
-        num_k = ((qi + 1) * block_q + block_k - 1) // block_k
-    else:
-        num_k = t // block_k
-
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [BQ, BK]
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
-
-    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-
-
-def _largest_dividing_block(t: int, cap: int, unit: int = 128) -> int:
-    """Largest multiple of ``unit`` that divides ``t`` and is ≤ cap."""
-    if t % unit != 0:
-        raise ValueError(f"sequence length {t} must be a multiple of {unit}")
-    b = min(cap - cap % unit, t)
-    while b > unit and t % b != 0:
-        b -= unit
-    if t % b != 0:
-        raise ValueError(f"no {unit}-multiple block divides T={t}")
-    return b
-
-
-def flash_attention(
-    q: jax.Array,  # [B, H, T, D]
-    k: jax.Array,  # [B, Hkv, T, D]
-    v: jax.Array,
-    *,
-    causal: bool = True,
-    scale: Optional[float] = None,
-    block_q: int = 256,
-    block_k: int = 512,
-    interpret: bool = False,
-) -> jax.Array:
-    from jax.experimental import pallas as pl
-
-    b, h, t, d = q.shape
-    hkv = k.shape[1]
-    assert h % hkv == 0
-    group = h // hkv
-    scale = scale if scale is not None else d**-0.5
-    # Blocks must divide T exactly: a partial tail block would silently
-    # drop keys (non-causal) or read out of bounds (causal).
-    block_q = _largest_dividing_block(t, block_q)
-    block_k = _largest_dividing_block(t, block_k)
-
-    kernel = functools.partial(
-        _flash_kernel, scale=scale, block_k=block_k, causal=causal
-    )
-    return pl.pallas_call(
-        kernel,
-        grid=(b, h, t // block_q),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, t, d), lambda b, h, qi: (b, h // group, 0, 0)),
-            pl.BlockSpec((1, 1, t, d), lambda b, h, qi: (b, h // group, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda b, h, qi: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=interpret,
-    )(q, k, v)
-
-
-def _flash_ok(q: jax.Array, k: jax.Array) -> bool:
-    b, h, t, d = q.shape
-    if jax.default_backend() != "tpu":
-        return False
-    # tiling constraints: last dim 128-multiple, seq tile-aligned
-    return d % 128 == 0 and t % 128 == 0 and k.shape[2] == t
-
-
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -165,6 +65,8 @@ def attention(
 ) -> jax.Array:
     """Dispatching attention entry point used by models."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    if impl == "flash" or (impl is None and q_offset == 0 and _flash_ok(q, k)):
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "flash" or (impl is None and flash_supported(q, k)):
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale, q_offset=q_offset
+        )
     return _xla_attention(q, k, v, causal=causal, scale=scale, q_offset=q_offset)

@@ -9,6 +9,21 @@ result exact (Liu et al., Ring Attention; blockwise softmax as in Flash
 Attention). Compute/communication overlap is left to XLA's latency
 hiding scheduler, which pipelines ppermute with the matmuls.
 
+Two per-step implementations:
+
+- **pallas** (default on TPU for tile-aligned shapes): the per-step
+  block runs the flash kernels from :mod:`dstack_tpu.ops.flash` — no
+  [Tq, Tk] score materialization, GQA KV rotates at KV-head width. The
+  ring has its own custom VJP: the backward pass makes a second ring
+  sweep in which dk/dv accumulators travel with their KV blocks a full
+  circle back to the owning device.
+- **xla** fallback (CPU tests, virtual meshes, non-tiling shapes):
+  einsum blockwise softmax.
+
+Causality is handled per ring step: blocks from earlier shards attend
+fully, the diagonal step uses the causal kernel, later shards are
+skipped (a `lax.switch` on the dynamic source index).
+
 No NCCL analog exists or is needed: this *is* the distributed
 communication backend for the sequence dimension.
 """
@@ -21,7 +36,14 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dstack_tpu.ops.flash import _flash_bwd, _flash_fwd
+
 NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# XLA fallback path (small/odd shapes, CPU virtual meshes)
+# ---------------------------------------------------------------------------
 
 
 def _block_attention(
@@ -63,36 +85,8 @@ def _causal_bias(tq: int, tk: int, q_offset, k_offset, dtype=jnp.float32) -> jax
     return jnp.where(qi >= kj, 0.0, NEG_INF).astype(dtype)[None, None]
 
 
-def ring_attention(
-    q: jax.Array,  # [B, H, T_local, D] — seq sharded over "sp"
-    k: jax.Array,  # [B, Hkv, T_local, D]
-    v: jax.Array,  # [B, Hkv, T_local, D]
-    *,
-    mesh: Mesh,
-    causal: bool = True,
-    scale: Optional[float] = None,
-    axis_name: str = "sp",
-) -> jax.Array:
-    """Exact multi-device attention with KV rotating around the ``sp`` ring.
-
-    Inputs/outputs are *global* arrays (sharded over ``axis_name`` on the
-    sequence dim); internally runs as shard_map.
-    """
-    sp = mesh.shape[axis_name]
-    if sp == 1:
-        from dstack_tpu.ops.attention import attention as local_attention
-
-        return local_attention(q, k, v, causal=causal, scale=scale)
-
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    if k.shape[1] != q.shape[1]:  # GQA: expand KV heads before the ring
-        assert q.shape[1] % k.shape[1] == 0
-        rep = q.shape[1] // k.shape[1]
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
-
-    # batch/head dims follow the outer sharding; seq is sharded over sp.
-    qkv_spec = P(None, None, axis_name, None)
+def _ring_xla_local(sp: int, axis_name: str, causal: bool, scale: float):
+    """Per-shard ring attention body, einsum blocks (KV at full Q heads)."""
 
     def local_fn(q, k, v):
         idx = jax.lax.axis_index(axis_name)
@@ -124,10 +118,199 @@ def ring_attention(
         l = jnp.where(l == 0.0, 1.0, l)
         return (o / l[..., None]).astype(q.dtype)
 
+    return local_fn
+
+
+# ---------------------------------------------------------------------------
+# pallas path: flash kernels per ring step, custom VJP
+# ---------------------------------------------------------------------------
+
+
+def _merge_lse(o, lse, o2, lse2):
+    """Merge normalized partials by logsumexp weights.
+
+    o/o2 [B, H, T, D] f32 (o2 may be model dtype), lse/lse2 [B, H, T, 1].
+    """
+    m = jnp.maximum(lse, lse2)
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    w1 = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(lse - m_safe))
+    w2 = jnp.where(lse2 <= NEG_INF / 2, 0.0, jnp.exp(lse2 - m_safe))
+    denom = w1 + w2
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    o_new = (o * w1 + o2.astype(jnp.float32) * w2) / denom
+    lse_new = m_safe + jnp.log(denom)
+    lse_new = jnp.where(m <= NEG_INF / 2, jnp.full_like(m, NEG_INF), lse_new)
+    return o_new, lse_new
+
+
+def _make_ring_pallas(
+    sp: int,
+    axis_name: str,
+    causal: bool,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+):
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    kw = dict(
+        block_q=block_q, block_k=block_k, q_offset=0, kv_offset=0,
+        interpret=interpret,
+    )
+
+    def branch_index(src, idx):
+        if not causal:
+            return jnp.int32(1)  # always full attention
+        return jnp.where(src > idx, 0, jnp.where(src < idx, 1, 2))
+
+    @jax.custom_vjp
+    def ring(q, k, v):
+        o, _ = _ring_fwd(q, k, v)
+        return o
+
+    def _ring_fwd(q, k, v):
+        idx = jax.lax.axis_index(axis_name)
+        b, h, tl, d = q.shape
+
+        def f_skip(q, kb, vb):
+            return (
+                jnp.zeros(q.shape, q.dtype),
+                jnp.full((b, h, tl, 1), NEG_INF, jnp.float32),
+            )
+
+        def f_full(q, kb, vb):
+            return _flash_fwd(q, kb, vb, False, scale, **kw)
+
+        def f_diag(q, kb, vb):
+            return _flash_fwd(q, kb, vb, True, scale, **kw)
+
+        def step(carry, r):
+            o, lse, kb, vb = carry
+            src = (idx - r) % sp
+            ob, lseb = jax.lax.switch(
+                branch_index(src, idx), (f_skip, f_full, f_diag), q, kb, vb
+            )
+            o, lse = _merge_lse(o, lse, ob, lseb)
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+            return (o, lse, kb, vb), None
+
+        o0 = jnp.zeros(q.shape, jnp.float32)
+        lse0 = jnp.full((b, h, tl, 1), NEG_INF, jnp.float32)
+        (o, lse, _, _), _ = jax.lax.scan(step, (o0, lse0, k, v), jnp.arange(sp))
+        return o.astype(q.dtype), lse
+
+    def ring_fwd(q, k, v):
+        o, lse = _ring_fwd(q, k, v)
+        return o, (q, k, v, o, lse)
+
+    def ring_bwd(res, do):
+        q, k, v, o, lse = res
+        idx = jax.lax.axis_index(axis_name)
+
+        def b_skip(q, kb, vb):
+            return (
+                jnp.zeros(q.shape, q.dtype),
+                jnp.zeros(kb.shape, kb.dtype),
+                jnp.zeros(vb.shape, vb.dtype),
+            )
+
+        def b_full(q, kb, vb):
+            return _flash_bwd(q, kb, vb, o, lse, do, False, scale, **kw)
+
+        def b_diag(q, kb, vb):
+            return _flash_bwd(q, kb, vb, o, lse, do, True, scale, **kw)
+
+        def step(carry, r):
+            dq, kb, vb, dkb, dvb = carry
+            src = (idx - r) % sp
+            dq_p, dk_p, dv_p = jax.lax.switch(
+                branch_index(src, idx), (b_skip, b_full, b_diag), q, kb, vb
+            )
+            dq = dq + dq_p.astype(jnp.float32)
+            dkb = dkb + dk_p.astype(jnp.float32)
+            dvb = dvb + dv_p.astype(jnp.float32)
+            # rotate KV *and* their gradient accumulators; after sp
+            # rotations the dk/dv buffers land back on the owner.
+            kb, vb, dkb, dvb = (
+                jax.lax.ppermute(x, axis_name, perm) for x in (kb, vb, dkb, dvb)
+            )
+            return (dq, kb, vb, dkb, dvb), None
+
+        dq0 = jnp.zeros(q.shape, jnp.float32)
+        dk0 = jnp.zeros(k.shape, jnp.float32)
+        dv0 = jnp.zeros(v.shape, jnp.float32)
+        (dq, _, _, dk, dv), _ = jax.lax.scan(
+            step, (dq0, k, v, dk0, dv0), jnp.arange(sp)
+        )
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+def _pallas_ok(h: int, hkv: int, t_local: int, d: int, interpret: bool) -> bool:
+    if not interpret and jax.default_backend() != "tpu":
+        return False
+    return d % 64 == 0 and t_local % 128 == 0 and h % hkv == 0
+
+
+def ring_attention(
+    q: jax.Array,  # [B, H, T, D] — seq sharded over "sp"
+    k: jax.Array,  # [B, Hkv, T, D]
+    v: jax.Array,  # [B, Hkv, T, D]
+    *,
+    mesh: Mesh,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis_name: str = "sp",
+    impl: Optional[str] = None,  # None=auto | "pallas" | "xla"
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: bool = False,
+) -> jax.Array:
+    """Exact multi-device attention with KV rotating around the ``sp`` ring.
+
+    Inputs/outputs are *global* arrays (sharded over ``axis_name`` on the
+    sequence dim); internally runs as shard_map.
+    """
+    sp = mesh.shape[axis_name]
+    if sp == 1:
+        from dstack_tpu.ops.attention import attention as local_attention
+
+        return local_attention(q, k, v, causal=causal, scale=scale)
+
+    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
+    t_local = q.shape[2] // sp
+    use_pallas = impl == "pallas" or (
+        impl is None
+        and _pallas_ok(q.shape[1], k.shape[1], t_local, q.shape[3], interpret)
+    )
+
+    if use_pallas:
+        # GQA KV stays at KV-head width: the flash kernels group
+        # natively, and the ring rotates the smaller buffers.
+        local_fn = _make_ring_pallas(
+            sp, axis_name, causal, scale, block_q, block_k, interpret
+        )
+    else:
+        if k.shape[1] != q.shape[1]:  # GQA: expand KV heads before the ring
+            assert q.shape[1] % k.shape[1] == 0
+            rep = q.shape[1] // k.shape[1]
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        local_fn = _ring_xla_local(sp, axis_name, causal, scale)
+
+    spec = P(None, None, axis_name, None)  # seq sharded; heads follow outer
     return shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
-        out_specs=qkv_spec,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
         check_rep=False,
     )(q, k, v)

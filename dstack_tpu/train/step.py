@@ -14,7 +14,12 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dstack_tpu.models import llama
-from dstack_tpu.parallel.sharding import ShardingRules, default_rules, tree_shardings
+from dstack_tpu.parallel.sharding import (
+    ShardingRules,
+    constrain,
+    default_rules,
+    tree_shardings,
+)
 
 
 def cross_entropy_loss(
@@ -30,6 +35,90 @@ def cross_entropy_loss(
     mask = mask.astype(jnp.float32)
     total = jnp.maximum(mask.sum(), 1.0)
     return -(ll * mask).sum() / total, total
+
+
+def fused_cross_entropy(
+    x: jax.Array,  # [B, T, H] final hidden (model dtype)
+    head: jax.Array,  # [H, V]
+    targets: jax.Array,  # [B, T] int32
+    mask: Optional[jax.Array],  # [B, T] 0/1
+    rules: Optional[ShardingRules] = None,
+    mesh: Optional[Mesh] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Cross-entropy in logsumexp form: loss = lse(logits) − logit[y].
+
+    Never materializes a full-vocab f32 log-*probability* tensor (a
+    second ~4 GB allocation in the naive log_softmax+gather form): only
+    the f32-accumulated logits exist, consumed by logsumexp/gather
+    reductions whose outputs are [B, T]. On tensor-parallel meshes the
+    logits are constrained over the vocab axis (pass rules+mesh).
+    """
+    logits = jnp.einsum(
+        "bth,hv->btv", x, head, preferred_element_type=jnp.float32
+    )
+    if rules is not None:
+        logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
+    lse = jax.nn.logsumexp(logits, axis=-1)  # [B, T]
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        mask = jnp.ones_like(targets, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    total = jnp.maximum(mask.sum(), 1.0)
+    return ((lse - tgt) * mask).sum() / total, total
+
+
+def chunked_cross_entropy(
+    x: jax.Array,  # [B, T, H] final hidden (model dtype)
+    head: jax.Array,  # [H, V]
+    targets: jax.Array,  # [B, T] int32
+    mask: Optional[jax.Array],  # [B, T] 0/1
+    max_chunk_bytes: int = 256 * 1024 * 1024,
+    rules: Optional[ShardingRules] = None,
+    mesh: Optional[Mesh] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """LM-head matmul fused into the loss, chunked over the sequence.
+
+    Full-vocab f32 logits for a Llama vocab are ~4 GB at [8, 1024, 128k]
+    — the single largest HBM allocation of a train step. Scanning the
+    head+softmax over sequence chunks (with remat on the chunk body so
+    the backward recomputes chunk logits) keeps peak HBM at one chunk of
+    logits while the MXU still sees large [B·Tc, H]×[H, V] matmuls.
+    """
+    b, t, h = x.shape
+    v = head.shape[-1]
+    if mask is None:
+        mask = jnp.ones_like(targets, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    # pick the largest chunk count (dividing T) that fits the budget
+    chunk_bytes = lambda c: b * (t // c) * v * 4
+    c = 1
+    while c < t and (chunk_bytes(c) > max_chunk_bytes or t % c != 0):
+        c += 1
+    while t % c != 0:
+        c += 1
+    tc = t // c
+
+    xs = jnp.moveaxis(x.reshape(b, c, tc, h), 1, 0)  # [C, B, Tc, H]
+    ts = jnp.moveaxis(targets.reshape(b, c, tc), 1, 0)
+    ms = jnp.moveaxis(mask.reshape(b, c, tc), 1, 0)
+
+    def chunk(carry, xtm):
+        xc, tcg, mc = xtm
+        logits = jnp.einsum(
+            "bth,hv->btv", xc, head, preferred_element_type=jnp.float32
+        )
+        if rules is not None:
+            logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, tcg[..., None], axis=-1)[..., 0]
+        nll, w = carry
+        return (nll - (ll * mc).sum(), w + mc.sum()), None
+
+    (nll, w), _ = jax.lax.scan(
+        jax.checkpoint(chunk), (jnp.zeros(()), jnp.zeros(())), (xs, ts, ms)
+    )
+    total = jnp.maximum(w, 1.0)
+    return nll / total, total
 
 
 def default_optimizer(
@@ -118,9 +207,15 @@ def make_train_step(
     mesh: Mesh,
     rules: Optional[ShardingRules] = None,
     attn_impl: Optional[str] = None,
+    loss_impl: str = "fused",  # "fused" | "chunked"
 ) -> Callable:
     """Build the jitted train step: (state, batch{tokens,targets,mask}) →
-    (state, metrics)."""
+    (state, metrics).
+
+    ``loss_impl`` picks the LM-head/loss fusion: "fused" (one f32-
+    accumulated logits tensor, reductions fused — fastest) or "chunked"
+    (sequence-chunked scan with remat — lowest peak HBM, for memory-
+    tight configs)."""
     rules = rules or default_rules()
     shardings = state_specs(config, optimizer, rules, mesh)
     b_sh = batch_sharding(mesh, rules)
@@ -128,10 +223,22 @@ def make_train_step(
     repl = NamedSharding(mesh, P())
 
     def loss_fn(params, batch):
-        logits = llama.forward(
-            params, batch["tokens"], config, mesh=mesh, rules=rules, attn_impl=attn_impl
+        x = llama.forward(
+            params, batch["tokens"], config, mesh=mesh, rules=rules,
+            attn_impl=attn_impl, return_hidden=True,
         )
-        loss, _ = cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+        head = (
+            params["embed"].T if config.tie_embeddings else params["lm_head"]
+        ).astype(config.dtype)
+        if loss_impl == "chunked":
+            loss, _ = chunked_cross_entropy(
+                x, head, batch["targets"], batch.get("mask"),
+                rules=rules, mesh=mesh,
+            )
+        else:
+            loss, _ = fused_cross_entropy(
+                x, head, batch["targets"], batch.get("mask"), rules=rules, mesh=mesh
+            )
         return loss
 
     def step(state, batch):
