@@ -369,12 +369,41 @@ class Executor {
       std::string url = repo["repo_url"].as_string();
       std::string branch = repo["repo_branch"].as_string();
       std::string hash = repo["repo_hash"].as_string();
-      std::string cmd = "git clone";
+      // Private-repo credentials, parity with the Python runner's
+      // _setup_repo: the token is served through GIT_ASKPASS from a
+      // 0600 file — never embedded in the URL, where it would land in
+      // .git/config and in git's error output.
+      std::string token = repo["repo_creds"]["oauth_token"].as_string();
+      std::string env_prefix;
+      std::string askpass_path = home_dir_ + "/.git-askpass";
+      std::string token_path = home_dir_ + "/.git-token";
+      bool have_creds = !token.empty() && url.rfind("https://", 0) == 0;
+      if (have_creds) {
+        url = "https://oauth2@" + url.substr(8);
+        {
+          std::ofstream tf(token_path);
+          tf << token;
+        }
+        ::chmod(token_path.c_str(), 0600);
+        {
+          std::ofstream af(askpass_path);
+          af << "#!/bin/sh\ncat " << shq(token_path) << "\n";
+        }
+        ::chmod(askpass_path.c_str(), 0700);
+        env_prefix =
+            "GIT_ASKPASS=" + shq(askpass_path) + " GIT_TERMINAL_PROMPT=0 ";
+      }
+      std::string cmd = env_prefix + "git clone";
       if (hash.empty()) cmd += " --depth 1";
       if (!branch.empty()) cmd += " -b " + shq(branch);
       cmd += " " + shq(url) + " " + shq(workdir) + " 2>&1";
-      rlog("cloning " + url);
-      if (system(cmd.c_str()) != 0) {
+      rlog("cloning " + repo["repo_url"].as_string());
+      int clone_rc = system(cmd.c_str());
+      if (have_creds) {
+        ::unlink(askpass_path.c_str());
+        ::unlink(token_path.c_str());
+      }
+      if (clone_rc != 0) {
         push_state({"failed", now_unix(), "executor_error", "git clone failed",
                     std::nullopt});
         return false;

@@ -85,6 +85,7 @@ class Executor:
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
         self.no_connections_since: Optional[float] = None
+        self._secrets: list[str] = []  # scrubbed from error messages
 
     # -- state/log pumps --
 
@@ -135,18 +136,33 @@ class Executor:
             raise ValueError("no job submitted")
         self._task = asyncio.create_task(self._run_job())
 
-    async def _git(self, args: list[str], cwd: Optional[Path] = None) -> str:
+    def _redact(self, text: str) -> str:
+        """Scrub registered secrets (repo tokens) from any text that can
+        reach job state, the DB, or logs."""
+        for s in self._secrets:
+            if s:
+                text = text.replace(s, "***")
+        return text
+
+    async def _git(
+        self,
+        args: list[str],
+        cwd: Optional[Path] = None,
+        env: Optional[dict] = None,
+    ) -> str:
         proc = await asyncio.create_subprocess_exec(
             "git",
             *args,
             cwd=cwd,
+            env=env,
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.STDOUT,
         )
         out, _ = await proc.communicate()
         if proc.returncode != 0:
             raise RuntimeError(
-                f"git {args[0]} failed: {out.decode(errors='replace')[-500:]}"
+                f"git {args[0]} failed: "
+                f"{self._redact(out.decode(errors='replace')[-500:])}"
             )
         return out.decode(errors="replace")
 
@@ -165,13 +181,36 @@ class Executor:
                 cmd += ["-b", repo["repo_branch"]]
             url = repo["repo_url"]
             creds = repo.get("repo_creds") or {}
-            if creds.get("oauth_token") and url.startswith("https://"):
-                url = url.replace(
-                    "https://", f"https://oauth2:{creds['oauth_token']}@", 1
-                )
+            token = creds.get("oauth_token")
+            env = None
+            askpass = None
+            if token and url.startswith("https://"):
+                # Never embed the token in the URL: it would land in
+                # .git/config and in git's error output (which is
+                # persisted as the job's failed-state message). Instead
+                # the username goes in the URL and the secret is served
+                # by a GIT_ASKPASS helper reading a 0600 file.
+                self._secrets.append(token)
+                url = url.replace("https://", "https://oauth2@", 1)
+                token_file = self.home_dir / ".git-token"
+                token_file.write_text(token)
+                token_file.chmod(0o600)
+                askpass = self.home_dir / ".git-askpass"
+                askpass.write_text(f"#!/bin/sh\ncat {shlex.quote(str(token_file))}\n")
+                askpass.chmod(0o700)
+                env = {
+                    **os.environ,
+                    "GIT_ASKPASS": str(askpass),
+                    "GIT_TERMINAL_PROMPT": "0",
+                }
             cmd += [url, str(workdir)]
             self._rlog(f"cloning {repo['repo_url']}")
-            await self._git(cmd)
+            try:
+                await self._git(cmd, env=env)
+            finally:
+                if askpass is not None:
+                    askpass.unlink(missing_ok=True)
+                    (self.home_dir / ".git-token").unlink(missing_ok=True)
             if repo.get("repo_hash"):
                 try:
                     await self._git(
@@ -246,7 +285,9 @@ class Executor:
         try:
             await self._setup_repo(workdir)
         except Exception as e:
-            self._push_state("failed", reason="executor_error", message=str(e))
+            self._push_state(
+                "failed", reason="executor_error", message=self._redact(str(e))
+            )
             return
 
         env = dict(os.environ)
@@ -278,7 +319,9 @@ class Executor:
                 start_new_session=True,  # own process group for clean kill
             )
         except FileNotFoundError as e:
-            self._push_state("failed", reason="executor_error", message=str(e))
+            self._push_state(
+                "failed", reason="executor_error", message=self._redact(str(e))
+            )
             return
 
         pump = asyncio.create_task(self._pump_logs())

@@ -155,9 +155,18 @@ def plan_attachment(run: Run) -> tuple[dict[int, int], Optional[dict], int]:
     if job_spec.service_port and job_spec.service_port not in container_ports:
         container_ports.append(job_spec.service_port)
     runtime_ports = (sub.job_runtime_data.ports or {}) if sub.job_runtime_data else {}
+    # NAT'd environments (kubernetes NodePort) publish the in-host ports
+    # elsewhere: this worker's port_map translates them (same lookup as
+    # the server's _runner_port).
+    port_map: dict = {}
+    for h in jpd.hosts:
+        if h.worker_id == jpd.worker_id and h.port_map:
+            port_map = h.port_map
+            break
 
     def on_host(port: int) -> int:
-        return int(runtime_ports.get(port) or runtime_ports.get(str(port)) or port)
+        p = int(runtime_ports.get(port) or runtime_ports.get(str(port)) or port)
+        return int(port_map.get(str(p), port_map.get(p, p)))
 
     host_ports = {int(c): on_host(c) for c in container_ports}
     return host_ports, jpd.model_dump(), on_host(CONTAINER_SSH_PORT)

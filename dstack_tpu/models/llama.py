@@ -253,7 +253,14 @@ def forward(
     """
     c = config
     rules = rules or default_rules()
-    x = params["embed"].at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
+    # Replicate the embed table for the token lookup: a gather from the
+    # (vocab-tp, hidden-fsdp)-sharded table would produce hidden-sharded
+    # activations that GSPMD can only reshard to batch/seq sharding by
+    # full rematerialization (an involuntary-remat warning and an extra
+    # copy). An explicit all-gather of the table lets the gather output
+    # inherit the token indices' batch/seq sharding directly.
+    embed = constrain(params["embed"], rules, None, None, mesh=mesh)
+    x = embed.at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
     x = constrain(x, rules, "batch", "seq", None, mesh=mesh)
     t = tokens.shape[1]
     pos = positions if positions is not None else jnp.arange(t)

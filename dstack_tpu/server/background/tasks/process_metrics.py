@@ -34,9 +34,12 @@ async def _collect_job(db: Database, job_row: dict) -> None:
     if jpd_raw is None:
         return
     jpd = JobProvisioningData.model_validate(jpd_raw)
-    jrd = loads(job_row.get("job_runtime_data")) or {}
-    ports = jrd.get("ports") or {}
-    runner_port = next(iter(ports.values()), 10999)
+    # _runner_port applies the NodePort port_map translation — without it
+    # kubernetes jobs would be dialed on the in-cluster port and every
+    # sample would fail silently.
+    from dstack_tpu.server.background.tasks.process_running_jobs import _runner_port
+
+    runner_port = _runner_port(job_row, jpd)
     async with runner_client_for(
         jpd, int(runner_port), db=db, project_id=job_row["project_id"]
     ) as runner:

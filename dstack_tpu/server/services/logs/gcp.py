@@ -99,10 +99,14 @@ class GCPLogStorage:
             parts.append(f'timestamp>"{start_time.isoformat()}"')
         # cursor contract (matches FileLogStorage): next_token must ALWAYS
         # be resumable — clients loop `token = batch.next_token or token`
-        # until an empty page. Cloud Logging page tokens end with None on
-        # the last page, so past it we hand out a timestamp cursor
+        # until an empty page. We only ever *issue* timestamp cursors
         # "ts:<iso>:<n>" where n = events already seen AT that timestamp
-        # (>= filter + skip, so same-timestamp bursts are never lost).
+        # (>= filter + skip, so same-timestamp bursts are never lost or
+        # re-delivered). Native Cloud Logging page tokens are still
+        # *accepted* (tokens issued by older builds) but not issued:
+        # a ts cursor derived mid-stream from a native page could not
+        # count same-timestamp events on earlier pages and would
+        # re-deliver them.
         page_token = None
         skip_at_cursor = 0
         cursor_ts: Optional[str] = None
@@ -138,18 +142,16 @@ class GCPLogStorage:
                         log_source=LogEventSource(payload.get("source", "stdout")),
                     )
                 )
-        token = getattr(pager, "next_page_token", None)
-        if token is None:
-            if events:
-                last_ts = events[-1].timestamp.isoformat()
-                n_at_last = sum(
-                    1 for ev in events if ev.timestamp.isoformat() == last_ts
-                )
-                if cursor_ts == last_ts:
-                    n_at_last += skip_at_cursor
-                token = f"ts:{last_ts}:{n_at_last}"
-            else:
-                token = next_token  # no progress; echo the cursor back
+        if events:
+            last_ts = events[-1].timestamp.isoformat()
+            n_at_last = sum(
+                1 for ev in events if ev.timestamp.isoformat() == last_ts
+            )
+            if cursor_ts == last_ts:
+                n_at_last += skip_at_cursor
+            token = f"ts:{last_ts}:{n_at_last}"
+        else:
+            token = next_token  # no progress; echo the cursor back
         return JobSubmissionLogs(logs=events, next_token=token)
 
 

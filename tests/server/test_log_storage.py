@@ -2,6 +2,7 @@
 client, backend selection, file fallback (reference
 server/services/logs/{gcp,filelog}.py)."""
 
+import re
 from datetime import datetime, timedelta, timezone
 
 from dstack_tpu.core.models.logs import LogEvent, LogEventSource
@@ -42,10 +43,20 @@ class FakeGCPClient:
 
     def list_entries(self, filter_, order_by, page_size, page_token=None):
         self.filters.append(filter_)
+        entries = self.entries
+        # honor timestamp filters like the real Cloud Logging API does
+        m = re.search(r'timestamp(>=|>)"([^"]+)"', filter_)
+        if m:
+            op, iso = m.groups()
+            bound = datetime.fromisoformat(iso)
+            entries = [
+                e for e in entries
+                if (e[2] >= bound if op == ">=" else e[2] > bound)
+            ]
         offset = int(page_token) if page_token else 0
         selected = [
             FakeEntry(ts, dict(payload))
-            for payload, labels, ts in self.entries[offset : offset + page_size]
+            for payload, labels, ts in entries[offset : offset + page_size]
         ]
         nt = (
             str(offset + page_size)
@@ -83,15 +94,33 @@ class TestGCPLogStorage:
         assert logs.next_token and logs.next_token.startswith("ts:")
 
     def test_pagination_token(self):
+        """Only ts cursors are issued (a ts cursor derived from a native
+        page boundary could undercount same-timestamp events and
+        re-deliver them); looping on the cursor delivers the whole
+        stream in order, without duplicates."""
         client = FakeGCPClient()
         storage = GCPLogStorage(client=client)
         storage.write_logs("main", "r", "r-0-0", _events(5))
-        page1 = storage.poll_logs("main", "r", "r-0-0", limit=2)
-        assert len(page1.logs) == 2 and page1.next_token == "2"
-        page2 = storage.poll_logs(
-            "main", "r", "r-0-0", limit=2, next_token=page1.next_token
-        )
-        assert [ev.text() for ev in page2.logs] == ["line-2\n", "line-3\n"]
+        collected, token = [], None
+        for _ in range(10):
+            page = storage.poll_logs(
+                "main", "r", "r-0-0", limit=2, next_token=token
+            )
+            assert page.next_token.startswith("ts:")
+            if not page.logs and token == page.next_token:
+                break
+            collected += [ev.text() for ev in page.logs]
+            token = page.next_token
+        assert collected == [f"line-{i}\n" for i in range(5)]
+
+    def test_legacy_page_token_accepted(self):
+        """Native page tokens issued by older builds still resume."""
+        client = FakeGCPClient()
+        storage = GCPLogStorage(client=client)
+        storage.write_logs("main", "r", "r-0-0", _events(5))
+        page = storage.poll_logs("main", "r", "r-0-0", limit=2, next_token="2")
+        assert [ev.text() for ev in page.logs] == ["line-2\n", "line-3\n"]
+        assert page.next_token.startswith("ts:")
 
     def test_ts_cursor_same_timestamp_no_duplicates(self):
         """Past the last Cloud Logging page the cursor is ts:<iso>:<n>;
