@@ -234,30 +234,42 @@ async def _provision_sibling(
         return
     offers = await compute.get_offers(job_spec.requirements)
     offers = [o for o in offers if o.region == master_jpd.region]
+    offers = offers[: settings.MAX_OFFERS_TRIED]
     if not offers:
         await _fail_no_capacity(db, job_row, "no sibling offers in master region")
         return
     instance_name = f"{run_row['run_name']}-{job_spec.replica_num}-{job_spec.job_num}"
     sibling_run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
-    try:
-        jpd = await compute.create_instance(
-            offers[0],
-            InstanceConfiguration(
-                project_name=project_row["name"],
-                instance_name=instance_name,
-                ssh_public_keys=await _instance_ssh_keys(
-                    db, project_row, sibling_run_spec
-                ),
-            ),
-        )
-    except Exception as e:
-        await _fail_no_capacity(db, job_row, f"sibling provisioning failed: {e}")
+    config = InstanceConfiguration(
+        project_name=project_row["name"],
+        instance_name=instance_name,
+        ssh_public_keys=await _instance_ssh_keys(db, project_row, sibling_run_spec),
+    )
+    # Walk offers like the master path (reference
+    # process_submitted_jobs.py:180-331 tries up to MAX_OFFERS_TRIED
+    # offers); a single stockout must not fail the whole node.
+    jpd = None
+    chosen_offer = None
+    for offer in offers:
+        try:
+            jpd = await compute.create_instance(offer, config)
+            chosen_offer = offer
+            break
+        except Exception as e:
+            logger.warning(
+                "sibling create_instance failed on %s (%s): %s",
+                offer.instance.name,
+                offer.region,
+                e,
+            )
+    if jpd is None or chosen_offer is None:
+        await _fail_no_capacity(db, job_row, "all sibling offers failed to provision")
         return
     inst_row = await instances_service.create_instance_row(
         db,
         project_row,
         name=instance_name,
-        offer=offers[0],
+        offer=chosen_offer,
         fleet_id=run_row.get("fleet_id"),
         instance_num=job_spec.job_num,
         status=InstanceStatus.PROVISIONING,

@@ -109,6 +109,7 @@ class FakeCompute(Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinod
         self.offers = offers if offers is not None else [tpu_offer()]
         self.fail_create = fail_create
         self.delay_ips = delay_ips
+        self.fail_next = 0  # fail this many upcoming create calls, then succeed
         self.created: list[InstanceConfiguration] = []
         self.terminated: list[str] = []
         self._counter = 0
@@ -131,6 +132,9 @@ class FakeCompute(Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinod
     async def create_instance(self, instance_offer, instance_config):
         if self.fail_create:
             raise RuntimeError("fake provisioning failure")
+        if self.fail_next > 0:
+            self.fail_next -= 1
+            raise RuntimeError("fake transient stockout")
         self.created.append(instance_config)
         self._counter += 1
         tpu = instance_offer.instance.resources.tpu

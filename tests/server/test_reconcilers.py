@@ -104,6 +104,34 @@ class TestSubmittedJobs:
         jpd3 = loads(jobs[3]["job_provisioning_data"])
         assert jpd3["hostname"].startswith("10.0.")
 
+    async def test_sibling_provisioning_walks_offers(self):
+        """Non-slice multinode: worker nodes provision separate
+        instances; one stockout must not fail the node (reference walks
+        MAX_OFFERS_TRIED offers, process_submitted_jobs.py:180-331)."""
+        from dstack_tpu.server.testing.common import cpu_offer
+
+        offers = [cpu_offer(price=0.5), cpu_offer(price=0.6)]
+        db, user_row, project_row, compute = await _setup(offers=offers)
+        conf = {
+            "type": "task",
+            "nodes": 2,
+            "commands": ["python train.py"],
+            "resources": {"cpu": "8"},
+        }
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "siblings")
+        )
+        await process_submitted_jobs(db)  # master provisions
+        compute.fail_next = 1  # first sibling offer stocks out
+        await process_submitted_jobs(db)  # worker 1 retries onto offer 2
+        jobs = await db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY job_num", (run.id,)
+        )
+        assert len(jobs) == 2
+        assert all(j["status"] == JobStatus.PROVISIONING.value for j in jobs)
+        assert len(compute.created) == 2  # master + sibling (second offer)
+        assert len({j["instance_id"] for j in jobs}) == 2
+
     async def test_pool_reuse(self):
         db, user_row, project_row, compute = await _setup()
         run1 = await runs_service.submit_run(
