@@ -41,6 +41,12 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Mixture-of-Experts (models/moe.py): n_experts == 0 → dense MLP
+    n_experts: int = 0
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_balance_coef: float = 0.01
+    router_z_coef: float = 1e-3
 
     @property
     def q_dim(self) -> int:
@@ -52,9 +58,26 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         e, h = self.vocab_size * self.hidden_size, self.hidden_size
+        n_mlp = max(1, self.n_experts)
         per_layer = (
             h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
-            + 3 * h * self.intermediate_size + 2 * h
+            + n_mlp * 3 * h * self.intermediate_size + 2 * h
+            + (h * self.n_experts if self.n_experts else 0)
+        )
+        out = 0 if self.tie_embeddings else e
+        return e + self.n_layers * per_layer + h + out
+
+    def num_active_params(self) -> int:
+        """Parameters touched per token: for MoE, only the
+        ``experts_per_token`` routed experts' FFNs count (MFU/FLOPs
+        estimates must use this, not :meth:`num_params`)."""
+        if not self.n_experts:
+            return self.num_params()
+        e, h = self.vocab_size * self.hidden_size, self.hidden_size
+        per_layer = (
+            h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
+            + self.experts_per_token * 3 * h * self.intermediate_size + 2 * h
+            + h * self.n_experts  # router
         )
         out = 0 if self.tie_embeddings else e
         return e + self.n_layers * per_layer + h + out
@@ -78,6 +101,15 @@ LLAMA_TINY = LlamaConfig(  # for tests / virtual meshes
     head_dim=32, intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
     remat=False,
 )
+MIXTRAL_8X7B = LlamaConfig(
+    vocab_size=32000, hidden_size=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    intermediate_size=14336, rope_theta=1e6, n_experts=8, experts_per_token=2,
+)
+MOE_TINY = LlamaConfig(  # for tests / virtual meshes
+    vocab_size=512, hidden_size=128, n_layers=2, n_heads=4, n_kv_heads=2,
+    head_dim=32, intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
+    remat=False, n_experts=4, experts_per_token=2, capacity_factor=2.0,
+)
 
 CONFIGS = {
     "llama-3-8b": LLAMA_3_8B,
@@ -85,12 +117,29 @@ CONFIGS = {
     "llama-3.2-1b": LLAMA_32_1B,
     "llama-3.2-3b": LLAMA_32_3B,
     "llama-tiny": LLAMA_TINY,
+    "mixtral-8x7b": MIXTRAL_8X7B,
+    "moe-tiny": MOE_TINY,
 }
 
 
 def param_specs(config: LlamaConfig) -> dict:
     """Logical-axis tree matching :func:`init_params` output."""
     L = ("layers",)
+    if config.n_experts:
+        mlp = {
+            "mlp_norm": L + (None,),
+            "w_router": L + ("embed_fsdp", None),
+            "w_gate": L + ("experts", "embed_fsdp", "mlp"),
+            "w_up": L + ("experts", "embed_fsdp", "mlp"),
+            "w_down": L + ("experts", "mlp", "embed_fsdp"),
+        }
+    else:
+        mlp = {
+            "mlp_norm": L + (None,),
+            "w_gate": L + ("embed_fsdp", "mlp"),
+            "w_up": L + ("embed_fsdp", "mlp"),
+            "w_down": L + ("mlp", "embed_fsdp"),
+        }
     specs = {
         "embed": ("vocab", "embed_fsdp"),
         "layers": {
@@ -99,10 +148,7 @@ def param_specs(config: LlamaConfig) -> dict:
             "wk": L + ("embed_fsdp", "kv_heads"),
             "wv": L + ("embed_fsdp", "kv_heads"),
             "wo": L + ("heads", "embed_fsdp"),
-            "mlp_norm": L + (None,),
-            "w_gate": L + ("embed_fsdp", "mlp"),
-            "w_up": L + ("embed_fsdp", "mlp"),
-            "w_down": L + ("mlp", "embed_fsdp"),
+            **mlp,
         },
         "final_norm": (None,),
     }
@@ -121,6 +167,26 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
 
     L = c.n_layers
+    if c.n_experts:
+        E = c.n_experts
+        mlp = {
+            "mlp_norm": jnp.ones((L, c.hidden_size), dt),
+            "w_router": normal(
+                jax.random.fold_in(key, 7), (L, c.hidden_size, E)
+            ),
+            "w_gate": normal(k[5], (L, E, c.hidden_size, c.intermediate_size)),
+            "w_up": normal(k[6], (L, E, c.hidden_size, c.intermediate_size)),
+            "w_down": normal(
+                k[7], (L, E, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)
+            ),
+        }
+    else:
+        mlp = {
+            "mlp_norm": jnp.ones((L, c.hidden_size), dt),
+            "w_gate": normal(k[5], (L, c.hidden_size, c.intermediate_size)),
+            "w_up": normal(k[6], (L, c.hidden_size, c.intermediate_size)),
+            "w_down": normal(k[7], (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)),
+        }
     params = {
         "embed": normal(k[0], (c.vocab_size, c.hidden_size)),
         "layers": {
@@ -129,10 +195,7 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
             "wk": normal(k[2], (L, c.hidden_size, c.kv_dim)),
             "wv": normal(k[3], (L, c.hidden_size, c.kv_dim)),
             "wo": normal(k[4], (L, c.q_dim, c.hidden_size), std / math.sqrt(2 * L)),
-            "mlp_norm": jnp.ones((L, c.hidden_size), dt),
-            "w_gate": normal(k[5], (L, c.hidden_size, c.intermediate_size)),
-            "w_up": normal(k[6], (L, c.hidden_size, c.intermediate_size)),
-            "w_down": normal(k[7], (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)),
+            **mlp,
         },
         "final_norm": jnp.ones((c.hidden_size,), dt),
     }
@@ -216,15 +279,84 @@ def _mlp_block(
     config: LlamaConfig,
     mesh: Optional[Mesh],
     rules: ShardingRules,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
+    """Dense SwiGLU or sparse MoE FFN → (out, aux loss scalar)."""
     h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    if config.n_experts:
+        from dstack_tpu.models import moe
+
+        o, aux = moe.moe_mlp(
+            h,
+            layer,
+            config.n_experts,
+            config.experts_per_token,
+            config.capacity_factor,
+            mesh,
+            rules,
+        )
+        aux_loss = (
+            config.router_balance_coef * aux["balance"]
+            + config.router_z_coef * aux["z"]
+        )
+        return o, aux_loss
     g = _proj(layer, "w_gate", h, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
     u = _proj(layer, "w_up", h, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
     g = constrain(g, rules, "batch", "seq", "mlp", mesh=mesh)
     o = _proj(
         layer, "w_down", jax.nn.silu(g) * u, "btf,fe->bte", "btf,fr->btr", "btr,re->bte"
     )
-    return constrain(o, rules, "batch", "seq", None, mesh=mesh)
+    return constrain(o, rules, "batch", "seq", None, mesh=mesh), jnp.zeros((), jnp.float32)
+
+
+def _embed_tokens(
+    params: dict,
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Optional[Mesh],
+    rules: ShardingRules,
+    positions: Optional[jax.Array],
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared forward preamble → (x [B,T,H], rope cos, rope sin)."""
+    # Replicate the embed table for the token lookup: a gather from the
+    # (vocab-tp, hidden-fsdp)-sharded table would produce hidden-sharded
+    # activations that GSPMD can only reshard to batch/seq sharding by
+    # full rematerialization (an involuntary-remat warning and an extra
+    # copy). An explicit all-gather of the table lets the gather output
+    # inherit the token indices' batch/seq sharding directly.
+    embed = constrain(params["embed"], rules, None, None, mesh=mesh)
+    x = embed.at[tokens].get(mode="fill", fill_value=0).astype(config.dtype)
+    x = constrain(x, rules, "batch", "seq", None, mesh=mesh)
+    pos = positions if positions is not None else jnp.arange(tokens.shape[1])
+    cos, sin = rope_freqs(pos, config.head_dim, config.rope_theta)
+    return x, cos, sin
+
+
+def _lm_head(
+    params: dict,
+    x: jax.Array,  # [B, T, H] final hidden (pre-norm)
+    config: LlamaConfig,
+    mesh: Optional[Mesh],
+    rules: ShardingRules,
+    return_hidden: bool,
+) -> jax.Array:
+    """Shared forward tail: final norm, then logits (or hidden states)."""
+    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    if return_hidden:
+        return x
+    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("bte,ev->btv", x, head.astype(config.dtype))
+    logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
+    return logits.astype(jnp.float32)
+
+
+def _merge_lora(xs: dict, lora: Optional[dict], lora_scale: float, config: LlamaConfig) -> dict:
+    if lora is None:
+        return xs
+    return {
+        **xs,
+        **lora["layers"],
+        "lora_scale": jnp.full((config.n_layers,), lora_scale, config.dtype),
+    }
 
 
 def forward(
@@ -238,6 +370,7 @@ def forward(
     lora: Optional[dict] = None,
     lora_scale: float = 1.0,
     return_hidden: bool = False,
+    return_aux: bool = False,
 ) -> jax.Array:
     """Token ids → logits [B, T, vocab] (f32).
 
@@ -247,29 +380,21 @@ def forward(
     log-probabilities never hit HBM; see fused_cross_entropy /
     chunked_cross_entropy there).
 
+    With ``return_aux=True`` returns ``(out, aux)`` where ``aux`` is the
+    summed router auxiliary loss (MoE configs; 0.0 for dense).
+
     ``lora`` is an adapter pytree from train/lora.py: stacked per-layer
     low-rank factors scanned together with the base weights — the
     adapters ride the same lax.scan, so XLA sees one fused layer body.
     """
     c = config
     rules = rules or default_rules()
-    # Replicate the embed table for the token lookup: a gather from the
-    # (vocab-tp, hidden-fsdp)-sharded table would produce hidden-sharded
-    # activations that GSPMD can only reshard to batch/seq sharding by
-    # full rematerialization (an involuntary-remat warning and an extra
-    # copy). An explicit all-gather of the table lets the gather output
-    # inherit the token indices' batch/seq sharding directly.
-    embed = constrain(params["embed"], rules, None, None, mesh=mesh)
-    x = embed.at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
-    x = constrain(x, rules, "batch", "seq", None, mesh=mesh)
-    t = tokens.shape[1]
-    pos = positions if positions is not None else jnp.arange(t)
-    cos, sin = rope_freqs(pos, c.head_dim, c.rope_theta)
+    x, cos, sin = _embed_tokens(params, tokens, c, mesh, rules, positions)
 
     def layer_fn(x, layer):
         x = x + _attention_block(x, layer, c, cos, sin, mesh, rules, attn_impl)
-        x = x + _mlp_block(x, layer, c, mesh, rules)
-        return x, None
+        o, aux = _mlp_block(x, layer, c, mesh, rules)
+        return x + o, aux
 
     if c.remat:
         # Save the flash-attention residuals (q/k/v/o/lse, tagged in
@@ -282,22 +407,80 @@ def forward(
                 "flash_residuals"
             ),
         )
-    xs = params["layers"]
-    if lora is not None:
-        L = c.n_layers
-        xs = {
-            **xs,
-            **lora["layers"],
-            "lora_scale": jnp.full((L,), lora_scale, c.dtype),
-        }
-    x, _ = jax.lax.scan(layer_fn, x, xs)
-    x = rms_norm(x, params["final_norm"], c.norm_eps)
-    if return_hidden:
-        return x
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bte,ev->btv", x, head.astype(c.dtype))
-    logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
-    return logits.astype(jnp.float32)
+    xs = _merge_lora(params["layers"], lora, lora_scale, c)
+    x, auxs = jax.lax.scan(layer_fn, x, xs)
+    aux = jnp.sum(auxs)
+    out = _lm_head(params, x, c, mesh, rules, return_hidden)
+    return (out, aux) if return_aux else out
+
+
+def forward_pipelined(
+    params: dict,
+    tokens: jax.Array,  # [B, T] int32
+    config: LlamaConfig,
+    *,
+    mesh: Mesh,
+    rules: Optional[ShardingRules] = None,
+    n_micro: Optional[int] = None,
+    attn_impl: Optional[str] = None,
+    positions: Optional[jax.Array] = None,
+    lora: Optional[dict] = None,
+    lora_scale: float = 1.0,
+    return_hidden: bool = False,
+    return_aux: bool = False,
+) -> jax.Array:
+    """:func:`forward` with the layer stack pipelined over the ``pp``
+    mesh axis (parallel/pipeline.py): layers split into contiguous
+    stages, batch split into ``n_micro`` microbatches, activations
+    ppermute between neighbor stages. Embed/rope/head run pp-replicated
+    (GSPMD still shards them over tp/fsdp); ring attention (``sp``)
+    cannot nest inside the pipeline's shard_map, so pp meshes use local
+    attention per device.
+    """
+    from dstack_tpu.parallel import pipeline as pl
+
+    c = config
+    rules = rules or default_rules()
+    pp = mesh.shape.get("pp", 1)
+    if c.n_layers % pp != 0:
+        raise ValueError(f"{c.n_layers} layers not divisible by pp={pp}")
+    n_micro = n_micro or pp
+    x, cos, sin = _embed_tokens(params, tokens, c, mesh, rules, positions)
+
+    def stage_fn(stage_layers, x, extras):
+        cos, sin = extras
+
+        def body(x, layer):
+            # mesh=None inside the stage: GSPMD propagates the auto-axis
+            # (fsdp/tp/ep) shardings; explicit constraints can't name the
+            # concrete mesh from inside the pp shard_map
+            x = x + _attention_block(x, layer, c, cos, sin, None, rules, attn_impl)
+            o, aux = _mlp_block(x, layer, c, None, rules)
+            return x + o, aux
+
+        if c.remat:
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "flash_residuals"
+                ),
+            )
+        y, auxs = jax.lax.scan(body, x, stage_layers)
+        return y, jnp.sum(auxs).astype(jnp.float32)
+
+    xs = _merge_lora(params["layers"], lora, lora_scale, c)
+    stage_params = pl.split_stages(xs, pp)
+    x_mb = pl.microbatch(x, n_micro)
+    # microbatch dim replicated, per-microbatch batch dim sharded over the
+    # batch axes: keeps the boundary reshapes local (see pl.microbatch)
+    x_mb = constrain(x_mb, rules, None, "batch", "seq", None, mesh=mesh)
+    y_mb, aux = pl.pipeline_apply(
+        stage_fn, stage_params, x_mb, mesh=mesh, extras=(cos, sin)
+    )
+    y_mb = constrain(y_mb, rules, None, "batch", "seq", None, mesh=mesh)
+    x = pl.unmicrobatch(y_mb)
+    out = _lm_head(params, x, c, mesh, rules, return_hidden)
+    return (out, aux) if return_aux else out
 
 
 def abstract_params(config: LlamaConfig) -> dict:

@@ -1,0 +1,144 @@
+"""Sparse Mixture-of-Experts MLP with expert parallelism over ``ep``.
+
+GShard/Switch-style static-shape dispatch, designed for XLA rather than
+translated from a CUDA/torch grouped-GEMM MoE: routing produces a
+one-hot *dispatch* tensor [B, T, E, C] (capacity-bounded), the token →
+expert shuffle and the return combine are plain einsums, and the expert
+FFNs are one batched einsum over the stacked expert dim. Sharding the
+expert dim over ``ep`` (and tokens over ``dp``/``fsdp``) makes XLA lower
+the dispatch einsums to ``all_to_all`` collectives on ICI — no manual
+communication code, static shapes throughout (capacity drop instead of
+dynamic gather), everything MXU-shaped.
+
+Aux losses follow Switch Transformer: load-balance (E · Σ_e f_e·p_e) and
+router z-loss; the router runs in f32 for softmax stability.
+
+The reference framework ships no MoE (parallelism is user-space there);
+this is part of the in-repo TPU compute plane. Expert-parallel axis
+vocabulary: parallel/mesh.py ``ep``; rules map "experts" → "ep"
+(parallel/sharding.py).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from dstack_tpu.parallel.sharding import ShardingRules, constrain
+
+
+def expert_capacity(
+    seq_len: int, n_experts: int, experts_per_token: int, capacity_factor: float
+) -> int:
+    """Per-expert token slots per batch row (static; multiple of 8 for
+    lane-friendly layouts)."""
+    raw = capacity_factor * seq_len * experts_per_token / n_experts
+    cap = max(8, int(-(-raw // 8) * 8))
+    return min(cap, seq_len)
+
+
+def router(
+    x: jax.Array,  # [B, T, H] (model dtype)
+    w_router: jax.Array,  # [H, E]
+    n_experts: int,
+    experts_per_token: int,
+    capacity: int,
+) -> tuple[jax.Array, jax.Array, dict]:
+    """Top-k routing → (dispatch [B,T,E,C] one-hot, combine [B,T,E,C], aux).
+
+    Each batch row is a routing group: capacity slots are assigned in
+    sequence order per expert (cumsum positions), tokens overflowing an
+    expert's capacity are dropped for that expert (their combine weight
+    is zero — the residual stream carries them unchanged).
+    """
+    logits = jnp.einsum(
+        "bth,he->bte", x, w_router.astype(x.dtype), preferred_element_type=jnp.float32
+    )  # [B, T, E] f32
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, experts_per_token)  # [B,T,k]
+
+    # Build per-choice one-hot assignments and capacity positions.
+    # Choice order gives earlier (higher-gate) choices slot priority.
+    dispatch = jnp.zeros((*logits.shape, capacity), x.dtype)  # [B,T,E,C]
+    combine = jnp.zeros((*logits.shape, capacity), x.dtype)
+    used = jnp.zeros(logits.shape, jnp.int32)  # [B,T,E] cumulative one-hots
+    for j in range(experts_per_token):
+        onehot = jax.nn.one_hot(expert_idx[..., j], logits.shape[-1], dtype=jnp.int32)
+        # slot of this token in expert e's capacity buffer: this-choice
+        # tokens before it in the sequence, offset past ALL assignments
+        # from earlier (higher-priority) choices
+        pos = jnp.cumsum(onehot, axis=1) - 1 + used.sum(axis=1, keepdims=True)
+        within = (pos < capacity) & (onehot > 0)
+        slot_oh = jax.nn.one_hot(
+            jnp.clip(pos, 0, capacity - 1), capacity, dtype=x.dtype
+        )  # [B,T,E,C]
+        sel = slot_oh * within[..., None].astype(x.dtype) * onehot[..., None].astype(x.dtype)
+        dispatch = dispatch + sel
+        combine = combine + sel * gate_vals[..., j, None, None].astype(x.dtype)
+        used = used + onehot
+
+    # Switch aux losses (f32): load balance + router z-loss
+    e = logits.shape[-1]
+    top1 = jax.nn.one_hot(expert_idx[..., 0], e, dtype=jnp.float32)
+    frac_tokens = top1.mean(axis=(0, 1))  # fraction routed (top-1) per expert
+    frac_probs = probs.mean(axis=(0, 1))
+    balance = e * jnp.sum(frac_tokens * frac_probs)
+    z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    aux = {"balance": balance, "z": z}
+    return dispatch, combine, aux
+
+
+def moe_mlp(
+    x: jax.Array,  # [B, T, H] — the *normed* hidden states
+    layer: dict,  # w_router [H,E], w_gate/w_up [E,H,F], w_down [E,F,H]
+    n_experts: int,
+    experts_per_token: int,
+    capacity_factor: float,
+    mesh: Optional[Mesh],
+    rules: Optional[ShardingRules],
+) -> tuple[jax.Array, dict]:
+    """Sparse SwiGLU FFN → (output [B,T,H], aux losses)."""
+    b, t, h = x.shape
+    cap = expert_capacity(t, n_experts, experts_per_token, capacity_factor)
+    dispatch, combine, aux = router(
+        x, layer["w_router"], n_experts, experts_per_token, cap
+    )
+    # token shuffle: [B,T,E,C] × [B,T,H] → [E,B,C,H]; ep-sharding the
+    # expert dim makes this the all_to_all dispatch
+    xe = jnp.einsum("btec,bth->ebch", dispatch, x)
+    if rules is not None:
+        xe = constrain(xe, rules, "experts", "batch_noexp", None, None, mesh=mesh)
+    g = jnp.einsum("ebch,ehf->ebcf", xe, layer["w_gate"])
+    u = jnp.einsum("ebch,ehf->ebcf", xe, layer["w_up"])
+    if rules is not None:
+        g = constrain(g, rules, "experts", "batch_noexp", None, "mlp", mesh=mesh)
+    y = jnp.einsum("ebcf,efh->ebch", jax.nn.silu(g) * u, layer["w_down"])
+    if rules is not None:
+        y = constrain(y, rules, "experts", "batch_noexp", None, None, mesh=mesh)
+    out = jnp.einsum("btec,ebch->bth", combine, y)
+    if rules is not None:
+        out = constrain(out, rules, "batch", "seq", None, mesh=mesh)
+    return out, aux
+
+
+def moe_mlp_reference(
+    x: jax.Array,
+    layer: dict,
+    n_experts: int,
+    experts_per_token: int,
+) -> jax.Array:
+    """Dense-everything reference (no capacity, no dispatch): every token
+    runs every expert, output = Σ top-k gate_e · FFN_e(x). For tests."""
+    logits = jnp.einsum("bth,he->bte", x, layer["w_router"].astype(x.dtype))
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, experts_per_token)
+    gates = jnp.zeros_like(probs)
+    for j in range(experts_per_token):
+        gates = gates + jax.nn.one_hot(
+            expert_idx[..., j], n_experts, dtype=jnp.float32
+        ) * gate_vals[..., j, None]
+    g = jnp.einsum("bth,ehf->ebtf", x, layer["w_gate"])
+    u = jnp.einsum("bth,ehf->ebtf", x, layer["w_up"])
+    y = jnp.einsum("ebtf,efh->ebth", jax.nn.silu(g) * u, layer["w_down"])
+    return jnp.einsum("bte,ebth->bth", gates.astype(x.dtype), y)

@@ -5,6 +5,7 @@ The framework's parallelism vocabulary (SPMD over a named
 recipe) uses five axes:
 
 - ``dp``   — pure data parallel (gradient all-reduce over ICI/DCN)
+- ``pp``   — pipeline stages (GPipe microbatch loop, parallel/pipeline.py)
 - ``fsdp`` — data parallel with parameter/optimizer sharding (ZeRO-3:
   all-gather params, reduce-scatter grads)
 - ``tp``   — tensor (megatron-style) parallelism inside a layer
@@ -26,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "fsdp", "ep", "sp", "tp")
+AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -34,13 +35,21 @@ class MeshConfig:
     """Logical mesh shape. ``-1`` on one axis means "absorb the rest"."""
 
     dp: int = 1
+    pp: int = 1
     fsdp: int = -1
     ep: int = 1
     sp: int = 1
     tp: int = 1
 
     def resolved(self, n_devices: int) -> dict[str, int]:
-        sizes = {"dp": self.dp, "fsdp": self.fsdp, "ep": self.ep, "sp": self.sp, "tp": self.tp}
+        sizes = {
+            "dp": self.dp,
+            "pp": self.pp,
+            "fsdp": self.fsdp,
+            "ep": self.ep,
+            "sp": self.sp,
+            "tp": self.tp,
+        }
         fixed = math.prod(v for v in sizes.values() if v != -1)
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
@@ -70,9 +79,10 @@ def make_mesh(
     """
     devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig()
-    if -1 not in (config.dp, config.fsdp, config.ep, config.sp, config.tp):
+    fixed_axes = (config.dp, config.pp, config.fsdp, config.ep, config.sp, config.tp)
+    if -1 not in fixed_axes:
         # All axes fixed: allow using a leading subset of the devices.
-        need = config.dp * config.fsdp * config.ep * config.sp * config.tp
+        need = math.prod(fixed_axes)
         if need <= len(devices):
             devices = devices[:need]
     sizes = config.resolved(len(devices))

@@ -1,0 +1,154 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline over ``pp``.
+
+TPU-first design: the layer stack (already stacked on a leading ``layers``
+dim for ``lax.scan``) is split into ``pp`` contiguous stages, the stage
+dim is sharded over the ``pp`` mesh axis, and activations flow between
+neighbor stages with ``lax.ppermute`` (nearest-neighbor ICI hops, no
+NCCL p2p analog needed). The whole schedule is a single ``lax.scan``
+over ``n_micro + pp - 1`` ticks inside a *partial-manual*
+``jax.shard_map``: only ``pp`` is manual; batch/tensor axes (``dp``,
+``fsdp``, ``tp``, ``ep``…) stay GSPMD-auto inside, so pipeline composes
+with FSDP/TP/MoE without explicit resharding. (Ring attention's ``sp``
+shard_map cannot nest inside; pp and sp are mutually exclusive today.)
+
+The loop is fully differentiable (``ppermute`` transposes to the reverse
+permutation, the scan reverses), so the backward pipeline falls out of
+``jax.grad`` — no hand-written 1F1B schedule. The price is the classic
+GPipe bubble: ``(pp−1)/(n_micro+pp−1)`` idle fraction; raise
+``n_micro`` to amortize.
+
+The reference framework has no pipeline engine (parallelism lives in
+user containers, reference docs/docs/concepts/tasks.md:113-139); this
+module is part of the in-repo TPU compute plane alongside ring attention.
+"""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def split_stages(layer_tree: Any, n_stages: int) -> Any:
+    """Reshape stacked layers [L, ...] → [pp, L/pp, ...] (contiguous split)."""
+
+    def split(a: jax.Array) -> jax.Array:
+        L = a.shape[0]
+        if L % n_stages != 0:
+            raise ValueError(f"{L} layers not divisible into {n_stages} stages")
+        return a.reshape(n_stages, L // n_stages, *a.shape[1:])
+
+    return jax.tree.map(split, layer_tree)
+
+
+def merge_stages(stage_tree: Any) -> Any:
+    """Inverse of :func:`split_stages`."""
+    return jax.tree.map(lambda a: a.reshape(-1, *a.shape[2:]), stage_tree)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array, Any], tuple[jax.Array, jax.Array]],
+    stage_params: Any,  # leaves [pp, L/pp, ...], sharded over "pp" on dim 0
+    x_mb: jax.Array,  # [n_micro, mb, ...] microbatched activations
+    *,
+    mesh: Mesh,
+    axis_name: str = "pp",
+    extras: Any = None,  # replicated side inputs (e.g. rope tables)
+) -> tuple[jax.Array, jax.Array]:
+    """Run microbatches through the stage pipeline.
+
+    ``stage_fn(local_stage_params, x, extras) -> (y, aux)`` applies one
+    stage's layers to one microbatch (leaves of ``local_stage_params``
+    have the [L/pp, ...] shape — typically an inner ``lax.scan``) and
+    returns the activation plus a scalar aux loss (0.0 for plain stacks;
+    router losses for MoE stages).
+
+    Returns ``(outputs [n_micro, mb, ...], aux_mean)`` with outputs
+    replicated over ``pp``. Aux values are *averaged* over microbatches
+    (each stage_fn aux is a per-microbatch mean, so the average equals
+    the full-batch mean a non-pipelined run would compute).
+    """
+    pp = mesh.shape[axis_name]
+    n_micro = x_mb.shape[0]
+    if pp == 1:
+        local = jax.tree.map(lambda a: a[0], stage_params)
+        ys, auxs = jax.vmap(lambda x: stage_fn(local, x, extras))(x_mb)
+        return ys, jnp.sum(auxs) / n_micro
+
+    def local_pipeline(stage_params, x_mb, extras):
+        params = jax.tree.map(lambda a: a[0], stage_params)
+        idx = lax.axis_index(axis_name)
+        steps = n_micro + pp - 1
+        buf = jnp.zeros_like(x_mb[0])
+        outputs = jnp.zeros_like(x_mb)
+        aux_acc = jnp.zeros((), jnp.float32)
+
+        def tick(carry, step):
+            buf, outputs, aux_acc = carry
+            mb_idx = jnp.clip(step, 0, n_micro - 1)
+            fed = lax.dynamic_index_in_dim(x_mb, mb_idx, 0, keepdims=False)
+            # stage 0 ingests microbatch `step`; later stages consume the
+            # activation their predecessor pushed last tick
+            x_in = jnp.where(idx == 0, fed, buf)
+            y, aux = stage_fn(params, x_in, extras)
+            # bubble ticks run on zero/garbage inputs; their activations
+            # are overwritten downstream but their aux must be masked out
+            on_real_input = (step >= idx) & (step - idx < n_micro)
+            aux_acc = aux_acc + jnp.where(on_real_input, aux, 0.0)
+            # forward shift: stage i -> i+1 (no wraparound; unaddressed
+            # targets receive zeros, which stage 0 ignores)
+            buf_next = lax.ppermute(
+                y, axis_name, [(i, i + 1) for i in range(pp - 1)]
+            )
+            # last stage emits microbatch `step - (pp-1)` once it's real
+            out_idx = step - (pp - 1)
+            valid = (idx == pp - 1) & (out_idx >= 0)
+            slot = jnp.clip(out_idx, 0, n_micro - 1)
+            cur = lax.dynamic_index_in_dim(outputs, slot, 0, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(valid, y, cur), slot, 0
+            )
+            return (buf_next, outputs, aux_acc), None
+
+        (buf, outputs, aux_acc), _ = lax.scan(
+            tick, (buf, outputs, aux_acc), jnp.arange(steps)
+        )
+        # replicate the last stage's outputs to the whole pp group so the
+        # head/loss (computed outside, pp-replicated) sees real values;
+        # aux contributions live one-per-stage, so a plain psum sums them
+        outputs = lax.psum(jnp.where(idx == pp - 1, outputs, 0.0), axis_name)
+        aux_acc = lax.psum(aux_acc, axis_name) / n_micro
+        return outputs, aux_acc
+
+    return jax.shard_map(
+        local_pipeline,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(), P()),
+        out_specs=(P(), P()),
+        axis_names={axis_name},
+        check_vma=False,
+    )(stage_params, x_mb, extras)
+
+
+def microbatch(x: jax.Array, n_micro: int) -> jax.Array:
+    """[B, ...] → [n_micro, B/n_micro, ...], *strided*: microbatch ``m``
+    takes rows ``m::n_micro``.
+
+    Strided (reshape-major + transpose) rather than contiguous split on
+    purpose: when the batch dim is sharded over dp/fsdp/ep, splitting the
+    MAJOR dim keeps every shard's rows in whole groups, so both this and
+    :func:`unmicrobatch` are local layout ops — a contiguous split would
+    make SPMD fall back to "involuntary full rematerialization"
+    (replicate-then-repartition) at the pipeline boundary.
+    """
+    b = x.shape[0]
+    if b % n_micro != 0:
+        raise ValueError(f"batch {b} not divisible into {n_micro} microbatches")
+    return x.reshape(b // n_micro, n_micro, *x.shape[1:]).swapaxes(0, 1)
+
+
+def unmicrobatch(x: jax.Array) -> jax.Array:
+    """Inverse of :func:`microbatch` (row order round-trips exactly)."""
+    x = x.swapaxes(0, 1)
+    return x.reshape(-1, *x.shape[2:])

@@ -18,6 +18,7 @@ MeshAxis = Union[str, tuple[str, ...], None]
 # Default rule table: logical axis -> mesh axis (or tuple).
 DEFAULT_RULES: dict[str, MeshAxis] = {
     "batch": ("dp", "fsdp", "ep"),
+    "batch_noexp": ("dp", "fsdp"),  # batch dim of ep-sharded MoE tensors
     "seq": "sp",
     "kv_seq": None,  # KV sequence stays replicated outside ring attention
     "embed": None,

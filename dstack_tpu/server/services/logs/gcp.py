@@ -103,10 +103,14 @@ class GCPLogStorage:
         # "ts:<iso>:<n>" where n = events already seen AT that timestamp
         # (>= filter + skip, so same-timestamp bursts are never lost or
         # re-delivered). Native Cloud Logging page tokens are still
-        # *accepted* (tokens issued by older builds) but not issued:
-        # a ts cursor derived mid-stream from a native page could not
-        # count same-timestamp events on earlier pages and would
-        # re-deliver them.
+        # *accepted* (tokens issued by older builds) but not issued
+        # mid-stream: a ts cursor derived from a native page cannot count
+        # same-timestamp events on earlier pages. A legacy native stream
+        # therefore stays on native tokens until exhausted; only the
+        # final page derives a ts cursor. If a same-timestamp burst
+        # straddles that final page boundary the transition re-delivers
+        # those events once (at-least-once across an upgrade; steady
+        # state is exactly-once).
         page_token = None
         skip_at_cursor = 0
         cursor_ts: Optional[str] = None
@@ -142,7 +146,11 @@ class GCPLogStorage:
                         log_source=LogEventSource(payload.get("source", "stdout")),
                     )
                 )
-        if events:
+        native_next = getattr(pager, "next_page_token", None)
+        if page_token is not None and native_next:
+            # legacy native stream not exhausted: keep riding it
+            token = native_next
+        elif events:
             last_ts = events[-1].timestamp.isoformat()
             n_at_last = sum(
                 1 for ev in events if ev.timestamp.isoformat() == last_ts

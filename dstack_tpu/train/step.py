@@ -121,6 +121,17 @@ def chunked_cross_entropy(
     return nll / total, total
 
 
+def rules_for_mesh(mesh: Mesh, rules: Optional[ShardingRules] = None) -> ShardingRules:
+    """Default sharding rules for a mesh: on pipeline meshes (pp > 1) the
+    stacked ``layers`` dim is sharded over ``pp`` so each stage's weights
+    and optimizer state live only on their stage's devices."""
+    if rules is not None:
+        return rules
+    if mesh.shape.get("pp", 1) > 1:
+        return default_rules({"layers": "pp"})
+    return default_rules()
+
+
 def default_optimizer(
     lr: float = 3e-4, weight_decay: float = 0.1, warmup: int = 100, decay_steps: int = 10000
 ) -> optax.GradientTransformation:
@@ -185,7 +196,7 @@ def sharded_init(
 
     Returns (state, state_shardings).
     """
-    rules = rules or default_rules()
+    rules = rules_for_mesh(mesh, rules)
     shardings = state_specs(config, optimizer, rules, mesh)
 
     def init(key):
@@ -208,6 +219,7 @@ def make_train_step(
     rules: Optional[ShardingRules] = None,
     attn_impl: Optional[str] = None,
     loss_impl: str = "fused",  # "fused" | "chunked"
+    n_micro: Optional[int] = None,
 ) -> Callable:
     """Build the jitted train step: (state, batch{tokens,targets,mask}) →
     (state, metrics).
@@ -215,18 +227,31 @@ def make_train_step(
     ``loss_impl`` picks the LM-head/loss fusion: "fused" (one f32-
     accumulated logits tensor, reductions fused — fastest) or "chunked"
     (sequence-chunked scan with remat — lowest peak HBM, for memory-
-    tight configs)."""
-    rules = rules or default_rules()
+    tight configs).
+
+    On pipeline meshes (pp > 1) the layer stack runs through
+    ``forward_pipelined`` with ``n_micro`` microbatches (default: pp).
+    MoE configs (n_experts > 0) add the router aux losses to the
+    training objective; metrics report CE and aux separately."""
+    rules = rules_for_mesh(mesh, rules)
+    pp = mesh.shape.get("pp", 1)
     shardings = state_specs(config, optimizer, rules, mesh)
     b_sh = batch_sharding(mesh, rules)
     batch_sh = {"tokens": b_sh, "targets": b_sh, "mask": b_sh}
     repl = NamedSharding(mesh, P())
 
     def loss_fn(params, batch):
-        x = llama.forward(
-            params, batch["tokens"], config, mesh=mesh, rules=rules,
-            attn_impl=attn_impl, return_hidden=True,
-        )
+        if pp > 1:
+            x, aux = llama.forward_pipelined(
+                params, batch["tokens"], config, mesh=mesh, rules=rules,
+                n_micro=n_micro, attn_impl=attn_impl,
+                return_hidden=True, return_aux=True,
+            )
+        else:
+            x, aux = llama.forward(
+                params, batch["tokens"], config, mesh=mesh, rules=rules,
+                attn_impl=attn_impl, return_hidden=True, return_aux=True,
+            )
         head = (
             params["embed"].T if config.tie_embeddings else params["lm_head"]
         ).astype(config.dtype)
@@ -239,10 +264,12 @@ def make_train_step(
             loss, _ = fused_cross_entropy(
                 x, head, batch["targets"], batch.get("mask"), rules=rules, mesh=mesh
             )
-        return loss
+        return loss + aux, (loss, aux)
 
     def step(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        (_, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], batch
+        )
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )
@@ -253,12 +280,15 @@ def make_train_step(
             "step": state["step"] + 1,
         }
         gnorm = optax.global_norm(grads)
-        return new_state, {"loss": loss, "grad_norm": gnorm}
+        return new_state, {"loss": loss, "aux_loss": aux, "grad_norm": gnorm}
 
     return jax.jit(
         step,
         in_shardings=(shardings, batch_sh),
-        out_shardings=(shardings, {"loss": repl, "grad_norm": repl}),
+        out_shardings=(
+            shardings,
+            {"loss": repl, "aux_loss": repl, "grad_norm": repl},
+        ),
         donate_argnums=(0,),
     )
 
@@ -279,7 +309,8 @@ def make_eval_step(
 
 
 def flops_per_token(config: llama.LlamaConfig, seq_len: int) -> float:
-    """Approximate train FLOPs/token: 6·N params + attention term."""
-    n = config.num_params()
+    """Approximate train FLOPs/token: 6·N *active* params + attention
+    term (for MoE only the routed experts' FLOPs count)."""
+    n = config.num_active_params()
     attn = 12 * config.n_layers * config.hidden_size * seq_len  # fwd+bwd qk/av
     return 6.0 * n + attn

@@ -12,7 +12,9 @@ from dstack_tpu.parallel.sharding import default_rules, tree_shardings
 class TestMesh:
     def test_make_mesh_8(self):
         mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-        assert mesh_shape(mesh) == {"dp": 2, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2}
+        assert mesh_shape(mesh) == {
+            "dp": 2, "pp": 1, "fsdp": 2, "ep": 1, "sp": 1, "tp": 2,
+        }
 
     def test_wildcard(self):
         mesh = make_mesh(MeshConfig(dp=1, fsdp=-1, tp=2))

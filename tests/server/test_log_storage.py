@@ -114,13 +114,19 @@ class TestGCPLogStorage:
         assert collected == [f"line-{i}\n" for i in range(5)]
 
     def test_legacy_page_token_accepted(self):
-        """Native page tokens issued by older builds still resume."""
+        """Native page tokens issued by older builds still resume: the
+        stream stays on native tokens until exhausted (a mid-stream ts:
+        cursor could not count same-timestamp events on earlier pages),
+        then switches to a ts: cursor."""
         client = FakeGCPClient()
         storage = GCPLogStorage(client=client)
         storage.write_logs("main", "r", "r-0-0", _events(5))
         page = storage.poll_logs("main", "r", "r-0-0", limit=2, next_token="2")
         assert [ev.text() for ev in page.logs] == ["line-2\n", "line-3\n"]
-        assert page.next_token.startswith("ts:")
+        assert page.next_token == "4"  # still mid native stream
+        page = storage.poll_logs("main", "r", "r-0-0", limit=2, next_token="4")
+        assert [ev.text() for ev in page.logs] == ["line-4\n"]
+        assert page.next_token.startswith("ts:")  # native stream exhausted
 
     def test_ts_cursor_same_timestamp_no_duplicates(self):
         """Past the last Cloud Logging page the cursor is ts:<iso>:<n>;
