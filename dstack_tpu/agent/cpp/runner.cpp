@@ -315,19 +315,37 @@ class Executor {
     if (n_nodes == 0) n_nodes = 1;
     int port = static_cast<int>(ci["coordinator_port"].as_int(8476));
     std::string coord = master.empty() ? "" : master + ":" + std::to_string(port);
+    // multislice: the server's wire contract submits the WITHIN-SLICE
+    // worker id as job_num for slice jobs (process_running_jobs submit);
+    // the global rank spans all slices slice-major (parity: python runner)
+    int num_slices = static_cast<int>(ci["num_slices"].as_int(1));
+    int slice_id = static_cast<int>(ci["slice_id"].as_int(0));
+    std::string slice_joined;
+    int n_slice = 0;
+    for (const auto& ip : ci["slice_ips"].as_array()) {
+      if (n_slice) slice_joined += ",";
+      slice_joined += ip.as_string();
+      n_slice++;
+    }
+    if (n_slice == 0) {
+      slice_joined = nodes_joined;
+      n_slice = n_nodes;
+    }
+    int slice_rank = rank;
+    int global_rank = (num_slices > 1) ? slice_id * n_slice + slice_rank : slice_rank;
     auto add = [&env](const std::string& k, const std::string& v) {
       env.push_back(k + "=" + v);
     };
     add("DTPU_NODES_IPS", nodes_newline);
     add("DTPU_MASTER_NODE_IP", master);
-    add("DTPU_NODE_RANK", std::to_string(rank));
+    add("DTPU_NODE_RANK", std::to_string(global_rank));
     add("DTPU_NODES_NUM", std::to_string(n_nodes));
     add("DTPU_COORDINATOR_ADDRESS", coord);
     add("JAX_COORDINATOR_ADDRESS", coord);
     add("JAX_NUM_PROCESSES", std::to_string(n_nodes));
-    add("JAX_PROCESS_ID", std::to_string(rank));
-    add("TPU_WORKER_ID", std::to_string(rank));
-    add("TPU_WORKER_HOSTNAMES", nodes_joined);
+    add("JAX_PROCESS_ID", std::to_string(global_rank));
+    add("TPU_WORKER_ID", std::to_string(slice_rank));
+    add("TPU_WORKER_HOSTNAMES", slice_joined);
     if (ci["tpu_chips_per_host"].as_int())
       add("DTPU_TPU_CHIPS_PER_HOST", std::to_string(ci["tpu_chips_per_host"].as_int()));
     if (ci["tpu_total_chips"].as_int())

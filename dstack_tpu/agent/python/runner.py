@@ -39,11 +39,22 @@ logger = get_logger("agent.runner")
 
 def cluster_env(ci, worker_id: Optional[int] = None) -> dict[str, str]:
     """ClusterInfo → rendezvous environment (the TPU analog of
-    reference executor.go:237-246)."""
+    reference executor.go:237-246).
+
+    ``worker_id`` is the submitted job_num, which by the server's wire
+    contract (process_running_jobs submit) is the WITHIN-SLICE worker id
+    for slice jobs; the global rank is derived from ``ci.slice_id``."""
     env: dict[str, str] = {}
     nodes = ci.nodes_ips or ([ci.master_node_ip] if ci.master_node_ip else [])
     num_nodes = max(len(nodes), 1)
-    rank = worker_id if worker_id is not None else 0
+    # worker_id is the rank within this job's slice; on multislice runs
+    # the global rank spans all slices in slice-major order
+    slice_rank = worker_id if worker_id is not None else 0
+    slice_ips = ci.slice_ips or nodes
+    if ci.num_slices > 1:
+        rank = ci.slice_id * len(slice_ips) + slice_rank
+    else:
+        rank = slice_rank
     env["DTPU_NODES_IPS"] = "\n".join(nodes)
     env["DTPU_MASTER_NODE_IP"] = ci.master_node_ip
     env["DTPU_NODE_RANK"] = str(rank)
@@ -55,9 +66,10 @@ def cluster_env(ci, worker_id: Optional[int] = None) -> dict[str, str]:
     env["JAX_COORDINATOR_ADDRESS"] = env["DTPU_COORDINATOR_ADDRESS"]
     env["JAX_NUM_PROCESSES"] = str(num_nodes)
     env["JAX_PROCESS_ID"] = str(rank)
-    # libtpu multi-host slice topology:
-    env["TPU_WORKER_ID"] = str(rank)
-    env["TPU_WORKER_HOSTNAMES"] = ",".join(nodes)
+    # libtpu multi-host topology is per-slice: worker id/hostnames name
+    # this slice's hosts only; DCN coordination rides MEGASCALE_* below
+    env["TPU_WORKER_ID"] = str(slice_rank)
+    env["TPU_WORKER_HOSTNAMES"] = ",".join(slice_ips)
     if ci.tpu_chips_per_host:
         env["DTPU_TPU_CHIPS_PER_HOST"] = str(ci.tpu_chips_per_host)
     if ci.tpu_total_chips:

@@ -9,6 +9,12 @@ per slice-hour, across regions, on-demand and spot.
 
 Data is approximate public GCP pricing (catalog data, easily refreshed);
 the scheduler only relies on relative ordering and shapes.
+
+DCN multislice jobs (``tpu.slices > 1``) are priced and matched
+per-slice against these same entries: the scheduler provisions N
+identical slices (one QueuedResource each) for one replica
+(process_submitted_jobs), so the catalog needs no NxM cross-product
+entries.
 """
 
 import math

@@ -153,13 +153,19 @@ def normalize_tpu_version(v: str) -> str:
 
 
 class TPUSpec(CoreModel):
-    """Requested TPU slice: any of the listed generations, a chip-count
-    range, and optionally an exact ICI topology (e.g. ``4x4x4`` for v4/v5p,
-    ``8x16`` for v5e/v6e)."""
+    """Requested TPU slice(s): any of the listed generations, a chip-count
+    range (per slice), optionally an exact ICI topology (e.g. ``4x4x4``
+    for v4/v5p, ``8x16`` for v5e/v6e), and a slice count.
+
+    ``slices > 1`` requests a DCN **multislice** job: N identical slices
+    provisioned atomically for one replica, wired together with
+    ``MEGASCALE_*`` env (the reference cannot do this — it refuses even
+    multi-host single slices, reference gcp/compute.py:699-726)."""
 
     version: Optional[list[str]] = None
     chips: IntRange = IntRange(min=1, max=None)
     topology: Optional[str] = None
+    slices: int = 1
 
     @field_validator("version", mode="before")
     @classmethod
@@ -209,11 +215,20 @@ class TPUSpec(CoreModel):
             return {"chips": v}
         return v
 
+    @field_validator("slices")
+    @classmethod
+    def _slices(cls, v: int) -> int:
+        if v < 1:
+            raise ValueError("tpu.slices must be >= 1")
+        return v
+
     def pretty(self) -> str:
         gen = "/".join(self.version) if self.version else "tpu"
         s = f"{gen}:{self.chips.pretty()}"
         if self.topology:
             s += f":{self.topology}"
+        if self.slices > 1:
+            s += f"×{self.slices}slices"
         return s
 
 

@@ -193,6 +193,7 @@ class ClusterInfo(CoreModel):
     megascale_coordinator_address: Optional[str] = None  # DCN multislice
     slice_id: int = 0
     num_slices: int = 1
+    slice_ips: list[str] = []  # this job's slice's worker hosts (multislice)
     tpu_chips_per_host: int = 0
     tpu_total_chips: int = 0
     tpu_topology: Optional[str] = None

@@ -121,26 +121,55 @@ async def _handle_unreachable(db: Database, job_row: dict, message: str) -> None
         )
 
 
+MEGASCALE_PORT = 8080  # libtpu DCN coordinator default
+
+
+async def _replica_job_ips(db: Database, job_row: dict) -> list[str]:
+    rows = await db.fetchall(
+        "SELECT job_num, job_provisioning_data FROM jobs "
+        "WHERE run_id = ? AND replica_num = ? AND submission_num = ? "
+        "ORDER BY job_num",
+        (job_row["run_id"], job_row["replica_num"], job_row["submission_num"]),
+    )
+    ips = []
+    for r in rows:
+        d = loads(r.get("job_provisioning_data"))
+        ips.append((d or {}).get("internal_ip") or (d or {}).get("hostname") or "")
+    return ips
+
+
 async def _build_cluster_info(db: Database, job_row: dict, jpd: JobProvisioningData) -> ClusterInfo:
-    """Rendezvous info across the replica's jobs (slice workers or
-    sibling instances)."""
+    """Rendezvous info across the replica's jobs (slice workers, DCN
+    multislice slices, or sibling instances)."""
     tpu = jpd.instance_type.resources.tpu
-    if jpd.hosts:
+    job_spec = JobSpec.model_validate(loads(job_row["job_spec"]))
+    tpu_req = job_spec.requirements.resources.tpu
+    n_slices = tpu_req.slices if tpu_req is not None else 1
+    slice_ips: list[str] = []
+    slice_id = 0
+    megascale_address = None
+    if n_slices > 1 and jpd.hosts:
+        # global node list spans every slice's workers (slice-major job
+        # order); this job's slice hosts come from its slice's jpd
+        hps = len(jpd.hosts)
+        slice_id = job_row["job_num"] // hps
+        slice_ips = [
+            h.internal_ip for h in sorted(jpd.hosts, key=lambda h: h.worker_id)
+        ]
+        ips = await _replica_job_ips(db, job_row)
+        if ips and ips[0]:
+            megascale_address = f"{ips[0]}:{MEGASCALE_PORT}"
+    elif jpd.hosts:
         ips = [h.internal_ip for h in sorted(jpd.hosts, key=lambda h: h.worker_id)]
     else:
-        rows = await db.fetchall(
-            "SELECT job_num, job_provisioning_data FROM jobs "
-            "WHERE run_id = ? AND replica_num = ? AND submission_num = ? "
-            "ORDER BY job_num",
-            (job_row["run_id"], job_row["replica_num"], job_row["submission_num"]),
-        )
-        ips = []
-        for r in rows:
-            d = loads(r.get("job_provisioning_data"))
-            ips.append((d or {}).get("internal_ip") or (d or {}).get("hostname") or "")
+        ips = await _replica_job_ips(db, job_row)
     return ClusterInfo(
         master_node_ip=ips[0] if ips else "",
         nodes_ips=ips,
+        slice_ips=slice_ips,
+        slice_id=slice_id,
+        num_slices=n_slices,
+        megascale_coordinator_address=megascale_address,
         tpu_chips_per_host=tpu.chips_per_host if tpu else 0,
         tpu_total_chips=tpu.chips if tpu else 0,
         tpu_topology=tpu.topology if tpu else None,
@@ -268,6 +297,10 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
             agent_schemas.SubmitBody(
                 run_name=run_row["run_name"],
                 job_name=job_spec.job_name,
+                # wire contract: the submitted job_num is the rank the
+                # runner feeds cluster_env() — the WITHIN-SLICE worker id
+                # for slice jobs (jpd.worker_id; cluster_env derives the
+                # global rank from slice_id), the global job_num otherwise
                 job_spec={
                     **job_spec.model_dump(),
                     "job_num": jpd.worker_id if jpd.hosts else job_spec.job_num,

@@ -111,8 +111,17 @@ async def _process_job(db: Database, job_id: str) -> None:
             continue
         tpu = offer.instance.resources.tpu
         if tpu is not None and job_spec.jobs_per_replica > 1:
-            # slice worker count must cover the requested nodes
-            if tpu.hosts < job_spec.jobs_per_replica:
+            tpu_req = requirements.resources.tpu
+            n_slices = tpu_req.slices if tpu_req is not None else 1
+            if n_slices > 1:
+                # multislice: job_num decomposes slice-major by the
+                # slice's host count, so every slice must have EXACTLY
+                # nodes/slices hosts — a bigger slice would shift the
+                # decomposition and leave slices unprovisioned
+                if tpu.hosts != job_spec.jobs_per_replica // n_slices:
+                    continue
+            elif tpu.hosts < job_spec.jobs_per_replica:
+                # single slice must cover all requested nodes
                 continue
         instance_name = f"{run_row['run_name']}-{job_spec.replica_num}-{job_spec.job_num}"
         config = InstanceConfiguration(
@@ -184,22 +193,108 @@ async def _attach_worker_job(
         )
         return
     jpd = JobProvisioningData.model_validate(master_jpd)
-    if len(jpd.hosts) > job_spec.job_num:
-        # multi-host slice: attach to worker job_num
-        worker = jpd.hosts[job_spec.job_num]
-        jpd.worker_id = job_spec.job_num
-        jpd.hostname = worker.external_ip or worker.internal_ip
-        jpd.internal_ip = worker.internal_ip
-        await _assign(
-            db, job_row, master["instance_id"], jpd.model_dump(), worker_id=job_spec.job_num
+    tpu_req = job_spec.requirements.resources.tpu
+    n_slices = tpu_req.slices if tpu_req is not None else 1
+    if tpu_req is not None and not jpd.hosts:
+        # TPU job but the master slice's worker hosts are not known yet
+        # (GCP fills them by polling after create, gcp/compute.py): wait.
+        # Falling through would sibling-provision standalone slices per
+        # worker host.
+        await db.update_by_id(
+            "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
         )
-        logger.info(
-            "job %s attached to slice worker %d", job_spec.job_name, job_spec.job_num
+        return
+    if n_slices > 1 and jpd.hosts:
+        # DCN multislice: job_num indexes (slice, worker) slice-major.
+        # slice 0 is the master job's slice; worker-0 jobs of later
+        # slices each provision one more identical slice; the rest
+        # attach to their slice's instance.
+        hps = len(jpd.hosts)
+        slice_idx, worker = divmod(job_spec.job_num, hps)
+        if slice_idx == 0:
+            await _attach_to_slice(db, job_row, job_spec, master, jpd, worker)
+        elif worker == 0:
+            await _provision_sibling(
+                db, job_row, run_row, job_spec, jpd, same_instance_type=True
+            )
+        else:
+            slice_master = await db.fetchone(
+                "SELECT * FROM jobs WHERE run_id = ? AND replica_num = ? "
+                "AND job_num = ? AND submission_num = ?",
+                (
+                    run_row["id"],
+                    job_row["replica_num"],
+                    slice_idx * hps,
+                    job_row["submission_num"],
+                ),
+            )
+            if slice_master is None:
+                await _fail(
+                    db, job_row, JobTerminationReason.TERMINATED_BY_SERVER,
+                    f"no slice-master job for slice {slice_idx}",
+                )
+                return
+            if slice_master["status"] in (
+                JobStatus.FAILED.value,
+                JobStatus.TERMINATED.value,
+                JobStatus.ABORTED.value,
+            ):
+                await _fail(
+                    db, job_row, JobTerminationReason.TERMINATED_BY_SERVER,
+                    f"slice-master job of slice {slice_idx} failed",
+                )
+                return
+            sm_jpd = loads(slice_master.get("job_provisioning_data"))
+            if not sm_jpd or not slice_master.get("instance_id"):
+                await db.update_by_id(
+                    "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+                )
+                return
+            sm = JobProvisioningData.model_validate(sm_jpd)
+            if not sm.hosts:
+                # slice provisioned but its worker hosts not polled yet
+                await db.update_by_id(
+                    "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+                )
+                return
+            await _attach_to_slice(db, job_row, job_spec, slice_master, sm, worker)
+    elif len(jpd.hosts) > job_spec.job_num:
+        # multi-host slice: attach to worker job_num
+        await _attach_to_slice(
+            db, job_row, job_spec, master, jpd, job_spec.job_num
         )
     else:
         # single-host instances: provision a separate instance per node
         # in the same backend/region (cluster fleet)
         await _provision_sibling(db, job_row, run_row, job_spec, jpd)
+
+
+async def _attach_to_slice(
+    db: Database,
+    job_row: dict,
+    job_spec: JobSpec,
+    owner_job: dict,
+    jpd: JobProvisioningData,
+    worker: int,
+) -> None:
+    """Point this job at worker ``worker`` of an already-provisioned
+    slice instance (owned by ``owner_job``)."""
+    if worker >= len(jpd.hosts):
+        await _fail(
+            db, job_row, JobTerminationReason.TERMINATED_BY_SERVER,
+            f"slice has {len(jpd.hosts)} hosts, worker {worker} requested",
+        )
+        return
+    host = jpd.hosts[worker]
+    jpd.worker_id = worker
+    jpd.hostname = host.external_ip or host.internal_ip
+    jpd.internal_ip = host.internal_ip
+    await _assign(
+        db, job_row, owner_job["instance_id"], jpd.model_dump(), worker_id=worker
+    )
+    logger.info(
+        "job %s attached to slice worker %d", job_spec.job_name, worker
+    )
 
 
 async def _instance_ssh_keys(db: Database, project_row: dict, run_spec) -> list[str]:
@@ -220,8 +315,17 @@ async def _instance_ssh_keys(db: Database, project_row: dict, run_spec) -> list[
 
 
 async def _provision_sibling(
-    db: Database, job_row: dict, run_row: dict, job_spec: JobSpec, master_jpd
+    db: Database,
+    job_row: dict,
+    run_row: dict,
+    job_spec: JobSpec,
+    master_jpd,
+    same_instance_type: bool = False,
 ) -> None:
+    """Provision one more instance for this replica in the master's
+    backend/region: a per-node VM for non-slice multinode, or (with
+    ``same_instance_type``) one more identical slice of a DCN multislice
+    job — each slice is its own QueuedResource on GCP."""
     project_row = await db.get_by_id("projects", run_row["project_id"])
     compute = await backends_service.get_project_backend(
         db, project_row, master_jpd.backend
@@ -234,6 +338,10 @@ async def _provision_sibling(
         return
     offers = await compute.get_offers(job_spec.requirements)
     offers = [o for o in offers if o.region == master_jpd.region]
+    if same_instance_type:
+        offers = [
+            o for o in offers if o.instance.name == master_jpd.instance_type.name
+        ]
     offers = offers[: settings.MAX_OFFERS_TRIED]
     if not offers:
         await _fail_no_capacity(db, job_row, "no sibling offers in master region")

@@ -13,6 +13,7 @@ from dstack_tpu.core.models.configurations import (
     ServiceConfiguration,
     TaskConfiguration,
 )
+from dstack_tpu.core.errors import ConfigurationError
 from dstack_tpu.core.models.profiles import resolve_retry
 from dstack_tpu.core.models.runs import (
     AppSpec,
@@ -83,6 +84,14 @@ def get_job_specs_from_run_spec(run_spec: RunSpec, replica_num: int = 0) -> list
     run_name = run_spec.run_name or "run"
     if isinstance(conf, TaskConfiguration):
         nodes = conf.nodes
+        tpu_req = (conf.resources.tpu if conf.resources else None)
+        if tpu_req is not None and tpu_req.slices > 1:
+            # DCN multislice: nodes spans all slices' worker hosts
+            if nodes < tpu_req.slices or nodes % tpu_req.slices != 0:
+                raise ConfigurationError(
+                    f"nodes ({nodes}) must be a multiple of tpu.slices "
+                    f"({tpu_req.slices}) — one job per worker host per slice"
+                )
         ssh_key = None
         if nodes > 1:
             private, public = generate_rsa_key_pair_bytes(f"{run_name}-internode")

@@ -113,6 +113,7 @@ class FakeCompute(Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinod
         self.created: list[InstanceConfiguration] = []
         self.terminated: list[str] = []
         self._counter = 0
+        self._pending_hosts: dict[str, list[HostMetadata]] = {}
 
     async def get_offers(self, requirements: Requirements):
         res = requirements.resources
@@ -148,10 +149,11 @@ class FakeCompute(Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinod
                     external_ip=f"34.1.{self._counter}.{w + 1}" if w == 0 else None,
                 )
             )
+        instance_id = f"fake-{self._counter}"
         jpd = JobProvisioningData(
             backend=instance_offer.backend,
             instance_type=instance_offer.instance,
-            instance_id=f"fake-{self._counter}",
+            instance_id=instance_id,
             hostname=None if self.delay_ips else (hosts[0].external_ip or hosts[0].internal_ip),
             internal_ip=None if self.delay_ips else hosts[0].internal_ip,
             region=instance_offer.region,
@@ -161,12 +163,14 @@ class FakeCompute(Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinod
             hosts=[] if self.delay_ips else hosts,
             backend_data=None,
         )
-        self._pending_hosts = hosts
+        self._pending_hosts[instance_id] = hosts
         return jpd
 
     async def update_provisioning_data(self, provisioning_data):
         if self.delay_ips and not provisioning_data.ready():
-            hosts = getattr(self, "_pending_hosts", [])
+            hosts = getattr(self, "_pending_hosts", {}).get(
+                provisioning_data.instance_id, []
+            )
             provisioning_data.hosts = hosts
             if hosts:
                 provisioning_data.hostname = hosts[0].external_ip or hosts[0].internal_ip

@@ -104,6 +104,119 @@ class TestSubmittedJobs:
         jpd3 = loads(jobs[3]["job_provisioning_data"])
         assert jpd3["hostname"].startswith("10.0.")
 
+    async def test_multislice_dcn(self):
+        """2 slices × 2 hosts (tpu.slices=2): master provisions slice A,
+        worker-0 of slice B provisions a second identical slice, the
+        other jobs attach — 2 instances, 4 jobs, MEGASCALE_* env wired
+        (the reference refuses even multi-host single slices,
+        gcp/compute.py:699-726)."""
+        from dstack_tpu.agent.python.runner import cluster_env
+        from dstack_tpu.core.models.runs import JobProvisioningData
+        from dstack_tpu.server.background.tasks.process_running_jobs import (
+            _build_cluster_info,
+        )
+
+        offers = [tpu_offer(version="v5e", chips=16, topology="4x4", hosts=2, price=19.2)]
+        db, user_row, project_row, compute = await _setup(offers=offers)
+        conf = {
+            "type": "task",
+            "nodes": 4,
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5e", "chips": 16, "slices": 2}},
+        }
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "multislice")
+        )
+        for _ in range(4):
+            await process_submitted_jobs(db)
+        jobs = await db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY job_num", (run.id,)
+        )
+        assert len(jobs) == 4
+        assert all(j["status"] == JobStatus.PROVISIONING.value for j in jobs)
+        assert len(compute.created) == 2  # one QueuedResource per slice
+        # jobs 0,1 on slice A; 2,3 on slice B
+        assert jobs[0]["instance_id"] == jobs[1]["instance_id"]
+        assert jobs[2]["instance_id"] == jobs[3]["instance_id"]
+        assert jobs[0]["instance_id"] != jobs[2]["instance_id"]
+
+        for j in jobs:
+            jpd = JobProvisioningData.model_validate(
+                loads(j["job_provisioning_data"])
+            )
+            ci = await _build_cluster_info(db, j, jpd)
+            assert ci.num_slices == 2
+            assert ci.slice_id == j["job_num"] // 2
+            assert len(ci.nodes_ips) == 4 and "" not in ci.nodes_ips
+            assert len(ci.slice_ips) == 2
+            assert ci.megascale_coordinator_address == f"{ci.nodes_ips[0]}:8080"
+            env = cluster_env(ci, worker_id=jpd.worker_id)
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == str(j["job_num"] // 2)
+            assert env["MEGASCALE_COORDINATOR_ADDRESS"].endswith(":8080")
+            assert env["TPU_WORKER_ID"] == str(j["job_num"] % 2)
+            assert env["DTPU_NODE_RANK"] == str(j["job_num"])
+            assert env["JAX_NUM_PROCESSES"] == "4"
+            assert env["TPU_WORKER_HOSTNAMES"].count(",") == 1  # 2 slice hosts
+
+    async def test_multislice_requires_exact_host_count(self):
+        """nodes=2, slices=2 needs 1-host slices; a 2-host offer must be
+        rejected (a bigger slice would shift the slice-major job
+        decomposition and leave slice B unprovisioned)."""
+        offers = [tpu_offer(version="v5e", chips=16, topology="4x4", hosts=2, price=19.2)]
+        db, user_row, project_row, compute = await _setup(offers=offers)
+        conf = {
+            "type": "task",
+            "nodes": 2,
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5e", "chips": 16, "slices": 2}},
+        }
+        await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "mismatched")
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs WHERE job_num = 0")
+        assert job["status"] == JobStatus.TERMINATING.value
+        assert job["termination_reason"] == "failed_to_start_due_to_no_capacity"
+        assert len(compute.created) == 0
+
+    async def test_multislice_waits_for_delayed_hosts(self):
+        """GCP-style delayed IPs: multislice worker jobs must requeue
+        until the master slice's hosts are known — not fall into
+        per-node sibling provisioning of standalone slices."""
+        offers = [tpu_offer(version="v5e", chips=16, topology="4x4", hosts=2, price=19.2)]
+        db, user_row, project_row, compute = await _setup(
+            offers=offers, delay_ips=True
+        )
+        conf = {
+            "type": "task",
+            "nodes": 4,
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5e", "chips": 16, "slices": 2}},
+        }
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "delayed-ms")
+        )
+        for _ in range(4):
+            await process_submitted_jobs(db)
+        # master created slice A; workers must all be waiting, NOT
+        # provisioning their own instances
+        assert len(compute.created) == 1
+        await process_instances(db)  # fills slice A's hosts
+        for _ in range(4):
+            await process_submitted_jobs(db)
+        await process_instances(db)  # fills slice B's hosts
+        for _ in range(4):
+            await process_submitted_jobs(db)
+        jobs = await db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY job_num", (run.id,)
+        )
+        assert [j["status"] for j in jobs] == [JobStatus.PROVISIONING.value] * 4
+        assert len(compute.created) == 2
+        assert jobs[0]["instance_id"] == jobs[1]["instance_id"]
+        assert jobs[2]["instance_id"] == jobs[3]["instance_id"]
+        assert jobs[0]["instance_id"] != jobs[2]["instance_id"]
+
     async def test_sibling_provisioning_walks_offers(self):
         """Non-slice multinode: worker nodes provision separate
         instances; one stockout must not fail the node (reference walks
