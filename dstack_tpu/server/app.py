@@ -12,7 +12,7 @@ from aiohttp import web
 from dstack_tpu.core.models.backends import BackendType
 from dstack_tpu.server import settings
 from dstack_tpu.server.background import create_scheduler
-from dstack_tpu.server.db import Database
+from dstack_tpu.server.db import Database, create_database
 from dstack_tpu.server.http.kit import build_app
 from dstack_tpu.server.routers.core import ALL_ROUTERS, auth_dependency
 from dstack_tpu.server.services import backends as backends_service
@@ -31,7 +31,7 @@ async def create_app(
     local_backend: bool = True,
     apply_server_config: bool = False,
 ) -> web.Application:
-    db = Database(database_url or settings.DATABASE_URL)
+    db = create_database(database_url or settings.DATABASE_URL)
     await db.connect()
     await db.migrate()
 

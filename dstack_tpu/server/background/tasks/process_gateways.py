@@ -15,7 +15,6 @@ from dstack_tpu.proxy.stats import get_service_stats
 from dstack_tpu.server.db import Database, dumps, loads
 from dstack_tpu.server.services import backends as backends_service
 from dstack_tpu.server.services import gateways as gateways_service
-from dstack_tpu.server.services.locking import claim_one
 from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("background.process_gateways")
@@ -29,7 +28,7 @@ async def process_gateways(db: Database) -> None:
         "ORDER BY last_processed_at ASC LIMIT 10",
         (GatewayStatus.SUBMITTED.value, GatewayStatus.PROVISIONING.value),
     )
-    async with claim_one("gateways", [r["id"] for r in rows]) as gid:
+    async with db.claim_one("gateways", [r["id"] for r in rows]) as gid:
         if gid is not None:
             await _process(db, gid)
     await _collect_stats(db)

@@ -15,7 +15,6 @@ from dstack_tpu.core.models.runs import JobProvisioningData, now_utc
 from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database, dumps, loads
 from dstack_tpu.server.services import backends as backends_service
-from dstack_tpu.server.services.locking import claim_one
 from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("server.process_instances")
@@ -34,7 +33,7 @@ async def process_instances(db: Database) -> None:
         "AND deleted = 0 ORDER BY last_processed_at ASC LIMIT ?",
         (*ACTIVE, settings.MAX_PROCESSING_INSTANCES),
     )
-    async with claim_one("instances", [r["id"] for r in rows]) as iid:
+    async with db.claim_one("instances", [r["id"] for r in rows]) as iid:
         if iid is None:
             return
         await _process(db, iid)

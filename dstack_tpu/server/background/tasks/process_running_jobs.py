@@ -27,7 +27,6 @@ from dstack_tpu.server.services.agent_client import (
     runner_client_for,
     shim_client_for,
 )
-from dstack_tpu.server.services.locking import claim_one
 from dstack_tpu.server.services.logs import get_log_storage
 from dstack_tpu.utils.logging import get_logger
 
@@ -46,7 +45,7 @@ async def process_running_jobs(db: Database) -> None:
         "ORDER BY last_processed_at ASC LIMIT ?",
         (*ACTIVE, settings.MAX_PROCESSING_JOBS),
     )
-    async with claim_one("jobs", [r["id"] for r in rows]) as job_id:
+    async with db.claim_one("jobs", [r["id"] for r in rows]) as job_id:
         if job_id is None:
             return
         await _process(db, job_id)

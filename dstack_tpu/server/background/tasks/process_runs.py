@@ -19,7 +19,6 @@ from dstack_tpu.core.models.runs import (
 from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database, dumps, loads
 from dstack_tpu.server.services import jobs as jobs_service
-from dstack_tpu.server.services.locking import claim_one
 from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("server.process_runs")
@@ -39,7 +38,7 @@ async def process_runs(db: Database) -> None:
         "AND deleted = 0 ORDER BY last_processed_at ASC LIMIT ?",
         (*ACTIVE, settings.MAX_PROCESSING_RUNS),
     )
-    async with claim_one("runs", [r["id"] for r in rows]) as run_id:
+    async with db.claim_one("runs", [r["id"] for r in rows]) as run_id:
         if run_id is None:
             return
         await _process(db, run_id)

@@ -35,7 +35,6 @@ from dstack_tpu.server.db import Database, dumps, loads
 from dstack_tpu.server.services import backends as backends_service
 from dstack_tpu.server.services import instances as instances_service
 from dstack_tpu.server.services import jobs as jobs_service
-from dstack_tpu.server.services.locking import claim_one
 from dstack_tpu.server.services.offers import get_offers_by_requirements
 from dstack_tpu.utils.logging import get_logger
 
@@ -47,7 +46,7 @@ async def process_submitted_jobs(db: Database) -> None:
         "SELECT id FROM jobs WHERE status = ? ORDER BY last_processed_at ASC LIMIT ?",
         (JobStatus.SUBMITTED.value, settings.MAX_PROCESSING_JOBS),
     )
-    async with claim_one("jobs", [r["id"] for r in rows]) as job_id:
+    async with db.claim_one("jobs", [r["id"] for r in rows]) as job_id:
         if job_id is None:
             return
         await _process_job(db, job_id)

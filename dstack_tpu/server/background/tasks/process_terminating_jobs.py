@@ -17,7 +17,6 @@ from dstack_tpu.server import settings
 from dstack_tpu.server.db import Database, loads
 from dstack_tpu.server.services import jobs as jobs_service
 from dstack_tpu.server.services.agent_client import shim_client_for
-from dstack_tpu.server.services.locking import claim_one
 from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("server.process_terminating_jobs")
@@ -28,7 +27,7 @@ async def process_terminating_jobs(db: Database) -> None:
         "SELECT id FROM jobs WHERE status = ? ORDER BY last_processed_at ASC LIMIT ?",
         (JobStatus.TERMINATING.value, settings.MAX_PROCESSING_JOBS),
     )
-    async with claim_one("jobs", [r["id"] for r in rows]) as job_id:
+    async with db.claim_one("jobs", [r["id"] for r in rows]) as job_id:
         if job_id is None:
             return
         await _process(db, job_id)

@@ -10,7 +10,6 @@ from dstack_tpu.core.models.runs import now_utc
 from dstack_tpu.core.models.volumes import Volume, VolumeStatus
 from dstack_tpu.server.db import Database, dumps, loads
 from dstack_tpu.server.services import backends as backends_service
-from dstack_tpu.server.services.locking import claim_one
 from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("server.process_volumes")
@@ -22,7 +21,7 @@ async def process_volumes(db: Database) -> None:
         "ORDER BY last_processed_at ASC LIMIT 10",
         (VolumeStatus.SUBMITTED.value,),
     )
-    async with claim_one("volumes", [r["id"] for r in rows]) as vid:
+    async with db.claim_one("volumes", [r["id"] for r in rows]) as vid:
         if vid is None:
             return
         await _provision(db, vid)

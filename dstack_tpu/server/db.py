@@ -1,4 +1,4 @@
-"""Async database layer over stdlib sqlite3.
+"""Async database layer: stdlib-sqlite3 engine + engine factory.
 
 The reference uses async SQLAlchemy + Alembic (reference server/db.py,
 server/migrations/). This image has neither, so the framework ships its
@@ -7,9 +7,11 @@ thread (sqlite connections are not thread-hoppable; a single worker
 thread serializes writes, matching sqlite's writer model), WAL mode for
 concurrent readers, an ordered in-code migration list, and dict rows.
 
-Postgres support is gated: if DTPU_DATABASE_URL is postgres:// and
-asyncpg is importable, the same Database interface binds to it (not
-bundled in this image).
+``DTPU_DATABASE_URL=postgres://…`` selects the asyncpg engine
+(:mod:`dstack_tpu.server.db_pg`) through :func:`create_database` — same
+interface, qmark SQL translated to ``$n``, row claims via Postgres
+advisory locks so multiple server replicas can share one database
+(reference runs sqlite AND postgres the same way).
 """
 
 import asyncio
@@ -27,13 +29,23 @@ from dstack_tpu.utils.logging import get_logger
 logger = get_logger("server.db")
 
 
+def create_database(url: str = "") -> "Database":
+    """Engine factory: sqlite:// (default) or postgres://."""
+    url = url or "sqlite://:memory:"
+    if url.startswith("postgres"):
+        from dstack_tpu.server.db_pg import PostgresDatabase
+
+        return PostgresDatabase(url)
+    return Database(url)
+
+
 class Database:
+    dialect = "sqlite"
+
     def __init__(self, url: str = ""):
         self.url = url or "sqlite://:memory:"
         if self.url.startswith("postgres"):
-            raise NotImplementedError(
-                "postgres requires asyncpg (not bundled); use sqlite"
-            )
+            raise ValueError("use create_database() for postgres:// URLs")
         path = self.url.removeprefix("sqlite://")
         self._path = path
         # one worker thread owns the connection: sqlite's single-writer
@@ -159,6 +171,16 @@ class Database:
 
                 await self._run(_rollback)
                 raise
+
+    @asynccontextmanager
+    async def claim_one(self, namespace: str, candidates: list):
+        """SKIP-LOCKED-style queue pop. The sqlite engine is
+        single-process, so an in-memory lockset suffices; the postgres
+        engine overrides this with advisory locks (db_pg.py)."""
+        from dstack_tpu.server.services.locking import claim_one as _claim
+
+        async with _claim(namespace, candidates) as claimed:
+            yield claimed
 
     # -- generic row helpers --
 

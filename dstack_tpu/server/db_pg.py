@@ -1,0 +1,305 @@
+"""Postgres database engine (asyncpg) — control-plane scale-out.
+
+Parity: reference server/db.py (async SQLAlchemy bound to
+sqlite+aiosqlite OR postgresql+asyncpg) and services/locking.py:42
+(Postgres advisory locks). This framework's services speak plain
+qmark-parameterized SQL against the :class:`~dstack_tpu.server.db.Database`
+interface; this engine translates that dialect to Postgres:
+
+- ``?`` placeholders → ``$1..$n`` (string literals and quoted
+  identifiers respected),
+- migration scripts split into single statements (asyncpg has no
+  ``executescript``),
+- ``claim_one`` row claims → ``pg_try_advisory_lock`` so multiple
+  server replicas can run reconcilers against one database (the
+  in-memory lockset only serializes one process),
+- migrations run under one advisory lock (reference app.py:96-100).
+
+asyncpg is not bundled in every image; the engine raises a clear error
+at construction when it is missing. ``DTPU_DATABASE_URL=postgres://…``
+selects it via :func:`dstack_tpu.server.db.create_database`.
+"""
+
+import contextvars
+import hashlib
+from contextlib import asynccontextmanager
+from typing import Any, Iterable, Optional, Sequence
+
+from dstack_tpu.utils.logging import get_logger
+
+try:  # gated: not bundled in the TPU image
+    import asyncpg  # type: ignore
+except ImportError:  # pragma: no cover - exercised via fake pool in tests
+    asyncpg = None
+
+logger = get_logger("server.db_pg")
+
+MIGRATION_LOCK_KEY = 0x5D7AC & 0x7FFFFFFF  # server-init advisory lock
+
+
+def qmark_to_dollar(sql: str) -> str:
+    """Translate ``?`` placeholders to ``$1..$n``.
+
+    Skips single-quoted string literals (with ``''`` escapes) and
+    double-quoted identifiers; no services SQL uses ``?`` operators.
+    """
+    out: list[str] = []
+    n = 0
+    i = 0
+    quote: Optional[str] = None
+    while i < len(sql):
+        c = sql[i]
+        if quote is not None:
+            out.append(c)
+            if c == quote:
+                # '' / "" escape: stay inside the literal
+                if i + 1 < len(sql) and sql[i + 1] == quote:
+                    out.append(quote)
+                    i += 1
+                else:
+                    quote = None
+        elif c in ("'", '"'):
+            quote = c
+            out.append(c)
+        elif c == "?":
+            n += 1
+            out.append(f"${n}")
+        else:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def split_statements(script: str) -> list[str]:
+    """Split a migration script into single statements on ``;`` outside
+    quotes (asyncpg prepares one statement at a time)."""
+    stmts: list[str] = []
+    buf: list[str] = []
+    quote: Optional[str] = None
+    i = 0
+    while i < len(script):
+        c = script[i]
+        if quote is not None:
+            buf.append(c)
+            if c == quote:
+                if i + 1 < len(script) and script[i + 1] == quote:
+                    buf.append(quote)
+                    i += 1
+                else:
+                    quote = None
+        elif c in ("'", '"'):
+            quote = c
+            buf.append(c)
+        elif c == ";":
+            s = "".join(buf).strip()
+            if s:
+                stmts.append(s)
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    s = "".join(buf).strip()
+    if s:
+        stmts.append(s)
+    return stmts
+
+
+def to_pg_ddl(stmt: str) -> str:
+    """Translate the (sqlite-dialect) migration DDL to Postgres: the
+    schemas avoid sqlite-isms by construction, leaving only type-name
+    differences."""
+    return stmt.replace(" BLOB", " BYTEA")
+
+
+def advisory_key(namespace: str, key: Any) -> int:
+    """Stable signed-64-bit advisory lock key for (namespace, id)."""
+    digest = hashlib.sha1(f"{namespace}:{key}".encode()).digest()
+    v = int.from_bytes(digest[:8], "big", signed=True)
+    return v
+
+
+_tx_conn: contextvars.ContextVar = contextvars.ContextVar(
+    "dtpu_pg_tx_conn", default=None
+)
+
+
+class PostgresDatabase:
+    """asyncpg-backed Database (same interface as db.Database)."""
+
+    dialect = "postgres"
+
+    def __init__(self, url: str, pool_factory=None):
+        # `pool_factory` lets tests substitute a fake asyncpg pool
+        if pool_factory is None and asyncpg is None:
+            raise RuntimeError(
+                "DTPU_DATABASE_URL is postgres:// but asyncpg is not "
+                "installed; install asyncpg or use sqlite://"
+            )
+        self.url = url.replace("postgres://", "postgresql://", 1)
+        self._pool_factory = pool_factory
+        self._pool = None
+        self._lock_pool = None
+
+    async def connect(self) -> None:
+        if self._pool_factory is not None:
+            self._pool = await self._pool_factory(self.url)
+            self._lock_pool = self._pool
+        else:
+            self._pool = await asyncpg.create_pool(
+                dsn=self.url, min_size=1, max_size=10
+            )
+            # advisory claims hold their connection for a reconciler's
+            # whole body (possibly multi-second cloud calls); a separate
+            # pool keeps them from starving query traffic
+            self._lock_pool = await asyncpg.create_pool(
+                dsn=self.url, min_size=1, max_size=8
+            )
+
+    async def close(self) -> None:
+        if self._lock_pool is not None and self._lock_pool is not self._pool:
+            await self._lock_pool.close()
+        self._lock_pool = None
+        if self._pool is not None:
+            await self._pool.close()
+            self._pool = None
+
+    # -- connection routing: inside `transaction()` every query of this
+    # asyncio task rides the transaction's connection --
+
+    @asynccontextmanager
+    async def _conn(self):
+        tx = _tx_conn.get()
+        if tx is not None:
+            yield tx
+            return
+        conn = await self._pool.acquire()
+        try:
+            yield conn
+        finally:
+            await self._pool.release(conn)
+
+    async def migrate(self) -> None:
+        from dstack_tpu.server import migrations
+
+        async with self._conn() as conn:
+            # one replica migrates at a time (reference app.py:96-100)
+            await conn.fetchval(
+                "SELECT pg_advisory_lock($1)", MIGRATION_LOCK_KEY
+            )
+            try:
+                await conn.execute(
+                    "CREATE TABLE IF NOT EXISTS schema_migrations ("
+                    "id SERIAL PRIMARY KEY, name TEXT NOT NULL UNIQUE, "
+                    "applied_at TIMESTAMPTZ NOT NULL DEFAULT now())"
+                )
+                rows = await conn.fetch("SELECT name FROM schema_migrations")
+                applied = {r["name"] for r in rows}
+                for name, sql in migrations.MIGRATIONS:
+                    if name in applied:
+                        continue
+                    logger.info("applying migration %s", name)
+                    # one transaction per migration: a mid-script failure
+                    # must not leave half a schema behind (re-running
+                    # would then die on "already exists" forever)
+                    tx = conn.transaction()
+                    await tx.start()
+                    try:
+                        for stmt in split_statements(sql):
+                            await conn.execute(to_pg_ddl(stmt))
+                        await conn.execute(
+                            "INSERT INTO schema_migrations (name) VALUES ($1)",
+                            name,
+                        )
+                        await tx.commit()
+                    except BaseException:
+                        await tx.rollback()
+                        raise
+            finally:
+                await conn.fetchval(
+                    "SELECT pg_advisory_unlock($1)", MIGRATION_LOCK_KEY
+                )
+
+    # -- query interface (qmark SQL, translated) --
+
+    async def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        async with self._conn() as conn:
+            status = await conn.execute(qmark_to_dollar(sql), *params)
+            try:  # e.g. "UPDATE 3" / "INSERT 0 1"
+                return int(str(status).rsplit(" ", 1)[-1])
+            except (ValueError, IndexError):
+                return 0
+
+    async def executemany(self, sql: str, seq: Iterable[Sequence[Any]]) -> None:
+        async with self._conn() as conn:
+            await conn.executemany(qmark_to_dollar(sql), list(seq))
+
+    async def fetchall(self, sql: str, params: Sequence[Any] = ()) -> list[dict]:
+        async with self._conn() as conn:
+            rows = await conn.fetch(qmark_to_dollar(sql), *params)
+            return [dict(r) for r in rows]
+
+    async def fetchone(self, sql: str, params: Sequence[Any] = ()) -> Optional[dict]:
+        async with self._conn() as conn:
+            r = await conn.fetchrow(qmark_to_dollar(sql), *params)
+            return dict(r) if r is not None else None
+
+    @asynccontextmanager
+    async def transaction(self):
+        conn = await self._pool.acquire()
+        tx = conn.transaction()
+        await tx.start()
+        token = _tx_conn.set(conn)
+        try:
+            yield self
+            await tx.commit()
+        except BaseException:
+            await tx.rollback()
+            raise
+        finally:
+            _tx_conn.reset(token)
+            await self._pool.release(conn)
+
+    # -- cross-replica row claims (pg_try_advisory_lock) --
+
+    @asynccontextmanager
+    async def claim_one(self, namespace: str, candidates: list):
+        """SKIP-LOCKED-style queue pop that holds across server
+        replicas: first candidate whose advisory lock is free."""
+        conn = await self._lock_pool.acquire()
+        claimed = None
+        try:
+            for k in candidates:
+                got = await conn.fetchval(
+                    "SELECT pg_try_advisory_lock($1)", advisory_key(namespace, k)
+                )
+                if got:
+                    claimed = k
+                    break
+            yield claimed
+        finally:
+            if claimed is not None:
+                await conn.fetchval(
+                    "SELECT pg_advisory_unlock($1)",
+                    advisory_key(namespace, claimed),
+                )
+            await self._lock_pool.release(conn)
+
+    # -- generic row helpers (same as db.Database) --
+
+    async def insert(self, table: str, row: dict) -> None:
+        cols = ", ".join(row)
+        ph = ", ".join("?" for _ in row)
+        await self.execute(
+            f"INSERT INTO {table} ({cols}) VALUES ({ph})", list(row.values())
+        )
+
+    async def update_by_id(self, table: str, id_: str, fields: dict) -> int:
+        if not fields:
+            return 0
+        sets = ", ".join(f"{k} = ?" for k in fields)
+        return await self.execute(
+            f"UPDATE {table} SET {sets} WHERE id = ?", [*fields.values(), id_]
+        )
+
+    async def get_by_id(self, table: str, id_: str) -> Optional[dict]:
+        return await self.fetchone(f"SELECT * FROM {table} WHERE id = ?", (id_,))

@@ -96,34 +96,6 @@ CREATE TABLE runs (
 );
 CREATE INDEX idx_runs_status ON runs(status, last_processed_at);
 
-CREATE TABLE jobs (
-    id TEXT PRIMARY KEY,
-    run_id TEXT NOT NULL REFERENCES runs(id),
-    run_name TEXT NOT NULL,
-    project_id TEXT NOT NULL REFERENCES projects(id),
-    job_num INTEGER NOT NULL DEFAULT 0,
-    replica_num INTEGER NOT NULL DEFAULT 0,
-    submission_num INTEGER NOT NULL DEFAULT 0,
-    job_name TEXT NOT NULL,
-    status TEXT NOT NULL DEFAULT 'submitted',
-    termination_reason TEXT,
-    termination_reason_message TEXT,
-    exit_status INTEGER,
-    job_spec TEXT NOT NULL,
-    job_provisioning_data TEXT,
-    job_runtime_data TEXT,
-    instance_id TEXT REFERENCES instances(id),
-    used_instance_id TEXT,
-    instance_assigned INTEGER NOT NULL DEFAULT 0,
-    disconnected_at TEXT,
-    inactivity_secs INTEGER,
-    submitted_at TEXT NOT NULL,
-    last_processed_at TEXT,
-    finished_at TEXT
-);
-CREATE INDEX idx_jobs_status ON jobs(status, last_processed_at);
-CREATE INDEX idx_jobs_run ON jobs(run_id);
-
 CREATE TABLE instances (
     id TEXT PRIMARY KEY,
     project_id TEXT NOT NULL REFERENCES projects(id),
@@ -155,6 +127,34 @@ CREATE TABLE instances (
     last_retry_at TEXT
 );
 CREATE INDEX idx_instances_status ON instances(status, last_processed_at);
+
+CREATE TABLE jobs (
+    id TEXT PRIMARY KEY,
+    run_id TEXT NOT NULL REFERENCES runs(id),
+    run_name TEXT NOT NULL,
+    project_id TEXT NOT NULL REFERENCES projects(id),
+    job_num INTEGER NOT NULL DEFAULT 0,
+    replica_num INTEGER NOT NULL DEFAULT 0,
+    submission_num INTEGER NOT NULL DEFAULT 0,
+    job_name TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT 'submitted',
+    termination_reason TEXT,
+    termination_reason_message TEXT,
+    exit_status INTEGER,
+    job_spec TEXT NOT NULL,
+    job_provisioning_data TEXT,
+    job_runtime_data TEXT,
+    instance_id TEXT REFERENCES instances(id),
+    used_instance_id TEXT,
+    instance_assigned INTEGER NOT NULL DEFAULT 0,
+    disconnected_at TEXT,
+    inactivity_secs INTEGER,
+    submitted_at TEXT NOT NULL,
+    last_processed_at TEXT,
+    finished_at TEXT
+);
+CREATE INDEX idx_jobs_status ON jobs(status, last_processed_at);
+CREATE INDEX idx_jobs_run ON jobs(run_id);
 
 CREATE TABLE volumes (
     id TEXT PRIMARY KEY,

@@ -40,15 +40,18 @@ async def prepare_placement_group(
         return None
     # one live group per (fleet, region); fleet_deleted rows are doomed —
     # a recreated same-name fleet must NOT reuse them (the reconciler is
-    # about to delete their cloud resource)
-    existing = await db.fetchone(
-        "SELECT id, name FROM placement_groups "
-        "WHERE fleet_id = ? AND json_extract(configuration, '$.region') = ? "
-        "AND deleted = 0 AND fleet_deleted = 0",
-        (fleet_id, region),
+    # about to delete their cloud resource). Region filtering happens in
+    # Python: JSON functions are dialect-specific (sqlite json_extract vs
+    # pg ->>) and a fleet has only a handful of groups.
+    rows = await db.fetchall(
+        "SELECT id, name, configuration FROM placement_groups "
+        "WHERE fleet_id = ? AND deleted = 0 AND fleet_deleted = 0",
+        (fleet_id,),
     )
-    if existing is not None:
-        return existing["name"]
+    for r in rows:
+        conf = loads(r.get("configuration")) or {}
+        if conf.get("region") == region:
+            return r["name"]
     name = f"{fleet_name}-{region}-{new_uuid()[:6]}-pg"
     backend_data = await compute.create_placement_group(name, region)
     await db.insert(

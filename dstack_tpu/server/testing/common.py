@@ -36,6 +36,36 @@ from dstack_tpu.server.services import users as users_service
 
 
 async def create_test_db() -> Database:
+    """In-memory sqlite by default; ``DTPU_TEST_DB=postgres`` runs the
+    same tests against a real Postgres at ``DTPU_TEST_PG_DSN`` (the
+    reference parametrizes its loop tests over sqlite AND postgres via
+    ``--runpostgres``; here the engine is an env switch so the whole
+    suite re-runs unchanged)."""
+    import os
+
+    if os.environ.get("DTPU_TEST_DB") == "postgres":
+        import uuid
+
+        import pytest
+
+        from dstack_tpu.server.db_pg import PostgresDatabase, asyncpg
+
+        dsn = os.environ.get("DTPU_TEST_PG_DSN")
+        if asyncpg is None or not dsn:
+            pytest.skip("postgres test engine needs asyncpg and DTPU_TEST_PG_DSN")
+        # fresh schema per test for isolation (schemas accumulate —
+        # point DTPU_TEST_PG_DSN at a throwaway database)
+        schema = f"t_{uuid.uuid4().hex[:12]}"
+        admin = await asyncpg.connect(dsn=dsn)
+        try:
+            await admin.execute(f'CREATE SCHEMA "{schema}"')
+        finally:
+            await admin.close()
+        sep = "&" if "?" in dsn else "?"
+        db = PostgresDatabase(f"{dsn}{sep}options=-csearch_path%3D{schema}")
+        await db.connect()
+        await db.migrate()
+        return db
     db = Database("sqlite://:memory:")
     await db.connect()
     await db.migrate()
