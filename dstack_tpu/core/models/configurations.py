@@ -107,11 +107,18 @@ class ScalingSpec(CoreModel):
 
 class ServiceModelSpec(CoreModel):
     """Registers the service in the OpenAI-compatible model gateway
-    (/proxy/models), cf. reference proxy/lib/routers/model_proxy.py."""
+    (/proxy/models), cf. reference proxy/lib/routers/model_proxy.py.
+
+    ``format: tgi`` services speak the text-generation-inference API;
+    the gateway adapts them to OpenAI chat/completions
+    (proxy/model_tgi.py), rendering ``chat_template`` (jinja,
+    llama-3-style default) and stopping at ``eos_token``."""
 
     name: str
     format: Literal["openai", "tgi"] = "openai"
     prefix: str = "/v1"
+    chat_template: Optional[str] = None
+    eos_token: Optional[str] = None
 
 
 class VolumeMountPoint(CoreModel):

@@ -1,0 +1,197 @@
+"""TGI → OpenAI model-format adapter.
+
+Services declaring ``model: {name: …, format: tgi}`` speak the
+text-generation-inference REST API (``/generate``, ``/generate_stream``)
+but are exposed through the gateway's OpenAI-compatible
+``/proxy/models/{project}/chat/completions`` endpoint. This module
+renders the chat template, maps OpenAI sampling params onto TGI
+parameters, and converts responses (incl. SSE streams) back to OpenAI
+chat-completion objects. Parity: reference
+proxy/lib/services/model_proxy/clients/tgi.py:208 (httpx+jinja there;
+aiohttp here, same wire behavior).
+"""
+
+import json
+import time
+import uuid
+from typing import AsyncIterator, Optional
+
+import jinja2
+import jinja2.sandbox
+
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("proxy.model_tgi")
+
+# Llama-3-style default; services can override with model.chat_template
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|start_header_id|>{{ message['role'] }}<|end_header_id|>\n\n"
+    "{{ message['content'] }}<|eot_id|>"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}"
+    "<|start_header_id|>assistant<|end_header_id|>\n\n"
+    "{% endif %}"
+)
+DEFAULT_EOS_TOKEN = "<|eot_id|>"
+
+
+class TGIAdapterError(Exception):
+    def __init__(self, message: str, status: int = 400):
+        super().__init__(message)
+        self.status = status
+
+
+def render_chat(
+    messages: list,
+    chat_template: Optional[str] = None,
+) -> str:
+    """Messages → prompt via a sandboxed jinja chat template."""
+    env = jinja2.sandbox.ImmutableSandboxedEnvironment(
+        trim_blocks=True, lstrip_blocks=True
+    )
+
+    def _raise(message: str):
+        raise jinja2.TemplateError(message)
+
+    env.globals["raise_exception"] = _raise
+    try:
+        template = env.from_string(chat_template or DEFAULT_CHAT_TEMPLATE)
+        return template.render(messages=messages, add_generation_prompt=True)
+    except jinja2.TemplateError as e:
+        raise TGIAdapterError(f"chat template failed: {e}")
+
+
+def openai_to_tgi(payload: dict, chat_template: Optional[str], eos_token: str) -> dict:
+    """OpenAI chat/completions request → TGI /generate payload."""
+    messages = payload.get("messages")
+    if not isinstance(messages, list) or not messages:
+        raise TGIAdapterError("'messages' is required")
+    inputs = render_chat(messages, chat_template)
+    stop = payload.get("stop") or []
+    if isinstance(stop, str):
+        stop = [stop]
+    stop = list(stop)
+    if eos_token and eos_token not in stop:
+        stop.append(eos_token)
+    parameters = {
+        "do_sample": True,
+        "max_new_tokens": payload.get("max_tokens") or 512,
+        "stop": stop,
+        "details": True,
+        "decoder_input_details": not payload.get("stream", False),
+    }
+    if payload.get("seed") is not None:
+        parameters["seed"] = payload["seed"]
+    if payload.get("temperature") is not None:
+        parameters["temperature"] = payload["temperature"]
+    if payload.get("n"):
+        parameters["best_of"] = payload["n"]
+    top_p = payload.get("top_p")
+    if top_p is not None and top_p < 1.0:
+        parameters["top_p"] = top_p
+    return {"inputs": inputs, "parameters": parameters}
+
+
+def _finish_reason(reason: str) -> str:
+    if reason in ("stop_sequence", "eos_token"):
+        return "stop"
+    return "length" if reason == "length" else reason
+
+
+def _trim_stop(text: str, stop: list) -> str:
+    for s in stop:
+        if s and text.endswith(s):
+            return text[: -len(s)]
+    return text
+
+
+def tgi_to_openai(data: dict, model: str, stop: list) -> dict:
+    """TGI /generate response → OpenAI chat.completion object."""
+    details = data.get("details") or {}
+    choices = [
+        {
+            "index": 0,
+            "message": {
+                "role": "assistant",
+                "content": _trim_stop(data.get("generated_text", ""), stop),
+            },
+            "finish_reason": _finish_reason(details.get("finish_reason", "stop")),
+        }
+    ]
+    completion_tokens = details.get("generated_tokens", 0)
+    prompt_tokens = len(details.get("prefill", []))
+    for i, seq in enumerate(details.get("best_of_sequences", []), start=1):
+        choices.append(
+            {
+                "index": i,
+                "message": {
+                    "role": "assistant",
+                    "content": _trim_stop(seq.get("generated_text", ""), stop),
+                },
+                "finish_reason": _finish_reason(seq.get("finish_reason", "stop")),
+            }
+        )
+        completion_tokens += seq.get("generated_tokens", 0)
+    return {
+        "id": f"chatcmpl-{uuid.uuid4().hex}",
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": choices,
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens,
+        },
+    }
+
+
+def tgi_chunk_to_openai(
+    data: dict, model: str, completion_id: str, created: int
+) -> dict:
+    """One TGI SSE stream event → OpenAI chat.completion.chunk."""
+    if "error" in data:
+        raise TGIAdapterError(str(data["error"]), status=502)
+    if data.get("details") is not None:
+        choices = [
+            {
+                "index": 0,
+                "delta": {},
+                "finish_reason": _finish_reason(
+                    data["details"].get("finish_reason", "stop")
+                ),
+            }
+        ]
+    else:
+        choices = [
+            {
+                "index": 0,
+                "delta": {
+                    "role": "assistant",
+                    "content": (data.get("token") or {}).get("text", ""),
+                },
+                "finish_reason": None,
+            }
+        ]
+    return {
+        "id": completion_id,
+        "object": "chat.completion.chunk",
+        "created": created,
+        "model": model,
+        "choices": choices,
+    }
+
+
+async def iter_sse_data(resp) -> AsyncIterator[dict]:
+    """Yield decoded ``data: {json}`` events from an aiohttp response."""
+    buf = b""
+    async for chunk, _ in resp.content.iter_chunks():
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            text = line.decode(errors="replace").strip()
+            if text.startswith("data:"):
+                body = text[len("data:"):].strip()
+                if body and body != "[DONE]":
+                    yield json.loads(body)

@@ -187,12 +187,117 @@ async def model_proxy_handler(request: web.Request) -> web.StreamResponse:
         )
     host, port = replicas[next(_rr_counter) % len(replicas)]
     spec = loads(run_row["run_spec"])
-    prefix = (
-        spec.get("configuration", {}).get("model", {}) or {}
-    ).get("prefix", "/v1")
+    model_conf = spec.get("configuration", {}).get("model", {}) or {}
+    if model_conf.get("format") == "tgi":
+        return await _tgi_chat_completions(
+            request, payload, host, port, path, model_conf
+        )
+    prefix = model_conf.get("prefix", "/v1")
     return await _forward(
         request, host, port, f"{prefix.strip('/')}/{path.lstrip('/')}"
     )
+
+
+async def _tgi_chat_completions(
+    request: web.Request,
+    payload: dict,
+    host: str,
+    port: int,
+    path: str,
+    model_conf: dict,
+) -> web.StreamResponse:
+    """OpenAI chat/completions adapted onto a TGI replica
+    (proxy/model_tgi.py; parity: reference clients/tgi.py:208)."""
+    from dstack_tpu.proxy import model_tgi
+
+    if path.removeprefix("v1/") != "chat/completions":
+        return web.json_response(
+            {"detail": f"TGI-format models only serve chat/completions, not {path!r}"},
+            status=404,
+        )
+    model_name = model_conf.get("name", "")
+    eos = model_conf.get("eos_token") or model_tgi.DEFAULT_EOS_TOKEN
+    try:
+        tgi_payload = model_tgi.openai_to_tgi(
+            payload, model_conf.get("chat_template"), eos
+        )
+    except model_tgi.TGIAdapterError as e:
+        return web.json_response({"detail": str(e)}, status=e.status)
+    # TGI serves /generate at the root; an explicit non-default prefix is
+    # honored for replicas behind their own sub-path
+    prefix = (model_conf.get("prefix") or "").strip("/")
+    if prefix == "v1":
+        prefix = ""
+    base = f"http://{host}:{port}/" + (f"{prefix}/" if prefix else "")
+    session = _proxy_session(request.app)
+    stream = bool(payload.get("stream"))
+    try:
+        if not stream:
+            async with session.post(
+                f"{base}generate", json=tgi_payload
+            ) as resp:
+                body = await resp.read()
+                if resp.status != 200:
+                    return web.json_response(
+                        {"detail": body.decode(errors="replace")}, status=resp.status
+                    )
+                data = json.loads(body)
+                out = model_tgi.tgi_to_openai(
+                    data, model_name, tgi_payload["parameters"]["stop"]
+                )
+                return web.json_response(out)
+        import time as _time
+        import uuid as _uuid
+
+        completion_id = f"chatcmpl-{_uuid.uuid4().hex}"
+        created = int(_time.time())
+        # connect to the replica BEFORE committing SSE headers: a down
+        # replica must surface as a plain 502, not a corrupted stream
+        resp = await session.post(f"{base}generate_stream", json=tgi_payload)
+        try:
+            if resp.status != 200:
+                err = await resp.read()
+                return web.json_response(
+                    {"detail": err.decode(errors="replace")}, status=resp.status
+                )
+            out_resp = web.StreamResponse(
+                status=200,
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                },
+            )
+            await out_resp.prepare(request)
+            try:
+                async for event in model_tgi.iter_sse_data(resp):
+                    try:
+                        chunk = model_tgi.tgi_chunk_to_openai(
+                            event, model_name, completion_id, created
+                        )
+                    except model_tgi.TGIAdapterError as e:
+                        await out_resp.write(
+                            b"data: "
+                            + json.dumps({"error": str(e)}).encode()
+                            + b"\n\n"
+                        )
+                        break
+                    await out_resp.write(
+                        b"data: " + json.dumps(chunk).encode() + b"\n\n"
+                    )
+            except aiohttp.ClientError as e:
+                # replica died mid-stream: headers are committed, so
+                # report in-band as an SSE error event
+                await out_resp.write(
+                    b"data: " + json.dumps({"error": repr(e)}).encode() + b"\n\n"
+                )
+            await out_resp.write(b"data: [DONE]\n\n")
+            return out_resp
+        finally:
+            resp.release()
+    except aiohttp.ClientError as e:
+        return web.json_response(
+            {"detail": f"error requesting TGI replica: {e!r}"}, status=502
+        )
 
 
 async def model_list_handler(request: web.Request) -> web.Response:

@@ -1,0 +1,235 @@
+"""TGI model-format adapter: unit conversions + an end-to-end run of a
+fake TGI service answering through /proxy/models/.../chat/completions
+(parity target: reference model_proxy/clients/tgi.py:208)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.proxy import model_tgi
+from dstack_tpu.server.app import create_app
+
+
+class TestConversions:
+    def test_render_default_template(self):
+        prompt = model_tgi.render_chat(
+            [
+                {"role": "system", "content": "be terse"},
+                {"role": "user", "content": "hi"},
+            ]
+        )
+        assert "system" in prompt and "be terse" in prompt
+        assert prompt.rstrip().endswith("<|start_header_id|>assistant<|end_header_id|>")
+
+    def test_render_custom_template(self):
+        prompt = model_tgi.render_chat(
+            [{"role": "user", "content": "hi"}],
+            chat_template="{% for m in messages %}[{{ m['role'] }}] {{ m['content'] }}{% endfor %}",
+        )
+        assert prompt == "[user] hi"
+
+    def test_openai_to_tgi_params(self):
+        p = model_tgi.openai_to_tgi(
+            {
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 7,
+                "temperature": 0.5,
+                "top_p": 0.9,
+                "stop": "END",
+                "n": 2,
+            },
+            None,
+            "<eos>",
+        )
+        params = p["parameters"]
+        assert params["max_new_tokens"] == 7
+        assert params["temperature"] == 0.5
+        assert params["top_p"] == 0.9
+        assert params["best_of"] == 2
+        assert params["stop"] == ["END", "<eos>"]
+        assert params["decoder_input_details"] is True
+
+    def test_missing_messages_raises(self):
+        with pytest.raises(model_tgi.TGIAdapterError):
+            model_tgi.openai_to_tgi({}, None, "<eos>")
+
+    def test_tgi_to_openai(self):
+        data = {
+            "generated_text": "hello there<eos>",
+            "details": {
+                "finish_reason": "eos_token",
+                "generated_tokens": 3,
+                "prefill": [{}, {}],
+            },
+        }
+        out = model_tgi.tgi_to_openai(data, "m1", ["<eos>"])
+        assert out["object"] == "chat.completion"
+        assert out["choices"][0]["message"]["content"] == "hello there"
+        assert out["choices"][0]["finish_reason"] == "stop"
+        assert out["usage"] == {
+            "prompt_tokens": 2,
+            "completion_tokens": 3,
+            "total_tokens": 5,
+        }
+
+    def test_chunk_token_and_final(self):
+        tok = model_tgi.tgi_chunk_to_openai(
+            {"token": {"text": "he"}, "details": None}, "m", "id1", 1
+        )
+        assert tok["choices"][0]["delta"]["content"] == "he"
+        assert tok["choices"][0]["finish_reason"] is None
+        fin = model_tgi.tgi_chunk_to_openai(
+            {"token": {"text": ""}, "details": {"finish_reason": "length"}},
+            "m", "id1", 1,
+        )
+        assert fin["choices"][0]["finish_reason"] == "length"
+        assert fin["choices"][0]["delta"] == {}
+
+
+# A fake TGI server runnable as a local-backend service command.
+FAKE_TGI = (
+    "import http.server,json\n"
+    "class H(http.server.BaseHTTPRequestHandler):\n"
+    "    def do_POST(self):\n"
+    "        n = int(self.headers.get('content-length', 0))\n"
+    "        req = json.loads(self.rfile.read(n))\n"
+    "        if self.path.rstrip('/') == '/generate':\n"
+    "            body = json.dumps({'generated_text': 'pong:' + req['inputs'][-4:],\n"
+    "                'details': {'finish_reason': 'eos_token', 'generated_tokens': 2,\n"
+    "                            'prefill': [{}]}}).encode()\n"
+    "            self.send_response(200); self.send_header('content-type','application/json')\n"
+    "            self.end_headers(); self.wfile.write(body)\n"
+    "        elif self.path.rstrip('/') == '/generate_stream':\n"
+    "            self.send_response(200); self.send_header('content-type','text/event-stream')\n"
+    "            self.end_headers()\n"
+    "            for ev in [{'token': {'text': 'po'}, 'details': None},\n"
+    "                       {'token': {'text': 'ng'}, 'details': None},\n"
+    "                       {'token': {'text': ''}, 'details': {'finish_reason': 'eos_token'}}]:\n"
+    "                self.wfile.write(b'data: ' + json.dumps(ev).encode() + b'\\n\\n')\n"
+    "        else:\n"
+    "            self.send_response(404); self.end_headers()\n"
+    "    def log_message(self, *a): pass\n"
+    "http.server.HTTPServer(('127.0.0.1', 18127), H).serve_forever()\n"
+)
+
+import shlex
+
+# shell-safe one-liner: json.dumps produces a valid Python string literal
+# whose \n escapes are decoded by exec() inside python, not by the shell
+_FAKE_TGI_CMD = "python -c " + shlex.quote("exec(" + json.dumps(FAKE_TGI) + ")")
+
+TGI_SERVICE_BODY = {
+    "run_spec": {
+        "run_name": "tgi-svc",
+        "configuration": {
+            "type": "service",
+            "commands": [_FAKE_TGI_CMD],
+            "port": 18127,
+            "model": {
+                "name": "tiny-tgi",
+                "format": "tgi",
+                "eos_token": "<eos>",
+                "chat_template": (
+                    "{% for m in messages %}{{ m['content'] }}{% endfor %}"
+                ),
+            },
+            "auth": False,
+        },
+        "ssh_key_pub": "ssh-ed25519 AAAA t",
+    }
+}
+
+
+def _auth(token):
+    return {"Authorization": f"Bearer {token}"}
+
+
+class TestTGIServiceE2E:
+    async def test_tgi_service_answers_chat_completions(self, tmp_path):
+        from pathlib import Path
+
+        from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
+
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tgi-tok",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/api/project/main/runs/apply",
+                headers=_auth("tgi-tok"),
+                json=TGI_SERVICE_BODY,
+            )
+            assert r.status == 200
+            deadline = asyncio.get_event_loop().time() + 60
+            while asyncio.get_event_loop().time() < deadline:
+                r = await client.post(
+                    "/api/project/main/runs/get",
+                    headers=_auth("tgi-tok"),
+                    json={"run_name": "tgi-svc"},
+                )
+                run = await r.json()
+                if run["status"] == "running":
+                    break
+                assert run["status"] not in ("failed", "terminated"), run
+                await asyncio.sleep(0.5)
+            assert run["status"] == "running"
+            await asyncio.sleep(1.0)
+
+            # model listed
+            r = await client.get("/api/project/main/models" if False else "/proxy/models/main/models")
+            models = await r.json()
+            assert any(m["id"] == "tiny-tgi" for m in models["data"])
+
+            # non-streaming chat completion through the TGI adapter
+            req = {
+                "model": "tiny-tgi",
+                "messages": [{"role": "user", "content": "ping"}],
+                "max_tokens": 8,
+            }
+            out = None
+            for _ in range(60):
+                r = await client.post("/proxy/models/main/chat/completions", json=req)
+                if r.status == 200:
+                    out = await r.json()
+                    break
+                await asyncio.sleep(0.5)
+            assert out is not None, "TGI service never answered"
+            assert out["object"] == "chat.completion"
+            # fake echoes the last 4 chars of the rendered prompt ("ping")
+            assert out["choices"][0]["message"]["content"] == "pong:ping"
+            assert out["choices"][0]["finish_reason"] == "stop"
+            assert out["usage"]["completion_tokens"] == 2
+
+            # streaming
+            r = await client.post(
+                "/proxy/models/main/chat/completions", json={**req, "stream": True}
+            )
+            assert r.status == 200
+            body = await r.read()
+            lines = [
+                json.loads(line[len(b"data: "):])
+                for line in body.split(b"\n\n")
+                if line.startswith(b"data: ") and not line.endswith(b"[DONE]")
+            ]
+            text = "".join(
+                c["choices"][0]["delta"].get("content", "") for c in lines
+            )
+            assert text == "pong"
+            assert lines[-1]["choices"][0]["finish_reason"] == "stop"
+            assert body.rstrip().endswith(b"data: [DONE]")
+
+            # non-chat paths are rejected for TGI models
+            r = await client.post(
+                "/proxy/models/main/completions", json={"model": "tiny-tgi", "prompt": "x"}
+            )
+            assert r.status == 404
+        finally:
+            await client.close()
