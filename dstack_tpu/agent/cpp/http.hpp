@@ -12,6 +12,8 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cerrno>
+#include <cstdint>
 #include <cstring>
 #include <functional>
 #include <map>
@@ -38,6 +40,9 @@ struct Response {
 };
 
 using Handler = std::function<Response(const Request&)>;
+// Raw handlers own the connection (websockets, streaming): they write
+// the full response themselves; the server just closes the fd after.
+using RawHandler = std::function<void(const Request&, int fd)>;
 
 namespace detail {
 
@@ -103,12 +108,217 @@ inline std::string lower(std::string s) {
 
 }  // namespace detail
 
+// ---------------------------------------------------------------------------
+// Server-side WebSocket (RFC 6455) — enough for one-way text streaming
+// (the /logs_ws surface; parity: reference runner/api/server.go:61-68).
+// ---------------------------------------------------------------------------
+namespace ws {
+
+// SHA-1 (RFC 3174) for the handshake accept key. Written against the
+// RFC pseudo-code; input sizes here are tiny (60-byte keys).
+inline void sha1(const unsigned char* data, size_t len, unsigned char out[20]) {
+  uint32_t h[5] = {0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0};
+  uint64_t bitlen = static_cast<uint64_t>(len) * 8;
+  size_t padded = ((len + 8) / 64 + 1) * 64;
+  std::vector<unsigned char> msg(padded, 0);
+  memcpy(msg.data(), data, len);
+  msg[len] = 0x80;
+  for (int i = 0; i < 8; i++)
+    msg[padded - 1 - i] = static_cast<unsigned char>((bitlen >> (8 * i)) & 0xFF);
+  auto rol = [](uint32_t v, int s) { return (v << s) | (v >> (32 - s)); };
+  for (size_t chunk = 0; chunk < padded; chunk += 64) {
+    uint32_t w[80];
+    for (int i = 0; i < 16; i++) {
+      w[i] = (static_cast<uint32_t>(msg[chunk + 4 * i]) << 24) |
+             (static_cast<uint32_t>(msg[chunk + 4 * i + 1]) << 16) |
+             (static_cast<uint32_t>(msg[chunk + 4 * i + 2]) << 8) |
+             static_cast<uint32_t>(msg[chunk + 4 * i + 3]);
+    }
+    for (int i = 16; i < 80; i++)
+      w[i] = rol(w[i - 3] ^ w[i - 8] ^ w[i - 14] ^ w[i - 16], 1);
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4];
+    for (int i = 0; i < 80; i++) {
+      uint32_t f, k;
+      if (i < 20) {
+        f = (b & c) | ((~b) & d);
+        k = 0x5A827999;
+      } else if (i < 40) {
+        f = b ^ c ^ d;
+        k = 0x6ED9EBA1;
+      } else if (i < 60) {
+        f = (b & c) | (b & d) | (c & d);
+        k = 0x8F1BBCDC;
+      } else {
+        f = b ^ c ^ d;
+        k = 0xCA62C1D6;
+      }
+      uint32_t tmp = rol(a, 5) + f + e + k + w[i];
+      e = d;
+      d = c;
+      c = rol(b, 30);
+      b = a;
+      a = tmp;
+    }
+    h[0] += a;
+    h[1] += b;
+    h[2] += c;
+    h[3] += d;
+    h[4] += e;
+  }
+  for (int i = 0; i < 5; i++) {
+    out[4 * i] = static_cast<unsigned char>(h[i] >> 24);
+    out[4 * i + 1] = static_cast<unsigned char>(h[i] >> 16);
+    out[4 * i + 2] = static_cast<unsigned char>(h[i] >> 8);
+    out[4 * i + 3] = static_cast<unsigned char>(h[i]);
+  }
+}
+
+inline std::string b64(const unsigned char* data, size_t len) {
+  static const char* tbl =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  for (size_t i = 0; i < len; i += 3) {
+    unsigned v = static_cast<unsigned>(data[i]) << 16;
+    if (i + 1 < len) v |= static_cast<unsigned>(data[i + 1]) << 8;
+    if (i + 2 < len) v |= data[i + 2];
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += (i + 1 < len) ? tbl[(v >> 6) & 63] : '=';
+    out += (i + 2 < len) ? tbl[v & 63] : '=';
+  }
+  return out;
+}
+
+using detail::write_all;
+
+// Upgrade an accepted HTTP request to a websocket. Returns false (after
+// writing a 400) when the request is not a ws upgrade.
+inline bool handshake(const Request& req, int fd) {
+  auto it = req.headers.find("sec-websocket-key");
+  auto up = req.headers.find("upgrade");
+  if (it == req.headers.end() || up == req.headers.end()) {
+    write_all(fd,
+              "HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\n\r\n");
+    return false;
+  }
+  std::string accept_src = it->second + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11";
+  unsigned char digest[20];
+  sha1(reinterpret_cast<const unsigned char*>(accept_src.data()),
+       accept_src.size(), digest);
+  std::string resp =
+      "HTTP/1.1 101 Switching Protocols\r\n"
+      "Upgrade: websocket\r\n"
+      "Connection: Upgrade\r\n"
+      "Sec-WebSocket-Accept: " + b64(digest, 20) + "\r\n\r\n";
+  return write_all(fd, resp);
+}
+
+// One unmasked server→client text frame.
+inline bool send_text(int fd, const std::string& payload) {
+  std::string frame;
+  frame += static_cast<char>(0x81);  // FIN + text opcode
+  size_t n = payload.size();
+  if (n < 126) {
+    frame += static_cast<char>(n);
+  } else if (n < 65536) {
+    frame += static_cast<char>(126);
+    frame += static_cast<char>((n >> 8) & 0xFF);
+    frame += static_cast<char>(n & 0xFF);
+  } else {
+    frame += static_cast<char>(127);
+    for (int i = 7; i >= 0; i--)
+      frame += static_cast<char>((static_cast<uint64_t>(n) >> (8 * i)) & 0xFF);
+  }
+  frame += payload;
+  return write_all(fd, frame);
+}
+
+// Drain client frames without blocking: answer pings with pongs (the
+// server relay connects with heartbeat=30 and kills unanswered
+// streams), detect close/EOF. Returns false when the peer is gone.
+// Client control frames are tiny (<126 bytes) and arrive whole; a
+// frame split across reads is simply re-read next poll.
+inline bool poll_client(int fd) {
+  unsigned char buf[512];
+  while (true) {
+    ssize_t r = ::recv(fd, buf, sizeof buf, MSG_DONTWAIT);
+    if (r == 0) return false;  // EOF: peer disconnected
+    if (r < 0) return errno == EAGAIN || errno == EWOULDBLOCK;
+    size_t i = 0;
+    auto n = static_cast<size_t>(r);
+    while (i + 2 <= n) {
+      uint8_t opcode = buf[i] & 0x0F;
+      bool masked = (buf[i + 1] & 0x80) != 0;
+      uint64_t len = buf[i + 1] & 0x7F;
+      size_t pos = i + 2;
+      if (len == 126) {
+        if (pos + 2 > n) break;
+        len = (static_cast<uint64_t>(buf[pos]) << 8) | buf[pos + 1];
+        pos += 2;
+      } else if (len == 127) {
+        if (pos + 8 > n) break;
+        len = 0;
+        for (int k = 0; k < 8; k++) len = (len << 8) | buf[pos + k];
+        pos += 8;
+      }
+      unsigned char mask[4] = {0, 0, 0, 0};
+      if (masked) {
+        if (pos + 4 > n) break;
+        memcpy(mask, buf + pos, 4);
+        pos += 4;
+      }
+      if (pos + len > n) break;
+      if (opcode == 0x8) return false;  // close
+      if (opcode == 0x9) {              // ping → pong (unmasked echo)
+        std::string payload;
+        for (uint64_t k = 0; k < len; k++)
+          payload += static_cast<char>(buf[pos + k] ^ mask[k % 4]);
+        std::string frame;
+        frame += static_cast<char>(0x8A);
+        frame += static_cast<char>(payload.size());
+        frame += payload;
+        if (!write_all(fd, frame)) return false;
+      }
+      i = pos + static_cast<size_t>(len);
+    }
+  }
+}
+
+inline void send_close(int fd) {
+  std::string frame;
+  frame += static_cast<char>(0x88);  // FIN + close opcode
+  frame += static_cast<char>(0x02);
+  frame += static_cast<char>(0x03);  // 1000 normal closure
+  frame += static_cast<char>(0xE8);
+  write_all(fd, frame);
+}
+
+}  // namespace ws
+
 // Route pattern: literal segments or "*" captures, e.g.
 // "/api/tasks/*/terminate" -> path_params = [task_id].
 class Router {
  public:
   void add(const std::string& method, const std::string& pattern, Handler h) {
     routes_.push_back({method, split(pattern), std::move(h)});
+  }
+
+  void add_raw(const std::string& method, const std::string& pattern, RawHandler h) {
+    raw_routes_.push_back({method, split(pattern), std::move(h)});
+  }
+
+  // Returns the raw handler owning this request's connection, if any.
+  const RawHandler* dispatch_raw(Request& req) const {
+    auto segs = split(req.path);
+    for (const auto& r : raw_routes_) {
+      if (r.method != req.method) continue;
+      std::vector<std::string> params;
+      if (match(r.pattern, segs, params)) {
+        req.path_params = std::move(params);
+        return &r.handler;
+      }
+    }
+    return nullptr;
   }
 
   Response dispatch(Request& req) const {
@@ -130,7 +340,13 @@ class Router {
     std::vector<std::string> pattern;
     Handler handler;
   };
+  struct RawRoute {
+    std::string method;
+    std::vector<std::string> pattern;
+    RawHandler handler;
+  };
   std::vector<Route> routes_;
+  std::vector<RawRoute> raw_routes_;
 
   static std::vector<std::string> split(const std::string& p) {
     std::vector<std::string> out;
@@ -256,6 +472,13 @@ class Server {
     if (req.body.size() < content_length) {
       if (!detail::read_exact(client, req.body, content_length - req.body.size()))
         return;
+    }
+    if (const RawHandler* raw = router_.dispatch_raw(req)) {
+      try {
+        (*raw)(req, client);
+      } catch (const std::exception&) {
+      }
+      return;
     }
     Response resp;
     try {

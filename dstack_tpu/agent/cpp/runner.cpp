@@ -193,6 +193,22 @@ class Executor {
     return resp;
   }
 
+  // Thread-safe views for the /logs_ws streaming loop.
+  std::vector<LogEvent> logs_snapshot(size_t from) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (from >= logs_.size()) return {};
+    return {logs_.begin() + static_cast<long>(from), logs_.end()};
+  }
+
+  bool finished() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& e : states_) {
+      if (e.state == "done" || e.state == "failed" || e.state == "terminated")
+        return true;
+    }
+    return false;
+  }
+
   // Seconds since the last ESTABLISHED TCP connection on the SSH port,
   // read from /proc/net/tcp{,6} (parity: reference connections.go:130) —
   // drives dev-env inactivity_duration termination.
@@ -640,6 +656,33 @@ int main(int argc, char** argv) {
   router.add("GET", "/api/metrics", [executor](const dtpu::http::Request&) {
     return dtpu::http::Response{200, "application/json",
                                 executor->metrics().dump()};
+  });
+  // Live log stream (parity: reference runner/api/server.go:61-68 and
+  // the Python runner's /logs_ws): replay buffered events, follow until
+  // the job finishes and the tail is drained, then close.
+  router.add_raw("GET", "/logs_ws",
+                 [executor](const dtpu::http::Request& req, int fd) {
+    namespace ws = dtpu::http::ws;
+    if (!ws::handshake(req, fd)) return;
+    double since = 0;
+    auto sq = req.query.find("since");
+    if (sq != req.query.end()) since = atof(sq->second.c_str());
+    size_t sent = 0;
+    while (true) {
+      auto batch = executor->logs_snapshot(sent);
+      for (const auto& e : batch) {
+        if (e.timestamp > since &&
+            !ws::send_text(fd, e.to_json().dump())) {
+          return;  // peer gone
+        }
+      }
+      sent += batch.size();
+      if (executor->finished() && executor->logs_snapshot(sent).empty()) break;
+      // answer pings / notice disconnects even while the job is quiet
+      if (!ws::poll_client(fd)) return;
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+    }
+    ws::send_close(fd);
   });
 
   signal(SIGPIPE, SIG_IGN);

@@ -395,6 +395,13 @@ class Executor:
                 except ProcessLookupError:
                     pass
 
+    @property
+    def finished(self) -> bool:
+        return any(
+            e.state in ("done", "failed", "terminated", "aborted")
+            for e in self.state_events
+        )
+
     def pull(self, since: float) -> schemas.PullResponse:
         states = [e for e in self.state_events if e.timestamp > since]
         logs = [
@@ -403,10 +410,7 @@ class Executor:
         rlogs = [
             e for e in self.runner_logs if e.timestamp.timestamp() > since
         ]
-        finished = any(
-            e.state in ("done", "failed", "terminated", "aborted")
-            for e in self.state_events
-        )
+        finished = self.finished
         ts_candidates = (
             [e.timestamp for e in states]
             + [e.timestamp.timestamp() for e in logs]
@@ -536,7 +540,33 @@ def build_app(home_dir: Path) -> web.Application:
             text=ex.metrics().model_dump_json(), content_type="application/json"
         )
 
+    async def logs_ws(request):
+        """Live log stream (reference runner/api/server.go:61-68
+        ``/logs_ws``): replays buffered job logs (from ``?since=<unix
+        ts>`` — the client's resume cursor after a dropped stream), then
+        follows until the job finishes and the tail is drained. One JSON
+        LogEvent per message."""
+        since = float(request.query.get("since", 0))
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        sent = 0
+        try:
+            while not ws.closed:
+                logs = ex.job_logs
+                while sent < len(logs):
+                    ev = logs[sent]
+                    if ev.timestamp.timestamp() > since:
+                        await ws.send_str(ev.model_dump_json())
+                    sent += 1
+                if ex.finished and sent >= len(ex.job_logs):
+                    break
+                await asyncio.sleep(0.2)
+        finally:
+            await ws.close()
+        return ws
+
     app.router.add_get("/api/healthcheck", healthcheck)
+    app.router.add_get("/logs_ws", logs_ws)
     app.router.add_post("/api/submit", submit)
     app.router.add_post("/api/upload_code", upload_code)
     app.router.add_post("/api/run", run)

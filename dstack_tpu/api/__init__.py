@@ -19,7 +19,7 @@ from typing import Iterator, Optional, Union
 import yaml
 
 from dstack_tpu.api.http_client import APIClient
-from dstack_tpu.core.errors import ConfigurationError
+from dstack_tpu.core.errors import ClientError, ConfigurationError
 from dstack_tpu.core.models.configurations import (
     AnyRunConfiguration,
     parse_run_configuration,
@@ -153,10 +153,17 @@ class RunCollection:
         on_status=None,
         poll_interval: float = 2.0,
     ) -> Iterator[str]:
-        """Yield decoded log text; with ``follow`` keeps polling until
-        the run finishes (and fully drains the tail). ``on_status`` is an
-        optional callback invoked with the Run on every status poll —
-        used by the CLI to interleave status lines."""
+        """Yield decoded log text; with ``follow`` streams live over the
+        server's ``/logs_ws`` websocket when a job is running (reference
+        Run.attach ws streaming) — reconnecting with a timestamp cursor
+        after drops — and falls back to REST polling (which also drains
+        the tail after the run finishes). ``on_status`` is an optional
+        callback invoked with the Run on status transitions — used by
+        the CLI to interleave status lines."""
+        if follow and not diagnose:
+            streamed = yield from self._ws_logs(run_name, on_status)
+            if streamed:
+                return
         token: Optional[str] = None
         finished_seen = False
         while True:
@@ -179,6 +186,39 @@ class RunCollection:
                 finished_seen = True  # one more drain pass, then exit
                 continue
             time.sleep(poll_interval)
+
+    def _ws_logs(self, run_name: str, on_status) -> Iterator[str]:
+        """Websocket leg of :meth:`logs`. Returns True when the stream
+        completed (caller is done), False to fall back to polling."""
+        from dstack_tpu.core.errors import LogStreamDropped
+
+        last_ts = 0.0
+        drops = 0
+        while True:
+            try:
+                for ev in self._c.api.stream_logs_ws(
+                    self._c.project, run_name, since=last_ts
+                ):
+                    last_ts = ev.timestamp.timestamp()
+                    yield ev.text()
+            except ClientError:
+                return False  # no live job / no ws on server: poll
+            except LogStreamDropped:
+                drops += 1
+                if drops > 5:
+                    return False  # persistent trouble: poll the rest
+                time.sleep(1.0)
+                continue  # resume from the cursor, no duplicates
+            # clean close: the runner drained its tail. Surface the final
+            # run state (the reconciler may lag the runner by a cycle).
+            if on_status is not None:
+                for _ in range(15):
+                    run = self.get(run_name)
+                    on_status(run)
+                    if run.status.is_finished():
+                        break
+                    time.sleep(1.0)
+            return True
 
 
 class Client:

@@ -11,6 +11,7 @@ import requests
 from dstack_tpu.core.errors import (
     ClientError,
     ForbiddenError,
+    LogStreamDropped,
     ResourceExistsError,
     ResourceNotExistsError,
     UnauthorizedError,
@@ -150,6 +151,109 @@ class APIClient:
                 },
             )
         )
+
+    def stream_logs_ws(self, project: str, run_name: str, since: float = 0.0):
+        """Yield live ``LogEvent``s over the server's ``/logs_ws``
+        websocket (reference Run.attach ws streaming,
+        api/_public/runs.py:244-365). ``since`` is a unix-timestamp
+        resume cursor: only later events are streamed, so callers
+        reconnect after a drop without duplicates.
+
+        Raises ClientError if the server rejects the stream (no live
+        job, no access, older server) — callers fall back to
+        :meth:`poll_logs` — and :class:`LogStreamDropped` when an
+        established stream dies mid-flight (callers reconnect with the
+        cursor).
+
+        Sync facade over aiohttp: the ws pump runs on a daemon thread,
+        frames arrive through a bounded queue; abandoning the generator
+        cancels the pump (no leaked thread or server connection).
+        """
+        import asyncio
+        import queue as _queue
+        import threading
+
+        import aiohttp
+
+        from dstack_tpu.core.models.logs import LogEvent
+
+        qs = f"?since={since}" if since else ""
+        url = (
+            self.base_url.replace("http", "ws", 1)
+            + f"/api/project/{project}/runs/{run_name}/logs_ws{qs}"
+        )
+        headers = {"Authorization": self._session.headers["Authorization"]}
+        q: _queue.Queue = _queue.Queue(maxsize=1000)
+        stop = threading.Event()
+
+        def put(item) -> None:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.25)
+                    return
+                except _queue.Full:
+                    continue
+
+        async def pump():
+            clean = False
+            try:
+                async with aiohttp.ClientSession() as session:
+                    async with session.ws_connect(url, headers=headers) as ws:
+                        async for msg in ws:
+                            if msg.type == aiohttp.WSMsgType.TEXT:
+                                put(("data", msg.data))
+                            elif msg.type == aiohttp.WSMsgType.CLOSE:
+                                clean = True
+                                break
+                            else:
+                                break
+                        else:
+                            clean = True  # server closed after draining
+            except aiohttp.WSServerHandshakeError as e:
+                put(("reject", e.status))
+                return
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001 - surfaced to caller
+                put(("drop", repr(e)))
+                return
+            finally:
+                put(("done", clean))
+
+        def run_pump():
+            try:
+                asyncio.run(pump())
+            except Exception:
+                pass
+
+        thread = threading.Thread(target=run_pump, daemon=True)
+        thread.start()
+        yielded = False
+        try:
+            while True:
+                kind, val = q.get()
+                if kind == "data":
+                    yielded = True
+                    yield LogEvent.model_validate_json(val)
+                elif kind == "done":
+                    if val:
+                        return
+                    raise LogStreamDropped("stream closed before run finished")
+                elif kind == "reject":
+                    raise _ERRORS.get(val, ClientError)(f"logs_ws rejected ({val})")
+                elif kind == "drop":
+                    if yielded:
+                        raise LogStreamDropped(str(val))
+                    raise ClientError(f"logs_ws failed: {val}")
+        finally:
+            stop.set()
+            # unblock the pump (it may be parked on a full queue) and
+            # let the daemon thread tear its loop down
+            try:
+                while True:
+                    q.get_nowait()
+            except _queue.Empty:
+                pass
 
     # metrics
     def get_job_metrics(self, project: str, run_name: str, limit: int = 100) -> JobMetrics:

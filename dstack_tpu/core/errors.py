@@ -27,6 +27,11 @@ class ConfigurationError(ClientError):
     code = "configuration_error"
 
 
+class LogStreamDropped(DstackTPUError):
+    """An established /logs_ws stream died mid-flight; reconnect with the
+    timestamp cursor (not a ClientError: rejection ≠ interruption)."""
+
+
 class ResourceNotExistsError(ClientError):
     code = "resource_not_exists"
     http_status = 404

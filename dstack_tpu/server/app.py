@@ -70,6 +70,9 @@ async def create_app(
     app = build_app(ALL_ROUTERS, state, auth_dependency=auth_dependency)
     register_proxy_routes(app)
     register_ui_routes(app)
+    from dstack_tpu.server.routers.logs_ws import register_ws_routes
+
+    register_ws_routes(app)
 
     scheduler = create_scheduler(db)
     state["scheduler"] = scheduler

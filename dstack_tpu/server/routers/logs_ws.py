@@ -1,0 +1,118 @@
+"""Websocket log streaming: ``/api/project/{p}/runs/{run}/logs_ws``.
+
+Parity: reference ``/logs_ws`` on the Go runner
+(runner/internal/runner/api/server.go:61-68) consumed by ``Run.attach``
+(api/_public/runs.py:244-365). Here the server relays the runner's
+websocket to the caller (the runner is reachable only via SSH tunnels
+from the server, so clients cannot dial it directly), falling back is
+the client's job (REST ``/logs/poll``).
+
+Auth: bearer header or ``?token=`` (browser WebSocket cannot set
+headers).
+"""
+
+import aiohttp
+from aiohttp import web
+
+from dstack_tpu.core.models.runs import JobProvisioningData, JobStatus
+from dstack_tpu.server.db import Database, loads
+from dstack_tpu.server.services.agent_client import runner_address_for
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.logs_ws")
+
+
+async def _authorized_user(request: web.Request, db: Database):
+    from dstack_tpu.server.services.users import get_user_by_token
+
+    auth = request.headers.get("Authorization", "")
+    token = auth.removeprefix("Bearer ").strip() if auth.startswith("Bearer ") else ""
+    token = token or request.query.get("token", "")
+    if not token:
+        return None
+    return await get_user_by_token(db, token)
+
+
+async def logs_ws_handler(request: web.Request) -> web.StreamResponse:
+    from dstack_tpu.core.errors import ForbiddenError
+    from dstack_tpu.server.services.projects import check_project_access
+
+    db: Database = request.app["state"]["db"]
+    user_row = await _authorized_user(request, db)
+    if user_row is None:
+        return web.json_response({"detail": "unauthorized"}, status=401)
+    project_name = request.match_info["project_name"]
+    run_name = request.match_info["run_name"]
+    project = await db.fetchone(
+        "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
+    )
+    if project is None:
+        return web.json_response({"detail": "project not found"}, status=404)
+    try:
+        # same project-membership gate as every /api/project route
+        await check_project_access(db, project, user_row)
+    except ForbiddenError:
+        return web.json_response({"detail": "no access to project"}, status=403)
+    run_row = await db.fetchone(
+        "SELECT * FROM runs WHERE project_id = ? AND run_name = ? AND deleted = 0",
+        (project["id"], run_name),
+    )
+    if run_row is None:
+        return web.json_response({"detail": "run not found"}, status=404)
+    job_row = await db.fetchone(
+        "SELECT * FROM jobs WHERE run_id = ? AND replica_num = 0 AND job_num = 0 "
+        "ORDER BY submission_num DESC LIMIT 1",
+        (run_row["id"],),
+    )
+    if job_row is None or job_row["status"] != JobStatus.RUNNING.value:
+        # nothing live to attach to — client falls back to /logs/poll
+        return web.json_response({"detail": "no live job to stream"}, status=409)
+    jpd_raw = loads(job_row.get("job_provisioning_data"))
+    if jpd_raw is None:
+        return web.json_response({"detail": "job not provisioned"}, status=409)
+    jpd = JobProvisioningData.model_validate(jpd_raw)
+    from dstack_tpu.server.background.tasks.process_running_jobs import _runner_port
+
+    port = _runner_port(job_row, jpd)
+    try:
+        async with runner_address_for(
+            jpd, port, db=db, project_id=job_row["project_id"]
+        ) as (host, rport):
+            async with aiohttp.ClientSession() as session:
+                # dial the runner BEFORE upgrading the caller: a dead or
+                # not-yet-listening runner surfaces as an HTTP error the
+                # client can retry/fall back on, not an empty stream
+                since = request.query.get("since", "")
+                qs = f"?since={since}" if since else ""
+                try:
+                    ws_client = await session.ws_connect(
+                        f"http://{host}:{rport}/logs_ws{qs}", heartbeat=30
+                    )
+                except (aiohttp.ClientError, OSError) as e:
+                    return web.json_response(
+                        {"detail": f"runner unreachable: {e!r}"}, status=502
+                    )
+                ws_server = web.WebSocketResponse(heartbeat=30)
+                await ws_server.prepare(request)
+                try:
+                    async for msg in ws_client:
+                        if msg.type == aiohttp.WSMsgType.TEXT:
+                            await ws_server.send_str(msg.data)
+                        elif msg.type in (
+                            aiohttp.WSMsgType.CLOSED,
+                            aiohttp.WSMsgType.ERROR,
+                        ):
+                            break
+                finally:
+                    await ws_client.close()
+                    await ws_server.close()
+                return ws_server
+    except (aiohttp.ClientError, OSError) as e:
+        logger.info("logs_ws relay for %s/%s failed: %s", project_name, run_name, e)
+        return web.json_response({"detail": f"relay failed: {e!r}"}, status=502)
+
+
+def register_ws_routes(app: web.Application) -> None:
+    app.router.add_get(
+        "/api/project/{project_name}/runs/{run_name}/logs_ws", logs_ws_handler
+    )

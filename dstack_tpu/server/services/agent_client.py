@@ -188,14 +188,16 @@ async def shim_client_for(
 
 
 @asynccontextmanager
-async def runner_client_for(
+async def runner_address_for(
     jpd: JobProvisioningData,
     runner_port: int,
     db=None,
     project_id: Optional[str] = None,
 ):
+    """Yield a reachable (host, port) for the job's runner, tunneling if
+    needed (used by RunnerClient calls and the /logs_ws relay)."""
     if _direct(jpd):
-        yield RunnerClient(jpd.hostname or "127.0.0.1", runner_port)
+        yield (jpd.hostname or "127.0.0.1", runner_port)
         return
     from dstack_tpu.core.services.ssh.tunnel import open_tunnel_to_params
     from dstack_tpu.core.models.instances import SSHConnectionParams
@@ -209,6 +211,17 @@ async def runner_client_for(
         identity_file=await _tunnel_identity(db, project_id),
     )
     try:
-        yield RunnerClient("127.0.0.1", ports[runner_port])
+        yield ("127.0.0.1", ports[runner_port])
     finally:
         tunnel.close()
+
+
+@asynccontextmanager
+async def runner_client_for(
+    jpd: JobProvisioningData,
+    runner_port: int,
+    db=None,
+    project_id: Optional[str] = None,
+):
+    async with runner_address_for(jpd, runner_port, db, project_id) as (host, port):
+        yield RunnerClient(host, port)

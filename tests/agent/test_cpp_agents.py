@@ -114,6 +114,50 @@ class TestCppRunner:
             proc.terminate()
             proc.wait(timeout=5)
 
+    async def test_logs_ws_stream(self, agent_binaries, tmp_path):
+        """The native runner's RFC6455 /logs_ws must interoperate with a
+        real websocket client (parity: python runner + reference
+        runner/api/server.go:61-68)."""
+        from dstack_tpu.core.models.logs import LogEvent
+
+        runner_bin, _ = agent_binaries
+        port = _free_port()
+        proc = subprocess.Popen(
+            [str(runner_bin), "--port", str(port), "--home", str(tmp_path)],
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            await _wait_port(port)
+            submit = schemas.SubmitBody(
+                run_name="cpp-ws",
+                job_name="cpp-ws-0-0",
+                job_spec={
+                    "commands": ["echo ws-a", "sleep 0.5", "echo ws-b"],
+                    "env": {},
+                    "job_num": 0,
+                },
+                cluster_info=ClusterInfo(
+                    master_node_ip="127.0.0.1", nodes_ips=["127.0.0.1"]
+                ),
+            )
+            await _request(port, "POST", "/api/submit", json_body=submit.model_dump())
+            await _request(port, "POST", "/api/run")
+            texts = []
+            async with aiohttp.ClientSession() as session:
+                async with session.ws_connect(
+                    f"http://127.0.0.1:{port}/logs_ws"
+                ) as ws:
+                    async for msg in ws:
+                        if msg.type == aiohttp.WSMsgType.TEXT:
+                            texts.append(LogEvent.model_validate_json(msg.data).text())
+                        else:
+                            break
+            joined = "".join(texts)
+            assert "ws-a" in joined and "ws-b" in joined
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
     async def test_code_archive_and_internode_ssh(self, agent_binaries, tmp_path):
         """NATIVE runner: uploaded archive materializes in the workdir;
         the per-replica ssh key + config are installed (parity with the

@@ -1,0 +1,82 @@
+"""Runner ``/logs_ws`` websocket: replay + live follow + close on finish
+(parity: reference runner/internal/runner/api/server.go:61-68)."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import aiohttp
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.agent import schemas
+from dstack_tpu.agent.python.runner import build_app
+from dstack_tpu.core.models.logs import LogEvent
+from dstack_tpu.core.models.runs import ClusterInfo
+
+
+class TestRunnerLogsWS:
+    async def test_streams_and_closes(self, tmp_path):
+        app = build_app(Path(tmp_path))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            body = schemas.SubmitBody(
+                run_name="ws1",
+                job_name="ws1-0-0",
+                job_spec={
+                    "commands": [
+                        "echo first", "sleep 0.5", "echo second", "echo third",
+                    ],
+                    "env": {},
+                    "job_num": 0,
+                },
+                cluster_info=ClusterInfo(
+                    master_node_ip="127.0.0.1", nodes_ips=["127.0.0.1"]
+                ),
+            )
+            await client.post("/api/submit", json=body.model_dump())
+            await client.post("/api/run")
+            # connect mid-run: buffered lines replay, the rest follow live
+            ws = await client.ws_connect("/logs_ws")
+            texts = []
+            async for msg in ws:
+                if msg.type == aiohttp.WSMsgType.TEXT:
+                    texts.append(LogEvent.model_validate_json(msg.data).text())
+                else:
+                    break
+            joined = "".join(texts)
+            assert "first" in joined and "second" in joined and "third" in joined
+            assert ws.closed  # server closed after job finished + drained
+        finally:
+            await client.close()
+
+    async def test_connect_after_finish_replays_all(self, tmp_path):
+        app = build_app(Path(tmp_path))
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            body = schemas.SubmitBody(
+                run_name="ws2",
+                job_name="ws2-0-0",
+                job_spec={"commands": ["echo done-line"], "env": {}, "job_num": 0},
+                cluster_info=ClusterInfo(
+                    master_node_ip="127.0.0.1", nodes_ips=["127.0.0.1"]
+                ),
+            )
+            await client.post("/api/submit", json=body.model_dump())
+            await client.post("/api/run")
+            ex = app["executor"]
+            for _ in range(100):
+                if ex.finished:
+                    break
+                await asyncio.sleep(0.1)
+            ws = await client.ws_connect("/logs_ws")
+            texts = []
+            async for msg in ws:
+                if msg.type == aiohttp.WSMsgType.TEXT:
+                    texts.append(LogEvent.model_validate_json(msg.data).text())
+                else:
+                    break
+            assert "done-line" in "".join(texts)
+        finally:
+            await client.close()
