@@ -147,6 +147,7 @@ class TPUNodesAPI:
         network: str = "default",
         labels: Optional[dict[str, str]] = None,
         reservation: Optional[str] = None,
+        data_disks: Optional[list[dict]] = None,
     ) -> dict:
         """QueuedResources: the all-workers-or-nothing path for big pod
         slices (v5p/v6e) — parity gap the reference punts on."""
@@ -165,6 +166,7 @@ class TPUNodesAPI:
                                 "enableExternalIps": True,
                             },
                             "labels": labels or {},
+                            "dataDisks": data_disks or [],
                         },
                     }
                 ]
@@ -272,6 +274,30 @@ class GCEInstancesAPI:
     async def get_instance(self, zone: str, name: str) -> dict:
         return await self.transport.request(
             "GET", f"{self._zone_url(zone)}/instances/{name}"
+        )
+
+    # ---- persistent disks (TPU data disks ride these) ----
+
+    async def create_disk(
+        self, zone: str, name: str, size_gb: int, disk_type: str = "pd-balanced"
+    ) -> dict:
+        body = {
+            "name": name,
+            "sizeGb": str(size_gb),
+            "type": f"zones/{zone}/diskTypes/{disk_type}",
+        }
+        return await self.transport.request(
+            "POST", f"{self._zone_url(zone)}/disks", json_body=body
+        )
+
+    async def get_disk(self, zone: str, name: str) -> dict:
+        return await self.transport.request(
+            "GET", f"{self._zone_url(zone)}/disks/{name}"
+        )
+
+    async def delete_disk(self, zone: str, name: str) -> dict:
+        return await self.transport.request(
+            "DELETE", f"{self._zone_url(zone)}/disks/{name}"
         )
 
     async def delete_instance(self, zone: str, name: str) -> dict:

@@ -8,6 +8,7 @@ worker host runs a shim (installed by the startup script), and
 workers have IPs (all-or-nothing).
 """
 
+import asyncio
 import json
 import shlex
 from typing import Optional
@@ -173,6 +174,15 @@ class GCPTPUCompute(
             instance_config.ssh_public_keys, tpu.version
         )
         spot = instance_offer.instance.resources.spot
+        # volumes attach as TPU data disks at node creation (the
+        # UpdateNode path in attach_volume covers reused instances)
+        data_disks = [
+            {
+                "sourceDisk": f"projects/{self.project_id}/zones/{zone}/disks/{vid}",
+                "mode": "READ_WRITE",
+            }
+            for vid in instance_config.volume_ids
+        ]
         used_qr = False
         try:
             if tpu.hosts > 4 or instance_config.reservation:
@@ -190,6 +200,7 @@ class GCPTPUCompute(
                     network=self.config.get("network", "default"),
                     labels={"dtpu-project": instance_config.project_name},
                     reservation=instance_config.reservation,
+                    data_disks=data_disks,
                 )
             else:
                 await self.api.create_node(
@@ -202,6 +213,7 @@ class GCPTPUCompute(
                     network=self.config.get("network", "default"),
                     labels={"dtpu-project": instance_config.project_name},
                     reservation=instance_config.reservation,
+                    data_disks=data_disks,
                 )
         except BackendError as e:
             raise ComputeError(str(e)) from e
@@ -346,9 +358,41 @@ class GCPTPUCompute(
     # ---- volumes: persistent disks attached to TPU nodes ----
 
     async def create_volume(self, volume: Volume) -> VolumeProvisioningData:
-        # Persistent-disk creation rides the compute API; kept out of
-        # round 1 (disk attach to existing disks works via register).
-        raise NotImplementedError("GCP disk creation: use an existing disk id")
+        """Create a persistent disk (reference gcp/compute.py:561-676
+        creates disks via the google-cloud SDK; here the REST API) and
+        poll it to READY. TPU nodes attach it as a dataDisk — at node
+        creation for fresh slices, via UpdateNode for reused ones."""
+        conf = volume.configuration
+        zone = conf.availability_zone or TPU_ZONES.get(conf.region or "", "")
+        if not zone:
+            raise ComputeError(
+                "volume needs availability_zone or a known region"
+            )
+        size_gb = int(conf.size or 100)
+        # project-scoped name: same-named volumes in different dstack
+        # projects must not collide inside one GCP project
+        disk_name = f"dtpu-{volume.project_name}-{volume.name}"[:60].rstrip("-")
+        await self.gce.create_disk(zone, disk_name, size_gb)
+        status = ""
+        for _ in range(30):
+            disk = await self.gce.get_disk(zone, disk_name)
+            status = disk.get("status", "")
+            if status == "READY":
+                break
+            if status == "FAILED":
+                raise ComputeError(f"disk {disk_name} entered FAILED state")
+            await asyncio.sleep(2)
+        if status != "READY":
+            raise ComputeError(
+                f"disk {disk_name} not READY after 60s (status {status!r})"
+            )
+        return VolumeProvisioningData(
+            backend=BackendType.GCP,
+            volume_id=disk_name,
+            size_gb=size_gb,
+            availability_zone=zone,
+            backend_data=json.dumps({"created": True}),
+        )
 
     async def register_volume(self, volume: Volume) -> VolumeProvisioningData:
         return VolumeProvisioningData(
@@ -359,7 +403,19 @@ class GCPTPUCompute(
         )
 
     async def delete_volume(self, volume: Volume) -> None:
-        pass  # registered external disks are not deleted by the framework
+        """Delete disks the framework created; registered external disks
+        are left alone."""
+        pd = volume.provisioning_data
+        if pd is None or volume.external:
+            return
+        created = bool(json.loads(pd.backend_data or "{}").get("created"))
+        if not created or not pd.availability_zone:
+            return
+        try:
+            await self.gce.delete_disk(pd.availability_zone, pd.volume_id)
+        except Exception as e:
+            if "404" not in str(e):
+                raise
 
     async def attach_volume(self, volume: Volume, instance_id: str) -> VolumeAttachmentData:
         pd = volume.provisioning_data

@@ -71,6 +71,39 @@ async def _process_job(db: Database, job_id: str) -> None:
     requirements = job_spec.requirements
     multinode = job_spec.jobs_per_replica > 1 or requirements.resources.tpu is not None
 
+    # Resolve the run's named volumes up front: both the reuse and the
+    # provision path must co-locate with the disks' zone (reference
+    # offers volume co-location filter).
+    from dstack_tpu.server.services import volumes as volumes_service
+
+    try:
+        volume_rows = await volumes_service.resolve_run_volumes(
+            db, project_row, run_spec
+        )
+    except volumes_service.VolumesNotReady:
+        await db.update_by_id(
+            "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+        )
+        return
+    except Exception as e:
+        await _fail(
+            db, job_row, JobTerminationReason.TERMINATED_BY_SERVER, str(e)[:300]
+        )
+        return
+    volume_zones = [
+        z for z in (volumes_service.volume_zone(r) for r in volume_rows) if z
+    ]
+    if len(set(volume_zones)) > 1:
+        # every sourceDisk path is rendered with the instance's zone, so
+        # cross-zone volume sets cannot attach to one slice
+        await _fail(
+            db, job_row, JobTerminationReason.TERMINATED_BY_SERVER,
+            f"volumes span zones {sorted(set(volume_zones))}; "
+            "all volumes of a run must share one zone",
+        )
+        return
+    volume_regions = {z.rsplit("-", 1)[0] for z in volume_zones}
+
     # Phase 1: idle pool instance
     pool = await instances_service.get_pool_instances(db, project_row)
     candidates = instances_service.filter_pool_instances(
@@ -79,6 +112,10 @@ async def _process_job(db: Database, job_id: str) -> None:
     for row in candidates:
         jpd = loads(row.get("job_provisioning_data"))
         if jpd is None:
+            continue
+        if volume_rows and not await _attach_volumes_to_reused(
+            db, project_row, volume_rows, volume_regions, row, jpd
+        ):
             continue
         await _assign(db, job_row, row["id"], jpd, worker_id=0)
         await instances_service.mark_instance(db, row["id"], InstanceStatus.BUSY)
@@ -98,6 +135,7 @@ async def _process_job(db: Database, job_id: str) -> None:
         (b, o)
         for b, o in offers
         if o.availability.is_available
+        and (not volume_regions or o.region in volume_regions)
     ][: settings.MAX_OFFERS_TRIED]
     if not offers:
         await _fail_no_capacity(db, job_row, "no matching offers")
@@ -128,6 +166,11 @@ async def _process_job(db: Database, job_id: str) -> None:
             instance_name=instance_name,
             user=run_row["user_id"],
             ssh_public_keys=await _instance_ssh_keys(db, project_row, run_spec),
+            volume_ids=[
+                (loads(r.get("provisioning_data")) or {}).get("volume_id", "")
+                for r in volume_rows
+            ],
+            availability_zone=volume_zones[0] if volume_zones else None,
         )
         try:
             jpd = await compute.create_instance(offer, config)
@@ -150,6 +193,14 @@ async def _process_job(db: Database, job_id: str) -> None:
                 else 300
             ),
         )
+        for vrow in volume_rows:
+            # ON CONFLICT DO NOTHING is shared sqlite/postgres dialect
+            await db.execute(
+                "INSERT INTO volume_attachments (id, volume_id, instance_id) "
+                "VALUES (?, ?, ?) "
+                "ON CONFLICT (volume_id, instance_id) DO NOTHING",
+                (new_uuid(), vrow["id"], inst_row["id"]),
+            )
         await _assign(db, job_row, inst_row["id"], jpd.model_dump(), worker_id=0)
         logger.info(
             "job %s provisioning on %s (%s, $%.2f/h)",
@@ -160,6 +211,48 @@ async def _process_job(db: Database, job_id: str) -> None:
         )
         return
     await _fail_no_capacity(db, job_row, "all offers failed to provision")
+
+
+async def _attach_volumes_to_reused(
+    db: Database,
+    project_row: dict,
+    volume_rows: list[dict],
+    volume_regions: set,
+    inst_row: dict,
+    jpd: dict,
+) -> bool:
+    """Attach the run's volumes to an idle pool instance via the
+    backend's UpdateNode path; False rejects this candidate."""
+    from dstack_tpu.backends.base.compute import ComputeWithVolumeSupport
+    from dstack_tpu.server.services import volumes as volumes_service
+
+    if volume_regions and inst_row.get("region") not in volume_regions:
+        return False
+    try:
+        compute = await backends_service.get_project_backend(
+            db, project_row, BackendType(jpd["backend"])
+        )
+    except Exception:
+        return False
+    if not isinstance(compute, ComputeWithVolumeSupport):
+        return False
+    for vrow in volume_rows:
+        volume = volumes_service.volume_row_to_model(vrow, project_row["name"])
+        try:
+            await compute.attach_volume(volume, jpd["instance_id"])
+        except Exception as e:
+            logger.warning(
+                "volume %s attach to reused instance %s failed: %s",
+                vrow["name"], inst_row["name"], e,
+            )
+            return False
+        await db.execute(
+            "INSERT INTO volume_attachments (id, volume_id, instance_id) "
+            "VALUES (?, ?, ?) "
+            "ON CONFLICT (volume_id, instance_id) DO NOTHING",
+            (new_uuid(), vrow["id"], inst_row["id"]),
+        )
+    return True
 
 
 async def _attach_worker_job(

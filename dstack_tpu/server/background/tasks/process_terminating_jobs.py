@@ -48,12 +48,42 @@ async def _process(db: Database, job_id: str) -> None:
                     reason=job_row.get("termination_reason"),
                 )
                 await shim.remove_task(job_row["id"])
-        except (AgentError, AgentNotReady) as e:
+        except (AgentError, AgentNotReady, OSError) as e:
+            # best-effort: unreachable hosts (or no ssh client at all)
+            # must not wedge termination
             logger.debug("job %s: agent teardown skipped: %s", job_row["job_name"], e)
-        # Release the instance for reuse. Only worker 0 owns the slice;
-        # sibling jobs release their own per-node instances.
+        # Detach volumes before releasing the instance; stay TERMINATING
+        # until detach succeeds or the force deadline passes (reference
+        # _detach_volumes_from_job_instance, jobs/__init__.py:409).
+        forced = False
         if job_row.get("instance_id"):
-            await _release_instance(db, job_row)
+            outcome = await _detach_volumes(db, job_row, jpd)
+            if outcome == "wait":
+                await db.update_by_id(
+                    "jobs",
+                    job_row["id"],
+                    {"last_processed_at": now_utc().isoformat()},
+                )
+                return
+            forced = outcome == "forced"
+        # Release the instance for reuse. Only worker 0 owns the slice;
+        # sibling jobs release their own per-node instances. A
+        # force-detached instance still holds its disks on the backend,
+        # so it must be torn down (node deletion frees the disks), never
+        # handed back to the pool.
+        if job_row.get("instance_id"):
+            if forced:
+                await db.update_by_id(
+                    "instances",
+                    job_row["instance_id"],
+                    {
+                        "status": InstanceStatus.TERMINATING.value,
+                        "termination_reason": "volume force-detach",
+                        "last_processed_at": now_utc().isoformat(),
+                    },
+                )
+            else:
+                await _release_instance(db, job_row)
 
     await _unregister_from_gateway(db, job_row)
     # metrics relay rows are only rendered for RUNNING jobs; drop them
@@ -71,6 +101,84 @@ async def _process(db: Database, job_id: str) -> None:
         db, job_row["id"], final, termination_reason=reason
     )
     logger.info("job %s: %s (%s)", job_row["job_name"], final.value, reason.value)
+
+
+async def _detach_volumes(db: Database, job_row: dict, jpd: JobProvisioningData) -> str:
+    """Detach this instance's volumes → "done" | "wait" | "forced".
+    Graceful detach is retried until ``VOLUME_DETACH_DEADLINE`` passes,
+    then attachment rows are force-dropped ("forced") and the caller
+    retires the instance so teardown frees the disks."""
+    from datetime import datetime
+
+    from dstack_tpu.backends.base.compute import ComputeWithVolumeSupport
+    from dstack_tpu.server.db import dumps
+    from dstack_tpu.server.services import backends as backends_service
+    from dstack_tpu.server.services import volumes as volumes_service
+
+    # only the last live job on the instance detaches
+    others = await db.fetchone(
+        "SELECT id FROM jobs WHERE instance_id = ? AND id != ? AND status IN (?,?,?,?)",
+        (
+            job_row["instance_id"],
+            job_row["id"],
+            JobStatus.PROVISIONING.value,
+            JobStatus.PULLING.value,
+            JobStatus.RUNNING.value,
+            JobStatus.TERMINATING.value,
+        ),
+    )
+    if others is not None:
+        return "done"
+    atts = await db.fetchall(
+        "SELECT * FROM volume_attachments WHERE instance_id = ?",
+        (job_row["instance_id"],),
+    )
+    if not atts:
+        return "done"
+    project_row = await db.get_by_id("projects", job_row["project_id"])
+    compute = await backends_service.get_project_backend(db, project_row, jpd.backend)
+    all_detached = True
+    for att in atts:
+        vrow = await db.get_by_id("volumes", att["volume_id"])
+        if vrow is None or not isinstance(compute, ComputeWithVolumeSupport):
+            await db.execute(
+                "DELETE FROM volume_attachments WHERE id = ?", (att["id"],)
+            )
+            continue
+        volume = volumes_service.volume_row_to_model(vrow, project_row["name"])
+        try:
+            await compute.detach_volume(volume, jpd.instance_id)
+            await db.execute(
+                "DELETE FROM volume_attachments WHERE id = ?", (att["id"],)
+            )
+        except Exception as e:
+            logger.warning(
+                "job %s: volume %s detach failed: %s",
+                job_row["job_name"], vrow["name"], e,
+            )
+            all_detached = False
+    if all_detached:
+        return "done"
+    jrd = loads(job_row.get("job_runtime_data")) or {}
+    started = jrd.get("detach_started_at")
+    if started is None:
+        jrd["detach_started_at"] = now_utc().isoformat()
+        await db.update_by_id(
+            "jobs", job_row["id"], {"job_runtime_data": dumps(jrd)}
+        )
+        return "wait"
+    age = (now_utc() - datetime.fromisoformat(started)).total_seconds()
+    if age > settings.VOLUME_DETACH_DEADLINE:
+        logger.warning(
+            "job %s: volume detach deadline passed, force-detaching",
+            job_row["job_name"],
+        )
+        await db.execute(
+            "DELETE FROM volume_attachments WHERE instance_id = ?",
+            (job_row["instance_id"],),
+        )
+        return "forced"
+    return "wait"
 
 
 async def _unregister_from_gateway(db: Database, job_row: dict) -> None:

@@ -86,6 +86,45 @@ async def apply_volume(
     return volume_row_to_model(row, project_row["name"])
 
 
+class VolumesNotReady(Exception):
+    """A referenced volume exists but is still provisioning — requeue."""
+
+
+async def resolve_run_volumes(
+    db: Database, project_row: dict, run_spec
+) -> list[dict]:
+    """ACTIVE volume rows for the run's named volume mount points
+    (reference jobs service volume resolution). Raises
+    ResourceNotExistsError for unknown names, VolumesNotReady for
+    volumes still provisioning."""
+    mounts = getattr(run_spec.configuration, "volumes", None) or []
+    rows = []
+    for m in mounts:
+        name = getattr(m, "name", None)
+        if not name:
+            continue  # instance mount points carry no named volume
+        row = await db.fetchone(
+            "SELECT * FROM volumes WHERE project_id = ? AND name = ? AND deleted = 0",
+            (project_row["id"], name),
+        )
+        if row is None:
+            raise ResourceNotExistsError(f"volume {name} not found")
+        if row["status"] in (
+            VolumeStatus.SUBMITTED.value,
+            VolumeStatus.PROVISIONING.value,
+        ):
+            raise VolumesNotReady(name)
+        if row["status"] != VolumeStatus.ACTIVE.value:
+            raise ClientError(f"volume {name} is {row['status']}")
+        rows.append(row)
+    return rows
+
+
+def volume_zone(row: dict) -> Optional[str]:
+    pd = loads(row.get("provisioning_data")) or {}
+    return pd.get("availability_zone")
+
+
 async def delete_volumes(db: Database, project_row: dict, names: list[str]) -> None:
     for name in names:
         row = await db.fetchone(
@@ -99,8 +138,39 @@ async def delete_volumes(db: Database, project_row: dict, names: list[str]) -> N
         )
         if atts:
             raise ClientError(f"volume {name} is attached; detach first")
+        await _delete_backend_disk(db, project_row, row)
         await db.update_by_id(
             "volumes",
             row["id"],
             {"deleted": 1, "last_processed_at": now_utc().isoformat()},
         )
+
+
+async def _delete_backend_disk(db: Database, project_row: dict, row: dict) -> None:
+    """Tear down the cloud disk for volumes the framework created
+    (external registered disks are left alone — compute.delete_volume
+    enforces that)."""
+    from dstack_tpu.backends.base.compute import ComputeWithVolumeSupport
+    from dstack_tpu.core.models.backends import BackendType
+    from dstack_tpu.server.services import backends as backends_service
+
+    pd = loads(row.get("provisioning_data"))
+    if pd is None or row["external"]:
+        return  # registered disks are never deleted; nothing to tear down
+    conf = VolumeConfiguration.model_validate(loads(row["configuration"]))
+    btype = BackendType(conf.backend) if conf.backend else BackendType.GCP
+    try:
+        compute = await backends_service.get_project_backend(db, project_row, btype)
+    except Exception as e:
+        # a framework-created disk with no reachable backend must NOT be
+        # silently orphaned: keep the row so deletion can be retried
+        raise ClientError(
+            f"cannot reach backend {btype.value} to delete the disk: {e}"
+        ) from e
+    if not isinstance(compute, ComputeWithVolumeSupport):
+        return
+    volume = volume_row_to_model(row, project_row["name"])
+    try:
+        await compute.delete_volume(volume)
+    except Exception as e:
+        raise ClientError(f"backend disk deletion failed: {e}") from e

@@ -52,6 +52,9 @@ MAX_OFFERS_TRIED = _env_int("DTPU_MAX_OFFERS_TRIED", 25)
 
 # Provisioning deadlines (seconds). Parity: process_instances.py:110.
 PROVISIONING_TIMEOUT = _env_int("DTPU_PROVISIONING_TIMEOUT", 600)
+# Graceful volume detach budget before attachment rows are force-dropped
+# (reference force-detach deadline in _detach_volumes_from_job_instance).
+VOLUME_DETACH_DEADLINE = _env_int("DTPU_VOLUME_DETACH_DEADLINE", 300)
 AGENT_WAIT_TIMEOUT = _env_int("DTPU_AGENT_WAIT_TIMEOUT", 600)
 
 SENTRY_DSN = os.getenv("DTPU_SENTRY_DSN")  # gated: sentry-sdk optional

@@ -12,6 +12,7 @@ from dstack_tpu.backends.base.compute import (
     Compute,
     ComputeWithCreateInstanceSupport,
     ComputeWithMultinodeSupport,
+    ComputeWithVolumeSupport,
 )
 from dstack_tpu.core.catalog import CatalogItem
 from dstack_tpu.core.models.backends import BackendType
@@ -127,7 +128,12 @@ def cpu_offer(region: str = "us-central1", price: float = 0.5) -> InstanceOfferW
     )
 
 
-class FakeCompute(Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinodeSupport):
+class FakeCompute(
+    Compute,
+    ComputeWithCreateInstanceSupport,
+    ComputeWithMultinodeSupport,
+    ComputeWithVolumeSupport,
+):
     """Instantly 'provisions' instances; records calls for assertions."""
 
     def __init__(
@@ -140,8 +146,13 @@ class FakeCompute(Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinod
         self.fail_create = fail_create
         self.delay_ips = delay_ips
         self.fail_next = 0  # fail this many upcoming create calls, then succeed
+        self.fail_detach = False
         self.created: list[InstanceConfiguration] = []
         self.terminated: list[str] = []
+        self.volumes_created: list[str] = []
+        self.volumes_deleted: list[str] = []
+        self.attached: list[tuple[str, str]] = []
+        self.detached: list[tuple[str, str]] = []
         self._counter = 0
         self._pending_hosts: dict[str, list[HostMetadata]] = {}
 
@@ -209,6 +220,43 @@ class FakeCompute(Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinod
 
     async def terminate_instance(self, instance_id, region, backend_data=None):
         self.terminated.append(instance_id)
+
+    # -- volumes --
+
+    async def create_volume(self, volume):
+        from dstack_tpu.core.models.volumes import VolumeProvisioningData
+
+        self.volumes_created.append(volume.name)
+        return VolumeProvisioningData(
+            backend=BackendType.GCP,
+            volume_id=f"disk-{volume.name}",
+            size_gb=float(volume.configuration.size or 100),
+            availability_zone="us-central1-a",
+        )
+
+    async def register_volume(self, volume):
+        from dstack_tpu.core.models.volumes import VolumeProvisioningData
+
+        return VolumeProvisioningData(
+            backend=BackendType.GCP,
+            volume_id=volume.configuration.volume_id or volume.name,
+            size_gb=float(volume.configuration.size or 0),
+            availability_zone="us-central1-a",
+        )
+
+    async def delete_volume(self, volume):
+        self.volumes_deleted.append(volume.name)
+
+    async def attach_volume(self, volume, instance_id):
+        from dstack_tpu.core.models.volumes import VolumeAttachmentData
+
+        self.attached.append((volume.name, instance_id))
+        return VolumeAttachmentData(device_name="persistent-disk-1")
+
+    async def detach_volume(self, volume, instance_id):
+        if self.fail_detach:
+            raise RuntimeError("fake detach failure")
+        self.detached.append((volume.name, instance_id))
 
 
 def make_run_spec(conf_dict: dict, run_name: Optional[str] = None) -> RunSpec:

@@ -33,6 +33,9 @@ class FakeTransport:
         if method == "GET" and "/nodes/" in url:
             node_id = url.rsplit("/", 1)[1]
             return self.nodes.get(node_id, {"state": "TERMINATED"})
+        if method == "DELETE" and "/disks/" in url:
+            getattr(self, "disks", {}).pop(url.rsplit("/", 1)[1], None)
+            return {}
         if method == "DELETE":
             node_id = url.rsplit("/", 1)[1]
             self.nodes.pop(node_id, None)
@@ -41,6 +44,22 @@ class FakeTransport:
             node_id = url.rsplit("/", 1)[1]
             self.nodes[node_id]["dataDisks"] = json_body["dataDisks"]
             return {}
+        if method == "POST" and url.endswith("/disks"):
+            self.disks = getattr(self, "disks", {})
+            self.disks[json_body["name"]] = {
+                "status": "READY",
+                "sizeGb": json_body["sizeGb"],
+                "type": json_body["type"],
+            }
+            return {"name": f"operations/disk-{json_body['name']}"}
+        if method == "GET" and "/disks/" in url:
+            name = url.rsplit("/", 1)[1]
+            disk = getattr(self, "disks", {}).get(name)
+            if disk is None:
+                from dstack_tpu.core.errors import BackendError
+
+                raise BackendError(f"GCP API GET {url}: 404 not found")
+            return disk
         return {}
 
 
@@ -171,3 +190,81 @@ class TestCreatePoll:
         )
         await compute.terminate_instance(jpd.instance_id, jpd.region, jpd.backend_data)
         assert not t.nodes
+
+
+class TestVolumes:
+    """Disk create → attach to a TPU node → detach → delete, all against
+    the mocked REST transport (reference gcp/compute.py:561-676)."""
+
+    def _volume(self, name="data", size=200, volume_id=None):
+        from dstack_tpu.core.models.configurations import VolumeConfiguration
+        from dstack_tpu.core.models.volumes import Volume
+
+        return Volume(
+            id="v1",
+            name=name,
+            project_name="main",
+            external=volume_id is not None,
+            configuration=VolumeConfiguration(
+                name=name,
+                region="us-central1",
+                size=size if volume_id is None else None,
+                volume_id=volume_id,
+            ),
+        )
+
+    async def test_create_attach_detach_delete(self):
+        compute, t = _compute()
+        vol = self._volume()
+        pd = await compute.create_volume(vol)
+        assert pd.volume_id == "dtpu-main-data"
+        assert pd.size_gb == 200
+        assert pd.availability_zone.startswith("us-central1")
+        assert "dtpu-main-data" in t.disks
+        vol.provisioning_data = pd
+
+        # attach to a freshly created v5e node via UpdateNode(dataDisks)
+        req = Requirements(resources=ResourcesSpec.model_validate({"tpu": "v5e-8"}))
+        offer = (await compute.get_offers(req))[0]
+        jpd = await compute.create_instance(
+            offer, InstanceConfiguration(project_name="main", instance_name="vm")
+        )
+        bd = json.loads(jpd.backend_data)
+        att = await compute.attach_volume(vol, bd["node_id"])
+        assert att.device_name
+        disks = t.nodes[bd["node_id"]]["dataDisks"]
+        assert any(d["sourceDisk"].endswith("/dtpu-main-data") for d in disks)
+
+        await compute.detach_volume(vol, bd["node_id"])
+        assert t.nodes[bd["node_id"]]["dataDisks"] == []
+
+        await compute.delete_volume(vol)
+        assert "dtpu-main-data" not in t.disks
+
+    async def test_volume_ids_attach_at_node_creation(self):
+        compute, t = _compute()
+        vol = self._volume()
+        pd = await compute.create_volume(vol)
+        req = Requirements(resources=ResourcesSpec.model_validate({"tpu": "v5e-8"}))
+        offer = (await compute.get_offers(req))[0]
+        await compute.create_instance(
+            offer,
+            InstanceConfiguration(
+                project_name="main",
+                instance_name="withvol",
+                volume_ids=[pd.volume_id],
+                availability_zone=pd.availability_zone,
+            ),
+        )
+        create = next(c for c in t.calls if c[0] == "POST" and c[1].endswith("/nodes"))
+        assert create[2]["dataDisks"][0]["sourceDisk"].endswith("/dtpu-main-data")
+
+    async def test_registered_external_disk_not_deleted(self):
+        compute, t = _compute()
+        vol = self._volume(volume_id="byo-disk")
+        pd = await compute.register_volume(vol)
+        assert pd.volume_id == "byo-disk"
+        vol.provisioning_data = pd
+        t.disks = {"byo-disk": {"status": "READY"}}
+        await compute.delete_volume(vol)
+        assert "byo-disk" in t.disks  # left alone

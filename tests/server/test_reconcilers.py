@@ -267,6 +267,165 @@ class TestSubmittedJobs:
         assert inst["status"] == InstanceStatus.BUSY.value
 
 
+class TestVolumeLifecycle:
+    async def _active_volume(self, db, project_row, user_row, name="data"):
+        from dstack_tpu.core.models.configurations import VolumeConfiguration
+        from dstack_tpu.server.background.tasks.process_volumes import (
+            process_volumes,
+        )
+        from dstack_tpu.server.services import volumes as volumes_service
+
+        await volumes_service.apply_volume(
+            db, project_row, user_row,
+            VolumeConfiguration(name=name, region="us-central1", size=100),
+        )
+        await process_volumes(db)
+        row = await db.fetchone("SELECT * FROM volumes WHERE name = ?", (name,))
+        assert row["status"] == "active"
+        return row
+
+    async def test_volume_attach_on_provision_detach_on_terminate(self):
+        """Volume create → attach to the TPU slice at node creation →
+        graceful detach when the job terminates (reference
+        gcp/compute.py:561-676 + jobs/__init__.py:409)."""
+        db, user_row, project_row, compute = await _setup()
+        vrow = await self._active_volume(db, project_row, user_row)
+        assert compute.volumes_created == ["data"]
+        conf = {
+            **TASK_V5E8,
+            "volumes": [{"name": "data", "path": "/data"}],
+        }
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "vol-run")
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run.id,))
+        assert job["status"] == JobStatus.PROVISIONING.value
+        # disk handed to the backend at node creation
+        assert compute.created[0].volume_ids == ["disk-data"]
+        atts = await db.fetchall("SELECT * FROM volume_attachments")
+        assert len(atts) == 1 and atts[0]["volume_id"] == vrow["id"]
+
+        # terminate: graceful detach drops the attachment row
+        await jobs_service_update(db, job["id"])
+        await process_terminating_jobs(db)
+        assert compute.detached and compute.detached[0][0] == "data"
+        assert await db.fetchall("SELECT * FROM volume_attachments") == []
+        job = await db.get_by_id("jobs", job["id"])
+        assert job["status"] in ("failed", "terminated", "aborted", "done")
+
+    async def test_volume_force_detach_after_deadline(self):
+        """Failing graceful detach keeps the job TERMINATING until the
+        force deadline passes, then attachment rows are force-dropped."""
+        from dstack_tpu.server import settings
+
+        db, user_row, project_row, compute = await _setup()
+        await self._active_volume(db, project_row, user_row)
+        conf = {**TASK_V5E8, "volumes": [{"name": "data", "path": "/data"}]}
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "stuck-vol")
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run.id,))
+        compute.fail_detach = True
+        await jobs_service_update(db, job["id"])
+        await process_terminating_jobs(db)  # starts the detach clock
+        job = await db.get_by_id("jobs", job["id"])
+        assert job["status"] == JobStatus.TERMINATING.value
+        assert len(await db.fetchall("SELECT * FROM volume_attachments")) == 1
+        old = settings.VOLUME_DETACH_DEADLINE
+        settings.VOLUME_DETACH_DEADLINE = 0
+        try:
+            await process_terminating_jobs(db)  # deadline passed: force
+        finally:
+            settings.VOLUME_DETACH_DEADLINE = old
+        assert await db.fetchall("SELECT * FROM volume_attachments") == []
+        job = await db.get_by_id("jobs", job["id"])
+        assert job["status"] != JobStatus.TERMINATING.value
+
+    async def test_volume_attaches_to_reused_instance(self):
+        """Pool reuse must attach volumes via the backend's UpdateNode
+        path (fresh nodes get them at creation instead)."""
+        db, user_row, project_row, compute = await _setup()
+        await self._active_volume(db, project_row, user_row)
+        # seed an idle instance by running + finishing a volume-less run
+        run1 = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK_V5E8, "seed")
+        )
+        await process_submitted_jobs(db)
+        job1 = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run1.id,))
+        await db.update_by_id(
+            "instances", job1["instance_id"], {"status": InstanceStatus.IDLE.value}
+        )
+        conf = {**TASK_V5E8, "volumes": [{"name": "data", "path": "/data"}]}
+        run2 = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "reuser")
+        )
+        await process_submitted_jobs(db)
+        job2 = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run2.id,))
+        assert job2["instance_id"] == job1["instance_id"]  # reused
+        assert compute.attached and compute.attached[0][0] == "data"
+        atts = await db.fetchall("SELECT * FROM volume_attachments")
+        assert len(atts) == 1 and atts[0]["instance_id"] == job1["instance_id"]
+
+    async def test_force_detach_retires_instance(self):
+        """A force-detached instance still holds its disks on the
+        backend: it must be torn down, never returned to the pool."""
+        from dstack_tpu.server import settings
+
+        db, user_row, project_row, compute = await _setup()
+        await self._active_volume(db, project_row, user_row)
+        conf = {**TASK_V5E8, "volumes": [{"name": "data", "path": "/data"}]}
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "retire")
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run.id,))
+        compute.fail_detach = True
+        await jobs_service_update(db, job["id"])
+        await process_terminating_jobs(db)  # starts the clock
+        old = settings.VOLUME_DETACH_DEADLINE
+        settings.VOLUME_DETACH_DEADLINE = 0
+        try:
+            await process_terminating_jobs(db)
+        finally:
+            settings.VOLUME_DETACH_DEADLINE = old
+        inst = await db.get_by_id("instances", job["instance_id"])
+        assert inst["status"] == InstanceStatus.TERMINATING.value
+
+    async def test_volume_not_ready_requeues(self):
+        """A run referencing a still-provisioning volume waits instead of
+        failing."""
+        from dstack_tpu.core.models.configurations import VolumeConfiguration
+        from dstack_tpu.server.services import volumes as volumes_service
+
+        db, user_row, project_row, compute = await _setup()
+        await volumes_service.apply_volume(
+            db, project_row, user_row,
+            VolumeConfiguration(name="slow", region="us-central1", size=10),
+        )  # stays SUBMITTED: process_volumes not run
+        conf = {**TASK_V5E8, "volumes": [{"name": "slow", "path": "/data"}]}
+        await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "waiting")
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs")
+        assert job["status"] == JobStatus.SUBMITTED.value  # requeued
+        assert compute.created == []
+
+
+async def jobs_service_update(db, job_id):
+    from dstack_tpu.core.models.runs import JobTerminationReason
+    from dstack_tpu.server.services import jobs as jobs_service
+
+    await jobs_service.update_job_status(
+        db,
+        job_id,
+        JobStatus.TERMINATING,
+        termination_reason=JobTerminationReason.TERMINATED_BY_USER,
+    )
+
+
 class TestRunFSM:
     async def test_run_provisioning_then_failed(self):
         db, user_row, project_row, compute = await _setup(offers=[])
