@@ -1,0 +1,73 @@
+"""Sharded training checkpoints (orbax): periodic save, resume, retention.
+
+The reference leaves user-workload checkpointing entirely to user code
+(SURVEY.md §5 checkpoint/resume: "none for user workloads"); the
+framework's own fine-tune driver checkpoints so preempted/restarted TPU
+runs resume mid-stream (BASELINE.md's fine-tune config wants restartable
+runs — spot v5e slices get preempted). Orbax writes each process's
+shards in parallel and coordinates multi-host commits, so the same code
+covers one chip and a multi-host slice; the target dir can be a volume
+mount or a gcsfuse path.
+"""
+
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+
+def _manager(ckpt_dir: str, keep: int = 3):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        Path(ckpt_dir).absolute(),
+        options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
+    )
+
+
+def save_checkpoint(ckpt_dir: str, step: int, state: Any, keep: int = 3) -> None:
+    """Save the full train state (params/opt/step or LoRA state) at
+    ``step``; retains the newest ``keep`` checkpoints."""
+    import orbax.checkpoint as ocp
+
+    mgr = _manager(ckpt_dir, keep)
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def latest_step(ckpt_dir: str) -> Optional[int]:
+    import orbax.checkpoint as ocp
+
+    d = Path(ckpt_dir)
+    if not d.exists():
+        return None
+    mgr = ocp.CheckpointManager(d.absolute())
+    try:
+        return mgr.latest_step()
+    finally:
+        mgr.close()
+
+
+def restore_checkpoint(ckpt_dir: str, state: Any) -> tuple[Any, Optional[int]]:
+    """Restore the latest checkpoint into the layout of ``state`` (same
+    tree/shapes/shardings — typically the freshly initialized state).
+    Returns (state, step); (state, None) when there is nothing to
+    restore."""
+    import orbax.checkpoint as ocp
+
+    step = latest_step(ckpt_dir)
+    if step is None:
+        return state, None
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if hasattr(x, "sharding")
+        else x,
+        state,
+    )
+    mgr = _manager(ckpt_dir)
+    try:
+        restored = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    finally:
+        mgr.close()
+    return restored, step

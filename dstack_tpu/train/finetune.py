@@ -40,6 +40,15 @@ def main(argv=None) -> int:
     p.add_argument("--data", default=None, help="pre-tokenized .npy [N, T] corpus")
     p.add_argument("--out", default="adapters", help="output dir for weights")
     p.add_argument("--log-every", type=int, default=10)
+    p.add_argument(
+        "--ckpt-dir", default=None,
+        help="checkpoint dir (volume mount / gcsfuse path); enables periodic saves",
+    )
+    p.add_argument("--ckpt-every", type=int, default=50, help="steps between saves")
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume from the latest checkpoint in --ckpt-dir",
+    )
     args = p.parse_args(argv)
 
     import jax
@@ -81,6 +90,15 @@ def main(argv=None) -> int:
         params, state, _ = lora_mod.sharded_lora_init(config, lora_conf, opt, mesh)
         step_fn = lora_mod.make_lora_train_step(config, lora_conf, opt, mesh)
     print(f"init done in {time.perf_counter() - t0:.1f}s", flush=True)
+
+    start_step = 0
+    if args.ckpt_dir and args.resume:
+        from dstack_tpu.train.checkpoint import restore_checkpoint
+
+        state, restored_step = restore_checkpoint(args.ckpt_dir, state)
+        if restored_step is not None:
+            start_step = restored_step
+            print(f"resumed from checkpoint step {start_step}", flush=True)
 
     if args.data:
         import numpy as np
@@ -124,12 +142,18 @@ def main(argv=None) -> int:
     tokens_per_step = args.batch * args.seq_len
     first_step_at = None
     t_window = time.perf_counter()
-    for i in range(args.steps):
+    for i in range(start_step, args.steps):
         batch = next_batch(i)
         if args.full:
             state, metrics = step_fn(state, batch)
         else:
             state, metrics = step_fn(params, state, batch)
+        if args.ckpt_dir and (i + 1) % args.ckpt_every == 0:
+            from dstack_tpu.train.checkpoint import save_checkpoint
+
+            jax.block_until_ready(metrics["loss"])
+            save_checkpoint(args.ckpt_dir, i + 1, state)
+            print(f"checkpoint saved at step {i + 1}", flush=True)
         if first_step_at is None:
             jax.block_until_ready(metrics["loss"])
             first_step_at = time.perf_counter()
