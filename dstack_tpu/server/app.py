@@ -131,6 +131,11 @@ async def run_server(
     import asyncio
 
     configure_logging()
+    # process-global telemetry init (once per server process, not per
+    # app construction — tests build many apps)
+    from dstack_tpu.server.tracing import init_sentry
+
+    init_sentry()
     app = await create_app(
         database_url=database_url, admin_token=admin_token, apply_server_config=True
     )

@@ -141,7 +141,11 @@ def build_app(
     state: dict,
     auth_dependency: Optional[Callable] = None,
 ) -> web.Application:
-    app = web.Application(client_max_size=256 * 1024 * 1024)
+    from dstack_tpu.server.tracing import tracing_middleware
+
+    app = web.Application(
+        client_max_size=256 * 1024 * 1024, middlewares=[tracing_middleware]
+    )
     app["state"] = state
     for router in routers:
         for method, path, fn in router.routes:

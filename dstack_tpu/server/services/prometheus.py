@@ -57,6 +57,10 @@ async def render_metrics(db: Database) -> str:
     await _render_instances(db, w, projects)
     await _render_runs(db, w, projects)
     await _render_jobs(db, w, projects)
+    # server-side HTTP latency/counters from the tracing middleware
+    from dstack_tpu.server.tracing import get_request_stats
+
+    w.raw(get_request_stats().render_prometheus())
     return w.render()
 
 

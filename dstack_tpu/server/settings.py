@@ -57,6 +57,16 @@ PROVISIONING_TIMEOUT = _env_int("DTPU_PROVISIONING_TIMEOUT", 600)
 VOLUME_DETACH_DEADLINE = _env_int("DTPU_VOLUME_DETACH_DEADLINE", 300)
 AGENT_WAIT_TIMEOUT = _env_int("DTPU_AGENT_WAIT_TIMEOUT", 600)
 
+# Tracing/profiling (reference server/app.py:68-76, 214-226)
 SENTRY_DSN = os.getenv("DTPU_SENTRY_DSN")  # gated: sentry-sdk optional
+SENTRY_ENVIRONMENT = os.getenv("DTPU_SENTRY_ENVIRONMENT", "production")
+SENTRY_TRACES_SAMPLE_RATE = float(
+    os.getenv("DTPU_SENTRY_TRACES_SAMPLE_RATE", "0.1")
+)
+SENTRY_PROFILES_SAMPLE_RATE = float(
+    os.getenv("DTPU_SENTRY_PROFILES_SAMPLE_RATE", "0.0")
+)
+DEBUG_REQUESTS = os.getenv("DTPU_DEBUG_REQUESTS", "") in ("1", "true", "yes")
+SLOW_REQUEST_SECONDS = float(os.getenv("DTPU_SLOW_REQUEST_SECONDS", "2.0"))
 
 SERVER_CONFIG_PATH = SERVER_DIR_PATH / "config.yml"

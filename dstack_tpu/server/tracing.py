@@ -1,0 +1,145 @@
+"""Error/performance tracing hooks for the server.
+
+Parity: reference server/app.py:68-76 (optional Sentry SDK init with
+error + performance tracing) and :214-226 (request-latency debug
+middleware). Sentry is gated on the SDK being importable and
+``DTPU_SENTRY_DSN`` being set — zero overhead otherwise. The latency
+middleware always records per-route timing into an in-process registry
+that ``/metrics`` renders as ``dtpu_http_request_*`` series (a step past
+the reference, whose latency numbers only reach debug logs).
+"""
+
+import time
+from collections import defaultdict
+from typing import Optional
+
+from aiohttp import web
+
+from dstack_tpu.server import settings
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.tracing")
+
+
+def init_sentry() -> bool:
+    """Initialize Sentry when configured; returns whether it is active."""
+    dsn = settings.SENTRY_DSN
+    if not dsn:
+        return False
+    try:
+        import sentry_sdk
+    except ImportError:
+        logger.warning("DTPU_SENTRY_DSN set but sentry_sdk is not installed")
+        return False
+    sentry_sdk.init(
+        dsn=dsn,
+        environment=settings.SENTRY_ENVIRONMENT,
+        traces_sample_rate=settings.SENTRY_TRACES_SAMPLE_RATE,
+        profiles_sample_rate=settings.SENTRY_PROFILES_SAMPLE_RATE,
+    )
+    logger.info("sentry tracing enabled (env=%s)", settings.SENTRY_ENVIRONMENT)
+    return True
+
+
+def capture_exception(exc: BaseException) -> None:
+    try:
+        import sentry_sdk
+
+        if sentry_sdk.Hub.current.client is not None:
+            sentry_sdk.capture_exception(exc)
+    except Exception:
+        pass
+
+
+def _esc_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class RequestStats:
+    """Per-route request counters/latency for /metrics. Routes are the
+    matched route *templates* (bounded set); unmatched requests collapse
+    to one sentinel so arbitrary 404 paths can't grow the registry."""
+
+    def __init__(self) -> None:
+        self.count: dict[tuple[str, str, int], int] = defaultdict(int)
+        self.total_seconds: dict[tuple[str, str, int], float] = defaultdict(float)
+
+    def record(self, method: str, route: str, status: int, seconds: float) -> None:
+        key = (method, route, status)
+        self.count[key] += 1
+        self.total_seconds[key] += seconds
+
+    def render_prometheus(self) -> str:
+        lines = [
+            "# HELP dtpu_http_requests_total HTTP requests served",
+            "# TYPE dtpu_http_requests_total counter",
+        ]
+        for (method, route, status), n in sorted(self.count.items()):
+            labels = (
+                f'method="{_esc_label(method)}",route="{_esc_label(route)}",'
+                f'status="{status}"'
+            )
+            lines.append(f"dtpu_http_requests_total{{{labels}}} {n}")
+        lines += [
+            "# HELP dtpu_http_request_seconds_total Cumulative request latency",
+            "# TYPE dtpu_http_request_seconds_total counter",
+        ]
+        for (method, route, status), s in sorted(self.total_seconds.items()):
+            labels = (
+                f'method="{_esc_label(method)}",route="{_esc_label(route)}",'
+                f'status="{status}"'
+            )
+            lines.append(f"dtpu_http_request_seconds_total{{{labels}}} {s:.6f}")
+        return "\n".join(lines) + "\n"
+
+
+_stats: Optional[RequestStats] = None
+
+
+def get_request_stats() -> RequestStats:
+    global _stats
+    if _stats is None:
+        _stats = RequestStats()
+    return _stats
+
+
+@web.middleware
+async def tracing_middleware(request: web.Request, handler):
+    """Record latency per route; surface slow requests and capture
+    unhandled errors (reference app.py:214-226 logs request durations
+    under a debug flag; here recording is always on, logging gated)."""
+    import asyncio
+
+    start = time.perf_counter()
+    status = 500
+    try:
+        resp = await handler(request)
+        status = resp.status
+        return resp
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    except asyncio.CancelledError:
+        status = 499  # client closed the connection; not an error
+        raise
+    except BaseException as e:
+        capture_exception(e)
+        raise
+    finally:
+        elapsed = time.perf_counter() - start
+        route = (
+            request.match_info.route.resource.canonical
+            if request.match_info.route.resource is not None
+            else "unmatched"  # sentinel: raw paths are unbounded-cardinality
+        )
+        get_request_stats().record(request.method, route, status, elapsed)
+        if settings.DEBUG_REQUESTS:
+            logger.info(
+                "%s %s -> %d in %.1fms", request.method, route, status,
+                elapsed * 1000,
+            )
+        elif elapsed > settings.SLOW_REQUEST_SECONDS:
+            logger.warning(
+                "slow request: %s %s -> %d in %.2fs",
+                request.method, route, status, elapsed,
+            )

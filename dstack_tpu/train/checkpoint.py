@@ -25,15 +25,30 @@ def _manager(ckpt_dir: str, keep: int = 3):
     )
 
 
-def save_checkpoint(ckpt_dir: str, step: int, state: Any, keep: int = 3) -> None:
-    """Save the full train state (params/opt/step or LoRA state) at
-    ``step``; retains the newest ``keep`` checkpoints."""
-    import orbax.checkpoint as ocp
+class Checkpointer:
+    """One CheckpointManager for a whole training run: ``save`` only
+    blocks for the device→host copy, the (possibly GCS) write continues
+    in the background while the next steps run; ``close`` drains."""
 
-    mgr = _manager(ckpt_dir, keep)
-    mgr.save(step, args=ocp.args.StandardSave(state))
-    mgr.wait_until_finished()
-    mgr.close()
+    def __init__(self, ckpt_dir: str, keep: int = 3):
+        self._mgr = _manager(ckpt_dir, keep)
+
+    def save(self, step: int, state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def save_checkpoint(ckpt_dir: str, step: int, state: Any, keep: int = 3) -> None:
+    """One-shot synchronous save (tests/tools; training loops should
+    hold a :class:`Checkpointer`)."""
+    ck = Checkpointer(ckpt_dir, keep)
+    ck.save(step, state)
+    ck.close()
 
 
 def latest_step(ckpt_dir: str) -> Optional[int]:

@@ -92,13 +92,16 @@ def main(argv=None) -> int:
     print(f"init done in {time.perf_counter() - t0:.1f}s", flush=True)
 
     start_step = 0
-    if args.ckpt_dir and args.resume:
-        from dstack_tpu.train.checkpoint import restore_checkpoint
+    checkpointer = None
+    if args.ckpt_dir:
+        from dstack_tpu.train.checkpoint import Checkpointer, restore_checkpoint
 
-        state, restored_step = restore_checkpoint(args.ckpt_dir, state)
-        if restored_step is not None:
-            start_step = restored_step
-            print(f"resumed from checkpoint step {start_step}", flush=True)
+        if args.resume:
+            state, restored_step = restore_checkpoint(args.ckpt_dir, state)
+            if restored_step is not None:
+                start_step = restored_step
+                print(f"resumed from checkpoint step {start_step}", flush=True)
+        checkpointer = Checkpointer(args.ckpt_dir)
 
     if args.data:
         import numpy as np
@@ -148,11 +151,10 @@ def main(argv=None) -> int:
             state, metrics = step_fn(state, batch)
         else:
             state, metrics = step_fn(params, state, batch)
-        if args.ckpt_dir and (i + 1) % args.ckpt_every == 0:
-            from dstack_tpu.train.checkpoint import save_checkpoint
-
-            jax.block_until_ready(metrics["loss"])
-            save_checkpoint(args.ckpt_dir, i + 1, state)
+        if checkpointer is not None and (i + 1) % args.ckpt_every == 0:
+            # async: only the device->host copy blocks; the write runs
+            # in the background while training continues
+            checkpointer.save(i + 1, state)
             print(f"checkpoint saved at step {i + 1}", flush=True)
         if first_step_at is None:
             jax.block_until_ready(metrics["loss"])
@@ -176,6 +178,9 @@ def main(argv=None) -> int:
                 f"mfu~{ftok * tps / n_chips / 197e12:.2%}",
                 flush=True,
             )
+
+    if checkpointer is not None:
+        checkpointer.close()  # drain in-flight background writes
 
     import numpy as np
 

@@ -28,4 +28,9 @@ def configure_logging(level: str | int | None = None) -> None:
 
 
 def get_logger(name: str) -> logging.Logger:
+    # modules pass short names ("server.tracing"); parent them under the
+    # configured "dstack_tpu" root or their records never reach its
+    # handler (the root logger drops INFO by default)
+    if not name.startswith("dstack_tpu"):
+        name = f"dstack_tpu.{name}"
     return logging.getLogger(name)

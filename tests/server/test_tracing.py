@@ -1,0 +1,58 @@
+"""Tracing middleware: per-route latency recording surfaced on /metrics
+(parity: reference server/app.py:68-76 sentry gate + :214-226 request
+latency middleware)."""
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.tracing import RequestStats, get_request_stats, init_sentry
+
+
+class TestRequestStats:
+    def test_record_and_render(self):
+        stats = RequestStats()
+        stats.record("GET", "/api/server/info", 200, 0.01)
+        stats.record("GET", "/api/server/info", 200, 0.02)
+        stats.record("POST", "/api/project/{p}/runs/list", 401, 0.001)
+        text = stats.render_prometheus()
+        assert (
+            'dtpu_http_requests_total{method="GET",route="/api/server/info",status="200"} 2'
+            in text
+        )
+        assert 'status="401"} 1' in text
+        assert "dtpu_http_request_seconds_total" in text
+
+    def test_sentry_disabled_without_dsn(self):
+        assert init_sentry() is False  # no DTPU_SENTRY_DSN in tests
+
+
+class TestMiddlewareE2E:
+    async def test_latency_recorded_and_rendered(self):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tr-tok",
+            with_background=False,
+            local_backend=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(
+                "/api/server/info",
+                headers={"Authorization": "Bearer tr-tok"},
+            )
+            assert r.status == 200
+            key_hits = [
+                k for k in get_request_stats().count if k[1] == "/api/server/info"
+            ]
+            assert key_hits, "middleware did not record the request"
+
+            r = await client.get(
+                "/metrics", headers={"Authorization": "Bearer tr-tok"}
+            )
+            assert r.status == 200
+            text = await r.text()
+            assert "dtpu_http_requests_total" in text
+            assert "/api/server/info" in text
+        finally:
+            await client.close()
