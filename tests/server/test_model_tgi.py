@@ -4,6 +4,7 @@ fake TGI service answering through /proxy/models/.../chat/completions
 
 import asyncio
 import json
+import shlex
 
 import pytest
 from aiohttp.test_utils import TestClient, TestServer
@@ -111,35 +112,39 @@ FAKE_TGI = (
     "        else:\n"
     "            self.send_response(404); self.end_headers()\n"
     "    def log_message(self, *a): pass\n"
-    "http.server.HTTPServer(('127.0.0.1', 18127), H).serve_forever()\n"
+    "http.server.HTTPServer(('127.0.0.1', @PORT@), H).serve_forever()\n"
 )
 
-import shlex
 
-# shell-safe one-liner: json.dumps produces a valid Python string literal
-# whose \n escapes are decoded by exec() inside python, not by the shell
-_FAKE_TGI_CMD = "python -c " + shlex.quote("exec(" + json.dumps(FAKE_TGI) + ")")
+from dstack_tpu.core.services.ssh.tunnel import find_free_port as _free_port
 
-TGI_SERVICE_BODY = {
-    "run_spec": {
-        "run_name": "tgi-svc",
-        "configuration": {
-            "type": "service",
-            "commands": [_FAKE_TGI_CMD],
-            "port": 18127,
-            "model": {
-                "name": "tiny-tgi",
-                "format": "tgi",
-                "eos_token": "<eos>",
-                "chat_template": (
-                    "{% for m in messages %}{{ m['content'] }}{% endfor %}"
-                ),
+
+def tgi_service_body(port: int) -> dict:
+    # ephemeral port: fixed ports collide with servers orphaned by
+    # earlier test runs
+    cmd = "python -c " + shlex.quote(
+        "exec(" + json.dumps(FAKE_TGI.replace("@PORT@", str(port))) + ")"
+    )
+    return {
+        "run_spec": {
+            "run_name": "tgi-svc",
+            "configuration": {
+                "type": "service",
+                "commands": [cmd],
+                "port": port,
+                "model": {
+                    "name": "tiny-tgi",
+                    "format": "tgi",
+                    "eos_token": "<eos>",
+                    "chat_template": (
+                        "{% for m in messages %}{{ m['content'] }}{% endfor %}"
+                    ),
+                },
+                "auth": False,
             },
-            "auth": False,
-        },
-        "ssh_key_pub": "ssh-ed25519 AAAA t",
+            "ssh_key_pub": "ssh-ed25519 AAAA t",
+        }
     }
-}
 
 
 def _auth(token):
@@ -165,7 +170,7 @@ class TestTGIServiceE2E:
             r = await client.post(
                 "/api/project/main/runs/apply",
                 headers=_auth("tgi-tok"),
-                json=TGI_SERVICE_BODY,
+                json=tgi_service_body(_free_port()),
             )
             assert r.status == 200
             deadline = asyncio.get_event_loop().time() + 60

@@ -24,27 +24,33 @@ def _auth(token):
     return {"Authorization": f"Bearer {token}"}
 
 
-SERVICE_BODY = {
-    "run_spec": {
-        "run_name": "echo-svc",
-        "configuration": {
-            "type": "service",
-            "commands": [
-                "python -c \""
-                "import http.server,json;"
-                "h=type('H',(http.server.BaseHTTPRequestHandler,),{"
-                "'do_GET':lambda s:(s.send_response(200),s.end_headers(),"
-                "s.wfile.write(b'echo-ok')),"
-                "'log_message':lambda s,*a:None});"
-                "http.server.HTTPServer(('127.0.0.1',18123),h).serve_forever()\""
-            ],
-            "port": 18123,
-            "model": "test-model",
-            "auth": False,
-        },
-        "ssh_key_pub": "ssh-ed25519 AAAA t",
+from dstack_tpu.core.services.ssh.tunnel import find_free_port as _free_port
+
+
+def service_body(port: int) -> dict:
+    # ephemeral port: fixed ports collide with servers orphaned by
+    # earlier test runs (local-backend job processes outlive pytest)
+    return {
+        "run_spec": {
+            "run_name": "echo-svc",
+            "configuration": {
+                "type": "service",
+                "commands": [
+                    "python -c \""
+                    "import http.server,json;"
+                    "h=type('H',(http.server.BaseHTTPRequestHandler,),{"
+                    "'do_GET':lambda s:(s.send_response(200),s.end_headers(),"
+                    "s.wfile.write(b'echo-ok')),"
+                    "'log_message':lambda s,*a:None});"
+                    f"http.server.HTTPServer(('127.0.0.1',{port}),h).serve_forever()\""
+                ],
+                "port": port,
+                "model": "test-model",
+                "auth": False,
+            },
+            "ssh_key_pub": "ssh-ed25519 AAAA t",
+        }
     }
-}
 
 
 class TestServiceE2E:
@@ -64,7 +70,7 @@ class TestServiceE2E:
         await client.start_server()
         try:
             r = await client.post(
-                "/api/project/main/runs/apply", headers=_auth("svc-tok"), json=SERVICE_BODY
+                "/api/project/main/runs/apply", headers=_auth("svc-tok"), json=service_body(_free_port())
             )
             assert r.status == 200
             run = await r.json()
