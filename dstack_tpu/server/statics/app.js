@@ -66,6 +66,7 @@ function toast(msg) {
 
 const PAGES = [
   ["runs", "Runs"],
+  ["models", "Models"],
   ["fleets", "Fleets"],
   ["instances", "Instances"],
   ["volumes", "Volumes"],
@@ -145,7 +146,7 @@ async function pageRuns() {
             : (jpd?.instance_type?.name || "—")),
           h("td", {}, fmtDate(r.submitted_at)),
           h("td", {}, h("div", { class: "row-actions" },
-            ["running", "submitted", "provisioning", "pending"].includes(r.status)
+            ACTIVE_STATUSES.includes(r.status)
               ? h("button", { class: "danger", onclick: async (e) => {
                   e.stopPropagation();
                   await papi("/runs/stop", { runs_names: [r.run_spec.run_name], abort: false });
@@ -164,36 +165,119 @@ async function pageRuns() {
   );
 }
 
+function decodeLogEvent(ev) {
+  // atob alone maps bytes to Latin-1 and mangles UTF-8 output
+  return new TextDecoder("utf-8").decode(
+    Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0)));
+}
+
+const ACTIVE_STATUSES = ["running", "submitted", "provisioning", "pending"];
+let activeLogWs = null;  // at most one live log stream; closed on re-render
+
 async function pageRunDetail(name) {
   const run = await papi("/runs/get", { run_name: name });
-  const sub = run.jobs?.[0]?.job_submissions?.slice(-1)[0];
-  const jpd = sub?.job_provisioning_data;
+  const jpd0 = run.jobs?.[0]?.job_submissions?.slice(-1)[0]?.job_provisioning_data;
   const logsPre = h("pre", { class: "logs" }, "loading logs…");
+  let polled = false;
 
-  (async () => {
+  function pollFallback() {
+    if (polled) return;  // onerror AND onclose both fire on a failed ws
+    polled = true;
+    pollOnce().catch((e) => { logsPre.textContent = "log fetch failed: " + e.message; });
+  }
+  // Live logs: websocket stream while a job is running (the CLI's
+  // `logs -f` path), one-shot REST poll otherwise.
+  function followWs() {
+    const proto = location.protocol === "https:" ? "wss" : "ws";
+    const ws = new WebSocket(
+      `${proto}://${location.host}/api/project/${state.project}` +
+      `/runs/${name}/logs_ws?token=${encodeURIComponent(state.token)}`);
+    activeLogWs = ws;
+    let text = "";
+    ws.onmessage = (m) => {
+      if (logsPre.textContent === "loading logs…") logsPre.textContent = "";
+      text += decodeLogEvent(JSON.parse(m.data));
+      logsPre.textContent = text;
+      logsPre.scrollTop = logsPre.scrollHeight;
+    };
+    ws.onerror = () => pollFallback();
+    ws.onclose = () => { if (!text) pollFallback(); };
+  }
+  async function pollOnce() {
     let token = null, text = "";
     for (let i = 0; i < 50; i++) {
       const batch = await papi("/logs/poll", { run_name: name, next_token: token, limit: 1000 });
       if (!batch.logs.length) break;
       token = batch.next_token;
-      // atob alone maps bytes to Latin-1 and mangles UTF-8 output
-      text += batch.logs.map((ev) => new TextDecoder("utf-8").decode(
-        Uint8Array.from(atob(ev.message), (c) => c.charCodeAt(0)))).join("");
+      text += batch.logs.map(decodeLogEvent).join("");
     }
     logsPre.textContent = text || "(no logs)";
-  })().catch((e) => { logsPre.textContent = "log fetch failed: " + e.message; });
+  }
+  if (run.status === "running") followWs();
+  else pollFallback();
+
+  // auto-refresh status while the run is active (render() closes the
+  // previous stream before building the page again)
+  if (ACTIVE_STATUSES.includes(run.status)) {
+    setTimeout(() => { if (currentRoute().arg === name) render(); }, 5000);
+  }
+
+  // per-node jobs table (multi-host slices / multislice runs)
+  const jobRows = (run.jobs || []).map((j, idx) => {
+    const s = j.job_submissions?.slice(-1)[0];
+    const jp = s?.job_provisioning_data;
+    return h("tr", {},
+      h("td", {}, j.job_spec?.job_name || `${name}-0-${idx}`),
+      h("td", {}, String(j.job_spec?.job_num ?? idx)),
+      h("td", {}, statusBadge(s?.status || "unknown")),
+      h("td", {}, jp?.internal_ip || jp?.hostname || "—"),
+      h("td", {}, s?.termination_reason || "—"),
+    );
+  });
+
+  // latest hardware metrics (cpu/mem/TPU duty cycle from the agent)
+  const metricsDiv = h("div", { class: "kv" }, h("div", { class: "muted" }, "loading…"));
+  (async () => {
+    const jm = await papi("/metrics/job", { run_name: name, limit: 15 });
+    const rows = [];
+    for (const m of jm.metrics || []) {
+      const v = m.values?.slice(-1)[0];
+      if (v == null) continue;
+      const val = m.name.includes("bytes")
+        ? `${(v / 1024 / 1024).toFixed(0)} MiB`
+        : m.name.includes("percent") ? `${Number(v).toFixed(1)}%` : String(v);
+      rows.push(h("div", { class: "k" }, m.name), h("div", {}, val));
+    }
+    metricsDiv.replaceChildren(
+      ...(rows.length ? rows : [h("div", { class: "muted" }, "no samples yet")]));
+  })().catch(() => metricsDiv.replaceChildren(h("div", { class: "muted" }, "unavailable")));
 
   return h("div", {},
-    h("h1", {}, h("a", { href: "#/runs" }, "Runs"), " / ", name, " ", statusBadge(run.status)),
+    h("h1", { style: "display:flex;align-items:center;gap:8px" },
+      h("a", { href: "#/runs" }, "Runs"), " / ", name, " ", statusBadge(run.status),
+      h("div", { style: "flex:1" }),
+      ACTIVE_STATUSES.includes(run.status)
+        ? h("button", { class: "danger", onclick: async () => {
+            await papi("/runs/stop", { runs_names: [name], abort: false });
+            toast(`Stopping ${name}`); render();
+          } }, "Stop")
+        : null,
+    ),
     h("div", { class: "kv" },
       h("div", { class: "k" }, "Type"), h("div", {}, run.run_spec.configuration?.type),
-      h("div", { class: "k" }, "Backend"), h("div", {}, jpd?.backend || "—"),
-      h("div", { class: "k" }, "Host"), h("div", {}, jpd?.hostname || "—"),
-      h("div", { class: "k" }, "Price"), h("div", {}, jpd ? `$${(jpd.price || 0).toFixed(2)}/h` : "—"),
+      h("div", { class: "k" }, "Backend"), h("div", {}, jpd0?.backend || "—"),
+      h("div", { class: "k" }, "Host"), h("div", {}, jpd0?.hostname || "—"),
+      h("div", { class: "k" }, "Price"), h("div", {}, jpd0 ? `$${(jpd0.price || 0).toFixed(2)}/h` : "—"),
       h("div", { class: "k" }, "Submitted"), h("div", {}, fmtDate(run.submitted_at)),
       h("div", { class: "k" }, "Status message"), h("div", {}, run.status_message || "—"),
       h("div", { class: "k" }, "Service URL"), h("div", {}, run.service?.url || "—"),
     ),
+    jobRows.length > 1
+      ? h("div", {}, h("h1", {}, "Jobs"),
+          table(["Job", "Node", "Status", "Host", "Reason"], jobRows))
+      : null,
+    h("h1", {}, "Hardware metrics"),
+    metricsDiv,
     h("h1", {}, "Logs"),
     logsPre,
   );
@@ -206,7 +290,7 @@ async function pageFleets() {
     table(
       ["Name", "Status", "Instances", "Created", ""],
       fleets.map((f) => h("tr", {},
-        h("td", {}, f.name),
+        h("td", {}, h("a", { href: `#/fleets/${f.name}` }, f.name)),
         h("td", {}, statusBadge(f.status)),
         h("td", {}, String((f.instances || []).length)),
         h("td", {}, fmtDate(f.created_at)),
@@ -217,6 +301,86 @@ async function pageFleets() {
       )),
       "No fleets — create one with `dtpu apply -f fleet.yaml`",
     ),
+  );
+}
+
+async function pageFleetDetail(name) {
+  const fleets = await papi("/fleets/list");
+  const fleet = fleets.find((f) => f.name === name);
+  if (!fleet) return h("div", { class: "empty" }, `fleet ${name} not found`);
+  return h("div", {},
+    h("h1", {}, h("a", { href: "#/fleets" }, "Fleets"), " / ", name, " ",
+      statusBadge(fleet.status)),
+    h("div", { class: "kv" },
+      h("div", { class: "k" }, "Created"), h("div", {}, fmtDate(fleet.created_at)),
+      h("div", { class: "k" }, "Placement"),
+      h("div", {}, fleet.spec?.configuration?.placement || "any"),
+      h("div", { class: "k" }, "Status message"),
+      h("div", {}, fleet.status_message || "—"),
+    ),
+    h("h1", {}, "Instances"),
+    table(
+      ["Name", "Status", "Backend", "Region", "Resources", "Price"],
+      (fleet.instances || []).map((i) => h("tr", {},
+        h("td", {}, i.name),
+        h("td", {}, statusBadge(i.status)),
+        h("td", {}, i.backend || "—"),
+        h("td", {}, i.region || "—"),
+        h("td", {}, i.instance_type?.resources?.tpu
+          ? `TPU ${i.instance_type.resources.tpu.version}-${i.instance_type.resources.tpu.chips}`
+          : (i.instance_type?.name || "—")),
+        h("td", {}, `$${(i.price || 0).toFixed(2)}/h`),
+      )),
+      "No instances in this fleet",
+    ),
+  );
+}
+
+async function pageModels() {
+  const resp = await fetch(`/proxy/models/${state.project}/models`, {
+    headers: { "Authorization": "Bearer " + state.token },
+  });
+  const models = (await resp.json()).data || [];
+  const modelSel = h("select", {},
+    models.map((m) => h("option", { value: m.id }, m.id)));
+  const promptIn = h("textarea", { rows: "3", placeholder: "Say something…" });
+  const out = h("pre", { class: "logs", style: "min-height:80px" }, "");
+  return h("div", {},
+    h("h1", {}, "Models"),
+    table(
+      ["Model", "Service"],
+      models.map((m) => h("tr", {},
+        h("td", {}, m.id), h("td", {}, m.owned_by || "—"))),
+      "No model services — declare `model:` in a service config",
+    ),
+    models.length ? h("div", {},
+      h("h1", {}, "Playground"),
+      h("div", { style: "display:flex;flex-direction:column;gap:8px;max-width:720px" },
+        modelSel, promptIn,
+        h("button", { class: "primary", style: "align-self:flex-start", onclick: async () => {
+          out.textContent = "…";
+          try {
+            const r = await fetch(`/proxy/models/${state.project}/chat/completions`, {
+              method: "POST",
+              headers: {
+                "Authorization": "Bearer " + state.token,
+                "Content-Type": "application/json",
+              },
+              body: JSON.stringify({
+                model: modelSel.value,
+                messages: [{ role: "user", content: promptIn.value }],
+                max_tokens: 512,
+              }),
+            });
+            const d = await r.json();
+            out.textContent = r.ok
+              ? (d.choices?.[0]?.message?.content || JSON.stringify(d))
+              : JSON.stringify(d);
+          } catch (e) { out.textContent = "request failed: " + e.message; }
+        } }, "Send"),
+        out,
+      ),
+    ) : null,
   );
 }
 
@@ -378,6 +542,7 @@ function renderLogin(err) {
 
 const ROUTES = {
   runs: pageRuns,
+  models: pageModels,
   fleets: pageFleets,
   instances: pageInstances,
   volumes: pageVolumes,
@@ -388,6 +553,7 @@ const ROUTES = {
 };
 
 async function render() {
+  if (activeLogWs) { try { activeLogWs.close(); } catch (e) {} activeLogWs = null; }
   if (!state.token) return renderLogin();
   try {
     state.user = await api("/api/users/get_my_user");
@@ -401,9 +567,9 @@ async function render() {
   const { page, arg } = currentRoute();
   let content;
   try {
-    content = page === "runs" && arg
-      ? await pageRunDetail(arg)
-      : await (ROUTES[page] || pageRuns)();
+    if (page === "runs" && arg) content = await pageRunDetail(arg);
+    else if (page === "fleets" && arg) content = await pageFleetDetail(arg);
+    else content = await (ROUTES[page] || pageRuns)();
   } catch (e) {
     content = h("div", { class: "empty" }, "Error: " + e.message);
   }

@@ -1,9 +1,18 @@
-"""Web console statics are served by the server (reference app.py:247-250
-serves the frontend SPA the same way)."""
+"""Web console: statics serving + API contract for every console view
+against a seeded DB (reference serves its React SPA the same way,
+app.py:247-250; rendering is client-side, so the tests pin the REST
+responses to the exact field paths the JS reads)."""
+
+import asyncio
+import base64
 
 from aiohttp.test_utils import TestClient, TestServer
 
 from dstack_tpu.server.app import create_app
+
+
+def _auth(token):
+    return {"Authorization": f"Bearer {token}"}
 
 
 class TestUIServing:
@@ -25,10 +34,124 @@ class TestUIServing:
             r = await client.get("/statics/app.js")
             assert r.status == 200
             js = await r.text()
-            assert "pageRuns" in js
+            # every console view exists
+            for page in (
+                "pageRuns", "pageRunDetail", "pageModels", "pageFleets",
+                "pageFleetDetail", "pageInstances", "pageVolumes",
+                "pageGateways", "pageRepos", "pageSecrets", "pageProject",
+            ):
+                assert page in js, page
+            # live logs ride the websocket endpoint
+            assert "logs_ws" in js
 
             # API routes unaffected
             r = await client.get("/api/server/info")
             assert r.status == 200
+        finally:
+            await client.close()
+
+
+class TestConsoleAPIContract:
+    """The endpoints the console calls, with a seeded run — asserting
+    the field paths app.js dereferences."""
+
+    async def test_views_render_against_seeded_db(self, tmp_path):
+        from pathlib import Path
+
+        from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
+
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="ui-tok",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            body = {
+                "run_spec": {
+                    "run_name": "ui-run",
+                    "configuration": {
+                        "type": "task",
+                        "commands": ["echo ui-hello", "sleep 0.2"],
+                    },
+                    "ssh_key_pub": "ssh-ed25519 AAAA t",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth("ui-tok"), json=body
+            )
+            assert r.status == 200
+            for _ in range(120):
+                r = await client.post(
+                    "/api/project/main/runs/get",
+                    headers=_auth("ui-tok"),
+                    json={"run_name": "ui-run"},
+                )
+                run = await r.json()
+                if run["status"] in ("done", "failed", "terminated"):
+                    break
+                await asyncio.sleep(0.5)
+            assert run["status"] == "done"
+
+            # pageRuns / pageRunDetail field paths
+            r = await client.post(
+                "/api/project/main/runs/list", headers=_auth("ui-tok"), json={}
+            )
+            runs = await r.json()
+            row = next(x for x in runs if x["run_spec"]["run_name"] == "ui-run")
+            sub = row["jobs"][0]["job_submissions"][-1]
+            assert sub["status"] == "done"
+            assert sub["job_provisioning_data"]["backend"] == "local"
+            assert row["jobs"][0]["job_spec"]["job_num"] == 0
+
+            # logs view (REST fallback path)
+            r = await client.post(
+                "/api/project/main/logs/poll",
+                headers=_auth("ui-tok"),
+                json={"run_name": "ui-run", "limit": 1000},
+            )
+            logs = await r.json()
+            decoded = [
+                base64.b64decode(ev["message"]).decode() for ev in logs["logs"]
+            ]
+            assert any("ui-hello" in text for text in decoded)
+
+            # metrics view
+            r = await client.post(
+                "/api/project/main/metrics/job",
+                headers=_auth("ui-tok"),
+                json={"run_name": "ui-run", "limit": 1},
+            )
+            assert r.status == 200
+            assert "metrics" in await r.json()
+
+            # fleets view incl. detail (auto-created per-run fleet)
+            r = await client.post(
+                "/api/project/main/fleets/list", headers=_auth("ui-tok"), json={}
+            )
+            fleets = await r.json()
+            assert fleets and "instances" in fleets[0]
+            assert "status" in fleets[0]
+
+            # volumes/gateways/repos/secrets/project/instances views
+            for path in (
+                "/api/project/main/volumes/list",
+                "/api/project/main/gateways/list",
+                "/api/project/main/repos/list",
+                "/api/project/main/secrets/list",
+                "/api/project/main/get",
+                "/api/project/main/backends/list",
+                "/api/project/main/instances/list",
+            ):
+                r = await client.post(path, headers=_auth("ui-tok"), json={})
+                assert r.status == 200, path
+
+            # models view
+            r = await client.get("/proxy/models/main/models")
+            assert r.status == 200
+            assert "data" in await r.json()
         finally:
             await client.close()
