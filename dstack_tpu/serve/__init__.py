@@ -1,0 +1,3 @@
+"""In-repo TPU inference engine: KV-cache decode + OpenAI-compatible
+server (the Service story's compute side; the reference only proxies to
+user containers)."""

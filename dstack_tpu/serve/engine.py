@@ -1,0 +1,408 @@
+"""KV-cache inference engine for the Llama family.
+
+TPU-first decode design: everything is static-shaped. The engine owns a
+fixed pool of ``max_batch`` sequence *slots* over preallocated KV caches
+[L, B, Hkv, T_max, D]; requests prefill into a free slot and every
+decode step advances all active slots at once (continuous batching
+without dynamic shapes — one compiled step serves any mix of sequence
+lengths, the XLA-friendly alternative to GPU paged-attention kernels).
+Sampling (greedy / temperature / top-p) runs inside the same jit.
+
+The reference framework has no inference engine at all (services run
+user containers, reference examples use vLLM/TGI); this module makes
+``type: service`` self-contained:
+``python -m dstack_tpu.serve.openai_server`` is a runnable service
+command on any slice the orchestrator provisions.
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dstack_tpu.models import llama
+from dstack_tpu.models.llama import (
+    LlamaConfig,
+    _proj,
+    rms_norm,
+    rope_freqs,
+)
+
+NEG_INF = -1e30
+
+
+@dataclass
+class GenParams:
+    max_new_tokens: int = 256
+    temperature: float = 0.0  # 0 = greedy
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+
+def init_cache(config: LlamaConfig, max_batch: int, max_seq: int) -> dict:
+    """Preallocated KV cache: k/v [L, B, Hkv, T_max, D] in model dtype."""
+    shape = (
+        config.n_layers,
+        max_batch,
+        config.n_kv_heads,
+        max_seq,
+        config.head_dim,
+    )
+    return {
+        "k": jnp.zeros(shape, config.dtype),
+        "v": jnp.zeros(shape, config.dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# model: prefill + single-token decode over the cache
+# ---------------------------------------------------------------------------
+
+
+def _apply_rope_batch(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x [B, H, 1, D]; cos/sin [B, D/2] (per-slot positions)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[:, None, None, :].astype(x.dtype)
+    s = sin[:, None, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _expand_gqa(k: jax.Array, n_heads: int) -> jax.Array:
+    hkv = k.shape[1]
+    if hkv == n_heads:
+        return k
+    return jnp.repeat(k, n_heads // hkv, axis=1)
+
+
+def prefill(
+    params: dict,
+    tokens: jax.Array,  # [B, Tp] int32, right-padded
+    lengths: jax.Array,  # [B] int32 true lengths
+    slot: jax.Array,  # [] int32: first cache row to write (B rows)
+    config: LlamaConfig,
+    cache: dict,
+) -> tuple[jax.Array, dict]:
+    """Run the prompt through the model, writing K/V into the cache rows
+    ``slot..slot+B`` (the full pool cache is donated — never slice it
+    per request: an identity slice aliases the pool's own buffer and
+    donation would delete it); returns (last-token logits [B, V], cache)."""
+    c = config
+    b, tp = tokens.shape
+    embed = params["embed"]
+    x = embed.at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
+    cos, sin = rope_freqs(jnp.arange(tp), c.head_dim, c.rope_theta)
+
+    def layer_fn(x, layer):
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        k = _proj(layer, "wk", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        q = q.reshape(b, tp, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, tp, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, tp, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        from dstack_tpu.models.llama import apply_rope
+
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        from dstack_tpu.ops.attention import attention
+
+        o = attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, tp, c.q_dim)
+        x = x + _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        m = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        if c.n_experts:
+            from dstack_tpu.models import moe
+
+            mo, _ = moe.moe_mlp(
+                m, layer, c.n_experts, c.experts_per_token, c.capacity_factor,
+                None, None,
+            )
+        else:
+            g = _proj(layer, "w_gate", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+            u = _proj(layer, "w_up", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+            mo = _proj(
+                layer, "w_down", jax.nn.silu(g) * u,
+                "btf,fe->bte", "btf,fr->btr", "btr,re->bte",
+            )
+        return x + mo, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
+    # write the prompt K/V into the slot's cache prefix
+    start = (0, slot.astype(jnp.int32), 0, 0, 0)
+    cache = {
+        "k": jax.lax.dynamic_update_slice(cache["k"], ks, start),
+        "v": jax.lax.dynamic_update_slice(cache["v"], vs, start),
+    }
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    # only the last real token's logits matter
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    logits = jnp.einsum(
+        "be,ev->bv", last, head.astype(c.dtype), preferred_element_type=jnp.float32
+    )
+    return logits, cache
+
+
+def decode_step(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B] int32: the freshly sampled tokens
+    positions: jax.Array,  # [B] int32: where to write (== current length)
+    config: LlamaConfig,
+) -> tuple[jax.Array, dict]:
+    """One token for every slot → (logits [B, V], cache)."""
+    c = config
+    b = tokens.shape[0]
+    embed = params["embed"]
+    x = embed.at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)[:, None, :]
+    cos, sin = rope_freqs(positions, c.head_dim, c.rope_theta)  # [B, D/2]
+    batch_ix = jnp.arange(b)
+
+    def layer_fn(x, layer_and_cache):
+        layer, ck, cv = layer_and_cache  # ck/cv [B, Hkv, Tmax, D]
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        k = _proj(layer, "wk", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        q = q.reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        q = _apply_rope_batch(q, cos, sin)
+        k = _apply_rope_batch(k, cos, sin)
+        # write this token's K/V at each slot's position
+        ck = ck.at[batch_ix, :, positions].set(k[:, :, 0, :])
+        cv = cv.at[batch_ix, :, positions].set(v[:, :, 0, :])
+        # attend over the cache prefix (mask: j <= position)
+        kk = _expand_gqa(ck, c.n_heads)
+        vv = _expand_gqa(cv, c.n_heads)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, kk, preferred_element_type=jnp.float32
+        ) * (c.head_dim**-0.5)
+        mask = jnp.arange(ck.shape[2])[None, None, None, :] <= positions[:, None, None, None]
+        s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vv.dtype), vv)
+        o = o.transpose(0, 2, 1, 3).reshape(b, 1, c.q_dim)
+        x = x + _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        m = rms_norm(x, layer["mlp_norm"], c.norm_eps)
+        if c.n_experts:
+            from dstack_tpu.models import moe
+
+            mo, _ = moe.moe_mlp(
+                m, layer, c.n_experts, c.experts_per_token, c.capacity_factor,
+                None, None,
+            )
+        else:
+            g = _proj(layer, "w_gate", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+            u = _proj(layer, "w_up", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+            mo = _proj(
+                layer, "w_down", jax.nn.silu(g) * u,
+                "btf,fe->bte", "btf,fr->btr", "btr,re->bte",
+            )
+        return x + mo, (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    cache = {"k": ks, "v": vs}
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum(
+        "be,ev->bv", x[:, 0], head.astype(c.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, cache
+
+
+def sample(
+    logits: jax.Array,  # [B, V] f32
+    key: jax.Array,
+    temperature: jax.Array,  # [B]
+    top_p: jax.Array,  # [B]
+) -> jax.Array:
+    """Greedy when temperature == 0, else top-p/temperature sampling —
+    all branches computed, selected per slot (static shapes)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # top-p: mask tokens beyond the nucleus. top_p >= 1 bypasses the
+    # mask entirely — f32 cumsum over a big vocab may never reach 1.0,
+    # which would silently collapse "full distribution" to greedy.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(sorted_probs, axis=-1)
+    # smallest k with cumsum >= top_p; keep everything before it
+    cutoff_ix = jnp.argmax(cumulative >= top_p[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_ix[:, None], axis=-1)
+    masked = jnp.where(scaled >= cutoff, scaled, NEG_INF)
+    masked = jnp.where(top_p[:, None] >= 1.0, scaled, masked)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+# ---------------------------------------------------------------------------
+# the engine: slots + continuous batching
+# ---------------------------------------------------------------------------
+
+
+class InferenceEngine:
+    """Slot-based continuous batching over one compiled decode step.
+
+    Synchronous core; the OpenAI server drives it from an asyncio loop
+    (``add_request`` into a free slot, ``step`` advances all active
+    slots and reports freshly sampled tokens per slot).
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params: dict,
+        max_batch: int = 8,
+        max_seq: int = 2048,
+        seed: int = 0,
+    ):
+        self.config = config
+        self.params = params
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.cache = init_cache(config, max_batch, max_seq)
+        self._key = jax.random.key(seed)
+        # per-slot host state
+        self.lengths = [0] * max_batch  # tokens currently in cache
+        self.active = [False] * max_batch
+        self.remaining = [0] * max_batch
+        self.eos = [None] * max_batch
+        self.last_token = [0] * max_batch
+        self.temps = [0.0] * max_batch
+        self.top_ps = [1.0] * max_batch
+
+        # donate caches: decode must update the KV buffers in place, not
+        # copy ~GBs per token
+        self._prefill = jax.jit(
+            partial(prefill, config=config), donate_argnames=("cache",)
+        )
+        self._decode = jax.jit(
+            partial(decode_step, config=config), donate_argnums=(1,)
+        )
+        self._sample = jax.jit(sample)
+
+    def free_slots(self) -> list[int]:
+        return [i for i in range(self.max_batch) if not self.active[i]]
+
+    def add_request(
+        self, prompt: list[int], gen: GenParams
+    ) -> tuple[int, int]:
+        """Prefill ``prompt`` into a free slot → (slot, first sampled
+        token). Raises RuntimeError when full."""
+        free = self.free_slots()
+        if not free:
+            raise RuntimeError("no free slots")
+        # cap the generation budget by the cache, then keep as much
+        # prompt tail as fits alongside it (never less than 1 token)
+        gen.max_new_tokens = max(1, min(gen.max_new_tokens, self.max_seq - 2))
+        keep = max(1, self.max_seq - 1 - gen.max_new_tokens)
+        if len(prompt) > keep:
+            prompt = prompt[-keep:]
+        slot = free[0]
+        tp = len(prompt)
+        # pad the prompt to a power-of-two bucket: one compiled prefill
+        # per bucket instead of one per distinct length (padded-tail K/V
+        # lands beyond `lengths` and is overwritten token-by-token as
+        # decode advances — the mask never reads it)
+        bucket = 16
+        while bucket < tp:
+            bucket *= 2
+        bucket = min(bucket, self.max_seq)
+        padded = prompt + [0] * (bucket - tp)
+        # single-sequence prefill (B=1) straight into the slot's rows of
+        # the donated pool cache
+        tokens = jnp.asarray([padded], jnp.int32)
+        logits, self.cache = self._prefill(
+            self.params,
+            tokens,
+            jnp.asarray([tp], jnp.int32),
+            jnp.asarray(slot, jnp.int32),
+            cache=self.cache,
+        )
+        self._key, sub = jax.random.split(self._key)
+        tok = int(
+            self._sample(
+                logits,
+                sub,
+                jnp.asarray([gen.temperature], jnp.float32),
+                jnp.asarray([gen.top_p], jnp.float32),
+            )[0]
+        )
+        self.active[slot] = True
+        self.lengths[slot] = tp
+        self.remaining[slot] = gen.max_new_tokens - 1
+        self.eos[slot] = gen.eos_id
+        self.last_token[slot] = tok
+        self.temps[slot] = gen.temperature
+        self.top_ps[slot] = gen.top_p
+        if tok == gen.eos_id or gen.max_new_tokens <= 1:
+            # finished immediately; slot never enters the decode loop
+            self.active[slot] = False
+        return slot, tok
+
+    def step(self) -> dict[int, int]:
+        """Advance every active slot one token → {slot: sampled token}.
+        Slots that hit EOS/max tokens (or the cache end) deactivate."""
+        live = [i for i in range(self.max_batch) if self.active[i]]
+        if not live:
+            return {}
+        tokens = jnp.asarray(self.last_token, jnp.int32)
+        positions = jnp.asarray(self.lengths, jnp.int32)
+        logits, self.cache = self._decode(
+            self.params, self.cache, tokens, positions
+        )
+        self._key, sub = jax.random.split(self._key)
+        sampled = self._sample(
+            logits,
+            sub,
+            jnp.asarray(self.temps, jnp.float32),
+            jnp.asarray(self.top_ps, jnp.float32),
+        )
+        sampled = jax.device_get(sampled)
+        out: dict[int, int] = {}
+        for i in live:
+            tok = int(sampled[i])
+            self.lengths[i] += 1
+            self.last_token[i] = tok
+            out[i] = tok
+            self.remaining[i] -= 1
+            if (
+                tok == self.eos[i]
+                or self.remaining[i] <= 0
+                or self.lengths[i] >= self.max_seq - 1
+            ):
+                self.active[i] = False
+        return out
+
+    def release(self, slot: int) -> None:
+        self.active[slot] = False
+
+    def generate(self, prompt: list[int], gen: GenParams) -> list[int]:
+        """Convenience single-prompt generation (tests, CLI)."""
+        slot, tok = self.add_request(prompt, gen)
+        out = [tok]
+        if tok == gen.eos_id:
+            return out
+        while self.active[slot]:
+            step_out = self.step()
+            if slot in step_out:
+                out.append(step_out[slot])
+                if step_out[slot] == gen.eos_id:
+                    out.pop()  # eos is not part of the text
+                    break
+        self.release(slot)
+        return out

@@ -1,0 +1,367 @@
+"""OpenAI-compatible inference server over the slot engine.
+
+``python -m dstack_tpu.serve.openai_server --model llama-3-8b
+--weights w.npz --tokenizer /path`` is a runnable ``type: service``
+command on any slice the orchestrator provisions: the gateway's model
+proxy (format: openai, default prefix /v1) points straight at it.
+
+Endpoints: ``/v1/models``, ``/v1/chat/completions`` (plain + SSE
+streaming), ``/v1/completions``, ``/health``. Requests queue into the
+continuous-batching engine; one background asyncio task drives
+prefills and decode steps for all in-flight requests (the jitted step
+runs in a thread so the event loop keeps serving).
+"""
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from dstack_tpu.proxy.model_tgi import DEFAULT_CHAT_TEMPLATE, render_chat
+from dstack_tpu.serve.engine import GenParams, InferenceEngine
+from dstack_tpu.serve.tokenizer import Tokenizer, load_tokenizer
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.openai")
+
+
+class _Request:
+    def __init__(self, prompt_ids: list[int], gen: GenParams):
+        self.prompt_ids = prompt_ids
+        self.gen = gen
+        self.queue: asyncio.Queue = asyncio.Queue()  # token ids, then None
+        self.error: Optional[str] = None
+        self.cancelled = False
+
+
+class Scheduler:
+    """Bridges HTTP handlers and the synchronous engine: a background
+    task prefills pending requests into free slots and steps the engine
+    while anything is active."""
+
+    def __init__(self, engine: InferenceEngine, tokenizer: Tokenizer):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.pending: asyncio.Queue = asyncio.Queue()
+        self.by_slot: dict[int, _Request] = {}
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    async def submit(self, req: _Request) -> None:
+        await self.pending.put(req)
+
+    def cancel(self, req: _Request) -> None:
+        """Client went away: free the slot so decode stops burning steps
+        on an abandoned generation."""
+        req.cancelled = True
+        for slot, r in list(self.by_slot.items()):
+            if r is req:
+                self.engine.release(slot)
+                del self.by_slot[slot]
+
+    async def _loop(self) -> None:
+        # the loop must survive ANY engine error (bad request shapes,
+        # XLA OOM): fail the affected request(s) and keep serving
+        while True:
+            try:
+                await self._tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 - reported per request
+                logger.exception("scheduler tick failed: %s", e)
+                for slot, req in list(self.by_slot.items()):
+                    self.engine.release(slot)
+                    req.error = str(e)
+                    req.queue.put_nowait(None)
+                self.by_slot.clear()
+
+    async def _tick(self) -> None:
+        # admit pending requests while slots are free
+        while not self.pending.empty() and self.engine.free_slots():
+            req = self.pending.get_nowait()
+            if req.cancelled:
+                continue
+            try:
+                slot, first = await asyncio.to_thread(
+                    self.engine.add_request, req.prompt_ids, req.gen
+                )
+            except Exception as e:  # noqa: BLE001 - reported per request
+                logger.exception("prefill failed: %s", e)
+                req.error = str(e)
+                req.queue.put_nowait(None)
+                continue
+            if first != req.gen.eos_id:
+                req.queue.put_nowait(first)
+            if self.engine.active[slot]:
+                self.by_slot[slot] = req
+            else:
+                req.queue.put_nowait(None)  # finished at first token
+        if not self.by_slot:
+            # idle: wait for work instead of spinning
+            req = await self.pending.get()
+            await self.pending.put(req)
+            return
+        out = await asyncio.to_thread(self.engine.step)
+        for slot, tok in out.items():
+            req = self.by_slot.get(slot)
+            if req is None:
+                continue
+            if tok != req.gen.eos_id:
+                req.queue.put_nowait(tok)
+            if not self.engine.active[slot]:
+                req.queue.put_nowait(None)
+                del self.by_slot[slot]
+        await asyncio.sleep(0)
+
+
+def _gen_params(payload: dict, tokenizer: Tokenizer) -> GenParams:
+    return GenParams(
+        max_new_tokens=int(payload.get("max_tokens") or 256),
+        temperature=float(payload.get("temperature") or 0.0),
+        top_p=float(payload.get("top_p") or 1.0),
+        eos_id=tokenizer.eos_id,
+    )
+
+
+def build_app(
+    engine: InferenceEngine,
+    tokenizer: Tokenizer,
+    model_name: str,
+    chat_template: Optional[str] = None,
+) -> web.Application:
+    app = web.Application()
+    sched = Scheduler(engine, tokenizer)
+    app["scheduler"] = sched
+
+    async def on_startup(_):
+        sched.start()
+
+    async def on_cleanup(_):
+        await sched.stop()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+
+    async def health(request):
+        return web.json_response({"status": "ok", "model": model_name})
+
+    async def models(request):
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [{"id": model_name, "object": "model", "owned_by": "dstack-tpu"}],
+            }
+        )
+
+    async def _run(prompt: str, payload: dict):
+        req = _Request(tokenizer.encode(prompt), _gen_params(payload, tokenizer))
+        await sched.submit(req)
+        return req
+
+    async def chat_completions(request):
+        payload = await request.json()
+        messages = payload.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return web.json_response({"detail": "'messages' required"}, status=400)
+        prompt = render_chat(messages, chat_template or DEFAULT_CHAT_TEMPLATE)
+        req = await _run(prompt, payload)
+        completion_id = f"chatcmpl-{uuid.uuid4().hex}"
+        created = int(time.time())
+        if payload.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"}
+            )
+            await resp.prepare(request)
+            # deltas come from re-decoding the accumulated ids: per-token
+            # decode would corrupt multi-byte UTF-8 and BPE boundaries.
+            # Trailing replacement chars (split multi-byte sequences) are
+            # held back until the next token completes them.
+            ids: list[int] = []
+            sent = ""
+            try:
+                while True:
+                    tok = await req.queue.get()
+                    if tok is None:
+                        break
+                    ids.append(tok)
+                    full = tokenizer.decode(ids)
+                    while full.endswith("�"):
+                        full = full[:-1]
+                    delta = full[len(sent):]
+                    if not delta:
+                        continue
+                    sent = full
+                    chunk = {
+                        "id": completion_id,
+                        "object": "chat.completion.chunk",
+                        "created": created,
+                        "model": model_name,
+                        "choices": [
+                            {
+                                "index": 0,
+                                "delta": {"role": "assistant", "content": delta},
+                                "finish_reason": None,
+                            }
+                        ],
+                    }
+                    await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+            finally:
+                sched.cancel(req)  # no-op when finished; frees the slot on disconnect
+            final = {
+                "id": completion_id,
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": model_name,
+                "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],
+            }
+            await resp.write(b"data: " + json.dumps(final).encode() + b"\n\n")
+            await resp.write(b"data: [DONE]\n\n")
+            return resp
+        ids = []
+        try:
+            while True:
+                tok = await req.queue.get()
+                if tok is None:
+                    break
+                ids.append(tok)
+        finally:
+            sched.cancel(req)
+        if req.error:
+            return web.json_response({"detail": req.error}, status=500)
+        text = tokenizer.decode(ids)
+        return web.json_response(
+            {
+                "id": completion_id,
+                "object": "chat.completion",
+                "created": created,
+                "model": model_name,
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": text},
+                        "finish_reason": "stop" if ids else "length",
+                    }
+                ],
+                "usage": {
+                    "prompt_tokens": len(req.prompt_ids),
+                    "completion_tokens": len(ids),
+                    "total_tokens": len(req.prompt_ids) + len(ids),
+                },
+            }
+        )
+
+    async def completions(request):
+        payload = await request.json()
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, str):
+            return web.json_response({"detail": "'prompt' required"}, status=400)
+        req = await _run(prompt, payload)
+        ids = []
+        try:
+            while True:
+                tok = await req.queue.get()
+                if tok is None:
+                    break
+                ids.append(tok)
+        finally:
+            sched.cancel(req)
+        if req.error:
+            return web.json_response({"detail": req.error}, status=500)
+        return web.json_response(
+            {
+                "id": f"cmpl-{uuid.uuid4().hex}",
+                "object": "text_completion",
+                "created": int(time.time()),
+                "model": model_name,
+                "choices": [
+                    {"index": 0, "text": tokenizer.decode(ids), "finish_reason": "stop"}
+                ],
+                "usage": {
+                    "prompt_tokens": len(req.prompt_ids),
+                    "completion_tokens": len(ids),
+                    "total_tokens": len(req.prompt_ids) + len(ids),
+                },
+            }
+        )
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/v1/models", models)
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/completions", completions)
+    return app
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama-3.2-1b", help="config name (models/llama.py CONFIGS)")
+    p.add_argument("--weights", default=None, help=".npz from finetune (random init when omitted)")
+    p.add_argument("--tokenizer", default="byte", help="'byte' or a HF tokenizer path")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-seq", type=int, default=2048)
+    p.add_argument("--chat-template", default=None, help="jinja chat template override")
+    p.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. cpu); overrides sitecustomize pins",
+    )
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from dstack_tpu.models import llama
+
+    config = llama.CONFIGS[args.model]
+    params = llama.init_params(config, jax.random.key(0))
+    if args.weights:
+        import numpy as np
+
+        flat = dict(np.load(args.weights))
+        import jax.numpy as jnp
+
+        if any("/" not in k and "." in k for k in flat if k != "step"):
+            raise SystemExit(
+                f"{args.weights} looks like a LoRA adapter file "
+                "(finetune without --full); the server loads full "
+                "checkpoints — re-run finetune with --full or merge "
+                "the adapters into the base weights first"
+            )
+
+        def set_path(tree, path, value):
+            *parents, leaf = path
+            for k in parents:
+                tree = tree[k]
+            tree[leaf] = jnp.asarray(value, tree[leaf].dtype)
+
+        for key, value in flat.items():
+            if key == "step":
+                continue
+            set_path(params, key.split("/"), value)
+        logger.info("loaded %d weight arrays from %s", len(flat), args.weights)
+
+    engine = InferenceEngine(
+        config, params, max_batch=args.max_batch, max_seq=args.max_seq
+    )
+    tokenizer = load_tokenizer(args.tokenizer)
+    app = build_app(engine, tokenizer, args.model, args.chat_template)
+    logger.info("openai server: %s on :%d", args.model, args.port)
+    web.run_app(app, host="0.0.0.0", port=args.port, print=None)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

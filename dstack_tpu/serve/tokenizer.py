@@ -1,0 +1,51 @@
+"""Tokenizers for the serving engine.
+
+Real deployments point ``--tokenizer`` at a HuggingFace tokenizer
+directory (transformers is a baked-in dependency of TPU images);
+zero-egress environments and tests use the built-in byte tokenizer
+(utf-8 bytes + bos/eos), which fits any vocab ≥ 258.
+"""
+
+from typing import Optional, Protocol
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """utf-8 bytes as ids 0..255; bos=256, eos=257."""
+
+    bos_id = 256
+    eos_id = 257
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path)
+        self.bos_id = self._tok.bos_token_id or 0
+        self.eos_id = self._tok.eos_token_id or 0
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(spec: Optional[str]) -> Tokenizer:
+    if not spec or spec == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(spec)

@@ -173,6 +173,7 @@ function decodeLogEvent(ev) {
 
 const ACTIVE_STATUSES = ["running", "submitted", "provisioning", "pending"];
 let activeLogWs = null;  // at most one live log stream; closed on re-render
+let refreshTimer = null;  // at most one pending auto-refresh
 
 async function pageRunDetail(name) {
   const run = await papi("/runs/get", { run_name: name });
@@ -219,7 +220,7 @@ async function pageRunDetail(name) {
   // auto-refresh status while the run is active (render() closes the
   // previous stream before building the page again)
   if (ACTIVE_STATUSES.includes(run.status)) {
-    setTimeout(() => { if (currentRoute().arg === name) render(); }, 5000);
+    refreshTimer = setTimeout(() => { if (currentRoute().arg === name) render(); }, 5000);
   }
 
   // per-node jobs table (multi-host slices / multislice runs)
@@ -553,6 +554,7 @@ const ROUTES = {
 };
 
 async function render() {
+  if (refreshTimer) { clearTimeout(refreshTimer); refreshTimer = null; }
   if (activeLogWs) { try { activeLogWs.close(); } catch (e) {} activeLogWs = null; }
   if (!state.token) return renderLogin();
   try {

@@ -1,0 +1,115 @@
+"""Inference engine: KV-cache decode must reproduce the full forward
+exactly, slots batch continuously, and sampling behaves."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dstack_tpu.models import llama
+from dstack_tpu.serve.engine import GenParams, InferenceEngine, sample
+
+
+def _reference_greedy(params, config, prompt: list[int], n: int) -> list[int]:
+    seq = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = llama.forward(params, jnp.asarray([seq], jnp.int32), config)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        seq.append(nxt)
+    return out
+
+
+class TestDecode:
+    def setup_method(self):
+        self.config = llama.LLAMA_TINY
+        self.params = llama.init_params(self.config, jax.random.key(0))
+
+    def test_greedy_matches_full_forward(self):
+        eng = InferenceEngine(self.config, self.params, max_batch=2, max_seq=64)
+        prompt = [5, 99, 321, 7, 250, 41, 18]
+        out = eng.generate(prompt, GenParams(max_new_tokens=8, temperature=0.0))
+        assert out == _reference_greedy(self.params, self.config, prompt, 8)
+
+    def test_continuous_batching_interleaves(self):
+        """A request admitted mid-decode of another must not perturb
+        either stream (per-slot cache isolation + masks)."""
+        eng = InferenceEngine(self.config, self.params, max_batch=4, max_seq=64)
+        p1 = [10, 20, 30, 40, 50]
+        p2 = [400, 3, 77]
+        ref1 = _reference_greedy(self.params, self.config, p1, 6)
+        ref2 = _reference_greedy(self.params, self.config, p2, 6)
+
+        s1, t1 = eng.add_request(p1, GenParams(max_new_tokens=6))
+        got1 = [t1]
+        # two solo steps, then p2 joins
+        for _ in range(2):
+            got1.append(eng.step()[s1])
+        s2, t2 = eng.add_request(p2, GenParams(max_new_tokens=6))
+        got2 = [t2]
+        while eng.active[s1] or eng.active[s2]:
+            out = eng.step()
+            if s1 in out:
+                got1.append(out[s1])
+            if s2 in out:
+                got2.append(out[s2])
+        assert got1 == ref1
+        assert got2 == ref2
+
+    def test_slot_reuse_after_release(self):
+        eng = InferenceEngine(self.config, self.params, max_batch=1, max_seq=64)
+        p = [9, 8, 7]
+        a = eng.generate(p, GenParams(max_new_tokens=4))
+        b = eng.generate(p, GenParams(max_new_tokens=4))
+        assert a == b  # stale cache from run 1 must not leak into run 2
+
+    def test_eos_stops(self):
+        eng = InferenceEngine(self.config, self.params, max_batch=1, max_seq=64)
+        prompt = [5, 99, 321]
+        ref = _reference_greedy(self.params, self.config, prompt, 1)
+        out = eng.generate(
+            prompt, GenParams(max_new_tokens=10, eos_id=ref[0])
+        )
+        assert out == ref  # first token is eos -> generation ends
+
+    def test_prompt_bucketing_consistent(self):
+        """Different prompt lengths land in different pad buckets but
+        must produce identical continuations for identical content."""
+        eng = InferenceEngine(self.config, self.params, max_batch=2, max_seq=128)
+        p_short = [3, 14, 15]
+        p_long = [3, 14, 15] * 7  # crosses the 16-bucket boundary
+        assert eng.generate(p_short, GenParams(max_new_tokens=3)) == \
+            _reference_greedy(self.params, self.config, p_short, 3)
+        assert eng.generate(p_long, GenParams(max_new_tokens=3)) == \
+            _reference_greedy(self.params, self.config, p_long, 3)
+
+
+class TestSampling:
+    def test_greedy_at_zero_temperature(self):
+        logits = jnp.asarray([[0.0, 5.0, 1.0], [2.0, 0.0, -1.0]], jnp.float32)
+        out = sample(
+            logits, jax.random.key(0),
+            jnp.asarray([0.0, 0.0]), jnp.asarray([1.0, 1.0]),
+        )
+        assert list(np.asarray(out)) == [1, 0]
+
+    def test_top_p_narrow_nucleus_is_greedy(self):
+        logits = jnp.asarray([[0.0, 5.0, 1.0]], jnp.float32)
+        out = sample(
+            logits, jax.random.key(1),
+            jnp.asarray([1.0]), jnp.asarray([1e-6]),
+        )
+        assert int(out[0]) == 1
+
+    def test_sampling_valid_and_varied(self):
+        logits = jnp.zeros((1, 16), jnp.float32)  # uniform
+        seen = set()
+        for i in range(12):
+            out = sample(
+                logits, jax.random.key(i),
+                jnp.asarray([1.0]), jnp.asarray([1.0]),
+            )
+            tok = int(out[0])
+            assert 0 <= tok < 16
+            seen.add(tok)
+        assert len(seen) > 1  # actually sampling, not collapsing

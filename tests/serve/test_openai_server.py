@@ -1,0 +1,121 @@
+"""OpenAI server over the slot engine: chat + completions + streaming
+against the tiny model with the byte tokenizer."""
+
+import json
+
+import jax
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.models import llama
+from dstack_tpu.serve.engine import InferenceEngine
+from dstack_tpu.serve.openai_server import build_app
+from dstack_tpu.serve.tokenizer import ByteTokenizer, load_tokenizer
+
+
+async def _client():
+    config = llama.LLAMA_TINY
+    params = llama.init_params(config, jax.random.key(0))
+    engine = InferenceEngine(config, params, max_batch=4, max_seq=128)
+    app = build_app(engine, ByteTokenizer(), "llama-tiny")
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestOpenAIServer:
+    async def test_health_and_models(self):
+        client = await _client()
+        try:
+            r = await client.get("/health")
+            assert r.status == 200 and (await r.json())["status"] == "ok"
+            r = await client.get("/v1/models")
+            data = await r.json()
+            assert data["data"][0]["id"] == "llama-tiny"
+        finally:
+            await client.close()
+
+    async def test_chat_completions(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "llama-tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 6,
+                },
+            )
+            assert r.status == 200
+            d = await r.json()
+            assert d["object"] == "chat.completion"
+            assert d["choices"][0]["message"]["role"] == "assistant"
+            assert d["usage"]["completion_tokens"] > 0
+            assert d["usage"]["total_tokens"] == (
+                d["usage"]["prompt_tokens"] + d["usage"]["completion_tokens"]
+            )
+        finally:
+            await client.close()
+
+    async def test_chat_streaming(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "llama-tiny",
+                    "messages": [{"role": "user", "content": "stream please"}],
+                    "max_tokens": 5,
+                    "stream": True,
+                },
+            )
+            assert r.status == 200
+            body = await r.read()
+            chunks = [
+                json.loads(line[len(b"data: "):])
+                for line in body.split(b"\n\n")
+                if line.startswith(b"data: ") and not line.endswith(b"[DONE]")
+            ]
+            assert chunks, body
+            assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+            assert body.rstrip().endswith(b"data: [DONE]")
+        finally:
+            await client.close()
+
+    async def test_completions_and_concurrency(self):
+        import asyncio
+
+        client = await _client()
+        try:
+            async def one(text):
+                r = await client.post(
+                    "/v1/completions",
+                    json={"prompt": text, "max_tokens": 4},
+                )
+                assert r.status == 200
+                return await r.json()
+
+            # concurrent requests share the engine via slots
+            results = await asyncio.gather(one("aaa"), one("bbb"), one("ccc"))
+            for d in results:
+                assert d["object"] == "text_completion"
+                assert d["usage"]["completion_tokens"] > 0
+        finally:
+            await client.close()
+
+    async def test_bad_requests(self):
+        client = await _client()
+        try:
+            r = await client.post("/v1/chat/completions", json={})
+            assert r.status == 400
+            r = await client.post("/v1/completions", json={"prompt": 42})
+            assert r.status == 400
+        finally:
+            await client.close()
+
+
+class TestTokenizers:
+    def test_byte_roundtrip(self):
+        t = load_tokenizer("byte")
+        ids = t.encode("héllo ✓")
+        assert t.decode(ids) == "héllo ✓"
+        assert t.eos_id == 257
