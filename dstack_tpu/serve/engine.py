@@ -46,8 +46,14 @@ class GenParams:
 # ---------------------------------------------------------------------------
 
 
-def init_cache(config: LlamaConfig, max_batch: int, max_seq: int) -> dict:
-    """Preallocated KV cache: k/v [L, B, Hkv, T_max, D] in model dtype."""
+def init_cache(
+    config: LlamaConfig,
+    max_batch: int,
+    max_seq: int,
+    mesh=None,
+) -> dict:
+    """Preallocated KV cache: k/v [L, B, Hkv, T_max, D] in model dtype,
+    KV heads sharded over ``tp`` when serving on a mesh."""
     shape = (
         config.n_layers,
         max_batch,
@@ -55,10 +61,20 @@ def init_cache(config: LlamaConfig, max_batch: int, max_seq: int) -> dict:
         max_seq,
         config.head_dim,
     )
-    return {
-        "k": jnp.zeros(shape, config.dtype),
-        "v": jnp.zeros(shape, config.dtype),
-    }
+    if mesh is None:
+        return {
+            "k": jnp.zeros(shape, config.dtype),
+            "v": jnp.zeros(shape, config.dtype),
+        }
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P(None, None, "tp", None, None))
+    # allocate directly sharded: a host-side zeros + device_put would
+    # materialize the full cache on one chip first
+    zeros = jax.jit(
+        lambda: jnp.zeros(shape, config.dtype), out_shardings=sh
+    )
+    return {"k": zeros(), "v": zeros()}
 
 
 # ---------------------------------------------------------------------------
@@ -254,6 +270,19 @@ def sample(
 # ---------------------------------------------------------------------------
 
 
+def sharded_params(config: LlamaConfig, mesh, seed: int = 0) -> dict:
+    """Initialize params directly under the mesh's shardings — the full
+    tree never materializes on one chip (required for models bigger
+    than a single device's HBM)."""
+    from dstack_tpu.parallel.sharding import default_rules, tree_shardings
+
+    shardings = tree_shardings(llama.param_specs(config), mesh, default_rules())
+    init = jax.jit(
+        lambda key: llama.init_params(config, key), out_shardings=shardings
+    )
+    return init(jax.random.key(seed))
+
+
 class InferenceEngine:
     """Slot-based continuous batching over one compiled decode step.
 
@@ -269,12 +298,33 @@ class InferenceEngine:
         max_batch: int = 8,
         max_seq: int = 2048,
         seed: int = 0,
+        mesh=None,
     ):
+        """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
+        params shard per the model's logical rules (heads/mlp/vocab over
+        tp), the KV cache shards over KV heads, and GSPMD inserts the
+        per-layer psums (how a 70B fits a v5e-16: BASELINE.md serving
+        sizing). Requires n_kv_heads % tp == 0. For models bigger than
+        one chip, pass params ALREADY sharded over this mesh
+        (:func:`sharded_params`) — device_put here is a convenience for
+        single-chip-sized trees."""
         self.config = config
+        if mesh is not None:
+            from dstack_tpu.parallel.sharding import default_rules, tree_shardings
+
+            tp = mesh.shape.get("tp", 1)
+            if tp > 1 and config.n_kv_heads % tp != 0:
+                raise ValueError(
+                    f"n_kv_heads {config.n_kv_heads} not divisible by tp={tp}"
+                )
+            shardings = tree_shardings(
+                llama.param_specs(config), mesh, default_rules()
+            )
+            params = jax.device_put(params, shardings)
         self.params = params
         self.max_batch = max_batch
         self.max_seq = max_seq
-        self.cache = init_cache(config, max_batch, max_seq)
+        self.cache = init_cache(config, max_batch, max_seq, mesh=mesh)
         self._key = jax.random.key(seed)
         # per-slot host state
         self.lengths = [0] * max_batch  # tokens currently in cache
@@ -284,6 +334,7 @@ class InferenceEngine:
         self.last_token = [0] * max_batch
         self.temps = [0.0] * max_batch
         self.top_ps = [1.0] * max_batch
+        self.finish_reason = [None] * max_batch  # "stop" | "length" once done
 
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
@@ -349,9 +400,11 @@ class InferenceEngine:
         self.last_token[slot] = tok
         self.temps[slot] = gen.temperature
         self.top_ps[slot] = gen.top_p
+        self.finish_reason[slot] = None
         if tok == gen.eos_id or gen.max_new_tokens <= 1:
             # finished immediately; slot never enters the decode loop
             self.active[slot] = False
+            self.finish_reason[slot] = "stop" if tok == gen.eos_id else "length"
         return slot, tok
 
     def step(self) -> dict[int, int]:
@@ -380,12 +433,12 @@ class InferenceEngine:
             self.last_token[i] = tok
             out[i] = tok
             self.remaining[i] -= 1
-            if (
-                tok == self.eos[i]
-                or self.remaining[i] <= 0
-                or self.lengths[i] >= self.max_seq - 1
-            ):
+            if tok == self.eos[i]:
                 self.active[i] = False
+                self.finish_reason[i] = "stop"
+            elif self.remaining[i] <= 0 or self.lengths[i] >= self.max_seq - 1:
+                self.active[i] = False
+                self.finish_reason[i] = "length"
         return out
 
     def release(self, slot: int) -> None:

@@ -35,6 +35,7 @@ class _Request:
         self.gen = gen
         self.queue: asyncio.Queue = asyncio.Queue()  # token ids, then None
         self.error: Optional[str] = None
+        self.finish_reason: Optional[str] = None
         self.cancelled = False
 
 
@@ -100,11 +101,16 @@ class Scheduler:
                 req.error = str(e)
                 req.queue.put_nowait(None)
                 continue
+            if req.cancelled:
+                # client left while prefill compiled/ran: free the slot
+                self.engine.release(slot)
+                continue
             if first != req.gen.eos_id:
                 req.queue.put_nowait(first)
             if self.engine.active[slot]:
                 self.by_slot[slot] = req
             else:
+                req.finish_reason = self.engine.finish_reason[slot]
                 req.queue.put_nowait(None)  # finished at first token
         if not self.by_slot:
             # idle: wait for work instead of spinning
@@ -119,6 +125,7 @@ class Scheduler:
             if tok != req.gen.eos_id:
                 req.queue.put_nowait(tok)
             if not self.engine.active[slot]:
+                req.finish_reason = self.engine.finish_reason[slot]
                 req.queue.put_nowait(None)
                 del self.by_slot[slot]
         await asyncio.sleep(0)
@@ -169,11 +176,24 @@ def build_app(
         return req
 
     async def chat_completions(request):
-        payload = await request.json()
+        from dstack_tpu.proxy.model_tgi import TGIAdapterError
+
+        try:
+            payload = await request.json()
+        except Exception:
+            return web.json_response({"detail": "invalid JSON body"}, status=400)
         messages = payload.get("messages")
-        if not isinstance(messages, list) or not messages:
-            return web.json_response({"detail": "'messages' required"}, status=400)
-        prompt = render_chat(messages, chat_template or DEFAULT_CHAT_TEMPLATE)
+        if not isinstance(messages, list) or not messages or not all(
+            isinstance(m, dict) and isinstance(m.get("content"), str)
+            for m in messages
+        ):
+            return web.json_response(
+                {"detail": "'messages' must be [{role, content}, ...]"}, status=400
+            )
+        try:
+            prompt = render_chat(messages, chat_template or DEFAULT_CHAT_TEMPLATE)
+        except TGIAdapterError as e:
+            return web.json_response({"detail": str(e)}, status=e.status)
         req = await _run(prompt, payload)
         completion_id = f"chatcmpl-{uuid.uuid4().hex}"
         created = int(time.time())
@@ -217,12 +237,24 @@ def build_app(
                     await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
             finally:
                 sched.cancel(req)  # no-op when finished; frees the slot on disconnect
+            if req.error:
+                await resp.write(
+                    b"data: " + json.dumps({"error": req.error}).encode() + b"\n\n"
+                )
+                await resp.write(b"data: [DONE]\n\n")
+                return resp
             final = {
                 "id": completion_id,
                 "object": "chat.completion.chunk",
                 "created": created,
                 "model": model_name,
-                "choices": [{"index": 0, "delta": {}, "finish_reason": "stop"}],
+                "choices": [
+                    {
+                        "index": 0,
+                        "delta": {},
+                        "finish_reason": req.finish_reason or "stop",
+                    }
+                ],
             }
             await resp.write(b"data: " + json.dumps(final).encode() + b"\n\n")
             await resp.write(b"data: [DONE]\n\n")
@@ -249,7 +281,7 @@ def build_app(
                     {
                         "index": 0,
                         "message": {"role": "assistant", "content": text},
-                        "finish_reason": "stop" if ids else "length",
+                        "finish_reason": req.finish_reason or "stop",
                     }
                 ],
                 "usage": {
@@ -261,7 +293,10 @@ def build_app(
         )
 
     async def completions(request):
-        payload = await request.json()
+        try:
+            payload = await request.json()
+        except Exception:
+            return web.json_response({"detail": "invalid JSON body"}, status=400)
         prompt = payload.get("prompt")
         if not isinstance(prompt, str):
             return web.json_response({"detail": "'prompt' required"}, status=400)
@@ -284,7 +319,11 @@ def build_app(
                 "created": int(time.time()),
                 "model": model_name,
                 "choices": [
-                    {"index": 0, "text": tokenizer.decode(ids), "finish_reason": "stop"}
+                    {
+                        "index": 0,
+                        "text": tokenizer.decode(ids),
+                        "finish_reason": req.finish_reason or "stop",
+                    }
                 ],
                 "usage": {
                     "prompt_tokens": len(req.prompt_ids),
@@ -314,7 +353,15 @@ def main(argv=None) -> int:
         "--platform", default=None,
         help="force a jax platform (e.g. cpu); overrides sitecustomize pins",
     )
+    p.add_argument(
+        "--tp", type=int, default=0,
+        help="tensor-parallel ways (default: all local devices)",
+    )
     args = p.parse_args(argv)
+
+    from dstack_tpu.utils.logging import configure_logging
+
+    configure_logging()
 
     import jax
 
@@ -324,7 +371,20 @@ def main(argv=None) -> int:
     from dstack_tpu.models import llama
 
     config = llama.CONFIGS[args.model]
-    params = llama.init_params(config, jax.random.key(0))
+    tp = args.tp or len(jax.devices())
+    mesh = None
+    if tp > 1:
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=tp))
+        logger.info("tensor-parallel serving over %d devices", tp)
+    if mesh is not None:
+        # init directly under the mesh shardings: a 70B never fits chip 0
+        from dstack_tpu.serve.engine import sharded_params
+
+        params = sharded_params(config, mesh)
+    else:
+        params = llama.init_params(config, jax.random.key(0))
     if args.weights:
         import numpy as np
 
@@ -343,7 +403,10 @@ def main(argv=None) -> int:
             *parents, leaf = path
             for k in parents:
                 tree = tree[k]
-            tree[leaf] = jnp.asarray(value, tree[leaf].dtype)
+            old = tree[leaf]
+            tree[leaf] = jax.device_put(
+                jnp.asarray(value, old.dtype), old.sharding
+            )
 
         for key, value in flat.items():
             if key == "step":
@@ -352,7 +415,7 @@ def main(argv=None) -> int:
         logger.info("loaded %d weight arrays from %s", len(flat), args.weights)
 
     engine = InferenceEngine(
-        config, params, max_batch=args.max_batch, max_seq=args.max_seq
+        config, params, max_batch=args.max_batch, max_seq=args.max_seq, mesh=mesh
     )
     tokenizer = load_tokenizer(args.tokenizer)
     app = build_app(engine, tokenizer, args.model, args.chat_template)

@@ -10,8 +10,8 @@ from typing import Optional, Protocol
 
 
 class Tokenizer(Protocol):
-    bos_id: int
-    eos_id: int
+    bos_id: Optional[int]
+    eos_id: Optional[int]
 
     def encode(self, text: str) -> list[int]: ...
     def decode(self, ids: list[int]) -> str: ...
@@ -35,8 +35,10 @@ class HFTokenizer:
         from transformers import AutoTokenizer
 
         self._tok = AutoTokenizer.from_pretrained(path)
-        self.bos_id = self._tok.bos_token_id or 0
-        self.eos_id = self._tok.eos_token_id or 0
+        # keep None when the tokenizer defines no bos/eos: coercing to 0
+        # would turn a real vocab token into an implicit stop token
+        self.bos_id = self._tok.bos_token_id
+        self.eos_id = self._tok.eos_token_id
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text, add_special_tokens=False)

@@ -113,3 +113,34 @@ class TestSampling:
             assert 0 <= tok < 16
             seen.add(tok)
         assert len(seen) > 1  # actually sampling, not collapsing
+
+
+class TestTensorParallelServing:
+    def test_tp_matches_single_device(self):
+        """tp=2 sharded serving must reproduce the unsharded greedy
+        stream exactly (params sharded over heads/mlp, cache over KV
+        heads, psums inserted by GSPMD)."""
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        config = llama.LLAMA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        prompt = [11, 22, 33, 44]
+        ref = InferenceEngine(config, params, max_batch=2, max_seq=64).generate(
+            prompt, GenParams(max_new_tokens=5)
+        )
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=2))
+        eng = InferenceEngine(
+            config, params, max_batch=2, max_seq=64, mesh=mesh
+        )
+        assert eng.generate(prompt, GenParams(max_new_tokens=5)) == ref
+
+    def test_tp_indivisible_kv_heads_rejected(self):
+        import pytest
+
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        config = llama.LLAMA_TINY  # 2 kv heads
+        params = llama.init_params(config, jax.random.key(0))
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=4))
+        with pytest.raises(ValueError):
+            InferenceEngine(config, params, mesh=mesh)

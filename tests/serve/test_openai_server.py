@@ -76,7 +76,8 @@ class TestOpenAIServer:
                 if line.startswith(b"data: ") and not line.endswith(b"[DONE]")
             ]
             assert chunks, body
-            assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+            # truncated by max_tokens: the OpenAI-defined "length" case
+            assert chunks[-1]["choices"][0]["finish_reason"] == "length"
             assert body.rstrip().endswith(b"data: [DONE]")
         finally:
             await client.close()
@@ -119,3 +120,38 @@ class TestTokenizers:
         ids = t.encode("héllo ✓")
         assert t.decode(ids) == "héllo ✓"
         assert t.eos_id == 257
+
+
+class TestFinishReason:
+    async def test_length_when_truncated_by_max_tokens(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "llama-tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 3,
+                },
+            )
+            d = await r.json()
+            # random tiny model essentially never emits eos in 3 tokens
+            assert d["choices"][0]["finish_reason"] == "length"
+        finally:
+            await client.close()
+
+    async def test_malformed_messages_get_400(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "m", "messages": [42]},
+            )
+            assert r.status == 400
+            r = await client.post(
+                "/v1/chat/completions", data=b"{not json",
+                headers={"Content-Type": "application/json"},
+            )
+            assert r.status == 400
+        finally:
+            await client.close()
