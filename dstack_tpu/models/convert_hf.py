@@ -1,0 +1,255 @@
+"""HuggingFace checkpoint → dstack_tpu parameter pytree.
+
+Bridges the serving/fine-tune paths to real released weights: point
+``load_checkpoint`` at a ``save_pretrained`` directory (safetensors or
+torch ``.bin`` shards) and get back ``(LlamaConfig, params)`` ready for
+:func:`dstack_tpu.models.llama.forward`, the serve engine, and the
+finetune driver.
+
+Supported ``model_type``s: ``llama``, ``qwen2``, ``mistral``, ``gemma``,
+``gemma2``, ``mixtral``. Each maps onto :class:`LlamaConfig` family
+flags (qkv_bias / sliding_window / norm_offset / softcaps / MoE) — the
+architecture deltas live in the config, not in per-family model code.
+
+The reference framework never loads weights itself (user containers do);
+this module is part of the in-repo inference/training engine that makes
+``type: service`` self-contained.
+
+Layout notes:
+- HF ``*_proj.weight`` is [out, in] (torch Linear); our kernels want
+  [in, out] → transpose.
+- HF llama-family checkpoints already use the rotate-half RoPE
+  convention (no head permutation needed, unlike Meta's originals).
+- Our layer stacks are scanned: every per-layer leaf gains a leading
+  ``[n_layers, ...]`` dim.
+"""
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from dstack_tpu.models.llama import LlamaConfig
+
+__all__ = ["config_from_hf", "convert_state_dict", "load_checkpoint"]
+
+
+def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
+    """HF ``config.json`` dict → :class:`LlamaConfig`."""
+    mt = hf.get("model_type", "llama")
+    hidden = hf["hidden_size"]
+    n_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or hidden // n_heads
+    if hf.get("attention_bias") and mt not in ("qwen2",):
+        # q/k/v/o biases exist in the checkpoint but our llama/mistral
+        # paths would silently drop them — refuse rather than mis-serve
+        raise ValueError(
+            f"{mt} checkpoint sets attention_bias=true, which this "
+            "converter only supports for qwen2"
+        )
+    common = dict(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hidden,
+        n_layers=hf["num_hidden_layers"],
+        n_heads=n_heads,
+        n_kv_heads=hf.get("num_key_value_heads", n_heads),
+        head_dim=head_dim,
+        intermediate_size=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        norm_eps=hf.get("rms_norm_eps", 1e-6),
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        rope_scaling=_rope_scaling_from_hf(hf),
+        dtype=dtype,
+    )
+    if mt == "llama":
+        return LlamaConfig(**common)
+    if mt == "qwen2":
+        # Qwen2 puts biases on q/k/v only (attention_bias is not in its
+        # config; the arch always has them)
+        return LlamaConfig(**common, qkv_bias=True)
+    if mt == "mistral":
+        return LlamaConfig(**common, sliding_window=hf.get("sliding_window") or 0)
+    if mt == "gemma":
+        return LlamaConfig(
+            **{**common, "tie_embeddings": True},
+            hidden_act="gelu_tanh",
+            norm_offset=True,
+            embed_scale=True,
+        )
+    if mt == "gemma2":
+        return LlamaConfig(
+            **{**common, "tie_embeddings": True},
+            hidden_act="gelu_tanh",
+            norm_offset=True,
+            embed_scale=True,
+            post_norms=True,
+            sliding_window=hf.get("sliding_window") or 0,
+            sliding_pattern=2,  # even layers sliding, odd global
+            attn_softcap=hf.get("attn_logit_softcapping") or 0.0,
+            logit_softcap=hf.get("final_logit_softcapping") or 0.0,
+            attn_scale=float(hf["query_pre_attn_scalar"]) ** -0.5
+            if hf.get("query_pre_attn_scalar")
+            else None,
+        )
+    if mt == "mixtral":
+        return LlamaConfig(
+            **common,
+            n_experts=hf["num_local_experts"],
+            experts_per_token=hf.get("num_experts_per_tok", 2),
+            router_renorm=True,
+        )
+    raise ValueError(f"unsupported HF model_type {mt!r}")
+
+
+def _rope_scaling_from_hf(hf: dict) -> Optional[tuple]:
+    """HF ``rope_scaling`` → :class:`LlamaConfig` tuple (llama3 only).
+
+    Llama-3.1/3.2 checkpoints rescale rope frequencies; ignoring the
+    field would load without error but generate silently-degraded text,
+    so unknown scaling types are a hard error.
+    """
+    rs = hf.get("rope_scaling")
+    if not rs:
+        return None
+    rope_type = rs.get("rope_type") or rs.get("type")
+    if rope_type in (None, "default"):
+        return None
+    if rope_type == "llama3":
+        return (
+            float(rs["factor"]),
+            float(rs["low_freq_factor"]),
+            float(rs["high_freq_factor"]),
+            float(rs["original_max_position_embeddings"]),
+        )
+    raise ValueError(f"unsupported rope_scaling type {rope_type!r}")
+
+
+def _to_np(t) -> np.ndarray:
+    """Torch tensor / numpy / jax array → numpy (bf16 via float32)."""
+    if isinstance(t, np.ndarray):
+        return t
+    if hasattr(t, "detach"):  # torch
+        t = t.detach()
+        if str(t.dtype) == "torch.bfloat16":
+            t = t.float()
+        return t.cpu().numpy()
+    return np.asarray(t)
+
+
+def convert_state_dict(
+    sd: dict, config: LlamaConfig, model_type: str = "llama"
+) -> dict:
+    """Flat HF state dict (name → tensor) → our nested params pytree.
+
+    Accepts torch tensors, numpy, or jax arrays as values; returns
+    ``config.dtype`` **host (numpy) arrays** with scanned ``[L, ...]``
+    layer stacks — staying on host lets the caller ``jax.device_put``
+    the tree straight into sharded device buffers (a 70B must never
+    materialize on one chip; ml_dtypes provides the numpy bfloat16).
+    """
+    c = config
+    dt = c.dtype
+
+    def get(name):
+        if name not in sd:
+            raise KeyError(
+                f"missing weight {name!r} (have e.g. {sorted(sd)[:5]})"
+            )
+        return _to_np(sd[name])
+
+    def stack(fmt, transpose=False):
+        mats = []
+        for i in range(c.n_layers):
+            m = get(fmt.format(i=i))
+            mats.append(m.T if transpose else m)
+        return np.asarray(np.stack(mats), dt)
+
+    P = "model.layers.{i}."
+    gemma2 = model_type == "gemma2"
+    layers = {
+        "attn_norm": stack(P + "input_layernorm.weight"),
+        "wq": stack(P + "self_attn.q_proj.weight", transpose=True),
+        "wk": stack(P + "self_attn.k_proj.weight", transpose=True),
+        "wv": stack(P + "self_attn.v_proj.weight", transpose=True),
+        "wo": stack(P + "self_attn.o_proj.weight", transpose=True),
+        # Gemma2's post_attention_layernorm norms the attention *output*;
+        # everywhere else it is the pre-MLP norm
+        "mlp_norm": stack(
+            P + ("pre_feedforward_layernorm.weight" if gemma2
+                 else "post_attention_layernorm.weight")
+        ),
+    }
+    if c.qkv_bias:
+        layers["bq"] = stack(P + "self_attn.q_proj.bias")
+        layers["bk"] = stack(P + "self_attn.k_proj.bias")
+        layers["bv"] = stack(P + "self_attn.v_proj.bias")
+    if c.post_norms:
+        layers["attn_post_norm"] = stack(P + "post_attention_layernorm.weight")
+        layers["mlp_post_norm"] = stack(P + "post_feedforward_layernorm.weight")
+    if c.n_experts:
+        layers["w_router"] = stack(
+            P + "block_sparse_moe.gate.weight", transpose=True
+        )
+        for ours, theirs in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
+            per_layer = []
+            for i in range(c.n_layers):
+                per_layer.append(
+                    np.stack([
+                        get(f"model.layers.{i}.block_sparse_moe.experts.{e}.{theirs}.weight").T
+                        for e in range(c.n_experts)
+                    ])
+                )
+            layers[ours] = np.asarray(np.stack(per_layer), dt)
+    else:
+        layers["w_gate"] = stack(P + "mlp.gate_proj.weight", transpose=True)
+        layers["w_up"] = stack(P + "mlp.up_proj.weight", transpose=True)
+        layers["w_down"] = stack(P + "mlp.down_proj.weight", transpose=True)
+
+    params = {
+        "embed": np.asarray(get("model.embed_tokens.weight"), dt),
+        "layers": layers,
+        "final_norm": np.asarray(get("model.norm.weight"), dt),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = np.asarray(get("lm_head.weight").T, dt)
+    return params
+
+
+def _load_raw_state_dict(path: Path) -> dict:
+    """Read all weight shards in a ``save_pretrained`` directory."""
+    safes = sorted(path.glob("*.safetensors"))
+    if safes:
+        from safetensors import safe_open
+
+        sd = {}
+        for f in safes:
+            # framework="pt": torch tensors carry bf16 losslessly;
+            # _to_np upcasts on conversion
+            with safe_open(f, framework="pt") as st:
+                for name in st.keys():
+                    sd[name] = st.get_tensor(name)
+        return sd
+    bins = sorted(path.glob("pytorch_model*.bin"))
+    if bins:
+        import torch
+
+        sd = {}
+        for f in bins:
+            sd.update(torch.load(f, map_location="cpu", weights_only=True))
+        return sd
+    raise FileNotFoundError(f"no *.safetensors or pytorch_model*.bin in {path}")
+
+
+def load_checkpoint(
+    path: str, dtype: Any = jnp.bfloat16
+) -> tuple[LlamaConfig, dict]:
+    """Load an HF ``save_pretrained`` directory → (config, params)."""
+    p = Path(path)
+    hf = json.loads((p / "config.json").read_text())
+    config = config_from_hf(hf, dtype=dtype)
+    sd = _load_raw_state_dict(p)
+    params = convert_state_dict(sd, config, hf.get("model_type", "llama"))
+    return config, params

@@ -13,6 +13,7 @@ reference ships torch examples instead — examples/fine-tuning).
 """
 
 import dataclasses
+import functools
 import math
 from dataclasses import dataclass
 from typing import Any, Optional
@@ -47,10 +48,36 @@ class LlamaConfig:
     capacity_factor: float = 1.25
     router_balance_coef: float = 0.01
     router_z_coef: float = 1e-3
+    router_renorm: bool = False  # Mixtral: renormalize top-k gates
+    # --- model-family deltas (all default to Llama behavior) ---
+    qkv_bias: bool = False  # Qwen2: bias on q/k/v projections
+    sliding_window: int = 0  # Mistral/Gemma2: 0 = full attention
+    # every `sliding_pattern` layers the LAST is global, the rest use the
+    # sliding window (Gemma2: pattern=2 → layers 0,2,… sliding); 0/1 =
+    # uniform window on all layers
+    sliding_pattern: int = 0
+    hidden_act: str = "silu"  # "silu" | "gelu_tanh" (Gemma)
+    norm_offset: bool = False  # Gemma RMSNorm scales by (1 + w)
+    embed_scale: bool = False  # Gemma multiplies embeddings by sqrt(H)
+    post_norms: bool = False  # Gemma2 sandwich norms around attn/mlp
+    attn_softcap: float = 0.0  # Gemma2 tanh soft-cap on attention scores
+    logit_softcap: float = 0.0  # Gemma2 tanh soft-cap on final logits
+    attn_scale: Optional[float] = None  # override 1/sqrt(head_dim)
+    # Llama-3.1+ rope scaling: (factor, low_freq_factor,
+    # high_freq_factor, original_max_position_embeddings); None = plain
+    # rope_theta frequencies
+    rope_scaling: Optional[tuple] = None
 
     @property
     def q_dim(self) -> int:
         return self.n_heads * self.head_dim
+
+    @property
+    def attention_scale(self) -> float:
+        return (
+            self.attn_scale if self.attn_scale is not None
+            else self.head_dim**-0.5
+        )
 
     @property
     def kv_dim(self) -> int:
@@ -63,6 +90,8 @@ class LlamaConfig:
             h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
             + n_mlp * 3 * h * self.intermediate_size + 2 * h
             + (h * self.n_experts if self.n_experts else 0)
+            + (self.q_dim + 2 * self.kv_dim if self.qkv_bias else 0)
+            + (2 * h if self.post_norms else 0)
         )
         out = 0 if self.tie_embeddings else e
         return e + self.n_layers * per_layer + h + out
@@ -110,6 +139,32 @@ MOE_TINY = LlamaConfig(  # for tests / virtual meshes
     head_dim=32, intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
     remat=False, n_experts=4, experts_per_token=2, capacity_factor=2.0,
 )
+# Model families beyond Llama: the architecture deltas are config flags
+# (models/convert_hf.py maps HF checkpoints onto them)
+QWEN25_7B = LlamaConfig(
+    vocab_size=152064, hidden_size=3584, n_layers=28, n_heads=28,
+    n_kv_heads=4, head_dim=128, intermediate_size=18944, rope_theta=1e6,
+    norm_eps=1e-6, max_seq_len=32768, qkv_bias=True,
+)
+MISTRAL_7B = LlamaConfig(
+    vocab_size=32000, hidden_size=4096, n_layers=32, n_heads=32,
+    n_kv_heads=8, head_dim=128, intermediate_size=14336, rope_theta=10000.0,
+    sliding_window=4096,
+)
+GEMMA_2B = LlamaConfig(
+    vocab_size=256000, hidden_size=2048, n_layers=18, n_heads=8,
+    n_kv_heads=1, head_dim=256, intermediate_size=16384, rope_theta=10000.0,
+    norm_eps=1e-6, tie_embeddings=True, hidden_act="gelu_tanh",
+    norm_offset=True, embed_scale=True,
+)
+GEMMA2_2B = LlamaConfig(
+    vocab_size=256000, hidden_size=2304, n_layers=26, n_heads=8,
+    n_kv_heads=4, head_dim=256, intermediate_size=9216, rope_theta=10000.0,
+    norm_eps=1e-6, tie_embeddings=True, hidden_act="gelu_tanh",
+    norm_offset=True, embed_scale=True, post_norms=True,
+    sliding_window=4096, sliding_pattern=2,
+    attn_softcap=50.0, logit_softcap=30.0, attn_scale=256.0**-0.5,
+)
 
 CONFIGS = {
     "llama-3-8b": LLAMA_3_8B,
@@ -119,6 +174,10 @@ CONFIGS = {
     "llama-tiny": LLAMA_TINY,
     "mixtral-8x7b": MIXTRAL_8X7B,
     "moe-tiny": MOE_TINY,
+    "qwen-2.5-7b": QWEN25_7B,
+    "mistral-7b": MISTRAL_7B,
+    "gemma-2b": GEMMA_2B,
+    "gemma-2-2b": GEMMA2_2B,
 }
 
 
@@ -152,6 +211,13 @@ def param_specs(config: LlamaConfig) -> dict:
         },
         "final_norm": (None,),
     }
+    if config.qkv_bias:
+        specs["layers"]["bq"] = L + ("heads",)
+        specs["layers"]["bk"] = L + ("kv_heads",)
+        specs["layers"]["bv"] = L + ("kv_heads",)
+    if config.post_norms:
+        specs["layers"]["attn_post_norm"] = L + (None,)
+        specs["layers"]["mlp_post_norm"] = L + (None,)
     if not config.tie_embeddings:
         specs["lm_head"] = ("embed_fsdp", "vocab")
     return specs
@@ -166,11 +232,15 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     def normal(key, shape, scale=std):
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
 
+    def norm_init(shape):
+        # Gemma-style norms scale by (1 + w): identity init is w = 0
+        return (jnp.zeros if c.norm_offset else jnp.ones)(shape, dt)
+
     L = c.n_layers
     if c.n_experts:
         E = c.n_experts
         mlp = {
-            "mlp_norm": jnp.ones((L, c.hidden_size), dt),
+            "mlp_norm": norm_init((L, c.hidden_size)),
             "w_router": normal(
                 jax.random.fold_in(key, 7), (L, c.hidden_size, E)
             ),
@@ -182,7 +252,7 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         }
     else:
         mlp = {
-            "mlp_norm": jnp.ones((L, c.hidden_size), dt),
+            "mlp_norm": norm_init((L, c.hidden_size)),
             "w_gate": normal(k[5], (L, c.hidden_size, c.intermediate_size)),
             "w_up": normal(k[6], (L, c.hidden_size, c.intermediate_size)),
             "w_down": normal(k[7], (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)),
@@ -190,29 +260,112 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     params = {
         "embed": normal(k[0], (c.vocab_size, c.hidden_size)),
         "layers": {
-            "attn_norm": jnp.ones((L, c.hidden_size), dt),
+            "attn_norm": norm_init((L, c.hidden_size)),
             "wq": normal(k[1], (L, c.hidden_size, c.q_dim)),
             "wk": normal(k[2], (L, c.hidden_size, c.kv_dim)),
             "wv": normal(k[3], (L, c.hidden_size, c.kv_dim)),
             "wo": normal(k[4], (L, c.q_dim, c.hidden_size), std / math.sqrt(2 * L)),
             **mlp,
         },
-        "final_norm": jnp.ones((c.hidden_size,), dt),
+        "final_norm": norm_init((c.hidden_size,)),
     }
+    if c.qkv_bias:
+        params["layers"]["bq"] = jnp.zeros((L, c.q_dim), dt)
+        params["layers"]["bk"] = jnp.zeros((L, c.kv_dim), dt)
+        params["layers"]["bv"] = jnp.zeros((L, c.kv_dim), dt)
+    if c.post_norms:
+        params["layers"]["attn_post_norm"] = norm_init((L, c.hidden_size))
+        params["layers"]["mlp_post_norm"] = norm_init((L, c.hidden_size))
     if not c.tie_embeddings:
         params["lm_head"] = normal(jax.random.fold_in(key, 99), (c.hidden_size, c.vocab_size))
     return params
 
 
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, w: jax.Array, eps: float, offset: bool = False
+) -> jax.Array:
     x32 = x.astype(jnp.float32)
     rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    if offset:  # Gemma convention: stored weight is (scale - 1)
+        w = 1.0 + w.astype(jnp.float32)
+        return ((x32 * rms) * w).astype(x.dtype)
     return (x32 * rms).astype(x.dtype) * w
 
 
-def rope_freqs(positions: jax.Array, head_dim: int, theta: float) -> tuple[jax.Array, jax.Array]:
-    """positions [T] → (cos, sin) each [T, head_dim//2], f32."""
+def act_fn(config: "LlamaConfig"):
+    if config.hidden_act == "silu":
+        return jax.nn.silu
+    if config.hidden_act == "gelu_tanh":
+        return functools.partial(jax.nn.gelu, approximate=True)
+    raise ValueError(f"unknown hidden_act {config.hidden_act!r}")
+
+
+def grouped_scan_layout(config: "LlamaConfig", xs: dict):
+    """→ (g, windows, xs') for scanning mixed sliding/global layers.
+
+    g == 1: uniform window, scan ``xs`` as-is. g > 1 (Gemma2): every
+    scan step runs ``g`` sublayers with static windows ``windows[:g]``;
+    the stacked [L, ...] leaves reshape to [L/g, g, ...]. One source of
+    truth for llama.forward and the serve engine's prefill.
+    """
+    windows = layer_windows(config)
+    g = 1 if len(set(windows)) == 1 else config.sliding_pattern
+    if config.n_layers % g != 0:
+        raise ValueError(
+            f"{config.n_layers} layers not divisible by pattern {g}"
+        )
+    if g > 1:
+        xs = jax.tree.map(
+            lambda a: a.reshape((config.n_layers // g, g) + a.shape[1:]), xs
+        )
+    return g, windows, xs
+
+
+def sublayer(group, i: int, g: int):
+    """Sublayer ``i`` of a grouped scan step (identity when g == 1)."""
+    return jax.tree.map(lambda a: a[i], group) if g > 1 else group
+
+
+def layer_windows(config: "LlamaConfig") -> list[int]:
+    """Static per-layer attention window (0 = full/global attention).
+
+    ``sliding_pattern == p`` (Gemma2: p=2) makes the last layer of every
+    group of ``p`` global and the others sliding; otherwise the window is
+    uniform across layers (Mistral).
+    """
+    c = config
+    if not c.sliding_window:
+        return [0] * c.n_layers
+    p = c.sliding_pattern
+    if p and p > 1:
+        return [
+            0 if i % p == p - 1 else c.sliding_window
+            for i in range(c.n_layers)
+        ]
+    return [c.sliding_window] * c.n_layers
+
+
+def rope_freqs(
+    positions: jax.Array,
+    head_dim: int,
+    theta: float,
+    scaling: Optional[tuple] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """positions [T] → (cos, sin) each [T, head_dim//2], f32.
+
+    ``scaling`` applies the Llama-3.1 "llama3" rope rescaling
+    (factor, low_freq_factor, high_freq_factor, original_context):
+    long-wavelength frequencies are divided by ``factor``, short ones
+    kept, with a smooth ramp between — matching HF's
+    ``rope_type: llama3`` so 3.1/3.2 checkpoints decode correctly.
+    """
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        factor, low_f, high_f, orig_ctx = scaling
+        wavelen = 2.0 * math.pi / inv
+        smooth = (orig_ctx / wavelen - low_f) / (high_f - low_f)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        inv = (1.0 - smooth) * inv / factor + smooth * inv
     ang = positions.astype(jnp.float32)[:, None] * inv[None, :]
     return jnp.cos(ang), jnp.sin(ang)
 
@@ -249,13 +402,18 @@ def _attention_block(
     mesh: Optional[Mesh],
     rules: ShardingRules,
     attn_impl: Optional[str],
+    window: int = 0,
 ) -> jax.Array:
     c = config
     b, t, _ = x.shape
-    h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+    h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
     q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
     k = _proj(layer, "wk", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
     v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+    if c.qkv_bias:
+        q = q + layer["bq"]
+        k = k + layer["bk"]
+        v = v + layer["bv"]
     q = q.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -263,13 +421,22 @@ def _attention_block(
     k = constrain(k, rules, "batch", "kv_heads", "seq", None, mesh=mesh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    scale = c.attention_scale
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     if use_ring:
-        o = ring_attention(q, k, v, mesh=mesh, causal=True)
+        o = ring_attention(
+            q, k, v, mesh=mesh, causal=True, scale=scale,
+            window=window, softcap=c.attn_softcap,
+        )
     else:
-        o = attention(q, k, v, causal=True, impl=attn_impl)
+        o = attention(
+            q, k, v, causal=True, scale=scale, impl=attn_impl,
+            window=window, softcap=c.attn_softcap,
+        )
     o = o.transpose(0, 2, 1, 3).reshape(b, t, c.q_dim)
     out = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+    if c.post_norms:
+        out = rms_norm(out, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
     return constrain(out, rules, "batch", "seq", None, mesh=mesh)
 
 
@@ -281,7 +448,7 @@ def _mlp_block(
     rules: ShardingRules,
 ) -> tuple[jax.Array, jax.Array]:
     """Dense SwiGLU or sparse MoE FFN → (out, aux loss scalar)."""
-    h = rms_norm(x, layer["mlp_norm"], config.norm_eps)
+    h = rms_norm(x, layer["mlp_norm"], config.norm_eps, offset=config.norm_offset)
     if config.n_experts:
         from dstack_tpu.models import moe
 
@@ -293,6 +460,7 @@ def _mlp_block(
             config.capacity_factor,
             mesh,
             rules,
+            renorm=config.router_renorm,
         )
         aux_loss = (
             config.router_balance_coef * aux["balance"]
@@ -303,8 +471,10 @@ def _mlp_block(
     u = _proj(layer, "w_up", h, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
     g = constrain(g, rules, "batch", "seq", "mlp", mesh=mesh)
     o = _proj(
-        layer, "w_down", jax.nn.silu(g) * u, "btf,fe->bte", "btf,fr->btr", "btr,re->bte"
+        layer, "w_down", act_fn(config)(g) * u, "btf,fe->bte", "btf,fr->btr", "btr,re->bte"
     )
+    if config.post_norms:
+        o = rms_norm(o, layer["mlp_post_norm"], config.norm_eps, offset=config.norm_offset)
     return constrain(o, rules, "batch", "seq", None, mesh=mesh), jnp.zeros((), jnp.float32)
 
 
@@ -325,9 +495,14 @@ def _embed_tokens(
     # inherit the token indices' batch/seq sharding directly.
     embed = constrain(params["embed"], rules, None, None, mesh=mesh)
     x = embed.at[tokens].get(mode="fill", fill_value=0).astype(config.dtype)
+    if config.embed_scale:
+        # Gemma: the normalizer is rounded to the model dtype first
+        x = x * jnp.asarray(config.hidden_size**0.5, config.dtype)
     x = constrain(x, rules, "batch", "seq", None, mesh=mesh)
     pos = positions if positions is not None else jnp.arange(tokens.shape[1])
-    cos, sin = rope_freqs(pos, config.head_dim, config.rope_theta)
+    cos, sin = rope_freqs(
+        pos, config.head_dim, config.rope_theta, config.rope_scaling
+    )
     return x, cos, sin
 
 
@@ -340,13 +515,17 @@ def _lm_head(
     return_hidden: bool,
 ) -> jax.Array:
     """Shared forward tail: final norm, then logits (or hidden states)."""
-    x = rms_norm(x, params["final_norm"], config.norm_eps)
+    x = rms_norm(x, params["final_norm"], config.norm_eps, offset=config.norm_offset)
     if return_hidden:
         return x
     head = params["embed"].T if config.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("bte,ev->btv", x, head.astype(config.dtype))
     logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if config.logit_softcap:
+        cap = config.logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 def _merge_lora(xs: dict, lora: Optional[dict], lora_scale: float, config: LlamaConfig) -> dict:
@@ -390,25 +569,37 @@ def forward(
     c = config
     rules = rules or default_rules()
     x, cos, sin = _embed_tokens(params, tokens, c, mesh, rules, positions)
+    # mixed sliding/global layers (Gemma2) scan in groups of `g`
+    # sublayers so every window is static — the flash kernel stays
+    # usable (a traced window would force the masked XLA path)
+    xs = _merge_lora(params["layers"], lora, lora_scale, c)
+    g, windows, xs = grouped_scan_layout(c, xs)
 
-    def layer_fn(x, layer):
-        x = x + _attention_block(x, layer, c, cos, sin, mesh, rules, attn_impl)
-        o, aux = _mlp_block(x, layer, c, mesh, rules)
-        return x + o, aux
+    def group_fn(x, group):
+        aux = jnp.zeros((), jnp.float32)
+        for i in range(g):
+            layer = sublayer(group, i, g)
+            x = x + _attention_block(
+                x, layer, c, cos, sin, mesh, rules, attn_impl,
+                window=windows[i],
+            )
+            o, aux_i = _mlp_block(x, layer, c, mesh, rules)
+            x = x + o
+            aux = aux + aux_i
+        return x, aux
 
     if c.remat:
         # Save the flash-attention residuals (q/k/v/o/lse, tagged in
         # ops/flash.py) across the remat boundary: the backward pass
         # then reuses them instead of re-running the attention kernel,
         # at ~80MB/layer — everything else is recomputed as usual.
-        layer_fn = jax.checkpoint(
-            layer_fn,
+        group_fn = jax.checkpoint(
+            group_fn,
             policy=jax.checkpoint_policies.save_only_these_names(
                 "flash_residuals"
             ),
         )
-    xs = _merge_lora(params["layers"], lora, lora_scale, c)
-    x, auxs = jax.lax.scan(layer_fn, x, xs)
+    x, auxs = jax.lax.scan(group_fn, x, xs)
     aux = jnp.sum(auxs)
     out = _lm_head(params, x, c, mesh, rules, return_hidden)
     return (out, aux) if return_aux else out
@@ -444,6 +635,13 @@ def forward_pipelined(
     pp = mesh.shape.get("pp", 1)
     if c.n_layers % pp != 0:
         raise ValueError(f"{c.n_layers} layers not divisible by pp={pp}")
+    windows = layer_windows(c)
+    if len(set(windows)) > 1:
+        raise ValueError(
+            "forward_pipelined supports a uniform attention window only "
+            "(mixed sliding/global layers don't split into equal stages)"
+        )
+    window = windows[0]
     n_micro = n_micro or pp
     x, cos, sin = _embed_tokens(params, tokens, c, mesh, rules, positions)
 
@@ -454,7 +652,9 @@ def forward_pipelined(
             # mesh=None inside the stage: GSPMD propagates the auto-axis
             # (fsdp/tp/ep) shardings; explicit constraints can't name the
             # concrete mesh from inside the pp shard_map
-            x = x + _attention_block(x, layer, c, cos, sin, None, rules, attn_impl)
+            x = x + _attention_block(
+                x, layer, c, cos, sin, None, rules, attn_impl, window=window
+            )
             o, aux = _mlp_block(x, layer, c, None, rules)
             return x + o, aux
 

@@ -44,6 +44,7 @@ def router(
     n_experts: int,
     experts_per_token: int,
     capacity: int,
+    renorm: bool = False,  # Mixtral: renormalize top-k gates to sum 1
 ) -> tuple[jax.Array, jax.Array, dict]:
     """Top-k routing → (dispatch [B,T,E,C] one-hot, combine [B,T,E,C], aux).
 
@@ -57,6 +58,8 @@ def router(
     )  # [B, T, E] f32
     probs = jax.nn.softmax(logits, axis=-1)
     gate_vals, expert_idx = jax.lax.top_k(probs, experts_per_token)  # [B,T,k]
+    if renorm:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
 
     # Build per-choice one-hot assignments and capacity positions.
     # Choice order gives earlier (higher-gate) choices slot priority.
@@ -97,12 +100,13 @@ def moe_mlp(
     capacity_factor: float,
     mesh: Optional[Mesh],
     rules: Optional[ShardingRules],
+    renorm: bool = False,
 ) -> tuple[jax.Array, dict]:
     """Sparse SwiGLU FFN → (output [B,T,H], aux losses)."""
     b, t, h = x.shape
     cap = expert_capacity(t, n_experts, experts_per_token, capacity_factor)
     dispatch, combine, aux = router(
-        x, layer["w_router"], n_experts, experts_per_token, cap
+        x, layer["w_router"], n_experts, experts_per_token, cap, renorm=renorm
     )
     # token shuffle: [B,T,E,C] × [B,T,H] → [E,B,C,H]; ep-sharding the
     # expert dim makes this the all_to_all dispatch
@@ -127,12 +131,15 @@ def moe_mlp_reference(
     layer: dict,
     n_experts: int,
     experts_per_token: int,
+    renorm: bool = False,
 ) -> jax.Array:
     """Dense-everything reference (no capacity, no dispatch): every token
     runs every expert, output = Σ top-k gate_e · FFN_e(x). For tests."""
     logits = jnp.einsum("bth,he->bte", x, layer["w_router"].astype(x.dtype))
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     gate_vals, expert_idx = jax.lax.top_k(probs, experts_per_token)
+    if renorm:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
     gates = jnp.zeros_like(probs)
     for j in range(experts_per_token):
         gates = gates + jax.nn.one_hot(

@@ -36,6 +36,8 @@ def _xla_attention(
     causal: bool,
     scale: float,
     q_offset: int = 0,
+    window: int = 0,
+    softcap: float = 0.0,
 ) -> jax.Array:
     b, h, tq, d = q.shape
     hkv = k.shape[1]
@@ -44,11 +46,18 @@ def _xla_attention(
         k = jnp.repeat(k, h // hkv, axis=1)
         v = jnp.repeat(v, h // hkv, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    if causal:
+    if softcap:
+        s = softcap * jnp.tanh(s / softcap)  # cap raw scores, then mask
+    if causal or window:
         tk = k.shape[2]
         qi = q_offset + jnp.arange(tq)[:, None]
         kj = jnp.arange(tk)[None, :]
-        s = jnp.where(qi >= kj, s, NEG_INF)
+        keep = (qi >= kj) if causal else jnp.ones((tq, tk), bool)
+        if window:
+            # HF sliding-window convention: key j visible to query i
+            # iff 0 <= i - j < window
+            keep = keep & (qi - kj < window)
+        s = jnp.where(keep, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
@@ -61,12 +70,18 @@ def attention(
     causal: bool = True,
     scale: Optional[float] = None,
     q_offset: int = 0,
+    window: int = 0,  # 0 = full attention; else sliding window size
+    softcap: float = 0.0,  # 0 = off; else tanh soft-cap on scores
     impl: Optional[str] = None,  # None=auto | "flash" | "xla"
 ) -> jax.Array:
     """Dispatching attention entry point used by models."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if impl == "flash" or (impl is None and flash_supported(q, k)):
         return flash_attention(
-            q, k, v, causal=causal, scale=scale, q_offset=q_offset
+            q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+            window=window, softcap=softcap,
         )
-    return _xla_attention(q, k, v, causal=causal, scale=scale, q_offset=q_offset)
+    return _xla_attention(
+        q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+        window=window, softcap=softcap,
+    )

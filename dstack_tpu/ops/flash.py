@@ -68,6 +68,8 @@ def _fwd_kernel(
     num_k: int,
     q_offset: int,
     kv_offset: int,
+    window: int,
+    softcap: float,
 ):
     from jax.experimental import pallas as pl
 
@@ -92,14 +94,19 @@ def _fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BQ, BK] f32
-        if causal:
+        if softcap:
+            s = softcap * jnp.tanh(s / softcap)  # cap raw scores, then mask
+        if causal or window:
             rows = q_lo + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
             cols = k_lo + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            keep = rows >= cols if causal else rows == rows
+            if window:
+                keep = jnp.logical_and(keep, rows - cols < window)
+            s = jnp.where(keep, s, NEG_INF)
         m_prev = m_sc[:, :1]  # [BQ, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -116,9 +123,14 @@ def _fwd_kernel(
         )
         m_sc[:, :1] = m_new
 
-    if causal:
-        # skip blocks strictly above the diagonal
-        pl.when(q_lo + block_q - 1 >= k_lo)(compute)
+    live = None
+    if causal:  # skip blocks strictly above the diagonal
+        live = q_lo + block_q - 1 >= k_lo
+    if window:  # skip blocks entirely below the sliding window
+        below = k_lo + block_k - 1 >= q_lo - (window - 1)
+        live = below if live is None else jnp.logical_and(live, below)
+    if live is not None:
+        pl.when(live)(compute)
     else:
         compute()
 
@@ -145,6 +157,8 @@ def _flash_fwd(
     q_offset: int,
     kv_offset: int,
     interpret: bool,
+    window: int = 0,
+    softcap: float = 0.0,
 ) -> tuple[jax.Array, jax.Array]:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -165,11 +179,14 @@ def _flash_fwd(
         num_k=num_k,
         q_offset=q_offset,
         kv_offset=kv_offset,
+        window=window,
+        softcap=softcap,
     )
-    # For causal grids, clamp the KV block index at the diagonal: steps
-    # above it re-request the same block, which pallas elides (no DMA),
-    # so skipped blocks cost neither bandwidth nor compute.
-    kv_ix = _causal_kv_clamp(causal, bq, bk, q_offset, kv_offset, num_k)
+    # For causal grids, clamp the KV block index at the diagonal (and,
+    # with a sliding window, from below): steps outside re-request the
+    # same block, which pallas elides (no DMA), so skipped blocks cost
+    # neither bandwidth nor compute.
+    kv_ix = _causal_kv_clamp(causal, bq, bk, q_offset, kv_offset, num_k, window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(b, h, tq // bq, num_k),
@@ -203,15 +220,22 @@ def _flash_fwd(
     return o, lse
 
 
-def _causal_kv_clamp(causal, bq, bk, q_offset, kv_offset, num_k):
+def _causal_kv_clamp(causal, bq, bk, q_offset, kv_offset, num_k, window=0):
     """KV block index map for (qi, ki) grids: identity when non-causal,
-    else clamped to the last block intersecting q block qi's diagonal."""
-    if not causal:
+    else clamped to the last block intersecting q block qi's diagonal
+    (and, with a sliding window, to the first block inside the window)."""
+    if not causal and not window:
         return lambda qi, ki: ki
 
     def ix(qi, ki):
-        last = (q_offset + (qi + 1) * bq - 1 - kv_offset) // bk
-        return jnp.minimum(ki, jnp.clip(last, 0, num_k - 1))
+        ix = ki
+        if causal:
+            last = (q_offset + (qi + 1) * bq - 1 - kv_offset) // bk
+            ix = jnp.minimum(ix, jnp.clip(last, 0, num_k - 1))
+        if window:
+            first = (q_offset + qi * bq - (window - 1) - kv_offset) // bk
+            ix = jnp.maximum(ix, jnp.clip(first, 0, num_k - 1))
+        return ix
 
     return ix
 
@@ -238,6 +262,8 @@ def _dq_kernel(
     num_k: int,
     q_offset: int,
     kv_offset: int,
+    window: int,
+    softcap: float,
 ):
     from jax.experimental import pallas as pl
 
@@ -261,22 +287,37 @@ def _dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
+        if softcap:
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
+        if causal or window:
             rows = q_lo + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = k_lo + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            keep = rows >= cols if causal else rows == rows
+            if window:
+                keep = jnp.logical_and(keep, rows - cols < window)
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - jnp.where(lse <= NEG_INF / 2, 0.0, lse))
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BQ, BK]
-        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        ds = p * (dp - delta) * scale
+        if softcap:  # d(softcap·tanh(s/softcap))/ds = 1 - tanh²
+            ds = ds * (1.0 - t * t)
         acc_sc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
+    live = None
     if causal:
-        pl.when(q_lo + block_q - 1 >= k_lo)(compute)
+        live = q_lo + block_q - 1 >= k_lo
+    if window:
+        below = k_lo + block_k - 1 >= q_lo - (window - 1)
+        live = below if live is None else jnp.logical_and(live, below)
+    if live is not None:
+        pl.when(live)(compute)
     else:
         compute()
 
@@ -305,6 +346,8 @@ def _dkv_kernel(
     num_inner: int,
     q_offset: int,
     kv_offset: int,
+    window: int,
+    softcap: float,
 ):
     """dk/dv for one KV block.
 
@@ -339,10 +382,16 @@ def _dkv_kernel(
         s_t = jax.lax.dot_general(
             k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [BK, BQ]
-        if causal:
+        if softcap:
+            t = jnp.tanh(s_t / softcap)
+            s_t = softcap * t
+        if causal or window:
             rows_k = k_lo + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0)
             cols_q = q_lo + jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1)
-            s_t = jnp.where(cols_q >= rows_k, s_t, NEG_INF)
+            keep = cols_q >= rows_k if causal else rows_k == rows_k
+            if window:
+                keep = jnp.logical_and(keep, cols_q - rows_k < window)
+            s_t = jnp.where(keep, s_t, NEG_INF)
         p_t = jnp.exp(s_t - jnp.where(lse <= NEG_INF / 2, 0.0, lse))
         p_t = jnp.where(s_t <= NEG_INF / 2, 0.0, p_t)
         dv_sc[...] += jax.lax.dot_general(
@@ -352,13 +401,22 @@ def _dkv_kernel(
         dp_t = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [BK, BQ]
-        ds_t = (p_t * (dp_t - delta) * scale).astype(q.dtype)
+        ds_t = p_t * (dp_t - delta) * scale
+        if softcap:  # d(softcap·tanh(s/softcap))/ds = 1 - tanh²
+            ds_t = ds_t * (1.0 - t * t)
         dk_sc[...] += jax.lax.dot_general(
-            ds_t, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
+    live = None
     if causal:
-        pl.when(q_lo + block_q - 1 >= k_lo)(compute)
+        live = q_lo + block_q - 1 >= k_lo
+    if window:
+        below = k_lo + block_k - 1 >= q_lo - (window - 1)
+        live = below if live is None else jnp.logical_and(live, below)
+    if live is not None:
+        pl.when(live)(compute)
     else:
         compute()
 
@@ -382,6 +440,8 @@ def _flash_bwd(
     q_offset: int,
     kv_offset: int,
     interpret: bool,
+    window: int = 0,
+    softcap: float = 0.0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -401,8 +461,9 @@ def _flash_bwd(
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         num_k=num_k, q_offset=q_offset, kv_offset=kv_offset,
+        window=window, softcap=softcap,
     )
-    kv_ix = _causal_kv_clamp(causal, bq, bk, q_offset, kv_offset, num_k)
+    kv_ix = _causal_kv_clamp(causal, bq, bk, q_offset, kv_offset, num_k, window)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, h, num_q, num_k),
@@ -433,18 +494,28 @@ def _flash_bwd(
     dkv_kernel = functools.partial(
         _dkv_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         num_q=num_q, num_inner=num_inner, q_offset=q_offset, kv_offset=kv_offset,
+        window=window, softcap=softcap,
     )
 
     def _qh(j):
         # query head for inner step j: this KV head's group member j // num_q
         return j // num_q
 
-    if causal:
-        # clamp the q block index up to the diagonal: steps strictly
-        # above it re-request the same block (DMA elided, compute skipped)
+    if causal or window:
+        # clamp the q block index into [diagonal, window end]: steps
+        # outside re-request the same block (DMA elided, compute skipped)
         def _qi(ki, j):
-            first = (kv_offset + ki * bk - q_offset) // bq
-            return jnp.maximum(j % num_q, jnp.clip(first, 0, num_q - 1))
+            ix = j % num_q
+            if causal:
+                first = (kv_offset + ki * bk - q_offset) // bq
+                ix = jnp.maximum(ix, jnp.clip(first, 0, num_q - 1))
+            if window:
+                # last q row that can see this KV block's newest key
+                last = (
+                    kv_offset + (ki + 1) * bk - 1 + (window - 1) - q_offset
+                ) // bq
+                ix = jnp.minimum(ix, jnp.clip(last, 0, num_q - 1))
+            return ix
     else:
         def _qi(ki, j):
             return j % num_q
@@ -498,22 +569,28 @@ def _flash_bwd(
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11)
 )
-def _flash(q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset, interpret):
+def _flash(
+    q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset, interpret,
+    window, softcap,
+):
     o, _ = _flash_fwd(
-        q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset, interpret
+        q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset,
+        interpret, window, softcap,
     )
     return o
 
 
 def _flash_fwd_rule(
-    q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset, interpret
+    q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset, interpret,
+    window, softcap,
 ):
     from jax.ad_checkpoint import checkpoint_name
 
     o, lse = _flash_fwd(
-        q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset, interpret
+        q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset,
+        interpret, window, softcap,
     )
     # Tag residuals so a rematerialized layer (llama.forward uses
     # save_only_these_names("flash_residuals")) saves them instead of
@@ -523,12 +600,13 @@ def _flash_fwd_rule(
 
 
 def _flash_bwd_rule(
-    causal, scale, block_q, block_k, q_offset, kv_offset, interpret, res, do
+    causal, scale, block_q, block_k, q_offset, kv_offset, interpret,
+    window, softcap, res, do,
 ):
     q, k, v, o, lse = res
     dq, dk, dv = _flash_bwd(
         q, k, v, o, lse, do, causal, scale, block_q, block_k,
-        q_offset, kv_offset, interpret,
+        q_offset, kv_offset, interpret, window, softcap,
     )
     return dq, dk, dv
 
@@ -548,18 +626,27 @@ def flash_attention(
     q_offset: int = 0,
     kv_offset: int = 0,
     interpret: bool = False,
+    window: int = 0,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Differentiable flash attention (pallas, TPU).
 
     GQA-native: ``k``/``v`` may have fewer heads (``H % Hkv == 0``).
     ``q_offset``/``kv_offset`` give the global positions of row/col 0
     for causal masking across sequence shards (ring attention).
+    ``window`` masks keys older than the sliding window (Mistral/Gemma2
+    convention: key j visible to query i iff i - j < window); blocks
+    entirely outside the window are skipped, so long-sequence windowed
+    attention costs O(T·window) not O(T²). ``softcap`` applies the
+    Gemma2 tanh score cap (with its exact gradient in the backward
+    kernels).
     """
     b, h, t, d = q.shape
     assert h % k.shape[1] == 0, (h, k.shape[1])
     scale = float(scale) if scale is not None else d**-0.5
     return _flash(
-        q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset, interpret
+        q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset,
+        interpret, window, softcap,
     )
 
 
@@ -575,6 +662,8 @@ def flash_attention_with_lse(
     q_offset: int = 0,
     kv_offset: int = 0,
     interpret: bool = False,
+    window: int = 0,
+    softcap: float = 0.0,
 ) -> tuple[jax.Array, jax.Array]:
     """Forward-only variant returning (o, logsumexp [B, H, Tq] f32).
 
@@ -584,7 +673,8 @@ def flash_attention_with_lse(
     d = q.shape[-1]
     scale = float(scale) if scale is not None else d**-0.5
     o, lse = _flash_fwd(
-        q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset, interpret
+        q, k, v, causal, scale, block_q, block_k, q_offset, kv_offset,
+        interpret, window, softcap,
     )
     return o, lse[..., 0]
 

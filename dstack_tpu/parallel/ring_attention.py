@@ -52,9 +52,12 @@ def _block_attention(
     v: jax.Array,  # [B, H, Tk, D]
     bias: Optional[jax.Array],  # broadcastable to [B, H, Tq, Tk] or None
     scale: float,
+    softcap: float = 0.0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One KV-block of attention → (unnormalized out, running max, denom)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if softcap:
+        s = softcap * jnp.tanh(s / softcap)  # cap raw scores, then mask
     if bias is not None:
         s = s + bias
     m = jnp.max(s, axis=-1)  # [B, H, Tq]
@@ -77,15 +80,25 @@ def _merge(o1, m1, l1, o2, m2, l2):
     return o, m, l
 
 
-def _causal_bias(tq: int, tk: int, q_offset, k_offset, dtype=jnp.float32) -> jax.Array:
-    """Causal mask bias for Q rows [q_offset, q_offset+tq) vs K cols
-    [k_offset, k_offset+tk) in global coordinates."""
+def _mask_bias(
+    tq: int, tk: int, q_offset, k_offset, causal: bool, window: int,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Causal/sliding-window mask bias for Q rows [q_offset, q_offset+tq)
+    vs K cols [k_offset, k_offset+tk) in global coordinates (offsets may
+    be traced — ring step indices are)."""
     qi = q_offset + jnp.arange(tq)[:, None]
     kj = k_offset + jnp.arange(tk)[None, :]
-    return jnp.where(qi >= kj, 0.0, NEG_INF).astype(dtype)[None, None]
+    keep = (qi >= kj) if causal else jnp.ones((tq, tk), bool)
+    if window:
+        keep = keep & (qi - kj < window)
+    return jnp.where(keep, 0.0, NEG_INF).astype(dtype)[None, None]
 
 
-def _ring_xla_local(sp: int, axis_name: str, causal: bool, scale: float):
+def _ring_xla_local(
+    sp: int, axis_name: str, causal: bool, scale: float,
+    window: int = 0, softcap: float = 0.0,
+):
     """Per-shard ring attention body, einsum blocks (KV at full Q heads)."""
 
     def local_fn(q, k, v):
@@ -97,11 +110,14 @@ def _ring_xla_local(sp: int, axis_name: str, causal: bool, scale: float):
             o, m, l, kb, vb = carry
             # KV block currently held originated at ring position (idx - r) % sp
             src = (idx - r) % sp
-            if causal:
-                bias = _causal_bias(t_local, t_local, idx * t_local, src * t_local)
+            if causal or window:
+                bias = _mask_bias(
+                    t_local, t_local, idx * t_local, src * t_local,
+                    causal, window,
+                )
             else:
                 bias = None
-            ob, mb, lb = _block_attention(q32, kb, vb, bias, scale)
+            ob, mb, lb = _block_attention(q32, kb, vb, bias, scale, softcap)
             o, m, l = _merge(o, m, l, ob, mb, lb)
             # rotate KV to the next device (ring neighbor over ICI)
             perm = [(i, (i + 1) % sp) for i in range(sp)]
@@ -151,11 +167,12 @@ def _make_ring_pallas(
     block_q: int,
     block_k: int,
     interpret: bool,
+    softcap: float = 0.0,
 ):
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     kw = dict(
         block_q=block_q, block_k=block_k, q_offset=0, kv_offset=0,
-        interpret=interpret,
+        interpret=interpret, softcap=softcap,
     )
 
     def branch_index(src, idx):
@@ -254,8 +271,14 @@ def _make_ring_pallas(
 # ---------------------------------------------------------------------------
 
 
-def _pallas_ok(h: int, hkv: int, t_local: int, d: int, interpret: bool) -> bool:
+def _pallas_ok(
+    h: int, hkv: int, t_local: int, d: int, interpret: bool, window: int
+) -> bool:
     if not interpret and jax.default_backend() != "tpu":
+        return False
+    if window:
+        # inter-shard window masking needs traced global offsets, which
+        # the static pallas kernel params can't express — XLA ring path
         return False
     return d % 64 == 0 and t_local % 128 == 0 and h % hkv == 0
 
@@ -273,6 +296,8 @@ def ring_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: bool = False,
+    window: int = 0,  # sliding window (global token coordinates)
+    softcap: float = 0.0,  # Gemma2 tanh score cap
 ) -> jax.Array:
     """Exact multi-device attention with KV rotating around the ``sp`` ring.
 
@@ -283,20 +308,30 @@ def ring_attention(
     if sp == 1:
         from dstack_tpu.ops.attention import attention as local_attention
 
-        return local_attention(q, k, v, causal=causal, scale=scale)
+        return local_attention(
+            q, k, v, causal=causal, scale=scale, window=window, softcap=softcap
+        )
 
     scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
     t_local = q.shape[2] // sp
+    if impl == "pallas" and window:
+        raise ValueError(
+            "ring_attention: sliding window requires the xla path "
+            "(inter-shard offsets are traced)"
+        )
     use_pallas = impl == "pallas" or (
         impl is None
-        and _pallas_ok(q.shape[1], k.shape[1], t_local, q.shape[3], interpret)
+        and _pallas_ok(
+            q.shape[1], k.shape[1], t_local, q.shape[3], interpret, window
+        )
     )
 
     if use_pallas:
         # GQA KV stays at KV-head width: the flash kernels group
         # natively, and the ring rotates the smaller buffers.
         local_fn = _make_ring_pallas(
-            sp, axis_name, causal, scale, block_q, block_k, interpret
+            sp, axis_name, causal, scale, block_q, block_k, interpret,
+            softcap=softcap,
         )
     else:
         if k.shape[1] != q.shape[1]:  # GQA: expand KV heads before the ring
@@ -304,7 +339,9 @@ def ring_attention(
             rep = q.shape[1] // k.shape[1]
             k = jnp.repeat(k, rep, axis=1)
             v = jnp.repeat(v, rep, axis=1)
-        local_fn = _ring_xla_local(sp, axis_name, causal, scale)
+        local_fn = _ring_xla_local(
+            sp, axis_name, causal, scale, window=window, softcap=softcap
+        )
 
     spec = P(None, None, axis_name, None)  # seq sharded; heads follow outer
     return shard_map(

@@ -98,6 +98,57 @@ def _expand_gqa(k: jax.Array, n_heads: int) -> jax.Array:
     return jnp.repeat(k, n_heads // hkv, axis=1)
 
 
+def _mlp(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
+    """Post-attention MLP sublayer (shared by prefill and decode)."""
+    from dstack_tpu.models.llama import act_fn
+
+    m = rms_norm(x, layer["mlp_norm"], c.norm_eps, offset=c.norm_offset)
+    if c.n_experts:
+        from dstack_tpu.models import moe
+
+        mo, _ = moe.moe_mlp(
+            m, layer, c.n_experts, c.experts_per_token, c.capacity_factor,
+            None, None, renorm=c.router_renorm,
+        )
+    else:
+        g = _proj(layer, "w_gate", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+        u = _proj(layer, "w_up", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+        mo = _proj(
+            layer, "w_down", act_fn(c)(g) * u,
+            "btf,fe->bte", "btf,fr->btr", "btr,re->bte",
+        )
+    if c.post_norms:
+        mo = rms_norm(mo, layer["mlp_post_norm"], c.norm_eps, offset=c.norm_offset)
+    return x + mo
+
+
+def _qkv(h: jax.Array, layer: dict, c: LlamaConfig) -> tuple:
+    q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+    k = _proj(layer, "wk", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+    v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+    if c.qkv_bias:
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    return q, k, v
+
+
+def _embed_lookup(params: dict, tokens: jax.Array, c: LlamaConfig) -> jax.Array:
+    x = params["embed"].at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
+    if c.embed_scale:
+        x = x * jnp.asarray(c.hidden_size**0.5, c.dtype)
+    return x
+
+
+def _head_logits(params: dict, x: jax.Array, c: LlamaConfig) -> jax.Array:
+    """x [B, H] (post-final-norm) → f32 logits [B, V] with Gemma2 cap."""
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum(
+        "be,ev->bv", x, head.astype(c.dtype), preferred_element_type=jnp.float32
+    )
+    if c.logit_softcap:
+        logits = c.logit_softcap * jnp.tanh(logits / c.logit_softcap)
+    return logits
+
+
 def prefill(
     params: dict,
     tokens: jax.Array,  # [B, Tp] int32, right-padded
@@ -110,63 +161,66 @@ def prefill(
     ``slot..slot+B`` (the full pool cache is donated — never slice it
     per request: an identity slice aliases the pool's own buffer and
     donation would delete it); returns (last-token logits [B, V], cache)."""
+    from dstack_tpu.models.llama import apply_rope, grouped_scan_layout, sublayer
+    from dstack_tpu.ops.attention import attention
+
     c = config
     b, tp = tokens.shape
-    embed = params["embed"]
-    x = embed.at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
-    cos, sin = rope_freqs(jnp.arange(tp), c.head_dim, c.rope_theta)
+    x = _embed_lookup(params, tokens, c)
+    cos, sin = rope_freqs(jnp.arange(tp), c.head_dim, c.rope_theta, c.rope_scaling)
+    scale = c.attention_scale
+    # mixed sliding/global layers (Gemma2): scan groups of `g` sublayers
+    # so every window is static (see llama.forward)
+    g, windows, xs = grouped_scan_layout(c, params["layers"])
 
-    def layer_fn(x, layer):
-        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
-        q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
-        k = _proj(layer, "wk", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
-        v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+    def one_layer(x, layer, window):
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+        q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, tp, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, tp, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, tp, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        from dstack_tpu.models.llama import apply_rope
-
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        from dstack_tpu.ops.attention import attention
-
-        o = attention(q, k, v, causal=True)
+        o = attention(
+            q, k, v, causal=True, scale=scale,
+            window=window, softcap=c.attn_softcap,
+        )
         o = o.transpose(0, 2, 1, 3).reshape(b, tp, c.q_dim)
-        x = x + _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
-        m = rms_norm(x, layer["mlp_norm"], c.norm_eps)
-        if c.n_experts:
-            from dstack_tpu.models import moe
+        ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        if c.post_norms:
+            ao = rms_norm(ao, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
+        x = x + ao
+        return _mlp(x, layer, c), (k, v)
 
-            mo, _ = moe.moe_mlp(
-                m, layer, c.n_experts, c.experts_per_token, c.capacity_factor,
-                None, None,
-            )
-        else:
-            g = _proj(layer, "w_gate", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
-            u = _proj(layer, "w_up", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
-            mo = _proj(
-                layer, "w_down", jax.nn.silu(g) * u,
-                "btf,fe->bte", "btf,fr->btr", "btr,re->bte",
-            )
-        return x + mo, (k, v)
+    def group_fn(x, group):
+        kvs = []
+        for i in range(g):
+            layer = sublayer(group, i, g)
+            x, kv = one_layer(x, layer, windows[i])
+            kvs.append(kv)
+        if g == 1:
+            return x, kvs[0]
+        return x, (
+            jnp.stack([kv[0] for kv in kvs]),
+            jnp.stack([kv[1] for kv in kvs]),
+        )
 
-    x, (ks, vs) = jax.lax.scan(layer_fn, x, params["layers"])
+    x, (ks, vs) = jax.lax.scan(group_fn, x, xs)
+    if g > 1:  # [L/g, g, ...] → [L, ...]
+        ks = ks.reshape((c.n_layers,) + ks.shape[2:])
+        vs = vs.reshape((c.n_layers,) + vs.shape[2:])
     # write the prompt K/V into the slot's cache prefix
     start = (0, slot.astype(jnp.int32), 0, 0, 0)
     cache = {
         "k": jax.lax.dynamic_update_slice(cache["k"], ks, start),
         "v": jax.lax.dynamic_update_slice(cache["v"], vs, start),
     }
-    x = rms_norm(x, params["final_norm"], c.norm_eps)
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)
     # only the last real token's logits matter
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]
-    logits = jnp.einsum(
-        "be,ev->bv", last, head.astype(c.dtype), preferred_element_type=jnp.float32
-    )
-    return logits, cache
+    return _head_logits(params, last, c), cache
 
 
 def decode_step(
@@ -177,19 +231,22 @@ def decode_step(
     config: LlamaConfig,
 ) -> tuple[jax.Array, dict]:
     """One token for every slot → (logits [B, V], cache)."""
+    from dstack_tpu.models.llama import layer_windows
+
     c = config
     b = tokens.shape[0]
-    embed = params["embed"]
-    x = embed.at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)[:, None, :]
-    cos, sin = rope_freqs(positions, c.head_dim, c.rope_theta)  # [B, D/2]
+    x = _embed_lookup(params, tokens, c)[:, None, :]
+    cos, sin = rope_freqs(positions, c.head_dim, c.rope_theta, c.rope_scaling)  # [B, D/2]
     batch_ix = jnp.arange(b)
+    scale = c.attention_scale
+    # decode attention is a masked einsum, so a *traced* per-layer window
+    # can ride the scan — no grouped unrolling needed here
+    windows = jnp.asarray(layer_windows(c), jnp.int32)
 
     def layer_fn(x, layer_and_cache):
-        layer, ck, cv = layer_and_cache  # ck/cv [B, Hkv, Tmax, D]
-        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
-        q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
-        k = _proj(layer, "wk", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
-        v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        layer, ck, cv, window = layer_and_cache  # ck/cv [B, Hkv, Tmax, D]
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+        q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -198,46 +255,37 @@ def decode_step(
         # write this token's K/V at each slot's position
         ck = ck.at[batch_ix, :, positions].set(k[:, :, 0, :])
         cv = cv.at[batch_ix, :, positions].set(v[:, :, 0, :])
-        # attend over the cache prefix (mask: j <= position)
+        # attend over the cache prefix (mask: j <= position, and within
+        # the layer's sliding window when set)
         kk = _expand_gqa(ck, c.n_heads)
         vv = _expand_gqa(cv, c.n_heads)
         s = jnp.einsum(
             "bhqd,bhkd->bhqk", q, kk, preferred_element_type=jnp.float32
-        ) * (c.head_dim**-0.5)
-        mask = jnp.arange(ck.shape[2])[None, None, None, :] <= positions[:, None, None, None]
+        ) * scale
+        if c.attn_softcap:
+            s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
+        kj = jnp.arange(ck.shape[2])[None, None, None, :]
+        pos = positions[:, None, None, None]
+        mask = kj <= pos
+        mask = jnp.logical_and(
+            mask, jnp.logical_or(window == 0, pos - kj < window)
+        )
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vv.dtype), vv)
         o = o.transpose(0, 2, 1, 3).reshape(b, 1, c.q_dim)
-        x = x + _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
-        m = rms_norm(x, layer["mlp_norm"], c.norm_eps)
-        if c.n_experts:
-            from dstack_tpu.models import moe
-
-            mo, _ = moe.moe_mlp(
-                m, layer, c.n_experts, c.experts_per_token, c.capacity_factor,
-                None, None,
-            )
-        else:
-            g = _proj(layer, "w_gate", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
-            u = _proj(layer, "w_up", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
-            mo = _proj(
-                layer, "w_down", jax.nn.silu(g) * u,
-                "btf,fe->bte", "btf,fr->btr", "btr,re->bte",
-            )
-        return x + mo, (ck, cv)
+        ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        if c.post_norms:
+            ao = rms_norm(ao, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
+        x = x + ao
+        return _mlp(x, layer, c), (ck, cv)
 
     x, (ks, vs) = jax.lax.scan(
-        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+        layer_fn, x, (params["layers"], cache["k"], cache["v"], windows)
     )
     cache = {"k": ks, "v": vs}
-    x = rms_norm(x, params["final_norm"], c.norm_eps)
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum(
-        "be,ev->bv", x[:, 0], head.astype(c.dtype),
-        preferred_element_type=jnp.float32,
-    )
-    return logits, cache
+    x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)
+    return _head_logits(params, x[:, 0], c), cache
 
 
 def sample(

@@ -17,6 +17,7 @@ import asyncio
 import json
 import time
 import uuid
+from pathlib import Path
 from typing import Optional
 
 from aiohttp import web
@@ -344,7 +345,16 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="llama-3.2-1b", help="config name (models/llama.py CONFIGS)")
     p.add_argument("--weights", default=None, help=".npz from finetune (random init when omitted)")
-    p.add_argument("--tokenizer", default="byte", help="'byte' or a HF tokenizer path")
+    p.add_argument(
+        "--hf-model", default=None,
+        help="HF save_pretrained dir (llama/qwen2/mistral/gemma/gemma2/"
+             "mixtral): loads config+weights+tokenizer, overrides --model",
+    )
+    p.add_argument(
+        "--tokenizer", default=None,
+        help="'byte' or a HF tokenizer path (default: the --hf-model "
+             "dir when it ships a tokenizer, else byte)",
+    )
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-seq", type=int, default=2048)
@@ -370,7 +380,23 @@ def main(argv=None) -> int:
 
     from dstack_tpu.models import llama
 
-    config = llama.CONFIGS[args.model]
+    hf_params = None
+    if args.hf_model:
+        from dstack_tpu.models.convert_hf import load_checkpoint
+
+        config, hf_params = load_checkpoint(args.hf_model)
+        args.model = Path(args.hf_model).name
+        if args.tokenizer is None and any(
+            (Path(args.hf_model) / f).exists()
+            for f in ("tokenizer.json", "tokenizer_config.json", "tokenizer.model")
+        ):
+            args.tokenizer = args.hf_model  # tokenizer ships alongside
+        logger.info(
+            "loaded HF checkpoint %s (%.2fB params)",
+            args.hf_model, config.num_params() / 1e9,
+        )
+    else:
+        config = llama.CONFIGS[args.model]
     tp = args.tp or len(jax.devices())
     mesh = None
     if tp > 1:
@@ -378,7 +404,22 @@ def main(argv=None) -> int:
 
         mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=tp))
         logger.info("tensor-parallel serving over %d devices", tp)
-    if mesh is not None:
+    if hf_params is not None:
+        # host (numpy) tree from convert_hf; with a mesh the engine
+        # device_puts it straight into sharded buffers (never whole on
+        # chip 0), without one a single put avoids per-call transfers
+        if mesh is not None and args.weights:
+            # the --weights overlay below reads each leaf's .sharding —
+            # shard the tree now (same shardings the engine would use)
+            from dstack_tpu.parallel.sharding import default_rules, tree_shardings
+
+            params = jax.device_put(
+                hf_params,
+                tree_shardings(llama.param_specs(config), mesh, default_rules()),
+            )
+        else:
+            params = hf_params if mesh is not None else jax.device_put(hf_params)
+    elif mesh is not None:
         # init directly under the mesh shardings: a 70B never fits chip 0
         from dstack_tpu.serve.engine import sharded_params
 
@@ -417,7 +458,7 @@ def main(argv=None) -> int:
     engine = InferenceEngine(
         config, params, max_batch=args.max_batch, max_seq=args.max_seq, mesh=mesh
     )
-    tokenizer = load_tokenizer(args.tokenizer)
+    tokenizer = load_tokenizer(args.tokenizer or "byte")
     app = build_app(engine, tokenizer, args.model, args.chat_template)
     logger.info("openai server: %s on :%d", args.model, args.port)
     web.run_app(app, host="0.0.0.0", port=args.port, print=None)

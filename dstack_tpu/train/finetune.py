@@ -26,6 +26,11 @@ from pathlib import Path
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="llama-3.2-1b")
+    p.add_argument(
+        "--hf-model", default=None,
+        help="HF save_pretrained dir (llama/qwen2/mistral/gemma/gemma2/"
+             "mixtral): fine-tune from those weights; overrides --model",
+    )
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--batch", type=int, default=8, help="global batch size")
     p.add_argument("--steps", type=int, default=100)
@@ -49,9 +54,16 @@ def main(argv=None) -> int:
         "--resume", action="store_true",
         help="resume from the latest checkpoint in --ckpt-dir",
     )
+    p.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. cpu); overrides sitecustomize pins",
+    )
     args = p.parse_args(argv)
 
     import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
 
     # join the slice-wide process group when the orchestrator provides one
     if os.environ.get("JAX_COORDINATOR_ADDRESS") and int(
@@ -71,7 +83,14 @@ def main(argv=None) -> int:
         sharded_init,
     )
 
-    config = llama.CONFIGS[args.model]
+    hf_params = None
+    if args.hf_model:
+        from dstack_tpu.models.convert_hf import load_checkpoint
+
+        config, hf_params = load_checkpoint(args.hf_model)
+        args.model = os.path.basename(os.path.normpath(args.hf_model))
+    else:
+        config = llama.CONFIGS[args.model]
     mesh = make_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, sp=args.sp, tp=args.tp))
     n_chips = len(jax.devices())
     print(
@@ -82,12 +101,17 @@ def main(argv=None) -> int:
 
     opt = default_optimizer(lr=args.lr, decay_steps=args.steps)
     t0 = time.perf_counter()
+    # hf_params (host numpy tree from convert_hf) goes straight into the
+    # sharded buffers — never whole on one chip, never alongside a
+    # discarded random init
     if args.full:
-        state, _ = sharded_init(config, opt, mesh)
+        state, _ = sharded_init(config, opt, mesh, params=hf_params)
         step_fn = make_train_step(config, opt, mesh)
     else:
         lora_conf = lora_mod.LoRAConfig(rank=args.lora_rank, alpha=args.lora_alpha)
-        params, state, _ = lora_mod.sharded_lora_init(config, lora_conf, opt, mesh)
+        params, state, _ = lora_mod.sharded_lora_init(
+            config, lora_conf, opt, mesh, params=hf_params
+        )
         step_fn = lora_mod.make_lora_train_step(config, lora_conf, opt, mesh)
     print(f"init done in {time.perf_counter() - t0:.1f}s", flush=True)
 

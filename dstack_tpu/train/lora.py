@@ -145,16 +145,23 @@ def sharded_lora_init(
     mesh: Mesh,
     rules: Optional[ShardingRules] = None,
     seed: int = 0,
+    params: Optional[dict] = None,
 ) -> tuple[dict, dict, tuple]:
     """→ (base_params, lora_state, (base_sharding, state_sharding));
-    everything initialized directly sharded (no host gather)."""
+    everything initialized directly sharded (no host gather).
+
+    ``params``: start from these base weights (host or device tree,
+    e.g. an HF checkpoint) instead of random init."""
     rules = rules or default_rules()
     base_sh, state_sh = lora_state_specs(config, lora_config, optimizer, rules, mesh)
 
     key = jax.random.key(seed)
-    params = jax.jit(
-        lambda k: llama.init_params(config, k), out_shardings=base_sh
-    )(key)
+    if params is not None:
+        params = jax.device_put(params, base_sh)
+    else:
+        params = jax.jit(
+            lambda k: llama.init_params(config, k), out_shardings=base_sh
+        )(key)
 
     def init_state(k):
         lora = init_lora_params(config, lora_config, k)

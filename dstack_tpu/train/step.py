@@ -44,6 +44,7 @@ def fused_cross_entropy(
     mask: Optional[jax.Array],  # [B, T] 0/1
     rules: Optional[ShardingRules] = None,
     mesh: Optional[Mesh] = None,
+    softcap: float = 0.0,  # Gemma2 final-logit tanh cap
 ) -> tuple[jax.Array, jax.Array]:
     """Cross-entropy in logsumexp form: loss = lse(logits) − logit[y].
 
@@ -56,6 +57,8 @@ def fused_cross_entropy(
     logits = jnp.einsum(
         "bth,hv->btv", x, head, preferred_element_type=jnp.float32
     )
+    if softcap:
+        logits = softcap * jnp.tanh(logits / softcap)
     if rules is not None:
         logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
     lse = jax.nn.logsumexp(logits, axis=-1)  # [B, T]
@@ -75,6 +78,7 @@ def chunked_cross_entropy(
     max_chunk_bytes: int = 256 * 1024 * 1024,
     rules: Optional[ShardingRules] = None,
     mesh: Optional[Mesh] = None,
+    softcap: float = 0.0,  # Gemma2 final-logit tanh cap
 ) -> tuple[jax.Array, jax.Array]:
     """LM-head matmul fused into the loss, chunked over the sequence.
 
@@ -107,6 +111,8 @@ def chunked_cross_entropy(
         logits = jnp.einsum(
             "bth,hv->btv", xc, head, preferred_element_type=jnp.float32
         )
+        if softcap:
+            logits = softcap * jnp.tanh(logits / softcap)
         if rules is not None:
             logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
         logp = jax.nn.log_softmax(logits, axis=-1)
@@ -191,13 +197,30 @@ def sharded_init(
     mesh: Mesh,
     rules: Optional[ShardingRules] = None,
     seed: int = 0,
+    params: Optional[dict] = None,
 ) -> tuple[dict, dict]:
     """Initialize the train state directly sharded (no host gather).
+
+    ``params``: start from these weights (host or device tree, e.g. an
+    HF checkpoint) instead of random init — they go straight into the
+    sharded buffers and only opt_state/step are built on device, so
+    peak memory stays at one parameter tree.
 
     Returns (state, state_shardings).
     """
     rules = rules_for_mesh(mesh, rules)
     shardings = state_specs(config, optimizer, rules, mesh)
+
+    if params is not None:
+        params = jax.device_put(params, shardings["params"])
+        state = {
+            "params": params,
+            "opt_state": jax.jit(
+                optimizer.init, out_shardings=shardings["opt_state"]
+            )(params),
+            "step": jax.device_put(jnp.zeros((), jnp.int32), shardings["step"]),
+        }
+        return state, shardings
 
     def init(key):
         params = llama.init_params(config, key)
@@ -258,11 +281,13 @@ def make_train_step(
         if loss_impl == "chunked":
             loss, _ = chunked_cross_entropy(
                 x, head, batch["targets"], batch.get("mask"),
+                softcap=config.logit_softcap,
                 rules=rules, mesh=mesh,
             )
         else:
             loss, _ = fused_cross_entropy(
-                x, head, batch["targets"], batch.get("mask"), rules=rules, mesh=mesh
+                x, head, batch["targets"], batch.get("mask"), rules=rules, mesh=mesh,
+                softcap=config.logit_softcap,
             )
         return loss + aux, (loss, aux)
 

@@ -126,3 +126,96 @@ class TestLossFunctions:
             )[0]
         )(x)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5)
+
+
+class TestWindowAndSoftcap:
+    """Sliding-window (Mistral/Gemma2) and tanh score-cap (Gemma2) paths."""
+
+    def _naive(self, q, k, v, scale, causal, window, softcap):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        if softcap:
+            s = softcap * jnp.tanh(s / softcap)
+        tq, tk = q.shape[2], k.shape[2]
+        qi = jnp.arange(tq)[:, None]
+        kj = jnp.arange(tk)[None, :]
+        keep = (qi >= kj) if causal else jnp.ones((tq, tk), bool)
+        if window:
+            keep = keep & (qi - kj < window)
+        s = jnp.where(keep, s, -jnp.inf)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    @pytest.mark.parametrize("window,softcap", [(8, 0.0), (0, 5.0), (8, 5.0)])
+    def test_xla_matches_naive(self, window, softcap):
+        q, k, v = _rand_qkv(jax.random.key(10), b=1, h=2, hkv=2, t=32, d=16)
+        ref = self._naive(q, k, v, 16**-0.5, True, window, softcap)
+        out = _xla_attention(
+            q, k, v, causal=True, scale=16**-0.5, window=window, softcap=softcap
+        )
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("window,softcap", [(256, 0.0), (0, 30.0), (256, 30.0)])
+    def test_flash_matches_xla(self, window, softcap):
+        q, k, v = _rand_qkv(jax.random.key(11), b=1, h=2, hkv=1, t=512, d=64)
+        ref = _xla_attention(
+            q, k, v, causal=True, scale=64**-0.5, window=window, softcap=softcap
+        )
+        out = flash_attention(
+            q, k, v, causal=True, window=window, softcap=softcap,
+            block_q=128, block_k=128, interpret=True,
+        )
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+    def test_flash_window_not_block_aligned(self):
+        """Window smaller than / not divisible by the KV block size."""
+        q, k, v = _rand_qkv(jax.random.key(12), b=1, h=2, hkv=2, t=512, d=64)
+        for window in (100, 130, 384):
+            ref = _xla_attention(
+                q, k, v, causal=True, scale=64**-0.5, window=window
+            )
+            out = flash_attention(
+                q, k, v, causal=True, window=window,
+                block_q=128, block_k=128, interpret=True,
+            )
+            np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+    @pytest.mark.parametrize("window,softcap", [(256, 0.0), (0, 20.0), (192, 20.0)])
+    def test_flash_backward_matches_xla(self, window, softcap):
+        q, k, v = _rand_qkv(jax.random.key(13), b=1, h=4, hkv=2, t=256, d=64)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(
+                    q, k, v, causal=True, window=window, softcap=softcap,
+                    block_q=128, block_k=128, interpret=True,
+                ) ** 2
+            )
+
+        def loss_xla(q, k, v):
+            return jnp.sum(
+                _xla_attention(
+                    q, k, v, causal=True, scale=64**-0.5,
+                    window=window, softcap=softcap,
+                ) ** 2
+            )
+
+        g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_flash, g_xla):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2
+            )
+
+    def test_ring_xla_window_matches_dense(self):
+        """Ring attention with a sliding window == dense windowed attention."""
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+        from dstack_tpu.parallel.ring_attention import ring_attention
+
+        if len(jax.devices()) < 4:
+            pytest.skip("needs >= 4 devices")
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, sp=4, tp=1))
+        q, k, v = _rand_qkv(jax.random.key(14), b=1, h=2, hkv=2, t=64, d=16)
+        ref = _xla_attention(q, k, v, causal=True, scale=16**-0.5, window=24)
+        out = ring_attention(q, k, v, mesh=mesh, causal=True, window=24)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )

@@ -1,0 +1,249 @@
+"""Logit-parity against HuggingFace transformers (CPU, tiny models).
+
+The strongest correctness check the model families can get without
+downloading weights: build a tiny randomly-initialized HF model per
+family, save_pretrained → models/convert_hf.load_checkpoint → compare
+our f32 forward logits to the torch forward, position by position.
+Covers weight-layout mapping, RoPE convention, GQA, biases, norms
+(offset/sandwich), activations, sliding windows, softcaps, and MoE
+routing in one assertion per family.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from dstack_tpu.models import llama
+from dstack_tpu.models.convert_hf import load_checkpoint
+
+B, T = 2, 16
+
+
+def _save_tiny(tmp_path, config_cls, model_cls, **kw):
+    torch.manual_seed(0)
+    cfg = config_cls(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        **kw,
+    )
+    model = model_cls(cfg)
+    model.eval()
+    model.save_pretrained(tmp_path)
+    return model
+
+
+def _assert_parity(tmp_path, hf_model, atol=2e-4, **fwd_kw):
+    config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+    params = jax.device_put(params)  # converter returns host arrays
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, config.vocab_size, (B, T))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(tokens)).logits.numpy()
+    config = llama.dataclasses.replace(config, remat=False)
+    ours = llama.forward(params, jnp.asarray(tokens), config, **fwd_kw)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=atol)
+    return config
+
+
+class TestHFParity:
+    def test_llama(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.LlamaConfig, transformers.LlamaForCausalLM,
+            rope_theta=10000.0, tie_word_embeddings=False,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert not cfg.qkv_bias and cfg.sliding_window == 0
+
+    def test_llama_tied_embeddings(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.LlamaConfig, transformers.LlamaForCausalLM,
+            tie_word_embeddings=True,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.tie_embeddings
+
+    def test_llama31_rope_scaling(self, tmp_path):
+        """rope_type llama3 (Llama-3.1/3.2 checkpoints) rescales rope
+        frequencies — must match HF, and differ from unscaled rope."""
+        m = _save_tiny(
+            tmp_path, transformers.LlamaConfig, transformers.LlamaForCausalLM,
+            rope_theta=10000.0,
+            rope_scaling={
+                "rope_type": "llama3", "factor": 8.0,
+                "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                "original_max_position_embeddings": 8,
+            },
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.rope_scaling == (8.0, 1.0, 4.0, 8.0)
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(config, remat=False)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, config.vocab_size, (B, T)))
+        scaled = llama.forward(params, tokens, config)
+        plain = llama.forward(
+            params, tokens, llama.dataclasses.replace(config, rope_scaling=None)
+        )
+        assert not np.allclose(np.asarray(scaled), np.asarray(plain))
+
+    def test_unsupported_rope_scaling_rejected(self, tmp_path):
+        import json
+        from dstack_tpu.models.convert_hf import config_from_hf
+
+        hf = json.loads((_save_tiny(
+            tmp_path, transformers.LlamaConfig, transformers.LlamaForCausalLM,
+        ).config.to_json_string()))
+        hf["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+        with pytest.raises(ValueError, match="rope_scaling"):
+            config_from_hf(hf)
+
+    def test_qwen2(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Qwen2Config, transformers.Qwen2ForCausalLM,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.qkv_bias
+
+    def test_mistral_sliding_window(self, tmp_path):
+        # window < T so the mask actually bites
+        m = _save_tiny(
+            tmp_path, transformers.MistralConfig, transformers.MistralForCausalLM,
+            sliding_window=8,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.sliding_window == 8
+        # and the windowed logits differ from a full-attention run
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(
+            config, remat=False, sliding_window=0
+        )
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, config.vocab_size, (B, T)))
+        full = llama.forward(params, tokens, config)
+        windowed = llama.forward(
+            params, tokens, llama.dataclasses.replace(config, sliding_window=8)
+        )
+        assert not np.allclose(np.asarray(full), np.asarray(windowed))
+
+    def test_gemma(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.GemmaConfig, transformers.GemmaForCausalLM,
+            head_dim=16,
+        )
+        cfg = _assert_parity(tmp_path, m, atol=5e-4)
+        assert cfg.norm_offset and cfg.embed_scale
+        assert cfg.hidden_act == "gelu_tanh"
+
+    def test_gemma2(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Gemma2Config, transformers.Gemma2ForCausalLM,
+            head_dim=16,
+            sliding_window=8,
+            attn_logit_softcapping=50.0,
+            final_logit_softcapping=30.0,
+            query_pre_attn_scalar=16,
+        )
+        cfg = _assert_parity(tmp_path, m, atol=5e-4)
+        assert cfg.post_norms and cfg.attn_softcap == 50.0
+        assert cfg.sliding_pattern == 2
+        # layer windows alternate sliding/global, HF convention
+        assert llama.layer_windows(cfg) == [8, 0, 8, 0]
+
+    def test_mixtral(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.MixtralConfig, transformers.MixtralForCausalLM,
+            num_local_experts=4, num_experts_per_tok=2,
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        # no-drop capacity so the static dispatch is exact vs HF's
+        # dynamic gather
+        config = llama.dataclasses.replace(
+            config, remat=False, capacity_factor=float(config.n_experts)
+        )
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, config.vocab_size, (B, T))
+        with torch.no_grad():
+            ref = m(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(params, jnp.asarray(tokens), config)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+
+class TestEngineParity:
+    """KV-cache decode (prefill + decode_step) vs HF greedy generation.
+
+    One family per engine-relevant delta group: gemma2 (norm offset,
+    sandwich norms, softcaps, alternating windows, embed scale), qwen2
+    (qkv bias), mixtral (MoE decode) — a flag ported to llama.forward
+    but missed in the engine fails here."""
+
+    def _assert_greedy_parity(self, tmp_path, hf_model, replace_cfg=None):
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(
+            config, remat=False, **(replace_cfg or {})
+        )
+        from dstack_tpu.serve.engine import decode_step, init_cache, prefill
+
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(1, config.vocab_size, (1, 12))
+        n_new = 8
+        with torch.no_grad():
+            hf_out = hf_model.generate(
+                torch.tensor(prompt), max_new_tokens=n_new, do_sample=False,
+                # tiny random models have no real eos; decode a fixed count
+                eos_token_id=None, pad_token_id=0,
+            ).numpy()[0, prompt.shape[1]:]
+
+        cache = init_cache(config, max_batch=1, max_seq=32)
+        logits, cache = prefill(
+            params, jnp.asarray(prompt), jnp.asarray([prompt.shape[1]]),
+            jnp.asarray(0), config, cache,
+        )
+        out = []
+        pos = prompt.shape[1]
+        for _ in range(n_new):
+            nxt = jnp.argmax(logits[0]).astype(jnp.int32)
+            out.append(int(nxt))
+            logits, cache = decode_step(
+                params, cache, jnp.asarray([nxt]), jnp.asarray([pos]), config
+            )
+            pos += 1
+        assert out == hf_out.tolist()
+
+    def test_gemma2_greedy_decode(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Gemma2Config, transformers.Gemma2ForCausalLM,
+            head_dim=16, sliding_window=8,
+            attn_logit_softcapping=50.0, final_logit_softcapping=30.0,
+            query_pre_attn_scalar=16,
+        )
+        self._assert_greedy_parity(tmp_path, m)
+
+    def test_qwen2_greedy_decode(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Qwen2Config, transformers.Qwen2ForCausalLM,
+        )
+        self._assert_greedy_parity(tmp_path, m)
+
+    def test_mixtral_greedy_decode(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.MixtralConfig, transformers.MixtralForCausalLM,
+            num_local_experts=4, num_experts_per_tok=2,
+        )
+        # no-drop capacity: static dispatch exact vs HF dynamic gather
+        self._assert_greedy_parity(
+            tmp_path, m, replace_cfg={"capacity_factor": 4.0}
+        )

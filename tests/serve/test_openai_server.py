@@ -155,3 +155,40 @@ class TestFinishReason:
             assert r.status == 400
         finally:
             await client.close()
+
+
+class TestHFModelServing:
+    async def test_serve_converted_hf_checkpoint(self, tmp_path):
+        """End-to-end: tiny HF llama → convert_hf → engine → /v1/completions."""
+        import pytest
+
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        import jax.numpy as jnp
+
+        from dstack_tpu.models.convert_hf import load_checkpoint
+
+        torch.manual_seed(0)
+        cfg = transformers.LlamaConfig(
+            vocab_size=300, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64,
+        )
+        transformers.LlamaForCausalLM(cfg).save_pretrained(tmp_path)
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)  # converter returns host arrays
+        config = llama.dataclasses.replace(config, remat=False)
+        engine = InferenceEngine(config, params, max_batch=2, max_seq=64)
+        app = build_app(engine, ByteTokenizer(), "hf-tiny")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "hf-tiny", "prompt": "ab", "max_tokens": 4},
+            )
+            assert r.status == 200
+            d = await r.json()
+            assert d["usage"]["completion_tokens"] >= 1
+        finally:
+            await client.close()
