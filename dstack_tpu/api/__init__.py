@@ -48,6 +48,40 @@ def write_client_config(url: str, token: str, project: str = "main") -> None:
     CLIENT_CONFIG_PATH.chmod(0o600)
 
 
+def load_profile(repo_dir, profile_name: Optional[str] = None):
+    """Load a profile from ``<repo>/.dtpu/profiles.yml`` (falling back
+    to ``~/.dtpu/profiles.yml``), reference ``api.utils.load_profile``:
+    named profile wins, else the ``default: true`` one; a missing name
+    is an error, no profiles at all yields the empty default profile.
+    """
+    from dstack_tpu.core.models.profiles import Profile, ProfilesConfig
+
+    def from_path(path: Path):
+        for p in (path, path.with_suffix(".yaml")):
+            if p.exists():
+                try:
+                    data = yaml.safe_load(p.read_text()) or {}
+                    config = ProfilesConfig.model_validate(data)
+                except Exception as e:
+                    raise ConfigurationError(f"invalid profiles file {p}: {e}")
+                if profile_name is not None:
+                    try:
+                        return config.get(profile_name)
+                    except KeyError:
+                        return None
+                return config.default()
+        return None
+
+    profile = from_path(Path(repo_dir) / ".dtpu" / "profiles.yml")
+    if profile is None:
+        profile = from_path(Path.home() / ".dtpu" / "profiles.yml")
+    if profile is None:
+        if profile_name is not None:
+            raise ConfigurationError(f"no such profile: {profile_name}")
+        return Profile(name="default")
+    return profile
+
+
 class RunCollection:
     def __init__(self, client: "Client"):
         self._c = client
@@ -57,9 +91,11 @@ class RunCollection:
         conf: Union[dict, AnyRunConfiguration],
         run_name: Optional[str] = None,
         repo_dir: Optional[str] = None,
+        profile=None,
     ) -> RunPlan:
         return self._c.api.get_run_plan(
-            self._c.project, self._spec(conf, run_name, repo_dir, upload=False)
+            self._c.project,
+            self._spec(conf, run_name, repo_dir, upload=False, profile=profile),
         )
 
     def apply_configuration(
@@ -67,13 +103,15 @@ class RunCollection:
         conf: Union[dict, AnyRunConfiguration],
         run_name: Optional[str] = None,
         repo_dir: Optional[str] = None,
+        profile=None,
     ) -> Run:
         """Submit a run. With ``repo_dir`` the working directory is
         packaged and uploaded first (archive for plain dirs, git diff for
         remote checkouts — reference api/_public/runs.py submit +
         repos upload)."""
         return self._c.api.apply_run(
-            self._c.project, self._spec(conf, run_name, repo_dir, upload=True)
+            self._c.project,
+            self._spec(conf, run_name, repo_dir, upload=True, profile=profile),
         )
 
     def _spec(
@@ -82,6 +120,7 @@ class RunCollection:
         run_name: Optional[str],
         repo_dir: Optional[str] = None,
         upload: bool = False,
+        profile=None,
     ) -> RunSpec:
         if isinstance(conf, dict):
             conf = parse_run_configuration(conf)
@@ -91,7 +130,10 @@ class RunCollection:
             _, ssh_key_pub = get_or_create_client_keypair()
         except Exception:
             ssh_key_pub = ""
-        spec = RunSpec(run_name=run_name, configuration=conf, ssh_key_pub=ssh_key_pub)
+        spec = RunSpec(
+            run_name=run_name, configuration=conf, ssh_key_pub=ssh_key_pub,
+            profile=profile,
+        )
         if repo_dir is not None:
             if not upload:
                 # plan-only: cheap metadata detection, no archive build

@@ -97,7 +97,12 @@ def config(url, token, project) -> None:
 @click.option(
     "--no-repo", is_flag=True, help="do not upload the working directory"
 )
-def apply(config_path, yes, detach, name, project, no_repo) -> None:
+@click.option(
+    "--profile", "profile_name", default=None,
+    help="profile from .dtpu/profiles.yml (or ~/.dtpu/profiles.yml); "
+         "default: the profile marked `default: true`",
+)
+def apply(config_path, yes, detach, name, project, no_repo, profile_name) -> None:
     """Apply a configuration (task/service/dev-environment/fleet/volume)."""
     from dstack_tpu.core.models.configurations import (
         FleetConfiguration,
@@ -125,13 +130,18 @@ def apply(config_path, yes, detach, name, project, no_repo) -> None:
             gw = client.api.create_gateway(client.project, conf)
             console.print(f"[green]Gateway {gw.name} submitted[/green]")
             return
-        repo_dir = None if no_repo else str(Path(config_path).resolve().parent)
-        plan = client.runs.get_plan(conf, run_name=name)
+        conf_dir = str(Path(config_path).resolve().parent)
+        repo_dir = None if no_repo else conf_dir
+        from dstack_tpu.api import load_profile
+
+        profile = load_profile(conf_dir, profile_name)
+        plan = client.runs.get_plan(conf, run_name=name, profile=profile)
         _print_plan(plan)
         if not yes and not click.confirm("Submit the run?", default=True):
             return
         run = client.runs.apply_configuration(
-            conf, run_name=plan.run_spec.run_name, repo_dir=repo_dir
+            conf, run_name=plan.run_spec.run_name, repo_dir=repo_dir,
+            profile=profile,
         )
         console.print(
             f"[green]Submitted[/green] run [bold]{run.run_spec.run_name}[/bold]"

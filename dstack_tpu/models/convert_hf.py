@@ -49,7 +49,20 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             f"{mt} checkpoint sets attention_bias=true, which this "
             "converter only supports for qwen2"
         )
+    act = hf.get("hidden_act") or "silu"
+    act_map = {"silu": "silu", "gelu_pytorch_tanh": "gelu_tanh"}
+    if mt in ("gemma", "gemma2"):
+        # Gemma configs historically say "gelu"/hidden_activation but
+        # the models always use the tanh approximation
+        act = "gelu_tanh"
+    elif act not in act_map:
+        raise ValueError(
+            f"unsupported hidden_act {act!r} (supported: {sorted(act_map)})"
+        )
+    else:
+        act = act_map[act]
     common = dict(
+        hidden_act=act,
         vocab_size=hf["vocab_size"],
         hidden_size=hidden,
         n_layers=hf["num_hidden_layers"],
@@ -67,6 +80,16 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     if mt == "llama":
         return LlamaConfig(**common)
     if mt == "qwen2":
+        if hf.get("use_sliding_window"):
+            # HF Qwen2 windows only layers >= max_window_layers — a
+            # layering our periodic sliding_pattern can't express except
+            # uniformly; refuse rather than silently run full attention
+            if hf.get("max_window_layers", 0) not in (0, None):
+                raise ValueError(
+                    "qwen2 use_sliding_window with max_window_layers > 0 "
+                    "is not supported"
+                )
+            common["sliding_window"] = hf.get("sliding_window") or 0
         # Qwen2 puts biases on q/k/v only (attention_bias is not in its
         # config; the arch always has them)
         return LlamaConfig(**common, qkv_bias=True)
@@ -75,14 +98,12 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     if mt == "gemma":
         return LlamaConfig(
             **{**common, "tie_embeddings": True},
-            hidden_act="gelu_tanh",
             norm_offset=True,
             embed_scale=True,
         )
     if mt == "gemma2":
         return LlamaConfig(
             **{**common, "tie_embeddings": True},
-            hidden_act="gelu_tanh",
             norm_offset=True,
             embed_scale=True,
             post_norms=True,

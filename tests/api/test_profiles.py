@@ -1,0 +1,73 @@
+"""Profile loading + merge: .dtpu/profiles.yml → RunSpec.profile →
+effective_profile (reference api.utils.load_profile + the RunSpec
+merged_profile root validator)."""
+
+import pytest
+
+from dstack_tpu.api import load_profile
+from dstack_tpu.core.errors import ConfigurationError
+from dstack_tpu.core.models.configurations import parse_run_configuration
+from dstack_tpu.core.models.runs import RunSpec
+
+PROFILES_YML = """
+profiles:
+  - name: spotty
+    spot_policy: spot
+    max_duration: 2h
+  - name: steady
+    default: true
+    spot_policy: on-demand
+    max_price: 5.0
+"""
+
+
+@pytest.fixture()
+def repo(tmp_path):
+    (tmp_path / ".dtpu").mkdir()
+    (tmp_path / ".dtpu" / "profiles.yml").write_text(PROFILES_YML)
+    return tmp_path
+
+
+class TestLoadProfile:
+    def test_named(self, repo):
+        p = load_profile(repo, "spotty")
+        assert p.spot_policy == "spot"
+        assert p.max_duration == 7200
+
+    def test_default_flag_wins_without_name(self, repo):
+        p = load_profile(repo)
+        assert p.name == "steady"
+        assert p.max_price == 5.0
+
+    def test_missing_name_raises(self, repo):
+        with pytest.raises(ConfigurationError, match="nope"):
+            load_profile(repo, "nope")
+
+    def test_no_profiles_file_gives_empty_default(self, tmp_path):
+        p = load_profile(tmp_path)
+        assert p.name == "default" and p.spot_policy is None
+
+    def test_yaml_suffix_fallback(self, tmp_path):
+        (tmp_path / ".dtpu").mkdir()
+        (tmp_path / ".dtpu" / "profiles.yaml").write_text(PROFILES_YML)
+        assert load_profile(tmp_path, "spotty").spot_policy == "spot"
+
+
+class TestProfileMerge:
+    def test_config_fields_win_over_profile(self, repo):
+        profile = load_profile(repo, "spotty")
+        conf = parse_run_configuration(
+            {"type": "task", "commands": ["true"], "spot_policy": "on-demand"}
+        )
+        spec = RunSpec(configuration=conf, profile=profile)
+        eff = spec.effective_profile()
+        assert eff.spot_policy == "on-demand"  # config overrides profile
+        assert eff.max_duration == 7200  # profile fills the gap
+
+    def test_profile_applies_when_config_silent(self, repo):
+        profile = load_profile(repo)  # steady
+        conf = parse_run_configuration({"type": "task", "commands": ["true"]})
+        spec = RunSpec(configuration=conf, profile=profile)
+        eff = spec.effective_profile()
+        assert eff.spot_policy == "on-demand"
+        assert eff.max_price == 5.0
