@@ -306,7 +306,6 @@ class RunSpec(CoreModel):
     configuration: AnyRunConfiguration
     profile: Optional[Profile] = None
     ssh_key_pub: str = ""
-    merged_profile: Optional[Profile] = None
 
     def effective_profile(self) -> Profile:
         """Run-config fields win over profile fields

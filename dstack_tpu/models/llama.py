@@ -67,6 +67,10 @@ class LlamaConfig:
     # high_freq_factor, original_max_position_embeddings); None = plain
     # rope_theta frequencies
     rope_scaling: Optional[tuple] = None
+    # sequence-parallel strategy on sp>1 meshes: "ring" (KV rotation,
+    # any head count, lowest memory) or "ulysses" (head⇄seq all_to_all,
+    # needs n_heads % sp == 0, keeps the flash kernel for windows)
+    seq_parallel: str = "ring"
 
     @property
     def q_dim(self) -> int:
@@ -422,8 +426,15 @@ def _attention_block(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     scale = c.attention_scale
-    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
-    if use_ring:
+    use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if use_sp and c.seq_parallel == "ulysses":
+        from dstack_tpu.parallel.ulysses import ulysses_attention
+
+        o = ulysses_attention(
+            q, k, v, mesh=mesh, causal=True, scale=scale,
+            window=window, softcap=c.attn_softcap,
+        )
+    elif use_sp:
         o = ring_attention(
             q, k, v, mesh=mesh, causal=True, scale=scale,
             window=window, softcap=c.attn_softcap,

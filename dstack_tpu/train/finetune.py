@@ -41,6 +41,10 @@ def main(argv=None) -> int:
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--fsdp", type=int, default=-1)
     p.add_argument("--sp", type=int, default=1)
+    p.add_argument(
+        "--seq-parallel", default=None, choices=["ring", "ulysses"],
+        help="sequence-parallel strategy on sp>1 meshes (default: ring)",
+    )
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--data", default=None, help="pre-tokenized .npy [N, T] corpus")
     p.add_argument("--out", default="adapters", help="output dir for weights")
@@ -91,6 +95,8 @@ def main(argv=None) -> int:
         args.model = os.path.basename(os.path.normpath(args.hf_model))
     else:
         config = llama.CONFIGS[args.model]
+    if args.seq_parallel:
+        config = llama.dataclasses.replace(config, seq_parallel=args.seq_parallel)
     mesh = make_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, sp=args.sp, tp=args.tp))
     n_chips = len(jax.devices())
     print(

@@ -1,6 +1,8 @@
 """Profile loading + merge: .dtpu/profiles.yml → RunSpec.profile →
-effective_profile (reference api.utils.load_profile + the RunSpec
-merged_profile root validator)."""
+effective_profile (reference api.utils.load_profile + RunSpec's
+merged-profile semantics)."""
+
+from pathlib import Path
 
 import pytest
 
@@ -19,6 +21,15 @@ profiles:
     spot_policy: on-demand
     max_price: 5.0
 """
+
+
+@pytest.fixture(autouse=True)
+def isolated_home(tmp_path_factory, monkeypatch):
+    # load_profile falls back to ~/.dtpu/profiles.yml — a developer's
+    # real home must not leak into (or break) these tests
+    home = tmp_path_factory.mktemp("home")
+    monkeypatch.setattr(Path, "home", staticmethod(lambda: home))
+    return home
 
 
 @pytest.fixture()
