@@ -94,7 +94,7 @@ def ulysses_attention(
     v = _expand_kv(v, h, sp)
 
     def local_fn(q, k, v):
-        # [B, h_local? no: B, H, T/sp, D] → scatter heads / gather seq
+        # local [B, H, T/sp, D] → scatter heads / gather sequence
         def seq_to_heads(x):
             return jax.lax.all_to_all(
                 x, axis_name, split_axis=1, concat_axis=2, tiled=True

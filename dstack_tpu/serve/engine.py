@@ -38,7 +38,11 @@ class GenParams:
     max_new_tokens: int = 256
     temperature: float = 0.0  # 0 = greedy
     top_p: float = 1.0
+    top_k: int = 0  # 0 = off
+    repetition_penalty: float = 1.0  # HF-style multiplicative; 1 = off
+    seed: Optional[int] = None  # per-request sampling seed
     eos_id: Optional[int] = None
+    stop: Optional[list] = None  # stop strings (matched by the server)
 
 
 # ---------------------------------------------------------------------------
@@ -290,18 +294,47 @@ def decode_step(
 
 def sample(
     logits: jax.Array,  # [B, V] f32
-    key: jax.Array,
+    key_data: jax.Array,  # [B, 2] uint32 per-slot PRNG key data
     temperature: jax.Array,  # [B]
     top_p: jax.Array,  # [B]
-) -> jax.Array:
-    """Greedy when temperature == 0, else top-p/temperature sampling —
-    all branches computed, selected per slot (static shapes)."""
+    top_k: jax.Array,  # [B] int32, 0 = off
+    rep_pen: jax.Array,  # [B] f32, 1.0 = off
+    seen: jax.Array,  # [B, V] bool: tokens in prompt or generated so far
+) -> tuple[jax.Array, jax.Array]:
+    """→ (tokens [B], advanced key_data). Greedy when temperature == 0,
+    else repetition-penalized temperature/top-k/top-p sampling — all
+    branches computed, selected per slot (static shapes). Per-slot keys
+    make a request's stream deterministic under its ``seed`` regardless
+    of which other slots are active."""
+    v = logits.shape[-1]
+    # HF repetition penalty: previously-seen tokens get logit/p when
+    # positive, logit*p when negative (p > 1 discourages repeats)
+    pen = rep_pen[:, None]
+    penalized = jnp.where(logits > 0, logits / pen, logits * pen)
+    logits = jnp.where(seen & (pen != 1.0), penalized, logits)
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # ONE [B, V] descending sort serves both filters — at a 128k vocab
+    # the sort dominates per-token sampling cost
+    sorted_full = jnp.sort(scaled, axis=-1)[:, ::-1]
+    # top-k: drop everything below the k-th largest logit (ties at the
+    # k-th value survive, HF TopKLogitsWarper semantics)
+    kth_ix = jnp.clip(top_k - 1, 0, v - 1)
+    kth = jnp.take_along_axis(sorted_full, kth_ix[:, None], axis=-1)
+    scaled = jnp.where(
+        (top_k[:, None] > 0) & (scaled < kth), NEG_INF, scaled
+    )
+    # the sorted view of the top-k-filtered logits is the full sort with
+    # positions >= k masked (entries past the nucleus get ~0 prob)
+    sorted_logits = jnp.where(
+        (top_k[:, None] > 0)
+        & (jnp.arange(v)[None, :] >= jnp.maximum(top_k, 1)[:, None]),
+        NEG_INF,
+        sorted_full,
+    )
     # top-p: mask tokens beyond the nucleus. top_p >= 1 bypasses the
     # mask entirely — f32 cumsum over a big vocab may never reach 1.0,
     # which would silently collapse "full distribution" to greedy.
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
     sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
     cumulative = jnp.cumsum(sorted_probs, axis=-1)
     # smallest k with cumsum >= top_p; keep everything before it
@@ -309,8 +342,28 @@ def sample(
     cutoff = jnp.take_along_axis(sorted_logits, cutoff_ix[:, None], axis=-1)
     masked = jnp.where(scaled >= cutoff, scaled, NEG_INF)
     masked = jnp.where(top_p[:, None] >= 1.0, scaled, masked)
-    sampled = jax.random.categorical(key, masked, axis=-1)
-    return jnp.where(temperature <= 0.0, greedy, sampled)
+    keys = jax.vmap(jax.random.wrap_key_data)(key_data)
+    splits = jax.vmap(lambda k: jax.random.split(k, 2))(keys)  # [B, 2]
+    sampled = jax.vmap(jax.random.categorical)(splits[:, 1], masked)
+    tokens = jnp.where(temperature <= 0.0, greedy, sampled)
+    return tokens, jax.vmap(jax.random.key_data)(splits[:, 0])
+
+
+def _mark_seen(seen: jax.Array, rows: jax.Array, tokens: jax.Array) -> jax.Array:
+    """seen[rows[i], tokens[i]] = True (donated in-place update)."""
+    return seen.at[rows, tokens].set(True)
+
+
+def _mark_prompt(
+    seen: jax.Array, slot: jax.Array, padded: jax.Array, tp: jax.Array
+) -> jax.Array:
+    """Reset slot's row, then mark the prompt's first ``tp`` tokens
+    (padding indices are pushed out of range and dropped)."""
+    v = seen.shape[-1]
+    row = jnp.zeros((v,), bool)
+    idx = jnp.where(jnp.arange(padded.shape[0]) < tp, padded, v)
+    row = row.at[idx].set(True, mode="drop")
+    return seen.at[slot].set(row)
 
 
 # ---------------------------------------------------------------------------
@@ -373,7 +426,7 @@ class InferenceEngine:
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.cache = init_cache(config, max_batch, max_seq, mesh=mesh)
-        self._key = jax.random.key(seed)
+        self._auto_seed = seed
         # per-slot host state
         self.lengths = [0] * max_batch  # tokens currently in cache
         self.active = [False] * max_batch
@@ -382,7 +435,13 @@ class InferenceEngine:
         self.last_token = [0] * max_batch
         self.temps = [0.0] * max_batch
         self.top_ps = [1.0] * max_batch
+        self.top_ks = [0] * max_batch
+        self.rep_pens = [1.0] * max_batch
         self.finish_reason = [None] * max_batch  # "stop" | "length" once done
+        # per-slot device state: PRNG keys + seen-token presence for the
+        # repetition penalty ([B, V] bool — ~1MB at a 128k vocab)
+        self._key_data = jnp.zeros((max_batch, 2), jnp.uint32)
+        self._seen = jnp.zeros((max_batch, config.vocab_size), bool)
 
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
@@ -393,6 +452,8 @@ class InferenceEngine:
             partial(decode_step, config=config), donate_argnums=(1,)
         )
         self._sample = jax.jit(sample)
+        self._mark_seen = jax.jit(_mark_seen, donate_argnums=0)
+        self._mark_prompt = jax.jit(_mark_prompt, donate_argnums=0)
 
     def free_slots(self) -> list[int]:
         return [i for i in range(self.max_batch) if not self.active[i]]
@@ -432,14 +493,32 @@ class InferenceEngine:
             jnp.asarray(slot, jnp.int32),
             cache=self.cache,
         )
-        self._key, sub = jax.random.split(self._key)
-        tok = int(
-            self._sample(
-                logits,
-                sub,
-                jnp.asarray([gen.temperature], jnp.float32),
-                jnp.asarray([gen.top_p], jnp.float32),
-            )[0]
+        # per-request PRNG stream: explicit seed or a fresh auto seed
+        if gen.seed is not None:
+            req_seed = int(gen.seed)
+        else:
+            self._auto_seed += 1
+            req_seed = self._auto_seed
+        self._key_data = self._key_data.at[slot].set(
+            jax.random.key_data(jax.random.key(req_seed))
+        )
+        self._seen = self._mark_prompt(
+            self._seen, jnp.asarray(slot), tokens[0],
+            jnp.asarray(tp, jnp.int32),
+        )
+        toks, kd = self._sample(
+            logits,
+            self._key_data[slot:slot + 1],
+            jnp.asarray([gen.temperature], jnp.float32),
+            jnp.asarray([gen.top_p], jnp.float32),
+            jnp.asarray([gen.top_k], jnp.int32),
+            jnp.asarray([gen.repetition_penalty], jnp.float32),
+            self._seen[slot:slot + 1],
+        )
+        tok = int(toks[0])
+        self._key_data = self._key_data.at[slot].set(kd[0])
+        self._seen = self._mark_seen(
+            self._seen, jnp.asarray([slot]), jnp.asarray([tok])
         )
         self.active[slot] = True
         self.lengths[slot] = tp
@@ -448,6 +527,8 @@ class InferenceEngine:
         self.last_token[slot] = tok
         self.temps[slot] = gen.temperature
         self.top_ps[slot] = gen.top_p
+        self.top_ks[slot] = gen.top_k
+        self.rep_pens[slot] = gen.repetition_penalty
         self.finish_reason[slot] = None
         if tok == gen.eos_id or gen.max_new_tokens <= 1:
             # finished immediately; slot never enters the decode loop
@@ -466,14 +547,19 @@ class InferenceEngine:
         logits, self.cache = self._decode(
             self.params, self.cache, tokens, positions
         )
-        self._key, sub = jax.random.split(self._key)
-        sampled = self._sample(
+        sampled_dev, self._key_data = self._sample(
             logits,
-            sub,
+            self._key_data,
             jnp.asarray(self.temps, jnp.float32),
             jnp.asarray(self.top_ps, jnp.float32),
+            jnp.asarray(self.top_ks, jnp.int32),
+            jnp.asarray(self.rep_pens, jnp.float32),
+            self._seen,
         )
-        sampled = jax.device_get(sampled)
+        self._seen = self._mark_seen(
+            self._seen, jnp.arange(self.max_batch), sampled_dev
+        )
+        sampled = jax.device_get(sampled_dev)
         out: dict[int, int] = {}
         for i in live:
             tok = int(sampled[i])

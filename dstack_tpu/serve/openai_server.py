@@ -38,6 +38,7 @@ class _Request:
         self.error: Optional[str] = None
         self.finish_reason: Optional[str] = None
         self.cancelled = False
+        self.gen_ids: list[int] = []  # for stop-string matching
 
 
 class Scheduler:
@@ -87,6 +88,23 @@ class Scheduler:
                     req.queue.put_nowait(None)
                 self.by_slot.clear()
 
+    def _hit_stop(self, req: _Request, tok: int) -> bool:
+        """Track generated ids; True once a stop string appears in the
+        decoded text. Streaming clients may have already received tokens
+        that form the stop string's head — generation halts as soon as
+        the match is visible; non-streaming handlers truncate the text.
+
+        Only a bounded tail is decoded per token (a token decodes to at
+        least ~1 char, so max-stop-len + slack tokens cover any match
+        crossing the newest token) — full-text rescans would be O(n²)
+        over the generation."""
+        req.gen_ids.append(tok)
+        if not req.gen.stop:
+            return False
+        keep = max(len(t) for t in req.gen.stop) + 8
+        text = self.tokenizer.decode(req.gen_ids[-keep:])
+        return any(t in text for t in req.gen.stop)
+
     async def _tick(self) -> None:
         # admit pending requests while slots are free
         while not self.pending.empty() and self.engine.free_slots():
@@ -108,6 +126,11 @@ class Scheduler:
                 continue
             if first != req.gen.eos_id:
                 req.queue.put_nowait(first)
+                if self._hit_stop(req, first):
+                    self.engine.release(slot)
+                    req.finish_reason = "stop"
+                    req.queue.put_nowait(None)
+                    continue
             if self.engine.active[slot]:
                 self.by_slot[slot] = req
             else:
@@ -125,6 +148,12 @@ class Scheduler:
                 continue
             if tok != req.gen.eos_id:
                 req.queue.put_nowait(tok)
+                if self._hit_stop(req, tok):
+                    self.engine.release(slot)
+                    req.finish_reason = "stop"
+                    req.queue.put_nowait(None)
+                    del self.by_slot[slot]
+                    continue
             if not self.engine.active[slot]:
                 req.finish_reason = self.engine.finish_reason[slot]
                 req.queue.put_nowait(None)
@@ -132,12 +161,38 @@ class Scheduler:
         await asyncio.sleep(0)
 
 
+def _truncate_stop(text: str, stop) -> str:
+    """Cut the completion at the first stop-string occurrence."""
+    if not stop:
+        return text
+    cut = len(text)
+    for t in stop:
+        i = text.find(t)
+        if i != -1:
+            cut = min(cut, i)
+    return text[:cut]
+
+
 def _gen_params(payload: dict, tokenizer: Tokenizer) -> GenParams:
+    stop = payload.get("stop")
+    if isinstance(stop, str):
+        stop = [stop]
+    elif not (
+        isinstance(stop, list) and all(isinstance(s, str) for s in stop)
+    ):
+        stop = None
+    if stop:  # an empty string would match every completion immediately
+        stop = [s for s in stop if s]
+    seed = payload.get("seed")
     return GenParams(
         max_new_tokens=int(payload.get("max_tokens") or 256),
         temperature=float(payload.get("temperature") or 0.0),
         top_p=float(payload.get("top_p") or 1.0),
+        top_k=int(payload.get("top_k") or 0),
+        repetition_penalty=float(payload.get("repetition_penalty") or 1.0),
+        seed=int(seed) if seed is not None else None,
         eos_id=tokenizer.eos_id,
+        stop=stop or None,
     )
 
 
@@ -271,7 +326,7 @@ def build_app(
             sched.cancel(req)
         if req.error:
             return web.json_response({"detail": req.error}, status=500)
-        text = tokenizer.decode(ids)
+        text = _truncate_stop(tokenizer.decode(ids), req.gen.stop)
         return web.json_response(
             {
                 "id": completion_id,
@@ -322,7 +377,9 @@ def build_app(
                 "choices": [
                     {
                         "index": 0,
-                        "text": tokenizer.decode(ids),
+                        "text": _truncate_stop(
+                            tokenizer.decode(ids), req.gen.stop
+                        ),
                         "finish_reason": req.finish_reason or "stop",
                     }
                 ],

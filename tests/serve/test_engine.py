@@ -84,35 +84,69 @@ class TestDecode:
             _reference_greedy(self.params, self.config, p_long, 3)
 
 
+def _sample(logits, seeds, temps, top_ps, top_ks=None, rep_pens=None, seen=None):
+    """Thin wrapper: per-row seeds → key_data; defaults for new knobs."""
+    b, v = logits.shape
+    kd = jnp.stack(
+        [jax.random.key_data(jax.random.key(s)) for s in seeds]
+    )
+    toks, _ = sample(
+        logits, kd, jnp.asarray(temps), jnp.asarray(top_ps),
+        jnp.asarray(top_ks if top_ks is not None else [0] * b, jnp.int32),
+        jnp.asarray(rep_pens if rep_pens is not None else [1.0] * b, jnp.float32),
+        seen if seen is not None else jnp.zeros((b, v), bool),
+    )
+    return toks
+
+
 class TestSampling:
     def test_greedy_at_zero_temperature(self):
         logits = jnp.asarray([[0.0, 5.0, 1.0], [2.0, 0.0, -1.0]], jnp.float32)
-        out = sample(
-            logits, jax.random.key(0),
-            jnp.asarray([0.0, 0.0]), jnp.asarray([1.0, 1.0]),
-        )
+        out = _sample(logits, [0, 0], [0.0, 0.0], [1.0, 1.0])
         assert list(np.asarray(out)) == [1, 0]
 
     def test_top_p_narrow_nucleus_is_greedy(self):
         logits = jnp.asarray([[0.0, 5.0, 1.0]], jnp.float32)
-        out = sample(
-            logits, jax.random.key(1),
-            jnp.asarray([1.0]), jnp.asarray([1e-6]),
-        )
+        out = _sample(logits, [1], [1.0], [1e-6])
         assert int(out[0]) == 1
 
     def test_sampling_valid_and_varied(self):
         logits = jnp.zeros((1, 16), jnp.float32)  # uniform
         seen = set()
         for i in range(12):
-            out = sample(
-                logits, jax.random.key(i),
-                jnp.asarray([1.0]), jnp.asarray([1.0]),
-            )
+            out = _sample(logits, [i], [1.0], [1.0])
             tok = int(out[0])
             assert 0 <= tok < 16
             seen.add(tok)
         assert len(seen) > 1  # actually sampling, not collapsing
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.asarray([[0.0, 3.0, 2.0, 1.0, -1.0]] * 1, jnp.float32)
+        for i in range(10):
+            out = _sample(logits, [i], [5.0], [1.0], top_ks=[2])
+            assert int(out[0]) in (1, 2)  # only the top-2 logits
+
+    def test_repetition_penalty_flips_argmax(self):
+        # token 1 leads, but was seen; a strong penalty hands the
+        # argmax to unseen token 2
+        logits = jnp.asarray([[0.0, 2.0, 1.9]], jnp.float32)
+        seen = jnp.zeros((1, 3), bool).at[0, 1].set(True)
+        out = _sample(
+            logits, [0], [0.0], [1.0], rep_pens=[2.0], seen=seen
+        )
+        assert int(out[0]) == 2
+        # penalty off: argmax stays at 1 even though seen
+        out = _sample(logits, [0], [0.0], [1.0], rep_pens=[1.0], seen=seen)
+        assert int(out[0]) == 1
+
+    def test_seeded_streams_deterministic(self):
+        logits = jnp.zeros((2, 32), jnp.float32)
+        a = _sample(logits, [7, 9], [1.0, 1.0], [1.0, 1.0])
+        b = _sample(logits, [7, 9], [1.0, 1.0], [1.0, 1.0])
+        assert list(np.asarray(a)) == list(np.asarray(b))
+        # a slot's stream depends only on its own key
+        c = _sample(logits, [7, 123], [1.0, 1.0], [1.0, 1.0])
+        assert int(a[0]) == int(c[0])
 
 
 class TestTensorParallelServing:
