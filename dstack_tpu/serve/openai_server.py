@@ -94,14 +94,14 @@ class Scheduler:
         that form the stop string's head — generation halts as soon as
         the match is visible; non-streaming handlers truncate the text.
 
-        Only a bounded tail is decoded per token (a token decodes to at
-        least ~1 char, so max-stop-len + slack tokens cover any match
-        crossing the newest token) — full-text rescans would be O(n²)
-        over the generation."""
+        Only a bounded tail is decoded per token — full-text rescans
+        would be O(n²) over the generation. A char can span up to 4
+        tokens (byte-level tokenizers emit one token per UTF-8 byte),
+        so the window is 4× the longest stop string plus slack."""
         req.gen_ids.append(tok)
         if not req.gen.stop:
             return False
-        keep = max(len(t) for t in req.gen.stop) + 8
+        keep = 4 * max(len(t) for t in req.gen.stop) + 8
         text = self.tokenizer.decode(req.gen_ids[-keep:])
         return any(t in text for t in req.gen.stop)
 
@@ -171,6 +171,22 @@ def _truncate_stop(text: str, stop) -> str:
         if i != -1:
             cut = min(cut, i)
     return text[:cut]
+
+
+def _stop_holdback(text: str, stop) -> int:
+    """Chars to withhold from streaming: the longest trailing substring
+    of ``text`` that is a proper prefix of some stop string (it may
+    complete into the stop sequence on the next token — OpenAI streams
+    never deliver any part of a stop sequence)."""
+    if not stop:
+        return 0
+    hold = 0
+    for t in stop:
+        for p in range(min(len(t) - 1, len(text)), 0, -1):
+            if text.endswith(t[:p]):
+                hold = max(hold, p)
+                break
+    return hold
 
 
 def _gen_params(payload: dict, tokenizer: Tokenizer) -> GenParams:
@@ -261,36 +277,56 @@ def build_app(
             # deltas come from re-decoding the accumulated ids: per-token
             # decode would corrupt multi-byte UTF-8 and BPE boundaries.
             # Trailing replacement chars (split multi-byte sequences) are
-            # held back until the next token completes them.
+            # held back until the next token completes them; so is any
+            # trailing prefix of a stop string (OpenAI semantics: no
+            # part of a stop sequence is ever delivered).
             ids: list[int] = []
             sent = ""
+
+            def emittable() -> str:
+                full = tokenizer.decode(ids)
+                while full.endswith("�"):
+                    full = full[:-1]
+                full = _truncate_stop(full, req.gen.stop)
+                return full[: len(full) - _stop_holdback(full, req.gen.stop)]
+
+            async def emit(delta: str) -> None:
+                chunk = {
+                    "id": completion_id,
+                    "object": "chat.completion.chunk",
+                    "created": created,
+                    "model": model_name,
+                    "choices": [
+                        {
+                            "index": 0,
+                            "delta": {"role": "assistant", "content": delta},
+                            "finish_reason": None,
+                        }
+                    ],
+                }
+                await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+
             try:
                 while True:
                     tok = await req.queue.get()
                     if tok is None:
                         break
                     ids.append(tok)
+                    out = emittable()
+                    delta = out[len(sent):]
+                    if not delta:
+                        continue
+                    sent = out
+                    await emit(delta)
+                # generation over: flush held-back text that never
+                # completed into a stop string (minus any true stop cut)
+                if ids:
                     full = tokenizer.decode(ids)
                     while full.endswith("�"):
                         full = full[:-1]
-                    delta = full[len(sent):]
-                    if not delta:
-                        continue
-                    sent = full
-                    chunk = {
-                        "id": completion_id,
-                        "object": "chat.completion.chunk",
-                        "created": created,
-                        "model": model_name,
-                        "choices": [
-                            {
-                                "index": 0,
-                                "delta": {"role": "assistant", "content": delta},
-                                "finish_reason": None,
-                            }
-                        ],
-                    }
-                    await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
+                    tail = _truncate_stop(full, req.gen.stop)[len(sent):]
+                    if tail:
+                        await emit(tail)
             finally:
                 sched.cancel(req)  # no-op when finished; frees the slot on disconnect
             if req.error:

@@ -46,7 +46,30 @@ def main(argv=None) -> int:
         help="sequence-parallel strategy on sp>1 meshes (default: ring)",
     )
     p.add_argument("--tp", type=int, default=1)
-    p.add_argument("--data", default=None, help="pre-tokenized .npy [N, T] corpus")
+    p.add_argument(
+        "--data", default=None,
+        help="corpus: pre-tokenized .npy/.bin, or .jsonl/.txt with "
+             "--data-tokenizer (train/data.py pipeline)",
+    )
+    p.add_argument(
+        "--data-tokenizer", default=None,
+        help="local HF tokenizer path for text corpora",
+    )
+    p.add_argument("--data-seed", type=int, default=0, help="shuffle seed")
+    p.add_argument(
+        "--data-bin-dtype", default="uint16", choices=["uint16", "uint32"],
+        help="token width of .bin corpora",
+    )
+    p.add_argument(
+        "--eval-data", default=None,
+        help="held-out corpus (same formats); evaluated every "
+             "--eval-every steps and at the end",
+    )
+    p.add_argument("--eval-every", type=int, default=0, help="0 = final only")
+    p.add_argument(
+        "--eval-batches", type=int, default=32,
+        help="max eval batches per evaluation",
+    )
     p.add_argument("--out", default="adapters", help="output dir for weights")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument(
@@ -133,33 +156,41 @@ def main(argv=None) -> int:
                 print(f"resumed from checkpoint step {start_step}", flush=True)
         checkpointer = Checkpointer(args.ckpt_dir)
 
-    if args.data:
-        import numpy as np
+    from dstack_tpu.train.data import batches, load_tokens, prefetch_to_device
+    from dstack_tpu.train.step import batch_sharding, rules_for_mesh
 
-        corpus = np.load(args.data)
-        if corpus.shape[0] < args.batch:
-            p.error(
-                f"corpus has {corpus.shape[0]} rows < batch size {args.batch}"
-            )
-        if corpus.shape[1] < args.seq_len:
-            p.error(
-                f"corpus seq len {corpus.shape[1]} < requested {args.seq_len}"
-            )
-
-    def _make_batch(tok):
-        # the roll wraps the last target to the sequence's first token —
-        # mask that position out instead of training on garbage
-        mask = jnp.ones_like(tok).at[:, -1].set(0)
-        return {"tokens": tok, "targets": jnp.roll(tok, -1, axis=1), "mask": mask}
+    bsh = batch_sharding(mesh, rules_for_mesh(mesh))
 
     if args.data:
+        try:
+            rows = load_tokens(
+                args.data, args.seq_len,
+                tokenizer=args.data_tokenizer,
+                bin_dtype=args.data_bin_dtype,
+            )
+        except ValueError as e:
+            p.error(str(e))
+        if rows.shape[0] < args.batch:
+            p.error(
+                f"corpus packs to {rows.shape[0]} rows < batch {args.batch}"
+            )
+        data_iter = prefetch_to_device(
+            batches(rows, args.batch, seed=args.data_seed), sharding=bsh
+        )
 
         def next_batch(i):
-            idx = (i * args.batch) % (corpus.shape[0] - args.batch + 1)
-            return _make_batch(
-                jnp.asarray(corpus[idx : idx + args.batch, : args.seq_len])
-            )
+            return next(data_iter)
     else:
+
+        def _make_batch(tok):
+            # the roll wraps the last target to the sequence's first
+            # token — mask that position out instead of training on it
+            mask = jnp.ones_like(tok).at[:, -1].set(0)
+            return {
+                "tokens": tok,
+                "targets": jnp.roll(tok, -1, axis=1),
+                "mask": mask,
+            }
 
         def next_batch(i):
             return _make_batch(
@@ -170,6 +201,62 @@ def main(argv=None) -> int:
                     config.vocab_size,
                 )
             )
+
+    eval_iterable = None
+    if args.eval_data:
+        from dstack_tpu.train.step import cross_entropy_loss
+
+        try:
+            eval_rows = load_tokens(
+                args.eval_data, args.seq_len,
+                tokenizer=args.data_tokenizer,
+                bin_dtype=args.data_bin_dtype,
+            )
+        except ValueError as e:
+            p.error(str(e))
+        if eval_rows.shape[0] < args.batch:
+            p.error(
+                f"eval corpus packs to {eval_rows.shape[0]} rows "
+                f"< batch {args.batch}"
+            )
+        lora_scale = 0.0 if args.full else lora_conf.scale
+
+        def _eval_fwd(params, lora, batch):
+            logits = llama.forward(
+                params, batch["tokens"], config, mesh=mesh,
+                lora=lora, lora_scale=lora_scale,
+            )
+            loss, w = cross_entropy_loss(
+                logits, batch["targets"], batch.get("mask")
+            )
+            return loss, w
+
+        eval_fwd = jax.jit(_eval_fwd)
+
+        def run_eval(tag: str) -> None:
+            total, weight = 0.0, 0.0
+            it = batches(
+                eval_rows, args.batch, seed=0, epochs=1, drop_last=True
+            )
+            for n, b in enumerate(prefetch_to_device(it, sharding=bsh)):
+                if n >= args.eval_batches:
+                    break
+                eval_params = state["params"] if args.full else params
+                eval_lora = None if args.full else state["lora"]
+                loss, w = eval_fwd(eval_params, eval_lora, b)
+                loss, w = float(jax.device_get(loss)), float(jax.device_get(w))
+                total += loss * w
+                weight += w
+            if weight:
+                mean = total / weight
+                import math as _math
+
+                print(
+                    f"eval[{tag}] loss={mean:.4f} ppl={_math.exp(min(mean, 30)):.2f}",
+                    flush=True,
+                )
+
+        eval_iterable = run_eval
 
     ftok = flops_per_token(config, args.seq_len)
     tokens_per_step = args.batch * args.seq_len
@@ -197,6 +284,10 @@ def main(argv=None) -> int:
                 ),
                 flush=True,
             )
+        if eval_iterable is not None and args.eval_every and (
+            i + 1
+        ) % args.eval_every == 0:
+            eval_iterable(f"step {i + 1}")
         if (i + 1) % args.log_every == 0:
             loss = float(jax.device_get(metrics["loss"]))
             dt = time.perf_counter() - t_window
@@ -208,6 +299,9 @@ def main(argv=None) -> int:
                 f"mfu~{ftok * tps / n_chips / 197e12:.2%}",
                 flush=True,
             )
+
+    if eval_iterable is not None:
+        eval_iterable("final")
 
     if checkpointer is not None:
         checkpointer.close()  # drain in-flight background writes

@@ -1,0 +1,110 @@
+"""Data pipeline: packing, sources, iteration, prefetch."""
+
+import numpy as np
+import pytest
+
+from dstack_tpu.train import data as D
+
+
+class TestPacking:
+    def test_pack_exact_rows(self):
+        docs = [np.arange(1, 10), np.arange(10, 15)]  # 9 + eos + 5 + eos = 16
+        rows = D.pack_documents(docs, seq_len=7, eos_id=0)
+        assert rows.shape == (2, 8)
+        stream = rows.reshape(-1)
+        assert list(stream[:10]) == [1, 2, 3, 4, 5, 6, 7, 8, 9, 0]
+
+    def test_pack_keeps_existing_eos(self):
+        docs = [np.asarray([1, 2, 0])]  # already EOS-terminated
+        rows = D.pack_documents(docs + [np.asarray([3])], seq_len=4, eos_id=0)
+        assert list(rows[0]) == [1, 2, 0, 3, 0]
+
+    def test_too_small_corpus_raises(self):
+        with pytest.raises(ValueError, match="too small"):
+            D.pack_documents([np.asarray([1, 2])], seq_len=100)
+
+
+class TestSources:
+    def test_npy_rows_already_packed(self, tmp_path):
+        rows = np.arange(33 * 4, dtype=np.int32).reshape(4, 33)
+        f = tmp_path / "c.npy"
+        np.save(f, rows)
+        out = D.load_tokens(str(f), seq_len=32)
+        np.testing.assert_array_equal(out, rows)
+
+    def test_npy_rows_repacked(self, tmp_path):
+        rows = np.ones((4, 100), np.int32)
+        f = tmp_path / "c.npy"
+        np.save(f, rows)
+        out = D.load_tokens(str(f), seq_len=32, eos_id=7)
+        assert out.shape[1] == 33
+
+    def test_flat_bin_uint16(self, tmp_path):
+        stream = np.arange(1, 200, dtype=np.uint16)
+        f = tmp_path / "c.bin"
+        stream.tofile(f)
+        out = D.load_tokens(str(f), seq_len=32)
+        assert out.shape == (6, 33)
+        assert list(out[0][:5]) == [1, 2, 3, 4, 5]
+
+    def test_flat_bin_uint32(self, tmp_path):
+        stream = np.arange(1, 200, dtype=np.uint32)
+        f = tmp_path / "c.bin"
+        stream.tofile(f)
+        out = D.load_tokens(str(f), seq_len=32, bin_dtype="uint32")
+        assert out.shape == (6, 33)
+        assert list(out[0][:3]) == [1, 2, 3]
+
+    def test_bad_bin_dtype_rejected(self, tmp_path):
+        f = tmp_path / "c.bin"
+        np.arange(100, dtype=np.uint16).tofile(f)
+        with pytest.raises(ValueError, match="bin_dtype"):
+            D.load_tokens(str(f), seq_len=8, bin_dtype="float32")
+
+    def test_jsonl_uses_tokenizer(self, tmp_path, monkeypatch):
+        f = tmp_path / "c.jsonl"
+        f.write_text('{"text": "hello"}\n{"text": "world"}\n')
+        monkeypatch.setattr(
+            D, "_tokenize_texts",
+            lambda texts, tok: [np.arange(1, 40, dtype=np.int32) for _ in texts],
+        )
+        out = D.load_tokens(str(f), seq_len=16, tokenizer="fake")
+        assert out.shape[1] == 17
+
+    def test_jsonl_without_tokenizer_raises(self, tmp_path):
+        f = tmp_path / "c.jsonl"
+        f.write_text('{"text": "x"}\n')
+        with pytest.raises(ValueError, match="tokenizer"):
+            D.load_tokens(str(f), seq_len=16)
+
+
+class TestIteration:
+    def test_batches_shift_targets(self):
+        rows = np.arange(4 * 9, dtype=np.int32).reshape(4, 9)
+        b = next(D.batches(rows, batch_size=4, seed=0))
+        np.testing.assert_array_equal(b["tokens"][:, 1:], b["targets"][:, :-1])
+        assert b["tokens"].shape == (4, 8)
+        assert b["mask"].all()
+
+    def test_epochs_and_shuffling(self):
+        rows = np.arange(8 * 5, dtype=np.int32).reshape(8, 5)
+        got = list(D.batches(rows, batch_size=4, seed=1, epochs=2))
+        assert len(got) == 4  # 2 batches/epoch × 2 epochs
+        # different epochs see different orders (overwhelmingly likely)
+        e1 = np.concatenate([got[0]["tokens"], got[1]["tokens"]])
+        e2 = np.concatenate([got[2]["tokens"], got[3]["tokens"]])
+        assert not np.array_equal(e1, e2)
+        # but the same multiset of rows
+        assert sorted(map(tuple, e1)) == sorted(map(tuple, e2))
+
+    def test_prefetch_preserves_order_and_content(self):
+        rows = np.arange(6 * 5, dtype=np.int32).reshape(6, 5)
+        plain = list(D.batches(rows, batch_size=2, seed=3, epochs=1))
+        pre = list(
+            D.prefetch_to_device(
+                D.batches(rows, batch_size=2, seed=3, epochs=1), size=2
+            )
+        )
+        assert len(plain) == len(pre)
+        for a, b in zip(plain, pre):
+            np.testing.assert_array_equal(a["tokens"], np.asarray(b["tokens"]))

@@ -263,19 +263,23 @@ class TestSamplingAPI:
 
 class TestStreamingStop:
     async def test_stream_never_contains_stop_string(self):
+        """The stop char is drawn from the SAME chat generation the
+        stream repeats (greedy → identical), so the stream must both
+        reach it and withhold it."""
         client = await _client()
         try:
+            msgs = [{"role": "user", "content": "q"}]
             r = await client.post(
-                "/v1/completions",
-                json={"model": "llama-tiny", "prompt": "q", "max_tokens": 10},
+                "/v1/chat/completions",
+                json={"model": "llama-tiny", "messages": msgs, "max_tokens": 10},
             )
-            free_run = (await r.json())["choices"][0]["text"]
-            stop = free_run[1]
+            free_run = (await r.json())["choices"][0]["message"]["content"]
+            assert len(free_run) > 3
+            stop = free_run[2]
             r = await client.post(
                 "/v1/chat/completions",
                 json={
-                    "model": "llama-tiny",
-                    "messages": [{"role": "user", "content": "q"}],
+                    "model": "llama-tiny", "messages": msgs,
                     "max_tokens": 10, "stop": stop, "stream": True,
                 },
             )
@@ -287,8 +291,7 @@ class TestStreamingStop:
                 and "error" not in line
             )
             assert stop not in text
-
-    # empty stop strings are dropped, not match-everything
+            assert text == free_run.split(stop)[0]
         finally:
             await client.close()
 
