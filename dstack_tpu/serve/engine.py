@@ -43,6 +43,7 @@ class GenParams:
     seed: Optional[int] = None  # per-request sampling seed
     eos_id: Optional[int] = None
     stop: Optional[list] = None  # stop strings (matched by the server)
+    logprobs: bool = False  # collect per-token logprobs (top-5 alts)
 
 
 # ---------------------------------------------------------------------------
@@ -349,6 +350,24 @@ def sample(
     return tokens, jax.vmap(jax.random.key_data)(splits[:, 0])
 
 
+TOP_LOGPROBS = 5  # static alternatives-per-token count (OpenAI max is 5)
+
+
+def token_logprobs(
+    logits: jax.Array,  # [B, V] f32 — raw model logits
+    tokens: jax.Array,  # [B] the sampled tokens
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """→ (chosen logprob [B], top ids [B, K], top logprobs [B, K]).
+
+    Computed from the RAW model distribution (pre-temperature/penalty),
+    the convention OpenAI's API documents for ``logprobs``.
+    """
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    chosen = jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+    top_lp, top_ids = jax.lax.top_k(logp, TOP_LOGPROBS)
+    return chosen, top_ids, top_lp
+
+
 def _mark_seen(seen: jax.Array, rows: jax.Array, tokens: jax.Array) -> jax.Array:
     """seen[rows[i], tokens[i]] = True (donated in-place update)."""
     return seen.at[rows, tokens].set(True)
@@ -438,6 +457,9 @@ class InferenceEngine:
         self.top_ks = [0] * max_batch
         self.rep_pens = [1.0] * max_batch
         self.finish_reason = [None] * max_batch  # "stop" | "length" once done
+        self.want_logprobs = [False] * max_batch
+        # most recent token's (logprob, [(alt_id, alt_lp), ...]) per slot
+        self._last_logprobs: dict = {}
         # per-slot device state: PRNG keys + seen-token presence for the
         # repetition penalty ([B, V] bool — ~1MB at a 128k vocab)
         self._key_data = jnp.zeros((max_batch, 2), jnp.uint32)
@@ -452,6 +474,7 @@ class InferenceEngine:
             partial(decode_step, config=config), donate_argnums=(1,)
         )
         self._sample = jax.jit(sample)
+        self._logprobs = jax.jit(token_logprobs)
         self._mark_seen = jax.jit(_mark_seen, donate_argnums=0)
         self._mark_prompt = jax.jit(_mark_prompt, donate_argnums=0)
 
@@ -520,6 +543,15 @@ class InferenceEngine:
         self._seen = self._mark_seen(
             self._seen, jnp.asarray([slot]), jnp.asarray([tok])
         )
+        self.want_logprobs[slot] = gen.logprobs
+        if gen.logprobs:
+            lp, tids, tlps = jax.device_get(
+                self._logprobs(logits, toks)
+            )
+            self._last_logprobs[slot] = (
+                float(lp[0]),
+                list(zip(map(int, tids[0]), map(float, tlps[0]))),
+            )
         self.active[slot] = True
         self.lengths[slot] = tp
         self.remaining[slot] = gen.max_new_tokens - 1
@@ -559,6 +591,16 @@ class InferenceEngine:
         self._seen = self._mark_seen(
             self._seen, jnp.arange(self.max_batch), sampled_dev
         )
+        if any(self.want_logprobs[i] for i in live):
+            lp, tids, tlps = jax.device_get(
+                self._logprobs(logits, sampled_dev)
+            )
+            for i in live:
+                if self.want_logprobs[i]:
+                    self._last_logprobs[i] = (
+                        float(lp[i]),
+                        list(zip(map(int, tids[i]), map(float, tlps[i]))),
+                    )
         sampled = jax.device_get(sampled_dev)
         out: dict[int, int] = {}
         for i in live:
@@ -575,8 +617,14 @@ class InferenceEngine:
                 self.finish_reason[i] = "length"
         return out
 
+    def take_logprobs(self, slot: int):
+        """(logprob, [(alt_id, alt_lp), ...]) of the slot's most recent
+        token, or None when the request didn't ask for logprobs."""
+        return self._last_logprobs.pop(slot, None)
+
     def release(self, slot: int) -> None:
         self.active[slot] = False
+        self._last_logprobs.pop(slot, None)
 
     def generate(self, prompt: list[int], gen: GenParams) -> list[int]:
         """Convenience single-prompt generation (tests, CLI)."""

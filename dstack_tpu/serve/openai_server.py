@@ -39,6 +39,8 @@ class _Request:
         self.finish_reason: Optional[str] = None
         self.cancelled = False
         self.gen_ids: list[int] = []  # for stop-string matching
+        # per generated token: (logprob, [(alt_id, alt_lp), ...])
+        self.logprob_entries: list = []
 
 
 class Scheduler:
@@ -124,6 +126,10 @@ class Scheduler:
                 # client left while prefill compiled/ran: free the slot
                 self.engine.release(slot)
                 continue
+            if req.gen.logprobs:
+                entry = self.engine.take_logprobs(slot)
+                if entry is not None:
+                    req.logprob_entries.append(entry)
             if first != req.gen.eos_id:
                 req.queue.put_nowait(first)
                 if self._hit_stop(req, first):
@@ -146,6 +152,10 @@ class Scheduler:
             req = self.by_slot.get(slot)
             if req is None:
                 continue
+            if req.gen.logprobs and tok != req.gen.eos_id:
+                entry = self.engine.take_logprobs(slot)
+                if entry is not None:
+                    req.logprob_entries.append(entry)
             if tok != req.gen.eos_id:
                 req.queue.put_nowait(tok)
                 if self._hit_stop(req, tok):
@@ -189,6 +199,86 @@ def _stop_holdback(text: str, stop) -> int:
     return hold
 
 
+def _logprobs_requested(payload: dict) -> Optional[int]:
+    """→ top-n alternatives wanted, or None when logprobs are off.
+    0 is valid (chosen-token logprobs, no alternatives). Accepts both
+    the completions convention (logprobs: int) and the chat convention
+    (logprobs: bool + top_logprobs: int), capped at the engine's
+    static TOP_LOGPROBS."""
+    from dstack_tpu.serve.engine import TOP_LOGPROBS
+
+    lp = payload.get("logprobs")
+    if lp is True:
+        n = int(payload.get("top_logprobs") or 0)
+        return min(max(n, 0), TOP_LOGPROBS)
+    if isinstance(lp, int) and not isinstance(lp, bool) and lp >= 0:
+        return min(lp, TOP_LOGPROBS)
+    return None
+
+
+def _kept_token_count(tokenizer: Tokenizer, ids: list, text: str) -> int:
+    """Smallest token count whose decoded prefix covers ``text`` — so
+    logprobs arrays align with a stop-truncated completion (OpenAI
+    truncates text and logprobs consistently)."""
+    if len(tokenizer.decode(ids)) <= len(text):
+        return len(ids)
+    for k in range(len(ids) + 1):
+        if len(tokenizer.decode(ids[:k])) >= len(text):
+            return k
+    return len(ids)
+
+
+def _format_completions_logprobs(
+    req, tokenizer: Tokenizer, top_n: int, text: str
+) -> dict:
+    """Legacy /v1/completions logprobs block (4 parallel arrays)."""
+    n = _kept_token_count(tokenizer, req.gen_ids, text)
+    tokens, token_lps, tops, offsets = [], [], [], []
+    pos = 0
+    for tok, (lp, alts) in list(zip(req.gen_ids, req.logprob_entries))[:n]:
+        piece = tokenizer.decode([tok])
+        tokens.append(piece)
+        token_lps.append(lp)
+        offsets.append(pos)
+        pos += len(piece)
+        top: dict = {}
+        for i, alp in alts[:top_n]:
+            # distinct ids can decode to the same text — keep the best
+            # (alts arrive sorted descending)
+            top.setdefault(tokenizer.decode([i]), alp)
+        tops.append(top)
+    return {
+        "tokens": tokens,
+        "token_logprobs": token_lps,
+        "top_logprobs": tops,
+        "text_offset": offsets,
+    }
+
+
+def _chat_logprob_entries(req, tokenizer: Tokenizer, top_n: int, lo: int, hi: int) -> list:
+    """Chat-format content entries for generated tokens [lo, hi)."""
+    pairs = list(zip(req.gen_ids, req.logprob_entries))[lo:hi]
+    return [
+        {
+            "token": tokenizer.decode([tok]),
+            "logprob": lp,
+            "top_logprobs": [
+                {"token": tokenizer.decode([i]), "logprob": alp}
+                for i, alp in alts[:top_n]
+            ],
+        }
+        for tok, (lp, alts) in pairs
+    ]
+
+
+def _format_chat_logprobs(
+    req, tokenizer: Tokenizer, top_n: int, text: str
+) -> dict:
+    """Chat completions logprobs block, aligned with the final text."""
+    n = _kept_token_count(tokenizer, req.gen_ids, text)
+    return {"content": _chat_logprob_entries(req, tokenizer, top_n, 0, n)}
+
+
 def _gen_params(payload: dict, tokenizer: Tokenizer) -> GenParams:
     stop = payload.get("stop")
     if isinstance(stop, str):
@@ -209,6 +299,7 @@ def _gen_params(payload: dict, tokenizer: Tokenizer) -> GenParams:
         seed=int(seed) if seed is not None else None,
         eos_id=tokenizer.eos_id,
         stop=stop or None,
+        logprobs=_logprobs_requested(payload) is not None,
     )
 
 
@@ -282,6 +373,8 @@ def build_app(
             # part of a stop sequence is ever delivered).
             ids: list[int] = []
             sent = ""
+            lp_top = _logprobs_requested(payload) or 0
+            lp_emitted = 0
 
             def emittable() -> str:
                 full = tokenizer.decode(ids)
@@ -291,18 +384,29 @@ def build_app(
                 return full[: len(full) - _stop_holdback(full, req.gen.stop)]
 
             async def emit(delta: str) -> None:
+                nonlocal lp_emitted
+                choice = {
+                    "index": 0,
+                    "delta": {"role": "assistant", "content": delta},
+                    "finish_reason": None,
+                }
+                if req.gen.logprobs:
+                    # entries for the tokens consumed since the last
+                    # chunk (delta boundaries are char-diffs, so the
+                    # token alignment is approximate at holdback edges)
+                    hi = len(req.logprob_entries)
+                    choice["logprobs"] = {
+                        "content": _chat_logprob_entries(
+                            req, tokenizer, lp_top, lp_emitted, hi
+                        )
+                    }
+                    lp_emitted = hi
                 chunk = {
                     "id": completion_id,
                     "object": "chat.completion.chunk",
                     "created": created,
                     "model": model_name,
-                    "choices": [
-                        {
-                            "index": 0,
-                            "delta": {"role": "assistant", "content": delta},
-                            "finish_reason": None,
-                        }
-                    ],
+                    "choices": [choice],
                 }
                 await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
 
@@ -363,19 +467,22 @@ def build_app(
         if req.error:
             return web.json_response({"detail": req.error}, status=500)
         text = _truncate_stop(tokenizer.decode(ids), req.gen.stop)
+        choice = {
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": req.finish_reason or "stop",
+        }
+        if req.gen.logprobs:
+            choice["logprobs"] = _format_chat_logprobs(
+                req, tokenizer, _logprobs_requested(payload) or 0, text
+            )
         return web.json_response(
             {
                 "id": completion_id,
                 "object": "chat.completion",
                 "created": created,
                 "model": model_name,
-                "choices": [
-                    {
-                        "index": 0,
-                        "message": {"role": "assistant", "content": text},
-                        "finish_reason": req.finish_reason or "stop",
-                    }
-                ],
+                "choices": [choice],
                 "usage": {
                     "prompt_tokens": len(req.prompt_ids),
                     "completion_tokens": len(ids),
@@ -404,21 +511,23 @@ def build_app(
             sched.cancel(req)
         if req.error:
             return web.json_response({"detail": req.error}, status=500)
+        choice = {
+            "index": 0,
+            "text": _truncate_stop(tokenizer.decode(ids), req.gen.stop),
+            "finish_reason": req.finish_reason or "stop",
+        }
+        if req.gen.logprobs:
+            choice["logprobs"] = _format_completions_logprobs(
+                req, tokenizer, _logprobs_requested(payload) or 0,
+                choice["text"],
+            )
         return web.json_response(
             {
                 "id": f"cmpl-{uuid.uuid4().hex}",
                 "object": "text_completion",
                 "created": int(time.time()),
                 "model": model_name,
-                "choices": [
-                    {
-                        "index": 0,
-                        "text": _truncate_stop(
-                            tokenizer.decode(ids), req.gen.stop
-                        ),
-                        "finish_reason": req.finish_reason or "stop",
-                    }
-                ],
+                "choices": [choice],
                 "usage": {
                     "prompt_tokens": len(req.prompt_ids),
                     "completion_tokens": len(ids),

@@ -50,15 +50,14 @@ def load_tokens(
     path: str,
     seq_len: int,
     tokenizer: Optional[str] = None,
-    eos_id: int = 0,
     bin_dtype: str = "uint16",
 ) -> np.ndarray:
     """Any supported corpus file → packed [N, seq_len+1] int32 rows.
 
-    - ``.npy``: pre-tokenized; [N, T] rows are repacked when T != seq_len+1,
-      a flat [M] stream is packed directly (``eos_id`` separates rows
-      when repacking; a flat stream is assumed to carry its own
-      separators and is only reshaped).
+    - ``.npy``: pre-tokenized; [N, T] rows are repacked when
+      T != seq_len+1 (rows are assumed to carry their own separators —
+      no token is injected between them), a flat [M] stream is
+      reshaped directly.
     - ``.bin``: flat token stream (GPT-2 style); ``bin_dtype`` picks
       uint16/uint32 explicitly — guessing from content can silently
       fuse token pairs on pad-heavy uint16 corpora.
@@ -74,7 +73,9 @@ def load_tokens(
         if arr.ndim == 2 and arr.shape[1] == seq_len + 1:
             return np.asarray(arr, np.int32)
         if arr.ndim == 2:
-            return pack_documents(list(np.asarray(arr, np.int32)), seq_len, eos_id)
+            return pack_documents(
+                list(np.asarray(arr, np.int32)), seq_len, eos_id=None
+            )
         return _reshape_stream(np.asarray(arr, np.int32), seq_len)
     if suffix == ".bin":
         if bin_dtype not in ("uint16", "uint32"):
@@ -133,16 +134,16 @@ def batches(
     batch_size: int,
     seed: int = 0,
     epochs: Optional[int] = None,  # None = loop forever
-    drop_last: bool = True,
 ) -> Iterator[dict]:
     """Shuffled epoch iterator → {tokens, targets, mask} host batches.
 
     Targets are the packed rows shifted by one (no wraparound garbage —
     the +1 column exists exactly for this). Mask is all-ones: packing
-    leaves no padding.
+    leaves no padding. The partial tail batch of each epoch is dropped
+    (static shapes: every batch recompiles nothing).
     """
     n = rows.shape[0]
-    if n < batch_size and drop_last:
+    if n < batch_size:
         raise ValueError(f"corpus has {n} rows < batch size {batch_size}")
     rng = np.random.default_rng(seed)
     epoch = 0

@@ -235,9 +235,7 @@ def main(argv=None) -> int:
 
         def run_eval(tag: str) -> None:
             total, weight = 0.0, 0.0
-            it = batches(
-                eval_rows, args.batch, seed=0, epochs=1, drop_last=True
-            )
+            it = batches(eval_rows, args.batch, seed=0, epochs=1)
             for n, b in enumerate(prefetch_to_device(it, sharding=bsh)):
                 if n >= args.eval_batches:
                     break

@@ -36,8 +36,10 @@ class TestSources:
         rows = np.ones((4, 100), np.int32)
         f = tmp_path / "c.npy"
         np.save(f, rows)
-        out = D.load_tokens(str(f), seq_len=32, eos_id=7)
+        out = D.load_tokens(str(f), seq_len=32)
         assert out.shape[1] == 33
+        # no separator token injected between rows
+        assert set(out.reshape(-1).tolist()) == {1}
 
     def test_flat_bin_uint16(self, tmp_path):
         stream = np.arange(1, 200, dtype=np.uint16)

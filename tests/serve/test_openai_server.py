@@ -310,3 +310,132 @@ class TestStreamingStop:
             assert d["choices"][0]["text"] != "" or d["choices"][0]["finish_reason"] == "length"
         finally:
             await client.close()
+
+
+class TestLogprobs:
+    async def test_completions_logprobs(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={
+                    "model": "llama-tiny", "prompt": "ab",
+                    "max_tokens": 4, "logprobs": 3,
+                },
+            )
+            d = await r.json()
+            lp = d["choices"][0]["logprobs"]
+            n = d["usage"]["completion_tokens"]
+            assert len(lp["tokens"]) == n
+            assert len(lp["token_logprobs"]) == n
+            assert all(v <= 0 for v in lp["token_logprobs"])
+            # dict keyed by decoded token text: distinct ids may decode
+            # to the same string (byte tokenizer), so <= requested n
+            assert all(1 <= len(t) <= 3 for t in lp["top_logprobs"])
+            # greedy: the chosen token's logprob equals the best alt
+            best = max(lp["top_logprobs"][0].values())
+            assert abs(lp["token_logprobs"][0] - best) < 1e-4
+        finally:
+            await client.close()
+
+    async def test_chat_logprobs(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "llama-tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 3, "logprobs": True, "top_logprobs": 2,
+                },
+            )
+            d = await r.json()
+            content = d["choices"][0]["logprobs"]["content"]
+            assert len(content) == d["usage"]["completion_tokens"]
+            for e in content:
+                assert e["logprob"] <= 0
+                assert len(e["top_logprobs"]) == 2
+
+    # absent when not requested
+        finally:
+            await client.close()
+
+    async def test_absent_when_not_requested(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "llama-tiny", "prompt": "ab", "max_tokens": 2},
+            )
+            d = await r.json()
+            assert "logprobs" not in d["choices"][0]
+        finally:
+            await client.close()
+
+    async def test_streaming_chat_logprobs_present(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "llama-tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 4, "logprobs": True, "top_logprobs": 2,
+                    "stream": True,
+                },
+            )
+            body = (await r.read()).decode()
+            entries = []
+            for line in body.splitlines():
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    ch = json.loads(line[6:])["choices"][0]
+                    if ch.get("logprobs"):
+                        entries.extend(ch["logprobs"]["content"])
+            assert entries and all(e["logprob"] <= 0 for e in entries)
+            assert all(len(e["top_logprobs"]) == 2 for e in entries)
+        finally:
+            await client.close()
+
+    async def test_logprobs_zero_alternatives(self):
+        """logprobs: 0 is valid — chosen-token logprobs, no alts."""
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={
+                    "model": "llama-tiny", "prompt": "ab",
+                    "max_tokens": 3, "logprobs": 0,
+                },
+            )
+            d = await r.json()
+            lp = d["choices"][0]["logprobs"]
+            assert len(lp["token_logprobs"]) == d["usage"]["completion_tokens"]
+            assert all(t == {} for t in lp["top_logprobs"])
+            assert len(lp["text_offset"]) == len(lp["tokens"])
+            assert lp["text_offset"][0] == 0
+        finally:
+            await client.close()
+
+    async def test_logprobs_align_with_stop_truncation(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "llama-tiny", "prompt": "abc", "max_tokens": 10},
+            )
+            free_run = (await r.json())["choices"][0]["text"]
+            stop = free_run[2]
+            r = await client.post(
+                "/v1/completions",
+                json={
+                    "model": "llama-tiny", "prompt": "abc",
+                    "max_tokens": 10, "stop": stop, "logprobs": 1,
+                },
+            )
+            d = await r.json()
+            text = d["choices"][0]["text"]
+            lp = d["choices"][0]["logprobs"]
+            # arrays cover exactly the returned text, not the cut tokens
+            assert "".join(lp["tokens"]) == text
+        finally:
+            await client.close()
