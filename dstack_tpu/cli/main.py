@@ -89,6 +89,20 @@ def config(url, token, project) -> None:
 
 
 @cli.command()
+@click.argument("shell", type=click.Choice(["bash", "zsh", "fish"]))
+def completion(shell) -> None:
+    """Print shell-completion setup instructions (reference `dstack completion`)."""
+    prog = "dtpu"
+    lines = {
+        "bash": f'eval "$(_{prog.upper()}_COMPLETE=bash_source {prog})"',
+        "zsh": f'eval "$(_{prog.upper()}_COMPLETE=zsh_source {prog})"',
+        "fish": f"_{prog.upper()}_COMPLETE=fish_source {prog} | source",
+    }
+    console.print(f"# add to your {shell} profile:")
+    console.print(lines[shell])
+
+
+@cli.command()
 @click.option("-f", "--file", "config_path", required=True, type=click.Path(exists=True))
 @click.option("-y", "--yes", is_flag=True, help="skip confirmation")
 @click.option("-d", "--detach", is_flag=True, help="do not stream logs")

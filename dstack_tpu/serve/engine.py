@@ -43,7 +43,8 @@ class GenParams:
     seed: Optional[int] = None  # per-request sampling seed
     eos_id: Optional[int] = None
     stop: Optional[list] = None  # stop strings (matched by the server)
-    logprobs: bool = False  # collect per-token logprobs (top-5 alts)
+    # None = off; n >= 0 = collect logprobs with n alternatives (≤ 5)
+    logprobs: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------
@@ -543,8 +544,8 @@ class InferenceEngine:
         self._seen = self._mark_seen(
             self._seen, jnp.asarray([slot]), jnp.asarray([tok])
         )
-        self.want_logprobs[slot] = gen.logprobs
-        if gen.logprobs:
+        self.want_logprobs[slot] = gen.logprobs is not None
+        if gen.logprobs is not None:
             lp, tids, tlps = jax.device_get(
                 self._logprobs(logits, toks)
             )

@@ -54,6 +54,12 @@ class Scheduler:
         self.pending: asyncio.Queue = asyncio.Queue()
         self.by_slot: dict[int, _Request] = {}
         self._task: Optional[asyncio.Task] = None
+        # serving counters for /metrics (scraped by the shim relay →
+        # server prometheus plane like any other service)
+        self.requests_total = 0
+        self.tokens_generated_total = 0
+        self.decode_steps_total = 0
+        self.decode_seconds_total = 0.0
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
@@ -63,6 +69,7 @@ class Scheduler:
             self._task.cancel()
 
     async def submit(self, req: _Request) -> None:
+        self.requests_total += 1
         await self.pending.put(req)
 
     def cancel(self, req: _Request) -> None:
@@ -126,11 +133,12 @@ class Scheduler:
                 # client left while prefill compiled/ran: free the slot
                 self.engine.release(slot)
                 continue
-            if req.gen.logprobs:
+            if req.gen.logprobs is not None:
                 entry = self.engine.take_logprobs(slot)
                 if entry is not None:
                     req.logprob_entries.append(entry)
             if first != req.gen.eos_id:
+                self.tokens_generated_total += 1
                 req.queue.put_nowait(first)
                 if self._hit_stop(req, first):
                     self.engine.release(slot)
@@ -147,16 +155,20 @@ class Scheduler:
             req = await self.pending.get()
             await self.pending.put(req)
             return
+        t0 = time.perf_counter()
         out = await asyncio.to_thread(self.engine.step)
+        self.decode_steps_total += 1
+        self.decode_seconds_total += time.perf_counter() - t0
         for slot, tok in out.items():
             req = self.by_slot.get(slot)
             if req is None:
                 continue
-            if req.gen.logprobs and tok != req.gen.eos_id:
+            if req.gen.logprobs is not None and tok != req.gen.eos_id:
                 entry = self.engine.take_logprobs(slot)
                 if entry is not None:
                     req.logprob_entries.append(entry)
             if tok != req.gen.eos_id:
+                self.tokens_generated_total += 1
                 req.queue.put_nowait(tok)
                 if self._hit_stop(req, tok):
                     self.engine.release(slot)
@@ -219,11 +231,16 @@ def _logprobs_requested(payload: dict) -> Optional[int]:
 def _kept_token_count(tokenizer: Tokenizer, ids: list, text: str) -> int:
     """Smallest token count whose decoded prefix covers ``text`` — so
     logprobs arrays align with a stop-truncated completion (OpenAI
-    truncates text and logprobs consistently)."""
+    truncates text and logprobs consistently). Trailing replacement
+    chars from a partially-decoded multi-byte character are not real
+    output yet and must not count toward the covered length."""
     if len(tokenizer.decode(ids)) <= len(text):
         return len(ids)
     for k in range(len(ids) + 1):
-        if len(tokenizer.decode(ids[:k])) >= len(text):
+        prefix = tokenizer.decode(ids[:k])
+        while prefix.endswith("�"):
+            prefix = prefix[:-1]
+        if len(prefix) >= len(text):
             return k
     return len(ids)
 
@@ -299,7 +316,7 @@ def _gen_params(payload: dict, tokenizer: Tokenizer) -> GenParams:
         seed=int(seed) if seed is not None else None,
         eos_id=tokenizer.eos_id,
         stop=stop or None,
-        logprobs=_logprobs_requested(payload) is not None,
+        logprobs=_logprobs_requested(payload),
     )
 
 
@@ -331,6 +348,32 @@ def build_app(
                 "object": "list",
                 "data": [{"id": model_name, "object": "model", "owned_by": "dstack-tpu"}],
             }
+        )
+
+    async def metrics(request):
+        """Prometheus text: the shim's metrics relay scrapes this like
+        any service and the server's prometheus plane re-exports it."""
+        e = sched.engine
+        active = sum(1 for a in e.active if a)
+        lines = [
+            "# TYPE dstack_serve_requests_total counter",
+            f"dstack_serve_requests_total {sched.requests_total}",
+            "# TYPE dstack_serve_tokens_generated_total counter",
+            f"dstack_serve_tokens_generated_total {sched.tokens_generated_total}",
+            "# TYPE dstack_serve_decode_steps_total counter",
+            f"dstack_serve_decode_steps_total {sched.decode_steps_total}",
+            "# TYPE dstack_serve_decode_seconds_total counter",
+            f"dstack_serve_decode_seconds_total {sched.decode_seconds_total:.6f}",
+            "# TYPE dstack_serve_active_slots gauge",
+            f"dstack_serve_active_slots {active}",
+            "# TYPE dstack_serve_max_slots gauge",
+            f"dstack_serve_max_slots {e.max_batch}",
+            "# TYPE dstack_serve_queue_depth gauge",
+            f"dstack_serve_queue_depth {sched.pending.qsize()}",
+        ]
+        return web.Response(
+            text="\n".join(lines) + "\n",
+            content_type="text/plain",
         )
 
     async def _run(prompt: str, payload: dict):
@@ -373,7 +416,7 @@ def build_app(
             # part of a stop sequence is ever delivered).
             ids: list[int] = []
             sent = ""
-            lp_top = _logprobs_requested(payload) or 0
+            lp_top = req.gen.logprobs or 0
             lp_emitted = 0
 
             def emittable() -> str:
@@ -390,7 +433,7 @@ def build_app(
                     "delta": {"role": "assistant", "content": delta},
                     "finish_reason": None,
                 }
-                if req.gen.logprobs:
+                if req.gen.logprobs is not None:
                     # entries for the tokens consumed since the last
                     # chunk (delta boundaries are char-diffs, so the
                     # token alignment is approximate at holdback edges)
@@ -472,9 +515,9 @@ def build_app(
             "message": {"role": "assistant", "content": text},
             "finish_reason": req.finish_reason or "stop",
         }
-        if req.gen.logprobs:
+        if req.gen.logprobs is not None:
             choice["logprobs"] = _format_chat_logprobs(
-                req, tokenizer, _logprobs_requested(payload) or 0, text
+                req, tokenizer, req.gen.logprobs, text
             )
         return web.json_response(
             {
@@ -516,10 +559,9 @@ def build_app(
             "text": _truncate_stop(tokenizer.decode(ids), req.gen.stop),
             "finish_reason": req.finish_reason or "stop",
         }
-        if req.gen.logprobs:
+        if req.gen.logprobs is not None:
             choice["logprobs"] = _format_completions_logprobs(
-                req, tokenizer, _logprobs_requested(payload) or 0,
-                choice["text"],
+                req, tokenizer, req.gen.logprobs, choice["text"],
             )
         return web.json_response(
             {
@@ -537,6 +579,7 @@ def build_app(
         )
 
     app.router.add_get("/health", health)
+    app.router.add_get("/metrics", metrics)
     app.router.add_get("/v1/models", models)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)

@@ -439,3 +439,29 @@ class TestLogprobs:
             assert "".join(lp["tokens"]) == text
         finally:
             await client.close()
+
+
+class TestServeMetrics:
+    async def test_prometheus_counters(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "llama-tiny", "prompt": "ab", "max_tokens": 5},
+            )
+            n = (await r.json())["usage"]["completion_tokens"]
+            r = await client.get("/metrics")
+            assert r.status == 200
+            text = await r.text()
+            metrics = {
+                line.split()[0]: float(line.split()[1])
+                for line in text.splitlines()
+                if line and not line.startswith("#")
+            }
+            assert metrics["dstack_serve_requests_total"] == 1
+            assert metrics["dstack_serve_tokens_generated_total"] == n
+            assert metrics["dstack_serve_decode_steps_total"] >= 1
+            assert metrics["dstack_serve_max_slots"] == 4
+            assert metrics["dstack_serve_active_slots"] == 0  # finished
+        finally:
+            await client.close()
