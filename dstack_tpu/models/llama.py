@@ -389,8 +389,18 @@ def _proj(
     """Base matmul + optional LoRA bypass: x·W + s·(x·A)·B.
     The low-rank path stays unfused from W (two skinny matmuls) —
     cheaper on MXU than materializing W+ΔW per step. One helper for all
-    seven adaptable projections."""
-    y = jnp.einsum(eq, inp, layer[name])
+    seven adaptable projections.
+
+    Weight-only int8 (models/quant.py): when ``name_q``/``name_s``
+    replace ``name``, the int8 weight casts into the matmul and the
+    per-output-channel scale multiplies the result — XLA fuses both
+    into the dot, and HBM reads half the bytes."""
+    w = layer.get(name)
+    if w is not None:
+        y = jnp.einsum(eq, inp, w)
+    else:
+        y = jnp.einsum(eq, inp, layer[f"{name}_q"].astype(inp.dtype))
+        y = y * layer[f"{name}_s"].astype(y.dtype)
     a, b = layer.get(f"{name}_lora_a"), layer.get(f"{name}_lora_b")
     if a is not None and b is not None:
         y = y + jnp.einsum(eq_b, jnp.einsum(eq_a, inp, a), b) * layer["lora_scale"]
@@ -529,14 +539,35 @@ def _lm_head(
     x = rms_norm(x, params["final_norm"], config.norm_eps, offset=config.norm_offset)
     if return_hidden:
         return x
-    head = params["embed"].T if config.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("bte,ev->btv", x, head.astype(config.dtype))
+    logits = head_logits_einsum(params, x, config, "bte,ev->btv")
     logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
     logits = logits.astype(jnp.float32)
     if config.logit_softcap:
         cap = config.logit_softcap
         logits = cap * jnp.tanh(logits / cap)
     return logits
+
+
+def head_logits_einsum(
+    params: dict, x: jax.Array, config: LlamaConfig, eq: str
+) -> jax.Array:
+    """Output-head matmul (``eq``: "bte,ev->btv" or "be,ev->bv") over
+    the tied embedding, the plain ``lm_head``, or its int8 form — the
+    per-channel scale multiplies the logits so the int8 bytes are all
+    that leaves HBM (models/quant.py)."""
+    if config.tie_embeddings:
+        head = params["embed"].T
+    elif "lm_head" in params:
+        head = params["lm_head"]
+    else:
+        logits = jnp.einsum(
+            eq, x, params["lm_head_q"].astype(config.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return logits * params["lm_head_s"]
+    return jnp.einsum(
+        eq, x, head.astype(config.dtype), preferred_element_type=jnp.float32
+    )
 
 
 def _merge_lora(xs: dict, lora: Optional[dict], lora_scale: float, config: LlamaConfig) -> dict:

@@ -1,0 +1,109 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is HBM-bandwidth-bound: the chip reads every weight once per
+token while the MXU idles. Storing the seven projection matrices (and
+the LM head) as int8 with per-output-channel scales halves the bytes
+per step — the dequantize is a cast the MXU input pipeline absorbs plus
+one per-channel multiply that XLA fuses into the matmul's epilogue.
+
+Per-output-channel absmax scaling is exact under the contraction: for
+W[:, o] quantized as q[:, o]·s[o], x·W ≈ (x·q)·s column-wise, so the
+scale multiplies the OUTPUT — no input statistics, no calibration data.
+
+``quantize_tree`` rewrites a params pytree: every target leaf ``name``
+becomes ``name_q`` (int8, same shape) + ``name_s`` (f32 scale per
+output channel); :func:`dstack_tpu.models.llama._proj` consumes either
+form, so training-free quantized serving works through every existing
+path (forward, prefill, decode, LoRA bypass on a quantized base).
+
+Norms, biases, and the embedding table stay in model dtype: they are a
+rounding error of the byte budget, and the embedding is a gather (no
+matmul to fuse a dequant into). MoE expert stacks are not quantized
+yet — refuse rather than serve a half-quantized model silently.
+"""
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dstack_tpu.models.llama import LlamaConfig
+
+# projection leaves quantized inside each layer ([L, in, out] stacks)
+LAYER_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w) -> tuple[np.ndarray, np.ndarray]:
+    """[..., in, out] → (int8 [..., in, out], f32 scale [..., out]).
+
+    Per-output-channel absmax: q = round(w / s), s = absmax_in / 127.
+    Runs on HOST (numpy): serving paths hand the engine a host tree so
+    big models go straight into sharded device buffers — quantizing
+    eagerly on device would commit every full-precision stack to chip 0
+    first, the exact OOM the host-tree contract avoids.
+    """
+    w32 = np.asarray(w, np.float32)
+    absmax = np.max(np.abs(w32), axis=-2)  # [..., out]
+    s = np.where(absmax == 0.0, 1.0, absmax / 127.0).astype(np.float32)
+    q = np.clip(np.round(w32 / s[..., None, :]), -127, 127).astype(np.int8)
+    return q, s
+
+
+def dequantize_weight(q, s, dtype: Any) -> jax.Array:
+    return (jnp.asarray(q, jnp.float32) * jnp.asarray(s)[..., None, :]).astype(dtype)
+
+
+def quantize_tree(params: dict, config: LlamaConfig) -> dict:
+    """Params pytree → serving pytree with int8 projection weights.
+
+    Quantizes the per-layer projections and the LM head (when untied);
+    embedding, norms, biases, and LoRA adapters pass through.
+    """
+    if config.n_experts:
+        raise ValueError(
+            "int8 quantization does not cover MoE expert stacks yet"
+        )
+    out = {k: v for k, v in params.items() if k not in ("layers", "lm_head")}
+    layers = {}
+    for name, leaf in params["layers"].items():
+        leaf = np.asarray(leaf) if name in LAYER_TARGETS else leaf
+        if name in LAYER_TARGETS:
+            q, s = quantize_weight(leaf)
+            layers[name + "_q"] = q
+            layers[name + "_s"] = s
+        else:
+            layers[name] = leaf
+    out["layers"] = layers
+    if "lm_head" in params:
+        q, s = quantize_weight(params["lm_head"])
+        out["lm_head_q"] = q
+        out["lm_head_s"] = s
+    return out
+
+
+def quant_param_specs(specs: dict) -> dict:
+    """Logical-axis spec tree for a quantized params tree.
+
+    ``name_q`` shards exactly like ``name``; ``name_s`` keeps only the
+    output-channel axis (the last spec entry), so tensor-parallel
+    serving shards scales alongside their columns.
+    """
+    out = {k: v for k, v in specs.items() if k not in ("layers", "lm_head")}
+    layers = {}
+    for name, spec in specs["layers"].items():
+        if name in LAYER_TARGETS:
+            layers[name + "_q"] = spec
+            # drop the input-dim axis: ("layers", in, out) → ("layers", out)
+            layers[name + "_s"] = spec[:-2] + spec[-1:]
+        else:
+            layers[name] = spec
+    out["layers"] = layers
+    if "lm_head" in specs:
+        out["lm_head_q"] = specs["lm_head"]
+        out["lm_head_s"] = specs["lm_head"][-1:]
+    return out
+
+
+def is_quantized(params: dict) -> bool:
+    return any(k.endswith("_q") for k in params.get("layers", {}))

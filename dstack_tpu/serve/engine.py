@@ -146,10 +146,9 @@ def _embed_lookup(params: dict, tokens: jax.Array, c: LlamaConfig) -> jax.Array:
 
 def _head_logits(params: dict, x: jax.Array, c: LlamaConfig) -> jax.Array:
     """x [B, H] (post-final-norm) → f32 logits [B, V] with Gemma2 cap."""
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum(
-        "be,ev->bv", x, head.astype(c.dtype), preferred_element_type=jnp.float32
-    )
+    from dstack_tpu.models.llama import head_logits_einsum
+
+    logits = head_logits_einsum(params, x, c, "be,ev->bv")
     if c.logit_softcap:
         logits = c.logit_softcap * jnp.tanh(logits / c.logit_softcap)
     return logits
@@ -431,6 +430,7 @@ class InferenceEngine:
         single-chip-sized trees."""
         self.config = config
         if mesh is not None:
+            from dstack_tpu.models.quant import is_quantized, quant_param_specs
             from dstack_tpu.parallel.sharding import default_rules, tree_shardings
 
             tp = mesh.shape.get("tp", 1)
@@ -438,9 +438,10 @@ class InferenceEngine:
                 raise ValueError(
                     f"n_kv_heads {config.n_kv_heads} not divisible by tp={tp}"
                 )
-            shardings = tree_shardings(
-                llama.param_specs(config), mesh, default_rules()
-            )
+            specs = llama.param_specs(config)
+            if is_quantized(params):
+                specs = quant_param_specs(specs)
+            shardings = tree_shardings(specs, mesh, default_rules())
             params = jax.device_put(params, shardings)
         self.params = params
         self.max_batch = max_batch

@@ -231,16 +231,23 @@ def _logprobs_requested(payload: dict) -> Optional[int]:
 def _kept_token_count(tokenizer: Tokenizer, ids: list, text: str) -> int:
     """Smallest token count whose decoded prefix covers ``text`` — so
     logprobs arrays align with a stop-truncated completion (OpenAI
-    truncates text and logprobs consistently). Trailing replacement
-    chars from a partially-decoded multi-byte character are not real
-    output yet and must not count toward the covered length."""
-    if len(tokenizer.decode(ids)) <= len(text):
+    truncates text and logprobs consistently).
+
+    Coverage is measured as the common prefix with the FULL decode:
+    replacement chars from a partially-decoded multi-byte character
+    differ from the final text and don't count, while a genuine U+FFFD
+    (invalid bytes the model actually emitted) matches and does."""
+    full = tokenizer.decode(ids)
+    if len(full) <= len(text):
         return len(ids)
     for k in range(len(ids) + 1):
         prefix = tokenizer.decode(ids[:k])
-        while prefix.endswith("�"):
-            prefix = prefix[:-1]
-        if len(prefix) >= len(text):
+        common = 0
+        for a, b in zip(prefix, full):
+            if a != b:
+                break
+            common += 1
+        if common >= len(text):
             return k
     return len(ids)
 
@@ -612,6 +619,11 @@ def main(argv=None) -> int:
         "--tp", type=int, default=0,
         help="tensor-parallel ways (default: all local devices)",
     )
+    p.add_argument(
+        "--quantize", default=None, choices=["int8"],
+        help="weight-only quantization: halves HBM per weight read "
+             "(decode is bandwidth-bound)",
+    )
     args = p.parse_args(argv)
 
     from dstack_tpu.utils.logging import configure_logging
@@ -700,6 +712,11 @@ def main(argv=None) -> int:
             set_path(params, key.split("/"), value)
         logger.info("loaded %d weight arrays from %s", len(flat), args.weights)
 
+    if args.quantize == "int8":
+        from dstack_tpu.models.quant import quantize_tree
+
+        params = quantize_tree(params, config)
+        logger.info("weights quantized to int8 (per-output-channel scales)")
     engine = InferenceEngine(
         config, params, max_batch=args.max_batch, max_seq=args.max_seq, mesh=mesh
     )
