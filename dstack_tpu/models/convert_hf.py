@@ -6,8 +6,8 @@ torch ``.bin`` shards) and get back ``(LlamaConfig, params)`` ready for
 :func:`dstack_tpu.models.llama.forward`, the serve engine, and the
 finetune driver.
 
-Supported ``model_type``s: ``llama``, ``qwen2``, ``mistral``, ``gemma``,
-``gemma2``, ``mixtral``. Each maps onto :class:`LlamaConfig` family
+Supported ``model_type``s: ``llama``, ``qwen2``, ``qwen3``,
+``mistral``, ``gemma``, ``gemma2``, ``mixtral``. Each maps onto :class:`LlamaConfig` family
 flags (qkv_bias / sliding_window / norm_offset / softcaps / MoE) — the
 architecture deltas live in the config, not in per-family model code.
 
@@ -42,7 +42,7 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     hidden = hf["hidden_size"]
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hidden // n_heads
-    if hf.get("attention_bias") and mt not in ("qwen2",):
+    if hf.get("attention_bias") and mt not in ("qwen2", "qwen3"):
         # q/k/v/o biases exist in the checkpoint but our llama/mistral
         # paths would silently drop them — refuse rather than mis-serve
         raise ValueError(
@@ -93,6 +93,16 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         # Qwen2 puts biases on q/k/v only (attention_bias is not in its
         # config; the arch always has them)
         return LlamaConfig(**common, qkv_bias=True)
+    if mt == "qwen3":
+        lt = hf.get("layer_types") or []
+        if hf.get("use_sliding_window") or "sliding_attention" in lt:
+            raise ValueError(
+                "qwen3 sliding-attention layer_types are not supported"
+            )
+        return LlamaConfig(
+            **common, qk_norm=True,
+            qkv_bias=bool(hf.get("attention_bias")),
+        )
     if mt == "mistral":
         return LlamaConfig(**common, sliding_window=hf.get("sliding_window") or 0)
     if mt == "gemma":
@@ -207,6 +217,9 @@ def convert_state_dict(
         layers["bq"] = stack(P + "self_attn.q_proj.bias")
         layers["bk"] = stack(P + "self_attn.k_proj.bias")
         layers["bv"] = stack(P + "self_attn.v_proj.bias")
+    if c.qk_norm:
+        layers["q_norm"] = stack(P + "self_attn.q_norm.weight")
+        layers["k_norm"] = stack(P + "self_attn.k_norm.weight")
     if c.post_norms:
         layers["attn_post_norm"] = stack(P + "post_attention_layernorm.weight")
         layers["mlp_post_norm"] = stack(P + "post_feedforward_layernorm.weight")

@@ -51,6 +51,7 @@ class LlamaConfig:
     router_renorm: bool = False  # Mixtral: renormalize top-k gates
     # --- model-family deltas (all default to Llama behavior) ---
     qkv_bias: bool = False  # Qwen2: bias on q/k/v projections
+    qk_norm: bool = False  # Qwen3: RMSNorm over head_dim on q/k pre-rope
     sliding_window: int = 0  # Mistral/Gemma2: 0 = full attention
     # every `sliding_pattern` layers the LAST is global, the rest use the
     # sliding window (Gemma2: pattern=2 → layers 0,2,… sliding); 0/1 =
@@ -145,6 +146,11 @@ MOE_TINY = LlamaConfig(  # for tests / virtual meshes
 )
 # Model families beyond Llama: the architecture deltas are config flags
 # (models/convert_hf.py maps HF checkpoints onto them)
+QWEN3_8B = LlamaConfig(
+    vocab_size=151936, hidden_size=4096, n_layers=36, n_heads=32,
+    n_kv_heads=8, head_dim=128, intermediate_size=12288, rope_theta=1e6,
+    norm_eps=1e-6, max_seq_len=32768, qk_norm=True,
+)
 QWEN25_7B = LlamaConfig(
     vocab_size=152064, hidden_size=3584, n_layers=28, n_heads=28,
     n_kv_heads=4, head_dim=128, intermediate_size=18944, rope_theta=1e6,
@@ -179,6 +185,7 @@ CONFIGS = {
     "mixtral-8x7b": MIXTRAL_8X7B,
     "moe-tiny": MOE_TINY,
     "qwen-2.5-7b": QWEN25_7B,
+    "qwen-3-8b": QWEN3_8B,
     "mistral-7b": MISTRAL_7B,
     "gemma-2b": GEMMA_2B,
     "gemma-2-2b": GEMMA2_2B,
@@ -219,6 +226,9 @@ def param_specs(config: LlamaConfig) -> dict:
         specs["layers"]["bq"] = L + ("heads",)
         specs["layers"]["bk"] = L + ("kv_heads",)
         specs["layers"]["bv"] = L + ("kv_heads",)
+    if config.qk_norm:
+        specs["layers"]["q_norm"] = L + (None,)
+        specs["layers"]["k_norm"] = L + (None,)
     if config.post_norms:
         specs["layers"]["attn_post_norm"] = L + (None,)
         specs["layers"]["mlp_post_norm"] = L + (None,)
@@ -277,6 +287,9 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         params["layers"]["bq"] = jnp.zeros((L, c.q_dim), dt)
         params["layers"]["bk"] = jnp.zeros((L, c.kv_dim), dt)
         params["layers"]["bv"] = jnp.zeros((L, c.kv_dim), dt)
+    if c.qk_norm:
+        params["layers"]["q_norm"] = jnp.ones((L, c.head_dim), dt)
+        params["layers"]["k_norm"] = jnp.ones((L, c.head_dim), dt)
     if c.post_norms:
         params["layers"]["attn_post_norm"] = norm_init((L, c.hidden_size))
         params["layers"]["mlp_post_norm"] = norm_init((L, c.hidden_size))
@@ -431,6 +444,9 @@ def _attention_block(
     q = q.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+    if c.qk_norm:  # Qwen3: per-head-dim RMSNorm before rope
+        q = rms_norm(q, layer["q_norm"], c.norm_eps)
+        k = rms_norm(k, layer["k_norm"], c.norm_eps)
     q = constrain(q, rules, "batch", "heads", "seq", None, mesh=mesh)
     k = constrain(k, rules, "batch", "kv_heads", "seq", None, mesh=mesh)
     q = apply_rope(q, cos, sin)

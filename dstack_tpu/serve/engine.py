@@ -184,6 +184,9 @@ def prefill(
         q = q.reshape(b, tp, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, tp, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, tp, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        if c.qk_norm:  # Qwen3: per-head-dim RMSNorm before rope
+            q = rms_norm(q, layer["q_norm"], c.norm_eps)
+            k = rms_norm(k, layer["k_norm"], c.norm_eps)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         o = attention(
@@ -255,6 +258,9 @@ def decode_step(
         q = q.reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        if c.qk_norm:  # Qwen3: per-head-dim RMSNorm before rope
+            q = rms_norm(q, layer["q_norm"], c.norm_eps)
+            k = rms_norm(k, layer["k_norm"], c.norm_eps)
         q = _apply_rope_batch(q, cos, sin)
         k = _apply_rope_batch(k, cos, sin)
         # write this token's K/V at each slot's position

@@ -115,6 +115,14 @@ class TestHFParity:
         cfg = _assert_parity(tmp_path, m)
         assert cfg.qkv_bias
 
+    def test_qwen3_qk_norm(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Qwen3Config, transformers.Qwen3ForCausalLM,
+            head_dim=16,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.qk_norm and not cfg.qkv_bias
+
     def test_mistral_sliding_window(self, tmp_path):
         # window < T so the mask actually bites
         m = _save_tiny(
@@ -235,6 +243,13 @@ class TestEngineParity:
     def test_qwen2_greedy_decode(self, tmp_path):
         m = _save_tiny(
             tmp_path, transformers.Qwen2Config, transformers.Qwen2ForCausalLM,
+        )
+        self._assert_greedy_parity(tmp_path, m)
+
+    def test_qwen3_greedy_decode(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Qwen3Config, transformers.Qwen3ForCausalLM,
+            head_dim=16,
         )
         self._assert_greedy_parity(tmp_path, m)
 
