@@ -624,6 +624,13 @@ def main(argv=None) -> int:
         help="weight-only quantization: halves HBM per weight read "
              "(decode is bandwidth-bound)",
     )
+    import os
+
+    p.add_argument(
+        "--compile-cache", default=os.environ.get("DSTACK_TPU_COMPILE_CACHE"),
+        help="persistent XLA compile-cache dir (volume-mounted: restarts "
+             "skip prefill/decode compiles, cutting time-to-first-token)",
+    )
     args = p.parse_args(argv)
 
     from dstack_tpu.utils.logging import configure_logging
@@ -634,6 +641,9 @@ def main(argv=None) -> int:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    if args.compile_cache:
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from dstack_tpu.models import llama
 

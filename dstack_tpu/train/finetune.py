@@ -85,12 +85,21 @@ def main(argv=None) -> int:
         "--platform", default=None,
         help="force a jax platform (e.g. cpu); overrides sitecustomize pins",
     )
+    p.add_argument(
+        "--compile-cache", default=os.environ.get("DSTACK_TPU_COMPILE_CACHE"),
+        help="persistent XLA compile-cache dir (put it on a volume: a "
+             "restarted/resumed run skips the multi-minute first "
+             "compile, cutting provision->first-train-step latency)",
+    )
     args = p.parse_args(argv)
 
     import jax
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    if args.compile_cache:
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     # join the slice-wide process group when the orchestrator provides one
     if os.environ.get("JAX_COORDINATOR_ADDRESS") and int(
