@@ -156,77 +156,119 @@ def _head_logits(params: dict, x: jax.Array, c: LlamaConfig) -> jax.Array:
 
 def prefill(
     params: dict,
-    tokens: jax.Array,  # [B, Tp] int32, right-padded
-    lengths: jax.Array,  # [B] int32 true lengths
-    slot: jax.Array,  # [] int32: first cache row to write (B rows)
+    tokens: jax.Array,  # [1, Tp] int32, right-padded
+    lengths: jax.Array,  # [1] int32 true length
+    slot: jax.Array,  # [] int32: cache row to write
     config: LlamaConfig,
     cache: dict,
 ) -> tuple[jax.Array, dict]:
-    """Run the prompt through the model, writing K/V into the cache rows
-    ``slot..slot+B`` (the full pool cache is donated — never slice it
-    per request: an identity slice aliases the pool's own buffer and
-    donation would delete it); returns (last-token logits [B, V], cache)."""
-    from dstack_tpu.models.llama import apply_rope, grouped_scan_layout, sublayer
+    """One-shot prompt prefill → (last-token logits [1, V], cache).
+
+    Thin wrapper over :func:`prefill_chunk_step` at ``start=0`` — ONE
+    code path for prompt processing, so model-family changes can't
+    drift between the one-shot form (tests, simple callers) and the
+    engine's chunked loop."""
+    assert tokens.shape[0] == 1, "one-shot prefill is single-sequence"
+    return prefill_chunk_step(
+        params, cache, tokens, slot, lengths[0] - 1, config, start=0
+    )
+
+
+def prefill_chunk_step(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [1, C] int32 chunk (right-padded on the last one)
+    slot: jax.Array,  # [] int32 cache row
+    last_ix: jax.Array,  # [] int32: prompt's last real index MINUS start
+    config: LlamaConfig,
+    *,
+    start: int,  # static: global position of the chunk's first token
+) -> tuple[jax.Array, dict]:
+    """One prompt chunk → (logits at ``last_ix`` [1, V], cache).
+
+    Chunked prefill: the chunk's K/V are written into the slot's cache
+    row first, then the chunk queries attend over the row's prefix with
+    causal masking at the STATIC ``start`` offset — so the pallas flash
+    kernel applies (per-layer windows/softcaps included) and no
+    [C, T_max] score matrix materializes. A long prompt becomes
+    ceil(Tp/C) identical-shape calls, letting the scheduler run decode
+    steps for other slots between chunks instead of stalling them for
+    the whole prompt (and collapsing the per-length compile zoo into
+    per-(C, start) variants the persistent cache reuses).
+    """
+    from dstack_tpu.models.llama import (
+        apply_rope,
+        grouped_scan_layout,
+        sublayer,
+    )
     from dstack_tpu.ops.attention import attention
 
     c = config
-    b, tp = tokens.shape
+    b, cl = tokens.shape
     x = _embed_lookup(params, tokens, c)
-    cos, sin = rope_freqs(jnp.arange(tp), c.head_dim, c.rope_theta, c.rope_scaling)
+    cos, sin = rope_freqs(
+        start + jnp.arange(cl), c.head_dim, c.rope_theta, c.rope_scaling
+    )
     scale = c.attention_scale
-    # mixed sliding/global layers (Gemma2): scan groups of `g` sublayers
-    # so every window is static (see llama.forward)
-    g, windows, xs = grouped_scan_layout(c, params["layers"])
+    g, windows, xs = grouped_scan_layout(
+        c, {"layer": params["layers"], "ck": cache["k"], "cv": cache["v"]}
+    )
 
-    def one_layer(x, layer, window):
+    def one_layer(x, layer, ck, cv, window):
+        # ck/cv [B_pool, Hkv, Tmax, D] — this layer's cache
         h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
         q, k, v = _qkv(h, layer, c)
-        q = q.reshape(b, tp, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = k.reshape(b, tp, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = v.reshape(b, tp, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        if c.qk_norm:  # Qwen3: per-head-dim RMSNorm before rope
+        q = q.reshape(b, cl, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        if c.qk_norm:
             q = rms_norm(q, layer["q_norm"], c.norm_eps)
             k = rms_norm(k, layer["k_norm"], c.norm_eps)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
+        # write the chunk's K/V into the slot's row, then attend over
+        # the whole row: positions beyond start+i are causally masked,
+        # so stale data past the prompt is never read
+        ck = jax.lax.dynamic_update_slice(
+            ck, k, (slot.astype(jnp.int32), 0, start, 0)
+        )
+        cv = jax.lax.dynamic_update_slice(
+            cv, v, (slot.astype(jnp.int32), 0, start, 0)
+        )
+        row_k = jax.lax.dynamic_slice_in_dim(ck, slot.astype(jnp.int32), 1, 0)
+        row_v = jax.lax.dynamic_slice_in_dim(cv, slot.astype(jnp.int32), 1, 0)
         o = attention(
-            q, k, v, causal=True, scale=scale,
+            q, row_k, row_v, causal=True, scale=scale, q_offset=start,
             window=window, softcap=c.attn_softcap,
         )
-        o = o.transpose(0, 2, 1, 3).reshape(b, tp, c.q_dim)
+        o = o.transpose(0, 2, 1, 3).reshape(b, cl, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
             ao = rms_norm(ao, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
         x = x + ao
-        return _mlp(x, layer, c), (k, v)
+        return _mlp(x, layer, c), ck, cv
 
     def group_fn(x, group):
-        kvs = []
+        cks, cvs = [], []
         for i in range(g):
-            layer = sublayer(group, i, g)
-            x, kv = one_layer(x, layer, windows[i])
-            kvs.append(kv)
+            sub = sublayer(group, i, g)
+            x, ck, cv = one_layer(
+                x, sub["layer"], sub["ck"], sub["cv"], windows[i]
+            )
+            cks.append(ck)
+            cvs.append(cv)
         if g == 1:
-            return x, kvs[0]
-        return x, (
-            jnp.stack([kv[0] for kv in kvs]),
-            jnp.stack([kv[1] for kv in kvs]),
-        )
+            return x, (cks[0], cvs[0])
+        return x, (jnp.stack(cks), jnp.stack(cvs))
 
     x, (ks, vs) = jax.lax.scan(group_fn, x, xs)
     if g > 1:  # [L/g, g, ...] → [L, ...]
         ks = ks.reshape((c.n_layers,) + ks.shape[2:])
         vs = vs.reshape((c.n_layers,) + vs.shape[2:])
-    # write the prompt K/V into the slot's cache prefix
-    start = (0, slot.astype(jnp.int32), 0, 0, 0)
-    cache = {
-        "k": jax.lax.dynamic_update_slice(cache["k"], ks, start),
-        "v": jax.lax.dynamic_update_slice(cache["v"], vs, start),
-    }
+    cache = {"k": ks, "v": vs}
     x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)
-    # only the last real token's logits matter
     last = jnp.take_along_axis(
-        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1
+        x, last_ix[None, None, None].astype(jnp.int32), axis=1
     )[:, 0]
     return _head_logits(params, last, c), cache
 
@@ -237,12 +279,23 @@ def decode_step(
     tokens: jax.Array,  # [B] int32: the freshly sampled tokens
     positions: jax.Array,  # [B] int32: where to write (== current length)
     config: LlamaConfig,
+    write_mask: jax.Array = None,  # [B] bool: rows allowed to write K/V
 ) -> tuple[jax.Array, dict]:
-    """One token for every slot → (logits [B, V], cache)."""
+    """One token for every slot → (logits [B, V], cache).
+
+    ``write_mask`` guards the cache writes: inactive rows (finished, or
+    mid-chunked-prefill for another request) must not scribble stale
+    K/V into their slot — a decode step interleaved between prefill
+    chunks would otherwise corrupt the prompt being written.
+    """
     from dstack_tpu.models.llama import layer_windows
 
     c = config
     b = tokens.shape[0]
+    if write_mask is None:
+        write_mask = jnp.ones((b,), bool)
+    # out-of-range scatter indices drop the write (mode="drop")
+    write_pos = jnp.where(write_mask, positions, cache["k"].shape[3])
     x = _embed_lookup(params, tokens, c)[:, None, :]
     cos, sin = rope_freqs(positions, c.head_dim, c.rope_theta, c.rope_scaling)  # [B, D/2]
     batch_ix = jnp.arange(b)
@@ -263,9 +316,10 @@ def decode_step(
             k = rms_norm(k, layer["k_norm"], c.norm_eps)
         q = _apply_rope_batch(q, cos, sin)
         k = _apply_rope_batch(k, cos, sin)
-        # write this token's K/V at each slot's position
-        ck = ck.at[batch_ix, :, positions].set(k[:, :, 0, :])
-        cv = cv.at[batch_ix, :, positions].set(v[:, :, 0, :])
+        # write this token's K/V at each slot's position (masked rows
+        # get an out-of-range index → dropped)
+        ck = ck.at[batch_ix, :, write_pos].set(k[:, :, 0, :], mode="drop")
+        cv = cv.at[batch_ix, :, write_pos].set(v[:, :, 0, :], mode="drop")
         # attend over the cache prefix (mask: j <= position, and within
         # the layer's sliding window when set)
         kk = _expand_gqa(ck, c.n_heads)
@@ -425,6 +479,7 @@ class InferenceEngine:
         max_seq: int = 2048,
         seed: int = 0,
         mesh=None,
+        prefill_chunk: int = 256,
     ):
         """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
         params shard per the model's logical rules (heads/mlp/vocab over
@@ -473,11 +528,17 @@ class InferenceEngine:
         self._key_data = jnp.zeros((max_batch, 2), jnp.uint32)
         self._seen = jnp.zeros((max_batch, config.vocab_size), bool)
 
+        # pending chunked prefills: slot → {tokens, tp, next (chunk
+        # cursor), gen}
+        self._prefilling: dict[int, dict] = {}
+        # chunk size: one compiled kernel per (C, start) pair instead of
+        # one per prompt-length bucket; between chunks the scheduler can
+        # run decode steps for other slots
+        self.prefill_chunk = max(16, min(prefill_chunk, max_seq))
+
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
-        self._prefill = jax.jit(
-            partial(prefill, config=config), donate_argnames=("cache",)
-        )
+        self._chunk_fns: dict = {}  # (C, start) → jitted prefill_chunk_step
         self._decode = jax.jit(
             partial(decode_step, config=config), donate_argnums=(1,)
         )
@@ -487,13 +548,23 @@ class InferenceEngine:
         self._mark_prompt = jax.jit(_mark_prompt, donate_argnums=0)
 
     def free_slots(self) -> list[int]:
-        return [i for i in range(self.max_batch) if not self.active[i]]
+        return [
+            i for i in range(self.max_batch)
+            if not self.active[i] and i not in self._prefilling
+        ]
 
-    def add_request(
-        self, prompt: list[int], gen: GenParams
-    ) -> tuple[int, int]:
-        """Prefill ``prompt`` into a free slot → (slot, first sampled
-        token). Raises RuntimeError when full."""
+    def _chunk_fn(self, cl: int, start: int):
+        key = (cl, start)
+        if key not in self._chunk_fns:
+            self._chunk_fns[key] = jax.jit(
+                partial(prefill_chunk_step, config=self.config, start=start),
+                donate_argnames=("cache",),
+            )
+        return self._chunk_fns[key]
+
+    def start_request(self, prompt: list[int], gen: GenParams) -> int:
+        """Reserve a slot and queue the prompt for chunked prefill
+        (host bookkeeping only). Raises RuntimeError when full."""
         free = self.free_slots()
         if not free:
             raise RuntimeError("no free slots")
@@ -504,26 +575,72 @@ class InferenceEngine:
         if len(prompt) > keep:
             prompt = prompt[-keep:]
         slot = free[0]
-        tp = len(prompt)
-        # pad the prompt to a power-of-two bucket: one compiled prefill
-        # per bucket instead of one per distinct length (padded-tail K/V
-        # lands beyond `lengths` and is overwritten token-by-token as
-        # decode advances — the mask never reads it)
-        bucket = 16
-        while bucket < tp:
-            bucket *= 2
-        bucket = min(bucket, self.max_seq)
-        padded = prompt + [0] * (bucket - tp)
-        # single-sequence prefill (B=1) straight into the slot's rows of
-        # the donated pool cache
-        tokens = jnp.asarray([padded], jnp.int32)
-        logits, self.cache = self._prefill(
+        self._prefilling[slot] = {
+            "prompt": list(prompt),
+            "tp": len(prompt),
+            "next": 0,  # next chunk's global start position
+            "gen": gen,
+        }
+        return slot
+
+    def prefill_step(self, slot: int):
+        """Process ONE prompt chunk for ``slot``; None while incomplete,
+        the first sampled token once the prompt is fully prefetched."""
+        st = self._prefilling.get(slot)
+        if st is None:
+            # released concurrently (client cancelled mid-chunk)
+            return None
+        tp, start = st["tp"], st["next"]
+        if tp <= self.prefill_chunk:
+            # short prompt: one chunk at the smallest power-of-2 bucket
+            cl = 16
+            while cl < tp:
+                cl *= 2
+            cl = min(cl, self.prefill_chunk)
+        else:
+            cl = self.prefill_chunk
+        # never overflow the cache row: dynamic_update_slice would CLAMP
+        # an out-of-range start and silently shift the written K/V
+        cl = min(cl, self.max_seq - start)
+        chunk = st["prompt"][start : start + cl]
+        final = start + cl >= tp
+        chunk = chunk + [0] * (cl - len(chunk))
+        # logits index only matters on the final chunk
+        last_ix = (tp - 1 - start) if final else (cl - 1)
+        logits, self.cache = self._chunk_fn(cl, start)(
             self.params,
-            tokens,
-            jnp.asarray([tp], jnp.int32),
+            self.cache,
+            jnp.asarray([chunk], jnp.int32),
             jnp.asarray(slot, jnp.int32),
-            cache=self.cache,
+            jnp.asarray(last_ix, jnp.int32),
         )
+        if not final:
+            st["next"] = start + cl
+            return None
+        gen = st["gen"]
+        if self._prefilling.pop(slot, None) is None:
+            return None  # released while the final chunk ran
+        return self._activate(slot, st["prompt"], tp, gen, logits)
+
+    def add_request(
+        self, prompt: list[int], gen: GenParams
+    ) -> tuple[int, int]:
+        """Prefill ``prompt`` into a free slot → (slot, first sampled
+        token). Raises RuntimeError when full. Blocking convenience
+        over start_request/prefill_step (the scheduler drives those
+        incrementally to interleave decode between chunks)."""
+        slot = self.start_request(prompt, gen)
+        tok = None
+        while tok is None:
+            tok = self.prefill_step(slot)
+        return slot, tok
+
+    def _activate(
+        self, slot: int, prompt: list[int], tp: int, gen: GenParams,
+        logits: jax.Array,
+    ) -> int:
+        """Final-prefill tail: seed the PRNG stream, mark seen tokens,
+        sample the first token, and publish the slot state."""
         # per-request PRNG stream: explicit seed or a fresh auto seed
         if gen.seed is not None:
             req_seed = int(gen.seed)
@@ -533,8 +650,12 @@ class InferenceEngine:
         self._key_data = self._key_data.at[slot].set(
             jax.random.key_data(jax.random.key(req_seed))
         )
+        pad = 16  # bucket the mark_prompt compile per power-of-2 length
+        while pad < tp:
+            pad *= 2
+        marked = list(prompt) + [0] * (pad - tp)
         self._seen = self._mark_prompt(
-            self._seen, jnp.asarray(slot), tokens[0],
+            self._seen, jnp.asarray(slot), jnp.asarray(marked, jnp.int32),
             jnp.asarray(tp, jnp.int32),
         )
         toks, kd = self._sample(
@@ -574,7 +695,7 @@ class InferenceEngine:
             # finished immediately; slot never enters the decode loop
             self.active[slot] = False
             self.finish_reason[slot] = "stop" if tok == gen.eos_id else "length"
-        return slot, tok
+        return tok
 
     def step(self) -> dict[int, int]:
         """Advance every active slot one token → {slot: sampled token}.
@@ -585,7 +706,8 @@ class InferenceEngine:
         tokens = jnp.asarray(self.last_token, jnp.int32)
         positions = jnp.asarray(self.lengths, jnp.int32)
         logits, self.cache = self._decode(
-            self.params, self.cache, tokens, positions
+            self.params, self.cache, tokens, positions,
+            write_mask=jnp.asarray(self.active, bool),
         )
         sampled_dev, self._key_data = self._sample(
             logits,
@@ -630,8 +752,14 @@ class InferenceEngine:
         token, or None when the request didn't ask for logprobs."""
         return self._last_logprobs.pop(slot, None)
 
+    def prefilling_slots(self) -> list[int]:
+        """Slots with a queued/in-progress chunked prefill (admission
+        order)."""
+        return list(self._prefilling)
+
     def release(self, slot: int) -> None:
         self.active[slot] = False
+        self._prefilling.pop(slot, None)
         self._last_logprobs.pop(slot, None)
 
     def generate(self, prompt: list[int], gen: GenParams) -> list[int]:

@@ -53,6 +53,7 @@ class Scheduler:
         self.tokenizer = tokenizer
         self.pending: asyncio.Queue = asyncio.Queue()
         self.by_slot: dict[int, _Request] = {}
+        self.by_prefill: dict[int, _Request] = {}  # chunked prefills in flight
         self._task: Optional[asyncio.Task] = None
         # serving counters for /metrics (scraped by the shim relay →
         # server prometheus plane like any other service)
@@ -74,12 +75,16 @@ class Scheduler:
 
     def cancel(self, req: _Request) -> None:
         """Client went away: free the slot so decode stops burning steps
-        on an abandoned generation."""
+        on an abandoned generation (or its remaining prefill chunks)."""
         req.cancelled = True
         for slot, r in list(self.by_slot.items()):
             if r is req:
                 self.engine.release(slot)
                 del self.by_slot[slot]
+        for slot, r in list(self.by_prefill.items()):
+            if r is req:
+                self.engine.release(slot)
+                del self.by_prefill[slot]
 
     async def _loop(self) -> None:
         # the loop must survive ANY engine error (bad request shapes,
@@ -96,6 +101,27 @@ class Scheduler:
                     req.error = str(e)
                     req.queue.put_nowait(None)
                 self.by_slot.clear()
+
+    def _handle_first_token(self, slot: int, req: _Request, first: int) -> bool:
+        """Deliver a finished prefill's first token; True when the slot
+        stays active for the decode loop."""
+        if req.gen.logprobs is not None:
+            entry = self.engine.take_logprobs(slot)
+            if entry is not None:
+                req.logprob_entries.append(entry)
+        if first != req.gen.eos_id:
+            self.tokens_generated_total += 1
+            req.queue.put_nowait(first)
+            if self._hit_stop(req, first):
+                self.engine.release(slot)
+                req.finish_reason = "stop"
+                req.queue.put_nowait(None)
+                return False
+        if self.engine.active[slot]:
+            return True
+        req.finish_reason = self.engine.finish_reason[slot]
+        req.queue.put_nowait(None)  # finished at first token
+        return False
 
     def _hit_stop(self, req: _Request, tok: int) -> bool:
         """Track generated ids; True once a stop string appears in the
@@ -115,42 +141,53 @@ class Scheduler:
         return any(t in text for t in req.gen.stop)
 
     async def _tick(self) -> None:
-        # admit pending requests while slots are free
+        # admit pending requests while slots are free (host bookkeeping
+        # only — the prompt prefills chunk by chunk below)
         while not self.pending.empty() and self.engine.free_slots():
             req = self.pending.get_nowait()
             if req.cancelled:
                 continue
             try:
-                slot, first = await asyncio.to_thread(
-                    self.engine.add_request, req.prompt_ids, req.gen
-                )
+                slot = self.engine.start_request(req.prompt_ids, req.gen)
             except Exception as e:  # noqa: BLE001 - reported per request
-                logger.exception("prefill failed: %s", e)
+                logger.exception("admission failed: %s", e)
                 req.error = str(e)
                 req.queue.put_nowait(None)
                 continue
+            self.by_prefill[slot] = req
+
+        # ONE prefill chunk per tick: decode steps for running slots
+        # interleave between a long prompt's chunks instead of stalling
+        # behind the whole prefill
+        if self.by_prefill:
+            slot = next(iter(self.by_prefill))
+            req = self.by_prefill[slot]
             if req.cancelled:
-                # client left while prefill compiled/ran: free the slot
                 self.engine.release(slot)
-                continue
-            if req.gen.logprobs is not None:
-                entry = self.engine.take_logprobs(slot)
-                if entry is not None:
-                    req.logprob_entries.append(entry)
-            if first != req.gen.eos_id:
-                self.tokens_generated_total += 1
-                req.queue.put_nowait(first)
-                if self._hit_stop(req, first):
+                del self.by_prefill[slot]
+                return
+            try:
+                first = await asyncio.to_thread(self.engine.prefill_step, slot)
+            except Exception as e:  # noqa: BLE001 - reported per request
+                logger.exception("prefill failed: %s", e)
+                self.engine.release(slot)
+                self.by_prefill.pop(slot, None)
+                req.error = str(e)
+                req.queue.put_nowait(None)
+                return
+            if slot not in self.by_prefill:
+                # cancel() landed while the chunk ran on the worker
+                # thread: the slot is already released
+                return
+            if first is not None:  # prompt complete; first token sampled
+                self.by_prefill.pop(slot, None)
+                if req.cancelled:
                     self.engine.release(slot)
-                    req.finish_reason = "stop"
-                    req.queue.put_nowait(None)
-                    continue
-            if self.engine.active[slot]:
-                self.by_slot[slot] = req
-            else:
-                req.finish_reason = self.engine.finish_reason[slot]
-                req.queue.put_nowait(None)  # finished at first token
+                elif self._handle_first_token(slot, req, first):
+                    self.by_slot[slot] = req
         if not self.by_slot:
+            if self.by_prefill:
+                return  # keep chunking without blocking
             # idle: wait for work instead of spinning
             req = await self.pending.get()
             await self.pending.put(req)
