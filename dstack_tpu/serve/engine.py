@@ -144,11 +144,15 @@ def _embed_lookup(params: dict, tokens: jax.Array, c: LlamaConfig) -> jax.Array:
     return x
 
 
-def _head_logits(params: dict, x: jax.Array, c: LlamaConfig) -> jax.Array:
-    """x [B, H] (post-final-norm) → f32 logits [B, V] with Gemma2 cap."""
+def _head_logits(
+    params: dict, x: jax.Array, c: LlamaConfig, eq: str = "be,ev->bv"
+) -> jax.Array:
+    """Post-final-norm hidden → f32 logits with the Gemma2 cap; ``eq``
+    picks the einsum shape ([B,H]→[B,V] default, [B,S,H]→[B,S,V] for
+    the speculative verify step)."""
     from dstack_tpu.models.llama import head_logits_einsum
 
-    logits = head_logits_einsum(params, x, c, "be,ev->bv")
+    logits = head_logits_einsum(params, x, c, eq)
     if c.logit_softcap:
         logits = c.logit_softcap * jnp.tanh(logits / c.logit_softcap)
     return logits
@@ -353,6 +357,101 @@ def decode_step(
     return _head_logits(params, x[:, 0], c), cache
 
 
+def verify_step(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B, S] int32: last sampled token + S-1 draft tokens
+    positions: jax.Array,  # [B] int32: row's current length (pos of tokens[:,0])
+    config: LlamaConfig,
+    write_mask: jax.Array,  # [B] bool
+) -> tuple[jax.Array, dict]:
+    """Multi-token decode for speculative verification → (logits
+    [B, S, V], cache).
+
+    Generalizes :func:`decode_step` to S tokens per row at per-row
+    offsets: one call verifies S-1 drafted tokens (prompt-lookup
+    decoding), costing ~S× one decode step but replacing up to S steps
+    when drafts are accepted. K/V for rejected positions is garbage
+    until the real tokens decode over it — the same masked-future
+    invariant padding relies on.
+    """
+    from dstack_tpu.models.llama import layer_windows
+
+    c = config
+    b, sdraft = tokens.shape
+    x = _embed_lookup(params, tokens, c)  # [B, S, H]
+    # per-row positions: row i covers [pos_i, pos_i + S)
+    pos_grid = positions[:, None] + jnp.arange(sdraft)[None, :]  # [B, S]
+    inv_shape = c.head_dim // 2
+    # rope per (row, step): build [B, S, D/2] then apply per-row
+    cos, sin = rope_freqs(
+        pos_grid.reshape(-1), c.head_dim, c.rope_theta, c.rope_scaling
+    )
+    cos = cos.reshape(b, sdraft, inv_shape)
+    sin = sin.reshape(b, sdraft, inv_shape)
+    batch_ix = jnp.arange(b)
+    scale = c.attention_scale
+    windows = jnp.asarray(layer_windows(c), jnp.int32)
+    tmax = cache["k"].shape[3]
+    write_pos = jnp.where(write_mask[:, None], pos_grid, tmax)  # [B, S]
+
+    def rope_rows(t):  # t [B, Hh, S, D]
+        d2 = t.shape[-1] // 2
+        t1, t2 = t[..., :d2], t[..., d2:]
+        cc = cos[:, None].astype(t.dtype)  # [B, 1, S, D/2]
+        ss = sin[:, None].astype(t.dtype)
+        return jnp.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss], axis=-1)
+
+    def layer_fn(x, layer_and_cache):
+        layer, ck, cv, window = layer_and_cache
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+        q, k, v = _qkv(h, layer, c)
+        q = q.reshape(b, sdraft, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, sdraft, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, sdraft, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        if c.qk_norm:
+            q = rms_norm(q, layer["q_norm"], c.norm_eps)
+            k = rms_norm(k, layer["k_norm"], c.norm_eps)
+        q = rope_rows(q)
+        k = rope_rows(k)
+        # scatter the S tokens' K/V at their per-row positions
+        ck = ck.at[batch_ix[:, None], :, write_pos].set(
+            k.transpose(0, 2, 1, 3), mode="drop"
+        )
+        cv = cv.at[batch_ix[:, None], :, write_pos].set(
+            v.transpose(0, 2, 1, 3), mode="drop"
+        )
+        kk = _expand_gqa(ck, c.n_heads)
+        vv = _expand_gqa(cv, c.n_heads)
+        s = jnp.einsum(
+            "bhsd,bhkd->bhsk", q, kk, preferred_element_type=jnp.float32
+        ) * scale
+        if c.attn_softcap:
+            s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
+        kj = jnp.arange(tmax)[None, None, None, :]  # [1,1,1,T]
+        qpos = pos_grid[:, None, :, None]  # [B,1,S,1]
+        mask = kj <= qpos
+        mask = jnp.logical_and(
+            mask, jnp.logical_or(window == 0, qpos - kj < window)
+        )
+        s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhsk,bhkd->bhsd", p.astype(vv.dtype), vv)
+        o = o.transpose(0, 2, 1, 3).reshape(b, sdraft, c.q_dim)
+        ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        if c.post_norms:
+            ao = rms_norm(ao, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
+        x = x + ao
+        return _mlp(x, layer, c), (ck, cv)
+
+    x, (ks, vs) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"], windows)
+    )
+    cache = {"k": ks, "v": vs}
+    x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)
+    return _head_logits(params, x, c, eq="bse,ev->bsv"), cache
+
+
 def sample(
     logits: jax.Array,  # [B, V] f32
     key_data: jax.Array,  # [B, 2] uint32 per-slot PRNG key data
@@ -480,6 +579,7 @@ class InferenceEngine:
         seed: int = 0,
         mesh=None,
         prefill_chunk: int = 256,
+        spec_draft: int = 4,
     ):
         """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
         params shard per the model's logical rules (heads/mlp/vocab over
@@ -531,6 +631,20 @@ class InferenceEngine:
         # pending chunked prefills: slot → {tokens, tp, next (chunk
         # cursor), gen}
         self._prefilling: dict[int, dict] = {}
+        # prompt-lookup speculative decoding (greedy slots): draft
+        # spec_draft tokens from the last n-gram match in the slot's
+        # history, verify them in ONE multi-token decode. 0 disables.
+        self.spec_draft = max(0, spec_draft)
+        self.spec_ngram = 2
+        self.history: list = [[] for _ in range(max_batch)]
+        # incremental {n-gram tuple: last index} per slot → O(1) draft
+        # lookup instead of rescanning the history every step
+        self._ngram_ix: list = [dict() for _ in range(max_batch)]
+        # per-request acceptance tracking: slots whose drafts keep
+        # getting rejected stop drafting (they'd only tax the batch)
+        self._spec_tries = [0] * max_batch
+        self._spec_accepted = [0] * max_batch
+        self._spec_off = [False] * max_batch
         # chunk size: one compiled kernel per (C, start) pair instead of
         # one per prompt-length bucket; between chunks the scheduler can
         # run decode steps for other slots
@@ -541,6 +655,9 @@ class InferenceEngine:
         self._chunk_fns: dict = {}  # (C, start) → jitted prefill_chunk_step
         self._decode = jax.jit(
             partial(decode_step, config=config), donate_argnums=(1,)
+        )
+        self._verify = jax.jit(
+            partial(verify_step, config=config), donate_argnums=(1,)
         )
         self._sample = jax.jit(sample)
         self._logprobs = jax.jit(token_logprobs)
@@ -682,6 +799,12 @@ class InferenceEngine:
                 list(zip(map(int, tids[0]), map(float, tlps[0]))),
             )
         self.active[slot] = True
+        self.history[slot] = []
+        self._ngram_ix[slot] = {}
+        self._spec_tries[slot] = 0
+        self._spec_accepted[slot] = 0
+        self._spec_off[slot] = False
+        self._record_tokens(slot, list(prompt) + [tok])
         self.lengths[slot] = tp
         self.remaining[slot] = gen.max_new_tokens - 1
         self.eos[slot] = gen.eos_id
@@ -697,12 +820,123 @@ class InferenceEngine:
             self.finish_reason[slot] = "stop" if tok == gen.eos_id else "length"
         return tok
 
-    def step(self) -> dict[int, int]:
-        """Advance every active slot one token → {slot: sampled token}.
-        Slots that hit EOS/max tokens (or the cache end) deactivate."""
+    def _record_tokens(self, slot: int, toks: list) -> None:
+        """Append to the slot's history, keeping the n-gram index
+        current (the index stores each n-gram's LAST occurrence, added
+        lazily one step behind so lookups never match the tail itself)."""
+        h = self.history[slot]
+        ix = self._ngram_ix[slot]
+        n = self.spec_ngram
+        for tok in toks:
+            h.append(tok)
+            # register the n-gram ENDING at the previous position: the
+            # trailing n-gram stays unindexed until a newer token lands
+            if len(h) > n:
+                gram = tuple(h[-n - 1 : -1])
+                ix[gram] = len(h) - 1 - n
+        return None
+
+    def _find_draft(self, slot: int) -> list:
+        """Prompt-lookup draft: tokens that followed the most recent
+        earlier occurrence of the history's trailing n-gram (O(1) via
+        the incremental index)."""
+        if not self.spec_draft or self._spec_off[slot]:
+            return []
+        h = self.history[slot]
+        n = self.spec_ngram
+        if len(h) <= n:
+            return []
+        j = self._ngram_ix[slot].get(tuple(h[-n:]))
+        if j is None:
+            return []
+        return h[j + n : j + n + self.spec_draft]
+
+    def step(self) -> dict:
+        """Advance every active slot → {slot: [tokens]}. Slots that hit
+        EOS/max tokens (or the cache end) deactivate. Greedy batches
+        with an n-gram draft take the speculative path and may emit
+        several tokens per call; otherwise each list has one token."""
         live = [i for i in range(self.max_batch) if self.active[i]]
         if not live:
             return {}
+        spec_ok = self.spec_draft > 0 and all(
+            self.temps[i] <= 0.0
+            and self.rep_pens[i] == 1.0
+            and not self.want_logprobs[i]
+            for i in live
+        )
+        if spec_ok:
+            drafts = {i: self._find_draft(i) for i in live}
+            drafting = sum(1 for d in drafts.values() if d)
+            # non-drafting slots pay ~(S×) decode compute for nothing —
+            # speculate only when at least half the batch drafts
+            if drafting and drafting * 2 >= len(live):
+                return self._spec_step(live, drafts)
+        out = self._plain_step(live)
+        for i, tok in out.items():
+            self._record_tokens(i, [tok])
+        return {i: [tok] for i, tok in out.items()}
+
+    def _spec_step(self, live: list, drafts: dict) -> dict:
+        """One verify_step call emits 1..spec_draft+1 tokens per slot."""
+        sdraft = self.spec_draft + 1
+        rows = []
+        for i in range(self.max_batch):
+            d = drafts.get(i, [])
+            row = [self.last_token[i]] + d
+            row = row + [0] * (sdraft - len(row))
+            rows.append(row[:sdraft])
+        logits, self.cache = self._verify(
+            self.params,
+            self.cache,
+            jnp.asarray(rows, jnp.int32),
+            jnp.asarray(self.lengths, jnp.int32),
+            write_mask=jnp.asarray(self.active, bool),
+        )
+        preds = jax.device_get(jnp.argmax(logits, axis=-1))  # [B, S]
+        out: dict = {}
+        for i in live:
+            draft = drafts.get(i, [])
+            emitted = [int(preds[i][0])]
+            for j, dtok in enumerate(draft):
+                if int(preds[i][j]) != dtok:
+                    break
+                emitted.append(int(preds[i][j + 1]))
+            if draft:
+                self._spec_tries[i] += 1
+                self._spec_accepted[i] += len(emitted) - 1
+                if (
+                    self._spec_tries[i] >= 4
+                    and self._spec_accepted[i] < self._spec_tries[i]
+                ):
+                    # < 1 accepted draft token per try: drafting this
+                    # slot costs more than it saves
+                    self._spec_off[i] = True
+            toks = []
+            for tok in emitted:
+                if self.remaining[i] <= 0 or self.lengths[i] >= self.max_seq - 1:
+                    break
+                toks.append(tok)
+                self.lengths[i] += 1
+                self.remaining[i] -= 1
+                self._record_tokens(i, [tok])
+                if tok == self.eos[i]:
+                    self.active[i] = False
+                    self.finish_reason[i] = "stop"
+                    break
+            if self.active[i] and (
+                self.remaining[i] <= 0 or self.lengths[i] >= self.max_seq - 1
+            ):
+                self.active[i] = False
+                self.finish_reason[i] = "length"
+            if toks:
+                self.last_token[i] = toks[-1]
+                out[i] = toks
+            # note: _seen is not updated here — the spec path is gated
+            # to repetition_penalty == 1.0, where seen has no effect
+        return out
+
+    def _plain_step(self, live: list) -> dict[int, int]:
         tokens = jnp.asarray(self.last_token, jnp.int32)
         positions = jnp.asarray(self.lengths, jnp.int32)
         logits, self.cache = self._decode(
@@ -770,10 +1004,9 @@ class InferenceEngine:
             return out
         while self.active[slot]:
             step_out = self.step()
-            if slot in step_out:
-                out.append(step_out[slot])
-                if step_out[slot] == gen.eos_id:
-                    out.pop()  # eos is not part of the text
+            for tok in step_out.get(slot, []):
+                if tok == gen.eos_id:
                     break
+                out.append(tok)
         self.release(slot)
         return out

@@ -196,15 +196,18 @@ class Scheduler:
         out = await asyncio.to_thread(self.engine.step)
         self.decode_steps_total += 1
         self.decode_seconds_total += time.perf_counter() - t0
-        for slot, tok in out.items():
+        for slot, toks in out.items():
             req = self.by_slot.get(slot)
             if req is None:
                 continue
-            if req.gen.logprobs is not None and tok != req.gen.eos_id:
-                entry = self.engine.take_logprobs(slot)
-                if entry is not None:
-                    req.logprob_entries.append(entry)
-            if tok != req.gen.eos_id:
+            stopped = False
+            for tok in toks:  # speculative steps emit several tokens
+                if tok == req.gen.eos_id:
+                    continue
+                if req.gen.logprobs is not None:
+                    entry = self.engine.take_logprobs(slot)
+                    if entry is not None:
+                        req.logprob_entries.append(entry)
                 self.tokens_generated_total += 1
                 req.queue.put_nowait(tok)
                 if self._hit_stop(req, tok):
@@ -212,7 +215,10 @@ class Scheduler:
                     req.finish_reason = "stop"
                     req.queue.put_nowait(None)
                     del self.by_slot[slot]
-                    continue
+                    stopped = True
+                    break
+            if stopped:
+                continue
             if not self.engine.active[slot]:
                 req.finish_reason = self.engine.finish_reason[slot]
                 req.queue.put_nowait(None)
@@ -668,6 +674,11 @@ def main(argv=None) -> int:
         help="persistent XLA compile-cache dir (volume-mounted: restarts "
              "skip prefill/decode compiles, cutting time-to-first-token)",
     )
+    p.add_argument(
+        "--spec-draft", type=int, default=4,
+        help="prompt-lookup speculative decoding draft length for greedy "
+             "requests (0 disables)",
+    )
     args = p.parse_args(argv)
 
     from dstack_tpu.utils.logging import configure_logging
@@ -765,7 +776,8 @@ def main(argv=None) -> int:
         params = quantize_tree(params, config)
         logger.info("weights quantized to int8 (per-output-channel scales)")
     engine = InferenceEngine(
-        config, params, max_batch=args.max_batch, max_seq=args.max_seq, mesh=mesh
+        config, params, max_batch=args.max_batch, max_seq=args.max_seq,
+        mesh=mesh, spec_draft=args.spec_draft,
     )
     tokenizer = load_tokenizer(args.tokenizer or "byte")
     app = build_app(engine, tokenizer, args.model, args.chat_template)

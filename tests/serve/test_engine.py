@@ -44,15 +44,13 @@ class TestDecode:
         got1 = [t1]
         # two solo steps, then p2 joins
         for _ in range(2):
-            got1.append(eng.step()[s1])
+            got1.extend(eng.step().get(s1, []))
         s2, t2 = eng.add_request(p2, GenParams(max_new_tokens=6))
         got2 = [t2]
         while eng.active[s1] or eng.active[s2]:
             out = eng.step()
-            if s1 in out:
-                got1.append(out[s1])
-            if s2 in out:
-                got2.append(out[s2])
+            got1.extend(out.get(s1, []))
+            got2.extend(out.get(s2, []))
         assert got1 == ref1
         assert got2 == ref2
 
@@ -232,17 +230,13 @@ class TestChunkedPrefill:
         while first2 is None:
             first2 = eng.prefill_step(s2)
             out = eng.step()  # s1 advances during s2's prefill
-            if s1 in out:
-                got1.append(out[s1])
-            if s2 in out:  # the step right after activation decodes s2 too
-                got2.append(out[s2])
+            got1.extend(out.get(s1, []))
+            got2.extend(out.get(s2, []))  # step right after activation
         got2 = [first2] + got2
         while eng.active[s1] or eng.active[s2]:
             out = eng.step()
-            if s1 in out:
-                got1.append(out[s1])
-            if s2 in out:
-                got2.append(out[s2])
+            got1.extend(out.get(s1, []))
+            got2.extend(out.get(s2, []))
         assert got1 == ref1
         assert got2 == ref2
 
@@ -273,3 +267,69 @@ class TestChunkedPrefill:
         ref = _reference_greedy(self.params, self.config, prompt, 3)
         out = eng.generate(prompt, GenParams(max_new_tokens=3))
         assert out == ref
+
+
+class TestSpeculativeDecoding:
+    """Prompt-lookup speculation must be lossless for greedy decoding
+    and actually accelerate repetitive text."""
+
+    config = llama.LLAMA_TINY
+
+    def setup_method(self):
+        self.params = llama.init_params(self.config, jax.random.key(0))
+
+    def test_lossless_vs_disabled(self):
+        prompt = [7, 8, 9, 10] * 6  # repetitive: drafts will fire
+        on = InferenceEngine(
+            self.config, self.params, max_batch=1, max_seq=128, spec_draft=4
+        )
+        off = InferenceEngine(
+            self.config, self.params, max_batch=1, max_seq=128, spec_draft=0
+        )
+        g = GenParams(max_new_tokens=12)
+        assert on.generate(prompt, g) == off.generate(prompt, GenParams(max_new_tokens=12))
+
+    def test_emits_multiple_tokens_per_step_on_repetition(self):
+        eng = InferenceEngine(
+            self.config, self.params, max_batch=1, max_seq=128, spec_draft=4
+        )
+        prompt = [5, 6] * 10
+        slot, _ = eng.add_request(prompt, GenParams(max_new_tokens=16))
+        steps, tokens = 0, 0
+        while eng.active[slot]:
+            out = eng.step()
+            steps += 1
+            tokens += len(out.get(slot, []))
+            assert steps < 50
+        # a tiny random model may not repeat itself, but the history
+        # n-grams from the prompt guarantee at least SOME drafted steps;
+        # losslessness is covered above — here we check the machinery
+        # emits exactly the budget across fewer-or-equal steps
+        assert tokens == 15  # max_new_tokens - 1 (first came from prefill)
+        assert steps <= tokens
+
+    def test_sampled_requests_bypass_speculation(self):
+        eng = InferenceEngine(
+            self.config, self.params, max_batch=1, max_seq=128, spec_draft=4
+        )
+        prompt = [5, 6] * 8
+        slot, _ = eng.add_request(
+            prompt, GenParams(max_new_tokens=6, temperature=1.0, seed=3)
+        )
+        while eng.active[slot]:
+            out = eng.step()
+            for toks in out.values():
+                assert len(toks) == 1  # plain path only
+        eng.release(slot)
+
+    def test_find_draft_matches_last_ngram(self):
+        eng = InferenceEngine(
+            self.config, self.params, max_batch=1, max_seq=64, spec_draft=3
+        )
+        eng._record_tokens(0, [1, 2, 3, 4, 5, 2, 3])
+        # tail (2,3) previously at index 1; following tokens: 4,5,2
+        assert eng._find_draft(0) == [4, 5, 2]
+        eng.history[0] = []
+        eng._ngram_ix[0] = {}
+        eng._record_tokens(0, [9, 9, 1, 7])
+        assert eng._find_draft(0) == []  # no earlier (1,7)
