@@ -1,0 +1,115 @@
+"""Serving benchmark: decode throughput + TTFT for the slot engine.
+
+``python -m dstack_tpu.serve.bench --model llama-3.2-1b --batch 8``
+drives the engine directly (no HTTP) and prints one JSON line:
+tokens/s decode throughput across concurrent slots, per-request TTFT
+through chunked prefill, and the speculative-decoding step ratio on a
+repetitive workload. Run it on the target TPU to size ``--max-batch``
+and ``--spec-draft`` for a service; CPU runs are smoke tests only.
+"""
+
+import argparse
+import json
+import statistics
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama-tiny")
+    p.add_argument("--batch", type=int, default=4, help="concurrent slots")
+    p.add_argument("--max-seq", type=int, default=1024)
+    p.add_argument("--prompt-len", type=int, default=256)
+    p.add_argument("--gen-len", type=int, default=64)
+    p.add_argument("--spec-draft", type=int, default=0)
+    p.add_argument(
+        "--repetitive", action="store_true",
+        help="tile a short phrase as the prompt (RAG/summarization-like "
+             "repetition where prompt-lookup speculation pays off); "
+             "random prompts measure the no-speculation floor",
+    )
+    p.add_argument("--quantize", default=None, choices=["int8"])
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from dstack_tpu.models import llama
+    from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+    config = llama.CONFIGS[args.model]
+    params = llama.init_params(config, jax.random.key(0))
+    if args.quantize == "int8":
+        from dstack_tpu.models.quant import quantize_tree
+
+        params = quantize_tree(params, config)
+    eng = InferenceEngine(
+        config, params, max_batch=args.batch, max_seq=args.max_seq,
+        spec_draft=args.spec_draft,
+    )
+    rng = np.random.default_rng(0)
+    if args.repetitive:
+        phrase = rng.integers(1, config.vocab_size, 16).tolist()
+        reps = args.prompt_len // 16 + 1
+        prompts = [
+            (phrase * reps)[: args.prompt_len] for _ in range(args.batch)
+        ]
+    else:
+        prompts = [
+            rng.integers(1, config.vocab_size, args.prompt_len).tolist()
+            for _ in range(args.batch)
+        ]
+
+    # warmup: compile prefill chunks + decode
+    eng.generate(prompts[0][:32], GenParams(max_new_tokens=2))
+
+    # TTFT: admission → first sampled token, per request (chunked prefill)
+    ttfts = []
+    slots = []
+    for prompt in prompts:
+        t0 = time.perf_counter()
+        slot, _ = eng.add_request(
+            prompt, GenParams(max_new_tokens=args.gen_len)
+        )
+        ttfts.append(time.perf_counter() - t0)
+        slots.append(slot)
+
+    # decode throughput across all concurrent slots
+    t0 = time.perf_counter()
+    tokens = 0
+    steps = 0
+    while any(eng.active[s] for s in slots):
+        out = eng.step()
+        steps += 1
+        tokens += sum(len(t) for t in out.values())
+    dt = time.perf_counter() - t0
+    for s in slots:
+        eng.release(s)
+
+    result = {
+        "metric": f"serve_decode_tokens_per_sec[{args.model},batch={args.batch}]",
+        "value": round(tokens / dt, 1),
+        "unit": "tokens/s",
+        "extra": {
+            "ttft_ms_p50": round(statistics.median(ttfts) * 1e3, 1),
+            "decode_steps": steps,
+            "tokens": tokens,
+            "tokens_per_step": round(tokens / max(steps, 1), 2),
+            "spec_draft": args.spec_draft,
+            "quantize": args.quantize,
+            "backend": jax.default_backend(),
+        },
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
