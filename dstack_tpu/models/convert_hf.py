@@ -28,12 +28,20 @@ import json
 from pathlib import Path
 from typing import Any, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from dstack_tpu.models.llama import LlamaConfig
 
-__all__ = ["config_from_hf", "convert_state_dict", "load_checkpoint"]
+__all__ = [
+    "config_from_hf",
+    "config_to_hf",
+    "convert_state_dict",
+    "export_state_dict",
+    "load_checkpoint",
+    "save_checkpoint",
+]
 
 
 def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
@@ -47,7 +55,7 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         # paths would silently drop them — refuse rather than mis-serve
         raise ValueError(
             f"{mt} checkpoint sets attention_bias=true, which this "
-            "converter only supports for qwen2"
+            "converter only supports for qwen2/qwen3"
         )
     act = hf.get("hidden_act") or "silu"
     act_map = {"silu": "silu", "gelu_pytorch_tanh": "gelu_tanh"}
@@ -287,3 +295,153 @@ def load_checkpoint(
     sd = _load_raw_state_dict(p)
     params = convert_state_dict(sd, config, hf.get("model_type", "llama"))
     return config, params
+
+
+def config_to_hf(config: LlamaConfig) -> dict:
+    """:class:`LlamaConfig` → HF ``config.json`` dict (inverse of
+    :func:`config_from_hf` for the families we can express)."""
+    c = config
+    hf = {
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.hidden_size,
+        "num_hidden_layers": c.n_layers,
+        "num_attention_heads": c.n_heads,
+        "num_key_value_heads": c.n_kv_heads,
+        "head_dim": c.head_dim,
+        "intermediate_size": c.intermediate_size,
+        "rope_theta": c.rope_theta,
+        "rms_norm_eps": c.norm_eps,
+        "max_position_embeddings": c.max_seq_len,
+        "tie_word_embeddings": c.tie_embeddings,
+        "torch_dtype": "bfloat16",
+    }
+    if c.rope_scaling is not None:
+        factor, low_f, high_f, orig = c.rope_scaling
+        hf["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": factor,
+            "low_freq_factor": low_f,
+            "high_freq_factor": high_f,
+            "original_max_position_embeddings": int(orig),
+        }
+    if c.n_experts:
+        hf.update(
+            model_type="mixtral",
+            num_local_experts=c.n_experts,
+            num_experts_per_tok=c.experts_per_token,
+        )
+    elif c.post_norms:
+        hf.update(
+            model_type="gemma2",
+            hidden_act="gelu_pytorch_tanh",
+            sliding_window=c.sliding_window or None,
+            attn_logit_softcapping=c.attn_softcap or None,
+            final_logit_softcapping=c.logit_softcap or None,
+            query_pre_attn_scalar=(
+                round(c.attn_scale**-2) if c.attn_scale else c.head_dim
+            ),
+        )
+    elif c.norm_offset:
+        hf.update(model_type="gemma", hidden_act="gelu_pytorch_tanh")
+    elif c.qk_norm:
+        hf.update(model_type="qwen3", attention_bias=c.qkv_bias)
+    elif c.qkv_bias:
+        hf.update(model_type="qwen2")
+        if c.sliding_window:
+            hf.update(
+                use_sliding_window=True,
+                sliding_window=c.sliding_window,
+                max_window_layers=0,
+            )
+    elif c.sliding_window:
+        hf.update(model_type="mistral", sliding_window=c.sliding_window)
+    else:
+        hf.update(model_type="llama")
+    return hf
+
+
+def export_state_dict(params: dict, config: LlamaConfig) -> dict:
+    """Our params pytree → flat HF state dict (numpy values) — the
+    inverse of :func:`convert_state_dict`, so fine-tuned weights serve
+    anywhere HF checkpoints do (vLLM, TGI, transformers)."""
+    from dstack_tpu.models.quant import is_quantized
+
+    if is_quantized(params):
+        raise ValueError("export requires full-precision params, not int8")
+    c = config
+    mt = config_to_hf(c)["model_type"]
+    gemma2 = mt == "gemma2"
+
+    def np32(x):
+        # keep the source dtype (bf16 stays bf16): upcasting every
+        # tensor to f32 here would stage a 70B at ~2x its size on host
+        return np.asarray(jax.device_get(x))
+
+    sd: dict = {"model.embed_tokens.weight": np32(params["embed"])}
+    L = params["layers"]
+    for i in range(c.n_layers):
+        P = f"model.layers.{i}."
+        sd[P + "input_layernorm.weight"] = np32(L["attn_norm"][i])
+        sd[P + "self_attn.q_proj.weight"] = np32(L["wq"][i]).T
+        sd[P + "self_attn.k_proj.weight"] = np32(L["wk"][i]).T
+        sd[P + "self_attn.v_proj.weight"] = np32(L["wv"][i]).T
+        sd[P + "self_attn.o_proj.weight"] = np32(L["wo"][i]).T
+        mlp_norm_name = (
+            "pre_feedforward_layernorm.weight" if gemma2
+            else "post_attention_layernorm.weight"
+        )
+        sd[P + mlp_norm_name] = np32(L["mlp_norm"][i])
+        if c.qkv_bias:
+            sd[P + "self_attn.q_proj.bias"] = np32(L["bq"][i])
+            sd[P + "self_attn.k_proj.bias"] = np32(L["bk"][i])
+            sd[P + "self_attn.v_proj.bias"] = np32(L["bv"][i])
+        if c.qk_norm:
+            sd[P + "self_attn.q_norm.weight"] = np32(L["q_norm"][i])
+            sd[P + "self_attn.k_norm.weight"] = np32(L["k_norm"][i])
+        if c.post_norms:
+            sd[P + "post_attention_layernorm.weight"] = np32(L["attn_post_norm"][i])
+            sd[P + "post_feedforward_layernorm.weight"] = np32(L["mlp_post_norm"][i])
+        if c.n_experts:
+            sd[P + "block_sparse_moe.gate.weight"] = np32(L["w_router"][i]).T
+            for e in range(c.n_experts):
+                E = P + f"block_sparse_moe.experts.{e}."
+                sd[E + "w1.weight"] = np32(L["w_gate"][i][e]).T
+                sd[E + "w3.weight"] = np32(L["w_up"][i][e]).T
+                sd[E + "w2.weight"] = np32(L["w_down"][i][e]).T
+        else:
+            sd[P + "mlp.gate_proj.weight"] = np32(L["w_gate"][i]).T
+            sd[P + "mlp.up_proj.weight"] = np32(L["w_up"][i]).T
+            sd[P + "mlp.down_proj.weight"] = np32(L["w_down"][i]).T
+    sd["model.norm.weight"] = np32(params["final_norm"])
+    if not c.tie_embeddings:
+        sd["lm_head.weight"] = np32(params["lm_head"]).T
+    return sd
+
+
+def save_checkpoint(config: LlamaConfig, params: dict, path: str) -> None:
+    """Write an HF ``save_pretrained``-compatible directory
+    (config.json + model.safetensors, bf16).
+
+    The tensors go through torch: safetensors' numpy API mangles
+    ml_dtypes bfloat16 arrays (verified: values corrupt on round trip),
+    while the torch API stores bf16 natively.
+    """
+    import ml_dtypes
+    import torch
+    from safetensors.torch import save_file
+
+    def to_torch_bf16(v: np.ndarray):
+        v = np.ascontiguousarray(v)
+        if v.dtype == ml_dtypes.bfloat16:
+            # bit-exact reinterpretation, no f32 staging
+            return torch.from_numpy(v.view(np.uint16)).view(torch.bfloat16)
+        return torch.from_numpy(np.asarray(v, np.float32)).to(torch.bfloat16)
+
+    p = Path(path)
+    p.mkdir(parents=True, exist_ok=True)
+    (p / "config.json").write_text(json.dumps(config_to_hf(config), indent=2))
+    sd = export_state_dict(params, config)
+    save_file(
+        {k: to_torch_bf16(v) for k, v in sd.items()},
+        str(p / "model.safetensors"),
+    )

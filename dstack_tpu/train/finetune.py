@@ -82,6 +82,12 @@ def main(argv=None) -> int:
         help="resume from the latest checkpoint in --ckpt-dir",
     )
     p.add_argument(
+        "--export-hf", default=None,
+        help="also write the final weights as an HF save_pretrained dir "
+             "(LoRA adapters are merged into the base first) — servable "
+             "by transformers/vLLM/TGI or openai_server --hf-model",
+    )
+    p.add_argument(
         "--platform", default=None,
         help="force a jax platform (e.g. cpu); overrides sitecustomize pins",
     )
@@ -324,10 +330,13 @@ def main(argv=None) -> int:
             return np.asarray(multihost_utils.process_allgather(x, tiled=True))
         return np.asarray(jax.device_get(x))
 
+    host_params = None
     if args.full:
+        # ONE device->host gather serves both the npz save and --export-hf
+        host_params = jax.tree.map(fetch, state["params"])
         flat = {
-            "/".join(str(getattr(k, "key", k)) for k in path): fetch(leaf)
-            for path, leaf in jax.tree_util.tree_leaves_with_path(state["params"])
+            "/".join(str(getattr(k, "key", k)) for k in path): leaf
+            for path, leaf in jax.tree_util.tree_leaves_with_path(host_params)
         }
         flat["step"] = fetch(state["step"])
     else:
@@ -340,6 +349,20 @@ def main(argv=None) -> int:
         fname = "model_weights.npz" if args.full else "lora_adapters.npz"
         np.savez(out / fname, **flat)
         print(f"weights saved to {out}/{fname}", flush=True)
+
+    if args.export_hf:
+        from dstack_tpu.models.convert_hf import save_checkpoint
+
+        if args.full:
+            host = host_params
+        else:
+            host = jax.tree.map(
+                fetch,
+                lora_mod.merge_lora_params(params, state["lora"], lora_conf),
+            )
+        if jax.process_index() == 0:
+            save_checkpoint(config, host, args.export_hf)
+            print(f"HF checkpoint exported to {args.export_hf}", flush=True)
     return 0
 
 

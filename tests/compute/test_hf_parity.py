@@ -262,3 +262,68 @@ class TestEngineParity:
         self._assert_greedy_parity(
             tmp_path, m, replace_cfg={"capacity_factor": 4.0}
         )
+
+
+class TestExport:
+    """Round trip: our params → HF directory → transformers forward
+    must match our forward (the inverse converter is exact up to bf16)."""
+
+    @pytest.mark.parametrize("family_kw", [
+        {},  # llama
+        {"qk_norm_family": True},  # qwen3
+    ])
+    def test_roundtrip_through_transformers(self, tmp_path, family_kw):
+        from dstack_tpu.models.convert_hf import save_checkpoint
+
+        if family_kw.get("qk_norm_family"):
+            config = llama.LlamaConfig(
+                vocab_size=128, hidden_size=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, head_dim=16, intermediate_size=96,
+                rope_theta=10000.0, max_seq_len=64, dtype=jnp.float32,
+                remat=False, qk_norm=True,
+            )
+        else:
+            config = llama.LlamaConfig(
+                vocab_size=128, hidden_size=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, head_dim=16, intermediate_size=96,
+                rope_theta=10000.0, max_seq_len=64, dtype=jnp.float32,
+                remat=False,
+            )
+        params = llama.init_params(config, jax.random.key(0))
+        out_dir = tmp_path / "export"
+        save_checkpoint(config, params, str(out_dir))
+
+        hf_model = transformers.AutoModelForCausalLM.from_pretrained(
+            str(out_dir), torch_dtype=torch.float32
+        )
+        hf_model.eval()
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, config.vocab_size, (2, 12))
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(params, jnp.asarray(tokens), config)
+        # bf16 storage rounds the weights once
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=0.05, atol=0.05)
+
+    def test_reload_with_our_loader(self, tmp_path):
+        from dstack_tpu.models.convert_hf import load_checkpoint, save_checkpoint
+
+        config = llama.dataclasses.replace(
+            llama.LLAMA_TINY, vocab_size=300, tie_embeddings=False
+        )
+        params = llama.init_params(config, jax.random.key(1))
+        save_checkpoint(config, params, str(tmp_path / "rt"))
+        config2, params2 = load_checkpoint(
+            str(tmp_path / "rt"), dtype=jnp.float32
+        )
+        assert config2.n_layers == config.n_layers
+        rng = np.random.default_rng(2)
+        tokens = jnp.asarray(rng.integers(0, 300, (1, 16)))
+        a = llama.forward(params, tokens, config)
+        b = llama.forward(
+            jax.device_put(params2), tokens,
+            llama.dataclasses.replace(config2, remat=False),
+        )
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0.05, atol=0.05
+        )
