@@ -302,6 +302,9 @@ def config_to_hf(config: LlamaConfig) -> dict:
     :func:`config_from_hf` for the families we can express)."""
     c = config
     hf = {
+        "hidden_act": (
+            "gelu_pytorch_tanh" if c.hidden_act == "gelu_tanh" else "silu"
+        ),
         "vocab_size": c.vocab_size,
         "hidden_size": c.hidden_size,
         "num_hidden_layers": c.n_layers,
@@ -333,7 +336,6 @@ def config_to_hf(config: LlamaConfig) -> dict:
     elif c.post_norms:
         hf.update(
             model_type="gemma2",
-            hidden_act="gelu_pytorch_tanh",
             sliding_window=c.sliding_window or None,
             attn_logit_softcapping=c.attn_softcap or None,
             final_logit_softcapping=c.logit_softcap or None,
@@ -342,7 +344,7 @@ def config_to_hf(config: LlamaConfig) -> dict:
             ),
         )
     elif c.norm_offset:
-        hf.update(model_type="gemma", hidden_act="gelu_pytorch_tanh")
+        hf.update(model_type="gemma")
     elif c.qk_norm:
         hf.update(model_type="qwen3", attention_bias=c.qkv_bias)
     elif c.qkv_bias:

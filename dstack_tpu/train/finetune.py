@@ -33,6 +33,11 @@ def main(argv=None) -> int:
     )
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--batch", type=int, default=8, help="global batch size")
+    p.add_argument(
+        "--grad-accum", type=int, default=1,
+        help="gradient-accumulation microbatches (effective batch = "
+             "--batch; activations sized --batch / accum)",
+    )
     p.add_argument("--steps", type=int, default=100)
     p.add_argument("--lr", type=float, default=2e-4)
     p.add_argument("--full", action="store_true", help="full fine-tune (no LoRA)")
@@ -86,6 +91,11 @@ def main(argv=None) -> int:
         help="also write the final weights as an HF save_pretrained dir "
              "(LoRA adapters are merged into the base first) — servable "
              "by transformers/vLLM/TGI or openai_server --hf-model",
+    )
+    p.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax profiler trace (XLA ops, HBM, fusion view — "
+             "open in tensorboard/xprof) of 3 steady-state steps",
     )
     p.add_argument(
         "--platform", default=None,
@@ -148,15 +158,19 @@ def main(argv=None) -> int:
     # hf_params (host numpy tree from convert_hf) goes straight into the
     # sharded buffers — never whole on one chip, never alongside a
     # discarded random init
+    if args.batch % max(args.grad_accum, 1) != 0:
+        p.error(f"--batch {args.batch} not divisible by --grad-accum {args.grad_accum}")
     if args.full:
         state, _ = sharded_init(config, opt, mesh, params=hf_params)
-        step_fn = make_train_step(config, opt, mesh)
+        step_fn = make_train_step(config, opt, mesh, grad_accum=args.grad_accum)
     else:
         lora_conf = lora_mod.LoRAConfig(rank=args.lora_rank, alpha=args.lora_alpha)
         params, state, _ = lora_mod.sharded_lora_init(
             config, lora_conf, opt, mesh, params=hf_params
         )
-        step_fn = lora_mod.make_lora_train_step(config, lora_conf, opt, mesh)
+        step_fn = lora_mod.make_lora_train_step(
+            config, lora_conf, opt, mesh, grad_accum=args.grad_accum
+        )
     print(f"init done in {time.perf_counter() - t0:.1f}s", flush=True)
 
     start_step = 0
@@ -275,12 +289,21 @@ def main(argv=None) -> int:
     tokens_per_step = args.batch * args.seq_len
     first_step_at = None
     t_window = time.perf_counter()
+    # profile 3 steady-state steps: skip compile + warmup noise
+    prof_start = start_step + min(2, max(args.steps - start_step - 3, 0))
+    prof_stop = prof_start + min(3, args.steps - start_step)
     for i in range(start_step, args.steps):
+        if args.profile_dir and i == prof_start:
+            jax.profiler.start_trace(args.profile_dir)
         batch = next_batch(i)
         if args.full:
             state, metrics = step_fn(state, batch)
         else:
             state, metrics = step_fn(params, state, batch)
+        if args.profile_dir and i + 1 == prof_stop:
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            print(f"profiler trace saved to {args.profile_dir}", flush=True)
         if checkpointer is not None and (i + 1) % args.ckpt_every == 0:
             # async: only the device->host copy blocks; the write runs
             # in the background while training continues

@@ -184,11 +184,14 @@ def make_lora_train_step(
     mesh: Mesh,
     rules: Optional[ShardingRules] = None,
     attn_impl: Optional[str] = None,
+    grad_accum: int = 1,
 ) -> Callable:
     """Jitted (base_params, lora_state, batch) → (lora_state, metrics).
 
     Base params are a frozen input: no grads, no optimizer state, not
-    donated (they are reused every step)."""
+    donated (they are reused every step). ``grad_accum > 1`` scans that
+    many microbatches (mask-weighted average) before one update —
+    see train/step.py."""
     rules = rules or default_rules()
     base_sh, state_sh = lora_state_specs(config, lora_config, optimizer, rules, mesh)
     b_sh = batch_sharding(mesh, rules)
@@ -209,8 +212,33 @@ def make_lora_train_step(
         loss, _ = cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
         return loss
 
+    def accum_grads(lora, params, batch):
+        micro = jax.tree.map(
+            lambda a: a.reshape(
+                (grad_accum, a.shape[0] // grad_accum) + a.shape[1:]
+            ),
+            batch,
+        )
+
+        def body(carry, mb):
+            g_acc, loss_acc, w_acc = carry
+            loss, g = jax.value_and_grad(loss_fn)(lora, params, mb)
+            w = jnp.maximum(mb["mask"].astype(jnp.float32).sum(), 1.0)
+            g_acc = jax.tree.map(lambda a, b: a + b * w, g_acc, g)
+            return (g_acc, loss_acc + loss * w, w_acc + w), None
+
+        zeros = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), lora)
+        (g, loss, w), _ = jax.lax.scan(
+            body, (zeros, jnp.zeros(()), jnp.zeros(())), micro
+        )
+        grads = jax.tree.map(lambda a, l: (a / w).astype(l.dtype), g, lora)
+        return loss / w, grads
+
     def step(params, state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(state["lora"], params, batch)
+        if grad_accum > 1:
+            loss, grads = accum_grads(state["lora"], params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(state["lora"], params, batch)
         updates, opt_state = optimizer.update(grads, state["opt_state"], state["lora"])
         lora = optax.apply_updates(state["lora"], updates)
         new_state = {

@@ -243,6 +243,7 @@ def make_train_step(
     attn_impl: Optional[str] = None,
     loss_impl: str = "fused",  # "fused" | "chunked"
     n_micro: Optional[int] = None,
+    grad_accum: int = 1,
 ) -> Callable:
     """Build the jitted train step: (state, batch{tokens,targets,mask}) →
     (state, metrics).
@@ -251,6 +252,12 @@ def make_train_step(
     accumulated logits tensor, reductions fused — fastest) or "chunked"
     (sequence-chunked scan with remat — lowest peak HBM, for memory-
     tight configs).
+
+    ``grad_accum > 1`` splits the batch's leading dim into that many
+    microbatches and scans them, averaging gradients before ONE
+    optimizer update — the effective batch scales past what activations
+    fit in HBM, at one extra params-sized f32 accumulator. Masked token
+    counts weight the average, so ragged masks stay exact.
 
     On pipeline meshes (pp > 1) the layer stack runs through
     ``forward_pipelined`` with ``n_micro`` microbatches (default: pp).
@@ -291,10 +298,37 @@ def make_train_step(
             )
         return loss + aux, (loss, aux)
 
-    def step(state, batch):
-        (_, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], batch
+    def grads_of(params, batch):
+        return jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+
+    def accum_grads(params, batch):
+        """Scan grad_accum microbatches; weight by each one's mask sum."""
+        micro = jax.tree.map(
+            lambda a: a.reshape((grad_accum, a.shape[0] // grad_accum) + a.shape[1:]),
+            batch,
         )
+
+        def body(carry, mb):
+            g_acc, loss_acc, aux_acc, w_acc = carry
+            (_, (loss, aux)), g = grads_of(params, mb)
+            w = jnp.maximum(mb["mask"].astype(jnp.float32).sum(), 1.0)
+            g_acc = jax.tree.map(lambda a, b: a + b * w, g_acc, g)
+            return (g_acc, loss_acc + loss * w, aux_acc + aux * w, w_acc + w), None
+
+        zeros = jax.tree.map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), params
+        )
+        (g, loss, aux, w), _ = jax.lax.scan(
+            body, (zeros, jnp.zeros(()), jnp.zeros(()), jnp.zeros(())), micro
+        )
+        grads = jax.tree.map(lambda a, p: (a / w).astype(p.dtype), g, params)
+        return loss / w, aux / w, grads
+
+    def step(state, batch):
+        if grad_accum > 1:
+            loss, aux, grads = accum_grads(state["params"], batch)
+        else:
+            (_, (loss, aux)), grads = grads_of(state["params"], batch)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

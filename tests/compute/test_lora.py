@@ -123,3 +123,39 @@ class TestLoRATraining:
         ) == jax.tree.structure(
             jax.tree.map(lambda x: 0, specs, is_leaf=lambda x: isinstance(x, tuple))
         )
+
+
+class TestLoRAGradAccum:
+    def test_accumulated_matches_full_batch(self):
+        import optax
+
+        from dstack_tpu.models import llama
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+        from dstack_tpu.train.lora import (
+            LoRAConfig,
+            make_lora_train_step,
+            sharded_lora_init,
+        )
+
+        config = llama.LLAMA_TINY
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=1), devices=jax.devices()[:1])
+        lc = LoRAConfig(rank=4, alpha=8.0)
+        opt = optax.sgd(1e-2)
+        tokens = jax.random.randint(jax.random.key(0), (4, 64), 0, config.vocab_size)
+        batch = {
+            "tokens": tokens,
+            "targets": jnp.roll(tokens, -1, axis=1),
+            "mask": jnp.ones_like(tokens),
+        }
+        p1, s1, _ = sharded_lora_init(config, lc, opt, mesh, seed=0)
+        p2, s2, _ = sharded_lora_init(config, lc, opt, mesh, seed=0)
+        full = make_lora_train_step(config, lc, opt, mesh)
+        accum = make_lora_train_step(config, lc, opt, mesh, grad_accum=2)
+        s1, m1 = full(p1, s1, batch)
+        s2, m2 = accum(p2, s2, batch)
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(s1["lora"]), jax.tree.leaves(s2["lora"])):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-3, atol=2e-6,
+            )
