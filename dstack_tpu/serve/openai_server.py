@@ -426,10 +426,60 @@ def build_app(
             content_type="text/plain",
         )
 
+    import dataclasses as _dc
+
     async def _run(prompt: str, payload: dict):
         req = _Request(tokenizer.encode(prompt), _gen_params(payload, tokenizer))
         await sched.submit(req)
         return req
+
+    def _n_choices(payload: dict):
+        """Validated OpenAI ``n`` (choices per request) → int or an
+        error response. Explicit null means default, like every other
+        optional param."""
+        n = payload.get("n")
+        if n is None:
+            n = 1
+        if not isinstance(n, int) or isinstance(n, bool) or not 1 <= n <= 8:
+            return web.json_response(
+                {"detail": "'n' must be an integer in [1, 8]"}, status=400
+            )
+        if n > 1 and payload.get("stream"):
+            return web.json_response(
+                {"detail": "streaming with n > 1 is not supported"}, status=400
+            )
+        return n
+
+    async def _collect(req) -> list:
+        ids = []
+        try:
+            while True:
+                tok = await req.queue.get()
+                if tok is None:
+                    break
+                ids.append(tok)
+        finally:
+            sched.cancel(req)
+        return ids
+
+    async def _fan_out(first_req, n: int):
+        """Submit the remaining n-1 choices (prompt tokenized once, gen
+        params copied with a per-choice seed offset), collect all →
+        (reqs, id_lists, total_completion_tokens) or an error response."""
+        reqs = [first_req]
+        for i in range(1, n):
+            gen = _dc.replace(first_req.gen)
+            if gen.seed is not None:
+                gen.seed += i  # distinct deterministic stream per choice
+            req = _Request(list(first_req.prompt_ids), gen)
+            await sched.submit(req)
+            reqs.append(req)
+        id_lists = await asyncio.gather(*(_collect(r) for r in reqs))
+        err = next((r.error for r in reqs if r.error), None)
+        if err:
+            return web.json_response({"detail": err}, status=500)
+        total = sum(len(ids) for ids in id_lists)
+        return reqs, id_lists, total
 
     async def chat_completions(request):
         from dstack_tpu.proxy.model_tgi import TGIAdapterError
@@ -450,6 +500,9 @@ def build_app(
             prompt = render_chat(messages, chat_template or DEFAULT_CHAT_TEMPLATE)
         except TGIAdapterError as e:
             return web.json_response({"detail": str(e)}, status=e.status)
+        n = _n_choices(payload)
+        if not isinstance(n, int):
+            return n
         req = await _run(prompt, payload)
         completion_id = f"chatcmpl-{uuid.uuid4().hex}"
         created = int(time.time())
@@ -548,38 +601,34 @@ def build_app(
             await resp.write(b"data: " + json.dumps(final).encode() + b"\n\n")
             await resp.write(b"data: [DONE]\n\n")
             return resp
-        ids = []
-        try:
-            while True:
-                tok = await req.queue.get()
-                if tok is None:
-                    break
-                ids.append(tok)
-        finally:
-            sched.cancel(req)
-        if req.error:
-            return web.json_response({"detail": req.error}, status=500)
-        text = _truncate_stop(tokenizer.decode(ids), req.gen.stop)
-        choice = {
-            "index": 0,
-            "message": {"role": "assistant", "content": text},
-            "finish_reason": req.finish_reason or "stop",
-        }
-        if req.gen.logprobs is not None:
-            choice["logprobs"] = _format_chat_logprobs(
-                req, tokenizer, req.gen.logprobs, text
-            )
+        fanned = await _fan_out(req, n)
+        if not isinstance(fanned, tuple):
+            return fanned
+        reqs, id_lists, total_completion = fanned
+        choices = []
+        for i, (r, ids) in enumerate(zip(reqs, id_lists)):
+            text = _truncate_stop(tokenizer.decode(ids), r.gen.stop)
+            choice = {
+                "index": i,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": r.finish_reason or "stop",
+            }
+            if r.gen.logprobs is not None:
+                choice["logprobs"] = _format_chat_logprobs(
+                    r, tokenizer, r.gen.logprobs, text
+                )
+            choices.append(choice)
         return web.json_response(
             {
                 "id": completion_id,
                 "object": "chat.completion",
                 "created": created,
                 "model": model_name,
-                "choices": [choice],
+                "choices": choices,
                 "usage": {
                     "prompt_tokens": len(req.prompt_ids),
-                    "completion_tokens": len(ids),
-                    "total_tokens": len(req.prompt_ids) + len(ids),
+                    "completion_tokens": total_completion,
+                    "total_tokens": len(req.prompt_ids) + total_completion,
                 },
             }
         )
@@ -592,38 +641,37 @@ def build_app(
         prompt = payload.get("prompt")
         if not isinstance(prompt, str):
             return web.json_response({"detail": "'prompt' required"}, status=400)
-        req = await _run(prompt, payload)
-        ids = []
-        try:
-            while True:
-                tok = await req.queue.get()
-                if tok is None:
-                    break
-                ids.append(tok)
-        finally:
-            sched.cancel(req)
-        if req.error:
-            return web.json_response({"detail": req.error}, status=500)
-        choice = {
-            "index": 0,
-            "text": _truncate_stop(tokenizer.decode(ids), req.gen.stop),
-            "finish_reason": req.finish_reason or "stop",
-        }
-        if req.gen.logprobs is not None:
-            choice["logprobs"] = _format_completions_logprobs(
-                req, tokenizer, req.gen.logprobs, choice["text"],
-            )
+        n = _n_choices(payload)
+        if not isinstance(n, int):
+            return n
+        first = await _run(prompt, payload)
+        fanned = await _fan_out(first, n)
+        if not isinstance(fanned, tuple):
+            return fanned
+        reqs, id_lists, total_completion = fanned
+        choices = []
+        for i, (r, ids) in enumerate(zip(reqs, id_lists)):
+            choice = {
+                "index": i,
+                "text": _truncate_stop(tokenizer.decode(ids), r.gen.stop),
+                "finish_reason": r.finish_reason or "stop",
+            }
+            if r.gen.logprobs is not None:
+                choice["logprobs"] = _format_completions_logprobs(
+                    r, tokenizer, r.gen.logprobs, choice["text"],
+                )
+            choices.append(choice)
         return web.json_response(
             {
                 "id": f"cmpl-{uuid.uuid4().hex}",
                 "object": "text_completion",
                 "created": int(time.time()),
                 "model": model_name,
-                "choices": [choice],
+                "choices": choices,
                 "usage": {
-                    "prompt_tokens": len(req.prompt_ids),
-                    "completion_tokens": len(ids),
-                    "total_tokens": len(req.prompt_ids) + len(ids),
+                    "prompt_tokens": len(reqs[0].prompt_ids),
+                    "completion_tokens": total_completion,
+                    "total_tokens": len(reqs[0].prompt_ids) + total_completion,
                 },
             }
         )

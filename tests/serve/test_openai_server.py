@@ -465,3 +465,77 @@ class TestServeMetrics:
             assert metrics["dstack_serve_active_slots"] == 0  # finished
         finally:
             await client.close()
+
+
+class TestNChoices:
+    async def test_n_greedy_choices_identical(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "llama-tiny", "prompt": "ab", "max_tokens": 4, "n": 3},
+            )
+            d = await r.json()
+            assert [c["index"] for c in d["choices"]] == [0, 1, 2]
+            texts = [c["text"] for c in d["choices"]]
+            assert texts[0] == texts[1] == texts[2]  # greedy
+            # usage sums across choices: 3 choices × 4 tokens each
+            assert d["usage"]["completion_tokens"] == 12
+        finally:
+            await client.close()
+
+    async def test_n_seeded_choices_differ(self):
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "llama-tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 8, "n": 2, "temperature": 1.0, "seed": 11,
+                },
+            )
+            d = await r.json()
+            assert len(d["choices"]) == 2
+            a, b = (c["message"]["content"] for c in d["choices"])
+            assert a != b  # per-choice seed offsets give distinct streams
+            # and deterministically reproducible
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "llama-tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 8, "n": 2, "temperature": 1.0, "seed": 11,
+                },
+            )
+            d2 = await r.json()
+            assert [c["message"]["content"] for c in d2["choices"]] == [a, b]
+        finally:
+            await client.close()
+
+    async def test_bad_n_rejected(self):
+        client = await _client()
+        try:
+            # explicit null = default (like other optional params)
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "m", "prompt": "x", "max_tokens": 2, "n": None},
+            )
+            assert r.status == 200
+            for bad in (0, 9, "2", True):
+                r = await client.post(
+                    "/v1/completions",
+                    json={"model": "m", "prompt": "x", "max_tokens": 2, "n": bad},
+                )
+                assert r.status == 400, bad
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "n": 2, "stream": True,
+                },
+            )
+            assert r.status == 400
+        finally:
+            await client.close()
