@@ -255,3 +255,119 @@ class TestAutoscaler:
             }
         )
         assert isinstance(get_service_scaler(auto), RPSAutoscaler)
+
+
+class TestFullStackModelService:
+    async def test_inrepo_engine_served_through_model_proxy(self, tmp_path):
+        """Capstone integration: a `type: service` whose command is the
+        framework's OWN OpenAI server (tiny model, CPU) — submitted
+        through the REST API, provisioned by the local backend's real
+        shim/runner agents, registered in the model registry, and
+        answered end-to-end through the in-server model proxy. Every
+        plane participates: control plane → reconcilers → agents →
+        service registry → model proxy → slot engine."""
+        from pathlib import Path
+
+        from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
+
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="fs-tok",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        port = _free_port()
+        body = {
+            "run_spec": {
+                "run_name": "engine-svc",
+                "configuration": {
+                    "type": "service",
+                    "commands": [
+                        # job processes run outside the repo dir — put
+                        # the framework on the path like a real image
+                        # would have it installed
+                        f"PYTHONPATH={Path.cwd()} "
+                        "python -m dstack_tpu.serve.openai_server "
+                        "--model llama-tiny --platform cpu "
+                        f"--port {port} --max-batch 2 --max-seq 64 "
+                        "--tp 1 --spec-draft 0"
+                    ],
+                    "port": port,
+                    "model": "tiny-engine",
+                    "auth": False,
+                },
+                "ssh_key_pub": "ssh-ed25519 AAAA t",
+            }
+        }
+        try:
+            r = await client.post(
+                "/api/project/main/runs/apply",
+                headers=_auth("fs-tok"), json=body,
+            )
+            assert r.status == 200
+
+            deadline = asyncio.get_event_loop().time() + 90
+            status = None
+            while asyncio.get_event_loop().time() < deadline:
+                r = await client.post(
+                    "/api/project/main/runs/get",
+                    headers=_auth("fs-tok"),
+                    json={"run_name": "engine-svc"},
+                )
+                run = await r.json()
+                status = run["status"]
+                if status == "running":
+                    break
+                assert status not in ("failed", "terminated"), run
+                await asyncio.sleep(0.5)
+            assert status == "running"
+
+            # the engine compiles its first kernels on the first request;
+            # poll generously (CPU jit under full-suite load)
+            payload = {
+                "model": "tiny-engine",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4,
+            }
+            data = None
+            last = None
+            for _ in range(240):
+                r = await client.post(
+                    "/proxy/models/main/chat/completions", json=payload
+                )
+                if r.status == 200:
+                    data = await r.json()
+                    break
+                last = (r.status, (await r.text())[:200])
+                await asyncio.sleep(1.0)
+            if data is None:
+                rr = await client.post(
+                    "/api/project/main/runs/get",
+                    headers=_auth("fs-tok"),
+                    json={"run_name": "engine-svc"},
+                )
+                run_state = await rr.json()
+                raise AssertionError(
+                    f"model proxy never answered: last={last} "
+                    f"run={run_state.get('status')} "
+                    f"msg={run_state.get('status_message')}"
+                )
+            assert data["object"] == "chat.completion"
+            assert data["usage"]["completion_tokens"] >= 1
+            assert data["choices"][0]["message"]["role"] == "assistant"
+
+            # the registry lists the model
+            r = await client.get("/proxy/models/main/models")
+            models = await r.json()
+            assert "tiny-engine" in [m["id"] for m in models["data"]]
+
+            await client.post(
+                "/api/project/main/runs/stop",
+                headers=_auth("fs-tok"),
+                json={"runs_names": ["engine-svc"]},
+            )
+        finally:
+            await client.close()
