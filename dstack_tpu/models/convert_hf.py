@@ -7,7 +7,9 @@ torch ``.bin`` shards) and get back ``(LlamaConfig, params)`` ready for
 finetune driver.
 
 Supported ``model_type``s: ``llama``, ``qwen2``, ``qwen3``,
-``mistral``, ``gemma``, ``gemma2``, ``mixtral``. Each maps onto :class:`LlamaConfig` family
+``mistral``, ``gemma``, ``gemma2``, ``mixtral``, ``phi3`` (fused
+qkv/gate_up projections are split on load; a Phi-3 export round-trips
+as the equivalent mistral/llama layout). Each maps onto :class:`LlamaConfig` family
 flags (qkv_bias / sliding_window / norm_offset / softcaps / MoE) — the
 architecture deltas live in the config, not in per-family model code.
 
@@ -113,6 +115,10 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         )
     if mt == "mistral":
         return LlamaConfig(**common, sliding_window=hf.get("sliding_window") or 0)
+    if mt == "phi3":
+        if float(hf.get("partial_rotary_factor") or 1.0) != 1.0:
+            raise ValueError("phi3 partial_rotary_factor != 1 is not supported")
+        return LlamaConfig(**common, sliding_window=hf.get("sliding_window") or 0)
     if mt == "gemma":
         return LlamaConfig(
             **{**common, "tie_embeddings": True},
@@ -191,6 +197,8 @@ def convert_state_dict(
     """
     c = config
     dt = c.dtype
+    if model_type == "phi3":
+        sd = _split_phi3(dict(sd), c)
 
     def get(name):
         if name not in sd:
@@ -258,6 +266,24 @@ def convert_state_dict(
     if not c.tie_embeddings:
         params["lm_head"] = np.asarray(get("lm_head.weight").T, dt)
     return params
+
+
+def _split_phi3(sd: dict, c: LlamaConfig) -> dict:
+    """Phi-3 fuses q/k/v into ``qkv_proj`` and gate/up into
+    ``gate_up_proj`` ([out, in] rows: q then k then v; gate then up) —
+    split them into the standard per-projection names."""
+    for i in range(c.n_layers):
+        P = f"model.layers.{i}."
+        qkv = _to_np(sd.pop(P + "self_attn.qkv_proj.weight"))
+        q, k, v = np.split(qkv, [c.q_dim, c.q_dim + c.kv_dim], axis=0)
+        sd[P + "self_attn.q_proj.weight"] = q
+        sd[P + "self_attn.k_proj.weight"] = k
+        sd[P + "self_attn.v_proj.weight"] = v
+        gu = _to_np(sd.pop(P + "mlp.gate_up_proj.weight"))
+        gate, up = np.split(gu, 2, axis=0)
+        sd[P + "mlp.gate_proj.weight"] = gate
+        sd[P + "mlp.up_proj.weight"] = up
+    return sd
 
 
 def _load_raw_state_dict(path: Path) -> dict:

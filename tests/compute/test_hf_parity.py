@@ -169,6 +169,14 @@ class TestHFParity:
         # layer windows alternate sliding/global, HF convention
         assert llama.layer_windows(cfg) == [8, 0, 8, 0]
 
+    def test_phi3_fused_projections(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Phi3Config, transformers.Phi3ForCausalLM,
+            pad_token_id=0,  # default 32000 exceeds the tiny vocab
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert not cfg.qkv_bias and cfg.hidden_act == "silu"
+
     def test_mixtral(self, tmp_path):
         m = _save_tiny(
             tmp_path, transformers.MixtralConfig, transformers.MixtralForCausalLM,
