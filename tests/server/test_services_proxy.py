@@ -288,8 +288,10 @@ class TestFullStackModelService:
                     "commands": [
                         # job processes run outside the repo dir — put
                         # the framework on the path like a real image
-                        # would have it installed
-                        f"PYTHONPATH={Path.cwd()} "
+                        # would have it installed (repo root derived
+                        # from this file: cwd is not guaranteed)
+                        f"PYTHONPATH={Path(__file__).resolve().parents[2]}"
+                        "${PYTHONPATH:+:$PYTHONPATH} "
                         "python -m dstack_tpu.serve.openai_server "
                         "--model llama-tiny --platform cpu "
                         f"--port {port} --max-batch 2 --max-seq 64 "
@@ -363,11 +365,14 @@ class TestFullStackModelService:
             r = await client.get("/proxy/models/main/models")
             models = await r.json()
             assert "tiny-engine" in [m["id"] for m in models["data"]]
-
-            await client.post(
-                "/api/project/main/runs/stop",
-                headers=_auth("fs-tok"),
-                json={"runs_names": ["engine-svc"]},
-            )
         finally:
-            await client.close()
+            # stop in finally: an assertion mid-test must not orphan
+            # the spawned engine process (it outlives pytest otherwise)
+            try:
+                await client.post(
+                    "/api/project/main/runs/stop",
+                    headers=_auth("fs-tok"),
+                    json={"runs_names": ["engine-svc"]},
+                )
+            finally:
+                await client.close()
