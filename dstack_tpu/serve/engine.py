@@ -40,6 +40,8 @@ class GenParams:
     top_p: float = 1.0
     top_k: int = 0  # 0 = off
     repetition_penalty: float = 1.0  # HF-style multiplicative; 1 = off
+    presence_penalty: float = 0.0  # OpenAI additive: once-seen tokens
+    frequency_penalty: float = 0.0  # OpenAI additive: per occurrence
     seed: Optional[int] = None  # per-request sampling seed
     eos_id: Optional[int] = None
     stop: Optional[list] = None  # stop strings (matched by the server)
@@ -459,19 +461,26 @@ def sample(
     top_p: jax.Array,  # [B]
     top_k: jax.Array,  # [B] int32, 0 = off
     rep_pen: jax.Array,  # [B] f32, 1.0 = off
-    seen: jax.Array,  # [B, V] bool: tokens in prompt or generated so far
+    counts: jax.Array,  # [B, V] int32: occurrences in prompt + generated
+    pres_pen: jax.Array,  # [B] f32 additive presence penalty
+    freq_pen: jax.Array,  # [B] f32 additive frequency penalty
 ) -> tuple[jax.Array, jax.Array]:
     """→ (tokens [B], advanced key_data). Greedy when temperature == 0,
-    else repetition-penalized temperature/top-k/top-p sampling — all
-    branches computed, selected per slot (static shapes). Per-slot keys
-    make a request's stream deterministic under its ``seed`` regardless
-    of which other slots are active."""
+    else penalized temperature/top-k/top-p sampling — all branches
+    computed, selected per slot (static shapes). Per-slot keys make a
+    request's stream deterministic under its ``seed`` regardless of
+    which other slots are active."""
     v = logits.shape[-1]
+    seen = counts > 0
     # HF repetition penalty: previously-seen tokens get logit/p when
     # positive, logit*p when negative (p > 1 discourages repeats)
     pen = rep_pen[:, None]
     penalized = jnp.where(logits > 0, logits / pen, logits * pen)
     logits = jnp.where(seen & (pen != 1.0), penalized, logits)
+    # OpenAI additive penalties: presence once per seen token,
+    # frequency per occurrence
+    logits = logits - pres_pen[:, None] * seen.astype(jnp.float32)
+    logits = logits - freq_pen[:, None] * counts.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     # ONE [B, V] descending sort serves both filters — at a 128k vocab
@@ -527,21 +536,21 @@ def token_logprobs(
     return chosen, top_ids, top_lp
 
 
-def _mark_seen(seen: jax.Array, rows: jax.Array, tokens: jax.Array) -> jax.Array:
-    """seen[rows[i], tokens[i]] = True (donated in-place update)."""
-    return seen.at[rows, tokens].set(True)
+def _mark_seen(counts: jax.Array, rows: jax.Array, tokens: jax.Array) -> jax.Array:
+    """counts[rows[i], tokens[i]] += 1 (donated in-place update)."""
+    return counts.at[rows, tokens].add(1)
 
 
 def _mark_prompt(
-    seen: jax.Array, slot: jax.Array, padded: jax.Array, tp: jax.Array
+    counts: jax.Array, slot: jax.Array, padded: jax.Array, tp: jax.Array
 ) -> jax.Array:
-    """Reset slot's row, then mark the prompt's first ``tp`` tokens
+    """Reset slot's row, then count the prompt's first ``tp`` tokens
     (padding indices are pushed out of range and dropped)."""
-    v = seen.shape[-1]
-    row = jnp.zeros((v,), bool)
+    v = counts.shape[-1]
+    row = jnp.zeros((v,), counts.dtype)
     idx = jnp.where(jnp.arange(padded.shape[0]) < tp, padded, v)
-    row = row.at[idx].set(True, mode="drop")
-    return seen.at[slot].set(row)
+    row = row.at[idx].add(1, mode="drop")
+    return counts.at[slot].set(row)
 
 
 # ---------------------------------------------------------------------------
@@ -619,14 +628,17 @@ class InferenceEngine:
         self.top_ps = [1.0] * max_batch
         self.top_ks = [0] * max_batch
         self.rep_pens = [1.0] * max_batch
+        self.pres_pens = [0.0] * max_batch
+        self.freq_pens = [0.0] * max_batch
         self.finish_reason = [None] * max_batch  # "stop" | "length" once done
         self.want_logprobs = [False] * max_batch
         # most recent token's (logprob, [(alt_id, alt_lp), ...]) per slot
         self._last_logprobs: dict = {}
-        # per-slot device state: PRNG keys + seen-token presence for the
-        # repetition penalty ([B, V] bool — ~1MB at a 128k vocab)
+        # per-slot device state: PRNG keys + seen-token counts for the
+        # repetition/presence/frequency penalties ([B, V] int32 —
+        # ~4MB at a 128k vocab)
         self._key_data = jnp.zeros((max_batch, 2), jnp.uint32)
-        self._seen = jnp.zeros((max_batch, config.vocab_size), bool)
+        self._seen = jnp.zeros((max_batch, config.vocab_size), jnp.int32)
 
         # pending chunked prefills: slot → {tokens, tp, next (chunk
         # cursor), gen}
@@ -783,6 +795,8 @@ class InferenceEngine:
             jnp.asarray([gen.top_k], jnp.int32),
             jnp.asarray([gen.repetition_penalty], jnp.float32),
             self._seen[slot:slot + 1],
+            jnp.asarray([gen.presence_penalty], jnp.float32),
+            jnp.asarray([gen.frequency_penalty], jnp.float32),
         )
         tok = int(toks[0])
         self._key_data = self._key_data.at[slot].set(kd[0])
@@ -813,6 +827,8 @@ class InferenceEngine:
         self.top_ps[slot] = gen.top_p
         self.top_ks[slot] = gen.top_k
         self.rep_pens[slot] = gen.repetition_penalty
+        self.pres_pens[slot] = gen.presence_penalty
+        self.freq_pens[slot] = gen.frequency_penalty
         self.finish_reason[slot] = None
         if tok == gen.eos_id or gen.max_new_tokens <= 1:
             # finished immediately; slot never enters the decode loop
@@ -862,6 +878,8 @@ class InferenceEngine:
         spec_ok = self.spec_draft > 0 and all(
             self.temps[i] <= 0.0
             and self.rep_pens[i] == 1.0
+            and self.pres_pens[i] == 0.0
+            and self.freq_pens[i] == 0.0
             and not self.want_logprobs[i]
             for i in live
         )
@@ -951,6 +969,8 @@ class InferenceEngine:
             jnp.asarray(self.top_ks, jnp.int32),
             jnp.asarray(self.rep_pens, jnp.float32),
             self._seen,
+            jnp.asarray(self.pres_pens, jnp.float32),
+            jnp.asarray(self.freq_pens, jnp.float32),
         )
         self._seen = self._mark_seen(
             self._seen, jnp.arange(self.max_batch), sampled_dev

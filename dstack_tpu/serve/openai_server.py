@@ -363,6 +363,8 @@ def _gen_params(payload: dict, tokenizer: Tokenizer) -> GenParams:
         top_p=float(payload.get("top_p") or 1.0),
         top_k=int(payload.get("top_k") or 0),
         repetition_penalty=float(payload.get("repetition_penalty") or 1.0),
+        presence_penalty=float(payload.get("presence_penalty") or 0.0),
+        frequency_penalty=float(payload.get("frequency_penalty") or 0.0),
         seed=int(seed) if seed is not None else None,
         eos_id=tokenizer.eos_id,
         stop=stop or None,

@@ -82,7 +82,10 @@ class TestDecode:
             _reference_greedy(self.params, self.config, p_long, 3)
 
 
-def _sample(logits, seeds, temps, top_ps, top_ks=None, rep_pens=None, seen=None):
+def _sample(
+    logits, seeds, temps, top_ps, top_ks=None, rep_pens=None, seen=None,
+    pres=None, freq=None,
+):
     """Thin wrapper: per-row seeds → key_data; defaults for new knobs."""
     b, v = logits.shape
     kd = jnp.stack(
@@ -92,7 +95,9 @@ def _sample(logits, seeds, temps, top_ps, top_ks=None, rep_pens=None, seen=None)
         logits, kd, jnp.asarray(temps), jnp.asarray(top_ps),
         jnp.asarray(top_ks if top_ks is not None else [0] * b, jnp.int32),
         jnp.asarray(rep_pens if rep_pens is not None else [1.0] * b, jnp.float32),
-        seen if seen is not None else jnp.zeros((b, v), bool),
+        seen if seen is not None else jnp.zeros((b, v), jnp.int32),
+        jnp.asarray(pres if pres is not None else [0.0] * b, jnp.float32),
+        jnp.asarray(freq if freq is not None else [0.0] * b, jnp.float32),
     )
     return toks
 
@@ -124,11 +129,29 @@ class TestSampling:
             out = _sample(logits, [i], [5.0], [1.0], top_ks=[2])
             assert int(out[0]) in (1, 2)  # only the top-2 logits
 
+    def test_presence_penalty_flips_argmax(self):
+        logits = jnp.asarray([[0.0, 2.0, 1.9]], jnp.float32)
+        counts = jnp.zeros((1, 3), jnp.int32).at[0, 1].set(1)
+        out = _sample(logits, [0], [0.0], [1.0], seen=counts, pres=[0.5])
+        assert int(out[0]) == 2  # 2.0 - 0.5 < 1.9
+        out = _sample(logits, [0], [0.0], [1.0], seen=counts, pres=[0.05])
+        assert int(out[0]) == 1  # small penalty: argmax unchanged
+
+    def test_frequency_penalty_scales_with_count(self):
+        logits = jnp.asarray([[0.0, 2.0, 1.9]], jnp.float32)
+        once = jnp.zeros((1, 3), jnp.int32).at[0, 1].set(1)
+        thrice = jnp.zeros((1, 3), jnp.int32).at[0, 1].set(3)
+        # 0.05/occurrence: 1 hit keeps argmax, 3 hits flip it
+        out = _sample(logits, [0], [0.0], [1.0], seen=once, freq=[0.05])
+        assert int(out[0]) == 1
+        out = _sample(logits, [0], [0.0], [1.0], seen=thrice, freq=[0.05])
+        assert int(out[0]) == 2
+
     def test_repetition_penalty_flips_argmax(self):
         # token 1 leads, but was seen; a strong penalty hands the
         # argmax to unseen token 2
         logits = jnp.asarray([[0.0, 2.0, 1.9]], jnp.float32)
-        seen = jnp.zeros((1, 3), bool).at[0, 1].set(True)
+        seen = jnp.zeros((1, 3), jnp.int32).at[0, 1].set(1)
         out = _sample(
             logits, [0], [0.0], [1.0], rep_pens=[2.0], seen=seen
         )
