@@ -464,12 +464,18 @@ def sample(
     counts: jax.Array,  # [B, V] int32: occurrences in prompt + generated
     pres_pen: jax.Array,  # [B] f32 additive presence penalty
     freq_pen: jax.Array,  # [B] f32 additive frequency penalty
+    gen_counts: jax.Array,  # [B, V] int32: occurrences in GENERATED text
 ) -> tuple[jax.Array, jax.Array]:
     """→ (tokens [B], advanced key_data). Greedy when temperature == 0,
     else penalized temperature/top-k/top-p sampling — all branches
     computed, selected per slot (static shapes). Per-slot keys make a
     request's stream deterministic under its ``seed`` regardless of
-    which other slots are active."""
+    which other slots are active.
+
+    Penalty scopes follow their ecosystems: the HF-style multiplicative
+    repetition penalty sees prompt + generated tokens, while OpenAI's
+    additive presence/frequency penalties count only SAMPLED tokens
+    (a long prompt must not pre-ban its own vocabulary)."""
     v = logits.shape[-1]
     seen = counts > 0
     # HF repetition penalty: previously-seen tokens get logit/p when
@@ -477,10 +483,9 @@ def sample(
     pen = rep_pen[:, None]
     penalized = jnp.where(logits > 0, logits / pen, logits * pen)
     logits = jnp.where(seen & (pen != 1.0), penalized, logits)
-    # OpenAI additive penalties: presence once per seen token,
-    # frequency per occurrence
-    logits = logits - pres_pen[:, None] * seen.astype(jnp.float32)
-    logits = logits - freq_pen[:, None] * counts.astype(jnp.float32)
+    # OpenAI additive penalties over generated-only counts
+    logits = logits - pres_pen[:, None] * (gen_counts > 0).astype(jnp.float32)
+    logits = logits - freq_pen[:, None] * gen_counts.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
     # ONE [B, V] descending sort serves both filters — at a 128k vocab
@@ -536,21 +541,31 @@ def token_logprobs(
     return chosen, top_ids, top_lp
 
 
-def _mark_seen(counts: jax.Array, rows: jax.Array, tokens: jax.Array) -> jax.Array:
-    """counts[rows[i], tokens[i]] += 1 (donated in-place update)."""
-    return counts.at[rows, tokens].add(1)
+def _mark_seen(
+    counts: jax.Array, gen_counts: jax.Array, rows: jax.Array, tokens: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Count sampled tokens in both maps (donated in-place updates)."""
+    return counts.at[rows, tokens].add(1), gen_counts.at[rows, tokens].add(1)
 
 
 def _mark_prompt(
-    counts: jax.Array, slot: jax.Array, padded: jax.Array, tp: jax.Array
-) -> jax.Array:
-    """Reset slot's row, then count the prompt's first ``tp`` tokens
-    (padding indices are pushed out of range and dropped)."""
+    counts: jax.Array,
+    gen_counts: jax.Array,
+    slot: jax.Array,
+    padded: jax.Array,
+    tp: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Reset the slot's rows; count the prompt's first ``tp`` tokens in
+    the all-tokens map only (padding indices are dropped out of range).
+    Generated-only counts start at zero."""
     v = counts.shape[-1]
     row = jnp.zeros((v,), counts.dtype)
     idx = jnp.where(jnp.arange(padded.shape[0]) < tp, padded, v)
     row = row.at[idx].add(1, mode="drop")
-    return counts.at[slot].set(row)
+    return (
+        counts.at[slot].set(row),
+        gen_counts.at[slot].set(jnp.zeros((v,), gen_counts.dtype)),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -639,6 +654,7 @@ class InferenceEngine:
         # ~4MB at a 128k vocab)
         self._key_data = jnp.zeros((max_batch, 2), jnp.uint32)
         self._seen = jnp.zeros((max_batch, config.vocab_size), jnp.int32)
+        self._gen_counts = jnp.zeros((max_batch, config.vocab_size), jnp.int32)
 
         # pending chunked prefills: slot → {tokens, tp, next (chunk
         # cursor), gen}
@@ -673,8 +689,8 @@ class InferenceEngine:
         )
         self._sample = jax.jit(sample)
         self._logprobs = jax.jit(token_logprobs)
-        self._mark_seen = jax.jit(_mark_seen, donate_argnums=0)
-        self._mark_prompt = jax.jit(_mark_prompt, donate_argnums=0)
+        self._mark_seen = jax.jit(_mark_seen, donate_argnums=(0, 1))
+        self._mark_prompt = jax.jit(_mark_prompt, donate_argnums=(0, 1))
 
     def free_slots(self) -> list[int]:
         return [
@@ -783,9 +799,9 @@ class InferenceEngine:
         while pad < tp:
             pad *= 2
         marked = list(prompt) + [0] * (pad - tp)
-        self._seen = self._mark_prompt(
-            self._seen, jnp.asarray(slot), jnp.asarray(marked, jnp.int32),
-            jnp.asarray(tp, jnp.int32),
+        self._seen, self._gen_counts = self._mark_prompt(
+            self._seen, self._gen_counts, jnp.asarray(slot),
+            jnp.asarray(marked, jnp.int32), jnp.asarray(tp, jnp.int32),
         )
         toks, kd = self._sample(
             logits,
@@ -797,11 +813,12 @@ class InferenceEngine:
             self._seen[slot:slot + 1],
             jnp.asarray([gen.presence_penalty], jnp.float32),
             jnp.asarray([gen.frequency_penalty], jnp.float32),
+            self._gen_counts[slot:slot + 1],
         )
         tok = int(toks[0])
         self._key_data = self._key_data.at[slot].set(kd[0])
-        self._seen = self._mark_seen(
-            self._seen, jnp.asarray([slot]), jnp.asarray([tok])
+        self._seen, self._gen_counts = self._mark_seen(
+            self._seen, self._gen_counts, jnp.asarray([slot]), jnp.asarray([tok])
         )
         self.want_logprobs[slot] = gen.logprobs is not None
         if gen.logprobs is not None:
@@ -971,9 +988,10 @@ class InferenceEngine:
             self._seen,
             jnp.asarray(self.pres_pens, jnp.float32),
             jnp.asarray(self.freq_pens, jnp.float32),
+            self._gen_counts,
         )
-        self._seen = self._mark_seen(
-            self._seen, jnp.arange(self.max_batch), sampled_dev
+        self._seen, self._gen_counts = self._mark_seen(
+            self._seen, self._gen_counts, jnp.arange(self.max_batch), sampled_dev
         )
         if any(self.want_logprobs[i] for i in live):
             lp, tids, tlps = jax.device_get(

@@ -91,13 +91,16 @@ def _sample(
     kd = jnp.stack(
         [jax.random.key_data(jax.random.key(s)) for s in seeds]
     )
+    counts = seen if seen is not None else jnp.zeros((b, v), jnp.int32)
     toks, _ = sample(
         logits, kd, jnp.asarray(temps), jnp.asarray(top_ps),
         jnp.asarray(top_ks if top_ks is not None else [0] * b, jnp.int32),
         jnp.asarray(rep_pens if rep_pens is not None else [1.0] * b, jnp.float32),
-        seen if seen is not None else jnp.zeros((b, v), jnp.int32),
+        counts,
         jnp.asarray(pres if pres is not None else [0.0] * b, jnp.float32),
         jnp.asarray(freq if freq is not None else [0.0] * b, jnp.float32),
+        # unit tests treat the given counts as generated-only too
+        counts,
     )
     return toks
 
@@ -356,3 +359,26 @@ class TestSpeculativeDecoding:
         eng._ngram_ix[0] = {}
         eng._record_tokens(0, [9, 9, 1, 7])
         assert eng._find_draft(0) == []  # no earlier (1,7)
+
+
+class TestPenaltyScopes:
+    def test_prompt_tokens_do_not_feed_additive_penalties(self):
+        """OpenAI semantics: presence/frequency penalties count only
+        GENERATED tokens — a long prompt must not pre-ban its own
+        vocabulary on the first sampled token."""
+        config = llama.LLAMA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        prompt = [7, 8, 9] * 8
+        base = InferenceEngine(config, params, max_batch=1, max_seq=128)
+        pen = InferenceEngine(config, params, max_batch=1, max_seq=128)
+        a = base.generate(prompt, GenParams(max_new_tokens=1))
+        # huge penalties: if prompt tokens counted, the first token's
+        # distribution would shift; generated-only counts are empty at
+        # the first token, so greedy argmax must be identical
+        b = pen.generate(
+            prompt,
+            GenParams(
+                max_new_tokens=1, presence_penalty=2.0, frequency_penalty=2.0
+            ),
+        )
+        assert a == b
