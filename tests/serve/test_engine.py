@@ -382,3 +382,40 @@ class TestPenaltyScopes:
             ),
         )
         assert a == b
+
+
+class TestSpecWithFamilyDeltas:
+    def test_lossless_on_gemma2_style_config(self):
+        """verify_step must honor per-layer sliding windows, softcaps,
+        qk-norm-free sandwich norms etc. — speculation on a config with
+        all deltas enabled must equal the non-speculative stream."""
+        config = llama.dataclasses.replace(
+            llama.LLAMA_TINY,
+            norm_offset=True, embed_scale=True, post_norms=True,
+            hidden_act="gelu_tanh", sliding_window=16, sliding_pattern=2,
+            attn_softcap=30.0, logit_softcap=20.0,
+        )
+        params = llama.init_params(config, jax.random.key(3))
+        prompt = [4, 5, 6] * 8
+        on = InferenceEngine(
+            config, params, max_batch=1, max_seq=128, spec_draft=4
+        )
+        off = InferenceEngine(
+            config, params, max_batch=1, max_seq=128, spec_draft=0
+        )
+        a = on.generate(prompt, GenParams(max_new_tokens=10))
+        b = off.generate(prompt, GenParams(max_new_tokens=10))
+        assert a == b
+
+    def test_lossless_with_qk_norm(self):
+        config = llama.dataclasses.replace(llama.LLAMA_TINY, qk_norm=True)
+        params = llama.init_params(config, jax.random.key(4))
+        prompt = [9, 9, 2] * 6
+        on = InferenceEngine(
+            config, params, max_batch=1, max_seq=128, spec_draft=3
+        )
+        off = InferenceEngine(
+            config, params, max_batch=1, max_seq=128, spec_draft=0
+        )
+        assert on.generate(prompt, GenParams(max_new_tokens=8)) == \
+            off.generate(prompt, GenParams(max_new_tokens=8))
