@@ -554,6 +554,16 @@ def metrics(run_name, project) -> None:
 
 
 @cli.command()
+@click.argument("run_name")
+@click.option("--project", default=None)
+@click.pass_context
+def stats(ctx, run_name, project) -> None:
+    """Deprecated alias for `dtpu metrics` (reference `dstack stats`)."""
+    console.print("[yellow]`dtpu stats` is deprecated in favor of `dtpu metrics`[/yellow]")
+    ctx.invoke(metrics, run_name=run_name, project=project)
+
+
+@cli.command()
 @click.option("--tpu", "tpu_spec", default=None, help="e.g. v5e-8 or v5p")
 @click.option("--spot/--on-demand", default=None)
 def offer(tpu_spec, spot) -> None:
