@@ -1,0 +1,28 @@
+"""CLI surface regression net: the command set is part of reference
+parity (SURVEY §2.5) — a refactor that drops one should fail loudly."""
+
+from click.testing import CliRunner
+
+from dstack_tpu.cli.main import cli
+
+EXPECTED = {
+    "apply", "attach", "completion", "config", "delete", "fleet",
+    "gateway", "init", "logs", "metrics", "offer", "pool", "ps",
+    "secret", "server", "stats", "stop", "volume",
+}
+
+
+def test_command_surface_complete():
+    assert EXPECTED <= set(cli.commands)
+
+
+def test_help_runs_clean():
+    r = CliRunner().invoke(cli, ["--help"])
+    assert r.exit_code == 0
+    for cmd in sorted(EXPECTED):
+        assert cmd in r.output
+
+
+def test_version():
+    r = CliRunner().invoke(cli, ["--version"])
+    assert r.exit_code == 0 and "dtpu" in r.output
