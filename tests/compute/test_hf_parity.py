@@ -335,3 +335,40 @@ class TestExport:
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=0.05, atol=0.05
         )
+
+
+class TestConfigRoundTrip:
+    """config_to_hf ∘ config_from_hf preserves every family's
+    architecture flags — the export a fine-tune writes must reload as
+    the same model."""
+
+    @pytest.mark.parametrize("name", [
+        "llama-3.2-1b", "qwen-2.5-7b", "qwen-3-8b", "mistral-7b",
+        "gemma-2b", "gemma-2-2b", "mixtral-8x7b",
+    ])
+    def test_flags_survive(self, name):
+        from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
+
+        c = llama.CONFIGS[name]
+        c2 = config_from_hf(config_to_hf(c), dtype=c.dtype)
+        for field in (
+            "vocab_size", "hidden_size", "n_layers", "n_heads",
+            "n_kv_heads", "head_dim", "intermediate_size", "rope_theta",
+            "tie_embeddings", "qkv_bias", "qk_norm", "sliding_window",
+            "sliding_pattern", "hidden_act", "norm_offset", "embed_scale",
+            "post_norms", "attn_softcap", "logit_softcap", "n_experts",
+            "experts_per_token", "rope_scaling",
+        ):
+            assert getattr(c2, field) == getattr(c, field), (name, field)
+        if c.attn_scale is not None:
+            assert abs(c2.attn_scale - c.attn_scale) < 1e-9
+
+    def test_unknown_model_type_rejected(self):
+        from dstack_tpu.models.convert_hf import config_from_hf
+
+        with pytest.raises(ValueError, match="model_type"):
+            config_from_hf({
+                "model_type": "mamba", "hidden_size": 8,
+                "num_attention_heads": 2, "vocab_size": 16,
+                "num_hidden_layers": 1, "intermediate_size": 16,
+            })
