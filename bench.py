@@ -112,6 +112,36 @@ def _bench(quick: bool = False) -> dict:
     tokens_per_sec_per_chip = tokens_per_sec / n_chips
     fpt = flops_per_token(config, seq)
     mfu = tokens_per_sec_per_chip * fpt / peak_flops
+    loss = round(float(jax.device_get(m["loss"])), 4)
+    # serving measurement (decode tok/s + TTFT) rides along in extra —
+    # the driver records ONE line, so both numbers live on it. The
+    # training state (params + Adam moments, ~15GB f32 for the 1B
+    # model) must be freed first or the serving engine's second param
+    # copy + KV cache OOMs a 16GB v5e chip.
+    del state, m, data, step_fn, opt
+    jax.clear_caches()
+    try:
+        from dstack_tpu.serve.bench import run_bench as serve_bench
+
+        if on_tpu:
+            serve_model = "llama-3.2-1b"
+            serve = serve_bench(
+                model=serve_model, batch=8, max_seq=1024,
+                prompt_len=256, gen_len=16 if quick else 64,
+            )
+        else:
+            serve_model = "llama-tiny"
+            serve = serve_bench(
+                model=serve_model, batch=2, max_seq=256,
+                prompt_len=64, gen_len=8,
+            )
+        serve_extra = {
+            "decode_tokens_per_sec": serve["value"],
+            "ttft_ms_p50": serve["extra"]["ttft_ms_p50"],
+            "model": serve_model,
+        }
+    except Exception as e:  # serving must not sink the training number
+        serve_extra = {"error": f"{type(e).__name__}: {e}"}
     return {
         "metric": f"train_tokens_per_sec_per_chip[{_config_name(config)},bf16,{backend}]",
         "value": round(tokens_per_sec_per_chip, 1),
@@ -122,8 +152,9 @@ def _bench(quick: bool = False) -> dict:
             "step_time_s": round(dt, 4),
             "batch": batch,
             "seq": seq,
-            "loss": round(float(jax.device_get(m["loss"])), 4),
+            "loss": loss,
             "params_b": round(config.num_params() / 1e9, 3),
+            "serve": serve_extra,
         },
     }
 

@@ -14,6 +14,105 @@ import statistics
 import time
 
 
+def run_bench(
+    model: str = "llama-tiny",
+    batch: int = 4,
+    max_seq: int = 1024,
+    prompt_len: int = 256,
+    gen_len: int = 64,
+    spec_draft: int = 0,
+    repetitive: bool = False,
+    quantize=None,
+) -> dict:
+    """Measure the engine directly → result dict (importable core;
+    the root ``bench.py`` embeds this next to the training number)."""
+    import jax
+    import numpy as np
+
+    from dstack_tpu.models import llama
+    from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+    config = llama.CONFIGS[model]
+    params = llama.init_params(config, jax.random.key(0))
+    if quantize == "int8":
+        from dstack_tpu.models.quant import quantize_tree
+
+        params = quantize_tree(params, config)
+    eng = InferenceEngine(
+        config, params, max_batch=batch, max_seq=max_seq,
+        spec_draft=spec_draft,
+    )
+    rng = np.random.default_rng(0)
+    if repetitive:
+        phrase = rng.integers(1, config.vocab_size, 16).tolist()
+        reps = prompt_len // 16 + 1
+        prompts = [
+            (phrase * reps)[:prompt_len] for _ in range(batch)
+        ]
+    else:
+        prompts = [
+            rng.integers(1, config.vocab_size, prompt_len).tolist()
+            for _ in range(batch)
+        ]
+
+    # warmup compiles every kernel the timed sections will hit: the
+    # full-length prompt's prefill chunks, the plain decode step, and
+    # (with --spec-draft) the speculative verify step — otherwise
+    # multi-second XLA compiles land inside the TTFT/throughput numbers
+    spec = eng.spec_draft
+    eng.spec_draft = 0  # force the plain decode to compile
+    slot, _ = eng.add_request(list(prompts[0]), GenParams(max_new_tokens=3))
+    while eng.active[slot]:
+        eng.step()
+    eng.release(slot)
+    eng.spec_draft = spec
+    if spec:
+        phrase = prompts[0][:16]
+        warm = (phrase * (prompt_len // 16 + 1))[:prompt_len]
+        slot, _ = eng.add_request(warm, GenParams(max_new_tokens=6))
+        while eng.active[slot]:
+            eng.step()  # repetition drafts → verify kernel compiles
+        eng.release(slot)
+
+    # TTFT: admission → first sampled token, per request (chunked prefill)
+    ttfts = []
+    slots = []
+    for prompt in prompts:
+        t0 = time.perf_counter()
+        slot, _ = eng.add_request(
+            prompt, GenParams(max_new_tokens=gen_len)
+        )
+        ttfts.append(time.perf_counter() - t0)
+        slots.append(slot)
+
+    # decode throughput across all concurrent slots
+    t0 = time.perf_counter()
+    tokens = 0
+    steps = 0
+    while any(eng.active[s] for s in slots):
+        out = eng.step()
+        steps += 1
+        tokens += sum(len(t) for t in out.values())
+    dt = time.perf_counter() - t0
+    for s in slots:
+        eng.release(s)
+
+    return {
+        "metric": f"serve_decode_tokens_per_sec[{model},batch={batch}]",
+        "value": round(tokens / dt, 1),
+        "unit": "tokens/s",
+        "extra": {
+            "ttft_ms_p50": round(statistics.median(ttfts) * 1e3, 1),
+            "decode_steps": steps,
+            "tokens": tokens,
+            "tokens_per_step": round(tokens / max(steps, 1), 2),
+            "spec_draft": spec_draft,
+            "quantize": quantize,
+            "backend": jax.default_backend(),
+        },
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="llama-tiny")
@@ -32,95 +131,21 @@ def main(argv=None) -> int:
     p.add_argument("--platform", default=None)
     args = p.parse_args(argv)
 
-    import jax
-
     if args.platform:
+        import jax
+
         jax.config.update("jax_platforms", args.platform)
 
-    import numpy as np
-
-    from dstack_tpu.models import llama
-    from dstack_tpu.serve.engine import GenParams, InferenceEngine
-
-    config = llama.CONFIGS[args.model]
-    params = llama.init_params(config, jax.random.key(0))
-    if args.quantize == "int8":
-        from dstack_tpu.models.quant import quantize_tree
-
-        params = quantize_tree(params, config)
-    eng = InferenceEngine(
-        config, params, max_batch=args.batch, max_seq=args.max_seq,
+    result = run_bench(
+        model=args.model,
+        batch=args.batch,
+        max_seq=args.max_seq,
+        prompt_len=args.prompt_len,
+        gen_len=args.gen_len,
         spec_draft=args.spec_draft,
+        repetitive=args.repetitive,
+        quantize=args.quantize,
     )
-    rng = np.random.default_rng(0)
-    if args.repetitive:
-        phrase = rng.integers(1, config.vocab_size, 16).tolist()
-        reps = args.prompt_len // 16 + 1
-        prompts = [
-            (phrase * reps)[: args.prompt_len] for _ in range(args.batch)
-        ]
-    else:
-        prompts = [
-            rng.integers(1, config.vocab_size, args.prompt_len).tolist()
-            for _ in range(args.batch)
-        ]
-
-    # warmup compiles every kernel the timed sections will hit: the
-    # full-length prompt's prefill chunks, the plain decode step, and
-    # (with --spec-draft) the speculative verify step — otherwise
-    # multi-second XLA compiles land inside the TTFT/throughput numbers
-    spec = eng.spec_draft
-    eng.spec_draft = 0  # force the plain decode to compile
-    slot, _ = eng.add_request(list(prompts[0]), GenParams(max_new_tokens=3))
-    while eng.active[slot]:
-        eng.step()
-    eng.release(slot)
-    eng.spec_draft = spec
-    if spec:
-        phrase = prompts[0][:16]
-        warm = (phrase * (args.prompt_len // 16 + 1))[: args.prompt_len]
-        slot, _ = eng.add_request(warm, GenParams(max_new_tokens=6))
-        while eng.active[slot]:
-            eng.step()  # repetition drafts → verify kernel compiles
-        eng.release(slot)
-
-    # TTFT: admission → first sampled token, per request (chunked prefill)
-    ttfts = []
-    slots = []
-    for prompt in prompts:
-        t0 = time.perf_counter()
-        slot, _ = eng.add_request(
-            prompt, GenParams(max_new_tokens=args.gen_len)
-        )
-        ttfts.append(time.perf_counter() - t0)
-        slots.append(slot)
-
-    # decode throughput across all concurrent slots
-    t0 = time.perf_counter()
-    tokens = 0
-    steps = 0
-    while any(eng.active[s] for s in slots):
-        out = eng.step()
-        steps += 1
-        tokens += sum(len(t) for t in out.values())
-    dt = time.perf_counter() - t0
-    for s in slots:
-        eng.release(s)
-
-    result = {
-        "metric": f"serve_decode_tokens_per_sec[{args.model},batch={args.batch}]",
-        "value": round(tokens / dt, 1),
-        "unit": "tokens/s",
-        "extra": {
-            "ttft_ms_p50": round(statistics.median(ttfts) * 1e3, 1),
-            "decode_steps": steps,
-            "tokens": tokens,
-            "tokens_per_step": round(tokens / max(steps, 1), 2),
-            "spec_draft": args.spec_draft,
-            "quantize": args.quantize,
-            "backend": jax.default_backend(),
-        },
-    }
     print(json.dumps(result))
     return 0
 

@@ -99,13 +99,6 @@ def _apply_rope_batch(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
-def _expand_gqa(k: jax.Array, n_heads: int) -> jax.Array:
-    hkv = k.shape[1]
-    if hkv == n_heads:
-        return k
-    return jnp.repeat(k, n_heads // hkv, axis=1)
-
-
 def _mlp(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
     """Post-attention MLP sublayer (shared by prefill and decode)."""
     from dstack_tpu.models.llama import act_fn
@@ -327,11 +320,15 @@ def decode_step(
         ck = ck.at[batch_ix, :, write_pos].set(k[:, :, 0, :], mode="drop")
         cv = cv.at[batch_ix, :, write_pos].set(v[:, :, 0, :], mode="drop")
         # attend over the cache prefix (mask: j <= position, and within
-        # the layer's sliding window when set)
-        kk = _expand_gqa(ck, c.n_heads)
-        vv = _expand_gqa(cv, c.n_heads)
+        # the layer's sliding window when set). Grouped-query einsum:
+        # q regrouped [B, Hkv, G, D] against the [B, Hkv, T, D] cache —
+        # decode is HBM-bandwidth-bound on the KV read, so the cache is
+        # streamed ONCE at KV width instead of materializing a G×-wider
+        # repeat (4× read amplification for 32q/8kv models).
+        grp = c.n_heads // c.n_kv_heads
+        qg = q[:, :, 0, :].reshape(b, c.n_kv_heads, grp, c.head_dim)
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", q, kk, preferred_element_type=jnp.float32
+            "bhgd,bhkd->bhgk", qg, ck, preferred_element_type=jnp.float32
         ) * scale
         if c.attn_softcap:
             s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
@@ -343,8 +340,9 @@ def decode_step(
         )
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vv.dtype), vv)
-        o = o.transpose(0, 2, 1, 3).reshape(b, 1, c.q_dim)
+        o = jnp.einsum("bhgk,bhkd->bhgd", p.astype(cv.dtype), cv)
+        # [B, Hkv, G, D] row-major flatten == query-head order
+        o = o.reshape(b, 1, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
             ao = rms_norm(ao, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
@@ -423,23 +421,25 @@ def verify_step(
         cv = cv.at[batch_ix[:, None], :, write_pos].set(
             v.transpose(0, 2, 1, 3), mode="drop"
         )
-        kk = _expand_gqa(ck, c.n_heads)
-        vv = _expand_gqa(cv, c.n_heads)
+        # grouped-query attention against the KV-width cache (see
+        # decode_step): q [B, Hkv, G, S, D] · cache [B, Hkv, T, D]
+        grp = c.n_heads // c.n_kv_heads
+        qg = q.reshape(b, c.n_kv_heads, grp, sdraft, c.head_dim)
         s = jnp.einsum(
-            "bhsd,bhkd->bhsk", q, kk, preferred_element_type=jnp.float32
+            "bhgsd,bhkd->bhgsk", qg, ck, preferred_element_type=jnp.float32
         ) * scale
         if c.attn_softcap:
             s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
-        kj = jnp.arange(tmax)[None, None, None, :]  # [1,1,1,T]
-        qpos = pos_grid[:, None, :, None]  # [B,1,S,1]
+        kj = jnp.arange(tmax)[None, None, None, None, :]  # [1,1,1,1,T]
+        qpos = pos_grid[:, None, None, :, None]  # [B,1,1,S,1]
         mask = kj <= qpos
         mask = jnp.logical_and(
             mask, jnp.logical_or(window == 0, qpos - kj < window)
         )
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhsk,bhkd->bhsd", p.astype(vv.dtype), vv)
-        o = o.transpose(0, 2, 1, 3).reshape(b, sdraft, c.q_dim)
+        o = jnp.einsum("bhgsk,bhkd->bhgsd", p.astype(cv.dtype), cv)
+        o = o.transpose(0, 3, 1, 2, 4).reshape(b, sdraft, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
             ao = rms_norm(ao, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
