@@ -278,12 +278,74 @@ class Shim {
       runner_port = tasks_[id].runner_port;
     }
     set_status(id, TaskStatus::Preparing);
+    if (!prepare_volumes(id, req)) return;
     std::string image = req["image_name"].as_string();
     if (use_docker_ && !image.empty()) {
       start_docker(id, req, image, runner_port);
     } else {
       start_process(id, req, runner_port);
     }
+  }
+
+  // Host-side prep for attached volume disks (parity with the python
+  // shim's prepare_volumes): ensure mount dirs; when the disk device
+  // is visible, mount it, formatting a blank disk ext4 first. A
+  // visible device that fails to mount fails the task; an absent
+  // device is skipped (local/test hosts).
+  // server-supplied names/paths are interpolated into shell commands:
+  // allow only path-safe characters (config-level validation enforces
+  // GCP disk-name rules already; this is the host's own guard)
+  static bool path_safe(const std::string& s) {
+    if (s.empty()) return false;
+    for (char c : s)
+      if (!isalnum(static_cast<unsigned char>(c)) && c != '/' && c != '-' &&
+          c != '_' && c != '.')
+        return false;
+    return s.find("..") == std::string::npos;
+  }
+
+  bool prepare_volumes(const std::string& id, const Value& req) {
+    if (req["volumes"].is_null()) return true;
+    for (const auto& v : req["volumes"].as_array()) {
+      std::string dir = v["mount_dir"].as_string();
+      if (dir.empty() && !v["name"].as_string().empty())
+        dir = "/mnt/disks/" + v["name"].as_string();
+      if (dir.empty()) continue;
+      if (!path_safe(dir) || !path_safe("x" + v["volume_id"].as_string())) {
+        fail_task(id, "volume mount dir/id contains unsafe characters");
+        return false;
+      }
+      std::string mk = "mkdir -p '" + dir + "'";
+      if (std::system(mk.c_str()) != 0) {
+        fail_task(id, "volume mount dir " + dir + " creation failed");
+        return false;
+      }
+      std::string vid = v["volume_id"].as_string();
+      if (vid.empty()) continue;
+      std::string dev = "/dev/disk/by-id/google-" + vid;
+      if (::access(dev.c_str(), F_OK) != 0) continue;  // no device here
+      if (std::system(("mountpoint -q '" + dir + "'").c_str()) == 0) continue;
+      // distinguish "no filesystem" (blkid exit 2) from "blkid broken/
+      // missing" (127 etc.) — only a verified-blank disk may be
+      // formatted; the python shim fails safe the same way
+      int st = std::system(("blkid '" + dev + "' >/dev/null 2>&1").c_str());
+      int blkid_code = (st != -1 && WIFEXITED(st)) ? WEXITSTATUS(st) : -1;
+      if (blkid_code == 2) {
+        if (std::system(("mkfs.ext4 -q '" + dev + "'").c_str()) != 0) {
+          fail_task(id, "mkfs " + dev + " failed");
+          return false;
+        }
+      } else if (blkid_code != 0) {
+        fail_task(id, "blkid " + dev + " failed (exit " +
+                          std::to_string(blkid_code) + ")");
+        return false;
+      }
+      if (std::system(("mount '" + dev + "' '" + dir + "'").c_str()) != 0) {
+        fail_task(id, "mount " + dev + " at " + dir + " failed");
+        return false;
+      }
+    }
+    return true;
   }
 
   // process runtime: runner subprocess on the host (no container)

@@ -72,6 +72,61 @@ def host_info() -> schemas.HostInfo:
     )
 
 
+def prepare_volumes(volumes: list) -> None:
+    """Host-side prep for attached volume disks, before the container
+    (or process) starts: ensure each volume's mount dir exists and,
+    when the attached disk device is visible on this host, mount it —
+    formatting a blank disk ext4 first. A visible device that fails to
+    mount raises (the job's data would otherwise silently land on the
+    boot disk); an absent device is skipped (local/test hosts).
+
+    Reference behavior: the shim mounts attached disks before starting
+    the job container (runner/internal/shim volume handling).
+    """
+    import subprocess
+
+    for v in volumes or []:
+        d = v.get("mount_dir") or (
+            f"/mnt/disks/{v['name']}" if v.get("name") else None
+        )
+        if not d:
+            continue
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError as e:
+            raise RuntimeError(f"volume mount dir {d}: {e}")
+        vid = v.get("volume_id")
+        if not vid or os.path.ismount(d):
+            continue
+        dev = f"/dev/disk/by-id/google-{vid}"
+        if not os.path.exists(dev):
+            continue  # no such device on this host (local backend, tests)
+        # blkid: 0 = has a filesystem, 2 = verified blank; anything
+        # else is a probe failure — never format on a failed probe
+        blkid = subprocess.run(["blkid", dev], capture_output=True, timeout=30)
+        if blkid.returncode == 2:
+            fmt = subprocess.run(
+                ["mkfs.ext4", "-q", dev], capture_output=True, timeout=600
+            )
+            if fmt.returncode != 0:
+                raise RuntimeError(
+                    f"mkfs {dev}: {fmt.stderr.decode(errors='replace')[:200]}"
+                )
+        elif blkid.returncode != 0:
+            raise RuntimeError(
+                f"blkid {dev} failed (exit {blkid.returncode})"
+            )
+        mnt = subprocess.run(
+            ["mount", dev, d], capture_output=True, timeout=60
+        )
+        if mnt.returncode != 0:
+            raise RuntimeError(
+                f"mount {dev} at {d}: "
+                f"{mnt.stderr.decode(errors='replace')[:200]}"
+            )
+        logger.info("volume %s mounted at %s", v.get("name"), d)
+
+
 class Task:
     def __init__(self, req: schemas.TaskSubmitRequest):
         self.req = req
@@ -322,6 +377,7 @@ class Shim:
 
     async def _start(self, task: Task) -> None:
         try:
+            await asyncio.to_thread(prepare_volumes, task.req.volumes)
             await self.runtime.start(task)
         except Exception as e:
             logger.exception("task %s failed to start", task.req.id)

@@ -348,6 +348,21 @@ class VolumeConfiguration(CoreModel):
             raise ValueError("volume requires `size` or `volume_id`")
         return self
 
+    def validate_name(self) -> None:
+        """Name rules checked at CREATE time only (apply path) — not in
+        the model validator, which re-runs on every stored row load and
+        would brick pre-existing rows on a rules change."""
+        if self.name is not None and not re.fullmatch(
+            r"[a-z]([a-z0-9-]{0,58}[a-z0-9])?", self.name
+        ):
+            # lowercase-dns-ish: derived GCP disk names stay legal and
+            # the name is shell-/path-safe on the host
+            # (/mnt/disks/<name> in the shim)
+            raise ValueError(
+                "volume name must match [a-z]([a-z0-9-]*[a-z0-9])?, "
+                "max 60 chars"
+            )
+
 
 class GatewayConfiguration(CoreModel):
     type: Literal["gateway"] = "gateway"

@@ -16,6 +16,7 @@ from typing import Any, Optional, Union
 from dstack_tpu.core.models.backends import BackendType
 from dstack_tpu.core.models.common import CoreModel, RegistryAuth
 from dstack_tpu.core.models.configurations import (
+    AnyMountPoint,
     AnyRunConfiguration,
     DevEnvironmentConfiguration,
     PortMapping,
@@ -233,6 +234,9 @@ class JobSpec(CoreModel):
     ssh_key: Optional[JobSSHKey] = None
     single_branch: bool = False
     service_port: Optional[int] = None
+    # this job's volume mounts, name-templating already resolved per
+    # node (``${{ dtpu.node_rank }}`` etc. — configurators)
+    volumes: list[AnyMountPoint] = []
 
 
 class JobProvisioningData(CoreModel):

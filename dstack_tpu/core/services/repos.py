@@ -104,13 +104,20 @@ def _walk_files(repo_dir: Path) -> list[str]:
 
 def package_archive(repo_dir: Union[str, Path]) -> tuple[str, bytes]:
     """Deterministic tar.gz of the working directory → (sha256, bytes)."""
+    import gzip
+
     repo_dir = Path(repo_dir).resolve()
     rel_files = _tracked_files(repo_dir)
     if rel_files is None:
         rel_files = _walk_files(repo_dir)
     buf = io.BytesIO()
     total = 0
-    with tarfile.open(fileobj=buf, mode="w:gz", format=tarfile.PAX_FORMAT) as tf:
+    # explicit gzip wrapper with mtime=0: tarfile's "w:gz" stamps the
+    # CURRENT time into the gzip header (1s resolution), which would
+    # make the "deterministic" hash flip across second boundaries
+    with gzip.GzipFile(fileobj=buf, mode="wb", mtime=0) as gz, tarfile.open(
+        fileobj=gz, mode="w", format=tarfile.PAX_FORMAT
+    ) as tf:
         for rel in sorted(set(rel_files)):
             p = repo_dir / rel
             if not p.is_file() or p.is_symlink():

@@ -198,6 +198,47 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
             pass
     if job_spec.ssh_key is not None and job_spec.ssh_key.public:
         authorized_keys.append(job_spec.ssh_key.public.strip())
+    # container mounts: instance paths bind directly; named volumes bind
+    # their host mount dir (/mnt/disks/<name>), which the shim prepares
+    # (mounting the attached disk device when one is present)
+    from dstack_tpu.core.models.configurations import VolumeMountPoint
+
+    mounts: list[dict] = []
+    volumes_info: list[dict] = []
+    for m in job_spec.volumes:
+        if isinstance(m, VolumeMountPoint):
+            vrow = await db.fetchone(
+                "SELECT * FROM volumes WHERE project_id = ? AND name = ? "
+                "AND deleted = 0",
+                (job_row["project_id"], m.name),
+            )
+            vid = ""
+            if vrow is not None:
+                vid = (loads(vrow.get("provisioning_data")) or {}).get(
+                    "volume_id", ""
+                )
+            if not vid:
+                # the volume vanished (or never finished provisioning)
+                # between submit-time resolution and now: fail loudly —
+                # binding an empty host dir would silently land the
+                # job's data on the boot disk
+                await jobs_service.update_job_status(
+                    db,
+                    job_row["id"],
+                    JobStatus.TERMINATING,
+                    termination_reason=JobTerminationReason.CREATING_CONTAINER_ERROR,
+                    termination_reason_message=(
+                        f"volume {m.name} is gone or has no provisioned disk"
+                    ),
+                )
+                return
+            mount_dir = f"/mnt/disks/{m.name}"
+            mounts.append({"source": mount_dir, "target": m.path})
+            volumes_info.append(
+                {"name": m.name, "volume_id": vid, "mount_dir": mount_dir}
+            )
+        else:  # InstanceMountPoint
+            mounts.append({"source": m.instance_path, "target": m.path})
     async with shim_client_for(
         jpd, db=db, project_id=job_row["project_id"]
     ) as shim:
@@ -213,6 +254,8 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
             env={},
             network_mode="host",
             ssh_authorized_keys=authorized_keys,
+            mounts=mounts,
+            volumes=volumes_info,
         )
         info = await shim.submit_task(task_req)
     jrd = {

@@ -73,12 +73,25 @@ async def _process_job(db: Database, job_id: str) -> None:
 
     # Resolve the run's named volumes up front: both the reuse and the
     # provision path must co-locate with the disks' zone (reference
-    # offers volume co-location filter).
+    # offers volume co-location filter). Volume names are interpolated
+    # per node (``${{ dtpu.node_rank }}``) and the replica's UNION of
+    # names attaches to the slice instance hosting all its nodes.
     from dstack_tpu.server.services import volumes as volumes_service
+    from dstack_tpu.server.services.jobs.configurators import (
+        interpolate_job_volumes,
+    )
 
     try:
+        conf_volumes = getattr(run_spec.configuration, "volumes", None) or []
+        replica_mounts, seen_names = [], set()
+        for jn in range(max(job_spec.jobs_per_replica, 1)):
+            for m in interpolate_job_volumes(conf_volumes, jn):
+                name = getattr(m, "name", None)
+                if name and name not in seen_names:
+                    seen_names.add(name)
+                    replica_mounts.append(m)
         volume_rows = await volumes_service.resolve_run_volumes(
-            db, project_row, run_spec
+            db, project_row, replica_mounts
         )
     except volumes_service.VolumesNotReady:
         await db.update_by_id(

@@ -7,7 +7,8 @@ thread (sqlite connections are not thread-hoppable; a single worker
 thread serializes writes, matching sqlite's writer model), WAL mode for
 concurrent readers, an ordered in-code migration list, and dict rows.
 
-``DTPU_DATABASE_URL=postgres://…`` selects the asyncpg engine
+``DTPU_DATABASE_URL=postgres://…`` selects the Postgres engine
+(asyncpg when installed, else the in-repo pure-Python wire client)
 (:mod:`dstack_tpu.server.db_pg`) through :func:`create_database` — same
 interface, qmark SQL translated to ``$n``, row claims via Postgres
 advisory locks so multiple server replicas can share one database

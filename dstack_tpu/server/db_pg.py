@@ -15,9 +15,11 @@ interface; this engine translates that dialect to Postgres:
   in-memory lockset only serializes one process),
 - migrations run under one advisory lock (reference app.py:96-100).
 
-asyncpg is not bundled in every image; the engine raises a clear error
-at construction when it is missing. ``DTPU_DATABASE_URL=postgres://…``
-selects it via :func:`dstack_tpu.server.db.create_database`.
+asyncpg is preferred when installed; otherwise the bundled
+pure-Python wire client (:mod:`dstack_tpu.server.pg_wire`) serves the
+same API subset, so ``DTPU_DATABASE_URL=postgres://…`` works in the
+dependency-free TPU image too (selected via
+:func:`dstack_tpu.server.db.create_database`).
 """
 
 import contextvars
@@ -27,10 +29,10 @@ from typing import Any, Iterable, Optional, Sequence
 
 from dstack_tpu.utils.logging import get_logger
 
-try:  # gated: not bundled in the TPU image
+try:  # asyncpg when available (C-accelerated, binary protocol)
     import asyncpg  # type: ignore
-except ImportError:  # pragma: no cover - exercised via fake pool in tests
-    asyncpg = None
+except ImportError:  # TPU image: the in-repo v3-protocol client
+    from dstack_tpu.server import pg_wire as asyncpg  # type: ignore
 
 logger = get_logger("server.db_pg")
 
@@ -130,11 +132,6 @@ class PostgresDatabase:
 
     def __init__(self, url: str, pool_factory=None):
         # `pool_factory` lets tests substitute a fake asyncpg pool
-        if pool_factory is None and asyncpg is None:
-            raise RuntimeError(
-                "DTPU_DATABASE_URL is postgres:// but asyncpg is not "
-                "installed; install asyncpg or use sqlite://"
-            )
         self.url = url.replace("postgres://", "postgresql://", 1)
         self._pool_factory = pool_factory
         self._pool = None

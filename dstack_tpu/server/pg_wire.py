@@ -1,0 +1,522 @@
+"""Pure-Python PostgreSQL wire-protocol (v3) client.
+
+The Postgres engine (:mod:`dstack_tpu.server.db_pg`) targets the
+asyncpg API, but asyncpg is not bundled in the TPU image and the image
+has no package egress. This module implements the small asyncpg subset
+the engine uses — ``create_pool`` → pool → connections with
+``execute/executemany/fetch/fetchrow/fetchval/transaction`` — directly
+on the frontend/backend protocol
+(https://www.postgresql.org/docs/current/protocol.html), so a
+multi-replica control plane can point ``DTPU_DATABASE_URL`` at a real
+Postgres with zero dependencies. When asyncpg *is* installed it is
+preferred (db_pg tries it first); this is the fallback.
+
+Protocol surface implemented:
+
+- startup + authentication: trust, cleartext password, MD5, and
+  SCRAM-SHA-256 (RFC 7677, the modern default);
+- extended query protocol (Parse/Bind/Describe/Execute/Sync) with
+  text-format parameters and results — every ``$n`` query runs
+  unnamed-prepared, matching asyncpg's semantics for our usage;
+- simple query for statement batches without parameters;
+- text-format decoding for the result types the schema uses (bool,
+  ints, floats, numeric, text, bytea, timestamp(tz), null).
+
+Parity note: the reference reaches Postgres through SQLAlchemy +
+asyncpg (src/dstack/_internal/server/db.py); this is the TPU-image
+equivalent of that dependency, not a translation of it.
+"""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import os
+import struct
+from datetime import datetime, timezone
+from typing import Any, Optional, Sequence
+from urllib.parse import parse_qs, unquote, urlparse
+
+__all__ = ["connect", "create_pool", "PgError", "Connection", "Pool"]
+
+
+class PgError(Exception):
+    """Server-reported error (``ERROR``/``FATAL`` response)."""
+
+    def __init__(self, fields: dict):
+        self.fields = fields
+        code = fields.get("C", "")
+        msg = fields.get("M", "postgres error")
+        super().__init__(f"{code}: {msg}" if code else msg)
+
+    @property
+    def sqlstate(self) -> str:
+        return self.fields.get("C", "")
+
+
+# ---------------------------------------------------------------------------
+# DSN
+# ---------------------------------------------------------------------------
+
+
+def parse_dsn(dsn: str) -> dict:
+    """postgres[ql]://user[:password]@host[:port]/database → parts."""
+    u = urlparse(dsn)
+    if u.scheme not in ("postgres", "postgresql"):
+        raise ValueError(f"not a postgres DSN: {dsn!r}")
+    q = parse_qs(u.query)
+    return {
+        "user": unquote(u.username or os.environ.get("PGUSER", "postgres")),
+        "password": unquote(u.password or os.environ.get("PGPASSWORD", "")),
+        "host": u.hostname or "127.0.0.1",
+        "port": u.port or 5432,
+        "database": unquote((u.path or "/").lstrip("/"))
+        or os.environ.get("PGDATABASE", "postgres"),
+        # e.g. options=-csearch_path=myschema (schema-per-test isolation)
+        "options": q.get("options", [""])[0],
+    }
+
+
+# ---------------------------------------------------------------------------
+# text-format codecs (by type OID)
+# ---------------------------------------------------------------------------
+
+_BOOL = 16
+_BYTEA = 17
+_INT8, _INT2, _INT4 = 20, 21, 23
+_FLOAT4, _FLOAT8 = 700, 701
+_NUMERIC = 1700
+_TIMESTAMP, _TIMESTAMPTZ = 1114, 1184
+
+
+def _decode(oid: int, text: str) -> Any:
+    if oid == _BOOL:
+        return text == "t"
+    if oid in (_INT2, _INT4, _INT8):
+        return int(text)
+    if oid in (_FLOAT4, _FLOAT8, _NUMERIC):
+        return float(text)
+    if oid == _BYTEA:  # hex format: \xDEADBEEF
+        return bytes.fromhex(text[2:]) if text.startswith("\\x") else text.encode()
+    if oid in (_TIMESTAMP, _TIMESTAMPTZ):
+        return _parse_ts(text, tz=oid == _TIMESTAMPTZ)
+    return text
+
+
+def _parse_ts(text: str, tz: bool) -> datetime:
+    # 2026-07-30 12:34:56.789+00 / without fraction / without offset
+    base = text
+    offset = None
+    for i, c in enumerate(text):
+        if i >= 19 and c in "+-":
+            base, offset = text[:i], text[i:]
+            break
+    fmt = "%Y-%m-%d %H:%M:%S.%f" if "." in base else "%Y-%m-%d %H:%M:%S"
+    dt = datetime.strptime(base, fmt)
+    if offset is not None:
+        if ":" not in offset:
+            offset += ":00"
+        sign = 1 if offset[0] == "+" else -1
+        hh, mm = offset[1:].split(":")[:2]
+        from datetime import timedelta
+
+        dt = dt.replace(
+            tzinfo=timezone(sign * timedelta(hours=int(hh), minutes=int(mm)))
+        )
+    elif tz:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt
+
+
+def _encode(v: Any) -> Optional[bytes]:
+    """Python value → text-format parameter (None = SQL NULL)."""
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        return b"\\x" + bytes(v).hex().encode()
+    if isinstance(v, datetime):
+        return v.isoformat(sep=" ").encode()
+    return str(v).encode()
+
+
+class Record(dict):
+    """Row with dict access — the asyncpg-Record subset db_pg uses
+    (``r["col"]``, ``dict(r)``, iteration over column names)."""
+
+    __slots__ = ()
+
+
+# ---------------------------------------------------------------------------
+# SCRAM-SHA-256 (RFC 5802 / 7677)
+# ---------------------------------------------------------------------------
+
+
+class _Scram:
+    def __init__(self, user: str, password: str):
+        self.password = password
+        self.nonce = base64.b64encode(os.urandom(18)).decode()
+        # channel-binding not supported (no TLS here) → gs2 header "n,,"
+        self.client_first_bare = f"n=,r={self.nonce}"
+        self.server_first: dict = {}
+
+    def client_first(self) -> bytes:
+        return ("n,," + self.client_first_bare).encode()
+
+    def client_final(self, server_first: bytes) -> bytes:
+        attrs = dict(
+            kv.split("=", 1) for kv in server_first.decode().split(",")
+        )
+        self.server_first = attrs
+        r, s, i = attrs["r"], attrs["s"], int(attrs["i"])
+        if not r.startswith(self.nonce):
+            raise PgError({"M": "SCRAM: server nonce does not extend ours"})
+        salted = hashlib.pbkdf2_hmac(
+            "sha256", self.password.encode(), base64.b64decode(s), i
+        )
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored = hashlib.sha256(client_key).digest()
+        without_proof = f"c={base64.b64encode(b'n,,').decode()},r={r}"
+        auth_msg = ",".join(
+            [self.client_first_bare, server_first.decode(), without_proof]
+        ).encode()
+        sig = hmac.new(stored, auth_msg, hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        self._server_sig = hmac.new(server_key, auth_msg, hashlib.sha256).digest()
+        return (
+            without_proof + ",p=" + base64.b64encode(proof).decode()
+        ).encode()
+
+    def verify_server_final(self, server_final: bytes) -> None:
+        attrs = dict(
+            kv.split("=", 1) for kv in server_final.decode().split(",")
+        )
+        if base64.b64decode(attrs.get("v", "")) != self._server_sig:
+            raise PgError({"M": "SCRAM: bad server signature"})
+
+
+# ---------------------------------------------------------------------------
+# connection
+# ---------------------------------------------------------------------------
+
+
+class _Transaction:
+    """asyncpg-style transaction handle (BEGIN/COMMIT/ROLLBACK)."""
+
+    def __init__(self, conn: "Connection"):
+        self._conn = conn
+
+    async def start(self) -> None:
+        await self._conn.execute("BEGIN")
+
+    async def commit(self) -> None:
+        await self._conn.execute("COMMIT")
+
+    async def rollback(self) -> None:
+        await self._conn.execute("ROLLBACK")
+
+
+class Connection:
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._r = reader
+        self._w = writer
+        self._lock = asyncio.Lock()  # one in-flight query per connection
+        self.closed = False
+
+    # -- framing --
+
+    async def _read_msg(self) -> tuple[bytes, bytes]:
+        hdr = await self._r.readexactly(5)
+        t, ln = hdr[:1], struct.unpack("!I", hdr[1:])[0]
+        body = await self._r.readexactly(ln - 4) if ln > 4 else b""
+        return t, body
+
+    def _send(self, t: bytes, body: bytes = b"") -> None:
+        self._w.write(t + struct.pack("!I", len(body) + 4) + body)
+
+    @staticmethod
+    def _cstr(s: str) -> bytes:
+        return s.encode() + b"\x00"
+
+    # -- startup / auth --
+
+    async def _startup(
+        self, user: str, password: str, database: str, options: str = ""
+    ) -> None:
+        params = (
+            self._cstr("user") + self._cstr(user)
+            + self._cstr("database") + self._cstr(database)
+            + self._cstr("client_encoding") + self._cstr("UTF8")
+        )
+        if options:
+            params += self._cstr("options") + self._cstr(options)
+        params += b"\x00"
+        body = struct.pack("!I", 196608) + params  # protocol 3.0
+        self._w.write(struct.pack("!I", len(body) + 4) + body)
+        await self._w.drain()
+        scram: Optional[_Scram] = None
+        while True:
+            t, b = await self._read_msg()
+            if t == b"E":
+                raise PgError(_err_fields(b))
+            if t == b"R":
+                (code,) = struct.unpack("!I", b[:4])
+                if code == 0:  # AuthenticationOk
+                    continue
+                if code == 3:  # cleartext
+                    self._send(b"p", self._cstr(password))
+                elif code == 5:  # md5: md5(md5(pw+user)+salt)
+                    salt = b[4:8]
+                    inner = hashlib.md5(
+                        password.encode() + user.encode()
+                    ).hexdigest()
+                    digest = hashlib.md5(inner.encode() + salt).hexdigest()
+                    self._send(b"p", self._cstr("md5" + digest))
+                elif code == 10:  # SASL: mechanism list
+                    mechs = [m for m in b[4:].split(b"\x00") if m]
+                    if b"SCRAM-SHA-256" not in mechs:
+                        raise PgError(
+                            {"M": f"unsupported SASL mechanisms {mechs}"}
+                        )
+                    scram = _Scram(user, password)
+                    first = scram.client_first()
+                    self._send(
+                        b"p",
+                        self._cstr("SCRAM-SHA-256")
+                        + struct.pack("!I", len(first))
+                        + first,
+                    )
+                elif code == 11:  # SASL continue
+                    assert scram is not None
+                    self._send(b"p", scram.client_final(b[4:]))
+                elif code == 12:  # SASL final
+                    assert scram is not None
+                    scram.verify_server_final(b[4:])
+                else:
+                    raise PgError({"M": f"unsupported auth method {code}"})
+                await self._w.drain()
+            elif t == b"Z":  # ReadyForQuery
+                return
+            # S (ParameterStatus), K (BackendKeyData), N (Notice): skip
+
+    # -- queries --
+
+    async def execute(self, sql: str, *args: Any) -> str:
+        """→ command tag (``"UPDATE 3"``); also used for BEGIN etc."""
+        rows, tag = await self._query(sql, args)
+        return tag
+
+    async def executemany(self, sql: str, seq: Sequence[Sequence[Any]]) -> None:
+        for args in seq:
+            await self._query(sql, tuple(args))
+
+    async def fetch(self, sql: str, *args: Any) -> list[Record]:
+        rows, _ = await self._query(sql, args)
+        return rows
+
+    async def fetchrow(self, sql: str, *args: Any) -> Optional[Record]:
+        rows, _ = await self._query(sql, args)
+        return rows[0] if rows else None
+
+    async def fetchval(self, sql: str, *args: Any) -> Any:
+        rows, _ = await self._query(sql, args)
+        if not rows:
+            return None
+        first = rows[0]
+        return next(iter(first.values()), None)
+
+    def transaction(self) -> _Transaction:
+        return _Transaction(self)
+
+    async def _query(
+        self, sql: str, args: Sequence[Any]
+    ) -> tuple[list[Record], str]:
+        async with self._lock:
+            if args:
+                return await self._extended(sql, args)
+            return await self._simple(sql)
+
+    async def _simple(self, sql: str) -> tuple[list[Record], str]:
+        self._send(b"Q", self._cstr(sql))
+        await self._w.drain()
+        return await self._collect()
+
+    async def _extended(
+        self, sql: str, args: Sequence[Any]
+    ) -> tuple[list[Record], str]:
+        # unnamed prepared statement: Parse, Bind (text params),
+        # Describe, Execute, Sync — one round trip
+        self._send(b"P", b"\x00" + self._cstr(sql) + struct.pack("!H", 0))
+        bind = b"\x00\x00" + struct.pack("!H", 0)  # portal, stmt, 0 fmt codes
+        bind += struct.pack("!H", len(args))
+        for a in args:
+            enc = _encode(a)
+            if enc is None:
+                bind += struct.pack("!i", -1)
+            else:
+                bind += struct.pack("!i", len(enc)) + enc
+        bind += struct.pack("!H", 0)  # all results text
+        self._send(b"B", bind)
+        self._send(b"D", b"P\x00")
+        self._send(b"E", b"\x00" + struct.pack("!i", 0))
+        self._send(b"S")
+        await self._w.drain()
+        return await self._collect()
+
+    async def _collect(self) -> tuple[list[Record], str]:
+        cols: list[tuple[str, int]] = []
+        rows: list[Record] = []
+        tag = ""
+        error: Optional[PgError] = None
+        while True:
+            t, b = await self._read_msg()
+            if t == b"T":  # RowDescription
+                (n,) = struct.unpack("!H", b[:2])
+                cols = []
+                off = 2
+                for _ in range(n):
+                    end = b.index(b"\x00", off)
+                    name = b[off:end].decode()
+                    off = end + 1
+                    (oid,) = struct.unpack("!I", b[off + 6 : off + 10])
+                    off += 18
+                    cols.append((name, oid))
+            elif t == b"D":  # DataRow
+                (n,) = struct.unpack("!H", b[:2])
+                off = 2
+                rec = Record()
+                for i in range(n):
+                    (ln,) = struct.unpack("!i", b[off : off + 4])
+                    off += 4
+                    name, oid = cols[i] if i < len(cols) else (str(i), 25)
+                    if ln == -1:
+                        rec[name] = None
+                    else:
+                        rec[name] = _decode(oid, b[off : off + ln].decode())
+                        off += ln
+                rows.append(rec)
+            elif t == b"C":  # CommandComplete
+                tag = b.rstrip(b"\x00").decode()
+            elif t == b"E":
+                error = PgError(_err_fields(b))
+            elif t == b"Z":  # ReadyForQuery — end of cycle
+                if error is not None:
+                    raise error
+                return rows, tag
+            # 1/2/3 (parse/bind/close complete), n (NoData), N (notice),
+            # s (portal suspended), I (empty query): skip
+
+    async def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self._send(b"X")
+            await self._w.drain()
+            self._w.close()
+            await self._w.wait_closed()
+        except (OSError, ConnectionError):
+            pass
+
+    def is_closed(self) -> bool:
+        return self.closed
+
+
+def _err_fields(body: bytes) -> dict:
+    fields = {}
+    for part in body.split(b"\x00"):
+        if part:
+            fields[chr(part[0])] = part[1:].decode(errors="replace")
+    return fields
+
+
+async def connect(dsn: str, timeout: float = 10.0) -> Connection:
+    p = parse_dsn(dsn)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(p["host"], p["port"]), timeout
+    )
+    conn = Connection(reader, writer)
+    try:
+        await asyncio.wait_for(
+            conn._startup(
+                p["user"], p["password"], p["database"], p["options"]
+            ),
+            timeout,
+        )
+    except BaseException:
+        writer.close()
+        raise
+    return conn
+
+
+# ---------------------------------------------------------------------------
+# pool
+# ---------------------------------------------------------------------------
+
+
+class Pool:
+    """Minimal asyncpg-style pool: lazy connections up to ``max_size``."""
+
+    def __init__(self, dsn: str, min_size: int = 1, max_size: int = 10):
+        self._dsn = dsn
+        self._max = max_size
+        self._free: list[Connection] = []
+        self._count = 0
+        self._cond = asyncio.Condition()
+        self._closed = False
+
+    async def _init(self, min_size: int) -> None:
+        for _ in range(max(min_size, 1)):
+            self._free.append(await connect(self._dsn))
+            self._count += 1
+
+    async def acquire(self) -> Connection:
+        async with self._cond:
+            while True:
+                if self._closed:
+                    raise RuntimeError("pool is closed")
+                while self._free:
+                    conn = self._free.pop()
+                    if not conn.is_closed():
+                        return conn
+                    self._count -= 1
+                if self._count < self._max:
+                    self._count += 1
+                    break
+                await self._cond.wait()
+        try:
+            return await connect(self._dsn)
+        except BaseException:
+            async with self._cond:
+                self._count -= 1
+                self._cond.notify()
+            raise
+
+    async def release(self, conn: Connection) -> None:
+        async with self._cond:
+            if self._closed or conn.is_closed():
+                self._count -= 1
+                if not conn.is_closed():
+                    await conn.close()
+            else:
+                self._free.append(conn)
+            self._cond.notify()
+
+    async def close(self) -> None:
+        async with self._cond:
+            self._closed = True
+            free, self._free = self._free, []
+            self._count -= len(free)
+            self._cond.notify_all()
+        for c in free:
+            await c.close()
+
+
+async def create_pool(
+    dsn: str, min_size: int = 1, max_size: int = 10
+) -> Pool:
+    pool = Pool(dsn, min_size=min_size, max_size=max_size)
+    await pool._init(min_size)
+    return pool

@@ -23,10 +23,43 @@ from dstack_tpu.core.models.runs import (
     Retry,
     RunSpec,
 )
+from dstack_tpu.core.models.configurations import (
+    AnyMountPoint,
+    VolumeMountPoint,
+)
 from dstack_tpu.server.services.offers import requirements_from_run_spec
 from dstack_tpu.utils.crypto import generate_rsa_key_pair_bytes
+from dstack_tpu.utils.interpolator import InterpolatorError, VariablesInterpolator
 
 DEFAULT_IMAGE = "python:3.12-slim"  # TPU jobs usually set their own image
+
+
+def interpolate_job_volumes(
+    mounts: list[AnyMountPoint], job_num: int
+) -> list[AnyMountPoint]:
+    """Resolve ``${{ dtpu.node_rank }}``-style templates in volume
+    names for one node's job, so a multi-node run can mount a distinct
+    volume per worker host (``name-${{ dtpu.node_rank }}:/data``).
+
+    Parity: reference jobs/configurators/base.py:258-294 (namespace
+    ``dstack`` with ``job_num`` and its alias ``node_rank``).
+    """
+    if not mounts:
+        return []
+    vi = VariablesInterpolator(
+        {"dtpu": {"job_num": str(job_num), "node_rank": str(job_num)}}
+    )
+    out: list[AnyMountPoint] = []
+    for m in mounts:
+        if not isinstance(m, VolumeMountPoint):
+            out.append(m.model_copy())
+            continue
+        try:
+            name = vi.interpolate_or_error(m.name)
+        except InterpolatorError as e:
+            raise ConfigurationError(str(e))
+        out.append(VolumeMountPoint(name=name, path=m.path))
+    return out
 
 
 def _base_spec(
@@ -75,6 +108,7 @@ def _base_spec(
         working_dir=conf.working_dir,
         ssh_key=ssh_key,
         service_port=service_port,
+        volumes=interpolate_job_volumes(conf.volumes, job_num),
     )
 
 

@@ -64,6 +64,10 @@ async def list_volumes(db: Database, project_row: dict) -> list[Volume]:
 async def apply_volume(
     db: Database, project_row: dict, user_row: dict, conf: VolumeConfiguration
 ) -> Volume:
+    try:
+        conf.validate_name()
+    except ValueError as e:
+        raise ClientError(str(e))
     name = conf.name or f"volume-{new_uuid()[:8]}"
     existing = await db.fetchone(
         "SELECT id FROM volumes WHERE project_id = ? AND name = ? AND deleted = 0",
@@ -91,13 +95,12 @@ class VolumesNotReady(Exception):
 
 
 async def resolve_run_volumes(
-    db: Database, project_row: dict, run_spec
+    db: Database, project_row: dict, mounts: list
 ) -> list[dict]:
-    """ACTIVE volume rows for the run's named volume mount points
-    (reference jobs service volume resolution). Raises
-    ResourceNotExistsError for unknown names, VolumesNotReady for
-    volumes still provisioning."""
-    mounts = getattr(run_spec.configuration, "volumes", None) or []
+    """ACTIVE volume rows for the given (already name-interpolated)
+    volume mount points (reference jobs service volume resolution).
+    Raises ResourceNotExistsError for unknown names, VolumesNotReady
+    for volumes still provisioning."""
     rows = []
     for m in mounts:
         name = getattr(m, "name", None)

@@ -36,6 +36,31 @@ from dstack_tpu.server.services import projects as projects_service
 from dstack_tpu.server.services import users as users_service
 
 
+_fake_pg_server = None
+_fake_pg_loop = None
+
+
+async def _shared_fake_pg():
+    """One wire-protocol fake Postgres per event loop (the test harness
+    gives every test a fresh loop, so in practice one per test; the
+    CREATE SCHEMA isolation flow below still runs, same as against a
+    real server)."""
+    global _fake_pg_server, _fake_pg_loop
+    import asyncio
+
+    loop = asyncio.get_running_loop()
+    if _fake_pg_loop is not loop:
+        from dstack_tpu.server.testing.pg_fake import FakePgServer
+
+        if _fake_pg_server is not None:
+            # the old server's loop is gone; release its listen socket
+            # and sqlite stores synchronously so fds don't accumulate
+            _fake_pg_server.stop_sync()
+        _fake_pg_server = await FakePgServer().start()
+        _fake_pg_loop = loop
+    return _fake_pg_server
+
+
 async def create_test_db() -> Database:
     """In-memory sqlite by default; ``DTPU_TEST_DB=postgres`` runs the
     same tests against a real Postgres at ``DTPU_TEST_PG_DSN`` (the
@@ -44,26 +69,46 @@ async def create_test_db() -> Database:
     suite re-runs unchanged)."""
     import os
 
-    if os.environ.get("DTPU_TEST_DB") == "postgres":
+    mode = os.environ.get("DTPU_TEST_DB")
+    if mode in ("postgres", "pgwire"):
         import uuid
 
         import pytest
 
         from dstack_tpu.server.db_pg import PostgresDatabase, asyncpg
 
-        dsn = os.environ.get("DTPU_TEST_PG_DSN")
-        if asyncpg is None or not dsn:
-            pytest.skip("postgres test engine needs asyncpg and DTPU_TEST_PG_DSN")
+        client = asyncpg
+        pool_factory = None
+        if mode == "pgwire":
+            # whole-suite runs through the wire stack without a real
+            # server: PostgresDatabase → pg_wire sockets → FakePgServer.
+            # The pg_wire client is forced explicitly — db_pg's
+            # `asyncpg` alias resolves to real asyncpg when installed,
+            # which uses Flush-based framing the fake doesn't serve.
+            from dstack_tpu.server import pg_wire as client  # noqa: F811
+
+            dsn = (await _shared_fake_pg()).dsn
+
+            async def pool_factory(url):  # noqa: F811
+                # url carries the schema's search_path options
+                return await client.create_pool(url, min_size=1, max_size=8)
+        else:
+            dsn = os.environ.get("DTPU_TEST_PG_DSN")
+        if not dsn:
+            pytest.skip("postgres test engine needs DTPU_TEST_PG_DSN")
         # fresh schema per test for isolation (schemas accumulate —
         # point DTPU_TEST_PG_DSN at a throwaway database)
         schema = f"t_{uuid.uuid4().hex[:12]}"
-        admin = await asyncpg.connect(dsn=dsn)
+        admin = await client.connect(dsn=dsn)
         try:
             await admin.execute(f'CREATE SCHEMA "{schema}"')
         finally:
             await admin.close()
         sep = "&" if "?" in dsn else "?"
-        db = PostgresDatabase(f"{dsn}{sep}options=-csearch_path%3D{schema}")
+        db = PostgresDatabase(
+            f"{dsn}{sep}options=-csearch_path%3D{schema}",
+            pool_factory=pool_factory,
+        )
         await db.connect()
         await db.migrate()
         return db

@@ -50,12 +50,3 @@ class VariablesInterpolator:
         if missing:
             raise InterpolatorError(f"unresolved variables: {missing}")
         return result
-
-
-def interpolate_job_volumes(text: str, env: dict[str, Any]) -> str:
-    """Resolve ``${{ env.X }}`` / ``${{ dtpu.node_rank }}`` in mount specs."""
-    ns = {
-        "env": {k: str(v) for k, v in env.items()},
-        "dtpu": {k: str(v) for k, v in env.items() if k.startswith(("node_", "run_"))},
-    }
-    return VariablesInterpolator(ns).interpolate_or_error(text)

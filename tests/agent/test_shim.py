@@ -106,3 +106,30 @@ class TestShimAPI:
             await client.post("/api/tasks/task-2/remove")
         finally:
             await client.close()
+
+
+class TestPrepareVolumes:
+    """Host-side volume prep (mount dir + best-effort device mount)."""
+
+    def test_creates_mount_dirs_and_skips_absent_devices(self, tmp_path):
+        from dstack_tpu.agent.python.shim import prepare_volumes
+
+        d = tmp_path / "disks" / "data-0"
+        prepare_volumes(
+            [{"name": "data-0", "volume_id": "disk-data-0", "mount_dir": str(d)}]
+        )
+        assert d.is_dir()  # created; /dev/disk/by-id/google-... absent -> no mount
+
+    def test_empty_and_none_are_noops(self):
+        from dstack_tpu.agent.python.shim import prepare_volumes
+
+        prepare_volumes([])
+        prepare_volumes(None)
+
+    def test_unwritable_mount_dir_raises(self):
+        import pytest
+
+        from dstack_tpu.agent.python.shim import prepare_volumes
+
+        with pytest.raises(RuntimeError, match="mount dir"):
+            prepare_volumes([{"name": "x", "mount_dir": "/proc/nope/xyz"}])

@@ -505,3 +505,107 @@ class TestInstances:
         inst = await db.get_by_id("instances", row["id"])
         assert inst["status"] == InstanceStatus.TERMINATED.value
         assert compute.terminated  # backend told to tear down
+
+
+class TestPerNodeVolumes:
+    """Volume name templating: ``name-${{ dtpu.node_rank }}`` mounts a
+    distinct volume per worker host (reference
+    jobs/configurators/base.py:258-294)."""
+
+    async def _active_volume(self, db, project_row, user_row, name):
+        from dstack_tpu.core.models.configurations import VolumeConfiguration
+        from dstack_tpu.server.background.tasks.process_volumes import (
+            process_volumes,
+        )
+        from dstack_tpu.server.services import volumes as volumes_service
+
+        await volumes_service.apply_volume(
+            db, project_row, user_row,
+            VolumeConfiguration(name=name, region="us-central1", size=100),
+        )
+        await process_volumes(db)
+
+    async def test_per_node_volume_name_templating(self):
+        from dstack_tpu.core.models.runs import JobSpec
+
+        offers = [
+            tpu_offer(version="v5e", chips=16, topology="4x4", hosts=2, price=19.2)
+        ]
+        db, user_row, project_row, compute = await _setup(offers=offers)
+        for name in ("data-0", "data-1"):
+            await self._active_volume(db, project_row, user_row, name)
+        conf = {
+            "type": "task",
+            "nodes": 2,
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5e", "chips": 16}},
+            "volumes": ["data-${{ dtpu.node_rank }}:/data"],
+        }
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "pernode")
+        )
+        for _ in range(3):
+            await process_submitted_jobs(db)
+        jobs = await db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY job_num", (run.id,)
+        )
+        assert len(jobs) == 2
+        # each node's JobSpec carries its own interpolated volume name
+        specs = [JobSpec.model_validate(loads(j["job_spec"])) for j in jobs]
+        assert [s.volumes[0].name for s in specs] == ["data-0", "data-1"]
+        assert all(s.volumes[0].path == "/data" for s in specs)
+        # the union of both nodes' disks lands on the slice instance
+        assert sorted(compute.created[0].volume_ids) == [
+            "disk-data-0", "disk-data-1",
+        ]
+        atts = await db.fetchall("SELECT * FROM volume_attachments")
+        assert len(atts) == 2
+
+    async def test_unknown_template_variable_rejected_at_submit(self):
+        from dstack_tpu.core.errors import ConfigurationError
+
+        db, user_row, project_row, _ = await _setup()
+        conf = {**TASK_V5E8, "volumes": ["data-${{ dtpu.bogus }}:/data"]}
+        with pytest.raises(ConfigurationError, match="bogus"):
+            await runs_service.submit_run(
+                db, project_row, user_row, make_run_spec(conf, "bad-template")
+            )
+
+    async def test_missing_per_node_volume_fails_run(self):
+        """Only data-0 exists; node 1's data-1 must fail resolution."""
+        offers = [
+            tpu_offer(version="v5e", chips=16, topology="4x4", hosts=2, price=19.2)
+        ]
+        db, user_row, project_row, compute = await _setup(offers=offers)
+        await self._active_volume(db, project_row, user_row, "data-0")
+        conf = {
+            "type": "task",
+            "nodes": 2,
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5e", "chips": 16}},
+            "volumes": ["data-${{ dtpu.node_rank }}:/data"],
+        }
+        await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(conf, "missing-vol")
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs WHERE job_num = 0")
+        assert job["status"] == JobStatus.TERMINATING.value
+        assert "data-1" in (job.get("termination_reason_message") or "")
+        assert len(compute.created) == 0
+
+    async def test_unsafe_volume_name_rejected_at_create(self):
+        """Names flow into host paths (/mnt/disks/<name>) and GCP disk
+        names — reject shell-unsafe names at CREATE, not on row load
+        (stored rows must never be invalidated retroactively)."""
+        from dstack_tpu.core.errors import ClientError
+        from dstack_tpu.core.models.configurations import VolumeConfiguration
+        from dstack_tpu.server.services import volumes as volumes_service
+
+        db, user_row, project_row, _ = await _setup()
+        for bad in ("x'; touch /pwned; '", "My_Volume", "-leading", "a" * 61):
+            with pytest.raises(ClientError):
+                await volumes_service.apply_volume(
+                    db, project_row, user_row,
+                    VolumeConfiguration(name=bad, region="us-central1", size=10),
+                )

@@ -197,7 +197,13 @@ class TestPackaging:
         (tmp_path / ".git").mkdir()
         (tmp_path / ".git" / "HEAD").write_text("ref")
 
+        import time
+
         h1, blob1 = package_archive(tmp_path)
+        # cross a wall-clock second boundary: the gzip header's mtime
+        # field has 1s resolution and must be pinned (it once wasn't —
+        # this test flaked whenever the two calls straddled a second)
+        time.sleep(1.0 - (time.time() % 1.0) + 0.05)
         h2, blob2 = package_archive(tmp_path)
         assert h1 == h2 and blob1 == blob2  # deterministic
 
