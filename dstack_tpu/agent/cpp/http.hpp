@@ -7,6 +7,7 @@
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <netdb.h>
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <unistd.h>
@@ -502,17 +503,27 @@ class Client {
  public:
   static Response request_tcp(const std::string& host, int port,
                               const std::string& method, const std::string& target,
-                              const std::string& body = "") {
-    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons(static_cast<uint16_t>(port));
-    inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
-    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
-      ::close(fd);
+                              const std::string& body = "",
+                              const std::string& extra_headers = "") {
+    // getaddrinfo: hostnames (metadata.google.internal) must resolve,
+    // not just IP literals
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), std::to_string(port).c_str(), &hints,
+                    &res) != 0 ||
+        res == nullptr) {
+      return Response{599, "text/plain", "resolve failed"};
+    }
+    int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0 || ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      if (fd >= 0) ::close(fd);
+      freeaddrinfo(res);
       return Response{599, "text/plain", "connect failed"};
     }
-    Response r = roundtrip(fd, host, method, target, body);
+    freeaddrinfo(res);
+    Response r = roundtrip(fd, host, method, target, body, extra_headers);
     ::close(fd);
     return r;
   }
@@ -536,14 +547,16 @@ class Client {
  private:
   static Response roundtrip(int fd, const std::string& host,
                             const std::string& method, const std::string& target,
-                            const std::string& body) {
+                            const std::string& body,
+                            const std::string& extra_headers = "") {
     std::ostringstream req;
     req << method << ' ' << target << " HTTP/1.1\r\n"
         << "Host: " << host << "\r\n"
         << "Content-Type: application/json\r\n"
         << "Content-Length: " << body.size() << "\r\n"
-        << "Connection: close\r\n\r\n"
-        << body;
+        << "Connection: close\r\n";
+    if (!extra_headers.empty()) req << extra_headers;  // "K: v\r\n"...
+    req << "\r\n" << body;
     if (!detail::write_all(fd, req.str())) {
       return Response{599, "text/plain", "write failed"};
     }

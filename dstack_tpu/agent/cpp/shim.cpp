@@ -222,6 +222,24 @@ class Shim {
     return t.info();
   }
 
+  void set_interruption(const std::string& notice) {
+    std::lock_guard<std::mutex> lk(mu_);
+    interruption_ = notice;
+  }
+
+  std::string interruption() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return interruption_;
+  }
+
+  std::vector<std::string> task_ids() {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::string> out;
+    for (const auto& [id, t] : tasks_)
+      if (t.status != TaskStatus::Terminated) out.push_back(id);
+    return out;
+  }
+
   bool remove(const std::string& id, std::string& error) {
     std::string container;
     {
@@ -252,6 +270,7 @@ class Shim {
   std::mutex mu_;
   std::map<std::string, Task> tasks_;
   int next_port_ = 11000;
+  std::string interruption_;  // metadata watcher notice (empty = none)
 
   void set_status(const std::string& id, TaskStatus to) {
     std::lock_guard<std::mutex> lk(mu_);
@@ -497,10 +516,13 @@ int main(int argc, char** argv) {
   auto shim = std::make_shared<Shim>(base_dir, runner_bin, use_docker);
 
   dtpu::http::Router router;
-  router.add("GET", "/api/healthcheck", [](const dtpu::http::Request&) {
+  router.add("GET", "/api/healthcheck", [shim](const dtpu::http::Request&) {
     Value v{Object{}};
     v.set("service", "tpu-shim");
     v.set("version", kVersion);
+    std::string notice = shim->interruption();
+    v.set("interruption_notice",
+          notice.empty() ? Value(nullptr) : Value(notice));
     return dtpu::http::Response{200, "application/json", v.dump()};
   });
   router.add("GET", "/api/host_info", [](const dtpu::http::Request&) {
@@ -576,6 +598,75 @@ int main(int argc, char** argv) {
   });
 
   signal(SIGPIPE, SIG_IGN);
+  // interruption watcher (parity with the python shim's
+  // watch_interruption): poll the metadata server for spot-preemption/
+  // terminate-maintenance notices; on one, record it (healthcheck) and
+  // gracefully stop tasks inside GCP's ~30s ACPI window
+  std::thread([shim] {
+    std::string base = "169.254.169.254";
+    int mport = 80;
+    if (const char* env = std::getenv("DTPU_METADATA_URL")) {
+      std::string u(env);  // http://host[:port]
+      auto pos = u.find("://");
+      if (pos != std::string::npos) u = u.substr(pos + 3);
+      auto colon = u.find(':');
+      if (colon != std::string::npos) {
+        base = u.substr(0, colon);
+        mport = atoi(u.c_str() + colon + 1);
+      } else {
+        base = u;
+      }
+    }
+    const std::string hdr = "Metadata-Flavor: Google\r\n";
+    const std::string pre = "/computeMetadata/v1/instance/preempted";
+    const std::string maint = "/computeMetadata/v1/instance/maintenance-event";
+    // initial probe with retries: a transient metadata 503/timeout at
+    // boot must not permanently disable interruption detection
+    bool reachable = false;
+    for (int i = 0; i < 5 && !reachable; i++) {
+      auto probe =
+          dtpu::http::Client::request_tcp(base, mport, "GET", pre, "", hdr);
+      if (probe.status == 200) reachable = true;
+      else if (probe.status == 404) return;  // no preempted key
+      else std::this_thread::sleep_for(std::chrono::seconds(2));
+    }
+    if (!reachable) return;  // not a cloud host
+    auto upper = [](std::string s) {
+      for (auto& c : s) c = toupper(static_cast<unsigned char>(c));
+      return s;
+    };
+    auto trim = [](std::string s) {
+      while (!s.empty() && isspace(static_cast<unsigned char>(s.back())))
+        s.pop_back();
+      return s;
+    };
+    while (true) {
+      std::string notice;
+      auto r = dtpu::http::Client::request_tcp(base, mport, "GET", pre, "", hdr);
+      if (r.status == 200 && upper(trim(r.body)) == "TRUE")
+        notice = "spot instance preempted";
+      if (notice.empty()) {
+        auto m = dtpu::http::Client::request_tcp(base, mport, "GET", maint, "", hdr);
+        if (m.status == 200 && upper(trim(m.body)).rfind("TERMINATE", 0) == 0)
+          notice = "host maintenance: " + trim(m.body);
+      }
+      if (!notice.empty()) {
+        fprintf(stderr, "tpu-shim: interruption notice: %s\n", notice.c_str());
+        shim->set_interruption(notice);
+        // stop concurrently: sequential 25s budgets would blow the
+        // ~30s ACPI window with 2+ tasks on the host
+        std::vector<std::thread> stops;
+        for (const auto& id : shim->task_ids())
+          stops.emplace_back([shim, id] {
+            bool found = false;
+            shim->terminate(id, 25, "interrupted_by_no_capacity", found);
+          });
+        for (auto& t : stops) t.join();
+        return;
+      }
+      std::this_thread::sleep_for(std::chrono::seconds(5));
+    }
+  }).detach();
   dtpu::http::Server server(std::move(router));
   int bound = server.listen_and_serve(port);
   if (bound < 0) {

@@ -352,6 +352,11 @@ class Shim:
         else:
             self.runtime = ProcessRuntime(base_dir)
         self._next_runner_port = 11000
+        # set by the interruption watcher on a spot-preemption /
+        # host-maintenance notice; surfaced via /api/healthcheck so the
+        # server classifies the loss as INTERRUPTED (retryable)
+        # immediately instead of inferring it from a dead agent later
+        self.interruption: Optional[str] = None
 
     def _alloc_port(self) -> int:
         # find a free localhost port for a process-mode runner
@@ -405,6 +410,95 @@ class Shim:
         del self.tasks[task_id]
 
 
+GCP_METADATA_URL = "http://metadata.google.internal"
+INTERRUPTION_POLL_INTERVAL = 5.0
+# graceful stop budget within GCP's ~30s ACPI window: trainers get
+# SIGTERM time to finish an async checkpoint save
+INTERRUPTION_STOP_TIMEOUT = 25
+
+
+async def watch_interruption(
+    shim: Shim,
+    base_url: Optional[str] = None,
+    interval: float = INTERRUPTION_POLL_INTERVAL,
+) -> None:
+    """Poll the cloud metadata server for spot-preemption/maintenance
+    notices; on one, record it on the shim and gracefully stop every
+    task with the ``interrupted_by_no_capacity`` reason.
+
+    On-host detection beats the server's dead-agent inference by up to
+    a healthcheck interval AND preserves the interruption-vs-crash
+    distinction the retry policy keys on (reference shim polls the
+    IMDS the same way). A host without a metadata server (local
+    backend, tests) disables the watcher on the first probe.
+    """
+    import aiohttp
+
+    base = base_url or os.environ.get("DTPU_METADATA_URL", GCP_METADATA_URL)
+    hdrs = {"Metadata-Flavor": "Google"}
+    timeout = aiohttp.ClientTimeout(total=3)
+    preempted_url = f"{base}/computeMetadata/v1/instance/preempted"
+    maint_url = f"{base}/computeMetadata/v1/instance/maintenance-event"
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        # initial probe: retry transient failures (GCP's metadata
+        # server documents occasional 503s at boot; one hiccup must
+        # not permanently disable interruption detection)
+        for attempt in range(5):
+            try:
+                async with session.get(preempted_url, headers=hdrs) as r:
+                    if r.status == 200:
+                        break
+                    if r.status == 404:
+                        return  # metadata service without preempted key
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                pass
+            if attempt == 4:
+                return  # no metadata service → not a cloud host
+            await asyncio.sleep(interval)
+        logger.info("interruption watcher active (metadata: %s)", base)
+        while shim.interruption is None:
+            notice = None
+            try:
+                async with session.get(preempted_url, headers=hdrs) as r:
+                    if r.status == 200 and (await r.text()).strip().upper() == "TRUE":
+                        notice = "spot instance preempted"
+                if notice is None:
+                    async with session.get(maint_url, headers=hdrs) as r:
+                        ev = (await r.text()).strip().upper() if r.status == 200 else ""
+                        if ev.startswith("TERMINATE"):
+                            notice = f"host maintenance: {ev}"
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                pass  # transient metadata hiccup; keep watching
+            if notice is not None:
+                logger.warning("interruption notice: %s", notice)
+                shim.interruption = notice
+
+                async def _stop(task_id: str) -> None:
+                    try:
+                        await shim.terminate(
+                            task_id,
+                            INTERRUPTION_STOP_TIMEOUT,
+                            reason="interrupted_by_no_capacity",
+                            message=notice,
+                        )
+                    except Exception as e:
+                        logger.warning(
+                            "terminate %s on interruption: %s", task_id, e
+                        )
+
+                # stop CONCURRENTLY: sequential 25s budgets would blow
+                # the ~30s ACPI window as soon as a host runs 2 tasks
+                await asyncio.gather(
+                    *(
+                        _stop(tid)
+                        for tid, t in list(shim.tasks.items())
+                        if t.status != TaskStatus.TERMINATED
+                    )
+                )
+                return
+            await asyncio.sleep(interval)
+
+
 def build_app(shim: Shim) -> web.Application:
     app = web.Application()
     app["shim"] = shim
@@ -412,7 +506,9 @@ def build_app(shim: Shim) -> web.Application:
     async def healthcheck(request):
         return web.json_response(
             schemas.HealthcheckResponse(
-                service="tpu-shim", version=__version__
+                service="tpu-shim",
+                version=__version__,
+                interruption_notice=shim.interruption,
             ).model_dump()
         )
 
@@ -508,6 +604,7 @@ async def serve(port: int, base_dir: Path, runtime: Optional[str] = None) -> web
     await runner.setup()
     site = web.TCPSite(runner, "0.0.0.0", port)
     await site.start()
+    asyncio.ensure_future(watch_interruption(shim))
     logger.info(
         "tpu-shim listening on :%d (runtime=%s)",
         port,

@@ -92,6 +92,9 @@ class TerminateRequest(CoreModel):
 class HealthcheckResponse(CoreModel):
     service: str  # "tpu-shim" | "tpu-runner"
     version: str
+    # set by the shim's metadata watcher when the host got a
+    # spot-preemption / terminate-maintenance notice
+    interruption_notice: Optional[str] = None
 
 
 class TPUDeviceInfo(CoreModel):

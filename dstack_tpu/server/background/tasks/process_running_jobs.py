@@ -81,9 +81,26 @@ async def _process(db: Database, job_id: str) -> None:
 
 
 async def _handle_unreachable(db: Database, job_row: dict, message: str) -> None:
-    """Agent unreachable: tolerate within the wait budget, then fail."""
+    """Agent unreachable: tolerate within the wait budget, then fail.
+
+    Before waiting anything out, ask the host's SHIM whether it saw an
+    interruption notice (spot preemption / terminate-maintenance — its
+    metadata watcher, agent/python/shim.py). A notice classifies the
+    loss as INTERRUPTED — the retryable event — immediately, instead
+    of burning the budget and reporting a generic unreachable."""
     from datetime import datetime, timezone
 
+    # probe only at the FIRST disconnect of a RUNNING job: that's the
+    # runner-phase loss where the shim may still be alive with a
+    # notice. Earlier phases talk to the shim itself (it being down is
+    # the error), and re-probing a dead host every poll would add a
+    # 5s timeout per cycle while the job claim is held.
+    if (
+        job_row["status"] == JobStatus.RUNNING.value
+        and job_row.get("disconnected_at") is None
+        and await _interruption_notice(db, job_row)
+    ):
+        return
     submitted = datetime.fromisoformat(job_row["submitted_at"])
     age = (now_utc() - submitted).total_seconds()
     status = JobStatus(job_row["status"])
@@ -118,6 +135,36 @@ async def _handle_unreachable(db: Database, job_row: dict, message: str) -> None
         await db.update_by_id(
             "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
         )
+
+
+async def _interruption_notice(db: Database, job_row: dict) -> bool:
+    """Probe the job host's shim for an interruption notice; when one
+    is up, mark the job INTERRUPTED (True = handled)."""
+    jpd_raw = loads(job_row.get("job_provisioning_data"))
+    if not jpd_raw:
+        return False
+    try:
+        jpd = JobProvisioningData.model_validate(jpd_raw)
+        async with shim_client_for(
+            jpd, db=db, project_id=job_row["project_id"]
+        ) as shim:
+            hc = await shim.healthcheck()
+    except Exception:
+        return False  # shim gone too: fall through to the wait budget
+    notice = getattr(hc, "interruption_notice", None)
+    if not notice:
+        return False
+    await jobs_service.update_job_status(
+        db,
+        job_row["id"],
+        JobStatus.TERMINATING,
+        termination_reason=JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
+        termination_reason_message=notice[:500],
+    )
+    logger.info(
+        "job %s interrupted on host notice: %s", job_row["id"], notice
+    )
+    return True
 
 
 MEGASCALE_PORT = 8080  # libtpu DCN coordinator default

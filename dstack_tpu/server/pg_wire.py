@@ -334,9 +334,20 @@ class Connection:
         self, sql: str, args: Sequence[Any]
     ) -> tuple[list[Record], str]:
         async with self._lock:
-            if args:
-                return await self._extended(sql, args)
-            return await self._simple(sql)
+            try:
+                if args:
+                    return await self._extended(sql, args)
+                return await self._simple(sql)
+            except (
+                ConnectionError,
+                asyncio.IncompleteReadError,
+                OSError,
+            ):
+                # the socket is gone (server restart, dropped TCP):
+                # mark closed so the pool discards instead of recycling
+                # a dead connection forever
+                self.closed = True
+                raise
 
     async def _simple(self, sql: str) -> tuple[list[Record], str]:
         self._send(b"Q", self._cstr(sql))

@@ -399,6 +399,11 @@ class FakePgServer:
             self._tag(w, upper)
             return False
 
+        if "dtpu_kill_connection" in stripped:
+            # test hook: drop this connection abruptly (simulates a
+            # server restart severing established sockets)
+            raise ConnectionResetError("killed by test hook")
+
         if upper.startswith("CREATE SCHEMA"):
             name = stripped.split()[-1].strip('"')
             self._stores.setdefault(name, _Store())

@@ -366,3 +366,67 @@ class TestCppShim:
         finally:
             proc.terminate()
             proc.wait(timeout=5)
+
+    async def test_interruption_watcher_sets_notice(
+        self, agent_binaries, tmp_path
+    ):
+        """The C++ shim's metadata watcher (DTPU_METADATA_URL) must
+        surface a preemption notice on /api/healthcheck — parity with
+        the python shim's watch_interruption."""
+        import os
+
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        state = {"preempted": "TRUE"}
+        md_app = web.Application()
+
+        async def preempted(request):
+            assert request.headers.get("Metadata-Flavor") == "Google"
+            return web.Response(text=state["preempted"])
+
+        md_app.router.add_get(
+            "/computeMetadata/v1/instance/preempted", preempted
+        )
+        md_app.router.add_get(
+            "/computeMetadata/v1/instance/maintenance-event",
+            lambda r: web.Response(text="NONE"),
+        )
+        md = TestServer(md_app)
+        await md.start_server()
+
+        runner_bin, shim_bin = agent_binaries
+        port = _free_port()
+        env = {
+            **os.environ,
+            "DTPU_METADATA_URL": f"http://127.0.0.1:{md.port}",
+        }
+        proc = subprocess.Popen(
+            [
+                str(shim_bin),
+                "--port", str(port),
+                "--base-dir", str(tmp_path),
+                "--runtime", "process",
+                "--runner-bin", str(runner_bin),
+            ],
+            stderr=subprocess.DEVNULL,
+            env=env,
+        )
+        try:
+            await _wait_port(port)
+            notice = None
+            async with aiohttp.ClientSession() as session:
+                for _ in range(100):
+                    async with session.get(
+                        f"http://127.0.0.1:{port}/api/healthcheck"
+                    ) as resp:
+                        body = await resp.json()
+                    notice = body.get("interruption_notice")
+                    if notice:
+                        break
+                    await asyncio.sleep(0.1)
+            assert notice == "spot instance preempted"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+            await md.close()

@@ -1,0 +1,222 @@
+"""Shim-side interruption detection: the metadata watcher sees a
+spot-preemption / terminate-maintenance notice, records it on the
+shim's healthcheck, gracefully stops tasks with the retryable
+``interrupted_by_no_capacity`` reason, and the server classifies the
+job INTERRUPTED — not FAILED/unreachable — as soon as it probes.
+
+Reference behavior anchor: the shim polls the cloud IMDS on-host so
+interruption is known before the control plane notices a dead agent.
+"""
+
+import asyncio
+from pathlib import Path
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from dstack_tpu.agent import schemas
+from dstack_tpu.agent.python.shim import (
+    ProcessRuntime,
+    Shim,
+    build_app,
+    watch_interruption,
+)
+
+
+class FakeMetadata:
+    """GCP metadata server double: flip ``preempted``/``maintenance``
+    at will."""
+
+    def __init__(self):
+        self.preempted = "FALSE"
+        self.maintenance = "NONE"
+        app = web.Application()
+        app.router.add_get(
+            "/computeMetadata/v1/instance/preempted", self._preempted
+        )
+        app.router.add_get(
+            "/computeMetadata/v1/instance/maintenance-event", self._maintenance
+        )
+        self.server = TestServer(app)
+
+    async def _preempted(self, request):
+        assert request.headers.get("Metadata-Flavor") == "Google"
+        return web.Response(text=self.preempted)
+
+    async def _maintenance(self, request):
+        return web.Response(text=self.maintenance)
+
+    @property
+    def url(self) -> str:
+        return str(self.server.make_url("")).rstrip("/")
+
+
+async def _start_shim(tmp_path) -> Shim:
+    return Shim(Path(tmp_path), runtime="process")
+
+
+class TestInterruptionWatcher:
+    async def test_no_metadata_server_disables_watcher(self, tmp_path):
+        shim = await _start_shim(tmp_path)
+        # nothing listens on this port: the first probe must bail out
+        await asyncio.wait_for(
+            watch_interruption(shim, base_url="http://127.0.0.1:1", interval=0.01),
+            timeout=10,
+        )
+        assert shim.interruption is None
+
+    async def test_preemption_terminates_tasks_with_interrupted_reason(
+        self, tmp_path
+    ):
+        md = FakeMetadata()
+        await md.server.start_server()
+        try:
+            shim = await _start_shim(tmp_path)
+            task = await shim.submit(
+                schemas.TaskSubmitRequest(
+                    id="t1", name="victim",
+                    commands=["sleep 600"],
+                )
+            )
+            for _ in range(100):
+                if task.status == schemas.TaskStatus.RUNNING:
+                    break
+                await asyncio.sleep(0.05)
+            watcher = asyncio.create_task(
+                watch_interruption(shim, base_url=md.url, interval=0.05)
+            )
+            await asyncio.sleep(0.2)
+            assert shim.interruption is None  # FALSE → keeps watching
+            md.preempted = "TRUE"
+            await asyncio.wait_for(watcher, timeout=10)
+            assert shim.interruption == "spot instance preempted"
+            info = shim.tasks["t1"].info()
+            assert info.status == schemas.TaskStatus.TERMINATED
+            assert info.termination_reason == "interrupted_by_no_capacity"
+        finally:
+            await md.server.close()
+
+    async def test_maintenance_terminate_sets_notice(self, tmp_path):
+        md = FakeMetadata()
+        md.maintenance = "TERMINATE_ON_HOST_MAINTENANCE"
+        await md.server.start_server()
+        try:
+            shim = await _start_shim(tmp_path)
+            await asyncio.wait_for(
+                watch_interruption(shim, base_url=md.url, interval=0.05),
+                timeout=10,
+            )
+            assert "maintenance" in shim.interruption
+        finally:
+            await md.server.close()
+
+    async def test_healthcheck_surfaces_notice(self, tmp_path):
+        from aiohttp.test_utils import TestClient
+
+        shim = await _start_shim(tmp_path)
+        shim.interruption = "spot instance preempted"
+        client = TestClient(TestServer(build_app(shim)))
+        await client.start_server()
+        try:
+            r = await client.get("/api/healthcheck")
+            body = await r.json()
+            assert body["interruption_notice"] == "spot instance preempted"
+        finally:
+            await client.close()
+
+
+class TestServerClassifiesInterruption:
+    async def test_unreachable_job_with_notice_becomes_interrupted(
+        self, tmp_path
+    ):
+        """RUNNING job whose runner died: with a shim interruption
+        notice up, the server must mark it INTERRUPTED immediately —
+        no 120s disconnect budget, no generic unreachable reason."""
+        from aiohttp.test_utils import TestClient
+
+        from dstack_tpu.core.models.runs import (
+            JobStatus,
+            JobTerminationReason,
+            new_uuid,
+            now_utc,
+        )
+        from dstack_tpu.server.background.tasks.process_running_jobs import (
+            _handle_unreachable,
+        )
+        from dstack_tpu.server.db import dumps
+        from dstack_tpu.server.testing.common import (
+            create_test_db,
+            create_test_project,
+            create_test_user,
+        )
+
+        shim = await _start_shim(tmp_path)
+        shim.interruption = "spot instance preempted"
+        client = TestClient(TestServer(build_app(shim)))
+        await client.start_server()
+        try:
+            port = client.server.port
+            db = await create_test_db()
+            _, user_row = await create_test_user(db)
+            project_row = await create_test_project(db, user_row)
+            run_id = new_uuid()
+            await db.insert(
+                "runs",
+                {
+                    "id": run_id,
+                    "project_id": project_row["id"],
+                    "run_name": "spot-run",
+                    "user_id": user_row["id"],
+                    "run_spec": dumps(
+                        {"run_name": "spot-run",
+                         "configuration": {"type": "task", "commands": ["x"]},
+                         "ssh_key_pub": ""}
+                    ),
+                    "status": "running",
+                    "submitted_at": now_utc().isoformat(),
+                    "last_processed_at": now_utc().isoformat(),
+                },
+            )
+            job_id = new_uuid()
+            jpd = {
+                "backend": "local",
+                "instance_type": {
+                    "name": "local",
+                    "resources": {"cpus": 1, "memory_mib": 1024},
+                },
+                "instance_id": "i-1",
+                "hostname": "127.0.0.1",
+                "worker_id": 0,
+                "hosts": [
+                    {"worker_id": 0, "internal_ip": "127.0.0.1",
+                     "shim_port": port}
+                ],
+            }
+            await db.insert(
+                "jobs",
+                {
+                    "id": job_id,
+                    "run_id": run_id,
+                    "run_name": "spot-run",
+                    "project_id": project_row["id"],
+                    "job_name": "spot-run-0-0",
+                    "status": JobStatus.RUNNING.value,
+                    "job_spec": dumps(
+                        {"job_name": "spot-run-0-0",
+                         "requirements": {"resources": {}}}
+                    ),
+                    "job_provisioning_data": dumps(jpd),
+                    "submitted_at": now_utc().isoformat(),
+                    "last_processed_at": now_utc().isoformat(),
+                },
+            )
+            await _handle_unreachable(db, await db.get_by_id("jobs", job_id), "runner gone")
+            job = await db.get_by_id("jobs", job_id)
+            assert job["status"] == JobStatus.TERMINATING.value
+            assert (
+                job["termination_reason"]
+                == JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY.value
+            )
+            assert "preempted" in (job["termination_reason_message"] or "")
+        finally:
+            await client.close()

@@ -218,3 +218,27 @@ class TestEngineOverTheWire:
                     raise RuntimeError("boom")
             assert await db.get_by_id("users", "u9") is None
             await db.close()
+
+
+class TestPoolResilience:
+    async def test_dead_connection_not_recycled(self):
+        """A connection whose socket died must be marked closed on the
+        query error so the pool discards it instead of recycling it
+        forever (a Postgres restart would otherwise poison the pool)."""
+        async with FakePgServer() as srv:
+            pool = await pg_wire.create_pool(srv.dsn, min_size=1, max_size=2)
+            conn = await pool.acquire()
+            with pytest.raises(
+                (ConnectionError, OSError, asyncio.IncompleteReadError)
+            ):
+                # the fake severs this connection mid-query (server
+                # restart simulation)
+                await conn.fetchval("SELECT dtpu_kill_connection()")
+            assert conn.is_closed()
+            await pool.release(conn)  # discarded, not recycled
+            assert conn not in pool._free
+            # the pool hands out a FRESH working connection afterwards
+            conn2 = await pool.acquire()
+            assert await conn2.fetchval("SELECT 3") == 3
+            await pool.release(conn2)
+            await pool.close()
