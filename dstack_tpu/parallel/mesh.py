@@ -60,6 +60,16 @@ class MeshConfig:
                     f"{n_devices} devices not divisible by fixed axes product {fixed}"
                 )
             sizes[wild[0]] = n_devices // fixed
+        if sizes["pp"] > 1 and sizes["sp"] > 1:
+            # checked AFTER wildcard resolution (a -1 axis could land on
+            # pp/sp): ring attention runs in its own sp shard_map, which
+            # cannot nest inside the pipeline's partial-manual pp
+            # shard_map — reject at CONFIG time, not when jit trips
+            raise ValueError(
+                "pp and sp cannot compose (pipeline's shard_map cannot "
+                "nest ring attention's); pick one, or use fsdp for the "
+                "memory axis alongside pp"
+            )
         if math.prod(sizes.values()) != n_devices:
             raise ValueError(
                 f"mesh {sizes} does not cover {n_devices} devices"

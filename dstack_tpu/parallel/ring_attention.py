@@ -16,9 +16,12 @@ Two per-step implementations:
   [Tq, Tk] score materialization, GQA KV rotates at KV-head width. The
   ring has its own custom VJP: the backward pass makes a second ring
   sweep in which dk/dv accumulators travel with their KV blocks a full
-  circle back to the owning device.
-- **xla** fallback (CPU tests, virtual meshes, non-tiling shapes):
-  einsum blockwise softmax.
+  circle back to the owning device. Causal sliding windows run a
+  Python-unrolled variant (static per-step offsets feed the kernel's
+  window mask; out-of-window steps are elided at trace time →
+  O(T·window)).
+- **xla** fallback (CPU tests, virtual meshes, non-tiling shapes,
+  non-causal windows): einsum blockwise softmax.
 
 Causality is handled per ring step: blocks from earlier shards attend
 fully, the diagonal step uses the causal kernel, later shards are
@@ -266,19 +269,148 @@ def _make_ring_pallas(
     return ring
 
 
+def _ring_live_steps(sp: int, t_local: int, window: int) -> int:
+    """Ring steps that can contain in-window pairs. Step r's nearest
+    (q, k) distance is ``r*t_local - (t_local - 1)``; once that reaches
+    the window, the step — and every later one — is all-masked and can
+    be skipped STATICALLY. This is what makes windowed sp attention
+    O(T·window) instead of O(T²/sp)."""
+    if not window:
+        return sp
+    return min(sp, max(1, -(-(window - 1) // t_local) + 1))
+
+
+def _make_ring_pallas_window(
+    sp: int,
+    axis_name: str,
+    scale: float,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+    window: int,
+    softcap: float,
+    t_local: int,
+):
+    """Causal sliding-window ring on the flash kernels.
+
+    The scan-based ring can't express windows (kernel offsets are
+    static parameters), but the RELATIVE offset between the local Q
+    shard and ring step ``r``'s KV block is ``r*t_local`` for every
+    device that keeps the step — static per step. So the ring unrolls
+    in Python: each step calls the kernel with its own static
+    ``q_offset``, devices that received a wrapped (future) block skip
+    via ``lax.cond``, and steps entirely beyond the window are elided
+    at trace time. The backward sweep fast-forwards the dk/dv
+    accumulators home with ONE shifted ppermute instead of rotating
+    through the skipped steps.
+    """
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    r_live = _ring_live_steps(sp, t_local, window)
+    kw = dict(
+        block_q=block_q, block_k=block_k, kv_offset=0,
+        interpret=interpret, window=window, softcap=softcap,
+    )
+
+    @jax.custom_vjp
+    def ring(q, k, v):
+        o, _ = _ring_fwd(q, k, v)
+        return o
+
+    def _ring_fwd(q, k, v):
+        idx = jax.lax.axis_index(axis_name)
+        b, h, tl, d = q.shape
+
+        def f_skip(q, kb, vb):
+            return (
+                jnp.zeros(q.shape, q.dtype),
+                jnp.full((b, h, tl, 1), NEG_INF, jnp.float32),
+            )
+
+        # r = 0: the diagonal block (causal + window inside the shard)
+        o, lse = _flash_fwd(q, k, v, True, scale, q_offset=0, **kw)
+        o = o.astype(jnp.float32)
+        kb, vb = k, v
+        for r in range(1, r_live):
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+
+            def f_run(q, kb, vb, _r=r):
+                # past block at static distance _r*t_local: causality
+                # holds for every pair, the window masks the far end
+                return _flash_fwd(
+                    q, kb, vb, False, scale, q_offset=_r * t_local, **kw
+                )
+
+            ob, lseb = jax.lax.cond(idx >= r, f_run, f_skip, q, kb, vb)
+            o, lse = _merge_lse(o, lse, ob, lseb)
+        return o.astype(q.dtype), lse
+
+    def ring_fwd(q, k, v):
+        o, lse = _ring_fwd(q, k, v)
+        return o, (q, k, v, o, lse)
+
+    def ring_bwd(res, do):
+        q, k, v, o, lse = res
+        idx = jax.lax.axis_index(axis_name)
+
+        def b_skip(q, kb, vb):
+            return (
+                jnp.zeros(q.shape, q.dtype),
+                jnp.zeros(kb.shape, kb.dtype),
+                jnp.zeros(vb.shape, vb.dtype),
+            )
+
+        dq_p, dk_p, dv_p = _flash_bwd(
+            q, k, v, o, lse, do, True, scale, q_offset=0, **kw
+        )
+        dq = dq_p.astype(jnp.float32)
+        dkb = dk_p.astype(jnp.float32)
+        dvb = dv_p.astype(jnp.float32)
+        kb, vb = k, v
+        for r in range(1, r_live):
+            kb, vb, dkb, dvb = (
+                jax.lax.ppermute(x, axis_name, perm)
+                for x in (kb, vb, dkb, dvb)
+            )
+
+            def b_run(q, kb, vb, _r=r):
+                return _flash_bwd(
+                    q, kb, vb, o, lse, do, False, scale,
+                    q_offset=_r * t_local, **kw
+                )
+
+            dq_p, dk_p, dv_p = jax.lax.cond(idx >= r, b_run, b_skip, q, kb, vb)
+            dq = dq + dq_p.astype(jnp.float32)
+            dkb = dkb + dk_p.astype(jnp.float32)
+            dvb = dvb + dv_p.astype(jnp.float32)
+        shift = sp - (r_live - 1)
+        if shift % sp:
+            # fast-forward the accumulators the rest of the way home in
+            # one hop (the elided steps would only have rotated them)
+            fperm = [(i, (i + shift) % sp) for i in range(sp)]
+            dkb = jax.lax.ppermute(dkb, axis_name, fperm)
+            dvb = jax.lax.ppermute(dvb, axis_name, fperm)
+        return dq.astype(q.dtype), dkb.astype(k.dtype), dvb.astype(v.dtype)
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring
+
+
 # ---------------------------------------------------------------------------
 # public entry
 # ---------------------------------------------------------------------------
 
 
 def _pallas_ok(
-    h: int, hkv: int, t_local: int, d: int, interpret: bool, window: int
+    h: int, hkv: int, t_local: int, d: int, interpret: bool, window: int,
+    causal: bool = True,
 ) -> bool:
     if not interpret and jax.default_backend() != "tpu":
         return False
-    if window:
-        # inter-shard window masking needs traced global offsets, which
-        # the static pallas kernel params can't express — XLA ring path
+    if window and not causal:
+        # non-causal windows need signed (wrapped) offsets per device;
+        # only the XLA ring expresses those. Causal windows run on the
+        # unrolled pallas ring (static per-step offsets).
         return False
     return d % 64 == 0 and t_local % 128 == 0 and h % hkv == 0
 
@@ -314,25 +446,32 @@ def ring_attention(
 
     scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
     t_local = q.shape[2] // sp
-    if impl == "pallas" and window:
+    if impl == "pallas" and window and not causal:
         raise ValueError(
-            "ring_attention: sliding window requires the xla path "
-            "(inter-shard offsets are traced)"
+            "ring_attention: non-causal sliding window requires the "
+            "xla path (wrapped offsets are signed per device)"
         )
     use_pallas = impl == "pallas" or (
         impl is None
         and _pallas_ok(
-            q.shape[1], k.shape[1], t_local, q.shape[3], interpret, window
+            q.shape[1], k.shape[1], t_local, q.shape[3], interpret, window,
+            causal,
         )
     )
 
     if use_pallas:
         # GQA KV stays at KV-head width: the flash kernels group
         # natively, and the ring rotates the smaller buffers.
-        local_fn = _make_ring_pallas(
-            sp, axis_name, causal, scale, block_q, block_k, interpret,
-            softcap=softcap,
-        )
+        if window:
+            local_fn = _make_ring_pallas_window(
+                sp, axis_name, scale, block_q, block_k, interpret,
+                window, softcap, t_local,
+            )
+        else:
+            local_fn = _make_ring_pallas(
+                sp, axis_name, causal, scale, block_q, block_k, interpret,
+                softcap=softcap,
+            )
     else:
         if k.shape[1] != q.shape[1]:  # GQA: expand KV heads before the ring
             assert q.shape[1] % k.shape[1] == 0

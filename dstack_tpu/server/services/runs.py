@@ -10,6 +10,7 @@ from typing import Optional
 
 from dstack_tpu.core.errors import (
     ClientError,
+    ConfigurationError,
     ResourceExistsError,
     ResourceNotExistsError,
 )
@@ -100,6 +101,40 @@ async def get_plan(
         multinode=multinode,
     )
     job_specs = get_job_specs_from_run_spec(run_spec, replica_num=0)
+    # multislice uniformity is decidable at PLAN time: slice-major job
+    # decomposition needs every slice to have EXACTLY nodes/slices
+    # worker hosts, so offers with other host counts can never be
+    # scheduled — surface that at `dtpu apply`, not as a scheduler
+    # no-capacity failure an hour later
+    tpu_req = run_spec.configuration.resources.tpu
+    if (
+        isinstance(run_spec.configuration, TaskConfiguration)
+        and tpu_req is not None
+        and tpu_req.slices > 1
+    ):
+        hosts_needed = run_spec.configuration.nodes // tpu_req.slices
+        conforming = [
+            bo
+            for bo in offers
+            if bo[1].instance.resources.tpu is not None
+            and bo[1].instance.resources.tpu.hosts == hosts_needed
+        ]
+        if offers and not conforming:
+            seen = sorted(
+                {
+                    bo[1].instance.resources.tpu.hosts
+                    for bo in offers
+                    if bo[1].instance.resources.tpu is not None
+                }
+            )
+            raise ConfigurationError(
+                f"tpu.slices={tpu_req.slices} with nodes="
+                f"{run_spec.configuration.nodes} needs slices of exactly "
+                f"{hosts_needed} worker host(s), but matching offers have "
+                f"{seen} hosts; adjust nodes (= slices x hosts per slice) "
+                "or the tpu size"
+            )
+        offers = conforming
     job_plans = [
         JobPlan(
             job_spec=spec,

@@ -118,3 +118,117 @@ class TestRingAttentionPallas:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
             )
+
+
+class TestMeshCompositionLimits:
+    def test_pp_sp_rejected_at_config_time(self):
+        import pytest
+
+        from dstack_tpu.parallel.mesh import MeshConfig
+
+        with pytest.raises(ValueError, match="pp and sp"):
+            MeshConfig(pp=2, sp=2, fsdp=1).resolved(8)
+
+    def test_pp_alone_and_sp_alone_fine(self):
+        from dstack_tpu.parallel.mesh import MeshConfig
+
+        assert MeshConfig(pp=2, fsdp=-1).resolved(8)["pp"] == 2
+        assert MeshConfig(sp=2, fsdp=-1).resolved(8)["sp"] == 2
+
+
+
+class TestRingAttentionPallasWindow:
+    """Causal sliding windows on the UNROLLED pallas ring: static
+    per-step offsets drive the flash kernel's window masking, and
+    steps beyond the window are elided at trace time (VERDICT r2 #8)."""
+
+    def _qkv(self, t=512, d=64, h=4, hkv=2, b=1, seed=9):
+        key = jax.random.key(seed)
+        k1, k2, k3 = jax.random.split(key, 3)
+        return (
+            jax.random.normal(k1, (b, h, t, d)),
+            jax.random.normal(k2, (b, hkv, t, d)),
+            jax.random.normal(k3, (b, hkv, t, d)),
+        )
+
+    @pytest.mark.parametrize("window", [32, 128, 200, 400])
+    def test_windowed_matches_dense(self, window):
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, sp=4, tp=1))
+        q, k, v = self._qkv()
+        ref = _xla_attention(q, k, v, causal=True, scale=64**-0.5, window=window)
+        out = ring_attention(
+            q, k, v, mesh=mesh, causal=True, window=window, impl="pallas",
+            block_q=128, block_k=128, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+        )
+
+    def test_windowed_grads_match(self):
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, sp=4, tp=1))
+        q, k, v = self._qkv()
+
+        def loss_ring(q, k, v):
+            return jnp.sum(
+                ring_attention(
+                    q, k, v, mesh=mesh, causal=True, window=150,
+                    impl="pallas", block_q=128, block_k=128, interpret=True,
+                ) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                _xla_attention(
+                    q, k, v, causal=True, scale=64**-0.5, window=150
+                ) ** 2
+            )
+
+        g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+            )
+
+    def test_window_softcap_compose(self):
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, sp=4, tp=1))
+        q, k, v = self._qkv()
+        ref = _xla_attention(
+            q, k, v, causal=True, scale=64**-0.5, window=96, softcap=30.0
+        )
+        out = ring_attention(
+            q, k, v, mesh=mesh, causal=True, window=96, softcap=30.0,
+            impl="pallas", block_q=128, block_k=128, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4
+        )
+
+    def test_auto_dispatch_takes_pallas_for_causal_windows(self):
+        from dstack_tpu.parallel.ring_attention import _pallas_ok
+
+        # causal windows now qualify for the flash path...
+        assert _pallas_ok(4, 2, 128, 64, interpret=True, window=64, causal=True)
+        # ...non-causal windows still route to xla
+        assert not _pallas_ok(4, 2, 128, 64, interpret=True, window=64, causal=False)
+
+    def test_live_step_elision(self):
+        from dstack_tpu.parallel.ring_attention import _ring_live_steps
+
+        # window fits one shard -> only diag + 1 neighbor step survive
+        assert _ring_live_steps(sp=8, t_local=1024, window=512) == 2
+        # Mistral-style: 4096 window over 1024-token shards -> 5 of 8
+        assert _ring_live_steps(sp=8, t_local=1024, window=4096) == 5
+        # window covers everything -> all steps
+        assert _ring_live_steps(sp=4, t_local=128, window=100000) == 4
+        assert _ring_live_steps(sp=4, t_local=128, window=0) == 4
+
+    def test_pp_sp_via_wildcard_also_rejected(self):
+        import pytest
+
+        from dstack_tpu.parallel.mesh import MeshConfig
+
+        with pytest.raises(ValueError, match="pp and sp"):
+            MeshConfig(pp=-1, fsdp=1, sp=2).resolved(8)
+        with pytest.raises(ValueError, match="pp and sp"):
+            MeshConfig(pp=2, fsdp=1, sp=-1).resolved(8)

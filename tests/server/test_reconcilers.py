@@ -609,3 +609,61 @@ class TestPerNodeVolumes:
                     db, project_row, user_row,
                     VolumeConfiguration(name=bad, region="us-central1", size=10),
                 )
+
+
+class TestPlanTimeValidation:
+    """Composition limits must fail at `dtpu apply` (plan), not deep in
+    the scheduler."""
+
+    async def test_multislice_plan_rejects_nonuniform_offers(self):
+        from dstack_tpu.core.errors import ConfigurationError
+
+        offers = [
+            tpu_offer(version="v5e", chips=16, topology="4x4", hosts=2, price=19.2)
+        ]
+        db, user_row, project_row, _ = await _setup(offers=offers)
+        conf = {
+            "type": "task",
+            "nodes": 2,  # slices=2 -> 1 host per slice; offer has 2
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5e", "chips": 16, "slices": 2}},
+        }
+        with pytest.raises(ConfigurationError, match="exactly 1 worker"):
+            await runs_service.get_plan(
+                db, project_row, user_row, make_run_spec(conf, "bad-plan")
+            )
+
+    async def test_multislice_plan_filters_to_uniform_offers(self):
+        offers = [
+            tpu_offer(version="v5e", chips=16, topology="4x4", hosts=2, price=19.2),
+            tpu_offer(version="v5e", chips=16, topology="4x4", hosts=1, price=29.2),
+        ]
+        db, user_row, project_row, _ = await _setup(offers=offers)
+        conf = {
+            "type": "task",
+            "nodes": 2,
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5e", "chips": 16, "slices": 2}},
+        }
+        plan = await runs_service.get_plan(
+            db, project_row, user_row, make_run_spec(conf, "uniform-plan")
+        )
+        kept = plan.job_plans[0].offers
+        assert kept and all(
+            o.instance.resources.tpu.hosts == 1 for o in kept
+        )
+
+    async def test_nodes_not_multiple_of_slices_rejected_at_plan(self):
+        from dstack_tpu.core.errors import ConfigurationError
+
+        db, user_row, project_row, _ = await _setup()
+        conf = {
+            "type": "task",
+            "nodes": 3,
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5e", "chips": 16, "slices": 2}},
+        }
+        with pytest.raises(ConfigurationError, match="multiple"):
+            await runs_service.get_plan(
+                db, project_row, user_row, make_run_spec(conf, "bad-nodes")
+            )
