@@ -192,6 +192,54 @@ async def apply_run_plan(ctx: RequestContext, body: s.ApplyRunPlanRequest):
     )
 
 
+@project_router.post("/apply_yaml")
+async def apply_yaml(ctx: RequestContext, body: s.ApplyYamlRequest):
+    """Browser-side `dtpu apply -f`: parse a pasted YAML configuration
+    and dispatch by type — run configs submit a run, fleet/volume/
+    gateway configs create their resource. Returns {kind, name}."""
+    import yaml as _yaml
+
+    from dstack_tpu.core.errors import ClientError
+    from dstack_tpu.core.models.configurations import (
+        FleetConfiguration,
+        GatewayConfiguration,
+        VolumeConfiguration,
+        parse_apply_configuration,
+    )
+    from dstack_tpu.core.models.runs import RunSpec
+
+    try:
+        data = _yaml.safe_load(body.yaml)
+    except _yaml.YAMLError as e:
+        raise ClientError(f"invalid YAML: {e}")
+    try:
+        conf = parse_apply_configuration(data)
+    except Exception as e:
+        raise ClientError(f"invalid configuration: {e}")
+    db = ctx.state["db"]
+    if isinstance(conf, FleetConfiguration):
+        from dstack_tpu.server.services.fleets import apply_fleet as _apply_fleet
+
+        fleet = await _apply_fleet(db, ctx.project, ctx.user, conf)
+        return {"kind": "fleet", "name": fleet.name}
+    if isinstance(conf, VolumeConfiguration):
+        from dstack_tpu.server.services.volumes import apply_volume as _apply
+
+        vol = await _apply(db, ctx.project, ctx.user, conf)
+        return {"kind": "volume", "name": vol.name}
+    if isinstance(conf, GatewayConfiguration):
+        from dstack_tpu.server.services.gateways import create_gateway as _create
+
+        gw = await _create(db, ctx.project, conf)
+        return {"kind": "gateway", "name": gw.name}
+    run_spec = RunSpec(run_name=body.name or conf.name, configuration=conf)
+    # plan first: config-time validation (mesh/multislice limits) fails
+    # HERE with a clear message rather than as a dead run
+    await runs_service.get_plan(db, ctx.project, ctx.user, run_spec)
+    run = await runs_service.submit_run(db, ctx.project, ctx.user, run_spec)
+    return {"kind": "run", "name": run.run_spec.run_name}
+
+
 @project_router.post("/runs/list")
 async def list_runs(ctx: RequestContext):
     return await runs_service.list_runs(ctx.state["db"], ctx.project)

@@ -49,6 +49,13 @@ class DeleteBackendsRequest(CoreModel):
     types: list[BackendType]
 
 
+class ApplyYamlRequest(CoreModel):
+    """Raw YAML apply (the console's paste-a-config flow)."""
+
+    yaml: str
+    name: Optional[str] = None  # run name override
+
+
 class GetRunPlanRequest(CoreModel):
     run_spec: RunSpec
 

@@ -83,6 +83,45 @@ async def get_run_row(
     )
 
 
+def filter_multislice_offers(run_spec: RunSpec, offers: list) -> list:
+    """Multislice uniformity is decidable BEFORE scheduling: slice-major
+    job decomposition needs every slice to have EXACTLY nodes/slices
+    worker hosts, so offers with other host counts can never be
+    scheduled. Raises ConfigurationError when no offer conforms —
+    surfaced at `dtpu apply`/submit, not as a scheduler no-capacity
+    failure an hour later. Returns the conforming offers."""
+    conf = run_spec.configuration
+    tpu_req = conf.resources.tpu
+    if (
+        not isinstance(conf, TaskConfiguration)
+        or tpu_req is None
+        or tpu_req.slices <= 1
+    ):
+        return offers
+    hosts_needed = conf.nodes // tpu_req.slices
+    conforming = [
+        bo
+        for bo in offers
+        if bo[1].instance.resources.tpu is not None
+        and bo[1].instance.resources.tpu.hosts == hosts_needed
+    ]
+    if offers and not conforming:
+        seen = sorted(
+            {
+                bo[1].instance.resources.tpu.hosts
+                for bo in offers
+                if bo[1].instance.resources.tpu is not None
+            }
+        )
+        raise ConfigurationError(
+            f"tpu.slices={tpu_req.slices} with nodes={conf.nodes} needs "
+            f"slices of exactly {hosts_needed} worker host(s), but "
+            f"matching offers have {seen} hosts; adjust nodes "
+            "(= slices x hosts per slice) or the tpu size"
+        )
+    return conforming
+
+
 async def get_plan(
     db: Database, project_row: dict, user_row: dict, run_spec: RunSpec
 ) -> RunPlan:
@@ -101,40 +140,7 @@ async def get_plan(
         multinode=multinode,
     )
     job_specs = get_job_specs_from_run_spec(run_spec, replica_num=0)
-    # multislice uniformity is decidable at PLAN time: slice-major job
-    # decomposition needs every slice to have EXACTLY nodes/slices
-    # worker hosts, so offers with other host counts can never be
-    # scheduled — surface that at `dtpu apply`, not as a scheduler
-    # no-capacity failure an hour later
-    tpu_req = run_spec.configuration.resources.tpu
-    if (
-        isinstance(run_spec.configuration, TaskConfiguration)
-        and tpu_req is not None
-        and tpu_req.slices > 1
-    ):
-        hosts_needed = run_spec.configuration.nodes // tpu_req.slices
-        conforming = [
-            bo
-            for bo in offers
-            if bo[1].instance.resources.tpu is not None
-            and bo[1].instance.resources.tpu.hosts == hosts_needed
-        ]
-        if offers and not conforming:
-            seen = sorted(
-                {
-                    bo[1].instance.resources.tpu.hosts
-                    for bo in offers
-                    if bo[1].instance.resources.tpu is not None
-                }
-            )
-            raise ConfigurationError(
-                f"tpu.slices={tpu_req.slices} with nodes="
-                f"{run_spec.configuration.nodes} needs slices of exactly "
-                f"{hosts_needed} worker host(s), but matching offers have "
-                f"{seen} hosts; adjust nodes (= slices x hosts per slice) "
-                "or the tpu size"
-            )
-        offers = conforming
+    offers = filter_multislice_offers(run_spec, offers)
     job_plans = [
         JobPlan(
             job_spec=spec,
@@ -185,6 +191,26 @@ async def submit_run(
     db: Database, project_row: dict, user_row: dict, run_spec: RunSpec
 ) -> Run:
     run_spec = _prepare_run_spec(run_spec)
+    conf = run_spec.configuration
+    tpu_req = conf.resources.tpu if conf.resources else None
+    if (
+        isinstance(conf, TaskConfiguration)
+        and tpu_req is not None
+        and tpu_req.slices > 1
+    ):
+        # direct-submit path (no prior get_plan): run the same
+        # multislice uniformity validation so an unschedulable run is
+        # rejected HERE, not parked by the scheduler
+        project_backends = await backends_service.get_project_backends(
+            db, project_row
+        )
+        offers = await get_offers_by_requirements(
+            project_backends,
+            requirements_from_run_spec(run_spec),
+            run_spec.effective_profile(),
+            multinode=True,
+        )
+        filter_multislice_offers(run_spec, offers)
     existing = await get_run_row(db, project_row, run_spec.run_name)
     if existing is not None:
         if RunStatus(existing["status"]).is_finished():

@@ -74,7 +74,38 @@ const PAGES = [
   ["repos", "Repos"],
   ["secrets", "Secrets"],
   ["project", "Project"],
+  ["users", "Users"],  // global-admin page; hidden for other roles
 ];
+
+function visiblePages() {
+  return PAGES.filter(([id]) =>
+    id !== "users" || state.user?.global_role === "admin");
+}
+
+/* Collapsible paste-a-YAML panel: the browser's `dtpu apply -f`.
+   POSTs to /apply_yaml, which parses + dispatches by `type`. */
+function yamlApplyPanel(label, placeholder, onDone) {
+  const ta = h("textarea", {
+    rows: "10", placeholder, class: "yaml",
+    style: "width:100%;font-family:monospace;font-size:12px",
+  });
+  const errDiv = h("div", { style: "color:var(--err)" }, "");
+  const body = h("div", { style: "display:none;flex-direction:column;gap:8px;margin:8px 0" },
+    ta, errDiv,
+    h("button", { class: "primary", style: "align-self:flex-start", onclick: async () => {
+      errDiv.textContent = "";
+      try {
+        const res = await papi("/apply_yaml", { yaml: ta.value });
+        toast(`${res.kind} ${res.name} submitted`);
+        if (onDone) onDone(res); else render();
+      } catch (e) { errDiv.textContent = e.message; }
+    } }, "Apply"),
+  );
+  const toggle = h("button", { class: "primary", onclick: () => {
+    body.style.display = body.style.display === "none" ? "flex" : "none";
+  } }, label);
+  return h("div", {}, toggle, body);
+}
 
 function currentRoute() {
   const parts = location.hash.replace(/^#\/?/, "").split("/").filter(Boolean);
@@ -98,7 +129,7 @@ function renderShell(content) {
     ),
     h("div", { id: "layout" },
       h("div", { id: "nav" },
-        PAGES.map(([id, label]) =>
+        visiblePages().map(([id, label]) =>
           h("a", { class: page === id ? "active" : "", href: `#/${id}` }, label)),
       ),
       h("div", { id: "main" }, content),
@@ -130,7 +161,18 @@ function table(headers, rows, empty) {
 async function pageRuns() {
   const runs = await papi("/runs/list");
   return h("div", {},
-    h("h1", {}, "Runs"),
+    h("h1", { style: "display:flex;align-items:center;gap:12px" }, "Runs",
+      h("div", { style: "flex:1" }),
+    ),
+    yamlApplyPanel(
+      "+ Submit run",
+      "type: task\ncommands:\n  - python train.py\nresources:\n  tpu: v5e-8",
+      (res) => {
+        // apply_yaml dispatches by type: only run kinds have a detail page
+        if (res.kind === "run") location.hash = `#/runs/${res.name}`;
+        else render();
+      },
+    ),
     table(
       ["Name", "Type", "Status", "Backend", "Resources", "Submitted", ""],
       runs.map((r) => {
@@ -288,6 +330,10 @@ async function pageFleets() {
   const fleets = await papi("/fleets/list");
   return h("div", {},
     h("h1", {}, "Fleets"),
+    yamlApplyPanel(
+      "+ Create fleet",
+      "type: fleet\nname: my-fleet\nnodes: 2\nresources:\n  tpu: v5e-8",
+    ),
     table(
       ["Name", "Status", "Instances", "Created", ""],
       fleets.map((f) => h("tr", {},
@@ -408,8 +454,23 @@ async function pageInstances() {
 
 async function pageVolumes() {
   const volumes = await papi("/volumes/list");
+  const nameIn = h("input", { placeholder: "name" });
+  const regionIn = h("input", { placeholder: "region (us-central1)" });
+  const sizeIn = h("input", { placeholder: "size GB", type: "number", value: "100" });
   return h("div", {},
     h("h1", {}, "Volumes"),
+    h("div", { style: "display:flex;gap:8px;margin-bottom:16px" },
+      nameIn, regionIn, sizeIn,
+      h("button", { class: "primary", onclick: async () => {
+        try {
+          await papi("/volumes/apply", { configuration: {
+            type: "volume", name: nameIn.value || null,
+            region: regionIn.value || null, size: Number(sizeIn.value) || 100,
+          } });
+          toast(`Volume ${nameIn.value || "(auto)"} submitted`); render();
+        } catch (e) { toast("create failed: " + e.message); }
+      } }, "Create volume"),
+    ),
     table(
       ["Name", "Status", "Backend", "Region", "Size", ""],
       volumes.map((v) => h("tr", {},
@@ -431,6 +492,10 @@ async function pageGateways() {
   const gws = await papi("/gateways/list");
   return h("div", {},
     h("h1", {}, "Gateways"),
+    yamlApplyPanel(
+      "+ Create gateway",
+      "type: gateway\nname: main-gw\nbackend: gcp\nregion: us-central1\ndomain: '*.example.com'",
+    ),
     table(
       ["Name", "Status", "Hostname", "Domain", ""],
       gws.map((g) => h("tr", {},
@@ -494,24 +559,142 @@ async function pageSecrets() {
   );
 }
 
+async function pageUsers() {
+  const users = await api("/api/users/list");
+  const nameIn = h("input", { placeholder: "username" });
+  const roleSel = h("select", {},
+    h("option", { value: "user" }, "user"),
+    h("option", { value: "admin" }, "admin"));
+  const createdTokens = h("div", {});
+  return h("div", {},
+    h("h1", {}, "Users"),
+    h("div", { style: "display:flex;gap:8px;margin-bottom:8px" },
+      nameIn, roleSel,
+      h("button", { class: "primary", onclick: async () => {
+        if (!nameIn.value) return;
+        try {
+          const u = await api("/api/users/create", {
+            username: nameIn.value, global_role: roleSel.value,
+          });
+          // show the one-time token so the admin can hand it over
+          createdTokens.append(h("div", { class: "kv" },
+            h("div", { class: "k" }, `${u.username} token`),
+            h("div", {}, h("code", {}, u.creds?.token || "—"))));
+          toast(`User ${u.username} created`);
+          nameIn.value = "";
+        } catch (e) { toast("create failed: " + e.message); }
+      } }, "Create user"),
+    ),
+    createdTokens,
+    table(
+      ["Username", "Global role", "Email", "Active", ""],
+      users.map((u) => h("tr", {},
+        h("td", {}, u.username),
+        h("td", {}, u.global_role),
+        h("td", {}, u.email || "—"),
+        h("td", {}, u.active ? "yes" : "no"),
+        h("td", {}, u.username === "admin" ? null :
+          h("button", { class: "danger", onclick: async () => {
+            try {
+              await api("/api/users/delete", { users: [u.username] });
+              toast(`Deleted ${u.username}`); render();
+            } catch (e) { toast("delete failed: " + e.message); }
+          } }, "Delete")),
+      )),
+    ),
+  );
+}
+
 async function pageProject() {
   const project = await papi("/get");
   const backends = await papi("/backends/list");
+
+  // ---- members editor (set_members round-trips the full list) ----
+  const members = (project.members || []).map((m) => ({
+    username: m.user.username, project_role: m.project_role,
+  }));
+  async function saveMembers(next) {
+    try {
+      await papi("/set_members", { members: next });
+      toast("Members updated"); render();
+    } catch (e) { toast("update failed: " + e.message); }
+  }
+  const memberRows = members.map((m) => h("tr", {},
+    h("td", {}, m.username),
+    h("td", {}, m.project_role),
+    h("td", {}, h("button", { class: "danger", onclick: () =>
+      saveMembers(members.filter((x) => x.username !== m.username)),
+    }, "Remove")),
+  ));
+  const addNameIn = h("input", { placeholder: "username" });
+  const addRoleSel = h("select", {},
+    ["user", "manager", "admin"].map((r) => h("option", { value: r }, r)));
+
+  // ---- backends editor ----
+  const btypeIn = h("input", { placeholder: "type (gcp / local / kubernetes / ssh)" });
+  const bconfIn = h("textarea", {
+    rows: "4", placeholder: '{"project_id": "my-gcp-project", "regions": ["us-central1"]}',
+    style: "width:100%;font-family:monospace;font-size:12px",
+  });
+
+  // ---- new project ----
+  const projNameIn = h("input", { placeholder: "new project name" });
+
   return h("div", {},
     h("h1", {}, `Project: ${project.project_name}`),
     h("div", { class: "kv" },
       h("div", { class: "k" }, "Owner"), h("div", {}, project.owner?.username || "—"),
-      h("div", { class: "k" }, "Members"),
-      h("div", {}, (project.members || []).map((m) =>
-        `${m.user.username} (${m.project_role})`).join(", ") || "—"),
+    ),
+    h("h1", {}, "Members"),
+    table(["Username", "Role", ""], memberRows, "No members"),
+    h("div", { style: "display:flex;gap:8px;margin:8px 0 16px" },
+      addNameIn, addRoleSel,
+      h("button", { class: "primary", onclick: () => {
+        if (!addNameIn.value) return;
+        saveMembers(members
+          .filter((x) => x.username !== addNameIn.value)
+          .concat([{ username: addNameIn.value, project_role: addRoleSel.value }]));
+      } }, "Add member"),
     ),
     h("h1", {}, "Backends"),
     table(
-      ["Type", "Config"],
+      ["Type", "Config", ""],
       backends.map((b) => h("tr", {},
         h("td", {}, b.name),
         h("td", {}, h("span", { class: "muted" }, JSON.stringify(b.config))),
+        h("td", {}, h("button", { class: "danger", onclick: async () => {
+          try {
+            await papi("/backends/delete", { types: [b.name] });
+            toast(`Backend ${b.name} removed`); render();
+          } catch (e) { toast("delete failed: " + e.message); }
+        } }, "Delete")),
       )),
+      "No backends configured",
+    ),
+    h("div", { style: "display:flex;flex-direction:column;gap:8px;margin:8px 0 16px;max-width:640px" },
+      btypeIn, bconfIn,
+      h("button", { class: "primary", style: "align-self:flex-start", onclick: async () => {
+        let config;
+        try { config = bconfIn.value ? JSON.parse(bconfIn.value) : {}; }
+        catch (e) { return toast("config is not valid JSON"); }
+        try {
+          await papi("/backends/create", { type: btypeIn.value, config });
+          toast(`Backend ${btypeIn.value} added`); render();
+        } catch (e) { toast("create failed: " + e.message); }
+      } }, "Add backend"),
+    ),
+    h("h1", {}, "New project"),
+    h("div", { style: "display:flex;gap:8px" },
+      projNameIn,
+      h("button", { class: "primary", onclick: async () => {
+        if (!projNameIn.value) return;
+        try {
+          await api("/api/projects/create", { project_name: projNameIn.value });
+          state.project = projNameIn.value;
+          localStorage.setItem("dtpu_project", state.project);
+          toast(`Project ${projNameIn.value} created`); render();
+        } catch (e) { toast("create failed: " + e.message); }
+      } }, "Create project"),
     ),
   );
 }
@@ -551,6 +734,7 @@ const ROUTES = {
   repos: pageRepos,
   secrets: pageSecrets,
   project: pageProject,
+  users: pageUsers,
 };
 
 async function render() {

@@ -162,7 +162,10 @@ class TestSubmittedJobs:
     async def test_multislice_requires_exact_host_count(self):
         """nodes=2, slices=2 needs 1-host slices; a 2-host offer must be
         rejected (a bigger slice would shift the slice-major job
-        decomposition and leave slice B unprovisioned)."""
+        decomposition and leave slice B unprovisioned). The rejection
+        now fires at SUBMIT time — no dead run ever parks."""
+        from dstack_tpu.core.errors import ConfigurationError
+
         offers = [tpu_offer(version="v5e", chips=16, topology="4x4", hosts=2, price=19.2)]
         db, user_row, project_row, compute = await _setup(offers=offers)
         conf = {
@@ -171,13 +174,11 @@ class TestSubmittedJobs:
             "commands": ["python train.py"],
             "resources": {"tpu": {"version": "v5e", "chips": 16, "slices": 2}},
         }
-        await runs_service.submit_run(
-            db, project_row, user_row, make_run_spec(conf, "mismatched")
-        )
-        await process_submitted_jobs(db)
-        job = await db.fetchone("SELECT * FROM jobs WHERE job_num = 0")
-        assert job["status"] == JobStatus.TERMINATING.value
-        assert job["termination_reason"] == "failed_to_start_due_to_no_capacity"
+        with pytest.raises(ConfigurationError, match="exactly 1 worker"):
+            await runs_service.submit_run(
+                db, project_row, user_row, make_run_spec(conf, "mismatched")
+            )
+        assert await db.fetchall("SELECT * FROM jobs") == []
         assert len(compute.created) == 0
 
     async def test_multislice_waits_for_delayed_hosts(self):
