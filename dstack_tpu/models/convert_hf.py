@@ -7,7 +7,7 @@ torch ``.bin`` shards) and get back ``(LlamaConfig, params)`` ready for
 finetune driver.
 
 Supported ``model_type``s: ``llama``, ``qwen2``, ``qwen3``,
-``mistral``, ``gemma``, ``gemma2``, ``mixtral``, ``phi3`` (fused
+``qwen3_moe``, ``mistral``, ``gemma``, ``gemma2``, ``mixtral``, ``phi3`` (fused
 qkv/gate_up projections are split on load; a Phi-3 export round-trips
 as the equivalent mistral/llama layout). Each maps onto :class:`LlamaConfig` family
 flags (qkv_bias / sliding_window / norm_offset / softcaps / MoE) — the
@@ -52,7 +52,7 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     hidden = hf["hidden_size"]
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hidden // n_heads
-    if hf.get("attention_bias") and mt not in ("qwen2", "qwen3"):
+    if hf.get("attention_bias") and mt not in ("qwen2", "qwen3", "qwen3_moe"):
         # q/k/v/o biases exist in the checkpoint but our llama/mistral
         # paths would silently drop them — refuse rather than mis-serve
         raise ValueError(
@@ -112,6 +112,26 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         return LlamaConfig(
             **common, qk_norm=True,
             qkv_bias=bool(hf.get("attention_bias")),
+        )
+    if mt == "qwen3_moe":
+        # qwen3 attention (qk-norm) + sparse MoE MLP on every layer.
+        # Checkpoints mixing dense and sparse layers can't be expressed
+        # by the uniform layer stack — refuse rather than mis-run.
+        if hf.get("mlp_only_layers") or hf.get("decoder_sparse_step", 1) != 1:
+            raise ValueError(
+                "qwen3_moe with dense layers (mlp_only_layers / "
+                "decoder_sparse_step != 1) is not supported"
+            )
+        if hf.get("use_sliding_window"):
+            raise ValueError("qwen3_moe sliding windows are not supported")
+        common["intermediate_size"] = hf["moe_intermediate_size"]
+        return LlamaConfig(
+            **common,
+            qk_norm=True,
+            qkv_bias=bool(hf.get("attention_bias")),
+            n_experts=hf["num_experts"],
+            experts_per_token=hf.get("num_experts_per_tok", 8),
+            router_renorm=bool(hf.get("norm_topk_prob", True)),
         )
     if mt == "mistral":
         return LlamaConfig(**common, sliding_window=hf.get("sliding_window") or 0)
@@ -240,15 +260,23 @@ def convert_state_dict(
         layers["attn_post_norm"] = stack(P + "post_attention_layernorm.weight")
         layers["mlp_post_norm"] = stack(P + "post_feedforward_layernorm.weight")
     if c.n_experts:
-        layers["w_router"] = stack(
-            P + "block_sparse_moe.gate.weight", transpose=True
+        # mixtral: block_sparse_moe.gate + experts.{e}.w1/w3/w2;
+        # qwen3_moe: mlp.gate + experts.{e}.gate_proj/up_proj/down_proj
+        qmoe = model_type == "qwen3_moe"
+        router = "mlp.gate.weight" if qmoe else "block_sparse_moe.gate.weight"
+        expert_prefix = "mlp.experts" if qmoe else "block_sparse_moe.experts"
+        names = (
+            (("w_gate", "gate_proj"), ("w_up", "up_proj"), ("w_down", "down_proj"))
+            if qmoe
+            else (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2"))
         )
-        for ours, theirs in (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2")):
+        layers["w_router"] = stack(P + router, transpose=True)
+        for ours, theirs in names:
             per_layer = []
             for i in range(c.n_layers):
                 per_layer.append(
                     np.stack([
-                        get(f"model.layers.{i}.block_sparse_moe.experts.{e}.{theirs}.weight").T
+                        get(f"model.layers.{i}.{expert_prefix}.{e}.{theirs}.weight").T
                         for e in range(c.n_experts)
                     ])
                 )
@@ -353,7 +381,16 @@ def config_to_hf(config: LlamaConfig) -> dict:
             "high_freq_factor": high_f,
             "original_max_position_embeddings": int(orig),
         }
-    if c.n_experts:
+    if c.n_experts and c.qk_norm:
+        hf.update(
+            model_type="qwen3_moe",
+            num_experts=c.n_experts,
+            num_experts_per_tok=c.experts_per_token,
+            moe_intermediate_size=c.intermediate_size,
+            norm_topk_prob=c.router_renorm,
+            attention_bias=c.qkv_bias,
+        )
+    elif c.n_experts:
         hf.update(
             model_type="mixtral",
             num_local_experts=c.n_experts,
@@ -430,12 +467,19 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
             sd[P + "post_attention_layernorm.weight"] = np32(L["attn_post_norm"][i])
             sd[P + "post_feedforward_layernorm.weight"] = np32(L["mlp_post_norm"][i])
         if c.n_experts:
-            sd[P + "block_sparse_moe.gate.weight"] = np32(L["w_router"][i]).T
+            qmoe = mt == "qwen3_moe"
+            router = "mlp.gate.weight" if qmoe else "block_sparse_moe.gate.weight"
+            eprefix = "mlp.experts" if qmoe else "block_sparse_moe.experts"
+            g, u, d = (
+                ("gate_proj", "up_proj", "down_proj")
+                if qmoe else ("w1", "w3", "w2")
+            )
+            sd[P + router] = np32(L["w_router"][i]).T
             for e in range(c.n_experts):
-                E = P + f"block_sparse_moe.experts.{e}."
-                sd[E + "w1.weight"] = np32(L["w_gate"][i][e]).T
-                sd[E + "w3.weight"] = np32(L["w_up"][i][e]).T
-                sd[E + "w2.weight"] = np32(L["w_down"][i][e]).T
+                E = P + f"{eprefix}.{e}."
+                sd[E + f"{g}.weight"] = np32(L["w_gate"][i][e]).T
+                sd[E + f"{u}.weight"] = np32(L["w_up"][i][e]).T
+                sd[E + f"{d}.weight"] = np32(L["w_down"][i][e]).T
         else:
             sd[P + "mlp.gate_proj.weight"] = np32(L["w_gate"][i]).T
             sd[P + "mlp.up_proj.weight"] = np32(L["w_up"][i]).T

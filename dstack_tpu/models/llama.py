@@ -156,6 +156,12 @@ QWEN25_7B = LlamaConfig(
     n_kv_heads=4, head_dim=128, intermediate_size=18944, rope_theta=1e6,
     norm_eps=1e-6, max_seq_len=32768, qkv_bias=True,
 )
+QWEN3_30B_A3B = LlamaConfig(  # sparse MoE: 30B total, ~3B active
+    vocab_size=151936, hidden_size=2048, n_layers=48, n_heads=32,
+    n_kv_heads=4, head_dim=128, intermediate_size=768, rope_theta=1e6,
+    norm_eps=1e-6, max_seq_len=32768, qk_norm=True,
+    n_experts=128, experts_per_token=8, router_renorm=True,
+)
 MISTRAL_7B = LlamaConfig(
     vocab_size=32000, hidden_size=4096, n_layers=32, n_heads=32,
     n_kv_heads=8, head_dim=128, intermediate_size=14336, rope_theta=10000.0,
@@ -186,6 +192,7 @@ CONFIGS = {
     "moe-tiny": MOE_TINY,
     "qwen-2.5-7b": QWEN25_7B,
     "qwen-3-8b": QWEN3_8B,
+    "qwen-3-30b-a3b": QWEN3_30B_A3B,
     "mistral-7b": MISTRAL_7B,
     "gemma-2b": GEMMA_2B,
     "gemma-2-2b": GEMMA2_2B,

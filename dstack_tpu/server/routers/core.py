@@ -234,9 +234,12 @@ async def apply_yaml(ctx: RequestContext, body: s.ApplyYamlRequest):
         return {"kind": "gateway", "name": gw.name}
     run_spec = RunSpec(run_name=body.name or conf.name, configuration=conf)
     # plan first: config-time validation (mesh/multislice limits) fails
-    # HERE with a clear message rather than as a dead run
+    # HERE with a clear message rather than as a dead run; submit can
+    # then skip re-validating offers
     await runs_service.get_plan(db, ctx.project, ctx.user, run_spec)
-    run = await runs_service.submit_run(db, ctx.project, ctx.user, run_spec)
+    run = await runs_service.submit_run(
+        db, ctx.project, ctx.user, run_spec, validate_offers=False
+    )
     return {"kind": "run", "name": run.run_spec.run_name}
 
 

@@ -188,29 +188,16 @@ def _desired_replica_count(run_spec: RunSpec) -> int:
 
 
 async def submit_run(
-    db: Database, project_row: dict, user_row: dict, run_spec: RunSpec
+    db: Database,
+    project_row: dict,
+    user_row: dict,
+    run_spec: RunSpec,
+    validate_offers: bool = True,
 ) -> Run:
+    """``validate_offers=False`` skips the multislice offer-uniformity
+    re-check for callers that just ran :func:`get_plan` (it performs
+    the same validation) — one offer enumeration per request."""
     run_spec = _prepare_run_spec(run_spec)
-    conf = run_spec.configuration
-    tpu_req = conf.resources.tpu if conf.resources else None
-    if (
-        isinstance(conf, TaskConfiguration)
-        and tpu_req is not None
-        and tpu_req.slices > 1
-    ):
-        # direct-submit path (no prior get_plan): run the same
-        # multislice uniformity validation so an unschedulable run is
-        # rejected HERE, not parked by the scheduler
-        project_backends = await backends_service.get_project_backends(
-            db, project_row
-        )
-        offers = await get_offers_by_requirements(
-            project_backends,
-            requirements_from_run_spec(run_spec),
-            run_spec.effective_profile(),
-            multinode=True,
-        )
-        filter_multislice_offers(run_spec, offers)
     existing = await get_run_row(db, project_row, run_spec.run_name)
     if existing is not None:
         if RunStatus(existing["status"]).is_finished():
@@ -264,10 +251,37 @@ async def submit_run(
         "submitted_at": now_utc().isoformat(),
         "last_processed_at": now_utc().isoformat(),
     }
+    # generate every replica's job specs BEFORE inserting anything: a
+    # configuration error (nodes % slices, bad volume template, …) must
+    # reject the submit cleanly, not orphan a jobless run row
+    replica_specs = [
+        get_job_specs_from_run_spec(run_spec, replica_num)
+        for replica_num in range(run_row["desired_replica_count"])
+    ]
+    conf = run_spec.configuration
+    tpu_req = conf.resources.tpu if conf.resources else None
+    if (
+        validate_offers
+        and isinstance(conf, TaskConfiguration)
+        and tpu_req is not None
+        and tpu_req.slices > 1
+    ):
+        # direct-submit path (no prior get_plan): the same multislice
+        # uniformity validation, so an unschedulable run is rejected
+        # HERE, not parked by the scheduler
+        project_backends = await backends_service.get_project_backends(
+            db, project_row
+        )
+        offers = await get_offers_by_requirements(
+            project_backends,
+            requirements_from_run_spec(run_spec),
+            run_spec.effective_profile(),
+            multinode=True,
+        )
+        filter_multislice_offers(run_spec, offers)
     await db.insert("runs", run_row)
-    # expand replica 0..N-1 into job rows
-    for replica_num in range(run_row["desired_replica_count"]):
-        for spec in get_job_specs_from_run_spec(run_spec, replica_num):
+    for specs in replica_specs:
+        for spec in specs:
             await jobs_service.create_job_row(db, run_row, spec)
     logger.info(
         "submitted run %s (%d replicas)",

@@ -343,8 +343,8 @@ class TestConfigRoundTrip:
     the same model."""
 
     @pytest.mark.parametrize("name", [
-        "llama-3.2-1b", "qwen-2.5-7b", "qwen-3-8b", "mistral-7b",
-        "gemma-2b", "gemma-2-2b", "mixtral-8x7b",
+        "llama-3.2-1b", "qwen-2.5-7b", "qwen-3-8b", "qwen-3-30b-a3b",
+        "mistral-7b", "gemma-2b", "gemma-2-2b", "mixtral-8x7b",
     ])
     def test_flags_survive(self, name):
         from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
@@ -371,4 +371,46 @@ class TestConfigRoundTrip:
                 "model_type": "mamba", "hidden_size": 8,
                 "num_attention_heads": 2, "vocab_size": 16,
                 "num_hidden_layers": 1, "intermediate_size": 16,
+            })
+
+
+class TestQwen3Moe:
+    def test_qwen3_moe_logit_parity(self, tmp_path):
+        """qwen3 attention (qk-norm) + sparse MoE MLP: router renorm,
+        per-expert gate/up/down naming, moe_intermediate_size."""
+        m = _save_tiny(
+            tmp_path,
+            transformers.Qwen3MoeConfig,
+            transformers.Qwen3MoeForCausalLM,
+            num_experts=4,
+            num_experts_per_tok=2,
+            moe_intermediate_size=96,
+            norm_topk_prob=True,
+            decoder_sparse_step=1,
+            mlp_only_layers=[],
+            head_dim=16,
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        assert config.qk_norm and config.n_experts == 4 and config.router_renorm
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(
+            config, remat=False, capacity_factor=float(config.n_experts)
+        )
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, config.vocab_size, (B, T))
+        with torch.no_grad():
+            ref = m(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(params, jnp.asarray(tokens), config)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+    def test_qwen3_moe_dense_layers_rejected(self, tmp_path):
+        from dstack_tpu.models.convert_hf import config_from_hf
+
+        with pytest.raises(ValueError, match="dense layers"):
+            config_from_hf({
+                "model_type": "qwen3_moe", "vocab_size": 128,
+                "hidden_size": 64, "intermediate_size": 96,
+                "moe_intermediate_size": 96, "num_hidden_layers": 4,
+                "num_attention_heads": 4, "num_experts": 4,
+                "mlp_only_layers": [0],
             })

@@ -668,3 +668,36 @@ class TestPlanTimeValidation:
             await runs_service.get_plan(
                 db, project_row, user_row, make_run_spec(conf, "bad-nodes")
             )
+
+    async def test_nondivisible_nodes_rejected_at_submit_no_orphan_run(self):
+        """nodes=3/slices=2 direct submit: rejected with the divisibility
+        error BEFORE any row is written (no jobless orphan run)."""
+        from dstack_tpu.core.errors import ConfigurationError
+
+        offers = [
+            tpu_offer(version="v5e", chips=16, topology="4x4", hosts=1, price=9.2)
+        ]
+        db, user_row, project_row, _ = await _setup(offers=offers)
+        conf = {
+            "type": "task",
+            "nodes": 3,
+            "commands": ["python train.py"],
+            "resources": {"tpu": {"version": "v5e", "chips": 16, "slices": 2}},
+        }
+        with pytest.raises(ConfigurationError, match="multiple"):
+            await runs_service.submit_run(
+                db, project_row, user_row, make_run_spec(conf, "nondiv")
+            )
+        assert await db.fetchall("SELECT * FROM runs WHERE deleted = 0") == []
+        assert await db.fetchall("SELECT * FROM jobs") == []
+
+    async def test_bad_volume_template_leaves_no_orphan_run(self):
+        from dstack_tpu.core.errors import ConfigurationError
+
+        db, user_row, project_row, _ = await _setup()
+        conf = {**TASK_V5E8, "volumes": ["data-${{ dtpu.bogus }}:/data"]}
+        with pytest.raises(ConfigurationError):
+            await runs_service.submit_run(
+                db, project_row, user_row, make_run_spec(conf, "bad-tpl")
+            )
+        assert await db.fetchall("SELECT * FROM runs WHERE deleted = 0") == []
