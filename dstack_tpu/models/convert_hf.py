@@ -169,6 +169,22 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     raise ValueError(f"unsupported HF model_type {mt!r}")
 
 
+# MoE tensor naming per family: (router weight, expert prefix,
+# (gate, up, down) per-expert names) — ONE table consumed by both
+# convert_state_dict and export_state_dict so import/export round-trip
+# symmetry can't drift.
+_MOE_NAMES = {
+    "qwen3_moe": (
+        "mlp.gate.weight", "mlp.experts",
+        ("gate_proj", "up_proj", "down_proj"),
+    ),
+    "mixtral": (
+        "block_sparse_moe.gate.weight", "block_sparse_moe.experts",
+        ("w1", "w3", "w2"),
+    ),
+}
+
+
 def _rope_scaling_from_hf(hf: dict) -> Optional[tuple]:
     """HF ``rope_scaling`` → :class:`LlamaConfig` tuple (llama3 only).
 
@@ -260,16 +276,10 @@ def convert_state_dict(
         layers["attn_post_norm"] = stack(P + "post_attention_layernorm.weight")
         layers["mlp_post_norm"] = stack(P + "post_feedforward_layernorm.weight")
     if c.n_experts:
-        # mixtral: block_sparse_moe.gate + experts.{e}.w1/w3/w2;
-        # qwen3_moe: mlp.gate + experts.{e}.gate_proj/up_proj/down_proj
-        qmoe = model_type == "qwen3_moe"
-        router = "mlp.gate.weight" if qmoe else "block_sparse_moe.gate.weight"
-        expert_prefix = "mlp.experts" if qmoe else "block_sparse_moe.experts"
-        names = (
-            (("w_gate", "gate_proj"), ("w_up", "up_proj"), ("w_down", "down_proj"))
-            if qmoe
-            else (("w_gate", "w1"), ("w_up", "w3"), ("w_down", "w2"))
+        router, expert_prefix, (g, u, d) = _MOE_NAMES.get(
+            model_type, _MOE_NAMES["mixtral"]
         )
+        names = (("w_gate", g), ("w_up", u), ("w_down", d))
         layers["w_router"] = stack(P + router, transpose=True)
         for ours, theirs in names:
             per_layer = []
@@ -467,12 +477,8 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
             sd[P + "post_attention_layernorm.weight"] = np32(L["attn_post_norm"][i])
             sd[P + "post_feedforward_layernorm.weight"] = np32(L["mlp_post_norm"][i])
         if c.n_experts:
-            qmoe = mt == "qwen3_moe"
-            router = "mlp.gate.weight" if qmoe else "block_sparse_moe.gate.weight"
-            eprefix = "mlp.experts" if qmoe else "block_sparse_moe.experts"
-            g, u, d = (
-                ("gate_proj", "up_proj", "down_proj")
-                if qmoe else ("w1", "w3", "w2")
+            router, eprefix, (g, u, d) = _MOE_NAMES.get(
+                mt, _MOE_NAMES["mixtral"]
             )
             sd[P + router] = np32(L["w_router"][i]).T
             for e in range(c.n_experts):

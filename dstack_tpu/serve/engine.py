@@ -688,6 +688,7 @@ class InferenceEngine:
             partial(verify_step, config=config), donate_argnums=(1,)
         )
         self._sample = jax.jit(sample)
+        self._argmax = jax.jit(partial(jnp.argmax, axis=-1))
         self._logprobs = jax.jit(token_logprobs)
         self._mark_seen = jax.jit(_mark_seen, donate_argnums=(0, 1))
         self._mark_prompt = jax.jit(_mark_prompt, donate_argnums=(0, 1))
@@ -892,14 +893,7 @@ class InferenceEngine:
         live = [i for i in range(self.max_batch) if self.active[i]]
         if not live:
             return {}
-        spec_ok = self.spec_draft > 0 and all(
-            self.temps[i] <= 0.0
-            and self.rep_pens[i] == 1.0
-            and self.pres_pens[i] == 0.0
-            and self.freq_pens[i] == 0.0
-            and not self.want_logprobs[i]
-            for i in live
-        )
+        spec_ok = self.spec_draft > 0 and self._all_greedy(live)
         if spec_ok:
             drafts = {i: self._find_draft(i) for i in live}
             drafting = sum(1 for d in drafts.values() if d)
@@ -971,6 +965,19 @@ class InferenceEngine:
             # to repetition_penalty == 1.0, where seen has no effect
         return out
 
+    def _all_greedy(self, live: list) -> bool:
+        """True when every live slot is plain-greedy with no penalties
+        or logprobs — the gate shared by the speculative path and the
+        argmax fast path. ANY new sampling knob must be added here."""
+        return all(
+            self.temps[i] <= 0.0
+            and self.rep_pens[i] == 1.0
+            and self.pres_pens[i] == 0.0
+            and self.freq_pens[i] == 0.0
+            and not self.want_logprobs[i]
+            for i in live
+        )
+
     def _plain_step(self, live: list) -> dict[int, int]:
         tokens = jnp.asarray(self.last_token, jnp.int32)
         positions = jnp.asarray(self.lengths, jnp.int32)
@@ -978,6 +985,11 @@ class InferenceEngine:
             self.params, self.cache, tokens, positions,
             write_mask=jnp.asarray(self.active, bool),
         )
+        if self._all_greedy(live):
+            # all-greedy batch: argmax only — the general sampler's
+            # full [B, V] descending sort (the dominant per-token cost
+            # at a 128k vocab) buys nothing here
+            return self._emit(live, jax.device_get(self._argmax(logits)))
         sampled_dev, self._key_data = self._sample(
             logits,
             self._key_data,
@@ -1003,7 +1015,10 @@ class InferenceEngine:
                         float(lp[i]),
                         list(zip(map(int, tids[i]), map(float, tlps[i]))),
                     )
-        sampled = jax.device_get(sampled_dev)
+        return self._emit(live, jax.device_get(sampled_dev))
+
+    def _emit(self, live: list, sampled) -> dict[int, int]:
+        """Publish one sampled token per live slot (host bookkeeping)."""
         out: dict[int, int] = {}
         for i in live:
             tok = int(sampled[i])

@@ -99,7 +99,10 @@ def main() -> int:
     p.add_argument("--quick", action="store_true")
     p.add_argument("--batches", default=None, help="comma list, e.g. 4,8,16")
     p.add_argument("--seqs", default=None)
-    p.add_argument("--peak-flops", type=float, default=197e12, help="v5e bf16")
+    p.add_argument(
+        "--peak-flops", type=float, default=None,
+        help="default: 197e12 (v5e bf16) on TPU, 1e12 nominal on CPU",
+    )
     args = p.parse_args()
 
     if args.platform is None and not _tpu_reachable():
@@ -116,7 +119,7 @@ def main() -> int:
     on_tpu = jax.default_backend() in ("tpu", "axon")
     model = args.model or ("llama-3.2-1b" if on_tpu else "llama-tiny")
     config = llama.CONFIGS[model]
-    peak = args.peak_flops if on_tpu else 1e12
+    peak = args.peak_flops or (197e12 if on_tpu else 1e12)
     if on_tpu:
         batches = [int(x) for x in (args.batches or "4,8,16").split(",")]
         seqs = [int(x) for x in (args.seqs or "1024,2048").split(",")]
