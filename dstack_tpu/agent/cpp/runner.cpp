@@ -116,6 +116,11 @@ class Executor {
   void submit(const Value& body) {
     std::lock_guard<std::mutex> lk(mu_);
     job_ = body;
+    // secret VALUES must never appear in diagnostics (python parity)
+    for (const auto& [k, v] : body["secrets"].as_object())
+      if (!v.as_string().empty()) redact_.push_back(v.as_string());
+    for (const auto& v : body["redact_values"].as_array())
+      if (!v.as_string().empty()) redact_.push_back(v.as_string());
     push_state_locked({"submitted", now_unix(), "", "", std::nullopt});
   }
 
@@ -281,7 +286,24 @@ class Executor {
   long ssh_port_ = 10022;
   double no_conn_since_ = 0;
 
-  void push_state_locked(StateEvent e) { states_.push_back(std::move(e)); }
+  std::vector<std::string> redact_;  // secret values; scrub diagnostics
+
+  std::string redact(std::string s) const {
+    for (const auto& r : redact_) {
+      if (r.empty()) continue;
+      size_t p = 0;
+      while ((p = s.find(r, p)) != std::string::npos) {
+        s.replace(p, r.size(), "***");
+        p += 3;
+      }
+    }
+    return s;
+  }
+
+  void push_state_locked(StateEvent e) {
+    e.termination_message = redact(std::move(e.termination_message));
+    states_.push_back(std::move(e));
+  }
 
   void push_state(StateEvent e) {
     std::lock_guard<std::mutex> lk(mu_);
@@ -290,7 +312,7 @@ class Executor {
 
   void rlog(const std::string& text) {
     std::lock_guard<std::mutex> lk(mu_);
-    runner_logs_.push_back({now_unix(), text + "\n"});
+    runner_logs_.push_back({now_unix(), redact(text) + "\n"});
   }
 
   static int64_t read_cgroup_cpu_micro() {

@@ -130,6 +130,12 @@ class Executor:
 
     def submit(self, body: schemas.SubmitBody) -> None:
         self.job = body
+        # secret VALUES must never appear in logs or failure messages
+        for v in list((body.secrets or {}).values()) + list(
+            body.redact_values or []
+        ):
+            if v:
+                self._secrets.append(v)
         self._push_state("submitted")
 
     def upload_code(self, data: bytes) -> None:

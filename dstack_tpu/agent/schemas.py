@@ -135,6 +135,9 @@ class SubmitBody(CoreModel):
     job_spec: dict  # JobSpec dump
     cluster_info: ClusterInfo = ClusterInfo()
     secrets: dict[str, str] = {}
+    # additional sensitive strings to scrub from diagnostics (e.g.
+    # secret values interpolated into env via ${{ secrets.X }})
+    redact_values: list[str] = []
     repo_data: dict = {}  # {repo_type, ...}
     state: str = "submitted"
 

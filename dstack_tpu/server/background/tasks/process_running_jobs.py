@@ -137,6 +137,52 @@ async def _handle_unreachable(db: Database, job_row: dict, message: str) -> None
         )
 
 
+async def _get_project_secrets(db: Database, project_id: str) -> dict:
+    """Decrypted {name: value} for the project; a secret that exists
+    but fails to decrypt (server encryption-key change) maps to None
+    so callers can report THAT instead of "not found". Values are
+    scoped by callers before they reach a job env (least privilege).
+    (The reference wires the secrets transport but left population as
+    a TODO, process_running_jobs.py:171; here the secrets flow.)"""
+    from dstack_tpu.server.services.encryption import decrypt
+
+    rows = await db.fetchall(
+        "SELECT name, value FROM secrets WHERE project_id = ?", (project_id,)
+    )
+    out = {}
+    for r in rows:
+        try:
+            out[r["name"]] = decrypt(r["value"]) or ""
+        except Exception:
+            logger.warning("secret %s failed to decrypt", r["name"])
+            out[r["name"]] = None
+    return out
+
+
+def _interpolate_registry_auth(registry_auth, secrets: dict):
+    """``${{ secrets.X }}`` in registry credentials → values (reference
+    process_running_jobs.py:418). Unresolvable references raise
+    InterpolatorError — a cryptic registry 401 later would be much
+    worse — with not-found vs failed-to-decrypt kept distinct."""
+    if registry_auth is None:
+        return None
+    from dstack_tpu.utils.interpolator import (
+        InterpolatorError,
+        substitute_secrets,
+    )
+
+    username, p1 = substitute_secrets(registry_auth.username or "", secrets)
+    password, p2 = substitute_secrets(registry_auth.password or "", secrets)
+    if p1 or p2:
+        raise InterpolatorError("; ".join(p1 + p2))
+    return registry_auth.model_copy(
+        update={
+            "username": username or registry_auth.username,
+            "password": password or registry_auth.password,
+        }
+    )
+
+
 async def _interruption_notice(db: Database, job_row: dict) -> bool:
     """Probe the job host's shim for an interruption notice; when one
     is up, mark the job INTERRUPTED (True = handled)."""
@@ -290,12 +336,40 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
         jpd, db=db, project_id=job_row["project_id"]
     ) as shim:
         await shim.healthcheck()
+        from dstack_tpu.utils.interpolator import InterpolatorError
+
+        ra = job_spec.registry_auth
+        needs_secrets = ra is not None and (
+            "${{" in (ra.username or "") or "${{" in (ra.password or "")
+        )
+        try:
+            reg_auth = _interpolate_registry_auth(
+                ra,
+                # fetched only when the credentials actually reference
+                # secrets — static creds skip the query + decrypts.
+                # None values (decrypt failures) pass through: the
+                # substitution reports them distinctly
+                (
+                    await _get_project_secrets(db, job_row["project_id"])
+                    if needs_secrets
+                    else {}
+                ),
+            )
+        except InterpolatorError as e:
+            await jobs_service.update_job_status(
+                db,
+                job_row["id"],
+                JobStatus.TERMINATING,
+                termination_reason=JobTerminationReason.CREATING_CONTAINER_ERROR,
+                termination_reason_message=f"registry_auth: {e}"[:500],
+            )
+            return
         task_req = agent_schemas.TaskSubmitRequest(
             id=job_row["id"],
             name=job_spec.job_name,
             image_name=job_spec.image_name if jpd.dockerized else "",
-            registry_username=(job_spec.registry_auth.username if job_spec.registry_auth else None),
-            registry_password=(job_spec.registry_auth.password if job_spec.registry_auth else None),
+            registry_username=(reg_auth.username if reg_auth else None),
+            registry_password=(reg_auth.password if reg_auth else None),
             privileged=job_spec.privileged,
             pjrt_device=job_spec.pjrt_device,
             env={},
@@ -373,6 +447,56 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
     from dstack_tpu.core.models.runs import RunSpec
 
     run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+    # config's `secrets:` allowlist + `${{ secrets.X }}` env references
+    # — ONE store fetch serves both; problems fail the job with a
+    # message that distinguishes "not found" from "failed to decrypt"
+    wanted = list(getattr(run_spec.configuration, "secrets", None) or [])
+    env = dict(job_spec.env or {})
+    env_refs = any("secrets." in v for v in env.values() if "${{" in v)
+    store: dict = {}
+    if wanted or env_refs:
+        store = await _get_project_secrets(db, run_row["project_id"])
+    job_secrets = {n: store[n] for n in wanted if store.get(n) is not None}
+    problems = [
+        (
+            f"{n} exists but failed to decrypt (server encryption key "
+            "changed?)"
+            if n in store
+            else f"{n} not found in project"
+        )
+        for n in wanted
+        if store.get(n) is None
+    ]
+    redact_values: list = []
+    if env_refs and not problems:
+        from dstack_tpu.utils.interpolator import substitute_secrets
+
+        # only exact ${{ secrets.X }} matches substitute; templates of
+        # other namespaces pass through untouched (the job's own
+        # tooling may consume them)
+        resolved = {}
+        for k, v in env.items():
+            resolved[k], probs = substitute_secrets(v, store)
+            problems.extend(probs)
+        if not problems:
+            env = resolved
+            # any secret value that landed in env gets scrubbed from
+            # runner diagnostics
+            redact_values = [
+                v for v in store.values()
+                if v and any(v in rv for rv in env.values())
+            ]
+    if problems:
+        await jobs_service.update_job_status(
+            db,
+            job_row["id"],
+            JobStatus.TERMINATING,
+            termination_reason=JobTerminationReason.CREATING_CONTAINER_ERROR,
+            termination_reason_message=(
+                f"secrets: {'; '.join(problems)}"[:500]
+            ),
+        )
+        return
     repo_data = dict(run_spec.repo_data or {})
     if repo_data and run_spec.repo_id:
         creds = await _get_repo_creds(db, run_row["project_id"], run_spec.repo_id)
@@ -392,10 +516,13 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
                 # global rank from slice_id), the global job_num otherwise
                 job_spec={
                     **job_spec.model_dump(),
+                    "env": env,  # secrets references resolved
                     "job_num": jpd.worker_id if jpd.hosts else job_spec.job_num,
                 },
                 cluster_info=cluster_info,
                 repo_data=repo_data,
+                secrets=job_secrets,
+                redact_values=redact_values,
             )
         )
         code = await _get_code_blob(db, run_row, run_spec)

@@ -83,6 +83,39 @@ async def get_run_row(
     )
 
 
+async def _validate_declared_secrets(
+    db: Database, project_row: dict, run_spec: RunSpec
+) -> None:
+    """Names in the config's ``secrets:`` list and ``${{ secrets.X }}``
+    env references must exist at submit time — a typo should fail the
+    apply, not a provisioned (paid-for) instance minutes later. The
+    runner-submit path re-checks at runtime (secrets can be deleted
+    between submit and run)."""
+    from dstack_tpu.utils.interpolator import secret_names_referenced
+
+    conf = run_spec.configuration
+    names = set(getattr(conf, "secrets", None) or [])
+    env = conf.env.as_dict() if getattr(conf, "env", None) else {}
+    for v in env.values():
+        names.update(secret_names_referenced(v))
+    reg = getattr(conf, "registry_auth", None)
+    if reg is not None:
+        names.update(secret_names_referenced(reg.username or ""))
+        names.update(secret_names_referenced(reg.password or ""))
+    if not names:
+        return
+    rows = await db.fetchall(
+        "SELECT name FROM secrets WHERE project_id = ?", (project_row["id"],)
+    )
+    have = {r["name"] for r in rows}
+    missing = sorted(names - have)
+    if missing:
+        raise ConfigurationError(
+            f"secrets not found in project: {', '.join(missing)} "
+            f"(create with `dtpu secret set`)"
+        )
+
+
 def filter_multislice_offers(run_spec: RunSpec, offers: list) -> list:
     """Multislice uniformity is decidable BEFORE scheduling: slice-major
     job decomposition needs every slice to have EXACTLY nodes/slices
@@ -198,6 +231,7 @@ async def submit_run(
     re-check for callers that just ran :func:`get_plan` (it performs
     the same validation) — one offer enumeration per request."""
     run_spec = _prepare_run_spec(run_spec)
+    await _validate_declared_secrets(db, project_row, run_spec)
     existing = await get_run_row(db, project_row, run_spec.run_name)
     if existing is not None:
         if RunStatus(existing["status"]).is_finished():

@@ -50,3 +50,43 @@ class VariablesInterpolator:
         if missing:
             raise InterpolatorError(f"unresolved variables: {missing}")
         return result
+
+
+def secret_names_referenced(text: str) -> list[str]:
+    """Secret names a ``${{ secrets.X }}`` template references — for
+    validating availability at submit time, before compute is paid
+    for."""
+    out = []
+    for m in _VAR_RE.finditer(text or ""):
+        expr = m.group("expr")
+        if expr.startswith("secrets.") and expr.count(".") == 1:
+            out.append(expr.split(".", 1)[1])
+    return out
+
+
+def substitute_secrets(text: str, store: dict) -> tuple[str, list[str]]:
+    """Replace only the exact ``${{ secrets.X }}`` matches in ``text``
+    → (result, problems). Templates of OTHER namespaces pass through
+    untouched (they may belong to the job's own tooling). A ``store``
+    value of None means the secret exists but failed to decrypt —
+    reported distinctly from "not found" so a server-side key rotation
+    doesn't read like a user typo."""
+    problems: list[str] = []
+
+    def repl(m: re.Match) -> str:
+        expr = m.group("expr")
+        if not (expr.startswith("secrets.") and expr.count(".") == 1):
+            return m.group(0)
+        name = expr.split(".", 1)[1]
+        if name not in store:
+            problems.append(f"{name} not found in project")
+            return m.group(0)
+        if store[name] is None:
+            problems.append(
+                f"{name} exists but failed to decrypt (server encryption "
+                "key changed?)"
+            )
+            return m.group(0)
+        return store[name]
+
+    return _VAR_RE.sub(repl, text or ""), problems

@@ -125,3 +125,264 @@ class TestLocalE2E:
             assert sub["termination_reason"] == "container_exited_with_error"
         finally:
             await client.close()
+
+
+class TestSecretsDelivery:
+    async def test_secret_reaches_job_env(self, tmp_path):
+        """Project secrets flow server → runner → job env (the
+        reference wires this transport but leaves population TODO,
+        reference process_running_jobs.py:171). Diagnostics scrubbing
+        is covered by test_secret_values_scrubbed_from_runner_diagnostics."""
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="e2e-token",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/api/project/main/secrets/create",
+                headers=_auth("e2e-token"),
+                json={"name": "API_KEY", "value": "sk-sekret-123"},
+            )
+            assert r.status == 200
+            body = {
+                "run_spec": {
+                    "run_name": "e2e-secret",
+                    "configuration": {
+                        "type": "task",
+                        # least privilege: only DECLARED secrets reach
+                        # the job env
+                        "secrets": ["API_KEY"],
+                        "commands": [
+                            'test -n "$API_KEY" && echo "key-len=${#API_KEY}"',
+                            'echo "key=$API_KEY"',
+                            'echo "other=${OTHER_SECRET:-unset}"',
+                        ],
+                    },
+                    "ssh_key_pub": "ssh-ed25519 AAAA test",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth("e2e-token"), json=body
+            )
+            assert r.status == 200
+            run = await _wait_run_status(
+                client, "e2e-token", "e2e-secret", ("done", "failed", "terminated")
+            )
+            assert run["status"] == "done", run
+            r = await client.post(
+                "/api/project/main/logs/poll",
+                headers=_auth("e2e-token"),
+                json={"run_name": "e2e-secret"},
+            )
+            logs = (await r.json())["logs"]
+            import base64 as b64
+
+            text = "".join(
+                b64.b64decode(e["message"]).decode() for e in logs
+            )
+            assert "key-len=13" in text          # env var was present
+            assert "key=sk-sekret-123" in text   # user explicitly printed it
+            assert "other=unset" in text         # undeclared secret absent
+        finally:
+            await client.close()
+
+    async def test_undeclared_secrets_not_delivered(self, tmp_path):
+        """A config without `secrets:` gets NO project secrets."""
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="e2e-token",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await client.post(
+                "/api/project/main/secrets/create",
+                headers=_auth("e2e-token"),
+                json={"name": "PROD_KEY", "value": "prod-555"},
+            )
+            body = {
+                "run_spec": {
+                    "run_name": "e2e-nosecret",
+                    "configuration": {
+                        "type": "task",
+                        "commands": ['echo "prod=${PROD_KEY:-unset}"'],
+                    },
+                    "ssh_key_pub": "ssh-ed25519 AAAA test",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth("e2e-token"), json=body
+            )
+            assert r.status == 200
+            run = await _wait_run_status(
+                client, "e2e-token", "e2e-nosecret", ("done", "failed", "terminated")
+            )
+            assert run["status"] == "done", run
+            r = await client.post(
+                "/api/project/main/logs/poll", headers=_auth("e2e-token"),
+                json={"run_name": "e2e-nosecret"},
+            )
+            import base64 as b64
+
+            text = "".join(
+                b64.b64decode(e["message"]).decode()
+                for e in (await r.json())["logs"]
+            )
+            assert "prod=unset" in text
+        finally:
+            await client.close()
+
+    async def test_missing_declared_secret_rejected_at_submit(self, tmp_path):
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="e2e-token",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            body = {
+                "run_spec": {
+                    "run_name": "e2e-missing-secret",
+                    "configuration": {
+                        "type": "task",
+                        "secrets": ["NO_SUCH_SECRET"],
+                        "commands": ["echo hi"],
+                    },
+                    "ssh_key_pub": "ssh-ed25519 AAAA test",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth("e2e-token"), json=body
+            )
+            # rejected at SUBMIT time — a typo must not provision compute
+            assert 400 <= r.status < 500
+            assert "NO_SUCH_SECRET" in await r.text()
+        finally:
+            await client.close()
+
+    def test_secret_values_scrubbed_from_runner_diagnostics(self, tmp_path):
+        """The runner redacts registered secret values from failure
+        messages (regression net for the submit() registration)."""
+        from pathlib import Path as _P
+
+        from dstack_tpu.agent import schemas as a_schemas
+        from dstack_tpu.agent.python.runner import Executor
+
+        r = Executor(_P(tmp_path))
+        r.submit(a_schemas.SubmitBody(
+            run_name="x", job_name="x-0-0", job_spec={},
+            secrets={"API_KEY": "sk-sekret-123"},
+        ))
+        assert "sk-sekret-123" not in r._redact(
+            "error: auth failed with token sk-sekret-123"
+        )
+
+
+class TestRegistryAuthInterpolation:
+    def test_secrets_resolve_into_credentials(self):
+        from dstack_tpu.core.models.common import RegistryAuth
+        from dstack_tpu.server.background.tasks.process_running_jobs import (
+            _interpolate_registry_auth,
+        )
+
+        ra = _interpolate_registry_auth(
+            RegistryAuth(username="bot", password="${{ secrets.REG_TOKEN }}"),
+            {"REG_TOKEN": "tok-1"},
+        )
+        assert ra.username == "bot" and ra.password == "tok-1"
+        assert _interpolate_registry_auth(None, {}) is None
+
+    def test_unknown_secret_name_raises(self):
+        import pytest
+
+        from dstack_tpu.core.models.common import RegistryAuth
+        from dstack_tpu.server.background.tasks.process_running_jobs import (
+            _interpolate_registry_auth,
+        )
+        from dstack_tpu.utils.interpolator import InterpolatorError
+
+        with pytest.raises(InterpolatorError):
+            _interpolate_registry_auth(
+                RegistryAuth(username="bot", password="${{ secrets.NOPE }}"),
+                {"REG_TOKEN": "tok-1"},
+            )
+
+    async def test_env_value_secret_interpolation(self, tmp_path):
+        """``env: TOKEN: ${{ secrets.X }}`` resolves server-side before
+        the runner sees the spec (the docs' HF_TOKEN pattern)."""
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="e2e-token",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            await client.post(
+                "/api/project/main/secrets/create",
+                headers=_auth("e2e-token"),
+                json={"name": "hf_token", "value": "hf-xyz-789"},
+            )
+            body = {
+                "run_spec": {
+                    "run_name": "e2e-envsecret",
+                    "configuration": {
+                        "type": "task",
+                        "env": {"HF_TOKEN": "${{ secrets.hf_token }}"},
+                        "commands": ['echo "tok=$HF_TOKEN"'],
+                    },
+                    "ssh_key_pub": "ssh-ed25519 AAAA test",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth("e2e-token"), json=body
+            )
+            assert r.status == 200
+            run = await _wait_run_status(
+                client, "e2e-token", "e2e-envsecret", ("done", "failed", "terminated")
+            )
+            assert run["status"] == "done", run
+            r = await client.post(
+                "/api/project/main/logs/poll", headers=_auth("e2e-token"),
+                json={"run_name": "e2e-envsecret"},
+            )
+            import base64 as b64
+
+            text = "".join(
+                b64.b64decode(e["message"]).decode()
+                for e in (await r.json())["logs"]
+            )
+            assert "tok=hf-xyz-789" in text
+        finally:
+            await client.close()
+
+    def test_mixed_namespace_env_value_keeps_other_templates(self):
+        """${{ secrets.X }} substitutes; ${{ other.y }} in the SAME
+        value passes through literally (the job's own templating)."""
+        from dstack_tpu.utils.interpolator import substitute_secrets
+
+        out, problems = substitute_secrets(
+            "${{ secrets.tok }}-${{ custom.thing }}", {"tok": "abc"}
+        )
+        assert out == "abc-${{ custom.thing }}" and problems == []
+
+    def test_decrypt_failure_distinct_from_not_found(self):
+        from dstack_tpu.utils.interpolator import substitute_secrets
+
+        _, p1 = substitute_secrets("${{ secrets.gone }}", {})
+        _, p2 = substitute_secrets("${{ secrets.corrupt }}", {"corrupt": None})
+        assert "not found" in p1[0]
+        assert "failed to decrypt" in p2[0]
