@@ -113,7 +113,11 @@ class Executor:
                 state=state,
                 timestamp=time.time(),
                 termination_reason=reason,
-                termination_message=message,
+                # centralized scrub (parity with runner.cpp
+                # push_state_locked): call sites can't forget it
+                termination_message=(
+                    self._redact(message) if message else message
+                ),
                 exit_status=exit_status,
             )
         )
@@ -124,7 +128,9 @@ class Executor:
         )
 
     def _rlog(self, text: str) -> None:
-        self.runner_logs.append(LogEvent.create(datetime.now(timezone.utc), text))
+        self.runner_logs.append(
+            LogEvent.create(datetime.now(timezone.utc), self._redact(text))
+        )
 
     # -- lifecycle --
 

@@ -175,10 +175,17 @@ def _interpolate_registry_auth(registry_auth, secrets: dict):
     password, p2 = substitute_secrets(registry_auth.password or "", secrets)
     if p1 or p2:
         raise InterpolatorError("; ".join(p1 + p2))
+    # keep substituted values unconditionally (an EMPTY secret resolves
+    # to "" — falling back to the raw template would leak it to the
+    # registry); only None-ness of the original field is preserved
     return registry_auth.model_copy(
         update={
-            "username": username or registry_auth.username,
-            "password": password or registry_auth.password,
+            "username": (
+                username if registry_auth.username is not None else None
+            ),
+            "password": (
+                password if registry_auth.password is not None else None
+            ),
         }
     )
 
@@ -456,16 +463,11 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
     store: dict = {}
     if wanted or env_refs:
         store = await _get_project_secrets(db, run_row["project_id"])
+    from dstack_tpu.utils.interpolator import classify_secret_problem
+
     job_secrets = {n: store[n] for n in wanted if store.get(n) is not None}
     problems = [
-        (
-            f"{n} exists but failed to decrypt (server encryption key "
-            "changed?)"
-            if n in store
-            else f"{n} not found in project"
-        )
-        for n in wanted
-        if store.get(n) is None
+        p for p in (classify_secret_problem(n, store) for n in wanted) if p
     ]
     redact_values: list = []
     if env_refs and not problems:

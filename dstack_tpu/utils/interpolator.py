@@ -78,15 +78,23 @@ def substitute_secrets(text: str, store: dict) -> tuple[str, list[str]]:
         if not (expr.startswith("secrets.") and expr.count(".") == 1):
             return m.group(0)
         name = expr.split(".", 1)[1]
-        if name not in store:
-            problems.append(f"{name} not found in project")
-            return m.group(0)
-        if store[name] is None:
-            problems.append(
-                f"{name} exists but failed to decrypt (server encryption "
-                "key changed?)"
-            )
+        problem = classify_secret_problem(name, store)
+        if problem:
+            problems.append(problem)
             return m.group(0)
         return store[name]
 
     return _VAR_RE.sub(repl, text or ""), problems
+
+
+def classify_secret_problem(name: str, store: dict) -> Optional[str]:
+    """One wording for secret-resolution failures everywhere: None when
+    resolvable, else the user-facing diagnostic."""
+    if name not in store:
+        return f"{name} not found in project"
+    if store[name] is None:
+        return (
+            f"{name} exists but failed to decrypt (server encryption "
+            "key changed?)"
+        )
+    return None

@@ -430,3 +430,64 @@ class TestCppShim:
             proc.terminate()
             proc.wait(timeout=5)
             await md.close()
+
+    async def test_volume_prep_creates_mount_dirs(
+        self, agent_binaries, tmp_path
+    ):
+        """C++ shim prepare_volumes: mount dirs created before the task
+        starts; absent devices skipped; unsafe names fail the task —
+        parity with the python shim."""
+        runner_bin, shim_bin = agent_binaries
+        port = _free_port()
+        proc = subprocess.Popen(
+            [
+                str(shim_bin),
+                "--port", str(port),
+                "--base-dir", str(tmp_path),
+                "--runtime", "process",
+                "--runner-bin", str(runner_bin),
+            ],
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            await _wait_port(port)
+            mnt = tmp_path / "disks" / "data-0"
+            req = schemas.TaskSubmitRequest(
+                id="t-vol", name="volt",
+                volumes=[{
+                    "name": "data-0", "volume_id": "disk-data-0",
+                    "mount_dir": str(mnt),
+                }],
+            )
+            status, _ = await _request(
+                port, "POST", "/api/tasks", json_body=req.model_dump()
+            )
+            assert status == 200
+            for _ in range(100):
+                if mnt.is_dir():
+                    break
+                await asyncio.sleep(0.05)
+            assert mnt.is_dir()
+
+            # shell-unsafe mount dir → task must FAIL, not execute it
+            req = schemas.TaskSubmitRequest(
+                id="t-evil", name="evil",
+                volumes=[{
+                    "name": "x", "volume_id": "",
+                    "mount_dir": str(tmp_path) + "/a'; touch /tmp/pwn; '",
+                }],
+            )
+            status, _ = await _request(
+                port, "POST", "/api/tasks", json_body=req.model_dump()
+            )
+            assert status == 200
+            for _ in range(100):
+                s2, info = await _request(port, "GET", "/api/tasks/t-evil")
+                if info["status"] == "terminated":
+                    break
+                await asyncio.sleep(0.05)
+            assert info["status"] == "terminated"
+            assert "unsafe" in (info.get("termination_message") or "")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
