@@ -310,7 +310,7 @@ class Executor:
             await self._setup_repo(workdir)
         except Exception as e:
             self._push_state(
-                "failed", reason="executor_error", message=self._redact(str(e))
+                "failed", reason="executor_error", message=str(e)
             )
             return
 
@@ -344,7 +344,7 @@ class Executor:
             )
         except FileNotFoundError as e:
             self._push_state(
-                "failed", reason="executor_error", message=self._redact(str(e))
+                "failed", reason="executor_error", message=str(e)
             )
             return
 

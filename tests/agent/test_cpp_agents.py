@@ -468,6 +468,15 @@ class TestCppShim:
                     break
                 await asyncio.sleep(0.05)
             assert mnt.is_dir()
+            # absent device must be SKIPPED, not fail the task: the
+            # task proceeds to run (and completes, no commands)
+            for _ in range(100):
+                s1, info = await _request(port, "GET", "/api/tasks/t-vol")
+                if info["status"] in ("running", "terminated"):
+                    break
+                await asyncio.sleep(0.05)
+            assert info["status"] in ("running", "terminated")
+            assert "unsafe" not in (info.get("termination_message") or "")
 
             # shell-unsafe mount dir → task must FAIL, not execute it
             req = schemas.TaskSubmitRequest(
