@@ -161,6 +161,10 @@ class Shim {
 
   Value submit(const Value& req, std::string& error) {
     std::lock_guard<std::mutex> lk(mu_);
+    if (shutting_down_) {
+      error = "shim is shutting down";
+      return Value(nullptr);
+    }
     std::string id = req["id"].as_string();
     if (tasks_.count(id)) {
       error = "task exists";
@@ -222,6 +226,11 @@ class Shim {
     return t.info();
   }
 
+  void begin_shutdown() {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutting_down_ = true;  // new submits are rejected from here on
+  }
+
   void set_interruption(const std::string& notice) {
     std::lock_guard<std::mutex> lk(mu_);
     interruption_ = notice;
@@ -271,6 +280,7 @@ class Shim {
   std::map<std::string, Task> tasks_;
   int next_port_ = 11000;
   std::string interruption_;  // metadata watcher notice (empty = none)
+  bool shutting_down_ = false;
 
   void set_status(const std::string& id, TaskStatus to) {
     std::lock_guard<std::mutex> lk(mu_);
@@ -391,17 +401,20 @@ class Shim {
             "--home", home.c_str(), nullptr);
       _exit(127);
     }
+    {
+      // record the pid IMMEDIATELY: a shutdown racing this startup
+      // must find something to kill, or the runner is orphaned with
+      // its port bound (poisoning the next shim on the host)
+      std::lock_guard<std::mutex> lk(mu_);
+      Task& t = tasks_[id];
+      t.runner_pid = pid;
+      t.container_name = "proc-" + std::to_string(pid);
+    }
     // wait for the runner port
     for (int i = 0; i < 100; i++) {
       auto r = dtpu::http::Client::request_tcp("127.0.0.1", runner_port, "GET",
                                                "/api/healthcheck");
-      if (r.status == 200) {
-        std::lock_guard<std::mutex> lk(mu_);
-        Task& t = tasks_[id];
-        t.runner_pid = pid;
-        t.container_name = "proc-" + std::to_string(pid);
-        break;
-      }
+      if (r.status == 200) break;
       int status;
       if (waitpid(pid, &status, WNOHANG) == pid) {
         fail_task(id, "runner exited early");
@@ -679,5 +692,16 @@ int main(int argc, char** argv) {
   signal(SIGTERM, [](int) { stop = true; });
   signal(SIGINT, [](int) { stop = true; });
   while (!stop) std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  // shutdown: reject new submits, then stop child runners CONCURRENTLY
+  // (same pattern as the interruption watcher) — orphaned runners
+  // would keep their ports bound and poison the next shim on this host
+  shim->begin_shutdown();
+  std::vector<std::thread> stops;
+  for (const auto& id : shim->task_ids())
+    stops.emplace_back([shim, id] {
+      bool found = false;
+      shim->terminate(id, 2, "terminated_by_server", found);
+    });
+  for (auto& t : stops) t.join();
   return 0;
 }
