@@ -23,6 +23,7 @@ def run_bench(
     spec_draft: int = 0,
     repetitive: bool = False,
     quantize=None,
+    turbo_steps: int = 8,
 ) -> dict:
     """Measure the engine directly → result dict (importable core;
     the root ``bench.py`` embeds this next to the training number)."""
@@ -40,7 +41,7 @@ def run_bench(
         params = quantize_tree(params, config)
     eng = InferenceEngine(
         config, params, max_batch=batch, max_seq=max_seq,
-        spec_draft=spec_draft,
+        spec_draft=spec_draft, turbo_steps=turbo_steps,
     )
     rng = np.random.default_rng(0)
     if repetitive:
@@ -56,12 +57,17 @@ def run_bench(
         ]
 
     # warmup compiles every kernel the timed sections will hit: the
-    # full-length prompt's prefill chunks, the plain decode step, and
-    # (with --spec-draft) the speculative verify step — otherwise
+    # full-length prompt's prefill chunks, the decode path at the SAME
+    # generation length (the turbo macro-step is budget-capped to
+    # power-of-2 step counts, so a short warmup would leave the timed
+    # loop's longer decode_loop variants uncompiled), and (with
+    # --spec-draft) the speculative verify step — otherwise
     # multi-second XLA compiles land inside the TTFT/throughput numbers
     spec = eng.spec_draft
-    eng.spec_draft = 0  # force the plain decode to compile
-    slot, _ = eng.add_request(list(prompts[0]), GenParams(max_new_tokens=3))
+    eng.spec_draft = 0  # force the plain/turbo decode to compile
+    slot, _ = eng.add_request(
+        list(prompts[0]), GenParams(max_new_tokens=gen_len)
+    )
     while eng.active[slot]:
         eng.step()
     eng.release(slot)
@@ -107,6 +113,7 @@ def run_bench(
             "tokens": tokens,
             "tokens_per_step": round(tokens / max(steps, 1), 2),
             "spec_draft": spec_draft,
+            "turbo_steps": turbo_steps,
             "quantize": quantize,
             "backend": jax.default_backend(),
         },
@@ -128,6 +135,10 @@ def main(argv=None) -> int:
              "random prompts measure the no-speculation floor",
     )
     p.add_argument("--quantize", default=None, choices=["int8"])
+    p.add_argument(
+        "--turbo-steps", type=int, default=8,
+        help="device-side decode steps per dispatch (0/1 = per-token)",
+    )
     p.add_argument("--platform", default=None)
     args = p.parse_args(argv)
 
@@ -145,6 +156,7 @@ def main(argv=None) -> int:
         spec_draft=args.spec_draft,
         repetitive=args.repetitive,
         quantize=args.quantize,
+        turbo_steps=args.turbo_steps,
     )
     print(json.dumps(result))
     return 0

@@ -357,6 +357,57 @@ def decode_step(
     return _head_logits(params, x[:, 0], c), cache
 
 
+def decode_loop(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B] int32: last sampled token per slot
+    positions: jax.Array,  # [B] int32 current lengths
+    remaining: jax.Array,  # [B] int32 generation budget left
+    active: jax.Array,  # [B] bool
+    eos_ids: jax.Array,  # [B] int32 (-1 = no EOS)
+    config: LlamaConfig,
+    *,
+    steps: int,  # static: decode steps per macro-step
+    max_seq: int,  # static: cache row length
+) -> tuple[jax.Array, dict, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """``steps`` greedy decode steps entirely on device → (emitted
+    [steps, B] int32 with -1 for inactive rows, cache, last token,
+    positions, remaining, active).
+
+    The macro-step is the latency-hiding design for serving: one
+    dispatch (and ONE host↔device round trip) advances every slot
+    ``steps`` tokens, where the step-at-a-time loop pays a blocking
+    transfer per token — under a remote/tunneled device that transfer
+    dominates decode wall-clock entirely, and even locally the scan
+    removes per-step dispatch overhead and lets XLA overlap the next
+    step's compute with the emission buffer. Greedy-only (argmax rides
+    inside the jit); sampled requests use the per-step path where the
+    sampler sees live penalty state. Per-slot EOS/budget/cache-end
+    deactivation happens on device so a finished slot stops writing
+    K/V mid-loop (same write_mask guard as :func:`decode_step`).
+    """
+
+    def body(carry, _):
+        cache, tok, pos, rem, act = carry
+        logits, cache = decode_step(
+            params, cache, tok, pos, config, write_mask=act
+        )
+        new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = jnp.where(act, new_tok, tok)
+        step = act.astype(jnp.int32)
+        pos = pos + step
+        rem = rem - step
+        emitted = jnp.where(act, tok, -1)
+        act = act & (tok != eos_ids) & (rem > 0) & (pos < max_seq - 1)
+        return (cache, tok, pos, rem, act), emitted
+
+    (cache, tok, pos, rem, act), toks = jax.lax.scan(
+        body, (cache, tokens, positions, remaining, active), None,
+        length=steps,
+    )
+    return toks, cache, tok, pos, rem, act
+
+
 def verify_step(
     params: dict,
     cache: dict,
@@ -604,6 +655,7 @@ class InferenceEngine:
         mesh=None,
         prefill_chunk: int = 256,
         spec_draft: int = 4,
+        turbo_steps: int = 8,
     ):
         """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
         params shard per the model's logical rules (heads/mlp/vocab over
@@ -677,6 +729,9 @@ class InferenceEngine:
         # one per prompt-length bucket; between chunks the scheduler can
         # run decode steps for other slots
         self.prefill_chunk = max(16, min(prefill_chunk, max_seq))
+        # device-side macro-steps for all-greedy batches (see
+        # decode_loop): K tokens per dispatch/transfer. 0/1 = per-step.
+        self.turbo_steps = max(0, turbo_steps)
 
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
@@ -688,6 +743,7 @@ class InferenceEngine:
             partial(verify_step, config=config), donate_argnums=(1,)
         )
         self._sample = jax.jit(sample)
+        self._turbo_fns: dict = {}  # steps → jitted decode_loop
         self._argmax = jax.jit(partial(jnp.argmax, axis=-1))
         self._logprobs = jax.jit(token_logprobs)
         self._mark_seen = jax.jit(_mark_seen, donate_argnums=(0, 1))
@@ -901,10 +957,13 @@ class InferenceEngine:
             # speculate only when at least half the batch drafts
             if drafting and drafting * 2 >= len(live):
                 return self._spec_step(live, drafts)
-        out = self._plain_step(live)
-        for i, tok in out.items():
-            self._record_tokens(i, [tok])
-        return {i: [tok] for i, tok in out.items()}
+        if (
+            self.turbo_steps > 1
+            and not self._prefilling  # don't starve queued prompt chunks
+            and self._all_greedy(live)
+        ):
+            return self._turbo_step(live)
+        return {i: [tok] for i, tok in self._plain_step(live).items()}
 
     def _spec_step(self, live: list, drafts: dict) -> dict:
         """One verify_step call emits 1..spec_draft+1 tokens per slot."""
@@ -943,26 +1002,68 @@ class InferenceEngine:
                     self._spec_off[i] = True
             toks = []
             for tok in emitted:
-                if self.remaining[i] <= 0 or self.lengths[i] >= self.max_seq - 1:
-                    break
                 toks.append(tok)
-                self.lengths[i] += 1
-                self.remaining[i] -= 1
-                self._record_tokens(i, [tok])
-                if tok == self.eos[i]:
-                    self.active[i] = False
-                    self.finish_reason[i] = "stop"
+                if not self._advance_slot(i, tok):
                     break
-            if self.active[i] and (
-                self.remaining[i] <= 0 or self.lengths[i] >= self.max_seq - 1
-            ):
-                self.active[i] = False
-                self.finish_reason[i] = "length"
             if toks:
-                self.last_token[i] = toks[-1]
                 out[i] = toks
             # note: _seen is not updated here — the spec path is gated
             # to repetition_penalty == 1.0, where seen has no effect
+        return out
+
+    def _turbo_fn(self, steps: int):
+        if steps not in self._turbo_fns:
+            self._turbo_fns[steps] = jax.jit(
+                partial(
+                    decode_loop, config=self.config, steps=steps,
+                    max_seq=self.max_seq,
+                ),
+                donate_argnums=(1,),
+            )
+        return self._turbo_fns[steps]
+
+    def _turbo_step(self, live: list) -> dict:
+        """One decode_loop macro-step → {slot: [tokens]}. The host
+        replays the device's per-step deactivation rules token by token
+        so lengths/remaining/finish_reason stay exactly as ``steps``
+        sequential :meth:`_plain_step` calls would have left them."""
+        # cap the loop by the widest live budget (a near-finished batch
+        # must not pay turbo_steps masked forward passes for one
+        # token), bucketed to powers of two so the compile-cache holds
+        # at most log2(turbo_steps) variants
+        needed = min(self.turbo_steps, max(self.remaining[i] for i in live))
+        steps = 1
+        while steps < needed:
+            steps *= 2
+        steps = min(steps, self.turbo_steps)
+        eos = [
+            self.eos[i] if self.eos[i] is not None else -1
+            for i in range(self.max_batch)
+        ]
+        toks_dev, self.cache, _, _, _, _ = self._turbo_fn(steps)(
+            self.params,
+            self.cache,
+            jnp.asarray(self.last_token, jnp.int32),
+            jnp.asarray(self.lengths, jnp.int32),
+            jnp.asarray(self.remaining, jnp.int32),
+            jnp.asarray(self.active, bool),
+            jnp.asarray(eos, jnp.int32),
+        )
+        toks = jax.device_get(toks_dev)  # [steps, B]
+        out: dict = {}
+        for i in live:
+            emitted: list = []
+            for k in range(steps):
+                tok = int(toks[k][i])
+                if tok < 0:  # row deactivated on an earlier step
+                    break
+                emitted.append(tok)
+                if not self._advance_slot(i, tok):
+                    break
+            if emitted:
+                out[i] = emitted
+            # _seen is not updated here — turbo is gated to slots with
+            # no penalties, where the counts can't affect sampling
         return out
 
     def _all_greedy(self, live: list) -> bool:
@@ -1017,21 +1118,32 @@ class InferenceEngine:
                     )
         return self._emit(live, jax.device_get(sampled_dev))
 
+    def _advance_slot(self, i: int, tok: int) -> bool:
+        """Publish ONE sampled token for slot ``i`` — the single copy
+        of the per-token bookkeeping shared by the plain, speculative,
+        and turbo emission paths: length/budget accounting, history for
+        the n-gram draft index, and the eos→stop / budget→length
+        finish rules (eos wins when both hit on the same token).
+        Returns whether the slot is still active."""
+        self.lengths[i] += 1
+        self.remaining[i] -= 1
+        self.last_token[i] = tok
+        self._record_tokens(i, [tok])
+        if tok == self.eos[i]:
+            self.active[i] = False
+            self.finish_reason[i] = "stop"
+        elif self.remaining[i] <= 0 or self.lengths[i] >= self.max_seq - 1:
+            self.active[i] = False
+            self.finish_reason[i] = "length"
+        return self.active[i]
+
     def _emit(self, live: list, sampled) -> dict[int, int]:
         """Publish one sampled token per live slot (host bookkeeping)."""
         out: dict[int, int] = {}
         for i in live:
             tok = int(sampled[i])
-            self.lengths[i] += 1
-            self.last_token[i] = tok
             out[i] = tok
-            self.remaining[i] -= 1
-            if tok == self.eos[i]:
-                self.active[i] = False
-                self.finish_reason[i] = "stop"
-            elif self.remaining[i] <= 0 or self.lengths[i] >= self.max_seq - 1:
-                self.active[i] = False
-                self.finish_reason[i] = "length"
+            self._advance_slot(i, tok)
         return out
 
     def take_logprobs(self, slot: int):

@@ -729,6 +729,12 @@ def main(argv=None) -> int:
         help="prompt-lookup speculative decoding draft length for greedy "
              "requests (0 disables)",
     )
+    p.add_argument(
+        "--turbo-steps", type=int, default=8,
+        help="device-side decode steps per dispatch for all-greedy "
+             "batches (amortizes the host round trip; 0/1 disables — "
+             "streaming then delivers token-by-token)",
+    )
     args = p.parse_args(argv)
 
     from dstack_tpu.utils.logging import configure_logging
@@ -828,6 +834,7 @@ def main(argv=None) -> int:
     engine = InferenceEngine(
         config, params, max_batch=args.max_batch, max_seq=args.max_seq,
         mesh=mesh, spec_draft=args.spec_draft,
+        turbo_steps=args.turbo_steps,
     )
     tokenizer = load_tokenizer(args.tokenizer or "byte")
     app = build_app(engine, tokenizer, args.model, args.chat_template)

@@ -33,8 +33,13 @@ class TestDecode:
 
     def test_continuous_batching_interleaves(self):
         """A request admitted mid-decode of another must not perturb
-        either stream (per-slot cache isolation + masks)."""
-        eng = InferenceEngine(self.config, self.params, max_batch=4, max_seq=64)
+        either stream (per-slot cache isolation + masks). Turbo off:
+        the scenario needs s1 still mid-stream when s2 joins, and a
+        macro-step would finish s1's whole budget in one call
+        (TestTurboDecode covers the macro-step path)."""
+        eng = InferenceEngine(
+            self.config, self.params, max_batch=4, max_seq=64, turbo_steps=0
+        )
         p1 = [10, 20, 30, 40, 50]
         p2 = [400, 3, 77]
         ref1 = _reference_greedy(self.params, self.config, p1, 6)
@@ -359,6 +364,98 @@ class TestSpeculativeDecoding:
         eng._ngram_ix[0] = {}
         eng._record_tokens(0, [9, 9, 1, 7])
         assert eng._find_draft(0) == []  # no earlier (1,7)
+
+
+class TestTurboDecode:
+    """Device-side decode macro-steps (decode_loop) must be invisible
+    except for emission granularity: same tokens, same finish reasons,
+    same per-slot bookkeeping as the per-step path."""
+
+    config = llama.LLAMA_TINY
+
+    def setup_method(self):
+        self.params = llama.init_params(self.config, jax.random.key(0))
+
+    def _engine(self, turbo: int, **kw):
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("max_seq", 64)
+        return InferenceEngine(
+            self.config, self.params, spec_draft=0, turbo_steps=turbo, **kw
+        )
+
+    def test_matches_per_step_path(self):
+        prompt = [5, 99, 321, 7, 250]
+        on = self._engine(8)
+        off = self._engine(0)
+        g = lambda: GenParams(max_new_tokens=13)  # noqa: E731
+        assert on.generate(prompt, g()) == off.generate(prompt, g())
+
+    def test_multi_token_emission_and_budget(self):
+        eng = self._engine(4)
+        slot, first = eng.add_request([3, 1, 4, 1, 5], GenParams(max_new_tokens=10))
+        calls, got = 0, [first]
+        while eng.active[slot]:
+            out = eng.step()
+            calls += 1
+            got.extend(out.get(slot, []))
+        # 9 post-prefill tokens over 4-step macro-steps: ≤ 3 dispatches
+        assert calls <= 3
+        assert len(got) == 10
+        assert eng.finish_reason[slot] == "length"
+
+    def test_eos_mid_macro_step(self):
+        prompt = [5, 99, 321]
+        ref = _reference_greedy(self.params, self.config, prompt, 4)
+        eng = self._engine(8)
+        slot, first = eng.add_request(
+            prompt, GenParams(max_new_tokens=10, eos_id=ref[3])
+        )
+        got = [first]
+        while eng.active[slot]:
+            got.extend(eng.step().get(slot, []))
+        # emission stops AT the eos token, exactly like _emit
+        assert got == ref[:4]
+        assert eng.finish_reason[slot] == "stop"
+        # device stopped writing this row mid-loop: lengths match host
+        # (the first token was sampled at prefill; 3 decode increments)
+        assert eng.lengths[slot] == len(prompt) + 3
+
+    def test_slots_finish_on_different_steps(self):
+        eng = self._engine(8, max_batch=2)
+        p1, p2 = [10, 20, 30], [400, 3, 77, 9]
+        ref1 = _reference_greedy(self.params, self.config, p1, 3)
+        ref2 = _reference_greedy(self.params, self.config, p2, 9)
+        s1, t1 = eng.add_request(p1, GenParams(max_new_tokens=3))
+        s2, t2 = eng.add_request(p2, GenParams(max_new_tokens=9))
+        got1, got2 = [t1], [t2]
+        while eng.active[s1] or eng.active[s2]:
+            out = eng.step()
+            got1.extend(out.get(s1, []))
+            got2.extend(out.get(s2, []))
+        # s1 exhausts its budget mid-macro-step; s2 decodes on (the
+        # deactivated row must neither emit nor corrupt s2's stream)
+        assert got1 == ref1
+        assert got2 == ref2
+
+    def test_sampled_batch_bypasses_turbo(self):
+        eng = self._engine(8, max_batch=1, max_seq=128)
+        slot, _ = eng.add_request(
+            [5, 6, 7, 8], GenParams(max_new_tokens=6, temperature=1.0, seed=3)
+        )
+        while eng.active[slot]:
+            out = eng.step()
+            for toks in out.values():
+                assert len(toks) == 1  # per-step sampler path only
+
+    def test_turbo_waits_for_pending_prefill(self):
+        eng = self._engine(8, max_batch=2, max_seq=256, prefill_chunk=32)
+        s1, _ = eng.add_request([3, 14, 15], GenParams(max_new_tokens=20))
+        # a long prompt is mid-chunk: decode must stay per-step so the
+        # scheduler can interleave the remaining chunks
+        s2 = eng.start_request(list(range(1, 97)), GenParams(max_new_tokens=4))
+        out = eng.step()
+        assert len(out.get(s1, [])) == 1
+        assert s2 in eng.prefilling_slots()
 
 
 class TestPenaltyScopes:
