@@ -217,26 +217,59 @@ async def apply_yaml(ctx: RequestContext, body: s.ApplyYamlRequest):
     except Exception as e:
         raise ClientError(f"invalid configuration: {e}")
     db = ctx.state["db"]
+    # resource configs: ONE service call serves both preview and apply
+    # (dry_run runs the full validation incl. name uniqueness and stops
+    # before creating), so the preview can't assert a validity the
+    # apply path would contradict
     if isinstance(conf, FleetConfiguration):
         from dstack_tpu.server.services.fleets import apply_fleet as _apply_fleet
 
-        fleet = await _apply_fleet(db, ctx.project, ctx.user, conf)
+        fleet = await _apply_fleet(
+            db, ctx.project, ctx.user, conf, dry_run=body.plan_only
+        )
+        if body.plan_only:
+            return {"kind": "fleet", "name": conf.name, "plan": {"valid": True}}
         return {"kind": "fleet", "name": fleet.name}
     if isinstance(conf, VolumeConfiguration):
         from dstack_tpu.server.services.volumes import apply_volume as _apply
 
-        vol = await _apply(db, ctx.project, ctx.user, conf)
+        vol = await _apply(db, ctx.project, ctx.user, conf, dry_run=body.plan_only)
+        if body.plan_only:
+            return {"kind": "volume", "name": conf.name, "plan": {"valid": True}}
         return {"kind": "volume", "name": vol.name}
     if isinstance(conf, GatewayConfiguration):
         from dstack_tpu.server.services.gateways import create_gateway as _create
 
-        gw = await _create(db, ctx.project, conf)
+        gw = await _create(db, ctx.project, conf, dry_run=body.plan_only)
+        if body.plan_only:
+            return {"kind": "gateway", "name": conf.name, "plan": {"valid": True}}
         return {"kind": "gateway", "name": gw.name}
+    # run configs: plan once (config-time validation — mesh/multislice
+    # limits — fails HERE with a clear message rather than as a dead
+    # run); preview returns the plan, apply submits without re-pricing
     run_spec = RunSpec(run_name=body.name or conf.name, configuration=conf)
-    # plan first: config-time validation (mesh/multislice limits) fails
-    # HERE with a clear message rather than as a dead run; submit can
-    # then skip re-validating offers
-    await runs_service.get_plan(db, ctx.project, ctx.user, run_spec)
+    plan = await runs_service.get_plan(db, ctx.project, ctx.user, run_spec)
+    if body.plan_only:
+        jp = plan.job_plans[0] if plan.job_plans else None
+        return {
+            "kind": "run",
+            "name": run_spec.run_name,
+            "plan": {
+                "jobs": len(plan.job_plans),
+                "total_offers": jp.total_offers if jp else 0,
+                "max_price": jp.max_price if jp else None,
+                "offers": [
+                    {
+                        "backend": str(o.backend.value if hasattr(o.backend, "value") else o.backend),
+                        "instance_type": o.instance.name,
+                        "region": o.region,
+                        "spot": o.instance.resources.spot,
+                        "price": o.price,
+                    }
+                    for o in (jp.offers[:10] if jp else [])
+                ],
+            },
+        }
     run = await runs_service.submit_run(
         db, ctx.project, ctx.user, run_spec, validate_offers=False
     )
@@ -496,6 +529,41 @@ async def upload_code(ctx: RequestContext):
     await repos_service.upload_code(
         ctx.state["db"], ctx.project["id"], repo_id, blob_hash, blob
     )
+
+
+@project_router.post("/offers/list")
+async def list_offers(ctx: RequestContext, body: s.ListOffersRequest):
+    """Browse the TPU slice catalog (the console's Offers page; the
+    server-side analog of `dtpu offer`, reference gpuhunt catalog)."""
+    from dstack_tpu.core.catalog.tpu import query_slices
+    from dstack_tpu.core.errors import ClientError
+    from dstack_tpu.core.models.resources import IntRange, ResourcesSpec, TPUSpec
+
+    try:
+        tpu = TPUSpec(
+            version=[body.version] if body.version else None,
+            chips=IntRange(min=body.min_chips or 1, max=body.max_chips),
+        )
+    except ValueError as e:
+        raise ClientError(str(e))
+    # query_slices is the CLI's filter (`dtpu offer`): same semantics,
+    # and sorted (price, chips, region) so the limit keeps the cheapest
+    items = query_slices(ResourcesSpec(tpu=tpu), spot=body.spot)
+    return {
+        "offers": [
+            {
+                "instance_name": item.instance_name,
+                "version": item.version,
+                "topology": item.topology,
+                "chips": item.chips,
+                "hosts": item.hosts,
+                "region": item.region,
+                "spot": item.spot,
+                "price": item.price,
+            }
+            for item in items[: body.limit]
+        ]
+    }
 
 
 # ---- metrics ----

@@ -6,6 +6,8 @@ kept together here — the models are thin).
 
 from typing import Optional
 
+from pydantic import Field
+
 from dstack_tpu.core.models.backends import BackendType
 from dstack_tpu.core.models.common import CoreModel
 from dstack_tpu.core.models.configurations import (
@@ -54,6 +56,19 @@ class ApplyYamlRequest(CoreModel):
 
     yaml: str
     name: Optional[str] = None  # run name override
+    # plan-preview: validate + price the config, submit nothing (the
+    # browser's analog of `dtpu apply`'s confirmation prompt)
+    plan_only: bool = False
+
+
+class ListOffersRequest(CoreModel):
+    """Browse the TPU slice catalog (console Offers page / `dtpu offer`)."""
+
+    version: Optional[str] = None
+    min_chips: Optional[int] = None
+    max_chips: Optional[int] = None
+    spot: Optional[bool] = None
+    limit: int = Field(200, ge=1, le=1000)
 
 
 class GetRunPlanRequest(CoreModel):

@@ -63,8 +63,11 @@ async def list_fleets(db: Database, project_row: dict) -> list[Fleet]:
 
 
 async def apply_fleet(
-    db: Database, project_row: dict, user_row: dict, conf: FleetConfiguration
-) -> Fleet:
+    db: Database, project_row: dict, user_row: dict, conf: FleetConfiguration,
+    dry_run: bool = False,
+) -> Optional[Fleet]:
+    """``dry_run``: validate (incl. name uniqueness) without creating —
+    shared by the console's plan preview."""
     name = conf.name or f"fleet-{new_uuid()[:8]}"
     existing = await db.fetchone(
         "SELECT id FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
@@ -72,6 +75,8 @@ async def apply_fleet(
     )
     if existing is not None:
         raise ClientError(f"fleet {name} already exists")
+    if dry_run:
+        return None
     fleet_id = new_uuid()
     await db.insert(
         "fleets",

@@ -54,8 +54,11 @@ async def list_gateways(db: Database, project_row: dict) -> list[Gateway]:
 
 
 async def create_gateway(
-    db: Database, project_row: dict, conf: GatewayConfiguration
-) -> Gateway:
+    db: Database, project_row: dict, conf: GatewayConfiguration,
+    dry_run: bool = False,
+) -> Optional[Gateway]:
+    """``dry_run``: validate (incl. name uniqueness) without creating —
+    shared by the console's plan preview."""
     name = conf.name or f"gateway-{new_uuid()[:8]}"
     existing = await db.fetchone(
         "SELECT id FROM gateways WHERE project_id = ? AND name = ?",
@@ -63,6 +66,8 @@ async def create_gateway(
     )
     if existing is not None:
         raise ClientError(f"gateway {name} already exists")
+    if dry_run:
+        return None
     any_gateway = await db.fetchone(
         "SELECT id FROM gateways WHERE project_id = ?", (project_row["id"],)
     )

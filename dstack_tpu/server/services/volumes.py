@@ -62,8 +62,12 @@ async def list_volumes(db: Database, project_row: dict) -> list[Volume]:
 
 
 async def apply_volume(
-    db: Database, project_row: dict, user_row: dict, conf: VolumeConfiguration
-) -> Volume:
+    db: Database, project_row: dict, user_row: dict, conf: VolumeConfiguration,
+    dry_run: bool = False,
+) -> Optional[Volume]:
+    """``dry_run`` runs the full validation (name rules + uniqueness)
+    and stops before creating anything — the console's plan preview
+    shares this exact path so preview and apply can't drift."""
     try:
         conf.validate_name()
     except ValueError as e:
@@ -75,6 +79,8 @@ async def apply_volume(
     )
     if existing is not None:
         raise ClientError(f"volume {name} already exists")
+    if dry_run:
+        return None
     row = {
         "id": new_uuid(),
         "project_id": project_row["id"],

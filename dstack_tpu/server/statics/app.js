@@ -71,6 +71,7 @@ const PAGES = [
   ["instances", "Instances"],
   ["volumes", "Volumes"],
   ["gateways", "Gateways"],
+  ["offers", "Offers"],
   ["repos", "Repos"],
   ["secrets", "Secrets"],
   ["project", "Project"],
@@ -82,29 +83,114 @@ function visiblePages() {
     id !== "users" || state.user?.global_role === "admin");
 }
 
-/* Collapsible paste-a-YAML panel: the browser's `dtpu apply -f`.
-   POSTs to /apply_yaml, which parses + dispatches by `type`. */
+/* Collapsible paste-a-YAML panel: the browser's `dtpu apply -f`,
+   with the CLI's plan-preview step. "Preview" POSTs plan_only (prices
+   the config, creates nothing), "Apply" submits. */
 function yamlApplyPanel(label, placeholder, onDone) {
   const ta = h("textarea", {
     rows: "10", placeholder, class: "yaml",
     style: "width:100%;font-family:monospace;font-size:12px",
   });
   const errDiv = h("div", { style: "color:var(--err)" }, "");
+  const planDiv = h("div", {}, "");
   const body = h("div", { style: "display:none;flex-direction:column;gap:8px;margin:8px 0" },
-    ta, errDiv,
-    h("button", { class: "primary", style: "align-self:flex-start", onclick: async () => {
-      errDiv.textContent = "";
-      try {
-        const res = await papi("/apply_yaml", { yaml: ta.value });
-        toast(`${res.kind} ${res.name} submitted`);
-        if (onDone) onDone(res); else render();
-      } catch (e) { errDiv.textContent = e.message; }
-    } }, "Apply"),
+    ta, errDiv, planDiv,
+    h("div", { class: "row-actions" },
+      h("button", { onclick: async () => {
+        errDiv.textContent = ""; planDiv.replaceChildren();
+        try {
+          const res = await papi("/apply_yaml", { yaml: ta.value, plan_only: true });
+          if (!res.plan || res.plan.valid) {
+            planDiv.replaceChildren(h("div", { class: "muted" },
+              `valid ${res.kind}${res.name ? " " + res.name : ""} — nothing created yet`));
+            return;
+          }
+          const p = res.plan;
+          planDiv.replaceChildren(
+        h("div", { class: "muted" },
+          `${p.jobs} job(s) · ${p.total_offers} offer(s)` +
+          (p.max_price != null ? ` · up to $${p.max_price.toFixed(2)}/h` : "")),
+            table(["Backend", "Instance", "Region", "Spot", "$/h"],
+              (p.offers || []).map((o) => h("tr", {},
+                h("td", {}, o.backend), h("td", {}, o.instance_type),
+                h("td", {}, o.region), h("td", {}, o.spot ? "yes" : "no"),
+                h("td", {}, `$${o.price.toFixed(2)}`))),
+              "no offers match"),
+          );
+        } catch (e) { errDiv.textContent = e.message; }
+      } }, "Preview plan"),
+      h("button", { class: "primary", onclick: async () => {
+        errDiv.textContent = "";
+        try {
+          const res = await papi("/apply_yaml", { yaml: ta.value });
+          toast(`${res.kind} ${res.name} submitted`);
+          if (onDone) onDone(res); else render();
+        } catch (e) { errDiv.textContent = e.message; }
+      } }, "Apply"),
+    ),
   );
   const toggle = h("button", { class: "primary", onclick: () => {
     body.style.display = body.style.display === "none" ? "flex" : "none";
   } }, label);
   return h("div", {}, toggle, body);
+}
+
+/* Single-series sparkline tile: stat number + inline-SVG line with a
+   nearest-point hover readout. One accent hue (identity lives in the
+   tile title); text stays in ink tokens, never the series color. */
+function sparkTile(title, series, fmt) {
+  const W = 220, H = 44, PAD = 3;
+  const vals = series.values || [];
+  const last = vals.length ? vals[vals.length - 1] : null;
+  const tile = h("div", {
+    style: "background:var(--panel);border:1px solid var(--border);" +
+      "border-radius:8px;padding:10px 12px;min-width:250px",
+  });
+  const readout = h("div", { class: "muted" }, " ");
+  tile.append(
+    h("div", { class: "muted", style: "text-transform:uppercase;font-size:11px" }, title),
+    h("div", { style: "font-size:20px;font-weight:600;margin:2px 0" },
+      last == null ? "—" : fmt(last)),
+  );
+  if (vals.length > 1) {
+    const lo = Math.min(...vals), hi = Math.max(...vals);
+    const span = hi - lo || 1;
+    const x = (i) => PAD + (i / (vals.length - 1)) * (W - 2 * PAD);
+    const y = (v) => H - PAD - ((v - lo) / span) * (H - 2 * PAD);
+    const d = vals.map((v, i) => `${i ? "L" : "M"}${x(i).toFixed(1)},${y(v).toFixed(1)}`).join("");
+    const ns = "http://www.w3.org/2000/svg";
+    const svg = document.createElementNS(ns, "svg");
+    svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
+    svg.setAttribute("width", W); svg.setAttribute("height", H);
+    const path = document.createElementNS(ns, "path");
+    path.setAttribute("d", d);
+    path.setAttribute("fill", "none");
+    path.setAttribute("stroke", "var(--accent)");
+    path.setAttribute("stroke-width", "2");
+    path.setAttribute("stroke-linejoin", "round");
+    svg.append(path);
+    const dot = document.createElementNS(ns, "circle");
+    dot.setAttribute("r", "3"); dot.setAttribute("fill", "var(--accent)");
+    dot.setAttribute("visibility", "hidden");
+    svg.append(dot);
+    svg.style.cursor = "crosshair";
+    svg.onmousemove = (ev) => {
+      const rect = svg.getBoundingClientRect();
+      const i = Math.max(0, Math.min(vals.length - 1,
+        Math.round(((ev.clientX - rect.left) / rect.width) * (vals.length - 1))));
+      dot.setAttribute("cx", x(i)); dot.setAttribute("cy", y(vals[i]));
+      dot.setAttribute("visibility", "visible");
+      const ts = (series.timestamps || [])[i];
+      readout.textContent = `${fmt(vals[i])}${ts ? " @ " + fmtDate(ts) : ""}`;
+    };
+    svg.onmouseleave = () => {
+      dot.setAttribute("visibility", "hidden");
+      readout.textContent = " ";
+    };
+    tile.append(svg);
+  }
+  tile.append(readout);
+  return tile;
 }
 
 function currentRoute() {
@@ -278,21 +364,21 @@ async function pageRunDetail(name) {
     );
   });
 
-  // latest hardware metrics (cpu/mem/TPU duty cycle from the agent)
-  const metricsDiv = h("div", { class: "kv" }, h("div", { class: "muted" }, "loading…"));
+  // hardware metrics: one sparkline tile per series (cpu/mem/TPU duty
+  // cycle/HBM from the agent sampler), latest value as the stat number
+  const metricsDiv = h("div",
+    { style: "display:flex;flex-wrap:wrap;gap:10px" },
+    h("div", { class: "muted" }, "loading…"));
   (async () => {
-    const jm = await papi("/metrics/job", { run_name: name, limit: 15 });
-    const rows = [];
-    for (const m of jm.metrics || []) {
-      const v = m.values?.slice(-1)[0];
-      if (v == null) continue;
-      const val = m.name.includes("bytes")
-        ? `${(v / 1024 / 1024).toFixed(0)} MiB`
-        : m.name.includes("percent") ? `${Number(v).toFixed(1)}%` : String(v);
-      rows.push(h("div", { class: "k" }, m.name), h("div", {}, val));
-    }
+    const jm = await papi("/metrics/job", { run_name: name, limit: 60 });
+    const fmtFor = (n) => n.includes("bytes")
+      ? (v) => `${(v / 1024 / 1024).toFixed(0)} MiB`
+      : n.includes("percent") ? (v) => `${Number(v).toFixed(1)}%` : (v) => String(v);
+    const tiles = (jm.metrics || [])
+      .filter((m) => m.values?.length)
+      .map((m) => sparkTile(m.name.replace(/_/g, " "), m, fmtFor(m.name)));
     metricsDiv.replaceChildren(
-      ...(rows.length ? rows : [h("div", { class: "muted" }, "no samples yet")]));
+      ...(tiles.length ? tiles : [h("div", { class: "muted" }, "no samples yet")]));
   })().catch(() => metricsDiv.replaceChildren(h("div", { class: "muted" }, "unavailable")));
 
   return h("div", {},
@@ -509,6 +595,45 @@ async function pageGateways() {
         } }, "Delete")),
       )),
     ),
+  );
+}
+
+/* TPU slice catalog browser — the console's `dtpu offer`. */
+async function pageOffers() {
+  const verIn = h("input", { placeholder: "version (v5e, v6e…)", style: "width:160px" });
+  const chipsIn = h("input", { placeholder: "chips (8, 16…)", style: "width:120px" });
+  const spotSel = h("select", {},
+    h("option", { value: "" }, "spot + on-demand"),
+    h("option", { value: "true" }, "spot only"),
+    h("option", { value: "false" }, "on-demand only"));
+  const results = h("div", {}, h("div", { class: "empty" }, "Set filters and search"));
+  async function search() {
+    const body = { limit: 100 };
+    if (verIn.value.trim()) body.version = verIn.value.trim();
+    const chips = parseInt(chipsIn.value, 10);
+    if (!isNaN(chips)) { body.min_chips = chips; body.max_chips = chips; }
+    if (spotSel.value) body.spot = spotSel.value === "true";
+    try {
+      const res = await papi("/offers/list", body);
+      results.replaceChildren(
+        table(["Slice", "Topology", "Chips", "Hosts", "Region", "Spot", "$/h"],
+          res.offers.map((o) => h("tr", {},
+            h("td", {}, o.instance_name), h("td", {}, o.topology),
+            h("td", {}, String(o.chips)), h("td", {}, String(o.hosts)),
+            h("td", {}, o.region), h("td", {}, o.spot ? "yes" : "no"),
+            h("td", {}, `$${o.price.toFixed(2)}`))),
+          "no slices match"));
+    } catch (e) {
+      results.replaceChildren(h("div", { class: "empty" }, "Error: " + e.message));
+    }
+  }
+  search();
+  return h("div", {},
+    h("h1", {}, "TPU offers"),
+    h("div", { class: "row-actions", style: "margin-bottom:12px" },
+      verIn, chipsIn, spotSel,
+      h("button", { class: "primary", onclick: search }, "Search")),
+    results,
   );
 }
 
@@ -731,6 +856,7 @@ const ROUTES = {
   instances: pageInstances,
   volumes: pageVolumes,
   gateways: pageGateways,
+  offers: pageOffers,
   repos: pageRepos,
   secrets: pageSecrets,
   project: pageProject,

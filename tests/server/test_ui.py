@@ -38,7 +38,8 @@ class TestUIServing:
             for page in (
                 "pageRuns", "pageRunDetail", "pageModels", "pageFleets",
                 "pageFleetDetail", "pageInstances", "pageVolumes",
-                "pageGateways", "pageRepos", "pageSecrets", "pageProject",
+                "pageGateways", "pageOffers", "pageRepos", "pageSecrets",
+                "pageProject",
             ):
                 assert page in js, page
             # live logs ride the websocket endpoint
@@ -274,6 +275,111 @@ class TestConsoleAdminLoop:
         finally:
             await client.close()
 
+    async def test_apply_yaml_plan_preview_submits_nothing(self):
+        """plan_only prices the config (the browser analog of the CLI's
+        confirmation prompt) without creating any resource."""
+        client = await self._app_client(local_backend=True)
+        try:
+            r = await client.post(
+                "/api/project/main/apply_yaml", headers=_auth("admin-tk"),
+                json={
+                    "yaml": "type: task\ncommands: [echo hi]\n",
+                    "plan_only": True,
+                },
+            )
+            assert r.status == 200
+            body = await r.json()
+            assert body["kind"] == "run"
+            assert body["plan"]["jobs"] == 1
+            assert body["plan"]["total_offers"] >= 1
+            offer = body["plan"]["offers"][0]
+            assert {"backend", "instance_type", "region", "spot", "price"} <= set(offer)
+            # nothing was submitted
+            r = await client.post(
+                "/api/project/main/runs/list", headers=_auth("admin-tk"), json={}
+            )
+            assert await r.json() == []
+
+            # resource configs: validated, not created
+            r = await client.post(
+                "/api/project/main/apply_yaml", headers=_auth("admin-tk"),
+                json={
+                    "yaml": "type: volume\nname: prev-vol\nsize: 10\n",
+                    "plan_only": True,
+                },
+            )
+            assert (await r.json()) == {
+                "kind": "volume", "name": "prev-vol", "plan": {"valid": True}
+            }
+            r = await client.post(
+                "/api/project/main/volumes/list", headers=_auth("admin-tk"), json={}
+            )
+            assert await r.json() == []
+
+            # plan errors surface as 4xx with the validation message
+            r = await client.post(
+                "/api/project/main/apply_yaml", headers=_auth("admin-tk"),
+                json={
+                    "yaml": "type: volume\nname: Bad_Name\nsize: 10\n",
+                    "plan_only": True,
+                },
+            )
+            assert 400 <= r.status < 500
+
+            # preview shares the apply path's uniqueness check: a name
+            # that would collide fails in PREVIEW, not just on apply
+            r = await client.post(
+                "/api/project/main/apply_yaml", headers=_auth("admin-tk"),
+                json={"yaml": "type: volume\nname: dup-vol\nsize: 10\n"},
+            )
+            assert r.status == 200
+            r = await client.post(
+                "/api/project/main/apply_yaml", headers=_auth("admin-tk"),
+                json={
+                    "yaml": "type: volume\nname: dup-vol\nsize: 10\n",
+                    "plan_only": True,
+                },
+            )
+            assert 400 <= r.status < 500
+            assert "already exists" in (await r.text())
+        finally:
+            await client.close()
+
+    async def test_offers_catalog_endpoint(self):
+        client = await self._app_client()
+        try:
+            r = await client.post(
+                "/api/project/main/offers/list", headers=_auth("admin-tk"),
+                json={"version": "v5e", "min_chips": 8, "max_chips": 8},
+            )
+            assert r.status == 200
+            offers = (await r.json())["offers"]
+            assert offers
+            assert all(o["version"] == "v5e" and o["chips"] == 8 for o in offers)
+            assert {"instance_name", "topology", "hosts", "region", "spot", "price"} <= set(offers[0])
+            # cheapest-first so the limit never drops the best offers
+            prices = [o["price"] for o in offers]
+            assert prices == sorted(prices)
+            # limit is validated, not silently mis-applied
+            r = await client.post(
+                "/api/project/main/offers/list", headers=_auth("admin-tk"),
+                json={"limit": 0},
+            )
+            assert 400 <= r.status < 500
+            # spot filter + unknown version error
+            r = await client.post(
+                "/api/project/main/offers/list", headers=_auth("admin-tk"),
+                json={"spot": True},
+            )
+            assert all(o["spot"] for o in (await r.json())["offers"])
+            r = await client.post(
+                "/api/project/main/offers/list", headers=_auth("admin-tk"),
+                json={"version": "h100"},
+            )
+            assert 400 <= r.status < 500
+        finally:
+            await client.close()
+
     async def test_user_and_member_and_backend_admin(self):
         client = await self._app_client()
         try:
@@ -348,6 +454,8 @@ class TestConsoleAdminLoop:
                 "yamlApplyPanel", "apply_yaml", "pageUsers", "set_members",
                 "backends/create", "users/create", "volumes/apply",
                 "projects/create",
+                # plan preview + offers browser + metrics sparklines
+                "plan_only", "pageOffers", "offers/list", "sparkTile",
             ):
                 assert needle in js, needle
         finally:
