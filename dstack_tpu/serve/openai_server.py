@@ -735,6 +735,11 @@ def main(argv=None) -> int:
              "batches (amortizes the host round trip; 0/1 disables — "
              "streaming then delivers token-by-token)",
     )
+    p.add_argument(
+        "--no-warmup", action="store_true",
+        help="skip the startup compile warmup (first request then pays "
+             "the prefill/decode XLA compiles in its TTFT)",
+    )
     args = p.parse_args(argv)
 
     from dstack_tpu.utils.logging import configure_logging
@@ -836,11 +841,60 @@ def main(argv=None) -> int:
         mesh=mesh, spec_draft=args.spec_draft,
         turbo_steps=args.turbo_steps,
     )
+    # tokenizer first: it's cheap and fail-fast — a typo'd path must
+    # not cost a full compile warmup before erroring
     tokenizer = load_tokenizer(args.tokenizer or "byte")
+    if not args.no_warmup:
+        _warmup_engine(engine)
     app = build_app(engine, tokenizer, args.model, args.chat_template)
     logger.info("openai server: %s on :%d", args.model, args.port)
     web.run_app(app, host="0.0.0.0", port=args.port, print=None)
     return 0
+
+
+def _warmup_engine(engine) -> None:
+    """Compile the kernels real requests will hit, at STARTUP instead
+    of inside first-request TTFT: the smallest and full prefill-chunk
+    buckets, EVERY power-of-two turbo decode_loop variant (the
+    macro-step is budget-capped, so short/tail generations pick smaller
+    variants), the sampled-path decode + full-batch sampler, and — when
+    speculation is on — the verify step. With --compile-cache mounted
+    this run also populates the persistent cache, so restarts skip even
+    the warmup cost."""
+    t0 = time.time()
+    spec = engine.spec_draft
+    engine.spec_draft = 0
+    full = [(i % 251) + 1 for i in range(engine.prefill_chunk)]
+    runs = 0
+
+    def run(prompt, gen):
+        nonlocal runs
+        runs += 1
+        slot, _ = engine.add_request(prompt, gen)
+        while engine.active[slot]:
+            engine.step()
+        engine.release(slot)
+
+    # full prefill chunk + the largest turbo variant (and steps=1 tail)
+    run(full, GenParams(max_new_tokens=max(2, engine.turbo_steps + 2)))
+    # smallest prefill bucket — short prompts must not compile on hit
+    run(full[:5], GenParams(max_new_tokens=2))
+    # intermediate turbo variants: budget s+1 → macro-step picks steps=s
+    s = engine.turbo_steps // 2
+    while s >= 2:
+        run(full[:5], GenParams(max_new_tokens=s + 1))
+        s //= 2
+    # sampled path: _decode + the full-batch [B, V] sampler
+    run(full[:5], GenParams(max_new_tokens=2, temperature=0.7, seed=0))
+    engine.spec_draft = spec
+    if spec:
+        # repetitive prompt → drafts fire → verify_step compiles
+        rep = (full[:4] * (engine.prefill_chunk // 4 + 1))[: engine.prefill_chunk]
+        run(rep, GenParams(max_new_tokens=spec + 2))
+    logger.info(
+        "warmup: %d requests compiled prefill/decode/sample%s in %.1fs",
+        runs, "/verify" if spec else "", time.time() - t0,
+    )
 
 
 if __name__ == "__main__":

@@ -22,6 +22,34 @@ async def _client():
     return client
 
 
+def test_warmup_compiles_and_leaves_engine_clean():
+    """Startup warmup must free its slot, restore spec_draft, and leave
+    the engine ready (the compiled fns it warmed are the ones step()
+    uses — a stale slot or clobbered knob would corrupt request 1)."""
+    from dstack_tpu.serve.openai_server import _warmup_engine
+
+    config = llama.LLAMA_TINY
+    params = llama.init_params(config, jax.random.key(0))
+    engine = InferenceEngine(
+        config, params, max_batch=2, max_seq=128, spec_draft=3, turbo_steps=4
+    )
+    _warmup_engine(engine)
+    assert engine.free_slots() == [0, 1]
+    assert engine.spec_draft == 3
+    # every power-of-two macro-step variant is warm (full, walk-down,
+    # tail), so no greedy request compiles a decode_loop mid-stream
+    assert {1, 2, 4} <= set(engine._turbo_fns)
+    # both prefill buckets: short prompts (16) and the full chunk
+    starts = set(engine._chunk_fns)
+    assert (16, 0) in starts
+    assert any(cl >= engine.prefill_chunk for cl, _ in starts)
+    # engine still serves normally after warmup
+    from dstack_tpu.serve.engine import GenParams
+
+    out = engine.generate([5, 6, 7], GenParams(max_new_tokens=4))
+    assert len(out) == 4
+
+
 class TestOpenAIServer:
     async def test_health_and_models(self):
         client = await _client()
