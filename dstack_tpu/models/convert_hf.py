@@ -7,10 +7,12 @@ torch ``.bin`` shards) and get back ``(LlamaConfig, params)`` ready for
 finetune driver.
 
 Supported ``model_type``s: ``llama``, ``qwen2``, ``qwen3``,
-``qwen3_moe``, ``mistral``, ``gemma``, ``gemma2``, ``mixtral``, ``phi3`` (fused
-qkv/gate_up projections are split on load; a Phi-3 export round-trips
-as the equivalent mistral/llama layout). Each maps onto :class:`LlamaConfig` family
-flags (qkv_bias / sliding_window / norm_offset / softcaps / MoE) — the
+``qwen3_moe``, ``mistral``, ``gemma``, ``gemma2``, ``gemma3``/
+``gemma3_text`` (multimodal checkpoints load their text tower),
+``mixtral``, ``phi3`` (fused qkv/gate_up projections are split on
+load; a Phi-3 export round-trips as the equivalent mistral/llama
+layout). Each maps onto :class:`LlamaConfig` family flags (qkv_bias /
+sliding_window / norm_offset / softcaps / dual-theta rope / MoE) — the
 architecture deltas live in the config, not in per-family model code.
 
 The reference framework never loads weights itself (user containers do);
@@ -35,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dstack_tpu.models.llama import LlamaConfig
+from dstack_tpu.models.llama import layer_windows as _layer_windows
 
 __all__ = [
     "config_from_hf",
@@ -49,6 +52,12 @@ __all__ = [
 def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     """HF ``config.json`` dict → :class:`LlamaConfig`."""
     mt = hf.get("model_type", "llama")
+    if mt == "gemma3" and "text_config" in hf:
+        # multimodal wrapper: the text tower's config is nested (the
+        # vision tower is out of scope; load_checkpoint strips its
+        # weights and the language_model prefix)
+        hf = {**hf["text_config"], "model_type": "gemma3_text"}
+        mt = "gemma3_text"
     hidden = hf["hidden_size"]
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hidden // n_heads
@@ -61,7 +70,7 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         )
     act = hf.get("hidden_act") or "silu"
     act_map = {"silu": "silu", "gelu_pytorch_tanh": "gelu_tanh"}
-    if mt in ("gemma", "gemma2"):
+    if mt in ("gemma", "gemma2", "gemma3", "gemma3_text"):
         # Gemma configs historically say "gelu"/hidden_activation but
         # the models always use the tanh approximation
         act = "gelu_tanh"
@@ -166,7 +175,56 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             experts_per_token=hf.get("num_experts_per_tok", 2),
             router_renorm=True,
         )
+    if mt in ("gemma3", "gemma3_text"):
+        sw = hf.get("sliding_window") or 0
+        sw, pattern = _gemma3_pattern(hf, sw)
+        return LlamaConfig(
+            **{**common, "tie_embeddings": hf.get("tie_word_embeddings", True)},
+            norm_offset=True,
+            embed_scale=True,
+            post_norms=True,
+            qk_norm=True,
+            sliding_window=sw,
+            sliding_pattern=pattern,
+            # dual rope: sliding layers rotate at the unscaled local
+            # theta, global layers at rope_theta (+ linear scaling)
+            rope_local_theta=hf.get("rope_local_base_freq", 10000.0),
+            attn_scale=float(hf["query_pre_attn_scalar"]) ** -0.5
+            if hf.get("query_pre_attn_scalar")
+            else None,
+        )
     raise ValueError(f"unsupported HF model_type {mt!r}")
+
+
+def _gemma3_pattern(hf: dict, sliding_window: int) -> tuple[int, int]:
+    """Gemma3 layer layout → (sliding_window, sliding_pattern).
+
+    Newer HF configs spell the layout as an explicit ``layer_types``
+    list; older ones as ``sliding_window_pattern`` (every p-th layer
+    global). Only the periodic layouts our stack expresses are
+    accepted — an aperiodic list is a hard error, not silent full
+    attention. When no layer actually slides, the window is zeroed
+    too: (sw, pattern=0) with sw > 0 would mean "uniform sliding" to
+    :func:`~dstack_tpu.models.llama.layer_windows`."""
+    lt = hf.get("layer_types")
+    if lt:
+        if not sliding_window or "sliding_attention" not in lt:
+            return 0, 0  # all-global layout: no window anywhere
+        globals_ix = [i for i, t in enumerate(lt) if t == "full_attention"]
+        if not globals_ix:
+            return sliding_window, 0  # uniform sliding (n_layers < pattern)
+        p = globals_ix[0] + 1
+        expect = [
+            "full_attention" if (i + 1) % p == 0 else "sliding_attention"
+            for i in range(len(lt))
+        ]
+        if lt != expect:
+            raise ValueError(
+                f"gemma3 layer_types {lt!r} is not the periodic "
+                f"1-global-per-{p} layout this stack expresses"
+            )
+        return sliding_window, p
+    return sliding_window, int(hf.get("sliding_window_pattern") or 6)
 
 
 # MoE tensor naming per family: (router weight, expert prefix,
@@ -205,6 +263,10 @@ def _rope_scaling_from_hf(hf: dict) -> Optional[tuple]:
             float(rs["high_freq_factor"]),
             float(rs["original_max_position_embeddings"]),
         )
+    if rope_type == "linear":
+        # classic position interpolation (Gemma3 global layers):
+        # every frequency divided by the factor
+        return ("linear", float(rs["factor"]))
     raise ValueError(f"unsupported rope_scaling type {rope_type!r}")
 
 
@@ -250,8 +312,22 @@ def convert_state_dict(
             mats.append(m.T if transpose else m)
         return np.asarray(np.stack(mats), dt)
 
+    if model_type == "gemma3":
+        # multimodal checkpoint: keep the text tower, drop the vision
+        # weights. Both layouts normalize to model.*:
+        #   language_model.model.layers...   (<= 4.51)
+        #   model.language_model.layers...   (>= 4.52)
+        stripped = {}
+        for k, v in sd.items():
+            if "language_model." not in k:
+                continue  # vision tower / projector
+            k = k.replace("model.language_model.", "model.", 1)
+            k = k.replace("language_model.", "", 1)
+            stripped[k] = v
+        sd = stripped or sd
+
     P = "model.layers.{i}."
-    gemma2 = model_type == "gemma2"
+    gemma2 = model_type in ("gemma2", "gemma3", "gemma3_text")
     layers = {
         "attn_norm": stack(P + "input_layernorm.weight"),
         "wq": stack(P + "self_attn.q_proj.weight", transpose=True),
@@ -382,8 +458,13 @@ def config_to_hf(config: LlamaConfig) -> dict:
         "tie_word_embeddings": c.tie_embeddings,
         "torch_dtype": "bfloat16",
     }
-    if c.rope_scaling is not None:
-        factor, low_f, high_f, orig = c.rope_scaling
+    if c.rope_scaling is not None and c.rope_scaling[0] == "linear":
+        hf["rope_scaling"] = {
+            "rope_type": "linear", "factor": float(c.rope_scaling[1])
+        }
+    elif c.rope_scaling is not None:
+        rs = c.rope_scaling
+        factor, low_f, high_f, orig = rs[1:] if rs[0] == "llama3" else rs
         hf["rope_scaling"] = {
             "rope_type": "llama3",
             "factor": factor,
@@ -405,6 +486,20 @@ def config_to_hf(config: LlamaConfig) -> dict:
             model_type="mixtral",
             num_local_experts=c.n_experts,
             num_experts_per_tok=c.experts_per_token,
+        )
+    elif c.rope_local_theta:
+        hf.update(
+            model_type="gemma3_text",
+            sliding_window=c.sliding_window or None,
+            sliding_window_pattern=c.sliding_pattern or None,
+            layer_types=[
+                "sliding_attention" if w else "full_attention"
+                for w in _layer_windows(c)
+            ],
+            rope_local_base_freq=c.rope_local_theta,
+            query_pre_attn_scalar=(
+                round(c.attn_scale**-2) if c.attn_scale else c.head_dim
+            ),
         )
     elif c.post_norms:
         hf.update(
@@ -445,7 +540,7 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
         raise ValueError("export requires full-precision params, not int8")
     c = config
     mt = config_to_hf(c)["model_type"]
-    gemma2 = mt == "gemma2"
+    gemma2 = mt in ("gemma2", "gemma3_text")
 
     def np32(x):
         # keep the source dtype (bf16 stays bf16): upcasting every

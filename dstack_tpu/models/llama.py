@@ -65,9 +65,15 @@ class LlamaConfig:
     logit_softcap: float = 0.0  # Gemma2 tanh soft-cap on final logits
     attn_scale: Optional[float] = None  # override 1/sqrt(head_dim)
     # Llama-3.1+ rope scaling: (factor, low_freq_factor,
-    # high_freq_factor, original_max_position_embeddings); None = plain
-    # rope_theta frequencies
+    # high_freq_factor, original_max_position_embeddings), or the tagged
+    # forms ("llama3", factor, low, high, orig) / ("linear", factor)
+    # (Gemma3 global layers use linear position interpolation); None =
+    # plain rope_theta frequencies
     rope_scaling: Optional[tuple] = None
+    # Gemma3 dual rope: sliding-window layers use this unscaled theta
+    # while global layers use rope_theta (+ rope_scaling). 0 = single
+    # rope for all layers.
+    rope_local_theta: float = 0.0
     # sequence-parallel strategy on sp>1 meshes: "ring" (KV rotation,
     # any head count, lowest memory) or "ulysses" (head⇄seq all_to_all,
     # needs n_heads % sp == 0, keeps the flash kernel for windows)
@@ -181,6 +187,26 @@ GEMMA2_2B = LlamaConfig(
     sliding_window=4096, sliding_pattern=2,
     attn_softcap=50.0, logit_softcap=30.0, attn_scale=256.0**-0.5,
 )
+# Gemma3: 5 sliding layers per global one, dual rope theta (local 10k
+# on sliding layers, 1M + linear interpolation on global), qk-norm,
+# no softcaps (google/gemma-3-*-it config.json)
+GEMMA3_1B = LlamaConfig(
+    vocab_size=262144, hidden_size=1152, n_layers=26, n_heads=4,
+    n_kv_heads=1, head_dim=256, intermediate_size=6912, rope_theta=1e6,
+    norm_eps=1e-6, max_seq_len=32768, tie_embeddings=True,
+    hidden_act="gelu_tanh", norm_offset=True, embed_scale=True,
+    post_norms=True, qk_norm=True, sliding_window=512, sliding_pattern=6,
+    rope_local_theta=10000.0, attn_scale=256.0**-0.5,
+)
+GEMMA3_4B = LlamaConfig(  # text tower of google/gemma-3-4b
+    vocab_size=262208, hidden_size=2560, n_layers=34, n_heads=8,
+    n_kv_heads=4, head_dim=256, intermediate_size=10240, rope_theta=1e6,
+    norm_eps=1e-6, max_seq_len=131072, tie_embeddings=True,
+    hidden_act="gelu_tanh", norm_offset=True, embed_scale=True,
+    post_norms=True, qk_norm=True, sliding_window=1024, sliding_pattern=6,
+    rope_local_theta=10000.0, rope_scaling=("linear", 8.0),
+    attn_scale=256.0**-0.5,
+)
 
 CONFIGS = {
     "llama-3-8b": LLAMA_3_8B,
@@ -196,6 +222,8 @@ CONFIGS = {
     "mistral-7b": MISTRAL_7B,
     "gemma-2b": GEMMA_2B,
     "gemma-2-2b": GEMMA2_2B,
+    "gemma-3-1b": GEMMA3_1B,
+    "gemma-3-4b": GEMMA3_4B,
 }
 
 
@@ -325,24 +353,29 @@ def act_fn(config: "LlamaConfig"):
 
 
 def grouped_scan_layout(config: "LlamaConfig", xs: dict):
-    """→ (g, windows, xs') for scanning mixed sliding/global layers.
+    """→ (g, windows, xs_main, xs_tail) for scanning mixed
+    sliding/global layers.
 
-    g == 1: uniform window, scan ``xs`` as-is. g > 1 (Gemma2): every
-    scan step runs ``g`` sublayers with static windows ``windows[:g]``;
-    the stacked [L, ...] leaves reshape to [L/g, g, ...]. One source of
-    truth for llama.forward and the serve engine's prefill.
+    g == 1: uniform window, scan ``xs`` as-is (no tail). g > 1
+    (Gemma2/3): every scan step runs ``g`` sublayers with static
+    windows ``windows[:g]``; the stacked [L, ...] leaves reshape to
+    [L//g, g, ...]. When the pattern doesn't divide the layer count
+    (Gemma3: 26 layers, pattern 6) the last ``L % g`` layers come back
+    as ``xs_tail`` ([r, ...] leaves) for the caller to unroll after the
+    scan — their windows are ``windows[-r:]``. One source of truth for
+    llama.forward and the serve engine's prefill.
     """
     windows = layer_windows(config)
     g = 1 if len(set(windows)) == 1 else config.sliding_pattern
-    if config.n_layers % g != 0:
-        raise ValueError(
-            f"{config.n_layers} layers not divisible by pattern {g}"
-        )
-    if g > 1:
-        xs = jax.tree.map(
-            lambda a: a.reshape((config.n_layers // g, g) + a.shape[1:]), xs
-        )
-    return g, windows, xs
+    if g == 1:
+        return g, windows, xs, None
+    r = config.n_layers % g
+    n_main = config.n_layers - r
+    xs_main = jax.tree.map(
+        lambda a: a[:n_main].reshape((n_main // g, g) + a.shape[1:]), xs
+    )
+    xs_tail = jax.tree.map(lambda a: a[n_main:], xs) if r else None
+    return g, windows, xs_main, xs_tail
 
 
 def sublayer(group, i: int, g: int):
@@ -382,9 +415,15 @@ def rope_freqs(
     long-wavelength frequencies are divided by ``factor``, short ones
     kept, with a smooth ramp between — matching HF's
     ``rope_type: llama3`` so 3.1/3.2 checkpoints decode correctly.
+    The tagged form ("linear", factor) divides every frequency by
+    ``factor`` (HF ``rope_type: linear``, Gemma3's global layers).
     """
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
-    if scaling is not None:
+    if scaling is not None and scaling[0] == "linear":
+        inv = inv / float(scaling[1])
+    elif scaling is not None:
+        if scaling[0] == "llama3":
+            scaling = scaling[1:]
         factor, low_f, high_f, orig_ctx = scaling
         wavelen = 2.0 * math.pi / inv
         smooth = (orig_ctx / wavelen - low_f) / (high_f - low_f)
@@ -392,6 +431,28 @@ def rope_freqs(
         inv = (1.0 - smooth) * inv / factor + smooth * inv
     ang = positions.astype(jnp.float32)[:, None] * inv[None, :]
     return jnp.cos(ang), jnp.sin(ang)
+
+
+def dual_rope_freqs(
+    config: "LlamaConfig", positions: jax.Array
+) -> tuple[tuple, tuple]:
+    """→ ((cos, sin), (cos_local, sin_local)) for the config's global
+    and sliding-window layers. Single-rope families get the same pair
+    twice (no extra compute — the arrays are shared); Gemma3 sliding
+    layers rotate with the unscaled ``rope_local_theta`` while global
+    layers use ``rope_theta`` + ``rope_scaling``."""
+    g = rope_freqs(
+        positions, config.head_dim, config.rope_theta, config.rope_scaling
+    )
+    if not config.rope_local_theta:
+        return g, g
+    return g, rope_freqs(positions, config.head_dim, config.rope_local_theta)
+
+
+def layer_rope(ropes: tuple[tuple, tuple], config: "LlamaConfig", window: int):
+    """Pick a layer's (cos, sin) from :func:`dual_rope_freqs` output by
+    its STATIC window (sliding layers → local rope)."""
+    return ropes[1] if window else ropes[0]
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
@@ -451,9 +512,11 @@ def _attention_block(
     q = q.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
     k = k.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-    if c.qk_norm:  # Qwen3: per-head-dim RMSNorm before rope
-        q = rms_norm(q, layer["q_norm"], c.norm_eps)
-        k = rms_norm(k, layer["k_norm"], c.norm_eps)
+    if c.qk_norm:  # Qwen3/Gemma3: per-head-dim RMSNorm before rope
+        # Gemma3 stores zero-centered norm weights (the family's
+        # norm_offset convention applies to q/k norms too)
+        q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
+        k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
     q = constrain(q, rules, "batch", "heads", "seq", None, mesh=mesh)
     k = constrain(k, rules, "batch", "kv_heads", "seq", None, mesh=mesh)
     q = apply_rope(q, cos, sin)
@@ -529,8 +592,8 @@ def _embed_tokens(
     mesh: Optional[Mesh],
     rules: ShardingRules,
     positions: Optional[jax.Array],
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Shared forward preamble → (x [B,T,H], rope cos, rope sin)."""
+) -> tuple[jax.Array, tuple]:
+    """Shared forward preamble → (x [B,T,H], dual rope pairs)."""
     # Replicate the embed table for the token lookup: a gather from the
     # (vocab-tp, hidden-fsdp)-sharded table would produce hidden-sharded
     # activations that GSPMD can only reshard to batch/seq sharding by
@@ -544,10 +607,7 @@ def _embed_tokens(
         x = x * jnp.asarray(config.hidden_size**0.5, config.dtype)
     x = constrain(x, rules, "batch", "seq", None, mesh=mesh)
     pos = positions if positions is not None else jnp.arange(tokens.shape[1])
-    cos, sin = rope_freqs(
-        pos, config.head_dim, config.rope_theta, config.rope_scaling
-    )
-    return x, cos, sin
+    return x, dual_rope_freqs(config, pos)
 
 
 def _lm_head(
@@ -633,39 +693,53 @@ def forward(
     """
     c = config
     rules = rules or default_rules()
-    x, cos, sin = _embed_tokens(params, tokens, c, mesh, rules, positions)
-    # mixed sliding/global layers (Gemma2) scan in groups of `g`
+    x, ropes = _embed_tokens(params, tokens, c, mesh, rules, positions)
+    # mixed sliding/global layers (Gemma2/3) scan in groups of `g`
     # sublayers so every window is static — the flash kernel stays
-    # usable (a traced window would force the masked XLA path)
+    # usable (a traced window would force the masked XLA path), and
+    # Gemma3's per-layer rope theta resolves statically too
     xs = _merge_lora(params["layers"], lora, lora_scale, c)
-    g, windows, xs = grouped_scan_layout(c, xs)
+    g, windows, xs_main, xs_tail = grouped_scan_layout(c, xs)
 
-    def group_fn(x, group):
-        aux = jnp.zeros((), jnp.float32)
-        for i in range(g):
-            layer = sublayer(group, i, g)
-            x = x + _attention_block(
-                x, layer, c, cos, sin, mesh, rules, attn_impl,
-                window=windows[i],
+    def make_group_fn(wins: tuple, stacked: bool):
+        def group_fn(x, group):
+            aux = jnp.zeros((), jnp.float32)
+            for i, w in enumerate(wins):
+                layer = (
+                    jax.tree.map(lambda a: a[i], group) if stacked else group
+                )
+                cos, sin = layer_rope(ropes, c, w)
+                x = x + _attention_block(
+                    x, layer, c, cos, sin, mesh, rules, attn_impl, window=w
+                )
+                o, aux_i = _mlp_block(x, layer, c, mesh, rules)
+                x = x + o
+                aux = aux + aux_i
+            return x, aux
+
+        if c.remat:
+            # Save the flash-attention residuals (q/k/v/o/lse, tagged
+            # in ops/flash.py) across the remat boundary: the backward
+            # pass then reuses them instead of re-running the attention
+            # kernel, at ~80MB/layer — everything else is recomputed.
+            group_fn = jax.checkpoint(
+                group_fn,
+                policy=jax.checkpoint_policies.save_only_these_names(
+                    "flash_residuals"
+                ),
             )
-            o, aux_i = _mlp_block(x, layer, c, mesh, rules)
-            x = x + o
-            aux = aux + aux_i
-        return x, aux
+        return group_fn
 
-    if c.remat:
-        # Save the flash-attention residuals (q/k/v/o/lse, tagged in
-        # ops/flash.py) across the remat boundary: the backward pass
-        # then reuses them instead of re-running the attention kernel,
-        # at ~80MB/layer — everything else is recomputed as usual.
-        group_fn = jax.checkpoint(
-            group_fn,
-            policy=jax.checkpoint_policies.save_only_these_names(
-                "flash_residuals"
-            ),
-        )
-    x, auxs = jax.lax.scan(group_fn, x, xs)
+    x, auxs = jax.lax.scan(
+        make_group_fn(tuple(windows[:g]), g > 1), x, xs_main
+    )
     aux = jnp.sum(auxs)
+    if xs_tail is not None:
+        # pattern doesn't divide the layer count (Gemma3): the last
+        # L % g layers run unrolled after the scan
+        r = c.n_layers % g
+        x, aux_tail = make_group_fn(tuple(windows[-r:]), True)(x, xs_tail)
+        aux = aux + aux_tail
     out = _lm_head(params, x, c, mesh, rules, return_hidden)
     return (out, aux) if return_aux else out
 
@@ -708,7 +782,8 @@ def forward_pipelined(
         )
     window = windows[0]
     n_micro = n_micro or pp
-    x, cos, sin = _embed_tokens(params, tokens, c, mesh, rules, positions)
+    x, ropes = _embed_tokens(params, tokens, c, mesh, rules, positions)
+    cos, sin = layer_rope(ropes, c, window)
 
     def stage_fn(stage_layers, x, extras):
         cos, sin = extras

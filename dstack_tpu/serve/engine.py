@@ -27,7 +27,6 @@ from dstack_tpu.models.llama import (
     LlamaConfig,
     _proj,
     rms_norm,
-    rope_freqs,
 )
 
 NEG_INF = -1e30
@@ -197,7 +196,9 @@ def prefill_chunk_step(
     """
     from dstack_tpu.models.llama import (
         apply_rope,
+        dual_rope_freqs,
         grouped_scan_layout,
+        layer_rope,
         sublayer,
     )
     from dstack_tpu.ops.attention import attention
@@ -205,24 +206,23 @@ def prefill_chunk_step(
     c = config
     b, cl = tokens.shape
     x = _embed_lookup(params, tokens, c)
-    cos, sin = rope_freqs(
-        start + jnp.arange(cl), c.head_dim, c.rope_theta, c.rope_scaling
-    )
+    ropes = dual_rope_freqs(c, start + jnp.arange(cl))
     scale = c.attention_scale
-    g, windows, xs = grouped_scan_layout(
+    g, windows, xs_main, xs_tail = grouped_scan_layout(
         c, {"layer": params["layers"], "ck": cache["k"], "cv": cache["v"]}
     )
 
     def one_layer(x, layer, ck, cv, window):
         # ck/cv [B_pool, Hkv, Tmax, D] — this layer's cache
+        cos, sin = layer_rope(ropes, c, window)
         h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
         q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, cl, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         if c.qk_norm:
-            q = rms_norm(q, layer["q_norm"], c.norm_eps)
-            k = rms_norm(k, layer["k_norm"], c.norm_eps)
+            q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
+            k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # write the chunk's K/V into the slot's row, then attend over
@@ -260,10 +260,25 @@ def prefill_chunk_step(
             return x, (cks[0], cvs[0])
         return x, (jnp.stack(cks), jnp.stack(cvs))
 
-    x, (ks, vs) = jax.lax.scan(group_fn, x, xs)
-    if g > 1:  # [L/g, g, ...] → [L, ...]
-        ks = ks.reshape((c.n_layers,) + ks.shape[2:])
-        vs = vs.reshape((c.n_layers,) + vs.shape[2:])
+    x, (ks, vs) = jax.lax.scan(group_fn, x, xs_main)
+    r = c.n_layers % g if g > 1 else 0
+    if g > 1:  # [L'/g, g, ...] → [L', ...]
+        ks = ks.reshape((c.n_layers - r,) + ks.shape[2:])
+        vs = vs.reshape((c.n_layers - r,) + vs.shape[2:])
+    if xs_tail is not None:
+        # pattern doesn't divide the layer count (Gemma3): unroll the
+        # last r layers after the scan and append their cache rows
+        tks, tvs = [], []
+        for j in range(r):
+            sub = jax.tree.map(lambda a: a[j], xs_tail)
+            x, ck, cv = one_layer(
+                x, sub["layer"], sub["ck"], sub["cv"],
+                windows[c.n_layers - r + j],
+            )
+            tks.append(ck)
+            tvs.append(cv)
+        ks = jnp.concatenate([ks, jnp.stack(tks)], axis=0)
+        vs = jnp.concatenate([vs, jnp.stack(tvs)], axis=0)
     cache = {"k": ks, "v": vs}
     x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)
     last = jnp.take_along_axis(
@@ -287,7 +302,7 @@ def decode_step(
     K/V into their slot — a decode step interleaved between prefill
     chunks would otherwise corrupt the prompt being written.
     """
-    from dstack_tpu.models.llama import layer_windows
+    from dstack_tpu.models.llama import dual_rope_freqs, layer_windows
 
     c = config
     b = tokens.shape[0]
@@ -296,7 +311,7 @@ def decode_step(
     # out-of-range scatter indices drop the write (mode="drop")
     write_pos = jnp.where(write_mask, positions, cache["k"].shape[3])
     x = _embed_lookup(params, tokens, c)[:, None, :]
-    cos, sin = rope_freqs(positions, c.head_dim, c.rope_theta, c.rope_scaling)  # [B, D/2]
+    (cos, sin), (cos_l, sin_l) = dual_rope_freqs(c, positions)  # [B, D/2]
     batch_ix = jnp.arange(b)
     scale = c.attention_scale
     # decode attention is a masked einsum, so a *traced* per-layer window
@@ -305,16 +320,22 @@ def decode_step(
 
     def layer_fn(x, layer_and_cache):
         layer, ck, cv, window = layer_and_cache  # ck/cv [B, Hkv, Tmax, D]
+        # Gemma3 dual rope rides the traced window too: sliding layers
+        # (window > 0) rotate with the local-theta pair
+        cs, sn = (
+            (jnp.where(window > 0, cos_l, cos), jnp.where(window > 0, sin_l, sin))
+            if c.rope_local_theta else (cos, sin)
+        )
         h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
         q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        if c.qk_norm:  # Qwen3: per-head-dim RMSNorm before rope
-            q = rms_norm(q, layer["q_norm"], c.norm_eps)
-            k = rms_norm(k, layer["k_norm"], c.norm_eps)
-        q = _apply_rope_batch(q, cos, sin)
-        k = _apply_rope_batch(k, cos, sin)
+        if c.qk_norm:  # Qwen3/Gemma3: per-head-dim RMSNorm before rope
+            q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
+            k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
+        q = _apply_rope_batch(q, cs, sn)
+        k = _apply_rope_batch(k, cs, sn)
         # write this token's K/V at each slot's position (masked rows
         # get an out-of-range index → dropped)
         ck = ck.at[batch_ix, :, write_pos].set(k[:, :, 0, :], mode="drop")
@@ -426,7 +447,7 @@ def verify_step(
     until the real tokens decode over it — the same masked-future
     invariant padding relies on.
     """
-    from dstack_tpu.models.llama import layer_windows
+    from dstack_tpu.models.llama import dual_rope_freqs, layer_windows
 
     c = config
     b, sdraft = tokens.shape
@@ -435,18 +456,17 @@ def verify_step(
     pos_grid = positions[:, None] + jnp.arange(sdraft)[None, :]  # [B, S]
     inv_shape = c.head_dim // 2
     # rope per (row, step): build [B, S, D/2] then apply per-row
-    cos, sin = rope_freqs(
-        pos_grid.reshape(-1), c.head_dim, c.rope_theta, c.rope_scaling
+    (cos, sin), (cos_l, sin_l) = jax.tree.map(
+        lambda a: a.reshape(b, sdraft, inv_shape),
+        dual_rope_freqs(c, pos_grid.reshape(-1)),
     )
-    cos = cos.reshape(b, sdraft, inv_shape)
-    sin = sin.reshape(b, sdraft, inv_shape)
     batch_ix = jnp.arange(b)
     scale = c.attention_scale
     windows = jnp.asarray(layer_windows(c), jnp.int32)
     tmax = cache["k"].shape[3]
     write_pos = jnp.where(write_mask[:, None], pos_grid, tmax)  # [B, S]
 
-    def rope_rows(t):  # t [B, Hh, S, D]
+    def rope_rows(t, cos, sin):  # t [B, Hh, S, D]
         d2 = t.shape[-1] // 2
         t1, t2 = t[..., :d2], t[..., d2:]
         cc = cos[:, None].astype(t.dtype)  # [B, 1, S, D/2]
@@ -455,16 +475,20 @@ def verify_step(
 
     def layer_fn(x, layer_and_cache):
         layer, ck, cv, window = layer_and_cache
+        cs, sn = (
+            (jnp.where(window > 0, cos_l, cos), jnp.where(window > 0, sin_l, sin))
+            if c.rope_local_theta else (cos, sin)
+        )
         h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
         q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, sdraft, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, sdraft, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, sdraft, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         if c.qk_norm:
-            q = rms_norm(q, layer["q_norm"], c.norm_eps)
-            k = rms_norm(k, layer["k_norm"], c.norm_eps)
-        q = rope_rows(q)
-        k = rope_rows(k)
+            q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
+            k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
+        q = rope_rows(q, cs, sn)
+        k = rope_rows(k, cs, sn)
         # scatter the S tokens' K/V at their per-row positions
         ck = ck.at[batch_ix[:, None], :, write_pos].set(
             k.transpose(0, 2, 1, 3), mode="drop"

@@ -26,16 +26,16 @@ B, T = 2, 16
 
 def _save_tiny(tmp_path, config_cls, model_cls, **kw):
     torch.manual_seed(0)
-    cfg = config_cls(
-        vocab_size=128,
-        hidden_size=64,
-        intermediate_size=96,
-        num_hidden_layers=4,
-        num_attention_heads=4,
-        num_key_value_heads=2,
-        max_position_embeddings=64,
+    cfg = config_cls(**{
+        "vocab_size": 128,
+        "hidden_size": 64,
+        "intermediate_size": 96,
+        "num_hidden_layers": 4,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "max_position_embeddings": 64,
         **kw,
-    )
+    })
     model = model_cls(cfg)
     model.eval()
     model.save_pretrained(tmp_path)
@@ -169,6 +169,139 @@ class TestHFParity:
         # layer windows alternate sliding/global, HF convention
         assert llama.layer_windows(cfg) == [8, 0, 8, 0]
 
+    def test_gemma3(self, tmp_path):
+        """Dual rope theta (local 10k on sliding layers, global 1M),
+        qk-norm with the Gemma zero-centered weights, alternating
+        windows, sandwich norms — the full Gemma3 delta set."""
+        m = _save_tiny(
+            tmp_path, transformers.Gemma3TextConfig,
+            transformers.Gemma3ForCausalLM,
+            head_dim=16,
+            sliding_window=8,
+            layer_types=[
+                "sliding_attention", "full_attention",
+                "sliding_attention", "full_attention",
+            ],
+            rope_theta=1000000.0,
+            rope_local_base_freq=10000.0,
+            query_pre_attn_scalar=16,
+        )
+        cfg = _assert_parity(tmp_path, m, atol=5e-4)
+        assert cfg.qk_norm and cfg.norm_offset and cfg.post_norms
+        assert cfg.rope_local_theta == 10000.0
+        assert cfg.sliding_pattern == 2 and cfg.sliding_window == 8
+        assert llama.layer_windows(cfg) == [8, 0, 8, 0]
+        # the dual rope actually matters: single-theta logits differ
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(config, remat=False)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, config.vocab_size, (B, T)))
+        dual = llama.forward(params, tokens, config)
+        single = llama.forward(
+            params, tokens,
+            llama.dataclasses.replace(config, rope_local_theta=0.0),
+        )
+        assert not np.allclose(np.asarray(dual), np.asarray(single))
+
+    def test_gemma3_uneven_pattern(self, tmp_path):
+        """Layer count not divisible by the sliding pattern (the real
+        gemma-3 shapes: 26 layers, pattern 6) — the scan covers the
+        full groups and the tail layers unroll after it."""
+        m = _save_tiny(
+            tmp_path, transformers.Gemma3TextConfig,
+            transformers.Gemma3ForCausalLM,
+            head_dim=16,
+            sliding_window=8,
+            num_hidden_layers=5,
+            layer_types=[
+                "sliding_attention", "sliding_attention", "full_attention",
+                "sliding_attention", "sliding_attention",
+            ],
+            rope_theta=1000000.0,
+            rope_local_base_freq=10000.0,
+            query_pre_attn_scalar=16,
+        )
+        cfg = _assert_parity(tmp_path, m, atol=5e-4)
+        assert cfg.sliding_pattern == 3 and cfg.n_layers == 5
+        assert llama.layer_windows(cfg) == [8, 8, 0, 8, 8]
+
+    def test_gemma3_linear_rope_scaling(self, tmp_path):
+        """Global layers apply linear position interpolation; local
+        layers stay unscaled (gemma-3-4b+ configs)."""
+        m = _save_tiny(
+            tmp_path, transformers.Gemma3TextConfig,
+            transformers.Gemma3ForCausalLM,
+            head_dim=16,
+            sliding_window=8,
+            layer_types=[
+                "sliding_attention", "full_attention",
+                "sliding_attention", "full_attention",
+            ],
+            rope_theta=1000000.0,
+            rope_local_base_freq=10000.0,
+            rope_scaling={"rope_type": "linear", "factor": 8.0},
+            query_pre_attn_scalar=16,
+        )
+        cfg = _assert_parity(tmp_path, m, atol=5e-4)
+        assert cfg.rope_scaling == ("linear", 8.0)
+
+    def test_gemma3_multimodal_prefix_layouts(self, tmp_path):
+        """Both multimodal key layouts (legacy language_model.model.*,
+        newer model.language_model.*) normalize to the text layout;
+        vision-tower keys are dropped."""
+        import numpy as np
+        from dstack_tpu.models.convert_hf import (
+            _load_raw_state_dict,
+            config_from_hf,
+            convert_state_dict,
+        )
+
+        _save_tiny(
+            tmp_path, transformers.Gemma3TextConfig,
+            transformers.Gemma3ForCausalLM,
+            head_dim=16, sliding_window=8,
+            layer_types=["sliding_attention", "full_attention"] * 2,
+            rope_theta=1000000.0, rope_local_base_freq=10000.0,
+            query_pre_attn_scalar=16,
+        )
+        import json as _json
+        hf = _json.loads((tmp_path / "config.json").read_text())
+        config = config_from_hf(hf, dtype=jnp.float32)
+        sd = _load_raw_state_dict(tmp_path)
+        direct = convert_state_dict(dict(sd), config, "gemma3_text")
+        legacy = {f"language_model.{k}": v for k, v in sd.items()}
+        legacy["vision_tower.blocks.0.w"] = np.zeros((2, 2), np.float32)
+        newer = {
+            k.replace("model.", "model.language_model.", 1): v
+            for k, v in sd.items()
+        }
+        newer["model.vision_tower.blocks.0.w"] = np.zeros((2, 2), np.float32)
+        for variant in (legacy, newer):
+            got = convert_state_dict(variant, config, "gemma3")
+            np.testing.assert_array_equal(
+                np.asarray(got["embed"]), np.asarray(direct["embed"])
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got["layers"]["wq"]), np.asarray(direct["layers"]["wq"])
+            )
+
+    def test_gemma3_all_global_layout_zeroes_window(self):
+        """sliding_window set but every layer full_attention: the
+        window must be dropped, not silently applied uniformly."""
+        from dstack_tpu.models.convert_hf import config_from_hf
+
+        cfg = config_from_hf({
+            "model_type": "gemma3_text", "vocab_size": 128,
+            "hidden_size": 64, "intermediate_size": 96,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "head_dim": 16,
+            "sliding_window": 512,
+            "layer_types": ["full_attention", "full_attention"],
+        })
+        assert cfg.sliding_window == 0 and cfg.sliding_pattern == 0
+        assert llama.layer_windows(cfg) == [0, 0]
+
     def test_phi3_fused_projections(self, tmp_path):
         m = _save_tiny(
             tmp_path, transformers.Phi3Config, transformers.Phi3ForCausalLM,
@@ -261,6 +394,22 @@ class TestEngineParity:
         )
         self._assert_greedy_parity(tmp_path, m)
 
+    def test_gemma3_greedy_decode(self, tmp_path):
+        """Engine decode path: traced-window dual-rope selection inside
+        the layer scan + offset qk-norm must match HF generation."""
+        m = _save_tiny(
+            tmp_path, transformers.Gemma3TextConfig,
+            transformers.Gemma3ForCausalLM,
+            head_dim=16, sliding_window=8,
+            layer_types=[
+                "sliding_attention", "full_attention",
+                "sliding_attention", "full_attention",
+            ],
+            rope_theta=1000000.0, rope_local_base_freq=10000.0,
+            query_pre_attn_scalar=16,
+        )
+        self._assert_greedy_parity(tmp_path, m)
+
     def test_mixtral_greedy_decode(self, tmp_path):
         m = _save_tiny(
             tmp_path, transformers.MixtralConfig, transformers.MixtralForCausalLM,
@@ -344,7 +493,8 @@ class TestConfigRoundTrip:
 
     @pytest.mark.parametrize("name", [
         "llama-3.2-1b", "qwen-2.5-7b", "qwen-3-8b", "qwen-3-30b-a3b",
-        "mistral-7b", "gemma-2b", "gemma-2-2b", "mixtral-8x7b",
+        "mistral-7b", "gemma-2b", "gemma-2-2b", "gemma-3-1b",
+        "gemma-3-4b", "mixtral-8x7b",
     ])
     def test_flags_survive(self, name):
         from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
@@ -357,7 +507,7 @@ class TestConfigRoundTrip:
             "tie_embeddings", "qkv_bias", "qk_norm", "sliding_window",
             "sliding_pattern", "hidden_act", "norm_offset", "embed_scale",
             "post_norms", "attn_softcap", "logit_softcap", "n_experts",
-            "experts_per_token", "rope_scaling",
+            "experts_per_token", "rope_scaling", "rope_local_theta",
         ):
             assert getattr(c2, field) == getattr(c, field), (name, field)
         if c.attn_scale is not None:
