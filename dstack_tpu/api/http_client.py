@@ -282,6 +282,14 @@ class APIClient:
     def delete_fleets(self, project: str, names: list[str]) -> None:
         self._post(f"/api/project/{project}/fleets/delete", {"names": names})
 
+    def delete_fleet_instances(
+        self, project: str, name: str, instance_nums: list[int]
+    ) -> None:
+        self._post(
+            f"/api/project/{project}/fleets/delete_instances",
+            {"name": name, "instance_nums": instance_nums},
+        )
+
     # volumes
     def list_volumes(self, project: str) -> list[Volume]:
         return [
@@ -384,3 +392,26 @@ class APIClient:
 
     def delete_gateways(self, project: str, names: list[str]) -> None:
         self._post(f"/api/project/{project}/gateways/delete", {"names": names})
+
+    def get_gateway(self, project: str, name: str) -> Gateway:
+        return Gateway.model_validate(
+            self._post(f"/api/project/{project}/gateways/get", {"name": name})
+        )
+
+    def set_default_gateway(self, project: str, name: str) -> None:
+        self._post(
+            f"/api/project/{project}/gateways/set_default", {"name": name}
+        )
+
+    def set_gateway_wildcard_domain(
+        self, project: str, name: str, domain: str
+    ) -> Gateway:
+        return Gateway.model_validate(
+            self._post(
+                f"/api/project/{project}/gateways/set_wildcard_domain",
+                {"name": name, "wildcard_domain": domain},
+            )
+        )
+
+    def get_secret(self, project: str, name: str) -> dict:
+        return self._post(f"/api/project/{project}/secrets/get", {"name": name})

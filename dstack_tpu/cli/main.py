@@ -379,15 +379,28 @@ def fleet_list(project) -> None:
 
 @fleet.command("delete")
 @click.argument("name")
+@click.option(
+    "-i", "--instance", "instances", multiple=True, type=int,
+    help="terminate only these instance numbers (fleet stays)",
+)
 @click.option("--project", default=None)
 @click.option("-y", "--yes", is_flag=True)
-def fleet_delete(name, project, yes) -> None:
-    if not yes and not click.confirm(f"Delete fleet {name}?", default=True):
+def fleet_delete(name, instances, project, yes) -> None:
+    what = (
+        f"instances {', '.join(map(str, instances))} of fleet {name}"
+        if instances else f"fleet {name}"
+    )
+    if not yes and not click.confirm(f"Delete {what}?", default=True):
         return
     client = _client(project)
     try:
-        client.api.delete_fleets(client.project, [name])
-        console.print(f"[green]Deleting[/green] fleet {name}")
+        if instances:
+            client.api.delete_fleet_instances(
+                client.project, name, list(instances)
+            )
+        else:
+            client.api.delete_fleets(client.project, [name])
+        console.print(f"[green]Deleting[/green] {what}")
     except DstackTPUError as e:
         _die(str(e))
 
@@ -432,6 +445,36 @@ def gateway_delete(name, project, yes) -> None:
         _die(str(e))
 
 
+@gateway.command("set-default")
+@click.argument("name")
+@click.option("--project", default=None)
+def gateway_set_default(name, project) -> None:
+    """Make NAME the project's default gateway."""
+    client = _client(project)
+    try:
+        client.api.set_default_gateway(client.project, name)
+        console.print(f"[green]Default gateway:[/green] {name}")
+    except DstackTPUError as e:
+        _die(str(e))
+
+
+@gateway.command("set-wildcard-domain")
+@click.argument("name")
+@click.argument("domain")
+@click.option("--project", default=None)
+def gateway_set_wildcard_domain(name, domain, project) -> None:
+    """Set the gateway's wildcard domain (services get
+    run-name.DOMAIN hostnames)."""
+    client = _client(project)
+    try:
+        g = client.api.set_gateway_wildcard_domain(client.project, name, domain)
+        console.print(
+            f"[green]Gateway {name}[/green] domain: {g.configuration.domain}"
+        )
+    except DstackTPUError as e:
+        _die(str(e))
+
+
 @cli.group()
 def secret() -> None:
     """Manage project secrets."""
@@ -459,6 +502,19 @@ def secret_list(project) -> None:
     for s in client.api.list_secrets(client.project):
         t.add_row(s["name"])
     console.print(t)
+
+
+@secret.command("get")
+@click.argument("name")
+@click.option("--project", default=None)
+def secret_get(name, project) -> None:
+    """Print the secret's value (project members only)."""
+    client = _client(project)
+    try:
+        s = client.api.get_secret(client.project, name)
+        console.print(s["value"], markup=False)
+    except DstackTPUError as e:
+        _die(str(e))
 
 
 @secret.command("delete")

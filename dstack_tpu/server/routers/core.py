@@ -87,6 +87,38 @@ async def delete_users(ctx: RequestContext, body: s.DeleteUsersRequest):
     await users_service.delete_users(ctx.state["db"], body.users)
 
 
+@users_router.post("/get_user")
+async def get_user(ctx: RequestContext, body: s.GetUserRequest):
+    """Self or admin; admins see the user's token (reference
+    users.get_user hands the token to admins for handover)."""
+    if ctx.user["username"] != body.username:
+        _require_global_admin(ctx)
+    row = await users_service.get_user_by_name(ctx.state["db"], body.username)
+    if row is None:
+        raise ResourceNotExistsError(f"no such user {body.username}")
+    model = users_service.user_row_to_model(row)
+    from dstack_tpu.core.models.users import UserWithCreds
+
+    return UserWithCreds(**model.model_dump(), creds={"token": row["token"]})
+
+
+@users_router.post("/update")
+async def update_user(ctx: RequestContext, body: s.UpdateUserRequest):
+    _require_global_admin(ctx)
+    return await users_service.update_user(
+        ctx.state["db"], body.username,
+        global_role=body.global_role, email=body.email, active=body.active,
+    )
+
+
+@users_router.post("/refresh_token")
+async def refresh_user_token(ctx: RequestContext, body: s.RefreshTokenRequest):
+    """Self or admin: rotate the user's bearer token."""
+    if ctx.user["username"] != body.username:
+        _require_global_admin(ctx)
+    return await users_service.refresh_token(ctx.state["db"], body.username)
+
+
 def _require_global_admin(ctx: RequestContext) -> None:
     from dstack_tpu.core.errors import ForbiddenError
 
@@ -371,6 +403,24 @@ async def delete_fleets(ctx: RequestContext, body: s.DeleteFleetsRequest):
     await _delete(ctx.state["db"], ctx.project, body.names)
 
 
+@project_router.post("/fleets/get")
+async def get_fleet(ctx: RequestContext, body: s.GetByNameRequest):
+    from dstack_tpu.server.services.fleets import get_fleet as _get
+
+    return await _get(ctx.state["db"], ctx.project, body.name)
+
+
+@project_router.post("/fleets/delete_instances")
+async def delete_fleet_instances(
+    ctx: RequestContext, body: s.DeleteFleetInstancesRequest
+):
+    from dstack_tpu.server.services.fleets import (
+        delete_fleet_instances as _delete,
+    )
+
+    await _delete(ctx.state["db"], ctx.project, body.name, body.instance_nums)
+
+
 # ---- volumes ----
 
 
@@ -379,6 +429,13 @@ async def list_volumes(ctx: RequestContext):
     from dstack_tpu.server.services.volumes import list_volumes as _list
 
     return await _list(ctx.state["db"], ctx.project)
+
+
+@project_router.post("/volumes/get")
+async def get_volume(ctx: RequestContext, body: s.GetByNameRequest):
+    from dstack_tpu.server.services.volumes import get_volume as _get
+
+    return await _get(ctx.state["db"], ctx.project, body.name)
 
 
 @project_router.post("/volumes/apply")
@@ -419,6 +476,35 @@ async def delete_gateways(ctx: RequestContext, body: s.DeleteGatewaysRequest):
     await _delete(ctx.state["db"], ctx.project, body.names)
 
 
+@project_router.post("/gateways/get")
+async def get_gateway(ctx: RequestContext, body: s.GetByNameRequest):
+    from dstack_tpu.server.services.gateways import get_gateway as _get
+
+    return await _get(ctx.state["db"], ctx.project, body.name)
+
+
+@project_router.post("/gateways/set_default")
+async def set_default_gateway(ctx: RequestContext, body: s.GetByNameRequest):
+    from dstack_tpu.server.services.gateways import (
+        set_default_gateway as _set,
+    )
+
+    await _set(ctx.state["db"], ctx.project, body.name)
+
+
+@project_router.post("/gateways/set_wildcard_domain")
+async def set_gateway_wildcard_domain(
+    ctx: RequestContext, body: s.SetWildcardDomainRequest
+):
+    from dstack_tpu.server.services.gateways import (
+        set_wildcard_domain as _set,
+    )
+
+    return await _set(
+        ctx.state["db"], ctx.project, body.name, body.wildcard_domain
+    )
+
+
 # ---- secrets ----
 
 
@@ -453,6 +539,27 @@ async def create_secret(ctx: RequestContext, body: s.CreateSecretRequest):
                 "value": encrypt(body.value),
             },
         )
+
+
+@project_router.post("/secrets/get")
+async def get_secret(ctx: RequestContext, body: s.GetByNameRequest):
+    """Name + decrypted value (reference secrets.get — the project
+    MANAGER's read-back; list stays names-only). Plain members and
+    public-project visitors must not read credential values."""
+    from dstack_tpu.server.services.encryption import decrypt
+    from dstack_tpu.server.services.projects import check_project_access
+
+    db = ctx.state["db"]
+    await check_project_access(
+        db, ctx.project, ctx.user, require_role=ProjectRole.MANAGER
+    )
+    row = await db.fetchone(
+        "SELECT * FROM secrets WHERE project_id = ? AND name = ?",
+        (ctx.project["id"], body.name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"secret {body.name} not found")
+    return {"name": row["name"], "value": decrypt(row["value"])}
 
 
 @project_router.post("/secrets/delete")

@@ -29,6 +29,21 @@ class DeleteUsersRequest(CoreModel):
     users: list[str]
 
 
+class GetUserRequest(CoreModel):
+    username: str
+
+
+class UpdateUserRequest(CoreModel):
+    username: str
+    global_role: Optional[GlobalRole] = None
+    email: Optional[str] = None
+    active: Optional[bool] = None
+
+
+class RefreshTokenRequest(CoreModel):
+    username: str
+
+
 class CreateProjectRequest(CoreModel):
     project_name: str
     is_public: bool = False
@@ -110,6 +125,20 @@ class ApplyFleetRequest(CoreModel):
 
 class DeleteFleetsRequest(CoreModel):
     names: list[str]
+
+
+class DeleteFleetInstancesRequest(CoreModel):
+    name: str
+    instance_nums: list[int]
+
+
+class GetByNameRequest(CoreModel):
+    name: str
+
+
+class SetWildcardDomainRequest(CoreModel):
+    name: str
+    wildcard_domain: str
 
 
 class ApplyVolumeRequest(CoreModel):

@@ -54,6 +54,17 @@ async def fleet_row_to_model(db: Database, row: dict, project_name: str) -> Flee
     )
 
 
+async def get_fleet(db: Database, project_row: dict, name: str) -> Fleet:
+    """Single fleet with instances (reference fleets.get)."""
+    row = await db.fetchone(
+        "SELECT * FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_row["id"], name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"fleet {name} not found")
+    return await fleet_row_to_model(db, row, project_row["name"])
+
+
 async def list_fleets(db: Database, project_row: dict) -> list[Fleet]:
     rows = await db.fetchall(
         "SELECT * FROM fleets WHERE project_id = ? AND deleted = 0 ORDER BY created_at DESC",
@@ -143,6 +154,47 @@ async def apply_fleet(
             )
     row = await db.get_by_id("fleets", fleet_id)
     return await fleet_row_to_model(db, row, project_row["name"])
+
+
+async def delete_fleet_instances(
+    db: Database, project_row: dict, name: str, instance_nums: list[int]
+) -> None:
+    """Terminate specific instances of a fleet without deleting it
+    (reference fleets.delete_fleet_instances — ``dstack fleet delete
+    my-fleet -i 2``). Busy instances are rejected; the fleet stays and
+    its nodes-count reconciliation may re-provision replacements."""
+    if not instance_nums:
+        raise ClientError("no instance numbers given")
+    row = await db.fetchone(
+        "SELECT * FROM fleets WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_row["id"], name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"fleet {name} not found")
+    for num in instance_nums:
+        inst = await db.fetchone(
+            "SELECT * FROM instances WHERE fleet_id = ? AND instance_num = ? "
+            "AND deleted = 0",
+            (row["id"], num),
+        )
+        if inst is None:
+            raise ResourceNotExistsError(
+                f"fleet {name} has no instance {num}"
+            )
+        if inst["status"] == InstanceStatus.BUSY.value:
+            raise ClientError(f"instance {name}-{num} is busy")
+    await db.execute(
+        "UPDATE instances SET status = ?, last_processed_at = ? "
+        f"WHERE fleet_id = ? AND deleted = 0 AND instance_num IN "
+        f"({','.join('?' * len(instance_nums))}) AND status != ?",
+        (
+            InstanceStatus.TERMINATING.value,
+            now_utc().isoformat(),
+            row["id"],
+            *instance_nums,
+            InstanceStatus.TERMINATED.value,
+        ),
+    )
 
 
 async def delete_fleets(db: Database, project_row: dict, names: list[str]) -> None:

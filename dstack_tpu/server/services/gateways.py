@@ -85,6 +85,47 @@ async def create_gateway(
     return gateway_row_to_model(row, project_row["name"])
 
 
+async def _gateway_row_or_error(db: Database, project_row: dict, name: str) -> dict:
+    row = await db.fetchone(
+        "SELECT * FROM gateways WHERE project_id = ? AND name = ?",
+        (project_row["id"], name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"gateway {name} not found")
+    return row
+
+
+async def get_gateway(db: Database, project_row: dict, name: str) -> Gateway:
+    row = await _gateway_row_or_error(db, project_row, name)
+    return gateway_row_to_model(row, project_row["name"])
+
+
+async def set_default_gateway(db: Database, project_row: dict, name: str) -> None:
+    """Make ``name`` the project's default gateway (reference
+    gateways.set_default) — services without an explicit ``gateway:``
+    register here."""
+    row = await _gateway_row_or_error(db, project_row, name)
+    await db.execute(
+        "UPDATE gateways SET is_default = 0 WHERE project_id = ?",
+        (project_row["id"],),
+    )
+    await db.update_by_id("gateways", row["id"], {"is_default": 1})
+
+
+async def set_wildcard_domain(
+    db: Database, project_row: dict, name: str, domain: str
+) -> Gateway:
+    """Update the gateway's wildcard domain (reference
+    gateways.set_wildcard_domain); newly registered services get
+    ``run-name.domain`` hostnames from it."""
+    row = await _gateway_row_or_error(db, project_row, name)
+    conf = GatewayConfiguration.model_validate(loads(row["configuration"]))
+    conf.domain = domain or None
+    await db.update_by_id("gateways", row["id"], {"configuration": dumps(conf)})
+    row["configuration"] = dumps(conf)
+    return gateway_row_to_model(row, project_row["name"])
+
+
 async def delete_gateways(db: Database, project_row: dict, names: list[str]) -> None:
     from dstack_tpu.server.services import backends as backends_service
 

@@ -5,7 +5,11 @@ Parity: reference server/services/users.py.
 
 from typing import Optional
 
-from dstack_tpu.core.errors import ForbiddenError, ResourceExistsError, UnauthorizedError
+from dstack_tpu.core.errors import (
+    ForbiddenError,
+    ResourceExistsError,
+    ResourceNotExistsError,
+)
 from dstack_tpu.core.models.runs import new_uuid, now_utc
 from dstack_tpu.core.models.users import GlobalRole, User, UserWithCreds
 from dstack_tpu.server.db import Database
@@ -80,10 +84,41 @@ async def delete_users(db: Database, usernames: list[str]) -> None:
         await db.execute("DELETE FROM users WHERE username = ?", (name,))
 
 
+async def update_user(
+    db: Database,
+    username: str,
+    global_role: Optional[GlobalRole] = None,
+    email: Optional[str] = None,
+    active: Optional[bool] = None,
+) -> User:
+    """Admin edit of role/email/active (reference users.update). The
+    admin account keeps its role and stays active — demoting or
+    deactivating it would lock the server out of itself."""
+    row = await get_user_by_name(db, username)
+    if row is None:
+        raise ResourceNotExistsError(f"no such user {username}")
+    if username == "admin" and (
+        (global_role is not None and global_role != GlobalRole.ADMIN)
+        or active is False
+    ):
+        raise ForbiddenError("cannot demote or deactivate the admin user")
+    if global_role is not None:
+        row["global_role"] = global_role.value
+    if email is not None:
+        row["email"] = email or None
+    if active is not None:
+        row["active"] = 1 if active else 0
+    await db.execute(
+        "UPDATE users SET global_role = ?, email = ?, active = ? WHERE id = ?",
+        (row["global_role"], row["email"], row["active"], row["id"]),
+    )
+    return user_row_to_model(row)
+
+
 async def refresh_token(db: Database, username: str) -> UserWithCreds:
     row = await get_user_by_name(db, username)
     if row is None:
-        raise UnauthorizedError(f"no such user {username}")
+        raise ResourceNotExistsError(f"no such user {username}")
     token = generate_auth_token()
     await db.execute("UPDATE users SET token = ? WHERE id = ?", (token, row["id"]))
     row["token"] = token

@@ -36,6 +36,27 @@ def volume_row_to_model(row: dict, project_name: str, attachments=None) -> Volum
     )
 
 
+async def get_volume(db: Database, project_row: dict, name: str) -> Volume:
+    """Single volume with attachments (reference volumes.get)."""
+    row = await db.fetchone(
+        "SELECT * FROM volumes WHERE project_id = ? AND name = ? AND deleted = 0",
+        (project_row["id"], name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"volume {name} not found")
+    atts = await db.fetchall(
+        "SELECT * FROM volume_attachments WHERE volume_id = ?", (row["id"],)
+    )
+    return volume_row_to_model(
+        row,
+        project_row["name"],
+        [
+            VolumeAttachment(volume_id=a["volume_id"], instance_id=a["instance_id"])
+            for a in atts
+        ],
+    )
+
+
 async def list_volumes(db: Database, project_row: dict) -> list[Volume]:
     rows = await db.fetchall(
         "SELECT * FROM volumes WHERE project_id = ? AND deleted = 0",

@@ -438,9 +438,9 @@ async function pageFleets() {
 }
 
 async function pageFleetDetail(name) {
-  const fleets = await papi("/fleets/list");
-  const fleet = fleets.find((f) => f.name === name);
-  if (!fleet) return h("div", { class: "empty" }, `fleet ${name} not found`);
+  let fleet;
+  try { fleet = await papi("/fleets/get", { name }); }
+  catch (e) { return h("div", { class: "empty" }, `fleet ${name}: ${e.message}`); }
   return h("div", {},
     h("h1", {}, h("a", { href: "#/fleets" }, "Fleets"), " / ", name, " ",
       statusBadge(fleet.status)),
@@ -453,9 +453,10 @@ async function pageFleetDetail(name) {
     ),
     h("h1", {}, "Instances"),
     table(
-      ["Name", "Status", "Backend", "Region", "Resources", "Price"],
+      ["Name", "#", "Status", "Backend", "Region", "Resources", "Price", ""],
       (fleet.instances || []).map((i) => h("tr", {},
         h("td", {}, i.name),
+        h("td", {}, String(i.instance_num ?? "—")),
         h("td", {}, statusBadge(i.status)),
         h("td", {}, i.backend || "—"),
         h("td", {}, i.region || "—"),
@@ -463,6 +464,15 @@ async function pageFleetDetail(name) {
           ? `TPU ${i.instance_type.resources.tpu.version}-${i.instance_type.resources.tpu.chips}`
           : (i.instance_type?.name || "—")),
         h("td", {}, `$${(i.price || 0).toFixed(2)}/h`),
+        h("td", {}, ["terminating", "terminated"].includes(i.status) ? null :
+          h("button", { class: "danger", onclick: async () => {
+            try {
+              await papi("/fleets/delete_instances", {
+                name, instance_nums: [i.instance_num ?? 0],
+              });
+              toast(`Terminating ${i.name}`); render();
+            } catch (e) { toast("terminate failed: " + e.message); }
+          } }, "Terminate")),
       )),
       "No instances in this fleet",
     ),
@@ -583,16 +593,35 @@ async function pageGateways() {
       "type: gateway\nname: main-gw\nbackend: gcp\nregion: us-central1\ndomain: '*.example.com'",
     ),
     table(
-      ["Name", "Status", "Hostname", "Domain", ""],
+      ["Name", "Default", "Status", "Hostname", "Domain", ""],
       gws.map((g) => h("tr", {},
         h("td", {}, g.name),
+        h("td", {}, g.default ? "✓" : ""),
         h("td", {}, statusBadge(g.status)),
         h("td", {}, g.hostname || "—"),
         h("td", {}, g.configuration?.domain || "—"),
-        h("td", {}, h("button", { class: "danger", onclick: async () => {
-          await papi("/gateways/delete", { names: [g.name] });
-          toast(`Deleted gateway ${g.name}`); render();
-        } }, "Delete")),
+        h("td", {}, h("div", { class: "row-actions" },
+          g.default ? null : h("button", { onclick: async () => {
+            try {
+              await papi("/gateways/set_default", { name: g.name });
+              toast(`${g.name} is now the default gateway`); render();
+            } catch (e) { toast("failed: " + e.message); }
+          } }, "Make default"),
+          h("button", { onclick: async () => {
+            const domain = prompt(`Wildcard domain for ${g.name}`, g.configuration?.domain || "");
+            if (domain == null) return;
+            try {
+              await papi("/gateways/set_wildcard_domain", {
+                name: g.name, wildcard_domain: domain,
+              });
+              toast(`Domain updated`); render();
+            } catch (e) { toast("failed: " + e.message); }
+          } }, "Domain"),
+          h("button", { class: "danger", onclick: async () => {
+            await papi("/gateways/delete", { names: [g.name] });
+            toast(`Deleted gateway ${g.name}`); render();
+          } }, "Delete"),
+        )),
       )),
     ),
   );
@@ -713,19 +742,55 @@ async function pageUsers() {
     createdTokens,
     table(
       ["Username", "Global role", "Email", "Active", ""],
-      users.map((u) => h("tr", {},
-        h("td", {}, u.username),
-        h("td", {}, u.global_role),
-        h("td", {}, u.email || "—"),
-        h("td", {}, u.active ? "yes" : "no"),
-        h("td", {}, u.username === "admin" ? null :
-          h("button", { class: "danger", onclick: async () => {
-            try {
-              await api("/api/users/delete", { users: [u.username] });
-              toast(`Deleted ${u.username}`); render();
-            } catch (e) { toast("delete failed: " + e.message); }
-          } }, "Delete")),
-      )),
+      users.map((u) => {
+        const isAdmin = u.username === "admin";
+        const rowRole = h("select", { onchange: undefined },
+          ["user", "admin"].map((r) => {
+            const o = h("option", { value: r }, r);
+            if (r === u.global_role) o.selected = true;
+            return o;
+          }));
+        if (isAdmin) rowRole.disabled = true;
+        rowRole.onchange = async () => {
+          try {
+            await api("/api/users/update", {
+              username: u.username, global_role: rowRole.value,
+            });
+            toast(`${u.username} → ${rowRole.value}`); render();
+          } catch (e) { toast("update failed: " + e.message); }
+        };
+        return h("tr", {},
+          h("td", {}, u.username),
+          h("td", {}, rowRole),
+          h("td", {}, u.email || "—"),
+          h("td", {}, u.active ? "yes" : "no"),
+          h("td", {}, h("div", { class: "row-actions" },
+            h("button", { onclick: async () => {
+              try {
+                const r = await api("/api/users/refresh_token", { username: u.username });
+                createdTokens.append(h("div", { class: "kv" },
+                  h("div", { class: "k" }, `${u.username} new token`),
+                  h("div", {}, h("code", {}, r.creds?.token || "—"))));
+                toast(`Token rotated for ${u.username}`);
+              } catch (e) { toast("refresh failed: " + e.message); }
+            } }, "New token"),
+            isAdmin ? null : h("button", { onclick: async () => {
+              try {
+                await api("/api/users/update", {
+                  username: u.username, active: !u.active,
+                });
+                toast(`${u.username} ${u.active ? "deactivated" : "activated"}`); render();
+              } catch (e) { toast("update failed: " + e.message); }
+            } }, u.active ? "Deactivate" : "Activate"),
+            isAdmin ? null : h("button", { class: "danger", onclick: async () => {
+              try {
+                await api("/api/users/delete", { users: [u.username] });
+                toast(`Deleted ${u.username}`); render();
+              } catch (e) { toast("delete failed: " + e.message); }
+            } }, "Delete"),
+          )),
+        );
+      }),
     ),
   );
 }

@@ -198,3 +198,179 @@ class TestSecretsAPI:
             assert r.status == 200
         finally:
             await client.close()
+
+
+class TestGetByNameParity:
+    """The reference's single-resource reads + gateway admin verbs
+    (routers/{fleets,volumes,gateways,secrets}.py: /get, /set_default,
+    /set_wildcard_domain) — the console detail pages and CLI `get`
+    commands consume these."""
+
+    async def test_fleet_and_volume_get(self):
+        client, token = await _client()
+        try:
+            r = await client.post(
+                "/api/project/main/apply_yaml", headers=_auth(token),
+                json={"yaml": "type: fleet\nname: gfleet\nnodes: 1\n"},
+            )
+            assert r.status == 200, await r.text()
+            r = await client.post(
+                "/api/project/main/fleets/get", headers=_auth(token),
+                json={"name": "gfleet"},
+            )
+            assert (await r.json())["name"] == "gfleet"
+            r = await client.post(
+                "/api/project/main/fleets/get", headers=_auth(token),
+                json={"name": "nope"},
+            )
+            assert r.status == 404
+
+            r = await client.post(
+                "/api/project/main/volumes/apply", headers=_auth(token),
+                json={"configuration": {
+                    "type": "volume", "name": "gvol",
+                    "region": "us-central1", "size": 10,
+                }},
+            )
+            assert r.status == 200, await r.text()
+            r = await client.post(
+                "/api/project/main/volumes/get", headers=_auth(token),
+                json={"name": "gvol"},
+            )
+            body = await r.json()
+            assert body["name"] == "gvol" and "attachments" in body
+            r = await client.post(
+                "/api/project/main/volumes/get", headers=_auth(token),
+                json={"name": "nope"},
+            )
+            assert r.status == 404
+        finally:
+            await client.close()
+
+    async def test_gateway_get_default_wildcard(self):
+        client, token = await _client()
+        try:
+            for name in ("gw-a", "gw-b"):
+                r = await client.post(
+                    "/api/project/main/gateways/create", headers=_auth(token),
+                    json={"configuration": {
+                        "type": "gateway", "name": name, "backend": "gcp",
+                        "region": "us-central1",
+                    }},
+                )
+                assert r.status == 200, await r.text()
+            # first created one became the default
+            r = await client.post(
+                "/api/project/main/gateways/get", headers=_auth(token),
+                json={"name": "gw-a"},
+            )
+            assert (await r.json())["default"] is True
+
+            # flip the default; exactly one default at a time
+            r = await client.post(
+                "/api/project/main/gateways/set_default", headers=_auth(token),
+                json={"name": "gw-b"},
+            )
+            assert r.status == 200
+            r = await client.post(
+                "/api/project/main/gateways/list", headers=_auth(token)
+            )
+            defaults = {g["name"]: g["default"] for g in await r.json()}
+            assert defaults == {"gw-a": False, "gw-b": True}
+
+            # wildcard domain lands in the configuration
+            r = await client.post(
+                "/api/project/main/gateways/set_wildcard_domain",
+                headers=_auth(token),
+                json={"name": "gw-b", "wildcard_domain": "*.tpu.example.com"},
+            )
+            assert (await r.json())["configuration"]["domain"] == "*.tpu.example.com"
+        finally:
+            await client.close()
+
+    async def test_secret_get_roundtrip(self):
+        client, token = await _client()
+        try:
+            r = await client.post(
+                "/api/project/main/secrets/create", headers=_auth(token),
+                json={"name": "api_key", "value": "v4lue"},
+            )
+            assert r.status == 200
+            r = await client.post(
+                "/api/project/main/secrets/get", headers=_auth(token),
+                json={"name": "api_key"},
+            )
+            assert await r.json() == {"name": "api_key", "value": "v4lue"}
+            r = await client.post(
+                "/api/project/main/secrets/get", headers=_auth(token),
+                json={"name": "nope"},
+            )
+            assert r.status == 404
+        finally:
+            await client.close()
+
+
+class TestReviewFixes:
+    """Regressions from the round-3 code review of the parity
+    endpoints."""
+
+    async def test_secret_get_requires_manager(self):
+        """Plain project members must not read secret values (the
+        console's list stays names-only for them)."""
+        client, token = await _client()
+        try:
+            await client.post(
+                "/api/project/main/secrets/create", headers=_auth(token),
+                json={"name": "sk", "value": "topsecret"},
+            )
+            r = await client.post(
+                "/api/users/create", headers=_auth(token),
+                json={"username": "plain"},
+            )
+            plain_tok = (await r.json())["creds"]["token"]
+            await client.post(
+                "/api/project/main/set_members", headers=_auth(token),
+                json={"members": [
+                    {"username": "admin", "project_role": "admin"},
+                    {"username": "plain", "project_role": "user"},
+                ]},
+            )
+            r = await client.post(
+                "/api/project/main/secrets/get", headers=_auth(plain_tok),
+                json={"name": "sk"},
+            )
+            assert r.status == 403
+            # the member can still list names
+            r = await client.post(
+                "/api/project/main/secrets/list", headers=_auth(plain_tok)
+            )
+            assert await r.json() == [{"name": "sk"}]
+        finally:
+            await client.close()
+
+    async def test_fleet_delete_instances_empty_list(self):
+        client, token = await _client()
+        try:
+            await client.post(
+                "/api/project/main/apply_yaml", headers=_auth(token),
+                json={"yaml": "type: fleet\nname: efleet\nnodes: 1\n"},
+            )
+            r = await client.post(
+                "/api/project/main/fleets/delete_instances",
+                headers=_auth(token),
+                json={"name": "efleet", "instance_nums": []},
+            )
+            assert 400 <= r.status < 500
+        finally:
+            await client.close()
+
+    async def test_user_update_unknown_is_404(self):
+        client, token = await _client()
+        try:
+            for path in ("/api/users/update", "/api/users/refresh_token"):
+                r = await client.post(
+                    path, headers=_auth(token), json={"username": "ghost"}
+                )
+                assert r.status == 404, path
+        finally:
+            await client.close()

@@ -445,6 +445,131 @@ class TestConsoleAdminLoop:
         finally:
             await client.close()
 
+    async def test_user_update_refresh_and_get(self):
+        """users/update (role, active), users/refresh_token (rotation
+        invalidates the old token), users/get_user (admin sees the
+        token; non-admins only themselves) — the console Users page's
+        full surface (reference routers/users.py)."""
+        client = await self._app_client()
+        try:
+            r = await client.post(
+                "/api/users/create", headers=_auth("admin-tk"),
+                json={"username": "dave", "global_role": "user"},
+            )
+            tok = (await r.json())["creds"]["token"]
+
+            # role edit from the console
+            r = await client.post(
+                "/api/users/update", headers=_auth("admin-tk"),
+                json={"username": "dave", "global_role": "admin"},
+            )
+            assert r.status == 200
+            assert (await r.json())["global_role"] == "admin"
+            # non-admin can't update (demote dave back first to prove it)
+            r = await client.post(
+                "/api/users/update", headers=_auth("admin-tk"),
+                json={"username": "dave", "global_role": "user"},
+            )
+            r = await client.post(
+                "/api/users/update", headers=_auth(tok),
+                json={"username": "admin", "global_role": "user"},
+            )
+            assert r.status == 403
+            # the admin account can't be demoted or deactivated at all
+            r = await client.post(
+                "/api/users/update", headers=_auth("admin-tk"),
+                json={"username": "admin", "global_role": "user"},
+            )
+            assert r.status == 403
+
+            # get_user: self sees own creds; admin sees anyone's
+            r = await client.post(
+                "/api/users/get_user", headers=_auth(tok),
+                json={"username": "dave"},
+            )
+            assert (await r.json())["creds"]["token"] == tok
+            r = await client.post(
+                "/api/users/get_user", headers=_auth(tok),
+                json={"username": "admin"},
+            )
+            assert r.status == 403
+            r = await client.post(
+                "/api/users/get_user", headers=_auth("admin-tk"),
+                json={"username": "dave"},
+            )
+            assert r.status == 200
+
+            # token rotation: new token works, old one is dead
+            r = await client.post(
+                "/api/users/refresh_token", headers=_auth("admin-tk"),
+                json={"username": "dave"},
+            )
+            new_tok = (await r.json())["creds"]["token"]
+            assert new_tok != tok
+            r = await client.post("/api/users/get_my_user", headers=_auth(tok))
+            assert r.status in (401, 403)
+            r = await client.post(
+                "/api/users/get_my_user", headers=_auth(new_tok)
+            )
+            assert (await r.json())["username"] == "dave"
+
+            # deactivation kills auth without deleting the account
+            r = await client.post(
+                "/api/users/update", headers=_auth("admin-tk"),
+                json={"username": "dave", "active": False},
+            )
+            assert not (await r.json())["active"]
+            r = await client.post(
+                "/api/users/get_my_user", headers=_auth(new_tok)
+            )
+            assert r.status in (401, 403)
+        finally:
+            await client.close()
+
+    async def test_fleet_instance_termination(self):
+        """fleets/delete_instances: terminate one node of a fleet from
+        the console/CLI without deleting the fleet (reference
+        fleets.delete_fleet_instances)."""
+        client = await self._app_client(local_backend=True)
+        try:
+            r = await client.post(
+                "/api/project/main/apply_yaml", headers=_auth("admin-tk"),
+                json={"yaml": "type: fleet\nname: tfleet\nnodes: 2\n"},
+            )
+            assert r.status == 200, await r.text()
+            r = await client.post(
+                "/api/project/main/fleets/list", headers=_auth("admin-tk"),
+                json={},
+            )
+            fleet = next(f for f in await r.json() if f["name"] == "tfleet")
+            nums = [i["instance_num"] for i in fleet["instances"]]
+            assert sorted(nums) == [0, 1]
+
+            r = await client.post(
+                "/api/project/main/fleets/delete_instances",
+                headers=_auth("admin-tk"),
+                json={"name": "tfleet", "instance_nums": [1]},
+            )
+            assert r.status == 200, await r.text()
+            r = await client.post(
+                "/api/project/main/fleets/list", headers=_auth("admin-tk"),
+                json={},
+            )
+            fleet = next(f for f in await r.json() if f["name"] == "tfleet")
+            by_num = {i["instance_num"]: i["status"] for i in fleet["instances"]}
+            assert by_num[1] == "terminating"
+            assert by_num[0] != "terminating"
+
+            # unknown instance num is a clear client error
+            r = await client.post(
+                "/api/project/main/fleets/delete_instances",
+                headers=_auth("admin-tk"),
+                json={"name": "tfleet", "instance_nums": [9]},
+            )
+            assert 400 <= r.status < 500
+        finally:
+            await client.close()
+
     async def test_console_js_has_admin_surfaces(self):
         client = await self._app_client()
         try:
