@@ -508,7 +508,7 @@ def secret_list(project) -> None:
 @click.argument("name")
 @click.option("--project", default=None)
 def secret_get(name, project) -> None:
-    """Print the secret's value (project members only)."""
+    """Print the secret's value (project managers/admins only)."""
     client = _client(project)
     try:
         s = client.api.get_secret(client.project, name)

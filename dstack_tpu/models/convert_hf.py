@@ -52,12 +52,12 @@ __all__ = [
 def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     """HF ``config.json`` dict → :class:`LlamaConfig`."""
     mt = hf.get("model_type", "llama")
-    if mt == "gemma3" and "text_config" in hf:
+    if mt in ("gemma3", "llama4") and "text_config" in hf:
         # multimodal wrapper: the text tower's config is nested (the
         # vision tower is out of scope; load_checkpoint strips its
         # weights and the language_model prefix)
-        hf = {**hf["text_config"], "model_type": "gemma3_text"}
-        mt = "gemma3_text"
+        hf = {**hf["text_config"], "model_type": f"{mt}_text"}
+        mt = f"{mt}_text"
     hidden = hf["hidden_size"]
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hidden // n_heads
@@ -193,7 +193,58 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             if hf.get("query_pre_attn_scalar")
             else None,
         )
+    if mt in ("llama4", "llama4_text"):
+        return _llama4_config(hf, common)
     raise ValueError(f"unsupported HF model_type {mt!r}")
+
+
+def _llama4_config(hf: dict, common: dict) -> LlamaConfig:
+    """Llama4 text tower → LlamaConfig (interleaved rope, periodic NoPE
+    layers, chunked attention, qk L2 norm, temperature tuning,
+    sigmoid-input-scaled MoE with a shared expert)."""
+    n_layers = hf["num_hidden_layers"]
+    # every layer must be MoE: the uniform layer stack can't express
+    # Maverick's interleaved dense/MoE layers
+    step = hf.get("interleave_moe_layer_step", 1)
+    moe_layers = hf.get("moe_layers")
+    if step != 1 or (moe_layers is not None and len(moe_layers) != n_layers):
+        raise ValueError(
+            "llama4 with interleaved dense/MoE layers "
+            "(interleave_moe_layer_step != 1) is not supported"
+        )
+    # no_rope_layers: 1 = rope, 0 = NoPE; expect the periodic
+    # every-p-th-layer-NoPE layout
+    nrl = hf.get("no_rope_layers")
+    if nrl:
+        nope_ix = [i for i, use_rope in enumerate(nrl) if not use_rope]
+        if not nope_ix:
+            pattern = 0
+        else:
+            pattern = nope_ix[0] + 1
+            expect = [0 if (i + 1) % pattern == 0 else 1 for i in range(n_layers)]
+            if [1 if r else 0 for r in nrl] != expect:
+                raise ValueError(
+                    f"llama4 no_rope_layers {nrl!r} is not the periodic "
+                    f"1-NoPE-per-{pattern} layout this stack expresses"
+                )
+    else:
+        pattern = 4
+    return LlamaConfig(
+        **common,
+        rope_interleaved=True,
+        nope_pattern=pattern,
+        attention_chunk_size=hf.get("attention_chunk_size") or 0,
+        qk_l2_norm=bool(hf.get("use_qk_norm", True)),
+        attn_temp_scale=(
+            float(hf.get("attn_scale", 0.1))
+            if hf.get("attn_temperature_tuning") else 0.0
+        ),
+        attn_temp_floor=float(hf.get("floor_scale", 8192.0)),
+        n_experts=hf["num_local_experts"],
+        experts_per_token=hf.get("num_experts_per_tok", 1),
+        router_sigmoid_input=True,
+        moe_shared_expert=True,
+    )
 
 
 def _gemma3_pattern(hf: dict, sliding_window: int) -> tuple[int, int]:
@@ -312,7 +363,7 @@ def convert_state_dict(
             mats.append(m.T if transpose else m)
         return np.asarray(np.stack(mats), dt)
 
-    if model_type == "gemma3":
+    if model_type in ("gemma3", "llama4"):
         # multimodal checkpoint: keep the text tower, drop the vision
         # weights. Both layouts normalize to model.*:
         #   language_model.model.layers...   (<= 4.51)
@@ -325,6 +376,7 @@ def convert_state_dict(
             k = k.replace("language_model.", "", 1)
             stripped[k] = v
         sd = stripped or sd
+    llama4 = model_type in ("llama4", "llama4_text")
 
     P = "model.layers.{i}."
     gemma2 = model_type in ("gemma2", "gemma3", "gemma3_text")
@@ -351,7 +403,28 @@ def convert_state_dict(
     if c.post_norms:
         layers["attn_post_norm"] = stack(P + "post_attention_layernorm.weight")
         layers["mlp_post_norm"] = stack(P + "post_feedforward_layernorm.weight")
-    if c.n_experts:
+    if c.n_experts and llama4:
+        # Llama4 ships the experts FUSED and PRE-STACKED:
+        #   experts.gate_up_proj [E, H, 2F]  (gate then up, no transpose)
+        #   experts.down_proj    [E, F, H]
+        #   router.weight        [E, H]  (nn.Linear [out, in])
+        # plus a dense shared expert with plain Linear layout.
+        gus, downs, routers = [], [], []
+        for i in range(c.n_layers):
+            F = f"model.layers.{i}.feed_forward."
+            gus.append(_to_np(get(F + "experts.gate_up_proj")))
+            downs.append(_to_np(get(F + "experts.down_proj")))
+            routers.append(_to_np(get(F + "router.weight")).T)
+        gu = np.stack(gus)  # [L, E, H, 2F]
+        layers["w_gate"] = np.asarray(gu[..., : c.intermediate_size], dt)
+        layers["w_up"] = np.asarray(gu[..., c.intermediate_size :], dt)
+        layers["w_down"] = np.asarray(np.stack(downs), dt)
+        layers["w_router"] = np.asarray(np.stack(routers), dt)
+        SE = "feed_forward.shared_expert."
+        layers["w_shared_gate"] = stack(P + SE + "gate_proj.weight", transpose=True)
+        layers["w_shared_up"] = stack(P + SE + "up_proj.weight", transpose=True)
+        layers["w_shared_down"] = stack(P + SE + "down_proj.weight", transpose=True)
+    elif c.n_experts:
         router, expert_prefix, (g, u, d) = _MOE_NAMES.get(
             model_type, _MOE_NAMES["mixtral"]
         )
@@ -472,7 +545,23 @@ def config_to_hf(config: LlamaConfig) -> dict:
             "high_freq_factor": high_f,
             "original_max_position_embeddings": int(orig),
         }
-    if c.n_experts and c.qk_norm:
+    if c.rope_interleaved:
+        from dstack_tpu.models.llama import layer_nope as _layer_nope
+
+        hf.update(
+            model_type="llama4_text",
+            no_rope_layers=[0 if n else 1 for n in _layer_nope(c)],
+            attention_chunk_size=c.attention_chunk_size or None,
+            use_qk_norm=c.qk_l2_norm,
+            attn_temperature_tuning=bool(c.attn_temp_scale),
+            attn_scale=c.attn_temp_scale or 0.1,
+            floor_scale=c.attn_temp_floor,
+            num_local_experts=c.n_experts,
+            num_experts_per_tok=c.experts_per_token,
+            interleave_moe_layer_step=1,
+            intermediate_size_mlp=c.intermediate_size,
+        )
+    elif c.n_experts and c.qk_norm:
         hf.update(
             model_type="qwen3_moe",
             num_experts=c.n_experts,
@@ -571,7 +660,19 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
         if c.post_norms:
             sd[P + "post_attention_layernorm.weight"] = np32(L["attn_post_norm"][i])
             sd[P + "post_feedforward_layernorm.weight"] = np32(L["mlp_post_norm"][i])
-        if c.n_experts:
+        if c.n_experts and mt == "llama4_text":
+            # fused pre-stacked layout (see convert_state_dict)
+            F = P + "feed_forward."
+            sd[F + "router.weight"] = np32(L["w_router"][i]).T
+            sd[F + "experts.gate_up_proj"] = np.concatenate(
+                [np32(L["w_gate"][i]), np32(L["w_up"][i])], axis=-1
+            )
+            sd[F + "experts.down_proj"] = np32(L["w_down"][i])
+            SE = F + "shared_expert."
+            sd[SE + "gate_proj.weight"] = np32(L["w_shared_gate"][i]).T
+            sd[SE + "up_proj.weight"] = np32(L["w_shared_up"][i]).T
+            sd[SE + "down_proj.weight"] = np32(L["w_shared_down"][i]).T
+        elif c.n_experts:
             router, eprefix, (g, u, d) = _MOE_NAMES.get(
                 mt, _MOE_NAMES["mixtral"]
             )

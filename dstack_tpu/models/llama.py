@@ -74,6 +74,30 @@ class LlamaConfig:
     # while global layers use rope_theta (+ rope_scaling). 0 = single
     # rope for all layers.
     rope_local_theta: float = 0.0
+    # --- Llama4 deltas ---
+    # every `nope_pattern`-th layer skips rope entirely (NoPE long-
+    # context layers; Llama4: 4). 0 = rope everywhere.
+    nope_pattern: int = 0
+    # rope rotates interleaved (even, odd) pairs as complex numbers
+    # (Meta's original convention, kept by Llama4) instead of
+    # rotate-half
+    rope_interleaved: bool = False
+    # weightless L2 norm (x/rms(x), f32) on q/k AFTER rope, rope
+    # layers only
+    qk_l2_norm: bool = False
+    # rope layers attend within `attention_chunk_size`-token chunks
+    # (blockwise-local, NOT a sliding window); NoPE layers stay global.
+    # 0 = off.
+    attention_chunk_size: int = 0
+    # NoPE-layer query temperature tuning:
+    # q *= 1 + attn_temp_scale * log1p(floor((pos+1)/attn_temp_floor))
+    attn_temp_scale: float = 0.0
+    attn_temp_floor: float = 8192.0
+    # Llama4 MoE: gates are sigmoid(top-k logit) applied to the expert
+    # INPUT (not the output), plus a dense shared expert on every MoE
+    # layer
+    router_sigmoid_input: bool = False
+    moe_shared_expert: bool = False
     # sequence-parallel strategy on sp>1 meshes: "ring" (KV rotation,
     # any head count, lowest memory) or "ulysses" (head⇄seq all_to_all,
     # needs n_heads % sp == 0, keeps the flash kernel for windows)
@@ -97,6 +121,8 @@ class LlamaConfig:
     def num_params(self) -> int:
         e, h = self.vocab_size * self.hidden_size, self.hidden_size
         n_mlp = max(1, self.n_experts)
+        if self.n_experts and self.moe_shared_expert:
+            n_mlp += 1  # Llama4 dense shared expert
         per_layer = (
             h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
             + n_mlp * 3 * h * self.intermediate_size + 2 * h
@@ -109,14 +135,18 @@ class LlamaConfig:
 
     def num_active_params(self) -> int:
         """Parameters touched per token: for MoE, only the
-        ``experts_per_token`` routed experts' FFNs count (MFU/FLOPs
-        estimates must use this, not :meth:`num_params`)."""
+        ``experts_per_token`` routed experts' FFNs (plus the always-on
+        shared expert) count — MFU/FLOPs estimates must use this, not
+        :meth:`num_params`."""
         if not self.n_experts:
             return self.num_params()
         e, h = self.vocab_size * self.hidden_size, self.hidden_size
+        active_mlps = self.experts_per_token + (
+            1 if self.moe_shared_expert else 0
+        )
         per_layer = (
             h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
-            + self.experts_per_token * 3 * h * self.intermediate_size + 2 * h
+            + active_mlps * 3 * h * self.intermediate_size + 2 * h
             + h * self.n_experts  # router
         )
         out = 0 if self.tie_embeddings else e
@@ -198,6 +228,15 @@ GEMMA3_1B = LlamaConfig(
     post_norms=True, qk_norm=True, sliding_window=512, sliding_pattern=6,
     rope_local_theta=10000.0, attn_scale=256.0**-0.5,
 )
+LLAMA4_SCOUT = LlamaConfig(  # meta-llama/Llama-4-Scout-17B-16E text tower
+    vocab_size=202048, hidden_size=5120, n_layers=48, n_heads=40,
+    n_kv_heads=8, head_dim=128, intermediate_size=8192, rope_theta=500000.0,
+    norm_eps=1e-5, max_seq_len=262144,
+    rope_interleaved=True, nope_pattern=4, attention_chunk_size=8192,
+    qk_l2_norm=True, attn_temp_scale=0.1, attn_temp_floor=8192.0,
+    n_experts=16, experts_per_token=1, router_sigmoid_input=True,
+    moe_shared_expert=True,
+)
 GEMMA3_4B = LlamaConfig(  # text tower of google/gemma-3-4b
     vocab_size=262208, hidden_size=2560, n_layers=34, n_heads=8,
     n_kv_heads=4, head_dim=256, intermediate_size=10240, rope_theta=1e6,
@@ -224,6 +263,7 @@ CONFIGS = {
     "gemma-2-2b": GEMMA2_2B,
     "gemma-3-1b": GEMMA3_1B,
     "gemma-3-4b": GEMMA3_4B,
+    "llama-4-scout": LLAMA4_SCOUT,
 }
 
 
@@ -238,6 +278,10 @@ def param_specs(config: LlamaConfig) -> dict:
             "w_up": L + ("experts", "embed_fsdp", "mlp"),
             "w_down": L + ("experts", "mlp", "embed_fsdp"),
         }
+        if config.moe_shared_expert:  # dense: shard like a plain MLP
+            mlp["w_shared_gate"] = L + ("embed_fsdp", "mlp")
+            mlp["w_shared_up"] = L + ("embed_fsdp", "mlp")
+            mlp["w_shared_down"] = L + ("mlp", "embed_fsdp")
     else:
         mlp = {
             "mlp_norm": L + (None,),
@@ -299,6 +343,17 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
                 k[7], (L, E, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)
             ),
         }
+        if c.moe_shared_expert:  # Llama4 dense shared expert
+            mlp["w_shared_gate"] = normal(
+                jax.random.fold_in(key, 11), (L, c.hidden_size, c.intermediate_size)
+            )
+            mlp["w_shared_up"] = normal(
+                jax.random.fold_in(key, 12), (L, c.hidden_size, c.intermediate_size)
+            )
+            mlp["w_shared_down"] = normal(
+                jax.random.fold_in(key, 13),
+                (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L),
+            )
     else:
         mlp = {
             "mlp_norm": norm_init((L, c.hidden_size)),
@@ -366,7 +421,19 @@ def grouped_scan_layout(config: "LlamaConfig", xs: dict):
     llama.forward and the serve engine's prefill.
     """
     windows = layer_windows(config)
-    g = 1 if len(set(windows)) == 1 else config.sliding_pattern
+    nopes = layer_nope(config)
+    mixed_windows = len(set(windows)) > 1
+    mixed_nope = len(set(nopes)) > 1
+    if mixed_windows and mixed_nope:
+        raise ValueError(
+            "mixed sliding windows and NoPE layers together are not "
+            "supported (no known family combines them)"
+        )
+    g = (
+        config.sliding_pattern if mixed_windows
+        else config.nope_pattern if mixed_nope
+        else 1
+    )
     if g == 1:
         return g, windows, xs, None
     r = config.n_layers % g
@@ -400,6 +467,35 @@ def layer_windows(config: "LlamaConfig") -> list[int]:
             for i in range(c.n_layers)
         ]
     return [c.sliding_window] * c.n_layers
+
+
+def layer_nope(config: "LlamaConfig") -> list[bool]:
+    """Static per-layer NoPE flag: every ``nope_pattern``-th layer
+    (Llama4: 4) skips rope and attends globally. ``nope_pattern == 1``
+    means EVERY layer is NoPE (an all-zeros ``no_rope_layers``
+    checkpoint); 0 disables NoPE entirely."""
+    c = config
+    if not c.nope_pattern:
+        return [False] * c.n_layers
+    return [(i + 1) % c.nope_pattern == 0 for i in range(c.n_layers)]
+
+
+def l2_norm(x: jax.Array, eps: float) -> jax.Array:
+    """Weightless rms normalization in f32 (Llama4 qk norm)."""
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms).astype(x.dtype)
+
+
+def attn_temp_scales(positions: jax.Array, config: "LlamaConfig") -> jax.Array:
+    """Llama4 NoPE-layer query temperature tuning → [T] f32:
+    1 + attn_temp_scale * log1p(floor((pos+1)/floor_scale))."""
+    p = positions.astype(jnp.float32)
+    return (
+        jnp.log1p(jnp.floor((p + 1.0) / config.attn_temp_floor))
+        * config.attn_temp_scale
+        + 1.0
+    )
 
 
 def rope_freqs(
@@ -455,8 +551,18 @@ def layer_rope(ropes: tuple[tuple, tuple], config: "LlamaConfig", window: int):
     return ropes[1] if window else ropes[0]
 
 
-def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """x [B, H, T, D]; rotate-half convention."""
+def apply_rope(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, interleaved: bool = False
+) -> jax.Array:
+    """x [B, H, T, D]; rotate-half convention, or Meta/Llama4's
+    interleaved complex-pair rotation when ``interleaved``."""
+    if interleaved:
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        c = cos[None, None].astype(x.dtype)
+        s = sin[None, None].astype(x.dtype)
+        out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        return out.reshape(x.shape)
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
     c = cos[None, None].astype(x.dtype)
@@ -498,6 +604,8 @@ def _attention_block(
     rules: ShardingRules,
     attn_impl: Optional[str],
     window: int = 0,
+    nope: bool = False,
+    positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     c = config
     b, t, _ = x.shape
@@ -519,10 +627,25 @@ def _attention_block(
         k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
     q = constrain(q, rules, "batch", "heads", "seq", None, mesh=mesh)
     k = constrain(k, rules, "batch", "kv_heads", "seq", None, mesh=mesh)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    if not nope:
+        q = apply_rope(q, cos, sin, interleaved=c.rope_interleaved)
+        k = apply_rope(k, cos, sin, interleaved=c.rope_interleaved)
+        if c.qk_l2_norm:  # Llama4: weightless L2 norm AFTER rope
+            q = l2_norm(q, c.norm_eps)
+            k = l2_norm(k, c.norm_eps)
+    elif c.attn_temp_scale:
+        # Llama4 NoPE layers: position-dependent query temperature
+        pos = positions if positions is not None else jnp.arange(t)
+        q = q * attn_temp_scales(pos, c)[None, None, :, None].astype(q.dtype)
+    # Llama4 blockwise-chunked attention applies on rope layers only
+    chunk = 0 if nope else c.attention_chunk_size
     scale = c.attention_scale
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if use_sp and chunk:
+        raise NotImplementedError(
+            "chunked attention (Llama4) does not compose with sp "
+            "sequence parallelism yet"
+        )
     if use_sp and c.seq_parallel == "ulysses":
         from dstack_tpu.parallel.ulysses import ulysses_attention
 
@@ -538,7 +661,7 @@ def _attention_block(
     else:
         o = attention(
             q, k, v, causal=True, scale=scale, impl=attn_impl,
-            window=window, softcap=c.attn_softcap,
+            window=window, softcap=c.attn_softcap, chunk=chunk,
         )
     o = o.transpose(0, 2, 1, 3).reshape(b, t, c.q_dim)
     out = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
@@ -568,6 +691,7 @@ def _mlp_block(
             mesh,
             rules,
             renorm=config.router_renorm,
+            sigmoid_input=config.router_sigmoid_input,
         )
         aux_loss = (
             config.router_balance_coef * aux["balance"]
@@ -592,8 +716,8 @@ def _embed_tokens(
     mesh: Optional[Mesh],
     rules: ShardingRules,
     positions: Optional[jax.Array],
-) -> tuple[jax.Array, tuple]:
-    """Shared forward preamble → (x [B,T,H], dual rope pairs)."""
+) -> tuple[jax.Array, tuple, jax.Array]:
+    """Shared forward preamble → (x [B,T,H], dual rope pairs, pos)."""
     # Replicate the embed table for the token lookup: a gather from the
     # (vocab-tp, hidden-fsdp)-sharded table would produce hidden-sharded
     # activations that GSPMD can only reshard to batch/seq sharding by
@@ -607,7 +731,7 @@ def _embed_tokens(
         x = x * jnp.asarray(config.hidden_size**0.5, config.dtype)
     x = constrain(x, rules, "batch", "seq", None, mesh=mesh)
     pos = positions if positions is not None else jnp.arange(tokens.shape[1])
-    return x, dual_rope_freqs(config, pos)
+    return x, dual_rope_freqs(config, pos), pos
 
 
 def _lm_head(
@@ -693,24 +817,26 @@ def forward(
     """
     c = config
     rules = rules or default_rules()
-    x, ropes = _embed_tokens(params, tokens, c, mesh, rules, positions)
-    # mixed sliding/global layers (Gemma2/3) scan in groups of `g`
-    # sublayers so every window is static — the flash kernel stays
-    # usable (a traced window would force the masked XLA path), and
-    # Gemma3's per-layer rope theta resolves statically too
+    x, ropes, pos = _embed_tokens(params, tokens, c, mesh, rules, positions)
+    # mixed per-layer attention (Gemma2/3 sliding windows, Llama4 NoPE)
+    # scans in groups of `g` sublayers so every window/rope choice is
+    # static — the flash kernel stays usable (a traced window would
+    # force the masked XLA path)
     xs = _merge_lora(params["layers"], lora, lora_scale, c)
     g, windows, xs_main, xs_tail = grouped_scan_layout(c, xs)
+    nopes = layer_nope(c)
 
-    def make_group_fn(wins: tuple, stacked: bool):
+    def make_group_fn(wins: tuple, nps: tuple, stacked: bool):
         def group_fn(x, group):
             aux = jnp.zeros((), jnp.float32)
-            for i, w in enumerate(wins):
+            for i, (w, np_) in enumerate(zip(wins, nps)):
                 layer = (
                     jax.tree.map(lambda a: a[i], group) if stacked else group
                 )
                 cos, sin = layer_rope(ropes, c, w)
                 x = x + _attention_block(
-                    x, layer, c, cos, sin, mesh, rules, attn_impl, window=w
+                    x, layer, c, cos, sin, mesh, rules, attn_impl,
+                    window=w, nope=np_, positions=pos,
                 )
                 o, aux_i = _mlp_block(x, layer, c, mesh, rules)
                 x = x + o
@@ -731,14 +857,16 @@ def forward(
         return group_fn
 
     x, auxs = jax.lax.scan(
-        make_group_fn(tuple(windows[:g]), g > 1), x, xs_main
+        make_group_fn(tuple(windows[:g]), tuple(nopes[:g]), g > 1), x, xs_main
     )
     aux = jnp.sum(auxs)
     if xs_tail is not None:
         # pattern doesn't divide the layer count (Gemma3): the last
         # L % g layers run unrolled after the scan
         r = c.n_layers % g
-        x, aux_tail = make_group_fn(tuple(windows[-r:]), True)(x, xs_tail)
+        x, aux_tail = make_group_fn(
+            tuple(windows[-r:]), tuple(nopes[-r:]), True
+        )(x, xs_tail)
         aux = aux + aux_tail
     out = _lm_head(params, x, c, mesh, rules, return_hidden)
     return (out, aux) if return_aux else out
@@ -780,9 +908,14 @@ def forward_pipelined(
             "forward_pipelined supports a uniform attention window only "
             "(mixed sliding/global layers don't split into equal stages)"
         )
+    if any(layer_nope(c)) or c.attention_chunk_size:
+        raise ValueError(
+            "forward_pipelined does not support Llama4 NoPE/chunked "
+            "layers (mixed layer kinds don't split into equal stages)"
+        )
     window = windows[0]
     n_micro = n_micro or pp
-    x, ropes = _embed_tokens(params, tokens, c, mesh, rules, positions)
+    x, ropes, _pos = _embed_tokens(params, tokens, c, mesh, rules, positions)
     cos, sin = layer_rope(ropes, c, window)
 
     def stage_fn(stage_layers, x, extras):

@@ -45,6 +45,7 @@ def router(
     experts_per_token: int,
     capacity: int,
     renorm: bool = False,  # Mixtral: renormalize top-k gates to sum 1
+    sigmoid: bool = False,  # Llama4: gates are sigmoid(top-k logit)
 ) -> tuple[jax.Array, jax.Array, dict]:
     """Top-k routing → (dispatch [B,T,E,C] one-hot, combine [B,T,E,C], aux).
 
@@ -52,12 +53,20 @@ def router(
     sequence order per expert (cumsum positions), tokens overflowing an
     expert's capacity are dropped for that expert (their combine weight
     is zero — the residual stream carries them unchanged).
+
+    ``sigmoid``: experts are still chosen by top-k logit (softmax is
+    monotonic, so the selection is identical), but the gate value is
+    sigmoid(logit) — Llama4's router scoring.
     """
     logits = jnp.einsum(
         "bth,he->bte", x, w_router.astype(x.dtype), preferred_element_type=jnp.float32
     )  # [B, T, E] f32
     probs = jax.nn.softmax(logits, axis=-1)
-    gate_vals, expert_idx = jax.lax.top_k(probs, experts_per_token)  # [B,T,k]
+    if sigmoid:
+        top_logits, expert_idx = jax.lax.top_k(logits, experts_per_token)
+        gate_vals = jax.nn.sigmoid(top_logits)
+    else:
+        gate_vals, expert_idx = jax.lax.top_k(probs, experts_per_token)  # [B,T,k]
     if renorm:
         gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
 
@@ -101,13 +110,25 @@ def moe_mlp(
     mesh: Optional[Mesh],
     rules: Optional[ShardingRules],
     renorm: bool = False,
+    sigmoid_input: bool = False,  # Llama4: sigmoid gate scales the INPUT
 ) -> tuple[jax.Array, dict]:
-    """Sparse SwiGLU FFN → (output [B,T,H], aux losses)."""
+    """Sparse SwiGLU FFN → (output [B,T,H], aux losses).
+
+    ``sigmoid_input`` (Llama4): the sigmoid gate multiplies the token
+    *before* the expert FFN (scaling through the nonlinearity) and the
+    return combine is unweighted; a dense shared expert
+    (``w_shared_gate/up/down`` in ``layer``) adds to every token.
+    """
     b, t, h = x.shape
     cap = expert_capacity(t, n_experts, experts_per_token, capacity_factor)
     dispatch, combine, aux = router(
-        x, layer["w_router"], n_experts, experts_per_token, cap, renorm=renorm
+        x, layer["w_router"], n_experts, experts_per_token, cap,
+        renorm=renorm, sigmoid=sigmoid_input,
     )
+    if sigmoid_input:
+        # move the gate onto the dispatch side: expert input is g·x,
+        # combine returns the raw expert output
+        dispatch, combine = combine, dispatch
     # token shuffle: [B,T,E,C] × [B,T,H] → [E,B,C,H]; ep-sharding the
     # expert dim makes this the all_to_all dispatch
     xe = jnp.einsum("btec,bth->ebch", dispatch, x)
@@ -121,6 +142,12 @@ def moe_mlp(
     if rules is not None:
         y = constrain(y, rules, "experts", "batch_noexp", None, None, mesh=mesh)
     out = jnp.einsum("btec,ebch->bth", combine, y)
+    if "w_shared_gate" in layer:  # Llama4 dense shared expert
+        sg = jnp.einsum("bth,hf->btf", x, layer["w_shared_gate"])
+        su = jnp.einsum("bth,hf->btf", x, layer["w_shared_up"])
+        out = out + jnp.einsum(
+            "btf,fh->bth", jax.nn.silu(sg) * su, layer["w_shared_down"]
+        )
     if rules is not None:
         out = constrain(out, rules, "batch", "seq", None, mesh=mesh)
     return out, aux

@@ -38,6 +38,7 @@ def _xla_attention(
     q_offset: int = 0,
     window: int = 0,
     softcap: float = 0.0,
+    chunk: int = 0,
 ) -> jax.Array:
     b, h, tq, d = q.shape
     hkv = k.shape[1]
@@ -48,7 +49,7 @@ def _xla_attention(
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if softcap:
         s = softcap * jnp.tanh(s / softcap)  # cap raw scores, then mask
-    if causal or window:
+    if causal or window or chunk:
         tk = k.shape[2]
         qi = q_offset + jnp.arange(tq)[:, None]
         kj = jnp.arange(tk)[None, :]
@@ -57,6 +58,11 @@ def _xla_attention(
             # HF sliding-window convention: key j visible to query i
             # iff 0 <= i - j < window
             keep = keep & (qi - kj < window)
+        if chunk:
+            # Llama4 chunked attention: key j visible to query i iff
+            # both land in the same `chunk`-token block (blockwise
+            # local, not a sliding window)
+            keep = keep & (qi // chunk == kj // chunk)
         s = jnp.where(keep, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
@@ -72,10 +78,24 @@ def attention(
     q_offset: int = 0,
     window: int = 0,  # 0 = full attention; else sliding window size
     softcap: float = 0.0,  # 0 = off; else tanh soft-cap on scores
+    chunk: int = 0,  # 0 = off; else Llama4 blockwise-chunk size
     impl: Optional[str] = None,  # None=auto | "flash" | "xla"
 ) -> jax.Array:
     """Dispatching attention entry point used by models."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if chunk and causal and q_offset + q.shape[2] <= chunk:
+        # all queries live in the first chunk, and causal masking
+        # already hides every key past them — identical to plain
+        # causal regardless of the KV buffer length (serving prefill
+        # passes the full cache row), so the flash path stays eligible
+        chunk = 0
+    if chunk:
+        # the pallas kernel has no chunk mask; blockwise-local layers
+        # beyond one chunk take the masked XLA path
+        return _xla_attention(
+            q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+            window=window, softcap=softcap, chunk=chunk,
+        )
     if impl == "flash" or (impl is None and flash_supported(q, k)):
         return flash_attention(
             q, k, v, causal=causal, scale=scale, q_offset=q_offset,

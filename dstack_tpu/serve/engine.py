@@ -89,12 +89,18 @@ def init_cache(
 # ---------------------------------------------------------------------------
 
 
-def _apply_rope_batch(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+def _apply_rope_batch(
+    x: jax.Array, cos: jax.Array, sin: jax.Array, interleaved: bool = False
+) -> jax.Array:
     """x [B, H, 1, D]; cos/sin [B, D/2] (per-slot positions)."""
-    d2 = x.shape[-1] // 2
-    x1, x2 = x[..., :d2], x[..., d2:]
     c = cos[:, None, None, :].astype(x.dtype)
     s = sin[:, None, None, :].astype(x.dtype)
+    if interleaved:  # Llama4: complex rotation of (even, odd) pairs
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+        return out.reshape(x.shape)
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
@@ -109,6 +115,7 @@ def _mlp(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
         mo, _ = moe.moe_mlp(
             m, layer, c.n_experts, c.experts_per_token, c.capacity_factor,
             None, None, renorm=c.router_renorm,
+            sigmoid_input=c.router_sigmoid_input,
         )
     else:
         g = _proj(layer, "w_gate", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
@@ -196,8 +203,11 @@ def prefill_chunk_step(
     """
     from dstack_tpu.models.llama import (
         apply_rope,
+        attn_temp_scales,
         dual_rope_freqs,
         grouped_scan_layout,
+        l2_norm,
+        layer_nope,
         layer_rope,
         sublayer,
     )
@@ -206,13 +216,15 @@ def prefill_chunk_step(
     c = config
     b, cl = tokens.shape
     x = _embed_lookup(params, tokens, c)
-    ropes = dual_rope_freqs(c, start + jnp.arange(cl))
+    chunk_pos = start + jnp.arange(cl)
+    ropes = dual_rope_freqs(c, chunk_pos)
     scale = c.attention_scale
     g, windows, xs_main, xs_tail = grouped_scan_layout(
         c, {"layer": params["layers"], "ck": cache["k"], "cv": cache["v"]}
     )
+    nopes = layer_nope(c)
 
-    def one_layer(x, layer, ck, cv, window):
+    def one_layer(x, layer, ck, cv, window, nope):
         # ck/cv [B_pool, Hkv, Tmax, D] — this layer's cache
         cos, sin = layer_rope(ropes, c, window)
         h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
@@ -223,8 +235,14 @@ def prefill_chunk_step(
         if c.qk_norm:
             q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
             k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        if not nope:
+            q = apply_rope(q, cos, sin, interleaved=c.rope_interleaved)
+            k = apply_rope(k, cos, sin, interleaved=c.rope_interleaved)
+            if c.qk_l2_norm:  # Llama4: weightless L2 norm after rope
+                q = l2_norm(q, c.norm_eps)
+                k = l2_norm(k, c.norm_eps)
+        elif c.attn_temp_scale:  # Llama4 NoPE query temperature
+            q = q * attn_temp_scales(chunk_pos, c)[None, None, :, None].astype(q.dtype)
         # write the chunk's K/V into the slot's row, then attend over
         # the whole row: positions beyond start+i are causally masked,
         # so stale data past the prompt is never read
@@ -239,6 +257,7 @@ def prefill_chunk_step(
         o = attention(
             q, row_k, row_v, causal=True, scale=scale, q_offset=start,
             window=window, softcap=c.attn_softcap,
+            chunk=0 if nope else c.attention_chunk_size,
         )
         o = o.transpose(0, 2, 1, 3).reshape(b, cl, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
@@ -252,7 +271,7 @@ def prefill_chunk_step(
         for i in range(g):
             sub = sublayer(group, i, g)
             x, ck, cv = one_layer(
-                x, sub["layer"], sub["ck"], sub["cv"], windows[i]
+                x, sub["layer"], sub["ck"], sub["cv"], windows[i], nopes[i]
             )
             cks.append(ck)
             cvs.append(cv)
@@ -273,7 +292,7 @@ def prefill_chunk_step(
             sub = jax.tree.map(lambda a: a[j], xs_tail)
             x, ck, cv = one_layer(
                 x, sub["layer"], sub["ck"], sub["cv"],
-                windows[c.n_layers - r + j],
+                windows[c.n_layers - r + j], nopes[c.n_layers - r + j],
             )
             tks.append(ck)
             tvs.append(cv)
@@ -302,7 +321,13 @@ def decode_step(
     K/V into their slot — a decode step interleaved between prefill
     chunks would otherwise corrupt the prompt being written.
     """
-    from dstack_tpu.models.llama import dual_rope_freqs, layer_windows
+    from dstack_tpu.models.llama import (
+        attn_temp_scales,
+        dual_rope_freqs,
+        l2_norm,
+        layer_nope,
+        layer_windows,
+    )
 
     c = config
     b = tokens.shape[0]
@@ -314,12 +339,17 @@ def decode_step(
     (cos, sin), (cos_l, sin_l) = dual_rope_freqs(c, positions)  # [B, D/2]
     batch_ix = jnp.arange(b)
     scale = c.attention_scale
-    # decode attention is a masked einsum, so a *traced* per-layer window
-    # can ride the scan — no grouped unrolling needed here
+    # decode attention is a masked einsum, so *traced* per-layer window
+    # and NoPE flags can ride the scan — no grouped unrolling needed
     windows = jnp.asarray(layer_windows(c), jnp.int32)
+    nopes = jnp.asarray(layer_nope(c), bool)
+    has_nope = any(layer_nope(c))
+    temp = (
+        attn_temp_scales(positions, c) if c.attn_temp_scale else None
+    )  # [B]
 
     def layer_fn(x, layer_and_cache):
-        layer, ck, cv, window = layer_and_cache  # ck/cv [B, Hkv, Tmax, D]
+        layer, ck, cv, window, nope = layer_and_cache  # ck/cv [B,Hkv,Tmax,D]
         # Gemma3 dual rope rides the traced window too: sliding layers
         # (window > 0) rotate with the local-theta pair
         cs, sn = (
@@ -334,8 +364,19 @@ def decode_step(
         if c.qk_norm:  # Qwen3/Gemma3: per-head-dim RMSNorm before rope
             q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
             k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
-        q = _apply_rope_batch(q, cs, sn)
-        k = _apply_rope_batch(k, cs, sn)
+        q_ro = _apply_rope_batch(q, cs, sn, interleaved=c.rope_interleaved)
+        k_ro = _apply_rope_batch(k, cs, sn, interleaved=c.rope_interleaved)
+        if c.qk_l2_norm:  # Llama4: weightless L2 norm after rope
+            q_ro = l2_norm(q_ro, c.norm_eps)
+            k_ro = l2_norm(k_ro, c.norm_eps)
+        if has_nope:  # Llama4 NoPE layers keep the unrotated q/k
+            q_no = q
+            if temp is not None:
+                q_no = q_no * temp[:, None, None, None].astype(q.dtype)
+            q = jnp.where(nope, q_no, q_ro)
+            k = jnp.where(nope, k, k_ro)
+        else:
+            q, k = q_ro, k_ro
         # write this token's K/V at each slot's position (masked rows
         # get an out-of-range index → dropped)
         ck = ck.at[batch_ix, :, write_pos].set(k[:, :, 0, :], mode="drop")
@@ -359,6 +400,10 @@ def decode_step(
         mask = jnp.logical_and(
             mask, jnp.logical_or(window == 0, pos - kj < window)
         )
+        if c.attention_chunk_size:
+            # Llama4: rope layers attend within their chunk only
+            start = (pos // c.attention_chunk_size) * c.attention_chunk_size
+            mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= start))
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhgk,bhkd->bhgd", p.astype(cv.dtype), cv)
@@ -371,7 +416,7 @@ def decode_step(
         return _mlp(x, layer, c), (ck, cv)
 
     x, (ks, vs) = jax.lax.scan(
-        layer_fn, x, (params["layers"], cache["k"], cache["v"], windows)
+        layer_fn, x, (params["layers"], cache["k"], cache["v"], windows, nopes)
     )
     cache = {"k": ks, "v": vs}
     x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)
@@ -447,7 +492,13 @@ def verify_step(
     until the real tokens decode over it — the same masked-future
     invariant padding relies on.
     """
-    from dstack_tpu.models.llama import dual_rope_freqs, layer_windows
+    from dstack_tpu.models.llama import (
+        attn_temp_scales,
+        dual_rope_freqs,
+        l2_norm,
+        layer_nope,
+        layer_windows,
+    )
 
     c = config
     b, sdraft = tokens.shape
@@ -463,18 +514,28 @@ def verify_step(
     batch_ix = jnp.arange(b)
     scale = c.attention_scale
     windows = jnp.asarray(layer_windows(c), jnp.int32)
+    nopes = jnp.asarray(layer_nope(c), bool)
+    has_nope = any(layer_nope(c))
+    temp = (
+        attn_temp_scales(pos_grid.reshape(-1), c).reshape(b, sdraft)
+        if c.attn_temp_scale else None
+    )  # [B, S]
     tmax = cache["k"].shape[3]
     write_pos = jnp.where(write_mask[:, None], pos_grid, tmax)  # [B, S]
 
     def rope_rows(t, cos, sin):  # t [B, Hh, S, D]
-        d2 = t.shape[-1] // 2
-        t1, t2 = t[..., :d2], t[..., d2:]
         cc = cos[:, None].astype(t.dtype)  # [B, 1, S, D/2]
         ss = sin[:, None].astype(t.dtype)
+        if c.rope_interleaved:  # Llama4 complex-pair rotation
+            t1, t2 = t[..., 0::2], t[..., 1::2]
+            out = jnp.stack([t1 * cc - t2 * ss, t2 * cc + t1 * ss], axis=-1)
+            return out.reshape(t.shape)
+        d2 = t.shape[-1] // 2
+        t1, t2 = t[..., :d2], t[..., d2:]
         return jnp.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss], axis=-1)
 
     def layer_fn(x, layer_and_cache):
-        layer, ck, cv, window = layer_and_cache
+        layer, ck, cv, window, nope = layer_and_cache
         cs, sn = (
             (jnp.where(window > 0, cos_l, cos), jnp.where(window > 0, sin_l, sin))
             if c.rope_local_theta else (cos, sin)
@@ -487,8 +548,19 @@ def verify_step(
         if c.qk_norm:
             q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
             k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
-        q = rope_rows(q, cs, sn)
-        k = rope_rows(k, cs, sn)
+        q_ro = rope_rows(q, cs, sn)
+        k_ro = rope_rows(k, cs, sn)
+        if c.qk_l2_norm:
+            q_ro = l2_norm(q_ro, c.norm_eps)
+            k_ro = l2_norm(k_ro, c.norm_eps)
+        if has_nope:
+            q_no = q
+            if temp is not None:
+                q_no = q_no * temp[:, None, :, None].astype(q.dtype)
+            q = jnp.where(nope, q_no, q_ro)
+            k = jnp.where(nope, k, k_ro)
+        else:
+            q, k = q_ro, k_ro
         # scatter the S tokens' K/V at their per-row positions
         ck = ck.at[batch_ix[:, None], :, write_pos].set(
             k.transpose(0, 2, 1, 3), mode="drop"
@@ -511,6 +583,9 @@ def verify_step(
         mask = jnp.logical_and(
             mask, jnp.logical_or(window == 0, qpos - kj < window)
         )
+        if c.attention_chunk_size:
+            cstart = (qpos // c.attention_chunk_size) * c.attention_chunk_size
+            mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= cstart))
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhgsk,bhkd->bhgsd", p.astype(cv.dtype), cv)
@@ -522,7 +597,7 @@ def verify_step(
         return _mlp(x, layer, c), (ck, cv)
 
     x, (ks, vs) = jax.lax.scan(
-        layer_fn, x, (params["layers"], cache["k"], cache["v"], windows)
+        layer_fn, x, (params["layers"], cache["k"], cache["v"], windows, nopes)
     )
     cache = {"k": ks, "v": vs}
     x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)

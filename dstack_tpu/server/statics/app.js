@@ -464,11 +464,13 @@ async function pageFleetDetail(name) {
           ? `TPU ${i.instance_type.resources.tpu.version}-${i.instance_type.resources.tpu.chips}`
           : (i.instance_type?.name || "—")),
         h("td", {}, `$${(i.price || 0).toFixed(2)}/h`),
-        h("td", {}, ["terminating", "terminated"].includes(i.status) ? null :
+        h("td", {},
+          ["terminating", "terminated"].includes(i.status)
+            || typeof i.instance_num !== "number" ? null :
           h("button", { class: "danger", onclick: async () => {
             try {
               await papi("/fleets/delete_instances", {
-                name, instance_nums: [i.instance_num ?? 0],
+                name, instance_nums: [i.instance_num],
               });
               toast(`Terminating ${i.name}`); render();
             } catch (e) { toast("terminate failed: " + e.message); }

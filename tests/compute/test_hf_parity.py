@@ -330,6 +330,127 @@ class TestHFParity:
         np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
 
 
+class TestLlama4:
+    """Llama4 text tower: interleaved-pair rope, periodic NoPE layers,
+    chunked attention, post-rope L2 qk norm, NoPE query temperature
+    tuning, and the sigmoid-input-scaled MoE with a shared expert."""
+
+    def _tiny(self, tmp_path, **kw):
+        return _save_tiny(
+            tmp_path, transformers.Llama4TextConfig,
+            transformers.Llama4ForCausalLM,
+            head_dim=16,
+            num_local_experts=4,
+            num_experts_per_tok=1,
+            interleave_moe_layer_step=1,
+            no_rope_layers=[1, 1, 1, 0],  # layer 3 NoPE
+            attention_chunk_size=8,
+            attn_temperature_tuning=True,
+            attn_scale=0.1,
+            floor_scale=4.0,
+            use_qk_norm=True,
+            rope_theta=500000.0,
+            **kw,
+        )
+
+    def test_llama4_logit_parity(self, tmp_path):
+        m = self._tiny(tmp_path)
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        assert config.rope_interleaved and config.qk_l2_norm
+        assert config.nope_pattern == 4 and config.attention_chunk_size == 8
+        assert config.router_sigmoid_input and config.moe_shared_expert
+        assert llama.layer_nope(config) == [False, False, False, True]
+        params = jax.device_put(params)
+        # no-drop capacity: static dispatch exact vs HF dense compute
+        config = llama.dataclasses.replace(
+            config, remat=False, capacity_factor=float(config.n_experts)
+        )
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, config.vocab_size, (B, T))
+        with torch.no_grad():
+            ref = m(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(params, jnp.asarray(tokens), config)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+    def test_llama4_chunked_attention_bites(self, tmp_path):
+        """The chunk mask actually changes logits vs full attention
+        (T=16 spans two 8-token chunks)."""
+        self._tiny(tmp_path)
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(
+            config, remat=False, capacity_factor=float(config.n_experts)
+        )
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, config.vocab_size, (B, T)))
+        chunked = llama.forward(params, tokens, config)
+        full = llama.forward(
+            params, tokens,
+            llama.dataclasses.replace(config, attention_chunk_size=0),
+        )
+        assert not np.allclose(np.asarray(chunked), np.asarray(full))
+
+    def test_llama4_greedy_decode(self, tmp_path):
+        m = self._tiny(tmp_path)
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(
+            config, remat=False, capacity_factor=float(config.n_experts)
+        )
+        from dstack_tpu.serve.engine import decode_step, init_cache, prefill
+
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(1, config.vocab_size, (1, 12))
+        n_new = 8
+        with torch.no_grad():
+            hf_out = m.generate(
+                torch.tensor(prompt), max_new_tokens=n_new, do_sample=False,
+                eos_token_id=None, pad_token_id=0,
+            ).numpy()[0, prompt.shape[1]:]
+        cache = init_cache(config, max_batch=1, max_seq=32)
+        logits, cache = prefill(
+            params, jnp.asarray(prompt), jnp.asarray([prompt.shape[1]]),
+            jnp.asarray(0), config, cache,
+        )
+        out = []
+        pos = prompt.shape[1]
+        for _ in range(n_new):
+            nxt = jnp.argmax(logits[0]).astype(jnp.int32)
+            out.append(int(nxt))
+            logits, cache = decode_step(
+                params, cache, jnp.asarray([nxt]), jnp.asarray([pos]), config
+            )
+            pos += 1
+        assert out == hf_out.tolist()
+
+    def test_llama4_all_nope_layout(self):
+        """no_rope_layers all zeros → every layer NoPE (pattern 1 must
+        not invert back to rope-everywhere)."""
+        from dstack_tpu.models.convert_hf import config_from_hf
+
+        cfg = config_from_hf({
+            "model_type": "llama4_text", "vocab_size": 128,
+            "hidden_size": 64, "intermediate_size": 96,
+            "num_hidden_layers": 3, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "num_local_experts": 4,
+            "no_rope_layers": [0, 0, 0],
+        })
+        assert cfg.nope_pattern == 1
+        assert llama.layer_nope(cfg) == [True, True, True]
+
+    def test_llama4_interleaved_moe_rejected(self):
+        from dstack_tpu.models.convert_hf import config_from_hf
+
+        with pytest.raises(ValueError, match="interleave"):
+            config_from_hf({
+                "model_type": "llama4_text", "vocab_size": 128,
+                "hidden_size": 64, "intermediate_size": 96,
+                "num_hidden_layers": 4, "num_attention_heads": 4,
+                "num_key_value_heads": 2, "num_local_experts": 4,
+                "interleave_moe_layer_step": 2,
+            })
+
+
 class TestEngineParity:
     """KV-cache decode (prefill + decode_step) vs HF greedy generation.
 
@@ -494,7 +615,7 @@ class TestConfigRoundTrip:
     @pytest.mark.parametrize("name", [
         "llama-3.2-1b", "qwen-2.5-7b", "qwen-3-8b", "qwen-3-30b-a3b",
         "mistral-7b", "gemma-2b", "gemma-2-2b", "gemma-3-1b",
-        "gemma-3-4b", "mixtral-8x7b",
+        "gemma-3-4b", "mixtral-8x7b", "llama-4-scout",
     ])
     def test_flags_survive(self, name):
         from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
@@ -508,6 +629,9 @@ class TestConfigRoundTrip:
             "sliding_pattern", "hidden_act", "norm_offset", "embed_scale",
             "post_norms", "attn_softcap", "logit_softcap", "n_experts",
             "experts_per_token", "rope_scaling", "rope_local_theta",
+            "nope_pattern", "rope_interleaved", "qk_l2_norm",
+            "attention_chunk_size", "attn_temp_scale", "attn_temp_floor",
+            "router_sigmoid_input", "moe_shared_expert",
         ):
             assert getattr(c2, field) == getattr(c, field), (name, field)
         if c.attn_scale is not None:
