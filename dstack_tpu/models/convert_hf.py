@@ -29,6 +29,7 @@ Layout notes:
 """
 
 import json
+import math
 from pathlib import Path
 from typing import Any, Optional
 
@@ -195,7 +196,66 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         )
     if mt in ("llama4", "llama4_text"):
         return _llama4_config(hf, common)
+    if mt in ("deepseek_v2", "deepseek_v3"):
+        return _deepseek_config(hf, common, mt)
     raise ValueError(f"unsupported HF model_type {mt!r}")
+
+
+def _deepseek_config(hf: dict, common: dict, mt: str) -> LlamaConfig:
+    """DeepSeek-V2/V3 → LlamaConfig: MLA attention (latent kv, split
+    nope/rope head dims, own v dim), dense-prelude + fine-grained MoE
+    with shared experts; V3 adds sigmoid scoring with a selection-only
+    correction bias and group-limited top-k."""
+    if hf.get("attention_bias"):
+        raise ValueError(f"{mt} attention_bias=true is not supported")
+    v3 = mt == "deepseek_v3"
+    mla = dict(
+        q_lora_rank=hf.get("q_lora_rank") or 0,
+        kv_lora_rank=hf["kv_lora_rank"],
+        qk_nope_head_dim=hf["qk_nope_head_dim"],
+        qk_rope_head_dim=hf["qk_rope_head_dim"],
+        v_head_dim=hf["v_head_dim"],
+    )
+    rs = hf.get("rope_scaling")
+    if v3 and rs and rs.get("mscale_all_dim"):
+        # HF DeepseekV3Attention multiplies the softmax scale by
+        # yarn mscale(factor, mscale_all_dim)^2 (V2's class does not)
+        ms = 0.1 * float(rs["mscale_all_dim"]) * math.log(float(rs["factor"])) + 1.0
+        qk_dim = hf["qk_nope_head_dim"] + hf["qk_rope_head_dim"]
+        mla["attn_scale"] = qk_dim**-0.5 * ms * ms
+    n_routed = hf.get("n_routed_experts")
+    n_layers = hf["num_hidden_layers"]
+    first_k = hf.get("first_k_dense_replace", 0)
+    if not n_routed or first_k >= n_layers:
+        # every layer dense: a plain MLA transformer
+        return LlamaConfig(**common, **mla)
+    if hf.get("moe_layer_freq", 1) != 1:
+        raise ValueError(f"{mt} moe_layer_freq != 1 is not supported")
+    topk_method = hf.get("topk_method") or ("noaux_tc" if v3 else "greedy")
+    if topk_method == "group_limited_greedy" or v3:
+        groups = (hf["n_group"], hf["topk_group"])
+    elif topk_method == "greedy":
+        groups = ()
+    else:
+        raise ValueError(f"{mt} topk_method {topk_method!r} is not supported")
+    shared = hf.get("n_shared_experts") or 0
+    moe_inter = hf["moe_intermediate_size"]
+    common = {**common, "intermediate_size": moe_inter}
+    return LlamaConfig(
+        **common,
+        **mla,
+        n_experts=n_routed,
+        experts_per_token=hf["num_experts_per_tok"],
+        router_renorm=bool(hf.get("norm_topk_prob", False)),
+        router_score="sigmoid" if v3 else "softmax",
+        router_bias=v3,  # e_score_correction_bias (noaux_tc)
+        router_groups=groups,
+        routed_scale=float(hf.get("routed_scaling_factor", 1.0)),
+        moe_shared_expert=shared > 0,
+        moe_shared_intermediate=shared * moe_inter,
+        first_k_dense=first_k,
+        dense_intermediate=hf["intermediate_size"],
+    )
 
 
 def _llama4_config(hf: dict, common: dict) -> LlamaConfig:
@@ -318,6 +378,35 @@ def _rope_scaling_from_hf(hf: dict) -> Optional[tuple]:
         # classic position interpolation (Gemma3 global layers):
         # every frequency divided by the factor
         return ("linear", float(rs["factor"]))
+    if rope_type == "yarn":
+        # NTK-by-parts YaRN (DeepSeek): mirror HF's
+        # _compute_yarn_parameters, resolving the cos/sin attention
+        # factor from mscale/mscale_all_dim at conversion time
+        if not rs.get("truncate", True):
+            raise ValueError("yarn rope_scaling with truncate=false is not supported")
+        factor = float(rs["factor"])
+
+        def get_mscale(scale, ms=1.0):
+            return 1.0 if scale <= 1 else 0.1 * ms * math.log(scale) + 1.0
+
+        att = rs.get("attention_factor")
+        if att is None:
+            mscale = rs.get("mscale")
+            mscale_all = rs.get("mscale_all_dim")
+            if mscale and mscale_all:
+                att = get_mscale(factor, mscale) / get_mscale(factor, mscale_all)
+            else:
+                att = get_mscale(factor)
+        orig = (
+            rs.get("original_max_position_embeddings")
+            or hf.get("max_position_embeddings", 8192)
+        )
+        return (
+            "yarn", factor,
+            float(rs.get("beta_fast") or 32),
+            float(rs.get("beta_slow") or 1),
+            float(orig), float(att),
+        )
     raise ValueError(f"unsupported rope_scaling type {rope_type!r}")
 
 
@@ -346,6 +435,8 @@ def convert_state_dict(
     """
     c = config
     dt = c.dtype
+    if model_type in ("deepseek_v2", "deepseek_v3"):
+        return _convert_deepseek(sd, c)
     if model_type == "phi3":
         sd = _split_phi3(dict(sd), c)
 
@@ -455,6 +546,105 @@ def convert_state_dict(
     return params
 
 
+def _convert_deepseek(sd: dict, c: LlamaConfig) -> dict:
+    """DeepSeek-V2/V3 state dict → params: MLA projections plus the
+    dense-prelude/MoE layer split (``first_k_dense`` layers stack into
+    ``dense_layers``, the rest into ``layers``)."""
+    dt = c.dtype
+
+    def get(name):
+        if name not in sd:
+            raise KeyError(
+                f"missing weight {name!r} (have e.g. {sorted(sd)[:5]})"
+            )
+        return _to_np(sd[name])
+
+    def stack(fmt, rows, transpose=False):
+        mats = [get(fmt.format(i=i)) for i in rows]
+        if transpose:
+            mats = [m.T for m in mats]
+        return np.asarray(np.stack(mats), dt)
+
+    def attn_and_norms(rows):
+        A = "model.layers.{i}.self_attn."
+        d = {
+            "attn_norm": stack("model.layers.{i}.input_layernorm.weight", rows),
+            "mlp_norm": stack(
+                "model.layers.{i}.post_attention_layernorm.weight", rows
+            ),
+            "wkv_a": stack(A + "kv_a_proj_with_mqa.weight", rows, transpose=True),
+            "kv_a_norm": stack(A + "kv_a_layernorm.weight", rows),
+            "wkv_b": stack(A + "kv_b_proj.weight", rows, transpose=True),
+            "wo": stack(A + "o_proj.weight", rows, transpose=True),
+        }
+        if c.q_lora_rank:
+            d["wq_a"] = stack(A + "q_a_proj.weight", rows, transpose=True)
+            d["q_a_norm"] = stack(A + "q_a_layernorm.weight", rows)
+            d["wq_b"] = stack(A + "q_b_proj.weight", rows, transpose=True)
+        else:
+            d["wq"] = stack(A + "q_proj.weight", rows, transpose=True)
+        return d
+
+    def dense_mlp(rows):
+        return {
+            "w_gate": stack("model.layers.{i}.mlp.gate_proj.weight", rows, transpose=True),
+            "w_up": stack("model.layers.{i}.mlp.up_proj.weight", rows, transpose=True),
+            "w_down": stack("model.layers.{i}.mlp.down_proj.weight", rows, transpose=True),
+        }
+
+    K = c.first_k_dense
+    main_rows = list(range(K, c.n_layers))
+    layers = attn_and_norms(main_rows)
+    if c.n_experts:
+        layers["w_router"] = stack(
+            "model.layers.{i}.mlp.gate.weight", main_rows, transpose=True
+        )
+        if c.router_bias:
+            layers["router_bias"] = np.asarray(
+                np.stack([
+                    get(f"model.layers.{i}.mlp.gate.e_score_correction_bias")
+                    for i in main_rows
+                ]),
+                np.float32,  # selection bias stays f32 (HF buffer dtype)
+            )
+        for ours, theirs in (
+            ("w_gate", "gate_proj"), ("w_up", "up_proj"), ("w_down", "down_proj")
+        ):
+            layers[ours] = np.asarray(
+                np.stack([
+                    np.stack([
+                        get(
+                            f"model.layers.{i}.mlp.experts.{e}.{theirs}.weight"
+                        ).T
+                        for e in range(c.n_experts)
+                    ])
+                    for i in main_rows
+                ]),
+                dt,
+            )
+        if c.moe_shared_expert:
+            S = "model.layers.{i}.mlp.shared_experts."
+            layers["w_shared_gate"] = stack(S + "gate_proj.weight", main_rows, transpose=True)
+            layers["w_shared_up"] = stack(S + "up_proj.weight", main_rows, transpose=True)
+            layers["w_shared_down"] = stack(S + "down_proj.weight", main_rows, transpose=True)
+    else:
+        layers.update(dense_mlp(main_rows))
+
+    params = {
+        "embed": np.asarray(get("model.embed_tokens.weight"), dt),
+        "layers": layers,
+        "final_norm": np.asarray(get("model.norm.weight"), dt),
+    }
+    if K:
+        dense_rows = list(range(K))
+        params["dense_layers"] = {
+            **attn_and_norms(dense_rows), **dense_mlp(dense_rows)
+        }
+    if not c.tie_embeddings:
+        params["lm_head"] = np.asarray(get("lm_head.weight").T, dt)
+    return params
+
+
 def _split_phi3(sd: dict, c: LlamaConfig) -> dict:
     """Phi-3 fuses q/k/v into ``qkv_proj`` and gate/up into
     ``gate_up_proj`` ([out, in] rows: q then k then v; gate then up) —
@@ -535,6 +725,16 @@ def config_to_hf(config: LlamaConfig) -> dict:
         hf["rope_scaling"] = {
             "rope_type": "linear", "factor": float(c.rope_scaling[1])
         }
+    elif c.rope_scaling is not None and c.rope_scaling[0] == "yarn":
+        _, factor, beta_fast, beta_slow, orig, att = c.rope_scaling
+        hf["rope_scaling"] = {
+            "rope_type": "yarn",
+            "factor": factor,
+            "beta_fast": beta_fast,
+            "beta_slow": beta_slow,
+            "original_max_position_embeddings": int(orig),
+            "attention_factor": att,  # resolved; HF reads it directly
+        }
     elif c.rope_scaling is not None:
         rs = c.rope_scaling
         factor, low_f, high_f, orig = rs[1:] if rs[0] == "llama3" else rs
@@ -545,6 +745,59 @@ def config_to_hf(config: LlamaConfig) -> dict:
             "high_freq_factor": high_f,
             "original_max_position_embeddings": int(orig),
         }
+    if c.mla:
+        v3 = c.router_score == "sigmoid"
+        hf.update(
+            model_type="deepseek_v3" if v3 else "deepseek_v2",
+            head_dim=c.qk_rope_head_dim,  # HF rope dim for deepseek
+            q_lora_rank=c.q_lora_rank or None,
+            kv_lora_rank=c.kv_lora_rank,
+            qk_nope_head_dim=c.qk_nope_head_dim,
+            qk_rope_head_dim=c.qk_rope_head_dim,
+            v_head_dim=c.v_head_dim,
+        )
+        if v3 and c.attn_scale is not None and "rope_scaling" in hf:
+            # invert the mscale^2 softmax-scale correction back into
+            # mscale_all_dim so HF reapplies it (and our loader
+            # re-derives attn_scale on the round trip)
+            factor = hf["rope_scaling"]["factor"]
+            ms = math.sqrt(c.attn_scale * c.qk_head_dim**0.5)
+            hf["rope_scaling"]["mscale_all_dim"] = (
+                (ms - 1.0) / (0.1 * math.log(factor))
+            )
+        if c.n_experts:
+            shared = (
+                c.moe_shared_intermediate // c.intermediate_size
+                if c.moe_shared_expert else None
+            )
+            hf.update(
+                n_routed_experts=c.n_experts,
+                num_experts_per_tok=c.experts_per_token,
+                moe_intermediate_size=c.intermediate_size,
+                intermediate_size=c.dense_intermediate or c.intermediate_size,
+                first_k_dense_replace=c.first_k_dense,
+                moe_layer_freq=1,
+                n_shared_experts=shared,
+                norm_topk_prob=c.router_renorm,
+                routed_scaling_factor=c.routed_scale,
+            )
+            if v3:
+                hf.update(
+                    n_group=c.router_groups[0] if c.router_groups else 1,
+                    topk_group=c.router_groups[1] if c.router_groups else 1,
+                )
+            else:
+                hf.update(
+                    topk_method=(
+                        "group_limited_greedy" if c.router_groups else "greedy"
+                    ),
+                    n_group=c.router_groups[0] if c.router_groups else None,
+                    topk_group=c.router_groups[1] if c.router_groups else None,
+                )
+        else:
+            # all-dense MLA: no layer reaches the MoE branch
+            hf.update(first_k_dense_replace=c.n_layers, n_routed_experts=None)
+        return hf
     if c.rope_interleaved:
         from dstack_tpu.models.llama import layer_nope as _layer_nope
 
@@ -629,6 +882,8 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
         raise ValueError("export requires full-precision params, not int8")
     c = config
     mt = config_to_hf(c)["model_type"]
+    if mt in ("deepseek_v2", "deepseek_v3"):
+        return _export_deepseek(params, c)
     gemma2 = mt in ("gemma2", "gemma3_text")
 
     def np32(x):
@@ -689,6 +944,66 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
     sd["model.norm.weight"] = np32(params["final_norm"])
     if not c.tie_embeddings:
         sd["lm_head.weight"] = np32(params["lm_head"]).T
+    return sd
+
+
+def _export_deepseek(params: dict, c: LlamaConfig) -> dict:
+    """Inverse of :func:`_convert_deepseek` (flat HF names, numpy)."""
+
+    def np_(x):
+        return np.asarray(jax.device_get(x))
+
+    sd: dict = {"model.embed_tokens.weight": np_(params["embed"])}
+
+    def put_layer(sd_row, i, moe):
+        P = f"model.layers.{i}."
+        A = P + "self_attn."
+        sd[P + "input_layernorm.weight"] = np_(sd_row["attn_norm"])
+        sd[P + "post_attention_layernorm.weight"] = np_(sd_row["mlp_norm"])
+        sd[A + "kv_a_proj_with_mqa.weight"] = np_(sd_row["wkv_a"]).T
+        sd[A + "kv_a_layernorm.weight"] = np_(sd_row["kv_a_norm"])
+        sd[A + "kv_b_proj.weight"] = np_(sd_row["wkv_b"]).T
+        sd[A + "o_proj.weight"] = np_(sd_row["wo"]).T
+        if c.q_lora_rank:
+            sd[A + "q_a_proj.weight"] = np_(sd_row["wq_a"]).T
+            sd[A + "q_a_layernorm.weight"] = np_(sd_row["q_a_norm"])
+            sd[A + "q_b_proj.weight"] = np_(sd_row["wq_b"]).T
+        else:
+            sd[A + "q_proj.weight"] = np_(sd_row["wq"]).T
+        if moe:
+            sd[P + "mlp.gate.weight"] = np_(sd_row["w_router"]).T
+            if c.router_bias:
+                sd[P + "mlp.gate.e_score_correction_bias"] = np_(
+                    sd_row["router_bias"]
+                )
+            for e in range(c.n_experts):
+                E = P + f"mlp.experts.{e}."
+                sd[E + "gate_proj.weight"] = np_(sd_row["w_gate"][e]).T
+                sd[E + "up_proj.weight"] = np_(sd_row["w_up"][e]).T
+                sd[E + "down_proj.weight"] = np_(sd_row["w_down"][e]).T
+            if c.moe_shared_expert:
+                S = P + "mlp.shared_experts."
+                sd[S + "gate_proj.weight"] = np_(sd_row["w_shared_gate"]).T
+                sd[S + "up_proj.weight"] = np_(sd_row["w_shared_up"]).T
+                sd[S + "down_proj.weight"] = np_(sd_row["w_shared_down"]).T
+        else:
+            sd[P + "mlp.gate_proj.weight"] = np_(sd_row["w_gate"]).T
+            sd[P + "mlp.up_proj.weight"] = np_(sd_row["w_up"]).T
+            sd[P + "mlp.down_proj.weight"] = np_(sd_row["w_down"]).T
+
+    K = c.first_k_dense
+    for j in range(K):
+        put_layer(
+            jax.tree.map(lambda a: a[j], params["dense_layers"]), j, False
+        )
+    for j in range(c.n_layers - K):
+        put_layer(
+            jax.tree.map(lambda a: a[j], params["layers"]), K + j,
+            bool(c.n_experts),
+        )
+    sd["model.norm.weight"] = np_(params["final_norm"])
+    if not c.tie_embeddings:
+        sd["lm_head.weight"] = np_(params["lm_head"]).T
     return sd
 
 

@@ -102,36 +102,118 @@ class LlamaConfig:
     # any head count, lowest memory) or "ulysses" (head⇄seq all_to_all,
     # needs n_heads % sp == 0, keeps the flash kernel for windows)
     seq_parallel: str = "ring"
+    # --- DeepSeek MLA (multi-head latent attention) deltas ---
+    # kv_lora_rank > 0 enables MLA: k/v decode from a shared low-rank
+    # latent (kv_a_proj → rmsnorm → kv_b_proj), q/k heads split into a
+    # rope-free "nope" part and a single-head-shared rope part, and v
+    # has its own head dim. head_dim/n_kv_heads are unused under MLA
+    # (reference for the math: HF deepseek_v2 modeling, which this
+    # matches logit-exactly in tests/compute/test_hf_parity.py).
+    q_lora_rank: int = 0  # 0 = direct wq projection (V2-Lite)
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    # --- DeepSeek MoE deltas (models/moe.py) ---
+    router_score: str = "softmax"  # "softmax" (V2) | "sigmoid" (V3)
+    router_bias: bool = False  # V3 e_score_correction_bias (selection only)
+    # (n_group, topk_group): group-limited top-k — experts partition
+    # into n_group groups, only the best topk_group groups are eligible
+    # (group score: max member for softmax/V2, top-2 sum for sigmoid/V3)
+    router_groups: tuple = ()
+    routed_scale: float = 1.0  # multiplier on routed gates
+    # shared always-on expert FFN width (0 = intermediate_size); HF
+    # deepseek folds n_shared_experts into ONE fused MLP of this width
+    moe_shared_intermediate: int = 0
+    # DeepSeek: the first k layers use a plain dense FFN (width
+    # dense_intermediate) instead of MoE — they live in a separate
+    # params["dense_layers"] stack scanned before the main layers
+    first_k_dense: int = 0
+    dense_intermediate: int = 0
+
+    @property
+    def mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
+    def qk_head_dim(self) -> int:
+        """Per-head q/k width (nope + rope parts under MLA)."""
+        if self.mla:
+            return self.qk_nope_head_dim + self.qk_rope_head_dim
+        return self.head_dim
+
+    @property
+    def rope_dim(self) -> int:
+        """Width the rotary embedding acts on (the pe slice under MLA)."""
+        return self.qk_rope_head_dim if self.mla else self.head_dim
 
     @property
     def q_dim(self) -> int:
-        return self.n_heads * self.head_dim
+        return self.n_heads * self.qk_head_dim
+
+    @property
+    def o_dim(self) -> int:
+        """Attention output width entering wo (v heads under MLA)."""
+        return self.n_heads * (self.v_head_dim if self.mla else self.head_dim)
 
     @property
     def attention_scale(self) -> float:
         return (
             self.attn_scale if self.attn_scale is not None
-            else self.head_dim**-0.5
+            else self.qk_head_dim**-0.5
         )
 
     @property
     def kv_dim(self) -> int:
         return self.n_kv_heads * self.head_dim
 
+    def _attn_params_per_layer(self) -> int:
+        h = self.hidden_size
+        if self.mla:
+            q = (
+                h * self.q_lora_rank + self.q_lora_rank
+                + self.q_lora_rank * self.q_dim
+                if self.q_lora_rank else h * self.q_dim
+            )
+            kv = (
+                h * (self.kv_lora_rank + self.qk_rope_head_dim)
+                + self.kv_lora_rank
+                + self.kv_lora_rank
+                * self.n_heads * (self.qk_nope_head_dim + self.v_head_dim)
+            )
+            return q + kv + self.o_dim * h
+        return (
+            h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
+            + (self.q_dim + 2 * self.kv_dim if self.qkv_bias else 0)
+        )
+
+    def _shared_expert_params(self) -> int:
+        if not (self.n_experts and self.moe_shared_expert):
+            return 0
+        inter = self.moe_shared_intermediate or self.intermediate_size
+        return 3 * self.hidden_size * inter
+
     def num_params(self) -> int:
         e, h = self.vocab_size * self.hidden_size, self.hidden_size
-        n_mlp = max(1, self.n_experts)
-        if self.n_experts and self.moe_shared_expert:
-            n_mlp += 1  # Llama4 dense shared expert
-        per_layer = (
-            h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
-            + n_mlp * 3 * h * self.intermediate_size + 2 * h
+        attn = self._attn_params_per_layer()
+        extras = 2 * h + (2 * h if self.post_norms else 0)
+        moe_layers = self.n_layers - self.first_k_dense
+        per_moe = (
+            attn + extras
+            + max(1, self.n_experts) * 3 * h * self.intermediate_size
+            + self._shared_expert_params()
             + (h * self.n_experts if self.n_experts else 0)
-            + (self.q_dim + 2 * self.kv_dim if self.qkv_bias else 0)
-            + (2 * h if self.post_norms else 0)
+            + (self.n_experts if self.router_bias else 0)
+        )
+        per_dense = (
+            attn + extras
+            + 3 * h * (self.dense_intermediate or self.intermediate_size)
         )
         out = 0 if self.tie_embeddings else e
-        return e + self.n_layers * per_layer + h + out
+        return (
+            e + moe_layers * per_moe + self.first_k_dense * per_dense
+            + h + out
+        )
 
     def num_active_params(self) -> int:
         """Parameters touched per token: for MoE, only the
@@ -141,16 +223,25 @@ class LlamaConfig:
         if not self.n_experts:
             return self.num_params()
         e, h = self.vocab_size * self.hidden_size, self.hidden_size
-        active_mlps = self.experts_per_token + (
-            1 if self.moe_shared_expert else 0
-        )
-        per_layer = (
-            h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
-            + active_mlps * 3 * h * self.intermediate_size + 2 * h
+        attn = self._attn_params_per_layer()
+        extras = 2 * h + (2 * h if self.post_norms else 0)
+        moe_layers = self.n_layers - self.first_k_dense
+        per_moe = (
+            attn + extras
+            + self.experts_per_token * 3 * h * self.intermediate_size
+            + self._shared_expert_params()
             + h * self.n_experts  # router
+            + (self.n_experts if self.router_bias else 0)
+        )
+        per_dense = (
+            attn + extras
+            + 3 * h * (self.dense_intermediate or self.intermediate_size)
         )
         out = 0 if self.tie_embeddings else e
-        return e + self.n_layers * per_layer + h + out
+        return (
+            e + moe_layers * per_moe + self.first_k_dense * per_dense
+            + h + out
+        )
 
 
 LLAMA_3_8B = LlamaConfig()
@@ -247,6 +338,45 @@ GEMMA3_4B = LlamaConfig(  # text tower of google/gemma-3-4b
     attn_scale=256.0**-0.5,
 )
 
+DEEPSEEK_V2_LITE = LlamaConfig(  # deepseek-ai/DeepSeek-V2-Lite
+    vocab_size=102400, hidden_size=2048, n_layers=27, n_heads=16,
+    n_kv_heads=16, head_dim=64, intermediate_size=1408, rope_theta=10000.0,
+    norm_eps=1e-6, max_seq_len=163840,
+    kv_lora_rank=512, qk_nope_head_dim=128, qk_rope_head_dim=64,
+    v_head_dim=128,
+    rope_scaling=("yarn", 40.0, 32.0, 1.0, 4096.0, 1.0),
+    n_experts=64, experts_per_token=6, moe_shared_expert=True,
+    moe_shared_intermediate=2816,  # 2 shared experts × 1408
+    first_k_dense=1, dense_intermediate=10944,
+)
+DEEPSEEK_V3 = LlamaConfig(  # deepseek-ai/DeepSeek-V3 (671B, 37B active)
+    vocab_size=129280, hidden_size=7168, n_layers=61, n_heads=128,
+    n_kv_heads=128, head_dim=64, intermediate_size=2048, rope_theta=10000.0,
+    norm_eps=1e-6, max_seq_len=163840,
+    q_lora_rank=1536, kv_lora_rank=512, qk_nope_head_dim=128,
+    qk_rope_head_dim=64, v_head_dim=128,
+    rope_scaling=("yarn", 40.0, 32.0, 1.0, 4096.0, 1.0),
+    # V3 under yarn multiplies the softmax scale by mscale(factor,
+    # mscale_all_dim=1.0)^2 (HF DeepseekV3Attention; V2 does not)
+    attn_scale=(192.0**-0.5) * (0.1 * math.log(40.0) + 1.0) ** 2,
+    n_experts=256, experts_per_token=8, router_renorm=True,
+    router_score="sigmoid", router_bias=True, router_groups=(8, 4),
+    routed_scale=2.5, moe_shared_expert=True, moe_shared_intermediate=2048,
+    first_k_dense=3, dense_intermediate=18432,
+)
+MLA_TINY = LlamaConfig(  # for tests / virtual meshes
+    vocab_size=512, hidden_size=128, n_layers=3, n_heads=4, n_kv_heads=4,
+    head_dim=16, intermediate_size=128, max_seq_len=256, dtype=jnp.float32,
+    remat=False,
+    q_lora_rank=48, kv_lora_rank=64, qk_nope_head_dim=32,
+    qk_rope_head_dim=16, v_head_dim=24,
+    n_experts=4, experts_per_token=2, capacity_factor=2.0,
+    router_score="sigmoid", router_bias=True, router_groups=(2, 1),
+    routed_scale=1.5, router_renorm=True,
+    moe_shared_expert=True, moe_shared_intermediate=64,
+    first_k_dense=1, dense_intermediate=192,
+)
+
 CONFIGS = {
     "llama-3-8b": LLAMA_3_8B,
     "llama-3-70b": LLAMA_3_70B,
@@ -264,12 +394,43 @@ CONFIGS = {
     "gemma-3-1b": GEMMA3_1B,
     "gemma-3-4b": GEMMA3_4B,
     "llama-4-scout": LLAMA4_SCOUT,
+    "deepseek-v2-lite": DEEPSEEK_V2_LITE,
+    "deepseek-v3": DEEPSEEK_V3,
+    "mla-tiny": MLA_TINY,
 }
 
 
 def param_specs(config: LlamaConfig) -> dict:
     """Logical-axis tree matching :func:`init_params` output."""
     L = ("layers",)
+    if config.mla:
+        # MLA: the latent projections are skinny (rank ≪ hidden), so
+        # only the per-head b-projections shard over tp ("heads")
+        attn = {
+            "wkv_a": L + ("embed_fsdp", None),
+            "kv_a_norm": L + (None,),
+            "wkv_b": L + (None, "heads"),
+            "wo": L + ("heads", "embed_fsdp"),
+        }
+        if config.q_lora_rank:
+            attn["wq_a"] = L + ("embed_fsdp", None)
+            attn["q_a_norm"] = L + (None,)
+            attn["wq_b"] = L + (None, "heads")
+        else:
+            attn["wq"] = L + ("embed_fsdp", "heads")
+    else:
+        attn = {
+            "wq": L + ("embed_fsdp", "heads"),
+            "wk": L + ("embed_fsdp", "kv_heads"),
+            "wv": L + ("embed_fsdp", "kv_heads"),
+            "wo": L + ("heads", "embed_fsdp"),
+        }
+    dense_mlp = {
+        "mlp_norm": L + (None,),
+        "w_gate": L + ("embed_fsdp", "mlp"),
+        "w_up": L + ("embed_fsdp", "mlp"),
+        "w_down": L + ("mlp", "embed_fsdp"),
+    }
     if config.n_experts:
         mlp = {
             "mlp_norm": L + (None,),
@@ -278,42 +439,85 @@ def param_specs(config: LlamaConfig) -> dict:
             "w_up": L + ("experts", "embed_fsdp", "mlp"),
             "w_down": L + ("experts", "mlp", "embed_fsdp"),
         }
+        if config.router_bias:
+            mlp["router_bias"] = L + (None,)
         if config.moe_shared_expert:  # dense: shard like a plain MLP
             mlp["w_shared_gate"] = L + ("embed_fsdp", "mlp")
             mlp["w_shared_up"] = L + ("embed_fsdp", "mlp")
             mlp["w_shared_down"] = L + ("mlp", "embed_fsdp")
     else:
-        mlp = {
-            "mlp_norm": L + (None,),
-            "w_gate": L + ("embed_fsdp", "mlp"),
-            "w_up": L + ("embed_fsdp", "mlp"),
-            "w_down": L + ("mlp", "embed_fsdp"),
-        }
+        mlp = dense_mlp
+    layer = {"attn_norm": L + (None,), **attn, **mlp}
+    if config.qkv_bias:
+        layer["bq"] = L + ("heads",)
+        layer["bk"] = L + ("kv_heads",)
+        layer["bv"] = L + ("kv_heads",)
+    if config.qk_norm:
+        layer["q_norm"] = L + (None,)
+        layer["k_norm"] = L + (None,)
+    if config.post_norms:
+        layer["attn_post_norm"] = L + (None,)
+        layer["mlp_post_norm"] = L + (None,)
     specs = {
         "embed": ("vocab", "embed_fsdp"),
-        "layers": {
-            "attn_norm": L + (None,),
-            "wq": L + ("embed_fsdp", "heads"),
-            "wk": L + ("embed_fsdp", "kv_heads"),
-            "wv": L + ("embed_fsdp", "kv_heads"),
-            "wo": L + ("heads", "embed_fsdp"),
-            **mlp,
-        },
+        "layers": layer,
         "final_norm": (None,),
     }
-    if config.qkv_bias:
-        specs["layers"]["bq"] = L + ("heads",)
-        specs["layers"]["bk"] = L + ("kv_heads",)
-        specs["layers"]["bv"] = L + ("kv_heads",)
-    if config.qk_norm:
-        specs["layers"]["q_norm"] = L + (None,)
-        specs["layers"]["k_norm"] = L + (None,)
-    if config.post_norms:
-        specs["layers"]["attn_post_norm"] = L + (None,)
-        specs["layers"]["mlp_post_norm"] = L + (None,)
+    if config.first_k_dense:
+        # DeepSeek dense prelude: same attention, plain-MLP FFN
+        specs["dense_layers"] = {
+            k: v for k, v in {**layer, **dense_mlp}.items()
+            if k not in ("w_router", "router_bias", "w_shared_gate",
+                         "w_shared_up", "w_shared_down")
+        }
     if not config.tie_embeddings:
         specs["lm_head"] = ("embed_fsdp", "vocab")
     return specs
+
+
+def _init_attn(c: LlamaConfig, key: jax.Array, L: int, std: float) -> dict:
+    """Attention projections for an L-layer stack (standard or MLA)."""
+    dt = c.dtype
+    k = jax.random.split(key, 8)
+
+    def normal(key, shape, scale=std):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    wo_scale = std / math.sqrt(2 * max(1, c.n_layers))
+    if c.mla:
+        attn = {
+            "wkv_a": normal(
+                k[2], (L, c.hidden_size, c.kv_lora_rank + c.qk_rope_head_dim)
+            ),
+            "kv_a_norm": jnp.ones((L, c.kv_lora_rank), dt),
+            "wkv_b": normal(
+                k[3],
+                (L, c.kv_lora_rank,
+                 c.n_heads * (c.qk_nope_head_dim + c.v_head_dim)),
+            ),
+            "wo": normal(k[4], (L, c.o_dim, c.hidden_size), wo_scale),
+        }
+        if c.q_lora_rank:
+            attn["wq_a"] = normal(k[1], (L, c.hidden_size, c.q_lora_rank))
+            attn["q_a_norm"] = jnp.ones((L, c.q_lora_rank), dt)
+            # distinct stream: k[5..7] are the MLP draws in init_params
+            attn["wq_b"] = normal(
+                jax.random.fold_in(key, 21), (L, c.q_lora_rank, c.q_dim)
+            )
+        else:
+            attn["wq"] = normal(k[1], (L, c.hidden_size, c.q_dim))
+        return attn
+    attn = {
+        "wq": normal(k[1], (L, c.hidden_size, c.q_dim)),
+        "wk": normal(k[2], (L, c.hidden_size, c.kv_dim)),
+        "wv": normal(k[3], (L, c.hidden_size, c.kv_dim)),
+        "wo": normal(k[4], (L, c.q_dim, c.hidden_size), wo_scale),
+    }
+    if c.qkv_bias:
+        attn["bq"] = jnp.zeros((L, c.q_dim), dt)
+        attn["bk"] = jnp.zeros((L, c.kv_dim), dt)
+        attn["bv"] = jnp.zeros((L, c.kv_dim), dt)
+    return attn
 
 
 def init_params(config: LlamaConfig, key: jax.Array) -> dict:
@@ -329,7 +533,7 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         # Gemma-style norms scale by (1 + w): identity init is w = 0
         return (jnp.zeros if c.norm_offset else jnp.ones)(shape, dt)
 
-    L = c.n_layers
+    L = c.n_layers - c.first_k_dense
     if c.n_experts:
         E = c.n_experts
         mlp = {
@@ -343,16 +547,17 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
                 k[7], (L, E, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)
             ),
         }
-        if c.moe_shared_expert:  # Llama4 dense shared expert
+        if c.moe_shared_expert:  # Llama4/DeepSeek dense shared expert
+            FS = c.moe_shared_intermediate or c.intermediate_size
             mlp["w_shared_gate"] = normal(
-                jax.random.fold_in(key, 11), (L, c.hidden_size, c.intermediate_size)
+                jax.random.fold_in(key, 11), (L, c.hidden_size, FS)
             )
             mlp["w_shared_up"] = normal(
-                jax.random.fold_in(key, 12), (L, c.hidden_size, c.intermediate_size)
+                jax.random.fold_in(key, 12), (L, c.hidden_size, FS)
             )
             mlp["w_shared_down"] = normal(
                 jax.random.fold_in(key, 13),
-                (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L),
+                (L, FS, c.hidden_size), std / math.sqrt(2 * L),
             )
     else:
         mlp = {
@@ -361,28 +566,44 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
             "w_up": normal(k[6], (L, c.hidden_size, c.intermediate_size)),
             "w_down": normal(k[7], (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)),
         }
+    if c.n_experts and c.router_bias:
+        mlp["router_bias"] = jnp.zeros((L, c.n_experts), jnp.float32)
     params = {
         "embed": normal(k[0], (c.vocab_size, c.hidden_size)),
         "layers": {
             "attn_norm": norm_init((L, c.hidden_size)),
-            "wq": normal(k[1], (L, c.hidden_size, c.q_dim)),
-            "wk": normal(k[2], (L, c.hidden_size, c.kv_dim)),
-            "wv": normal(k[3], (L, c.hidden_size, c.kv_dim)),
-            "wo": normal(k[4], (L, c.q_dim, c.hidden_size), std / math.sqrt(2 * L)),
+            # pass the ORIGINAL key: _init_attn re-splits it to k[1..4],
+            # reproducing the exact pre-refactor draws (seed-stable)
+            **_init_attn(c, key, L, std),
             **mlp,
         },
         "final_norm": norm_init((c.hidden_size,)),
     }
-    if c.qkv_bias:
-        params["layers"]["bq"] = jnp.zeros((L, c.q_dim), dt)
-        params["layers"]["bk"] = jnp.zeros((L, c.kv_dim), dt)
-        params["layers"]["bv"] = jnp.zeros((L, c.kv_dim), dt)
     if c.qk_norm:
         params["layers"]["q_norm"] = jnp.ones((L, c.head_dim), dt)
         params["layers"]["k_norm"] = jnp.ones((L, c.head_dim), dt)
     if c.post_norms:
         params["layers"]["attn_post_norm"] = norm_init((L, c.hidden_size))
         params["layers"]["mlp_post_norm"] = norm_init((L, c.hidden_size))
+    if c.first_k_dense:
+        # DeepSeek dense prelude: same attention, plain-MLP FFN
+        K, F = c.first_k_dense, c.dense_intermediate or c.intermediate_size
+        kp = jax.random.fold_in(key, 2)
+        kd = jax.random.split(kp, 4)
+        dense = {
+            "attn_norm": norm_init((K, c.hidden_size)),
+            **_init_attn(c, kd[0], K, std),
+            "mlp_norm": norm_init((K, c.hidden_size)),
+            "w_gate": normal(kd[1], (K, c.hidden_size, F)),
+            "w_up": normal(kd[2], (K, c.hidden_size, F)),
+            "w_down": normal(
+                kd[3], (K, F, c.hidden_size), std / math.sqrt(2 * c.n_layers)
+            ),
+        }
+        if c.post_norms:
+            dense["attn_post_norm"] = norm_init((K, c.hidden_size))
+            dense["mlp_post_norm"] = norm_init((K, c.hidden_size))
+        params["dense_layers"] = dense
     if not c.tie_embeddings:
         params["lm_head"] = normal(jax.random.fold_in(key, 99), (c.hidden_size, c.vocab_size))
     return params
@@ -513,10 +734,35 @@ def rope_freqs(
     ``rope_type: llama3`` so 3.1/3.2 checkpoints decode correctly.
     The tagged form ("linear", factor) divides every frequency by
     ``factor`` (HF ``rope_type: linear``, Gemma3's global layers).
+    The tagged form ("yarn", factor, beta_fast, beta_slow, orig_ctx,
+    attention_factor) is NTK-by-parts YaRN (DeepSeek checkpoints),
+    mirroring HF ``_compute_yarn_parameters`` with truncate=True; the
+    precomputed ``attention_factor`` multiplies cos/sin.
     """
     inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
     if scaling is not None and scaling[0] == "linear":
         inv = inv / float(scaling[1])
+    elif scaling is not None and scaling[0] == "yarn":
+        _, factor, beta_fast, beta_slow, orig_ctx, att_f = scaling
+
+        def corr_dim(rot):  # dim whose wavelength fits `rot` rotations
+            return (
+                head_dim * math.log(orig_ctx / (rot * 2 * math.pi))
+            ) / (2 * math.log(theta))
+
+        low = max(math.floor(corr_dim(beta_fast)), 0)
+        high = min(math.ceil(corr_dim(beta_slow)), head_dim - 1)
+        if low == high:
+            high += 0.001  # HF's singularity guard
+        ramp = jnp.clip(
+            (jnp.arange(head_dim // 2, dtype=jnp.float32) - low) / (high - low),
+            0.0, 1.0,
+        )
+        # low dims (fast rotations): extrapolate (keep inv); high dims:
+        # interpolate (inv / factor); ramp blends between
+        inv = (inv / factor) * ramp + inv * (1.0 - ramp)
+        ang = positions.astype(jnp.float32)[:, None] * inv[None, :]
+        return jnp.cos(ang) * att_f, jnp.sin(ang) * att_f
     elif scaling is not None:
         if scaling[0] == "llama3":
             scaling = scaling[1:]
@@ -538,11 +784,11 @@ def dual_rope_freqs(
     layers rotate with the unscaled ``rope_local_theta`` while global
     layers use ``rope_theta`` + ``rope_scaling``."""
     g = rope_freqs(
-        positions, config.head_dim, config.rope_theta, config.rope_scaling
+        positions, config.rope_dim, config.rope_theta, config.rope_scaling
     )
     if not config.rope_local_theta:
         return g, g
-    return g, rope_freqs(positions, config.head_dim, config.rope_local_theta)
+    return g, rope_freqs(positions, config.rope_dim, config.rope_local_theta)
 
 
 def layer_rope(ropes: tuple[tuple, tuple], config: "LlamaConfig", window: int):
@@ -594,6 +840,54 @@ def _proj(
     return y
 
 
+def mla_qkv(
+    h: jax.Array,  # [B, T, H] normed hidden
+    layer: dict,
+    config: LlamaConfig,
+    cos: jax.Array,
+    sin: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """DeepSeek MLA projections, non-absorbed (training/prefill) form →
+    (q, k [B, Hq, T, qk_head_dim], v [B, Hq, T, v_head_dim]).
+
+    The rope acts only on the single-head-shared ``k_pe`` slice and the
+    per-head ``q_pe`` slice, in the interleaved complex-pair convention
+    (matching HF ``apply_rotary_emb`` for deepseek_v2/v3). The serve
+    engine uses the *absorbed* form instead (serve/engine.py): this form
+    materializes full k/v for flash-kernel-friendly training.
+    """
+    c = config
+    b, t, _ = h.shape
+    if c.q_lora_rank:
+        qa = _proj(layer, "wq_a", h, "bte,er->btr", "bte,ex->btx", "btx,xr->btr")
+        qa = rms_norm(qa, layer["q_a_norm"], c.norm_eps)
+        q = _proj(layer, "wq_b", qa, "btr,rd->btd", "btr,rx->btx", "btx,xd->btd")
+    else:
+        q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+    q = q.reshape(b, t, c.n_heads, c.qk_head_dim).transpose(0, 2, 1, 3)
+    kv_a = _proj(layer, "wkv_a", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+    ckv = kv_a[..., : c.kv_lora_rank]
+    k_pe = kv_a[..., c.kv_lora_rank :]  # [B, T, rope_dim], one shared head
+    ckv = rms_norm(ckv, layer["kv_a_norm"], c.norm_eps)
+    kv = _proj(layer, "wkv_b", ckv, "btr,rd->btd", "btr,rx->btx", "btx,xd->btd")
+    kv = kv.reshape(
+        b, t, c.n_heads, c.qk_nope_head_dim + c.v_head_dim
+    ).transpose(0, 2, 1, 3)
+    k_nope = kv[..., : c.qk_nope_head_dim]
+    v = kv[..., c.qk_nope_head_dim :]
+    q_nope = q[..., : c.qk_nope_head_dim]
+    q_pe = apply_rope(q[..., c.qk_nope_head_dim :], cos, sin, interleaved=True)
+    k_pe = apply_rope(
+        k_pe.reshape(b, 1, t, c.qk_rope_head_dim), cos, sin, interleaved=True
+    )
+    q = jnp.concatenate([q_nope, q_pe], axis=-1)
+    k = jnp.concatenate(
+        [k_nope, jnp.broadcast_to(k_pe, k_nope.shape[:-1] + (c.qk_rope_head_dim,))],
+        axis=-1,
+    )
+    return q, k, v
+
+
 def _attention_block(
     x: jax.Array,
     layer: dict,
@@ -610,33 +904,44 @@ def _attention_block(
     c = config
     b, t, _ = x.shape
     h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
-    q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
-    k = _proj(layer, "wk", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
-    v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
-    if c.qkv_bias:
-        q = q + layer["bq"]
-        k = k + layer["bk"]
-        v = v + layer["bv"]
-    q = q.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-    k = k.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-    v = v.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-    if c.qk_norm:  # Qwen3/Gemma3: per-head-dim RMSNorm before rope
-        # Gemma3 stores zero-centered norm weights (the family's
-        # norm_offset convention applies to q/k norms too)
-        q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
-        k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
-    q = constrain(q, rules, "batch", "heads", "seq", None, mesh=mesh)
-    k = constrain(k, rules, "batch", "kv_heads", "seq", None, mesh=mesh)
-    if not nope:
-        q = apply_rope(q, cos, sin, interleaved=c.rope_interleaved)
-        k = apply_rope(k, cos, sin, interleaved=c.rope_interleaved)
-        if c.qk_l2_norm:  # Llama4: weightless L2 norm AFTER rope
-            q = l2_norm(q, c.norm_eps)
-            k = l2_norm(k, c.norm_eps)
-    elif c.attn_temp_scale:
-        # Llama4 NoPE layers: position-dependent query temperature
-        pos = positions if positions is not None else jnp.arange(t)
-        q = q * attn_temp_scales(pos, c)[None, None, :, None].astype(q.dtype)
+    if c.mla:
+        q, k, v = mla_qkv(h, layer, c, cos, sin)
+        # zero-pad v to the qk head dim so every dispatch path below
+        # (flash / ring / ulysses / XLA) sees uniform head dims — exact,
+        # the padded lanes produce zeros that are sliced off after
+        v_pad = c.qk_head_dim - c.v_head_dim
+        if v_pad > 0:
+            v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, v_pad)))
+        q = constrain(q, rules, "batch", "heads", "seq", None, mesh=mesh)
+        k = constrain(k, rules, "batch", "heads", "seq", None, mesh=mesh)
+    else:
+        q = _proj(layer, "wq", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        k = _proj(layer, "wk", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
+        if c.qkv_bias:
+            q = q + layer["bq"]
+            k = k + layer["bk"]
+            v = v + layer["bv"]
+        q = q.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        if c.qk_norm:  # Qwen3/Gemma3: per-head-dim RMSNorm before rope
+            # Gemma3 stores zero-centered norm weights (the family's
+            # norm_offset convention applies to q/k norms too)
+            q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
+            k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
+        q = constrain(q, rules, "batch", "heads", "seq", None, mesh=mesh)
+        k = constrain(k, rules, "batch", "kv_heads", "seq", None, mesh=mesh)
+        if not nope:
+            q = apply_rope(q, cos, sin, interleaved=c.rope_interleaved)
+            k = apply_rope(k, cos, sin, interleaved=c.rope_interleaved)
+            if c.qk_l2_norm:  # Llama4: weightless L2 norm AFTER rope
+                q = l2_norm(q, c.norm_eps)
+                k = l2_norm(k, c.norm_eps)
+        elif c.attn_temp_scale:
+            # Llama4 NoPE layers: position-dependent query temperature
+            pos = positions if positions is not None else jnp.arange(t)
+            q = q * attn_temp_scales(pos, c)[None, None, :, None].astype(q.dtype)
     # Llama4 blockwise-chunked attention applies on rope layers only
     chunk = 0 if nope else c.attention_chunk_size
     scale = c.attention_scale
@@ -663,7 +968,9 @@ def _attention_block(
             q, k, v, causal=True, scale=scale, impl=attn_impl,
             window=window, softcap=c.attn_softcap, chunk=chunk,
         )
-    o = o.transpose(0, 2, 1, 3).reshape(b, t, c.q_dim)
+    if c.mla and c.qk_head_dim > c.v_head_dim:
+        o = o[..., : c.v_head_dim]  # drop the zero v padding
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, c.o_dim)
     out = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
     if c.post_norms:
         out = rms_norm(out, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
@@ -677,9 +984,14 @@ def _mlp_block(
     mesh: Optional[Mesh],
     rules: ShardingRules,
 ) -> tuple[jax.Array, jax.Array]:
-    """Dense SwiGLU or sparse MoE FFN → (out, aux loss scalar)."""
+    """Dense SwiGLU or sparse MoE FFN → (out, aux loss scalar).
+
+    The MoE path keys off ``w_router`` *in the layer dict*, not just the
+    config: DeepSeek's ``first_k_dense`` prelude layers carry a plain
+    dense FFN inside an MoE model and must take the dense branch.
+    """
     h = rms_norm(x, layer["mlp_norm"], config.norm_eps, offset=config.norm_offset)
-    if config.n_experts:
+    if config.n_experts and "w_router" in layer:
         from dstack_tpu.models import moe
 
         o, aux = moe.moe_mlp(
@@ -692,6 +1004,9 @@ def _mlp_block(
             rules,
             renorm=config.router_renorm,
             sigmoid_input=config.router_sigmoid_input,
+            score=config.router_score,
+            groups=config.router_groups,
+            routed_scale=config.routed_scale,
         )
         aux_loss = (
             config.router_balance_coef * aux["balance"]
@@ -856,6 +1171,15 @@ def forward(
             )
         return group_fn
 
+    if "dense_layers" in params:
+        # DeepSeek first-k dense prelude: same attention, plain FFN,
+        # scanned before the MoE stack (uniform attention — no family
+        # mixes first_k_dense with sliding windows or NoPE)
+        x, _ = jax.lax.scan(
+            make_group_fn((windows[0],), (nopes[0],), False),
+            x,
+            params["dense_layers"],
+        )
     x, auxs = jax.lax.scan(
         make_group_fn(tuple(windows[:g]), tuple(nopes[:g]), g > 1), x, xs_main
     )
@@ -912,6 +1236,12 @@ def forward_pipelined(
         raise ValueError(
             "forward_pipelined does not support Llama4 NoPE/chunked "
             "layers (mixed layer kinds don't split into equal stages)"
+        )
+    if c.first_k_dense:
+        raise ValueError(
+            "forward_pipelined does not support DeepSeek first_k_dense "
+            "prelude layers (mixed layer kinds don't split into equal "
+            "stages)"
         )
     window = windows[0]
     n_micro = n_micro or pp

@@ -38,6 +38,29 @@ def expert_capacity(
     return min(cap, seq_len)
 
 
+def _group_limit(
+    sel: jax.Array,  # [B, T, E] selection scores (≥ 0 where eligible)
+    groups: tuple,  # (n_group, topk_group)
+    score: str,
+) -> jax.Array:
+    """DeepSeek group-limited top-k: experts partition into ``n_group``
+    groups; only the best ``topk_group`` groups stay eligible, the rest
+    are zeroed (HF's ``masked_fill(~mask, 0)`` — exact parity incl. its
+    quirk that a zeroed slot can outrank a genuinely negative score).
+    Group score: max member (V2 softmax) or top-2 sum (V3 sigmoid)."""
+    n_group, topk_group = groups
+    e = sel.shape[-1]
+    gs = sel.reshape(*sel.shape[:-1], n_group, e // n_group)
+    if score == "sigmoid":  # V3: sum of the group's top-2 biased scores
+        top2, _ = jax.lax.top_k(gs, 2)
+        g_score = top2.sum(axis=-1)
+    else:  # V2 group_limited_greedy: best member
+        g_score = gs.max(axis=-1)
+    _, gidx = jax.lax.top_k(g_score, topk_group)  # [B, T, topk_group]
+    gmask = jax.nn.one_hot(gidx, n_group, dtype=sel.dtype).sum(axis=-2)
+    return (gs * gmask[..., None]).reshape(sel.shape)
+
+
 def router(
     x: jax.Array,  # [B, T, H] (model dtype)
     w_router: jax.Array,  # [H, E]
@@ -46,6 +69,10 @@ def router(
     capacity: int,
     renorm: bool = False,  # Mixtral: renormalize top-k gates to sum 1
     sigmoid: bool = False,  # Llama4: gates are sigmoid(top-k logit)
+    score: str = "softmax",  # full-score fn: "softmax" (V2) | "sigmoid" (V3)
+    groups: tuple = (),  # DeepSeek (n_group, topk_group) group limiting
+    bias: Optional[jax.Array] = None,  # V3 e_score_correction_bias [E]
+    routed_scale: float = 1.0,  # DeepSeek routed_scaling_factor
 ) -> tuple[jax.Array, jax.Array, dict]:
     """Top-k routing → (dispatch [B,T,E,C] one-hot, combine [B,T,E,C], aux).
 
@@ -57,6 +84,12 @@ def router(
     ``sigmoid``: experts are still chosen by top-k logit (softmax is
     monotonic, so the selection is identical), but the gate value is
     sigmoid(logit) — Llama4's router scoring.
+
+    DeepSeek variants (HF deepseek_v2/v3 parity): ``score="sigmoid"``
+    scores every expert with sigmoid(logit) instead of softmax; ``bias``
+    shifts scores for *selection only* (gate values stay unbiased);
+    ``groups`` restricts selection to the best expert groups; gates are
+    finally scaled by ``routed_scale``.
     """
     logits = jnp.einsum(
         "bth,he->bte", x, w_router.astype(x.dtype), preferred_element_type=jnp.float32
@@ -66,9 +99,22 @@ def router(
         top_logits, expert_idx = jax.lax.top_k(logits, experts_per_token)
         gate_vals = jax.nn.sigmoid(top_logits)
     else:
-        gate_vals, expert_idx = jax.lax.top_k(probs, experts_per_token)  # [B,T,k]
+        scores = jax.nn.sigmoid(logits) if score == "sigmoid" else probs
+        sel = scores if bias is None else scores + bias
+        if groups:
+            sel = _group_limit(sel, groups, score)
+        sel_vals, expert_idx = jax.lax.top_k(sel, experts_per_token)  # [B,T,k]
+        gate_vals = (
+            jnp.take_along_axis(scores, expert_idx, axis=-1)
+            if (bias is not None or groups) else sel_vals
+        )
     if renorm:
-        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+        denom = jnp.sum(gate_vals, axis=-1, keepdims=True)
+        if score == "sigmoid":
+            denom = denom + 1e-20  # HF V3 epsilon
+        gate_vals = gate_vals / denom
+    if routed_scale != 1.0:
+        gate_vals = gate_vals * routed_scale
 
     # Build per-choice one-hot assignments and capacity positions.
     # Choice order gives earlier (higher-gate) choices slot priority.
@@ -111,6 +157,9 @@ def moe_mlp(
     rules: Optional[ShardingRules],
     renorm: bool = False,
     sigmoid_input: bool = False,  # Llama4: sigmoid gate scales the INPUT
+    score: str = "softmax",  # DeepSeek-V3: "sigmoid" full-score routing
+    groups: tuple = (),  # DeepSeek (n_group, topk_group)
+    routed_scale: float = 1.0,  # DeepSeek routed_scaling_factor
 ) -> tuple[jax.Array, dict]:
     """Sparse SwiGLU FFN → (output [B,T,H], aux losses).
 
@@ -123,7 +172,8 @@ def moe_mlp(
     cap = expert_capacity(t, n_experts, experts_per_token, capacity_factor)
     dispatch, combine, aux = router(
         x, layer["w_router"], n_experts, experts_per_token, cap,
-        renorm=renorm, sigmoid=sigmoid_input,
+        renorm=renorm, sigmoid=sigmoid_input, score=score, groups=groups,
+        bias=layer.get("router_bias"), routed_scale=routed_scale,
     )
     if sigmoid_input:
         # move the gate onto the dispatch side: expert input is g·x,

@@ -70,6 +70,15 @@ def init_lora_params(
     config: llama.LlamaConfig, lora_config: LoRAConfig, key: jax.Array
 ) -> dict:
     """A ~ N(0, 1/r) and B = 0, so training starts at the base model."""
+    if config.mla or config.first_k_dense:
+        # MLA projections (wq_a/wq_b/wkv_a/wkv_b) and the DeepSeek
+        # dense-prelude split don't map onto the wq/wk/wv adapter
+        # naming or the uniform [n_layers, ...] stack — full fine-tune
+        # covers these families (train/finetune.py --full)
+        raise ValueError(
+            "LoRA adapters are not supported for MLA/DeepSeek configs; "
+            "use a full fine-tune (--full)"
+        )
     L, r = config.n_layers, lora_config.rank
     layers: dict = {}
     keys = jax.random.split(key, len(lora_config.target_modules))

@@ -104,7 +104,7 @@ class TestHFParity:
         hf = json.loads((_save_tiny(
             tmp_path, transformers.LlamaConfig, transformers.LlamaForCausalLM,
         ).config.to_json_string()))
-        hf["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+        hf["rope_scaling"] = {"rope_type": "longrope", "factor": 4.0}
         with pytest.raises(ValueError, match="rope_scaling"):
             config_from_hf(hf)
 
@@ -616,6 +616,7 @@ class TestConfigRoundTrip:
         "llama-3.2-1b", "qwen-2.5-7b", "qwen-3-8b", "qwen-3-30b-a3b",
         "mistral-7b", "gemma-2b", "gemma-2-2b", "gemma-3-1b",
         "gemma-3-4b", "mixtral-8x7b", "llama-4-scout",
+        "deepseek-v2-lite", "deepseek-v3",
     ])
     def test_flags_survive(self, name):
         from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
@@ -624,7 +625,7 @@ class TestConfigRoundTrip:
         c2 = config_from_hf(config_to_hf(c), dtype=c.dtype)
         for field in (
             "vocab_size", "hidden_size", "n_layers", "n_heads",
-            "n_kv_heads", "head_dim", "intermediate_size", "rope_theta",
+            "intermediate_size", "rope_theta",
             "tie_embeddings", "qkv_bias", "qk_norm", "sliding_window",
             "sliding_pattern", "hidden_act", "norm_offset", "embed_scale",
             "post_norms", "attn_softcap", "logit_softcap", "n_experts",
@@ -632,8 +633,16 @@ class TestConfigRoundTrip:
             "nope_pattern", "rope_interleaved", "qk_l2_norm",
             "attention_chunk_size", "attn_temp_scale", "attn_temp_floor",
             "router_sigmoid_input", "moe_shared_expert",
+            "q_lora_rank", "kv_lora_rank", "qk_nope_head_dim",
+            "qk_rope_head_dim", "v_head_dim", "router_score",
+            "router_bias", "router_groups", "routed_scale",
+            "moe_shared_intermediate", "first_k_dense",
+            "dense_intermediate",
         ):
             assert getattr(c2, field) == getattr(c, field), (name, field)
+        if not c.mla:  # under MLA head_dim/n_kv_heads are unused
+            for field in ("n_kv_heads", "head_dim"):
+                assert getattr(c2, field) == getattr(c, field), (name, field)
         if c.attn_scale is not None:
             assert abs(c2.attn_scale - c.attn_scale) < 1e-9
 
@@ -676,6 +685,241 @@ class TestQwen3Moe:
             ref = m(torch.tensor(tokens)).logits.numpy()
         ours = llama.forward(params, jnp.asarray(tokens), config)
         np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+    def test_deepseek_v2_mla_dense(self, tmp_path):
+        """MLA attention alone (every layer dense): latent kv projection,
+        split nope/rope head dims, shared single-head rope key, own v
+        head dim, interleaved-complex rope on the pe slices."""
+        m = _save_tiny(
+            tmp_path,
+            transformers.DeepseekV2Config,
+            transformers.DeepseekV2ForCausalLM,
+            num_key_value_heads=4,
+            first_k_dense_replace=4,  # = num_hidden_layers: no MoE layer
+            q_lora_rank=None,  # V2-Lite style direct q projection
+            kv_lora_rank=32,
+            qk_nope_head_dim=32,
+            qk_rope_head_dim=16,
+            v_head_dim=24,
+            head_dim=16,  # HF derives the rope dim from this
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.mla and cfg.q_lora_rank == 0 and cfg.n_experts == 0
+        assert cfg.qk_head_dim == 48 and cfg.v_head_dim == 24
+
+    def test_deepseek_v2_q_lora(self, tmp_path):
+        """Full-size V2 shape: low-rank q projection (q_a/q_b + norm)."""
+        m = _save_tiny(
+            tmp_path,
+            transformers.DeepseekV2Config,
+            transformers.DeepseekV2ForCausalLM,
+            num_key_value_heads=4,
+            first_k_dense_replace=4,
+            q_lora_rank=48,
+            kv_lora_rank=32,
+            qk_nope_head_dim=32,
+            qk_rope_head_dim=16,
+            v_head_dim=24,
+            head_dim=16,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.q_lora_rank == 48
+
+    def test_deepseek_v2_moe(self, tmp_path):
+        """V2 MoE: softmax full-score gates, dense first-k prelude,
+        fused shared experts, greedy top-k."""
+        m = _save_tiny(
+            tmp_path,
+            transformers.DeepseekV2Config,
+            transformers.DeepseekV2ForCausalLM,
+            num_key_value_heads=4,
+            first_k_dense_replace=1,
+            q_lora_rank=None,
+            kv_lora_rank=32,
+            qk_nope_head_dim=32,
+            qk_rope_head_dim=16,
+            v_head_dim=24,
+            head_dim=16,
+            n_routed_experts=8,
+            n_shared_experts=2,
+            num_experts_per_tok=3,
+            moe_intermediate_size=32,
+            topk_method="greedy",
+            norm_topk_prob=False,
+            routed_scaling_factor=1.0,
+            n_group=1,
+            topk_group=1,
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        assert config.first_k_dense == 1 and config.n_experts == 8
+        assert config.moe_shared_expert
+        assert config.moe_shared_intermediate == 64  # 2 shared × 32
+        assert config.dense_intermediate == 96
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(
+            config, remat=False, capacity_factor=float(config.n_experts)
+        )
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, config.vocab_size, (B, T))
+        with torch.no_grad():
+            ref = m(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(params, jnp.asarray(tokens), config)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+    def test_deepseek_v2_group_limited(self, tmp_path):
+        """V2 group_limited_greedy: only the best topk_group expert
+        groups (scored by their best member) are selectable."""
+        m = _save_tiny(
+            tmp_path,
+            transformers.DeepseekV2Config,
+            transformers.DeepseekV2ForCausalLM,
+            num_key_value_heads=4,
+            first_k_dense_replace=1,
+            q_lora_rank=None,
+            kv_lora_rank=32,
+            qk_nope_head_dim=32,
+            qk_rope_head_dim=16,
+            v_head_dim=24,
+            head_dim=16,
+            n_routed_experts=8,
+            n_shared_experts=1,
+            num_experts_per_tok=2,
+            moe_intermediate_size=32,
+            topk_method="group_limited_greedy",
+            n_group=4,
+            topk_group=2,
+            norm_topk_prob=False,
+            routed_scaling_factor=1.5,
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        assert config.router_groups == (4, 2) and config.routed_scale == 1.5
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(
+            config, remat=False, capacity_factor=float(config.n_experts)
+        )
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, config.vocab_size, (B, T))
+        with torch.no_grad():
+            ref = m(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(params, jnp.asarray(tokens), config)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+    def test_deepseek_v3(self, tmp_path):
+        """V3: sigmoid scoring, e_score_correction_bias (selection
+        only), group top-2-sum limiting, renormed gates × routed
+        scale."""
+        m = _save_tiny(
+            tmp_path,
+            transformers.DeepseekV3Config,
+            transformers.DeepseekV3ForCausalLM,
+            num_key_value_heads=4,
+            first_k_dense_replace=1,
+            q_lora_rank=48,
+            kv_lora_rank=32,
+            qk_nope_head_dim=32,
+            qk_rope_head_dim=16,
+            v_head_dim=24,
+            head_dim=16,
+            n_routed_experts=8,
+            n_shared_experts=1,
+            num_experts_per_tok=3,
+            moe_intermediate_size=32,
+            n_group=4,
+            topk_group=2,
+            norm_topk_prob=True,
+            routed_scaling_factor=2.5,
+        )
+        # exercise the correction bias: the random init leaves it zero.
+        # Std 0.1 dominates the (near-0.5) sigmoid score spread so the
+        # bias demonstrably drives selection, while keeping every biased
+        # score positive — a tiny random model with larger biases can
+        # push a whole group below the masked-fill zeros, creating an
+        # exact top-k TIE whose torch-vs-jax tie-breaking diverges
+        # (never happens with trained checkpoints' score scales).
+        with torch.no_grad():
+            for lyr in m.model.layers[1:]:
+                lyr.mlp.gate.e_score_correction_bias.normal_(0.0, 0.1)
+        m.save_pretrained(tmp_path)
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        assert config.router_score == "sigmoid" and config.router_bias
+        assert config.router_groups == (4, 2) and config.router_renorm
+        assert float(np.abs(params["layers"]["router_bias"]).max()) > 0
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(
+            config, remat=False, capacity_factor=float(config.n_experts)
+        )
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, config.vocab_size, (B, T))
+        with torch.no_grad():
+            ref = m(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(params, jnp.asarray(tokens), config)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+    def test_deepseek_v3_yarn_mscale(self, tmp_path):
+        """V3 under yarn multiplies the softmax scale by
+        mscale(factor, mscale_all_dim)^2 — V2 does not; missing it makes
+        attention logits ~1.9x too small on real V3 checkpoints."""
+        m = _save_tiny(
+            tmp_path,
+            transformers.DeepseekV3Config,
+            transformers.DeepseekV3ForCausalLM,
+            num_key_value_heads=4,
+            first_k_dense_replace=4,
+            q_lora_rank=48,
+            kv_lora_rank=32,
+            qk_nope_head_dim=32,
+            qk_rope_head_dim=16,
+            v_head_dim=24,
+            head_dim=16,
+            rope_scaling={
+                "rope_type": "yarn", "factor": 40.0,
+                "beta_fast": 32.0, "beta_slow": 1.0,
+                "mscale": 1.0, "mscale_all_dim": 1.0,
+                "original_max_position_embeddings": 8,
+            },
+        )
+        cfg = _assert_parity(tmp_path, m)
+        import math as _math
+
+        expected = (48.0**-0.5) * (0.1 * _math.log(40.0) + 1.0) ** 2
+        assert cfg.attn_scale is not None
+        assert abs(cfg.attn_scale - expected) < 1e-9
+
+    def test_deepseek_yarn_rope(self, tmp_path):
+        """YaRN NTK-by-parts rope (DeepSeek long-context checkpoints):
+        must match HF and differ from unscaled rope."""
+        m = _save_tiny(
+            tmp_path,
+            transformers.DeepseekV2Config,
+            transformers.DeepseekV2ForCausalLM,
+            num_key_value_heads=4,
+            first_k_dense_replace=4,
+            q_lora_rank=None,
+            kv_lora_rank=32,
+            qk_nope_head_dim=32,
+            qk_rope_head_dim=16,
+            v_head_dim=24,
+            head_dim=16,
+            rope_scaling={
+                "rope_type": "yarn", "factor": 4.0,
+                "beta_fast": 32.0, "beta_slow": 1.0,
+                "mscale": 0.707, "mscale_all_dim": 0.707,
+                "original_max_position_embeddings": 8,
+            },
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.rope_scaling is not None and cfg.rope_scaling[0] == "yarn"
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(config, remat=False)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, config.vocab_size, (B, T)))
+        scaled = llama.forward(params, tokens, config)
+        plain = llama.forward(
+            params, tokens,
+            llama.dataclasses.replace(config, rope_scaling=None),
+        )
+        assert not np.allclose(np.asarray(scaled), np.asarray(plain))
 
     def test_qwen3_moe_dense_layers_rejected(self, tmp_path):
         from dstack_tpu.models.convert_hf import config_from_hf
