@@ -234,6 +234,8 @@ def _deepseek_config(hf: dict, common: dict, mt: str) -> LlamaConfig:
     topk_method = hf.get("topk_method") or ("noaux_tc" if v3 else "greedy")
     if topk_method == "group_limited_greedy" or v3:
         groups = (hf["n_group"], hf["topk_group"])
+        if groups == (1, 1):
+            groups = ()  # one group of everything = no limiting
     elif topk_method == "greedy":
         groups = ()
     else:

@@ -544,7 +544,7 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
             "w_gate": normal(k[5], (L, E, c.hidden_size, c.intermediate_size)),
             "w_up": normal(k[6], (L, E, c.hidden_size, c.intermediate_size)),
             "w_down": normal(
-                k[7], (L, E, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)
+                k[7], (L, E, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * c.n_layers)
             ),
         }
         if c.moe_shared_expert:  # Llama4/DeepSeek dense shared expert
@@ -557,14 +557,14 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
             )
             mlp["w_shared_down"] = normal(
                 jax.random.fold_in(key, 13),
-                (L, FS, c.hidden_size), std / math.sqrt(2 * L),
+                (L, FS, c.hidden_size), std / math.sqrt(2 * c.n_layers),
             )
     else:
         mlp = {
             "mlp_norm": norm_init((L, c.hidden_size)),
             "w_gate": normal(k[5], (L, c.hidden_size, c.intermediate_size)),
             "w_up": normal(k[6], (L, c.hidden_size, c.intermediate_size)),
-            "w_down": normal(k[7], (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * L)),
+            "w_down": normal(k[7], (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * c.n_layers)),
         }
     if c.n_experts and c.router_bias:
         mlp["router_bias"] = jnp.zeros((L, c.n_experts), jnp.float32)

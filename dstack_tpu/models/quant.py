@@ -64,6 +64,10 @@ def quantize_tree(params: dict, config: LlamaConfig) -> dict:
         raise ValueError(
             "int8 quantization does not cover MoE expert stacks yet"
         )
+    if config.mla:
+        raise ValueError(
+            "int8 quantization does not cover MLA projections yet"
+        )
     out = {k: v for k, v in params.items() if k not in ("layers", "lm_head")}
     layers = {}
     for name, leaf in params["layers"].items():

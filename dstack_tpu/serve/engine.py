@@ -60,7 +60,31 @@ def init_cache(
     mesh=None,
 ) -> dict:
     """Preallocated KV cache: k/v [L, B, Hkv, T_max, D] in model dtype,
-    KV heads sharded over ``tp`` when serving on a mesh."""
+    KV heads sharded over ``tp`` when serving on a mesh.
+
+    MLA (DeepSeek): ONE latent tensor ``ckv`` [L, B, T_max,
+    kv_lora_rank + qk_rope_head_dim] — the absorbed-attention form
+    caches the shared compressed latent plus the single-head rope key
+    instead of per-head K/V. For V2/V3 shapes (rank 512 + rope 64 vs
+    128 heads × 2 × 192/128 wide) that is a ~50-100× smaller cache and
+    proportionally less HBM traffic per decoded token — the reason MLA
+    exists. Replicated over ``tp`` (it has no head dim; the q heads
+    shard instead).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if config.mla:
+        shape = (
+            config.n_layers,
+            max_batch,
+            max_seq,
+            config.kv_lora_rank + config.qk_rope_head_dim,
+        )
+        if mesh is None:
+            return {"ckv": jnp.zeros(shape, config.dtype)}
+        sh = NamedSharding(mesh, P(None, None, None, None))
+        zeros = jax.jit(lambda: jnp.zeros(shape, config.dtype), out_shardings=sh)
+        return {"ckv": zeros()}
     shape = (
         config.n_layers,
         max_batch,
@@ -73,8 +97,6 @@ def init_cache(
             "k": jnp.zeros(shape, config.dtype),
             "v": jnp.zeros(shape, config.dtype),
         }
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     sh = NamedSharding(mesh, P(None, None, "tp", None, None))
     # allocate directly sharded: a host-side zeros + device_put would
     # materialize the full cache on one chip first
@@ -109,13 +131,17 @@ def _mlp(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
     from dstack_tpu.models.llama import act_fn
 
     m = rms_norm(x, layer["mlp_norm"], c.norm_eps, offset=c.norm_offset)
-    if c.n_experts:
+    # key off w_router in the LAYER: DeepSeek first_k_dense prelude
+    # layers are dense inside an MoE model (see llama._mlp_block)
+    if c.n_experts and "w_router" in layer:
         from dstack_tpu.models import moe
 
         mo, _ = moe.moe_mlp(
             m, layer, c.n_experts, c.experts_per_token, c.capacity_factor,
             None, None, renorm=c.router_renorm,
             sigmoid_input=c.router_sigmoid_input,
+            score=c.router_score, groups=c.router_groups,
+            routed_scale=c.routed_scale,
         )
     else:
         g = _proj(layer, "w_gate", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
@@ -138,6 +164,49 @@ def _qkv(h: jax.Array, layer: dict, c: LlamaConfig) -> tuple:
     return q, k, v
 
 
+# --- MLA (DeepSeek) absorbed attention pieces --------------------------------
+#
+# Identity behind the absorbed form: per head, k_nope = ckv · W_kb^nope
+# and v = ckv · W_kb^v, so
+#   q_nope · k_nope = (q_nope · W_kb^nope) · ckv      (absorb into q)
+#   attn_out        = (probs · ckv) · W_kb^v          (absorb into out)
+# which turns attention into MQA with ONE shared kv "head"
+# [ckv ; k_pe] of width rank+rope whose value IS the latent — exact up
+# to float reassociation, and the cache never materializes per-head K/V
+# (llama.mla_qkv documents the non-absorbed training form).
+
+
+def _mla_kb(layer: dict, c: LlamaConfig) -> tuple[jax.Array, jax.Array]:
+    """wkv_b [rank, H*(nope+v)] → (w_kb_nope [rank,H,nope], w_kb_v
+    [rank,H,v])."""
+    w = layer["wkv_b"].reshape(
+        c.kv_lora_rank, c.n_heads, c.qk_nope_head_dim + c.v_head_dim
+    )
+    return w[..., : c.qk_nope_head_dim], w[..., c.qk_nope_head_dim :]
+
+
+def _mla_q(h: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
+    """Normed hidden [B,T,H] → q [B, Hq, T, qk_head_dim] (pre-rope)."""
+    b, t, _ = h.shape
+    if c.q_lora_rank:
+        qa = jnp.einsum("bte,er->btr", h, layer["wq_a"])
+        qa = rms_norm(qa, layer["q_a_norm"], c.norm_eps)
+        q = jnp.einsum("btr,rd->btd", qa, layer["wq_b"])
+    else:
+        q = jnp.einsum("bte,ed->btd", h, layer["wq"])
+    return q.reshape(b, t, c.n_heads, c.qk_head_dim).transpose(0, 2, 1, 3)
+
+
+def _mla_latents(
+    h: jax.Array, layer: dict, c: LlamaConfig
+) -> tuple[jax.Array, jax.Array]:
+    """Normed hidden [B,T,H] → (ckv [B,T,rank] normed, k_pe [B,T,rope]
+    un-roped)."""
+    kv_a = jnp.einsum("bte,ed->btd", h, layer["wkv_a"])
+    ckv = rms_norm(kv_a[..., : c.kv_lora_rank], layer["kv_a_norm"], c.norm_eps)
+    return ckv, kv_a[..., c.kv_lora_rank :]
+
+
 def _embed_lookup(params: dict, tokens: jax.Array, c: LlamaConfig) -> jax.Array:
     x = params["embed"].at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
     if c.embed_scale:
@@ -157,6 +226,213 @@ def _head_logits(
     if c.logit_softcap:
         logits = c.logit_softcap * jnp.tanh(logits / c.logit_softcap)
     return logits
+
+
+def _mla_scan(params: dict, rows: jax.Array, x: jax.Array, one_layer):
+    """Drive ``one_layer(x, layer, row) -> (x, row)`` over the DeepSeek
+    layer layout: the ``first_k_dense`` prelude layers run unrolled
+    (K ≤ 3 on every real config), the main stack runs as one
+    ``lax.scan``; returns (x, updated [L, ...] cache rows)."""
+    k_dense = rows.shape[0] - params["layers"]["attn_norm"].shape[0]
+    out_pre = []
+    for j in range(k_dense):
+        lyr = jax.tree.map(lambda a: a[j], params["dense_layers"])
+        x, r = one_layer(x, lyr, rows[j])
+        out_pre.append(r)
+
+    def scan_fn(xx, layer_and_row):
+        layer, row = layer_and_row
+        xx, r = one_layer(xx, layer, row)
+        return xx, r
+
+    x, main = jax.lax.scan(scan_fn, x, (params["layers"], rows[k_dense:]))
+    if k_dense:
+        main = jnp.concatenate([jnp.stack(out_pre), main], axis=0)
+    return x, main
+
+
+def _prefill_chunk_mla(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [1, C]
+    slot: jax.Array,
+    last_ix: jax.Array,
+    c: LlamaConfig,
+    *,
+    start: int,
+) -> tuple[jax.Array, dict]:
+    """MLA chunked prefill in the absorbed form: the chunk's latents
+    write into the slot's ``ckv`` row, then the absorbed queries attend
+    over the row as MQA with one rank+rope-wide kv head whose value is
+    the latent itself — the flash kernel applies when the widths tile,
+    and no per-head K/V ever materializes."""
+    from dstack_tpu.models.llama import apply_rope, dual_rope_freqs
+    from dstack_tpu.ops.attention import attention
+
+    b, cl = tokens.shape
+    x = _embed_lookup(params, tokens, c)
+    chunk_pos = start + jnp.arange(cl)
+    (cos, sin), _ = dual_rope_freqs(c, chunk_pos)
+    scale = c.attention_scale
+    si = slot.astype(jnp.int32)
+
+    def one_layer(x, layer, row_cache):
+        # row_cache [B_pool, Tmax, rank+rope] — this layer's latents
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = _mla_q(h, layer, c)  # [B, H, C, qk_head_dim]
+        q_nope = q[..., : c.qk_nope_head_dim]
+        q_pe = apply_rope(
+            q[..., c.qk_nope_head_dim :], cos, sin, interleaved=True
+        )
+        ckv, k_pe = _mla_latents(h, layer, c)
+        k_pe = apply_rope(k_pe[:, None], cos, sin, interleaved=True)[:, 0]
+        new_rows = jnp.concatenate([ckv, k_pe], axis=-1)  # [B, C, R]
+        row_cache = jax.lax.dynamic_update_slice(
+            row_cache, new_rows, (si, start, 0)
+        )
+        row = jax.lax.dynamic_slice_in_dim(row_cache, si, 1, 0)  # [1,Tmax,R]
+        w_kb_nope, w_kb_v = _mla_kb(layer, c)
+        q_lat = jnp.einsum("bhcn,rhn->bhcr", q_nope, w_kb_nope)
+        q_abs = jnp.concatenate([q_lat, q_pe], axis=-1)  # [B, H, C, R]
+        k_abs = row[:, None]  # [1, 1, Tmax, R] — one shared kv head
+        v_abs = jnp.concatenate(
+            [row[..., : c.kv_lora_rank], jnp.zeros_like(row[..., c.kv_lora_rank :])],
+            axis=-1,
+        )[:, None]
+        o = attention(
+            q_abs.astype(c.dtype), k_abs, v_abs, causal=True, scale=scale,
+            q_offset=start,
+        )[..., : c.kv_lora_rank]  # [B, H, C, rank]
+        o = jnp.einsum("bhcr,rhv->bchv", o, w_kb_v).reshape(b, cl, c.o_dim)
+        ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        return _mlp(x + ao, layer, c), row_cache
+
+    x, rows = _mla_scan(params, cache["ckv"], x, one_layer)
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    last = jnp.take_along_axis(
+        x, last_ix[None, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return _head_logits(params, last, c), {"ckv": rows}
+
+
+def _decode_step_mla(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B]
+    positions: jax.Array,  # [B]
+    c: LlamaConfig,
+    write_mask: jax.Array,
+) -> tuple[jax.Array, dict]:
+    """Absorbed MLA decode: per layer, stream the slot's latent row
+    ONCE at rank+rope width — for DeepSeek-V3 that is ~100× fewer HBM
+    bytes than materialized per-head K/V in the bandwidth-bound decode
+    regime."""
+    from dstack_tpu.models.llama import dual_rope_freqs
+
+    b = tokens.shape[0]
+    tmax = cache["ckv"].shape[2]
+    write_pos = jnp.where(write_mask, positions, tmax)
+    x = _embed_lookup(params, tokens, c)[:, None, :]
+    (cos, sin), _ = dual_rope_freqs(c, positions)  # [B, rope/2]
+    batch_ix = jnp.arange(b)
+    scale = c.attention_scale
+
+    def one_layer(x, layer, row):
+        # row [B, Tmax, R]
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = _mla_q(h, layer, c)  # [B, H, 1, qk_head_dim]
+        q_nope = q[..., : c.qk_nope_head_dim]
+        q_pe = _apply_rope_batch(
+            q[..., c.qk_nope_head_dim :], cos, sin, interleaved=True
+        )
+        ckv, k_pe = _mla_latents(h, layer, c)  # [B,1,rank], [B,1,rope]
+        k_pe = _apply_rope_batch(
+            k_pe[:, :, None], cos, sin, interleaved=True
+        )[:, 0, 0]  # [B, rope]
+        new_row = jnp.concatenate([ckv[:, 0], k_pe], axis=-1)  # [B, R]
+        row = row.at[batch_ix, write_pos].set(new_row, mode="drop")
+        w_kb_nope, w_kb_v = _mla_kb(layer, c)
+        q_lat = jnp.einsum("bhn,rhn->bhr", q_nope[:, :, 0], w_kb_nope)
+        q_abs = jnp.concatenate([q_lat, q_pe[:, :, 0]], axis=-1)  # [B,H,R]
+        s = jnp.einsum(
+            "bhr,btr->bht", q_abs, row, preferred_element_type=jnp.float32
+        ) * scale
+        kj = jnp.arange(tmax)[None, None, :]
+        s = jnp.where(kj <= positions[:, None, None], s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o_lat = jnp.einsum(
+            "bht,btr->bhr", p.astype(row.dtype), row[..., : c.kv_lora_rank]
+        )
+        o = jnp.einsum("bhr,rhv->bhv", o_lat, w_kb_v).reshape(b, 1, c.o_dim)
+        ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        return _mlp(x + ao, layer, c), row
+
+    x, rows = _mla_scan(params, cache["ckv"], x, one_layer)
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    return _head_logits(params, x[:, 0], c), {"ckv": rows}
+
+
+def _verify_step_mla(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B, S]
+    positions: jax.Array,  # [B]
+    c: LlamaConfig,
+    write_mask: jax.Array,
+) -> tuple[jax.Array, dict]:
+    """Absorbed-form multi-token decode (speculative verification)."""
+    from dstack_tpu.models.llama import dual_rope_freqs
+
+    b, sdraft = tokens.shape
+    tmax = cache["ckv"].shape[2]
+    x = _embed_lookup(params, tokens, c)
+    pos_grid = positions[:, None] + jnp.arange(sdraft)[None, :]  # [B, S]
+    (cos, sin), _ = jax.tree.map(
+        lambda a: a.reshape(b, sdraft, c.qk_rope_head_dim // 2),
+        dual_rope_freqs(c, pos_grid.reshape(-1)),
+    )
+    batch_ix = jnp.arange(b)
+    scale = c.attention_scale
+    write_pos = jnp.where(write_mask[:, None], pos_grid, tmax)  # [B, S]
+
+    def rope_rows(t):  # t [B, Hh, S, rope] with per-(row, step) angles
+        cc = cos[:, None].astype(t.dtype)  # [B, 1, S, rope/2]
+        ss = sin[:, None].astype(t.dtype)
+        t1, t2 = t[..., 0::2], t[..., 1::2]  # interleaved complex pairs
+        out = jnp.stack([t1 * cc - t2 * ss, t2 * cc + t1 * ss], axis=-1)
+        return out.reshape(t.shape)
+
+    def one_layer(x, layer, row):
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = _mla_q(h, layer, c)  # [B, H, S, qk_head_dim]
+        q_nope = q[..., : c.qk_nope_head_dim]
+        q_pe = rope_rows(q[..., c.qk_nope_head_dim :])
+        ckv, k_pe = _mla_latents(h, layer, c)  # [B,S,rank], [B,S,rope]
+        k_pe = rope_rows(k_pe[:, None])[:, 0]  # [B, S, rope]
+        new_rows = jnp.concatenate([ckv, k_pe], axis=-1)  # [B, S, R]
+        row = row.at[batch_ix[:, None], write_pos].set(new_rows, mode="drop")
+        w_kb_nope, w_kb_v = _mla_kb(layer, c)
+        q_lat = jnp.einsum("bhsn,rhn->bhsr", q_nope, w_kb_nope)
+        q_abs = jnp.concatenate([q_lat, q_pe], axis=-1)  # [B, H, S, R]
+        s = jnp.einsum(
+            "bhsr,btr->bhst", q_abs, row, preferred_element_type=jnp.float32
+        ) * scale
+        kj = jnp.arange(tmax)[None, None, None, :]  # [1,1,1,T]
+        qpos = pos_grid[:, None, :, None]  # [B,1,S,1]
+        s = jnp.where(kj <= qpos, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o_lat = jnp.einsum(
+            "bhst,btr->bhsr", p.astype(row.dtype), row[..., : c.kv_lora_rank]
+        )
+        o = jnp.einsum("bhsr,rhv->bshv", o_lat, w_kb_v).reshape(
+            b, sdraft, c.o_dim
+        )
+        ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        return _mlp(x + ao, layer, c), row
+
+    x, rows = _mla_scan(params, cache["ckv"], x, one_layer)
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    return _head_logits(params, x, c, eq="bse,ev->bsv"), {"ckv": rows}
 
 
 def prefill(
@@ -214,6 +490,10 @@ def prefill_chunk_step(
     from dstack_tpu.ops.attention import attention
 
     c = config
+    if c.mla:
+        return _prefill_chunk_mla(
+            params, cache, tokens, slot, last_ix, c, start=start
+        )
     b, cl = tokens.shape
     x = _embed_lookup(params, tokens, c)
     chunk_pos = start + jnp.arange(cl)
@@ -333,6 +613,10 @@ def decode_step(
     b = tokens.shape[0]
     if write_mask is None:
         write_mask = jnp.ones((b,), bool)
+    if c.mla:
+        return _decode_step_mla(
+            params, cache, tokens, positions, c, write_mask
+        )
     # out-of-range scatter indices drop the write (mode="drop")
     write_pos = jnp.where(write_mask, positions, cache["k"].shape[3])
     x = _embed_lookup(params, tokens, c)[:, None, :]
@@ -501,6 +785,10 @@ def verify_step(
     )
 
     c = config
+    if c.mla:
+        return _verify_step_mla(
+            params, cache, tokens, positions, c, write_mask
+        )
     b, sdraft = tokens.shape
     x = _embed_lookup(params, tokens, c)  # [B, S, H]
     # per-row positions: row i covers [pos_i, pos_i + S)
@@ -770,7 +1058,14 @@ class InferenceEngine:
             from dstack_tpu.parallel.sharding import default_rules, tree_shardings
 
             tp = mesh.shape.get("tp", 1)
-            if tp > 1 and config.n_kv_heads % tp != 0:
+            if config.mla:
+                # MLA: the latent cache has no head dim (replicated);
+                # the q/out heads shard over tp instead
+                if tp > 1 and config.n_heads % tp != 0:
+                    raise ValueError(
+                        f"n_heads {config.n_heads} not divisible by tp={tp}"
+                    )
+            elif tp > 1 and config.n_kv_heads % tp != 0:
                 raise ValueError(
                     f"n_kv_heads {config.n_kv_heads} not divisible by tp={tp}"
                 )

@@ -567,3 +567,48 @@ class TestNChoices:
             assert r.status == 400
         finally:
             await client.close()
+
+
+class TestDeepseekServing:
+    async def test_serve_deepseek_checkpoint(self, tmp_path):
+        """End-to-end: tiny HF DeepSeek-V2 (MLA + MoE + dense prelude)
+        → convert_hf → absorbed-cache engine → /v1/completions."""
+        import pytest
+
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        import jax.numpy as jnp
+
+        from dstack_tpu.models.convert_hf import load_checkpoint
+
+        torch.manual_seed(0)
+        cfg = transformers.DeepseekV2Config(
+            vocab_size=300, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=64,
+            first_k_dense_replace=1, q_lora_rank=None, kv_lora_rank=32,
+            qk_nope_head_dim=32, qk_rope_head_dim=16, v_head_dim=24,
+            head_dim=16, n_routed_experts=4, n_shared_experts=1,
+            num_experts_per_tok=2, moe_intermediate_size=32,
+            topk_method="greedy", n_group=1, topk_group=1,
+        )
+        transformers.DeepseekV2ForCausalLM(cfg).save_pretrained(tmp_path)
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(
+            config, remat=False, capacity_factor=float(config.n_experts)
+        )
+        engine = InferenceEngine(config, params, max_batch=2, max_seq=64)
+        app = build_app(engine, ByteTokenizer(), "deepseek-tiny")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "deepseek-tiny", "prompt": "ab", "max_tokens": 4},
+            )
+            assert r.status == 200
+            d = await r.json()
+            assert d["usage"]["completion_tokens"] >= 1
+        finally:
+            await client.close()
