@@ -62,12 +62,14 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     hidden = hf["hidden_size"]
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hidden // n_heads
-    if hf.get("attention_bias") and mt not in ("qwen2", "qwen3", "qwen3_moe"):
+    if hf.get("attention_bias") and mt not in (
+        "qwen2", "qwen3", "qwen3_moe", "glm", "glm4"
+    ):
         # q/k/v/o biases exist in the checkpoint but our llama/mistral
         # paths would silently drop them — refuse rather than mis-serve
         raise ValueError(
             f"{mt} checkpoint sets attention_bias=true, which this "
-            "converter only supports for qwen2/qwen3"
+            "converter only supports for qwen2/qwen3/glm"
         )
     act = hf.get("hidden_act") or "silu"
     act_map = {"silu": "silu", "gelu_pytorch_tanh": "gelu_tanh"}
@@ -198,6 +200,19 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         return _llama4_config(hf, common)
     if mt in ("deepseek_v2", "deepseek_v3"):
         return _deepseek_config(hf, common, mt)
+    if mt in ("glm", "glm4"):
+        # GLM-4: partial rotary (interleaved, first half of head_dim),
+        # qkv bias, fused gate_up MLP (split on load); glm4 adds
+        # Gemma2-style sandwich norms (post_self_attn/post_mlp)
+        return LlamaConfig(
+            **common,
+            # GLM defaults attention_bias=True but it is a real config
+            # knob — honor bias-free checkpoints
+            qkv_bias=bool(hf.get("attention_bias", True)),
+            rope_interleaved=True,
+            partial_rotary=float(hf.get("partial_rotary_factor") or 0.5),
+            post_norms=(mt == "glm4"),
+        )
     raise ValueError(f"unsupported HF model_type {mt!r}")
 
 
@@ -441,6 +456,8 @@ def convert_state_dict(
         return _convert_deepseek(sd, c)
     if model_type == "phi3":
         sd = _split_phi3(dict(sd), c)
+    if model_type in ("glm", "glm4"):
+        sd = _split_glm(dict(sd), c, model_type)
 
     def get(name):
         if name not in sd:
@@ -472,7 +489,9 @@ def convert_state_dict(
     llama4 = model_type in ("llama4", "llama4_text")
 
     P = "model.layers.{i}."
-    gemma2 = model_type in ("gemma2", "gemma3", "gemma3_text")
+    # families whose pre-MLP norm is named pre_feedforward_layernorm
+    # (sandwich-norm layouts; _split_glm renames glm4 into this shape)
+    gemma2 = model_type in ("gemma2", "gemma3", "gemma3_text", "glm4")
     layers = {
         "attn_norm": stack(P + "input_layernorm.weight"),
         "wq": stack(P + "self_attn.q_proj.weight", transpose=True),
@@ -647,6 +666,28 @@ def _convert_deepseek(sd: dict, c: LlamaConfig) -> dict:
     return params
 
 
+def _split_glm(sd: dict, c: LlamaConfig, model_type: str) -> dict:
+    """GLM fuses gate/up into ``gate_up_proj`` ([2F, H] rows: gate then
+    up) — split it; glm4's sandwich norms are renamed into the
+    Gemma2-style names the generic path reads (post_self_attn →
+    post_attention, post_attention → pre_feedforward, post_mlp →
+    post_feedforward)."""
+    F = c.intermediate_size
+    for i in range(c.n_layers):
+        P = f"model.layers.{i}."
+        gu = _to_np(sd.pop(P + "mlp.gate_up_proj.weight"))
+        sd[P + "mlp.gate_proj.weight"] = gu[:F]
+        sd[P + "mlp.up_proj.weight"] = gu[F:]
+        if model_type == "glm4":
+            attn_post = sd.pop(P + "post_self_attn_layernorm.weight")
+            pre_mlp = sd.pop(P + "post_attention_layernorm.weight")
+            mlp_post = sd.pop(P + "post_mlp_layernorm.weight")
+            sd[P + "post_attention_layernorm.weight"] = attn_post
+            sd[P + "pre_feedforward_layernorm.weight"] = pre_mlp
+            sd[P + "post_feedforward_layernorm.weight"] = mlp_post
+    return sd
+
+
 def _split_phi3(sd: dict, c: LlamaConfig) -> dict:
     """Phi-3 fuses q/k/v into ``qkv_proj`` and gate/up into
     ``gate_up_proj`` ([out, in] rows: q then k then v; gate then up) —
@@ -800,6 +841,13 @@ def config_to_hf(config: LlamaConfig) -> dict:
             # all-dense MLA: no layer reaches the MoE branch
             hf.update(first_k_dense_replace=c.n_layers, n_routed_experts=None)
         return hf
+    if c.partial_rotary != 1.0:
+        hf.update(
+            model_type="glm4" if c.post_norms else "glm",
+            attention_bias=True,
+            partial_rotary_factor=c.partial_rotary,
+        )
+        return hf
     if c.rope_interleaved:
         from dstack_tpu.models.llama import layer_nope as _layer_nope
 
@@ -886,7 +934,7 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
     mt = config_to_hf(c)["model_type"]
     if mt in ("deepseek_v2", "deepseek_v3"):
         return _export_deepseek(params, c)
-    gemma2 = mt in ("gemma2", "gemma3_text")
+    gemma2 = mt in ("gemma2", "gemma3_text", "glm4")
 
     def np32(x):
         # keep the source dtype (bf16 stays bf16): upcasting every
@@ -946,6 +994,22 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
     sd["model.norm.weight"] = np32(params["final_norm"])
     if not c.tie_embeddings:
         sd["lm_head.weight"] = np32(params["lm_head"]).T
+    if mt in ("glm", "glm4"):
+        # inverse of _split_glm: re-fuse gate/up; restore glm4 norm names
+        for i in range(c.n_layers):
+            P = f"model.layers.{i}."
+            sd[P + "mlp.gate_up_proj.weight"] = np.concatenate(
+                [sd.pop(P + "mlp.gate_proj.weight"),
+                 sd.pop(P + "mlp.up_proj.weight")],
+                axis=0,
+            )
+            if mt == "glm4":
+                attn_post = sd.pop(P + "post_attention_layernorm.weight")
+                pre_mlp = sd.pop(P + "pre_feedforward_layernorm.weight")
+                mlp_post = sd.pop(P + "post_feedforward_layernorm.weight")
+                sd[P + "post_self_attn_layernorm.weight"] = attn_post
+                sd[P + "post_attention_layernorm.weight"] = pre_mlp
+                sd[P + "post_mlp_layernorm.weight"] = mlp_post
     return sd
 
 

@@ -102,6 +102,10 @@ class LlamaConfig:
     # any head count, lowest memory) or "ulysses" (head⇄seq all_to_all,
     # needs n_heads % sp == 0, keeps the flash kernel for windows)
     seq_parallel: str = "ring"
+    # GLM: rope rotates only the first head_dim*partial_rotary dims
+    # (interleaved convention — GLM sets rope_interleaved too); the
+    # rest pass through unrotated. 1.0 = full-width rope.
+    partial_rotary: float = 1.0
     # --- DeepSeek MLA (multi-head latent attention) deltas ---
     # kv_lora_rank > 0 enables MLA: k/v decode from a shared low-rank
     # latent (kv_a_proj → rmsnorm → kv_b_proj), q/k heads split into a
@@ -144,8 +148,11 @@ class LlamaConfig:
 
     @property
     def rope_dim(self) -> int:
-        """Width the rotary embedding acts on (the pe slice under MLA)."""
-        return self.qk_rope_head_dim if self.mla else self.head_dim
+        """Width the rotary embedding acts on (the pe slice under MLA,
+        the first partial_rotary fraction for GLM)."""
+        if self.mla:
+            return self.qk_rope_head_dim
+        return int(self.head_dim * self.partial_rotary)
 
     @property
     def q_dim(self) -> int:
@@ -338,6 +345,13 @@ GEMMA3_4B = LlamaConfig(  # text tower of google/gemma-3-4b
     attn_scale=256.0**-0.5,
 )
 
+GLM_4_9B = LlamaConfig(  # THUDM/GLM-4-9B-0414 (glm4)
+    vocab_size=151552, hidden_size=4096, n_layers=40, n_heads=32,
+    n_kv_heads=2, head_dim=128, intermediate_size=13696,
+    rope_theta=10000.0, norm_eps=1.5625e-7, max_seq_len=131072,
+    qkv_bias=True, rope_interleaved=True, partial_rotary=0.5,
+    post_norms=True,
+)
 DEEPSEEK_V2_LITE = LlamaConfig(  # deepseek-ai/DeepSeek-V2-Lite
     vocab_size=102400, hidden_size=2048, n_layers=27, n_heads=16,
     n_kv_heads=16, head_dim=64, intermediate_size=1408, rope_theta=10000.0,
@@ -397,6 +411,7 @@ CONFIGS = {
     "deepseek-v2-lite": DEEPSEEK_V2_LITE,
     "deepseek-v3": DEEPSEEK_V3,
     "mla-tiny": MLA_TINY,
+    "glm-4-9b": GLM_4_9B,
 }
 
 
@@ -797,11 +812,29 @@ def layer_rope(ropes: tuple[tuple, tuple], config: "LlamaConfig", window: int):
     return ropes[1] if window else ropes[0]
 
 
+def rope_partial(apply, x: jax.Array, cos: jax.Array) -> jax.Array:
+    """GLM partial rotary, shared by every rope applier (train forward,
+    engine decode/prefill/verify): when cos/sin are narrower than D/2,
+    ``apply`` rotates only the first ``2·cos.shape[-1]`` dims and the
+    tail passes through — ONE place owns the split convention."""
+    rd = 2 * cos.shape[-1]
+    if rd >= x.shape[-1]:
+        return apply(x)
+    return jnp.concatenate([apply(x[..., :rd]), x[..., rd:]], axis=-1)
+
+
 def apply_rope(
     x: jax.Array, cos: jax.Array, sin: jax.Array, interleaved: bool = False
 ) -> jax.Array:
     """x [B, H, T, D]; rotate-half convention, or Meta/Llama4's
-    interleaved complex-pair rotation when ``interleaved``."""
+    interleaved complex-pair rotation when ``interleaved``.
+
+    When cos/sin are narrower than D/2 (GLM partial rotary), only the
+    leading dims rotate (see :func:`rope_partial`)."""
+    if 2 * cos.shape[-1] < x.shape[-1]:
+        return rope_partial(
+            lambda xx: apply_rope(xx, cos, sin, interleaved), x, cos
+        )
     if interleaved:
         x1 = x[..., 0::2]
         x2 = x[..., 1::2]

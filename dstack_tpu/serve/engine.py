@@ -114,7 +114,14 @@ def init_cache(
 def _apply_rope_batch(
     x: jax.Array, cos: jax.Array, sin: jax.Array, interleaved: bool = False
 ) -> jax.Array:
-    """x [B, H, 1, D]; cos/sin [B, D/2] (per-slot positions)."""
+    """x [B, H, 1, D]; cos/sin [B, D/2] (per-slot positions). Narrower
+    cos/sin (GLM partial rotary) rotate only the leading dims."""
+    from dstack_tpu.models.llama import rope_partial
+
+    if 2 * cos.shape[-1] < x.shape[-1]:
+        return rope_partial(
+            lambda xx: _apply_rope_batch(xx, cos, sin, interleaved), x, cos
+        )
     c = cos[:, None, None, :].astype(x.dtype)
     s = sin[:, None, None, :].astype(x.dtype)
     if interleaved:  # Llama4: complex rotation of (even, odd) pairs
@@ -793,7 +800,7 @@ def verify_step(
     x = _embed_lookup(params, tokens, c)  # [B, S, H]
     # per-row positions: row i covers [pos_i, pos_i + S)
     pos_grid = positions[:, None] + jnp.arange(sdraft)[None, :]  # [B, S]
-    inv_shape = c.head_dim // 2
+    inv_shape = c.rope_dim // 2  # narrower under GLM partial rotary
     # rope per (row, step): build [B, S, D/2] then apply per-row
     (cos, sin), (cos_l, sin_l) = jax.tree.map(
         lambda a: a.reshape(b, sdraft, inv_shape),
@@ -812,6 +819,10 @@ def verify_step(
     write_pos = jnp.where(write_mask[:, None], pos_grid, tmax)  # [B, S]
 
     def rope_rows(t, cos, sin):  # t [B, Hh, S, D]
+        from dstack_tpu.models.llama import rope_partial
+
+        if 2 * cos.shape[-1] < t.shape[-1]:  # GLM partial rotary
+            return rope_partial(lambda tt: rope_rows(tt, cos, sin), t, cos)
         cc = cos[:, None].astype(t.dtype)  # [B, 1, S, D/2]
         ss = sin[:, None].astype(t.dtype)
         if c.rope_interleaved:  # Llama4 complex-pair rotation

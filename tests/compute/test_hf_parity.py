@@ -616,7 +616,7 @@ class TestConfigRoundTrip:
         "llama-3.2-1b", "qwen-2.5-7b", "qwen-3-8b", "qwen-3-30b-a3b",
         "mistral-7b", "gemma-2b", "gemma-2-2b", "gemma-3-1b",
         "gemma-3-4b", "mixtral-8x7b", "llama-4-scout",
-        "deepseek-v2-lite", "deepseek-v3",
+        "deepseek-v2-lite", "deepseek-v3", "glm-4-9b",
     ])
     def test_flags_survive(self, name):
         from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
@@ -637,7 +637,7 @@ class TestConfigRoundTrip:
             "qk_rope_head_dim", "v_head_dim", "router_score",
             "router_bias", "router_groups", "routed_scale",
             "moe_shared_intermediate", "first_k_dense",
-            "dense_intermediate",
+            "dense_intermediate", "partial_rotary",
         ):
             assert getattr(c2, field) == getattr(c, field), (name, field)
         if not c.mla:  # under MLA head_dim/n_kv_heads are unused
@@ -685,6 +685,55 @@ class TestQwen3Moe:
             ref = m(torch.tensor(tokens)).logits.numpy()
         ours = llama.forward(params, jnp.asarray(tokens), config)
         np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+    def test_glm_partial_rotary(self, tmp_path):
+        """GLM: interleaved rope on the first half of head_dim only,
+        qkv bias, fused gate_up MLP split on load."""
+        m = _save_tiny(
+            tmp_path, transformers.GlmConfig, transformers.GlmForCausalLM,
+            head_dim=16, partial_rotary_factor=0.5, pad_token_id=0,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.partial_rotary == 0.5 and cfg.qkv_bias
+        assert cfg.rope_interleaved and not cfg.post_norms
+        assert cfg.rope_dim == 8
+
+    def test_glm4_sandwich_norms(self, tmp_path):
+        """glm4 adds post_self_attn/post_mlp sandwich norms on top of
+        the GLM layout — mapped onto the post_norms flag with renames."""
+        m = _save_tiny(
+            tmp_path, transformers.Glm4Config, transformers.Glm4ForCausalLM,
+            head_dim=16, partial_rotary_factor=0.5, pad_token_id=0,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.post_norms and cfg.partial_rotary == 0.5
+
+    def test_glm4_greedy_decode(self, tmp_path):
+        """Engine decode parity for partial rotary: the narrow cos/sin
+        must rotate only the leading dims in decode/prefill too."""
+        m = _save_tiny(
+            tmp_path, transformers.Glm4Config, transformers.Glm4ForCausalLM,
+            head_dim=16, partial_rotary_factor=0.5, pad_token_id=0,
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(config, remat=False)
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        eng = InferenceEngine(
+            config, params, max_batch=2, max_seq=48,
+            spec_draft=0, turbo_steps=0,
+        )
+        prompt = [5, 9, 21, 7]
+        out = eng.generate(prompt, GenParams(max_new_tokens=6, temperature=0.0))
+        seq = list(prompt)
+        ref = []
+        for _ in range(6):
+            logits = llama.forward(params, jnp.asarray([seq], jnp.int32), config)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            ref.append(nxt)
+            seq.append(nxt)
+        assert out == ref
 
     def test_deepseek_v2_mla_dense(self, tmp_path):
         """MLA attention alone (every layer dense): latent kv projection,
