@@ -844,7 +844,7 @@ def config_to_hf(config: LlamaConfig) -> dict:
     if c.partial_rotary != 1.0:
         hf.update(
             model_type="glm4" if c.post_norms else "glm",
-            attention_bias=True,
+            attention_bias=c.qkv_bias,
             partial_rotary_factor=c.partial_rotary,
         )
         return hf

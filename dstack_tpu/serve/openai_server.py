@@ -678,11 +678,98 @@ def build_app(
             }
         )
 
+    # /v1/embeddings: mean-pooled, L2-normalized final hidden states —
+    # decoder-only-LLM-as-embedder convention (e5-mistral-style pooling
+    # without the instruction prefix). One jitted fn per power-of-2
+    # length bucket; compiled lazily, reused across requests.
+    import functools as _ft
+
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from dstack_tpu.models import llama as _llama
+
+    _embed_cfg = _llama.dataclasses.replace(engine.config, remat=False)
+
+    @_ft.lru_cache(maxsize=16)
+    def _embed_fn(padded: int):
+        def fn(params, tokens, n):  # tokens [1, padded], n [] int32
+            h = _llama.forward(
+                params, tokens, _embed_cfg, return_hidden=True
+            ).astype(_jnp.float32)  # [1, P, H]
+            m = (_jnp.arange(tokens.shape[1]) < n)[None, :, None]
+            pooled = _jnp.sum(h * m, axis=1)[0] / _jnp.maximum(n, 1)
+            return pooled / _jnp.maximum(
+                _jnp.linalg.norm(pooled), 1e-9
+            )
+
+        return _jax.jit(fn)
+
+    async def embeddings(request):
+        try:
+            payload = await request.json()
+        except Exception:
+            return web.json_response({"detail": "invalid JSON body"}, status=400)
+        inputs = payload.get("input")
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        if not isinstance(inputs, list) or not all(
+            isinstance(s, str) for s in inputs
+        ) or not inputs:
+            return web.json_response(
+                {"detail": "'input' must be a string or list of strings"},
+                status=400,
+            )
+        id_lists = [
+            tokenizer.encode(text)[- engine.max_seq :] or [0]
+            for text in inputs
+        ]
+        total_tokens = sum(len(ids) for ids in id_lists)
+
+        def _compute():
+            # dispatch EVERY forward before the first device_get: JAX's
+            # async dispatch then pipelines the batch instead of paying
+            # a host-device sync per item
+            vecs = []
+            for ids in id_lists:
+                padded = 16
+                while padded < len(ids):
+                    padded *= 2
+                toks = _jnp.asarray(
+                    [ids + [0] * (padded - len(ids))], _jnp.int32
+                )
+                vecs.append(_embed_fn(padded)(
+                    engine.params, toks, _jnp.asarray(len(ids), _jnp.int32)
+                ))
+            return _jax.device_get(vecs)
+
+        # off the event loop: a new length bucket compiles for seconds,
+        # which must not stall other connections' streams
+        host_vecs = await asyncio.to_thread(_compute)
+        data = [
+            {
+                "object": "embedding",
+                "index": i,
+                "embedding": [float(v) for v in vec],
+            }
+            for i, vec in enumerate(host_vecs)
+        ]
+        return web.json_response({
+            "object": "list",
+            "data": data,
+            "model": model_name,
+            "usage": {
+                "prompt_tokens": total_tokens,
+                "total_tokens": total_tokens,
+            },
+        })
+
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/v1/models", models)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/embeddings", embeddings)
     return app
 
 

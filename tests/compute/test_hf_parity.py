@@ -697,6 +697,13 @@ class TestQwen3Moe:
         assert cfg.partial_rotary == 0.5 and cfg.qkv_bias
         assert cfg.rope_interleaved and not cfg.post_norms
         assert cfg.rope_dim == 8
+        # bias-free GLM round-trips without resurrecting the bias
+        from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
+
+        c2 = config_from_hf(
+            config_to_hf(llama.dataclasses.replace(cfg, qkv_bias=False))
+        )
+        assert not c2.qkv_bias and c2.partial_rotary == 0.5
 
     def test_glm4_sandwich_norms(self, tmp_path):
         """glm4 adds post_self_attn/post_mlp sandwich norms on top of

@@ -612,3 +612,41 @@ class TestDeepseekServing:
             assert d["usage"]["completion_tokens"] >= 1
         finally:
             await client.close()
+
+
+class TestEmbeddings:
+    async def test_embeddings_shapes_and_norm(self):
+        config = llama.LLAMA_TINY
+        params = jax.device_put(llama.init_params(config, jax.random.key(0)))
+        engine = InferenceEngine(config, params, max_batch=2, max_seq=64)
+        app = build_app(engine, ByteTokenizer(), "tiny")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/embeddings",
+                json={"model": "tiny", "input": ["hello world", "goodbye"]},
+            )
+            assert r.status == 200
+            d = await r.json()
+            assert len(d["data"]) == 2
+            import math
+
+            for item in d["data"]:
+                vec = item["embedding"]
+                assert len(vec) == config.hidden_size
+                assert abs(math.sqrt(sum(v * v for v in vec)) - 1.0) < 1e-3
+            # different inputs → different embeddings
+            assert d["data"][0]["embedding"] != d["data"][1]["embedding"]
+            assert d["usage"]["prompt_tokens"] > 0
+            # string input form
+            r2 = await client.post(
+                "/v1/embeddings", json={"model": "tiny", "input": "hello world"}
+            )
+            d2 = await r2.json()
+            assert d2["data"][0]["embedding"] == d["data"][0]["embedding"]
+            # bad input rejected
+            r3 = await client.post("/v1/embeddings", json={"input": 7})
+            assert r3.status == 400
+        finally:
+            await client.close()
