@@ -720,10 +720,16 @@ def build_app(
                 {"detail": "'input' must be a string or list of strings"},
                 status=400,
             )
-        id_lists = [
-            tokenizer.encode(text)[- engine.max_seq :] or [0]
-            for text in inputs
-        ]
+        id_lists = [tokenizer.encode(text) or [0] for text in inputs]
+        for i, ids in enumerate(id_lists):
+            if len(ids) > engine.max_seq:
+                # OpenAI returns a context-length error rather than
+                # silently embedding a truncated tail
+                return web.json_response(
+                    {"detail": f"input {i} has {len(ids)} tokens, over "
+                               f"the model's {engine.max_seq} maximum"},
+                    status=400,
+                )
         total_tokens = sum(len(ids) for ids in id_lists)
 
         def _compute():

@@ -65,6 +65,7 @@ function toast(msg) {
 /* ---------- layout ---------- */
 
 const PAGES = [
+  ["overview", "Overview"],
   ["runs", "Runs"],
   ["models", "Models"],
   ["fleets", "Fleets"],
@@ -244,24 +245,78 @@ function table(headers, rows, empty) {
 
 /* ---------- pages ---------- */
 
+/* Overview dashboard: burn rate + fleet/run posture at a glance (the
+   reference SPA's landing summary). */
+async function pageOverview() {
+  const [runs, fleets, volumes, gateways] = await Promise.all([
+    papi("/runs/list"), papi("/fleets/list"),
+    papi("/volumes/list"), papi("/gateways/list"),
+  ]);
+  const activeRuns = runs.filter((r) => ACTIVE_STATUSES.includes(r.status));
+  const instances = fleets.flatMap((f) => f.instances || []);
+  const liveInst = instances.filter(
+    (i) => !["terminated", "terminating"].includes(i.status));
+  const burn = liveInst.reduce((s, i) => s + (i.price || 0), 0);
+  const chips = liveInst.reduce(
+    (s, i) => s + (i.instance_type?.resources?.tpu?.chips || 0), 0);
+  const gb = volumes.reduce((s, v) => s + (v.configuration?.size || 0), 0);
+  const tile = (label, value, href) => h("a", {
+    href, class: "stat-tile",
+    style: "display:block;padding:14px 18px;border:1px solid var(--border);" +
+      "border-radius:8px;min-width:130px;text-decoration:none;color:inherit",
+  },
+    h("div", { style: "font-size:26px;font-weight:600" }, String(value)),
+    h("div", { class: "muted" }, label));
+  return h("div", {},
+    h("h1", {}, "Overview"),
+    h("div", { style: "display:flex;flex-wrap:wrap;gap:12px;margin-bottom:20px" },
+      tile("active runs", activeRuns.length, "#/runs"),
+      tile("instances live", liveInst.length, "#/instances"),
+      tile("TPU chips", chips, "#/instances"),
+      tile("burn $/h", `$${burn.toFixed(2)}`, "#/fleets"),
+      tile("fleets", fleets.length, "#/fleets"),
+      tile("volumes GB", gb, "#/volumes"),
+      tile("gateways", gateways.length, "#/gateways"),
+    ),
+    h("h1", {}, "Recent runs"),
+    table(["Name", "Type", "Status", "Submitted"],
+      runs.slice(0, 8).map((r) => h("tr", {},
+        h("td", {}, h("a", { href: `#/runs/${r.run_spec.run_name}` },
+          r.run_spec.run_name)),
+        h("td", {}, r.run_spec.configuration?.type || "task"),
+        h("td", {}, statusBadge(r.status)),
+        h("td", {}, fmtDate(r.submitted_at)))),
+      "No runs yet"),
+  );
+}
+
 async function pageRuns() {
   const runs = await papi("/runs/list");
-  return h("div", {},
-    h("h1", { style: "display:flex;align-items:center;gap:12px" }, "Runs",
-      h("div", { style: "flex:1" }),
-    ),
-    yamlApplyPanel(
-      "+ Submit run",
-      "type: task\ncommands:\n  - python train.py\nresources:\n  tpu: v5e-8",
-      (res) => {
-        // apply_yaml dispatches by type: only run kinds have a detail page
-        if (res.kind === "run") location.hash = `#/runs/${res.name}`;
-        else render();
-      },
-    ),
-    table(
+  // client-side filtering re-renders ONLY the table container: a full
+  // render() would rebuild the DOM and steal focus from the input
+  const listDiv = h("div", {});
+  const filterIn = h("input", {
+    placeholder: "filter by name/status/type", value: state.runsFilter || "",
+    style: "width:220px",
+  });
+  const activeCb = h("input", { type: "checkbox" });
+  activeCb.checked = !!state.runsActiveOnly;
+  const applyFilter = () => {
+    const q = (state.runsFilter || "").toLowerCase();
+    const filtered = runs.filter((r) => {
+      if (state.runsActiveOnly && !ACTIVE_STATUSES.includes(r.status)) return false;
+      if (!q) return true;
+      const hay = (`${r.run_spec.run_name} ${r.status} ` +
+        `${r.run_spec.configuration?.type || ""}`).toLowerCase();
+      return hay.includes(q);
+    });
+    listDiv.replaceChildren(runsTable(filtered));
+  };
+  filterIn.oninput = () => { state.runsFilter = filterIn.value; applyFilter(); };
+  activeCb.onchange = () => { state.runsActiveOnly = activeCb.checked; applyFilter(); };
+  const runsTable = (rows) => table(
       ["Name", "Type", "Status", "Backend", "Resources", "Submitted", ""],
-      runs.map((r) => {
+      rows.map((r) => {
         const sub = r.jobs?.[0]?.job_submissions?.slice(-1)[0];
         const jpd = sub?.job_provisioning_data;
         return h("tr", {},
@@ -289,7 +344,25 @@ async function pageRuns() {
         );
       }),
       "No runs — submit one with `dtpu apply -f task.yaml`",
+  );
+  applyFilter();
+  return h("div", {},
+    h("h1", { style: "display:flex;align-items:center;gap:12px" }, "Runs",
+      h("div", { style: "flex:1" }),
+      filterIn,
+      h("label", { class: "muted", style: "display:flex;gap:4px;align-items:center" },
+        activeCb, "active only"),
     ),
+    yamlApplyPanel(
+      "+ Submit run",
+      "type: task\ncommands:\n  - python train.py\nresources:\n  tpu: v5e-8",
+      (res) => {
+        // apply_yaml dispatches by type: only run kinds have a detail page
+        if (res.kind === "run") location.hash = `#/runs/${res.name}`;
+        else render();
+      },
+    ),
+    listDiv,
   );
 }
 
@@ -917,6 +990,7 @@ function renderLogin(err) {
 }
 
 const ROUTES = {
+  overview: pageOverview,
   runs: pageRuns,
   models: pageModels,
   fleets: pageFleets,

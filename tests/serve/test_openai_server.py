@@ -650,3 +650,19 @@ class TestEmbeddings:
             assert r3.status == 400
         finally:
             await client.close()
+
+    async def test_embeddings_overlong_input_400(self):
+        config = llama.LLAMA_TINY
+        params = jax.device_put(llama.init_params(config, jax.random.key(0)))
+        engine = InferenceEngine(config, params, max_batch=2, max_seq=32)
+        app = build_app(engine, ByteTokenizer(), "tiny")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/embeddings", json={"input": "x" * 200}
+            )
+            assert r.status == 400
+            assert "maximum" in (await r.json())["detail"]
+        finally:
+            await client.close()
