@@ -1022,6 +1022,39 @@ def _mark_prompt(
 # ---------------------------------------------------------------------------
 
 
+def copy_cache_prefix(cache: dict, src, dst, *, p: int) -> dict:
+    """Copy the first ``p`` cached positions of slot ``src`` into slot
+    ``dst`` on device (prefix caching: a new request whose prompt shares
+    a prefix with an already-cached sequence skips prefilling it).
+    ``p`` is static (jitted per chunk-aligned length); src/dst are
+    traced scalars so one compile serves every slot pair."""
+    out = {}
+    for name, a in cache.items():
+        if name == "ckv":  # MLA latent [L, B, T, R]
+            rows = jax.lax.dynamic_index_in_dim(
+                a, src, axis=1, keepdims=False
+            )  # [L, T, R]
+            rows = rows[:, None, :p]
+            out[name] = jax.lax.dynamic_update_slice(a, rows, (0, dst, 0, 0))
+        else:  # k/v [L, B, H, T, D]
+            rows = jax.lax.dynamic_index_in_dim(
+                a, src, axis=1, keepdims=False
+            )  # [L, H, T, D]
+            rows = rows[:, None, :, :p]
+            out[name] = jax.lax.dynamic_update_slice(
+                a, rows, (0, dst, 0, 0, 0)
+            )
+    return out
+
+
+def _common_prefix_len(a: list, b: list) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
 def sharded_params(config: LlamaConfig, mesh, seed: int = 0) -> dict:
     """Initialize params directly under the mesh's shardings — the full
     tree never materializes on one chip (required for models bigger
@@ -1054,6 +1087,7 @@ class InferenceEngine:
         prefill_chunk: int = 256,
         spec_draft: int = 4,
         turbo_steps: int = 8,
+        prefix_cache: bool = True,
     ):
         """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
         params shard per the model's logical rules (heads/mlp/vocab over
@@ -1134,6 +1168,17 @@ class InferenceEngine:
         # one per prompt-length bucket; between chunks the scheduler can
         # run decode steps for other slots
         self.prefill_chunk = max(16, min(prefill_chunk, max_seq))
+        # automatic prefix caching: slots whose cache rows still hold a
+        # fully-prefilled prompt (they stay valid after release, until
+        # the slot is reused) → a new request sharing a chunk-aligned
+        # prefix device-copies those rows and skips their prefill
+        # chunks. Chunk alignment keeps the (C, start) compile grid
+        # unchanged — a reused prefix resumes mid-grid, no new kernels.
+        self.prefix_cache = prefix_cache
+        self._prefix_registry: dict[int, list] = {}  # slot → prompt ids
+        self._copy_fns: dict = {}  # p → jitted copy_cache_prefix
+        self.prefix_hits = 0
+        self.prefix_tokens_reused = 0
         # device-side macro-steps for all-greedy batches (see
         # decode_loop): K tokens per dispatch/transfer. 0/1 = per-step.
         self.turbo_steps = max(0, turbo_steps)
@@ -1169,9 +1214,28 @@ class InferenceEngine:
             )
         return self._chunk_fns[key]
 
+    def _find_prefix_source(self, prompt: list) -> tuple[int, Optional[int]]:
+        """Longest chunk-aligned cached prefix of ``prompt`` among
+        registered slots → (reusable length, source slot)."""
+        C = self.prefill_chunk
+        best_len, best_src = 0, None
+        for s, cached in self._prefix_registry.items():
+            common = _common_prefix_len(cached, prompt)
+            # at least one real tail token must prefill (it produces
+            # the first-token logits), and reuse stays chunk-aligned
+            reuse = min(common, len(prompt) - 1) // C * C
+            if reuse >= C and reuse > best_len:
+                best_len, best_src = reuse, s
+        return best_len, best_src
+
     def start_request(self, prompt: list[int], gen: GenParams) -> int:
         """Reserve a slot and queue the prompt for chunked prefill
-        (host bookkeeping only). Raises RuntimeError when full."""
+        (host bookkeeping only). Raises RuntimeError when full.
+
+        With ``prefix_cache``, a prompt sharing a chunk-aligned prefix
+        with a registered slot's cached prompt device-copies those KV
+        rows and starts prefill after them — TTFT for a shared system
+        prompt drops to the unshared tail's prefill time."""
         free = self.free_slots()
         if not free:
             raise RuntimeError("no free slots")
@@ -1181,11 +1245,36 @@ class InferenceEngine:
         keep = max(1, self.max_seq - 1 - gen.max_new_tokens)
         if len(prompt) > keep:
             prompt = prompt[-keep:]
-        slot = free[0]
+        reuse_len, src = (
+            self._find_prefix_source(prompt) if self.prefix_cache else (0, None)
+        )
+        # prefer slots NOT holding a reusable prefix (preserve the
+        # registry), and never overwrite the chosen source itself
+        candidates = [s for s in free if s != src] or free
+        slot = min(
+            candidates, key=lambda s: (s in self._prefix_registry, s)
+        )
+        if slot == src:
+            reuse_len, src = 0, None
+        self._prefix_registry.pop(slot, None)  # rows about to be overwritten
+        start = 0
+        if src is not None and reuse_len > 0:
+            if reuse_len not in self._copy_fns:
+                self._copy_fns[reuse_len] = jax.jit(
+                    partial(copy_cache_prefix, p=reuse_len),
+                    donate_argnums=(0,),
+                )
+            self.cache = self._copy_fns[reuse_len](
+                self.cache, jnp.asarray(src, jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+            )
+            start = reuse_len
+            self.prefix_hits += 1
+            self.prefix_tokens_reused += reuse_len
         self._prefilling[slot] = {
             "prompt": list(prompt),
             "tp": len(prompt),
-            "next": 0,  # next chunk's global start position
+            "next": start,  # next chunk's global start position
             "gen": gen,
         }
         return slot
@@ -1292,6 +1381,10 @@ class InferenceEngine:
                 list(zip(map(int, tids[0]), map(float, tlps[0]))),
             )
         self.active[slot] = True
+        if self.prefix_cache:
+            # the slot's rows now hold this fully-prefilled prompt;
+            # they stay reusable until the slot is reassigned
+            self._prefix_registry[slot] = list(prompt)
         self.history[slot] = []
         self._ngram_ix[slot] = {}
         self._spec_tries[slot] = 0

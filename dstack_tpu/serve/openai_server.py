@@ -422,6 +422,11 @@ def build_app(
             f"dstack_serve_max_slots {e.max_batch}",
             "# TYPE dstack_serve_queue_depth gauge",
             f"dstack_serve_queue_depth {sched.pending.qsize()}",
+            "# TYPE dstack_serve_prefix_hits_total counter",
+            f"dstack_serve_prefix_hits_total {getattr(e, 'prefix_hits', 0)}",
+            "# TYPE dstack_serve_prefix_tokens_reused_total counter",
+            "dstack_serve_prefix_tokens_reused_total "
+            f"{getattr(e, 'prefix_tokens_reused', 0)}",
         ]
         return web.Response(
             text="\n".join(lines) + "\n",
@@ -833,6 +838,11 @@ def main(argv=None) -> int:
         help="skip the startup compile warmup (first request then pays "
              "the prefill/decode XLA compiles in its TTFT)",
     )
+    p.add_argument(
+        "--no-prefix-cache", action="store_true",
+        help="disable automatic prefix caching (KV-row reuse across "
+             "requests sharing a chunk-aligned prompt prefix)",
+    )
     args = p.parse_args(argv)
 
     from dstack_tpu.utils.logging import configure_logging
@@ -933,6 +943,7 @@ def main(argv=None) -> int:
         config, params, max_batch=args.max_batch, max_seq=args.max_seq,
         mesh=mesh, spec_draft=args.spec_draft,
         turbo_steps=args.turbo_steps,
+        prefix_cache=not args.no_prefix_cache,
     )
     # tokenizer first: it's cheap and fail-fast — a typo'd path must
     # not cost a full compile warmup before erroring
@@ -984,6 +995,26 @@ def _warmup_engine(engine) -> None:
         # repetitive prompt → drafts fire → verify_step compiles
         rep = (full[:4] * (engine.prefill_chunk // 4 + 1))[: engine.prefill_chunk]
         run(rep, GenParams(max_new_tokens=spec + 2))
+    if engine.prefix_cache:
+        # pre-compile every chunk-aligned prefix-copy variant (trivial
+        # fused copies, but a cold jit inside start_request would put
+        # the compile wait on a production request's TTFT)
+        import jax.numpy as _jnp
+
+        from dstack_tpu.serve.engine import copy_cache_prefix as _ccp
+        from functools import partial as _part
+
+        import jax as _jax
+
+        p = engine.prefill_chunk
+        while p < engine.max_seq:
+            fn = _jax.jit(_part(_ccp, p=p), donate_argnums=(0,))
+            engine.cache = fn(
+                engine.cache, _jnp.asarray(0, _jnp.int32),
+                _jnp.asarray(0, _jnp.int32),
+            )
+            engine._copy_fns[p] = fn
+            p += engine.prefill_chunk
     logger.info(
         "warmup: %d requests compiled prefill/decode/sample%s in %.1fs",
         runs, "/verify" if spec else "", time.time() - t0,

@@ -196,7 +196,7 @@ function sparkTile(title, series, fmt) {
 
 function currentRoute() {
   const parts = location.hash.replace(/^#\/?/, "").split("/").filter(Boolean);
-  return { page: parts[0] || "runs", arg: parts[1] };
+  return { page: parts[0] || "overview", arg: parts[1] };
 }
 
 function renderShell(content) {

@@ -606,3 +606,92 @@ class TestMLADecode:
             got2.extend(out.get(s2, []))
         assert got1 == ref1
         assert got2 == ref2
+
+
+class TestPrefixCache:
+    """Automatic prefix caching: chunk-aligned KV rows of a cached
+    prompt are device-copied into the new slot and their prefill chunks
+    skipped — output must be token-identical to a cold engine."""
+
+    config = llama.LLAMA_TINY
+
+    def setup_method(self):
+        self.params = llama.init_params(self.config, jax.random.key(0))
+
+    def _engine(self, **kw):
+        kw.setdefault("max_batch", 3)
+        kw.setdefault("max_seq", 96)
+        kw.setdefault("prefill_chunk", 16)
+        kw.setdefault("spec_draft", 0)
+        kw.setdefault("turbo_steps", 0)
+        return InferenceEngine(self.config, self.params, **kw)
+
+    def test_hit_is_token_exact(self):
+        shared = list(range(40, 80))  # 40-token shared "system prompt"
+        p1 = shared + [3, 1]
+        p2 = shared + [9, 9, 2]
+        cold = self._engine(prefix_cache=False)
+        ref2 = cold.generate(p2, GenParams(max_new_tokens=6))
+        eng = self._engine()
+        eng.generate(p1, GenParams(max_new_tokens=4))
+        out2 = eng.generate(p2, GenParams(max_new_tokens=6))
+        assert eng.prefix_hits == 1
+        # 40 shared tokens, chunk 16 → 32 rows copied, 2 chunks skipped
+        assert eng.prefix_tokens_reused == 32
+        assert out2 == ref2
+
+    def test_source_active_during_reuse(self):
+        shared = list(range(10, 50))
+        p1 = shared + [5]
+        p2 = shared + [7, 8]
+        cold = self._engine(prefix_cache=False)
+        ref1 = cold.generate(p1, GenParams(max_new_tokens=8))
+        ref2 = self._engine(prefix_cache=False).generate(
+            p2, GenParams(max_new_tokens=6))
+        eng = self._engine()
+        s1, t1 = eng.add_request(p1, GenParams(max_new_tokens=8))
+        got1 = [t1]
+        got1.extend(eng.step().get(s1, []))  # s1 mid-decode
+        s2, t2 = eng.add_request(p2, GenParams(max_new_tokens=6))
+        assert eng.prefix_hits == 1
+        got2 = [t2]
+        while eng.active[s1] or eng.active[s2]:
+            out = eng.step()
+            got1.extend(out.get(s1, []))
+            got2.extend(out.get(s2, []))
+        assert got1 == ref1
+        assert got2 == ref2
+
+    def test_short_prompts_never_reuse(self):
+        eng = self._engine()
+        eng.generate([1, 2, 3], GenParams(max_new_tokens=2))
+        eng.generate([1, 2, 3, 4], GenParams(max_new_tokens=2))
+        assert eng.prefix_hits == 0
+
+    def test_registry_evicted_on_slot_reuse(self):
+        eng = self._engine(max_batch=1)
+        p = list(range(40))
+        eng.generate(p + [1], GenParams(max_new_tokens=2))
+        assert 0 in eng._prefix_registry
+        # the only slot is also the only candidate: reuse must disable
+        # itself rather than copy from the slot being overwritten
+        eng.generate(p + [2], GenParams(max_new_tokens=2))
+        assert eng.prefix_hits == 0
+        assert eng._prefix_registry.get(0) == p + [2]
+
+    def test_mla_prefix_cache(self):
+        config = llama.MLA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        shared = list(range(30, 70))
+        p2 = shared + [3, 4]
+        cold = InferenceEngine(
+            config, params, max_batch=2, max_seq=96, prefill_chunk=16,
+            spec_draft=0, turbo_steps=0, prefix_cache=False)
+        ref = cold.generate(p2, GenParams(max_new_tokens=5))
+        eng = InferenceEngine(
+            config, params, max_batch=2, max_seq=96, prefill_chunk=16,
+            spec_draft=0, turbo_steps=0)
+        eng.generate(shared + [1], GenParams(max_new_tokens=3))
+        out = eng.generate(p2, GenParams(max_new_tokens=5))
+        assert eng.prefix_hits == 1
+        assert out == ref
