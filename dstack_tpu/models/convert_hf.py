@@ -200,6 +200,12 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         return _llama4_config(hf, common)
     if mt in ("deepseek_v2", "deepseek_v3"):
         return _deepseek_config(hf, common, mt)
+    if mt == "olmo2":
+        # OLMo-2: NO pre-norms (sublayer outputs are normed), q/k
+        # RMSNorm over the full projection width before head reshape
+        return LlamaConfig(
+            **common, pre_norm=False, post_norms=True, qk_norm_flat=True
+        )
     if mt in ("glm", "glm4"):
         # GLM-4: partial rotary (interleaved, first half of head_dim),
         # qkv bias, fused gate_up MLP (split on load); glm4 adds
@@ -493,23 +499,24 @@ def convert_state_dict(
     # (sandwich-norm layouts; _split_glm renames glm4 into this shape)
     gemma2 = model_type in ("gemma2", "gemma3", "gemma3_text", "glm4")
     layers = {
-        "attn_norm": stack(P + "input_layernorm.weight"),
         "wq": stack(P + "self_attn.q_proj.weight", transpose=True),
         "wk": stack(P + "self_attn.k_proj.weight", transpose=True),
         "wv": stack(P + "self_attn.v_proj.weight", transpose=True),
         "wo": stack(P + "self_attn.o_proj.weight", transpose=True),
+    }
+    if c.pre_norm:
+        layers["attn_norm"] = stack(P + "input_layernorm.weight")
         # Gemma2's post_attention_layernorm norms the attention *output*;
         # everywhere else it is the pre-MLP norm
-        "mlp_norm": stack(
+        layers["mlp_norm"] = stack(
             P + ("pre_feedforward_layernorm.weight" if gemma2
                  else "post_attention_layernorm.weight")
-        ),
-    }
+        )
     if c.qkv_bias:
         layers["bq"] = stack(P + "self_attn.q_proj.bias")
         layers["bk"] = stack(P + "self_attn.k_proj.bias")
         layers["bv"] = stack(P + "self_attn.v_proj.bias")
-    if c.qk_norm:
+    if c.qk_norm or c.qk_norm_flat:
         layers["q_norm"] = stack(P + "self_attn.q_norm.weight")
         layers["k_norm"] = stack(P + "self_attn.k_norm.weight")
     if c.post_norms:
@@ -841,6 +848,9 @@ def config_to_hf(config: LlamaConfig) -> dict:
             # all-dense MLA: no layer reaches the MoE branch
             hf.update(first_k_dense_replace=c.n_layers, n_routed_experts=None)
         return hf
+    if not c.pre_norm:
+        hf.update(model_type="olmo2")
+        return hf
     if c.partial_rotary != 1.0:
         hf.update(
             model_type="glm4" if c.post_norms else "glm",
@@ -945,21 +955,22 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
     L = params["layers"]
     for i in range(c.n_layers):
         P = f"model.layers.{i}."
-        sd[P + "input_layernorm.weight"] = np32(L["attn_norm"][i])
         sd[P + "self_attn.q_proj.weight"] = np32(L["wq"][i]).T
         sd[P + "self_attn.k_proj.weight"] = np32(L["wk"][i]).T
         sd[P + "self_attn.v_proj.weight"] = np32(L["wv"][i]).T
         sd[P + "self_attn.o_proj.weight"] = np32(L["wo"][i]).T
-        mlp_norm_name = (
-            "pre_feedforward_layernorm.weight" if gemma2
-            else "post_attention_layernorm.weight"
-        )
-        sd[P + mlp_norm_name] = np32(L["mlp_norm"][i])
+        if c.pre_norm:
+            sd[P + "input_layernorm.weight"] = np32(L["attn_norm"][i])
+            mlp_norm_name = (
+                "pre_feedforward_layernorm.weight" if gemma2
+                else "post_attention_layernorm.weight"
+            )
+            sd[P + mlp_norm_name] = np32(L["mlp_norm"][i])
         if c.qkv_bias:
             sd[P + "self_attn.q_proj.bias"] = np32(L["bq"][i])
             sd[P + "self_attn.k_proj.bias"] = np32(L["bk"][i])
             sd[P + "self_attn.v_proj.bias"] = np32(L["bv"][i])
-        if c.qk_norm:
+        if c.qk_norm or c.qk_norm_flat:
             sd[P + "self_attn.q_norm.weight"] = np32(L["q_norm"][i])
             sd[P + "self_attn.k_norm.weight"] = np32(L["k_norm"][i])
         if c.post_norms:

@@ -106,6 +106,14 @@ class LlamaConfig:
     # (interleaved convention — GLM sets rope_interleaved too); the
     # rest pass through unrotated. 1.0 = full-width rope.
     partial_rotary: float = 1.0
+    # OLMo-2: no pre-norms — sublayer OUTPUTS are normed instead
+    # (pre_norm=False implies post_norms=True; attn_norm/mlp_norm
+    # leave the param tree entirely)
+    pre_norm: bool = True
+    # OLMo-2: q/k RMSNorm over the FULL projection width (all heads
+    # jointly, before the head reshape) — distinct from qk_norm's
+    # per-head-dim norm (Qwen3/Gemma3)
+    qk_norm_flat: bool = False
     # --- DeepSeek MLA (multi-head latent attention) deltas ---
     # kv_lora_rank > 0 enables MLA: k/v decode from a shared low-rank
     # latent (kv_a_proj → rmsnorm → kv_b_proj), q/k heads split into a
@@ -134,6 +142,15 @@ class LlamaConfig:
     # params["dense_layers"] stack scanned before the main layers
     first_k_dense: int = 0
     dense_intermediate: int = 0
+
+    def __post_init__(self):
+        if self.qk_norm and self.qk_norm_flat:
+            raise ValueError(
+                "qk_norm (per-head, Qwen3) and qk_norm_flat (full "
+                "width, OLMo-2) are mutually exclusive"
+            )
+        if not self.pre_norm and not self.post_norms:
+            raise ValueError("pre_norm=False requires post_norms=True")
 
     @property
     def mla(self) -> bool:
@@ -345,6 +362,12 @@ GEMMA3_4B = LlamaConfig(  # text tower of google/gemma-3-4b
     attn_scale=256.0**-0.5,
 )
 
+OLMO2_7B = LlamaConfig(  # allenai/OLMo-2-1124-7B
+    vocab_size=100352, hidden_size=4096, n_layers=32, n_heads=32,
+    n_kv_heads=32, head_dim=128, intermediate_size=11008,
+    rope_theta=500000.0, norm_eps=1e-6, max_seq_len=4096,
+    pre_norm=False, post_norms=True, qk_norm_flat=True,
+)
 GLM_4_9B = LlamaConfig(  # THUDM/GLM-4-9B-0414 (glm4)
     vocab_size=151552, hidden_size=4096, n_layers=40, n_heads=32,
     n_kv_heads=2, head_dim=128, intermediate_size=13696,
@@ -412,6 +435,7 @@ CONFIGS = {
     "deepseek-v3": DEEPSEEK_V3,
     "mla-tiny": MLA_TINY,
     "glm-4-9b": GLM_4_9B,
+    "olmo-2-7b": OLMO2_7B,
 }
 
 
@@ -441,19 +465,21 @@ def param_specs(config: LlamaConfig) -> dict:
             "wo": L + ("heads", "embed_fsdp"),
         }
     dense_mlp = {
-        "mlp_norm": L + (None,),
         "w_gate": L + ("embed_fsdp", "mlp"),
         "w_up": L + ("embed_fsdp", "mlp"),
         "w_down": L + ("mlp", "embed_fsdp"),
     }
+    if config.pre_norm:
+        dense_mlp["mlp_norm"] = L + (None,)
     if config.n_experts:
         mlp = {
-            "mlp_norm": L + (None,),
             "w_router": L + ("embed_fsdp", None),
             "w_gate": L + ("experts", "embed_fsdp", "mlp"),
             "w_up": L + ("experts", "embed_fsdp", "mlp"),
             "w_down": L + ("experts", "mlp", "embed_fsdp"),
         }
+        if config.pre_norm:
+            mlp["mlp_norm"] = L + (None,)
         if config.router_bias:
             mlp["router_bias"] = L + (None,)
         if config.moe_shared_expert:  # dense: shard like a plain MLP
@@ -462,7 +488,9 @@ def param_specs(config: LlamaConfig) -> dict:
             mlp["w_shared_down"] = L + ("mlp", "embed_fsdp")
     else:
         mlp = dense_mlp
-    layer = {"attn_norm": L + (None,), **attn, **mlp}
+    layer = {**attn, **mlp}
+    if config.pre_norm:
+        layer["attn_norm"] = L + (None,)
     if config.qkv_bias:
         layer["bq"] = L + ("heads",)
         layer["bk"] = L + ("kv_heads",)
@@ -470,6 +498,9 @@ def param_specs(config: LlamaConfig) -> dict:
     if config.qk_norm:
         layer["q_norm"] = L + (None,)
         layer["k_norm"] = L + (None,)
+    if config.qk_norm_flat:  # OLMo-2: full projection width
+        layer["q_norm"] = L + ("heads",)
+        layer["k_norm"] = L + ("kv_heads",)
     if config.post_norms:
         layer["attn_post_norm"] = L + (None,)
         layer["mlp_post_norm"] = L + (None,)
@@ -583,10 +614,11 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         }
     if c.n_experts and c.router_bias:
         mlp["router_bias"] = jnp.zeros((L, c.n_experts), jnp.float32)
+    if not c.pre_norm:  # OLMo-2: no input norms in the tree
+        mlp.pop("mlp_norm", None)
     params = {
         "embed": normal(k[0], (c.vocab_size, c.hidden_size)),
         "layers": {
-            "attn_norm": norm_init((L, c.hidden_size)),
             # pass the ORIGINAL key: _init_attn re-splits it to k[1..4],
             # reproducing the exact pre-refactor draws (seed-stable)
             **_init_attn(c, key, L, std),
@@ -594,9 +626,14 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         },
         "final_norm": norm_init((c.hidden_size,)),
     }
+    if c.pre_norm:
+        params["layers"]["attn_norm"] = norm_init((L, c.hidden_size))
     if c.qk_norm:
         params["layers"]["q_norm"] = jnp.ones((L, c.head_dim), dt)
         params["layers"]["k_norm"] = jnp.ones((L, c.head_dim), dt)
+    if c.qk_norm_flat:  # OLMo-2: full projection width
+        params["layers"]["q_norm"] = jnp.ones((L, c.q_dim), dt)
+        params["layers"]["k_norm"] = jnp.ones((L, c.kv_dim), dt)
     if c.post_norms:
         params["layers"]["attn_post_norm"] = norm_init((L, c.hidden_size))
         params["layers"]["mlp_post_norm"] = norm_init((L, c.hidden_size))
@@ -936,7 +973,10 @@ def _attention_block(
 ) -> jax.Array:
     c = config
     b, t, _ = x.shape
-    h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+    h = (
+        rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+        if c.pre_norm else x  # OLMo-2 norms the OUTPUT instead
+    )
     if c.mla:
         q, k, v = mla_qkv(h, layer, c, cos, sin)
         # zero-pad v to the qk head dim so every dispatch path below
@@ -955,6 +995,9 @@ def _attention_block(
             q = q + layer["bq"]
             k = k + layer["bk"]
             v = v + layer["bv"]
+        if c.qk_norm_flat:  # OLMo-2: norm the full projection width
+            q = rms_norm(q, layer["q_norm"], c.norm_eps)
+            k = rms_norm(k, layer["k_norm"], c.norm_eps)
         q = q.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -1023,7 +1066,10 @@ def _mlp_block(
     config: DeepSeek's ``first_k_dense`` prelude layers carry a plain
     dense FFN inside an MoE model and must take the dense branch.
     """
-    h = rms_norm(x, layer["mlp_norm"], config.norm_eps, offset=config.norm_offset)
+    h = (
+        rms_norm(x, layer["mlp_norm"], config.norm_eps, offset=config.norm_offset)
+        if config.pre_norm else x  # OLMo-2 norms the OUTPUT instead
+    )
     if config.n_experts and "w_router" in layer:
         from dstack_tpu.models import moe
 

@@ -137,7 +137,10 @@ def _mlp(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
     """Post-attention MLP sublayer (shared by prefill and decode)."""
     from dstack_tpu.models.llama import act_fn
 
-    m = rms_norm(x, layer["mlp_norm"], c.norm_eps, offset=c.norm_offset)
+    m = (
+        rms_norm(x, layer["mlp_norm"], c.norm_eps, offset=c.norm_offset)
+        if c.pre_norm else x  # OLMo-2 norms the OUTPUT instead
+    )
     # key off w_router in the LAYER: DeepSeek first_k_dense prelude
     # layers are dense inside an MoE model (see llama._mlp_block)
     if c.n_experts and "w_router" in layer:
@@ -168,6 +171,9 @@ def _qkv(h: jax.Array, layer: dict, c: LlamaConfig) -> tuple:
     v = _proj(layer, "wv", h, "bte,ed->btd", "bte,er->btr", "btr,rd->btd")
     if c.qkv_bias:
         q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    if c.qk_norm_flat:  # OLMo-2: norm the full projection width
+        q = rms_norm(q, layer["q_norm"], c.norm_eps)
+        k = rms_norm(k, layer["k_norm"], c.norm_eps)
     return q, k, v
 
 
@@ -514,7 +520,10 @@ def prefill_chunk_step(
     def one_layer(x, layer, ck, cv, window, nope):
         # ck/cv [B_pool, Hkv, Tmax, D] — this layer's cache
         cos, sin = layer_rope(ropes, c, window)
-        h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+        h = (
+            rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+            if c.pre_norm else x
+        )
         q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, cl, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -647,7 +656,10 @@ def decode_step(
             (jnp.where(window > 0, cos_l, cos), jnp.where(window > 0, sin_l, sin))
             if c.rope_local_theta else (cos, sin)
         )
-        h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+        h = (
+            rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+            if c.pre_norm else x
+        )
         q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -839,7 +851,10 @@ def verify_step(
             (jnp.where(window > 0, cos_l, cos), jnp.where(window > 0, sin_l, sin))
             if c.rope_local_theta else (cos, sin)
         )
-        h = rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+        h = (
+            rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+            if c.pre_norm else x
+        )
         q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, sdraft, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, sdraft, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
@@ -1248,6 +1263,19 @@ class InferenceEngine:
         reuse_len, src = (
             self._find_prefix_source(prompt) if self.prefix_cache else (0, None)
         )
+        return self._start_request_inner(prompt, gen, free, reuse_len, src)
+
+    def get_copy_fn(self, p: int):
+        """Jitted prefix-copy for reuse length ``p`` — the single
+        construction point (the server warmup precompiles via this, so
+        its variants can't drift from what start_request builds)."""
+        if p not in self._copy_fns:
+            self._copy_fns[p] = jax.jit(
+                partial(copy_cache_prefix, p=p), donate_argnums=(0,)
+            )
+        return self._copy_fns[p]
+
+    def _start_request_inner(self, prompt, gen, free, reuse_len, src) -> int:
         # prefer slots NOT holding a reusable prefix (preserve the
         # registry), and never overwrite the chosen source itself
         candidates = [s for s in free if s != src] or free
@@ -1259,12 +1287,7 @@ class InferenceEngine:
         self._prefix_registry.pop(slot, None)  # rows about to be overwritten
         start = 0
         if src is not None and reuse_len > 0:
-            if reuse_len not in self._copy_fns:
-                self._copy_fns[reuse_len] = jax.jit(
-                    partial(copy_cache_prefix, p=reuse_len),
-                    donate_argnums=(0,),
-                )
-            self.cache = self._copy_fns[reuse_len](
+            self.cache = self.get_copy_fn(reuse_len)(
                 self.cache, jnp.asarray(src, jnp.int32),
                 jnp.asarray(slot, jnp.int32),
             )

@@ -998,22 +998,16 @@ def _warmup_engine(engine) -> None:
     if engine.prefix_cache:
         # pre-compile every chunk-aligned prefix-copy variant (trivial
         # fused copies, but a cold jit inside start_request would put
-        # the compile wait on a production request's TTFT)
+        # the compile wait on a production request's TTFT); slot 0 onto
+        # itself is a semantic no-op
         import jax.numpy as _jnp
-
-        from dstack_tpu.serve.engine import copy_cache_prefix as _ccp
-        from functools import partial as _part
-
-        import jax as _jax
 
         p = engine.prefill_chunk
         while p < engine.max_seq:
-            fn = _jax.jit(_part(_ccp, p=p), donate_argnums=(0,))
-            engine.cache = fn(
+            engine.cache = engine.get_copy_fn(p)(
                 engine.cache, _jnp.asarray(0, _jnp.int32),
                 _jnp.asarray(0, _jnp.int32),
             )
-            engine._copy_fns[p] = fn
             p += engine.prefill_chunk
     logger.info(
         "warmup: %d requests compiled prefill/decode/sample%s in %.1fs",

@@ -616,7 +616,7 @@ class TestConfigRoundTrip:
         "llama-3.2-1b", "qwen-2.5-7b", "qwen-3-8b", "qwen-3-30b-a3b",
         "mistral-7b", "gemma-2b", "gemma-2-2b", "gemma-3-1b",
         "gemma-3-4b", "mixtral-8x7b", "llama-4-scout",
-        "deepseek-v2-lite", "deepseek-v3", "glm-4-9b",
+        "deepseek-v2-lite", "deepseek-v3", "glm-4-9b", "olmo-2-7b",
     ])
     def test_flags_survive(self, name):
         from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
@@ -637,7 +637,8 @@ class TestConfigRoundTrip:
             "qk_rope_head_dim", "v_head_dim", "router_score",
             "router_bias", "router_groups", "routed_scale",
             "moe_shared_intermediate", "first_k_dense",
-            "dense_intermediate", "partial_rotary",
+            "dense_intermediate", "partial_rotary", "pre_norm",
+            "qk_norm_flat",
         ):
             assert getattr(c2, field) == getattr(c, field), (name, field)
         if not c.mla:  # under MLA head_dim/n_kv_heads are unused
@@ -685,6 +686,63 @@ class TestQwen3Moe:
             ref = m(torch.tensor(tokens)).logits.numpy()
         ours = llama.forward(params, jnp.asarray(tokens), config)
         np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+    def test_olmo2_post_norm_layout(self, tmp_path):
+        """OLMo-2: NO pre-norms (sublayer outputs normed before the
+        residual add) and q/k RMSNorm over the full projection width
+        before the head reshape."""
+        m = _save_tiny(
+            tmp_path, transformers.Olmo2Config, transformers.Olmo2ForCausalLM,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert not cfg.pre_norm and cfg.post_norms and cfg.qk_norm_flat
+
+    def test_olmo2_greedy_decode(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Olmo2Config, transformers.Olmo2ForCausalLM,
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(config, remat=False)
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        eng = InferenceEngine(
+            config, params, max_batch=2, max_seq=48,
+            spec_draft=0, turbo_steps=0,
+        )
+        prompt = [5, 9, 21, 7]
+        out = eng.generate(prompt, GenParams(max_new_tokens=6, temperature=0.0))
+        seq = list(prompt)
+        ref = []
+        for _ in range(6):
+            logits = llama.forward(params, jnp.asarray([seq], jnp.int32), config)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            ref.append(nxt)
+            seq.append(nxt)
+        assert out == ref
+
+    def test_olmo2_export_roundtrip(self, tmp_path):
+        """save_checkpoint(olmo2) → transformers loads it and agrees."""
+        from dstack_tpu.models.convert_hf import save_checkpoint
+
+        config = llama.dataclasses.replace(
+            llama.OLMO2_7B, vocab_size=128, hidden_size=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, head_dim=16, intermediate_size=96,
+            max_seq_len=64, dtype=jnp.float32, remat=False,
+        )
+        params = llama.init_params(config, jax.random.key(0))
+        out = tmp_path / "export"
+        save_checkpoint(config, params, str(out))
+        hf_model = transformers.AutoModelForCausalLM.from_pretrained(
+            str(out), torch_dtype=torch.float32
+        )
+        hf_model.eval()
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, config.vocab_size, (2, 12))
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(params, jnp.asarray(tokens), config)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=0.05, atol=0.05)
 
     def test_glm_partial_rotary(self, tmp_path):
         """GLM: interleaved rope on the first half of head_dim only,
