@@ -80,10 +80,18 @@ def run_bench(
             eng.step()  # repetition drafts → verify kernel compiles
         eng.release(slot)
 
+    # cold TTFT must stay cold: the warmup request registered its
+    # prompt for prefix reuse — drop it (repetitive mode's identical
+    # prompts would otherwise prefix-hit and flatter the numbers)
+    eng._prefix_registry.clear()
+
     # TTFT: admission → first sampled token, per request (chunked prefill)
     ttfts = []
     slots = []
     for prompt in prompts:
+        # per-admission clear: in repetitive mode requests 2..N would
+        # otherwise prefix-hit against request 1's registration
+        eng._prefix_registry.clear()
         t0 = time.perf_counter()
         slot, _ = eng.add_request(
             prompt, GenParams(max_new_tokens=gen_len)
@@ -103,12 +111,59 @@ def run_bench(
     for s in slots:
         eng.release(s)
 
+    # prefix-cache TTFT: a request sharing a long prefix with a served
+    # one skips the shared chunks (chunk-aligned device copy). Prompt
+    # pair at 2× prompt_len so at least one chunk is reusable.
+    C = eng.prefill_chunk
+    # mirror start_request's tail truncation (max_new_tokens=2 here) so
+    # the precompiled copy variant matches the engine's actual reuse
+    plen2 = min(2 * prompt_len, max_seq - 3)
+    long_prompt = rng.integers(1, config.vocab_size, plen2).tolist()
+    follow = long_prompt[:-8] + rng.integers(1, config.vocab_size, 8).tolist()
+    reuse = min(plen2 - 8, len(follow) - 1) // C * C
+    ttft_prefix_ms = ttft_long_cold_ms = None
+    if reuse >= C:
+        import jax.numpy as jnp
+
+        # warm the (chunk, start) prefill variants past prompt_len —
+        # the earlier sections never prefilled a 2× prompt, and a cold
+        # XLA compile would masquerade as prefill time
+        warm = rng.integers(1, config.vocab_size, plen2).tolist()
+        slot, _ = eng.add_request(warm, GenParams(max_new_tokens=2))
+        while eng.active[slot]:
+            eng.step()
+        eng.release(slot)
+        eng._prefix_registry.clear()
+        t0 = time.perf_counter()
+        slot, _ = eng.add_request(long_prompt, GenParams(max_new_tokens=2))
+        ttft_long_cold_ms = round((time.perf_counter() - t0) * 1e3, 1)
+        while eng.active[slot]:
+            eng.step()
+        eng.release(slot)
+        # compile the copy variant outside the timed window (slot 0
+        # onto itself is a semantic no-op)
+        eng.cache = eng.get_copy_fn(reuse)(
+            eng.cache, jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32)
+        )
+        hits0 = eng.prefix_hits
+        t0 = time.perf_counter()
+        slot, _ = eng.add_request(follow, GenParams(max_new_tokens=2))
+        ttft_prefix_ms = round((time.perf_counter() - t0) * 1e3, 1)
+        assert eng.prefix_hits == hits0 + 1, "expected a prefix hit"
+        while eng.active[slot]:
+            eng.step()
+        eng.release(slot)
+
     return {
         "metric": f"serve_decode_tokens_per_sec[{model},batch={batch}]",
         "value": round(tokens / dt, 1),
         "unit": "tokens/s",
         "extra": {
             "ttft_ms_p50": round(statistics.median(ttfts) * 1e3, 1),
+            # 2×-length prompt pair: cold full prefill vs prefix-hit
+            "ttft_long_cold_ms": ttft_long_cold_ms,
+            "ttft_prefix_hit_ms": ttft_prefix_ms,
+            "prefix_reuse_tokens": reuse if reuse >= C else 0,
             "decode_steps": steps,
             "tokens": tokens,
             "tokens_per_step": round(tokens / max(steps, 1), 2),
