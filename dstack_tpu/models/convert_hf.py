@@ -200,6 +200,21 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         return _llama4_config(hf, common)
     if mt in ("deepseek_v2", "deepseek_v3"):
         return _deepseek_config(hf, common, mt)
+    if mt == "cohere":
+        # Command-R: mean-centered LayerNorm, parallel attn+MLP block
+        # over ONE shared input norm, interleaved rope, logit_scale,
+        # optional per-head qk LayerNorm, tied embeddings
+        return LlamaConfig(
+            **{**common,
+               "norm_eps": float(hf.get("layer_norm_eps", 1e-5)),
+               # Cohere ties by default and omits the key when tied
+               "tie_embeddings": bool(hf.get("tie_word_embeddings", True))},
+            norm_type="layernorm",
+            parallel_block=True,
+            rope_interleaved=True,
+            qk_norm=bool(hf.get("use_qk_norm")),
+            logit_scale=float(hf.get("logit_scale", 0.0625)),  # HF default
+        )
     if mt == "olmo2":
         # OLMo-2: NO pre-norms (sublayer outputs are normed), q/k
         # RMSNorm over the full projection width before head reshape
@@ -506,12 +521,15 @@ def convert_state_dict(
     }
     if c.pre_norm:
         layers["attn_norm"] = stack(P + "input_layernorm.weight")
-        # Gemma2's post_attention_layernorm norms the attention *output*;
-        # everywhere else it is the pre-MLP norm
-        layers["mlp_norm"] = stack(
-            P + ("pre_feedforward_layernorm.weight" if gemma2
-                 else "post_attention_layernorm.weight")
-        )
+        if c.parallel_block:
+            pass  # Cohere: attn_norm IS the shared norm (single leaf)
+        else:
+            # Gemma2's post_attention_layernorm norms the attention
+            # *output*; everywhere else it is the pre-MLP norm
+            layers["mlp_norm"] = stack(
+                P + ("pre_feedforward_layernorm.weight" if gemma2
+                     else "post_attention_layernorm.weight")
+            )
     if c.qkv_bias:
         layers["bq"] = stack(P + "self_attn.q_proj.bias")
         layers["bk"] = stack(P + "self_attn.k_proj.bias")
@@ -851,6 +869,14 @@ def config_to_hf(config: LlamaConfig) -> dict:
     if not c.pre_norm:
         hf.update(model_type="olmo2")
         return hf
+    if c.parallel_block:
+        hf.update(
+            model_type="cohere",
+            layer_norm_eps=c.norm_eps,
+            logit_scale=c.logit_scale,
+            use_qk_norm=c.qk_norm,
+        )
+        return hf
     if c.partial_rotary != 1.0:
         hf.update(
             model_type="glm4" if c.post_norms else "glm",
@@ -961,11 +987,12 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
         sd[P + "self_attn.o_proj.weight"] = np32(L["wo"][i]).T
         if c.pre_norm:
             sd[P + "input_layernorm.weight"] = np32(L["attn_norm"][i])
-            mlp_norm_name = (
-                "pre_feedforward_layernorm.weight" if gemma2
-                else "post_attention_layernorm.weight"
-            )
-            sd[P + mlp_norm_name] = np32(L["mlp_norm"][i])
+            if not c.parallel_block:  # Cohere's single norm is aliased
+                mlp_norm_name = (
+                    "pre_feedforward_layernorm.weight" if gemma2
+                    else "post_attention_layernorm.weight"
+                )
+                sd[P + mlp_norm_name] = np32(L["mlp_norm"][i])
         if c.qkv_bias:
             sd[P + "self_attn.q_proj.bias"] = np32(L["bq"][i])
             sd[P + "self_attn.k_proj.bias"] = np32(L["bk"][i])

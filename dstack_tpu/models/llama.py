@@ -114,6 +114,17 @@ class LlamaConfig:
     # jointly, before the head reshape) — distinct from qk_norm's
     # per-head-dim norm (Qwen3/Gemma3)
     qk_norm_flat: bool = False
+    # --- Cohere (Command-R) deltas ---
+    # "layernorm": mean-centered, weight-only LayerNorm everywhere a
+    # model norm applies (Cohere); "rms" is everyone else
+    norm_type: str = "rms"
+    # parallel residual: attention and MLP both read the SAME layer
+    # input and their outputs add jointly (x + attn(n(x)) + mlp(n(x)));
+    # the converter aliases Cohere's single input_layernorm into both
+    # attn_norm and mlp_norm slots
+    parallel_block: bool = False
+    # multiplier on the final logits (Cohere logit_scale); 0 = off
+    logit_scale: float = 0.0
     # --- DeepSeek MLA (multi-head latent attention) deltas ---
     # kv_lora_rank > 0 enables MLA: k/v decode from a shared low-rank
     # latent (kv_a_proj → rmsnorm → kv_b_proj), q/k heads split into a
@@ -220,7 +231,8 @@ class LlamaConfig:
     def num_params(self) -> int:
         e, h = self.vocab_size * self.hidden_size, self.hidden_size
         attn = self._attn_params_per_layer()
-        extras = 2 * h + (2 * h if self.post_norms else 0)
+        pre = (1 if self.parallel_block else 2) if self.pre_norm else 0
+        extras = pre * h + (2 * h if self.post_norms else 0)
         moe_layers = self.n_layers - self.first_k_dense
         per_moe = (
             attn + extras
@@ -248,7 +260,8 @@ class LlamaConfig:
             return self.num_params()
         e, h = self.vocab_size * self.hidden_size, self.hidden_size
         attn = self._attn_params_per_layer()
-        extras = 2 * h + (2 * h if self.post_norms else 0)
+        pre = (1 if self.parallel_block else 2) if self.pre_norm else 0
+        extras = pre * h + (2 * h if self.post_norms else 0)
         moe_layers = self.n_layers - self.first_k_dense
         per_moe = (
             attn + extras
@@ -362,6 +375,13 @@ GEMMA3_4B = LlamaConfig(  # text tower of google/gemma-3-4b
     attn_scale=256.0**-0.5,
 )
 
+COMMAND_R_35B = LlamaConfig(  # CohereForAI/c4ai-command-r-v01
+    vocab_size=256000, hidden_size=8192, n_layers=40, n_heads=64,
+    n_kv_heads=64, head_dim=128, intermediate_size=22528,
+    rope_theta=8000000.0, norm_eps=1e-5, max_seq_len=131072,
+    tie_embeddings=True, norm_type="layernorm", parallel_block=True,
+    rope_interleaved=True, logit_scale=0.0625,
+)
 OLMO2_7B = LlamaConfig(  # allenai/OLMo-2-1124-7B
     vocab_size=100352, hidden_size=4096, n_layers=32, n_heads=32,
     n_kv_heads=32, head_dim=128, intermediate_size=11008,
@@ -436,6 +456,7 @@ CONFIGS = {
     "mla-tiny": MLA_TINY,
     "glm-4-9b": GLM_4_9B,
     "olmo-2-7b": OLMO2_7B,
+    "command-r-35b": COMMAND_R_35B,
 }
 
 
@@ -469,7 +490,8 @@ def param_specs(config: LlamaConfig) -> dict:
         "w_up": L + ("embed_fsdp", "mlp"),
         "w_down": L + ("mlp", "embed_fsdp"),
     }
-    if config.pre_norm:
+    if config.pre_norm and not config.parallel_block:
+        # Cohere's parallel block shares attn_norm (one real leaf)
         dense_mlp["mlp_norm"] = L + (None,)
     if config.n_experts:
         mlp = {
@@ -478,7 +500,7 @@ def param_specs(config: LlamaConfig) -> dict:
             "w_up": L + ("experts", "embed_fsdp", "mlp"),
             "w_down": L + ("experts", "mlp", "embed_fsdp"),
         }
-        if config.pre_norm:
+        if config.pre_norm and not config.parallel_block:
             mlp["mlp_norm"] = L + (None,)
         if config.router_bias:
             mlp["router_bias"] = L + (None,)
@@ -496,8 +518,12 @@ def param_specs(config: LlamaConfig) -> dict:
         layer["bk"] = L + ("kv_heads",)
         layer["bv"] = L + ("kv_heads",)
     if config.qk_norm:
-        layer["q_norm"] = L + (None,)
-        layer["k_norm"] = L + (None,)
+        if config.norm_type == "layernorm":  # Cohere [H, D] weights
+            layer["q_norm"] = L + ("heads", None)
+            layer["k_norm"] = L + ("kv_heads", None)
+        else:
+            layer["q_norm"] = L + (None,)
+            layer["k_norm"] = L + (None,)
     if config.qk_norm_flat:  # OLMo-2: full projection width
         layer["q_norm"] = L + ("heads",)
         layer["k_norm"] = L + ("kv_heads",)
@@ -614,7 +640,9 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         }
     if c.n_experts and c.router_bias:
         mlp["router_bias"] = jnp.zeros((L, c.n_experts), jnp.float32)
-    if not c.pre_norm:  # OLMo-2: no input norms in the tree
+    if not c.pre_norm or c.parallel_block:
+        # OLMo-2 has no input norms; Cohere's parallel block shares
+        # attn_norm for both sublayers (one real leaf)
         mlp.pop("mlp_norm", None)
     params = {
         "embed": normal(k[0], (c.vocab_size, c.hidden_size)),
@@ -629,8 +657,12 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     if c.pre_norm:
         params["layers"]["attn_norm"] = norm_init((L, c.hidden_size))
     if c.qk_norm:
-        params["layers"]["q_norm"] = jnp.ones((L, c.head_dim), dt)
-        params["layers"]["k_norm"] = jnp.ones((L, c.head_dim), dt)
+        if c.norm_type == "layernorm":  # Cohere per-head weights
+            params["layers"]["q_norm"] = jnp.ones((L, c.n_heads, c.head_dim), dt)
+            params["layers"]["k_norm"] = jnp.ones((L, c.n_kv_heads, c.head_dim), dt)
+        else:
+            params["layers"]["q_norm"] = jnp.ones((L, c.head_dim), dt)
+            params["layers"]["k_norm"] = jnp.ones((L, c.head_dim), dt)
     if c.qk_norm_flat:  # OLMo-2: full projection width
         params["layers"]["q_norm"] = jnp.ones((L, c.q_dim), dt)
         params["layers"]["k_norm"] = jnp.ones((L, c.kv_dim), dt)
@@ -670,6 +702,39 @@ def rms_norm(
         w = 1.0 + w.astype(jnp.float32)
         return ((x32 * rms) * w).astype(x.dtype)
     return (x32 * rms).astype(x.dtype) * w
+
+
+def layer_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    """Mean-centered, weight-only LayerNorm in f32 (Cohere). ``w`` may
+    carry leading broadcast dims (per-head qk norms store [H, D])."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def model_norm(x: jax.Array, w: jax.Array, config: "LlamaConfig") -> jax.Array:
+    """The model's norm flavor: RMSNorm (with the Gemma offset
+    convention) or Cohere's mean-centered LayerNorm."""
+    if config.norm_type == "layernorm":
+        return layer_norm(x, w, config.norm_eps)
+    return rms_norm(x, w, config.norm_eps, offset=config.norm_offset)
+
+
+def qk_norm_apply(q, k, layer: dict, c: "LlamaConfig"):
+    """Per-head q/k norm on [B, H, T, D]: Qwen3/Gemma3 RMSNorm with a
+    shared [D] weight, or Cohere per-head LayerNorm with [H, D] /
+    [Hkv, D] weights."""
+    if c.norm_type == "layernorm":
+        return (
+            layer_norm(q, layer["q_norm"][None, :, None, :], c.norm_eps),
+            layer_norm(k, layer["k_norm"][None, :, None, :], c.norm_eps),
+        )
+    return (
+        rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset),
+        rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset),
+    )
 
 
 def act_fn(config: "LlamaConfig"):
@@ -974,7 +1039,7 @@ def _attention_block(
     c = config
     b, t, _ = x.shape
     h = (
-        rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+        model_norm(x, layer["attn_norm"], c)
         if c.pre_norm else x  # OLMo-2 norms the OUTPUT instead
     )
     if c.mla:
@@ -1001,11 +1066,8 @@ def _attention_block(
         q = q.reshape(b, t, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, t, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        if c.qk_norm:  # Qwen3/Gemma3: per-head-dim RMSNorm before rope
-            # Gemma3 stores zero-centered norm weights (the family's
-            # norm_offset convention applies to q/k norms too)
-            q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
-            k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
+        if c.qk_norm:  # per-head q/k norm before rope (Qwen3/Cohere)
+            q, k = qk_norm_apply(q, k, layer, c)
         q = constrain(q, rules, "batch", "heads", "seq", None, mesh=mesh)
         k = constrain(k, rules, "batch", "kv_heads", "seq", None, mesh=mesh)
         if not nope:
@@ -1049,7 +1111,7 @@ def _attention_block(
     o = o.transpose(0, 2, 1, 3).reshape(b, t, c.o_dim)
     out = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
     if c.post_norms:
-        out = rms_norm(out, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
+        out = model_norm(out, layer["attn_post_norm"], c)
     return constrain(out, rules, "batch", "seq", None, mesh=mesh)
 
 
@@ -1067,8 +1129,9 @@ def _mlp_block(
     dense FFN inside an MoE model and must take the dense branch.
     """
     h = (
-        rms_norm(x, layer["mlp_norm"], config.norm_eps, offset=config.norm_offset)
+        model_norm(x, layer.get("mlp_norm", layer.get("attn_norm")), config)
         if config.pre_norm else x  # OLMo-2 norms the OUTPUT instead
+        # (parallel_block shares attn_norm — Cohere's single input norm)
     )
     if config.n_experts and "w_router" in layer:
         from dstack_tpu.models import moe
@@ -1099,7 +1162,7 @@ def _mlp_block(
         layer, "w_down", act_fn(config)(g) * u, "btf,fe->bte", "btf,fr->btr", "btr,re->bte"
     )
     if config.post_norms:
-        o = rms_norm(o, layer["mlp_post_norm"], config.norm_eps, offset=config.norm_offset)
+        o = model_norm(o, layer["mlp_post_norm"], config)
     return constrain(o, rules, "batch", "seq", None, mesh=mesh), jnp.zeros((), jnp.float32)
 
 
@@ -1137,12 +1200,14 @@ def _lm_head(
     return_hidden: bool,
 ) -> jax.Array:
     """Shared forward tail: final norm, then logits (or hidden states)."""
-    x = rms_norm(x, params["final_norm"], config.norm_eps, offset=config.norm_offset)
+    x = model_norm(x, params["final_norm"], config)
     if return_hidden:
         return x
     logits = head_logits_einsum(params, x, config, "bte,ev->btv")
     logits = constrain(logits, rules, "batch", "seq", "vocab", mesh=mesh)
     logits = logits.astype(jnp.float32)
+    if config.logit_scale:
+        logits = logits * config.logit_scale  # Cohere
     if config.logit_softcap:
         cap = config.logit_softcap
         logits = cap * jnp.tanh(logits / cap)
@@ -1228,12 +1293,19 @@ def forward(
                     jax.tree.map(lambda a: a[i], group) if stacked else group
                 )
                 cos, sin = layer_rope(ropes, c, w)
-                x = x + _attention_block(
+                ao = _attention_block(
                     x, layer, c, cos, sin, mesh, rules, attn_impl,
                     window=w, nope=np_, positions=pos,
                 )
-                o, aux_i = _mlp_block(x, layer, c, mesh, rules)
-                x = x + o
+                if c.parallel_block:
+                    # Cohere: attention and MLP read the SAME input,
+                    # outputs add jointly (mlp_norm aliases attn_norm)
+                    o, aux_i = _mlp_block(x, layer, c, mesh, rules)
+                    x = x + ao + o
+                else:
+                    x = x + ao
+                    o, aux_i = _mlp_block(x, layer, c, mesh, rules)
+                    x = x + o
                 aux = aux + aux_i
             return x, aux
 

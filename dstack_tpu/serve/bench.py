@@ -122,7 +122,8 @@ def run_bench(
     follow = long_prompt[:-8] + rng.integers(1, config.vocab_size, 8).tolist()
     reuse = min(plen2 - 8, len(follow) - 1) // C * C
     ttft_prefix_ms = ttft_long_cold_ms = None
-    if reuse >= C:
+    # batch 1 cannot prefix-hit: the only slot is also the source
+    if reuse >= C and batch >= 2:
         import jax.numpy as jnp
 
         # warm the (chunk, start) prefill variants past prompt_len —

@@ -26,6 +26,8 @@ from dstack_tpu.models import llama
 from dstack_tpu.models.llama import (
     LlamaConfig,
     _proj,
+    model_norm,
+    qk_norm_apply,
     rms_norm,
 )
 
@@ -134,12 +136,19 @@ def _apply_rope_batch(
 
 
 def _mlp(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
-    """Post-attention MLP sublayer (shared by prefill and decode)."""
+    """x + MLP sublayer (shared by prefill and decode)."""
+    return x + _mlp_out(x, layer, c)
+
+
+def _mlp_out(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
+    """The MLP sublayer output alone (Cohere's parallel block adds it
+    next to the attention output instead of sequentially)."""
     from dstack_tpu.models.llama import act_fn
 
     m = (
-        rms_norm(x, layer["mlp_norm"], c.norm_eps, offset=c.norm_offset)
+        model_norm(x, layer.get("mlp_norm", layer.get("attn_norm")), c)
         if c.pre_norm else x  # OLMo-2 norms the OUTPUT instead
+        # (parallel_block shares attn_norm — Cohere's single input norm)
     )
     # key off w_router in the LAYER: DeepSeek first_k_dense prelude
     # layers are dense inside an MoE model (see llama._mlp_block)
@@ -161,8 +170,8 @@ def _mlp(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
             "btf,fe->bte", "btf,fr->btr", "btr,re->bte",
         )
     if c.post_norms:
-        mo = rms_norm(mo, layer["mlp_post_norm"], c.norm_eps, offset=c.norm_offset)
-    return x + mo
+        mo = model_norm(mo, layer["mlp_post_norm"], c)
+    return mo
 
 
 def _qkv(h: jax.Array, layer: dict, c: LlamaConfig) -> tuple:
@@ -236,6 +245,8 @@ def _head_logits(
     from dstack_tpu.models.llama import head_logits_einsum
 
     logits = head_logits_einsum(params, x, c, eq)
+    if c.logit_scale:
+        logits = logits * c.logit_scale  # Cohere
     if c.logit_softcap:
         logits = c.logit_softcap * jnp.tanh(logits / c.logit_softcap)
     return logits
@@ -521,16 +532,15 @@ def prefill_chunk_step(
         # ck/cv [B_pool, Hkv, Tmax, D] — this layer's cache
         cos, sin = layer_rope(ropes, c, window)
         h = (
-            rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+            model_norm(x, layer["attn_norm"], c)
             if c.pre_norm else x
         )
         q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, cl, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        if c.qk_norm:
-            q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
-            k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
+        if c.qk_norm:  # per-head q/k norm (Qwen3 rms / Cohere ln)
+            q, k = qk_norm_apply(q, k, layer, c)
         if not nope:
             q = apply_rope(q, cos, sin, interleaved=c.rope_interleaved)
             k = apply_rope(k, cos, sin, interleaved=c.rope_interleaved)
@@ -558,7 +568,9 @@ def prefill_chunk_step(
         o = o.transpose(0, 2, 1, 3).reshape(b, cl, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
-            ao = rms_norm(ao, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
+            ao = model_norm(ao, layer["attn_post_norm"], c)
+        if c.parallel_block:  # Cohere: joint residual add
+            return x + ao + _mlp_out(x, layer, c), ck, cv
         x = x + ao
         return _mlp(x, layer, c), ck, cv
 
@@ -595,7 +607,7 @@ def prefill_chunk_step(
         ks = jnp.concatenate([ks, jnp.stack(tks)], axis=0)
         vs = jnp.concatenate([vs, jnp.stack(tvs)], axis=0)
     cache = {"k": ks, "v": vs}
-    x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)
+    x = model_norm(x, params["final_norm"], c)
     last = jnp.take_along_axis(
         x, last_ix[None, None, None].astype(jnp.int32), axis=1
     )[:, 0]
@@ -657,16 +669,15 @@ def decode_step(
             if c.rope_local_theta else (cos, sin)
         )
         h = (
-            rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+            model_norm(x, layer["attn_norm"], c)
             if c.pre_norm else x
         )
         q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, 1, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, 1, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        if c.qk_norm:  # Qwen3/Gemma3: per-head-dim RMSNorm before rope
-            q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
-            k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
+        if c.qk_norm:  # per-head q/k norm (Qwen3 rms / Cohere ln)
+            q, k = qk_norm_apply(q, k, layer, c)
         q_ro = _apply_rope_batch(q, cs, sn, interleaved=c.rope_interleaved)
         k_ro = _apply_rope_batch(k, cs, sn, interleaved=c.rope_interleaved)
         if c.qk_l2_norm:  # Llama4: weightless L2 norm after rope
@@ -714,7 +725,9 @@ def decode_step(
         o = o.reshape(b, 1, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
-            ao = rms_norm(ao, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
+            ao = model_norm(ao, layer["attn_post_norm"], c)
+        if c.parallel_block:  # Cohere: joint residual add
+            return x + ao + _mlp_out(x, layer, c), (ck, cv)
         x = x + ao
         return _mlp(x, layer, c), (ck, cv)
 
@@ -722,7 +735,7 @@ def decode_step(
         layer_fn, x, (params["layers"], cache["k"], cache["v"], windows, nopes)
     )
     cache = {"k": ks, "v": vs}
-    x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)
+    x = model_norm(x, params["final_norm"], c)
     return _head_logits(params, x[:, 0], c), cache
 
 
@@ -852,16 +865,15 @@ def verify_step(
             if c.rope_local_theta else (cos, sin)
         )
         h = (
-            rms_norm(x, layer["attn_norm"], c.norm_eps, offset=c.norm_offset)
+            model_norm(x, layer["attn_norm"], c)
             if c.pre_norm else x
         )
         q, k, v = _qkv(h, layer, c)
         q = q.reshape(b, sdraft, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
         k = k.reshape(b, sdraft, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
         v = v.reshape(b, sdraft, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        if c.qk_norm:
-            q = rms_norm(q, layer["q_norm"], c.norm_eps, offset=c.norm_offset)
-            k = rms_norm(k, layer["k_norm"], c.norm_eps, offset=c.norm_offset)
+        if c.qk_norm:  # per-head q/k norm (Qwen3 rms / Cohere ln)
+            q, k = qk_norm_apply(q, k, layer, c)
         q_ro = rope_rows(q, cs, sn)
         k_ro = rope_rows(k, cs, sn)
         if c.qk_l2_norm:
@@ -906,7 +918,9 @@ def verify_step(
         o = o.transpose(0, 3, 1, 2, 4).reshape(b, sdraft, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
-            ao = rms_norm(ao, layer["attn_post_norm"], c.norm_eps, offset=c.norm_offset)
+            ao = model_norm(ao, layer["attn_post_norm"], c)
+        if c.parallel_block:  # Cohere: joint residual add
+            return x + ao + _mlp_out(x, layer, c), (ck, cv)
         x = x + ao
         return _mlp(x, layer, c), (ck, cv)
 
@@ -914,7 +928,7 @@ def verify_step(
         layer_fn, x, (params["layers"], cache["k"], cache["v"], windows, nopes)
     )
     cache = {"k": ks, "v": vs}
-    x = rms_norm(x, params["final_norm"], c.norm_eps, offset=c.norm_offset)
+    x = model_norm(x, params["final_norm"], c)
     return _head_logits(params, x, c, eq="bse,ev->bsv"), cache
 
 

@@ -617,6 +617,7 @@ class TestConfigRoundTrip:
         "mistral-7b", "gemma-2b", "gemma-2-2b", "gemma-3-1b",
         "gemma-3-4b", "mixtral-8x7b", "llama-4-scout",
         "deepseek-v2-lite", "deepseek-v3", "glm-4-9b", "olmo-2-7b",
+        "command-r-35b",
     ])
     def test_flags_survive(self, name):
         from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
@@ -638,7 +639,7 @@ class TestConfigRoundTrip:
             "router_bias", "router_groups", "routed_scale",
             "moe_shared_intermediate", "first_k_dense",
             "dense_intermediate", "partial_rotary", "pre_norm",
-            "qk_norm_flat",
+            "qk_norm_flat", "norm_type", "parallel_block", "logit_scale",
         ):
             assert getattr(c2, field) == getattr(c, field), (name, field)
         if not c.mla:  # under MLA head_dim/n_kv_heads are unused
@@ -686,6 +687,53 @@ class TestQwen3Moe:
             ref = m(torch.tensor(tokens)).logits.numpy()
         ours = llama.forward(params, jnp.asarray(tokens), config)
         np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=5e-4)
+
+    def test_cohere_parallel_block(self, tmp_path):
+        """Command-R: mean-centered LayerNorm, parallel attn+MLP over
+        one shared input norm, interleaved rope, logit_scale."""
+        m = _save_tiny(
+            tmp_path, transformers.CohereConfig, transformers.CohereForCausalLM,
+            logit_scale=0.0625, use_qk_norm=False, pad_token_id=0,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.parallel_block and cfg.norm_type == "layernorm"
+        assert cfg.logit_scale == 0.0625 and cfg.tie_embeddings
+        assert cfg.rope_interleaved and not cfg.qk_norm
+
+    def test_cohere_qk_norm(self, tmp_path):
+        """Command-R+ adds per-head q/k LayerNorm ([H, D] weights,
+        applied before rope)."""
+        m = _save_tiny(
+            tmp_path, transformers.CohereConfig, transformers.CohereForCausalLM,
+            logit_scale=0.0625, use_qk_norm=True, pad_token_id=0,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.qk_norm and cfg.norm_type == "layernorm"
+
+    def test_cohere_greedy_decode(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.CohereConfig, transformers.CohereForCausalLM,
+            logit_scale=0.0625, use_qk_norm=True, pad_token_id=0,
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(config, remat=False)
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        eng = InferenceEngine(
+            config, params, max_batch=2, max_seq=48,
+            spec_draft=0, turbo_steps=0,
+        )
+        prompt = [5, 9, 21, 7]
+        out = eng.generate(prompt, GenParams(max_new_tokens=6, temperature=0.0))
+        seq = list(prompt)
+        ref = []
+        for _ in range(6):
+            logits = llama.forward(params, jnp.asarray([seq], jnp.int32), config)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            ref.append(nxt)
+            seq.append(nxt)
+        assert out == ref
 
     def test_olmo2_post_norm_layout(self, tmp_path):
         """OLMo-2: NO pre-norms (sublayer outputs normed before the
