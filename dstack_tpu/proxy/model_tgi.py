@@ -27,7 +27,9 @@ logger = get_logger("proxy.model_tgi")
 DEFAULT_CHAT_TEMPLATE = (
     "{% for message in messages %}"
     "<|start_header_id|>{{ message['role'] }}<|end_header_id|>\n\n"
-    "{{ message['content'] }}<|eot_id|>"
+    "{{ message['content'] or '' }}"
+    "{% if message.get('tool_calls') %}{{ message['tool_calls'] | tojson }}"
+    "{% endif %}<|eot_id|>"
     "{% endfor %}"
     "{% if add_generation_prompt %}"
     "<|start_header_id|>assistant<|end_header_id|>\n\n"
@@ -45,8 +47,15 @@ class TGIAdapterError(Exception):
 def render_chat(
     messages: list,
     chat_template: Optional[str] = None,
+    tools: Optional[list] = None,
 ) -> str:
-    """Messages → prompt via a sandboxed jinja chat template."""
+    """Messages → prompt via a sandboxed jinja chat template.
+
+    ``tools`` (OpenAI function specs) are exposed to the template like
+    HF ``apply_chat_template(tools=...)`` — tool-capable templates
+    (llama3.1/qwen/mistral) render them into their system prompt;
+    others ignore the variable.
+    """
     env = jinja2.sandbox.ImmutableSandboxedEnvironment(
         trim_blocks=True, lstrip_blocks=True
     )
@@ -57,7 +66,9 @@ def render_chat(
     env.globals["raise_exception"] = _raise
     try:
         template = env.from_string(chat_template or DEFAULT_CHAT_TEMPLATE)
-        return template.render(messages=messages, add_generation_prompt=True)
+        return template.render(
+            messages=messages, tools=tools, add_generation_prompt=True
+        )
     except jinja2.TemplateError as e:
         raise TGIAdapterError(f"chat template failed: {e}")
 
@@ -67,7 +78,7 @@ def openai_to_tgi(payload: dict, chat_template: Optional[str], eos_token: str) -
     messages = payload.get("messages")
     if not isinstance(messages, list) or not messages:
         raise TGIAdapterError("'messages' is required")
-    inputs = render_chat(messages, chat_template)
+    inputs = render_chat(messages, chat_template, tools=payload.get("tools"))
     stop = payload.get("stop") or []
     if isinstance(stop, str):
         stop = [stop]

@@ -15,6 +15,7 @@ runs in a thread so the event loop keeps serving).
 import argparse
 import asyncio
 import json
+import re
 import time
 import uuid
 from pathlib import Path
@@ -372,6 +373,73 @@ def _gen_params(payload: dict, tokenizer: Tokenizer) -> GenParams:
     )
 
 
+def _valid_chat_message(m) -> bool:
+    """OpenAI chat message shapes: plain {role, content:str}, assistant
+    tool-call messages (content may be null), and role=tool results."""
+    if not isinstance(m, dict):
+        return False
+    if isinstance(m.get("content"), str):
+        return True
+    return m.get("role") == "assistant" and isinstance(
+        m.get("tool_calls"), list
+    )
+
+
+_TOOL_CALL_RE = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.S)
+
+
+def _parse_tool_calls(text: str) -> tuple[Optional[str], Optional[list]]:
+    """Recognize the two dominant open-model tool-call output formats →
+    (remaining content or None, OpenAI ``tool_calls`` list or None).
+
+    - Hermes/Qwen: one or more ``<tool_call>{...}</tool_call>`` blocks;
+      surrounding prose survives as content (OpenAI returns both)
+    - Llama-3.1 JSON: the whole reply is one object with ``name`` and
+      ``arguments``/``parameters``
+
+    Anything else (prose, partial JSON) stays ordinary content — the
+    caller must not lose text by over-eager parsing.
+    """
+    t = text.strip()
+    raw = []
+    content = None
+    if "<tool_call>" in t:
+        for m in _TOOL_CALL_RE.findall(t):
+            try:
+                obj = json.loads(m)
+            except json.JSONDecodeError:
+                return text, None
+            if not (isinstance(obj, dict) and "name" in obj):
+                return text, None
+            raw.append(obj)
+        if not raw:
+            return text, None
+        content = _TOOL_CALL_RE.sub("", t).strip() or None
+    else:
+        try:
+            obj = json.loads(t)
+        except json.JSONDecodeError:
+            return text, None
+        if not (
+            isinstance(obj, dict) and "name" in obj
+            and ("arguments" in obj or "parameters" in obj)
+        ):
+            return text, None
+        raw.append(obj)
+    calls = []
+    for obj in raw:
+        args = obj.get("arguments", obj.get("parameters", {}))
+        calls.append({
+            "id": f"call_{uuid.uuid4().hex[:24]}",
+            "type": "function",
+            "function": {
+                "name": str(obj["name"]),
+                "arguments": args if isinstance(args, str) else json.dumps(args),
+            },
+        })
+    return content, calls
+
+
 def build_app(
     engine: InferenceEngine,
     tokenizer: Tokenizer,
@@ -497,14 +565,25 @@ def build_app(
             return web.json_response({"detail": "invalid JSON body"}, status=400)
         messages = payload.get("messages")
         if not isinstance(messages, list) or not messages or not all(
-            isinstance(m, dict) and isinstance(m.get("content"), str)
-            for m in messages
+            _valid_chat_message(m) for m in messages
         ):
             return web.json_response(
-                {"detail": "'messages' must be [{role, content}, ...]"}, status=400
+                {"detail": "'messages' must be [{role, content}, ...] "
+                           "(assistant tool_calls / role=tool allowed)"},
+                status=400,
+            )
+        tools = payload.get("tools")
+        if tools is not None and not (
+            isinstance(tools, list)
+            and all(isinstance(t, dict) for t in tools)
+        ):
+            return web.json_response(
+                {"detail": "'tools' must be a list of objects"}, status=400
             )
         try:
-            prompt = render_chat(messages, chat_template or DEFAULT_CHAT_TEMPLATE)
+            prompt = render_chat(
+                messages, chat_template or DEFAULT_CHAT_TEMPLATE, tools=tools
+            )
         except TGIAdapterError as e:
             return web.json_response({"detail": str(e)}, status=e.status)
         n = _n_choices(payload)
@@ -563,12 +642,18 @@ def build_app(
                 }
                 await resp.write(b"data: " + json.dumps(chunk).encode() + b"\n\n")
 
+            stream_finish = None
             try:
                 while True:
                     tok = await req.queue.get()
                     if tok is None:
                         break
                     ids.append(tok)
+                    if tools:
+                        # tool-call outputs can't stream as prose: the
+                        # text is only classifiable once complete, so
+                        # buffer and emit a single chunk at the end
+                        continue
                     out = emittable()
                     delta = out[len(sent):]
                     if not delta:
@@ -577,13 +662,41 @@ def build_app(
                     await emit(delta)
                 # generation over: flush held-back text that never
                 # completed into a stop string (minus any true stop cut)
-                if ids:
+                if ids and not tools:
                     full = tokenizer.decode(ids)
                     while full.endswith("�"):
                         full = full[:-1]
                     tail = _truncate_stop(full, req.gen.stop)[len(sent):]
                     if tail:
                         await emit(tail)
+                elif ids and tools:
+                    full = tokenizer.decode(ids)
+                    while full.endswith("�"):
+                        full = full[:-1]
+                    text = _truncate_stop(full, req.gen.stop)
+                    content, tool_calls = _parse_tool_calls(text)
+                    if tool_calls:
+                        delta = {"role": "assistant", "content": content}
+                        delta["tool_calls"] = [
+                            {**c, "index": ci}
+                            for ci, c in enumerate(tool_calls)
+                        ]
+                        chunk = {
+                            "id": completion_id,
+                            "object": "chat.completion.chunk",
+                            "created": created,
+                            "model": model_name,
+                            "choices": [{
+                                "index": 0, "delta": delta,
+                                "finish_reason": None,
+                            }],
+                        }
+                        await resp.write(
+                            b"data: " + json.dumps(chunk).encode() + b"\n\n"
+                        )
+                        stream_finish = "tool_calls"
+                    elif text:
+                        await emit(text)
             finally:
                 sched.cancel(req)  # no-op when finished; frees the slot on disconnect
             if req.error:
@@ -601,7 +714,9 @@ def build_app(
                     {
                         "index": 0,
                         "delta": {},
-                        "finish_reason": req.finish_reason or "stop",
+                        "finish_reason": (
+                            stream_finish or req.finish_reason or "stop"
+                        ),
                     }
                 ],
             }
@@ -615,10 +730,22 @@ def build_app(
         choices = []
         for i, (r, ids) in enumerate(zip(reqs, id_lists)):
             text = _truncate_stop(tokenizer.decode(ids), r.gen.stop)
+            content, tool_calls = (
+                _parse_tool_calls(text) if tools else (text, None)
+            )
+            if tool_calls:
+                message = {
+                    "role": "assistant", "content": content,
+                    "tool_calls": tool_calls,
+                }
+                finish = "tool_calls"
+            else:
+                message = {"role": "assistant", "content": text}
+                finish = r.finish_reason or "stop"
             choice = {
                 "index": i,
-                "message": {"role": "assistant", "content": text},
-                "finish_reason": r.finish_reason or "stop",
+                "message": message,
+                "finish_reason": finish,
             }
             if r.gen.logprobs is not None:
                 choice["logprobs"] = _format_chat_logprobs(

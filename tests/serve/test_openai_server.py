@@ -666,3 +666,104 @@ class TestEmbeddings:
             assert "maximum" in (await r.json())["detail"]
         finally:
             await client.close()
+
+
+class TestToolCalls:
+    def test_parse_hermes_format(self):
+        from dstack_tpu.serve.openai_server import _parse_tool_calls
+
+        text = ('Checking.\n<tool_call>\n{"name": "get_weather", "arguments": '
+                '{"city": "Paris"}}\n</tool_call>')
+        content, calls = _parse_tool_calls(text)
+        assert content == "Checking."  # surrounding prose survives
+        assert calls and calls[0]["type"] == "function"
+        assert calls[0]["function"]["name"] == "get_weather"
+        import json as j
+
+        assert j.loads(calls[0]["function"]["arguments"]) == {"city": "Paris"}
+
+    def test_parse_llama_json_format(self):
+        from dstack_tpu.serve.openai_server import _parse_tool_calls
+
+        content, calls = _parse_tool_calls(
+            '{"name": "search", "parameters": {"q": "tpu"}}')
+        assert content is None
+        assert calls and calls[0]["function"]["name"] == "search"
+
+    def test_prose_is_not_a_tool_call(self):
+        from dstack_tpu.serve.openai_server import _parse_tool_calls
+
+        for text in ("The weather in Paris is nice.", '{"not_a_call": 1}',
+                     "<tool_call>{broken</tool_call>"):
+            content, calls = _parse_tool_calls(text)
+            assert calls is None and content == text
+
+    async def test_chat_accepts_tools_and_tool_messages(self):
+        config = llama.LLAMA_TINY
+        params = jax.device_put(llama.init_params(config, jax.random.key(0)))
+        engine = InferenceEngine(config, params, max_batch=2, max_seq=64)
+        # template that proves tools reach the renderer
+        tmpl = ("{% for m in messages %}{{ m['role'] }}:"
+                "{{ m['content'] or '' }}\n{% endfor %}"
+                "{% if tools %}TOOLS:{{ tools|length }}\n{% endif %}assistant:")
+        app = build_app(engine, ByteTokenizer(), "tiny", chat_template=tmpl)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "tiny",
+                "messages": [
+                    {"role": "user", "content": "hi"},
+                    {"role": "assistant", "content": None, "tool_calls": [
+                        {"id": "call_1", "type": "function",
+                         "function": {"name": "f", "arguments": "{}"}}]},
+                    {"role": "tool", "content": "42", "tool_call_id": "call_1"},
+                ],
+                "tools": [{"type": "function",
+                           "function": {"name": "f", "parameters": {}}}],
+                "max_tokens": 4,
+            })
+            assert r.status == 200
+            d = await r.json()
+            assert d["choices"][0]["finish_reason"] in ("stop", "length",
+                                                        "tool_calls")
+            # bad tools rejected
+            r2 = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "x"}],
+                "tools": "nope", "max_tokens": 2,
+            })
+            assert r2.status == 400
+        finally:
+            await client.close()
+
+
+    async def test_streaming_with_tools_buffers(self):
+        """stream=true + tools: content is buffered (tool markup must
+        never leak as prose deltas) and arrives as one chunk."""
+        config = llama.LLAMA_TINY
+        params = jax.device_put(llama.init_params(config, jax.random.key(0)))
+        engine = InferenceEngine(config, params, max_batch=2, max_seq=64)
+        app = build_app(engine, ByteTokenizer(), "tiny")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": [{"type": "function",
+                           "function": {"name": "f", "parameters": {}}}],
+                "max_tokens": 5, "stream": True,
+            })
+            assert r.status == 200
+            body = await r.text()
+            chunks = [json.loads(line[len("data: "):])
+                      for line in body.splitlines()
+                      if line.startswith("data: ") and line != "data: [DONE]"]
+            # exactly one content-bearing delta (buffered), then final
+            deltas = [c for c in chunks
+                      if c["choices"][0]["delta"].get("content")
+                      or c["choices"][0]["delta"].get("tool_calls")]
+            assert len(deltas) <= 1
+            assert chunks[-1]["choices"][0]["finish_reason"] in (
+                "stop", "length", "tool_calls")
+        finally:
+            await client.close()
