@@ -215,6 +215,30 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             qk_norm=bool(hf.get("use_qk_norm")),
             logit_scale=float(hf.get("logit_scale", 0.0625)),  # HF default
         )
+    if mt == "cohere2":
+        # Command R7B: the Cohere layout (LayerNorm, parallel block,
+        # logit_scale, interleaved rope) + a periodic sliding layout
+        # where the full-attention layers carry NO rope at all — the
+        # NoPE layers ARE the global layers, same period
+        if hf.get("use_qk_norm"):
+            raise ValueError("cohere2 use_qk_norm is not supported")
+        # cohere2's default period is 4 (_gemma3_pattern would fall
+        # back to Gemma3's 6 when both layout fields are absent)
+        hf_l = {**hf}
+        hf_l.setdefault("sliding_window_pattern", 4)
+        sw, pattern = _gemma3_pattern(hf_l, hf.get("sliding_window") or 0)
+        return LlamaConfig(
+            **{**common,
+               "norm_eps": float(hf.get("layer_norm_eps", 1e-5)),
+               "tie_embeddings": bool(hf.get("tie_word_embeddings", True))},
+            norm_type="layernorm",
+            parallel_block=True,
+            rope_interleaved=True,
+            logit_scale=float(hf.get("logit_scale", 0.0625)),
+            sliding_window=sw,
+            sliding_pattern=pattern,
+            nope_pattern=pattern if sw else 0,
+        )
     if mt == "olmo2":
         # OLMo-2: NO pre-norms (sublayer outputs are normed), q/k
         # RMSNorm over the full projection width before head reshape
@@ -870,12 +894,31 @@ def config_to_hf(config: LlamaConfig) -> dict:
         hf.update(model_type="olmo2")
         return hf
     if c.parallel_block:
-        hf.update(
-            model_type="cohere",
-            layer_norm_eps=c.norm_eps,
-            logit_scale=c.logit_scale,
-            use_qk_norm=c.qk_norm,
-        )
+        if c.sliding_window:
+            if c.qk_norm or c.nope_pattern != c.sliding_pattern:
+                raise ValueError(
+                    "cohere2 export requires nope_pattern == "
+                    "sliding_pattern and no qk_norm (the HF config "
+                    "cannot express other layouts)"
+                )
+            hf.update(
+                model_type="cohere2",
+                layer_norm_eps=c.norm_eps,
+                logit_scale=c.logit_scale,
+                sliding_window=c.sliding_window,
+                sliding_window_pattern=c.sliding_pattern,
+                layer_types=[
+                    "sliding_attention" if w else "full_attention"
+                    for w in _layer_windows(c)
+                ],
+            )
+        else:
+            hf.update(
+                model_type="cohere",
+                layer_norm_eps=c.norm_eps,
+                logit_scale=c.logit_scale,
+                use_qk_norm=c.qk_norm,
+            )
         return hf
     if c.partial_rotary != 1.0:
         hf.update(

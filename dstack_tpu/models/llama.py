@@ -763,10 +763,15 @@ def grouped_scan_layout(config: "LlamaConfig", xs: dict):
     mixed_windows = len(set(windows)) > 1
     mixed_nope = len(set(nopes)) > 1
     if mixed_windows and mixed_nope:
-        raise ValueError(
-            "mixed sliding windows and NoPE layers together are not "
-            "supported (no known family combines them)"
+        aligned = config.sliding_pattern == config.nope_pattern and all(
+            (w == 0) == n for w, n in zip(windows, nopes)
         )
+        if not aligned:
+            raise ValueError(
+                "mixed sliding windows and NoPE layers are only "
+                "supported when aligned (Cohere2: the global layers "
+                "ARE the NoPE layers, same period)"
+            )
     g = (
         config.sliding_pattern if mixed_windows
         else config.nope_pattern if mixed_nope

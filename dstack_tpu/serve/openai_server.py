@@ -412,9 +412,13 @@ def _parse_tool_calls(text: str) -> tuple[Optional[str], Optional[list]]:
             if not (isinstance(obj, dict) and "name" in obj):
                 return text, None
             raw.append(obj)
-        if not raw:
+        remainder = _TOOL_CALL_RE.sub("", t).strip()
+        if not raw or "<tool_call>" in remainder:
+            # no complete block, or a TRUNCATED trailing block (length
+            # cut mid-call): keep everything as plain content so the
+            # client sees the real finish_reason, not a partial call
             return text, None
-        content = _TOOL_CALL_RE.sub("", t).strip() or None
+        content = remainder or None
     else:
         try:
             obj = json.loads(t)
@@ -580,6 +584,16 @@ def build_app(
             return web.json_response(
                 {"detail": "'tools' must be a list of objects"}, status=400
             )
+        tool_choice = payload.get("tool_choice")
+        if tool_choice == "none":
+            tools = None  # opt-out: render no tools, parse nothing
+        elif tool_choice not in (None, "auto"):
+            # 'required' / named-function forcing needs constrained
+            # decoding — refuse loudly rather than silently not forcing
+            return web.json_response(
+                {"detail": "tool_choice supports 'auto' and 'none' only"},
+                status=400,
+            )
         try:
             prompt = render_chat(
                 messages, chat_template or DEFAULT_CHAT_TEMPLATE, tools=tools
@@ -615,11 +629,14 @@ def build_app(
                 full = _truncate_stop(full, req.gen.stop)
                 return full[: len(full) - _stop_holdback(full, req.gen.stop)]
 
-            async def emit(delta: str) -> None:
+            async def emit(delta: str, tool_calls=None) -> None:
                 nonlocal lp_emitted
+                d = {"role": "assistant", "content": delta}
+                if tool_calls is not None:
+                    d["tool_calls"] = tool_calls
                 choice = {
                     "index": 0,
-                    "delta": {"role": "assistant", "content": delta},
+                    "delta": d,
                     "finish_reason": None,
                 }
                 if req.gen.logprobs is not None:

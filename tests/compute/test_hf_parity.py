@@ -1094,3 +1094,66 @@ class TestQwen3Moe:
                 "num_attention_heads": 4, "num_experts": 4,
                 "mlp_only_layers": [0],
             })
+
+
+class TestCohere2:
+    def test_cohere2_sliding_nope_layout(self, tmp_path):
+        """Command R7B: Cohere layout + periodic sliding where the
+        full-attention layers carry NO rope (aligned NoPE)."""
+        m = _save_tiny(
+            tmp_path, transformers.Cohere2Config,
+            transformers.Cohere2ForCausalLM,
+            logit_scale=0.0625, pad_token_id=0, sliding_window=8,
+            sliding_window_pattern=4,
+            layer_types=["sliding_attention", "sliding_attention",
+                         "sliding_attention", "full_attention"],
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.parallel_block and cfg.norm_type == "layernorm"
+        assert cfg.sliding_pattern == 4 and cfg.nope_pattern == 4
+        assert llama.layer_windows(cfg) == [8, 8, 8, 0]
+        assert llama.layer_nope(cfg) == [False, False, False, True]
+
+    def test_cohere2_greedy_decode(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Cohere2Config,
+            transformers.Cohere2ForCausalLM,
+            logit_scale=0.0625, pad_token_id=0, sliding_window=8,
+            sliding_window_pattern=4,
+            layer_types=["sliding_attention", "sliding_attention",
+                         "sliding_attention", "full_attention"],
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(config, remat=False)
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        eng = InferenceEngine(
+            config, params, max_batch=2, max_seq=48,
+            spec_draft=0, turbo_steps=0,
+        )
+        prompt = [5, 9, 21, 7, 3, 2, 8, 1, 4, 6, 11, 13]  # spans the window
+        out = eng.generate(prompt, GenParams(max_new_tokens=6, temperature=0.0))
+        seq = list(prompt)
+        ref = []
+        for _ in range(6):
+            logits = llama.forward(params, jnp.asarray([seq], jnp.int32), config)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            ref.append(nxt)
+            seq.append(nxt)
+        assert out == ref
+
+    def test_cohere2_config_roundtrip(self):
+        from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
+
+        c = llama.LlamaConfig(
+            vocab_size=256, hidden_size=64, n_layers=8, n_heads=4,
+            n_kv_heads=2, head_dim=16, intermediate_size=96,
+            norm_eps=1e-5, tie_embeddings=True, norm_type="layernorm",
+            parallel_block=True, rope_interleaved=True, logit_scale=0.0625,
+            sliding_window=8, sliding_pattern=4, nope_pattern=4,
+        )
+        c2 = config_from_hf(config_to_hf(c), dtype=c.dtype)
+        for f in ("sliding_window", "sliding_pattern", "nope_pattern",
+                  "parallel_block", "norm_type", "logit_scale"):
+            assert getattr(c2, f) == getattr(c, f), f

@@ -767,3 +767,30 @@ class TestToolCalls:
                 "stop", "length", "tool_calls")
         finally:
             await client.close()
+
+    async def test_tool_choice_none_and_unsupported(self):
+        config = llama.LLAMA_TINY
+        params = jax.device_put(llama.init_params(config, jax.random.key(0)))
+        engine = InferenceEngine(config, params, max_batch=2, max_seq=64)
+        app = build_app(engine, ByteTokenizer(), "tiny")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            base = {
+                "messages": [{"role": "user", "content": "hi"}],
+                "tools": [{"type": "function",
+                           "function": {"name": "f", "parameters": {}}}],
+                "max_tokens": 3,
+            }
+            r = await client.post("/v1/chat/completions",
+                                  json={**base, "tool_choice": "none"})
+            assert r.status == 200
+            d = await r.json()
+            # tools opted out: plain content, never tool_calls
+            assert d["choices"][0]["finish_reason"] in ("stop", "length")
+            assert "tool_calls" not in d["choices"][0]["message"]
+            r2 = await client.post("/v1/chat/completions",
+                                   json={**base, "tool_choice": "required"})
+            assert r2.status == 400
+        finally:
+            await client.close()
