@@ -200,6 +200,19 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
         return _llama4_config(hf, common)
     if mt in ("deepseek_v2", "deepseek_v3"):
         return _deepseek_config(hf, common, mt)
+    if mt == "granite":
+        # IBM Granite: llama skeleton + four scalar multipliers
+        # (attention_multiplier IS the softmax scale; logits_scaling
+        # divides, so it maps onto 1/logit_scale)
+        ls = float(hf.get("logits_scaling") or 1.0)
+        return LlamaConfig(
+            **common,
+            qkv_bias=False,
+            attn_scale=float(hf.get("attention_multiplier") or 1.0),
+            embed_multiplier=float(hf.get("embedding_multiplier") or 1.0),
+            residual_multiplier=float(hf.get("residual_multiplier") or 1.0),
+            logit_scale=(1.0 / ls) if ls != 1.0 else 0.0,
+        )
     if mt == "cohere":
         # Command-R: mean-centered LayerNorm, parallel attn+MLP block
         # over ONE shared input norm, interleaved rope, logit_scale,
@@ -892,6 +905,20 @@ def config_to_hf(config: LlamaConfig) -> dict:
         return hf
     if not c.pre_norm:
         hf.update(model_type="olmo2")
+        return hf
+    if c.embed_multiplier or c.residual_multiplier:
+        hf.update(
+            model_type="granite",
+            embedding_multiplier=c.embed_multiplier or 1.0,
+            residual_multiplier=c.residual_multiplier or 1.0,
+            # None means the default 1/sqrt(head_dim) — emit the real
+            # value so a save/load roundtrip keeps the softmax scale
+            attention_multiplier=(
+                c.attn_scale if c.attn_scale is not None
+                else c.qk_head_dim**-0.5
+            ),
+            logits_scaling=(1.0 / c.logit_scale) if c.logit_scale else 1.0,
+        )
         return hf
     if c.parallel_block:
         if c.sliding_window:

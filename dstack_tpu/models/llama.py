@@ -123,8 +123,13 @@ class LlamaConfig:
     # the converter aliases Cohere's single input_layernorm into both
     # attn_norm and mlp_norm slots
     parallel_block: bool = False
-    # multiplier on the final logits (Cohere logit_scale); 0 = off
+    # multiplier on the final logits (Cohere logit_scale; Granite uses
+    # 1/logits_scaling); 0 = off
     logit_scale: float = 0.0
+    # --- IBM Granite deltas (scalar multipliers on the llama skeleton;
+    # attention_multiplier maps onto attn_scale) ---
+    embed_multiplier: float = 0.0  # scales embeddings (0 = off)
+    residual_multiplier: float = 0.0  # scales sublayer outputs (0 = off)
     # --- DeepSeek MLA (multi-head latent attention) deltas ---
     # kv_lora_rank > 0 enables MLA: k/v decode from a shared low-rank
     # latent (kv_a_proj → rmsnorm → kv_b_proj), q/k heads split into a
@@ -1117,6 +1122,8 @@ def _attention_block(
     out = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
     if c.post_norms:
         out = model_norm(out, layer["attn_post_norm"], c)
+    if c.residual_multiplier:  # Granite scales the sublayer output
+        out = out * jnp.asarray(c.residual_multiplier, out.dtype)
     return constrain(out, rules, "batch", "seq", None, mesh=mesh)
 
 
@@ -1168,6 +1175,8 @@ def _mlp_block(
     )
     if config.post_norms:
         o = model_norm(o, layer["mlp_post_norm"], config)
+    if config.residual_multiplier:  # Granite scales the sublayer output
+        o = o * jnp.asarray(config.residual_multiplier, o.dtype)
     return constrain(o, rules, "batch", "seq", None, mesh=mesh), jnp.zeros((), jnp.float32)
 
 
@@ -1191,6 +1200,8 @@ def _embed_tokens(
     if config.embed_scale:
         # Gemma: the normalizer is rounded to the model dtype first
         x = x * jnp.asarray(config.hidden_size**0.5, config.dtype)
+    if config.embed_multiplier:
+        x = x * jnp.asarray(config.embed_multiplier, config.dtype)
     x = constrain(x, rules, "batch", "seq", None, mesh=mesh)
     pos = positions if positions is not None else jnp.arange(tokens.shape[1])
     return x, dual_rope_freqs(config, pos), pos

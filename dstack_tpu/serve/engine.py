@@ -171,6 +171,8 @@ def _mlp_out(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
         )
     if c.post_norms:
         mo = model_norm(mo, layer["mlp_post_norm"], c)
+    if c.residual_multiplier:  # Granite scales the sublayer output
+        mo = mo * jnp.asarray(c.residual_multiplier, mo.dtype)
     return mo
 
 
@@ -233,6 +235,8 @@ def _embed_lookup(params: dict, tokens: jax.Array, c: LlamaConfig) -> jax.Array:
     x = params["embed"].at[tokens].get(mode="fill", fill_value=0).astype(c.dtype)
     if c.embed_scale:
         x = x * jnp.asarray(c.hidden_size**0.5, c.dtype)
+    if c.embed_multiplier:
+        x = x * jnp.asarray(c.embed_multiplier, c.dtype)
     return x
 
 
@@ -569,6 +573,8 @@ def prefill_chunk_step(
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
             ao = model_norm(ao, layer["attn_post_norm"], c)
+        if c.residual_multiplier:  # Granite scales the sublayer output
+            ao = ao * jnp.asarray(c.residual_multiplier, ao.dtype)
         if c.parallel_block:  # Cohere: joint residual add
             return x + ao + _mlp_out(x, layer, c), ck, cv
         x = x + ao
@@ -726,6 +732,8 @@ def decode_step(
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
             ao = model_norm(ao, layer["attn_post_norm"], c)
+        if c.residual_multiplier:  # Granite scales the sublayer output
+            ao = ao * jnp.asarray(c.residual_multiplier, ao.dtype)
         if c.parallel_block:  # Cohere: joint residual add
             return x + ao + _mlp_out(x, layer, c), (ck, cv)
         x = x + ao
@@ -919,6 +927,8 @@ def verify_step(
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
             ao = model_norm(ao, layer["attn_post_norm"], c)
+        if c.residual_multiplier:  # Granite scales the sublayer output
+            ao = ao * jnp.asarray(c.residual_multiplier, ao.dtype)
         if c.parallel_block:  # Cohere: joint residual add
             return x + ao + _mlp_out(x, layer, c), (ck, cv)
         x = x + ao

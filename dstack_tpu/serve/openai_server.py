@@ -679,38 +679,24 @@ def build_app(
                     await emit(delta)
                 # generation over: flush held-back text that never
                 # completed into a stop string (minus any true stop cut)
-                if ids and not tools:
+                def final_text() -> str:
                     full = tokenizer.decode(ids)
                     while full.endswith("�"):
                         full = full[:-1]
-                    tail = _truncate_stop(full, req.gen.stop)[len(sent):]
+                    return _truncate_stop(full, req.gen.stop)
+
+                if ids and not tools:
+                    tail = final_text()[len(sent):]
                     if tail:
                         await emit(tail)
                 elif ids and tools:
-                    full = tokenizer.decode(ids)
-                    while full.endswith("�"):
-                        full = full[:-1]
-                    text = _truncate_stop(full, req.gen.stop)
+                    text = final_text()
                     content, tool_calls = _parse_tool_calls(text)
                     if tool_calls:
-                        delta = {"role": "assistant", "content": content}
-                        delta["tool_calls"] = [
+                        await emit(content, tool_calls=[
                             {**c, "index": ci}
                             for ci, c in enumerate(tool_calls)
-                        ]
-                        chunk = {
-                            "id": completion_id,
-                            "object": "chat.completion.chunk",
-                            "created": created,
-                            "model": model_name,
-                            "choices": [{
-                                "index": 0, "delta": delta,
-                                "finish_reason": None,
-                            }],
-                        }
-                        await resp.write(
-                            b"data: " + json.dumps(chunk).encode() + b"\n\n"
-                        )
+                        ])
                         stream_finish = "tool_calls"
                     elif text:
                         await emit(text)

@@ -1157,3 +1157,61 @@ class TestCohere2:
         for f in ("sliding_window", "sliding_pattern", "nope_pattern",
                   "parallel_block", "norm_type", "logit_scale"):
             assert getattr(c2, f) == getattr(c, f), f
+
+
+class TestGranite:
+    def test_granite_multipliers(self, tmp_path):
+        """IBM Granite: llama skeleton + embedding/residual/attention
+        multipliers and logits_scaling (divisor)."""
+        m = _save_tiny(
+            tmp_path, transformers.GraniteConfig,
+            transformers.GraniteForCausalLM,
+            embedding_multiplier=12.0, residual_multiplier=0.22,
+            attention_multiplier=0.015625, logits_scaling=8.0,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.embed_multiplier == 12.0
+        assert cfg.residual_multiplier == 0.22
+        assert cfg.attn_scale == 0.015625
+        assert abs(cfg.logit_scale - 0.125) < 1e-12
+
+    def test_granite_greedy_decode(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.GraniteConfig,
+            transformers.GraniteForCausalLM,
+            embedding_multiplier=12.0, residual_multiplier=0.22,
+            attention_multiplier=0.015625, logits_scaling=8.0,
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(config, remat=False)
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        eng = InferenceEngine(
+            config, params, max_batch=2, max_seq=48,
+            spec_draft=0, turbo_steps=0,
+        )
+        prompt = [5, 9, 21, 7]
+        out = eng.generate(prompt, GenParams(max_new_tokens=6, temperature=0.0))
+        seq = list(prompt)
+        ref = []
+        for _ in range(6):
+            logits = llama.forward(params, jnp.asarray([seq], jnp.int32), config)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            ref.append(nxt)
+            seq.append(nxt)
+        assert out == ref
+
+    def test_granite_config_roundtrip(self):
+        from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
+
+        c = llama.LlamaConfig(
+            vocab_size=256, hidden_size=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, head_dim=16, intermediate_size=96,
+            embed_multiplier=12.0, residual_multiplier=0.22,
+            attn_scale=0.015625, logit_scale=0.125,
+        )
+        c2 = config_from_hf(config_to_hf(c), dtype=c.dtype)
+        for f in ("embed_multiplier", "residual_multiplier", "attn_scale",
+                  "logit_scale"):
+            assert abs(getattr(c2, f) - getattr(c, f)) < 1e-12, f
