@@ -24,6 +24,7 @@ def run_bench(
     repetitive: bool = False,
     quantize=None,
     turbo_steps: int = 8,
+    kv_quant=None,
 ) -> dict:
     """Measure the engine directly → result dict (importable core;
     the root ``bench.py`` embeds this next to the training number)."""
@@ -41,7 +42,7 @@ def run_bench(
         params = quantize_tree(params, config)
     eng = InferenceEngine(
         config, params, max_batch=batch, max_seq=max_seq,
-        spec_draft=spec_draft, turbo_steps=turbo_steps,
+        spec_draft=spec_draft, turbo_steps=turbo_steps, kv_quant=kv_quant,
     )
     rng = np.random.default_rng(0)
     if repetitive:
@@ -171,6 +172,7 @@ def run_bench(
             "spec_draft": spec_draft,
             "turbo_steps": turbo_steps,
             "quantize": quantize,
+            "kv_quant": kv_quant,
             "backend": jax.default_backend(),
         },
     }
@@ -191,6 +193,10 @@ def main(argv=None) -> int:
              "random prompts measure the no-speculation floor",
     )
     p.add_argument("--quantize", default=None, choices=["int8"])
+    p.add_argument(
+        "--kv-quant", default=None, choices=["int8"],
+        help="int8 KV cache (halves decode cache HBM traffic)",
+    )
     p.add_argument(
         "--turbo-steps", type=int, default=8,
         help="device-side decode steps per dispatch (0/1 = per-token)",
@@ -213,6 +219,7 @@ def main(argv=None) -> int:
         repetitive=args.repetitive,
         quantize=args.quantize,
         turbo_steps=args.turbo_steps,
+        kv_quant=args.kv_quant,
     )
     print(json.dumps(result))
     return 0

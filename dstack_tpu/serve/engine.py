@@ -55,14 +55,115 @@ class GenParams:
 # ---------------------------------------------------------------------------
 
 
+def kv_quantize(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[..., D] → (int8 values, per-vector f32 scale [...]).
+
+    Symmetric absmax per (token, head) vector — the granularity that
+    keeps dequantization a cheap broadcast multiply XLA fuses into the
+    attention dot, so the HBM read stays int8."""
+    x32 = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x32 / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def kv_dequant(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    return q.astype(dtype) * s[..., None].astype(dtype)
+
+
+# Quantized caches travel through the compute paths as (int8, scale)
+# TUPLE leaves in place of the plain array — lax.scan carries pytrees,
+# so the prefill/decode/verify plumbing is untouched; only the
+# write/read wrappers below branch. Dequantization sits adjacent to the
+# attention dot so XLA fuses it into the operand read and the HBM
+# traffic stays int8.
+
+
+def _tree_stack(lst):
+    """Stack a list of same-structure pytrees leaf-wise (plain arrays
+    AND (int8, scale) cache tuples)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *lst)
+
+
+def _cache_pack(cache: dict) -> tuple:
+    """dict → (ck, cv) where each is an array or an (int8, scale) pair."""
+    if "k_s" in cache:
+        return (cache["k"], cache["k_s"]), (cache["v"], cache["v_s"])
+    return cache["k"], cache["v"]
+
+
+def _cache_unpack(ck, cv) -> dict:
+    if isinstance(ck, tuple):
+        return {"k": ck[0], "k_s": ck[1], "v": cv[0], "v_s": cv[1]}
+    return {"k": ck, "v": cv}
+
+
+def _cwrite_chunk(ckv, new, slot, start: int):
+    """Write a prefill chunk [B, H, C, D] at (slot, start)."""
+    if isinstance(ckv, tuple):
+        q, s = kv_quantize(new)
+        s = s.astype(ckv[1].dtype)
+        return (
+            jax.lax.dynamic_update_slice(ckv[0], q, (slot, 0, start, 0)),
+            jax.lax.dynamic_update_slice(ckv[1], s, (slot, 0, start)),
+        )
+    return jax.lax.dynamic_update_slice(ckv, new, (slot, 0, start, 0))
+
+
+def _cread_row(ckv, slot, dtype):
+    """One slot's row [1, H, Tmax, D] in compute dtype."""
+    if isinstance(ckv, tuple):
+        rq = jax.lax.dynamic_slice_in_dim(ckv[0], slot, 1, 0)
+        rs = jax.lax.dynamic_slice_in_dim(ckv[1], slot, 1, 0)
+        return kv_dequant(rq, rs, dtype)
+    return jax.lax.dynamic_slice_in_dim(ckv, slot, 1, 0)
+
+
+def _cwrite_at(ckv, batch_ix, write_pos, new):
+    """Scatter per-slot tokens: new [B, H, D] at [B] positions, or
+    [B, S, H, D] at [B, S] positions (speculative verify)."""
+    if isinstance(ckv, tuple):
+        q, s = kv_quantize(new)
+        s = s.astype(ckv[1].dtype)
+        if new.ndim == 3:  # [B, H, D] single token
+            return (
+                ckv[0].at[batch_ix, :, write_pos].set(q, mode="drop"),
+                ckv[1].at[batch_ix, :, write_pos].set(s, mode="drop"),
+            )
+        return (  # [B, S, H, D] at [B, S]
+            ckv[0].at[batch_ix[:, None], :, write_pos].set(q, mode="drop"),
+            ckv[1].at[batch_ix[:, None], :, write_pos].set(s, mode="drop"),
+        )
+    if new.ndim == 3:
+        return ckv.at[batch_ix, :, write_pos].set(new, mode="drop")
+    return ckv.at[batch_ix[:, None], :, write_pos].set(new, mode="drop")
+
+
+def _cfull(ckv, dtype):
+    """The whole cache tensor in compute dtype (decode/verify einsums —
+    the dequant multiply fuses into the dot, the HBM read stays int8)."""
+    if isinstance(ckv, tuple):
+        return kv_dequant(ckv[0], ckv[1], dtype)
+    return ckv
+
+
 def init_cache(
     config: LlamaConfig,
     max_batch: int,
     max_seq: int,
     mesh=None,
+    kv_quant=None,  # None | "int8"
 ) -> dict:
     """Preallocated KV cache: k/v [L, B, Hkv, T_max, D] in model dtype,
     KV heads sharded over ``tp`` when serving on a mesh.
+
+    ``kv_quant="int8"``: k/v store as int8 with per-(token, head) f32
+    scales (``k_s``/``v_s`` [L, B, Hkv, T_max]) — decode is
+    HBM-bandwidth-bound on the cache read, so halving the bytes per
+    cached value is ~2× less decode cache traffic and doubles the
+    context that fits. The cache dict's ``k_s`` key is the signal the
+    compute paths branch on. Not combined with MLA (the latent cache
+    is already the compression).
 
     MLA (DeepSeek): ONE latent tensor ``ckv`` [L, B, T_max,
     kv_lora_rank + qk_rope_head_dim] — the absorbed-attention form
@@ -76,6 +177,11 @@ def init_cache(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     if config.mla:
+        if kv_quant:
+            raise ValueError(
+                "kv_quant does not combine with MLA (the latent cache "
+                "is already the compression)"
+            )
         shape = (
             config.n_layers,
             max_batch,
@@ -87,6 +193,8 @@ def init_cache(
         sh = NamedSharding(mesh, P(None, None, None, None))
         zeros = jax.jit(lambda: jnp.zeros(shape, config.dtype), out_shardings=sh)
         return {"ckv": zeros()}
+    if kv_quant not in (None, "int8"):
+        raise ValueError(f"unknown kv_quant {kv_quant!r}")
     shape = (
         config.n_layers,
         max_batch,
@@ -94,18 +202,28 @@ def init_cache(
         max_seq,
         config.head_dim,
     )
+    dt = jnp.int8 if kv_quant else config.dtype
+    names = {"k": shape, "v": shape}
+    if kv_quant:
+        # per-(token, head) scales in the COMPUTE dtype: dequant casts
+        # there anyway, so f32 storage would buy no accuracy
+        names["k_s"] = shape[:-1]
+        names["v_s"] = shape[:-1]
     if mesh is None:
         return {
-            "k": jnp.zeros(shape, config.dtype),
-            "v": jnp.zeros(shape, config.dtype),
+            n: jnp.zeros(s, config.dtype if n.endswith("_s") else dt)
+            for n, s in names.items()
         }
-    sh = NamedSharding(mesh, P(None, None, "tp", None, None))
     # allocate directly sharded: a host-side zeros + device_put would
     # materialize the full cache on one chip first
-    zeros = jax.jit(
-        lambda: jnp.zeros(shape, config.dtype), out_shardings=sh
-    )
-    return {"k": zeros(), "v": zeros()}
+    out = {}
+    for n, s in names.items():
+        sh = NamedSharding(mesh, P(*([None, None, "tp"] + [None] * (len(s) - 3))))
+        out[n] = jax.jit(
+            partial(jnp.zeros, s, config.dtype if n.endswith("_s") else dt),
+            out_shardings=sh,
+        )()
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -527,8 +645,9 @@ def prefill_chunk_step(
     chunk_pos = start + jnp.arange(cl)
     ropes = dual_rope_freqs(c, chunk_pos)
     scale = c.attention_scale
+    ck_p, cv_p = _cache_pack(cache)
     g, windows, xs_main, xs_tail = grouped_scan_layout(
-        c, {"layer": params["layers"], "ck": cache["k"], "cv": cache["v"]}
+        c, {"layer": params["layers"], "ck": ck_p, "cv": cv_p}
     )
     nopes = layer_nope(c)
 
@@ -556,14 +675,10 @@ def prefill_chunk_step(
         # write the chunk's K/V into the slot's row, then attend over
         # the whole row: positions beyond start+i are causally masked,
         # so stale data past the prompt is never read
-        ck = jax.lax.dynamic_update_slice(
-            ck, k, (slot.astype(jnp.int32), 0, start, 0)
-        )
-        cv = jax.lax.dynamic_update_slice(
-            cv, v, (slot.astype(jnp.int32), 0, start, 0)
-        )
-        row_k = jax.lax.dynamic_slice_in_dim(ck, slot.astype(jnp.int32), 1, 0)
-        row_v = jax.lax.dynamic_slice_in_dim(cv, slot.astype(jnp.int32), 1, 0)
+        ck = _cwrite_chunk(ck, k, slot.astype(jnp.int32), start)
+        cv = _cwrite_chunk(cv, v, slot.astype(jnp.int32), start)
+        row_k = _cread_row(ck, slot.astype(jnp.int32), k.dtype)
+        row_v = _cread_row(cv, slot.astype(jnp.int32), v.dtype)
         o = attention(
             q, row_k, row_v, causal=True, scale=scale, q_offset=start,
             window=window, softcap=c.attn_softcap,
@@ -591,13 +706,15 @@ def prefill_chunk_step(
             cvs.append(cv)
         if g == 1:
             return x, (cks[0], cvs[0])
-        return x, (jnp.stack(cks), jnp.stack(cvs))
+        return x, (_tree_stack(cks), _tree_stack(cvs))
 
     x, (ks, vs) = jax.lax.scan(group_fn, x, xs_main)
     r = c.n_layers % g if g > 1 else 0
+    unflat = lambda t: jax.tree.map(
+        lambda a: a.reshape((c.n_layers - r,) + a.shape[2:]), t
+    )
     if g > 1:  # [L'/g, g, ...] → [L', ...]
-        ks = ks.reshape((c.n_layers - r,) + ks.shape[2:])
-        vs = vs.reshape((c.n_layers - r,) + vs.shape[2:])
+        ks, vs = unflat(ks), unflat(vs)
     if xs_tail is not None:
         # pattern doesn't divide the layer count (Gemma3): unroll the
         # last r layers after the scan and append their cache rows
@@ -610,9 +727,12 @@ def prefill_chunk_step(
             )
             tks.append(ck)
             tvs.append(cv)
-        ks = jnp.concatenate([ks, jnp.stack(tks)], axis=0)
-        vs = jnp.concatenate([vs, jnp.stack(tvs)], axis=0)
-    cache = {"k": ks, "v": vs}
+        cat = lambda a, t: jax.tree.map(
+            lambda x1, x2: jnp.concatenate([x1, x2], axis=0), a, t
+        )
+        ks = cat(ks, _tree_stack(tks))
+        vs = cat(vs, _tree_stack(tvs))
+    cache = _cache_unpack(ks, vs)
     x = model_norm(x, params["final_norm"], c)
     last = jnp.take_along_axis(
         x, last_ix[None, None, None].astype(jnp.int32), axis=1
@@ -699,8 +819,10 @@ def decode_step(
             q, k = q_ro, k_ro
         # write this token's K/V at each slot's position (masked rows
         # get an out-of-range index → dropped)
-        ck = ck.at[batch_ix, :, write_pos].set(k[:, :, 0, :], mode="drop")
-        cv = cv.at[batch_ix, :, write_pos].set(v[:, :, 0, :], mode="drop")
+        ck = _cwrite_at(ck, batch_ix, write_pos, k[:, :, 0, :])
+        cv = _cwrite_at(cv, batch_ix, write_pos, v[:, :, 0, :])
+        ckf = _cfull(ck, k.dtype)  # int8 caches dequant INSIDE the dot
+        cvf = _cfull(cv, v.dtype)
         # attend over the cache prefix (mask: j <= position, and within
         # the layer's sliding window when set). Grouped-query einsum:
         # q regrouped [B, Hkv, G, D] against the [B, Hkv, T, D] cache —
@@ -710,11 +832,11 @@ def decode_step(
         grp = c.n_heads // c.n_kv_heads
         qg = q[:, :, 0, :].reshape(b, c.n_kv_heads, grp, c.head_dim)
         s = jnp.einsum(
-            "bhgd,bhkd->bhgk", qg, ck, preferred_element_type=jnp.float32
+            "bhgd,bhkd->bhgk", qg, ckf, preferred_element_type=jnp.float32
         ) * scale
         if c.attn_softcap:
             s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
-        kj = jnp.arange(ck.shape[2])[None, None, None, :]
+        kj = jnp.arange(ckf.shape[2])[None, None, None, :]
         pos = positions[:, None, None, None]
         mask = kj <= pos
         mask = jnp.logical_and(
@@ -726,7 +848,7 @@ def decode_step(
             mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= start))
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhgk,bhkd->bhgd", p.astype(cv.dtype), cv)
+        o = jnp.einsum("bhgk,bhkd->bhgd", p.astype(cvf.dtype), cvf)
         # [B, Hkv, G, D] row-major flatten == query-head order
         o = o.reshape(b, 1, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
@@ -739,10 +861,11 @@ def decode_step(
         x = x + ao
         return _mlp(x, layer, c), (ck, cv)
 
+    ck_p, cv_p = _cache_pack(cache)
     x, (ks, vs) = jax.lax.scan(
-        layer_fn, x, (params["layers"], cache["k"], cache["v"], windows, nopes)
+        layer_fn, x, (params["layers"], ck_p, cv_p, windows, nopes)
     )
-    cache = {"k": ks, "v": vs}
+    cache = _cache_unpack(ks, vs)
     x = model_norm(x, params["final_norm"], c)
     return _head_logits(params, x[:, 0], c), cache
 
@@ -896,18 +1019,16 @@ def verify_step(
         else:
             q, k = q_ro, k_ro
         # scatter the S tokens' K/V at their per-row positions
-        ck = ck.at[batch_ix[:, None], :, write_pos].set(
-            k.transpose(0, 2, 1, 3), mode="drop"
-        )
-        cv = cv.at[batch_ix[:, None], :, write_pos].set(
-            v.transpose(0, 2, 1, 3), mode="drop"
-        )
+        ck = _cwrite_at(ck, batch_ix, write_pos, k.transpose(0, 2, 1, 3))
+        cv = _cwrite_at(cv, batch_ix, write_pos, v.transpose(0, 2, 1, 3))
+        ckf = _cfull(ck, k.dtype)  # int8 caches dequant INSIDE the dot
+        cvf = _cfull(cv, v.dtype)
         # grouped-query attention against the KV-width cache (see
         # decode_step): q [B, Hkv, G, S, D] · cache [B, Hkv, T, D]
         grp = c.n_heads // c.n_kv_heads
         qg = q.reshape(b, c.n_kv_heads, grp, sdraft, c.head_dim)
         s = jnp.einsum(
-            "bhgsd,bhkd->bhgsk", qg, ck, preferred_element_type=jnp.float32
+            "bhgsd,bhkd->bhgsk", qg, ckf, preferred_element_type=jnp.float32
         ) * scale
         if c.attn_softcap:
             s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
@@ -922,7 +1043,7 @@ def verify_step(
             mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= cstart))
         s = jnp.where(mask, s, NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhgsk,bhkd->bhgsd", p.astype(cv.dtype), cv)
+        o = jnp.einsum("bhgsk,bhkd->bhgsd", p.astype(cvf.dtype), cvf)
         o = o.transpose(0, 3, 1, 2, 4).reshape(b, sdraft, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.post_norms:
@@ -934,10 +1055,11 @@ def verify_step(
         x = x + ao
         return _mlp(x, layer, c), (ck, cv)
 
+    ck_p, cv_p = _cache_pack(cache)
     x, (ks, vs) = jax.lax.scan(
-        layer_fn, x, (params["layers"], cache["k"], cache["v"], windows, nopes)
+        layer_fn, x, (params["layers"], ck_p, cv_p, windows, nopes)
     )
-    cache = {"k": ks, "v": vs}
+    cache = _cache_unpack(ks, vs)
     x = model_norm(x, params["final_norm"], c)
     return _head_logits(params, x, c, eq="bse,ev->bsv"), cache
 
@@ -1067,22 +1189,16 @@ def copy_cache_prefix(cache: dict, src, dst, *, p: int) -> dict:
     a prefix with an already-cached sequence skips prefilling it).
     ``p`` is static (jitted per chunk-aligned length); src/dst are
     traced scalars so one compile serves every slot pair."""
+    # token axis per cache tensor: MLA latent [L,B,T,R] → 2; k/v
+    # [L,B,H,T,D] → 3; int8 scales k_s/v_s [L,B,H,T] → 3 (last)
+    t_axis = {"ckv": 2, "k": 3, "v": 3, "k_s": 3, "v_s": 3}
     out = {}
     for name, a in cache.items():
-        if name == "ckv":  # MLA latent [L, B, T, R]
-            rows = jax.lax.dynamic_index_in_dim(
-                a, src, axis=1, keepdims=False
-            )  # [L, T, R]
-            rows = rows[:, None, :p]
-            out[name] = jax.lax.dynamic_update_slice(a, rows, (0, dst, 0, 0))
-        else:  # k/v [L, B, H, T, D]
-            rows = jax.lax.dynamic_index_in_dim(
-                a, src, axis=1, keepdims=False
-            )  # [L, H, T, D]
-            rows = rows[:, None, :, :p]
-            out[name] = jax.lax.dynamic_update_slice(
-                a, rows, (0, dst, 0, 0, 0)
-            )
+        rows = jax.lax.dynamic_index_in_dim(a, src, axis=1, keepdims=True)
+        rows = jax.lax.slice_in_dim(rows, 0, p, axis=t_axis[name])
+        idx = [jnp.asarray(0, jnp.int32)] * a.ndim
+        idx[1] = dst
+        out[name] = jax.lax.dynamic_update_slice(a, rows, tuple(idx))
     return out
 
 
@@ -1127,6 +1243,7 @@ class InferenceEngine:
         spec_draft: int = 4,
         turbo_steps: int = 8,
         prefix_cache: bool = True,
+        kv_quant=None,  # None | "int8": quantized KV cache
     ):
         """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
         params shard per the model's logical rules (heads/mlp/vocab over
@@ -1161,7 +1278,10 @@ class InferenceEngine:
         self.params = params
         self.max_batch = max_batch
         self.max_seq = max_seq
-        self.cache = init_cache(config, max_batch, max_seq, mesh=mesh)
+        self.kv_quant = kv_quant
+        self.cache = init_cache(
+            config, max_batch, max_seq, mesh=mesh, kv_quant=kv_quant
+        )
         self._auto_seed = seed
         # per-slot host state
         self.lengths = [0] * max_batch  # tokens currently in cache

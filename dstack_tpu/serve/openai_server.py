@@ -969,6 +969,12 @@ def main(argv=None) -> int:
              "the prefill/decode XLA compiles in its TTFT)",
     )
     p.add_argument(
+        "--kv-quant", default=None, choices=["int8"],
+        help="int8 KV cache with per-(token, head) scales: ~2x less "
+             "decode HBM traffic and 2x the context per slot, at a "
+             "small quantization accuracy cost (not for MLA models)",
+    )
+    p.add_argument(
         "--no-prefix-cache", action="store_true",
         help="disable automatic prefix caching (KV-row reuse across "
              "requests sharing a chunk-aligned prompt prefix)",
@@ -1074,6 +1080,7 @@ def main(argv=None) -> int:
         mesh=mesh, spec_draft=args.spec_draft,
         turbo_steps=args.turbo_steps,
         prefix_cache=not args.no_prefix_cache,
+        kv_quant=args.kv_quant,
     )
     # tokenizer first: it's cheap and fail-fast — a typo'd path must
     # not cost a full compile warmup before erroring

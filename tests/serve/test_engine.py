@@ -695,3 +695,87 @@ class TestPrefixCache:
         out = eng.generate(p2, GenParams(max_new_tokens=5))
         assert eng.prefix_hits == 1
         assert out == ref
+
+
+class TestKVQuant:
+    """int8 KV cache: per-(token, head) scales, dequant fused into the
+    attention dots. Quantization perturbs logits slightly, so tests
+    assert bounded drift and structural correctness, not token
+    equality."""
+
+    config = llama.LLAMA_TINY
+
+    def setup_method(self):
+        self.params = llama.init_params(self.config, jax.random.key(0))
+
+    def _engine(self, kv_quant, **kw):
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("max_seq", 64)
+        kw.setdefault("spec_draft", 0)
+        kw.setdefault("turbo_steps", 0)
+        return InferenceEngine(self.config, self.params, kv_quant=kv_quant, **kw)
+
+    def test_cache_layout(self):
+        eng = self._engine("int8")
+        import jax.numpy as jnp
+
+        assert eng.cache["k"].dtype == jnp.int8
+        assert eng.cache["k_s"].shape == eng.cache["k"].shape[:-1]
+
+    def test_roundtrip_error_bounded(self):
+        from dstack_tpu.serve.engine import kv_dequant, kv_quantize
+        import jax.numpy as jnp
+        import numpy as np
+
+        x = jax.random.normal(jax.random.key(1), (2, 4, 8, 32), jnp.float32)
+        q, s = kv_quantize(x)
+        back = kv_dequant(q, s, jnp.float32)
+        rel = np.abs(np.asarray(back - x)).max() / np.abs(np.asarray(x)).max()
+        assert rel < 1.5 / 127  # half-step absmax error
+
+    def test_decode_logits_close_to_exact(self):
+        from dstack_tpu.serve.engine import GenParams as GP
+
+        prompt = [5, 99, 321, 7, 250, 41, 18]
+        exact = self._engine(None)
+        quant = self._engine("int8")
+        se, _ = exact.add_request(list(prompt), GP(max_new_tokens=2))
+        sq, _ = quant.add_request(list(prompt), GP(max_new_tokens=2))
+        import numpy as np
+        from dstack_tpu.serve.engine import decode_step
+        import jax.numpy as jnp
+
+        toks = jnp.asarray([prompt[-1], 0], jnp.int32)
+        pos = jnp.asarray([len(prompt), 0], jnp.int32)
+        mask = jnp.asarray([True, False])
+        le, _ = decode_step(exact.params, exact.cache, toks, pos,
+                            exact.config, write_mask=mask)
+        lq, _ = decode_step(quant.params, quant.cache, toks, pos,
+                            quant.config, write_mask=mask)
+        diff = np.abs(np.asarray(le[0]) - np.asarray(lq[0])).max()
+        spread = np.abs(np.asarray(le[0])).max()
+        assert diff < 0.05 * max(spread, 1.0), (diff, spread)
+
+    def test_generation_and_prefix_cache(self):
+        eng = self._engine("int8", max_seq=96, prefill_chunk=16, max_batch=3)
+        shared = list(range(40, 80))
+        out1 = eng.generate(shared + [3], GenParams(max_new_tokens=5))
+        assert len(out1) == 5
+        out2 = eng.generate(shared + [9, 2], GenParams(max_new_tokens=5))
+        assert len(out2) == 5
+        assert eng.prefix_hits == 1  # the copy fn handles the scales too
+
+    def test_speculative_runs(self):
+        eng = self._engine("int8", max_seq=96, spec_draft=4)
+        prompt = [7, 8, 9, 7, 8, 9, 7, 8]
+        out = eng.generate(prompt, GenParams(max_new_tokens=12))
+        assert len(out) <= 12 and len(out) > 0
+
+    def test_mla_refuses(self):
+        import pytest
+
+        config = llama.MLA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        with pytest.raises(ValueError, match="MLA"):
+            InferenceEngine(config, params, max_batch=2, max_seq=32,
+                            kv_quant="int8")
