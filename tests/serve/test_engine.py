@@ -779,3 +779,25 @@ class TestKVQuant:
         with pytest.raises(ValueError, match="MLA"):
             InferenceEngine(config, params, max_batch=2, max_seq=32,
                             kv_quant="int8")
+
+
+class TestExpertParallelServing:
+    def test_ep_mesh_matches_single_device(self):
+        """MoE serving over an ep mesh: experts shard over the expert
+        axis (GSPMD turns the dispatch einsums into all_to_all) and the
+        greedy stream must match unsharded serving exactly."""
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+
+        config = llama.MOE_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        prompt = [11, 22, 33, 44]
+        ref = InferenceEngine(
+            config, params, max_batch=2, max_seq=64,
+            spec_draft=0, turbo_steps=0,
+        ).generate(prompt, GenParams(max_new_tokens=5))
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, ep=2, tp=2))
+        eng = InferenceEngine(
+            config, params, max_batch=2, max_seq=64, mesh=mesh,
+            spec_draft=0, turbo_steps=0,
+        )
+        assert eng.generate(prompt, GenParams(max_new_tokens=5)) == ref
