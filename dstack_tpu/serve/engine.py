@@ -43,6 +43,10 @@ class GenParams:
     repetition_penalty: float = 1.0  # HF-style multiplicative; 1 = off
     presence_penalty: float = 0.0  # OpenAI additive: once-seen tokens
     frequency_penalty: float = 0.0  # OpenAI additive: per occurrence
+    min_p: float = 0.0  # mask tokens with p < min_p * p_max (0 = off)
+    # OpenAI logit_bias: {token_id: bias in [-100, 100]} added to the
+    # raw logits before sampling (±100 effectively bans/forces)
+    logit_bias: Optional[dict] = None
     seed: Optional[int] = None  # per-request sampling seed
     eos_id: Optional[int] = None
     stop: Optional[list] = None  # stop strings (matched by the server)
@@ -1075,6 +1079,8 @@ def sample(
     pres_pen: jax.Array,  # [B] f32 additive presence penalty
     freq_pen: jax.Array,  # [B] f32 additive frequency penalty
     gen_counts: jax.Array,  # [B, V] int32: occurrences in GENERATED text
+    logit_bias=None,  # [B, V] f32 additive bias (None = off)
+    min_p=None,  # [B] f32: drop tokens with p < min_p·p_max (None = off)
 ) -> tuple[jax.Array, jax.Array]:
     """→ (tokens [B], advanced key_data). Greedy when temperature == 0,
     else penalized temperature/top-k/top-p sampling — all branches
@@ -1087,6 +1093,8 @@ def sample(
     additive presence/frequency penalties count only SAMPLED tokens
     (a long prompt must not pre-ban its own vocabulary)."""
     v = logits.shape[-1]
+    if logit_bias is not None:
+        logits = logits + logit_bias  # OpenAI bias: pre-everything
     seen = counts > 0
     # HF repetition penalty: previously-seen tokens get logit/p when
     # positive, logit*p when negative (p > 1 discourages repeats)
@@ -1098,6 +1106,13 @@ def sample(
     logits = logits - freq_pen[:, None] * gen_counts.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1)
     scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    if min_p is not None:
+        # min-p (applied before top-k/top-p): relative-probability floor
+        probs_mp = jax.nn.softmax(scaled, axis=-1)
+        floor = min_p[:, None] * jnp.max(probs_mp, axis=-1, keepdims=True)
+        scaled = jnp.where(
+            (min_p[:, None] <= 0.0) | (probs_mp >= floor), scaled, NEG_INF
+        )
     # ONE [B, V] descending sort serves both filters — at a 128k vocab
     # the sort dominates per-token sampling cost
     sorted_full = jnp.sort(scaled, axis=-1)[:, ::-1]
@@ -1295,6 +1310,8 @@ class InferenceEngine:
         self.rep_pens = [1.0] * max_batch
         self.pres_pens = [0.0] * max_batch
         self.freq_pens = [0.0] * max_batch
+        self.min_ps = [0.0] * max_batch
+        self.has_bias = [False] * max_batch
         self.finish_reason = [None] * max_batch  # "stop" | "length" once done
         self.want_logprobs = [False] * max_batch
         # most recent token's (logprob, [(alt_id, alt_lp), ...]) per slot
@@ -1305,6 +1322,7 @@ class InferenceEngine:
         self._key_data = jnp.zeros((max_batch, 2), jnp.uint32)
         self._seen = jnp.zeros((max_batch, config.vocab_size), jnp.int32)
         self._gen_counts = jnp.zeros((max_batch, config.vocab_size), jnp.int32)
+        self._logit_bias = jnp.zeros((max_batch, config.vocab_size), jnp.float32)
 
         # pending chunked prefills: slot → {tokens, tp, next (chunk
         # cursor), gen}
@@ -1521,6 +1539,19 @@ class InferenceEngine:
             self._seen, self._gen_counts, jnp.asarray(slot),
             jnp.asarray(marked, jnp.int32), jnp.asarray(tp, jnp.int32),
         )
+        if gen.logit_bias or self.has_bias[slot]:
+            # skip the vocab-size upload when the row is known zero
+            # (buffer starts zeroed; has_bias tracks any write)
+            import numpy as np
+
+            bias_row = np.zeros((self.config.vocab_size,), np.float32)
+            for tid, bv in (gen.logit_bias or {}).items():
+                t = int(tid)
+                if 0 <= t < self.config.vocab_size:
+                    bias_row[t] = float(bv)
+            self._logit_bias = self._logit_bias.at[slot].set(bias_row)
+        self.min_ps[slot] = gen.min_p
+        self.has_bias[slot] = bool(gen.logit_bias)
         toks, kd = self._sample(
             logits,
             self._key_data[slot:slot + 1],
@@ -1532,6 +1563,8 @@ class InferenceEngine:
             jnp.asarray([gen.presence_penalty], jnp.float32),
             jnp.asarray([gen.frequency_penalty], jnp.float32),
             self._gen_counts[slot:slot + 1],
+            self._logit_bias[slot:slot + 1],
+            jnp.asarray([gen.min_p], jnp.float32),
         )
         tok = int(toks[0])
         self._key_data = self._key_data.at[slot].set(kd[0])
@@ -1740,6 +1773,8 @@ class InferenceEngine:
             and self.rep_pens[i] == 1.0
             and self.pres_pens[i] == 0.0
             and self.freq_pens[i] == 0.0
+            and self.min_ps[i] == 0.0
+            and not self.has_bias[i]
             and not self.want_logprobs[i]
             for i in live
         )
@@ -1767,6 +1802,8 @@ class InferenceEngine:
             jnp.asarray(self.pres_pens, jnp.float32),
             jnp.asarray(self.freq_pens, jnp.float32),
             self._gen_counts,
+            self._logit_bias,
+            jnp.asarray(self.min_ps, jnp.float32),
         )
         self._seen, self._gen_counts = self._mark_seen(
             self._seen, self._gen_counts, jnp.arange(self.max_batch), sampled_dev

@@ -366,11 +366,43 @@ def _gen_params(payload: dict, tokenizer: Tokenizer) -> GenParams:
         repetition_penalty=float(payload.get("repetition_penalty") or 1.0),
         presence_penalty=float(payload.get("presence_penalty") or 0.0),
         frequency_penalty=float(payload.get("frequency_penalty") or 0.0),
+        min_p=float(payload.get("min_p") or 0.0),
+        logit_bias=(
+            {int(k): max(-100.0, min(100.0, float(v)))
+             for k, v in payload["logit_bias"].items()}
+            if isinstance(payload.get("logit_bias"), dict)
+            and payload["logit_bias"] else None
+        ),
         seed=int(seed) if seed is not None else None,
         eos_id=tokenizer.eos_id,
         stop=stop or None,
         logprobs=_logprobs_requested(payload),
     )
+
+
+def _bad_sampling_params(payload: dict) -> Optional[str]:
+    """Validate the sampling knobs that can't be silently coerced →
+    error string for a 400, or None. Runs BEFORE prefill so a malformed
+    request can't waste a full prompt's compute."""
+    mp = payload.get("min_p")
+    if mp is not None:
+        try:
+            mp = float(mp)
+        except (TypeError, ValueError):
+            return "'min_p' must be a number"
+        if not 0.0 <= mp <= 1.0:
+            return "'min_p' must be in [0, 1]"
+    lb = payload.get("logit_bias")
+    if lb is not None:
+        if not isinstance(lb, dict):
+            return "'logit_bias' must be an object of {token_id: bias}"
+        for k, v in lb.items():
+            try:
+                int(k)
+                float(v)
+            except (TypeError, ValueError):
+                return f"'logit_bias' entry {k!r} is not numeric"
+    return None
 
 
 def _valid_chat_message(m) -> bool:
@@ -567,6 +599,9 @@ def build_app(
             payload = await request.json()
         except Exception:
             return web.json_response({"detail": "invalid JSON body"}, status=400)
+        bad = _bad_sampling_params(payload)
+        if bad:
+            return web.json_response({"detail": bad}, status=400)
         messages = payload.get("messages")
         if not isinstance(messages, list) or not messages or not all(
             _valid_chat_message(m) for m in messages
@@ -778,6 +813,9 @@ def build_app(
         prompt = payload.get("prompt")
         if not isinstance(prompt, str):
             return web.json_response({"detail": "'prompt' required"}, status=400)
+        bad = _bad_sampling_params(payload)
+        if bad:
+            return web.json_response({"detail": bad}, status=400)
         n = _n_choices(payload)
         if not isinstance(n, int):
             return n

@@ -801,3 +801,46 @@ class TestExpertParallelServing:
             spec_draft=0, turbo_steps=0,
         )
         assert eng.generate(prompt, GenParams(max_new_tokens=5)) == ref
+
+
+class TestLogitBiasMinP:
+    config = llama.LLAMA_TINY
+
+    def setup_method(self):
+        self.params = llama.init_params(self.config, jax.random.key(0))
+        self.eng = InferenceEngine(
+            self.config, self.params, max_batch=2, max_seq=64,
+            spec_draft=0, turbo_steps=0,
+        )
+
+    def test_positive_bias_forces_token(self):
+        prompt = [5, 9, 21, 7]
+        out = self.eng.generate(
+            prompt, GenParams(max_new_tokens=3, logit_bias={"77": 100.0}))
+        assert out == [77, 77, 77]
+
+    def test_negative_bias_bans_argmax(self):
+        prompt = [5, 9, 21, 7]
+        base = self.eng.generate(prompt, GenParams(max_new_tokens=1))
+        banned = self.eng.generate(
+            prompt,
+            GenParams(max_new_tokens=1, logit_bias={str(base[0]): -100.0}))
+        assert banned[0] != base[0]
+
+    def test_min_p_one_is_greedy(self):
+        """min_p=1.0 keeps only the argmax token — a seeded sampled
+        stream collapses to the greedy stream."""
+        prompt = [5, 9, 21, 7, 3]
+        greedy = self.eng.generate(prompt, GenParams(max_new_tokens=6))
+        sampled = self.eng.generate(
+            prompt,
+            GenParams(max_new_tokens=6, temperature=1.5, min_p=1.0, seed=7))
+        assert sampled == greedy
+
+    def test_min_p_zero_still_varies(self):
+        prompt = [5, 9, 21, 7, 3]
+        greedy = self.eng.generate(prompt, GenParams(max_new_tokens=8))
+        sampled = self.eng.generate(
+            prompt,
+            GenParams(max_new_tokens=8, temperature=3.0, min_p=0.0, seed=7))
+        assert sampled != greedy  # hot sampling without the floor differs

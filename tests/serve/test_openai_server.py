@@ -794,3 +794,37 @@ class TestToolCalls:
             assert r2.status == 400
         finally:
             await client.close()
+
+
+class TestSamplingValidation:
+    async def test_bad_min_p_and_logit_bias_400(self):
+        config = llama.LLAMA_TINY
+        params = jax.device_put(llama.init_params(config, jax.random.key(0)))
+        engine = InferenceEngine(config, params, max_batch=2, max_seq=64)
+        app = build_app(engine, ByteTokenizer(), "tiny")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for bad in (
+                {"min_p": 1.5},
+                {"min_p": "hot"},
+                {"logit_bias": {"abc": -100}},
+                {"logit_bias": {"7": "ban"}},
+            ):
+                r = await client.post("/v1/completions", json={
+                    "prompt": "ab", "max_tokens": 2, **bad,
+                })
+                assert r.status == 400, bad
+            # valid forms pass on both endpoints
+            r = await client.post("/v1/completions", json={
+                "prompt": "ab", "max_tokens": 2,
+                "min_p": 0.3, "logit_bias": {"65": 5},
+            })
+            assert r.status == 200
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2, "min_p": 1.5,
+            })
+            assert r.status == 400
+        finally:
+            await client.close()
