@@ -211,7 +211,10 @@ def main() -> None:
         tpu_down = True
         note = (
             "TPU backend unreachable (tunnel down); CPU fallback "
-            "measurement — not a TPU number"
+            "measurement — not a TPU number. Driver-grade TPU runs "
+            "captured while the tunnel was up are in "
+            "BENCH_TPU_r03_evidence.json (0.525-0.530 MFU, 13.2-13.4k "
+            "tok/s/chip train; 1348-1408 tok/s serving decode)"
         )
     if result is None:
         try:
