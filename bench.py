@@ -142,6 +142,9 @@ def _bench(quick: bool = False) -> dict:
         serve_extra = {
             "decode_tokens_per_sec": serve["value"],
             "ttft_ms_p50": serve["extra"]["ttft_ms_p50"],
+            # prefix caching: 2×-length prompt pair, cold vs hit
+            "ttft_long_cold_ms": serve["extra"].get("ttft_long_cold_ms"),
+            "ttft_prefix_hit_ms": serve["extra"].get("ttft_prefix_hit_ms"),
             "model": serve_model,
         }
     except Exception as e:  # serving must not sink the training number
