@@ -72,7 +72,9 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             "converter only supports for qwen2/qwen3/glm"
         )
     act = hf.get("hidden_act") or "silu"
-    act_map = {"silu": "silu", "gelu_pytorch_tanh": "gelu_tanh"}
+    act_map = {
+        "silu": "silu", "gelu_pytorch_tanh": "gelu_tanh", "relu2": "relu2"
+    }
     if mt in ("gemma", "gemma2", "gemma3", "gemma3_text"):
         # Gemma configs historically say "gelu"/hidden_activation but
         # the models always use the tanh approximation
@@ -212,6 +214,15 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             embed_multiplier=float(hf.get("embedding_multiplier") or 1.0),
             residual_multiplier=float(hf.get("residual_multiplier") or 1.0),
             logit_scale=(1.0 / ls) if ls != 1.0 else 0.0,
+        )
+    if mt == "nemotron":
+        # Nemotron/Minitron: LayerNorm1P ((1+w)·norm + b, stored stacked
+        # [2, H]), gateless relu² MLP, rotate-half partial rotary
+        return LlamaConfig(
+            **{**common, "norm_eps": float(hf.get("norm_eps", 1e-5))},
+            norm_type="layernorm1p",
+            mlp_gateless=True,
+            partial_rotary=float(hf.get("partial_rotary_factor") or 0.5),
         )
     if mt == "cohere":
         # Command-R: mean-centered LayerNorm, parallel attn+MLP block
@@ -516,6 +527,8 @@ def convert_state_dict(
         sd = _split_phi3(dict(sd), c)
     if model_type in ("glm", "glm4"):
         sd = _split_glm(dict(sd), c, model_type)
+    if model_type == "nemotron":
+        sd = _stack_nemotron_norms(dict(sd), c)
 
     def get(name):
         if name not in sd:
@@ -615,7 +628,8 @@ def convert_state_dict(
                 )
             layers[ours] = np.asarray(np.stack(per_layer), dt)
     else:
-        layers["w_gate"] = stack(P + "mlp.gate_proj.weight", transpose=True)
+        if not c.mlp_gateless:
+            layers["w_gate"] = stack(P + "mlp.gate_proj.weight", transpose=True)
         layers["w_up"] = stack(P + "mlp.up_proj.weight", transpose=True)
         layers["w_down"] = stack(P + "mlp.down_proj.weight", transpose=True)
 
@@ -726,6 +740,23 @@ def _convert_deepseek(sd: dict, c: LlamaConfig) -> dict:
     if not c.tie_embeddings:
         params["lm_head"] = np.asarray(get("lm_head.weight").T, dt)
     return params
+
+
+def _stack_nemotron_norms(sd: dict, c: LlamaConfig) -> dict:
+    """Nemotron LayerNorm1P carries weight AND bias; our tree stores
+    them stacked [2, H] (scale-1 row then bias row — the checkpoint's
+    weight already IS scale-1 since forward uses weight + 1)."""
+    names = ["model.norm"]
+    for i in range(c.n_layers):
+        names += [
+            f"model.layers.{i}.input_layernorm",
+            f"model.layers.{i}.post_attention_layernorm",
+        ]
+    for n in names:
+        w = _to_np(sd.pop(n + ".weight"))
+        b = _to_np(sd.pop(n + ".bias"))
+        sd[n + ".weight"] = np.stack([w, b])
+    return sd
 
 
 def _split_glm(sd: dict, c: LlamaConfig, model_type: str) -> dict:
@@ -947,6 +978,14 @@ def config_to_hf(config: LlamaConfig) -> dict:
                 use_qk_norm=c.qk_norm,
             )
         return hf
+    if c.norm_type == "layernorm1p":
+        hf.update(
+            model_type="nemotron",
+            norm_eps=c.norm_eps,
+            partial_rotary_factor=c.partial_rotary,
+        )
+        hf["hidden_act"] = "relu2"
+        return hf
     if c.partial_rotary != 1.0:
         hf.update(
             model_type="glm4" if c.post_norms else "glm",
@@ -1096,10 +1135,18 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
                 sd[E + f"{u}.weight"] = np32(L["w_up"][i][e]).T
                 sd[E + f"{d}.weight"] = np32(L["w_down"][i][e]).T
         else:
-            sd[P + "mlp.gate_proj.weight"] = np32(L["w_gate"][i]).T
+            if not c.mlp_gateless:
+                sd[P + "mlp.gate_proj.weight"] = np32(L["w_gate"][i]).T
             sd[P + "mlp.up_proj.weight"] = np32(L["w_up"][i]).T
             sd[P + "mlp.down_proj.weight"] = np32(L["w_down"][i]).T
     sd["model.norm.weight"] = np32(params["final_norm"])
+    if c.norm_type == "layernorm1p":
+        # split the stacked (scale-1, bias) rows back into HF names
+        stacked = [n for n in sd if n.endswith("layernorm.weight")]
+        for n in stacked + ["model.norm.weight"]:
+            wb = sd.pop(n)
+            sd[n] = wb[0]
+            sd[n[: -len(".weight")] + ".bias"] = wb[1]
     if not c.tie_embeddings:
         sd["lm_head.weight"] = np32(params["lm_head"]).T
     if mt in ("glm", "glm4"):

@@ -115,8 +115,10 @@ class LlamaConfig:
     # per-head-dim norm (Qwen3/Gemma3)
     qk_norm_flat: bool = False
     # --- Cohere (Command-R) deltas ---
-    # "layernorm": mean-centered, weight-only LayerNorm everywhere a
-    # model norm applies (Cohere); "rms" is everyone else
+    # "layernorm": mean-centered, weight-only LayerNorm (Cohere);
+    # "layernorm1p": mean-centered with (1 + w) scale AND bias, stored
+    # STACKED as [..., 2, H] = (scale-1, bias) rows (Nemotron); "rms"
+    # is everyone else
     norm_type: str = "rms"
     # parallel residual: attention and MLP both read the SAME layer
     # input and their outputs add jointly (x + attn(n(x)) + mlp(n(x)));
@@ -126,6 +128,8 @@ class LlamaConfig:
     # multiplier on the final logits (Cohere logit_scale; Granite uses
     # 1/logits_scaling); 0 = off
     logit_scale: float = 0.0
+    # Nemotron: gateless MLP — down(act(up(x))), no gate matrix
+    mlp_gateless: bool = False
     # --- IBM Granite deltas (scalar multipliers on the llama skeleton;
     # attention_multiplier maps onto attn_scale) ---
     embed_multiplier: float = 0.0  # scales embeddings (0 = off)
@@ -380,6 +384,13 @@ GEMMA3_4B = LlamaConfig(  # text tower of google/gemma-3-4b
     attn_scale=256.0**-0.5,
 )
 
+MINITRON_4B = LlamaConfig(  # nvidia/Minitron-4B-Base (nemotron)
+    vocab_size=256000, hidden_size=3072, n_layers=32, n_heads=24,
+    n_kv_heads=8, head_dim=128, intermediate_size=9216,
+    rope_theta=10000.0, norm_eps=1e-5, max_seq_len=4096,
+    norm_type="layernorm1p", mlp_gateless=True, partial_rotary=0.5,
+    hidden_act="relu2",
+)
 COMMAND_R_35B = LlamaConfig(  # CohereForAI/c4ai-command-r-v01
     vocab_size=256000, hidden_size=8192, n_layers=40, n_heads=64,
     n_kv_heads=64, head_dim=128, intermediate_size=22528,
@@ -462,6 +473,7 @@ CONFIGS = {
     "glm-4-9b": GLM_4_9B,
     "olmo-2-7b": OLMO2_7B,
     "command-r-35b": COMMAND_R_35B,
+    "minitron-4b": MINITRON_4B,
 }
 
 
@@ -490,14 +502,16 @@ def param_specs(config: LlamaConfig) -> dict:
             "wv": L + ("embed_fsdp", "kv_heads"),
             "wo": L + ("heads", "embed_fsdp"),
         }
+    N = (None, None) if config.norm_type == "layernorm1p" else (None,)
     dense_mlp = {
-        "w_gate": L + ("embed_fsdp", "mlp"),
         "w_up": L + ("embed_fsdp", "mlp"),
         "w_down": L + ("mlp", "embed_fsdp"),
     }
+    if not config.mlp_gateless:
+        dense_mlp["w_gate"] = L + ("embed_fsdp", "mlp")
     if config.pre_norm and not config.parallel_block:
         # Cohere's parallel block shares attn_norm (one real leaf)
-        dense_mlp["mlp_norm"] = L + (None,)
+        dense_mlp["mlp_norm"] = L + N
     if config.n_experts:
         mlp = {
             "w_router": L + ("embed_fsdp", None),
@@ -506,7 +520,7 @@ def param_specs(config: LlamaConfig) -> dict:
             "w_down": L + ("experts", "mlp", "embed_fsdp"),
         }
         if config.pre_norm and not config.parallel_block:
-            mlp["mlp_norm"] = L + (None,)
+            mlp["mlp_norm"] = L + N
         if config.router_bias:
             mlp["router_bias"] = L + (None,)
         if config.moe_shared_expert:  # dense: shard like a plain MLP
@@ -517,7 +531,7 @@ def param_specs(config: LlamaConfig) -> dict:
         mlp = dense_mlp
     layer = {**attn, **mlp}
     if config.pre_norm:
-        layer["attn_norm"] = L + (None,)
+        layer["attn_norm"] = L + N
     if config.qkv_bias:
         layer["bq"] = L + ("heads",)
         layer["bk"] = L + ("kv_heads",)
@@ -538,7 +552,7 @@ def param_specs(config: LlamaConfig) -> dict:
     specs = {
         "embed": ("vocab", "embed_fsdp"),
         "layers": layer,
-        "final_norm": (None,),
+        "final_norm": N,
     }
     if config.first_k_dense:
         # DeepSeek dense prelude: same attention, plain-MLP FFN
@@ -607,6 +621,9 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
 
     def norm_init(shape):
+        if c.norm_type == "layernorm1p":
+            # Nemotron stacked (scale-1, bias): identity init is zeros
+            return jnp.zeros(shape[:-1] + (2, shape[-1]), dt)
         # Gemma-style norms scale by (1 + w): identity init is w = 0
         return (jnp.zeros if c.norm_offset else jnp.ones)(shape, dt)
 
@@ -639,10 +656,13 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     else:
         mlp = {
             "mlp_norm": norm_init((L, c.hidden_size)),
-            "w_gate": normal(k[5], (L, c.hidden_size, c.intermediate_size)),
             "w_up": normal(k[6], (L, c.hidden_size, c.intermediate_size)),
             "w_down": normal(k[7], (L, c.intermediate_size, c.hidden_size), std / math.sqrt(2 * c.n_layers)),
         }
+        if not c.mlp_gateless:
+            mlp["w_gate"] = normal(
+                k[5], (L, c.hidden_size, c.intermediate_size)
+            )
     if c.n_experts and c.router_bias:
         mlp["router_bias"] = jnp.zeros((L, c.n_experts), jnp.float32)
     if not c.pre_norm or c.parallel_block:
@@ -721,9 +741,19 @@ def layer_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
 
 def model_norm(x: jax.Array, w: jax.Array, config: "LlamaConfig") -> jax.Array:
     """The model's norm flavor: RMSNorm (with the Gemma offset
-    convention) or Cohere's mean-centered LayerNorm."""
+    convention), Cohere's mean-centered LayerNorm, or Nemotron's
+    LayerNorm1P — (1 + w)·norm(x) + b with ``w`` stacked [..., 2, H]
+    as (scale-1, bias)."""
     if config.norm_type == "layernorm":
         return layer_norm(x, w, config.norm_eps)
+    if config.norm_type == "layernorm1p":
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+        scale = 1.0 + w[..., 0, :].astype(jnp.float32)
+        bias = w[..., 1, :].astype(jnp.float32)
+        out = (x32 - mu) * jax.lax.rsqrt(var + config.norm_eps) * scale + bias
+        return out.astype(x.dtype)
     return rms_norm(x, w, config.norm_eps, offset=config.norm_offset)
 
 
@@ -747,6 +777,8 @@ def act_fn(config: "LlamaConfig"):
         return jax.nn.silu
     if config.hidden_act == "gelu_tanh":
         return functools.partial(jax.nn.gelu, approximate=True)
+    if config.hidden_act == "relu2":  # Nemotron squared ReLU
+        return lambda x: jnp.square(jax.nn.relu(x))
     raise ValueError(f"unknown hidden_act {config.hidden_act!r}")
 
 
@@ -1167,11 +1199,19 @@ def _mlp_block(
             + config.router_z_coef * aux["z"]
         )
         return o, aux_loss
-    g = _proj(layer, "w_gate", h, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
     u = _proj(layer, "w_up", h, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
-    g = constrain(g, rules, "batch", "seq", "mlp", mesh=mesh)
+    if config.mlp_gateless:  # Nemotron: down(act(up(x)))
+        # CONFIG-driven branch: int8 quantization renames w_gate to
+        # w_gate_q, so key presence would misdetect quantized gated
+        # models as gateless
+        inner = act_fn(config)(u)
+        inner = constrain(inner, rules, "batch", "seq", "mlp", mesh=mesh)
+    else:
+        g = _proj(layer, "w_gate", h, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+        g = constrain(g, rules, "batch", "seq", "mlp", mesh=mesh)
+        inner = act_fn(config)(g) * u
     o = _proj(
-        layer, "w_down", act_fn(config)(g) * u, "btf,fe->bte", "btf,fr->btr", "btr,re->bte"
+        layer, "w_down", inner, "btf,fe->bte", "btf,fr->btr", "btr,re->bte"
     )
     if config.post_norms:
         o = model_norm(o, layer["mlp_post_norm"], config)

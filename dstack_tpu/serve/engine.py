@@ -285,10 +285,15 @@ def _mlp_out(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
             routed_scale=c.routed_scale,
         )
     else:
-        g = _proj(layer, "w_gate", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
         u = _proj(layer, "w_up", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+        if c.mlp_gateless:  # Nemotron (config-driven: int8 renames
+            # w_gate to w_gate_q, so key presence would misdetect)
+            inner = act_fn(c)(u)
+        else:
+            g = _proj(layer, "w_gate", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+            inner = act_fn(c)(g) * u
         mo = _proj(
-            layer, "w_down", act_fn(c)(g) * u,
+            layer, "w_down", inner,
             "btf,fe->bte", "btf,fr->btr", "btr,re->bte",
         )
     if c.post_norms:
