@@ -168,6 +168,15 @@ def moe_mlp(
     return combine is unweighted; a dense shared expert
     (``w_shared_gate/up/down`` in ``layer``) adds to every token.
     """
+    def qw(name):
+        """Expert weight, resolving the int8 form: returns (w, scale or
+        None). The per-output-channel scale multiplies the einsum OUTPUT
+        (exact under the contraction — models/quant.py)."""
+        w = layer.get(name)
+        if w is not None:
+            return w, None
+        return layer[name + "_q"].astype(x.dtype), layer[name + "_s"]
+
     b, t, h = x.shape
     cap = expert_capacity(t, n_experts, experts_per_token, capacity_factor)
     dispatch, combine, aux = router(
@@ -184,19 +193,32 @@ def moe_mlp(
     xe = jnp.einsum("btec,bth->ebch", dispatch, x)
     if rules is not None:
         xe = constrain(xe, rules, "experts", "batch_noexp", None, None, mesh=mesh)
-    g = jnp.einsum("ebch,ehf->ebcf", xe, layer["w_gate"])
-    u = jnp.einsum("ebch,ehf->ebcf", xe, layer["w_up"])
+    wg, sg = qw("w_gate")
+    wu, su = qw("w_up")
+    g = jnp.einsum("ebch,ehf->ebcf", xe, wg)
+    u = jnp.einsum("ebch,ehf->ebcf", xe, wu)
+    if sg is not None:  # scales are [E, F]: broadcast over (b, c)
+        g = g * sg[:, None, None, :].astype(g.dtype)
+        u = u * su[:, None, None, :].astype(u.dtype)
     if rules is not None:
         g = constrain(g, rules, "experts", "batch_noexp", None, "mlp", mesh=mesh)
-    y = jnp.einsum("ebcf,efh->ebch", jax.nn.silu(g) * u, layer["w_down"])
+    wd, sd = qw("w_down")
+    y = jnp.einsum("ebcf,efh->ebch", jax.nn.silu(g) * u, wd)
+    if sd is not None:  # [E, H]
+        y = y * sd[:, None, None, :].astype(y.dtype)
     if rules is not None:
         y = constrain(y, rules, "experts", "batch_noexp", None, None, mesh=mesh)
     out = jnp.einsum("btec,ebch->bth", combine, y)
-    if "w_shared_gate" in layer:  # Llama4 dense shared expert
-        sg = jnp.einsum("bth,hf->btf", x, layer["w_shared_gate"])
-        su = jnp.einsum("bth,hf->btf", x, layer["w_shared_up"])
-        out = out + jnp.einsum(
-            "btf,fh->bth", jax.nn.silu(sg) * su, layer["w_shared_down"]
+    if "w_shared_gate" in layer or "w_shared_gate_q" in layer:
+        # Llama4/DeepSeek dense shared expert: plain 2D matmuls, so
+        # llama._proj resolves the int8 form (and any LoRA bypass)
+        from dstack_tpu.models.llama import _proj
+
+        sg = _proj(layer, "w_shared_gate", x, "bth,hf->btf", "bth,hr->btr", "btr,rf->btf")
+        su = _proj(layer, "w_shared_up", x, "bth,hf->btf", "bth,hr->btr", "btr,rf->btf")
+        out = out + _proj(
+            layer, "w_shared_down", jax.nn.silu(sg) * su,
+            "btf,fh->bth", "btf,fr->btr", "btr,rh->bth",
         )
     if rules is not None:
         out = constrain(out, rules, "batch", "seq", None, mesh=mesh)

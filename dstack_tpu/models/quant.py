@@ -1,9 +1,9 @@
 """Weight-only int8 quantization for serving.
 
 Decode is HBM-bandwidth-bound: the chip reads every weight once per
-token while the MXU idles. Storing the seven projection matrices (and
-the LM head) as int8 with per-output-channel scales halves the bytes
-per step — the dequantize is a cast the MXU input pipeline absorbs plus
+token while the MXU idles. Storing the projection matrices (attention,
+dense/MoE/shared-expert FFNs — see LAYER_TARGETS — and the LM head) as
+int8 with per-output-channel scales halves the bytes per step — the dequantize is a cast the MXU input pipeline absorbs plus
 one per-channel multiply that XLA fuses into the matmul's epilogue.
 
 Per-output-channel absmax scaling is exact under the contraction: for
@@ -18,8 +18,12 @@ path (forward, prefill, decode, LoRA bypass on a quantized base).
 
 Norms, biases, and the embedding table stay in model dtype: they are a
 rounding error of the byte budget, and the embedding is a gather (no
-matmul to fuse a dequant into). MoE expert stacks are not quantized
-yet — refuse rather than serve a half-quantized model silently.
+matmul to fuse a dequant into). MoE expert stacks ([L, E, in, out])
+quantize through the same rank-generic absmax — per (expert, output
+channel) scales — and models/moe.py resolves the ``_q``/``_s`` form in
+its batched expert einsums; routers stay full precision (tiny, and
+routing decisions are precision-sensitive). MLA projections are still
+refused (the absorbed serving path reads raw weight names).
 """
 
 from typing import Any
@@ -30,8 +34,13 @@ import numpy as np
 
 from dstack_tpu.models.llama import LlamaConfig
 
-# projection leaves quantized inside each layer ([L, in, out] stacks)
-LAYER_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# projection leaves quantized inside each layer ([L, in, out] stacks;
+# the MoE expert stacks [L, E, in, out] and the fused shared experts
+# ride the same rank-generic per-output-channel quantization)
+LAYER_TARGETS = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    "w_shared_gate", "w_shared_up", "w_shared_down",
+)
 
 
 def quantize_weight(w) -> tuple[np.ndarray, np.ndarray]:
@@ -60,13 +69,16 @@ def quantize_tree(params: dict, config: LlamaConfig) -> dict:
     Quantizes the per-layer projections and the LM head (when untied);
     embedding, norms, biases, and LoRA adapters pass through.
     """
-    if config.n_experts:
-        raise ValueError(
-            "int8 quantization does not cover MoE expert stacks yet"
-        )
     if config.mla:
         raise ValueError(
             "int8 quantization does not cover MLA projections yet"
+        )
+    if "dense_layers" in params:
+        # belt for a future non-MLA first_k_dense family: quantizing
+        # only params["layers"] would silently serve the prelude at
+        # full precision
+        raise ValueError(
+            "int8 quantization does not cover dense-prelude stacks yet"
         )
     out = {k: v for k, v in params.items() if k not in ("layers", "lm_head")}
     layers = {}

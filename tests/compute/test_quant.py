@@ -63,10 +63,68 @@ class TestQuantizedForward:
         denom = np.abs(np.asarray(full)).max() + 1e-6
         assert np.abs(np.asarray(quant) - np.asarray(full)).max() / denom < 0.05
 
-    def test_moe_refused(self):
-        config = llama.MOE_TINY
+    def test_moe_expert_stacks_quantized(self):
+        """MoE expert stacks [L, E, in, out] quantize per (expert,
+        output channel); the router stays full precision and the
+        dispatch/combine path consumes the int8 form."""
+        config = llama.dataclasses.replace(
+            llama.MOE_TINY, capacity_factor=float(llama.MOE_TINY.n_experts)
+        )
         params = llama.init_params(config, jax.random.key(0))
-        with pytest.raises(ValueError, match="MoE"):
+        qparams = quantize_tree(params, config)
+        assert "w_gate_q" in qparams["layers"]
+        assert qparams["layers"]["w_gate_s"].shape == (
+            config.n_layers, config.n_experts, config.intermediate_size
+        )
+        assert "w_router" in qparams["layers"]  # router not quantized
+        tokens = jax.random.randint(
+            jax.random.key(1), (2, 16), 0, config.vocab_size
+        )
+        full = llama.forward(params, tokens, config)
+        quant = llama.forward(qparams, tokens, config)
+        denom = np.abs(np.asarray(full)).max() + 1e-6
+        rel = np.abs(np.asarray(quant) - np.asarray(full)).max() / denom
+        assert rel < 0.05, f"relative logit error {rel:.3f}"
+
+    def test_shared_expert_quantized(self):
+        """The fused shared expert (Llama4/DeepSeek layout) quantizes
+        through _proj's int8 resolution like any dense projection."""
+        config = llama.dataclasses.replace(
+            llama.MOE_TINY, moe_shared_expert=True,
+            moe_shared_intermediate=64,
+            capacity_factor=float(llama.MOE_TINY.n_experts),
+        )
+        params = llama.init_params(config, jax.random.key(0))
+        qparams = quantize_tree(params, config)
+        assert "w_shared_gate_q" in qparams["layers"]
+        tokens = jax.random.randint(
+            jax.random.key(1), (2, 16), 0, config.vocab_size
+        )
+        full = llama.forward(params, tokens, config)
+        quant = llama.forward(qparams, tokens, config)
+        denom = np.abs(np.asarray(full)).max() + 1e-6
+        rel = np.abs(np.asarray(quant) - np.asarray(full)).max() / denom
+        assert rel < 0.05, f"relative logit error {rel:.3f}"
+
+    def test_moe_engine_decode(self):
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        config = llama.dataclasses.replace(
+            llama.MOE_TINY, capacity_factor=float(llama.MOE_TINY.n_experts)
+        )
+        params = llama.init_params(config, jax.random.key(0))
+        qparams = quantize_tree(params, config)
+        eng = InferenceEngine(
+            config, qparams, max_batch=2, max_seq=64,
+            spec_draft=0, turbo_steps=0,
+        )
+        out = eng.generate([3, 14, 15, 9], GenParams(max_new_tokens=5))
+        assert len(out) == 5
+
+    def test_mla_refused(self):
+        config = llama.MLA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        with pytest.raises(ValueError, match="MLA"):
             quantize_tree(params, config)
 
 
