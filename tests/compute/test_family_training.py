@@ -1,0 +1,70 @@
+"""Training-path coverage for the architecture-delta families.
+
+Parity tests pin the forward math against HF; these pin the BACKWARD:
+every family's deltas (parallel blocks, stacked LayerNorm1P weights,
+gateless relu² MLPs, partial rotary, post-norm residual layout,
+Granite multipliers, full-width qk-norm) must produce finite grads and
+a decreasing loss through the real train step on a sharded mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dstack_tpu.models import llama
+from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+from dstack_tpu.train.step import default_optimizer, make_train_step, sharded_init
+
+TINY = dict(
+    vocab_size=256, hidden_size=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    head_dim=16, intermediate_size=96, max_seq_len=64, dtype=jnp.float32,
+    remat=False,
+)
+
+FAMILY_DELTAS = {
+    "glm4": dict(
+        qkv_bias=True, rope_interleaved=True, partial_rotary=0.5,
+        post_norms=True,
+    ),
+    "olmo2": dict(pre_norm=False, post_norms=True, qk_norm_flat=True),
+    "cohere": dict(
+        norm_type="layernorm", parallel_block=True, rope_interleaved=True,
+        logit_scale=0.0625, tie_embeddings=True, qk_norm=True,
+    ),
+    "cohere2": dict(
+        norm_type="layernorm", parallel_block=True, rope_interleaved=True,
+        logit_scale=0.0625, tie_embeddings=True, sliding_window=8,
+        sliding_pattern=2, nope_pattern=2,
+    ),
+    "nemotron": dict(
+        norm_type="layernorm1p", mlp_gateless=True, partial_rotary=0.5,
+        hidden_act="relu2",
+    ),
+    "granite": dict(
+        embed_multiplier=12.0, residual_multiplier=0.22,
+        attn_scale=0.25, logit_scale=0.125,
+    ),
+}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILY_DELTAS))
+def test_family_trains(family):
+    config = llama.LlamaConfig(**TINY, **FAMILY_DELTAS[family])
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=2, sp=1, tp=2))
+    opt = default_optimizer(lr=3e-3)
+    state, _ = sharded_init(config, opt, mesh, seed=0)
+    step = make_train_step(config, opt, mesh)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, config.vocab_size)
+    data = {
+        "tokens": tokens,
+        "targets": jnp.roll(tokens, -1, axis=1),
+        "mask": jnp.ones_like(tokens),
+    }
+    losses = []
+    for _ in range(20):
+        state, m = step(state, data)
+        losses.append(float(jax.device_get(m["loss"])))
+    assert all(jnp.isfinite(jnp.asarray(losses))), losses
+    # memorizing one batch through the default warmup schedule: the
+    # loss must clearly move down by the end
+    assert losses[-1] < losses[0] * 0.95, losses
