@@ -67,9 +67,10 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     ):
         # q/k/v/o biases exist in the checkpoint but our llama/mistral
         # paths would silently drop them — refuse rather than mis-serve
+        # (StarCoder2 spells its biases use_bias, handled in its branch)
         raise ValueError(
             f"{mt} checkpoint sets attention_bias=true, which this "
-            "converter only supports for qwen2/qwen3/glm"
+            "converter only supports for qwen2/qwen3/glm/glm4"
         )
     act = hf.get("hidden_act") or "silu"
     act_map = {
@@ -214,6 +215,20 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             embed_multiplier=float(hf.get("embedding_multiplier") or 1.0),
             residual_multiplier=float(hf.get("residual_multiplier") or 1.0),
             logit_scale=(1.0 / ls) if ls != 1.0 else 0.0,
+        )
+    if mt == "starcoder2":
+        # StarCoder2: plain LayerNorm with bias (stacked storage),
+        # biases on every projection, gateless GELU MLP (c_fc/c_proj),
+        # full-width rotate-half rope, tied embeddings
+        return LlamaConfig(
+            **{**common,
+               "norm_eps": float(hf.get("norm_epsilon", 1e-5)),
+               "tie_embeddings": bool(hf.get("tie_word_embeddings", True)),
+               "sliding_window": hf.get("sliding_window") or 0},
+            norm_type="layernorm_bias",
+            mlp_gateless=True,
+            qkv_bias=bool(hf.get("use_bias", True)),
+            proj_bias=bool(hf.get("use_bias", True)),
         )
     if mt == "nemotron":
         # Nemotron/Minitron: LayerNorm1P ((1+w)·norm + b, stored stacked
@@ -529,6 +544,16 @@ def convert_state_dict(
         sd = _split_glm(dict(sd), c, model_type)
     if model_type == "nemotron":
         sd = _stack_nemotron_norms(dict(sd), c)
+    if model_type == "starcoder2":
+        sd = dict(sd)
+        for i in range(c.n_layers):  # c_fc/c_proj → the unified names
+            P = f"model.layers.{i}.mlp."
+            for suff in ("weight", "bias"):
+                if P + f"c_fc.{suff}" in sd:
+                    sd[P + f"up_proj.{suff}"] = sd.pop(P + f"c_fc.{suff}")
+                if P + f"c_proj.{suff}" in sd:
+                    sd[P + f"down_proj.{suff}"] = sd.pop(P + f"c_proj.{suff}")
+        sd = _stack_nemotron_norms(sd, c)  # same stacked-norm layout
 
     def get(name):
         if name not in sd:
@@ -584,6 +609,10 @@ def convert_state_dict(
         layers["bq"] = stack(P + "self_attn.q_proj.bias")
         layers["bk"] = stack(P + "self_attn.k_proj.bias")
         layers["bv"] = stack(P + "self_attn.v_proj.bias")
+    if c.proj_bias:  # StarCoder2: o and MLP biases
+        layers["bo"] = stack(P + "self_attn.o_proj.bias")
+        layers["b_up"] = stack(P + "mlp.up_proj.bias")
+        layers["b_down"] = stack(P + "mlp.down_proj.bias")
     if c.qk_norm or c.qk_norm_flat:
         layers["q_norm"] = stack(P + "self_attn.q_norm.weight")
         layers["k_norm"] = stack(P + "self_attn.k_norm.weight")
@@ -978,6 +1007,14 @@ def config_to_hf(config: LlamaConfig) -> dict:
                 use_qk_norm=c.qk_norm,
             )
         return hf
+    if c.norm_type == "layernorm_bias":
+        hf.update(
+            model_type="starcoder2",
+            norm_epsilon=c.norm_eps,
+            use_bias=c.proj_bias,
+            sliding_window=c.sliding_window or None,
+        )
+        return hf
     if c.norm_type == "layernorm1p":
         hf.update(
             model_type="nemotron",
@@ -1106,6 +1143,10 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
             sd[P + "self_attn.q_proj.bias"] = np32(L["bq"][i])
             sd[P + "self_attn.k_proj.bias"] = np32(L["bk"][i])
             sd[P + "self_attn.v_proj.bias"] = np32(L["bv"][i])
+        if c.proj_bias:
+            sd[P + "self_attn.o_proj.bias"] = np32(L["bo"][i])
+            sd[P + "mlp.up_proj.bias"] = np32(L["b_up"][i])
+            sd[P + "mlp.down_proj.bias"] = np32(L["b_down"][i])
         if c.qk_norm or c.qk_norm_flat:
             sd[P + "self_attn.q_norm.weight"] = np32(L["q_norm"][i])
             sd[P + "self_attn.k_norm.weight"] = np32(L["k_norm"][i])
@@ -1140,13 +1181,22 @@ def export_state_dict(params: dict, config: LlamaConfig) -> dict:
             sd[P + "mlp.up_proj.weight"] = np32(L["w_up"][i]).T
             sd[P + "mlp.down_proj.weight"] = np32(L["w_down"][i]).T
     sd["model.norm.weight"] = np32(params["final_norm"])
-    if c.norm_type == "layernorm1p":
-        # split the stacked (scale-1, bias) rows back into HF names
+    if c.norm_type in ("layernorm1p", "layernorm_bias"):
+        # split the stacked (scale, bias) rows back into HF names
         stacked = [n for n in sd if n.endswith("layernorm.weight")]
         for n in stacked + ["model.norm.weight"]:
             wb = sd.pop(n)
             sd[n] = wb[0]
             sd[n[: -len(".weight")] + ".bias"] = wb[1]
+    if c.norm_type == "layernorm_bias":
+        # back to StarCoder2's c_fc/c_proj MLP names
+        for i in range(c.n_layers):
+            P = f"model.layers.{i}.mlp."
+            for suff in ("weight", "bias"):
+                if P + f"up_proj.{suff}" in sd:
+                    sd[P + f"c_fc.{suff}"] = sd.pop(P + f"up_proj.{suff}")
+                if P + f"down_proj.{suff}" in sd:
+                    sd[P + f"c_proj.{suff}"] = sd.pop(P + f"down_proj.{suff}")
     if not c.tie_embeddings:
         sd["lm_head.weight"] = np32(params["lm_head"]).T
     if mt in ("glm", "glm4"):

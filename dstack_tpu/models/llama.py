@@ -130,6 +130,9 @@ class LlamaConfig:
     logit_scale: float = 0.0
     # Nemotron: gateless MLP — down(act(up(x))), no gate matrix
     mlp_gateless: bool = False
+    # StarCoder2: biases on the o projection and the gateless MLP
+    # (bo / b_up / b_down; q/k/v biases ride qkv_bias)
+    proj_bias: bool = False
     # --- IBM Granite deltas (scalar multipliers on the llama skeleton;
     # attention_multiplier maps onto attn_scale) ---
     embed_multiplier: float = 0.0  # scales embeddings (0 = off)
@@ -229,6 +232,7 @@ class LlamaConfig:
         return (
             h * self.q_dim + 2 * h * self.kv_dim + self.q_dim * h
             + (self.q_dim + 2 * self.kv_dim if self.qkv_bias else 0)
+            + (h if self.proj_bias else 0)  # bo
         )
 
     def _shared_expert_params(self) -> int:
@@ -241,23 +245,30 @@ class LlamaConfig:
         e, h = self.vocab_size * self.hidden_size, self.hidden_size
         attn = self._attn_params_per_layer()
         pre = (1 if self.parallel_block else 2) if self.pre_norm else 0
-        extras = pre * h + (2 * h if self.post_norms else 0)
+        # stacked (scale, bias) norm types carry 2H per norm
+        nw = 2 * h if self.norm_type in ("layernorm1p", "layernorm_bias") else h
+        extras = pre * nw + (2 * nw if self.post_norms else 0)
+        mats = 2 if self.mlp_gateless else 3  # StarCoder2/Nemotron
+        mlp_bias = (
+            self.intermediate_size + h if self.proj_bias else 0
+        )
         moe_layers = self.n_layers - self.first_k_dense
         per_moe = (
             attn + extras
-            + max(1, self.n_experts) * 3 * h * self.intermediate_size
+            + max(1, self.n_experts) * mats * h * self.intermediate_size
+            + mlp_bias
             + self._shared_expert_params()
             + (h * self.n_experts if self.n_experts else 0)
             + (self.n_experts if self.router_bias else 0)
         )
         per_dense = (
             attn + extras
-            + 3 * h * (self.dense_intermediate or self.intermediate_size)
+            + mats * h * (self.dense_intermediate or self.intermediate_size)
         )
         out = 0 if self.tie_embeddings else e
         return (
             e + moe_layers * per_moe + self.first_k_dense * per_dense
-            + h + out
+            + nw + out
         )
 
     def num_active_params(self) -> int:
@@ -270,23 +281,25 @@ class LlamaConfig:
         e, h = self.vocab_size * self.hidden_size, self.hidden_size
         attn = self._attn_params_per_layer()
         pre = (1 if self.parallel_block else 2) if self.pre_norm else 0
-        extras = pre * h + (2 * h if self.post_norms else 0)
+        nw = 2 * h if self.norm_type in ("layernorm1p", "layernorm_bias") else h
+        extras = pre * nw + (2 * nw if self.post_norms else 0)
+        mats = 2 if self.mlp_gateless else 3
         moe_layers = self.n_layers - self.first_k_dense
         per_moe = (
             attn + extras
-            + self.experts_per_token * 3 * h * self.intermediate_size
+            + self.experts_per_token * mats * h * self.intermediate_size
             + self._shared_expert_params()
             + h * self.n_experts  # router
             + (self.n_experts if self.router_bias else 0)
         )
         per_dense = (
             attn + extras
-            + 3 * h * (self.dense_intermediate or self.intermediate_size)
+            + mats * h * (self.dense_intermediate or self.intermediate_size)
         )
         out = 0 if self.tie_embeddings else e
         return (
             e + moe_layers * per_moe + self.first_k_dense * per_dense
-            + h + out
+            + nw + out
         )
 
 
@@ -384,6 +397,14 @@ GEMMA3_4B = LlamaConfig(  # text tower of google/gemma-3-4b
     attn_scale=256.0**-0.5,
 )
 
+STARCODER2_7B = LlamaConfig(  # bigcode/starcoder2-7b
+    vocab_size=49152, hidden_size=4608, n_layers=32, n_heads=36,
+    n_kv_heads=4, head_dim=128, intermediate_size=18432,
+    rope_theta=1000000.0, norm_eps=1e-5, max_seq_len=16384,
+    tie_embeddings=True, norm_type="layernorm_bias", mlp_gateless=True,
+    qkv_bias=True, proj_bias=True, hidden_act="gelu_tanh",
+    sliding_window=4096,
+)
 MINITRON_4B = LlamaConfig(  # nvidia/Minitron-4B-Base (nemotron)
     vocab_size=256000, hidden_size=3072, n_layers=32, n_heads=24,
     n_kv_heads=8, head_dim=128, intermediate_size=9216,
@@ -474,6 +495,7 @@ CONFIGS = {
     "olmo-2-7b": OLMO2_7B,
     "command-r-35b": COMMAND_R_35B,
     "minitron-4b": MINITRON_4B,
+    "starcoder2-7b": STARCODER2_7B,
 }
 
 
@@ -502,7 +524,11 @@ def param_specs(config: LlamaConfig) -> dict:
             "wv": L + ("embed_fsdp", "kv_heads"),
             "wo": L + ("heads", "embed_fsdp"),
         }
-    N = (None, None) if config.norm_type == "layernorm1p" else (None,)
+    N = (
+        (None, None)
+        if config.norm_type in ("layernorm1p", "layernorm_bias")
+        else (None,)
+    )
     dense_mlp = {
         "w_up": L + ("embed_fsdp", "mlp"),
         "w_down": L + ("mlp", "embed_fsdp"),
@@ -536,6 +562,10 @@ def param_specs(config: LlamaConfig) -> dict:
         layer["bq"] = L + ("heads",)
         layer["bk"] = L + ("kv_heads",)
         layer["bv"] = L + ("kv_heads",)
+    if config.proj_bias:  # StarCoder2
+        layer["bo"] = L + (None,)
+        layer["b_up"] = L + ("mlp",)
+        layer["b_down"] = L + (None,)
     if config.qk_norm:
         if config.norm_type == "layernorm":  # Cohere [H, D] weights
             layer["q_norm"] = L + ("heads", None)
@@ -621,9 +651,13 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
 
     def norm_init(shape):
-        if c.norm_type == "layernorm1p":
-            # Nemotron stacked (scale-1, bias): identity init is zeros
-            return jnp.zeros(shape[:-1] + (2, shape[-1]), dt)
+        if c.norm_type in ("layernorm1p", "layernorm_bias"):
+            # stacked (scale, bias); Nemotron's 1p stores scale-1 so
+            # zeros are identity there, ones-row for plain LayerNorm
+            z = jnp.zeros(shape[:-1] + (2, shape[-1]), dt)
+            if c.norm_type == "layernorm_bias":
+                z = z.at[..., 0, :].set(1.0)
+            return z
         # Gemma-style norms scale by (1 + w): identity init is w = 0
         return (jnp.zeros if c.norm_offset else jnp.ones)(shape, dt)
 
@@ -681,6 +715,10 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     }
     if c.pre_norm:
         params["layers"]["attn_norm"] = norm_init((L, c.hidden_size))
+    if c.proj_bias:  # StarCoder2
+        params["layers"]["bo"] = jnp.zeros((L, c.hidden_size), dt)
+        params["layers"]["b_up"] = jnp.zeros((L, c.intermediate_size), dt)
+        params["layers"]["b_down"] = jnp.zeros((L, c.hidden_size), dt)
     if c.qk_norm:
         if c.norm_type == "layernorm":  # Cohere per-head weights
             params["layers"]["q_norm"] = jnp.ones((L, c.n_heads, c.head_dim), dt)
@@ -746,11 +784,15 @@ def model_norm(x: jax.Array, w: jax.Array, config: "LlamaConfig") -> jax.Array:
     as (scale-1, bias)."""
     if config.norm_type == "layernorm":
         return layer_norm(x, w, config.norm_eps)
-    if config.norm_type == "layernorm1p":
+    if config.norm_type in ("layernorm1p", "layernorm_bias"):
+        # stacked [..., 2, H] = (scale row, bias row); Nemotron's 1p
+        # stores scale-1, StarCoder2's plain LayerNorm stores scale
         x32 = x.astype(jnp.float32)
         mu = jnp.mean(x32, axis=-1, keepdims=True)
         var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
-        scale = 1.0 + w[..., 0, :].astype(jnp.float32)
+        scale = w[..., 0, :].astype(jnp.float32)
+        if config.norm_type == "layernorm1p":
+            scale = 1.0 + scale
         bias = w[..., 1, :].astype(jnp.float32)
         out = (x32 - mu) * jax.lax.rsqrt(var + config.norm_eps) * scale + bias
         return out.astype(x.dtype)
@@ -1152,6 +1194,8 @@ def _attention_block(
         o = o[..., : c.v_head_dim]  # drop the zero v padding
     o = o.transpose(0, 2, 1, 3).reshape(b, t, c.o_dim)
     out = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+    if c.proj_bias:
+        out = out + layer["bo"]
     if c.post_norms:
         out = model_norm(out, layer["attn_post_norm"], c)
     if c.residual_multiplier:  # Granite scales the sublayer output
@@ -1200,6 +1244,8 @@ def _mlp_block(
         )
         return o, aux_loss
     u = _proj(layer, "w_up", h, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+    if config.proj_bias:
+        u = u + layer["b_up"]
     if config.mlp_gateless:  # Nemotron: down(act(up(x)))
         # CONFIG-driven branch: int8 quantization renames w_gate to
         # w_gate_q, so key presence would misdetect quantized gated
@@ -1213,6 +1259,8 @@ def _mlp_block(
     o = _proj(
         layer, "w_down", inner, "btf,fe->bte", "btf,fr->btr", "btr,re->bte"
     )
+    if config.proj_bias:
+        o = o + layer["b_down"]
     if config.post_norms:
         o = model_norm(o, layer["mlp_post_norm"], config)
     if config.residual_multiplier:  # Granite scales the sublayer output

@@ -286,6 +286,8 @@ def _mlp_out(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
         )
     else:
         u = _proj(layer, "w_up", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
+        if c.proj_bias:
+            u = u + layer["b_up"]
         if c.mlp_gateless:  # Nemotron (config-driven: int8 renames
             # w_gate to w_gate_q, so key presence would misdetect)
             inner = act_fn(c)(u)
@@ -296,6 +298,8 @@ def _mlp_out(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
             layer, "w_down", inner,
             "btf,fe->bte", "btf,fr->btr", "btr,re->bte",
         )
+        if c.proj_bias:
+            mo = mo + layer["b_down"]
     if c.post_norms:
         mo = model_norm(mo, layer["mlp_post_norm"], c)
     if c.residual_multiplier:  # Granite scales the sublayer output
@@ -695,6 +699,8 @@ def prefill_chunk_step(
         )
         o = o.transpose(0, 2, 1, 3).reshape(b, cl, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        if c.proj_bias:
+            ao = ao + layer["bo"]
         if c.post_norms:
             ao = model_norm(ao, layer["attn_post_norm"], c)
         if c.residual_multiplier:  # Granite scales the sublayer output
@@ -861,6 +867,8 @@ def decode_step(
         # [B, Hkv, G, D] row-major flatten == query-head order
         o = o.reshape(b, 1, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        if c.proj_bias:
+            ao = ao + layer["bo"]
         if c.post_norms:
             ao = model_norm(ao, layer["attn_post_norm"], c)
         if c.residual_multiplier:  # Granite scales the sublayer output
@@ -1055,6 +1063,8 @@ def verify_step(
         o = jnp.einsum("bhgsk,bhkd->bhgsd", p.astype(cvf.dtype), cvf)
         o = o.transpose(0, 3, 1, 2, 4).reshape(b, sdraft, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        if c.proj_bias:
+            ao = ao + layer["bo"]
         if c.post_norms:
             ao = model_norm(ao, layer["attn_post_norm"], c)
         if c.residual_multiplier:  # Granite scales the sublayer output

@@ -40,6 +40,10 @@ FAMILY_DELTAS = {
         norm_type="layernorm1p", mlp_gateless=True, partial_rotary=0.5,
         hidden_act="relu2",
     ),
+    "starcoder2": dict(
+        norm_type="layernorm_bias", mlp_gateless=True, qkv_bias=True,
+        proj_bias=True, hidden_act="gelu_tanh", tie_embeddings=True,
+    ),
     "granite": dict(
         embed_multiplier=12.0, residual_multiplier=0.22,
         attn_scale=0.25, logit_scale=0.125,

@@ -617,7 +617,7 @@ class TestConfigRoundTrip:
         "mistral-7b", "gemma-2b", "gemma-2-2b", "gemma-3-1b",
         "gemma-3-4b", "mixtral-8x7b", "llama-4-scout",
         "deepseek-v2-lite", "deepseek-v3", "glm-4-9b", "olmo-2-7b",
-        "command-r-35b", "minitron-4b",
+        "command-r-35b", "minitron-4b", "starcoder2-7b",
     ])
     def test_flags_survive(self, name):
         from dstack_tpu.models.convert_hf import config_from_hf, config_to_hf
@@ -640,7 +640,7 @@ class TestConfigRoundTrip:
             "moe_shared_intermediate", "first_k_dense",
             "dense_intermediate", "partial_rotary", "pre_norm",
             "qk_norm_flat", "norm_type", "parallel_block", "logit_scale",
-            "mlp_gateless",
+            "mlp_gateless", "proj_bias",
         ):
             assert getattr(c2, field) == getattr(c, field), (name, field)
         if not c.mla:  # under MLA head_dim/n_kv_heads are unused
@@ -1158,6 +1158,69 @@ class TestCohere2:
         for f in ("sliding_window", "sliding_pattern", "nope_pattern",
                   "parallel_block", "norm_type", "logit_scale"):
             assert getattr(c2, f) == getattr(c, f), f
+
+
+class TestStarcoder2:
+    def test_starcoder2_layout(self, tmp_path):
+        """StarCoder2: plain LayerNorm WITH bias (stacked storage),
+        biases on every projection, gateless GELU MLP (c_fc/c_proj)."""
+        m = _save_tiny(
+            tmp_path, transformers.Starcoder2Config,
+            transformers.Starcoder2ForCausalLM,
+            sliding_window=None, use_bias=True,
+        )
+        cfg = _assert_parity(tmp_path, m)
+        assert cfg.norm_type == "layernorm_bias" and cfg.mlp_gateless
+        assert cfg.qkv_bias and cfg.proj_bias and cfg.tie_embeddings
+        assert cfg.hidden_act == "gelu_tanh"
+
+    def test_starcoder2_greedy_decode(self, tmp_path):
+        m = _save_tiny(
+            tmp_path, transformers.Starcoder2Config,
+            transformers.Starcoder2ForCausalLM,
+            sliding_window=None, use_bias=True,
+        )
+        config, params = load_checkpoint(str(tmp_path), dtype=jnp.float32)
+        params = jax.device_put(params)
+        config = llama.dataclasses.replace(config, remat=False)
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        eng = InferenceEngine(
+            config, params, max_batch=2, max_seq=48,
+            spec_draft=0, turbo_steps=0,
+        )
+        prompt = [5, 9, 21, 7]
+        out = eng.generate(prompt, GenParams(max_new_tokens=6, temperature=0.0))
+        seq = list(prompt)
+        ref = []
+        for _ in range(6):
+            logits = llama.forward(params, jnp.asarray([seq], jnp.int32), config)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            ref.append(nxt)
+            seq.append(nxt)
+        assert out == ref
+
+    def test_starcoder2_export_roundtrip(self, tmp_path):
+        from dstack_tpu.models.convert_hf import save_checkpoint
+
+        config = llama.dataclasses.replace(
+            llama.STARCODER2_7B, vocab_size=128, hidden_size=64, n_layers=2,
+            n_heads=4, n_kv_heads=2, head_dim=16, intermediate_size=96,
+            max_seq_len=64, sliding_window=0, dtype=jnp.float32, remat=False,
+        )
+        params = llama.init_params(config, jax.random.key(0))
+        out = tmp_path / "export"
+        save_checkpoint(config, params, str(out))
+        hf_model = transformers.AutoModelForCausalLM.from_pretrained(
+            str(out), torch_dtype=torch.float32
+        )
+        hf_model.eval()
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, config.vocab_size, (2, 12))
+        with torch.no_grad():
+            ref = hf_model(torch.tensor(tokens)).logits.numpy()
+        ours = llama.forward(params, jnp.asarray(tokens), config)
+        np.testing.assert_allclose(np.asarray(ours), ref, rtol=0.05, atol=0.05)
 
 
 class TestNemotron:
