@@ -38,6 +38,36 @@ def _tpu_reachable(timeout: float = 120.0) -> bool:
         return False
 
 
+def _wait_for_tpu(budget_s: float, probe_timeout: float = 120.0) -> dict:
+    """Keep probing for the TPU until it answers or ``budget_s`` runs
+    out. Tunnel outages are transient (rounds 2 and 3 both lost their
+    driver-captured TPU number to a one-shot probe), so we retry for
+    minutes — not attempts — before conceding to the CPU fallback.
+
+    Returns ``{"ok": bool, "attempts": N, "waited_s": S}``.
+    """
+    t0 = time.monotonic()
+    attempts = 0
+    while True:
+        attempts += 1
+        if _tpu_reachable(timeout=probe_timeout):
+            return {
+                "ok": True,
+                "attempts": attempts,
+                "waited_s": round(time.monotonic() - t0, 1),
+            }
+        elapsed = time.monotonic() - t0
+        if elapsed >= budget_s:
+            return {
+                "ok": False,
+                "attempts": attempts,
+                "waited_s": round(elapsed, 1),
+            }
+        # a failed probe already burned up to probe_timeout seconds;
+        # short sleep between probes so a tunnel flap is caught quickly
+        time.sleep(min(30.0, max(0.0, budget_s - elapsed)))
+
+
 def _bench(quick: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
@@ -135,9 +165,12 @@ def _bench(quick: bool = False) -> dict:
             )
         else:
             serve_model = "llama-tiny"
+            # prefill_chunk 32 so the 128-token long-prompt pair still
+            # spans >=1 reusable chunk — with the engine's default 256
+            # the prefix-cache TTFT pair is structurally null on CPU
             serve = serve_bench(
                 model=serve_model, batch=2, max_seq=256,
-                prompt_len=64, gen_len=8,
+                prompt_len=64, gen_len=8, prefill_chunk=32,
             )
         serve_extra = {
             "decode_tokens_per_sec": serve["value"],
@@ -197,29 +230,39 @@ def main() -> None:
     if "--_child" in sys.argv:  # the watchdogged TPU measurement
         print(json.dumps(_bench(quick=quick)))
         return
-    tpu_down = False
-    note = None
     result = None
-    if _tpu_reachable():
+    # Total patience before conceding to CPU: tunnel outages observed in
+    # rounds 2/3 cost the driver-captured TPU number both times. 20 min
+    # of retry (env-overridable) is cheap next to losing the round's
+    # only hardware datapoint.
+    budget_s = float(os.environ.get("DTPU_BENCH_TPU_WAIT_S", "1200"))
+    deadline = time.monotonic() + budget_s
+    attempt_notes = []
+    for attempt in range(3):  # full bench attempts, each behind a probe
+        wait = _wait_for_tpu(budget_s=max(0.0, deadline - time.monotonic()))
+        if not wait["ok"]:
+            attempt_notes.append(
+                f"probe gave up after {wait['attempts']} tries / "
+                f"{wait['waited_s']}s"
+            )
+            break
         try:
             result = _run_tpu_child(quick)
+            break
         except Exception as e:
-            tpu_down = True
             detail = str(e).strip()[:300] or type(e).__name__
-            note = (
-                f"TPU bench died mid-run ({detail}); CPU fallback "
-                "measurement — not a TPU number"
-            )
-    else:
-        tpu_down = True
-        note = (
-            "TPU backend unreachable (tunnel down); CPU fallback "
-            "measurement — not a TPU number. Driver-grade TPU runs "
-            "captured while the tunnel was up are in "
-            "BENCH_TPU_r03_evidence.json (0.525-0.530 MFU, 13.2-13.4k "
-            "tok/s/chip train; 1348-1408 tok/s serving decode)"
-        )
+            attempt_notes.append(f"attempt {attempt + 1} died: {detail}")
+            if time.monotonic() >= deadline:
+                break
     if result is None:
+        note = (
+            "TPU backend unreachable or bench died "
+            f"({'; '.join(attempt_notes)}); waited up to "
+            f"{budget_s:.0f}s with retries. CPU fallback measurement "
+            "— not a TPU number. Last TPU evidence: "
+            "BENCH_TPU_r03_evidence.json (0.525-0.530 MFU train, "
+            "1348-1408 tok/s serving decode)"
+        )
         try:
             import jax
 

@@ -25,6 +25,7 @@ def run_bench(
     quantize=None,
     turbo_steps: int = 8,
     kv_quant=None,
+    prefill_chunk: int = 256,
 ) -> dict:
     """Measure the engine directly → result dict (importable core;
     the root ``bench.py`` embeds this next to the training number)."""
@@ -43,6 +44,7 @@ def run_bench(
     eng = InferenceEngine(
         config, params, max_batch=batch, max_seq=max_seq,
         spec_draft=spec_draft, turbo_steps=turbo_steps, kv_quant=kv_quant,
+        prefill_chunk=prefill_chunk,
     )
     rng = np.random.default_rng(0)
     if repetitive:
@@ -201,6 +203,10 @@ def main(argv=None) -> int:
         "--turbo-steps", type=int, default=8,
         help="device-side decode steps per dispatch (0/1 = per-token)",
     )
+    p.add_argument(
+        "--prefill-chunk", type=int, default=256,
+        help="prefill chunk length (prefix reuse is chunk-granular)",
+    )
     p.add_argument("--platform", default=None)
     args = p.parse_args(argv)
 
@@ -220,6 +226,7 @@ def main(argv=None) -> int:
         quantize=args.quantize,
         turbo_steps=args.turbo_steps,
         kv_quant=args.kv_quant,
+        prefill_chunk=args.prefill_chunk,
     )
     print(json.dumps(result))
     return 0
