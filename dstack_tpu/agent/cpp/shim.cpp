@@ -6,6 +6,7 @@
 // docker.go:775-776,807,995-1065). Wire contract: agent/schemas.py.
 
 #include <dirent.h>
+#include <ftw.h>
 #include <signal.h>
 #include <sys/stat.h>
 #include <sys/statvfs.h>
@@ -124,6 +125,9 @@ struct Task {
   std::string container_name;
   pid_t runner_pid = 0;
   int runner_port = 0;
+  // re-adopted by restore(): pid is not our child, so it must be
+  // re-validated against /proc before any signal (pid reuse)
+  bool adopted = false;
 
   Value info() const {
     Value v{Object{}};
@@ -150,6 +154,31 @@ const char* kDockerSock = "/var/run/docker.sock";
 bool docker_available() {
   struct stat st{};
   return ::stat(kDockerSock, &st) == 0;
+}
+
+// True when `pid` is still a tpu-runner serving `id`'s home dir.
+// Matches the stable "/<id>" path segment, not the full home path or
+// runner binary spelling — both can differ between shim invocations.
+bool is_our_runner(pid_t pid, const std::string& id) {
+  if (pid <= 0 || ::kill(pid, 0) != 0) return false;
+  std::ifstream cf("/proc/" + std::to_string(pid) + "/cmdline");
+  std::stringstream cs;
+  cs << cf.rdbuf();
+  std::string cmd = cs.str();
+  for (auto& ch : cmd)
+    if (ch == '\0') ch = ' ';
+  return cmd.find("--home") != std::string::npos &&
+         cmd.find("/" + id) != std::string::npos;
+}
+
+// recursive delete via syscalls (no shell: ids/paths need no quoting)
+void rm_rf(const std::string& path) {
+  nftw(
+      path.c_str(),
+      [](const char* p, const struct stat*, int, struct FTW*) {
+        return ::remove(p);
+      },
+      16, FTW_DEPTH | FTW_PHYS);
 }
 
 class Shim {
@@ -196,6 +225,7 @@ class Shim {
   Value terminate(const std::string& id, int timeout, const std::string& reason,
                   bool& found) {
     pid_t pid = 0;
+    bool adopted = false;
     std::string container;
     {
       std::lock_guard<std::mutex> lk(mu_);
@@ -205,6 +235,7 @@ class Shim {
       Task& t = it->second;
       if (t.status == TaskStatus::Terminated) return t.info();
       pid = t.runner_pid;
+      adopted = t.adopted;
       container = t.container_name;
       if (!reason.empty()) t.termination_reason = reason;
     }
@@ -212,7 +243,7 @@ class Shim {
       dtpu::http::Client::request_unix(
           kDockerSock, "POST",
           "/containers/" + container + "/stop?t=" + std::to_string(timeout));
-    } else if (pid > 0) {
+    } else if (pid > 0 && (!adopted || is_our_runner(pid, id))) {
       ::kill(pid, SIGTERM);
       for (int i = 0; i < timeout * 10; i++) {
         if (::kill(pid, 0) != 0) break;
@@ -268,8 +299,23 @@ class Shim {
     if (use_docker_ && !container.empty() && container.rfind("proc-", 0) != 0) {
       dtpu::http::Client::request_unix(kDockerSock, "DELETE",
                                        "/containers/" + container + "?force=true");
+    } else {
+      // drop the task home incl. its pid file, or a restarted shim
+      // would resurrect the removed task from it (syscall delete: no
+      // shell, so arbitrary ids need no quoting/path_safe gate)
+      rm_rf(base_dir_ + "/" + id);
     }
     return true;
+  }
+
+  // Reconstruct tasks after a shim restart (parity: reference
+  // docker.go:103-160 restores task storage from live containers).
+  // Docker runtime: re-adopt containers carrying the dtpu.task-id
+  // label — running → RUNNING, exited → TERMINATED. Process runtime:
+  // re-adopt live pids from each task's task.json pid file, with a
+  // /proc cmdline check against pid reuse. Returns tasks restored.
+  int restore() {
+    return use_docker_ ? restore_docker() : restore_process();
   }
 
  private:
@@ -281,6 +327,101 @@ class Shim {
   int next_port_ = 11000;
   std::string interruption_;  // metadata watcher notice (empty = none)
   bool shutting_down_ = false;
+
+  int restore_docker() {
+    // filters={"label":["dtpu.task-id"]} URL-encoded
+    auto r = dtpu::http::Client::request_unix(
+        kDockerSock, "GET",
+        "/containers/json?all=1&filters="
+        "%7B%22label%22%3A%5B%22dtpu.task-id%22%5D%7D");
+    if (r.status != 200) return 0;
+    Value arr;
+    try {
+      arr = Value::parse(r.body);
+    } catch (...) {
+      return 0;
+    }
+    int restored = 0;
+    std::lock_guard<std::mutex> lk(mu_);
+    for (const auto& c : arr.as_array()) {
+      const Value& labels = c["Labels"];
+      std::string tid = labels["dtpu.task-id"].as_string();
+      if (tid.empty() || tasks_.count(tid)) continue;
+      Task& t = tasks_[tid];
+      Value req{Object{}};
+      req.set("id", tid);
+      req.set("name", labels["dtpu.task-name"].as_string());
+      req.set("image_name", c["Image"].as_string());
+      t.req = std::move(req);
+      t.runner_port = atoi(labels["dtpu.runner-port"].as_string().c_str());
+      std::string name;
+      if (!c["Names"].as_array().empty())
+        name = c["Names"].as_array()[0].as_string();
+      if (!name.empty() && name[0] == '/') name = name.substr(1);
+      t.container_name = name.empty() ? "dtpu-" + tid.substr(0, 13) : name;
+      if (c["State"].as_string() == "running") {
+        t.status = TaskStatus::Running;
+      } else {
+        t.status = TaskStatus::Terminated;
+        t.termination_reason = "container_exited";
+        t.termination_message = "container exited while shim was down";
+      }
+      if (t.runner_port >= next_port_) next_port_ = t.runner_port + 1;
+      restored++;
+      fprintf(stderr, "tpu-shim: restored task %s from container %s (%s)\n",
+              tid.c_str(), t.container_name.c_str(), status_name(t.status));
+    }
+    return restored;
+  }
+
+  int restore_process() {
+    DIR* d = opendir(base_dir_.c_str());
+    if (!d) return 0;
+    int restored = 0;
+    while (dirent* e = readdir(d)) {
+      if (e->d_name[0] == '.') continue;
+      std::string home = base_dir_ + "/" + e->d_name;
+      std::ifstream f(home + "/task.json");
+      if (!f.good()) continue;
+      std::stringstream ss;
+      ss << f.rdbuf();
+      Value meta;
+      try {
+        meta = Value::parse(ss.str());
+      } catch (...) {
+        continue;
+      }
+      std::string tid = meta["id"].as_string();
+      pid_t pid = static_cast<pid_t>(meta["pid"].as_int());
+      std::lock_guard<std::mutex> lk(mu_);
+      if (tid.empty() || tasks_.count(tid)) continue;
+      Task& t = tasks_[tid];
+      Value req{Object{}};
+      req.set("id", tid);
+      req.set("name", meta["name"].as_string());
+      req.set("image_name", "");
+      t.req = std::move(req);
+      t.runner_port = static_cast<int>(meta["runner_port"].as_int());
+      // pid-reuse guard: only re-adopt a pid that is still our runner
+      // for this task
+      if (is_our_runner(pid, tid)) {
+        t.runner_pid = pid;
+        t.adopted = true;
+        t.container_name = "proc-" + std::to_string(pid);
+        t.status = TaskStatus::Running;
+      } else {
+        t.status = TaskStatus::Terminated;
+        t.termination_reason = "container_exited";
+        t.termination_message = "runner process died while shim was down";
+      }
+      if (t.runner_port >= next_port_) next_port_ = t.runner_port + 1;
+      restored++;
+      fprintf(stderr, "tpu-shim: restored task %s from pid file (%s)\n",
+              tid.c_str(), status_name(t.status));
+    }
+    closedir(d);
+    return restored;
+  }
 
   void set_status(const std::string& id, TaskStatus to) {
     std::lock_guard<std::mutex> lk(mu_);
@@ -410,6 +551,16 @@ class Shim {
       t.runner_pid = pid;
       t.container_name = "proc-" + std::to_string(pid);
     }
+    {
+      // pid file: lets a restarted shim re-adopt this runner
+      Value meta{Object{}};
+      meta.set("id", id);
+      meta.set("name", req["name"].as_string());
+      meta.set("pid", static_cast<int64_t>(pid));
+      meta.set("runner_port", runner_port);
+      std::ofstream f(home + "/task.json");
+      f << meta.dump();
+    }
     // wait for the runner port
     for (int i = 0; i < 100; i++) {
       auto r = dtpu::http::Client::request_tcp("127.0.0.1", runner_port, "GET",
@@ -477,6 +628,12 @@ class Shim {
       binds.push_back(m["source"].as_string() + ":" + m["target"].as_string());
     host_config.set("Binds", std::move(binds));
     config.set("HostConfig", std::move(host_config));
+    // labels carry enough to reconstruct the task after a shim restart
+    Value labels{Object{}};
+    labels.set("dtpu.task-id", id);
+    labels.set("dtpu.task-name", req["name"].as_string());
+    labels.set("dtpu.runner-port", std::to_string(runner_port));
+    config.set("Labels", std::move(labels));
     std::string name = "dtpu-" + id.substr(0, 13);
     auto create = dtpu::http::Client::request_unix(
         kDockerSock, "POST", "/containers/create?name=" + name, config.dump());
@@ -527,6 +684,10 @@ int main(int argc, char** argv) {
     f << host_info().dump();
   }
   auto shim = std::make_shared<Shim>(base_dir, runner_bin, use_docker);
+  int restored = shim->restore();
+  if (restored > 0)
+    fprintf(stderr, "tpu-shim: restored %d task(s) from previous shim\n",
+            restored);
 
   dtpu::http::Router router;
   router.add("GET", "/api/healthcheck", [shim](const dtpu::http::Request&) {

@@ -135,6 +135,9 @@ class Task:
         self.termination_message: Optional[str] = None
         self.container_name: Optional[str] = None
         self.runner_proc: Optional[asyncio.subprocess.Process] = None
+        # pid survives a shim restart (runner_proc does not): restored
+        # process-mode tasks are terminated through it
+        self.runner_pid: Optional[int] = None
         self.runner_port: int = req.runner_port
         self.home: Optional[Path] = None
 
@@ -154,6 +157,20 @@ class Task:
                 schemas.PortMapping(container_port=self.runner_port, host_port=self.runner_port)
             ],
         )
+
+
+def _is_our_runner(pid: int, task_id: str) -> bool:
+    """True when ``pid`` is still a tpu-runner serving ``task_id``'s
+    home dir. Matches the stable ``/<task_id>`` path segment rather
+    than the full home path — base-dir spelling (relative vs absolute,
+    symlinks) can differ between shim invocations."""
+    if not pid or not psutil.pid_exists(pid):
+        return False
+    try:
+        cmd = " ".join(psutil.Process(pid).cmdline())
+    except (psutil.Error, OSError):
+        return False
+    return "runner_main" in cmd and f"/{task_id}" in cmd
 
 
 class ProcessRuntime:
@@ -189,6 +206,22 @@ class ProcessRuntime:
             # same process group as the shim: killing the shim's group
             # reaps runners too (no orphan agents after abrupt exit)
         )
+        task.runner_pid = task.runner_proc.pid
+        # pid file: lets a restarted shim reconstruct this task
+        # (reference restores docker tasks from live containers,
+        # docker.go:103-160; the process runtime's analog is this file)
+        import json as _json
+
+        (home / "task.json").write_text(
+            _json.dumps(
+                {
+                    "id": task.req.id,
+                    "name": task.req.name,
+                    "pid": task.runner_proc.pid,
+                    "runner_port": task.runner_port,
+                }
+            )
+        )
         # wait for the runner port to accept
         for _ in range(100):
             if task.runner_proc.returncode is not None:
@@ -216,6 +249,23 @@ class ProcessRuntime:
                 except asyncio.TimeoutError:
                     proc.kill()
             except ProcessLookupError:
+                pass
+        elif proc is None and task.runner_pid:
+            # restored task: no Process handle, only the pid from the
+            # pid file — re-validate it is still OUR runner immediately
+            # before signalling (the pid could have been recycled since
+            # restore) and signal it directly
+            if not _is_our_runner(task.runner_pid, task.req.id):
+                return
+            try:
+                os.kill(task.runner_pid, 15)
+                for _ in range(timeout * 10):
+                    if not psutil.pid_exists(task.runner_pid):
+                        break
+                    await asyncio.sleep(0.1)
+                else:
+                    os.kill(task.runner_pid, 9)
+            except (ProcessLookupError, PermissionError):
                 pass
 
     async def remove(self, task: Task) -> None:
@@ -304,6 +354,14 @@ class DockerRuntime:
             "Image": req.image_name,
             "Env": env,
             "Cmd": ["/bin/sh", "-c", entry],
+            # labels carry enough to reconstruct the task after a shim
+            # restart (reference docker.go:103-160 restores its task
+            # storage from exactly such labels)
+            "Labels": {
+                "dtpu.task-id": req.id,
+                "dtpu.task-name": req.name,
+                "dtpu.runner-port": str(req.runner_port),
+            },
             "HostConfig": {
                 "Privileged": req.privileged,
                 "NetworkMode": req.network_mode,
@@ -408,6 +466,112 @@ class Shim:
             raise ValueError("task must be terminated before removal")
         await self.runtime.remove(task)
         del self.tasks[task_id]
+
+    async def restore(self) -> int:
+        """Reconstruct tasks after a shim restart, so a crashed/upgraded
+        shim does not orphan its containers or runner processes.
+
+        Docker runtime: containers are found by the ``dtpu.task-id``
+        label and re-adopted — running ones come back RUNNING,
+        exited ones TERMINATED (reference shim restores its task
+        storage from live containers the same way, docker.go:103-160).
+        Process runtime: each task wrote a ``task.json`` pid file; a
+        live pid whose cmdline is still our runner is re-adopted,
+        anything else is TERMINATED. Returns the number restored.
+        """
+        import json as _json
+
+        restored = 0
+        if isinstance(self.runtime, DockerRuntime):
+            try:
+                containers = await self.runtime._request(
+                    "GET",
+                    "/containers/json",
+                    params={
+                        "all": "1",
+                        "filters": _json.dumps({"label": ["dtpu.task-id"]}),
+                    },
+                )
+            except (RuntimeError, OSError) as e:
+                logger.warning("state restore: docker list failed: %s", e)
+                return 0
+            for c in containers:
+                labels = c.get("Labels") or {}
+                tid = labels.get("dtpu.task-id")
+                if not tid or tid in self.tasks:
+                    continue
+                try:
+                    port = int(labels.get("dtpu.runner-port") or 10999)
+                except ValueError:
+                    # foreign/corrupt label (the filter only requires
+                    # dtpu.task-id): skip it, never brick the shim boot
+                    logger.warning(
+                        "state restore: skipping container with bad "
+                        "runner-port label (task %s)", tid,
+                    )
+                    continue
+                req = schemas.TaskSubmitRequest(
+                    id=tid,
+                    name=labels.get("dtpu.task-name", tid),
+                    image_name=c.get("Image", ""),
+                    runner_port=port,
+                )
+                task = Task(req)
+                names = c.get("Names") or []
+                task.container_name = (
+                    names[0].lstrip("/") if names else f"dtpu-{tid[:13]}"
+                )
+                if c.get("State") == "running":
+                    task.status = TaskStatus.RUNNING
+                else:
+                    task.status = TaskStatus.TERMINATED
+                    task.termination_reason = "container_exited"
+                    task.termination_message = (
+                        f"container {c.get('Status', 'exited')} "
+                        "while shim was down"
+                    )
+                self.tasks[tid] = task
+                restored += 1
+                logger.info(
+                    "restored task %s from container %s (%s)",
+                    tid, task.container_name, task.status.value,
+                )
+        else:
+            for pid_file in sorted(self.base_dir.glob("*/task.json")):
+                try:
+                    meta = _json.loads(pid_file.read_text())
+                except (OSError, ValueError):
+                    continue
+                tid = meta.get("id")
+                if not tid or tid in self.tasks:
+                    continue
+                req = schemas.TaskSubmitRequest(
+                    id=tid,
+                    name=meta.get("name", tid),
+                    runner_port=int(meta.get("runner_port", 0) or 0),
+                )
+                task = Task(req)
+                task.home = pid_file.parent
+                pid = int(meta.get("pid", 0) or 0)
+                # pid-reuse guard: only re-adopt if it is still OUR
+                # runner for THIS task
+                if _is_our_runner(pid, tid):
+                    task.runner_pid = pid
+                    task.container_name = f"proc-{pid}"
+                    task.status = TaskStatus.RUNNING
+                else:
+                    task.status = TaskStatus.TERMINATED
+                    task.termination_reason = "container_exited"
+                    task.termination_message = (
+                        "runner process died while shim was down"
+                    )
+                self.tasks[tid] = task
+                restored += 1
+                logger.info(
+                    "restored task %s from pid file (%s)",
+                    tid, task.status.value,
+                )
+        return restored
 
 
 GCP_METADATA_URL = "http://metadata.google.internal"
@@ -599,6 +763,9 @@ def build_app(shim: Shim) -> web.Application:
 
 async def serve(port: int, base_dir: Path, runtime: Optional[str] = None) -> web.AppRunner:
     shim = Shim(base_dir, runtime=runtime)
+    restored = await shim.restore()
+    if restored:
+        logger.info("restored %d task(s) from previous shim", restored)
     app = build_app(shim)
     runner = web.AppRunner(app)
     await runner.setup()

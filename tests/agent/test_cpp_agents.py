@@ -367,6 +367,107 @@ class TestCppShim:
             proc.terminate()
             proc.wait(timeout=5)
 
+    async def test_state_restore_after_shim_kill(self, agent_binaries, tmp_path):
+        """Kill -9 the native shim mid-task; a new shim over the same
+        base dir re-adopts the still-running runner (RUNNING, same
+        port), can terminate it, and a third shim reports it
+        TERMINATED — reference docker.go:103-160 restart-safety."""
+        import os
+        import signal
+
+        runner_bin, shim_bin = agent_binaries
+
+        def spawn(port):
+            return subprocess.Popen(
+                [
+                    str(shim_bin),
+                    "--port", str(port),
+                    "--base-dir", str(tmp_path),
+                    "--runtime", "process",
+                    "--runner-bin", str(runner_bin),
+                ],
+                stderr=subprocess.DEVNULL,
+            )
+
+        port1 = _free_port()
+        proc = spawn(port1)
+        runner_pid = None
+        try:
+            await _wait_port(port1)
+            req = schemas.TaskSubmitRequest(id="t-restore", name="task")
+            status, _ = await _request(
+                port1, "POST", "/api/tasks", json_body=req.model_dump()
+            )
+            assert status == 200
+            for _ in range(100):
+                status, info = await _request(port1, "GET", "/api/tasks/t-restore")
+                ti = schemas.TaskInfo.model_validate(info)
+                if ti.status == schemas.TaskStatus.RUNNING:
+                    break
+                await asyncio.sleep(0.1)
+            assert ti.status == schemas.TaskStatus.RUNNING, ti
+            runner_port = ti.ports[0].host_port
+            assert ti.container_name.startswith("proc-")
+            runner_pid = int(ti.container_name.split("-", 1)[1])
+
+            # hard-kill the shim: the runner survives (no graceful stop)
+            proc.kill()
+            proc.wait(timeout=5)
+            status, hc = await _request(runner_port, "GET", "/api/healthcheck")
+            assert hc["service"] == "tpu-runner"
+
+            # new shim, same base dir -> task restored RUNNING
+            port2 = _free_port()
+            proc = spawn(port2)
+            await _wait_port(port2)
+            status, listing = await _request(port2, "GET", "/api/tasks")
+            assert listing["ids"] == ["t-restore"]
+            status, info = await _request(port2, "GET", "/api/tasks/t-restore")
+            ti = schemas.TaskInfo.model_validate(info)
+            assert ti.status == schemas.TaskStatus.RUNNING
+            assert ti.ports[0].host_port == runner_port
+
+            # terminate through the NEW shim kills the adopted runner
+            status, info = await _request(
+                port2, "POST", "/api/tasks/t-restore/terminate",
+                json_body={"timeout_seconds": 3},
+            )
+            assert (
+                schemas.TaskInfo.model_validate(info).status
+                == schemas.TaskStatus.TERMINATED
+            )
+            for _ in range(50):
+                try:
+                    os.kill(runner_pid, 0)
+                except ProcessLookupError:
+                    runner_pid = None
+                    break
+                await asyncio.sleep(0.1)
+            assert runner_pid is None, "adopted runner survived terminate"
+
+            # third shim: dead pid -> restored TERMINATED; after remove,
+            # nothing left to restore
+            proc.kill()
+            proc.wait(timeout=5)
+            port3 = _free_port()
+            proc = spawn(port3)
+            await _wait_port(port3)
+            status, info = await _request(port3, "GET", "/api/tasks/t-restore")
+            ti = schemas.TaskInfo.model_validate(info)
+            assert ti.status == schemas.TaskStatus.TERMINATED
+            assert ti.termination_reason == "container_exited"
+            status, _ = await _request(port3, "POST", "/api/tasks/t-restore/remove")
+            assert status == 200
+            assert not (tmp_path / "t-restore").exists()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+            if runner_pid:
+                try:
+                    os.kill(runner_pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
     async def test_interruption_watcher_sets_notice(
         self, agent_binaries, tmp_path
     ):

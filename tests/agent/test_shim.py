@@ -108,6 +108,79 @@ class TestShimAPI:
             await client.close()
 
 
+class TestShimStateRestore:
+    """Restart-safety: a new shim over the same base dir re-adopts live
+    runners from pid files and reports dead ones terminated (parity:
+    reference docker.go:103-160 restores task storage from containers)."""
+
+    async def test_restore_running_then_dead(self, tmp_path):
+        import os
+
+        shim = Shim(Path(tmp_path), runtime="process")
+        req = schemas.TaskSubmitRequest(id="task-r", name="restoreme")
+        await shim.submit(req)
+        for _ in range(100):
+            if shim.tasks["task-r"].status == TaskStatus.RUNNING:
+                break
+            await asyncio.sleep(0.1)
+        task = shim.tasks["task-r"]
+        assert task.status == TaskStatus.RUNNING
+        pid = task.runner_pid
+        port = task.runner_port
+        assert (Path(tmp_path) / "task-r" / "task.json").exists()
+
+        # "crash": drop the shim object without terminating; the runner
+        # subprocess stays alive. A fresh shim restores it RUNNING.
+        shim2 = Shim(Path(tmp_path), runtime="process")
+        restored = await shim2.restore()
+        assert restored == 1
+        t2 = shim2.tasks["task-r"]
+        assert t2.status == TaskStatus.RUNNING
+        assert t2.runner_pid == pid and t2.runner_port == port
+
+        # the restored task can be terminated through the NEW shim
+        await shim2.terminate("task-r", timeout=3)
+        assert shim2.tasks["task-r"].status == TaskStatus.TERMINATED
+        for _ in range(50):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("runner survived terminate")
+
+        # a third shim sees the dead pid -> TERMINATED, reason recorded
+        shim3 = Shim(Path(tmp_path), runtime="process")
+        assert await shim3.restore() == 1
+        t3 = shim3.tasks["task-r"]
+        assert t3.status == TaskStatus.TERMINATED
+        assert t3.termination_reason == "container_exited"
+
+        # remove deletes the pid file -> nothing left to restore
+        await shim3.remove("task-r")
+        shim4 = Shim(Path(tmp_path), runtime="process")
+        assert await shim4.restore() == 0
+
+    async def test_restore_ignores_foreign_pid(self, tmp_path):
+        """pid-reuse guard: a live pid whose cmdline is NOT our runner
+        for this home must not be re-adopted as running."""
+        import json
+        import os
+
+        home = Path(tmp_path) / "task-x"
+        home.mkdir(parents=True)
+        (home / "task.json").write_text(
+            json.dumps(
+                {"id": "task-x", "name": "x", "pid": os.getpid(),
+                 "runner_port": 12345}
+            )
+        )
+        shim = Shim(Path(tmp_path), runtime="process")
+        assert await shim.restore() == 1
+        assert shim.tasks["task-x"].status == TaskStatus.TERMINATED
+
+
 class TestPrepareVolumes:
     """Host-side volume prep (mount dir + best-effort device mount)."""
 
