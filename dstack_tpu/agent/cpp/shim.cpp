@@ -5,9 +5,12 @@
 // TPU-flavored: /dev/accel* & /dev/vfio passthrough + PJRT_DEVICE=TPU,
 // docker.go:775-776,807,995-1065). Wire contract: agent/schemas.py.
 
+#include <arpa/inet.h>
 #include <dirent.h>
 #include <ftw.h>
+#include <netinet/in.h>
 #include <signal.h>
+#include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/statvfs.h>
 #include <sys/sysinfo.h>
@@ -171,6 +174,26 @@ bool is_our_runner(pid_t pid, const std::string& id) {
          cmd.find("/" + id) != std::string::npos;
 }
 
+// kernel-chosen ephemeral port (two shims on one host racing a
+// deterministic counter collide; the kernel never hands out a bound
+// port). 0 on failure — the caller falls back to its counter.
+int alloc_ephemeral_port() {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 0;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = 0;
+  int port = 0;
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) == 0) {
+    socklen_t len = sizeof addr;
+    if (::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) == 0)
+      port = ntohs(addr.sin_port);
+  }
+  ::close(fd);
+  return port;
+}
+
 // recursive delete via syscalls (no shell: ids/paths need no quoting)
 void rm_rf(const std::string& path) {
   nftw(
@@ -201,7 +224,8 @@ class Shim {
     }
     Task& task = tasks_[id];
     task.req = req;
-    task.runner_port = next_port_++;
+    int eph = alloc_ephemeral_port();
+    task.runner_port = eph > 0 ? eph : next_port_++;
     std::thread([this, id] { start_task(id); }).detach();
     return task.info();
   }

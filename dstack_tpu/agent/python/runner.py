@@ -158,6 +158,13 @@ class Executor:
     async def run(self) -> None:
         if self.job is None:
             raise ValueError("no job submitted")
+        if self._task is not None:
+            # idempotent: a server retry (timed-out first call, loop
+            # crash between run and the DB status update) must not
+            # exec the job a second time — the duplicate would race
+            # the first pump on self._proc and double-join the
+            # jax.distributed rendezvous
+            return
         self._task = asyncio.create_task(self._run_job())
 
     def _redact(self, text: str) -> str:

@@ -409,7 +409,6 @@ class Shim:
             self.runtime = DockerRuntime(base_dir)
         else:
             self.runtime = ProcessRuntime(base_dir)
-        self._next_runner_port = 11000
         # set by the interruption watcher on a spot-preemption /
         # host-maintenance notice; surfaced via /api/healthcheck so the
         # server classifies the loss as INTERRUPTED (retryable)
@@ -417,16 +416,14 @@ class Shim:
         self.interruption: Optional[str] = None
 
     def _alloc_port(self) -> int:
-        # find a free localhost port for a process-mode runner
-        while True:
-            port = self._next_runner_port
-            self._next_runner_port += 1
-            with socket.socket() as s:
-                try:
-                    s.bind(("127.0.0.1", port))
-                    return port
-                except OSError:
-                    continue
+        # kernel-chosen ephemeral port for a process-mode runner: two
+        # shims on one host (nodes: 2 on the local backend) racing a
+        # deterministic counter both picked 11000 and one runner died
+        # on bind; ephemeral allocation makes collisions practically
+        # impossible
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
 
     async def submit(self, req: schemas.TaskSubmitRequest) -> Task:
         if req.id in self.tasks:

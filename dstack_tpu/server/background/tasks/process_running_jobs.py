@@ -258,9 +258,14 @@ async def _build_cluster_info(db: Database, job_row: dict, jpd: JobProvisioningD
         ips = await _replica_job_ips(db, job_row)
         if ips and ips[0]:
             megascale_address = f"{ips[0]}:{MEGASCALE_PORT}"
-    elif jpd.hosts:
+    elif jpd.hosts and len(jpd.hosts) > 1:
+        # a real multihost slice: one instance, N workers
         ips = [h.internal_ip for h in sorted(jpd.hosts, key=lambda h: h.worker_id)]
     else:
+        # single-host instances (incl. jpd.hosts == [self]): the node
+        # list spans the replica's SIBLING jobs — a 1-host jpd must not
+        # shadow a `nodes: N` run across N instances, or every node
+        # sees a 1-process world and jax.distributed never forms
         ips = await _replica_job_ips(db, job_row)
     return ClusterInfo(
         master_node_ip=ips[0] if ips else "",
@@ -514,12 +519,19 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
                 job_name=job_spec.job_name,
                 # wire contract: the submitted job_num is the rank the
                 # runner feeds cluster_env() — the WITHIN-SLICE worker id
-                # for slice jobs (jpd.worker_id; cluster_env derives the
-                # global rank from slice_id), the global job_num otherwise
+                # for multi-worker slice jobs (jpd.worker_id; cluster_env
+                # derives the global rank from slice_id), the global
+                # job_num otherwise. A 1-host jpd (local/self-entry)
+                # must NOT shadow sibling-instance ranks: every node of
+                # a `nodes: N` run would submit as rank 0.
                 job_spec={
                     **job_spec.model_dump(),
                     "env": env,  # secrets references resolved
-                    "job_num": jpd.worker_id if jpd.hosts else job_spec.job_num,
+                    "job_num": (
+                        jpd.worker_id
+                        if jpd.hosts and len(jpd.hosts) > 1
+                        else job_spec.job_num
+                    ),
                 },
                 cluster_info=cluster_info,
                 repo_data=repo_data,

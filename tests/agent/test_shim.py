@@ -71,7 +71,7 @@ class TestShimAPI:
                     break
                 await asyncio.sleep(0.1)
             assert info.status == TaskStatus.RUNNING, info
-            assert info.ports and info.ports[0].host_port >= 11000
+            assert info.ports and info.ports[0].host_port > 1024
 
             # duplicate submit is a conflict
             r = await client.post("/api/tasks", json=req.model_dump())
