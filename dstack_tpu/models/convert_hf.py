@@ -318,7 +318,16 @@ def _deepseek_config(hf: dict, common: dict, mt: str) -> LlamaConfig:
     rs = hf.get("rope_scaling")
     if v3 and rs and rs.get("mscale_all_dim"):
         # HF DeepseekV3Attention multiplies the softmax scale by
-        # yarn mscale(factor, mscale_all_dim)^2 (V2's class does not)
+        # yarn mscale(factor, mscale_all_dim)^2 — and HF's native
+        # DeepseekV2Attention does NOT (verified against transformers
+        # 4.57.6), so this correction is V3-only here to match HF.
+        # KNOWN DIVERGENCE: DeepSeek's original remote-code V2 modeling
+        # applies the same mscale^2 correction, and V2-Lite ships
+        # mscale_all_dim=0.707 — serving a real V2-Lite checkpoint via
+        # this HF-faithful path runs ~1.59x off the released model's
+        # intended attention scale (an upstream HF-inherited
+        # divergence; the hardcoded DEEPSEEK_V2_LITE preset follows HF
+        # deliberately so parity tests against HF outputs pass).
         ms = 0.1 * float(rs["mscale_all_dim"]) * math.log(float(rs["factor"])) + 1.0
         qk_dim = hf["qk_nope_head_dim"] + hf["qk_rope_head_dim"]
         mla["attn_scale"] = qk_dim**-0.5 * ms * ms

@@ -420,6 +420,27 @@ def _valid_chat_message(m) -> bool:
 _TOOL_CALL_RE = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.S)
 
 
+def _tool_stream_safe_len(out: str) -> int:
+    """How much of the accumulated stream text is PROVABLY not part of a
+    tool call and may stream as prose right away (tools-enabled clients
+    should not lose incremental streaming for plain-prose replies).
+
+    Llama-3.1 JSON calls are whole-reply objects → a reply whose first
+    non-space char is ``{`` buffers entirely. Hermes blocks start at
+    ``<tool_call>`` → hold back from the first complete tag, or from a
+    trailing partial prefix of it (the tag may still be arriving)."""
+    if out.lstrip().startswith("{"):
+        return 0
+    i = out.find("<tool_call>")
+    if i != -1:
+        return i
+    tag = "<tool_call>"
+    for k in range(min(len(tag) - 1, len(out)), 0, -1):
+        if out.endswith(tag[:k]):
+            return len(out) - k
+    return len(out)
+
+
 def _parse_tool_calls(text: str) -> tuple[Optional[str], Optional[list]]:
     """Recognize the two dominant open-model tool-call output formats →
     (remaining content or None, OpenAI ``tool_calls`` list or None).
@@ -701,12 +722,12 @@ def build_app(
                     if tok is None:
                         break
                     ids.append(tok)
-                    if tools:
-                        # tool-call outputs can't stream as prose: the
-                        # text is only classifiable once complete, so
-                        # buffer and emit a single chunk at the end
-                        continue
                     out = emittable()
+                    if tools:
+                        # stream prose up to the first point that could
+                        # still become a tool call; only the candidate
+                        # region buffers for end-of-stream parsing
+                        out = out[:_tool_stream_safe_len(out)]
                     delta = out[len(sent):]
                     if not delta:
                         continue
@@ -725,16 +746,20 @@ def build_app(
                     if tail:
                         await emit(tail)
                 elif ids and tools:
-                    text = final_text()
-                    content, tool_calls = _parse_tool_calls(text)
+                    # parse only the HELD-BACK tail: any prose before it
+                    # already streamed incrementally
+                    rest = final_text()[len(sent):]
+                    content, tool_calls = (
+                        _parse_tool_calls(rest) if rest else (None, None)
+                    )
                     if tool_calls:
                         await emit(content, tool_calls=[
                             {**c, "index": ci}
                             for ci, c in enumerate(tool_calls)
                         ])
                         stream_finish = "tool_calls"
-                    elif text:
-                        await emit(text)
+                    elif rest:
+                        await emit(rest)
             finally:
                 sched.cancel(req)  # no-op when finished; frees the slot on disconnect
             if req.error:

@@ -33,10 +33,21 @@ async def process_instances(db: Database) -> None:
         "AND deleted = 0 ORDER BY last_processed_at ASC LIMIT ?",
         (*ACTIVE, settings.MAX_PROCESSING_INSTANCES),
     )
-    async with db.claim_one("instances", [r["id"] for r in rows]) as iid:
-        if iid is None:
+    # batch pass (see process_running_jobs): instances healthcheck /
+    # provision / terminate independently
+    import asyncio
+
+    async with db.claim_batch(
+        "instances", [r["id"] for r in rows], settings.MAX_PROCESSING_INSTANCES
+    ) as iids:
+        if not iids:
             return
-        await _process(db, iid)
+        results = await asyncio.gather(
+            *(_process(db, iid) for iid in iids), return_exceptions=True
+        )
+        for iid, res in zip(iids, results):
+            if isinstance(res, BaseException):
+                logger.exception("processing instance %s failed", iid, exc_info=res)
 
 
 async def _process(db: Database, instance_id: str) -> None:

@@ -40,15 +40,28 @@ ACTIVE = (
 
 
 async def process_running_jobs(db: Database) -> None:
+    import asyncio
+
     rows = await db.fetchall(
         f"SELECT id FROM jobs WHERE status IN ({','.join('?' for _ in ACTIVE)}) "
         "ORDER BY last_processed_at ASC LIMIT ?",
         (*ACTIVE, settings.MAX_PROCESSING_JOBS),
     )
-    async with db.claim_one("jobs", [r["id"] for r in rows]) as job_id:
-        if job_id is None:
+    # batch pass: each active job is independent (its own agent poll),
+    # so one tick visits MAX_PROCESSING_JOBS of them concurrently —
+    # sequential one-row ticks cap visit latency at rows×interval,
+    # which blows the 150-jobs-in-2-minutes capacity target
+    async with db.claim_batch(
+        "jobs", [r["id"] for r in rows], settings.MAX_PROCESSING_JOBS
+    ) as job_ids:
+        if not job_ids:
             return
-        await _process(db, job_id)
+        results = await asyncio.gather(
+            *(_process(db, jid) for jid in job_ids), return_exceptions=True
+        )
+        for jid, res in zip(job_ids, results):
+            if isinstance(res, BaseException):
+                logger.exception("processing job %s failed", jid, exc_info=res)
 
 
 async def _process(db: Database, job_id: str) -> None:

@@ -46,10 +46,23 @@ async def process_submitted_jobs(db: Database) -> None:
         "SELECT id FROM jobs WHERE status = ? ORDER BY last_processed_at ASC LIMIT ?",
         (JobStatus.SUBMITTED.value, settings.MAX_PROCESSING_JOBS),
     )
-    async with db.claim_one("jobs", [r["id"] for r in rows]) as job_id:
-        if job_id is None:
+    # bounded burst: scheduling is the one loop where rows CONTEND
+    # (two jobs may want the same pool instance — the loser falls
+    # through to offers and retries), so the batch stays small; 4/s
+    # comfortably clears the reference's documented 75/min ceiling
+    import asyncio
+
+    async with db.claim_batch(
+        "jobs", [r["id"] for r in rows], min(4, settings.MAX_PROCESSING_JOBS)
+    ) as job_ids:
+        if not job_ids:
             return
-        await _process_job(db, job_id)
+        results = await asyncio.gather(
+            *(_process_job(db, jid) for jid in job_ids), return_exceptions=True
+        )
+        for jid, res in zip(job_ids, results):
+            if isinstance(res, BaseException):
+                logger.exception("scheduling job %s failed", jid, exc_info=res)
 
 
 async def _process_job(db: Database, job_id: str) -> None:

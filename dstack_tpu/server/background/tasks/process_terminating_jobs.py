@@ -27,10 +27,21 @@ async def process_terminating_jobs(db: Database) -> None:
         "SELECT id FROM jobs WHERE status = ? ORDER BY last_processed_at ASC LIMIT ?",
         (JobStatus.TERMINATING.value, settings.MAX_PROCESSING_JOBS),
     )
-    async with db.claim_one("jobs", [r["id"] for r in rows]) as job_id:
-        if job_id is None:
+    # batch pass (see process_running_jobs): terminations are
+    # independent per job; volume detach is claim-guarded
+    import asyncio
+
+    async with db.claim_batch(
+        "jobs", [r["id"] for r in rows], settings.MAX_PROCESSING_JOBS
+    ) as job_ids:
+        if not job_ids:
             return
-        await _process(db, job_id)
+        results = await asyncio.gather(
+            *(_process(db, jid) for jid in job_ids), return_exceptions=True
+        )
+        for jid, res in zip(job_ids, results):
+            if isinstance(res, BaseException):
+                logger.exception("terminating job %s failed", jid, exc_info=res)
 
 
 async def _process(db: Database, job_id: str) -> None:

@@ -183,6 +183,15 @@ class Database:
         async with _claim(namespace, candidates) as claimed:
             yield claimed
 
+    @asynccontextmanager
+    async def claim_batch(self, namespace: str, candidates: list, limit: int):
+        """Claim up to ``limit`` candidates for one concurrent batch
+        pass (see services.locking.claim_batch)."""
+        from dstack_tpu.server.services.locking import claim_batch as _claim
+
+        async with _claim(namespace, candidates, limit) as claimed:
+            yield claimed
+
     # -- generic row helpers --
 
     async def insert(self, table: str, row: dict) -> None:

@@ -281,6 +281,30 @@ class PostgresDatabase:
                 )
             await self._lock_pool.release(conn)
 
+    @asynccontextmanager
+    async def claim_batch(self, namespace: str, candidates: list, limit: int):
+        """Batched queue pop across replicas: up to ``limit`` candidates
+        whose advisory locks were free (one concurrent reconciler
+        pass per tick — the 150-rows-in-2-minutes capacity lever)."""
+        conn = await self._lock_pool.acquire()
+        claimed: list = []
+        try:
+            for k in candidates:
+                if len(claimed) >= limit:
+                    break
+                got = await conn.fetchval(
+                    "SELECT pg_try_advisory_lock($1)", advisory_key(namespace, k)
+                )
+                if got:
+                    claimed.append(k)
+            yield claimed
+        finally:
+            for k in claimed:
+                await conn.fetchval(
+                    "SELECT pg_advisory_unlock($1)", advisory_key(namespace, k)
+                )
+            await self._lock_pool.release(conn)
+
     # -- generic row helpers (same as db.Database) --
 
     async def insert(self, table: str, row: dict) -> None:

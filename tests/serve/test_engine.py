@@ -733,7 +733,31 @@ class TestKVQuant:
         rel = np.abs(np.asarray(back - x)).max() / np.abs(np.asarray(x)).max()
         assert rel < 1.5 / 127  # half-step absmax error
 
-    def test_decode_logits_close_to_exact(self):
+    def test_scales_stored_f32_under_bf16_compute(self):
+        """Scales stay FLOAT32 even when the model computes in bf16
+        (bf16 scale storage would stack ~0.4% multiplicative error on
+        every dequantized vector), and dequant applies the f32 scale at
+        full precision — only the result rounds to bf16."""
+        import dataclasses
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from dstack_tpu.serve.engine import init_cache, kv_dequant, kv_quantize
+
+        bf16_cfg = dataclasses.replace(self.config, dtype=jnp.bfloat16)
+        cache = init_cache(bf16_cfg, 2, 32, kv_quant="int8")
+        assert cache["k_s"].dtype == jnp.float32
+        assert cache["v_s"].dtype == jnp.float32
+        assert cache["k"].dtype == jnp.int8
+
+        x = jax.random.normal(jax.random.key(2), (2, 4, 8, 32), jnp.float32)
+        q, s = kv_quantize(x)
+        back = np.asarray(kv_dequant(q, s, jnp.bfloat16), np.float32)
+        rel = np.abs(back - np.asarray(x)).max() / np.abs(np.asarray(x)).max()
+        # int8 half-step + one bf16 RESULT rounding — no second
+        # scale-rounding term
+        assert rel < 1.5 / 127 + 0.005, rel
         from dstack_tpu.serve.engine import GenParams as GP
 
         prompt = [5, 99, 321, 7, 250, 41, 18]

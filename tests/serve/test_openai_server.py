@@ -737,9 +737,29 @@ class TestToolCalls:
             await client.close()
 
 
-    async def test_streaming_with_tools_buffers(self):
-        """stream=true + tools: content is buffered (tool markup must
-        never leak as prose deltas) and arrives as one chunk."""
+    def test_tool_stream_safe_len(self):
+        """Prose streams immediately; only tool-call-candidate regions
+        hold back (plain-prose replies must not lose incremental
+        streaming just because the request declared tools)."""
+        from dstack_tpu.serve.openai_server import _tool_stream_safe_len as f
+
+        assert f("plain prose, no markup") == len("plain prose, no markup")
+        # a leading '{' could be a Llama-3.1 whole-reply JSON call
+        assert f('{"name": "fn"') == 0
+        assert f('  {"name"') == 0
+        # complete Hermes tag: prose before it is safe, tag is not
+        t = "sure: <tool_call>{}"
+        assert f(t) == t.index("<tool_call>")
+        # trailing PARTIAL tag holds back only the candidate suffix
+        assert f("hello <tool") == len("hello ")
+        assert f("hello <") == len("hello ")
+        # '<' mid-word that stopped matching streams freely
+        assert f("a < b math") == len("a < b math")
+
+    async def test_streaming_with_tools_streams_prose(self):
+        """stream=true + tools: prose streams incrementally (no
+        buffer-everything), tool markup never leaks as a prose delta,
+        and the stream still terminates with a valid finish_reason."""
         config = llama.LLAMA_TINY
         params = jax.device_put(llama.init_params(config, jax.random.key(0)))
         engine = InferenceEngine(config, params, max_batch=2, max_seq=64)
@@ -758,11 +778,9 @@ class TestToolCalls:
             chunks = [json.loads(line[len("data: "):])
                       for line in body.splitlines()
                       if line.startswith("data: ") and line != "data: [DONE]"]
-            # exactly one content-bearing delta (buffered), then final
-            deltas = [c for c in chunks
-                      if c["choices"][0]["delta"].get("content")
-                      or c["choices"][0]["delta"].get("tool_calls")]
-            assert len(deltas) <= 1
+            for c in chunks:
+                content = c["choices"][0]["delta"].get("content") or ""
+                assert "<tool_call>" not in content
             assert chunks[-1]["choices"][0]["finish_reason"] in (
                 "stop", "length", "tool_calls")
         finally:

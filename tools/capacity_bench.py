@@ -1,0 +1,276 @@
+"""Control-plane capacity benchmark.
+
+The reference documents its per-replica capacity as ~150 active
+jobs/runs/instances with <= 2 min processing latency and a 75 jobs/min
+scheduling ceiling (reference server/background/__init__.py:45-56).
+This tool measures the same two numbers for THIS control plane:
+
+1. **Scheduling ramp**: N runs submitted at once -> time for every job
+   to reach RUNNING through the real reconcilers (jobs/min).
+2. **Steady-state visit latency**: with N RUNNING jobs (+ their
+   instances) the reconcilers keep polling agents; we record every
+   per-job visit and report the p50/p95/max gap between consecutive
+   visits of the same job. Target: max <= 120 s.
+
+Compute + on-host agents are faked (5 ms simulated RTT per call) so the
+measurement isolates the control plane: DB, locking, reconciler
+batching. Engines: sqlite in-memory (default), ``--db pgwire`` (the
+bundled wire-protocol fake Postgres), or ``--db postgres`` with
+``DTPU_TEST_PG_DSN``.
+
+Usage::
+
+    python tools/capacity_bench.py --jobs 150 --window 60
+"""
+
+import argparse
+import asyncio
+import contextlib
+import json
+import os
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+AGENT_RTT_S = 0.005  # simulated server<->agent round trip
+
+
+def _fake_agents():
+    """(shim_client_for, runner_client_for) replacements with canned
+    happy-path responses and a small simulated RTT."""
+    from contextlib import asynccontextmanager
+
+    from dstack_tpu.agent import schemas as agent_schemas
+
+    class FakeShim:
+        async def healthcheck(self):
+            await asyncio.sleep(AGENT_RTT_S)
+            return agent_schemas.HealthcheckResponse(
+                service="tpu-shim", version="bench"
+            )
+
+        async def submit_task(self, req):
+            await asyncio.sleep(AGENT_RTT_S)
+            return agent_schemas.TaskInfo(
+                id=req.id,
+                status=agent_schemas.TaskStatus.PULLING,
+                ports=[agent_schemas.PortMapping(container_port=10999, host_port=10999)],
+            )
+
+        async def get_task(self, task_id):
+            await asyncio.sleep(AGENT_RTT_S)
+            return agent_schemas.TaskInfo(
+                id=task_id,
+                status=agent_schemas.TaskStatus.RUNNING,
+                ports=[agent_schemas.PortMapping(container_port=10999, host_port=10999)],
+            )
+
+        async def terminate(self, task_id, timeout_seconds=10, reason=None, message=None):
+            await asyncio.sleep(AGENT_RTT_S)
+            return agent_schemas.TaskInfo(
+                id=task_id, status=agent_schemas.TaskStatus.TERMINATED
+            )
+
+        async def remove(self, task_id):
+            await asyncio.sleep(AGENT_RTT_S)
+
+    class FakeRunner:
+        async def healthcheck(self):
+            await asyncio.sleep(AGENT_RTT_S)
+            return agent_schemas.HealthcheckResponse(
+                service="tpu-runner", version="bench"
+            )
+
+        async def submit(self, body):
+            await asyncio.sleep(AGENT_RTT_S)
+
+        async def upload_code(self, blob):
+            await asyncio.sleep(AGENT_RTT_S)
+
+        async def run(self):
+            await asyncio.sleep(AGENT_RTT_S)
+
+        async def pull(self, since):
+            await asyncio.sleep(AGENT_RTT_S)
+            return agent_schemas.PullResponse(
+                job_states=[], job_logs=[], runner_logs=[],
+                last_updated=since, has_more=True,
+            )
+
+        async def stop(self):
+            await asyncio.sleep(AGENT_RTT_S)
+
+    @asynccontextmanager
+    async def shim_client_for(jpd, shim_port=None, db=None, project_id=None):
+        yield FakeShim()
+
+    @asynccontextmanager
+    async def runner_client_for(jpd, runner_port, db=None, project_id=None):
+        yield FakeRunner()
+
+    return shim_client_for, runner_client_for
+
+
+async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
+    os.environ.setdefault("DTPU_LOG_LEVEL", "warning")
+    if engine in ("postgres", "pgwire"):
+        os.environ["DTPU_TEST_DB"] = engine
+    else:
+        os.environ.pop("DTPU_TEST_DB", None)
+
+    from dstack_tpu.server.background.tasks import (
+        process_metrics,
+        process_running_jobs,
+        process_terminating_jobs,
+    )
+    from dstack_tpu.server.background.tasks.process_instances import (
+        process_instances,
+    )
+    from dstack_tpu.server.background.tasks.process_runs import process_runs
+    from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+        process_submitted_jobs,
+    )
+    from dstack_tpu.server.services import runs as runs_service
+    from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
+    from dstack_tpu.server.testing.common import (
+        FakeCompute,
+        cpu_offer,
+        create_test_db,
+        create_test_project,
+        create_test_user,
+        install_fake_backend,
+        make_run_spec,
+    )
+
+    import tempfile
+
+    set_log_storage(FileLogStorage(Path(tempfile.mkdtemp(prefix="cap-bench-"))))
+
+    shim_for, runner_for = _fake_agents()
+    process_running_jobs.shim_client_for = shim_for
+    process_running_jobs.runner_client_for = runner_for
+    process_terminating_jobs.shim_client_for = shim_for
+    process_metrics.runner_client_for = runner_for
+
+    # record every reconciler visit of a RUNNING job (the pull path)
+    visits: dict[str, list[float]] = {}
+    orig_running = process_running_jobs._process_running
+
+    async def tracked_running(db, job_row, jpd):
+        visits.setdefault(job_row["id"], []).append(time.monotonic())
+        return await orig_running(db, job_row, jpd)
+
+    process_running_jobs._process_running = tracked_running
+
+    db = await create_test_db()
+    _user, user_row = await create_test_user(db)
+    project_row = await create_test_project(db, user_row)
+    # one offer, unlimited capacity: every job gets its own instance
+    compute = FakeCompute(offers=[cpu_offer()])
+    install_fake_backend(project_row, compute)
+
+    conf = {"type": "task", "commands": ["python train.py"]}
+    t_submit = time.monotonic()
+    for i in range(n_jobs):
+        await runs_service.submit_run(
+            db, project_row, user_row,
+            make_run_spec(conf, f"cap-{i:04d}"),
+        )
+
+    # drive the loops at their production intervals
+    # (server/background/__init__.py)
+    loops = [
+        (process_runs, 2.0),
+        (process_submitted_jobs, 1.0),
+        (process_running_jobs.process_running_jobs, 1.0),
+        (process_terminating_jobs.process_terminating_jobs, 2.0),
+        (process_instances, 2.0),
+    ]
+    stop = asyncio.Event()
+
+    async def drive(fn, interval):
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                await fn(db)
+            except Exception as e:  # pragma: no cover - surfacing only
+                print(f"loop {fn.__name__} error: {e}", file=sys.stderr)
+            elapsed = time.monotonic() - t0
+            with contextlib.suppress(asyncio.TimeoutError):
+                await asyncio.wait_for(
+                    stop.wait(), timeout=max(interval - elapsed, 0.01)
+                )
+
+    tasks = [asyncio.create_task(drive(fn, iv)) for fn, iv in loops]
+
+    # --- phase 1: ramp to all-RUNNING ---
+    ramp_s = None
+    deadline = time.monotonic() + max(300.0, window_s)
+    while time.monotonic() < deadline:
+        row = await db.fetchone(
+            "SELECT COUNT(*) AS n FROM jobs WHERE status = 'running'"
+        )
+        if row["n"] >= n_jobs:
+            ramp_s = time.monotonic() - t_submit
+            break
+        await asyncio.sleep(0.5)
+
+    # --- phase 2: steady-state visit latency over the window ---
+    visits.clear()
+    t_window = time.monotonic()
+    await asyncio.sleep(window_s)
+    stop.set()
+    await asyncio.gather(*tasks, return_exceptions=True)
+
+    gaps: list[float] = []
+    visited = 0
+    for ts in visits.values():
+        visited += 1
+        # include the edge gaps so a job visited once in the whole
+        # window still contributes its true starvation time
+        seq = [t_window, *ts, t_window + window_s]
+        gaps.extend(b - a for a, b in zip(seq, seq[1:]))
+    result = {
+        "engine": engine,
+        "jobs": n_jobs,
+        "ramp_to_all_running_s": round(ramp_s, 1) if ramp_s else None,
+        "scheduling_rate_per_min": (
+            round(n_jobs / ramp_s * 60, 1) if ramp_s else None
+        ),
+        "window_s": window_s,
+        "jobs_visited_in_window": visited,
+        "visit_gap_p50_s": round(statistics.median(gaps), 2) if gaps else None,
+        "visit_gap_p95_s": (
+            round(statistics.quantiles(gaps, n=20)[18], 2)
+            if len(gaps) >= 20 else None
+        ),
+        "visit_gap_max_s": round(max(gaps), 2) if gaps else None,
+        "meets_150_at_2min": bool(
+            ramp_s is not None
+            and visited >= n_jobs
+            and gaps
+            and max(gaps) <= 120.0
+        ),
+    }
+    await db.close()
+    return result
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--jobs", type=int, default=150)
+    p.add_argument("--window", type=float, default=60.0)
+    p.add_argument(
+        "--db", default="sqlite", choices=["sqlite", "pgwire", "postgres"]
+    )
+    args = p.parse_args()
+    result = asyncio.run(bench(args.jobs, args.window, args.db))
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
