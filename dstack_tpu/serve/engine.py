@@ -15,6 +15,7 @@ user containers, reference examples use vLLM/TGI); this module makes
 command on any slice the orchestrator provisions.
 """
 
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional
@@ -1282,6 +1283,7 @@ class InferenceEngine:
         turbo_steps: int = 8,
         prefix_cache: bool = True,
         kv_quant=None,  # None | "int8": quantized KV cache
+        turbo_quiet_s: float = 0.5,
     ):
         """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
         params shard per the model's logical rules (heads/mlp/vocab over
@@ -1382,6 +1384,15 @@ class InferenceEngine:
         # device-side macro-steps for all-greedy batches (see
         # decode_loop): K tokens per dispatch/transfer. 0/1 = per-step.
         self.turbo_steps = max(0, turbo_steps)
+        # ADAPTIVE K: a full-K loop makes a newly-arrived request wait
+        # up to K device steps before its prefill (or a freed slot) —
+        # a TTFT tax under load. K starts small, doubles per macro-step
+        # once the engine has been arrival-quiet for turbo_quiet_s, and
+        # snaps back to the floor whenever requests arrive or wait.
+        self.turbo_quiet_s = turbo_quiet_s
+        self.waiting_requests = 0  # hint set by the serving scheduler
+        self._turbo_k = min(8, self.turbo_steps) or self.turbo_steps
+        self._last_admit = 0.0
 
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
@@ -1439,6 +1450,7 @@ class InferenceEngine:
         free = self.free_slots()
         if not free:
             raise RuntimeError("no free slots")
+        self._last_admit = time.monotonic()  # arrival signal → small K
         # cap the generation budget by the cache, then keep as much
         # prompt tail as fits alongside it (never less than 1 token)
         gen.max_new_tokens = max(1, min(gen.max_new_tokens, self.max_seq - 2))
@@ -1732,6 +1744,25 @@ class InferenceEngine:
             # to repetition_penalty == 1.0, where seen has no effect
         return out
 
+    def _adaptive_turbo_cap(self) -> int:
+        """Current macro-step budget: the floor (8) while requests are
+        arriving/waiting, doubling toward ``turbo_steps`` once
+        arrival-quiet — so a saturated single-stream batch still gets
+        the full-K dispatch amortization, but a newly-arrived request
+        never waits a 128-step loop for its first token."""
+        if self.turbo_steps <= 1:
+            return self.turbo_steps
+        floor = min(8, self.turbo_steps)
+        busy = (
+            self.waiting_requests > 0
+            or (time.monotonic() - self._last_admit) < self.turbo_quiet_s
+        )
+        if busy:
+            self._turbo_k = floor
+        else:
+            self._turbo_k = min(self._turbo_k * 2, self.turbo_steps)
+        return self._turbo_k
+
     def _turbo_fn(self, steps: int):
         if steps not in self._turbo_fns:
             self._turbo_fns[steps] = jax.jit(
@@ -1752,7 +1783,9 @@ class InferenceEngine:
         # must not pay turbo_steps masked forward passes for one
         # token), bucketed to powers of two so the compile-cache holds
         # at most log2(turbo_steps) variants
-        needed = min(self.turbo_steps, max(self.remaining[i] for i in live))
+        needed = min(
+            self._adaptive_turbo_cap(), max(self.remaining[i] for i in live)
+        )
         steps = 1
         while steps < needed:
             steps *= 2

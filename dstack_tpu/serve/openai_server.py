@@ -142,6 +142,10 @@ class Scheduler:
         return any(t in text for t in req.gen.stop)
 
     async def _tick(self) -> None:
+        # adaptive-turbo hint: requests parked for a slot shrink the
+        # engine's device-side macro-step so they are not stuck behind
+        # a full-K decode loop (engine._adaptive_turbo_cap)
+        self.engine.waiting_requests = self.pending.qsize()
         # admit pending requests while slots are free (host bookkeeping
         # only — the prompt prefills chunk by chunk below)
         while not self.pending.empty() and self.engine.free_slots():

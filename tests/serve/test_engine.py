@@ -805,6 +805,51 @@ class TestKVQuant:
                             kv_quant="int8")
 
 
+class TestAdaptiveTurbo:
+    """Adaptive macro-step K: floor while requests arrive/wait,
+    exponential ramp to turbo_steps when arrival-quiet, snap back on
+    pressure — a new arrival must not wait a 128-step device loop."""
+
+    config = llama.LLAMA_TINY
+
+    def _engine(self, **kw):
+        params = llama.init_params(self.config, jax.random.key(0))
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("max_seq", 256)
+        kw.setdefault("spec_draft", 0)
+        kw.setdefault("turbo_steps", 64)
+        kw.setdefault("turbo_quiet_s", 0.0)  # quiet immediately
+        return InferenceEngine(self.config, params, **kw)
+
+    def test_ramp_and_snap_back(self):
+        eng = self._engine()
+        eng.add_request(list(range(1, 9)), GenParams(max_new_tokens=200))
+        eng._last_admit = 0.0  # pretend the admission was long ago
+        caps = [eng._adaptive_turbo_cap() for _ in range(5)]
+        assert caps == [16, 32, 64, 64, 64]
+        # pressure: a waiting request snaps K back to the floor
+        eng.waiting_requests = 1
+        assert eng._adaptive_turbo_cap() == 8
+        eng.waiting_requests = 0
+        assert eng._adaptive_turbo_cap() == 16  # ramps again
+
+    def test_fresh_arrival_holds_floor(self):
+        eng = self._engine(turbo_quiet_s=60.0)
+        eng.add_request(list(range(1, 9)), GenParams(max_new_tokens=200))
+        # the admission just happened → inside the quiet window
+        assert eng._adaptive_turbo_cap() == 8
+        assert eng._adaptive_turbo_cap() == 8
+
+    def test_turbo_step_emits_at_most_cap(self):
+        eng = self._engine()
+        slot, _ = eng.add_request(list(range(1, 9)), GenParams(max_new_tokens=200))
+        eng._last_admit = 0.0
+        out = eng.step()  # first turbo macro-step after quiet: K=16
+        assert 0 < len(out.get(slot, [])) <= 16
+        total = sum(len(v) for v in out.values())
+        assert total <= 16
+
+
 class TestExpertParallelServing:
     def test_ep_mesh_matches_single_device(self):
         """MoE serving over an ep mesh: experts shard over the expert
