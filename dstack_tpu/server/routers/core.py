@@ -382,6 +382,47 @@ async def list_instances(ctx: RequestContext):
     return [instance_row_to_model(r, ctx.param("project_name")) for r in rows]
 
 
+@project_router.post("/instances/get")
+async def get_instance(ctx: RequestContext, body: s.GetByNameRequest):
+    """Instance detail for the console: the instance itself, jobs that
+    ran on it, and its volume attachments — the data behind the
+    reference frontend's instance page."""
+    from dstack_tpu.server.services.instances import instance_row_to_model
+
+    db = ctx.state["db"]
+    row = await db.fetchone(
+        "SELECT * FROM instances WHERE project_id = ? AND name = ? AND deleted = 0",
+        (ctx.project["id"], body.name),
+    )
+    if row is None:
+        raise ResourceNotExistsError(f"instance {body.name} not found")
+    fleet_name = None
+    if row.get("fleet_id"):
+        fr = await db.get_by_id("fleets", row["fleet_id"])
+        fleet_name = fr["name"] if fr else None
+    jobs = await db.fetchall(
+        "SELECT job_name, run_name, job_num, status, termination_reason, "
+        "exit_status, submitted_at FROM jobs "
+        "WHERE instance_id = ? OR used_instance_id = ? "
+        "ORDER BY submitted_at DESC LIMIT 50",
+        (row["id"], row["id"]),
+    )
+    atts = await db.fetchall(
+        "SELECT va.attachment_data, v.name AS volume_name, "
+        "v.status AS volume_status "
+        "FROM volume_attachments va JOIN volumes v ON va.volume_id = v.id "
+        "WHERE va.instance_id = ?",
+        (row["id"],),
+    )
+    return {
+        "instance": instance_row_to_model(
+            row, ctx.param("project_name"), fleet_name
+        ).model_dump(mode="json"),
+        "jobs": [dict(j) for j in jobs],
+        "attachments": [dict(a) for a in atts],
+    }
+
+
 @project_router.post("/fleets/list")
 async def list_fleets(ctx: RequestContext):
     from dstack_tpu.server.services.fleets import list_fleets as _list

@@ -424,18 +424,64 @@ async function pageRunDetail(name) {
     refreshTimer = setTimeout(() => { if (currentRoute().arg === name) render(); }, 5000);
   }
 
-  // per-node jobs table (multi-host slices / multislice runs)
-  const jobRows = (run.jobs || []).map((j, idx) => {
-    const s = j.job_submissions?.slice(-1)[0];
+  // per-node jobs table (multi-host slices / multislice runs) with a
+  // submission-history drill-down per job (retries leave a trail)
+  const jobRows = (run.jobs || []).flatMap((j, idx) => {
+    const subs = j.job_submissions || [];
+    const s = subs.slice(-1)[0];
     const jp = s?.job_provisioning_data;
-    return h("tr", {},
+    const jobNum = j.job_spec?.job_num ?? idx;
+    const histId = `job-hist-${idx}`;
+    const rows = [h("tr", {},
       h("td", {}, j.job_spec?.job_name || `${name}-0-${idx}`),
-      h("td", {}, String(j.job_spec?.job_num ?? idx)),
+      h("td", {}, String(jobNum)),
       h("td", {}, statusBadge(s?.status || "unknown")),
       h("td", {}, jp?.internal_ip || jp?.hostname || "—"),
       h("td", {}, s?.termination_reason || "—"),
-    );
+      h("td", {}, s?.exit_status == null ? "—" : String(s.exit_status)),
+      h("td", {},
+        h("button", { onclick: () => {
+          const el = document.getElementById(histId);
+          if (el) el.style.display = el.style.display === "none" ? "" : "none";
+        } }, `${subs.length} submission${subs.length === 1 ? "" : "s"}`),
+        " ",
+        h("button", { onclick: () => { showJobLogs(jobNum); } }, "logs"),
+      ),
+    )];
+    rows.push(h("tr", { id: histId, style: "display:none" },
+      h("td", { colspan: "7" },
+        table(["#", "Status", "Reason", "Message", "Exit", "Submitted"],
+          subs.map((sub, sn) => h("tr", {},
+            h("td", {}, String(sn)),
+            h("td", {}, statusBadge(sub.status)),
+            h("td", {}, sub.termination_reason || "—"),
+            h("td", {}, sub.termination_reason_message || "—"),
+            h("td", {}, sub.exit_status == null ? "—" : String(sub.exit_status)),
+            h("td", {}, fmtDate(sub.submitted_at)),
+          )),
+          "no submissions"),
+      ),
+    ));
+    return rows;
   });
+
+  // per-job log view: re-poll the selected node's stream (multi-node
+  // runs interleave badly as one blob)
+  async function showJobLogs(jobNum) {
+    if (activeLogWs) { try { activeLogWs.close(); } catch (e) {} }
+    logsPre.textContent = `loading logs for job ${jobNum}…`;
+    let token = null, text = "";
+    try {
+      for (let i = 0; i < 50; i++) {
+        const batch = await papi("/logs/poll",
+          { run_name: name, job_num: jobNum, next_token: token, limit: 1000 });
+        if (!batch.logs.length) break;
+        token = batch.next_token;
+        text += batch.logs.map(decodeLogEvent).join("");
+      }
+      logsPre.textContent = text || "(no logs)";
+    } catch (e) { logsPre.textContent = "log fetch failed: " + e.message; }
+  }
 
   // hardware metrics: one sparkline tile per series (cpu/mem/TPU duty
   // cycle/HBM from the agent sampler), latest value as the stat number
@@ -474,9 +520,9 @@ async function pageRunDetail(name) {
       h("div", { class: "k" }, "Status message"), h("div", {}, run.status_message || "—"),
       h("div", { class: "k" }, "Service URL"), h("div", {}, run.service?.url || "—"),
     ),
-    jobRows.length > 1
+    jobRows.length
       ? h("div", {}, h("h1", {}, "Jobs"),
-          table(["Job", "Node", "Status", "Host", "Reason"], jobRows))
+          table(["Job", "Node", "Status", "Host", "Reason", "Exit", ""], jobRows))
       : null,
     h("h1", {}, "Hardware metrics"),
     metricsDiv,
@@ -602,6 +648,12 @@ async function pageModels() {
   );
 }
 
+function instanceResources(i) {
+  return i.instance_type?.resources?.tpu
+    ? `TPU ${i.instance_type.resources.tpu.version}-${i.instance_type.resources.tpu.chips}`
+    : (i.instance_type?.name || "—");
+}
+
 async function pageInstances() {
   const instances = await papi("/instances/list");
   return h("div", {},
@@ -609,13 +661,11 @@ async function pageInstances() {
     table(
       ["Name", "Status", "Backend", "Region", "Resources", "Price", "Created"],
       instances.map((i) => h("tr", {},
-        h("td", {}, i.name),
+        h("td", {}, h("a", { href: `#/instances/${i.name}` }, i.name)),
         h("td", {}, statusBadge(i.status)),
         h("td", {}, i.backend || "—"),
         h("td", {}, i.region || "—"),
-        h("td", {}, i.instance_type?.resources?.tpu
-          ? `TPU ${i.instance_type.resources.tpu.version}-${i.instance_type.resources.tpu.chips}`
-          : (i.instance_type?.name || "—")),
+        h("td", {}, instanceResources(i)),
         h("td", {}, `$${(i.price || 0).toFixed(2)}/h`),
         h("td", {}, fmtDate(i.created)),
       )),
@@ -623,8 +673,63 @@ async function pageInstances() {
   );
 }
 
+async function pageInstanceDetail(name) {
+  const detail = await papi("/instances/get", { name });
+  const inst = detail.instance;
+  const tpu = inst.instance_type?.resources?.tpu;
+  return h("div", {},
+    h("h1", { style: "display:flex;align-items:center;gap:8px" },
+      h("a", { href: "#/instances" }, "Instances"), " / ", name, " ",
+      statusBadge(inst.status)),
+    h("div", { class: "kv" },
+      h("div", { class: "k" }, "Backend"), h("div", {}, inst.backend || "—"),
+      h("div", { class: "k" }, "Fleet"),
+      h("div", {}, inst.fleet_name
+        ? h("a", { href: `#/fleets/${inst.fleet_name}` }, inst.fleet_name) : "—"),
+      h("div", { class: "k" }, "Region"),
+      h("div", {}, `${inst.region || "—"}${inst.availability_zone ? " / " + inst.availability_zone : ""}`),
+      h("div", { class: "k" }, "Resources"), h("div", {}, instanceResources(inst)),
+      h("div", { class: "k" }, "Topology"), h("div", {}, tpu?.topology || "—"),
+      h("div", { class: "k" }, "Host"), h("div", {}, inst.hostname || "—"),
+      h("div", { class: "k" }, "Price"), h("div", {}, `$${(inst.price || 0).toFixed(2)}/h`),
+      h("div", { class: "k" }, "Unreachable"), h("div", {}, inst.unreachable ? "YES" : "no"),
+      h("div", { class: "k" }, "Termination reason"),
+      h("div", {}, inst.termination_reason || "—"),
+      h("div", { class: "k" }, "Created"), h("div", {}, fmtDate(inst.created)),
+    ),
+    h("h1", {}, "Jobs on this instance"),
+    table(
+      ["Job", "Run", "Status", "Reason", "Exit", "Submitted"],
+      (detail.jobs || []).map((j) => h("tr", {},
+        h("td", {}, j.job_name),
+        h("td", {}, h("a", { href: `#/runs/${j.run_name}` }, j.run_name)),
+        h("td", {}, statusBadge(j.status)),
+        h("td", {}, j.termination_reason || "—"),
+        h("td", {}, j.exit_status == null ? "—" : String(j.exit_status)),
+        h("td", {}, fmtDate(j.submitted_at)),
+      )),
+      "No jobs have been placed on this instance",
+    ),
+    h("h1", {}, "Volume attachments"),
+    table(
+      ["Volume", "Volume status"],
+      (detail.attachments || []).map((a) => h("tr", {},
+        h("td", {}, h("a", { href: "#/volumes" }, a.volume_name)),
+        h("td", {}, statusBadge(a.volume_status)),
+      )),
+      "No volumes attached",
+    ),
+  );
+}
+
 async function pageVolumes() {
   const volumes = await papi("/volumes/list");
+  // resolve attachment instance ids → names once for the whole table
+  let instById = {};
+  try {
+    const instances = await papi("/instances/list");
+    instById = Object.fromEntries(instances.map((i) => [i.id, i.name]));
+  } catch (e) { /* attachments degrade to ids */ }
   const nameIn = h("input", { placeholder: "name" });
   const regionIn = h("input", { placeholder: "region (us-central1)" });
   const sizeIn = h("input", { placeholder: "size GB", type: "number", value: "100" });
@@ -643,13 +748,19 @@ async function pageVolumes() {
       } }, "Create volume"),
     ),
     table(
-      ["Name", "Status", "Backend", "Region", "Size", ""],
+      ["Name", "Status", "Backend", "Region", "Size", "Attached to", ""],
       volumes.map((v) => h("tr", {},
         h("td", {}, v.name),
         h("td", {}, statusBadge(v.status)),
         h("td", {}, v.configuration?.backend || "—"),
         h("td", {}, v.configuration?.region || "—"),
         h("td", {}, v.configuration?.size ? `${v.configuration.size}` : "—"),
+        h("td", {}, (v.attachments || []).length
+          ? (v.attachments || []).map((a, ai) => h("span", {},
+              ai ? ", " : "",
+              h("a", { href: `#/instances/${instById[a.instance_id] || ""}` },
+                instById[a.instance_id] || a.instance_id.slice(0, 8))))
+          : "—"),
         h("td", {}, h("button", { class: "danger", onclick: async () => {
           await papi("/volumes/delete", { names: [v.name] });
           toast(`Deleted volume ${v.name}`); render();
@@ -1022,6 +1133,7 @@ async function render() {
   try {
     if (page === "runs" && arg) content = await pageRunDetail(arg);
     else if (page === "fleets" && arg) content = await pageFleetDetail(arg);
+    else if (page === "instances" && arg) content = await pageInstanceDetail(arg);
     else content = await (ROUTES[page] || pageRuns)();
   } catch (e) {
     content = h("div", { class: "empty" }, "Error: " + e.message);

@@ -16,6 +16,17 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compile cache: CPU compiles dominate the suite on this
+# single-core image (a cold full run cannot finish in any reviewer's
+# patience budget; a warm one can). On by default for tests — disable
+# with DTPU_TEST_NO_COMPILE_CACHE=1. The cpu_aot_loader logs a noisy
+# machine-feature pseudo-mismatch (prefer-no-scatter/gather) on every
+# cache load even though compile and execute happen on this same
+# machine; those ERROR lines are suppressed ONLY when the cache is on.
+_use_compile_cache = os.environ.get("DTPU_TEST_NO_COMPILE_CACHE") != "1"
+if _use_compile_cache:
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
@@ -28,6 +39,14 @@ try:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if _use_compile_cache:
+        cache_dir = os.environ.get(
+            "DTPU_TEST_COMPILE_CACHE_DIR",
+            os.path.join(os.path.dirname(__file__), ".jax_compile_cache"),
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 except Exception:
     pass
 

@@ -585,3 +585,203 @@ class TestConsoleAdminLoop:
                 assert needle in js, needle
         finally:
             await client.close()
+
+
+class TestConsoleDetailPages:
+    """Round-4 console depth: instance detail page, volume attachment
+    state, per-job submission drill-down + per-job logs — the
+    highest-traffic pages of the reference frontend
+    (frontend/src/pages/)."""
+
+    async def _seeded(self, tmp_path):
+        """App + client with one finished local run (shared recipe)."""
+        from pathlib import Path
+
+        from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
+
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="dt-tok",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        body = {
+            "run_spec": {
+                "run_name": "dt-run",
+                "configuration": {"type": "task", "commands": ["echo dt"]},
+                "ssh_key_pub": "ssh-ed25519 AAAA t",
+            }
+        }
+        r = await client.post(
+            "/api/project/main/runs/apply", headers=_auth("dt-tok"), json=body
+        )
+        assert r.status == 200
+        for _ in range(120):
+            r = await client.post(
+                "/api/project/main/runs/get",
+                headers=_auth("dt-tok"),
+                json={"run_name": "dt-run"},
+            )
+            run = await r.json()
+            if run["status"] in ("done", "failed", "terminated"):
+                break
+            await asyncio.sleep(0.5)
+        assert run["status"] == "done"
+        return app, client, run
+
+    async def test_instance_get_returns_jobs_and_attachments(self, tmp_path):
+        app, client, _ = await self._seeded(tmp_path)
+        try:
+            r = await client.post(
+                "/api/project/main/instances/list",
+                headers=_auth("dt-tok"), json={},
+            )
+            instances = await r.json()
+            assert instances
+            name = instances[0]["name"]
+            r = await client.post(
+                "/api/project/main/instances/get",
+                headers=_auth("dt-tok"), json={"name": name},
+            )
+            assert r.status == 200, await r.text()
+            detail = await r.json()
+            inst = detail["instance"]
+            # the field paths pageInstanceDetail dereferences
+            for key in ("backend", "region", "price", "status", "created",
+                        "hostname", "fleet_name", "unreachable"):
+                assert key in inst, key
+            # the run's job was placed on this instance
+            jobs = detail["jobs"]
+            assert any(j["run_name"] == "dt-run" for j in jobs)
+            j = next(j for j in jobs if j["run_name"] == "dt-run")
+            for key in ("job_name", "status", "termination_reason",
+                        "exit_status", "submitted_at"):
+                assert key in j, key
+            assert detail["attachments"] == []
+        finally:
+            await client.close()
+
+    async def test_instance_get_unknown_is_404(self, tmp_path):
+        app, client, _ = await self._seeded(tmp_path)
+        try:
+            r = await client.post(
+                "/api/project/main/instances/get",
+                headers=_auth("dt-tok"), json={"name": "no-such-instance"},
+            )
+            assert r.status == 404
+        finally:
+            await client.close()
+
+    async def test_instance_get_reports_volume_attachment(self, tmp_path):
+        """Attachment state: a volume_attachments row surfaces on the
+        instance detail with the volume's name + status."""
+        app, client, _ = await self._seeded(tmp_path)
+        try:
+            db = app["state"]["db"]
+            inst = await db.fetchone("SELECT * FROM instances LIMIT 1")
+            await db.insert("volumes", {
+                "id": "vol-ui-1",
+                "project_id": inst["project_id"],
+                "name": "data-vol",
+                "status": "active",
+                "external": 0,
+                "deleted": 0,
+                "configuration":
+                    '{"type": "volume", "name": "data-vol", "size": 100}',
+                "created_at": "2026-07-31T00:00:00",
+                "last_processed_at": "2026-07-31T00:00:00",
+            })
+            await db.insert("volume_attachments", {
+                "id": "att-ui-1",
+                "volume_id": "vol-ui-1",
+                "instance_id": inst["id"],
+                "attachment_data": None,
+            })
+            r = await client.post(
+                "/api/project/main/instances/get",
+                headers=_auth("dt-tok"), json={"name": inst["name"]},
+            )
+            detail = await r.json()
+            assert detail["attachments"] == [{
+                "attachment_data": None,
+                "volume_name": "data-vol",
+                "volume_status": "active",
+            }]
+            # the volumes LIST carries the attachment for the volumes
+            # page's "Attached to" column
+            r = await client.post(
+                "/api/project/main/volumes/list",
+                headers=_auth("dt-tok"), json={},
+            )
+            vols = await r.json()
+            v = next(v for v in vols if v["name"] == "data-vol")
+            assert len(v["attachments"]) == 1
+            att = v["attachments"][0]
+            assert att["volume_id"] == "vol-ui-1"
+            assert att["instance_id"] == inst["id"]
+        finally:
+            await client.close()
+
+    async def test_run_detail_submission_drilldown_fields(self, tmp_path):
+        """runs/get exposes the per-submission fields the drill-down
+        table renders (status / reason / message / exit / submitted)."""
+        app, client, run = await self._seeded(tmp_path)
+        try:
+            sub = run["jobs"][0]["job_submissions"][-1]
+            for key in ("status", "termination_reason",
+                        "termination_reason_message", "exit_status",
+                        "submitted_at"):
+                assert key in sub, key
+            assert sub["exit_status"] == 0
+        finally:
+            await client.close()
+
+    async def test_job_logs_poll_by_job_num(self, tmp_path):
+        app, client, _ = await self._seeded(tmp_path)
+        try:
+            r = await client.post(
+                "/api/project/main/logs/poll",
+                headers=_auth("dt-tok"),
+                json={"run_name": "dt-run", "job_num": 0, "limit": 100},
+            )
+            assert r.status == 200
+            logs = await r.json()
+            decoded = [
+                base64.b64decode(ev["message"]).decode() for ev in logs["logs"]
+            ]
+            assert any("dt" in t for t in decoded)
+            # a job_num that never existed is a clean 404, not a 500
+            r = await client.post(
+                "/api/project/main/logs/poll",
+                headers=_auth("dt-tok"),
+                json={"run_name": "dt-run", "job_num": 7, "limit": 100},
+            )
+            assert r.status == 404
+        finally:
+            await client.close()
+
+    async def test_console_js_has_detail_surfaces(self):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="x", with_background=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/statics/app.js")
+            js = await r.text()
+            for needle in (
+                # instance detail page + routing
+                "pageInstanceDetail", "instances/get",
+                "Jobs on this instance", "Volume attachments",
+                # volumes page attachment column
+                "Attached to", "instById",
+                # run-detail drill-down + per-job logs
+                "showJobLogs", "submission", "job-hist-",
+            ):
+                assert needle in js, needle
+        finally:
+            await client.close()
