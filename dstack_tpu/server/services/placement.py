@@ -38,6 +38,29 @@ async def prepare_placement_group(
     backend has no placement-group concept."""
     if not isinstance(compute, ComputeWithPlacementGroupSupport):
         return None
+    # get-or-create must be atomic per fleet: the instance reconciler
+    # provisions a BATCH of instances concurrently, and two siblings of
+    # one cluster fleet racing here would each create their own group —
+    # defeating the point of placement
+    from dstack_tpu.server.services.locking import get_locker
+
+    async with get_locker().lock_ctx(
+        "placement_group_prepare", [fleet_id or fleet_name]
+    ):
+        return await _prepare_locked(
+            db, project_row, fleet_id, fleet_name, compute, backend, region
+        )
+
+
+async def _prepare_locked(
+    db: Database,
+    project_row: dict,
+    fleet_id: Optional[str],
+    fleet_name: str,
+    compute,
+    backend: BackendType,
+    region: str,
+) -> Optional[str]:
     # one live group per (fleet, region); fleet_deleted rows are doomed —
     # a recreated same-name fleet must NOT reuse them (the reconciler is
     # about to delete their cloud resource). Region filtering happens in

@@ -51,6 +51,35 @@ except Exception:
     pass
 
 
+# ---- quick tier ----
+# `pytest -m "not heavy" -q` is the smoke pass: the full control plane
+# (server/agent/api/core) plus one representative per compute/serve
+# area. Everything else under the JAX-compile-heavy trees is marked
+# `heavy` at collection time. The FULL suite stays the default.
+_QUICK_KEEP = (
+    # one forward/backward + one sharded train step
+    "test_llama.py::TestForward",
+    "test_llama.py::TestTraining::test_loss_decreases_sharded",
+    # one engine decode + one KV-quant structural check
+    "test_engine.py::TestDecode",
+    "test_engine.py::TestKVQuant::test_cache_layout",
+    "test_engine.py::TestAdaptiveTurbo::test_ramp_and_snap_back",
+    # one parallelism identity (ring attention vs local)
+    "test_parallel.py::TestRingAttention::test_matches_local",
+    # serving HTTP surface
+    "test_openai_server.py::TestOpenAIServer::test_chat_completions",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        p = str(item.fspath)
+        if ("/tests/compute/" in p or "/tests/serve/" in p) and not any(
+            k in item.nodeid for k in _QUICK_KEEP
+        ):
+            item.add_marker(pytest.mark.heavy)
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests without pytest-asyncio (not in this image):

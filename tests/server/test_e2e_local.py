@@ -19,7 +19,9 @@ def _auth(token: str) -> dict:
     return {"Authorization": f"Bearer {token}"}
 
 
-async def _wait_run_status(client, token, run_name, target, timeout=60.0):
+async def _wait_run_status(client, token, run_name, target, timeout=120.0):
+    # generous default: on the single-core CI image a full-suite run
+    # contends with XLA compiles and a 60s budget flakes
     deadline = asyncio.get_event_loop().time() + timeout
     status = None
     while asyncio.get_event_loop().time() < deadline:
