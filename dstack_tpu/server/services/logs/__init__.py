@@ -150,9 +150,22 @@ def init_log_storage():
             logging.getLogger("dstack_tpu.server.logs").warning(
                 "DTPU_LOG_STORAGE=gcp unavailable (%s); using file storage", e
             )
+    elif kind == "gcs":
+        from dstack_tpu.server.services.logs.gcs import GCSLogStorage
+
+        try:
+            _storage = GCSLogStorage()
+            return _storage
+        except RuntimeError as e:  # google-cloud-storage not installed
+            import logging
+
+            logging.getLogger("dstack_tpu.server.logs").warning(
+                "DTPU_LOG_STORAGE=gcs unavailable (%s); using file storage", e
+            )
     elif kind != "file":
         raise ValueError(
-            f"unknown DTPU_LOG_STORAGE={kind!r} (expected 'file' or 'gcp')"
+            f"unknown DTPU_LOG_STORAGE={kind!r} "
+            "(expected 'file', 'gcp' or 'gcs')"
         )
     _storage = FileLogStorage()
     return _storage

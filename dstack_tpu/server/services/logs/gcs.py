@@ -1,0 +1,133 @@
+"""GCS object-store log archive tier.
+
+Parity: the reference's second MANAGED log tier is CloudWatch
+(`server/services/logs/aws.py:317` — put_log_events into streams).
+The TPU-native analog is a GCS bucket: each ``write_logs`` flush
+becomes one immutable JSONL object under the job's prefix, named by
+zero-padded epoch-micros so a lexicographic listing is time order
+(objects are append-only chunks exactly like CloudWatch events
+batches; multi-replica servers never contend — names are unique).
+
+Layout::
+
+    gs://<bucket>/<prefix>/<project>/<run>/<job>.<kind>/
+        00001753970000000000-3f2a9c1b.jsonl
+        00001753970004200000-9e01d77a.jsonl
+
+Pagination: ``next_token`` is ``"<object name>|<line offset>"`` — the
+poll resumes mid-chunk, so bursts sharing a timestamp are never
+dropped (same contract as FileLogStorage's line-offset token).
+
+Selected via ``DTPU_LOG_STORAGE=gcs`` + ``DTPU_GCS_LOGS_BUCKET``;
+requires google-cloud-storage unless a client is injected (tests use
+an in-memory fake).
+"""
+
+import json
+import time
+import uuid
+from datetime import datetime
+from typing import Optional
+
+from dstack_tpu.core.models.logs import JobSubmissionLogs, LogEvent
+from dstack_tpu.server import settings
+
+
+class GCSLogStorage:
+    def __init__(
+        self,
+        bucket: Optional[str] = None,
+        prefix: str = "logs",
+        client=None,
+    ):
+        bucket = bucket or settings.GCS_LOGS_BUCKET
+        if not bucket:
+            raise RuntimeError(
+                "DTPU_GCS_LOGS_BUCKET is required for DTPU_LOG_STORAGE=gcs"
+            )
+        if client is None:
+            try:
+                from google.cloud import storage  # type: ignore
+            except ImportError as e:
+                raise RuntimeError(
+                    "google-cloud-storage is not installed"
+                ) from e
+            client = storage.Client()
+        self._bucket = client.bucket(bucket)
+        self._prefix = prefix.strip("/")
+
+    def _dir(self, project_name: str, run_name: str, job_name: str, diag: bool) -> str:
+        from dstack_tpu.server.services.logs import _safe
+
+        kind = "runner" if diag else "job"
+        return (
+            f"{self._prefix}/{_safe(project_name)}/{_safe(run_name)}/"
+            f"{_safe(job_name)}.{kind}/"
+        )
+
+    def write_logs(
+        self,
+        project_name: str,
+        run_name: str,
+        job_name: str,
+        events: list[LogEvent],
+        diagnostics: bool = False,
+    ) -> None:
+        if not events:
+            return
+        d = self._dir(project_name, run_name, job_name, diagnostics)
+        # epoch-micros zero-padded to 20 digits: listing order == time
+        # order; uuid suffix de-dupes concurrent flushes
+        name = f"{d}{int(time.time() * 1e6):020d}-{uuid.uuid4().hex[:8]}.jsonl"
+        body = "".join(ev.model_dump_json() + "\n" for ev in events)
+        self._bucket.blob(name).upload_from_string(
+            body, content_type="application/jsonl"
+        )
+
+    def poll_logs(
+        self,
+        project_name: str,
+        run_name: str,
+        job_name: str,
+        start_time: Optional[datetime] = None,
+        limit: int = 1000,
+        diagnostics: bool = False,
+        next_token: Optional[str] = None,
+    ) -> JobSubmissionLogs:
+        from dstack_tpu.server.services.logs import _aware
+
+        d = self._dir(project_name, run_name, job_name, diagnostics)
+        blobs = sorted(
+            self._bucket.list_blobs(prefix=d), key=lambda b: b.name
+        )
+        start_time = _aware(start_time)
+        resume_name, resume_line = "", 0
+        if next_token:
+            resume_name, _, off = next_token.partition("|")
+            resume_line = int(off or 0)
+        events: list[LogEvent] = []
+        tok_name, tok_line = resume_name, resume_line
+        for blob in blobs:
+            if blob.name < resume_name:
+                continue
+            skip = resume_line if blob.name == resume_name else 0
+            lines = blob.download_as_bytes().decode().splitlines()
+            for i, line in enumerate(lines):
+                if i < skip:
+                    continue
+                tok_name, tok_line = blob.name, i + 1
+                try:
+                    ev = LogEvent.model_validate(json.loads(line))
+                except Exception:
+                    continue
+                if start_time is not None and _aware(ev.timestamp) <= start_time:
+                    continue
+                events.append(ev)
+                if len(events) >= limit:
+                    return JobSubmissionLogs(
+                        logs=events, next_token=f"{tok_name}|{tok_line}"
+                    )
+        return JobSubmissionLogs(
+            logs=events,
+            next_token=f"{tok_name}|{tok_line}" if tok_name else None,
+        )

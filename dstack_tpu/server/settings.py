@@ -38,7 +38,9 @@ DEFAULT_PROJECT_NAME = os.getenv("DTPU_DEFAULT_PROJECT", "main")
 ENCRYPTION_KEYS = [k for k in os.getenv("DTPU_ENCRYPTION_KEYS", "").split(",") if k]
 
 # Log storage: "file" (default) | "gcp" (gated on google-cloud-logging)
-LOG_STORAGE = os.getenv("DTPU_LOG_STORAGE", "file")
+LOG_STORAGE = os.getenv("DTPU_LOG_STORAGE", "file")  # file | gcp | gcs
+# GCS archive tier (CloudWatch analog): bucket for DTPU_LOG_STORAGE=gcs
+GCS_LOGS_BUCKET = os.getenv("DTPU_GCS_LOGS_BUCKET", "")
 LOG_DIR = Path(os.getenv("DTPU_LOG_DIR", str(SERVER_DIR_PATH / "logs"))).expanduser()
 
 ENABLE_PROMETHEUS_METRICS = _env_bool("DTPU_ENABLE_PROMETHEUS_METRICS", True)
