@@ -530,7 +530,8 @@ class Client {
 
   static Response request_unix(const std::string& socket_path,
                                const std::string& method, const std::string& target,
-                               const std::string& body = "") {
+                               const std::string& body = "",
+                               const std::string& extra_headers = "") {
     int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
     sockaddr_un addr{};
     addr.sun_family = AF_UNIX;
@@ -539,7 +540,7 @@ class Client {
       ::close(fd);
       return Response{599, "text/plain", "connect failed"};
     }
-    Response r = roundtrip(fd, "docker", method, target, body);
+    Response r = roundtrip(fd, "docker", method, target, body, extra_headers);
     ::close(fd);
     return r;
   }

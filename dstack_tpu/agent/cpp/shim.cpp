@@ -174,6 +174,40 @@ bool is_our_runner(pid_t pid, const std::string& id) {
          cmd.find("/" + id) != std::string::npos;
 }
 
+// standard base64 (no wrapping) — registry auth header + wrapping
+// user-controlled ssh keys so they never meet shell quoting
+std::string b64encode(const std::string& in) {
+  static const char* tbl =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+  std::string out;
+  out.reserve((in.size() + 2) / 3 * 4);
+  size_t i = 0;
+  while (i + 2 < in.size()) {
+    unsigned v = (static_cast<unsigned char>(in[i]) << 16) |
+                 (static_cast<unsigned char>(in[i + 1]) << 8) |
+                 static_cast<unsigned char>(in[i + 2]);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += tbl[v & 63];
+    i += 3;
+  }
+  if (i + 1 == in.size()) {
+    unsigned v = static_cast<unsigned char>(in[i]) << 16;
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += "==";
+  } else if (i + 2 == in.size()) {
+    unsigned v = (static_cast<unsigned char>(in[i]) << 16) |
+                 (static_cast<unsigned char>(in[i + 1]) << 8);
+    out += tbl[(v >> 18) & 63];
+    out += tbl[(v >> 12) & 63];
+    out += tbl[(v >> 6) & 63];
+    out += '=';
+  }
+  return out;
+}
+
 // kernel-chosen ephemeral port (two shims on one host racing a
 // deterministic counter collide; the kernel never hands out a bound
 // port). 0 on failure — the caller falls back to its counter.
@@ -604,8 +638,19 @@ class Shim {
   void start_docker(const std::string& id, const Value& req,
                     const std::string& image, int runner_port) {
     set_status(id, TaskStatus::Pulling);
+    // private registry auth rides the X-Registry-Auth header
+    // (reference docker.go pulls with RegistryAuth; the header value is
+    // base64 of the docker AuthConfig JSON)
+    std::string auth_hdr;
+    if (!req["registry_username"].as_string().empty()) {
+      Value auth{Object{}};
+      auth.set("username", req["registry_username"].as_string());
+      auth.set("password", req["registry_password"].as_string());
+      auth_hdr = "X-Registry-Auth: " + b64encode(auth.dump()) + "\r\n";
+    }
     auto pull = dtpu::http::Client::request_unix(
-        kDockerSock, "POST", "/images/create?fromImage=" + image);
+        kDockerSock, "POST", "/images/create?fromImage=" + image, "",
+        auth_hdr);
     if (pull.status >= 400) {
       fail_task(id, "image pull failed: " + pull.body.substr(0, 200));
       return;
@@ -621,11 +666,34 @@ class Shim {
     if (!req["pjrt_device"].as_string().empty())
       env.push_back("PJRT_DEVICE=" + req["pjrt_device"].as_string());
     config.set("Env", std::move(env));
+    std::string runner_cmd = "tpu-runner --port " +
+                             std::to_string(runner_port) +
+                             " --home /root/.dtpu";
+    std::string entry = runner_cmd;
+    if (!req["ssh_authorized_keys"].as_array().empty()) {
+      // reference docker.go:884-910: authorize keys + best-effort sshd
+      // so attach / inter-node ssh can reach the container; images
+      // without sshd still run the job. Keys are base64-wrapped: they
+      // are user-controlled strings and must not meet shell quoting.
+      std::string keys;
+      for (const auto& k : req["ssh_authorized_keys"].as_array())
+        keys += k.as_string() + "\n";
+      int ssh_port = static_cast<int>(req["ssh_port"].as_int(10022));
+      entry =
+          "mkdir -p /root/.ssh && chmod 700 /root/.ssh && "
+          "echo " + b64encode(keys) + " | base64 -d >> "
+          "/root/.ssh/authorized_keys && "
+          "chmod 600 /root/.ssh/authorized_keys && "
+          "if command -v sshd >/dev/null 2>&1; then "
+          "mkdir -p /run/sshd; ssh-keygen -A >/dev/null 2>&1; "
+          "\"$(command -v sshd)\" -p " + std::to_string(ssh_port) +
+          " -o PermitRootLogin=yes -o PasswordAuthentication=no; fi; " +
+          runner_cmd;
+    }
     Value cmd{Array{}};
     cmd.push_back("/bin/sh");
     cmd.push_back("-c");
-    cmd.push_back("tpu-runner --port " + std::to_string(runner_port) +
-                  " --home /root/.dtpu");
+    cmd.push_back(entry);
     config.set("Cmd", std::move(cmd));
     Value host_config{Object{}};
     host_config.set("Privileged", req["privileged"].as_bool());
