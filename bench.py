@@ -255,13 +255,21 @@ def main() -> None:
             if time.monotonic() >= deadline:
                 break
     if result is None:
+        import glob
+
+        evidence = sorted(
+            glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "BENCH_TPU_r*_evidence.json"))
+        )
+        ev_note = (
+            f" Last TPU evidence: {os.path.basename(evidence[-1])}"
+            if evidence else ""
+        )
         note = (
             "TPU backend unreachable or bench died "
             f"({'; '.join(attempt_notes)}); waited up to "
             f"{budget_s:.0f}s with retries. CPU fallback measurement "
-            "— not a TPU number. Last TPU evidence: "
-            "BENCH_TPU_r03_evidence.json (0.525-0.530 MFU train, "
-            "1348-1408 tok/s serving decode)"
+            f"— not a TPU number.{ev_note}"
         )
         try:
             import jax
