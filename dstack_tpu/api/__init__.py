@@ -194,6 +194,7 @@ class RunCollection:
         diagnose: bool = False,
         on_status=None,
         poll_interval: float = 2.0,
+        job_num: int = 0,
     ) -> Iterator[str]:
         """Yield decoded log text; with ``follow`` streams live over the
         server's ``/logs_ws`` websocket when a job is running (reference
@@ -202,7 +203,9 @@ class RunCollection:
         the tail after the run finishes). ``on_status`` is an optional
         callback invoked with the Run on status transitions — used by
         the CLI to interleave status lines."""
-        if follow and not diagnose:
+        if follow and not diagnose and job_num == 0:
+            # the ws stream follows the master job; node selection
+            # rides the REST poll path
             streamed = yield from self._ws_logs(run_name, on_status)
             if streamed:
                 return
@@ -210,7 +213,8 @@ class RunCollection:
         finished_seen = False
         while True:
             batch = self._c.api.poll_logs(
-                self._c.project, run_name, next_token=token, diagnose=diagnose
+                self._c.project, run_name, next_token=token,
+                diagnose=diagnose, job_num=job_num,
             )
             token = batch.next_token or token
             for ev in batch.logs:

@@ -138,6 +138,7 @@ class APIClient:
         next_token: Optional[str] = None,
         diagnose: bool = False,
         limit: int = 1000,
+        job_num: int = 0,
     ) -> JobSubmissionLogs:
         return JobSubmissionLogs.model_validate(
             self._post(
@@ -148,6 +149,7 @@ class APIClient:
                     "next_token": next_token,
                     "diagnose": diagnose,
                     "limit": limit,
+                    "job_num": job_num,
                 },
             )
         )

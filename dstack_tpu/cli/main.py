@@ -266,11 +266,17 @@ def ps(project, show_all) -> None:
 @click.option("--project", default=None)
 @click.option("-d", "--diagnose", is_flag=True, help="show runner diagnostics logs")
 @click.option("-f", "--follow", is_flag=True)
-def logs(run_name, project, diagnose, follow) -> None:
+@click.option(
+    "--job", "job_num", type=int, default=0, show_default=True,
+    help="node to read on multi-node runs (job_num)",
+)
+def logs(run_name, project, diagnose, follow, job_num) -> None:
     """Print a run's logs."""
     client = _client(project)
     try:
-        for text in client.runs.logs(run_name, follow=follow, diagnose=diagnose):
+        for text in client.runs.logs(
+            run_name, follow=follow, diagnose=diagnose, job_num=job_num
+        ):
             sys.stdout.write(text)
         sys.stdout.flush()
     except DstackTPUError as e:

@@ -26,3 +26,11 @@ def test_help_runs_clean():
 def test_version():
     r = CliRunner().invoke(cli, ["--version"])
     assert r.exit_code == 0 and "dtpu" in r.output
+
+
+def test_logs_job_option():
+    """Multi-node runs: `dtpu logs --job N` selects the node's stream
+    (the per-job analog of the console's log selector)."""
+    r = CliRunner().invoke(cli, ["logs", "--help"])
+    assert r.exit_code == 0
+    assert "--job" in r.output and "job_num" in r.output.replace("-", "_")
