@@ -654,6 +654,34 @@ def build_app(
                 {"detail": "tool_choice supports 'auto' and 'none' only"},
                 status=400,
             )
+        rf = payload.get("response_format")
+        if rf is not None:
+            kind = rf.get("type") if isinstance(rf, dict) else None
+            if kind == "json_schema":
+                # schema enforcement needs grammar-constrained decoding
+                # — refuse loudly rather than return unconstrained text
+                return web.json_response(
+                    {"detail": "response_format 'json_schema' is not "
+                               "supported (no constrained decoding); "
+                               "'json_object' and 'text' are"},
+                    status=400,
+                )
+            if kind not in (None, "text", "json_object"):
+                return web.json_response(
+                    {"detail": "response_format.type must be 'text' or "
+                               "'json_object'"},
+                    status=400,
+                )
+            if kind == "json_object":
+                # best-effort JSON mode: steer via an instruction the
+                # template renders as the LAST system turn (the same
+                # mechanism TGI/older vLLM used pre-grammar); output is
+                # NOT validated — documented in docs/guides/serving.md
+                messages = list(messages) + [{
+                    "role": "system",
+                    "content": "Respond ONLY with a valid JSON object. "
+                               "No prose, no markdown fences.",
+                }]
         try:
             prompt = render_chat(
                 messages, chat_template or DEFAULT_CHAT_TEMPLATE, tools=tools

@@ -668,6 +668,76 @@ class TestEmbeddings:
             await client.close()
 
 
+class TestResponseFormat:
+    """OpenAI `response_format`: json_object is best-effort steering
+    (system-turn instruction), json_schema refuses loudly (no
+    constrained decoding), unknown types are 400s — never silently
+    ignored."""
+
+    async def _client(self):
+        config = llama.LLAMA_TINY
+        params = jax.device_put(llama.init_params(config, jax.random.key(0)))
+        engine = InferenceEngine(config, params, max_batch=2, max_seq=64)
+        app = build_app(engine, ByteTokenizer(), "tiny")
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    async def test_json_object_accepted(self):
+        client = await self._client()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "response_format": {"type": "json_object"},
+                "max_tokens": 4,
+            })
+            assert r.status == 200, await r.text()
+            body = await r.json()
+            assert body["choices"][0]["message"]["role"] == "assistant"
+        finally:
+            await client.close()
+
+    async def test_json_schema_refused(self):
+        client = await self._client()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "response_format": {
+                    "type": "json_schema",
+                    "json_schema": {"name": "x", "schema": {}},
+                },
+                "max_tokens": 4,
+            })
+            assert r.status == 400
+            assert "json_schema" in (await r.json())["detail"]
+        finally:
+            await client.close()
+
+    async def test_unknown_type_rejected(self):
+        client = await self._client()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "response_format": {"type": "xml"},
+                "max_tokens": 4,
+            })
+            assert r.status == 400
+        finally:
+            await client.close()
+
+    async def test_text_type_passthrough(self):
+        client = await self._client()
+        try:
+            r = await client.post("/v1/chat/completions", json={
+                "messages": [{"role": "user", "content": "hi"}],
+                "response_format": {"type": "text"},
+                "max_tokens": 4,
+            })
+            assert r.status == 200
+        finally:
+            await client.close()
+
+
 class TestToolCalls:
     def test_parse_hermes_format(self):
         from dstack_tpu.serve.openai_server import _parse_tool_calls
