@@ -36,11 +36,20 @@ def run_bench(
     from dstack_tpu.serve.engine import GenParams, InferenceEngine
 
     config = llama.CONFIGS[model]
-    params = llama.init_params(config, jax.random.key(0))
     if quantize == "int8":
+        # init + quantize on the HOST: a bf16 8B tree (16 GB) cannot
+        # coexist with its int8 copy inside a v5e's 16 GiB HBM, so the
+        # accelerator only ever sees the quantized tree (this is also
+        # the real serving path: checkpoints quantize host-side in
+        # convert_hf before device_put)
         from dstack_tpu.models.quant import quantize_tree
 
-        params = quantize_tree(params, config)
+        with jax.default_device(jax.devices("cpu")[0]):
+            params = llama.init_params(config, jax.random.key(0))
+            params = quantize_tree(params, config)
+        params = jax.device_put(params)
+    else:
+        params = llama.init_params(config, jax.random.key(0))
     eng = InferenceEngine(
         config, params, max_batch=batch, max_seq=max_seq,
         spec_draft=spec_draft, turbo_steps=turbo_steps, kv_quant=kv_quant,
