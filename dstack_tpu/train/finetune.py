@@ -289,10 +289,39 @@ def main(argv=None) -> int:
     tokens_per_step = args.batch * args.seq_len
     first_step_at = None
     t_window = time.perf_counter()
+
+    # Spot-interruption safety: the shim forwards GCP's preemption
+    # notice as SIGTERM with a ~25s grace budget (agent
+    # INTERRUPTION_STOP_TIMEOUT). Catch it, finish the current step,
+    # save a final checkpoint, and exit 0 — the server's retry policy
+    # resubmits and the run resumes from this step instead of losing
+    # the window since the last periodic save.
+    import signal as _signal
+
+    interrupted = {"flag": False}
+
+    def _on_sigterm(signum, frame):
+        interrupted["flag"] = True
+        print("SIGTERM: checkpointing before exit", flush=True)
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # non-main thread (tests drive main() directly)
+
     # profile 3 steady-state steps: skip compile + warmup noise
     prof_start = start_step + min(2, max(args.steps - start_step - 3, 0))
     prof_stop = prof_start + min(3, args.steps - start_step)
     for i in range(start_step, args.steps):
+        if interrupted["flag"]:
+            if checkpointer is not None:
+                checkpointer.save(i, state)
+                checkpointer.close()
+                print(
+                    f"interrupted: checkpoint saved at step {i}; exiting",
+                    flush=True,
+                )
+            return 0
         if args.profile_dir and i == prof_start:
             jax.profiler.start_trace(args.profile_dir)
         batch = next_batch(i)
