@@ -174,38 +174,25 @@ bool is_our_runner(pid_t pid, const std::string& id) {
          cmd.find("/" + id) != std::string::npos;
 }
 
-// standard base64 (no wrapping) — registry auth header + wrapping
-// user-controlled ssh keys so they never meet shell quoting
+// base64 via the shared http.hpp encoder (also used by the websocket
+// accept key) — registry auth header + wrapping user-controlled ssh
+// keys so they never meet shell quoting
 std::string b64encode(const std::string& in) {
-  static const char* tbl =
-      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
-  std::string out;
-  out.reserve((in.size() + 2) / 3 * 4);
-  size_t i = 0;
-  while (i + 2 < in.size()) {
-    unsigned v = (static_cast<unsigned char>(in[i]) << 16) |
-                 (static_cast<unsigned char>(in[i + 1]) << 8) |
-                 static_cast<unsigned char>(in[i + 2]);
-    out += tbl[(v >> 18) & 63];
-    out += tbl[(v >> 12) & 63];
-    out += tbl[(v >> 6) & 63];
-    out += tbl[v & 63];
-    i += 3;
-  }
-  if (i + 1 == in.size()) {
-    unsigned v = static_cast<unsigned char>(in[i]) << 16;
-    out += tbl[(v >> 18) & 63];
-    out += tbl[(v >> 12) & 63];
-    out += "==";
-  } else if (i + 2 == in.size()) {
-    unsigned v = (static_cast<unsigned char>(in[i]) << 16) |
-                 (static_cast<unsigned char>(in[i + 1]) << 8);
-    out += tbl[(v >> 18) & 63];
-    out += tbl[(v >> 12) & 63];
-    out += tbl[(v >> 6) & 63];
-    out += '=';
-  }
-  return out;
+  return dtpu::http::ws::b64(
+      reinterpret_cast<const unsigned char*>(in.data()), in.size());
+}
+
+// Task ids become path components under base_dir (task home, pid
+// file) and get recursively DELETED on remove — a traversal id like
+// "../../home" must never reach the filesystem. Server-issued ids are
+// UUIDs; anything else is rejected at submit.
+bool id_safe(const std::string& id) {
+  if (id.empty() || id.size() > 128 || id[0] == '.') return false;
+  for (char c : id)
+    if (!isalnum(static_cast<unsigned char>(c)) && c != '-' && c != '_' &&
+        c != '.')
+      return false;
+  return true;
 }
 
 // kernel-chosen ephemeral port (two shims on one host racing a
@@ -252,6 +239,10 @@ class Shim {
       return Value(nullptr);
     }
     std::string id = req["id"].as_string();
+    if (!id_safe(id)) {
+      error = "task id contains unsafe characters";
+      return Value(nullptr);
+    }
     if (tasks_.count(id)) {
       error = "task exists";
       return Value(nullptr);
@@ -357,10 +348,11 @@ class Shim {
     if (use_docker_ && !container.empty() && container.rfind("proc-", 0) != 0) {
       dtpu::http::Client::request_unix(kDockerSock, "DELETE",
                                        "/containers/" + container + "?force=true");
-    } else {
+    } else if (id_safe(id)) {
       // drop the task home incl. its pid file, or a restarted shim
-      // would resurrect the removed task from it (syscall delete: no
-      // shell, so arbitrary ids need no quoting/path_safe gate)
+      // would resurrect the removed task from it. id_safe is enforced
+      // at submit AND re-checked here (defense in depth: a recursive
+      // delete must never see a traversal component)
       rm_rf(base_dir_ + "/" + id);
     }
     return true;

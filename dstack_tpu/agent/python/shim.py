@@ -426,6 +426,16 @@ class Shim:
             return s.getsockname()[1]
 
     async def submit(self, req: schemas.TaskSubmitRequest) -> Task:
+        # ids become path components under base_dir (task home, pid
+        # file) and are recursively deleted on remove — reject anything
+        # that could traverse. Server-issued ids are UUIDs.
+        if (
+            not req.id
+            or len(req.id) > 128
+            or req.id.startswith(".")
+            or not all(c.isalnum() or c in "-_." for c in req.id)
+        ):
+            raise ValueError("task id contains unsafe characters")
         if req.id in self.tasks:
             raise ValueError(f"task {req.id} exists")
         if isinstance(self.runtime, ProcessRuntime):

@@ -8,6 +8,9 @@ const state = {
   project: localStorage.getItem("dtpu_project") || "main",
   projects: [],
   user: null,
+  // run-detail per-job log selection, keyed by run name — survives the
+  // page's 5s auto-refresh re-render (null/undefined = job 0 stream)
+  jobLogSel: {},
 };
 
 async function api(path, body) {
@@ -415,7 +418,12 @@ async function pageRunDetail(name) {
     }
     logsPre.textContent = text || "(no logs)";
   }
-  if (run.status === "running") followWs();
+  const selectedJob = state.jobLogSel[name];
+  if (selectedJob != null) {
+    // a node was explicitly selected: keep showing ITS stream across
+    // auto-refresh renders instead of snapping back to job 0's ws
+    showJobLogs(selectedJob);
+  } else if (run.status === "running") followWs();
   else pollFallback();
 
   // auto-refresh status while the run is active (render() closes the
@@ -466,8 +474,10 @@ async function pageRunDetail(name) {
   });
 
   // per-job log view: re-poll the selected node's stream (multi-node
-  // runs interleave badly as one blob)
+  // runs interleave badly as one blob); remembered per run so the
+  // auto-refresh re-render keeps the selection
   async function showJobLogs(jobNum) {
+    state.jobLogSel[name] = jobNum;
     if (activeLogWs) { try { activeLogWs.close(); } catch (e) {} }
     logsPre.textContent = `loading logs for job ${jobNum}…`;
     let token = null, text = "";

@@ -758,6 +758,8 @@ class TestKVQuant:
         # int8 half-step + one bf16 RESULT rounding — no second
         # scale-rounding term
         assert rel < 1.5 / 127 + 0.005, rel
+
+    def test_decode_logits_close_to_exact(self):
         from dstack_tpu.serve.engine import GenParams as GP
 
         prompt = [5, 99, 321, 7, 250, 41, 18]
