@@ -367,6 +367,35 @@ class TestCppShim:
             proc.terminate()
             proc.wait(timeout=5)
 
+    async def test_traversal_task_id_rejected(self, agent_binaries, tmp_path):
+        """Native shim: path-traversal ids are refused at submit (they
+        become task-home path components, recursively deleted on
+        remove)."""
+        runner_bin, shim_bin = agent_binaries
+        port = _free_port()
+        proc = subprocess.Popen(
+            [
+                str(shim_bin),
+                "--port", str(port),
+                "--base-dir", str(tmp_path),
+                "--runtime", "process",
+                "--runner-bin", str(runner_bin),
+            ],
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            await _wait_port(port)
+            for bad in ("../../etc", "a/b", ".hidden"):
+                req = schemas.TaskSubmitRequest(id=bad, name="evil")
+                status, body = await _request(
+                    port, "POST", "/api/tasks", json_body=req.model_dump()
+                )
+                assert status == 409, bad
+                assert "unsafe" in body["detail"]
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
     async def test_state_restore_after_shim_kill(self, agent_binaries, tmp_path):
         """Kill -9 the native shim mid-task; a new shim over the same
         base dir re-adopts the still-running runner (RUNNING, same

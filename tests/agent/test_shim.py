@@ -162,6 +162,19 @@ class TestShimStateRestore:
         shim4 = Shim(Path(tmp_path), runtime="process")
         assert await shim4.restore() == 0
 
+    async def test_traversal_task_id_rejected(self, tmp_path):
+        """ids become path components (task home; recursively deleted
+        on remove) — traversal ids must be refused at submit."""
+        shim, client = await _shim_client(tmp_path)
+        try:
+            for bad in ("../../etc", "a/b", ".hidden", "", "x" * 200):
+                req = schemas.TaskSubmitRequest(id=bad, name="evil")
+                r = await client.post("/api/tasks", json=req.model_dump())
+                assert r.status == 409, bad
+                assert "unsafe" in (await r.json())["detail"] or bad == ""
+        finally:
+            await client.close()
+
     async def test_restore_ignores_foreign_pid(self, tmp_path):
         """pid-reuse guard: a live pid whose cmdline is NOT our runner
         for this home must not be re-adopted as running."""
