@@ -239,7 +239,9 @@ def ps(project, show_all) -> None:
     client = _client(project)
     runs = client.runs.list()
     t = Table()
-    for col in ("NAME", "BACKEND", "RESOURCES", "PRICE", "STATUS", "SUBMITTED"):
+    for col in (
+        "NAME", "BACKEND", "RESOURCES", "PRICE", "COST", "STATUS", "SUBMITTED"
+    ):
         t.add_column(col)
     for run in runs:
         if not show_all and run.status.is_finished():
@@ -255,6 +257,7 @@ def ps(project, show_all) -> None:
             jpd.backend.value if jpd else "",
             jpd.instance_type.resources.pretty_format() if jpd else "",
             f"{jpd.price:.2f}" if jpd else "",
+            f"${run.cost:.2f}" if run.cost else "",
             run.status.value,
             pretty_date(run.submitted_at),
         )

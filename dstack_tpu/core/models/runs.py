@@ -347,6 +347,9 @@ class Run(CoreModel):
     service: Optional[ServiceSpec] = None
     deleted: bool = False
     error: Optional[str] = None
+    # accrued $ across all job submissions: price x (finished_at or
+    # now - submitted_at); reference runs.py cost calc
+    cost: float = 0.0
 
     @property
     def run_name(self) -> str:

@@ -68,6 +68,23 @@ async def run_row_to_run(db: Database, row: dict) -> Run:
         service=ServiceSpec.model_validate(service_spec) if service_spec else None,
         deleted=bool(row["deleted"]),
     )
+    # accrued cost: every submission that reached an instance bills its
+    # price from submission to finish (or to now while live) —
+    # reference runs service cost calc
+    from datetime import timezone as _tz
+
+    def _aware(d):
+        return d.replace(tzinfo=_tz.utc) if d.tzinfo is None else d
+
+    cost = 0.0
+    for job in jobs:
+        for sub in job.job_submissions:
+            if sub.job_provisioning_data is None:
+                continue
+            end = _aware(sub.finished_at) if sub.finished_at else now_utc()
+            secs = max((end - _aware(sub.submitted_at)).total_seconds(), 0.0)
+            cost += sub.job_provisioning_data.price * secs / 3600.0
+    run.cost = round(cost, 6)
     if not run.project_name:
         proj = await db.get_by_id("projects", row["project_id"])
         run.project_name = proj["name"] if proj else ""

@@ -526,6 +526,7 @@ async function pageRunDetail(name) {
       h("div", { class: "k" }, "Backend"), h("div", {}, jpd0?.backend || "—"),
       h("div", { class: "k" }, "Host"), h("div", {}, jpd0?.hostname || "—"),
       h("div", { class: "k" }, "Price"), h("div", {}, jpd0 ? `$${(jpd0.price || 0).toFixed(2)}/h` : "—"),
+      h("div", { class: "k" }, "Cost"), h("div", {}, run.cost ? `$${run.cost.toFixed(2)}` : "—"),
       h("div", { class: "k" }, "Submitted"), h("div", {}, fmtDate(run.submitted_at)),
       h("div", { class: "k" }, "Status message"), h("div", {}, run.status_message || "—"),
       h("div", { class: "k" }, "Service URL"), h("div", {}, run.service?.url || "—"),

@@ -1,0 +1,71 @@
+"""Run cost accrual: price x submission duration summed over the run's
+job submissions (reference runs service cost calc)."""
+
+from datetime import datetime, timedelta, timezone
+
+from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+    process_submitted_jobs,
+)
+from dstack_tpu.server.services import runs as runs_service
+from dstack_tpu.server.testing.common import (
+    FakeCompute,
+    cpu_offer,
+    create_test_db,
+    create_test_project,
+    create_test_user,
+    install_fake_backend,
+    make_run_spec,
+)
+
+TASK = {"type": "task", "commands": ["python train.py"]}
+
+
+async def _provisioned_run(price: float):
+    db = await create_test_db()
+    _user, user_row = await create_test_user(db)
+    project_row = await create_test_project(db, user_row)
+    compute = FakeCompute(offers=[cpu_offer(price=price)])
+    install_fake_backend(project_row, compute)
+    run = await runs_service.submit_run(
+        db, project_row, user_row, make_run_spec(TASK, "cost-run")
+    )
+    await process_submitted_jobs(db)
+    return db, project_row, run
+
+
+class TestRunCost:
+    async def test_finished_submission_bills_price_times_duration(self):
+        db, project_row, run = await _provisioned_run(price=0.5)
+        job = await db.fetchone("SELECT * FROM jobs")
+        t0 = datetime(2026, 7, 31, 10, 0, 0, tzinfo=timezone.utc)
+        await db.update_by_id("jobs", job["id"], {
+            "status": "done",
+            "submitted_at": t0.isoformat(),
+            "finished_at": (t0 + timedelta(hours=2)).isoformat(),
+        })
+        row = await db.get_by_id("runs", run.id)
+        out = await runs_service.run_row_to_run(db, row)
+        assert abs(out.cost - 1.0) < 1e-6  # $0.50/h x 2h
+
+    async def test_live_submission_accrues_to_now(self):
+        db, project_row, run = await _provisioned_run(price=1.0)
+        job = await db.fetchone("SELECT * FROM jobs")
+        t0 = datetime.now(timezone.utc) - timedelta(hours=3)
+        await db.update_by_id(
+            "jobs", job["id"], {"submitted_at": t0.isoformat()}
+        )
+        row = await db.get_by_id("runs", run.id)
+        out = await runs_service.run_row_to_run(db, row)
+        assert 2.99 < out.cost < 3.01  # still running: bills to now
+
+    async def test_unprovisioned_job_costs_nothing(self):
+        db = await create_test_db()
+        _user, user_row = await create_test_user(db)
+        project_row = await create_test_project(db, user_row)
+        install_fake_backend(project_row, FakeCompute(offers=[]))
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK, "free-run")
+        )
+        row = await db.get_by_id("runs", run.id)
+        out = await runs_service.run_row_to_run(db, row)
+        assert out.cost == 0.0  # no jpd, no billing
