@@ -382,6 +382,50 @@ async def list_instances(ctx: RequestContext):
     return [instance_row_to_model(r, ctx.param("project_name")) for r in rows]
 
 
+@project_router.post("/services/list")
+async def list_services(ctx: RequestContext):
+    """Service observability for the console: every active service run
+    with its URL, live replica count, and measured RPS (in-server proxy
+    samples merged with gateway-scraped windows — the numbers the RPS
+    autoscaler acts on)."""
+    from dstack_tpu.proxy.stats import get_service_stats
+    from dstack_tpu.server.services import runs as runs_service
+
+    db = ctx.state["db"]
+    project_name = ctx.param("project_name")
+    rows = await db.fetchall(
+        "SELECT * FROM runs WHERE project_id = ? AND deleted = 0 "
+        "AND status IN ('submitted', 'provisioning', 'running')",
+        (ctx.project["id"],),
+    )
+    stats = get_service_stats()
+    out = []
+    for row in rows:
+        run = await runs_service.run_row_to_run(db, row)
+        if getattr(run.run_spec.configuration, "type", None) != "service":
+            continue
+        live = sum(
+            1
+            for j in run.jobs
+            for s in j.job_submissions[-1:]
+            if s.status.value == "running"
+        )
+        out.append({
+            "run_name": run.run_name,
+            "status": run.status.value,
+            "url": run.service.url if run.service else None,
+            "model": (
+                (run.service.model or {}).get("name")
+                if run.service
+                else None
+            ),
+            "replicas": live,
+            "rps": round(stats.rps(project_name, run.run_name), 3),
+            "cost": run.cost,
+        })
+    return out
+
+
 @project_router.post("/instances/get")
 async def get_instance(ctx: RequestContext, body: s.GetByNameRequest):
     """Instance detail for the console: the instance itself, jobs that

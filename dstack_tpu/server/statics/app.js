@@ -70,6 +70,7 @@ function toast(msg) {
 const PAGES = [
   ["overview", "Overview"],
   ["runs", "Runs"],
+  ["services", "Services"],
   ["models", "Models"],
   ["fleets", "Fleets"],
   ["instances", "Instances"],
@@ -781,6 +782,29 @@ async function pageVolumes() {
   );
 }
 
+async function pageServices() {
+  // the numbers the RPS autoscaler acts on: live replicas + measured
+  // RPS per active service (in-server proxy + gateway windows merged)
+  const services = await papi("/services/list");
+  return h("div", {},
+    h("h1", {}, "Services"),
+    table(
+      ["Run", "Status", "Model", "Replicas", "RPS (60s)", "Cost", "URL"],
+      services.map((s) => h("tr", {},
+        h("td", {}, h("a", { href: `#/runs/${s.run_name}` }, s.run_name)),
+        h("td", {}, statusBadge(s.status)),
+        h("td", {}, s.model || "—"),
+        h("td", {}, String(s.replicas)),
+        h("td", {}, s.rps.toFixed(2)),
+        h("td", {}, s.cost ? `$${s.cost.toFixed(2)}` : "—"),
+        h("td", {}, s.url
+          ? h("a", { href: s.url, target: "_blank" }, s.url) : "—"),
+      )),
+      "No active services — apply a `type: service` config",
+    ),
+  );
+}
+
 async function pageGateways() {
   const gws = await papi("/gateways/list");
   return h("div", {},
@@ -1114,6 +1138,7 @@ function renderLogin(err) {
 const ROUTES = {
   overview: pageOverview,
   runs: pageRuns,
+  services: pageServices,
   models: pageModels,
   fleets: pageFleets,
   instances: pageInstances,

@@ -785,3 +785,62 @@ class TestConsoleDetailPages:
                 assert needle in js, needle
         finally:
             await client.close()
+
+
+class TestServicesView:
+    async def test_services_list_shape_and_filtering(self):
+        """/services/list returns active service runs with the
+        replica/RPS fields the Services page renders; task runs and
+        finished services are excluded."""
+        from dstack_tpu.server.db import dumps
+        from dstack_tpu.server.services import runs as runs_service
+        from dstack_tpu.server.testing.common import make_run_spec
+
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="svc-tok",
+            with_background=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            db = app["state"]["db"]
+            project = await db.fetchone("SELECT * FROM projects")
+            user = await db.fetchone("SELECT * FROM users")
+            # one service (submitted), one task — only the service lists
+            svc_spec = make_run_spec(
+                {"type": "service", "commands": ["python serve.py"],
+                 "port": 8000, "model": {"name": "m1", "format": "openai"}},
+                "svc-run",
+            )
+            run = await runs_service.submit_run(db, project, user, svc_spec)
+            await db.update_by_id(
+                "runs", run.id,
+                {"service_spec": dumps(
+                    {"url": "/proxy/services/main/svc-run/",
+                     "model": {"name": "m1"}, "options": {}}
+                )},
+            )
+            await runs_service.submit_run(
+                db, project, user,
+                make_run_spec({"type": "task", "commands": ["true"]}, "t-run"),
+            )
+            r = await client.post(
+                "/api/project/main/services/list",
+                headers=_auth("svc-tok"), json={},
+            )
+            assert r.status == 200, await r.text()
+            services = await r.json()
+            assert [s["run_name"] for s in services] == ["svc-run"]
+            s = services[0]
+            assert s["model"] == "m1"
+            assert s["replicas"] == 0 and s["rps"] == 0.0
+            assert s["url"].endswith("/svc-run/")
+            assert "cost" in s
+
+            # the console has the page + nav entry
+            r = await client.get("/statics/app.js")
+            js = await r.text()
+            assert "pageServices" in js and "services/list" in js
+        finally:
+            await client.close()
