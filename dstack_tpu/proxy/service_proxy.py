@@ -303,6 +303,22 @@ async def _tgi_chat_completions(
 async def model_list_handler(request: web.Request) -> web.Response:
     db: Database = request.app["state"]["db"]
     project = request.match_info["project_name"]
+    # model names are deployment metadata: listing requires a valid
+    # server token (reference model_proxy routes sit behind auth; the
+    # per-service `auth: false` opt-out covers INFERENCE on that
+    # service, not the project-wide catalog)
+    auth = request.headers.get("Authorization", "")
+    token = (
+        auth.removeprefix("Bearer ").strip()
+        if auth.startswith("Bearer ")
+        else ""
+    )
+    from dstack_tpu.server.services.users import get_user_by_token
+
+    if not token or await get_user_by_token(db, token) is None:
+        return web.json_response(
+            {"detail": "authentication required"}, status=401
+        )
     rows = await _list_model_services(db, project)
     data = [
         {

@@ -113,8 +113,10 @@ class TestServiceE2E:
                 )
             assert await r.text() == "echo-ok"
 
-            # model registry lists the service's model
-            r = await client.get("/proxy/models/main/models")
+            # model registry lists the service's model (authed)
+            r = await client.get(
+                "/proxy/models/main/models", headers=_auth("svc-tok")
+            )
             data = await r.json()
             assert [m["id"] for m in data["data"]] == ["test-model"]
 
@@ -361,8 +363,10 @@ class TestFullStackModelService:
             assert data["usage"]["completion_tokens"] >= 1
             assert data["choices"][0]["message"]["role"] == "assistant"
 
-            # the registry lists the model
-            r = await client.get("/proxy/models/main/models")
+            # the registry lists the model (authed)
+            r = await client.get(
+                "/proxy/models/main/models", headers=_auth("fs-tok")
+            )
             models = await r.json()
             assert "tiny-engine" in [m["id"] for m in models["data"]]
         finally:

@@ -150,8 +150,13 @@ class TestConsoleAPIContract:
                 r = await client.post(path, headers=_auth("ui-tok"), json={})
                 assert r.status == 200, path
 
-            # models view
+            # models view requires a token (model names are
+            # deployment metadata); anonymous is 401
             r = await client.get("/proxy/models/main/models")
+            assert r.status == 401
+            r = await client.get(
+                "/proxy/models/main/models", headers=_auth("ui-tok")
+            )
             assert r.status == 200
             assert "data" in await r.json()
         finally:
