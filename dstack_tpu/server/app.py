@@ -149,7 +149,21 @@ async def run_server(
     logger.info("dstack-tpu server is running at http://%s:%d", host, port)
     print(f"The admin token is {token}", flush=True)
     print(f"The server is running at http://{host}:{port}/", flush=True)
+    # SIGTERM must unwind cleanly: the default action kills the process
+    # without running finally/atexit, orphaning local-backend shims and
+    # their runners (observed as hour-old agent processes after a
+    # `pkill`-style stop). A handled stop lets runner.cleanup() and the
+    # LocalCompute atexit reaper run at normal interpreter exit.
+    import signal
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-main thread / restricted env: default handling
     try:
-        await asyncio.Event().wait()
+        await stop.wait()
     finally:
         await runner.cleanup()
