@@ -189,7 +189,10 @@ class TestTGIServiceE2E:
             await asyncio.sleep(1.0)
 
             # model listed
-            r = await client.get("/api/project/main/models" if False else "/proxy/models/main/models")
+            r = await client.get(
+                "/proxy/models/main/models",
+                headers={"Authorization": "Bearer tgi-tok"},
+            )
             models = await r.json()
             assert any(m["id"] == "tiny-tgi" for m in models["data"])
 
