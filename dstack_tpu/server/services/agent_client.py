@@ -153,6 +153,111 @@ async def _tunnel_identity(db, project_id: Optional[str]) -> Optional[str]:
         return None
 
 
+class TunnelPool:
+    """Persistent SSH tunnels, keyed by (host, ssh port, user, remote
+    port, identity, proxy host).
+
+    Per-poll tunnel setup is the control plane's documented latency and
+    flakiness hotspot (SURVEY.md hard parts; the reference reserves +
+    opens a fresh tunnel for EVERY reconciler call, runner/ssh.py:24).
+    A pooled tunnel serves every poll to that host until its ssh
+    process dies or it sits idle past the TTL — turning the 1-4s
+    reconciler cadence from one ssh handshake per poll into one per
+    tunnel lifetime.
+    """
+
+    def __init__(self, idle_ttl: float = 300.0, opener=None):
+        import time as _time
+
+        self._time = _time
+        self._ttl = idle_ttl
+        self._opener = opener  # injectable for tests
+        self._items: dict[tuple, dict] = {}
+        self._locks: dict[tuple, "asyncio.Lock"] = {}
+
+    def _lock(self, key):
+        import asyncio
+
+        if key not in self._locks:
+            self._locks[key] = asyncio.Lock()
+        return self._locks[key]
+
+    @staticmethod
+    def _alive(item) -> bool:
+        proc = getattr(item["tunnel"], "_proc", None)
+        return proc is None or proc.poll() is None
+
+    def _evict_idle(self) -> None:
+        now = self._time.monotonic()
+        for key, item in list(self._items.items()):
+            if now - item["last_used"] > self._ttl or not self._alive(item):
+                item["tunnel"].close()
+                del self._items[key]
+
+    async def acquire(self, params, remote_port: int, identity_file, proxy) -> int:
+        """Local forwarded port for (host, remote_port), opening or
+        reusing the tunnel as needed."""
+        key = (
+            params.hostname,
+            params.port,
+            params.username,
+            remote_port,
+            identity_file or "",
+            getattr(proxy, "hostname", "") or "",
+        )
+        async with self._lock(key):
+            self._evict_idle()
+            item = self._items.get(key)
+            if item is not None:
+                item["last_used"] = self._time.monotonic()
+                return item["local_port"]
+            from dstack_tpu.core.services.ssh.tunnel import (
+                open_tunnel_to_params,
+            )
+
+            opener = self._opener or open_tunnel_to_params
+            tunnel, ports = await opener(
+                params, [remote_port],
+                identity_file=identity_file, proxy=proxy,
+            )
+            self._items[key] = {
+                "tunnel": tunnel,
+                "local_port": ports[remote_port],
+                "last_used": self._time.monotonic(),
+            }
+            return ports[remote_port]
+
+    def close_all(self) -> None:
+        for item in self._items.values():
+            item["tunnel"].close()
+        self._items.clear()
+
+
+_tunnel_pool: Optional[TunnelPool] = None
+
+
+def get_tunnel_pool() -> TunnelPool:
+    global _tunnel_pool
+    if _tunnel_pool is None:
+        _tunnel_pool = TunnelPool()
+    return _tunnel_pool
+
+
+async def _pooled_local_port(
+    jpd: JobProvisioningData, remote_port: int, db, project_id
+) -> int:
+    from dstack_tpu.core.models.instances import SSHConnectionParams
+
+    return await get_tunnel_pool().acquire(
+        SSHConnectionParams(
+            hostname=jpd.hostname or "", username=jpd.username, port=jpd.ssh_port
+        ),
+        remote_port,
+        identity_file=await _tunnel_identity(db, project_id),
+        proxy=jpd.ssh_proxy,
+    )
+
+
 @asynccontextmanager
 async def shim_client_for(
     jpd: JobProvisioningData,
@@ -170,21 +275,8 @@ async def shim_client_for(
     if _direct(jpd):
         yield ShimClient(jpd.hostname or "127.0.0.1", port)
         return
-    from dstack_tpu.core.services.ssh.tunnel import open_tunnel_to_params
-    from dstack_tpu.core.models.instances import SSHConnectionParams
-
-    tunnel, ports = await open_tunnel_to_params(
-        SSHConnectionParams(
-            hostname=jpd.hostname or "", username=jpd.username, port=jpd.ssh_port
-        ),
-        [port],
-        proxy=jpd.ssh_proxy,
-        identity_file=await _tunnel_identity(db, project_id),
-    )
-    try:
-        yield ShimClient("127.0.0.1", ports[port])
-    finally:
-        tunnel.close()
+    local = await _pooled_local_port(jpd, port, db, project_id)
+    yield ShimClient("127.0.0.1", local)
 
 
 @asynccontextmanager
@@ -199,21 +291,8 @@ async def runner_address_for(
     if _direct(jpd):
         yield (jpd.hostname or "127.0.0.1", runner_port)
         return
-    from dstack_tpu.core.services.ssh.tunnel import open_tunnel_to_params
-    from dstack_tpu.core.models.instances import SSHConnectionParams
-
-    tunnel, ports = await open_tunnel_to_params(
-        SSHConnectionParams(
-            hostname=jpd.hostname or "", username=jpd.username, port=jpd.ssh_port
-        ),
-        [runner_port],
-        proxy=jpd.ssh_proxy,
-        identity_file=await _tunnel_identity(db, project_id),
-    )
-    try:
-        yield ("127.0.0.1", ports[runner_port])
-    finally:
-        tunnel.close()
+    local = await _pooled_local_port(jpd, runner_port, db, project_id)
+    yield ("127.0.0.1", local)
 
 
 @asynccontextmanager

@@ -1,0 +1,95 @@
+"""Persistent SSH tunnel pool: per-poll tunnel setup was the control
+plane's latency hotspot (SURVEY hard parts); one tunnel now serves
+every poll to a host until it dies or idles out."""
+
+import asyncio
+
+from dstack_tpu.core.models.instances import SSHConnectionParams
+from dstack_tpu.server.services.agent_client import TunnelPool
+
+
+class _FakeProc:
+    def __init__(self):
+        self.dead = False
+
+    def poll(self):
+        return 1 if self.dead else None
+
+
+class _FakeTunnel:
+    def __init__(self):
+        self._proc = _FakeProc()
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+        self._proc.dead = True
+
+
+def _opener_factory(log: list):
+    next_port = iter(range(40000, 41000))
+
+    async def opener(params, remote_ports, identity_file=None, proxy=None):
+        t = _FakeTunnel()
+        ports = {rp: next(next_port) for rp in remote_ports}
+        log.append((params.hostname, remote_ports[0], t, ports[remote_ports[0]]))
+        return t, ports
+
+    return opener
+
+
+PARAMS = SSHConnectionParams(hostname="10.0.0.5", username="tpu", port=22)
+
+
+class TestTunnelPool:
+    async def test_reuses_open_tunnel(self):
+        log = []
+        pool = TunnelPool(opener=_opener_factory(log))
+        p1 = await pool.acquire(PARAMS, 10998, None, None)
+        p2 = await pool.acquire(PARAMS, 10998, None, None)
+        assert p1 == p2
+        assert len(log) == 1  # one ssh process for both polls
+
+    async def test_distinct_keys_get_distinct_tunnels(self):
+        log = []
+        pool = TunnelPool(opener=_opener_factory(log))
+        await pool.acquire(PARAMS, 10998, None, None)
+        await pool.acquire(PARAMS, 10999, None, None)  # other remote port
+        other = SSHConnectionParams(hostname="10.0.0.6", username="tpu", port=22)
+        await pool.acquire(other, 10998, None, None)
+        assert len(log) == 3
+
+    async def test_dead_tunnel_reopens(self):
+        log = []
+        pool = TunnelPool(opener=_opener_factory(log))
+        p1 = await pool.acquire(PARAMS, 10998, None, None)
+        log[0][2]._proc.dead = True  # ssh process died
+        p2 = await pool.acquire(PARAMS, 10998, None, None)
+        assert len(log) == 2 and p1 != p2
+
+    async def test_idle_ttl_evicts_and_closes(self):
+        log = []
+        pool = TunnelPool(idle_ttl=0.05, opener=_opener_factory(log))
+        await pool.acquire(PARAMS, 10998, None, None)
+        await asyncio.sleep(0.08)
+        await pool.acquire(PARAMS, 10998, None, None)
+        assert len(log) == 2
+        assert log[0][2].closed  # evicted tunnel was closed, not leaked
+
+    async def test_concurrent_acquires_share_one_tunnel(self):
+        log = []
+        pool = TunnelPool(opener=_opener_factory(log))
+        ports = await asyncio.gather(
+            *(pool.acquire(PARAMS, 10998, None, None) for _ in range(8))
+        )
+        assert len(set(ports)) == 1
+        assert len(log) == 1
+
+    async def test_close_all(self):
+        log = []
+        pool = TunnelPool(opener=_opener_factory(log))
+        await pool.acquire(PARAMS, 10998, None, None)
+        pool.close_all()
+        assert log[0][2].closed
+        await pool.acquire(PARAMS, 10998, None, None)
+        assert len(log) == 2
