@@ -100,6 +100,22 @@ async def _forward(
         )
 
 
+async def _bearer_user(request: web.Request, db: Database):
+    """The user row for the request's Bearer token, or None (single
+    token-parsing path for every proxy auth decision)."""
+    auth = request.headers.get("Authorization", "")
+    token = (
+        auth.removeprefix("Bearer ").strip()
+        if auth.startswith("Bearer ")
+        else ""
+    )
+    if not token:
+        return None
+    from dstack_tpu.server.services.users import get_user_by_token
+
+    return await get_user_by_token(db, token)
+
+
 async def _check_service_auth(
     request: web.Request, db: Database, run_row: Optional[dict]
 ) -> Optional[web.Response]:
@@ -111,13 +127,8 @@ async def _check_service_auth(
     conf = (loads(run_row["run_spec"]) or {}).get("configuration", {})
     if conf.get("auth") is False:
         return None
-    auth = request.headers.get("Authorization", "")
-    token = auth.removeprefix("Bearer ").strip() if auth.startswith("Bearer ") else ""
-    if token:
-        from dstack_tpu.server.services.users import get_user_by_token
-
-        if await get_user_by_token(db, token) is not None:
-            return None
+    if await _bearer_user(request, db) is not None:
+        return None
     return web.json_response(
         {"detail": "authentication required for this service"}, status=401
     )
@@ -303,31 +314,22 @@ async def _tgi_chat_completions(
 async def model_list_handler(request: web.Request) -> web.Response:
     db: Database = request.app["state"]["db"]
     project = request.match_info["project_name"]
-    # model names are deployment metadata: listing requires a valid
-    # server token (reference model_proxy routes sit behind auth; the
-    # per-service `auth: false` opt-out covers INFERENCE on that
-    # service, not the project-wide catalog)
-    auth = request.headers.get("Authorization", "")
-    token = (
-        auth.removeprefix("Bearer ").strip()
-        if auth.startswith("Bearer ")
-        else ""
-    )
-    from dstack_tpu.server.services.users import get_user_by_token
-
-    if not token or await get_user_by_token(db, token) is None:
-        return web.json_response(
-            {"detail": "authentication required"}, status=401
-        )
+    # same policy as the gateway's catalog (gateway/app.py model_list):
+    # anonymous callers see only `auth: false` (public) models; a valid
+    # server token reveals the rest — model names of private services
+    # are deployment metadata, not enumerable anonymously
+    authed = await _bearer_user(request, db) is not None
     rows = await _list_model_services(db, project)
-    data = [
-        {
-            "id": (loads(r["run_spec"])["configuration"]["model"] or {}).get("name"),
+    data = []
+    for r in rows:
+        conf = loads(r["run_spec"])["configuration"]
+        if not authed and conf.get("auth") is not False:
+            continue
+        data.append({
+            "id": (conf["model"] or {}).get("name"),
             "object": "model",
             "owned_by": "dstack-tpu",
-        }
-        for r in rows
-    ]
+        })
     return web.json_response({"object": "list", "data": data})
 
 

@@ -89,6 +89,9 @@ async def create_app(
         from dstack_tpu.server.services.gateways import get_connection_pool
 
         await get_connection_pool().close()
+        from dstack_tpu.server.services.agent_client import close_tunnel_pool
+
+        close_tunnel_pool()  # reap pooled ssh subprocesses
         await db.close()
 
     app.on_startup.append(on_startup)

@@ -395,7 +395,10 @@ async def list_services(ctx: RequestContext):
     project_name = ctx.param("project_name")
     rows = await db.fetchall(
         "SELECT * FROM runs WHERE project_id = ? AND deleted = 0 "
-        "AND status IN ('submitted', 'provisioning', 'running')",
+        # every non-finished state: terminating services still hold
+        # replicas/cost, pending ones await capacity — both must show
+        "AND status IN ('pending', 'submitted', 'provisioning', "
+        "'running', 'terminating')",
         (ctx.project["id"],),
     )
     stats = get_service_stats()

@@ -190,13 +190,15 @@ class TunnelPool:
     def _evict_idle(self) -> None:
         now = self._time.monotonic()
         for key, item in list(self._items.items()):
+            # leased tunnels are NEVER evicted by the TTL — a websocket
+            # log follower holds its lease for the whole stream
+            if item["refs"] > 0:
+                continue
             if now - item["last_used"] > self._ttl or not self._alive(item):
                 item["tunnel"].close()
                 del self._items[key]
 
-    async def acquire(self, params, remote_port: int, identity_file, proxy) -> int:
-        """Local forwarded port for (host, remote_port), opening or
-        reusing the tunnel as needed."""
+    async def _acquire_item(self, params, remote_port, identity_file, proxy):
         key = (
             params.hostname,
             params.port,
@@ -208,29 +210,62 @@ class TunnelPool:
         async with self._lock(key):
             self._evict_idle()
             item = self._items.get(key)
-            if item is not None:
-                item["last_used"] = self._time.monotonic()
-                return item["local_port"]
-            from dstack_tpu.core.services.ssh.tunnel import (
-                open_tunnel_to_params,
-            )
+            if item is not None and not self._alive(item):
+                item["tunnel"].close()
+                del self._items[key]
+                item = None
+            if item is None:
+                from dstack_tpu.core.services.ssh.tunnel import (
+                    open_tunnel_to_params,
+                )
 
-            opener = self._opener or open_tunnel_to_params
-            tunnel, ports = await opener(
-                params, [remote_port],
-                identity_file=identity_file, proxy=proxy,
-            )
-            self._items[key] = {
-                "tunnel": tunnel,
-                "local_port": ports[remote_port],
-                "last_used": self._time.monotonic(),
-            }
-            return ports[remote_port]
+                opener = self._opener or open_tunnel_to_params
+                tunnel, ports = await opener(
+                    params, [remote_port],
+                    identity_file=identity_file, proxy=proxy,
+                )
+                item = {
+                    "tunnel": tunnel,
+                    "local_port": ports[remote_port],
+                    "last_used": self._time.monotonic(),
+                    "refs": 0,
+                }
+                self._items[key] = item
+            item["last_used"] = self._time.monotonic()
+            item["refs"] += 1
+            return item
+
+    @asynccontextmanager
+    async def lease(self, params, remote_port: int, identity_file, proxy):
+        """Hold the tunnel for a scope: yields the local forwarded port;
+        the tunnel cannot be TTL-evicted while any lease is open."""
+        item = await self._acquire_item(params, remote_port, identity_file, proxy)
+        try:
+            yield item["local_port"]
+        finally:
+            item["refs"] -= 1
+            item["last_used"] = self._time.monotonic()
+
+    async def acquire(self, params, remote_port: int, identity_file, proxy) -> int:
+        """One-shot variant (tests / short callers): returns the local
+        port without holding a lease."""
+        item = await self._acquire_item(params, remote_port, identity_file, proxy)
+        item["refs"] -= 1
+        return item["local_port"]
 
     def close_all(self) -> None:
         for item in self._items.values():
             item["tunnel"].close()
         self._items.clear()
+
+
+def close_tunnel_pool() -> None:
+    """Server-shutdown hook: reap every pooled ssh subprocess (wired
+    into the app's on_cleanup next to the scheduler/db teardown)."""
+    global _tunnel_pool
+    if _tunnel_pool is not None:
+        _tunnel_pool.close_all()
+        _tunnel_pool = None
 
 
 _tunnel_pool: Optional[TunnelPool] = None
@@ -243,19 +278,21 @@ def get_tunnel_pool() -> TunnelPool:
     return _tunnel_pool
 
 
+@asynccontextmanager
 async def _pooled_local_port(
     jpd: JobProvisioningData, remote_port: int, db, project_id
-) -> int:
+):
     from dstack_tpu.core.models.instances import SSHConnectionParams
 
-    return await get_tunnel_pool().acquire(
+    async with get_tunnel_pool().lease(
         SSHConnectionParams(
             hostname=jpd.hostname or "", username=jpd.username, port=jpd.ssh_port
         ),
         remote_port,
         identity_file=await _tunnel_identity(db, project_id),
         proxy=jpd.ssh_proxy,
-    )
+    ) as local:
+        yield local
 
 
 @asynccontextmanager
@@ -275,8 +312,8 @@ async def shim_client_for(
     if _direct(jpd):
         yield ShimClient(jpd.hostname or "127.0.0.1", port)
         return
-    local = await _pooled_local_port(jpd, port, db, project_id)
-    yield ShimClient("127.0.0.1", local)
+    async with _pooled_local_port(jpd, port, db, project_id) as local:
+        yield ShimClient("127.0.0.1", local)
 
 
 @asynccontextmanager
@@ -291,8 +328,8 @@ async def runner_address_for(
     if _direct(jpd):
         yield (jpd.hostname or "127.0.0.1", runner_port)
         return
-    local = await _pooled_local_port(jpd, runner_port, db, project_id)
-    yield ("127.0.0.1", local)
+    async with _pooled_local_port(jpd, runner_port, db, project_id) as local:
+        yield ("127.0.0.1", local)
 
 
 @asynccontextmanager

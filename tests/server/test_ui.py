@@ -150,10 +150,12 @@ class TestConsoleAPIContract:
                 r = await client.post(path, headers=_auth("ui-tok"), json={})
                 assert r.status == 200, path
 
-            # models view requires a token (model names are
-            # deployment metadata); anonymous is 401
+            # models view: anonymous callers see only `auth: false`
+            # (public) models — private model names need a token (same
+            # policy as the gateway catalog)
             r = await client.get("/proxy/models/main/models")
-            assert r.status == 401
+            assert r.status == 200
+            assert (await r.json())["data"] == []
             r = await client.get(
                 "/proxy/models/main/models", headers=_auth("ui-tok")
             )
