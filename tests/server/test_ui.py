@@ -851,3 +851,45 @@ class TestServicesView:
             assert "pageServices" in js and "services/list" in js
         finally:
             await client.close()
+
+
+class TestModelCatalogPolicy:
+    async def test_anonymous_sees_only_public_models(self):
+        """Catalog policy (matches the gateway): anonymous callers see
+        `auth: false` models only; a server token reveals the rest."""
+        from dstack_tpu.server.services import runs as runs_service
+        from dstack_tpu.server.testing.common import make_run_spec
+
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="cat-tok",
+            with_background=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            db = app["state"]["db"]
+            project = await db.fetchone("SELECT * FROM projects")
+            user = await db.fetchone("SELECT * FROM users")
+            await runs_service.submit_run(db, project, user, make_run_spec(
+                {"type": "service", "commands": ["serve"], "port": 8000,
+                 "auth": False,
+                 "model": {"name": "public-m", "format": "openai"}},
+                "pub-svc",
+            ))
+            await runs_service.submit_run(db, project, user, make_run_spec(
+                {"type": "service", "commands": ["serve"], "port": 8000,
+                 "model": {"name": "private-m", "format": "openai"}},
+                "priv-svc",
+            ))
+            r = await client.get("/proxy/models/main/models")
+            assert r.status == 200
+            ids = [m["id"] for m in (await r.json())["data"]]
+            assert ids == ["public-m"]
+            r = await client.get(
+                "/proxy/models/main/models", headers=_auth("cat-tok")
+            )
+            ids = sorted(m["id"] for m in (await r.json())["data"])
+            assert ids == ["private-m", "public-m"]
+        finally:
+            await client.close()
