@@ -1,0 +1,36 @@
+"""Quick-tier integrity: every _QUICK_KEEP entry must still match a
+collected test — a rename/refactor that orphans an entry would silently
+shrink the smoke tier's compute/serve coverage to nothing."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def test_quick_keep_entries_all_match():
+    sys.path.insert(0, str(REPO / "tests"))
+    import conftest as test_conftest
+
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "pytest",
+            "tests/compute", "tests/serve",
+            "-m", "not heavy", "--collect-only", "-q",
+        ],
+        cwd=REPO, capture_output=True, text=True, timeout=180,
+    )
+    collected = out.stdout
+    missing = [
+        k for k in test_conftest._QUICK_KEEP
+        # a keep entry names either a class (its tests collect) or a
+        # single test; either way its node-id fragment must appear
+        if k.split("::", 1)[1] not in collected
+    ]
+    assert not missing, (
+        f"_QUICK_KEEP entries match no collected test: {missing}"
+    )
+    # the smoke subset is supposed to be small but NON-empty
+    n = sum(1 for ln in collected.splitlines() if "::" in ln)
+    assert n >= len(test_conftest._QUICK_KEEP) - 1
