@@ -397,13 +397,26 @@ class Shim {
       const Value& labels = c["Labels"];
       std::string tid = labels["dtpu.task-id"].as_string();
       if (tid.empty() || tasks_.count(tid)) continue;
+      // port label parsing matches shim.py restore: missing/empty
+      // label falls back to the default runner port; a PRESENT but
+      // unparseable/non-positive label skips the container — never
+      // brick the shim boot with a "running" task every runner poll
+      // would fail against
+      std::string port_label = labels["dtpu.runner-port"].as_string();
+      int port = port_label.empty() ? 10999 : atoi(port_label.c_str());
+      if (port <= 0) {
+        fprintf(stderr,
+                "tpu-shim: state restore: skipping container with bad "
+                "runner-port label (task %s)\n", tid.c_str());
+        continue;
+      }
       Task& t = tasks_[tid];
       Value req{Object{}};
       req.set("id", tid);
       req.set("name", labels["dtpu.task-name"].as_string());
       req.set("image_name", c["Image"].as_string());
       t.req = std::move(req);
-      t.runner_port = atoi(labels["dtpu.runner-port"].as_string().c_str());
+      t.runner_port = port;
       std::string name;
       if (!c["Names"].as_array().empty())
         name = c["Names"].as_array()[0].as_string();

@@ -139,12 +139,32 @@ async def _process_job(db: Database, job_id: str) -> None:
         jpd = loads(row.get("job_provisioning_data"))
         if jpd is None:
             continue
-        if volume_rows and not await _attach_volumes_to_reused(
-            db, project_row, volume_rows, volume_regions, row, jpd
-        ):
+        if volume_regions and row.get("region") not in volume_regions:
+            # pure filter stays BEFORE the claim: claiming resets
+            # last_processed_at, which would postpone the candidate's
+            # idle-timeout clock every scheduling tick
             continue
-        await _assign(db, job_row, row["id"], jpd, worker_id=0)
-        await instances_service.mark_instance(db, row["id"], InstanceStatus.BUSY)
+        # claim next (IDLE->BUSY compare-and-swap): the batch gathers
+        # several jobs concurrently and claim_batch only locks job ids,
+        # so two jobs can read the same idle row — the CAS loser falls
+        # through to the next candidate / offers
+        if not await instances_service.try_claim_idle_instance(db, row["id"]):
+            continue
+        try:
+            if volume_rows and not await _attach_volumes_to_reused(
+                db, project_row, volume_rows, volume_regions, row, jpd
+            ):
+                await instances_service.mark_instance(
+                    db, row["id"], InstanceStatus.IDLE
+                )
+                continue
+            await _assign(db, job_row, row["id"], jpd, worker_id=0)
+        except BaseException:
+            # never leak the claim: a BUSY instance with no job assigned
+            # is invisible to every reconciler (no reuse, no idle
+            # termination)
+            await instances_service.mark_instance(db, row["id"], InstanceStatus.IDLE)
+            raise
         logger.info("job %s reuses instance %s", job_spec.job_name, row["name"])
         return
 

@@ -246,9 +246,13 @@ class TunnelPool:
             item["refs"] -= 1
             item["last_used"] = self._time.monotonic()
 
-    async def acquire(self, params, remote_port: int, identity_file, proxy) -> int:
-        """One-shot variant (tests / short callers): returns the local
-        port without holding a lease."""
+    async def _acquire_for_tests(
+        self, params, remote_port: int, identity_file, proxy
+    ) -> int:
+        """TEST-ONLY: returns the local port without holding a lease, so
+        a concurrent ``_evict_idle`` may TTL-close the tunnel while the
+        caller still uses the port. Production callers must use
+        ``lease()``."""
         item = await self._acquire_item(params, remote_port, identity_file, proxy)
         item["refs"] -= 1
         return item["local_port"]

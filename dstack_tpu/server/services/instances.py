@@ -142,6 +142,24 @@ def filter_pool_instances(
     return out
 
 
+async def try_claim_idle_instance(db: Database, instance_id: str) -> bool:
+    """Compare-and-swap IDLE -> BUSY; False means another concurrently
+    scheduled job won the instance and the caller must try the next
+    candidate. Guards the batched scheduler (claim_batch locks job ids,
+    not instances, so two jobs in one tick can see the same idle row)."""
+    changed = await db.execute(
+        "UPDATE instances SET status = ?, last_processed_at = ? "
+        "WHERE id = ? AND status = ? AND deleted = 0",
+        (
+            InstanceStatus.BUSY.value,
+            now_utc().isoformat(),
+            instance_id,
+            InstanceStatus.IDLE.value,
+        ),
+    )
+    return changed > 0
+
+
 async def mark_instance(
     db: Database, instance_id: str, status: InstanceStatus, **fields
 ) -> None:

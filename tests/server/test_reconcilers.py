@@ -267,6 +267,37 @@ class TestSubmittedJobs:
         inst = await db.get_by_id("instances", job1["instance_id"])
         assert inst["status"] == InstanceStatus.BUSY.value
 
+    async def test_batched_tick_no_double_assign(self):
+        """Two jobs scheduled in ONE batched tick must not both land on
+        the same idle instance: the IDLE->BUSY transition is a
+        compare-and-swap, so the loser falls through to offers
+        (claim_batch locks job ids, not instances)."""
+        db, user_row, project_row, compute = await _setup()
+        run1 = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK_V5E8, "seed")
+        )
+        await process_submitted_jobs(db)
+        job1 = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run1.id,))
+        await db.update_by_id(
+            "instances", job1["instance_id"], {"status": InstanceStatus.IDLE.value}
+        )
+        runs = [
+            await runs_service.submit_run(
+                db, project_row, user_row, make_run_spec(TASK_V5E8, f"race-{i}")
+            )
+            for i in range(2)
+        ]
+        await process_submitted_jobs(db)  # ONE tick schedules both
+        jobs = [
+            await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (r.id,))
+            for r in runs
+        ]
+        assert all(j["status"] == JobStatus.PROVISIONING.value for j in jobs)
+        instance_ids = {j["instance_id"] for j in jobs}
+        assert len(instance_ids) == 2, "both jobs placed on the same instance"
+        assert job1["instance_id"] in instance_ids  # one reused the idle row
+        assert len(compute.created) == 2  # seed + the CAS loser's provision
+
 
 class TestVolumeLifecycle:
     async def _active_volume(self, db, project_row, user_row, name="data"):

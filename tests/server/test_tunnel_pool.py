@@ -45,34 +45,34 @@ class TestTunnelPool:
     async def test_reuses_open_tunnel(self):
         log = []
         pool = TunnelPool(opener=_opener_factory(log))
-        p1 = await pool.acquire(PARAMS, 10998, None, None)
-        p2 = await pool.acquire(PARAMS, 10998, None, None)
+        p1 = await pool._acquire_for_tests(PARAMS, 10998, None, None)
+        p2 = await pool._acquire_for_tests(PARAMS, 10998, None, None)
         assert p1 == p2
         assert len(log) == 1  # one ssh process for both polls
 
     async def test_distinct_keys_get_distinct_tunnels(self):
         log = []
         pool = TunnelPool(opener=_opener_factory(log))
-        await pool.acquire(PARAMS, 10998, None, None)
-        await pool.acquire(PARAMS, 10999, None, None)  # other remote port
+        await pool._acquire_for_tests(PARAMS, 10998, None, None)
+        await pool._acquire_for_tests(PARAMS, 10999, None, None)  # other remote port
         other = SSHConnectionParams(hostname="10.0.0.6", username="tpu", port=22)
-        await pool.acquire(other, 10998, None, None)
+        await pool._acquire_for_tests(other, 10998, None, None)
         assert len(log) == 3
 
     async def test_dead_tunnel_reopens(self):
         log = []
         pool = TunnelPool(opener=_opener_factory(log))
-        p1 = await pool.acquire(PARAMS, 10998, None, None)
+        p1 = await pool._acquire_for_tests(PARAMS, 10998, None, None)
         log[0][2]._proc.dead = True  # ssh process died
-        p2 = await pool.acquire(PARAMS, 10998, None, None)
+        p2 = await pool._acquire_for_tests(PARAMS, 10998, None, None)
         assert len(log) == 2 and p1 != p2
 
     async def test_idle_ttl_evicts_and_closes(self):
         log = []
         pool = TunnelPool(idle_ttl=0.05, opener=_opener_factory(log))
-        await pool.acquire(PARAMS, 10998, None, None)
+        await pool._acquire_for_tests(PARAMS, 10998, None, None)
         await asyncio.sleep(0.08)
-        await pool.acquire(PARAMS, 10998, None, None)
+        await pool._acquire_for_tests(PARAMS, 10998, None, None)
         assert len(log) == 2
         assert log[0][2].closed  # evicted tunnel was closed, not leaked
 
@@ -80,7 +80,7 @@ class TestTunnelPool:
         log = []
         pool = TunnelPool(opener=_opener_factory(log))
         ports = await asyncio.gather(
-            *(pool.acquire(PARAMS, 10998, None, None) for _ in range(8))
+            *(pool._acquire_for_tests(PARAMS, 10998, None, None) for _ in range(8))
         )
         assert len(set(ports)) == 1
         assert len(log) == 1
@@ -88,8 +88,8 @@ class TestTunnelPool:
     async def test_close_all(self):
         log = []
         pool = TunnelPool(opener=_opener_factory(log))
-        await pool.acquire(PARAMS, 10998, None, None)
+        await pool._acquire_for_tests(PARAMS, 10998, None, None)
         pool.close_all()
         assert log[0][2].closed
-        await pool.acquire(PARAMS, 10998, None, None)
+        await pool._acquire_for_tests(PARAMS, 10998, None, None)
         assert len(log) == 2

@@ -1,8 +1,8 @@
 """One-command TPU evidence capture for a tunnel window.
 
 The axon tunnel to the real chip comes and goes; when it is up, this
-script captures EVERYTHING round 4 needs in one go and appends each
-result to ``BENCH_TPU_r04_evidence.json``:
+script captures EVERYTHING this round needs in one go and appends each
+result to ``BENCH_TPU_r05_evidence.json``:
 
 1. the full headline bench (train MFU + serve decode + prefix TTFT pair)
 2. Llama-3-8B int8 + int8-KV serving decode/TTFT (BASELINE.md's named
@@ -28,7 +28,7 @@ from datetime import datetime, timezone
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
-EVIDENCE = REPO / "BENCH_TPU_r04_evidence.json"
+EVIDENCE = REPO / "BENCH_TPU_r05_evidence.json"
 
 
 def _now() -> str:

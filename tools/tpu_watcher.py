@@ -1,0 +1,84 @@
+"""TPU tunnel window-watcher (VERDICT r4 item 1).
+
+The axon tunnel to the real chip comes and goes; rounds 2-4 all missed
+their window. This watcher makes capture automatic: probe
+``jax.devices()`` in a short-lived subprocess every PROBE_INTERVAL
+seconds, and the moment the tunnel answers, fire
+``tools/tpu_capture.py`` for every phase that does not yet have a
+successful entry in ``BENCH_TPU_r05_evidence.json``. Keeps watching
+until all phases are captured (a tunnel drop mid-window leaves the
+remaining phases for the next window).
+
+Run it once in the background for the whole session:
+
+    nohup python tools/tpu_watcher.py >> tools/tpu_watcher.log 2>&1 &
+"""
+
+import json
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+EVIDENCE = REPO / "BENCH_TPU_r05_evidence.json"
+PROBE_INTERVAL = 180  # seconds between probes while the tunnel is down
+PROBE_TIMEOUT = 90  # jax TPU init hangs (not errors) when the tunnel is down
+ALL_PHASES = ("headline_bench", "serve_8b_int8", "latency_under_load", "mfu_sweep")
+PHASE_NUM = {name: i + 1 for i, name in enumerate(ALL_PHASES)}
+
+PROBE_SNIPPET = (
+    "import jax; d = jax.devices(); "
+    "assert d and d[0].platform == 'tpu', d; print('tpu ok', len(d))"
+)
+
+
+def _log(msg: str) -> None:
+    ts = datetime.now(timezone.utc).strftime("%H:%M:%SZ")
+    print(f"[{ts}] {msg}", flush=True)
+
+
+def probe() -> bool:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_SNIPPET],
+            cwd=REPO, timeout=PROBE_TIMEOUT, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return proc.returncode == 0
+
+
+def captured_phases() -> set:
+    """Phase names with at least one successful (non-error) entry."""
+    if not EVIDENCE.exists():
+        return set()
+    try:
+        runs = json.loads(EVIDENCE.read_text()).get("runs", [])
+    except ValueError:
+        return set()
+    return {r["phase"] for r in runs if "error" not in r}
+
+
+def main() -> int:
+    _log(f"watcher up; probing every {PROBE_INTERVAL}s")
+    while True:
+        missing = [p for p in ALL_PHASES if p not in captured_phases()]
+        if not missing:
+            _log("all phases captured — watcher done")
+            return 0
+        if probe():
+            nums = ",".join(str(PHASE_NUM[p]) for p in missing)
+            _log(f"TUNNEL UP — capturing phases {nums} ({missing})")
+            subprocess.run(
+                [sys.executable, "tools/tpu_capture.py", "--phases", nums],
+                cwd=REPO,
+            )
+            continue  # immediately re-check what is still missing
+        _log(f"tunnel down (missing: {len(missing)} phases)")
+        time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
