@@ -68,7 +68,20 @@ def _wait_for_tpu(budget_s: float, probe_timeout: float = 120.0) -> dict:
         time.sleep(min(30.0, max(0.0, budget_s - elapsed)))
 
 
-def _bench(quick: bool = False) -> dict:
+def train_bench(
+    config=None,
+    batch: int = 8,
+    seq: int = 1024,
+    steps: int = 20,
+    peak_flops: float = 197e12,
+    opt_bits: int = 32,
+    grad_accum: int = 1,
+    loss_impl: str = "fused",
+) -> dict:
+    """One parameterized train-step measurement (used by the headline
+    bench AND tools/roofline_levers.py's lever sweep). ``batch`` is the
+    TOTAL batch; with ``grad_accum > 1`` each microbatch is
+    batch/grad_accum and one optimizer update covers the whole batch."""
     import jax
     import jax.numpy as jnp
 
@@ -81,28 +94,15 @@ def _bench(quick: bool = False) -> dict:
         sharded_init,
     )
 
-    backend = jax.default_backend()
-    on_tpu = backend in ("tpu", "axon")
-    if on_tpu:
-        config = llama.LLAMA_32_1B
-        # batch 8 saturates the MXU on a single v5e chip (measured:
-        # batch 4 → 0.37 MFU, batch 8 → 0.42; batch 16 exceeds HBM)
-        batch, seq = 8, 1024
-        steps = 10 if quick else 20
-        peak_flops = 197e12  # v5e bf16 per chip
-    else:
-        config = llama.LLAMA_TINY
-        batch, seq = 4, 128
-        steps = 3
-        peak_flops = 1e12  # nominal; CPU numbers are smoke-test only
-
-    n_chips = 1  # bench runs per-chip; multi-chip scaling via dryrun/tests
+    config = config or llama.LLAMA_32_1B
     mesh = make_mesh(
         MeshConfig(dp=1, fsdp=1, sp=1, tp=1), devices=jax.devices()[:1]
     )
-    opt = default_optimizer(lr=1e-4)
+    opt = default_optimizer(lr=1e-4, opt_bits=opt_bits)
     state, _ = sharded_init(config, opt, mesh, seed=0)
-    step_fn = make_train_step(config, opt, mesh)
+    step_fn = make_train_step(
+        config, opt, mesh, grad_accum=grad_accum, loss_impl=loss_impl
+    )
 
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0, config.vocab_size)
     data = {
@@ -139,17 +139,69 @@ def _bench(quick: bool = False) -> dict:
 
     dt = statistics.median(times)
     tokens_per_sec = batch * seq / dt
-    tokens_per_sec_per_chip = tokens_per_sec / n_chips
     fpt = flops_per_token(config, seq)
-    mfu = tokens_per_sec_per_chip * fpt / peak_flops
+    mfu = tokens_per_sec * fpt / peak_flops
     loss = round(float(jax.device_get(m["loss"])), 4)
+    del state, m, data, step_fn, opt
+    jax.clear_caches()
+    return {
+        "tokens_per_sec": tokens_per_sec,
+        "mfu": mfu,
+        "step_time_s": dt,
+        "loss": loss,
+        "batch": batch,
+        "seq": seq,
+        "opt_bits": opt_bits,
+        "grad_accum": grad_accum,
+        "loss_impl": loss_impl,
+    }
+
+
+def _bench(quick: bool = False) -> dict:
+    import jax
+
+    from dstack_tpu.models import llama
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    if on_tpu:
+        config = llama.LLAMA_32_1B
+        # batch 8 saturates the MXU on a single v5e chip (measured:
+        # batch 4 → 0.37 MFU, batch 8 → 0.42; batch 16 exceeds HBM
+        # with f32 Adam state — int8 state lifts that wall, see
+        # DTPU_BENCH_* knobs + tools/roofline_levers.py)
+        batch, seq = 8, 1024
+        steps = 10 if quick else 20
+        peak_flops = 197e12  # v5e bf16 per chip
+    else:
+        config = llama.LLAMA_TINY
+        batch, seq = 4, 128
+        steps = 3
+        peak_flops = 1e12  # nominal; CPU numbers are smoke-test only
+
+    # roofline-lever knobs (official variants; the headline default
+    # stays the honest accum=1/f32 per-step measurement until a lever
+    # is proven ≥ on hardware, then the capture records both)
+    batch = int(os.environ.get("DTPU_BENCH_BATCH", batch))
+    opt_bits = int(os.environ.get("DTPU_BENCH_OPT_BITS", "32"))
+    grad_accum = int(os.environ.get("DTPU_BENCH_GRAD_ACCUM", "1"))
+    loss_impl = os.environ.get("DTPU_BENCH_LOSS_IMPL", "fused")
+
+    n_chips = 1  # bench runs per-chip; multi-chip scaling via dryrun/tests
+    t = train_bench(
+        config=config, batch=batch, seq=seq, steps=steps,
+        peak_flops=peak_flops, opt_bits=opt_bits, grad_accum=grad_accum,
+        loss_impl=loss_impl,
+    )
+    dt = t["step_time_s"]
+    tokens_per_sec_per_chip = t["tokens_per_sec"] / n_chips
+    mfu = t["mfu"]
+    loss = t["loss"]
     # serving measurement (decode tok/s + TTFT) rides along in extra —
     # the driver records ONE line, so both numbers live on it. The
     # training state (params + Adam moments, ~15GB f32 for the 1B
-    # model) must be freed first or the serving engine's second param
-    # copy + KV cache OOMs a 16GB v5e chip.
-    del state, m, data, step_fn, opt
-    jax.clear_caches()
+    # model) was freed by train_bench or the serving engine's second
+    # param copy + KV cache OOMs a 16GB v5e chip.
     try:
         from dstack_tpu.serve.bench import run_bench as serve_bench
 
@@ -194,6 +246,8 @@ def _bench(quick: bool = False) -> dict:
             "seq": seq,
             "loss": loss,
             "params_b": round(config.num_params() / 1e9, 3),
+            "opt_bits": opt_bits,
+            "grad_accum": grad_accum,
             "serve": serve_extra,
         },
     }

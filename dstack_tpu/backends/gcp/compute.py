@@ -83,32 +83,72 @@ fi
 GATEWAY_PORT = 8002
 
 
+GATEWAY_VENVS_DIR = "/root/.dtpu/gateway-venvs"
+
+
+def _gateway_venv_install(version: str) -> str:
+    """Shell fragment: install ``dstack-tpu==version`` into a fresh
+    versioned venv and atomically flip the ``current`` symlink to it —
+    the blue/green step (reference base/compute.py:684-692 installs
+    `/home/ubuntu/dstack/{{version}}` venvs the same way). The previous
+    venv stays on disk for rollback; the symlink flip is `ln -sfn` via a
+    temp name + rename so a crash mid-upgrade never leaves `current`
+    dangling."""
+    vdir = f"{GATEWAY_VENVS_DIR}/{version}"
+    return f"""mkdir -p {GATEWAY_VENVS_DIR}
+if [ ! -x {vdir}/bin/python ] || ! {vdir}/bin/python -c 'import dstack_tpu' 2>/dev/null; then
+  python3 -m venv {vdir}
+  {vdir}/bin/pip install -q dstack-tpu=={version} || {{ rm -rf {vdir}; exit 1; }}
+fi
+ln -s {vdir} {GATEWAY_VENVS_DIR}/.next.$$ && \\
+  mv -T {GATEWAY_VENVS_DIR}/.next.$$ {GATEWAY_VENVS_DIR}/current"""
+
+
 def get_gateway_startup_script(token: str, server_url: str = "") -> str:
     """Startup script for a gateway VM: nginx + certbot + the gateway
-    agent (reference base/compute.py:684-692 blue/green venv install +
-    proxy/gateway/systemd/)."""
+    agent in a versioned venv behind a ``current`` symlink, run as a
+    systemd unit (reference base/compute.py:684-692 blue/green venv
+    install + proxy/gateway/systemd/). The unit survives VM reboots
+    (enabled) and agent crashes (Restart=always); upgrades install a
+    NEW venv and flip the symlink (see get_gateway_upgrade_script) so
+    a failed install never takes down the running version."""
     server_flag = (
         f" \\\n  --server-url {shlex.quote(server_url)}" if server_url else ""
     )
     return f"""#!/bin/bash
 set -e
-apt-get update -q && apt-get install -yq nginx certbot python3-certbot-nginx python3-pip
-python3 -m pip install -q dstack-tpu=={__version__} || true
+apt-get update -q && apt-get install -yq nginx certbot python3-certbot-nginx python3-pip python3-venv
 mkdir -p /root/.dtpu
+{_gateway_venv_install(__version__)}
 cat > /etc/systemd/system/tpu-gateway.service <<'EOF'
 [Unit]
 Description=dstack-tpu gateway agent
 After=network.target nginx.service
 [Service]
-ExecStart=/usr/bin/python3 -m dstack_tpu.gateway.app --port {GATEWAY_PORT} \\
+ExecStart={GATEWAY_VENVS_DIR}/current/bin/python -m dstack_tpu.gateway.app --port {GATEWAY_PORT} \\
   --state-file /root/.dtpu/gateway-state.json --token {shlex.quote(token)} \\
   --nginx-conf-dir /etc/nginx/sites-enabled --access-log /var/log/nginx/access.log{server_flag}
 Restart=always
+RestartSec=2
 [Install]
 WantedBy=multi-user.target
 EOF
 systemctl daemon-reload
 systemctl enable --now tpu-gateway
+"""
+
+
+def get_gateway_upgrade_script(version: str = __version__) -> str:
+    """Blue/green gateway upgrade: install ``version`` into its own
+    venv, flip the ``current`` symlink, restart the unit. State (and
+    the served traffic's nginx configs) live outside the venv
+    (`/root/.dtpu/gateway-state.json`, `/etc/nginx/sites-enabled`), so
+    the new agent restores every service/replica on boot; a failed
+    install leaves the symlink — and the running agent — untouched."""
+    return f"""#!/bin/bash
+set -e
+{_gateway_venv_install(version)}
+systemctl restart tpu-gateway
 """
 
 

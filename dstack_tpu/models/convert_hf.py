@@ -300,6 +300,17 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     raise ValueError(f"unsupported HF model_type {mt!r}")
 
 
+def _v2_mscale_fix() -> bool:
+    """Opt-in: scale DeepSeek-V2 attention like the released model's
+    remote-code modeling (mscale^2 correction) instead of HF's native
+    DeepseekV2Attention. See the comment at the use site."""
+    import os
+
+    return os.environ.get("DTPU_DEEPSEEK_V2_MSCALE_FIX", "").lower() in (
+        "1", "true", "yes"
+    )
+
+
 def _deepseek_config(hf: dict, common: dict, mt: str) -> LlamaConfig:
     """DeepSeek-V2/V3 → LlamaConfig: MLA attention (latent kv, split
     nope/rope head dims, own v dim), dense-prelude + fine-grained MoE
@@ -316,18 +327,17 @@ def _deepseek_config(hf: dict, common: dict, mt: str) -> LlamaConfig:
         v_head_dim=hf["v_head_dim"],
     )
     rs = hf.get("rope_scaling")
-    if v3 and rs and rs.get("mscale_all_dim"):
+    if rs and rs.get("mscale_all_dim") and (v3 or _v2_mscale_fix()):
         # HF DeepseekV3Attention multiplies the softmax scale by
         # yarn mscale(factor, mscale_all_dim)^2 — and HF's native
         # DeepseekV2Attention does NOT (verified against transformers
-        # 4.57.6), so this correction is V3-only here to match HF.
-        # KNOWN DIVERGENCE: DeepSeek's original remote-code V2 modeling
-        # applies the same mscale^2 correction, and V2-Lite ships
-        # mscale_all_dim=0.707 — serving a real V2-Lite checkpoint via
-        # this HF-faithful path runs ~1.59x off the released model's
-        # intended attention scale (an upstream HF-inherited
-        # divergence; the hardcoded DEEPSEEK_V2_LITE preset follows HF
-        # deliberately so parity tests against HF outputs pass).
+        # 4.57.6), while DeepSeek's original remote-code V2 modeling
+        # DOES. V2-Lite ships mscale_all_dim=0.707, so the two versions
+        # disagree by ~1.59x on the intended attention scale. Default
+        # follows HF (so parity tests against HF outputs pass);
+        # DTPU_DEEPSEEK_V2_MSCALE_FIX=1 opts V2 into the released
+        # model's intended scale (the remote-code behavior). V3 always
+        # applies it — both implementations agree there.
         ms = 0.1 * float(rs["mscale_all_dim"]) * math.log(float(rs["factor"])) + 1.0
         qk_dim = hf["qk_nope_head_dim"] + hf["qk_rope_head_dim"]
         mla["attn_scale"] = qk_dim**-0.5 * ms * ms
@@ -930,10 +940,15 @@ def config_to_hf(config: LlamaConfig) -> dict:
             qk_rope_head_dim=c.qk_rope_head_dim,
             v_head_dim=c.v_head_dim,
         )
-        if v3 and c.attn_scale is not None and "rope_scaling" in hf:
+        if (
+            (v3 or _v2_mscale_fix())
+            and c.attn_scale is not None
+            and "rope_scaling" in hf
+        ):
             # invert the mscale^2 softmax-scale correction back into
             # mscale_all_dim so HF reapplies it (and our loader
-            # re-derives attn_scale on the round trip)
+            # re-derives attn_scale on the round trip; V2 only when the
+            # fix flag is on — mirrors the load-side gate)
             factor = hf["rope_scaling"]["factor"]
             ms = math.sqrt(c.attn_scale * c.qk_head_dim**0.5)
             hf["rope_scaling"]["mscale_all_dim"] = (

@@ -152,7 +152,7 @@ async def _process_job(db: Database, job_id: str) -> None:
             continue
         try:
             if volume_rows and not await _attach_volumes_to_reused(
-                db, project_row, volume_rows, volume_regions, row, jpd
+                db, project_row, volume_rows, row, jpd
             ):
                 await instances_service.mark_instance(
                     db, row["id"], InstanceStatus.IDLE
@@ -263,7 +263,6 @@ async def _attach_volumes_to_reused(
     db: Database,
     project_row: dict,
     volume_rows: list[dict],
-    volume_regions: set,
     inst_row: dict,
     jpd: dict,
 ) -> bool:
@@ -272,8 +271,8 @@ async def _attach_volumes_to_reused(
     from dstack_tpu.backends.base.compute import ComputeWithVolumeSupport
     from dstack_tpu.server.services import volumes as volumes_service
 
-    if volume_regions and inst_row.get("region") not in volume_regions:
-        return False
+    # region compatibility is pre-filtered by the caller BEFORE its
+    # instance claim (claiming resets the idle-timeout clock)
     try:
         compute = await backends_service.get_project_backend(
             db, project_row, BackendType(jpd["backend"])

@@ -11,6 +11,8 @@ const state = {
   // run-detail per-job log selection, keyed by run name — survives the
   // page's 5s auto-refresh re-render (null/undefined = job 0 stream)
   jobLogSel: {},
+  // run-detail expanded metric chart selection, keyed by run name
+  expandedMetric: {},
 };
 
 async function api(path, body) {
@@ -196,6 +198,92 @@ function sparkTile(title, series, fmt) {
   }
   tile.append(readout);
   return tile;
+}
+
+/* Full-width time-series chart: y min/max labels, first/last timestamp
+   on the x axis, quarter gridlines, nearest-point hover readout. Used
+   by the run-detail metrics view when a sparkline tile is expanded. */
+function bigChart(title, series, fmt) {
+  const W = 760, H = 180, L = 64, R = 10, T = 10, B = 22;
+  const vals = series.values || [];
+  const tss = series.timestamps || [];
+  const wrap = h("div", {
+    style: "background:var(--panel);border:1px solid var(--border);" +
+      "border-radius:8px;padding:10px 12px;margin:8px 0;max-width:800px",
+  });
+  const readout = h("span", { class: "muted" }, " ");
+  wrap.append(h("div",
+    { style: "display:flex;justify-content:space-between;align-items:baseline" },
+    h("div", { class: "muted",
+      style: "text-transform:uppercase;font-size:11px" }, title),
+    readout));
+  if (vals.length < 2) {
+    wrap.append(h("div", { class: "muted" }, "not enough samples"));
+    return wrap;
+  }
+  const lo = Math.min(...vals), hi = Math.max(...vals);
+  const span = hi - lo || 1;
+  const x = (i) => L + (i / (vals.length - 1)) * (W - L - R);
+  const y = (v) => T + (1 - (v - lo) / span) * (H - T - B);
+  const ns = "http://www.w3.org/2000/svg";
+  const el = (tag, attrs) => {
+    const e = document.createElementNS(ns, tag);
+    for (const [k, v] of Object.entries(attrs)) e.setAttribute(k, v);
+    return e;
+  };
+  const svg = el("svg", {
+    viewBox: `0 0 ${W} ${H}`, width: "100%",
+    style: "max-width:780px;cursor:crosshair",
+  });
+  for (const f of [0, 0.25, 0.5, 0.75, 1]) {
+    const gy = T + f * (H - T - B);
+    svg.append(el("line", {
+      x1: L, y1: gy, x2: W - R, y2: gy,
+      stroke: "var(--border)", "stroke-width": f === 0 || f === 1 ? 1 : 0.5,
+    }));
+  }
+  const label = (txt, lx, ly, anchor) => {
+    const t = el("text", {
+      x: lx, y: ly, "text-anchor": anchor, "font-size": "11",
+      fill: "var(--muted, #888)",
+    });
+    t.textContent = txt;
+    svg.append(t);
+  };
+  label(fmt(hi), L - 6, T + 4, "end");
+  label(fmt(lo), L - 6, H - B, "end");
+  const short = (ts) => {
+    const d = new Date(ts);
+    return isNaN(d) ? String(ts) : d.toLocaleTimeString();
+  };
+  if (tss.length) {
+    label(short(tss[0]), L, H - 6, "start");
+    label(short(tss[tss.length - 1]), W - R, H - 6, "end");
+  }
+  const d = vals.map((v, i) =>
+    `${i ? "L" : "M"}${x(i).toFixed(1)},${y(v).toFixed(1)}`).join("");
+  svg.append(el("path", {
+    d, fill: "none", stroke: "var(--accent)", "stroke-width": 2,
+    "stroke-linejoin": "round",
+  }));
+  const dot = el("circle", { r: 3.5, fill: "var(--accent)", visibility: "hidden" });
+  svg.append(dot);
+  svg.onmousemove = (ev) => {
+    const rect = svg.getBoundingClientRect();
+    const fx = (ev.clientX - rect.left) / rect.width * W;
+    const i = Math.max(0, Math.min(vals.length - 1,
+      Math.round((fx - L) / (W - L - R) * (vals.length - 1))));
+    dot.setAttribute("cx", x(i)); dot.setAttribute("cy", y(vals[i]));
+    dot.setAttribute("visibility", "visible");
+    readout.textContent =
+      `${fmt(vals[i])}${tss[i] ? " @ " + fmtDate(tss[i]) : ""}`;
+  };
+  svg.onmouseleave = () => {
+    dot.setAttribute("visibility", "hidden");
+    readout.textContent = " ";
+  };
+  wrap.append(svg);
+  return wrap;
 }
 
 function currentRoute() {
@@ -495,20 +583,38 @@ async function pageRunDetail(name) {
   }
 
   // hardware metrics: one sparkline tile per series (cpu/mem/TPU duty
-  // cycle/HBM from the agent sampler), latest value as the stat number
+  // cycle/HBM from the agent sampler), latest value as the stat number;
+  // clicking a tile expands it into a full time-axis chart below (the
+  // choice survives the page's auto-refresh re-render)
   const metricsDiv = h("div",
     { style: "display:flex;flex-wrap:wrap;gap:10px" },
     h("div", { class: "muted" }, "loading…"));
+  const chartDiv = h("div", {});
   (async () => {
     const jm = await papi("/metrics/job", { run_name: name, limit: 60 });
     const fmtFor = (n) => n.includes("bytes")
       ? (v) => `${(v / 1024 / 1024).toFixed(0)} MiB`
       : n.includes("percent") ? (v) => `${Number(v).toFixed(1)}%` : (v) => String(v);
-    const tiles = (jm.metrics || [])
-      .filter((m) => m.values?.length)
-      .map((m) => sparkTile(m.name.replace(/_/g, " "), m, fmtFor(m.name)));
+    const avail = (jm.metrics || []).filter((m) => m.values?.length);
+    function drawChart() {
+      const sel = avail.find((m) => m.name === state.expandedMetric[name]);
+      chartDiv.replaceChildren(
+        sel ? bigChart(sel.name.replace(/_/g, " "), sel, fmtFor(sel.name)) : "");
+    }
+    const tiles = avail.map((m) => {
+      const tile = sparkTile(m.name.replace(/_/g, " "), m, fmtFor(m.name));
+      tile.style.cursor = "pointer";
+      tile.title = "click to expand";
+      tile.onclick = () => {
+        state.expandedMetric[name] =
+          state.expandedMetric[name] === m.name ? null : m.name;
+        drawChart();
+      };
+      return tile;
+    });
     metricsDiv.replaceChildren(
       ...(tiles.length ? tiles : [h("div", { class: "muted" }, "no samples yet")]));
+    drawChart();
   })().catch(() => metricsDiv.replaceChildren(h("div", { class: "muted" }, "unavailable")));
 
   return h("div", {},
@@ -538,6 +644,7 @@ async function pageRunDetail(name) {
       : null,
     h("h1", {}, "Hardware metrics"),
     metricsDiv,
+    chartDiv,
     h("h1", {}, "Logs"),
     logsPre,
   );

@@ -1,0 +1,144 @@
+"""8-bit Adam state: blockwise log-quantized first/second moments.
+
+The optimizer update is the pure-bandwidth tail of a train step: f32
+mu+nu for a 1.2B model is 9.9 GB read+written per step with ~zero FLOPs
+(docs/guides/perf-roofline.md item 1, ~33 ms on a v5e). Storing both
+moments as int8 with per-256-block f32 scales cuts that state to ~2.6 GB
+— the decode/encode is elementwise VPU work fused into the (HBM-bound)
+update, so the phase speeds up by roughly the byte ratio. It also frees
+~7.4 GB of HBM, enough to lift the train batch past the f32-Adam OOM
+wall measured in round 2.
+
+Scheme (TPU-first, no codebook gathers): per block of 256 along the
+last axis, scale = absmax; magnitudes are coded on a log grid spanning
+1e-6..1 of the block scale (127 levels + sign), giving a uniform ~±5%
+relative decode error across six decades — the property linear int8
+lacks and the reason bitsandbytes-style 8-bit Adam uses a dynamic map.
+Moment noise at that level is far below gradient noise; the parity test
+(tests/compute/test_llama.py) trains the same model under f32 and int8
+state and asserts matching loss trajectories.
+
+Leaves whose last dim is not a multiple of the block, or with fewer
+than 16384 elements (norm scales, biases), stay f32 — their traffic is
+negligible and tiny blocks quantize poorly.
+"""
+
+import math
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BLOCK = 256
+_LN_RANGE = -math.log(1e-6)  # magnitude grid spans [1e-6, 1] of blockmax
+_MIN_QUANT_SIZE = 16384
+
+
+def _is_quantized(shape: tuple) -> bool:
+    size = 1
+    for d in shape:
+        size *= d
+    return (
+        len(shape) >= 1
+        and shape[-1] % BLOCK == 0
+        and size >= _MIN_QUANT_SIZE
+    )
+
+
+def q8_encode(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """f32 [..., D] -> (int8 [..., D], f32 scales [..., D/BLOCK])."""
+    shape = x.shape
+    xb = x.reshape(shape[:-1] + (shape[-1] // BLOCK, BLOCK))
+    scale = jnp.maximum(jnp.max(jnp.abs(xb), axis=-1, keepdims=True), 1e-30)
+    n = xb / scale
+    mag = jnp.clip(jnp.abs(n), 1e-6, 1.0)
+    code = jnp.round((1.0 + jnp.log(mag) / _LN_RANGE) * 127.0)
+    q = (jnp.sign(n) * code).astype(jnp.int8).reshape(shape)
+    return q, scale[..., 0]
+
+
+def q8_decode(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """(int8 [..., D], f32 [..., D/BLOCK]) -> f32 [..., D]."""
+    shape = q.shape
+    qf = q.astype(jnp.float32).reshape(shape[:-1] + (shape[-1] // BLOCK, BLOCK))
+    mag = jnp.exp((jnp.abs(qf) / 127.0 - 1.0) * _LN_RANGE)
+    # sign(0) = 0 keeps exact zeros exact
+    val = jnp.sign(qf) * mag * scale[..., None]
+    return val.reshape(shape)
+
+
+class ScaleByAdam8State(NamedTuple):
+    count: jax.Array
+    mu: Any  # per-leaf: int8 codes (quantized) or f32 moment (small leaf)
+    mu_scale: Any  # per-leaf: f32 [..., nblocks] or f32 scalar placeholder
+    nu: Any
+    nu_scale: Any
+
+
+def scale_by_adam8(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        def enc_zero(p):
+            if _is_quantized(p.shape):
+                return q8_encode(jnp.zeros(p.shape, jnp.float32))
+            return jnp.zeros(p.shape, jnp.float32), jnp.zeros((), jnp.float32)
+
+        enc = jax.tree.map(enc_zero, params)
+        mu = jax.tree.map(lambda t: t[0], enc, is_leaf=lambda t: isinstance(t, tuple))
+        sc = jax.tree.map(lambda t: t[1], enc, is_leaf=lambda t: isinstance(t, tuple))
+        return ScaleByAdam8State(
+            count=jnp.zeros((), jnp.int32), mu=mu, mu_scale=sc,
+            nu=jax.tree.map(jnp.copy, mu), nu_scale=jax.tree.map(jnp.copy, sc),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def per_leaf(g, mu_q, mu_s, nu_q, nu_s):
+            g = g.astype(jnp.float32)
+            quant = _is_quantized(g.shape)
+            mu = q8_decode(mu_q, mu_s) if quant else mu_q
+            nu = q8_decode(nu_q, nu_s) if quant else nu_q
+            mu = b1 * mu + (1.0 - b1) * g
+            nu = b2 * nu + (1.0 - b2) * g * g
+            upd = (mu / c1) / (jnp.sqrt(nu / c2) + eps)
+            if quant:
+                mu_q, mu_s = q8_encode(mu)
+                nu_q, nu_s = q8_encode(nu)
+            else:
+                mu_q, mu_s, nu_q, nu_s = mu, mu_s, nu, nu_s
+            return upd, mu_q, mu_s, nu_q, nu_s
+
+        out = jax.tree.map(
+            per_leaf, updates, state.mu, state.mu_scale, state.nu, state.nu_scale
+        )
+        pick = lambda i: jax.tree.map(
+            lambda t: t[i], out, is_leaf=lambda t: isinstance(t, tuple)
+        )
+        new_state = ScaleByAdam8State(
+            count=count, mu=pick(1), mu_scale=pick(2), nu=pick(3), nu_scale=pick(4)
+        )
+        return pick(0), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adamw8(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mask: Optional[Any] = None,
+) -> optax.GradientTransformation:
+    """AdamW with int8 moment state (drop-in for ``optax.adamw``)."""
+    return optax.chain(
+        scale_by_adam8(b1=b1, b2=b2, eps=eps),
+        optax.add_decayed_weights(weight_decay, mask),
+        optax.scale_by_learning_rate(learning_rate),
+    )

@@ -849,6 +849,55 @@ class TestQwen3Moe:
             seq.append(nxt)
         assert out == ref
 
+    def test_deepseek_v2_mscale_flag(self, monkeypatch):
+        """V2-Lite attention-scale policy: default follows HF's native
+        DeepseekV2Attention (no mscale^2 correction — attn_scale unset);
+        DTPU_DEEPSEEK_V2_MSCALE_FIX=1 applies the released model's
+        remote-code correction; V3 always applies it (VERDICT r4 #6)."""
+        import math
+
+        from dstack_tpu.models.convert_hf import config_from_hf
+
+        def v2_lite(model_type):
+            # the fields _deepseek_config reads, V2-Lite values where it
+            # matters (mscale_all_dim=0.707, yarn factor=40)
+            return {
+                "model_type": model_type,
+                "hidden_size": 128, "num_attention_heads": 4,
+                "num_hidden_layers": 2, "num_key_value_heads": 4,
+                "intermediate_size": 256, "vocab_size": 128,
+                "rms_norm_eps": 1e-6, "max_position_embeddings": 163840,
+                "rope_theta": 10000.0,
+                "q_lora_rank": None, "kv_lora_rank": 32,
+                "qk_nope_head_dim": 32, "qk_rope_head_dim": 16,
+                "v_head_dim": 24, "head_dim": 16,
+                "first_k_dense_replace": 2,
+                "rope_scaling": {
+                    "rope_type": "yarn", "factor": 40.0,
+                    "mscale": 0.707, "mscale_all_dim": 0.707,
+                    "original_max_position_embeddings": 4096,
+                    "beta_fast": 32, "beta_slow": 1,
+                },
+                # V3-only router fields (ignored by the dense-only path)
+                "n_group": 1, "topk_group": 1,
+            }
+
+        monkeypatch.delenv("DTPU_DEEPSEEK_V2_MSCALE_FIX", raising=False)
+        assert config_from_hf(v2_lite("deepseek_v2")).attn_scale is None
+
+        ms = 0.1 * 0.707 * math.log(40.0) + 1.0
+        expected = 48 ** -0.5 * ms * ms  # qk_dim = 32 nope + 16 rope
+        monkeypatch.setenv("DTPU_DEEPSEEK_V2_MSCALE_FIX", "1")
+        fixed = config_from_hf(v2_lite("deepseek_v2")).attn_scale
+        assert fixed == pytest.approx(expected)
+        # the correction is the documented ~1.59x over the HF default
+        assert fixed / 48 ** -0.5 == pytest.approx(ms * ms, rel=1e-6)
+        assert ms * ms == pytest.approx(1.59, abs=5e-3)
+
+        monkeypatch.delenv("DTPU_DEEPSEEK_V2_MSCALE_FIX", raising=False)
+        v3 = config_from_hf(v2_lite("deepseek_v3")).attn_scale
+        assert v3 == pytest.approx(expected)  # V3 applies it always
+
     def test_deepseek_v2_mla_dense(self, tmp_path):
         """MLA attention alone (every layer dense): latent kv projection,
         split nope/rope head dims, shared single-head rope key, own v

@@ -186,3 +186,97 @@ class TestGradAccum:
                 np.asarray(a, np.float32), np.asarray(b, np.float32),
                 rtol=2e-3, atol=2e-5,
             )
+
+
+class TestAdam8:
+    """8-bit optimizer state (train/opt8.py): quantization quality,
+    training parity with f32 Adam, and sharded execution."""
+
+    def test_q8_roundtrip_relative_error(self):
+        from dstack_tpu.train.opt8 import q8_decode, q8_encode
+
+        rng = np.random.default_rng(0)
+        # six decades of magnitude, mixed signs — the case linear int8 fails
+        x = jnp.asarray(
+            rng.standard_normal((64, 512))
+            * 10.0 ** rng.uniform(-6, 0, (64, 512)),
+            jnp.float32,
+        )
+        q, s = q8_encode(x)
+        assert q.dtype == jnp.int8 and s.shape == (64, 2)
+        y = q8_decode(q, s)
+        rel = np.abs(np.asarray(y - x)) / np.maximum(np.abs(np.asarray(x)), 1e-30)
+        # log grid spacing gives ~±5.6% worst-case within the grid range
+        within = np.abs(np.asarray(x)) >= np.asarray(s)[..., None].repeat(256, -1).reshape(64, 512) * 2e-6
+        assert np.quantile(rel[np.asarray(within)], 0.99) < 0.06
+        # zeros stay exactly zero
+        z, zs = q8_encode(jnp.zeros((1, 256)))
+        assert np.all(np.asarray(q8_decode(z, zs)) == 0.0)
+
+    def test_training_parity_with_f32_adam(self):
+        """Same model, same data: int8-state Adam must track f32 Adam's
+        loss trajectory (moment noise << gradient noise)."""
+        cfg = llama.dataclasses.replace(
+            CFG, hidden_size=256, intermediate_size=512, n_heads=4,
+            n_kv_heads=2, head_dim=64,
+        )
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=1))
+        tokens = jax.random.randint(jax.random.key(3), (4, 32), 0, cfg.vocab_size)
+        batch = {
+            "tokens": tokens,
+            "targets": jnp.roll(tokens, -1, axis=1),
+            "mask": jnp.ones_like(tokens),
+        }
+
+        def train(opt_bits):
+            opt = default_optimizer(lr=1e-2, warmup=1, decay_steps=100,
+                                    opt_bits=opt_bits)
+            state, _ = sharded_init(cfg, opt, mesh, seed=0)
+            step = make_train_step(cfg, opt, mesh)
+            losses = []
+            for _ in range(20):
+                state, metrics = step(state, batch)
+                losses.append(float(metrics["loss"]))
+            return losses
+
+        l32, l8 = train(32), train(8)
+        assert l8[-1] < l8[0] * 0.7, l8  # int8 run actually learns
+        # trajectories agree step by step within ~10% (moment
+        # quantization noise; measured max deviation ~9% at one step)
+        np.testing.assert_allclose(l8, l32, rtol=0.12)
+
+    def test_int8_state_is_int8_and_sharded(self):
+        """The moment codes shard like their params; the per-block scale
+        tensors shard on the leading axes with the last axis replicated."""
+        from dstack_tpu.train.opt8 import ScaleByAdam8State
+
+        cfg = llama.dataclasses.replace(
+            CFG, hidden_size=256, intermediate_size=512, n_heads=4,
+            n_kv_heads=2, head_dim=64,
+        )
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=4))
+        opt = default_optimizer(opt_bits=8)
+        state, _ = sharded_init(cfg, opt, mesh, seed=0)
+        adam = next(
+            s for s in jax.tree.leaves(
+                state["opt_state"],
+                is_leaf=lambda s: isinstance(s, ScaleByAdam8State),
+            )
+            if isinstance(s, ScaleByAdam8State)
+        )
+        embed_q = adam.mu["embed"]
+        assert embed_q.dtype == jnp.int8
+        assert embed_q.sharding == state["params"]["embed"].sharding
+        # scale: [vocab, hidden/256]; leading axis sharded like embed
+        sc = adam.mu_scale["embed"]
+        assert sc.shape == (cfg.vocab_size, cfg.hidden_size // 256)
+        # one step executes end to end on the mesh
+        step = make_train_step(cfg, opt, mesh)
+        tokens = jax.random.randint(jax.random.key(5), (4, 32), 0, cfg.vocab_size)
+        batch = {
+            "tokens": tokens,
+            "targets": jnp.roll(tokens, -1, axis=1),
+            "mask": jnp.ones_like(tokens),
+        }
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))

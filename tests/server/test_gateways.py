@@ -182,6 +182,63 @@ class TestGatewayAgent:
             assert r.status == 401
 
 
+class TestGatewayAgentRestart:
+    async def test_agent_restart_restores_services(self, tmp_path):
+        """Kill-and-restart through the FULL app: a second agent booted
+        from the same state file must route the registered service
+        without re-registration (systemd Restart=always + persisted
+        state is the gateway's crash story)."""
+        async with _upstream() as up:
+            async with _agent_client(tmp_path) as (client, _):
+                await _register_svc(client, model_name="llama-3-8b")
+                await _register_replica(client, up.server.port)
+                r = await client.get("/services/main/svc1/ping")
+                assert r.status == 200
+            # first agent is gone; boot a replacement on the same state
+            async with _agent_client(tmp_path) as (client2, agent2):
+                r = await client2.get("/services/main/svc1/v1/chat")
+                assert r.status == 200
+                body = await r.json()
+                assert body["who"] == "replica-1"
+                assert agent2.state.by_model("main", "llama-3-8b") is not None
+
+
+class TestGatewayInstallScripts:
+    def test_startup_script_blue_green(self):
+        """The VM startup script installs a VERSIONED venv behind a
+        `current` symlink and runs the agent as an enabled systemd unit
+        (reference base/compute.py:684-692 + proxy/gateway/systemd/)."""
+        from dstack_tpu import __version__
+        from dstack_tpu.backends.gcp.compute import (
+            GATEWAY_VENVS_DIR,
+            get_gateway_startup_script,
+        )
+
+        s = get_gateway_startup_script("tok-123", "https://srv.example")
+        assert f"{GATEWAY_VENVS_DIR}/{__version__}" in s  # versioned venv
+        assert f"mv -T {GATEWAY_VENVS_DIR}/.next.$$ {GATEWAY_VENVS_DIR}/current" in s
+        assert f"ExecStart={GATEWAY_VENVS_DIR}/current/bin/python" in s
+        assert "Restart=always" in s
+        assert "systemctl enable --now tpu-gateway" in s
+        assert "--server-url https://srv.example" in s
+        # state and nginx configs live OUTSIDE the venv: upgrades keep them
+        assert "--state-file /root/.dtpu/gateway-state.json" in s
+
+    def test_upgrade_script_flips_and_restarts(self):
+        from dstack_tpu.backends.gcp.compute import (
+            GATEWAY_VENVS_DIR,
+            get_gateway_upgrade_script,
+        )
+
+        s = get_gateway_upgrade_script("9.9.9")
+        assert f"{GATEWAY_VENVS_DIR}/9.9.9" in s
+        assert "systemctl restart tpu-gateway" in s
+        # a failed install must leave `current` untouched: set -e aborts
+        # BEFORE the symlink flip
+        assert s.index("pip install") < s.index("mv -T")
+        assert s.startswith("#!/bin/bash\nset -e")
+
+
 class TestGatewayState:
     def test_persistence_roundtrip(self, tmp_path):
         path = tmp_path / "state.json"

@@ -1,0 +1,101 @@
+"""Measure the roofline levers from docs/guides/perf-roofline.md.
+
+Round-4 verdict item 2: the levers (8-bit optimizer state, grad
+accumulation, the batch size the f32-Adam OOM wall forbade) were
+analyzed, not measured. This sweep runs each variant of the 1B train
+bench on the visible accelerator and prints one JSON line per variant,
+worst-case-isolated in subprocesses so an OOM variant doesn't sink the
+sweep. ``tools/tpu_capture.py`` runs it as phase 5 when the tunnel is
+up; results land in ``BENCH_TPU_r05_evidence.json``.
+
+Variants (all Llama-3.2-1B, seq 1024, single chip):
+  base        batch 8,  f32 Adam, accum 1   — round-3 headline config
+  opt8        batch 8,  int8 Adam, accum 1  — halves the optimizer tail
+  opt8-b16    batch 16, int8 Adam, accum 1  — the freed ~7.4 GB buys 2x batch
+  opt8-accum  batch 32, int8 Adam, accum 4  — amortizes the update 4x
+              (microbatch 8 keeps the matmul M; chunked CE keeps logits
+              HBM at one chunk so the bigger batch fits)
+"""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+VARIANTS = [
+    ("base", dict(batch=8, opt_bits=32, grad_accum=1, loss_impl="fused")),
+    ("opt8", dict(batch=8, opt_bits=8, grad_accum=1, loss_impl="fused")),
+    ("opt8-b16", dict(batch=16, opt_bits=8, grad_accum=1, loss_impl="fused")),
+    ("opt8-accum", dict(batch=32, opt_bits=8, grad_accum=4, loss_impl="chunked")),
+]
+
+CHILD = """
+import json, sys
+import jax
+spec = json.loads(sys.argv[1])
+if spec.pop("_force_cpu", False):
+    # the axon sitecustomize force-registers the TPU plugin; with the
+    # tunnel down its init HANGS, so the parent probes first and tells
+    # us to pin cpu (config.update after import — env alone loses)
+    jax.config.update("jax_platforms", "cpu")
+from dstack_tpu.models import llama
+from bench import train_bench
+on_tpu = jax.default_backend() in ("tpu", "axon")
+cfg = llama.LLAMA_32_1B if on_tpu else llama.LLAMA_TINY
+if not on_tpu:
+    spec["batch"] = max(spec["batch"] // 4, spec.get("grad_accum", 1))
+    r = train_bench(config=cfg, seq=128, steps=3, peak_flops=1e12, **spec)
+else:
+    r = train_bench(config=cfg, seq=1024, steps=10, **spec)
+print(json.dumps(r))
+"""
+
+
+def _tpu_reachable(timeout: float = 90.0) -> bool:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            timeout=timeout, capture_output=True, text=True,
+        )
+        return proc.returncode == 0 and "ok" in proc.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def main() -> int:
+    force_cpu = not _tpu_reachable()
+    if force_cpu:
+        print(json.dumps({"note": "TPU unreachable; cpu smoke numbers only"}))
+    for name, spec in VARIANTS:
+        spec = {**spec, "_force_cpu": force_cpu}
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", CHILD, json.dumps(spec)],
+                cwd=REPO, timeout=1500, capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"variant": name, "error": "timeout 1500s"}))
+            continue
+        lines = [l for l in proc.stdout.strip().splitlines() if l.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            print(json.dumps({
+                "variant": name,
+                "error": (proc.stderr or proc.stdout).strip()[-300:],
+            }))
+            continue
+        out = json.loads(lines[-1])
+        out["variant"] = name
+        out["wall_s"] = round(time.time() - t0, 1)
+        for k in ("mfu", "step_time_s", "tokens_per_sec"):
+            if k in out:
+                out[k] = round(out[k], 4)
+        print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,14 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
 EVIDENCE = REPO / "BENCH_TPU_r05_evidence.json"
+# phase N (1-based) = PHASES[N-1]; tpu_watcher.py imports both names
+PHASES = (
+    "headline_bench",
+    "serve_8b_int8",
+    "latency_under_load",
+    "mfu_sweep",
+    "roofline_levers",
+)
 
 
 def _now() -> str:
@@ -83,7 +91,7 @@ def _run(phase: str, cmd: list, timeout: int) -> None:
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true")
-    p.add_argument("--phases", default="1,2,3,4")
+    p.add_argument("--phases", default="1,2,3,4,5")
     args = p.parse_args()
     phases = {int(x) for x in args.phases.split(",")}
     py = sys.executable
@@ -117,6 +125,12 @@ def main() -> int:
         _run("mfu_sweep",
              [py, "tools/mfu_sweep.py"],
              timeout=2700)
+    if 5 in phases:
+        # roofline levers (verdict r4 #2): int8 Adam state, lifted
+        # batch, grad accumulation — one JSON line per variant
+        _run("roofline_levers",
+             [py, "tools/roofline_levers.py"],
+             timeout=5400)
     print(f"capture done {_now()}", flush=True)
     return 0
 

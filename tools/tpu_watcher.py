@@ -5,9 +5,11 @@ their window. This watcher makes capture automatic: probe
 ``jax.devices()`` in a short-lived subprocess every PROBE_INTERVAL
 seconds, and the moment the tunnel answers, fire
 ``tools/tpu_capture.py`` for every phase that does not yet have a
-successful entry in ``BENCH_TPU_r05_evidence.json``. Keeps watching
-until all phases are captured (a tunnel drop mid-window leaves the
-remaining phases for the next window).
+successful entry in the evidence file. Keeps watching until every phase
+is captured or has burned MAX_ATTEMPTS failed tries (a tunnel drop
+mid-window leaves the remaining phases for the next window; a
+deterministically failing phase is abandoned instead of retried
+forever).
 
 Run it once in the background for the whole session:
 
@@ -21,12 +23,13 @@ import time
 from datetime import datetime, timezone
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from tpu_capture import EVIDENCE, PHASES  # single source of truth
+
 REPO = Path(__file__).resolve().parents[1]
-EVIDENCE = REPO / "BENCH_TPU_r05_evidence.json"
 PROBE_INTERVAL = 180  # seconds between probes while the tunnel is down
 PROBE_TIMEOUT = 90  # jax TPU init hangs (not errors) when the tunnel is down
-ALL_PHASES = ("headline_bench", "serve_8b_int8", "latency_under_load", "mfu_sweep")
-PHASE_NUM = {name: i + 1 for i, name in enumerate(ALL_PHASES)}
+MAX_ATTEMPTS = 3  # errors per phase before giving up on it
 
 PROBE_SNIPPET = (
     "import jax; d = jax.devices(); "
@@ -50,32 +53,46 @@ def probe() -> bool:
     return proc.returncode == 0
 
 
-def captured_phases() -> set:
-    """Phase names with at least one successful (non-error) entry."""
+def phase_states() -> tuple[set, dict]:
+    """(phases with a successful entry, error counts per phase)."""
+    ok, errors = set(), {}
     if not EVIDENCE.exists():
-        return set()
+        return ok, errors
     try:
         runs = json.loads(EVIDENCE.read_text()).get("runs", [])
     except ValueError:
-        return set()
-    return {r["phase"] for r in runs if "error" not in r}
+        return ok, errors
+    for r in runs:
+        if "error" in r:
+            errors[r["phase"]] = errors.get(r["phase"], 0) + 1
+        else:
+            ok.add(r["phase"])
+    return ok, errors
 
 
 def main() -> int:
-    _log(f"watcher up; probing every {PROBE_INTERVAL}s")
+    _log(f"watcher up; probing every {PROBE_INTERVAL}s; phases: {PHASES}")
     while True:
-        missing = [p for p in ALL_PHASES if p not in captured_phases()]
+        ok, errors = phase_states()
+        missing = [p for p in PHASES if p not in ok]
+        live = [p for p in missing if errors.get(p, 0) < MAX_ATTEMPTS]
         if not missing:
             _log("all phases captured — watcher done")
             return 0
+        if not live:
+            _log(f"gave up: {missing} failed {MAX_ATTEMPTS}x each — watcher done")
+            return 1
         if probe():
-            nums = ",".join(str(PHASE_NUM[p]) for p in missing)
-            _log(f"TUNNEL UP — capturing phases {nums} ({missing})")
+            nums = ",".join(str(PHASES.index(p) + 1) for p in live)
+            _log(f"TUNNEL UP — capturing phases {nums} ({live})")
             subprocess.run(
                 [sys.executable, "tools/tpu_capture.py", "--phases", nums],
                 cwd=REPO,
             )
-            continue  # immediately re-check what is still missing
+            # re-probe on the next iteration, but never spin: a capture
+            # that failed instantly would otherwise loop back-to-back
+            time.sleep(30)
+            continue
         _log(f"tunnel down (missing: {len(missing)} phases)")
         time.sleep(PROBE_INTERVAL)
 
