@@ -111,6 +111,21 @@ class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
             topology = labels.get(
                 "cloud.google.com/gke-tpu-topology", f"1x{tpu_count}"
             )
+            topo_chips = 1
+            for d in topology.split("x"):
+                topo_chips *= int(d) if d.isdigit() else 1
+            if topo_chips > tpu_count:
+                # the node is ONE HOST of a multi-host slice pool
+                # (topology spans more chips than this node holds): a
+                # lone pod pinned here would hang in TPU runtime init —
+                # gang scheduling is the GCP backend's job
+                logger.warning(
+                    "kubernetes node %s is part of a multi-host TPU "
+                    "slice (%s topology, %d chips/node); skipping — "
+                    "no gang scheduling on this backend",
+                    node["metadata"]["name"], topology, tpu_count,
+                )
+                return None
             tpu = TPUInfo(
                 version=version,
                 chips=tpu_count,
@@ -134,9 +149,18 @@ class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
     async def get_offers(
         self, requirements: Requirements
     ) -> list[InstanceOfferWithAvailability]:
+        res = requirements.resources
+        if res.tpu is not None and (res.tpu.slices or 1) > 1:
+            # multislice needs gang scheduling (JobSet); refuse loudly
+            # here so get_plan can tell the user at apply time instead
+            # of a late scheduler no-capacity failure
+            logger.warning(
+                "kubernetes backend: multislice TPU request refused "
+                "(no gang scheduling; use the gcp backend)"
+            )
+            return []
         nodes = await run_async(self.api.list_nodes)
         offers = []
-        res = requirements.resources
         for node in nodes:
             offer = self._node_offer(node)
             if offer is None:

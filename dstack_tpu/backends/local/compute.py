@@ -75,11 +75,39 @@ class LocalCompute(
         self, requirements: Requirements
     ) -> list[InstanceOfferWithAvailability]:
         res = requirements.resources
+        tpu_info = None
         if res.tpu is not None:
-            # Local host has no schedulable TPU slices unless detected.
+            # Local host has no schedulable TPU slices unless detected —
+            # or faked via DTPU_LOCAL_FAKE_TPU=v5e-8 for e2e tests of
+            # the multislice rendezvous wiring (each local "slice" is a
+            # shim subprocess; the job runs on CPU).
+            import os
+
             from dstack_tpu.agent.python.shim import detect_tpu
 
-            if detect_tpu() is None:
+            fake = os.environ.get("DTPU_LOCAL_FAKE_TPU")
+            if fake:
+                from dstack_tpu.core.catalog.tpu import GENERATIONS, TPU_SLICES
+                from dstack_tpu.core.models.instances import TPUInfo
+
+                version, _, chips_s = fake.rpartition("-")
+                shape = next(
+                    (
+                        s for s in TPU_SLICES
+                        if s.version == version and s.chips == int(chips_s or 0)
+                    ),
+                    None,
+                )
+                if shape is None:
+                    return []
+                tpu_info = TPUInfo(
+                    version=shape.version,
+                    chips=shape.chips,
+                    topology=shape.topology,
+                    hosts=shape.hosts,
+                    chips_per_host=GENERATIONS[shape.version].chips_per_host,
+                )
+            elif detect_tpu() is None:
                 return []
         # Dev backend: offer the host as-is without cpu/mem minimum
         # filtering (the reference local backend offers its fake instance
@@ -91,7 +119,8 @@ class LocalCompute(
             instance=InstanceType(
                 name="local",
                 resources=Resources(
-                    cpus=cpus, memory_mib=mem_mib, spot=False, disk_size_mib=51200
+                    cpus=cpus, memory_mib=mem_mib, spot=False,
+                    disk_size_mib=51200, tpu=tpu_info,
                 ),
             ),
             region="local",

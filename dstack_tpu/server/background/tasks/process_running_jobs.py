@@ -532,17 +532,21 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
                 job_name=job_spec.job_name,
                 # wire contract: the submitted job_num is the rank the
                 # runner feeds cluster_env() — the WITHIN-SLICE worker id
-                # for multi-worker slice jobs (jpd.worker_id; cluster_env
-                # derives the global rank from slice_id), the global
-                # job_num otherwise. A 1-host jpd (local/self-entry)
-                # must NOT shadow sibling-instance ranks: every node of
-                # a `nodes: N` run would submit as rank 0.
+                # for slice jobs (jpd.worker_id; cluster_env derives the
+                # global rank from slice_id), the global job_num
+                # otherwise. Two traps pinned by tests: a 1-host jpd
+                # (local/self-entry) must NOT shadow sibling-instance
+                # ranks (every node would submit as rank 0), and a
+                # 1-host-per-slice MULTISLICE job must NOT leak its
+                # global job_num as the within-slice rank (cluster_env
+                # would double-count it on top of slice_id).
                 job_spec={
                     **job_spec.model_dump(),
                     "env": env,  # secrets references resolved
                     "job_num": (
                         jpd.worker_id
-                        if jpd.hosts and len(jpd.hosts) > 1
+                        if (jpd.hosts and len(jpd.hosts) > 1)
+                        or cluster_info.num_slices > 1
                         else job_spec.job_num
                     ),
                 },

@@ -82,7 +82,14 @@ async def _process_job(db: Database, job_id: str) -> None:
 
     profile = run_spec.effective_profile()
     requirements = job_spec.requirements
-    multinode = job_spec.jobs_per_replica > 1 or requirements.resources.tpu is not None
+    # multinode gates backends lacking ComputeWithMultinodeSupport. A
+    # single-host TPU job must NOT set it — kubernetes (single-host TPU
+    # pods, no gang scheduling) would be excluded from offers it can
+    # legitimately serve
+    tpu_req_ = requirements.resources.tpu
+    multinode = job_spec.jobs_per_replica > 1 or (
+        tpu_req_ is not None and (tpu_req_.slices or 1) > 1
+    )
 
     # Resolve the run's named volumes up front: both the reuse and the
     # provision path must co-locate with the disks' zone (reference

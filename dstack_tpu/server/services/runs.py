@@ -172,16 +172,28 @@ def filter_multislice_offers(run_spec: RunSpec, offers: list) -> list:
     return conforming
 
 
+def _tpu_needs_multiple_hosts(tpu_req) -> bool:
+    """True when the requested slice cannot fit one worker host for ANY
+    allowed generation (e.g. v5e chips>=16 spans >=2 hosts) — such runs
+    need gang scheduling even with slices=1/nodes=1."""
+    from dstack_tpu.core.catalog.tpu import GENERATIONS
+
+    versions = tpu_req.version or list(GENERATIONS)
+    max_cph = max(
+        GENERATIONS[v].chips_per_host for v in versions if v in GENERATIONS
+    )
+    return (tpu_req.chips.min or 1) > max_cph
+
+
 async def get_plan(
     db: Database, project_row: dict, user_row: dict, run_spec: RunSpec
 ) -> RunPlan:
     run_spec = _prepare_run_spec(run_spec)
+    tpu_req = run_spec.configuration.resources.tpu
     multinode = (
         isinstance(run_spec.configuration, TaskConfiguration)
         and run_spec.configuration.nodes > 1
-    ) or (
-        run_spec.configuration.resources.tpu is not None
-    )
+    ) or (tpu_req is not None and (tpu_req.slices or 1) > 1)
     project_backends = await backends_service.get_project_backends(db, project_row)
     offers = await get_offers_by_requirements(
         project_backends,
@@ -191,6 +203,23 @@ async def get_plan(
     )
     job_specs = get_job_specs_from_run_spec(run_spec, replica_num=0)
     offers = filter_multislice_offers(run_spec, offers)
+    if not offers and tpu_req is not None and (
+        multinode or _tpu_needs_multiple_hosts(tpu_req)
+    ):
+        from dstack_tpu.core.models.backends import BackendType
+
+        if project_backends and all(
+            b == BackendType.KUBERNETES for b, _ in project_backends
+        ):
+            # loud refusal AT APPLY instead of a scheduler no-capacity
+            # failure later: multi-host gang scheduling is the GCP
+            # backend's job (kubernetes/compute.py module docstring)
+            raise ConfigurationError(
+                "multi-host / multislice TPU runs need gang scheduling, "
+                "which the kubernetes backend does not implement "
+                "(single-host TPU pods only); configure the gcp backend "
+                "for this run"
+            )
     job_plans = [
         JobPlan(
             job_spec=spec,

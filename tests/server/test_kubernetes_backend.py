@@ -224,3 +224,96 @@ class TestRunnerPortTranslation:
         job_row = {"job_runtime_data": dumps({"ports": {11000: 11000}})}
         assert _runner_port(job_row, jpd) == 30001
         assert _runner_port(job_row) == 11000  # no translation without jpd
+
+
+class TestSchedulerIntegration:
+    """The k8s backend through the REAL scheduler/plan paths — not just
+    direct get_offers calls (VERDICT r4 #9)."""
+
+    async def _project_with_k8s(self, nodes):
+        from dstack_tpu.core.models.backends import BackendType
+        from dstack_tpu.server.testing.common import (
+            create_test_db,
+            create_test_project,
+            create_test_user,
+            install_fake_backend,
+        )
+
+        db = await create_test_db()
+        _, user_row = await create_test_user(db)
+        project_row = await create_test_project(db, user_row)
+        compute = _compute(nodes)
+        install_fake_backend(project_row, compute, btype=BackendType.KUBERNETES)
+        return db, user_row, project_row, compute
+
+    async def test_single_host_tpu_schedules_on_k8s(self):
+        """A single-host TPU job must reach a kubernetes pod through
+        process_submitted_jobs: the multinode gate must not exclude the
+        backend for every TPU request (bug found in round 5: any tpu
+        spec set multinode=True and k8s lacks the multinode mixin)."""
+        from dstack_tpu.core.models.runs import JobStatus
+        from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+            process_submitted_jobs,
+        )
+        from dstack_tpu.server.services import runs as runs_service
+        from dstack_tpu.server.testing.common import make_run_spec
+
+        nodes = [_node("n1", tpu=4, accel="tpu-v5-lite-podslice", topo="2x2")]
+        db, user_row, project_row, compute = await self._project_with_k8s(nodes)
+        run = await runs_service.submit_run(
+            db, project_row, user_row,
+            make_run_spec(
+                {
+                    "type": "task",
+                    "commands": ["python train.py"],
+                    "resources": {"tpu": {"version": "v5e", "chips": 4}},
+                },
+                "k8s-tpu",
+            ),
+        )
+        await process_submitted_jobs(db)
+        job = await db.fetchone("SELECT * FROM jobs WHERE run_id = ?", (run.id,))
+        assert job["status"] == JobStatus.PROVISIONING.value, job["termination_reason_message"]
+        assert compute.api.pods  # the pod actually exists
+
+    async def test_multislice_on_k8s_only_project_refused_at_plan(self):
+        """Multi-host/multislice TPU on a kubernetes-only project fails
+        LOUDLY at plan/apply time with a gang-scheduling message, not as
+        a late scheduler no-capacity failure."""
+        from dstack_tpu.core.errors import ConfigurationError
+        from dstack_tpu.server.services import runs as runs_service
+        from dstack_tpu.server.testing.common import make_run_spec
+
+        nodes = [_node("n1", tpu=4, accel="tpu-v5-lite-podslice", topo="2x2")]
+        db, user_row, project_row, _ = await self._project_with_k8s(nodes)
+        with pytest.raises(ConfigurationError, match="gang scheduling"):
+            await runs_service.get_plan(
+                db, project_row, user_row,
+                make_run_spec(
+                    {
+                        "type": "task",
+                        "nodes": 2,
+                        "commands": ["python train.py"],
+                        "resources": {
+                            "tpu": {"version": "v5e", "chips": 8, "slices": 2}
+                        },
+                    },
+                    "k8s-ms",
+                ),
+            )
+
+    async def test_multihost_pool_node_not_offered(self):
+        """A node that is one host of a multi-host slice pool (topology
+        chip product > the node's own chips) must not be offered: a
+        lone pod pinned there hangs in TPU runtime init."""
+        nodes = [
+            _node("ms1", tpu=8, accel="tpu-v5-lite-podslice", topo="4x4"),
+            _node("ok1", tpu=8, accel="tpu-v5-lite-podslice", topo="2x4"),
+        ]
+        compute = _compute(nodes)
+        offers = await compute.get_offers(
+            Requirements(resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5e", "chips": 8}}
+            ))
+        )
+        assert [o.instance.name for o in offers] == ["ok1"]

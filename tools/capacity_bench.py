@@ -267,6 +267,14 @@ def main() -> int:
         "--db", default="sqlite", choices=["sqlite", "pgwire", "postgres"]
     )
     args = p.parse_args()
+    if args.db == "postgres" and not os.environ.get("DTPU_TEST_PG_DSN"):
+        print(json.dumps({
+            "engine": "postgres",
+            "error": "set DTPU_TEST_PG_DSN to a throwaway database; "
+            "with asyncpg installed the row measures the asyncpg path, "
+            "otherwise the bundled pg_wire client (docs/guides/testing.md)",
+        }))
+        return 2
     result = asyncio.run(bench(args.jobs, args.window, args.db))
     print(json.dumps(result))
     return 0

@@ -54,19 +54,11 @@ print(json.dumps(r))
 """
 
 
-def _tpu_reachable(timeout: float = 90.0) -> bool:
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-            timeout=timeout, capture_output=True, text=True,
-        )
-        return proc.returncode == 0 and "ok" in proc.stdout
-    except (subprocess.TimeoutExpired, OSError):
-        return False
-
-
 def main() -> int:
-    force_cpu = not _tpu_reachable()
+    sys.path.insert(0, str(REPO))
+    from bench import _tpu_reachable  # one probe definition, bench.py's
+
+    force_cpu = not _tpu_reachable(timeout=90.0)
     if force_cpu:
         print(json.dumps({"note": "TPU unreachable; cpu smoke numbers only"}))
     for name, spec in VARIANTS:

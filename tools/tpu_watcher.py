@@ -53,29 +53,29 @@ def probe() -> bool:
     return proc.returncode == 0
 
 
-def phase_states() -> tuple[set, dict]:
-    """(phases with a successful entry, error counts per phase)."""
-    ok, errors = set(), {}
+def captured_ok() -> set:
+    """Phases with at least one successful (non-error) evidence entry."""
+    ok = set()
     if not EVIDENCE.exists():
-        return ok, errors
+        return ok
     try:
         runs = json.loads(EVIDENCE.read_text()).get("runs", [])
     except ValueError:
-        return ok, errors
-    for r in runs:
-        if "error" in r:
-            errors[r["phase"]] = errors.get(r["phase"], 0) + 1
-        else:
-            ok.add(r["phase"])
-    return ok, errors
+        return ok
+    return {r["phase"] for r in runs if "error" not in r}
 
 
 def main() -> int:
     _log(f"watcher up; probing every {PROBE_INTERVAL}s; phases: {PHASES}")
+    # attempts are counted IN-SESSION only: a capture try that ends with
+    # the TUNNEL DOWN (probe fails right after) was a drop, not a phase
+    # failure, and doesn't count toward giving up — past sessions' error
+    # entries in the evidence file never count
+    attempts: dict = {}
     while True:
-        ok, errors = phase_states()
+        ok = captured_ok()
         missing = [p for p in PHASES if p not in ok]
-        live = [p for p in missing if errors.get(p, 0) < MAX_ATTEMPTS]
+        live = [p for p in missing if attempts.get(p, 0) < MAX_ATTEMPTS]
         if not missing:
             _log("all phases captured — watcher done")
             return 0
@@ -89,8 +89,14 @@ def main() -> int:
                 [sys.executable, "tools/tpu_capture.py", "--phases", nums],
                 cwd=REPO,
             )
-            # re-probe on the next iteration, but never spin: a capture
-            # that failed instantly would otherwise loop back-to-back
+            still_missing = [p for p in live if p not in captured_ok()]
+            if still_missing and probe():
+                # tunnel is still up, so these were real phase failures
+                for p in still_missing:
+                    attempts[p] = attempts.get(p, 0) + 1
+                _log(f"phase failures (tunnel up): {still_missing}")
+            # never spin: a capture that failed instantly would
+            # otherwise loop back-to-back
             time.sleep(30)
             continue
         _log(f"tunnel down (missing: {len(missing)} phases)")
