@@ -111,9 +111,16 @@ class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
             topology = labels.get(
                 "cloud.google.com/gke-tpu-topology", f"1x{tpu_count}"
             )
-            topo_chips = 1
-            for d in topology.split("x"):
-                topo_chips *= int(d) if d.isdigit() else 1
+            from dstack_tpu.core.models.resources import topology_chips
+
+            try:
+                topo_chips = topology_chips(topology)
+            except ValueError:
+                logger.warning(
+                    "kubernetes node %s: malformed gke-tpu-topology label "
+                    "%r; skipping node", node["metadata"]["name"], topology,
+                )
+                return None
             if topo_chips > tpu_count:
                 # the node is ONE HOST of a multi-host slice pool
                 # (topology spans more chips than this node holds): a

@@ -89,16 +89,32 @@ class LocalCompute(
             if fake:
                 from dstack_tpu.core.catalog.tpu import GENERATIONS, TPU_SLICES
                 from dstack_tpu.core.models.instances import TPUInfo
+                from dstack_tpu.core.models.resources import (
+                    normalize_tpu_version,
+                )
 
                 version, _, chips_s = fake.rpartition("-")
+                try:
+                    version = normalize_tpu_version(version)
+                    chips = int(chips_s)
+                except (ValueError, KeyError):
+                    logger.warning(
+                        "DTPU_LOCAL_FAKE_TPU=%r is not <generation>-<chips> "
+                        "(e.g. v5e-8); offering no TPU", fake,
+                    )
+                    return []
                 shape = next(
                     (
                         s for s in TPU_SLICES
-                        if s.version == version and s.chips == int(chips_s or 0)
+                        if s.version == version and s.chips == chips
                     ),
                     None,
                 )
                 if shape is None:
+                    logger.warning(
+                        "DTPU_LOCAL_FAKE_TPU=%r matches no catalog slice "
+                        "shape; offering no TPU", fake,
+                    )
                     return []
                 tpu_info = TPUInfo(
                     version=shape.version,

@@ -285,23 +285,49 @@ class PostgresDatabase:
     async def claim_batch(self, namespace: str, candidates: list, limit: int):
         """Batched queue pop across replicas: up to ``limit`` candidates
         whose advisory locks were free (one concurrent reconciler
-        pass per tick — the 150-rows-in-2-minutes capacity lever)."""
+        pass per tick — the 150-rows-in-2-minutes capacity lever).
+
+        All try-locks go to the server in ONE statement (N result
+        columns), not N sequential round trips — per-tick latency on a
+        real network is what caps the PG scheduling rate
+        (CAPACITY_r05.json). Extra locks won (beyond ``limit``) and the
+        final releases are likewise batched."""
         conn = await self._lock_pool.acquire()
         claimed: list = []
+
+        async def _batch_call(fn: str, keys: list) -> list:
+            cols = ", ".join(
+                f"{fn}(${i + 1}) AS c{i}" for i in range(len(keys))
+            )
+            row = await conn.fetchrow(f"SELECT {cols}", *keys)
+            return [row[f"c{i}"] for i in range(len(keys))]
+
         try:
-            for k in candidates:
+            # scan ALL candidates (chunked so one statement stays a
+            # sane size) until ``limit`` claims land — truncating the
+            # scan would let a third replica claim nothing while free
+            # rows sit further down the list
+            chunk = max(limit * 2, limit)
+            for start in range(0, len(candidates), chunk):
                 if len(claimed) >= limit:
                     break
-                got = await conn.fetchval(
-                    "SELECT pg_try_advisory_lock($1)", advisory_key(namespace, k)
-                )
-                if got:
-                    claimed.append(k)
+                ask = candidates[start:start + chunk]
+                keys = [advisory_key(namespace, k) for k in ask]
+                got = await _batch_call("pg_try_advisory_lock", keys)
+                extras = []
+                for k, key, ok in zip(ask, keys, got):
+                    if ok and len(claimed) < limit:
+                        claimed.append(k)
+                    elif ok:
+                        extras.append(key)
+                if extras:
+                    await _batch_call("pg_advisory_unlock", extras)
             yield claimed
         finally:
-            for k in claimed:
-                await conn.fetchval(
-                    "SELECT pg_advisory_unlock($1)", advisory_key(namespace, k)
+            if claimed:
+                await _batch_call(
+                    "pg_advisory_unlock",
+                    [advisory_key(namespace, k) for k in claimed],
                 )
             await self._lock_pool.release(conn)
 

@@ -410,16 +410,27 @@ class FakePgServer:
             self._tag(w, "CREATE SCHEMA")
             return in_tx
 
-        m = re.search(
-            r"pg_(try_advisory_lock|advisory_lock|advisory_unlock)", stripped
+        calls = re.findall(
+            r"pg_(try_advisory_lock|advisory_lock|advisory_unlock)"
+            r"\((?:\$\d+|([-\d]+))\)(?:\s+AS\s+(\w+))?",
+            stripped,
+            re.IGNORECASE,
         )
-        if m:
-            key = int(params[0]) if params else int(
-                re.search(r"\(([-\d]+)\)", stripped).group(1)
-            )
-            kind = m.group(1)
-            val = await self._advisory(kind, key, conn_id, held)
-            self._rows(w, [{"lock": val}])
+        if calls:
+            # one statement may carry MANY advisory calls (db_pg's
+            # batched claim_batch): params map positionally, like real
+            # PG evaluating the select list left to right
+            row: dict = {}
+            pi = 0
+            for i, (kind, literal, alias) in enumerate(calls):
+                if literal:
+                    key = int(literal)
+                else:
+                    key = int(params[pi])
+                    pi += 1
+                val = await self._advisory(kind, key, conn_id, held)
+                row[alias or (f"c{i}" if len(calls) > 1 else "lock")] = val
+            self._rows(w, [row])
             self._tag(w, "SELECT 1")
             return in_tx
 

@@ -120,6 +120,20 @@ class FakeConn:
         return [dict(r) for r in self._c.execute(self._prep(sql), params)]
 
     async def fetchrow(self, sql, *params):
+        calls = re.findall(r"pg_(try_advisory_lock|advisory_unlock)\(\$\d+\)", sql)
+        if calls:  # batched advisory statement (db_pg.claim_batch)
+            row = {}
+            for i, (kind, key) in enumerate(zip(calls, params)):
+                if kind == "try_advisory_lock":
+                    if key in self._locks:
+                        row[f"c{i}"] = False
+                    else:
+                        self._locks.add(key)
+                        row[f"c{i}"] = True
+                else:
+                    self._locks.discard(key)
+                    row[f"c{i}"] = True
+            return row
         r = self._c.execute(self._prep(sql), params).fetchone()
         return dict(r) if r is not None else None
 

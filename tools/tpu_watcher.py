@@ -91,10 +91,26 @@ def main() -> int:
             )
             still_missing = [p for p in live if p not in captured_ok()]
             if still_missing and probe():
-                # tunnel is still up, so these were real phase failures
-                for p in still_missing:
+                # tunnel is up NOW — but a drop-and-recover mid-capture
+                # looks the same, and those phases would be timeouts:
+                # only count failures whose last evidence entry is a
+                # real error (nonzero exit with output), never timeouts
+                timed_out = set()
+                try:
+                    runs = json.loads(EVIDENCE.read_text()).get("runs", [])
+                    for r in runs:
+                        if "error" in r:
+                            is_to = str(r["error"]).startswith("timeout")
+                            (timed_out.add if is_to else timed_out.discard)(
+                                r["phase"]
+                            )
+                except (ValueError, OSError):
+                    pass
+                failed = [p for p in still_missing if p not in timed_out]
+                for p in failed:
                     attempts[p] = attempts.get(p, 0) + 1
-                _log(f"phase failures (tunnel up): {still_missing}")
+                if failed:
+                    _log(f"phase failures (tunnel up): {failed}")
             # never spin: a capture that failed instantly would
             # otherwise loop back-to-back
             time.sleep(30)
