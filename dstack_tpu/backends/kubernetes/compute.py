@@ -8,9 +8,17 @@ the standard selectors (``cloud.google.com/gke-tpu-accelerator``,
 (shim in process mode) so the normal shim→runner flow applies, reached
 through a NodePort service instead of SSH.
 
-Single-host TPU slices per pod (like the reference's TPU support);
-multi-host GKE slices need JobSet-style gang scheduling — the GCP
-``tpu_v2`` backend is the multi-host path in this framework.
+**Multi-host slices (beyond the reference's single-host TPU support)**:
+nodes whose ``gke-tpu-topology`` spans more chips than one node holds
+are one host of a multi-host slice pool. When the pool has enough
+nodes, the whole slice is offered as ONE instance (the same
+slice-as-instance shape the GCP backend uses) and provisioned as a
+gang: one agent pod per worker, each pinned by ``nodeName`` to a
+distinct pool node (JobSet-style placement without the JobSet CRD),
+each with its own NodePort service. The server's normal slice
+rendezvous (TPU_WORKER_ID/HOSTNAMES via cluster_info) then applies
+unchanged. DCN multislice (``slices > 1``) stays refused on this
+backend.
 
 Offers are derived from the cluster's live nodes (the reference does the
 same: capacity is whatever the cluster has).
@@ -21,6 +29,7 @@ from typing import Optional
 from dstack_tpu.backends.base.compute import (
     Compute,
     ComputeWithCreateInstanceSupport,
+    ComputeWithMultinodeSupport,
 )
 from dstack_tpu.backends.kubernetes.api import KubernetesAPI
 from dstack_tpu.core.errors import ComputeError
@@ -34,6 +43,7 @@ from dstack_tpu.core.models.instances import (
     Resources,
     TPUInfo,
 )
+from dstack_tpu.core.models.resources import topology_chips
 from dstack_tpu.core.models.runs import JobProvisioningData, Requirements
 from dstack_tpu.utils.common import run_async
 from dstack_tpu.utils.logging import get_logger
@@ -72,7 +82,58 @@ def _parse_quantity(q) -> int:
     return int(float(s) * mult)
 
 
-class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
+class _SlicePool:
+    """Nodes forming one multi-host GKE TPU slice."""
+
+    def __init__(self, pool_id, accel, version, topology, region,
+                 chips_per_node, hosts_needed, total_chips):
+        self.pool_id = pool_id  # GKE node-pool name: one physical slice set
+        self.accel = accel
+        self.version = version
+        self.topology = topology
+        self.region = region
+        self.chips_per_node = chips_per_node
+        self.hosts_needed = hosts_needed
+        self.total_chips = total_chips
+        self.node_names: list[str] = []
+        self.cpus = 0
+        self.memory_mib = 0
+
+    def add_node(self, name: str, cpus: int, memory_mib: int) -> None:
+        self.node_names.append(name)
+        # slice-as-instance offers report WHOLE-SLICE totals (the GCP
+        # catalog multiplies host resources by hosts the same way)
+        self.cpus += cpus
+        self.memory_mib += memory_mib
+
+    def offer(self, price: float):
+        if len(self.node_names) < self.hosts_needed:
+            return None  # incomplete pool: the slice cannot form
+        return InstanceOfferWithAvailability(
+            backend=BackendType.KUBERNETES,
+            instance=InstanceType(
+                name=f"slice-{self.pool_id}-{self.topology}",
+                resources=Resources(
+                    cpus=self.cpus,
+                    memory_mib=self.memory_mib,
+                    tpu=TPUInfo(
+                        version=self.version,
+                        chips=self.total_chips,
+                        topology=self.topology,
+                        hosts=self.hosts_needed,
+                        chips_per_host=self.chips_per_node,
+                    ),
+                ),
+            ),
+            region=self.region,
+            price=price * self.hosts_needed,
+            availability=InstanceAvailability.AVAILABLE,
+        )
+
+
+class KubernetesCompute(
+    Compute, ComputeWithCreateInstanceSupport, ComputeWithMultinodeSupport
+):
     """``config``: {api_server, token, namespace?, verify_ssl?,
     ca_cert_path?, agent_image?, node_price_per_hour?}."""
 
@@ -96,23 +157,29 @@ class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
 
     # -- offers --
 
-    def _node_offer(self, node: dict) -> Optional[InstanceOfferWithAvailability]:
+    @staticmethod
+    def _node_facts(node: dict) -> Optional[dict]:
+        """One parse of a node's labels/allocatable, shared by the
+        single-host offer path and the slice-pool grouping."""
         labels = node["metadata"].get("labels", {})
         alloc = node.get("status", {}).get("allocatable", {})
         cpus = _parse_quantity(alloc.get("cpu"))
-        memory_mib = _parse_quantity(alloc.get("memory")) // (1024 * 1024)
         if cpus <= 0:
             return None
-        tpu = None
+        facts = {
+            "name": node["metadata"]["name"],
+            "cpus": cpus,
+            "memory_mib": _parse_quantity(alloc.get("memory")) // (1024 * 1024),
+            "region": labels.get("topology.kubernetes.io/region", "cluster"),
+            "nodepool": labels.get("cloud.google.com/gke-nodepool", ""),
+            "tpu_count": 0,
+        }
         accel = labels.get("cloud.google.com/gke-tpu-accelerator")
         tpu_count = _parse_quantity(alloc.get("google.com/tpu"))
         if accel and accel in GKE_TPU_TYPES and tpu_count > 0:
-            version, chips_per_host = GKE_TPU_TYPES[accel]
             topology = labels.get(
                 "cloud.google.com/gke-tpu-topology", f"1x{tpu_count}"
             )
-            from dstack_tpu.core.models.resources import topology_chips
-
             try:
                 topo_chips = topology_chips(topology)
             except ValueError:
@@ -121,34 +188,41 @@ class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
                     "%r; skipping node", node["metadata"]["name"], topology,
                 )
                 return None
-            if topo_chips > tpu_count:
-                # the node is ONE HOST of a multi-host slice pool
-                # (topology spans more chips than this node holds): a
-                # lone pod pinned here would hang in TPU runtime init —
-                # gang scheduling is the GCP backend's job
-                logger.warning(
-                    "kubernetes node %s is part of a multi-host TPU "
-                    "slice (%s topology, %d chips/node); skipping — "
-                    "no gang scheduling on this backend",
-                    node["metadata"]["name"], topology, tpu_count,
-                )
+            version, chips_per_host = GKE_TPU_TYPES[accel]
+            facts.update(
+                accel=accel, version=version, chips_per_host=chips_per_host,
+                tpu_count=tpu_count, topology=topology, topo_chips=topo_chips,
+            )
+        return facts
+
+    def _node_offer(self, node: dict) -> Optional[InstanceOfferWithAvailability]:
+        facts = self._node_facts(node)
+        if facts is None:
+            return None
+        tpu = None
+        if facts["tpu_count"] > 0:
+            if facts["topo_chips"] > facts["tpu_count"]:
+                # one HOST of a multi-host slice pool: never offered
+                # alone (a lone pod pinned here hangs in TPU runtime
+                # init); get_offers aggregates the pool into one
+                # gang-scheduled slice offer instead
                 return None
             tpu = TPUInfo(
-                version=version,
-                chips=tpu_count,
-                topology=topology,
+                version=facts["version"],
+                chips=facts["tpu_count"],
+                topology=facts["topology"],
                 hosts=1,
-                chips_per_host=chips_per_host,
+                chips_per_host=facts["chips_per_host"],
             )
-        region = labels.get("topology.kubernetes.io/region", "cluster")
-        name = node["metadata"]["name"]
         return InstanceOfferWithAvailability(
             backend=BackendType.KUBERNETES,
             instance=InstanceType(
-                name=name,
-                resources=Resources(cpus=cpus, memory_mib=memory_mib, tpu=tpu),
+                name=facts["name"],
+                resources=Resources(
+                    cpus=facts["cpus"], memory_mib=facts["memory_mib"], tpu=tpu
+                ),
             ),
-            region=region,
+            region=facts["region"],
             price=self.price,
             availability=InstanceAvailability.AVAILABLE,
         )
@@ -181,7 +255,55 @@ class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
                 if not res.tpu.chips.contains(tpu.chips):
                     continue
             offers.append(offer)
+        for pool in self._slice_pools(nodes).values():
+            offer = pool.offer(self.price)
+            if offer is None:
+                continue
+            tpu = offer.instance.resources.tpu
+            if res.tpu is not None:
+                if res.tpu.version is not None and tpu.version not in res.tpu.version:
+                    continue
+                if not res.tpu.chips.contains(tpu.chips):
+                    continue
+                if res.tpu.topology is not None and tpu.topology != res.tpu.topology:
+                    continue
+            elif tpu is not None:
+                continue  # don't waste a whole slice on a CPU job
+            offers.append(offer)
         return offers
+
+    def _slice_pools(self, nodes: list) -> dict:
+        """Group multi-host slice-pool nodes by GKE NODE POOL — one
+        physical slice's ICI-connected hosts. Grouping any looser (e.g.
+        by accelerator+topology alone) could gang pods across two
+        unconnected slices, whose TPU rendezvous would hang."""
+        pools: dict = {}
+        for node in nodes:
+            facts = self._node_facts(node)
+            if facts is None or facts["tpu_count"] <= 0:
+                continue
+            if facts["topo_chips"] <= facts["tpu_count"]:
+                continue  # single-host node, offered individually
+            # GKE stamps every node with its node pool; clusters without
+            # the label fall back to grouping by shape alone, which
+            # cannot distinguish two identical slices — acceptable only
+            # because GKE (the TPU case) always labels
+            pool_id = facts["nodepool"] or f"{facts['accel']}-pool"
+            key = (pool_id, facts["accel"], facts["topology"], facts["region"])
+            pool = pools.get(key)
+            if pool is None:
+                pool = pools[key] = _SlicePool(
+                    pool_id=pool_id,
+                    accel=facts["accel"],
+                    version=facts["version"],
+                    topology=facts["topology"],
+                    region=facts["region"],
+                    chips_per_node=facts["tpu_count"],
+                    hosts_needed=-(-facts["topo_chips"] // facts["tpu_count"]),
+                    total_chips=facts["topo_chips"],
+                )
+            pool.add_node(facts["name"], facts["cpus"], facts["memory_mib"])
+        return pools
 
     # -- provisioning --
 
@@ -193,14 +315,20 @@ class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
         pod_name: str,
         offer: InstanceOfferWithAvailability,
         instance_config: InstanceConfiguration,
+        node_name: Optional[str] = None,
     ) -> tuple[dict, dict]:
         tpu = offer.instance.resources.tpu
         resources: dict = {}
         node_selector: dict = {}
         if tpu is not None:
+            # a multi-host slice worker pod asks for ITS node's chips,
+            # not the whole slice's
+            pod_chips = (
+                tpu.chips_per_host if tpu.hosts > 1 else tpu.chips
+            )
             resources = {
-                "requests": {"google.com/tpu": str(tpu.chips)},
-                "limits": {"google.com/tpu": str(tpu.chips)},
+                "requests": {"google.com/tpu": str(pod_chips)},
+                "limits": {"google.com/tpu": str(pod_chips)},
             }
             accel = next(
                 (
@@ -243,6 +371,7 @@ class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
             },
             "spec": {
                 "restartPolicy": "Never",
+                **({"nodeName": node_name} if node_name else {}),
                 "nodeSelector": node_selector,
                 "containers": [
                     {
@@ -282,61 +411,122 @@ class KubernetesCompute(Compute, ComputeWithCreateInstanceSupport):
         instance_offer: InstanceOfferWithAvailability,
         instance_config: InstanceConfiguration,
     ) -> JobProvisioningData:
-        pod_name = self._pod_name(instance_config.instance_name)
-        pod, service = self._manifests(pod_name, instance_offer, instance_config)
-        await run_async(self.api.create_pod, pod)
-        try:
-            await run_async(self.api.create_service, service)
-        except Exception:
-            await run_async(self.api.delete_pod, pod_name)
-            raise
+        import json
+
+        base = self._pod_name(instance_config.instance_name)
+        tpu = instance_offer.instance.resources.tpu
+        if tpu is not None and tpu.hosts > 1:
+            # gang scheduling: one worker pod per pool node, pinned by
+            # nodeName so the set lands on exactly the slice's hosts
+            nodes = await run_async(self.api.list_nodes)
+            pool = next(
+                (
+                    p for p in self._slice_pools(nodes).values()
+                    if f"slice-{p.pool_id}-{p.topology}"
+                    == instance_offer.instance.name
+                    and len(p.node_names) >= tpu.hosts
+                ),
+                None,
+            )
+            if pool is None:
+                raise ComputeError(
+                    f"no complete {tpu.version} {tpu.topology} slice pool "
+                    "in the cluster anymore"
+                )
+            pod_names = [f"{base[:55]}-w{i}" for i in range(tpu.hosts)]
+            created: list[str] = []
+            try:
+                for name, node_name in zip(pod_names, pool.node_names):
+                    pod, service = self._manifests(
+                        name, instance_offer, instance_config,
+                        node_name=node_name,
+                    )
+                    await run_async(self.api.create_pod, pod)
+                    created.append(name)
+                    await run_async(self.api.create_service, service)
+            except Exception:
+                # all-or-nothing: a partial gang is torn down
+                for name in created:
+                    await run_async(self.api.delete_service, name)
+                    await run_async(self.api.delete_pod, name)
+                raise
+            backend_data = json.dumps({"pods": pod_names})
+            instance_id = pod_names[0]
+        else:
+            pod, service = self._manifests(base, instance_offer, instance_config)
+            await run_async(self.api.create_pod, pod)
+            try:
+                await run_async(self.api.create_service, service)
+            except Exception:
+                await run_async(self.api.delete_pod, base)
+                raise
+            backend_data = None
+            instance_id = base
         return JobProvisioningData(
             backend=BackendType.KUBERNETES,
             instance_type=instance_offer.instance,
-            instance_id=pod_name,
+            instance_id=instance_id,
             hostname=None,  # filled by update_provisioning_data
             region=instance_offer.region,
             price=instance_offer.price,
             username="root",
             ssh_port=SSH_PORT,
             dockerized=True,  # pod runs the shim; normal shim→runner flow
+            backend_data=backend_data,
         )
 
-    async def update_provisioning_data(
-        self, provisioning_data: JobProvisioningData
-    ) -> JobProvisioningData:
-        pod_name = provisioning_data.instance_id
+    async def _pod_host(self, pod_name: str, worker_id: int):
+        """One worker's HostMetadata, or None while it is not Running."""
         pod = await run_async(self.api.get_pod, pod_name)
         if pod is None:
-            return provisioning_data
+            return None
         status = pod.get("status", {})
         host_ip = status.get("hostIP")
         pod_ip = status.get("podIP")
         if status.get("phase") != "Running" or not host_ip:
-            return provisioning_data
+            return None
         svc = await run_async(self.api.get_service, pod_name)
         port_map: dict[str, int] = {}
         if svc is not None:
             for p in svc.get("spec", {}).get("ports", []):
                 if p.get("nodePort"):
                     port_map[str(p["port"])] = int(p["nodePort"])
-        provisioning_data.hostname = host_ip
-        provisioning_data.internal_ip = pod_ip or host_ip
-        shim_nodeport = int(port_map.get(str(SHIM_PORT), SHIM_PORT))
-        provisioning_data.ssh_port = int(port_map.get(str(SSH_PORT), SSH_PORT))
-        provisioning_data.hosts = [
-            HostMetadata(
-                worker_id=0,
-                internal_ip=pod_ip or host_ip,
-                external_ip=host_ip,
-                shim_port=shim_nodeport,
-                port_map=port_map,
-            )
-        ]
+        return HostMetadata(
+            worker_id=worker_id,
+            internal_ip=pod_ip or host_ip,
+            external_ip=host_ip,
+            shim_port=int(port_map.get(str(SHIM_PORT), SHIM_PORT)),
+            port_map=port_map,
+        )
+
+    async def update_provisioning_data(
+        self, provisioning_data: JobProvisioningData
+    ) -> JobProvisioningData:
+        import json
+
+        pods = json.loads(provisioning_data.backend_data or "{}").get(
+            "pods"
+        ) or [provisioning_data.instance_id]
+        hosts = []
+        for wid, name in enumerate(pods):
+            host = await self._pod_host(name, wid)
+            if host is None:
+                return provisioning_data  # gang not fully Running yet
+            hosts.append(host)
+        provisioning_data.hosts = hosts
+        provisioning_data.hostname = hosts[0].external_ip
+        provisioning_data.internal_ip = hosts[0].internal_ip
+        provisioning_data.ssh_port = int(
+            (hosts[0].port_map or {}).get(str(SSH_PORT), SSH_PORT)
+        )
         return provisioning_data
 
     async def terminate_instance(
         self, instance_id: str, region: str, backend_data: Optional[str] = None
     ) -> None:
-        await run_async(self.api.delete_service, instance_id)
-        await run_async(self.api.delete_pod, instance_id)
+        import json
+
+        pods = json.loads(backend_data or "{}").get("pods") or [instance_id]
+        for name in pods:
+            await run_async(self.api.delete_service, name)
+            await run_async(self.api.delete_pod, name)

@@ -303,11 +303,14 @@ class PostgresDatabase:
             return [row[f"c{i}"] for i in range(len(keys))]
 
         try:
+            if limit <= 0:  # reconciler paused via MAX_PROCESSING_*=0
+                yield claimed
+                return
             # scan ALL candidates (chunked so one statement stays a
             # sane size) until ``limit`` claims land — truncating the
             # scan would let a third replica claim nothing while free
             # rows sit further down the list
-            chunk = max(limit * 2, limit)
+            chunk = limit * 2
             for start in range(0, len(candidates), chunk):
                 if len(claimed) >= limit:
                     break

@@ -212,13 +212,15 @@ async def get_plan(
             b == BackendType.KUBERNETES for b, _ in project_backends
         ):
             # loud refusal AT APPLY instead of a scheduler no-capacity
-            # failure later: multi-host gang scheduling is the GCP
-            # backend's job (kubernetes/compute.py module docstring)
+            # failure later (kubernetes/compute.py module docstring:
+            # multi-host slices need a complete slice node pool; DCN
+            # multislice is not supported on this backend at all)
             raise ConfigurationError(
-                "multi-host / multislice TPU runs need gang scheduling, "
-                "which the kubernetes backend does not implement "
-                "(single-host TPU pods only); configure the gcp backend "
-                "for this run"
+                "this multi-host / multislice TPU run cannot be served "
+                "by the kubernetes backend: no complete multi-host TPU "
+                "slice node pool matches (and slices > 1 needs the gcp "
+                "backend); add a matching GKE slice pool or configure "
+                "the gcp backend"
             )
     job_plans = [
         JobPlan(

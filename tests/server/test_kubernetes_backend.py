@@ -15,8 +15,10 @@ from dstack_tpu.core.models.resources import ResourcesSpec
 from dstack_tpu.core.models.runs import Requirements
 
 
-def _node(name, cpus="8", memory="32Gi", tpu=None, accel=None, topo=None, region="us-central2"):
+def _node(name, cpus="8", memory="32Gi", tpu=None, accel=None, topo=None, region="us-central2", nodepool=None):
     labels = {"topology.kubernetes.io/region": region}
+    if nodepool:
+        labels["cloud.google.com/gke-nodepool"] = nodepool
     alloc = {"cpu": cpus, "memory": memory}
     if tpu:
         alloc["google.com/tpu"] = str(tpu)
@@ -286,7 +288,7 @@ class TestSchedulerIntegration:
 
         nodes = [_node("n1", tpu=4, accel="tpu-v5-lite-podslice", topo="2x2")]
         db, user_row, project_row, _ = await self._project_with_k8s(nodes)
-        with pytest.raises(ConfigurationError, match="gang scheduling"):
+        with pytest.raises(ConfigurationError, match="slice node pool"):
             await runs_service.get_plan(
                 db, project_row, user_row,
                 make_run_spec(
@@ -317,3 +319,173 @@ class TestSchedulerIntegration:
             ))
         )
         assert [o.instance.name for o in offers] == ["ok1"]
+
+
+class TestMultiHostGang:
+    """Multi-host GKE slices as gang-scheduled pod sets (beyond the
+    reference, which is single-host TPU only on kubernetes)."""
+
+    def _pool_nodes(self, n=2, topo="4x4", tpu=8, nodepool="slice-a"):
+        return [
+            _node(f"pool-{i}", tpu=tpu, accel="tpu-v5-lite-podslice",
+                  topo=topo, nodepool=nodepool)
+            for i in range(n)
+        ]
+
+    async def test_complete_pool_offered_as_one_slice(self):
+        compute = _compute(self._pool_nodes(2))
+        offers = await compute.get_offers(
+            Requirements(resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5e", "chips": 16}}
+            ))
+        )
+        assert len(offers) == 1
+        tpu = offers[0].instance.resources.tpu
+        assert (tpu.chips, tpu.hosts, tpu.topology) == (16, 2, "4x4")
+
+    async def test_incomplete_pool_not_offered(self):
+        compute = _compute(self._pool_nodes(1))
+        offers = await compute.get_offers(
+            Requirements(resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5e", "chips": 16}}
+            ))
+        )
+        assert offers == []
+
+    async def test_gang_create_pins_pods_and_updates_all_workers(self):
+        compute = _compute(self._pool_nodes(2))
+        offers = await compute.get_offers(
+            Requirements(resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5e", "chips": 16}}
+            ))
+        )
+        jpd = await compute.create_instance(
+            offers[0], InstanceConfiguration(
+                project_name="main", instance_name="trainer-0-0",
+                ssh_public_keys=["ssh-ed25519 AAAA t"],
+            )
+        )
+        # one pod per worker, pinned to DISTINCT pool nodes
+        assert len(compute.api.pods) == 2
+        pinned = {p["spec"]["nodeName"] for p in compute.api.pods.values()}
+        assert pinned == {"pool-0", "pool-1"}
+        # each worker pod asks for its NODE's chips, not the slice's 16
+        for p in compute.api.pods.values():
+            assert p["spec"]["containers"][0]["resources"]["limits"][
+                "google.com/tpu"] == "8"
+        assert len(compute.api.services) == 2
+
+        jpd = await compute.update_provisioning_data(jpd)
+        assert len(jpd.hosts) == 2
+        assert [h.worker_id for h in jpd.hosts] == [0, 1]
+        assert all(h.port_map for h in jpd.hosts)
+        assert jpd.hostname  # worker 0 reachable
+
+        await compute.terminate_instance(
+            jpd.instance_id, jpd.region, backend_data=jpd.backend_data
+        )
+        assert compute.api.pods == {} and compute.api.services == {}
+
+    async def test_gang_create_rolls_back_on_partial_failure(self):
+        compute = _compute(self._pool_nodes(2))
+        offers = await compute.get_offers(
+            Requirements(resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5e", "chips": 16}}
+            ))
+        )
+        orig = compute.api.create_service
+        calls = {"n": 0}
+
+        def failing_service(manifest):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("quota")
+            return orig(manifest)
+
+        compute.api.create_service = failing_service
+        with pytest.raises(RuntimeError):
+            await compute.create_instance(
+                offers[0], InstanceConfiguration(
+                    project_name="main", instance_name="t2",
+                    ssh_public_keys=[],
+                )
+            )
+        assert compute.api.pods == {} and compute.api.services == {}
+
+    async def test_nodes2_run_schedules_one_gang_two_jobs(self):
+        """Scheduler-level: nodes=2 on the 2-host slice offer → ONE
+        instance, both jobs attach to its workers (the GCP slice-as-
+        instance shape, now on kubernetes)."""
+        from dstack_tpu.core.models.backends import BackendType
+        from dstack_tpu.core.models.runs import JobStatus
+        from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+            process_submitted_jobs,
+        )
+        from dstack_tpu.server.services import runs as runs_service
+        from dstack_tpu.server.testing.common import (
+            create_test_db,
+            create_test_project,
+            create_test_user,
+            install_fake_backend,
+            make_run_spec,
+        )
+
+        db = await create_test_db()
+        _, user_row = await create_test_user(db)
+        project_row = await create_test_project(db, user_row)
+        compute = _compute(self._pool_nodes(2))
+        install_fake_backend(project_row, compute, btype=BackendType.KUBERNETES)
+        run = await runs_service.submit_run(
+            db, project_row, user_row,
+            make_run_spec(
+                {
+                    "type": "task",
+                    "nodes": 2,
+                    "commands": ["python train.py"],
+                    "resources": {"tpu": {"version": "v5e", "chips": 16}},
+                },
+                "gang",
+            ),
+        )
+        await process_submitted_jobs(db)  # master provisions the gang
+        from dstack_tpu.server.background.tasks.process_instances import (
+            process_instances,
+        )
+
+        await process_instances(db)  # polls pods Running -> fills hosts
+        await process_submitted_jobs(db)  # worker attaches
+        jobs = await db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ? ORDER BY job_num", (run.id,)
+        )
+        assert len(jobs) == 2
+        assert all(j["status"] == JobStatus.PROVISIONING.value for j in jobs)
+        assert len({j["instance_id"] for j in jobs}) == 1  # one gang
+        assert len(compute.api.pods) == 2  # two worker pods
+
+    async def test_two_physical_slices_never_merge(self):
+        """Two complete pools of identical shape (distinct GKE node
+        pools = distinct ICI domains) yield TWO slice offers, and a
+        gang pins only within ONE pool — never across slices whose TPU
+        rendezvous would hang."""
+        nodes = self._pool_nodes(2, nodepool="slice-a") + [
+            _node(f"b-{i}", tpu=8, accel="tpu-v5-lite-podslice",
+                  topo="4x4", nodepool="slice-b")
+            for i in range(2)
+        ]
+        compute = _compute(nodes)
+        offers = await compute.get_offers(
+            Requirements(resources=ResourcesSpec.model_validate(
+                {"tpu": {"version": "v5e", "chips": 16}}
+            ))
+        )
+        assert len(offers) == 2  # capacity = two slices, not one merged
+        jpd = await compute.create_instance(
+            offers[0], InstanceConfiguration(
+                project_name="main", instance_name="t3", ssh_public_keys=[],
+            )
+        )
+        pinned = {p["spec"]["nodeName"] for p in compute.api.pods.values()}
+        assert pinned in ({"pool-0", "pool-1"}, {"b-0", "b-1"})
+        assert jpd.instance_type.resources.tpu.hosts == 2
+        # whole-slice totals, like the GCP catalog's slice offers
+        assert offers[0].instance.resources.cpus == 16  # 2 hosts x 8

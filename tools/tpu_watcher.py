@@ -29,7 +29,10 @@ from tpu_capture import EVIDENCE, PHASES  # single source of truth
 REPO = Path(__file__).resolve().parents[1]
 PROBE_INTERVAL = 180  # seconds between probes while the tunnel is down
 PROBE_TIMEOUT = 90  # jax TPU init hangs (not errors) when the tunnel is down
-MAX_ATTEMPTS = 3  # errors per phase before giving up on it
+MAX_ATTEMPTS = 3  # real phase failures before giving up on it
+MAX_TIMEOUTS = 6  # timeout-looking failures get a higher cap (a tunnel
+# drop mid-capture also times out, so one timeout is weak evidence of a
+# broken phase — but a phase that hangs 6 times with the tunnel up is)
 
 PROBE_SNIPPET = (
     "import jax; d = jax.devices(); "
@@ -72,15 +75,20 @@ def main() -> int:
     # failure, and doesn't count toward giving up — past sessions' error
     # entries in the evidence file never count
     attempts: dict = {}
+    timeouts: dict = {}
     while True:
         ok = captured_ok()
         missing = [p for p in PHASES if p not in ok]
-        live = [p for p in missing if attempts.get(p, 0) < MAX_ATTEMPTS]
+        live = [
+            p for p in missing
+            if attempts.get(p, 0) < MAX_ATTEMPTS
+            and timeouts.get(p, 0) < MAX_TIMEOUTS
+        ]
         if not missing:
             _log("all phases captured — watcher done")
             return 0
         if not live:
-            _log(f"gave up: {missing} failed {MAX_ATTEMPTS}x each — watcher done")
+            _log(f"gave up: {missing} exhausted their attempts — watcher done")
             return 1
         if probe():
             nums = ",".join(str(PHASES.index(p) + 1) for p in live)
@@ -109,8 +117,14 @@ def main() -> int:
                 failed = [p for p in still_missing if p not in timed_out]
                 for p in failed:
                     attempts[p] = attempts.get(p, 0) + 1
-                if failed:
-                    _log(f"phase failures (tunnel up): {failed}")
+                for p in still_missing:
+                    if p in timed_out:
+                        timeouts[p] = timeouts.get(p, 0) + 1
+                if still_missing:
+                    _log(
+                        f"capture incomplete (tunnel up): failed={failed} "
+                        f"timed_out={[p for p in still_missing if p in timed_out]}"
+                    )
             # never spin: a capture that failed instantly would
             # otherwise loop back-to-back
             time.sleep(30)
