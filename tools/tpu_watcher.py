@@ -11,9 +11,10 @@ mid-window leaves the remaining phases for the next window; a
 deterministically failing phase is abandoned instead of retried
 forever).
 
-Run it once in the background for the whole session:
+Run it once in the background for the whole session (pidfile-managed —
+never pkill by name, the invoking shell's own command line matches):
 
-    nohup python tools/tpu_watcher.py >> tools/tpu_watcher.log 2>&1 &
+    tools/watcher_ctl.sh start
 """
 
 import json

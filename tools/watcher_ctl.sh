@@ -1,0 +1,42 @@
+#!/bin/bash
+# Manage the TPU window watcher via a pidfile (pkill -f is unsafe here:
+# the invoking shell's own command line contains the script name).
+set -e
+SELF="$(cd "$(dirname "$0")" && pwd)/$(basename "$0")"
+cd "$(dirname "$SELF")/.."
+PIDFILE=tools/tpu_watcher.pid
+case "${1:-status}" in
+  start)
+    if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+      echo "already running: $(cat "$PIDFILE")"
+      exit 0
+    fi
+    setsid nohup python tools/tpu_watcher.py >> tools/tpu_watcher.log 2>&1 < /dev/null &
+    echo $! > "$PIDFILE"
+    echo "started: $(cat "$PIDFILE")"
+    ;;
+  stop)
+    if [ -f "$PIDFILE" ]; then
+      # the watcher runs in its own setsid session; kill the whole
+      # group so an in-flight tpu_capture.py child goes with it
+      kill -- -"$(cat "$PIDFILE")" 2>/dev/null \
+        || kill "$(cat "$PIDFILE")" 2>/dev/null || true
+    fi
+    rm -f "$PIDFILE"
+    echo stopped
+    ;;
+  restart)
+    "$SELF" stop; sleep 1; "$SELF" start
+    ;;
+  status)
+    if [ -f "$PIDFILE" ] && kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+      echo "running: $(cat "$PIDFILE")"
+    else
+      echo "not running"
+    fi
+    ;;
+  *)
+    echo "usage: $0 {start|stop|restart|status}" >&2
+    exit 1
+    ;;
+esac
