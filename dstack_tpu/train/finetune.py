@@ -78,6 +78,11 @@ def main(argv=None) -> int:
     p.add_argument("--out", default="adapters", help="output dir for weights")
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument(
+        "--opt-bits", type=int, default=32, choices=[8, 32],
+        help="8 stores the Adam moments as blockwise int8 (train/opt8.py:"
+             " ~4x less optimizer HBM; checkpoints stay byte-exact)",
+    )
+    p.add_argument(
         "--ckpt-dir", default=None,
         help="checkpoint dir (volume mount / gcsfuse path); enables periodic saves",
     )
@@ -153,7 +158,9 @@ def main(argv=None) -> int:
         flush=True,
     )
 
-    opt = default_optimizer(lr=args.lr, decay_steps=args.steps)
+    opt = default_optimizer(
+        lr=args.lr, decay_steps=args.steps, opt_bits=args.opt_bits
+    )
     t0 = time.perf_counter()
     # hf_params (host numpy tree from convert_hf) goes straight into the
     # sharded buffers — never whole on one chip, never alongside a
