@@ -43,6 +43,50 @@ class ServiceStats:
             total += sum(1 for t in q if t >= cutoff) / over_seconds
         return total
 
+    def snapshot(
+        self,
+        project: str,
+        run_name: str,
+        buckets: int = 20,
+        bucket_seconds: float = 30.0,
+    ) -> tuple[float, list[float]]:
+        """(rps over 60s, per-bucket RPS oldest-first) in ONE pass over
+        the request deque — /services/list calls this per poll, and a
+        busy service retains tens of thousands of timestamps. The
+        latest gateway-scraped window, if fresh, joins both numbers (on
+        the last bucket) so gateway-routed services do not chart flat
+        zero."""
+        now = time.monotonic()
+        out = [0.0] * buckets
+        recent = 0
+        q = self._requests.get((project, run_name))
+        if q:
+            self._trim(q)
+            span = buckets * bucket_seconds
+            for t in q:
+                age = now - t
+                if age < 60.0:
+                    recent += 1
+                if age < span:
+                    out[buckets - 1 - int(age // bucket_seconds)] += 1
+            out = [c / bucket_seconds for c in out]
+        rps60 = recent / 60.0
+        ext = self._external.get((project, run_name))
+        if ext is not None and now - ext[1] < 120.0:
+            out[-1] += ext[0]
+            rps60 += ext[0]
+        return round(rps60, 3), [round(v, 3) for v in out]
+
+    def rps_history(
+        self,
+        project: str,
+        run_name: str,
+        buckets: int = 20,
+        bucket_seconds: float = 30.0,
+    ) -> list[float]:
+        """The sparkline series alone (see :meth:`snapshot`)."""
+        return self.snapshot(project, run_name, buckets, bucket_seconds)[1]
+
     def last_request_at(self, project: str, run_name: str) -> float:
         q = self._requests.get((project, run_name))
         return q[-1] if q else 0.0

@@ -423,9 +423,11 @@ async def list_services(ctx: RequestContext):
                 else None
             ),
             "replicas": live,
-            "rps": round(stats.rps(project_name, run.run_name), 3),
             "cost": run.cost,
         })
+        out[-1]["rps"], out[-1]["rps_history"] = stats.snapshot(
+            project_name, run.run_name
+        )
     return out
 
 

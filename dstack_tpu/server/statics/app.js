@@ -142,6 +142,21 @@ function yamlApplyPanel(label, placeholder, onDone) {
   return h("div", {}, toggle, body);
 }
 
+/* Shared polyline-path builder for sparklines: maps vals onto a
+   w×h box with pad, returning the SVG "d" string plus the x/y mappers
+   (for hover dots). zeroBaseline pins y=0 to the bottom (rate charts);
+   otherwise the series min does (trend charts). */
+function sparkPath(vals, w, hgt, pad, zeroBaseline) {
+  const lo = zeroBaseline ? 0 : Math.min(...vals);
+  const hi = Math.max(...vals, zeroBaseline ? 1e-9 : -Infinity);
+  const span = hi - lo || 1;
+  const x = (i) => pad + (i / Math.max(vals.length - 1, 1)) * (w - 2 * pad);
+  const y = (v) => hgt - pad - ((v - lo) / span) * (hgt - 2 * pad);
+  const d = vals.map((v, i) =>
+    `${i ? "L" : "M"}${x(i).toFixed(1)},${y(v).toFixed(1)}`).join("");
+  return { d, x, y };
+}
+
 /* Single-series sparkline tile: stat number + inline-SVG line with a
    nearest-point hover readout. One accent hue (identity lives in the
    tile title); text stays in ink tokens, never the series color. */
@@ -160,11 +175,7 @@ function sparkTile(title, series, fmt) {
       last == null ? "—" : fmt(last)),
   );
   if (vals.length > 1) {
-    const lo = Math.min(...vals), hi = Math.max(...vals);
-    const span = hi - lo || 1;
-    const x = (i) => PAD + (i / (vals.length - 1)) * (W - 2 * PAD);
-    const y = (v) => H - PAD - ((v - lo) / span) * (H - 2 * PAD);
-    const d = vals.map((v, i) => `${i ? "L" : "M"}${x(i).toFixed(1)},${y(v).toFixed(1)}`).join("");
+    const { d, x, y } = sparkPath(vals, W, H, PAD, false);
     const ns = "http://www.w3.org/2000/svg";
     const svg = document.createElementNS(ns, "svg");
     svg.setAttribute("viewBox", `0 0 ${W} ${H}`);
@@ -889,20 +900,40 @@ async function pageVolumes() {
   );
 }
 
+/* Tiny inline sparkline for table cells (no hover chrome); rates chart
+   against a zero baseline. */
+function miniSpark(vals, w = 90, hgt = 18) {
+  const ns = "http://www.w3.org/2000/svg";
+  const svg = document.createElementNS(ns, "svg");
+  svg.setAttribute("viewBox", `0 0 ${w} ${hgt}`);
+  svg.setAttribute("width", w); svg.setAttribute("height", hgt);
+  svg.style.verticalAlign = "middle";
+  const path = document.createElementNS(ns, "path");
+  path.setAttribute("d", sparkPath(vals, w, hgt, 2, true).d);
+  path.setAttribute("fill", "none");
+  path.setAttribute("stroke", "var(--accent)");
+  path.setAttribute("stroke-width", "1.5");
+  svg.append(path);
+  return svg;
+}
+
 async function pageServices() {
   // the numbers the RPS autoscaler acts on: live replicas + measured
-  // RPS per active service (in-server proxy + gateway windows merged)
+  // RPS per active service (in-server proxy + gateway windows merged),
+  // with a 10-minute RPS sparkline per service
   const services = await papi("/services/list");
   return h("div", {},
     h("h1", {}, "Services"),
     table(
-      ["Run", "Status", "Model", "Replicas", "RPS (60s)", "Cost", "URL"],
+      ["Run", "Status", "Model", "Replicas", "RPS (60s)", "RPS (10 min)", "Cost", "URL"],
       services.map((s) => h("tr", {},
         h("td", {}, h("a", { href: `#/runs/${s.run_name}` }, s.run_name)),
         h("td", {}, statusBadge(s.status)),
         h("td", {}, s.model || "—"),
         h("td", {}, String(s.replicas)),
         h("td", {}, s.rps.toFixed(2)),
+        h("td", {}, (s.rps_history || []).some((v) => v > 0)
+          ? miniSpark(s.rps_history) : h("span", { class: "muted" }, "—")),
         h("td", {}, s.cost ? `$${s.cost.toFixed(2)}` : "—"),
         h("td", {}, s.url
           ? h("a", { href: s.url, target: "_blank" }, s.url) : "—"),

@@ -380,3 +380,36 @@ class TestFullStackModelService:
                 )
             finally:
                 await client.close()
+
+
+class TestRpsHistory:
+    def test_bucketing_oldest_first(self, monkeypatch):
+        """rps_history buckets the request deque into fixed windows,
+        oldest first — what the console's 10-min sparkline renders."""
+        stats = ServiceStats()
+        now = 10_000.0
+        monkeypatch.setattr("dstack_tpu.proxy.stats.time",
+                            type("T", (), {"monotonic": staticmethod(lambda: now)}))
+        # 30 requests 5 min ago (one bucket), 60 requests just now
+        q = stats._requests[("p", "r")]
+        for _ in range(30):
+            q.append(now - 300.0)
+        for _ in range(60):
+            q.append(now - 1.0)
+        hist = stats.rps_history("p", "r", buckets=20, bucket_seconds=30.0)
+        assert len(hist) == 20
+        assert hist[-1] == 2.0  # 60 req / 30s bucket
+        assert hist[20 - 1 - 10] == 1.0  # 300s ago = bucket index 9
+        assert sum(1 for v in hist if v > 0) == 2
+
+    def test_external_window_rides_last_bucket(self, monkeypatch):
+        stats = ServiceStats()
+        now = 10_000.0
+        monkeypatch.setattr("dstack_tpu.proxy.stats.time",
+                            type("T", (), {"monotonic": staticmethod(lambda: now)}))
+        stats.merge_external("p", "r", 4.5)
+        hist = stats.rps_history("p", "r")
+        assert hist[-1] == 4.5 and all(v == 0 for v in hist[:-1])
+
+    def test_empty_service_flat_zero(self):
+        assert ServiceStats().rps_history("p", "none") == [0.0] * 20

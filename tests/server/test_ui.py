@@ -907,6 +907,7 @@ class TestServicesView:
             s = services[0]
             assert s["model"] == "m1"
             assert s["replicas"] == 0 and s["rps"] == 0.0
+            assert s["rps_history"] == [0.0] * 20  # the sparkline series
             assert s["url"].endswith("/svc-run/")
             assert "cost" in s
 
@@ -914,6 +915,7 @@ class TestServicesView:
             r = await client.get("/statics/app.js")
             js = await r.text()
             assert "pageServices" in js and "services/list" in js
+            assert "miniSpark" in js and "rps_history" in js
         finally:
             await client.close()
 
