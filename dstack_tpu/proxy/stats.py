@@ -77,16 +77,6 @@ class ServiceStats:
             rps60 += ext[0]
         return round(rps60, 3), [round(v, 3) for v in out]
 
-    def rps_history(
-        self,
-        project: str,
-        run_name: str,
-        buckets: int = 20,
-        bucket_seconds: float = 30.0,
-    ) -> list[float]:
-        """The sparkline series alone (see :meth:`snapshot`)."""
-        return self.snapshot(project, run_name, buckets, bucket_seconds)[1]
-
     def last_request_at(self, project: str, run_name: str) -> float:
         q = self._requests.get((project, run_name))
         return q[-1] if q else 0.0

@@ -311,3 +311,73 @@ class TestPoolResilience:
             assert await conn2.fetchval("SELECT 3") == 3
             await pool.release(conn2)
             await pool.close()
+
+
+class TestTwoReplicaControlPlane:
+    _db = TestEngineOverTheWire._db
+
+    async def test_two_replicas_schedule_disjointly_over_one_postgres(self):
+        """TWO server replicas (separate PostgresDatabase engines over
+        real sockets to one shared server) run the REAL submitted-jobs
+        reconciler CONCURRENTLY over the same queue: every job must be
+        scheduled exactly once — the advisory-lock claim_batch is the
+        only thing standing between the replicas and double
+        provisioning (the reference's multi-replica deployment story,
+        its server/background/__init__.py capacity notes)."""
+        import asyncio
+
+        from dstack_tpu.core.models.runs import JobStatus
+        from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+            process_submitted_jobs,
+        )
+        from dstack_tpu.server.services import runs as runs_service
+        from dstack_tpu.server.testing.common import (
+            FakeCompute,
+            cpu_offer,
+            create_test_project,
+            create_test_user,
+            install_fake_backend,
+            make_run_spec,
+        )
+
+        async with FakePgServer() as srv:
+            db_a = await self._db(srv)
+            db_b = await self._db(srv)
+            _, user_row = await create_test_user(db_a)
+            project_row = await create_test_project(db_a, user_row)
+            # the backend cache is process-global by project id, so both
+            # replicas share ONE FakeCompute — its created list counts
+            # provisioning across the whole "deployment"
+            compute = FakeCompute(offers=[cpu_offer() for _ in range(4)])
+            install_fake_backend(project_row, compute)
+            runs = [
+                await runs_service.submit_run(
+                    db_a, project_row, user_row,
+                    make_run_spec(
+                        {"type": "task", "commands": ["python t.py"],
+                         "resources": {"cpu": "2"}},
+                        f"rep-{i}",
+                    ),
+                )
+                for i in range(12)
+            ]
+            for _ in range(8):  # both replicas tick concurrently
+                await asyncio.gather(
+                    process_submitted_jobs(db_a),
+                    process_submitted_jobs(db_b),
+                )
+                jobs = await db_a.fetchall("SELECT status FROM jobs")
+                if all(
+                    j["status"] == JobStatus.PROVISIONING.value for j in jobs
+                ):
+                    break
+            jobs = await db_a.fetchall("SELECT * FROM jobs")
+            assert len(jobs) == 12
+            assert all(
+                j["status"] == JobStatus.PROVISIONING.value for j in jobs
+            ), sorted({j["status"] for j in jobs})
+            # exactly one instance per job, each job on its own instance
+            assert len(compute.created) == 12
+            assert len({j["instance_id"] for j in jobs}) == 12
+            await db_a.close()
+            await db_b.close()

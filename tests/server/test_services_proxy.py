@@ -396,7 +396,7 @@ class TestRpsHistory:
             q.append(now - 300.0)
         for _ in range(60):
             q.append(now - 1.0)
-        hist = stats.rps_history("p", "r", buckets=20, bucket_seconds=30.0)
+        hist = stats.snapshot("p", "r", buckets=20, bucket_seconds=30.0)[1]
         assert len(hist) == 20
         assert hist[-1] == 2.0  # 60 req / 30s bucket
         assert hist[20 - 1 - 10] == 1.0  # 300s ago = bucket index 9
@@ -408,8 +408,8 @@ class TestRpsHistory:
         monkeypatch.setattr("dstack_tpu.proxy.stats.time",
                             type("T", (), {"monotonic": staticmethod(lambda: now)}))
         stats.merge_external("p", "r", 4.5)
-        hist = stats.rps_history("p", "r")
+        hist = stats.snapshot("p", "r")[1]
         assert hist[-1] == 4.5 and all(v == 0 for v in hist[:-1])
 
     def test_empty_service_flat_zero(self):
-        assert ServiceStats().rps_history("p", "none") == [0.0] * 20
+        assert ServiceStats().snapshot("p", "none") == (0.0, [0.0] * 20)
