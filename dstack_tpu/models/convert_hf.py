@@ -11,7 +11,9 @@ Supported ``model_type``s: ``llama``, ``qwen2``, ``qwen3``,
 ``gemma3_text`` (multimodal checkpoints load their text tower),
 ``mixtral``, ``phi3`` (fused qkv/gate_up projections are split on
 load; a Phi-3 export round-trips as the equivalent mistral/llama
-layout). Each maps onto :class:`LlamaConfig` family flags (qkv_bias /
+layout), ``gpt_oss`` (attention sinks, linear router with
+softmax-over-top-k gates, fused biased experts with the clamped glu,
+yarn truncate=false). Each maps onto :class:`LlamaConfig` family flags (qkv_bias /
 sliding_window / norm_offset / softcaps / dual-theta rope / MoE) — the
 architecture deltas live in the config, not in per-family model code.
 
@@ -63,7 +65,7 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
     n_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hidden // n_heads
     if hf.get("attention_bias") and mt not in (
-        "qwen2", "qwen3", "qwen3_moe", "glm", "glm4"
+        "qwen2", "qwen3", "qwen3_moe", "glm", "glm4", "gpt_oss"
     ):
         # q/k/v/o biases exist in the checkpoint but our llama/mistral
         # paths would silently drop them — refuse rather than mis-serve
@@ -147,6 +149,38 @@ def config_from_hf(hf: dict, dtype: Any = jnp.bfloat16) -> LlamaConfig:
             n_experts=hf["num_experts"],
             experts_per_token=hf.get("num_experts_per_tok", 8),
             router_renorm=bool(hf.get("norm_topk_prob", True)),
+        )
+    if mt == "gpt_oss":
+        # OpenAI gpt-oss: alternating sliding/full attention with
+        # learned attention sinks, a LINEAR router (bias + softmax over
+        # the top-k logits), fused biased experts with the clamped glu
+        # activation, yarn rope with truncate=false (HF
+        # modeling_gpt_oss.py is the parity reference).
+        lt = hf.get("layer_types") or []
+        expected = [
+            "sliding_attention" if i % 2 == 0 else "full_attention"
+            for i in range(hf["num_hidden_layers"])
+        ]
+        if lt and lt != expected:
+            raise ValueError(
+                "gpt_oss layer_types deviate from the alternating "
+                "sliding/full pattern; not supported"
+            )
+        return LlamaConfig(
+            **common,
+            qkv_bias=True,
+            proj_bias=True,  # o-proj bias (dense-MLP biases N/A: MoE)
+            attn_sinks=True,
+            sliding_window=hf.get("sliding_window") or 0,
+            # absent layer_types default to the alternating pattern in
+            # HF GptOssConfig — a 0 fallback would window EVERY layer
+            sliding_pattern=2,
+            n_experts=hf["num_local_experts"],
+            experts_per_token=hf.get("num_experts_per_tok", 4),
+            router_topk_softmax=True,
+            moe_bias=True,
+            moe_act="oai_glu",
+            act_limit=float(hf.get("swiglu_limit") or 7.0),
         )
     if mt == "mistral":
         return LlamaConfig(**common, sliding_window=hf.get("sliding_window") or 0)
@@ -502,8 +536,7 @@ def _rope_scaling_from_hf(hf: dict) -> Optional[tuple]:
         # NTK-by-parts YaRN (DeepSeek): mirror HF's
         # _compute_yarn_parameters, resolving the cos/sin attention
         # factor from mscale/mscale_all_dim at conversion time
-        if not rs.get("truncate", True):
-            raise ValueError("yarn rope_scaling with truncate=false is not supported")
+        truncate = bool(rs.get("truncate", True))
         factor = float(rs["factor"])
 
         def get_mscale(scale, ms=1.0):
@@ -526,7 +559,10 @@ def _rope_scaling_from_hf(hf: dict) -> Optional[tuple]:
             float(rs.get("beta_fast") or 32),
             float(rs.get("beta_slow") or 1),
             float(orig), float(att),
-        )
+            # canonical form: the truncate element appears ONLY when
+            # False (gpt-oss), so truncate-True configs keep the 6-tuple
+            # shape existing presets/round-trips use
+        ) + ((False,) if not truncate else ())
     raise ValueError(f"unsupported rope_scaling type {rope_type!r}")
 
 
@@ -628,10 +664,16 @@ def convert_state_dict(
         layers["bq"] = stack(P + "self_attn.q_proj.bias")
         layers["bk"] = stack(P + "self_attn.k_proj.bias")
         layers["bv"] = stack(P + "self_attn.v_proj.bias")
-    if c.proj_bias:  # StarCoder2: o and MLP biases
+    if c.proj_bias:  # StarCoder2 / gpt-oss: o (and dense-MLP) biases
         layers["bo"] = stack(P + "self_attn.o_proj.bias")
-        layers["b_up"] = stack(P + "mlp.up_proj.bias")
-        layers["b_down"] = stack(P + "mlp.down_proj.bias")
+        if not c.n_experts:
+            layers["b_up"] = stack(P + "mlp.up_proj.bias")
+            layers["b_down"] = stack(P + "mlp.down_proj.bias")
+    if c.attn_sinks:
+        layers["sinks"] = np.stack([
+            _to_np(get(f"model.layers.{i}.self_attn.sinks")).astype(np.float32)
+            for i in range(c.n_layers)
+        ])
     if c.qk_norm or c.qk_norm_flat:
         layers["q_norm"] = stack(P + "self_attn.q_norm.weight")
         layers["k_norm"] = stack(P + "self_attn.k_norm.weight")
@@ -659,6 +701,31 @@ def convert_state_dict(
         layers["w_shared_gate"] = stack(P + SE + "gate_proj.weight", transpose=True)
         layers["w_shared_up"] = stack(P + SE + "up_proj.weight", transpose=True)
         layers["w_shared_down"] = stack(P + SE + "down_proj.weight", transpose=True)
+    elif c.n_experts and model_type == "gpt_oss":
+        # gpt-oss ships experts FUSED, PRE-STACKED and INTERLEAVED:
+        #   experts.gate_up_proj [E, H, 2F] with gate = [..., ::2],
+        #   up = [..., 1::2] (HF GptOssExperts), biases [E, 2F] the
+        #   same way; down_proj [E, F, H] + bias [E, H]; router is a
+        #   true Linear [E, H] + [E].
+        gus, gubs, downs, downbs, routers, rbs = [], [], [], [], [], []
+        for i in range(c.n_layers):
+            F = f"model.layers.{i}.mlp."
+            gus.append(_to_np(get(F + "experts.gate_up_proj")))
+            gubs.append(_to_np(get(F + "experts.gate_up_proj_bias")))
+            downs.append(_to_np(get(F + "experts.down_proj")))
+            downbs.append(_to_np(get(F + "experts.down_proj_bias")))
+            routers.append(_to_np(get(F + "router.weight")).T)
+            rbs.append(_to_np(get(F + "router.bias")))
+        gu = np.stack(gus)  # [L, E, H, 2F]
+        gub = np.stack(gubs)  # [L, E, 2F]
+        layers["w_gate"] = np.asarray(gu[..., ::2], dt)
+        layers["w_up"] = np.asarray(gu[..., 1::2], dt)
+        layers["b_gate"] = np.asarray(gub[..., ::2], dt)
+        layers["b_up_e"] = np.asarray(gub[..., 1::2], dt)
+        layers["w_down"] = np.asarray(np.stack(downs), dt)
+        layers["b_down_e"] = np.asarray(np.stack(downbs), dt)
+        layers["w_router"] = np.asarray(np.stack(routers), dt)
+        layers["b_router"] = np.stack(rbs).astype(np.float32)
     elif c.n_experts:
         router, expert_prefix, (g, u, d) = _MOE_NAMES.get(
             model_type, _MOE_NAMES["mixtral"]
@@ -888,6 +955,16 @@ def config_to_hf(config: LlamaConfig) -> dict:
     """:class:`LlamaConfig` → HF ``config.json`` dict (inverse of
     :func:`config_from_hf` for the families we can express)."""
     c = config
+    if c.attn_sinks or c.moe_bias or c.router_topk_softmax:
+        # the generic MoE branch would tag this "mixtral" and silently
+        # drop sinks/expert biases/router semantics — refuse rather
+        # than mis-export (module policy); re-serve gpt-oss fine-tunes
+        # through this framework's engine instead
+        raise ValueError(
+            "gpt-oss configs (attention sinks / biased experts / "
+            "topk-softmax router) cannot be exported as an HF "
+            "checkpoint yet"
+        )
     hf = {
         "hidden_act": (
             "gelu_pytorch_tanh" if c.hidden_act == "gelu_tanh" else "silu"
@@ -910,7 +987,7 @@ def config_to_hf(config: LlamaConfig) -> dict:
             "rope_type": "linear", "factor": float(c.rope_scaling[1])
         }
     elif c.rope_scaling is not None and c.rope_scaling[0] == "yarn":
-        _, factor, beta_fast, beta_slow, orig, att = c.rope_scaling
+        _, factor, beta_fast, beta_slow, orig, att = c.rope_scaling[:6]
         hf["rope_scaling"] = {
             "rope_type": "yarn",
             "factor": factor,
@@ -919,6 +996,8 @@ def config_to_hf(config: LlamaConfig) -> dict:
             "original_max_position_embeddings": int(orig),
             "attention_factor": att,  # resolved; HF reads it directly
         }
+        if len(c.rope_scaling) > 6:  # gpt-oss: truncate=false round trip
+            hf["rope_scaling"]["truncate"] = bool(c.rope_scaling[6])
     elif c.rope_scaling is not None:
         rs = c.rope_scaling
         factor, low_f, high_f, orig = rs[1:] if rs[0] == "llama3" else rs

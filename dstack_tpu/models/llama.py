@@ -157,6 +157,21 @@ class LlamaConfig:
     # (group score: max member for softmax/V2, top-2 sum for sigmoid/V3)
     router_groups: tuple = ()
     routed_scale: float = 1.0  # multiplier on routed gates
+    # --- gpt-oss deltas ---
+    # learned per-head attention-sink logits: an always-present softmax
+    # column that absorbs probability mass (params["layers"]["sinks"])
+    attn_sinks: bool = False
+    # router is a LINEAR layer (logit bias b_router) and gates are
+    # softmax over the top-k logits (select-then-normalize)
+    router_topk_softmax: bool = False
+    # biases on every expert matmul (b_gate/b_up_e [E,F], b_down_e [E,H])
+    # and on the router
+    moe_bias: bool = False
+    # expert activation: "silu" (SwiGLU) | "oai_glu" (gpt-oss clamped
+    # glu: (up+1) * gate * sigmoid(1.702 * gate), inputs clamped to
+    # act_limit)
+    moe_act: str = "silu"
+    act_limit: float = 7.0
     # shared always-on expert FFN width (0 = intermediate_size); HF
     # deepseek folds n_shared_experts into ONE fused MLP of this width
     moe_shared_intermediate: int = 0
@@ -250,8 +265,14 @@ class LlamaConfig:
         extras = pre * nw + (2 * nw if self.post_norms else 0)
         mats = 2 if self.mlp_gateless else 3  # StarCoder2/Nemotron
         mlp_bias = (
-            self.intermediate_size + h if self.proj_bias else 0
+            self.intermediate_size + h
+            if self.proj_bias and not self.n_experts else 0
         )
+        moe_bias = (
+            self.n_experts * (1 + 2 * self.intermediate_size + h)
+            if self.moe_bias else 0
+        )
+        sink = self.n_heads if self.attn_sinks else 0
         moe_layers = self.n_layers - self.first_k_dense
         per_moe = (
             attn + extras
@@ -260,6 +281,7 @@ class LlamaConfig:
             + self._shared_expert_params()
             + (h * self.n_experts if self.n_experts else 0)
             + (self.n_experts if self.router_bias else 0)
+            + moe_bias + sink
         )
         per_dense = (
             attn + extras
@@ -471,6 +493,22 @@ MLA_TINY = LlamaConfig(  # for tests / virtual meshes
     first_k_dense=1, dense_intermediate=192,
 )
 
+_GPT_OSS_COMMON = dict(
+    vocab_size=201088, hidden_size=2880, n_heads=64, n_kv_heads=8,
+    head_dim=64, intermediate_size=2880, rope_theta=150000.0,
+    norm_eps=1e-5, max_seq_len=131072,
+    rope_scaling=("yarn", 32.0, 32.0, 1.0, 4096.0, 1.3465735902799727, False),
+    qkv_bias=True, proj_bias=True, attn_sinks=True,
+    sliding_window=128, sliding_pattern=2,
+    experts_per_token=4, router_topk_softmax=True, moe_bias=True,
+    moe_act="oai_glu",
+)
+GPT_OSS_20B = LlamaConfig(  # openai/gpt-oss-20b (20.9B, 3.6B active)
+    **_GPT_OSS_COMMON, n_layers=24, n_experts=32,
+)
+GPT_OSS_120B = LlamaConfig(  # openai/gpt-oss-120b (116.8B, 5.1B active)
+    **_GPT_OSS_COMMON, n_layers=36, n_experts=128,
+)
 CONFIGS = {
     "llama-3-8b": LLAMA_3_8B,
     "llama-3-70b": LLAMA_3_70B,
@@ -496,6 +534,8 @@ CONFIGS = {
     "command-r-35b": COMMAND_R_35B,
     "minitron-4b": MINITRON_4B,
     "starcoder2-7b": STARCODER2_7B,
+    "gpt-oss-20b": GPT_OSS_20B,
+    "gpt-oss-120b": GPT_OSS_120B,
 }
 
 
@@ -549,6 +589,11 @@ def param_specs(config: LlamaConfig) -> dict:
             mlp["mlp_norm"] = L + N
         if config.router_bias:
             mlp["router_bias"] = L + (None,)
+        if config.moe_bias:
+            mlp["b_router"] = L + (None,)
+            mlp["b_gate"] = L + ("experts", "mlp")
+            mlp["b_up_e"] = L + ("experts", "mlp")
+            mlp["b_down_e"] = L + ("experts", None)
         if config.moe_shared_expert:  # dense: shard like a plain MLP
             mlp["w_shared_gate"] = L + ("embed_fsdp", "mlp")
             mlp["w_shared_up"] = L + ("embed_fsdp", "mlp")
@@ -562,10 +607,13 @@ def param_specs(config: LlamaConfig) -> dict:
         layer["bq"] = L + ("heads",)
         layer["bk"] = L + ("kv_heads",)
         layer["bv"] = L + ("kv_heads",)
-    if config.proj_bias:  # StarCoder2
+    if config.proj_bias:  # StarCoder2 / gpt-oss
         layer["bo"] = L + (None,)
-        layer["b_up"] = L + ("mlp",)
-        layer["b_down"] = L + (None,)
+        if not config.n_experts:  # dense-MLP biases only
+            layer["b_up"] = L + ("mlp",)
+            layer["b_down"] = L + (None,)
+    if config.attn_sinks:
+        layer["sinks"] = L + ("heads",)
     if config.qk_norm:
         if config.norm_type == "layernorm":  # Cohere [H, D] weights
             layer["q_norm"] = L + ("heads", None)
@@ -699,6 +747,11 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
             )
     if c.n_experts and c.router_bias:
         mlp["router_bias"] = jnp.zeros((L, c.n_experts), jnp.float32)
+    if c.n_experts and c.moe_bias:
+        mlp["b_router"] = jnp.zeros((L, c.n_experts), jnp.float32)
+        mlp["b_gate"] = jnp.zeros((L, c.n_experts, c.intermediate_size), dt)
+        mlp["b_up_e"] = jnp.zeros((L, c.n_experts, c.intermediate_size), dt)
+        mlp["b_down_e"] = jnp.zeros((L, c.n_experts, c.hidden_size), dt)
     if not c.pre_norm or c.parallel_block:
         # OLMo-2 has no input norms; Cohere's parallel block shares
         # attn_norm for both sublayers (one real leaf)
@@ -715,10 +768,13 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     }
     if c.pre_norm:
         params["layers"]["attn_norm"] = norm_init((L, c.hidden_size))
-    if c.proj_bias:  # StarCoder2
+    if c.proj_bias:  # StarCoder2 / gpt-oss
         params["layers"]["bo"] = jnp.zeros((L, c.hidden_size), dt)
-        params["layers"]["b_up"] = jnp.zeros((L, c.intermediate_size), dt)
-        params["layers"]["b_down"] = jnp.zeros((L, c.hidden_size), dt)
+        if not c.n_experts:
+            params["layers"]["b_up"] = jnp.zeros((L, c.intermediate_size), dt)
+            params["layers"]["b_down"] = jnp.zeros((L, c.hidden_size), dt)
+    if c.attn_sinks:
+        params["layers"]["sinks"] = jnp.zeros((L, c.n_heads), jnp.float32)
     if c.qk_norm:
         if c.norm_type == "layernorm":  # Cohere per-head weights
             params["layers"]["q_norm"] = jnp.ones((L, c.n_heads, c.head_dim), dt)
@@ -944,15 +1000,20 @@ def rope_freqs(
     if scaling is not None and scaling[0] == "linear":
         inv = inv / float(scaling[1])
     elif scaling is not None and scaling[0] == "yarn":
-        _, factor, beta_fast, beta_slow, orig_ctx, att_f = scaling
+        _, factor, beta_fast, beta_slow, orig_ctx, att_f = scaling[:6]
+        truncate = scaling[6] if len(scaling) > 6 else True
 
         def corr_dim(rot):  # dim whose wavelength fits `rot` rotations
             return (
                 head_dim * math.log(orig_ctx / (rot * 2 * math.pi))
             ) / (2 * math.log(theta))
 
-        low = max(math.floor(corr_dim(beta_fast)), 0)
-        high = min(math.ceil(corr_dim(beta_slow)), head_dim - 1)
+        if truncate:  # HF floor/ceils the correction range by default
+            low = max(math.floor(corr_dim(beta_fast)), 0)
+            high = min(math.ceil(corr_dim(beta_slow)), head_dim - 1)
+        else:  # gpt-oss: truncate=false keeps the raw boundaries
+            low = max(corr_dim(beta_fast), 0)
+            high = min(corr_dim(beta_slow), head_dim - 1)
         if low == high:
             high += 0.001  # HF's singularity guard
         ramp = jnp.clip(
@@ -1173,6 +1234,13 @@ def _attention_block(
             "chunked attention (Llama4) does not compose with sp "
             "sequence parallelism yet"
         )
+    sinks = layer.get("sinks") if c.attn_sinks else None
+    if use_sp and sinks is not None:
+        raise NotImplementedError(
+            "attention sinks do not compose with sp sequence "
+            "parallelism yet (the ring/ulysses paths have no sink "
+            "column)"
+        )
     if use_sp and c.seq_parallel == "ulysses":
         from dstack_tpu.parallel.ulysses import ulysses_attention
 
@@ -1189,6 +1257,7 @@ def _attention_block(
         o = attention(
             q, k, v, causal=True, scale=scale, impl=attn_impl,
             window=window, softcap=c.attn_softcap, chunk=chunk,
+            sinks=sinks,
         )
     if c.mla and c.qk_head_dim > c.v_head_dim:
         o = o[..., : c.v_head_dim]  # drop the zero v padding
@@ -1237,6 +1306,9 @@ def _mlp_block(
             score=config.router_score,
             groups=config.router_groups,
             routed_scale=config.routed_scale,
+            topk_softmax=config.router_topk_softmax,
+            act=config.moe_act,
+            act_limit=config.act_limit,
         )
         aux_loss = (
             config.router_balance_coef * aux["balance"]

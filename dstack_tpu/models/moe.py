@@ -73,6 +73,8 @@ def router(
     groups: tuple = (),  # DeepSeek (n_group, topk_group) group limiting
     bias: Optional[jax.Array] = None,  # V3 e_score_correction_bias [E]
     routed_scale: float = 1.0,  # DeepSeek routed_scaling_factor
+    pre_bias: Optional[jax.Array] = None,  # gpt-oss linear router bias [E]
+    topk_softmax: bool = False,  # gpt-oss: gates = softmax over top-k logits
 ) -> tuple[jax.Array, jax.Array, dict]:
     """Top-k routing → (dispatch [B,T,E,C] one-hot, combine [B,T,E,C], aux).
 
@@ -94,8 +96,15 @@ def router(
     logits = jnp.einsum(
         "bth,he->bte", x, w_router.astype(x.dtype), preferred_element_type=jnp.float32
     )  # [B, T, E] f32
+    if pre_bias is not None:  # a true LINEAR router (gpt-oss)
+        logits = logits + pre_bias.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
-    if sigmoid:
+    if topk_softmax:
+        # gpt-oss: select by raw logit, then softmax over ONLY the
+        # selected logits (HF GptOssTopKRouter)
+        top_logits, expert_idx = jax.lax.top_k(logits, experts_per_token)
+        gate_vals = jax.nn.softmax(top_logits, axis=-1)
+    elif sigmoid:
         top_logits, expert_idx = jax.lax.top_k(logits, experts_per_token)
         gate_vals = jax.nn.sigmoid(top_logits)
     else:
@@ -160,6 +169,9 @@ def moe_mlp(
     score: str = "softmax",  # DeepSeek-V3: "sigmoid" full-score routing
     groups: tuple = (),  # DeepSeek (n_group, topk_group)
     routed_scale: float = 1.0,  # DeepSeek routed_scaling_factor
+    topk_softmax: bool = False,  # gpt-oss router (gates softmax over top-k)
+    act: str = "silu",  # "silu" SwiGLU | "oai_glu" gpt-oss clamped glu
+    act_limit: float = 7.0,
 ) -> tuple[jax.Array, dict]:
     """Sparse SwiGLU FFN → (output [B,T,H], aux losses).
 
@@ -183,6 +195,7 @@ def moe_mlp(
         x, layer["w_router"], n_experts, experts_per_token, cap,
         renorm=renorm, sigmoid=sigmoid_input, score=score, groups=groups,
         bias=layer.get("router_bias"), routed_scale=routed_scale,
+        pre_bias=layer.get("b_router"), topk_softmax=topk_softmax,
     )
     if sigmoid_input:
         # move the gate onto the dispatch side: expert input is g·x,
@@ -200,12 +213,25 @@ def moe_mlp(
     if sg is not None:  # scales are [E, F]: broadcast over (b, c)
         g = g * sg[:, None, None, :].astype(g.dtype)
         u = u * su[:, None, None, :].astype(u.dtype)
+    if "b_gate" in layer:  # gpt-oss expert biases [E, F]
+        g = g + layer["b_gate"][:, None, None, :].astype(g.dtype)
+        u = u + layer["b_up_e"][:, None, None, :].astype(u.dtype)
     if rules is not None:
         g = constrain(g, rules, "experts", "batch_noexp", None, "mlp", mesh=mesh)
+    if act == "oai_glu":
+        # gpt-oss clamped glu: (up+1) * gate * sigmoid(1.702 * gate),
+        # gate clamped above, up clamped both sides (HF GptOssExperts)
+        g = jnp.minimum(g, act_limit)
+        u = jnp.clip(u, -act_limit, act_limit)
+        inner = (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
+    else:
+        inner = jax.nn.silu(g) * u
     wd, sd = qw("w_down")
-    y = jnp.einsum("ebcf,efh->ebch", jax.nn.silu(g) * u, wd)
+    y = jnp.einsum("ebcf,efh->ebch", inner, wd)
     if sd is not None:  # [E, H]
         y = y * sd[:, None, None, :].astype(y.dtype)
+    if "b_down_e" in layer:
+        y = y + layer["b_down_e"][:, None, None, :].astype(y.dtype)
     if rules is not None:
         y = constrain(y, rules, "experts", "batch_noexp", None, None, mesh=mesh)
     out = jnp.einsum("btec,ebch->bth", combine, y)

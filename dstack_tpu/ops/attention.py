@@ -29,6 +29,18 @@ __all__ = [
 ]
 
 
+def sink_softmax(s: jax.Array, sink: jax.Array) -> jax.Array:
+    """Softmax over the last axis with a learned sink logit joining the
+    DENOMINATOR only (gpt-oss attention sinks: an always-present column
+    that absorbs probability mass and is dropped from the value sum —
+    HF's concat-then-drop eager path in streaming form). ``s`` is the
+    pre-masked f32 scores; ``sink`` must broadcast against ``s`` with a
+    trailing singleton key axis."""
+    m = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), sink)
+    e = jnp.exp(s - m)
+    return e / (jnp.sum(e, axis=-1, keepdims=True) + jnp.exp(sink - m))
+
+
 def _xla_attention(
     q: jax.Array,  # [B, H, Tq, D]
     k: jax.Array,  # [B, Hkv, Tk, D]
@@ -39,6 +51,7 @@ def _xla_attention(
     window: int = 0,
     softcap: float = 0.0,
     chunk: int = 0,
+    sinks: "Optional[jax.Array]" = None,  # [H] per-head sink logits
 ) -> jax.Array:
     b, h, tq, d = q.shape
     hkv = k.shape[1]
@@ -64,7 +77,10 @@ def _xla_attention(
             # local, not a sliding window)
             keep = keep & (qi // chunk == kj // chunk)
         s = jnp.where(keep, s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+    if sinks is not None:
+        p = sink_softmax(s, sinks.astype(jnp.float32).reshape(1, -1, 1, 1))
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
@@ -79,10 +95,19 @@ def attention(
     window: int = 0,  # 0 = full attention; else sliding window size
     softcap: float = 0.0,  # 0 = off; else tanh soft-cap on scores
     chunk: int = 0,  # 0 = off; else Llama4 blockwise-chunk size
+    sinks: Optional[jax.Array] = None,  # [H] gpt-oss attention sinks
     impl: Optional[str] = None,  # None=auto | "flash" | "xla"
 ) -> jax.Array:
     """Dispatching attention entry point used by models."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if sinks is not None:
+        # the pallas kernel has no sink column; sink models take the
+        # masked XLA path (scores softmax is the cheap part at the
+        # sizes these models serve at)
+        return _xla_attention(
+            q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+            window=window, softcap=softcap, chunk=chunk, sinks=sinks,
+        )
     if chunk and causal and q_offset + q.shape[2] <= chunk:
         # all queries live in the first chunk, and causal masking
         # already hides every key past them — identical to plain

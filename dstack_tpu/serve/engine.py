@@ -292,6 +292,8 @@ def _mlp_out(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
             sigmoid_input=c.router_sigmoid_input,
             score=c.router_score, groups=c.router_groups,
             routed_scale=c.routed_scale,
+            topk_softmax=c.router_topk_softmax,
+            act=c.moe_act, act_limit=c.act_limit,
         )
     else:
         u = _proj(layer, "w_up", m, "bte,ef->btf", "bte,er->btr", "btr,rf->btf")
@@ -705,6 +707,7 @@ def prefill_chunk_step(
             q, row_k, row_v, causal=True, scale=scale, q_offset=start,
             window=window, softcap=c.attn_softcap,
             chunk=0 if nope else c.attention_chunk_size,
+            sinks=layer.get("sinks") if c.attn_sinks else None,
         )
         o = o.transpose(0, 2, 1, 3).reshape(b, cl, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
@@ -871,7 +874,18 @@ def decode_step(
             start = (pos // c.attention_chunk_size) * c.attention_chunk_size
             mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= start))
         s = jnp.where(mask, s, NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
+        if c.attn_sinks:
+            # [Hkv, G] regroup matches the query-head order
+            from dstack_tpu.ops.attention import sink_softmax
+
+            p = sink_softmax(
+                s,
+                layer["sinks"].astype(jnp.float32).reshape(
+                    1, c.n_kv_heads, grp, 1
+                ),
+            )
+        else:
+            p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhgk,bhkd->bhgd", p.astype(cvf.dtype), cvf)
         # [B, Hkv, G, D] row-major flatten == query-head order
         o = o.reshape(b, 1, c.q_dim)
@@ -1068,7 +1082,20 @@ def verify_step(
             cstart = (qpos // c.attention_chunk_size) * c.attention_chunk_size
             mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= cstart))
         s = jnp.where(mask, s, NEG_INF)
-        p = jax.nn.softmax(s, axis=-1)
+        if c.attn_sinks:
+            # speculative verify attends with the SAME sink column as
+            # decode — omitting it here would silently verify drafts
+            # against a different model
+            from dstack_tpu.ops.attention import sink_softmax
+
+            p = sink_softmax(
+                s,
+                layer["sinks"].astype(jnp.float32).reshape(
+                    1, c.n_kv_heads, grp, 1, 1
+                ),
+            )
+        else:
+            p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bhgsk,bhkd->bhgsd", p.astype(cvf.dtype), cvf)
         o = o.transpose(0, 3, 1, 2, 4).reshape(b, sdraft, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")

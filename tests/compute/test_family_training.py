@@ -48,6 +48,12 @@ FAMILY_DELTAS = {
         embed_multiplier=12.0, residual_multiplier=0.22,
         attn_scale=0.25, logit_scale=0.125,
     ),
+    "gpt_oss": dict(
+        qkv_bias=True, proj_bias=True, attn_sinks=True,
+        sliding_window=8, sliding_pattern=2,
+        n_experts=4, experts_per_token=2, capacity_factor=2.0,
+        router_topk_softmax=True, moe_bias=True, moe_act="oai_glu",
+    ),
 }
 
 
