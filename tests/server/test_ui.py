@@ -626,7 +626,9 @@ class TestConsoleDetailPages:
             "/api/project/main/runs/apply", headers=_auth("dt-tok"), json=body
         )
         assert r.status == 200
-        for _ in range(120):
+        # generous budget: under full-suite load (XLA compiles on one
+        # core) a 60s wait flaked; 120s matches test_e2e_local's default
+        for _ in range(240):
             r = await client.post(
                 "/api/project/main/runs/get",
                 headers=_auth("dt-tok"),
@@ -636,7 +638,7 @@ class TestConsoleDetailPages:
             if run["status"] in ("done", "failed", "terminated"):
                 break
             await asyncio.sleep(0.5)
-        assert run["status"] == "done"
+        assert run["status"] == "done", run["status"]
         return app, client, run
 
     async def test_instance_get_returns_jobs_and_attachments(self, tmp_path):
