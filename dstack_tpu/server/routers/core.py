@@ -309,8 +309,16 @@ async def apply_yaml(ctx: RequestContext, body: s.ApplyYamlRequest):
 
 
 @project_router.post("/runs/list")
-async def list_runs(ctx: RequestContext):
-    return await runs_service.list_runs(ctx.state["db"], ctx.project)
+async def list_runs(ctx: RequestContext, body: s.ListRunsRequest):
+    return await runs_service.list_runs(
+        ctx.state["db"],
+        ctx.project,
+        only_active=body.only_active,
+        prev_submitted_at=body.prev_submitted_at,
+        prev_run_id=body.prev_run_id,
+        limit=body.limit,
+        ascending=body.ascending,
+    )
 
 
 @project_router.post("/runs/get")

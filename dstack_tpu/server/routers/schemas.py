@@ -95,6 +95,20 @@ class ApplyRunPlanRequest(CoreModel):
     force: bool = False
 
 
+class ListRunsRequest(CoreModel):
+    """Keyset pagination over runs, newest first by default — parity
+    with the reference's ListRunsRequest (server/schemas/runs.py:11-16:
+    only_active + prev_submitted_at/prev_run_id cursor + limit +
+    ascending). All fields defaulted so legacy `{}` bodies (CLI/API
+    clients predating pagination) keep returning the full list."""
+
+    only_active: bool = False
+    prev_submitted_at: Optional[str] = None
+    prev_run_id: Optional[str] = None
+    limit: int = 0  # 0 = unlimited
+    ascending: bool = False
+
+
 class GetRunRequest(CoreModel):
     run_name: str
 

@@ -5,7 +5,7 @@ Parity: reference server/services/runs.py (``get_plan:273``,
 ``scale_run_replicas:957``).
 """
 
-from datetime import datetime
+from datetime import datetime, timezone
 from typing import Optional
 
 from dstack_tpu.core.errors import (
@@ -378,7 +378,15 @@ async def list_runs(
     project_row: Optional[dict] = None,
     include_deleted: bool = False,
     only_active: bool = False,
+    prev_submitted_at: Optional[str] = None,
+    prev_run_id: Optional[str] = None,
+    limit: int = 0,
+    ascending: bool = False,
 ) -> list[Run]:
+    """Keyset-paginated listing (reference: services/runs.py:160-176 —
+    (submitted_at, id) cursor so pages stay stable while new runs
+    arrive). ``limit=0`` returns everything; the cursor is the last
+    row's (submitted_at, id) pair from the previous page."""
     sql = "SELECT * FROM runs WHERE 1=1"
     params: list = []
     if project_row is not None:
@@ -390,7 +398,34 @@ async def list_runs(
         finished = tuple(s.value for s in RunStatus.finished_statuses())
         sql += f" AND status NOT IN ({','.join('?' for _ in finished)})"
         params.extend(finished)
-    sql += " ORDER BY submitted_at DESC"
+    if prev_submitted_at:  # "" = no cursor, like None
+        # normalize the cursor to the stored representation
+        # (now_utc().isoformat(), +00:00 offset) — clients echo the
+        # JSON-serialized "Z"-suffix form back, which py3.10's
+        # fromisoformat rejects and any python rejects when malformed
+        try:
+            parsed = _dt(prev_submitted_at.replace("Z", "+00:00"))
+        except ValueError:
+            raise ClientError(
+                f"invalid prev_submitted_at cursor: {prev_submitted_at!r}"
+            )
+        if parsed is not None:
+            prev_submitted_at = parsed.astimezone(timezone.utc).isoformat()
+        cmp = ">" if ascending else "<"
+        if prev_run_id is not None:
+            sql += (
+                f" AND (submitted_at {cmp} ? OR"
+                f" (submitted_at = ? AND id {cmp} ?))"
+            )
+            params.extend([prev_submitted_at, prev_submitted_at, prev_run_id])
+        else:
+            sql += f" AND submitted_at {cmp} ?"
+            params.append(prev_submitted_at)
+    order = "ASC" if ascending else "DESC"
+    sql += f" ORDER BY submitted_at {order}, id {order}"
+    if limit > 0:
+        sql += " LIMIT ?"
+        params.append(limit)
     rows = await db.fetchall(sql, params)
     return [await run_row_to_run(db, r) for r in rows]
 

@@ -393,8 +393,14 @@ async function pageOverview() {
   );
 }
 
+const RUNS_PAGE = 100;  // server-side keyset page size for the Runs list
+
 async function pageRuns() {
-  const runs = await papi("/runs/list");
+  // "active only" filters server-side (an active run older than the
+  // first page must still show up); the text filter is client-side
+  // over the loaded pages
+  const runs = await papi("/runs/list",
+    { limit: RUNS_PAGE, only_active: !!state.runsActiveOnly });
   // client-side filtering re-renders ONLY the table container: a full
   // render() would rebuild the DOM and steal focus from the input
   const listDiv = h("div", {});
@@ -407,7 +413,6 @@ async function pageRuns() {
   const applyFilter = () => {
     const q = (state.runsFilter || "").toLowerCase();
     const filtered = runs.filter((r) => {
-      if (state.runsActiveOnly && !ACTIVE_STATUSES.includes(r.status)) return false;
       if (!q) return true;
       const hay = (`${r.run_spec.run_name} ${r.status} ` +
         `${r.run_spec.configuration?.type || ""}`).toLowerCase();
@@ -416,7 +421,8 @@ async function pageRuns() {
     listDiv.replaceChildren(runsTable(filtered));
   };
   filterIn.oninput = () => { state.runsFilter = filterIn.value; applyFilter(); };
-  activeCb.onchange = () => { state.runsActiveOnly = activeCb.checked; applyFilter(); };
+  // server-side flag: refetch page 1 with the new only_active value
+  activeCb.onchange = () => { state.runsActiveOnly = activeCb.checked; render(); };
   const runsTable = (rows) => table(
       ["Name", "Type", "Status", "Backend", "Resources", "Submitted", ""],
       rows.map((r) => {
@@ -438,6 +444,9 @@ async function pageRuns() {
                   await papi("/runs/stop", { runs_names: [r.run_spec.run_name], abort: false });
                   toast(`Stopping ${r.run_spec.run_name}`); render();
                 } }, "Stop")
+              // terminating: neither stoppable nor deletable yet —
+              // the server rejects delete until the run is finished
+              : r.status === "terminating" ? null
               : h("button", { class: "danger", onclick: async (e) => {
                   e.stopPropagation();
                   await papi("/runs/delete", { runs_names: [r.run_spec.run_name] });
@@ -449,6 +458,27 @@ async function pageRuns() {
       "No runs — submit one with `dtpu apply -f task.yaml`",
   );
   applyFilter();
+  // keyset "Load more": cursor = last row's (submitted_at, id); the
+  // button disappears once a page comes back short
+  const moreDiv = h("div", { style: "margin:10px 0" });
+  if (runs.length === RUNS_PAGE) {
+    const moreBtn = h("button", { onclick: async () => {
+      moreBtn.disabled = true;  // double-click = duplicate page append
+      try {
+        const last = runs[runs.length - 1];
+        const page = await papi("/runs/list", {
+          limit: RUNS_PAGE,
+          only_active: !!state.runsActiveOnly,
+          prev_submitted_at: last.submitted_at,
+          prev_run_id: last.id,
+        });
+        runs.push(...page);
+        applyFilter();
+        if (page.length < RUNS_PAGE) { moreDiv.replaceChildren(); return; }
+      } finally { moreBtn.disabled = false; }
+    } }, `Load ${RUNS_PAGE} more`);
+    moreDiv.replaceChildren(moreBtn);
+  }
   return h("div", {},
     h("h1", { style: "display:flex;align-items:center;gap:12px" }, "Runs",
       h("div", { style: "flex:1" }),
@@ -466,6 +496,7 @@ async function pageRuns() {
       },
     ),
     listDiv,
+    moreDiv,
   );
 }
 
@@ -656,7 +687,14 @@ async function pageRunDetail(name) {
     h("h1", {}, "Hardware metrics"),
     metricsDiv,
     chartDiv,
-    h("h1", {}, "Logs"),
+    h("h1", { style: "display:flex;align-items:center;gap:10px" }, "Logs",
+      h("button", { style: "font-size:12px", onclick: () => {
+        const blob = new Blob([logsPre.textContent], { type: "text/plain" });
+        const a = h("a", { href: URL.createObjectURL(blob), download: `${name}.log` });
+        a.click();
+        URL.revokeObjectURL(a.href);
+      } }, "Download"),
+    ),
     logsPre,
   );
 }

@@ -175,6 +175,72 @@ class TestRunsAPI:
         finally:
             await client.close()
 
+    async def test_list_keyset_pagination(self):
+        """(submitted_at, id) cursor pages cover every run exactly once
+        even with colliding timestamps — parity with the reference's
+        ListRunsRequest cursor (server/schemas/runs.py:11-16)."""
+        client, token = await _client()
+        try:
+            for i in range(5):
+                r = await client.post(
+                    "/api/project/main/runs/apply",
+                    headers=_auth(token),
+                    json={"run_spec": {
+                        **TASK["run_spec"], "run_name": f"page-run-{i}",
+                    }},
+                )
+                assert r.status == 200
+            seen: list = []
+            cursor: dict = {}
+            for _ in range(10):  # bounded walk; breaks on short page
+                r = await client.post(
+                    "/api/project/main/runs/list",
+                    headers=_auth(token),
+                    json={"limit": 2, **cursor},
+                )
+                page = await r.json()
+                seen.extend(x["run_spec"]["run_name"] for x in page)
+                if len(page) < 2:
+                    break
+                cursor = {
+                    "prev_submitted_at": page[-1]["submitted_at"],
+                    "prev_run_id": page[-1]["id"],
+                }
+            assert sorted(seen) == [f"page-run-{i}" for i in range(5)]
+            assert len(seen) == len(set(seen))  # no duplicates across pages
+            # legacy empty body still returns everything, newest first
+            r = await client.post(
+                "/api/project/main/runs/list", headers=_auth(token)
+            )
+            assert len(await r.json()) == 5
+            # ascending walks oldest → newest
+            r = await client.post(
+                "/api/project/main/runs/list",
+                headers=_auth(token),
+                json={"limit": 5, "ascending": True},
+            )
+            asc = [x["run_spec"]["run_name"] for x in await r.json()]
+            assert asc == list(reversed(
+                [x for x in seen]))  # descending pages reversed
+            # the JSON-serialized "Z"-suffix timestamp form is accepted
+            r = await client.post(
+                "/api/project/main/runs/list",
+                headers=_auth(token),
+                json={"limit": 2, "prev_submitted_at":
+                      page[0]["submitted_at"].replace("+00:00", "Z")
+                      if page else "2030-01-01T00:00:00Z"},
+            )
+            assert r.status == 200
+            # a malformed cursor is a client error, not a 500
+            r = await client.post(
+                "/api/project/main/runs/list",
+                headers=_auth(token),
+                json={"limit": 2, "prev_submitted_at": "garbage"},
+            )
+            assert r.status == 400
+        finally:
+            await client.close()
+
 
 class TestSecretsAPI:
     async def test_secret_roundtrip(self):

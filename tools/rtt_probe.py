@@ -1,0 +1,79 @@
+"""Tunnel round-trip probe: separates axon-tunnel latency from engine
+behavior when serving numbers look dispatch-bound.
+
+Measures, on the live backend (TPU via the tunnel, or CPU fallback):
+
+- ``dispatch_rtt_ms``: host→device→host round trip for a trivial op
+  (1-element add, result pulled with ``device_get``) — the floor every
+  un-amortized ``Engine.step()`` pays per token.
+- ``chained_rtt_ms``: the same op dispatched K=32 times back-to-back
+  before a single ``device_get`` — how much of the RTT async dispatch
+  pipelining hides (turbo macro-steps rely on this amortization).
+- ``h2d_MBps`` / ``d2h_MBps``: 64 MiB transfer bandwidth each way, the
+  cost of weight upload and sampled-token readback.
+
+Prints one JSON line; used to annotate serving evidence captured
+through the tunnel (decode tok/s at batch B implies a per-step budget
+of ``B / tok_s`` seconds — compare against ``dispatch_rtt_ms``).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _med(samples):
+    return float(np.median(samples) * 1000.0)
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    one = jnp.ones((), jnp.float32)
+    add = jax.jit(lambda x: x + 1)
+    add(one).block_until_ready()  # compile
+
+    rtts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        jax.device_get(add(one))
+        rtts.append(time.perf_counter() - t0)
+
+    chained = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        x = one
+        for _ in range(32):
+            x = add(x)
+        jax.device_get(x)
+        chained.append(time.perf_counter() - t0)
+
+    mb = 64
+    buf = np.ones((mb << 20) // 4, np.float32)
+    t0 = time.perf_counter()
+    dbuf = jax.device_put(buf)
+    dbuf.block_until_ready()
+    h2d = mb / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    jax.device_get(dbuf)
+    d2h = mb / (time.perf_counter() - t0)
+
+    print(json.dumps({
+        "metric": "tunnel_rtt",
+        "value": round(_med(rtts), 2),
+        "unit": "ms",
+        "extra": {
+            "platform": dev.platform,
+            "dispatch_rtt_ms": round(_med(rtts), 2),
+            "chained32_total_ms": round(_med(chained), 2),
+            "chained32_per_step_ms": round(_med(chained) / 32, 3),
+            "h2d_MBps": round(h2d, 1),
+            "d2h_MBps": round(d2h, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
