@@ -24,6 +24,7 @@ def run_bench(
     repetitive: bool = False,
     quantize=None,
     turbo_steps: int = 8,
+    turbo_depth: int = 1,
     kv_quant=None,
     prefill_chunk: int = 256,
 ) -> dict:
@@ -52,7 +53,8 @@ def run_bench(
         params = llama.init_params(config, jax.random.key(0))
     eng = InferenceEngine(
         config, params, max_batch=batch, max_seq=max_seq,
-        spec_draft=spec_draft, turbo_steps=turbo_steps, kv_quant=kv_quant,
+        spec_draft=spec_draft, turbo_steps=turbo_steps,
+        turbo_depth=turbo_depth, kv_quant=kv_quant,
         prefill_chunk=prefill_chunk,
     )
     rng = np.random.default_rng(0)
@@ -182,6 +184,7 @@ def run_bench(
             "tokens_per_step": round(tokens / max(steps, 1), 2),
             "spec_draft": spec_draft,
             "turbo_steps": turbo_steps,
+            "turbo_depth": turbo_depth,
             "quantize": quantize,
             "kv_quant": kv_quant,
             "backend": jax.default_backend(),
@@ -213,6 +216,11 @@ def main(argv=None) -> int:
         help="device-side decode steps per dispatch (0/1 = per-token)",
     )
     p.add_argument(
+        "--turbo-depth", type=int, default=1,
+        help="macro-steps kept in flight per host round trip (pipelined "
+             "turbo; >1 amortizes remote-device RTT)",
+    )
+    p.add_argument(
         "--prefill-chunk", type=int, default=256,
         help="prefill chunk length (prefix reuse is chunk-granular)",
     )
@@ -234,6 +242,7 @@ def main(argv=None) -> int:
         repetitive=args.repetitive,
         quantize=args.quantize,
         turbo_steps=args.turbo_steps,
+        turbo_depth=args.turbo_depth,
         kv_quant=args.kv_quant,
         prefill_chunk=args.prefill_chunk,
     )

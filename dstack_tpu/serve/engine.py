@@ -22,6 +22,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from dstack_tpu.models import llama
 from dstack_tpu.models.llama import (
@@ -1311,6 +1312,7 @@ class InferenceEngine:
         prefix_cache: bool = True,
         kv_quant=None,  # None | "int8": quantized KV cache
         turbo_quiet_s: float = 0.5,
+        turbo_depth: int = 1,
     ):
         """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
         params shard per the model's logical rules (heads/mlp/vocab over
@@ -1420,6 +1422,15 @@ class InferenceEngine:
         self.waiting_requests = 0  # hint set by the serving scheduler
         self._turbo_k = min(8, self.turbo_steps) or self.turbo_steps
         self._last_admit = 0.0
+        # PIPELINED turbo: once the adaptive cap is fully open, chain
+        # up to turbo_depth macro-steps device-side per step() call and
+        # fetch their token buffers with ONE blocking transfer — each
+        # un-chained macro-step pays a full host↔device round trip,
+        # which dominates when the device is remote (driver host ↔ TPU
+        # VM, or the dev tunnel). decode_loop's returned device-side
+        # (token, position, budget, active) state feeds the next
+        # segment directly, so chaining never syncs mid-flight.
+        self.turbo_depth = max(1, turbo_depth)
 
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
@@ -1771,6 +1782,14 @@ class InferenceEngine:
             # to repetition_penalty == 1.0, where seen has no effect
         return out
 
+    def _arrival_busy(self) -> bool:
+        """Requests waiting or recently admitted: the regime where long
+        device loops tax a newcomer's first token."""
+        return (
+            self.waiting_requests > 0
+            or (time.monotonic() - self._last_admit) < self.turbo_quiet_s
+        )
+
     def _adaptive_turbo_cap(self) -> int:
         """Current macro-step budget: the floor (8) while requests are
         arriving/waiting, doubling toward ``turbo_steps`` once
@@ -1780,11 +1799,7 @@ class InferenceEngine:
         if self.turbo_steps <= 1:
             return self.turbo_steps
         floor = min(8, self.turbo_steps)
-        busy = (
-            self.waiting_requests > 0
-            or (time.monotonic() - self._last_admit) < self.turbo_quiet_s
-        )
-        if busy:
+        if self._arrival_busy():
             self._turbo_k = floor
         else:
             self._turbo_k = min(self._turbo_k * 2, self.turbo_steps)
@@ -1810,31 +1825,49 @@ class InferenceEngine:
         # must not pay turbo_steps masked forward passes for one
         # token), bucketed to powers of two so the compile-cache holds
         # at most log2(turbo_steps) variants
-        needed = min(
-            self._adaptive_turbo_cap(), max(self.remaining[i] for i in live)
-        )
+        budget = max(self.remaining[i] for i in live)
+        needed = min(self._adaptive_turbo_cap(), budget)
         steps = 1
         while steps < needed:
             steps *= 2
         steps = min(steps, self.turbo_steps)
+        # pipelined segments: only in the saturated regime — cap fully
+        # open AND arrival-quiet (with turbo_steps ≤ 8 the busy floor
+        # equals the cap, so the cap alone can't prove quiet) — and
+        # never past the widest remaining budget; arrivals would
+        # otherwise wait depth×K device steps for their first token
+        depth = 1
+        if (
+            self.turbo_depth > 1
+            and steps == self.turbo_steps
+            and self._turbo_k == self.turbo_steps
+            and not self._arrival_busy()
+        ):
+            depth = min(self.turbo_depth, -(-budget // steps))
         eos = [
             self.eos[i] if self.eos[i] is not None else -1
             for i in range(self.max_batch)
         ]
-        toks_dev, self.cache, _, _, _, _ = self._turbo_fn(steps)(
-            self.params,
-            self.cache,
-            jnp.asarray(self.last_token, jnp.int32),
-            jnp.asarray(self.lengths, jnp.int32),
-            jnp.asarray(self.remaining, jnp.int32),
-            jnp.asarray(self.active, bool),
-            jnp.asarray(eos, jnp.int32),
-        )
-        toks = jax.device_get(toks_dev)  # [steps, B]
+        tok_d = jnp.asarray(self.last_token, jnp.int32)
+        pos_d = jnp.asarray(self.lengths, jnp.int32)
+        rem_d = jnp.asarray(self.remaining, jnp.int32)
+        act_d = jnp.asarray(self.active, bool)
+        eos_d = jnp.asarray(eos, jnp.int32)
+        segs = []
+        for _ in range(depth):
+            toks_dev, self.cache, tok_d, pos_d, rem_d, act_d = (
+                self._turbo_fn(steps)(
+                    self.params, self.cache,
+                    tok_d, pos_d, rem_d, act_d, eos_d,
+                )
+            )
+            segs.append(toks_dev)
+        # ONE blocking fetch for every in-flight segment ([depth*steps, B])
+        toks = np.concatenate(jax.device_get(segs), axis=0)
         out: dict = {}
         for i in live:
             emitted: list = []
-            for k in range(steps):
+            for k in range(depth * steps):
                 tok = int(toks[k][i])
                 if tok < 0:  # row deactivated on an earlier step
                     break

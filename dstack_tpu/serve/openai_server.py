@@ -1059,6 +1059,14 @@ def main(argv=None) -> int:
              "streaming then delivers token-by-token)",
     )
     p.add_argument(
+        "--turbo-depth", type=int, default=1,
+        help="macro-steps kept in flight per host round trip once the "
+             "adaptive turbo cap is fully open (pipelined turbo: >1 "
+             "amortizes the host↔device RTT when the server drives a "
+             "remote TPU; costs up to depth×turbo-steps extra masked "
+             "steps when every slot finishes early)",
+    )
+    p.add_argument(
         "--no-warmup", action="store_true",
         help="skip the startup compile warmup (first request then pays "
              "the prefill/decode XLA compiles in its TTFT)",
@@ -1174,6 +1182,7 @@ def main(argv=None) -> int:
         config, params, max_batch=args.max_batch, max_seq=args.max_seq,
         mesh=mesh, spec_draft=args.spec_draft,
         turbo_steps=args.turbo_steps,
+        turbo_depth=args.turbo_depth,
         prefix_cache=not args.no_prefix_cache,
         kv_quant=args.kv_quant,
     )

@@ -409,8 +409,7 @@ async def list_runs(
             raise ClientError(
                 f"invalid prev_submitted_at cursor: {prev_submitted_at!r}"
             )
-        if parsed is not None:
-            prev_submitted_at = parsed.astimezone(timezone.utc).isoformat()
+        prev_submitted_at = parsed.astimezone(timezone.utc).isoformat()
         cmp = ">" if ascending else "<"
         if prev_run_id is not None:
             sql += (

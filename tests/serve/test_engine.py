@@ -437,6 +437,46 @@ class TestTurboDecode:
         assert got1 == ref1
         assert got2 == ref2
 
+    def test_pipelined_depth_matches_per_step(self):
+        # turbo_depth chains macro-steps device-side with one fetch —
+        # emission must stay byte-identical to the per-step path
+        prompt = [5, 99, 321, 7, 250]
+        on = self._engine(4, turbo_depth=3, turbo_quiet_s=0.0)
+        off = self._engine(0)
+        g = lambda: GenParams(max_new_tokens=25)  # noqa: E731
+        assert on.generate(prompt, g()) == off.generate(prompt, g())
+
+    def test_pipelined_single_fetch_per_chain(self):
+        eng = self._engine(4, turbo_depth=2, turbo_quiet_s=0.0, max_seq=128)
+        slot, first = eng.add_request(
+            [3, 1, 4, 1, 5], GenParams(max_new_tokens=17)
+        )
+        calls, got = 0, [first]
+        while eng.active[slot]:
+            out = eng.step()
+            calls += 1
+            got.extend(out.get(slot, []))
+        assert len(got) == 17
+        # 16 post-prefill tokens / (depth 2 × 4-step macro) = 2 chains
+        assert calls <= 2
+        assert eng.finish_reason[slot] == "length"
+
+    def test_pipelined_eos_mid_chain(self):
+        # EOS inside segment 1 of a depth-2 chain: segment 2 runs fully
+        # masked on device; the host replay stops at the eos token
+        prompt = [5, 99, 321]
+        ref = _reference_greedy(self.params, self.config, prompt, 4)
+        eng = self._engine(4, turbo_depth=2, turbo_quiet_s=0.0, max_seq=128)
+        slot, first = eng.add_request(
+            prompt, GenParams(max_new_tokens=20, eos_id=ref[3])
+        )
+        got = [first]
+        while eng.active[slot]:
+            got.extend(eng.step().get(slot, []))
+        assert got == ref[:4]
+        assert eng.finish_reason[slot] == "stop"
+        assert eng.lengths[slot] == len(prompt) + 3
+
     def test_sampled_batch_bypasses_turbo(self):
         eng = self._engine(8, max_batch=1, max_seq=128)
         slot, _ = eng.add_request(
