@@ -123,3 +123,69 @@ def quant_param_specs(specs: dict) -> dict:
 
 def is_quantized(params: dict) -> bool:
     return any(k.endswith("_q") for k in params.get("layers", {}))
+
+
+def random_quantized_params(config: LlamaConfig, seed: int = 0) -> dict:
+    """Benchmark-only: the int8 serving tree with random values, built
+    directly in numpy.
+
+    ``init_params`` → ``quantize_tree`` materializes the full-precision
+    tree through JAX's host PRNG first — tens of minutes of threefry on
+    a small driver VM for an 8B model, which blew the 8B serving
+    capture's whole tunnel-window budget. Decode throughput/latency are
+    weight-value-independent, so the bench path emits random int8
+    projections (+ jittered per-channel scales, so no two channels
+    dequantize identically) and random-normal bf16 for everything
+    else. Structure comes from ``jax.eval_shape`` over the real
+    ``init_params``/``quantize_tree`` pair, so any tree-layout change
+    shows up here as a shape mismatch, not silent drift."""
+    from functools import partial
+
+    from dstack_tpu.models import llama
+
+    if config.mla:
+        raise ValueError(
+            "int8 quantization does not cover MLA projections yet"
+        )
+    shapes = jax.eval_shape(
+        partial(llama.init_params, config), jax.random.key(seed)
+    )
+    if "dense_layers" in shapes:
+        raise ValueError(
+            "int8 quantization does not cover dense-prelude stacks yet"
+        )
+    rng = np.random.default_rng(seed)
+
+    def dense(leaf) -> np.ndarray:
+        dt = np.dtype(leaf.dtype)
+        # standard_normal only emits float32/64; cast after
+        return (
+            rng.standard_normal(leaf.shape, np.float32) * 0.02
+        ).astype(dt)
+
+    def q_and_s(leaf) -> tuple[np.ndarray, np.ndarray]:
+        q = rng.integers(
+            -127, 128, size=leaf.shape, dtype=np.int8
+        )
+        s_shape = leaf.shape[:-2] + leaf.shape[-1:]
+        s = (
+            rng.uniform(0.8, 1.2, s_shape) * (0.02 / 127.0)
+        ).astype(np.float32)
+        return q, s
+
+    out: dict = {}
+    for key, leaf in shapes.items():
+        if key == "layers":
+            layers: dict = {}
+            for name, lf in leaf.items():
+                if name in LAYER_TARGETS:
+                    layers[name + "_q"], layers[name + "_s"] = q_and_s(lf)
+                else:
+                    layers[name] = dense(lf)
+            out["layers"] = layers
+        elif key == "lm_head":
+            out["lm_head_q"], out["lm_head_s"] = q_and_s(leaf)
+        else:
+            # embedding / norms / nested aux trees pass through dense
+            out[key] = jax.tree_util.tree_map(dense, leaf)
+    return out

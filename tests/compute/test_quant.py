@@ -128,6 +128,43 @@ class TestQuantizedForward:
             quantize_tree(params, config)
 
 
+class TestRandomQuantizedParams:
+    """The numpy fast path must mirror the real init→quantize tree
+    exactly — any layout drift must fail here, not at device_put."""
+
+    def _assert_same_tree(self, config):
+        from dstack_tpu.models.quant import random_quantized_params
+
+        real = quantize_tree(
+            llama.init_params(config, jax.random.key(0)), config
+        )
+        fast = random_quantized_params(config)
+        rl = jax.tree_util.tree_leaves_with_path(real)
+        fl = jax.tree_util.tree_leaves_with_path(fast)
+        assert [p for p, _ in rl] == [p for p, _ in fl]
+        for (path, a), (_, b) in zip(rl, fl):
+            assert a.shape == b.shape, path
+            assert jnp.asarray(a).dtype == jnp.asarray(b).dtype, path
+
+    def test_matches_quantize_tree_structure(self):
+        self._assert_same_tree(llama.LLAMA_TINY)
+
+    def test_untied_head_and_forward_runs(self):
+        from dstack_tpu.models.quant import random_quantized_params
+
+        config = llama.dataclasses.replace(
+            llama.LLAMA_TINY, tie_embeddings=False
+        )
+        self._assert_same_tree(config)
+        qparams = jax.device_put(random_quantized_params(config))
+        assert is_quantized(qparams)
+        tokens = jax.random.randint(
+            jax.random.key(1), (1, 8), 0, config.vocab_size
+        )
+        logits = llama.forward(qparams, tokens, config)
+        assert np.isfinite(np.asarray(logits)).all()
+
+
 class TestQuantizedServing:
     def test_engine_greedy_decode(self):
         from dstack_tpu.serve.engine import GenParams, InferenceEngine
