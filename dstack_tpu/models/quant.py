@@ -136,9 +136,11 @@ def random_quantized_params(config: LlamaConfig, seed: int = 0) -> dict:
     weight-value-independent, so the bench path emits random int8
     projections (+ jittered per-channel scales, so no two channels
     dequantize identically) and random-normal bf16 for everything
-    else. Structure comes from ``jax.eval_shape`` over the real
-    ``init_params``/``quantize_tree`` pair, so any tree-layout change
-    shows up here as a shape mismatch, not silent drift."""
+    else. Leaf shapes come from ``jax.eval_shape`` over the real
+    ``init_params``; the quantized layout (targets, ``_q``/``_s``
+    naming, scale shapes) mirrors :func:`quantize_tree` by hand — the
+    structure-parity test in ``tests/compute/test_quant.py`` is what
+    actually pins the two together."""
     from functools import partial
 
     from dstack_tpu.models import llama

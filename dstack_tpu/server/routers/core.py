@@ -379,15 +379,18 @@ async def poll_logs(ctx: RequestContext, body: s.PollLogsRequest):
 
 
 @project_router.post("/instances/list")
-async def list_instances(ctx: RequestContext):
-    from dstack_tpu.server.services.instances import instance_row_to_model
+async def list_instances(ctx: RequestContext, body: s.ListPageRequest):
+    from dstack_tpu.server.services.instances import list_instances as _list
 
-    db = ctx.state["db"]
-    rows = await db.fetchall(
-        "SELECT * FROM instances WHERE project_id = ? AND deleted = 0",
-        (ctx.project["id"],),
+    return await _list(
+        ctx.state["db"],
+        ctx.project,
+        project_name=ctx.param("project_name"),
+        prev_created_at=body.prev_created_at,
+        prev_id=body.prev_id,
+        limit=body.limit,
+        ascending=body.ascending,
     )
-    return [instance_row_to_model(r, ctx.param("project_name")) for r in rows]
 
 
 @project_router.post("/services/list")
@@ -481,10 +484,17 @@ async def get_instance(ctx: RequestContext, body: s.GetByNameRequest):
 
 
 @project_router.post("/fleets/list")
-async def list_fleets(ctx: RequestContext):
+async def list_fleets(ctx: RequestContext, body: s.ListPageRequest):
     from dstack_tpu.server.services.fleets import list_fleets as _list
 
-    return await _list(ctx.state["db"], ctx.project)
+    return await _list(
+        ctx.state["db"],
+        ctx.project,
+        prev_created_at=body.prev_created_at,
+        prev_id=body.prev_id,
+        limit=body.limit,
+        ascending=body.ascending,
+    )
 
 
 @project_router.post("/fleets/apply")
@@ -523,10 +533,17 @@ async def delete_fleet_instances(
 
 
 @project_router.post("/volumes/list")
-async def list_volumes(ctx: RequestContext):
+async def list_volumes(ctx: RequestContext, body: s.ListPageRequest):
     from dstack_tpu.server.services.volumes import list_volumes as _list
 
-    return await _list(ctx.state["db"], ctx.project)
+    return await _list(
+        ctx.state["db"],
+        ctx.project,
+        prev_created_at=body.prev_created_at,
+        prev_id=body.prev_id,
+        limit=body.limit,
+        ascending=body.ascending,
+    )
 
 
 @project_router.post("/volumes/get")

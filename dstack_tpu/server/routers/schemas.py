@@ -95,6 +95,17 @@ class ApplyRunPlanRequest(CoreModel):
     force: bool = False
 
 
+class ListPageRequest(CoreModel):
+    """Shared keyset-pagination body for fleets/instances/volumes
+    listings (reference: server/schemas/{fleets,instances,volumes}.py
+    prev_created_at/prev_id). All-defaulted: `{}` returns everything."""
+
+    prev_created_at: Optional[str] = None
+    prev_id: Optional[str] = None
+    limit: int = 0  # 0 = unlimited
+    ascending: bool = False
+
+
 class ListRunsRequest(CoreModel):
     """Keyset pagination over runs, newest first by default — parity
     with the reference's ListRunsRequest (server/schemas/runs.py:11-16:

@@ -65,11 +65,22 @@ async def get_fleet(db: Database, project_row: dict, name: str) -> Fleet:
     return await fleet_row_to_model(db, row, project_row["name"])
 
 
-async def list_fleets(db: Database, project_row: dict) -> list[Fleet]:
-    rows = await db.fetchall(
-        "SELECT * FROM fleets WHERE project_id = ? AND deleted = 0 ORDER BY created_at DESC",
-        (project_row["id"],),
+async def list_fleets(
+    db: Database,
+    project_row: dict,
+    prev_created_at=None,
+    prev_id=None,
+    limit: int = 0,
+    ascending: bool = False,
+) -> list[Fleet]:
+    from dstack_tpu.server.services import pagination
+
+    sql, params = pagination.paginate(
+        "SELECT * FROM fleets WHERE project_id = ? AND deleted = 0",
+        [project_row["id"]], "created_at", prev_created_at, prev_id,
+        ascending, limit,
     )
+    rows = await db.fetchall(sql, params)
     return [await fleet_row_to_model(db, r, project_row["name"]) for r in rows]
 
 

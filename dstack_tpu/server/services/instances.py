@@ -51,6 +51,28 @@ def instance_row_to_model(row: dict, project_name: str = "", fleet_name: Optiona
     )
 
 
+async def list_instances(
+    db: Database,
+    project_row: dict,
+    project_name: str = "",
+    prev_created_at=None,
+    prev_id=None,
+    limit: int = 0,
+    ascending: bool = False,
+) -> list[Instance]:
+    """Keyset-paginated project listing (reference:
+    server/schemas/instances.py prev_created_at/prev_id)."""
+    from dstack_tpu.server.services import pagination
+
+    sql, params = pagination.paginate(
+        "SELECT * FROM instances WHERE project_id = ? AND deleted = 0",
+        [project_row["id"]], "created_at", prev_created_at, prev_id,
+        ascending, limit,
+    )
+    rows = await db.fetchall(sql, params)
+    return [instance_row_to_model(r, project_name) for r in rows]
+
+
 async def create_instance_row(
     db: Database,
     project_row: dict,

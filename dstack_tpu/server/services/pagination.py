@@ -1,0 +1,56 @@
+"""Shared keyset pagination for list endpoints.
+
+Reference parity: the reference pages every heavyweight list —
+fleets/instances/volumes/runs — by a ``(timestamp, id)`` cursor
+(``server/schemas/{fleets,instances,volumes}.py`` ``prev_created_at``
+/ ``prev_id``; ``schemas/runs.py`` ``prev_submitted_at`` /
+``prev_run_id``) so pages stay stable while new rows arrive.
+``limit == 0`` means unpaginated (legacy clients post ``{}``).
+"""
+
+from datetime import timezone
+
+from dstack_tpu.core.errors import ClientError
+from dstack_tpu.utils.common import parse_dt
+
+
+def paginate(
+    sql: str,
+    params: list,
+    column: str,
+    prev_ts,
+    prev_id,
+    ascending: bool,
+    limit: int,
+    field: str = "",
+) -> tuple[str, list]:
+    """Append the cursor WHERE fragment + ORDER BY/LIMIT to a raw-SQL
+    query → (sql, params). ``field`` names the REQUEST field in cursor
+    validation errors (defaults to ``prev_<column>``). The timestamp is
+    normalized to the stored representation (``now_utc().isoformat()``,
+    +00:00 offset) — clients echo the JSON-serialized "Z"-suffix form
+    back."""
+    params = list(params)
+    if prev_ts:
+        try:
+            parsed = parse_dt(prev_ts.replace("Z", "+00:00"))
+        except ValueError:
+            raise ClientError(
+                f"invalid {field or 'prev_' + column} cursor: {prev_ts!r}"
+            )
+        prev_ts = parsed.astimezone(timezone.utc).isoformat()
+        cmp = ">" if ascending else "<"
+        if prev_id:
+            sql += (
+                f" AND ({column} {cmp} ? OR ({column} = ? AND id {cmp} ?))"
+            )
+            params.extend([prev_ts, prev_ts, prev_id])
+        else:
+            sql += f" AND {column} {cmp} ?"
+            params.append(prev_ts)
+    order = "ASC" if ascending else "DESC"
+    sql += f" ORDER BY {column} {order}, id {order}"
+    if limit > 0:
+        sql += " LIMIT ?"
+        params.append(limit)
+    return sql, params

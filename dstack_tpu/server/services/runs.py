@@ -5,7 +5,6 @@ Parity: reference server/services/runs.py (``get_plan:273``,
 ``scale_run_replicas:957``).
 """
 
-from datetime import datetime, timezone
 from typing import Optional
 
 from dstack_tpu.core.errors import (
@@ -32,6 +31,7 @@ from dstack_tpu.core.models.runs import (
 )
 from dstack_tpu.server.db import Database, dumps, loads
 from dstack_tpu.server.services import backends as backends_service
+from dstack_tpu.server.services import pagination
 from dstack_tpu.server.services import jobs as jobs_service
 from dstack_tpu.server.services.jobs.configurators import get_job_specs_from_run_spec
 from dstack_tpu.server.services.offers import (
@@ -398,33 +398,10 @@ async def list_runs(
         finished = tuple(s.value for s in RunStatus.finished_statuses())
         sql += f" AND status NOT IN ({','.join('?' for _ in finished)})"
         params.extend(finished)
-    if prev_submitted_at:  # "" = no cursor, like None
-        # normalize the cursor to the stored representation
-        # (now_utc().isoformat(), +00:00 offset) — clients echo the
-        # JSON-serialized "Z"-suffix form back, which py3.10's
-        # fromisoformat rejects and any python rejects when malformed
-        try:
-            parsed = _dt(prev_submitted_at.replace("Z", "+00:00"))
-        except ValueError:
-            raise ClientError(
-                f"invalid prev_submitted_at cursor: {prev_submitted_at!r}"
-            )
-        prev_submitted_at = parsed.astimezone(timezone.utc).isoformat()
-        cmp = ">" if ascending else "<"
-        if prev_run_id is not None:
-            sql += (
-                f" AND (submitted_at {cmp} ? OR"
-                f" (submitted_at = ? AND id {cmp} ?))"
-            )
-            params.extend([prev_submitted_at, prev_submitted_at, prev_run_id])
-        else:
-            sql += f" AND submitted_at {cmp} ?"
-            params.append(prev_submitted_at)
-    order = "ASC" if ascending else "DESC"
-    sql += f" ORDER BY submitted_at {order}, id {order}"
-    if limit > 0:
-        sql += " LIMIT ?"
-        params.append(limit)
+    sql, params = pagination.paginate(
+        sql, params, "submitted_at", prev_submitted_at, prev_run_id,
+        ascending, limit, field="prev_submitted_at",
+    )
     rows = await db.fetchall(sql, params)
     return [await run_row_to_run(db, r) for r in rows]
 

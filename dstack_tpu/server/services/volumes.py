@@ -57,11 +57,22 @@ async def get_volume(db: Database, project_row: dict, name: str) -> Volume:
     )
 
 
-async def list_volumes(db: Database, project_row: dict) -> list[Volume]:
-    rows = await db.fetchall(
+async def list_volumes(
+    db: Database,
+    project_row: dict,
+    prev_created_at=None,
+    prev_id=None,
+    limit: int = 0,
+    ascending: bool = False,
+) -> list[Volume]:
+    from dstack_tpu.server.services import pagination
+
+    sql, params = pagination.paginate(
         "SELECT * FROM volumes WHERE project_id = ? AND deleted = 0",
-        (project_row["id"],),
+        [project_row["id"]], "created_at", prev_created_at, prev_id,
+        ascending, limit,
     )
+    rows = await db.fetchall(sql, params)
     out = []
     for row in rows:
         atts = await db.fetchall(

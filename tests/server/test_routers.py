@@ -241,6 +241,52 @@ class TestRunsAPI:
         finally:
             await client.close()
 
+    async def test_fleet_volume_instance_lists_paginate(self):
+        """fleets/instances/volumes share the (created_at, id) keyset
+        cursor (reference schemas/{fleets,instances,volumes}.py)."""
+        client, token = await _client()
+        try:
+            for i in range(3):
+                r = await client.post(
+                    "/api/project/main/fleets/apply",
+                    headers=_auth(token),
+                    json={"configuration": {
+                        "type": "fleet", "name": f"pfleet-{i}", "nodes": 1,
+                    }},
+                )
+                assert r.status == 200
+            r = await client.post(
+                "/api/project/main/fleets/list",
+                headers=_auth(token), json={"limit": 2},
+            )
+            page = await r.json()
+            assert len(page) == 2
+            r = await client.post(
+                "/api/project/main/fleets/list",
+                headers=_auth(token),
+                json={"limit": 2,
+                      "prev_created_at": page[-1]["created_at"],
+                      "prev_id": page[-1]["id"]},
+            )
+            rest = await r.json()
+            assert len(rest) == 1
+            names = {f["name"] for f in page} | {f["name"] for f in rest}
+            assert names == {"pfleet-0", "pfleet-1", "pfleet-2"}
+            # legacy empty body unchanged; instances/volumes accept the
+            # same page body (empty DBs: shape check only)
+            for ep in ("fleets", "instances", "volumes"):
+                r = await client.post(
+                    f"/api/project/main/{ep}/list",
+                    headers=_auth(token), json={"limit": 1},
+                )
+                assert r.status == 200
+                r = await client.post(
+                    f"/api/project/main/{ep}/list", headers=_auth(token)
+                )
+                assert r.status == 200
+        finally:
+            await client.close()
+
 
 class TestSecretsAPI:
     async def test_secret_roundtrip(self):
