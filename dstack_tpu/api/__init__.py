@@ -163,8 +163,12 @@ class RunCollection:
 
         return attach_sync(self.get(run_name))
 
-    def list(self) -> list[Run]:
-        return self._c.api.list_runs(self._c.project)
+    def list(
+        self, only_active: bool = False, limit: int = 0
+    ) -> list[Run]:
+        return self._c.api.list_runs(
+            self._c.project, only_active=only_active, limit=limit
+        )
 
     def get(self, run_name: str) -> Run:
         return self._c.api.get_run(self._c.project, run_name)

@@ -110,9 +110,37 @@ class APIClient:
             )
         )
 
-    def list_runs(self, project: str) -> list[Run]:
+    def list_runs(
+        self,
+        project: str,
+        only_active: bool = False,
+        limit: int = 0,
+        prev_submitted_at=None,
+        prev_run_id=None,
+        ascending: bool = False,
+    ) -> list[Run]:
+        """Keyset paging: pass the last row's (submitted_at, id) pair
+        as (prev_submitted_at, prev_run_id). An id without a timestamp
+        cannot seed the cursor — the server orders by (submitted_at,
+        id) — so that call is refused rather than silently re-serving
+        page 1."""
+        if prev_run_id and not prev_submitted_at:
+            raise ValueError(
+                "prev_run_id requires prev_submitted_at (keyset cursor "
+                "is the (submitted_at, id) pair)"
+            )
+        body = {
+            "only_active": only_active,
+            "limit": limit,
+            "ascending": ascending,
+        }
+        if prev_submitted_at:
+            body["prev_submitted_at"] = str(prev_submitted_at)
+            if prev_run_id:
+                body["prev_run_id"] = prev_run_id
         return [
-            Run.model_validate(r) for r in self._post(f"/api/project/{project}/runs/list")
+            Run.model_validate(r)
+            for r in self._post(f"/api/project/{project}/runs/list", body)
         ]
 
     def get_run(self, project: str, run_name: str) -> Run:

@@ -234,10 +234,16 @@ def _stream_run(client, run_name: str) -> None:
 @cli.command()
 @click.option("--project", default=None)
 @click.option("-a", "--all", "show_all", is_flag=True, help="include finished runs")
-def ps(project, show_all) -> None:
+@click.option(
+    "-n", "--last", "last", type=int, default=0, show_default=True,
+    help="only the N most recent runs (0 = all; server-side keyset page)",
+)
+def ps(project, show_all, last) -> None:
     """List runs."""
     client = _client(project)
-    runs = client.runs.list()
+    # without -a the server filters to active runs, so -n N returns N
+    # ACTIVE runs rather than N rows that might all be finished
+    runs = client.runs.list(only_active=not show_all, limit=last)
     t = Table()
     for col in (
         "NAME", "BACKEND", "RESOURCES", "PRICE", "COST", "STATUS", "SUBMITTED"

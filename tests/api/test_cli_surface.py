@@ -28,6 +28,28 @@ def test_version():
     assert r.exit_code == 0 and "dtpu" in r.output
 
 
+def test_ps_last_option():
+    """`dtpu ps -n N` pages server-side: the limit and the
+    active-only flag must reach RunCollection.list, not be applied
+    client-side after fetching everything."""
+    from unittest import mock
+
+    r = CliRunner().invoke(cli, ["ps", "--help"])
+    assert r.exit_code == 0
+    assert "--last" in r.output
+
+    client = mock.MagicMock()
+    client.runs.list.return_value = []
+    with mock.patch("dstack_tpu.cli.main._client", return_value=client):
+        r = CliRunner().invoke(cli, ["ps", "-n", "7"])
+        assert r.exit_code == 0, r.output
+        client.runs.list.assert_called_once_with(only_active=True, limit=7)
+        client.reset_mock()
+        r = CliRunner().invoke(cli, ["ps", "-a"])
+        assert r.exit_code == 0, r.output
+        client.runs.list.assert_called_once_with(only_active=False, limit=0)
+
+
 def test_logs_job_option():
     """Multi-node runs: `dtpu logs --job N` selects the node's stream
     (the per-job analog of the console's log selector)."""
