@@ -208,12 +208,17 @@ def _bench(quick: bool = False) -> dict:
         if on_tpu:
             # batch 16 + turbo 128 measured best on v5e through the
             # tunneled driver (batch 32/64 regress: the masked
-            # full-cache attention read grows linearly with slots)
+            # full-cache attention read grows linearly with slots);
+            # turbo_depth chains macro-steps per host round trip —
+            # overridable while the latency matrix settles its default
             serve_model = "llama-3.2-1b"
             serve = serve_bench(
                 model=serve_model, batch=16, max_seq=1024,
                 prompt_len=256, gen_len=64 if quick else 128,
                 turbo_steps=128,
+                turbo_depth=int(
+                    os.environ.get("DTPU_BENCH_TURBO_DEPTH", "1")
+                ),
             )
         else:
             serve_model = "llama-tiny"

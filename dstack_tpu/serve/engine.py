@@ -1431,6 +1431,15 @@ class InferenceEngine:
         # (token, position, budget, active) state feeds the next
         # segment directly, so chaining never syncs mid-flight.
         self.turbo_depth = max(1, turbo_depth)
+        # decode-state device residency: decode_loop returns the
+        # post-chain (token, position, budget, active) arrays, and the
+        # host replay applies the SAME transition rules — so the
+        # returned arrays stay valid as next macro-step inputs until a
+        # host-side mutation (admission, release, sampled/speculative
+        # step) touches slot state. Caching them drops the five small
+        # host→device uploads every macro-step otherwise pays — on a
+        # remote device those transfers, not compute, bound decode.
+        self._turbo_state = None  # (tok, pos, rem, act, eos) on device
 
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
@@ -1654,6 +1663,7 @@ class InferenceEngine:
                 list(zip(map(int, tids[0]), map(float, tlps[0]))),
             )
         self.active[slot] = True
+        self._turbo_state = None  # host slot state changed
         if self.prefix_cache:
             # the slot's rows now hold this fully-prefilled prompt;
             # they stay reusable until the slot is reassigned
@@ -1738,6 +1748,7 @@ class InferenceEngine:
 
     def _spec_step(self, live: list, drafts: dict) -> dict:
         """One verify_step call emits 1..spec_draft+1 tokens per slot."""
+        self._turbo_state = None  # advancing outside the turbo replay
         sdraft = self.spec_draft + 1
         rows = []
         for i in range(self.max_batch):
@@ -1844,15 +1855,18 @@ class InferenceEngine:
             and not self._arrival_busy()
         ):
             depth = min(self.turbo_depth, -(-budget // steps))
-        eos = [
-            self.eos[i] if self.eos[i] is not None else -1
-            for i in range(self.max_batch)
-        ]
-        tok_d = jnp.asarray(self.last_token, jnp.int32)
-        pos_d = jnp.asarray(self.lengths, jnp.int32)
-        rem_d = jnp.asarray(self.remaining, jnp.int32)
-        act_d = jnp.asarray(self.active, bool)
-        eos_d = jnp.asarray(eos, jnp.int32)
+        if self._turbo_state is not None:
+            tok_d, pos_d, rem_d, act_d, eos_d = self._turbo_state
+        else:
+            eos = [
+                self.eos[i] if self.eos[i] is not None else -1
+                for i in range(self.max_batch)
+            ]
+            tok_d = jnp.asarray(self.last_token, jnp.int32)
+            pos_d = jnp.asarray(self.lengths, jnp.int32)
+            rem_d = jnp.asarray(self.remaining, jnp.int32)
+            act_d = jnp.asarray(self.active, bool)
+            eos_d = jnp.asarray(eos, jnp.int32)
         segs = []
         for _ in range(depth):
             toks_dev, self.cache, tok_d, pos_d, rem_d, act_d = (
@@ -1862,6 +1876,7 @@ class InferenceEngine:
                 )
             )
             segs.append(toks_dev)
+        self._turbo_state = (tok_d, pos_d, rem_d, act_d, eos_d)
         # ONE blocking fetch for every in-flight segment ([depth*steps, B])
         toks = np.concatenate(jax.device_get(segs), axis=0)
         out: dict = {}
@@ -1957,6 +1972,7 @@ class InferenceEngine:
 
     def _emit(self, live: list, sampled) -> dict[int, int]:
         """Publish one sampled token per live slot (host bookkeeping)."""
+        self._turbo_state = None  # advancing outside the turbo replay
         out: dict[int, int] = {}
         for i in live:
             tok = int(sampled[i])
@@ -1976,6 +1992,7 @@ class InferenceEngine:
 
     def release(self, slot: int) -> None:
         self.active[slot] = False
+        self._turbo_state = None  # host slot state changed
         self._prefilling.pop(slot, None)
         self._last_logprobs.pop(slot, None)
 

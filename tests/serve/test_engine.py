@@ -477,6 +477,34 @@ class TestTurboDecode:
         assert eng.finish_reason[slot] == "stop"
         assert eng.lengths[slot] == len(prompt) + 3
 
+    def test_device_state_cache_slot_reuse(self):
+        # the cached device-side decode state must invalidate on
+        # release + re-admission (slot reuse), not leak stale budgets
+        eng = self._engine(4, turbo_depth=2, turbo_quiet_s=0.0, max_seq=128)
+        off = self._engine(0)
+        for prompt in ([5, 99, 321], [7, 8, 9, 10]):
+            g = lambda: GenParams(max_new_tokens=9)  # noqa: E731
+            assert eng.generate(prompt, g()) == off.generate(prompt, g())
+
+    def test_device_state_cache_staggered_admission(self):
+        # a turbo chain caches device state; a new admission mid-run
+        # must invalidate it so the fresh slot's budget/eos are seen
+        eng = self._engine(4, turbo_depth=2, turbo_quiet_s=0.0, max_seq=128)
+        p1, p2 = [10, 20, 30], [400, 3, 77, 9]
+        ref1 = _reference_greedy(self.params, self.config, p1, 12)
+        ref2 = _reference_greedy(self.params, self.config, p2, 8)
+        s1, t1 = eng.add_request(p1, GenParams(max_new_tokens=12))
+        got1, got2 = [t1], []
+        got1.extend(eng.step().get(s1, []))  # chain runs, state cached
+        s2, t2 = eng.add_request(p2, GenParams(max_new_tokens=8))
+        got2.append(t2)
+        while eng.active[s1] or eng.active[s2]:
+            out = eng.step()
+            got1.extend(out.get(s1, []))
+            got2.extend(out.get(s2, []))
+        assert got1 == ref1
+        assert got2 == ref2
+
     def test_sampled_batch_bypasses_turbo(self):
         eng = self._engine(8, max_batch=1, max_seq=128)
         slot, _ = eng.add_request(

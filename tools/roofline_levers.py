@@ -60,7 +60,13 @@ def main() -> int:
 
     force_cpu = not _tpu_reachable(timeout=90.0)
     if force_cpu:
-        print(json.dumps({"note": "TPU unreachable; cpu smoke numbers only"}))
+        # structured flag: the capture layer marks the phase as NOT
+        # captured (cpu smoke is not TPU evidence) and the watcher
+        # retries it next window
+        print(json.dumps({
+            "note": "TPU unreachable; cpu smoke numbers only",
+            "fallback": True, "platform": "cpu",
+        }))
     for name, spec in VARIANTS:
         spec = {**spec, "_force_cpu": force_cpu}
         t0 = time.time()
@@ -81,6 +87,8 @@ def main() -> int:
             continue
         out = json.loads(lines[-1])
         out["variant"] = name
+        if force_cpu:
+            out["platform"] = "cpu"
         out["wall_s"] = round(time.time() - t0, 1)
         for k in ("mfu", "step_time_s", "tokens_per_sec"):
             if k in out:

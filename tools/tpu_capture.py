@@ -10,6 +10,7 @@ result to ``BENCH_TPU_r05_evidence.json``:
    weight-value-independent)
 3. the serving latency-under-load curve (concurrency × turbo cells)
 4. the flash-attention block sweep (tools/mfu_sweep.py)
+5. the roofline lever sweep (int8 Adam / batch / grad-accum variants)
 
 Each phase is independently fault-isolated (subprocess + timeout): a
 tunnel drop mid-phase records the failure note and moves on, so a
@@ -80,12 +81,30 @@ def _run(phase: str, cmd: list, timeout: int) -> None:
             results.append(json.loads(ln))
         except ValueError:
             pass
-    _append({
+    entry = {
         "phase": phase,
         "captured": _now(),
         "wall_s": round(time.time() - t0, 1),
         "results": results,
-    })
+    }
+    # a tool that smoke-falls-back to CPU exits 0 — that is NOT
+    # captured TPU evidence; mark it so the window-watcher retries the
+    # phase instead of counting it done. Structured flags first
+    # (platform/fallback emitted by the tools), then a case-insensitive
+    # note check as the belt for tools predating the flags.
+    structured = any(
+        r.get("fallback") is True or r.get("platform") == "cpu"
+        or (isinstance(r.get("metric"), str) and ",cpu]" in r["metric"])
+        for r in results
+    )
+    noted = any(
+        "tpu unreachable" in str(r.get("note", "")).lower()
+        or "cpu fallback" in str(r.get("note", "")).lower()
+        for r in results
+    )
+    if structured or noted:
+        entry["error"] = "cpu fallback (tunnel down mid-window)"
+    _append(entry)
 
 
 def main() -> int:
@@ -111,7 +130,7 @@ def main() -> int:
               "--kv-quant", "int8", "--batch", "8",
               "--max-seq", "2048", "--prompt-len", "512",
               "--gen-len", "64" if args.quick else "128",
-              "--turbo-steps", "32"],
+              "--turbo-steps", "32", "--turbo-depth", "4"],
              timeout=3000)
     if 3 in phases:
         _run("latency_under_load",
