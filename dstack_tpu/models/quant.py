@@ -141,21 +141,7 @@ def random_quantized_params(config: LlamaConfig, seed: int = 0) -> dict:
     naming, scale shapes) mirrors :func:`quantize_tree` by hand — the
     structure-parity test in ``tests/compute/test_quant.py`` is what
     actually pins the two together."""
-    from functools import partial
-
-    from dstack_tpu.models import llama
-
-    if config.mla:
-        raise ValueError(
-            "int8 quantization does not cover MLA projections yet"
-        )
-    shapes = jax.eval_shape(
-        partial(llama.init_params, config), jax.random.key(seed)
-    )
-    if "dense_layers" in shapes:
-        raise ValueError(
-            "int8 quantization does not cover dense-prelude stacks yet"
-        )
+    shapes = _random_tree_shapes(config, seed)
     rng = np.random.default_rng(seed)
 
     def dense(leaf) -> np.ndarray:
@@ -175,6 +161,37 @@ def random_quantized_params(config: LlamaConfig, seed: int = 0) -> dict:
         ).astype(np.float32)
         return q, s
 
+    return _assemble_random_tree(shapes, dense, q_and_s)
+
+
+def _random_tree_shapes(config: LlamaConfig, seed: int) -> dict:
+    """Shared prologue for the random-tree generators: the
+    unsupported-config guards and the ``eval_shape`` over the real
+    ``init_params`` — one copy, so a new precondition cannot drift
+    between the host and on-device paths."""
+    from functools import partial
+
+    from dstack_tpu.models import llama
+
+    if config.mla:
+        raise ValueError(
+            "int8 quantization does not cover MLA projections yet"
+        )
+    shapes = jax.eval_shape(
+        partial(llama.init_params, config), jax.random.key(seed)
+    )
+    if "dense_layers" in shapes:
+        raise ValueError(
+            "int8 quantization does not cover dense-prelude stacks yet"
+        )
+    return shapes
+
+
+def _assemble_random_tree(shapes: dict, dense, q_and_s) -> dict:
+    """Walk ``init_params``' shape tree into the quantized layout,
+    generating each leaf through the supplied callbacks (numpy host
+    path or jitted device path — same structure either way, which is
+    what the parity test in tests/compute/test_quant.py pins)."""
     out: dict = {}
     for key, leaf in shapes.items():
         if key == "layers":
@@ -191,3 +208,53 @@ def random_quantized_params(config: LlamaConfig, seed: int = 0) -> dict:
             # embedding / norms / nested aux trees pass through dense
             out[key] = jax.tree_util.tree_map(dense, leaf)
     return out
+
+
+def random_quantized_params_on_device(
+    config: LlamaConfig, seed: int = 0
+) -> dict:
+    """Benchmark-only: :func:`random_quantized_params`, but every leaf
+    is generated ON the accelerator by a small jitted PRNG program.
+
+    Through a tunneled driver host the numpy tree's ``device_put`` is
+    the killer — ~8 GB of int8 weights streamed host→device blew the
+    8B serving capture twice (timeout, then UNAVAILABLE mid-transfer).
+    Here only compiled programs and 16-byte keys cross the link; the
+    threefry runs at chip speed. Same tree structure and value
+    distributions as the numpy path."""
+    from functools import partial
+
+    shapes = _random_tree_shapes(config, seed)
+    root = jax.random.key(seed)
+    leaf_no = iter(range(1 << 30))
+
+    @partial(jax.jit, static_argnums=(1, 2))
+    def _dense(k, shape, dtype):
+        return (
+            jax.random.normal(k, shape, jnp.float32) * 0.02
+        ).astype(dtype)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def _q(k, shape):
+        return jax.random.randint(k, shape, -127, 128, dtype=jnp.int8)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def _s(k, shape):
+        return jax.random.uniform(
+            k, shape, jnp.float32, 0.8, 1.2
+        ) * (0.02 / 127.0)
+
+    def _key():
+        return jax.random.fold_in(root, next(leaf_no))
+
+    def dense(leaf):
+        return _dense(_key(), tuple(leaf.shape), np.dtype(leaf.dtype))
+
+    def q_and_s(leaf):
+        s_shape = tuple(leaf.shape[:-2] + leaf.shape[-1:])
+        return (
+            _q(_key(), tuple(leaf.shape)),
+            _s(_key(), s_shape),
+        )
+
+    return _assemble_random_tree(shapes, dense, q_and_s)

@@ -38,15 +38,23 @@ def run_bench(
 
     config = llama.CONFIGS[model]
     if quantize == "int8":
-        # int8 tree built host-side, straight in numpy: the accelerator
-        # only ever sees the quantized tree (a bf16 8B tree cannot
-        # coexist with its int8 copy inside a v5e's 16 GiB HBM), and
-        # skipping the full-precision materialization keeps 8B init to
-        # minutes instead of an hour on a 1-vCPU driver host (real
-        # checkpoints quantize host-side in convert_hf the same way)
-        from dstack_tpu.models.quant import random_quantized_params
+        # the accelerator only ever sees the quantized tree (a bf16 8B
+        # tree cannot coexist with its int8 copy inside a v5e's 16 GiB
+        # HBM). On an accelerator every leaf is generated device-side
+        # by jitted PRNG — streaming the ~8 GB numpy tree through a
+        # tunneled driver link repeatedly blew the capture window. The
+        # numpy host path stays for CPU smoke runs (no transfer there,
+        # and it dodges per-leaf compiles).
+        if jax.default_backend() == "cpu":
+            from dstack_tpu.models.quant import random_quantized_params
 
-        params = jax.device_put(random_quantized_params(config))
+            params = jax.device_put(random_quantized_params(config))
+        else:
+            from dstack_tpu.models.quant import (
+                random_quantized_params_on_device,
+            )
+
+            params = random_quantized_params_on_device(config)
     else:
         params = llama.init_params(config, jax.random.key(0))
     eng = InferenceEngine(

@@ -1663,7 +1663,7 @@ class InferenceEngine:
                 list(zip(map(int, tids[0]), map(float, tlps[0]))),
             )
         self.active[slot] = True
-        self._turbo_state = None  # host slot state changed
+        self._invalidate_decode_cache()  # activation mutated slot state
         if self.prefix_cache:
             # the slot's rows now hold this fully-prefilled prompt;
             # they stay reusable until the slot is reassigned
@@ -1748,7 +1748,7 @@ class InferenceEngine:
 
     def _spec_step(self, live: list, drafts: dict) -> dict:
         """One verify_step call emits 1..spec_draft+1 tokens per slot."""
-        self._turbo_state = None  # advancing outside the turbo replay
+        self._invalidate_decode_cache()  # advancing outside the turbo replay
         sdraft = self.spec_draft + 1
         rows = []
         for i in range(self.max_batch):
@@ -1826,6 +1826,16 @@ class InferenceEngine:
                 donate_argnums=(1,),
             )
         return self._turbo_fns[steps]
+
+    def _invalidate_decode_cache(self) -> None:
+        """EVERY host-side slot-state mutation — activation, release,
+        sampled/speculative advance, any future cancel/abort or budget
+        edit touching ``active``/``lengths``/``remaining``/``last_token``
+        — must call this. ``_turbo_step`` trusts the cached device
+        arrays otherwise and would silently decode from stale state
+        (wrong tokens, no error). The slot-reuse and staggered-admission
+        parity tests in tests/serve/test_engine.py pin the contract."""
+        self._turbo_state = None
 
     def _turbo_step(self, live: list) -> dict:
         """One decode_loop macro-step → {slot: [tokens]}. The host
@@ -1972,7 +1982,7 @@ class InferenceEngine:
 
     def _emit(self, live: list, sampled) -> dict[int, int]:
         """Publish one sampled token per live slot (host bookkeeping)."""
-        self._turbo_state = None  # advancing outside the turbo replay
+        self._invalidate_decode_cache()  # advancing outside the turbo replay
         out: dict[int, int] = {}
         for i in live:
             tok = int(sampled[i])
@@ -1992,7 +2002,7 @@ class InferenceEngine:
 
     def release(self, slot: int) -> None:
         self.active[slot] = False
-        self._turbo_state = None  # host slot state changed
+        self._invalidate_decode_cache()
         self._prefilling.pop(slot, None)
         self._last_logprobs.pop(slot, None)
 

@@ -775,9 +775,11 @@ async def _check_job_policies(
         )
         # require coverage of most of the window before judging
         if points and len(points) >= 3:
-            from datetime import datetime as _dt
+            from dstack_tpu.utils.common import parse_dt
 
-            first = _dt.fromisoformat(points[0]["timestamp"])
+            # parse_dt: naive rows (older collectors) are UTC — raw
+            # fromisoformat would crash the aware-minus-naive subtraction
+            first = parse_dt(points[0]["timestamp"])
             covered = (now_utc() - first).total_seconds()
             if covered >= int(policy.time_window) * 0.9:
                 below = True

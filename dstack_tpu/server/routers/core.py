@@ -811,13 +811,15 @@ async def get_job_metrics(ctx: RequestContext, body: s.GetJobMetricsRequest):
         (job_row["id"], body.limit),
     )
     points.reverse()
-    from datetime import datetime
+    # parse_dt: naive rows (older collectors, seeded fixtures) are UTC —
+    # one job's mixed naive/aware points must still subtract cleanly
+    from dstack_tpu.utils.common import parse_dt
 
     def series(name, key, transform=lambda v, prev, dt: v):
         ts, vals = [], []
         prev = None
         for p in points:
-            t = datetime.fromisoformat(p["timestamp"])
+            t = parse_dt(p["timestamp"])
             v = p[key]
             if prev is not None:
                 dt = (t - prev[0]).total_seconds()
@@ -837,7 +839,7 @@ async def get_job_metrics(ctx: RequestContext, body: s.GetJobMetricsRequest):
     # TPU series: one per chip
     tpu_series: dict[str, Metric] = {}
     for p in points:
-        t = datetime.fromisoformat(p["timestamp"])
+        t = parse_dt(p["timestamp"])
         tm = loads(p.get("tpu_metrics")) or {}
         for i, duty in enumerate(tm.get("duty_cycle") or []):
             m = tpu_series.setdefault(

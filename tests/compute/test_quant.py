@@ -149,6 +149,32 @@ class TestRandomQuantizedParams:
     def test_matches_quantize_tree_structure(self):
         self._assert_same_tree(llama.LLAMA_TINY)
 
+    def test_on_device_path_matches_numpy_path(self):
+        """The jitted on-device generator (what the TPU serving bench
+        uses — nothing bulk crosses a tunneled link) must emit the
+        exact structure/shapes/dtypes of the numpy host path, and its
+        tree must drive a forward pass."""
+        from dstack_tpu.models.quant import (
+            random_quantized_params,
+            random_quantized_params_on_device,
+        )
+
+        config = llama.LLAMA_TINY
+        host = random_quantized_params(config)
+        dev = random_quantized_params_on_device(config)
+        hl = jax.tree_util.tree_leaves_with_path(host)
+        dl = jax.tree_util.tree_leaves_with_path(dev)
+        assert [p for p, _ in hl] == [p for p, _ in dl]
+        for (path, a), (_, b) in zip(hl, dl):
+            assert a.shape == b.shape, path
+            assert jnp.asarray(a).dtype == jnp.asarray(b).dtype, path
+        assert is_quantized(dev)
+        tokens = jax.random.randint(
+            jax.random.key(1), (1, 8), 0, config.vocab_size
+        )
+        logits = llama.forward(dev, tokens, config)
+        assert np.isfinite(np.asarray(logits)).all()
+
     def test_untied_head_and_forward_runs(self):
         from dstack_tpu.models.quant import random_quantized_params
 

@@ -745,11 +745,21 @@ class TestConsoleDetailPages:
         try:
             db = app["state"]["db"]
             job = await db.fetchone("SELECT * FROM jobs LIMIT 1")
+            # the real run may have left collector points (timing-
+            # dependent); clear them so the seeded series are exact
+            await db.execute(
+                "DELETE FROM job_metrics_points WHERE job_id = ?",
+                (job["id"],),
+            )
             for i in range(4):
+                # last point tz-aware, rest naive: the endpoint must
+                # normalize (mixed collector generations crashed the
+                # cpu derivative with naive-vs-aware subtraction)
+                tz = "+00:00" if i == 3 else ""
                 await db.insert("job_metrics_points", {
                     "id": f"mp-{i}",
                     "job_id": job["id"],
-                    "timestamp": f"2026-07-31T00:00:{10 + i:02d}",
+                    "timestamp": f"2026-07-31T00:00:{10 + i:02d}{tz}",
                     "cpu_usage_micro": 1_000_000 * i,  # 100% of one core
                     "memory_usage_bytes": (i + 1) * 1024**3,
                     "memory_working_set_bytes": (i + 1) * 1024**3,

@@ -1,0 +1,73 @@
+"""Capture-evidence hygiene: a tool that smoke-falls-back to CPU must
+never be recorded as TPU evidence, and drop-class failures (timeouts,
+CPU fallbacks) must not permanently abandon a phase in the watcher.
+
+These pins exist because rounds 2-4 each lost a capture window to one
+of these classification gaps (VERDICT r4 item 1 / weak #1).
+"""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+TOOLS = Path(__file__).resolve().parents[2] / "tools"
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(name, TOOLS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault(name, mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+tpu_capture = _load("tpu_capture")
+tpu_watcher = _load("tpu_watcher")
+
+
+class TestCpuFallbackDetector:
+    def test_structured_flags(self):
+        assert tpu_capture.cpu_fallback([{"fallback": True}])
+        assert tpu_capture.cpu_fallback([{"platform": "cpu"}])
+        # latency_bench marks cells with a top-level backend field
+        assert tpu_capture.cpu_fallback([{"backend": "cpu"}])
+        # serve bench nests its backend under extra
+        assert tpu_capture.cpu_fallback([{"extra": {"backend": "cpu"}}])
+        # bench.py encodes the platform in the metric name
+        assert tpu_capture.cpu_fallback(
+            [{"metric": "train_tokens_per_sec_per_chip[llama,bf16,cpu]"}]
+        )
+
+    def test_note_belt(self):
+        assert tpu_capture.cpu_fallback(
+            [{"note": "TPU unreachable; cpu smoke numbers only"}]
+        )
+
+    def test_tpu_results_pass(self):
+        assert not tpu_capture.cpu_fallback([
+            {"metric": "train_tokens_per_sec_per_chip[llama,bf16,tpu]",
+             "extra": {"backend": "tpu"}},
+            {"backend": "tpu", "platform": "tpu"},
+        ])
+        assert not tpu_capture.cpu_fallback([])
+
+
+class TestWatcherDropClass:
+    def test_drop_class_errors_are_lenient(self):
+        # every tunnel-drop signature observed in a real capture window
+        # goes to the MAX_TIMEOUTS bucket, not the strict attempts cap
+        assert tpu_watcher.drop_class("timeout 3000s")
+        assert tpu_watcher.drop_class("cpu fallback (tunnel down mid-window)")
+        # JAX init failure mid-window (latency_under_load, r5 evidence)
+        assert tpu_watcher.drop_class(
+            "RuntimeError: Unable to initialize backend 'axon': "
+            "UNAVAILABLE: TPU backend setup/compile error (Unavailable)."
+        )
+        # a tool's own unreachable self-report (mfu_sweep, r5 evidence)
+        assert tpu_watcher.drop_class(
+            '{"error": "TPU unreachable (tunnel down)"}'
+        )
+
+    def test_real_failures_count_attempts(self):
+        assert not tpu_watcher.drop_class("Traceback (most recent call last)")
+        assert not tpu_watcher.drop_class("AssertionError: bad shape")

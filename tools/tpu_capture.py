@@ -87,13 +87,24 @@ def _run(phase: str, cmd: list, timeout: int) -> None:
         "wall_s": round(time.time() - t0, 1),
         "results": results,
     }
-    # a tool that smoke-falls-back to CPU exits 0 — that is NOT
-    # captured TPU evidence; mark it so the window-watcher retries the
-    # phase instead of counting it done. Structured flags first
-    # (platform/fallback emitted by the tools), then a case-insensitive
-    # note check as the belt for tools predating the flags.
+    if cpu_fallback(results):
+        entry["error"] = "cpu fallback (tunnel down mid-window)"
+    _append(entry)
+
+
+def cpu_fallback(results: list) -> bool:
+    """True when a tool smoke-fell-back to CPU and exited 0 — that is
+    NOT captured TPU evidence; the entry gets marked so the
+    window-watcher retries the phase instead of counting it done.
+    Structured flags first (fallback/platform/backend emitted by the
+    tools — serve bench nests its backend under ``extra``), then a
+    case-insensitive note check as the belt for tools predating the
+    flags."""
     structured = any(
         r.get("fallback") is True or r.get("platform") == "cpu"
+        or r.get("backend") == "cpu"
+        or (isinstance(r.get("extra"), dict)
+            and r["extra"].get("backend") == "cpu")
         or (isinstance(r.get("metric"), str) and ",cpu]" in r["metric"])
         for r in results
     )
@@ -102,9 +113,7 @@ def _run(phase: str, cmd: list, timeout: int) -> None:
         or "cpu fallback" in str(r.get("note", "")).lower()
         for r in results
     )
-    if structured or noted:
-        entry["error"] = "cpu fallback (tunnel down mid-window)"
-    _append(entry)
+    return structured or noted
 
 
 def main() -> int:
