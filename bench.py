@@ -22,20 +22,12 @@ import time
 
 
 def _tpu_reachable(timeout: float = 120.0) -> bool:
-    """Probe TPU backend init in a SUBPROCESS: a broken axon tunnel can
-    hang device enumeration forever (observed during tunnel outages),
-    which would turn the whole bench into a timeout instead of a
-    result. The probe hangs → kill it → fall back to CPU with an
-    honest note."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            timeout=timeout, capture_output=True, text=True,
-        )
-        return proc.returncode == 0 and "ok" in proc.stdout
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    """Shared subprocess probe (a broken axon tunnel HANGS device
+    enumeration; the probe hangs → kill it → fall back to CPU with an
+    honest note). One implementation: utils/tpu_probe."""
+    from dstack_tpu.utils.tpu_probe import tpu_reachable
+
+    return tpu_reachable(timeout=timeout)
 
 
 def _wait_for_tpu(budget_s: float, probe_timeout: float = 120.0) -> dict:

@@ -343,6 +343,11 @@ LLAMA_TINY = LlamaConfig(  # for tests / virtual meshes
     head_dim=32, intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
     remat=False,
 )
+LLAMA_TINY_64 = LlamaConfig(  # head_dim-64 tiny: pallas-kernel-eligible
+    vocab_size=512, hidden_size=128, n_layers=2, n_heads=2, n_kv_heads=1,
+    head_dim=64, intermediate_size=256, max_seq_len=256, dtype=jnp.float32,
+    remat=False,
+)
 MIXTRAL_8X7B = LlamaConfig(
     vocab_size=32000, hidden_size=4096, n_layers=32, n_heads=32, n_kv_heads=8,
     intermediate_size=14336, rope_theta=1e6, n_experts=8, experts_per_token=2,
@@ -515,6 +520,7 @@ CONFIGS = {
     "llama-3.2-1b": LLAMA_32_1B,
     "llama-3.2-3b": LLAMA_32_3B,
     "llama-tiny": LLAMA_TINY,
+    "llama-tiny-64": LLAMA_TINY_64,
     "mixtral-8x7b": MIXTRAL_8X7B,
     "moe-tiny": MOE_TINY,
     "qwen-2.5-7b": QWEN25_7B,

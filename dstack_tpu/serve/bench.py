@@ -27,6 +27,7 @@ def run_bench(
     turbo_depth: int = 1,
     kv_quant=None,
     prefill_chunk: int = 256,
+    decode_kernel=None,  # None/"einsum" | "flash" (ragged pallas read)
 ) -> dict:
     """Measure the engine directly → result dict (importable core;
     the root ``bench.py`` embeds this next to the training number)."""
@@ -61,7 +62,7 @@ def run_bench(
         config, params, max_batch=batch, max_seq=max_seq,
         spec_draft=spec_draft, turbo_steps=turbo_steps,
         turbo_depth=turbo_depth, kv_quant=kv_quant,
-        prefill_chunk=prefill_chunk,
+        prefill_chunk=prefill_chunk, decode_kernel=decode_kernel,
     )
     rng = np.random.default_rng(0)
     if repetitive:
@@ -193,6 +194,7 @@ def run_bench(
             "turbo_depth": turbo_depth,
             "quantize": quantize,
             "kv_quant": kv_quant,
+            "decode_kernel": decode_kernel or "einsum",
             "backend": jax.default_backend(),
         },
     }
@@ -230,6 +232,12 @@ def main(argv=None) -> int:
         "--prefill-chunk", type=int, default=256,
         help="prefill chunk length (prefix reuse is chunk-granular)",
     )
+    p.add_argument(
+        "--decode-kernel", default=None, choices=["einsum", "flash"],
+        help="decode attention path: masked einsum (default) or the "
+             "ragged pallas kernel (each slot reads only its own "
+             "cache prefix)",
+    )
     p.add_argument("--platform", default=None)
     args = p.parse_args(argv)
 
@@ -250,6 +258,7 @@ def main(argv=None) -> int:
         turbo_steps=args.turbo_steps,
         turbo_depth=args.turbo_depth,
         kv_quant=args.kv_quant,
+        decode_kernel=args.decode_kernel,
         prefill_chunk=args.prefill_chunk,
     )
     print(json.dumps(result))

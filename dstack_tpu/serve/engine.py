@@ -775,6 +775,7 @@ def decode_step(
     positions: jax.Array,  # [B] int32: where to write (== current length)
     config: LlamaConfig,
     write_mask: jax.Array = None,  # [B] bool: rows allowed to write K/V
+    decode_kernel: str = "einsum",  # "einsum" | "flash" (ops/flash_decode)
 ) -> tuple[jax.Array, dict]:
     """One token for every slot → (logits [B, V], cache).
 
@@ -782,6 +783,12 @@ def decode_step(
     mid-chunked-prefill for another request) must not scribble stale
     K/V into their slot — a decode step interleaved between prefill
     chunks would otherwise corrupt the prompt being written.
+
+    ``decode_kernel="flash"`` routes the cache attention through the
+    ragged pallas kernel (:func:`dstack_tpu.ops.flash_decode.flash_decode`)
+    — each slot reads only the cache blocks covering its own length
+    instead of the full ``Tmax`` row. The caller gates eligibility
+    (:func:`~dstack_tpu.ops.flash_decode.flash_decode_supported`).
     """
     from dstack_tpu.models.llama import (
         attn_temp_scales,
@@ -859,35 +866,56 @@ def decode_step(
         # repeat (4× read amplification for 32q/8kv models).
         grp = c.n_heads // c.n_kv_heads
         qg = q[:, :, 0, :].reshape(b, c.n_kv_heads, grp, c.head_dim)
-        s = jnp.einsum(
-            "bhgd,bhkd->bhgk", qg, ckf, preferred_element_type=jnp.float32
-        ) * scale
-        if c.attn_softcap:
-            s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
-        kj = jnp.arange(ckf.shape[2])[None, None, None, :]
-        pos = positions[:, None, None, None]
-        mask = kj <= pos
-        mask = jnp.logical_and(
-            mask, jnp.logical_or(window == 0, pos - kj < window)
-        )
-        if c.attention_chunk_size:
-            # Llama4: rope layers attend within their chunk only
-            start = (pos // c.attention_chunk_size) * c.attention_chunk_size
-            mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= start))
-        s = jnp.where(mask, s, NEG_INF)
-        if c.attn_sinks:
-            # [Hkv, G] regroup matches the query-head order
-            from dstack_tpu.ops.attention import sink_softmax
+        if decode_kernel == "flash":
+            # ragged pallas read: blocks past each slot's position are
+            # DMA-elided (caller gated out MLA/chunked-attention/shape
+            # misfits via flash_decode_supported)
+            from dstack_tpu.ops.flash_decode import flash_decode
 
-            p = sink_softmax(
-                s,
-                layer["sinks"].astype(jnp.float32).reshape(
-                    1, c.n_kv_heads, grp, 1
+            kq, ks = (ck if isinstance(ck, tuple) else (ck, None))
+            vq, vs = (cv if isinstance(cv, tuple) else (cv, None))
+            o = flash_decode(
+                qg, kq, vq, positions,
+                scale=scale,
+                window=window,
+                softcap=float(c.attn_softcap or 0.0),
+                sinks=(
+                    layer["sinks"].reshape(c.n_kv_heads, grp)
+                    if c.attn_sinks else None
                 ),
+                k_scale=ks, v_scale=vs,
+                interpret=jax.default_backend() != "tpu",
             )
         else:
-            p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhgk,bhkd->bhgd", p.astype(cvf.dtype), cvf)
+            s = jnp.einsum(
+                "bhgd,bhkd->bhgk", qg, ckf, preferred_element_type=jnp.float32
+            ) * scale
+            if c.attn_softcap:
+                s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
+            kj = jnp.arange(ckf.shape[2])[None, None, None, :]
+            pos = positions[:, None, None, None]
+            mask = kj <= pos
+            mask = jnp.logical_and(
+                mask, jnp.logical_or(window == 0, pos - kj < window)
+            )
+            if c.attention_chunk_size:
+                # Llama4: rope layers attend within their chunk only
+                start = (pos // c.attention_chunk_size) * c.attention_chunk_size
+                mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= start))
+            s = jnp.where(mask, s, NEG_INF)
+            if c.attn_sinks:
+                # [Hkv, G] regroup matches the query-head order
+                from dstack_tpu.ops.attention import sink_softmax
+
+                p = sink_softmax(
+                    s,
+                    layer["sinks"].astype(jnp.float32).reshape(
+                        1, c.n_kv_heads, grp, 1
+                    ),
+                )
+            else:
+                p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhgk,bhkd->bhgd", p.astype(cvf.dtype), cvf)
         # [B, Hkv, G, D] row-major flatten == query-head order
         o = o.reshape(b, 1, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
@@ -923,6 +951,7 @@ def decode_loop(
     *,
     steps: int,  # static: decode steps per macro-step
     max_seq: int,  # static: cache row length
+    decode_kernel: str = "einsum",
 ) -> tuple[jax.Array, dict, jax.Array, jax.Array, jax.Array, jax.Array]:
     """``steps`` greedy decode steps entirely on device → (emitted
     [steps, B] int32 with -1 for inactive rows, cache, last token,
@@ -944,7 +973,8 @@ def decode_loop(
     def body(carry, _):
         cache, tok, pos, rem, act = carry
         logits, cache = decode_step(
-            params, cache, tok, pos, config, write_mask=act
+            params, cache, tok, pos, config, write_mask=act,
+            decode_kernel=decode_kernel,
         )
         new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         tok = jnp.where(act, new_tok, tok)
@@ -1313,6 +1343,7 @@ class InferenceEngine:
         kv_quant=None,  # None | "int8": quantized KV cache
         turbo_quiet_s: float = 0.5,
         turbo_depth: int = 1,
+        decode_kernel: Optional[str] = None,  # None/"einsum" | "flash"
     ):
         """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
         params shard per the model's logical rules (heads/mlp/vocab over
@@ -1441,11 +1472,43 @@ class InferenceEngine:
         # remote device those transfers, not compute, bound decode.
         self._turbo_state = None  # (tok, pos, rem, act, eos) on device
 
+        # ragged pallas decode attention (ops/flash_decode): opt-in via
+        # decode_kernel="flash"; requires a supported model/cache shape
+        # and no tensor-parallel mesh (pallas calls are not GSPMD-
+        # partitionable — the sharded path keeps the einsum, whose
+        # per-shard reads XLA already handles)
+        if decode_kernel not in (None, "einsum", "flash"):
+            raise ValueError(
+                f"decode_kernel={decode_kernel!r}: expected 'einsum' or "
+                "'flash' (a typo here would silently measure the wrong "
+                "path)"
+            )
+        if decode_kernel == "flash":
+            from dstack_tpu.ops.flash_decode import flash_decode_supported
+
+            if mesh is not None:
+                raise ValueError(
+                    "decode_kernel='flash' is single-device (pallas "
+                    "under GSPMD needs shard_map); drop it when serving "
+                    "over a mesh"
+                )
+            if not flash_decode_supported(config, max_seq):
+                raise ValueError(
+                    "decode_kernel='flash' unsupported for this model/"
+                    "max_seq (MLA, chunked attention, head_dim % 64, "
+                    "or max_seq % 128)"
+                )
+        self.decode_kernel = decode_kernel or "einsum"
+
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
         self._chunk_fns: dict = {}  # (C, start) → jitted prefill_chunk_step
         self._decode = jax.jit(
-            partial(decode_step, config=config), donate_argnums=(1,)
+            partial(
+                decode_step, config=config,
+                decode_kernel=self.decode_kernel,
+            ),
+            donate_argnums=(1,),
         )
         self._verify = jax.jit(
             partial(verify_step, config=config), donate_argnums=(1,)
@@ -1822,6 +1885,7 @@ class InferenceEngine:
                 partial(
                     decode_loop, config=self.config, steps=steps,
                     max_seq=self.max_seq,
+                    decode_kernel=self.decode_kernel,
                 ),
                 donate_argnums=(1,),
             )

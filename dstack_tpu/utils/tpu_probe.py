@@ -1,0 +1,25 @@
+"""Shared TPU-reachability probe for benches and capture tools.
+
+A broken axon tunnel HANGS ``jax.devices()`` rather than erroring, so
+every tool that wants to fall back to CPU must probe in a short-lived
+subprocess it can kill. One copy here — bench.py, tools/mfu_sweep.py
+and tools/decode_kernel_ab.py all import it (they previously carried
+drifting copies).
+"""
+
+import subprocess
+import sys
+
+
+def tpu_reachable(timeout: float = 90.0) -> bool:
+    """True when a fresh process can enumerate a TPU device in time."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "assert d and d[0].platform == 'tpu', d; print('ok')"],
+            timeout=timeout, capture_output=True, text=True,
+        )
+        return proc.returncode == 0 and "ok" in proc.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False

@@ -16,7 +16,6 @@ import itertools
 import json
 import os
 import statistics
-import subprocess
 import sys
 import time
 
@@ -25,14 +24,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _tpu_reachable(timeout: float = 90.0) -> bool:
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
-            timeout=timeout, capture_output=True, text=True,
-        )
-        return proc.returncode == 0 and "ok" in proc.stdout
-    except (subprocess.TimeoutExpired, OSError):
-        return False
+    from dstack_tpu.utils.tpu_probe import tpu_reachable  # one impl
+
+    return tpu_reachable(timeout=timeout)
 
 
 def measure(config, batch, seq, loss_impl, remat, steps, peak_flops):
