@@ -13,6 +13,8 @@ from datetime import datetime, timezone
 from enum import Enum
 from typing import Any, Optional, Union
 
+from pydantic import computed_field
+
 from dstack_tpu.core.models.backends import BackendType
 from dstack_tpu.core.models.common import CoreModel, RegistryAuth
 from dstack_tpu.core.models.configurations import (
@@ -271,6 +273,10 @@ class JobRuntimeData(CoreModel):
     ports: Optional[dict[int, int]] = None  # container→host when bridged
     offer: Optional[InstanceOfferWithAvailability] = None
     volume_names: list[str] = []
+    # unix seconds of the job's first_train_step log marker (emitted by
+    # train/finetune.py, scraped by process_running_jobs) — the
+    # provision→first-train-step latency metric BASELINE.md names
+    first_step_at: Optional[float] = None
 
 
 class JobSubmission(CoreModel):
@@ -289,6 +295,18 @@ class JobSubmission(CoreModel):
     @property
     def age(self) -> float:
         return (now_utc() - self.submitted_at).total_seconds()
+
+    @computed_field  # serialized: console/CLI read it, no duplicate math
+    @property
+    def provision_to_first_step_s(self) -> Optional[float]:
+        """Submission accepted → first training step on the accelerator
+        (BASELINE.md target metric). None until the job's
+        first_train_step marker has been scraped from its logs; clamped
+        at 0 for clock skew between the TPU host and the server."""
+        jrd = self.job_runtime_data
+        if jrd is None or jrd.first_step_at is None:
+            return None
+        return max(0.0, jrd.first_step_at - self.submitted_at.timestamp())
 
 
 class Job(CoreModel):

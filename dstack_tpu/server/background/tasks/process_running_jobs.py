@@ -6,6 +6,7 @@ container, submit to runner :772-827; RUNNING: incremental pull of
 states/logs :601-649).
 """
 
+import json
 from typing import Optional
 
 from dstack_tpu.agent import schemas as agent_schemas
@@ -651,6 +652,34 @@ async def _get_code_blob(
     return code["blob"] if code else None
 
 
+def _scan_first_step_marker(
+    events: list, tail: str = ""
+) -> tuple[Optional[float], str]:
+    """(unix time of the finetune driver's first_train_step log marker
+    ``{"event": "first_train_step", "t_unix": ...}`` or None, new
+    tail). Scraped once per job into job_runtime_data.first_step_at —
+    the provision→first-train-step latency metric BASELINE.md names.
+
+    ``tail`` is the trailing partial line carried across pull batches:
+    the C++ runner emits raw PTY read() chunks (not line-delimited), so
+    the marker line can straddle two events or two pulls — the batch is
+    joined before line-splitting and the unterminated remainder comes
+    back for the next call."""
+    text = tail + "".join(ev.text() for ev in events)
+    lines = text.split("\n")
+    # an unterminated final line is the next batch's prefix (bounded:
+    # the marker line is ~60 bytes, keep at most 1 KiB of tail)
+    new_tail = lines.pop()[-1024:] if not text.endswith("\n") else ""
+    for line in lines:
+        if '"first_train_step"' not in line:
+            continue
+        try:
+            return float(json.loads(line.strip())["t_unix"]), new_tail
+        except (ValueError, KeyError, TypeError):
+            continue
+    return None, new_tail
+
+
 async def _process_running(db: Database, job_row: dict, jpd: JobProvisioningData) -> None:
     jrd = loads(job_row.get("job_runtime_data")) or {}
     cursor = float(jrd.get("pull_cursor", 0.0))
@@ -686,6 +715,20 @@ async def _process_running(db: Database, job_row: dict, jpd: JobProvisioningData
                 diagnostics=True,
             )
         )
+    # first_train_step scrape: TASK runs only (the training driver is
+    # the only emitter — scanning a serve job's log firehose for the
+    # job's whole lifetime would be pure decode waste)
+    if resp.job_logs and jrd.get("first_step_at") is None:
+        run_conf = (loads(run_row["run_spec"]) or {}).get("configuration", {})
+        if run_conf.get("type") == "task":
+            t, jrd_tail = _scan_first_step_marker(
+                resp.job_logs, jrd.get("marker_tail", "")
+            )
+            if t is not None:
+                jrd["first_step_at"] = t
+                jrd.pop("marker_tail", None)
+            else:
+                jrd["marker_tail"] = jrd_tail
     jrd["pull_cursor"] = max(cursor, resp.last_updated)
     fields = {
         "job_runtime_data": dumps(jrd),

@@ -677,6 +677,13 @@ async function pageRunDetail(name) {
       h("div", { class: "k" }, "Price"), h("div", {}, jpd0 ? `$${(jpd0.price || 0).toFixed(2)}/h` : "—"),
       h("div", { class: "k" }, "Cost"), h("div", {}, run.cost ? `$${run.cost.toFixed(2)}` : "—"),
       h("div", { class: "k" }, "Submitted"), h("div", {}, fmtDate(run.submitted_at)),
+      // provision→first-train-step latency (server-computed from the
+      // job's first_train_step log marker; training runs only)
+      h("div", { class: "k" }, "First train step"), h("div", {}, (() => {
+        const s0 = run.jobs?.[0]?.job_submissions?.slice(-1)[0];
+        const dt = s0?.provision_to_first_step_s;
+        return dt == null ? "—" : `+${dt.toFixed(1)}s after submit`;
+      })()),
       h("div", { class: "k" }, "Status message"), h("div", {}, run.status_message || "—"),
       h("div", { class: "k" }, "Service URL"), h("div", {}, run.service?.url || "—"),
     ),

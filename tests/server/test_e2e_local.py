@@ -97,6 +97,56 @@ class TestLocalE2E:
         finally:
             await client.close()
 
+    async def test_provision_to_first_step_latency_scraped(self, tmp_path):
+        """The provision→first-train-step metric BASELINE.md names:
+        a job printing the finetune driver's first_train_step marker
+        gets job_runtime_data.first_step_at scraped from its logs by
+        process_running_jobs, and the submission model computes the
+        latency from it."""
+        set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="e2e-token",
+            with_background=True,
+            local_backend=True,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            marker = (
+                "python -c \"import json, time; print(json.dumps("
+                "{'event': 'first_train_step', 't_unix': time.time()}))\""
+            )
+            body = {
+                "run_spec": {
+                    "run_name": "e2e-first-step",
+                    "configuration": {
+                        "type": "task",
+                        # sleep keeps the job alive past one pull cycle
+                        # so the marker is scraped while still running
+                        "commands": [marker, "sleep 3"],
+                    },
+                    "ssh_key_pub": "ssh-ed25519 AAAA test",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply", headers=_auth("e2e-token"), json=body
+            )
+            assert r.status == 200
+            run = await _wait_run_status(
+                client, "e2e-token", "e2e-first-step",
+                ("done", "failed", "terminated"),
+            )
+            assert run["status"] == "done", run
+            sub = run["jobs"][0]["job_submissions"][-1]
+            jrd = sub["job_runtime_data"]
+            assert jrd and jrd.get("first_step_at"), jrd
+            # the computed field reaches the wire (console reads it raw)
+            lat = sub["provision_to_first_step_s"]
+            assert lat is not None and 0.0 <= lat < 120.0, lat
+        finally:
+            await client.close()
+
     async def test_two_node_jax_distributed_psum(self, tmp_path):
         """``nodes: 2`` on the local backend → two REAL runner
         processes; the job calls ``jax.distributed.initialize()`` from

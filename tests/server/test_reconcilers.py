@@ -732,3 +732,71 @@ class TestPlanTimeValidation:
                 db, project_row, user_row, make_run_spec(conf, "bad-tpl")
             )
         assert await db.fetchall("SELECT * FROM runs WHERE deleted = 0") == []
+
+
+class TestFirstStepMarkerScan:
+    """_scan_first_step_marker: the one-shot log scrape feeding the
+    provision→first-train-step metric (BASELINE.md)."""
+
+    def _ev(self, text):
+        from datetime import datetime, timezone
+
+        from dstack_tpu.core.models.logs import LogEvent
+
+        return LogEvent.create(datetime.now(timezone.utc), text)
+
+    def test_marker_parsed(self):
+        from dstack_tpu.server.background.tasks.process_running_jobs import (
+            _scan_first_step_marker,
+        )
+
+        events = [
+            self._ev("step 0 compiling...\n"),
+            self._ev('{"event": "first_train_step", "t_unix": 1754000000.5}\n'),
+        ]
+        t, tail = _scan_first_step_marker(events)
+        assert t == 1754000000.5 and tail == ""
+
+    def test_marker_mid_batch_multiline(self):
+        from dstack_tpu.server.background.tasks.process_running_jobs import (
+            _scan_first_step_marker,
+        )
+
+        ev = self._ev(
+            "noise\n"
+            '{"event": "first_train_step", "t_unix": 42.0}\n'
+            "more noise\n"
+        )
+        assert _scan_first_step_marker([ev])[0] == 42.0
+
+    def test_marker_split_across_pty_chunks(self):
+        """The C++ runner pushes raw read() chunks, so the marker line
+        can straddle two events (or two pull batches): the joined-text
+        + carried-tail scan must still find it."""
+        from dstack_tpu.server.background.tasks.process_running_jobs import (
+            _scan_first_step_marker,
+        )
+
+        line = '{"event": "first_train_step", "t_unix": 42.0}\n'
+        # split mid-key, within one batch
+        t, _ = _scan_first_step_marker(
+            [self._ev("x\n" + line[:17]), self._ev(line[17:])]
+        )
+        assert t == 42.0
+        # split across two PULLS: first batch ends mid-line → tail
+        t, tail = _scan_first_step_marker([self._ev("y\n" + line[:17])])
+        assert t is None and tail == line[:17]
+        t, tail = _scan_first_step_marker([self._ev(line[17:])], tail)
+        assert t == 42.0 and tail == ""
+
+    def test_garbage_and_missing_fields_skipped(self):
+        from dstack_tpu.server.background.tasks.process_running_jobs import (
+            _scan_first_step_marker,
+        )
+
+        events = [
+            self._ev('echo "first_train_step" not json\n'),
+            self._ev('{"event": "first_train_step"}\n'),  # no t_unix
+            self._ev("plain line\n"),
+        ]
+        assert _scan_first_step_marker(events)[0] is None
