@@ -1067,6 +1067,13 @@ def main(argv=None) -> int:
              "steps when every slot finishes early)",
     )
     p.add_argument(
+        "--decode-kernel", default=None, choices=["einsum", "flash"],
+        help="decode attention path: masked einsum over the full cache "
+             "row (default) or the ragged pallas kernel "
+             "(ops/flash_decode — each slot reads only its own prefix; "
+             "single-device, non-MLA models)",
+    )
+    p.add_argument(
         "--no-warmup", action="store_true",
         help="skip the startup compile warmup (first request then pays "
              "the prefill/decode XLA compiles in its TTFT)",
@@ -1185,6 +1192,7 @@ def main(argv=None) -> int:
         turbo_depth=args.turbo_depth,
         prefix_cache=not args.no_prefix_cache,
         kv_quant=args.kv_quant,
+        decode_kernel=args.decode_kernel,
     )
     # tokenizer first: it's cheap and fail-fast — a typo'd path must
     # not cost a full compile warmup before erroring
