@@ -776,6 +776,7 @@ def decode_step(
     config: LlamaConfig,
     write_mask: jax.Array = None,  # [B] bool: rows allowed to write K/V
     decode_kernel: str = "einsum",  # "einsum" | "flash" (ops/flash_decode)
+    mesh=None,  # static: shard_map the flash kernel over this mesh
 ) -> tuple[jax.Array, dict]:
     """One token for every slot → (logits [B, V], cache).
 
@@ -789,6 +790,10 @@ def decode_step(
     — each slot reads only the cache blocks covering its own length
     instead of the full ``Tmax`` row. The caller gates eligibility
     (:func:`~dstack_tpu.ops.flash_decode.flash_decode_supported`).
+    With a ``mesh``, the kernel runs per-shard under ``shard_map``
+    (q/cache sharded over KV heads on ``tp``, everything else
+    replicated — attention is per-head, so no collectives are needed
+    inside; GSPMD cannot partition a pallas call on its own).
     """
     from dstack_tpu.models.llama import (
         attn_temp_scales,
@@ -874,18 +879,49 @@ def decode_step(
 
             kq, ks = (ck if isinstance(ck, tuple) else (ck, None))
             vq, vs = (cv if isinstance(cv, tuple) else (cv, None))
-            o = flash_decode(
-                qg, kq, vq, positions,
-                scale=scale,
-                window=window,
-                softcap=float(c.attn_softcap or 0.0),
-                sinks=(
-                    layer["sinks"].reshape(c.n_kv_heads, grp)
-                    if c.attn_sinks else None
-                ),
-                k_scale=ks, v_scale=vs,
-                interpret=jax.default_backend() != "tpu",
+            sinks_arr = (
+                layer["sinks"].reshape(c.n_kv_heads, grp)
+                if c.attn_sinks else None
             )
+            interp = jax.default_backend() != "tpu"
+            softcap = float(c.attn_softcap or 0.0)
+
+            def _fd(qg_, kq_, vq_, pos_, win_, *opt):
+                it = iter(opt)
+                ks_ = next(it) if ks is not None else None
+                vs_ = next(it) if ks is not None else None
+                sk_ = next(it) if sinks_arr is not None else None
+                return flash_decode(
+                    qg_, kq_, vq_, pos_, scale=scale, window=win_,
+                    softcap=softcap, sinks=sk_,
+                    k_scale=ks_, v_scale=vs_, interpret=interp,
+                )
+
+            opt_args = []
+            if ks is not None:
+                opt_args += [ks, vs]
+            if sinks_arr is not None:
+                opt_args.append(sinks_arr)
+            if mesh is None:
+                o = _fd(qg, kq, vq, positions, window, *opt_args)
+            else:
+                # per-shard kernel over the tp axis (KV heads local to
+                # each shard; attention is per-head → no collectives).
+                # Axes the specs don't mention (dp/fsdp/ep) replicate.
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                h4 = P(None, "tp", None, None)
+                in_specs = [h4, h4, h4, P(None), P()]
+                if ks is not None:
+                    in_specs += [P(None, "tp", None)] * 2
+                if sinks_arr is not None:
+                    in_specs.append(P("tp", None))
+                o = shard_map(
+                    _fd, mesh=mesh,
+                    in_specs=tuple(in_specs), out_specs=h4,
+                    check_rep=False,
+                )(qg, kq, vq, positions, window, *opt_args)
         else:
             s = jnp.einsum(
                 "bhgd,bhkd->bhgk", qg, ckf, preferred_element_type=jnp.float32
@@ -952,6 +988,7 @@ def decode_loop(
     steps: int,  # static: decode steps per macro-step
     max_seq: int,  # static: cache row length
     decode_kernel: str = "einsum",
+    mesh=None,
 ) -> tuple[jax.Array, dict, jax.Array, jax.Array, jax.Array, jax.Array]:
     """``steps`` greedy decode steps entirely on device → (emitted
     [steps, B] int32 with -1 for inactive rows, cache, last token,
@@ -974,7 +1011,7 @@ def decode_loop(
         cache, tok, pos, rem, act = carry
         logits, cache = decode_step(
             params, cache, tok, pos, config, write_mask=act,
-            decode_kernel=decode_kernel,
+            decode_kernel=decode_kernel, mesh=mesh,
         )
         new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         tok = jnp.where(act, new_tok, tok)
@@ -1486,12 +1523,6 @@ class InferenceEngine:
         if decode_kernel == "flash":
             from dstack_tpu.ops.flash_decode import flash_decode_supported
 
-            if mesh is not None:
-                raise ValueError(
-                    "decode_kernel='flash' is single-device (pallas "
-                    "under GSPMD needs shard_map); drop it when serving "
-                    "over a mesh"
-                )
             if not flash_decode_supported(config, max_seq):
                 raise ValueError(
                     "decode_kernel='flash' unsupported for this model/"
@@ -1499,6 +1530,7 @@ class InferenceEngine:
                     "or max_seq % 128)"
                 )
         self.decode_kernel = decode_kernel or "einsum"
+        self._mesh = mesh  # shard_map target for the flash decode path
 
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
@@ -1506,7 +1538,7 @@ class InferenceEngine:
         self._decode = jax.jit(
             partial(
                 decode_step, config=config,
-                decode_kernel=self.decode_kernel,
+                decode_kernel=self.decode_kernel, mesh=mesh,
             ),
             donate_argnums=(1,),
         )
@@ -1885,7 +1917,7 @@ class InferenceEngine:
                 partial(
                     decode_loop, config=self.config, steps=steps,
                     max_seq=self.max_seq,
-                    decode_kernel=self.decode_kernel,
+                    decode_kernel=self.decode_kernel, mesh=self._mesh,
                 ),
                 donate_argnums=(1,),
             )

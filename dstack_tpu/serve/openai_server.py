@@ -1071,7 +1071,7 @@ def main(argv=None) -> int:
         help="decode attention path: masked einsum over the full cache "
              "row (default) or the ragged pallas kernel "
              "(ops/flash_decode — each slot reads only its own prefix; "
-             "single-device, non-MLA models)",
+             "non-MLA models; runs per-shard under tensor parallelism)",
     )
     p.add_argument(
         "--no-warmup", action="store_true",

@@ -179,6 +179,33 @@ class TestEngineParity:
         # but every slot must actually have decoded something
         assert all(len(t) >= 1 for t in outs["flash"])
 
+    @pytest.mark.parametrize("kv_quant", [None, "int8"])
+    def test_tp_mesh_matches_einsum(self, kv_quant):
+        """flash decode under shard_map on a tp=2 mesh (KV heads local
+        per shard, no collectives) must reproduce the einsum mesh
+        path's greedy stream exactly."""
+        from dstack_tpu.models import llama
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        # MHA 2 heads × 64: tp=2 leaves one KV head per shard
+        config = llama.dataclasses.replace(
+            llama.LLAMA_TINY_64, n_heads=2, n_kv_heads=2,
+        )
+        params = llama.init_params(config, jax.random.key(0))
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=2))
+        prompt = [11, 22, 33, 44, 55]
+        outs = {}
+        for kernel in ("einsum", "flash"):
+            eng = InferenceEngine(
+                config, params, max_batch=2, max_seq=256, mesh=mesh,
+                turbo_steps=4, spec_draft=0, kv_quant=kv_quant,
+                decode_kernel=kernel,
+            )
+            outs[kernel] = eng.generate(prompt, GenParams(max_new_tokens=6))
+        assert outs["flash"] == outs["einsum"]
+        assert len(outs["flash"]) >= 1
+
     def test_unsupported_config_raises(self):
         from dstack_tpu.models import llama
         from dstack_tpu.serve.engine import InferenceEngine
