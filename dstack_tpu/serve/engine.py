@@ -1510,10 +1510,10 @@ class InferenceEngine:
         self._turbo_state = None  # (tok, pos, rem, act, eos) on device
 
         # ragged pallas decode attention (ops/flash_decode): opt-in via
-        # decode_kernel="flash"; requires a supported model/cache shape
-        # and no tensor-parallel mesh (pallas calls are not GSPMD-
-        # partitionable — the sharded path keeps the einsum, whose
-        # per-shard reads XLA already handles)
+        # decode_kernel="flash"; requires a supported model/cache shape.
+        # Works under a tp mesh too — decode_step shard_maps the kernel
+        # per KV-head shard (GSPMD can't partition a pallas call on its
+        # own)
         if decode_kernel not in (None, "einsum", "flash"):
             raise ValueError(
                 f"decode_kernel={decode_kernel!r}: expected 'einsum' or "

@@ -206,6 +206,35 @@ class TestEngineParity:
         assert outs["flash"] == outs["einsum"]
         assert len(outs["flash"]) >= 1
 
+    @pytest.mark.parametrize("kv_quant", [None, "int8"])
+    def test_tp_mesh_gqa_sinks_window(self, kv_quant):
+        """The shard_map spec branches the plain test misses: GQA
+        (grp 2 per KV head), sink logits (P('tp', None) sharding), and
+        the traced per-layer sliding window (alternating 0/32 via
+        sliding_pattern) — all under a tp=2 mesh, vs the einsum path."""
+        from dstack_tpu.models import llama
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        config = llama.dataclasses.replace(
+            llama.LLAMA_TINY_64, n_heads=4, n_kv_heads=2,
+            hidden_size=256, intermediate_size=512,
+            attn_sinks=True, sliding_window=32, sliding_pattern=2,
+        )
+        params = llama.init_params(config, jax.random.key(2))
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=2))
+        prompt = list(range(3, 50))  # long enough to engage the window
+        outs = {}
+        for kernel in ("einsum", "flash"):
+            eng = InferenceEngine(
+                config, params, max_batch=2, max_seq=256, mesh=mesh,
+                turbo_steps=4, spec_draft=0, kv_quant=kv_quant,
+                decode_kernel=kernel,
+            )
+            outs[kernel] = eng.generate(prompt, GenParams(max_new_tokens=6))
+        assert outs["flash"] == outs["einsum"]
+        assert len(outs["flash"]) >= 1
+
     def test_unsupported_config_raises(self):
         from dstack_tpu.models import llama
         from dstack_tpu.serve.engine import InferenceEngine
