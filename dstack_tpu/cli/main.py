@@ -621,6 +621,16 @@ def metrics(run_name, project) -> None:
     for m in jm.metrics:
         last = f"{m.values[-1]:.1f}" if m.values else "-"
         t.add_row(m.name, last, str(len(m.values)))
+    # provision→first-train-step latency (BASELINE.md target metric;
+    # scraped from the job's first_train_step log marker — task runs)
+    try:
+        run = client.runs.get(run_name)
+        sub = run.jobs[0].job_submissions[-1] if run.jobs else None
+        lat = sub.provision_to_first_step_s if sub else None
+        if lat is not None:
+            t.add_row("provision_to_first_step_s", f"{lat:.1f}", "1")
+    except DstackTPUError:
+        pass
     console.print(t)
 
 
