@@ -58,6 +58,7 @@ def _decode_kernel(
     num_k: int,
     quantized: bool,
     sinks: bool,
+    rows_per_slot: int,
 ):
     from jax.experimental import pallas as pl
 
@@ -82,8 +83,13 @@ def _decode_kernel(
         l_sc[...] = jnp.zeros_like(l_sc)
 
     # live block range for this slot (must agree with _kv_ix's clamp:
-    # clamped-away blocks re-request a live block and skip compute)
-    last = jnp.clip(pos // block_k, 0, num_k - 1)
+    # clamped-away blocks re-request a live block and skip compute).
+    # Speculative verify (rows_per_slot = S > 1) extends the readable
+    # range to the last drafted position; the window's lower bound
+    # stays at row 0's (the loosest that covers every row).
+    last = jnp.clip(
+        (pos + rows_per_slot - 1) // block_k, 0, num_k - 1
+    )
     first = jnp.where(
         win > 0, jnp.clip((pos - (win - 1)) // block_k, 0, num_k - 1), 0
     )
@@ -102,12 +108,18 @@ def _decode_kernel(
         ) * scale  # [G, BK] f32
         if softcap:
             s = softcap * jnp.tanh(s / softcap)
+        nrows = q_ref.shape[2]
         cols = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (q_ref.shape[2], block_k), 1
+            jnp.int32, (nrows, block_k), 1
         )
-        keep = cols <= pos
+        # rows are [G, S] flattened row-major: row r verifies the
+        # token at pos + (r % S), so it sees keys up to there
+        qpos = pos + jax.lax.broadcasted_iota(
+            jnp.int32, (nrows, block_k), 0
+        ) % rows_per_slot
+        keep = cols <= qpos
         keep = jnp.logical_and(
-            keep, jnp.logical_or(win == 0, pos - cols < win)
+            keep, jnp.logical_or(win == 0, qpos - cols < win)
         )
         s = jnp.where(keep, s, NEG_INF)
         m_prev = m_sc[:, :1]  # [G, 1]
@@ -160,11 +172,18 @@ def flash_decode(
     v_scale: Optional[jax.Array] = None,
     block_k: int = 512,
     interpret: bool = False,
+    rows_per_slot: int = 1,
 ) -> jax.Array:
     """One-token-per-slot GQA attention over the cache → [B, Hkv, G, D].
 
     Ragged: each slot reads only the KV blocks covering
     ``positions[b]`` (and, with a window, only blocks inside it).
+
+    ``rows_per_slot=S`` serves speculative verify: ``q``'s row axis is
+    ``[G, S]`` flattened row-major, row ``g*S + s`` attends to keys
+    ``<= positions[b] + s`` (the engine scatters the S candidate K/V
+    into the cache before calling). ``sinks`` must then be pre-expanded
+    to ``[Hkv, G*S]``.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -191,7 +210,9 @@ def flash_decode(
         # must agree with the kernel's `live` range: tail blocks clamp
         # to the last live block, leading out-of-window blocks to the
         # first — re-requested blocks cost no DMA
-        last = jnp.clip(pos_ref[bi] // bk, 0, num_k - 1)
+        last = jnp.clip(
+            (pos_ref[bi] + rows_per_slot - 1) // bk, 0, num_k - 1
+        )
         ix = jnp.minimum(ki, last)
         first = jnp.where(
             win_ref[0] > 0,
@@ -227,6 +248,7 @@ def flash_decode(
         num_k=num_k,
         quantized=quantized,
         sinks=sinks is not None,
+        rows_per_slot=rows_per_slot,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

@@ -1036,6 +1036,8 @@ def verify_step(
     positions: jax.Array,  # [B] int32: row's current length (pos of tokens[:,0])
     config: LlamaConfig,
     write_mask: jax.Array,  # [B] bool
+    decode_kernel: str = "einsum",
+    mesh=None,
 ) -> tuple[jax.Array, dict]:
     """Multi-token decode for speculative verification → (logits
     [B, S, V], cache).
@@ -1135,36 +1137,91 @@ def verify_step(
         # decode_step): q [B, Hkv, G, S, D] · cache [B, Hkv, T, D]
         grp = c.n_heads // c.n_kv_heads
         qg = q.reshape(b, c.n_kv_heads, grp, sdraft, c.head_dim)
-        s = jnp.einsum(
-            "bhgsd,bhkd->bhgsk", qg, ckf, preferred_element_type=jnp.float32
-        ) * scale
-        if c.attn_softcap:
-            s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
-        kj = jnp.arange(tmax)[None, None, None, None, :]  # [1,1,1,1,T]
-        qpos = pos_grid[:, None, None, :, None]  # [B,1,1,S,1]
-        mask = kj <= qpos
-        mask = jnp.logical_and(
-            mask, jnp.logical_or(window == 0, qpos - kj < window)
-        )
-        if c.attention_chunk_size:
-            cstart = (qpos // c.attention_chunk_size) * c.attention_chunk_size
-            mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= cstart))
-        s = jnp.where(mask, s, NEG_INF)
-        if c.attn_sinks:
-            # speculative verify attends with the SAME sink column as
-            # decode — omitting it here would silently verify drafts
-            # against a different model
-            from dstack_tpu.ops.attention import sink_softmax
+        if decode_kernel == "flash":
+            # ragged verify: rows flatten [G, S] row-major; row g*S+s
+            # attends keys <= pos+s inside the kernel (same shard_map
+            # wrap as decode_step under a mesh)
+            from dstack_tpu.ops.flash_decode import flash_decode
 
-            p = sink_softmax(
-                s,
-                layer["sinks"].astype(jnp.float32).reshape(
-                    1, c.n_kv_heads, grp, 1, 1
-                ),
-            )
+            kq, ksc = (ck if isinstance(ck, tuple) else (ck, None))
+            vq, vsc = (cv if isinstance(cv, tuple) else (cv, None))
+            sinks_arr = None
+            if c.attn_sinks:
+                # verify attends with the SAME sink column as decode —
+                # pre-expanded to [Hkv, G*S] per-row
+                sinks_arr = jnp.broadcast_to(
+                    layer["sinks"].reshape(c.n_kv_heads, grp, 1),
+                    (c.n_kv_heads, grp, sdraft),
+                ).reshape(c.n_kv_heads, grp * sdraft)
+            interp = jax.default_backend() != "tpu"
+            softcap = float(c.attn_softcap or 0.0)
+            qr = qg.reshape(b, c.n_kv_heads, grp * sdraft, c.head_dim)
+
+            def _fv(qr_, kq_, vq_, pos_, win_, *opt):
+                it = iter(opt)
+                ks_ = next(it) if ksc is not None else None
+                vs_ = next(it) if ksc is not None else None
+                sk_ = next(it) if sinks_arr is not None else None
+                return flash_decode(
+                    qr_, kq_, vq_, pos_, scale=scale, window=win_,
+                    softcap=softcap, sinks=sk_, k_scale=ks_, v_scale=vs_,
+                    interpret=interp, rows_per_slot=sdraft,
+                )
+
+            opt_args = []
+            if ksc is not None:
+                opt_args += [ksc, vsc]
+            if sinks_arr is not None:
+                opt_args.append(sinks_arr)
+            if mesh is None:
+                o = _fv(qr, kq, vq, positions, window, *opt_args)
+            else:
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import PartitionSpec as P
+
+                h4 = P(None, "tp", None, None)
+                in_specs = [h4, h4, h4, P(None), P()]
+                if ksc is not None:
+                    in_specs += [P(None, "tp", None)] * 2
+                if sinks_arr is not None:
+                    in_specs.append(P("tp", None))
+                o = shard_map(
+                    _fv, mesh=mesh,
+                    in_specs=tuple(in_specs), out_specs=h4,
+                    check_rep=False,
+                )(qr, kq, vq, positions, window, *opt_args)
+            o = o.reshape(b, c.n_kv_heads, grp, sdraft, c.head_dim)
         else:
-            p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhgsk,bhkd->bhgsd", p.astype(cvf.dtype), cvf)
+            s = jnp.einsum(
+                "bhgsd,bhkd->bhgsk", qg, ckf, preferred_element_type=jnp.float32
+            ) * scale
+            if c.attn_softcap:
+                s = c.attn_softcap * jnp.tanh(s / c.attn_softcap)
+            kj = jnp.arange(tmax)[None, None, None, None, :]  # [1,1,1,1,T]
+            qpos = pos_grid[:, None, None, :, None]  # [B,1,1,S,1]
+            mask = kj <= qpos
+            mask = jnp.logical_and(
+                mask, jnp.logical_or(window == 0, qpos - kj < window)
+            )
+            if c.attention_chunk_size:
+                cstart = (qpos // c.attention_chunk_size) * c.attention_chunk_size
+                mask = jnp.logical_and(mask, jnp.logical_or(nope, kj >= cstart))
+            s = jnp.where(mask, s, NEG_INF)
+            if c.attn_sinks:
+                # speculative verify attends with the SAME sink column as
+                # decode — omitting it here would silently verify drafts
+                # against a different model
+                from dstack_tpu.ops.attention import sink_softmax
+
+                p = sink_softmax(
+                    s,
+                    layer["sinks"].astype(jnp.float32).reshape(
+                        1, c.n_kv_heads, grp, 1, 1
+                    ),
+                )
+            else:
+                p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhgsk,bhkd->bhgsd", p.astype(cvf.dtype), cvf)
         o = o.transpose(0, 3, 1, 2, 4).reshape(b, sdraft, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
         if c.proj_bias:
@@ -1543,7 +1600,11 @@ class InferenceEngine:
             donate_argnums=(1,),
         )
         self._verify = jax.jit(
-            partial(verify_step, config=config), donate_argnums=(1,)
+            partial(
+                verify_step, config=config,
+                decode_kernel=self.decode_kernel, mesh=mesh,
+            ),
+            donate_argnums=(1,),
         )
         self._sample = jax.jit(sample)
         self._turbo_fns: dict = {}  # steps → jitted decode_loop

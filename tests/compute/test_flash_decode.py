@@ -138,6 +138,33 @@ class TestFlashDecodeParity:
         )
 
 
+class TestVerifyRows:
+    def test_rows_per_slot_matches_per_row_masks(self):
+        """rows_per_slot=S: row g*S+s attends to keys <= pos+s — the
+        speculative-verify shape, checked against a per-row einsum."""
+        S, g = 3, 2
+        b, hkv, t, d = 2, 2, 256, 64
+        kq, kk, kv = jax.random.split(jax.random.key(8), 3)
+        q = jax.random.normal(kq, (b, hkv, g * S, d), jnp.float32)
+        k = jax.random.normal(kk, (b, hkv, t, d), jnp.float32)
+        v = jax.random.normal(kv, (b, hkv, t, d), jnp.float32)
+        positions = jnp.asarray([5, 130], jnp.int32)
+        out = flash_decode(
+            q, k, v, positions, scale=0.125, rows_per_slot=S,
+            block_k=128, interpret=True,
+        )
+        # reference: einsum with per-row key limits
+        s_ = jnp.einsum(
+            "bhrd,bhkd->bhrk", q, k, preferred_element_type=jnp.float32
+        ) * 0.125
+        kj = jnp.arange(t)[None, None, None, :]
+        roff = (jnp.arange(g * S) % S)[None, None, :, None]
+        qpos = positions[:, None, None, None] + roff
+        p = jax.nn.softmax(jnp.where(kj <= qpos, s_, NEG_INF), axis=-1)
+        ref = jnp.einsum("bhrk,bhkd->bhrd", p.astype(v.dtype), v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
 class TestEngineParity:
     def _config(self):
         from dstack_tpu.models import llama
@@ -203,6 +230,31 @@ class TestEngineParity:
                 decode_kernel=kernel,
             )
             outs[kernel] = eng.generate(prompt, GenParams(max_new_tokens=6))
+        assert outs["flash"] == outs["einsum"]
+        assert len(outs["flash"]) >= 1
+
+    @pytest.mark.parametrize("kv_quant", [None, "int8"])
+    def test_speculative_verify_parity(self, kv_quant):
+        """spec_draft routes through verify_step: a repetitive prompt
+        makes prompt-lookup drafts fire, so the flash verify path
+        (rows_per_slot=S) must emit the einsum path's exact stream."""
+        from dstack_tpu.models import llama
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        config = self._config()
+        params = llama.init_params(config, jax.random.key(0))
+        phrase = [5, 9, 13, 17]
+        prompt = (phrase * 12)[:40]  # repetition → drafts accepted
+        outs = {}
+        for kernel in ("einsum", "flash"):
+            eng = InferenceEngine(
+                config, params, max_batch=2, max_seq=256,
+                turbo_steps=0, spec_draft=3, kv_quant=kv_quant,
+                decode_kernel=kernel,
+            )
+            outs[kernel] = eng.generate(
+                prompt, GenParams(max_new_tokens=10)
+            )
         assert outs["flash"] == outs["einsum"]
         assert len(outs["flash"]) >= 1
 
