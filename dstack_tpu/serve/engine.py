@@ -768,6 +768,70 @@ def prefill_chunk_step(
     return _head_logits(params, last, c), cache
 
 
+def _flash_attend(
+    q_rows,  # [B, Hkv, R, D] — R = grp * rows_per_slot, row-major [G, S]
+    ck, cv,  # per-layer cache slices: arrays or (int8, scale) tuples
+    positions,  # [B] int32
+    window,  # traced int32 scalar (0 = full)
+    *,
+    config, scale, grp, rows_per_slot, sinks_leaf, mesh,
+):
+    """Shared flash_decode dispatch for decode_step (rows_per_slot=1)
+    and verify_step (S>1): quant-tuple unpack, per-row sink expansion,
+    optional-arg threading, interpret detection, and the shard_map wrap
+    under a mesh — ONE copy, so a kernel-signature or sharding-spec
+    change cannot silently diverge decode from verify."""
+    from dstack_tpu.ops.flash_decode import flash_decode
+
+    c = config
+    kq, ks = (ck if isinstance(ck, tuple) else (ck, None))
+    vq, vs = (cv if isinstance(cv, tuple) else (cv, None))
+    sinks_arr = None
+    if c.attn_sinks:
+        # row g*S+s carries group g's sink (decode: S=1 → [Hkv, G])
+        sinks_arr = jnp.broadcast_to(
+            sinks_leaf.reshape(c.n_kv_heads, grp, 1),
+            (c.n_kv_heads, grp, rows_per_slot),
+        ).reshape(c.n_kv_heads, grp * rows_per_slot)
+    interp = jax.default_backend() != "tpu"
+    softcap = float(c.attn_softcap or 0.0)
+
+    def _fd(q_, kq_, vq_, pos_, win_, *opt):
+        it = iter(opt)
+        ks_ = next(it) if ks is not None else None
+        vs_ = next(it) if ks is not None else None
+        sk_ = next(it) if sinks_arr is not None else None
+        return flash_decode(
+            q_, kq_, vq_, pos_, scale=scale, window=win_,
+            softcap=softcap, sinks=sk_, k_scale=ks_, v_scale=vs_,
+            interpret=interp, rows_per_slot=rows_per_slot,
+        )
+
+    opt_args = []
+    if ks is not None:
+        opt_args += [ks, vs]
+    if sinks_arr is not None:
+        opt_args.append(sinks_arr)
+    if mesh is None:
+        return _fd(q_rows, kq, vq, positions, window, *opt_args)
+    # per-shard kernel over the tp axis (KV heads local to each shard;
+    # attention is per-head → no collectives). Axes the specs don't
+    # mention (dp/fsdp/ep) replicate.
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    h4 = P(None, "tp", None, None)
+    in_specs = [h4, h4, h4, P(None), P()]
+    if ks is not None:
+        in_specs += [P(None, "tp", None)] * 2
+    if sinks_arr is not None:
+        in_specs.append(P("tp", None))
+    return shard_map(
+        _fd, mesh=mesh, in_specs=tuple(in_specs), out_specs=h4,
+        check_rep=False,
+    )(q_rows, kq, vq, positions, window, *opt_args)
+
+
 def decode_step(
     params: dict,
     cache: dict,
@@ -875,53 +939,11 @@ def decode_step(
             # ragged pallas read: blocks past each slot's position are
             # DMA-elided (caller gated out MLA/chunked-attention/shape
             # misfits via flash_decode_supported)
-            from dstack_tpu.ops.flash_decode import flash_decode
-
-            kq, ks = (ck if isinstance(ck, tuple) else (ck, None))
-            vq, vs = (cv if isinstance(cv, tuple) else (cv, None))
-            sinks_arr = (
-                layer["sinks"].reshape(c.n_kv_heads, grp)
-                if c.attn_sinks else None
+            o = _flash_attend(
+                qg, ck, cv, positions, window,
+                config=c, scale=scale, grp=grp, rows_per_slot=1,
+                sinks_leaf=layer.get("sinks"), mesh=mesh,
             )
-            interp = jax.default_backend() != "tpu"
-            softcap = float(c.attn_softcap or 0.0)
-
-            def _fd(qg_, kq_, vq_, pos_, win_, *opt):
-                it = iter(opt)
-                ks_ = next(it) if ks is not None else None
-                vs_ = next(it) if ks is not None else None
-                sk_ = next(it) if sinks_arr is not None else None
-                return flash_decode(
-                    qg_, kq_, vq_, pos_, scale=scale, window=win_,
-                    softcap=softcap, sinks=sk_,
-                    k_scale=ks_, v_scale=vs_, interpret=interp,
-                )
-
-            opt_args = []
-            if ks is not None:
-                opt_args += [ks, vs]
-            if sinks_arr is not None:
-                opt_args.append(sinks_arr)
-            if mesh is None:
-                o = _fd(qg, kq, vq, positions, window, *opt_args)
-            else:
-                # per-shard kernel over the tp axis (KV heads local to
-                # each shard; attention is per-head → no collectives).
-                # Axes the specs don't mention (dp/fsdp/ep) replicate.
-                from jax.experimental.shard_map import shard_map
-                from jax.sharding import PartitionSpec as P
-
-                h4 = P(None, "tp", None, None)
-                in_specs = [h4, h4, h4, P(None), P()]
-                if ks is not None:
-                    in_specs += [P(None, "tp", None)] * 2
-                if sinks_arr is not None:
-                    in_specs.append(P("tp", None))
-                o = shard_map(
-                    _fd, mesh=mesh,
-                    in_specs=tuple(in_specs), out_specs=h4,
-                    check_rep=False,
-                )(qg, kq, vq, positions, window, *opt_args)
         else:
             s = jnp.einsum(
                 "bhgd,bhkd->bhgk", qg, ckf, preferred_element_type=jnp.float32
@@ -1139,58 +1161,14 @@ def verify_step(
         qg = q.reshape(b, c.n_kv_heads, grp, sdraft, c.head_dim)
         if decode_kernel == "flash":
             # ragged verify: rows flatten [G, S] row-major; row g*S+s
-            # attends keys <= pos+s inside the kernel (same shard_map
-            # wrap as decode_step under a mesh)
-            from dstack_tpu.ops.flash_decode import flash_decode
-
-            kq, ksc = (ck if isinstance(ck, tuple) else (ck, None))
-            vq, vsc = (cv if isinstance(cv, tuple) else (cv, None))
-            sinks_arr = None
-            if c.attn_sinks:
-                # verify attends with the SAME sink column as decode —
-                # pre-expanded to [Hkv, G*S] per-row
-                sinks_arr = jnp.broadcast_to(
-                    layer["sinks"].reshape(c.n_kv_heads, grp, 1),
-                    (c.n_kv_heads, grp, sdraft),
-                ).reshape(c.n_kv_heads, grp * sdraft)
-            interp = jax.default_backend() != "tpu"
-            softcap = float(c.attn_softcap or 0.0)
+            # attends keys <= pos+s inside the kernel (verify rides the
+            # SAME dispatch — sink column included — as decode)
             qr = qg.reshape(b, c.n_kv_heads, grp * sdraft, c.head_dim)
-
-            def _fv(qr_, kq_, vq_, pos_, win_, *opt):
-                it = iter(opt)
-                ks_ = next(it) if ksc is not None else None
-                vs_ = next(it) if ksc is not None else None
-                sk_ = next(it) if sinks_arr is not None else None
-                return flash_decode(
-                    qr_, kq_, vq_, pos_, scale=scale, window=win_,
-                    softcap=softcap, sinks=sk_, k_scale=ks_, v_scale=vs_,
-                    interpret=interp, rows_per_slot=sdraft,
-                )
-
-            opt_args = []
-            if ksc is not None:
-                opt_args += [ksc, vsc]
-            if sinks_arr is not None:
-                opt_args.append(sinks_arr)
-            if mesh is None:
-                o = _fv(qr, kq, vq, positions, window, *opt_args)
-            else:
-                from jax.experimental.shard_map import shard_map
-                from jax.sharding import PartitionSpec as P
-
-                h4 = P(None, "tp", None, None)
-                in_specs = [h4, h4, h4, P(None), P()]
-                if ksc is not None:
-                    in_specs += [P(None, "tp", None)] * 2
-                if sinks_arr is not None:
-                    in_specs.append(P("tp", None))
-                o = shard_map(
-                    _fv, mesh=mesh,
-                    in_specs=tuple(in_specs), out_specs=h4,
-                    check_rep=False,
-                )(qr, kq, vq, positions, window, *opt_args)
-            o = o.reshape(b, c.n_kv_heads, grp, sdraft, c.head_dim)
+            o = _flash_attend(
+                qr, ck, cv, positions, window,
+                config=c, scale=scale, grp=grp, rows_per_slot=sdraft,
+                sinks_leaf=layer.get("sinks"), mesh=mesh,
+            ).reshape(b, c.n_kv_heads, grp, sdraft, c.head_dim)
         else:
             s = jnp.einsum(
                 "bhgsd,bhkd->bhgsk", qg, ckf, preferred_element_type=jnp.float32

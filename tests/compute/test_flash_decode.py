@@ -258,6 +258,36 @@ class TestEngineParity:
         assert outs["flash"] == outs["einsum"]
         assert len(outs["flash"]) >= 1
 
+    def test_speculative_verify_sinks_window_tp_mesh(self):
+        """Speculative verify through the per-row window mask, the
+        [Hkv, G*S] sink expansion, AND the verify shard_map specs at
+        once — the branches the plain spec-parity test never enters."""
+        from dstack_tpu.models import llama
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        config = llama.dataclasses.replace(
+            llama.LLAMA_TINY_64, n_heads=4, n_kv_heads=2,
+            hidden_size=256, intermediate_size=512,
+            attn_sinks=True, sliding_window=32, sliding_pattern=2,
+        )
+        params = llama.init_params(config, jax.random.key(3))
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=2))
+        phrase = [5, 9, 13, 17]
+        prompt = (phrase * 12)[:44]  # repetition → drafts fire
+        outs = {}
+        for kernel in ("einsum", "flash"):
+            eng = InferenceEngine(
+                config, params, max_batch=2, max_seq=256, mesh=mesh,
+                turbo_steps=0, spec_draft=3, kv_quant="int8",
+                decode_kernel=kernel,
+            )
+            outs[kernel] = eng.generate(
+                prompt, GenParams(max_new_tokens=10)
+            )
+        assert outs["flash"] == outs["einsum"]
+        assert len(outs["flash"]) >= 1
+
     @pytest.mark.parametrize("kv_quant", [None, "int8"])
     def test_tp_mesh_gqa_sinks_window(self, kv_quant):
         """The shard_map spec branches the plain test misses: GQA
