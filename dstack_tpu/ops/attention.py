@@ -84,6 +84,25 @@ def _xla_attention(
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
+def sink_postscale(
+    o: jax.Array,  # [B, H, Tq, D] sink-less attention output
+    lse: jax.Array,  # [B, H, Tq] f32 logsumexp of the same call
+    sinks: jax.Array,  # [H] learned sink logits
+) -> jax.Array:
+    """Apply gpt-oss attention sinks AFTER a sink-less softmax.
+
+    The sink joins the DENOMINATOR only (:func:`sink_softmax`), so the
+    sinked output is an exact rescale of the sink-less one:
+    ``p_sink @ v = (p @ v) · l / (l + e^{sink-m}) = o · σ(lse - sink)``
+    — which lets the pallas flash kernel serve sink models without a
+    sink column in the kernel (forward only: ``lse`` from
+    :func:`flash_attention_with_lse` has no VJP)."""
+    gate = jax.nn.sigmoid(
+        lse - sinks.astype(jnp.float32).reshape(1, -1, 1)
+    )[..., None]
+    return (o.astype(jnp.float32) * gate).astype(o.dtype)
+
+
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -97,13 +116,25 @@ def attention(
     chunk: int = 0,  # 0 = off; else Llama4 blockwise-chunk size
     sinks: Optional[jax.Array] = None,  # [H] gpt-oss attention sinks
     impl: Optional[str] = None,  # None=auto | "flash" | "xla"
+    sinks_forward_only: bool = False,  # caller never differentiates
 ) -> jax.Array:
     """Dispatching attention entry point used by models."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     if sinks is not None:
-        # the pallas kernel has no sink column; sink models take the
-        # masked XLA path (scores softmax is the cheap part at the
-        # sizes these models serve at)
+        # sinks join the softmax DENOMINATOR only, so a sink-less flash
+        # pass rescaled by σ(lse - sink) is exact (sink_postscale) —
+        # but lse has no VJP, so only forward-only callers (serving
+        # prefill) may ride it; training keeps the masked XLA path
+        if (
+            sinks_forward_only
+            and not chunk
+            and (impl == "flash" or (impl is None and flash_supported(q, k)))
+        ):
+            o, lse = flash_attention_with_lse(
+                q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+                window=window, softcap=softcap,
+            )
+            return sink_postscale(o, lse, sinks)
         return _xla_attention(
             q, k, v, causal=causal, scale=scale, q_offset=q_offset,
             window=window, softcap=softcap, chunk=chunk, sinks=sinks,

@@ -709,6 +709,9 @@ def prefill_chunk_step(
             window=window, softcap=c.attn_softcap,
             chunk=0 if nope else c.attention_chunk_size,
             sinks=layer.get("sinks") if c.attn_sinks else None,
+            # serving never differentiates: sink models may ride the
+            # flash kernel + exact σ(lse - sink) rescale on TPU
+            sinks_forward_only=True,
         )
         o = o.transpose(0, 2, 1, 3).reshape(b, cl, c.q_dim)
         ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")

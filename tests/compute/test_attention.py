@@ -219,3 +219,64 @@ class TestWindowAndSoftcap:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
         )
+
+
+class TestSinkPostscale:
+    """gpt-oss sinks as an exact rescale of a sink-less flash pass:
+    p_sink @ v == (p @ v) * sigmoid(lse - sink). Lets serving prefill
+    ride the pallas kernel for sink models (forward only)."""
+
+    def test_matches_sink_softmax_reference(self):
+        from dstack_tpu.ops.attention import sink_postscale
+        from dstack_tpu.ops.flash import flash_attention_with_lse
+
+        q, k, v = _rand_qkv(jax.random.key(9), b=2, h=4, hkv=2, t=256, d=128)
+        sinks = jax.random.normal(jax.random.key(10), (4,), jnp.float32)
+        ref = _xla_attention(
+            q, k, v, causal=True, scale=128**-0.5, sinks=sinks
+        )
+        o, lse = flash_attention_with_lse(
+            q, k, v, causal=True, scale=128**-0.5,
+            block_q=128, block_k=128, interpret=True,
+        )
+        out = sink_postscale(o, lse, sinks)
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+    def test_with_window_and_softcap(self):
+        from dstack_tpu.ops.attention import sink_postscale
+        from dstack_tpu.ops.flash import flash_attention_with_lse
+
+        q, k, v = _rand_qkv(jax.random.key(11), b=1, h=2, hkv=2, t=256, d=128)
+        sinks = jnp.asarray([0.5, -1.0], jnp.float32)
+        ref = _xla_attention(
+            q, k, v, causal=True, scale=128**-0.5, sinks=sinks,
+            window=64, softcap=20.0,
+        )
+        o, lse = flash_attention_with_lse(
+            q, k, v, causal=True, scale=128**-0.5, window=64,
+            softcap=20.0, block_q=128, block_k=128, interpret=True,
+        )
+        out = sink_postscale(o, lse, sinks)
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+
+    def test_dispatcher_routes_forward_only(self, monkeypatch):
+        """attention(sinks=..., sinks_forward_only=True) takes the
+        flash+postscale path when the kernel is supported, and the
+        result matches the XLA sink path."""
+        import dstack_tpu.ops.attention as attn_mod
+
+        q, k, v = _rand_qkv(jax.random.key(12), b=1, h=2, hkv=2, t=256, d=128)
+        sinks = jnp.asarray([0.2, -0.7], jnp.float32)
+        # force the flash path on CPU: interpret-mode kernel
+        monkeypatch.setattr(
+            attn_mod, "flash_attention_with_lse",
+            lambda *a, **kw: __import__(
+                "dstack_tpu.ops.flash", fromlist=["flash_attention_with_lse"]
+            ).flash_attention_with_lse(*a, **kw, interpret=True),
+        )
+        out = attn_mod.attention(
+            q, k, v, causal=True, sinks=sinks,
+            sinks_forward_only=True, impl="flash",
+        )
+        ref = attn_mod.attention(q, k, v, causal=True, sinks=sinks)
+        np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
