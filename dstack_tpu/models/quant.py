@@ -22,8 +22,10 @@ matmul to fuse a dequant into). MoE expert stacks ([L, E, in, out])
 quantize through the same rank-generic absmax — per (expert, output
 channel) scales — and models/moe.py resolves the ``_q``/``_s`` form in
 its batched expert einsums; routers stay full precision (tiny, and
-routing decisions are precision-sensitive). MLA projections are still
-refused (the absorbed serving path reads raw weight names).
+routing decisions are precision-sensitive). MLA models quantize their
+expert/FFN stacks and ``wo`` — nearly all of a DeepSeek checkpoint's
+bytes — while the latent attention projections stay full precision
+(raw-einsum/absorbed-reshape consumers; see :func:`quant_targets`).
 """
 
 from typing import Any
@@ -63,34 +65,54 @@ def dequantize_weight(q, s, dtype: Any) -> jax.Array:
     return (jnp.asarray(q, jnp.float32) * jnp.asarray(s)[..., None, :]).astype(dtype)
 
 
+def quant_targets(config: LlamaConfig) -> tuple:
+    """The projection leaves int8 covers for this config.
+
+    MLA models (DeepSeek) keep their latent attention projections
+    (``wq_a/wq_b/wkv_a/wkv_b``) in full precision: they are consumed by
+    raw einsums and the absorbed-form reshape, and the latent path is
+    already the compression — while the expert/FFN stacks and ``wo``
+    (a ``_proj`` consumer) carry nearly all of a DeepSeek checkpoint's
+    bytes and quantize exactly like any other family's."""
+    if config.mla:
+        # derived, not hardcoded: a future FFN target added to
+        # LAYER_TARGETS must not silently serve full-precision on MLA
+        return tuple(
+            t for t in LAYER_TARGETS if t not in ("wq", "wk", "wv")
+        )
+    return LAYER_TARGETS
+
+
+def _quantize_stack(stack: dict, targets: tuple) -> dict:
+    out = {}
+    for name, leaf in stack.items():
+        if name in targets:
+            q, s = quantize_weight(leaf)  # asarray(f32) happens inside
+            out[name + "_q"] = q
+            out[name + "_s"] = s
+        else:
+            out[name] = leaf
+    return out
+
+
 def quantize_tree(params: dict, config: LlamaConfig) -> dict:
     """Params pytree → serving pytree with int8 projection weights.
 
     Quantizes the per-layer projections and the LM head (when untied);
-    embedding, norms, biases, and LoRA adapters pass through.
+    embedding, norms, biases, and LoRA adapters pass through. The
+    DeepSeek dense prelude (``dense_layers``) quantizes its FFN like
+    the main stack; see :func:`quant_targets` for the MLA carve-out.
     """
-    if config.mla:
-        raise ValueError(
-            "int8 quantization does not cover MLA projections yet"
-        )
+    targets = quant_targets(config)
+    out = {
+        k: v for k, v in params.items()
+        if k not in ("layers", "dense_layers", "lm_head")
+    }
+    out["layers"] = _quantize_stack(params["layers"], targets)
     if "dense_layers" in params:
-        # belt for a future non-MLA first_k_dense family: quantizing
-        # only params["layers"] would silently serve the prelude at
-        # full precision
-        raise ValueError(
-            "int8 quantization does not cover dense-prelude stacks yet"
+        out["dense_layers"] = _quantize_stack(
+            params["dense_layers"], targets
         )
-    out = {k: v for k, v in params.items() if k not in ("layers", "lm_head")}
-    layers = {}
-    for name, leaf in params["layers"].items():
-        leaf = np.asarray(leaf) if name in LAYER_TARGETS else leaf
-        if name in LAYER_TARGETS:
-            q, s = quantize_weight(leaf)
-            layers[name + "_q"] = q
-            layers[name + "_s"] = s
-        else:
-            layers[name] = leaf
-    out["layers"] = layers
     if "lm_head" in params:
         q, s = quantize_weight(params["lm_head"])
         out["lm_head_q"] = q
@@ -98,23 +120,35 @@ def quantize_tree(params: dict, config: LlamaConfig) -> dict:
     return out
 
 
-def quant_param_specs(specs: dict) -> dict:
+def quant_param_specs(specs: dict, config: LlamaConfig = None) -> dict:
     """Logical-axis spec tree for a quantized params tree.
 
     ``name_q`` shards exactly like ``name``; ``name_s`` keeps only the
     output-channel axis (the last spec entry), so tensor-parallel
-    serving shards scales alongside their columns.
+    serving shards scales alongside their columns. ``config`` picks the
+    per-config target set (MLA quantizes FFN + ``wo`` only) — omitted,
+    the full LAYER_TARGETS set is assumed (pre-MLA callers).
     """
-    out = {k: v for k, v in specs.items() if k not in ("layers", "lm_head")}
-    layers = {}
-    for name, spec in specs["layers"].items():
-        if name in LAYER_TARGETS:
-            layers[name + "_q"] = spec
-            # drop the input-dim axis: ("layers", in, out) → ("layers", out)
-            layers[name + "_s"] = spec[:-2] + spec[-1:]
-        else:
-            layers[name] = spec
-    out["layers"] = layers
+    targets = quant_targets(config) if config is not None else LAYER_TARGETS
+
+    def spec_stack(stack: dict) -> dict:
+        out = {}
+        for name, spec in stack.items():
+            if name in targets:
+                out[name + "_q"] = spec
+                # drop the input-dim axis: ("layers", in, out) → ("layers", out)
+                out[name + "_s"] = spec[:-2] + spec[-1:]
+            else:
+                out[name] = spec
+        return out
+
+    out = {
+        k: v for k, v in specs.items()
+        if k not in ("layers", "dense_layers", "lm_head")
+    }
+    out["layers"] = spec_stack(specs["layers"])
+    if "dense_layers" in specs:
+        out["dense_layers"] = spec_stack(specs["dense_layers"])
     if "lm_head" in specs:
         out["lm_head_q"] = specs["lm_head"]
         out["lm_head_s"] = specs["lm_head"][-1:]
@@ -175,14 +209,18 @@ def _random_tree_shapes(config: LlamaConfig, seed: int) -> dict:
 
     if config.mla:
         raise ValueError(
-            "int8 quantization does not cover MLA projections yet"
+            "the bench's random int8 tree generator does not cover MLA "
+            "configs (real checkpoints DO quantize via quantize_tree; "
+            "the bench targets the llama family)"
         )
     shapes = jax.eval_shape(
         partial(llama.init_params, config), jax.random.key(seed)
     )
     if "dense_layers" in shapes:
         raise ValueError(
-            "int8 quantization does not cover dense-prelude stacks yet"
+            "the bench's random int8 tree generator does not cover "
+            "dense-prelude stacks (real checkpoints DO quantize via "
+            "quantize_tree)"
         )
     return shapes
 

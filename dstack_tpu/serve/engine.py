@@ -1447,7 +1447,7 @@ class InferenceEngine:
                 )
             specs = llama.param_specs(config)
             if is_quantized(params):
-                specs = quant_param_specs(specs)
+                specs = quant_param_specs(specs, config)
             shardings = tree_shardings(specs, mesh, default_rules())
             params = jax.device_put(params, shardings)
         self.params = params

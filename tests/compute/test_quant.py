@@ -121,11 +121,14 @@ class TestQuantizedForward:
         out = eng.generate([3, 14, 15, 9], GenParams(max_new_tokens=5))
         assert len(out) == 5
 
-    def test_mla_refused(self):
-        config = llama.MLA_TINY
-        params = llama.init_params(config, jax.random.key(0))
+    def test_mla_bench_path_still_refused(self):
+        """The bench's random-tree generators stay non-MLA (the serving
+        bench targets the llama family); the REAL quantize_tree now
+        covers MLA — see TestMLAQuantization."""
+        from dstack_tpu.models.quant import random_quantized_params
+
         with pytest.raises(ValueError, match="MLA"):
-            quantize_tree(params, config)
+            random_quantized_params(llama.MLA_TINY)
 
 
 class TestRandomQuantizedParams:
@@ -232,6 +235,68 @@ class TestQuantizedServing:
         qparams = quantize_tree(params, config)
         specs = quant_param_specs(llama.param_specs(config))
         # identical tree structure → shardable leaf-for-leaf
+        p_paths = {
+            jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_leaves_with_path(qparams)
+        }
+        s_paths = {
+            jax.tree_util.keystr(p)
+            for p, _ in jax.tree_util.tree_leaves_with_path(
+                specs, is_leaf=lambda x: isinstance(x, tuple)
+            )
+        }
+        assert p_paths == s_paths
+
+
+class TestMLAQuantization:
+    """DeepSeek trees quantize their expert/FFN stacks + wo (the bytes)
+    while latent attention projections stay full precision — previously
+    MLA was refused entirely, serving V2/V3-family checkpoints bf16."""
+
+    def test_mla_tree_quantizes_ffn_and_wo(self):
+        from dstack_tpu.models.quant import quant_targets
+
+        config = llama.MLA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        qparams = quantize_tree(params, config)
+        assert is_quantized(qparams)
+        for stack in ("layers", "dense_layers"):
+            keys = qparams[stack]
+            assert "w_gate_q" in keys and "w_gate" not in keys
+            assert "wo_q" in keys and "wo" not in keys
+            # latent attention stays full precision
+            for name in ("wq_a", "wq_b", "wkv_a", "wkv_b"):
+                assert name in keys and name + "_q" not in keys, name
+        assert "wo" in quant_targets(config)
+
+    def test_mla_quantized_forward_close(self):
+        config = llama.MLA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        qparams = quantize_tree(params, config)
+        tokens = jax.random.randint(
+            jax.random.key(1), (2, 32), 0, config.vocab_size
+        )
+        full = llama.forward(params, tokens, config)
+        quant = llama.forward(qparams, tokens, config)
+        denom = np.abs(np.asarray(full)).max() + 1e-6
+        rel = np.abs(np.asarray(quant) - np.asarray(full)).max() / denom
+        assert rel < 0.05, f"relative logit error {rel:.3f}"
+
+    def test_mla_quantized_serving_runs(self):
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        config = llama.MLA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        qparams = quantize_tree(params, config)
+        eng = InferenceEngine(config, qparams, max_batch=2, max_seq=128)
+        out = eng.generate([7, 11, 13, 17], GenParams(max_new_tokens=5))
+        assert len(out) >= 1 and all(isinstance(t, int) for t in out)
+
+    def test_mla_spec_tree_matches_quantized_leaves(self):
+        config = llama.MLA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        qparams = quantize_tree(params, config)
+        specs = quant_param_specs(llama.param_specs(config), config)
         p_paths = {
             jax.tree_util.keystr(p)
             for p, _ in jax.tree_util.tree_leaves_with_path(qparams)
