@@ -292,6 +292,26 @@ class TestMLAQuantization:
         out = eng.generate([7, 11, 13, 17], GenParams(max_new_tokens=5))
         assert len(out) >= 1 and all(isinstance(t, int) for t in out)
 
+    def test_mla_quantized_tp_mesh_matches_single_device(self):
+        """The V3 deployment shape: int8 MLA tree over a tp mesh. The
+        config-aware quant specs must shard the partial tree so the
+        greedy stream matches unsharded quantized serving exactly."""
+        from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
+        from dstack_tpu.serve.engine import GenParams, InferenceEngine
+
+        config = llama.MLA_TINY  # 4 q heads: tp=2 shards them
+        params = llama.init_params(config, jax.random.key(0))
+        qparams = quantize_tree(params, config)
+        prompt = [7, 11, 13, 17]
+        ref = InferenceEngine(
+            config, qparams, max_batch=2, max_seq=128
+        ).generate(prompt, GenParams(max_new_tokens=5))
+        mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=2))
+        eng = InferenceEngine(
+            config, qparams, max_batch=2, max_seq=128, mesh=mesh
+        )
+        assert eng.generate(prompt, GenParams(max_new_tokens=5)) == ref
+
     def test_mla_spec_tree_matches_quantized_leaves(self):
         config = llama.MLA_TINY
         params = llama.init_params(config, jax.random.key(0))
