@@ -294,6 +294,11 @@ class APIClient:
             )
         )
 
+    def get_run_timeline(self, run_id: str) -> dict:
+        """Ordered lifecycle phase transitions with durations
+        (run_events timeline; `dtpu stats` renders it)."""
+        return self._get(f"/api/runs/{run_id}/timeline")
+
     # fleets
     def list_fleets(self, project: str) -> list[Fleet]:
         return [

@@ -17,7 +17,7 @@ from rich.console import Console
 from rich.table import Table
 
 from dstack_tpu.core.errors import ClientError, DstackTPUError
-from dstack_tpu.utils.common import pretty_date
+from dstack_tpu.utils.common import parse_dt, pretty_date
 from dstack_tpu.version import __version__
 
 console = Console()
@@ -634,14 +634,57 @@ def metrics(run_name, project) -> None:
     console.print(t)
 
 
+def _format_duration(s) -> str:
+    if s is None:
+        return "-"  # terminal event of a finished run: nothing accrues
+    if s >= 60:
+        return f"{int(s // 60)}m{s % 60:04.1f}s"
+    return f"{s:.1f}s"
+
+
+def render_timeline_table(tl: dict) -> Table:
+    """run_events timeline → rich table (separate from the command so
+    tests can assert the rendering without a server)."""
+    t = Table(title=f"{tl['run_name']} · {tl['status']}")
+    t.add_column("PHASE")
+    t.add_column("AT", justify="right")
+    t.add_column("T+", justify="right")
+    t.add_column("DURATION", justify="right")
+    for ev in tl["events"]:
+        label = ev["event"] + (" (job)" if ev.get("job_id") else "")
+        if ev.get("details"):
+            label += f" [{ev['details']}]"
+        t.add_row(
+            label,
+            pretty_date(parse_dt(ev["timestamp"])),
+            f"+{_format_duration(ev['elapsed_s'])}",
+            _format_duration(ev["duration_s"]),
+        )
+    if tl.get("total_s") is not None:
+        t.add_row("total", "", "", _format_duration(tl["total_s"]))
+    return t
+
+
 @cli.command()
 @click.argument("run_name")
 @click.option("--project", default=None)
-@click.pass_context
-def stats(ctx, run_name, project) -> None:
-    """Deprecated alias for `dtpu metrics` (reference `dstack stats`)."""
-    console.print("[yellow]`dtpu stats` is deprecated in favor of `dtpu metrics`[/yellow]")
-    ctx.invoke(metrics, run_name=run_name, project=project)
+def stats(run_name, project) -> None:
+    """Phase-latency timeline of a run: every lifecycle transition
+    (submitted→provisioning→pulling→running→first_step→…) with
+    durations, from the server's run_events table."""
+    client = _client(project)
+    try:
+        run = client.runs.get(run_name)
+        tl = client.api.get_run_timeline(run.id)
+    except DstackTPUError as e:
+        _die(str(e))
+    if not tl["events"]:
+        console.print(
+            f"no lifecycle events recorded for [bold]{run_name}[/bold] "
+            "(run predates the timeline table?)"
+        )
+        return
+    console.print(render_timeline_table(tl))
 
 
 @cli.command()

@@ -1,0 +1,61 @@
+"""JAX profiler capture: start/stop a trace into a directory.
+
+One guarded wrapper shared by the serve server's
+``/debug/profiler/start|stop`` endpoints and any other process that
+wants on-demand traces. Captures are gated behind
+``DTPU_PROFILER_DIR`` (settings flag): unset means the endpoints are
+not even registered — a production server must not expose an
+unauthenticated knob that writes multi-GB traces to disk.
+
+jax is imported lazily so control-plane-only deployments never pay
+the import.
+"""
+
+import os
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_active_dir: Optional[str] = None
+
+
+def profiler_dir() -> Optional[str]:
+    """The configured capture directory, or None when disabled."""
+    return os.environ.get("DTPU_PROFILER_DIR") or None
+
+
+def start_trace(trace_dir: Optional[str] = None) -> dict:
+    """Begin a capture; returns {"tracing": True, "dir": ...}.
+    Raises RuntimeError when a capture is already running."""
+    global _active_dir
+    d = trace_dir or profiler_dir()
+    if not d:
+        raise RuntimeError("profiler disabled (set DTPU_PROFILER_DIR)")
+    import jax
+
+    with _lock:
+        if _active_dir is not None:
+            raise RuntimeError(f"trace already running into {_active_dir}")
+        os.makedirs(d, exist_ok=True)
+        jax.profiler.start_trace(d)
+        _active_dir = d
+    return {"tracing": True, "dir": d}
+
+
+def stop_trace() -> dict:
+    """End the capture; returns {"tracing": False, "dir": ...}.
+    Raises RuntimeError when no capture is running."""
+    global _active_dir
+    import jax
+
+    with _lock:
+        if _active_dir is None:
+            raise RuntimeError("no trace running")
+        d = _active_dir
+        jax.profiler.stop_trace()
+        _active_dir = None
+    return {"tracing": False, "dir": d}
+
+
+def is_tracing() -> bool:
+    return _active_dir is not None

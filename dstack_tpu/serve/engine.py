@@ -1419,6 +1419,7 @@ class InferenceEngine:
         turbo_quiet_s: float = 0.5,
         turbo_depth: int = 1,
         decode_kernel: Optional[str] = None,  # None/"einsum" | "flash"
+        registry=None,  # obs.Registry (default: a fresh serve registry)
     ):
         """``mesh``: serve tensor-parallel over the mesh's ``tp`` axis —
         params shard per the model's logical rules (heads/mlp/vocab over
@@ -1454,6 +1455,15 @@ class InferenceEngine:
         self.max_batch = max_batch
         self.max_seq = max_seq
         self.kv_quant = kv_quant
+        # telemetry at the source: the engine records TTFT/step-latency/
+        # throughput itself so the HTTP server's /metrics and the
+        # offline bench read the SAME histograms (one source of truth
+        # instead of parallel stopwatches)
+        from dstack_tpu.serve.metrics import new_serve_registry
+
+        self.metrics = registry or new_serve_registry()
+        self.metrics.family("dtpu_serve_max_slots").set(max_batch)
+        self._admit_t0: dict[int, float] = {}  # slot → admission time
         self.cache = init_cache(
             config, max_batch, max_seq, mesh=mesh, kv_quant=kv_quant
         )
@@ -1675,6 +1685,11 @@ class InferenceEngine:
             start = reuse_len
             self.prefix_hits += 1
             self.prefix_tokens_reused += reuse_len
+            self.metrics.family("dtpu_serve_prefix_hits_total").inc(1)
+            self.metrics.family("dtpu_serve_prefix_tokens_reused_total").inc(
+                reuse_len
+            )
+        self._admit_t0[slot] = time.perf_counter()
         self._prefilling[slot] = {
             "prompt": list(prompt),
             "tp": len(prompt),
@@ -1799,6 +1814,12 @@ class InferenceEngine:
                 float(lp[0]),
                 list(zip(map(int, tids[0]), map(float, tlps[0]))),
             )
+        t_admit = self._admit_t0.pop(slot, None)
+        if t_admit is not None:
+            self.metrics.family("dtpu_serve_ttft_seconds").observe(
+                time.perf_counter() - t_admit
+            )
+        self.metrics.family("dtpu_serve_tokens_generated_total").inc(1)
         self.active[slot] = True
         self._invalidate_decode_cache()  # activation mutated slot state
         if self.prefix_cache:
@@ -1863,7 +1884,28 @@ class InferenceEngine:
         """Advance every active slot → {slot: [tokens]}. Slots that hit
         EOS/max tokens (or the cache end) deactivate. Greedy batches
         with an n-gram draft take the speculative path and may emit
-        several tokens per call; otherwise each list has one token."""
+        several tokens per call; otherwise each list has one token.
+
+        Wraps the dispatch in the step-latency/TPOT/throughput
+        histograms — recorded here, at the engine, so the HTTP server
+        and the offline bench export identical numbers."""
+        t0 = time.perf_counter()
+        out = self._step_dispatch()
+        if out:
+            dt = time.perf_counter() - t0
+            n_tokens = sum(len(v) for v in out.values())
+            m = self.metrics
+            m.family("dtpu_serve_decode_steps_total").inc(1)
+            m.family("dtpu_serve_decode_step_seconds").observe(dt)
+            m.family("dtpu_serve_tokens_generated_total").inc(n_tokens)
+            if n_tokens and dt > 0:
+                m.family("dtpu_serve_tpot_seconds").observe(dt / n_tokens)
+                m.family("dtpu_serve_decode_tokens_per_sec").observe(
+                    n_tokens / dt
+                )
+        return out
+
+    def _step_dispatch(self) -> dict:
         live = [i for i in range(self.max_batch) if self.active[i]]
         if not live:
             return {}
@@ -2142,7 +2184,38 @@ class InferenceEngine:
         self.active[slot] = False
         self._invalidate_decode_cache()
         self._prefilling.pop(slot, None)
+        self._admit_t0.pop(slot, None)
         self._last_logprobs.pop(slot, None)
+
+    def kv_cache_utilization(self) -> float:
+        """Cached tokens across live (active or prefilling) slots as a
+        fraction of total cache capacity. Called from the /metrics
+        handler on the event loop while the scheduler mutates slot
+        state in a worker thread — snapshot the prefill dict first
+        (list() is atomic under the GIL; iterating the live dict could
+        hit 'changed size during iteration')."""
+        prefilling = list(self._prefilling.values())
+        live_tokens = sum(
+            self.lengths[i]
+            for i in range(self.max_batch)
+            if self.active[i]
+        ) + sum(st["next"] for st in prefilling)
+        return live_tokens / float(self.max_batch * self.max_seq)
+
+    def update_state_gauges(self) -> None:
+        """Refresh the engine-state gauges (called at scrape time — a
+        gauge that only changes when requests move needs no per-step
+        writes)."""
+        active = sum(1 for a in self.active if a)
+        m = self.metrics
+        m.family("dtpu_serve_active_slots").set(active)
+        m.family("dtpu_serve_max_slots").set(self.max_batch)
+        m.family("dtpu_serve_batch_occupancy_ratio").set(
+            active / float(self.max_batch)
+        )
+        m.family("dtpu_serve_kv_cache_utilization_ratio").set(
+            round(self.kv_cache_utilization(), 6)
+        )
 
     def generate(self, prompt: list[int], gen: GenParams) -> list[int]:
         """Convenience single-prompt generation (tests, CLI)."""

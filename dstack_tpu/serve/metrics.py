@@ -1,0 +1,91 @@
+"""Serve-engine metric families (obs registry factory).
+
+One construction point for every ``dtpu_serve_*`` series, used by:
+
+- :class:`dstack_tpu.serve.engine.InferenceEngine` — records TTFT,
+  per-step decode latency, TPOT, decode throughput, token counters,
+  and prefix-cache counters at the source (the engine), so the HTTP
+  server and the offline bench (``serve/bench.py``) read ONE set of
+  numbers instead of keeping parallel stopwatches.
+- ``serve/openai_server.py`` — sets the scheduler-level gauges
+  (queue depth, batch occupancy, KV utilization) and serves the
+  rendered page from ``/metrics`` for the shim relay to scrape.
+- ``tools/check_metrics_docs.py`` — enumerates the family names to
+  hold docs/reference/server.md to account.
+
+Import-light on purpose (no jax): the docs checker and unit tests
+instantiate the registry without an accelerator runtime.
+"""
+
+from dstack_tpu.obs import (
+    LATENCY_BUCKETS_S,
+    Registry,
+    SHORT_LATENCY_BUCKETS_S,
+    THROUGHPUT_BUCKETS,
+)
+
+
+def new_serve_registry() -> Registry:
+    """Registry pre-populated with every serve metric family."""
+    r = Registry()
+    # request lifecycle
+    r.counter(
+        "dtpu_serve_requests_total", "Requests admitted to the scheduler"
+    )
+    r.counter(
+        "dtpu_serve_tokens_generated_total", "Tokens sampled across all slots"
+    )
+    r.counter(
+        "dtpu_serve_decode_steps_total", "Engine step() calls"
+    )
+    # latency distributions
+    r.histogram(
+        "dtpu_serve_ttft_seconds",
+        "Slot-admission-to-first-token latency (chunked prefill incl. "
+        "any prefix-cache reuse; excludes scheduler queue wait — add "
+        "dtpu_serve_queue_wait_seconds for the client-observed TTFT)",
+        buckets=LATENCY_BUCKETS_S,
+    )
+    r.histogram(
+        "dtpu_serve_queue_wait_seconds",
+        "Submit-to-slot-admission wait in the scheduler queue (the "
+        "saturation component of client-observed TTFT)",
+        buckets=LATENCY_BUCKETS_S,
+    )
+    r.histogram(
+        "dtpu_serve_decode_step_seconds",
+        "Wall time of one engine step (a turbo macro-step counts once)",
+        buckets=LATENCY_BUCKETS_S,
+    )
+    r.histogram(
+        "dtpu_serve_tpot_seconds",
+        "Time per output token: step wall time / tokens emitted",
+        buckets=SHORT_LATENCY_BUCKETS_S,
+    )
+    r.histogram(
+        "dtpu_serve_decode_tokens_per_sec",
+        "Per-step decode throughput across all active slots",
+        buckets=THROUGHPUT_BUCKETS,
+    )
+    # engine/scheduler state gauges
+    r.gauge("dtpu_serve_queue_depth", "Requests waiting for a slot")
+    r.gauge("dtpu_serve_active_slots", "Slots currently decoding")
+    r.gauge("dtpu_serve_max_slots", "Configured slot count (max_batch)")
+    r.gauge(
+        "dtpu_serve_batch_occupancy_ratio",
+        "active_slots / max_slots (continuous-batching fill)",
+    )
+    r.gauge(
+        "dtpu_serve_kv_cache_utilization_ratio",
+        "Cached tokens across live slots / (max_batch * max_seq)",
+    )
+    # prefix cache
+    r.counter(
+        "dtpu_serve_prefix_hits_total",
+        "Requests that reused a cached chunk-aligned prompt prefix",
+    )
+    r.counter(
+        "dtpu_serve_prefix_tokens_reused_total",
+        "Prompt tokens skipped via prefix-cache reuse",
+    )
+    return r

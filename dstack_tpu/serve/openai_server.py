@@ -35,6 +35,7 @@ class _Request:
     def __init__(self, prompt_ids: list[int], gen: GenParams):
         self.prompt_ids = prompt_ids
         self.gen = gen
+        self.submitted_at: Optional[float] = None  # set by Scheduler.submit
         self.queue: asyncio.Queue = asyncio.Queue()  # token ids, then None
         self.error: Optional[str] = None
         self.finish_reason: Optional[str] = None
@@ -56,12 +57,9 @@ class Scheduler:
         self.by_slot: dict[int, _Request] = {}
         self.by_prefill: dict[int, _Request] = {}  # chunked prefills in flight
         self._task: Optional[asyncio.Task] = None
-        # serving counters for /metrics (scraped by the shim relay →
-        # server prometheus plane like any other service)
-        self.requests_total = 0
-        self.tokens_generated_total = 0
-        self.decode_steps_total = 0
-        self.decode_seconds_total = 0.0
+        # serving metrics live in the ENGINE's obs registry (one source
+        # of truth shared with serve/bench.py); /metrics renders the
+        # registry for the shim relay → server prometheus plane.
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
@@ -71,7 +69,8 @@ class Scheduler:
             self._task.cancel()
 
     async def submit(self, req: _Request) -> None:
-        self.requests_total += 1
+        req.submitted_at = time.perf_counter()
+        self.engine.metrics.family("dtpu_serve_requests_total").inc(1)
         await self.pending.put(req)
 
     def cancel(self, req: _Request) -> None:
@@ -111,7 +110,6 @@ class Scheduler:
             if entry is not None:
                 req.logprob_entries.append(entry)
         if first != req.gen.eos_id:
-            self.tokens_generated_total += 1
             req.queue.put_nowait(first)
             if self._hit_stop(req, first):
                 self.engine.release(slot)
@@ -159,6 +157,12 @@ class Scheduler:
                 req.error = str(e)
                 req.queue.put_nowait(None)
                 continue
+            if req.submitted_at is not None:
+                # the saturation half of client-observed TTFT: the
+                # engine's dtpu_serve_ttft_seconds starts HERE
+                self.engine.metrics.family(
+                    "dtpu_serve_queue_wait_seconds"
+                ).observe(time.perf_counter() - req.submitted_at)
             self.by_prefill[slot] = req
 
         # ONE prefill chunk per tick: decode steps for running slots
@@ -197,10 +201,7 @@ class Scheduler:
             req = await self.pending.get()
             await self.pending.put(req)
             return
-        t0 = time.perf_counter()
         out = await asyncio.to_thread(self.engine.step)
-        self.decode_steps_total += 1
-        self.decode_seconds_total += time.perf_counter() - t0
         for slot, toks in out.items():
             req = self.by_slot.get(slot)
             if req is None:
@@ -213,7 +214,6 @@ class Scheduler:
                     entry = self.engine.take_logprobs(slot)
                     if entry is not None:
                         req.logprob_entries.append(entry)
-                self.tokens_generated_total += 1
                 req.queue.put_nowait(tok)
                 if self._hit_stop(req, tok):
                     self.engine.release(slot)
@@ -532,34 +532,15 @@ def build_app(
         )
 
     async def metrics(request):
-        """Prometheus text: the shim's metrics relay scrapes this like
-        any service and the server's prometheus plane re-exports it."""
+        """Prometheus text from the engine's obs registry (TTFT/TPOT/
+        throughput histograms, queue/batch/KV gauges): the shim's
+        metrics relay scrapes this like any service and the server's
+        prometheus plane re-exports it."""
         e = sched.engine
-        active = sum(1 for a in e.active if a)
-        lines = [
-            "# TYPE dstack_serve_requests_total counter",
-            f"dstack_serve_requests_total {sched.requests_total}",
-            "# TYPE dstack_serve_tokens_generated_total counter",
-            f"dstack_serve_tokens_generated_total {sched.tokens_generated_total}",
-            "# TYPE dstack_serve_decode_steps_total counter",
-            f"dstack_serve_decode_steps_total {sched.decode_steps_total}",
-            "# TYPE dstack_serve_decode_seconds_total counter",
-            f"dstack_serve_decode_seconds_total {sched.decode_seconds_total:.6f}",
-            "# TYPE dstack_serve_active_slots gauge",
-            f"dstack_serve_active_slots {active}",
-            "# TYPE dstack_serve_max_slots gauge",
-            f"dstack_serve_max_slots {e.max_batch}",
-            "# TYPE dstack_serve_queue_depth gauge",
-            f"dstack_serve_queue_depth {sched.pending.qsize()}",
-            "# TYPE dstack_serve_prefix_hits_total counter",
-            f"dstack_serve_prefix_hits_total {getattr(e, 'prefix_hits', 0)}",
-            "# TYPE dstack_serve_prefix_tokens_reused_total counter",
-            "dstack_serve_prefix_tokens_reused_total "
-            f"{getattr(e, 'prefix_tokens_reused', 0)}",
-        ]
+        e.update_state_gauges()
+        e.metrics.family("dtpu_serve_queue_depth").set(sched.pending.qsize())
         return web.Response(
-            text="\n".join(lines) + "\n",
-            content_type="text/plain",
+            text=e.metrics.render(), content_type="text/plain"
         )
 
     import dataclasses as _dc
@@ -1006,6 +987,27 @@ def build_app(
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
+
+    from dstack_tpu.obs import profiling as _profiling
+
+    if _profiling.profiler_dir():
+        # on-demand JAX profiler capture, registered ONLY when
+        # DTPU_PROFILER_DIR is set (an always-on unauthenticated knob
+        # that writes multi-GB traces would be a production footgun)
+        async def profiler_start(request):
+            try:
+                return web.json_response(_profiling.start_trace())
+            except RuntimeError as e:
+                return web.json_response({"detail": str(e)}, status=409)
+
+        async def profiler_stop(request):
+            try:
+                return web.json_response(_profiling.stop_trace())
+            except RuntimeError as e:
+                return web.json_response({"detail": str(e)}, status=409)
+
+        app.router.add_post("/debug/profiler/start", profiler_start)
+        app.router.add_post("/debug/profiler/stop", profiler_stop)
     return app
 
 

@@ -91,6 +91,7 @@ async def _process(db: Database, job_id: str) -> None:
             JobStatus.TERMINATING,
             termination_reason=JobTerminationReason.EXECUTOR_ERROR,
             termination_reason_message=str(e)[:500],
+            run_id=job_row["run_id"],
         )
 
 
@@ -144,6 +145,7 @@ async def _handle_unreachable(db: Database, job_row: dict, message: str) -> None
             JobStatus.TERMINATING,
             termination_reason=reason,
             termination_reason_message=message[:500],
+            run_id=job_row["run_id"],
         )
     else:
         await db.update_by_id(
@@ -227,6 +229,7 @@ async def _interruption_notice(db: Database, job_row: dict) -> bool:
         JobStatus.TERMINATING,
         termination_reason=JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
         termination_reason_message=notice[:500],
+        run_id=job_row["run_id"],
     )
     logger.info(
         "job %s interrupted on host notice: %s", job_row["id"], notice
@@ -349,6 +352,7 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
                     termination_reason_message=(
                         f"volume {m.name} is gone or has no provisioned disk"
                     ),
+                    run_id=job_row["run_id"],
                 )
                 return
             mount_dir = f"/mnt/disks/{m.name}"
@@ -388,6 +392,7 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
                 JobStatus.TERMINATING,
                 termination_reason=JobTerminationReason.CREATING_CONTAINER_ERROR,
                 termination_reason_message=f"registry_auth: {e}"[:500],
+                run_id=job_row["run_id"],
             )
             return
         task_req = agent_schemas.TaskSubmitRequest(
@@ -418,6 +423,11 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
             "job_runtime_data": dumps(jrd),
             "last_processed_at": now_utc().isoformat(),
         },
+    )
+    from dstack_tpu.server.services.run_events import record_run_event
+
+    await record_run_event(
+        db, job_row["run_id"], JobStatus.PULLING.value, job_id=job_row["id"]
     )
     logger.info("job %s: task submitted to shim", job_spec.job_name)
 
@@ -450,6 +460,7 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
             JobStatus.TERMINATING,
             termination_reason=JobTerminationReason.CREATING_CONTAINER_ERROR,
             termination_reason_message=info.termination_message,
+            run_id=job_row["run_id"],
         )
         return
     if info.status != agent_schemas.TaskStatus.RUNNING:
@@ -516,6 +527,7 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
             termination_reason_message=(
                 f"secrets: {'; '.join(problems)}"[:500]
             ),
+            run_id=job_row["run_id"],
         )
         return
     repo_data = dict(run_spec.repo_data or {})
@@ -568,6 +580,11 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
             "status": JobStatus.RUNNING.value,
             "last_processed_at": now_utc().isoformat(),
         },
+    )
+    from dstack_tpu.server.services.run_events import record_run_event
+
+    await record_run_event(
+        db, job_row["run_id"], JobStatus.RUNNING.value, job_id=job_row["id"]
     )
     logger.info("job %s: running", job_spec.job_name)
     await _register_on_gateway(db, job_row, job_spec, jpd)
@@ -727,6 +744,34 @@ async def _process_running(db: Database, job_row: dict, jpd: JobProvisioningData
             if t is not None:
                 jrd["first_step_at"] = t
                 jrd.pop("marker_tail", None)
+                # timeline terminus: the marker's own timestamp, not
+                # the scrape time (log pulls lag by a poll interval) —
+                # clamped to the run's latest event so a marker that
+                # fired inside the RUNNING-observation poll lag can't
+                # sort before 'running' in the ORDER BY timestamp view
+                from datetime import datetime, timezone
+
+                from dstack_tpu.server.services.run_events import (
+                    record_run_event,
+                )
+
+                marker_ts = datetime.fromtimestamp(
+                    t, timezone.utc
+                ).isoformat()
+                last_ev = await db.fetchone(
+                    "SELECT timestamp FROM run_events WHERE run_id = ? "
+                    "ORDER BY timestamp DESC, id DESC LIMIT 1",
+                    (job_row["run_id"],),
+                )
+                if last_ev is not None:
+                    marker_ts = max(marker_ts, last_ev["timestamp"])
+                await record_run_event(
+                    db,
+                    job_row["run_id"],
+                    "first_step",
+                    job_id=job_row["id"],
+                    timestamp=marker_ts,
+                )
             else:
                 jrd["marker_tail"] = jrd_tail
     jrd["pull_cursor"] = max(cursor, resp.last_updated)

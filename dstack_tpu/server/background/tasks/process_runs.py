@@ -111,6 +111,12 @@ async def _process(db: Database, run_id: str) -> None:
         if reason is not None:
             fields["termination_reason"] = reason.value
         await db.update_by_id("runs", run_id, fields)
+        from dstack_tpu.server.services.run_events import record_run_event
+
+        await record_run_event(
+            db, run_id, new_status.value,
+            details=reason.value if reason else None,
+        )
         logger.info(
             "run %s: %s -> %s", run_row["run_name"], status.value, new_status.value
         )
@@ -125,6 +131,7 @@ async def _process(db: Database, run_id: str) -> None:
                         r["id"],
                         JobStatus.TERMINATING,
                         termination_reason=JobTerminationReason.TERMINATED_BY_SERVER,
+                        run_id=run_id,
                     )
     else:
         await _touch(db, run_id)
@@ -223,6 +230,7 @@ async def _process_service_run(db: Database, run_row: dict, job_rows: list[dict]
                 row["id"],
                 JobStatus.TERMINATING,
                 termination_reason=JobTerminationReason.SCALED_DOWN,
+                run_id=run_row["id"],
             )
 
     # aggregate status: RUNNING if any replica serves
@@ -239,6 +247,9 @@ async def _process_service_run(db: Database, run_row: dict, job_rows: list[dict]
             run_row["id"],
             {"status": new_status.value, "last_processed_at": now_utc().isoformat()},
         )
+        from dstack_tpu.server.services.run_events import record_run_event
+
+        await record_run_event(db, run_row["id"], new_status.value)
         logger.info(
             "run %s: %s -> %s", run_row["run_name"], status.value, new_status.value
         )
@@ -299,6 +310,11 @@ async def _finish_if_jobs_done(db: Database, run_row: dict, job_rows: list[dict]
         "runs",
         run_row["id"],
         {"status": final.value, "last_processed_at": now_utc().isoformat()},
+    )
+    from dstack_tpu.server.services.run_events import record_run_event
+
+    await record_run_event(
+        db, run_row["id"], final.value, details=reason.value
     )
     logger.info("run %s: %s", run_row["run_name"], final.value)
 

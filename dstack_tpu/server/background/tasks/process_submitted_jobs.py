@@ -575,6 +575,12 @@ async def _assign(
             "last_processed_at": now_utc().isoformat(),
         },
     )
+    from dstack_tpu.server.services.run_events import record_run_event
+
+    await record_run_event(
+        db, job_row["run_id"], JobStatus.PROVISIONING.value,
+        job_id=job_row["id"],
+    )
 
 
 async def _fail_no_capacity(db: Database, job_row: dict, message: str) -> None:
@@ -593,4 +599,5 @@ async def _fail(
         JobStatus.TERMINATING,
         termination_reason=reason,
         termination_reason_message=message,
+        run_id=job_row["run_id"],
     )

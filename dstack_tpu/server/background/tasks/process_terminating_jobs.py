@@ -109,7 +109,8 @@ async def _process(db: Database, job_id: str) -> None:
     )
     final = reason.to_job_status()
     await jobs_service.update_job_status(
-        db, job_row["id"], final, termination_reason=reason
+        db, job_row["id"], final, termination_reason=reason,
+        run_id=job_row["run_id"],
     )
     logger.info("job %s: %s (%s)", job_row["job_name"], final.value, reason.value)
 

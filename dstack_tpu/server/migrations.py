@@ -239,4 +239,22 @@ ALTER TABLE projects ADD COLUMN ssh_private_key TEXT;
 ALTER TABLE projects ADD COLUMN ssh_public_key TEXT;
 """,
     ),
+    (
+        # run lifecycle timeline: every run/job state transition as an
+        # append-only event row, rendered by /api/runs/{id}/timeline
+        # and `dtpu stats` as the submitted→provisioning→pulling→
+        # running→first_step phase-latency breakdown
+        "0003_run_events",
+        """
+CREATE TABLE run_events (
+    id TEXT PRIMARY KEY,
+    run_id TEXT NOT NULL REFERENCES runs(id),
+    job_id TEXT,
+    event TEXT NOT NULL,
+    timestamp TEXT NOT NULL,
+    details TEXT
+);
+CREATE INDEX idx_run_events_run ON run_events(run_id, timestamp);
+""",
+    ),
 ]

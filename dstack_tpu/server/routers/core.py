@@ -30,6 +30,7 @@ server_router = Router("/api/server")
 users_router = Router("/api/users")
 projects_router = Router("/api/projects")
 project_router = Router("/api/project/{project_name}")
+runs_router = Router("/api/runs")
 root_router = Router("")
 
 
@@ -336,6 +337,24 @@ async def stop_runs(ctx: RequestContext, body: s.StopRunsRequest):
 @project_router.post("/runs/delete")
 async def delete_runs(ctx: RequestContext, body: s.DeleteRunsRequest):
     await runs_service.delete_runs(ctx.state["db"], ctx.project, body.runs_names)
+
+
+@runs_router.get("/{run_id}/timeline")
+async def run_timeline(ctx: RequestContext):
+    """Per-run phase-latency timeline: ordered lifecycle transitions
+    (submitted→provisioning→pulling→running→first_step→…) with
+    durations, from the run_events table. Addressed by run id (ids are
+    globally unique; project access is checked against the run's own
+    project)."""
+    from dstack_tpu.server.services import run_events as run_events_service
+
+    db = ctx.state["db"]
+    run_row = await db.get_by_id("runs", ctx.param("run_id"))
+    if run_row is None:
+        raise ResourceNotExistsError(f"run {ctx.param('run_id')} not found")
+    project_row = await db.get_by_id("projects", run_row["project_id"])
+    await projects_service.check_project_access(db, project_row, ctx.user)
+    return await run_events_service.get_run_timeline(db, run_row)
 
 
 # ---- logs ----
@@ -883,5 +902,6 @@ ALL_ROUTERS = [
     users_router,
     projects_router,
     project_router,
+    runs_router,
     root_router,
 ]

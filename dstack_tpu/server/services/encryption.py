@@ -9,7 +9,10 @@ import base64
 import hashlib
 from typing import Optional
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:  # gated: the identity scheme needs no crypto lib at all
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:
+    AESGCM = None
 
 from dstack_tpu.server import settings
 
@@ -28,6 +31,11 @@ def encrypt(plaintext: Optional[str]) -> Optional[str]:
     keys = _aes_keys()
     if not keys:
         return _PREFIX_IDENTITY + plaintext
+    if AESGCM is None:
+        raise RuntimeError(
+            "DTPU_ENCRYPTION_KEYS set but the `cryptography` package is "
+            "not installed"
+        )
     aes = AESGCM(keys[0])
     import os
 
@@ -42,6 +50,11 @@ def decrypt(stored: Optional[str]) -> Optional[str]:
     if stored.startswith(_PREFIX_IDENTITY):
         return stored[len(_PREFIX_IDENTITY):]
     if stored.startswith(_PREFIX_AES):
+        if AESGCM is None:
+            raise RuntimeError(
+                "AES-encrypted row but the `cryptography` package is "
+                "not installed"
+            )
         blob = base64.b64decode(stored[len(_PREFIX_AES):])
         nonce, ct = blob[:12], blob[12:]
         last = None

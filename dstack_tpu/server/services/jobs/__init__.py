@@ -102,6 +102,7 @@ async def update_job_status(
     termination_reason: Optional[JobTerminationReason] = None,
     termination_reason_message: Optional[str] = None,
     exit_status: Optional[int] = None,
+    run_id: Optional[str] = None,  # skips the run_id lookup when known
 ) -> None:
     fields: dict = {
         "status": status.value,
@@ -116,6 +117,22 @@ async def update_job_status(
     if status.is_finished():
         fields["finished_at"] = now_utc().isoformat()
     await db.update_by_id("jobs", job_id, fields)
+    # lifecycle timeline: one event per job transition (run-level
+    # aggregation events are recorded by process_runs)
+    from dstack_tpu.server.services.run_events import record_run_event
+
+    if run_id is None:
+        row = await db.fetchone(
+            "SELECT run_id FROM jobs WHERE id = ?", (job_id,)
+        )
+        run_id = row["run_id"] if row is not None else None
+    if run_id is not None:
+        await record_run_event(
+            db, run_id, status.value, job_id=job_id,
+            details=(
+                termination_reason.value if termination_reason else None
+            ),
+        )
 
 
 async def get_unfinished_job_rows(db: Database, run_id: str) -> list[dict]:

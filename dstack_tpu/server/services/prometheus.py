@@ -12,14 +12,11 @@ from datetime import datetime
 from typing import Iterable, Optional
 
 from dstack_tpu.core.models.runs import JobStatus, RunStatus
+from dstack_tpu.obs import escape_label as _esc
 from dstack_tpu.server.db import Database, loads
 
 
 RELAY_STALENESS_SECONDS = 60.0  # a few 10s scrape intervals
-
-
-def _esc(v) -> str:
-    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", " ")
 
 
 def _labels(d: dict) -> str:
@@ -56,12 +53,56 @@ async def render_metrics(db: Database) -> str:
 
     await _render_instances(db, w, projects)
     await _render_runs(db, w, projects)
+    await _render_run_phases(db, w, projects)
     await _render_jobs(db, w, projects)
-    # server-side HTTP latency/counters from the tracing middleware
+    # server-side HTTP latency histograms/counters from the tracing
+    # middleware's obs registry
     from dstack_tpu.server.tracing import get_request_stats
 
     w.raw(get_request_stats().render_prometheus())
     return w.render()
+
+
+async def _render_run_phases(db: Database, w: _Writer, projects: dict) -> None:
+    """Seconds each active run has spent in its CURRENT phase (from the
+    run_events timeline) — the scrape-side view of `dtpu stats`: a run
+    stuck provisioning for 20 minutes shows as one growing gauge."""
+    from dstack_tpu.utils.common import parse_dt
+
+    rows = await db.fetchall(
+        "SELECT id, project_id, run_name, status FROM runs "
+        "WHERE deleted = 0"
+    )
+    active = [r for r in rows if not RunStatus(r["status"]).is_finished()]
+    if not active:
+        return
+    # ONE query for every active run's events (a per-run lookup would
+    # be ~150 sequential queries per scrape at the capacity target);
+    # ordered ascending so the last row seen per run is its latest
+    placeholders = ",".join("?" for _ in active)
+    events = await db.fetchall(
+        f"SELECT run_id, event, timestamp FROM run_events "
+        f"WHERE run_id IN ({placeholders}) ORDER BY timestamp, id",
+        tuple(r["id"] for r in active),
+    )
+    last_by_run = {e["run_id"]: e for e in events}
+    now = datetime.now().astimezone()
+    for r in active:
+        ev = last_by_run.get(r["id"])
+        if ev is None:
+            continue
+        age = (now - parse_dt(ev["timestamp"])).total_seconds()
+        w.sample(
+            "dtpu_run_current_phase_seconds",
+            "gauge",
+            "Seconds the run has been in its current lifecycle phase",
+            {
+                "dtpu_project_name": projects.get(r["project_id"], ""),
+                "dtpu_run_name": r["run_name"],
+                "dtpu_run_phase": ev["event"],
+            },
+            round(max(0.0, age), 3),
+        )
 
 
 async def _render_instances(db: Database, w: _Writer, projects: dict) -> None:

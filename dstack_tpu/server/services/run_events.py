@@ -1,0 +1,94 @@
+"""Run lifecycle timeline: append-only state-transition events.
+
+Every run/job status change records one ``run_events`` row (the
+reconcilers and services call :func:`record_run_event` next to their
+status writes). The timeline view orders them and derives per-phase
+durations — submitted→provisioning→pulling→running→first_step — the
+breakdown behind ``GET /api/runs/{id}/timeline`` and ``dtpu stats``.
+
+Recording is deliberately fire-and-forget: a telemetry insert must
+never fail a reconciler tick or a submit, so errors are logged and
+swallowed.
+"""
+
+from typing import Optional
+
+from dstack_tpu.core.models.runs import RunStatus, new_uuid, now_utc
+from dstack_tpu.server.db import Database
+from dstack_tpu.utils.common import parse_dt
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.run_events")
+
+
+async def record_run_event(
+    db: Database,
+    run_id: str,
+    event: str,
+    job_id: Optional[str] = None,
+    timestamp: Optional[str] = None,
+    details: Optional[str] = None,
+) -> None:
+    """Append one lifecycle event; never raises."""
+    try:
+        await db.insert(
+            "run_events",
+            {
+                "id": new_uuid(),
+                "run_id": run_id,
+                "job_id": job_id,
+                "event": event,
+                "timestamp": timestamp or now_utc().isoformat(),
+                "details": details,
+            },
+        )
+    except Exception:
+        logger.exception("recording run event %s for %s failed", event, run_id)
+
+
+async def get_run_timeline(db: Database, run_row: dict) -> dict:
+    """Ordered phase transitions with durations for one run.
+
+    Each event carries ``elapsed_s`` (since submission) and
+    ``duration_s`` (until the next event; the last event's duration
+    runs to now for active runs, and is null for finished ones — the
+    terminal state has no "phase time still accruing" meaning).
+    """
+    rows = await db.fetchall(
+        "SELECT * FROM run_events WHERE run_id = ? ORDER BY timestamp, id",
+        (run_row["id"],),
+    )
+    submitted = parse_dt(run_row["submitted_at"])
+    now = now_utc()
+    finished = RunStatus(run_row["status"]).is_finished()
+    events = []
+    times = [parse_dt(r["timestamp"]) for r in rows]
+    for i, r in enumerate(rows):
+        t = times[i]
+        nxt = times[i + 1] if i + 1 < len(rows) else (None if finished else now)
+        events.append(
+            {
+                "event": r["event"],
+                "job_id": r.get("job_id"),
+                "timestamp": r["timestamp"],
+                "elapsed_s": round(max(0.0, (t - submitted).total_seconds()), 3),
+                "duration_s": (
+                    round(max(0.0, (nxt - t).total_seconds()), 3)
+                    if nxt is not None
+                    else None
+                ),
+                "details": r.get("details"),
+            }
+        )
+    total = None
+    if times:
+        end = times[-1] if finished else now
+        total = round(max(0.0, (end - submitted).total_seconds()), 3)
+    return {
+        "run_id": run_row["id"],
+        "run_name": run_row["run_name"],
+        "status": run_row["status"],
+        "submitted_at": run_row["submitted_at"],
+        "events": events,
+        "total_s": total,
+    }

@@ -365,6 +365,12 @@ async def submit_run(
     for specs in replica_specs:
         for spec in specs:
             await jobs_service.create_job_row(db, run_row, spec)
+    from dstack_tpu.server.services.run_events import record_run_event
+
+    await record_run_event(
+        db, run_row["id"], RunStatus.SUBMITTED.value,
+        timestamp=run_row["submitted_at"],
+    )
     logger.info(
         "submitted run %s (%d replicas)",
         run_spec.run_name,
@@ -437,6 +443,11 @@ async def stop_runs(
                 "last_processed_at": now_utc().isoformat(),
             },
         )
+        from dstack_tpu.server.services.run_events import record_run_event
+
+        await record_run_event(
+            db, row["id"], RunStatus.TERMINATING.value, details=reason.value
+        )
         # flag unfinished jobs for the terminating reconciler
         job_reason = (
             JobTerminationReason.ABORTED_BY_USER
@@ -449,6 +460,7 @@ async def stop_runs(
                 job_row["id"],
                 JobStatus.TERMINATING,
                 termination_reason=job_reason,
+                run_id=row["id"],
             )
 
 
@@ -460,3 +472,8 @@ async def delete_runs(db: Database, project_row: dict, run_names: list[str]) -> 
         if not RunStatus(row["status"]).is_finished():
             raise ClientError(f"run {name} is not finished; stop it first")
         await db.execute("UPDATE runs SET deleted = 1 WHERE id = ?", (row["id"],))
+        # timeline rows are only reachable through the run: drop them
+        # with it so run_events doesn't grow without bound
+        await db.execute(
+            "DELETE FROM run_events WHERE run_id = ?", (row["id"],)
+        )

@@ -71,4 +71,10 @@ SENTRY_PROFILES_SAMPLE_RATE = float(
 DEBUG_REQUESTS = os.getenv("DTPU_DEBUG_REQUESTS", "") in ("1", "true", "yes")
 SLOW_REQUEST_SECONDS = float(os.getenv("DTPU_SLOW_REQUEST_SECONDS", "2.0"))
 
+# On-demand JAX profiler captures (obs/profiling.py): unset disables
+# the /debug/profiler endpoints entirely (serve/openai_server.py reads
+# the env var directly so the serving process doesn't import server
+# settings; this mirror exists for documentation/introspection).
+PROFILER_DIR = os.getenv("DTPU_PROFILER_DIR") or None
+
 SERVER_CONFIG_PATH = SERVER_DIR_PATH / "config.yml"

@@ -4,17 +4,20 @@ Parity: reference server/app.py:68-76 (optional Sentry SDK init with
 error + performance tracing) and :214-226 (request-latency debug
 middleware). Sentry is gated on the SDK being importable and
 ``DTPU_SENTRY_DSN`` being set — zero overhead otherwise. The latency
-middleware always records per-route timing into an in-process registry
-that ``/metrics`` renders as ``dtpu_http_request_*`` series (a step past
-the reference, whose latency numbers only reach debug logs).
+middleware always records per-route timing into an in-process ``obs``
+registry that ``/metrics`` renders as ``dtpu_http_*`` series: a
+request counter plus a log-bucketed latency HISTOGRAM (a step past the
+reference, whose latency numbers only reach debug logs — and past our
+own earlier count/sum counters, which could not answer "what is p99").
 """
 
+import asyncio
 import time
-from collections import defaultdict
 from typing import Optional
 
 from aiohttp import web
 
+from dstack_tpu.obs import LATENCY_BUCKETS_S, Registry
 from dstack_tpu.server import settings
 from dstack_tpu.utils.logging import get_logger
 
@@ -51,46 +54,45 @@ def capture_exception(exc: BaseException) -> None:
         pass
 
 
-def _esc_label(v: str) -> str:
-    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
-
-
 class RequestStats:
-    """Per-route request counters/latency for /metrics. Routes are the
-    matched route *templates* (bounded set); unmatched requests collapse
-    to one sentinel so arbitrary 404 paths can't grow the registry."""
+    """Per-route request counters + latency histograms for /metrics.
+    Routes are the matched route *templates* (bounded set); unmatched
+    requests collapse to one sentinel so arbitrary 404 paths can't grow
+    the registry — the obs cardinality cap backstops even that."""
 
     def __init__(self) -> None:
-        self.count: dict[tuple[str, str, int], int] = defaultdict(int)
-        self.total_seconds: dict[tuple[str, str, int], float] = defaultdict(float)
+        self.registry = Registry()
+        self.requests = self.registry.counter(
+            "dtpu_http_requests_total",
+            "HTTP requests served",
+            ("method", "route", "status"),
+        )
+        # status is NOT a histogram label: latency distributions are
+        # per-route questions, and a status label would multiply the
+        # bucket series count by the distinct statuses seen
+        self.latency = self.registry.histogram(
+            "dtpu_http_request_duration_seconds",
+            "HTTP request latency",
+            ("method", "route"),
+            buckets=LATENCY_BUCKETS_S,
+        )
 
     def record(self, method: str, route: str, status: int, seconds: float) -> None:
-        key = (method, route, status)
-        self.count[key] += 1
-        self.total_seconds[key] += seconds
+        self.requests.inc(1, method, route, str(status))
+        self.latency.observe(seconds, method, route)
+
+    @property
+    def count(self) -> dict:
+        """{(method, route, status): n} view over the counter (legacy
+        shape kept for tests/introspection)."""
+        return {
+            (m, r, int(s)): int(n)
+            for (m, r, s), n in self.requests._series.items()
+            if s.isdigit()
+        }
 
     def render_prometheus(self) -> str:
-        lines = [
-            "# HELP dtpu_http_requests_total HTTP requests served",
-            "# TYPE dtpu_http_requests_total counter",
-        ]
-        for (method, route, status), n in sorted(self.count.items()):
-            labels = (
-                f'method="{_esc_label(method)}",route="{_esc_label(route)}",'
-                f'status="{status}"'
-            )
-            lines.append(f"dtpu_http_requests_total{{{labels}}} {n}")
-        lines += [
-            "# HELP dtpu_http_request_seconds_total Cumulative request latency",
-            "# TYPE dtpu_http_request_seconds_total counter",
-        ]
-        for (method, route, status), s in sorted(self.total_seconds.items()):
-            labels = (
-                f'method="{_esc_label(method)}",route="{_esc_label(route)}",'
-                f'status="{status}"'
-            )
-            lines.append(f"dtpu_http_request_seconds_total{{{labels}}} {s:.6f}")
-        return "\n".join(lines) + "\n"
+        return self.registry.render()
 
 
 _stats: Optional[RequestStats] = None
@@ -108,8 +110,6 @@ async def tracing_middleware(request: web.Request, handler):
     """Record latency per route; surface slow requests and capture
     unhandled errors (reference app.py:214-226 logs request durations
     under a debug flag; here recording is always on, logging gated)."""
-    import asyncio
-
     start = time.perf_counter()
     status = 500
     try:

@@ -296,6 +296,14 @@ def main(argv=None) -> int:
     tokens_per_step = args.batch * args.seq_len
     first_step_at = None
     t_window = time.perf_counter()
+    # obs hook: step-time/tokens-per-sec/MFU samples into the shared
+    # train registry, fed at the log-window sync points (per-step
+    # syncing would serialize the async dispatch)
+    from dstack_tpu.train.step import make_step_callback
+
+    step_cb = make_step_callback(
+        config, tokens_per_step, args.seq_len, n_chips=n_chips
+    )
 
     # Spot-interruption safety: the shim forwards GCP's preemption
     # notice as SIGTERM with a ~25s grace budget (agent
@@ -365,6 +373,7 @@ def main(argv=None) -> int:
             dt = time.perf_counter() - t_window
             t_window = time.perf_counter()
             tps = tokens_per_step * args.log_every / dt
+            step_cb(dt / args.log_every, steps=args.log_every)
             print(
                 f"step {i + 1}/{args.steps} loss={loss:.4f} "
                 f"tokens/s={tps:,.0f} tokens/s/chip={tps / n_chips:,.0f} "

@@ -405,3 +405,73 @@ def flops_per_token(config: llama.LlamaConfig, seq_len: int) -> float:
     n = config.num_active_params()
     attn = 12 * config.n_layers * config.hidden_size * seq_len  # fwd+bwd qk/av
     return 6.0 * n + attn
+
+
+# ---------------------------------------------------------------------------
+# step telemetry (obs registry hook)
+# ---------------------------------------------------------------------------
+
+
+def new_train_registry():
+    """Registry pre-populated with every train metric family (the
+    serve-side twin lives in serve/metrics.py; tools/
+    check_metrics_docs.py enumerates both against the docs)."""
+    from dstack_tpu.obs import LATENCY_BUCKETS_S, Registry
+
+    r = Registry()
+    r.histogram(
+        "dtpu_train_step_seconds",
+        "Train-step wall time (averaged over the sync window)",
+        buckets=LATENCY_BUCKETS_S,
+    )
+    r.gauge(
+        "dtpu_train_tokens_per_sec", "Training throughput over all chips"
+    )
+    r.gauge(
+        "dtpu_train_mfu",
+        "Model-FLOPs utilization vs the configured per-chip peak",
+    )
+    r.counter("dtpu_train_steps_total", "Optimizer steps completed")
+    r.counter("dtpu_train_tokens_total", "Tokens consumed by training")
+    return r
+
+
+def make_step_callback(
+    config: llama.LlamaConfig,
+    tokens_per_step: int,
+    seq_len: int,
+    peak_flops_per_chip: float = 197e12,  # v5e bf16
+    n_chips: int = 1,
+    registry=None,
+):
+    """Step-telemetry hook → ``cb(dt_seconds, steps=1)``.
+
+    The training loop calls it at its host-sync points (finetune syncs
+    once per log window — per-step syncing would serialize JAX's async
+    dispatch, so ``dt_seconds`` is the window-average step time and
+    ``steps`` the window width). Each call observes step time and
+    refreshes tokens/sec and MFU; an exporter (or the bench) reads the
+    registry. Returns the callback; the registry rides on it as
+    ``cb.registry``."""
+    reg = registry if registry is not None else new_train_registry()
+    fpt = flops_per_token(config, seq_len)
+    step_hist = reg.family("dtpu_train_step_seconds")
+    tps_gauge = reg.family("dtpu_train_tokens_per_sec")
+    mfu_gauge = reg.family("dtpu_train_mfu")
+    steps_ctr = reg.family("dtpu_train_steps_total")
+    tokens_ctr = reg.family("dtpu_train_tokens_total")
+
+    def cb(dt_seconds: float, steps: int = 1) -> dict:
+        dt = max(float(dt_seconds), 1e-9)
+        tps = tokens_per_step / dt
+        mfu = tps * fpt / (peak_flops_per_chip * max(n_chips, 1))
+        for _ in range(steps):
+            step_hist.observe(dt)
+        tps_gauge.set(round(tps, 3))
+        mfu_gauge.set(round(mfu, 6))
+        steps_ctr.inc(steps)
+        tokens_ctr.inc(tokens_per_step * steps)
+        return {"tokens_per_sec": tps, "mfu": mfu, "step_time_s": dt}
+
+    cb.registry = reg
+    return cb

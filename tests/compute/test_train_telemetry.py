@@ -1,0 +1,53 @@
+"""Train-step telemetry hook: registry families, callback math, and
+the finetune wiring point (obs layer of train/step.py)."""
+
+from dstack_tpu.models import llama
+from dstack_tpu.train.step import (
+    flops_per_token,
+    make_step_callback,
+    new_train_registry,
+)
+
+
+class TestTrainRegistry:
+    def test_families_present(self):
+        names = new_train_registry().metric_names()
+        assert "dtpu_train_step_seconds" in names
+        assert "dtpu_train_tokens_per_sec" in names
+        assert "dtpu_train_mfu" in names
+        assert "dtpu_train_steps_total" in names
+        assert "dtpu_train_tokens_total" in names
+
+
+class TestStepCallback:
+    def test_observes_and_computes(self):
+        config = llama.LLAMA_TINY
+        tokens_per_step = 4 * 128
+        cb = make_step_callback(
+            config, tokens_per_step, seq_len=128,
+            peak_flops_per_chip=1e12, n_chips=1,
+        )
+        out = cb(0.5)
+        assert out["tokens_per_sec"] == tokens_per_step / 0.5
+        expected_mfu = (
+            (tokens_per_step / 0.5) * flops_per_token(config, 128) / 1e12
+        )
+        assert abs(out["mfu"] - expected_mfu) < 1e-9
+        reg = cb.registry
+        assert reg.family("dtpu_train_steps_total").value() == 1
+        assert reg.family("dtpu_train_tokens_total").value() == tokens_per_step
+        assert reg.family("dtpu_train_step_seconds").count() == 1
+
+    def test_window_width_scales_counters(self):
+        config = llama.LLAMA_TINY
+        cb = make_step_callback(config, 512, seq_len=128)
+        cb(0.1, steps=10)  # one log window covering 10 steps
+        reg = cb.registry
+        assert reg.family("dtpu_train_steps_total").value() == 10
+        assert reg.family("dtpu_train_tokens_total").value() == 5120
+        assert reg.family("dtpu_train_step_seconds").count() == 10
+        # rendered page exposes the histogram triplet
+        text = reg.render()
+        assert "dtpu_train_step_seconds_bucket" in text
+        assert "dtpu_train_step_seconds_sum" in text
+        assert "dtpu_train_mfu" in text

@@ -1,0 +1,120 @@
+"""obs telemetry core: primitives, escaping, rendering, cardinality."""
+
+import threading
+
+from dstack_tpu.obs import (
+    LATENCY_BUCKETS_S,
+    Registry,
+    escape_label,
+)
+
+
+class TestEscaping:
+    def test_prometheus_label_rules(self):
+        # the ONE correct escaper: backslash doubled, quote escaped,
+        # newline as literal backslash-n (NOT a space — the old
+        # services/prometheus.py behavior lost information)
+        assert escape_label('a"b') == 'a\\"b'
+        assert escape_label("a\\b") == "a\\\\b"
+        assert escape_label("a\nb") == "a\\nb"
+        assert escape_label(123) == "123"
+
+
+class TestCounterGauge:
+    def test_counter_inc_and_render(self):
+        r = Registry()
+        c = r.counter("x_total", "help", ("route",))
+        c.inc(1, "/a")
+        c.inc(2, "/a")
+        text = r.render()
+        assert "# TYPE x_total counter" in text
+        assert 'x_total{route="/a"} 3' in text
+        assert c.value("/a") == 3
+
+    def test_gauge_set(self):
+        r = Registry()
+        g = r.gauge("x_gauge", "help")
+        g.set(0.25)
+        assert "x_gauge 0.25" in r.render()
+
+    def test_reregistration_returns_same_family(self):
+        r = Registry()
+        a = r.counter("dup_total", "h")
+        b = r.counter("dup_total", "h")
+        assert a is b
+
+
+class TestHistogram:
+    def test_buckets_cumulative_sum_count(self):
+        r = Registry()
+        h = r.histogram("lat_seconds", "h", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        text = r.render()
+        assert 'lat_seconds_bucket{le="0.01"} 1' in text
+        assert 'lat_seconds_bucket{le="0.1"} 2' in text
+        assert 'lat_seconds_bucket{le="1"} 3' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 4' in text
+        assert "lat_seconds_count 4" in text
+        assert h.sum() == 5.555
+        assert h.count() == 4
+
+    def test_boundary_value_inclusive(self):
+        # Prometheus le is inclusive: v == bucket lands in that bucket
+        r = Registry()
+        h = r.histogram("b_seconds", "h", buckets=(0.1, 1.0))
+        h.observe(0.1)
+        assert 'b_seconds_bucket{le="0.1"} 1' in r.render()
+
+    def test_quantile_from_samples(self):
+        r = Registry()
+        h = r.histogram("q_seconds", "h", buckets=LATENCY_BUCKETS_S)
+        for v in range(1, 101):
+            h.observe(v / 100.0)
+        assert abs(h.quantile(0.5) - 0.5) < 0.02
+        assert abs(h.quantile(0.99) - 0.99) < 0.02
+        assert r.histogram("empty_seconds", "h").quantile(0.5) is None
+
+    def test_labeled_series(self):
+        r = Registry()
+        h = r.histogram("l_seconds", "h", ("m",), buckets=(1.0,))
+        h.observe(0.5, "GET")
+        h.observe(2.0, "POST")
+        text = r.render()
+        assert 'l_seconds_bucket{m="GET",le="1"} 1' in text
+        assert 'l_seconds_bucket{m="POST",le="1"} 0' in text
+
+
+class TestCardinalityCap:
+    def test_overflow_collapses_to_sentinel(self):
+        r = Registry()
+        c = r.counter("cap_total", "h", ("x",), max_series=3)
+        for i in range(10):
+            c.inc(1, f"v{i}")
+        keys = set(c._series)
+        assert len(keys) == 4  # 3 real + the sentinel
+        assert ("<truncated>",) in keys
+        assert c.value("<truncated>") == 7  # overflow accumulated, not lost
+
+
+class TestThreadSafety:
+    def test_concurrent_observe_and_render(self):
+        r = Registry()
+        h = r.histogram("t_seconds", "h", buckets=(0.5,))
+        errors = []
+
+        def work():
+            try:
+                for _ in range(500):
+                    h.observe(0.1)
+                    r.render()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert h.count() == 2000

@@ -469,28 +469,109 @@ class TestLogprobs:
             await client.close()
 
 
+def _parse_prometheus(text: str) -> dict:
+    """{'name{labels}': value} plus per-family TYPE map — a real parse
+    of the exposition format, not a substring check."""
+    samples: dict = {}
+    types: dict = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3].strip()
+            continue
+        key, value = line.rsplit(None, 1)
+        samples[key] = float(value)
+    return {"samples": samples, "types": types}
+
+
 class TestServeMetrics:
-    async def test_prometheus_counters(self):
+    async def test_prometheus_histograms_and_gauges(self):
         client = await _client()
         try:
             r = await client.post(
                 "/v1/completions",
                 json={"model": "llama-tiny", "prompt": "ab", "max_tokens": 5},
             )
-            n = (await r.json())["usage"]["completion_tokens"]
+            assert (await r.json())["usage"]["completion_tokens"] >= 1
             r = await client.get("/metrics")
             assert r.status == 200
-            text = await r.text()
-            metrics = {
-                line.split()[0]: float(line.split()[1])
-                for line in text.splitlines()
-                if line and not line.startswith("#")
-            }
-            assert metrics["dstack_serve_requests_total"] == 1
-            assert metrics["dstack_serve_tokens_generated_total"] == n
-            assert metrics["dstack_serve_decode_steps_total"] >= 1
-            assert metrics["dstack_serve_max_slots"] == 4
-            assert metrics["dstack_serve_active_slots"] == 0  # finished
+            parsed = _parse_prometheus(await r.text())
+            s, t = parsed["samples"], parsed["types"]
+            # TTFT histogram: bucket/sum/count triplet, one observation
+            assert t["dtpu_serve_ttft_seconds"] == "histogram"
+            assert s["dtpu_serve_ttft_seconds_count"] == 1
+            assert s["dtpu_serve_ttft_seconds_sum"] > 0
+            assert s['dtpu_serve_ttft_seconds_bucket{le="+Inf"}'] == 1
+            # TPOT + step-latency histograms observed at least once
+            assert t["dtpu_serve_tpot_seconds"] == "histogram"
+            assert s["dtpu_serve_tpot_seconds_count"] >= 1
+            assert s["dtpu_serve_decode_step_seconds_count"] >= 1
+            assert s["dtpu_serve_decode_tokens_per_sec_count"] >= 1
+            # cumulative-bucket invariant: counts never decrease with le
+            prefix = 'dtpu_serve_ttft_seconds_bucket{le="'
+            buckets = sorted(
+                (float(k[len(prefix):-2]), v)
+                for k, v in s.items()
+                if k.startswith(prefix) and "+Inf" not in k
+            )
+            vals = [v for _, v in buckets]
+            assert len(vals) > 2 and vals == sorted(vals)
+            # scheduler/engine state gauges
+            assert t["dtpu_serve_queue_depth"] == "gauge"
+            assert s["dtpu_serve_queue_depth"] == 0
+            assert t["dtpu_serve_batch_occupancy_ratio"] == "gauge"
+            assert s["dtpu_serve_batch_occupancy_ratio"] == 0  # finished
+            assert s["dtpu_serve_kv_cache_utilization_ratio"] == 0
+            assert s["dtpu_serve_max_slots"] == 4
+            assert s["dtpu_serve_active_slots"] == 0
+            # counters
+            assert s["dtpu_serve_requests_total"] == 1
+            assert s["dtpu_serve_tokens_generated_total"] >= 1
+            assert s["dtpu_serve_decode_steps_total"] >= 1
+        finally:
+            await client.close()
+
+
+class TestProfilerEndpoints:
+    async def test_gated_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("DTPU_PROFILER_DIR", raising=False)
+        client = await _client()
+        try:
+            r = await client.post("/debug/profiler/start")
+            assert r.status == 404  # not registered without the flag
+        finally:
+            await client.close()
+
+    async def test_start_stop_trace(self, tmp_path, monkeypatch):
+        import os
+
+        from dstack_tpu.obs import profiling
+
+        monkeypatch.setenv("DTPU_PROFILER_DIR", str(tmp_path / "traces"))
+        # a stale capture from another test would 409 the start
+        assert not profiling.is_tracing()
+        client = await _client()
+        try:
+            r = await client.post("/debug/profiler/start")
+            assert r.status == 200
+            d = await r.json()
+            assert d["tracing"] is True
+            # double-start is a 409, not a crash
+            r = await client.post("/debug/profiler/start")
+            assert r.status == 409
+            r = await client.post("/debug/profiler/stop")
+            assert r.status == 200
+            assert (await r.json())["tracing"] is False
+            # the capture directory exists and received trace artifacts
+            trace_dir = tmp_path / "traces"
+            assert trace_dir.exists()
+            assert any(os.scandir(trace_dir))
+            # stop without a running capture is a 409
+            r = await client.post("/debug/profiler/stop")
+            assert r.status == 409
         finally:
             await client.close()
 

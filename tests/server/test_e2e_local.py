@@ -94,6 +94,27 @@ class TestLocalE2E:
             )
             instances = await r.json()
             assert len(instances) >= 1
+
+            # lifecycle timeline: the run driven through the real local
+            # harness produced ordered phase transitions with durations
+            r = await client.get(
+                f"/api/runs/{run['id']}/timeline", headers=_auth("e2e-token")
+            )
+            assert r.status == 200
+            tl = await r.json()
+            events = [e["event"] for e in tl["events"]]
+            assert events[0] == "submitted"
+            # job-level provisioning/pulling/running phases all occurred
+            for phase in ("provisioning", "pulling", "running"):
+                assert phase in events, events
+            assert events[-1] == "done", events  # terminal state last
+            # ordered by time, durations fill the gaps
+            elapsed = [e["elapsed_s"] for e in tl["events"]]
+            assert elapsed == sorted(elapsed)
+            for e in tl["events"][:-1]:
+                assert e["duration_s"] is not None and e["duration_s"] >= 0
+            assert tl["events"][-1]["duration_s"] is None  # finished run
+            assert tl["total_s"] >= 0
         finally:
             await client.close()
 

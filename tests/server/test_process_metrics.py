@@ -1,0 +1,172 @@
+"""Metrics collection loop: retention pruning actually bounds the
+job_metrics_points table, and unreachable runners are skipped without
+aborting the loop (parity: reference process_metrics 10s loop)."""
+
+import contextlib
+
+from dstack_tpu.core.errors import AgentNotReady
+from dstack_tpu.core.models.runs import new_uuid, now_utc
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.background.tasks import process_metrics
+from dstack_tpu.server.db import dumps
+
+
+async def _seed_job(db, name: str) -> str:
+    project = await db.fetchone("SELECT * FROM projects WHERE name = 'main'")
+    user = await db.fetchone("SELECT * FROM users")
+    run_id = new_uuid()
+    await db.insert(
+        "runs",
+        {
+            "id": run_id,
+            "project_id": project["id"],
+            "user_id": user["id"],
+            "run_name": name,
+            "status": "running",
+            "run_spec": dumps({"configuration": {"type": "task"}}),
+            "deleted": 0,
+            "submitted_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    job_id = new_uuid()
+    await db.insert(
+        "jobs",
+        {
+            "id": job_id,
+            "run_id": run_id,
+            "run_name": name,
+            "project_id": project["id"],
+            "job_name": f"{name}-0-0",
+            "job_num": 0,
+            "replica_num": 0,
+            "submission_num": 0,
+            "status": "running",
+            "job_spec": dumps({"job_name": f"{name}-0-0"}),
+            "job_provisioning_data": dumps(
+                {
+                    "backend": "local",
+                    "instance_type": {
+                        "name": "local",
+                        "resources": {
+                            "cpus": 1, "memory_mib": 1024, "spot": False,
+                        },
+                    },
+                    "instance_id": "local-1",
+                    "hostname": "127.0.0.1",
+                    "region": "local",
+                    "price": 0.0,
+                    "username": "local",
+                    "ssh_port": 0,
+                    "dockerized": True,
+                }
+            ),
+            "submitted_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    return job_id
+
+
+class _FakeSample:
+    cpu_usage_micro = 1_000_000
+    memory_usage_bytes = 2048
+    memory_working_set_bytes = 1024
+    tpu_duty_cycle_percent = [50.0]
+    tpu_hbm_usage_bytes = [1e9]
+    tpu_hbm_total_bytes = [16e9]
+
+
+def _fake_runner_client(fail_hosts=()):
+    """runner_client_for stand-in: async context manager whose
+    .metrics() returns a fixed sample, or raises AgentNotReady for
+    jobs whose hostname is in fail_hosts."""
+
+    @contextlib.asynccontextmanager
+    async def factory(jpd, port, db=None, project_id=None):
+        class _Runner:
+            async def metrics(self):
+                if jpd.instance_id in fail_hosts:
+                    raise AgentNotReady("runner not up")
+                return _FakeSample()
+
+        yield _Runner()
+
+    return factory
+
+
+class TestMetricsRetention:
+    async def test_keep_points_bounds_table(self, monkeypatch):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tok",
+            with_background=False,
+            local_backend=False,
+        )
+        db = app["state"]["db"]
+        job_id = await _seed_job(db, "retention-run")
+        monkeypatch.setattr(process_metrics, "KEEP_POINTS_PER_JOB", 5)
+        monkeypatch.setattr(
+            process_metrics, "runner_client_for", _fake_runner_client()
+        )
+        for _ in range(9):
+            await process_metrics.collect_metrics(db)
+        rows = await db.fetchall(
+            "SELECT * FROM job_metrics_points WHERE job_id = ?", (job_id,)
+        )
+        assert len(rows) == 5  # pruned to the retention cap, not 9
+        # newest points survive: all timestamps ≥ the oldest kept one
+        all_ts = sorted(r["timestamp"] for r in rows)
+        assert all_ts == sorted(all_ts)
+
+    async def test_unreachable_runner_skipped_not_fatal(self, monkeypatch):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tok",
+            with_background=False,
+            local_backend=False,
+        )
+        db = app["state"]["db"]
+        dead_id = await _seed_job(db, "dead-run")
+        # make the dead job's instance distinguishable
+        await db.execute(
+            "UPDATE jobs SET job_provisioning_data = ? WHERE id = ?",
+            (
+                dumps(
+                    {
+                        "backend": "local",
+                        "instance_type": {
+                            "name": "local",
+                            "resources": {
+                                "cpus": 1, "memory_mib": 1024, "spot": False,
+                            },
+                        },
+                        "instance_id": "dead-host",
+                        "hostname": "10.0.0.99",
+                        "region": "local",
+                        "price": 0.0,
+                        "username": "local",
+                        "ssh_port": 0,
+                        "dockerized": True,
+                    }
+                ),
+                dead_id,
+            ),
+        )
+        live_id = await _seed_job(db, "live-run")
+        monkeypatch.setattr(
+            process_metrics,
+            "runner_client_for",
+            _fake_runner_client(fail_hosts={"dead-host"}),
+        )
+        # must not raise: the dead runner is skipped, the live one sampled
+        await process_metrics.collect_metrics(db)
+        dead_points = await db.fetchall(
+            "SELECT * FROM job_metrics_points WHERE job_id = ?", (dead_id,)
+        )
+        live_points = await db.fetchall(
+            "SELECT * FROM job_metrics_points WHERE job_id = ?", (live_id,)
+        )
+        assert dead_points == []
+        assert len(live_points) == 1
+        assert live_points[0]["memory_usage_bytes"] == 2048

@@ -1,0 +1,184 @@
+"""Run lifecycle timeline: run_events recording, the
+GET /api/runs/{id}/timeline endpoint, the cluster-metrics phase gauge,
+and the `dtpu stats` rendering."""
+
+import datetime
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.core.models.runs import new_uuid, now_utc
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.db import dumps
+from dstack_tpu.server.services.run_events import (
+    get_run_timeline,
+    record_run_event,
+)
+
+PHASES = ["submitted", "provisioning", "pulling", "running", "first_step"]
+
+
+async def _seed_run(db, status="running", gap_s=3.0):
+    project = await db.fetchone("SELECT * FROM projects WHERE name = 'main'")
+    user = await db.fetchone("SELECT * FROM users")
+    run_id = new_uuid()
+    t0 = now_utc() - datetime.timedelta(seconds=gap_s * len(PHASES))
+    await db.insert(
+        "runs",
+        {
+            "id": run_id,
+            "project_id": project["id"],
+            "user_id": user["id"],
+            "run_name": "tl-run",
+            "status": status,
+            "run_spec": dumps({"configuration": {"type": "task"}}),
+            "deleted": 0,
+            "submitted_at": t0.isoformat(),
+            "last_processed_at": t0.isoformat(),
+        },
+    )
+    for i, ev in enumerate(PHASES):
+        ts = (t0 + datetime.timedelta(seconds=gap_s * i)).isoformat()
+        await record_run_event(db, run_id, ev, timestamp=ts)
+    return run_id
+
+
+class TestTimelineService:
+    async def test_ordered_events_with_durations(self):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tok",
+            with_background=False,
+            local_backend=False,
+        )
+        db = app["state"]["db"]
+        run_id = await _seed_run(db)
+        run_row = await db.get_by_id("runs", run_id)
+        tl = await get_run_timeline(db, run_row)
+        assert [e["event"] for e in tl["events"]] == PHASES
+        # consecutive phases: 3s elapsed between each
+        assert [e["elapsed_s"] for e in tl["events"]] == [0.0, 3.0, 6.0, 9.0, 12.0]
+        for e in tl["events"][:-1]:
+            assert e["duration_s"] == 3.0
+        # active run: the last phase's duration keeps accruing (to now)
+        assert tl["events"][-1]["duration_s"] >= 0.0
+        assert tl["total_s"] >= 12.0
+
+    async def test_finished_run_terminal_duration_none(self):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tok",
+            with_background=False,
+            local_backend=False,
+        )
+        db = app["state"]["db"]
+        run_id = await _seed_run(db, status="done")
+        run_row = await db.get_by_id("runs", run_id)
+        tl = await get_run_timeline(db, run_row)
+        assert tl["events"][-1]["duration_s"] is None
+        assert tl["total_s"] == 12.0
+
+
+class TestTimelineEndpoint:
+    async def test_get_timeline(self):
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tok",
+            with_background=False,
+            local_backend=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        db = app["state"]["db"]
+        try:
+            run_id = await _seed_run(db)
+            r = await client.get(
+                f"/api/runs/{run_id}/timeline",
+                headers={"Authorization": "Bearer tok"},
+            )
+            assert r.status == 200
+            tl = await r.json()
+            assert tl["run_name"] == "tl-run"
+            assert [e["event"] for e in tl["events"]] == PHASES
+            # auth required / unknown id 404
+            r = await client.get(f"/api/runs/{run_id}/timeline")
+            assert r.status == 401
+            r = await client.get(
+                "/api/runs/does-not-exist/timeline",
+                headers={"Authorization": "Bearer tok"},
+            )
+            assert r.status == 404
+            # scrape side: current-phase age gauge on /metrics
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "dtpu_run_current_phase_seconds" in text
+            assert 'dtpu_run_phase="first_step"' in text
+        finally:
+            await client.close()
+
+
+class TestEventRecordingSites:
+    async def test_submit_and_stop_record_events(self):
+        """runs_service.submit_run / stop_runs append timeline rows."""
+        from dstack_tpu.core.models.runs import RunSpec
+        from dstack_tpu.server.services import runs as runs_service
+
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tok",
+            with_background=False,
+            local_backend=True,
+        )
+        db = app["state"]["db"]
+        project = await db.fetchone("SELECT * FROM projects WHERE name = 'main'")
+        user = await db.fetchone("SELECT * FROM users")
+        spec = RunSpec.model_validate(
+            {
+                "run_name": "ev-run",
+                "configuration": {"type": "task", "commands": ["true"]},
+            }
+        )
+        run = await runs_service.submit_run(db, project, user, spec)
+        rows = await db.fetchall(
+            "SELECT * FROM run_events WHERE run_id = ? ORDER BY timestamp",
+            (run.id,),
+        )
+        assert [r["event"] for r in rows] == ["submitted"]
+        await runs_service.stop_runs(db, project, ["ev-run"], abort=True)
+        rows = await db.fetchall(
+            "SELECT * FROM run_events WHERE run_id = ? ORDER BY timestamp, id",
+            (run.id,),
+        )
+        events = [r["event"] for r in rows]
+        assert events[0] == "submitted"
+        assert "terminating" in events  # run-level stop event
+
+
+class TestCliRendering:
+    def test_stats_table_renders_phases(self):
+        from rich.console import Console
+
+        from dstack_tpu.cli.main import render_timeline_table
+
+        tl = {
+            "run_name": "tl-run",
+            "status": "running",
+            "events": [
+                {
+                    "event": ev,
+                    "job_id": None if i < 2 else "j1",
+                    "timestamp": now_utc().isoformat(),
+                    "elapsed_s": 3.0 * i,
+                    "duration_s": 3.0 if i < 4 else None,
+                    "details": None,
+                }
+                for i, ev in enumerate(PHASES)
+            ],
+            "total_s": 12.0,
+        }
+        console = Console(record=True, width=100)
+        console.print(render_timeline_table(tl))
+        out = console.export_text()
+        for ev in PHASES:
+            assert ev in out
+        assert "3.0s" in out and "+9.0s" in out
+        assert "total" in out

@@ -1,11 +1,21 @@
-"""Tracing middleware: per-route latency recording surfaced on /metrics
+"""Tracing middleware: per-route latency histograms surfaced on /metrics
 (parity: reference server/app.py:68-76 sentry gate + :214-226 request
-latency middleware)."""
+latency middleware; histograms via the shared obs core)."""
 
+import asyncio
+
+import pytest
+from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 
+from dstack_tpu.server import tracing
 from dstack_tpu.server.app import create_app
-from dstack_tpu.server.tracing import RequestStats, get_request_stats, init_sentry
+from dstack_tpu.server.tracing import (
+    RequestStats,
+    get_request_stats,
+    init_sentry,
+    tracing_middleware,
+)
 
 
 class TestRequestStats:
@@ -20,7 +30,19 @@ class TestRequestStats:
             in text
         )
         assert 'status="401"} 1' in text
-        assert "dtpu_http_request_seconds_total" in text
+        # histogram triplet with cumulative buckets
+        assert "# TYPE dtpu_http_request_duration_seconds histogram" in text
+        assert (
+            'dtpu_http_request_duration_seconds_count{method="GET",route="/api/server/info"} 2'
+            in text
+        )
+        assert "dtpu_http_request_duration_seconds_sum" in text
+        assert (
+            'dtpu_http_request_duration_seconds_bucket{method="GET",route="/api/server/info",le="0.025"} 2'
+            in text
+        )
+        # legacy dict view still works
+        assert stats.count[("GET", "/api/server/info", 200)] == 2
 
     def test_sentry_disabled_without_dsn(self):
         assert init_sentry() is False  # no DTPU_SENTRY_DSN in tests
@@ -53,6 +75,33 @@ class TestMiddlewareE2E:
             assert r.status == 200
             text = await r.text()
             assert "dtpu_http_requests_total" in text
+            assert "dtpu_http_request_duration_seconds_bucket" in text
+            assert "dtpu_http_request_duration_seconds_sum" in text
+            assert "dtpu_http_request_duration_seconds_count" in text
             assert "/api/server/info" in text
         finally:
             await client.close()
+
+    async def test_client_disconnect_recorded_as_499(self, monkeypatch):
+        """A handler cancelled by client disconnect must be recorded
+        under the 499 sentinel status, not 500 (and not crash the
+        middleware)."""
+        fresh = RequestStats()
+        monkeypatch.setattr(tracing, "_stats", fresh)
+
+        async def cancelled_handler(request):
+            raise asyncio.CancelledError()
+
+        app = web.Application(middlewares=[tracing_middleware])
+        app.router.add_get("/gone", cancelled_handler)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            # aiohttp surfaces the server-side cancellation as a failed
+            # fetch; the middleware's finally block must still record
+            with pytest.raises(Exception):
+                await client.get("/gone")
+        finally:
+            await client.close()
+        assert ("GET", "/gone", 499) in fresh.count
+        assert fresh.latency.count("GET", "/gone") == 1

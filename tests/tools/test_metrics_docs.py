@@ -1,0 +1,30 @@
+"""Metrics/docs drift gate: every exported series must appear in
+docs/reference/server.md (tools/check_metrics_docs.py, run here so
+tier-1 fails on drift instead of docs rotting silently)."""
+
+import importlib.util
+from pathlib import Path
+
+TOOL = Path(__file__).resolve().parents[2] / "tools" / "check_metrics_docs.py"
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_metrics_docs", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_docs_cover_every_exported_series():
+    mod = _load_tool()
+    assert mod.main() == 0
+
+
+def test_collector_sees_all_three_layers():
+    names = _load_tool().collect_metric_names()
+    # one representative per exporter: tracing, cluster renderer,
+    # serve, train — a refactor dropping a whole layer fails here
+    assert "dtpu_http_request_duration_seconds" in names
+    assert "dtpu_runs" in names
+    assert "dtpu_serve_ttft_seconds" in names
+    assert "dtpu_train_step_seconds" in names

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Fail when an exported metric series is missing from the docs.
+
+Scrapes every metric family name the system can export —
+
+- the HTTP tracing registry (``server/tracing.RequestStats``)
+- the serve registry (``serve/metrics.new_serve_registry``)
+- the train registry (``train/step.new_train_registry``)
+- the DB-backed cluster renderer (``w.sample("name", ...)`` calls in
+  ``server/services/prometheus.py``, collected by regex: those names
+  are data-driven, not registry-driven)
+
+— and asserts each appears in ``docs/reference/server.md``'s
+"Metrics & timeline" section. Run by tier-1 tests
+(tests/tools/test_metrics_docs.py), so adding a series without
+documenting it fails CI instead of silently drifting.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DOCS = REPO / "docs" / "reference" / "server.md"
+
+if str(REPO) not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, str(REPO))
+
+
+def collect_metric_names() -> set:
+    names: set = set()
+    from dstack_tpu.serve.metrics import new_serve_registry
+    from dstack_tpu.server.tracing import RequestStats
+
+    names.update(RequestStats().registry.metric_names())
+    names.update(new_serve_registry().metric_names())
+    try:
+        from dstack_tpu.train.step import new_train_registry
+
+        names.update(new_train_registry().metric_names())
+    except ImportError as e:
+        # jax/optax absent: scrape the registry-construction source
+        # instead (a hardcoded fallback list would silently drift when
+        # a family is added to new_train_registry)
+        print(f"note: train registry parsed from source ({e})", file=sys.stderr)
+        step_src = (
+            REPO / "dstack_tpu" / "train" / "step.py"
+        ).read_text()
+        names.update(
+            re.findall(
+                r'r\.(?:counter|gauge|histogram)\(\s*\n?\s*"([a-z0-9_]+)"',
+                step_src,
+            )
+        )
+    renderer = (
+        REPO / "dstack_tpu" / "server" / "services" / "prometheus.py"
+    ).read_text()
+    names.update(re.findall(r'w\.sample\(\s*\n?\s*"([a-z0-9_]+)"', renderer))
+    return names
+
+
+def main() -> int:
+    doc = DOCS.read_text()
+    missing = sorted(n for n in collect_metric_names() if n not in doc)
+    if missing:
+        print(
+            "exported metrics missing from docs/reference/server.md "
+            "(add them to the 'Metrics & timeline' section):",
+            file=sys.stderr,
+        )
+        for n in missing:
+            print(f"  {n}", file=sys.stderr)
+        return 1
+    print(f"docs cover all {len(collect_metric_names())} exported series")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
