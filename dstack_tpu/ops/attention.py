@@ -47,7 +47,7 @@ def _xla_attention(
     v: jax.Array,
     causal: bool,
     scale: float,
-    q_offset: int = 0,
+    q_offset=0,  # int, or [B] int32 per-row offsets (packed prefill)
     window: int = 0,
     softcap: float = 0.0,
     chunk: int = 0,
@@ -64,9 +64,16 @@ def _xla_attention(
         s = softcap * jnp.tanh(s / softcap)  # cap raw scores, then mask
     if causal or window or chunk:
         tk = k.shape[2]
-        qi = q_offset + jnp.arange(tq)[:, None]
-        kj = jnp.arange(tk)[None, :]
-        keep = (qi >= kj) if causal else jnp.ones((tq, tk), bool)
+        # a scalar offset broadcasts ([1, Tq, 1] rows); a [B] vector
+        # gives per-row causal frontiers (packed multi-slot prefill:
+        # each row's chunk sits at its own global start)
+        off = jnp.reshape(jnp.asarray(q_offset, jnp.int32), (-1, 1, 1))
+        qi = off + jnp.arange(tq)[None, :, None]  # [B|1, Tq, 1]
+        kj = jnp.arange(tk)[None, None, :]  # [1, 1, Tk]
+        keep = (
+            (qi >= kj) if causal
+            else jnp.ones((off.shape[0], tq, tk), bool)
+        )
         if window:
             # HF sliding-window convention: key j visible to query i
             # iff 0 <= i - j < window
@@ -76,7 +83,7 @@ def _xla_attention(
             # both land in the same `chunk`-token block (blockwise
             # local, not a sliding window)
             keep = keep & (qi // chunk == kj // chunk)
-        s = jnp.where(keep, s, NEG_INF)
+        s = jnp.where(keep[:, None], s, NEG_INF)  # broadcast over heads
     if sinks is not None:
         p = sink_softmax(s, sinks.astype(jnp.float32).reshape(1, -1, 1, 1))
     else:
@@ -110,7 +117,7 @@ def attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    q_offset: int = 0,
+    q_offset=0,  # int, or [B] int32 per-row offsets
     window: int = 0,  # 0 = full attention; else sliding window size
     softcap: float = 0.0,  # 0 = off; else tanh soft-cap on scores
     chunk: int = 0,  # 0 = off; else Llama4 blockwise-chunk size
@@ -118,8 +125,20 @@ def attention(
     impl: Optional[str] = None,  # None=auto | "flash" | "xla"
     sinks_forward_only: bool = False,  # caller never differentiates
 ) -> jax.Array:
-    """Dispatching attention entry point used by models."""
+    """Dispatching attention entry point used by models.
+
+    ``q_offset`` may be a ``[B]`` int32 vector giving each batch row its
+    own causal frontier (packed multi-slot prefill: concurrent prompt
+    chunks at unequal starts share one dispatch). The pallas kernel
+    tiles exactly one static offset per call, so vector offsets always
+    take the masked-einsum path (window/softcap/chunk/sinks included).
+    """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    if isinstance(q_offset, jax.Array) and q_offset.ndim > 0:
+        return _xla_attention(
+            q, k, v, causal=causal, scale=scale, q_offset=q_offset,
+            window=window, softcap=softcap, chunk=chunk, sinks=sinks,
+        )
     if sinks is not None:
         # sinks join the softmax DENOMINATOR only, so a sink-less flash
         # pass rescaled by σ(lse - sink) is exact (sink_postscale) —

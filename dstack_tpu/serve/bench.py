@@ -13,6 +13,71 @@ import json
 import time
 
 
+def _drive_burst(eng, prompts, gen_len):
+    """Admit every prompt at once (concurrent arrival), then drive
+    prefill waves + decode interleaved to completion — the scheduler's
+    tick pattern, minus HTTP."""
+    from dstack_tpu.serve.engine import GenParams
+
+    slots = [
+        eng.start_request(list(p), GenParams(max_new_tokens=gen_len))
+        for p in prompts
+    ]
+    while eng.prefilling_slots() or any(eng.active[s] for s in slots):
+        if eng.prefilling_slots():
+            eng.prefill_wave()
+        if any(eng.active[s] for s in slots):
+            eng.step()
+    for s in slots:
+        eng.release(s)
+
+
+def _concurrent_arrival_bench(eng, rng, vocab, burst, prompt_len, gen_len):
+    """Burst TTFT + prefill-dispatch accounting → result dict.
+
+    Runs the SAME burst twice — packed (the engine's prefill_pack) and
+    serial (prefill_pack temporarily 0) — so one JSON line shows the
+    dispatch reduction and the TTFT-under-load it buys."""
+    ttft_hist = eng.metrics.family("dtpu_serve_ttft_seconds")
+    disp = eng.metrics.family("dtpu_serve_prefill_dispatches_total")
+    prompts = [
+        rng.integers(1, vocab, prompt_len).tolist() for _ in range(burst)
+    ]
+    pack = eng.prefill_pack
+
+    def measure():
+        eng.reset_prefix_cache()  # identical-length bursts must not hit
+        ttft_hist.clear()
+        d0 = disp.value()
+        _drive_burst(eng, prompts, gen_len)
+        return {
+            "ttft_ms_p50": round((ttft_hist.quantile(0.5) or 0.0) * 1e3, 1),
+            "ttft_ms_p95": round((ttft_hist.quantile(0.95) or 0.0) * 1e3, 1),
+            "prefill_dispatches": int(disp.value() - d0),
+        }
+
+    # warm both paths' compile variants outside the timed bursts
+    _drive_burst(eng, prompts, 2)
+    eng.prefill_pack = 0
+    _drive_burst(eng, prompts, 2)
+    eng.prefill_pack = pack
+    packed = measure()
+    eng.prefill_pack = 0
+    serial = measure()
+    eng.prefill_pack = pack
+    return {
+        "burst": burst,
+        "prefill_pack": pack,
+        "packed": packed,
+        "serial": serial,
+        "dispatch_ratio": round(
+            serial["prefill_dispatches"]
+            / max(packed["prefill_dispatches"], 1),
+            2,
+        ),
+    }
+
+
 def run_bench(
     model: str = "llama-tiny",
     batch: int = 4,
@@ -26,6 +91,8 @@ def run_bench(
     turbo_depth: int = 1,
     kv_quant=None,
     prefill_chunk: int = 256,
+    prefill_pack: int = 4,
+    arrival_burst: int = 0,  # 0 = off; else concurrent-arrival mode size
     decode_kernel=None,  # None/"einsum" | "flash" (ragged pallas read)
 ) -> dict:
     """Measure the engine directly → result dict (importable core;
@@ -57,11 +124,17 @@ def run_bench(
             params = random_quantized_params_on_device(config)
     else:
         params = llama.init_params(config, jax.random.key(0))
+    if arrival_burst and arrival_burst > batch:
+        raise ValueError(
+            f"--arrival-burst {arrival_burst} needs --batch >= burst "
+            f"(got {batch}): the burst is admitted all at once"
+        )
     eng = InferenceEngine(
         config, params, max_batch=batch, max_seq=max_seq,
         spec_draft=spec_draft, turbo_steps=turbo_steps,
         turbo_depth=turbo_depth, kv_quant=kv_quant,
-        prefill_chunk=prefill_chunk, decode_kernel=decode_kernel,
+        prefill_chunk=prefill_chunk, prefill_pack=prefill_pack,
+        decode_kernel=decode_kernel,
     )
     rng = np.random.default_rng(0)
     if repetitive:
@@ -103,7 +176,7 @@ def run_bench(
     # cold TTFT must stay cold: the warmup request registered its
     # prompt for prefix reuse — drop it (repetitive mode's identical
     # prompts would otherwise prefix-hit and flatter the numbers)
-    eng._prefix_registry.clear()
+    eng.reset_prefix_cache()
 
     # Timed sections read the ENGINE's own obs histograms — the same
     # series the openai_server exports from /metrics — instead of
@@ -120,7 +193,7 @@ def run_bench(
     for prompt in prompts:
         # per-admission clear: in repetitive mode requests 2..N would
         # otherwise prefix-hit against request 1's registration
-        eng._prefix_registry.clear()
+        eng.reset_prefix_cache()
         slot, _ = eng.add_request(
             prompt, GenParams(max_new_tokens=gen_len)
         )
@@ -168,7 +241,7 @@ def run_bench(
         while eng.active[slot]:
             eng.step()
         eng.release(slot)
-        eng._prefix_registry.clear()
+        eng.reset_prefix_cache()
         ttft_hist.clear()  # isolate: the single cold sample IS the number
         slot, _ = eng.add_request(long_prompt, GenParams(max_new_tokens=2))
         ttft_long_cold_ms = round((ttft_hist.quantile(0.5) or 0.0) * 1e3, 1)
@@ -189,6 +262,15 @@ def run_bench(
             eng.step()
         eng.release(slot)
 
+    # concurrent-arrival mode: an N-prompt burst through the packed
+    # prefill wave vs serial per-prompt prefill — dispatch counts and
+    # TTFT p50/p95 under load, from the engine's own histograms
+    concurrent = None
+    if arrival_burst:
+        concurrent = _concurrent_arrival_bench(
+            eng, rng, config.vocab_size, arrival_burst, prompt_len, gen_len
+        )
+
     return {
         "metric": f"serve_decode_tokens_per_sec[{model},batch={batch}]",
         # engine-step time, not the bench loop's wall clock: the same
@@ -206,6 +288,11 @@ def run_bench(
             "decode_steps": steps,
             "tokens": tokens,
             "tokens_per_step": round(tokens / max(steps, 1), 2),
+            # N-prompt burst: packed vs serial prefill dispatches + TTFT
+            "concurrent": concurrent,
+            # the engine's EFFECTIVE pack width (power-of-2-floored,
+            # capped at batch), not the raw argument
+            "prefill_pack": eng.prefill_pack,
             "spec_draft": spec_draft,
             "turbo_steps": turbo_steps,
             "turbo_depth": turbo_depth,
@@ -250,6 +337,17 @@ def main(argv=None) -> int:
         help="prefill chunk length (prefix reuse is chunk-granular)",
     )
     p.add_argument(
+        "--prefill-pack", type=int, default=4,
+        help="max prompt chunks packed into one prefill dispatch "
+             "(0/1 = serial per-prompt prefill)",
+    )
+    p.add_argument(
+        "--arrival-burst", type=int, default=0,
+        help="concurrent-arrival mode: admit this many prompts at once "
+             "and report packed-vs-serial prefill dispatch counts and "
+             "TTFT p50/p95 under load (requires --batch >= burst)",
+    )
+    p.add_argument(
         "--decode-kernel", default=None, choices=["einsum", "flash"],
         help="decode attention path: masked einsum (default) or the "
              "ragged pallas kernel (each slot reads only its own "
@@ -277,6 +375,8 @@ def main(argv=None) -> int:
         kv_quant=args.kv_quant,
         decode_kernel=args.decode_kernel,
         prefill_chunk=args.prefill_chunk,
+        prefill_pack=args.prefill_pack,
+        arrival_burst=args.arrival_burst,
     )
     print(json.dumps(result))
     return 0

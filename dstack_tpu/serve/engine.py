@@ -131,6 +131,16 @@ def _cread_row(ckv, slot, dtype):
     return jax.lax.dynamic_slice_in_dim(ckv, slot, 1, 0)
 
 
+def _cread_rows(ckv, slots, dtype):
+    """Gather ``slots``' rows [G, H, Tmax, D] in compute dtype (packed
+    prefill: G concurrent prompt chunks attend over their own rows)."""
+    if isinstance(ckv, tuple):
+        rq = jnp.take(ckv[0], slots, axis=0)
+        rs = jnp.take(ckv[1], slots, axis=0)
+        return kv_dequant(rq, rs, dtype)
+    return jnp.take(ckv, slots, axis=0)
+
+
 def _cwrite_at(ckv, batch_ix, write_pos, new):
     """Scatter per-slot tokens: new [B, H, D] at [B] positions, or
     [B, S, H, D] at [B, S] positions (speculative verify)."""
@@ -265,6 +275,34 @@ def _apply_rope_batch(
     d2 = x.shape[-1] // 2
     x1, x2 = x[..., :d2], x[..., d2:]
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _rope_rows(
+    t: jax.Array,  # [B, Hh, S, D]
+    cos: jax.Array,  # [B, S, D/2] per-(row, step) angles
+    sin: jax.Array,
+    interleaved: bool = False,
+) -> jax.Array:
+    """Rope with per-(row, step) angles — the grid form used wherever a
+    batch of rows sits at unequal positions: speculative verify (width
+    S) and packed multi-slot prefill (width C). Narrower cos/sin (GLM
+    partial rotary) rotate only the leading dims; ``interleaved`` is
+    the Meta/Llama4 complex-pair convention (always on for MLA)."""
+    from dstack_tpu.models.llama import rope_partial
+
+    if 2 * cos.shape[-1] < t.shape[-1]:
+        return rope_partial(
+            lambda tt: _rope_rows(tt, cos, sin, interleaved), t, cos
+        )
+    cc = cos[:, None].astype(t.dtype)  # [B, 1, S, D/2]
+    ss = sin[:, None].astype(t.dtype)
+    if interleaved:
+        t1, t2 = t[..., 0::2], t[..., 1::2]
+        out = jnp.stack([t1 * cc - t2 * ss, t2 * cc + t1 * ss], axis=-1)
+        return out.reshape(t.shape)
+    d2 = t.shape[-1] // 2
+    t1, t2 = t[..., :d2], t[..., d2:]
+    return jnp.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss], axis=-1)
 
 
 def _mlp(x: jax.Array, layer: dict, c: LlamaConfig) -> jax.Array:
@@ -566,12 +604,8 @@ def _verify_step_mla(
     scale = c.attention_scale
     write_pos = jnp.where(write_mask[:, None], pos_grid, tmax)  # [B, S]
 
-    def rope_rows(t):  # t [B, Hh, S, rope] with per-(row, step) angles
-        cc = cos[:, None].astype(t.dtype)  # [B, 1, S, rope/2]
-        ss = sin[:, None].astype(t.dtype)
-        t1, t2 = t[..., 0::2], t[..., 1::2]  # interleaved complex pairs
-        out = jnp.stack([t1 * cc - t2 * ss, t2 * cc + t1 * ss], axis=-1)
-        return out.reshape(t.shape)
+    def rope_rows(t):  # MLA rope is always interleaved
+        return _rope_rows(t, cos, sin, interleaved=True)
 
     def one_layer(x, layer, row):
         h = rms_norm(x, layer["attn_norm"], c.norm_eps)
@@ -626,105 +660,24 @@ def prefill(
     )
 
 
-def prefill_chunk_step(
-    params: dict,
-    cache: dict,
-    tokens: jax.Array,  # [1, C] int32 chunk (right-padded on the last one)
-    slot: jax.Array,  # [] int32 cache row
-    last_ix: jax.Array,  # [] int32: prompt's last real index MINUS start
-    config: LlamaConfig,
-    *,
-    start: int,  # static: global position of the chunk's first token
-) -> tuple[jax.Array, dict]:
-    """One prompt chunk → (logits at ``last_ix`` [1, V], cache).
-
-    Chunked prefill: the chunk's K/V are written into the slot's cache
-    row first, then the chunk queries attend over the row's prefix with
-    causal masking at the STATIC ``start`` offset — so the pallas flash
-    kernel applies (per-layer windows/softcaps included) and no
-    [C, T_max] score matrix materializes. A long prompt becomes
-    ceil(Tp/C) identical-shape calls, letting the scheduler run decode
-    steps for other slots between chunks instead of stalling them for
-    the whole prompt (and collapsing the per-length compile zoo into
-    per-(C, start) variants the persistent cache reuses).
-    """
+def _scan_layers_kv(params: dict, cache: dict, x: jax.Array, one_layer, c):
+    """Drive ``one_layer(x, layer, ck, cv, window, nope) -> (x, ck, cv)``
+    over the grouped scan layout (static per-layer windows / NoPE flags
+    ride the unrolled group; see :func:`llama.grouped_scan_layout`) →
+    (final hidden, updated cache). ONE copy of the scan/tail plumbing
+    shared by the chunked and packed prefill forms, so a layout change
+    cannot silently diverge them."""
     from dstack_tpu.models.llama import (
-        apply_rope,
-        attn_temp_scales,
-        dual_rope_freqs,
         grouped_scan_layout,
-        l2_norm,
         layer_nope,
-        layer_rope,
         sublayer,
     )
-    from dstack_tpu.ops.attention import attention
 
-    c = config
-    if c.mla:
-        return _prefill_chunk_mla(
-            params, cache, tokens, slot, last_ix, c, start=start
-        )
-    b, cl = tokens.shape
-    x = _embed_lookup(params, tokens, c)
-    chunk_pos = start + jnp.arange(cl)
-    ropes = dual_rope_freqs(c, chunk_pos)
-    scale = c.attention_scale
     ck_p, cv_p = _cache_pack(cache)
     g, windows, xs_main, xs_tail = grouped_scan_layout(
         c, {"layer": params["layers"], "ck": ck_p, "cv": cv_p}
     )
     nopes = layer_nope(c)
-
-    def one_layer(x, layer, ck, cv, window, nope):
-        # ck/cv [B_pool, Hkv, Tmax, D] — this layer's cache
-        cos, sin = layer_rope(ropes, c, window)
-        h = (
-            model_norm(x, layer["attn_norm"], c)
-            if c.pre_norm else x
-        )
-        q, k, v = _qkv(h, layer, c)
-        q = q.reshape(b, cl, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
-        k = k.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        v = v.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
-        if c.qk_norm:  # per-head q/k norm (Qwen3 rms / Cohere ln)
-            q, k = qk_norm_apply(q, k, layer, c)
-        if not nope:
-            q = apply_rope(q, cos, sin, interleaved=c.rope_interleaved)
-            k = apply_rope(k, cos, sin, interleaved=c.rope_interleaved)
-            if c.qk_l2_norm:  # Llama4: weightless L2 norm after rope
-                q = l2_norm(q, c.norm_eps)
-                k = l2_norm(k, c.norm_eps)
-        elif c.attn_temp_scale:  # Llama4 NoPE query temperature
-            q = q * attn_temp_scales(chunk_pos, c)[None, None, :, None].astype(q.dtype)
-        # write the chunk's K/V into the slot's row, then attend over
-        # the whole row: positions beyond start+i are causally masked,
-        # so stale data past the prompt is never read
-        ck = _cwrite_chunk(ck, k, slot.astype(jnp.int32), start)
-        cv = _cwrite_chunk(cv, v, slot.astype(jnp.int32), start)
-        row_k = _cread_row(ck, slot.astype(jnp.int32), k.dtype)
-        row_v = _cread_row(cv, slot.astype(jnp.int32), v.dtype)
-        o = attention(
-            q, row_k, row_v, causal=True, scale=scale, q_offset=start,
-            window=window, softcap=c.attn_softcap,
-            chunk=0 if nope else c.attention_chunk_size,
-            sinks=layer.get("sinks") if c.attn_sinks else None,
-            # serving never differentiates: sink models may ride the
-            # flash kernel + exact σ(lse - sink) rescale on TPU
-            sinks_forward_only=True,
-        )
-        o = o.transpose(0, 2, 1, 3).reshape(b, cl, c.q_dim)
-        ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
-        if c.proj_bias:
-            ao = ao + layer["bo"]
-        if c.post_norms:
-            ao = model_norm(ao, layer["attn_post_norm"], c)
-        if c.residual_multiplier:  # Granite scales the sublayer output
-            ao = ao * jnp.asarray(c.residual_multiplier, ao.dtype)
-        if c.parallel_block:  # Cohere: joint residual add
-            return x + ao + _mlp_out(x, layer, c), ck, cv
-        x = x + ao
-        return _mlp(x, layer, c), ck, cv
 
     def group_fn(x, group):
         cks, cvs = [], []
@@ -763,10 +716,283 @@ def prefill_chunk_step(
         )
         ks = cat(ks, _tree_stack(tks))
         vs = cat(vs, _tree_stack(tvs))
-    cache = _cache_unpack(ks, vs)
+    return x, _cache_unpack(ks, vs)
+
+
+def _prefill_one_layer(
+    c: LlamaConfig,
+    ropes: tuple,
+    *,
+    rope_apply,  # (t [B, Hh, C, D], cos, sin) → roped t
+    temp_apply,  # (q) → NoPE-temperature-scaled q (Llama4)
+    kv_update,  # (ck, cv, k, v [B, Hkv, C, D]) → (ck, cv, row_k, row_v)
+    q_offset,  # static int (serial chunk) or [B] vector (packed)
+):
+    """Build the dense prefill attention+MLP sublayer shared by the
+    serial chunk and packed multi-slot forms. The two forms differ ONLY
+    in rope application, NoPE temperature broadcasting, the cache
+    write/read, and the causal offset — injected here so every
+    model-family branch (qk norm, sinks, softcap, post norms, parallel
+    block, ...) exists ONCE and packed-vs-serial parity cannot drift."""
+    from dstack_tpu.models.llama import l2_norm, layer_rope
+    from dstack_tpu.ops.attention import attention
+
+    scale = c.attention_scale
+
+    def one_layer(x, layer, ck, cv, window, nope):
+        # ck/cv [B_pool, Hkv, Tmax, D] — this layer's cache
+        b, cl = x.shape[0], x.shape[1]
+        cos, sin = layer_rope(ropes, c, window)
+        h = (
+            model_norm(x, layer["attn_norm"], c)
+            if c.pre_norm else x
+        )
+        q, k, v = _qkv(h, layer, c)
+        q = q.reshape(b, cl, c.n_heads, c.head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, cl, c.n_kv_heads, c.head_dim).transpose(0, 2, 1, 3)
+        if c.qk_norm:  # per-head q/k norm (Qwen3 rms / Cohere ln)
+            q, k = qk_norm_apply(q, k, layer, c)
+        if not nope:
+            q = rope_apply(q, cos, sin)
+            k = rope_apply(k, cos, sin)
+            if c.qk_l2_norm:  # Llama4: weightless L2 norm after rope
+                q = l2_norm(q, c.norm_eps)
+                k = l2_norm(k, c.norm_eps)
+        elif c.attn_temp_scale:  # Llama4 NoPE query temperature
+            q = temp_apply(q)
+        # write the chunk K/V into the slot rows, then attend over the
+        # whole rows: positions past each causal frontier are masked,
+        # so stale data beyond the prompts is never read
+        ck, cv, row_k, row_v = kv_update(ck, cv, k, v)
+        o = attention(
+            q, row_k, row_v, causal=True, scale=scale, q_offset=q_offset,
+            window=window, softcap=c.attn_softcap,
+            chunk=0 if nope else c.attention_chunk_size,
+            sinks=layer.get("sinks") if c.attn_sinks else None,
+            # serving never differentiates: sink models may ride the
+            # flash kernel + exact σ(lse - sink) rescale on TPU
+            sinks_forward_only=True,
+        )
+        o = o.transpose(0, 2, 1, 3).reshape(b, cl, c.q_dim)
+        ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        if c.proj_bias:
+            ao = ao + layer["bo"]
+        if c.post_norms:
+            ao = model_norm(ao, layer["attn_post_norm"], c)
+        if c.residual_multiplier:  # Granite scales the sublayer output
+            ao = ao * jnp.asarray(c.residual_multiplier, ao.dtype)
+        if c.parallel_block:  # Cohere: joint residual add
+            return x + ao + _mlp_out(x, layer, c), ck, cv
+        x = x + ao
+        return _mlp(x, layer, c), ck, cv
+
+    return one_layer
+
+
+def prefill_chunk_step(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [1, C] int32 chunk (right-padded on the last one)
+    slot: jax.Array,  # [] int32 cache row
+    last_ix: jax.Array,  # [] int32: prompt's last real index MINUS start
+    config: LlamaConfig,
+    *,
+    start: int,  # static: global position of the chunk's first token
+) -> tuple[jax.Array, dict]:
+    """One prompt chunk → (logits at ``last_ix`` [1, V], cache).
+
+    Chunked prefill: the chunk's K/V are written into the slot's cache
+    row first, then the chunk queries attend over the row's prefix with
+    causal masking at the STATIC ``start`` offset — so the pallas flash
+    kernel applies (per-layer windows/softcaps included) and no
+    [C, T_max] score matrix materializes. A long prompt becomes
+    ceil(Tp/C) identical-shape calls, letting the scheduler run decode
+    steps for other slots between chunks instead of stalling them for
+    the whole prompt (and collapsing the per-length compile zoo into
+    per-(C, start) variants the persistent cache reuses).
+    """
+    from dstack_tpu.models.llama import (
+        apply_rope,
+        attn_temp_scales,
+        dual_rope_freqs,
+    )
+
+    c = config
+    if c.mla:
+        return _prefill_chunk_mla(
+            params, cache, tokens, slot, last_ix, c, start=start
+        )
+    x = _embed_lookup(params, tokens, c)
+    chunk_pos = start + jnp.arange(tokens.shape[1])
+    si = slot.astype(jnp.int32)
+
+    def kv_update(ck, cv, k, v):
+        ck = _cwrite_chunk(ck, k, si, start)
+        cv = _cwrite_chunk(cv, v, si, start)
+        return ck, cv, _cread_row(ck, si, k.dtype), _cread_row(cv, si, v.dtype)
+
+    one_layer = _prefill_one_layer(
+        c, dual_rope_freqs(c, chunk_pos),
+        rope_apply=lambda t, cos, sin: apply_rope(
+            t, cos, sin, interleaved=c.rope_interleaved
+        ),
+        temp_apply=lambda q: q * attn_temp_scales(chunk_pos, c)[
+            None, None, :, None
+        ].astype(q.dtype),
+        kv_update=kv_update,
+        q_offset=start,  # STATIC: the pallas flash kernel applies
+    )
+    x, cache = _scan_layers_kv(params, cache, x, one_layer, c)
     x = model_norm(x, params["final_norm"], c)
     last = jnp.take_along_axis(
         x, last_ix[None, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return _head_logits(params, last, c), cache
+
+
+def _prefill_packed_mla(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [G, C]
+    slots: jax.Array,  # [G]
+    starts: jax.Array,  # [G] traced per-row start positions
+    last_ix: jax.Array,  # [G]; -1 marks an inactive pad row
+    c: LlamaConfig,
+) -> tuple[jax.Array, dict]:
+    """MLA packed prefill: G concurrent prompt chunks write their
+    latents into their own ``ckv`` rows (masked scatter) and attend in
+    the absorbed MQA form with per-row causal frontiers."""
+    from dstack_tpu.models.llama import dual_rope_freqs
+    from dstack_tpu.ops.attention import attention
+
+    g, cl = tokens.shape
+    x = _embed_lookup(params, tokens, c)
+    pos_grid = starts[:, None] + jnp.arange(cl)[None, :]  # [G, C]
+    (cos, sin), _ = jax.tree.map(
+        lambda a: a.reshape(g, cl, c.qk_rope_head_dim // 2),
+        dual_rope_freqs(c, pos_grid.reshape(-1)),
+    )
+    scale = c.attention_scale
+    si = slots.astype(jnp.int32)
+    tmax = cache["ckv"].shape[2]
+    # positions past each row's real tokens (padding, pad rows) scatter
+    # out of range and drop — the masked-future invariant
+    valid = jnp.arange(cl)[None, :] <= last_ix[:, None]  # [G, C]
+    write_pos = jnp.where(valid, pos_grid, tmax)
+
+    def rope_rows(t):  # MLA rope is always interleaved
+        return _rope_rows(t, cos, sin, interleaved=True)
+
+    def one_layer(x, layer, row_cache):
+        # row_cache [B_pool, Tmax, rank+rope] — this layer's latents
+        h = rms_norm(x, layer["attn_norm"], c.norm_eps)
+        q = _mla_q(h, layer, c)  # [G, H, C, qk_head_dim]
+        q_nope = q[..., : c.qk_nope_head_dim]
+        q_pe = rope_rows(q[..., c.qk_nope_head_dim :])
+        ckv, k_pe = _mla_latents(h, layer, c)  # [G,C,rank], [G,C,rope]
+        k_pe = rope_rows(k_pe[:, None])[:, 0]  # [G, C, rope]
+        new_rows = jnp.concatenate([ckv, k_pe], axis=-1)  # [G, C, R]
+        row_cache = row_cache.at[si[:, None], write_pos].set(
+            new_rows, mode="drop"
+        )
+        row = jnp.take(row_cache, si, axis=0)  # [G, Tmax, R]
+        w_kb_nope, w_kb_v = _mla_kb(layer, c)
+        q_lat = jnp.einsum("bhcn,rhn->bhcr", q_nope, w_kb_nope)
+        q_abs = jnp.concatenate([q_lat, q_pe], axis=-1)  # [G, H, C, R]
+        k_abs = row[:, None]  # [G, 1, Tmax, R] — one shared kv head
+        v_abs = jnp.concatenate(
+            [row[..., : c.kv_lora_rank], jnp.zeros_like(row[..., c.kv_lora_rank :])],
+            axis=-1,
+        )[:, None]
+        o = attention(
+            q_abs.astype(c.dtype), k_abs, v_abs, causal=True, scale=scale,
+            q_offset=starts,
+        )[..., : c.kv_lora_rank]  # [G, H, C, rank]
+        o = jnp.einsum("bhcr,rhv->bchv", o, w_kb_v).reshape(g, cl, c.o_dim)
+        ao = _proj(layer, "wo", o, "btd,de->bte", "btd,dr->btr", "btr,re->bte")
+        return _mlp(x + ao, layer, c), row_cache
+
+    x, rows = _mla_scan(params, cache["ckv"], x, one_layer)
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(last_ix, 0)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    return _head_logits(params, last, c), {"ckv": rows}
+
+
+def prefill_packed_step(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [G, C] int32 chunk rows (right-padded)
+    slots: jax.Array,  # [G] int32 cache rows (distinct per real row)
+    starts: jax.Array,  # [G] int32 TRACED per-row global start positions
+    last_ix: jax.Array,  # [G] int32 last real index minus start; -1 = pad row
+    config: LlamaConfig,
+) -> tuple[jax.Array, dict]:
+    """Packed multi-slot prefill: G prompt chunks, one dispatch →
+    (per-row logits at ``last_ix`` [G, V], cache).
+
+    Generalizes :func:`prefill_chunk_step` from ``[1, C]`` + static
+    ``start`` to ``[G, C]`` with traced per-row starts (the ``pos_grid``
+    form :func:`verify_step` uses at decode width S, here at prefill
+    width C): a burst of N arrivals costs ceil(N/G) dispatches per
+    chunk wave instead of N batch-1 passes that underfill the MXU.
+    Per-row rope angles come from the position grid, cache writes use
+    the ``mode="drop"`` scatter so short rows and inactive pad rows
+    (``last_ix = -1``) mask out, and attention gets per-row causal
+    frontiers via the vector ``q_offset`` (masked-einsum path — the
+    pallas kernel can't tile per-row offsets). Because ``starts`` is
+    traced, ONE compile per (G, C) shape serves every start
+    combination — including prefix-cache-resumed rows at unequal
+    starts — where the serial path compiles per (C, start).
+    """
+    from dstack_tpu.models.llama import attn_temp_scales, dual_rope_freqs
+
+    c = config
+    if c.mla:
+        return _prefill_packed_mla(
+            params, cache, tokens, slots, starts, last_ix, c
+        )
+    g, cl = tokens.shape
+    x = _embed_lookup(params, tokens, c)
+    pos_grid = starts[:, None] + jnp.arange(cl)[None, :]  # [G, C]
+    inv_shape = c.rope_dim // 2  # narrower under GLM partial rotary
+    ropes = jax.tree.map(
+        lambda a: a.reshape(g, cl, inv_shape),
+        dual_rope_freqs(c, pos_grid.reshape(-1)),
+    )
+    si = slots.astype(jnp.int32)
+    tmax = cache["k"].shape[3]
+    # positions past each row's real tokens (padding, pad rows) scatter
+    # out of range and drop — the masked-future invariant
+    valid = jnp.arange(cl)[None, :] <= last_ix[:, None]  # [G, C]
+    write_pos = jnp.where(valid, pos_grid, tmax)
+    temp = (
+        attn_temp_scales(pos_grid.reshape(-1), c).reshape(g, cl)
+        if c.attn_temp_scale else None
+    )
+
+    def kv_update(ck, cv, k, v):
+        # scatter each row's chunk K/V at its own positions, then
+        # gather the packed rows for attention
+        ck = _cwrite_at(ck, si, write_pos, k.transpose(0, 2, 1, 3))
+        cv = _cwrite_at(cv, si, write_pos, v.transpose(0, 2, 1, 3))
+        return ck, cv, _cread_rows(ck, si, k.dtype), _cread_rows(cv, si, v.dtype)
+
+    one_layer = _prefill_one_layer(
+        c, ropes,
+        rope_apply=lambda t, cos, sin: _rope_rows(
+            t, cos, sin, interleaved=c.rope_interleaved
+        ),
+        temp_apply=lambda q: q * temp[:, None, :, None].astype(q.dtype),
+        kv_update=kv_update,
+        q_offset=starts,  # VECTOR: per-row frontiers, masked-einsum path
+    )
+    x, cache = _scan_layers_kv(params, cache, x, one_layer, c)
+    x = model_norm(x, params["final_norm"], c)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(last_ix, 0)[:, None, None].astype(jnp.int32), axis=1
     )[:, 0]
     return _head_logits(params, last, c), cache
 
@@ -1000,6 +1226,31 @@ def decode_step(
     return _head_logits(params, x[:, 0], c), cache
 
 
+def advance_decode_state(
+    tok: jax.Array,  # [B] int32 last token per slot
+    pos: jax.Array,  # [B] int32 current lengths
+    rem: jax.Array,  # [B] int32 generation budget left
+    act: jax.Array,  # [B] bool
+    eos_ids: jax.Array,  # [B] int32 (-1 = no EOS)
+    sampled: jax.Array,  # [B] int32 freshly sampled tokens
+    *,
+    max_seq: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One decode step's slot-state transition → (tok, pos, rem, act).
+
+    THE single copy of the per-token deactivation rules
+    (eos/budget/cache-end), used by :func:`decode_loop`'s device-side
+    scan AND the engine's per-step device mirror — the host replay
+    (``_advance_slot``) applies the same rules, so the two cannot
+    drift without the turbo parity tests failing."""
+    new_tok = jnp.where(act, sampled.astype(jnp.int32), tok)
+    step = act.astype(jnp.int32)
+    pos = pos + step
+    rem = rem - step
+    act = act & (new_tok != eos_ids) & (rem > 0) & (pos < max_seq - 1)
+    return new_tok, pos, rem, act
+
+
 def decode_loop(
     params: dict,
     cache: dict,
@@ -1039,13 +1290,11 @@ def decode_loop(
             decode_kernel=decode_kernel, mesh=mesh,
         )
         new_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        tok = jnp.where(act, new_tok, tok)
-        step = act.astype(jnp.int32)
-        pos = pos + step
-        rem = rem - step
+        tok, pos, rem, act2 = advance_decode_state(
+            tok, pos, rem, act, eos_ids, new_tok, max_seq=max_seq
+        )
         emitted = jnp.where(act, tok, -1)
-        act = act & (tok != eos_ids) & (rem > 0) & (pos < max_seq - 1)
-        return (cache, tok, pos, rem, act), emitted
+        return (cache, tok, pos, rem, act2), emitted
 
     (cache, tok, pos, rem, act), toks = jax.lax.scan(
         body, (cache, tokens, positions, remaining, active), None,
@@ -1110,19 +1359,7 @@ def verify_step(
     write_pos = jnp.where(write_mask[:, None], pos_grid, tmax)  # [B, S]
 
     def rope_rows(t, cos, sin):  # t [B, Hh, S, D]
-        from dstack_tpu.models.llama import rope_partial
-
-        if 2 * cos.shape[-1] < t.shape[-1]:  # GLM partial rotary
-            return rope_partial(lambda tt: rope_rows(tt, cos, sin), t, cos)
-        cc = cos[:, None].astype(t.dtype)  # [B, 1, S, D/2]
-        ss = sin[:, None].astype(t.dtype)
-        if c.rope_interleaved:  # Llama4 complex-pair rotation
-            t1, t2 = t[..., 0::2], t[..., 1::2]
-            out = jnp.stack([t1 * cc - t2 * ss, t2 * cc + t1 * ss], axis=-1)
-            return out.reshape(t.shape)
-        d2 = t.shape[-1] // 2
-        t1, t2 = t[..., :d2], t[..., d2:]
-        return jnp.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss], axis=-1)
+        return _rope_rows(t, cos, sin, interleaved=c.rope_interleaved)
 
     def layer_fn(x, layer_and_cache):
         layer, ck, cv, window, nope = layer_and_cache
@@ -1412,6 +1649,7 @@ class InferenceEngine:
         seed: int = 0,
         mesh=None,
         prefill_chunk: int = 256,
+        prefill_pack: int = 4,
         spec_draft: int = 4,
         turbo_steps: int = 8,
         prefix_cache: bool = True,
@@ -1515,6 +1753,19 @@ class InferenceEngine:
         # one per prompt-length bucket; between chunks the scheduler can
         # run decode steps for other slots
         self.prefill_chunk = max(16, min(prefill_chunk, max_seq))
+        # packed multi-slot prefill: prefill_wave() sweeps the pending
+        # prompts each tick and packs up to this many chunk rows —
+        # bucketed to powers of two — into ONE prefill_packed_step
+        # dispatch with traced per-row starts. A burst of N arrivals
+        # costs ceil(N/G) dispatches per chunk wave instead of N
+        # underfilled batch-1 passes. 0/1 = serial per-slot prefill.
+        # Floored to a power of two: G buckets must stay the log2 grid
+        # the server warmup precompiles and the compile-cache
+        # accounting bound documents.
+        pack = max(0, min(prefill_pack, max_batch))
+        while pack & (pack - 1):
+            pack &= pack - 1
+        self.prefill_pack = pack
         # automatic prefix caching: slots whose cache rows still hold a
         # fully-prefilled prompt (they stay valid after release, until
         # the slot is reused) → a new request sharing a chunk-aligned
@@ -1583,6 +1834,14 @@ class InferenceEngine:
         # donate caches: decode must update the KV buffers in place, not
         # copy ~GBs per token
         self._chunk_fns: dict = {}  # (C, start) → jitted prefill_chunk_step
+        # (G, C) → jitted prefill_packed_step: starts are TRACED, so the
+        # packed grid is (log2 G buckets) × (log2 C buckets) — it cannot
+        # grow with start combinations (tests/serve/test_engine.py's
+        # compile-cache accounting test pins the bound)
+        self._packed_fns: dict = {}
+        # slots the most recent prefill_wave dispatched — the failure
+        # domain a caller should release when that dispatch raises
+        self.last_wave_slots: list = []
         self._decode = jax.jit(
             partial(
                 decode_step, config=config,
@@ -1600,6 +1859,13 @@ class InferenceEngine:
         self._sample = jax.jit(sample)
         self._turbo_fns: dict = {}  # steps → jitted decode_loop
         self._argmax = jax.jit(partial(jnp.argmax, axis=-1))
+        # per-step device mirror of the slot-state transition (shared
+        # with decode_loop's scan body): _plain_step advances the cached
+        # decode state on device instead of re-uploading five host
+        # lists per sampled token
+        self._advance_state = jax.jit(
+            partial(advance_decode_state, max_seq=max_seq)
+        )
         self._logprobs = jax.jit(token_logprobs)
         self._mark_seen = jax.jit(_mark_seen, donate_argnums=(0, 1))
         self._mark_prompt = jax.jit(_mark_prompt, donate_argnums=(0, 1))
@@ -1618,6 +1884,15 @@ class InferenceEngine:
                 donate_argnames=("cache",),
             )
         return self._chunk_fns[key]
+
+    def _packed_fn(self, g: int, cl: int):
+        key = (g, cl)
+        if key not in self._packed_fns:
+            self._packed_fns[key] = jax.jit(
+                partial(prefill_packed_step, config=self.config),
+                donate_argnames=("cache",),
+            )
+        return self._packed_fns[key]
 
     def _find_prefix_source(self, prompt: list) -> tuple[int, Optional[int]]:
         """Longest chunk-aligned cached prefix of ``prompt`` among
@@ -1729,6 +2004,8 @@ class InferenceEngine:
             jnp.asarray(slot, jnp.int32),
             jnp.asarray(last_ix, jnp.int32),
         )
+        self.metrics.family("dtpu_serve_prefill_dispatches_total").inc(1)
+        self.metrics.family("dtpu_serve_prefill_pack_rows").observe(1)
         if not final:
             st["next"] = start + cl
             return None
@@ -1736,6 +2013,106 @@ class InferenceEngine:
         if self._prefilling.pop(slot, None) is None:
             return None  # released while the final chunk ran
         return self._activate(slot, st["prompt"], tp, gen, logits)
+
+    def prefill_wave(self) -> dict[int, int]:
+        """ONE prefill dispatch advancing up to ``prefill_pack`` pending
+        prompts a chunk each → {slot: first token} for prompts that
+        completed this wave (empty while all are mid-prompt).
+
+        The packed call (:func:`prefill_packed_step`) takes traced
+        per-row starts, so rows at unequal positions — fresh arrivals
+        next to prefix-cache-resumed ones — share one dispatch and one
+        compiled variant per (G, C) bucket. A lone chunk-aligned row
+        takes the serial per-slot path instead (static start keeps the
+        pallas flash prefill kernel eligible); rows a packed wave left
+        at a non-chunk-aligned start finish packed at G=1 rather than
+        minting serial (C, start) compile variants per odd start.
+        """
+        # SNAPSHOT the pending states first: Scheduler.cancel() can
+        # release a slot (popping its _prefilling entry) from the event
+        # loop while the wave runs on a worker thread — every
+        # pre-dispatch read goes through the snapshot, the wave-wide
+        # form of the serial path's released-concurrently guard. A
+        # cancelled row's chunk still dispatches harmlessly (its slot
+        # can't be reassigned until the next scheduler tick) and is
+        # skipped at activation below.
+        states = {}
+        for s in list(self._prefilling):
+            st = self._prefilling.get(s)
+            if st is not None:
+                states[s] = st
+        if not states:
+            return {}
+        pending = list(states)
+        if self.prefill_pack <= 1 or (
+            len(pending) == 1
+            and states[pending[0]]["next"] % self.prefill_chunk == 0
+        ):
+            slot = pending[0]
+            self.last_wave_slots = [slot]
+            tok = self.prefill_step(slot)
+            return {} if tok is None else {slot: tok}
+        rows = pending[: self.prefill_pack]
+        # published BEFORE dispatch: on an engine error the caller fails
+        # exactly the rows that were in the failing dispatch, not every
+        # queued prefill (slots beyond prefill_pack never ran)
+        self.last_wave_slots = list(rows)
+        # chunk length: the power-of-2 bucket covering the widest
+        # remaining chunk in the pack, capped at prefill_chunk (the
+        # serial path's short-prompt bucketing, shared across rows)
+        need = max(
+            min(states[s]["tp"] - states[s]["next"], self.prefill_chunk)
+            for s in rows
+        )
+        cl = 16
+        while cl < need:
+            cl *= 2
+        cl = min(cl, self.prefill_chunk)
+        # bucket G by powers of two so the (G, C) compile grid stays
+        # log2 × log2; pad rows carry last_ix = -1 (every write drops)
+        g = 1
+        while g < len(rows):
+            g *= 2
+        g = min(g, self.prefill_pack)
+        tok_rows, slot_ix, starts, last_ix = [], [], [], []
+        final = {}
+        for s in rows:
+            st = states[s]
+            tp, start = st["tp"], st["next"]
+            chunk = st["prompt"][start : start + cl]
+            final[s] = start + cl >= tp
+            tok_rows.append(chunk + [0] * (cl - len(chunk)))
+            slot_ix.append(s)
+            starts.append(start)
+            last_ix.append((tp - 1 - start) if final[s] else (cl - 1))
+        for _ in range(g - len(rows)):
+            tok_rows.append([0] * cl)
+            slot_ix.append(0)
+            starts.append(0)
+            last_ix.append(-1)
+        logits, self.cache = self._packed_fn(g, cl)(
+            self.params,
+            self.cache,
+            jnp.asarray(tok_rows, jnp.int32),
+            jnp.asarray(slot_ix, jnp.int32),
+            jnp.asarray(starts, jnp.int32),
+            jnp.asarray(last_ix, jnp.int32),
+        )
+        self.metrics.family("dtpu_serve_prefill_dispatches_total").inc(1)
+        self.metrics.family("dtpu_serve_prefill_pack_rows").observe(len(rows))
+        out: dict[int, int] = {}
+        for i, s in enumerate(rows):
+            st = self._prefilling.get(s)
+            if st is None:
+                continue  # released while the wave ran
+            if not final[s]:
+                st["next"] += cl
+                continue
+            self._prefilling.pop(s, None)
+            out[s] = self._activate(
+                s, st["prompt"], st["tp"], st["gen"], logits[i : i + 1]
+            )
+        return out
 
     def add_request(
         self, prompt: list[int], gen: GenParams
@@ -2017,6 +2394,28 @@ class InferenceEngine:
         parity tests in tests/serve/test_engine.py pin the contract."""
         self._turbo_state = None
 
+    def _decode_state(self) -> tuple:
+        """Device-resident (token, position, budget, active, eos)
+        mirrors of the per-slot host lists, rebuilt only after a
+        host-side mutation (the :meth:`_invalidate_decode_cache`
+        contract). Shared by the turbo macro-step AND the per-step
+        paths — without the mirror, ``_plain_step`` re-uploads five
+        host lists to device on EVERY sampled token, transfers that
+        dominate decode on a remote device."""
+        if self._turbo_state is None:
+            eos = [
+                self.eos[i] if self.eos[i] is not None else -1
+                for i in range(self.max_batch)
+            ]
+            self._turbo_state = (
+                jnp.asarray(self.last_token, jnp.int32),
+                jnp.asarray(self.lengths, jnp.int32),
+                jnp.asarray(self.remaining, jnp.int32),
+                jnp.asarray(self.active, bool),
+                jnp.asarray(eos, jnp.int32),
+            )
+        return self._turbo_state
+
     def _turbo_step(self, live: list) -> dict:
         """One decode_loop macro-step → {slot: [tokens]}. The host
         replays the device's per-step deactivation rules token by token
@@ -2045,18 +2444,7 @@ class InferenceEngine:
             and not self._arrival_busy()
         ):
             depth = min(self.turbo_depth, -(-budget // steps))
-        if self._turbo_state is not None:
-            tok_d, pos_d, rem_d, act_d, eos_d = self._turbo_state
-        else:
-            eos = [
-                self.eos[i] if self.eos[i] is not None else -1
-                for i in range(self.max_batch)
-            ]
-            tok_d = jnp.asarray(self.last_token, jnp.int32)
-            pos_d = jnp.asarray(self.lengths, jnp.int32)
-            rem_d = jnp.asarray(self.remaining, jnp.int32)
-            act_d = jnp.asarray(self.active, bool)
-            eos_d = jnp.asarray(eos, jnp.int32)
+        tok_d, pos_d, rem_d, act_d, eos_d = self._decode_state()
         segs = []
         for _ in range(depth):
             toks_dev, self.cache, tok_d, pos_d, rem_d, act_d = (
@@ -2101,17 +2489,28 @@ class InferenceEngine:
         )
 
     def _plain_step(self, live: list) -> dict[int, int]:
-        tokens = jnp.asarray(self.last_token, jnp.int32)
-        positions = jnp.asarray(self.lengths, jnp.int32)
+        # device-resident decode state: tokens/positions/active come
+        # from the cached mirror (rebuilt only after a host-side slot
+        # mutation — the _invalidate_decode_cache contract) instead of
+        # re-uploading the host lists on every sampled token
+        tok_d, pos_d, rem_d, act_d, eos_d = self._decode_state()
         logits, self.cache = self._decode(
-            self.params, self.cache, tokens, positions,
-            write_mask=jnp.asarray(self.active, bool),
+            self.params, self.cache, tok_d, pos_d, write_mask=act_d,
         )
         if self._all_greedy(live):
             # all-greedy batch: argmax only — the general sampler's
             # full [B, V] descending sort (the dominant per-token cost
             # at a 128k vocab) buys nothing here
-            return self._emit(live, jax.device_get(self._argmax(logits)))
+            sampled_dev = self._argmax(logits)
+            adv = self._advance_state(
+                tok_d, pos_d, rem_d, act_d, eos_d, sampled_dev
+            )
+            out = self._emit(live, jax.device_get(sampled_dev))
+            # _emit invalidated the mirror; the host replay applied the
+            # SAME transition advance_decode_state just did on device,
+            # so the advanced arrays are the valid next-step inputs
+            self._turbo_state = (*adv, eos_d)
+            return out
         sampled_dev, self._key_data = self._sample(
             logits,
             self._key_data,
@@ -2139,7 +2538,12 @@ class InferenceEngine:
                         float(lp[i]),
                         list(zip(map(int, tids[i]), map(float, tlps[i]))),
                     )
-        return self._emit(live, jax.device_get(sampled_dev))
+        adv = self._advance_state(
+            tok_d, pos_d, rem_d, act_d, eos_d, sampled_dev
+        )
+        out = self._emit(live, jax.device_get(sampled_dev))
+        self._turbo_state = (*adv, eos_d)  # see the greedy branch
+        return out
 
     def _advance_slot(self, i: int, tok: int) -> bool:
         """Publish ONE sampled token for slot ``i`` — the single copy
@@ -2186,6 +2590,13 @@ class InferenceEngine:
         self._prefilling.pop(slot, None)
         self._admit_t0.pop(slot, None)
         self._last_logprobs.pop(slot, None)
+
+    def reset_prefix_cache(self) -> None:
+        """Forget every registered reusable prompt prefix (no device
+        work — the KV rows just stop being reuse candidates). For
+        warmup/bench isolation: synthetic prompts must not prefix-hit
+        real traffic or a measured cold run."""
+        self._prefix_registry.clear()
 
     def kv_cache_utilization(self) -> float:
         """Cached tokens across live (active or prefilling) slots as a

@@ -67,6 +67,19 @@ def new_serve_registry() -> Registry:
         "Per-step decode throughput across all active slots",
         buckets=THROUGHPUT_BUCKETS,
     )
+    # prefill dispatch accounting: the packed multi-slot prefill packs
+    # up to prefill_pack concurrent prompt chunks into one forward —
+    # dispatches per burst is the TTFT-under-load lever these observe
+    r.counter(
+        "dtpu_serve_prefill_dispatches_total",
+        "Prefill forward dispatches (a packed wave counts once)",
+    )
+    r.histogram(
+        "dtpu_serve_prefill_pack_rows",
+        "Prompt chunk rows per prefill dispatch (1 = serial; >1 = "
+        "packed multi-slot prefill)",
+        buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0),
+    )
     # engine/scheduler state gauges
     r.gauge("dtpu_serve_queue_depth", "Requests waiting for a slot")
     r.gauge("dtpu_serve_active_slots", "Slots currently decoding")

@@ -165,32 +165,39 @@ class Scheduler:
                 ).observe(time.perf_counter() - req.submitted_at)
             self.by_prefill[slot] = req
 
-        # ONE prefill chunk per tick: decode steps for running slots
-        # interleave between a long prompt's chunks instead of stalling
-        # behind the whole prefill
+        # ONE prefill dispatch per tick — a packed wave advancing up to
+        # prefill_pack pending prompts a chunk each (engine.prefill_wave)
+        # — so decode steps for running slots interleave between chunk
+        # waves instead of stalling behind N serial per-prompt prefills
         if self.by_prefill:
-            slot = next(iter(self.by_prefill))
-            req = self.by_prefill[slot]
-            if req.cancelled:
+            for slot in [
+                s for s, r in self.by_prefill.items() if r.cancelled
+            ]:
                 self.engine.release(slot)
                 del self.by_prefill[slot]
-                return
+        if self.by_prefill:
             try:
-                first = await asyncio.to_thread(self.engine.prefill_step, slot)
+                firsts = await asyncio.to_thread(self.engine.prefill_wave)
             except Exception as e:  # noqa: BLE001 - reported per request
                 logger.exception("prefill failed: %s", e)
-                self.engine.release(slot)
-                self.by_prefill.pop(slot, None)
-                req.error = str(e)
-                req.queue.put_nowait(None)
+                # fail exactly the rows that were in the failing
+                # dispatch (the engine publishes them before running);
+                # prompts beyond prefill_pack never ran and keep their
+                # place in the queue
+                for slot in self.engine.last_wave_slots:
+                    req = self.by_prefill.pop(slot, None)
+                    if req is None:
+                        continue
+                    self.engine.release(slot)
+                    req.error = str(e)
+                    req.queue.put_nowait(None)
                 return
-            if slot not in self.by_prefill:
-                # cancel() landed while the chunk ran on the worker
-                # thread: the slot is already released
-                return
-            if first is not None:  # prompt complete; first token sampled
-                self.by_prefill.pop(slot, None)
-                if req.cancelled:
+            for slot, first in firsts.items():
+                # prompt complete; first token sampled
+                req = self.by_prefill.pop(slot, None)
+                if req is None or req.cancelled:
+                    # cancel() landed while the wave ran on the worker
+                    # thread
                     self.engine.release(slot)
                 elif self._handle_first_token(slot, req, first):
                     self.by_slot[slot] = req
@@ -1050,6 +1057,13 @@ def main(argv=None) -> int:
              "skip prefill/decode compiles, cutting time-to-first-token)",
     )
     p.add_argument(
+        "--prefill-pack", type=int, default=4,
+        help="max concurrent prompt chunks packed into one prefill "
+             "dispatch (a burst of N arrivals costs ceil(N/pack) "
+             "dispatches per chunk wave instead of N; 0/1 = serial "
+             "per-prompt prefill)",
+    )
+    p.add_argument(
         "--spec-draft", type=int, default=4,
         help="prompt-lookup speculative decoding draft length for greedy "
              "requests (0 disables)",
@@ -1190,6 +1204,7 @@ def main(argv=None) -> int:
     engine = InferenceEngine(
         config, params, max_batch=args.max_batch, max_seq=args.max_seq,
         mesh=mesh, spec_draft=args.spec_draft,
+        prefill_pack=args.prefill_pack,
         turbo_steps=args.turbo_steps,
         turbo_depth=args.turbo_depth,
         prefix_cache=not args.no_prefix_cache,
@@ -1241,11 +1256,35 @@ def _warmup_engine(engine) -> None:
         s //= 2
     # sampled path: _decode + the full-batch [B, V] sampler
     run(full[:5], GenParams(max_new_tokens=2, temperature=0.7, seed=0))
+    if engine.prefill_pack > 1:
+        # packed prefill variants: every power-of-2 G bucket at the
+        # full chunk width (the shapes concurrent bursts hit; short-C
+        # buckets are cheap first-hit compiles). Starts are traced, so
+        # one variant per (G, C) covers every start combination.
+        g = 2
+        while g <= engine.prefill_pack and g <= engine.max_batch:
+            slots = [
+                engine.start_request(list(full), GenParams(max_new_tokens=2))
+                for _ in range(g)
+            ]
+            runs += g
+            pending = set(slots)
+            while pending:
+                pending -= set(engine.prefill_wave())
+            while any(engine.active[s] for s in slots):
+                engine.step()
+            for s in slots:
+                engine.release(s)
+            g *= 2
     engine.spec_draft = spec
     if spec:
         # repetitive prompt → drafts fire → verify_step compiles
         rep = (full[:4] * (engine.prefill_chunk // 4 + 1))[: engine.prefill_chunk]
         run(rep, GenParams(max_new_tokens=spec + 2))
+    # warmup prompts aren't real: none may linger as prefix-reuse
+    # candidates (a production prompt sharing their byte pattern would
+    # silently reuse warmup KV rows)
+    engine.reset_prefix_cache()
     if engine.prefix_cache:
         # pre-compile every chunk-aligned prefix-copy variant (trivial
         # fused copies, but a cold jit inside start_request would put

@@ -84,6 +84,29 @@ class TestFlashKernel:
         )
         np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
 
+    def test_q_offset_vector_matches_per_row(self):
+        """Packed multi-slot prefill: a [B] q_offset vector gives each
+        batch row its own causal frontier — must equal per-row calls
+        with the scalar offset (window/softcap included)."""
+        key = jax.random.key(9)
+        k1, k2, k3 = jax.random.split(key, 3)
+        offs = [0, 48, 96]
+        q = jax.random.normal(k1, (3, 2, 32, 16))
+        k = jax.random.normal(k2, (3, 2, 128, 16))
+        v = jax.random.normal(k3, (3, 2, 128, 16))
+        for kw in ({}, {"window": 24}, {"softcap": 20.0}):
+            out = attention(
+                q, k, v, causal=True, q_offset=jnp.asarray(offs), **kw
+            )
+            for i, off in enumerate(offs):
+                ref = attention(
+                    q[i : i + 1], k[i : i + 1], v[i : i + 1],
+                    causal=True, q_offset=off, impl="xla", **kw
+                )
+                np.testing.assert_allclose(
+                    out[i : i + 1], ref, rtol=1e-5, atol=1e-5
+                )
+
 
 class TestLossFunctions:
     def test_fused_and_chunked_match_reference(self):

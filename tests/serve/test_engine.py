@@ -942,6 +942,250 @@ class TestExpertParallelServing:
         assert eng.generate(prompt, GenParams(max_new_tokens=5)) == ref
 
 
+def _drive_packed(eng, prompts, gens, stagger=None):
+    """Admit prompts at staggered wave offsets, drive prefill_wave +
+    step interleaved to completion → per-request token lists."""
+    stagger = stagger or [0] * len(prompts)
+    slots, outs = {}, [[] for _ in prompts]
+    admitted, wave = 0, 0
+    def live():
+        return any(eng.active[s] for s in slots)
+    while admitted < len(prompts) or eng.prefilling_slots() or live():
+        while (
+            admitted < len(prompts)
+            and stagger[admitted] <= wave
+            and eng.free_slots()
+        ):
+            s = eng.start_request(prompts[admitted], gens[admitted])
+            slots[s] = admitted
+            admitted += 1
+        for s, t in eng.prefill_wave().items():
+            outs[slots[s]].append(t)
+        for s, toks in eng.step().items():
+            if s in slots:
+                outs[slots[s]].extend(toks)
+        wave += 1
+        assert wave < 500
+    return outs
+
+
+class TestPackedPrefill:
+    """Packed multi-slot prefill (one [G, C] dispatch per chunk wave)
+    must be token-identical to serial per-prompt prefill — the
+    masked-future invariant: short rows, pad rows, and unequal starts
+    all scatter out of range instead of corrupting neighbors."""
+
+    config = llama.LLAMA_TINY
+
+    def setup_method(self):
+        self.params = llama.init_params(self.config, jax.random.key(0))
+
+    def _engine(self, **kw):
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("max_seq", 128)
+        kw.setdefault("prefill_chunk", 16)
+        kw.setdefault("prefill_pack", 4)
+        kw.setdefault("spec_draft", 0)
+        kw.setdefault("turbo_steps", 0)
+        return InferenceEngine(self.config, self.params, **kw)
+
+    def test_staggered_greedy_burst_matches_reference(self):
+        # lengths straddle chunk boundaries; arrival 3 joins mid-wave
+        # so the pack holds rows at unequal starts
+        prompts = [
+            [(7 * i + 3) % self.config.vocab_size for i in range(40)],
+            [5, 99, 321, 7, 250],
+            [(11 * i + 2) % self.config.vocab_size for i in range(23)],
+            [(5 * i + 1) % self.config.vocab_size for i in range(33)],
+        ]
+        gens = [GenParams(max_new_tokens=5) for _ in prompts]
+        eng = self._engine()
+        outs = _drive_packed(eng, prompts, gens, stagger=[0, 0, 0, 1])
+        for p, got in zip(prompts, outs):
+            assert got == _reference_greedy(self.params, self.config, p, 5)
+        # the burst actually packed: fewer dispatches than serial chunks
+        rows = eng.metrics.family("dtpu_serve_prefill_pack_rows")
+        assert rows.sum() > rows.count()  # some dispatch carried > 1 row
+
+    def test_seeded_sampled_burst_matches_serial(self):
+        prompts = [list(range(3, 40)), list(range(60, 85)), [9, 9, 2, 7]]
+        mk = lambda: [  # noqa: E731
+            GenParams(max_new_tokens=6, temperature=0.9, seed=11),
+            GenParams(max_new_tokens=6, temperature=1.3, seed=5),
+            GenParams(max_new_tokens=6, temperature=0.7, seed=2),
+        ]
+        packed = _drive_packed(self._engine(), prompts, mk())
+        serial = _drive_packed(self._engine(prefill_pack=0), prompts, mk())
+        assert packed == serial
+
+    def test_prefix_hit_row_packs_at_unequal_start(self):
+        """A prefix-cache-resumed row (start 32) packs with a fresh row
+        (start 0) in one dispatch; both streams must stay exact."""
+        shared = list(range(40, 80))
+        p2 = shared + [9, 9, 2]
+        p3 = [7, 3, 1, 4, 4, 2, 9] * 3
+        cold = self._engine(prefix_cache=False, prefill_pack=0)
+        ref2 = cold.generate(p2, GenParams(max_new_tokens=5))
+        ref3 = cold.generate(p3, GenParams(max_new_tokens=5))
+        eng = self._engine()
+        eng.generate(shared + [3, 1], GenParams(max_new_tokens=3))
+        outs = _drive_packed(
+            eng, [p2, p3],
+            [GenParams(max_new_tokens=5), GenParams(max_new_tokens=5)],
+        )
+        assert eng.prefix_hits == 1
+        assert outs[0] == ref2
+        assert outs[1] == ref3
+
+    def test_mla_packed_matches_serial(self):
+        config = llama.MLA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        mk = lambda n: InferenceEngine(  # noqa: E731
+            config, params, max_batch=4, max_seq=96, prefill_chunk=16,
+            prefill_pack=n, spec_draft=0, turbo_steps=0,
+        )
+        prompts = [list(range(3, 40)), [5, 99, 321, 7]]
+        gens = lambda: [GenParams(max_new_tokens=4)] * 2  # noqa: E731
+        assert _drive_packed(mk(4), prompts, gens()) == \
+            _drive_packed(mk(0), prompts, gens())
+
+    def test_release_mid_wave_frees_slot(self):
+        eng = self._engine()
+        p = [(3 * i) % self.config.vocab_size for i in range(60)]
+        s1 = eng.start_request(p, GenParams(max_new_tokens=4))
+        s2 = eng.start_request([1, 2, 3], GenParams(max_new_tokens=4))
+        eng.prefill_wave()  # s2 completes, s1 mid-prompt
+        eng.release(s1)
+        assert s1 in eng.free_slots()
+        ref = _reference_greedy(self.params, self.config, [4, 5, 6], 3)
+        assert eng.generate([4, 5, 6], GenParams(max_new_tokens=3)) == ref
+
+    def test_lone_aligned_row_takes_serial_path(self):
+        """A single chunk-aligned pending prompt keeps the static-start
+        serial path (flash-kernel eligible); a burst takes the packed
+        one."""
+        eng = self._engine()
+        eng.start_request(list(range(40)), GenParams(max_new_tokens=2))
+        eng.prefill_wave()
+        assert not eng._packed_fns  # serial: (C, start) variant only
+        assert eng._chunk_fns
+        eng.start_request(list(range(50, 90)), GenParams(max_new_tokens=2))
+        eng.prefill_wave()
+        assert eng._packed_fns  # two rows pending → packed dispatch
+
+
+class TestDecodeStateMirror:
+    """_plain_step keeps (token, position, budget, active) device-
+    resident between steps instead of re-uploading host lists per
+    sampled token; EVERY host-side slot mutation must invalidate the
+    mirror (the _invalidate_decode_cache contract) or decode silently
+    runs from stale state."""
+
+    config = llama.LLAMA_TINY
+
+    def setup_method(self):
+        self.params = llama.init_params(self.config, jax.random.key(0))
+
+    def _engine(self, **kw):
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("max_seq", 64)
+        kw.setdefault("spec_draft", 0)
+        kw.setdefault("turbo_steps", 0)
+        return InferenceEngine(self.config, self.params, **kw)
+
+    def test_mirror_set_after_step_cleared_on_mutation(self):
+        eng = self._engine()
+        slot, _ = eng.add_request([5, 9, 21], GenParams(max_new_tokens=8))
+        assert eng._turbo_state is None  # activation invalidated it
+        eng.step()
+        assert eng._turbo_state is not None  # mirror survives the step
+        eng.release(slot)
+        assert eng._turbo_state is None  # release must invalidate
+
+    def test_slot_reuse_not_stale(self):
+        # a fresh request into a just-released slot must decode from
+        # its own state, not the mirror of the previous occupant
+        eng = self._engine(max_batch=1)
+        ref = self._engine(max_batch=1)
+        for prompt in ([5, 99, 321], [7, 8, 9, 10]):
+            g = lambda: GenParams(  # noqa: E731
+                max_new_tokens=7, temperature=1.1, seed=13
+            )
+            assert eng.generate(prompt, g()) == ref.generate(prompt, g())
+
+    def test_staggered_admission_sampled_not_stale(self):
+        # admission mid-stream mutates slot state: the mirror must
+        # rebuild or the newcomer decodes from garbage
+        eng = self._engine(max_batch=3, max_seq=128)
+        one = self._engine(max_batch=3, max_seq=128)
+        g1 = lambda: GenParams(max_new_tokens=8, temperature=0.9, seed=3)  # noqa: E731
+        g2 = lambda: GenParams(max_new_tokens=6, temperature=1.2, seed=9)  # noqa: E731
+        p1, p2 = [10, 20, 30, 40], [400, 3, 77]
+        ref1 = one.generate(p1, g1())
+        ref2 = one.generate(p2, g2())
+        s1, t1 = eng.add_request(p1, g1())
+        got1, got2 = [t1], []
+        got1.extend(eng.step().get(s1, []))  # mirror now cached
+        s2, t2 = eng.add_request(p2, g2())
+        got2.append(t2)
+        while eng.active[s1] or eng.active[s2]:
+            out = eng.step()
+            got1.extend(out.get(s1, []))
+            got2.extend(out.get(s2, []))
+        assert got1 == ref1
+        assert got2 == ref2
+
+
+class TestCompileCacheAccounting:
+    """Packing must not reintroduce a per-(start-combination) compile
+    zoo: packed variants are keyed (G, C) with TRACED starts, so a
+    mixed packed/serial/prefix-hit workload stays within
+    (log2 pack + 1) × (log2 chunk/16 + 1) packed variants and the
+    serial path's documented (C, start) grid."""
+
+    config = llama.LLAMA_TINY
+
+    def test_variant_count_bounded_across_start_combinations(self):
+        import math
+
+        params = llama.init_params(self.config, jax.random.key(0))
+        chunk, pack = 16, 4
+        eng = InferenceEngine(
+            self.config, params, max_batch=4, max_seq=128,
+            prefill_chunk=chunk, prefill_pack=pack,
+            spec_draft=0, turbo_steps=0,
+        )
+        gen = lambda: GenParams(max_new_tokens=2)  # noqa: E731
+        shared = list(range(40, 80))
+        # serial request (registers a reusable prefix), then three
+        # bursts with different length mixes and a prefix-hit row —
+        # many distinct start combinations through the packed path
+        eng.generate(shared + [1], gen())
+        bursts = [
+            [list(range(3, 40)), [5, 6, 7]],
+            [shared + [9, 2], list(range(60, 95)), [4, 4]],
+            [list(range(10, 73)), list(range(20, 41)), [8], [9, 1, 2]],
+        ]
+        for prompts in bursts:
+            _drive_packed(eng, prompts, [gen() for _ in prompts])
+        packed_bound = (int(math.log2(pack)) + 1) * (
+            int(math.log2(eng.prefill_chunk // 16)) + 1
+        )
+        assert len(eng._packed_fns) <= packed_bound, eng._packed_fns
+        # serial variants: chunk-aligned starts only (short buckets at
+        # start 0 + one per chunk-multiple start) — never one per odd
+        # packed start
+        assert all(s % chunk == 0 for (_, s) in eng._chunk_fns)
+        n_packed = len(eng._packed_fns)
+        # MORE start combinations must not mint new packed variants
+        _drive_packed(
+            eng,
+            [list(range(30, 95)), list(range(5, 22)), [7, 7, 7]],
+            [gen()] * 3,
+        )
+        assert len(eng._packed_fns) == n_packed
+
+
 class TestLogitBiasMinP:
     config = llama.LLAMA_TINY
 
