@@ -97,9 +97,11 @@ class ScalingSpec(CoreModel):
 
     Parity: reference core/models/configurations.py ``ScalingSpec``
     (metric ``rps``, consumed by RPSAutoscaler, services/autoscalers.py:60).
+    ``queue-depth`` selects the QueueDepthAutoscaler: ``target`` is then
+    the tolerated probed queue depth per replica, with RPS as fallback.
     """
 
-    metric: Literal["rps"] = "rps"
+    metric: Literal["rps", "queue-depth"] = "rps"
     target: float = 10.0
     scale_up_delay: Duration = 300
     scale_down_delay: Duration = 600

@@ -9,18 +9,22 @@ connection pool; reference gateway/routers/registry.py:122). Routes:
 - ``POST /api/registry/services/unregister``
 - ``POST /api/registry/replicas/register``   attach replica (job_id, host, port)
 - ``POST /api/registry/replicas/unregister``
+- ``POST /api/registry/replicas/drain``      stop new traffic, finish inflight
 - ``GET /api/stats``                         per-service RPS windows
+- ``GET /metrics``                           dtpu_router_* Prometheus text
 - ``POST /api/config``                       acme email, server url (auth checks)
 
 Data path: nginx in production (configs written per service); embedded
 aiohttp proxy always available — by ``Host`` header for registered
 domains, by path ``/services/{project}/{run}/...``, and an
-OpenAI-compatible ``/models/{project}/...`` router.
+OpenAI-compatible ``/models/{project}/...`` router. Replica selection
+goes through the shared routing pool (``dstack_tpu.routing``):
+least-outstanding picks over probed health, per-replica circuit
+breakers, and failover before a client ever sees an upstream error.
 """
 
 import argparse
 import asyncio
-import itertools
 import json
 import time
 from pathlib import Path
@@ -32,12 +36,15 @@ from aiohttp import web
 from dstack_tpu.gateway.nginx import NginxManager
 from dstack_tpu.gateway.state import GatewayState, Replica, Service
 from dstack_tpu.gateway.stats import AccessLogTailer, GatewayStats
+from dstack_tpu.routing import (
+    PoolRegistry,
+    forward_with_failover,
+    get_router_registry,
+)
 from dstack_tpu.utils.logging import get_logger
 from dstack_tpu.version import __version__
 
 logger = get_logger("gateway.app")
-
-_rr = itertools.count()
 
 
 class GatewayAgent:
@@ -53,9 +60,19 @@ class GatewayAgent:
         self.nginx = nginx
         self.server_url = server_url
         self.stats = GatewayStats()
+        self.pools = PoolRegistry()
         self.tailer: Optional[AccessLogTailer] = None
         self._session: Optional[aiohttp.ClientSession] = None
         self._auth_cache: dict[str, tuple[bool, float]] = {}
+
+    def pool_for(self, svc: Service):
+        """The routing pool for a service, membership-synced from the
+        registry (health state persists across syncs)."""
+        pool = self.pools.pool(svc.project, svc.run_name)
+        pool.sync(
+            (r.job_id, r.host, r.port) for r in svc.replicas.values()
+        )
+        return pool
 
     def session(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
@@ -106,6 +123,10 @@ class GatewayAgent:
 
 
 def _registry_auth(agent: GatewayAgent, request: web.Request) -> Optional[web.Response]:
+    """→ a 401 response, or None when authorized. Callers MUST test
+    ``is not None``: an unprepared aiohttp Response is falsy (its
+    __len__ is the body length, 0 here), so a bare truthiness check
+    silently waves every request through."""
     if agent.token is None:
         return None
     auth = request.headers.get("Authorization", "")
@@ -131,47 +152,17 @@ async def _service_auth(
 async def _forward(
     agent: GatewayAgent, request: web.Request, svc: Service, path: str
 ) -> web.StreamResponse:
-    replicas = list(svc.replicas.values())
-    if not replicas:
+    pool = agent.pool_for(svc)
+    if pool.size() == 0:
         return web.json_response(
             {"detail": f"no running replicas for {svc.run_name}"}, status=503
         )
-    r = replicas[next(_rr) % len(replicas)]
-    url = f"http://{r.host}:{r.port}/{path.lstrip('/')}"
-    if request.query_string:
-        url += f"?{request.query_string}"
-    body = await request.read()
-    headers = {
-        k: v
-        for k, v in request.headers.items()
-        if k.lower() not in ("host", "authorization", "transfer-encoding")
-    }
-    try:
-        async with agent.session().request(
-            request.method, url, data=body, headers=headers
-        ) as upstream:
-            # pass response headers through except hop-by-hop ones
-            # (Set-Cookie/Location/rate-limit headers must survive)
-            hop = {
-                "transfer-encoding", "connection", "keep-alive", "upgrade",
-                "content-length", "proxy-authenticate", "te", "trailers",
-            }
-            out_headers = [
-                (k, v) for k, v in upstream.headers.items() if k.lower() not in hop
-            ]
-            resp = web.StreamResponse(status=upstream.status)
-            for k, v in out_headers:
-                resp.headers.add(k, v)
-            await resp.prepare(request)
-            async for chunk in upstream.content.iter_chunked(64 * 1024):
-                await resp.write(chunk)
-            await resp.write_eof()
-            return resp
-    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-        return web.json_response({"detail": f"replica unreachable: {e}"}, status=502)
+    return await forward_with_failover(request, pool, agent.session(), path)
 
 
-def build_app(agent: GatewayAgent) -> web.Application:
+def build_app(
+    agent: GatewayAgent, probe_interval: Optional[float] = None
+) -> web.Application:
     app = web.Application()
     app["agent"] = agent
 
@@ -182,7 +173,7 @@ def build_app(agent: GatewayAgent) -> web.Application:
 
     async def register_service(request: web.Request) -> web.Response:
         denied = _registry_auth(agent, request)
-        if denied:
+        if denied is not None:
             return denied
         b = await request.json()
         svc = Service(
@@ -202,7 +193,7 @@ def build_app(agent: GatewayAgent) -> web.Application:
 
     async def unregister_service(request: web.Request) -> web.Response:
         denied = _registry_auth(agent, request)
-        if denied:
+        if denied is not None:
             return denied
         b = await request.json()
         svc = agent.state.unregister_service(b["project"], b["run_name"])
@@ -212,7 +203,7 @@ def build_app(agent: GatewayAgent) -> web.Application:
 
     async def register_replica(request: web.Request) -> web.Response:
         denied = _registry_auth(agent, request)
-        if denied:
+        if denied is not None:
             return denied
         b = await request.json()
         try:
@@ -228,7 +219,7 @@ def build_app(agent: GatewayAgent) -> web.Application:
 
     async def unregister_replica(request: web.Request) -> web.Response:
         denied = _registry_auth(agent, request)
-        if denied:
+        if denied is not None:
             return denied
         b = await request.json()
         svc = agent.state.unregister_replica(
@@ -238,9 +229,71 @@ def build_app(agent: GatewayAgent) -> web.Application:
             await agent.sync_nginx(svc)
         return web.json_response({"status": "ok"})
 
+    async def drain_replica(request: web.Request) -> web.Response:
+        """Mark a replica DRAINING ahead of unregister: the picker stops
+        sending new work while inflight requests finish (the server
+        calls this on scale-down, then unregisters once drained).
+        ``cancel: true`` reverses it (scale-down aborted before the
+        drain finished) and puts the replica back in rotation."""
+        denied = _registry_auth(agent, request)
+        if denied is not None:
+            return denied
+        b = await request.json()
+        svc = agent.state.get(b["project"], b["run_name"])
+        if svc is None:
+            return web.json_response({"detail": "service not found"}, status=404)
+        pool = agent.pool_for(svc)
+        job_id = str(b["job_id"])
+        nginx_routed = agent.nginx is not None and bool(svc.domain)
+        if b.get("cancel"):
+            if pool.cancel_draining(job_id) and nginx_routed:
+                await agent.sync_nginx(svc)  # replica back in upstreams
+            return web.json_response({"status": "ok", "drained": False})
+        newly_marked = not pool.is_draining(job_id)
+        if not pool.mark_draining(job_id, b.get("deadline_seconds")):
+            return web.json_response({"detail": "replica not found"}, status=404)
+        if newly_marked and nginx_routed:
+            # nginx keeps its own connections: rewrite the upstream
+            # block without the draining replica so the production data
+            # path stops sending NEW requests too. Only on the state
+            # transition — the server polls this endpoint every tick,
+            # and each sync is a config write + nginx reload
+            import dataclasses as _dc
+
+            live = {
+                k: r for k, r in svc.replicas.items()
+                if not pool.is_draining(k)
+            }
+            await agent.sync_nginx(_dc.replace(svc, replicas=live))
+        drained = pool.drained(job_id)
+        if drained and nginx_routed:
+            # nginx's own inflight requests are invisible to the pool:
+            # behind nginx a drain is only over when its deadline is —
+            # outstanding==0 proves nothing about nginx-routed streams
+            entry = pool.get(job_id)
+            if entry is not None and time.monotonic() < entry.drain_deadline_at:
+                drained = False
+        return web.json_response({"status": "ok", "drained": drained})
+
+    async def router_metrics(request: web.Request) -> web.StreamResponse:
+        # a registered custom domain owns its whole path space — its
+        # /metrics (e.g. the in-repo OpenAI server's serve metrics)
+        # keeps proxying to the replica, exactly as before this route
+        if agent.state.by_domain(request.headers.get("Host", "")) is not None:
+            return await host_proxy(request)
+        # replica topology and health are deployment metadata: same
+        # token gate as /api/stats
+        denied = _registry_auth(agent, request)
+        if denied is not None:
+            return denied
+        agent.pools.update_state_gauge()
+        return web.Response(
+            text=get_router_registry().render(), content_type="text/plain"
+        )
+
     async def get_stats(request: web.Request) -> web.Response:
         denied = _registry_auth(agent, request)
-        if denied:
+        if denied is not None:
             return denied
         if agent.tailer is not None:
             agent.tailer.poll()
@@ -248,7 +301,7 @@ def build_app(agent: GatewayAgent) -> web.Application:
 
     async def set_config(request: web.Request) -> web.Response:
         denied = _registry_auth(agent, request)
-        if denied:
+        if denied is not None:
             return denied
         b = await request.json()
         agent.state.set_config(
@@ -265,7 +318,9 @@ def build_app(agent: GatewayAgent) -> web.Application:
     app.router.add_post("/api/registry/services/unregister", unregister_service)
     app.router.add_post("/api/registry/replicas/register", register_replica)
     app.router.add_post("/api/registry/replicas/unregister", unregister_replica)
+    app.router.add_post("/api/registry/replicas/drain", drain_replica)
     app.router.add_get("/api/stats", get_stats)
+    app.router.add_get("/metrics", router_metrics)
     app.router.add_post("/api/config", set_config)
 
     # ---- embedded data path ----
@@ -278,7 +333,7 @@ def build_app(agent: GatewayAgent) -> web.Application:
         if svc is None:
             return web.json_response({"detail": "service not found"}, status=404)
         denied = await _service_auth(agent, svc, request)
-        if denied:
+        if denied is not None:
             return denied
         agent.stats.record(project, run_name)
         # strip_prefix=false services expect the full request path
@@ -318,7 +373,7 @@ def build_app(agent: GatewayAgent) -> web.Application:
                 {"detail": f"model {payload.get('model')!r} not found"}, status=404
             )
         denied = await _service_auth(agent, svc, request)
-        if denied:
+        if denied is not None:
             return denied
         agent.stats.record(project, svc.run_name)
         return await _forward(
@@ -335,7 +390,7 @@ def build_app(agent: GatewayAgent) -> web.Application:
         if svc is None:
             return web.json_response({"detail": "not found"}, status=404)
         denied = await _service_auth(agent, svc, request)
-        if denied:
+        if denied is not None:
             return denied
         agent.stats.record(svc.project, svc.run_name)
         return await _forward(agent, request, svc, request.path)
@@ -347,9 +402,40 @@ def build_app(agent: GatewayAgent) -> web.Application:
     )
     app.router.add_route("*", "/{path:.*}", host_proxy)
 
+    async def _probe_loop() -> None:
+        """Poll every replica's /health on an interval: the data the
+        picker and the DEGRADED/DEAD transitions run on. Pools are
+        membership-synced from the registry first, so replicas get
+        probed even before their first request."""
+        timeout = aiohttp.ClientTimeout(total=agent.pools.config.probe_timeout)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            while True:
+                try:
+                    for svc in list(agent.state.services.values()):
+                        agent.pool_for(svc)
+                    agent.pools.prune(agent.state.services.keys())
+                    await agent.pools.probe_all(session)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 - loop must survive
+                    logger.exception("probe loop tick failed: %s", e)
+                await asyncio.sleep(probe_interval)
+
+    async def on_startup(app: web.Application) -> None:
+        if probe_interval:
+            app["probe_task"] = asyncio.create_task(_probe_loop())
+
     async def on_cleanup(app: web.Application) -> None:
+        task = app.get("probe_task")
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
         await agent.close()
 
+    app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
     return app
 
@@ -363,6 +449,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("--server-url", default="")
     p.add_argument("--nginx-conf-dir", default="")
     p.add_argument("--access-log", default="")
+    p.add_argument(
+        "--probe-interval", type=float, default=2.0,
+        help="seconds between replica /health probes (0 disables the "
+             "probing loop; picks then rely on request outcomes only)",
+    )
     args = p.parse_args(argv)
 
     state = GatewayState(Path(args.state_file) if args.state_file else None)
@@ -381,7 +472,7 @@ def main(argv: Optional[list[str]] = None) -> None:
     )
     if args.access_log:
         agent.tailer = AccessLogTailer(Path(args.access_log), state, agent.stats)
-    app = build_app(agent)
+    app = build_app(agent, probe_interval=args.probe_interval or None)
     logger.info("tpu-gateway listening on %s:%d", args.host, args.port)
     web.run_app(app, host=args.host, port=args.port, print=None)
 

@@ -3,12 +3,13 @@
 Parity: reference server/services/proxy (``/proxy/services/{proj}/{run}/``
 gateway-less ingress, service_proxy.py:135) and the model adapter
 (reference proxy/lib/routers/model_proxy.py:102, clients/openai.py:67 /
-tgi.py:208). Requests resolve the run's RUNNING service replicas and
-round-robin across them; each request is recorded for the RPS
+tgi.py:208). Requests resolve the run's RUNNING service replicas into
+the shared routing pool (``dstack_tpu.routing``): picks are
+least-outstanding over probed replica health, connect errors and 5xx
+fail over to another replica, and each request is recorded for the
 autoscaler.
 """
 
-import itertools
 import json
 from typing import Optional
 
@@ -17,18 +18,17 @@ from aiohttp import web
 
 from dstack_tpu.core.models.runs import JobProvisioningData, JobStatus
 from dstack_tpu.proxy.stats import get_service_stats
+from dstack_tpu.routing import forward_with_failover, get_pool_registry
 from dstack_tpu.server.db import Database, loads
 from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("proxy.service")
 
-_rr_counter = itertools.count()
-
 
 async def _resolve_replicas(
     db: Database, project_name: str, run_name: str
-) -> list[tuple[str, int]]:
-    """→ [(host, port)] of RUNNING service replicas."""
+) -> list[tuple[str, str, int]]:
+    """→ [(job_id, host, port)] of RUNNING service replicas."""
     project = await db.fetchone(
         "SELECT * FROM projects WHERE name = ? AND deleted = 0", (project_name,)
     )
@@ -52,8 +52,20 @@ async def _resolve_replicas(
             continue
         jpd = JobProvisioningData.model_validate(jpd_raw)
         # host networking: service listens on its container port on the host
-        out.append((jpd.hostname or "127.0.0.1", int(spec["service_port"])))
+        out.append(
+            (job["id"], jpd.hostname or "127.0.0.1", int(spec["service_port"]))
+        )
     return out
+
+
+async def _synced_pool(db: Database, project: str, run_name: str):
+    """Resolve RUNNING replicas and reconcile them into the shared
+    routing pool (health state survives across requests; membership is
+    authoritative from the DB every time)."""
+    replicas = await _resolve_replicas(db, project, run_name)
+    pool = get_pool_registry().pool(project, run_name)
+    pool.sync(replicas)
+    return pool
 
 
 def _proxy_session(app: web.Application) -> aiohttp.ClientSession:
@@ -67,37 +79,6 @@ def _proxy_session(app: web.Application) -> aiohttp.ClientSession:
         )
         state["proxy_session"] = session
     return session
-
-
-async def _forward(
-    request: web.Request, host: str, port: int, path: str
-) -> web.StreamResponse:
-    url = f"http://{host}:{port}/{path.lstrip('/')}"
-    if request.query_string:
-        url += f"?{request.query_string}"
-    body = await request.read()
-    headers = {
-        k: v
-        for k, v in request.headers.items()
-        if k.lower() not in ("host", "authorization", "transfer-encoding")
-    }
-    session = _proxy_session(request.app)
-    try:
-        async with session.request(
-            request.method, url, data=body, headers=headers
-        ) as upstream:
-            resp = web.StreamResponse(
-                status=upstream.status, headers={"Content-Type": upstream.content_type}
-            )
-            await resp.prepare(request)
-            async for chunk in upstream.content.iter_chunked(64 * 1024):
-                await resp.write(chunk)
-            await resp.write_eof()
-            return resp
-    except aiohttp.ClientError as e:
-        return web.json_response(
-            {"detail": f"service unreachable: {e}"}, status=502
-        )
 
 
 async def _bearer_user(request: web.Request, db: Database):
@@ -160,13 +141,14 @@ async def service_proxy_handler(request: web.Request) -> web.StreamResponse:
     # for runs that actually exist (no unbounded keys from random names)
     if run_row is not None:
         get_service_stats().record(project, run_name)
-    replicas = await _resolve_replicas(db, project, run_name)
-    if not replicas:
+    pool = await _synced_pool(db, project, run_name)
+    if pool.size() == 0:
         return web.json_response(
             {"detail": f"no running replicas for {run_name}"}, status=503
         )
-    host, port = replicas[next(_rr_counter) % len(replicas)]
-    return await _forward(request, host, port, path)
+    return await forward_with_failover(
+        request, pool, _proxy_session(request.app), path
+    )
 
 
 async def model_proxy_handler(request: web.Request) -> web.StreamResponse:
@@ -191,21 +173,45 @@ async def model_proxy_handler(request: web.Request) -> web.StreamResponse:
     if denied is not None:
         return denied
     get_service_stats().record(project, run_name)  # before the 503 check
-    replicas = await _resolve_replicas(db, project, run_name)
-    if not replicas:
+    pool = await _synced_pool(db, project, run_name)
+    if pool.size() == 0:
         return web.json_response(
             {"detail": f"no running replicas for model {model_name}"}, status=503
         )
-    host, port = replicas[next(_rr_counter) % len(replicas)]
     spec = loads(run_row["run_spec"])
     model_conf = spec.get("configuration", {}).get("model", {}) or {}
     if model_conf.get("format") == "tgi":
-        return await _tgi_chat_completions(
-            request, payload, host, port, path, model_conf
-        )
+        # the TGI adapter drives its own upstream exchange (SSE
+        # re-framing): pick one healthy replica, no mid-protocol retries
+        entry = pool.pick()
+        if entry is None:
+            return web.json_response(
+                {"detail": f"no healthy replicas for model {model_name}"},
+                status=503,
+                headers={"Retry-After": str(pool.retry_after_hint())},
+            )
+        pool.acquire(entry)
+        try:
+            resp = await _tgi_chat_completions(
+                request, payload, entry.host, entry.port, path, model_conf
+            )
+        except Exception:
+            pool.report_failure(entry)
+            raise
+        else:
+            if resp.status < 500:
+                pool.report_success(entry)
+            else:
+                pool.report_failure(entry)
+            return resp
+        finally:
+            pool.release(entry)
     prefix = model_conf.get("prefix", "/v1")
-    return await _forward(
-        request, host, port, f"{prefix.strip('/')}/{path.lstrip('/')}"
+    return await forward_with_failover(
+        request,
+        pool,
+        _proxy_session(request.app),
+        f"{prefix.strip('/')}/{path.lstrip('/')}",
     )
 
 

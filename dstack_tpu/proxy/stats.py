@@ -32,16 +32,22 @@ class ServiceStats:
         self._external[(project, run_name)] = (rps, time.monotonic())
 
     def rps(self, project: str, run_name: str, over_seconds: float = 60.0) -> float:
-        total = 0.0
+        # policy: max, not sum, of the gateway-scraped window and the
+        # locally recorded requests — deliberately conservative
+        # de-duplication (relay topologies can report the same requests
+        # through both channels; mixed split-ingress traffic is instead
+        # under-counted, the cheaper autoscaling error)
+        local = 0.0
+        external = 0.0
         ext = self._external.get((project, run_name))
         if ext is not None and time.monotonic() - ext[1] < 120.0:
-            total += ext[0]
+            external = ext[0]
         q = self._requests.get((project, run_name))
         if q:
             self._trim(q)
             cutoff = time.monotonic() - over_seconds
-            total += sum(1 for t in q if t >= cutoff) / over_seconds
-        return total
+            local = sum(1 for t in q if t >= cutoff) / over_seconds
+        return max(local, external)
 
     def snapshot(
         self,
@@ -73,8 +79,10 @@ class ServiceStats:
         rps60 = recent / 60.0
         ext = self._external.get((project, run_name))
         if ext is not None and now - ext[1] < 120.0:
-            out[-1] += ext[0]
-            rps60 += ext[0]
+            # same max-not-sum policy as rps(): both sources watched
+            # the same requests when both are live
+            out[-1] = max(out[-1], ext[0])
+            rps60 = max(rps60, ext[0])
         return round(rps60, 3), [round(v, 3) for v in out]
 
     def last_request_at(self, project: str, run_name: str) -> float:

@@ -1,0 +1,35 @@
+"""Health-aware replica routing shared by the in-server proxy and the
+standalone gateway: replica pools with a probed state machine
+(STARTING → READY → DEGRADED → DRAINING → DEAD), least-outstanding
+picking behind per-replica circuit breakers, failover forwarding, and
+graceful draining. Exports ``dtpu_router_*`` metrics through the obs
+package."""
+
+from dstack_tpu.routing.forward import (
+    copy_response_headers,
+    filter_request_headers,
+    forward_with_failover,
+)
+from dstack_tpu.routing.metrics import get_router_registry, new_router_registry
+from dstack_tpu.routing.pool import (
+    PoolConfig,
+    PoolRegistry,
+    ReplicaEntry,
+    ReplicaPool,
+    ReplicaState,
+    get_pool_registry,
+)
+
+__all__ = [
+    "PoolConfig",
+    "PoolRegistry",
+    "ReplicaEntry",
+    "ReplicaPool",
+    "ReplicaState",
+    "copy_response_headers",
+    "filter_request_headers",
+    "forward_with_failover",
+    "get_pool_registry",
+    "get_router_registry",
+    "new_router_registry",
+]

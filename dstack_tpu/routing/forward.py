@@ -1,0 +1,153 @@
+"""Health-aware request forwarding with failover.
+
+The one data-path helper both proxies share: pick a replica from the
+pool, forward, and on a connect error or 5xx — as long as the response
+has not started streaming to the client — retry on a different replica.
+Only when every routable replica has been tried does the client see an
+error, and then it is a 503 with a ``Retry-After`` derived from the
+earliest breaker half-open, never a raw upstream 502.
+
+Response headers pass through minus hop-by-hop ones, so
+``x-request-id``, cache headers, and SSE headers survive the proxy.
+"""
+
+import asyncio
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from dstack_tpu.routing.metrics import get_router_registry
+from dstack_tpu.routing.pool import ReplicaPool
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("routing.forward")
+
+# RFC 9110 hop-by-hop headers, plus the framing headers aiohttp manages
+# itself. content-encoding is dropped because the client session
+# auto-decompresses upstream bodies: re-advertising gzip over an
+# already-inflated stream would corrupt it.
+_DROP_REQUEST = frozenset({"host", "authorization", "transfer-encoding"})
+_DROP_RESPONSE = frozenset({
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailers", "transfer-encoding", "upgrade",
+    "content-length", "content-encoding",
+})
+
+
+def filter_request_headers(headers) -> dict:
+    return {k: v for k, v in headers.items() if k.lower() not in _DROP_REQUEST}
+
+
+def copy_response_headers(upstream, resp: web.StreamResponse) -> None:
+    for k, v in upstream.headers.items():
+        if k.lower() not in _DROP_RESPONSE:
+            resp.headers.add(k, v)
+
+
+async def _stream_body(pool, entry, upstream, resp: web.StreamResponse):
+    """Relay the upstream body chunk by chunk, attributing failures to
+    the right side: an upstream read error is the replica's fault (it
+    died mid-stream — breaker accounting, truncated stream ended); a
+    client write error is not (clients abort streams routinely; marking
+    a healthy replica DEAD for that would 503 real traffic)."""
+    try:
+        async for chunk in upstream.content.iter_chunked(64 * 1024):
+            try:
+                await resp.write(chunk)
+            except (ConnectionError, RuntimeError):
+                return resp  # client disconnected: no replica penalty
+        await resp.write_eof()
+    except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+        if not isinstance(e, aiohttp.ClientError):
+            # the proxy session's own total-timeout budget ran out on a
+            # long stream — the proxy's limit, not replica failure: no
+            # breaker penalty, just end the truncated stream
+            logger.warning(
+                "stream to %s/%s hit the proxy timeout budget",
+                pool.project, pool.run_name,
+            )
+        else:
+            pool.report_failure(entry)
+            logger.warning(
+                "replica %s died mid-stream for %s/%s: %r",
+                entry.replica_id, pool.project, pool.run_name, e,
+            )
+        try:
+            await resp.write_eof()
+        except (ConnectionError, RuntimeError, aiohttp.ClientError):
+            pass
+    return resp
+
+
+async def forward_with_failover(
+    request: web.Request,
+    pool: ReplicaPool,
+    session: aiohttp.ClientSession,
+    path: str,
+    max_attempts: Optional[int] = None,
+) -> web.StreamResponse:
+    """Forward ``request`` to a pool replica, failing over across
+    replicas until one answers or the pool is exhausted."""
+    m = get_router_registry()
+    body = await request.read()
+    req_headers = filter_request_headers(request.headers)
+    query = f"?{request.query_string}" if request.query_string else ""
+    tried: set = set()
+    limit = max_attempts if max_attempts is not None else max(1, pool.size())
+    attempts = 0
+    last_error = "no routable replicas"
+    while attempts < limit:
+        entry = pool.pick(exclude=tried)
+        if entry is None:
+            break
+        if attempts > 0:
+            m.family("dtpu_router_failovers_total").inc(1)
+        attempts += 1
+        tried.add(entry.replica_id)
+        url = f"http://{entry.host}:{entry.port}/{path.lstrip('/')}{query}"
+        pool.acquire(entry)
+        try:
+            try:
+                upstream_ctx = session.request(
+                    request.method, url, data=body, headers=req_headers
+                )
+                upstream = await upstream_ctx.__aenter__()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                # connect/send failure: replica's fault, safe to retry
+                pool.report_failure(entry)
+                last_error = repr(e)
+                continue
+            try:
+                if upstream.status >= 500:
+                    # response not committed: another replica may serve
+                    pool.report_failure(entry)
+                    last_error = f"replica answered {upstream.status}"
+                    continue
+                pool.report_success(entry)
+                resp = web.StreamResponse(status=upstream.status)
+                copy_response_headers(upstream, resp)
+                try:
+                    await resp.prepare(request)
+                    return await _stream_body(pool, entry, upstream, resp)
+                except (ConnectionError, RuntimeError) as e:
+                    # the CLIENT went away before/while the response was
+                    # being committed — not the replica's fault; no
+                    # breaker penalty, nothing left to answer
+                    logger.debug("client gone during response: %r", e)
+                    return resp
+            finally:
+                await upstream_ctx.__aexit__(None, None, None)
+        finally:
+            pool.release(entry)
+    m.family("dtpu_router_exhausted_total").inc(1)
+    return web.json_response(
+        {
+            "detail": (
+                f"no healthy replicas for {pool.run_name} "
+                f"({last_error})"
+            )
+        },
+        status=503,
+        headers={"Retry-After": str(pool.retry_after_hint())},
+    )

@@ -1,0 +1,79 @@
+"""Router metric families (``dtpu_router_*``, obs registry factory).
+
+One construction point for every series the replica-routing subsystem
+exports, used by:
+
+- :mod:`dstack_tpu.routing.pool` — pick/breaker/probe accounting at the
+  source, so the in-server proxy and the standalone gateway report the
+  same series from the same code.
+- ``server/services/prometheus.py`` — renders the process-global
+  registry into the server's ``/metrics`` page.
+- ``gateway/app.py`` — serves the same render from the gateway agent's
+  own ``/metrics``.
+- ``tools/check_metrics_docs.py`` — enumerates the family names to hold
+  docs/reference/server.md to account.
+
+Import-light on purpose (no jax, no aiohttp): the docs checker and unit
+tests instantiate the registry without a serving runtime.
+"""
+
+from typing import Optional
+
+from dstack_tpu.obs import Registry, SHORT_LATENCY_BUCKETS_S
+
+
+def new_router_registry() -> Registry:
+    """Registry pre-populated with every router metric family."""
+    r = Registry()
+    r.counter(
+        "dtpu_router_picks_total",
+        "Replica picks by replica state at pick time",
+        labelnames=("state",),
+    )
+    r.counter(
+        "dtpu_router_failovers_total",
+        "Requests retried on another replica after a connect error or "
+        "5xx (before the response started streaming)",
+    )
+    r.counter(
+        "dtpu_router_exhausted_total",
+        "Requests answered 503 because every replica was tried or "
+        "unroutable (dead/draining)",
+    )
+    r.counter(
+        "dtpu_router_breaker_opens_total",
+        "Circuit-breaker opens (replica marked DEAD after consecutive "
+        "failures)",
+    )
+    r.counter(
+        "dtpu_router_probe_failures_total",
+        "Health probes that failed (connect error, timeout, or 5xx)",
+    )
+    r.counter(
+        "dtpu_router_drained_total",
+        "Replicas that finished draining (inflight hit zero or the "
+        "drain deadline passed)",
+    )
+    r.histogram(
+        "dtpu_router_probe_seconds",
+        "Round-trip latency of successful /health probes",
+        buckets=SHORT_LATENCY_BUCKETS_S,
+    )
+    r.gauge(
+        "dtpu_router_replicas",
+        "Known replicas by state across all pools in this process",
+        labelnames=("state",),
+    )
+    return r
+
+
+_registry: Optional[Registry] = None
+
+
+def get_router_registry() -> Registry:
+    """The process-global router registry (proxy and gateway run in
+    different processes in production; in tests both feed this one)."""
+    global _registry
+    if _registry is None:
+        _registry = new_router_registry()
+    return _registry

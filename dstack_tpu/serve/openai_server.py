@@ -528,7 +528,24 @@ def build_app(
     app.on_cleanup.append(on_cleanup)
 
     async def health(request):
-        return web.json_response({"status": "ok", "model": model_name})
+        """Liveness plus live load: queue depth, inflight count, and KV
+        utilization from the engine's obs gauges — what the routing
+        layer's probe loop reads to drive READY/DEGRADED transitions
+        and least-loaded picks (dstack_tpu.routing.pool)."""
+        e = sched.engine
+        e.update_state_gauges()
+        m = e.metrics
+        return web.json_response({
+            "status": "ok",
+            "model": model_name,
+            "queue_depth": sched.pending.qsize(),
+            "inflight": len(sched.by_slot) + len(sched.by_prefill),
+            "active_slots": int(m.family("dtpu_serve_active_slots").value()),
+            "max_slots": int(m.family("dtpu_serve_max_slots").value()),
+            "kv_utilization": m.family(
+                "dtpu_serve_kv_cache_utilization_ratio"
+            ).value(),
+        })
 
     async def models(request):
         return web.json_response(

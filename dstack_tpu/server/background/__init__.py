@@ -23,6 +23,9 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
         process_terminating_jobs,
     )
     from dstack_tpu.server.background.tasks.process_gateways import process_gateways
+    from dstack_tpu.server.background.tasks.process_replica_health import (
+        probe_service_replicas,
+    )
     from dstack_tpu.server.background.tasks.process_prometheus_metrics import (
         collect_prometheus_metrics,
     )
@@ -41,9 +44,15 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
     sched.add(lambda: process_volumes(db), 10.0, "process_volumes")
     sched.add(lambda: process_placement_groups(db), 30.0, "process_placement_groups")
     sched.add(lambda: process_gateways(db), 5.0, "process_gateways")
-    sched.add(lambda: collect_metrics(db), 10.0, "collect_metrics")
     from dstack_tpu.server import settings
 
+    if settings.REPLICA_PROBE_INTERVAL > 0:  # 0 disables probing
+        sched.add(
+            lambda: probe_service_replicas(db),
+            float(settings.REPLICA_PROBE_INTERVAL),
+            "probe_service_replicas",
+        )
+    sched.add(lambda: collect_metrics(db), 10.0, "collect_metrics")
     if settings.ENABLE_PROMETHEUS_METRICS:
         sched.add(
             lambda: collect_prometheus_metrics(db),

@@ -1,0 +1,56 @@
+"""Replica health probing loop for the in-server proxy's routing pools.
+
+Each tick resolves the RUNNING replicas of every active service run
+into the shared pool registry (``dstack_tpu.routing``) and probes each
+replica's ``/health`` — so replicas reach READY/DEGRADED/DEAD from
+probe evidence even before the first proxied request, pools of deleted
+services are pruned, and the ``dtpu_router_replicas`` gauge stays
+current for ``/metrics``.
+"""
+
+import aiohttp
+
+from dstack_tpu.core.models.runs import RunStatus
+from dstack_tpu.routing import get_pool_registry
+from dstack_tpu.server.db import Database, loads
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.replica_health")
+
+_ACTIVE = (RunStatus.RUNNING.value, RunStatus.PROVISIONING.value)
+
+
+async def probe_service_replicas(db: Database) -> None:
+    from dstack_tpu.proxy.service_proxy import _resolve_replicas
+
+    registry = get_pool_registry()
+    projects = {
+        p["id"]: p["name"] for p in await db.fetchall("SELECT * FROM projects")
+    }
+    runs = await db.fetchall(
+        f"SELECT * FROM runs WHERE status IN ({','.join('?' for _ in _ACTIVE)}) "
+        "AND deleted = 0",
+        _ACTIVE,
+    )
+    keys = set()
+    for run in runs:
+        conf = (loads(run["run_spec"]) or {}).get("configuration", {})
+        if conf.get("type") != "service":
+            continue
+        project_name = projects.get(run["project_id"])
+        if project_name is None:
+            continue
+        key = (project_name, run["run_name"])
+        keys.add(key)
+        replicas = await _resolve_replicas(db, project_name, run["run_name"])
+        registry.pool(*key).sync(replicas)
+    registry.prune(keys)
+    if not registry.pools:
+        registry.update_state_gauge()
+        return
+    timeout = aiohttp.ClientTimeout(total=registry.config.probe_timeout)
+    # a fresh session per tick: the scheduler may drive this from
+    # different event loops across app lifecycles (tests), and a probe
+    # tick is a handful of local HTTP GETs
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+        await registry.probe_all(session)

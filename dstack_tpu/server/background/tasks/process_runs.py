@@ -141,6 +141,22 @@ async def _process(db: Database, run_id: str) -> None:
 _last_scaled: dict[str, float] = {}
 
 
+async def _gateway_for_service(db: Database, project_row: dict, conf):
+    """The gateway row publishing this service, or None — including
+    when the configured gateway has since been deleted
+    (resolve_run_gateway raises then; a dangling gateway reference must
+    not abort replica reconciliation)."""
+    from dstack_tpu.server.services import gateways as gateways_service
+
+    try:
+        return await gateways_service.resolve_run_gateway(
+            db, project_row, {"type": "service", **conf.model_dump()}
+        )
+    except Exception as e:  # noqa: BLE001 - degraded mode: no gateway
+        logger.warning("gateway resolution failed: %s", e)
+        return None
+
+
 async def _process_service_run(db: Database, run_row: dict, job_rows: list[dict]) -> None:
     """Service replica reconciliation + status aggregation.
 
@@ -190,9 +206,38 @@ async def _process_service_run(db: Database, run_row: dict, job_rows: list[dict]
         get_job_specs_from_run_spec,
     )
 
+    from dstack_tpu.routing import get_pool_registry
+
+    pool = get_pool_registry().pool(project["name"], run_row["run_name"])
     for num in range(desired):
         row = by_replica.get(num)
         if row is not None and not JobStatus(row["status"]).is_finished():
+            # a replica back under the desired count mid-drain (demand
+            # returned) goes back into rotation instead of sitting
+            # unroutable forever — on every data plane that was told to
+            # drain (the publishing gateway marked it too). When the
+            # local pool doesn't know a RUNNING replica yet (probe sync
+            # pending) the gateway might still be draining it: send the
+            # idempotent cancel anyway — only for RUNNING ones; a
+            # provisioning replica can never have been drain-marked
+            if pool.cancel_draining(row["id"]) or (
+                row["status"] == JobStatus.RUNNING.value
+                and not pool.has(row["id"])
+                # the not-yet-synced window only exists while the probe
+                # task is on; with probing disabled this heuristic would
+                # fire (and POST the gateway) every tick forever
+                and settings.REPLICA_PROBE_INTERVAL > 0
+            ):
+                gw_row = await _gateway_for_service(db, project, conf)
+                if gw_row is not None:
+                    from dstack_tpu.server.services import (
+                        gateways as gateways_service,
+                    )
+
+                    await gateways_service.cancel_drain_replica(
+                        gw_row, project["name"], run_row["run_name"],
+                        row["id"],
+                    )
             continue
         if row is not None and row.get("termination_reason") not in (
             None,
@@ -222,16 +267,64 @@ async def _process_service_run(db: Database, run_row: dict, job_rows: list[dict]
         for spec in get_job_specs_from_run_spec(run_spec, replica_num=num):
             await jobs_service.create_job_row(db, run_row, spec, submission_num=sub)
         logger.info("service %s: (re)starting replica %d", run_row["run_name"], num)
-    # scale down excess replicas
-    for num, row in sorted(active.items(), reverse=True):
-        if num >= desired and row["status"] != JobStatus.TERMINATING.value:
-            await jobs_service.update_job_status(
-                db,
-                row["id"],
-                JobStatus.TERMINATING,
-                termination_reason=JobTerminationReason.SCALED_DOWN,
-                run_id=run_row["id"],
-            )
+    # scale down excess replicas — gracefully: a RUNNING replica is
+    # marked DRAINING in every data plane that routes to it (the
+    # in-server pool directly, a publishing gateway via its drain API)
+    # and only terminates once inflight requests finish everywhere or
+    # the drain deadline passes
+    excess = [
+        (num, row)
+        for num, row in sorted(active.items(), reverse=True)
+        if num >= desired and row["status"] != JobStatus.TERMINATING.value
+    ]
+    gw_row = None
+    if any(r["status"] == JobStatus.RUNNING.value for _, r in excess):
+        from dstack_tpu.server.services import gateways as gateways_service
+
+        gw_row = await _gateway_for_service(db, project, conf)
+        # the pool may be empty right after a server restart (pools are
+        # in-memory; the probe task hasn't synced yet) — resolve and
+        # sync here so a RUNNING replica still drains instead of being
+        # killed with requests inflight
+        from dstack_tpu.proxy.service_proxy import _resolve_replicas
+
+        pool.sync(
+            await _resolve_replicas(db, project["name"], run_row["run_name"])
+        )
+    for num, row in excess:
+        if row["status"] == JobStatus.RUNNING.value:
+            drained = True
+            first_mark = False
+            if pool.has(row["id"]):
+                if not pool.is_draining(row["id"]):
+                    pool.mark_draining(row["id"], settings.SERVICE_DRAIN_SECONDS)
+                    first_mark = True
+                    drained = False
+                else:
+                    drained = pool.drained(row["id"])
+            if gw_row is not None:
+                gw_drained = await gateways_service.drain_replica(
+                    gw_row, project["name"], run_row["run_name"], row["id"],
+                    settings.SERVICE_DRAIN_SECONDS,
+                )
+                if gw_drained is not None:
+                    # the gateway's inflight view gates teardown too; an
+                    # unreachable/unaware agent must not block it
+                    drained = drained and gw_drained
+            if first_mark:
+                logger.info(
+                    "service %s: draining replica %d before scale-down",
+                    run_row["run_name"], num,
+                )
+            if not drained:
+                continue  # inflight requests still finishing somewhere
+        await jobs_service.update_job_status(
+            db,
+            row["id"],
+            JobStatus.TERMINATING,
+            termination_reason=JobTerminationReason.SCALED_DOWN,
+            run_id=run_row["id"],
+        )
 
     # aggregate status: RUNNING if any replica serves
     statuses = {JobStatus(r["status"]) for r in job_rows}

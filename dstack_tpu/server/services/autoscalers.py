@@ -44,14 +44,20 @@ class RPSAutoscaler(BaseScaler):
         self.replicas = replicas
         self.scaling = scaling
 
-    def get_desired_count(self, project, run_name, current, last_scaled_at) -> int:
+    def _bounds(self) -> tuple[int, int]:
         lo = self.replicas.min if self.replicas.min is not None else 0
         hi = self.replicas.max or max(lo, 1)
+        return lo, hi
+
+    def _needed_for_rps(self, project, run_name, target: float, lo: int) -> int:
         rps = get_service_stats().rps(project, run_name, over_seconds=60.0)
         # replicas needed so that per-replica RPS <= target
         import math
 
-        needed = math.ceil(rps / self.scaling.target) if rps > 0 else lo
+        return math.ceil(rps / target) if rps > 0 else lo
+
+    def _clamp_and_delay(self, needed, current, last_scaled_at) -> int:
+        lo, hi = self._bounds()
         desired = min(max(needed, lo), hi)
         now = time.monotonic()
         if last_scaled_at is not None:
@@ -62,11 +68,57 @@ class RPSAutoscaler(BaseScaler):
                 return current
         return desired
 
+    def get_desired_count(self, project, run_name, current, last_scaled_at) -> int:
+        lo, _ = self._bounds()
+        needed = self._needed_for_rps(project, run_name, self.scaling.target, lo)
+        return self._clamp_and_delay(needed, current, last_scaled_at)
+
+
+class QueueDepthAutoscaler(RPSAutoscaler):
+    """Scales on probed engine queue depth, combined with RPS.
+
+    ``scaling.target`` is the tolerated queue depth per replica (tokens
+    of the ``metric: queue-depth`` configuration). The probed total
+    comes from the routing pool's /health data
+    (:meth:`dstack_tpu.routing.pool.ReplicaPool.probe_summary`) — the
+    direct saturation signal RPS only approximates. RPS (against a
+    conservative default per-replica target) still participates as a
+    floor, and becomes the ONLY signal when probes are stale (probe
+    loop down, replicas not yet probed): a blind scaler must fail
+    toward the coarse metric, not toward zero.
+    """
+
+    FALLBACK_RPS_TARGET = 10.0
+
+    def get_desired_count(self, project, run_name, current, last_scaled_at) -> int:
+        import math
+
+        from dstack_tpu.routing import get_pool_registry
+
+        lo, _ = self._bounds()
+        rps_needed = self._needed_for_rps(
+            project, run_name, self.FALLBACK_RPS_TARGET, lo
+        )
+        summary = get_pool_registry().pool(project, run_name).probe_summary()
+        if summary is None:
+            needed = rps_needed  # probes stale: RPS keeps the lights on
+        else:
+            total_queue, _fresh = summary
+            qd_needed = (
+                math.ceil(total_queue / max(self.scaling.target, 1e-9))
+                if total_queue > 0
+                else lo
+            )
+            needed = max(rps_needed, qd_needed)
+        return self._clamp_and_delay(needed, current, last_scaled_at)
+
 
 def get_service_scaler(conf: ServiceConfiguration) -> BaseScaler:
     replicas = conf.replicas
     if not isinstance(replicas, IntRange):
         replicas = IntRange.model_validate(replicas)
     if conf.scaling is not None and replicas.min != replicas.max:
+        if conf.scaling.metric == "queue-depth":
+            return QueueDepthAutoscaler(replicas, conf.scaling)
         return RPSAutoscaler(replicas, conf.scaling)
     return ManualScaler(replicas)

@@ -321,6 +321,52 @@ async def register_replica(
     return resp is not None
 
 
+async def drain_replica(
+    gateway_row: dict,
+    project_name: str,
+    run_name: str,
+    job_id: str,
+    deadline_seconds: float,
+) -> Optional[bool]:
+    """Tell the gateway agent to stop routing to a replica and report
+    whether its inflight requests have finished. → the agent's drained
+    verdict, or None when the agent is unreachable / doesn't know the
+    replica (callers must not block teardown on a dead gateway)."""
+    resp = await call_agent(
+        gateway_row,
+        "POST",
+        "/api/registry/replicas/drain",
+        {
+            "project": project_name,
+            "run_name": run_name,
+            "job_id": job_id,
+            "deadline_seconds": deadline_seconds,
+        },
+    )
+    if resp is None:
+        return None
+    return bool(resp.get("drained"))
+
+
+async def cancel_drain_replica(
+    gateway_row: dict, project_name: str, run_name: str, job_id: str
+) -> None:
+    """Best-effort reversal of :func:`drain_replica` when scale-down is
+    aborted before the drain finishes — without it the gateway would
+    keep the still-RUNNING replica unroutable forever."""
+    await call_agent(
+        gateway_row,
+        "POST",
+        "/api/registry/replicas/drain",
+        {
+            "project": project_name,
+            "run_name": run_name,
+            "job_id": job_id,
+            "cancel": True,
+        },
+    )
+
+
 async def unregister_replica(
     db: Database, gateway_row: dict, project_name: str, run_name: str, job_id: str
 ) -> None:

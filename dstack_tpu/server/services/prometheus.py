@@ -60,6 +60,12 @@ async def render_metrics(db: Database) -> str:
     from dstack_tpu.server.tracing import get_request_stats
 
     w.raw(get_request_stats().render_prometheus())
+    # replica-routing series (picks, failovers, breaker opens, probe
+    # latency) from the shared routing pools the in-server proxy uses
+    from dstack_tpu.routing import get_pool_registry, get_router_registry
+
+    get_pool_registry().update_state_gauge()
+    w.raw(get_router_registry().render())
     return w.render()
 
 

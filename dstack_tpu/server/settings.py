@@ -52,6 +52,13 @@ MAX_PROCESSING_JOBS = _env_int("DTPU_MAX_PROCESSING_JOBS", 15)
 MAX_PROCESSING_INSTANCES = _env_int("DTPU_MAX_PROCESSING_INSTANCES", 15)
 MAX_OFFERS_TRIED = _env_int("DTPU_MAX_OFFERS_TRIED", 25)
 
+# Graceful replica drain budget (seconds): a scaled-down service
+# replica stops receiving new requests immediately but keeps serving
+# inflight ones this long before the job is terminated.
+SERVICE_DRAIN_SECONDS = _env_int("DTPU_SERVICE_DRAIN_SECONDS", 30)
+# Interval between replica /health probes driving the routing pools.
+REPLICA_PROBE_INTERVAL = _env_int("DTPU_REPLICA_PROBE_INTERVAL", 2)
+
 # Provisioning deadlines (seconds). Parity: process_instances.py:110.
 PROVISIONING_TIMEOUT = _env_int("DTPU_PROVISIONING_TIMEOUT", 600)
 # Graceful volume detach budget before attachment rows are force-dropped

@@ -1,0 +1,410 @@
+"""Failover, draining, and probing through the real gateway data path.
+
+The gateway agent embeds the same ``dstack_tpu.routing`` pool +
+forwarder the in-server proxy uses, without needing a control plane —
+so these tests exercise the shared subsystem end-to-end: kill a replica
+mid-burst and assert zero client-visible 5xx, drain a replica and
+assert inflight streams finish while new requests route elsewhere.
+"""
+
+import asyncio
+
+import aiohttp
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.gateway.app import GatewayAgent, build_app
+from dstack_tpu.gateway.state import GatewayState, Replica, Service
+from dstack_tpu.routing import (
+    PoolConfig,
+    ReplicaPool,
+    ReplicaState,
+    get_router_registry,
+)
+
+
+def _replica_app(name: str, hits: list, health: dict = None) -> web.Application:
+    app = web.Application()
+
+    async def ok(request):
+        hits.append(request.path)
+        return web.Response(
+            text=f"{name}-ok", headers={"x-request-id": f"req-{name}"}
+        )
+
+    async def slow_stream(request):
+        hits.append(request.path)
+        resp = web.StreamResponse(headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        for _ in range(10):
+            await resp.write(b"x")
+            await asyncio.sleep(0.05)
+        await resp.write_eof()
+        return resp
+
+    async def health_handler(request):
+        return web.json_response(health or {"queue_depth": 0})
+
+    app.router.add_get("/slow", slow_stream)
+    app.router.add_get("/health", health_handler)
+    app.router.add_route("*", "/{path:.*}", ok)
+    return app
+
+
+async def _gateway(replicas: list) -> tuple:
+    """A gateway serving one auth-less service over ``replicas``
+    [(job_id, TestServer)]; → (client, agent)."""
+    state = GatewayState(None)
+    agent = GatewayAgent(state)
+    state.register_service(
+        Service(project="p", run_name="svc", auth=False, https=False)
+    )
+    for job_id, server in replicas:
+        state.register_replica(
+            "p", "svc", Replica(job_id=job_id, host=server.host, port=server.port)
+        )
+    client = TestClient(TestServer(build_app(agent)))
+    await client.start_server()
+    return client, agent
+
+
+class TestFailover:
+    async def test_kill_one_replica_mid_burst_zero_5xx(self):
+        """Acceptance: 2 replicas, one killed mid-burst → every request
+        still answers 200 (connect errors fail over before the response
+        starts), the dead replica's breaker opens, and the survivor
+        absorbs the rest of the burst."""
+        hits1, hits2 = [], []
+        r1 = TestServer(_replica_app("r1", hits1))
+        r2 = TestServer(_replica_app("r2", hits2))
+        await r1.start_server()
+        await r2.start_server()
+        client, agent = await _gateway([("a", r1), ("b", r2)])
+        failovers = get_router_registry().family("dtpu_router_failovers_total")
+        failovers_before = failovers.value()
+        statuses = []
+
+        async def one() -> int:
+            r = await client.get("/services/p/svc/ok")
+            return r.status
+
+        try:
+            # concurrent warm burst: least-outstanding spreads the
+            # overlapping requests across both replicas
+            statuses += await asyncio.gather(*(one() for _ in range(6)))
+            assert hits1 and hits2
+            await r1.close()  # kill replica 1 mid-burst
+            for _ in range(20):
+                r = await client.get("/services/p/svc/ok")
+                statuses.append(r.status)
+            assert statuses == [200] * len(statuses)  # zero client 5xx
+            entry = agent.pools.pool("p", "svc").get("a")
+            assert entry.state == ReplicaState.DEAD  # breaker opened
+            assert failovers.value() > failovers_before
+            # once the breaker is open, picks skip the dead replica:
+            # the survivor saw the whole post-kill burst
+            assert len(hits2) >= 20
+        finally:
+            await client.close()
+            await r2.close()
+
+    async def test_upstream_headers_survive_the_proxy(self):
+        """Non-hop-by-hop upstream headers (x-request-id here) must
+        reach the client — the old proxy dropped everything but
+        Content-Type."""
+        hits = []
+        r1 = TestServer(_replica_app("r1", hits))
+        await r1.start_server()
+        client, _ = await _gateway([("a", r1)])
+        try:
+            r = await client.get("/services/p/svc/ok")
+            assert r.status == 200
+            assert r.headers["x-request-id"] == "req-r1"
+            assert r.headers["Content-Type"].startswith("text/plain")
+        finally:
+            await client.close()
+            await r1.close()
+
+    async def test_pool_exhausted_returns_503_with_retry_after(self):
+        hits = []
+        r1 = TestServer(_replica_app("r1", hits))
+        await r1.start_server()
+        client, agent = await _gateway([("a", r1)])
+        # force the single replica DEAD with a long breaker window
+        pool = agent.pools.pool("p", "svc")
+        pool.config.startup_grace = 0.0
+        pool.config.breaker_base_backoff = 60.0
+        await r1.close()
+        try:
+            statuses = set()
+            for _ in range(5):
+                r = await client.get("/services/p/svc/ok")
+                statuses.add(r.status)
+            assert statuses == {503}  # failures burn down, then breaker
+            r = await client.get("/services/p/svc/ok")
+            assert r.status == 503
+            assert int(r.headers["Retry-After"]) >= 1
+        finally:
+            await client.close()
+
+
+class TestDraining:
+    async def test_draining_replica_finishes_inflight_gets_no_new_work(self):
+        """Acceptance: a DRAINING replica completes its inflight stream
+        (full body delivered) while every new request routes to the
+        other replica."""
+        hits1, hits2 = [], []
+        r1 = TestServer(_replica_app("r1", hits1))
+        r2 = TestServer(_replica_app("r2", hits2))
+        await r1.start_server()
+        await r2.start_server()
+        # job id "a" sorts first: the tie-broken first pick lands on r1
+        client, agent = await _gateway([("a", r1), ("b", r2)])
+        try:
+            stream = await client.get("/services/p/svc/slow")
+            assert stream.status == 200
+            assert hits1 == ["/slow"]  # inflight on r1
+            pool = agent.pools.pool("p", "svc")
+            assert pool.get("a").outstanding == 1
+            # drain r1 through the gateway API while the stream runs
+            r = await client.post(
+                "/api/registry/replicas/drain",
+                json={"project": "p", "run_name": "svc", "job_id": "a"},
+            )
+            assert r.status == 200 and not (await r.json())["drained"]
+            for _ in range(8):  # new work all lands on r2
+                r = await client.get("/services/p/svc/ok")
+                assert r.status == 200
+            assert len(hits1) == 1 and len(hits2) == 8
+            body = await stream.read()  # inflight stream completes
+            assert body == b"x" * 10
+            assert pool.drained("a")
+            r = await client.post(
+                "/api/registry/replicas/drain",
+                json={"project": "p", "run_name": "svc", "job_id": "a"},
+            )
+            assert (await r.json())["drained"]
+        finally:
+            await client.close()
+            await r1.close()
+            await r2.close()
+
+
+class TestStreamFailureAttribution:
+    """Mid-stream failures must be charged to the right side: the
+    replica when IT dies, nobody when the CLIENT aborts (clients abort
+    LLM streams routinely — three aborts must not open the breaker)."""
+
+    def _fixtures(self):
+        pool = ReplicaPool("p", "svc", PoolConfig(startup_grace=0.0))
+        pool.sync([("a", "h", 1)])
+        entry = pool.get("a")
+        entry.state = ReplicaState.READY
+        return pool, entry
+
+    class _Upstream:
+        def __init__(self, chunks, error=None):
+            self._chunks = chunks
+            self._error = error
+            outer = self
+
+            class _Content:
+                async def iter_chunked(self, n):
+                    for c in outer._chunks:
+                        yield c
+                    if outer._error is not None:
+                        raise outer._error
+
+            self.content = _Content()
+
+    class _Resp:
+        def __init__(self, fail_write_after=None):
+            self.written = []
+            self.fail_write_after = fail_write_after
+            self.eof = False
+
+        async def write(self, chunk):
+            if (
+                self.fail_write_after is not None
+                and len(self.written) >= self.fail_write_after
+            ):
+                raise ConnectionResetError("Cannot write to closing transport")
+            self.written.append(chunk)
+
+        async def write_eof(self):
+            self.eof = True
+
+    async def test_client_abort_no_replica_penalty(self):
+        from dstack_tpu.routing.forward import _stream_body
+
+        pool, entry = self._fixtures()
+        upstream = self._Upstream([b"a", b"b", b"c"])
+        resp = self._Resp(fail_write_after=1)  # client gone after chunk 1
+        await _stream_body(pool, entry, upstream, resp)
+        assert entry.consecutive_failures == 0
+        assert entry.state == ReplicaState.READY
+
+    async def test_proxy_timeout_budget_no_replica_penalty(self):
+        """The proxy session's own total-timeout expiring on a long
+        stream is the proxy's limit, not replica failure."""
+        from dstack_tpu.routing.forward import _stream_body
+
+        pool, entry = self._fixtures()
+        upstream = self._Upstream([b"a"], error=asyncio.TimeoutError())
+        resp = self._Resp()
+        await _stream_body(pool, entry, upstream, resp)
+        assert entry.consecutive_failures == 0
+        assert resp.eof
+
+    async def test_upstream_death_counts_against_replica(self):
+        from dstack_tpu.routing.forward import _stream_body
+
+        pool, entry = self._fixtures()
+        upstream = self._Upstream(
+            [b"a"], error=aiohttp.ClientPayloadError("upstream died")
+        )
+        resp = self._Resp()
+        await _stream_body(pool, entry, upstream, resp)
+        assert entry.consecutive_failures == 1
+        assert resp.eof  # truncated stream still ended for the client
+
+    async def test_clean_stream_relays_everything(self):
+        from dstack_tpu.routing.forward import _stream_body
+
+        pool, entry = self._fixtures()
+        upstream = self._Upstream([b"a", b"b"])
+        resp = self._Resp()
+        await _stream_body(pool, entry, upstream, resp)
+        assert resp.written == [b"a", b"b"] and resp.eof
+        assert entry.consecutive_failures == 0
+
+
+class TestGatewayMetricsRoute:
+    async def test_metrics_requires_registry_token(self):
+        state = GatewayState(None)
+        agent = GatewayAgent(state, token="gw-token")
+        client = TestClient(TestServer(build_app(agent)))
+        await client.start_server()
+        try:
+            r = await client.get("/metrics")
+            assert r.status == 401
+            r = await client.get(
+                "/metrics", headers={"Authorization": "Bearer gw-token"}
+            )
+            assert r.status == 200
+            assert "dtpu_router_replicas" in await r.text()
+        finally:
+            await client.close()
+
+    async def test_metrics_host_routed_service_still_proxied(self):
+        """A registered custom domain owns /metrics too: scrapes of the
+        replica's own metrics page must keep working."""
+        app = web.Application()
+
+        async def replica_metrics(request):
+            return web.Response(text="replica_metric 1")
+
+        app.router.add_get("/metrics", replica_metrics)
+        server = TestServer(app)
+        await server.start_server()
+        state = GatewayState(None)
+        agent = GatewayAgent(state, token="gw-token")
+        state.register_service(Service(
+            project="p", run_name="svc", auth=False, https=False,
+            domain="svc.example.com",
+        ))
+        state.register_replica(
+            "p", "svc", Replica(job_id="a", host=server.host, port=server.port)
+        )
+        client = TestClient(TestServer(build_app(agent)))
+        await client.start_server()
+        try:
+            r = await client.get(
+                "/metrics", headers={"Host": "svc.example.com"}
+            )
+            assert r.status == 200
+            assert await r.text() == "replica_metric 1"
+        finally:
+            await client.close()
+            await server.close()
+
+
+class TestProbing:
+    async def test_probe_promotes_and_degrades(self):
+        hits = []
+        healthy = TestServer(_replica_app("h", hits, {"queue_depth": 1}))
+        loaded = TestServer(
+            _replica_app("l", hits, {"queue_depth": 99, "kv_utilization": 0.2})
+        )
+        await healthy.start_server()
+        await loaded.start_server()
+        pool = ReplicaPool("p", "svc", PoolConfig())
+        pool.sync([
+            ("a", healthy.host, healthy.port),
+            ("b", loaded.host, loaded.port),
+        ])
+        async with aiohttp.ClientSession() as session:
+            assert await pool.probe_replica(session, pool.get("a"))
+            assert await pool.probe_replica(session, pool.get("b"))
+            assert pool.get("a").state == ReplicaState.READY
+            assert pool.get("a").probe["queue_depth"] == 1
+            # second probe applies the DEGRADED classification (first
+            # promotes out of STARTING)
+            assert await pool.probe_replica(session, pool.get("b"))
+            assert pool.get("b").state == ReplicaState.DEGRADED
+        assert pool.probe_summary() == (100.0, 2)
+        await healthy.close()
+        await loaded.close()
+
+    async def test_probe_failures_kill_after_grace(self):
+        pool = ReplicaPool(
+            "p", "svc", PoolConfig(startup_grace=0.0, breaker_base_backoff=60.0)
+        )
+        pool.sync([("a", "127.0.0.1", 1)])  # nothing listens on port 1
+        failures = get_router_registry().family(
+            "dtpu_router_probe_failures_total"
+        )
+        before = failures.value()
+        async with aiohttp.ClientSession() as session:
+            for _ in range(3):
+                assert not await pool.probe_replica(session, pool.get("a"))
+        assert pool.get("a").state == ReplicaState.DEAD
+        assert failures.value() == before + 3
+        # inside the breaker window the prober skips it
+        assert pool.probe_targets() == []
+
+    async def test_abandoned_drain_self_heals_on_probe(self):
+        """A DRAINING replica still registered and healthy long past
+        its deadline (control plane restarted and forgot) must rejoin
+        rotation instead of staying blackholed forever."""
+        hits = []
+        server = TestServer(_replica_app("r", hits))
+        await server.start_server()
+        pool = ReplicaPool("p", "svc", PoolConfig(drain_deadline=0.0))
+        pool.sync([("a", server.host, server.port)])
+        e = pool.get("a")
+        e.state = ReplicaState.READY
+        pool.mark_draining("a", 0.0)  # deadline (and 2x grace) passed
+        assert pool.pick() is None
+        async with aiohttp.ClientSession() as session:
+            assert await pool.probe_replica(session, e)
+        assert e.state == ReplicaState.READY
+        assert pool.pick() is e
+        await server.close()
+
+    async def test_non_json_health_counts_as_alive(self):
+        app = web.Application()
+
+        async def health(request):
+            return web.Response(text="alive")
+
+        app.router.add_get("/health", health)
+        server = TestServer(app)
+        await server.start_server()
+        pool = ReplicaPool("p", "svc", PoolConfig())
+        pool.sync([("a", server.host, server.port)])
+        async with aiohttp.ClientSession() as session:
+            assert await pool.probe_replica(session, pool.get("a"))
+        assert pool.get("a").state == ReplicaState.READY
+        assert pool.get("a").last_probe_at > 0
+        await server.close()

@@ -1,0 +1,256 @@
+"""Replica pool unit tests: state machine, picker, breaker, draining."""
+
+from dstack_tpu.routing import (
+    PoolConfig,
+    PoolRegistry,
+    ReplicaPool,
+    ReplicaState,
+    get_router_registry,
+)
+
+
+def mk_pool(**cfg) -> ReplicaPool:
+    pool = ReplicaPool("proj", "svc", PoolConfig(**cfg))
+    pool.sync([("a", "127.0.0.1", 1001), ("b", "127.0.0.1", 1002)])
+    return pool
+
+
+class TestMembership:
+    def test_sync_adds_keeps_and_removes(self):
+        pool = mk_pool()
+        pool.get("a").state = ReplicaState.READY
+        pool.sync([("a", "127.0.0.1", 1001), ("c", "127.0.0.1", 1003)])
+        assert pool.get("a").state == ReplicaState.READY  # state survives
+        assert pool.get("c").state == ReplicaState.STARTING
+        assert not pool.has("b")
+
+    def test_sync_address_change_resets_state(self):
+        """Same job id at a new host:port is a new process — the old
+        health verdict must not carry over."""
+        pool = mk_pool()
+        pool.get("a").state = ReplicaState.DEAD
+        pool.sync([("a", "127.0.0.1", 9999), ("b", "127.0.0.1", 1002)])
+        assert pool.get("a").state == ReplicaState.STARTING
+
+    def test_registry_prune(self):
+        reg = PoolRegistry()
+        reg.pool("p", "keep")
+        reg.pool("p", "drop")
+        reg.prune([("p", "keep")])
+        assert list(reg.pools) == [("p", "keep")]
+
+
+class TestPicker:
+    def test_least_outstanding_wins(self):
+        pool = mk_pool()
+        for e in pool.entries.values():
+            e.state = ReplicaState.READY
+        pool.get("a").outstanding = 3
+        assert pool.pick().replica_id == "b"
+
+    def test_ready_preferred_over_starting_and_degraded(self):
+        pool = mk_pool()
+        pool.sync(
+            [("a", "h", 1), ("b", "h", 2), ("c", "h", 3)]
+        )
+        pool.get("a").state = ReplicaState.DEGRADED
+        pool.get("b").state = ReplicaState.READY
+        pool.get("c").state = ReplicaState.STARTING
+        pool.get("b").outstanding = 5  # READY still wins with more load
+        assert pool.pick().replica_id == "b"
+        assert pool.pick(exclude={"b"}).replica_id == "c"
+        assert pool.pick(exclude={"b", "c"}).replica_id == "a"
+
+    def test_sequential_ties_rotate_round_robin(self):
+        """Non-overlapping requests tie on every load signal — the
+        pick must still spread across replicas, not pin the lexically
+        smallest id."""
+        pool = mk_pool()
+        for e in pool.entries.values():
+            e.state = ReplicaState.READY
+        picks = [pool.pick().replica_id for _ in range(6)]
+        assert picks == ["a", "b", "a", "b", "a", "b"]
+
+    def test_probed_queue_depth_breaks_ties(self):
+        pool = mk_pool()
+        for e in pool.entries.values():
+            e.state = ReplicaState.READY
+        pool.get("a").probe = {"queue_depth": 7}
+        pool.get("b").probe = {"queue_depth": 1}
+        assert pool.pick().replica_id == "b"
+
+    def test_draining_and_dead_not_picked(self):
+        pool = mk_pool()
+        pool.get("a").state = ReplicaState.DRAINING
+        e = pool.get("b")
+        e.state = ReplicaState.DEAD
+        e.breaker_open_until = 1e18  # window far in the future
+        assert pool.pick() is None
+
+    def test_exhausted_pool_returns_none(self):
+        pool = ReplicaPool("p", "r")
+        assert pool.pick() is None
+
+
+class TestBreaker:
+    def test_failures_open_breaker_after_threshold(self):
+        pool = mk_pool(startup_grace=0.0, breaker_base_backoff=60.0)
+        before = get_router_registry().family(
+            "dtpu_router_breaker_opens_total"
+        ).value()
+        e = pool.get("a")
+        for _ in range(3):
+            pool.report_failure(e)
+        assert e.state == ReplicaState.DEAD
+        assert e.breaker_open_until > 0
+        assert get_router_registry().family(
+            "dtpu_router_breaker_opens_total"
+        ).value() == before + 1
+        # picker routes around it
+        assert pool.pick().replica_id == "b"
+
+    def test_startup_grace_blocks_death(self):
+        pool = mk_pool()  # default grace: entries were just created
+        e = pool.get("a")
+        for _ in range(10):
+            pool.report_failure(e)
+        assert e.state == ReplicaState.STARTING  # failover covers it
+
+    def test_half_open_single_trial_then_recovery(self):
+        pool = mk_pool(startup_grace=0.0, breaker_base_backoff=0.0)
+        e = pool.get("a")
+        for _ in range(3):
+            pool.report_failure(e)
+        assert e.state == ReplicaState.DEAD
+        # backoff 0: immediately eligible for ONE half-open trial
+        trial = pool.pick(exclude={"b"})
+        assert trial is e and e.half_open
+        assert pool.pick(exclude={"b"}) is None  # no second trial
+        pool.report_success(e)
+        assert e.state == ReplicaState.READY and not e.half_open
+
+    def test_failed_trial_doubles_backoff(self):
+        pool = mk_pool(
+            startup_grace=0.0, breaker_base_backoff=1.0, breaker_max_backoff=4.0
+        )
+        e = pool.get("a")
+        for _ in range(3):
+            pool.report_failure(e)
+        assert e.breaker_backoff == 1.0
+        e.breaker_open_until = 0.0  # force window expiry
+        assert pool.pick(exclude={"b"}) is e
+        pool.report_failure(e)  # trial failed
+        assert e.breaker_backoff == 2.0 and not e.half_open
+        e.breaker_open_until = 0.0
+        pool.pick(exclude={"b"})
+        pool.report_failure(e)
+        assert e.breaker_backoff == 4.0
+        e.breaker_open_until = 0.0
+        pool.pick(exclude={"b"})
+        pool.report_failure(e)
+        assert e.breaker_backoff == 4.0  # capped
+
+    def test_success_resets_failure_streak(self):
+        pool = mk_pool(startup_grace=0.0)
+        e = pool.get("a")
+        pool.report_failure(e)
+        pool.report_failure(e)
+        pool.report_success(e)
+        pool.report_failure(e)
+        pool.report_failure(e)
+        assert e.state != ReplicaState.DEAD
+
+
+class TestDraining:
+    def test_draining_gets_no_picks_finishes_inflight(self):
+        pool = mk_pool()
+        e = pool.get("a")
+        e.state = ReplicaState.READY
+        pool.acquire(e)  # one inflight request
+        assert pool.mark_draining("a", 60.0)
+        assert pool.is_draining("a")
+        assert pool.pick().replica_id == "b"
+        assert pool.pick(exclude={"b"}) is None
+        assert not pool.drained("a")  # inflight still running
+        pool.release(e)
+        assert pool.drained("a")
+
+    def test_idle_drain_counts_in_drained_total(self):
+        pool = mk_pool()
+        counter = get_router_registry().family("dtpu_router_drained_total")
+        before = counter.value()
+        pool.mark_draining("a", 60.0)  # zero inflight: drained at once
+        assert pool.drained("a")
+        assert counter.value() == before + 1
+        pool.drained("a")  # idempotent: counted once
+        assert counter.value() == before + 1
+
+    def test_drain_deadline_forces_drained(self):
+        pool = mk_pool()
+        e = pool.get("a")
+        pool.acquire(e)
+        pool.mark_draining("a", 0.0)  # deadline already passed
+        assert pool.drained("a")
+
+    def test_unknown_replica_is_trivially_drained(self):
+        pool = mk_pool()
+        assert pool.drained("ghost")
+        assert not pool.mark_draining("ghost")
+
+    def test_cancel_draining_rejoins_rotation(self):
+        """Scale-down reversed mid-drain: the replica must come back
+        as a routable target instead of sitting DRAINING forever."""
+        pool = mk_pool()
+        pool.get("b").state = ReplicaState.DEAD
+        pool.get("b").breaker_open_until = 1e18
+        pool.mark_draining("a")
+        assert pool.pick() is None
+        assert pool.cancel_draining("a")
+        assert pool.get("a").state == ReplicaState.READY
+        assert pool.pick().replica_id == "a"
+        assert not pool.cancel_draining("a")  # not draining anymore
+
+    def test_failures_keep_draining_state(self):
+        pool = mk_pool(startup_grace=0.0)
+        e = pool.get("a")
+        pool.mark_draining("a")
+        for _ in range(5):
+            pool.report_failure(e)
+        assert e.state == ReplicaState.DRAINING
+
+
+class TestProbeSummary:
+    def test_fresh_probes_sum_queue_depth(self):
+        import time
+
+        pool = mk_pool()
+        now = time.monotonic()
+        pool.get("a").probe = {"queue_depth": 3}
+        pool.get("a").last_probe_at = now
+        pool.get("b").probe = {"queue_depth": 2}
+        pool.get("b").last_probe_at = now
+        assert pool.probe_summary() == (5.0, 2)
+
+    def test_stale_probes_return_none(self):
+        import time
+
+        pool = mk_pool(probe_stale_after=10.0)
+        pool.get("a").probe = {"queue_depth": 3}
+        pool.get("a").last_probe_at = time.monotonic() - 100.0
+        assert pool.probe_summary() is None
+
+    def test_never_probed_returns_none(self):
+        assert mk_pool().probe_summary() is None
+
+
+class TestStateGauge:
+    def test_gauge_counts_by_state(self):
+        reg = PoolRegistry()
+        pool = reg.pool("p", "r")
+        pool.sync([("a", "h", 1), ("b", "h", 2)])
+        pool.get("a").state = ReplicaState.READY
+        reg.update_state_gauge()
+        g = get_router_registry().family("dtpu_router_replicas")
+        assert g.value("ready") == 1
+        assert g.value("starting") == 1
+        assert g.value("dead") == 0

@@ -55,7 +55,16 @@ class TestOpenAIServer:
         client = await _client()
         try:
             r = await client.get("/health")
-            assert r.status == 200 and (await r.json())["status"] == "ok"
+            assert r.status == 200
+            h = await r.json()
+            assert h["status"] == "ok"
+            # load fields the routing layer's probes consume
+            # (routing/pool.probe_replica): idle engine → empty queue,
+            # nothing inflight, all slots free
+            assert h["queue_depth"] == 0
+            assert h["inflight"] == 0
+            assert h["max_slots"] == 4
+            assert h["kv_utilization"] == 0.0
             r = await client.get("/v1/models")
             data = await r.json()
             assert data["data"][0]["id"] == "llama-tiny"

@@ -800,3 +800,97 @@ class TestFirstStepMarkerScan:
             self._ev("plain line\n"),
         ]
         assert _scan_first_step_marker(events)[0] is None
+
+
+class TestServiceDraining:
+    async def test_scale_down_drains_before_terminating(self, monkeypatch):
+        """Scale-down of a RUNNING replica the routing pool knows about
+        goes through DRAINING: the job stays RUNNING while its inflight
+        requests finish, and only then terminates with SCALED_DOWN."""
+        from dstack_tpu.core.models.runs import (
+            JobProvisioningData,
+            JobTerminationReason,
+            RunSpec,
+        )
+        from dstack_tpu.proxy.stats import ServiceStats
+        from dstack_tpu.routing import PoolRegistry
+        from dstack_tpu.server.db import dumps
+        from dstack_tpu.server.services import jobs as jobs_service
+        from dstack_tpu.server.services.jobs.configurators import (
+            get_job_specs_from_run_spec,
+        )
+
+        db, user_row, project_row, _ = await _setup()
+        spec = make_run_spec(
+            {
+                "type": "service",
+                "commands": ["serve"],
+                "port": 8000,
+                "replicas": "1..4",
+                "scaling": {
+                    "metric": "rps", "target": 10,
+                    "scale_up_delay": 0, "scale_down_delay": 0,
+                },
+            },
+            "drain-svc",
+        )
+        run = await runs_service.submit_run(db, project_row, user_row, spec)
+        run_row = await db.get_by_id("runs", run.id)
+        # a second replica, as if a previous tick scaled up
+        run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
+        for jspec in get_job_specs_from_run_spec(run_spec, replica_num=1):
+            await jobs_service.create_job_row(db, run_row, jspec)
+        await db.update_by_id(
+            "runs", run.id, {"desired_replica_count": 2, "status": "running"}
+        )
+        offer = tpu_offer()
+        jpd = JobProvisioningData(
+            backend=offer.backend, instance_type=offer.instance,
+            instance_id="i-drain", hostname="127.0.0.1", region=offer.region,
+        )
+        jobs = await db.fetchall(
+            "SELECT * FROM jobs WHERE run_id = ?", (run.id,)
+        )
+        assert len(jobs) == 2
+        for j in jobs:
+            await db.update_by_id(
+                "jobs", j["id"],
+                {"status": "running",
+                 "job_provisioning_data": dumps(jpd.model_dump())},
+            )
+        # zero RPS -> autoscaler wants 1 replica (min), replica 1 excess
+        monkeypatch.setattr(
+            "dstack_tpu.server.services.autoscalers.get_service_stats",
+            lambda: ServiceStats(),
+        )
+        # the routing pool knows both replicas; the excess one has one
+        # inflight request
+        reg = PoolRegistry()
+        monkeypatch.setattr("dstack_tpu.routing.get_pool_registry", lambda: reg)
+        pool = reg.pool(project_row["name"], "drain-svc")
+        pool.sync([(j["id"], "127.0.0.1", 8000) for j in jobs])
+        excess = next(j for j in jobs if j["replica_num"] == 1)
+        entry = pool.get(excess["id"])
+        pool.acquire(entry)
+
+        await process_runs(db)  # tick 1: marks DRAINING, keeps the job
+        row = await db.get_by_id("jobs", excess["id"])
+        assert row["status"] == JobStatus.RUNNING.value
+        assert pool.is_draining(excess["id"])
+
+        await process_runs(db)  # inflight not done: still draining
+        row = await db.get_by_id("jobs", excess["id"])
+        assert row["status"] == JobStatus.RUNNING.value
+
+        pool.release(entry)  # inflight request finished
+        await process_runs(db)
+        row = await db.get_by_id("jobs", excess["id"])
+        assert row["status"] == JobStatus.TERMINATING.value
+        assert (
+            row["termination_reason"]
+            == JobTerminationReason.SCALED_DOWN.value
+        )
+        # the surviving replica was never touched
+        keeper = next(j for j in jobs if j["replica_num"] == 0)
+        row0 = await db.get_by_id("jobs", keeper["id"])
+        assert row0["status"] == JobStatus.RUNNING.value

@@ -257,6 +257,126 @@ class TestAutoscaler:
             }
         )
         assert isinstance(get_service_scaler(auto), RPSAutoscaler)
+        qd = ServiceConfiguration.model_validate(
+            {
+                "type": "service",
+                "commands": ["x"],
+                "port": 80,
+                "replicas": "1..4",
+                "scaling": {"metric": "queue-depth", "target": 4},
+            }
+        )
+        scaler = get_service_scaler(qd)
+        from dstack_tpu.server.services.autoscalers import QueueDepthAutoscaler
+
+        assert isinstance(scaler, QueueDepthAutoscaler)
+
+
+class TestQueueDepthAutoscaler:
+    def _scaler(self, target=4):
+        from dstack_tpu.server.services.autoscalers import QueueDepthAutoscaler
+
+        return QueueDepthAutoscaler(
+            IntRange(min=1, max=8),
+            ScalingSpec(
+                metric="queue-depth", target=target,
+                scale_up_delay=0, scale_down_delay=0,
+            ),
+        )
+
+    def _pool_with_queue(self, monkeypatch, per_replica: list):
+        import time as _time
+
+        from dstack_tpu.routing import PoolRegistry
+
+        reg = PoolRegistry()
+        pool = reg.pool("p", "r")
+        pool.sync([
+            (f"j{i}", "127.0.0.1", 9000 + i) for i in range(len(per_replica))
+        ])
+        now = _time.monotonic()
+        for i, qd in enumerate(per_replica):
+            e = pool.get(f"j{i}")
+            e.probe = {"queue_depth": qd}
+            e.last_probe_at = now
+        monkeypatch.setattr(
+            "dstack_tpu.routing.pool.get_pool_registry", lambda: reg
+        )
+        monkeypatch.setattr("dstack_tpu.routing.get_pool_registry", lambda: reg)
+        return reg
+
+    def test_scales_up_on_probed_queue_depth(self, monkeypatch):
+        stats = ServiceStats()  # zero RPS: queue depth alone drives it
+        monkeypatch.setattr(
+            "dstack_tpu.server.services.autoscalers.get_service_stats",
+            lambda: stats,
+        )
+        self._pool_with_queue(monkeypatch, [10, 10])  # 20 queued, target 4
+        s = self._scaler(target=4)
+        assert s.get_desired_count("p", "r", current=2, last_scaled_at=None) == 5
+
+    def test_stale_probes_fall_back_to_rps(self, monkeypatch):
+        stats = ServiceStats()
+        for _ in range(1800):  # 30 rps over the last minute
+            stats.record("p", "r")
+        monkeypatch.setattr(
+            "dstack_tpu.server.services.autoscalers.get_service_stats",
+            lambda: stats,
+        )
+        reg = self._pool_with_queue(monkeypatch, [50])
+        e = reg.pool("p", "r").get("j0")
+        e.last_probe_at -= 1000.0  # probe data is ancient
+        s = self._scaler(target=4)
+        # queue depth ignored; 30 rps / fallback target 10 → 3 replicas
+        assert s.get_desired_count("p", "r", current=1, last_scaled_at=None) == 3
+
+    def test_rps_floor_combines_with_queue_depth(self, monkeypatch):
+        stats = ServiceStats()
+        for _ in range(1800):  # 30 rps → needs 3
+            stats.record("p", "r")
+        monkeypatch.setattr(
+            "dstack_tpu.server.services.autoscalers.get_service_stats",
+            lambda: stats,
+        )
+        self._pool_with_queue(monkeypatch, [2])  # shallow queue → needs 1
+        s = self._scaler(target=4)
+        assert s.get_desired_count("p", "r", current=1, last_scaled_at=None) == 3
+
+    def test_idle_scales_to_min(self, monkeypatch):
+        stats = ServiceStats()
+        monkeypatch.setattr(
+            "dstack_tpu.server.services.autoscalers.get_service_stats",
+            lambda: stats,
+        )
+        self._pool_with_queue(monkeypatch, [0, 0, 0])
+        s = self._scaler(target=4)
+        assert s.get_desired_count("p", "r", current=3, last_scaled_at=None) == 1
+
+
+class TestStatsNoDoubleCount:
+    def test_rps_takes_max_of_local_and_external(self):
+        """A gateway-scraped window and locally recorded requests are
+        two views of the SAME traffic — summing them double-counted
+        every request and made the autoscaler overshoot 2x."""
+        stats = ServiceStats()
+        for _ in range(120):  # 2 rps locally observed
+            stats.record("p", "r")
+        stats.merge_external("p", "r", 2.0)  # gateway saw the same 2 rps
+        assert stats.rps("p", "r", over_seconds=60.0) == 2.0
+
+    def test_rps_external_dominates_when_larger(self):
+        stats = ServiceStats()
+        stats.record("p", "r")
+        stats.merge_external("p", "r", 9.0)
+        assert stats.rps("p", "r", over_seconds=60.0) == 9.0
+
+    def test_snapshot_last_bucket_uses_max(self):
+        stats = ServiceStats()
+        for _ in range(60):
+            stats.record("p", "r")
+        stats.merge_external("p", "r", 1.0)
+        rps60, hist = stats.snapshot("p", "r")
+        assert rps60 == 1.0  # max(local 1.0, external 1.0), not 2.0
 
 
 class TestFullStackModelService:

@@ -1,0 +1,102 @@
+"""Event-loop hygiene gate: no blocking calls inside async def bodies
+under the proxy/gateway/routing data planes
+(tools/check_async_blocking.py, run here so tier-1 fails on the first
+``time.sleep`` someone drops into a coroutine)."""
+
+import importlib.util
+from pathlib import Path
+
+TOOL = Path(__file__).resolve().parents[2] / "tools" / "check_async_blocking.py"
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("check_async_blocking", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_data_plane_has_no_blocking_async_calls():
+    assert _load_tool().main() == 0
+
+
+def test_flags_the_blocking_patterns():
+    src = '''
+import time
+import time as _t
+import requests
+from time import sleep
+
+async def bad():
+    time.sleep(1)
+    _t.sleep(2)
+    sleep(3)
+    requests.get("http://x")
+    open("/tmp/f")
+    p.read_text()
+'''
+    found = _load_tool().check_source(src)
+    assert len(found) == 6
+    messages = " | ".join(m for _, m in found)
+    assert "time.sleep" in messages
+    assert "requests" in messages
+    assert "open()" in messages
+    assert ".read_text()" in messages
+
+
+def test_sync_code_and_executor_helpers_are_exempt():
+    src = '''
+import time
+
+def sync_fn():
+    time.sleep(1)  # fine: not a coroutine
+
+async def good():
+    def executor_work():
+        time.sleep(1)  # fine: handed to a thread
+        return open("/tmp/f")
+    import asyncio
+    await asyncio.to_thread(executor_work)
+
+async def opted_out():
+    time.sleep(0.0)  # blocking: ok
+'''
+    assert _load_tool().check_source(src) == []
+
+
+def test_urllib_request_flagged_but_urllib_parse_is_not():
+    """`import urllib.request` binds only the `urllib` root: calls must
+    spell the full sync-HTTP module to count — urllib.parse is pure."""
+    src = '''
+import urllib.request
+
+async def handler(path):
+    quoted = urllib.parse.quote(path)
+    return urllib.request.urlopen("http://x" + quoted)
+'''
+    found = _load_tool().check_source(src)
+    assert len(found) == 1
+    assert "urllib.request" in found[0][1]
+
+
+def test_aliased_submodule_import_flagged():
+    src = '''
+import urllib.request as ur
+
+async def handler():
+    return ur.urlopen("http://x")
+'''
+    assert len(_load_tool().check_source(src)) == 1
+
+
+def test_nested_async_def_still_checked():
+    src = '''
+import time
+
+async def outer():
+    async def inner():
+        time.sleep(1)
+    await inner()
+'''
+    found = _load_tool().check_source(src)
+    assert len(found) == 1

@@ -5,6 +5,7 @@ Scrapes every metric family name the system can export —
 
 - the HTTP tracing registry (``server/tracing.RequestStats``)
 - the serve registry (``serve/metrics.new_serve_registry``)
+- the routing registry (``routing/metrics.new_router_registry``)
 - the train registry (``train/step.new_train_registry``)
 - the DB-backed cluster renderer (``w.sample("name", ...)`` calls in
   ``server/services/prometheus.py``, collected by regex: those names
@@ -29,11 +30,13 @@ if str(REPO) not in sys.path:  # runnable as a script from anywhere
 
 def collect_metric_names() -> set:
     names: set = set()
+    from dstack_tpu.routing.metrics import new_router_registry
     from dstack_tpu.serve.metrics import new_serve_registry
     from dstack_tpu.server.tracing import RequestStats
 
     names.update(RequestStats().registry.metric_names())
     names.update(new_serve_registry().metric_names())
+    names.update(new_router_registry().metric_names())
     try:
         from dstack_tpu.train.step import new_train_registry
 
