@@ -244,6 +244,7 @@ def init_cache(
     out = {}
     for n, s in names.items():
         sh = NamedSharding(mesh, P(*([None, None, "tp"] + [None] * (len(s) - 3))))
+        # dtpu: noqa[DTPU003] loop over the fixed cache buffer names (k/v[/scales]) at engine construction — bounded and once
         out[n] = jax.jit(
             partial(jnp.zeros, s, buf_dtype(n)), out_shardings=sh
         )()
@@ -1731,6 +1732,17 @@ class InferenceEngine:
         self._seen = jnp.zeros((max_batch, config.vocab_size), jnp.int32)
         self._gen_counts = jnp.zeros((max_batch, config.vocab_size), jnp.int32)
         self._logit_bias = jnp.zeros((max_batch, config.vocab_size), jnp.float32)
+        # [0..B) row index, built once: _plain_step's _mark_seen call
+        # was allocating+uploading a fresh jnp.arange per sampled token
+        # dtpu: noqa[DTPU002] one-time construction at engine init, not a hot path
+        self._slot_iota = jnp.arange(max_batch)
+        # device mirror of the 7 per-slot sampling-parameter lists
+        # (temps/top_ps/top_ks/rep_pens/pres_pens/freq_pens/min_ps).
+        # They only change on admission/release — exactly the
+        # _invalidate_decode_cache events — yet the sampled decode path
+        # re-uploaded all 7 host lists on EVERY generated token
+        # (DTPU002). None = rebuild on next use.
+        self._sampling_state = None
 
         # pending chunked prefills: slot → {tokens, tp, next (chunk
         # cursor), gen}
@@ -1879,6 +1891,7 @@ class InferenceEngine:
     def _chunk_fn(self, cl: int, start: int):
         key = (cl, start)
         if key not in self._chunk_fns:
+            # dtpu: noqa[DTPU003] cl is power-of-2-bucketed and start chunk-aligned by prefill_step; grid ≤ log2(C) × (T/C)
             self._chunk_fns[key] = jax.jit(
                 partial(prefill_chunk_step, config=self.config, start=start),
                 donate_argnames=("cache",),
@@ -1888,6 +1901,7 @@ class InferenceEngine:
     def _packed_fn(self, g: int, cl: int):
         key = (g, cl)
         if key not in self._packed_fns:
+            # dtpu: noqa[DTPU003] prefill_wave buckets g and cl to powers of two; grid ≤ log2(G) × log2(C), pinned by the compile-cache accounting test
             self._packed_fns[key] = jax.jit(
                 partial(prefill_packed_step, config=self.config),
                 donate_argnames=("cache",),
@@ -1936,6 +1950,7 @@ class InferenceEngine:
         construction point (the server warmup precompiles via this, so
         its variants can't drift from what start_request builds)."""
         if p not in self._copy_fns:
+            # dtpu: noqa[DTPU003] p is chunk-aligned by _find_prefix_source (reuse // C * C), ≤ max_seq/prefill_chunk variants, warmup precompiles them
             self._copy_fns[p] = jax.jit(
                 partial(copy_cache_prefix, p=p), donate_argnums=(0,)
             )
@@ -2319,7 +2334,9 @@ class InferenceEngine:
             jnp.asarray(self.lengths, jnp.int32),
             write_mask=jnp.asarray(self.active, bool),
         )
-        preds = jax.device_get(jnp.argmax(logits, axis=-1))  # [B, S]
+        # the shared jitted argmax (an op-by-op jnp.argmax here paid
+        # uncompiled dispatch overhead every speculative step)
+        preds = jax.device_get(self._argmax(logits))  # [B, S]
         out: dict = {}
         for i in live:
             draft = drafts.get(i, [])
@@ -2374,6 +2391,7 @@ class InferenceEngine:
 
     def _turbo_fn(self, steps: int):
         if steps not in self._turbo_fns:
+            # dtpu: noqa[DTPU003] _turbo_step buckets steps to powers of two capped at turbo_steps; ≤ log2(turbo_steps) variants
             self._turbo_fns[steps] = jax.jit(
                 partial(
                     decode_loop, config=self.config, steps=steps,
@@ -2393,6 +2411,29 @@ class InferenceEngine:
         (wrong tokens, no error). The slot-reuse and staggered-admission
         parity tests in tests/serve/test_engine.py pin the contract."""
         self._turbo_state = None
+        self._sampling_state = None
+
+    def _sampling_params(self) -> tuple:
+        """Device-resident mirrors of the per-slot sampling-parameter
+        lists, rebuilt only after a host-side slot mutation (the
+        :meth:`_invalidate_decode_cache` contract — activation/release
+        are the only writers of these lists). Without the mirror the
+        sampled decode path uploads seven host lists per token."""
+        if self._sampling_state is None:
+            fields = (
+                (self.temps, jnp.float32),
+                (self.top_ps, jnp.float32),
+                (self.top_ks, jnp.int32),
+                (self.rep_pens, jnp.float32),
+                (self.pres_pens, jnp.float32),
+                (self.freq_pens, jnp.float32),
+                (self.min_ps, jnp.float32),
+            )
+            self._sampling_state = tuple(
+                jnp.asarray(v, dt)  # dtpu: noqa[DTPU002] THE mirror rebuild — runs only after an invalidation (admission/release), never per token
+                for v, dt in fields
+            )
+        return self._sampling_state
 
     def _decode_state(self) -> tuple:
         """Device-resident (token, position, budget, active, eos)
@@ -2456,6 +2497,7 @@ class InferenceEngine:
             segs.append(toks_dev)
         self._turbo_state = (tok_d, pos_d, rem_d, act_d, eos_d)
         # ONE blocking fetch for every in-flight segment ([depth*steps, B])
+        # dtpu: noqa[DTPU002] the designed single device_get per macro-step — K×depth tokens amortize this one round trip
         toks = np.concatenate(jax.device_get(segs), axis=0)
         out: dict = {}
         for i in live:
@@ -2511,22 +2553,24 @@ class InferenceEngine:
             # so the advanced arrays are the valid next-step inputs
             self._turbo_state = (*adv, eos_d)
             return out
+        sp = self._sampling_params()
+        temps, top_ps, top_ks, rep_pens, pres_pens, freq_pens, min_ps = sp
         sampled_dev, self._key_data = self._sample(
             logits,
             self._key_data,
-            jnp.asarray(self.temps, jnp.float32),
-            jnp.asarray(self.top_ps, jnp.float32),
-            jnp.asarray(self.top_ks, jnp.int32),
-            jnp.asarray(self.rep_pens, jnp.float32),
+            temps,
+            top_ps,
+            top_ks,
+            rep_pens,
             self._seen,
-            jnp.asarray(self.pres_pens, jnp.float32),
-            jnp.asarray(self.freq_pens, jnp.float32),
+            pres_pens,
+            freq_pens,
             self._gen_counts,
             self._logit_bias,
-            jnp.asarray(self.min_ps, jnp.float32),
+            min_ps,
         )
         self._seen, self._gen_counts = self._mark_seen(
-            self._seen, self._gen_counts, jnp.arange(self.max_batch), sampled_dev
+            self._seen, self._gen_counts, self._slot_iota, sampled_dev
         )
         if any(self.want_logprobs[i] for i in live):
             lp, tids, tlps = jax.device_get(
@@ -2543,6 +2587,10 @@ class InferenceEngine:
         )
         out = self._emit(live, jax.device_get(sampled_dev))
         self._turbo_state = (*adv, eos_d)  # see the greedy branch
+        # _emit's invalidation also dropped the sampling-params mirror,
+        # but the per-token advance never touches those lists — restore
+        # so the next sampled token reuses the same device arrays
+        self._sampling_state = sp
         return out
 
     def _advance_slot(self, i: int, tok: int) -> bool:

@@ -982,6 +982,7 @@ def build_app(
                 vecs.append(_embed_fn(padded)(
                     engine.params, toks, _jnp.asarray(len(ids), _jnp.int32)
                 ))
+            # dtpu: noqa[DTPU002] ONE batched pull after every forward dispatched — the pipelined design this comment block describes
             return _jax.device_get(vecs)
 
         # off the event loop: a new length bucket compiles for seconds,

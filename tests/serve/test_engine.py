@@ -1135,6 +1135,30 @@ class TestDecodeStateMirror:
         assert got1 == ref1
         assert got2 == ref2
 
+    def test_sampling_params_mirror_reused_and_invalidated(self):
+        # the 7 per-slot sampling-parameter lists only change on
+        # admission/release, so the sampled path must NOT re-upload
+        # them per token (the DTPU002 defect this mirror fixed) — and
+        # a new admission with different params must rebuild them
+        eng = self._engine(max_batch=2, max_seq=128)
+        s1, _ = eng.add_request(
+            [5, 9, 21], GenParams(max_new_tokens=8, temperature=0.9, seed=3)
+        )
+        assert eng._sampling_state is None  # activation invalidated
+        eng.step()
+        first = eng._sampling_state
+        assert first is not None  # mirror survives the per-token advance
+        eng.step()
+        assert eng._sampling_state is first  # reused, not re-uploaded
+        s2, _ = eng.add_request(
+            [7, 8], GenParams(max_new_tokens=4, temperature=1.3, seed=9)
+        )
+        assert eng._sampling_state is None  # admission invalidated
+        eng.step()
+        rebuilt = eng._sampling_state
+        assert rebuilt is not None and rebuilt is not first
+        assert abs(float(rebuilt[0][s2]) - 1.3) < 1e-6  # temps row
+
 
 class TestCompileCacheAccounting:
     """Packing must not reintroduce a per-(start-combination) compile

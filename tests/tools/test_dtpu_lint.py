@@ -1,0 +1,448 @@
+"""dtpu-lint framework gate + per-rule fixtures.
+
+Three layers:
+
+- per-rule positive/negative fixtures (``check_file_source`` on inline
+  sources, scope bypassed via explicit ``rule_ids``)
+- framework mechanics: pragma opt-outs, baseline round-trip,
+  shrink-only staleness
+- THE tier-1 gate: ``run_lint()`` over the repo must be clean against
+  ``tools/dtpu_lint/baseline.json`` — no findings beyond the baseline,
+  no stale entries.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.dtpu_lint import (  # noqa: E402
+    Finding,
+    apply_baseline,
+    check_file_source,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from tools.dtpu_lint.core import all_rules  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# DTPU001 — blocking call in async def (detail coverage lives in
+# tests/tools/test_check_async_blocking.py via the shim)
+# ---------------------------------------------------------------------------
+
+
+def test_dtpu001_fires_on_sleep_in_async():
+    src = """
+import time
+
+async def bad():
+    time.sleep(1)
+"""
+    found = check_file_source(src, "x.py", rule_ids=["DTPU001"])
+    assert len(found) == 1
+    assert found[0].rule == "DTPU001"
+    assert "time.sleep" in found[0].message
+
+
+def test_dtpu001_quiet_on_sync_code():
+    src = """
+import time
+
+def fine():
+    time.sleep(1)
+"""
+    assert check_file_source(src, "x.py", rule_ids=["DTPU001"]) == []
+
+
+# ---------------------------------------------------------------------------
+# DTPU002 — host-device sync / transfer in hot paths
+# ---------------------------------------------------------------------------
+
+_SYNC_SRC = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+class Engine:
+    def step(self, logits, temps):
+        t = jnp.asarray(temps, jnp.float32)
+        tok = int(logits[0])
+        v = logits.item()
+        h = jax.device_get(logits)
+        n = np.asarray(logits)
+        print(logits)
+        logits.block_until_ready()
+"""
+
+
+def test_dtpu002_fires_on_each_sync_pattern():
+    found = check_file_source(_SYNC_SRC, "x.py", rule_ids=["DTPU002"])
+    blob = " | ".join(f.message for f in found)
+    assert len(found) == 7
+    assert "jnp.asarray" in blob
+    assert "int()" in blob
+    assert ".item()" in blob
+    assert "device_get" in blob
+    assert "np.asarray" in blob
+    assert "print()" in blob
+    assert "block_until_ready" in blob
+
+
+def test_dtpu002_fires_on_fully_qualified_jax_numpy_upload():
+    # `import jax` binds only the root: jax.numpy.asarray must still
+    # count as a jnp-module upload in dispatch code
+    src = """
+import jax
+import jax.numpy
+
+class Engine:
+    def step(self, temps):
+        return jax.numpy.asarray(temps)
+"""
+    found = check_file_source(src, "x.py", rule_ids=["DTPU002"])
+    assert len(found) == 1
+    assert "jnp.asarray" in found[0].message
+
+
+def test_dtpu002_quiet_in_traced_module_functions_and_host_code():
+    src = """
+import jax.numpy as jnp
+
+def traced(x):
+    # module-level = jit-traced model code: asarray is a constant fold
+    return x * jnp.asarray(0.5, jnp.float32)
+
+class Engine:
+    def host_only(self, payload):
+        n = int(payload)          # not a subscript
+        print("literal only")     # constant args
+        return n
+"""
+    assert check_file_source(src, "x.py", rule_ids=["DTPU002"]) == []
+
+
+# ---------------------------------------------------------------------------
+# DTPU003 — recompile hazards
+# ---------------------------------------------------------------------------
+
+
+def test_dtpu003_fires_on_param_keyed_jit_cache():
+    src = """
+import jax
+
+class Engine:
+    def _fn(self, cl, start):
+        key = (cl, start)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(lambda x: x)
+        return self._fns[key]
+"""
+    found = check_file_source(src, "x.py", rule_ids=["DTPU003"])
+    assert len(found) == 1
+    assert "caller-supplied" in found[0].message
+
+
+def test_dtpu003_fires_on_jit_in_loop():
+    src = """
+import jax
+
+def build(fns):
+    out = []
+    while fns:
+        out.append(jax.jit(fns.pop()))
+    return out
+"""
+    found = check_file_source(src, "x.py", rule_ids=["DTPU003"])
+    assert len(found) == 1
+    assert "inside a loop" in found[0].message
+
+
+def test_dtpu003_quiet_on_bounded_jits():
+    src = """
+import jax
+
+def make(f):
+    return jax.jit(f)          # once per call, no cache growth
+
+class Engine:
+    def __init__(self):
+        self._fns = {"fixed": jax.jit(lambda x: x)}  # constant key
+"""
+    assert check_file_source(src, "x.py", rule_ids=["DTPU003"]) == []
+
+
+# ---------------------------------------------------------------------------
+# DTPU004 — metric label hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_dtpu004_fires_on_request_derived_labels():
+    src = """
+def record(reg, user, path):
+    reg.family("dtpu_x_total").inc(1, f"user-{user}")
+    reg.family("dtpu_y_seconds").observe(0.5, "pre" + path)
+    reg.family("dtpu_z").set(1, str(user))
+"""
+    found = check_file_source(src, "x.py", rule_ids=["DTPU004"])
+    assert len(found) == 3
+    assert all("label" in f.message for f in found)
+
+
+def test_dtpu004_quiet_on_bounded_labels():
+    src = """
+def record(reg, entry, state):
+    reg.family("dtpu_x_total").inc(1)                    # no labels
+    reg.family("dtpu_x_total").inc(1, "ready")           # literal
+    reg.family("dtpu_x_total").inc(1, entry.state.value) # enum attr
+    reg.family("dtpu_x_total").set(3, state)             # bare name
+"""
+    assert check_file_source(src, "x.py", rule_ids=["DTPU004"]) == []
+
+
+def test_dtpu004_docs_collector_sees_all_layers():
+    # one representative per exporter: tracing, cluster renderer,
+    # serve, train — a refactor dropping a whole layer fails here
+    from tools.dtpu_lint.rules.metric_hygiene import collect_metric_names
+
+    names = collect_metric_names(REPO)
+    assert "dtpu_http_request_duration_seconds" in names
+    assert "dtpu_runs" in names
+    assert "dtpu_serve_ttft_seconds" in names
+    assert "dtpu_train_step_seconds" in names
+
+
+# ---------------------------------------------------------------------------
+# DTPU005 — settings drift
+# ---------------------------------------------------------------------------
+
+
+def test_dtpu005_fires_on_undocumented_env_read():
+    src = """
+import os
+
+def load():
+    a = os.getenv("DTPU_NOT_A_REAL_VAR_XYZ")
+    b = os.environ["DTPU_ALSO_NOT_DOCUMENTED"]
+    c = os.environ.get("DTPU_THIRD_UNDOCUMENTED", "x")
+    return a, b, c
+"""
+    found = check_file_source(src, "dstack_tpu/x.py", rule_ids=["DTPU005"])
+    assert len(found) == 3
+    assert "DTPU_NOT_A_REAL_VAR_XYZ" in found[0].message
+
+
+def test_dtpu005_quiet_on_documented_or_foreign_vars():
+    src = """
+import os
+
+def load():
+    a = os.getenv("DTPU_LOG_LEVEL", "INFO")   # documented in server.md
+    b = os.environ.get("HOME")                 # not a DTPU_ var
+    os.environ["DTPU_SOMETHING_NEW"] = "1"     # a write is not drift
+    return a, b
+"""
+    assert check_file_source(src, "dstack_tpu/x.py", rule_ids=["DTPU005"]) == []
+
+
+def test_dtpu005_never_applies_to_settings_py():
+    rule = all_rules()["DTPU005"]
+    assert not rule.applies("dstack_tpu/server/settings.py")
+    assert rule.applies("dstack_tpu/serve/engine.py")
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_suppresses_matching_rule_only():
+    src = """
+import jax
+
+class Engine:
+    def step(self, x):
+        v = x.item()  # dtpu: noqa[DTPU002] device already synced here
+        w = x.item()  # dtpu: noqa[DTPU003] wrong rule id
+"""
+    found = check_file_source(src, "x.py", rule_ids=["DTPU002"])
+    assert len(found) == 1
+    assert found[0].line == 7
+
+
+def test_pragma_on_preceding_comment_line():
+    src = """
+import jax
+
+class Engine:
+    def step(self, x):
+        # dtpu: noqa[DTPU002] one deliberate pull, reason documented
+        v = x.item()
+"""
+    assert check_file_source(src, "x.py", rule_ids=["DTPU002"]) == []
+
+
+def test_legacy_blocking_ok_still_respected_by_dtpu001():
+    src = """
+import time
+
+async def startup():
+    time.sleep(0.0)  # blocking: ok
+"""
+    assert check_file_source(src, "x.py", rule_ids=["DTPU001"]) == []
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip + shrink-only
+# ---------------------------------------------------------------------------
+
+
+def _mk(n, msg="m"):
+    return Finding("DTPU002", "pkg/f.py", n, msg)
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = [_mk(1, "a"), _mk(5, "b"), _mk(9, "b")]
+    path = tmp_path / "baseline.json"
+    write_baseline(findings, path)
+    diff = apply_baseline(findings, load_baseline(path))
+    assert diff.clean
+
+
+def test_baseline_reports_only_new_findings(tmp_path):
+    path = tmp_path / "baseline.json"
+    write_baseline([_mk(1, "a")], path)
+    diff = apply_baseline([_mk(2, "a"), _mk(7, "fresh")], load_baseline(path))
+    assert [f.message for f in diff.new] == ["fresh"]
+    assert not diff.stale
+
+
+def test_baseline_grown_count_is_new_finding(tmp_path):
+    # same key appearing more often than granted: overflow is NEW
+    path = tmp_path / "baseline.json"
+    write_baseline([_mk(1, "a")], path)
+    diff = apply_baseline([_mk(1, "a"), _mk(8, "a")], load_baseline(path))
+    assert len(diff.new) == 1
+    assert diff.new[0].line == 8  # the newest call site is reported
+
+
+def test_baseline_is_shrink_only(tmp_path):
+    # a fixed finding whose entry was kept → stale, gate fails
+    path = tmp_path / "baseline.json"
+    write_baseline([_mk(1, "a"), _mk(2, "b")], path)
+    diff = apply_baseline([_mk(1, "a")], load_baseline(path))
+    assert not diff.new
+    assert len(diff.stale) == 1
+    (key, granted, seen) = diff.stale[0]
+    assert key[2] == "b" and granted == 1 and seen == 0
+
+
+def test_missing_baseline_means_everything_is_new(tmp_path):
+    diff = apply_baseline([_mk(1)], load_baseline(tmp_path / "absent.json"))
+    assert len(diff.new) == 1
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate + CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean_against_checked_in_baseline():
+    """THE gate: repo-wide lint must have no findings beyond the
+    baseline and no stale entries (shrink-only policy)."""
+    diff = apply_baseline(run_lint(REPO), load_baseline())
+    assert not diff.new, "new findings:\n" + "\n".join(
+        f.render() for f in diff.new
+    )
+    assert not diff.stale, (
+        "stale baseline entries (fixed findings whose baseline entry "
+        f"must be pruned — shrink-only): {diff.stale}"
+    )
+
+
+def test_every_advertised_rule_is_registered():
+    rules = all_rules()
+    for rid in ("DTPU001", "DTPU002", "DTPU003", "DTPU004", "DTPU005"):
+        assert rid in rules, f"rule {rid} missing from the registry"
+
+
+def test_cli_list_rules_and_subset_lint(capsys):
+    from tools.dtpu_lint.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "DTPU001" in out and "DTPU005" in out
+    assert main(["dstack_tpu/routing/metrics.py"]) == 0
+
+
+def test_cli_subset_runs_restrict_baseline_not_skip_it(capsys):
+    # a --rules subset must not report other rules' grandfathered
+    # entries as stale, and a path subset must honor the baseline for
+    # the linted files (keys are per-path, so counts reconcile) — both
+    # are the documented day-to-day invocations and must exit 0 on a
+    # clean repo
+    from tools.dtpu_lint.__main__ import main
+
+    assert main(["--rules", "DTPU001"]) == 0
+    assert main(["--rules", "DTPU004"]) == 0  # incl. the -DOCS half
+    assert main(["dstack_tpu/serve/engine.py"]) == 0
+    err = capsys.readouterr().err
+    assert "stale" not in err and "beyond baseline" not in err
+
+
+def test_cli_write_baseline_refuses_subset_runs(capsys):
+    # a subset --write-baseline would overwrite the full baseline with
+    # only the subset's findings, un-grandfathering everything else
+    from tools.dtpu_lint.__main__ import main
+
+    assert main(["--write-baseline", "--rules", "DTPU005"]) == 2
+    assert main(["--write-baseline", "dstack_tpu/serve/engine.py"]) == 2
+    assert "full run" in capsys.readouterr().err
+
+
+def test_cli_rejects_paths_outside_the_repo(capsys):
+    from tools.dtpu_lint.__main__ import main
+
+    assert main(["/tmp/definitely-not-in-repo.py"]) == 2
+    assert "outside the repo" in capsys.readouterr().err
+
+
+def test_rules_dtpu004_selects_the_docs_project_half():
+    # the docs-coverage ProjectRule registers as DTPU004-DOCS but must
+    # run whenever its base id is selected — the shim's recommended
+    # `--rules DTPU004` invocation covers both halves
+    from tools.dtpu_lint.core import ProjectRule
+
+    ran = {"docs": False}
+
+    class _Probe(ProjectRule):
+        id = "DTPU004-DOCS"
+
+        def check_project(self, repo):
+            ran["docs"] = True
+            return []
+
+    from tools.dtpu_lint.core import RULES
+
+    real = RULES["DTPU004-DOCS"]
+    RULES["DTPU004-DOCS"] = _Probe()
+    try:
+        run_lint(REPO, rule_ids=["DTPU004"])
+    finally:
+        RULES["DTPU004-DOCS"] = real
+    assert ran["docs"]
+
+
+def test_scope_glob_matches_top_level_package_modules():
+    # fnmatch gives ** no special meaning; the framework's matcher
+    # must span zero directories so dstack_tpu/version.py-style
+    # modules stay inside DTPU004/DTPU005's repo-wide scope
+    from tools.dtpu_lint.core import glob_match
+
+    assert glob_match("dstack_tpu/version.py", "dstack_tpu/**/*.py")
+    assert glob_match("dstack_tpu/a/b/c.py", "dstack_tpu/**/*.py")
+    assert not glob_match("tests/x.py", "dstack_tpu/**/*.py")
+    assert not glob_match("dstack_tpu/ops/x.py", "dstack_tpu/ops.py")

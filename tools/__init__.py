@@ -1,0 +1,1 @@
+"""Repo tooling package (``python -m tools.dtpu_lint`` etc.)."""

@@ -1,174 +1,40 @@
 #!/usr/bin/env python
-"""Fail on blocking calls inside ``async def`` bodies of the data plane.
+"""Thin shim over dtpu-lint rule DTPU001 (blocking-call-in-async).
 
-The proxy, gateway, and routing packages ARE the serving hot path: one
-``time.sleep`` or sync ``requests.get`` inside a coroutine stalls every
-connection on the event loop, and such bugs pass tests (which never
-load the loop enough to notice). This AST lint flags, directly inside
-``async def`` bodies under ``dstack_tpu/proxy``, ``dstack_tpu/gateway``,
-and ``dstack_tpu/routing``:
-
-- ``time.sleep(...)`` (any import alias, incl. ``from time import sleep``)
-- any call into the sync ``requests`` / ``urllib.request`` modules
-- blocking file I/O: builtin ``open(...)`` and ``Path`` convenience
-  methods (``.read_text/.write_text/.read_bytes/.write_bytes``)
-
-Nested *sync* ``def``s inside a coroutine are exempt — the idiom for
-work handed to ``run_in_executor``/``asyncio.to_thread``. A line may
-opt out with a trailing ``# blocking: ok`` comment (e.g. startup-only
-code). Run by tier-1 tests (tests/tools/test_check_async_blocking.py).
+The checker moved into the unified static-analysis framework
+(``tools/dtpu_lint/rules/async_blocking.py``); this entry point keeps
+the old script name, the ``check_source(src)`` API, and the exit-code
+contract so ``tests/tools/test_check_async_blocking.py`` and the
+verify recipes stay green. Prefer ``python -m tools.dtpu_lint``
+(optionally ``--rules DTPU001``) for new wiring.
 """
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-CHECKED_DIRS = (
-    "dstack_tpu/proxy",
-    "dstack_tpu/gateway",
-    "dstack_tpu/routing",
-)
-SYNC_HTTP_MODULES = {"requests", "urllib.request"}
-PATH_IO_METHODS = {"read_text", "write_text", "read_bytes", "write_bytes"}
-OPT_OUT = "# blocking: ok"
+if str(REPO) not in sys.path:  # runnable as a script from anywhere
+    sys.path.insert(0, str(REPO))
 
-
-def _module_aliases(tree: ast.AST) -> tuple[dict, set]:
-    """(name -> (module, exact), bare function names that are
-    ``time.sleep``) collected from the file's imports. ``exact`` means
-    the name IS the module object (``import requests``, ``import
-    urllib.request as ur``); ``import urllib.request`` only binds the
-    ``urllib`` root, so calls through it must spell out the full dotted
-    module path to count (``urllib.parse.quote`` is not sync HTTP)."""
-    aliases: dict = {}
-    sleep_names: set = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name in SYNC_HTTP_MODULES or a.name == "time":
-                    if a.asname is not None or "." not in a.name:
-                        aliases[a.asname or a.name] = (a.name, True)
-                    else:
-                        aliases[a.name.split(".")[0]] = (a.name, False)
-        elif isinstance(node, ast.ImportFrom) and node.module:
-            if node.module == "time":
-                for a in node.names:
-                    if a.name == "sleep":
-                        sleep_names.add(a.asname or a.name)
-            elif node.module in SYNC_HTTP_MODULES or node.module == "urllib":
-                for a in node.names:
-                    full = f"{node.module}.{a.name}"
-                    if node.module in SYNC_HTTP_MODULES:
-                        aliases[a.asname or a.name] = (full, True)
-                    elif full in SYNC_HTTP_MODULES:
-                        aliases[a.asname or a.name] = (full, True)
-    return aliases, sleep_names
-
-
-def _dotted(node: ast.AST):
-    """'a.b.c' for nested Attribute/Name chains, else None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if not isinstance(node, ast.Name):
-        return None
-    parts.append(node.id)
-    return ".".join(reversed(parts))
-
-
-class _AsyncBodyChecker(ast.NodeVisitor):
-    """Walks ONE coroutine body; does not descend into nested sync
-    defs (executor-bound work) — nested async defs get their own walk
-    from the file-level pass."""
-
-    def __init__(self, aliases, sleep_names, violations, lines):
-        self.aliases = aliases
-        self.sleep_names = sleep_names
-        self.violations = violations
-        self.lines = lines
-
-    def visit_FunctionDef(self, node):
-        pass  # sync helper inside a coroutine: allowed (executor work)
-
-    def visit_AsyncFunctionDef(self, node):
-        pass  # checked separately by the file-level pass
-
-    def visit_Lambda(self, node):
-        pass
-
-    def visit_Call(self, node):
-        msg = self._classify(node)
-        if msg is not None:
-            line = self.lines[node.lineno - 1] if node.lineno <= len(self.lines) else ""
-            if OPT_OUT not in line:
-                self.violations.append((node.lineno, msg))
-        self.generic_visit(node)
-
-    def _classify(self, node: ast.Call):
-        func = node.func
-        if isinstance(func, ast.Name):
-            if func.id == "open":
-                return "blocking file I/O: open() in async def"
-            if func.id in self.sleep_names:
-                return "time.sleep() in async def (use asyncio.sleep)"
-            bound = self.aliases.get(func.id)
-            if bound is not None and (
-                bound[0] in SYNC_HTTP_MODULES
-                or bound[0].rsplit(".", 1)[0] in SYNC_HTTP_MODULES
-            ):
-                return f"sync HTTP call ({bound[0]}) in async def"
-            return None
-        dotted = _dotted(func)
-        if dotted is not None:
-            root = dotted.split(".")[0]
-            bound = self.aliases.get(root)
-            if bound is not None:
-                module, exact = bound
-                if module == "time" and dotted.endswith(".sleep"):
-                    return "time.sleep() in async def (use asyncio.sleep)"
-                if module in SYNC_HTTP_MODULES and (
-                    exact or dotted.startswith(module + ".")
-                ):
-                    return f"sync HTTP call ({module}) in async def"
-        if isinstance(func, ast.Attribute) and func.attr in PATH_IO_METHODS:
-            return f"blocking file I/O: .{func.attr}() in async def"
-        return None
-
-
-def check_source(src: str, path: str = "<string>") -> list:
-    """→ [(lineno, message)] for one file's source."""
-    tree = ast.parse(src, filename=path)
-    aliases, sleep_names = _module_aliases(tree)
-    lines = src.splitlines()
-    violations: list = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.AsyncFunctionDef):
-            checker = _AsyncBodyChecker(aliases, sleep_names, violations, lines)
-            for stmt in node.body:
-                checker.visit(stmt)
-    return sorted(set(violations))
+from tools.dtpu_lint.core import apply_baseline, load_baseline, run_lint  # noqa: E402
+from tools.dtpu_lint.rules.async_blocking import check_source  # noqa: E402,F401
 
 
 def main() -> int:
-    bad = 0
-    files = sorted(
-        f for d in CHECKED_DIRS for f in (REPO / d).rglob("*.py")
-    )
-    for f in files:
-        for lineno, msg in check_source(f.read_text(), str(f)):
-            print(f"{f.relative_to(REPO)}:{lineno}: {msg}", file=sys.stderr)
-            bad += 1
-    if bad:
+    findings = run_lint(REPO, rule_ids=["DTPU001"], project_rules=False)
+    diff = apply_baseline(findings, load_baseline())
+    for f in diff.new:
+        print(f.render(), file=sys.stderr)
+    if diff.new:
         print(
-            f"\n{bad} blocking call(s) inside async def bodies — move "
-            "them off the event loop (asyncio.to_thread / run_in_executor "
-            "/ aiohttp), or append '# blocking: ok' when genuinely safe.",
+            f"\n{len(diff.new)} blocking call(s) inside async def bodies — "
+            "move them off the event loop (asyncio.to_thread / "
+            "run_in_executor / aiohttp), or append '# blocking: ok' when "
+            "genuinely safe.",
             file=sys.stderr,
         )
         return 1
-    print(f"no blocking calls in async bodies across {len(files)} files")
+    print("no blocking calls in async bodies (dtpu-lint DTPU001)")
     return 0
 
 

@@ -1,80 +1,46 @@
 #!/usr/bin/env python
-"""Fail when an exported metric series is missing from the docs.
+"""Thin shim over dtpu-lint rule DTPU004 (metric docs coverage).
 
-Scrapes every metric family name the system can export —
-
-- the HTTP tracing registry (``server/tracing.RequestStats``)
-- the serve registry (``serve/metrics.new_serve_registry``)
-- the routing registry (``routing/metrics.new_router_registry``)
-- the train registry (``train/step.new_train_registry``)
-- the DB-backed cluster renderer (``w.sample("name", ...)`` calls in
-  ``server/services/prometheus.py``, collected by regex: those names
-  are data-driven, not registry-driven)
-
-— and asserts each appears in ``docs/reference/server.md``'s
-"Metrics & timeline" section. Run by tier-1 tests
-(tests/tools/test_metrics_docs.py), so adding a series without
-documenting it fails CI instead of silently drifting.
+The checker moved into the unified static-analysis framework
+(``tools/dtpu_lint/rules/metric_hygiene.py``); this entry point keeps
+the old script name, the ``collect_metric_names()`` API, and the
+exit-code contract so ``tests/tools/test_metrics_docs.py`` and the
+verify recipes stay green. Prefer ``python -m tools.dtpu_lint``
+(optionally ``--rules DTPU004``) for new wiring.
 """
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-DOCS = REPO / "docs" / "reference" / "server.md"
-
 if str(REPO) not in sys.path:  # runnable as a script from anywhere
     sys.path.insert(0, str(REPO))
 
+from tools.dtpu_lint.rules.metric_hygiene import (  # noqa: E402
+    docs_coverage_findings,
+    collect_metric_names as _collect,
+)
+
 
 def collect_metric_names() -> set:
-    names: set = set()
-    from dstack_tpu.routing.metrics import new_router_registry
-    from dstack_tpu.serve.metrics import new_serve_registry
-    from dstack_tpu.server.tracing import RequestStats
-
-    names.update(RequestStats().registry.metric_names())
-    names.update(new_serve_registry().metric_names())
-    names.update(new_router_registry().metric_names())
-    try:
-        from dstack_tpu.train.step import new_train_registry
-
-        names.update(new_train_registry().metric_names())
-    except ImportError as e:
-        # jax/optax absent: scrape the registry-construction source
-        # instead (a hardcoded fallback list would silently drift when
-        # a family is added to new_train_registry)
-        print(f"note: train registry parsed from source ({e})", file=sys.stderr)
-        step_src = (
-            REPO / "dstack_tpu" / "train" / "step.py"
-        ).read_text()
-        names.update(
-            re.findall(
-                r'r\.(?:counter|gauge|histogram)\(\s*\n?\s*"([a-z0-9_]+)"',
-                step_src,
-            )
-        )
-    renderer = (
-        REPO / "dstack_tpu" / "server" / "services" / "prometheus.py"
-    ).read_text()
-    names.update(re.findall(r'w\.sample\(\s*\n?\s*"([a-z0-9_]+)"', renderer))
-    return names
+    return _collect(REPO)
 
 
 def main() -> int:
-    doc = DOCS.read_text()
-    missing = sorted(n for n in collect_metric_names() if n not in doc)
+    missing = docs_coverage_findings(REPO)
     if missing:
         print(
             "exported metrics missing from docs/reference/server.md "
             "(add them to the 'Metrics & timeline' section):",
             file=sys.stderr,
         )
-        for n in missing:
-            print(f"  {n}", file=sys.stderr)
+        for f in missing:
+            print(f"  {f.message}", file=sys.stderr)
         return 1
-    print(f"docs cover all {len(collect_metric_names())} exported series")
+    print(
+        f"docs cover all {len(collect_metric_names())} exported series "
+        "(dtpu-lint DTPU004)"
+    )
     return 0
 
 

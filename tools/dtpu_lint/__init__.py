@@ -1,0 +1,37 @@
+"""dtpu-lint: JAX/TPU-aware static analysis for this repo.
+
+The control plane is performance-critical glue where a single blocking
+call, per-token host sync, or unbounded metric label silently destroys
+throughput — the bug class that passes unit tests and only shows up
+under load. dtpu-lint encodes those invariants as enforceable AST
+rules instead of reviewer folklore:
+
+- **DTPU001** blocking calls inside ``async def`` on the data plane
+- **DTPU002** host↔device syncs/transfers in serve/ops hot paths
+- **DTPU003** recompile hazards (unbucketed jit cache keys, jit-in-loop)
+- **DTPU004** metric hygiene (docs coverage + bounded label values)
+- **DTPU005** settings drift (undocumented ``DTPU_*`` env reads)
+
+Run repo-wide: ``python -m tools.dtpu_lint`` (tier-1 gate via
+``tests/tools/test_dtpu_lint.py``). Opt a line out with
+``# dtpu: noqa[DTPU002] <reason>``; grandfathered findings live in
+``tools/dtpu_lint/baseline.json`` (shrink-only — see
+``docs/reference/lint.md``).
+"""
+
+from tools.dtpu_lint.core import (  # noqa: F401
+    Finding,
+    FileRule,
+    ProjectRule,
+    RULES,
+    all_rules,
+    apply_baseline,
+    check_file_source,
+    load_baseline,
+    register,
+    run_lint,
+    write_baseline,
+)
+
+# importing the package registers every rule
+import tools.dtpu_lint.rules  # noqa: F401,E402

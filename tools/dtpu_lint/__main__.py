@@ -1,0 +1,178 @@
+"""CLI: ``python -m tools.dtpu_lint [paths...]``.
+
+Exit 0 when every finding is grandfathered (baseline) or pragma'd;
+exit 1 on findings beyond the baseline OR stale baseline entries
+(shrink-only policy — see docs/reference/lint.md). ``--format json``
+emits machine-readable findings for editor/CI integration.
+"""
+
+import argparse
+import json
+import sys
+from collections import Counter
+from pathlib import Path
+
+# runnable from anywhere: `python tools/dtpu_lint` resolves imports
+# relative to the repo root
+_REPO = Path(__file__).resolve().parent.parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from tools.dtpu_lint.core import (  # noqa: E402
+    BASELINE_PATH,
+    REPO,
+    all_rules,
+    apply_baseline,
+    iter_lint_files,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dtpu_lint",
+        description="JAX/TPU-aware static analysis (rule catalog: "
+        "docs/reference/lint.md)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to lint (default: the shipped package, with "
+        "baseline + stale-entry enforcement)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--baseline",
+        type=Path,
+        default=BASELINE_PATH,
+        help="baseline file (default: tools/dtpu_lint/baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, grandfathered or not",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="persist current findings as the new baseline and exit 0",
+    )
+    ap.add_argument(
+        "--rules", help="comma-separated rule ids to run (default: all)"
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, rule in sorted(all_rules().items()):
+            print(f"{rid}  {rule.name}")
+        return 0
+
+    rule_ids = (
+        [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        if args.rules
+        else None
+    )
+    if args.write_baseline and (args.paths or rule_ids):
+        # a subset run would overwrite the full baseline with only the
+        # subset's findings, silently un-grandfathering everything else
+        print(
+            "--write-baseline requires a full run (no paths, no --rules)",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        findings = run_lint(
+            REPO, paths=args.paths or None, rule_ids=rule_ids
+        )
+    except ValueError as e:
+        print(f"dtpu_lint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(
+            f"baseline written: {len(findings)} finding(s) → {args.baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.no_baseline:
+        new, stale = list(findings), []
+    else:
+        # subset runs (paths and/or --rules) compare against the
+        # baseline RESTRICTED to what was actually scanned — keys are
+        # (rule, path, message), so per-key counts reconcile exactly
+        # for whole-file subsets; an unrestricted baseline would
+        # report every other rule/file's entries as stale
+        baseline = load_baseline(args.baseline)
+        if rule_ids or args.paths:
+            rels = (
+                set(iter_lint_files(REPO, args.paths))
+                if args.paths
+                else None
+            )
+            baseline = Counter(
+                {
+                    k: n
+                    for k, n in baseline.items()
+                    if (
+                        rule_ids is None
+                        or k[0] in rule_ids
+                        or k[0].split("-")[0] in rule_ids
+                    )
+                    and (rels is None or k[1] in rels)
+                }
+            )
+        diff = apply_baseline(findings, baseline)
+        new, stale = diff.new, diff.stale
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_json() for f in new],
+                    "stale_baseline": [
+                        {
+                            "rule": k[0],
+                            "path": k[1],
+                            "message": k[2],
+                            "granted": granted,
+                            "seen": seen,
+                        }
+                        for k, granted, seen in stale
+                    ],
+                },
+                indent=1,
+            )
+        )
+        return 1 if (new or stale) else 0
+
+    for f in new:
+        print(f.render(), file=sys.stderr)
+    for key, granted, seen in stale:
+        print(
+            f"stale baseline entry ({key[0]} {key[1]}: granted {granted}, "
+            f"now {seen}): shrink the entry — baseline is shrink-only",
+            file=sys.stderr,
+        )
+    if new or stale:
+        print(
+            f"\n{len(new)} finding(s) beyond baseline, {len(stale)} stale "
+            "baseline entr(ies). Fix the code, opt out with "
+            "'# dtpu: noqa[RULE] <reason>', or (stale) prune "
+            "tools/dtpu_lint/baseline.json. Catalog: docs/reference/lint.md",
+            file=sys.stderr,
+        )
+        return 1
+    n = len(findings)
+    print(f"dtpu-lint clean ({n} grandfathered finding(s) in baseline)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

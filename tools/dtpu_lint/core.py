@@ -1,0 +1,347 @@
+"""Framework core: rule registry, pragmas, baseline, runner.
+
+Pieces, in dependency order:
+
+- :class:`Finding` — one diagnostic, keyed for baseline matching by
+  ``(rule, path, message)`` (line numbers drift with every edit; the
+  message text is stable per call site because rules interpolate the
+  offending symbol, not the position).
+- :class:`FileRule` / :class:`ProjectRule` — a file rule sees one
+  parsed AST at a time and declares the path globs it applies to; a
+  project rule runs once per lint over the whole repo (docs-coverage
+  style checks that aren't per-file).
+- ``# dtpu: noqa[RULE]`` pragmas — line-scoped opt-outs, rule id
+  required so an unrelated rule never hides behind someone else's
+  exemption. A reason after the bracket is conventional (reviewers
+  enforce it; the PR that adds a bare one gets asked why).
+- Baseline — grandfathered findings checked into
+  ``tools/dtpu_lint/baseline.json`` so a new rule can land with the
+  gate green while the backlog shrinks PR by PR. Shrink-only: the
+  gate fails on findings beyond the baseline AND on stale entries
+  (fixed findings must leave the file, or they'd mask regressions).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from collections import Counter
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional, Sequence
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+# default lint surface: the shipped package (tests and tools lint
+# themselves a rule at a time via fixtures, not the repo gate)
+DEFAULT_GLOBS = ("dstack_tpu/**/*.py",)
+
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*dtpu:\s*noqa\[(?P<rules>[A-Za-z0-9_,\s]+)\](?P<reason>[^\n]*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic. ``key`` ignores the line so baselines survive
+    unrelated edits above the call site."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    @property
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+def glob_match(relpath: str, pattern: str) -> bool:
+    """Pathlib-style glob matching where ``**/`` spans zero or more
+    directories — plain :func:`fnmatch.fnmatch` gives ``**`` no
+    special meaning, so ``pkg/**/*.py`` would silently exclude
+    top-level ``pkg/x.py`` while ``Path.glob`` includes it."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        if pattern.startswith("**/", i):
+            out.append(r"(?:[^/]+/)*")
+            i += 3
+        elif pattern[i] == "*":
+            out.append(r"[^/]*")
+            i += 1
+        else:
+            out.append(re.escape(pattern[i]))
+            i += 1
+    return re.fullmatch("".join(out), relpath) is not None
+
+
+class FileRule:
+    """Base for per-file AST rules. Subclasses set ``id``/``name``/
+    ``scope`` and implement :meth:`check`."""
+
+    id: str = ""
+    name: str = ""
+    #: ``**``-aware globs over repo-relative posix paths
+    scope: tuple = ("dstack_tpu/**/*.py",)
+
+    def applies(self, relpath: str) -> bool:
+        return any(glob_match(relpath, g) for g in self.scope)
+
+    def check(
+        self, tree: ast.AST, src: str, relpath: str, repo: Path
+    ) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+class ProjectRule:
+    """Base for once-per-lint whole-repo rules (docs coverage etc.)."""
+
+    id: str = ""
+    name: str = ""
+
+    def check_project(self, repo: Path) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+RULES: dict = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and index the rule by id."""
+    rule = cls()
+    if not rule.id:
+        raise ValueError(f"{cls.__name__} has no id")
+    if rule.id in RULES:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    RULES[rule.id] = rule
+    return cls
+
+
+def all_rules() -> dict:
+    """id → rule instance, importing the rule modules on first use."""
+    import tools.dtpu_lint.rules  # noqa: F401 - registration side effect
+
+    return RULES
+
+
+def _pragma_rules(line: str) -> Optional[set]:
+    """Rule ids a source line opts out of, or None without a pragma."""
+    m = _PRAGMA_RE.search(line)
+    if m is None:
+        return None
+    return {r.strip().upper() for r in m.group("rules").split(",") if r.strip()}
+
+
+def suppressed(finding: Finding, lines: Sequence[str]) -> bool:
+    """True when the finding's line — or a comment-only line directly
+    above it (the readable spot for a long reason) — carries a
+    matching noqa pragma."""
+    if not (1 <= finding.line <= len(lines)):
+        return False
+    candidates = [lines[finding.line - 1]]
+    prev = lines[finding.line - 2] if finding.line >= 2 else ""
+    if prev.lstrip().startswith("#"):
+        candidates.append(prev)
+    for line in candidates:
+        rules = _pragma_rules(line)
+        if rules is not None and finding.rule.upper() in rules:
+            return True
+    return False
+
+
+def check_file_source(
+    src: str,
+    relpath: str = "<string>",
+    rule_ids: Optional[Sequence[str]] = None,
+    repo: Optional[Path] = None,
+) -> list:
+    """Run file rules over one source string → sorted Findings (pragma
+    suppression applied). The unit-test / shim entry point."""
+    repo = repo or REPO
+    rules = all_rules()
+    picked = [
+        r
+        for rid, r in sorted(rules.items())
+        if isinstance(r, FileRule)
+        and (rule_ids is None or rid in rule_ids)
+    ]
+    tree = ast.parse(src, filename=relpath)
+    lines = src.splitlines()
+    out: list = []
+    for rule in picked:
+        if rule_ids is None and not rule.applies(relpath):
+            continue
+        for f in rule.check(tree, src, relpath, repo):
+            if not suppressed(f, lines):
+                out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def iter_lint_files(
+    repo: Path, paths: Optional[Sequence[str]] = None
+) -> list:
+    """Repo-relative posix paths to lint (sorted, deduped)."""
+    rels: set = set()
+    if paths:
+        for p in paths:
+            pp = Path(p)
+            if not pp.is_absolute():
+                pp = repo / pp
+            pp = pp.resolve()
+            try:
+                rel = pp.relative_to(repo)
+            except ValueError:
+                raise ValueError(
+                    f"path outside the repo ({repo}): {p}"
+                ) from None
+            if pp.is_dir():
+                rels.update(
+                    (rel / f.relative_to(pp)).as_posix()
+                    for f in pp.rglob("*.py")
+                )
+            else:
+                rels.add(rel.as_posix())
+    else:
+        for g in DEFAULT_GLOBS:
+            rels.update(f.relative_to(repo).as_posix() for f in repo.glob(g))
+    return sorted(rels)
+
+
+def run_lint(
+    repo: Optional[Path] = None,
+    paths: Optional[Sequence[str]] = None,
+    rule_ids: Optional[Sequence[str]] = None,
+    project_rules: bool = True,
+) -> list:
+    """Lint the repo (or ``paths``) → sorted Findings, pragmas applied,
+    baseline NOT applied (callers compare via :func:`apply_baseline`)."""
+    repo = repo or REPO
+    rules = all_rules()
+    file_rules = [
+        r
+        for rid, r in sorted(rules.items())
+        if isinstance(r, FileRule) and (rule_ids is None or rid in rule_ids)
+    ]
+    findings: list = []
+    for rel in iter_lint_files(repo, paths):
+        f = repo / rel
+        applicable = [r for r in file_rules if r.applies(rel)]
+        if not applicable:
+            continue
+        try:
+            src = f.read_text()
+            tree = ast.parse(src, filename=str(f))
+        except (OSError, SyntaxError) as e:
+            findings.append(
+                Finding("DTPU000", rel, 1, f"unparseable file: {e}")
+            )
+            continue
+        lines = src.splitlines()
+        for rule in applicable:
+            for finding in rule.check(tree, src, rel, repo):
+                if not suppressed(finding, lines):
+                    findings.append(finding)
+    if project_rules and paths is None:
+        for rid, r in sorted(rules.items()):
+            # a project rule shipped as a sub-id of a file rule
+            # (DTPU004-DOCS) runs whenever its base id is selected
+            if isinstance(r, ProjectRule) and (
+                rule_ids is None
+                or rid in rule_ids
+                or rid.split("-")[0] in rule_ids
+            ):
+                findings.extend(r.check_project(repo))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BaselineDiff:
+    """New findings beyond the baseline + stale (over-granted) entries."""
+
+    new: list = field(default_factory=list)
+    stale: list = field(default_factory=list)  # [(key, granted, seen)]
+
+    @property
+    def clean(self) -> bool:
+        return not self.new and not self.stale
+
+
+def load_baseline(path: Optional[Path] = None) -> Counter:
+    """key → grandfathered count (empty when the file is absent)."""
+    path = path or BASELINE_PATH
+    if not Path(path).exists():
+        return Counter()
+    data = json.loads(Path(path).read_text())
+    out: Counter = Counter()
+    for e in data.get("entries", []):
+        out[(e["rule"], e["path"], e["message"])] += int(e.get("count", 1))
+    return out
+
+
+def write_baseline(findings: Iterable[Finding], path: Optional[Path] = None) -> dict:
+    """Persist current findings as the new baseline (sorted, counted)."""
+    path = path or BASELINE_PATH
+    counts: Counter = Counter(f.key for f in findings)
+    entries = [
+        {"rule": k[0], "path": k[1], "message": k[2], "count": n}
+        for k, n in sorted(counts.items())
+    ]
+    data = {
+        "note": (
+            "Grandfathered dtpu-lint findings. SHRINK-ONLY: PRs may "
+            "remove entries (by fixing the finding and deleting the "
+            "entry) but never add or grow one — new code opts out per "
+            "line with '# dtpu: noqa[RULE] <reason>' instead. "
+            "Regenerate after fixes: python -m tools.dtpu_lint "
+            "--write-baseline"
+        ),
+        "entries": entries,
+    }
+    Path(path).write_text(json.dumps(data, indent=1) + "\n")
+    return data
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Counter) -> BaselineDiff:
+    """Split findings into (beyond-baseline, stale-entry) buckets.
+
+    Per key the first ``granted`` findings are grandfathered;
+    overflow (highest line numbers first kept as NEW so the newest
+    call site is what gets reported) fails the gate. A key granted
+    more than currently seen is stale — the finding was fixed but the
+    entry kept, which would silently re-admit a regression."""
+    diff = BaselineDiff()
+    by_key: dict = {}
+    for f in findings:
+        by_key.setdefault(f.key, []).append(f)
+    for key, fs in by_key.items():
+        granted = baseline.get(key, 0)
+        if len(fs) > granted:
+            ordered = sorted(fs, key=lambda f: f.line)
+            diff.new.extend(ordered[granted:])
+    for key, granted in baseline.items():
+        seen = len(by_key.get(key, ()))
+        if seen < granted:
+            diff.stale.append((key, granted, seen))
+    diff.new.sort(key=lambda f: (f.path, f.line, f.rule))
+    diff.stale.sort()
+    return diff

@@ -1,0 +1,9 @@
+"""Rule modules — importing this package registers every rule."""
+
+from tools.dtpu_lint.rules import (  # noqa: F401
+    async_blocking,
+    host_sync,
+    metric_hygiene,
+    recompile,
+    settings_drift,
+)

@@ -1,0 +1,165 @@
+"""DTPU001: blocking calls inside ``async def`` on the data plane.
+
+The proxy, gateway, and routing packages ARE the serving hot path: one
+``time.sleep`` or sync ``requests.get`` inside a coroutine stalls every
+connection on the event loop, and such bugs pass tests (which never
+load the loop enough to notice). Flagged, directly inside ``async def``
+bodies:
+
+- ``time.sleep(...)`` (any import alias, incl. ``from time import sleep``)
+- any call into the sync ``requests`` / ``urllib.request`` modules
+- blocking file I/O: builtin ``open(...)`` and ``Path`` convenience
+  methods (``.read_text/.write_text/.read_bytes/.write_bytes``)
+
+Nested *sync* ``def``s inside a coroutine are exempt — the idiom for
+work handed to ``run_in_executor``/``asyncio.to_thread``. Opt-outs:
+the framework pragma ``# dtpu: noqa[DTPU001] <reason>`` or the legacy
+``# blocking: ok`` trailer (kept so pre-framework exemptions and the
+muscle memory around them stay valid).
+
+Migrated from ``tools/check_async_blocking.py`` (PR 3), which remains
+as a thin shim over this rule.
+"""
+
+import ast
+
+from tools.dtpu_lint.core import FileRule, Finding, register
+
+SYNC_HTTP_MODULES = {"requests", "urllib.request"}
+PATH_IO_METHODS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+LEGACY_OPT_OUT = "# blocking: ok"
+
+
+def _module_aliases(tree: ast.AST) -> tuple:
+    """(name -> (module, exact), bare names bound to ``time.sleep``)
+    collected from the file's imports. ``exact`` means the name IS the
+    module object (``import requests``, ``import urllib.request as
+    ur``); ``import urllib.request`` only binds the ``urllib`` root, so
+    calls through it must spell out the full dotted module path to
+    count (``urllib.parse.quote`` is not sync HTTP)."""
+    aliases: dict = {}
+    sleep_names: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in SYNC_HTTP_MODULES or a.name == "time":
+                    if a.asname is not None or "." not in a.name:
+                        aliases[a.asname or a.name] = (a.name, True)
+                    else:
+                        aliases[a.name.split(".")[0]] = (a.name, False)
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module == "time":
+                for a in node.names:
+                    if a.name == "sleep":
+                        sleep_names.add(a.asname or a.name)
+            elif node.module in SYNC_HTTP_MODULES or node.module == "urllib":
+                for a in node.names:
+                    full = f"{node.module}.{a.name}"
+                    if node.module in SYNC_HTTP_MODULES or full in SYNC_HTTP_MODULES:
+                        aliases[a.asname or a.name] = (full, True)
+    return aliases, sleep_names
+
+
+def _dotted(node: ast.AST):
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+class _AsyncBodyChecker(ast.NodeVisitor):
+    """Walks ONE coroutine body; does not descend into nested sync
+    defs (executor-bound work) — nested async defs get their own walk
+    from the file-level pass."""
+
+    def __init__(self, aliases, sleep_names, violations, lines):
+        self.aliases = aliases
+        self.sleep_names = sleep_names
+        self.violations = violations
+        self.lines = lines
+
+    def visit_FunctionDef(self, node):
+        pass  # sync helper inside a coroutine: allowed (executor work)
+
+    def visit_AsyncFunctionDef(self, node):
+        pass  # checked separately by the file-level pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Call(self, node):
+        msg = self._classify(node)
+        if msg is not None:
+            line = (
+                self.lines[node.lineno - 1]
+                if node.lineno <= len(self.lines)
+                else ""
+            )
+            if LEGACY_OPT_OUT not in line:
+                self.violations.append((node.lineno, msg))
+        self.generic_visit(node)
+
+    def _classify(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id == "open":
+                return "blocking file I/O: open() in async def"
+            if func.id in self.sleep_names:
+                return "time.sleep() in async def (use asyncio.sleep)"
+            bound = self.aliases.get(func.id)
+            if bound is not None and (
+                bound[0] in SYNC_HTTP_MODULES
+                or bound[0].rsplit(".", 1)[0] in SYNC_HTTP_MODULES
+            ):
+                return f"sync HTTP call ({bound[0]}) in async def"
+            return None
+        dotted = _dotted(func)
+        if dotted is not None:
+            root = dotted.split(".")[0]
+            bound = self.aliases.get(root)
+            if bound is not None:
+                module, exact = bound
+                if module == "time" and dotted.endswith(".sleep"):
+                    return "time.sleep() in async def (use asyncio.sleep)"
+                if module in SYNC_HTTP_MODULES and (
+                    exact or dotted.startswith(module + ".")
+                ):
+                    return f"sync HTTP call ({module}) in async def"
+        if isinstance(func, ast.Attribute) and func.attr in PATH_IO_METHODS:
+            return f"blocking file I/O: .{func.attr}() in async def"
+        return None
+
+
+def check_source(src: str, path: str = "<string>") -> list:
+    """→ [(lineno, message)] for one file's source (the shim API kept
+    for tools/check_async_blocking.py and its tier-1 test)."""
+    tree = ast.parse(src, filename=path)
+    aliases, sleep_names = _module_aliases(tree)
+    lines = src.splitlines()
+    violations: list = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            checker = _AsyncBodyChecker(aliases, sleep_names, violations, lines)
+            for stmt in node.body:
+                checker.visit(stmt)
+    return sorted(set(violations))
+
+
+@register
+class AsyncBlockingRule(FileRule):
+    id = "DTPU001"
+    name = "blocking call inside async def (data plane)"
+    scope = (  # glob_match's **/ spans zero dirs: top-level included
+        "dstack_tpu/proxy/**/*.py",
+        "dstack_tpu/gateway/**/*.py",
+        "dstack_tpu/routing/**/*.py",
+    )
+
+    def check(self, tree, src, relpath, repo):
+        for lineno, msg in check_source(src, relpath):
+            yield Finding(self.id, relpath, lineno, msg)

@@ -1,0 +1,202 @@
+"""DTPU002: host↔device syncs/transfers in serve/ops hot paths.
+
+The serve engine's decode loop runs per generated token; one stray
+``.item()`` (a blocking device→host round trip) or a re-uploaded host
+list (``jnp.asarray`` per token) caps throughput at the host-device
+link instead of the TPU — and no unit test notices, because parity
+tests don't measure dispatch counts. Flagged inside
+``dstack_tpu/serve/engine.py``, ``dstack_tpu/serve/openai_server.py``,
+and ``dstack_tpu/ops/``:
+
+anywhere in the file (these block even in dispatch code, and cannot
+appear inside traced code at all):
+
+- ``.item()`` — blocking scalar pull
+- ``jax.device_get(...)`` / ``from jax import device_get``
+- ``.block_until_ready()``
+- ``np.asarray(...)`` (numpy) — materializes a device array on host
+
+only inside *class method* bodies — the engine's dispatch code. The
+module-level functions in these files are jit-traced model code where
+``jnp.asarray`` is a free constant fold, so flagging them would be
+pure noise:
+
+- ``jnp.asarray/jnp.array/jnp.arange(...)`` — a fresh host→device
+  upload per call; per-token call sites should mirror device-resident
+  state instead (see ``InferenceEngine._decode_state``)
+- ``float(x[...])`` / ``int(x[...])`` — scalar coercion of an indexed
+  array forces a device sync when ``x`` is device-resident
+- ``print(...)`` with a non-literal argument — formatting a device
+  array blocks on its transfer
+
+Findings name the enclosing function so the baseline shrinks method
+by method as call sites get fixed. Most grandfathered sites are
+per-request (prefill/activation) rather than per-token — acceptable
+today, still worth burning down.
+"""
+
+import ast
+
+from tools.dtpu_lint.core import FileRule, Finding, register
+
+_UPLOAD_FUNCS = {"asarray", "array", "arange", "zeros", "ones", "full"}
+
+
+def _collect_aliases(tree: ast.AST) -> dict:
+    """name → one of {"numpy", "jax.numpy", "jax"} plus bare names
+    bound to jax.device_get, from the file's imports."""
+    aliases: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    aliases[a.asname or "numpy"] = "numpy"
+                elif a.name == "jax.numpy":
+                    aliases[a.asname or "jax"] = (
+                        "jax.numpy" if a.asname else "jax"
+                    )
+                elif a.name == "jax":
+                    aliases[a.asname or "jax"] = "jax"
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases[a.asname or "numpy"] = "jax.numpy"
+                    elif a.name == "device_get":
+                        aliases[a.asname or "device_get"] = "jax.device_get"
+            elif node.module == "numpy":
+                for a in node.names:
+                    if a.name == "asarray":
+                        aliases[a.asname or "asarray"] = "numpy.asarray"
+    return aliases
+
+
+def _receiver_root(node: ast.AST):
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, aliases: dict, relpath: str):
+        self.aliases = aliases
+        self.relpath = relpath
+        self.findings: list = []
+        self._ctx: list = []  # enclosing function names
+        self._method_depth = 0  # >0 while inside a class-method body
+
+    # -- context tracking ---------------------------------------------------
+
+    def visit_ClassDef(self, node):
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._ctx.append(f"{node.name}.{stmt.name}")
+                self._method_depth += 1
+                for inner in stmt.body:
+                    self.visit(inner)
+                self._method_depth -= 1
+                self._ctx.pop()
+            else:
+                self.visit(stmt)
+
+    def _visit_fn(self, node):
+        self._ctx.append(node.name)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._ctx.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    # -- checks -------------------------------------------------------------
+
+    def _where(self) -> str:
+        return self._ctx[-1] if self._ctx else "<module>"
+
+    def _emit(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(
+            Finding("DTPU002", self.relpath, node.lineno, f"{msg} [in {self._where()}]")
+        )
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        # .item() / .block_until_ready(): blocking pulls, any context
+        if isinstance(func, ast.Attribute) and not node.args and not node.keywords:
+            if func.attr == "item":
+                self._emit(node, "host sync: .item() blocks on a device→host transfer")
+            elif func.attr == "block_until_ready":
+                self._emit(node, "host sync: .block_until_ready()")
+        # module-qualified calls
+        if isinstance(func, ast.Attribute):
+            root = _receiver_root(func)
+            mod = self.aliases.get(root) if root is not None else None
+            # fully-qualified jax.numpy.<fn>: the root alias resolves
+            # to "jax", so treat a `.numpy` receiver as the jnp module
+            if (
+                mod == "jax"
+                and isinstance(func.value, ast.Attribute)
+                and func.value.attr == "numpy"
+            ):
+                mod = "jax.numpy"
+            if mod == "jax" and func.attr == "device_get":
+                self._emit(node, "host sync: jax.device_get() pulls arrays to host")
+            elif mod == "numpy" and func.attr == "asarray":
+                self._emit(
+                    node,
+                    "host copy: np.asarray() materializes a (possibly "
+                    "device) array on host",
+                )
+            elif (
+                mod == "jax.numpy"
+                and func.attr in _UPLOAD_FUNCS
+                and self._method_depth > 0
+            ):
+                self._emit(
+                    node,
+                    f"per-call device upload: jnp.{func.attr}() in engine "
+                    "dispatch code (hoist, or mirror device-resident state)",
+                )
+        elif isinstance(func, ast.Name):
+            bound = self.aliases.get(func.id)
+            if bound == "jax.device_get":
+                self._emit(node, "host sync: jax.device_get() pulls arrays to host")
+            elif bound == "numpy.asarray":
+                self._emit(
+                    node,
+                    "host copy: np.asarray() materializes a (possibly "
+                    "device) array on host",
+                )
+            elif self._method_depth > 0:
+                if func.id in ("float", "int") and len(node.args) == 1 and isinstance(
+                    node.args[0], ast.Subscript
+                ):
+                    self._emit(
+                        node,
+                        f"host sync: {func.id}() on an indexed array forces "
+                        "a device→host transfer",
+                    )
+                elif func.id == "print" and any(
+                    not isinstance(a, ast.Constant) for a in node.args
+                ):
+                    self._emit(
+                        node,
+                        "print() of a non-literal in dispatch code blocks "
+                        "if the value is a device array",
+                    )
+        self.generic_visit(node)
+
+
+@register
+class HostSyncRule(FileRule):
+    id = "DTPU002"
+    name = "host-device sync/transfer in serve/ops hot paths"
+    scope = (
+        "dstack_tpu/serve/engine.py",
+        "dstack_tpu/serve/openai_server.py",
+        "dstack_tpu/ops/*.py",
+    )
+
+    def check(self, tree, src, relpath, repo):
+        checker = _Checker(_collect_aliases(tree), relpath)
+        checker.visit(tree)
+        return checker.findings

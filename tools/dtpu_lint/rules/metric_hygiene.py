@@ -1,0 +1,153 @@
+"""DTPU004: metric hygiene — docs coverage + bounded label values.
+
+Two halves, one invariant: every exported series is documented, and no
+label can grow without bound.
+
+**Docs coverage** (project-wide, absorbs ``tools/check_metrics_docs.py``
+from PR 1): scrapes every metric family name the system can export —
+the HTTP tracing registry, the serve/routing/train registry factories,
+and the DB-backed cluster renderer's ``w.sample("name", ...)`` calls —
+and fails when one is missing from ``docs/reference/server.md``'s
+"Metrics & timeline" section.
+
+**Label hygiene** (per file, repo-wide): label values passed to
+``.inc(value, *labels)`` / ``.set(value, *labels)`` /
+``.observe(value, *labels)`` must be literals or come from a bounded
+enum (``x.state.value``-style attribute access). A request-derived
+string — an f-string, concatenation, ``.format()``, ``str(...)`` or
+any call result — mints a new series per distinct value; the obs
+registry's cardinality cap turns that into a silent ``<truncated>``
+collapse instead of an OOM, but the series is still garbage. Bare
+names are allowed (typically a loop over a bounded state dict); the
+rule catches the *construction* of unbounded values at the call site.
+"""
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+from tools.dtpu_lint.core import FileRule, Finding, ProjectRule, register
+
+_LABEL_METHODS = {"inc", "set", "observe"}
+
+DOCS_REL = Path("docs") / "reference" / "server.md"
+
+
+def _label_problem(arg: ast.AST):
+    """Why this label-value expression is unbounded, or None when ok."""
+    if isinstance(arg, ast.JoinedStr):
+        return "an f-string"
+    if isinstance(arg, ast.BinOp):
+        return "a string-building expression"
+    if isinstance(arg, ast.Call):
+        f = arg.func
+        if isinstance(f, ast.Attribute) and f.attr == "format":
+            return ".format()"
+        if isinstance(f, ast.Name) and f.id == "str":
+            return "str(...)"
+        return "a call result"
+    return None
+
+
+def check_label_source(src: str, relpath: str = "<string>") -> list:
+    """→ Findings for unbounded metric label values in one file."""
+    tree = ast.parse(src, filename=relpath)
+    findings: list = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _LABEL_METHODS
+            and len(node.args) >= 2
+        ):
+            continue
+        # args[0] is the value; the rest are label values
+        for arg in node.args[1:]:
+            why = _label_problem(arg)
+            if why is not None:
+                findings.append(
+                    Finding(
+                        "DTPU004",
+                        relpath,
+                        node.lineno,
+                        f"metric label value built from {why}: labels "
+                        "must be literals or bounded-enum attributes "
+                        "(request-derived labels mint unbounded series)",
+                    )
+                )
+    return findings
+
+
+@register
+class MetricLabelRule(FileRule):
+    id = "DTPU004"
+    name = "metric hygiene (bounded label values)"
+    scope = ("dstack_tpu/**/*.py",)
+
+    def check(self, tree, src, relpath, repo):
+        return check_label_source(src, relpath)
+
+
+# ---------------------------------------------------------------------------
+# docs coverage (project half)
+# ---------------------------------------------------------------------------
+
+
+def collect_metric_names(repo: Path) -> set:
+    """Every metric family name the system can export."""
+    if str(repo) not in sys.path:  # runnable from anywhere
+        sys.path.insert(0, str(repo))
+    names: set = set()
+    from dstack_tpu.routing.metrics import new_router_registry
+    from dstack_tpu.serve.metrics import new_serve_registry
+    from dstack_tpu.server.tracing import RequestStats
+
+    names.update(RequestStats().registry.metric_names())
+    names.update(new_serve_registry().metric_names())
+    names.update(new_router_registry().metric_names())
+    try:
+        from dstack_tpu.train.step import new_train_registry
+
+        names.update(new_train_registry().metric_names())
+    except ImportError as e:
+        # jax/optax absent: scrape the registry-construction source
+        # instead (a hardcoded fallback list would silently drift when
+        # a family is added to new_train_registry)
+        print(f"note: train registry parsed from source ({e})", file=sys.stderr)
+        step_src = (repo / "dstack_tpu" / "train" / "step.py").read_text()
+        names.update(
+            re.findall(
+                r'r\.(?:counter|gauge|histogram)\(\s*\n?\s*"([a-z0-9_]+)"',
+                step_src,
+            )
+        )
+    renderer = (
+        repo / "dstack_tpu" / "server" / "services" / "prometheus.py"
+    ).read_text()
+    names.update(re.findall(r'w\.sample\(\s*\n?\s*"([a-z0-9_]+)"', renderer))
+    return names
+
+
+def docs_coverage_findings(repo: Path) -> list:
+    doc = (repo / DOCS_REL).read_text()
+    return [
+        Finding(
+            "DTPU004",
+            DOCS_REL.as_posix(),
+            1,
+            f"exported metric series `{n}` is missing from the "
+            "'Metrics & timeline' section",
+        )
+        for n in sorted(collect_metric_names(repo))
+        if n not in doc
+    ]
+
+
+@register
+class MetricDocsRule(ProjectRule):
+    id = "DTPU004-DOCS"
+    name = "metric hygiene (every exported series documented)"
+
+    def check_project(self, repo):
+        return docs_coverage_findings(repo)

@@ -1,0 +1,163 @@
+"""DTPU003: recompile hazards around ``jax.jit``.
+
+XLA compiles one variant per (shape, static-arg) signature. Two
+patterns turn that into an unbounded compile storm that passes every
+unit test (tests use one or two shapes) and melts down under real
+traffic:
+
+- **jit inside a loop** — ``jax.jit(...)`` in a ``for``/``while`` body
+  re-traces every iteration unless the result is memoized; even
+  memoized, each iteration pays Python-side wrapper construction.
+- **jit cache keyed by a caller-supplied value** — the
+  ``self._fns[key] = jax.jit(...)`` memoization idiom is only bounded
+  if every caller buckets the key (this repo's contract: powers of
+  two, giving a log2 grid of variants — see
+  ``InferenceEngine.prefill_wave``). The rule cannot see across
+  functions, so every such assignment is flagged; a site whose
+  callers provably bucket opts out with
+  ``# dtpu: noqa[DTPU003] <which caller buckets and how>`` — the
+  pragma (not folklore) then documents the contract, and a new
+  unbucketed caller is a reviewable diff on the bucketing sites.
+
+A ``functools.lru_cache(maxsize=N)``-decorated factory is the bounded
+alternative for caller-keyed jits (the embeddings endpoint's pattern).
+"""
+
+import ast
+
+from tools.dtpu_lint.core import FileRule, Finding, register
+
+
+def _jax_names(tree: ast.AST) -> set:
+    """Local names bound to the jax module (``import jax``,
+    ``import jax as _jax``)."""
+    names: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    names.add(a.asname or "jax")
+    return names
+
+
+def _is_jit_call(node: ast.AST, jax_names: set) -> bool:
+    """``jax.jit(...)`` / ``jax.pmap(...)`` through any jax alias."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr in ("jit", "pmap")
+        and isinstance(f.value, ast.Name)
+        and f.value.id in jax_names
+    )
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class _FnChecker(ast.NodeVisitor):
+    """Walks ONE function body looking for loop-jits and cache-key
+    assignments; nested defs get their own walk from the file pass."""
+
+    def __init__(self, fn, jax_names, relpath, findings):
+        self.fn = fn
+        self.jax_names = jax_names
+        self.relpath = relpath
+        self.findings = findings
+        params = {
+            a.arg
+            for a in (
+                fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+            )
+            if a.arg not in ("self", "cls")
+        }
+        # taint locals derived from parameters (`key = (cl, start)`)
+        # so the engine's two-line memoization idiom is still seen;
+        # iterate to a fixpoint for chained assignments
+        tainted = set(params)
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and _names_in(node.value) & tainted:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id not in tainted:
+                            tainted.add(t.id)
+                            changed = True
+        self.params = tainted
+        self._loop_depth = 0
+
+    def visit_FunctionDef(self, node):
+        pass  # separate walk
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_Assign(self, node):
+        jit_value = _is_jit_call(node.value, self.jax_names)
+        for target in node.targets:
+            if jit_value and isinstance(target, ast.Subscript):
+                key_names = _names_in(target.slice) & self.params
+                if key_names:
+                    self.findings.append(
+                        Finding(
+                            "DTPU003",
+                            self.relpath,
+                            node.lineno,
+                            "jit cache keyed by caller-supplied "
+                            f"value(s) {sorted(key_names)} in "
+                            f"{self.fn.name}(): unbounded unless every "
+                            "caller buckets the key (powers of two); "
+                            "noqa with the bucketing call sites, or "
+                            "use functools.lru_cache(maxsize=N)",
+                        )
+                    )
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if self._loop_depth > 0 and _is_jit_call(node, self.jax_names):
+            self.findings.append(
+                Finding(
+                    "DTPU003",
+                    self.relpath,
+                    node.lineno,
+                    f"jax.{node.func.attr}() inside a loop in "
+                    f"{self.fn.name}(): re-traces/rebuilds per iteration "
+                    "— hoist it or memoize with a bounded key",
+                )
+            )
+        self.generic_visit(node)
+
+
+@register
+class RecompileRule(FileRule):
+    id = "DTPU003"
+    name = "recompile hazard (jit-in-loop, unbucketed jit cache key)"
+    scope = (
+        "dstack_tpu/serve/*.py",
+        "dstack_tpu/ops/*.py",
+        "dstack_tpu/train/*.py",
+        "dstack_tpu/models/*.py",
+    )
+
+    def check(self, tree, src, relpath, repo):
+        jax_names = _jax_names(tree)
+        if not jax_names:
+            return []
+        findings: list = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                checker = _FnChecker(node, jax_names, relpath, findings)
+                for stmt in node.body:
+                    checker.visit(stmt)
+        return findings

@@ -1,0 +1,102 @@
+"""DTPU005: settings drift — undocumented ``DTPU_*`` env reads.
+
+``server/settings.py`` is the documented configuration surface; env
+vars read anywhere else accumulate silently until nobody can list what
+actually configures a deployment. The agent, serve, and backend
+processes legitimately read a handful of ``DTPU_*`` vars directly
+(they run on job hosts and must not import server settings), so the
+contract is *documented, not necessarily centralized*: every
+``os.getenv("DTPU_…")`` / ``os.environ["DTPU_…"]`` /
+``os.environ.get("DTPU_…")`` outside ``server/settings.py`` must name
+a variable documented in ``docs/reference/server.md`` (operator
+surface) or ``docs/reference/testing.md`` (test-infra switches).
+An undocumented read fails the gate — centralize it into
+``server/settings.py`` or add it to the docs table.
+"""
+
+import ast
+import re
+from functools import lru_cache
+from pathlib import Path
+
+from tools.dtpu_lint.core import FileRule, Finding, register
+
+DOC_FILES = (
+    Path("docs") / "reference" / "server.md",
+    Path("docs") / "reference" / "testing.md",
+)
+
+_VAR_RE = re.compile(r"DTPU_[A-Z0-9_]+")
+
+
+@lru_cache(maxsize=4)
+def documented_vars(repo: Path) -> frozenset:
+    names: set = set()
+    for rel in DOC_FILES:
+        p = repo / rel
+        if p.exists():
+            names.update(_VAR_RE.findall(p.read_text()))
+    return frozenset(names)
+
+
+def _env_read_var(node: ast.AST):
+    """The DTPU_* var name a call/subscript reads, or None.
+
+    Matches ``os.getenv("X", ...)``, ``os.environ.get("X", ...)``,
+    ``os.environ["X"]``, and the same through ``environ`` imported
+    from os (``from os import environ, getenv``)."""
+
+    def _const_var(expr) -> str:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            m = _VAR_RE.fullmatch(expr.value)
+            if m:
+                return expr.value
+        return None
+
+    def _is_environ(expr) -> bool:
+        if isinstance(expr, ast.Attribute) and expr.attr == "environ":
+            return isinstance(expr.value, ast.Name) and expr.value.id == "os"
+        return isinstance(expr, ast.Name) and expr.id == "environ"
+
+    if isinstance(node, ast.Call) and node.args:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "getenv" and isinstance(f.value, ast.Name) and f.value.id == "os":
+                return _const_var(node.args[0])
+            if f.attr == "get" and _is_environ(f.value):
+                return _const_var(node.args[0])
+        elif isinstance(f, ast.Name) and f.id == "getenv":
+            return _const_var(node.args[0])
+    elif (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.ctx, ast.Load)  # a write is not drift
+        and _is_environ(node.value)
+    ):
+        return _const_var(node.slice)
+    return None
+
+
+@register
+class SettingsDriftRule(FileRule):
+    id = "DTPU005"
+    name = "settings drift (undocumented DTPU_* env read)"
+    scope = ("dstack_tpu/**/*.py",)
+
+    def applies(self, relpath: str) -> bool:
+        if relpath == "dstack_tpu/server/settings.py":
+            return False  # THE settings surface
+        return super().applies(relpath)
+
+    def check(self, tree, src, relpath, repo):
+        documented = documented_vars(repo)
+        for node in ast.walk(tree):
+            var = _env_read_var(node)
+            if var is not None and var not in documented:
+                yield Finding(
+                    "DTPU005",
+                    relpath,
+                    node.lineno,
+                    f"env var {var} read outside server/settings.py and "
+                    "not documented in docs/reference/server.md — "
+                    "centralize it in settings or document it",
+                )
