@@ -12,6 +12,8 @@ Usage::
         print(line, end="")
 """
 
+import os
+import random
 import time
 from pathlib import Path
 from typing import Iterator, Optional, Union
@@ -25,8 +27,28 @@ from dstack_tpu.core.models.configurations import (
     parse_run_configuration,
 )
 from dstack_tpu.core.models.runs import Run, RunPlan, RunSpec, RunStatus
+from dstack_tpu.utils.retry import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    wait_for_sync,
+)
 
 CLIENT_CONFIG_PATH = Path("~/.dtpu/config.yml").expanduser()
+
+# Overall deadlines for the client's polling loops — a wedged run or a
+# server that stops answering must not block the Python API forever.
+# WAIT bounds `runs.wait()` end to end (runs legitimately take long:
+# default one day); IDLE bounds `runs.logs(follow=True)` on *lack of
+# progress* — any log batch or run-status change resets it, so a noisy
+# week-long training follow never trips while a wedged one does.
+# 0 disables the bound (legacy unbounded behavior).
+WAIT_DEADLINE = float(os.getenv("DTPU_API_WAIT_DEADLINE", "86400"))
+IDLE_DEADLINE = float(os.getenv("DTPU_API_IDLE_DEADLINE", "3600"))
+
+
+def _deadline(seconds: float) -> Optional[Deadline]:
+    return Deadline(seconds) if seconds > 0 else None
 
 
 def read_client_config(path: Optional[Path] = None) -> dict:
@@ -182,14 +204,27 @@ class RunCollection:
     def wait(
         self, run_name: str, timeout: Optional[float] = None, poll: float = 2.0
     ) -> Run:
-        deadline = time.monotonic() + timeout if timeout else None
-        while True:
+        """Block until the run finishes. ``timeout`` overrides the
+        default overall deadline (``DTPU_API_WAIT_DEADLINE``, 24h;
+        0 = unbounded, same convention as the env var); exhaustion
+        raises a ``TimeoutError``
+        (:class:`~dstack_tpu.utils.retry.DeadlineExceeded`)."""
+        if timeout is not None:
+            deadline = Deadline(timeout) if timeout > 0 else None
+        else:
+            deadline = _deadline(WAIT_DEADLINE)
+
+        def _poll() -> Optional[Run]:
             run = self.get(run_name)
-            if run.status.is_finished():
-                return run
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"run {run_name} not finished: {run.status}")
-            time.sleep(poll)
+            return run if run.status.is_finished() else None
+
+        return wait_for_sync(
+            _poll,
+            site="api.run_wait",
+            interval=poll,
+            deadline=deadline,
+            what=f"run {run_name} not finished",
+        )
 
     def logs(
         self,
@@ -215,6 +250,11 @@ class RunCollection:
                 return
         token: Optional[str] = None
         finished_seen = False
+        # idle deadline: resets on ANY progress (a log batch or a run
+        # status change) — bounds a wedged run without capping how long
+        # a live one may be followed (DTPU_API_IDLE_DEADLINE, 0 = off)
+        idle = _deadline(IDLE_DEADLINE)
+        last_status = None
         while True:
             batch = self._c.api.poll_logs(
                 self._c.project, run_name, next_token=token,
@@ -224,6 +264,7 @@ class RunCollection:
             for ev in batch.logs:
                 yield ev.text()
             if batch.logs:
+                idle = _deadline(IDLE_DEADLINE)
                 continue  # keep draining full pages back-to-back
             if not follow:
                 return
@@ -232,9 +273,18 @@ class RunCollection:
             run = self.get(run_name)
             if on_status is not None:
                 on_status(run)
+            if run.status != last_status:
+                last_status = run.status
+                idle = _deadline(IDLE_DEADLINE)
             if run.status.is_finished():
                 finished_seen = True  # one more drain pass, then exit
                 continue
+            if idle is not None and idle.expired():
+                raise DeadlineExceeded(
+                    f"no log or status progress from run {run_name} in "
+                    f"{IDLE_DEADLINE:.0f}s (run stuck in {run.status}); "
+                    "raise DTPU_API_IDLE_DEADLINE or set 0 to disable"
+                )
             time.sleep(poll_interval)
 
     def _ws_logs(self, run_name: str, on_status) -> Iterator[str]:
@@ -243,7 +293,13 @@ class RunCollection:
         from dstack_tpu.core.errors import LogStreamDropped
 
         last_ts = 0.0
-        drops = 0
+        # reconnect backoff: jittered exponential (0.5s → ~8s) instead
+        # of the old fixed 1s hammer; schedule exhaustion = persistent
+        # trouble, fall back to REST polling
+        reconnects = iter(
+            RetryPolicy(max_attempts=6, base_delay=0.5, max_delay=8.0)
+            .schedule(random.Random())
+        )
         while True:
             try:
                 for ev in self._c.api.stream_logs_ws(
@@ -254,20 +310,30 @@ class RunCollection:
             except ClientError:
                 return False  # no live job / no ws on server: poll
             except LogStreamDropped:
-                drops += 1
-                if drops > 5:
+                delay = next(reconnects, None)
+                if delay is None:
                     return False  # persistent trouble: poll the rest
-                time.sleep(1.0)
+                time.sleep(delay)
                 continue  # resume from the cursor, no duplicates
             # clean close: the runner drained its tail. Surface the final
             # run state (the reconciler may lag the runner by a cycle).
             if on_status is not None:
-                for _ in range(15):
+                final = Deadline(15.0)
+
+                def _final_status() -> Optional[Run]:
                     run = self.get(run_name)
                     on_status(run)
-                    if run.status.is_finished():
-                        break
-                    time.sleep(1.0)
+                    return run if run.status.is_finished() else None
+
+                try:
+                    wait_for_sync(
+                        _final_status,
+                        site="api.log_final_status",
+                        interval=1.0,
+                        deadline=final,
+                    )
+                except DeadlineExceeded:
+                    pass  # reconciler still lagging; caller has the logs
             return True
 
 

@@ -10,16 +10,40 @@ fake transport, real deployments authenticate via google.auth
 
 import asyncio
 import json
+import os
 from typing import Any, Optional
 
 import aiohttp
 
-from dstack_tpu.core.errors import BackendAuthError, BackendError
+from dstack_tpu import faults
+from dstack_tpu.core.errors import (
+    BackendAuthError,
+    BackendError,
+    BackendRequestError,
+)
 from dstack_tpu.utils.logging import get_logger
+from dstack_tpu.utils.retry import (
+    Deadline,
+    RetryPolicy,
+    default_should_retry,
+    retry_async,
+)
 
 logger = get_logger("backends.gcp.api")
 
 TPU_API = "https://tpu.googleapis.com/v2"
+
+# Transient-failure budget for one logical API call: 429s, 5xx, and
+# connect errors retry with jittered exponential backoff (Retry-After
+# respected); 4xx and auth errors never retry. Node/disk creation is
+# safe to retry: GCP keys creations on the caller-supplied id, so a
+# replayed create answers 409 (not retryable, surfaced).
+GCP_RETRY_ATTEMPTS = int(os.getenv("DTPU_GCP_RETRY_ATTEMPTS", "4"))
+GCP_RETRY_DEADLINE = float(os.getenv("DTPU_GCP_RETRY_DEADLINE", "120"))
+
+_RETRY_POLICY = RetryPolicy(
+    max_attempts=GCP_RETRY_ATTEMPTS, base_delay=0.5, max_delay=15.0
+)
 
 
 class Transport:
@@ -71,22 +95,50 @@ class Transport:
         json_body: Optional[dict] = None,
         params: Optional[dict] = None,
     ) -> dict:
-        loop = asyncio.get_running_loop()
-        token = await loop.run_in_executor(None, self._get_token)
-        session = self._get_session()
-        async with session.request(
-            method,
-            url,
-            json=json_body,
-            params=params,
-            headers={"Authorization": f"Bearer {token}"},
-        ) as resp:
-            text = await resp.text()
-            if resp.status >= 400:
-                raise BackendError(
-                    f"GCP API {method} {url}: {resp.status} {text[:400]}"
+        """One logical API call: transient failures (429/5xx/connect
+        errors/timeouts) retry per :data:`_RETRY_POLICY` under an
+        overall deadline; auth errors and 4xx surface immediately."""
+        deadline = Deadline(GCP_RETRY_DEADLINE)
+
+        async def _attempt() -> dict:
+            await faults.afire("gcp.api.request", method=method, url=url)
+            loop = asyncio.get_running_loop()
+            token = await loop.run_in_executor(None, self._get_token)
+            session = self._get_session()
+            async with session.request(
+                method,
+                url,
+                json=json_body,
+                params=params,
+                headers={"Authorization": f"Bearer {token}"},
+            ) as resp:
+                text = await resp.text()
+                if resp.status >= 400:
+                    raise BackendRequestError(
+                        f"GCP API {method} {url}: {resp.status} {text[:400]}",
+                        status=resp.status,
+                        retry_after=resp.headers.get("Retry-After"),
+                    )
+                result = json.loads(text) if text else {}
+                return faults.mutate(
+                    "gcp.api.request", result, method=method, url=url
                 )
-            return json.loads(text) if text else {}
+
+        def _transient(exc: BaseException) -> bool:
+            # the shared classifier (429/5xx via the status attr,
+            # connect errors, timeouts) with one backend-specific
+            # carve-out: auth failures never retry
+            if isinstance(exc, BackendAuthError):
+                return False
+            return default_should_retry(exc)
+
+        return await retry_async(
+            _attempt,
+            site="gcp.api",
+            policy=_RETRY_POLICY,
+            should_retry=_transient,
+            deadline=deadline,
+        )
 
 
 class TPUNodesAPI:
@@ -326,7 +378,11 @@ class GCEInstancesAPI:
                 json_body=body,
             )
         except BackendError as e:
-            if "409" not in str(e) and "alreadyExists" not in str(e):
+            if (
+                getattr(e, "status", None) != 409
+                and "409" not in str(e)
+                and "alreadyExists" not in str(e)
+            ):
                 raise
 
 

@@ -413,19 +413,30 @@ class GCPTPUCompute(
         # projects must not collide inside one GCP project
         disk_name = f"dtpu-{volume.project_name}-{volume.name}"[:60].rstrip("-")
         await self.gce.create_disk(zone, disk_name, size_gb)
-        status = ""
-        for _ in range(30):
+        from dstack_tpu.utils.retry import (
+            Deadline,
+            DeadlineExceeded,
+            wait_for_async,
+        )
+
+        async def _ready():
             disk = await self.gce.get_disk(zone, disk_name)
             status = disk.get("status", "")
-            if status == "READY":
-                break
             if status == "FAILED":
                 raise ComputeError(f"disk {disk_name} entered FAILED state")
-            await asyncio.sleep(2)
-        if status != "READY":
-            raise ComputeError(
-                f"disk {disk_name} not READY after 60s (status {status!r})"
+            return status if status == "READY" else None
+
+        try:
+            await wait_for_async(
+                _ready,
+                site="gcp.disk_ready",
+                interval=2.0,
+                deadline=Deadline(60.0),
             )
+        except DeadlineExceeded:
+            raise ComputeError(
+                f"disk {disk_name} not READY after 60s"
+            ) from None
         return VolumeProvisioningData(
             backend=BackendType.GCP,
             volume_id=disk_name,

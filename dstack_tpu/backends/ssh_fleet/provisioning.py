@@ -83,15 +83,32 @@ async def adopt_host(
             f"shim install failed on {rci.host}: {out[-400:]}"
         )
     # wait for the host-info handshake file written in --service mode
-    for _ in range(30):
+    from dstack_tpu.utils.retry import (
+        Deadline,
+        DeadlineExceeded,
+        wait_for_async,
+    )
+
+    async def _handshake():
         rc, out = await run(rci, "cat /root/.dtpu/host_info.json 2>/dev/null")
         if rc == 0 and out.strip():
             try:
                 return agent_schemas.HostInfo.model_validate(json.loads(out))
             except (json.JSONDecodeError, ValueError):
                 pass
-        await asyncio.sleep(2)
-    raise ProvisioningError(f"no host-info handshake from {rci.host}")
+        return None
+
+    try:
+        return await wait_for_async(
+            _handshake,
+            site="ssh_fleet.host_info",
+            interval=2.0,
+            deadline=Deadline(60.0),
+        )
+    except DeadlineExceeded:
+        raise ProvisioningError(
+            f"no host-info handshake from {rci.host}"
+        ) from None
 
 
 async def remove_host(

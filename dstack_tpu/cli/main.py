@@ -318,9 +318,14 @@ def attach(run_name, project, no_logs) -> None:
         if att.ide_url:
             console.print(f"IDE: [link]{att.ide_url}[/link]")
         if no_logs:
+            from dstack_tpu.utils.retry import wait_for_sync
+
             console.print("Attached. Ctrl-C to detach.")
-            while att.alive():
-                time.sleep(2)
+            wait_for_sync(
+                lambda: (None if att.alive() else True),
+                site="cli.attach_keepalive",
+                interval=2.0,
+            )
             console.print("[red]Tunnel died[/red]")
         else:
             _stream_run(client, run_name)

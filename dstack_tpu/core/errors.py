@@ -65,6 +65,19 @@ class BackendError(ServerError):
     pass
 
 
+class BackendRequestError(BackendError):
+    """A cloud API call answered >= 400. Carries the HTTP ``status``
+    and any ``Retry-After`` hint so the retry layer
+    (:mod:`dstack_tpu.utils.retry`) can classify 429/5xx as transient
+    and honor the server's pacing without string-matching messages."""
+
+    def __init__(self, message: str, status: int = 0,
+                 retry_after=None):
+        super().__init__(message)
+        self.status = int(status)
+        self.retry_after = retry_after
+
+
 class BackendAuthError(BackendError):
     pass
 

@@ -69,21 +69,36 @@ class SSHTunnel:
             cmd, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE
         )
         # wait until the first forwarded port accepts
+        from dstack_tpu.utils.retry import (
+            Deadline,
+            DeadlineExceeded,
+            wait_for_async,
+        )
+
         local_ports = list(self.forwards)
-        deadline = asyncio.get_event_loop().time() + timeout
-        while asyncio.get_event_loop().time() < deadline:
+
+        async def _port_open():
             if self._proc.poll() is not None:
                 err = (self._proc.stderr.read() or b"").decode()[-500:]
                 raise SSHError(f"ssh tunnel exited: {err}")
             if not local_ports:
-                return
+                return True
             try:
                 with socket.create_connection(("127.0.0.1", local_ports[0]), 0.5):
-                    return
+                    return True
             except OSError:
-                await asyncio.sleep(0.2)
-        self.close()
-        raise SSHError(f"ssh tunnel to {self.host} timed out")
+                return None
+
+        try:
+            await wait_for_async(
+                _port_open,
+                site="ssh.tunnel_open",
+                interval=0.2,
+                deadline=Deadline(timeout),
+            )
+        except DeadlineExceeded:
+            self.close()
+            raise SSHError(f"ssh tunnel to {self.host} timed out") from None
 
     def close(self) -> None:
         if self._proc is not None and self._proc.poll() is None:

@@ -1,0 +1,81 @@
+"""Registry of named injection points.
+
+Static on purpose: ``python -m dstack_tpu.faults`` must list every
+point and validate a plan OFFLINE — without importing aiohttp, jax, or
+the server — so the catalog cannot be populated by side effects of
+importing the instrumented modules. A tier-1 test greps the source
+tree for ``faults.fire/afire/mutate`` literals and fails when an
+instrumented point is missing here (or a cataloged point has no call
+site), so the two cannot drift.
+
+Context keys listed per point are what the call site passes — a plan
+rule's ``ctx`` may match on any subset of them.
+"""
+
+#: point name -> (description, context keys)
+POINTS: dict = {
+    "gcp.api.request": (
+        "GCP TPU/GCE REST call (backends/gcp/api.py Transport.request); "
+        "fires before the HTTP request, mutate corrupts the parsed "
+        "response",
+        ("method", "url"),
+    ),
+    "agent.request": (
+        "any shim/runner agent HTTP call "
+        "(server/services/agent_client.py); raising 'connect', "
+        "'oserror', 'timeout', or aiohttp.ClientConnectionError "
+        "surfaces as AgentNotReady (the unreachable-agent path)",
+        ("method", "path"),
+    ),
+    "agent.pull": (
+        "the runner /api/pull poll specifically (log/state pull during "
+        "RUNNING); same error mapping as agent.request",
+        ("method", "path"),
+    ),
+    "agent.shim.healthcheck": (
+        "the shim /api/healthcheck; mutate corrupts the raw response "
+        "dict BEFORE schema validation — e.g. replace "
+        "{'interruption_notice': ...} to simulate a spot preemption "
+        "notice",
+        ("method", "path"),
+    ),
+    "agent.tunnel.open": (
+        "SSH tunnel establishment to an instance "
+        "(agent_client.TunnelPool)",
+        ("host", "port"),
+    ),
+    "routing.probe": (
+        "replica /health probe (routing/pool.probe_replica); raise "
+        "'connect'/'timeout' to fail the probe through the normal "
+        "breaker accounting",
+        ("replica",),
+    ),
+    "routing.forward": (
+        "one forwarding attempt to a replica "
+        "(routing/forward.forward_with_failover); raise "
+        "'connect'/'oserror' to kill the attempt before the response "
+        "streams (failover path)",
+        ("replica", "attempt"),
+    ),
+    "serve.engine.step": (
+        "one decode step of the inference engine (serve/engine.py); "
+        "runs on the worker thread — sync actions only",
+        (),
+    ),
+    "db.commit": (
+        "a control-plane DB write commit (server/db.py execute/"
+        "transaction); nth-call targeting provokes mid-transition "
+        "reconciler crashes",
+        ("sql",),
+    ),
+    "background.tick": (
+        "one tick of a background reconciliation loop "
+        "(server/background/scheduler.py); ctx task = loop name, e.g. "
+        "process_runs",
+        ("task",),
+    ),
+    "logs.write": (
+        "job log persistence (server/services/logs file storage)",
+        ("run_name",),
+    ),
+}

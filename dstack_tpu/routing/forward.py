@@ -17,6 +17,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from dstack_tpu import faults
 from dstack_tpu.routing.metrics import get_router_registry
 from dstack_tpu.routing.pool import ReplicaPool
 from dstack_tpu.utils.logging import get_logger
@@ -109,6 +110,10 @@ async def forward_with_failover(
         pool.acquire(entry)
         try:
             try:
+                await faults.afire(
+                    "routing.forward",
+                    replica=entry.replica_id, attempt=attempts,
+                )
                 upstream_ctx = session.request(
                     request.method, url, data=body, headers=req_headers
                 )

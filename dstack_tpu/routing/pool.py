@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from enum import Enum
 from typing import Dict, Iterable, Optional, Tuple
 
+from dstack_tpu import faults
 from dstack_tpu.routing.metrics import get_router_registry
 from dstack_tpu.utils.logging import get_logger
 
@@ -374,6 +375,7 @@ class ReplicaPool:
         url = f"http://{entry.host}:{entry.port}/health"
         t0 = time.perf_counter()
         try:
+            await faults.afire("routing.probe", replica=entry.replica_id)
             async with session.get(
                 url, timeout=aiohttp.ClientTimeout(total=self.config.probe_timeout)
             ) as resp:

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dstack_tpu import faults
 from dstack_tpu.models import llama
 from dstack_tpu.models.llama import (
     LlamaConfig,
@@ -2281,6 +2282,10 @@ class InferenceEngine:
         Wraps the dispatch in the step-latency/TPOT/throughput
         histograms — recorded here, at the engine, so the HTTP server
         and the offline bench export identical numbers."""
+        # chaos hook (no-op call when no plan is installed): provokes
+        # mid-decode engine death; the scheduler loop must fail only
+        # the inflight requests and keep serving
+        faults.fire("serve.engine.step")
         t0 = time.perf_counter()
         out = self._step_dispatch()
         if out:

@@ -10,6 +10,7 @@ import asyncio
 import random
 from typing import Awaitable, Callable, Optional
 
+from dstack_tpu import faults
 from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("server.background")
@@ -35,6 +36,7 @@ class BackgroundScheduler:
         await asyncio.sleep(random.uniform(0, min(interval, 1.0)))
         while not self._stopped.is_set():
             try:
+                await faults.afire("background.tick", task=name)
                 await fn()
             except asyncio.CancelledError:
                 raise

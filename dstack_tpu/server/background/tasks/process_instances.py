@@ -136,6 +136,7 @@ async def _provision(db: Database, row: dict) -> None:
                 placement_group_name=placement_group_name,
             ),
         )
+    # dtpu: noqa[DTPU006] failure logged + persisted via _provision_failed
     except Exception as e:
         await _provision_failed(db, row, e, what=f"instance {row['name']} provisioning")
         return
@@ -166,6 +167,7 @@ async def _adopt_remote(db: Database, row: dict, rci_raw: dict) -> None:
     rci = RemoteConnectionInfo.model_validate(rci_raw)
     try:
         info = await ssh_prov.adopt_host(rci, ssh_run=_SSH_RUN_OVERRIDE)
+    # dtpu: noqa[DTPU006] failure logged + persisted via _provision_failed
     except Exception as e:
         await _provision_failed(db, row, e, what=f"ssh-fleet adoption of {rci.host}")
         return

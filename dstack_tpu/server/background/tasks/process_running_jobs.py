@@ -218,8 +218,13 @@ async def _interruption_notice(db: Database, job_row: dict) -> bool:
             jpd, db=db, project_id=job_row["project_id"]
         ) as shim:
             hc = await shim.healthcheck()
-    except Exception:
-        return False  # shim gone too: fall through to the wait budget
+    except Exception as e:
+        # shim gone too: fall through to the wait budget
+        logger.debug(
+            "job %s: interruption probe of the shim failed: %r",
+            job_row["id"], e,
+        )
+        return False
     notice = getattr(hc, "interruption_notice", None)
     if not notice:
         return False
@@ -316,8 +321,13 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
             _spec = _RunSpec.model_validate(loads(run_row_for_keys["run_spec"]))
             if _spec.ssh_key_pub:
                 authorized_keys.append(_spec.ssh_key_pub.strip())
-        except Exception:
-            pass
+        except Exception as e:
+            # job still starts; `dtpu attach` to it won't authenticate
+            logger.warning(
+                "job %s: run_spec unreadable while collecting ssh keys "
+                "(attach will not work): %r",
+                job_row["id"], e,
+            )
     if job_spec.ssh_key is not None and job_spec.ssh_key.public:
         authorized_keys.append(job_spec.ssh_key.public.strip())
     # container mounts: instance paths bind directly; named volumes bind
@@ -642,8 +652,12 @@ async def _get_repo_creds(
         if creds.get(key):
             try:
                 creds[key] = decrypt(creds[key])
-            except Exception:
-                pass  # stored unencrypted (pre-encryption rows)
+            except Exception as e:
+                # stored unencrypted (pre-encryption rows): pass through
+                logger.debug(
+                    "repo %s: %s not decryptable (pre-encryption row?): %r",
+                    repo_id, key, e,
+                )
     return creds
 
 
@@ -822,7 +836,12 @@ async def _check_job_policies(
 
     try:
         run_spec = RunSpec.model_validate(loads(run_row["run_spec"]))
-    except Exception:
+    except Exception as e:
+        logger.warning(
+            "run %s: run_spec unreadable; inactivity/utilization "
+            "policies not enforced: %r",
+            run_row["run_name"], e,
+        )
         return {}
     conf = run_spec.configuration
 

@@ -118,6 +118,7 @@ async def _process_job(db: Database, job_id: str) -> None:
             "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
         )
         return
+    # dtpu: noqa[DTPU006] failure logged + persisted as job state via _fail
     except Exception as e:
         await _fail(
             db, job_row, JobTerminationReason.TERMINATED_BY_SERVER, str(e)[:300]
@@ -284,7 +285,11 @@ async def _attach_volumes_to_reused(
         compute = await backends_service.get_project_backend(
             db, project_row, BackendType(jpd["backend"])
         )
-    except Exception:
+    except Exception as e:
+        logger.warning(
+            "instance %s: backend %s unavailable for volume attach: %r",
+            inst_row["name"], jpd.get("backend"), e,
+        )
         return False
     if not isinstance(compute, ComputeWithVolumeSupport):
         return False

@@ -24,6 +24,7 @@ from contextlib import asynccontextmanager
 from pathlib import Path
 from typing import Any, Iterable, Optional, Sequence
 
+from dstack_tpu import faults
 from dstack_tpu.server import migrations
 from dstack_tpu.utils.logging import get_logger
 
@@ -117,6 +118,8 @@ class Database:
     # -- query helpers (auto-commit per statement outside transactions) --
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        await faults.afire("db.commit", sql=sql)
+
         def _exec():
             assert self._conn is not None
             cur = self._conn.execute(sql, params)
@@ -160,6 +163,8 @@ class Database:
             await self._run(_begin)
             try:
                 yield self
+                await faults.afire("db.commit", sql="<transaction>")
+
                 def _commit():
                     assert self._conn is not None
                     self._conn.commit()

@@ -219,6 +219,11 @@ class PostgresDatabase:
     # -- query interface (qmark SQL, translated) --
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
+        from dstack_tpu import faults
+
+        # same chaos point as the sqlite engine (server/db.py): the
+        # DTPU_TEST_DB=pgwire suite re-run injects identically
+        await faults.afire("db.commit", sql=sql)
         async with self._conn() as conn:
             status = await conn.execute(qmark_to_dollar(sql), *params)
             try:  # e.g. "UPDATE 3" / "INSERT 0 1"
@@ -242,12 +247,15 @@ class PostgresDatabase:
 
     @asynccontextmanager
     async def transaction(self):
+        from dstack_tpu import faults
+
         conn = await self._pool.acquire()
         tx = conn.transaction()
         await tx.start()
         token = _tx_conn.set(conn)
         try:
             yield self
+            await faults.afire("db.commit", sql="<transaction>")
             await tx.commit()
         except BaseException:
             await tx.rollback()

@@ -13,6 +13,7 @@ from typing import Optional
 
 import aiohttp
 
+from dstack_tpu import faults
 from dstack_tpu.agent import schemas
 from dstack_tpu.core.errors import AgentError, AgentNotReady
 from dstack_tpu.core.models.runs import JobProvisioningData
@@ -31,8 +32,12 @@ class _HTTPBase:
     async def _request(
         self, method: str, path: str, json_body=None, data=None, params=None,
         timeout: float = 20.0, raw: bool = False,
+        fault_point: str = "agent.request",
     ):
+        # inside the try: an injected ClientConnectionError/timeout maps
+        # to AgentNotReady exactly like a real unreachable agent
         try:
+            await faults.afire(fault_point, method=method, path=path)
             async with aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=timeout)
             ) as session:
@@ -51,14 +56,29 @@ class _HTTPBase:
                     return await (resp.text() if raw else resp.json())
         except aiohttp.ClientConnectionError as e:
             raise AgentNotReady(f"{self.base}{path}: {e}") from e
-        except asyncio.TimeoutError as e:
+        except (asyncio.TimeoutError, TimeoutError) as e:
             raise AgentNotReady(f"{self.base}{path}: timeout") from e
+        except OSError as e:
+            # raw socket-level failures (tunnel reset, DNS, refused
+            # conn surfacing outside aiohttp's wrapper) are the SAME
+            # "agent unreachable" condition — before this mapping they
+            # escaped as OSError, crashed the reconciler tick, and the
+            # job never entered the unreachable/interruption path
+            # (found by the chaos suite injecting connect errors on
+            # agent.pull)
+            raise AgentNotReady(f"{self.base}{path}: {e}") from e
 
 
 class ShimClient(_HTTPBase):
     async def healthcheck(self) -> schemas.HealthcheckResponse:
+        # mutate BEFORE validation so a chaos plan can graft fields the
+        # shim would report under real failures (interruption_notice)
+        data = await self._request(
+            "GET", "/api/healthcheck", timeout=5,
+            fault_point="agent.shim.healthcheck",
+        )
         return schemas.HealthcheckResponse.model_validate(
-            await self._request("GET", "/api/healthcheck", timeout=5)
+            faults.mutate("agent.shim.healthcheck", data)
         )
 
     async def submit_task(self, req: schemas.TaskSubmitRequest) -> schemas.TaskInfo:
@@ -116,7 +136,8 @@ class RunnerClient(_HTTPBase):
     async def pull(self, timestamp: float) -> schemas.PullResponse:
         return schemas.PullResponse.model_validate(
             await self._request(
-                "GET", "/api/pull", params={"timestamp": str(timestamp)}
+                "GET", "/api/pull", params={"timestamp": str(timestamp)},
+                fault_point="agent.pull",
             )
         )
 
@@ -219,6 +240,10 @@ class TunnelPool:
                     open_tunnel_to_params,
                 )
 
+                await faults.afire(
+                    "agent.tunnel.open",
+                    host=params.hostname, port=remote_port,
+                )
                 opener = self._opener or open_tunnel_to_params
                 tunnel, ports = await opener(
                     params, [remote_port],

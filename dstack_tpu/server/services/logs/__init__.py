@@ -11,6 +11,7 @@ from datetime import datetime, timezone
 from pathlib import Path
 from typing import Optional, Protocol
 
+from dstack_tpu import faults
 from dstack_tpu.core.models.logs import JobSubmissionLogs, LogEvent
 from dstack_tpu.server import settings
 
@@ -59,6 +60,7 @@ class FileLogStorage:
     ) -> None:
         if not events:
             return
+        faults.fire("logs.write", run_name=run_name)
         path = self._path(project_name, run_name, job_name, diagnostics)
         path.parent.mkdir(parents=True, exist_ok=True)
         with path.open("a") as f:

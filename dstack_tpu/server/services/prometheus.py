@@ -66,6 +66,11 @@ async def render_metrics(db: Database) -> str:
 
     get_pool_registry().update_state_gauge()
     w.raw(get_router_registry().render())
+    # unified retry layer (dtpu_retry_attempts_total{site} etc.): every
+    # migrated backoff site in this process reports here
+    from dstack_tpu.utils.retry import get_retry_registry
+
+    w.raw(get_retry_registry().render())
     return w.render()
 
 

@@ -1,0 +1,28 @@
+"""Chaos-suite fixtures: fault plans install per-test and ALWAYS clear.
+
+A leaked plan would inject faults into unrelated tests collected after
+the chaos suite — the autouse guard makes that impossible.
+"""
+
+import pytest
+
+from dstack_tpu import faults
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def fault_plan():
+    """Install a plan for one test: ``plan = fault_plan({...})``; the
+    compiled plan's rule counters are inspectable; cleanup is
+    automatic (autouse guard)."""
+
+    def _install(data):
+        return faults.install_plan(data)
+
+    return _install

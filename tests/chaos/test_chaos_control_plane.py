@@ -1,0 +1,298 @@
+"""Control-plane invariants under injected faults, driven through the
+REAL in-process local-backend stack (REST submit → reconcilers → local
+shim subprocess → runner) and through reconciler-level harnesses.
+
+Invariants pinned here:
+
+- spot preemption surfaces as INTERRUPTED **immediately** (the shim's
+  interruption notice short-circuits the 120s unreachable budget) and
+  a retry policy covering `interruption` resubmits the job;
+- a failed job retries per its retry policy and the retried submission
+  completes the run;
+- a reconciler crashed mid-transition (injected `db.commit` fault)
+  resumes idempotently on the next tick — the run converges to the
+  same terminal state, no wedge, no duplicate terminal events.
+"""
+
+import asyncio
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu import faults
+from dstack_tpu.core.models.runs import JobStatus, RunStatus
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.background.tasks.process_runs import process_runs
+from dstack_tpu.server.services import runs as runs_service
+from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
+from dstack_tpu.server.testing.common import (
+    create_test_db,
+    create_test_project,
+    create_test_user,
+    make_run_spec,
+)
+
+
+def _auth(token: str) -> dict:
+    return {"Authorization": f"Bearer {token}"}
+
+
+async def _wait_run(client, token, run_name, targets, timeout=150.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    run = None
+    while asyncio.get_event_loop().time() < deadline:
+        r = await client.post(
+            "/api/project/main/runs/get",
+            headers=_auth(token),
+            json={"run_name": run_name},
+        )
+        run = await r.json()
+        if run.get("status") in targets:
+            return run
+        await asyncio.sleep(0.5)
+    raise TimeoutError(f"run {run_name} stuck in {run and run.get('status')}")
+
+
+async def _local_stack(tmp_path):
+    set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
+    app = await create_app(
+        database_url="sqlite://:memory:",
+        admin_token="chaos-token",
+        with_background=True,
+        local_backend=True,
+    )
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, app
+
+
+class TestPreemptionSurfacesImmediately:
+    async def test_injected_preemption_interrupts_and_retries(
+        self, tmp_path, fault_plan
+    ):
+        """Full stack: a RUNNING job loses its runner (injected connect
+        errors on agent.pull) while the shim's healthcheck carries an
+        injected interruption notice → the job terminates as
+        INTERRUPTED_BY_NO_CAPACITY on the FIRST failed poll (no 120s
+        unreachable budget), the retry policy covering `interruption`
+        resubmits it, and the retried submission completes the run."""
+        client, app = await _local_stack(tmp_path)
+        db = app["state"]["db"]
+        try:
+            body = {
+                "run_spec": {
+                    "run_name": "chaos-preempt",
+                    "configuration": {
+                        "type": "task",
+                        # long enough to be RUNNING when the fault
+                        # lands; short enough that the retried
+                        # submission finishes fast
+                        "commands": ["echo started", "sleep 4"],
+                    },
+                    "profile": {
+                        "name": "chaos",
+                        "retry": {"on_events": ["interruption"]},
+                    },
+                    "ssh_key_pub": "ssh-ed25519 AAAA test",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply",
+                headers=_auth("chaos-token"), json=body,
+            )
+            assert r.status == 200, await r.text()
+            await _wait_run(client, "chaos-token", "chaos-preempt",
+                            ("running",))
+            # the "preemption": runner RPCs die, the shim (still up, as
+            # on a real spot VM during the grace window) reports a
+            # notice. Bounded budgets so the RETRIED job self-heals
+            # without test intervention.
+            fault_plan({"rules": [
+                {"point": "agent.pull", "action": "raise",
+                 "error": "connect", "times": 2},
+                {"point": "agent.shim.healthcheck", "action": "corrupt",
+                 "replace": {"interruption_notice":
+                             "injected spot preemption"}, "times": 2},
+            ]})
+            # INTERRUPTED immediately: the first failed pull probes the
+            # shim and classifies — well inside one reconciler cadence,
+            # nothing close to the 120s unreachable budget
+            deadline = asyncio.get_event_loop().time() + 30.0
+            interrupted = None
+            while asyncio.get_event_loop().time() < deadline:
+                rows = await db.fetchall(
+                    "SELECT * FROM jobs WHERE run_id IN "
+                    "(SELECT id FROM runs WHERE run_name = ?) "
+                    "ORDER BY submission_num",
+                    ("chaos-preempt",),
+                )
+                interrupted = next(
+                    (j for j in rows if j["termination_reason"]
+                     == "interrupted_by_no_capacity"),
+                    None,
+                )
+                if interrupted is not None:
+                    break
+                await asyncio.sleep(0.3)
+            assert interrupted is not None, (
+                "preemption was not classified as INTERRUPTED"
+            )
+            # ... and the retry policy resubmits: a second submission
+            # appears and the run completes
+            run = await _wait_run(
+                client, "chaos-token", "chaos-preempt",
+                ("done", "failed", "terminated"),
+            )
+            assert run["status"] == "done", run
+            rows = await db.fetchall(
+                "SELECT submission_num, termination_reason FROM jobs "
+                "WHERE run_id = ? ORDER BY submission_num", (run["id"],),
+            )
+            assert len(rows) >= 2, rows  # original + retried submission
+            assert rows[0]["termination_reason"] == \
+                "interrupted_by_no_capacity"
+        finally:
+            faults.clear()
+            await client.close()
+
+
+class TestFailedJobRetriesPerPolicy:
+    async def test_crash_then_retry_completes_the_run(self, tmp_path):
+        """A job whose first submission exits non-zero retries per its
+        `error` retry policy; the second submission succeeds and the
+        run finishes DONE (not FAILED)."""
+        client, app = await _local_stack(tmp_path)
+        db = app["state"]["db"]
+        flag = tmp_path / "second-attempt"
+        try:
+            body = {
+                "run_spec": {
+                    "run_name": "chaos-retry",
+                    "configuration": {
+                        "type": "task",
+                        "commands": [
+                            f"if [ -f {flag} ]; then echo retried-ok; "
+                            f"else touch {flag}; exit 1; fi"
+                        ],
+                    },
+                    "profile": {
+                        "name": "chaos",
+                        "retry": {"on_events": ["error"]},
+                    },
+                    "ssh_key_pub": "ssh-ed25519 AAAA test",
+                }
+            }
+            r = await client.post(
+                "/api/project/main/runs/apply",
+                headers=_auth("chaos-token"), json=body,
+            )
+            assert r.status == 200, await r.text()
+            run = await _wait_run(
+                client, "chaos-token", "chaos-retry",
+                ("done", "failed", "terminated"),
+            )
+            assert run["status"] == "done", run
+            rows = await db.fetchall(
+                "SELECT submission_num, status, termination_reason "
+                "FROM jobs WHERE run_id = ? ORDER BY submission_num",
+                (run["id"],),
+            )
+            assert len(rows) == 2, rows
+            assert rows[0]["termination_reason"] in (
+                "container_exited_with_error", "executor_error",
+            )
+            assert rows[1]["status"] == "done"
+        finally:
+            await client.close()
+
+
+TASK = {"type": "task", "commands": ["python train.py"],
+        "resources": {"tpu": "v5e-8"}}
+
+
+class TestReconcilerMidTransitionIdempotency:
+    async def test_db_fault_mid_transition_resumes_next_tick(
+        self, fault_plan
+    ):
+        """The run-status transition commits, then the run-event insert
+        dies (injected db.commit fault #2) — exactly a mid-transition
+        crash. The next tick must converge the run to its terminal
+        state with no wedge and exactly one terminal event."""
+        db = await create_test_db()
+        _, user_row = await create_test_user(db)
+        project_row = await create_test_project(db, user_row)
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK, "chaos-idem")
+        )
+        await db.execute(
+            "UPDATE jobs SET status = ? WHERE run_id = ?",
+            (JobStatus.DONE.value, run.id),
+        )
+        await db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?",
+            (RunStatus.RUNNING.value, run.id),
+        )
+        # tick 1: commit #1 = the RUNNING→TERMINATING status update
+        # (lands), commit #2 = the run_events insert (dies)
+        fault_plan({"rules": [
+            {"point": "db.commit", "action": "raise", "nth": 2},
+        ]})
+        await process_runs(db)  # must not raise: per-run errors are logged
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.TERMINATING.value
+        events = await db.fetchall(
+            "SELECT event FROM run_events WHERE run_id = ?", (run.id,)
+        )
+        assert "terminating" not in [e["event"] for e in events]
+        # tick 2 (fault budget spent): idempotent resume to terminal
+        faults.clear()
+        await process_runs(db)
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.DONE.value
+        events = [
+            e["event"] for e in await db.fetchall(
+                "SELECT event FROM run_events WHERE run_id = ?", (run.id,)
+            )
+        ]
+        assert events.count("done") == 1
+        # tick 3 is a no-op: terminal runs are left alone
+        await process_runs(db)
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.DONE.value
+
+    async def test_db_fault_before_transition_is_a_clean_no_op(
+        self, fault_plan
+    ):
+        """Fault on commit #1 (the status update itself): nothing
+        committed, the next tick replays the whole transition."""
+        db = await create_test_db()
+        _, user_row = await create_test_user(db)
+        project_row = await create_test_project(db, user_row)
+        run = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK, "chaos-idem2")
+        )
+        await db.execute(
+            "UPDATE jobs SET status = ? WHERE run_id = ?",
+            (JobStatus.DONE.value, run.id),
+        )
+        await db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?",
+            (RunStatus.RUNNING.value, run.id),
+        )
+        fault_plan({"rules": [
+            {"point": "db.commit", "action": "raise", "nth": 1},
+        ]})
+        await process_runs(db)
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.RUNNING.value  # untouched
+        faults.clear()
+        await process_runs(db)  # replays: TERMINATING + event
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.TERMINATING.value
+        events = [
+            e["event"] for e in await db.fetchall(
+                "SELECT event FROM run_events WHERE run_id = ?", (run.id,)
+            )
+        ]
+        assert events.count("terminating") == 1

@@ -1,0 +1,214 @@
+"""Routing-plane invariants under injected faults.
+
+Replica death is provoked by the fault layer (point
+``routing.forward`` / ``routing.probe``) instead of actually killing
+servers — same failure surface the forwarder sees
+(connect error before the response streams), fully deterministic.
+"""
+
+import aiohttp
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.routing import (
+    PoolConfig,
+    ReplicaPool,
+    ReplicaState,
+    get_router_registry,
+)
+from dstack_tpu.routing.forward import forward_with_failover
+
+
+def _replica_app(name: str, hits: list) -> web.Application:
+    app = web.Application()
+
+    async def ok(request):
+        hits.append(name)
+        return web.Response(text=f"{name}-ok")
+
+    app.router.add_route("*", "/{path:.*}", ok)
+    return app
+
+
+async def _proxy_for(pool: ReplicaPool):
+    session = aiohttp.ClientSession()
+
+    async def handler(request):
+        return await forward_with_failover(
+            request, pool, session, request.match_info["path"]
+        )
+
+    app = web.Application()
+    app.router.add_route("*", "/{path:.*}", handler)
+
+    async def _close(_):
+        await session.close()
+
+    app.on_cleanup.append(_close)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestReplicaKilledBeforeStream:
+    async def test_failover_yields_zero_client_5xx(self, fault_plan):
+        """Invariant: a replica dying before its response streams never
+        surfaces as a client 5xx — the forwarder retries the other
+        replica. Injected: every attempt against replica "a" raises a
+        connect error."""
+        hits_a, hits_b = [], []
+        ra = TestServer(_replica_app("a", hits_a))
+        rb = TestServer(_replica_app("b", hits_b))
+        await ra.start_server()
+        await rb.start_server()
+        pool = ReplicaPool("p", "svc", PoolConfig(startup_grace=0.0))
+        pool.sync([
+            ("a", ra.host, ra.port), ("b", rb.host, rb.port),
+        ])
+        # both probed READY: the round-robin tie-break keeps offering
+        # "a" (a STARTING replica would be deprioritized after failure
+        # one and the breaker would never see its threshold)
+        pool.get("a").state = ReplicaState.READY
+        pool.get("b").state = ReplicaState.READY
+        fault_plan({"rules": [
+            {"point": "routing.forward", "ctx": {"replica": "a"},
+             "action": "raise", "error": "connect"},
+        ]})
+        failovers = get_router_registry().family(
+            "dtpu_router_failovers_total"
+        )
+        before = failovers.value()
+        client = await _proxy_for(pool)
+        try:
+            statuses = []
+            for _ in range(8):
+                r = await client.get("/ok")
+                statuses.append(r.status)
+            assert statuses == [200] * 8  # zero client 5xx
+            assert not hits_a and len(hits_b) == 8
+            # the injected deaths burned a's failure budget: breaker open
+            assert pool.get("a").state == ReplicaState.DEAD
+            assert failovers.value() > before
+        finally:
+            await client.close()
+            await ra.close()
+            await rb.close()
+
+    async def test_nth_scoped_fault_hits_exactly_one_request(self, fault_plan):
+        """Deterministic single-shot: only the first attempt dies; the
+        request still answers 200 via failover and the replica
+        recovers (no breaker)."""
+        hits_a, hits_b = [], []
+        ra = TestServer(_replica_app("a", hits_a))
+        rb = TestServer(_replica_app("b", hits_b))
+        await ra.start_server()
+        await rb.start_server()
+        pool = ReplicaPool("p", "svc", PoolConfig(startup_grace=0.0))
+        pool.sync([("a", ra.host, ra.port), ("b", rb.host, rb.port)])
+        fault_plan({"rules": [
+            {"point": "routing.forward", "action": "raise",
+             "error": "connect", "nth": 1},
+        ]})
+        client = await _proxy_for(pool)
+        try:
+            for _ in range(4):
+                r = await client.get("/ok")
+                assert r.status == 200
+            assert len(hits_a) + len(hits_b) == 4
+            assert pool.get("a").state != ReplicaState.DEAD
+            assert pool.get("b").state != ReplicaState.DEAD
+        finally:
+            await client.close()
+            await ra.close()
+            await rb.close()
+
+
+class TestPoolExhausted:
+    async def test_503_with_retry_after(self, fault_plan):
+        """Invariant: every replica unroutable → 503 + Retry-After,
+        never a raw 502. Injected: all forward attempts die."""
+        hits = []
+        ra = TestServer(_replica_app("a", hits))
+        await ra.start_server()
+        pool = ReplicaPool(
+            "p", "svc",
+            PoolConfig(startup_grace=0.0, breaker_base_backoff=60.0),
+        )
+        pool.sync([("a", ra.host, ra.port)])
+        fault_plan({"rules": [
+            {"point": "routing.forward", "action": "raise",
+             "error": "connect"},
+        ]})
+        exhausted = get_router_registry().family(
+            "dtpu_router_exhausted_total"
+        )
+        before = exhausted.value()
+        client = await _proxy_for(pool)
+        try:
+            statuses = set()
+            for _ in range(4):  # burn the failure budget, open breaker
+                r = await client.get("/ok")
+                statuses.add(r.status)
+                assert r.status == 503
+                assert int(r.headers["Retry-After"]) >= 1
+            assert statuses == {503}
+            assert not hits  # nothing ever reached the replica
+            assert exhausted.value() > before
+        finally:
+            await client.close()
+            await ra.close()
+
+
+class TestProbeFaults:
+    async def test_injected_probe_failures_open_the_breaker(self, fault_plan):
+        """Probe-path faults flow through the normal breaker
+        accounting: 3 injected probe failures kill the replica and the
+        probe-failure counter advances — no silent swallowing."""
+        hits = []
+        ra = TestServer(_replica_app("a", hits))
+        await ra.start_server()
+        pool = ReplicaPool(
+            "p", "svc",
+            PoolConfig(startup_grace=0.0, breaker_base_backoff=60.0),
+        )
+        pool.sync([("a", ra.host, ra.port)])
+        plan = fault_plan({"rules": [
+            {"point": "routing.probe", "action": "raise",
+             "error": "connect", "times": 3},
+        ]})
+        failures = get_router_registry().family(
+            "dtpu_router_probe_failures_total"
+        )
+        before = failures.value()
+        async with aiohttp.ClientSession() as session:
+            for _ in range(3):
+                assert not await pool.probe_replica(session, pool.get("a"))
+        assert pool.get("a").state == ReplicaState.DEAD
+        assert failures.value() == before + 3
+        assert plan.rules[0].fired == 3
+        await ra.close()
+
+    async def test_probe_recovers_after_fault_budget(self, fault_plan):
+        """Once the injected fault budget is spent the replica probes
+        healthy again — a half-open trial closes the breaker."""
+        hits = []
+        ra = TestServer(_replica_app("a", hits))
+        await ra.start_server()
+        pool = ReplicaPool(
+            "p", "svc",
+            PoolConfig(startup_grace=0.0, breaker_base_backoff=0.0),
+        )
+        pool.sync([("a", ra.host, ra.port)])
+        fault_plan({"rules": [
+            {"point": "routing.probe", "action": "raise",
+             "error": "connect", "times": 3},
+        ]})
+        async with aiohttp.ClientSession() as session:
+            for _ in range(3):
+                await pool.probe_replica(session, pool.get("a"))
+            assert pool.get("a").state == ReplicaState.DEAD
+            # fault budget spent: next probe succeeds and revives it
+            assert await pool.probe_replica(session, pool.get("a"))
+        assert pool.get("a").state == ReplicaState.READY
+        await ra.close()

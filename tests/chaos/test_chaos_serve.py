@@ -1,0 +1,66 @@
+"""Serve-plane invariant: an engine-step fault fails only the inflight
+request(s); the scheduler loop survives and the server keeps serving.
+"""
+
+import jax
+
+from dstack_tpu import faults
+from dstack_tpu.models import llama
+from dstack_tpu.serve.engine import InferenceEngine
+from dstack_tpu.serve.openai_server import build_app
+from dstack_tpu.serve.tokenizer import ByteTokenizer
+
+
+async def _client():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    config = llama.LLAMA_TINY
+    params = llama.init_params(config, jax.random.key(0))
+    engine = InferenceEngine(config, params, max_batch=4, max_seq=128)
+    app = build_app(engine, ByteTokenizer(), "llama-tiny")
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+class TestEngineStepFault:
+    async def test_step_fault_fails_inflight_only_server_survives(
+        self, fault_plan
+    ):
+        """One injected engine-step crash: the inflight request answers
+        500 (not a hang, not a dead server); the NEXT request decodes
+        normally on the same engine."""
+        client = await _client()
+        try:
+            # warm request before the fault proves the path works
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "llama-tiny", "prompt": "ab", "max_tokens": 3},
+            )
+            assert r.status == 200
+            fault_plan({"rules": [
+                {"point": "serve.engine.step", "action": "raise", "nth": 1},
+            ]})
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "llama-tiny", "prompt": "ab", "max_tokens": 3},
+            )
+            assert r.status == 500
+            detail = (await r.json())["detail"]
+            assert "injected fault" in detail
+            # fault budget spent (nth=1): the engine must still serve
+            faults.clear()
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "llama-tiny", "prompt": "ab", "max_tokens": 3},
+            )
+            assert r.status == 200
+            d = await r.json()
+            assert d["usage"]["completion_tokens"] >= 1
+            # and /health still answers with a clean engine
+            r = await client.get("/health")
+            assert r.status == 200
+            h = await r.json()
+            assert h["inflight"] == 0
+        finally:
+            await client.close()

@@ -1,0 +1,316 @@
+"""The fault layer itself: plan semantics, determinism, the zero-cost
+disabled path, catalog/source coherence, and the offline CLI."""
+
+import asyncio
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from dstack_tpu import faults
+from dstack_tpu.faults.catalog import POINTS
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+class TestZeroCostDisabledPath:
+    def test_disabled_entry_points_are_the_module_noops(self):
+        """The acceptance contract: with no plan installed the
+        injection entry points ARE the no-op functions — no dict
+        lookups, no rule matching, nothing on any hot path."""
+        assert faults.fire is faults._noop_fire
+        assert faults.afire is faults._noop_afire
+        assert faults.mutate is faults._noop_mutate
+        assert not faults.active()
+        # and they behave as no-ops
+        assert faults.fire("serve.engine.step") is None
+        assert faults.mutate("gcp.api.request", {"a": 1}) == {"a": 1}
+
+    def test_install_swaps_and_clear_restores(self, fault_plan):
+        fault_plan({"rules": [{"point": "db.commit", "action": "delay",
+                               "seconds": 0.0}]})
+        assert faults.active()
+        assert faults.fire is not faults._noop_fire
+        faults.clear()
+        assert faults.fire is faults._noop_fire
+        assert faults.mutate is faults._noop_mutate
+
+    def test_import_does_not_pull_heavy_deps(self):
+        """Import-light contract: a bare `import dstack_tpu.faults`
+        must not drag in aiohttp/jax (agents and tools import it)."""
+        src = (
+            "import sys\n"
+            "import dstack_tpu.faults\n"
+            "bad = [m for m in ('aiohttp', 'jax') if m in sys.modules]\n"
+            "assert not bad, bad\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", src], cwd=REPO,
+            capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, r.stderr[-500:]
+
+
+class TestPlanSemantics:
+    def test_nth_fires_on_exactly_those_calls(self, fault_plan):
+        fault_plan({"rules": [
+            {"point": "db.commit", "action": "raise", "nth": [2, 4]},
+        ]})
+        outcomes = []
+        for _ in range(5):
+            try:
+                faults.fire("db.commit", sql="x")
+                outcomes.append("ok")
+            except faults.FaultInjected:
+                outcomes.append("boom")
+        assert outcomes == ["ok", "boom", "ok", "boom", "ok"]
+
+    def test_times_caps_total_firings(self, fault_plan):
+        fault_plan({"rules": [
+            {"point": "db.commit", "action": "raise", "times": 2},
+        ]})
+        boom = 0
+        for _ in range(6):
+            try:
+                faults.fire("db.commit")
+            except faults.FaultInjected:
+                boom += 1
+        assert boom == 2
+
+    def test_glob_and_ctx_matching(self, fault_plan):
+        fault_plan({"rules": [
+            {"point": "agent.*", "action": "raise",
+             "ctx": {"path": "/api/pull"}},
+        ]})
+        # wrong point family: no match
+        faults.fire("db.commit", path="/api/pull")
+        # right family, wrong ctx: no match
+        faults.fire("agent.request", path="/api/run")
+        # right family + ctx: fires
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("agent.pull", path="/api/pull")
+
+    def test_error_shorthands_and_dotted_paths(self, fault_plan):
+        plan = fault_plan({"rules": [
+            {"point": "routing.forward", "action": "raise",
+             "error": "connect", "nth": 1},
+            {"point": "routing.forward", "action": "raise",
+             "error": "http:429", "retry_after": 3, "nth": 2},
+            {"point": "routing.forward", "action": "raise",
+             "error": "dstack_tpu.core.errors.BackendError", "nth": 3},
+        ]})
+        with pytest.raises(ConnectionError):
+            faults.fire("routing.forward")
+        with pytest.raises(faults.InjectedHTTPError) as ei:
+            faults.fire("routing.forward")
+        assert ei.value.status == 429 and ei.value.retry_after == 3
+        from dstack_tpu.core.errors import BackendError
+
+        with pytest.raises(BackendError):
+            faults.fire("routing.forward")
+        assert [r.fired for r in plan.rules] == [1, 1, 1]
+
+    def test_corrupt_merges_replace_into_dicts(self, fault_plan):
+        fault_plan({"rules": [
+            {"point": "agent.shim.healthcheck", "action": "corrupt",
+             "replace": {"interruption_notice": "spot preemption"}},
+        ]})
+        out = faults.mutate("agent.shim.healthcheck", {"status": "ok"})
+        assert out == {"status": "ok",
+                       "interruption_notice": "spot preemption"}
+        # non-dict values collapse to the sentinel
+        assert faults.mutate("agent.shim.healthcheck", "text") == \
+            "__dtpu_corrupt__"
+
+    def test_corrupt_value_substitutes_wholesale(self, fault_plan):
+        fault_plan({"rules": [
+            {"point": "gcp.api.request", "action": "corrupt",
+             "value": {"state": "GARBAGE"}},
+        ]})
+        assert faults.mutate("gcp.api.request", {"state": "READY"}) == \
+            {"state": "GARBAGE"}
+
+    def test_delay_uses_asyncio_sleep_in_afire(self, fault_plan):
+        fault_plan({"rules": [
+            {"point": "background.tick", "action": "delay", "seconds": 0.01},
+        ]})
+
+        async def go():
+            loop = asyncio.get_running_loop()
+            t0 = loop.time()
+            await faults.afire("background.tick", task="x")
+            return loop.time() - t0
+
+        assert asyncio.run(go()) >= 0.009
+
+    def test_raise_in_afire(self, fault_plan):
+        fault_plan({"rules": [
+            {"point": "agent.pull", "action": "raise", "error": "timeout"},
+        ]})
+
+        async def go():
+            with pytest.raises(TimeoutError):
+                await faults.afire("agent.pull")
+
+        asyncio.run(go())
+
+
+class TestDeterminism:
+    def _schedule(self, seed: int, n: int = 40) -> list:
+        faults.install_plan({"seed": seed, "rules": [
+            {"point": "routing.probe", "action": "raise", "prob": 0.5},
+        ]})
+        out = []
+        for _ in range(n):
+            try:
+                faults.fire("routing.probe")
+                out.append(0)
+            except faults.FaultInjected:
+                out.append(1)
+        faults.clear()
+        return out
+
+    def test_same_seed_same_injection_schedule(self):
+        a = self._schedule(seed=11)
+        b = self._schedule(seed=11)
+        assert a == b
+        assert 0 < sum(a) < 40  # actually probabilistic, not all/none
+
+    def test_different_seed_different_schedule(self):
+        # 2^-40 collision odds: a failure here means the seed is dead
+        assert self._schedule(seed=11) != self._schedule(seed=12)
+
+    def test_rule_order_isolated_streams(self):
+        """Adding a rule must not perturb another rule's schedule:
+        each rule draws from its own (seed, index) stream."""
+        one = self._schedule(seed=7)
+        faults.install_plan({"seed": 7, "rules": [
+            {"point": "routing.probe", "action": "raise", "prob": 0.5},
+            {"point": "db.commit", "action": "raise", "prob": 0.9},
+        ]})
+        out = []
+        for _ in range(40):
+            try:
+                faults.fire("routing.probe")
+                out.append(0)
+            except faults.FaultInjected:
+                out.append(1)
+        faults.clear()
+        assert out == one
+
+
+class TestValidation:
+    def test_valid_plan_passes(self):
+        assert faults.validate_plan({"seed": 1, "rules": [
+            {"point": "db.commit", "action": "hang", "seconds": 1},
+        ]}) == []
+
+    def test_rejections(self):
+        for plan, frag in [
+            ([], "object"),
+            ({"rules": [{"action": "raise"}]}, "'point'"),
+            ({"rules": [{"point": "no.such.point"}]}, "matches no"),
+            ({"rules": [{"point": "db.commit", "action": "explode"}]},
+             "action"),
+            ({"rules": [{"point": "db.commit", "error": "bogus"}]},
+             "shorthand"),
+            ({"rules": [{"point": "db.commit", "nth": "x"}]}, "nth"),
+            ({"rules": [{"point": "db.commit", "prob": 2}]}, "prob"),
+            ({"rules": [{"point": "db.commit", "wat": 1}]}, "unknown keys"),
+        ]:
+            errors = faults.validate_plan(plan)
+            assert errors and any(frag in e for e in errors), (plan, errors)
+
+    def test_install_rejects_invalid(self):
+        with pytest.raises(ValueError):
+            faults.install_plan({"rules": [{"point": "no.such.point"}]})
+        assert not faults.active()
+
+
+class TestCatalogSourceCoherence:
+    # literal point names at instrumented call sites:
+    #   faults.fire("x") / afire / mutate, and the fault_point="x"
+    #   indirection in agent_client
+    _CALL_RE = re.compile(
+        r"""(?:faults\.(?:fire|afire|mutate)\(\s*|fault_point(?::\s*str)?\s*=\s*)["']([a-z0-9_.]+)["']"""
+    )
+
+    def _source_points(self) -> set:
+        found = set()
+        for f in (REPO / "dstack_tpu").rglob("*.py"):
+            if "faults" in f.parts:
+                continue  # the layer itself, not an instrumented site
+            found.update(self._CALL_RE.findall(f.read_text()))
+        return found
+
+    def test_every_source_point_is_cataloged(self):
+        unknown = self._source_points() - set(POINTS)
+        assert not unknown, f"uncataloged injection points: {sorted(unknown)}"
+
+    def test_every_cataloged_point_is_instrumented(self):
+        dead = set(POINTS) - self._source_points()
+        assert not dead, f"cataloged but never fired: {sorted(dead)}"
+
+
+class TestCLI:
+    def test_list_points_smoke(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "dstack_tpu.faults"],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, r.stderr[-500:]
+        for point in POINTS:
+            assert point in r.stdout
+
+    def test_validate_good_plan(self, tmp_path):
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps({"seed": 1, "rules": [
+            {"point": "agent.pull", "action": "raise", "error": "connect"},
+        ]}))
+        r = subprocess.run(
+            [sys.executable, "-m", "dstack_tpu.faults",
+             "--validate", str(plan)],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "OK: 1 rule(s)" in r.stdout
+
+    def test_validate_bad_plan_exits_nonzero(self):
+        r = subprocess.run(
+            [sys.executable, "-m", "dstack_tpu.faults", "--validate",
+             '{"rules": [{"point": "no.such.point"}]}'],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 1
+        assert "matches no registered" in r.stderr
+
+    def test_env_plan_installs_at_import(self):
+        src = (
+            "import dstack_tpu.faults as f\n"
+            "assert f.active()\n"
+            "try:\n"
+            "    f.fire('db.commit')\n"
+            "except f.FaultInjected:\n"
+            "    print('INJECTED')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", src], cwd=REPO,
+            capture_output=True, text=True, timeout=60,
+            env={**__import__("os").environ,
+                 "DTPU_FAULT_PLAN":
+                     '{"rules": [{"point": "db.commit"}]}'},
+        )
+        assert r.returncode == 0, r.stderr[-500:]
+        assert "INJECTED" in r.stdout
+
+    def test_env_plan_broken_fails_loudly(self):
+        r = subprocess.run(
+            [sys.executable, "-c", "import dstack_tpu.faults"],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+            env={**__import__("os").environ,
+                 "DTPU_FAULT_PLAN": '{"rules": [{"point": "bogus.x"}]}'},
+        )
+        assert r.returncode != 0  # silent fault-free chaos run = banned

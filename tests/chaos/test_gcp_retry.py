@@ -1,0 +1,135 @@
+"""GCP transport resilience: 429/5xx/connect errors retry with backoff
+and Retry-After respect; 4xx and auth errors never retry.
+
+Failures are injected at the ``gcp.api.request`` point (so no network
+is involved); successes come from a fake aiohttp session.
+"""
+
+import json
+
+import pytest
+
+from dstack_tpu import faults
+from dstack_tpu.backends.gcp import api as gcp_api
+from dstack_tpu.core.errors import BackendAuthError, BackendRequestError
+from dstack_tpu.utils.retry import RetryPolicy, get_retry_registry
+
+
+class _FakeResp:
+    def __init__(self, status=200, body=None, headers=None):
+        self.status = status
+        self._body = body if body is not None else {"ok": True}
+        self.headers = headers or {}
+
+    async def text(self):
+        return json.dumps(self._body)
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *a):
+        return False
+
+
+class _FakeSession:
+    def __init__(self, responses):
+        self._responses = list(responses)
+        self.calls = 0
+
+    def request(self, method, url, **kw):
+        self.calls += 1
+        return self._responses.pop(0)
+
+
+def _transport(responses) -> gcp_api.Transport:
+    t = gcp_api.Transport(credentials=object())
+    t._get_token = lambda: "fake-token"
+    session = _FakeSession(responses)
+    t._get_session = lambda: session
+    t._fake_session = session
+    return t
+
+
+def _attempts() -> float:
+    return get_retry_registry().family(
+        "dtpu_retry_attempts_total"
+    ).value("gcp.api")
+
+
+@pytest.fixture(autouse=True)
+def _fast_policy(monkeypatch):
+    monkeypatch.setattr(
+        gcp_api, "_RETRY_POLICY",
+        RetryPolicy(max_attempts=3, base_delay=0.001, max_delay=0.01,
+                    jitter=0.0),
+    )
+
+
+class TestGCPTransportRetry:
+    async def test_429_retries_and_succeeds(self, fault_plan):
+        t = _transport([_FakeResp(200, {"name": "op"})])
+        fault_plan({"rules": [
+            {"point": "gcp.api.request", "action": "raise",
+             "error": "http:429", "retry_after": 0, "times": 2},
+        ]})
+        before = _attempts()
+        out = await t.request("GET", "https://tpu.googleapis.com/v2/x")
+        assert out == {"name": "op"}
+        assert _attempts() == before + 2  # two injected 429s retried
+
+    async def test_connect_error_retries(self, fault_plan):
+        t = _transport([_FakeResp(200)])
+        fault_plan({"rules": [
+            {"point": "gcp.api.request", "action": "raise",
+             "error": "connect", "nth": 1},
+        ]})
+        out = await t.request("GET", "https://tpu.googleapis.com/v2/x")
+        assert out == {"ok": True}
+
+    async def test_real_5xx_response_retries_then_raises_typed(self):
+        t = _transport([
+            _FakeResp(503, {"err": 1}, headers={"Retry-After": "0"}),
+            _FakeResp(503, {"err": 2}, headers={"Retry-After": "0"}),
+            _FakeResp(503, {"err": 3}, headers={"Retry-After": "0"}),
+        ])
+        with pytest.raises(BackendRequestError) as ei:
+            await t.request("GET", "https://tpu.googleapis.com/v2/x")
+        assert ei.value.status == 503
+        assert t._fake_session.calls == 3  # attempts exhausted
+
+    async def test_4xx_never_retries(self):
+        t = _transport([_FakeResp(404, {"err": "gone"})])
+        with pytest.raises(BackendRequestError) as ei:
+            await t.request("GET", "https://tpu.googleapis.com/v2/x")
+        assert ei.value.status == 404
+        assert t._fake_session.calls == 1
+
+    async def test_auth_errors_never_retry(self):
+        t = gcp_api.Transport(credentials=object())
+
+        def _boom():
+            raise BackendAuthError("bad creds")
+
+        t._get_token = _boom
+        calls = {"n": 0}
+
+        class _CountingSession:
+            def request(self, *a, **kw):
+                calls["n"] += 1
+                return _FakeResp(200)
+
+        t._get_session = lambda: _CountingSession()
+        with pytest.raises(BackendAuthError):
+            await t.request("GET", "https://tpu.googleapis.com/v2/x")
+        assert calls["n"] == 0
+
+    async def test_corrupt_response_injection(self, fault_plan):
+        """The mutate hook garbles the parsed response — what a chaos
+        plan uses to simulate a malformed API answer."""
+        t = _transport([_FakeResp(200, {"state": "READY"})])
+        fault_plan({"rules": [
+            {"point": "gcp.api.request", "action": "corrupt",
+             "value": {"state": "GARBAGE"}},
+        ]})
+        out = await t.request("GET", "https://tpu.googleapis.com/v2/x")
+        assert out == {"state": "GARBAGE"}

@@ -1,0 +1,271 @@
+"""utils/retry: backoff determinism, deadlines, Retry-After, metrics."""
+
+import asyncio
+import random
+
+import pytest
+
+from dstack_tpu.core.errors import BackendRequestError
+from dstack_tpu.utils import retry as retry_mod
+from dstack_tpu.utils.retry import (
+    Deadline,
+    DeadlineExceeded,
+    RetryPolicy,
+    default_should_retry,
+    get_retry_registry,
+    retry_async,
+    retry_sync,
+    wait_for_async,
+    wait_for_sync,
+)
+
+
+def _attempts(site: str) -> float:
+    return get_retry_registry().family("dtpu_retry_attempts_total").value(site)
+
+
+def _exhausted(site: str) -> float:
+    return get_retry_registry().family(
+        "dtpu_retry_exhausted_total"
+    ).value(site)
+
+
+class TestBackoffSchedule:
+    def test_deterministic_under_seeded_rng(self):
+        policy = RetryPolicy(max_attempts=6, base_delay=0.5, max_delay=30.0)
+        a = list(policy.schedule(random.Random(42)))
+        b = list(policy.schedule(random.Random(42)))
+        assert a == b and len(a) == 5
+        assert a != list(policy.schedule(random.Random(43)))
+
+    def test_exponential_shape_without_jitter(self):
+        policy = RetryPolicy(
+            max_attempts=5, base_delay=1.0, max_delay=6.0,
+            multiplier=2.0, jitter=0.0,
+        )
+        assert list(policy.schedule(random.Random(0))) == [1.0, 2.0, 4.0, 6.0]
+
+    def test_jitter_bounds(self):
+        policy = RetryPolicy(
+            max_attempts=40, base_delay=1.0, max_delay=1.0, jitter=0.25
+        )
+        for d in policy.schedule(random.Random(7)):
+            assert 0.75 <= d <= 1.25
+
+
+class TestRetrySync:
+    def test_retries_transient_then_succeeds(self):
+        calls = {"n": 0}
+        sleeps = []
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("nope")
+            return "ok"
+
+        before = _attempts("t.sync")
+        out = retry_sync(
+            fn, site="t.sync",
+            policy=RetryPolicy(max_attempts=5, base_delay=0.01, jitter=0.0),
+            rng=random.Random(0), sleep=sleeps.append,
+        )
+        assert out == "ok" and calls["n"] == 3
+        assert sleeps == [0.01, 0.02]
+        assert _attempts("t.sync") == before + 2
+
+    def test_non_retryable_raises_immediately(self):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            retry_sync(fn, site="t.nonretry", sleep=lambda s: None)
+        assert calls["n"] == 1
+
+    def test_exhaustion_reraises_and_counts(self):
+        before = _exhausted("t.exhaust")
+
+        def fn():
+            raise ConnectionError("always")
+
+        with pytest.raises(ConnectionError):
+            retry_sync(
+                fn, site="t.exhaust",
+                policy=RetryPolicy(max_attempts=3, base_delay=0.0, jitter=0.0),
+                sleep=lambda s: None,
+            )
+        assert _exhausted("t.exhaust") == before + 1
+
+    def test_retry_after_overrides_backoff(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise BackendRequestError("429", status=429, retry_after=7)
+            return "ok"
+
+        retry_sync(
+            fn, site="t.retry_after",
+            policy=RetryPolicy(max_attempts=3, base_delay=0.01, jitter=0.0),
+            sleep=sleeps.append,
+        )
+        assert sleeps == [7.0]  # the server's hint, not the 0.01 backoff
+
+    def test_retry_after_ignored_when_disabled(self):
+        sleeps = []
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise BackendRequestError("429", status=429, retry_after=7)
+            return "ok"
+
+        retry_sync(
+            fn, site="t.retry_after_off",
+            policy=RetryPolicy(max_attempts=3, base_delay=0.01, jitter=0.0),
+            sleep=sleeps.append, respect_retry_after=False,
+        )
+        assert sleeps == [0.01]
+
+    def test_deadline_exhausted_raises_deadline_exceeded_chained(self):
+        """Budget already spent → DeadlineExceeded, chained from the
+        last real error, with no sleep."""
+
+        def fn():
+            raise ConnectionError("always")
+
+        slept = []
+        with pytest.raises(DeadlineExceeded) as ei:
+            retry_sync(
+                fn, site="t.deadline",
+                policy=RetryPolicy(
+                    max_attempts=10, base_delay=5.0, jitter=0.0
+                ),
+                deadline=Deadline(0.0),
+                sleep=slept.append,
+            )
+        assert slept == []
+        assert isinstance(ei.value.__cause__, ConnectionError)
+
+    def test_sleeps_clamped_to_remaining_budget(self):
+        """A backoff (or Retry-After hint) larger than the remaining
+        budget is clamped, not abandoned — the final attempt still
+        runs inside the deadline."""
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # Retry-After far beyond the budget: must be clamped
+                from dstack_tpu.core.errors import BackendRequestError
+
+                raise BackendRequestError("429", status=429, retry_after=30)
+            return "ok"
+
+        slept = []
+        out = retry_sync(
+            fn, site="t.clamp",
+            policy=RetryPolicy(max_attempts=5, base_delay=9.0, jitter=0.0),
+            deadline=Deadline(0.5),
+            sleep=slept.append,
+        )
+        assert out == "ok" and calls["n"] == 2
+        assert len(slept) == 1 and 0.0 < slept[0] <= 0.5
+
+
+class TestRetryAsync:
+    def test_async_retry_and_metrics(self):
+        calls = {"n": 0}
+
+        async def fn():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise asyncio.TimeoutError()
+            return 7
+
+        before = _attempts("t.async")
+
+        async def go():
+            return await retry_async(
+                fn, site="t.async",
+                policy=RetryPolicy(
+                    max_attempts=3, base_delay=0.001, jitter=0.0
+                ),
+                rng=random.Random(1),
+            )
+
+        assert asyncio.run(go()) == 7
+        assert _attempts("t.async") == before + 1
+
+    def test_cancellation_is_never_swallowed(self):
+        async def fn():
+            raise asyncio.CancelledError()
+
+        async def go():
+            with pytest.raises(asyncio.CancelledError):
+                await retry_async(fn, site="t.cancel")
+
+        asyncio.run(go())
+
+
+class TestWaitFor:
+    def test_sync_returns_first_non_none(self):
+        vals = iter([None, None, "ready"])
+        sleeps = []
+        out = wait_for_sync(
+            lambda: next(vals), site="t.wait", interval=0.3,
+            sleep=sleeps.append,
+        )
+        assert out == "ready" and len(sleeps) == 2
+
+    def test_sync_deadline_exceeded(self):
+        with pytest.raises(DeadlineExceeded):
+            wait_for_sync(
+                lambda: None, site="t.wait_dl", interval=0.01,
+                deadline=Deadline(0.03), what="thing",
+            )
+
+    def test_deadline_exceeded_is_a_timeout_error(self):
+        # legacy callers catch TimeoutError; the subclassing is API
+        assert issubclass(DeadlineExceeded, TimeoutError)
+
+    def test_async_wait(self):
+        vals = iter([None, 42])
+
+        async def fn():
+            return next(vals)
+
+        async def go():
+            return await wait_for_async(
+                fn, site="t.await", interval=0.001,
+            )
+
+        assert asyncio.run(go()) == 42
+
+
+class TestClassifier:
+    def test_status_duck_typing(self):
+        from dstack_tpu.faults import InjectedHTTPError
+
+        assert default_should_retry(BackendRequestError("x", status=429))
+        assert default_should_retry(BackendRequestError("x", status=503))
+        assert not default_should_retry(BackendRequestError("x", status=404))
+        assert default_should_retry(InjectedHTTPError(500))
+        assert default_should_retry(ConnectionError())
+        assert default_should_retry(asyncio.TimeoutError())
+        assert not default_should_retry(ValueError())
+        assert not default_should_retry(DeadlineExceeded())
+
+    def test_metrics_registered_and_rendered(self):
+        text = get_retry_registry().render()
+        assert "dtpu_retry_attempts_total" in text
+        assert "dtpu_retry_exhausted_total" in text
+        assert retry_mod.new_retry_registry().metric_names() == [
+            "dtpu_retry_attempts_total", "dtpu_retry_exhausted_total",
+        ]

@@ -9,7 +9,6 @@ import numpy as np
 
 from dstack_tpu.models import llama
 from dstack_tpu.parallel.mesh import MeshConfig, make_mesh
-from dstack_tpu.train import finetune
 from dstack_tpu.train.checkpoint import (
     latest_step,
     restore_checkpoint,
@@ -49,11 +48,30 @@ class TestCheckpointRoundtrip:
         assert step is None and restored is state
 
 
-def _run(argv, capsys) -> dict[int, float]:
-    """Run the driver, return {step: loss} parsed from its logs."""
-    rc = finetune.main(argv)
-    assert rc == 0
-    out = capsys.readouterr().out
+def _run(argv, capsys=None) -> dict[int, float]:
+    """Run the driver IN A SUBPROCESS, return {step: loss} parsed from
+    its logs. Subprocess-run on purpose: in-process ``finetune.main``
+    reliably dies with a native SIGSEGV/SIGABRT on this container
+    (tensorstore/XLA teardown interplay inside the pytest process),
+    and an in-process native abort kills every test collected after
+    this one. The driver is exactly what the SIGTERM test already runs
+    as a subprocess, so coverage is unchanged — only blast radius."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "dstack_tpu.train.finetune",
+         "--platform", "cpu", *argv],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=Path(__file__).resolve().parents[2], timeout=600,
+    )
+    out = proc.stdout
+    if proc.returncode < 0:
+        raise AssertionError(
+            f"finetune driver died on signal {-proc.returncode}:\n{out[-800:]}"
+        )
+    assert proc.returncode == 0, out[-800:]
     losses = {}
     for m in re.finditer(r"step (\d+)/\d+ loss=([0-9.]+)", out):
         losses[int(m.group(1))] = float(m.group(2))
@@ -134,47 +152,75 @@ class TestFinetuneResume:
             np.testing.assert_allclose(resumed[s], ref[s], rtol=1e-4)
 
 
+def _int8_roundtrip_impl(tmp_dir: str) -> None:
+    """Body of the int8-optimizer roundtrip check; module-level so the
+    test can execute it in a subprocess (see :func:`_run` for why
+    in-process checkpoint traffic is a suite-killer on this image)."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    from dstack_tpu.train.step import make_train_step
+
+    cfg = llama.dataclasses.replace(
+        llama.LLAMA_TINY, hidden_size=256, intermediate_size=512,
+        n_heads=4, n_kv_heads=2, head_dim=64,
+    )
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=1))
+    opt = default_optimizer(lr=1e-2, warmup=1, opt_bits=8)
+    state, _ = sharded_init(cfg, opt, mesh, seed=0)
+    step = make_train_step(cfg, opt, mesh)
+    tokens = jax.random.randint(jax.random.key(3), (4, 32), 0, cfg.vocab_size)
+    batch = {
+        "tokens": tokens,
+        "targets": jnp.roll(tokens, -1, axis=1),
+        "mask": jnp.ones_like(tokens),
+    }
+    for _ in range(3):
+        state, _m = step(state, batch)
+    # the config must actually quantize (guards against threshold
+    # drift turning this into an f32-only roundtrip test)
+    assert any(
+        l.dtype == jnp.int8 for l in jax.tree.leaves(state["opt_state"])
+    )
+    save_checkpoint(tmp_dir, 3, state)
+    state2, st = restore_checkpoint(tmp_dir, state)
+    assert st == 3
+    for (pa, la), (_pb, lb) in zip(
+        jtu.tree_leaves_with_path(state["opt_state"]),
+        jtu.tree_leaves_with_path(state2["opt_state"]),
+    ):
+        assert la.dtype == lb.dtype, (pa, la.dtype, lb.dtype)
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    sa, ma = step(state, batch)
+    sb, mb = step(state2, batch)
+    assert abs(float(ma["loss"]) - float(mb["loss"])) < 1e-6
+
+
 class TestInt8OptimizerCheckpoint:
     def test_int8_state_roundtrips_and_resumes_identically(self, tmp_path):
         """Orbax must roundtrip the ScaleByAdam8State NamedTuple
         byte-exact (int8 codes + f32 scales keep their dtypes) and a
         restored run must continue on the SAME trajectory — the
-        spot-resume guarantee extends to the quantized optimizer."""
-        import jax.numpy as jnp
-        import jax.tree_util as jtu
+        spot-resume guarantee extends to the quantized optimizer.
+        Subprocess-run so a native abort in the checkpoint path fails
+        THIS test instead of killing the rest of the suite."""
+        import subprocess
+        import sys
+        from pathlib import Path
 
-        from dstack_tpu.train.step import make_train_step
-
-        cfg = llama.dataclasses.replace(
-            llama.LLAMA_TINY, hidden_size=256, intermediate_size=512,
-            n_heads=4, n_kv_heads=2, head_dim=64,
+        proc = subprocess.run(
+            [
+                sys.executable, "-c",
+                "from tests.compute.test_checkpoint import "
+                "_int8_roundtrip_impl; "
+                f"_int8_roundtrip_impl({str(tmp_path)!r})",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=Path(__file__).resolve().parents[2], timeout=600,
         )
-        mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=1))
-        opt = default_optimizer(lr=1e-2, warmup=1, opt_bits=8)
-        state, _ = sharded_init(cfg, opt, mesh, seed=0)
-        step = make_train_step(cfg, opt, mesh)
-        tokens = jax.random.randint(jax.random.key(3), (4, 32), 0, cfg.vocab_size)
-        batch = {
-            "tokens": tokens,
-            "targets": jnp.roll(tokens, -1, axis=1),
-            "mask": jnp.ones_like(tokens),
-        }
-        for _ in range(3):
-            state, _m = step(state, batch)
-        # the config must actually quantize (guards against threshold
-        # drift turning this into an f32-only roundtrip test)
-        assert any(
-            l.dtype == jnp.int8 for l in jax.tree.leaves(state["opt_state"])
-        )
-        save_checkpoint(str(tmp_path), 3, state)
-        state2, st = restore_checkpoint(str(tmp_path), state)
-        assert st == 3
-        for (pa, la), (_pb, lb) in zip(
-            jtu.tree_leaves_with_path(state["opt_state"]),
-            jtu.tree_leaves_with_path(state2["opt_state"]),
-        ):
-            assert la.dtype == lb.dtype, (pa, la.dtype, lb.dtype)
-            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
-        sa, ma = step(state, batch)
-        sb, mb = step(state2, batch)
-        assert abs(float(ma["loss"]) - float(mb["loss"])) < 1e-6
+        if proc.returncode < 0:
+            raise AssertionError(
+                f"int8 roundtrip died on signal {-proc.returncode}:\n"
+                f"{proc.stdout[-800:]}"
+            )
+        assert proc.returncode == 0, proc.stdout[-1500:]

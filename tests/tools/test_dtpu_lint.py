@@ -254,6 +254,63 @@ def test_dtpu005_never_applies_to_settings_py():
     assert rule.applies("dstack_tpu/serve/engine.py")
 
 
+def test_dtpu006_fires_on_silent_broad_except():
+    src = """
+def tick():
+    try:
+        work()
+    except Exception:
+        pass
+
+async def probe():
+    try:
+        await poke()
+    except:
+        return None
+"""
+    found = check_file_source(
+        src, "dstack_tpu/server/background/tasks/x.py",
+        rule_ids=["DTPU006"],
+    )
+    assert len(found) == 2
+    assert "silent broad except in tick" in found[0].message
+    assert "silent broad except in probe" in found[1].message
+
+
+def test_dtpu006_quiet_when_logged_narrowed_or_reraised():
+    src = """
+def a():
+    try:
+        work()
+    except Exception:
+        logger.warning("work for %s failed", name)
+
+def b():
+    try:
+        work()
+    except ValueError:
+        pass  # narrow: fine
+
+def c():
+    try:
+        work()
+    except Exception as e:
+        raise RuntimeError("context") from e
+"""
+    assert check_file_source(
+        src, "dstack_tpu/routing/x.py", rule_ids=["DTPU006"]
+    ) == []
+
+
+def test_dtpu006_scope_is_background_and_routing_only():
+    rule = all_rules()["DTPU006"]
+    assert rule.applies("dstack_tpu/server/background/scheduler.py")
+    assert rule.applies("dstack_tpu/server/background/tasks/process_runs.py")
+    assert rule.applies("dstack_tpu/routing/pool.py")
+    assert not rule.applies("dstack_tpu/serve/engine.py")
+    assert not rule.applies("dstack_tpu/server/services/runs.py")
+
+
 # ---------------------------------------------------------------------------
 # pragmas
 # ---------------------------------------------------------------------------

@@ -6,4 +6,5 @@ from tools.dtpu_lint.rules import (  # noqa: F401
     metric_hygiene,
     recompile,
     settings_drift,
+    silent_except,
 )

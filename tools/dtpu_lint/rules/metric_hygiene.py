@@ -102,10 +102,12 @@ def collect_metric_names(repo: Path) -> set:
     from dstack_tpu.routing.metrics import new_router_registry
     from dstack_tpu.serve.metrics import new_serve_registry
     from dstack_tpu.server.tracing import RequestStats
+    from dstack_tpu.utils.retry import new_retry_registry
 
     names.update(RequestStats().registry.metric_names())
     names.update(new_serve_registry().metric_names())
     names.update(new_router_registry().metric_names())
+    names.update(new_retry_registry().metric_names())
     try:
         from dstack_tpu.train.step import new_train_registry
 

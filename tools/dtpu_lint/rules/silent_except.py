@@ -1,0 +1,93 @@
+"""DTPU006: silent broad except in reconciliation/routing code.
+
+The background loops and the routing layer are exactly where the fault
+layer (:mod:`dstack_tpu.faults`) injects failures — and where a bare
+``except Exception: pass`` turns an injected (or real) fault into
+nothing: the chaos suite would green-light an invariant the code never
+actually survived, and production failures would vanish without a log
+line.
+
+The rule flags ``except Exception:`` / bare ``except:`` handlers whose
+body neither logs (no ``logger``/``logging``/``log`` call) nor
+re-raises. Narrow the exception to what the code actually expects, or
+add structured logging (the failure's identity and subject, not just
+"something went wrong"). A handler that legitimately must stay silent
+takes a ``# dtpu: noqa[DTPU006] <why>`` pragma.
+
+Scope: ``server/background/`` and ``routing/`` — the planes the chaos
+suite drives. Grandfathered findings live in the shrink-only baseline.
+"""
+
+import ast
+from typing import Iterable
+
+from tools.dtpu_lint.core import FileRule, Finding, register
+
+_LOG_NAMES = {"logger", "logging", "log"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    if isinstance(t, ast.Name) and t.id in ("Exception", "BaseException"):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in ("Exception", "BaseException")
+            for e in t.elts
+        )
+    return False
+
+
+def _handles_visibly(handler: ast.ExceptHandler) -> bool:
+    """True when the body logs or re-raises (incl. raising a new
+    error — the failure stays visible to the caller either way)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if isinstance(base, ast.Name) and base.id in _LOG_NAMES:
+                return True
+    return False
+
+
+def _enclosing_function(tree: ast.AST, handler: ast.ExceptHandler) -> str:
+    best = "<module>"
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if (
+                node.lineno <= handler.lineno
+                and handler.lineno <= (node.end_lineno or node.lineno)
+            ):
+                best = node.name  # innermost wins: walk yields outer first
+    return best
+
+
+@register
+class SilentBroadExceptRule(FileRule):
+    id = "DTPU006"
+    name = "silent broad except in background/routing code"
+    scope = (
+        "dstack_tpu/server/background/**/*.py",
+        "dstack_tpu/routing/**/*.py",
+    )
+
+    def check(self, tree, src, relpath, repo) -> Iterable[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _handles_visibly(node):
+                continue
+            fn = _enclosing_function(tree, node)
+            yield Finding(
+                "DTPU006",
+                relpath,
+                node.lineno,
+                f"silent broad except in {fn}: an injected or real fault "
+                "vanishes here — log it (with the subject's identity) or "
+                "narrow the exception",
+            )
