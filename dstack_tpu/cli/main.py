@@ -670,6 +670,37 @@ def render_timeline_table(tl: dict) -> Table:
     return t
 
 
+def render_qos_lines(tl: dict) -> list:
+    """QoS summary lines for `dtpu stats` — why requests were (or were
+    not) served: edge admission/shed counts, engine-side sheds, and
+    mean replica queue wait. Empty when the run has no QoS signal."""
+    q = tl.get("qos") or {}
+    lines = []
+    edge = q.get("edge")
+    if edge:
+        shed = edge.get("shed", 0)
+        line = (
+            f"edge admission: {edge.get('admitted', 0)} admitted, "
+            f"{shed} shed (429)"
+        )
+        if shed and edge.get("last_retry_after"):
+            line += f", last Retry-After {edge['last_retry_after']}s"
+        if edge.get("shed_tenants"):
+            line += f", {edge['shed_tenants']} tenant(s) throttled"
+        lines.append(line)
+    if q.get("replica_shed") or q.get("replica_admitted"):
+        lines.append(
+            f"replica admission: {q.get('replica_admitted', 0)} admitted, "
+            f"{q.get('replica_shed', 0)} shed at the engine edge"
+        )
+    if q.get("replica_queue_waits"):
+        lines.append(
+            f"queue wait: {q['replica_queue_wait_mean_s'] * 1000:.1f}ms mean "
+            f"over {q['replica_queue_waits']} slot admissions"
+        )
+    return lines
+
+
 @cli.command()
 @click.argument("run_name")
 @click.option("--project", default=None)
@@ -690,6 +721,8 @@ def stats(run_name, project) -> None:
         )
         return
     console.print(render_timeline_table(tl))
+    for line in render_qos_lines(tl):
+        console.print(line)
 
 
 @cli.command()

@@ -107,6 +107,38 @@ class ScalingSpec(CoreModel):
     scale_down_delay: Duration = 600
 
 
+class QoSSpec(CoreModel):
+    """Per-tenant admission control for a service's request edges.
+
+    Enforced at every admission point that routes to the service — the
+    in-server proxy, the gateway agent, and (via ``DTPU_QOS_*`` env the
+    configurator injects) the in-repo OpenAI server itself. Tenants are
+    keyed by API token; a tenant past its budget receives 429 +
+    ``Retry-After`` (never a raw 5xx), other tenants are unaffected.
+    """
+
+    rps: float = 0.0  # sustained requests/second per tenant; 0 = off
+    burst: float = 0.0  # bucket capacity; 0 = max(1, 2×rps)
+    tenant_inflight: int = 0  # concurrent engine slots per tenant; 0 = off
+    max_tenants: int = 256  # distinct tenant buckets before overflow pooling
+
+    @field_validator("rps", "burst", "tenant_inflight")
+    @classmethod
+    def _nonneg(cls, v: float) -> float:
+        if v < 0:
+            raise ValueError("qos rates and caps must be >= 0")
+        return v
+
+    @field_validator("max_tenants")
+    @classmethod
+    def _at_least_one(cls, v: int) -> int:
+        # < 1 would route every tenant into the single overflow bucket,
+        # silently collapsing per-tenant isolation into a shared budget
+        if v < 1:
+            raise ValueError("qos max_tenants must be >= 1")
+        return v
+
+
 class ServiceModelSpec(CoreModel):
     """Registers the service in the OpenAI-compatible model gateway
     (/proxy/models), cf. reference proxy/lib/routers/model_proxy.py.
@@ -186,6 +218,20 @@ class BaseRunConfiguration(ProfileParams):
     volumes: list[AnyMountPoint] = []
     working_dir: Optional[str] = None
     repos: list[RepoSpec] = []
+    # scheduling priority class (0..100, default 50): higher-priority
+    # runs schedule first in process_submitted_jobs' fair-share pass,
+    # and — strictly above a lower-priority batch run — may preempt it
+    # for capacity (the preempted job terminates
+    # INTERRUPTED_BY_NO_CAPACITY and resubmits under retry:
+    # on-interruption)
+    priority: Optional[int] = None
+
+    @field_validator("priority")
+    @classmethod
+    def _priority(cls, v: Optional[int]) -> Optional[int]:
+        if v is not None and not 0 <= v <= 100:
+            raise ValueError("priority must be in 0..100")
+        return v
 
     @field_validator("volumes", mode="before")
     @classmethod
@@ -235,6 +281,7 @@ class ServiceConfiguration(BaseRunConfiguration):
     auth: bool = True
     replicas: Any = None  # Range[int]; parsed below
     scaling: Optional[ScalingSpec] = None
+    qos: Optional[QoSSpec] = None  # per-tenant admission control
 
     @field_validator("model", mode="before")
     @classmethod

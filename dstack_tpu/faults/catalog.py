@@ -57,6 +57,18 @@ POINTS: dict = {
         "streams (failover path)",
         ("replica", "attempt"),
     ),
+    "routing.admit": (
+        "one QoS admission decision at the proxy/gateway edge "
+        "(qos.edge_admit); raise 'http:429' (+retry_after) to force "
+        "the shed path deterministically, independent of bucket state",
+        ("tenant", "run"),
+    ),
+    "serve.admit": (
+        "one QoS admission decision at the OpenAI server's edge "
+        "(serve/openai_server build_app _admit, via qos.edge_admit); "
+        "raise 'http:429' to force a shed before any prompt work",
+        ("tenant", "run"),
+    ),
     "serve.engine.step": (
         "one decode step of the inference engine (serve/engine.py); "
         "runs on the worker thread — sync actions only",

@@ -33,9 +33,12 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from dstack_tpu import qos
 from dstack_tpu.gateway.nginx import NginxManager
 from dstack_tpu.gateway.state import GatewayState, Replica, Service
 from dstack_tpu.gateway.stats import AccessLogTailer, GatewayStats
+from dstack_tpu.qos.metrics import get_qos_registry
+from dstack_tpu.qos.web import admit_or_shed
 from dstack_tpu.routing import (
     PoolRegistry,
     forward_with_failover,
@@ -149,15 +152,40 @@ async def _service_auth(
     )
 
 
+def _request_tenant(svc: Service, request: web.Request) -> str:
+    """Gateway-edge QoS bucket key: the Bearer-token digest — but only
+    when ``_service_auth`` actually VALIDATED that token (``auth:
+    true``). On an ``auth: false`` service the token is whatever the
+    client typed: digesting it would let a flooder mint a fresh
+    full-burst bucket per made-up token (budget bypass) and churn the
+    bounded tenant map, so everyone shares the anonymous budget."""
+    if svc.auth:
+        return qos.tenant_from_headers(request.headers)
+    return qos.ANONYMOUS_TENANT
+
+
+def _qos_admit(svc: Service, tenant: str) -> Optional[web.Response]:
+    """Gateway-edge per-tenant admission (the gateway never sees
+    usernames), policy from the service's registered ``qos`` block.
+    → 429 + monotone ``Retry-After`` or None."""
+    return admit_or_shed(svc.qos, tenant, svc.project, svc.run_name)
+
+
 async def _forward(
-    agent: GatewayAgent, request: web.Request, svc: Service, path: str
+    agent: GatewayAgent, request: web.Request, svc: Service, path: str,
+    tenant: str,
 ) -> web.StreamResponse:
     pool = agent.pool_for(svc)
     if pool.size() == 0:
         return web.json_response(
-            {"detail": f"no running replicas for {svc.run_name}"}, status=503
+            {"detail": f"no running replicas for {svc.run_name}"},
+            status=503,
+            headers={"Retry-After": str(pool.retry_after_hint())},
         )
-    return await forward_with_failover(request, pool, agent.session(), path)
+    return await forward_with_failover(
+        request, pool, agent.session(), path,
+        extra_headers={qos.TENANT_HEADER: tenant},
+    )
 
 
 def build_app(
@@ -186,6 +214,7 @@ def build_app(
             model_name=b.get("model_name"),
             model_prefix=b.get("model_prefix", "/v1"),
             https=b.get("https", True),
+            qos=b.get("qos") if isinstance(b.get("qos"), dict) else None,
         )
         agent.state.register_service(svc)
         await agent.sync_nginx(agent.state.get(svc.project, svc.run_name))
@@ -288,7 +317,8 @@ def build_app(
             return denied
         agent.pools.update_state_gauge()
         return web.Response(
-            text=get_router_registry().render(), content_type="text/plain"
+            text=get_router_registry().render() + get_qos_registry().render(),
+            content_type="text/plain",
         )
 
     async def get_stats(request: web.Request) -> web.Response:
@@ -335,11 +365,15 @@ def build_app(
         denied = await _service_auth(agent, svc, request)
         if denied is not None:
             return denied
+        tenant = _request_tenant(svc, request)
+        shed = _qos_admit(svc, tenant)
+        if shed is not None:
+            return shed
         agent.stats.record(project, run_name)
         # strip_prefix=false services expect the full request path
         if not svc.strip_prefix:
             path = request.path
-        return await _forward(agent, request, svc, path)
+        return await _forward(agent, request, svc, path, tenant)
 
     async def model_list(request: web.Request) -> web.Response:
         project = request.match_info["project"]
@@ -375,12 +409,17 @@ def build_app(
         denied = await _service_auth(agent, svc, request)
         if denied is not None:
             return denied
+        tenant = _request_tenant(svc, request)
+        shed = _qos_admit(svc, tenant)
+        if shed is not None:
+            return shed
         agent.stats.record(project, svc.run_name)
         return await _forward(
             agent,
             request,
             svc,
             f"{svc.model_prefix.strip('/')}/{path.lstrip('/')}",
+            tenant,
         )
 
     async def host_proxy(request: web.Request) -> web.StreamResponse:
@@ -392,8 +431,12 @@ def build_app(
         denied = await _service_auth(agent, svc, request)
         if denied is not None:
             return denied
+        tenant = _request_tenant(svc, request)
+        shed = _qos_admit(svc, tenant)
+        if shed is not None:
+            return shed
         agent.stats.record(svc.project, svc.run_name)
-        return await _forward(agent, request, svc, request.path)
+        return await _forward(agent, request, svc, request.path, tenant)
 
     app.router.add_get("/models/{project}/models", model_list)
     app.router.add_post("/models/{project}/{path:.*}", model_proxy)

@@ -83,6 +83,9 @@ server {{{listen}
         proxy_pass http://{upstream};
         proxy_set_header Host $host;
         proxy_set_header X-Real-IP $remote_addr;
+        # the replica trusts X-DTPU-Tenant as proxy-asserted identity
+        # (its QoS bucket key): never let a client-supplied value through
+        proxy_set_header X-DTPU-Tenant "";
         proxy_http_version 1.1;
         proxy_set_header Upgrade $http_upgrade;
         proxy_set_header Connection "upgrade";

@@ -31,6 +31,10 @@ class Service:
     model_name: Optional[str] = None  # OpenAI model routing
     model_prefix: str = "/v1"
     https: bool = True
+    # per-tenant admission policy from the service spec's `qos` block
+    # (rps/burst/tenant_inflight/max_tenants) — enforced by the agent's
+    # data path; None = no gateway-side rate limiting
+    qos: Optional[dict] = None
     replicas: dict[str, Replica] = field(default_factory=dict)
 
     @property
@@ -145,6 +149,7 @@ class GatewayState:
                     "model_name": s.model_name,
                     "model_prefix": s.model_prefix,
                     "https": s.https,
+                    "qos": s.qos,
                     "replicas": [
                         {"job_id": r.job_id, "host": r.host, "port": r.port}
                         for r in s.replicas.values()
@@ -176,6 +181,7 @@ class GatewayState:
                 model_name=sd.get("model_name"),
                 model_prefix=sd.get("model_prefix", "/v1"),
                 https=sd.get("https", True),
+                qos=sd.get("qos"),
             )
             for rd in sd.get("replicas", []):
                 svc.replicas[rd["job_id"]] = Replica(

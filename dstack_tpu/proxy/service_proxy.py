@@ -16,13 +16,29 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from dstack_tpu import qos
 from dstack_tpu.core.models.runs import JobProvisioningData, JobStatus
 from dstack_tpu.proxy.stats import get_service_stats
+from dstack_tpu.qos.web import admit_or_shed
 from dstack_tpu.routing import forward_with_failover, get_pool_registry
 from dstack_tpu.server.db import Database, loads
 from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("proxy.service")
+
+
+def _request_tenant(user_row: Optional[dict]) -> str:
+    """The QoS bucket key for one proxied request: the authenticated
+    username when the proxy resolved one, else the shared anonymous
+    tenant. Never a client-supplied header, and never a digest of an
+    UNVERIFIED Bearer token (``auth: false`` services skip token
+    validation): an attacker rotating made-up tokens would mint a
+    fresh full-burst bucket per token — a budget bypass — and churn
+    the bounded tenant map. No verified identity ⇒ one shared
+    budget."""
+    if user_row is not None:
+        return str(user_row["username"])[:64]
+    return qos.ANONYMOUS_TENANT
 
 
 async def _resolve_replicas(
@@ -98,20 +114,27 @@ async def _bearer_user(request: web.Request, db: Database):
 
 
 async def _check_service_auth(
-    request: web.Request, db: Database, run_row: Optional[dict]
-) -> Optional[web.Response]:
+    request: web.Request, db: Database, run_row: Optional[dict], conf: dict
+) -> tuple:
     """Enforce the service's ``auth: true`` (the default): the caller must
     present a valid server token (reference: gateway auth check against
-    /api/auth). Returns an error response or None when authorized."""
+    /api/auth). Returns ``(error response or None, resolved user row or
+    None)`` — the user row doubles as the QoS tenant identity. An
+    ``auth: false`` service skips the token DB lookup entirely (the old
+    fast path): with no verified identity its QoS tenant is the shared
+    anonymous one (see ``_request_tenant``)."""
     if run_row is None:
-        return None  # nonexistent run: fall through to 503 (no info leak)
-    conf = (loads(run_row["run_spec"]) or {}).get("configuration", {})
+        return None, None  # nonexistent run: fall through to 503 (no info leak)
     if conf.get("auth") is False:
-        return None
-    if await _bearer_user(request, db) is not None:
-        return None
-    return web.json_response(
-        {"detail": "authentication required for this service"}, status=401
+        return None, None
+    user = await _bearer_user(request, db)
+    if user is not None:
+        return None, user
+    return (
+        web.json_response(
+            {"detail": "authentication required for this service"}, status=401
+        ),
+        None,
     )
 
 
@@ -133,9 +156,19 @@ async def service_proxy_handler(request: web.Request) -> web.StreamResponse:
     run_name = request.match_info["run_name"]
     path = request.match_info.get("path", "")
     run_row = await _get_run_row(db, project, run_name)
-    denied = await _check_service_auth(request, db, run_row)
+    conf = (
+        (loads(run_row["run_spec"]) or {}).get("configuration", {})
+        if run_row is not None
+        else {}
+    )
+    denied, user = await _check_service_auth(request, db, run_row, conf)
     if denied is not None:
         return denied
+    tenant = _request_tenant(user)
+    if run_row is not None:  # no stats/bucket keys from random run names
+        shed = admit_or_shed(conf.get("qos"), tenant, project, run_name)
+        if shed is not None:
+            return shed
     # record BEFORE the no-replica check: demand on a scaled-to-zero
     # service is what makes the autoscaler scale it back up — but only
     # for runs that actually exist (no unbounded keys from random names)
@@ -144,10 +177,13 @@ async def service_proxy_handler(request: web.Request) -> web.StreamResponse:
     pool = await _synced_pool(db, project, run_name)
     if pool.size() == 0:
         return web.json_response(
-            {"detail": f"no running replicas for {run_name}"}, status=503
+            {"detail": f"no running replicas for {run_name}"},
+            status=503,
+            headers={"Retry-After": str(pool.retry_after_hint())},
         )
     return await forward_with_failover(
-        request, pool, _proxy_session(request.app), path
+        request, pool, _proxy_session(request.app), path,
+        extra_headers={qos.TENANT_HEADER: tenant},
     )
 
 
@@ -169,17 +205,23 @@ async def model_proxy_handler(request: web.Request) -> web.StreamResponse:
             {"detail": f"model {model_name!r} not found"}, status=404
         )
     run_name = run_row["run_name"]
-    denied = await _check_service_auth(request, db, run_row)
+    conf = (loads(run_row["run_spec"]) or {}).get("configuration", {})
+    denied, user = await _check_service_auth(request, db, run_row, conf)
     if denied is not None:
         return denied
+    tenant = _request_tenant(user)
+    shed = admit_or_shed(conf.get("qos"), tenant, project, run_name)
+    if shed is not None:
+        return shed
     get_service_stats().record(project, run_name)  # before the 503 check
     pool = await _synced_pool(db, project, run_name)
     if pool.size() == 0:
         return web.json_response(
-            {"detail": f"no running replicas for model {model_name}"}, status=503
+            {"detail": f"no running replicas for model {model_name}"},
+            status=503,
+            headers={"Retry-After": str(pool.retry_after_hint())},
         )
-    spec = loads(run_row["run_spec"])
-    model_conf = spec.get("configuration", {}).get("model", {}) or {}
+    model_conf = conf.get("model", {}) or {}
     if model_conf.get("format") == "tgi":
         # the TGI adapter drives its own upstream exchange (SSE
         # re-framing): pick one healthy replica, no mid-protocol retries
@@ -212,6 +254,7 @@ async def model_proxy_handler(request: web.Request) -> web.StreamResponse:
         pool,
         _proxy_session(request.app),
         f"{prefix.strip('/')}/{path.lstrip('/')}",
+        extra_headers={qos.TENANT_HEADER: tenant},
     )
 
 

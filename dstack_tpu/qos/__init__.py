@@ -1,0 +1,713 @@
+"""Multi-tenant QoS: admission control, priority, and overload isolation.
+
+The shared layer behind every admission edge — the OpenAI serve server,
+the in-server service proxy, and the gateway agent — plus the
+priority/fair-share machinery the scheduling plane
+(``server/background/tasks/process_submitted_jobs.py``) runs on. One
+tenant flooding requests must cost *that tenant* 429s, never another
+tenant's latency; one project submitting a thousand batch jobs must not
+starve everyone else's scheduling tick.
+
+Pieces, by plane:
+
+- :class:`TokenBucket` / :class:`TenantBuckets` — deterministic
+  leaky-bucket rate limiting with an injectable clock (tests drive a
+  fake clock and assert the exact admit/shed schedule; production uses
+  ``time.monotonic``). Tenant maps are bounded: past ``max_tenants``
+  distinct keys, new tenants share one overflow bucket instead of
+  growing memory without bound (the same cardinality defense the obs
+  registry applies to label sets).
+- :class:`QoSPolicy` — per-service admission config, parsed from the
+  run/service spec's ``qos`` block or from ``DTPU_QOS_*`` env (the form
+  the job configurator injects into a service replica's environment).
+- :func:`edge_admit` — the one admission decision both HTTP edges call:
+  fires the ``routing.admit`` fault point (chaos plans force the shed
+  path deterministically), charges the tenant's bucket, counts
+  admitted/shed into the ``dtpu_qos_*`` metrics and the per-run edge
+  stats, and returns the 429 ``Retry-After`` hint on shed. Hints are
+  monotone within a flood: they are derived from the bucket's refill
+  schedule, so back-to-back sheds never tell a client to wait *longer*
+  than the previous response did.
+- Priority classes + :class:`PriorityPending` — the serve scheduler's
+  admission queue: interactive requests are admitted to slots ahead of
+  batch, with per-tenant in-flight caps so no tenant holds every slot.
+- :func:`select_jobs_fair_share` — deficit-style weighted selection for
+  ``process_submitted_jobs``: strict priority tiers, round-robin across
+  projects inside a tier (projects that went underserved carry a
+  deficit into the next tick), FIFO with a deterministic id tie-break
+  inside a project.
+
+Import-light on purpose (stdlib + obs only — no aiohttp, no jax): the
+scheduler plane, the serve process, and unit tests all import this
+without pulling a web or accelerator runtime.
+"""
+
+import asyncio
+import hashlib
+import heapq
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+from dstack_tpu import faults
+from dstack_tpu.qos.metrics import get_qos_registry
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("qos")
+
+# ---------------------------------------------------------------------------
+# token buckets
+# ---------------------------------------------------------------------------
+
+
+class TokenBucket:
+    """Deterministic leaky bucket: ``rate`` tokens/second refill toward
+    ``burst`` capacity; each admitted request spends one token.
+
+    The clock is injectable so the refill schedule is a pure function
+    of (rate, burst, clock readings) — the unit tests drive a fake
+    clock and assert exactly which calls admit and which shed.
+    """
+
+    __slots__ = ("rate", "burst", "tokens", "updated", "clock")
+
+    def __init__(
+        self,
+        rate: float,
+        burst: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rate = max(0.0, float(rate))
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst
+        self.clock = clock
+        self.updated = clock()
+
+    def _refill(self) -> None:
+        now = self.clock()
+        if now > self.updated:
+            self.tokens = min(self.burst, self.tokens + (now - self.updated) * self.rate)
+        self.updated = now
+
+    def try_acquire(self, cost: float = 1.0) -> bool:
+        self._refill()
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return True
+        return False
+
+    def retry_after(self, cost: float = 1.0) -> float:
+        """Seconds until ``cost`` tokens will have accrued. A shed does
+        NOT spend tokens, so while a flood lasts the hint shrinks
+        monotonically as the refill progresses — it never grows."""
+        self._refill()
+        deficit = cost - self.tokens
+        if deficit <= 0:
+            return 0.0
+        if self.rate <= 0:
+            return 3600.0  # rate 0 = hard-closed bucket
+        return deficit / self.rate
+
+    def refund(self, cost: float = 1.0) -> None:
+        """Return tokens spent on work that was ultimately rejected —
+        the serve edge's two-phase charge (1 pre-parse + n-1 once the
+        fan-out width is known) refunds the first token when the
+        second phase sheds, so a shed stays free of charge and the
+        Retry-After contract (hints shrink, a compliant client lands
+        on its tokens) holds across the split. Capped at burst."""
+        self._refill()
+        self.tokens = min(self.burst, self.tokens + cost)
+
+    def is_idle_full(self) -> bool:
+        """Fully refilled — indistinguishable from a freshly-created
+        bucket, so evicting it loses no state."""
+        self._refill()
+        return self.tokens >= self.burst
+
+
+class TenantBuckets:
+    """Per-tenant buckets with bounded tenant cardinality: past
+    ``max_tenants`` distinct keys, new tenants share one overflow
+    bucket (they still get rate-limited — collectively — instead of
+    minting unbounded state).
+
+    A full map first evicts idle (fully-refilled) buckets before
+    overflowing: a burst of throwaway identities — e.g. rotated Bearer
+    tokens at an edge that cannot verify them — poisons the map only
+    while those buckets are still draining, not forever. Eviction is
+    lossless: a full bucket behaves identically to a fresh one."""
+
+    _OVERFLOW = "<overflow>"
+
+    def __init__(
+        self,
+        rate: float,
+        burst: float,
+        max_tenants: int = 256,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        # < 1 would route EVERY tenant to the overflow bucket, silently
+        # collapsing per-tenant isolation into one shared budget
+        self.max_tenants = max(1, int(max_tenants))
+        self.clock = clock
+        self._buckets: Dict[str, TokenBucket] = {}
+
+    def _evict_idle(self) -> None:
+        for k in [
+            k for k, b in self._buckets.items()
+            if k != self._OVERFLOW and b.is_idle_full()
+        ]:
+            del self._buckets[k]
+
+    def bucket(self, tenant: str) -> TokenBucket:
+        b = self._buckets.get(tenant)
+        if b is None:
+            if len(self._buckets) >= self.max_tenants and tenant != self._OVERFLOW:
+                self._evict_idle()
+            if len(self._buckets) >= self.max_tenants and tenant != self._OVERFLOW:
+                return self.bucket(self._OVERFLOW)
+            b = self._buckets[tenant] = TokenBucket(
+                self.rate, self.burst, clock=self.clock
+            )
+        return b
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+#: priority classes for the serve admission queue, lower = admitted first
+PRIORITY_INTERACTIVE = 0
+PRIORITY_STANDARD = 1
+PRIORITY_BATCH = 2
+
+_PRIORITY_CLASSES = {
+    "interactive": PRIORITY_INTERACTIVE,
+    "standard": PRIORITY_STANDARD,
+    "batch": PRIORITY_BATCH,
+}
+_PRIORITY_NAMES = {v: k for k, v in _PRIORITY_CLASSES.items()}
+
+
+def parse_priority_class(value: Any) -> int:
+    """``interactive`` / ``standard`` / ``batch`` (header or payload
+    value) → queue rank; anything unrecognized is standard — a bad
+    header must not 400 a request or grant it priority."""
+    if isinstance(value, str):
+        return _PRIORITY_CLASSES.get(value.strip().lower(), PRIORITY_STANDARD)
+    return PRIORITY_STANDARD
+
+
+def priority_class_name(rank: int) -> str:
+    return _PRIORITY_NAMES.get(rank, "standard")
+
+
+@dataclass(frozen=True)
+class QoSPolicy:
+    """Admission config for one service edge. ``rps <= 0`` disables
+    rate limiting; ``tenant_inflight <= 0`` disables the in-flight cap."""
+
+    rps: float = 0.0
+    burst: float = 0.0  # 0 → derived as max(1, 2×rps)
+    tenant_inflight: int = 0
+    max_tenants: int = 256
+
+    @property
+    def enabled(self) -> bool:
+        return self.rps > 0
+
+    def effective_burst(self) -> float:
+        return self.burst if self.burst > 0 else max(1.0, 2.0 * self.rps)
+
+    @classmethod
+    def from_spec(cls, spec: Optional[dict]) -> "QoSPolicy":
+        """Parse a run/service configuration ``qos`` block (already a
+        plain dict on the server side). Bad values degrade to disabled
+        rather than 500 the data path."""
+        if not isinstance(spec, dict):
+            return cls()
+        try:
+            return cls(
+                rps=float(spec.get("rps") or 0.0),
+                burst=float(spec.get("burst") or 0.0),
+                tenant_inflight=int(spec.get("tenant_inflight") or 0),
+                max_tenants=int(spec.get("max_tenants") or 256),
+            )
+        except (TypeError, ValueError):
+            logger.warning("ignoring malformed qos spec: %r", spec)
+            return cls()
+
+    @classmethod
+    def from_env(cls) -> "QoSPolicy":
+        """The serve-process form: the job configurator renders a
+        service spec's ``qos`` block into ``DTPU_QOS_*`` env vars for
+        the replica (documented in docs/reference/server.md)."""
+
+        def _f(name: str, default: float = 0.0) -> float:
+            try:
+                return float(os.getenv(name, "") or default)
+            except ValueError:
+                return default
+
+        return cls(
+            rps=_f("DTPU_QOS_RPS"),
+            burst=_f("DTPU_QOS_BURST"),
+            tenant_inflight=int(_f("DTPU_QOS_TENANT_INFLIGHT")),
+            # 0 falls back to the default like from_spec — collapsing
+            # every tenant into the overflow bucket is never intended
+            max_tenants=int(_f("DTPU_QOS_MAX_TENANTS") or 256),
+        )
+
+    def env(self) -> Dict[str, str]:
+        """The inverse of :meth:`from_env` — what the configurator
+        injects into a service replica's environment."""
+        return {
+            "DTPU_QOS_RPS": str(self.rps),
+            "DTPU_QOS_BURST": str(self.burst),
+            "DTPU_QOS_TENANT_INFLIGHT": str(self.tenant_inflight),
+            "DTPU_QOS_MAX_TENANTS": str(self.max_tenants),
+        }
+
+
+# ---------------------------------------------------------------------------
+# tenant identity
+# ---------------------------------------------------------------------------
+
+TENANT_HEADER = "X-DTPU-Tenant"
+PRIORITY_HEADER = "X-DTPU-Priority"
+ANONYMOUS_TENANT = "anonymous"
+
+
+def tenant_from_headers(headers, trust_header: bool = False) -> str:
+    """Stable tenant key for a request: a digest of the Bearer token
+    (the key never appears in logs or metric labels in the clear), else
+    the shared anonymous tenant.
+
+    ``trust_header`` honors an explicit ``X-DTPU-Tenant`` INSTEAD of
+    the token digest and is ONLY for the serve process sitting behind
+    the proxy/gateway — those edges strip client-supplied values and
+    re-inject the authenticated identity, so the header is the one
+    trustworthy signal and the Authorization header is NOT: on the
+    nginx custom-domain path the raw client token reaches the replica
+    unvalidated, and digesting it would let a flooder rotating made-up
+    Bearer tokens mint a fresh full-burst bucket per token (budget
+    bypass, bounded-map churn). Absent header → shared anonymous
+    budget, never the token. A client-facing edge must never set
+    ``trust_header``: a spoofable tenant header lets a flooder mint a
+    fresh bucket per request or impersonate a victim tenant to exhaust
+    theirs. With ``trust_header=False`` the digest fallback is safe
+    because its one caller — the gateway's ``_request_tenant`` — only
+    reaches it with a token ``_service_auth`` already validated (the
+    in-server proxy keys by authenticated username instead)."""
+    if trust_header:
+        explicit = headers.get(TENANT_HEADER)
+        if explicit:
+            return str(explicit)[:64]
+        return ANONYMOUS_TENANT
+    auth = headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        token = auth[len("Bearer "):].strip()
+        if token:
+            return "tok-" + hashlib.sha256(token.encode()).hexdigest()[:12]
+    return ANONYMOUS_TENANT
+
+
+# ---------------------------------------------------------------------------
+# per-run edge stats (the `dtpu stats` / timeline surface)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RunEdgeStats:
+    admitted: int = 0
+    shed: int = 0
+    last_shed_at: float = 0.0  # unix seconds
+    last_retry_after: int = 0
+    shed_tenants: set = field(default_factory=set)  # bounded below
+
+
+_MAX_RUN_STATS = 512
+_MAX_SHED_TENANTS = 64
+_run_stats: Dict[Tuple[str, str], RunEdgeStats] = {}
+
+
+def record_edge(
+    project: str, run_name: str, admitted: bool, retry_after: int = 0,
+    tenant: str = "", count: int = 1,
+) -> None:
+    key = (project, run_name)
+    st = _run_stats.get(key)
+    if st is None:
+        if len(_run_stats) >= _MAX_RUN_STATS:
+            return  # bounded: drop stats, never memory
+        st = _run_stats[key] = RunEdgeStats()
+    if admitted:
+        st.admitted += count
+    else:
+        st.shed += 1
+        st.last_shed_at = time.time()
+        st.last_retry_after = retry_after
+        if tenant and len(st.shed_tenants) < _MAX_SHED_TENANTS:
+            st.shed_tenants.add(tenant)
+
+
+def run_edge_snapshot(project: str, run_name: str) -> Optional[dict]:
+    st = _run_stats.get((project, run_name))
+    if st is None:
+        return None
+    return {
+        "admitted": st.admitted,
+        "shed": st.shed,
+        "last_shed_at": st.last_shed_at or None,
+        "last_retry_after": st.last_retry_after or None,
+        "shed_tenants": len(st.shed_tenants),
+    }
+
+
+def reset_edge_stats() -> None:
+    """Test hook: edge stats are per-process module state."""
+    _run_stats.clear()
+
+
+# ---------------------------------------------------------------------------
+# edge admission
+# ---------------------------------------------------------------------------
+
+
+class EdgeLimiters:
+    """Per-service tenant-bucket sets for one process's admission edge
+    (the in-server proxy or the gateway agent). Buckets are keyed by
+    (project, run) and rebuilt when the service's policy changes — a
+    redeploy with a new ``qos`` block takes effect on the next request."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self.clock = clock
+        self._limiters: Dict[Tuple[str, str], Tuple[QoSPolicy, TenantBuckets]] = {}
+
+    def buckets_for(
+        self, project: str, run_name: str, policy: QoSPolicy
+    ) -> TenantBuckets:
+        key = (project, run_name)
+        cached = self._limiters.get(key)
+        if cached is not None and cached[0] == policy:
+            return cached[1]
+        buckets = TenantBuckets(
+            policy.rps, policy.effective_burst(),
+            max_tenants=policy.max_tenants, clock=self.clock,
+        )
+        self._limiters[key] = (policy, buckets)
+        return buckets
+
+
+_edge_limiters: Optional[EdgeLimiters] = None
+
+
+def get_edge_limiters() -> EdgeLimiters:
+    global _edge_limiters
+    if _edge_limiters is None:
+        _edge_limiters = EdgeLimiters()
+    return _edge_limiters
+
+
+def edge_admit(
+    policy: QoSPolicy,
+    buckets: Optional[TenantBuckets],
+    tenant: str,
+    project: str = "",
+    run_name: str = "",
+    fault_point: Optional[str] = "routing.admit",
+    cost: float = 1.0,
+) -> Optional[int]:
+    """One admission decision at an HTTP edge → ``None`` when admitted,
+    else the integer ``Retry-After`` seconds for the 429.
+
+    The fault point (``routing.admit`` at the proxy/gateway edges,
+    ``serve.admit`` at the OpenAI server's) fires first so a chaos plan
+    can force the shed path (``action: raise, error: http:429``)
+    deterministically, independent of bucket state. ``fault_point=None``
+    skips the fire — the serve fan-out's extra-choice charge is a
+    second decision on a request whose ``serve.admit`` already fired,
+    and chaos plans count fires per HTTP request.
+
+    ``cost`` weights the bucket charge: an ``n``-choice fan-out is n
+    engine generations and must spend n tokens, not 1 — otherwise
+    ``n=8`` buys 8× a compliant tenant's decode budget. On admit the
+    counters advance by ``round(cost)`` (one per covered generation,
+    matching ``dtpu_serve_requests_total``'s per-choice accounting); a
+    shed is one rejected HTTP request and counts 1 regardless of
+    cost."""
+    if fault_point is not None:
+        try:
+            faults.fire(fault_point, tenant=tenant, run=run_name)
+        except faults.FaultError as e:
+            hint = max(1, int(math.ceil(getattr(e, "retry_after", None) or 1)))
+            _count_edge(tenant, project, run_name, admitted=False, retry_after=hint)
+            return hint
+    if not policy.enabled or buckets is None:
+        # no QoS configured: pass through WITHOUT counting — minting
+        # metrics series / RunEdgeStats for every un-policied run would
+        # exhaust the bounded _run_stats map and make `dtpu stats`
+        # print an admission line for services that have no QoS at all
+        return None
+    bucket = buckets.bucket(tenant)
+    if bucket.try_acquire(cost):
+        _count_edge(
+            tenant, project, run_name, admitted=True,
+            count=max(1, int(round(cost))),
+        )
+        return None
+    hint = max(1, int(math.ceil(bucket.retry_after(cost))))
+    _count_edge(tenant, project, run_name, admitted=False, retry_after=hint)
+    return hint
+
+
+def _count_edge(
+    tenant: str, project: str, run_name: str, admitted: bool,
+    retry_after: int = 0, count: int = 1,
+) -> None:
+    m = get_qos_registry()
+    if admitted:
+        m.family("dtpu_qos_admitted_total").inc(count, tenant)
+    else:
+        m.family("dtpu_qos_shed_total").inc(1, tenant)
+    if project or run_name:
+        record_edge(
+            project, run_name, admitted, retry_after=retry_after, tenant=tenant,
+            count=count,
+        )
+
+
+# ---------------------------------------------------------------------------
+# serve admission queue
+# ---------------------------------------------------------------------------
+
+
+class PriorityPending:
+    """Priority-ordered admission queue for the serve scheduler.
+
+    Items are popped best-first by ``(priority_class, arrival_seq)`` —
+    interactive ahead of standard ahead of batch, FIFO within a class.
+    ``pop_admissible`` skips (but keeps) items an admission predicate
+    rejects — the per-tenant in-flight cap — and silently drops items a
+    ``discard`` predicate matches (cancelled requests)."""
+
+    def __init__(self):
+        self._heap: list = []
+        self._seq = 0
+        self._event = asyncio.Event()
+
+    def push(self, item, priority: int) -> None:
+        heapq.heappush(self._heap, (int(priority), self._seq, item))
+        self._seq += 1
+        self._event.set()
+
+    def qsize(self) -> int:
+        return len(self._heap)
+
+    def empty(self) -> bool:
+        return not self._heap
+
+    def pop_admissible(
+        self,
+        admissible: Callable[[Any], bool],
+        discard: Optional[Callable[[Any], bool]] = None,
+    ):
+        """Best admissible item, or None. Skipped items keep their heap
+        position (and their arrival seq, so fairness within a class
+        survives the skip)."""
+        out = self.pop_admissible_many(1, admissible, discard)
+        return out[0] if out else None
+
+    def pop_admissible_many(
+        self,
+        limit: int,
+        admissible: Callable[[Any], bool],
+        discard: Optional[Callable[[Any], bool]] = None,
+    ) -> list:
+        """Up to ``limit`` best admissible items in ONE heap walk.
+
+        The serve tick admits a whole slot-batch through this: popping
+        per slot would re-walk (heappop + re-push) every cap-blocked
+        entry parked ahead of admissible work ONCE PER SLOT — an
+        abusive tenant's backlog would cost O(slots × backlog) heap
+        operations on the event loop each tick, during exactly the
+        flood QoS exists to absorb. One walk is O(backlog) per tick.
+
+        ``admissible`` runs once per surviving entry in priority order
+        and a True return ACCEPTS the item — a predicate tracking a
+        budget (the per-tenant in-flight caps) must charge it on
+        acceptance, since later entries are judged in the same walk.
+        Skipped items keep their heap position and arrival seq."""
+        kept: list = []
+        out: list = []
+        while self._heap and len(out) < limit:
+            entry = heapq.heappop(self._heap)
+            item = entry[2]
+            if discard is not None and discard(item):
+                continue
+            if admissible(item):
+                out.append(item)
+            else:
+                kept.append(entry)
+        for entry in kept:
+            heapq.heappush(self._heap, entry)
+        if not self._heap:
+            self._event.clear()
+        return out
+
+    def any_admissible(
+        self,
+        admissible: Callable[[Any], bool],
+        discard: Optional[Callable[[Any], bool]] = None,
+    ) -> bool:
+        """Early-exit scan (no heap mutation, no acceptance): does any
+        queued item pass ``admissible``? Feeds the engine's adaptive-
+        turbo hint — a cap-blocked tenant's parked backlog must not
+        read as arrival pressure and shrink every OTHER tenant's
+        macro-step."""
+        for entry in self._heap:
+            item = entry[2]
+            if discard is not None and discard(item):
+                continue
+            if admissible(item):
+                return True
+        return False
+
+    async def wait(self) -> None:
+        """Block until an item may be present (edge-triggered on push)."""
+        if self._heap:
+            return
+        self._event.clear()
+        await self._event.wait()
+
+
+# ---------------------------------------------------------------------------
+# scheduling-plane fair share
+# ---------------------------------------------------------------------------
+
+DEFAULT_RUN_PRIORITY = 50
+
+
+def select_jobs_fair_share(
+    rows: Iterable[dict],
+    limit: int,
+    deficits: Optional[Dict[str, float]] = None,
+) -> list:
+    """Deficit-style fair-share selection over submitted-job candidate
+    rows → the ordered id list one scheduling tick should process.
+
+    Rows carry ``id``, ``project_id``, ``priority`` (run priority,
+    higher first), and ``last_processed_at``. Selection is:
+
+    1. strict priority tiers — a higher-priority run's jobs always
+       schedule before a lower-priority run's;
+    2. inside a tier, round-robin across projects, projects ordered by
+       carried deficit (descending) then project id — one abusive
+       project submitting hundreds of jobs gets 1/N of the tier's
+       slots, not all of them;
+    3. inside a project, FIFO by ``(last_processed_at, id)`` — the id
+       tie-break makes equal timestamps deterministic (they are common:
+       a burst submit stamps many jobs in the same millisecond).
+
+    ``deficits`` carries under-service across ticks and is READ-ONLY
+    here (ordering input): selection is a proposal — the caller's
+    ``claim_batch`` may claim only a subset (concurrent passes hold
+    locks), and charging debts for jobs that were never actually
+    processed would punish the wrong project. Call
+    :func:`settle_fair_share` with the CLAIMED ids afterwards to apply
+    the debts/credits.
+    """
+    if deficits is None:
+        deficits = {}
+    deficits = dict(deficits)  # local working copy: no caller mutation
+
+    def _prio(r: dict) -> int:
+        p = r.get("priority")
+        # explicit None check: priority 0 is a VALID (lowest) class,
+        # `or` would silently promote it to the default
+        return DEFAULT_RUN_PRIORITY if p is None else int(p)
+
+    rows = sorted(
+        rows,
+        key=lambda r: (
+            -_prio(r),
+            str(r.get("last_processed_at") or ""),
+            str(r["id"]),
+        ),
+    )
+    selected: list = []
+    by_tier: Dict[int, Dict[str, list]] = {}
+    tier_order: list = []
+    for r in rows:
+        tier = _prio(r)
+        if tier not in by_tier:
+            by_tier[tier] = {}
+            tier_order.append(tier)
+        by_tier[tier].setdefault(str(r.get("project_id") or ""), []).append(r)
+    for tier in tier_order:  # already descending (rows sorted by -priority)
+        projects = by_tier[tier]
+        while projects and len(selected) < limit:
+            order = sorted(
+                projects, key=lambda p: (-deficits.get(p, 0.0), p)
+            )
+            for p in order:
+                if len(selected) >= limit:
+                    break
+                queue = projects.get(p)
+                if not queue:
+                    projects.pop(p, None)
+                    continue
+                selected.append(queue.pop(0)["id"])
+                deficits[p] = deficits.get(p, 0.0) - 1.0
+                # every OTHER project still waiting earns a credit
+                for q in projects:
+                    if q != p and projects[q]:
+                        deficits[q] = min(
+                            float(limit), deficits.get(q, 0.0) + 1.0 / max(
+                                1, len(order) - 1
+                            )
+                        )
+            for p in [p for p, q in projects.items() if not q]:
+                projects.pop(p)
+        if len(selected) >= limit:
+            break
+    return selected
+
+
+def settle_fair_share(
+    rows: Iterable[dict],
+    claimed: Iterable,
+    deficits: Dict[str, float],
+    limit: int,
+) -> None:
+    """Apply fair-share debts/credits for one scheduling tick, based on
+    what was actually CLAIMED (not merely selected): each project with
+    waiting candidates earns an equal share of the tick's claimed
+    capacity and pays for the claims it received. Net: served projects
+    owe, crowded-out projects bank credit for the next tick's ordering.
+    Deficits are clamped to ±limit so one starved epoch cannot bank
+    unbounded credit; zero entries are dropped."""
+    claimed = set(claimed)
+    if not claimed:
+        return  # nobody was served: all candidates are equally unserved
+    candidates_by_project: Dict[str, int] = {}
+    served: Dict[str, int] = {}
+    for r in rows:
+        p = str(r.get("project_id") or "")
+        candidates_by_project[p] = candidates_by_project.get(p, 0) + 1
+        if r["id"] in claimed:
+            served[p] = served.get(p, 0) + 1
+    if not candidates_by_project:
+        return
+    share = len(claimed) / len(candidates_by_project)
+    for p in candidates_by_project:
+        v = deficits.get(p, 0.0) + share - served.get(p, 0)
+        v = max(-float(limit), min(float(limit), v))
+        if v == 0.0:
+            deficits.pop(p, None)
+        else:
+            deficits[p] = v

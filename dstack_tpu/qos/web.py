@@ -1,0 +1,45 @@
+"""The HTTP half of edge admission, shared by the in-server proxy and
+the gateway agent (kept out of ``qos/__init__`` so the scheduler and
+serve planes stay aiohttp-free).
+
+One policy→buckets→:func:`qos.edge_admit`→429 sequence instead of a
+copy per edge: the shed body shape, the ``Retry-After`` contract
+(DTPU007), and the hint rounding evolve in exactly one place.
+"""
+
+from typing import Optional
+
+from aiohttp import web
+
+from dstack_tpu import qos
+
+
+def admit_or_shed(
+    spec: Optional[dict], tenant: str, project: str, run_name: str
+) -> Optional[web.Response]:
+    """Per-tenant token-bucket admission for one proxied request → a
+    429 with a monotone ``Retry-After``, or None when admitted.
+
+    ``spec`` is the service's raw ``qos`` block (parse it ONCE per
+    request — run specs are multi-KB JSON and this sits on the proxy
+    hot path); with none configured only the ``routing.admit`` fault
+    point can shed. Callers must gate on an EXISTING run: per-run stats
+    entries keyed by attacker-chosen names would exhaust the bounded
+    stats map.
+    """
+    policy = qos.QoSPolicy.from_spec(spec)
+    buckets = (
+        qos.get_edge_limiters().buckets_for(project, run_name, policy)
+        if policy.enabled
+        else None
+    )
+    hint = qos.edge_admit(
+        policy, buckets, tenant, project=project, run_name=run_name
+    )
+    if hint is None:
+        return None
+    return web.json_response(
+        {"detail": f"request budget for {run_name} exhausted; retry later"},
+        status=429,
+        headers={"Retry-After": str(hint)},
+    )

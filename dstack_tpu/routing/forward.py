@@ -27,8 +27,13 @@ logger = get_logger("routing.forward")
 # RFC 9110 hop-by-hop headers, plus the framing headers aiohttp manages
 # itself. content-encoding is dropped because the client session
 # auto-decompresses upstream bodies: re-advertising gzip over an
-# already-inflated stream would corrupt it.
-_DROP_REQUEST = frozenset({"host", "authorization", "transfer-encoding"})
+# already-inflated stream would corrupt it. x-dtpu-tenant is
+# proxy-asserted identity (QoS bucket key): a client-supplied value
+# must never pass through — the edge re-injects the authenticated one
+# via ``extra_headers``.
+_DROP_REQUEST = frozenset({
+    "host", "authorization", "transfer-encoding", "x-dtpu-tenant",
+})
 _DROP_RESPONSE = frozenset({
     "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
     "te", "trailers", "transfer-encoding", "upgrade",
@@ -87,12 +92,20 @@ async def forward_with_failover(
     session: aiohttp.ClientSession,
     path: str,
     max_attempts: Optional[int] = None,
+    extra_headers: Optional[dict] = None,
 ) -> web.StreamResponse:
     """Forward ``request`` to a pool replica, failing over across
-    replicas until one answers or the pool is exhausted."""
+    replicas until one answers or the pool is exhausted.
+
+    ``extra_headers`` lets the edge inject proxy-derived context the
+    client cannot be trusted to set itself — e.g. the authenticated
+    tenant identity (``X-DTPU-Tenant``) the replica's QoS layer keys
+    on; they override same-named client headers."""
     m = get_router_registry()
     body = await request.read()
     req_headers = filter_request_headers(request.headers)
+    if extra_headers:
+        req_headers.update(extra_headers)
     query = f"?{request.query_string}" if request.query_string else ""
     tried: set = set()
     limit = max_attempts if max_attempts is not None else max(1, pool.size())

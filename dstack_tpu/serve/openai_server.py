@@ -10,6 +10,14 @@ streaming), ``/v1/completions``, ``/health``. Requests queue into the
 continuous-batching engine; one background asyncio task drives
 prefills and decode steps for all in-flight requests (the jitted step
 runs in a thread so the event loop keeps serving).
+
+Multi-tenant QoS (``dstack_tpu.qos``): per-tenant token buckets shed
+over-budget tenants with 429 + ``Retry-After`` before any prompt work;
+admission to engine slots is priority-ordered (``X-DTPU-Priority``:
+interactive/standard/batch) with per-tenant in-flight caps so one
+flooding tenant can never hold every slot. Policy comes from
+``DTPU_QOS_*`` env (injected by the job configurator from the service
+spec's ``qos`` block) or the ``--qos-*`` flags.
 """
 
 import argparse
@@ -23,7 +31,9 @@ from typing import Optional
 
 from aiohttp import web
 
+from dstack_tpu import qos
 from dstack_tpu.proxy.model_tgi import DEFAULT_CHAT_TEMPLATE, render_chat
+from dstack_tpu.qos.metrics import get_qos_registry
 from dstack_tpu.serve.engine import GenParams, InferenceEngine
 from dstack_tpu.serve.tokenizer import Tokenizer, load_tokenizer
 from dstack_tpu.utils.logging import get_logger
@@ -32,9 +42,18 @@ logger = get_logger("serve.openai")
 
 
 class _Request:
-    def __init__(self, prompt_ids: list[int], gen: GenParams):
+    def __init__(
+        self,
+        prompt_ids: list[int],
+        gen: GenParams,
+        tenant: str = qos.ANONYMOUS_TENANT,
+        priority: int = qos.PRIORITY_STANDARD,
+    ):
         self.prompt_ids = prompt_ids
         self.gen = gen
+        self.tenant = tenant
+        self.priority = priority
+        self.cap_deferred = False  # counted once in inflight_deferred_total
         self.submitted_at: Optional[float] = None  # set by Scheduler.submit
         self.queue: asyncio.Queue = asyncio.Queue()  # token ids, then None
         self.error: Optional[str] = None
@@ -48,12 +67,24 @@ class _Request:
 class Scheduler:
     """Bridges HTTP handlers and the synchronous engine: a background
     task prefills pending requests into free slots and steps the engine
-    while anything is active."""
+    while anything is active.
 
-    def __init__(self, engine: InferenceEngine, tokenizer: Tokenizer):
+    Admission is priority-aware, not FIFO: pending requests pop by
+    (priority class, arrival order) and a per-tenant in-flight cap
+    (``tenant_inflight``) skips — but keeps queued — requests whose
+    tenant already holds its share of slots, so interactive traffic is
+    admitted ahead of batch and no tenant can occupy every slot."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        tokenizer: Tokenizer,
+        tenant_inflight: int = 0,
+    ):
         self.engine = engine
         self.tokenizer = tokenizer
-        self.pending: asyncio.Queue = asyncio.Queue()
+        self.pending = qos.PriorityPending()
+        self.tenant_inflight = max(0, int(tenant_inflight))  # 0 = off
         self.by_slot: dict[int, _Request] = {}
         self.by_prefill: dict[int, _Request] = {}  # chunked prefills in flight
         self._task: Optional[asyncio.Task] = None
@@ -71,7 +102,7 @@ class Scheduler:
     async def submit(self, req: _Request) -> None:
         req.submitted_at = time.perf_counter()
         self.engine.metrics.family("dtpu_serve_requests_total").inc(1)
-        await self.pending.put(req)
+        self.pending.push(req, req.priority)
 
     def cancel(self, req: _Request) -> None:
         """Client went away: free the slot so decode stops burning steps
@@ -85,6 +116,32 @@ class Scheduler:
             if r is req:
                 self.engine.release(slot)
                 del self.by_prefill[slot]
+
+    def _tenant_held_counts(self) -> dict:
+        """tenant → slots currently held (prefilling or decoding);
+        computed ONCE per tick and updated as admissions are granted —
+        a per-candidate rescan would be O(pending × inflight)."""
+        counts: dict = {}
+        for r in self.by_slot.values():
+            counts[r.tenant] = counts.get(r.tenant, 0) + 1
+        for r in self.by_prefill.values():
+            counts[r.tenant] = counts.get(r.tenant, 0) + 1
+        return counts
+
+    def _tenant_cap_ok(self, req: _Request, counts: dict) -> bool:
+        """Admission predicate against the tick's held-count snapshot.
+        The deferred counter ticks once per REQUEST (first time it
+        waits at the cap), not once per scheduler pass."""
+        if self.tenant_inflight <= 0:
+            return True
+        if counts.get(req.tenant, 0) < self.tenant_inflight:
+            return True
+        if not req.cap_deferred:
+            req.cap_deferred = True
+            get_qos_registry().family(
+                "dtpu_qos_inflight_deferred_total"
+            ).inc(1, req.tenant)
+        return False
 
     async def _loop(self) -> None:
         # the loop must survive ANY engine error (bad request shapes,
@@ -140,29 +197,62 @@ class Scheduler:
         return any(t in text for t in req.gen.stop)
 
     async def _tick(self) -> None:
-        # adaptive-turbo hint: requests parked for a slot shrink the
-        # engine's device-side macro-step so they are not stuck behind
-        # a full-K decode loop (engine._adaptive_turbo_cap)
-        self.engine.waiting_requests = self.pending.qsize()
-        # admit pending requests while slots are free (host bookkeeping
-        # only — the prompt prefills chunk by chunk below)
-        while not self.pending.empty() and self.engine.free_slots():
-            req = self.pending.get_nowait()
-            if req.cancelled:
-                continue
+        # admit pending requests into the free slots (host bookkeeping
+        # only — the prompt prefills chunk by chunk below) in ONE heap
+        # walk: priority-ordered, a tenant at its in-flight cap skipped
+        # (stays queued) so other tenants' requests take the slots. The
+        # accepting predicate charges `held` so a tenant cannot grab
+        # every slot of the batch (pop_admissible_many judges later
+        # entries in the same walk).
+        held = self._tenant_held_counts()
+
+        def _cap_and_charge(r: _Request) -> bool:
+            if not self._tenant_cap_ok(r, held):
+                return False
+            held[r.tenant] = held.get(r.tenant, 0) + 1
+            return True
+
+        free = len(self.engine.free_slots())
+        admitted = (
+            self.pending.pop_admissible_many(
+                free, _cap_and_charge, discard=lambda r: r.cancelled
+            )
+            if free
+            else []
+        )
+        # adaptive-turbo hint AFTER admission: only work that could
+        # still take a slot (not cap-blocked, not cancelled) counts as
+        # arrival pressure — a cap-blocked flood's parked backlog must
+        # not shrink the macro-step and tax every OTHER tenant's decode
+        # throughput (engine._adaptive_turbo_cap)
+        self.engine.waiting_requests = int(
+            self.pending.any_admissible(
+                lambda r: self._tenant_cap_ok(r, held),
+                discard=lambda r: r.cancelled,
+            )
+        )
+        for req in admitted:
             try:
                 slot = self.engine.start_request(req.prompt_ids, req.gen)
             except Exception as e:  # noqa: BLE001 - reported per request
                 logger.exception("admission failed: %s", e)
                 req.error = str(e)
                 req.queue.put_nowait(None)
+                # the walk charged `held` for this request; it holds no
+                # slot, but the one-tick overcount only defers a same-
+                # tenant sibling to the next tick (rare error path)
                 continue
             if req.submitted_at is not None:
                 # the saturation half of client-observed TTFT: the
                 # engine's dtpu_serve_ttft_seconds starts HERE
+                wait = time.perf_counter() - req.submitted_at
                 self.engine.metrics.family(
                     "dtpu_serve_queue_wait_seconds"
-                ).observe(time.perf_counter() - req.submitted_at)
+                ).observe(wait)
+                prio_label = qos.priority_class_name(req.priority)  # bounded enum
+                get_qos_registry().family(
+                    "dtpu_qos_queue_wait_seconds"
+                ).observe(wait, prio_label)
             self.by_prefill[slot] = req
 
         # ONE prefill dispatch per tick — a packed wave advancing up to
@@ -204,9 +294,11 @@ class Scheduler:
         if not self.by_slot:
             if self.by_prefill:
                 return  # keep chunking without blocking
-            # idle: wait for work instead of spinning
-            req = await self.pending.get()
-            await self.pending.put(req)
+            # idle: wait for work instead of spinning. With nothing in
+            # flight the tenant caps cannot defer anyone, so an empty
+            # by_slot/by_prefill here implies an empty queue — wait()
+            # parks until the next push.
+            await self.pending.wait()
             return
         out = await asyncio.to_thread(self.engine.step)
         for slot, toks in out.items():
@@ -513,10 +605,85 @@ def build_app(
     tokenizer: Tokenizer,
     model_name: str,
     chat_template: Optional[str] = None,
+    qos_policy: Optional[qos.QoSPolicy] = None,
 ) -> web.Application:
+    if qos_policy is None:
+        qos_policy = qos.QoSPolicy.from_env()
     app = web.Application()
-    sched = Scheduler(engine, tokenizer)
+    sched = Scheduler(
+        engine, tokenizer, tenant_inflight=qos_policy.tenant_inflight
+    )
     app["scheduler"] = sched
+    buckets = (
+        qos.TenantBuckets(
+            qos_policy.rps,
+            qos_policy.effective_burst(),
+            max_tenants=qos_policy.max_tenants,
+        )
+        if qos_policy.enabled
+        else None
+    )
+
+    def _admit(request) -> Optional[web.Response]:
+        """Tenant-bucket admission for one request → a 429 response
+        with a monotone ``Retry-After``, or None when admitted. Runs
+        before any tokenization/prefill so an over-budget tenant costs
+        nothing but this check."""
+        # trust_header: the tenant header reaching this process is
+        # proxy-asserted (the proxy/gateway strip client-supplied
+        # values and inject the authenticated identity)
+        tenant = qos.tenant_from_headers(request.headers, trust_header=True)
+        hint = qos.edge_admit(
+            qos_policy, buckets, tenant,
+            run_name=model_name, fault_point="serve.admit",
+        )
+        if hint is None:
+            return None
+        return web.json_response(
+            {"detail": "tenant request budget exhausted; retry later"},
+            status=429,
+            headers={"Retry-After": str(hint)},
+        )
+
+    def _admit_extra(request, extra: int) -> Optional[web.Response]:
+        """The fan-out charge: ``n`` choices are n engine generations,
+        but the pre-parse _admit spent one token. Charge the other n-1
+        (weighted try_acquire) once ``n`` is known, so ``n=8`` cannot
+        buy 8× a compliant tenant's decode budget for one token.
+
+        A shed REFUNDS the pre-parse token — sheds must stay free of
+        charge, or a compliant client retrying on the hint drains its
+        own budget and watches hints grow instead of shrink. With the
+        refund, the returned hint (deficit for ``extra`` pre-refund ==
+        deficit for the full ``n`` post-refund) is the full-cost wait,
+        so obeying it lands on n tokens — unless n can NEVER fit the
+        burst, which is a 400 (a 429's Retry-After would be a promise
+        no wait can keep), also refunded. ``serve.admit`` fires only
+        in _admit — one deterministic fire per HTTP request."""
+        if extra <= 0 or buckets is None or not qos_policy.enabled:
+            return None
+        tenant = qos.tenant_from_headers(request.headers, trust_header=True)
+        burst = qos_policy.effective_burst()
+        if 1 + extra > burst:
+            buckets.bucket(tenant).refund(1.0)
+            return web.json_response(
+                {"detail": f"'n' exceeds this service's request budget "
+                           f"(n tokens needed, burst is {int(burst)})"},
+                status=400,
+            )
+        hint = qos.edge_admit(
+            qos_policy, buckets, tenant, run_name=model_name,
+            fault_point=None, cost=float(extra),
+        )
+        if hint is None:
+            return None
+        buckets.bucket(tenant).refund(1.0)
+        return web.json_response(
+            {"detail": "tenant request budget exhausted for n choices; "
+                       "retry later"},
+            status=429,
+            headers={"Retry-After": str(hint)},
+        )
 
     async def on_startup(_):
         sched.start()
@@ -563,14 +730,26 @@ def build_app(
         e = sched.engine
         e.update_state_gauges()
         e.metrics.family("dtpu_serve_queue_depth").set(sched.pending.qsize())
+        # one page: engine families + this process's dtpu_qos_* edge
+        # counters (shed/admitted per tenant digest, queue wait by
+        # priority class) — the shim relay scrapes both together
         return web.Response(
-            text=e.metrics.render(), content_type="text/plain"
+            text=e.metrics.render() + get_qos_registry().render(),
+            content_type="text/plain",
         )
 
     import dataclasses as _dc
 
-    async def _run(prompt: str, payload: dict):
-        req = _Request(tokenizer.encode(prompt), _gen_params(payload, tokenizer))
+    async def _run(prompt: str, payload: dict, request):
+        req = _Request(
+            tokenizer.encode(prompt),
+            _gen_params(payload, tokenizer),
+            tenant=qos.tenant_from_headers(request.headers, trust_header=True),
+            priority=qos.parse_priority_class(
+                request.headers.get(qos.PRIORITY_HEADER)
+                or payload.get("priority")
+            ),
+        )
         await sched.submit(req)
         return req
 
@@ -612,7 +791,10 @@ def build_app(
             gen = _dc.replace(first_req.gen)
             if gen.seed is not None:
                 gen.seed += i  # distinct deterministic stream per choice
-            req = _Request(list(first_req.prompt_ids), gen)
+            req = _Request(
+                list(first_req.prompt_ids), gen,
+                tenant=first_req.tenant, priority=first_req.priority,
+            )
             await sched.submit(req)
             reqs.append(req)
         id_lists = await asyncio.gather(*(_collect(r) for r in reqs))
@@ -625,6 +807,9 @@ def build_app(
     async def chat_completions(request):
         from dstack_tpu.proxy.model_tgi import TGIAdapterError
 
+        shed = _admit(request)
+        if shed is not None:
+            return shed
         try:
             payload = await request.json()
         except Exception:
@@ -696,7 +881,10 @@ def build_app(
         n = _n_choices(payload)
         if not isinstance(n, int):
             return n
-        req = await _run(prompt, payload)
+        shed = _admit_extra(request, n - 1)
+        if shed is not None:
+            return shed
+        req = await _run(prompt, payload, request)
         completion_id = f"chatcmpl-{uuid.uuid4().hex}"
         created = int(time.time())
         if payload.get("stream"):
@@ -868,6 +1056,9 @@ def build_app(
         )
 
     async def completions(request):
+        shed = _admit(request)
+        if shed is not None:
+            return shed
         try:
             payload = await request.json()
         except Exception:
@@ -881,7 +1072,10 @@ def build_app(
         n = _n_choices(payload)
         if not isinstance(n, int):
             return n
-        first = await _run(prompt, payload)
+        shed = _admit_extra(request, n - 1)
+        if shed is not None:
+            return shed
+        first = await _run(prompt, payload, request)
         fanned = await _fan_out(first, n)
         if not isinstance(fanned, tuple):
             return fanned
@@ -941,6 +1135,9 @@ def build_app(
         return _jax.jit(fn)
 
     async def embeddings(request):
+        shed = _admit(request)
+        if shed is not None:
+            return shed
         try:
             payload = await request.json()
         except Exception:
@@ -1123,6 +1320,21 @@ def main(argv=None) -> int:
         help="disable automatic prefix caching (KV-row reuse across "
              "requests sharing a chunk-aligned prompt prefix)",
     )
+    p.add_argument(
+        "--qos-rps", type=float, default=None,
+        help="per-tenant sustained requests/second; over-budget tenants "
+             "get 429 + Retry-After (default: DTPU_QOS_RPS env, 0 = off)",
+    )
+    p.add_argument(
+        "--qos-burst", type=float, default=None,
+        help="per-tenant bucket capacity (default: DTPU_QOS_BURST env, "
+             "0 = 2x rps)",
+    )
+    p.add_argument(
+        "--qos-tenant-inflight", type=int, default=None,
+        help="max engine slots one tenant may hold concurrently "
+             "(default: DTPU_QOS_TENANT_INFLIGHT env, 0 = off)",
+    )
     args = p.parse_args(argv)
 
     from dstack_tpu.utils.logging import configure_logging
@@ -1234,7 +1446,27 @@ def main(argv=None) -> int:
     tokenizer = load_tokenizer(args.tokenizer or "byte")
     if not args.no_warmup:
         _warmup_engine(engine)
-    app = build_app(engine, tokenizer, args.model, args.chat_template)
+    env_policy = qos.QoSPolicy.from_env()
+    qos_policy = qos.QoSPolicy(
+        rps=env_policy.rps if args.qos_rps is None else args.qos_rps,
+        burst=env_policy.burst if args.qos_burst is None else args.qos_burst,
+        tenant_inflight=(
+            env_policy.tenant_inflight
+            if args.qos_tenant_inflight is None
+            else args.qos_tenant_inflight
+        ),
+        max_tenants=env_policy.max_tenants,
+    )
+    if qos_policy.enabled or qos_policy.tenant_inflight:
+        logger.info(
+            "qos: %.3g rps/tenant (burst %.3g), tenant inflight cap %d",
+            qos_policy.rps, qos_policy.effective_burst(),
+            qos_policy.tenant_inflight,
+        )
+    app = build_app(
+        engine, tokenizer, args.model, args.chat_template,
+        qos_policy=qos_policy,
+    )
     logger.info("openai server: %s on :%d", args.model, args.port)
     web.run_app(app, host="0.0.0.0", port=args.port, print=None)
     return 0

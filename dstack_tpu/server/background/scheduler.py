@@ -7,6 +7,7 @@ logged and swallowed (a failing tick must not kill the loop).
 """
 
 import asyncio
+import os
 import random
 from typing import Awaitable, Callable, Optional
 
@@ -16,11 +17,24 @@ from dstack_tpu.utils.logging import get_logger
 logger = get_logger("server.background")
 
 
+def _tick_scale() -> float:
+    """``DTPU_BG_TICK_SCALE`` multiplies every loop interval — the
+    chaos e2e suite sets it below 1 so the real control plane converges
+    on a fast clock instead of waiting out production cadences
+    (documented in docs/reference/testing.md)."""
+    try:
+        scale = float(os.getenv("DTPU_BG_TICK_SCALE", "") or 1.0)
+    except ValueError:
+        return 1.0
+    return scale if scale > 0 else 1.0
+
+
 class BackgroundScheduler:
     def __init__(self) -> None:
         self._jobs: list[tuple[str, Callable[[], Awaitable], float, float]] = []
         self._tasks: list[asyncio.Task] = []
         self._stopped = asyncio.Event()
+        self._scale = _tick_scale()
 
     def add(
         self,
@@ -29,7 +43,7 @@ class BackgroundScheduler:
         name: Optional[str] = None,
         jitter: float = 0.2,
     ) -> None:
-        self._jobs.append((name or fn.__name__, fn, interval, jitter))
+        self._jobs.append((name or fn.__name__, fn, interval * self._scale, jitter))
 
     async def _loop(self, name: str, fn, interval: float, jitter: float) -> None:
         # initial stagger so loops don't fire in lockstep

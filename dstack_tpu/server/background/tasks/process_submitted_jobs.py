@@ -9,8 +9,19 @@ TPU-first: a multi-host slice provisions **atomically** as one instance;
 jobs 1..N-1 of the replica attach to workers of the master job's slice
 instead of provisioning their own VMs (slice-level rethink of the
 reference's master-job dance, SURVEY.md §7).
+
+Multi-tenant QoS: the tick's candidate set is no longer a bare
+``ORDER BY last_processed_at`` — jobs are selected by run priority
+(strict tiers), then deficit-style fair share across projects, then
+FIFO with a deterministic id tie-break (``qos.select_jobs_fair_share``).
+A higher-priority run that finds no capacity may *preempt* a strictly
+lower-priority batch run: the victim terminates
+``INTERRUPTED_BY_NO_CAPACITY`` (resubmitted by ``process_runs`` when
+its retry policy covers interruption) and the preemptor requeues until
+the freed instance reaches the pool.
 """
 
+import time
 from typing import Optional
 
 from dstack_tpu.core.models.backends import BackendType
@@ -40,11 +51,66 @@ from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("server.process_submitted_jobs")
 
+# fair-share deficit carried across ticks: a project crowded out of one
+# tick's batch goes first in the next (per-process state, like the
+# autoscaler's _last_scaled — a restart forgets debts, not correctness)
+_fair_deficits: dict = {}
+
+# preemptors waiting for their victim's instance to drain back to the
+# pool: job_id -> monotonic deadline. While waiting, a no-capacity pass
+# REQUEUES the job instead of failing it; past the deadline the normal
+# no-capacity failure applies (the freed capacity never materialized).
+_preempt_wait: dict = {}
+PREEMPT_WAIT_SECONDS = 300.0
+
+# victim job ids with a preemption commit in flight: up to 4
+# _process_job coroutines run under one gather, and _try_preempt has
+# await points between its victim SELECT and the TERMINATING commit —
+# without this guard two concurrent preemptors can pick the SAME
+# RUNNING victim (double transition, double metrics, and the loser
+# banks a 300s wait window against capacity that never frees for it).
+# Membership check + add happen with no await in between, so the
+# cooperative scheduler makes the claim atomic; the claim holder
+# re-reads the victim's status before committing (a stale SELECT row
+# may predate a sibling's completed commit), and entries leave the set
+# once the commit lands or fails (failure is retryable; success makes
+# the victim non-RUNNING so no later SELECT returns it).
+_preempt_inflight: set = set()
+
 
 async def process_submitted_jobs(db: Database) -> None:
+    # prune ORPHANED preempt-wait entries: a waiting preemptor that left
+    # SUBMITTED by a path other than _assign/_fail (user stop, run
+    # termination) would otherwise pin its {job_id: deadline} entry in
+    # the module-global forever. Entries whose job is still SUBMITTED are
+    # kept even past the deadline — _no_capacity owns that expiry (pop,
+    # then one more preemption attempt before failing); pruning them
+    # here would disarm the one-victim-per-window guard and let a
+    # starved preemptor kill a fresh victim every tick
+    now = time.monotonic()
+    for jid in [j for j, d in _preempt_wait.items() if d < now]:
+        job = await db.get_by_id("jobs", jid)
+        if job is None or job["status"] != JobStatus.SUBMITTED.value:
+            _preempt_wait.pop(jid, None)
+    # over-fetch the candidate pool (not just one batch's worth) so the
+    # fair-share pass has alternatives to pick from when one project
+    # floods the queue. The window itself is priority-FIRST: a flood of
+    # low-priority jobs must not push a newly-submitted high-priority
+    # job out of the LIMIT — tiers have to hold against the exact
+    # backlog this layer exists for. Tie-break by id makes equal
+    # timestamps (burst submits stamp many rows in the same
+    # millisecond) deterministic.
     rows = await db.fetchall(
-        "SELECT id FROM jobs WHERE status = ? ORDER BY last_processed_at ASC LIMIT ?",
-        (JobStatus.SUBMITTED.value, settings.MAX_PROCESSING_JOBS),
+        "SELECT j.id AS id, j.project_id AS project_id, "
+        "j.last_processed_at AS last_processed_at, r.priority AS priority "
+        "FROM jobs j JOIN runs r ON j.run_id = r.id WHERE j.status = ? "
+        "ORDER BY r.priority DESC, j.last_processed_at ASC, j.id ASC LIMIT ?",
+        (JobStatus.SUBMITTED.value, settings.MAX_PROCESSING_JOBS * 4),
+    )
+    from dstack_tpu.qos import select_jobs_fair_share, settle_fair_share
+
+    candidates = select_jobs_fair_share(
+        rows, settings.MAX_PROCESSING_JOBS, _fair_deficits
     )
     # bounded burst: scheduling is the one loop where rows CONTEND
     # (two jobs may want the same pool instance — the loser falls
@@ -53,8 +119,14 @@ async def process_submitted_jobs(db: Database) -> None:
     import asyncio
 
     async with db.claim_batch(
-        "jobs", [r["id"] for r in rows], min(4, settings.MAX_PROCESSING_JOBS)
+        "jobs", candidates, min(4, settings.MAX_PROCESSING_JOBS)
     ) as job_ids:
+        # debts/credits are settled against what was actually CLAIMED —
+        # a concurrent pass holding locks must not make a project pay
+        # for service it never received
+        settle_fair_share(
+            rows, job_ids, _fair_deficits, settings.MAX_PROCESSING_JOBS
+        )
         if not job_ids:
             return
         results = await asyncio.gather(
@@ -177,7 +249,11 @@ async def _process_job(db: Database, job_id: str) -> None:
         return
 
     if profile.creation_policy == CreationPolicy.REUSE:
-        await _fail_no_capacity(db, job_row, "no idle instance and creation_policy=reuse")
+        await _no_capacity(
+            db, job_row, run_row, requirements,
+            "no idle instance and creation_policy=reuse",
+            volume_regions=volume_regions,
+        )
         return
 
     # Phase 2: provision
@@ -192,7 +268,10 @@ async def _process_job(db: Database, job_id: str) -> None:
         and (not volume_regions or o.region in volume_regions)
     ][: settings.MAX_OFFERS_TRIED]
     if not offers:
-        await _fail_no_capacity(db, job_row, "no matching offers")
+        await _no_capacity(
+            db, job_row, run_row, requirements, "no matching offers",
+            volume_regions=volume_regions,
+        )
         return
 
     fleet_id = await _get_or_create_run_fleet(db, run_row, project_row, run_spec)
@@ -264,7 +343,10 @@ async def _process_job(db: Database, job_id: str) -> None:
             offer.price,
         )
         return
-    await _fail_no_capacity(db, job_row, "all offers failed to provision")
+    await _no_capacity(
+        db, job_row, run_row, requirements, "all offers failed to provision",
+        volume_regions=volume_regions,
+    )
 
 
 async def _attach_volumes_to_reused(
@@ -566,6 +648,7 @@ async def _get_or_create_run_fleet(
 async def _assign(
     db: Database, job_row: dict, instance_id: str, jpd: dict, worker_id: int
 ) -> None:
+    _preempt_wait.pop(job_row["id"], None)  # capacity arrived
     if isinstance(jpd, dict):
         jpd = dict(jpd)
         jpd["worker_id"] = worker_id
@@ -588,6 +671,152 @@ async def _assign(
     )
 
 
+async def _no_capacity(
+    db: Database, job_row: dict, run_row: dict, requirements, message: str,
+    volume_regions: Optional[set] = None,
+) -> None:
+    """No-capacity outcome for a replica's master job: try priority
+    preemption first; while a preempted victim is still draining its
+    instance back to the pool, requeue instead of failing.
+
+    A wait window that closes WITHOUT this job landing capacity ends
+    the episode and allows one more preemption attempt before the
+    normal no-capacity failure: the freed instance may have been
+    claimed by a concurrent (possibly lower-priority) job racing the
+    same pool — hard-failing here would mean the victim died for
+    nothing while the preemptor, still the highest-priority waiter,
+    gives up. The kill rate stays bounded at one victim per
+    ``PREEMPT_WAIT_SECONDS`` per preemptor."""
+    deadline = _preempt_wait.get(job_row["id"])
+    if deadline is not None and time.monotonic() >= deadline:
+        _preempt_wait.pop(job_row["id"], None)
+        deadline = None
+    if deadline is not None:
+        # inside the wait window: the victim's instance hasn't reached
+        # the pool yet — requeue rather than failing a job we just
+        # made room for (one victim per episode: no new preemption)
+        await db.update_by_id(
+            "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+        )
+        return
+    if await _try_preempt(db, job_row, run_row, requirements, volume_regions):
+        _preempt_wait[job_row["id"]] = time.monotonic() + PREEMPT_WAIT_SECONDS
+        await db.update_by_id(
+            "jobs", job_row["id"], {"last_processed_at": now_utc().isoformat()}
+        )
+        return
+    await _fail_no_capacity(db, job_row, message)
+
+
+def _retry_window_open(retry: dict, run_submitted_at: str) -> bool:
+    """Mirror of ``process_runs._maybe_retry``'s duration gate: a retry
+    policy with an elapsed ``duration`` will refuse to resubmit."""
+    duration = retry.get("duration")
+    if duration is None:
+        return True
+    from datetime import datetime, timedelta
+
+    try:
+        submitted = datetime.fromisoformat(run_submitted_at)
+        return now_utc() - submitted <= timedelta(seconds=int(duration))
+    except (TypeError, ValueError):
+        return False  # can't prove the victim would come back: spare it
+
+
+async def _try_preempt(
+    db: Database, job_row: dict, run_row: dict, requirements,
+    volume_regions: Optional[set] = None,
+) -> bool:
+    """Preempt one strictly-lower-priority batch job whose instance can
+    host this job. The victim terminates ``INTERRUPTED_BY_NO_CAPACITY``
+    — exactly what a spot reclaim produces — so ``process_runs``
+    resubmits it under ``retry: on-interruption`` and it reschedules
+    once capacity returns. Services and dev environments are never
+    preempted (interactive state does not survive an interruption the
+    way a checkpointed batch job does)."""
+    from dstack_tpu.qos import DEFAULT_RUN_PRIORITY
+    from dstack_tpu.qos.metrics import get_qos_registry
+
+    prio = run_row.get("priority")
+    prio = DEFAULT_RUN_PRIORITY if prio is None else int(prio)
+    if job_row["id"] in _preempt_wait:
+        return False  # one victim per no-capacity episode
+    victims = await db.fetchall(
+        "SELECT j.*, r.priority AS run_priority, r.run_spec AS victim_run_spec, "
+        "r.submitted_at AS run_submitted_at "
+        "FROM jobs j JOIN runs r ON j.run_id = r.id "
+        "WHERE j.project_id = ? AND j.status = ? AND r.priority < ? "
+        "AND j.instance_id IS NOT NULL "
+        "ORDER BY r.priority ASC, j.submitted_at DESC, j.id ASC",
+        (run_row["project_id"], JobStatus.RUNNING.value, prio),
+    )
+    for victim in victims:
+        conf = (loads(victim["victim_run_spec"]) or {}).get("configuration", {})
+        if conf.get("type") != "task":
+            continue
+        retry = (loads(victim["job_spec"]) or {}).get("retry") or {}
+        if "interruption" not in (retry.get("on_events") or []):
+            # preemption relies on the retry-on-interruption machinery
+            # to resubmit the victim; killing a job that would NOT come
+            # back is destruction, not scheduling
+            continue
+        if not _retry_window_open(retry, victim["run_submitted_at"]):
+            # retry.duration already elapsed: process_runs._maybe_retry
+            # would refuse the resubmission, so preempting this victim
+            # is the same destruction the on_events check guards against
+            continue
+        inst = await db.get_by_id("instances", victim["instance_id"])
+        if inst is None or inst.get("deleted"):
+            continue
+        if not instances_service.instance_matches_requirements(inst, requirements):
+            continue
+        if volume_regions and inst.get("region") not in volume_regions:
+            # the preemptor's volumes pin it to specific regions — an
+            # instance it can never attach to is not capacity for it,
+            # and killing its tenant would free nothing usable
+            continue
+        # claim the victim against concurrent preemptors in this gather
+        # (no await between check and add — see _preempt_inflight),
+        # then re-read its status under the claim: our SELECT row is
+        # stale across the awaits above, and a sibling that already
+        # COMMITTED against this victim has left the set again
+        if victim["id"] in _preempt_inflight:
+            continue
+        _preempt_inflight.add(victim["id"])
+        try:
+            current = await db.get_by_id("jobs", victim["id"])
+            if current is None or current["status"] != JobStatus.RUNNING.value:
+                continue
+            await jobs_service.update_job_status(
+                db,
+                victim["id"],
+                JobStatus.TERMINATING,
+                termination_reason=JobTerminationReason.INTERRUPTED_BY_NO_CAPACITY,
+                termination_reason_message=(
+                    f"preempted by higher-priority run {run_row['run_name']} "
+                    f"(priority {prio} > {victim['run_priority']})"
+                ),
+                run_id=victim["run_id"],
+            )
+        finally:
+            _preempt_inflight.discard(victim["id"])
+        from dstack_tpu.server.services.run_events import record_run_event
+
+        await record_run_event(
+            db, victim["run_id"], "preempted",
+            job_id=victim["id"],
+            details=f"by {run_row['run_name']} (priority {prio})",
+        )
+        get_qos_registry().family("dtpu_qos_preempted_jobs_total").inc(1)
+        logger.info(
+            "job %s (priority %s) preempts %s (priority %s) on instance %s",
+            job_row["job_name"], prio, victim["job_name"],
+            victim["run_priority"], inst["name"],
+        )
+        return True
+    return False
+
+
 async def _fail_no_capacity(db: Database, job_row: dict, message: str) -> None:
     await _fail(
         db, job_row, JobTerminationReason.FAILED_TO_START_DUE_TO_NO_CAPACITY, message
@@ -597,6 +826,7 @@ async def _fail_no_capacity(db: Database, job_row: dict, message: str) -> None:
 async def _fail(
     db: Database, job_row: dict, reason: JobTerminationReason, message: str
 ) -> None:
+    _preempt_wait.pop(job_row["id"], None)  # no longer waiting on capacity
     logger.info("job %s: %s (%s)", job_row["job_name"], reason.value, message)
     await jobs_service.update_job_status(
         db,

@@ -257,4 +257,14 @@ CREATE TABLE run_events (
 CREATE INDEX idx_run_events_run ON run_events(run_id, timestamp);
 """,
     ),
+    (
+        # multi-tenant QoS: scheduling priority class per run (0..100,
+        # default 50) — process_submitted_jobs orders its fair-share
+        # pass by it and higher-priority runs may preempt lower-priority
+        # batch runs for capacity
+        "0004_run_priority",
+        """
+ALTER TABLE runs ADD COLUMN priority INTEGER NOT NULL DEFAULT 50;
+""",
+    ),
 ]

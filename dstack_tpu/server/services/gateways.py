@@ -287,6 +287,9 @@ async def register_service(
         "model_name": model.get("name"),
         "model_prefix": model.get("prefix", "/v1"),
         "https": bool(gw_conf.get("certificate")) and conf.get("https", True),
+        # per-tenant admission policy: the gateway enforces the same
+        # qos block the in-server proxy reads from the run spec
+        "qos": conf.get("qos"),
     }
     resp = await call_agent(
         gateway_row, "POST", "/api/registry/services/register", body

@@ -120,6 +120,34 @@ async def get_pool_instances(
     return await db.fetchall(sql, params)
 
 
+def instance_matches_requirements(row: dict, requirements) -> bool:
+    """Resource fit of one instance row against a job's requirements —
+    shared by the idle-reuse filter and the scheduler's preemption pass
+    (which evaluates BUSY instances a victim job would free)."""
+    offer = loads(row.get("offer"))
+    if offer is None:
+        return False
+    res = offer["instance"]["resources"]
+    spec = requirements.resources
+    if spec.cpu.count.min is not None and res["cpus"] < spec.cpu.count.min:
+        return False
+    if spec.memory.min is not None and res["memory_mib"] / 1024 < spec.memory.min:
+        return False
+    tpu = res.get("tpu")
+    if spec.tpu is not None:
+        if tpu is None:
+            return False
+        if spec.tpu.version is not None and tpu["version"] not in spec.tpu.version:
+            return False
+        if not spec.tpu.chips.contains(tpu["chips"]):
+            return False
+        if spec.tpu.topology is not None and tpu["topology"] != spec.tpu.topology:
+            return False
+    elif tpu is not None:
+        return False  # don't waste TPU slices on CPU jobs
+    return True
+
+
 def filter_pool_instances(
     rows: list[dict],
     offer_backend: Optional[BackendType] = None,
@@ -137,28 +165,10 @@ def filter_pool_instances(
             continue
         if fleet_id is not None and row.get("fleet_id") != fleet_id:
             continue
-        if requirements is not None:
-            offer = loads(row.get("offer"))
-            if offer is None:
-                continue
-            res = offer["instance"]["resources"]
-            spec = requirements.resources
-            if spec.cpu.count.min is not None and res["cpus"] < spec.cpu.count.min:
-                continue
-            if spec.memory.min is not None and res["memory_mib"] / 1024 < spec.memory.min:
-                continue
-            tpu = res.get("tpu")
-            if spec.tpu is not None:
-                if tpu is None:
-                    continue
-                if spec.tpu.version is not None and tpu["version"] not in spec.tpu.version:
-                    continue
-                if not spec.tpu.chips.contains(tpu["chips"]):
-                    continue
-                if spec.tpu.topology is not None and tpu["topology"] != spec.tpu.topology:
-                    continue
-            elif tpu is not None:
-                continue  # don't waste TPU slices on CPU jobs
+        if requirements is not None and not instance_matches_requirements(
+            row, requirements
+        ):
+            continue
         out.append(row)
     out.sort(key=lambda r: r.get("price") or 0.0)
     return out

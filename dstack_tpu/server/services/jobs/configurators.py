@@ -147,25 +147,33 @@ def get_job_specs_from_run_spec(run_spec: RunSpec, replica_num: int = 0) -> list
             for job_num in range(nodes)
         ]
     if isinstance(conf, ServiceConfiguration):
-        return [
-            _base_spec(
-                run_spec,
-                job_name=f"{run_name}-{replica_num}-0",
-                replica_num=replica_num,
-                job_num=0,
-                jobs_per_replica=1,
-                ssh_key=None,
-                commands=list(conf.commands),
-                service_port=conf.port.container_port,
-                app_specs=[
-                    AppSpec(
-                        port=conf.port.container_port,
-                        map_to_port=conf.port.local_port,
-                        app_name="service",
-                    )
-                ],
-            )
-        ]
+        spec = _base_spec(
+            run_spec,
+            job_name=f"{run_name}-{replica_num}-0",
+            replica_num=replica_num,
+            job_num=0,
+            jobs_per_replica=1,
+            ssh_key=None,
+            commands=list(conf.commands),
+            service_port=conf.port.container_port,
+            app_specs=[
+                AppSpec(
+                    port=conf.port.container_port,
+                    map_to_port=conf.port.local_port,
+                    app_name="service",
+                )
+            ],
+        )
+        if conf.qos is not None:
+            # render the spec's qos block as DTPU_QOS_* env so the
+            # replica process (the in-repo OpenAI server, or anything
+            # reading the same contract) enforces the engine-side half
+            # of the policy; explicit user env wins
+            from dstack_tpu.qos import QoSPolicy
+
+            qos_env = QoSPolicy.from_spec(conf.qos.model_dump()).env()
+            spec.env = {**qos_env, **spec.env}
+        return [spec]
     if isinstance(conf, DevEnvironmentConfiguration):
         commands = list(conf.init) + ["tail -f /dev/null"]
         return [

@@ -71,6 +71,11 @@ async def render_metrics(db: Database) -> str:
     from dstack_tpu.utils.retry import get_retry_registry
 
     w.raw(get_retry_registry().render())
+    # QoS edge (dtpu_qos_admitted/shed per tenant digest through the
+    # in-server proxy, scheduler preemptions)
+    from dstack_tpu.qos.metrics import get_qos_registry
+
+    w.raw(get_qos_registry().render())
     return w.render()
 
 

@@ -91,4 +91,59 @@ async def get_run_timeline(db: Database, run_row: dict) -> dict:
         "submitted_at": run_row["submitted_at"],
         "events": events,
         "total_s": total,
+        "qos": await _run_qos_summary(db, run_row),
     }
+
+
+async def _run_qos_summary(db: Database, run_row: dict) -> Optional[dict]:
+    """Why requests to this run were (not) served: edge admission
+    counts from the in-server proxy's QoS layer plus queue-wait and
+    engine-side shed totals scraped from the replicas' own /metrics
+    (the job_prometheus_metrics relay) — so ``dtpu stats`` answers
+    "was my request rejected, and where did it wait" without grepping
+    three Prometheus surfaces. None when the run has no QoS signal at
+    all (keeps old timelines byte-identical)."""
+    import re
+
+    from dstack_tpu import qos as qos_mod
+
+    project_row = await db.get_by_id("projects", run_row["project_id"])
+    project_name = project_row["name"] if project_row else ""
+    out: dict = {}
+    edge = qos_mod.run_edge_snapshot(project_name, run_row["run_name"])
+    if edge is not None:
+        out["edge"] = edge
+    # replica-side signal: the prometheus relay stores each job's last
+    # scraped /metrics page; histogram sum/count give mean queue wait
+    rows = await db.fetchall(
+        "SELECT m.text FROM job_prometheus_metrics m JOIN jobs j ON m.job_id = j.id "
+        "WHERE j.run_id = ?",
+        (run_row["id"],),
+    )
+    qw_sum = qw_count = 0.0
+    shed = admitted = 0.0
+    for r in rows:
+        text = r["text"] or ""
+        for m in re.finditer(
+            r"^dtpu_serve_queue_wait_seconds_(sum|count)(?:\{[^}]*\})? ([0-9.e+-]+)$",
+            text, re.M,
+        ):
+            if m.group(1) == "sum":
+                qw_sum += float(m.group(2))
+            else:
+                qw_count += float(m.group(2))
+        for m in re.finditer(
+            r"^dtpu_qos_(shed|admitted)_total(?:\{[^}]*\})? ([0-9.e+-]+)$",
+            text, re.M,
+        ):
+            if m.group(1) == "shed":
+                shed += float(m.group(2))
+            else:
+                admitted += float(m.group(2))
+    if qw_count:
+        out["replica_queue_wait_mean_s"] = round(qw_sum / qw_count, 4)
+        out["replica_queue_waits"] = int(qw_count)
+    if shed or admitted:
+        out["replica_shed"] = int(shed)
+        out["replica_admitted"] = int(admitted)
+    return out or None

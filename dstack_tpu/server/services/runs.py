@@ -261,6 +261,15 @@ def _prepare_run_spec(run_spec: RunSpec) -> RunSpec:
     return run_spec
 
 
+def _run_priority(run_spec: RunSpec) -> int:
+    """Effective scheduling priority of a run (0..100; validated at the
+    configuration model, defaulted here so the column is always set)."""
+    from dstack_tpu.qos import DEFAULT_RUN_PRIORITY
+
+    p = getattr(run_spec.configuration, "priority", None)
+    return DEFAULT_RUN_PRIORITY if p is None else int(p)
+
+
 def _desired_replica_count(run_spec: RunSpec) -> int:
     conf = run_spec.configuration
     if isinstance(conf, ServiceConfiguration):
@@ -328,6 +337,7 @@ async def submit_run(
         "status": RunStatus.SUBMITTED.value,
         "run_spec": dumps(run_spec),
         "service_spec": dumps(service_spec) if service_spec else None,
+        "priority": _run_priority(run_spec),
         "desired_replica_count": _desired_replica_count(run_spec),
         "deleted": 0,
         "submitted_at": now_utc().isoformat(),

@@ -50,11 +50,17 @@ async def _wait_run(client, token, run_name, targets, timeout=150.0):
         run = await r.json()
         if run.get("status") in targets:
             return run
-        await asyncio.sleep(0.5)
+        await asyncio.sleep(0.15)
     raise TimeoutError(f"run {run_name} stuck in {run and run.get('status')}")
 
 
-async def _local_stack(tmp_path):
+async def _local_stack(tmp_path, monkeypatch):
+    # run the reconcilers on a fast clock: these tests wait out several
+    # full submit→provision→run→terminate→retry cycles, and at
+    # production cadences (1-2s per tick) each cycle is mostly idle
+    # waiting. The invariants under test are ordering/idempotency, not
+    # wall-clock intervals.
+    monkeypatch.setenv("DTPU_BG_TICK_SCALE", "0.3")
     set_log_storage(FileLogStorage(Path(tmp_path) / "logs"))
     app = await create_app(
         database_url="sqlite://:memory:",
@@ -67,9 +73,33 @@ async def _local_stack(tmp_path):
     return client, app
 
 
+class TestTickScale:
+    def test_scale_multiplies_registered_intervals(self, monkeypatch):
+        from dstack_tpu.server.background.scheduler import BackgroundScheduler
+
+        monkeypatch.setenv("DTPU_BG_TICK_SCALE", "0.5")
+        sched = BackgroundScheduler()
+
+        async def tick():
+            pass
+
+        sched.add(tick, 2.0, "t")
+        assert sched._jobs[0][2] == 1.0
+
+    def test_bad_or_nonpositive_scale_falls_back_to_1(self, monkeypatch):
+        from dstack_tpu.server.background.scheduler import _tick_scale
+
+        monkeypatch.setenv("DTPU_BG_TICK_SCALE", "not-a-float")
+        assert _tick_scale() == 1.0
+        monkeypatch.setenv("DTPU_BG_TICK_SCALE", "0")
+        assert _tick_scale() == 1.0
+        monkeypatch.delenv("DTPU_BG_TICK_SCALE")
+        assert _tick_scale() == 1.0
+
+
 class TestPreemptionSurfacesImmediately:
     async def test_injected_preemption_interrupts_and_retries(
-        self, tmp_path, fault_plan
+        self, tmp_path, fault_plan, monkeypatch
     ):
         """Full stack: a RUNNING job loses its runner (injected connect
         errors on agent.pull) while the shim's healthcheck carries an
@@ -77,7 +107,7 @@ class TestPreemptionSurfacesImmediately:
         INTERRUPTED_BY_NO_CAPACITY on the FIRST failed poll (no 120s
         unreachable budget), the retry policy covering `interruption`
         resubmits it, and the retried submission completes the run."""
-        client, app = await _local_stack(tmp_path)
+        client, app = await _local_stack(tmp_path, monkeypatch)
         db = app["state"]["db"]
         try:
             body = {
@@ -88,7 +118,7 @@ class TestPreemptionSurfacesImmediately:
                         # long enough to be RUNNING when the fault
                         # lands; short enough that the retried
                         # submission finishes fast
-                        "commands": ["echo started", "sleep 4"],
+                        "commands": ["echo started", "sleep 2"],
                     },
                     "profile": {
                         "name": "chaos",
@@ -134,7 +164,7 @@ class TestPreemptionSurfacesImmediately:
                 )
                 if interrupted is not None:
                     break
-                await asyncio.sleep(0.3)
+                await asyncio.sleep(0.1)
             assert interrupted is not None, (
                 "preemption was not classified as INTERRUPTED"
             )
@@ -158,11 +188,11 @@ class TestPreemptionSurfacesImmediately:
 
 
 class TestFailedJobRetriesPerPolicy:
-    async def test_crash_then_retry_completes_the_run(self, tmp_path):
+    async def test_crash_then_retry_completes_the_run(self, tmp_path, monkeypatch):
         """A job whose first submission exits non-zero retries per its
         `error` retry policy; the second submission succeeds and the
         run finishes DONE (not FAILED)."""
-        client, app = await _local_stack(tmp_path)
+        client, app = await _local_stack(tmp_path, monkeypatch)
         db = app["state"]["db"]
         flag = tmp_path / "second-attempt"
         try:

@@ -233,9 +233,10 @@ class TestValidation:
 class TestCatalogSourceCoherence:
     # literal point names at instrumented call sites:
     #   faults.fire("x") / afire / mutate, and the fault_point="x"
-    #   indirection in agent_client
+    #   indirection in agent_client / qos.edge_admit (any annotation:
+    #   plain str, or Optional[str] where None suppresses the fire)
     _CALL_RE = re.compile(
-        r"""(?:faults\.(?:fire|afire|mutate)\(\s*|fault_point(?::\s*str)?\s*=\s*)["']([a-z0-9_.]+)["']"""
+        r"""(?:faults\.(?:fire|afire|mutate)\(\s*|fault_point(?::\s*[\w\[\]\. ]+)?\s*=\s*)["']([a-z0-9_.]+)["']"""
     )
 
     def _source_points(self) -> set:

@@ -86,6 +86,27 @@ class TestService:
         )
         assert conf.model is not None and conf.model.name == "llama-3-8b"
 
+    def test_qos_block_validated(self):
+        conf = parse_run_configuration(
+            {
+                "type": "service", "commands": ["serve"], "port": 8000,
+                "qos": {"rps": 10, "burst": 20, "tenant_inflight": 2},
+            }
+        )
+        assert conf.qos is not None and conf.qos.rps == 10
+        for bad in (
+            {"rps": -1},
+            {"rps": 10, "tenant_inflight": -2},
+            # < 1 would silently collapse per-tenant isolation into the
+            # single shared overflow bucket
+            {"rps": 10, "max_tenants": 0},
+        ):
+            with pytest.raises(ValueError):
+                parse_run_configuration(
+                    {"type": "service", "commands": ["serve"], "port": 8000,
+                     "qos": bad}
+                )
+
 
 class TestOtherConfigs:
     def test_dev_env(self):

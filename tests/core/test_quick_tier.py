@@ -13,10 +13,24 @@ def test_quick_keep_entries_all_match():
     sys.path.insert(0, str(REPO / "tests"))
     import conftest as test_conftest
 
+    # collect only the files the keep entries name: collection cost is
+    # module imports (jax + models), and the full compute+serve tree
+    # pays ~20s of them for the same answer. A file rename that orphans
+    # entries fails the existence assert below, louder than a silent
+    # no-match ever was.
+    names = sorted({k.split("::", 1)[0] for k in test_conftest._QUICK_KEEP})
+    files = []
+    for name in names:
+        hits = [
+            str(p.relative_to(REPO))
+            for root in ("tests/compute", "tests/serve")
+            for p in (REPO / root).glob(name)
+        ]
+        assert hits, f"_QUICK_KEEP names a file that no longer exists: {name}"
+        files.extend(hits)
     out = subprocess.run(
         [
-            sys.executable, "-m", "pytest",
-            "tests/compute", "tests/serve",
+            sys.executable, "-m", "pytest", *files,
             "-m", "not heavy", "--collect-only", "-q",
         ],
         cwd=REPO, capture_output=True, text=True, timeout=180,

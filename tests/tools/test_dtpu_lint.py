@@ -493,6 +493,59 @@ def test_rules_dtpu004_selects_the_docs_project_half():
     assert ran["docs"]
 
 
+class TestRetryAfterRule:
+    """DTPU007: 429/503 responses must carry Retry-After."""
+
+    def _check(self, src):
+        from tools.dtpu_lint.rules.retry_after import check_retry_after
+
+        return check_retry_after(src)
+
+    def test_503_without_headers_flagged(self):
+        fs = self._check(
+            "from aiohttp import web\n"
+            "def h():\n"
+            "    return web.json_response({'d': 1}, status=503)\n"
+        )
+        assert len(fs) == 1 and fs[0].rule == "DTPU007"
+
+    def test_429_with_headers_missing_key_flagged(self):
+        fs = self._check(
+            "from aiohttp import web\n"
+            "def h():\n"
+            "    return web.json_response(\n"
+            "        {'d': 1}, status=429, headers={'X-Other': '1'})\n"
+        )
+        assert len(fs) == 1
+
+    def test_retry_after_literal_ok(self):
+        fs = self._check(
+            "from aiohttp import web\n"
+            "def h(hint):\n"
+            "    return web.json_response(\n"
+            "        {'d': 1}, status=429,\n"
+            "        headers={'Retry-After': str(hint)})\n"
+        )
+        assert fs == []
+
+    def test_nonliteral_headers_accepted(self):
+        # headers built elsewhere: the rule can't prove absence
+        fs = self._check(
+            "from aiohttp import web\n"
+            "def h(hdrs):\n"
+            "    return web.json_response({'d': 1}, status=503, headers=hdrs)\n"
+        )
+        assert fs == []
+
+    def test_other_statuses_ignored(self):
+        fs = self._check(
+            "from aiohttp import web\n"
+            "def h():\n"
+            "    return web.json_response({'d': 1}, status=404)\n"
+        )
+        assert fs == []
+
+
 def test_scope_glob_matches_top_level_package_modules():
     # fnmatch gives ** no special meaning; the framework's matcher
     # must span zero directories so dstack_tpu/version.py-style

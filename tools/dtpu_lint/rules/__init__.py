@@ -5,6 +5,7 @@ from tools.dtpu_lint.rules import (  # noqa: F401
     host_sync,
     metric_hygiene,
     recompile,
+    retry_after,
     settings_drift,
     silent_except,
 )

@@ -1,0 +1,89 @@
+"""DTPU007: backpressure contract — 429/503 responses carry Retry-After.
+
+Every overload answer in the system tells the client *when to come
+back*: the routing plane's pool-exhausted 503 derives its hint from the
+earliest breaker half-open (PR 3), the QoS edges' 429s from the token
+bucket's refill schedule. A 429/503 without ``Retry-After`` invites the
+worst client behavior — immediate blind retry — exactly when the system
+is shedding load to survive. PR 3 and PR 5 established the invariant by
+convention; this rule enforces it.
+
+Flags any ``web.json_response(...)`` / ``web.Response(...)`` /
+``web.StreamResponse(...)`` constructed with ``status=429`` or
+``status=503`` whose ``headers`` argument is missing, or is a dict
+literal without a ``"Retry-After"`` key. A non-literal ``headers``
+expression is accepted (the rule cannot prove its contents; reviewers
+can). Handlers with a genuine reason to omit the header take a
+``# dtpu: noqa[DTPU007] <why>`` pragma.
+"""
+
+import ast
+from typing import Iterable, Optional
+
+from tools.dtpu_lint.core import FileRule, Finding, register
+
+_RESPONSE_CTORS = {"json_response", "Response", "StreamResponse"}
+_BACKPRESSURE_STATUSES = {429, 503}
+
+
+def _status_of(call: ast.Call) -> Optional[int]:
+    for kw in call.keywords:
+        if kw.arg == "status" and isinstance(kw.value, ast.Constant):
+            v = kw.value.value
+            if isinstance(v, int):
+                return v
+    return None
+
+
+def _headers_have_retry_after(call: ast.Call) -> Optional[bool]:
+    """True/False when provable from a literal ``headers=`` dict;
+    None when headers is a non-literal expression (benefit of the
+    doubt) — a missing ``headers`` kwarg returns False."""
+    for kw in call.keywords:
+        if kw.arg != "headers":
+            continue
+        if isinstance(kw.value, ast.Dict):
+            return any(
+                isinstance(k, ast.Constant) and k.value == "Retry-After"
+                for k in kw.value.keys
+            )
+        return None  # built elsewhere: cannot prove, accept
+    return False
+
+
+def check_retry_after(src: str, relpath: str = "<string>") -> list:
+    tree = ast.parse(src, filename=relpath)
+    findings: list = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _RESPONSE_CTORS
+        ):
+            continue
+        status = _status_of(node)
+        if status not in _BACKPRESSURE_STATUSES:
+            continue
+        if _headers_have_retry_after(node) is False:
+            findings.append(
+                Finding(
+                    "DTPU007",
+                    relpath,
+                    node.lineno,
+                    f"{status} response without a Retry-After header: "
+                    "overload answers must tell clients when to come "
+                    "back (pool.retry_after_hint() / the QoS bucket's "
+                    "refill hint)",
+                )
+            )
+    return findings
+
+
+@register
+class RetryAfterRule(FileRule):
+    id = "DTPU007"
+    name = "backpressure contract (429/503 ⇒ Retry-After)"
+    scope = ("dstack_tpu/**/*.py",)
+
+    def check(self, tree, src, relpath, repo) -> Iterable[Finding]:
+        return check_retry_after(src, relpath)
