@@ -80,6 +80,27 @@ POINTS: dict = {
         "reconciler crashes",
         ("sql",),
     ),
+    "db.notify": (
+        "a wakeup enqueue (server/services/wakeups.enqueue); raising "
+        "here LOSES the event — the entity must converge via the "
+        "safety-net sweep (the enqueue is fire-and-forget, so the "
+        "state transition itself is unaffected)",
+        ("queue", "entity"),
+    ),
+    "reconciler.wakeup": (
+        "one drain-worker pass, fired AFTER its wakeup batch is "
+        "claimed and BEFORE any entity is processed "
+        "(server/background/wakeup_drain.drain_queue); raising here is "
+        "a worker killed mid-batch — its claims re-deliver to a "
+        "sibling shard after the lease expires",
+        ("queue", "shard"),
+    ),
+    "reconciler.lease": (
+        "a wakeup-queue claim/lease acquisition "
+        "(server/services/wakeups.claim); raise 'timeout'/'connect' to "
+        "starve a shard's claim path without touching its siblings",
+        ("queue", "shard"),
+    ),
     "background.tick": (
         "one tick of a background reconciliation loop "
         "(server/background/scheduler.py); ctx task = loop name, e.g. "

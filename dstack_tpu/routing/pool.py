@@ -151,6 +151,11 @@ class ReplicaPool:
     def get(self, replica_id: str) -> Optional[ReplicaEntry]:
         return self.entries.get(str(replica_id))
 
+    def replica_ids(self) -> list:
+        """Known replica ids (for per-replica state snapshots — count
+        aggregates hide offsetting same-tick transitions)."""
+        return list(self.entries)
+
     def states(self) -> Dict[str, int]:
         out = {s.value: 0 for s in ReplicaState}
         for e in self.entries.values():

@@ -1,7 +1,13 @@
 """Background reconciler registration.
 
-Parity: reference server/background/__init__.py:39-97 (intervals tuned
-for ~150 active jobs/runs/instances per replica).
+Parity: reference server/background/__init__.py:39-97 — but the
+intervals below are now the SAFETY NET, not the reaction path: state
+transitions enqueue targeted revisits into the durable wakeup queue
+(services/wakeups.py) and the sharded drain workers registered here
+deliver them at ``DTPU_WAKEUP_POLL_INTERVAL`` (sub-second). The
+interval sweeps keep running to catch any entity whose wakeup was
+lost — dropped enqueue, crashed process, exhausted redelivery budget
+(docs/reference/server.md "Reconciliation & wakeups").
 """
 
 from dstack_tpu.server.background.scheduler import BackgroundScheduler
@@ -35,6 +41,13 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
     from dstack_tpu.server.background.tasks.process_volumes import process_volumes
 
     sched = BackgroundScheduler()
+    # event path: sharded wakeup drain workers (sub-second targeted
+    # revisits; DTPU_RECONCILER_SHARDS=0 falls back to pure sweeps)
+    from dstack_tpu.server.background.wakeup_drain import register_drain_workers
+
+    register_drain_workers(sched, db)
+    # safety net: the interval sweeps (original cadences) — the only
+    # path still pinned to a polling tick
     sched.add(lambda: process_runs(db), 2.0, "process_runs")
     sched.add(lambda: process_submitted_jobs(db), 1.0, "process_submitted_jobs")
     sched.add(lambda: process_running_jobs(db), 1.0, "process_running_jobs")

@@ -16,6 +16,9 @@ from dstack_tpu.utils.logging import get_logger
 
 logger = get_logger("server.background")
 
+#: consecutive tick failures before a loop reports degraded on /metrics
+DEGRADED_AFTER = 3
+
 
 def _tick_scale() -> float:
     """``DTPU_BG_TICK_SCALE`` multiplies every loop interval — the
@@ -46,16 +49,31 @@ class BackgroundScheduler:
         self._jobs.append((name or fn.__name__, fn, interval * self._scale, jitter))
 
     async def _loop(self, name: str, fn, interval: float, jitter: float) -> None:
+        # swallowed errors are still COUNTED: a permanently crashing
+        # loop used to be invisible outside the log stream — now it
+        # shows on /metrics as dtpu_background_task_failures_total plus
+        # a degraded gauge after DEGRADED_AFTER consecutive failures
+        from dstack_tpu.server.services.wakeups import get_reconcile_registry
+
+        reg = get_reconcile_registry()
+        consecutive = 0
         # initial stagger so loops don't fire in lockstep
         await asyncio.sleep(random.uniform(0, min(interval, 1.0)))
         while not self._stopped.is_set():
             try:
                 await faults.afire("background.tick", task=name)
                 await fn()
+                if consecutive:
+                    consecutive = 0
+                    reg.family("dtpu_background_task_degraded").set(0, name)
             except asyncio.CancelledError:
                 raise
             except Exception:
                 logger.exception("background task %s failed", name)
+                consecutive += 1
+                reg.family("dtpu_background_task_failures_total").inc(1, name)
+                if consecutive >= DEGRADED_AFTER:
+                    reg.family("dtpu_background_task_degraded").set(1, name)
             delay = interval + random.uniform(-jitter, jitter) * interval
             try:
                 await asyncio.wait_for(self._stopped.wait(), timeout=max(delay, 0.05))

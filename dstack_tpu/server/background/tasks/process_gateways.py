@@ -16,10 +16,23 @@ from dstack_tpu.server.db import Database, dumps, loads
 from dstack_tpu.server.services import backends as backends_service
 from dstack_tpu.server.services import gateways as gateways_service
 from dstack_tpu.utils.logging import get_logger
+from dstack_tpu.utils.retry import (
+    Deadline,
+    RetryPolicy,
+    retry_async,
+    should_retry_non_idempotent,
+)
 
 logger = get_logger("background.process_gateways")
 
 PROVISION_TIMEOUT_SECONDS = 10 * 60
+
+# transient backend hiccups retry inside one visit. create_gateway is
+# NOT idempotent → conservative classifier (connect refusal/429 only;
+# an ambiguous timeout could mean the VM exists and a retry would
+# double-provision). The provisioning-data poll is a read → full
+# transient classifier.
+_PROVISION_RETRY = RetryPolicy(max_attempts=3, base_delay=0.5, max_delay=5.0)
 
 
 async def process_gateways(db: Database) -> None:
@@ -69,7 +82,13 @@ async def _provision(db: Database, row: dict) -> None:
         )
         return
     try:
-        pd = await compute.create_gateway(row["name"], conf.region)
+        pd = await retry_async(
+            lambda: compute.create_gateway(row["name"], conf.region),
+            site="gateways.provision",
+            policy=_PROVISION_RETRY,
+            should_retry=should_retry_non_idempotent,
+            deadline=Deadline(30.0),
+        )
     except Exception as e:
         logger.warning("gateway %s provisioning failed: %s", row["name"], e)
         await db.update_by_id(
@@ -105,7 +124,12 @@ async def _check_ready(db: Database, row: dict) -> None:
         )
         pd = loads(row.get("provisioning_data")) or {}
         if isinstance(compute, ComputeWithGatewaySupport):
-            pd = await compute.update_gateway_provisioning_data(pd)
+            pd = await retry_async(
+                lambda: compute.update_gateway_provisioning_data(pd),
+                site="gateways.poll",
+                policy=_PROVISION_RETRY,
+                deadline=Deadline(15.0),
+            )
             await db.update_by_id(
                 "gateways",
                 row["id"],
@@ -120,8 +144,12 @@ async def _check_ready(db: Database, row: dict) -> None:
         # proxies to the dstack server)
         from dstack_tpu.server import settings
 
+        # the config push must land on a gateway that just answered its
+        # healthcheck — a transient transport blip here would leave a
+        # RUNNING gateway unable to validate end-user tokens
         await gateways_service.call_agent(
-            row, "POST", "/api/config", {"server_url": settings.SERVER_URL}
+            row, "POST", "/api/config", {"server_url": settings.SERVER_URL},
+            retry_site="gateways.agent",
         )
         await db.update_by_id(
             "gateways", row["id"], {"status": GatewayStatus.RUNNING.value}
@@ -182,7 +210,9 @@ async def _collect_stats(db: Database) -> None:
     )
     stats = get_service_stats()
     for row in rows:
-        resp = await gateways_service.call_agent(row, "GET", "/api/stats")
+        resp = await gateways_service.call_agent(
+            row, "GET", "/api/stats", retry_site="gateways.stats"
+        )
         if resp is None:
             continue
         for s in resp.get("services", []):

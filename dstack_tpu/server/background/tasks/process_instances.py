@@ -305,6 +305,12 @@ async def _poll_provisioning(db: Database, row: dict) -> None:
             await db.update_by_id(
                 "jobs", j["id"], {"job_provisioning_data": dumps(merged)}
             )
+        # event path: fresh host data unblocks the jobs waiting on
+        # jpd.ready() in process_running_jobs — wake them now
+        from dstack_tpu.server.services import wakeups
+
+        for j in jobs:
+            await wakeups.enqueue(db, "running_jobs", j["id"])
     # instance is reachable; busy if jobs are assigned
     jobs = await db.fetchall(
         "SELECT id FROM jobs WHERE instance_id = ? AND status IN (?,?,?,?)",
@@ -319,6 +325,12 @@ async def _poll_provisioning(db: Database, row: dict) -> None:
     await _mark(
         db, row, InstanceStatus.BUSY if jobs else InstanceStatus.IDLE
     )
+    if not jobs:
+        # a fleet instance that just became reachable-and-idle is fresh
+        # capacity: wake the project's waiting SUBMITTED jobs
+        from dstack_tpu.server.services import wakeups
+
+        await wakeups.wake_submitted_jobs_in_project(db, row["project_id"])
 
 
 async def _maybe_terminate_idle(db: Database, row: dict) -> None:
@@ -436,3 +448,10 @@ async def _touch(db: Database, row: dict) -> None:
     await db.update_by_id(
         "instances", row["id"], {"last_processed_at": now_utc().isoformat()}
     )
+
+
+async def reconcile_one(db: Database, entity_id: str) -> None:
+    """Per-entity entry point for the wakeup drain workers (same
+    handler the sweep dispatches to; late-bound so tests patching
+    ``_process`` cover both paths)."""
+    await _process(db, entity_id)

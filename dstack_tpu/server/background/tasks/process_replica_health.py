@@ -33,6 +33,7 @@ async def probe_service_replicas(db: Database) -> None:
         _ACTIVE,
     )
     keys = set()
+    run_ids = {}
     for run in runs:
         conf = (loads(run["run_spec"]) or {}).get("configuration", {})
         if conf.get("type") != "service":
@@ -42,15 +43,36 @@ async def probe_service_replicas(db: Database) -> None:
             continue
         key = (project_name, run["run_name"])
         keys.add(key)
+        run_ids[key] = run["id"]
         replicas = await _resolve_replicas(db, project_name, run["run_name"])
         registry.pool(*key).sync(replicas)
     registry.prune(keys)
     if not registry.pools:
         registry.update_state_gauge()
         return
+    # probe-result wakeups: a tick whose probes changed any replica's
+    # state (READY→DEAD, DEGRADED→READY, …) enqueues a targeted revisit
+    # of that service's run, so replica restart / drain / aggregation
+    # reacts within the wakeup poll interval instead of the run sweep.
+    # Snapshot PER-REPLICA states, not per-state counts: offsetting
+    # transitions in one tick (A READY→DEAD while B DEAD→READY) leave
+    # the counts identical but absolutely need the run revisited
+    def _replica_states(key):
+        pool = registry.pool(*key)
+        return {
+            rid: (e.state if (e := pool.get(rid)) is not None else None)
+            for rid in pool.replica_ids()
+        }
+
+    before = {key: _replica_states(key) for key in keys}
     timeout = aiohttp.ClientTimeout(total=registry.config.probe_timeout)
     # a fresh session per tick: the scheduler may drive this from
     # different event loops across app lifecycles (tests), and a probe
     # tick is a handful of local HTTP GETs
     async with aiohttp.ClientSession(timeout=timeout) as session:
         await registry.probe_all(session)
+    from dstack_tpu.server.services import wakeups
+
+    for key in keys:
+        if _replica_states(key) != before.get(key):
+            await wakeups.enqueue(db, "runs", run_ids[key])

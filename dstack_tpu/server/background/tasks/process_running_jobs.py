@@ -439,6 +439,13 @@ async def _process_provisioning(db: Database, job_row: dict, jpd: JobProvisionin
     await record_run_event(
         db, job_row["run_id"], JobStatus.PULLING.value, job_id=job_row["id"]
     )
+    # event path: the first get_task poll can happen now instead of at
+    # the next sweep (this write bypasses update_job_status)
+    from dstack_tpu.server.services import wakeups
+
+    await wakeups.wake_job(
+        db, job_row["id"], JobStatus.PULLING.value, run_id=job_row["run_id"]
+    )
     logger.info("job %s: task submitted to shim", job_spec.job_name)
 
 
@@ -595,6 +602,13 @@ async def _process_pulling(db: Database, job_row: dict, jpd: JobProvisioningData
 
     await record_run_event(
         db, job_row["run_id"], JobStatus.RUNNING.value, job_id=job_row["id"]
+    )
+    # event path: the run aggregate + first log pull react now (this
+    # write bypasses update_job_status)
+    from dstack_tpu.server.services import wakeups
+
+    await wakeups.wake_job(
+        db, job_row["id"], JobStatus.RUNNING.value, run_id=job_row["run_id"]
     )
     logger.info("job %s: running", job_spec.job_name)
     await _register_on_gateway(db, job_row, job_spec, jpd)
@@ -825,6 +839,15 @@ async def _process_running(db: Database, job_row: dict, jpd: JobProvisioningData
         )
         fields.update(policy_fields)
     await db.update_by_id("jobs", job_row["id"], fields)
+    if fields.get("status") == JobStatus.TERMINATING.value:
+        # runner-reported exit or policy kill: wake the terminating
+        # loop now (this write bypasses update_job_status)
+        from dstack_tpu.server.services import wakeups
+
+        await wakeups.wake_job(
+            db, job_row["id"], JobStatus.TERMINATING.value,
+            run_id=job_row["run_id"],
+        )
 
 
 async def _check_job_policies(
@@ -917,3 +940,10 @@ async def _check_job_policies(
                         ),
                     }
     return {}
+
+
+async def reconcile_one(db: Database, entity_id: str) -> None:
+    """Per-entity entry point for the wakeup drain workers (same
+    handler the sweep dispatches to; late-bound so tests patching
+    ``_process`` cover both paths)."""
+    await _process(db, entity_id)

@@ -60,6 +60,12 @@ async def _process(db: Database, run_id: str) -> None:
     run_row = await db.get_by_id("runs", run_id)
     if run_row is None:
         return
+    # terminal/deleted runs are no-ops: the sweep's SELECT already
+    # filters them, but the wakeup drain path delivers at-least-once —
+    # a duplicate wakeup arriving after termination must not resurrect
+    # a DONE run into TERMINATING (idempotency contract)
+    if run_row.get("deleted") or run_row["status"] not in ACTIVE:
+        return
     status = RunStatus(run_row["status"])
     job_rows = await jobs_service.latest_job_rows_for_run(db, run_id)
     if status == RunStatus.TERMINATING:
@@ -416,3 +422,10 @@ async def _touch(db: Database, run_id: str) -> None:
     await db.update_by_id(
         "runs", run_id, {"last_processed_at": now_utc().isoformat()}
     )
+
+
+async def reconcile_one(db: Database, entity_id: str) -> None:
+    """Per-entity entry point for the wakeup drain workers (same
+    handler the sweep dispatches to; late-bound so tests patching
+    ``_process`` cover both paths)."""
+    await _process(db, entity_id)

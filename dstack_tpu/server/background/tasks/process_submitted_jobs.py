@@ -669,6 +669,14 @@ async def _assign(
         db, job_row["run_id"], JobStatus.PROVISIONING.value,
         job_id=job_row["id"],
     )
+    # event path: the assigned job is ready for its provisioning poll
+    # immediately (this write bypasses update_job_status, so wake here)
+    from dstack_tpu.server.services import wakeups
+
+    await wakeups.wake_job(
+        db, job_row["id"], JobStatus.PROVISIONING.value,
+        run_id=job_row["run_id"],
+    )
 
 
 async def _no_capacity(
@@ -836,3 +844,30 @@ async def _fail(
         termination_reason_message=message,
         run_id=job_row["run_id"],
     )
+
+
+async def reconcile_one(db: Database, entity_id: str) -> None:
+    """Per-entity entry point for the wakeup drain workers.
+
+    Scheduling is the one queue where ORDER is a contract: PR-6's
+    strict priority tiers must hold against the event path too, or a
+    flood of fresh low-priority submissions (each with a sub-second
+    wakeup) would grab freed capacity ahead of older higher-priority
+    jobs that only compete at the sweep tick. Gate: a wakeup is
+    processed only while NO strictly-higher-priority SUBMITTED job is
+    waiting — outranked wakeups are dropped (the fair-share sweep owns
+    their ordering, and the higher-priority jobs carry wakeups of
+    their own). Equal-priority jobs flow freely: within one tier the
+    event path's arrival order matches the sweep's FIFO closely
+    enough, and deficit fair-share across projects remains the sweep's
+    refinement, not a hard guarantee of this path."""
+    outranked = await db.fetchone(
+        "SELECT 1 AS x FROM jobs j2 JOIN runs r2 ON j2.run_id = r2.id "
+        "WHERE j2.status = ? AND r2.priority > ("
+        "  SELECT r.priority FROM jobs j JOIN runs r ON j.run_id = r.id "
+        "  WHERE j.id = ?) LIMIT 1",
+        (JobStatus.SUBMITTED.value, entity_id),
+    )
+    if outranked is not None:
+        return  # strict tiers: the sweep schedules in priority order
+    await _process_job(db, entity_id)

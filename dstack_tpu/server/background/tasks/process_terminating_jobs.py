@@ -251,3 +251,17 @@ async def _release_instance(db: Database, job_row: dict) -> None:
             "last_processed_at": now_utc().isoformat(),
         },
     )
+    # instance-freed event: the idle reconciler tracks the instance and
+    # the project's waiting SUBMITTED jobs race for the capacity now,
+    # not at the next scheduling sweep
+    from dstack_tpu.server.services import wakeups
+
+    await wakeups.enqueue(db, "instances", inst["id"])
+    await wakeups.wake_submitted_jobs_in_project(db, job_row["project_id"])
+
+
+async def reconcile_one(db: Database, entity_id: str) -> None:
+    """Per-entity entry point for the wakeup drain workers (same
+    handler the sweep dispatches to; late-bound so tests patching
+    ``_process`` cover both paths)."""
+    await _process(db, entity_id)

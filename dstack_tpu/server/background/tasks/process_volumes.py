@@ -11,8 +11,23 @@ from dstack_tpu.core.models.volumes import Volume, VolumeStatus
 from dstack_tpu.server.db import Database, dumps, loads
 from dstack_tpu.server.services import backends as backends_service
 from dstack_tpu.utils.logging import get_logger
+from dstack_tpu.utils.retry import (
+    Deadline,
+    RetryPolicy,
+    retry_async,
+    should_retry_non_idempotent,
+)
 
 logger = get_logger("server.process_volumes")
+
+# transient backend hiccups retry INSIDE one reconciler visit instead
+# of failing the volume outright. create_volume is NOT idempotent, so
+# it uses the conservative classifier (connect refusal / 429 only —
+# a timeout or 5xx may mean the create LANDED and a blind retry would
+# double-provision); register_volume only adopts an existing disk, so
+# the full transient classifier is safe there
+_PROVISION_RETRY = RetryPolicy(max_attempts=3, base_delay=0.5, max_delay=5.0)
+_PROVISION_DEADLINE_S = 30.0
 
 
 async def process_volumes(db: Database) -> None:
@@ -55,9 +70,20 @@ async def _provision(db: Database, volume_id: str) -> None:
     )
     try:
         if conf.volume_id:
-            pd = await compute.register_volume(volume)
+            pd = await retry_async(
+                lambda: compute.register_volume(volume),
+                site="volumes.register",
+                policy=_PROVISION_RETRY,
+                deadline=Deadline(_PROVISION_DEADLINE_S),
+            )
         else:
-            pd = await compute.create_volume(volume)
+            pd = await retry_async(
+                lambda: compute.create_volume(volume),
+                site="volumes.provision",
+                policy=_PROVISION_RETRY,
+                should_retry=should_retry_non_idempotent,
+                deadline=Deadline(_PROVISION_DEADLINE_S),
+            )
     except Exception as e:
         logger.warning("volume %s provisioning failed: %s", row["name"], e)
         await db.update_by_id(

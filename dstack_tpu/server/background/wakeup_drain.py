@@ -1,0 +1,146 @@
+"""Sharded drain workers for the durable wakeup queue.
+
+One worker per (queue, shard) runs as a background loop at
+``DTPU_WAKEUP_POLL_INTERVAL`` (sub-second): it claims its shard's due
+wakeups under a lease (:mod:`dstack_tpu.server.services.wakeups`),
+visits each entity through the SAME per-entity handler the safety-net
+sweep uses — behind the same entity lock namespace, so a drain worker
+and a sweep can never process one entity concurrently — then acks
+processed wakeups and releases the rest for redelivery.
+
+Crash semantics: the ``reconciler.wakeup`` fault point fires after the
+claim and before any processing — raising there is a worker killed
+mid-batch. Its claimed rows keep their lease; after
+``DTPU_WAKEUP_LEASE_SECONDS`` any sibling shard's claim pass steals
+and redelivers them (pinned by tests/chaos/test_chaos_wakeups.py).
+"""
+
+import asyncio
+from typing import Awaitable, Callable
+
+from dstack_tpu import faults
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database
+from dstack_tpu.server.services import wakeups
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.wakeup_drain")
+
+Handler = Callable[[Database, str], Awaitable[None]]
+
+
+async def drain_queue(
+    db: Database,
+    queue: str,
+    handler: Handler,
+    namespace: str,
+    shard: int,
+    nshards: int,
+) -> int:
+    """One drain pass: claim → process → ack/release. Returns the
+    number of entities visited."""
+    claimed = await wakeups.claim(
+        db,
+        queue,
+        shard,
+        nshards,
+        limit=settings.WAKEUP_BATCH,
+        lease_seconds=settings.WAKEUP_LEASE_SECONDS,
+    )
+    if not claimed:
+        return 0
+    # crash point: a raise here is a worker dying mid-batch — the rows
+    # above stay claimed until their lease expires, then any shard
+    # steals them (at-least-once, never lost)
+    await faults.afire("reconciler.wakeup", queue=queue, shard=str(shard))
+    ids = [r["entity_id"] for r in claimed]
+    results: dict = {}
+    async with db.claim_batch(namespace, ids, len(ids)) as got_ids:
+        got = [eid for eid in ids if eid in set(got_ids)]
+        if got:
+            out = await asyncio.gather(
+                *(handler(db, eid) for eid in got), return_exceptions=True
+            )
+            results = dict(zip(got, out))
+    visited = 0
+    for row in claimed:
+        eid = row["entity_id"]
+        res = results.get(eid, _NOT_PROCESSED)
+        if res is _NOT_PROCESSED:
+            # entity lock contention: a sweep or sibling worker holds
+            # the entity right now — redeliver shortly (idempotent; a
+            # prompt extra visit is cheaper than a swallowed event)
+            await wakeups.release(
+                db, queue, row,
+                retry_delay=settings.WAKEUP_POLL_INTERVAL,
+                max_attempts=settings.WAKEUP_MAX_ATTEMPTS,
+            )
+        elif isinstance(res, BaseException):
+            logger.exception(
+                "wakeup handler failed (queue=%s entity=%s attempt=%s)",
+                queue, eid, row.get("attempts"), exc_info=res,
+            )
+            await wakeups.release(
+                db, queue, row,
+                retry_delay=0.5 * int(row.get("attempts") or 1),
+                max_attempts=settings.WAKEUP_MAX_ATTEMPTS,
+            )
+        else:
+            visited += 1
+            await wakeups.ack(db, queue, row)
+    # depth AFTER acks/releases: a pass that drained the queue must
+    # report 0, not the pre-ack count it claimed (sampled only on
+    # passes that did work, so idle polls stay one SELECT)
+    wakeups.get_reconcile_registry().family("dtpu_reconcile_queue_depth").set(
+        await wakeups.queue_depth(db, queue), queue
+    )
+    return visited
+
+
+_NOT_PROCESSED = object()
+
+
+def queue_bindings() -> list:
+    """(queue, handler, entity-lock namespace) for every wakeup queue —
+    the handlers are the SAME per-entity functions the safety-net
+    sweeps dispatch to (their idempotency is what makes at-least-once
+    delivery safe)."""
+    from dstack_tpu.server.background.tasks import (
+        process_instances,
+        process_runs,
+        process_running_jobs,
+        process_submitted_jobs,
+        process_terminating_jobs,
+    )
+
+    return [
+        ("runs", process_runs.reconcile_one, "runs"),
+        ("submitted_jobs", process_submitted_jobs.reconcile_one, "jobs"),
+        ("running_jobs", process_running_jobs.reconcile_one, "jobs"),
+        ("terminating_jobs", process_terminating_jobs.reconcile_one, "jobs"),
+        ("instances", process_instances.reconcile_one, "instances"),
+    ]
+
+
+def register_drain_workers(sched, db: Database) -> None:
+    """Add one drain loop per (queue, shard) to the scheduler.
+    ``DTPU_RECONCILER_SHARDS=0`` disables the event path entirely
+    (pure-sweep mode, the pre-wakeup behavior)."""
+    nshards = settings.RECONCILER_SHARDS
+    if nshards <= 0:
+        return
+    for queue, handler, namespace in queue_bindings():
+        for shard in range(nshards):
+            def make(queue=queue, handler=handler, namespace=namespace,
+                     shard=shard):
+                async def drain():
+                    await drain_queue(
+                        db, queue, handler, namespace, shard, nshards
+                    )
+                return drain
+
+            sched.add(
+                make(),
+                settings.WAKEUP_POLL_INTERVAL,
+                f"drain_{queue}_{shard}",
+            )

@@ -140,16 +140,31 @@ class PostgresDatabase:
     async def connect(self) -> None:
         if self._pool_factory is not None:
             self._pool = await self._pool_factory(self.url)
-            self._lock_pool = self._pool
+            # the lock pool must be DISTINCT even under a test factory:
+            # claim_batch holds its connection for a reconciler's whole
+            # body while that body runs queries — with one shared pool,
+            # enough concurrent claimants (5 sweeps + the wakeup drain
+            # workers) hold every connection and their bodies' queries
+            # wait forever: a true deadlock, observed wedging the
+            # 1500-job capacity bench on the pgwire engine
+            self._lock_pool = await self._pool_factory(self.url)
         else:
             self._pool = await asyncpg.create_pool(
                 dsn=self.url, min_size=1, max_size=10
             )
             # advisory claims hold their connection for a reconciler's
             # whole body (possibly multi-second cloud calls); a separate
-            # pool keeps them from starving query traffic
+            # pool keeps them from starving query traffic. Sized for
+            # every concurrent claimant — 5 sweep loops + the per-queue
+            # wakeup drain shards (5 queues × DTPU_RECONCILER_SHARDS) +
+            # the volume/gateway claim_one loops — plus slack, and
+            # DERIVED from the shard setting so raising it can't
+            # silently reintroduce claim-queuing latency
+            from dstack_tpu.server import settings
+
+            claimants = 5 + 5 * max(0, settings.RECONCILER_SHARDS) + 2
             self._lock_pool = await asyncpg.create_pool(
-                dsn=self.url, min_size=1, max_size=8
+                dsn=self.url, min_size=1, max_size=max(16, claimants + 4)
             )
 
     async def close(self) -> None:

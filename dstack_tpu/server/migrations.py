@@ -267,4 +267,30 @@ CREATE INDEX idx_run_events_run ON run_events(run_id, timestamp);
 ALTER TABLE runs ADD COLUMN priority INTEGER NOT NULL DEFAULT 50;
 """,
     ),
+    (
+        # event-driven reconciliation: one durable targeted-revisit row
+        # per (queue, entity). State transitions upsert rows here;
+        # sharded drain workers claim them under a lease and visit the
+        # entity sub-second instead of waiting out the sweep interval.
+        # `generation` guards acks against an event that arrived while
+        # the row was claimed (the ack must not swallow it); claimed
+        # rows whose lease expired are claimable by ANY shard (work
+        # stealing — a crashed worker's batch re-delivers to a sibling).
+        "0005_wakeups",
+        """
+CREATE TABLE wakeups (
+    queue TEXT NOT NULL,
+    entity_id TEXT NOT NULL,
+    shard_hash INTEGER NOT NULL DEFAULT 0,
+    generation INTEGER NOT NULL DEFAULT 0,
+    attempts INTEGER NOT NULL DEFAULT 0,
+    due_at TEXT NOT NULL,
+    enqueued_at TEXT NOT NULL,
+    claimed_by TEXT,
+    lease_expires_at TEXT,
+    PRIMARY KEY (queue, entity_id)
+);
+CREATE INDEX idx_wakeups_due ON wakeups(queue, due_at);
+""",
+    ),
 ]

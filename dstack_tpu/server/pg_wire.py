@@ -444,10 +444,21 @@ def _err_fields(body: bytes) -> dict:
 
 
 async def connect(dsn: str, timeout: float = 10.0) -> Connection:
+    import socket as _socket
+
     p = parse_dsn(dsn)
     reader, writer = await asyncio.wait_for(
         asyncio.open_connection(p["host"], p["port"]), timeout
     )
+    # belt-and-braces: asyncio usually disables Nagle on connect-side
+    # transports, but a stray 40ms delayed-ACK stall per round trip is
+    # catastrophic for a chatty wire protocol — assert it ourselves
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        try:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
     conn = Connection(reader, writer)
     try:
         await asyncio.wait_for(

@@ -209,14 +209,44 @@ def agent_headers(row: dict) -> dict:
     return {"Authorization": f"Bearer {token}"} if token else {}
 
 
+# transport retry for agent calls that opt in (retry_site=...): short,
+# bounded — a gateway loop tick must not camp on one dead agent
+_AGENT_RETRY_POLICY = None  # built lazily (utils.retry import stays cold)
+
+
+def _agent_retry_policy():
+    global _AGENT_RETRY_POLICY
+    if _AGENT_RETRY_POLICY is None:
+        from dstack_tpu.utils.retry import RetryPolicy
+
+        _AGENT_RETRY_POLICY = RetryPolicy(
+            max_attempts=3, base_delay=0.2, max_delay=2.0
+        )
+    return _AGENT_RETRY_POLICY
+
+
 async def call_agent(
-    row: dict, method: str, path: str, json_body: Optional[dict] = None
+    row: dict,
+    method: str,
+    path: str,
+    json_body: Optional[dict] = None,
+    retry_site: Optional[str] = None,
 ) -> Optional[dict]:
-    """One API call to a gateway agent; None on connection failure."""
+    """One API call to a gateway agent; None on connection failure.
+
+    ``retry_site`` opts the call into the unified retry layer
+    (``utils/retry.py``): transient transport errors (connect reset,
+    timeout) retry with jittered backoff under a short deadline and
+    count into ``dtpu_retry_attempts_total{site}``; the "None on
+    failure" contract is preserved after exhaustion. Callers probing a
+    host that is EXPECTED to be down (provisioning healthchecks) leave
+    it unset."""
+
     base = agent_base_url(row)
     if base is None:
         return None
-    try:
+
+    async def _once():
         async with _pool.session(row["id"]).request(
             method, f"{base}{path}", json=json_body, headers=agent_headers(row)
         ) as resp:
@@ -226,6 +256,18 @@ async def call_agent(
                 )
                 return None
             return await resp.json()
+
+    try:
+        if retry_site is not None:
+            from dstack_tpu.utils.retry import Deadline, retry_async
+
+            return await retry_async(
+                _once,
+                site=retry_site,
+                policy=_agent_retry_policy(),
+                deadline=Deadline(10.0),
+            )
+        return await _once()
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
         # aiohttp's total-timeout surfaces as asyncio.TimeoutError, not
         # ClientError — both must honor the "None on failure" contract

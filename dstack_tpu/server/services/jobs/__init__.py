@@ -92,6 +92,14 @@ async def create_job_row(
         "last_processed_at": now_utc().isoformat(),
     }
     await db.insert("jobs", row)
+    # event path: a fresh SUBMITTED job is schedulable NOW — enqueue the
+    # targeted revisit after the insert commit (fire-and-forget; a lost
+    # wakeup leaves the job to the safety-net sweep)
+    from dstack_tpu.server.services import wakeups
+
+    await wakeups.enqueue(
+        db, "submitted_jobs", row["id"], shard_key=row["run_id"]
+    )
     return row
 
 
@@ -133,6 +141,15 @@ async def update_job_status(
                 termination_reason.value if termination_reason else None
             ),
         )
+    # event path: wake the reconciler that owns the NEW status, plus the
+    # run aggregation loop. Deliberately LAST — the wakeup is an
+    # acceleration of already-committed state, so a crash (or injected
+    # fault) here loses nothing but latency, and the db.commit
+    # fault-injection schedules of the chaos suite keep their
+    # commit-ordinal meaning
+    from dstack_tpu.server.services import wakeups
+
+    await wakeups.wake_job(db, job_id, status.value, run_id=run_id)
 
 
 async def get_unfinished_job_rows(db: Database, run_id: str) -> list[dict]:

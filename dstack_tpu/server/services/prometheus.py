@@ -76,6 +76,11 @@ async def render_metrics(db: Database) -> str:
     from dstack_tpu.qos.metrics import get_qos_registry
 
     w.raw(get_qos_registry().render())
+    # event-driven reconciliation (wakeup queue deliveries/reaction
+    # latency) + background-loop failure/degraded health
+    from dstack_tpu.server.services.wakeups import get_reconcile_registry
+
+    w.raw(get_reconcile_registry().render())
     return w.render()
 
 

@@ -381,6 +381,11 @@ async def submit_run(
         db, run_row["id"], RunStatus.SUBMITTED.value,
         timestamp=run_row["submitted_at"],
     )
+    # event path: react to the submit now (job wakeups were enqueued by
+    # create_job_row; this one covers the run aggregation loop)
+    from dstack_tpu.server.services import wakeups
+
+    await wakeups.enqueue(db, "runs", run_row["id"])
     logger.info(
         "submitted run %s (%d replicas)",
         run_spec.run_name,
@@ -472,6 +477,11 @@ async def stop_runs(
                 termination_reason=job_reason,
                 run_id=row["id"],
             )
+        # event path: a stop with NO unfinished jobs still needs the run
+        # loop to finalize TERMINATING → terminal status promptly
+        from dstack_tpu.server.services import wakeups
+
+        await wakeups.enqueue(db, "runs", row["id"])
 
 
 async def delete_runs(db: Database, project_row: dict, run_names: list[str]) -> None:

@@ -1,0 +1,432 @@
+"""Durable wakeup queue: event-driven, crash-safe reconciliation.
+
+The control plane used to be pure fixed-interval sweeps, so every
+reaction — a preemption, a finished replica, a freed instance — waited
+out the polling tick (CAPACITY_r05.json: visit-gap p50 = p95 = the
+10 s tick). This module is the event path that replaces the wait:
+state transitions *enqueue a targeted revisit* of exactly the entity
+that changed, and per-queue drain workers deliver it to the existing
+reconciler handler within the wakeup poll interval (sub-second),
+independent of how many other entities exist.
+
+Correctness model (the hard part — wakeups get lost, duplicated, and
+workers die mid-batch):
+
+- **At-least-once, never exactly-once.** A wakeup may be delivered
+  twice (lease expiry races, generation-guard redelivery); the
+  reconciler handlers are idempotent — every one re-reads the entity
+  row and no-ops unless its CURRENT status wants work (pinned by
+  tests/chaos/test_chaos_wakeups.py). Duplicate deliveries therefore
+  produce no duplicate terminal transitions or ``run_events`` rows.
+- **Deduplicated by entity.** One row per (queue, entity_id): a burst
+  of transitions for one entity collapses into one pending revisit
+  (``generation`` counts collapsed arrivals so an ack cannot swallow
+  an event that arrived while the row was claimed).
+- **Leased claims, work stealing.** A drain worker claims rows with a
+  compare-and-swap UPDATE stamping ``claimed_by`` + a lease deadline.
+  A worker killed mid-batch (the ``reconciler.wakeup`` fault point)
+  leaves its claims behind; once the lease expires ANY shard's claim
+  pass may steal them, so a dead worker delays its batch by one lease,
+  never forever.
+- **Sharded without double-claiming.** Rows carry a stable
+  ``shard_hash`` (run-id keyed); shard *s* of *N* claims only rows
+  with ``shard_hash % N = s`` — except expired leases, which are fair
+  game for any shard. The claim CAS makes concurrent claimers safe
+  even across server replicas (one UPDATE statement is atomic on both
+  engines).
+- **Lost wakeups converge via the safety net.** ``enqueue`` is
+  fire-and-forget (a telemetry-grade write must never fail a state
+  transition); a lost enqueue (the ``db.notify`` fault point, a
+  crashed process) just means the entity waits for the safety-net
+  sweep — the old interval loops, still running, now as backstop.
+- **Bounded redelivery.** A wakeup whose handler keeps failing is
+  dropped after ``DTPU_WAKEUP_MAX_ATTEMPTS`` deliveries (counted, and
+  the sweep still owns the entity) so a poison entity cannot hot-loop
+  a drain worker.
+
+SQL here is deliberately the shared sqlite/postgres dialect
+(``ON CONFLICT`` upsert, ``CASE``, integer ``%``) — the same statements
+run on the stdlib-sqlite engine, asyncpg, and the bundled pg_wire
+stack. ISO-8601 UTC strings compare lexicographically, like every
+other timestamp column in the schema.
+"""
+
+import uuid
+import zlib
+from typing import Optional
+
+from dstack_tpu import faults
+from dstack_tpu.core.models.runs import now_utc
+from dstack_tpu.obs import LATENCY_BUCKETS_S, Registry
+from dstack_tpu.server import settings
+from dstack_tpu.server.db import Database
+from dstack_tpu.utils.common import parse_dt
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.wakeups")
+
+#: queue name -> the reconciler loop that drains it (docs/reference/
+#: server.md "Reconciliation & wakeups"). Kept static so the drain
+#: registration, the metrics labels, and the docs can't drift.
+QUEUES = (
+    "runs",
+    "submitted_jobs",
+    "running_jobs",
+    "terminating_jobs",
+    "instances",
+)
+
+
+def shard_hash(key: str) -> int:
+    """Stable non-negative int31 for shard routing (crc32 — stable
+    across processes and restarts, unlike ``hash()``; masked to fit
+    Postgres INTEGER)."""
+    return zlib.crc32(str(key).encode()) & 0x7FFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def new_reconcile_registry() -> Registry:
+    r = Registry()
+    r.counter(
+        "dtpu_reconcile_wakeups_enqueued_total",
+        "Targeted revisits enqueued by state transitions, by queue",
+        labelnames=("queue",),
+    )
+    r.counter(
+        "dtpu_reconcile_wakeups_lost_total",
+        "Enqueue attempts that failed (fault-injected or real DB error) "
+        "— the entity falls back to the safety-net sweep, by queue",
+        labelnames=("queue",),
+    )
+    r.counter(
+        "dtpu_reconcile_wakeups_delivered_total",
+        "Wakeups claimed by a drain worker (at-least-once deliveries), "
+        "by queue",
+        labelnames=("queue",),
+    )
+    r.counter(
+        "dtpu_reconcile_wakeups_acked_total",
+        "Wakeups acknowledged after their entity was processed, by queue",
+        labelnames=("queue",),
+    )
+    r.counter(
+        "dtpu_reconcile_wakeups_redelivered_total",
+        "Wakeups released for redelivery (handler error, entity lock "
+        "contention, or a concurrent enqueue during processing), by queue",
+        labelnames=("queue",),
+    )
+    r.counter(
+        "dtpu_reconcile_wakeups_stolen_total",
+        "Expired-lease wakeups claimed away from a dead/stuck worker "
+        "(crash-recovery redeliveries), by queue",
+        labelnames=("queue",),
+    )
+    r.counter(
+        "dtpu_reconcile_wakeups_dropped_total",
+        "Wakeups dropped after exhausting their delivery attempts (the "
+        "safety-net sweep still owns the entity), by queue",
+        labelnames=("queue",),
+    )
+    r.gauge(
+        "dtpu_reconcile_queue_depth",
+        "Pending wakeup rows per queue (sampled after each drain pass "
+        "that delivered work, so a drained queue reads 0)",
+        labelnames=("queue",),
+    )
+    r.histogram(
+        "dtpu_reconcile_reaction_seconds",
+        "Latency from a state transition's enqueue to the drain worker "
+        "picking the entity up, by queue",
+        labelnames=("queue",),
+        buckets=LATENCY_BUCKETS_S,
+    )
+    r.counter(
+        "dtpu_background_task_failures_total",
+        "Background loop ticks that raised (errors are logged and "
+        "swallowed so the loop survives — this makes them countable), "
+        "by task",
+        labelnames=("task",),
+    )
+    r.gauge(
+        "dtpu_background_task_degraded",
+        "1 when a background loop has failed 3+ consecutive ticks (a "
+        "permanently crashing reconciler is visible, not just logged), "
+        "by task",
+        labelnames=("task",),
+    )
+    return r
+
+
+_registry: Optional[Registry] = None
+
+
+def get_reconcile_registry() -> Registry:
+    global _registry
+    if _registry is None:
+        _registry = new_reconcile_registry()
+    return _registry
+
+
+# ---------------------------------------------------------------------------
+# enqueue (producer side: state transitions)
+# ---------------------------------------------------------------------------
+
+
+async def enqueue(
+    db: Database,
+    queue: str,
+    entity_id: str,
+    shard_key: Optional[str] = None,
+    delay: float = 0.0,
+) -> bool:
+    """Enqueue a targeted revisit of ``entity_id`` on ``queue``.
+
+    Fire-and-forget: a wakeup is an acceleration, not the source of
+    truth — any failure here (including the injected ``db.notify``
+    fault) is logged + counted and the entity converges via the
+    safety-net sweep instead. Returns True when the upsert landed.
+
+    The upsert dedups by (queue, entity_id): an existing unclaimed row
+    keeps its earlier ``due_at`` (no postponement by later events); a
+    claimed row gets ``generation`` bumped so the in-flight worker's
+    ack releases it for redelivery instead of deleting it.
+    """
+    from datetime import timedelta
+
+    reg = get_reconcile_registry()
+    now = now_utc().isoformat()
+    due = (
+        now
+        if delay <= 0
+        else (now_utc() + timedelta(seconds=delay)).isoformat()
+    )
+    try:
+        # the event-loss injection point: raising here loses the wakeup
+        # exactly like a process crash between commit and notify would
+        await faults.afire("db.notify", queue=queue, entity=str(entity_id))
+        await db.execute(
+            "INSERT INTO wakeups "
+            "(queue, entity_id, shard_hash, generation, attempts, due_at, "
+            "enqueued_at) VALUES (?, ?, ?, 0, 0, ?, ?) "
+            "ON CONFLICT (queue, entity_id) DO UPDATE SET "
+            "generation = wakeups.generation + 1, "
+            "attempts = 0, "
+            "enqueued_at = CASE WHEN wakeups.claimed_by IS NULL "
+            "  THEN wakeups.enqueued_at ELSE excluded.enqueued_at END, "
+            "due_at = CASE WHEN wakeups.claimed_by IS NULL "
+            "  AND wakeups.due_at <= excluded.due_at "
+            "  THEN wakeups.due_at ELSE excluded.due_at END",
+            (queue, str(entity_id), shard_hash(shard_key or entity_id), due, now),
+        )
+    except Exception as e:
+        reg.family("dtpu_reconcile_wakeups_lost_total").inc(1, queue)
+        logger.warning(
+            "wakeup enqueue lost (queue=%s entity=%s): %r — safety-net "
+            "sweep will converge it",
+            queue, entity_id, e,
+        )
+        return False
+    reg.family("dtpu_reconcile_wakeups_enqueued_total").inc(1, queue)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# claim / ack / release (consumer side: drain workers)
+# ---------------------------------------------------------------------------
+
+
+async def claim(
+    db: Database,
+    queue: str,
+    shard: int,
+    nshards: int,
+    limit: int,
+    lease_seconds: float,
+    worker: Optional[str] = None,
+) -> list[dict]:
+    """Claim up to ``limit`` due wakeups for shard ``shard`` of
+    ``nshards`` under a lease. Returns the claimed rows (entity_id,
+    generation, attempts, enqueued_at, stolen).
+
+    Eligible rows: unclaimed ones belonging to this shard, plus ANY
+    row whose lease already expired (work stealing — a crashed
+    worker's batch must not wait for its own shard to come back).
+    The claim itself is one CAS UPDATE stamping a per-call worker
+    token; concurrent claimers (other shards, other server replicas)
+    can each win only disjoint subsets.
+    """
+    from datetime import timedelta
+
+    await faults.afire("reconciler.lease", queue=queue, shard=str(shard))
+    now = now_utc()
+    now_s = now.isoformat()
+    token = worker or f"{queue}:{shard}:{uuid.uuid4().hex[:8]}"
+    cand = await db.fetchall(
+        "SELECT entity_id, claimed_by FROM wakeups "
+        "WHERE queue = ? AND due_at <= ? AND ("
+        "  (claimed_by IS NULL AND shard_hash % ? = ?) "
+        "  OR (claimed_by IS NOT NULL AND lease_expires_at <= ?)"
+        ") ORDER BY due_at ASC LIMIT ?",
+        (queue, now_s, nshards, shard, now_s, limit),
+    )
+    if not cand:
+        return []
+    stolen_ids = {r["entity_id"] for r in cand if r["claimed_by"] is not None}
+    lease = (now + timedelta(seconds=lease_seconds)).isoformat()
+    ids = [r["entity_id"] for r in cand]
+    ph = ",".join("?" for _ in ids)
+    # CAS: re-checks eligibility inside the UPDATE so a row another
+    # worker claimed between the SELECT and here is skipped
+    await db.execute(
+        f"UPDATE wakeups SET claimed_by = ?, lease_expires_at = ?, "
+        f"attempts = attempts + 1 "
+        f"WHERE queue = ? AND entity_id IN ({ph}) AND due_at <= ? "
+        f"AND (claimed_by IS NULL OR lease_expires_at <= ?)",
+        (token, lease, queue, *ids, now_s, now_s),
+    )
+    rows = await db.fetchall(
+        "SELECT entity_id, generation, attempts, enqueued_at FROM wakeups "
+        "WHERE queue = ? AND claimed_by = ?",
+        (queue, token),
+    )
+    reg = get_reconcile_registry()
+    if rows:
+        reg.family("dtpu_reconcile_wakeups_delivered_total").inc(
+            len(rows), queue
+        )
+        stolen = sum(1 for r in rows if r["entity_id"] in stolen_ids)
+        if stolen:
+            reg.family("dtpu_reconcile_wakeups_stolen_total").inc(stolen, queue)
+        hist = reg.family("dtpu_reconcile_reaction_seconds")
+        for r in rows:
+            t0 = parse_dt(r["enqueued_at"])
+            if t0 is not None:
+                hist.observe(max(0.0, (now - t0).total_seconds()), queue)
+    for r in rows:
+        r["claimed_by"] = token
+    return rows
+
+
+async def ack(db: Database, queue: str, row: dict) -> None:
+    """Acknowledge one processed wakeup. Deletes the row only when no
+    new event arrived while it was claimed (same ``generation``, still
+    our claim); otherwise releases it for prompt redelivery — the
+    arriving event must not be swallowed by the ack."""
+    n = await db.execute(
+        "DELETE FROM wakeups WHERE queue = ? AND entity_id = ? "
+        "AND generation = ? AND claimed_by = ?",
+        (queue, row["entity_id"], row["generation"], row["claimed_by"]),
+    )
+    reg = get_reconcile_registry()
+    if n:
+        reg.family("dtpu_reconcile_wakeups_acked_total").inc(1, queue)
+        return
+    # generation bumped (new event mid-processing) or lease stolen:
+    # release our claim if it is still ours so the row redelivers now
+    released = await db.execute(
+        "UPDATE wakeups SET claimed_by = NULL, lease_expires_at = NULL, "
+        "attempts = 0, due_at = ? WHERE queue = ? AND entity_id = ? "
+        "AND claimed_by = ?",
+        (now_utc().isoformat(), queue, row["entity_id"], row["claimed_by"]),
+    )
+    if released:
+        reg.family("dtpu_reconcile_wakeups_redelivered_total").inc(1, queue)
+
+
+async def release(
+    db: Database,
+    queue: str,
+    row: dict,
+    retry_delay: float,
+    max_attempts: int,
+) -> None:
+    """Give a claimed-but-unprocessed wakeup back (handler error or
+    entity-lock contention): unclaim with a backoff ``due_at`` so a
+    sibling retries, unless the delivery budget is spent — then drop
+    it (the safety-net sweep still owns the entity; a poison entity
+    must not hot-loop the drain worker)."""
+    from datetime import timedelta
+
+    reg = get_reconcile_registry()
+    if int(row.get("attempts") or 0) >= max_attempts:
+        n = await db.execute(
+            "DELETE FROM wakeups WHERE queue = ? AND entity_id = ? "
+            "AND generation = ? AND claimed_by = ?",
+            (queue, row["entity_id"], row["generation"], row["claimed_by"]),
+        )
+        if n:
+            reg.family("dtpu_reconcile_wakeups_dropped_total").inc(1, queue)
+            logger.warning(
+                "wakeup dropped after %s deliveries (queue=%s entity=%s); "
+                "safety-net sweep owns the entity now",
+                row.get("attempts"), queue, row["entity_id"],
+            )
+            return
+        # generation moved: fall through to an ordinary release (the
+        # fresh event deserves a fresh budget — attempts reset below)
+    due = (now_utc() + timedelta(seconds=max(0.0, retry_delay))).isoformat()
+    released = await db.execute(
+        "UPDATE wakeups SET claimed_by = NULL, lease_expires_at = NULL, "
+        "due_at = ? WHERE queue = ? AND entity_id = ? AND claimed_by = ?",
+        (due, queue, row["entity_id"], row["claimed_by"]),
+    )
+    if released:
+        reg.family("dtpu_reconcile_wakeups_redelivered_total").inc(1, queue)
+
+
+async def queue_depth(db: Database, queue: str) -> int:
+    row = await db.fetchone(
+        "SELECT COUNT(*) AS n FROM wakeups WHERE queue = ?", (queue,)
+    )
+    return int(row["n"]) if row else 0
+
+
+# ---------------------------------------------------------------------------
+# producer conveniences (which queue does a job status belong to?)
+# ---------------------------------------------------------------------------
+
+#: job status value -> the queue whose reconciler owns that status
+JOB_STATUS_QUEUE = {
+    "submitted": "submitted_jobs",
+    "provisioning": "running_jobs",
+    "pulling": "running_jobs",
+    "running": "running_jobs",
+    "terminating": "terminating_jobs",
+}
+
+
+async def wake_job(
+    db: Database, job_id: str, status_value: str, run_id: Optional[str] = None
+) -> None:
+    """Targeted revisit of a job after a status write: the owning job
+    queue plus the run aggregation queue (a job transition is exactly
+    what changes a run's aggregate). Terminal job statuses have no job
+    queue — only the run reacts."""
+    q = JOB_STATUS_QUEUE.get(status_value)
+    if q is not None:
+        await enqueue(db, q, job_id, shard_key=run_id or job_id)
+    if run_id is not None:
+        await enqueue(db, "runs", run_id)
+
+
+async def wake_submitted_jobs_in_project(
+    db: Database, project_id: str, limit: Optional[int] = None
+) -> None:
+    """Instance-freed event: wake the project's highest-priority
+    waiting SUBMITTED jobs so one of them grabs the capacity this
+    tick-fraction, not next sweep. Bounded fan-out (one batch's
+    worth)."""
+    lim = limit if limit is not None else settings.MAX_PROCESSING_JOBS
+    rows = await db.fetchall(
+        "SELECT j.id AS id, j.run_id AS run_id FROM jobs j "
+        "JOIN runs r ON j.run_id = r.id "
+        "WHERE j.project_id = ? AND j.status = 'submitted' "
+        "ORDER BY r.priority DESC, j.last_processed_at ASC, j.id ASC LIMIT ?",
+        (project_id, lim),
+    )
+    for r in rows:
+        await enqueue(db, "submitted_jobs", r["id"], shard_key=r["run_id"])

@@ -13,6 +13,14 @@ def _env_int(name: str, default: int) -> int:
     return int(v) if v else default
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.getenv(name)
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
 def _env_bool(name: str, default: bool = False) -> bool:
     v = os.getenv(name)
     if v is None:
@@ -51,6 +59,18 @@ MAX_PROCESSING_RUNS = _env_int("DTPU_MAX_PROCESSING_RUNS", 15)
 MAX_PROCESSING_JOBS = _env_int("DTPU_MAX_PROCESSING_JOBS", 15)
 MAX_PROCESSING_INSTANCES = _env_int("DTPU_MAX_PROCESSING_INSTANCES", 15)
 MAX_OFFERS_TRIED = _env_int("DTPU_MAX_OFFERS_TRIED", 25)
+
+# Event-driven reconciliation (docs/reference/server.md
+# "Reconciliation & wakeups"): state transitions enqueue targeted
+# revisits into the durable wakeup queue; sharded drain workers deliver
+# them at WAKEUP_POLL_INTERVAL so reaction latency decouples from the
+# safety-net sweep cadence. RECONCILER_SHARDS=0 disables the event
+# path entirely (pure-sweep mode).
+RECONCILER_SHARDS = _env_int("DTPU_RECONCILER_SHARDS", 2)
+WAKEUP_POLL_INTERVAL = _env_float("DTPU_WAKEUP_POLL_INTERVAL", 0.25)
+WAKEUP_LEASE_SECONDS = _env_float("DTPU_WAKEUP_LEASE_SECONDS", 10.0)
+WAKEUP_BATCH = _env_int("DTPU_WAKEUP_BATCH", 15)
+WAKEUP_MAX_ATTEMPTS = _env_int("DTPU_WAKEUP_MAX_ATTEMPTS", 5)
 
 # Graceful replica drain budget (seconds): a scaled-down service
 # replica stops receiving new requests immediately but keeps serving

@@ -126,6 +126,23 @@ def default_should_retry(exc: BaseException) -> bool:
     return type(exc).__module__.startswith("aiohttp")
 
 
+def should_retry_non_idempotent(exc: BaseException) -> bool:
+    """Classifier for NON-idempotent operations (create_instance-style
+    calls): retry only failures that prove the request never landed —
+    a connection refused/reset before a response, or an explicit 429
+    rejection. Timeouts and 5xx are AMBIGUOUS (the create may have
+    succeeded with the response lost); retrying those can
+    double-provision billed resources, so they propagate."""
+    status = getattr(exc, "status", None)
+    if isinstance(status, int):
+        return status == 429
+    if isinstance(exc, (TimeoutError, asyncio.TimeoutError)):
+        return False
+    if isinstance(exc, ConnectionError):
+        return True
+    return False
+
+
 def retry_after_hint(exc: BaseException) -> Optional[float]:
     """The server-provided wait, when the error carries one."""
     ra = getattr(exc, "retry_after", None)

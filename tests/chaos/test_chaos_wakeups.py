@@ -1,0 +1,459 @@
+"""Wakeup-queue invariants: the event-driven reconciliation path under
+lost, duplicated, and crash-interrupted deliveries.
+
+Pinned here:
+
+- queue semantics: dedup by entity, generation guard, lease CAS,
+  shard disjointness, expired-lease work stealing, bounded redelivery;
+- a DROPPED wakeup (injected ``db.notify`` fault) loses nothing — the
+  safety-net sweep converges the entity within one sweep;
+- a DUPLICATED wakeup/delivery produces exactly one terminal
+  transition and no duplicate ``run_events`` rows (handler
+  idempotency is what makes at-least-once delivery safe);
+- a worker killed mid-batch (injected ``reconciler.wakeup`` fault)
+  leaves its claims leased; after lease expiry a SIBLING shard steals
+  and processes them.
+"""
+
+import asyncio
+
+import pytest
+
+from dstack_tpu import faults
+from dstack_tpu.core.models.runs import JobStatus, RunStatus
+from dstack_tpu.server import settings
+from dstack_tpu.server.background.wakeup_drain import drain_queue
+from dstack_tpu.server.services import jobs as jobs_service
+from dstack_tpu.server.services import runs as runs_service
+from dstack_tpu.server.services import wakeups
+from dstack_tpu.server.testing.common import (
+    FakeCompute,
+    cpu_offer,
+    create_test_db,
+    create_test_project,
+    create_test_user,
+    install_fake_backend,
+    make_run_spec,
+)
+
+TASK = {"type": "task", "commands": ["python train.py"],
+        "resources": {"tpu": "v5e-8"}}
+
+
+async def _stack(run_name: str):
+    db = await create_test_db()
+    _, user_row = await create_test_user(db)
+    project_row = await create_test_project(db, user_row)
+    install_fake_backend(project_row, FakeCompute(offers=[cpu_offer()]))
+    run = await runs_service.submit_run(
+        db, project_row, user_row, make_run_spec(TASK, run_name)
+    )
+    return db, project_row, run
+
+
+async def _clear_queue(db):
+    await db.execute("DELETE FROM wakeups", ())
+
+
+def _reg():
+    return wakeups.get_reconcile_registry()
+
+
+class TestWakeupQueueSemantics:
+    async def test_enqueue_dedups_by_entity_and_bumps_generation(self):
+        db, _, _run = await _stack("wq-dedup")
+        await _clear_queue(db)
+        assert await wakeups.enqueue(db, "runs", "e1")
+        assert await wakeups.enqueue(db, "runs", "e1")
+        rows = await db.fetchall(
+            "SELECT * FROM wakeups WHERE queue = 'runs'"
+        )
+        assert len(rows) == 1
+        assert rows[0]["generation"] == 1  # second enqueue collapsed in
+        # a different queue is a different row
+        await wakeups.enqueue(db, "instances", "e1")
+        assert await wakeups.queue_depth(db, "instances") == 1
+
+    async def test_earlier_due_at_wins_while_unclaimed(self):
+        db, _, _run = await _stack("wq-due")
+        await _clear_queue(db)
+        await wakeups.enqueue(db, "runs", "e1")
+        row0 = await db.fetchone(
+            "SELECT due_at FROM wakeups WHERE entity_id = 'e1'"
+        )
+        await wakeups.enqueue(db, "runs", "e1", delay=30.0)
+        row1 = await db.fetchone(
+            "SELECT due_at FROM wakeups WHERE entity_id = 'e1'"
+        )
+        assert row1["due_at"] == row0["due_at"]  # no postponement
+
+    async def test_claim_is_exclusive_until_lease_expires(self):
+        db, _, _run = await _stack("wq-claim")
+        await _clear_queue(db)
+        await wakeups.enqueue(db, "runs", "e1")
+        got = await wakeups.claim(db, "runs", 0, 1, 10, lease_seconds=30)
+        assert [r["entity_id"] for r in got] == ["e1"]
+        # second claim sees nothing: the lease is live
+        again = await wakeups.claim(db, "runs", 0, 1, 10, lease_seconds=30)
+        assert again == []
+
+    async def test_shards_claim_disjoint_sets(self):
+        db, _, _run = await _stack("wq-shards")
+        await _clear_queue(db)
+        ids = [f"ent-{i}" for i in range(16)]
+        for e in ids:
+            await wakeups.enqueue(db, "runs", e)
+        got0 = await wakeups.claim(db, "runs", 0, 2, 100, lease_seconds=30)
+        got1 = await wakeups.claim(db, "runs", 1, 2, 100, lease_seconds=30)
+        s0 = {r["entity_id"] for r in got0}
+        s1 = {r["entity_id"] for r in got1}
+        assert s0.isdisjoint(s1)
+        assert s0 | s1 == set(ids)
+        # shard routing is the stable run-id hash
+        for e in s0:
+            assert wakeups.shard_hash(e) % 2 == 0
+        for e in s1:
+            assert wakeups.shard_hash(e) % 2 == 1
+
+    async def test_expired_lease_is_stolen_by_any_shard(self):
+        db, _, _run = await _stack("wq-steal")
+        await _clear_queue(db)
+        await wakeups.enqueue(db, "runs", "victim")
+        own_shard = wakeups.shard_hash("victim") % 2
+        other_shard = 1 - own_shard
+        got = await wakeups.claim(
+            db, "runs", own_shard, 2, 10, lease_seconds=0.0
+        )
+        assert got, "own shard must claim first"
+        before = _reg().family("dtpu_reconcile_wakeups_stolen_total").value(
+            "runs"
+        )
+        await asyncio.sleep(0.01)  # lease (0s) is already expired
+        stolen = await wakeups.claim(
+            db, "runs", other_shard, 2, 10, lease_seconds=30
+        )
+        assert [r["entity_id"] for r in stolen] == ["victim"]
+        assert stolen[0]["attempts"] == 2  # second delivery
+        after = _reg().family("dtpu_reconcile_wakeups_stolen_total").value(
+            "runs"
+        )
+        assert after == before + 1
+        # the original claimant's ack is now a no-op (claim moved on)
+        await wakeups.ack(db, "runs", got[0])
+        assert await wakeups.queue_depth(db, "runs") == 1
+
+    async def test_ack_honors_generation_guard(self):
+        """An event arriving while the row is claimed must survive the
+        ack: the row releases for prompt redelivery instead of being
+        deleted."""
+        db, _, _run = await _stack("wq-gen")
+        await _clear_queue(db)
+        await wakeups.enqueue(db, "runs", "e1")
+        got = await wakeups.claim(db, "runs", 0, 1, 10, lease_seconds=30)
+        assert got
+        # a new event lands mid-processing
+        await wakeups.enqueue(db, "runs", "e1")
+        await wakeups.ack(db, "runs", got[0])
+        assert await wakeups.queue_depth(db, "runs") == 1  # not swallowed
+        redelivered = await wakeups.claim(
+            db, "runs", 0, 1, 10, lease_seconds=30
+        )
+        assert [r["entity_id"] for r in redelivered] == ["e1"]
+        # clean ack with a stable generation deletes
+        await wakeups.ack(db, "runs", redelivered[0])
+        assert await wakeups.queue_depth(db, "runs") == 0
+
+    async def test_release_drops_after_attempt_budget(self):
+        db, _, _run = await _stack("wq-drop")
+        await _clear_queue(db)
+        await wakeups.enqueue(db, "runs", "poison")
+        before = _reg().family("dtpu_reconcile_wakeups_dropped_total").value(
+            "runs"
+        )
+        for _ in range(3):
+            got = await wakeups.claim(
+                db, "runs", wakeups.shard_hash("poison") % 1, 1, 10,
+                lease_seconds=30,
+            )
+            assert got
+            await wakeups.release(
+                db, "runs", got[0], retry_delay=0.0, max_attempts=3
+            )
+        assert await wakeups.queue_depth(db, "runs") == 0
+        after = _reg().family("dtpu_reconcile_wakeups_dropped_total").value(
+            "runs"
+        )
+        assert after == before + 1
+
+
+class TestTransitionsEnqueueWakeups:
+    async def test_submit_and_status_writes_enqueue_targeted_revisits(self):
+        db, _, run = await _stack("wq-sites")
+        queues = {
+            r["queue"]: r for r in await db.fetchall("SELECT * FROM wakeups")
+        }
+        # submit enqueued the run aggregation AND the job scheduling visit
+        assert "runs" in queues
+        assert "submitted_jobs" in queues
+        job = await db.fetchone(
+            "SELECT * FROM jobs WHERE run_id = ?", (run.id,)
+        )
+        await _clear_queue(db)
+        await jobs_service.update_job_status(
+            db, job["id"], JobStatus.TERMINATING, run_id=run.id
+        )
+        queues = {
+            r["queue"] for r in await db.fetchall("SELECT * FROM wakeups")
+        }
+        assert queues == {"terminating_jobs", "runs"}
+        # shard key is the run id: the job's wakeup routes by run hash
+        row = await db.fetchone(
+            "SELECT shard_hash FROM wakeups WHERE queue = 'terminating_jobs'"
+        )
+        assert row["shard_hash"] == wakeups.shard_hash(run.id)
+
+
+class TestSubmittedDrainPriorityGate:
+    async def test_outranked_wakeup_defers_to_the_sweep(self):
+        """The event path must not let a low-priority submission jump
+        PR-6's strict tiers: while a strictly-higher-priority SUBMITTED
+        job waits, the low-priority job's wakeup is a no-op (the
+        fair-share sweep owns the ordering); equal/highest-priority
+        wakeups process normally."""
+        db, project_row, run = await _stack("wq-prio-hi")
+        await db.execute(
+            "UPDATE runs SET priority = 90 WHERE id = ?", (run.id,)
+        )
+        from dstack_tpu.server.background.tasks.process_submitted_jobs import (
+            reconcile_one,
+        )
+        from dstack_tpu.server.services import runs as runs_service
+        from dstack_tpu.server.services import users as users_service
+        from dstack_tpu.server.testing.common import make_run_spec
+
+        user_row = await db.fetchone("SELECT * FROM users LIMIT 1")
+        low = await runs_service.submit_run(
+            db, project_row, user_row, make_run_spec(TASK, "wq-prio-lo")
+        )
+        await db.execute(
+            "UPDATE runs SET priority = 10 WHERE id = ?", (low.id,)
+        )
+        lo_job = await db.fetchone(
+            "SELECT * FROM jobs WHERE run_id = ?", (low.id,)
+        )
+        hi_job = await db.fetchone(
+            "SELECT * FROM jobs WHERE run_id = ?", (run.id,)
+        )
+        # outranked: the low-priority wakeup is dropped untouched
+        await reconcile_one(db, lo_job["id"])
+        row = await db.get_by_id("jobs", lo_job["id"])
+        assert row["status"] == JobStatus.SUBMITTED.value
+        # the top tier processes via the event path
+        await reconcile_one(db, hi_job["id"])
+        row = await db.get_by_id("jobs", hi_job["id"])
+        assert row["status"] != JobStatus.SUBMITTED.value
+        # with the high tier drained, the low job's next wakeup works
+        await reconcile_one(db, lo_job["id"])
+        row = await db.get_by_id("jobs", lo_job["id"])
+        assert row["status"] != JobStatus.SUBMITTED.value
+
+
+class TestQueueDepthGauge:
+    async def test_drained_queue_reports_zero(self):
+        db, _, run = await _stack("wq-depth")
+        await db.execute(
+            "UPDATE jobs SET status = ? WHERE run_id = ?",
+            (JobStatus.DONE.value, run.id),
+        )
+        await db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?",
+            (RunStatus.RUNNING.value, run.id),
+        )
+        await _clear_queue(db)
+        await wakeups.enqueue(db, "runs", run.id)
+        from dstack_tpu.server.background.tasks.process_runs import (
+            reconcile_one,
+        )
+
+        await drain_queue(
+            db, "runs", reconcile_one, "runs",
+            wakeups.shard_hash(run.id) % settings.RECONCILER_SHARDS,
+            settings.RECONCILER_SHARDS,
+        )
+        gauge = _reg().family("dtpu_reconcile_queue_depth")
+        assert gauge.value("runs") == 0  # post-ack sample, not pre-ack
+
+
+class TestDroppedWakeupConvergesViaSweep:
+    async def test_db_notify_fault_loses_events_sweep_converges(
+        self, fault_plan
+    ):
+        """Every enqueue dies (injected db.notify fault) → the wakeups
+        table stays empty, state transitions are unaffected, and ONE
+        safety-net sweep pass still visits the entity."""
+        before_lost = _reg().family("dtpu_reconcile_wakeups_lost_total")
+        lost0 = before_lost.value("runs")
+        fault_plan({"rules": [
+            {"point": "db.notify", "action": "raise", "error": "oserror"},
+        ]})
+        db, _, run = await _stack("wq-lost")
+        assert await db.fetchall("SELECT * FROM wakeups") == []
+        assert before_lost.value("runs") > lost0
+        faults.clear()
+        # the transition COMMITTED despite the lost wakeup; one sweep
+        # pass of the owning loop converges the entity
+        await db.execute(
+            "UPDATE jobs SET status = ? WHERE run_id = ?",
+            (JobStatus.DONE.value, run.id),
+        )
+        await db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?",
+            (RunStatus.RUNNING.value, run.id),
+        )
+        from dstack_tpu.server.background.tasks.process_runs import (
+            process_runs,
+        )
+
+        await process_runs(db)
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.TERMINATING.value
+
+
+class TestDuplicateDeliveryIdempotency:
+    async def test_duplicate_run_wakeups_one_terminal_event(self):
+        """Deliver 'revisit run' three times across its terminal
+        transition: exactly one terminating + one done event, and the
+        terminal state is never resurrected."""
+        db, _, run = await _stack("wq-dup")
+        await db.execute(
+            "UPDATE jobs SET status = ? WHERE run_id = ?",
+            (JobStatus.DONE.value, run.id),
+        )
+        await db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?",
+            (RunStatus.RUNNING.value, run.id),
+        )
+        await db.execute(
+            "DELETE FROM run_events WHERE run_id = ?", (run.id,)
+        )
+        await _clear_queue(db)
+        from dstack_tpu.server.background.tasks.process_runs import (
+            reconcile_one,
+        )
+
+        for _ in range(2):
+            await wakeups.enqueue(db, "runs", run.id)
+            visited = await drain_queue(
+                db, "runs", reconcile_one, "runs",
+                wakeups.shard_hash(run.id) % settings.RECONCILER_SHARDS,
+                settings.RECONCILER_SHARDS,
+            )
+            assert visited == 1
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.DONE.value
+        # duplicate wakeup AFTER the terminal state: a no-op, no
+        # resurrection, no extra events
+        await wakeups.enqueue(db, "runs", run.id)
+        await drain_queue(
+            db, "runs", reconcile_one, "runs",
+            wakeups.shard_hash(run.id) % settings.RECONCILER_SHARDS,
+            settings.RECONCILER_SHARDS,
+        )
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.DONE.value
+        events = [
+            e["event"] for e in await db.fetchall(
+                "SELECT event FROM run_events WHERE run_id = ?", (run.id,)
+            )
+        ]
+        assert events.count("terminating") == 1
+        assert events.count("done") == 1
+
+    async def test_double_delivery_of_terminating_job_one_terminal_event(
+        self, monkeypatch
+    ):
+        """The same wakeup delivered twice (lease-expiry steal) drives
+        the terminating handler twice; the second visit no-ops on the
+        already-terminal job — one terminal run_events row."""
+        db, _, run = await _stack("wq-dup-job")
+        job = await db.fetchone(
+            "SELECT * FROM jobs WHERE run_id = ?", (run.id,)
+        )
+        await jobs_service.update_job_status(
+            db, job["id"], JobStatus.TERMINATING, run_id=run.id
+        )
+        await db.execute("DELETE FROM run_events WHERE run_id = ?", (run.id,))
+        await _clear_queue(db)
+        await wakeups.enqueue(db, "terminating_jobs", job["id"])
+        # force double delivery: first claim's lease expires instantly
+        monkeypatch.setattr(settings, "WAKEUP_LEASE_SECONDS", 0.0)
+        got = await wakeups.claim(
+            db, "terminating_jobs",
+            wakeups.shard_hash(job["id"]) % 1, 1, 10, lease_seconds=0.0,
+        )
+        assert got
+        from dstack_tpu.server.background.tasks.process_terminating_jobs import (
+            reconcile_one,
+        )
+
+        await reconcile_one(db, job["id"])  # delivery 1 processes
+        # delivery 2 (stolen) re-runs the handler on the terminal job
+        await reconcile_one(db, job["id"])
+        row = await db.get_by_id("jobs", job["id"])
+        assert JobStatus(row["status"]).is_finished()
+        terminal_events = [
+            e["event"] for e in await db.fetchall(
+                "SELECT event FROM run_events WHERE run_id = ? AND job_id = ?",
+                (run.id, job["id"]),
+            )
+            if e["event"] in ("done", "failed", "terminated", "aborted")
+        ]
+        assert len(terminal_events) == 1, terminal_events
+
+
+class TestWorkerCrashMidBatch:
+    async def test_crash_after_claim_redelivers_to_sibling_shard(
+        self, fault_plan, monkeypatch
+    ):
+        """A drain worker dies between claiming its batch and
+        processing it (injected reconciler.wakeup raise). Its claims
+        stay leased — invisible to an immediate retry — and after the
+        lease expires a SIBLING shard's pass steals and processes
+        them."""
+        db, _, run = await _stack("wq-crash")
+        await db.execute(
+            "UPDATE jobs SET status = ? WHERE run_id = ?",
+            (JobStatus.DONE.value, run.id),
+        )
+        await db.execute(
+            "UPDATE runs SET status = ? WHERE id = ?",
+            (RunStatus.RUNNING.value, run.id),
+        )
+        await _clear_queue(db)
+        await wakeups.enqueue(db, "runs", run.id)
+        own = wakeups.shard_hash(run.id) % 2
+        sibling = 1 - own
+        monkeypatch.setattr(settings, "WAKEUP_LEASE_SECONDS", 0.05)
+        from dstack_tpu.server.background.tasks.process_runs import (
+            reconcile_one,
+        )
+
+        fault_plan({"rules": [
+            {"point": "reconciler.wakeup", "action": "raise", "times": 1},
+        ]})
+        with pytest.raises(faults.FaultInjected):
+            await drain_queue(db, "runs", reconcile_one, "runs", own, 2)
+        # the run was NOT processed; its wakeup is leased, not lost
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.RUNNING.value
+        assert await wakeups.queue_depth(db, "runs") == 1
+        # sibling shard can't touch it while the lease lives...
+        # (claim eligibility only opens at lease expiry)
+        await asyncio.sleep(0.1)
+        visited = await drain_queue(
+            db, "runs", reconcile_one, "runs", sibling, 2
+        )
+        assert visited == 1
+        row = await db.get_by_id("runs", run.id)
+        assert row["status"] == RunStatus.TERMINATING.value
+        assert await wakeups.queue_depth(db, "runs") == 0

@@ -1,26 +1,41 @@
-"""Control-plane capacity benchmark.
+"""Control-plane capacity benchmark: the 1500-job envelope.
 
-The reference documents its per-replica capacity as ~150 active
-jobs/runs/instances with <= 2 min processing latency and a 75 jobs/min
-scheduling ceiling (reference server/background/__init__.py:45-56).
-This tool measures the same two numbers for THIS control plane:
+The reference documents ~150 active jobs with a <= 2 min processing
+latency and a 75 jobs/min scheduling ceiling (reference
+server/background/__init__.py:45-56). Since the event-driven wakeup
+layer (docs/reference/server.md "Reconciliation & wakeups") the number
+that matters most is neither of those: it's how fast the control plane
+*reacts to a state change* while carrying a big steady-state load.
+This tool measures all three:
 
-1. **Scheduling ramp**: N runs submitted at once -> time for every job
-   to reach RUNNING through the real reconcilers (jobs/min).
-2. **Steady-state visit latency**: with N RUNNING jobs (+ their
-   instances) the reconcilers keep polling agents; we record every
-   per-job visit and report the p50/p95/max gap between consecutive
-   visits of the same job. Target: max <= 120 s.
+1. **Scheduling ramp** (``--ramp`` runs, default 150): submit→RUNNING
+   through the real pipeline (reconcilers + wakeup drain workers) →
+   jobs/min.
+2. **Steady-state visit latency**: with ``--jobs`` RUNNING jobs total
+   (the non-ramped remainder is bulk-seeded), the safety-net sweeps
+   keep pulling every job's agent; p50/p95/max gap between consecutive
+   visits of one job. Target: max <= 120 s.
+3. **Transition→visit reaction** (``--transitions`` sampled jobs):
+   flip a RUNNING job to TERMINATING mid-window and measure how long
+   until the terminating reconciler actually visits it. The wakeup
+   path makes this independent of the backlog — target p95 < 1 s
+   (the acceptance bar; only the safety-net sweep remains pinned to
+   the polling interval).
 
-Compute + on-host agents are faked (5 ms simulated RTT per call) so the
-measurement isolates the control plane: DB, locking, reconciler
-batching. Engines: sqlite in-memory (default), ``--db pgwire`` (the
-bundled wire-protocol fake Postgres), or ``--db postgres`` with
-``DTPU_TEST_PG_DSN``.
+Compute + on-host agents are faked (5 ms simulated RTT per call) so
+the measurement isolates the control plane: DB, locking, wakeup queue,
+reconciler batching. Engines: sqlite in-memory (default), ``--db
+pgwire`` (the bundled wire-protocol fake Postgres), or ``--db
+postgres`` with ``DTPU_TEST_PG_DSN``.
+
+The run records its knobs in the output: the 1500-job envelope sizes
+the sweep batches to 60 (DTPU_MAX_PROCESSING_*) so a full safety-net
+rotation fits in ~25 s; reaction latency comes from the wakeup path
+and does not depend on that tuning.
 
 Usage::
 
-    python tools/capacity_bench.py --jobs 150 --window 60
+    python tools/capacity_bench.py --jobs 1500 --window 60
 """
 
 import argparse
@@ -28,6 +43,7 @@ import asyncio
 import contextlib
 import json
 import os
+import random
 import statistics
 import sys
 import time
@@ -36,6 +52,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 AGENT_RTT_S = 0.005  # simulated server<->agent round trip
+SWEEP_BATCH = 60  # DTPU_MAX_PROCESSING_* for the 1500-job envelope
 
 
 def _fake_agents():
@@ -68,13 +85,13 @@ def _fake_agents():
                 ports=[agent_schemas.PortMapping(container_port=10999, host_port=10999)],
             )
 
-        async def terminate(self, task_id, timeout_seconds=10, reason=None, message=None):
+        async def terminate_task(self, task_id, timeout=10, reason=None, message=None):
             await asyncio.sleep(AGENT_RTT_S)
             return agent_schemas.TaskInfo(
                 id=task_id, status=agent_schemas.TaskStatus.TERMINATED
             )
 
-        async def remove(self, task_id):
+        async def remove_task(self, task_id):
             await asyncio.sleep(AGENT_RTT_S)
 
     class FakeRunner:
@@ -114,13 +131,112 @@ def _fake_agents():
     return shim_client_for, runner_client_for
 
 
-async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
+async def _seed_running_jobs(db, project_row, user_row, n: int) -> None:
+    """Bulk-seed n runs × 1 job each directly in RUNNING (+ their BUSY
+    instances): the steady-state load the reaction measurement runs
+    against, without paying a 1500-run provisioning ramp per engine."""
+    if n <= 0:
+        return
+    from dstack_tpu.core.models.runs import new_uuid, now_utc
+    from dstack_tpu.server.db import dumps
+    from dstack_tpu.server.services.jobs.configurators import (
+        get_job_specs_from_run_spec,
+    )
+    from dstack_tpu.server.testing.common import cpu_offer, make_run_spec
+
+    conf = {"type": "task", "commands": ["python train.py"]}
+    spec_template = make_run_spec(conf, "seed-template")
+    job_spec = get_job_specs_from_run_spec(spec_template, 0)[0]
+    offer = cpu_offer()
+    jpd_template = {
+        "backend": "local",
+        "instance_type": offer.instance.model_dump(),
+        "instance_id": "seeded",
+        "hostname": "127.0.0.1",
+        "internal_ip": "127.0.0.1",
+        "region": offer.region,
+        "price": offer.price,
+        "username": "bench",
+        "ssh_port": 22,
+        "dockerized": False,
+        "worker_id": 0,
+        "hosts": [],
+    }
+    now = now_utc().isoformat()
+    run_rows, inst_rows, job_rows = [], [], []
+    for i in range(n):
+        name = f"seed-{i:05d}"
+        run_id, inst_id, job_id = new_uuid(), new_uuid(), new_uuid()
+        spec = spec_template.model_copy(update={"run_name": name})
+        run_rows.append((
+            run_id, project_row["id"], user_row["id"], name, "running",
+            dumps(spec), 1, 0, now, now,
+        ))
+        inst_rows.append((
+            inst_id, project_row["id"], f"inst-{name}", "busy", "local",
+            offer.region, dumps({**jpd_template, "instance_id": inst_id}),
+            now, now,
+        ))
+        jspec = job_spec.model_copy(
+            update={"job_name": f"{name}-0-0", "run_name": name}
+        )
+        job_rows.append((
+            job_id, run_id, name, project_row["id"], 0, 0, 0,
+            f"{name}-0-0", "running", dumps(jspec),
+            dumps({**jpd_template, "instance_id": inst_id}),
+            dumps({"ports": {"10999": 10999}, "pull_cursor": 0.0}),
+            inst_id, 1, now, now,
+        ))
+    await db.executemany(
+        "INSERT INTO runs (id, project_id, user_id, run_name, status, "
+        "run_spec, desired_replica_count, deleted, submitted_at, "
+        "last_processed_at) VALUES (?,?,?,?,?,?,?,?,?,?)",
+        run_rows,
+    )
+    await db.executemany(
+        "INSERT INTO instances (id, project_id, name, status, backend, "
+        "region, job_provisioning_data, created_at, last_processed_at) "
+        "VALUES (?,?,?,?,?,?,?,?,?)",
+        inst_rows,
+    )
+    await db.executemany(
+        "INSERT INTO jobs (id, run_id, run_name, project_id, job_num, "
+        "replica_num, submission_num, job_name, status, job_spec, "
+        "job_provisioning_data, job_runtime_data, instance_id, "
+        "instance_assigned, submitted_at, last_processed_at) "
+        "VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?,?,?,?)",
+        job_rows,
+    )
+
+
+def _quantile(vals, q):
+    if not vals:
+        return None
+    ordered = sorted(vals)
+    ix = min(len(ordered) - 1, max(0, int(round(q * (len(ordered) - 1)))))
+    return round(ordered[ix], 3)
+
+
+async def bench(
+    n_jobs: int,
+    window_s: float,
+    engine: str,
+    ramp_n: int,
+    transitions: int,
+) -> dict:
     os.environ.setdefault("DTPU_LOG_LEVEL", "warning")
+    # envelope tuning (recorded in the result): sweep batches sized so
+    # one full safety-net rotation over n_jobs fits well inside 120 s
+    os.environ.setdefault("DTPU_MAX_PROCESSING_JOBS", str(SWEEP_BATCH))
+    os.environ.setdefault("DTPU_MAX_PROCESSING_RUNS", str(SWEEP_BATCH))
+    os.environ.setdefault("DTPU_MAX_PROCESSING_INSTANCES", str(SWEEP_BATCH))
     if engine in ("postgres", "pgwire"):
         os.environ["DTPU_TEST_DB"] = engine
     else:
         os.environ.pop("DTPU_TEST_DB", None)
 
+    from dstack_tpu.core.models.runs import JobStatus, JobTerminationReason
+    from dstack_tpu.server import settings
     from dstack_tpu.server.background.tasks import (
         process_metrics,
         process_running_jobs,
@@ -133,6 +249,11 @@ async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
     from dstack_tpu.server.background.tasks.process_submitted_jobs import (
         process_submitted_jobs,
     )
+    from dstack_tpu.server.background.wakeup_drain import (
+        drain_queue,
+        queue_bindings,
+    )
+    from dstack_tpu.server.services import jobs as jobs_service
     from dstack_tpu.server.services import runs as runs_service
     from dstack_tpu.server.services.logs import FileLogStorage, set_log_storage
     from dstack_tpu.server.testing.common import (
@@ -165,6 +286,17 @@ async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
 
     process_running_jobs._process_running = tracked_running
 
+    # record the first terminating-reconciler visit per job (the
+    # transition→visit reaction measurement)
+    term_visits: dict[str, float] = {}
+    orig_term = process_terminating_jobs._process
+
+    async def tracked_term(db, job_id):
+        term_visits.setdefault(job_id, time.monotonic())
+        return await orig_term(db, job_id)
+
+    process_terminating_jobs._process = tracked_term
+
     db = await create_test_db()
     _user, user_row = await create_test_user(db)
     project_row = await create_test_project(db, user_row)
@@ -172,16 +304,22 @@ async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
     compute = FakeCompute(offers=[cpu_offer()])
     install_fake_backend(project_row, compute)
 
+    seeded = max(0, n_jobs - ramp_n)
+    t0 = time.monotonic()
+    await _seed_running_jobs(db, project_row, user_row, seeded)
+    seed_s = time.monotonic() - t0
+
     conf = {"type": "task", "commands": ["python train.py"]}
     t_submit = time.monotonic()
-    for i in range(n_jobs):
+    for i in range(ramp_n):
         await runs_service.submit_run(
             db, project_row, user_row,
             make_run_spec(conf, f"cap-{i:04d}"),
         )
 
-    # drive the loops at their production intervals
-    # (server/background/__init__.py)
+    # drive the sweeps at their production intervals (the safety net)
+    # plus the sharded wakeup drain workers (the event path) — exactly
+    # what server/background/__init__.py registers
     loops = [
         (process_runs, 2.0),
         (process_submitted_jobs, 1.0),
@@ -197,7 +335,7 @@ async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
             try:
                 await fn(db)
             except Exception as e:  # pragma: no cover - surfacing only
-                print(f"loop {fn.__name__} error: {e}", file=sys.stderr)
+                print(f"loop error: {e}", file=sys.stderr)
             elapsed = time.monotonic() - t0
             with contextlib.suppress(asyncio.TimeoutError):
                 await asyncio.wait_for(
@@ -205,10 +343,27 @@ async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
                 )
 
     tasks = [asyncio.create_task(drive(fn, iv)) for fn, iv in loops]
+    nshards = max(1, settings.RECONCILER_SHARDS)
+    for queue, handler, namespace in queue_bindings():
+        for shard in range(nshards):
+            def make(queue=queue, handler=handler, namespace=namespace,
+                     shard=shard):
+                async def one_drain(db):
+                    await drain_queue(
+                        db, queue, handler, namespace, shard, nshards
+                    )
+                return one_drain
+
+            tasks.append(
+                asyncio.create_task(
+                    drive(make(), settings.WAKEUP_POLL_INTERVAL)
+                )
+            )
 
     # --- phase 1: ramp to all-RUNNING ---
     ramp_s = None
     deadline = time.monotonic() + max(300.0, window_s)
+    last_print = 0.0
     while time.monotonic() < deadline:
         row = await db.fetchone(
             "SELECT COUNT(*) AS n FROM jobs WHERE status = 'running'"
@@ -216,29 +371,90 @@ async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
         if row["n"] >= n_jobs:
             ramp_s = time.monotonic() - t_submit
             break
+        if time.monotonic() - last_print > 10:
+            last_print = time.monotonic()
+            print(
+                f"ramp: {row['n']}/{n_jobs} running "
+                f"({time.monotonic() - t_submit:.0f}s)",
+                file=sys.stderr,
+            )
         await asyncio.sleep(0.5)
 
-    # --- phase 2: steady-state visit latency over the window ---
+    # --- phase 2: steady-state window with injected transitions ---
     visits.clear()
+    term_visits.clear()
+    reactions: list[float] = []
+    flips: dict[str, float] = {}
     t_window = time.monotonic()
+
+    async def inject_transitions():
+        """Flip sampled RUNNING jobs to TERMINATING spread over the
+        window's middle half; reaction = transition commit → first
+        terminating-reconciler visit."""
+        if transitions <= 0:
+            return
+        rows = await db.fetchall(
+            "SELECT id, run_id FROM jobs WHERE status = 'running' "
+            "ORDER BY id LIMIT ?",
+            (n_jobs,),
+        )
+        rng = random.Random(8)
+        sample = rng.sample(rows, min(transitions, len(rows)))
+        gap = (window_s * 0.5) / max(len(sample), 1)
+        await asyncio.sleep(window_s * 0.1)
+        for r in sample:
+            if stop.is_set():
+                break
+            flips[r["id"]] = time.monotonic()
+            await jobs_service.update_job_status(
+                db, r["id"], JobStatus.TERMINATING,
+                termination_reason=JobTerminationReason.TERMINATED_BY_USER,
+                run_id=r["run_id"],
+            )
+            await asyncio.sleep(gap)
+        # wait (bounded) for every flip to be visited
+        flip_deadline = time.monotonic() + 30.0
+        while time.monotonic() < flip_deadline:
+            if all(j in term_visits for j in flips):
+                break
+            await asyncio.sleep(0.05)
+        for j, t_flip in flips.items():
+            if j in term_visits:
+                reactions.append(term_visits[j] - t_flip)
+
+    injector = asyncio.create_task(inject_transitions())
     await asyncio.sleep(window_s)
+    await injector
     stop.set()
     await asyncio.gather(*tasks, return_exceptions=True)
 
     gaps: list[float] = []
     visited = 0
-    for ts in visits.values():
+    for jid, ts in visits.items():
+        if jid in flips:
+            # deliberately terminated mid-window: its visit stream ends
+            # by design, so its trailing edge gap is not starvation
+            continue
         visited += 1
         # include the edge gaps so a job visited once in the whole
         # window still contributes its true starvation time
         seq = [t_window, *ts, t_window + window_s]
         gaps.extend(b - a for a, b in zip(seq, seq[1:]))
+    from dstack_tpu.server.services.wakeups import get_reconcile_registry
+
+    reg = get_reconcile_registry()
     result = {
         "engine": engine,
         "jobs": n_jobs,
+        "ramp_jobs": ramp_n,
+        "seeded_jobs": seeded,
+        "seed_s": round(seed_s, 1),
+        "sweep_batch": SWEEP_BATCH,
+        "reconciler_shards": nshards,
+        "wakeup_poll_interval_s": settings.WAKEUP_POLL_INTERVAL,
         "ramp_to_all_running_s": round(ramp_s, 1) if ramp_s else None,
         "scheduling_rate_per_min": (
-            round(n_jobs / ramp_s * 60, 1) if ramp_s else None
+            round(ramp_n / ramp_s * 60, 1) if ramp_s else None
         ),
         "window_s": window_s,
         "jobs_visited_in_window": visited,
@@ -248,11 +464,23 @@ async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
             if len(gaps) >= 20 else None
         ),
         "visit_gap_max_s": round(max(gaps), 2) if gaps else None,
-        "meets_150_at_2min": bool(
+        "transitions_injected": transitions,
+        "transitions_visited": len(reactions),
+        "reaction_p50_s": _quantile(reactions, 0.50),
+        "reaction_p95_s": _quantile(reactions, 0.95),
+        "reaction_max_s": _quantile(reactions, 1.0),
+        "wakeups_delivered": int(
+            reg.family("dtpu_reconcile_wakeups_delivered_total").value(
+                "terminating_jobs"
+            )
+        ),
+        "meets_envelope": bool(
             ramp_s is not None
-            and visited >= n_jobs
+            and visited >= n_jobs - transitions
             and gaps
             and max(gaps) <= 120.0
+            and len(reactions) >= min(transitions, 1)
+            and (_quantile(reactions, 0.95) or 99.0) < 1.0
         ),
     }
     await db.close()
@@ -261,8 +489,18 @@ async def bench(n_jobs: int, window_s: float, engine: str) -> dict:
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--jobs", type=int, default=150)
+    p.add_argument("--jobs", type=int, default=1500)
     p.add_argument("--window", type=float, default=60.0)
+    p.add_argument(
+        "--ramp", type=int, default=150,
+        help="jobs submitted through the real pipeline (the rest of "
+        "--jobs is bulk-seeded RUNNING)",
+    )
+    p.add_argument(
+        "--transitions", type=int, default=100,
+        help="RUNNING jobs flipped to TERMINATING mid-window for the "
+        "reaction-latency measurement",
+    )
     p.add_argument(
         "--db", default="sqlite", choices=["sqlite", "pgwire", "postgres"]
     )
@@ -275,7 +513,13 @@ def main() -> int:
             "otherwise the bundled pg_wire client (docs/guides/testing.md)",
         }))
         return 2
-    result = asyncio.run(bench(args.jobs, args.window, args.db))
+    result = asyncio.run(
+        bench(
+            args.jobs, args.window, args.db,
+            ramp_n=min(args.ramp, args.jobs),
+            transitions=args.transitions,
+        )
+    )
     print(json.dumps(result))
     return 0
 

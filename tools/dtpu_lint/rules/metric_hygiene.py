@@ -102,6 +102,7 @@ def collect_metric_names(repo: Path) -> set:
     from dstack_tpu.qos.metrics import new_qos_registry
     from dstack_tpu.routing.metrics import new_router_registry
     from dstack_tpu.serve.metrics import new_serve_registry
+    from dstack_tpu.server.services.wakeups import new_reconcile_registry
     from dstack_tpu.server.tracing import RequestStats
     from dstack_tpu.utils.retry import new_retry_registry
 
@@ -110,6 +111,7 @@ def collect_metric_names(repo: Path) -> set:
     names.update(new_router_registry().metric_names())
     names.update(new_retry_registry().metric_names())
     names.update(new_qos_registry().metric_names())
+    names.update(new_reconcile_registry().metric_names())
     try:
         from dstack_tpu.train.step import new_train_registry
 
