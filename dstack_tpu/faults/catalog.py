@@ -76,9 +76,43 @@ POINTS: dict = {
     ),
     "db.commit": (
         "a control-plane DB write commit (server/db.py execute/"
-        "transaction); nth-call targeting provokes mid-transition "
-        "reconciler crashes",
+        "executemany/transaction); nth-call targeting provokes "
+        "mid-transition reconciler crashes",
         ("sql",),
+    ),
+    "db.query": (
+        "a control-plane DB read (server/db.py + db_pg.py "
+        "fetchall/fetchone); raising makes a reconciler's read path "
+        "fail independently of its writes — added when DTPU011 showed "
+        "reads were the one DB path no chaos plan could fail",
+        ("sql",),
+    ),
+    "db.lock": (
+        "a cross-replica advisory-lock claim "
+        "(server/db_pg.py claim_one/claim_batch); raise "
+        "'connect'/'timeout' to starve a reconciler's claim pass "
+        "without touching query traffic",
+        ("namespace",),
+    ),
+    "gateway.auth": (
+        "the gateway's end-user token check against the server "
+        "(gateway/app.py check_user_token); raise 'oserror' to "
+        "exercise the deny-on-unreachable path",
+        ("url",),
+    ),
+    "gateway.agent": (
+        "one server->gateway-agent API call "
+        "(server/services/gateways.py call_agent); raise "
+        "'connect'/'timeout' to make a gateway unreachable per call "
+        "(the None-on-failure contract)",
+        ("gateway", "path"),
+    ),
+    "logs.relay": (
+        "the /logs_ws runner websocket dial "
+        "(server/routers/logs_ws.py); raise 'connect' to fail the "
+        "relay before the client upgrade (clean 502, not a dead "
+        "stream)",
+        ("job",),
     ),
     "db.notify": (
         "a wakeup enqueue (server/services/wakeups.enqueue); raising "

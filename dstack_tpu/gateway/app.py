@@ -33,7 +33,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
-from dstack_tpu import qos
+from dstack_tpu import faults, qos
 from dstack_tpu.gateway.nginx import NginxManager
 from dstack_tpu.gateway.state import GatewayState, Replica, Service
 from dstack_tpu.gateway.stats import AccessLogTailer, GatewayStats
@@ -111,13 +111,19 @@ class GatewayAgent:
         if cached is not None and cached[1] > time.time():
             return cached[0]
         ok = False
+        url = f"{self.server_url.rstrip('/')}/api/users/get_my_user"
         try:
+            await faults.afire("gateway.auth", url=url)
             async with self.session().post(
-                f"{self.server_url.rstrip('/')}/api/users/get_my_user",
+                url,
                 headers={"Authorization": f"Bearer {token}"},
             ) as resp:
                 ok = resp.status == 200
-        except aiohttp.ClientError:
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            # OSError included: a DNS/socket-level failure reaching the
+            # server must deny (and negative-cache) the token, not
+            # escape and 500 the proxied request — the same unmapped-
+            # transport-error class DTPU011 exists to catch
             ok = False
         self._auth_cache[token] = (ok, time.time() + 60.0)
         if len(self._auth_cache) > 10_000:  # bound the cache

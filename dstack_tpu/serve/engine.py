@@ -2200,12 +2200,14 @@ class InferenceEngine:
         )
         self.want_logprobs[slot] = gen.logprobs is not None
         if gen.logprobs is not None:
-            lp, tids, tlps = jax.device_get(
-                self._logprobs(logits, toks)
+            lp, tids, tlps = (
+                a.tolist()
+                for a in jax.device_get(self._logprobs(logits, toks))
             )
+            # tolist() above already yields python floats/ints
             self._last_logprobs[slot] = (
-                float(lp[0]),
-                list(zip(map(int, tids[0]), map(float, tlps[0]))),
+                lp[0],
+                list(zip(tids[0], tlps[0])),
             )
         t_admit = self._admit_t0.pop(slot, None)
         if t_admit is not None:
@@ -2340,16 +2342,17 @@ class InferenceEngine:
             write_mask=jnp.asarray(self.active, bool),
         )
         # the shared jitted argmax (an op-by-op jnp.argmax here paid
-        # uncompiled dispatch overhead every speculative step)
-        preds = jax.device_get(self._argmax(logits))  # [B, S]
+        # uncompiled dispatch overhead every speculative step); ONE
+        # fetch + tolist() so the accept loop compares plain ints
+        preds = jax.device_get(self._argmax(logits)).tolist()  # [B, S]
         out: dict = {}
         for i in live:
             draft = drafts.get(i, [])
-            emitted = [int(preds[i][0])]
+            emitted = [preds[i][0]]
             for j, dtok in enumerate(draft):
-                if int(preds[i][j]) != dtok:
+                if preds[i][j] != dtok:
                     break
-                emitted.append(int(preds[i][j + 1]))
+                emitted.append(preds[i][j + 1])
             if draft:
                 self._spec_tries[i] += 1
                 self._spec_accepted[i] += len(emitted) - 1
@@ -2503,12 +2506,12 @@ class InferenceEngine:
         self._turbo_state = (tok_d, pos_d, rem_d, act_d, eos_d)
         # ONE blocking fetch for every in-flight segment ([depth*steps, B])
         # dtpu: noqa[DTPU002] the designed single device_get per macro-step — K×depth tokens amortize this one round trip
-        toks = np.concatenate(jax.device_get(segs), axis=0)
+        toks = np.concatenate(jax.device_get(segs), axis=0).tolist()
         out: dict = {}
         for i in live:
             emitted: list = []
             for k in range(depth * steps):
-                tok = int(toks[k][i])
+                tok = toks[k][i]  # plain int: the fetch tolist()'d once
                 if tok < 0:  # row deactivated on an earlier step
                     break
                 emitted.append(tok)
@@ -2578,14 +2581,16 @@ class InferenceEngine:
             self._seen, self._gen_counts, self._slot_iota, sampled_dev
         )
         if any(self.want_logprobs[i] for i in live):
-            lp, tids, tlps = jax.device_get(
-                self._logprobs(logits, sampled_dev)
+            lp, tids, tlps = (
+                a.tolist()
+                for a in jax.device_get(self._logprobs(logits, sampled_dev))
             )
             for i in live:
                 if self.want_logprobs[i]:
+                    # tolist() above already yields python floats/ints
                     self._last_logprobs[i] = (
-                        float(lp[i]),
-                        list(zip(map(int, tids[i]), map(float, tlps[i]))),
+                        lp[i],
+                        list(zip(tids[i], tlps[i])),
                     )
         adv = self._advance_state(
             tok_d, pos_d, rem_d, act_d, eos_d, sampled_dev
@@ -2618,11 +2623,15 @@ class InferenceEngine:
         return self.active[i]
 
     def _emit(self, live: list, sampled) -> dict[int, int]:
-        """Publish one sampled token per live slot (host bookkeeping)."""
+        """Publish one sampled token per live slot (host bookkeeping).
+        ``sampled`` is already host-resident (callers device_get once);
+        one tolist() yields plain ints — no per-element numpy scalar
+        boxing in the per-token loop."""
         self._invalidate_decode_cache()  # advancing outside the turbo replay
+        toks = sampled.tolist() if hasattr(sampled, "tolist") else list(sampled)
         out: dict[int, int] = {}
         for i in live:
-            tok = int(sampled[i])
+            tok = toks[i]
             out[i] = tok
             self._advance_slot(i, tok)
         return out

@@ -39,6 +39,10 @@ async def drain_queue(
 ) -> int:
     """One drain pass: claim → process → ack/release. Returns the
     number of entities visited."""
+    # dtpu: noqa[DTPU010] lease-expiry redelivery makes this claim
+    # crash/cancel-safe by design: an unacked row re-delivers to a
+    # sibling shard after WAKEUP_LEASE_SECONDS (pinned by the chaos
+    # suite's mid-batch-crash tests)
     claimed = await wakeups.claim(
         db,
         queue,

@@ -129,6 +129,8 @@ class Database:
         return await self._run(_exec)
 
     async def executemany(self, sql: str, seq: Iterable[Sequence[Any]]) -> None:
+        await faults.afire("db.commit", sql=sql)
+
         def _exec():
             assert self._conn is not None
             self._conn.executemany(sql, list(seq))
@@ -137,6 +139,8 @@ class Database:
         await self._run(_exec)
 
     async def fetchall(self, sql: str, params: Sequence[Any] = ()) -> list[dict]:
+        await faults.afire("db.query", sql=sql)
+
         def _fetch():
             assert self._conn is not None
             return [dict(r) for r in self._conn.execute(sql, params)]
@@ -144,6 +148,8 @@ class Database:
         return await self._run(_fetch)
 
     async def fetchone(self, sql: str, params: Sequence[Any] = ()) -> Optional[dict]:
+        await faults.afire("db.query", sql=sql)
+
         def _fetch():
             assert self._conn is not None
             r = self._conn.execute(sql, params).fetchone()

@@ -27,6 +27,7 @@ import hashlib
 from contextlib import asynccontextmanager
 from typing import Any, Iterable, Optional, Sequence
 
+from dstack_tpu import faults
 from dstack_tpu.utils.logging import get_logger
 
 try:  # asyncpg when available (C-accelerated, binary protocol)
@@ -184,6 +185,10 @@ class PostgresDatabase:
         if tx is not None:
             yield tx
             return
+        # dtpu: noqa[DTPU008] reentrancy-aware: inside transaction()
+        # the contextvar above diverts to the already-held connection,
+        # so queries under a tx never re-enter this pool (the claim
+        # paths ride the DISTINCT _lock_pool — see connect())
         conn = await self._pool.acquire()
         try:
             yield conn
@@ -195,6 +200,8 @@ class PostgresDatabase:
 
         async with self._conn() as conn:
             # one replica migrates at a time (reference app.py:96-100)
+            # dtpu: noqa[DTPU011] startup-only: runs once before the
+            # fault-instrumented planes are live
             await conn.fetchval(
                 "SELECT pg_advisory_lock($1)", MIGRATION_LOCK_KEY
             )
@@ -204,6 +211,7 @@ class PostgresDatabase:
                     "id SERIAL PRIMARY KEY, name TEXT NOT NULL UNIQUE, "
                     "applied_at TIMESTAMPTZ NOT NULL DEFAULT now())"
                 )
+                # dtpu: noqa[DTPU011] startup-only migration read
                 rows = await conn.fetch("SELECT name FROM schema_migrations")
                 applied = {r["name"] for r in rows}
                 for name, sql in migrations.MIGRATIONS:
@@ -234,8 +242,6 @@ class PostgresDatabase:
     # -- query interface (qmark SQL, translated) --
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> int:
-        from dstack_tpu import faults
-
         # same chaos point as the sqlite engine (server/db.py): the
         # DTPU_TEST_DB=pgwire suite re-run injects identically
         await faults.afire("db.commit", sql=sql)
@@ -247,23 +253,24 @@ class PostgresDatabase:
                 return 0
 
     async def executemany(self, sql: str, seq: Iterable[Sequence[Any]]) -> None:
+        await faults.afire("db.commit", sql=sql)
         async with self._conn() as conn:
             await conn.executemany(qmark_to_dollar(sql), list(seq))
 
     async def fetchall(self, sql: str, params: Sequence[Any] = ()) -> list[dict]:
+        await faults.afire("db.query", sql=sql)
         async with self._conn() as conn:
             rows = await conn.fetch(qmark_to_dollar(sql), *params)
             return [dict(r) for r in rows]
 
     async def fetchone(self, sql: str, params: Sequence[Any] = ()) -> Optional[dict]:
+        await faults.afire("db.query", sql=sql)
         async with self._conn() as conn:
             r = await conn.fetchrow(qmark_to_dollar(sql), *params)
             return dict(r) if r is not None else None
 
     @asynccontextmanager
     async def transaction(self):
-        from dstack_tpu import faults
-
         conn = await self._pool.acquire()
         tx = conn.transaction()
         await tx.start()
@@ -285,6 +292,7 @@ class PostgresDatabase:
     async def claim_one(self, namespace: str, candidates: list):
         """SKIP-LOCKED-style queue pop that holds across server
         replicas: first candidate whose advisory lock is free."""
+        await faults.afire("db.lock", namespace=namespace)
         conn = await self._lock_pool.acquire()
         claimed = None
         try:
@@ -315,6 +323,7 @@ class PostgresDatabase:
         real network is what caps the PG scheduling rate
         (CAPACITY_r05.json). Extra locks won (beyond ``limit``) and the
         final releases are likewise batched."""
+        await faults.afire("db.lock", namespace=namespace)
         conn = await self._lock_pool.acquire()
         claimed: list = []
 

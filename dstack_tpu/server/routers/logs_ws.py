@@ -14,6 +14,7 @@ headers).
 import aiohttp
 from aiohttp import web
 
+from dstack_tpu import faults
 from dstack_tpu.core.models.runs import JobProvisioningData, JobStatus
 from dstack_tpu.server.db import Database, loads
 from dstack_tpu.server.services.agent_client import runner_address_for
@@ -85,6 +86,7 @@ async def logs_ws_handler(request: web.Request) -> web.StreamResponse:
                 since = request.query.get("since", "")
                 qs = f"?since={since}" if since else ""
                 try:
+                    await faults.afire("logs.relay", job=str(job_row["id"]))
                     ws_client = await session.ws_connect(
                         f"http://{host}:{rport}/logs_ws{qs}", heartbeat=30
                     )

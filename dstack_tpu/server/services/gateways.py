@@ -14,6 +14,7 @@ from typing import Optional
 
 import aiohttp
 
+from dstack_tpu import faults
 from dstack_tpu.core.errors import ClientError, ResourceNotExistsError
 from dstack_tpu.core.models.configurations import GatewayConfiguration
 from dstack_tpu.core.models.gateways import (
@@ -247,6 +248,7 @@ async def call_agent(
         return None
 
     async def _once():
+        await faults.afire("gateway.agent", gateway=row["name"], path=path)
         async with _pool.session(row["id"]).request(
             method, f"{base}{path}", json=json_body, headers=agent_headers(row)
         ) as resp:

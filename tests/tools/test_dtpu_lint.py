@@ -342,6 +342,58 @@ class Engine:
     assert check_file_source(src, "x.py", rule_ids=["DTPU002"]) == []
 
 
+def test_pragma_multi_rule_brackets():
+    # one line, two rules opted out at once: noqa[DTPU008,DTPU010]
+    from tools.dtpu_lint.core import suppressed
+
+    lines = [
+        "got = ls.try_claim(keys)  # dtpu: noqa[DTPU008,DTPU010] lease",
+    ]
+    for rid in ("DTPU008", "DTPU010"):
+        assert suppressed(Finding(rid, "x.py", 1, "m"), lines)
+    assert not suppressed(Finding("DTPU009", "x.py", 1, "m"), lines)
+
+
+def test_pragma_multi_rule_in_file_rules():
+    src = """
+import jax
+
+class Engine:
+    def step(self, x):
+        v = x.item()  # dtpu: noqa[DTPU002,DTPU003] both excused
+"""
+    assert check_file_source(src, "x.py", rule_ids=["DTPU002"]) == []
+    assert check_file_source(src, "x.py", rule_ids=["DTPU003"]) == []
+
+
+def test_pragma_on_decorator_line_and_comment_block():
+    # a finding at a def line is suppressible from the decorator line
+    # above it, and a multi-line comment block keeps its pragma valid
+    # anywhere in the block
+    from tools.dtpu_lint.core import suppressed
+
+    lines = [
+        "@register  # dtpu: noqa[DTPU006] handler must stay silent",
+        "def handler():",
+    ]
+    assert suppressed(Finding("DTPU006", "x.py", 2, "m"), lines)
+    block = [
+        "# dtpu: noqa[DTPU008] reentrancy-aware: the contextvar",
+        "# diverts to the held connection, so this never",
+        "# re-enters the pool under a transaction",
+        "conn = await self._pool.acquire()",
+    ]
+    assert suppressed(Finding("DTPU008", "x.py", 4, "m"), block)
+    # the block must be CONTIGUOUS comments/decorators — code between
+    # breaks the association
+    gap = [
+        "# dtpu: noqa[DTPU008] reason",
+        "other = 1",
+        "conn = await self._pool.acquire()",
+    ]
+    assert not suppressed(Finding("DTPU008", "x.py", 3, "m"), gap)
+
+
 def test_legacy_blocking_ok_still_respected_by_dtpu001():
     src = """
 import time
@@ -402,6 +454,71 @@ def test_missing_baseline_means_everything_is_new(tmp_path):
     assert len(diff.new) == 1
 
 
+def test_renamed_rule_baseline_semantics(tmp_path, capsys):
+    """A rule rename leaves its old baseline entries orphaned. A
+    SUBSET run of other rules must not trip over them (the baseline is
+    restricted to the scanned rules), while a FULL run reports them
+    stale — shrink-only means the rename PR must prune the entries."""
+    import json as _json
+
+    from tools.dtpu_lint.__main__ import main
+
+    data = _json.loads((REPO / "tools/dtpu_lint/baseline.json").read_text())
+    data["entries"].append(
+        {
+            "rule": "DTPU099",  # the pre-rename id, no longer registered
+            "path": "dstack_tpu/serve/engine.py",
+            "message": "finding of a renamed rule",
+            "count": 2,
+        }
+    )
+    bl = tmp_path / "baseline.json"
+    bl.write_text(_json.dumps(data))
+    # subset run of a live rule: orphaned entries out of scope, clean
+    assert main(["--rules", "DTPU001", "--baseline", str(bl)]) == 0
+    capsys.readouterr()
+    # full run: the orphaned key is stale and fails the gate
+    assert main(["--baseline", str(bl)]) == 1
+    err = capsys.readouterr().err
+    assert "stale baseline entry (DTPU099" in err
+
+
+def test_stale_entry_detection_for_project_rules(tmp_path, capsys):
+    """ProjectRule findings (flow rules, docs coverage) ride the same
+    shrink-only machinery: a baseline entry for a fixed DTPU008
+    finding must be reported stale by the subset run that scans
+    DTPU008."""
+    import json as _json
+
+    from tools.dtpu_lint.__main__ import main
+
+    data = _json.loads((REPO / "tools/dtpu_lint/baseline.json").read_text())
+    data["entries"].append(
+        {
+            "rule": "DTPU008",
+            "path": "dstack_tpu/server/services/runs.py",
+            "message": "a finding that was fixed but not pruned",
+            "count": 1,
+        }
+    )
+    bl = tmp_path / "baseline.json"
+    bl.write_text(_json.dumps(data))
+    assert main(["--rules", "DTPU008", "--baseline", str(bl)]) == 1
+    err = capsys.readouterr().err
+    assert "stale baseline entry (DTPU008" in err
+    # an unrelated subset doesn't see it
+    assert main(["--rules", "DTPU001", "--baseline", str(bl)]) == 0
+
+
+def test_changed_only_smoke(capsys):
+    from tools.dtpu_lint.__main__ import main
+
+    rc = main(["--changed-only", "HEAD"])
+    assert rc in (0,), capsys.readouterr().err
+    # mutually exclusive with explicit paths
+    assert main(["--changed-only", "HEAD", "dstack_tpu"]) == 2
+
+
 # ---------------------------------------------------------------------------
 # the tier-1 gate + CLI surface
 # ---------------------------------------------------------------------------
@@ -422,7 +539,11 @@ def test_repo_lints_clean_against_checked_in_baseline():
 
 def test_every_advertised_rule_is_registered():
     rules = all_rules()
-    for rid in ("DTPU001", "DTPU002", "DTPU003", "DTPU004", "DTPU005"):
+    for rid in (
+        "DTPU001", "DTPU002", "DTPU003", "DTPU004", "DTPU005",
+        "DTPU006", "DTPU007", "DTPU008", "DTPU009", "DTPU010",
+        "DTPU011",
+    ):
         assert rid in rules, f"rule {rid} missing from the registry"
 
 

@@ -1,12 +1,12 @@
 #!/usr/bin/env python
-"""Thin shim over dtpu-lint rule DTPU001 (blocking-call-in-async).
+"""Pure delegating entry point for dtpu-lint rule DTPU001.
 
-The checker moved into the unified static-analysis framework
-(``tools/dtpu_lint/rules/async_blocking.py``); this entry point keeps
-the old script name, the ``check_source(src)`` API, and the exit-code
-contract so ``tests/tools/test_check_async_blocking.py`` and the
-verify recipes stay green. Prefer ``python -m tools.dtpu_lint``
-(optionally ``--rules DTPU001``) for new wiring.
+Every piece of this checker — the AST walk, the repo scan, the
+baseline diff, and the CLI messaging — lives in
+``tools/dtpu_lint/rules/async_blocking.py`` (``check_source`` +
+``shim_main``). This file only keeps the historical script name and
+import path (``check_source``) alive for the verify recipes and old
+muscle memory. Prefer ``python -m tools.dtpu_lint --rules DTPU001``.
 """
 
 import sys
@@ -16,27 +16,10 @@ REPO = Path(__file__).resolve().parent.parent
 if str(REPO) not in sys.path:  # runnable as a script from anywhere
     sys.path.insert(0, str(REPO))
 
-from tools.dtpu_lint.core import apply_baseline, load_baseline, run_lint  # noqa: E402
-from tools.dtpu_lint.rules.async_blocking import check_source  # noqa: E402,F401
-
-
-def main() -> int:
-    findings = run_lint(REPO, rule_ids=["DTPU001"], project_rules=False)
-    diff = apply_baseline(findings, load_baseline())
-    for f in diff.new:
-        print(f.render(), file=sys.stderr)
-    if diff.new:
-        print(
-            f"\n{len(diff.new)} blocking call(s) inside async def bodies — "
-            "move them off the event loop (asyncio.to_thread / "
-            "run_in_executor / aiohttp), or append '# blocking: ok' when "
-            "genuinely safe.",
-            file=sys.stderr,
-        )
-        return 1
-    print("no blocking calls in async bodies (dtpu-lint DTPU001)")
-    return 0
-
+from tools.dtpu_lint.rules.async_blocking import (  # noqa: E402,F401
+    check_source,
+    shim_main as main,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,12 +1,12 @@
 #!/usr/bin/env python
-"""Thin shim over dtpu-lint rule DTPU004 (metric docs coverage).
+"""Pure delegating entry point for dtpu-lint rule DTPU004 (docs half).
 
-The checker moved into the unified static-analysis framework
-(``tools/dtpu_lint/rules/metric_hygiene.py``); this entry point keeps
-the old script name, the ``collect_metric_names()`` API, and the
-exit-code contract so ``tests/tools/test_metrics_docs.py`` and the
-verify recipes stay green. Prefer ``python -m tools.dtpu_lint``
-(optionally ``--rules DTPU004``) for new wiring.
+Every piece of this checker — the exporter scrape, the docs diff, and
+the CLI messaging — lives in
+``tools/dtpu_lint/rules/metric_hygiene.py`` (``collect_metric_names``
++ ``docs_coverage_findings`` + ``shim_main``). This file only keeps
+the historical script name and ``collect_metric_names()`` signature
+alive. Prefer ``python -m tools.dtpu_lint --rules DTPU004``.
 """
 
 import sys
@@ -17,31 +17,14 @@ if str(REPO) not in sys.path:  # runnable as a script from anywhere
     sys.path.insert(0, str(REPO))
 
 from tools.dtpu_lint.rules.metric_hygiene import (  # noqa: E402
-    docs_coverage_findings,
     collect_metric_names as _collect,
+    docs_coverage_findings,  # noqa: F401
+    shim_main as main,
 )
 
 
 def collect_metric_names() -> set:
     return _collect(REPO)
-
-
-def main() -> int:
-    missing = docs_coverage_findings(REPO)
-    if missing:
-        print(
-            "exported metrics missing from docs/reference/server.md "
-            "(add them to the 'Metrics & timeline' section):",
-            file=sys.stderr,
-        )
-        for f in missing:
-            print(f"  {f.message}", file=sys.stderr)
-        return 1
-    print(
-        f"docs cover all {len(collect_metric_names())} exported series "
-        "(dtpu-lint DTPU004)"
-    )
-    return 0
 
 
 if __name__ == "__main__":

@@ -11,6 +11,22 @@ rules instead of reviewer folklore:
 - **DTPU003** recompile hazards (unbucketed jit cache keys, jit-in-loop)
 - **DTPU004** metric hygiene (docs coverage + bounded label values)
 - **DTPU005** settings drift (undocumented ``DTPU_*`` env reads)
+- **DTPU006** silent broad excepts in background/routing code
+- **DTPU007** 429/503 responses without ``Retry-After``
+
+Interprocedural rules over the shared flow layer (``flow.py``:
+project-wide call graph + held-resource tracking across ``await``
+boundaries, content-hash cached):
+
+- **DTPU008** exclusive resource held across a blocking await
+  (the PR 7 claim-pool deadlock shape, generalized)
+- **DTPU009** lock discipline: nested/ABBA/blocking-under-held
+  acquisitions across the entity-lock namespaces
+- **DTPU010** cancellation safety: tracked acquisitions must release
+  in a ``try/finally`` (or ride a context manager)
+- **DTPU011** fault-point boundary coverage: raw I/O must sit under a
+  ``faults.fire`` point and map ``OSError`` to a typed error
+  (the PR 5 unmapped transport error, generalized)
 
 Run repo-wide: ``python -m tools.dtpu_lint`` (tier-1 gate via
 ``tests/tools/test_dtpu_lint.py``). Opt a line out with

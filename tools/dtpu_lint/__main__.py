@@ -30,6 +30,38 @@ from tools.dtpu_lint.core import (  # noqa: E402
 )
 
 
+def _emit(text: str, output) -> None:
+    if output is None:
+        print(text)
+    else:
+        Path(output).write_text(text + "\n")
+
+
+def _changed_paths(ref: str):
+    """Lintable .py files changed vs ``ref`` plus untracked ones, or
+    None on git failure (exit 2). Deleted files are filtered — linting
+    them would die on read."""
+    import subprocess
+
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "--diff-filter=d", ref, "--"],
+            cwd=REPO, capture_output=True, text=True, check=True,
+        ).stdout.splitlines()
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=REPO, capture_output=True, text=True, check=True,
+        ).stdout.splitlines()
+    except (OSError, subprocess.CalledProcessError) as e:
+        print(f"dtpu_lint: git diff vs {ref!r} failed: {e}", file=sys.stderr)
+        return None
+    return sorted(
+        p
+        for p in {*diff, *untracked}
+        if p.endswith(".py") and (REPO / p).exists()
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="dtpu_lint",
@@ -42,7 +74,24 @@ def main(argv=None) -> int:
         help="files/dirs to lint (default: the shipped package, with "
         "baseline + stale-entry enforcement)",
     )
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--format", choices=("text", "json", "sarif"), default="text"
+    )
+    ap.add_argument(
+        "--output",
+        type=Path,
+        help="write the json/sarif report to this file instead of stdout "
+        "(the CI artifact path, e.g. lint.sarif)",
+    )
+    ap.add_argument(
+        "--changed-only",
+        nargs="?",
+        const="HEAD",
+        metavar="GITREF",
+        help="lint only files changed vs GITREF (default HEAD), plus "
+        "untracked ones — the fast pre-commit pass; file rules only, "
+        "baseline restricted to the scanned files like any path subset",
+    )
     ap.add_argument(
         "--baseline",
         type=Path,
@@ -77,6 +126,21 @@ def main(argv=None) -> int:
         if args.rules
         else None
     )
+    if args.changed_only:
+        if args.paths:
+            print(
+                "--changed-only computes the path list itself; drop the "
+                "explicit paths",
+                file=sys.stderr,
+            )
+            return 2
+        changed = _changed_paths(args.changed_only)
+        if changed is None:
+            return 2
+        if not changed:
+            print("dtpu-lint: no lintable files changed")
+            return 0
+        args.paths = changed
     if args.write_baseline and (args.paths or rule_ids):
         # a subset run would overwrite the full baseline with only the
         # subset's findings, silently un-grandfathering everything else
@@ -131,8 +195,17 @@ def main(argv=None) -> int:
         diff = apply_baseline(findings, baseline)
         new, stale = diff.new, diff.stale
 
+    if args.format == "sarif":
+        from tools.dtpu_lint.sarif import render_sarif
+
+        new_set = set(new)
+        grandfathered = [f for f in findings if f not in new_set]
+        log = render_sarif(new, grandfathered, rules=all_rules())
+        _emit(json.dumps(log, indent=1), args.output)
+        return 1 if (new or stale) else 0
+
     if args.format == "json":
-        print(
+        _emit(
             json.dumps(
                 {
                     "findings": [f.to_json() for f in new],
@@ -148,7 +221,8 @@ def main(argv=None) -> int:
                     ],
                 },
                 indent=1,
-            )
+            ),
+            args.output,
         )
         return 1 if (new or stale) else 0
 

@@ -147,17 +147,29 @@ def _pragma_rules(line: str) -> Optional[set]:
     return {r.strip().upper() for r in m.group("rules").split(",") if r.strip()}
 
 
+def pragma_lines(lines: Sequence[str], lineno: int) -> Iterable[str]:
+    """The lines a pragma for a finding at ``lineno`` may live on: the
+    line itself, then the contiguous block of comment-only and
+    decorator lines directly above it — multi-line reasons and
+    ``@decorated`` defs both keep their pragma adjacent to the code it
+    excuses."""
+    if not (1 <= lineno <= len(lines)):
+        return
+    yield lines[lineno - 1]
+    ln = lineno - 1
+    while ln >= 1:
+        stripped = lines[ln - 1].lstrip()
+        if not stripped.startswith(("#", "@")):
+            break
+        yield lines[ln - 1]
+        ln -= 1
+
+
 def suppressed(finding: Finding, lines: Sequence[str]) -> bool:
-    """True when the finding's line — or a comment-only line directly
-    above it (the readable spot for a long reason) — carries a
-    matching noqa pragma."""
-    if not (1 <= finding.line <= len(lines)):
-        return False
-    candidates = [lines[finding.line - 1]]
-    prev = lines[finding.line - 2] if finding.line >= 2 else ""
-    if prev.lstrip().startswith("#"):
-        candidates.append(prev)
-    for line in candidates:
+    """True when the finding's line — or the contiguous comment/
+    decorator block directly above it (the readable spot for a long
+    reason) — carries a matching noqa pragma."""
+    for line in pragma_lines(lines, finding.line):
         rules = _pragma_rules(line)
         if rules is not None and finding.rule.upper() in rules:
             return True
@@ -257,6 +269,19 @@ def run_lint(
                 if not suppressed(finding, lines):
                     findings.append(finding)
     if project_rules and paths is None:
+        # project-rule findings honor line pragmas too: flow rules
+        # (DTPU008-011) point at real source lines where a
+        # `# dtpu: noqa[RULE] reason` is the sanctioned opt-out
+        line_cache: dict = {}
+
+        def _lines_for(rel: str):
+            if rel not in line_cache:
+                try:
+                    line_cache[rel] = (repo / rel).read_text().splitlines()
+                except OSError:
+                    line_cache[rel] = []
+            return line_cache[rel]
+
         for rid, r in sorted(rules.items()):
             # a project rule shipped as a sub-id of a file rule
             # (DTPU004-DOCS) runs whenever its base id is selected
@@ -265,7 +290,9 @@ def run_lint(
                 or rid in rule_ids
                 or rid.split("-")[0] in rule_ids
             ):
-                findings.extend(r.check_project(repo))
+                for finding in r.check_project(repo):
+                    if not suppressed(finding, _lines_for(finding.path)):
+                        findings.append(finding)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
 
 

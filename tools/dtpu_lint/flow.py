@@ -1,0 +1,816 @@
+"""Interprocedural flow analysis for the async control plane.
+
+The per-file rules (DTPU001-007) catch single-function defects; the
+two worst concurrency bugs this repo has shipped were *cross-function*
+shapes invisible to them:
+
+- the PR 7 pool deadlock: ``claim_batch`` held a connection from the
+  SAME asyncpg pool its callers' body queries acquired from — 15
+  concurrent claimants held all 8 connections while their bodies
+  waited on the pool, a hard deadlock only the 1500-job bench hit;
+- the PR 5 unmapped transport error: ``aiohttp`` raised a raw
+  ``OSError`` two frames below the reconciler, which had handlers for
+  ``ClientConnectionError``/timeouts only — the tick crashed instead
+  of entering the unreachable-agent path.
+
+This module gives ProjectRules the project-wide facts those bug
+classes need (RacerD-style lock/resource discipline, applied to
+asyncio):
+
+- a **symbol table** over the analyzed packages (module-level
+  functions + class methods, import aliases),
+- a **call graph** with pragmatic resolution: ``self.x`` binds to the
+  enclosing class, ``module.fn`` through import aliases, and bare
+  method names fall back to a by-name union over project classes
+  (conservative over-approximation — good for "does this await
+  transitively reach X" facts),
+- per-function **event streams** (with-enter/exit, awaits, yields,
+  resource acquire/release, try/finally shape, raw I/O sites, fault
+  fires) extracted once per file and **cached on disk keyed by file
+  content hash** (plus an analyzer-version salt), so warm runs skip
+  parsing entirely,
+- fixpoint **facts**: reaches-retry, reaches-network-RPC, pool tokens
+  acquired, lock namespaces acquired, resources held across an
+  ``asynccontextmanager``'s yield, and fault-point coverage.
+
+Rules DTPU008-011 (rules/resource_await.py, lock_discipline.py,
+cancel_safety.py, fault_coverage.py) are thin evaluations over these
+facts. Tests exercise them on synthetic fixture *trees* by pointing
+:func:`get_flow` at a temp root — nothing here hardcodes the real
+repo beyond the default package globs.
+
+Source-site pragmas: an acquisition line carrying
+``# dtpu: noqa[DTPU008]`` (or the rule in question) is excluded at the
+*propagation source* — e.g. ``PostgresDatabase._conn`` re-acquires the
+query pool by design (a tx contextvar diverts to the held connection),
+and the pragma there silences every transitive re-acquisition report
+instead of requiring one per caller.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional, Sequence
+
+from tools.dtpu_lint.core import _PRAGMA_RE
+
+#: packages indexed for symbol resolution (call targets resolve across
+#: all of these)
+ANALYZED_GLOBS = (
+    "dstack_tpu/server/**/*.py",
+    "dstack_tpu/routing/**/*.py",
+    "dstack_tpu/gateway/**/*.py",
+    "dstack_tpu/faults/**/*.py",
+    "dstack_tpu/qos/**/*.py",
+    "dstack_tpu/utils/**/*.py",
+)
+
+#: paths where findings are REPORTED (the async control plane; testing
+#: doubles and the wire-protocol internals below the fault boundary are
+#: indexed for resolution but never reported on)
+REPORT_GLOBS = (
+    "dstack_tpu/server/**/*.py",
+    "dstack_tpu/routing/**/*.py",
+    "dstack_tpu/gateway/**/*.py",
+    "dstack_tpu/faults/**/*.py",
+)
+REPORT_EXCLUDE = (
+    "dstack_tpu/server/testing/**/*.py",
+    "dstack_tpu/server/pg_wire.py",
+)
+
+CACHE_PATH = Path(__file__).resolve().parent / ".flowcache.json"
+
+#: retry drivers: any call whose final name is one of these makes the
+#: calling function a retry site (utils/retry.py's public API)
+RETRY_NAMES = frozenset(
+    {"retry_async", "retry_sync", "wait_for_async", "wait_for_sync"}
+)
+
+#: non-blocking (SKIP-LOCKED-style) lock constructs: namespace = arg0
+CLAIM_NAMES = frozenset({"claim_one", "claim_batch"})
+#: blocking lock constructs (wait until free): namespace = arg0
+BLOCKING_LOCK_NAMES = frozenset({"lock_ctx"})
+#: context managers that hold a QoS bucket charge / an engine slot for
+#: their body (the ctx idiom for those resources; imperative
+#: try_acquire/refund-style charges are DTPU010's domain)
+BUCKET_HOLD_NAMES = frozenset({"charged", "charge_ctx"})
+SLOT_HOLD_NAMES = frozenset({"hold_slot", "slot_ctx"})
+
+#: network I/O call patterns: (final attr, receiver substring or None)
+_NET_FINALS = frozenset(
+    {"request", "ws_connect", "get", "post", "put", "delete", "patch"}
+)
+_DB_IO_FINALS = frozenset({"fetch", "fetchrow", "fetchval", "executemany"})
+
+#: resource acquire -> release pairings for cancellation-safety
+#: (final call names; "claim" is special-cased to the wakeups module)
+ACQUIRE_RELEASE = {
+    "try_claim": ("release",),
+    "try_acquire": ("refund",),
+    "acquire": ("release",),
+}
+
+
+def _sha1(data: bytes) -> str:
+    return hashlib.sha1(data).hexdigest()
+
+
+def _analyzer_version() -> str:
+    """Content hash of the analysis code itself: editing flow.py or a
+    flow rule invalidates every cached summary."""
+    here = Path(__file__).resolve().parent
+    parts = []
+    for p in sorted([here / "flow.py", *sorted((here / "rules").glob("*.py"))]):
+        try:
+            parts.append(p.read_bytes())
+        except OSError:
+            pass
+    return _sha1(b"\0".join(parts))[:16]
+
+
+_ANALYZER_VERSION: Optional[str] = None
+
+
+def analyzer_version() -> str:
+    global _ANALYZER_VERSION
+    if _ANALYZER_VERSION is None:
+        _ANALYZER_VERSION = _analyzer_version()
+    return _ANALYZER_VERSION
+
+
+# ---------------------------------------------------------------------------
+# pass 1: per-file summary extraction (pure function of source text)
+# ---------------------------------------------------------------------------
+
+
+def callee_str(node: ast.AST) -> Optional[str]:
+    """Dotted rendering of a call target: ``a.b.c``, ``self.x``, and
+    call-chains like ``get_locker().lock_ctx`` (calls render as
+    ``()``); anything else (subscripts, literals) is None."""
+    parts: list[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Call):
+            inner = callee_str(node.func)
+            if inner is None:
+                return None
+            parts.append(inner + "()")
+            return ".".join(reversed(parts))
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        elif isinstance(node, ast.Await):
+            node = node.value
+        else:
+            return None
+
+
+def _arg0_literal(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+        call.args[0].value, str
+    ):
+        return call.args[0].value
+    return None
+
+
+def _line_pragmas(lines: Sequence[str], lineno: int) -> list[str]:
+    """Rule ids noqa'd on this line or the comment/decorator block
+    directly above it (same placement contract as core.suppressed)."""
+    from tools.dtpu_lint.core import pragma_lines
+
+    out: set = set()
+    for text in pragma_lines(lines, lineno):
+        m = _PRAGMA_RE.search(text)
+        if m:
+            out.update(
+                r.strip().upper()
+                for r in m.group("rules").split(",")
+                if r.strip()
+            )
+    return sorted(out)
+
+
+class _FuncExtractor(ast.NodeVisitor):
+    """Linearizes ONE function body into an event stream. Does not
+    descend into nested function definitions (they get their own
+    summaries)."""
+
+    def __init__(self, lines: Sequence[str]):
+        self.lines = lines
+        self.events: list[dict] = []
+        self.fires: list[str] = []
+        self.fires_any = False
+        self._fin_depth = 0
+        self._handler_stack: list[list[str]] = []
+
+    # -- helpers --
+
+    def _ev(self, kind: str, line: int, **kw) -> dict:
+        ev = {"k": kind, "line": line, "fin": self._fin_depth > 0, **kw}
+        prag = _line_pragmas(self.lines, line)
+        if prag:
+            ev["noqa"] = prag
+        self.events.append(ev)
+        return ev
+
+    def _enclosing_handlers(self) -> list[str]:
+        out: list[str] = []
+        for hs in self._handler_stack:
+            out.extend(hs)
+        return out
+
+    def _record_call(self, call: ast.Call, awaited: bool) -> None:
+        callee = callee_str(call.func)
+        if callee is None:
+            self.generic_visit(call)
+            return
+        final = callee.rsplit(".", 1)[-1]
+        line = call.lineno
+        # fault fires
+        if final in ("fire", "afire", "mutate") and (
+            callee.startswith("faults.") or callee == final
+        ):
+            self.fires_any = True
+            lit = _arg0_literal(call)
+            if lit:
+                self.fires.append(lit)
+        # fault_point= keyword indirection (agent_client-style)
+        for kw in call.keywords:
+            if kw.arg == "fault_point" and isinstance(kw.value, ast.Constant):
+                if isinstance(kw.value.value, str):
+                    self.fires_any = True
+                    self.fires.append(kw.value.value)
+        self._ev(
+            "await" if awaited else "call",
+            line,
+            callee=callee,
+            arg0=_arg0_literal(call),
+            handlers=self._enclosing_handlers(),
+        )
+        # descend into arguments (nested calls inside args still count)
+        for a in call.args:
+            self.visit(a)
+        for kw in call.keywords:
+            self.visit(kw.value)
+
+    # -- structure --
+
+    def visit_FunctionDef(self, node):  # nested defs: own summary
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Await(self, node):
+        if isinstance(node.value, ast.Call):
+            self._record_call(node.value, awaited=True)
+        else:
+            self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self._record_call(node, awaited=False)
+
+    def _visit_with(self, node, is_async: bool) -> None:
+        entered = []
+        for item in node.items:
+            if isinstance(item.context_expr, ast.Call):
+                callee = callee_str(item.context_expr.func)
+                ev = self._ev(
+                    "enter",
+                    item.context_expr.lineno,
+                    callee=callee,
+                    arg0=_arg0_literal(item.context_expr),
+                    awaited=is_async,
+                    handlers=self._enclosing_handlers(),
+                )
+                entered.append(ev)
+                for a in item.context_expr.args:
+                    self.visit(a)
+                for kw in item.context_expr.keywords:
+                    self.visit(kw.value)
+            else:
+                self.visit(item.context_expr)
+                entered.append(None)
+        for stmt in node.body:
+            self.visit(stmt)
+        for ev in reversed(entered):
+            if ev is not None:
+                self._ev("exit", node.body[-1].end_lineno or ev["line"],
+                         callee=ev.get("callee"))
+
+    def visit_With(self, node):
+        self._visit_with(node, is_async=False)
+
+    def visit_AsyncWith(self, node):
+        self._visit_with(node, is_async=True)
+
+    def visit_Try(self, node):
+        handler_names: list[str] = []
+        for h in node.handlers:
+            t = h.type
+            if t is None:
+                handler_names.append("BaseException")  # bare except
+            elif isinstance(t, ast.Tuple):
+                handler_names.extend(
+                    callee_str(e) or "?" for e in t.elts
+                )
+            else:
+                handler_names.append(callee_str(t) or "?")
+        self._handler_stack.append(handler_names)
+        for stmt in node.body:
+            self.visit(stmt)
+        self._handler_stack.pop()
+        for h in node.handlers:
+            for stmt in h.body:
+                self.visit(stmt)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        if node.finalbody:
+            self._fin_depth += 1
+            for stmt in node.finalbody:
+                self.visit(stmt)
+            self._fin_depth -= 1
+
+    def visit_Yield(self, node):
+        self._ev("yield", node.lineno)
+        self.generic_visit(node)
+
+    def visit_YieldFrom(self, node):
+        self._ev("yield", node.lineno)
+        self.generic_visit(node)
+
+    def visit_Return(self, node):
+        self._ev("return", node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        tgt = callee_str(node.target)
+        if tgt is not None:
+            low = tgt.rsplit(".", 1)[-1].lower()
+            if "inflight" in low or "outstanding" in low or "refs" == low:
+                op = "+" if isinstance(node.op, ast.Add) else "-"
+                self._ev("aug", node.lineno, target=tgt, op=op)
+        self.generic_visit(node)
+
+
+def _decorator_names(node) -> list[str]:
+    out = []
+    for d in node.decorator_list:
+        s = callee_str(d.func if isinstance(d, ast.Call) else d)
+        if s:
+            out.append(s.rsplit(".", 1)[-1])
+    return out
+
+
+def extract_summary(src: str, relpath: str) -> dict:
+    """Pure per-file pass: imports + one summary per function. This is
+    what the on-disk cache stores, keyed by the file's content hash."""
+    tree = ast.parse(src, filename=relpath)
+    lines = src.splitlines()
+    imports: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                imports[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    functions: list[dict] = []
+
+    def _walk_body(body, cls: Optional[str], prefix: str):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ex = _FuncExtractor(lines)
+                for stmt in node.body:
+                    ex.visit(stmt)
+                decos = _decorator_names(node)
+                functions.append(
+                    {
+                        "name": node.name,
+                        "qual": f"{prefix}{node.name}",
+                        "cls": cls,
+                        "line": node.lineno,
+                        "is_async": isinstance(node, ast.AsyncFunctionDef),
+                        "is_acm": "asynccontextmanager" in decos
+                        or "contextmanager" in decos,
+                        "events": ex.events,
+                        "fires": sorted(set(ex.fires)),
+                        "fires_any": ex.fires_any,
+                    }
+                )
+                # nested defs become their own (unresolvable) summaries
+                _walk_body(
+                    node.body, cls, f"{prefix}{node.name}.<locals>."
+                )
+            elif isinstance(node, ast.ClassDef):
+                _walk_body(node.body, node.name, f"{node.name}.")
+
+    _walk_body(tree.body, None, "")
+    return {"path": relpath, "imports": imports, "functions": functions}
+
+
+# ---------------------------------------------------------------------------
+# pass 2: project index + resolution + fact fixpoints
+# ---------------------------------------------------------------------------
+
+#: method/function names too generic to resolve by project-wide name
+#: union (the fallback when no better binding exists)
+_UNION_BLOCKLIST = frozenset(
+    {
+        "get", "set", "add", "pop", "items", "values", "keys", "close",
+        "update", "remove", "append", "extend", "join", "read", "write",
+        "send", "put", "text", "json", "copy", "strip", "split", "format",
+        "encode", "decode", "info", "debug", "warning", "error", "exception",
+        "inc", "observe", "isoformat", "model_dump", "model_validate",
+        "dumps", "loads", "family", "render", "start", "commit", "rollback",
+        "wait", "cancel", "result", "done", "sleep", "gather", "create_task",
+    }
+)
+
+
+@dataclass
+class FuncInfo:
+    key: str  # "relpath::Qual.name"
+    path: str
+    summary: dict
+    # computed facts
+    reaches_retry: bool = False
+    reaches_rpc: bool = False
+    pool_tokens: frozenset = frozenset()
+    lock_reach: frozenset = frozenset()  # (namespace, blocking)
+    holds: frozenset = frozenset()  # tokens held across this acm's yield
+    covered: bool = False  # under a fault point (self or all callers)
+    callees: set = field(default_factory=set)
+    callers: set = field(default_factory=set)
+
+
+def _is_net_io(callee: str) -> bool:
+    final = callee.rsplit(".", 1)[-1]
+    recv = callee[: -len(final) - 1] if "." in callee else ""
+    if callee in ("asyncio.open_connection",) or final == "create_connection":
+        return True
+    # the receiver's LAST segment must be session-like: `self._sessions`
+    # is a dict of sessions and `.get()` on it is a lookup, not I/O
+    last = recv.split(".")[-1].lower()
+    if final in _NET_FINALS and last in ("session", "_session", "session()"):
+        return True
+    if callee.startswith("aiohttp.request"):
+        return True
+    return False
+
+
+def _is_db_io(callee: str) -> bool:
+    final = callee.rsplit(".", 1)[-1]
+    recv = callee[: -len(final) - 1] if "." in callee else ""
+    return final in _DB_IO_FINALS and recv.split(".")[-1] in ("conn", "_conn")
+
+
+def _pool_token(callee: str, cls: Optional[str]) -> Optional[str]:
+    """``<expr>.acquire()`` on a pool-ish receiver → a class-qualified
+    token so ``self._pool`` in different classes never collides."""
+    final = callee.rsplit(".", 1)[-1]
+    if final != "acquire":
+        return None
+    recv = callee[: -len(final) - 1]
+    if "pool" not in recv.lower():
+        return None
+    return f"{cls or '<module>'}::{recv}"
+
+
+class ProjectFlow:
+    """The resolved project: symbol table, call graph, facts."""
+
+    def __init__(self, root: Path, summaries: list[dict]):
+        self.root = root
+        self.funcs: dict[str, FuncInfo] = {}
+        self.by_name: dict[str, list[str]] = {}
+        self.by_method: dict[tuple, list[str]] = {}  # (cls, name) -> keys
+        self.module_symbols: dict[tuple, str] = {}  # (modpath, name) -> key
+        self.imports: dict[str, dict] = {}
+        self.summaries = summaries
+        for s in summaries:
+            self.imports[s["path"]] = s.get("imports", {})
+            for f in s["functions"]:
+                key = f"{s['path']}::{f['qual']}"
+                fi = FuncInfo(key=key, path=s["path"], summary=f)
+                self.funcs[key] = fi
+                self.by_name.setdefault(f["name"], []).append(key)
+                if f["cls"]:
+                    self.by_method.setdefault(
+                        (f["cls"], f["name"]), []
+                    ).append(key)
+                else:
+                    self.module_symbols[(s["path"], f["name"])] = key
+        self._resolve_cache: dict = {}
+        self._build_graph()
+        self._fixpoints()
+
+    # -- resolution --
+
+    def _module_for(self, dotted_module: str) -> Optional[str]:
+        """'dstack_tpu.server.db' -> 'dstack_tpu/server/db.py' when
+        indexed."""
+        rel = dotted_module.replace(".", "/")
+        for cand in (f"{rel}.py", f"{rel}/__init__.py"):
+            if any(s["path"] == cand for s in self.summaries):
+                return cand
+        return None
+
+    def resolve(self, path: str, cls: Optional[str], callee: str) -> list[str]:
+        """Call target → candidate FuncInfo keys (possibly empty)."""
+        ck = (path, cls, callee)
+        if ck in self._resolve_cache:
+            return self._resolve_cache[ck]
+        out: list[str] = []
+        parts = callee.split(".")
+        final = parts[-1]
+        if callee.startswith("self.") and cls is not None and len(parts) == 2:
+            out = list(self.by_method.get((cls, final), []))
+            if not out:
+                out = self._union(final)
+        elif len(parts) == 1:
+            # bare name: module-level symbol, then import alias, then union
+            key = self.module_symbols.get((path, final))
+            if key:
+                out = [key]
+            else:
+                imp = self.imports.get(path, {}).get(final)
+                if imp and "." in imp:
+                    mod, name = imp.rsplit(".", 1)
+                    mpath = self._module_for(mod)
+                    if mpath:
+                        k = self.module_symbols.get((mpath, name))
+                        if k:
+                            out = [k]
+                if not out:
+                    out = self._union(final)
+        else:
+            # dotted: resolve the root through import aliases
+            root_name = parts[0].split("()")[0]
+            imp = self.imports.get(path, {}).get(root_name)
+            resolved = False
+            if imp and len(parts) == 2:
+                mpath = self._module_for(imp)
+                if mpath:
+                    k = self.module_symbols.get((mpath, final))
+                    out = [k] if k else []
+                    resolved = True
+            if not resolved:
+                out = self._union(final)
+        self._resolve_cache[ck] = out
+        return out
+
+    def _union(self, name: str) -> list[str]:
+        if name in _UNION_BLOCKLIST:
+            return []
+        return list(self.by_name.get(name, []))
+
+    # -- graph + fixpoints --
+
+    def _build_graph(self) -> None:
+        for fi in self.funcs.values():
+            f = fi.summary
+            for ev in f["events"]:
+                if ev["k"] in ("await", "call", "enter") and ev.get("callee"):
+                    for tgt in self.resolve(fi.path, f["cls"], ev["callee"]):
+                        fi.callees.add(tgt)
+                        self.funcs[tgt].callers.add(fi.key)
+            # a closure inherits its enclosing function as a caller:
+            # `_exec` handed to `self._run(_exec)` is never *called*
+            # syntactically, but runs under the outer function's fault
+            # coverage
+            if ".<locals>." in f["qual"]:
+                outer_qual = f["qual"].rsplit(".<locals>.", 1)[0]
+                outer = f"{fi.path}::{outer_qual}"
+                if outer in self.funcs:
+                    fi.callers.add(outer)
+                    self.funcs[outer].callees.add(fi.key)
+
+    def _fixpoints(self) -> None:
+        # seed local facts
+        for fi in self.funcs.values():
+            f = fi.summary
+            tokens: set = set()
+            locks: set = set()
+            retry = rpc = False
+            for ev in f["events"]:
+                callee = ev.get("callee")
+                if not callee or ev["k"] not in ("await", "call", "enter"):
+                    continue
+                final = callee.rsplit(".", 1)[-1]
+                if final in RETRY_NAMES:
+                    retry = True
+                if _is_net_io(callee):
+                    rpc = True
+                tok = _pool_token(callee, f["cls"])
+                rule_noqa = set(ev.get("noqa", ()))
+                if tok and "DTPU008" not in rule_noqa:
+                    tokens.add(tok)
+                if final in CLAIM_NAMES and "DTPU009" not in rule_noqa:
+                    locks.add((ev.get("arg0"), False))
+                elif final in BLOCKING_LOCK_NAMES and "DTPU009" not in rule_noqa:
+                    locks.add((ev.get("arg0"), True))
+            fi.reaches_retry = retry
+            fi.reaches_rpc = rpc
+            fi.pool_tokens = frozenset(tokens)
+            fi.lock_reach = frozenset(locks)
+            fi.covered = f["fires_any"]
+
+        # propagate reaches_* / pool_tokens / lock_reach up the graph
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs.values():
+                for tgt in fi.callees:
+                    g = self.funcs[tgt]
+                    if g.reaches_retry and not fi.reaches_retry:
+                        fi.reaches_retry = True
+                        changed = True
+                    if g.reaches_rpc and not fi.reaches_rpc:
+                        fi.reaches_rpc = True
+                        changed = True
+                    if not g.pool_tokens <= fi.pool_tokens:
+                        fi.pool_tokens = fi.pool_tokens | g.pool_tokens
+                        changed = True
+                    if not g.lock_reach <= fi.lock_reach:
+                        fi.lock_reach = fi.lock_reach | g.lock_reach
+                        changed = True
+
+        # holds-across-yield for context-manager functions
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs.values():
+                f = fi.summary
+                if not f["is_acm"]:
+                    continue
+                held: set = set()
+                at_yield: set = set()
+                for ev in f["events"]:
+                    k = ev["k"]
+                    if k == "enter" and ev.get("callee"):
+                        held |= self._direct_hold(fi, ev)
+                    elif k == "await" and ev.get("callee"):
+                        tok = _pool_token(ev["callee"], f["cls"])
+                        if tok and "DTPU008" not in set(ev.get("noqa", ())):
+                            held.add(("pool", tok))
+                    elif k == "yield":
+                        at_yield |= held
+                if at_yield != set(fi.holds):
+                    fi.holds = frozenset(at_yield)
+                    changed = True
+
+        # fault coverage: covered if self fires, or every caller covered
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs.values():
+                if fi.covered or not fi.callers:
+                    continue
+                if all(self.funcs[c].covered for c in fi.callers):
+                    fi.covered = True
+                    changed = True
+
+    def _direct_hold(self, fi: FuncInfo, ev: dict) -> set:
+        """Resource tokens a with-item context installs, including
+        those an asynccontextmanager holds across its yield."""
+        callee = ev["callee"]
+        final = callee.rsplit(".", 1)[-1]
+        held: set = set()
+        noqa = set(ev.get("noqa", ()))
+        if "DTPU008" in noqa:
+            return held
+        if final == "transaction":
+            held.add(("tx", callee))
+        elif final in CLAIM_NAMES:
+            held.add(("claim", ev.get("arg0") or callee))
+        elif final in BUCKET_HOLD_NAMES:
+            held.add(("bucket", callee))
+        elif final in SLOT_HOLD_NAMES:
+            held.add(("slot", callee))
+        for tgt in self.resolve(fi.path, fi.summary["cls"], callee):
+            g = self.funcs[tgt]
+            if g.summary["is_acm"]:
+                held |= set(g.holds)
+        return held
+
+    # -- convenience for rules --
+
+    def functions(self) -> Iterable[FuncInfo]:
+        return self.funcs.values()
+
+    def callee_facts(self, fi: FuncInfo, callee: str) -> list["FuncInfo"]:
+        return [
+            self.funcs[k]
+            for k in self.resolve(fi.path, fi.summary["cls"], callee)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# entry point + caching
+# ---------------------------------------------------------------------------
+
+
+def _glob_many(root: Path, globs: Sequence[str]) -> list[str]:
+    rels: set = set()
+    for g in globs:
+        rels.update(p.relative_to(root).as_posix() for p in root.glob(g))
+    return sorted(rels)
+
+
+def report_paths(root: Path) -> set:
+    from tools.dtpu_lint.core import glob_match
+
+    out = set()
+    for rel in _glob_many(root, REPORT_GLOBS):
+        if not any(glob_match(rel, g) for g in REPORT_EXCLUDE):
+            out.add(rel)
+    return out
+
+
+def _load_cache(path: Path) -> dict:
+    try:
+        data = json.loads(path.read_text())
+        if data.get("version") == analyzer_version():
+            return data.get("files", {})
+    except (OSError, ValueError):
+        pass
+    return {}
+
+
+def _store_cache(path: Path, files: dict) -> None:
+    try:
+        path.write_text(
+            json.dumps({"version": analyzer_version(), "files": files})
+        )
+    except OSError:
+        pass  # read-only checkout: cache is an optimization only
+
+
+#: in-process memo: root -> (state-digest, ProjectFlow) — four rules
+#: in one lint run (and repeated run_lint calls in one pytest session)
+#: share a single analysis; one live state per root
+_memo: dict = {}
+
+
+def get_flow(
+    root: Path, cache_path: Optional[Path] = CACHE_PATH
+) -> ProjectFlow:
+    root = Path(root).resolve()
+    if cache_path is CACHE_PATH:
+        from tools.dtpu_lint.core import REPO
+
+        if root != Path(REPO).resolve():
+            # fixture trees (tests) must not churn the shared cache
+            cache_path = None
+    rels = _glob_many(root, ANALYZED_GLOBS)
+    sources: dict[str, bytes] = {}
+    digests: dict[str, str] = {}
+    for rel in rels:
+        try:
+            raw = (root / rel).read_bytes()
+        except OSError:
+            continue
+        sources[rel] = raw
+        digests[rel] = _sha1(raw)
+    state = _sha1(
+        json.dumps(sorted(digests.items())).encode()
+        + analyzer_version().encode()
+    )
+    hit = _memo.get(str(root))
+    if hit is not None and hit[0] == state:
+        return hit[1]
+
+    cached = _load_cache(cache_path) if cache_path else {}
+    fresh: dict = {}
+    summaries: list[dict] = []
+    for rel, raw in sorted(sources.items()):
+        d = digests[rel]
+        hit = cached.get(d)
+        if hit is not None and hit.get("path") == rel:
+            summaries.append(hit)
+            fresh[d] = hit
+            continue
+        try:
+            summary = extract_summary(raw.decode("utf-8"), rel)
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # DTPU000 reports unparseable files already
+        summaries.append(summary)
+        fresh[d] = summary
+    if cache_path and fresh != cached:
+        _store_cache(cache_path, fresh)
+
+    flow = ProjectFlow(root, summaries)
+    _memo[str(root)] = (state, flow)
+    return flow

@@ -2,9 +2,13 @@
 
 from tools.dtpu_lint.rules import (  # noqa: F401
     async_blocking,
+    cancel_safety,
+    fault_coverage,
     host_sync,
+    lock_discipline,
     metric_hygiene,
     recompile,
+    resource_await,
     retry_after,
     settings_drift,
     silent_except,

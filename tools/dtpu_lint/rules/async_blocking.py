@@ -163,3 +163,33 @@ class AsyncBlockingRule(FileRule):
     def check(self, tree, src, relpath, repo):
         for lineno, msg in check_source(src, relpath):
             yield Finding(self.id, relpath, lineno, msg)
+
+
+def shim_main() -> int:
+    """The whole CLI of tools/check_async_blocking.py (a pure
+    delegating entry point since the shim fold): run DTPU001
+    repo-wide against the baseline, old exit-code contract intact."""
+    import sys
+
+    from tools.dtpu_lint.core import (
+        REPO,
+        apply_baseline,
+        load_baseline,
+        run_lint,
+    )
+
+    findings = run_lint(REPO, rule_ids=["DTPU001"], project_rules=False)
+    diff = apply_baseline(findings, load_baseline())
+    for f in diff.new:
+        print(f.render(), file=sys.stderr)
+    if diff.new:
+        print(
+            f"\n{len(diff.new)} blocking call(s) inside async def bodies — "
+            "move them off the event loop (asyncio.to_thread / "
+            "run_in_executor / aiohttp), or append '# blocking: ok' when "
+            "genuinely safe.",
+            file=sys.stderr,
+        )
+        return 1
+    print("no blocking calls in async bodies (dtpu-lint DTPU001)")
+    return 0

@@ -1,0 +1,158 @@
+"""DTPU010: cancellation-safety of tracked resource acquisitions.
+
+asyncio cancellation can land on ANY ``await``. An async function that
+acquires a tracked resource imperatively — an entity-lock claim
+(``try_claim``), a QoS bucket charge (``try_acquire``), a pool/lock
+``acquire``, a durable wakeup claim (``wakeups.claim``), or an
+inflight/outstanding counter bump — and then reaches an ``await``
+before releasing it will LEAK the resource when the task is cancelled
+between the two, unless the release runs in a ``try/finally`` (or the
+acquisition rides a context manager, which is the preferred idiom and
+is never flagged).
+
+Leaked claims wedge entities until lease expiry; a stranded
+``inflight`` gauge makes a drained replica look busy forever (the
+autoscaler and drain logic both key on it); an uncharged-back bucket
+silently shrinks a tenant's budget. All were near-misses in PR 6/7
+review.
+
+The rule matches acquire/release pairs on the same receiver
+(``ls.try_claim`` ↔ ``ls.release``, ``pool.acquire`` ↔
+``pool.release``, ``bucket.try_acquire`` ↔ ``bucket.refund``,
+``self._inflight += 1`` ↔ ``-= 1``) and flags:
+
+- an acquisition with awaits after it and **no release on the path**;
+- a release that is **not inside a finally** while awaits occur
+  between acquire and release.
+
+Lease-style acquisitions that are crash-safe BY DESIGN (redelivery on
+lease expiry) opt out at the acquisition line with
+``# dtpu: noqa[DTPU010] <why>``.
+"""
+
+from typing import Iterable, Optional
+
+from tools.dtpu_lint.core import Finding, ProjectRule, register
+from tools.dtpu_lint.flow import ACQUIRE_RELEASE, get_flow, report_paths
+
+
+def _receiver(callee: str) -> str:
+    return callee.rsplit(".", 1)[0] if "." in callee else ""
+
+
+def _final(callee: str) -> str:
+    return callee.rsplit(".", 1)[-1]
+
+
+def _is_suspension(ev) -> bool:
+    """Events where cancellation can land: awaits, awaited context
+    enters, and yields. A synchronous ``with`` enter is not a
+    suspension point — a sync critical section between acquire and
+    release is cancellation-safe."""
+    k = ev["k"]
+    if k in ("await", "yield"):
+        return True
+    return k == "enter" and bool(ev.get("awaited"))
+
+
+def _is_wakeup_claim(flow, fi, callee: str) -> bool:
+    if _final(callee) != "claim":
+        return False
+    return any(
+        t.path.endswith("services/wakeups.py") and t.summary["name"] == "claim"
+        for t in flow.callee_facts(fi, callee)
+    )
+
+
+@register
+class CancellationSafetyRule(ProjectRule):
+    id = "DTPU010"
+    name = "resource acquisition without cancellation-safe release"
+
+    def check_project(self, repo) -> Iterable[Finding]:
+        flow = get_flow(repo)
+        scope = report_paths(repo)
+        for fi in flow.functions():
+            if fi.path not in scope or not fi.summary["is_async"]:
+                continue
+            yield from self._check_function(flow, fi)
+
+    def _check_function(self, flow, fi):
+        f = fi.summary
+        events = f["events"]
+        qual = f["qual"]
+        matched_releases: set = set()
+        for i, ev in enumerate(events):
+            acq = self._acquire_of(flow, fi, ev)
+            if acq is None or ev.get("fin"):
+                continue
+            if "DTPU010" in set(ev.get("noqa", ())):
+                continue
+            release_names, receiver, label = acq
+            rel_idx: Optional[int] = None
+            for j in range(i + 1, len(events)):
+                if j in matched_releases:
+                    continue
+                if self._releases(events[j], release_names, receiver):
+                    rel_idx = j
+                    break
+            if rel_idx is None:
+                if any(_is_suspension(e) for e in events[i + 1:]):
+                    yield Finding(
+                        "DTPU010",
+                        fi.path,
+                        ev["line"],
+                        f"{label} acquired with awaits following but no "
+                        f"release on this path — task cancellation leaks "
+                        f"it [in {qual}]",
+                    )
+                continue
+            matched_releases.add(rel_idx)
+            rel = events[rel_idx]
+            if rel.get("fin"):
+                continue  # try/finally: cancellation-safe
+            if any(_is_suspension(e) for e in events[i + 1: rel_idx]):
+                yield Finding(
+                    "DTPU010",
+                    fi.path,
+                    ev["line"],
+                    f"{label} released outside try/finally with awaits "
+                    f"in between — cancellation at any of them leaks it "
+                    f"[in {qual}]",
+                )
+
+    def _acquire_of(self, flow, fi, ev):
+        """(release-names, receiver, label) when ev acquires a tracked
+        resource; None otherwise. ``enter`` events are context-managed
+        and inherently safe."""
+        k = ev["k"]
+        if k == "aug" and ev["op"] == "+":
+            return (("-",), ev["target"], f"counter {ev['target']} bump")
+        if k not in ("await", "call") or not ev.get("callee"):
+            return None
+        callee = ev["callee"]
+        final = _final(callee)
+        if final in ACQUIRE_RELEASE:
+            return (
+                ACQUIRE_RELEASE[final],
+                _receiver(callee),
+                f"resource ({callee})",
+            )
+        if _is_wakeup_claim(flow, fi, callee):
+            return (
+                ("ack", "release"),
+                _receiver(callee),
+                f"wakeup claim ({callee})",
+            )
+        return None
+
+    @staticmethod
+    def _releases(ev, release_names, receiver) -> bool:
+        if ev["k"] == "aug":
+            return ev["op"] == "-" and ev["target"] == receiver
+        if ev["k"] not in ("await", "call") or not ev.get("callee"):
+            return False
+        callee = ev["callee"]
+        return _final(callee) in release_names and (
+            _receiver(callee) == receiver or not receiver
+        )

@@ -1,0 +1,97 @@
+"""DTPU011: fault-point boundary coverage for raw I/O.
+
+The deterministic fault layer (:mod:`dstack_tpu.faults`) can only
+exercise failure paths that sit behind a ``faults.fire`` point, and
+the chaos suite can only assert invariants about errors that arrive
+TYPED. PR 5's worst find was the gap between the two: ``aiohttp``
+raised a raw ``OSError`` below the agent transport whose handlers
+mapped ``ClientConnectionError``/timeouts only — the reconciler tick
+crashed on an exception class nobody had seen in a test, because no
+injection point could produce it there.
+
+This rule generalizes that incident. For every raw network/DB I/O
+call site in the instrumented planes (``aiohttp`` session calls,
+``asyncio.open_connection``, asyncpg ``conn.fetch*``):
+
+- **uninstrumented I/O**: the call is not under any fault injection
+  point — neither its function nor (transitively) every caller path
+  fires one — so no chaos plan can fail it deterministically;
+- **unmapped OSError** (the PR 5 shape): the call sits in a ``try``
+  whose handlers name specific transport errors but nothing covering
+  ``OSError`` — the one class raw sockets add beneath every HTTP
+  client — so a tunnel reset/DNS failure escapes the typed-error
+  boundary exactly like the original bug.
+
+Sites below the fault boundary by design (wire-protocol internals,
+startup-only paths that run before the chaos planes are live) opt out
+with ``# dtpu: noqa[DTPU011] <why>``.
+"""
+
+from typing import Iterable
+
+from tools.dtpu_lint.core import Finding, ProjectRule, register
+from tools.dtpu_lint.flow import (
+    _is_db_io,
+    _is_net_io,
+    get_flow,
+    report_paths,
+)
+
+#: handler type names (finals) that cover a raw OSError
+_OS_COVERING = frozenset({"OSError", "IOError", "Exception", "BaseException"})
+
+
+@register
+class FaultBoundaryCoverageRule(ProjectRule):
+    id = "DTPU011"
+    name = "raw I/O outside fault-point / typed-error boundary"
+
+    def check_project(self, repo) -> Iterable[Finding]:
+        flow = get_flow(repo)
+        scope = report_paths(repo)
+        for fi in flow.functions():
+            if fi.path not in scope:
+                continue
+            f = fi.summary
+            qual = f["qual"]
+            seen = set()
+            for ev in f["events"]:
+                if ev["k"] not in ("await", "call", "enter"):
+                    continue
+                callee = ev.get("callee")
+                if not callee:
+                    continue
+                net = _is_net_io(callee)
+                db = _is_db_io(callee)
+                if not (net or db):
+                    continue
+                kind = "network" if net else "DB"
+                if not fi.covered:
+                    key = ("fire", callee)
+                    if key not in seen:
+                        seen.add(key)
+                        yield Finding(
+                            "DTPU011",
+                            fi.path,
+                            ev["line"],
+                            f"{kind} I/O ({callee}) not under any fault "
+                            f"injection point — no chaos plan can fail it "
+                            f"deterministically [in {qual}]",
+                        )
+                handlers = ev.get("handlers") or []
+                if handlers:
+                    finals = {h.rsplit(".", 1)[-1] for h in handlers}
+                    if not finals & _OS_COVERING:
+                        key = ("os", callee)
+                        if key not in seen:
+                            seen.add(key)
+                            yield Finding(
+                                "DTPU011",
+                                fi.path,
+                                ev["line"],
+                                f"{kind} I/O ({callee}) inside a try that "
+                                f"maps {sorted(finals)} but not OSError — "
+                                f"a raw socket error escapes the typed-"
+                                f"error boundary (the PR 5 unmapped "
+                                f"transport error) [in {qual}]",
+                            )

@@ -90,11 +90,18 @@ class _Checker(ast.NodeVisitor):
     def visit_ClassDef(self, node):
         for stmt in node.body:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # constructors run once per engine, not per token/
+                # request: a one-time jnp.zeros there is allocation,
+                # not a dispatch-path upload (the blocking-sync checks
+                # above still apply anywhere in the file)
+                dispatch = stmt.name not in (
+                    "__init__", "__post_init__", "__new__"
+                )
                 self._ctx.append(f"{node.name}.{stmt.name}")
-                self._method_depth += 1
+                self._method_depth += 1 if dispatch else 0
                 for inner in stmt.body:
                     self.visit(inner)
-                self._method_depth -= 1
+                self._method_depth -= 1 if dispatch else 0
                 self._ctx.pop()
             else:
                 self.visit(stmt)

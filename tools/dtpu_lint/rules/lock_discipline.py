@@ -1,0 +1,177 @@
+"""DTPU009: entity-lock / advisory-lock discipline.
+
+The server's locks are namespaced (``jobs``, ``runs``, ``instances``,
+``volumes``, ``gateways``, placement …) and come in two flavors:
+non-blocking SKIP-LOCKED claims (``claim_one`` / ``claim_batch``) and
+blocking waits (``lock_ctx`` → ``LockSet.acquire``). Three shapes are
+deadlock-prone and invisible to per-file review:
+
+- **nested acquisition of the same namespace** — a handler that claims
+  ``jobs`` and awaits a helper that claims ``jobs`` again waits on (or
+  skips past) its own claim, depending on engine; either is a bug;
+- **inconsistent acquisition order across functions** — function A
+  takes ``jobs`` then ``instances`` while function B takes
+  ``instances`` then ``jobs``: run concurrently they ABBA-deadlock.
+  The order graph is global, so only a project-wide pass can see it;
+- **awaiting a blocking cross-namespace lock while one is held** —
+  a blocking wait of unbounded depth under a held claim pins the claim
+  (and on Postgres its lock-pool connection) behind another queue.
+
+Acquisitions are tracked interprocedurally: holding ``jobs`` and
+awaiting a function that three calls down claims ``instances`` records
+the ``jobs → instances`` edge. Namespaces are recognized from the
+first string-literal argument; dynamically-named locks participate in
+held-state tracking but not in order analysis.
+"""
+
+from typing import Iterable
+
+from tools.dtpu_lint.core import Finding, ProjectRule, register
+from tools.dtpu_lint.flow import (
+    BLOCKING_LOCK_NAMES,
+    CLAIM_NAMES,
+    get_flow,
+    report_paths,
+)
+
+
+@register
+class LockDisciplineRule(ProjectRule):
+    id = "DTPU009"
+    name = "lock-order / nested-lock discipline"
+
+    def check_project(self, repo) -> Iterable[Finding]:
+        flow = get_flow(repo)
+        scope = report_paths(repo)
+        findings: list = []
+        # (ns_before, ns_after) -> [(path, qual, line)]
+        edges: dict = {}
+        for fi in flow.functions():
+            if fi.path not in scope or not fi.summary["is_async"]:
+                continue
+            self._walk(flow, fi, findings, edges)
+        # order-graph conflicts: X→Y and Y→X both witnessed
+        reported = set()
+        for (x, y), wits in sorted(edges.items()):
+            if (y, x) not in edges or x >= y:
+                continue
+            other = edges[(y, x)]
+            for path, qual, line in wits:
+                key = (path, qual, x, y)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(
+                    Finding(
+                        "DTPU009",
+                        path,
+                        line,
+                        f"inconsistent lock order: {x} acquired before {y} "
+                        f"[in {qual}], but {y} before {x} "
+                        f"[in {other[0][1]}] — concurrent ABBA deadlock",
+                    )
+                )
+            for path, qual, line in other:
+                key = (path, qual, y, x)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(
+                    Finding(
+                        "DTPU009",
+                        path,
+                        line,
+                        f"inconsistent lock order: {y} acquired before {x} "
+                        f"[in {qual}], but {x} before {y} "
+                        f"[in {wits[0][1]}] — concurrent ABBA deadlock",
+                    )
+                )
+        return findings
+
+    def _walk(self, flow, fi, findings, edges) -> None:
+        f = fi.summary
+        qual = f["qual"]
+        held: list = []  # (ns-or-None, callee)
+        seen = set()
+        for ev in f["events"]:
+            k = ev["k"]
+            callee = ev.get("callee")
+            if k == "exit":
+                if held and held[-1][1] == callee:
+                    held.pop()
+                continue
+            if k not in ("enter", "await") or not callee:
+                continue
+            final = callee.rsplit(".", 1)[-1]
+            noqa = set(ev.get("noqa", ()))
+            is_claim = final in CLAIM_NAMES
+            is_blocking = final in BLOCKING_LOCK_NAMES
+            if (is_claim or is_blocking) and "DTPU009" not in noqa:
+                ns = ev.get("arg0")
+                self._check_acquire(
+                    fi, qual, ev, ns, is_blocking, held, findings, edges,
+                    seen, via=None,
+                )
+                if k == "enter":
+                    held.append((ns, callee))
+                continue
+            if k == "enter":
+                held.append((None, callee))  # non-lock ctx: neutral
+                continue
+            # plain await: does the callee transitively acquire locks?
+            if not held or all(h[0] is None for h in held):
+                continue
+            if "DTPU009" in noqa:
+                continue
+            reach = set()
+            for t in flow.callee_facts(fi, callee):
+                reach |= set(t.lock_reach)
+            for ns2, blocking2 in sorted(
+                reach, key=lambda e: (str(e[0]), e[1])
+            ):
+                self._check_acquire(
+                    fi, qual, ev, ns2, blocking2, held, findings, edges,
+                    seen, via=callee,
+                )
+
+    def _check_acquire(
+        self, fi, qual, ev, ns, blocking, held, findings, edges, seen, via
+    ) -> None:
+        suffix = f" via {via}" if via else ""
+        for hns, _ in held:
+            if hns is None:
+                continue
+            if ns is not None and ns == hns:
+                key = ("nested", ns, via)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(
+                        Finding(
+                            "DTPU009",
+                            fi.path,
+                            ev["line"],
+                            f"nested acquisition of lock namespace "
+                            f"'{ns}'{suffix} while already holding it "
+                            f"[in {qual}]",
+                        )
+                    )
+                continue
+            if ns is not None:
+                edges.setdefault((hns, ns), []).append(
+                    (fi.path, qual, ev["line"])
+                )
+            if blocking:
+                key = ("blocking", hns, ns, via)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(
+                        Finding(
+                            "DTPU009",
+                            fi.path,
+                            ev["line"],
+                            f"blocking acquisition of lock namespace "
+                            f"'{ns or '<dynamic>'}'{suffix} while holding "
+                            f"'{hns}' — unbounded wait under a held lock "
+                            f"[in {qual}]",
+                        )
+                    )

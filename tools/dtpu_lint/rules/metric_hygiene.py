@@ -157,3 +157,26 @@ class MetricDocsRule(ProjectRule):
 
     def check_project(self, repo):
         return docs_coverage_findings(repo)
+
+
+def shim_main() -> int:
+    """The whole CLI of tools/check_metrics_docs.py (a pure delegating
+    entry point since the shim fold): docs-coverage scan with the old
+    exit-code contract."""
+    from tools.dtpu_lint.core import REPO
+
+    missing = docs_coverage_findings(REPO)
+    if missing:
+        print(
+            "exported metrics missing from docs/reference/server.md "
+            "(add them to the 'Metrics & timeline' section):",
+            file=sys.stderr,
+        )
+        for f in missing:
+            print(f"  {f.message}", file=sys.stderr)
+        return 1
+    print(
+        f"docs cover all {len(collect_metric_names(REPO))} exported series "
+        "(dtpu-lint DTPU004)"
+    )
+    return 0

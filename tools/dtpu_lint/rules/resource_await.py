@@ -1,0 +1,165 @@
+"""DTPU008: exclusive resource held across a blocking await.
+
+The PR 7 pool deadlock, generalized. Holding an exclusive resource —
+a DB transaction (the sqlite engine's single-writer lock; a pooled
+connection on Postgres), a bounded-pool connection, a TokenBucket
+charge, an engine slot — while awaiting something *unbounded* hands
+the event loop a classic resource-ordering hazard:
+
+- awaiting **lock acquisition** (entity locks, advisory claims) while
+  holding the resource serializes every other holder behind a lock
+  queue of unknown depth;
+- awaiting an **agent/network RPC** pins the resource for a remote
+  round trip (seconds under fault injection, forever under a hang);
+- awaiting anything that transitively reaches a **retry_async site**
+  pins it for a whole jittered backoff schedule;
+- awaiting anything that **re-acquires from the same pool** the held
+  connection came from is the literal PR 7 shape: enough concurrent
+  holders exhaust the pool and every body blocks on itself — a hard
+  deadlock no unit test reaches (15 claimants × 8 connections did it
+  at the 1500-job bench).
+
+Tracked held resources: ``db.transaction()`` contexts (and any
+asynccontextmanager that transitively holds a pool connection across
+its yield), advisory-claim contexts (pool-identity checks only), and
+the ctx-held forms of a QoS bucket charge / engine slot
+(``async with bucket.charged(...)`` / ``engine.hold_slot(...)`` —
+see ``flow.BUCKET_HOLD_NAMES``/``SLOT_HOLD_NAMES``; the imperative
+``try_acquire``/``refund`` style is DTPU010's domain).
+
+Findings are interprocedural: ``async with db.transaction():`` +
+``await helper()`` is flagged when ``helper`` reaches an RPC three
+calls down. Opt-outs at the await line (``# dtpu: noqa[DTPU008]
+<why>``) — or at the *acquisition source* for reentrancy-aware code
+(``PostgresDatabase._conn`` diverts to the held tx connection via a
+contextvar; its pragma silences every transitive report).
+"""
+
+from typing import Iterable
+
+from tools.dtpu_lint.core import Finding, ProjectRule, register
+from tools.dtpu_lint.flow import (
+    BLOCKING_LOCK_NAMES,
+    CLAIM_NAMES,
+    RETRY_NAMES,
+    _is_net_io,
+    _pool_token,
+    get_flow,
+    report_paths,
+)
+
+#: held-resource kinds that make ANY blocking await a finding (the
+#: single-writer tx lock is the most contended object in the server)
+_STRICT_KINDS = frozenset({"tx", "bucket", "slot"})
+
+
+def _classify_await(flow, fi, callee: str) -> list:
+    """Blocking classes an awaited call belongs to."""
+    out = []
+    final = callee.rsplit(".", 1)[-1]
+    targets = flow.callee_facts(fi, callee)
+    if final in CLAIM_NAMES or final in BLOCKING_LOCK_NAMES or any(
+        t.lock_reach for t in targets
+    ):
+        out.append("lock acquisition")
+    if _is_net_io(callee) or any(t.reaches_rpc for t in targets):
+        out.append("network RPC")
+    if final in RETRY_NAMES or any(t.reaches_retry for t in targets):
+        out.append("a retry/backoff loop")
+    return out
+
+
+def _await_pool_tokens(flow, fi, callee: str) -> set:
+    toks = set()
+    direct = _pool_token(callee, fi.summary["cls"])
+    if direct:
+        toks.add(direct)
+    for t in flow.callee_facts(fi, callee):
+        toks |= set(t.pool_tokens)
+    return toks
+
+
+@register
+class ResourceAcrossAwaitRule(ProjectRule):
+    id = "DTPU008"
+    name = "exclusive resource held across blocking await"
+
+    def check_project(self, repo) -> Iterable[Finding]:
+        flow = get_flow(repo)
+        scope = report_paths(repo)
+        seen = set()
+        for fi in flow.functions():
+            if fi.path not in scope or not fi.summary["is_async"]:
+                continue
+            yield from self._check_function(flow, fi, seen)
+
+    def _check_function(self, flow, fi, seen):
+        f = fi.summary
+        held: list = []  # (callee, frozenset of (kind, token) entries)
+        for ev in f["events"]:
+            k = ev["k"]
+            callee = ev.get("callee")
+            if k == "exit":
+                if held and held[-1][0] == callee:
+                    held.pop()
+                continue
+            if k not in ("enter", "await") or not callee:
+                continue
+            # classify this await against what is CURRENTLY held —
+            # before an enter installs its own resources
+            if held:
+                yield from self._check_await(flow, fi, ev, held, seen)
+            if k == "enter":
+                held.append((callee, frozenset(flow._direct_hold(fi, ev))))
+
+    def _check_await(self, flow, fi, ev, held, seen):
+        callee = ev["callee"]
+        final = callee.rsplit(".", 1)[-1]
+        held_res = set().union(*(h[1] for h in held))
+        if not held_res:
+            return
+        strict = [r for r in held_res if r[0] in _STRICT_KINDS]
+        qual = f"{fi.summary['qual']}"
+        if strict:
+            for cls in _classify_await(flow, fi, callee):
+                key = (fi.path, qual, callee, cls)
+                if key in seen:
+                    continue
+                seen.add(key)
+                res = strict[0]
+                yield Finding(
+                    "DTPU008",
+                    fi.path,
+                    ev["line"],
+                    f"{_describe(res)} held across {cls} "
+                    f"(await {final}) [in {qual}]",
+                )
+        # same-pool re-acquisition: checked for EVERY held pool token,
+        # strict or not — this is the PR 7 deadlock shape
+        held_pools = {r[1] for r in held_res if r[0] == "pool"}
+        if held_pools:
+            re_acq = _await_pool_tokens(flow, fi, callee) & held_pools
+            for tok in sorted(re_acq):
+                key = (fi.path, qual, callee, "pool", tok)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Finding(
+                    "DTPU008",
+                    fi.path,
+                    ev["line"],
+                    f"re-acquisition from pool {tok.split('::')[-1]} while "
+                    f"holding one of its connections (await {final}) — the "
+                    f"PR 7 claim-pool deadlock shape [in {qual}]",
+                )
+
+
+def _describe(res) -> str:
+    kind = res[0]
+    if kind == "tx":
+        return "DB transaction (single-writer lock / pooled connection)"
+    if kind == "bucket":
+        return "QoS token-bucket charge"
+    if kind == "slot":
+        return "engine slot"
+    return f"{kind} {res[1]}"

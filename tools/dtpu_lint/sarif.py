@@ -1,0 +1,120 @@
+"""SARIF 2.1.0 rendering for CI artifacts.
+
+SARIF (Static Analysis Results Interchange Format, OASIS standard) is
+what code-scanning UIs ingest; emitting it lets CI upload
+``lint.sarif`` and annotate PR diffs with dtpu-lint findings without
+any custom glue. Only the minimal valid subset is produced: one run,
+the rule catalog as ``tool.driver.rules``, one ``result`` per finding
+with a physical location. ``level`` is ``error`` for findings beyond
+the baseline and ``note`` for grandfathered ones (both are included so
+the artifact shows the full picture; the exit code still keys off the
+baseline diff alone).
+"""
+
+from typing import Iterable, Optional, Sequence
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def render_sarif(
+    new: Sequence,
+    grandfathered: Sequence = (),
+    rules: Optional[dict] = None,
+    base_uri: Optional[str] = None,
+) -> dict:
+    """Findings → a SARIF 2.1.0 log dict (``json.dumps``-ready)."""
+    rule_ids = sorted(
+        {f.rule for f in new}
+        | {f.rule for f in grandfathered}
+        | (set(rules) if rules else set())
+    )
+    driver: dict = {
+        "name": "dtpu-lint",
+        "informationUri": "docs/reference/lint.md",
+        "rules": [
+            {
+                "id": rid,
+                "shortDescription": {
+                    "text": getattr(
+                        (rules or {}).get(rid), "name", rid
+                    )
+                    or rid
+                },
+            }
+            for rid in rule_ids
+        ],
+    }
+    run: dict = {
+        "tool": {"driver": driver},
+        "results": [
+            *(_result(f, "error") for f in new),
+            *(_result(f, "note") for f in grandfathered),
+        ],
+    }
+    if base_uri:
+        run["originalUriBaseIds"] = {
+            "REPOROOT": {"uri": base_uri}
+        }
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [run],
+    }
+
+
+def _result(f, level: str) -> dict:
+    return {
+        "ruleId": f.rule,
+        "level": level,
+        "message": {"text": f.message},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(1, int(f.line))},
+                }
+            }
+        ],
+    }
+
+
+def validate_minimal(log: dict) -> list:
+    """Structural check against the SARIF 2.1.0 required shape —
+    returns a list of problems (empty = valid subset). Used by the
+    tier-1 test so CI never uploads an artifact scanners reject; the
+    full JSON Schema validation runs too when ``jsonschema`` is
+    importable."""
+    problems = []
+    if log.get("version") != SARIF_VERSION:
+        problems.append("version must be '2.1.0'")
+    runs = log.get("runs")
+    if not isinstance(runs, list) or not runs:
+        problems.append("runs must be a non-empty array")
+        return problems
+    for i, run in enumerate(runs):
+        driver = (run.get("tool") or {}).get("driver") or {}
+        if not driver.get("name"):
+            problems.append(f"runs[{i}].tool.driver.name missing")
+        for j, res in enumerate(run.get("results", ())):
+            if not isinstance(res.get("message", {}).get("text"), str):
+                problems.append(f"runs[{i}].results[{j}].message.text missing")
+            if "ruleId" not in res:
+                problems.append(f"runs[{i}].results[{j}].ruleId missing")
+            for loc in res.get("locations", ()):
+                art = (loc.get("physicalLocation") or {}).get(
+                    "artifactLocation"
+                ) or {}
+                if not isinstance(art.get("uri"), str):
+                    problems.append(
+                        f"runs[{i}].results[{j}] location uri missing"
+                    )
+    return problems
+
+
+def iter_results(log: dict) -> Iterable[dict]:
+    for run in log.get("runs", ()):
+        yield from run.get("results", ())
