@@ -70,8 +70,27 @@ POINTS: dict = {
         ("tenant", "run"),
     ),
     "serve.engine.step": (
-        "one decode step of the inference engine (serve/engine.py); "
-        "runs on the worker thread — sync actions only",
+        "one decode step of the inference engine (serve/engine.py), "
+        "fired once per live slot before the dispatch with ctx "
+        "slot=<index>; runs on the worker thread — sync actions only. "
+        "'hang' with a ctx slot wedges exactly that slot's step, the "
+        "shape the serve scheduler's engine watchdog "
+        "(DTPU_ENGINE_WATCHDOG_SECONDS) attributes and aborts",
+        ("slot",),
+    ),
+    "serve.stream": (
+        "one relayed upstream chunk of a resumable SSE completion "
+        "stream (routing/forward); raise 'connect'/'oserror' on the "
+        "nth chunk to kill the replica mid-body — the forwarder must "
+        "resume the stream on another replica (or end it with a "
+        "terminal SSE error event), never a truncated/hung stream",
+        ("replica", "chunk"),
+    ),
+    "serve.deadline": (
+        "one per-request deadline check in the serve scheduler "
+        "(serve/openai_server); a mutate rule's 'value' is added as "
+        "clock skew (seconds) to the check, so value: 1e9 forces "
+        "every armed deadline to read expired deterministically",
         (),
     ),
     "db.commit": (

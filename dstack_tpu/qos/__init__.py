@@ -279,6 +279,18 @@ class QoSPolicy:
 
 TENANT_HEADER = "X-DTPU-Tenant"
 PRIORITY_HEADER = "X-DTPU-Priority"
+#: router-asserted marker on a mid-stream-failover continuation: the
+#: proxy/gateway strip client-supplied values (routing.forward
+#: _DROP_REQUEST) and inject it only on a resume re-dispatch, so the
+#: serve edge may trust it the same way it trusts TENANT_HEADER —
+#: a resumed continuation was already admitted (and charged) on its
+#: original leg and must not be charged or shed again
+RESUME_HEADER = "X-DTPU-Resume"
+#: per-request wall-clock budget in seconds (float), set by the client
+#: or defaulted by DTPU_REQUEST_DEADLINE_DEFAULT at the serve edge; the
+#: forwarder rewrites it to the REMAINING budget on every failover /
+#: resume re-dispatch so the budget spans the whole request, not each leg
+DEADLINE_HEADER = "X-DTPU-Deadline"
 ANONYMOUS_TENANT = "anonymous"
 
 
@@ -558,6 +570,24 @@ class PriorityPending:
         if not self._heap:
             self._event.clear()
         return out
+
+    def drain_matching(self, pred: Callable[[Any], bool]) -> list:
+        """Remove and return every queued item matching ``pred`` (one
+        pred call per item — predicates may have side effects, e.g. the
+        deadline check fires a fault point). Survivors keep their
+        (priority, arrival seq) ordering. The serve scheduler uses this
+        to fail deadline-expired requests still parked in the queue —
+        a silent ``discard`` would leave their clients hanging."""
+        kept: list = []
+        out: list = []
+        for entry in self._heap:
+            (out if pred(entry[2]) else kept).append(entry)
+        if out:
+            self._heap = kept
+            heapq.heapify(self._heap)
+            if not self._heap:
+                self._event.clear()
+        return [entry[2] for entry in out]
 
     def any_admissible(
         self,

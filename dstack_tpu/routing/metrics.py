@@ -41,6 +41,13 @@ def new_router_registry() -> Registry:
         "unroutable (dead/draining)",
     )
     r.counter(
+        "dtpu_router_stream_resumes_total",
+        "In-flight SSE completion streams re-dispatched onto another "
+        "replica after the upstream died mid-body (resumable "
+        "generation: the continuation re-prefills prompt + delivered "
+        "tokens and the client stream continues without a 5xx)",
+    )
+    r.counter(
         "dtpu_router_breaker_opens_total",
         "Circuit-breaker opens (replica marked DEAD after consecutive "
         "failures)",

@@ -51,6 +51,13 @@ class GenParams:
     # raw logits before sampling (±100 effectively bans/forces)
     logit_bias: Optional[dict] = None
     seed: Optional[int] = None  # per-request sampling seed
+    # resumable generation: advance the seeded PRNG stream by this many
+    # draws before the first sample, so a request whose prompt was
+    # extended by n already-generated tokens (mid-stream failover
+    # resume) samples token n+1 with EXACTLY the key the original
+    # stream would have used. Ignored when seed is None (greedy resume
+    # needs no RNG; unseeded sampling is not resumable).
+    seed_skip: int = 0
     eos_id: Optional[int] = None
     stop: Optional[list] = None  # stop strings (matched by the server)
     # None = off; n >= 0 = collect logprobs with n alternatives (≤ 5)
@@ -1544,6 +1551,21 @@ def sample(
     return tokens, jax.vmap(jax.random.key_data)(splits[:, 0])
 
 
+def skip_key_data(kd: jax.Array, n) -> jax.Array:
+    """Advance per-slot PRNG key data ``kd`` ([2] uint32) by ``n``
+    draws, replaying exactly :func:`sample`'s per-token key evolution
+    (``key' = split(key, 2)[0]``). Mid-stream resume uses this so a
+    seeded-sampled request re-prefilled with n already-delivered tokens
+    continues the ORIGINAL stream's randomness instead of restarting
+    it. ``n`` is traced (one compile serves every resume length)."""
+
+    def body(_, k):
+        key = jax.random.wrap_key_data(k)
+        return jax.random.key_data(jax.random.split(key, 2)[0])
+
+    return jax.lax.fori_loop(0, n, body, kd)
+
+
 TOP_LOGPROBS = 5  # static alternatives-per-token count (OpenAI max is 5)
 
 
@@ -1882,6 +1904,14 @@ class InferenceEngine:
         self._logprobs = jax.jit(token_logprobs)
         self._mark_seen = jax.jit(_mark_seen, donate_argnums=(0, 1))
         self._mark_prompt = jax.jit(_mark_prompt, donate_argnums=(0, 1))
+        self._skip_key = jax.jit(skip_key_data)
+        # watchdog plumbing: the serve scheduler runs step() on a worker
+        # thread and may give up on a wedged dispatch (abandon_step).
+        # The abandoned thread checks the epoch after every pre-dispatch
+        # suspension point and before publishing, so its eventual return
+        # can never corrupt slot state the scheduler has since reused.
+        self._step_epoch = 0
+        self._step_wedge: Optional[tuple] = None  # ("slot", i) | ("dispatch",)
 
     def free_slots(self) -> list[int]:
         return [
@@ -2155,9 +2185,13 @@ class InferenceEngine:
         else:
             self._auto_seed += 1
             req_seed = self._auto_seed
-        self._key_data = self._key_data.at[slot].set(
-            jax.random.key_data(jax.random.key(req_seed))
-        )
+        kd = jax.random.key_data(jax.random.key(req_seed))
+        if gen.seed is not None and gen.seed_skip > 0:
+            # resumable generation: replay the n key advances the
+            # delivered tokens consumed, so the continuation samples
+            # from the original stream's key sequence (skip_key_data)
+            kd = self._skip_key(kd, gen.seed_skip)
+        self._key_data = self._key_data.at[slot].set(kd)
         pad = 16  # bucket the mark_prompt compile per power-of-2 length
         while pad < tp:
             pad *= 2
@@ -2284,12 +2318,34 @@ class InferenceEngine:
         Wraps the dispatch in the step-latency/TPOT/throughput
         histograms — recorded here, at the engine, so the HTTP server
         and the offline bench export identical numbers."""
-        # chaos hook (no-op call when no plan is installed): provokes
-        # mid-decode engine death; the scheduler loop must fail only
-        # the inflight requests and keep serving
-        faults.fire("serve.engine.step")
+        epoch = self._step_epoch
+        # chaos hook (no-op calls when no plan is installed), fired once
+        # per live slot with ctx slot=<i>: a raise provokes mid-decode
+        # engine death (the scheduler loop must fail only the inflight
+        # requests and keep serving); a hang with a ctx slot wedges
+        # exactly that slot's step — the shape the scheduler's watchdog
+        # attributes via _step_wedge and aborts via abandon_step().
+        for i in range(self.max_batch):
+            if not self.active[i]:
+                continue
+            self._step_wedge = ("slot", i)
+            faults.fire("serve.engine.step", slot=i)
+            if epoch != self._step_epoch:
+                # the watchdog abandoned this step while it was wedged
+                # here; slot state may have been reused since — return
+                # without touching anything
+                return {}
+        self._step_wedge = ("dispatch",)
         t0 = time.perf_counter()
         out = self._step_dispatch()
+        self._step_wedge = None
+        # NOTE: no epoch check after the dispatch — its host/device
+        # mutations already happened, so discarding `out` could only
+        # hide them (and would LOSE tokens when a step completes
+        # concurrently with a watchdog trip). A dispatch-abandoned
+        # step is instead neutralized by the scheduler: it quiesces
+        # the engine until this thread returns, then calls
+        # :meth:`finish_abandoned_step` before dispatching again.
         if out:
             dt = time.perf_counter() - t0
             n_tokens = sum(len(v) for v in out.values())
@@ -2645,6 +2701,31 @@ class InferenceEngine:
         """Slots with a queued/in-progress chunked prefill (admission
         order)."""
         return list(self._prefilling)
+
+    def abandon_step(self) -> Optional[tuple]:
+        """Watchdog entry: give up on a wedged :meth:`step` running on
+        a worker thread → the wedge phase — ``("slot", i)`` when the
+        hang is attributable to one slot's pre-dispatch work (the
+        chaos-injectable shape: only that slot need die; the epoch
+        bump makes the sleeping thread return before it touches any
+        state), ``("dispatch",)`` when the jitted dispatch itself is
+        stuck (the whole batch is the failure domain, and the caller
+        must QUIESCE — no admission, no new dispatch — until the stuck
+        thread actually returns, then call
+        :meth:`finish_abandoned_step`), or None when the step finished
+        concurrently with the trip (the caller should harvest its
+        result, not abort anything)."""
+        phase = self._step_wedge
+        self._step_epoch += 1
+        self._step_wedge = None
+        return phase
+
+    def finish_abandoned_step(self) -> None:
+        """Called once a dispatch-abandoned step's thread has actually
+        returned: the stale step rebuilt the device decode mirrors
+        from slot state the scheduler has since released — drop them
+        so the next dispatch rebuilds from current host truth."""
+        self._invalidate_decode_cache()
 
     def release(self, slot: int) -> None:
         self.active[slot] = False

@@ -92,6 +92,25 @@ def new_serve_registry() -> Registry:
         "dtpu_serve_kv_cache_utilization_ratio",
         "Cached tokens across live slots / (max_batch * max_seq)",
     )
+    # request lifecycle hardening: deadlines, watchdog, stream resume
+    r.counter(
+        "dtpu_serve_deadline_expired_total",
+        "Requests aborted because their per-request deadline "
+        "(X-DTPU-Deadline / DTPU_REQUEST_DEADLINE_DEFAULT) expired — "
+        "queued or in a slot; an aborted slot frees its KV immediately",
+    )
+    r.counter(
+        "dtpu_serve_watchdog_aborts_total",
+        "Engine-watchdog trips: a step() dispatch exceeded "
+        "DTPU_ENGINE_WATCHDOG_SECONDS and was abandoned (the wedged "
+        "slot — or, unattributable, the whole batch — was aborted)",
+    )
+    r.counter(
+        "dtpu_serve_resumed_requests_total",
+        "Continuations accepted via the router's mid-stream-failover "
+        "resume extension (prompt re-prefilled with already-delivered "
+        "tokens; admission charge stays on the original leg)",
+    )
     # prefix cache
     r.counter(
         "dtpu_serve_prefix_hits_total",

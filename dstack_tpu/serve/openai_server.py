@@ -18,11 +18,32 @@ interactive/standard/batch) with per-tenant in-flight caps so one
 flooding tenant can never hold every slot. Policy comes from
 ``DTPU_QOS_*`` env (injected by the job configurator from the service
 spec's ``qos`` block) or the ``--qos-*`` flags.
+
+Request-lifecycle hardening (serving.md §9):
+
+- **Per-request deadlines.** ``X-DTPU-Deadline`` (seconds) — or
+  ``DTPU_REQUEST_DEADLINE_DEFAULT`` when absent — arms a
+  ``utils/retry.Deadline`` that follows the request from the pending
+  queue into its engine slot; the scheduler aborts expired requests
+  every tick (slot released → KV freed, 504 to the client, un-started
+  QoS token refunded). The ``serve.deadline`` fault point injects
+  clock skew into the check.
+- **Engine watchdog.** ``DTPU_ENGINE_WATCHDOG_SECONDS`` bounds one
+  ``engine.step`` dispatch: a wedged step (the ``serve.engine.step``
+  hang fault, or a stuck device) is abandoned and only the wedged slot
+  is aborted — the other in-flight streams keep decoding.
+- **Resumable continuations.** The router's mid-stream failover
+  re-dispatches a dying stream here with ``dtpu_resume`` + the
+  proxy-asserted ``X-DTPU-Resume`` header: the delivered text is
+  appended to the rendered prompt (re-prefill rides the prefix cache),
+  the budget shrinks accordingly, seeded streams replay their PRNG
+  advance, and the continuation is neither re-charged nor re-shed.
 """
 
 import argparse
 import asyncio
 import json
+import os
 import re
 import time
 import uuid
@@ -31,14 +52,22 @@ from typing import Optional
 
 from aiohttp import web
 
-from dstack_tpu import qos
+from dstack_tpu import faults, qos
 from dstack_tpu.proxy.model_tgi import DEFAULT_CHAT_TEMPLATE, render_chat
 from dstack_tpu.qos.metrics import get_qos_registry
 from dstack_tpu.serve.engine import GenParams, InferenceEngine
 from dstack_tpu.serve.tokenizer import Tokenizer, load_tokenizer
 from dstack_tpu.utils.logging import get_logger
+from dstack_tpu.utils.retry import Deadline
 
 logger = get_logger("serve.openai")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.getenv(name, "") or default)
+    except ValueError:
+        return default
 
 
 class _Request:
@@ -57,11 +86,30 @@ class _Request:
         self.submitted_at: Optional[float] = None  # set by Scheduler.submit
         self.queue: asyncio.Queue = asyncio.Queue()  # token ids, then None
         self.error: Optional[str] = None
+        self.error_status = 500  # HTTP status a non-streaming error maps to
+        self.retry_after: Optional[int] = None  # hint for 429/503 errors
         self.finish_reason: Optional[str] = None
         self.cancelled = False
         self.gen_ids: list[int] = []  # for stop-string matching
         # per generated token: (logprob, [(alt_id, alt_lp), ...])
         self.logprob_entries: list = []
+        # lifecycle hardening (serving.md §9)
+        self.deadline: Optional[Deadline] = None
+        self.bucket = None  # qos.TokenBucket this request's admission charged
+        self.refunded = False
+        self.started = False  # at least one token queued to the client
+
+
+def _reap_abandoned_step(task) -> None:
+    """Done-callback for a watchdog-abandoned engine step: its outcome
+    is deliberately discarded (the engine's epoch guard already made it
+    a no-op) — retrieving the exception just keeps asyncio from logging
+    'exception was never retrieved'."""
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        logger.warning("abandoned engine step finally returned: %r", exc)
 
 
 class Scheduler:
@@ -80,11 +128,19 @@ class Scheduler:
         engine: InferenceEngine,
         tokenizer: Tokenizer,
         tenant_inflight: int = 0,
+        watchdog_seconds: float = 0.0,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.pending = qos.PriorityPending()
         self.tenant_inflight = max(0, int(tenant_inflight))  # 0 = off
+        # engine watchdog: one step() dispatch may take at most this
+        # long before it is abandoned and the wedged slot aborted
+        # (0 = off — DTPU_ENGINE_WATCHDOG_SECONDS via build_app)
+        self.watchdog_seconds = max(0.0, float(watchdog_seconds))
+        # a dispatch-abandoned step still OWNS the engine until its
+        # thread returns: while set, ticks neither admit nor dispatch
+        self._abandoned: Optional[asyncio.Task] = None
         self.by_slot: dict[int, _Request] = {}
         self.by_prefill: dict[int, _Request] = {}  # chunked prefills in flight
         self._task: Optional[asyncio.Task] = None
@@ -106,8 +162,12 @@ class Scheduler:
 
     def cancel(self, req: _Request) -> None:
         """Client went away: free the slot so decode stops burning steps
-        on an abandoned generation (or its remaining prefill chunks)."""
+        on an abandoned generation (or its remaining prefill chunks).
+        A request cancelled before its first token refunds its QoS
+        charge (the satellite invariant: abusive-reconnect churn must
+        not burn a victim tenant's budget)."""
         req.cancelled = True
+        self._refund_unstarted(req)
         for slot, r in list(self.by_slot.items()):
             if r is req:
                 self.engine.release(slot)
@@ -116,6 +176,136 @@ class Scheduler:
             if r is req:
                 self.engine.release(slot)
                 del self.by_prefill[slot]
+
+    def _refund_unstarted(self, req: _Request) -> None:
+        """Return the admission charge of a request that dies before
+        delivering its first token (disconnect, deadline expiry,
+        watchdog abort, engine failure). A completed — or even merely
+        started — generation keeps its charge; the refund is
+        idempotent per request."""
+        if (
+            req.bucket is not None
+            and not req.refunded
+            and not req.started
+            and req.finish_reason is None
+        ):
+            req.refunded = True
+            req.bucket.refund(1.0)
+
+    # ---- per-request deadlines ----
+
+    def _deadline_expired(self, req: _Request) -> bool:
+        """One deadline check; the ``serve.deadline`` fault point's
+        mutate value is added as clock skew so chaos plans can force
+        expiry deterministically."""
+        if req.deadline is None or req.cancelled:
+            return False
+        skew = faults.mutate("serve.deadline", 0.0)
+        try:
+            skew = float(skew)
+        except (TypeError, ValueError):
+            skew = 0.0
+        rem = req.deadline.remaining()
+        return rem is not None and rem - skew <= 0.0
+
+    def _abort_expired(self) -> None:
+        """Deadline sweep, once per scheduler tick: expired slots are
+        aborted (KV freed immediately — the slot re-enters the free
+        pool this tick) and expired queued requests fail loudly
+        instead of rotting in the heap; un-started charges refund."""
+        for table in (self.by_slot, self.by_prefill):
+            expired = [
+                (slot, req)
+                for slot, req in list(table.items())
+                if self._deadline_expired(req)
+            ]
+            for slot, req in expired:
+                del table[slot]
+                self.engine.release(slot)
+                self._fail_deadline(req)
+        if self.pending.qsize():
+            for req in self.pending.drain_matching(self._deadline_expired):
+                self._fail_deadline(req)
+
+    def _fail_deadline(self, req: _Request) -> None:
+        self.engine.metrics.family(
+            "dtpu_serve_deadline_expired_total"
+        ).inc(1)
+        self._refund_unstarted(req)
+        req.error = "request deadline exceeded"
+        req.error_status = 504
+        req.queue.put_nowait(None)
+
+    # ---- engine watchdog ----
+
+    async def _guarded_step(self) -> Optional[dict]:
+        """``engine.step`` on a worker thread, under the watchdog: a
+        dispatch exceeding ``watchdog_seconds`` is abandoned (the
+        engine's step-epoch guard neutralizes the stuck thread's
+        eventual return) and the wedged slot — or, when the wedge is
+        inside the jitted dispatch and unattributable, the whole batch
+        — is aborted, so one stuck dispatch cannot freeze every
+        stream. Returns None when the watchdog tripped (this tick
+        produced no tokens); engine errors propagate as before."""
+        if self.watchdog_seconds <= 0:
+            return await asyncio.to_thread(self.engine.step)
+        task = asyncio.ensure_future(asyncio.to_thread(self.engine.step))
+        done, _ = await asyncio.wait({task}, timeout=self.watchdog_seconds)
+        if done:
+            return task.result()
+        phase = self.engine.abandon_step()
+        if phase is None:
+            # the step finished concurrently with the trip (its wedge
+            # marker already cleared): this is a slow step, not a
+            # wedge — harvest the result instead of aborting a batch
+            # that just decoded successfully
+            done, _ = await asyncio.wait(
+                {task}, timeout=max(1.0, self.watchdog_seconds)
+            )
+            if done:
+                return task.result()
+            # marker cleared but the thread still won't return —
+            # treat as an unattributable wedge below
+        self.engine.metrics.family("dtpu_serve_watchdog_aborts_total").inc(1)
+        task.add_done_callback(_reap_abandoned_step)
+        if phase is not None and phase[0] == "slot":
+            slot = phase[1]
+            req = self.by_slot.pop(slot, None) or self.by_prefill.pop(
+                slot, None
+            )
+            self.engine.release(slot)
+            logger.error(
+                "engine watchdog: step wedged on slot %d for > %.1fs; "
+                "aborted that slot, %d other requests keep serving",
+                slot, self.watchdog_seconds,
+                len(self.by_slot) + len(self.by_prefill),
+            )
+            if req is not None:
+                self._refund_unstarted(req)
+                req.error = "engine watchdog aborted a wedged decode step"
+                req.queue.put_nowait(None)
+            return None
+        # wedged inside the jitted dispatch: no single slot to blame —
+        # fail the batch honestly (behind the router these streams
+        # resume on another replica) rather than freezing every stream.
+        # The stuck thread still owns the engine's buffers: quiesce
+        # (no admission, no new dispatch) until it actually returns.
+        logger.error(
+            "engine watchdog: dispatch wedged for > %.1fs with no "
+            "attributable slot; failing all %d in-flight requests and "
+            "quiescing until the stuck dispatch returns",
+            self.watchdog_seconds,
+            len(self.by_slot) + len(self.by_prefill),
+        )
+        for table in (self.by_slot, self.by_prefill):
+            for slot, req in list(table.items()):
+                self.engine.release(slot)
+                self._refund_unstarted(req)
+                req.error = "engine watchdog aborted a wedged decode step"
+                req.queue.put_nowait(None)
+            table.clear()
+        self._abandoned = task
+        return None
 
     def _tenant_held_counts(self) -> dict:
         """tenant → slots currently held (prefilling or decoding);
@@ -155,6 +345,7 @@ class Scheduler:
                 logger.exception("scheduler tick failed: %s", e)
                 for slot, req in list(self.by_slot.items()):
                     self.engine.release(slot)
+                    self._refund_unstarted(req)
                     req.error = str(e)
                     req.queue.put_nowait(None)
                 self.by_slot.clear()
@@ -167,6 +358,7 @@ class Scheduler:
             if entry is not None:
                 req.logprob_entries.append(entry)
         if first != req.gen.eos_id:
+            req.started = True  # charge is earned once a token ships
             req.queue.put_nowait(first)
             if self._hit_stop(req, first):
                 self.engine.release(slot)
@@ -197,6 +389,33 @@ class Scheduler:
         return any(t in text for t in req.gen.stop)
 
     async def _tick(self) -> None:
+        if self._abandoned is not None:
+            if not self._abandoned.done():
+                # a dispatch-abandoned step's thread still owns the
+                # engine: fail new arrivals fast (clients must not
+                # hang behind a wedge) and wait for it to return
+                for req in self.pending.drain_matching(lambda r: True):
+                    self._refund_unstarted(req)
+                    req.error = (
+                        "engine wedged: a decode dispatch exceeded the "
+                        "watchdog budget"
+                    )
+                    req.error_status = 503
+                    # the DTPU007 contract: every 429/503 carries a
+                    # retry hint — a wedge clears when the stuck
+                    # dispatch returns, so hint one watchdog budget
+                    req.retry_after = max(1, int(round(self.watchdog_seconds)))
+                    req.queue.put_nowait(None)
+                await asyncio.sleep(0.05)
+                return
+            self._abandoned = None
+            # the stale step rebuilt device mirrors from released slot
+            # state — drop them before the next dispatch
+            self.engine.finish_abandoned_step()
+        # deadline sweep FIRST: an expired slot frees its KV before the
+        # admission pass below, so the reclaimed slot serves live work
+        # in the same tick
+        self._abort_expired()
         # admit pending requests into the free slots (host bookkeeping
         # only — the prompt prefills chunk by chunk below) in ONE heap
         # walk: priority-ordered, a tenant at its in-flight cap skipped
@@ -236,6 +455,7 @@ class Scheduler:
                 slot = self.engine.start_request(req.prompt_ids, req.gen)
             except Exception as e:  # noqa: BLE001 - reported per request
                 logger.exception("admission failed: %s", e)
+                self._refund_unstarted(req)
                 req.error = str(e)
                 req.queue.put_nowait(None)
                 # the walk charged `held` for this request; it holds no
@@ -279,6 +499,7 @@ class Scheduler:
                     if req is None:
                         continue
                     self.engine.release(slot)
+                    self._refund_unstarted(req)
                     req.error = str(e)
                     req.queue.put_nowait(None)
                 return
@@ -300,7 +521,9 @@ class Scheduler:
             # parks until the next push.
             await self.pending.wait()
             return
-        out = await asyncio.to_thread(self.engine.step)
+        out = await self._guarded_step()
+        if out is None:
+            return  # watchdog tripped: bookkeeping already done
         for slot, toks in out.items():
             req = self.by_slot.get(slot)
             if req is None:
@@ -313,6 +536,7 @@ class Scheduler:
                     entry = self.engine.take_logprobs(slot)
                     if entry is not None:
                         req.logprob_entries.append(entry)
+                req.started = True
                 req.queue.put_nowait(tok)
                 if self._hit_stop(req, tok):
                     self.engine.release(slot)
@@ -606,14 +830,44 @@ def build_app(
     model_name: str,
     chat_template: Optional[str] = None,
     qos_policy: Optional[qos.QoSPolicy] = None,
+    watchdog_seconds: Optional[float] = None,
+    deadline_default: Optional[float] = None,
 ) -> web.Application:
     if qos_policy is None:
         qos_policy = qos.QoSPolicy.from_env()
+    if watchdog_seconds is None:
+        watchdog_seconds = _env_float("DTPU_ENGINE_WATCHDOG_SECONDS", 0.0)
+    if deadline_default is None:
+        deadline_default = _env_float("DTPU_REQUEST_DEADLINE_DEFAULT", 0.0)
     app = web.Application()
     sched = Scheduler(
-        engine, tokenizer, tenant_inflight=qos_policy.tenant_inflight
+        engine, tokenizer, tenant_inflight=qos_policy.tenant_inflight,
+        watchdog_seconds=watchdog_seconds,
     )
     app["scheduler"] = sched
+
+    def _is_resume(request) -> bool:
+        """Router-asserted mid-stream-failover continuation. The header
+        is trustworthy for the same reason X-DTPU-Tenant is: the
+        proxy/gateway strip client-supplied values and the forwarder
+        injects it only on a resume re-dispatch."""
+        return request.headers.get(qos.RESUME_HEADER) == "1"
+
+    def _request_deadline(request) -> Optional[Deadline]:
+        """Arm the per-request wall-clock budget: the edge header wins,
+        DTPU_REQUEST_DEADLINE_DEFAULT covers headerless requests, and
+        no deadline is armed otherwise. Malformed values are ignored —
+        a bad header must not 400 the data path."""
+        raw = request.headers.get(qos.DEADLINE_HEADER)
+        seconds = None
+        if raw:
+            try:
+                seconds = max(0.0, float(raw))
+            except (TypeError, ValueError):
+                seconds = None
+        if seconds is None and deadline_default > 0:
+            seconds = deadline_default
+        return None if seconds is None else Deadline(seconds)
     buckets = (
         qos.TenantBuckets(
             qos_policy.rps,
@@ -629,6 +883,12 @@ def build_app(
         with a monotone ``Retry-After``, or None when admitted. Runs
         before any tokenization/prefill so an over-budget tenant costs
         nothing but this check."""
+        if _is_resume(request):
+            # a resumed continuation was admitted — and charged — on
+            # its original leg; charging again would double-count
+            # dtpu_qos_admitted, and shedding it would kill a stream
+            # the service already committed to
+            return None
         # trust_header: the tenant header reaching this process is
         # proxy-asserted (the proxy/gateway strip client-supplied
         # values and inject the authenticated identity)
@@ -740,16 +1000,50 @@ def build_app(
 
     import dataclasses as _dc
 
-    async def _run(prompt: str, payload: dict, request):
+    async def _run(prompt: str, payload: dict, request, resume_text=None):
+        gen = _gen_params(payload, tokenizer)
+        prompt_ids = tokenizer.encode(prompt)
+        resumed_ids: list = []
+        if resume_text:
+            # mid-stream failover continuation: a partially-generated
+            # sequence is just a longer prompt — append the delivered
+            # text (the prefix cache turns the re-prefill into a packed
+            # resume), shrink the generation budget by what already
+            # shipped, and replay a seeded stream's PRNG advance so the
+            # continuation samples the ORIGINAL stream's tokens.
+            # n_resumed is derived by RE-tokenizing the splice: exact
+            # whenever the delivered text re-encodes to the tokens the
+            # original stream drew (byte tokenizer on ASCII; canonical
+            # BPE output) — a boundary merge shifts both the context
+            # and the skip count together and the stream may diverge
+            # from the unbroken run (serving.md §9's stated limit)
+            full_ids = tokenizer.encode(prompt + resume_text)
+            n_resumed = max(0, len(full_ids) - len(prompt_ids))
+            resumed_ids = full_ids[len(full_ids) - n_resumed:]
+            gen.max_new_tokens = max(1, gen.max_new_tokens - n_resumed)
+            if gen.seed is not None:
+                gen.seed_skip = n_resumed
+            prompt_ids = full_ids
+            engine.metrics.family("dtpu_serve_resumed_requests_total").inc(1)
+        tenant = qos.tenant_from_headers(request.headers, trust_header=True)
         req = _Request(
-            tokenizer.encode(prompt),
-            _gen_params(payload, tokenizer),
-            tenant=qos.tenant_from_headers(request.headers, trust_header=True),
+            prompt_ids,
+            gen,
+            tenant=tenant,
             priority=qos.parse_priority_class(
                 request.headers.get(qos.PRIORITY_HEADER)
                 or payload.get("priority")
             ),
         )
+        # stop-string continuity across the resume splice: the
+        # delivered tail participates in the bounded match window
+        req.gen_ids = list(resumed_ids)
+        if buckets is not None and qos_policy.enabled and not _is_resume(request):
+            # remember the charged bucket so a pre-first-token abort
+            # (disconnect/deadline/watchdog) can refund it; resumed
+            # continuations were never charged here
+            req.bucket = buckets.bucket(tenant)
+        req.deadline = _request_deadline(request)
         await sched.submit(req)
         return req
 
@@ -795,12 +1089,25 @@ def build_app(
                 list(first_req.prompt_ids), gen,
                 tenant=first_req.tenant, priority=first_req.priority,
             )
+            # each choice charged one bucket token at admission — each
+            # refunds its own on a pre-first-token abort
+            req.bucket = first_req.bucket
+            req.deadline = first_req.deadline
             await sched.submit(req)
             reqs.append(req)
         id_lists = await asyncio.gather(*(_collect(r) for r in reqs))
-        err = next((r.error for r in reqs if r.error), None)
-        if err:
-            return web.json_response({"detail": err}, status=500)
+        failed = next((r for r in reqs if r.error), None)
+        if failed is not None:
+            headers = {}
+            if failed.retry_after is not None and failed.error_status in (
+                429, 503,
+            ):
+                headers["Retry-After"] = str(failed.retry_after)
+            return web.json_response(
+                {"detail": failed.error},
+                status=failed.error_status,
+                headers=headers,
+            )
         total = sum(len(ids) for ids in id_lists)
         return reqs, id_lists, total
 
@@ -817,6 +1124,20 @@ def build_app(
         bad = _bad_sampling_params(payload)
         if bad:
             return web.json_response({"detail": bad}, status=400)
+        resume_text = None
+        if _is_resume(request):
+            r = payload.get("dtpu_resume")
+            if isinstance(r, dict) and isinstance(r.get("text"), str) and r["text"]:
+                if _logprobs_requested(payload) is not None:
+                    # logprob entries cannot align across the splice —
+                    # the router never resumes logprob streams; refuse
+                    # loudly rather than return misaligned arrays
+                    return web.json_response(
+                        {"detail": "a resumed continuation cannot carry "
+                                   "logprobs"},
+                        status=400,
+                    )
+                resume_text = r["text"]
         messages = payload.get("messages")
         if not isinstance(messages, list) or not messages or not all(
             _valid_chat_message(m) for m in messages
@@ -884,7 +1205,7 @@ def build_app(
         shed = _admit_extra(request, n - 1)
         if shed is not None:
             return shed
-        req = await _run(prompt, payload, request)
+        req = await _run(prompt, payload, request, resume_text=resume_text)
         completion_id = f"chatcmpl-{uuid.uuid4().hex}"
         created = int(time.time())
         if payload.get("stream"):

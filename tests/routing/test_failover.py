@@ -190,6 +190,43 @@ class TestDraining:
             await r2.close()
 
 
+class TestAllDraining:
+    async def test_every_replica_draining_503_without_picking(self):
+        """A pool whose every replica is DRAINING is exhausted: clients
+        get 503 + Retry-After immediately, and no request is ever
+        routed to (or counted against) a draining replica."""
+        hits1, hits2 = [], []
+        r1 = TestServer(_replica_app("r1", hits1))
+        r2 = TestServer(_replica_app("r2", hits2))
+        await r1.start_server()
+        await r2.start_server()
+        client, agent = await _gateway([("a", r1), ("b", r2)])
+        picks = get_router_registry().family("dtpu_router_picks_total")
+        draining_picks_before = picks.value("draining")
+        try:
+            pool = agent.pools.pool("p", "svc")
+            # resolve membership once, then drain everything
+            r = await client.get("/services/p/svc/ok")
+            assert r.status == 200
+            hits1.clear(), hits2.clear()
+            assert pool.mark_draining("a") and pool.mark_draining("b")
+            for _ in range(4):
+                r = await client.get("/services/p/svc/ok")
+                assert r.status == 503
+                assert int(r.headers["Retry-After"]) >= 1
+            assert hits1 == [] and hits2 == []  # nothing was routed
+            assert picks.value("draining") == draining_picks_before
+            # drain cancel restores service (scale-down reversed)
+            assert pool.cancel_draining("a")
+            r = await client.get("/services/p/svc/ok")
+            assert r.status == 200
+            assert hits1 == ["/ok"]
+        finally:
+            await client.close()
+            await r1.close()
+            await r2.close()
+
+
 class TestStreamFailureAttribution:
     """Mid-stream failures must be charged to the right side: the
     replica when IT dies, nobody when the CLIENT aborts (clients abort

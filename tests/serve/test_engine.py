@@ -1251,3 +1251,124 @@ class TestLogitBiasMinP:
             prompt,
             GenParams(max_new_tokens=8, temperature=3.0, min_p=0.0, seed=7))
         assert sampled != greedy  # hot sampling without the floor differs
+
+
+class TestResumableGeneration:
+    """Mid-stream failover's core premise (serving.md §9): a partially
+    generated sequence is just a longer prompt. Re-prefilling
+    prompt+delivered on a FRESH engine (= another replica) must
+    continue the original token stream exactly — greedy trivially,
+    seeded sampling via ``GenParams.seed_skip`` replaying the
+    per-token PRNG advance."""
+
+    def setup_method(self):
+        self.config = llama.LLAMA_TINY
+        self.params = llama.init_params(self.config, jax.random.key(0))
+
+    def _engine(self):
+        return InferenceEngine(
+            self.config, self.params, max_batch=2, max_seq=64
+        )
+
+    def test_greedy_resume_continues_identically(self):
+        prompt = [5, 99, 321, 7, 250]
+        full = self._engine().generate(
+            prompt, GenParams(max_new_tokens=10, temperature=0.0)
+        )
+        assert len(full) == 10
+        cut = 4  # tokens the client already received before the death
+        resumed = self._engine().generate(
+            prompt + full[:cut],
+            GenParams(max_new_tokens=10 - cut, temperature=0.0),
+        )
+        assert resumed == full[cut:]
+
+    def test_seeded_resume_replays_prng(self):
+        prompt = [5, 9, 21, 33]
+        full = self._engine().generate(
+            prompt, GenParams(max_new_tokens=10, temperature=1.1, seed=13)
+        )
+        assert len(full) == 10
+        cut = 5
+        g = GenParams(
+            max_new_tokens=10 - cut, temperature=1.1, seed=13, seed_skip=cut
+        )
+        resumed = self._engine().generate(prompt + full[:cut], g)
+        assert resumed == full[cut:]
+
+    def test_seeded_resume_with_repetition_penalty(self):
+        """The multiplicative repetition penalty sees prompt+generated
+        tokens; on resume the delivered tokens re-enter via the prompt
+        mark, so the penalty state — and hence the stream — is exact."""
+        prompt = [5, 9, 21, 33, 7]
+        g0 = GenParams(
+            max_new_tokens=8, temperature=0.9, seed=3,
+            repetition_penalty=1.3,
+        )
+        full = self._engine().generate(prompt, g0)
+        assert len(full) == 8
+        cut = 3
+        g = GenParams(
+            max_new_tokens=8 - cut, temperature=0.9, seed=3,
+            repetition_penalty=1.3, seed_skip=cut,
+        )
+        resumed = self._engine().generate(prompt + full[:cut], g)
+        assert resumed == full[cut:]
+
+    def test_seed_skip_zero_is_identity(self):
+        prompt = [5, 9, 21, 33]
+        a = self._engine().generate(
+            prompt, GenParams(max_new_tokens=6, temperature=1.1, seed=13)
+        )
+        b = self._engine().generate(
+            prompt,
+            GenParams(max_new_tokens=6, temperature=1.1, seed=13, seed_skip=0),
+        )
+        assert a == b
+
+
+class TestAbandonStep:
+    """The engine watchdog's epoch guard: a step abandoned mid-wedge
+    must return empty-handed when it finally wakes, never corrupt the
+    reused slot state."""
+
+    def setup_method(self):
+        config = llama.LLAMA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        self.eng = InferenceEngine(config, params, max_batch=2, max_seq=64)
+
+    def test_abandon_reports_wedge_phase_and_bumps_epoch(self):
+        self.eng._step_wedge = ("slot", 1)
+        epoch = self.eng._step_epoch
+        assert self.eng.abandon_step() == ("slot", 1)
+        assert self.eng._step_epoch == epoch + 1
+        assert self.eng._step_wedge is None
+        assert self.eng.abandon_step() is None  # nothing in flight now
+
+    def test_stale_step_returns_empty_after_abandon(self):
+        """Simulate the watchdog racing a wedged step: bumping the
+        epoch mid-step makes the step discard its result (the fault
+        hook runs between the per-slot fires, exactly where a hang
+        wakes up)."""
+        from dstack_tpu import faults
+
+        slot, tok = self.eng.add_request([5, 9, 21], GenParams(max_new_tokens=4))
+        calls = []
+        real_fire = faults.fire
+
+        def abandoning_fire(point, **ctx):
+            if point == "serve.engine.step" and not calls:
+                calls.append(ctx)
+                self.eng.abandon_step()  # the watchdog gave up on us
+            return real_fire(point, **ctx)
+
+        faults.fire = abandoning_fire
+        try:
+            assert self.eng.step() == {}  # stale epoch: no tokens, no mutation
+        finally:
+            faults.fire = real_fire
+        # slot state untouched by the abandoned step: a normal step
+        # afterwards continues the stream
+        assert self.eng.active[slot]
+        out = self.eng.step()
+        assert slot in out and out[slot]

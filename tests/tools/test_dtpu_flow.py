@@ -751,3 +751,48 @@ def test_sarif_render_validates_structurally():
         },
     }
     schema_validate.validate(log, subset_schema)
+
+
+# ---------------------------------------------------------------------------
+# DTPU010 — serve data-plane scope (PR 10)
+# ---------------------------------------------------------------------------
+
+
+def test_dtpu010_covers_serve_openai_server(tmp_path):
+    """The serve server's async edge is release-checked like the
+    routing/server planes: a bucket charge followed by awaits with no
+    refund on the path is flagged even though serve/ sits outside the
+    shared flow report scope (only DTPU010 widens)."""
+    root = _tree(
+        tmp_path,
+        {
+            "dstack_tpu/serve/openai_server.py": """
+            async def handler(bucket, req):
+                ok = bucket.try_acquire(1.0)
+                await req.queue.get()
+                return ok
+            """,
+        },
+    )
+    found = _run_rule("DTPU010", root)
+    assert len(found) == 1
+    assert "no release on this path" in found[0].message
+    # the other flow rules keep the control-plane scope
+    assert _run_rule("DTPU011", root) == []
+
+
+def test_dtpu010_serve_repo_paths_in_scope():
+    """The live repo's serve edge is actually analyzed+reported: the
+    scope the rule computes includes the file (a regression here would
+    silently un-lint the slot-acquire/deadline-abort/refund paths)."""
+    from tools.dtpu_lint.core import REPO
+    from tools.dtpu_lint.flow import get_flow, report_paths
+    from tools.dtpu_lint.rules.cancel_safety import EXTRA_REPORT_PATHS
+
+    scope = report_paths(Path(REPO)) | EXTRA_REPORT_PATHS
+    assert "dstack_tpu/serve/openai_server.py" in scope
+    flow = get_flow(Path(REPO))
+    assert any(
+        fi.path == "dstack_tpu/serve/openai_server.py"
+        for fi in flow.functions()
+    )

@@ -67,6 +67,10 @@ ANALYZED_GLOBS = (
     "dstack_tpu/faults/**/*.py",
     "dstack_tpu/qos/**/*.py",
     "dstack_tpu/utils/**/*.py",
+    # the serve data plane's async edge: indexed so DTPU010 can check
+    # its slot-acquire/deadline-abort/refund paths (the jax engine
+    # below it is sync and stays out of flow analysis)
+    "dstack_tpu/serve/openai_server.py",
 )
 
 #: paths where findings are REPORTED (the async control plane; testing

@@ -35,6 +35,14 @@ from typing import Iterable, Optional
 from tools.dtpu_lint.core import Finding, ProjectRule, register
 from tools.dtpu_lint.flow import ACQUIRE_RELEASE, get_flow, report_paths
 
+#: DTPU010 reports beyond the shared flow scope: the serve data
+#: plane's async edge, whose slot-acquire / deadline-abort / QoS-refund
+#: paths (PR 10) carry exactly the tracked-resource shapes this rule
+#: exists for. Only this rule widens — DTPU008/009/011 keep the
+#: control-plane scope (the serve process has no DB pools or
+#: cross-shard locks to analyze).
+EXTRA_REPORT_PATHS = frozenset({"dstack_tpu/serve/openai_server.py"})
+
 
 def _receiver(callee: str) -> str:
     return callee.rsplit(".", 1)[0] if "." in callee else ""
@@ -71,7 +79,7 @@ class CancellationSafetyRule(ProjectRule):
 
     def check_project(self, repo) -> Iterable[Finding]:
         flow = get_flow(repo)
-        scope = report_paths(repo)
+        scope = report_paths(repo) | EXTRA_REPORT_PATHS
         for fi in flow.functions():
             if fi.path not in scope or not fi.summary["is_async"]:
                 continue
