@@ -3,8 +3,17 @@ standalone gateway: replica pools with a probed state machine
 (STARTING → READY → DEGRADED → DRAINING → DEAD), least-outstanding
 picking behind per-replica circuit breakers, failover forwarding, and
 graceful draining. Exports ``dtpu_router_*`` metrics through the obs
-package."""
+package. Picks are KV-cache-aware: requests carry a prompt-prefix
+digest chain and land on the replica already holding their prefix KV
+unless that would breach the imbalance cap (routing/affinity.py,
+serving.md §10)."""
 
+from dstack_tpu.routing.affinity import (
+    AffinityConfig,
+    AffinityKey,
+    AffinityMap,
+    request_affinity,
+)
 from dstack_tpu.routing.forward import (
     copy_response_headers,
     filter_request_headers,
@@ -21,6 +30,10 @@ from dstack_tpu.routing.pool import (
 )
 
 __all__ = [
+    "AffinityConfig",
+    "AffinityKey",
+    "AffinityMap",
+    "request_affinity",
     "PoolConfig",
     "PoolRegistry",
     "ReplicaEntry",

@@ -49,6 +49,7 @@ import aiohttp
 from aiohttp import web
 
 from dstack_tpu import faults, qos
+from dstack_tpu.routing.affinity import request_affinity
 from dstack_tpu.routing.metrics import get_router_registry
 from dstack_tpu.routing.pool import ReplicaPool
 from dstack_tpu.utils.logging import get_logger
@@ -99,6 +100,38 @@ def stream_resume_enabled() -> bool:
     )
 
 
+def resume_record_max_chars() -> int:
+    """``DTPU_STREAM_RESUME_MAX_CHARS`` (default 2_000_000): cap on
+    the delivered-text record one resumable stream may accumulate.
+    A stream past the cap stops being resumable (its record is the
+    resume prompt — unbounded growth would be a per-stream memory
+    flood) and ends with an honest terminal error if its replica
+    dies."""
+    try:
+        return int(
+            os.getenv("DTPU_STREAM_RESUME_MAX_CHARS", "").strip()
+            or 2_000_000
+        )
+    except (TypeError, ValueError):
+        return 2_000_000
+
+
+def _json_payload(body: bytes) -> Optional[dict]:
+    """The request body as a JSON object, or None (non-JSON bodies are
+    forwarded verbatim; they just carry no resume/affinity context)."""
+    try:
+        payload = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+# "caller did not parse" sentinel: distinguishes a pre-parsed body that
+# turned out not to be a JSON object (payload=None — do NOT parse again)
+# from a direct call that never parsed at all
+_UNPARSED = object()
+
+
 def _edge_deadline(headers) -> Optional[Deadline]:
     """The request's wall-clock budget from ``X-DTPU-Deadline``
     (seconds, float), or None. Malformed values are ignored — a bad
@@ -144,7 +177,8 @@ class _ResumeState:
 
     __slots__ = (
         "kind", "payload", "prompt", "delivered", "completion_id",
-        "created", "finished", "done_sent", "resumes",
+        "created", "finished", "done_sent", "resumes", "max_chars",
+        "oversized",
     )
 
     def __init__(self, kind: str, payload: dict):
@@ -157,6 +191,11 @@ class _ResumeState:
         self.finished = False  # a finish_reason chunk was relayed
         self.done_sent = False  # the [DONE] sentinel was relayed
         self.resumes = 0
+        # the delivered record IS the resume prompt: bound it so one
+        # pathological stream cannot grow proxy memory without limit —
+        # past the cap the stream simply stops being resumable
+        self.max_chars = resume_record_max_chars()
+        self.oversized = False
 
     def resume_body(self) -> bytes:
         """The re-dispatch payload: the original request with the
@@ -176,7 +215,9 @@ class _ResumeState:
         return json.dumps(p).encode()
 
 
-def _resumable_stream(method: str, path: str, body: bytes) -> Optional[_ResumeState]:
+def _resumable_stream(
+    method: str, path: str, body: bytes, payload=_UNPARSED
+) -> Optional[_ResumeState]:
     """→ a :class:`_ResumeState` when this request is a resumable
     OpenAI completion stream, else None.
 
@@ -195,10 +236,8 @@ def _resumable_stream(method: str, path: str, body: bytes) -> Optional[_ResumeSt
         kind = "completions"
     else:
         return None
-    try:
-        payload = json.loads(body)
-    except (ValueError, UnicodeDecodeError):
-        return None
+    if payload is _UNPARSED:
+        payload = _json_payload(body)
     if not isinstance(payload, dict) or not payload.get("stream"):
         return None
     if payload.get("n") not in (None, 1):
@@ -315,7 +354,11 @@ class _SSERelay:
             if st.created is not None:
                 obj["created"] = st.created
             block = b"data: " + json.dumps(obj).encode() + b"\n\n"
-        st.delivered += delta_text
+        if not st.oversized:
+            st.delivered += delta_text
+            if len(st.delivered) > st.max_chars:
+                st.oversized = True
+                st.delivered = ""  # free the record; it can't be used now
         return block, None
 
 
@@ -440,7 +483,27 @@ async def forward_with_failover(
     if extra_headers:
         req_headers.update(extra_headers)
     deadline = _edge_deadline(request.headers)
-    resume = _resumable_stream(request.method, path, body)
+    # parse the body once, and ONLY when something will consume it:
+    # a completion-path POST with resume or affinity on. Arbitrary
+    # proxied POSTs (uploads, non-completion APIs) must not pay an
+    # O(body) json.loads on the event loop for nothing.
+    wants_payload = (
+        request.method == "POST"
+        and path.rstrip("/").endswith("completions")
+        and (stream_resume_enabled() or pool.affinity.config.enabled)
+    )
+    payload = _json_payload(body) if wants_payload else None
+    resume = _resumable_stream(request.method, path, body, payload)
+    # prompt-prefix affinity: completion payloads digest into a prefix
+    # chain + tenant session key; pick() prefers the replica whose KV
+    # already covers the deepest shared prefix (serving.md §10). A
+    # resume leg re-keys to the SAME digests, so a resumed stream also
+    # prefers whichever peer may hold its prefix.
+    affinity_key = (
+        request_affinity(path, payload, req_headers.get(qos.TENANT_HEADER))
+        if pool.affinity.config.enabled
+        else None
+    )
     query = f"?{request.query_string}" if request.query_string else ""
     tried: set = set()
     limit = max_attempts if max_attempts is not None else max(1, pool.size())
@@ -452,7 +515,7 @@ async def forward_with_failover(
         if deadline is not None and deadline.expired():
             last_error = "request deadline exceeded"
             break
-        entry = pool.pick(exclude=tried)
+        entry = pool.pick(exclude=tried, affinity=affinity_key)
         if entry is None:
             break
         if attempts > 0 and resp is None:
@@ -511,6 +574,7 @@ async def forward_with_failover(
                         )
                         continue
                     pool.report_success(entry)
+                    pool.affinity.record(affinity_key, entry.replica_id)
                     resume.resumes += 1
                     relay.reset()
                     m.family("dtpu_router_stream_resumes_total").inc(1)
@@ -522,6 +586,15 @@ async def forward_with_failover(
                     )
                 else:
                     pool.report_success(entry)
+                    if upstream.status < 300:
+                        # learn the mapping only from ACCEPTED requests:
+                        # this replica's prefix registry will hold the
+                        # prompt's KV once prefill lands, and future
+                        # turns extend exactly this digest chain. A
+                        # 4xx (QoS shed, over-length prompt) never
+                        # prefilled — recording it would steer the
+                        # session back at the replica that just shed it
+                        pool.affinity.record(affinity_key, entry.replica_id)
                     resp = web.StreamResponse(status=upstream.status)
                     copy_response_headers(upstream, resp)
                     if resume is not None and _is_sse(upstream.headers):
@@ -560,6 +633,15 @@ async def forward_with_failover(
         # close out the stream honestly instead of re-dispatching.
         if resume.finished:
             await _write_stream_error_suffix(resp)
+            return resp
+        if resume.oversized:
+            # delivered record outgrew DTPU_STREAM_RESUME_MAX_CHARS
+            # and was dropped: no prompt to splice a continuation from
+            await _write_stream_error(
+                resp,
+                "stream not resumable: delivered text exceeded the "
+                "resume record cap",
+            )
             return resp
         last_error = "replica died mid-stream"
     if resp is not None:

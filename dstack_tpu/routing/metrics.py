@@ -48,6 +48,25 @@ def new_router_registry() -> Registry:
         "tokens and the client stream continues without a 5xx)",
     )
     r.counter(
+        "dtpu_router_affinity_hits_total",
+        "Picks routed to the replica holding the request's deepest "
+        "known prompt-prefix KV (prefix-affinity routing honored)",
+    )
+    r.counter(
+        "dtpu_router_affinity_misses_total",
+        "Affinity lookups that fell back to load-based picking: no "
+        "recorded mapping, or the mapped replica was unroutable "
+        "(dead/draining/excluded) or provably cold (fresh probe with "
+        "an empty prefix registry)",
+    )
+    r.counter(
+        "dtpu_router_affinity_overrides_total",
+        "Affinity targets shed back to load balancing because honoring "
+        "them would exceed the imbalance cap "
+        "(DTPU_ROUTER_AFFINITY_MAX_IMBALANCE) or route past a "
+        "healthier peer — the overload-isolation escape hatch",
+    )
+    r.counter(
         "dtpu_router_breaker_opens_total",
         "Circuit-breaker opens (replica marked DEAD after consecutive "
         "failures)",

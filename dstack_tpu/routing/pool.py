@@ -30,6 +30,15 @@ Design points:
   prefers healthier states, then fewest in-flight proxied requests,
   then the smallest probed queue depth — live load data when the
   prober has it, plain outstanding counts when it does not.
+- **Prefix affinity.** When the forwarder hands ``pick()`` a request's
+  :class:`~dstack_tpu.routing.affinity.AffinityKey`, the replica that
+  most recently served the deepest shared prompt prefix wins — its KV
+  rows make the re-prefill nearly free — unless it is less healthy or
+  carries more than ``DTPU_ROUTER_AFFINITY_MAX_IMBALANCE`` extra
+  outstanding requests vs the least-loaded peer (then the pick falls
+  back to load and ``dtpu_router_affinity_overrides_total`` counts
+  the shed). Mappings die with the replica: DEAD/DRAINING/unsynced
+  replicas are purged from the affinity map immediately.
 
 Everything here runs on one event loop per process (aiohttp handlers,
 probe task, reconcilers); no locking — the metrics registry underneath
@@ -42,6 +51,7 @@ from enum import Enum
 from typing import Dict, Iterable, Optional, Tuple
 
 from dstack_tpu import faults
+from dstack_tpu.routing.affinity import AffinityKey, AffinityMap
 from dstack_tpu.routing.metrics import get_router_registry
 from dstack_tpu.utils.logging import get_logger
 
@@ -110,6 +120,16 @@ class ReplicaEntry:
         except (TypeError, ValueError):
             return 0.0
 
+    def probed_prefix_slots(self) -> Optional[int]:
+        """Occupied prefix-registry slots from the last /health probe,
+        or None when the replica never reported them (non-dtpu
+        service, pre-upgrade replica)."""
+        v = self.probe.get("prefix_slots") if self.probe else None
+        try:
+            return int(v) if v is not None else None
+        except (TypeError, ValueError):
+            return None
+
 
 class ReplicaPool:
     """Health-aware replica set for one service (project, run_name)."""
@@ -120,6 +140,9 @@ class ReplicaPool:
         self.config = config or PoolConfig()
         self.entries: Dict[str, ReplicaEntry] = {}
         self._rr = 0  # rotates equal-score picks (round-robin tie-break)
+        # digest/session → replica learned from dispatch history
+        # (bounded LRU + TTL; see routing/affinity.py)
+        self.affinity = AffinityMap()
 
     # ---- membership ----
 
@@ -137,10 +160,13 @@ class ReplicaPool:
                 self.entries[rid] = ReplicaEntry(rid, host, int(port))
             elif e.address != (host, int(port)):
                 # same id at a new address: it's a different process —
-                # restart the state machine from scratch
+                # restart the state machine from scratch (and drop the
+                # affinity hints: the new process has an empty KV cache)
                 self.entries[rid] = ReplicaEntry(rid, host, int(port))
+                self.affinity.invalidate_replica(rid)
         for rid in [r for r in self.entries if r not in seen]:
             del self.entries[rid]
+            self.affinity.invalidate_replica(rid)
 
     def size(self) -> int:
         return len(self.entries)
@@ -164,10 +190,19 @@ class ReplicaPool:
 
     # ---- picking ----
 
-    def pick(self, exclude: Iterable[str] = ()) -> Optional[ReplicaEntry]:
+    def pick(
+        self,
+        exclude: Iterable[str] = (),
+        affinity: Optional[AffinityKey] = None,
+    ) -> Optional[ReplicaEntry]:
         """Least-outstanding-requests selection over routable replicas,
         or one half-open trial against a breaker-expired DEAD replica
-        when nothing else is left. None = pool exhausted."""
+        when nothing else is left. None = pool exhausted.
+
+        With ``affinity``, the replica recorded against the request's
+        deepest known prompt-prefix digest wins instead — provided it
+        is as healthy as the best candidate and within the imbalance
+        cap of the least-loaded one (docs/guides/serving.md §10)."""
         excluded = set(exclude)
         now = time.monotonic()
         candidates = []
@@ -183,19 +218,26 @@ class ReplicaPool:
                 continue
             candidates.append(e)
         if candidates:
-            score = lambda e: (  # noqa: E731 - used twice below
-                _STATE_RANK[e.state], e.outstanding, e.queue_depth(),
+            best = (
+                self._affinity_choice(affinity, candidates)
+                if affinity is not None and self.affinity.config.enabled
+                else None
             )
-            best_score = min(score(e) for e in candidates)
-            # sequential (non-overlapping) requests tie on everything —
-            # rotate among the tied so the spread survives without live
-            # load data (the old round-robin's one virtue)
-            tied = sorted(
-                (e for e in candidates if score(e) == best_score),
-                key=lambda e: e.replica_id,
-            )
-            best = tied[self._rr % len(tied)]
-            self._rr += 1
+            if best is None:
+                score = lambda e: (  # noqa: E731 - used twice below
+                    _STATE_RANK[e.state], e.outstanding, e.queue_depth(),
+                )
+                best_score = min(score(e) for e in candidates)
+                # sequential (non-overlapping) requests tie on
+                # everything — rotate among the tied so the spread
+                # survives without live load data (the old
+                # round-robin's one virtue)
+                tied = sorted(
+                    (e for e in candidates if score(e) == best_score),
+                    key=lambda e: e.replica_id,
+                )
+                best = tied[self._rr % len(tied)]
+                self._rr += 1
         elif trials:
             best = min(trials, key=lambda e: (e.outstanding, e.replica_id))
             best.half_open = True  # exactly one trial per window
@@ -205,6 +247,57 @@ class ReplicaPool:
             1, best.state.value
         )
         return best
+
+    def _affinity_choice(
+        self, key: AffinityKey, candidates: list
+    ) -> Optional[ReplicaEntry]:
+        """The two-term affinity score: the mapped replica wins the
+        pick (hit) unless the mapping is absent/unroutable/provably
+        cold (miss → load pick) or honoring it would pile more than
+        ``max_imbalance`` extra outstanding requests onto it — or
+        route past a healthier peer — while others idle (override →
+        load pick, counted so an imbalance flood is observable)."""
+        m = get_router_registry()
+        hit = self.affinity.lookup_entry(key)
+        target_rid, recorded_at = hit if hit is not None else (None, 0.0)
+        target = (
+            next(
+                (e for e in candidates if e.replica_id == target_rid), None
+            )
+            if target_rid is not None
+            else None
+        )
+        if target is None:
+            # no mapping, or the mapped replica is excluded (already
+            # tried this request), DRAINING, DEAD, or gone: cache miss
+            m.family("dtpu_router_affinity_misses_total").inc(1)
+            return None
+        now = time.monotonic()
+        fresh = (
+            target.last_probe_at > 0
+            and now - target.last_probe_at <= self.config.probe_stale_after
+            # a probe OLDER than the mapping predates the dispatch that
+            # warmed the registry — it proves nothing about THIS prefix
+            # (post-restart: the t=0 slots=0 probe must not invalidate
+            # a mapping learned at t=1 until the next probe lands)
+            and target.last_probe_at >= recorded_at
+        )
+        if fresh and target.probed_prefix_slots() == 0:
+            # a fresh probe proves the prefix registry empty (engine
+            # restarted/reset): the KV this mapping promised is gone
+            m.family("dtpu_router_affinity_misses_total").inc(1)
+            return None
+        cfg = self.affinity.config
+        rank_min = min(_STATE_RANK[e.state] for e in candidates)
+        out_min = min(e.outstanding for e in candidates)
+        if (
+            _STATE_RANK[target.state] > rank_min
+            or target.outstanding - out_min > cfg.max_imbalance
+        ):
+            m.family("dtpu_router_affinity_overrides_total").inc(1)
+            return None
+        m.family("dtpu_router_affinity_hits_total").inc(1)
+        return target
 
     def acquire(self, entry: ReplicaEntry) -> None:
         entry.outstanding += 1
@@ -270,6 +363,9 @@ class ReplicaPool:
         entry.state = ReplicaState.DEAD
         entry.breaker_backoff = self.config.breaker_base_backoff
         entry.breaker_open_until = time.monotonic() + entry.breaker_backoff
+        # the replica's KV cache dies with it: affinity hints pointing
+        # there would only steer sessions into the breaker
+        self.affinity.invalidate_replica(entry.replica_id)
         get_router_registry().family("dtpu_router_breaker_opens_total").inc(1)
         logger.warning(
             "replica %s of %s/%s marked DEAD after %d consecutive failures",
@@ -292,6 +388,9 @@ class ReplicaPool:
                 if deadline_seconds is not None
                 else self.config.drain_deadline
             )
+            # draining ends in teardown: sessions must re-warm
+            # elsewhere, not chase a replica that stopped taking work
+            self.affinity.invalidate_replica(str(replica_id))
             logger.info(
                 "replica %s of %s/%s draining (%d inflight)",
                 replica_id, self.project, self.run_name, e.outstanding,
@@ -404,7 +503,12 @@ class ReplicaPool:
         entry.probe = {
             k: data.get(k)
             for k in ("queue_depth", "inflight", "kv_utilization",
-                      "active_slots", "max_slots")
+                      "active_slots", "max_slots",
+                      # prefix-cache occupancy (serving.md §10): the
+                      # affinity score treats a fresh prefix_slots=0
+                      # as proof the mapped KV is gone
+                      "prefix_hits", "prefix_slots", "prefix_occupancy",
+                      "prefix_tokens")
         }
         entry.last_probe_at = time.monotonic()
         self.report_success(entry)

@@ -6,6 +6,14 @@ tokens/s decode throughput across concurrent slots, per-request TTFT
 through chunked prefill, and the speculative-decoding step ratio on a
 repetitive workload. Run it on the target TPU to size ``--max-batch``
 and ``--spec-draft`` for a service; CPU runs are smoke tests only.
+
+``--sessions N`` switches to the multi-replica chat-session workload:
+N seeded multi-turn conversations from interleaved tenants are routed
+across ``--replicas`` in-process engines through the REAL
+:class:`~dstack_tpu.routing.pool.ReplicaPool` picker — once with
+prefix-affinity routing, once with the plain least-outstanding
+control — and the JSON reports warm-turn TTFT p50/p95 for both, the
+speedup, prefix-hit counts, and session stickiness (serving.md §10).
 """
 
 import argparse
@@ -304,6 +312,196 @@ def run_bench(
     }
 
 
+def _percentile(samples: list, q: float) -> float:
+    """Nearest-rank percentile over a small sample list (no numpy
+    dependency on the report path)."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(len(s) - 1, max(0, round(q * (len(s) - 1))))]
+
+
+def _session_text(rng, n_chars: int) -> str:
+    """Seeded pseudo-prose: ~5-char lowercase words. Deterministic in
+    the rng, so the affinity-on and control runs replay the exact same
+    conversations."""
+    letters = "abcdefghijklmnopqrstuvwxyz"
+    words = []
+    total = 0
+    while total < n_chars:
+        w = "".join(letters[i] for i in rng.integers(0, 26, 5))
+        words.append(w)
+        total += len(w) + 1
+    return " ".join(words)
+
+
+def run_session_bench(
+    model: str = "llama-tiny",
+    replicas: int = 2,
+    sessions: int = 6,
+    turns: int = 4,
+    tenants: int = 2,
+    gen_len: int = 8,
+    turn_chars: int = 160,
+    batch: int = 8,
+    max_seq: int = 2048,
+    prefill_chunk: int = 64,
+    seed: int = 0,
+) -> dict:
+    """Multi-session chat workload over ≥2 in-process replicas, routed
+    by the real pool picker: prefix-affinity on vs off → result dict.
+
+    Each session is a seeded multi-turn conversation (its own tenant,
+    interleaved with the others turn by turn, assistant replies fed
+    back into the history — the prompt of turn *k+1* extends turn
+    *k*'s). Affinity-on routes each turn through
+    ``pool.pick(affinity=...)`` exactly like the production forwarder;
+    the control uses the same pool with affinity disabled (plain
+    least-outstanding + round-robin ties). Warm turns (2..N) are where
+    the KV either is or is not where the router sends the request —
+    their TTFT p50/p95 is the headline. Both passes run once untimed
+    first so XLA compiles (chunk and prefix-copy variants) never land
+    in the measured numbers."""
+    import jax
+    import numpy as np
+
+    from dstack_tpu.models import llama
+    from dstack_tpu.proxy.model_tgi import DEFAULT_CHAT_TEMPLATE, render_chat
+    from dstack_tpu.routing.affinity import AffinityConfig, request_affinity
+    from dstack_tpu.routing.pool import PoolConfig, ReplicaPool
+    from dstack_tpu.serve.engine import GenParams, InferenceEngine
+    from dstack_tpu.serve.tokenizer import ByteTokenizer
+
+    if replicas < 2:
+        raise ValueError("--replicas must be >= 2: the point is routing")
+    config = llama.CONFIGS[model]
+    params = llama.init_params(config, jax.random.key(0))
+    tok = ByteTokenizer()
+    engines = [
+        InferenceEngine(
+            config, params, max_batch=batch, max_seq=max_seq,
+            prefill_chunk=prefill_chunk,
+        )
+        for _ in range(replicas)
+    ]
+    pool = ReplicaPool("bench", "sessions", PoolConfig(startup_grace=0.0))
+    pool.sync([(f"r{i}", "inproc", i) for i in range(replicas)])
+    by_rid = {f"r{i}": engines[i] for i in range(replicas)}
+
+    def _conversations():
+        """Seeded turn texts, regenerated identically per pass."""
+        rng = np.random.default_rng(seed)
+        return [
+            [_session_text(rng, turn_chars) for _ in range(turns)]
+            for _ in range(sessions)
+        ]
+
+    def run_pass(affinity_on: bool, timed: bool) -> dict:
+        for eng in engines:
+            eng.reset_prefix_cache()
+        pool.affinity.clear()
+        pool.affinity.config = AffinityConfig(enabled=affinity_on)
+        pool._rr = 0
+        convs = _conversations()
+        histories = [[] for _ in range(sessions)]
+        last_rid = [None] * sessions
+        warm_ttft_ms, cold_ttft_ms = [], []
+        sticky = moved = 0
+        hits0 = {rid: e.prefix_hits for rid, e in by_rid.items()}
+        # sessions arrive in a seeded-shuffled order each turn: real
+        # traffic has no fixed arrival order, and a FIXED order would
+        # let the control's round-robin tie-break accidentally pin
+        # session s to replica s%N — a stickiness the load-only picker
+        # does not actually promise
+        order_rng = np.random.default_rng(seed + 1)
+        for t in range(turns):
+            order = list(range(sessions))
+            order_rng.shuffle(order)
+            for s in order:
+                tenant = f"tenant-{s % tenants}"
+                histories[s].append(
+                    {"role": "user", "content": convs[s][t]}
+                )
+                key = request_affinity(
+                    "chat/completions",
+                    {"messages": histories[s]},
+                    tenant,
+                )
+                entry = pool.pick(affinity=key if affinity_on else None)
+                pool.affinity.record(key, entry.replica_id)
+                eng = by_rid[entry.replica_id]
+                prompt_ids = tok.encode(render_chat(
+                    histories[s], DEFAULT_CHAT_TEMPLATE
+                ))
+                t0 = time.perf_counter()
+                slot, first = eng.add_request(
+                    prompt_ids, GenParams(max_new_tokens=gen_len)
+                )
+                ttft_ms = (time.perf_counter() - t0) * 1e3
+                out = [first]
+                while eng.active[slot]:
+                    for toks in eng.step().get(slot, []):
+                        out.append(toks)
+                eng.release(slot)
+                histories[s].append(
+                    {"role": "assistant", "content": tok.decode(out)}
+                )
+                if timed:
+                    (warm_ttft_ms if t > 0 else cold_ttft_ms).append(ttft_ms)
+                    if t > 0:
+                        if entry.replica_id == last_rid[s]:
+                            sticky += 1
+                        else:
+                            moved += 1
+                last_rid[s] = entry.replica_id
+        if not timed:
+            return {}
+        warm_total = max(1, sticky + moved)
+        return {
+            "ttft_warm_ms_p50": round(_percentile(warm_ttft_ms, 0.5), 1),
+            "ttft_warm_ms_p95": round(_percentile(warm_ttft_ms, 0.95), 1),
+            "ttft_cold_ms_p50": round(_percentile(cold_ttft_ms, 0.5), 1),
+            "prefix_hits": sum(
+                e.prefix_hits - hits0[rid] for rid, e in by_rid.items()
+            ),
+            "same_replica_rate": round(sticky / warm_total, 3),
+        }
+
+    results = {}
+    for name, on in (("affinity_on", True), ("affinity_off", False)):
+        run_pass(on, timed=False)  # compile warm-up, identical schedule
+        results[name] = run_pass(on, timed=True)
+    on, off = results["affinity_on"], results["affinity_off"]
+    return {
+        "metric": f"serve_session_ttft_warm_ms[{model},replicas={replicas}]",
+        "value": on["ttft_warm_ms_p50"],
+        "unit": "ms",
+        "extra": {
+            **results,
+            "warm_ttft_speedup_p50": round(
+                off["ttft_warm_ms_p50"] / max(on["ttft_warm_ms_p50"], 1e-9), 2
+            ),
+            "sessions": sessions,
+            "turns": turns,
+            "tenants": tenants,
+            "replicas": replicas,
+            "gen_len": gen_len,
+            "turn_chars": turn_chars,
+            "prefill_chunk": prefill_chunk,
+            "seed": seed,
+            "backend": jax.default_backend(),
+            # per the roadmap's stale-TPU-evidence maintenance note:
+            # say plainly when this ran on the CPU fallback
+            "note": (
+                None
+                if jax.default_backend() == "tpu"
+                else "CPU fallback — relative affinity-on/off comparison "
+                     "only; absolute ms are not TPU evidence"
+            ),
+        },
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="llama-tiny")
@@ -353,6 +551,35 @@ def main(argv=None) -> int:
              "ragged pallas kernel (each slot reads only its own "
              "cache prefix)",
     )
+    p.add_argument(
+        "--sessions", type=int, default=0,
+        help="multi-session chat-workload mode: route this many seeded "
+             "multi-turn conversations across --replicas engines via "
+             "the real pool picker and report warm-turn TTFT with "
+             "prefix-affinity routing on vs off (0 = regular bench)",
+    )
+    p.add_argument(
+        "--replicas", type=int, default=2,
+        help="in-process replicas for --sessions mode (>= 2)",
+    )
+    p.add_argument(
+        "--turns", type=int, default=4,
+        help="turns per conversation in --sessions mode",
+    )
+    p.add_argument(
+        "--tenants", type=int, default=2,
+        help="tenant identities the sessions interleave across "
+             "(the affinity session key is tenant-scoped)",
+    )
+    p.add_argument(
+        "--turn-chars", type=int, default=160,
+        help="approximate user-message length per turn (--sessions)",
+    )
+    p.add_argument(
+        "--output", default=None,
+        help="also write the result JSON to this file (e.g. "
+             "BENCH_r06.json)",
+    )
     p.add_argument("--platform", default=None)
     args = p.parse_args(argv)
 
@@ -360,6 +587,28 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    def emit(result: dict) -> int:
+        line = json.dumps(result)
+        print(line)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(line + "\n")
+        return 0
+
+    if args.sessions:
+        return emit(run_session_bench(
+            model=args.model,
+            replicas=args.replicas,
+            sessions=args.sessions,
+            turns=args.turns,
+            tenants=args.tenants,
+            gen_len=args.gen_len,
+            turn_chars=args.turn_chars,
+            batch=args.batch,
+            max_seq=args.max_seq,
+            prefill_chunk=args.prefill_chunk,
+        ))
 
     result = run_bench(
         model=args.model,
@@ -378,8 +627,7 @@ def main(argv=None) -> int:
         prefill_pack=args.prefill_pack,
         arrival_burst=args.arrival_burst,
     )
-    print(json.dumps(result))
-    return 0
+    return emit(result)
 
 
 if __name__ == "__main__":

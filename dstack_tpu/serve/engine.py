@@ -2756,6 +2756,21 @@ class InferenceEngine:
         ) + sum(st["next"] for st in prefilling)
         return live_tokens / float(self.max_batch * self.max_seq)
 
+    def prefix_stats(self) -> dict:
+        """Prefix-cache registry occupancy for ``/health`` and the
+        router's affinity score (serving.md §10): lifetime hit count,
+        occupied registry slots, occupancy ratio, and total cached
+        prompt tokens still reusable. Snapshot the registry first —
+        this runs on the event loop while the scheduler mutates slots
+        in a worker thread (same contract as kv_cache_utilization)."""
+        cached = list(self._prefix_registry.values())
+        return {
+            "prefix_hits": self.prefix_hits,
+            "prefix_slots": len(cached),
+            "prefix_occupancy": round(len(cached) / float(self.max_batch), 6),
+            "prefix_tokens": sum(len(p) for p in cached),
+        }
+
     def update_state_gauges(self) -> None:
         """Refresh the engine-state gauges (called at scrape time — a
         gauge that only changes when requests move needs no per-step
@@ -2769,6 +2784,9 @@ class InferenceEngine:
         )
         m.family("dtpu_serve_kv_cache_utilization_ratio").set(
             round(self.kv_cache_utilization(), 6)
+        )
+        m.family("dtpu_serve_prefix_slots").set(
+            self.prefix_stats()["prefix_slots"]
         )
 
     def generate(self, prompt: list[int], gen: GenParams) -> list[int]:

@@ -116,6 +116,12 @@ def new_serve_registry() -> Registry:
         "dtpu_serve_prefix_hits_total",
         "Requests that reused a cached chunk-aligned prompt prefix",
     )
+    r.gauge(
+        "dtpu_serve_prefix_slots",
+        "Prefix-registry slots currently holding a reusable prompt "
+        "(also reported on /health as prefix_slots for the router's "
+        "cache-aware affinity score)",
+    )
     r.counter(
         "dtpu_serve_prefix_tokens_reused_total",
         "Prompt tokens skipped via prefix-cache reuse",

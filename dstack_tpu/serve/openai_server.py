@@ -972,6 +972,11 @@ def build_app(
             "kv_utilization": m.family(
                 "dtpu_serve_kv_cache_utilization_ratio"
             ).value(),
+            # prefix-cache occupancy: what the routing layer's probe
+            # loop folds into its replica load snapshot so the
+            # affinity score can tell a warm registry from a cold one
+            # (routing/pool.py, serving.md §10)
+            **e.prefix_stats(),
         })
 
     async def models(request):

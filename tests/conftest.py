@@ -68,6 +68,14 @@ _QUICK_KEEP = (
     "test_parallel.py::TestRingAttention::test_matches_local",
     # serving HTTP surface
     "test_openai_server.py::TestOpenAIServer::test_chat_completions",
+    # prefix-registry lifecycle: the engine-side contract prefix-
+    # affinity routing stands on (slot overwrite / reset / partial
+    # overlap)
+    "test_prefix_registry.py::TestPrefixRegistryLifecycle",
+    # prefix-affinity routing units (tests/routing — never heavy-
+    # marked; listed so a rename fails test_quick_tier loudly)
+    "test_affinity.py::TestAffinityPick",
+    "test_affinity.py::TestAffinityMap",
     # event-driven reconciliation invariants (tests/chaos — never
     # heavy-marked; listed so a rename fails test_quick_tier loudly)
     "test_chaos_wakeups.py::TestWakeupQueueSemantics",

@@ -23,7 +23,10 @@ def test_quick_keep_entries_all_match():
     for name in names:
         hits = [
             str(p.relative_to(REPO))
-            for root in ("tests/compute", "tests/serve", "tests/chaos")
+            for root in (
+                "tests/compute", "tests/serve", "tests/chaos",
+                "tests/routing",
+            )
             for p in (REPO / root).glob(name)
         ]
         assert hits, f"_QUICK_KEEP names a file that no longer exists: {name}"

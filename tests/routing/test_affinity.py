@@ -1,0 +1,485 @@
+"""Prefix-affinity routing units: digest chains, the bounded affinity
+map, the two-term pick score with its imbalance cap, invalidation on
+death/drain/membership churn, and the flood/oversize bounds
+(serving.md §10)."""
+
+import json
+
+from dstack_tpu.routing import (
+    AffinityConfig,
+    AffinityKey,
+    AffinityMap,
+    PoolConfig,
+    ReplicaPool,
+    ReplicaState,
+    get_router_registry,
+    request_affinity,
+)
+from dstack_tpu.routing import affinity as affinity_mod
+from dstack_tpu.routing.forward import _ResumeState, _SSERelay
+
+
+def _chat(*contents, tenant="t1", path="v1/chat/completions"):
+    payload = {
+        "messages": [
+            {"role": "system", "content": "you are helpful"},
+            *({"role": "user", "content": c} for c in contents),
+        ]
+    }
+    return request_affinity(path, payload, tenant)
+
+
+def _counter(name: str) -> float:
+    return get_router_registry().family(name).value()
+
+
+def mk_pool(n=3, affinity_cfg=None, **cfg) -> ReplicaPool:
+    pool = ReplicaPool("proj", "svc", PoolConfig(**cfg))
+    pool.sync([(f"r{i}", "h", 1000 + i) for i in range(n)])
+    for e in pool.entries.values():
+        e.state = ReplicaState.READY
+    if affinity_cfg is not None:
+        pool.affinity.config = affinity_cfg
+    return pool
+
+
+class TestDigestChain:
+    def test_extension_shares_head_digests(self):
+        """Turn k+1 extends turn k, so its chain repeats turn k's
+        digests — the property the whole design stands on."""
+        k1 = _chat("hello")
+        k2 = _chat("hello", "tell me more")
+        assert k2.digests[: len(k1.digests)] == k1.digests
+        assert len(k2.digests) == len(k1.digests) + 1
+
+    def test_divergent_turn_forks_the_chain(self):
+        k1 = _chat("hello", "tell me more")
+        k2 = _chat("hello", "actually, nevermind")
+        assert k1.digests[:2] == k2.digests[:2]
+        assert k1.digests[2] != k2.digests[2]
+
+    def test_whitespace_normalization(self):
+        a = request_affinity(
+            "v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi   there \n"}]},
+        )
+        b = request_affinity(
+            "v1/chat/completions",
+            {"messages": [{"role": "user", "content": "hi there"}]},
+        )
+        assert a.digests == b.digests
+
+    def test_plain_prompt_blocks_share_head(self):
+        doc = "x" * (2 * affinity_mod.PROMPT_BLOCK_CHARS)
+        a = request_affinity("v1/completions", {"prompt": doc + "Q1"})
+        b = request_affinity("v1/completions", {"prompt": doc + "Q2 longer"})
+        assert a.digests[:2] == b.digests[:2]
+        assert a.digests != b.digests
+
+    def test_session_key_is_tenant_scoped(self):
+        assert _chat("hi", tenant="t1").session != _chat(
+            "hi", tenant="t2"
+        ).session
+        # later turns keep the session key (head-derived)
+        assert _chat("hi", tenant="t1").session == _chat(
+            "hi", "more", tenant="t1"
+        ).session
+        assert _chat("hi").session is not None
+        assert _chat("hi", tenant=None).session is None
+
+    def test_chain_is_capped(self):
+        payload = {
+            "messages": [
+                {"role": "user", "content": f"turn {i}"} for i in range(500)
+            ]
+        }
+        key = request_affinity("v1/chat/completions", payload)
+        assert len(key.digests) == affinity_mod.MAX_PREFIX_UNITS
+
+    def test_non_completion_paths_have_no_key(self):
+        assert request_affinity("v1/embeddings", {"input": "x"}) is None
+        assert request_affinity("v1/chat/completions", None) is None
+        assert (
+            request_affinity("v1/chat/completions", {"messages": "bad"})
+            is None
+        )
+
+
+class TestAffinityMap:
+    def test_deepest_prefix_wins(self):
+        m = AffinityMap(config=AffinityConfig())
+        m.record(_chat("a"), "r0")
+        m.record(_chat("x", "y"), "r1")
+        # continuations match their own conversation's record
+        assert m.lookup(_chat("x", "y", "z")) == "r1"
+        assert m.lookup(_chat("a", "more")) == "r0"
+
+    def test_shared_prefix_last_writer_wins(self):
+        """Two conversations share a head; the replica that served the
+        shared prefix most recently owns it — ITS registry provably
+        holds those KV rows (possibly both do, but one is certain)."""
+        m = AffinityMap(config=AffinityConfig())
+        m.record(_chat("a"), "r0")
+        m.record(_chat("a", "b"), "r1")
+        assert m.lookup(_chat("a", "b", "c")) == "r1"
+        # a fork after turn 1 falls back to the shared-head digest,
+        # which r1 refreshed last — a partial-overlap hit there
+        assert m.lookup(_chat("a", "z")) == "r1"
+
+    def test_session_key_fallback(self):
+        m = AffinityMap(config=AffinityConfig())
+        m.record(_chat("a", "b"), "r1")
+        # an edited history breaks every digest, but the tenant+head
+        # session key still lands the request on the same replica
+        edited = _chat("a", "b (edited)")
+        assert edited.digests[-1] not in m._entries
+        assert m.lookup(edited) == "r1"
+
+    def test_ttl_expiry(self, monkeypatch):
+        t = [100.0]
+        monkeypatch.setattr(
+            affinity_mod.time, "monotonic", lambda: t[0]
+        )
+        m = AffinityMap(config=AffinityConfig(ttl_seconds=10.0))
+        m.record(_chat("a"), "r0")
+        assert m.lookup(_chat("a")) == "r0"
+        t[0] += 11.0
+        assert m.lookup(_chat("a")) is None
+        assert len(m) == 0  # expired entries are dropped on lookup
+
+    def test_lru_bound_under_session_flood(self):
+        """Satellite invariant: a 10k-session flood cannot grow the
+        map past its configured cap. Distinct-head conversations so
+        no shared digest keeps old sessions reachable."""
+
+        def _session(i):
+            return request_affinity(
+                "v1/chat/completions",
+                {"messages": [{"role": "user", "content": f"session {i}"}]},
+                f"t{i}",
+            )
+
+        m = AffinityMap(config=AffinityConfig(max_entries=256))
+        for i in range(10_000):
+            m.record(_session(i), "r0")
+        assert len(m) <= 256
+        # newest sessions survived, oldest evicted
+        assert m.lookup(_session(9999)) == "r0"
+        assert m.lookup(_session(0)) is None
+
+    def test_invalidate_replica(self):
+        m = AffinityMap(config=AffinityConfig())
+        a = request_affinity(
+            "v1/completions", {"prompt": "doc A" * 100}, "t1"
+        )
+        b = request_affinity(
+            "v1/completions", {"prompt": "doc B" * 100}, "t1"
+        )
+        m.record(a, "r0")
+        m.record(b, "r1")
+        m.invalidate_replica("r0")
+        assert m.lookup(a) is None
+        assert m.lookup(b) == "r1"
+
+    def test_disabled_records_and_returns_nothing(self):
+        m = AffinityMap(config=AffinityConfig(enabled=False))
+        m.record(_chat("a"), "r0")
+        assert len(m) == 0
+        assert m.lookup(_chat("a")) is None
+
+
+class TestAffinityPick:
+    def test_affinity_target_wins_over_round_robin(self):
+        pool = mk_pool()
+        key = _chat("hello")
+        pool.affinity.record(key, "r2")
+        h0 = _counter("dtpu_router_affinity_hits_total")
+        for _ in range(4):  # RR would rotate; affinity must not
+            assert pool.pick(affinity=key).replica_id == "r2"
+        assert _counter("dtpu_router_affinity_hits_total") == h0 + 4
+
+    def test_no_mapping_counts_miss_and_load_balances(self):
+        pool = mk_pool()
+        m0 = _counter("dtpu_router_affinity_misses_total")
+        picked = {pool.pick(affinity=_chat(f"s{i}")).replica_id
+                  for i in range(3)}
+        assert _counter("dtpu_router_affinity_misses_total") == m0 + 3
+        assert len(picked) == 3  # RR spread preserved on misses
+
+    def test_imbalance_cap_overrides(self):
+        pool = mk_pool(affinity_cfg=AffinityConfig(max_imbalance=2))
+        key = _chat("hot session")
+        pool.affinity.record(key, "r0")
+        pool.get("r0").outstanding = 3  # peers idle: 3 - 0 > cap
+        o0 = _counter("dtpu_router_affinity_overrides_total")
+        e = pool.pick(affinity=key)
+        assert e.replica_id != "r0"
+        assert _counter("dtpu_router_affinity_overrides_total") == o0 + 1
+        # within the cap the hot replica still wins
+        pool.get("r0").outstanding = 2
+        assert pool.pick(affinity=key).replica_id == "r0"
+
+    def test_less_healthy_target_is_overridden(self):
+        pool = mk_pool()
+        key = _chat("x")
+        pool.affinity.record(key, "r0")
+        pool.get("r0").state = ReplicaState.DEGRADED
+        o0 = _counter("dtpu_router_affinity_overrides_total")
+        assert pool.pick(affinity=key).replica_id != "r0"
+        assert _counter("dtpu_router_affinity_overrides_total") == o0 + 1
+
+    def test_dead_target_is_a_miss_and_unlearned(self):
+        pool = mk_pool(fail_threshold=1, startup_grace=0.0)
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        pool.report_failure(pool.get("r1"))  # → DEAD, map purged
+        assert pool.get("r1").state == ReplicaState.DEAD
+        assert pool.affinity.lookup(key) is None
+        m0 = _counter("dtpu_router_affinity_misses_total")
+        assert pool.pick(affinity=key).replica_id != "r1"
+        assert _counter("dtpu_router_affinity_misses_total") == m0 + 1
+
+    def test_draining_target_invalidated(self):
+        pool = mk_pool()
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        pool.mark_draining("r1")
+        assert pool.affinity.lookup(key) is None
+        assert pool.pick(affinity=key).replica_id != "r1"
+
+    def test_sync_removal_invalidates(self):
+        pool = mk_pool()
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        pool.sync([("r0", "h", 1000), ("r2", "h", 1002)])
+        assert pool.affinity.lookup(key) is None
+
+    def test_sync_address_change_invalidates(self):
+        pool = mk_pool(n=2)
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        pool.sync([("r0", "h", 1000), ("r1", "h", 9999)])
+        assert pool.affinity.lookup(key) is None
+
+    def test_fresh_probe_with_empty_registry_is_a_miss(self):
+        import time as _time
+
+        pool = mk_pool()
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        e = pool.get("r1")
+        e.probe = {"prefix_slots": 0}
+        e.last_probe_at = _time.monotonic()
+        m0 = _counter("dtpu_router_affinity_misses_total")
+        assert pool.pick(affinity=key).replica_id != "r1"
+        assert _counter("dtpu_router_affinity_misses_total") == m0 + 1
+        # a warm registry (or no probe data at all) honors affinity
+        e.probe = {"prefix_slots": 2}
+        assert pool.pick(affinity=key).replica_id == "r1"
+
+    def test_probe_older_than_mapping_does_not_invalidate(self):
+        """Post-restart flap guard: a slots=0 probe taken BEFORE the
+        mapping was learned predates the dispatch that warmed the
+        registry — it must not demote a just-recorded mapping (the
+        session would bounce between replicas for a whole probe
+        interval after every engine reset)."""
+        import time as _time
+
+        pool = mk_pool()
+        e = pool.get("r1")
+        e.probe = {"prefix_slots": 0}  # restart-era probe...
+        e.last_probe_at = _time.monotonic()
+        _time.sleep(0.01)
+        key = _chat("x")
+        pool.affinity.record(key, "r1")  # ...mapping learned AFTER it
+        assert pool.pick(affinity=key).replica_id == "r1"
+
+    def test_excluded_target_is_a_miss(self):
+        """A resume/failover leg already tried the hot replica: the
+        re-pick must not hand it back."""
+        pool = mk_pool()
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        assert pool.pick(exclude={"r1"}, affinity=key).replica_id != "r1"
+
+    def test_disabled_config_skips_affinity_entirely(self):
+        pool = mk_pool(affinity_cfg=AffinityConfig(enabled=False))
+        key = AffinityKey(digests=("deadbeef",), session=None)
+        h0 = _counter("dtpu_router_affinity_hits_total")
+        m0 = _counter("dtpu_router_affinity_misses_total")
+        assert pool.pick(affinity=key) is not None
+        assert _counter("dtpu_router_affinity_hits_total") == h0
+        assert _counter("dtpu_router_affinity_misses_total") == m0
+
+
+class TestProbeCarriesPrefixStats:
+    async def test_probe_snapshot_includes_prefix_occupancy(self):
+        """The PR-3 probe loop's replica load snapshot now carries the
+        engine's prefix-registry stats — independently of the picker
+        change, so dashboards and the DEGRADED classifier see them."""
+        import aiohttp
+        from aiohttp import web
+        from aiohttp.test_utils import TestServer
+
+        async def health(request):
+            return web.json_response({
+                "queue_depth": 1, "inflight": 0, "kv_utilization": 0.1,
+                "prefix_hits": 7, "prefix_slots": 3,
+                "prefix_occupancy": 0.75, "prefix_tokens": 512,
+            })
+
+        app = web.Application()
+        app.router.add_get("/health", health)
+        server = TestServer(app)
+        await server.start_server()
+        pool = ReplicaPool("p", "svc", PoolConfig())
+        pool.sync([("a", server.host, server.port)])
+        try:
+            async with aiohttp.ClientSession() as session:
+                assert await pool.probe_replica(session, pool.get("a"))
+            e = pool.get("a")
+            assert e.probe["prefix_hits"] == 7
+            assert e.probe["prefix_slots"] == 3
+            assert e.probe["prefix_occupancy"] == 0.75
+            assert e.probe["prefix_tokens"] == 512
+            assert e.probed_prefix_slots() == 3
+        finally:
+            await server.close()
+
+    def test_probed_prefix_slots_tolerates_absence_and_garbage(self):
+        pool = mk_pool(n=1)
+        e = pool.get("r0")
+        assert e.probed_prefix_slots() is None  # never probed
+        e.probe = {"queue_depth": 2}  # pre-upgrade replica: no field
+        assert e.probed_prefix_slots() is None
+        e.probe = {"prefix_slots": "junk"}
+        assert e.probed_prefix_slots() is None
+        e.probe = {"prefix_slots": 0}
+        assert e.probed_prefix_slots() == 0
+
+
+class TestForwarderRecording:
+    async def test_rejected_requests_learn_no_mapping(self):
+        """A 4xx answer (QoS shed, over-length prompt) never prefilled:
+        the forwarder must NOT record affinity for it — a 2xx must."""
+        import aiohttp
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from dstack_tpu.routing.forward import forward_with_failover
+
+        status_by_path = {"shed": 429, "ok": 200}
+
+        async def replica(request):
+            status = status_by_path[request.path.strip("/").split("/")[0]]
+            if status != 200:
+                return web.json_response(
+                    {"detail": "shed"}, status=status,
+                    headers={"Retry-After": "1"},
+                )
+            return web.json_response({"ok": True})
+
+        upstream_app = web.Application()
+        upstream_app.router.add_route("*", "/{path:.*}", replica)
+        upstream = TestServer(upstream_app)
+        await upstream.start_server()
+        pool = ReplicaPool("p", "svc", PoolConfig(startup_grace=0.0))
+        pool.sync([("r0", upstream.host, upstream.port)])
+
+        router_app = web.Application()
+
+        async def handler(request):
+            return await forward_with_failover(
+                request, pool, request.app["session"],
+                request.match_info["path"],
+            )
+
+        router_app.router.add_route("*", "/{path:.*}", handler)
+
+        async def on_start(app):
+            app["session"] = aiohttp.ClientSession()
+
+        async def on_clean(app):
+            await app["session"].close()
+
+        router_app.on_startup.append(on_start)
+        router_app.on_cleanup.append(on_clean)
+        client = TestClient(TestServer(router_app))
+        await client.start_server()
+        body = {
+            "messages": [{"role": "user", "content": "hello"}],
+            "model": "m",
+        }
+        try:
+            r = await client.post("/shed/v1/chat/completions", json=body)
+            assert r.status == 429
+            assert len(pool.affinity) == 0  # shed taught nothing
+            r = await client.post("/ok/v1/chat/completions", json=body)
+            assert r.status == 200
+            assert len(pool.affinity) > 0  # accepted request recorded
+            key = request_affinity("v1/chat/completions", body, None)
+            assert pool.affinity.lookup(key) == "r0"
+        finally:
+            await client.close()
+            await upstream.close()
+
+
+class TestAffinityConfigEnv:
+    def test_env_round_trip(self, monkeypatch):
+        monkeypatch.setenv("DTPU_ROUTER_AFFINITY", "0")
+        monkeypatch.setenv("DTPU_ROUTER_AFFINITY_MAX_IMBALANCE", "7")
+        monkeypatch.setenv("DTPU_ROUTER_AFFINITY_MAP_SIZE", "99")
+        monkeypatch.setenv("DTPU_ROUTER_AFFINITY_TTL", "33.5")
+        cfg = AffinityConfig.from_env()
+        assert cfg.enabled is False
+        assert cfg.max_imbalance == 7
+        assert cfg.max_entries == 99
+        assert cfg.ttl_seconds == 33.5
+
+    def test_env_defaults_and_garbage(self, monkeypatch):
+        monkeypatch.setenv("DTPU_ROUTER_AFFINITY_MAX_IMBALANCE", "junk")
+        monkeypatch.delenv("DTPU_ROUTER_AFFINITY", raising=False)
+        cfg = AffinityConfig.from_env()
+        assert cfg.enabled is True
+        assert cfg.max_imbalance == 4
+
+
+class TestResumeRecordBound:
+    """Satellite invariant: the forwarder's per-stream delivered-text
+    record has an explicit cap — past it the stream stops being
+    resumable and the record is freed."""
+
+    def _feed(self, relay, text):
+        chunk = (
+            b"data: "
+            + json.dumps(
+                {"id": "c1", "choices": [{"delta": {"content": text}}]}
+            ).encode()
+            + b"\n\n"
+        )
+        relay.feed(chunk)
+
+    def test_delivered_record_capped(self):
+        state = _ResumeState("chat", {"messages": [], "stream": True})
+        state.max_chars = 64
+        relay = _SSERelay(state)
+        for _ in range(6):
+            self._feed(relay, "x" * 16)
+        assert state.oversized
+        assert state.delivered == ""  # record freed at the cap
+
+    def test_under_cap_keeps_recording(self):
+        state = _ResumeState("chat", {"messages": [], "stream": True})
+        state.max_chars = 64
+        relay = _SSERelay(state)
+        self._feed(relay, "x" * 16)
+        assert not state.oversized
+        assert state.delivered == "x" * 16
+
+    def test_cap_env_parse(self, monkeypatch):
+        from dstack_tpu.routing.forward import resume_record_max_chars
+
+        monkeypatch.setenv("DTPU_STREAM_RESUME_MAX_CHARS", "123")
+        assert resume_record_max_chars() == 123
+        monkeypatch.setenv("DTPU_STREAM_RESUME_MAX_CHARS", "garbage")
+        assert resume_record_max_chars() == 2_000_000

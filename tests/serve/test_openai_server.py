@@ -66,6 +66,12 @@ class TestOpenAIServer:
             assert h["inflight"] == 0
             assert h["max_slots"] == 4
             assert h["kv_utilization"] == 0.0
+            # prefix-cache occupancy for the router's affinity score
+            # (serving.md §10): fresh engine → empty registry
+            assert h["prefix_hits"] == 0
+            assert h["prefix_slots"] == 0
+            assert h["prefix_occupancy"] == 0.0
+            assert h["prefix_tokens"] == 0
             r = await client.get("/v1/models")
             data = await r.json()
             assert data["data"][0]["id"] == "llama-tiny"

@@ -1,0 +1,67 @@
+"""PR-11 lint gate: the new prefix-affinity routing code must hold the
+interprocedural concurrency/resource-discipline rules (DTPU008–011) at
+ZERO findings — the affinity map and pick-time scoring run on the
+proxy/gateway event loop, exactly the code the PR-7 deadlock and PR-5
+unmapped-OSError shapes lived in, so regressions here must fail the
+gate rather than accumulate in a baseline."""
+
+from pathlib import Path
+
+from tools.dtpu_lint.core import REPO, run_lint
+
+ROUTING = Path("dstack_tpu") / "routing"
+FLOW_RULES = ("DTPU008", "DTPU009", "DTPU010", "DTPU011")
+
+
+def test_flow_rules_zero_findings_repo_wide():
+    """The four flow rules are zero-baselined repo-wide; the affinity
+    changes (pool scoring, forwarder recording, map eviction) must
+    keep them there."""
+    findings = run_lint(REPO, rule_ids=list(FLOW_RULES))
+    assert findings == [], [
+        f"{f.rule} {f.path}:{f.line} {f.message}" for f in findings
+    ]
+
+
+def test_routing_tree_clean_under_all_rules():
+    """The routing package carries no baseline entries at all: every
+    rule (blocking-call, metric hygiene, settings drift, flow) must
+    report zero findings over it — including affinity.py's env reads
+    (DTPU005 requires them documented in server.md)."""
+    findings = run_lint(REPO, paths=[str(ROUTING)])
+    assert findings == [], [
+        f"{f.rule} {f.path}:{f.line} {f.message}" for f in findings
+    ]
+
+
+def test_affinity_import_stays_jax_free():
+    """The routing package (affinity included) must import without
+    jax: the gateway agent and the docs tooling load it on hosts with
+    no accelerator runtime. (aiohttp is a long-standing routing
+    dependency via forward.py — only jax is the contract here.)"""
+    import ast
+    import subprocess
+    import sys
+
+    # the affinity module itself is stdlib-only (unit tests and the
+    # bench instantiate AffinityMap without the serving runtime)
+    tree = ast.parse((REPO / ROUTING / "affinity.py").read_text())
+    imported = {
+        (n.module or "").split(".")[0] if isinstance(n, ast.ImportFrom)
+        else a.name.split(".")[0]
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.Import, ast.ImportFrom))
+        for a in (n.names if isinstance(n, ast.Import) else [None])
+    }
+    assert not imported & {"jax", "aiohttp", "numpy"}, imported
+
+    code = (
+        "import sys\n"
+        "import dstack_tpu.routing.affinity\n"
+        "assert 'jax' not in sys.modules, 'routing pulled in jax'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
