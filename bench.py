@@ -316,18 +316,25 @@ def main() -> None:
             f" Last TPU evidence: {os.path.basename(evidence[-1])}"
             if evidence else ""
         )
-        note = (
-            "TPU backend unreachable or bench died "
-            f"({'; '.join(attempt_notes)}); waited up to "
-            f"{budget_s:.0f}s with retries. CPU fallback measurement "
-            f"— not a TPU number.{ev_note}"
-        )
         try:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
             result = _bench(quick=quick)
-            result["note"] = note
+            # the SHARED artifact labeler (utils/backend.py) phrases
+            # the unreachable note so every bench/soak artifact says
+            # it the same way
+            from dstack_tpu.utils.backend import backend_info
+
+            info = backend_info(
+                requested="tpu",
+                detail=(
+                    f"bench died or {'; '.join(attempt_notes)}; waited "
+                    f"up to {budget_s:.0f}s with retries"
+                ),
+            )
+            result["backend"] = info["backend"]
+            result["note"] = (info["note"] or "") + ev_note
         except Exception as e:  # always print a line; the driver records it
             result = {
                 "metric": "train_tokens_per_sec_per_chip",

@@ -1,0 +1,514 @@
+"""Full-stack soak: real replicas, real router, real QoS, real chaos.
+
+The runner behind ``python -m dstack_tpu.loadgen``: stands up N (≥ 2)
+REAL in-process replicas — each a live :class:`InferenceEngine` behind
+its own :func:`serve.openai_server.build_app` with QoS admission
+enabled — puts the REAL :func:`routing.forward.forward_with_failover`
+over a :class:`routing.pool.ReplicaPool` in front of them (probe loop
+included, exactly the production data path), fires the compiled
+open-loop schedule through the router, and writes a ``SOAK_rNN.json``
+artifact scoring goodput under SLO.
+
+Mid-soak chaos, on by default:
+
+- **Drain flip**: one replica is marked DRAINING partway in and put
+  back in rotation (``cancel_draining``) at the window's end — the
+  scale-down/upgrade shape; the picker must route around it with zero
+  client-visible errors.
+- **Replica kill**: later, a different replica "dies": a
+  ``serve.stream`` fault rule (installed through the real
+  :mod:`dstack_tpu.faults` plan machinery, merged into any active
+  ``DTPU_FAULT_PLAN``) severs every in-flight and future stream chunk
+  from that replica while its listener socket stops accepting — so
+  in-flight streams take the PR-9 mid-stream resume path onto a
+  survivor and new requests fail over, and the breaker converges the
+  pool to DEAD. The replica's *process* survives (this is an
+  in-process harness) but the router must treat it exactly like a
+  death. The acceptance bar: **zero client 5xx through the kill**.
+
+Both windows land in the report's tail-amplification block.
+
+This module imports jax + aiohttp — keep it out of the package's
+import-light generator path (``__main__`` imports it directly).
+"""
+
+import asyncio
+import json
+import socket
+from dataclasses import dataclass
+from typing import List, Optional
+
+from dstack_tpu.loadgen.report import EventWindow, evaluate
+from dstack_tpu.loadgen.schedule import EventSchedule
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("loadgen.soak")
+
+#: router metric families snapshotted into the artifact (delta over
+#: the soak, so back-to-back runs in one process stay honest)
+_ROUTER_FAMILIES = (
+    "dtpu_router_failovers_total",
+    "dtpu_router_stream_resumes_total",
+    "dtpu_router_breaker_opens_total",
+    "dtpu_router_exhausted_total",
+    "dtpu_router_affinity_hits_total",
+    "dtpu_router_affinity_overrides_total",
+)
+
+
+@dataclass
+class SoakConfig:
+    """Everything about the soak that is NOT the workload (the
+    workload lives in the spec; this is the stack under test)."""
+
+    replicas: int = 2
+    model: str = "llama-tiny"
+    qos_rps: float = 2.0  # per-tenant bucket rate at each serve edge
+    qos_burst: float = 6.0
+    tenant_inflight: int = 0
+    max_batch: int = 8
+    max_seq: int = 2048
+    prefill_chunk: int = 64
+    probe_interval_s: float = 0.5
+    # chaos (soak-relative fractions of the schedule duration)
+    chaos: bool = True
+    drain_start_frac: float = 0.25
+    drain_end_frac: float = 0.40
+    kill_frac: float = 0.60
+    kill_window_s: float = 8.0  # scored amplification window after kill
+    drain_s: float = 30.0  # driver straggler budget past the last event
+    output: Optional[str] = "SOAK_r01.json"
+
+
+class _Replica:
+    __slots__ = ("rid", "engine", "runner", "site", "port", "killed")
+
+    def __init__(self, rid, engine, runner, site, port):
+        self.rid = rid
+        self.engine = engine
+        self.runner = runner
+        self.site = site
+        self.port = port
+        self.killed = False
+
+
+async def _start_replica(rid: str, engine, model: str, policy):
+    from aiohttp import web
+
+    from dstack_tpu.serve.openai_server import build_app
+    from dstack_tpu.serve.tokenizer import ByteTokenizer
+
+    app = build_app(engine, ByteTokenizer(), model, qos_policy=policy)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    site = web.SockSite(runner, sock)
+    await site.start()
+    return _Replica(rid, engine, runner, site, port)
+
+
+def _router_app(pool, session_holder):
+    """The minimal production edge: every request forwarded through
+    ``forward_with_failover``, with the soak's tenant identity
+    re-asserted as the proxy-trusted ``X-DTPU-Tenant`` (the driver
+    sends ``X-Soak-Tenant``; a real edge would derive it from auth —
+    either way the client-supplied QoS header never passes through)."""
+    from aiohttp import web
+
+    from dstack_tpu import qos
+    from dstack_tpu.routing.forward import forward_with_failover
+
+    app = web.Application()
+
+    async def handler(request):
+        tenant = request.headers.get("X-Soak-Tenant") or "anonymous"
+        return await forward_with_failover(
+            request, pool, session_holder["session"],
+            request.match_info["path"],
+            extra_headers={qos.TENANT_HEADER: tenant},
+        )
+
+    app.router.add_route("*", "/{path:.*}", handler)
+    return app
+
+
+async def _probe_loop(pool, interval: float):
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+        while True:
+            targets = pool.probe_targets()
+            if targets:
+                await asyncio.gather(
+                    *(pool.probe_replica(session, e) for e in targets),
+                    return_exceptions=True,
+                )
+            await asyncio.sleep(interval)
+
+
+async def _warmup(replicas: List[_Replica], model: str, bias: dict):
+    """Compile every kernel the soak will hit, per replica, outside
+    the timed schedule. The timed numbers must measure the stack, not
+    XLA: that means covering not just one prompt but the shape
+    *buckets* the schedule exercises — short and long chat prompts
+    (different chunk counts), a completion prompt, a full-size decode
+    budget, and CONCURRENT arrivals (the packed-prefill G=2/G=4
+    variants compile only when a wave actually packs). Warmup text is
+    then dropped from the prefix cache so the soak starts cold."""
+    import aiohttp
+
+    long_text = " ".join(f"warm{i}" for i in range(180))
+    short_text = " ".join(f"warm{i}" for i in range(30))
+
+    def _chat(text):
+        return ("/v1/chat/completions", {
+            "model": model, "max_tokens": 16, "stream": True,
+            "temperature": 0.0, "logit_bias": bias,
+            "messages": [{"role": "user", "content": text}],
+        })
+
+    def _completion(text):
+        return ("/v1/completions", {
+            "model": model, "max_tokens": 16, "stream": True,
+            "temperature": 0.0, "logit_bias": bias, "prompt": text,
+        })
+
+    seq = iter(range(10_000))
+
+    async def _one(session, base, path, payload):
+        # one tenant per warmup request: warmup must never collide
+        # with the replica's own QoS burst (a shed here would abort
+        # the soak, and warmup traffic is not part of the workload)
+        async with session.post(
+            base + path, json=payload,
+            headers={"X-DTPU-Tenant": f"warmup-{next(seq)}"},
+        ) as resp:
+            await resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"warmup {path} answered {resp.status}"
+                )
+
+    async with aiohttp.ClientSession() as session:
+        for r in replicas:
+            base = f"http://127.0.0.1:{r.port}"
+            # serial pass: each shape bucket compiles alone
+            for path, payload in (
+                _chat(short_text), _chat(long_text),
+                _completion(long_text),
+            ):
+                await _one(session, base, path, payload)
+            # concurrent pass: four at once so prefill waves PACK and
+            # the G>1 bucket variants compile now, not mid-soak
+            await asyncio.gather(*(
+                _one(session, base, path, dict(payload))
+                for path, payload in (
+                    _chat(short_text + " a"), _chat(short_text + " b"),
+                    _chat(long_text + " a"), _chat(long_text + " b"),
+                )
+            ))
+            r.engine.reset_prefix_cache()
+
+
+async def _drain_flip(pool, rid: str, start: float, end: float):
+    await asyncio.sleep(start)
+    pool.mark_draining(rid)
+    logger.warning("soak chaos: replica %s DRAINING at t=%.1fs", rid, start)
+    await asyncio.sleep(max(0.0, end - start))
+    pool.cancel_draining(rid)
+    logger.warning("soak chaos: replica %s drain cancelled", rid)
+
+
+async def _kill_replica(replica: _Replica, seed: int, at: float):
+    """The mid-soak death: merge a ``serve.stream`` connect-error rule
+    for this replica into the active fault plan (the deterministic
+    kill of every in-flight stream — the forwarder resumes them
+    elsewhere), stop its listener (new connects fail over), and
+    force-close its established connections (a dead process holds no
+    keep-alive sockets — without this, pooled router and probe
+    connections would keep reaching the 'corpse' and the breaker
+    would never learn it died)."""
+    from dstack_tpu import faults
+
+    await asyncio.sleep(at)
+    rules = []
+    prior = faults.current_plan()
+    if prior is not None:
+        rules.extend(r.raw for r in prior.rules)
+    rules.append({
+        "point": "serve.stream",
+        "ctx": {"replica": replica.rid},
+        "action": "raise",
+        "error": "connect",
+    })
+    faults.install_plan({"seed": seed, "rules": rules})
+    await replica.site.stop()
+    if replica.runner.server is not None:
+        # a SMALL positive timeout, then cancel in-progress handlers
+        # and close their transports (aiohttp treats timeout=0 as "no
+        # timeout" and would wait forever for in-flight streams — the
+        # exact opposite of a death); the outer bound keeps a wedged
+        # handler from stalling the chaos task itself
+        try:
+            await asyncio.wait_for(
+                replica.runner.server.shutdown(timeout=0.05), timeout=2.0
+            )
+        except asyncio.TimeoutError:
+            pass
+    replica.killed = True
+    logger.warning(
+        "soak chaos: replica %s killed at t=%.1fs (listener stopped, "
+        "connections severed, serve.stream fault installed)",
+        replica.rid, at,
+    )
+
+
+def _snapshot(registry, families) -> dict:
+    return {name: registry.family(name).value() for name in families}
+
+
+async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
+    import jax
+
+    from dstack_tpu import faults, qos
+    from dstack_tpu.loadgen.driver import OpenLoopDriver, default_payload
+    from dstack_tpu.loadgen.metrics import new_loadgen_registry
+    from dstack_tpu.models import llama
+    from dstack_tpu.routing.metrics import get_router_registry
+    from dstack_tpu.routing.pool import (
+        PoolConfig,
+        ReplicaPool,
+        ReplicaState,
+    )
+    from dstack_tpu.serve.engine import InferenceEngine
+    from dstack_tpu.utils.backend import backend_info
+
+    spec, seed = schedule.spec, schedule.seed
+    if cfg.replicas < 2:
+        raise ValueError("soak needs >= 2 replicas: the point is routing")
+    if cfg.chaos and cfg.replicas == 2 and cfg.drain_end_frac > cfg.kill_frac:
+        # with two replicas, the drained one must be BACK IN ROTATION
+        # before the other dies — overlapping windows would leave zero
+        # routable replicas and report a harness-config artifact as a
+        # stack failure
+        raise ValueError(
+            "chaos windows overlap with only 2 replicas: drain ends at "
+            f"{cfg.drain_end_frac} but the kill fires at "
+            f"{cfg.kill_frac}; end the drain first or add a third "
+            "replica"
+        )
+    config = llama.CONFIGS[cfg.model]
+    params = llama.init_params(config, jax.random.key(0))
+    # pin the random-init model to ASCII output (ban non-byte ids incl.
+    # eos): resumed streams splice delivered TEXT back into the prompt,
+    # so output must round-trip the byte tokenizer exactly, and banning
+    # eos keeps generations at their full token budget
+    ascii_bias = {str(i): -100 for i in range(128, config.vocab_size)}
+    policy = qos.QoSPolicy(
+        rps=cfg.qos_rps, burst=cfg.qos_burst,
+        tenant_inflight=cfg.tenant_inflight,
+    )
+    prior_plan = faults.current_plan()
+    prior_rules = (
+        {"seed": prior_plan.seed, "rules": [r.raw for r in prior_plan.rules]}
+        if prior_plan is not None
+        else None
+    )
+    replicas: List[_Replica] = []
+    chaos_tasks: List[asyncio.Task] = []
+    probe_task = None
+    router_runner = None
+    session_holder: dict = {"session": None}
+    try:
+        for i in range(cfg.replicas):
+            engine = InferenceEngine(
+                config, params, max_batch=cfg.max_batch,
+                max_seq=cfg.max_seq, prefill_chunk=cfg.prefill_chunk,
+            )
+            replicas.append(
+                await _start_replica(f"r{i}", engine, cfg.model, policy)
+            )
+        pool = ReplicaPool("soak", "loadgen", PoolConfig(startup_grace=0.0))
+        pool.sync([("r%d" % i, "127.0.0.1", r.port)
+                   for i, r in enumerate(replicas)])
+        # serial warmup traffic + optimistic-STARTING would pin every
+        # request to the first success (READY outranks STARTING): start
+        # READY like a probed pool; the probe loop maintains it from here
+        for e in pool.entries.values():
+            e.state = ReplicaState.READY
+        router = await _start_router(pool, session_holder)
+        router_runner = router
+        probe_task = asyncio.ensure_future(
+            _probe_loop(pool, cfg.probe_interval_s)
+        )
+        await _warmup(replicas, cfg.model, ascii_bias)
+
+        windows: List[EventWindow] = []
+        if cfg.chaos:
+            d0 = spec.duration_s * cfg.drain_start_frac
+            d1 = spec.duration_s * cfg.drain_end_frac
+            kill_at = spec.duration_s * cfg.kill_frac
+            # drain one replica we are NOT going to kill, so at least
+            # one replica stays routable at every moment
+            drain_rid, kill_ix = "r1", 0
+            chaos_tasks.append(asyncio.ensure_future(
+                _drain_flip(pool, drain_rid, d0, d1)
+            ))
+            chaos_tasks.append(asyncio.ensure_future(
+                _kill_replica(replicas[kill_ix], seed, kill_at)
+            ))
+            windows = [
+                EventWindow("drain", d0, d1),
+                EventWindow(
+                    "kill", kill_at,
+                    min(spec.duration_s, kill_at + cfg.kill_window_s),
+                ),
+            ]
+
+        router_url = f"http://127.0.0.1:{router.port}"
+        driver = OpenLoopDriver(
+            router_url,
+            payload_for=lambda ev: {
+                **default_payload(ev, cfg.model),
+                "logit_bias": ascii_bias,
+            },
+            headers_for=lambda ev: {"X-Soak-Tenant": ev.tenant},
+            drain_s=cfg.drain_s,
+            # fresh per-soak registry: the artifact embeds its render,
+            # which must count THIS soak only (back-to-back runs in
+            # one process must not leak into each other's artifacts —
+            # the same honesty the router-family deltas get)
+            registry=new_loadgen_registry(),
+        )
+        r0 = _snapshot(get_router_registry(), _ROUTER_FAMILIES)
+        records = await driver.run(schedule.events)
+        router_delta = {
+            k: int(v - r0[k])
+            for k, v in _snapshot(
+                get_router_registry(), _ROUTER_FAMILIES
+            ).items()
+        }
+    finally:
+        for t in chaos_tasks:
+            t.cancel()
+        if probe_task is not None:
+            probe_task.cancel()
+        await asyncio.gather(
+            *chaos_tasks,
+            *( [probe_task] if probe_task is not None else [] ),
+            return_exceptions=True,
+        )
+        if session_holder.get("session") is not None:
+            await session_holder["session"].close()
+        if router_runner is not None:
+            await _stop_runner(router_runner.runner)
+        for r in replicas:
+            if not r.killed:
+                try:
+                    await r.site.stop()
+                except RuntimeError:
+                    pass
+            await _stop_runner(r.runner)
+        # restore whatever fault plan the process came in with
+        if prior_rules is not None:
+            faults.install_plan(prior_rules)
+        elif faults.active():
+            faults.clear()
+
+    analysis = evaluate(
+        records,
+        {c.name: (c.ttft_slo_ms, c.tpot_slo_ms) for c in spec.classes},
+        spec.duration_s,
+        windows=windows,
+    )
+    info = backend_info()
+    result = {
+        "metric": (
+            f"loadgen_goodput_under_slo[{cfg.model},"
+            f"replicas={cfg.replicas}]"
+        ),
+        "value": analysis["overall"]["goodput_ratio"],
+        "unit": "ratio",
+        "seed": seed,
+        "schedule_digest": schedule.digest(),
+        "events": len(schedule.events),
+        "duration_s": spec.duration_s,
+        "replicas": cfg.replicas,
+        "qos": {
+            "rps": cfg.qos_rps,
+            "burst": cfg.qos_burst,
+            "tenant_inflight": cfg.tenant_inflight,
+        },
+        "chaos": (
+            {
+                "drain": [w.start for w in windows if w.name == "drain"]
+                + [w.end for w in windows if w.name == "drain"],
+                "kill_at": next(
+                    (w.start for w in windows if w.name == "kill"), None
+                ),
+            }
+            if cfg.chaos
+            else None
+        ),
+        "backend": info["backend"],
+        "note": info["note"],
+        "router": router_delta,
+        "spec": spec.to_dict(),
+        # the dtpu_loadgen_* families' Prometheus text, embedded so
+        # the artifact carries the driver's own raw accounting next to
+        # the derived analysis (docs/reference/server.md)
+        "loadgen_metrics": driver.metrics.render(),
+        **analysis,
+    }
+    return result
+
+
+class _Router:
+    __slots__ = ("runner", "port")
+
+    def __init__(self, runner, port):
+        self.runner = runner
+        self.port = port
+
+
+async def _start_router(pool, session_holder) -> _Router:
+    import aiohttp
+    from aiohttp import web
+
+    # one shared upstream session, created on the running loop before
+    # any request (a lazy per-handler create would race on the first
+    # concurrent burst and leak the losers)
+    session_holder["session"] = aiohttp.ClientSession()
+    app = _router_app(pool, session_holder)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    site = web.SockSite(runner, sock)
+    await site.start()
+    return _Router(runner, port)
+
+
+async def _stop_runner(runner) -> None:
+    """Bounded cleanup: a wedged handler must not hang the soak's
+    teardown (the report is already computed from driver records)."""
+    try:
+        await asyncio.wait_for(runner.cleanup(), timeout=5.0)
+    except (asyncio.TimeoutError, RuntimeError):
+        pass
+
+
+def run_soak(schedule: EventSchedule, cfg: Optional[SoakConfig] = None) -> dict:
+    """Synchronous entry: run one soak → the artifact dict (written to
+    ``cfg.output`` when set)."""
+    cfg = cfg or SoakConfig()
+    result = asyncio.run(_soak_async(schedule, cfg))
+    if cfg.output:
+        with open(cfg.output, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=False)
+            f.write("\n")
+        logger.warning("soak artifact written to %s", cfg.output)
+    return result

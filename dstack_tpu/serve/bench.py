@@ -14,11 +14,26 @@ across ``--replicas`` in-process engines through the REAL
 prefix-affinity routing, once with the plain least-outstanding
 control — and the JSON reports warm-turn TTFT p50/p95 for both, the
 speedup, prefix-hit counts, and session stickiness (serving.md §10).
+
+Workload generation (burst prompts, repetitive phrases, session
+conversations) comes from :mod:`dstack_tpu.loadgen.textgen` — ONE
+seeded-workload implementation shared with the traffic-replay soak
+harness (serving.md §11), so "the bench's sessions" and "the soak's
+sessions" can never drift apart. Backend labeling comes from
+:func:`dstack_tpu.utils.backend.backend_info` for the same reason.
 """
 
 import argparse
 import json
 import time
+
+from dstack_tpu.loadgen.report import percentile as _percentile
+from dstack_tpu.loadgen.textgen import (
+    conversation_texts,
+    repetitive_prompts,
+    token_prompts,
+)
+from dstack_tpu.utils.backend import TPU_BACKENDS, backend_info
 
 
 def _drive_burst(eng, prompts, gen_len):
@@ -48,9 +63,7 @@ def _concurrent_arrival_bench(eng, rng, vocab, burst, prompt_len, gen_len):
     dispatch reduction and the TTFT-under-load it buys."""
     ttft_hist = eng.metrics.family("dtpu_serve_ttft_seconds")
     disp = eng.metrics.family("dtpu_serve_prefill_dispatches_total")
-    prompts = [
-        rng.integers(1, vocab, prompt_len).tolist() for _ in range(burst)
-    ]
+    prompts = token_prompts(rng, vocab, burst, prompt_len)
     pack = eng.prefill_pack
 
     def measure():
@@ -146,16 +159,11 @@ def run_bench(
     )
     rng = np.random.default_rng(0)
     if repetitive:
-        phrase = rng.integers(1, config.vocab_size, 16).tolist()
-        reps = prompt_len // 16 + 1
-        prompts = [
-            (phrase * reps)[:prompt_len] for _ in range(batch)
-        ]
+        prompts = repetitive_prompts(
+            rng, config.vocab_size, batch, prompt_len
+        )
     else:
-        prompts = [
-            rng.integers(1, config.vocab_size, prompt_len).tolist()
-            for _ in range(batch)
-        ]
+        prompts = token_prompts(rng, config.vocab_size, batch, prompt_len)
 
     # warmup compiles every kernel the timed sections will hit: the
     # full-length prompt's prefill chunks, the decode path at the SAME
@@ -279,6 +287,7 @@ def run_bench(
             eng, rng, config.vocab_size, arrival_burst, prompt_len, gen_len
         )
 
+    backend = backend_info()
     return {
         "metric": f"serve_decode_tokens_per_sec[{model},batch={batch}]",
         # engine-step time, not the bench loop's wall clock: the same
@@ -307,32 +316,12 @@ def run_bench(
             "quantize": quantize,
             "kv_quant": kv_quant,
             "decode_kernel": decode_kernel or "einsum",
-            "backend": jax.default_backend(),
+            # one shared helper labels every bench/soak artifact, and
+            # says so plainly when TPU was requested but unreachable
+            "backend": backend["backend"],
+            "note": backend["note"],
         },
     }
-
-
-def _percentile(samples: list, q: float) -> float:
-    """Nearest-rank percentile over a small sample list (no numpy
-    dependency on the report path)."""
-    if not samples:
-        return 0.0
-    s = sorted(samples)
-    return s[min(len(s) - 1, max(0, round(q * (len(s) - 1))))]
-
-
-def _session_text(rng, n_chars: int) -> str:
-    """Seeded pseudo-prose: ~5-char lowercase words. Deterministic in
-    the rng, so the affinity-on and control runs replay the exact same
-    conversations."""
-    letters = "abcdefghijklmnopqrstuvwxyz"
-    words = []
-    total = 0
-    while total < n_chars:
-        w = "".join(letters[i] for i in rng.integers(0, 26, 5))
-        words.append(w)
-        total += len(w) + 1
-    return " ".join(words)
 
 
 def run_session_bench(
@@ -389,12 +378,12 @@ def run_session_bench(
     by_rid = {f"r{i}": engines[i] for i in range(replicas)}
 
     def _conversations():
-        """Seeded turn texts, regenerated identically per pass."""
-        rng = np.random.default_rng(seed)
-        return [
-            [_session_text(rng, turn_chars) for _ in range(turns)]
-            for _ in range(sessions)
-        ]
+        """Seeded turn texts, regenerated identically per pass — the
+        loadgen generator, so bench sessions and soak sessions are the
+        same workload."""
+        return conversation_texts(
+            np.random.default_rng(seed), sessions, turns, turn_chars
+        )
 
     def run_pass(affinity_on: bool, timed: bool) -> dict:
         for eng in engines:
@@ -472,6 +461,7 @@ def run_session_bench(
         run_pass(on, timed=False)  # compile warm-up, identical schedule
         results[name] = run_pass(on, timed=True)
     on, off = results["affinity_on"], results["affinity_off"]
+    backend = backend_info()
     return {
         "metric": f"serve_session_ttft_warm_ms[{model},replicas={replicas}]",
         "value": on["ttft_warm_ms_p50"],
@@ -489,14 +479,16 @@ def run_session_bench(
             "turn_chars": turn_chars,
             "prefill_chunk": prefill_chunk,
             "seed": seed,
-            "backend": jax.default_backend(),
             # per the roadmap's stale-TPU-evidence maintenance note:
-            # say plainly when this ran on the CPU fallback
-            "note": (
+            # the SHARED helper labels the backend and says plainly
+            # when TPU was requested but this ran on a fallback
+            "backend": backend["backend"],
+            "note": backend["note"] or (
                 None
-                if jax.default_backend() == "tpu"
-                else "CPU fallback — relative affinity-on/off comparison "
-                     "only; absolute ms are not TPU evidence"
+                if backend["backend"] in TPU_BACKENDS
+                else "relative affinity-on/off comparison on "
+                     f"{backend['backend']}; absolute ms are not TPU "
+                     "evidence"
             ),
         },
     }

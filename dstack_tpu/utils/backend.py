@@ -1,0 +1,62 @@
+"""One shared backend label for every bench/soak artifact.
+
+Benches fell out of sync on how they report *where a number came
+from*: ``serve/bench.py`` labeled ``jax.default_backend()`` with its
+own CPU-fallback note, the root ``bench.py`` composed a different
+"TPU backend unreachable" sentence, and newer artifacts risked
+omitting the label entirely. The ROADMAP's maintenance entry tracks
+TPU evidence by these artifact notes, so the phrasing is worth
+keeping stable — it lives here, once.
+
+:func:`backend_info` returns ``{"backend": <actual>, "note":
+<str|None>}``: the note is set exactly when a TPU-class backend was
+requested (explicitly, or via ``JAX_PLATFORMS``) but the process is
+actually running on a fallback — stated plainly so a CPU smoke number
+can never masquerade as TPU evidence.
+"""
+
+import os
+from typing import Optional
+
+#: jax backend names that count as real TPU evidence
+TPU_BACKENDS = ("tpu", "axon")
+
+#: the stable core phrase of every unreachable note (historical
+#: BENCH_r* artifacts carry it; keep rewordings out of it)
+UNREACHABLE_PHRASE = "TPU backend unreachable"
+
+
+def requested_platform(explicit: Optional[str] = None) -> Optional[str]:
+    """The platform the run *asked for*: an explicit request wins,
+    else the first entry of ``JAX_PLATFORMS``, else None (no stated
+    preference — whatever jax picked is by definition correct)."""
+    if explicit:
+        return explicit.split(",")[0].strip().lower()
+    env = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip().lower()
+    return env or None
+
+
+def backend_info(
+    requested: Optional[str] = None, detail: Optional[str] = None
+) -> dict:
+    """→ ``{"backend": actual, "note": str|None}`` for an artifact.
+
+    ``requested`` overrides the env-derived request; ``detail`` (e.g.
+    probe/retry history) is folded into the note when one is emitted.
+    Imports jax lazily — callers already have a jax runtime by the
+    time they emit an artifact."""
+    import jax
+
+    actual = jax.default_backend()
+    want = requested_platform(requested)
+    note = None
+    if (
+        want in TPU_BACKENDS
+        and actual not in TPU_BACKENDS
+    ):
+        note = (
+            f"{UNREACHABLE_PHRASE}"
+            + (f" ({detail})" if detail else "")
+            + f"; {actual} fallback measurement — not a TPU number."
+        )
+    return {"backend": actual, "note": note}

@@ -1,0 +1,90 @@
+"""Tentpole acceptance (PR 12): the traffic-replay soak drives the
+FULL stack — open-loop schedule → router (``forward_with_failover``)
+→ QoS-enabled replicas — through a mid-soak drain flip AND an
+injected replica death, and the SOAK report proves:
+
+1. **Zero client 5xx.** The kill severs every in-flight stream from
+   the dead replica and stops its listener; resumable streams take the
+   PR-9 resume path and new requests fail over. No client ever sees a
+   5xx or a truncated stream.
+2. **Goodput dips bounded, then recovers.** The kill window's goodput
+   stays above a floor and the post-window tail recovers to baseline.
+3. **Honest sheds only.** Any 429 carries a Retry-After and hints
+   never grow within a tenant's flood run.
+4. **Determinism end to end.** The artifact's schedule digest equals
+   an independent compilation's — the soak really replayed the seeded
+   workload.
+
+Seconds-scale by construction (a ~10s schedule; warmup kernels come
+from the shared test compile cache), so it can sit in tier-1.
+"""
+
+from dstack_tpu.loadgen import compile_schedule, default_spec
+from dstack_tpu.loadgen.soak import SoakConfig, run_soak
+
+SEED = 7
+DURATION = 10.0
+RATE = 5.0
+
+
+def _spec():
+    return default_spec(duration_s=DURATION, rate_rps=RATE)
+
+
+class TestSoakChaosAcceptance:
+    def test_kill_and_drain_under_open_loop_load(self):
+        schedule = compile_schedule(_spec(), SEED)
+        assert len(schedule.events) >= 10, "workload too thin to prove anything"
+        cfg = SoakConfig(
+            replicas=2,
+            chaos=True,
+            drain_start_frac=0.20,
+            drain_end_frac=0.35,
+            kill_frac=0.55,
+            kill_window_s=2.5,  # leaves a tail to prove recovery
+            output=None,  # report dict only; no artifact file
+        )
+        report = run_soak(schedule, cfg)
+
+        # (4) the soak replayed the seeded workload, all of it
+        assert report["schedule_digest"] == schedule.digest()
+        assert report["overall"]["requests"] == len(schedule.events)
+
+        # (1) zero client 5xx, zero failures of any kind: no truncated
+        # streams, no terminal error events, no abandoned requests
+        assert report["client_5xx"] == 0, report["overall"]["outcomes"]
+        assert report["failures"] == 0, report["overall"]["outcomes"]
+
+        # the chaos actually bit: the breaker opened on the killed
+        # replica and at least one stream resumed or request failed
+        # over onto the survivor
+        router = report["router"]
+        assert router["dtpu_router_breaker_opens_total"] >= 1, router
+        assert (
+            router["dtpu_router_stream_resumes_total"]
+            + router["dtpu_router_failovers_total"]
+        ) >= 1, router
+
+        # (2) bounded dip + recovery: the kill window still served,
+        # and the tail after it returned to (near-)baseline goodput
+        kill = report["windows"]["kill"]
+        assert kill["requests"] >= 1
+        assert kill["goodput_ratio"] is not None
+        assert kill["goodput_ratio"] >= 0.25, kill
+        recovery = report["windows"]["_recovery"]
+        assert recovery["recovered"] is True, recovery
+
+        # (3) honest sheds only (whether the QoS edge shed or not)
+        sheds = report["overall"]["sheds"]
+        assert sheds["honest"] is True, sheds
+
+        # report shape the docs promise: per-class goodput + SLO
+        # percentiles + shed/failure accounting
+        for name, cls in report["classes"].items():
+            assert cls["goodput_ratio"] is not None, name
+            assert "ttft_ms_p50" in cls and "tpot_ms_p50" in cls
+            assert "ttft_slo_ms" in cls and "sheds" in cls
+        assert report["open_loop"]["sched_lag_ms_p95"] is not None
+        # open-loop fidelity: the driver kept (roughly) to schedule
+        # even while the stack was being killed under it
+        assert report["open_loop"]["sched_lag_ms_p95"] < 2000.0

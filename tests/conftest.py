@@ -81,6 +81,13 @@ _QUICK_KEEP = (
     "test_chaos_wakeups.py::TestWakeupQueueSemantics",
     "test_chaos_wakeups.py::TestDuplicateDeliveryIdempotency",
     "test_chaos_wakeups.py::TestWorkerCrashMidBatch",
+    # traffic-replay soak harness: schedule determinism + driver
+    # outcome classification (tests/loadgen) and the seconds-scale
+    # full-stack chaos soak (tests/chaos) — listed so a rename fails
+    # test_quick_tier loudly
+    "test_loadgen_schedule.py::TestScheduleDeterminism",
+    "test_loadgen_driver.py::TestDriverOutcomes",
+    "test_chaos_loadgen.py::TestSoakChaosAcceptance",
 )
 
 

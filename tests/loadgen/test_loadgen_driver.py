@@ -1,0 +1,188 @@
+"""Open-loop driver against a scripted fake OpenAI edge (no jax, no
+engines — seconds-scale): outcome classification, open-loop timing,
+SSE parsing across chunk boundaries, and shed/Retry-After capture.
+"""
+
+import asyncio
+import json
+
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from dstack_tpu.loadgen.driver import OpenLoopDriver, _SSETally, default_payload
+from dstack_tpu.loadgen.schedule import Event
+
+
+def _event(rid, t, kind="chat", tenant="t0", stream=True, max_tokens=4):
+    return Event(
+        t=t, rid=rid, cls="fast", kind=kind, tenant=tenant,
+        priority="standard", session=None, turn=0,
+        messages=(
+            ({"role": "user", "content": f"hello {rid}"},)
+            if kind == "chat" else None
+        ),
+        prompt=None if kind == "chat" else f"prompt {rid}",
+        max_tokens=max_tokens, stream=stream, temperature=0.0,
+        seed=None, ttft_slo_ms=1000.0, tpot_slo_ms=500.0,
+    )
+
+
+def _sse_chunk(text, finish=None):
+    obj = {
+        "id": "cmpl-1", "object": "chat.completion.chunk",
+        "choices": [{
+            "index": 0,
+            "delta": {"content": text} if text else {},
+            "finish_reason": finish,
+        }],
+    }
+    return b"data: " + json.dumps(obj).encode() + b"\n\n"
+
+
+class _ScriptedEdge:
+    """Behavior keyed by tenant: ok / shed / 5xx / truncate / error."""
+
+    def __init__(self):
+        self.hints = {"shed": [3.0, 2.0, 1.0, 0.5]}
+        self.seen = []
+
+    def app(self):
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/completions", self._chat)
+        return app
+
+    async def _chat(self, request):
+        body = await request.json()
+        tenant = request.headers.get("X-Soak-Tenant", "")
+        self.seen.append((tenant, body))
+        mode = tenant.split("-")[0]
+        if mode == "shed":
+            hint = self.hints["shed"].pop(0)
+            return web.json_response(
+                {"detail": "budget exhausted"},
+                status=429, headers={"Retry-After": str(hint)},
+            )
+        if mode == "flap":
+            return web.json_response({"detail": "boom"}, status=500)
+        resp = web.StreamResponse(
+            status=200,
+            headers={"Content-Type": "text/event-stream"},
+        )
+        await resp.prepare(request)
+        await resp.write(_sse_chunk("he"))
+        await asyncio.sleep(0.02)
+        await resp.write(_sse_chunk("llo"))
+        if mode == "trunc":
+            await resp.write_eof()  # died without [DONE]
+            return resp
+        if mode == "errevent":
+            await resp.write(
+                b'data: {"error": {"message": "engine wedged"}}\n\n'
+            )
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        await resp.write(_sse_chunk("", finish="stop"))
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+
+async def _drive(events, drain_s=5.0):
+    edge = _ScriptedEdge()
+    server = TestServer(edge.app())
+    await server.start_server()
+    try:
+        driver = OpenLoopDriver(
+            f"http://{server.host}:{server.port}",
+            payload_for=lambda ev: default_payload(ev, "llama-tiny"),
+            headers_for=lambda ev: {"X-Soak-Tenant": ev.tenant},
+            drain_s=drain_s,
+        )
+        records = await driver.run(events)
+    finally:
+        await server.close()
+    return edge, records
+
+
+class TestDriverOutcomes:
+    async def test_classification_matrix(self):
+        events = [
+            _event("e00", 0.00, tenant="ok-a"),
+            _event("e01", 0.02, tenant="shed-a"),
+            _event("e02", 0.04, tenant="flap-a"),
+            _event("e03", 0.06, tenant="trunc-a"),
+            _event("e04", 0.08, tenant="errevent-a"),
+        ]
+        _, records = await _drive(events)
+        by = {r.rid: r for r in records}
+        assert by["e00"].outcome == "ok"
+        assert by["e00"].ttft_s is not None and by["e00"].tokens == 2
+        assert by["e00"].tpot_s is not None
+        assert by["e01"].outcome == "shed"
+        assert by["e01"].retry_after == 3.0
+        assert by["e02"].outcome == "failed_5xx"
+        assert by["e03"].outcome == "failed_truncated"
+        assert by["e04"].outcome == "failed_stream_error"
+        assert "engine wedged" in by["e04"].detail
+
+    async def test_shed_run_hints_recorded_for_honesty_check(self):
+        from dstack_tpu.loadgen.report import evaluate
+
+        events = [
+            _event(f"e{i:02d}", 0.01 * i, tenant="shed-a")
+            for i in range(4)
+        ]
+        _, records = await _drive(events)
+        sheds = evaluate(
+            records, {"fast": (1000.0, 500.0)}, 1.0
+        )["overall"]["sheds"]
+        assert sheds["sheds"] == 4
+        assert sheds["honest"] is True  # the fake's hints shrink
+
+    async def test_open_loop_fires_at_schedule_time(self):
+        """Events fire at their compiled offsets (no completion
+        coupling): with a 60ms spread the send times must track the
+        schedule, not serialize behind one another."""
+        events = [_event(f"e{i:02d}", 0.03 * i, tenant="ok-a")
+                  for i in range(3)]
+        _, records = await _drive(events)
+        for r in records:
+            assert r.t_sent >= r.t_sched - 1e-4
+            assert r.lag_s < 0.5, (r.rid, r.lag_s)
+
+    async def test_completion_kind_posts_prompt(self):
+        events = [_event("e00", 0.0, kind="completion", tenant="ok-a")]
+        edge, records = await _drive(events)
+        assert records[0].outcome == "ok"
+        _, body = edge.seen[0]
+        assert body["prompt"] == "prompt e00"
+        assert "messages" not in body
+
+
+class TestSSETally:
+    def test_events_split_across_chunks(self):
+        t = _SSETally()
+        block = _sse_chunk("abc")
+        assert t.feed(block[:7]) == 0  # partial event buffered
+        assert t.feed(block[7:]) == 1
+        assert t.deltas == 1
+
+    def test_done_and_finish_markers(self):
+        t = _SSETally()
+        t.feed(_sse_chunk("x", finish=None))
+        t.feed(_sse_chunk("", finish="stop"))
+        assert t.finished and not t.done
+        t.feed(b"data: [DONE]\n\n")
+        assert t.done
+
+    def test_error_event_detected(self):
+        t = _SSETally()
+        t.feed(b'data: {"error": "boom"}\n\n')
+        assert t.error == "boom"
+
+    def test_non_json_and_comment_frames_ignored(self):
+        t = _SSETally()
+        assert t.feed(b": keepalive\n\ndata: not-json\n\n") == 0
+        assert t.error is None and t.deltas == 0

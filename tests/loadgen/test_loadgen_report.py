@@ -1,0 +1,164 @@
+"""SLO evaluator units: goodput arithmetic, honest-shed accounting,
+and tail-amplification windows over synthetic record lists (no HTTP,
+no jax — the report path is import-light by contract)."""
+
+from dstack_tpu.loadgen.report import (
+    EventWindow,
+    RequestRecord,
+    evaluate,
+)
+
+SLOS = {"fast": (100.0, 50.0), "slow": (1000.0, 500.0)}
+
+
+def _rec(
+    rid, cls="fast", outcome="ok", t=1.0, ttft=0.05, tpot=0.01,
+    tenant="t0", retry_after=None, sent=None,
+):
+    return RequestRecord(
+        rid=rid, cls=cls, tenant=tenant, t_sched=t,
+        t_sent=sent if sent is not None else t, outcome=outcome,
+        ttft_s=ttft, tpot_s=tpot, retry_after=retry_after,
+    )
+
+
+class TestGoodputReport:
+    def test_goodput_counts_only_slo_met_completions(self):
+        records = [
+            _rec("e0"),  # ok, meets both targets
+            _rec("e1", ttft=0.2),  # completed but blew TTFT
+            _rec("e2", tpot=0.09),  # completed but blew TPOT
+            _rec("e3", outcome="shed", ttft=None, tpot=None,
+                 retry_after=1.0),  # shed: denominator only
+        ]
+        r = evaluate(records, SLOS, duration_s=10.0)
+        fast = r["classes"]["fast"]
+        assert fast["requests"] == 4
+        assert fast["completed"] == 3
+        assert fast["slo_met"] == 1
+        assert fast["goodput_ratio"] == 0.25
+        assert fast["goodput_rps"] == 0.1
+        assert r["failures"] == 0  # a shed is never a failure
+
+    def test_classes_scored_against_their_own_slos(self):
+        records = [
+            _rec("e0", cls="fast", ttft=0.5),  # fails fast's 100ms
+            _rec("e1", cls="slow", ttft=0.5),  # meets slow's 1000ms
+        ]
+        r = evaluate(records, SLOS, duration_s=10.0)
+        assert r["classes"]["fast"]["slo_met"] == 0
+        assert r["classes"]["slow"]["slo_met"] == 1
+
+    def test_missing_tpot_means_tpot_slo_vacuous(self):
+        # single-token / non-streaming completions have no TPOT sample
+        r = evaluate(
+            [_rec("e0", tpot=None)], SLOS, duration_s=1.0
+        )
+        assert r["classes"]["fast"]["slo_met"] == 1
+
+    def test_failures_counted_by_kind(self):
+        records = [
+            _rec("e0", outcome="failed_5xx", ttft=None, tpot=None),
+            _rec("e1", outcome="failed_truncated", ttft=None, tpot=None),
+            _rec("e2", outcome="failed_stream_error", ttft=None,
+                 tpot=None),
+            _rec("e3", outcome="abandoned", ttft=None, tpot=None),
+            _rec("e4"),
+        ]
+        r = evaluate(records, SLOS, duration_s=10.0)
+        assert r["failures"] == 4
+        assert r["client_5xx"] == 1
+        assert r["overall"]["outcomes"]["failed_truncated"] == 1
+
+
+class TestHonestSheds:
+    def test_monotone_hints_are_honest(self):
+        records = [
+            _rec(f"e{i}", outcome="shed", ttft=None, tpot=None,
+                 sent=float(i), retry_after=hint)
+            for i, hint in enumerate((3.0, 2.2, 1.4, 0.9))
+        ]
+        sheds = evaluate(records, SLOS, 10.0)["overall"]["sheds"]
+        assert sheds["sheds"] == 4
+        assert sheds["honest"] is True
+
+    def test_growing_hint_within_a_run_is_dishonest(self):
+        records = [
+            _rec("e0", outcome="shed", ttft=None, sent=0.0,
+                 retry_after=1.0),
+            _rec("e1", outcome="shed", ttft=None, sent=0.1,
+                 retry_after=2.5),  # grew: the contract violation
+        ]
+        sheds = evaluate(records, SLOS, 10.0)["overall"]["sheds"]
+        assert sheds["honest"] is False
+        assert sheds["hint_grew"] == ["e1"]
+
+    def test_missing_retry_after_is_dishonest(self):
+        records = [
+            _rec("e0", outcome="shed", ttft=None, sent=0.0),
+        ]
+        sheds = evaluate(records, SLOS, 10.0)["overall"]["sheds"]
+        assert sheds["honest"] is False
+        assert sheds["missing_retry_after"] == ["e0"]
+
+    def test_admit_between_sheds_resets_the_run(self):
+        # the monotone contract holds within a flood; once an admit
+        # lands the bucket refilled and a LARGER later hint is fine
+        records = [
+            _rec("e0", outcome="shed", ttft=None, sent=0.0,
+                 retry_after=1.0),
+            _rec("e1", sent=5.0),  # admitted
+            _rec("e2", outcome="shed", ttft=None, sent=9.0,
+                 retry_after=3.0),  # larger, but a NEW run
+        ]
+        sheds = evaluate(records, SLOS, 10.0)["overall"]["sheds"]
+        assert sheds["honest"] is True
+
+    def test_tenants_have_independent_runs(self):
+        records = [
+            _rec("e0", outcome="shed", ttft=None, sent=0.0,
+                 tenant="a", retry_after=1.0),
+            _rec("e1", outcome="shed", ttft=None, sent=0.1,
+                 tenant="b", retry_after=9.0),  # different bucket
+        ]
+        sheds = evaluate(records, SLOS, 10.0)["overall"]["sheds"]
+        assert sheds["honest"] is True
+
+
+class TestWindows:
+    def _records(self):
+        out = []
+        # baseline [0, 4): fast and healthy
+        for i in range(8):
+            out.append(_rec(f"b{i}", t=i * 0.5, ttft=0.05))
+        # window [4, 6): amplified tails, one dip
+        out.append(_rec("w0", t=4.2, ttft=0.09))
+        out.append(_rec("w1", t=4.8, ttft=0.5))  # blew the SLO
+        # tail [6, 10): recovered
+        for i in range(4):
+            out.append(_rec(f"t{i}", t=6.5 + i * 0.5, ttft=0.05))
+        return out
+
+    def test_amplification_and_recovery(self):
+        r = evaluate(
+            self._records(), SLOS, 10.0,
+            windows=[EventWindow("kill", 4.0, 6.0)],
+        )
+        kill = r["windows"]["kill"]
+        assert kill["requests"] == 2
+        assert kill["goodput_ratio"] == 0.5
+        assert kill["ttft_p95_amplification"] > 1.0
+        rec = r["windows"]["_recovery"]
+        assert rec["baseline_goodput_ratio"] == 1.0
+        assert rec["tail_goodput_ratio"] == 1.0
+        assert rec["recovered"] is True
+
+    def test_empty_tail_recovery_is_none_not_false(self):
+        # a kill window clamped to the soak end proves nothing about
+        # recovery — the report must say "unknown", not "failed"
+        records = [_rec("b0", t=1.0)]
+        r = evaluate(
+            records, SLOS, 10.0,
+            windows=[EventWindow("kill", 5.0, 10.0)],
+        )
+        assert r["windows"]["_recovery"]["recovered"] is None

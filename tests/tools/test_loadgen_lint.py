@@ -1,0 +1,82 @@
+"""PR-12 lint gate: the loadgen subsystem stays clean and import-light.
+
+The open-loop driver shares an event loop with the stack it measures —
+a blocking call there (DTPU001) distorts every latency number it
+reports — and the generator path (spec/schedule/textgen/report/
+metrics) must import without jax, aiohttp, or numpy so schedule
+compilation and artifact diffing run anywhere (the ``faults/``
+contract). Both are pinned here rather than trusted.
+"""
+
+import ast
+import subprocess
+import sys
+from pathlib import Path
+
+from tools.dtpu_lint.core import REPO, run_lint
+
+LOADGEN = Path("dstack_tpu") / "loadgen"
+FLOW_RULES = ("DTPU008", "DTPU009", "DTPU010", "DTPU011")
+
+#: the generator path: importable with no serving or accelerator
+#: runtime (driver.py and soak.py are the deliberate exceptions and
+#: are imported lazily by __main__/soak callers)
+IMPORT_LIGHT = (
+    "__init__.py", "spec.py", "schedule.py", "textgen.py",
+    "report.py", "metrics.py",
+)
+
+_HEAVY = {"jax", "aiohttp", "numpy", "jaxlib"}
+
+
+def test_loadgen_tree_clean_under_all_rules():
+    """Zero findings — and zero baseline entries — over the whole
+    package: DTPU001 (its scope now covers loadgen), metric hygiene,
+    settings drift, and the flow rules all hold."""
+    findings = run_lint(REPO, paths=[str(LOADGEN)])
+    assert findings == [], [
+        f"{f.rule} {f.path}:{f.line} {f.message}" for f in findings
+    ]
+
+
+def test_flow_rules_stay_zero_repo_wide():
+    findings = run_lint(REPO, rule_ids=list(FLOW_RULES))
+    assert findings == [], [
+        f"{f.rule} {f.path}:{f.line} {f.message}" for f in findings
+    ]
+
+
+def test_generator_path_static_imports_are_light():
+    """AST-level: no generator-path module imports jax/aiohttp/numpy,
+    directly or at module scope."""
+    for name in IMPORT_LIGHT:
+        tree = ast.parse((REPO / LOADGEN / name).read_text())
+        imported = {
+            (n.module or "").split(".")[0]
+            if isinstance(n, ast.ImportFrom)
+            else a.name.split(".")[0]
+            for n in ast.walk(tree)
+            if isinstance(n, (ast.Import, ast.ImportFrom))
+            for a in (n.names if isinstance(n, ast.Import) else [None])
+        }
+        assert not imported & _HEAVY, (name, imported & _HEAVY)
+
+
+def test_package_import_pulls_no_heavy_runtime():
+    """Runtime pin (like tests/chaos/test_faults.py's for faults/):
+    importing the package — and compiling a schedule — must not drag
+    aiohttp or jax into the process."""
+    code = (
+        "import sys\n"
+        "from dstack_tpu.loadgen import compile_schedule, default_spec\n"
+        "s = compile_schedule(default_spec(10.0, 2.0), 1)\n"
+        "assert s.digest()\n"
+        "bad = [m for m in ('aiohttp', 'jax', 'numpy') "
+        "if m in sys.modules]\n"
+        "assert not bad, f'loadgen pulled in {bad}'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr

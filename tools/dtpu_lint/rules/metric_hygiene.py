@@ -99,6 +99,7 @@ def collect_metric_names(repo: Path) -> set:
     if str(repo) not in sys.path:  # runnable from anywhere
         sys.path.insert(0, str(repo))
     names: set = set()
+    from dstack_tpu.loadgen.metrics import new_loadgen_registry
     from dstack_tpu.qos.metrics import new_qos_registry
     from dstack_tpu.routing.metrics import new_router_registry
     from dstack_tpu.serve.metrics import new_serve_registry
@@ -112,6 +113,7 @@ def collect_metric_names(repo: Path) -> set:
     names.update(new_retry_registry().metric_names())
     names.update(new_qos_registry().metric_names())
     names.update(new_reconcile_registry().metric_names())
+    names.update(new_loadgen_registry().metric_names())
     try:
         from dstack_tpu.train.step import new_train_registry
 
