@@ -75,6 +75,22 @@ class APIClient:
     def server_info(self) -> dict:
         return self._get("/api/server/info")
 
+    # distributed tracing (obs.tracing; docs/reference/server.md)
+    def get_traces(
+        self,
+        trace_id: Optional[str] = None,
+        slowest: Optional[int] = None,
+    ) -> dict:
+        """``GET /debug/traces`` — one trace by id, the N slowest, or
+        the most recent completed traces on the server process."""
+        if trace_id:
+            q = f"?id={trace_id}"
+        elif slowest:
+            q = f"?slowest={int(slowest)}"
+        else:
+            q = ""
+        return self._get("/debug/traces" + q)
+
     # users
     def get_my_user(self) -> User:
         return User.model_validate(self._post("/api/users/get_my_user"))

@@ -725,6 +725,166 @@ def stats(run_name, project) -> None:
         console.print(line)
 
 
+def _span_bar(start: float, dur: float, t0: float, total: float, width: int = 28) -> str:
+    """One waterfall bar: offset + extent of a span inside the trace's
+    wall interval, in ``width`` character cells (minimum one cell so
+    microsecond spans stay visible)."""
+    if total <= 0:
+        return "▪"
+    lead = int(round((start - t0) / total * width))
+    lead = max(0, min(width - 1, lead))
+    cells = max(1, int(round(dur / total * width)))
+    cells = min(cells, width - lead)
+    return " " * lead + "█" * cells
+
+
+def _span_detail(span: dict) -> str:
+    """Compact attr/event summary for the waterfall's DETAIL column."""
+    attrs = span.get("attrs") or {}
+    parts = [
+        f"{k}={attrs[k]}"
+        for k in (
+            "replica", "slot", "attempt", "resume", "endpoint", "route",
+            "http_status", "tokens", "finish", "prompt_tokens", "affinity",
+        )
+        if k in attrs
+    ]
+    names = [e["name"] for e in span.get("events") or []]
+    if names:
+        seen: dict = {}
+        for n in names:
+            seen[n] = seen.get(n, 0) + 1
+        parts.append(
+            "events: " + ", ".join(
+                f"{n}×{c}" if c > 1 else n for n, c in seen.items()
+            )
+        )
+    return " ".join(parts)
+
+
+def render_trace_waterfall(trace: dict) -> Table:
+    """One completed trace → a rich waterfall table (separate from the
+    command so tests can assert the rendering without a server).
+
+    Spans sort by start time and indent under their parent; spans whose
+    parent lives in ANOTHER process's ring (e.g. the replica-side half
+    of a router trace fetched from the replica) render as top-level
+    with a ``↳`` marker instead of being dropped."""
+    spans = [s for s in trace.get("spans", []) if s]
+    t = Table(title=f"trace {trace.get('trace_id', '?')}")
+    for col in ("SPAN", "T+", "DURATION", "WATERFALL", "DETAIL"):
+        t.add_column(col)
+    if not spans:
+        return t
+    spans = sorted(spans, key=lambda s: s.get("start_mono") or 0.0)
+    ids = {s["span_id"] for s in spans}
+    children: dict = {}
+    roots = []
+    for s in spans:
+        p = s.get("parent_id")
+        if p is not None and p in ids:
+            children.setdefault(p, []).append(s)
+        else:
+            roots.append(s)
+    t0 = min(s.get("start_mono") or 0.0 for s in spans)
+    t1 = max(
+        (s.get("start_mono") or 0.0) + (s.get("duration_s") or 0.0)
+        for s in spans
+    )
+    total = t1 - t0
+
+    def _emit(s: dict, depth: int) -> None:
+        start = s.get("start_mono") or 0.0
+        dur = s.get("duration_s") or 0.0
+        orphan = depth == 0 and s.get("parent_id") is not None
+        label = "  " * depth + ("↳ " if orphan else "") + s["name"]
+        if s.get("status") not in ("ok", None):
+            label += f" [red]({s['status']})[/red]"
+        t.add_row(
+            label,
+            f"+{(start - t0) * 1e3:.1f}ms",
+            f"{dur * 1e3:.1f}ms",
+            _span_bar(start, dur, t0, total),
+            _span_detail(s),
+        )
+        for c in children.get(s["span_id"], []):
+            _emit(c, depth + 1)
+
+    for s in roots:
+        _emit(s, 0)
+    return t
+
+
+@cli.command()
+@click.argument("trace_id", required=False)
+@click.option(
+    "--slowest", type=int, default=None,
+    help="list the N slowest retained traces instead of the most recent",
+)
+@click.option(
+    "--url", default=None,
+    help="query this base URL's /debug/traces (a gateway or replica) "
+         "instead of the configured server",
+)
+@click.option("--project", default=None)
+def trace(trace_id, slowest, url, project) -> None:
+    """Inspect distributed request traces (GET /debug/traces).
+
+    With TRACE_ID, render that trace's span waterfall — gateway/proxy
+    admission, QoS decision, one router.dispatch leg per
+    failover/resume attempt, and the replica's queue/prefill/decode
+    phases. Without one, list recent (or --slowest) traces. Trace ids
+    come from the X-DTPU-Trace response header, histogram exemplars on
+    /metrics, or this listing."""
+    if url:
+        import requests
+
+        q = (
+            f"?id={trace_id}" if trace_id
+            else f"?slowest={slowest}" if slowest
+            else ""
+        )
+        resp = requests.get(url.rstrip("/") + "/debug/traces" + q, timeout=15)
+        if resp.status_code >= 400:
+            _die(f"{url} answered {resp.status_code}: {resp.text[:200]}")
+        payload = resp.json()
+    else:
+        client = _client(project)
+        try:
+            payload = client.api.get_traces(trace_id=trace_id, slowest=slowest)
+        except DstackTPUError as e:
+            _die(str(e))
+    if not payload.get("enabled", True):
+        _die("tracing is disabled on the target (DTPU_TRACE=0)")
+    if trace_id:
+        tr = payload.get("trace")
+        if not tr:
+            _die(
+                f"trace {trace_id} not found — rotated out of the ring, "
+                "or recorded on another process (try --url pointing at "
+                "the gateway or replica that served it)"
+            )
+        console.print(render_trace_waterfall(tr))
+        return
+    t = Table()
+    for col in ("TRACE", "ROOT", "SPANS", "DURATION", "STATUS"):
+        t.add_column(col)
+    for s in payload.get("traces", []):
+        t.add_row(
+            s["trace_id"],
+            s.get("root") or "?",
+            str(s["spans"]),
+            f"{s['duration_s'] * 1e3:.1f}ms",
+            s.get("status", ""),
+        )
+    console.print(t)
+    if not payload.get("traces"):
+        console.print(
+            "no completed traces retained (send traffic, or raise "
+            "DTPU_TRACE_BUFFER)"
+        )
+
+
 @cli.command()
 @click.option("--tpu", "tpu_spec", default=None, help="e.g. v5e-8 or v5p")
 @click.option("--spot/--on-demand", default=None)

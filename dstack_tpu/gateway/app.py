@@ -35,6 +35,8 @@ from aiohttp import web
 
 from dstack_tpu import faults, qos
 from dstack_tpu.gateway.nginx import NginxManager
+from dstack_tpu.obs import tracing
+from dstack_tpu.obs.tracing import get_trace_registry
 from dstack_tpu.gateway.state import GatewayState, Replica, Service
 from dstack_tpu.gateway.stats import AccessLogTailer, GatewayStats
 from dstack_tpu.qos.metrics import get_qos_registry
@@ -170,11 +172,17 @@ def _request_tenant(svc: Service, request: web.Request) -> str:
     return qos.ANONYMOUS_TENANT
 
 
-def _qos_admit(svc: Service, tenant: str) -> Optional[web.Response]:
+def _qos_admit(
+    svc: Service, tenant: str, request: web.Request
+) -> Optional[web.Response]:
     """Gateway-edge per-tenant admission (the gateway never sees
     usernames), policy from the service's registered ``qos`` block.
-    → 429 + monotone ``Retry-After`` or None."""
-    return admit_or_shed(svc.qos, tenant, svc.project, svc.run_name)
+    → 429 + monotone ``Retry-After`` or None. The decision lands as
+    an ``edge_admit`` event on the request's root trace span."""
+    return admit_or_shed(
+        svc.qos, tenant, svc.project, svc.run_name,
+        span=request.get(tracing.REQUEST_SPAN_KEY),
+    )
 
 
 async def _forward(
@@ -194,10 +202,47 @@ async def _forward(
     )
 
 
+@web.middleware
+async def _trace_middleware(request: web.Request, handler):
+    """Open/close the gateway-side root span of the distributed trace.
+    The gateway is a client-facing edge: incoming ``X-DTPU-Trace`` is
+    NEVER honored (the forwarder strips it and asserts its own per
+    dispatch leg) — every request starts a fresh trace here, and the
+    trace id is echoed on unprepared (non-streamed) responses; the
+    forwarder echoes it itself on committed streams."""
+    root = tracing.span("gateway.request", method=request.method)
+    request[tracing.REQUEST_SPAN_KEY] = root
+    status = 500
+    try:
+        resp = await handler(request)
+        status = resp.status
+        if root.recording and not resp.prepared:
+            resp.headers[tracing.TRACE_HEADER] = root.trace_id
+        return resp
+    except web.HTTPException as e:
+        # a 404/405 from the dispatcher is a normal answer, not a
+        # 500-status error trace (mirrors the server middleware) — a
+        # port scanner must not fill the bounded ring with "errors"
+        status = e.status
+        raise
+    except asyncio.CancelledError:
+        status = 499  # client closed the connection; not an error
+        raise
+    finally:
+        route = (
+            request.match_info.route.resource.canonical
+            if request.match_info.route.resource is not None
+            else "unmatched"
+        )
+        root.end(
+            "error" if status >= 500 else "ok", route=route, http_status=status,
+        )
+
+
 def build_app(
     agent: GatewayAgent, probe_interval: Optional[float] = None
 ) -> web.Application:
-    app = web.Application()
+    app = web.Application(middlewares=[_trace_middleware])
     app["agent"] = agent
 
     # ---- health + registry ----
@@ -323,9 +368,22 @@ def build_app(
             return denied
         agent.pools.update_state_gauge()
         return web.Response(
-            text=get_router_registry().render() + get_qos_registry().render(),
+            text=get_router_registry().render() + get_qos_registry().render()
+            + get_trace_registry().render(),
             content_type="text/plain",
         )
+
+    async def debug_traces(request: web.Request) -> web.StreamResponse:
+        # same custom-domain carve-out and token gate as /metrics:
+        # a registered domain owns its path space (its replica's own
+        # /debug/traces keeps proxying through), and trace attrs are
+        # deployment metadata (replica ids, routes)
+        if agent.state.by_domain(request.headers.get("Host", "")) is not None:
+            return await host_proxy(request)
+        denied = _registry_auth(agent, request)
+        if denied is not None:
+            return denied
+        return web.json_response(tracing.debug_payload(request.query))
 
     async def get_stats(request: web.Request) -> web.Response:
         denied = _registry_auth(agent, request)
@@ -357,6 +415,7 @@ def build_app(
     app.router.add_post("/api/registry/replicas/drain", drain_replica)
     app.router.add_get("/api/stats", get_stats)
     app.router.add_get("/metrics", router_metrics)
+    app.router.add_get("/debug/traces", debug_traces)
     app.router.add_post("/api/config", set_config)
 
     # ---- embedded data path ----
@@ -372,7 +431,7 @@ def build_app(
         if denied is not None:
             return denied
         tenant = _request_tenant(svc, request)
-        shed = _qos_admit(svc, tenant)
+        shed = _qos_admit(svc, tenant, request)
         if shed is not None:
             return shed
         agent.stats.record(project, run_name)
@@ -416,7 +475,7 @@ def build_app(
         if denied is not None:
             return denied
         tenant = _request_tenant(svc, request)
-        shed = _qos_admit(svc, tenant)
+        shed = _qos_admit(svc, tenant, request)
         if shed is not None:
             return shed
         agent.stats.record(project, svc.run_name)
@@ -438,7 +497,7 @@ def build_app(
         if denied is not None:
             return denied
         tenant = _request_tenant(svc, request)
-        shed = _qos_admit(svc, tenant)
+        shed = _qos_admit(svc, tenant, request)
         if shed is not None:
             return shed
         agent.stats.record(svc.project, svc.run_name)

@@ -55,6 +55,17 @@ class NginxManager:
         self.reload()
 
     def render_config(self, svc: Service) -> str:
+        # the replica trusts each proxy-asserted header (tenant
+        # identity, resume marker, trace context) — never let a
+        # client-supplied value through. ONE list, shared with the
+        # aiohttp forwarder's strip set, so the enforcement points
+        # cannot drift.
+        from dstack_tpu.routing.forward import PROXY_ASSERTED_HEADERS
+
+        blanked = "\n".join(
+            f'        proxy_set_header {h} "";'
+            for h in PROXY_ASSERTED_HEADERS
+        )
         upstream = f"{svc.run_name}_{svc.project}".replace("-", "_")
         servers = (
             "\n".join(
@@ -83,9 +94,7 @@ server {{{listen}
         proxy_pass http://{upstream};
         proxy_set_header Host $host;
         proxy_set_header X-Real-IP $remote_addr;
-        # the replica trusts X-DTPU-Tenant as proxy-asserted identity
-        # (its QoS bucket key): never let a client-supplied value through
-        proxy_set_header X-DTPU-Tenant "";
+{blanked}
         proxy_http_version 1.1;
         proxy_set_header Upgrade $http_upgrade;
         proxy_set_header Connection "upgrade";

@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import aiohttp
 
 from dstack_tpu.loadgen.metrics import get_loadgen_registry
+from dstack_tpu.obs.tracing import TRACE_HEADER
 from dstack_tpu.loadgen.report import RequestRecord
 from dstack_tpu.loadgen.schedule import Event
 from dstack_tpu.utils.logging import get_logger
@@ -269,6 +270,9 @@ class OpenLoopDriver:
             headers=self.headers_for(ev),
         ) as resp:
             rec.status = resp.status
+            # the router's trace-id echo: links this record to its
+            # distributed trace for the report's tail attribution
+            rec.trace_id = resp.headers.get(TRACE_HEADER)
             if resp.status == 429:
                 rec.outcome = "shed"
                 rec.retry_after = _retry_after(resp)

@@ -61,6 +61,9 @@ class RequestRecord:
     tokens: int = 0
     retry_after: Optional[float] = None
     detail: str = ""
+    # the router's X-DTPU-Trace response echo: the key that links this
+    # record to its distributed trace for tail attribution
+    trace_id: Optional[str] = None
 
     @property
     def lag_s(self) -> float:
@@ -140,6 +143,81 @@ def _shed_honesty(records: Sequence[RequestRecord]) -> dict:
     }
 
 
+#: TTFT-relevant phases a window's worst requests attribute to (decode
+#: happens after the first token and is reported but never dominant)
+_TTFT_PHASES = ("qos_queue", "prefill", "router_retry")
+
+
+def attribute_trace_phases(trace) -> Optional[dict]:
+    """One completed trace (the ``obs.tracing`` dict shape) → per-phase
+    duration sums and the dominant TTFT phase, or None.
+
+    Phases: ``qos_queue`` (serve.queue spans — admission-queue wait),
+    ``prefill`` (serve.prefill), ``decode`` (serve.decode), and
+    ``router_retry`` (router.dispatch legs that did NOT complete ok —
+    the failover/resume overhead a kill window inflicts). Stdlib-only
+    on purpose: the lookup callable is injected, so unit tests attribute
+    synthetic trace dicts without aiohttp."""
+    if not isinstance(trace, dict):
+        return None
+    sums = {
+        "qos_queue": 0.0, "prefill": 0.0, "decode": 0.0,
+        "router_retry": 0.0,
+    }
+    for s in trace.get("spans", []):
+        d = s.get("duration_s") or 0.0
+        name = s.get("name")
+        if name == "serve.queue":
+            sums["qos_queue"] += d
+        elif name == "serve.prefill":
+            sums["prefill"] += d
+        elif name == "serve.decode":
+            sums["decode"] += d
+        elif name == "router.dispatch" and s.get("status") not in ("ok", None):
+            sums["router_retry"] += d
+    dominant = None
+    if any(sums[k] > 0.0 for k in _TTFT_PHASES):
+        dominant = max(_TTFT_PHASES, key=lambda k: sums[k])
+    return {
+        "phase_ms": {k: round(v * 1e3, 2) for k, v in sums.items()},
+        "dominant_phase": dominant,
+    }
+
+
+def _worst_request_phases(
+    records: Sequence[RequestRecord], trace_lookup, n: int = 3
+) -> list:
+    """The window's ``n`` worst completed requests by TTFT, each
+    attributed to its dominant span phase via ``trace_lookup(trace_id)
+    → trace dict or None`` (the soak passes ``obs.tracing.get_trace``
+    — router and replicas share one in-process ring there)."""
+    worst = sorted(
+        (r for r in records if r.outcome == "ok" and r.ttft_s is not None),
+        key=lambda r: r.ttft_s,
+        reverse=True,
+    )[: max(0, int(n))]
+    out = []
+    for r in worst:
+        entry = {
+            "rid": r.rid,
+            "ttft_ms": _ms(r.ttft_s),
+            "trace_id": r.trace_id,
+        }
+        attributed = (
+            attribute_trace_phases(trace_lookup(r.trace_id))
+            if r.trace_id
+            else None
+        )
+        if attributed is not None:
+            entry.update(attributed)
+        else:
+            # honest gap: the trace rotated out of the bounded ring (or
+            # tracing was off) — the record still lists, unattributed
+            entry["dominant_phase"] = None
+        out.append(entry)
+    return out
+
+
 def _bucket_stats(
     records: Sequence[RequestRecord],
     slos: Dict[str, Tuple[float, float]],
@@ -176,13 +254,17 @@ def evaluate(
     class_slos: Dict[str, Tuple[float, float]],
     duration_s: float,
     windows: Sequence[EventWindow] = (),
+    trace_lookup=None,
 ) -> dict:
     """Score one soak run → the report's analysis block.
 
     ``class_slos`` maps class name → (ttft_slo_ms, tpot_slo_ms);
     ``windows`` are the injected-event intervals (kill, drain) whose
     tail amplification and recovery get scored against the pre-window
-    baseline."""
+    baseline. ``trace_lookup`` (``trace_id → obs.tracing trace dict or
+    None``, optional) attributes each window's worst requests to their
+    dominant span phase — the "WHY did the kill window amplify TTFT
+    2×" block of the artifact."""
     records = list(records)
     per_class: Dict[str, dict] = {}
     for name, slos in sorted(class_slos.items()):
@@ -227,6 +309,10 @@ def evaluate(
             blk["ttft_p95_amplification"] = (
                 round(w95 / b95, 2) if b95 and w95 else None
             )
+            if trace_lookup is not None:
+                blk["worst_requests"] = _worst_request_phases(
+                    in_w, trace_lookup
+                )
             window_blocks[w.name] = blk
         bg, tg = baseline["goodput_ratio"], tail["goodput_ratio"]
         # None (not False): an empty tail or baseline proves nothing —

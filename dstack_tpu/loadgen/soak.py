@@ -299,6 +299,20 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
             f"{cfg.kill_frac}; end the drain first or add a third "
             "replica"
         )
+    # size the trace ring to the whole schedule: the report attributes
+    # each window's worst requests from the ring AFTER the soak, and
+    # the default 256-trace buffer would evict the drain window's
+    # traces long before then (warmup + per-request churn included)
+    from dstack_tpu.obs import tracing as obs_tracing
+
+    if obs_tracing.enabled():
+        obs_tracing.enable(
+            buffer=max(
+                obs_tracing.get_tracer().buffer,
+                4 * len(schedule.events) + 64,
+            ),
+            sample=1.0,
+        )
     config = llama.CONFIGS[cfg.model]
     params = llama.init_params(config, jax.random.key(0))
     # pin the random-init model to ASCII output (ban non-byte ids incl.
@@ -417,11 +431,17 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
         elif faults.active():
             faults.clear()
 
+    # trace-based tail attribution: router and replicas all run in this
+    # process, so the obs.tracing ring (imported above, where the soak
+    # sized it to the schedule) holds the STITCHED trace — router legs
+    # + replica phases — for the report to attribute each window's
+    # worst requests from
     analysis = evaluate(
         records,
         {c.name: (c.ttft_slo_ms, c.tpot_slo_ms) for c in spec.classes},
         spec.duration_s,
         windows=windows,
+        trace_lookup=obs_tracing.get_trace,
     )
     info = backend_info()
     result = {

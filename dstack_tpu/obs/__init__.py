@@ -1,7 +1,7 @@
 """Shared telemetry core: metric primitives + Prometheus rendering.
 
 One implementation of counters/gauges/histograms used by every layer —
-the control-plane HTTP middleware (``server/tracing.py``), the cluster
+the control-plane HTTP middleware (``server/sentry_compat.py``), the cluster
 ``/metrics`` renderer (``server/services/prometheus.py``), the serve
 engine (``serve/metrics.py``), and the train-step telemetry hook
 (``train/step.py``) — so escaping rules, bucket layouts, and the text

@@ -190,19 +190,31 @@ class Histogram(_Family):
             "sum": 0.0,
             "count": 0,
             "samples": deque(maxlen=self.sample_window),
+            # bucket index -> (value, exemplar id): the latest
+            # exemplar-carrying observation landing in each bucket —
+            # bounded by construction (one slot per bucket)
+            "exemplars": {},
         }
 
-    def observe(self, value: float, *labels) -> None:
+    def observe(self, value: float, *labels, exemplar=None) -> None:
+        """Record one observation. ``exemplar`` optionally attaches a
+        trace id to the bucket the value lands in (OpenMetrics-style:
+        "show me the trace behind p99" resolves the p99 bucket's
+        exemplar — see :meth:`exemplars`); storage is one slot per
+        bucket, latest wins."""
         v = float(value)
         with self._lock:
             key = self._key(labels)
             s = self._series.get(key)
             if s is None:
                 s = self._series[key] = self._new_series()
-            s["counts"][bisect.bisect_left(self.buckets, v)] += 1
+            ix = bisect.bisect_left(self.buckets, v)
+            s["counts"][ix] += 1
             s["sum"] += v
             s["count"] += 1
             s["samples"].append(v)
+            if exemplar is not None:
+                s["exemplars"][ix] = (v, str(exemplar))
 
     def _get(self, labels: Sequence) -> Optional[dict]:
         return self._series.get(tuple(str(v) for v in labels))
@@ -216,6 +228,39 @@ class Histogram(_Family):
         with self._lock:
             s = self._get(labels)
             return s["count"] if s else 0
+
+    def exemplars(self, *labels) -> dict:
+        """{bucket upper bound (float, or ``float("inf")``): (value,
+        exemplar id)} for one series — the in-process path from a
+        quantile to the trace behind it: find the bucket covering the
+        quantile, read its exemplar."""
+        with self._lock:
+            s = self._get(labels)
+            if s is None:
+                return {}
+            bounds = list(self.buckets) + [float("inf")]
+            return {bounds[ix]: ex for ix, ex in s["exemplars"].items()}
+
+    def exemplar_near(self, q: float, *labels):
+        """(value, exemplar id) from the bucket covering quantile ``q``
+        — or, when that bucket holds none, the nearest higher bucket's
+        (a tail exemplar still explains the tail) — else None."""
+        with self._lock:
+            s = self._get(labels)
+            if s is None or s["count"] == 0 or not s["exemplars"]:
+                return None
+            target = q * s["count"]
+            acc = 0
+            q_ix = len(self.buckets)  # +Inf by default
+            for i in range(len(self.buckets) + 1):
+                acc += s["counts"][i]
+                if acc >= target:
+                    q_ix = i
+                    break
+            for ix in sorted(s["exemplars"]):
+                if ix >= q_ix:
+                    return s["exemplars"][ix]
+            return s["exemplars"][max(s["exemplars"])]
 
     def quantile(self, q: float, *labels) -> Optional[float]:
         """Exact quantile over the raw-sample window when samples are
@@ -251,15 +296,17 @@ class Histogram(_Family):
             for key in sorted(self._series):
                 s = self._series[key]
                 acc = 0
-                for b, c in zip(self.buckets, s["counts"]):
+                for i, (b, c) in enumerate(zip(self.buckets, s["counts"])):
                     acc += c
                     le = 'le="%s"' % _fmt(b)
                     lines.append(
                         f"{self.name}_bucket{self._labelstr(key, le)} {acc}"
+                        + self._exemplar_suffix(s, i)
                     )
                 inf = 'le="+Inf"'
                 lines.append(
                     f"{self.name}_bucket{self._labelstr(key, inf)} {s['count']}"
+                    + self._exemplar_suffix(s, len(self.buckets))
                 )
                 lines.append(
                     f"{self.name}_sum{self._labelstr(key)} {_fmt(s['sum'])}"
@@ -268,6 +315,17 @@ class Histogram(_Family):
                     f"{self.name}_count{self._labelstr(key)} {s['count']}"
                 )
             return lines
+
+    @staticmethod
+    def _exemplar_suffix(s: dict, ix: int) -> str:
+        """OpenMetrics exemplar suffix for one bucket line (consumers
+        that relay this text must keep the ``# {...}`` tail intact —
+        server/services/prometheus._relabel does)."""
+        ex = s["exemplars"].get(ix)
+        if ex is None:
+            return ""
+        value, eid = ex
+        return ' # {trace_id="%s"} %s' % (escape_label(eid), _fmt(value))
 
 
 class Registry:

@@ -18,6 +18,7 @@ from aiohttp import web
 
 from dstack_tpu import qos
 from dstack_tpu.core.models.runs import JobProvisioningData, JobStatus
+from dstack_tpu.obs import tracing
 from dstack_tpu.proxy.stats import get_service_stats
 from dstack_tpu.qos.web import admit_or_shed
 from dstack_tpu.routing import forward_with_failover, get_pool_registry
@@ -166,7 +167,10 @@ async def service_proxy_handler(request: web.Request) -> web.StreamResponse:
         return denied
     tenant = _request_tenant(user)
     if run_row is not None:  # no stats/bucket keys from random run names
-        shed = admit_or_shed(conf.get("qos"), tenant, project, run_name)
+        shed = admit_or_shed(
+            conf.get("qos"), tenant, project, run_name,
+            span=request.get(tracing.REQUEST_SPAN_KEY),
+        )
         if shed is not None:
             return shed
     # record BEFORE the no-replica check: demand on a scaled-to-zero
@@ -210,7 +214,10 @@ async def model_proxy_handler(request: web.Request) -> web.StreamResponse:
     if denied is not None:
         return denied
     tenant = _request_tenant(user)
-    shed = admit_or_shed(conf.get("qos"), tenant, project, run_name)
+    shed = admit_or_shed(
+        conf.get("qos"), tenant, project, run_name,
+        span=request.get(tracing.REQUEST_SPAN_KEY),
+    )
     if shed is not None:
         return shed
     get_service_stats().record(project, run_name)  # before the 503 check

@@ -433,6 +433,7 @@ def edge_admit(
     run_name: str = "",
     fault_point: Optional[str] = "routing.admit",
     cost: float = 1.0,
+    span=None,
 ) -> Optional[int]:
     """One admission decision at an HTTP edge → ``None`` when admitted,
     else the integer ``Retry-After`` seconds for the 429.
@@ -451,13 +452,23 @@ def edge_admit(
     counters advance by ``round(cost)`` (one per covered generation,
     matching ``dtpu_serve_requests_total``'s per-choice accounting); a
     shed is one rejected HTTP request and counts 1 regardless of
-    cost."""
+    cost.
+
+    ``span`` (an :mod:`obs.tracing` span, optional) receives one
+    ``edge_admit`` event recording the decision — a trace of a shed
+    request then shows the 429 as an admission decision, not a
+    mystery, and a trace of a slow one proves admission was not the
+    wait."""
     if fault_point is not None:
         try:
             faults.fire(fault_point, tenant=tenant, run=run_name)
         except faults.FaultError as e:
             hint = max(1, int(math.ceil(getattr(e, "retry_after", None) or 1)))
             _count_edge(tenant, project, run_name, admitted=False, retry_after=hint)
+            if span is not None:
+                span.event(
+                    "edge_admit", shed=True, injected=True, retry_after=hint,
+                )
             return hint
     if not policy.enabled or buckets is None:
         # no QoS configured: pass through WITHOUT counting — minting
@@ -471,9 +482,13 @@ def edge_admit(
             tenant, project, run_name, admitted=True,
             count=max(1, int(round(cost))),
         )
+        if span is not None:
+            span.event("edge_admit", shed=False)
         return None
     hint = max(1, int(math.ceil(bucket.retry_after(cost))))
     _count_edge(tenant, project, run_name, admitted=False, retry_after=hint)
+    if span is not None:
+        span.event("edge_admit", shed=True, retry_after=hint)
     return hint
 
 

@@ -15,7 +15,8 @@ from dstack_tpu import qos
 
 
 def admit_or_shed(
-    spec: Optional[dict], tenant: str, project: str, run_name: str
+    spec: Optional[dict], tenant: str, project: str, run_name: str,
+    span=None,
 ) -> Optional[web.Response]:
     """Per-tenant token-bucket admission for one proxied request → a
     429 with a monotone ``Retry-After``, or None when admitted.
@@ -25,7 +26,8 @@ def admit_or_shed(
     hot path); with none configured only the ``routing.admit`` fault
     point can shed. Callers must gate on an EXISTING run: per-run stats
     entries keyed by attacker-chosen names would exhaust the bounded
-    stats map.
+    stats map. ``span`` (the request's root trace span, optional)
+    records the decision as an ``edge_admit`` event.
     """
     policy = qos.QoSPolicy.from_spec(spec)
     buckets = (
@@ -34,7 +36,8 @@ def admit_or_shed(
         else None
     )
     hint = qos.edge_admit(
-        policy, buckets, tenant, project=project, run_name=run_name
+        policy, buckets, tenant, project=project, run_name=run_name,
+        span=span,
     )
     if hint is None:
         return None

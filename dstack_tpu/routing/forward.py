@@ -49,6 +49,7 @@ import aiohttp
 from aiohttp import web
 
 from dstack_tpu import faults, qos
+from dstack_tpu.obs import tracing
 from dstack_tpu.routing.affinity import request_affinity
 from dstack_tpu.routing.metrics import get_router_registry
 from dstack_tpu.routing.pool import ReplicaPool
@@ -57,23 +58,35 @@ from dstack_tpu.utils.retry import Deadline
 
 logger = get_logger("routing.forward")
 
+#: The ONE list of proxy-asserted request headers — context the edges
+#: derive/inject themselves and a client must never smuggle through:
+#: the authenticated tenant identity (QoS bucket key), the mid-stream
+#: resume marker (skips the serve edge's admission charge), and the
+#: trace context (one spoofed value would graft an attacker's spans
+#: onto a victim's trace). Shared by the forwarder's request-header
+#: filter below, the serve edge's trust decisions, and the nginx site
+#: template (``gateway/nginx.py`` blanks each of these), so the strip
+#: list cannot drift between the three enforcement points.
+PROXY_ASSERTED_HEADERS = (
+    qos.TENANT_HEADER,
+    qos.RESUME_HEADER,
+    tracing.TRACE_HEADER,
+)
+
 # RFC 9110 hop-by-hop headers, plus the framing headers aiohttp manages
 # itself. content-encoding is dropped because the client session
 # auto-decompresses upstream bodies: re-advertising gzip over an
-# already-inflated stream would corrupt it. x-dtpu-tenant is
-# proxy-asserted identity (QoS bucket key) and x-dtpu-resume the
-# proxy-asserted resume marker (it skips the serve edge's admission
-# charge): a client-supplied value must never pass through — the edge
-# re-injects the authenticated tenant via ``extra_headers`` and the
-# forwarder injects the resume marker only on a resume re-dispatch.
+# already-inflated stream would corrupt it. The proxy-asserted headers
+# are stripped here and re-injected by the edge (tenant, via
+# ``extra_headers``) or the forwarder itself (resume marker and trace
+# context, per dispatch leg).
 _DROP_REQUEST = frozenset({
-    "host", "authorization", "transfer-encoding", "x-dtpu-tenant",
-    "x-dtpu-resume",
+    "host", "authorization", "transfer-encoding",
     # recomputed by the client session from the body it actually sends:
     # a resume re-dispatch carries a LONGER body than the original
     # request, and relaying the stale length would truncate it upstream
     "content-length",
-})
+}) | frozenset(h.lower() for h in PROXY_ASSERTED_HEADERS)
 _DROP_RESPONSE = frozenset({
     "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
     "te", "trailers", "transfer-encoding", "upgrade",
@@ -477,7 +490,6 @@ async def forward_with_failover(
     client cannot be trusted to set itself — e.g. the authenticated
     tenant identity (``X-DTPU-Tenant``) the replica's QoS layer keys
     on; they override same-named client headers."""
-    m = get_router_registry()
     body = await request.read()
     req_headers = filter_request_headers(request.headers)
     if extra_headers:
@@ -505,8 +517,34 @@ async def forward_with_failover(
         else None
     )
     query = f"?{request.query_string}" if request.query_string else ""
-    tried: set = set()
     limit = max_attempts if max_attempts is not None else max(1, pool.size())
+    # the forward span: parented to the edge's root (the server
+    # middleware / gateway handler stash it on the request) or — at an
+    # edge without one — a fresh root. Client-supplied X-DTPU-Trace is
+    # NEVER honored here: it was stripped above, and each dispatch leg
+    # below gets its own child span whose header() the replica trusts.
+    fspan = tracing.span(
+        "router.forward",
+        parent=request.get(tracing.REQUEST_SPAN_KEY),
+        service=f"{pool.project}/{pool.run_name}",
+    )
+    try:
+        return await _forward_legs(
+            request, pool, session, path, fspan, body, req_headers,
+            deadline, resume, affinity_key, query, limit,
+        )
+    finally:
+        fspan.end()
+
+
+async def _forward_legs(
+    request, pool, session, path, fspan, body, req_headers, deadline,
+    resume, affinity_key, query, limit,
+) -> web.StreamResponse:
+    """The per-leg failover loop of :func:`forward_with_failover`
+    (split out so the forward span's lifetime is one try/finally)."""
+    m = get_router_registry()
+    tried: set = set()
     attempts = 0
     last_error = "no routable replicas"
     resp: Optional[web.StreamResponse] = None  # committed client response
@@ -515,7 +553,7 @@ async def forward_with_failover(
         if deadline is not None and deadline.expired():
             last_error = "request deadline exceeded"
             break
-        entry = pool.pick(exclude=tried, affinity=affinity_key)
+        entry = pool.pick(exclude=tried, affinity=affinity_key, span=fspan)
         if entry is None:
             break
         if attempts > 0 and resp is None:
@@ -524,13 +562,28 @@ async def forward_with_failover(
             m.family("dtpu_router_failovers_total").inc(1)
         attempts += 1
         tried.add(entry.replica_id)
+        is_resume_leg = resp is not None and resume is not None
+        # one child span per dispatch leg: failover retries and resume
+        # legs attach to the ORIGINAL trace as siblings, so a stitched
+        # waterfall shows the dead leg next to the one that continued it
+        leg = tracing.span(
+            "router.dispatch", parent=fspan,
+            replica=entry.replica_id, attempt=attempts,
+            resume=is_resume_leg,
+        )
         url = f"http://{entry.host}:{entry.port}/{path.lstrip('/')}{query}"
         send_body, send_headers = body, req_headers
-        if resp is not None and resume is not None:
+        if is_resume_leg:
             # resuming mid-stream: prompt extended by delivered text,
             # marker header asserted (clients can't — _DROP_REQUEST)
             send_body = resume.resume_body()
             send_headers = {**req_headers, qos.RESUME_HEADER: "1"}
+        if leg.recording:
+            # proxy-asserted trace context: the replica parents its
+            # serve.request span to THIS leg (client values stripped)
+            send_headers = {
+                **send_headers, tracing.TRACE_HEADER: leg.header(),
+            }
         if deadline is not None:
             # replace case-insensitively: an HTTP/2-terminating LB
             # lowercases header names, and a dict-spread under a
@@ -556,12 +609,14 @@ async def forward_with_failover(
                 # connect/send failure: replica's fault, safe to retry
                 pool.report_failure(entry)
                 last_error = repr(e)
+                leg.end("error", error=last_error)
                 continue
             try:
                 if upstream.status >= 500:
                     # response not committed: another replica may serve
                     pool.report_failure(entry)
                     last_error = f"replica answered {upstream.status}"
+                    leg.end("error", http_status=upstream.status)
                     continue
                 if resp is not None:
                     # a resume leg must stream a 200 SSE continuation;
@@ -572,6 +627,7 @@ async def forward_with_failover(
                             f"resume answered {upstream.status} "
                             f"({upstream.headers.get('Content-Type', '')!r})"
                         )
+                        leg.end("error", error=last_error)
                         continue
                     pool.report_success(entry)
                     pool.affinity.record(affinity_key, entry.replica_id)
@@ -597,6 +653,11 @@ async def forward_with_failover(
                         pool.affinity.record(affinity_key, entry.replica_id)
                     resp = web.StreamResponse(status=upstream.status)
                     copy_response_headers(upstream, resp)
+                    if fspan.recording:
+                        # echo the BARE trace id (never the span id —
+                        # that would let the client mint trusted child
+                        # context) so callers can query /debug/traces
+                        resp.headers[tracing.TRACE_HEADER] = fspan.trace_id
                     if resume is not None and _is_sse(upstream.headers):
                         relay = _SSERelay(resume)
                     try:
@@ -606,16 +667,29 @@ async def forward_with_failover(
                         # was being committed — not the replica's fault;
                         # no breaker penalty, nothing left to answer
                         logger.debug("client gone during response: %r", e)
+                        leg.end("client_gone")
                         return resp
                     if relay is None:
-                        return await _stream_body(pool, entry, upstream, resp)
+                        out = await _stream_body(pool, entry, upstream, resp)
+                        leg.end("ok", http_status=upstream.status, opaque=True)
+                        return out
                 outcome = await _pump_resumable(
                     pool, entry, upstream, resp, relay
+                )
+                leg.end(
+                    "ok" if outcome == "done"
+                    else "error" if outcome == "upstream_died"
+                    else outcome,
+                    http_status=upstream.status,
                 )
             finally:
                 await upstream_ctx.__aexit__(None, None, None)
         finally:
             pool.release(entry)
+            # safety net for paths that raise out of the leg (e.g. an
+            # injected routing.forward HTTP fault): idempotent, so
+            # every explicitly-ended leg above keeps its status
+            leg.end("error", aborted=True)
         if outcome in ("done", "client_gone"):
             if outcome == "done":
                 try:
@@ -654,12 +728,18 @@ async def forward_with_failover(
             f"({len(resume.delivered)} chars delivered)",
         )
         return resp
+    err_headers = (
+        {tracing.TRACE_HEADER: fspan.trace_id} if fspan.recording else {}
+    )
     if deadline is not None and deadline.expired():
+        fspan.event("deadline_expired")
         return web.json_response(
             {"detail": f"request deadline exceeded before {pool.run_name} answered"},
             status=504,
+            headers=err_headers,
         )
     m.family("dtpu_router_exhausted_total").inc(1)
+    fspan.event("pool_exhausted", error=last_error)
     return web.json_response(
         {
             "detail": (
@@ -668,7 +748,7 @@ async def forward_with_failover(
             )
         },
         status=503,
-        headers={"Retry-After": str(pool.retry_after_hint())},
+        headers={"Retry-After": str(pool.retry_after_hint()), **err_headers},
     )
 
 

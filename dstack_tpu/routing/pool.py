@@ -194,6 +194,7 @@ class ReplicaPool:
         self,
         exclude: Iterable[str] = (),
         affinity: Optional[AffinityKey] = None,
+        span=None,
     ) -> Optional[ReplicaEntry]:
         """Least-outstanding-requests selection over routable replicas,
         or one half-open trial against a breaker-expired DEAD replica
@@ -202,7 +203,12 @@ class ReplicaPool:
         With ``affinity``, the replica recorded against the request's
         deepest known prompt-prefix digest wins instead — provided it
         is as healthy as the best candidate and within the imbalance
-        cap of the least-loaded one (docs/guides/serving.md §10)."""
+        cap of the least-loaded one (docs/guides/serving.md §10).
+
+        ``span`` (an :mod:`obs.tracing` span, optional) receives one
+        ``replica_pick`` event per call — the chosen replica, its
+        state, and the affinity outcome (hit/miss/override/off) — so a
+        trace explains WHY a request landed where it did."""
         excluded = set(exclude)
         now = time.monotonic()
         candidates = []
@@ -217,12 +223,13 @@ class ReplicaPool:
                     trials.append(e)
                 continue
             candidates.append(e)
+        affinity_outcome = "off"
         if candidates:
-            best = (
-                self._affinity_choice(affinity, candidates)
-                if affinity is not None and self.affinity.config.enabled
-                else None
-            )
+            best = None
+            if affinity is not None and self.affinity.config.enabled:
+                best, affinity_outcome = self._affinity_choice(
+                    affinity, candidates
+                )
             if best is None:
                 score = lambda e: (  # noqa: E731 - used twice below
                     _STATE_RANK[e.state], e.outstanding, e.queue_depth(),
@@ -242,21 +249,30 @@ class ReplicaPool:
             best = min(trials, key=lambda e: (e.outstanding, e.replica_id))
             best.half_open = True  # exactly one trial per window
         else:
+            if span is not None:
+                span.event("replica_pick", exhausted=True)
             return None
         get_router_registry().family("dtpu_router_picks_total").inc(
             1, best.state.value
         )
+        if span is not None:
+            span.event(
+                "replica_pick",
+                replica=best.replica_id, state=best.state.value,
+                outstanding=best.outstanding, affinity=affinity_outcome,
+            )
         return best
 
     def _affinity_choice(
         self, key: AffinityKey, candidates: list
-    ) -> Optional[ReplicaEntry]:
-        """The two-term affinity score: the mapped replica wins the
-        pick (hit) unless the mapping is absent/unroutable/provably
-        cold (miss → load pick) or honoring it would pile more than
-        ``max_imbalance`` extra outstanding requests onto it — or
-        route past a healthier peer — while others idle (override →
-        load pick, counted so an imbalance flood is observable)."""
+    ) -> Tuple[Optional[ReplicaEntry], str]:
+        """The two-term affinity score → (choice or None, outcome
+        label): the mapped replica wins the pick (``hit``) unless the
+        mapping is absent/unroutable/provably cold (``miss`` → load
+        pick) or honoring it would pile more than ``max_imbalance``
+        extra outstanding requests onto it — or route past a healthier
+        peer — while others idle (``override`` → load pick, counted so
+        an imbalance flood is observable)."""
         m = get_router_registry()
         hit = self.affinity.lookup_entry(key)
         target_rid, recorded_at = hit if hit is not None else (None, 0.0)
@@ -271,7 +287,7 @@ class ReplicaPool:
             # no mapping, or the mapped replica is excluded (already
             # tried this request), DRAINING, DEAD, or gone: cache miss
             m.family("dtpu_router_affinity_misses_total").inc(1)
-            return None
+            return None, "miss"
         now = time.monotonic()
         fresh = (
             target.last_probe_at > 0
@@ -286,7 +302,7 @@ class ReplicaPool:
             # a fresh probe proves the prefix registry empty (engine
             # restarted/reset): the KV this mapping promised is gone
             m.family("dtpu_router_affinity_misses_total").inc(1)
-            return None
+            return None, "miss"
         cfg = self.affinity.config
         rank_min = min(_STATE_RANK[e.state] for e in candidates)
         out_min = min(e.outstanding for e in candidates)
@@ -295,9 +311,9 @@ class ReplicaPool:
             or target.outstanding - out_min > cfg.max_imbalance
         ):
             m.family("dtpu_router_affinity_overrides_total").inc(1)
-            return None
+            return None, "override"
         m.family("dtpu_router_affinity_hits_total").inc(1)
-        return target
+        return target, "hit"
 
     def acquire(self, entry: ReplicaEntry) -> None:
         entry.outstanding += 1

@@ -62,6 +62,11 @@ class GenParams:
     stop: Optional[list] = None  # stop strings (matched by the server)
     # None = off; n >= 0 = collect logprobs with n alternatives (≤ 5)
     logprobs: Optional[int] = None
+    # distributed-tracing exemplar id: when set, the engine attaches it
+    # to the TTFT/TPOT histogram buckets this request lands in, so
+    # "show me the trace behind p99" resolves through /metrics — the
+    # engine itself opens no spans (serve.openai_server owns phases)
+    trace_id: Optional[str] = None
 
 
 # ---------------------------------------------------------------------------
@@ -1726,6 +1731,7 @@ class InferenceEngine:
         self.metrics = registry or new_serve_registry()
         self.metrics.family("dtpu_serve_max_slots").set(max_batch)
         self._admit_t0: dict[int, float] = {}  # slot → admission time
+        self._trace_ids: dict[int, str] = {}  # slot → exemplar trace id
         self.cache = init_cache(
             config, max_batch, max_seq, mesh=mesh, kv_quant=kv_quant
         )
@@ -2243,10 +2249,12 @@ class InferenceEngine:
                 lp[0],
                 list(zip(tids[0], tlps[0])),
             )
+        if gen.trace_id:
+            self._trace_ids[slot] = gen.trace_id
         t_admit = self._admit_t0.pop(slot, None)
         if t_admit is not None:
             self.metrics.family("dtpu_serve_ttft_seconds").observe(
-                time.perf_counter() - t_admit
+                time.perf_counter() - t_admit, exemplar=gen.trace_id,
             )
         self.metrics.family("dtpu_serve_tokens_generated_total").inc(1)
         self.active[slot] = True
@@ -2354,7 +2362,17 @@ class InferenceEngine:
             m.family("dtpu_serve_decode_step_seconds").observe(dt)
             m.family("dtpu_serve_tokens_generated_total").inc(n_tokens)
             if n_tokens and dt > 0:
-                m.family("dtpu_serve_tpot_seconds").observe(dt / n_tokens)
+                # TPOT covers the whole batch: exemplar from the slot
+                # that yielded the most tokens this dispatch (ties by
+                # slot order) — any live trace explains the step
+                ex = None
+                for s in sorted(out, key=lambda s: -len(out[s])):
+                    ex = self._trace_ids.get(s)
+                    if ex is not None:
+                        break
+                m.family("dtpu_serve_tpot_seconds").observe(
+                    dt / n_tokens, exemplar=ex,
+                )
                 m.family("dtpu_serve_decode_tokens_per_sec").observe(
                     n_tokens / dt
                 )
@@ -2732,6 +2750,7 @@ class InferenceEngine:
         self._invalidate_decode_cache()
         self._prefilling.pop(slot, None)
         self._admit_t0.pop(slot, None)
+        self._trace_ids.pop(slot, None)
         self._last_logprobs.pop(slot, None)
 
     def reset_prefix_cache(self) -> None:

@@ -53,6 +53,8 @@ from typing import Optional
 from aiohttp import web
 
 from dstack_tpu import faults, qos
+from dstack_tpu.obs import tracing
+from dstack_tpu.obs.tracing import get_trace_registry
 from dstack_tpu.proxy.model_tgi import DEFAULT_CHAT_TEMPLATE, render_chat
 from dstack_tpu.qos.metrics import get_qos_registry
 from dstack_tpu.serve.engine import GenParams, InferenceEngine
@@ -98,6 +100,13 @@ class _Request:
         self.bucket = None  # qos.TokenBucket this request's admission charged
         self.refunded = False
         self.started = False  # at least one token queued to the client
+        # distributed tracing: `span` is the request's serve-side root
+        # (parented to the router's dispatch leg via X-DTPU-Trace);
+        # `phase` is the currently-open engine phase child —
+        # serve.queue → serve.prefill → serve.decode — advanced by the
+        # scheduler. Both default to the shared no-op span.
+        self.span = tracing.NOOP_SPAN
+        self.phase = tracing.NOOP_SPAN
 
 
 def _reap_abandoned_step(task) -> None:
@@ -158,6 +167,9 @@ class Scheduler:
     async def submit(self, req: _Request) -> None:
         req.submitted_at = time.perf_counter()
         self.engine.metrics.family("dtpu_serve_requests_total").inc(1)
+        # first engine phase: time parked in the admission queue (the
+        # QoS saturation component of client-observed TTFT)
+        req.phase = tracing.span("serve.queue", parent=req.span)
         self.pending.push(req, req.priority)
 
     def cancel(self, req: _Request) -> None:
@@ -168,6 +180,7 @@ class Scheduler:
         not burn a victim tenant's budget)."""
         req.cancelled = True
         self._refund_unstarted(req)
+        req.phase.end("cancelled")
         for slot, r in list(self.by_slot.items()):
             if r is req:
                 self.engine.release(slot)
@@ -232,6 +245,10 @@ class Scheduler:
             "dtpu_serve_deadline_expired_total"
         ).inc(1)
         self._refund_unstarted(req)
+        # terminating trace event: the deadline sweep, not the engine,
+        # ended this request — a trace of the 504 says so explicitly
+        req.span.event("deadline_expired")
+        req.phase.end("deadline")
         req.error = "request deadline exceeded"
         req.error_status = 504
         req.queue.put_nowait(None)
@@ -282,6 +299,8 @@ class Scheduler:
             )
             if req is not None:
                 self._refund_unstarted(req)
+                req.span.event("watchdog_abort", slot=slot)
+                req.phase.end("error")
                 req.error = "engine watchdog aborted a wedged decode step"
                 req.queue.put_nowait(None)
             return None
@@ -301,6 +320,8 @@ class Scheduler:
             for slot, req in list(table.items()):
                 self.engine.release(slot)
                 self._refund_unstarted(req)
+                req.span.event("watchdog_abort", attributable=False)
+                req.phase.end("error")
                 req.error = "engine watchdog aborted a wedged decode step"
                 req.queue.put_nowait(None)
             table.clear()
@@ -346,6 +367,7 @@ class Scheduler:
                 for slot, req in list(self.by_slot.items()):
                     self.engine.release(slot)
                     self._refund_unstarted(req)
+                    req.phase.end("error")
                     req.error = str(e)
                     req.queue.put_nowait(None)
                 self.by_slot.clear()
@@ -353,6 +375,8 @@ class Scheduler:
     def _handle_first_token(self, slot: int, req: _Request, first: int) -> bool:
         """Deliver a finished prefill's first token; True when the slot
         stays active for the decode loop."""
+        req.phase.end()  # serve.prefill: slot admission → first token
+        req.phase = tracing.NOOP_SPAN
         if req.gen.logprobs is not None:
             entry = self.engine.take_logprobs(slot)
             if entry is not None:
@@ -366,6 +390,9 @@ class Scheduler:
                 req.queue.put_nowait(None)
                 return False
         if self.engine.active[slot]:
+            # decode phase: first token → finish, with macro-step
+            # events aggregated per engine dispatch (bounded per span)
+            req.phase = tracing.span("serve.decode", parent=req.span, slot=slot)
             return True
         req.finish_reason = self.engine.finish_reason[slot]
         req.queue.put_nowait(None)  # finished at first token
@@ -396,6 +423,8 @@ class Scheduler:
                 # hang behind a wedge) and wait for it to return
                 for req in self.pending.drain_matching(lambda r: True):
                     self._refund_unstarted(req)
+                    req.span.event("engine_wedged")
+                    req.phase.end("error")
                     req.error = (
                         "engine wedged: a decode dispatch exceeded the "
                         "watchdog budget"
@@ -456,6 +485,7 @@ class Scheduler:
             except Exception as e:  # noqa: BLE001 - reported per request
                 logger.exception("admission failed: %s", e)
                 self._refund_unstarted(req)
+                req.phase.end("error")
                 req.error = str(e)
                 req.queue.put_nowait(None)
                 # the walk charged `held` for this request; it holds no
@@ -473,6 +503,13 @@ class Scheduler:
                 get_qos_registry().family(
                     "dtpu_qos_queue_wait_seconds"
                 ).observe(wait, prio_label)
+            # queue phase over: the prefill phase (chunked/packed
+            # prefill waves through first token) starts at slot grant
+            req.phase.end()
+            req.phase = tracing.span(
+                "serve.prefill", parent=req.span,
+                slot=slot, prompt_tokens=len(req.prompt_ids),
+            )
             self.by_prefill[slot] = req
 
         # ONE prefill dispatch per tick — a packed wave advancing up to
@@ -500,6 +537,7 @@ class Scheduler:
                         continue
                     self.engine.release(slot)
                     self._refund_unstarted(req)
+                    req.phase.end("error")
                     req.error = str(e)
                     req.queue.put_nowait(None)
                 return
@@ -528,6 +566,11 @@ class Scheduler:
             req = self.by_slot.get(slot)
             if req is None:
                 continue
+            # one event per engine dispatch: a turbo macro-step or
+            # speculative verify counts once with its token yield, so
+            # the decode span shows batching granularity, not per-token
+            # noise (bounded per span; overflow is counted)
+            req.phase.event("macro_step", tokens=len(toks))
             stopped = False
             for tok in toks:  # speculative steps emit several tokens
                 if tok == req.gen.eos_id:
@@ -546,9 +589,13 @@ class Scheduler:
                     stopped = True
                     break
             if stopped:
+                req.phase.end(tokens=len(req.gen_ids), finish="stop")
                 continue
             if not self.engine.active[slot]:
                 req.finish_reason = self.engine.finish_reason[slot]
+                req.phase.end(
+                    tokens=len(req.gen_ids), finish=req.finish_reason,
+                )
                 req.queue.put_nowait(None)
                 del self.by_slot[slot]
         await asyncio.sleep(0)
@@ -878,11 +925,12 @@ def build_app(
         else None
     )
 
-    def _admit(request) -> Optional[web.Response]:
+    def _admit(request, span=tracing.NOOP_SPAN) -> Optional[web.Response]:
         """Tenant-bucket admission for one request → a 429 response
         with a monotone ``Retry-After``, or None when admitted. Runs
         before any tokenization/prefill so an over-budget tenant costs
-        nothing but this check."""
+        nothing but this check. The decision lands on ``span`` as an
+        ``edge_admit`` event."""
         if _is_resume(request):
             # a resumed continuation was admitted — and charged — on
             # its original leg; charging again would double-count
@@ -895,7 +943,7 @@ def build_app(
         tenant = qos.tenant_from_headers(request.headers, trust_header=True)
         hint = qos.edge_admit(
             qos_policy, buckets, tenant,
-            run_name=model_name, fault_point="serve.admit",
+            run_name=model_name, fault_point="serve.admit", span=span,
         )
         if hint is None:
             return None
@@ -997,16 +1045,32 @@ def build_app(
         e.metrics.family("dtpu_serve_queue_depth").set(sched.pending.qsize())
         # one page: engine families + this process's dtpu_qos_* edge
         # counters (shed/admitted per tenant digest, queue wait by
-        # priority class) — the shim relay scrapes both together
+        # priority class) + tracing bookkeeping — the shim relay
+        # scrapes them together
         return web.Response(
-            text=e.metrics.render() + get_qos_registry().render(),
+            text=e.metrics.render() + get_qos_registry().render()
+            + get_trace_registry().render(),
             content_type="text/plain",
         )
 
+    async def debug_traces(request):
+        """Completed traces from this replica's in-process ring: the
+        serve-side half of a stitched request trace (``?id=`` /
+        ``?slowest=N`` — same contract as the server's and gateway's
+        endpoints, docs/reference/server.md "Tracing")."""
+        return web.json_response(tracing.debug_payload(request.query))
+
     import dataclasses as _dc
 
-    async def _run(prompt: str, payload: dict, request, resume_text=None):
+    async def _run(
+        prompt: str, payload: dict, request, resume_text=None,
+        span=tracing.NOOP_SPAN,
+    ):
         gen = _gen_params(payload, tokenizer)
+        if span.recording:
+            # engine-side exemplar plumbing: the TTFT/TPOT histograms
+            # attach this trace id to the bucket the request lands in
+            gen.trace_id = span.trace_id
         prompt_ids = tokenizer.encode(prompt)
         resumed_ids: list = []
         if resume_text:
@@ -1043,6 +1107,9 @@ def build_app(
         # stop-string continuity across the resume splice: the
         # delivered tail participates in the bounded match window
         req.gen_ids = list(resumed_ids)
+        req.span = span
+        if resume_text:
+            span.set(resumed=True, resumed_tokens=len(resumed_ids))
         if buckets is not None and qos_policy.enabled and not _is_resume(request):
             # remember the charged bucket so a pre-first-token abort
             # (disconnect/deadline/watchdog) can refund it; resumed
@@ -1098,6 +1165,9 @@ def build_app(
             # refunds its own on a pre-first-token abort
             req.bucket = first_req.bucket
             req.deadline = first_req.deadline
+            # fan-out choices share the request's root trace: their
+            # queue/prefill/decode phases land as siblings under it
+            req.span = first_req.span
             await sched.submit(req)
             reqs.append(req)
         id_lists = await asyncio.gather(*(_collect(r) for r in reqs))
@@ -1116,10 +1186,33 @@ def build_app(
         total = sum(len(ids) for ids in id_lists)
         return reqs, id_lists, total
 
+    def _start_trace(request, endpoint: str):
+        """The serve-side root span: parented to the router's dispatch
+        leg via the proxy-asserted ``X-DTPU-Trace`` header (stripped
+        from client requests by the forwarder and blanked by nginx —
+        the same trust chain as ``X-DTPU-Tenant``); a headerless
+        direct hit starts a fresh trace. Span attrs carry identifiers
+        and counts only, never prompt or completion text."""
+        return tracing.span(
+            "serve.request",
+            trace=request.headers.get(tracing.TRACE_HEADER),
+            endpoint=endpoint,
+        )
+
     async def chat_completions(request):
+        root = _start_trace(request, "chat")
+        try:
+            resp = await _chat_completions(request, root)
+            if root.recording and not resp.prepared:
+                resp.headers[tracing.TRACE_HEADER] = root.trace_id
+            return resp
+        finally:
+            root.end()
+
+    async def _chat_completions(request, root):
         from dstack_tpu.proxy.model_tgi import TGIAdapterError
 
-        shed = _admit(request)
+        shed = _admit(request, span=root)
         if shed is not None:
             return shed
         try:
@@ -1210,13 +1303,20 @@ def build_app(
         shed = _admit_extra(request, n - 1)
         if shed is not None:
             return shed
-        req = await _run(prompt, payload, request, resume_text=resume_text)
+        req = await _run(
+            prompt, payload, request, resume_text=resume_text, span=root
+        )
         completion_id = f"chatcmpl-{uuid.uuid4().hex}"
         created = int(time.time())
         if payload.get("stream"):
-            resp = web.StreamResponse(
-                headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"}
-            )
+            stream_headers = {
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+            if root.recording:
+                # headers commit at prepare(): echo the trace id now
+                stream_headers[tracing.TRACE_HEADER] = root.trace_id
+            resp = web.StreamResponse(headers=stream_headers)
             await resp.prepare(request)
             # deltas come from re-decoding the accumulated ids: per-token
             # decode would corrupt multi-byte UTF-8 and BPE boundaries.
@@ -1382,7 +1482,17 @@ def build_app(
         )
 
     async def completions(request):
-        shed = _admit(request)
+        root = _start_trace(request, "completions")
+        try:
+            resp = await _completions(request, root)
+            if root.recording and not resp.prepared:
+                resp.headers[tracing.TRACE_HEADER] = root.trace_id
+            return resp
+        finally:
+            root.end()
+
+    async def _completions(request, root):
+        shed = _admit(request, span=root)
         if shed is not None:
             return shed
         try:
@@ -1401,7 +1511,7 @@ def build_app(
         shed = _admit_extra(request, n - 1)
         if shed is not None:
             return shed
-        first = await _run(prompt, payload, request)
+        first = await _run(prompt, payload, request, span=root)
         fanned = await _fan_out(first, n)
         if not isinstance(fanned, tuple):
             return fanned
@@ -1531,6 +1641,7 @@ def build_app(
 
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/traces", debug_traces)
     app.router.add_get("/v1/models", models)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)

@@ -136,7 +136,7 @@ async def run_server(
     configure_logging()
     # process-global telemetry init (once per server process, not per
     # app construction — tests build many apps)
-    from dstack_tpu.server.tracing import init_sentry
+    from dstack_tpu.server.sentry_compat import init_sentry
 
     init_sentry()
     app = await create_app(

@@ -141,7 +141,7 @@ def build_app(
     state: dict,
     auth_dependency: Optional[Callable] = None,
 ) -> web.Application:
-    from dstack_tpu.server.tracing import tracing_middleware
+    from dstack_tpu.server.sentry_compat import tracing_middleware
 
     app = web.Application(
         client_max_size=256 * 1024 * 1024, middlewares=[tracing_middleware]

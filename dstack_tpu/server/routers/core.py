@@ -897,6 +897,24 @@ async def prometheus_metrics(ctx: RequestContext):
     return web.Response(text=text, content_type="text/plain")
 
 
+@root_router.get("/debug/traces")
+@no_auth
+async def debug_traces(ctx: RequestContext):
+    """Completed distributed traces from this server process's
+    in-process ring (obs.tracing): ``?id=<trace_id>``, ``?slowest=N``,
+    or the most recent. Same exposure policy as /metrics — trace
+    attrs are identifiers/counts (routes, replica ids, tenant
+    digests), never request content."""
+    from aiohttp import web
+
+    from dstack_tpu.obs import tracing
+    from dstack_tpu.server import settings
+
+    if not settings.ENABLE_PROMETHEUS_METRICS:
+        raise ResourceNotExistsError("prometheus metrics disabled")
+    return web.json_response(tracing.debug_payload(ctx.request.query))
+
+
 ALL_ROUTERS = [
     server_router,
     users_router,

@@ -1,0 +1,173 @@
+"""Sentry integration + per-route RequestStats for the server.
+
+Renamed from ``server/tracing.py`` (a deprecation shim remains there)
+so :mod:`dstack_tpu.obs.tracing` unambiguously owns *distributed*
+tracing — this module is the Sentry compatibility layer plus the
+HTTP-middleware request accounting.
+
+Parity: reference server/app.py:68-76 (optional Sentry SDK init with
+error + performance tracing) and :214-226 (request-latency debug
+middleware). Sentry is gated on the SDK being importable and
+``DTPU_SENTRY_DSN`` being set — zero overhead otherwise. The latency
+middleware always records per-route timing into an in-process ``obs``
+registry that ``/metrics`` renders as ``dtpu_http_*`` series: a
+request counter plus a log-bucketed latency HISTOGRAM (a step past the
+reference, whose latency numbers only reach debug logs — and past our
+own earlier count/sum counters, which could not answer "what is p99").
+
+The middleware also opens/closes the server-side ROOT span of the
+distributed trace (``http.request``): downstream layers — the
+in-server proxy's QoS admission, ``forward_with_failover`` — find it
+under ``request[obs.tracing.REQUEST_SPAN_KEY]`` and parent their spans
+to it, so one trace id covers a proxied request from server admission
+through every dispatch leg to the replica's engine phases.
+"""
+
+import asyncio
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from dstack_tpu.obs import LATENCY_BUCKETS_S, Registry, tracing
+from dstack_tpu.server import settings
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.sentry_compat")
+
+
+def init_sentry() -> bool:
+    """Initialize Sentry when configured; returns whether it is active."""
+    dsn = settings.SENTRY_DSN
+    if not dsn:
+        return False
+    try:
+        import sentry_sdk
+    except ImportError:
+        logger.warning("DTPU_SENTRY_DSN set but sentry_sdk is not installed")
+        return False
+    sentry_sdk.init(
+        dsn=dsn,
+        environment=settings.SENTRY_ENVIRONMENT,
+        traces_sample_rate=settings.SENTRY_TRACES_SAMPLE_RATE,
+        profiles_sample_rate=settings.SENTRY_PROFILES_SAMPLE_RATE,
+    )
+    logger.info("sentry tracing enabled (env=%s)", settings.SENTRY_ENVIRONMENT)
+    return True
+
+
+def capture_exception(exc: BaseException) -> None:
+    try:
+        import sentry_sdk
+
+        if sentry_sdk.Hub.current.client is not None:
+            sentry_sdk.capture_exception(exc)
+    except Exception:
+        pass
+
+
+class RequestStats:
+    """Per-route request counters + latency histograms for /metrics.
+    Routes are the matched route *templates* (bounded set); unmatched
+    requests collapse to one sentinel so arbitrary 404 paths can't grow
+    the registry — the obs cardinality cap backstops even that."""
+
+    def __init__(self) -> None:
+        self.registry = Registry()
+        self.requests = self.registry.counter(
+            "dtpu_http_requests_total",
+            "HTTP requests served",
+            ("method", "route", "status"),
+        )
+        # status is NOT a histogram label: latency distributions are
+        # per-route questions, and a status label would multiply the
+        # bucket series count by the distinct statuses seen
+        self.latency = self.registry.histogram(
+            "dtpu_http_request_duration_seconds",
+            "HTTP request latency",
+            ("method", "route"),
+            buckets=LATENCY_BUCKETS_S,
+        )
+
+    def record(self, method: str, route: str, status: int, seconds: float) -> None:
+        # dtpu: noqa[DTPU004] str(status) renders an int HTTP status code — a bounded set; route is the matched template, not the raw path
+        self.requests.inc(1, method, route, str(status))
+        self.latency.observe(seconds, method, route)
+
+    @property
+    def count(self) -> dict:
+        """{(method, route, status): n} view over the counter (legacy
+        shape kept for tests/introspection)."""
+        return {
+            (m, r, int(s)): int(n)
+            for (m, r, s), n in self.requests._series.items()
+            if s.isdigit()
+        }
+
+    def render_prometheus(self) -> str:
+        return self.registry.render()
+
+
+_stats: Optional[RequestStats] = None
+
+
+def get_request_stats() -> RequestStats:
+    global _stats
+    if _stats is None:
+        _stats = RequestStats()
+    return _stats
+
+
+@web.middleware
+async def tracing_middleware(request: web.Request, handler):
+    """Record latency per route; surface slow requests and capture
+    unhandled errors (reference app.py:214-226 logs request durations
+    under a debug flag; here recording is always on, logging gated).
+
+    Also the server-side root of the distributed trace: the span is
+    opened before the handler (client-supplied ``X-DTPU-Trace`` is NOT
+    honored — the server is a client-facing edge, so every request
+    starts a fresh trace exactly like the tenant-identity rule) and
+    closed here with the matched route and status; the trace id is
+    echoed on the response so callers can query ``/debug/traces``."""
+    start = time.perf_counter()
+    status = 500
+    root = tracing.span("http.request", method=request.method)
+    request[tracing.REQUEST_SPAN_KEY] = root
+    try:
+        resp = await handler(request)
+        status = resp.status
+        if root.recording and not resp.prepared:
+            resp.headers[tracing.TRACE_HEADER] = root.trace_id
+        return resp
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    except asyncio.CancelledError:
+        status = 499  # client closed the connection; not an error
+        raise
+    except BaseException as e:
+        capture_exception(e)
+        raise
+    finally:
+        elapsed = time.perf_counter() - start
+        route = (
+            request.match_info.route.resource.canonical
+            if request.match_info.route.resource is not None
+            else "unmatched"  # sentinel: raw paths are unbounded-cardinality
+        )
+        root.end(
+            "error" if status >= 500 else "ok",
+            route=route, http_status=status,
+        )
+        get_request_stats().record(request.method, route, status, elapsed)
+        if settings.DEBUG_REQUESTS:
+            logger.info(
+                "%s %s -> %d in %.1fms", request.method, route, status,
+                elapsed * 1000,
+            )
+        elif elapsed > settings.SLOW_REQUEST_SECONDS:
+            logger.warning(
+                "slow request: %s %s -> %d in %.2fs",
+                request.method, route, status, elapsed,
+            )

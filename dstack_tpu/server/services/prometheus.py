@@ -57,7 +57,7 @@ async def render_metrics(db: Database) -> str:
     await _render_jobs(db, w, projects)
     # server-side HTTP latency histograms/counters from the tracing
     # middleware's obs registry
-    from dstack_tpu.server.tracing import get_request_stats
+    from dstack_tpu.server.sentry_compat import get_request_stats
 
     w.raw(get_request_stats().render_prometheus())
     # replica-routing series (picks, failovers, breaker opens, probe
@@ -81,6 +81,11 @@ async def render_metrics(db: Database) -> str:
     from dstack_tpu.server.services.wakeups import get_reconcile_registry
 
     w.raw(get_reconcile_registry().render())
+    # distributed-tracing bookkeeping (span/eviction counts for the
+    # obs.tracing ring this process's /debug/traces serves)
+    from dstack_tpu.obs.tracing import get_trace_registry
+
+    w.raw(get_trace_registry().render())
     return w.render()
 
 
@@ -290,16 +295,24 @@ def _relabel(text: str, labels: dict, seen_meta: Optional[set] = None) -> str:
                     seen_meta.add(key)
             out.append(line)
             continue
+        # an OpenMetrics exemplar tail (` # {trace_id="..."} v`) carries
+        # its own brace group: split it off first so the label rewrite
+        # below never mistakes the exemplar's `}` for the sample's
+        exemplar = ""
+        if " # " in s:
+            s, _, ex_tail = s.partition(" # ")
+            s = s.rstrip()
+            exemplar = " # " + ex_tail
         # metric{a="b"} v  |  metric v
         if "{" in s and "}" in s:
             name, rest = s.split("{", 1)
             inner, tail = rest.rsplit("}", 1)
             joined = f"{inner},{extra}" if inner else extra
-            out.append(f"{name}{{{joined}}}{tail}")
+            out.append(f"{name}{{{joined}}}{tail}{exemplar}")
         else:
             parts = s.split(None, 1)
             if len(parts) == 2:
-                out.append(f"{parts[0]}{{{extra}}} {parts[1]}")
+                out.append(f"{parts[0]}{{{extra}}} {parts[1]}{exemplar}")
             else:
                 out.append(line)
     return "\n".join(out)

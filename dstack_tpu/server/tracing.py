@@ -1,146 +1,25 @@
-"""Error/performance tracing hooks for the server.
+"""Deprecation shim: this module moved to
+:mod:`dstack_tpu.server.sentry_compat`.
 
-Parity: reference server/app.py:68-76 (optional Sentry SDK init with
-error + performance tracing) and :214-226 (request-latency debug
-middleware). Sentry is gated on the SDK being importable and
-``DTPU_SENTRY_DSN`` being set — zero overhead otherwise. The latency
-middleware always records per-route timing into an in-process ``obs``
-registry that ``/metrics`` renders as ``dtpu_http_*`` series: a
-request counter plus a log-bucketed latency HISTOGRAM (a step past the
-reference, whose latency numbers only reach debug logs — and past our
-own earlier count/sum counters, which could not answer "what is p99").
+The old name collided with :mod:`dstack_tpu.obs.tracing` — the
+distributed request-tracing subsystem — while this module is actually
+the Sentry integration plus the per-route RequestStats middleware.
+Import ``dstack_tpu.server.sentry_compat`` directly; this shim keeps
+existing imports working and will be removed eventually.
 """
 
-import asyncio
-import time
-from typing import Optional
+from dstack_tpu.server.sentry_compat import (  # noqa: F401
+    RequestStats,
+    capture_exception,
+    get_request_stats,
+    init_sentry,
+    tracing_middleware,
+)
 
-from aiohttp import web
-
-from dstack_tpu.obs import LATENCY_BUCKETS_S, Registry
-from dstack_tpu.server import settings
-from dstack_tpu.utils.logging import get_logger
-
-logger = get_logger("server.tracing")
-
-
-def init_sentry() -> bool:
-    """Initialize Sentry when configured; returns whether it is active."""
-    dsn = settings.SENTRY_DSN
-    if not dsn:
-        return False
-    try:
-        import sentry_sdk
-    except ImportError:
-        logger.warning("DTPU_SENTRY_DSN set but sentry_sdk is not installed")
-        return False
-    sentry_sdk.init(
-        dsn=dsn,
-        environment=settings.SENTRY_ENVIRONMENT,
-        traces_sample_rate=settings.SENTRY_TRACES_SAMPLE_RATE,
-        profiles_sample_rate=settings.SENTRY_PROFILES_SAMPLE_RATE,
-    )
-    logger.info("sentry tracing enabled (env=%s)", settings.SENTRY_ENVIRONMENT)
-    return True
-
-
-def capture_exception(exc: BaseException) -> None:
-    try:
-        import sentry_sdk
-
-        if sentry_sdk.Hub.current.client is not None:
-            sentry_sdk.capture_exception(exc)
-    except Exception:
-        pass
-
-
-class RequestStats:
-    """Per-route request counters + latency histograms for /metrics.
-    Routes are the matched route *templates* (bounded set); unmatched
-    requests collapse to one sentinel so arbitrary 404 paths can't grow
-    the registry — the obs cardinality cap backstops even that."""
-
-    def __init__(self) -> None:
-        self.registry = Registry()
-        self.requests = self.registry.counter(
-            "dtpu_http_requests_total",
-            "HTTP requests served",
-            ("method", "route", "status"),
-        )
-        # status is NOT a histogram label: latency distributions are
-        # per-route questions, and a status label would multiply the
-        # bucket series count by the distinct statuses seen
-        self.latency = self.registry.histogram(
-            "dtpu_http_request_duration_seconds",
-            "HTTP request latency",
-            ("method", "route"),
-            buckets=LATENCY_BUCKETS_S,
-        )
-
-    def record(self, method: str, route: str, status: int, seconds: float) -> None:
-        # dtpu: noqa[DTPU004] str(status) renders an int HTTP status code — a bounded set; route is the matched template, not the raw path
-        self.requests.inc(1, method, route, str(status))
-        self.latency.observe(seconds, method, route)
-
-    @property
-    def count(self) -> dict:
-        """{(method, route, status): n} view over the counter (legacy
-        shape kept for tests/introspection)."""
-        return {
-            (m, r, int(s)): int(n)
-            for (m, r, s), n in self.requests._series.items()
-            if s.isdigit()
-        }
-
-    def render_prometheus(self) -> str:
-        return self.registry.render()
-
-
-_stats: Optional[RequestStats] = None
-
-
-def get_request_stats() -> RequestStats:
-    global _stats
-    if _stats is None:
-        _stats = RequestStats()
-    return _stats
-
-
-@web.middleware
-async def tracing_middleware(request: web.Request, handler):
-    """Record latency per route; surface slow requests and capture
-    unhandled errors (reference app.py:214-226 logs request durations
-    under a debug flag; here recording is always on, logging gated)."""
-    start = time.perf_counter()
-    status = 500
-    try:
-        resp = await handler(request)
-        status = resp.status
-        return resp
-    except web.HTTPException as e:
-        status = e.status
-        raise
-    except asyncio.CancelledError:
-        status = 499  # client closed the connection; not an error
-        raise
-    except BaseException as e:
-        capture_exception(e)
-        raise
-    finally:
-        elapsed = time.perf_counter() - start
-        route = (
-            request.match_info.route.resource.canonical
-            if request.match_info.route.resource is not None
-            else "unmatched"  # sentinel: raw paths are unbounded-cardinality
-        )
-        get_request_stats().record(request.method, route, status, elapsed)
-        if settings.DEBUG_REQUESTS:
-            logger.info(
-                "%s %s -> %d in %.1fms", request.method, route, status,
-                elapsed * 1000,
-            )
-        elif elapsed > settings.SLOW_REQUEST_SECONDS:
-            logger.warning(
-                "slow request: %s %s -> %d in %.2fs",
-                request.method, route, status, elapsed,
-            )
+__all__ = [
+    "RequestStats",
+    "capture_exception",
+    "get_request_stats",
+    "init_sentry",
+    "tracing_middleware",
+]

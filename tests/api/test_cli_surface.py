@@ -8,7 +8,7 @@ from dstack_tpu.cli.main import cli
 EXPECTED = {
     "apply", "attach", "completion", "config", "delete", "fleet",
     "gateway", "init", "logs", "metrics", "offer", "pool", "ps",
-    "secret", "server", "stats", "stop", "volume",
+    "secret", "server", "stats", "stop", "trace", "volume",
 }
 
 
@@ -56,3 +56,62 @@ def test_logs_job_option():
     r = CliRunner().invoke(cli, ["logs", "--help"])
     assert r.exit_code == 0
     assert "--job" in r.output and "job_num" in r.output.replace("-", "_")
+
+
+class TestTraceWaterfall:
+    """`dtpu trace` rendering units (pure function over a trace dict —
+    no server needed, the render_timeline_table convention)."""
+
+    def _trace(self):
+        return {
+            "trace_id": "abc123",
+            "spans": [
+                {"name": "router.forward", "span_id": "s1",
+                 "parent_id": None, "start_mono": 10.0,
+                 "duration_s": 0.5, "status": "ok",
+                 "attrs": {"service": "p/svc"},
+                 "events": [{"t_s": 0.0, "name": "replica_pick"},
+                            {"t_s": 0.2, "name": "replica_pick"}]},
+                {"name": "router.dispatch", "span_id": "s2",
+                 "parent_id": "s1", "start_mono": 10.01,
+                 "duration_s": 0.1, "status": "error",
+                 "attrs": {"replica": "r0", "attempt": 1},
+                 "events": []},
+                {"name": "router.dispatch", "span_id": "s3",
+                 "parent_id": "s1", "start_mono": 10.12,
+                 "duration_s": 0.38, "status": "ok",
+                 "attrs": {"replica": "r1", "attempt": 2, "resume": True},
+                 "events": []},
+                # replica-side span whose parent lives in ANOTHER
+                # process's ring: must render as an orphan, not vanish
+                {"name": "serve.request", "span_id": "s4",
+                 "parent_id": "zz", "start_mono": 10.13,
+                 "duration_s": 0.3, "status": "ok",
+                 "attrs": {}, "events": []},
+            ],
+        }
+
+    def test_waterfall_renders_hierarchy_and_orphans(self):
+        from rich.console import Console
+
+        from dstack_tpu.cli.main import render_trace_waterfall
+
+        table = render_trace_waterfall(self._trace())
+        console = Console(width=160, legacy_windows=False)
+        with console.capture() as cap:
+            console.print(table)
+        out = cap.get()
+        assert "abc123" in out
+        assert "router.forward" in out
+        assert "router.dispatch" in out
+        assert "(error)" in out
+        assert "↳ serve.request" in out  # orphan marker, not dropped
+        assert "replica_pick×2" in out
+        assert "replica=r1" in out and "resume=True" in out
+        assert "█" in out  # a waterfall actually rendered
+
+    def test_empty_trace_renders(self):
+        from dstack_tpu.cli.main import render_trace_waterfall
+
+        table = render_trace_waterfall({"trace_id": "x", "spans": []})
+        assert table.row_count == 0

@@ -97,8 +97,10 @@ class _Router:
         orig_pick = self.pool.pick
         orig_acquire = self.pool.acquire
 
-        def logging_pick(exclude=(), affinity=None):
-            e = orig_pick(exclude=exclude, affinity=affinity)
+        def logging_pick(exclude=(), affinity=None, **kw):
+            # **kw: pass through forwarder-supplied extras (e.g. the
+            # trace span) so the shim tracks, never changes, the API
+            e = orig_pick(exclude=exclude, affinity=affinity, **kw)
             if e is not None:
                 self.picks.append(e.replica_id)
             return e

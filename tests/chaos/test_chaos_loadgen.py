@@ -78,6 +78,32 @@ class TestSoakChaosAcceptance:
         sheds = report["overall"]["sheds"]
         assert sheds["honest"] is True, sheds
 
+        # (5) trace-based tail attribution (PR 13): each window lists
+        # its worst completed requests with the trace id the router
+        # echoed and the dominant TTFT phase from the stitched trace —
+        # the artifact explains its own amplification numbers
+        for wname in ("drain", "kill"):
+            worst = report["windows"][wname].get("worst_requests")
+            assert worst is not None, f"{wname}: no worst_requests block"
+            if not worst:
+                continue  # a window may legally contain zero ok records
+            for entry in worst:
+                assert entry["ttft_ms"] is not None
+                assert "dominant_phase" in entry
+            attributed = [w for w in worst if w.get("phase_ms")]
+            assert attributed, (
+                f"{wname}: no worst request resolved to a trace "
+                f"(ring evicted them?): {worst}"
+            )
+            for entry in attributed:
+                assert entry["trace_id"]
+                assert entry["dominant_phase"] in (
+                    "qos_queue", "prefill", "router_retry",
+                )
+                assert set(entry["phase_ms"]) == {
+                    "qos_queue", "prefill", "decode", "router_retry",
+                }
+
         # report shape the docs promise: per-class goodput + SLO
         # percentiles + shed/failure accounting
         for name, cls in report["classes"].items():

@@ -25,7 +25,7 @@ def test_quick_keep_entries_all_match():
             str(p.relative_to(REPO))
             for root in (
                 "tests/compute", "tests/serve", "tests/chaos",
-                "tests/routing", "tests/loadgen",
+                "tests/routing", "tests/loadgen", "tests/obs",
             )
             for p in (REPO / root).glob(name)
         ]

@@ -162,3 +162,81 @@ class TestWindows:
             windows=[EventWindow("kill", 5.0, 10.0)],
         )
         assert r["windows"]["_recovery"]["recovered"] is None
+
+
+class TestTracePhaseAttribution:
+    """PR-13 tail attribution: a window's worst requests resolve their
+    dominant span phase from injected trace lookups (stdlib only —
+    synthetic trace dicts stand in for the obs.tracing ring)."""
+
+    @staticmethod
+    def _trace(queue=0.0, prefill=0.0, decode=0.0, retry=0.0):
+        spans = [
+            {"name": "router.forward", "duration_s": 1.0, "status": "ok"},
+            {"name": "serve.queue", "duration_s": queue, "status": "ok"},
+            {"name": "serve.prefill", "duration_s": prefill, "status": "ok"},
+            {"name": "serve.decode", "duration_s": decode, "status": "ok"},
+        ]
+        if retry:
+            spans.append({
+                "name": "router.dispatch", "duration_s": retry,
+                "status": "error",
+            })
+            spans.append({
+                "name": "router.dispatch", "duration_s": 0.01,
+                "status": "ok",
+            })
+        return {"trace_id": "t", "spans": spans}
+
+    def test_dominant_phase_per_shape(self):
+        from dstack_tpu.loadgen.report import attribute_trace_phases
+
+        a = attribute_trace_phases(self._trace(queue=0.4, prefill=0.1))
+        assert a["dominant_phase"] == "qos_queue"
+        a = attribute_trace_phases(self._trace(prefill=0.4, retry=0.1))
+        assert a["dominant_phase"] == "prefill"
+        a = attribute_trace_phases(self._trace(prefill=0.1, retry=0.4))
+        assert a["dominant_phase"] == "router_retry"
+        assert a["phase_ms"]["router_retry"] == 400.0
+        # ok dispatch legs are normal serving, not retry overhead
+        assert attribute_trace_phases(self._trace())["dominant_phase"] is None
+        # decode never dominates TTFT attribution but is reported
+        a = attribute_trace_phases(self._trace(queue=0.01, decode=9.0))
+        assert a["dominant_phase"] == "qos_queue"
+        assert a["phase_ms"]["decode"] == 9000.0
+        assert attribute_trace_phases(None) is None
+
+    def test_windows_gain_worst_requests_with_lookup(self):
+        traces = {
+            "t-slow": self._trace(retry=0.4, prefill=0.1),
+            "t-mid": self._trace(queue=0.2),
+        }
+        records = [
+            _rec("b0", t=1.0, ttft=0.05),
+            _rec("w0", t=4.2, ttft=0.5),
+            _rec("w1", t=4.5, ttft=0.2),
+            _rec("w2", t=4.6, ttft=0.06),
+            _rec("w3", t=4.7, ttft=0.4, outcome="shed"),  # never listed
+        ]
+        records[1].trace_id = "t-slow"
+        records[2].trace_id = "t-mid"
+        records[3].trace_id = "t-evicted"  # lookup returns None
+        r = evaluate(
+            records, SLOS, 10.0,
+            windows=[EventWindow("kill", 4.0, 6.0)],
+            trace_lookup=traces.get,
+        )
+        worst = r["windows"]["kill"]["worst_requests"]
+        assert [w["rid"] for w in worst] == ["w0", "w1", "w2"]
+        assert worst[0]["dominant_phase"] == "router_retry"
+        assert worst[1]["dominant_phase"] == "qos_queue"
+        # honest gap: unattributable records list without phases
+        assert worst[2]["dominant_phase"] is None
+        assert "phase_ms" not in worst[2]
+
+    def test_no_lookup_no_block(self):
+        r = evaluate(
+            [_rec("w0", t=4.2)], SLOS, 10.0,
+            windows=[EventWindow("kill", 4.0, 6.0)],
+        )
+        assert "worst_requests" not in r["windows"]["kill"]

@@ -1,0 +1,263 @@
+"""obs.tracing unit contract: span lifecycle, bounded ring, the
+zero-cost-when-disabled no-op rebinding (the ``faults.fire`` idiom),
+header parsing/trust shape, histogram exemplars, and the import-light
+pin — the foundations the cross-layer instrumentation stands on."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from dstack_tpu.obs import tracing
+from dstack_tpu.obs.metrics import Registry
+from dstack_tpu.obs.tracing import Tracer
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+@pytest.fixture(autouse=True)
+def _restore_tracer():
+    """Each test gets a fresh tracer and leaves the module state as it
+    found it (the process default is enabled via DTPU_TRACE)."""
+    prior = tracing.get_tracer()
+    yield
+    if prior is not None:
+        tracing._tracer = prior
+        tracing.span = prior.span
+    else:
+        tracing.disable()
+
+
+class TestSpanLifecycle:
+    def test_root_child_nesting_and_ring(self):
+        tracer = tracing.enable(buffer=16)
+        root = tracing.span("router.forward", service="p/svc")
+        child = tracing.span("router.dispatch", parent=root, replica="r0")
+        child.event("replica_pick", replica="r0")
+        child.end("ok")
+        root.end()
+        tr = tracing.get_trace(root.trace_id)
+        assert tr is not None and len(tr["spans"]) == 2
+        by_name = {s["name"]: s for s in tr["spans"]}
+        assert by_name["router.dispatch"]["parent_id"] == root.span_id
+        assert by_name["router.forward"]["parent_id"] is None
+        assert by_name["router.dispatch"]["attrs"]["replica"] == "r0"
+        assert by_name["router.dispatch"]["events"][0]["name"] == "replica_pick"
+        assert tracer.trace(root.trace_id)["spans"][0]["duration_s"] >= 0
+
+    def test_end_is_idempotent_first_status_wins(self):
+        tracing.enable(buffer=4)
+        s = tracing.span("serve.queue")
+        s.end("error", why="deadline")
+        s.end("ok", why="late")  # must not overwrite
+        tr = tracing.get_trace(s.trace_id)
+        assert tr["spans"][0]["status"] == "error"
+        assert tr["spans"][0]["attrs"] == {"why": "deadline"}
+        assert len(tr["spans"]) == 1  # ended once, recorded once
+
+    def test_context_manager_error_status(self):
+        tracing.enable(buffer=4)
+        with pytest.raises(ValueError):
+            with tracing.span("http.request") as s:
+                raise ValueError("boom")
+        assert tracing.get_trace(s.trace_id)["spans"][0]["status"] == "error"
+
+    def test_header_roundtrip_continues_the_trace(self):
+        tracing.enable(buffer=8)
+        leg = tracing.span("router.dispatch")
+        header = leg.header()
+        assert header == f"{leg.trace_id}-{leg.span_id}"
+        remote = tracing.span("serve.request", trace=header)
+        assert remote.trace_id == leg.trace_id
+        assert remote.parent_id == leg.span_id
+        # malformed headers start a FRESH trace, never an error
+        for bad in (None, "", "zz", "a-b-c", "nothex-1234", "x" * 200):
+            s = tracing.span("serve.request", trace=bad)
+            assert s.recording and s.parent_id is None
+
+    def test_attr_values_truncate_and_never_grow(self):
+        tracing.enable(buffer=4)
+        s = tracing.span("serve.request", blob="x" * 10_000)
+        s.event("e", detail="y" * 10_000)
+        s.end()
+        sd = tracing.get_trace(s.trace_id)["spans"][0]
+        assert len(sd["attrs"]["blob"]) == tracing._MAX_ATTR_CHARS
+        assert len(sd["events"][0]["attrs"]["detail"]) == tracing._MAX_ATTR_CHARS
+
+    def test_event_cap_counts_overflow(self):
+        tracing.enable(buffer=4)
+        before = tracing.get_trace_registry().family(
+            "dtpu_trace_events_dropped_total"
+        ).value()
+        s = tracing.span("serve.decode")
+        for i in range(tracing._MAX_EVENTS + 7):
+            s.event("macro_step", tokens=1)
+        s.end()
+        sd = tracing.get_trace(s.trace_id)["spans"][0]
+        assert len(sd["events"]) == tracing._MAX_EVENTS
+        assert sd["events_dropped"] == 7
+        after = tracing.get_trace_registry().family(
+            "dtpu_trace_events_dropped_total"
+        ).value()
+        assert after == before + 7
+
+
+class TestRingBounds:
+    def test_buffer_evicts_oldest(self):
+        tracer = tracing.enable(buffer=4)
+        ids = []
+        for i in range(10):
+            s = tracing.span("http.request")
+            s.end()
+            ids.append(s.trace_id)
+        assert len(tracer.trace_ids()) == 4
+        assert tracer.trace_ids() == ids[-4:]
+        assert tracing.get_trace(ids[0]) is None
+        evicted = tracing.get_trace_registry().family(
+            "dtpu_trace_traces_evicted_total"
+        ).value()
+        assert evicted >= 6
+
+    def test_slowest_orders_by_duration(self):
+        tracer = tracing.enable(buffer=8)
+        import time
+
+        fast = tracing.span("a")
+        fast.end()
+        slow = tracing.span("b")
+        time.sleep(0.02)
+        slow.end()
+        top = tracer.slowest(1)
+        assert top[0]["trace_id"] == slow.trace_id
+
+    def test_debug_payload_shapes(self):
+        tracing.enable(buffer=8)
+        s = tracing.span("http.request")
+        s.end("error")
+        p = tracing.debug_payload({"id": s.trace_id})
+        assert p["enabled"] and p["trace"]["trace_id"] == s.trace_id
+        p = tracing.debug_payload({"slowest": "3"})
+        assert p["enabled"] and len(p["traces"]) >= 1
+        assert p["traces"][0]["status"] == "error"
+        p = tracing.debug_payload({})
+        assert p["traces"][0]["trace_id"] == s.trace_id
+        assert tracing.debug_payload({"id": "deadbeef"})["trace"] is None
+
+
+class TestDisabledIsNoop:
+    def test_noop_rebinding_pinned(self):
+        """THE zero-cost contract (same pin as faults.fire): disabled
+        means `tracing.span` IS the module-level no-op function and
+        every span operation hits the shared no-op singleton."""
+        tracing.disable()
+        assert tracing.span is tracing._noop_span
+        s = tracing.span("anything", parent=None, big_attr="x" * 1000)
+        assert s is tracing.NOOP_SPAN
+        assert not s.recording and s.trace_id is None and s.header() is None
+        s.event("e")
+        s.end("error")
+        with tracing.span("ctx") as c:
+            assert c is tracing.NOOP_SPAN
+        assert tracing.get_trace("anything") is None
+        assert tracing.debug_payload({}) == {"enabled": False, "traces": []}
+
+    def test_children_of_noop_parent_are_noop(self):
+        tracing.enable(buffer=4)
+        child = tracing.span("x", parent=tracing.NOOP_SPAN)
+        assert child is tracing.NOOP_SPAN
+
+    def test_sampling_zero_records_nothing_but_continues_traces(self):
+        tracer = tracing.enable(buffer=4, sample=0.0)
+        assert tracing.span("root") is tracing.NOOP_SPAN
+        # a continued trace was sampled at ITS first edge: always record
+        s = tracing.span("serve.request", trace="deadbeef-12345678")
+        assert s.recording and s.trace_id == "deadbeef"
+        s.end()
+        assert tracer.trace("deadbeef") is not None
+
+    def test_env_kill_switch_in_subprocess(self):
+        code = (
+            "from dstack_tpu.obs import tracing\n"
+            "assert tracing.span is tracing._noop_span\n"
+            "assert not tracing.enabled()\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+            env={"PATH": "/usr/bin:/bin", "DTPU_TRACE": "0"},
+        )
+        assert proc.returncode == 0, proc.stderr
+
+
+class TestImportLight:
+    def test_import_pulls_no_heavy_runtime(self):
+        """obs.tracing must import without aiohttp/jax/numpy (the
+        faults/ + loadgen-generator contract): the lint collector,
+        offline tools, and the CLI enumerate traces without a serving
+        runtime."""
+        code = (
+            "import sys\n"
+            "from dstack_tpu.obs import tracing\n"
+            "t = tracing.enable(buffer=2)\n"
+            "s = tracing.span('x'); s.end()\n"
+            "assert tracing.get_trace(s.trace_id)\n"
+            "bad = [m for m in ('aiohttp', 'jax', 'numpy', 'jaxlib') "
+            "if m in sys.modules]\n"
+            "assert not bad, f'tracing pulled in {bad}'\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+
+class TestHistogramExemplars:
+    def _hist(self):
+        r = Registry()
+        return r.histogram("t_seconds", "test", buckets=(0.1, 1.0))
+
+    def test_exemplar_stored_per_bucket_and_rendered(self):
+        h = self._hist()
+        h.observe(0.05, exemplar="aaa")
+        h.observe(0.5, exemplar="bbb")
+        h.observe(0.06, exemplar="ccc")  # same bucket: latest wins
+        h.observe(5.0)  # no exemplar: bucket stays bare
+        ex = h.exemplars()
+        assert ex[0.1] == (0.06, "ccc")
+        assert ex[1.0] == (0.5, "bbb")
+        assert float("inf") not in ex
+        text = "\n".join(h.render())
+        assert '# {trace_id="ccc"} 0.06' in text
+        assert '# {trace_id="bbb"} 0.5' in text
+        # bucket lines still carry cumulative counts before the suffix
+        assert 't_seconds_bucket{le="0.1"} 2 #' in text
+
+    def test_exemplar_near_quantile(self):
+        h = self._hist()
+        for _ in range(99):
+            h.observe(0.05, exemplar="fast")
+        h.observe(0.5, exemplar="slow")
+        assert h.exemplar_near(0.5) == (0.05, "fast")
+        # p995 falls in the tail bucket: its exemplar explains the tail
+        assert h.exemplar_near(0.995) == (0.5, "slow")
+        assert self._hist().exemplar_near(0.99) is None
+
+    def test_relabel_preserves_exemplar_suffix(self):
+        """The server's relay rewrite must not mistake the exemplar's
+        closing brace for the sample's label block."""
+        from dstack_tpu.server.services.prometheus import _relabel
+
+        line = (
+            'dtpu_serve_ttft_seconds_bucket{le="0.25"} 41 '
+            '# {trace_id="abc"} 0.231'
+        )
+        out = _relabel(line, {"dtpu_run_name": "svc"})
+        assert out == (
+            'dtpu_serve_ttft_seconds_bucket{le="0.25",dtpu_run_name="svc"}'
+            ' 41 # {trace_id="abc"} 0.231'
+        )
+        bare = "dtpu_x_total 3 # {trace_id=\"z\"} 1"
+        out = _relabel(bare, {"dtpu_run_name": "svc"})
+        assert out == 'dtpu_x_total{dtpu_run_name="svc"} 3 # {trace_id="z"} 1'

@@ -94,6 +94,17 @@ class TestGatewayAgent:
             )
             assert r.status == 401
 
+    async def test_debug_traces_token_gated(self, tmp_path):
+        """Same exposure policy as the gateway's /metrics: replica
+        topology in span attrs is deployment metadata."""
+        async with _agent_client(tmp_path) as (client, _):
+            r = await client.get("/debug/traces")
+            assert r.status == 401
+            r = await client.get("/debug/traces", headers=_auth())
+            assert r.status == 200
+            body = await r.json()
+            assert "traces" in body or "trace" in body
+
     async def test_register_and_proxy_path(self, tmp_path):
         async with _agent_client(tmp_path) as (client, _), _upstream() as up:
             await _register_svc(client, model_name="llama-3-8b")
@@ -293,6 +304,12 @@ class TestNginxManager:
         assert "server 10.0.0.2:8000;" in conf
         assert "server_name svc1.gw.example.com;" in conf
         assert "listen 80;" in conf
+        # EVERY proxy-asserted header is blanked (the one shared list
+        # with routing.forward._DROP_REQUEST — tenant, resume, trace)
+        from dstack_tpu.routing.forward import PROXY_ASSERTED_HEADERS
+
+        for header in PROXY_ASSERTED_HEADERS:
+            assert f'proxy_set_header {header} "";' in conf, header
         assert ["nginx", "-s", "reload"] in calls
 
         mgr.remove_service(svc)

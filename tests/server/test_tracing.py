@@ -1,6 +1,10 @@
-"""Tracing middleware: per-route latency histograms surfaced on /metrics
-(parity: reference server/app.py:68-76 sentry gate + :214-226 request
-latency middleware; histograms via the shared obs core)."""
+"""RequestStats middleware: per-route latency histograms surfaced on
+/metrics (parity: reference server/app.py:68-76 sentry gate + :214-226
+request latency middleware; histograms via the shared obs core).
+
+The module lives at ``server/sentry_compat.py``; imports here go
+through the deprecated ``server/tracing.py`` shim ON PURPOSE — the
+shim's continued correctness is part of what this file pins."""
 
 import asyncio
 
@@ -8,14 +12,27 @@ import pytest
 from aiohttp import web
 from aiohttp.test_utils import TestClient, TestServer
 
-from dstack_tpu.server import tracing
+from dstack_tpu.server import sentry_compat
 from dstack_tpu.server.app import create_app
-from dstack_tpu.server.tracing import (
+from dstack_tpu.server.tracing import (  # the deprecation shim
     RequestStats,
     get_request_stats,
     init_sentry,
     tracing_middleware,
 )
+
+
+class TestDeprecationShim:
+    def test_shim_exports_are_the_real_objects(self):
+        """`server.tracing` must stay a pure alias of sentry_compat —
+        a diverging copy would split the middleware's module state."""
+        from dstack_tpu.server import tracing as shim
+
+        assert shim.RequestStats is sentry_compat.RequestStats
+        assert shim.get_request_stats is sentry_compat.get_request_stats
+        assert shim.tracing_middleware is sentry_compat.tracing_middleware
+        assert shim.init_sentry is sentry_compat.init_sentry
+        assert shim.capture_exception is sentry_compat.capture_exception
 
 
 class TestRequestStats:
@@ -79,15 +96,57 @@ class TestMiddlewareE2E:
             assert "dtpu_http_request_duration_seconds_sum" in text
             assert "dtpu_http_request_duration_seconds_count" in text
             assert "/api/server/info" in text
+            # tracing bookkeeping rides the same page
+            assert "dtpu_trace_spans_total" in text
         finally:
             await client.close()
+
+    async def test_root_span_and_debug_traces_endpoint(self):
+        """The middleware opens/closes the server-side root span: the
+        trace id is echoed on the response and resolvable through the
+        server's own /debug/traces."""
+        from dstack_tpu.obs import tracing
+
+        prior = tracing.get_tracer()
+        tracing.enable(buffer=64)
+        app = await create_app(
+            database_url="sqlite://:memory:",
+            admin_token="tr-tok2",
+            with_background=False,
+            local_backend=False,
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get(
+                "/api/server/info",
+                headers={"Authorization": "Bearer tr-tok2"},
+            )
+            assert r.status == 200
+            tid = r.headers.get(tracing.TRACE_HEADER)
+            assert tid, "middleware did not echo the root trace id"
+            r = await client.get(f"/debug/traces?id={tid}")
+            assert r.status == 200
+            payload = await r.json()
+            spans = payload["trace"]["spans"]
+            root = next(s for s in spans if s["name"] == "http.request")
+            assert root["attrs"]["route"] == "/api/server/info"
+            assert root["attrs"]["http_status"] == 200
+            assert root["status"] == "ok"
+        finally:
+            await client.close()
+            if prior is not None:
+                tracing._tracer = prior
+                tracing.span = prior.span
+            else:
+                tracing.disable()
 
     async def test_client_disconnect_recorded_as_499(self, monkeypatch):
         """A handler cancelled by client disconnect must be recorded
         under the 499 sentinel status, not 500 (and not crash the
         middleware)."""
         fresh = RequestStats()
-        monkeypatch.setattr(tracing, "_stats", fresh)
+        monkeypatch.setattr(sentry_compat, "_stats", fresh)
 
         async def cancelled_handler(request):
             raise asyncio.CancelledError()

@@ -213,6 +213,9 @@ def test_dtpu004_docs_collector_sees_all_layers():
     assert "dtpu_runs" in names
     assert "dtpu_serve_ttft_seconds" in names
     assert "dtpu_train_step_seconds" in names
+    # distributed-tracing bookkeeping (obs/tracing.py's registry)
+    assert "dtpu_trace_spans_total" in names
+    assert "dtpu_trace_traces_evicted_total" in names
 
 
 # ---------------------------------------------------------------------------
@@ -677,3 +680,85 @@ def test_scope_glob_matches_top_level_package_modules():
     assert glob_match("dstack_tpu/a/b/c.py", "dstack_tpu/**/*.py")
     assert not glob_match("tests/x.py", "dstack_tpu/**/*.py")
     assert not glob_match("dstack_tpu/ops/x.py", "dstack_tpu/ops.py")
+
+
+class TestSpanNameRule:
+    """DTPU004's span-name half: names passed to tracing.span() must be
+    string literals (bounded cardinality, like metric label values)."""
+
+    def _check(self, src):
+        from tools.dtpu_lint.rules.metric_hygiene import (
+            check_span_name_source,
+        )
+
+        return check_span_name_source(src)
+
+    def test_literal_name_ok(self):
+        assert self._check(
+            "from dstack_tpu.obs import tracing\n"
+            "s = tracing.span('router.dispatch', replica=rid)\n"
+        ) == []
+
+    def test_fstring_name_flagged(self):
+        fs = self._check(
+            "from dstack_tpu.obs import tracing\n"
+            "s = tracing.span(f'leg-{rid}')\n"
+        )
+        assert len(fs) == 1 and fs[0].rule == "DTPU004"
+
+    def test_variable_name_flagged(self):
+        fs = self._check(
+            "from dstack_tpu.obs import tracing\n"
+            "def f(name):\n"
+            "    return tracing.span(name)\n"
+        )
+        assert len(fs) == 1
+
+    def test_aliased_tracing_module_covered(self):
+        fs = self._check(
+            "from dstack_tpu.obs import tracing as obs_tracing\n"
+            "s = obs_tracing.span(n)\n"
+        )
+        assert len(fs) == 1
+
+    def test_bare_span_import_covered(self):
+        fs = self._check(
+            "from dstack_tpu.obs.tracing import span\n"
+            "s = span(f'leg-{rid}')\n"
+            "ok = span('router.dispatch')\n"
+        )
+        assert len(fs) == 1
+
+    def test_aliased_bare_span_import_covered(self):
+        fs = self._check(
+            "from dstack_tpu.obs.tracing import span as mkspan\n"
+            "s = mkspan(name)\n"
+        )
+        assert len(fs) == 1
+
+    def test_unrelated_bare_span_name_ignored(self):
+        # a local helper named span with no tracing import is not ours
+        assert self._check(
+            "def span(a, b):\n"
+            "    return b - a\n"
+            "x = span(lo, hi)\n"
+        ) == []
+
+    def test_unrelated_span_attribute_ignored(self):
+        # Tracer.span / arbitrary .span methods on non-tracing names
+        # are out of scope (the module-level factory is the API)
+        assert self._check(
+            "s = self.span(name)\n"
+            "t = builder.span(n)\n"
+        ) == []
+
+    def test_live_repo_span_names_are_literal(self):
+        from tools.dtpu_lint.core import run_lint
+
+        findings = [
+            f for f in run_lint(REPO, rule_ids=["DTPU004"])
+            if "span name" in f.message
+        ]
+        assert findings == [], [
+            f"{f.path}:{f.line} {f.message}" for f in findings
+        ]

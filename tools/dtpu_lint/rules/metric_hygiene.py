@@ -20,6 +20,13 @@ registry's cardinality cap turns that into a silent ``<truncated>``
 collapse instead of an OOM, but the series is still garbage. Bare
 names are allowed (typically a loop over a bounded state dict); the
 rule catches the *construction* of unbounded values at the call site.
+
+**Span-name hygiene** (same FileRule): the name passed to
+``tracing.span(...)`` (``dstack_tpu.obs.tracing``) must be a string
+LITERAL — span names are bounded-cardinality identifiers exactly like
+metric label names; a request-derived name would flood every grouping
+consumer of ``/debug/traces``. Span *attrs* are free-form (and
+truncated by the tracer).
 """
 
 import ast
@@ -79,14 +86,63 @@ def check_label_source(src: str, relpath: str = "<string>") -> list:
     return findings
 
 
+def check_span_name_source(src: str, relpath: str = "<string>") -> list:
+    """→ Findings for non-literal span names in one file. Matches
+    ``<x>tracing.span(...)`` attribute calls (the module-level factory
+    under any alias ending in ``tracing``) AND bare calls through a
+    ``from dstack_tpu.obs.tracing import span [as alias]`` binding;
+    ``Tracer.span``'s own definition and no-op rebinding are
+    declarations, not calls."""
+    tree = ast.parse(src, filename=relpath)
+    # names the span factory was imported under directly
+    span_aliases: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and (
+            node.module or ""
+        ).endswith("tracing"):
+            for a in node.names:
+                if a.name == "span":
+                    span_aliases.add(a.asname or a.name)
+    findings: list = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        is_factory = (
+            isinstance(f, ast.Attribute)
+            and f.attr == "span"
+            and isinstance(f.value, ast.Name)
+            and f.value.id.endswith("tracing")
+        ) or (isinstance(f, ast.Name) and f.id in span_aliases)
+        if not is_factory or not node.args:
+            continue
+        name = node.args[0]
+        if isinstance(name, ast.Constant) and isinstance(name.value, str):
+            continue
+        findings.append(
+            Finding(
+                "DTPU004",
+                relpath,
+                node.lineno,
+                "span name passed to tracing.span() must be a string "
+                "literal: span names are bounded-cardinality "
+                "identifiers, same rationale as metric label values "
+                "(put request-derived context in span attrs instead)",
+            )
+        )
+    return findings
+
+
 @register
 class MetricLabelRule(FileRule):
     id = "DTPU004"
-    name = "metric hygiene (bounded label values)"
+    name = "metric hygiene (bounded label values + literal span names)"
     scope = ("dstack_tpu/**/*.py",)
 
     def check(self, tree, src, relpath, repo):
-        return check_label_source(src, relpath)
+        return check_label_source(src, relpath) + check_span_name_source(
+            src, relpath
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -100,11 +156,12 @@ def collect_metric_names(repo: Path) -> set:
         sys.path.insert(0, str(repo))
     names: set = set()
     from dstack_tpu.loadgen.metrics import new_loadgen_registry
+    from dstack_tpu.obs.tracing import new_trace_registry
     from dstack_tpu.qos.metrics import new_qos_registry
     from dstack_tpu.routing.metrics import new_router_registry
     from dstack_tpu.serve.metrics import new_serve_registry
     from dstack_tpu.server.services.wakeups import new_reconcile_registry
-    from dstack_tpu.server.tracing import RequestStats
+    from dstack_tpu.server.sentry_compat import RequestStats
     from dstack_tpu.utils.retry import new_retry_registry
 
     names.update(RequestStats().registry.metric_names())
@@ -114,6 +171,7 @@ def collect_metric_names(repo: Path) -> set:
     names.update(new_qos_registry().metric_names())
     names.update(new_reconcile_registry().metric_names())
     names.update(new_loadgen_registry().metric_names())
+    names.update(new_trace_registry().metric_names())
     try:
         from dstack_tpu.train.step import new_train_registry
 
