@@ -91,6 +91,13 @@ class APIClient:
             q = ""
         return self._get("/debug/traces" + q)
 
+    # live SLO engine (obs.slo; docs/reference/server.md)
+    def get_slo(self) -> dict:
+        """``GET /api/slo`` — per-scope burn rates, error budget
+        remaining, and alert state machines from the server's live SLO
+        engine."""
+        return self._get("/api/slo")
+
     # users
     def get_my_user(self) -> User:
         return User.model_validate(self._post("/api/users/get_my_user"))

@@ -885,6 +885,106 @@ def trace(trace_id, slowest, url, project) -> None:
         )
 
 
+def render_slo_tables(payload: dict) -> list:
+    """``GET /api/slo`` payload → rich tables (separate from the
+    command so tests can assert the rendering without a server):
+    a burn-rate table (one row per scope × objective, one column per
+    window, budget remaining last) and an alerts table."""
+    windows = list(payload.get("windows_s") or {})
+    burn = Table(title="error-budget burn (1.0 = budget-rate)")
+    burn.add_column("SCOPE")
+    burn.add_column("OBJECTIVE")
+    for w in windows:
+        burn.add_column(w, justify="right")
+    burn.add_column("BUDGET LEFT", justify="right")
+    for scope in payload.get("scopes", []):
+        label = scope["scope"] + (
+            f"#{scope['replica']}" if scope.get("replica") else ""
+        )
+        for oid, entry in sorted((scope.get("objectives") or {}).items()):
+            burns = entry.get("burn") or {}
+            remaining = entry.get("budget_remaining")
+            burn.add_row(
+                label,
+                oid,
+                *(
+                    f"{burns[w]:.2f}x" if w in burns else "-"
+                    for w in windows
+                ),
+                f"{remaining * 100:.1f}%" if remaining is not None else "-",
+            )
+    alerts = Table(title="alerts")
+    for col in ("SCOPE", "OBJECTIVE", "SEVERITY", "STATE", "BURN"):
+        alerts.add_column(col)
+    for a in payload.get("alerts", []):
+        label = a["scope"] + (f"#{a['replica']}" if a.get("replica") else "")
+        state = a.get("state", "")
+        if state == "firing":
+            state = f"[red]{state}[/red]"
+        alerts.add_row(
+            label, a.get("objective", ""), a.get("severity", ""),
+            state, f"{a.get('burn', 0):.1f}x",
+        )
+    return [burn, alerts]
+
+
+def _print_slo(payload: dict) -> None:
+    if not payload.get("enabled", True):
+        _die("the live SLO engine is disabled on the server (DTPU_SLO=0)")
+    policy = payload.get("policy") or {}
+    console.print(
+        f"policy [bold]{policy.get('name', '?')}[/bold] · "
+        f"fast {policy.get('fast_burn', {}).get('factor', '?')}x over "
+        f"{'+'.join(policy.get('fast_burn', {}).get('windows', []))} · "
+        f"slow {policy.get('slow_burn', {}).get('factor', '?')}x over "
+        f"{'+'.join(policy.get('slow_burn', {}).get('windows', []))}"
+    )
+    for t in render_slo_tables(payload):
+        console.print(t)
+    if not payload.get("scopes"):
+        console.print(
+            "no scopes with a verdict yet (no traffic in any window, "
+            "or the process_slo loop has not ticked)"
+        )
+
+
+@cli.command()
+@click.argument("action", required=False, type=click.Choice(["watch"]))
+@click.option(
+    "--interval", type=float, default=5.0,
+    help="refresh seconds for `dtpu slo watch`",
+)
+@click.option("--project", default=None)
+def slo(action, interval, project) -> None:
+    """Live SLO engine state (GET /api/slo): per-scope error-budget
+    burn rates by sliding window, budget remaining, and burn-rate
+    alerts (pending/firing). `dtpu slo watch` re-renders every
+    --interval seconds until interrupted."""
+    client = _client(project)
+    if action != "watch":
+        try:
+            payload = client.api.get_slo()
+        except DstackTPUError as e:
+            _die(str(e))
+        _print_slo(payload)
+        return
+    try:
+        while True:
+            # a watch must SURVIVE transient fetch errors — a server
+            # restart mid-incident is exactly when continuous SLO
+            # visibility matters; report and retry next interval
+            try:
+                payload = client.api.get_slo()
+            except DstackTPUError as e:
+                console.print(f"[red]fetch failed:[/red] {e} (retrying)")
+            else:
+                console.clear()
+                _print_slo(payload)
+            time.sleep(max(0.5, interval))
+    except KeyboardInterrupt:
+        pass
+
+
 @cli.command()
 @click.option("--tpu", "tpu_spec", default=None, help="e.g. v5e-8 or v5p")
 @click.option("--spot/--on-demand", default=None)

@@ -99,9 +99,13 @@ class ScalingSpec(CoreModel):
     (metric ``rps``, consumed by RPSAutoscaler, services/autoscalers.py:60).
     ``queue-depth`` selects the QueueDepthAutoscaler: ``target`` is then
     the tolerated probed queue depth per replica, with RPS as fallback.
+    ``slo-burn`` selects the SLOBurnAutoscaler: ``target`` is then the
+    tolerated error-budget burn rate over the policy's fast windows
+    (1.0 = consume budget exactly as fast as allowed), with RPS as
+    fallback when the live SLO engine has no verdict.
     """
 
-    metric: Literal["rps", "queue-depth"] = "rps"
+    metric: Literal["rps", "queue-depth", "slo-burn"] = "rps"
     target: float = 10.0
     scale_up_delay: Duration = 300
     scale_down_delay: Duration = 600

@@ -75,8 +75,10 @@ POINTS: dict = {
         "slot=<index>; runs on the worker thread — sync actions only. "
         "'hang' with a ctx slot wedges exactly that slot's step, the "
         "shape the serve scheduler's engine watchdog "
-        "(DTPU_ENGINE_WATCHDOG_SECONDS) attributes and aborts",
-        ("slot",),
+        "(DTPU_ENGINE_WATCHDOG_SECONDS) attributes and aborts. "
+        "Multi-replica-in-one-process harnesses add replica=<id> via "
+        "engine.fault_ctx so a rule can target one engine",
+        ("slot", "replica"),
     ),
     "serve.stream": (
         "one relayed upstream chunk of a resumable SSE completion "

@@ -35,6 +35,7 @@ import-light generator path (``__main__`` imports it directly).
 import asyncio
 import json
 import socket
+import time
 from dataclasses import dataclass
 from typing import List, Optional
 
@@ -53,6 +54,8 @@ _ROUTER_FAMILIES = (
     "dtpu_router_exhausted_total",
     "dtpu_router_affinity_hits_total",
     "dtpu_router_affinity_overrides_total",
+    "dtpu_router_slo_degraded_total",
+    "dtpu_router_slo_restored_total",
 )
 
 
@@ -76,6 +79,21 @@ class SoakConfig:
     drain_end_frac: float = 0.40
     kill_frac: float = 0.60
     kill_window_s: float = 8.0  # scored amplification window after kill
+    # extra fault rules merged into the plan AT kill time (rule
+    # counters restart with the new plan, so nth counts from the kill)
+    # — the SLO chaos acceptance injects bounded serve.engine.step
+    # errors on a SURVIVOR here: clients ride the resume path, the
+    # replica's own error counter burns its SLO
+    kill_extra_rules: Optional[list] = None
+    # live SLO engine over the soak's own pool (obs/slo.py): a policy
+    # dict turns it on — per-replica windows are ingested from the
+    # probe loop's /health captures, burn alerts evaluated every
+    # slo_tick_s, per-replica fast-burn firing pins the replica
+    # DEGRADED exactly like the server's process_slo, and the artifact
+    # gains an `slo` block with the transition timeline
+    slo_policy: Optional[dict] = None
+    slo_windows: Optional[dict] = None  # window name -> seconds (as-is)
+    slo_tick_s: float = 0.5
     drain_s: float = 30.0  # driver straggler budget past the last event
     output: Optional[str] = "SOAK_r01.json"
 
@@ -221,7 +239,29 @@ async def _drain_flip(pool, rid: str, start: float, end: float):
     logger.warning("soak chaos: replica %s drain cancelled", rid)
 
 
-async def _kill_replica(replica: _Replica, seed: int, at: float):
+async def _slo_loop(engine, pool, scope: str, interval: float):
+    """The soak's in-process analogue of the server's process_slo
+    loop — ingest, evaluate, pin — via the SAME obs.slo helpers the
+    server uses, so the chaos acceptance exercises the production
+    contract, not a reimplementation."""
+    from dstack_tpu.obs import slo as obs_slo
+
+    while True:
+        obs_slo.ingest_pool_windows(engine, pool, scope)
+        transitions = engine.evaluate()
+        obs_slo.apply_replica_pins(pool, transitions, scope=scope)
+        for tr in transitions:
+            logger.warning(
+                "soak slo_alert %s: %s %s%s burn=%.1fx",
+                tr.state, tr.severity, tr.objective,
+                f" replica={tr.replica}" if tr.replica else "", tr.burn,
+            )
+        await asyncio.sleep(interval)
+
+
+async def _kill_replica(
+    replica: _Replica, seed: int, at: float, extra_rules=None
+):
     """The mid-soak death: merge a ``serve.stream`` connect-error rule
     for this replica into the active fault plan (the deterministic
     kill of every in-flight stream — the forwarder resumes them
@@ -243,6 +283,8 @@ async def _kill_replica(replica: _Replica, seed: int, at: float):
         "action": "raise",
         "error": "connect",
     })
+    if extra_rules:
+        rules.extend(extra_rules)
     faults.install_plan({"seed": seed, "rules": rules})
     await replica.site.stop()
     if replica.runner.server is not None:
@@ -341,6 +383,10 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
                 config, params, max_batch=cfg.max_batch,
                 max_seq=cfg.max_seq, prefill_chunk=cfg.prefill_chunk,
             )
+            # both engines share this process's fault plan: the replica
+            # ctx lets a chaos rule target ONE of them (e.g. bounded
+            # serve.engine.step errors on a survivor)
+            engine.fault_ctx = {"replica": f"r{i}"}
             replicas.append(
                 await _start_replica(f"r{i}", engine, cfg.model, policy)
             )
@@ -357,6 +403,23 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
         probe_task = asyncio.ensure_future(
             _probe_loop(pool, cfg.probe_interval_s)
         )
+        slo_engine = None
+        if cfg.slo_policy is not None:
+            from dstack_tpu.obs import slo as obs_slo
+
+            if obs_slo.enabled():
+                # scale=None: windows and hold-downs ride
+                # DTPU_BG_TICK_SCALE exactly like the replicas' own
+                # aggregators, so both sides window the same spans
+                slo_engine = obs_slo.SLOEngine(
+                    policy=obs_slo.policy_from_dict(cfg.slo_policy),
+                    windows=cfg.slo_windows,
+                    registry=obs_slo.new_slo_registry(),  # per-soak
+                )
+                slo_task = asyncio.ensure_future(_slo_loop(
+                    slo_engine, pool, "soak/loadgen", cfg.slo_tick_s
+                ))
+                chaos_tasks.append(slo_task)
         await _warmup(replicas, cfg.model, ascii_bias)
 
         windows: List[EventWindow] = []
@@ -371,7 +434,10 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
                 _drain_flip(pool, drain_rid, d0, d1)
             ))
             chaos_tasks.append(asyncio.ensure_future(
-                _kill_replica(replicas[kill_ix], seed, kill_at)
+                _kill_replica(
+                    replicas[kill_ix], seed, kill_at,
+                    extra_rules=cfg.kill_extra_rules,
+                )
             ))
             windows = [
                 EventWindow("drain", d0, d1),
@@ -397,6 +463,10 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
             registry=new_loadgen_registry(),
         )
         r0 = _snapshot(get_router_registry(), _ROUTER_FAMILIES)
+        # schedule-time anchor for the live SLO transition timeline
+        # (the chaos tasks anchored their sleeps moments earlier; the
+        # skew is milliseconds against seconds-scale windows)
+        soak_t0 = time.monotonic()
         records = await driver.run(schedule.events)
         router_delta = {
             k: int(v - r0[k])
@@ -474,6 +544,23 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
         ),
         "backend": info["backend"],
         "note": info["note"],
+        "slo": (
+            {
+                "policy": slo_engine.policy.name,
+                "windows_s": {
+                    k: round(v, 3) for k, v in slo_engine.windows.items()
+                },
+                # schedule-relative timestamps, matching the report's
+                # tail-amplification windows — live and offline views
+                # of the same soak line up by construction
+                "transitions": [
+                    {**tr.to_dict(), "t": round(tr.t - soak_t0, 3)}
+                    for tr in slo_engine.transitions
+                ],
+            }
+            if slo_engine is not None
+            else None
+        ),
         "router": router_delta,
         "spec": spec.to_dict(),
         # the dtpu_loadgen_* families' Prometheus text, embedded so

@@ -37,6 +37,15 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from dstack_tpu.loadgen.textgen import bounds_pair
+# the one SLO-target schema: per-class ttft_slo_ms/tpot_slo_ms
+# defaults and validation live in obs/slo.py (stdlib-only, so this
+# module stays import-light) — the live burn engine's SLOPolicy
+# classes and these tenant classes cannot drift
+from dstack_tpu.obs.slo import (
+    DEFAULT_TPOT_SLO_MS,
+    DEFAULT_TTFT_SLO_MS,
+    validate_slo_target_fields,
+)
 
 _KINDS = ("chat", "completion")
 _PROCESSES = ("poisson", "diurnal")
@@ -71,8 +80,8 @@ class TenantClass:
     share: float = 1.0  # relative weight of the arrival mix
     tenants: int = 2  # distinct tenant identities in this class
     priority: str = "standard"  # serve-edge priority class
-    ttft_slo_ms: float = 2000.0
-    tpot_slo_ms: float = 500.0
+    ttft_slo_ms: float = DEFAULT_TTFT_SLO_MS
+    tpot_slo_ms: float = DEFAULT_TPOT_SLO_MS
     stream: bool = True
     temperature: float = 0.0  # 0 = greedy (resumable mid-stream)
     seeded: bool = False  # temperature > 0 with a per-request seed
@@ -199,12 +208,14 @@ def validate_spec(data) -> List[str]:
         turns = c.get("turns", 3)
         if kind == "chat" and (not isinstance(turns, int) or turns < 1):
             errors.append(f"{where}: turns must be an int >= 1")
-        for key in ("ttft_slo_ms", "tpot_slo_ms", "think_time_s"):
-            v = c.get(key)
-            if v is not None and (
-                not isinstance(v, (int, float)) or v <= 0
-            ):
-                errors.append(f"{where}: {key} must be positive, got {v!r}")
+        # shared SLO-target validation (obs/slo.py: the same checker
+        # SLOPolicy classes run through)
+        errors.extend(validate_slo_target_fields(c, where))
+        v = c.get("think_time_s")
+        if v is not None and (not isinstance(v, (int, float)) or v <= 0):
+            errors.append(
+                f"{where}: think_time_s must be positive, got {v!r}"
+            )
         for key in ("max_tokens", "turn_chars", "prompt_chars"):
             v = c.get(key)
             if v is None or isinstance(v, int):
@@ -246,8 +257,8 @@ def spec_from_dict(data: dict) -> WorkloadSpec:
                 share=float(c.get("share", 1.0)),
                 tenants=int(c.get("tenants", 2)),
                 priority=c.get("priority", "standard"),
-                ttft_slo_ms=float(c.get("ttft_slo_ms", 2000.0)),
-                tpot_slo_ms=float(c.get("tpot_slo_ms", 500.0)),
+                ttft_slo_ms=float(c.get("ttft_slo_ms", DEFAULT_TTFT_SLO_MS)),
+                tpot_slo_ms=float(c.get("tpot_slo_ms", DEFAULT_TPOT_SLO_MS)),
                 stream=bool(c.get("stream", True)),
                 temperature=float(c.get("temperature", 0.0)),
                 seeded=bool(c.get("seeded", False)),

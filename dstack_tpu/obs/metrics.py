@@ -115,6 +115,26 @@ class _Family:
         with self._lock:
             self._series.clear()
 
+    def remove(self, *labels) -> bool:
+        """Drop one label combination's series (True when it existed).
+        Lifecycle-scoped exporters (the SLO engine's per-scope burn
+        gauges) remove series when their subject is garbage-collected,
+        so label churn cannot fill the cardinality cap with stale
+        values."""
+        with self._lock:
+            return (
+                self._series.pop(tuple(str(v) for v in labels), None)
+                is not None
+            )
+
+    def items(self) -> list:
+        """Thread-safe ``[(labels tuple, value)]`` snapshot — what the
+        SLO engine's signal collectors read (e.g. summing the 5xx
+        subset of a status-labeled counter). Histogram values are the
+        internal series dicts; scalar families yield floats."""
+        with self._lock:
+            return list(self._series.items())
+
 
 class Counter(_Family):
     kind = "counter"
@@ -127,6 +147,12 @@ class Counter(_Family):
     def value(self, *labels) -> float:
         with self._lock:
             return self._series.get(tuple(str(v) for v in labels), 0.0)
+
+    def total(self) -> float:
+        """Sum across every label combination (windowed-rate sources
+        aggregate per scope, not per label)."""
+        with self._lock:
+            return float(sum(self._series.values()))
 
     def render(self) -> list:
         with self._lock:
@@ -228,6 +254,21 @@ class Histogram(_Family):
         with self._lock:
             s = self._get(labels)
             return s["count"] if s else 0
+
+    def totals(self):
+        """``(per-bucket counts incl. +Inf, sum, count)`` summed
+        element-wise across every label combination — the cumulative
+        snapshot the SLO engine's sliding windows delta against."""
+        with self._lock:
+            counts = [0.0] * (len(self.buckets) + 1)
+            total_sum = 0.0
+            total_count = 0.0
+            for s in self._series.values():
+                for i, c in enumerate(s["counts"]):
+                    counts[i] += c
+                total_sum += s["sum"]
+                total_count += s["count"]
+            return counts, total_sum, total_count
 
     def exemplars(self, *labels) -> dict:
         """{bucket upper bound (float, or ``float("inf")``): (value,

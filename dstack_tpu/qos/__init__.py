@@ -501,6 +501,12 @@ def _count_edge(
         m.family("dtpu_qos_admitted_total").inc(count, tenant)
     else:
         m.family("dtpu_qos_shed_total").inc(1, tenant)
+        if retry_after < 1:
+            # structurally unreachable under the DTPU007 contract
+            # (every shed computes a hint >= 1) — counted anyway so the
+            # SLO engine's shed_honesty objective watches the invariant
+            # instead of assuming it
+            m.family("dtpu_qos_shed_unhinted_total").inc(1)
     if project or run_name:
         record_edge(
             project, run_name, admitted, retry_after=retry_after, tenant=tenant,

@@ -41,6 +41,13 @@ def new_qos_registry() -> Registry:
         max_series=TENANT_SERIES_CAP,
     )
     r.counter(
+        "dtpu_qos_shed_unhinted_total",
+        "Sheds recorded without a Retry-After hint — structurally "
+        "zero under the DTPU007 contract; any count means the shed "
+        "contract itself broke (the SLO engine's shed_honesty "
+        "objective burns on this)",
+    )
+    r.counter(
         "dtpu_qos_inflight_deferred_total",
         "Requests that waited at least once at their tenant's in-flight "
         "slot cap (counted once per request; the request stays queued, "

@@ -72,6 +72,18 @@ def new_router_registry() -> Registry:
         "failures)",
     )
     r.counter(
+        "dtpu_router_slo_degraded_total",
+        "Replicas pinned DEGRADED by a firing per-replica SLO "
+        "fast-burn alert (the soft-failure analogue of a breaker "
+        "open: the replica stays routable as a last resort while it "
+        "violates its service-level targets)",
+    )
+    r.counter(
+        "dtpu_router_slo_restored_total",
+        "SLO-degraded pins released after the per-replica fast-burn "
+        "alert resolved (the replica re-enters normal rotation)",
+    )
+    r.counter(
         "dtpu_router_probe_failures_total",
         "Health probes that failed (connect error, timeout, or 5xx)",
     )

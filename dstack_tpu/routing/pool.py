@@ -103,6 +103,10 @@ class ReplicaEntry:
     probe: dict = field(default_factory=dict)  # last /health payload
     drain_deadline_at: float = 0.0
     drained_counted: bool = False  # dtpu_router_drained_total fired once
+    # a firing per-replica SLO fast-burn alert pins the replica
+    # DEGRADED (last-resort target) until the alert resolves — the
+    # soft-failure analogue of the breaker (obs/slo.py, process_slo)
+    slo_degraded: bool = False
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -350,8 +354,13 @@ class ReplicaPool:
         if entry.state in (ReplicaState.STARTING, ReplicaState.DEAD):
             # request successes promote; DEGRADED only clears via a
             # probe (one cheap request succeeding says nothing about
-            # the queue that made it degraded)
-            entry.state = ReplicaState.READY
+            # the queue that made it degraded) — and never past a
+            # pinned SLO alert
+            entry.state = (
+                ReplicaState.DEGRADED
+                if entry.slo_degraded
+                else ReplicaState.READY
+            )
 
     def report_failure(self, entry: ReplicaEntry) -> None:
         entry.consecutive_failures += 1
@@ -428,6 +437,49 @@ class ReplicaPool:
             replica_id, self.project, self.run_name,
         )
         return True
+
+    def set_slo_degraded(self, replica_id: str, degraded: bool) -> bool:
+        """Pin (or release) a replica's DEGRADED state from a firing
+        per-replica SLO fast-burn alert (process_slo / the soak's live
+        engine). While pinned, probes keep the replica DEGRADED even
+        when its queue/KV look healthy — it violated its service-level
+        targets, so it serves only as a last-resort target. Releasing
+        restores READY immediately unless the probe data itself says
+        overloaded; the next probe reclassifies either way. True when
+        the flag actually changed."""
+        e = self.entries.get(str(replica_id))
+        if e is None or e.slo_degraded == degraded:
+            return False
+        e.slo_degraded = degraded
+        m = get_router_registry()
+        if degraded:
+            if e.state == ReplicaState.READY:
+                e.state = ReplicaState.DEGRADED
+            m.family("dtpu_router_slo_degraded_total").inc(1)
+            logger.warning(
+                "replica %s of %s/%s marked DEGRADED by a firing SLO "
+                "fast-burn alert",
+                replica_id, self.project, self.run_name,
+            )
+        else:
+            if e.state == ReplicaState.DEGRADED and not self._overloaded(e):
+                e.state = ReplicaState.READY
+            m.family("dtpu_router_slo_restored_total").inc(1)
+            logger.info(
+                "replica %s of %s/%s SLO alert resolved; restored",
+                replica_id, self.project, self.run_name,
+            )
+        return True
+
+    def _overloaded(self, entry: ReplicaEntry) -> bool:
+        """The probe-data overload predicate behind READY↔DEGRADED,
+        OR-ed with the SLO pin (one definition for both the probe path
+        and the pin-release path)."""
+        return (
+            entry.slo_degraded
+            or entry.queue_depth() >= self.config.degraded_queue_depth
+            or entry.kv_utilization() >= self.config.degraded_kv_util
+        )
 
     def is_draining(self, replica_id: str) -> bool:
         e = self.entries.get(str(replica_id))
@@ -524,7 +576,13 @@ class ReplicaPool:
                       # affinity score treats a fresh prefix_slots=0
                       # as proof the mapped KV is gone
                       "prefix_hits", "prefix_slots", "prefix_occupancy",
-                      "prefix_tokens")
+                      "prefix_tokens",
+                      # the replica's rolling SLO window summaries
+                      # (obs/slo.py ReplicaSLO): TTFT/queue-wait/TPOT
+                      # bucket deltas + request/error/shed counts per
+                      # window, consumed by process_slo — the probe IS
+                      # the transport, no new scrape protocol
+                      "slo_windows")
         }
         entry.last_probe_at = time.monotonic()
         self.report_success(entry)
@@ -539,12 +597,13 @@ class ReplicaPool:
             # and forgot) must rejoin rotation, not stay blackholed
             self.cancel_draining(entry.replica_id)
         if entry.state in (ReplicaState.READY, ReplicaState.DEGRADED):
-            overloaded = (
-                entry.queue_depth() >= self.config.degraded_queue_depth
-                or entry.kv_utilization() >= self.config.degraded_kv_util
-            )
+            # probe-data overload OR a pinned SLO fast-burn alert
+            # (the pin outlives healthy-looking probes until the alert
+            # resolves — soft failures don't show in queue depth)
             entry.state = (
-                ReplicaState.DEGRADED if overloaded else ReplicaState.READY
+                ReplicaState.DEGRADED
+                if self._overloaded(entry)
+                else ReplicaState.READY
             )
         return True
 

@@ -1918,6 +1918,11 @@ class InferenceEngine:
         # can never corrupt slot state the scheduler has since reused.
         self._step_epoch = 0
         self._step_wedge: Optional[tuple] = None  # ("slot", i) | ("dispatch",)
+        # extra context merged into every serve.engine.step fire —
+        # multi-replica-in-one-process harnesses set e.g.
+        # {"replica": "r1"} so a chaos rule can target ONE engine
+        # (production runs one engine per process and leaves it empty)
+        self.fault_ctx: dict = {}
 
     def free_slots(self) -> list[int]:
         return [
@@ -2337,7 +2342,7 @@ class InferenceEngine:
             if not self.active[i]:
                 continue
             self._step_wedge = ("slot", i)
-            faults.fire("serve.engine.step", slot=i)
+            faults.fire("serve.engine.step", slot=i, **self.fault_ctx)
             if epoch != self._step_epoch:
                 # the watchdog abandoned this step while it was wedged
                 # here; slot state may have been reused since — return

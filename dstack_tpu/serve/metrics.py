@@ -92,6 +92,16 @@ def new_serve_registry() -> Registry:
         "dtpu_serve_kv_cache_utilization_ratio",
         "Cached tokens across live slots / (max_batch * max_seq)",
     )
+    r.counter(
+        "dtpu_serve_request_errors_total",
+        "Requests this replica failed server-side (engine/prefill/"
+        "admission errors, watchdog aborts, deadline expiries) — "
+        "behind the router these streams fail over or resume, so "
+        "clients may see none of them; the live SLO engine's "
+        "error-rate objective burns on this, which is exactly how a "
+        "soft-failing replica gets caught before its breaker would "
+        "(obs/slo.py). Honest 503 sheds are NOT counted",
+    )
     # request lifecycle hardening: deadlines, watchdog, stream resume
     r.counter(
         "dtpu_serve_deadline_expired_total",

@@ -53,6 +53,7 @@ from typing import Optional
 from aiohttp import web
 
 from dstack_tpu import faults, qos
+from dstack_tpu.obs import slo as obs_slo
 from dstack_tpu.obs import tracing
 from dstack_tpu.obs.tracing import get_trace_registry
 from dstack_tpu.proxy.model_tgi import DEFAULT_CHAT_TEMPLATE, render_chat
@@ -190,6 +191,17 @@ class Scheduler:
                 self.engine.release(slot)
                 del self.by_prefill[slot]
 
+    def _count_error(self, req: _Request) -> None:
+        """One server-side request failure (engine/prefill/admission
+        error, watchdog abort, deadline expiry) into
+        ``dtpu_serve_request_errors_total`` — the live SLO engine's
+        error-rate signal. Honest overload sheds (the wedge-quiesce
+        503, which carries Retry-After per DTPU007) are not failures
+        and are not counted."""
+        self.engine.metrics.family(
+            "dtpu_serve_request_errors_total"
+        ).inc(1)
+
     def _refund_unstarted(self, req: _Request) -> None:
         """Return the admission charge of a request that dies before
         delivering its first token (disconnect, deadline expiry,
@@ -244,6 +256,7 @@ class Scheduler:
         self.engine.metrics.family(
             "dtpu_serve_deadline_expired_total"
         ).inc(1)
+        self._count_error(req)
         self._refund_unstarted(req)
         # terminating trace event: the deadline sweep, not the engine,
         # ended this request — a trace of the 504 says so explicitly
@@ -298,6 +311,7 @@ class Scheduler:
                 len(self.by_slot) + len(self.by_prefill),
             )
             if req is not None:
+                self._count_error(req)
                 self._refund_unstarted(req)
                 req.span.event("watchdog_abort", slot=slot)
                 req.phase.end("error")
@@ -319,6 +333,7 @@ class Scheduler:
         for table in (self.by_slot, self.by_prefill):
             for slot, req in list(table.items()):
                 self.engine.release(slot)
+                self._count_error(req)
                 self._refund_unstarted(req)
                 req.span.event("watchdog_abort", attributable=False)
                 req.phase.end("error")
@@ -366,6 +381,7 @@ class Scheduler:
                 logger.exception("scheduler tick failed: %s", e)
                 for slot, req in list(self.by_slot.items()):
                     self.engine.release(slot)
+                    self._count_error(req)
                     self._refund_unstarted(req)
                     req.phase.end("error")
                     req.error = str(e)
@@ -484,6 +500,7 @@ class Scheduler:
                 slot = self.engine.start_request(req.prompt_ids, req.gen)
             except Exception as e:  # noqa: BLE001 - reported per request
                 logger.exception("admission failed: %s", e)
+                self._count_error(req)
                 self._refund_unstarted(req)
                 req.phase.end("error")
                 req.error = str(e)
@@ -536,6 +553,7 @@ class Scheduler:
                     if req is None:
                         continue
                     self.engine.release(slot)
+                    self._count_error(req)
                     self._refund_unstarted(req)
                     req.phase.end("error")
                     req.error = str(e)
@@ -892,6 +910,17 @@ def build_app(
         watchdog_seconds=watchdog_seconds,
     )
     app["scheduler"] = sched
+    # live SLO windows over THIS replica's own registries (obs/slo.py;
+    # no-op None under DTPU_SLO=0): /health embeds the rolling
+    # TTFT/queue-wait/TPOT window summaries as `slo_windows`, which the
+    # router's probe loop relays to the control plane's process_slo —
+    # the probe is the transport, no new scrape protocol. Per-app (not
+    # module-global) because test harnesses run several replicas in
+    # one process.
+    replica_slo_state = obs_slo.replica_slo(
+        lambda: obs_slo.serve_signals(engine.metrics, get_qos_registry())
+    )
+    app["replica_slo"] = replica_slo_state
 
     def _is_resume(request) -> bool:
         """Router-asserted mid-stream-failover continuation. The header
@@ -1010,7 +1039,7 @@ def build_app(
         e = sched.engine
         e.update_state_gauges()
         m = e.metrics
-        return web.json_response({
+        body = {
             "status": "ok",
             "model": model_name,
             "queue_depth": sched.pending.qsize(),
@@ -1025,7 +1054,14 @@ def build_app(
             # affinity score can tell a warm registry from a cold one
             # (routing/pool.py, serving.md §10)
             **e.prefix_stats(),
-        })
+        }
+        if replica_slo_state is not None:
+            # rolling per-window TTFT/queue-wait/TPOT bucket deltas +
+            # request/error/shed counts: the probe loop relays these to
+            # process_slo for fleet burn-rate evaluation (server.md
+            # "SLO & alerting")
+            body["slo_windows"] = replica_slo_state.health_windows()
+        return web.json_response(body)
 
     async def models(request):
         return web.json_response(
@@ -1047,9 +1083,14 @@ def build_app(
         # counters (shed/admitted per tenant digest, queue wait by
         # priority class) + tracing bookkeeping — the shim relay
         # scrapes them together
+        if replica_slo_state is not None:
+            # keep the local burn gauges fresh even when nothing probes
+            # /health (ad-hoc replicas scraped directly)
+            replica_slo_state.maybe_tick()
         return web.Response(
             text=e.metrics.render() + get_qos_registry().render()
-            + get_trace_registry().render(),
+            + get_trace_registry().render()
+            + obs_slo.get_slo_registry().render(),
             content_type="text/plain",
         )
 

@@ -65,6 +65,15 @@ def create_scheduler(db: Database) -> BackgroundScheduler:
             float(settings.REPLICA_PROBE_INTERVAL),
             "probe_service_replicas",
         )
+    # live SLO engine: burn-rate evaluation over the server's own
+    # registries + the probe loop's relayed replica windows
+    # (obs/slo.py; DTPU_SLO=0 or DTPU_SLO_TICK=0 disables)
+    from dstack_tpu.obs import slo as obs_slo
+
+    if settings.SLO_TICK > 0 and obs_slo.enabled():
+        from dstack_tpu.server.background.tasks.process_slo import process_slo
+
+        sched.add(lambda: process_slo(db), float(settings.SLO_TICK), "process_slo")
     sched.add(lambda: collect_metrics(db), 10.0, "collect_metrics")
     if settings.ENABLE_PROMETHEUS_METRICS:
         sched.add(

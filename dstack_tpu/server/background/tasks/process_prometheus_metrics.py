@@ -24,10 +24,29 @@ async def collect_prometheus_metrics(db: Database) -> None:
         "WHERE j.status = ? ORDER BY COALESCE(m.collected_at, '') ASC LIMIT 50",
         (JobStatus.RUNNING.value,),
     )
+    from dstack_tpu.server.services.wakeups import get_reconcile_registry
+
+    skipped = get_reconcile_registry().family("dtpu_prom_relay_skipped_total")
     for job_row in rows:
         try:
             await _collect_job(db, job_row)
-        except (AgentError, AgentNotReady):
+        except AgentNotReady as e:
+            # a gap here means the job's /metrics page goes stale and
+            # the server serves (or drops) old samples: count it so a
+            # persistently unreachable agent is visible on /metrics
+            # instead of reading as healthy
+            skipped.inc(1, "agent_not_ready")
+            logger.debug(
+                "prometheus relay skipped for %s (agent not ready): %s",
+                job_row["job_name"], e,
+            )
+            continue
+        except AgentError as e:
+            skipped.inc(1, "agent_error")
+            logger.debug(
+                "prometheus relay skipped for %s (agent error): %s",
+                job_row["job_name"], e,
+            )
             continue
         except Exception:
             logger.exception(

@@ -1,0 +1,121 @@
+"""Fleet-wide live SLO evaluation: burn rates → alerts → feedback.
+
+Each tick the loop feeds the process-global :class:`~dstack_tpu.obs.
+slo.SLOEngine` three kinds of signal and evaluates every alert state
+machine:
+
+- the **server's own traffic** (``dtpu_http_requests_total`` status
+  labels + the in-server QoS edge) under the ``server`` scope;
+- **per-replica windows** relayed by the probe loop: each replica's
+  ``/health`` already carries its rolling ``slo_windows`` block
+  (``obs.slo.ReplicaSLO``), captured into ``ReplicaEntry.probe`` by
+  ``routing.pool.probe_replica`` — the probe is the transport, there
+  is no new scrape protocol;
+- a **fleet merge** per service (window counts summed across its
+  replicas) under the ``<project>/<run>`` scope.
+
+Alert transitions close the loop twice (docs/guides/serving.md §12):
+
+- a firing **per-replica fast-burn** alert pins that replica DEGRADED
+  in the routing pool (last-resort target; released on resolve) — the
+  soft-failure analogue of the breaker: a replica quietly violating
+  its latency/error targets stops receiving affinity-pinned traffic
+  *before* hard failures trip anything;
+- every transition for a known service run lands on the run timeline
+  as a ``slo_alert`` run event, so ``dtpu stats`` shows pages next to
+  lifecycle phases.
+
+``GET /api/slo`` and the ``dtpu slo`` CLI read the same engine via
+:func:`get_slo_engine`; the ``slo-burn`` autoscaler metric reads
+:meth:`SLOEngine.fleet_burn`.
+"""
+
+import time
+from typing import Dict, Optional, Tuple
+
+from dstack_tpu.core.models.runs import RunStatus
+from dstack_tpu.obs import slo as obs_slo
+from dstack_tpu.routing import get_pool_registry
+from dstack_tpu.server.db import Database, loads
+from dstack_tpu.server.services.run_events import record_run_event
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("server.process_slo")
+
+_ACTIVE = (RunStatus.RUNNING.value, RunStatus.PROVISIONING.value)
+
+_engine: Optional[obs_slo.SLOEngine] = None
+
+
+def get_slo_engine() -> Optional[obs_slo.SLOEngine]:
+    """The server's live SLO engine (None while ``DTPU_SLO=0``) —
+    shared by this loop, ``GET /api/slo``, and the slo-burn scaler."""
+    global _engine
+    if _engine is None and obs_slo.enabled():
+        _engine = obs_slo.SLOEngine(policy=obs_slo.policy_from_env())
+    return _engine
+
+
+def reset_slo_engine() -> None:
+    """Test hook: drop the process-global engine (module state)."""
+    global _engine
+    _engine = None
+
+
+async def process_slo(db: Database) -> None:
+    engine = get_slo_engine()
+    if engine is None:
+        return
+    engine.tick_scope("server", obs_slo.server_signals())
+    registry = get_pool_registry()
+    scope_keys: Dict[str, Tuple[str, str]] = {}
+    now = time.monotonic()
+    for (project, run_name), pool in list(registry.pools.items()):
+        scope = f"{project}/{run_name}"
+        scope_keys[scope] = (project, run_name)
+        obs_slo.ingest_pool_windows(engine, pool, scope, now=now)
+    transitions = engine.evaluate()
+    if not transitions:
+        return
+    run_ids = await _service_run_ids(db)
+    for scope, key in scope_keys.items():
+        pool = registry.pools.get(key)
+        if pool is not None:
+            obs_slo.apply_replica_pins(pool, transitions, scope=scope)
+    for tr in transitions:
+        key = scope_keys.get(tr.scope)
+        run_id = run_ids.get(key) if key else None
+        if run_id is not None:
+            details = f"{tr.state} {tr.severity} {tr.objective}"
+            if tr.replica is not None:
+                details += f" replica={tr.replica}"
+            details += f" burn={tr.burn:.1f}x"
+            await record_run_event(db, run_id, "slo_alert", details=details)
+        logger.warning(
+            "slo_alert %s: %s %s scope=%s%s burn=%.1fx",
+            tr.state, tr.severity, tr.objective, tr.scope,
+            f" replica={tr.replica}" if tr.replica else "", tr.burn,
+        )
+
+
+async def _service_run_ids(db: Database) -> Dict[Tuple[str, str], str]:
+    """(project, run_name) → run id for active service runs (the
+    timeline targets of ``slo_alert`` events)."""
+    projects = {
+        p["id"]: p["name"] for p in await db.fetchall("SELECT * FROM projects")
+    }
+    runs = await db.fetchall(
+        f"SELECT * FROM runs WHERE status IN "
+        f"({','.join('?' for _ in _ACTIVE)}) AND deleted = 0",
+        _ACTIVE,
+    )
+    out: Dict[Tuple[str, str], str] = {}
+    for run in runs:
+        conf = (loads(run["run_spec"]) or {}).get("configuration", {})
+        if conf.get("type") != "service":
+            continue
+        project_name = projects.get(run["project_id"])
+        if project_name is None:
+            continue
+        out[(project_name, run["run_name"])] = run["id"]
+    return out

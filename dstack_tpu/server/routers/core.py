@@ -915,6 +915,27 @@ async def debug_traces(ctx: RequestContext):
     return web.json_response(tracing.debug_payload(ctx.request.query))
 
 
+@root_router.get("/api/slo")
+@no_auth
+async def slo_status(ctx: RequestContext):
+    """Live SLO engine state: per-scope burn rates by window, error
+    budget remaining, and every alert state machine with its recent
+    transitions (obs/slo.py; the ``dtpu slo`` CLI renders this). Same
+    exposure policy as /metrics — scopes and objective names only,
+    never request content."""
+    from aiohttp import web
+
+    from dstack_tpu.server import settings
+    from dstack_tpu.server.background.tasks.process_slo import get_slo_engine
+
+    if not settings.ENABLE_PROMETHEUS_METRICS:
+        raise ResourceNotExistsError("prometheus metrics disabled")
+    engine = get_slo_engine()
+    if engine is None:
+        return web.json_response({"enabled": False})
+    return web.json_response(engine.status_payload())
+
+
 ALL_ROUTERS = [
     server_router,
     users_router,

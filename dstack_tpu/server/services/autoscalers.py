@@ -113,6 +113,57 @@ class QueueDepthAutoscaler(RPSAutoscaler):
         return self._clamp_and_delay(needed, current, last_scaled_at)
 
 
+class SLOBurnAutoscaler(RPSAutoscaler):
+    """Scales on service-level error-budget burn (metric ``slo-burn``).
+
+    ``scaling.target`` is the tolerated burn rate over the SLO policy's
+    fast windows (1.0 = consuming budget exactly as fast as allowed).
+    The signal is :meth:`dstack_tpu.obs.slo.SLOEngine.fleet_burn` for
+    this service's fleet scope — the same number the fast-burn page
+    fires on, so scale-out starts from the signal that would page an
+    operator instead of a proxy for it. Burn above target grows the
+    fleet proportionally (bad fraction dilutes across replicas for
+    saturation-shaped burn); RPS (conservative per-replica target)
+    stays as the floor and becomes the ONLY signal when the engine has
+    no verdict (DTPU_SLO=0, no windows yet, stale probes): a blind
+    scaler must fail toward the coarse metric, not toward zero.
+    """
+
+    FALLBACK_RPS_TARGET = 10.0
+
+    def get_desired_count(self, project, run_name, current, last_scaled_at) -> int:
+        import math
+
+        from dstack_tpu.server.background.tasks.process_slo import (
+            get_slo_engine,
+        )
+
+        lo, _ = self._bounds()
+        rps_needed = self._needed_for_rps(
+            project, run_name, self.FALLBACK_RPS_TARGET, lo
+        )
+        engine = get_slo_engine()
+        burn = (
+            engine.fleet_burn(f"{project}/{run_name}")
+            if engine is not None
+            else None
+        )
+        if burn is None:
+            needed = rps_needed  # no verdict: RPS keeps the lights on
+        else:
+            target = max(self.scaling.target, 1e-9)
+            if burn > target and current > 0:
+                burn_needed = math.ceil(current * burn / target)
+                # bound one decision's growth: burn is a ratio of small
+                # deltas and can spike arbitrarily on thin windows —
+                # doubling per scale_up_delay is fast enough
+                burn_needed = min(burn_needed, current * 2)
+            else:
+                burn_needed = lo
+            needed = max(rps_needed, burn_needed)
+        return self._clamp_and_delay(needed, current, last_scaled_at)
+
+
 def get_service_scaler(conf: ServiceConfiguration) -> BaseScaler:
     replicas = conf.replicas
     if not isinstance(replicas, IntRange):
@@ -120,5 +171,7 @@ def get_service_scaler(conf: ServiceConfiguration) -> BaseScaler:
     if conf.scaling is not None and replicas.min != replicas.max:
         if conf.scaling.metric == "queue-depth":
             return QueueDepthAutoscaler(replicas, conf.scaling)
+        if conf.scaling.metric == "slo-burn":
+            return SLOBurnAutoscaler(replicas, conf.scaling)
         return RPSAutoscaler(replicas, conf.scaling)
     return ManualScaler(replicas)

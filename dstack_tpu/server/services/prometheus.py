@@ -86,6 +86,12 @@ async def render_metrics(db: Database) -> str:
     from dstack_tpu.obs.tracing import get_trace_registry
 
     w.raw(get_trace_registry().render())
+    # live SLO engine (burn-rate gauges per objective/scope/window,
+    # error-budget remaining, alerts firing — obs/slo.py, fed by the
+    # process_slo loop)
+    from dstack_tpu.obs.slo import get_slo_registry
+
+    w.raw(get_slo_registry().render())
     return w.render()
 
 

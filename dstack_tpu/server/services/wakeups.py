@@ -158,6 +158,15 @@ def new_reconcile_registry() -> Registry:
         "by task",
         labelnames=("task",),
     )
+    r.counter(
+        "dtpu_prom_relay_skipped_total",
+        "Prometheus relay scrapes skipped because the job's agent was "
+        "unreachable or errored (process_prometheus_metrics) — a "
+        "silent scrape gap used to read as healthy; now it counts, by "
+        "reason",
+        labelnames=("reason",),
+        max_series=8,
+    )
     return r
 
 

@@ -78,6 +78,10 @@ WAKEUP_MAX_ATTEMPTS = _env_int("DTPU_WAKEUP_MAX_ATTEMPTS", 5)
 SERVICE_DRAIN_SECONDS = _env_int("DTPU_SERVICE_DRAIN_SECONDS", 30)
 # Interval between replica /health probes driving the routing pools.
 REPLICA_PROBE_INTERVAL = _env_int("DTPU_REPLICA_PROBE_INTERVAL", 2)
+# Live SLO engine evaluation tick (seconds) for the process_slo loop
+# (obs/slo.py burn-rate monitoring; 0 disables the loop, DTPU_SLO=0
+# disables the whole subsystem).
+SLO_TICK = _env_float("DTPU_SLO_TICK", 5.0)
 
 # Provisioning deadlines (seconds). Parity: process_instances.py:110.
 PROVISIONING_TIMEOUT = _env_int("DTPU_PROVISIONING_TIMEOUT", 600)

@@ -8,7 +8,7 @@ from dstack_tpu.cli.main import cli
 EXPECTED = {
     "apply", "attach", "completion", "config", "delete", "fleet",
     "gateway", "init", "logs", "metrics", "offer", "pool", "ps",
-    "secret", "server", "stats", "stop", "trace", "volume",
+    "secret", "server", "slo", "stats", "stop", "trace", "volume",
 }
 
 
@@ -115,3 +115,66 @@ class TestTraceWaterfall:
 
         table = render_trace_waterfall({"trace_id": "x", "spans": []})
         assert table.row_count == 0
+
+
+class TestSloRender:
+    def _payload(self) -> dict:
+        return {
+            "enabled": True,
+            "policy": {
+                "name": "prod",
+                "fast_burn": {"factor": 14.4, "windows": ["5m", "1h"]},
+                "slow_burn": {"factor": 1.0, "windows": ["6h"]},
+            },
+            "windows_s": {"5m": 300.0, "1h": 3600.0, "6h": 21600.0},
+            "scopes": [
+                {
+                    "scope": "main/svc", "replica": None,
+                    "objectives": {
+                        "error_rate": {
+                            "burn": {"5m": 22.5, "1h": 8.1, "6h": 1.2},
+                            "budget_remaining": 0.0,
+                        },
+                        "ttft:interactive": {
+                            "burn": {"5m": 0.4},
+                            "budget_remaining": 0.96,
+                        },
+                    },
+                },
+                {
+                    "scope": "main/svc", "replica": "r1",
+                    "objectives": {
+                        "error_rate": {"burn": {"5m": 40.0}},
+                    },
+                },
+            ],
+            "alerts": [
+                {"scope": "main/svc", "replica": "r1",
+                 "objective": "error_rate", "severity": "fast",
+                 "state": "firing", "burn": 40.0},
+            ],
+            "transitions": [],
+        }
+
+    def test_tables_render_scopes_and_alerts(self):
+        from rich.console import Console
+
+        from dstack_tpu.cli.main import render_slo_tables
+
+        console = Console(width=160, legacy_windows=False)
+        with console.capture() as cap:
+            for t in render_slo_tables(self._payload()):
+                console.print(t)
+        out = cap.get()
+        assert "main/svc" in out and "main/svc#r1" in out
+        assert "error_rate" in out and "ttft:interactive" in out
+        assert "22.50x" in out and "40.00x" in out
+        assert "96.0%" in out  # budget remaining
+        assert "firing" in out
+
+    def test_empty_payload_renders(self):
+        from dstack_tpu.cli.main import render_slo_tables
+
+        tables = render_slo_tables({"enabled": True, "windows_s": {},
+                                    "scopes": [], "alerts": []})
+        assert len(tables) == 2

@@ -1,0 +1,159 @@
+"""Tentpole acceptance (PR 14): the LIVE SLO engine watches a seeded
+loadgen soak through a mid-soak replica kill and catches a
+soft-failing survivor — firing and resolving burn-rate alerts whose
+timing agrees with the offline report's tail-amplification windows,
+and closing the routing loop, all with zero client-visible damage:
+
+1. **The kill**: replica r0 dies mid-soak (listener stopped, streams
+   severed → the PR-9 resume path; breaker converges). Merged into the
+   SAME kill-time fault plan, bounded ``serve.engine.step`` errors
+   target survivor r1 (``engine.fault_ctx`` replica targeting): its
+   in-flight requests fail server-side and resume on r2 — clients see
+   **zero 5xx and zero failures**, but r1's own
+   ``dtpu_serve_request_errors_total`` advances. That is precisely the
+   soft failure the breaker cannot see (errors are not consecutive
+   connect failures) and the SLO engine exists to catch.
+2. **Live detection**: each replica's /health ``slo_windows`` ride the
+   probe loop into the soak's live engine (the process_slo shape); the
+   ``error_rate`` fast-burn alert must FIRE inside the offline
+   report's kill window and RESOLVE after it.
+3. **Alert-driven routing**: the firing per-replica alert pins r1
+   DEGRADED through the real ReplicaPool and releases it on resolve —
+   observed via the ``dtpu_router_slo_*`` counters.
+
+Windows/hold-downs run on ``DTPU_BG_TICK_SCALE`` (the chaos-suite
+contract): the REAL burn math on a fast clock, no test-only code
+paths. Determinism of the transition sequence itself (same inputs on
+a fake clock → identical transitions) is pinned in
+tests/obs/test_slo.py::TestAlertDeterminism.
+"""
+
+from dstack_tpu.loadgen import compile_schedule, default_spec
+from dstack_tpu.loadgen.soak import SoakConfig, run_soak
+
+SEED = 11
+DURATION = 16.0
+RATE = 3.5
+
+#: DTPU_BG_TICK_SCALE for this soak: 5m→3s, 1h→36s, 6h→216s;
+#: hold-down 60s→0.6s, resolve 120s→1.2s
+SCALE = "0.01"
+
+#: latency targets deliberately unreachable (this acceptance isolates
+#: the deterministic error-rate signal; latency burn is CPU-timing
+#: noise on a shared single core) — Workbook burn rules otherwise stock
+SLO_POLICY = {
+    "name": "chaos-acceptance",
+    "classes": [
+        {"name": "soak", "ttft_slo_ms": 60000.0, "tpot_slo_ms": 60000.0}
+    ],
+    "latency_compliance": 0.5,
+    "error_rate_slo": 0.001,
+    "shed_honesty": True,
+    "fast_burn": {"factor": 14.4, "windows": ["5m", "1h"]},
+    "slow_burn": {"factor": 1.0, "windows": ["6h"]},
+    "hold_down_s": 60.0,
+    "resolve_after_s": 120.0,
+    "min_events": 2,
+}
+
+#: merged into the kill-time plan (counters restart with the plan, so
+#: nth counts post-kill): r1's 1st and 20th live-slot step calls raise
+#: — every affected stream resumes on r2 (r0 is dead, r1 excluded)
+KILL_EXTRA_RULES = [
+    {
+        "point": "serve.engine.step",
+        "ctx": {"replica": "r1"},
+        "action": "raise",
+        "nth": [1, 20],
+    }
+]
+
+
+class TestLiveSLOChaosAcceptance:
+    def test_fast_burn_fires_in_kill_window_and_closes_the_loop(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("DTPU_BG_TICK_SCALE", SCALE)
+        schedule = compile_schedule(
+            default_spec(duration_s=DURATION, rate_rps=RATE), SEED
+        )
+        assert len(schedule.events) >= 20, "workload too thin"
+        cfg = SoakConfig(
+            replicas=3,  # r0 dies, r1 soft-fails, r2 absorbs resumes
+            chaos=True,
+            drain_start_frac=0.15,
+            drain_end_frac=0.30,
+            kill_frac=0.45,
+            kill_window_s=4.0,
+            kill_extra_rules=KILL_EXTRA_RULES,
+            slo_policy=SLO_POLICY,
+            slo_tick_s=0.4,
+            probe_interval_s=0.4,
+            output=None,
+        )
+        report = run_soak(schedule, cfg)
+
+        # the soak replayed the seeded workload
+        assert report["schedule_digest"] == schedule.digest()
+
+        # (1) zero client-visible damage THROUGH the kill and the
+        # injected engine errors: resume/failover absorbed everything
+        assert report["client_5xx"] == 0, report["overall"]["outcomes"]
+        assert report["failures"] == 0, report["overall"]["outcomes"]
+        router = report["router"]
+        assert router["dtpu_router_breaker_opens_total"] >= 1, router
+        assert (
+            router["dtpu_router_stream_resumes_total"]
+            + router["dtpu_router_failovers_total"]
+        ) >= 1, router
+
+        # (2) the live engine saw the burn: a fast error_rate alert
+        # fired INSIDE the offline kill window and resolved AFTER it
+        slo = report["slo"]
+        assert slo is not None and slo["policy"] == "chaos-acceptance"
+        transitions = slo["transitions"]
+        kill = report["windows"]["kill"]
+        fast_err = [
+            tr for tr in transitions
+            if tr["severity"] == "fast" and tr["objective"] == "error_rate"
+        ]
+        fired = [tr for tr in fast_err if tr["state"] == "firing"]
+        assert fired, f"no fast error_rate firing transition: {transitions}"
+        fired_t = min(tr["t"] for tr in fired)
+        assert kill["start"] <= fired_t <= kill["end"], (
+            f"fired at t={fired_t}, kill window "
+            f"[{kill['start']}, {kill['end']}]: {fast_err}"
+        )
+        resolved = [tr for tr in fast_err if tr["state"] == "resolved"]
+        assert resolved, f"firing never resolved: {fast_err}"
+        resolved_t = max(tr["t"] for tr in resolved)
+        assert resolved_t > kill["end"], (
+            f"resolved at t={resolved_t} inside the kill window "
+            f"(ends {kill['end']})"
+        )
+        assert resolved_t > fired_t
+
+        # attribution: the per-replica alert blames the soft-failing
+        # survivor r1, not the dead r0 or the clean r2
+        per_replica = {
+            tr["replica"] for tr in fired if tr["replica"] is not None
+        }
+        assert per_replica == {"r1"}, fast_err
+
+        # (3) alert-driven routing: r1 was pinned DEGRADED while
+        # firing and restored on resolve (dtpu_router_* counters)
+        assert router["dtpu_router_slo_degraded_total"] >= 1, router
+        assert router["dtpu_router_slo_restored_total"] >= 1, router
+
+        # the unreachable latency targets never fired — the alert is
+        # the injected signal, not timing noise
+        latency_fired = [
+            tr for tr in transitions
+            if tr["state"] == "firing"
+            and tr["objective"].split(":")[0] in ("ttft", "tpot")
+        ]
+        assert latency_fired == [], latency_fired
+
+        # honest sheds still hold under chaos (the §11 contract)
+        assert report["overall"]["sheds"]["honest"] is True

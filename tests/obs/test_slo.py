@@ -1,0 +1,607 @@
+"""Live SLO engine units: bucket-delta estimators (property-tested
+against exact percentiles), sliding-window rings, policy validation
+(shared schema with loadgen), the burn-rate alert state machine's
+determinism under an injectable clock, the zero-cost no-op pin, and
+the offline --validate CLI."""
+
+import bisect
+import json
+import random
+import subprocess
+import sys
+import textwrap
+
+from dstack_tpu.obs import slo
+from dstack_tpu.obs.metrics import LATENCY_BUCKETS_S
+
+
+def _bucketize(samples, bounds):
+    counts = [0.0] * (len(bounds) + 1)
+    for v in samples:
+        counts[bisect.bisect_left(bounds, v)] += 1
+    return counts
+
+
+def _exact_percentile(samples, q):
+    s = sorted(samples)
+    return s[min(len(s) - 1, max(0, round(q * (len(s) - 1))))]
+
+
+def _covering_width(bounds, value):
+    """Width of the bucket covering ``value`` (the estimator's error
+    bound)."""
+    ix = bisect.bisect_left(bounds, value)
+    if ix >= len(bounds):
+        return float("inf")  # +Inf bucket: no bound claimed
+    lo = bounds[ix - 1] if ix > 0 else 0.0
+    return bounds[ix] - lo
+
+
+class TestBucketEstimators:
+    def test_quantile_error_bounded_by_bucket_width(self):
+        """Property: for seeded synthetic streams, the bucket-delta
+        quantile estimate lands within the covering bucket's width of
+        the exact percentile."""
+        bounds = list(LATENCY_BUCKETS_S)
+        for seed in range(8):
+            rng = random.Random(seed)
+            # log-spread samples covering several decades, like real
+            # latency distributions
+            samples = [
+                10 ** rng.uniform(-3, 0.8) for _ in range(500)
+            ]
+            counts = _bucketize(samples, bounds)
+            for q in (0.5, 0.9, 0.95, 0.99):
+                est = slo.quantile_from_counts(bounds, counts, q)
+                exact = _exact_percentile(samples, q)
+                width = _covering_width(bounds, exact)
+                assert est is not None
+                assert abs(est - exact) <= width + 1e-9, (
+                    f"seed={seed} q={q}: est {est} vs exact {exact} "
+                    f"(bucket width {width})"
+                )
+
+    def test_fraction_over_error_bounded_by_covering_bucket_mass(self):
+        """Property: the violation-fraction estimate differs from the
+        exact fraction by at most the covering bucket's share of the
+        total (interpolation can only mis-assign within one bucket)."""
+        bounds = list(LATENCY_BUCKETS_S)
+        for seed in range(8):
+            rng = random.Random(100 + seed)
+            samples = [10 ** rng.uniform(-3, 0.8) for _ in range(400)]
+            counts = _bucketize(samples, bounds)
+            for thr in (0.005, 0.05, 0.25, 1.0):
+                est = slo.fraction_over(bounds, counts, thr)
+                exact = sum(1 for v in samples if v > thr) / len(samples)
+                ix = bisect.bisect_left(bounds, thr)
+                bucket_mass = (
+                    counts[ix] / len(samples) if ix < len(counts) else 0.0
+                )
+                assert est is not None
+                assert abs(est - exact) <= bucket_mass + 1e-9, (
+                    f"seed={seed} thr={thr}: est {est} vs exact {exact}"
+                )
+
+    def test_empty_and_degenerate_inputs(self):
+        bounds = [0.1, 1.0]
+        assert slo.quantile_from_counts(bounds, [0, 0, 0], 0.95) is None
+        assert slo.fraction_over(bounds, [0, 0, 0], 0.5) is None
+        # everything in +Inf, threshold below the last bound: all over
+        assert slo.fraction_over(bounds, [0, 0, 10], 0.5) == 1.0
+        # threshold past the last finite bound: the +Inf bucket is
+        # conservatively NOT counted as over (error stays bounded)
+        assert slo.fraction_over(bounds, [0, 0, 10], 2.0) == 0.0
+
+
+class TestSlidingWindows:
+    def test_deltas_and_span_on_fake_clock(self):
+        clock = [0.0]
+        sw = slo.SlidingWindows({"w": 10.0}, clock=lambda: clock[0])
+        out = sw.advance({"requests": 0.0})
+        assert out == {}  # first tick: no prior anchor
+        clock[0] = 5.0
+        out = sw.advance({"requests": 7.0})
+        assert out["w"]["requests"] == 7.0
+        assert out["w"]["span_s"] == 5.0
+        clock[0] = 12.0
+        out = sw.advance({"requests": 10.0})
+        # anchor at t=0 still covers the 10s window boundary
+        assert out["w"]["requests"] == 10.0
+        clock[0] = 30.0
+        out = sw.advance({"requests": 10.0})
+        # old anchors pruned: the delta now spans ~the window, and no
+        # events landed in it
+        assert out["w"]["requests"] <= 3.0
+        assert out["w"]["span_s"] <= 30.0
+
+    def test_counter_reset_clamps_to_zero(self):
+        clock = [0.0]
+        sw = slo.SlidingWindows({"w": 10.0}, clock=lambda: clock[0])
+        sw.advance({"requests": 100.0})
+        clock[0] = 1.0
+        out = sw.advance({"requests": 5.0})  # registry reset mid-window
+        assert out["w"]["requests"] == 0.0
+
+    def test_ring_bounded_under_fast_ticks(self):
+        clock = [0.0]
+        sw = slo.SlidingWindows(
+            {"w": 64.0}, clock=lambda: clock[0], slots=8
+        )
+        for i in range(10_000):
+            clock[0] = i * 0.01
+            sw.advance({"requests": float(i)})
+        # spacing >= window/slots bounds the ring regardless of tick rate
+        assert len(sw._rings["w"]) <= 8 + 2
+
+    def test_hist_delta_and_merge(self):
+        clock = [0.0]
+        sw = slo.SlidingWindows({"w": 100.0}, clock=lambda: clock[0])
+        h0 = {"le": [0.1, 1.0], "counts": [1.0, 0.0, 0.0], "sum": 0.05,
+              "count": 1.0}
+        sw.advance({"ttft": h0})
+        clock[0] = 10.0
+        h1 = {"le": [0.1, 1.0], "counts": [1.0, 3.0, 0.0], "sum": 1.55,
+              "count": 4.0}
+        out = sw.advance({"ttft": h1})
+        d = out["w"]["ttft"]
+        assert d["counts"] == [0.0, 3.0, 0.0]
+        assert d["count"] == 3.0
+        merged = slo.merge_windows([out, out])
+        assert merged["w"]["ttft"]["count"] == 6.0
+        assert merged["w"]["span_s"] == out["w"]["span_s"]
+
+
+class TestPolicyValidation:
+    def test_default_policy_is_valid(self):
+        assert slo.validate_policy(slo.default_policy().to_dict()) == []
+
+    def test_unknown_keys_rejected(self):
+        errs = slo.validate_policy({
+            "classes": [{"name": "a", "bogus": 1}], "nope": 2,
+        })
+        assert any("nope" in e for e in errs)
+        assert any("bogus" in e for e in errs)
+
+    def test_shared_target_schema_with_loadgen(self):
+        """Satellite: the SAME validator rejects a bad ttft_slo_ms in
+        both a workload spec class and a policy class — one schema."""
+        from dstack_tpu.loadgen.spec import validate_spec
+
+        bad_cls = {"name": "a", "ttft_slo_ms": -5}
+        policy_errs = slo.validate_policy({"classes": [bad_cls]})
+        spec_errs = validate_spec({
+            "duration_s": 10, "classes": [dict(bad_cls, kind="chat")],
+        })
+        needle = "ttft_slo_ms must be positive"
+        assert any(needle in e for e in policy_errs)
+        assert any(needle in e for e in spec_errs)
+        # and the defaults are literally the same objects
+        from dstack_tpu.loadgen.spec import TenantClass
+
+        assert TenantClass("x").ttft_slo_ms == slo.DEFAULT_TTFT_SLO_MS
+        assert TenantClass("x").tpot_slo_ms == slo.DEFAULT_TPOT_SLO_MS
+
+    def test_burn_rule_windows_validated(self):
+        errs = slo.validate_policy({
+            "classes": [{"name": "a"}],
+            "fast_burn": {"factor": 0, "windows": ["5q"]},
+        })
+        assert any("factor" in e for e in errs)
+        assert any("5q" in e for e in errs)
+
+    def test_policy_roundtrip(self):
+        p = slo.policy_from_dict({
+            "name": "t",
+            "classes": [{"name": "a", "ttft_slo_ms": 123}],
+            "fast_burn": {"factor": 3.0, "windows": ["5m"]},
+        })
+        assert p.fast.factor == 3.0
+        assert p.classes[0].ttft_slo_ms == 123
+        oids = [o.oid for o in slo.compile_objectives(p)]
+        assert oids == ["ttft:a", "tpot:a", "error_rate", "shed_honesty"]
+
+
+def _synthetic_feed(seed: int):
+    """A seeded (clock, signals) sequence: error burst mid-stream —
+    the pure-function-of-seed input the determinism contract runs on."""
+    rng = random.Random(seed)
+    reqs = errs = 0.0
+    feed = []
+    burst_at = 10 + rng.randrange(5)
+    for t in range(40):
+        reqs += 2 + rng.randrange(3)
+        if burst_at <= t < burst_at + 4:
+            errs += 1 + rng.randrange(2)
+        feed.append((float(t), {"requests": reqs, "errors": errs}))
+    return feed
+
+
+def _run_engine(feed):
+    clock = [0.0]
+    policy = slo.policy_from_dict({
+        "classes": [{"name": "c"}],
+        "error_rate_slo": 0.01,
+        "fast_burn": {"factor": 2.0, "windows": ["5m", "1h"]},
+        "slow_burn": {"factor": 1.0, "windows": ["6h"]},
+        "hold_down_s": 2.0, "resolve_after_s": 3.0, "min_events": 2,
+    })
+    eng = slo.SLOEngine(
+        policy=policy,
+        windows={"5m": 8.0, "1h": 20.0, "6h": 60.0},
+        clock=lambda: clock[0],
+        registry=slo.new_slo_registry(),
+        scale=1.0,
+    )
+    out = []
+    for t, sig in feed:
+        clock[0] = t
+        eng.tick_scope("svc", sig)
+        out.extend(
+            (tr.t, tr.objective, tr.severity, tr.state, round(tr.burn, 6))
+            for tr in eng.evaluate()
+        )
+    return out
+
+
+class TestAlertDeterminism:
+    def test_same_seed_twice_identical_transitions(self):
+        """The acceptance contract: the same event sequence on the fake
+        clock produces the IDENTICAL transition sequence."""
+        for seed in (3, 7):
+            feed = _synthetic_feed(seed)
+            assert _run_engine(feed) == _run_engine(feed)
+
+    def test_lifecycle_pending_firing_resolved(self):
+        feed = _synthetic_feed(3)
+        transitions = _run_engine(feed)
+        fast = [tr for tr in transitions if tr[2] == "fast"]
+        states = [tr[3] for tr in fast]
+        assert states[:2] == ["pending", "firing"]
+        assert "resolved" in states
+        pend = next(tr for tr in fast if tr[3] == "pending")
+        fire = next(tr for tr in fast if tr[3] == "firing")
+        res = next(tr for tr in fast if tr[3] == "resolved")
+        assert fire[0] - pend[0] >= 2.0  # hold-down honored
+        assert res[0] > fire[0]
+
+    def test_pending_cancels_on_blip(self):
+        """A one-tick burn blip never fires: pending → cancelled."""
+        clock = [0.0]
+        policy = slo.policy_from_dict({
+            "classes": [{"name": "c"}],
+            "error_rate_slo": 0.01,
+            "fast_burn": {"factor": 2.0, "windows": ["5m"]},
+            "hold_down_s": 5.0, "resolve_after_s": 3.0, "min_events": 2,
+        })
+        eng = slo.SLOEngine(
+            policy=policy, windows={"5m": 3.0, "6h": 60.0},
+            clock=lambda: clock[0], registry=slo.new_slo_registry(),
+            scale=1.0,
+        )
+        reqs, errs = 0.0, 0.0
+        fast_states = []
+        for t in range(12):
+            clock[0] = float(t)
+            reqs += 5
+            if t == 4:
+                errs += 3  # one bad tick; ages out of the 3s window
+            eng.tick_scope("svc", {"requests": reqs, "errors": errs})
+            fast_states += [
+                tr.state for tr in eng.evaluate() if tr.severity == "fast"
+            ]
+        assert "firing" not in fast_states
+        assert fast_states.count("pending") == 1
+        assert fast_states.count("cancelled") == 1
+
+    def test_stale_ingested_scope_resolves(self):
+        """A replica that stops reporting (killed) must not freeze its
+        alerts in firing: staleness ends the burn, resolve follows."""
+        clock = [0.0]
+        policy = slo.policy_from_dict({
+            "classes": [{"name": "c"}],
+            "error_rate_slo": 0.01,
+            "fast_burn": {"factor": 2.0, "windows": ["5m"]},
+            "hold_down_s": 0.0, "resolve_after_s": 2.0, "min_events": 2,
+        })
+        eng = slo.SLOEngine(
+            policy=policy, windows={"5m": 10.0, "6h": 60.0},
+            clock=lambda: clock[0], registry=slo.new_slo_registry(),
+            scale=1.0, stale_after=3.0,
+        )
+        burning = {"5m": {
+            "span_s": 10.0, "requests": 50.0, "errors": 25.0,
+        }}
+        states = []
+        for t in range(3):
+            clock[0] = float(t)
+            eng.ingest_windows("svc", "r1", burning)
+            states += [tr.state for tr in eng.evaluate()]
+        assert "firing" in states
+        # the replica dies: no more ingests — stale after t=2+3
+        for t in range(3, 12):
+            clock[0] = float(t)
+            states += [tr.state for tr in eng.evaluate()]
+        assert "resolved" in states
+
+    def test_gauges_and_status_payload(self):
+        clock = [10.0]
+        reg = slo.new_slo_registry()
+        policy = slo.policy_from_dict({
+            "classes": [{"name": "c"}],
+            "error_rate_slo": 0.01, "min_events": 2,
+            "fast_burn": {"factor": 2.0, "windows": ["5m"]},
+        })
+        eng = slo.SLOEngine(
+            policy=policy, windows={"5m": 10.0, "6h": 60.0},
+            clock=lambda: clock[0], registry=reg, scale=1.0,
+        )
+        eng.ingest_windows("svc", None, {
+            "5m": {"span_s": 10.0, "requests": 100.0, "errors": 1.0},
+            # full nominal coverage: undamped burn over the long window
+            "6h": {"span_s": 60.0, "requests": 100.0, "errors": 1.0},
+        })
+        eng.evaluate()
+        assert reg.family("dtpu_slo_burn_rate").value(
+            "error_rate", "svc", "5m"
+        ) == 1.0
+        remaining = reg.family("dtpu_slo_error_budget_remaining").value(
+            "error_rate", "svc"
+        )
+        assert remaining == 0.0  # burn 1.0 over the longest window
+        payload = eng.status_payload()
+        assert payload["enabled"] is True
+        svc = next(s for s in payload["scopes"] if s["scope"] == "svc")
+        assert svc["objectives"]["error_rate"]["burn"]["5m"] == 1.0
+        # fleet_burn: min over fast windows, max over objectives
+        assert eng.fleet_burn("svc") == 1.0
+        assert eng.fleet_burn("missing") is None
+
+
+class TestEngineHardening:
+    def test_rule_windows_join_the_configured_set(self):
+        """A burn rule naming a window outside DTPU_SLO_WINDOWS must
+        not silently disable alerting: the engine joins it in."""
+        policy = slo.policy_from_dict({
+            "classes": [{"name": "c"}],
+            "fast_burn": {"factor": 2.0, "windows": ["2m", "1h"]},
+        })
+        eng = slo.SLOEngine(
+            policy=policy, windows={"5m": 300.0},
+            registry=slo.new_slo_registry(), scale=1.0,
+        )
+        assert eng.windows["2m"] == 120.0
+        assert eng.windows["1h"] == 3600.0
+        assert "6h" in eng.windows  # default slow rule joined too
+
+    def test_startup_coverage_damps_long_window_burn(self):
+        """A window spanning a fraction of its nominal width scales
+        the burn by coverage: a 60s-old process's '1h' blip cannot
+        satisfy the long-window materiality check."""
+        obj = slo.Objective("error_rate", "error_rate", 0.001)
+        ws = {"span_s": 60.0, "requests": 20.0, "errors": 10.0}
+        full = slo.objective_burn(obj, ws, min_events=10)
+        damped = slo.objective_burn(obj, ws, min_events=10, window_s=3600.0)
+        assert full == 500.0
+        assert abs(damped - 500.0 * (60.0 / 3600.0)) < 1e-9
+        # at or past nominal coverage the burn is undamped
+        assert slo.objective_burn(
+            obj, dict(ws, span_s=3600.0), min_events=10, window_s=3600.0
+        ) == 500.0
+
+    def test_multi_class_latency_floor_cannot_false_page(self):
+        """The classless serve histograms mean per-class latency
+        thresholds would cross-contaminate (lenient-class traffic
+        burning the strict class): multi-class policies compile ONE
+        fleet-floor objective at the LOOSEST target."""
+        policy = slo.policy_from_dict({
+            "classes": [
+                {"name": "interactive", "ttft_slo_ms": 2500,
+                 "tpot_slo_ms": 400},
+                {"name": "batch", "ttft_slo_ms": 15000,
+                 "tpot_slo_ms": 2000},
+            ],
+        })
+        objs = {o.oid: o for o in slo.compile_objectives(policy)}
+        assert set(objs) == {"ttft", "tpot", "error_rate", "shed_honesty"}
+        assert objs["ttft"].threshold_s == 15.0  # the loosest target
+        # batch-only traffic at ~8s TTFT (within batch's own SLO)
+        # produces ZERO burn at the floor — no false page
+        hist = {"le": [1.0, 10.0], "counts": [0.0, 100.0, 0.0],
+                "sum": 800.0, "count": 100.0}
+        burn = slo.objective_burn(objs["ttft"], {"ttft": hist},
+                                  min_events=10)
+        assert burn == 0.0
+        # a single-class policy keeps the class-named id
+        one = slo.policy_from_dict({"classes": [{"name": "soak"}]})
+        assert "ttft:soak" in {o.oid for o in slo.compile_objectives(one)}
+
+    def test_no_verdict_removes_gauge_series_not_freezes(self):
+        """A live scope whose traffic falls below min_events must not
+        leave the burn gauge frozen at the incident's last value."""
+        clock = [0.0]
+        reg = slo.new_slo_registry()
+        policy = slo.policy_from_dict({
+            "classes": [{"name": "c"}],
+            "error_rate_slo": 0.01, "min_events": 10,
+            "fast_burn": {"factor": 2.0, "windows": ["5m"]},
+        })
+        eng = slo.SLOEngine(
+            policy=policy, windows={"5m": 10.0, "6h": 60.0},
+            clock=lambda: clock[0], registry=reg, scale=1.0,
+            stale_after=60.0,
+        )
+        eng.ingest_windows("svc", None, {
+            "5m": {"span_s": 10.0, "requests": 100.0, "errors": 50.0},
+        })
+        eng.evaluate()
+        burn_g = reg.family("dtpu_slo_burn_rate")
+        assert burn_g.value("error_rate", "svc", "5m") == 50.0
+        # incident over, traffic nearly gone: below min_events
+        clock[0] = 1.0
+        eng.ingest_windows("svc", None, {
+            "5m": {"span_s": 10.0, "requests": 2.0, "errors": 0.0},
+        })
+        eng.evaluate()
+        assert ("error_rate", "svc", "5m") not in dict(burn_g.items())
+
+    def test_gc_removes_dead_scope_gauge_series(self):
+        clock = [0.0]
+        reg = slo.new_slo_registry()
+        policy = slo.policy_from_dict({
+            "classes": [{"name": "c"}],
+            "error_rate_slo": 0.01, "min_events": 2,
+            "fast_burn": {"factor": 2.0, "windows": ["5m"]},
+        })
+        eng = slo.SLOEngine(
+            policy=policy, windows={"5m": 10.0, "6h": 60.0},
+            clock=lambda: clock[0], registry=reg, scale=1.0,
+            stale_after=5.0,
+        )
+        eng.ingest_windows("svc", "r9", {
+            "5m": {"span_s": 10.0, "requests": 100.0, "errors": 1.0},
+        })
+        eng.evaluate()
+        burn_g = reg.family("dtpu_slo_burn_rate")
+        assert burn_g.value("error_rate", "svc#r9", "5m") == 1.0
+        # scope goes silent long enough to be GC'd: series drop with it
+        # the first stale_after seconds still count as live ticks
+        for t in range(1, slo._SCOPE_GC_AFTER_TICKS + 10):
+            clock[0] = float(t)
+            eng.evaluate()
+        assert ("svc", "r9") not in eng._scopes
+        assert ("error_rate", "svc#r9", "5m") not in dict(burn_g.items())
+
+
+class TestSignalCollectors:
+    def test_serve_signals_shapes(self):
+        from dstack_tpu.qos.metrics import new_qos_registry
+        from dstack_tpu.serve.metrics import new_serve_registry
+
+        r = new_serve_registry()
+        q = new_qos_registry()
+        r.family("dtpu_serve_requests_total").inc(3)
+        r.family("dtpu_serve_request_errors_total").inc(1)
+        r.family("dtpu_serve_ttft_seconds").observe(0.2)
+        r.family("dtpu_serve_queue_wait_seconds").observe(0.01)
+        r.family("dtpu_serve_tpot_seconds").observe(0.005)
+        q.family("dtpu_qos_shed_total").inc(2, "t1")
+        sig = slo.serve_signals(r, q)
+        assert sig["requests"] == 3.0
+        assert sig["errors"] == 1.0
+        assert sig["sheds"] == 2.0
+        assert sig["sheds_unhinted"] == 0.0
+        assert sig["ttft"]["count"] == 1.0
+        assert len(sig["ttft"]["counts"]) == len(sig["ttft"]["le"]) + 1
+        # JSON round-trip: this exact shape ships inside /health
+        assert json.loads(json.dumps(sig)) == sig
+
+    def test_server_signals_counts_5xx(self):
+        from dstack_tpu.obs.metrics import Registry
+
+        r = Registry()
+        c = r.counter(
+            "dtpu_http_requests_total", "t", ("method", "route", "status")
+        )
+        c.inc(5, "GET", "/x", "200")
+        c.inc(2, "POST", "/y", "502")
+        c.inc(1, "POST", "/y", "404")
+        from dstack_tpu.qos.metrics import new_qos_registry
+
+        sig = slo.server_signals(r, new_qos_registry())
+        assert sig["requests"] == 8.0
+        assert sig["errors"] == 2.0
+
+    def test_ttft_objective_uses_queue_wait_lower_bound(self):
+        obj = slo.Objective("ttft:c", "ttft", 0.1, threshold_s=0.1)
+        hist = {"le": [0.1, 1.0], "counts": [10.0, 0.0, 0.0],
+                "sum": 0.5, "count": 10.0}
+        qw = {"le": [0.1, 1.0], "counts": [0.0, 10.0, 0.0],
+              "sum": 5.0, "count": 10.0}
+        # engine-TTFT clean but queue wait violating: the max wins
+        burn = slo.objective_burn(
+            obj, {"ttft": hist, "queue_wait": qw}, min_events=2
+        )
+        assert burn is not None and burn > 5.0
+        burn_clean = slo.objective_burn(obj, {"ttft": hist}, min_events=2)
+        assert burn_clean == 0.0
+
+
+class TestZeroCostAndImportLight:
+    def test_enabled_by_default_in_this_process(self):
+        assert slo.enabled()
+        assert slo.replica_slo is slo._replica_slo
+
+    def test_kill_switch_pins_noop_binding(self):
+        """DTPU_SLO=0 → `replica_slo` IS the no-op (the faults.fire
+        identity contract), asserted in a clean subprocess."""
+        code = textwrap.dedent("""
+            from dstack_tpu.obs import slo
+            assert not slo.enabled()
+            assert slo.replica_slo is slo._noop_replica_slo
+            assert slo.replica_slo(lambda: {}) is None
+            print("OK")
+        """)
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True,
+            env={"PATH": "/usr/bin:/bin", "DTPU_SLO": "0",
+                 "PYTHONPATH": _repo_root()},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "OK" in proc.stdout
+
+    def test_import_light_no_jax_no_aiohttp(self):
+        """obs.slo (and through it the loadgen generator path's SLO
+        import) must not pull jax or aiohttp — pinned like faults/."""
+        code = textwrap.dedent("""
+            import sys
+            import dstack_tpu.obs.slo  # noqa: F401
+            import dstack_tpu.loadgen.spec  # noqa: F401
+            heavy = {"jax", "aiohttp", "numpy", "jaxlib"} & {
+                m.split(".")[0] for m in sys.modules
+            }
+            assert not heavy, f"heavy imports leaked: {heavy}"
+            print("OK")
+        """)
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True,
+            env={"PATH": "/usr/bin:/bin", "PYTHONPATH": _repo_root()},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "OK" in proc.stdout
+
+
+def _repo_root() -> str:
+    import pathlib
+
+    return str(pathlib.Path(__file__).resolve().parents[2])
+
+
+class TestOfflineCLI:
+    def test_validate_accepts_good_policy(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "dstack_tpu.obs.slo", "--validate",
+             json.dumps({"classes": [{"name": "a", "ttft_slo_ms": 100}]})],
+            capture_output=True, text=True, cwd=_repo_root(),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "valid" in proc.stdout
+
+    def test_validate_rejects_bad_policy(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "dstack_tpu.obs.slo", "--validate",
+             json.dumps({"classes": [], "typo_key": 1})],
+            capture_output=True, text=True, cwd=_repo_root(),
+        )
+        assert proc.returncode == 1
+        assert "typo_key" in proc.stderr
+
+    def test_bare_invocation_lists_objectives(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "dstack_tpu.obs.slo"],
+            capture_output=True, text=True, cwd=_repo_root(),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "ttft:default" in proc.stdout
+        assert "14.4x" in proc.stdout

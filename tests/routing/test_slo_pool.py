@@ -1,0 +1,69 @@
+"""ReplicaPool SLO-degraded pin semantics: the soft-failure analogue
+of the breaker — a firing per-replica fast-burn alert pins DEGRADED,
+probes and request successes cannot promote past the pin, release
+restores READY."""
+
+from dstack_tpu.routing.metrics import get_router_registry
+from dstack_tpu.routing.pool import ReplicaPool, ReplicaState
+
+
+def _pool_with_ready_replica() -> ReplicaPool:
+    pool = ReplicaPool("p", "svc")
+    pool.sync([("r0", "127.0.0.1", 1234), ("r1", "127.0.0.1", 1235)])
+    for e in pool.entries.values():
+        e.state = ReplicaState.READY
+    return pool
+
+
+class TestSloDegradedPin:
+    def test_pin_and_release_flip_state_and_counters(self):
+        pool = _pool_with_ready_replica()
+        m = get_router_registry()
+        d0 = m.family("dtpu_router_slo_degraded_total").value()
+        r0 = m.family("dtpu_router_slo_restored_total").value()
+        assert pool.set_slo_degraded("r0", True) is True
+        entry = pool.get("r0")
+        assert entry.state == ReplicaState.DEGRADED
+        assert entry.slo_degraded is True
+        assert m.family("dtpu_router_slo_degraded_total").value() == d0 + 1
+        # idempotent: already pinned
+        assert pool.set_slo_degraded("r0", False) is True
+        assert entry.state == ReplicaState.READY
+        assert m.family("dtpu_router_slo_restored_total").value() == r0 + 1
+        assert pool.set_slo_degraded("r0", False) is False  # already clear
+        assert pool.set_slo_degraded("missing", True) is False
+
+    def test_request_success_cannot_promote_past_pin(self):
+        pool = _pool_with_ready_replica()
+        pool.set_slo_degraded("r0", True)
+        entry = pool.get("r0")
+        entry.state = ReplicaState.STARTING  # e.g. resync churn
+        pool.report_success(entry)
+        # a cheap request succeeding says nothing about the SLO burn
+        assert entry.state == ReplicaState.DEGRADED
+
+    def test_pinned_replica_is_last_resort_target(self):
+        pool = _pool_with_ready_replica()
+        pool.set_slo_degraded("r0", True)
+        for _ in range(4):
+            pick = pool.pick()
+            assert pick.replica_id == "r1"  # READY outranks DEGRADED
+        # but the pinned replica still serves when it is all that's left
+        pick = pool.pick(exclude=["r1"])
+        assert pick is not None and pick.replica_id == "r0"
+
+    def test_overloaded_predicate_ors_pin_with_probe_data(self):
+        pool = _pool_with_ready_replica()
+        entry = pool.get("r0")
+        assert pool._overloaded(entry) is False
+        entry.slo_degraded = True
+        assert pool._overloaded(entry) is True
+        entry.slo_degraded = False
+        entry.probe = {"queue_depth": 999}
+        assert pool._overloaded(entry) is True
+        # release with hot probe data: stays DEGRADED until a probe
+        # reclassifies (the probe path owns overload)
+        entry.state = ReplicaState.DEGRADED
+        entry.slo_degraded = True
+        pool.set_slo_degraded("r0", False)
+        assert entry.state == ReplicaState.DEGRADED

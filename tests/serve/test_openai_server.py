@@ -508,7 +508,9 @@ class TestLogprobs:
 
 def _parse_prometheus(text: str) -> dict:
     """{'name{labels}': value} plus per-family TYPE map — a real parse
-    of the exposition format, not a substring check."""
+    of the exposition format, not a substring check. OpenMetrics
+    exemplar tails (` # {trace_id="…"} v`) are split off the sample
+    before parsing, like a real scraper would."""
     samples: dict = {}
     types: dict = {}
     for line in text.splitlines():
@@ -519,6 +521,7 @@ def _parse_prometheus(text: str) -> dict:
             if len(parts) >= 3 and parts[1] == "TYPE":
                 types[parts[2]] = parts[3].strip()
             continue
+        line = line.split(" # ", 1)[0].rstrip()
         key, value = line.rsplit(None, 1)
         samples[key] = float(value)
     return {"samples": samples, "types": types}

@@ -1,0 +1,250 @@
+"""Server-side SLO wiring: the process_slo loop ingests probe-relayed
+replica windows, fires/resolves burn alerts, pins DEGRADED through the
+real ReplicaPool, records ``slo_alert`` run events; ``GET /api/slo``
+serves the engine state; the ``slo-burn`` autoscaler scales on fleet
+burn with an RPS fallback."""
+
+import time
+from types import SimpleNamespace
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dstack_tpu.core.models.configurations import ScalingSpec
+from dstack_tpu.core.models.resources import IntRange
+from dstack_tpu.core.models.runs import new_uuid, now_utc
+from dstack_tpu.obs import slo as obs_slo
+from dstack_tpu.routing import get_pool_registry
+from dstack_tpu.routing.pool import ReplicaState
+from dstack_tpu.server.app import create_app
+from dstack_tpu.server.background.tasks import process_slo
+from dstack_tpu.server.db import dumps
+from dstack_tpu.server.services.autoscalers import (
+    SLOBurnAutoscaler,
+    get_service_scaler,
+)
+
+
+async def _app():
+    return await create_app(
+        database_url="sqlite://:memory:",
+        admin_token="tok",
+        with_background=False,
+        local_backend=False,
+    )
+
+
+async def _seed_service_run(db, name: str) -> str:
+    project = await db.fetchone("SELECT * FROM projects WHERE name = 'main'")
+    user = await db.fetchone("SELECT * FROM users")
+    run_id = new_uuid()
+    await db.insert(
+        "runs",
+        {
+            "id": run_id,
+            "project_id": project["id"],
+            "user_id": user["id"],
+            "run_name": name,
+            "status": "running",
+            "run_spec": dumps({"configuration": {"type": "service"}}),
+            "deleted": 0,
+            "submitted_at": now_utc().isoformat(),
+            "last_processed_at": now_utc().isoformat(),
+        },
+    )
+    return run_id
+
+
+def _test_engine() -> obs_slo.SLOEngine:
+    policy = obs_slo.policy_from_dict({
+        "classes": [{"name": "c"}],
+        "error_rate_slo": 0.01,
+        "fast_burn": {"factor": 2.0, "windows": ["5m"]},
+        "slow_burn": {"factor": 1.0, "windows": ["6h"]},
+        "hold_down_s": 0.0, "resolve_after_s": 0.0, "min_events": 2,
+    })
+    return obs_slo.SLOEngine(
+        policy=policy, windows={"5m": 5.0, "6h": 60.0},
+        registry=obs_slo.new_slo_registry(), scale=1.0, stale_after=60.0,
+    )
+
+
+_BURNING = {"5m": {"span_s": 5.0, "requests": 100.0, "errors": 50.0}}
+_CLEAN = {"5m": {"span_s": 5.0, "requests": 100.0, "errors": 0.0}}
+
+
+class TestProcessSLO:
+    async def test_fire_degrade_resolve_restore_and_run_events(
+        self, monkeypatch
+    ):
+        app = await _app()
+        db = app["state"]["db"]
+        run_id = await _seed_service_run(db, "slosvc")
+        registry = get_pool_registry()
+        pool = registry.pool("main", "slosvc")
+        try:
+            pool.sync([("r0", "127.0.0.1", 19999)])
+            entry = pool.get("r0")
+            entry.state = ReplicaState.READY
+            monkeypatch.setattr(process_slo, "_engine", _test_engine())
+
+            def _probe(payload):
+                entry.probe = {"slo_windows": payload}
+                entry.last_probe_at = time.monotonic()
+
+            # burning windows relayed by the probe: pending, then firing
+            _probe(_BURNING)
+            await process_slo.process_slo(db)  # pending
+            assert entry.state == ReplicaState.READY
+            await process_slo.process_slo(db)  # firing -> DEGRADED pin
+            assert entry.state == ReplicaState.DEGRADED
+            assert entry.slo_degraded is True
+
+            # burn stops: firing -> resolved -> pin released
+            _probe(_CLEAN)
+            await process_slo.process_slo(db)  # clear_since set
+            await process_slo.process_slo(db)  # resolved -> restored
+            assert entry.slo_degraded is False
+            assert entry.state == ReplicaState.READY
+
+            rows = await db.fetchall(
+                "SELECT * FROM run_events WHERE run_id = ? "
+                "AND event = 'slo_alert'",
+                (run_id,),
+            )
+            details = [r["details"] for r in rows]
+            assert any(
+                d.startswith("firing fast error_rate")
+                and "replica=r0" in d
+                for d in details
+            ), details
+            assert any(
+                d.startswith("resolved fast error_rate") for d in details
+            ), details
+            # the fleet scope (no replica suffix) also alerted
+            assert any("replica=" not in d for d in details), details
+        finally:
+            registry.pools.pop(("main", "slosvc"), None)
+            process_slo.reset_slo_engine()
+
+    async def test_stale_probe_windows_not_ingested(self, monkeypatch):
+        app = await _app()
+        db = app["state"]["db"]
+        registry = get_pool_registry()
+        pool = registry.pool("main", "stalesvc")
+        try:
+            pool.sync([("r0", "127.0.0.1", 19998)])
+            entry = pool.get("r0")
+            entry.state = ReplicaState.READY
+            engine = _test_engine()
+            monkeypatch.setattr(process_slo, "_engine", engine)
+            entry.probe = {"slo_windows": _BURNING}
+            entry.last_probe_at = time.monotonic() - 120.0  # stale
+            await process_slo.process_slo(db)
+            await process_slo.process_slo(db)
+            # no ingest -> no alert -> no pin
+            assert entry.state == ReplicaState.READY
+            assert not any(
+                key[0] == "main/stalesvc" for key in engine._scopes
+            )
+        finally:
+            registry.pools.pop(("main", "stalesvc"), None)
+            process_slo.reset_slo_engine()
+
+
+class TestApiSloRoute:
+    async def test_api_slo_serves_engine_state(self, monkeypatch):
+        engine = _test_engine()
+        engine.ingest_windows("main/svc", None, _BURNING)
+        engine.evaluate()
+        monkeypatch.setattr(process_slo, "_engine", engine)
+        app = await _app()
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/api/slo")
+            assert r.status == 200
+            payload = await r.json()
+            assert payload["enabled"] is True
+            assert payload["policy"]["name"] == "default"
+            scopes = {s["scope"] for s in payload["scopes"]}
+            assert "main/svc" in scopes
+        finally:
+            await client.close()
+            process_slo.reset_slo_engine()
+
+    async def test_api_slo_disabled(self, monkeypatch):
+        monkeypatch.setattr(process_slo, "_engine", None)
+        monkeypatch.setattr(obs_slo, "_enabled", False)
+        app = await _app()
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/api/slo")
+            assert r.status == 200
+            assert (await r.json()) == {"enabled": False}
+        finally:
+            await client.close()
+            process_slo.reset_slo_engine()
+
+
+class TestSLOBurnAutoscaler:
+    def _scaler(self, target=1.0) -> SLOBurnAutoscaler:
+        return SLOBurnAutoscaler(
+            IntRange(min=1, max=8),
+            ScalingSpec(
+                metric="slo-burn", target=target,
+                scale_up_delay=0, scale_down_delay=0,
+            ),
+        )
+
+    def test_selected_by_metric(self):
+        from dstack_tpu.core.models.configurations import (
+            ServiceConfiguration,
+        )
+
+        conf = ServiceConfiguration(
+            commands=["serve"], port=8000,
+            replicas={"min": 1, "max": 4},
+            scaling={"metric": "slo-burn", "target": 2.0},
+        )
+        assert isinstance(get_service_scaler(conf), SLOBurnAutoscaler)
+
+    def test_scales_proportionally_on_burn(self, monkeypatch):
+        monkeypatch.setattr(
+            process_slo, "_engine",
+            SimpleNamespace(fleet_burn=lambda scope: 4.0),
+        )
+        try:
+            desired = self._scaler(target=1.0).get_desired_count(
+                "main", "svc", current=2, last_scaled_at=None
+            )
+            # ceil(2 * 4 / 1) = 8, capped at doubling -> 4
+            assert desired == 4
+        finally:
+            process_slo.reset_slo_engine()
+
+    def test_burn_below_target_holds_floor(self, monkeypatch):
+        monkeypatch.setattr(
+            process_slo, "_engine",
+            SimpleNamespace(fleet_burn=lambda scope: 0.5),
+        )
+        try:
+            desired = self._scaler(target=1.0).get_desired_count(
+                "main", "svc", current=3, last_scaled_at=None
+            )
+            assert desired == 1  # lo: burn within budget, no RPS either
+        finally:
+            process_slo.reset_slo_engine()
+
+    def test_no_verdict_falls_back_to_rps(self, monkeypatch):
+        monkeypatch.setattr(
+            process_slo, "_engine",
+            SimpleNamespace(fleet_burn=lambda scope: None),
+        )
+        try:
+            desired = self._scaler(target=1.0).get_desired_count(
+                "main", "svc", current=2, last_scaled_at=None
+            )
+            assert desired == 1  # rps floor (no traffic recorded)
+        finally:
+            process_slo.reset_slo_engine()
