@@ -38,6 +38,22 @@ _ERRORS = {
 }
 
 
+def flight_query(
+    limit: Optional[int] = None, postmortems: Optional[int] = None
+) -> str:
+    """The ``/debug/flight`` query string — ONE builder shared by
+    :meth:`APIClient.get_flight` and the ``dtpu flight --url`` path so
+    their param handling cannot drift (both params use ``is not
+    None``: an explicit 0 must reach the server, not silently fall to
+    its default)."""
+    params = []
+    if limit is not None:
+        params.append(f"limit={int(limit)}")
+    if postmortems is not None:
+        params.append(f"postmortems={int(postmortems)}")
+    return ("?" + "&".join(params)) if params else ""
+
+
 class APIClient:
     def __init__(self, base_url: str, token: str):
         self.base_url = base_url.rstrip("/")
@@ -90,6 +106,20 @@ class APIClient:
         else:
             q = ""
         return self._get("/debug/traces" + q)
+
+    # engine flight recorder (obs.flight; docs/reference/server.md)
+    def get_flight(
+        self,
+        limit: Optional[int] = None,
+        postmortems: Optional[int] = None,
+    ) -> dict:
+        """``GET /debug/flight`` — the target process's flight ring,
+        compile accounting, memory watermarks, and post-mortems. Only
+        serve replicas carry a flight recorder; against the control
+        plane this 404s (point ``dtpu flight --url`` at a replica)."""
+        return self._get(
+            "/debug/flight" + flight_query(limit, postmortems)
+        )
 
     # live SLO engine (obs.slo; docs/reference/server.md)
     def get_slo(self) -> dict:

@@ -985,6 +985,162 @@ def slo(action, interval, project) -> None:
         pass
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    v = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if v < 1024 or unit == "TiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024
+    return str(n)
+
+
+def render_flight_tables(payload: dict) -> list:
+    """``GET /debug/flight`` payload → rich tables (separate from the
+    command so tests can assert the rendering without a server): the
+    step-timeline waterfall (most recent records), the per-fn compile
+    summary, and the post-mortem list."""
+    records = payload.get("records") or []
+    timeline = Table(title="flight timeline (most recent last)")
+    for col in ("SEQ", "PHASE", "SLOTS", "TOK", "DISPATCH", "HOST", "DETAIL"):
+        timeline.add_column(col)
+    t_end = max((r.get("t") or 0.0 for r in records), default=0.0)
+    for r in records:
+        detail = []
+        if r.get("g") is not None:
+            detail.append(f"g={r['g']} c={r.get('cl')}")
+        if r.get("fn"):
+            detail.append(
+                f"{r['fn']}"
+                + (f"[{r['key']}]" if r.get("key") else "")
+                + f" {r.get('seconds', 0):.3f}s"
+            )
+        if r.get("trace"):
+            detail.append(f"trace={r['trace']}")
+        if r.get("mem_peak_bytes") is not None:
+            detail.append(f"peak={_fmt_bytes(r['mem_peak_bytes'])}")
+        phase = r.get("phase", "")
+        if phase in ("recompile", "wedge"):
+            phase = f"[red]{phase}[/red]"
+        slots = r.get("slots")
+        if slots is None and r.get("slot") is not None:
+            slots = [r["slot"]]
+        timeline.add_row(
+            str(r.get("seq", "")),
+            phase,
+            ",".join(str(s) for s in slots) if slots else "",
+            str(r.get("tokens", "")),
+            (
+                f"{r['dispatch_s'] * 1e3:.1f}ms"
+                if r.get("dispatch_s") is not None else ""
+            ),
+            (
+                f"{r['host_s'] * 1e3:.1f}ms"
+                if r.get("host_s") is not None else ""
+            ),
+            " ".join(detail) + (
+                f" (T-{t_end - r['t']:.1f}s)" if r.get("t") else ""
+            ),
+        )
+    compile_block = payload.get("compile") or {}
+    compiles = Table(title="compile accounting")
+    for col in ("FN", "COMPILES", "RECOMPILES", "SECONDS"):
+        compiles.add_column(col)
+    for fn, row in sorted((compile_block.get("fns") or {}).items()):
+        rc = row.get("recompiles", 0)
+        compiles.add_row(
+            fn,
+            str(row.get("compiles", 0)),
+            f"[red]{rc}[/red]" if rc else "0",
+            f"{row.get('seconds', 0.0):.3f}",
+        )
+    pms = Table(title="post-mortems")
+    for col in ("REASON", "SEQ", "WEDGE", "RECORDS", "LAST RECORD"):
+        pms.add_column(col)
+    for pm in payload.get("postmortems") or []:
+        recs = pm.get("records") or []
+        last = recs[-1] if recs else {}
+        last_s = last.get("phase", "")
+        if last.get("slot") is not None:
+            last_s += f" slot={last['slot']}"
+        if last.get("trace"):
+            last_s += f" trace={last['trace']}"
+        pms.add_row(
+            pm.get("reason", ""),
+            str(pm.get("seq", "")),
+            str((pm.get("ctx") or {}).get("wedge", "")),
+            str(len(recs)),
+            last_s,
+        )
+    return [timeline, compiles, pms]
+
+
+@cli.command()
+@click.option(
+    "--url", default=None,
+    help="query this base URL's /debug/flight (an OpenAI-serve "
+         "replica) instead of the configured server",
+)
+@click.option(
+    "--limit", type=int, default=30,
+    help="flight records to show (most recent)",
+)
+@click.option(
+    "--postmortems", "pm_limit", type=int, default=None,
+    help="post-mortem snapshots to include",
+)
+@click.option("--project", default=None)
+def flight(url, limit, pm_limit, project) -> None:
+    """Inspect the engine flight recorder (GET /debug/flight).
+
+    Renders the per-step timeline waterfall (phase, batch composition,
+    dispatch vs host wall time, tokens), the per-fn XLA compile
+    accounting with steady-state recompiles highlighted, memory
+    watermarks, and watchdog/error post-mortems. Only serve replicas
+    carry a flight recorder — point --url at one."""
+    if url:
+        import requests
+
+        from dstack_tpu.api.http_client import flight_query
+
+        q = flight_query(limit, pm_limit)
+        resp = requests.get(url.rstrip("/") + "/debug/flight" + q, timeout=15)
+        if resp.status_code >= 400:
+            _die(f"{url} answered {resp.status_code}: {resp.text[:200]}")
+        payload = resp.json()
+    else:
+        client = _client(project)
+        try:
+            payload = client.api.get_flight(
+                limit=limit, postmortems=pm_limit
+            )
+        except DstackTPUError as e:
+            _die(
+                f"{e} — the flight recorder lives on serve replicas; "
+                "try --url http://<replica>:<port>"
+            )
+    if not payload.get("enabled", True):
+        _die("the flight recorder is disabled on the target (DTPU_FLIGHT=0)")
+    mem = payload.get("memory") or {}
+    mem_s = (
+        f"in use {_fmt_bytes(mem.get('bytes_in_use'))}, peak "
+        f"{_fmt_bytes(mem.get('peak_bytes_in_use'))}"
+        if mem.get("available")
+        else "unavailable on this backend"
+    )
+    console.print(
+        f"seq [bold]{payload.get('seq', 0)}[/bold] · device memory: {mem_s}"
+    )
+    for t in render_flight_tables(payload):
+        console.print(t)
+    if not payload.get("records"):
+        console.print(
+            "no flight records retained (send traffic, or raise "
+            "DTPU_FLIGHT_BUFFER)"
+        )
+
+
 @cli.command()
 @click.option("--tpu", "tpu_spec", default=None, help="e.g. v5e-8 or v5p")
 @click.option("--spot/--on-demand", default=None)

@@ -249,12 +249,32 @@ def _bucket_stats(
     }
 
 
+def _window_compile_stalls(
+    flight_events: Sequence[dict], w: EventWindow
+) -> dict:
+    """Compile activity inside one window, from the flight recorder's
+    soak-relative event list (``{"t": rel_s, "fn", "seconds",
+    "recompile"}``): a tail-amplification window whose worst requests
+    line up with compile seconds is a compile stall, not a routing or
+    queueing problem."""
+    hits = [e for e in flight_events if w.covers(float(e.get("t", -1.0)))]
+    return {
+        "events": len(hits),
+        "recompiles": sum(1 for e in hits if e.get("recompile")),
+        "seconds": round(
+            sum(float(e.get("seconds", 0.0)) for e in hits), 4
+        ),
+        "fns": sorted({e.get("fn") for e in hits if e.get("fn")}),
+    }
+
+
 def evaluate(
     records: Sequence[RequestRecord],
     class_slos: Dict[str, Tuple[float, float]],
     duration_s: float,
     windows: Sequence[EventWindow] = (),
     trace_lookup=None,
+    flight_events: Optional[Sequence[dict]] = None,
 ) -> dict:
     """Score one soak run → the report's analysis block.
 
@@ -264,7 +284,11 @@ def evaluate(
     baseline. ``trace_lookup`` (``trace_id → obs.tracing trace dict or
     None``, optional) attributes each window's worst requests to their
     dominant span phase — the "WHY did the kill window amplify TTFT
-    2×" block of the artifact."""
+    2×" block of the artifact. ``flight_events`` (optional, from the
+    engine flight recorder, timestamps already soak-relative) adds a
+    ``compile_stalls`` block per window so a tail spike caused by an
+    XLA compile — a steady-state recompile especially — is
+    attributable as such."""
     records = list(records)
     per_class: Dict[str, dict] = {}
     for name, slos in sorted(class_slos.items()):
@@ -312,6 +336,10 @@ def evaluate(
             if trace_lookup is not None:
                 blk["worst_requests"] = _worst_request_phases(
                     in_w, trace_lookup
+                )
+            if flight_events is not None:
+                blk["compile_stalls"] = _window_compile_stalls(
+                    flight_events, w
                 )
             window_blocks[w.name] = blk
         bg, tg = baseline["goodput_ratio"], tail["goodput_ratio"]

@@ -421,6 +421,17 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
                 ))
                 chaos_tasks.append(slo_task)
         await _warmup(replicas, cfg.model, ascii_bias)
+        # flight steady state: the HTTP warmup covered every shape
+        # bucket the schedule exercises, and the prefix-copy grid
+        # compiles lazily per reuse length — precompile it like the
+        # server warmup does (the flight recorder FOUND this gap: the
+        # first soak flagged mid-soak `copy` compiles as steady-state
+        # recompiles). From here on any compile the timed soak
+        # observes is a real recompile — flagged in the artifact's
+        # flight block and attributable to its tail window.
+        for r in replicas:
+            r.engine.warm_prefix_copies()
+            r.engine.mark_flight_warm()
 
         windows: List[EventWindow] = []
         if cfg.chaos:
@@ -463,10 +474,26 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
             registry=new_loadgen_registry(),
         )
         r0 = _snapshot(get_router_registry(), _ROUTER_FAMILIES)
+        # flight-recorder baseline: the artifact's flight block deltas
+        # compile/post-mortem accounting over the TIMED soak only
+        # (warmup compiles are the point of warmup, not a finding)
+        from dstack_tpu.obs import flight as obs_flight
+
+        flight_rec = obs_flight.get_recorder()
+        f0 = (
+            flight_rec.compile_totals() if flight_rec is not None else None
+        )
+        # monotonic capture count, NOT len(postmortems()): the snapshot
+        # buffer saturates at POSTMORTEM_KEEP, which would undercount a
+        # stormy soak and zero out back-to-back soaks in one process
+        pm0 = (
+            flight_rec.postmortems_total() if flight_rec is not None else 0
+        )
         # schedule-time anchor for the live SLO transition timeline
         # (the chaos tasks anchored their sleeps moments earlier; the
         # skew is milliseconds against seconds-scale windows)
         soak_t0 = time.monotonic()
+        wall_t0 = time.time()  # flight events carry wall-clock stamps
         records = await driver.run(schedule.events)
         router_delta = {
             k: int(v - r0[k])
@@ -506,12 +533,57 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
     # sized it to the schedule) holds the STITCHED trace — router legs
     # + replica phases — for the report to attribute each window's
     # worst requests from
+    # flight block: compile/post-mortem deltas over the timed soak +
+    # memory watermarks, and the soak-relative compile-event list so
+    # the report can attribute tail-amplification windows to compile
+    # stalls (a steady-state recompile inside the kill window is a
+    # different finding than router retry overhead)
+    flight_block = None
+    flight_events: list = []
+    if flight_rec is not None and f0 is not None:
+        f1 = flight_rec.compile_totals()
+        mem = flight_rec.memory()
+        flight_events = [
+            {
+                "t": round(e["t"] - wall_t0, 3),
+                "fn": e["fn"],
+                "key": e.get("key"),
+                "seconds": e["seconds"],
+                "recompile": e.get("recompile", False),
+            }
+            for e in flight_rec.compile_events()
+            if e["t"] >= wall_t0
+        ]
+        flight_block = {
+            "compiles": {
+                fn: int(n - f0["compiles"].get(fn, 0))
+                for fn, n in f1["compiles"].items()
+                if n - f0["compiles"].get(fn, 0)
+            },
+            "recompiles": int(
+                sum(f1["recompiles"].values())
+                - sum(f0["recompiles"].values())
+            ),
+            "compile_seconds": round(
+                sum(f1["seconds"].values()) - sum(f0["seconds"].values()),
+                4,
+            ),
+            "postmortems": flight_rec.postmortems_total() - pm0,
+            "peak_memory_bytes": (
+                mem.get("peak_bytes_in_use")
+                if mem.get("available")
+                else None
+            ),
+            "memory_available": bool(mem.get("available")),
+            "events": flight_events,
+        }
     analysis = evaluate(
         records,
         {c.name: (c.ttft_slo_ms, c.tpot_slo_ms) for c in spec.classes},
         spec.duration_s,
         windows=windows,
         trace_lookup=obs_tracing.get_trace,
+        flight_events=flight_events if flight_block is not None else None,
     )
     info = backend_info()
     result = {
@@ -544,6 +616,10 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
         ),
         "backend": info["backend"],
         "note": info["note"],
+        # engine-side observability over the timed soak (obs/flight.py;
+        # same backend label as the artifact — CPU-fallback honesty
+        # applies to memory/compile numbers too)
+        "flight": flight_block,
         "slo": (
             {
                 "policy": slo_engine.policy.name,

@@ -582,7 +582,13 @@ class ReplicaPool:
                       # bucket deltas + request/error/shed counts per
                       # window, consumed by process_slo — the probe IS
                       # the transport, no new scrape protocol
-                      "slo_windows")
+                      "slo_windows",
+                      # engine observability (obs/flight.py +
+                      # obs/profiling.py): a replica stuck in a
+                      # profiler capture or a compile storm shows here
+                      # — probes carry the flight compile/recompile/
+                      # post-mortem counts and the is_tracing flag
+                      "profiler_tracing", "flight")
         }
         entry.last_probe_at = time.monotonic()
         self.report_success(entry)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dstack_tpu import faults
+from dstack_tpu.obs import flight
 from dstack_tpu.models import llama
 from dstack_tpu.models.llama import (
     LlamaConfig,
@@ -1883,34 +1884,53 @@ class InferenceEngine:
         # slots the most recent prefill_wave dispatched — the failure
         # domain a caller should release when that dispatch raises
         self.last_wave_slots: list = []
-        self._decode = jax.jit(
+        # flight recorder (obs/flight.py): every jit site below is
+        # wrapped for compile accounting — first-trace events counted
+        # and timed per fn with the causing bucket key — and a compile
+        # observed after mark_flight_warm() is flagged as a
+        # steady-state recompile (the runtime DTPU003). watch_jit is
+        # the IDENTITY when DTPU_FLIGHT=0, so disabled engines carry
+        # no wrapper at all. `_last_step_phase` names the dispatch
+        # path the current step() took for its flight record.
+        self._flight_warm = False
+        self._last_step_phase = "decode"
+        _watch = partial(
+            flight.watch_jit, registry=self.metrics,
+            warm=lambda: self._flight_warm,
+        )
+        self._watch_jit = _watch
+        self._decode = _watch(jax.jit(
             partial(
                 decode_step, config=config,
                 decode_kernel=self.decode_kernel, mesh=mesh,
             ),
             donate_argnums=(1,),
-        )
-        self._verify = jax.jit(
+        ), "decode")
+        self._verify = _watch(jax.jit(
             partial(
                 verify_step, config=config,
                 decode_kernel=self.decode_kernel, mesh=mesh,
             ),
             donate_argnums=(1,),
-        )
-        self._sample = jax.jit(sample)
+        ), "verify")
+        self._sample = _watch(jax.jit(sample), "sample")
         self._turbo_fns: dict = {}  # steps → jitted decode_loop
-        self._argmax = jax.jit(partial(jnp.argmax, axis=-1))
+        self._argmax = _watch(jax.jit(partial(jnp.argmax, axis=-1)), "argmax")
         # per-step device mirror of the slot-state transition (shared
         # with decode_loop's scan body): _plain_step advances the cached
         # decode state on device instead of re-uploading five host
         # lists per sampled token
-        self._advance_state = jax.jit(
+        self._advance_state = _watch(jax.jit(
             partial(advance_decode_state, max_seq=max_seq)
+        ), "advance_state")
+        self._logprobs = _watch(jax.jit(token_logprobs), "logprobs")
+        self._mark_seen = _watch(
+            jax.jit(_mark_seen, donate_argnums=(0, 1)), "mark_seen"
         )
-        self._logprobs = jax.jit(token_logprobs)
-        self._mark_seen = jax.jit(_mark_seen, donate_argnums=(0, 1))
-        self._mark_prompt = jax.jit(_mark_prompt, donate_argnums=(0, 1))
-        self._skip_key = jax.jit(skip_key_data)
+        self._mark_prompt = _watch(
+            jax.jit(_mark_prompt, donate_argnums=(0, 1)), "mark_prompt"
+        )
+        self._skip_key = _watch(jax.jit(skip_key_data), "skip_key")
         # watchdog plumbing: the serve scheduler runs step() on a worker
         # thread and may give up on a wedged dispatch (abandon_step).
         # The abandoned thread checks the epoch after every pre-dispatch
@@ -1934,20 +1954,20 @@ class InferenceEngine:
         key = (cl, start)
         if key not in self._chunk_fns:
             # dtpu: noqa[DTPU003] cl is power-of-2-bucketed and start chunk-aligned by prefill_step; grid ≤ log2(C) × (T/C)
-            self._chunk_fns[key] = jax.jit(
+            self._chunk_fns[key] = self._watch_jit(jax.jit(
                 partial(prefill_chunk_step, config=self.config, start=start),
                 donate_argnames=("cache",),
-            )
+            ), "chunk", key=key)
         return self._chunk_fns[key]
 
     def _packed_fn(self, g: int, cl: int):
         key = (g, cl)
         if key not in self._packed_fns:
             # dtpu: noqa[DTPU003] prefill_wave buckets g and cl to powers of two; grid ≤ log2(G) × log2(C), pinned by the compile-cache accounting test
-            self._packed_fns[key] = jax.jit(
+            self._packed_fns[key] = self._watch_jit(jax.jit(
                 partial(prefill_packed_step, config=self.config),
                 donate_argnames=("cache",),
-            )
+            ), "packed", key=key)
         return self._packed_fns[key]
 
     def _find_prefix_source(self, prompt: list) -> tuple[int, Optional[int]]:
@@ -1993,9 +2013,9 @@ class InferenceEngine:
         its variants can't drift from what start_request builds)."""
         if p not in self._copy_fns:
             # dtpu: noqa[DTPU003] p is chunk-aligned by _find_prefix_source (reuse // C * C), ≤ max_seq/prefill_chunk variants, warmup precompiles them
-            self._copy_fns[p] = jax.jit(
+            self._copy_fns[p] = self._watch_jit(jax.jit(
                 partial(copy_cache_prefix, p=p), donate_argnums=(0,)
-            )
+            ), "copy", key=p)
         return self._copy_fns[p]
 
     def _start_request_inner(self, prompt, gen, free, reuse_len, src) -> int:
@@ -2054,6 +2074,7 @@ class InferenceEngine:
         chunk = chunk + [0] * (cl - len(chunk))
         # logits index only matters on the final chunk
         last_ix = (tp - 1 - start) if final else (cl - 1)
+        t0 = time.perf_counter()
         logits, self.cache = self._chunk_fn(cl, start)(
             self.params,
             self.cache,
@@ -2063,6 +2084,19 @@ class InferenceEngine:
         )
         self.metrics.family("dtpu_serve_prefill_dispatches_total").inc(1)
         self.metrics.family("dtpu_serve_prefill_pack_rows").observe(1)
+        if flight.enabled():
+            # host-side data only (the DTPU002 contract): serial chunk
+            # at its static (C, start) bucket, one row
+            flight.record(
+                phase="prefill", slots=[slot], rows=1, g=1, cl=cl,
+                start=start, final=final,
+                dispatch_s=round(time.perf_counter() - t0, 6),
+                traces=(
+                    {slot: st["gen"].trace_id} if st["gen"].trace_id
+                    else None
+                ),
+                **self.fault_ctx,
+            )
         if not final:
             st["next"] = start + cl
             return None
@@ -2147,6 +2181,7 @@ class InferenceEngine:
             slot_ix.append(0)
             starts.append(0)
             last_ix.append(-1)
+        t0 = time.perf_counter()
         logits, self.cache = self._packed_fn(g, cl)(
             self.params,
             self.cache,
@@ -2157,6 +2192,20 @@ class InferenceEngine:
         )
         self.metrics.family("dtpu_serve_prefill_dispatches_total").inc(1)
         self.metrics.family("dtpu_serve_prefill_pack_rows").observe(len(rows))
+        if flight.enabled():
+            # batch composition straight from the wave's host lists:
+            # the (G, C) bucket, real rows packed, per-row starts
+            flight.record(
+                phase="prefill_packed", g=g, cl=cl, rows=len(rows),
+                slots=list(rows), starts=starts[: len(rows)],
+                dispatch_s=round(time.perf_counter() - t0, 6),
+                traces={
+                    s: states[s]["gen"].trace_id
+                    for s in rows
+                    if states[s]["gen"].trace_id
+                } or None,
+                **self.fault_ctx,
+            )
         out: dict[int, int] = {}
         for i, s in enumerate(rows):
             st = self._prefilling.get(s)
@@ -2332,6 +2381,7 @@ class InferenceEngine:
         histograms — recorded here, at the engine, so the HTTP server
         and the offline bench export identical numbers."""
         epoch = self._step_epoch
+        t_all0 = time.perf_counter()
         # chaos hook (no-op calls when no plan is installed), fired once
         # per live slot with ctx slot=<i>: a raise provokes mid-decode
         # engine death (the scheduler loop must fail only the inflight
@@ -2381,6 +2431,29 @@ class InferenceEngine:
                 m.family("dtpu_serve_decode_tokens_per_sec").observe(
                     n_tokens / dt
                 )
+            if flight.enabled():
+                # one flight record per emitting step — strictly
+                # host-side fields (slot lists, perf counters, the
+                # prefix-registry snapshot; DTPU002-clean), with the
+                # trace ids riding the step for post-mortem stitching
+                flight.record(
+                    phase=self._last_step_phase,
+                    slots=list(out),
+                    tokens=n_tokens,
+                    dispatch_s=round(dt, 6),
+                    host_s=round(
+                        max(0.0, time.perf_counter() - t_all0 - dt), 6
+                    ),
+                    kv_util=round(self.kv_cache_utilization(), 4),
+                    prefix_slots=len(self._prefix_registry),
+                    traces={
+                        s: self._trace_ids[s]
+                        for s in out
+                        if s in self._trace_ids
+                    } or None,
+                    **self.fault_ctx,
+                )
+                flight.maybe_poll_memory(self.metrics)
         return out
 
     def _step_dispatch(self) -> dict:
@@ -2394,13 +2467,16 @@ class InferenceEngine:
             # non-drafting slots pay ~(S×) decode compute for nothing —
             # speculate only when at least half the batch drafts
             if drafting and drafting * 2 >= len(live):
+                self._last_step_phase = "spec"
                 return self._spec_step(live, drafts)
         if (
             self.turbo_steps > 1
             and not self._prefilling  # don't starve queued prompt chunks
             and self._all_greedy(live)
         ):
+            self._last_step_phase = "turbo"
             return self._turbo_step(live)
+        self._last_step_phase = "decode"
         return {i: [tok] for i, tok in self._plain_step(live).items()}
 
     def _spec_step(self, live: list, drafts: dict) -> dict:
@@ -2479,14 +2555,14 @@ class InferenceEngine:
     def _turbo_fn(self, steps: int):
         if steps not in self._turbo_fns:
             # dtpu: noqa[DTPU003] _turbo_step buckets steps to powers of two capped at turbo_steps; ≤ log2(turbo_steps) variants
-            self._turbo_fns[steps] = jax.jit(
+            self._turbo_fns[steps] = self._watch_jit(jax.jit(
                 partial(
                     decode_loop, config=self.config, steps=steps,
                     max_seq=self.max_seq,
                     decode_kernel=self.decode_kernel, mesh=self._mesh,
                 ),
                 donate_argnums=(1,),
-            )
+            ), "turbo", key=steps)
         return self._turbo_fns[steps]
 
     def _invalidate_decode_cache(self) -> None:
@@ -2741,6 +2817,34 @@ class InferenceEngine:
         phase = self._step_wedge
         self._step_epoch += 1
         self._step_wedge = None
+        if phase is not None and flight.enabled():
+            # flight-record the wedge itself — the attribution the
+            # post-mortem's LAST record carries: the wedged slot and
+            # its trace id when attributable, a dispatch marker when
+            # the jitted dispatch hung with no single culprit
+            if phase[0] == "slot":
+                flight.record(
+                    phase="wedge", slot=phase[1],
+                    trace=self._trace_ids.get(phase[1]),
+                    **self.fault_ctx,
+                )
+            else:
+                flight.record(
+                    phase="wedge", dispatch=True, **self.fault_ctx
+                )
+            flight.post_mortem(
+                "watchdog_abort",
+                registry=self.metrics,
+                wedge=(
+                    f"slot:{phase[1]}" if phase[0] == "slot" else "dispatch"
+                ),
+                slots={
+                    i: self._trace_ids.get(i)
+                    for i in range(self.max_batch)
+                    if self.active[i]
+                },
+                **self.fault_ctx,
+            )
         return phase
 
     def finish_abandoned_step(self) -> None:
@@ -2757,6 +2861,38 @@ class InferenceEngine:
         self._admit_t0.pop(slot, None)
         self._trace_ids.pop(slot, None)
         self._last_logprobs.pop(slot, None)
+
+    def warm_prefix_copies(self) -> None:
+        """Pre-compile every chunk-aligned prefix-copy variant (slot 0
+        onto itself is a semantic no-op — trivial fused copies, but a
+        cold jit inside start_request would land the compile wait on a
+        production request's TTFT, and a post-warmup compile is
+        exactly what the flight recorder flags as a recompile). ONE
+        copy of the loop shared by the server warmup and the soak
+        harness, so their definitions of "warm" cannot drift."""
+        if not self.prefix_cache:
+            return
+        # dtpu: noqa[DTPU002] one-time warmup constant (slot index 0), uploaded once outside any dispatch path
+        zero = jnp.asarray(0, jnp.int32)
+        p = self.prefill_chunk
+        while p < self.max_seq:
+            self.cache = self.get_copy_fn(p)(self.cache, zero, zero)
+            p += self.prefill_chunk
+
+    def mark_flight_warm(self) -> None:
+        """Declare the warmup complete: every expected compile variant
+        exists, so any compile the flight recorder observes from here
+        on is a STEADY-STATE RECOMPILE — flagged as a ``recompile``
+        ring record + ``dtpu_serve_recompiles_total`` (the runtime
+        complement of lint rule DTPU003's bucketing pragmas). Called
+        by the server warmup and the soak harness after their warmup
+        traffic; per-engine, so one process's replicas warm
+        independently."""
+        self._flight_warm = True
+
+    @property
+    def flight_warm(self) -> bool:
+        return self._flight_warm
 
     def reset_prefix_cache(self) -> None:
         """Forget every registered reusable prompt prefix (no device
@@ -2812,6 +2948,25 @@ class InferenceEngine:
         m.family("dtpu_serve_prefix_slots").set(
             self.prefix_stats()["prefix_slots"]
         )
+        # compile-cache footprint of the memoized jit grids (the
+        # log2-bucket contracts bound these; a growing gauge in steady
+        # state is the compile-storm signal the recompile counter
+        # explains)
+        m.family("dtpu_serve_compile_cache_entries").set(
+            len(self._chunk_fns), "chunk"
+        )
+        m.family("dtpu_serve_compile_cache_entries").set(
+            len(self._packed_fns), "packed"
+        )
+        m.family("dtpu_serve_compile_cache_entries").set(
+            len(self._turbo_fns), "turbo"
+        )
+        m.family("dtpu_serve_compile_cache_entries").set(
+            len(self._copy_fns), "copy"
+        )
+        # scrape-time device-memory freshness (throttled; honest
+        # no-op on backends without stats)
+        flight.maybe_poll_memory(m)
 
     def generate(self, prompt: list[int], gen: GenParams) -> list[int]:
         """Convenience single-prompt generation (tests, CLI)."""

@@ -121,6 +121,65 @@ def new_serve_registry() -> Registry:
         "resume extension (prompt re-prefilled with already-delivered "
         "tokens; admission charge stays on the original leg)",
     )
+    # XLA compile accounting (obs/flight.py watch_jit wrappers): the
+    # `fn` label is the bounded enum of engine jit sites — decode/
+    # verify/sample/argmax/advance_state/logprobs/mark_seen/
+    # mark_prompt/skip_key plus the memoized grids chunk/packed/turbo/
+    # copy — never a request-derived value
+    r.counter(
+        "dtpu_serve_compiles_total",
+        "XLA trace/compile events per engine jit site (first call of a "
+        "new shape/bucket variant; the causing bucket key rides the "
+        "flight ring's compile records)",
+        labelnames=("fn",),
+    )
+    r.histogram(
+        "dtpu_serve_compile_seconds",
+        "Wall time of compile-triggering calls per jit site (trace + "
+        "compile + first execution — the cost the triggering request "
+        "actually paid)",
+        labelnames=("fn",),
+        buckets=LATENCY_BUCKETS_S,
+    )
+    r.counter(
+        "dtpu_serve_recompiles_total",
+        "Steady-state recompiles: compile events observed AFTER "
+        "warmup declared the engine warm — each one is a live "
+        "TTFT/TPOT stall some request paid: an unwarmed grid cell "
+        "(warmup coverage gap) or a broken power-of-two bucketing "
+        "contract (the runtime complement of lint rule DTPU003). "
+        "Identical steady traffic must never advance this (pinned by "
+        "the two-pass regression test)",
+        labelnames=("fn",),
+    )
+    r.gauge(
+        "dtpu_serve_compile_cache_entries",
+        "Entries in the engine's memoized jit grids (fn = chunk/"
+        "packed/turbo/copy) — the compile-cache footprint the "
+        "log2-bucket contracts bound",
+        labelnames=("fn",),
+    )
+    r.counter(
+        "dtpu_serve_postmortems_total",
+        "Flight post-mortem snapshots captured FOR THIS ENGINE "
+        "(watchdog aborts, engine/prefill errors, deadline "
+        "batch-aborts) — the per-replica signal /health embeds; the "
+        "process-wide ring count is dtpu_flight_postmortems_total",
+    )
+    # device-memory watermarks (best-effort jax memory_stats; absent —
+    # not zero — on backends without stats, e.g. CPU jaxlib)
+    r.gauge(
+        "dtpu_serve_device_memory_bytes_in_use",
+        "Device HBM bytes in use, summed across local devices "
+        "(best-effort jax memory_stats; series absent when the "
+        "backend exposes no stats)",
+    )
+    r.gauge(
+        "dtpu_serve_device_memory_peak_bytes",
+        "Running peak of device HBM bytes in use since engine start "
+        "(high-water mark across polls; series absent when the "
+        "backend exposes no stats)",
+    )
     # prefix cache
     r.counter(
         "dtpu_serve_prefix_hits_total",

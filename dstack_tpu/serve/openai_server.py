@@ -53,6 +53,8 @@ from typing import Optional
 from aiohttp import web
 
 from dstack_tpu import faults, qos
+from dstack_tpu.obs import flight
+from dstack_tpu.obs import profiling as obs_profiling
 from dstack_tpu.obs import slo as obs_slo
 from dstack_tpu.obs import tracing
 from dstack_tpu.obs.tracing import get_trace_registry
@@ -248,6 +250,22 @@ class Scheduler:
                 del table[slot]
                 self.engine.release(slot)
                 self._fail_deadline(req)
+            if expired and flight.enabled():
+                # deadline batch-abort: the post-mortem names the
+                # aborted slots and their traces so a deadline storm
+                # is attributable after the fact
+                flight.post_mortem(
+                    "deadline_abort",
+                    registry=self.engine.metrics,
+                    slots={
+                        slot: (
+                            req.span.trace_id if req.span.recording
+                            else None
+                        )
+                        for slot, req in expired
+                    },
+                    **self.engine.fault_ctx,
+                )
         if self.pending.qsize():
             for req in self.pending.drain_matching(self._deadline_expired):
                 self._fail_deadline(req)
@@ -379,6 +397,13 @@ class Scheduler:
                 raise
             except Exception as e:  # noqa: BLE001 - reported per request
                 logger.exception("scheduler tick failed: %s", e)
+                flight.post_mortem(
+                    "engine_error",
+                    registry=self.engine.metrics,
+                    error=str(e)[:200],
+                    slots=sorted(self.by_slot),
+                    **self.engine.fault_ctx,
+                )
                 for slot, req in list(self.by_slot.items()):
                     self.engine.release(slot)
                     self._count_error(req)
@@ -544,6 +569,13 @@ class Scheduler:
                 firsts = await asyncio.to_thread(self.engine.prefill_wave)
             except Exception as e:  # noqa: BLE001 - reported per request
                 logger.exception("prefill failed: %s", e)
+                flight.post_mortem(
+                    "prefill_error",
+                    registry=self.engine.metrics,
+                    error=str(e)[:200],
+                    slots=list(self.engine.last_wave_slots),
+                    **self.engine.fault_ctx,
+                )
                 # fail exactly the rows that were in the failing
                 # dispatch (the engine publishes them before running);
                 # prompts beyond prefill_pack never ran and keep their
@@ -1054,6 +1086,27 @@ def build_app(
             # affinity score can tell a warm registry from a cold one
             # (routing/pool.py, serving.md §10)
             **e.prefix_stats(),
+            # a replica wedged inside a profiler capture (multi-GB
+            # trace writes stall the event loop) or a compile storm
+            # must be VISIBLE to probes: is_tracing plus THIS ENGINE's
+            # compile/recompile/post-mortem counts — read from the
+            # engine's own registry, not the process-global recorder,
+            # so multi-replica-in-one-process harnesses attribute a
+            # storm to the replica actually having it
+            "profiler_tracing": obs_profiling.is_tracing(),
+            "flight": {
+                "enabled": flight.enabled(),
+                "warm": e.flight_warm,
+                "compiles": int(
+                    m.family("dtpu_serve_compiles_total").total()
+                ),
+                "recompiles": int(
+                    m.family("dtpu_serve_recompiles_total").total()
+                ),
+                "postmortems": int(
+                    m.family("dtpu_serve_postmortems_total").value()
+                ),
+            },
         }
         if replica_slo_state is not None:
             # rolling per-window TTFT/queue-wait/TPOT bucket deltas +
@@ -1090,7 +1143,8 @@ def build_app(
         return web.Response(
             text=e.metrics.render() + get_qos_registry().render()
             + get_trace_registry().render()
-            + obs_slo.get_slo_registry().render(),
+            + obs_slo.get_slo_registry().render()
+            + flight.get_flight_registry().render(),
             content_type="text/plain",
         )
 
@@ -1100,6 +1154,14 @@ def build_app(
         ``?slowest=N`` — same contract as the server's and gateway's
         endpoints, docs/reference/server.md "Tracing")."""
         return web.json_response(tracing.debug_payload(request.query))
+
+    async def debug_flight(request):
+        """The engine flight recorder: per-step timeline ring, compile
+        accounting, device-memory watermarks, and post-mortem
+        snapshots (``?limit=`` / ``?postmortems=`` — same exposure
+        gate as ``/debug/traces``; docs/reference/server.md "Flight
+        recorder")."""
+        return web.json_response(flight.debug_payload(request.query))
 
     import dataclasses as _dc
 
@@ -1683,26 +1745,25 @@ def build_app(
     app.router.add_get("/health", health)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/traces", debug_traces)
+    app.router.add_get("/debug/flight", debug_flight)
     app.router.add_get("/v1/models", models)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
 
-    from dstack_tpu.obs import profiling as _profiling
-
-    if _profiling.profiler_dir():
+    if obs_profiling.profiler_dir():
         # on-demand JAX profiler capture, registered ONLY when
         # DTPU_PROFILER_DIR is set (an always-on unauthenticated knob
         # that writes multi-GB traces would be a production footgun)
         async def profiler_start(request):
             try:
-                return web.json_response(_profiling.start_trace())
+                return web.json_response(obs_profiling.start_trace())
             except RuntimeError as e:
                 return web.json_response({"detail": str(e)}, status=409)
 
         async def profiler_stop(request):
             try:
-                return web.json_response(_profiling.stop_trace())
+                return web.json_response(obs_profiling.stop_trace())
             except RuntimeError as e:
                 return web.json_response({"detail": str(e)}, status=409)
 
@@ -2013,20 +2074,11 @@ def _warmup_engine(engine) -> None:
     # candidates (a production prompt sharing their byte pattern would
     # silently reuse warmup KV rows)
     engine.reset_prefix_cache()
-    if engine.prefix_cache:
-        # pre-compile every chunk-aligned prefix-copy variant (trivial
-        # fused copies, but a cold jit inside start_request would put
-        # the compile wait on a production request's TTFT); slot 0 onto
-        # itself is a semantic no-op
-        import jax.numpy as _jnp
-
-        p = engine.prefill_chunk
-        while p < engine.max_seq:
-            engine.cache = engine.get_copy_fn(p)(
-                engine.cache, _jnp.asarray(0, _jnp.int32),
-                _jnp.asarray(0, _jnp.int32),
-            )
-            p += engine.prefill_chunk
+    engine.warm_prefix_copies()
+    # flight recorder steady state begins HERE: every expected compile
+    # variant now exists, so any later compile is a recompile —
+    # flagged loudly as the runtime complement of DTPU003
+    engine.mark_flight_warm()
     logger.info(
         "warmup: %d requests compiled prefill/decode/sample%s in %.1fs",
         runs, "/verify" if spec else "", time.time() - t0,

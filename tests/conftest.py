@@ -101,6 +101,14 @@ _QUICK_KEEP = (
     "test_slo.py::TestBucketEstimators",
     "test_slo.py::TestAlertDeterminism",
     "test_chaos_slo.py::TestLiveSLOChaosAcceptance",
+    # engine flight recorder: ring/compile/no-op contract (tests/obs),
+    # the steady-state recompile regression gate (tests/serve), and
+    # the watchdog post-mortem acceptance (tests/chaos) — listed so a
+    # rename fails test_quick_tier loudly
+    "test_flight.py::TestCompileAccounting",
+    "test_flight.py::TestDisabledIsNoop",
+    "test_engine.py::TestSteadyStateRecompiles",
+    "test_chaos_flight.py::TestFlightChaosAcceptance",
 )
 
 

@@ -240,3 +240,51 @@ class TestTracePhaseAttribution:
             windows=[EventWindow("kill", 4.0, 6.0)],
         )
         assert "worst_requests" not in r["windows"]["kill"]
+
+
+class TestCompileStallAttribution:
+    """PR-15 flight attribution: windows resolve compile activity from
+    the flight recorder's soak-relative event list, so a tail spike
+    caused by an XLA compile stall — a steady-state recompile
+    especially — is attributable as such (stdlib only, synthetic
+    events)."""
+
+    _EVENTS = [
+        {"t": 1.0, "fn": "chunk", "seconds": 0.2, "recompile": False},
+        {"t": 4.5, "fn": "packed", "seconds": 0.8, "recompile": True},
+        {"t": 4.9, "fn": "decode", "seconds": 0.3, "recompile": False},
+        {"t": 7.0, "fn": "turbo", "seconds": 0.1, "recompile": False},
+    ]
+
+    def test_windows_gain_compile_stalls(self):
+        records = [
+            _rec("b0", t=1.0, ttft=0.05),
+            _rec("w0", t=4.2, ttft=0.5),
+        ]
+        r = evaluate(
+            records, SLOS, 10.0,
+            windows=[EventWindow("kill", 4.0, 6.0)],
+            flight_events=self._EVENTS,
+        )
+        stalls = r["windows"]["kill"]["compile_stalls"]
+        assert stalls["events"] == 2
+        assert stalls["recompiles"] == 1
+        assert stalls["seconds"] == 1.1
+        assert stalls["fns"] == ["decode", "packed"]
+
+    def test_no_events_no_block(self):
+        r = evaluate(
+            [_rec("w0", t=4.2)], SLOS, 10.0,
+            windows=[EventWindow("kill", 4.0, 6.0)],
+        )
+        assert "compile_stalls" not in r["windows"]["kill"]
+        # an empty list still produces an honest zero block (flight on,
+        # nothing compiled — steady state held)
+        r = evaluate(
+            [_rec("w0", t=4.2)], SLOS, 10.0,
+            windows=[EventWindow("kill", 4.0, 6.0)],
+            flight_events=[],
+        )
+        assert r["windows"]["kill"]["compile_stalls"] == {
+            "events": 0, "recompiles": 0, "seconds": 0.0, "fns": [],
+        }

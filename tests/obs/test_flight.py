@@ -1,0 +1,346 @@
+"""obs.flight unit contract: bounded flight ring, compile accounting
+(first-trace detection, bucket keys, steady-state recompile flagging),
+device-memory honesty, post-mortem snapshots, the
+zero-cost-when-disabled no-op rebinding (the ``faults.fire`` idiom),
+and the import-light pin — the foundations the engine wiring stands
+on."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from dstack_tpu.obs import flight
+from dstack_tpu.obs.metrics import Registry
+from dstack_tpu.serve.metrics import new_serve_registry
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+@pytest.fixture(autouse=True)
+def _restore_recorder():
+    """Each test gets a fresh recorder and leaves the module state as
+    it found it (the process default is enabled via DTPU_FLIGHT)."""
+    prior = flight.get_recorder()
+    yield
+    if prior is not None:
+        flight._recorder = prior
+        flight.record = prior.record
+    else:
+        flight.disable()
+
+
+class _FakeJit:
+    """A stand-in jitted callable with the jax ``_cache_size``
+    introspection shape: the 'cache' grows whenever the call sees a
+    new ``shape`` kwarg — exactly how jit variants mint."""
+
+    def __init__(self):
+        self._shapes = set()
+
+    def _cache_size(self):
+        return len(self._shapes)
+
+    def __call__(self, shape=1):
+        self._shapes.add(shape)
+        return shape
+
+
+class _FakeJitNoIntrospection:
+    def __call__(self, shape=1):
+        return shape
+
+
+class TestFlightRing:
+    def test_records_seq_and_bounds(self):
+        rec = flight.enable(buffer=16)
+        for i in range(40):
+            flight.record(phase="decode", slots=[0], tokens=i)
+        records = rec.records(100)
+        assert len(records) == 16  # bounded
+        assert records[-1]["seq"] == 40  # seq keeps counting past drops
+        assert records[-1]["tokens"] == 39
+        assert records[0]["seq"] == 25
+        assert rec.seq == 40
+        total = flight.get_flight_registry().family(
+            "dtpu_flight_records_total"
+        )
+        assert total.value() >= 40
+
+    def test_none_fields_dropped_ctx_kept(self):
+        flight.enable(buffer=8)
+        flight.record(
+            phase="prefill_packed", g=4, cl=64, rows=3, traces=None,
+            replica="r1",
+        )
+        r = flight.get_recorder().records(1)[0]
+        assert "traces" not in r  # None fields never serialize
+        assert r["replica"] == "r1"  # fault_ctx-style fields ride along
+        assert r["g"] == 4 and r["cl"] == 64
+
+    def test_debug_payload_shapes(self):
+        flight.enable(buffer=8)
+        flight.record(phase="decode", slots=[1], tokens=2)
+        flight.post_mortem("engine_error", error="boom")
+        p = flight.debug_payload({})
+        assert p["enabled"]
+        assert p["records"][-1]["phase"] in ("decode",)
+        assert p["postmortems"][-1]["reason"] == "engine_error"
+        assert "memory" in p and "compile" in p
+        p = flight.debug_payload({"limit": "1", "postmortems": "0"})
+        assert len(p["records"]) == 1 and p["postmortems"] == []
+
+
+class TestCompileAccounting:
+    def test_first_trace_counted_with_key_and_registry(self):
+        rec = flight.enable(buffer=32)
+        reg = new_serve_registry()
+        fn = flight.watch_jit(
+            _FakeJit(), "packed", reg, key=(4, 64), warm=lambda: False
+        )
+        fn(shape=1)  # compiles
+        fn(shape=1)  # cached
+        fn(shape=2)  # new variant compiles
+        totals = rec.compile_totals()
+        assert totals["compiles"]["packed"] == 2
+        assert totals["recompiles"] == {}
+        assert totals["seconds"]["packed"] >= 0.0
+        assert reg.family("dtpu_serve_compiles_total").value("packed") == 2
+        assert reg.family("dtpu_serve_compile_seconds").count("packed") == 2
+        # the causing bucket key rides the ring's compile records
+        compiles = [
+            r for r in rec.records(50) if r["phase"] == "compile"
+        ]
+        assert len(compiles) == 2
+        assert compiles[0]["fn"] == "packed"
+        assert compiles[0]["key"] == repr((4, 64))
+
+    def test_steady_state_recompile_flagged(self):
+        rec = flight.enable(buffer=32)
+        reg = new_serve_registry()
+        warm = {"on": False}
+        fn = flight.watch_jit(
+            _FakeJit(), "chunk", reg, key=(64, 0), warm=lambda: warm["on"]
+        )
+        fn(shape=1)  # cold compile — fine
+        warm["on"] = True
+        fn(shape=1)  # cached — fine
+        fn(shape=9)  # NEW variant after warm: a steady-state recompile
+        totals = rec.compile_totals()
+        assert totals["compiles"]["chunk"] == 2
+        assert totals["recompiles"]["chunk"] == 1
+        assert reg.family("dtpu_serve_recompiles_total").value("chunk") == 1
+        last = rec.records(1)[0]
+        assert last["phase"] == "recompile"  # the flight annotation
+        assert last["fn"] == "chunk"
+        ev = rec.compile_events()
+        assert [e["recompile"] for e in ev] == [False, True]
+
+    def test_fallback_first_call_without_introspection(self):
+        rec = flight.enable(buffer=8)
+        fn = flight.watch_jit(_FakeJitNoIntrospection(), "sample")
+        fn()
+        fn()
+        assert rec.compile_totals()["compiles"] == {"sample": 1}
+
+    def test_watch_jit_identity_when_disabled(self):
+        flight.disable()
+        raw = _FakeJit()
+        assert flight.watch_jit(raw, "decode") is raw
+
+
+class TestDeviceMemory:
+    def test_cpu_backend_reports_honest_unavailable(self):
+        """CPU jaxlib exposes no memory_stats: the recorder must say
+        available=False, never fake zeros, and the gauges stay
+        absent."""
+        rec = flight.enable(buffer=8)
+        reg = new_serve_registry()
+        mem = rec.maybe_poll_memory(reg)
+        assert mem["available"] is False
+        # gauges never set → families render no samples
+        fam = reg.family("dtpu_serve_device_memory_bytes_in_use")
+        assert fam.items() == []
+        flight.record(phase="decode", slots=[0])
+        assert "mem_peak_bytes" not in rec.records(1)[0]
+
+    def test_poll_is_throttled(self):
+        rec = flight.enable(buffer=8)
+        rec.maybe_poll_memory()
+        t0 = rec._mem_t
+        rec.maybe_poll_memory()  # inside the interval: no new poll
+        assert rec._mem_t == t0
+
+    def test_peak_is_running_high_water_mark(self):
+        rec = flight.enable(buffer=8)
+        # simulate two polls where the backend's peak went DOWN (some
+        # allocators reset it): the recorder's watermark must not
+        rec._mem = {
+            "available": True, "bytes_in_use": 10,
+            "peak_bytes_in_use": 100, "bytes_limit": 0, "devices": 1,
+        }
+        flight.record(phase="decode", slots=[0])
+        assert rec.records(1)[0]["mem_peak_bytes"] == 100
+
+
+class TestPostMortems:
+    def test_snapshot_carries_tail_records_and_state(self):
+        rec = flight.enable(buffer=64)
+        for i in range(40):
+            flight.record(phase="decode", slots=[i % 4], tokens=1)
+        flight.record(phase="wedge", slot=2, trace="abc123")
+        pm = flight.post_mortem(
+            "watchdog_abort", wedge="slot:2", slots={2: "abc123"},
+        )
+        assert pm["reason"] == "watchdog_abort"
+        assert len(pm["records"]) == flight.POSTMORTEM_RECORDS
+        last = pm["records"][-1]
+        assert last["phase"] == "wedge"
+        assert last["slot"] == 2 and last["trace"] == "abc123"
+        assert pm["ctx"]["wedge"] == "slot:2"
+        assert "compile" in pm and "memory" in pm
+        assert flight.get_flight_registry().family(
+            "dtpu_flight_postmortems_total"
+        ).value() >= 1
+
+    def test_buffer_bounded_but_total_monotonic(self):
+        rec = flight.enable(buffer=8)
+        for i in range(flight.POSTMORTEM_KEEP + 5):
+            flight.post_mortem("engine_error", error=f"e{i}")
+        pms = rec.postmortems()
+        assert len(pms) == flight.POSTMORTEM_KEEP
+        assert pms[-1]["ctx"]["error"] == f"e{flight.POSTMORTEM_KEEP + 4}"
+        # the monotonic total never saturates — deltas (the soak
+        # artifact) and probe signals read it, not len(deque)
+        assert rec.postmortems_total() == flight.POSTMORTEM_KEEP + 5
+
+    def test_registry_counts_per_engine_attribution(self):
+        flight.enable(buffer=8)
+        r1, r2 = new_serve_registry(), new_serve_registry()
+        flight.post_mortem("watchdog_abort", registry=r1)
+        assert r1.family("dtpu_serve_postmortems_total").value() == 1
+        assert r2.family("dtpu_serve_postmortems_total").value() == 0
+
+    def test_health_summary_counts(self):
+        rec = flight.enable(buffer=8)
+        reg = Registry()
+        reg.counter("dtpu_serve_compiles_total", "t", ("fn",))
+        reg.histogram("dtpu_serve_compile_seconds", "t", ("fn",))
+        reg.counter("dtpu_serve_recompiles_total", "t", ("fn",))
+        fn = flight.watch_jit(_FakeJit(), "decode", reg, warm=lambda: True)
+        fn(shape=1)
+        flight.post_mortem("engine_error")
+        h = flight.health_summary()
+        assert h == {
+            "enabled": True, "seq": rec.seq, "compiles": 1,
+            "recompiles": 1, "postmortems": 1,
+        }
+
+
+class TestDisabledIsNoop:
+    def test_noop_rebinding_pinned(self):
+        """THE zero-cost contract (same pin as faults.fire /
+        tracing.span): disabled means `flight.record` IS the
+        module-level no-op function and every module entry point is a
+        cheap no-op."""
+        flight.disable()
+        assert flight.record is flight._noop_record
+        assert flight.record(phase="decode", slots=[0]) is None
+        assert not flight.enabled()
+        assert flight.get_recorder() is None
+        assert flight.post_mortem("watchdog_abort") is None
+        assert flight.maybe_poll_memory() is None
+        assert flight.health_summary() == {"enabled": False}
+        assert flight.debug_payload({}) == {
+            "enabled": False, "records": [], "postmortems": [],
+        }
+
+    def test_env_kill_switch_in_subprocess(self):
+        code = (
+            "from dstack_tpu.obs import flight\n"
+            "assert flight.record is flight._noop_record\n"
+            "assert not flight.enabled()\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+            env={"PATH": "/usr/bin:/bin", "DTPU_FLIGHT": "0"},
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    def test_env_buffer_respected_in_subprocess(self):
+        code = (
+            "from dstack_tpu.obs import flight\n"
+            "assert flight.enabled()\n"
+            "assert flight.get_recorder().buffer == 64\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+            env={"PATH": "/usr/bin:/bin", "DTPU_FLIGHT_BUFFER": "64"},
+        )
+        assert proc.returncode == 0, proc.stderr
+
+
+class TestImportLight:
+    def test_import_pulls_no_heavy_runtime(self):
+        """obs.flight must import without aiohttp/jax/numpy (the
+        faults/ contract): the lint collector, the CLI renderer, and
+        offline tools enumerate flight state without a serving
+        runtime. The memory poll imports jax lazily at call time
+        only."""
+        code = (
+            "import sys\n"
+            "from dstack_tpu.obs import flight\n"
+            "rec = flight.enable(buffer=4)\n"
+            "flight.record(phase='decode', slots=[0], tokens=1)\n"
+            "assert rec.records(1)[0]['tokens'] == 1\n"
+            "bad = [m for m in ('aiohttp', 'jax', 'numpy', 'jaxlib') "
+            "if m in sys.modules]\n"
+            "assert not bad, f'flight pulled in {bad}'\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+
+class TestCLIRendering:
+    def test_render_flight_tables_pure(self):
+        """The `dtpu flight` renderer is a pure function of the
+        /debug/flight payload (no server needed)."""
+        from dstack_tpu.cli.main import render_flight_tables
+
+        payload = {
+            "enabled": True,
+            "seq": 7,
+            "records": [
+                {"seq": 5, "t": 10.0, "phase": "prefill_packed",
+                 "slots": [0, 1], "g": 2, "cl": 64, "rows": 2,
+                 "dispatch_s": 0.012},
+                {"seq": 6, "t": 10.5, "phase": "recompile",
+                 "fn": "chunk", "key": "(64, 0)", "seconds": 0.4},
+                {"seq": 7, "t": 11.0, "phase": "wedge", "slot": 3,
+                 "trace": "deadbeef"},
+            ],
+            "compile": {
+                "fns": {
+                    "chunk": {"compiles": 3, "recompiles": 1,
+                              "seconds": 1.2},
+                },
+                "events": [],
+            },
+            "memory": {"available": False},
+            "postmortems": [
+                {"reason": "watchdog_abort", "seq": 7,
+                 "ctx": {"wedge": "slot:3"},
+                 "records": [{"phase": "wedge", "slot": 3,
+                              "trace": "deadbeef"}]},
+            ],
+        }
+        timeline, compiles, pms = render_flight_tables(payload)
+        assert timeline.row_count == 3
+        assert compiles.row_count == 1
+        assert pms.row_count == 1

@@ -1372,3 +1372,217 @@ class TestAbandonStep:
         assert self.eng.active[slot]
         out = self.eng.step()
         assert slot in out and out[slot]
+
+
+class TestFlightRecorder:
+    """Engine-side flight recorder wiring (obs/flight.py): per-step
+    and per-wave records with strictly host-side batch composition,
+    compile accounting into the ENGINE's registry, and the wedge
+    record + post-mortem on abandon_step — the black box the watchdog
+    chaos acceptance reads."""
+
+    config = llama.LLAMA_TINY
+
+    def setup_method(self):
+        from dstack_tpu.obs import flight
+
+        self.params = llama.init_params(self.config, jax.random.key(0))
+        self._prior = flight.get_recorder()
+        self.rec = flight.enable(buffer=256)
+
+    def teardown_method(self):
+        from dstack_tpu.obs import flight
+
+        if self._prior is not None:
+            flight._recorder = self._prior
+            flight.record = self._prior.record
+        else:
+            flight.disable()
+
+    def _engine(self, **kw):
+        kw.setdefault("max_batch", 4)
+        kw.setdefault("max_seq", 128)
+        return InferenceEngine(self.config, self.params, **kw)
+
+    def test_step_records_phase_timing_and_traces(self):
+        eng = self._engine(turbo_steps=0, spec_draft=0)
+        gen = GenParams(max_new_tokens=4)
+        gen.trace_id = "feedc0de"
+        slot, tok = eng.add_request([5, 9, 21, 7], gen)
+        while eng.active[slot]:
+            eng.step()
+        recs = self.rec.records(200)
+        prefills = [r for r in recs if r["phase"] == "prefill"]
+        steps = [r for r in recs if r["phase"] == "decode"]
+        assert prefills and steps
+        p = prefills[-1]
+        assert p["slots"] == [slot] and p["g"] == 1 and p["rows"] == 1
+        assert p["dispatch_s"] > 0
+        assert p["traces"] == {slot: "feedc0de"}
+        s = steps[-1]
+        assert s["slots"] == [slot]
+        assert s["tokens"] >= 1
+        assert s["dispatch_s"] > 0 and s["host_s"] >= 0
+        assert 0.0 <= s["kv_util"] <= 1.0
+        assert s["traces"] == {slot: "feedc0de"}
+        # spec/turbo paths name themselves too
+        eng2 = self._engine(turbo_steps=8, spec_draft=0)
+        eng2.generate([5, 9, 21, 7], GenParams(max_new_tokens=6))
+        assert any(r["phase"] == "turbo" for r in self.rec.records(50))
+
+    def test_packed_wave_records_bucket_composition(self):
+        eng = self._engine(
+            prefill_chunk=16, prefill_pack=4, spec_draft=0, turbo_steps=0
+        )
+        _drive_packed(
+            eng,
+            [list(range(3, 40)), list(range(60, 95)), [5, 6, 7]],
+            [GenParams(max_new_tokens=2) for _ in range(3)],
+        )
+        waves = [
+            r for r in self.rec.records(200)
+            if r["phase"] == "prefill_packed"
+        ]
+        assert waves, "packed waves must flight-record"
+        w = waves[0]
+        assert w["rows"] == 3 and w["g"] == 4  # 3 rows → G=4 bucket
+        assert len(w["slots"]) == 3 and len(w["starts"]) == 3
+        assert w["dispatch_s"] > 0
+
+    def test_compile_accounting_lands_in_engine_registry(self):
+        eng = self._engine(spec_draft=0, turbo_steps=0)
+        eng.generate([5, 9, 21, 7], GenParams(max_new_tokens=3))
+        compiles = eng.metrics.family("dtpu_serve_compiles_total")
+        # the cold path compiled at least the chunk prefill + decode
+        assert compiles.value("chunk") >= 1
+        assert compiles.value("decode") >= 1
+        assert eng.metrics.family(
+            "dtpu_serve_compile_seconds"
+        ).count("chunk") >= 1
+        # ring carries the causing bucket key for the memoized grid
+        keys = [
+            r.get("key") for r in self.rec.records(200)
+            if r["phase"] == "compile" and r.get("fn") == "chunk"
+        ]
+        assert keys and all(k for k in keys)
+        # cache-size gauges reflect the memoized grids at scrape time
+        eng.update_state_gauges()
+        g = eng.metrics.family("dtpu_serve_compile_cache_entries")
+        assert g.value("chunk") == len(eng._chunk_fns) >= 1
+
+    def test_abandon_step_writes_wedge_record_and_postmortem(self):
+        eng = self._engine(turbo_steps=0, spec_draft=0)
+        eng.fault_ctx = {"replica": "r7"}
+        gen = GenParams(max_new_tokens=8)
+        gen.trace_id = "abad1dea"
+        slot, _ = eng.add_request([5, 9, 21, 7], gen)
+        pm0 = len(self.rec.postmortems())
+        eng._step_wedge = ("slot", slot)  # the watchdog's view mid-hang
+        assert eng.abandon_step() == ("slot", slot)
+        # the ring's LAST record is the wedge marker naming the slot
+        # and its trace — what the post-mortem's tail carries
+        last = self.rec.records(1)[0]
+        assert last["phase"] == "wedge"
+        assert last["slot"] == slot and last["trace"] == "abad1dea"
+        assert last["replica"] == "r7"
+        pms = self.rec.postmortems()
+        assert len(pms) == pm0 + 1
+        pm = pms[-1]
+        assert pm["reason"] == "watchdog_abort"
+        assert pm["ctx"]["wedge"] == f"slot:{slot}"
+        assert pm["ctx"]["slots"] == {slot: "abad1dea"}
+        assert pm["records"][-1]["phase"] == "wedge"
+        # a None phase (step finished concurrently) must NOT post-mortem
+        assert eng.abandon_step() is None
+        assert len(self.rec.postmortems()) == pm0 + 1
+
+    def test_disabled_engine_writes_nothing(self):
+        from dstack_tpu.obs import flight
+
+        flight.disable()
+        assert flight.record is flight._noop_record
+        eng = self._engine(spec_draft=0, turbo_steps=0)
+        eng.generate([5, 9, 21, 7], GenParams(max_new_tokens=3))
+        # jit sites carry NO wrapper (identity) when built disabled
+        from dstack_tpu.obs.flight import JitWatch
+
+        assert not isinstance(eng._decode, JitWatch)
+        assert not any(
+            isinstance(f, JitWatch) for f in eng._chunk_fns.values()
+        )
+        # re-enabling later shows an empty ring: nothing was recorded
+        rec = flight.enable(buffer=8)
+        assert rec.records(10) == []
+
+
+class TestSteadyStateRecompiles:
+    """The recompile regression gate (the runtime complement of
+    DTPU003's noqa pragmas): run the engine through mixed greedy /
+    sampled / packed traffic TWICE — the first pass compiles the
+    power-of-two bucket grid, the second pass must compile NOTHING.
+    If a bucketing contract breaks (e.g. a memoization dict keyed by a
+    caller-supplied value), this test fails before any TPU ever pays
+    the stall."""
+
+    config = llama.LLAMA_TINY
+
+    def setup_method(self):
+        from dstack_tpu.obs import flight
+
+        self._prior = flight.get_recorder()
+        self.rec = flight.enable(buffer=512)
+
+    def teardown_method(self):
+        from dstack_tpu.obs import flight
+
+        if self._prior is not None:
+            flight._recorder = self._prior
+            flight.record = self._prior.record
+        else:
+            flight.disable()
+
+    def _mixed_pass(self, eng):
+        gen = lambda **kw: GenParams(max_new_tokens=3, **kw)  # noqa: E731
+        # greedy serial (short + long buckets), sampled, seeded with
+        # penalties, logit-bias, and a packed burst with a prefix hit
+        eng.generate(list(range(3, 20)), gen())
+        eng.generate(list(range(40, 80)) + [1], gen())
+        eng.generate([5, 9, 21, 7], gen(temperature=0.8, seed=3))
+        eng.generate(
+            [5, 9, 21, 7, 3],
+            gen(temperature=0.9, seed=5, repetition_penalty=1.2),
+        )
+        eng.generate([5, 9, 21], gen(logit_bias={"7": 2.0}))
+        _drive_packed(
+            eng,
+            [list(range(40, 80)) + [9, 2], list(range(60, 95)), [4, 4]],
+            [gen() for _ in range(3)],
+        )
+
+    def test_second_pass_compiles_nothing(self):
+        params = llama.init_params(self.config, jax.random.key(0))
+        eng = InferenceEngine(
+            self.config, params, max_batch=4, max_seq=128,
+            prefill_chunk=16, prefill_pack=4, spec_draft=0,
+            turbo_steps=4,
+        )
+        self._mixed_pass(eng)
+        compiles = eng.metrics.family("dtpu_serve_compiles_total")
+        first = {
+            labels[0]: v for labels, v in compiles.items()
+        }
+        assert first, "cold pass must have compiled something"
+        eng.mark_flight_warm()
+        self._mixed_pass(eng)  # identical traffic: all buckets warm
+        second = {
+            labels[0]: v for labels, v in compiles.items()
+        }
+        assert second == first, (
+            "steady-state traffic minted new compile variants: "
+            f"{ {k: second[k] - first.get(k, 0) for k in second} }"
+        )
+        recompiles = eng.metrics.family("dtpu_serve_recompiles_total")
+        assert recompiles.items() == [], "recompiles flagged after warmup"
+        assert not any(
+            r["phase"] == "recompile" for r in self.rec.records(512)
+        )

@@ -640,6 +640,10 @@ class TestProfilerEndpoints:
             assert r.status == 200
             d = await r.json()
             assert d["tracing"] is True
+            # the live capture is VISIBLE to probes: /health says so
+            # (a replica wedged in a capture must not look healthy-idle)
+            r = await client.get("/health")
+            assert (await r.json())["profiler_tracing"] is True
             # double-start is a 409, not a crash
             r = await client.post("/debug/profiler/start")
             assert r.status == 409
@@ -653,8 +657,83 @@ class TestProfilerEndpoints:
             # stop without a running capture is a 409
             r = await client.post("/debug/profiler/stop")
             assert r.status == 409
+            # and /health reflects the capture ending
+            r = await client.get("/health")
+            assert (await r.json())["profiler_tracing"] is False
         finally:
             await client.close()
+
+
+class TestFlightEndpoint:
+    """The replica's /debug/flight surface + the /health flight block
+    (obs/flight.py; same exposure gate as /debug/traces)."""
+
+    async def test_debug_flight_and_health_block(self):
+        from dstack_tpu.obs import flight
+
+        prior = flight.get_recorder()
+        flight.enable(buffer=128)
+        client = await _client()
+        try:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "llama-tiny", "prompt": "abcd",
+                      "max_tokens": 4},
+            )
+            assert r.status == 200
+            r = await client.get("/debug/flight")
+            assert r.status == 200
+            p = await r.json()
+            assert p["enabled"] is True
+            phases = {rec["phase"] for rec in p["records"]}
+            assert "prefill" in phases or "prefill_packed" in phases
+            assert phases & {"decode", "turbo", "spec"}
+            assert "compile" in p and p["compile"]["fns"]
+            # honest memory on CPU: available False, no fake zeros
+            assert p["memory"]["available"] is False
+            # query params bound the payload
+            r = await client.get("/debug/flight?limit=2&postmortems=0")
+            p2 = await r.json()
+            assert len(p2["records"]) == 2 and p2["postmortems"] == []
+            # /health carries the probe-visible summary
+            r = await client.get("/health")
+            h = await r.json()
+            fb = h["flight"]
+            assert fb["enabled"] is True
+            assert fb["compiles"] >= 1 and fb["recompiles"] == 0
+            assert fb["postmortems"] == 0 and fb["warm"] is False
+            assert h["profiler_tracing"] is False
+            # /metrics renders the flight registry families
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert "dtpu_flight_records_total" in text
+            assert "dtpu_serve_compiles_total" in text
+        finally:
+            await client.close()
+            if prior is not None:
+                flight._recorder = prior
+                flight.record = prior.record
+            else:
+                flight.disable()
+
+    async def test_debug_flight_disabled_payload(self):
+        from dstack_tpu.obs import flight
+
+        prior = flight.get_recorder()
+        flight.disable()
+        client = await _client()
+        try:
+            r = await client.get("/debug/flight")
+            p = await r.json()
+            assert p == {"enabled": False, "records": [],
+                         "postmortems": []}
+            r = await client.get("/health")
+            assert (await r.json())["flight"]["enabled"] is False
+        finally:
+            await client.close()
+            if prior is not None:
+                flight._recorder = prior
+                flight.record = prior.record
 
 
 class TestNChoices:
