@@ -121,6 +121,16 @@ class APIClient:
             "/debug/flight" + flight_query(limit, postmortems)
         )
 
+    # boot recorder (obs.boot; docs/reference/server.md)
+    def get_boot(self, limit: Optional[int] = None) -> dict:
+        """``GET /debug/boot`` — the target process's boot timeline
+        (TTFST decomposition by stage), /health-shaped summary, and
+        the engine's boot-compile manifest. Only serve replicas carry
+        a boot recorder; against the control plane this 404s (point
+        ``dtpu boot --url`` at a replica)."""
+        q = f"?limit={int(limit)}" if limit is not None else ""
+        return self._get("/debug/boot" + q)
+
     # live SLO engine (obs.slo; docs/reference/server.md)
     def get_slo(self) -> dict:
         """``GET /api/slo`` — per-scope burn rates, error budget

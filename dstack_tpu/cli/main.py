@@ -1141,6 +1141,96 @@ def flight(url, limit, pm_limit, project) -> None:
         )
 
 
+def render_boot_table(payload: dict) -> Table:
+    """``GET /debug/boot`` payload → the boot waterfall table
+    (separate from the command so tests can assert the rendering
+    without a server): one row per timeline entry, scoped stages with
+    their duration, point-in-time marks with their offset."""
+    table = Table(title="boot timeline")
+    for col in ("T+", "STAGE", "SECONDS", "DETAIL"):
+        table.add_column(col)
+    for e in payload.get("timeline") or []:
+        detail = []
+        if e.get("bytes") is not None:
+            detail.append(_fmt_bytes(e["bytes"]))
+        if e.get("bytes_per_s") is not None:
+            detail.append(f"{_fmt_bytes(e['bytes_per_s'])}/s")
+        for k in ("source", "phase", "model", "runs", "manifest", "replica"):
+            if e.get(k) is not None:
+                detail.append(f"{k}={e[k]}")
+        if e.get("error"):
+            detail.append("[red]error[/red]")
+        table.add_row(
+            f"{e.get('t', 0.0):.2f}s",
+            ("[bold]" + e["stage"] + "[/bold]") if e.get("mark") else e.get("stage", ""),
+            "" if e.get("mark") else f"{e.get('seconds', 0.0):.3f}",
+            " ".join(str(d) for d in detail),
+        )
+    return table
+
+
+@cli.command()
+@click.option(
+    "--url", default=None,
+    help="query this base URL's /debug/boot (an OpenAI-serve replica) "
+         "instead of the configured server",
+)
+@click.option(
+    "--limit", type=int, default=None,
+    help="timeline entries to show (most recent)",
+)
+@click.option("--project", default=None)
+def boot(url, limit, project) -> None:
+    """Inspect the replica boot recorder (GET /debug/boot).
+
+    Renders the time-to-first-served-token decomposition: each boot
+    stage (config/tokenizer/weights load with bytes/s, engine
+    construction, compile-grid warmup, prefix-copy warm) and milestone
+    (listener up, first probe, first served token) at its offset from
+    process start, plus the boot-compile manifest's warmup-coverage
+    verdict. Only serve replicas carry a boot recorder — point --url
+    at one."""
+    if url:
+        import requests
+
+        q = f"?limit={int(limit)}" if limit is not None else ""
+        resp = requests.get(url.rstrip("/") + "/debug/boot" + q, timeout=15)
+        if resp.status_code >= 400:
+            _die(f"{url} answered {resp.status_code}: {resp.text[:200]}")
+        payload = resp.json()
+    else:
+        client = _client(project)
+        try:
+            payload = client.api.get_boot(limit=limit)
+        except DstackTPUError as e:
+            _die(
+                f"{e} — the boot recorder lives on serve replicas; "
+                "try --url http://<replica>:<port>"
+            )
+    if not payload.get("enabled", True):
+        _die("the boot recorder is disabled on the target (DTPU_BOOT=0)")
+    summary = payload.get("summary") or {}
+    ttfst = summary.get("ttfst_s")
+    ready = summary.get("time_to_ready_s")
+    console.print(
+        f"boot [bold]{payload.get('boot_id', '')}[/bold] · up "
+        f"{payload.get('uptime_s', 0.0):.0f}s · time-to-ready "
+        + (f"{ready:.2f}s" if ready is not None else "[yellow]pending[/yellow]")
+        + " · first served token "
+        + (f"{ttfst:.2f}s" if ttfst is not None else "[yellow]pending[/yellow]")
+    )
+    console.print(render_boot_table(payload))
+    manifest = payload.get("compile_manifest") or {}
+    if manifest:
+        gaps = manifest.get("gap_compiles", 0)
+        gaps_s = f"[red]{gaps}[/red]" if gaps else "0"
+        console.print(
+            f"compile manifest: {len(manifest.get('variants') or [])} "
+            f"variants warmed (warm={manifest.get('warm')}) · "
+            f"warmup-coverage gap compiles: {gaps_s}"
+        )
+
+
 @cli.command()
 @click.option("--tpu", "tpu_spec", default=None, help="e.g. v5e-8 or v5p")
 @click.option("--spot/--on-demand", default=None)

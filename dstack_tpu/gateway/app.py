@@ -36,6 +36,7 @@ from aiohttp import web
 from dstack_tpu import faults, qos
 from dstack_tpu.gateway.nginx import NginxManager
 from dstack_tpu.obs import tracing
+from dstack_tpu.obs.boot import get_boot_registry
 from dstack_tpu.obs.slo import get_slo_registry
 from dstack_tpu.obs.tracing import get_trace_registry
 from dstack_tpu.gateway.state import GatewayState, Replica, Service
@@ -370,7 +371,10 @@ def build_app(
         agent.pools.update_state_gauge()
         return web.Response(
             text=get_router_registry().render() + get_qos_registry().render()
-            + get_trace_registry().render() + get_slo_registry().render(),
+            + get_trace_registry().render() + get_slo_registry().render()
+            # fleet boot decomposition, fed by this agent's pool probes
+            # ingesting replica /health boot blocks (obs/boot.py)
+            + get_boot_registry().render(),
             content_type="text/plain",
         )
 

@@ -43,6 +43,14 @@ def main(argv=None) -> int:
     p.add_argument("--qos-burst", type=float, default=6.0)
     p.add_argument("--no-chaos", action="store_true",
                    help="skip the mid-soak drain flip and replica kill")
+    p.add_argument("--scale-up", action="store_true",
+                   help="mid-soak, boot a COLD extra replica under the "
+                        "boot recorder and join it to the pool; the "
+                        "artifact gains a `boot` block decomposing its "
+                        "time-to-first-served-token (BOOT_rNN baseline)")
+    p.add_argument("--scale-up-frac", type=float, default=0.45,
+                   help="when to spawn the cold replica (fraction of "
+                        "duration)")
     p.add_argument("--kill-frac", type=float, default=0.60,
                    help="when to kill a replica (fraction of duration)")
     p.add_argument("--drain-frac", type=float, nargs=2,
@@ -97,6 +105,8 @@ def main(argv=None) -> int:
         qos_rps=args.qos_rps,
         qos_burst=args.qos_burst,
         chaos=not args.no_chaos,
+        scale_up=args.scale_up,
+        scale_up_frac=args.scale_up_frac,
         drain_start_frac=args.drain_frac[0],
         drain_end_frac=args.drain_frac[1],
         kill_frac=args.kill_frac,

@@ -85,6 +85,17 @@ class SoakConfig:
     # errors on a SURVIVOR here: clients ride the resume path, the
     # replica's own error counter burns its SLO
     kill_extra_rules: Optional[list] = None
+    # scale-up (obs/boot.py): mid-soak a COLD extra replica is built
+    # from nothing — params init, engine construction, HTTP warmup,
+    # prefix-copy warm — under its own boot recorder, then joins the
+    # pool via sync(); the artifact gains a `boot` block decomposing
+    # its time-to-first-served-token by stage plus a scored
+    # `scale_up` goodput/tail window around the join. This artifact
+    # (BOOT_rNN.json) is the scale-out-latency baseline ROADMAP item
+    # 4 optimizes against.
+    scale_up: bool = False
+    scale_up_frac: float = 0.45  # spawn at this fraction of the soak
+    scale_up_window_s: float = 8.0  # scored window after the spawn
     # live SLO engine over the soak's own pool (obs/slo.py): a policy
     # dict turns it on — per-replica windows are ingested from the
     # probe loop's /health captures, burn alerts evaluated every
@@ -99,24 +110,30 @@ class SoakConfig:
 
 
 class _Replica:
-    __slots__ = ("rid", "engine", "runner", "site", "port", "killed")
+    __slots__ = ("rid", "engine", "app", "runner", "site", "port", "killed")
 
-    def __init__(self, rid, engine, runner, site, port):
+    def __init__(self, rid, engine, app, runner, site, port):
         self.rid = rid
         self.engine = engine
+        self.app = app
         self.runner = runner
         self.site = site
         self.port = port
         self.killed = False
 
 
-async def _start_replica(rid: str, engine, model: str, policy):
+async def _start_replica(rid: str, engine, model: str, policy, boot=None):
     from aiohttp import web
 
     from dstack_tpu.serve.openai_server import build_app
     from dstack_tpu.serve.tokenizer import ByteTokenizer
 
-    app = build_app(engine, ByteTokenizer(), model, qos_policy=policy)
+    # boot=None keeps the harness replicas OFF the process-global boot
+    # recorder (one process, many replicas — only the scale-up replica
+    # carries one, and it brings its own)
+    app = build_app(
+        engine, ByteTokenizer(), model, qos_policy=policy, boot=boot,
+    )
     runner = web.AppRunner(app)
     await runner.setup()
     sock = socket.socket()
@@ -124,7 +141,7 @@ async def _start_replica(rid: str, engine, model: str, policy):
     port = sock.getsockname()[1]
     site = web.SockSite(runner, sock)
     await site.start()
-    return _Replica(rid, engine, runner, site, port)
+    return _Replica(rid, engine, app, runner, site, port)
 
 
 def _router_app(pool, session_holder):
@@ -307,6 +324,79 @@ async def _kill_replica(
     )
 
 
+async def _scale_up_replica(
+    state: dict, replicas: List["_Replica"], pool, config, cfg,
+    policy, bias: dict, at: float,
+):
+    """The mid-soak scale-up: build a COLD replica from nothing under
+    its own boot recorder — params init (honest bytes: a fresh tree,
+    not a shared reference), engine construction, listener, the same
+    HTTP shape-bucket warmup the baseline replicas got, prefix-copy
+    warm — then join the pool via sync(). From there the production
+    machinery takes over: the probe loop's first /health answers the
+    ``first_probe`` (time-to-ready) mark and ingests the boot block,
+    and the first soak-workload token it serves seals TTFST.
+
+    The recorder carries a PRIVATE registry: its replica-local
+    histogram observations must not double-count against the pool's
+    probe-ingested fleet aggregation living in the same process (in a
+    real deployment those are different processes)."""
+    import jax
+
+    from dstack_tpu.models import llama
+    from dstack_tpu.obs import boot as obs_boot
+    from dstack_tpu.serve.engine import InferenceEngine
+
+    await asyncio.sleep(at)
+    rid = f"r{cfg.replicas}"
+    rec = obs_boot.BootRecorder(registry=obs_boot.new_boot_registry())
+    state["recorder"] = rec
+    state["t_spawn"] = at
+    logger.warning(
+        "soak scale-up: spawning cold replica %s at t=%.1fs (boot %s)",
+        rid, at, rec.boot_id,
+    )
+    with rec.stage("weights_load", source="init") as st:
+        fresh = llama.init_params(config, jax.random.key(1))
+        st.set(bytes=sum(
+            int(x.nbytes) for x in jax.tree_util.tree_leaves(fresh)
+        ))
+    with rec.stage("engine_init"):
+        engine = InferenceEngine(
+            config, fresh, max_batch=cfg.max_batch,
+            max_seq=cfg.max_seq, prefill_chunk=cfg.prefill_chunk,
+        )
+    engine.fault_ctx = {"replica": rid}
+    replica = await _start_replica(
+        rid, engine, cfg.model, policy, boot=rec,
+    )
+    # shared teardown list FIRST: if anything below fails, the soak's
+    # finally block still stops this replica
+    replicas.append(replica)
+    state["engine"] = engine
+    sched = replica.app["scheduler"]
+    # warmup tokens are harness traffic, not the workload: suppress
+    # the TTFST mark until the replica is in rotation, so the boot
+    # block measures first token served THROUGH THE ROUTER
+    sched._boot_served = True
+    with rec.stage("warmup_compile") as st:
+        await _warmup([replica], cfg.model, bias)
+        st.set(manifest=len(engine.compile_manifest()))
+    with rec.stage("warm_prefix_copies"):
+        engine.warm_prefix_copies()
+    engine.mark_flight_warm()
+    sched._boot_served = False
+    # join: re-sync with the full membership — existing entries keep
+    # their probed health state, the newcomer starts STARTING and the
+    # probe loop promotes it (its first probe is the READY mark)
+    pool.sync(state["members"] + [(rid, "127.0.0.1", replica.port)])
+    state["joined_at"] = time.monotonic()
+    logger.warning(
+        "soak scale-up: replica %s joined the pool (warm, %d manifest "
+        "variants)", rid, len(engine.compile_manifest()),
+    )
+
+
 def _snapshot(registry, families) -> dict:
     return {name: registry.family(name).value() for name in families}
 
@@ -391,8 +481,11 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
                 await _start_replica(f"r{i}", engine, cfg.model, policy)
             )
         pool = ReplicaPool("soak", "loadgen", PoolConfig(startup_grace=0.0))
-        pool.sync([("r%d" % i, "127.0.0.1", r.port)
-                   for i, r in enumerate(replicas)])
+        members = [
+            ("r%d" % i, "127.0.0.1", r.port)
+            for i, r in enumerate(replicas)
+        ]
+        pool.sync(members)
         # serial warmup traffic + optimistic-STARTING would pin every
         # request to the first success (READY outranks STARTING): start
         # READY like a probed pool; the probe loop maintains it from here
@@ -457,6 +550,21 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
                     min(spec.duration_s, kill_at + cfg.kill_window_s),
                 ),
             ]
+        scale_state: dict = {"members": members}
+        if cfg.scale_up:
+            up_at = spec.duration_s * cfg.scale_up_frac
+            chaos_tasks.append(asyncio.ensure_future(_scale_up_replica(
+                scale_state, replicas, pool, config, cfg, policy,
+                ascii_bias, up_at,
+            )))
+            # the scored join window: goodput/tails while a cold
+            # replica boots, warms, and enters rotation next to live
+            # traffic — the acceptance bar is zero client 5xx and no
+            # goodput regression vs the baseline soak
+            windows.append(EventWindow(
+                "scale_up", up_at,
+                min(spec.duration_s, up_at + cfg.scale_up_window_s),
+            ))
 
         router_url = f"http://127.0.0.1:{router.port}"
         driver = OpenLoopDriver(
@@ -585,6 +693,29 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
         trace_lookup=obs_tracing.get_trace,
         flight_events=flight_events if flight_block is not None else None,
     )
+    # the scale-up replica's TTFST decomposition (obs/boot.py): the
+    # per-stage boot timeline from its private recorder, schedule-
+    # relative spawn time, and the /health-shaped summary — read next
+    # to the `scale_up` entry in the window analysis (goodput/tails
+    # around the join). Same backend/note labels as the whole
+    # artifact: on CPU fallback these stage durations are NOT TPU boot
+    # numbers.
+    boot_block = None
+    boot_rec = scale_state.get("recorder") if cfg.scale_up else None
+    if boot_rec is not None:
+        up_engine = scale_state.get("engine")
+        boot_block = {
+            "replica": f"r{cfg.replicas}",
+            "t_spawn": round(scale_state.get("t_spawn", 0.0), 3),
+            **boot_rec.health_block(
+                warm=bool(up_engine is not None and up_engine.flight_warm)
+            ),
+            "timeline": boot_rec.timeline(),
+            "manifest_variants": (
+                len(up_engine.compile_manifest())
+                if up_engine is not None else 0
+            ),
+        }
     info = backend_info()
     result = {
         "metric": (
@@ -620,6 +751,9 @@ async def _soak_async(schedule: EventSchedule, cfg: SoakConfig) -> dict:
         # same backend label as the artifact — CPU-fallback honesty
         # applies to memory/compile numbers too)
         "flight": flight_block,
+        # scale-up boot decomposition (None unless cfg.scale_up): the
+        # TTFST baseline for ROADMAP item 4
+        "boot": boot_block,
         "slo": (
             {
                 "policy": slo_engine.policy.name,

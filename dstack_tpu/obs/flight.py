@@ -462,10 +462,14 @@ class JitWatch:
     first-call == compile by construction. ``warm`` is a zero-arg
     callable (typically reading the owning engine's warmup flag): a
     compile while it returns True is flagged as a steady-state
-    recompile."""
+    recompile. ``on_compile`` is an optional
+    ``(name, key, seconds, recompile)`` callback fired after the
+    recorder is notified — the engine's boot-compile manifest hangs
+    off it (warmup compiles populate the manifest; post-warm compiles
+    are checked against it for warmup-coverage gaps)."""
 
     __slots__ = ("fn", "name", "key", "_registry", "_warm", "_cache_size",
-                 "_calls")
+                 "_calls", "_on_compile")
 
     def __init__(
         self,
@@ -474,6 +478,7 @@ class JitWatch:
         registry: Optional[Registry] = None,
         key: Any = None,
         warm: Optional[Callable[[], bool]] = None,
+        on_compile: Optional[Callable[[str, Any, float, bool], None]] = None,
     ):
         self.fn = fn
         self.name = name
@@ -482,6 +487,7 @@ class JitWatch:
         self._warm = warm
         self._cache_size = getattr(fn, "_cache_size", None)
         self._calls = 0
+        self._on_compile = on_compile
 
     def __call__(self, *args, **kwargs):
         rec = _recorder
@@ -496,10 +502,13 @@ class JitWatch:
         dt = time.perf_counter() - t0
         compiled = (cs() > before) if cs is not None else first
         if compiled:
+            recompile = bool(self._warm is not None and self._warm())
             rec.note_compile(
                 self.name, self.key, dt, self._registry,
-                recompile=bool(self._warm is not None and self._warm()),
+                recompile=recompile,
             )
+            if self._on_compile is not None:
+                self._on_compile(self.name, self.key, dt, recompile)
         return out
 
 
@@ -509,14 +518,17 @@ def watch_jit(
     registry: Optional[Registry] = None,
     key: Any = None,
     warm: Optional[Callable[[], bool]] = None,
+    on_compile: Optional[Callable[[str, Any, float, bool], None]] = None,
 ) -> Callable:
     """Wrap a jitted callable for compile accounting — or return it
     UNCHANGED (identity, zero cost) when no recorder is installed at
     wrap time (engines built under ``DTPU_FLIGHT=0`` carry no wrapper
-    at all)."""
+    at all — which also means no boot-compile manifest: the coverage
+    gate needs the flight recorder on)."""
     if _recorder is None:
         return fn
-    return JitWatch(fn, name, registry, key=key, warm=warm)
+    return JitWatch(fn, name, registry, key=key, warm=warm,
+                    on_compile=on_compile)
 
 
 # ---------------------------------------------------------------------------

@@ -88,6 +88,14 @@ def new_router_registry() -> Registry:
         "Health probes that failed (connect error, timeout, or 5xx)",
     )
     r.counter(
+        "dtpu_router_boot_restarts_total",
+        "Replica restarts detected by a changed boot_id in the probed "
+        "/health boot block (same id, same address, new process): each "
+        "one invalidates the replica's prefix-affinity mappings — the "
+        "authoritative restart signal the prefix_slots=0 heuristic "
+        "cannot provide for a replica that re-warmed between probes",
+    )
+    r.counter(
         "dtpu_router_drained_total",
         "Replicas that finished draining (inflight hit zero or the "
         "drain deadline passed)",

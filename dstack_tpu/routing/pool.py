@@ -51,6 +51,7 @@ from enum import Enum
 from typing import Dict, Iterable, Optional, Tuple
 
 from dstack_tpu import faults
+from dstack_tpu.obs import boot as obs_boot
 from dstack_tpu.routing.affinity import AffinityKey, AffinityMap
 from dstack_tpu.routing.metrics import get_router_registry
 from dstack_tpu.utils.logging import get_logger
@@ -107,6 +108,11 @@ class ReplicaEntry:
     # DEGRADED (last-resort target) until the alert resolves — the
     # soft-failure analogue of the breaker (obs/slo.py, process_slo)
     slo_degraded: bool = False
+    # boot-block ingestion memo (obs/boot.py ingest): tracks which
+    # stages of the replica's CURRENT boot_id were already folded into
+    # the fleet histograms, so repeated probes observe each once; a
+    # boot_id change here is the authoritative restart signal
+    boot_memo: dict = field(default_factory=dict)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -535,6 +541,34 @@ class ReplicaPool:
             or (now >= e.breaker_open_until and not e.half_open)
         ]
 
+    def ingest_boot(self, entry: ReplicaEntry) -> None:
+        """Fold a probed ``/health`` ``boot`` block into the fleet boot
+        histograms (via the entry's memo, so each boot observes each
+        stage once) and — the restart detector — invalidate the
+        replica's affinity mappings when its ``boot_id`` changed: same
+        id, same address, NEW process, so every KV row the affinity map
+        remembers is gone. The ``prefix_slots=0`` heuristic in the
+        affinity score cannot catch a replica that restarted AND
+        re-warmed between probes; boot identity can, and the heuristic
+        stays for same-process registry resets. Separate from
+        probe_replica so restart-flap tests drive it with synthetic
+        probe payloads."""
+        block = entry.probe.get("boot") if entry.probe else None
+        if not isinstance(block, dict) or not block.get("boot_id"):
+            return
+        prior = entry.boot_memo.get("boot_id")
+        if prior is not None and prior != str(block["boot_id"]):
+            self.affinity.invalidate_replica(entry.replica_id)
+            get_router_registry().family(
+                "dtpu_router_boot_restarts_total"
+            ).inc(1)
+            logger.info(
+                "replica %s rebooted (boot_id %s -> %s): affinity "
+                "mappings invalidated",
+                entry.replica_id, prior, block["boot_id"],
+            )
+        obs_boot.ingest(block, entry.boot_memo)
+
     async def probe_replica(self, session, entry: ReplicaEntry) -> bool:
         """One ``GET /health`` against a replica; updates its state.
         Any HTTP answer below 500 counts as alive (plain services need
@@ -588,9 +622,15 @@ class ReplicaPool:
                       # profiler capture or a compile storm shows here
                       # — probes carry the flight compile/recompile/
                       # post-mortem counts and the is_tracing flag
-                      "profiler_tracing", "flight")
+                      "profiler_tracing", "flight",
+                      # boot decomposition (obs/boot.py): boot_id +
+                      # per-stage seconds + TTFST — the probe is the
+                      # transport for the fleet boot histograms, and
+                      # a boot_id change invalidates affinity
+                      "boot")
         }
         entry.last_probe_at = time.monotonic()
+        self.ingest_boot(entry)
         self.report_success(entry)
         if (
             entry.state == ReplicaState.DRAINING

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dstack_tpu import faults
+from dstack_tpu.obs import boot as obs_boot
 from dstack_tpu.obs import flight
 from dstack_tpu.models import llama
 from dstack_tpu.models.llama import (
@@ -34,6 +35,9 @@ from dstack_tpu.models.llama import (
     qk_norm_apply,
     rms_norm,
 )
+from dstack_tpu.utils.logging import get_logger
+
+logger = get_logger("serve.engine")
 
 NEG_INF = -1e30
 
@@ -1894,9 +1898,17 @@ class InferenceEngine:
         # path the current step() took for its flight record.
         self._flight_warm = False
         self._last_step_phase = "decode"
+        # boot-compile manifest (obs/boot.py helpers): every compile
+        # BEFORE mark_flight_warm() records its per-fn key here; a
+        # compile AFTER of a key absent from the manifest is a
+        # warmup-coverage gap — warmup never visited that bucket, so a
+        # live request paid the trace. Host-side set bookkeeping only
+        # (DTPU002: no device sync on the compile path).
+        self._compile_manifest: set = set()
         _watch = partial(
             flight.watch_jit, registry=self.metrics,
             warm=lambda: self._flight_warm,
+            on_compile=self._note_boot_compile,
         )
         self._watch_jit = _watch
         self._decode = _watch(jax.jit(
@@ -2893,6 +2905,38 @@ class InferenceEngine:
     @property
     def flight_warm(self) -> bool:
         return self._flight_warm
+
+    def _note_boot_compile(
+        self, fn_name: str, key, seconds: float, recompile: bool
+    ) -> None:
+        """watch_jit on_compile hook: warmup compiles populate the
+        boot-compile manifest; a post-warm compile of a variant the
+        manifest never saw is a WARMUP-COVERAGE GAP — warmup skipped
+        that bucket, so a live request just paid its first trace
+        (``dtpu_serve_warmup_gap_compiles_total{fn}``). A post-warm
+        compile of a covered variant is a plain recompile (retrace of
+        a warmed shape: jit cache eviction, donation mismatch) and
+        already counted by the flight recorder."""
+        mk = obs_boot.manifest_key(fn_name, key)
+        if not self._flight_warm:
+            self._compile_manifest.add(mk)
+            return
+        if mk not in self._compile_manifest:
+            fam = self.metrics.family("dtpu_serve_warmup_gap_compiles_total")
+            if fam is not None:
+                fam.inc(1, fn_name)
+            logger.warning(
+                "warmup-coverage gap: %s compiled %.3fs post-warm but was "
+                "never visited by warmup (manifest of %d variants)",
+                mk, seconds, len(self._compile_manifest),
+            )
+
+    def compile_manifest(self) -> set:
+        """The boot-compile manifest: every ``manifest_key`` warmup
+        visited (frozen in practice once ``mark_flight_warm`` runs).
+        Copy — callers diff it against observed steady-state keys via
+        ``obs.boot.manifest_diff``."""
+        return set(self._compile_manifest)
 
     def reset_prefix_cache(self) -> None:
         """Forget every registered reusable prompt prefix (no device

@@ -152,6 +152,17 @@ def new_serve_registry() -> Registry:
         "the two-pass regression test)",
         labelnames=("fn",),
     )
+    r.counter(
+        "dtpu_serve_warmup_gap_compiles_total",
+        "Steady-state compiles of a variant ABSENT from the "
+        "boot-compile manifest (the per-fn compile keys warmup "
+        "visited): warmup never covered that bucket, so a live "
+        "request paid its first-ever trace. The subset of "
+        "dtpu_serve_recompiles_total that indicts warmup coverage "
+        "rather than cache churn (obs/boot.py manifest helpers; "
+        "gated by the two-pass recompile test)",
+        labelnames=("fn",),
+    )
     r.gauge(
         "dtpu_serve_compile_cache_entries",
         "Entries in the engine's memoized jit grids (fn = chunk/"

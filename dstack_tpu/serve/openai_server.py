@@ -53,6 +53,7 @@ from typing import Optional
 from aiohttp import web
 
 from dstack_tpu import faults, qos
+from dstack_tpu.obs import boot as obs_boot
 from dstack_tpu.obs import flight
 from dstack_tpu.obs import profiling as obs_profiling
 from dstack_tpu.obs import slo as obs_slo
@@ -66,6 +67,10 @@ from dstack_tpu.utils.logging import get_logger
 from dstack_tpu.utils.retry import Deadline
 
 logger = get_logger("serve.openai")
+
+# build_app boot param sentinel: "use the process-global recorder" —
+# distinct from an explicit None ("this app has no boot recorder")
+_BOOT_FROM_ENV = object()
 
 
 def _env_float(name: str, default: float) -> float:
@@ -141,11 +146,19 @@ class Scheduler:
         tokenizer: Tokenizer,
         tenant_inflight: int = 0,
         watchdog_seconds: float = 0.0,
+        boot=None,
     ):
         self.engine = engine
         self.tokenizer = tokenizer
         self.pending = qos.PriorityPending()
         self.tenant_inflight = max(0, int(tenant_inflight))  # 0 = off
+        # boot recorder (obs/boot.py): the scheduler owns the
+        # first-served-token milestone — the instant the FIRST token of
+        # this process's lifetime is queued to a client, TTFST is over.
+        # A local bool guards the hot path so steady state pays one
+        # attribute read, not a recorder call per token.
+        self._boot = boot
+        self._boot_served = boot is None
         # engine watchdog: one step() dispatch may take at most this
         # long before it is abandoned and the wedged slot aborted
         # (0 = off — DTPU_ENGINE_WATCHDOG_SECONDS via build_app)
@@ -162,6 +175,15 @@ class Scheduler:
 
     def start(self) -> None:
         self._task = asyncio.create_task(self._loop())
+
+    def _note_served_token(self) -> None:
+        """First token of the process's lifetime queued to a client →
+        the boot recorder's terminal milestone (seals the boot trace,
+        observes TTFST). `_boot_served` starts True when no recorder
+        is attached, so steady state costs one bool check."""
+        if not self._boot_served:
+            self._boot_served = True
+            self._boot.mark(obs_boot.SERVED_MARK)
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -424,6 +446,7 @@ class Scheduler:
                 req.logprob_entries.append(entry)
         if first != req.gen.eos_id:
             req.started = True  # charge is earned once a token ships
+            self._note_served_token()
             req.queue.put_nowait(first)
             if self._hit_stop(req, first):
                 self.engine.release(slot)
@@ -630,6 +653,7 @@ class Scheduler:
                     if entry is not None:
                         req.logprob_entries.append(entry)
                 req.started = True
+                self._note_served_token()
                 req.queue.put_nowait(tok)
                 if self._hit_stop(req, tok):
                     self.engine.release(slot)
@@ -929,6 +953,7 @@ def build_app(
     qos_policy: Optional[qos.QoSPolicy] = None,
     watchdog_seconds: Optional[float] = None,
     deadline_default: Optional[float] = None,
+    boot=_BOOT_FROM_ENV,
 ) -> web.Application:
     if qos_policy is None:
         qos_policy = qos.QoSPolicy.from_env()
@@ -936,10 +961,18 @@ def build_app(
         watchdog_seconds = _env_float("DTPU_ENGINE_WATCHDOG_SECONDS", 0.0)
     if deadline_default is None:
         deadline_default = _env_float("DTPU_REQUEST_DEADLINE_DEFAULT", 0.0)
+    # boot recorder (obs/boot.py): the default is the process-global
+    # one installed at import (DTPU_BOOT=0 leaves it None → every boot
+    # touchpoint below is skipped). Multi-replica harnesses pass their
+    # own — or an explicit None to opt a replica out, since one
+    # process-wide recorder cannot describe several replicas' boots.
+    if boot is _BOOT_FROM_ENV:
+        boot = obs_boot.get_recorder()
     app = web.Application()
+    app["boot"] = boot
     sched = Scheduler(
         engine, tokenizer, tenant_inflight=qos_policy.tenant_inflight,
-        watchdog_seconds=watchdog_seconds,
+        watchdog_seconds=watchdog_seconds, boot=boot,
     )
     app["scheduler"] = sched
     # live SLO windows over THIS replica's own registries (obs/slo.py;
@@ -1056,6 +1089,10 @@ def build_app(
 
     async def on_startup(_):
         sched.start()
+        if boot is not None:
+            # aiohttp fires on_startup once the site is about to accept
+            # — the closest in-process anchor for "listener up"
+            boot.mark("listener_up")
 
     async def on_cleanup(_):
         await sched.stop()
@@ -1114,6 +1151,16 @@ def build_app(
             # process_slo for fleet burn-rate evaluation (server.md
             # "SLO & alerting")
             body["slo_windows"] = replica_slo_state.health_windows()
+        if boot is not None:
+            # the first /health this process answers IS its readiness
+            # probe (probes are the only callers): mark time-to-ready
+            # once, then embed the TTFST decomposition + boot_id. The
+            # probe loop ingests the block fleet-side and invalidates
+            # affinity on a boot_id change (the authoritative restart
+            # signal — a restarted, re-warmed replica never shows
+            # prefix_slots=0).
+            boot.mark(obs_boot.READY_MARK)
+            body["boot"] = boot.health_block(warm=e.flight_warm)
         return web.json_response(body)
 
     async def models(request):
@@ -1144,7 +1191,8 @@ def build_app(
             text=e.metrics.render() + get_qos_registry().render()
             + get_trace_registry().render()
             + obs_slo.get_slo_registry().render()
-            + flight.get_flight_registry().render(),
+            + flight.get_flight_registry().render()
+            + obs_boot.get_boot_registry().render(),
             content_type="text/plain",
         )
 
@@ -1162,6 +1210,30 @@ def build_app(
         gate as ``/debug/traces``; docs/reference/server.md "Flight
         recorder")."""
         return web.json_response(flight.debug_payload(request.query))
+
+    async def debug_boot(request):
+        """The boot recorder: boot_id, the full stage timeline
+        (``?limit=``), the /health-shaped summary, and this engine's
+        boot-compile manifest with its warmup-coverage verdict
+        (docs/reference/server.md "Boot & cold start")."""
+        # an app built with boot=None OPTED OUT (multi-replica
+        # harnesses): report disabled rather than falling back to the
+        # process-global recorder, which describes a different replica
+        if boot is None:
+            return web.json_response({"enabled": False, "timeline": []})
+        payload = obs_boot.debug_payload(request.query, recorder=boot)
+        if payload.get("enabled"):
+            manifest = sorted(sched.engine.compile_manifest())
+            payload["compile_manifest"] = {
+                "warm": sched.engine.flight_warm,
+                "variants": manifest,
+                "gap_compiles": int(
+                    sched.engine.metrics.family(
+                        "dtpu_serve_warmup_gap_compiles_total"
+                    ).total()
+                ),
+            }
+        return web.json_response(payload)
 
     import dataclasses as _dc
 
@@ -1746,6 +1818,7 @@ def build_app(
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/traces", debug_traces)
     app.router.add_get("/debug/flight", debug_flight)
+    app.router.add_get("/debug/boot", debug_boot)
     app.router.add_get("/v1/models", models)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
@@ -1894,7 +1967,16 @@ def main(argv=None) -> int:
     if args.hf_model:
         from dstack_tpu.models.convert_hf import load_checkpoint
 
-        config, hf_params = load_checkpoint(args.hf_model)
+        # boot stage: the HF path reads config AND weights in one
+        # pass, so the whole checkpoint read is the weights_load
+        # stage (bytes → bytes/s is the number a streamed-weights
+        # optimization would move)
+        with obs_boot.stage("weights_load", source="hf") as _bs:
+            config, hf_params = load_checkpoint(args.hf_model)
+            _bs.set(bytes=sum(
+                int(getattr(leaf, "nbytes", 0))
+                for leaf in jax.tree_util.tree_leaves(hf_params)
+            ))
         args.model = Path(args.hf_model).name
         if args.tokenizer is None and any(
             (Path(args.hf_model) / f).exists()
@@ -1906,7 +1988,8 @@ def main(argv=None) -> int:
             args.hf_model, config.num_params() / 1e9,
         )
     else:
-        config = llama.CONFIGS[args.model]
+        with obs_boot.stage("config_load", model=args.model):
+            config = llama.CONFIGS[args.model]
     tp = args.tp or len(jax.devices())
     mesh = None
     if tp > 1:
@@ -1914,55 +1997,66 @@ def main(argv=None) -> int:
 
         mesh = make_mesh(MeshConfig(dp=1, fsdp=1, tp=tp))
         logger.info("tensor-parallel serving over %d devices", tp)
-    if hf_params is not None:
-        # host (numpy) tree from convert_hf; with a mesh the engine
-        # device_puts it straight into sharded buffers (never whole on
-        # chip 0), without one a single put avoids per-call transfers
-        if mesh is not None and args.weights:
-            # the --weights overlay below reads each leaf's .sharding —
-            # shard the tree now (same shardings the engine would use)
-            from dstack_tpu.parallel.sharding import default_rules, tree_shardings
+    # boot: device placement/init sums into the same weights_load
+    # stage as the checkpoint read — together they are the total
+    # weights cost of the boot
+    with obs_boot.stage("weights_load", phase="device_put"):
+        if hf_params is not None:
+            # host (numpy) tree from convert_hf; with a mesh the engine
+            # device_puts it straight into sharded buffers (never whole
+            # on chip 0), without one a single put avoids per-call
+            # transfers
+            if mesh is not None and args.weights:
+                # the --weights overlay below reads each leaf's
+                # .sharding — shard the tree now (same shardings the
+                # engine would use)
+                from dstack_tpu.parallel.sharding import default_rules, tree_shardings
 
-            params = jax.device_put(
-                hf_params,
-                tree_shardings(llama.param_specs(config), mesh, default_rules()),
-            )
+                params = jax.device_put(
+                    hf_params,
+                    tree_shardings(llama.param_specs(config), mesh, default_rules()),
+                )
+            else:
+                params = hf_params if mesh is not None else jax.device_put(hf_params)
+        elif mesh is not None:
+            # init directly under the mesh shardings: a 70B never fits
+            # chip 0
+            from dstack_tpu.serve.engine import sharded_params
+
+            params = sharded_params(config, mesh)
         else:
-            params = hf_params if mesh is not None else jax.device_put(hf_params)
-    elif mesh is not None:
-        # init directly under the mesh shardings: a 70B never fits chip 0
-        from dstack_tpu.serve.engine import sharded_params
-
-        params = sharded_params(config, mesh)
-    else:
-        params = llama.init_params(config, jax.random.key(0))
+            params = llama.init_params(config, jax.random.key(0))
     if args.weights:
         import numpy as np
 
-        flat = dict(np.load(args.weights))
-        import jax.numpy as jnp
+        with obs_boot.stage("weights_load", source="npz") as _bs:
+            flat = dict(np.load(args.weights))
+            _bs.set(bytes=sum(
+                int(v.nbytes) for k, v in flat.items() if k != "step"
+            ))
+            import jax.numpy as jnp
 
-        if any("/" not in k and "." in k for k in flat if k != "step"):
-            raise SystemExit(
-                f"{args.weights} looks like a LoRA adapter file "
-                "(finetune without --full); the server loads full "
-                "checkpoints — re-run finetune with --full or merge "
-                "the adapters into the base weights first"
-            )
+            if any("/" not in k and "." in k for k in flat if k != "step"):
+                raise SystemExit(
+                    f"{args.weights} looks like a LoRA adapter file "
+                    "(finetune without --full); the server loads full "
+                    "checkpoints — re-run finetune with --full or merge "
+                    "the adapters into the base weights first"
+                )
 
-        def set_path(tree, path, value):
-            *parents, leaf = path
-            for k in parents:
-                tree = tree[k]
-            old = tree[leaf]
-            tree[leaf] = jax.device_put(
-                jnp.asarray(value, old.dtype), old.sharding
-            )
+            def set_path(tree, path, value):
+                *parents, leaf = path
+                for k in parents:
+                    tree = tree[k]
+                old = tree[leaf]
+                tree[leaf] = jax.device_put(
+                    jnp.asarray(value, old.dtype), old.sharding
+                )
 
-        for key, value in flat.items():
-            if key == "step":
-                continue
-            set_path(params, key.split("/"), value)
+            for key, value in flat.items():
+                if key == "step":
+                    continue
+                set_path(params, key.split("/"), value)
         logger.info("loaded %d weight arrays from %s", len(flat), args.weights)
 
     if args.quantize == "int8":
@@ -1970,19 +2064,21 @@ def main(argv=None) -> int:
 
         params = quantize_tree(params, config)
         logger.info("weights quantized to int8 (per-output-channel scales)")
-    engine = InferenceEngine(
-        config, params, max_batch=args.max_batch, max_seq=args.max_seq,
-        mesh=mesh, spec_draft=args.spec_draft,
-        prefill_pack=args.prefill_pack,
-        turbo_steps=args.turbo_steps,
-        turbo_depth=args.turbo_depth,
-        prefix_cache=not args.no_prefix_cache,
-        kv_quant=args.kv_quant,
-        decode_kernel=args.decode_kernel,
-    )
+    with obs_boot.stage("engine_init"):
+        engine = InferenceEngine(
+            config, params, max_batch=args.max_batch, max_seq=args.max_seq,
+            mesh=mesh, spec_draft=args.spec_draft,
+            prefill_pack=args.prefill_pack,
+            turbo_steps=args.turbo_steps,
+            turbo_depth=args.turbo_depth,
+            prefix_cache=not args.no_prefix_cache,
+            kv_quant=args.kv_quant,
+            decode_kernel=args.decode_kernel,
+        )
     # tokenizer first: it's cheap and fail-fast — a typo'd path must
     # not cost a full compile warmup before erroring
-    tokenizer = load_tokenizer(args.tokenizer or "byte")
+    with obs_boot.stage("tokenizer_load"):
+        tokenizer = load_tokenizer(args.tokenizer or "byte")
     if not args.no_warmup:
         _warmup_engine(engine)
     env_policy = qos.QoSPolicy.from_env()
@@ -2034,50 +2130,65 @@ def _warmup_engine(engine) -> None:
             engine.step()
         engine.release(slot)
 
-    # full prefill chunk + the largest turbo variant (and steps=1 tail)
-    run(full, GenParams(max_new_tokens=max(2, engine.turbo_steps + 2)))
-    # smallest prefill bucket — short prompts must not compile on hit
-    run(full[:5], GenParams(max_new_tokens=2))
-    # intermediate turbo variants: budget s+1 → macro-step picks steps=s
-    s = engine.turbo_steps // 2
-    while s >= 2:
-        run(full[:5], GenParams(max_new_tokens=s + 1))
-        s //= 2
-    # sampled path: _decode + the full-batch [B, V] sampler
-    run(full[:5], GenParams(max_new_tokens=2, temperature=0.7, seed=0))
-    if engine.prefill_pack > 1:
-        # packed prefill variants: every power-of-2 G bucket at the
-        # full chunk width (the shapes concurrent bursts hit; short-C
-        # buckets are cheap first-hit compiles). Starts are traced, so
-        # one variant per (G, C) covers every start combination.
-        g = 2
-        while g <= engine.prefill_pack and g <= engine.max_batch:
-            slots = [
-                engine.start_request(list(full), GenParams(max_new_tokens=2))
-                for _ in range(g)
-            ]
-            runs += g
-            pending = set(slots)
-            while pending:
-                pending -= set(engine.prefill_wave())
-            while any(engine.active[s] for s in slots):
-                engine.step()
-            for s in slots:
-                engine.release(s)
-            g *= 2
-    engine.spec_draft = spec
-    if spec:
-        # repetitive prompt → drafts fire → verify_step compiles
-        rep = (full[:4] * (engine.prefill_chunk // 4 + 1))[: engine.prefill_chunk]
-        run(rep, GenParams(max_new_tokens=spec + 2))
-    # warmup prompts aren't real: none may linger as prefix-reuse
-    # candidates (a production prompt sharing their byte pattern would
-    # silently reuse warmup KV rows)
-    engine.reset_prefix_cache()
-    engine.warm_prefix_copies()
+    # boot stage: the compile-grid warmup — every run() below inserts
+    # its variants into the engine's boot-compile manifest via the
+    # watch_jit on_compile hook, so the manifest IS the coverage
+    # record of this stage
+    with obs_boot.stage("warmup_compile") as _boot_stage:
+        # full prefill chunk + the largest turbo variant (and steps=1
+        # tail)
+        run(full, GenParams(max_new_tokens=max(2, engine.turbo_steps + 2)))
+        # smallest prefill bucket — short prompts must not compile on
+        # hit
+        run(full[:5], GenParams(max_new_tokens=2))
+        # intermediate turbo variants: budget s+1 → macro-step picks
+        # steps=s
+        s = engine.turbo_steps // 2
+        while s >= 2:
+            run(full[:5], GenParams(max_new_tokens=s + 1))
+            s //= 2
+        # sampled path: _decode + the full-batch [B, V] sampler
+        run(full[:5], GenParams(max_new_tokens=2, temperature=0.7, seed=0))
+        if engine.prefill_pack > 1:
+            # packed prefill variants: every power-of-2 G bucket at the
+            # full chunk width (the shapes concurrent bursts hit;
+            # short-C buckets are cheap first-hit compiles). Starts are
+            # traced, so one variant per (G, C) covers every start
+            # combination.
+            g = 2
+            while g <= engine.prefill_pack and g <= engine.max_batch:
+                slots = [
+                    engine.start_request(list(full), GenParams(max_new_tokens=2))
+                    for _ in range(g)
+                ]
+                runs += g
+                pending = set(slots)
+                while pending:
+                    pending -= set(engine.prefill_wave())
+                while any(engine.active[s] for s in slots):
+                    engine.step()
+                for s in slots:
+                    engine.release(s)
+                g *= 2
+        engine.spec_draft = spec
+        if spec:
+            # repetitive prompt → drafts fire → verify_step compiles
+            rep = (full[:4] * (engine.prefill_chunk // 4 + 1))[: engine.prefill_chunk]
+            run(rep, GenParams(max_new_tokens=spec + 2))
+        # warmup prompts aren't real: none may linger as prefix-reuse
+        # candidates (a production prompt sharing their byte pattern
+        # would silently reuse warmup KV rows)
+        engine.reset_prefix_cache()
+        _boot_stage.set(
+            runs=runs, manifest=len(engine.compile_manifest()),
+        )
+    with obs_boot.stage("warm_prefix_copies"):
+        engine.warm_prefix_copies()
     # flight recorder steady state begins HERE: every expected compile
     # variant now exists, so any later compile is a recompile —
-    # flagged loudly as the runtime complement of DTPU003
+    # flagged loudly as the runtime complement of DTPU003 — and a
+    # recompile OUTSIDE the boot-compile manifest is a warmup-coverage
+    # gap
     engine.mark_flight_warm()
     logger.info(
         "warmup: %d requests compiled prefill/decode/sample%s in %.1fs",

@@ -92,6 +92,12 @@ async def render_metrics(db: Database) -> str:
     from dstack_tpu.obs.slo import get_slo_registry
 
     w.raw(get_slo_registry().render())
+    # fleet boot decomposition (dtpu_boot_stage_seconds/ttfst per
+    # probed replica boot — obs/boot.py, fed by the pool's probe-time
+    # ingest)
+    from dstack_tpu.obs.boot import get_boot_registry
+
+    w.raw(get_boot_registry().render())
     return w.render()
 
 

@@ -109,6 +109,13 @@ _QUICK_KEEP = (
     "test_flight.py::TestDisabledIsNoop",
     "test_engine.py::TestSteadyStateRecompiles",
     "test_chaos_flight.py::TestFlightChaosAcceptance",
+    # boot recorder: timeline/no-op/manifest contract (tests/obs) and
+    # the mid-soak cold-replica scale-up acceptance (tests/chaos) —
+    # listed so a rename fails test_quick_tier loudly
+    "test_boot.py::TestBootTimeline",
+    "test_boot.py::TestDisabledIsNoop",
+    "test_boot.py::TestManifestDiff",
+    "test_chaos_boot.py::TestBootChaosAcceptance",
 )
 
 

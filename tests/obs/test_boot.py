@@ -1,0 +1,325 @@
+"""obs.boot unit contract: monotonic boot timeline (stage ordering,
+ring/attr bounds, bytes/s derivation, once-only marks and TTFST
+sealing), the probe-memo fleet ingest, the warmup-coverage manifest
+helpers, the zero-cost-when-disabled no-op rebinding (the
+``faults.fire`` idiom), and the import-light pin — the foundations the
+serve/routing boot wiring stands on."""
+
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from dstack_tpu.obs import boot
+from dstack_tpu.obs.metrics import Registry
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+@pytest.fixture(autouse=True)
+def _restore_recorder():
+    """Each test gets to install its own recorder and leaves the
+    module state as it found it (the process default is enabled via
+    DTPU_BOOT)."""
+    prior = boot.get_recorder()
+    yield
+    if prior is not None:
+        boot._recorder = prior
+        boot.stage = prior.stage
+        boot.mark = prior.mark
+    else:
+        boot.disable()
+
+
+class TestBootTimeline:
+    def test_stage_ordering_and_monotonic_offsets(self):
+        rec = boot.BootRecorder(registry=boot.new_boot_registry())
+        with rec.stage("config_load", model="llama-tiny"):
+            pass
+        with rec.stage("weights_load", source="npz") as s:
+            s.set(bytes=1024)
+        rec.mark("listener_up")
+        tl = rec.timeline()
+        assert [e["stage"] for e in tl] == [
+            "config_load", "weights_load", "listener_up",
+        ]
+        # offsets from one monotonic anchor never go backwards
+        ts = [e["t"] for e in tl]
+        assert ts == sorted(ts)
+        assert all(t >= 0.0 for t in ts)
+        assert tl[0]["model"] == "llama-tiny"
+        assert tl[0]["seconds"] >= 0.0
+        assert tl[2]["mark"] is True and "seconds" not in tl[2]
+
+    def test_bytes_per_s_derived_on_exit(self):
+        rec = boot.BootRecorder(registry=boot.new_boot_registry())
+        with rec.stage("weights_load") as s:
+            time.sleep(0.01)
+            s.set(bytes=10_000_000)
+        e = rec.timeline()[-1]
+        assert e["bytes"] == 10_000_000
+        assert e["bytes_per_s"] == pytest.approx(
+            e["bytes"] / e["seconds"], rel=0.01
+        )
+
+    def test_ring_bounded_and_attrs_truncated(self):
+        rec = boot.BootRecorder(
+            buffer=8, registry=boot.new_boot_registry()
+        )
+        for i in range(20):
+            with rec.stage("warmup_compile", note="x" * 10_000):
+                pass
+        tl = rec.timeline(limit=100)
+        assert len(tl) == 8  # bounded ring
+        assert len(tl[-1]["note"]) == boot._MAX_ATTR_CHARS
+        # summed stage seconds survive entries falling off the ring
+        assert rec.health_block()["stages"]["warmup_compile"] > 0.0
+
+    def test_marks_are_once_only_and_ttfst_seals(self):
+        reg = boot.new_boot_registry()
+        rec = boot.BootRecorder(registry=reg)
+        assert rec.mark(boot.READY_MARK) is True
+        assert rec.mark(boot.READY_MARK) is False  # idempotent
+        assert not rec.warm
+        assert rec.mark(boot.SERVED_MARK) is True
+        assert rec.warm
+        assert rec.mark(boot.SERVED_MARK) is False
+        assert reg.family("dtpu_boot_ttfst_seconds").count() == 1
+        assert rec.ttfst() is not None
+        assert rec.time_to_ready() is not None
+        assert rec.ttfst() >= rec.time_to_ready()
+
+    def test_stage_error_annotated(self):
+        rec = boot.BootRecorder(registry=boot.new_boot_registry())
+        with pytest.raises(RuntimeError):
+            with rec.stage("engine_init"):
+                raise RuntimeError("boom")
+        assert rec.timeline()[-1]["error"] is True
+
+    def test_health_block_shape(self):
+        rec = boot.BootRecorder(registry=boot.new_boot_registry())
+        with rec.stage("engine_init"):
+            pass
+        rec.mark(boot.READY_MARK)
+        h = rec.health_block(warm=False)
+        assert h["boot_id"] == rec.boot_id
+        assert h["stages"]["engine_init"] >= 0.0
+        assert h["marks"][boot.READY_MARK] >= 0.0
+        assert h["warm"] is False
+        assert h["time_to_ready_s"] is not None
+        assert h["ttfst_s"] is None  # not served yet
+
+    def test_stage_histogram_observed_per_stage_label(self):
+        reg = boot.new_boot_registry()
+        rec = boot.BootRecorder(registry=reg)
+        with rec.stage("warm_prefix_copies"):
+            pass
+        with rec.stage("warm_prefix_copies"):
+            pass
+        fam = reg.family("dtpu_boot_stage_seconds")
+        assert fam.count("warm_prefix_copies") == 2
+
+    def test_enable_rebinds_and_debug_payload(self):
+        rec = boot.enable(buffer=16)
+        # bound methods mint per-access: pin via __self__, not `is`
+        assert getattr(boot.stage, "__self__", None) is rec
+        assert getattr(boot.mark, "__self__", None) is rec
+        with boot.stage("tokenizer_load"):
+            pass
+        boot.mark("listener_up")
+        p = boot.debug_payload({})
+        assert p["enabled"] and p["boot_id"] == rec.boot_id
+        assert p["uptime_s"] >= 0.0
+        assert [e["stage"] for e in p["timeline"]] == [
+            "tokenizer_load", "listener_up",
+        ]
+        assert p["summary"]["stages"]["tokenizer_load"] >= 0.0
+        p = boot.debug_payload({"limit": "1"})
+        assert len(p["timeline"]) == 1
+        assert boot.health_block(warm=True)["warm"] is True
+
+
+class TestIngest:
+    def _block(self, boot_id="b1", **over):
+        b = {
+            "boot_id": boot_id,
+            "started_at": 1000.0,
+            "stages": {"weights_load": 2.0, "warmup_compile": 5.0},
+            "marks": {},
+            "ttfst_s": None,
+        }
+        b.update(over)
+        return b
+
+    def test_memo_observes_each_stage_once(self):
+        reg = boot.new_boot_registry()
+        memo: dict = {}
+        assert boot.ingest(self._block(), memo, registry=reg) == 2
+        # same boot probed again: nothing new to observe
+        assert boot.ingest(self._block(), memo, registry=reg) == 0
+        # a stage completing between probes lands incrementally
+        assert boot.ingest(
+            self._block(stages={"weights_load": 2.0, "engine_init": 1.0}),
+            memo, registry=reg,
+        ) == 1
+        fam = reg.family("dtpu_boot_stage_seconds")
+        assert fam.count("weights_load") == 1
+        assert fam.count("engine_init") == 1
+        assert reg.family("dtpu_boot_replicas_total").value() == 1
+
+    def test_ttfst_observed_once_when_it_arrives(self):
+        reg = boot.new_boot_registry()
+        memo: dict = {}
+        boot.ingest(self._block(), memo, registry=reg)
+        assert reg.family("dtpu_boot_ttfst_seconds").count() == 0
+        boot.ingest(self._block(ttfst_s=9.5), memo, registry=reg)
+        boot.ingest(self._block(ttfst_s=9.5), memo, registry=reg)
+        assert reg.family("dtpu_boot_ttfst_seconds").count() == 1
+
+    def test_boot_id_change_resets_memo_and_counts_new_boot(self):
+        reg = boot.new_boot_registry()
+        memo: dict = {}
+        assert boot.ingest(self._block("b1"), memo, registry=reg) == 2
+        # restart: same stage names observe again under the new boot
+        assert boot.ingest(self._block("b2"), memo, registry=reg) == 2
+        assert memo["boot_id"] == "b2"
+        assert reg.family("dtpu_boot_replicas_total").value() == 2
+        assert reg.family("dtpu_boot_stage_seconds").count(
+            "weights_load"
+        ) == 2
+
+    def test_garbage_blocks_ignored(self):
+        reg = boot.new_boot_registry()
+        memo: dict = {}
+        assert boot.ingest(None, memo, registry=reg) == 0
+        assert boot.ingest({}, memo, registry=reg) == 0
+        assert boot.ingest(
+            self._block(stages={"weights_load": "NaN-ish"}),
+            memo, registry=reg,
+        ) == 0
+        assert memo["boot_id"] == "b1"  # identity still latched
+
+
+class TestManifestDiff:
+    def test_key_matches_flight_repr_stringification(self):
+        assert boot.manifest_key("decode") == "decode"
+        assert boot.manifest_key("packed", (4, 64)) == "packed(4, 64)"
+        # same stringification the flight ring uses for compile records
+        assert boot.manifest_key("chunk", (64, 0)) == "chunk" + repr(
+            (64, 0)
+        )
+
+    def test_diff_partitions_covered_and_gaps(self):
+        manifest = {"packed(4, 64)", "decode", "chunk(64, 0)"}
+        observed = {"packed(4, 64)", "packed(8, 128)"}
+        d = boot.manifest_diff(manifest, observed)
+        assert d == {
+            "covered": ["packed(4, 64)"],
+            "gaps": ["packed(8, 128)"],
+        }
+
+    def test_empty_sides(self):
+        assert boot.manifest_diff(set(), set()) == {
+            "covered": [], "gaps": [],
+        }
+        assert boot.manifest_diff(set(), {"a"}) == {
+            "covered": [], "gaps": ["a"],
+        }
+        assert boot.manifest_diff({"a"}, set()) == {
+            "covered": [], "gaps": [],
+        }
+
+
+class TestDisabledIsNoop:
+    def test_noop_rebinding_pinned(self):
+        """THE zero-cost contract (same pin as faults.fire /
+        flight.record): disabled means `boot.stage` IS the
+        module-level no-op and every entry point is a cheap no-op."""
+        boot.disable()
+        assert boot.stage is boot._noop_stage
+        assert boot.mark is boot._noop_mark
+        assert not boot.enabled()
+        assert boot.get_recorder() is None
+        with boot.stage("weights_load", bytes=1) as s:
+            s.set(bytes=2)  # _NoopStage.set exists and does nothing
+        assert boot.mark(boot.SERVED_MARK) is False
+        assert boot.health_block() is None
+        assert boot.debug_payload({}) == {
+            "enabled": False, "timeline": [],
+        }
+
+    def test_env_kill_switch_in_subprocess(self):
+        code = (
+            "from dstack_tpu.obs import boot\n"
+            "assert boot.stage is boot._noop_stage\n"
+            "assert boot.mark is boot._noop_mark\n"
+            "assert not boot.enabled()\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+            env={"PATH": "/usr/bin:/bin", "DTPU_BOOT": "0"},
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    def test_env_buffer_respected_in_subprocess(self):
+        code = (
+            "from dstack_tpu.obs import boot\n"
+            "assert boot.enabled()\n"
+            "assert boot.get_recorder().buffer == 32\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+            env={"PATH": "/usr/bin:/bin", "DTPU_BOOT_BUFFER": "32"},
+        )
+        assert proc.returncode == 0, proc.stderr
+
+
+class TestImportLight:
+    def test_import_pulls_no_heavy_runtime(self):
+        """obs.boot must import (and record) without aiohttp/jax/numpy
+        — the lint collector, the CLI renderer, and the routing pool's
+        ingest all touch it without a serving runtime."""
+        code = (
+            "import sys\n"
+            "from dstack_tpu.obs import boot\n"
+            "rec = boot.enable(buffer=8)\n"
+            "with boot.stage('weights_load', bytes=10):\n"
+            "    pass\n"
+            "boot.mark(boot.SERVED_MARK)\n"
+            "assert rec.ttfst() is not None\n"
+            "bad = [m for m in ('aiohttp', 'jax', 'numpy', 'jaxlib') "
+            "if m in sys.modules]\n"
+            "assert not bad, f'boot pulled in {bad}'\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+
+class TestCLIRendering:
+    def test_render_boot_table_pure(self):
+        """The `dtpu boot` renderer is a pure function of the
+        /debug/boot payload (no server needed)."""
+        from dstack_tpu.cli.main import render_boot_table
+
+        payload = {
+            "enabled": True,
+            "boot_id": "abc123",
+            "uptime_s": 42.0,
+            "timeline": [
+                {"stage": "weights_load", "t": 0.5, "seconds": 2.1,
+                 "bytes": 10_000_000, "bytes_per_s": 4_761_904.8,
+                 "source": "npz"},
+                {"stage": "warmup_compile", "t": 2.7, "seconds": 5.0,
+                 "runs": 9, "manifest": 7},
+                {"stage": "first_served_token", "t": 9.9, "mark": True},
+            ],
+        }
+        table = render_boot_table(payload)
+        assert table.row_count == 3

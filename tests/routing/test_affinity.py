@@ -483,3 +483,102 @@ class TestResumeRecordBound:
         assert resume_record_max_chars() == 123
         monkeypatch.setenv("DTPU_STREAM_RESUME_MAX_CHARS", "garbage")
         assert resume_record_max_chars() == 2_000_000
+
+
+class TestBootRestartInvalidation:
+    """ISSUE 16 satellite: boot identity is the authoritative restart
+    signal. An engine that restarts AND re-warms between probes never
+    shows ``prefix_slots=0`` — the heuristic above is blind to it —
+    but its ``boot_id`` changed, and every KV row the affinity map
+    remembers is gone with the old process."""
+
+    def _probe(self, boot_id, slots=3):
+        import time as _time
+
+        return {
+            "prefix_slots": slots,
+            "boot": {
+                "boot_id": boot_id,
+                "started_at": _time.time(),
+                "stages": {"warmup_compile": 1.0},
+                "marks": {},
+                "ttfst_s": None,
+            },
+        }
+
+    def test_rewarmed_restart_flap_invalidates_by_boot_id(self):
+        """THE regression: restart + re-warm between probes. The probe
+        is fresh, slots>0 (the heuristic would happily route back),
+        mapping learned before the restart — only the boot_id change
+        can invalidate, and it must."""
+        import time as _time
+
+        pool = mk_pool()
+        e = pool.get("r1")
+        e.probe = self._probe("boot-a")
+        e.last_probe_at = _time.monotonic()
+        pool.ingest_boot(e)  # latch boot identity
+        _time.sleep(0.01)
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        assert pool.pick(affinity=key).replica_id == "r1"
+        r0 = _counter("dtpu_router_boot_restarts_total")
+        # the replica restarted and RE-WARMED: next probe is fresh,
+        # slots still > 0, but a new process answered it
+        e.probe = self._probe("boot-b", slots=3)
+        e.last_probe_at = _time.monotonic()
+        pool.ingest_boot(e)
+        assert _counter("dtpu_router_boot_restarts_total") == r0 + 1
+        assert pool.affinity.lookup(key) is None
+        assert pool.pick(affinity=key).replica_id != "r1"
+
+    def test_same_boot_id_repeat_probes_keep_affinity(self):
+        import time as _time
+
+        pool = mk_pool()
+        e = pool.get("r1")
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        r0 = _counter("dtpu_router_boot_restarts_total")
+        for _ in range(3):
+            e.probe = self._probe("boot-a")
+            e.last_probe_at = _time.monotonic()
+            pool.ingest_boot(e)
+        assert _counter("dtpu_router_boot_restarts_total") == r0
+        assert pool.pick(affinity=key).replica_id == "r1"
+
+    def test_probes_without_boot_block_are_inert(self):
+        """Pre-upgrade replicas (or DTPU_BOOT=0) probe without a boot
+        block: nothing latches, nothing invalidates, forever."""
+        pool = mk_pool()
+        e = pool.get("r1")
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        r0 = _counter("dtpu_router_boot_restarts_total")
+        for probe in ({}, {"prefix_slots": 2}, {"boot": None},
+                      {"boot": {"no_id": 1}}):
+            e.probe = probe
+            pool.ingest_boot(e)
+        assert e.boot_memo == {}
+        assert _counter("dtpu_router_boot_restarts_total") == r0
+        assert pool.pick(affinity=key).replica_id == "r1"
+
+    def test_prefix_slots_zero_heuristic_survives(self):
+        """The boot_id detector ADDS to the slots=0 heuristic (same-
+        process registry resets carry the same boot_id): a fresh
+        slots=0 probe under an unchanged boot_id still demotes."""
+        import time as _time
+
+        pool = mk_pool()
+        e = pool.get("r1")
+        e.probe = self._probe("boot-a")
+        e.last_probe_at = _time.monotonic()
+        pool.ingest_boot(e)
+        _time.sleep(0.01)
+        key = _chat("x")
+        pool.affinity.record(key, "r1")
+        _time.sleep(0.01)
+        e.probe = self._probe("boot-a", slots=0)  # same process, reset
+        e.last_probe_at = _time.monotonic()
+        pool.ingest_boot(e)
+        assert pool.pick(affinity=key).replica_id != "r1"

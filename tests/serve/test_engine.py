@@ -1572,6 +1572,14 @@ class TestSteadyStateRecompiles:
             labels[0]: v for labels, v in compiles.items()
         }
         assert first, "cold pass must have compiled something"
+        # the boot-compile manifest captured exactly the variants the
+        # cold pass visited (same repr stringification as the flight
+        # ring, so the two views can never disagree on identity)
+        observed_cold = {
+            e["fn"] + (e["key"] or "")
+            for e in self.rec.compile_events(512)
+        }
+        assert eng.compile_manifest() == observed_cold
         eng.mark_flight_warm()
         self._mixed_pass(eng)  # identical traffic: all buckets warm
         second = {
@@ -1586,3 +1594,46 @@ class TestSteadyStateRecompiles:
         assert not any(
             r["phase"] == "recompile" for r in self.rec.records(512)
         )
+        # ... and therefore zero warmup-coverage gaps: every pass-2 key
+        # sits inside the pass-1 manifest
+        gaps = eng.metrics.family("dtpu_serve_warmup_gap_compiles_total")
+        assert gaps.items() == [], "gap detector fired on covered traffic"
+
+    def test_skipped_warmup_bucket_fails_the_gate(self):
+        """The negative half of the manifest gate: a deliberately THIN
+        warmup (greedy serial only — it never visits the packed
+        prefill grid or the sampling variants) marks warm, then full
+        mixed traffic arrives. Every compile it pays must be flagged
+        as a warmup-coverage gap — the un-warmed-grid-cell bug class
+        detected, not merely priced as a generic recompile."""
+        from dstack_tpu.obs import boot
+
+        params = llama.init_params(self.config, jax.random.key(0))
+        eng = InferenceEngine(
+            self.config, params, max_batch=4, max_seq=128,
+            prefill_chunk=16, prefill_pack=4, spec_draft=0,
+            turbo_steps=4,
+        )
+        gen = lambda **kw: GenParams(max_new_tokens=3, **kw)  # noqa: E731
+        eng.generate(list(range(3, 20)), gen())  # the whole "warmup"
+        manifest = eng.compile_manifest()
+        assert manifest, "thin warmup still compiles its own bucket"
+        eng.mark_flight_warm()
+        self._mixed_pass(eng)
+        gaps = eng.metrics.family("dtpu_serve_warmup_gap_compiles_total")
+        gap_total = sum(v for _, v in gaps.items())
+        assert gap_total > 0, (
+            "mixed traffic compiled outside a thin warmup manifest but "
+            "the gap detector stayed silent"
+        )
+        # the manifest froze at warm: post-warm compiles never
+        # retroactively join it (else the gate would self-heal shut)
+        assert eng.compile_manifest() == manifest
+        # manifest_diff tells the same story from the flight events
+        observed = {
+            e["fn"] + (e["key"] or "")
+            for e in self.rec.compile_events(512)
+        }
+        diff = boot.manifest_diff(manifest, observed)
+        assert diff["gaps"], diff
+        assert gap_total == len(diff["gaps"]), (gap_total, diff)

@@ -736,6 +736,85 @@ class TestFlightEndpoint:
                 flight.record = prior.record
 
 
+class TestBootEndpoint:
+    """The replica's /debug/boot surface + the /health boot block
+    (obs/boot.py): the first /health answers the time-to-ready mark,
+    the first served token seals TTFST, and the debug payload carries
+    the warmup-coverage manifest verdict."""
+
+    async def _boot_client(self, rec):
+        config = llama.LLAMA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        engine = InferenceEngine(config, params, max_batch=4, max_seq=128)
+        app = build_app(engine, ByteTokenizer(), "llama-tiny", boot=rec)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return client
+
+    async def test_health_and_debug_boot(self):
+        from dstack_tpu.obs import boot
+
+        rec = boot.BootRecorder(registry=boot.new_boot_registry())
+        client = await self._boot_client(rec)
+        try:
+            # the listener came up before any request could land
+            assert "listener_up" in rec.health_block()["marks"]
+            r = await client.get("/health")
+            h = await r.json()
+            b = h["boot"]
+            assert b["boot_id"] == rec.boot_id
+            # THIS probe was the first sight of the replica: the
+            # time-to-ready mark is answered in the same response
+            assert b["marks"][boot.READY_MARK] is not None
+            assert b["ttfst_s"] is None  # nothing served yet
+            assert b["warm"] is False  # the ENGINE's warmup flag
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "llama-tiny", "prompt": "abcd",
+                      "max_tokens": 4},
+            )
+            assert r.status == 200
+            assert rec.ttfst() is not None  # first served token sealed
+            r = await client.get("/health")
+            assert (await r.json())["boot"]["ttfst_s"] == rec.ttfst()
+            r = await client.get("/debug/boot")
+            assert r.status == 200
+            p = await r.json()
+            assert p["enabled"] is True
+            assert p["boot_id"] == rec.boot_id
+            marks = {e["stage"] for e in p["timeline"] if e.get("mark")}
+            assert {"listener_up", boot.READY_MARK,
+                    boot.SERVED_MARK} <= marks
+            assert p["summary"]["ttfst_s"] == rec.ttfst()
+            # the boot-compile manifest verdict rides the payload
+            m = p["compile_manifest"]
+            assert m["warm"] is False  # this engine never ran warmup
+            assert m["gap_compiles"] == 0
+            assert isinstance(m["variants"], list)
+            # ?limit bounds the timeline
+            r = await client.get("/debug/boot?limit=1")
+            assert len((await r.json())["timeline"]) == 1
+        finally:
+            await client.close()
+
+    async def test_opted_out_replica_has_no_boot_surface(self):
+        """build_app(boot=None): no boot block in /health and an
+        honest disabled /debug/boot (the soak's baseline replicas)."""
+        config = llama.LLAMA_TINY
+        params = llama.init_params(config, jax.random.key(0))
+        engine = InferenceEngine(config, params, max_batch=4, max_seq=128)
+        app = build_app(engine, ByteTokenizer(), "llama-tiny", boot=None)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            h = await (await client.get("/health")).json()
+            assert "boot" not in h
+            p = await (await client.get("/debug/boot")).json()
+            assert p == {"enabled": False, "timeline": []}
+        finally:
+            await client.close()
+
+
 class TestNChoices:
     async def test_n_greedy_choices_identical(self):
         client = await _client()

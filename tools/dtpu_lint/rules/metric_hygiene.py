@@ -156,6 +156,7 @@ def collect_metric_names(repo: Path) -> set:
         sys.path.insert(0, str(repo))
     names: set = set()
     from dstack_tpu.loadgen.metrics import new_loadgen_registry
+    from dstack_tpu.obs.boot import new_boot_registry
     from dstack_tpu.obs.flight import new_flight_registry
     from dstack_tpu.obs.slo import new_slo_registry
     from dstack_tpu.obs.tracing import new_trace_registry
@@ -176,6 +177,7 @@ def collect_metric_names(repo: Path) -> set:
     names.update(new_trace_registry().metric_names())
     names.update(new_slo_registry().metric_names())
     names.update(new_flight_registry().metric_names())
+    names.update(new_boot_registry().metric_names())
     try:
         from dstack_tpu.train.step import new_train_registry
 
