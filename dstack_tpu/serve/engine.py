@@ -2268,9 +2268,11 @@ class InferenceEngine:
         while pad < tp:
             pad *= 2
         marked = list(prompt) + [0] * (pad - tp)
+        # slot/tp ride along as traced scalars — only the prompt itself
+        # is a host list that must cross to device
         self._seen, self._gen_counts = self._mark_prompt(
-            self._seen, self._gen_counts, jnp.asarray(slot),
-            jnp.asarray(marked, jnp.int32), jnp.asarray(tp, jnp.int32),
+            self._seen, self._gen_counts, slot,
+            jnp.asarray(marked, jnp.int32), tp,
         )
         if gen.logit_bias or self.has_bias[slot]:
             # skip the vocab-size upload when the row is known zero
@@ -2285,24 +2287,38 @@ class InferenceEngine:
             self._logit_bias = self._logit_bias.at[slot].set(bias_row)
         self.min_ps[slot] = gen.min_p
         self.has_bias[slot] = bool(gen.logit_bias)
+        # publish the request's sampling knobs to the host lists FIRST,
+        # then sample through row slices of the device-resident mirror
+        # (_sampling_params) — the previous shape uploaded seven fresh
+        # single-element arrays per activation
+        self.temps[slot] = gen.temperature
+        self.top_ps[slot] = gen.top_p
+        self.top_ks[slot] = gen.top_k
+        self.rep_pens[slot] = gen.repetition_penalty
+        self.pres_pens[slot] = gen.presence_penalty
+        self.freq_pens[slot] = gen.frequency_penalty
+        self._sampling_state = None  # the writes above made any cached mirror stale
+        sp = self._sampling_params()
+        temps, top_ps, top_ks, rep_pens, pres_pens, freq_pens, min_ps = sp
+        row = slice(slot, slot + 1)
         toks, kd = self._sample(
             logits,
-            self._key_data[slot:slot + 1],
-            jnp.asarray([gen.temperature], jnp.float32),
-            jnp.asarray([gen.top_p], jnp.float32),
-            jnp.asarray([gen.top_k], jnp.int32),
-            jnp.asarray([gen.repetition_penalty], jnp.float32),
-            self._seen[slot:slot + 1],
-            jnp.asarray([gen.presence_penalty], jnp.float32),
-            jnp.asarray([gen.frequency_penalty], jnp.float32),
-            self._gen_counts[slot:slot + 1],
-            self._logit_bias[slot:slot + 1],
-            jnp.asarray([gen.min_p], jnp.float32),
+            self._key_data[row],
+            temps[row],
+            top_ps[row],
+            top_ks[row],
+            rep_pens[row],
+            self._seen[row],
+            pres_pens[row],
+            freq_pens[row],
+            self._gen_counts[row],
+            self._logit_bias[row],
+            min_ps[row],
         )
         tok = int(toks[0])
         self._key_data = self._key_data.at[slot].set(kd[0])
         self._seen, self._gen_counts = self._mark_seen(
-            self._seen, self._gen_counts, jnp.asarray([slot]), jnp.asarray([tok])
+            self._seen, self._gen_counts, self._slot_iota[row], toks
         )
         self.want_logprobs[slot] = gen.logprobs is not None
         if gen.logprobs is not None:
@@ -2325,6 +2341,11 @@ class InferenceEngine:
         self.metrics.family("dtpu_serve_tokens_generated_total").inc(1)
         self.active[slot] = True
         self._invalidate_decode_cache()  # activation mutated slot state
+        # the sampling-param lists were published BEFORE the mirror was
+        # built above and nothing after touched them — restore so the
+        # next sampled token reuses the same device arrays (same idiom
+        # as _plain_step's restore)
+        self._sampling_state = sp
         if self.prefix_cache:
             # the slot's rows now hold this fully-prefilled prompt;
             # they stay reusable until the slot is reassigned
@@ -2339,12 +2360,6 @@ class InferenceEngine:
         self.remaining[slot] = gen.max_new_tokens - 1
         self.eos[slot] = gen.eos_id
         self.last_token[slot] = tok
-        self.temps[slot] = gen.temperature
-        self.top_ps[slot] = gen.top_p
-        self.top_ks[slot] = gen.top_k
-        self.rep_pens[slot] = gen.repetition_penalty
-        self.pres_pens[slot] = gen.presence_penalty
-        self.freq_pens[slot] = gen.frequency_penalty
         self.finish_reason[slot] = None
         if tok == gen.eos_id or gen.max_new_tokens <= 1:
             # finished immediately; slot never enters the decode loop

@@ -1,0 +1,91 @@
+"""parallel/sharding.py units: logical→mesh spec translation on
+partial meshes and the no-mesh ``constrain`` path.
+
+These are the helpers the multi-host serve surface leans on (sharded
+engine init, shardcheck's manifest) — ``filter_spec_for_mesh`` is what
+lets one logical rule table serve meshes that only declare a subset of
+the axes (a tp-only serving mesh vs the full 6-axis training mesh).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dstack_tpu.parallel.sharding import (
+    constrain,
+    default_rules,
+    filter_spec_for_mesh,
+    tree_pspecs,
+)
+
+
+def _mesh(axes: dict) -> Mesh:
+    n = int(np.prod(list(axes.values())))
+    devs = np.asarray(jax.devices()[:n]).reshape(tuple(axes.values()))
+    return Mesh(devs, tuple(axes))
+
+
+class TestFilterSpecForMesh:
+    def test_drops_axes_the_mesh_lacks(self):
+        mesh = _mesh({"tp": 2})
+        assert filter_spec_for_mesh(P("pp", "tp"), mesh) == P(None, "tp")
+
+    def test_tuple_entries_filter_to_present_members(self):
+        mesh = _mesh({"dp": 2, "tp": 2})
+        assert filter_spec_for_mesh(P(("dp", "fsdp"), "tp"), mesh) == P(
+            ("dp",), "tp"
+        )
+
+    def test_fully_absent_tuple_becomes_replicated(self):
+        mesh = _mesh({"tp": 2})
+        assert filter_spec_for_mesh(P(("dp", "fsdp"), None), mesh) == P(
+            None, None
+        )
+
+    def test_identity_on_full_mesh(self):
+        mesh = _mesh({"dp": 2, "fsdp": 2, "tp": 2})
+        spec = P(("dp", "fsdp"), None, "tp")
+        assert filter_spec_for_mesh(spec, mesh) == spec
+
+
+class TestConstrain:
+    def test_noop_without_mesh(self):
+        rules = default_rules()
+        x = jnp.arange(8.0)
+        # the mesh=None path must be a true no-op (serve code calls
+        # constrain unconditionally; single-host runs pass no mesh)
+        assert constrain(x, rules, "batch", mesh=None) is x
+
+    def test_applies_filtered_sharding_under_jit(self):
+        rules = default_rules()
+        mesh = _mesh({"tp": 2})
+        x = jnp.arange(16.0).reshape(8, 2)
+
+        @jax.jit
+        def f(a):
+            # "batch" maps to (dp, fsdp, ep) — all absent on the
+            # tp-only mesh, so the constraint filters to replicated
+            # instead of raising on undeclared axes
+            return constrain(a, rules, "batch", "head_dim", mesh=mesh)
+
+        with mesh:
+            out = f(x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_vocab_row_shards_over_tp(self):
+        rules = default_rules()
+        mesh = _mesh({"tp": 2})
+        x = jnp.arange(16.0).reshape(2, 8)
+        out = jax.jit(
+            lambda a: constrain(a, rules, None, "vocab", mesh=mesh)
+        )(x)
+        assert out.sharding == NamedSharding(mesh, P(None, "tp"))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    def test_tree_pspecs_maps_logical_tuples(self):
+        rules = default_rules()
+        tree = {"emb": ("vocab", "embed"), "moe": ("experts", "mlp")}
+        specs = tree_pspecs(tree, rules)
+        assert specs == {"emb": P("tp", None), "moe": P("ep", "tp")}

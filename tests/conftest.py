@@ -66,6 +66,14 @@ _QUICK_KEEP = (
     "test_engine.py::TestAdaptiveTurbo::test_ramp_and_snap_back",
     # one parallelism identity (ring attention vs local)
     "test_parallel.py::TestRingAttention::test_matches_local",
+    # logical→mesh spec translation on partial meshes + the no-mesh
+    # constrain path (the helpers sharded serving and shardcheck's
+    # manifest stand on)
+    "test_sharding_utils.py::TestFilterSpecForMesh",
+    "test_sharding_utils.py::TestConstrain",
+    # sampling-param device mirror lifecycle (the DTPU002 burn-down's
+    # activation-publishes-a-fresh-mirror contract)
+    "test_engine.py::TestDecodeStateMirror",
     # serving HTTP surface
     "test_openai_server.py::TestOpenAIServer::test_chat_completions",
     # prefix-registry lifecycle: the engine-side contract prefix-

@@ -1144,20 +1144,22 @@ class TestDecodeStateMirror:
         s1, _ = eng.add_request(
             [5, 9, 21], GenParams(max_new_tokens=8, temperature=0.9, seed=3)
         )
-        assert eng._sampling_state is None  # activation invalidated
-        eng.step()
+        # activation publishes a fresh mirror already holding the new
+        # request's knobs (it sampled the first token through it)
         first = eng._sampling_state
-        assert first is not None  # mirror survives the per-token advance
+        assert first is not None
+        assert abs(float(first[0][s1]) - 0.9) < 1e-6  # temps row
+        eng.step()
+        assert eng._sampling_state is first  # survives the per-token advance
         eng.step()
         assert eng._sampling_state is first  # reused, not re-uploaded
         s2, _ = eng.add_request(
             [7, 8], GenParams(max_new_tokens=4, temperature=1.3, seed=9)
         )
-        assert eng._sampling_state is None  # admission invalidated
-        eng.step()
         rebuilt = eng._sampling_state
-        assert rebuilt is not None and rebuilt is not first
+        assert rebuilt is not None and rebuilt is not first  # admission rebuilt
         assert abs(float(rebuilt[0][s2]) - 1.3) < 1e-6  # temps row
+        assert abs(float(rebuilt[0][s1]) - 0.9) < 1e-6  # s1's row kept
 
 
 class TestCompileCacheAccounting:

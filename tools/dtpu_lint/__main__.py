@@ -89,8 +89,10 @@ def main(argv=None) -> int:
         const="HEAD",
         metavar="GITREF",
         help="lint only files changed vs GITREF (default HEAD), plus "
-        "untracked ones — the fast pre-commit pass; file rules only, "
-        "baseline restricted to the scanned files like any path subset",
+        "untracked ones — the fast pre-commit pass; file rules plus "
+        "path-scoped project rules (DTPU012-014) whose scope matches a "
+        "changed file, baseline restricted to the scanned files like "
+        "any path subset",
     )
     ap.add_argument(
         "--baseline",

@@ -268,7 +268,7 @@ def run_lint(
             for finding in rule.check(tree, src, rel, repo):
                 if not suppressed(finding, lines):
                     findings.append(finding)
-    if project_rules and paths is None:
+    if project_rules:
         # project-rule findings honor line pragmas too: flow rules
         # (DTPU008-011) point at real source lines where a
         # `# dtpu: noqa[RULE] reason` is the sanctioned opt-out
@@ -282,6 +282,13 @@ def run_lint(
                     line_cache[rel] = []
             return line_cache[rel]
 
+        # path-restricted runs (--changed-only, explicit paths) include
+        # only project rules that declare a `scope`, and only when a
+        # scanned path matches it; their findings are then filtered to
+        # the scanned set so an unrelated file's finding can't fail a
+        # pre-commit pass. Scope-less project rules (repo-wide
+        # docs-coverage style) still run on full lints only.
+        scanned = set(iter_lint_files(repo, paths)) if paths else None
         for rid, r in sorted(rules.items()):
             # a project rule shipped as a sub-id of a file rule
             # (DTPU004-DOCS) runs whenever its base id is selected
@@ -290,7 +297,15 @@ def run_lint(
                 or rid in rule_ids
                 or rid.split("-")[0] in rule_ids
             ):
+                if scanned is not None:
+                    scope = getattr(r, "scope", None)
+                    if not scope or not any(
+                        glob_match(rel, g) for rel in scanned for g in scope
+                    ):
+                        continue
                 for finding in r.check_project(repo):
+                    if scanned is not None and finding.path not in scanned:
+                        continue
                     if not suppressed(finding, _lines_for(finding.path)):
                         findings.append(finding)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))

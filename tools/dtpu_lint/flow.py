@@ -743,21 +743,30 @@ def report_paths(root: Path) -> set:
     return out
 
 
-def _load_cache(path: Path) -> dict:
+def _load_cache(path: Path, section: str = "files") -> dict:
     try:
         data = json.loads(path.read_text())
         if data.get("version") == analyzer_version():
-            return data.get("files", {})
+            return data.get(section, {})
     except (OSError, ValueError):
         pass
     return {}
 
 
-def _store_cache(path: Path, files: dict) -> None:
+def _store_cache(path: Path, files: dict, section: str = "files") -> None:
+    """Write one section, preserving the others (the async event-stream
+    summaries and the SPMD summaries share `.flowcache.json`; each
+    get_*_flow call refreshes only its own section)."""
     try:
-        path.write_text(
-            json.dumps({"version": analyzer_version(), "files": files})
-        )
+        data = json.loads(path.read_text())
+        if data.get("version") != analyzer_version():
+            data = {}
+    except (OSError, ValueError):
+        data = {}
+    data["version"] = analyzer_version()
+    data[section] = files
+    try:
+        path.write_text(json.dumps(data))
     except OSError:
         pass  # read-only checkout: cache is an optimization only
 
@@ -817,4 +826,1056 @@ def get_flow(
 
     flow = ProjectFlow(root, summaries)
     _memo[str(root)] = (state, flow)
+    return flow
+
+
+# ---------------------------------------------------------------------------
+# SPMD sharding analysis (DTPU012-014 + shardcheck's static side)
+# ---------------------------------------------------------------------------
+#
+# A second, independent index over the *traced* compute plane: where the
+# async analysis above follows awaits and resource holds, this one
+# follows mesh-axis names. The unit of interest is an "axis reference"
+# — a collective's axis argument, a ``shard_map`` spec entry, a
+# ``PartitionSpec`` element — and the core problem is that the library
+# idiom never writes the axis literal at the use site:
+#
+#     def ring_attention(q, k, v, mesh, axis_name: str = "sp", ...):
+#         local_fn = _make_ring_pallas(sp, axis_name, ...)   # param ref
+#         ...
+#             kb = jax.lax.ppermute(kb, axis_name, perm)      # closure ref
+#
+# so per-function summaries record axis references symbolically
+# ({"t": "param", "fq": owner, "p": name}) and :class:`SpmdFlow` runs a
+# small interprocedural fixpoint mapping every axis-carrying parameter
+# to the set of string literals that can flow into it (defaults plus
+# call-site literals, transitively through parameter-to-parameter
+# passes). Summaries are cached in `.flowcache.json` under a separate
+# "spmd" section, keyed by content hash like the async ones.
+
+#: files indexed for SPMD analysis (the traced compute plane)
+SPMD_GLOBS = (
+    "dstack_tpu/parallel/**/*.py",
+    "dstack_tpu/ops/**/*.py",
+    "dstack_tpu/models/**/*.py",
+    "dstack_tpu/serve/engine.py",
+)
+
+#: the file whose module-level ``AXES = (...)`` tuple is the project's
+#: mesh-axis vocabulary
+MESH_AXES_FILE = "dstack_tpu/parallel/mesh.py"
+
+#: collective name -> positional index of its axis-name argument
+COLLECTIVES = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "ppermute": 1,
+    "all_to_all": 1,
+    "psum_scatter": 1,
+    "axis_index": 0,
+}
+
+#: names bindable to jax.sharding.PartitionSpec by import
+_PSPEC_NAMES = frozenset({"PartitionSpec", "P"})
+
+#: attribute accesses that yield static (host) values even on traced
+#: arrays — branching on these is shape-dependent Python, not a trace
+#: divergence
+_STATIC_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "sharding"})
+_STATIC_CALLS = frozenset({"len", "isinstance", "range", "type", "getattr", "hasattr"})
+
+
+def axis_vocabulary_from_source(src: str) -> frozenset:
+    """Mesh-axis names from a module-level ``AXES = ("dp", ...)``."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return frozenset()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "AXES":
+                    if isinstance(node.value, (ast.Tuple, ast.List)):
+                        return frozenset(
+                            e.value
+                            for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                        )
+    return frozenset()
+
+
+def axis_vocabulary(root: Path) -> frozenset:
+    """The project's declared mesh-axis vocabulary (empty when the
+    mesh module is absent — fixture trees without one skip the vocab
+    checks)."""
+    try:
+        src = (Path(root) / MESH_AXES_FILE).read_text()
+    except OSError:
+        return frozenset()
+    return axis_vocabulary_from_source(src)
+
+
+# -- axis-reference encoding (JSON-friendly) --
+# {"t": "lit", "v": "tp"}          a string literal
+# {"t": "param", "fq": q, "p": n}  parameter `n` of function `q` (same file)
+# {"t": "none"}                    an explicit None spec entry
+# {"t": "unk", "v": "<expr>"}      statically unresolvable
+
+
+def _lit(v):
+    return {"t": "lit", "v": v}
+
+
+class _SpmdEnv:
+    """Per-function lexical environment: params, string locals, spec
+    locals, taint. Chained through ``parent`` for closures."""
+
+    def __init__(self, qual, params, parent=None):
+        self.qual = qual
+        self.params = list(params)
+        self.parent = parent
+        self.str_locals: dict = {}
+        self.spec_locals: dict = {}  # name -> [axisref, ...] (one P(...))
+        self.list_locals: dict = {}  # name -> [axisref, ...] (spec lists)
+        self.tainted: set = set(params)
+
+    def resolve_name(self, name):
+        env = self
+        while env is not None:
+            if name in env.str_locals:
+                return _lit(env.str_locals[name])
+            if name in env.params:
+                return {"t": "param", "fq": env.qual, "p": name}
+            env = env.parent
+        return {"t": "unk", "v": name}
+
+    def lookup_spec(self, name):
+        env = self
+        while env is not None:
+            if name in env.spec_locals:
+                return list(env.spec_locals[name])
+            if name in env.list_locals:
+                return list(env.list_locals[name])
+            env = env.parent
+        return None
+
+
+def _names_used(node) -> set:
+    """Names an expression *dynamically* depends on: attribute reads of
+    static metadata (``x.shape``) and calls like ``len()`` don't count
+    — branching on those is shape-specialization, not a per-shard
+    divergence."""
+    out: set = set()
+
+    def walk(n):
+        if isinstance(n, ast.Attribute):
+            if n.attr in _STATIC_ATTRS:
+                return
+            walk(n.value)
+            return
+        if isinstance(n, ast.Call):
+            fn = n.func
+            if isinstance(fn, ast.Name) and fn.id in _STATIC_CALLS:
+                return
+            # receiver methods that read metadata: x.shape[...] handled
+            # above; anything else descends normally
+            for child in ast.iter_child_nodes(n):
+                walk(child)
+            return
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+            return
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
+    walk(node)
+    return out
+
+
+class _SpmdExtractor:
+    """Extracts one function's SPMD events, recursing into nested
+    functions (each nested def gets its own entry, with the lexical
+    chain threaded for closure resolution)."""
+
+    def __init__(self, lines, imports, functions_out):
+        self.lines = lines
+        self.imports = imports  # name -> dotted module/symbol
+        self.functions = functions_out
+
+    # -- helpers --
+
+    def _noqa(self, line):
+        return _line_pragmas(self.lines, line)
+
+    def _is_numpy(self, root):
+        return self.imports.get(root) == "numpy"
+
+    def _is_pspec(self, call: ast.Call):
+        f = call.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if name not in _PSPEC_NAMES:
+            return False
+        if isinstance(f, ast.Name):
+            bound = self.imports.get(name, name)
+            return bound.rsplit(".", 1)[-1] in _PSPEC_NAMES or name == "P"
+        return True  # jax.sharding.PartitionSpec(...)
+
+    def _parse_pspec_axes(self, call: ast.Call, env) -> list:
+        axes: list = []
+
+        def add(node):
+            if isinstance(node, ast.Constant):
+                if isinstance(node.value, str):
+                    axes.append(_lit(node.value))
+                elif node.value is None:
+                    axes.append({"t": "none"})
+            elif isinstance(node, ast.Name):
+                axes.append(env.resolve_name(node.id))
+            elif isinstance(node, (ast.Tuple, ast.List)):
+                for e in node.elts:
+                    add(e)
+            elif isinstance(node, ast.Starred):
+                # P(*([None, None, "tp"] + pad)): collect the literals
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Constant) and isinstance(
+                        sub.value, str
+                    ):
+                        axes.append(_lit(sub.value))
+            else:
+                axes.append({"t": "unk", "v": ast.unparse(node)[:40]})
+
+        for a in call.args:
+            add(a)
+        return axes
+
+    def _parse_spec_expr(self, node, env) -> Optional[list]:
+        """A shard_map in_specs/out_specs expression → flat axisref
+        list, or None when unresolvable."""
+        if isinstance(node, ast.Call):
+            if self._is_pspec(node):
+                return self._parse_pspec_axes(node, env)
+            # tuple(in_specs) / list(in_specs) over a tracked local
+            f = node.func
+            if (
+                isinstance(f, ast.Name)
+                and f.id in ("tuple", "list")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+            ):
+                return env.lookup_spec(node.args[0].id)
+            return None
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out: list = []
+            for e in node.elts:
+                sub = self._parse_spec_expr(e, env)
+                if sub is None:
+                    return None
+                out.extend(sub)
+            return out
+        if isinstance(node, ast.BinOp):
+            # [P(None, "tp", None)] * 2 and listA + listB spec builders
+            if isinstance(node.op, ast.Mult):
+                return self._parse_spec_expr(node.left, env)
+            if isinstance(node.op, ast.Add):
+                left = self._parse_spec_expr(node.left, env)
+                right = self._parse_spec_expr(node.right, env)
+                if left is None or right is None:
+                    return None
+                return left + right
+            return None
+        if isinstance(node, ast.Name):
+            hit = env.lookup_spec(node.id)
+            if hit is not None:
+                return hit
+            return None
+        if isinstance(node, ast.Constant) and node.value is None:
+            return [{"t": "none"}]
+        return None
+
+    def _axisval(self, node, env):
+        """A call argument as an axis value for binding flow, or None
+        when uninteresting."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return _lit(node.value)
+        if isinstance(node, ast.Name):
+            ref = env.resolve_name(node.id)
+            if ref["t"] in ("lit", "param"):
+                return ref
+        return None
+
+    # -- the walk --
+
+    def extract_function(self, node, qual, cls, env_parent):
+        args = node.args
+        params = [
+            a.arg
+            for a in (*args.posonlyargs, *args.args, *args.kwonlyargs)
+        ]
+        defaults: dict = {}
+        pos = [*args.posonlyargs, *args.args]
+        for a, d in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            if isinstance(d, ast.Constant) and isinstance(d.value, str):
+                defaults[a.arg] = d.value
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if (
+                d is not None
+                and isinstance(d, ast.Constant)
+                and isinstance(d.value, str)
+            ):
+                defaults[a.arg] = d.value
+        env = _SpmdEnv(qual, params, env_parent)
+        fn = {
+            "name": node.name,
+            "qual": qual,
+            "cls": cls,
+            "line": node.lineno,
+            "params": [a.arg for a in (*args.posonlyargs, *args.args)],
+            "kwparams": [a.arg for a in args.kwonlyargs],
+            "defaults": defaults,
+            "collectives": [],
+            "host_syncs": [],
+            "tainted_branches": [],
+            "shard_maps": [],
+            "pspecs": [],
+            "calls": [],
+        }
+        self.functions.append(fn)
+        self._walk_body(node.body, fn, env, qual, cls, cond=False)
+        return fn
+
+    def _walk_body(self, body, fn, env, qual, cls, cond):
+        after_tainted_return = False
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.extract_function(
+                    stmt, f"{qual}.<locals>.{stmt.name}", cls, env
+                )
+                continue
+            self._walk_stmt(
+                stmt, fn, env, qual, cls, cond or after_tainted_return
+            )
+            if self._stmt_has_tainted_early_exit(stmt, env):
+                after_tainted_return = True
+
+    def _stmt_has_tainted_early_exit(self, stmt, env) -> bool:
+        """A tainted ``if`` that returns/raises makes everything after
+        it conditional on per-shard data."""
+        if not isinstance(stmt, ast.If):
+            return False
+        if not (_names_used(stmt.test) & env.tainted):
+            return False
+        return any(
+            isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break))
+            for branch in (stmt.body, stmt.orelse)
+            for s in branch
+        )
+
+    def _walk_stmt(self, stmt, fn, env, qual, cls, cond):
+        if isinstance(stmt, (ast.If, ast.While)):
+            tainted = bool(_names_used(stmt.test) & env.tainted)
+            if tainted:
+                fn["tainted_branches"].append(
+                    {
+                        "line": stmt.lineno,
+                        "test": ast.unparse(stmt.test)[:60],
+                        "noqa": self._noqa(stmt.lineno),
+                    }
+                )
+            self._walk_expr(stmt.test, fn, env, cond)
+            self._walk_body(
+                stmt.body, fn, env, qual, cls, cond or tainted
+            )
+            self._walk_body(
+                stmt.orelse, fn, env, qual, cls, cond or tainted
+            )
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._walk_expr(stmt.iter, fn, env, cond)
+            if _names_used(stmt.iter) & env.tainted:
+                for n in ast.walk(stmt.target):
+                    if isinstance(n, ast.Name):
+                        env.tainted.add(n.id)
+            self._walk_body(stmt.body, fn, env, qual, cls, cond)
+            self._walk_body(stmt.orelse, fn, env, qual, cls, cond)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._walk_expr(item.context_expr, fn, env, cond)
+            self._walk_body(stmt.body, fn, env, qual, cls, cond)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body, fn, env, qual, cls, cond)
+            for h in stmt.handlers:
+                self._walk_body(h.body, fn, env, qual, cls, cond)
+            self._walk_body(stmt.orelse, fn, env, qual, cls, cond)
+            self._walk_body(stmt.finalbody, fn, env, qual, cls, cond)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._track_assign(stmt, env)
+            self._walk_expr(stmt.value, fn, env, cond)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            # in_specs += [P(...)] extends a tracked spec list
+            if (
+                isinstance(stmt.target, ast.Name)
+                and isinstance(stmt.op, ast.Add)
+                and stmt.target.id in env.list_locals
+            ):
+                more = self._parse_spec_expr(stmt.value, env)
+                if more is not None:
+                    env.list_locals[stmt.target.id].extend(more)
+                else:
+                    del env.list_locals[stmt.target.id]
+            if _names_used(stmt.value) & env.tainted and isinstance(
+                stmt.target, ast.Name
+            ):
+                env.tainted.add(stmt.target.id)
+            self._walk_expr(stmt.value, fn, env, cond)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._walk_expr(stmt.value, fn, env, cond)
+            return
+        if isinstance(stmt, ast.Expr):
+            # in_specs.append(P(...))
+            v = stmt.value
+            if (
+                isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "append"
+                and isinstance(v.func.value, ast.Name)
+                and v.func.value.id in env.list_locals
+                and len(v.args) == 1
+            ):
+                more = self._parse_spec_expr(v.args[0], env)
+                if more is not None:
+                    env.list_locals[v.func.value.id].extend(more)
+                else:
+                    del env.list_locals[v.func.value.id]
+            self._walk_expr(stmt.value, fn, env, cond)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._walk_expr(child, fn, env, cond)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(child, fn, env, qual, cls, cond)
+
+    def _track_assign(self, stmt: ast.Assign, env):
+        if len(stmt.targets) != 1 or not isinstance(stmt.targets[0], ast.Name):
+            # tuple unpack from tainted rhs taints all targets
+            if _names_used(stmt.value) & env.tainted:
+                for t in stmt.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            env.tainted.add(n.id)
+            return
+        name = stmt.targets[0].id
+        v = stmt.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            env.str_locals[name] = v.value
+        elif isinstance(v, ast.Call) and self._is_pspec(v):
+            env.spec_locals[name] = self._parse_pspec_axes(v, env)
+        elif isinstance(v, (ast.Tuple, ast.List)):
+            spec = self._parse_spec_expr(v, env)
+            if spec is not None:
+                env.list_locals[name] = spec
+        elif (
+            isinstance(v, ast.Call)
+            and isinstance(v.func, ast.Name)
+            and v.func.id in ("tuple", "list")
+            and len(v.args) == 1
+            and isinstance(v.args[0], ast.Name)
+        ):
+            hit = env.lookup_spec(v.args[0].id)
+            if hit is not None:
+                env.list_locals[name] = list(hit)
+        if _names_used(v) & env.tainted:
+            env.tainted.add(name)
+
+    def _walk_expr(self, node, fn, env, cond):
+        if isinstance(node, ast.IfExp):
+            tainted = bool(_names_used(node.test) & env.tainted)
+            self._walk_expr(node.test, fn, env, cond)
+            self._walk_expr(node.body, fn, env, cond or tainted)
+            self._walk_expr(node.orelse, fn, env, cond or tainted)
+            return
+        if isinstance(node, (ast.Lambda,)):
+            return  # opaque; shard_map bodies are named functions here
+        if isinstance(node, ast.Call):
+            self._record_call(node, fn, env, cond)
+            if not isinstance(node.func, (ast.Name, ast.Attribute)):
+                # shard_map(...)(q, k, v): the wrap call lives in .func
+                self._walk_expr(node.func, fn, env, cond)
+            for a in node.args:
+                if isinstance(a, ast.Starred):
+                    self._walk_expr(a.value, fn, env, cond)
+                else:
+                    self._walk_expr(a, fn, env, cond)
+            for kw in node.keywords:
+                self._walk_expr(kw.value, fn, env, cond)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.expr, ast.keyword)):
+                self._walk_expr(
+                    child.value if isinstance(child, ast.keyword) else child,
+                    fn,
+                    env,
+                    cond,
+                )
+
+    def _record_call(self, call: ast.Call, fn, env, cond):
+        callee = callee_str(call.func)
+        f = call.func
+        final = None
+        if isinstance(f, ast.Attribute):
+            final = f.attr
+        elif isinstance(f, ast.Name):
+            final = f.id
+        line = call.lineno
+
+        # host syncs (DTPU013's raw material)
+        if (
+            isinstance(f, ast.Attribute)
+            and final == "item"
+            and not call.args
+            and not call.keywords
+        ):
+            fn["host_syncs"].append(
+                {"line": line, "what": ".item()", "noqa": self._noqa(line)}
+            )
+        elif isinstance(f, ast.Attribute) and final == "block_until_ready":
+            fn["host_syncs"].append(
+                {
+                    "line": line,
+                    "what": ".block_until_ready()",
+                    "noqa": self._noqa(line),
+                }
+            )
+        elif final == "device_get" and (
+            (isinstance(f, ast.Name) and self.imports.get(final) == "jax.device_get")
+            or (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and self.imports.get(f.value.id, f.value.id) == "jax"
+            )
+        ):
+            fn["host_syncs"].append(
+                {
+                    "line": line,
+                    "what": "jax.device_get()",
+                    "noqa": self._noqa(line),
+                }
+            )
+        elif final == "asarray" and isinstance(f, ast.Attribute) and isinstance(
+            f.value, ast.Name
+        ) and self._is_numpy(f.value.id):
+            fn["host_syncs"].append(
+                {
+                    "line": line,
+                    "what": "np.asarray()",
+                    "noqa": self._noqa(line),
+                }
+            )
+        elif final in ("pure_callback", "io_callback") or (
+            final == "callback"
+            and isinstance(f, ast.Attribute)
+            and isinstance(f.value, (ast.Attribute, ast.Name))
+            and (callee or "").split(".")[-2:-1] == ["debug"]
+        ):
+            fn["host_syncs"].append(
+                {
+                    "line": line,
+                    "what": f"host callback {final}()",
+                    "noqa": self._noqa(line),
+                }
+            )
+
+        # collectives
+        if final in COLLECTIVES and (
+            callee is None
+            or callee in (final, f"lax.{final}", f"jax.lax.{final}")
+            or callee.endswith(f".lax.{final}")
+        ):
+            axis_pos = COLLECTIVES[final]
+            axis_node = None
+            if len(call.args) > axis_pos:
+                axis_node = call.args[axis_pos]
+            else:
+                for kw in call.keywords:
+                    if kw.arg in ("axis_name", "axis"):
+                        axis_node = kw.value
+            if axis_node is None:
+                ref = {"t": "unk", "v": "<missing axis>"}
+            elif isinstance(axis_node, ast.Constant) and isinstance(
+                axis_node.value, str
+            ):
+                ref = _lit(axis_node.value)
+            elif isinstance(axis_node, ast.Name):
+                ref = env.resolve_name(axis_node.id)
+            elif isinstance(axis_node, (ast.Tuple, ast.List)):
+                refs = []
+                for e in axis_node.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        refs.append(_lit(e.value))
+                    elif isinstance(e, ast.Name):
+                        refs.append(env.resolve_name(e.id))
+                    else:
+                        refs.append({"t": "unk", "v": ast.unparse(e)[:40]})
+                for r in refs:
+                    fn["collectives"].append(
+                        {
+                            "line": line,
+                            "fn": final,
+                            "axis": r,
+                            "cond": cond,
+                            "noqa": self._noqa(line),
+                        }
+                    )
+                return
+            else:
+                ref = {"t": "unk", "v": ast.unparse(axis_node)[:40]}
+            fn["collectives"].append(
+                {
+                    "line": line,
+                    "fn": final,
+                    "axis": ref,
+                    "cond": cond,
+                    "noqa": self._noqa(line),
+                }
+            )
+
+        # shard_map(...) wrap sites
+        if final == "shard_map" and (call.keywords or len(call.args) > 1):
+            body_name = (
+                call.args[0].id
+                if call.args and isinstance(call.args[0], ast.Name)
+                else None
+            )
+            in_axes = out_axes = None
+            axis_names: list = []
+            unknown_specs = False
+            for kw in call.keywords:
+                if kw.arg == "in_specs":
+                    in_axes = self._parse_spec_expr(kw.value, env)
+                    unknown_specs |= in_axes is None
+                elif kw.arg == "out_specs":
+                    out_axes = self._parse_spec_expr(kw.value, env)
+                    unknown_specs |= out_axes is None
+                elif kw.arg == "axis_names" and isinstance(
+                    kw.value, (ast.Set, ast.Tuple, ast.List)
+                ):
+                    for e in kw.value.elts:
+                        if isinstance(e, ast.Constant) and isinstance(
+                            e.value, str
+                        ):
+                            axis_names.append(_lit(e.value))
+                        elif isinstance(e, ast.Name):
+                            axis_names.append(env.resolve_name(e.id))
+            fn["shard_maps"].append(
+                {
+                    "line": line,
+                    "body": body_name,
+                    "in_axes": in_axes or [],
+                    "out_axes": out_axes or [],
+                    "axis_names": axis_names,
+                    "unknown_specs": unknown_specs,
+                    "noqa": self._noqa(line),
+                }
+            )
+
+        # bare PartitionSpec construction (vocabulary check)
+        if self._is_pspec(call):
+            axes = self._parse_pspec_axes(call, env)
+            if axes:
+                fn["pspecs"].append(
+                    {"line": line, "axes": axes, "noqa": self._noqa(line)}
+                )
+
+        # calls (graph edges + axis-binding flow)
+        if callee is not None:
+            a = [self._axisval(x, env) for x in call.args]
+            k = {
+                kw.arg: self._axisval(kw.value, env)
+                for kw in call.keywords
+                if kw.arg is not None
+            }
+            k = {n: v for n, v in k.items() if v is not None}
+            fn["calls"].append(
+                {"line": line, "callee": callee, "a": a, "k": k}
+            )
+
+
+def extract_spmd_summary(src: str, relpath: str) -> dict:
+    """Pure per-file SPMD pass (cached by content hash, "spmd" section)."""
+    tree = ast.parse(src, filename=relpath)
+    lines = src.splitlines()
+    imports: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                imports[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    functions: list = []
+    ex = _SpmdExtractor(lines, imports, functions)
+
+    # module-level PartitionSpec literals (e.g. cache spec constants)
+    mod_fn = {
+        "name": "<module>",
+        "qual": "<module>",
+        "cls": None,
+        "line": 1,
+        "params": [],
+        "kwparams": [],
+        "defaults": {},
+        "collectives": [],
+        "host_syncs": [],
+        "tainted_branches": [],
+        "shard_maps": [],
+        "pspecs": [],
+        "calls": [],
+    }
+    mod_env = _SpmdEnv("<module>", [])
+    mod_env.tainted = set()  # nothing is per-shard at module level
+
+    def _walk_top(body, cls, prefix):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ex.extract_function(node, f"{prefix}{node.name}", cls, None)
+            elif isinstance(node, ast.ClassDef):
+                _walk_top(node.body, node.name, f"{node.name}.")
+            elif isinstance(node, (ast.Assign, ast.Expr)):
+                ex._walk_stmt(
+                    node, mod_fn, mod_env, "<module>", None, cond=False
+                )
+
+    _walk_top(tree.body, None, "")
+    if any(
+        mod_fn[k]
+        for k in ("collectives", "pspecs", "shard_maps", "host_syncs", "calls")
+    ):
+        functions.append(mod_fn)
+    return {"path": relpath, "imports": imports, "functions": functions}
+
+
+class SpmdFlow:
+    """Resolved SPMD index: axis-literal bindings per parameter, the
+    shard_map body set, reachability, per-body transitive collective
+    axes. Rules DTPU012-014 and shardcheck's static checks read this."""
+
+    def __init__(self, root: Path, summaries: list, vocab: frozenset):
+        self.root = root
+        self.vocab = vocab
+        self.summaries = summaries
+        self.funcs: dict = {}  # key "path::qual" -> fn summary dict
+        self.paths: dict = {}  # key -> path
+        self.by_name: dict = {}
+        self.module_symbols: dict = {}
+        self.imports: dict = {}
+        for s in summaries:
+            self.imports[s["path"]] = s.get("imports", {})
+            for f in s["functions"]:
+                key = f"{s['path']}::{f['qual']}"
+                self.funcs[key] = f
+                self.paths[key] = s["path"]
+                self.by_name.setdefault(f["name"], []).append(key)
+                if f["cls"] is None and ".<locals>." not in f["qual"]:
+                    self.module_symbols[(s["path"], f["name"])] = key
+        self._resolve_cache: dict = {}
+        self.callees: dict = {k: set() for k in self.funcs}
+        self.callers: dict = {k: set() for k in self.funcs}
+        self._build_graph()
+        self.bindings: dict = {}  # (path, qual, param) -> {lit: (path, line)}
+        self._bind_fixpoint()
+        self.bodies: set = self._find_bodies()
+        self.traced: set = self._traced_set()
+
+    # -- resolution --
+
+    def _module_for(self, dotted: str):
+        rel = dotted.replace(".", "/")
+        for cand in (f"{rel}.py", f"{rel}/__init__.py"):
+            if any(s["path"] == cand for s in self.summaries):
+                return cand
+        return None
+
+    def resolve(self, path: str, qual: str, callee: str) -> list:
+        return self.resolve_ex(path, qual, callee)[0]
+
+    def resolve_ex(self, path: str, qual: str, callee: str) -> tuple:
+        """→ (candidate keys, strict). ``strict`` is False when the
+        binding came from the by-name union fallback — a conservative
+        over-approximation good for reachability facts but too loose
+        for the axis-coverage check."""
+        ck = (path, qual, callee)
+        if ck in self._resolve_cache:
+            return self._resolve_cache[ck]
+        parts = callee.split(".")
+        final = parts[-1].split("()")[0]
+        out: list = []
+        strict = True
+        if len(parts) == 1:
+            # nested def in the enclosing chain, innermost first
+            q = qual
+            while True:
+                cand = f"{path}::{q}.<locals>.{final}"
+                if cand in self.funcs:
+                    out = [cand]
+                    break
+                if ".<locals>." not in q:
+                    break
+                q = q.rsplit(".<locals>.", 1)[0]
+            if not out:
+                key = self.module_symbols.get((path, final))
+                if key:
+                    out = [key]
+            if not out:
+                imp = self.imports.get(path, {}).get(final)
+                if imp and "." in imp:
+                    mod, name = imp.rsplit(".", 1)
+                    mpath = self._module_for(mod)
+                    if mpath:
+                        k = self.module_symbols.get((mpath, name))
+                        if k:
+                            out = [k]
+            if not out:
+                out = self._union(final)
+                strict = False
+        elif parts[0] == "self":
+            out = self._union(final)
+            strict = False
+        else:
+            # dotted: resolve the root through import aliases. An
+            # external module (imported but not indexed — jnp, np,
+            # torch) or an unknown receiver must NOT fall back to the
+            # name union: `jnp.stack` resolving to every local `stack`
+            # helper would drag host-side code into the traced set.
+            root_name = parts[0].split("()")[0]
+            imp = self.imports.get(path, {}).get(root_name)
+            if imp and len(parts) == 2:
+                mpath = self._module_for(imp)
+                if mpath:
+                    k = self.module_symbols.get((mpath, final))
+                    out = [k] if k else []
+        res = (out, strict)
+        self._resolve_cache[ck] = res
+        return res
+
+    def _union(self, name: str) -> list:
+        if name in _UNION_BLOCKLIST or name in (
+            "jit", "vmap", "scan", "partial", "checkpoint", "forward",
+        ):
+            return []
+        return list(self.by_name.get(name, []))
+
+    def _build_graph(self) -> None:
+        self.callees_strict: dict = {k: set() for k in self.funcs}
+        for key, f in self.funcs.items():
+            path = self.paths[key]
+            for call in f["calls"]:
+                tgts, strict = self.resolve_ex(path, f["qual"], call["callee"])
+                for tgt in tgts:
+                    self.callees[key].add(tgt)
+                    self.callers[tgt].add(key)
+                    if strict:
+                        self.callees_strict[key].add(tgt)
+            # closure edge: a nested def runs under its enclosing fn
+            if ".<locals>." in f["qual"]:
+                outer = f"{path}::{f['qual'].rsplit('.<locals>.', 1)[0]}"
+                if outer in self.funcs:
+                    self.callees[outer].add(key)
+                    self.callers[key].add(outer)
+
+    # -- axis-literal binding fixpoint --
+
+    def _bind_key(self, path, qual, param):
+        return (path, qual, param)
+
+    def _bind_fixpoint(self) -> None:
+        binds = self.bindings
+        for key, f in self.funcs.items():
+            path = self.paths[key]
+            for p, lit in f["defaults"].items():
+                binds.setdefault(self._bind_key(path, f["qual"], p), {})[
+                    lit
+                ] = (path, f["line"])
+        changed = True
+        while changed:
+            changed = False
+            for key, f in self.funcs.items():
+                path = self.paths[key]
+                for call in f["calls"]:
+                    tgts = self.resolve(path, f["qual"], call["callee"])
+                    for tgt in tgts:
+                        g = self.funcs[tgt]
+                        gpath = self.paths[tgt]
+                        pairs = []
+                        for i, v in enumerate(call["a"]):
+                            if v is not None and i < len(g["params"]):
+                                pairs.append((g["params"][i], v))
+                        for n, v in call["k"].items():
+                            if n in g["params"] or n in g["kwparams"]:
+                                pairs.append((n, v))
+                        for pname, v in pairs:
+                            bk = self._bind_key(gpath, g["qual"], pname)
+                            cur = binds.setdefault(bk, {})
+                            if v["t"] == "lit":
+                                if v["v"] not in cur:
+                                    cur[v["v"]] = (path, call["line"])
+                                    changed = True
+                            elif v["t"] == "param":
+                                src = binds.get(
+                                    self._bind_key(path, v["fq"], v["p"]), {}
+                                )
+                                for lit, origin in src.items():
+                                    if lit not in cur:
+                                        cur[lit] = origin
+                                        changed = True
+
+    def resolve_axis(self, path: str, ref: dict) -> Optional[dict]:
+        """Axis reference → {literal: origin} map; None = unresolvable."""
+        if ref["t"] == "lit":
+            return {ref["v"]: (path, 0)}
+        if ref["t"] == "param":
+            hit = self.bindings.get(
+                self._bind_key(path, ref["fq"], ref["p"]), {}
+            )
+            return hit or None
+        if ref["t"] == "none":
+            return {}
+        return None
+
+    # -- traced-set computation --
+
+    def _find_bodies(self) -> set:
+        """All functions a shard_map site may wrap. A body named by a
+        plain variable (``local_fn = _make_ring(...)``) resolves by
+        name union — every same-named candidate is a possible body
+        (the ring/ulysses impl dispatch really does pick between
+        them), so sites carry the full candidate list."""
+        bodies: set = set()
+        self.body_sites: list = []  # (wrapping-fn key, sm event, [body keys])
+        for key, f in self.funcs.items():
+            path = self.paths[key]
+            for sm in f["shard_maps"]:
+                cands: list = []
+                if sm["body"]:
+                    cands = self.resolve(path, f["qual"], sm["body"])
+                bodies.update(cands)
+                self.body_sites.append((key, sm, cands))
+        return bodies
+
+    def _descendants(self, seeds: set) -> set:
+        seen = set(seeds)
+        frontier = list(seeds)
+        while frontier:
+            k = frontier.pop()
+            for tgt in self.callees.get(k, ()):
+                if tgt not in seen:
+                    seen.add(tgt)
+                    frontier.append(tgt)
+        return seen
+
+    def _traced_set(self) -> set:
+        seeds = set(self.bodies)
+        for key, f in self.funcs.items():
+            if f["collectives"]:
+                seeds.add(key)
+        return self._descendants(seeds)
+
+    def transitive_collective_axes(self, body_key: str) -> list:
+        """Collective axis refs attributable to ``body_key`` →
+        [(owner_key, event)]. Follows strict (non-union) call edges
+        plus the body's lexical sibling closures — a custom_vjp's
+        fwd/bwd live beside the shard_map body inside the same
+        factory and run under the same mapping, but no syntactic call
+        connects them. Union edges are excluded: they would attribute
+        another wrapper's collectives to this body (e.g. the pipeline
+        body union-reaching attention code it never traces)."""
+        seeds = {body_key}
+        qual = self.funcs[body_key]["qual"]
+        path = self.paths[body_key]
+        if ".<locals>." in qual:
+            prefix = qual.rsplit(".<locals>.", 1)[0] + ".<locals>."
+            for k, f in self.funcs.items():
+                if self.paths[k] == path and f["qual"].startswith(prefix):
+                    seeds.add(k)
+        seen = set(seeds)
+        frontier = list(seeds)
+        while frontier:
+            k = frontier.pop()
+            for tgt in self.callees_strict.get(k, ()):
+                if tgt not in seen:
+                    seen.add(tgt)
+                    frontier.append(tgt)
+        out: list = []
+        for k in sorted(seen):
+            for ev in self.funcs[k]["collectives"]:
+                out.append((k, ev))
+        return out
+
+    def functions_items(self):
+        return self.funcs.items()
+
+
+_spmd_memo: dict = {}
+
+
+def get_spmd_flow(
+    root: Path, cache_path: Optional[Path] = CACHE_PATH
+) -> SpmdFlow:
+    root = Path(root).resolve()
+    if cache_path is CACHE_PATH:
+        from tools.dtpu_lint.core import REPO
+
+        if root != Path(REPO).resolve():
+            cache_path = None  # fixture trees must not churn the cache
+    rels = _glob_many(root, SPMD_GLOBS)
+    sources: dict = {}
+    digests: dict = {}
+    for rel in rels:
+        try:
+            raw = (root / rel).read_bytes()
+        except OSError:
+            continue
+        sources[rel] = raw
+        digests[rel] = _sha1(raw)
+    state = _sha1(
+        json.dumps(sorted(digests.items())).encode()
+        + analyzer_version().encode()
+    )
+    hit = _spmd_memo.get(str(root))
+    if hit is not None and hit[0] == state:
+        return hit[1]
+
+    cached = _load_cache(cache_path, "spmd") if cache_path else {}
+    fresh: dict = {}
+    summaries: list = []
+    for rel, raw in sorted(sources.items()):
+        d = digests[rel]
+        prev = cached.get(d)
+        if prev is not None and prev.get("path") == rel:
+            summaries.append(prev)
+            fresh[d] = prev
+            continue
+        try:
+            summary = extract_spmd_summary(raw.decode("utf-8"), rel)
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # DTPU000 reports unparseable files already
+        summaries.append(summary)
+        fresh[d] = summary
+    if cache_path and fresh != cached:
+        _store_cache(cache_path, fresh, "spmd")
+
+    flow = SpmdFlow(root, summaries, axis_vocabulary(root))
+    _spmd_memo[str(root)] = (state, flow)
     return flow

@@ -12,4 +12,5 @@ from tools.dtpu_lint.rules import (  # noqa: F401
     retry_after,
     settings_drift,
     silent_except,
+    spmd,
 )

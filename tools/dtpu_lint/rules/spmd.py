@@ -1,0 +1,257 @@
+"""DTPU012-014: SPMD sharding discipline for the multi-host serve surface.
+
+ROADMAP item 1 promotes ``parallel/`` and the tp2 dryrun into
+multi-host serving, where the failure modes are categorically worse
+than single-host: a typo'd mesh-axis name fails at trace time on the
+fleet (the most expensive place to find it), and a collective that
+only *some* members execute — because a host-side Python branch
+diverged, or because a host sync forced per-host values — is not a
+crash but a fleet-wide deadlock: every other member blocks in the
+collective waiting for the missing participant until the job is
+killed from outside. These rules make those shapes fail in tier-1 CI
+on CPU instead (flow.py's SPMD index; see also tools/shardcheck for
+the dynamic abstract-trace gate):
+
+- **DTPU012** sharding discipline — axis names passed to collectives
+  (``psum``/``all_gather``/``ppermute``/``axis_index``/``pmean``/...),
+  ``shard_map`` ``in_specs``/``out_specs``/``axis_names``, and
+  ``PartitionSpec`` literals must resolve to string literals drawn
+  from the mesh-axis vocabulary declared in ``parallel/mesh.py``
+  (``AXES``). The library idiom threads axis names through parameters
+  (``axis_name: str = "sp"``) and closures, so resolution follows the
+  interprocedural binding fixpoint in :class:`flow.SpmdFlow` — a
+  literal is checked wherever it *enters* the flow (the call site
+  passing ``axis_name="typo"`` gets the finding, not the collective
+  ten frames below).
+- **DTPU013** SPMD purity — no host syncs (``.item()``,
+  ``jax.device_get``, ``np.asarray``, ``.block_until_ready()``), no
+  host callbacks (``pure_callback``/``io_callback``/
+  ``jax.debug.callback``) anywhere in shard_map-reachable code, and no
+  Python branching on traced per-shard values inside ``shard_map``
+  bodies (body parameters are per-shard arrays by construction; a
+  branch on one diverges per member).
+- **DTPU014** collective discipline — every collective reachable from
+  a ``shard_map`` body must execute unconditionally on all members
+  (no collective under a Python ``if``/``while``/early-``return`` on
+  per-shard data, interprocedurally), and every axis a body's
+  collectives use must appear in that ``shard_map``'s specs or
+  ``axis_names`` (an unbound axis is a trace-time NameError on the
+  fleet).
+
+Opt-outs: ``# dtpu: noqa[DTPU01x] reason`` on the offending line (or
+the comment/decorator block above it), same contract as every rule.
+"""
+
+from pathlib import Path
+
+from tools.dtpu_lint.core import Finding, ProjectRule, register
+from tools.dtpu_lint.flow import SPMD_GLOBS, get_spmd_flow
+
+
+def _vocab_str(vocab) -> str:
+    return "{" + ", ".join(sorted(vocab)) + "}"
+
+
+class _SpmdRuleBase(ProjectRule):
+    #: participates in --changed-only runs when a changed file matches
+    scope = SPMD_GLOBS
+
+    def _flow(self, repo: Path):
+        return get_spmd_flow(Path(repo))
+
+
+@register
+class SpmdShardingRule(_SpmdRuleBase):
+    id = "DTPU012"
+    name = "mesh-axis names must be literals from parallel/mesh.py AXES"
+
+    def check_project(self, repo):
+        flow = self._flow(repo)
+        vocab = flow.vocab
+        if not vocab:
+            return []  # no declared vocabulary to check against
+        out: set = set()
+
+        def emit(path, line, msg):
+            out.add(Finding(self.id, path, line, msg))
+
+        def check_ref(path, line, ref, what, noqa):
+            if "DTPU012" in noqa:
+                return
+            if ref["t"] == "none":
+                return
+            if ref["t"] == "lit":
+                if ref["v"] not in vocab:
+                    emit(
+                        path, line,
+                        f"{what}: axis '{ref['v']}' is not a declared mesh "
+                        f"axis {_vocab_str(vocab)} (parallel/mesh.py AXES)",
+                    )
+                return
+            if ref["t"] == "param":
+                binds = flow.resolve_axis(path, ref)
+                if binds is None:
+                    emit(
+                        path, line,
+                        f"{what}: axis flows through param "
+                        f"'{ref['p']}' of {ref['fq']} with no string "
+                        "default and no literal call site — not "
+                        "statically resolvable to a mesh axis",
+                    )
+                    return
+                for lit, (opath, oline) in sorted(binds.items()):
+                    if lit not in vocab:
+                        emit(
+                            opath, oline or line,
+                            f"axis '{lit}' bound to param '{ref['p']}' of "
+                            f"{ref['fq']} is not a declared mesh axis "
+                            f"{_vocab_str(vocab)} (parallel/mesh.py AXES)",
+                        )
+                return
+            emit(
+                path, line,
+                f"{what}: axis is not a static string "
+                f"(got `{ref.get('v', '?')}`)",
+            )
+
+        for key, f in flow.functions_items():
+            path = flow.paths[key]
+            for ev in f["collectives"]:
+                check_ref(
+                    path, ev["line"], ev["axis"],
+                    f"collective {ev['fn']}() in {f['name']}",
+                    set(ev.get("noqa", ())),
+                )
+            for sm in f["shard_maps"]:
+                noqa = set(sm.get("noqa", ()))
+                if sm["unknown_specs"] and "DTPU012" not in noqa:
+                    emit(
+                        path, sm["line"],
+                        f"shard_map in {f['name']}: in_specs/out_specs not "
+                        "statically resolvable to PartitionSpec literals",
+                    )
+                for ref in (*sm["in_axes"], *sm["out_axes"], *sm["axis_names"]):
+                    check_ref(
+                        path, sm["line"], ref,
+                        f"shard_map spec in {f['name']}", noqa,
+                    )
+            for ps in f["pspecs"]:
+                noqa = set(ps.get("noqa", ()))
+                for ref in ps["axes"]:
+                    # bare PartitionSpec constructions are literal-checked
+                    # only: dynamic spec builders (sharding.py's
+                    # logical→mesh translation) are legitimate
+                    if ref["t"] == "lit":
+                        check_ref(
+                            path, ps["line"], ref,
+                            f"PartitionSpec in {f['name']}", noqa,
+                        )
+        return sorted(out, key=lambda f: (f.path, f.line, f.message))
+
+
+@register
+class SpmdPurityRule(_SpmdRuleBase):
+    id = "DTPU013"
+    name = "no host syncs/callbacks/per-shard branches in SPMD-traced code"
+
+    def check_project(self, repo):
+        flow = self._flow(repo)
+        out: list = []
+        for key in sorted(flow.traced):
+            f = flow.funcs[key]
+            path = flow.paths[key]
+            for ev in f["host_syncs"]:
+                if "DTPU013" in set(ev.get("noqa", ())):
+                    continue
+                out.append(
+                    Finding(
+                        self.id, path, ev["line"],
+                        f"host sync {ev['what']} in SPMD-traced code "
+                        f"[in {f['name']}] — on multi-host this forces a "
+                        "per-host value where members must agree "
+                        "(deadlock around the next collective)",
+                    )
+                )
+        for key in sorted(flow.bodies):
+            f = flow.funcs[key]
+            path = flow.paths[key]
+            for ev in f["tainted_branches"]:
+                if "DTPU013" in set(ev.get("noqa", ())):
+                    continue
+                out.append(
+                    Finding(
+                        self.id, path, ev["line"],
+                        f"Python branch on per-shard value "
+                        f"`{ev['test']}` inside shard_map body "
+                        f"[in {f['name']}] — use lax.cond/jnp.where; a "
+                        "host branch diverges per member",
+                    )
+                )
+        return sorted(out, key=lambda f: (f.path, f.line, f.message))
+
+
+@register
+class SpmdCollectiveRule(_SpmdRuleBase):
+    id = "DTPU014"
+    name = "collectives unconditional + axes covered by shard_map specs"
+
+    def check_project(self, repo):
+        flow = self._flow(repo)
+        out: set = set()
+        for key in sorted(flow.traced):
+            f = flow.funcs[key]
+            path = flow.paths[key]
+            for ev in f["collectives"]:
+                if not ev.get("cond"):
+                    continue
+                if "DTPU014" in set(ev.get("noqa", ())):
+                    continue
+                out.add(
+                    Finding(
+                        self.id, path, ev["line"],
+                        f"collective {ev['fn']}() under data-dependent "
+                        f"Python control flow [in {f['name']}] — members "
+                        "that skip it leave the rest of the fleet blocked "
+                        "in the collective (use lax.cond so every member "
+                        "traces both paths)",
+                    )
+                )
+        # axis coverage: body's transitive collective axes ⊆ site specs
+        for wkey, sm, body_keys in flow.body_sites:
+            if not body_keys:
+                continue
+            noqa = set(sm.get("noqa", ()))
+            if "DTPU014" in noqa or sm["unknown_specs"]:
+                continue
+            path = flow.paths[wkey]
+            spec_lits: set = set()
+            resolvable = True
+            for ref in (*sm["in_axes"], *sm["out_axes"], *sm["axis_names"]):
+                binds = flow.resolve_axis(path, ref)
+                if binds is None:
+                    resolvable = False  # DTPU012's finding, not ours
+                    continue
+                spec_lits.update(binds)
+            if not resolvable:
+                continue
+            for body_key in body_keys:
+                bname = flow.funcs[body_key]["name"]
+                for okey, ev in flow.transitive_collective_axes(body_key):
+                    if "DTPU014" in set(ev.get("noqa", ())):
+                        continue
+                    binds = flow.resolve_axis(flow.paths[okey], ev["axis"])
+                    if binds is None:
+                        continue
+                    for lit in sorted(binds):
+                        if lit not in spec_lits:
+                            out.add(
+                                Finding(
+                                    self.id, path, sm["line"],
+                                    f"shard_map body '{bname}' runs "
+                                    f"{ev['fn']}() over axis '{lit}' which "
+                                    "appears in neither in_specs/out_specs "
+                                    "nor axis_names — unbound axis at "
+                                    "trace time on the fleet",
+                                )
+                            )
+        return sorted(out, key=lambda f: (f.path, f.line, f.message))

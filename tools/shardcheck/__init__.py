@@ -1,0 +1,25 @@
+"""shardcheck: device-free SPMD verification of the serve jit surface.
+
+``python -m tools.shardcheck`` abstractly traces every entry in the
+registered manifest (tools/shardcheck/manifest.py) with
+``jax.eval_shape`` over :class:`jax.sharding.AbstractMesh` grids —
+tp2, tp4, dp2×tp2 — on CPU, with zero devices of any mesh shape
+attached. What an abstract trace catches *before* a fleet does:
+
+- a typo'd mesh-axis name in ``shard_map`` specs or a collective
+  (``KeyError``/``NameError`` at trace time — on a real deployment
+  that is a multi-host trace failure at the most expensive moment);
+- shapes not divisible by the mesh axes they shard over
+  (``ValueError`` from shard_map's evenness check);
+- an engine jit signature drifting from its manifest contract
+  (output shapes/dtypes, cache-donation structure).
+
+The manifest-coverage check keeps the gate honest: every named jit
+site the engine registers through ``_watch``/``_watch_jit`` must have
+a manifest entry, so a new jit site cannot ship unverified — adding
+one without registering it fails ``python -m tools.shardcheck`` (and
+tier-1 CI) until a manifest entry exists. ``--validate`` runs the
+offline subset (manifest well-formedness + coverage scan) without
+importing JAX. Companion static gate: dtpu-lint DTPU012-014
+(docs/reference/lint.md).
+"""
